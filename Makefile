@@ -1,0 +1,33 @@
+# Builds the native core: libbrpc_core.so (C++ host runtime).
+# The compute path is JAX/XLA; this library is the bRPC-shaped host core:
+# IOBuf, resource pools, work-stealing executor, timers, epoll socket core,
+# wire framing, and bvar combiners.  Python binds it via ctypes
+# (brpc_tpu/_core/lib.py).
+
+CXX      ?= g++
+CXXFLAGS ?= -O2 -g -std=c++20 -fPIC -Wall -Wextra -Wno-unused-parameter -pthread
+LDFLAGS  ?= -shared -pthread
+
+SRC := $(wildcard src/cc/butil/*.cc) \
+       $(wildcard src/cc/bthread/*.cc) \
+       $(wildcard src/cc/net/*.cc) \
+       $(wildcard src/cc/bvar/*.cc) \
+       $(wildcard src/cc/*.cc)
+OBJ := $(SRC:.cc=.o)
+LIB := brpc_tpu/_core/libbrpc_core.so
+
+all: $(LIB)
+
+$(LIB): $(OBJ)
+	$(CXX) $(LDFLAGS) -o $@ $(OBJ)
+
+%.o: %.cc
+	$(CXX) $(CXXFLAGS) -Isrc/cc -c -o $@ $<
+
+clean:
+	rm -f $(OBJ) $(LIB)
+
+test: $(LIB)
+	python -m pytest tests/ -x -q
+
+.PHONY: all clean test

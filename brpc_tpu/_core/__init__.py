@@ -1,0 +1,12 @@
+from brpc_tpu._core.lib import (  # noqa: F401
+    core,
+    core_init,
+    core_shutdown,
+    IOBuf,
+    MESSAGE_CB,
+    FAILED_CB,
+    ACCEPTED_CB,
+    TASK_CB,
+    MSG_TRPC,
+    MSG_HTTP,
+)

@@ -1,0 +1,180 @@
+"""ctypes bindings to libbrpc_core.so — the native host core.
+
+The native core owns the transport hot path (epoll dispatchers, wait-free
+socket writes, frame parsing, IOBuf block management, work-stealing executor,
+timer thread); Python is the protocol/API layer above it, mirroring how the
+reference layers generated protobuf stubs over its C++ core.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+MSG_TRPC = 0
+MSG_HTTP = 1
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_libpath = os.path.join(_here, "libbrpc_core.so")
+
+
+def _build_if_needed() -> None:
+    if os.path.exists(_libpath):
+        return
+    repo = os.path.dirname(os.path.dirname(_here))
+    subprocess.run(["make", "-j8"], cwd=repo, check=True,
+                   stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+_build_if_needed()
+core = ctypes.CDLL(_libpath)
+
+# Callback signatures (see src/cc/capi.cc).
+# meta is c_void_p, NOT c_char_p: meta is opaque binary (may contain NULs) and
+# ctypes would strlen-truncate a c_char_p argument.  Read it with
+# ctypes.string_at(meta, meta_len).
+MESSAGE_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_int,
+                              ctypes.c_void_p, ctypes.c_size_t,
+                              ctypes.c_void_p, ctypes.c_void_p)
+FAILED_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_int,
+                             ctypes.c_void_p)
+ACCEPTED_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_uint64,
+                               ctypes.c_void_p)
+TASK_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+DELETER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
+
+_sigs = {
+    "brpc_core_init": (None, [ctypes.c_int, ctypes.c_int]),
+    "brpc_core_shutdown": (None, []),
+    "brpc_set_min_log_level": (None, [ctypes.c_int]),
+    "brpc_iobuf_new": (ctypes.c_void_p, []),
+    "brpc_iobuf_free": (None, [ctypes.c_void_p]),
+    "brpc_iobuf_clear": (None, [ctypes.c_void_p]),
+    "brpc_iobuf_size": (ctypes.c_size_t, [ctypes.c_void_p]),
+    "brpc_iobuf_block_num": (ctypes.c_size_t, [ctypes.c_void_p]),
+    "brpc_iobuf_append": (None, [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]),
+    "brpc_iobuf_append_iobuf": (None, [ctypes.c_void_p, ctypes.c_void_p]),
+    "brpc_iobuf_copy_to": (ctypes.c_size_t, [ctypes.c_void_p, ctypes.c_void_p,
+                                             ctypes.c_size_t, ctypes.c_size_t]),
+    "brpc_iobuf_cutn": (ctypes.c_size_t, [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_size_t]),
+    "brpc_iobuf_pop_front": (ctypes.c_size_t, [ctypes.c_void_p, ctypes.c_size_t]),
+    "brpc_iobuf_append_user_data": (None, [ctypes.c_void_p, ctypes.c_void_p,
+                                           ctypes.c_size_t, DELETER_CB,
+                                           ctypes.c_void_p]),
+    "brpc_iobuf_live_blocks": (ctypes.c_int64, []),
+    "brpc_executor_submit": (None, [TASK_CB, ctypes.c_void_p]),
+    "brpc_executor_tasks_executed": (ctypes.c_int64, []),
+    "brpc_executor_steals": (ctypes.c_int64, []),
+    "brpc_executor_num_workers": (ctypes.c_int, []),
+    "brpc_timer_add": (ctypes.c_uint64, [TASK_CB, ctypes.c_void_p, ctypes.c_int64]),
+    "brpc_timer_cancel": (ctypes.c_int, [ctypes.c_uint64]),
+    "brpc_timer_fired": (ctypes.c_int64, []),
+    "brpc_now_us": (ctypes.c_int64, []),
+    "brpc_listen": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_int, MESSAGE_CB,
+                                   FAILED_CB, ACCEPTED_CB, ctypes.c_void_p,
+                                   ctypes.c_int, ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.POINTER(ctypes.c_int)]),
+    "brpc_connect": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_int, MESSAGE_CB,
+                                    FAILED_CB, ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint64)]),
+    "brpc_socket_write_frame": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_char_p,
+                                               ctypes.c_size_t, ctypes.c_char_p,
+                                               ctypes.c_size_t, ctypes.c_void_p]),
+    "brpc_socket_write_raw": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_char_p,
+                                             ctypes.c_size_t, ctypes.c_void_p]),
+    "brpc_socket_set_failed": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_int]),
+    "brpc_socket_alive": (ctypes.c_int, [ctypes.c_uint64]),
+    "brpc_socket_stats": (ctypes.c_int, [ctypes.c_uint64,
+                                         ctypes.POINTER(ctypes.c_int64),
+                                         ctypes.POINTER(ctypes.c_int64),
+                                         ctypes.POINTER(ctypes.c_int64),
+                                         ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_int)]),
+    "brpc_socket_active_count": (ctypes.c_int64, []),
+}
+for _name, (_res, _args) in _sigs.items():
+    fn = getattr(core, _name)
+    fn.restype = _res
+    fn.argtypes = _args
+
+_init_lock = threading.Lock()
+_initialized = False
+
+
+def core_init(num_workers: int = 0, num_dispatchers: int = 2) -> None:
+    """Start the native executor, dispatchers and timer thread (idempotent)."""
+    global _initialized
+    with _init_lock:
+        if not _initialized:
+            core.brpc_core_init(num_workers, num_dispatchers)
+            _initialized = True
+
+
+def core_shutdown() -> None:
+    global _initialized
+    with _init_lock:
+        if _initialized:
+            core.brpc_core_shutdown()
+            _initialized = False
+
+
+class IOBuf:
+    """Python view of a native zero-copy chained buffer.
+
+    Wraps the native butil::IOBuf (src/cc/butil/iobuf.h).  Appending shares
+    or copies into refcounted 8KB blocks; moving data between IOBufs
+    (``append_iobuf``, ``cutn``) never copies payload bytes.
+    """
+
+    __slots__ = ("handle", "_owned")
+
+    def __init__(self, data: bytes | None = None, *, handle: int | None = None):
+        if handle is not None:
+            self.handle = handle
+            self._owned = True
+        else:
+            self.handle = core.brpc_iobuf_new()
+            self._owned = True
+        if data:
+            self.append(data)
+
+    def __del__(self):
+        h = getattr(self, "handle", None)
+        if h and self._owned:
+            core.brpc_iobuf_free(h)
+            self.handle = None
+
+    def __len__(self) -> int:
+        return core.brpc_iobuf_size(self.handle)
+
+    @property
+    def block_count(self) -> int:
+        return core.brpc_iobuf_block_num(self.handle)
+
+    def append(self, data: bytes) -> None:
+        core.brpc_iobuf_append(self.handle, data, len(data))
+
+    def append_iobuf(self, other: "IOBuf") -> None:
+        core.brpc_iobuf_append_iobuf(self.handle, other.handle)
+
+    def cutn(self, n: int) -> "IOBuf":
+        out = IOBuf()
+        core.brpc_iobuf_cutn(self.handle, out.handle, n)
+        return out
+
+    def pop_front(self, n: int) -> int:
+        return core.brpc_iobuf_pop_front(self.handle, n)
+
+    def to_bytes(self, n: int | None = None, pos: int = 0) -> bytes:
+        size = len(self)
+        if n is None:
+            n = size - pos
+        n = max(0, min(n, size - pos))
+        buf = ctypes.create_string_buffer(n)
+        got = core.brpc_iobuf_copy_to(self.handle, buf, n, pos)
+        return buf.raw[:got]
+
+    def clear(self) -> None:
+        core.brpc_iobuf_clear(self.handle)

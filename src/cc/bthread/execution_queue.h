@@ -1,0 +1,105 @@
+// ExecutionQueue — MPSC serialized executor (SURVEY.md §2.2; reference
+// src/bthread/execution_queue.h:35-187).
+//
+// Producers push nodes onto a lock-free Treiber stack; the first producer to
+// make the queue non-empty schedules one drain task on the Executor, which
+// reverses the stack into FIFO order and feeds batches to the consumer
+// callback.  Exactly one drain runs at a time, so consumption is serialized
+// without a mutex — the property streams rely on for in-order delivery
+// (reference stream_impl.h:133).
+#pragma once
+
+#include <atomic>
+#include <functional>
+#include <thread>
+
+#include "bthread/executor.h"
+
+namespace bthread {
+
+template <typename T>
+class ExecutionQueue {
+ public:
+  // consume(item) is called serially, in push order.
+  ExecutionQueue(Executor* ex, std::function<void(T&)> consume)
+      : _ex(ex), _consume(std::move(consume)) {}
+
+  ~ExecutionQueue() {
+    // Callers must stop producers first.  A drain task submitted by the last
+    // producer may not have finished (or even started); _inflight covers the
+    // whole drain lambda, so waiting on it prevents a use-after-free of the
+    // pending `this` capture.
+    while (_inflight.load(std::memory_order_acquire) != 0) {
+      std::this_thread::yield();
+    }
+    Node* head = _head.exchange(nullptr, std::memory_order_acquire);
+    while (head != nullptr) {
+      Node* next = head->next;
+      delete head;
+      head = next;
+    }
+  }
+
+  void execute(T value) {
+    Node* n = new Node{std::move(value), nullptr};
+    Node* old = _head.load(std::memory_order_relaxed);
+    do {
+      n->next = old;
+    } while (!_head.compare_exchange_weak(old, n, std::memory_order_seq_cst,
+                                          std::memory_order_relaxed));
+    // Become the drainer unless one is already running.  seq_cst on the push
+    // and this exchange (and on the drainer's release+recheck) guarantees
+    // that either we take the busy flag or the active drainer sees our node.
+    if (!_busy.exchange(true, std::memory_order_seq_cst)) {
+      _inflight.fetch_add(1, std::memory_order_acq_rel);
+      _ex->submit([this] {
+        drain();
+        _inflight.fetch_sub(1, std::memory_order_acq_rel);
+      });
+    }
+  }
+
+ private:
+  struct Node {
+    T value;
+    Node* next;
+  };
+
+  void drain() {
+    while (true) {
+      Node* head = _head.exchange(nullptr, std::memory_order_seq_cst);
+      if (head == nullptr) {
+        _busy.store(false, std::memory_order_seq_cst);
+        // Recheck: a producer may have pushed between our exchange and the
+        // release; if so and nobody claimed the flag, keep draining.
+        if (_head.load(std::memory_order_seq_cst) != nullptr &&
+            !_busy.exchange(true, std::memory_order_seq_cst)) {
+          continue;
+        }
+        return;
+      }
+      // Reverse to FIFO.
+      Node* prev = nullptr;
+      while (head != nullptr) {
+        Node* next = head->next;
+        head->next = prev;
+        prev = head;
+        head = next;
+      }
+      while (prev != nullptr) {
+        _consume(prev->value);
+        Node* next = prev->next;
+        delete prev;
+        prev = next;
+      }
+    }
+  }
+
+  Executor* _ex;
+  std::function<void(T&)> _consume;
+  std::atomic<Node*> _head{nullptr};
+  std::atomic<bool> _busy{false};
+  std::atomic<int> _inflight{0};
+};
+
+}  // namespace bthread

@@ -1,0 +1,232 @@
+#include "bthread/executor.h"
+
+#include "butil/common.h"
+
+namespace bthread {
+
+// ---- WorkStealingQueue (Chase-Lev) ----
+
+WorkStealingQueue::WorkStealingQueue(size_t cap) : _cap(cap) {
+  _buf = new std::atomic<TaskNode*>[cap];
+}
+WorkStealingQueue::~WorkStealingQueue() { delete[] _buf; }
+
+bool WorkStealingQueue::push(TaskNode* t) {
+  const int64_t b = _bottom.load(std::memory_order_relaxed);
+  const int64_t top = _top.load(std::memory_order_acquire);
+  if (b - top >= (int64_t)_cap) return false;
+  _buf[b % _cap].store(t, std::memory_order_relaxed);
+  _bottom.store(b + 1, std::memory_order_release);
+  return true;
+}
+
+TaskNode* WorkStealingQueue::pop() {
+  int64_t b = _bottom.load(std::memory_order_relaxed);
+  if (b == _top.load(std::memory_order_relaxed)) return nullptr;
+  --b;
+  _bottom.store(b, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  int64_t t = _top.load(std::memory_order_relaxed);
+  TaskNode* task = _buf[b % _cap].load(std::memory_order_relaxed);
+  if (t < b) return task;  // more than one element left
+  bool won = true;
+  if (t == b) {
+    // Last element: race with thieves via CAS on top.
+    won = _top.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                       std::memory_order_relaxed);
+  } else {
+    won = false;
+  }
+  _bottom.store(b + 1, std::memory_order_relaxed);
+  return won ? task : nullptr;
+}
+
+TaskNode* WorkStealingQueue::steal() {
+  int64_t t = _top.load(std::memory_order_acquire);
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+  const int64_t b = _bottom.load(std::memory_order_acquire);
+  if (t >= b) return nullptr;
+  TaskNode* task = _buf[t % _cap].load(std::memory_order_relaxed);
+  if (!_top.compare_exchange_strong(t, t + 1, std::memory_order_seq_cst,
+                                    std::memory_order_relaxed)) {
+    return nullptr;
+  }
+  return task;
+}
+
+size_t WorkStealingQueue::volatile_size() const {
+  const int64_t b = _bottom.load(std::memory_order_relaxed);
+  const int64_t t = _top.load(std::memory_order_relaxed);
+  return b > t ? (size_t)(b - t) : 0;
+}
+
+// ---- ParkingLot ----
+
+void ParkingLot::signal(int n) {
+  {
+    std::lock_guard<std::mutex> g(_mu);
+    _pending.fetch_add(1, std::memory_order_release);
+  }
+  if (n >= 2) _cv.notify_all();
+  else _cv.notify_one();
+}
+
+void ParkingLot::wait(int expected_state) {
+  std::unique_lock<std::mutex> g(_mu);
+  // If state moved since the caller's snapshot, a signal already happened —
+  // don't sleep (the miss-proofing from reference task_group.h:227-229).
+  _cv.wait(g, [&] {
+    return _pending.load(std::memory_order_acquire) != expected_state ||
+           _stopped.load(std::memory_order_acquire);
+  });
+}
+
+void ParkingLot::stop() {
+  {
+    std::lock_guard<std::mutex> g(_mu);
+    _stopped.store(true, std::memory_order_release);
+  }
+  _cv.notify_all();
+}
+
+// ---- Executor ----
+
+static thread_local Executor* tls_executor = nullptr;
+static thread_local int tls_worker_index = -1;
+
+Executor::Executor(int num_workers, const char* tag) : _tag(tag) {
+  if (num_workers <= 0) num_workers = (int)std::thread::hardware_concurrency();
+  if (num_workers <= 0) num_workers = 4;
+  _workers.reserve(num_workers);
+  for (int i = 0; i < num_workers; ++i) _workers.push_back(new Worker());
+  for (int i = 0; i < num_workers; ++i)
+    _workers[i]->thread = std::thread([this, i] { worker_main(i); });
+}
+
+Executor::~Executor() { stop_and_join(); for (auto* w : _workers) delete w; }
+
+bool Executor::in_worker() const { return tls_executor == this; }
+
+void Executor::submit(TaskFn fn, void* arg) {
+  auto* t = new TaskNode{fn, arg};
+  if (tls_executor == this && tls_worker_index >= 0 &&
+      _workers[tls_worker_index]->rq.push(t)) {
+    // Local fast path still signals so siblings can steal (NOSIGNAL batching
+    // would go here; round-1 keeps it simple and always signals once).
+    _signals.fetch_add(1, std::memory_order_relaxed);
+    _pl.signal(1);
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> g(_remote_mu);
+    _remote.push_back(t);
+  }
+  _signals.fetch_add(1, std::memory_order_relaxed);
+  _pl.signal(1);
+}
+
+struct FnHolder {
+  std::function<void()> fn;
+};
+
+void run_function_task(void* arg) {
+  FnHolder* h = (FnHolder*)arg;
+  h->fn();
+  delete h;
+}
+
+void Executor::submit(std::function<void()> fn) {
+  submit(run_function_task, new FnHolder{std::move(fn)});
+}
+
+TaskNode* Executor::pop_remote() {
+  std::lock_guard<std::mutex> g(_remote_mu);
+  if (_remote.empty()) return nullptr;
+  TaskNode* t = _remote.front();
+  _remote.pop_front();
+  return t;
+}
+
+TaskNode* Executor::steal_task(int self) {
+  const int n = (int)_workers.size();
+  // Random-victim sweep (reference task_control.cpp:423).
+  for (int attempt = 0; attempt < 2 * n; ++attempt) {
+    const int v = (int)butil::fast_rand_less_than(n);
+    if (v == self) continue;
+    TaskNode* t = _workers[v]->rq.steal();
+    if (t != nullptr) {
+      _steals.fetch_add(1, std::memory_order_relaxed);
+      return t;
+    }
+  }
+  return pop_remote();
+}
+
+void Executor::worker_main(int index) {
+  tls_executor = this;
+  tls_worker_index = index;
+  Worker* w = _workers[index];
+  while (!_stopping.load(std::memory_order_acquire)) {
+    TaskNode* t = w->rq.pop();
+    if (t == nullptr) t = pop_remote();
+    if (t == nullptr) t = steal_task(index);
+    if (t == nullptr) {
+      const int state = _pl.get_state();
+      // Re-check after snapshot to close the missed-wakeup window.
+      t = pop_remote();
+      if (t == nullptr) t = steal_task(index);
+      if (t == nullptr) {
+        _pl.wait(state);
+        continue;
+      }
+    }
+    t->fn(t->arg);
+    delete t;
+    _executed.fetch_add(1, std::memory_order_relaxed);
+  }
+  // Drain remaining tasks so shutdown doesn't leak work.
+  TaskNode* t;
+  while ((t = w->rq.pop()) != nullptr || (t = pop_remote()) != nullptr) {
+    t->fn(t->arg);
+    delete t;
+    _executed.fetch_add(1, std::memory_order_relaxed);
+  }
+  tls_executor = nullptr;
+  tls_worker_index = -1;
+}
+
+void Executor::stop_and_join() {
+  bool expected = false;
+  if (!_stopping.compare_exchange_strong(expected, true)) {
+    return;
+  }
+  _pl.stop();
+  for (auto* w : _workers)
+    if (w->thread.joinable()) w->thread.join();
+}
+
+static std::mutex g_global_mu;
+static Executor* g_global = nullptr;
+static int g_global_workers = 0;
+
+Executor* Executor::global() {
+  std::lock_guard<std::mutex> g(g_global_mu);
+  if (g_global == nullptr) g_global = new Executor(g_global_workers, "default");
+  return g_global;
+}
+
+void Executor::init_global(int num_workers) {
+  std::lock_guard<std::mutex> g(g_global_mu);
+  if (g_global == nullptr) g_global_workers = num_workers;
+}
+
+void Executor::shutdown_global() {
+  std::lock_guard<std::mutex> g(g_global_mu);
+  if (g_global != nullptr) {
+    g_global->stop_and_join();
+    delete g_global;
+    g_global = nullptr;
+  }
+}
+
+}  // namespace bthread

@@ -1,0 +1,120 @@
+// Work-stealing task executor — the TPU-host analog of bthread's
+// TaskControl/TaskGroup (SURVEY.md §2.2; reference src/bthread/task_group.*).
+//
+// Design kept from the reference: per-worker Chase-Lev deques with random-
+// victim stealing, a ParkingLot that idle workers sleep on after snapshotting
+// its state (so a signal between snapshot and wait is never missed,
+// reference task_group.h:227-229), remote submission queue for non-worker
+// threads, and worker "tags" (isolated pools) so one service's load cannot
+// starve another (task_control.h:39).
+//
+// Deliberately NOT kept: user-space fcontext stack switching.  Our tasks are
+// run-to-completion callbacks; blocking composition is done with
+// continuations (the RPC state machine is callback-driven end to end), and
+// user Python code runs on its own threads.  This trades bRPC's "block
+// anywhere" fiber model for a simpler engine that the XLA host runtime —
+// which is itself callback-driven — composes with naturally.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace bthread {
+
+typedef void (*TaskFn)(void*);
+
+struct TaskNode {
+  TaskFn fn;
+  void* arg;
+};
+
+// Chase-Lev work-stealing deque over TaskNode pointers
+// (reference work_stealing_queue.h:31-120 semantics).
+class WorkStealingQueue {
+ public:
+  explicit WorkStealingQueue(size_t cap = 8192);
+  ~WorkStealingQueue();
+  bool push(TaskNode* t);    // owner only; false if full
+  TaskNode* pop();           // owner only
+  TaskNode* steal();         // any thread
+  size_t volatile_size() const;
+
+ private:
+  std::atomic<int64_t> _top{0};
+  std::atomic<int64_t> _bottom{0};
+  size_t _cap;
+  std::atomic<TaskNode*>* _buf;
+};
+
+// Idle-worker parking with a miss-proof state snapshot
+// (reference parking_lot.h:31-74).
+class ParkingLot {
+ public:
+  int get_state() const { return _pending.load(std::memory_order_acquire); }
+  void signal(int n);
+  void wait(int expected_state);
+  void stop();
+  bool stopped() const { return _stopped.load(std::memory_order_acquire); }
+
+ private:
+  std::mutex _mu;
+  std::condition_variable _cv;
+  std::atomic<int> _pending{0};
+  std::atomic<bool> _stopped{false};
+};
+
+class Executor {
+ public:
+  // One tagged worker pool (reference bthread tag).
+  explicit Executor(int num_workers, const char* tag = "default");
+  ~Executor();
+
+  // Submit from any thread.  Worker threads push to their local deque;
+  // foreign threads go through the remote queue + wake.
+  void submit(TaskFn fn, void* arg);
+  void submit(std::function<void()> fn);
+
+  void stop_and_join();
+
+  int num_workers() const { return (int)_workers.size(); }
+  // True if the calling thread is one of this executor's workers.
+  bool in_worker() const;
+
+  // bvar-style counters (exported via the metrics registry).
+  int64_t tasks_executed() const { return _executed.load(std::memory_order_relaxed); }
+  int64_t steals() const { return _steals.load(std::memory_order_relaxed); }
+  int64_t signals() const { return _signals.load(std::memory_order_relaxed); }
+
+  static Executor* global();            // lazily started default pool
+  static void init_global(int num_workers);
+  static void shutdown_global();
+
+ private:
+  struct Worker {
+    WorkStealingQueue rq;
+    std::thread thread;
+  };
+
+  void worker_main(int index);
+  TaskNode* steal_task(int self);
+  TaskNode* pop_remote();
+
+  std::string _tag;
+  std::vector<Worker*> _workers;
+  ParkingLot _pl;
+  std::mutex _remote_mu;
+  std::deque<TaskNode*> _remote;
+  std::atomic<bool> _stopping{false};
+  std::atomic<int64_t> _executed{0}, _steals{0}, _signals{0};
+};
+
+// Run std::function tasks through the C-style TaskFn interface.
+void run_function_task(void* arg);
+
+}  // namespace bthread

@@ -1,0 +1,95 @@
+#include "bthread/timer.h"
+
+#include "butil/common.h"
+
+namespace bthread {
+
+TimerThread::TimerThread() { _thread = std::thread([this] { run(); }); }
+
+TimerThread::~TimerThread() { stop_and_join(); }
+
+uint64_t TimerThread::schedule(TimerFn fn, void* arg, int64_t abstime_us) {
+  std::lock_guard<std::mutex> g(_mu);
+  const uint64_t id = _next_id++;
+  _heap.push(Item{abstime_us, id, fn, arg});
+  _pending_ids.insert(id);
+  _cv.notify_one();
+  return id;
+}
+
+uint64_t TimerThread::schedule_after(TimerFn fn, void* arg, int64_t delay_us) {
+  return schedule(fn, arg, butil::monotonic_time_us() + delay_us);
+}
+
+bool TimerThread::unschedule(uint64_t id) {
+  std::lock_guard<std::mutex> g(_mu);
+  // True only if the callback has not run and will not run.  Ids of fired
+  // timers are removed from _pending_ids, so both sets stay bounded.
+  if (_pending_ids.erase(id) == 0) return false;
+  _cancelled.insert(id);
+  return true;
+}
+
+size_t TimerThread::pending() const {
+  std::lock_guard<std::mutex> g(_mu);
+  return _heap.size();
+}
+
+void TimerThread::run() {
+  std::unique_lock<std::mutex> g(_mu);
+  while (!_stop) {
+    if (_heap.empty()) {
+      _cv.wait(g);
+      continue;
+    }
+    const Item top = _heap.top();
+    const int64_t now = butil::monotonic_time_us();
+    if (top.when_us > now) {
+      _cv.wait_for(g, std::chrono::microseconds(top.when_us - now));
+      continue;
+    }
+    _heap.pop();
+    auto it = _cancelled.find(top.id);
+    if (it != _cancelled.end()) {
+      _cancelled.erase(it);
+      continue;
+    }
+    _pending_ids.erase(top.id);
+    g.unlock();
+    top.fn(top.arg);  // fired outside the lock
+    _fired.fetch_add(1, std::memory_order_relaxed);
+    g.lock();
+  }
+}
+
+void TimerThread::stop_and_join() {
+  {
+    std::lock_guard<std::mutex> g(_mu);
+    if (_stop) {
+      if (!_thread.joinable()) return;
+    }
+    _stop = true;
+    _cv.notify_all();
+  }
+  if (_thread.joinable()) _thread.join();
+}
+
+static std::mutex g_timer_mu;
+static TimerThread* g_timer = nullptr;
+
+TimerThread* TimerThread::global() {
+  std::lock_guard<std::mutex> g(g_timer_mu);
+  if (g_timer == nullptr) g_timer = new TimerThread();
+  return g_timer;
+}
+
+void TimerThread::shutdown_global() {
+  std::lock_guard<std::mutex> g(g_timer_mu);
+  if (g_timer != nullptr) {
+    g_timer->stop_and_join();
+    delete g_timer;
+    g_timer = nullptr;
+  }
+}
+
+}  // namespace bthread

@@ -1,0 +1,66 @@
+// TimerThread — one dedicated thread firing scheduled callbacks
+// (SURVEY.md §2.2; reference src/bthread/timer_thread.{h,cpp}).
+//
+// The reference shards its schedule lock over 13 hashed buckets and sleeps on
+// a futex keyed by the nearest run time.  We keep the single dedicated
+// thread + nearest-deadline sleep, but use one mutex + min-heap with lazy
+// cancellation (version-checked ids): timer insertion is off the RPC fast
+// path in our design (timeouts are armed per call, fired rarely), so bucket
+// sharding is deferred until contention shows up in the bvar counters.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_set>
+#include <vector>
+
+namespace bthread {
+
+typedef void (*TimerFn)(void*);
+
+class TimerThread {
+ public:
+  TimerThread();
+  ~TimerThread();
+
+  // Run fn(arg) at absolute monotonic time `abstime_us`; returns timer id.
+  uint64_t schedule(TimerFn fn, void* arg, int64_t abstime_us);
+  uint64_t schedule_after(TimerFn fn, void* arg, int64_t delay_us);
+  // Best-effort cancel; returns true if the timer had not fired yet.
+  bool unschedule(uint64_t id);
+
+  void stop_and_join();
+
+  int64_t fired() const { return _fired.load(std::memory_order_relaxed); }
+  size_t pending() const;
+
+  static TimerThread* global();
+  static void shutdown_global();
+
+ private:
+  struct Item {
+    int64_t when_us;
+    uint64_t id;
+    TimerFn fn;
+    void* arg;
+    bool operator>(const Item& o) const { return when_us > o.when_us; }
+  };
+
+  void run();
+
+  mutable std::mutex _mu;
+  std::condition_variable _cv;
+  std::priority_queue<Item, std::vector<Item>, std::greater<Item>> _heap;
+  std::unordered_set<uint64_t> _cancelled;
+  std::unordered_set<uint64_t> _pending_ids;  // scheduled, not yet fired
+  uint64_t _next_id = 1;
+  bool _stop = false;
+  std::atomic<int64_t> _fired{0};
+  std::thread _thread;
+};
+
+}  // namespace bthread

@@ -1,0 +1,67 @@
+// Base definitions for the tpu-rpc native core.
+//
+// This library is a from-scratch TPU-host runtime shaped like bRPC's butil
+// layer (reference: /root/reference/src/butil).  It is NOT a port: the code
+// here is new, written against the behavioral spec in SURVEY.md §2.1.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+namespace butil {
+
+inline int64_t monotonic_time_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+inline int64_t monotonic_time_us() { return monotonic_time_ns() / 1000; }
+
+inline int64_t realtime_time_us() {
+  timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return ts.tv_sec * 1000000LL + ts.tv_nsec / 1000;
+}
+
+// xorshift128+ thread-local fast rand (the role fast_rand.cpp plays in the
+// reference: cheap per-thread randomness for work stealing victims etc).
+inline uint64_t fast_rand() {
+  static thread_local uint64_t s0 = 0, s1 = 0;
+  if (s0 == 0 && s1 == 0) {
+    s0 = monotonic_time_ns() ^ (uint64_t)(uintptr_t)&s0;
+    s1 = s0 * 0x9E3779B97F4A7C15ULL + 1;
+  }
+  uint64_t x = s0;
+  const uint64_t y = s1;
+  s0 = y;
+  x ^= x << 23;
+  s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+  return s1 + y;
+}
+
+inline uint64_t fast_rand_less_than(uint64_t bound) {
+  return bound ? fast_rand() % bound : 0;
+}
+
+// Minimal leveled logging with a pluggable sink (SURVEY.md §2.1 "Logging").
+enum LogLevel { LOG_DEBUG = 0, LOG_INFO = 1, LOG_WARNING = 2, LOG_ERROR = 3, LOG_FATAL = 4 };
+
+typedef void (*LogSinkFn)(int level, const char* msg, void* arg);
+
+void set_log_sink(LogSinkFn fn, void* arg);
+void set_min_log_level(int level);
+int min_log_level();
+void log_message(int level, const char* fmt, ...) __attribute__((format(printf, 2, 3)));
+
+#define BLOG(level, ...)                                        \
+  do {                                                          \
+    if ((int)(butil::LOG_##level) >= butil::min_log_level())    \
+      butil::log_message(butil::LOG_##level, __VA_ARGS__);      \
+  } while (0)
+
+}  // namespace butil

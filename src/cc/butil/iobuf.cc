@@ -1,0 +1,456 @@
+#include "butil/iobuf.h"
+
+#include <errno.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cassert>
+#include <new>
+
+namespace butil {
+namespace iobuf {
+
+static std::atomic<int64_t> g_live_blocks{0};
+
+struct Block {
+  std::atomic<int32_t> nshared;
+  uint32_t size;          // claim cursor: bytes handed out to refs
+  uint32_t cap;
+  void (*deleter)(void*, void*);  // non-null => user block
+  void* deleter_arg;
+  char* data;
+  Block* next_cached;     // TLS free-list link
+};
+
+// ---- thread-local block cache (reference iobuf.cpp:379-449 role) ----
+
+struct TlsBlockCache {
+  Block* head = nullptr;
+  size_t count = 0;
+  Block* write_block = nullptr;  // current shared append target (one ref held)
+  ~TlsBlockCache();
+};
+
+static constexpr size_t kMaxCachedBlocks = 64;
+static thread_local TlsBlockCache tls_cache;
+
+static void destroy_block(Block* b) {
+  g_live_blocks.fetch_sub(1, std::memory_order_relaxed);
+  if (b->deleter != nullptr) {
+    b->deleter(b->data, b->deleter_arg);
+    free(b);
+  } else {
+    free(b);  // header + payload are one allocation
+  }
+}
+
+Block* create_block(size_t payload_cap) {
+  TlsBlockCache& c = tls_cache;
+  if (payload_cap == kDefaultPayload && c.head != nullptr) {
+    Block* b = c.head;
+    c.head = b->next_cached;
+    --c.count;
+    b->nshared.store(1, std::memory_order_relaxed);
+    b->size = 0;
+    return b;
+  }
+  auto* b = (Block*)malloc(sizeof(Block) + payload_cap);
+  if (b == nullptr) return nullptr;
+  b->nshared.store(1, std::memory_order_relaxed);
+  b->size = 0;
+  b->cap = (uint32_t)payload_cap;
+  b->deleter = nullptr;
+  b->deleter_arg = nullptr;
+  b->data = (char*)(b + 1);
+  b->next_cached = nullptr;
+  g_live_blocks.fetch_add(1, std::memory_order_relaxed);
+  return b;
+}
+
+Block* create_user_block(void* data, size_t size, void (*deleter)(void*, void*),
+                         void* arg) {
+  auto* b = (Block*)malloc(sizeof(Block));
+  b->nshared.store(1, std::memory_order_relaxed);
+  b->size = (uint32_t)size;  // fully claimed: never appended into
+  b->cap = (uint32_t)size;
+  b->deleter = deleter;
+  b->deleter_arg = arg;
+  b->data = (char*)data;
+  b->next_cached = nullptr;
+  g_live_blocks.fetch_add(1, std::memory_order_relaxed);
+  return b;
+}
+
+void block_inc_ref(Block* b) { b->nshared.fetch_add(1, std::memory_order_relaxed); }
+
+void block_dec_ref(Block* b) {
+  if (b->nshared.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    TlsBlockCache& c = tls_cache;
+    if (b->deleter == nullptr && b->cap == kDefaultPayload &&
+        c.count < kMaxCachedBlocks) {
+      b->next_cached = c.head;
+      c.head = b;
+      ++c.count;
+      return;
+    }
+    destroy_block(b);
+  }
+}
+
+TlsBlockCache::~TlsBlockCache() {
+  if (write_block != nullptr) {
+    // Drop our ref without re-entering the (dying) cache.
+    Block* wb = write_block;
+    write_block = nullptr;
+    if (wb->nshared.fetch_sub(1, std::memory_order_acq_rel) == 1)
+      destroy_block(wb);
+  }
+  while (head != nullptr) {
+    Block* b = head;
+    head = b->next_cached;
+    destroy_block(b);
+  }
+  count = 0;
+}
+
+char* block_data(Block* b) { return b->data; }
+size_t block_cap(Block* b) { return b->cap; }
+size_t block_size(Block* b) { return b->size; }
+void block_set_size(Block* b, size_t n) { b->size = (uint32_t)n; }
+int block_ref_count(Block* b) { return b->nshared.load(std::memory_order_relaxed); }
+size_t tls_cached_blocks() { return tls_cache.count; }
+int64_t live_block_count() { return g_live_blocks.load(std::memory_order_relaxed); }
+
+// The thread-shared write block (reference share_tls_block, iobuf.cpp:411):
+// sequential appends from one thread claim ranges of one block, so many small
+// messages pack densely and appends rarely allocate.
+static Block* tls_write_block_with_room() {
+  TlsBlockCache& c = tls_cache;
+  Block* b = c.write_block;
+  if (b != nullptr && b->size < b->cap) return b;
+  if (b != nullptr) {
+    block_dec_ref(b);
+    c.write_block = nullptr;
+  }
+  b = create_block(kDefaultPayload);
+  c.write_block = b;  // hold one ref as the TLS owner
+  return b;
+}
+
+}  // namespace iobuf
+
+using iobuf::Block;
+
+// ---- IOBuf ----
+
+IOBuf::IOBuf() { }
+
+IOBuf::~IOBuf() { unref_all(); }
+
+IOBuf::IOBuf(const IOBuf& rhs) : IOBuf() { append(rhs); }
+
+IOBuf& IOBuf::operator=(const IOBuf& rhs) {
+  if (this != &rhs) {
+    clear();
+    append(rhs);
+  }
+  return *this;
+}
+
+IOBuf::IOBuf(IOBuf&& rhs) noexcept {
+  memcpy(_inline, rhs._inline, sizeof(_inline));
+  _ring = rhs._ring;
+  _ring_cap = rhs._ring_cap;
+  _start = rhs._start;
+  _nref = rhs._nref;
+  _nbytes = rhs._nbytes;
+  rhs._ring = nullptr;
+  rhs._ring_cap = rhs._start = rhs._nref = 0;
+  rhs._nbytes = 0;
+}
+
+IOBuf& IOBuf::operator=(IOBuf&& rhs) noexcept {
+  if (this != &rhs) {
+    unref_all();
+    memcpy(_inline, rhs._inline, sizeof(_inline));
+    _ring = rhs._ring;
+    _ring_cap = rhs._ring_cap;
+    _start = rhs._start;
+    _nref = rhs._nref;
+    _nbytes = rhs._nbytes;
+    rhs._ring = nullptr;
+    rhs._ring_cap = rhs._start = rhs._nref = 0;
+    rhs._nbytes = 0;
+  }
+  return *this;
+}
+
+BlockRef& IOBuf::ref_at(size_t i) {
+  return _ring != nullptr ? _ring[(_start + i) & (_ring_cap - 1)] : _inline[i];
+}
+const BlockRef& IOBuf::ref_at(size_t i) const {
+  return _ring != nullptr ? _ring[(_start + i) & (_ring_cap - 1)] : _inline[i];
+}
+
+const BlockRef& IOBuf::backing_block(size_t i) const { return ref_at(i); }
+
+void IOBuf::unref_all() {
+  for (size_t i = 0; i < _nref; ++i) iobuf::block_dec_ref(ref_at(i).block);
+  free(_ring);
+  _ring = nullptr;
+  _ring_cap = _start = _nref = 0;
+  _nbytes = 0;
+}
+
+void IOBuf::clear() { unref_all(); }
+
+void IOBuf::grow_ring() {
+  uint32_t new_cap = _ring == nullptr ? 8 : _ring_cap * 2;
+  auto* nr = (BlockRef*)malloc(new_cap * sizeof(BlockRef));
+  for (size_t i = 0; i < _nref; ++i) nr[i] = ref_at(i);
+  free(_ring);
+  _ring = nr;
+  _ring_cap = new_cap;
+  _start = 0;
+}
+
+void IOBuf::push_ref(const BlockRef& r) {
+  // Merge with tail if contiguous in the same block (keeps ref count low when
+  // one thread appends repeatedly through the TLS write block).
+  if (_nref > 0) {
+    BlockRef& tail = ref_at(_nref - 1);
+    if (tail.block == r.block && tail.offset + tail.length == r.offset) {
+      tail.length += r.length;
+      _nbytes += r.length;
+      iobuf::block_dec_ref(r.block);  // merged: drop the extra count
+      return;
+    }
+  }
+  if (_ring == nullptr && _nref >= 2) grow_ring();
+  else if (_ring != nullptr && _nref == _ring_cap) grow_ring();
+  if (_ring != nullptr)
+    _ring[(_start + _nref) & (_ring_cap - 1)] = r;
+  else
+    _inline[_nref] = r;
+  ++_nref;
+  _nbytes += r.length;
+}
+
+void IOBuf::add_block_ref(const BlockRef& ref) {
+  iobuf::block_inc_ref(ref.block);
+  push_ref(ref);
+}
+
+void IOBuf::pop_front_ref() {
+  iobuf::block_dec_ref(ref_at(0).block);
+  if (_ring != nullptr) _start = (_start + 1) & (_ring_cap - 1);
+  else _inline[0] = _inline[1];
+  --_nref;
+}
+
+void IOBuf::pop_back_ref() {
+  iobuf::block_dec_ref(ref_at(_nref - 1).block);
+  --_nref;
+}
+
+void IOBuf::append(const void* data, size_t n) {
+  const char* p = (const char*)data;
+  while (n > 0) {
+    Block* b = iobuf::tls_write_block_with_room();
+    const size_t room = iobuf::block_cap(b) - iobuf::block_size(b);
+    const size_t m = std::min(n, room);
+    const uint32_t off = (uint32_t)iobuf::block_size(b);
+    memcpy(iobuf::block_data(b) + off, p, m);
+    iobuf::block_set_size(b, off + m);
+    iobuf::block_inc_ref(b);
+    push_ref(BlockRef{off, (uint32_t)m, b});
+    p += m;
+    n -= m;
+  }
+}
+
+void IOBuf::append(const IOBuf& other) {
+  // Snapshot the count so self-append (`buf.append(buf)`) terminates: pushed
+  // refs are copies of existing ones (never offset-contiguous with the tail),
+  // so they don't merge and indexes 0..n-1 stay stable while we push.
+  const size_t n = other._nref;
+  for (size_t i = 0; i < n; ++i) add_block_ref(other.ref_at(i));
+}
+
+void IOBuf::append(IOBuf&& other) {
+  if (_nref == 0) {
+    *this = std::move(other);
+    return;
+  }
+  for (size_t i = 0; i < other._nref; ++i) {
+    iobuf::block_inc_ref(other.ref_at(i).block);
+    push_ref(other.ref_at(i));
+  }
+  other.clear();
+}
+
+void IOBuf::append_user_data(void* data, size_t n, void (*deleter)(void*, void*),
+                             void* arg) {
+  Block* b = iobuf::create_user_block(data, n, deleter, arg);
+  push_ref(BlockRef{0, (uint32_t)n, b});  // takes the creation ref
+}
+
+size_t IOBuf::pop_front(size_t n) {
+  size_t popped = 0;
+  while (n > 0 && _nref > 0) {
+    BlockRef& r = ref_at(0);
+    if (r.length > n) {
+      r.offset += (uint32_t)n;
+      r.length -= (uint32_t)n;
+      popped += n;
+      _nbytes -= n;
+      return popped;
+    }
+    n -= r.length;
+    popped += r.length;
+    _nbytes -= r.length;
+    pop_front_ref();
+  }
+  return popped;
+}
+
+size_t IOBuf::pop_back(size_t n) {
+  size_t popped = 0;
+  while (n > 0 && _nref > 0) {
+    BlockRef& r = ref_at(_nref - 1);
+    if (r.length > n) {
+      r.length -= (uint32_t)n;
+      popped += n;
+      _nbytes -= n;
+      return popped;
+    }
+    n -= r.length;
+    popped += r.length;
+    _nbytes -= r.length;
+    pop_back_ref();
+  }
+  return popped;
+}
+
+size_t IOBuf::cutn(IOBuf* out, size_t n) {
+  size_t moved = 0;
+  while (n > 0 && _nref > 0) {
+    BlockRef& r = ref_at(0);
+    if (r.length <= n) {
+      iobuf::block_inc_ref(r.block);
+      out->push_ref(r);
+      n -= r.length;
+      moved += r.length;
+      _nbytes -= r.length;
+      pop_front_ref();
+    } else {
+      BlockRef part{r.offset, (uint32_t)n, r.block};
+      iobuf::block_inc_ref(r.block);
+      out->push_ref(part);
+      r.offset += (uint32_t)n;
+      r.length -= (uint32_t)n;
+      _nbytes -= n;
+      moved += n;
+      n = 0;
+    }
+  }
+  return moved;
+}
+
+size_t IOBuf::cutn(void* out, size_t n) {
+  const size_t m = copy_to(out, n, 0);
+  pop_front(m);
+  return m;
+}
+
+size_t IOBuf::copy_to(void* buf, size_t n, size_t pos) const {
+  char* out = (char*)buf;
+  size_t copied = 0;
+  for (size_t i = 0; i < _nref && n > 0; ++i) {
+    const BlockRef& r = ref_at(i);
+    if (pos >= r.length) {
+      pos -= r.length;
+      continue;
+    }
+    const size_t m = std::min((size_t)r.length - pos, n);
+    memcpy(out, iobuf::block_data(r.block) + r.offset + pos, m);
+    out += m;
+    copied += m;
+    n -= m;
+    pos = 0;
+  }
+  return copied;
+}
+
+std::string IOBuf::to_string() const {
+  std::string s;
+  s.resize(_nbytes);
+  copy_to(s.data(), _nbytes, 0);
+  return s;
+}
+
+char IOBuf::byte_at(size_t pos) const {
+  char c = 0;
+  copy_to(&c, 1, pos);
+  return c;
+}
+
+ssize_t IOBuf::cut_into_file_descriptor(int fd, size_t max_refs) {
+  if (_nref == 0) return 0;
+  iovec vec[64];
+  const size_t nvec = std::min({(size_t)_nref, max_refs, (size_t)64});
+  for (size_t i = 0; i < nvec; ++i) {
+    const BlockRef& r = ref_at(i);
+    vec[i].iov_base = iobuf::block_data(r.block) + r.offset;
+    vec[i].iov_len = r.length;
+  }
+  const ssize_t nw = writev(fd, vec, (int)nvec);
+  if (nw > 0) pop_front((size_t)nw);
+  return nw;
+}
+
+// ---- IOPortal ----
+
+ssize_t IOPortal::append_from_file_descriptor(int fd, size_t max_bytes) {
+  // Scatter-read into up to 16 blocks (~128KB) per syscall: first the TLS
+  // write block's tail room, then fresh cache blocks.
+  Block* blocks[16];
+  iovec vec[16];
+  size_t nvec = 0;
+  size_t planned = 0;
+  while (planned < max_bytes && nvec < 16) {
+    Block* b = (nvec == 0) ? iobuf::tls_write_block_with_room()
+                           : iobuf::create_block(iobuf::kDefaultPayload);
+    const size_t room = iobuf::block_cap(b) - iobuf::block_size(b);
+    blocks[nvec] = b;
+    vec[nvec].iov_base = iobuf::block_data(b) + iobuf::block_size(b);
+    vec[nvec].iov_len = std::min(room, max_bytes - planned);
+    planned += vec[nvec].iov_len;
+    ++nvec;
+  }
+  ssize_t nr = readv(fd, vec, (int)nvec);
+  // Blocks past the first are plain new blocks we own; consume or recycle.
+  ssize_t remain = nr < 0 ? 0 : nr;
+  for (size_t i = 0; i < nvec; ++i) {
+    Block* b = blocks[i];
+    const size_t filled = std::min((size_t)remain, (size_t)vec[i].iov_len);
+    if (filled > 0) {
+      const uint32_t off = (uint32_t)iobuf::block_size(b);
+      iobuf::block_set_size(b, off + filled);
+      if (i == 0) {
+        iobuf::block_inc_ref(b);
+        push_ref(BlockRef{off, (uint32_t)filled, b});
+      } else {
+        push_ref(BlockRef{0, (uint32_t)filled, b});  // takes creation ref
+      }
+      remain -= filled;
+    } else if (i != 0) {
+      iobuf::block_dec_ref(b);  // untouched fresh block → cache
+    }
+  }
+  return nr;
+}
+
+}  // namespace butil

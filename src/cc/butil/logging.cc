@@ -1,0 +1,36 @@
+#include "butil/common.h"
+
+#include <cstdarg>
+#include <mutex>
+
+namespace butil {
+
+static LogSinkFn g_sink = nullptr;
+static void* g_sink_arg = nullptr;
+static std::atomic<int> g_min_level{LOG_WARNING};
+
+void set_log_sink(LogSinkFn fn, void* arg) {
+  g_sink = fn;
+  g_sink_arg = arg;
+}
+
+void set_min_log_level(int level) { g_min_level.store(level, std::memory_order_relaxed); }
+int min_log_level() { return g_min_level.load(std::memory_order_relaxed); }
+
+void log_message(int level, const char* fmt, ...) {
+  char buf[2048];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  LogSinkFn sink = g_sink;
+  if (sink != nullptr) {
+    sink(level, buf, g_sink_arg);
+  } else {
+    static const char* names[] = {"D", "I", "W", "E", "F"};
+    fprintf(stderr, "[%s] %s\n", names[level < 5 ? level : 4], buf);
+  }
+  if (level >= LOG_FATAL) abort();
+}
+
+}  // namespace butil

@@ -1,0 +1,113 @@
+// ResourcePool — slab allocator addressing objects by dense 32-bit slot ids.
+//
+// Spec from the reference (SURVEY.md §2.1; /root/reference
+// src/butil/resource_pool.h:28-70): objects live forever in chunked slabs and
+// are recycled through free lists; a 32-bit slot id addresses any object in
+// O(1).  Combined with a per-object 32-bit version (see VersionedId below),
+// a stale 64-bit handle simply fails validation instead of racing on freed
+// memory — the safety backbone of SocketId and call ids (§5.3).
+//
+// New implementation: global chunk table + per-thread free-slot caches with a
+// mutex-guarded overflow list (the reference uses lock-free thread-local
+// chunks; our hot paths hit the TLS cache and take the lock only to refill).
+#pragma once
+
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace butil {
+
+// 64-bit versioned handle: high 32 bits version, low 32 bits slot.
+struct VersionedId {
+  uint64_t value;
+  uint32_t slot() const { return (uint32_t)value; }
+  uint32_t version() const { return (uint32_t)(value >> 32); }
+  static VersionedId make(uint32_t version, uint32_t slot) {
+    return VersionedId{((uint64_t)version << 32) | slot};
+  }
+};
+
+template <typename T>
+class ResourcePool {
+ public:
+  static constexpr size_t kChunkItems = 256;
+  static constexpr size_t kTlsCacheMax = 64;
+
+  // Get a free object; *slot receives its id.  Object is NOT reconstructed —
+  // callers reset fields (mirrors reference semantics where pooled objects
+  // keep internal version state across reuse).
+  // Returns nullptr if the pool is exhausted (kMaxChunks reached).
+  T* get_resource(uint32_t* slot) {
+    auto& tls = tls_free();
+    if (tls.empty()) refill_tls(tls);
+    if (tls.empty()) return nullptr;
+    uint32_t s = tls.back();
+    tls.pop_back();
+    *slot = s;
+    return address(s);
+  }
+
+  void return_resource(uint32_t slot) {
+    auto& tls = tls_free();
+    tls.push_back(slot);
+    if (tls.size() > kTlsCacheMax) {
+      std::lock_guard<std::mutex> g(_mu);
+      _free.insert(_free.end(), tls.begin() + kTlsCacheMax / 2, tls.end());
+      tls.resize(kTlsCacheMax / 2);
+    }
+  }
+
+  // O(1) slot → object.  Valid for any slot ever returned by get_resource.
+  // Lock-free: the chunk table is a fixed array of pointers published with
+  // release stores, so it never moves under a reader.
+  T* address(uint32_t slot) {
+    Chunk* c = _chunks[slot / kChunkItems].load(std::memory_order_acquire);
+    return &c->items[slot % kChunkItems];
+  }
+
+  size_t allocated() const { return _allocated; }
+
+  static ResourcePool* singleton() {
+    static ResourcePool pool;
+    return &pool;
+  }
+
+ private:
+  struct Chunk {
+    T items[kChunkItems];
+  };
+
+  std::vector<uint32_t>& tls_free() {
+    static thread_local std::vector<uint32_t> cache;
+    return cache;
+  }
+
+  void refill_tls(std::vector<uint32_t>& tls) {
+    std::lock_guard<std::mutex> g(_mu);
+    if (_free.empty() && _nchunks < kMaxChunks) {
+      // Carve a new chunk.
+      auto* c = new Chunk();
+      _chunks[_nchunks].store(c, std::memory_order_release);
+      const uint32_t base = (uint32_t)(_nchunks * kChunkItems);
+      ++_nchunks;
+      _allocated += kChunkItems;
+      for (uint32_t i = 0; i < kChunkItems; ++i)
+        _free.push_back(base + kChunkItems - 1 - i);
+    }
+    const size_t take = _free.size() < kTlsCacheMax / 2 ? _free.size()
+                                                        : kTlsCacheMax / 2;
+    tls.insert(tls.end(), _free.end() - take, _free.end());
+    _free.resize(_free.size() - take);
+  }
+
+  static constexpr size_t kMaxChunks = 65536;  // 16.7M objects max per pool
+
+  std::mutex _mu;
+  std::atomic<Chunk*> _chunks[kMaxChunks] = {};
+  size_t _nchunks = 0;
+  std::vector<uint32_t> _free;
+  size_t _allocated = 0;
+};
+
+}  // namespace butil

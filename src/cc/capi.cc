@@ -1,0 +1,181 @@
+// C ABI for the tpu-rpc native core.  Python binds these with ctypes
+// (brpc_tpu/_core/lib.py).  The surface mirrors how the reference layers
+// protobuf stubs over a native core: transport, framing, buffers, timers and
+// the executor are native; protocol semantics live above.
+#include <cstring>
+
+#include "bthread/executor.h"
+#include "bthread/timer.h"
+#include "butil/common.h"
+#include "butil/iobuf.h"
+#include "net/event_dispatcher.h"
+#include "net/parser.h"
+#include "net/socket.h"
+
+using butil::IOBuf;
+
+extern "C" {
+
+// ---- lifecycle ----
+
+void brpc_core_init(int num_workers, int num_dispatchers) {
+  bthread::Executor::init_global(num_workers);
+  brpc::EventDispatcher::InitGlobal(num_dispatchers);
+  (void)bthread::Executor::global();
+  (void)bthread::TimerThread::global();
+}
+
+void brpc_core_shutdown() {
+  brpc::EventDispatcher::ShutdownGlobal();
+  bthread::TimerThread::shutdown_global();
+  bthread::Executor::shutdown_global();
+}
+
+void brpc_set_log_sink(butil::LogSinkFn fn, void* arg) { butil::set_log_sink(fn, arg); }
+void brpc_set_min_log_level(int level) { butil::set_min_log_level(level); }
+
+// ---- IOBuf ----
+
+void* brpc_iobuf_new() { return new IOBuf(); }
+void brpc_iobuf_free(void* h) { delete (IOBuf*)h; }
+void brpc_iobuf_clear(void* h) { ((IOBuf*)h)->clear(); }
+size_t brpc_iobuf_size(void* h) { return ((IOBuf*)h)->size(); }
+size_t brpc_iobuf_block_num(void* h) { return ((IOBuf*)h)->backing_block_num(); }
+void brpc_iobuf_append(void* h, const void* data, size_t n) {
+  ((IOBuf*)h)->append(data, n);
+}
+void brpc_iobuf_append_iobuf(void* h, void* other) {
+  ((IOBuf*)h)->append(*(IOBuf*)other);
+}
+size_t brpc_iobuf_copy_to(void* h, void* out, size_t n, size_t pos) {
+  return ((IOBuf*)h)->copy_to(out, n, pos);
+}
+size_t brpc_iobuf_cutn(void* h, void* out_iobuf, size_t n) {
+  return ((IOBuf*)h)->cutn((IOBuf*)out_iobuf, n);
+}
+size_t brpc_iobuf_pop_front(void* h, size_t n) { return ((IOBuf*)h)->pop_front(n); }
+void brpc_iobuf_append_user_data(void* h, void* data, size_t n,
+                                 void (*deleter)(void*, void*), void* arg) {
+  ((IOBuf*)h)->append_user_data(data, n, deleter, arg);
+}
+int64_t brpc_iobuf_live_blocks() { return butil::iobuf::live_block_count(); }
+
+// ---- executor / timers ----
+
+typedef void (*brpc_task_fn)(void*);
+
+void brpc_executor_submit(brpc_task_fn fn, void* arg) {
+  bthread::Executor::global()->submit(fn, arg);
+}
+int64_t brpc_executor_tasks_executed() {
+  return bthread::Executor::global()->tasks_executed();
+}
+int64_t brpc_executor_steals() { return bthread::Executor::global()->steals(); }
+int brpc_executor_num_workers() { return bthread::Executor::global()->num_workers(); }
+
+uint64_t brpc_timer_add(brpc_task_fn fn, void* arg, int64_t delay_us) {
+  return bthread::TimerThread::global()->schedule_after(fn, arg, delay_us);
+}
+int brpc_timer_cancel(uint64_t id) {
+  return bthread::TimerThread::global()->unschedule(id) ? 0 : -1;
+}
+int64_t brpc_timer_fired() { return bthread::TimerThread::global()->fired(); }
+
+int64_t brpc_now_us() { return butil::monotonic_time_us(); }
+
+// ---- sockets ----
+
+typedef void (*brpc_message_cb)(uint64_t sid, int kind, const char* meta,
+                                size_t meta_len, void* body_iobuf, void* user);
+typedef void (*brpc_failed_cb)(uint64_t sid, int error_code, void* user);
+typedef void (*brpc_accepted_cb)(uint64_t listener, uint64_t conn, void* user);
+
+static brpc::SocketOptions make_opts(brpc_message_cb on_msg, brpc_failed_cb on_fail,
+                                     brpc_accepted_cb on_accept, void* user,
+                                     int native_echo) {
+  brpc::SocketOptions o;
+  o.on_message = (brpc::MessageCallback)on_msg;
+  o.on_failed = (brpc::SocketFailedCallback)on_fail;
+  o.on_accepted = (brpc::AcceptedCallback)on_accept;
+  o.user = user;
+  o.native_echo = native_echo != 0;
+  return o;
+}
+
+int brpc_listen(const char* addr, int port, brpc_message_cb on_msg,
+                brpc_failed_cb on_fail, brpc_accepted_cb on_accept, void* user,
+                int native_echo, uint64_t* sid_out, int* bound_port) {
+  return brpc::Listen(addr, port,
+                      make_opts(on_msg, on_fail, on_accept, user, native_echo),
+                      sid_out, bound_port);
+}
+
+int brpc_connect(const char* host, int port, brpc_message_cb on_msg,
+                 brpc_failed_cb on_fail, void* user, uint64_t* sid_out) {
+  return brpc::Connect(host, port,
+                       make_opts(on_msg, on_fail, nullptr, user, 0), sid_out);
+}
+
+// Write one TRPC frame: header + meta + body.  body_iobuf may be null.
+int brpc_socket_write_frame(uint64_t sid, const void* meta, size_t meta_len,
+                            const void* body, size_t body_len,
+                            void* body_iobuf) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  IOBuf out;
+  char hdr[brpc::kTrpcHeaderLen];
+  const uint64_t blen = body_iobuf != nullptr ? ((IOBuf*)body_iobuf)->size()
+                                              : body_len;
+  brpc::make_trpc_header(hdr, (uint32_t)meta_len, blen);
+  out.append(hdr, sizeof(hdr));
+  if (meta_len > 0) out.append(meta, meta_len);
+  if (body_iobuf != nullptr) out.append(std::move(*(IOBuf*)body_iobuf));
+  else if (body_len > 0) out.append(body, body_len);
+  const int rc = s->Write(std::move(out));
+  s->Dereference();
+  return rc;
+}
+
+// Write raw bytes (HTTP responses etc.).
+int brpc_socket_write_raw(uint64_t sid, const void* data, size_t len,
+                          void* body_iobuf) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  IOBuf out;
+  if (data != nullptr && len > 0) out.append(data, len);
+  if (body_iobuf != nullptr) out.append(std::move(*(IOBuf*)body_iobuf));
+  const int rc = s->Write(std::move(out));
+  s->Dereference();
+  return rc;
+}
+
+int brpc_socket_set_failed(uint64_t sid, int error_code) {
+  return brpc::Socket::SetFailed(sid, error_code);
+}
+
+int brpc_socket_alive(uint64_t sid) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return 0;
+  s->Dereference();
+  return 1;
+}
+
+int brpc_socket_stats(uint64_t sid, int64_t* nread, int64_t* nwritten,
+                      int64_t* nmsg, char* ip_out, int ip_cap, int* port) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  if (nread) *nread = s->bytes_read();
+  if (nwritten) *nwritten = s->bytes_written();
+  if (nmsg) *nmsg = s->messages_read();
+  if (ip_out && ip_cap > 0) {
+    strncpy(ip_out, s->remote_ip(), ip_cap - 1);
+    ip_out[ip_cap - 1] = 0;
+  }
+  if (port) *port = (int)s->remote_port();
+  s->Dereference();
+  return 0;
+}
+
+int64_t brpc_socket_active_count() { return brpc::Socket::active_count(); }
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+#include "net/event_dispatcher.h"
+
+#include <errno.h>
+#include <sys/epoll.h>
+#include <unistd.h>
+
+#include <mutex>
+
+#include "butil/common.h"
+
+namespace brpc {
+
+EventDispatcher::EventDispatcher() {
+  _epfd = epoll_create1(EPOLL_CLOEXEC);
+  if (pipe(_wakeup) != 0) {
+    BLOG(ERROR, "EventDispatcher: pipe() failed: %d", errno);
+  }
+  epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.u64 = (uint64_t)-1;  // wakeup marker
+  epoll_ctl(_epfd, EPOLL_CTL_ADD, _wakeup[0], &ev);
+  _thread = std::thread([this] { Run(); });
+}
+
+EventDispatcher::~EventDispatcher() {
+  Stop();
+  Join();
+  if (_epfd >= 0) close(_epfd);
+  if (_wakeup[0] >= 0) close(_wakeup[0]);
+  if (_wakeup[1] >= 0) close(_wakeup[1]);
+}
+
+int EventDispatcher::AddConsumer(SocketId sid, int fd) {
+  epoll_event ev;
+  ev.events = EPOLLIN | EPOLLOUT | EPOLLET | EPOLLRDHUP;
+  ev.data.u64 = sid;
+  return epoll_ctl(_epfd, EPOLL_CTL_ADD, fd, &ev);
+}
+
+int EventDispatcher::Rearm(SocketId sid, int fd) {
+  epoll_event ev;
+  ev.events = EPOLLIN | EPOLLOUT | EPOLLET | EPOLLRDHUP;
+  ev.data.u64 = sid;
+  return epoll_ctl(_epfd, EPOLL_CTL_MOD, fd, &ev);
+}
+
+void EventDispatcher::RemoveConsumer(int fd) {
+  epoll_ctl(_epfd, EPOLL_CTL_DEL, fd, nullptr);
+}
+
+void EventDispatcher::Stop() {
+  bool expected = false;
+  if (_stop.compare_exchange_strong(expected, true)) {
+    const char c = 0;
+    ssize_t rc = write(_wakeup[1], &c, 1);
+    (void)rc;
+  }
+}
+
+void EventDispatcher::Join() {
+  if (_thread.joinable()) _thread.join();
+}
+
+void EventDispatcher::Run() {
+  epoll_event events[64];
+  while (!_stop.load(std::memory_order_acquire)) {
+    const int n = epoll_wait(_epfd, events, 64, 1000);
+    if (n < 0 && errno != EINTR) {
+      BLOG(ERROR, "epoll_wait failed: %d", errno);
+      return;
+    }
+    for (int i = 0; i < n; ++i) {
+      const SocketId sid = events[i].data.u64;
+      if (sid == (uint64_t)-1) continue;  // wakeup pipe
+      Socket* s = Socket::Address(sid);
+      if (s == nullptr) continue;  // stale: slot recycled, drop
+      if (events[i].events & (EPOLLIN | EPOLLRDHUP | EPOLLHUP | EPOLLERR)) {
+        s->OnReadable();
+      }
+      if (events[i].events & EPOLLOUT) {
+        s->OnWritable();
+      }
+      s->Dereference();
+    }
+  }
+}
+
+// ---- global sharded set ----
+
+static std::mutex g_disp_mu;
+static std::atomic<std::vector<EventDispatcher*>*> g_dispatchers{nullptr};
+
+void EventDispatcher::InitGlobal(int num) {
+  std::lock_guard<std::mutex> g(g_disp_mu);
+  if (g_dispatchers.load(std::memory_order_acquire) != nullptr) return;
+  if (num <= 0) num = 2;
+  auto* v = new std::vector<EventDispatcher*>();
+  for (int i = 0; i < num; ++i) v->push_back(new EventDispatcher());
+  g_dispatchers.store(v, std::memory_order_release);
+}
+
+EventDispatcher* EventDispatcher::GetDispatcher(int fd) {
+  auto* v = g_dispatchers.load(std::memory_order_acquire);
+  if (v == nullptr) {
+    InitGlobal(0);
+    v = g_dispatchers.load(std::memory_order_acquire);
+  }
+  return (*v)[fd % v->size()];
+}
+
+void EventDispatcher::ShutdownGlobal() {
+  std::lock_guard<std::mutex> g(g_disp_mu);
+  auto* v = g_dispatchers.load(std::memory_order_acquire);
+  if (v == nullptr) return;
+  for (auto* d : *v) d->Stop();
+  for (auto* d : *v) {
+    d->Join();
+    delete d;
+  }
+  g_dispatchers.store(nullptr, std::memory_order_release);
+  delete v;
+}
+
+}  // namespace brpc

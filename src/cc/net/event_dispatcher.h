@@ -1,0 +1,45 @@
+// EventDispatcher — N epoll loops dispatching socket events
+// (SURVEY.md §2.3; reference src/brpc/event_dispatcher_epoll.cpp).
+//
+// Each dispatcher owns one epoll fd and one thread running epoll_wait;
+// sockets are registered edge-triggered with their versioned SocketId as the
+// epoll cookie, so a stale event on a recycled slot simply fails Address()
+// and is dropped — the same structural safety the reference gets.  Sockets
+// are sharded across dispatchers by fd (event_dispatcher.cpp:44).
+#pragma once
+
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include "net/socket.h"
+
+namespace brpc {
+
+class EventDispatcher {
+ public:
+  EventDispatcher();
+  ~EventDispatcher();
+
+  int AddConsumer(SocketId sid, int fd);
+  // EPOLL_CTL_MOD with the same event set: re-arms edge-triggered readiness
+  // so an EPOLLOUT edge missed between EAGAIN and this call is re-delivered.
+  int Rearm(SocketId sid, int fd);
+  void RemoveConsumer(int fd);
+  void Stop();
+  void Join();
+
+  static void InitGlobal(int num);        // idempotent; default 2
+  static EventDispatcher* GetDispatcher(int fd);
+  static void ShutdownGlobal();
+
+ private:
+  void Run();
+
+  int _epfd = -1;
+  int _wakeup[2] = {-1, -1};
+  std::atomic<bool> _stop{false};
+  std::thread _thread;
+};
+
+}  // namespace brpc

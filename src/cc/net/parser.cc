@@ -1,0 +1,131 @@
+#include "net/parser.h"
+
+#include <cstring>
+
+namespace brpc {
+
+size_t g_max_body_size = (size_t)2 * 1024 * 1024 * 1024;
+
+static uint32_t load_be32(const char* p) {
+  return ((uint32_t)(uint8_t)p[0] << 24) | ((uint32_t)(uint8_t)p[1] << 16) |
+         ((uint32_t)(uint8_t)p[2] << 8) | (uint32_t)(uint8_t)p[3];
+}
+
+static uint64_t load_be64(const char* p) {
+  return ((uint64_t)load_be32(p) << 32) | load_be32(p + 4);
+}
+
+static void store_be32(char* p, uint32_t v) {
+  p[0] = (char)(v >> 24);
+  p[1] = (char)(v >> 16);
+  p[2] = (char)(v >> 8);
+  p[3] = (char)v;
+}
+
+void make_trpc_header(char out[16], uint32_t meta_size, uint64_t body_size) {
+  memcpy(out, kTrpcMagic, 4);
+  store_be32(out + 4, meta_size);
+  store_be32(out + 8, (uint32_t)(body_size >> 32));
+  store_be32(out + 12, (uint32_t)body_size);
+}
+
+static bool looks_like_http(const char* p, size_t n) {
+  // Methods the console/RESTful layer accepts, plus response lines.
+  static const char* kTokens[] = {"GET ",  "POST ",   "PUT ",  "DELETE ",
+                                  "HEAD ", "OPTIONS ", "PATCH ", "HTTP/1."};
+  for (const char* t : kTokens) {
+    const size_t tl = strlen(t);
+    if (n >= tl && memcmp(p, t, tl) == 0) return true;
+    if (n < tl && memcmp(p, t, n) == 0) return true;  // maybe, need more
+  }
+  return false;
+}
+
+static ParseResult parse_http(butil::IOBuf* in, ParseState* st,
+                              ParsedMessage* out) {
+  // Copy up to 64KB of header zone to scan for CRLFCRLF; console traffic is
+  // small so the copy is fine (the TRPC hot path never comes here).
+  if (st->http_header_end == 0) {
+    const size_t scan = in->size() < 65536 ? in->size() : 65536;
+    std::string hdr;
+    hdr.resize(scan);
+    in->copy_to(hdr.data(), scan, 0);
+    const size_t pos = hdr.find("\r\n\r\n");
+    if (pos == std::string::npos) {
+      if (in->size() > 65536) return PARSE_ERROR;  // header too large
+      return PARSE_NEED_MORE;
+    }
+    st->http_header_end = pos + 4;
+    // Walk header lines properly: a substring scan would match inside
+    // e.g. "X-Content-Length" and mis-frame the stream.
+    st->http_body_len = 0;
+    std::string lower = hdr.substr(0, pos + 4);
+    for (auto& c : lower) c = (char)tolower(c);
+    size_t line = lower.find("\r\n");  // skip request/status line
+    while (line != std::string::npos && line + 2 < lower.size()) {
+      const size_t start = line + 2;
+      const size_t end = lower.find("\r\n", start);
+      if (end == std::string::npos || end == start) break;
+      const size_t colon = lower.find(':', start);
+      if (colon != std::string::npos && colon < end) {
+        std::string key = lower.substr(start, colon - start);
+        // trim trailing spaces from key, leading spaces from value
+        while (!key.empty() && (key.back() == ' ' || key.back() == '\t'))
+          key.pop_back();
+        size_t vs = colon + 1;
+        while (vs < end && (lower[vs] == ' ' || lower[vs] == '\t')) ++vs;
+        const std::string val = lower.substr(vs, end - vs);
+        if (key == "content-length") {
+          st->http_body_len = atoll(val.c_str());
+          if (st->http_body_len < 0 ||
+              (size_t)st->http_body_len > g_max_body_size)
+            return PARSE_ERROR;
+        } else if (key == "transfer-encoding" &&
+                   val.find("chunked") != std::string::npos) {
+          return PARSE_ERROR;  // chunked unsupported in the native core
+        }
+      }
+      line = end;
+    }
+  }
+  const size_t total = st->http_header_end + (size_t)st->http_body_len;
+  if (in->size() < total) return PARSE_NEED_MORE;
+  out->kind = MSG_HTTP;
+  out->meta.clear();
+  in->cutn(&out->body, total);
+  st->http_header_end = 0;
+  st->http_body_len = -1;
+  return PARSE_OK;
+}
+
+ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) {
+  if (in->empty()) return PARSE_NEED_MORE;
+  if (st->detected == MSG_HTTP) return parse_http(in, st, out);
+
+  char hdr[kTrpcHeaderLen];
+  const size_t got = in->copy_to(hdr, kTrpcHeaderLen, 0);
+  if (memcmp(hdr, kTrpcMagic, got < 4 ? got : 4) != 0) {
+    // Not TRPC: try-next-protocol (input_messenger.cpp:144-160 pattern).
+    if (looks_like_http(hdr, got)) {
+      st->detected = MSG_HTTP;
+      return parse_http(in, st, out);
+    }
+    return PARSE_ERROR;
+  }
+  if (got < kTrpcHeaderLen) return PARSE_NEED_MORE;
+  const uint32_t meta_size = load_be32(hdr + 4);
+  const uint64_t body_size = load_be64(hdr + 8);
+  if (meta_size > kMaxMetaSize || body_size > g_max_body_size)
+    return PARSE_ERROR;
+  const uint64_t total = kTrpcHeaderLen + meta_size + body_size;
+  if (in->size() < total) return PARSE_NEED_MORE;
+  in->pop_front(kTrpcHeaderLen);
+  out->kind = MSG_TRPC;
+  out->meta.resize(meta_size);
+  in->cutn(out->meta.data(), meta_size);
+  out->body.clear();
+  in->cutn(&out->body, body_size);
+  return PARSE_OK;
+}
+
+}  // namespace brpc

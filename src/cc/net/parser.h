@@ -1,0 +1,64 @@
+// Wire framing for the TPU-RPC socket core (SURVEY.md §2.4).
+//
+// The extension point in the reference is `struct Protocol` — a function
+// table tried in order until one recognizes the bytes, which is how all
+// protocols share one port (protocol.h:77-166, input_messenger.cpp:144-160).
+// Our native core implements the same try-in-order scheme over two built-in
+// framings, and hands *complete messages* (not bytes) upward; higher-level
+// protocol semantics (method dispatch, JSON↔tensor mapping, redis RESP, …)
+// live in the Python protocol registry which receives (kind, meta, body).
+//
+//  * TRPC framing (our baidu_std analog, reference baidu_rpc_protocol.cpp:
+//    97-137): 16-byte header = "TRPC" + u32be meta_size + u64be body_size,
+//    then meta bytes, then body bytes.  Meta is opaque to the core.
+//  * HTTP/1.x detection: request/status line + headers until CRLFCRLF +
+//    content-length body, delivered as one raw message (kind HTTP).  Enough
+//    for the builtin debug console and RESTful access; chunked uploads are
+//    handled by the Python layer over streaming reads in a later round.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "butil/iobuf.h"
+
+namespace brpc {
+
+enum MessageKind {
+  MSG_TRPC = 0,
+  MSG_HTTP = 1,
+};
+
+enum ParseResult {
+  PARSE_OK = 0,
+  PARSE_NEED_MORE = 1,
+  PARSE_ERROR = 2,
+};
+
+constexpr char kTrpcMagic[4] = {'T', 'R', 'P', 'C'};
+constexpr size_t kTrpcHeaderLen = 16;
+constexpr size_t kMaxMetaSize = 16 * 1024 * 1024;
+extern size_t g_max_body_size;  // FLAGS_max_body_size analog (default 2GB)
+
+struct ParsedMessage {
+  int kind = MSG_TRPC;
+  std::string meta;      // contiguous, small
+  butil::IOBuf body;     // zero-copy cut from the read buffer
+};
+
+struct ParseState {
+  int detected = -1;     // -1 unknown, else MessageKind
+  // http incremental state
+  size_t http_header_end = 0;   // offset past CRLFCRLF once found
+  ssize_t http_body_len = -1;   // from content-length
+};
+
+// Try to cut one message off `in`.  On PARSE_OK, fills *out and removes the
+// consumed bytes from `in`; PARSE_NEED_MORE leaves `in` intact.
+ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out);
+
+// Serialize a TRPC frame header.
+void make_trpc_header(char out[16], uint32_t meta_size, uint64_t body_size);
+
+}  // namespace brpc

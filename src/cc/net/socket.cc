@@ -1,0 +1,413 @@
+#include "net/socket.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include "bthread/executor.h"
+#include "net/event_dispatcher.h"
+
+namespace brpc {
+
+using butil::ResourcePool;
+
+static ResourcePool<Socket>* pool() { return ResourcePool<Socket>::singleton(); }
+
+static std::atomic<int64_t> g_active_sockets{0};
+
+int64_t Socket::active_count() { return g_active_sockets.load(std::memory_order_relaxed); }
+
+static int make_nonblocking(int fd) {
+  const int flags = fcntl(fd, F_GETFL, 0);
+  return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+// ---- versioned lifecycle ----
+
+int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
+  uint32_t slot = 0;
+  Socket* s = pool()->get_resource(&slot);
+  if (s == nullptr) {
+    BLOG(ERROR, "socket pool exhausted");
+    return -1;
+  }
+  const uint64_t v = s->_vref.load(std::memory_order_acquire);
+  const uint32_t version = (uint32_t)(v >> 32);  // even for a recycled slot
+  s->_id = ((uint64_t)version << 32) | slot;
+  s->_fd = opts.fd;
+  s->_error_code = 0;
+  s->_opts = opts;
+  s->_out_buf.clear();
+  s->_read_buf.clear();
+  s->_parse = ParseState();
+  s->_write_stack.store(nullptr, std::memory_order_relaxed);
+  s->_write_busy.store(false, std::memory_order_relaxed);
+  s->_waiting_epollout.store(false, std::memory_order_relaxed);
+  s->_nread.store(0, std::memory_order_relaxed);
+  s->_nwritten.store(0, std::memory_order_relaxed);
+  s->_nmsg.store(0, std::memory_order_relaxed);
+  s->FillRemoteAddr();
+  // Publish with one "registration" ref (dropped by SetFailed).
+  s->_vref.store(((uint64_t)version << 32) | 1, std::memory_order_release);
+  g_active_sockets.fetch_add(1, std::memory_order_relaxed);
+  *id_out = s->_id;
+  if (opts.fd >= 0) {
+    make_nonblocking(opts.fd);
+    if (!opts.is_listener) {
+      const int one = 1;
+      setsockopt(opts.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    }
+    if (EventDispatcher::GetDispatcher(opts.fd)->AddConsumer(s->_id, opts.fd) != 0) {
+      SetFailed(s->_id, errno);
+      return -1;
+    }
+  }
+  return 0;
+}
+
+Socket* Socket::Address(SocketId id) {
+  Socket* s = pool()->address((uint32_t)id);
+  if (s == nullptr) return nullptr;
+  uint64_t v = s->_vref.load(std::memory_order_acquire);
+  const uint32_t want = (uint32_t)(id >> 32);
+  while (true) {
+    if ((uint32_t)(v >> 32) != want) return nullptr;
+    if (s->_vref.compare_exchange_weak(v, v + 1, std::memory_order_acq_rel,
+                                       std::memory_order_acquire)) {
+      return s;
+    }
+  }
+}
+
+bool Socket::failed() const {
+  // Alive iff the packed version still equals this socket's id version
+  // (SetFailed bumps it to id_version+1, recycle to id_version+2).
+  return (uint32_t)(_id >> 32) !=
+         (uint32_t)(_vref.load(std::memory_order_acquire) >> 32);
+}
+
+int Socket::SetFailed(SocketId id, int error_code) {
+  Socket* s = Socket::Address(id);
+  if (s == nullptr) return -1;
+  const uint32_t want = (uint32_t)(id >> 32);
+  uint64_t v = s->_vref.load(std::memory_order_acquire);
+  bool won = false;
+  while ((uint32_t)(v >> 32) == want) {
+    const uint64_t nv = ((uint64_t)(want + 1) << 32) | (uint32_t)v;
+    if (s->_vref.compare_exchange_weak(v, nv, std::memory_order_acq_rel,
+                                       std::memory_order_acquire)) {
+      won = true;
+      break;
+    }
+  }
+  if (won) {
+    s->_error_code = error_code;
+    if (s->_fd >= 0) EventDispatcher::GetDispatcher(s->_fd)->RemoveConsumer(s->_fd);
+    if (s->_opts.on_failed != nullptr) {
+      s->_opts.on_failed(id, error_code, s->_opts.user);
+    }
+    s->Dereference();  // drop the registration ref
+  }
+  s->Dereference();  // drop the Address ref
+  return won ? 0 : -1;
+}
+
+void Socket::CloseFd() {
+  if (_fd >= 0) {
+    close(_fd);
+    _fd = -1;
+  }
+}
+
+void Socket::Dereference() {
+  const uint64_t v = _vref.fetch_sub(1, std::memory_order_acq_rel);
+  if ((uint32_t)v != 1) return;
+  // Last ref: recycle.  Version is odd (SetFailed ran); make it even for the
+  // next Create so the slot can be reused with a fresh id.
+  const uint32_t ver = (uint32_t)(v >> 32);
+  CloseFd();
+  WriteRequest* head = _write_stack.exchange(nullptr, std::memory_order_acquire);
+  while (head != nullptr) {
+    WriteRequest* next = head->next;
+    delete head;
+    head = next;
+  }
+  _out_buf.clear();
+  _read_buf.clear();
+  g_active_sockets.fetch_sub(1, std::memory_order_relaxed);
+  const uint32_t slot = (uint32_t)_id;
+  _vref.store((uint64_t)(ver + 1) << 32, std::memory_order_release);
+  pool()->return_resource(slot);
+}
+
+void Socket::FillRemoteAddr() {
+  _remote_ip[0] = 0;
+  _remote_port = 0;
+  if (_fd < 0) return;
+  sockaddr_storage ss;
+  socklen_t len = sizeof(ss);
+  if (getpeername(_fd, (sockaddr*)&ss, &len) == 0) {
+    if (ss.ss_family == AF_INET) {
+      auto* a = (sockaddr_in*)&ss;
+      inet_ntop(AF_INET, &a->sin_addr, _remote_ip, sizeof(_remote_ip));
+      _remote_port = ntohs(a->sin_port);
+    } else if (ss.ss_family == AF_INET6) {
+      auto* a = (sockaddr_in6*)&ss;
+      inet_ntop(AF_INET6, &a->sin6_addr, _remote_ip, sizeof(_remote_ip));
+      _remote_port = ntohs(a->sin6_port);
+    }
+  }
+}
+
+// ---- write path (wait-free producers, single drainer) ----
+
+int Socket::Write(butil::IOBuf&& data) {
+  if (failed()) return -1;
+  auto* req = new WriteRequest{std::move(data), nullptr};
+  WriteRequest* old = _write_stack.load(std::memory_order_relaxed);
+  do {
+    req->next = old;
+  } while (!_write_stack.compare_exchange_weak(old, req,
+                                               std::memory_order_seq_cst,
+                                               std::memory_order_relaxed));
+  if (!_write_busy.exchange(true, std::memory_order_seq_cst)) {
+    // We own the drain: write inline once on the caller thread (the wait-free
+    // fast path — one syscall in caller context, reference socket.cpp:1748).
+    DrainWriteQueue(false);
+  }
+  return 0;
+}
+
+void Socket::DrainWriteQueue(bool from_keepwrite) {
+  while (true) {
+    if (failed()) {
+      WriteRequest* head = _write_stack.exchange(nullptr, std::memory_order_acquire);
+      while (head != nullptr) {
+        WriteRequest* next = head->next;
+        delete head;
+        head = next;
+      }
+      _out_buf.clear();
+      _write_busy.store(false, std::memory_order_seq_cst);
+      return;
+    }
+    // Move queued requests into _out_buf in FIFO order (zero-copy).
+    WriteRequest* head = _write_stack.exchange(nullptr, std::memory_order_seq_cst);
+    WriteRequest* prev = nullptr;
+    while (head != nullptr) {
+      WriteRequest* next = head->next;
+      head->next = prev;
+      prev = head;
+      head = next;
+    }
+    while (prev != nullptr) {
+      _out_buf.append(std::move(prev->data));
+      WriteRequest* next = prev->next;
+      delete prev;
+      prev = next;
+    }
+    if (_out_buf.empty()) {
+      // Release with recheck (single-drainer protocol, see execution_queue.h).
+      _write_busy.store(false, std::memory_order_seq_cst);
+      if (_write_stack.load(std::memory_order_seq_cst) != nullptr &&
+          !_write_busy.exchange(true, std::memory_order_seq_cst)) {
+        continue;
+      }
+      return;
+    }
+    while (!_out_buf.empty()) {
+      const ssize_t nw = _out_buf.cut_into_file_descriptor(_fd);
+      if (nw >= 0) {
+        _nwritten.fetch_add(nw, std::memory_order_relaxed);
+        continue;
+      }
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        // Wait for EPOLLOUT.  EPOLL_CTL_MOD re-arms edge-triggered readiness,
+        // so a writability edge between our failed write and the MOD is not
+        // lost (reference RegisterEvent, socket.cpp:1800-1920 role).  After
+        // the MOD we must not touch socket state — the resume task may
+        // already be running.
+        _waiting_epollout.store(true, std::memory_order_seq_cst);
+        EventDispatcher::GetDispatcher(_fd)->Rearm(_id, _fd);
+        return;
+      }
+      SetFailed(_id, errno);
+      break;  // failed() branch cleans up on the next loop
+    }
+  }
+}
+
+void Socket::OnWritable() {
+  if (_waiting_epollout.exchange(false, std::memory_order_seq_cst)) {
+    // Resume the drain off the dispatcher thread.
+    Socket* self = Socket::Address(_id);
+    if (self == nullptr) return;
+    bthread::Executor::global()->submit([self] {
+      self->DrainWriteQueue(true);
+      self->Dereference();
+    });
+  }
+}
+
+// ---- read path ----
+
+void Socket::OnReadable() {
+  if (_opts.is_listener) {
+    DoAcceptLoop();
+    return;
+  }
+  while (true) {
+    const ssize_t nr = _read_buf.append_from_file_descriptor(_fd, 256 * 1024);
+    if (nr > 0) {
+      _nread.fetch_add(nr, std::memory_order_relaxed);
+      DispatchMessages();
+      // Edge-triggered: must keep reading until EAGAIN.
+      continue;
+    }
+    if (nr == 0) {
+      SetFailed(_id, 0);  // clean EOF
+      return;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+    if (errno == EINTR) continue;
+    SetFailed(_id, errno);
+    return;
+  }
+}
+
+struct PendingMessage {
+  SocketId sid;
+  int kind;
+  std::string meta;
+  butil::IOBuf* body;
+  MessageCallback cb;
+  void* user;
+};
+
+static void run_message_task(void* arg) {
+  auto* m = (PendingMessage*)arg;
+  m->cb(m->sid, m->kind, m->meta.data(), m->meta.size(), m->body, m->user);
+  delete m;  // callback owns *body (freed via C ABI)
+}
+
+void Socket::DispatchMessages() {
+  ParsedMessage msg;
+  while (true) {
+    const ParseResult r = parse_message(&_read_buf, &_parse, &msg);
+    if (r == PARSE_NEED_MORE) return;
+    if (r == PARSE_ERROR) {
+      BLOG(WARNING, "parse error on socket %llu, closing",
+           (unsigned long long)_id);
+      SetFailed(_id, EPROTO);
+      return;
+    }
+    _nmsg.fetch_add(1, std::memory_order_relaxed);
+    if (_opts.native_echo && msg.kind == MSG_TRPC) {
+      // Native echo service: reflect the frame without leaving C++.
+      butil::IOBuf out;
+      char hdr[kTrpcHeaderLen];
+      make_trpc_header(hdr, (uint32_t)msg.meta.size(), msg.body.size());
+      out.append(hdr, sizeof(hdr));
+      out.append(msg.meta);
+      out.append(std::move(msg.body));
+      Write(std::move(out));
+      msg.body.clear();
+      continue;
+    }
+    if (_opts.on_message == nullptr) {
+      msg.body.clear();
+      continue;
+    }
+    auto* pm = new PendingMessage{_id, msg.kind, std::move(msg.meta),
+                                  new butil::IOBuf(std::move(msg.body)),
+                                  _opts.on_message, _opts.user};
+    bthread::Executor::global()->submit(run_message_task, pm);
+  }
+}
+
+void Socket::DoAcceptLoop() {
+  while (true) {
+    sockaddr_storage ss;
+    socklen_t len = sizeof(ss);
+    const int fd = accept4(_fd, (sockaddr*)&ss, &len, SOCK_NONBLOCK | SOCK_CLOEXEC);
+    if (fd < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+      if (errno == EINTR || errno == ECONNABORTED) continue;
+      BLOG(WARNING, "accept4 failed: %d", errno);
+      return;
+    }
+    SocketOptions copts = _opts;
+    copts.fd = fd;
+    copts.is_listener = false;
+    SocketId cid;
+    if (Socket::Create(copts, &cid) == 0 && _opts.on_accepted != nullptr) {
+      _opts.on_accepted(_id, cid, _opts.user);
+    }
+  }
+}
+
+// ---- connect / listen ----
+
+int Connect(const char* host, int port, const SocketOptions& opts, SocketId* id) {
+  addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || res == nullptr) {
+    return -1;
+  }
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return -1;
+  SocketOptions o = opts;
+  o.fd = fd;
+  return Socket::Create(o, id);
+}
+
+int Listen(const char* addr, int port, const SocketOptions& opts, SocketId* id,
+           int* bound_port) {
+  const int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  const int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (addr == nullptr || addr[0] == 0) {
+    sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) {
+    close(fd);
+    return -1;
+  }
+  if (bind(fd, (sockaddr*)&sa, sizeof(sa)) != 0 || listen(fd, 1024) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (bound_port != nullptr) {
+    socklen_t len = sizeof(sa);
+    getsockname(fd, (sockaddr*)&sa, &len);
+    *bound_port = ntohs(sa.sin_port);
+  }
+  SocketOptions o = opts;
+  o.fd = fd;
+  o.is_listener = true;
+  return Socket::Create(o, id);
+}
+
+}  // namespace brpc

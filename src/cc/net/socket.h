@@ -1,0 +1,139 @@
+// Socket — the central connection object (SURVEY.md §2.3; reference
+// src/brpc/socket.{h,cpp}).
+//
+// Shapes kept from the reference, re-implemented:
+//  * Versioned addressing: SocketId = version⊕slot over a ResourcePool;
+//    Address() only yields a pointer while the packed (version|nref) word
+//    matches, so stale handles fail instead of racing (socket_id.h:26-34,
+//    versioned_ref_with_id.h).  SetFailed bumps the version.
+//  * Wait-free write: Write() pushes onto a lock-free MPSC stack; exactly one
+//    drainer exists at a time (busy-flag protocol); the thread that takes the
+//    flag writes inline once and hands leftovers to a KeepWrite task that
+//    waits for EPOLLOUT on EAGAIN (socket.cpp:1692-1920 behavior).
+//  * Input side: edge-triggered read into an IOPortal, protocol parse cuts
+//    messages, each message dispatched as one Executor task (the "one bthread
+//    per message" rule, input_messenger.cpp:175-213).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+#include "butil/common.h"
+#include "butil/iobuf.h"
+#include "butil/resource_pool.h"
+#include "net/parser.h"
+
+namespace brpc {
+
+typedef uint64_t SocketId;
+constexpr SocketId INVALID_SOCKET_ID = (SocketId)-1;
+
+class EventDispatcher;
+class Socket;
+
+// Complete-message callback.  kind: see parser.h MessageKind.
+// meta/meta_len: contiguous protocol meta bytes (frame header payload).
+// body: heap IOBuf* whose ownership passes to the callback.
+typedef void (*MessageCallback)(SocketId sid, int kind, const char* meta,
+                                size_t meta_len, butil::IOBuf* body,
+                                void* user);
+// Called once when a socket transitions to failed.
+typedef void (*SocketFailedCallback)(SocketId sid, int error_code, void* user);
+// Called for a listening socket when a new connection is accepted.
+typedef void (*AcceptedCallback)(SocketId listener, SocketId conn, void* user);
+
+struct SocketOptions {
+  int fd = -1;
+  MessageCallback on_message = nullptr;
+  SocketFailedCallback on_failed = nullptr;
+  AcceptedCallback on_accepted = nullptr;  // listener sockets only
+  void* user = nullptr;
+  bool is_listener = false;
+  // Echo TRPC frames back in native code without surfacing to the callback
+  // (benchmark fast path; models a native service implementation).
+  bool native_echo = false;
+};
+
+struct WriteRequest {
+  butil::IOBuf data;
+  WriteRequest* next = nullptr;
+};
+
+class Socket {
+ public:
+  // ---- lifecycle (static, pool-based) ----
+  static int Create(const SocketOptions& opts, SocketId* id);
+  // Returns a referenced Socket* or nullptr if the id is stale/failed.
+  // Callers MUST pair with Dereference().
+  static Socket* Address(SocketId id);
+  static int SetFailed(SocketId id, int error_code);
+  static int64_t active_count();
+
+  void Dereference();
+
+  // ---- IO ----
+  // Queue a frame for writing (wait-free producer side).  Takes ownership of
+  // data's refs.  Returns 0 or -1 if the socket is failed.
+  int Write(butil::IOBuf&& data);
+  int fd() const { return _fd; }
+  SocketId id() const { return _id; }
+  bool failed() const;
+
+  // stats (exported through bvar)
+  int64_t bytes_read() const { return _nread.load(std::memory_order_relaxed); }
+  int64_t bytes_written() const { return _nwritten.load(std::memory_order_relaxed); }
+  int64_t messages_read() const { return _nmsg.load(std::memory_order_relaxed); }
+  int64_t remote_port() const { return _remote_port; }
+  const char* remote_ip() const { return _remote_ip; }
+
+  // ---- called by EventDispatcher ----
+  void OnReadable();
+  void OnWritable();
+
+  Socket() = default;
+
+ private:
+  friend class EventDispatcher;
+
+  void DoAcceptLoop();
+  void DrainWriteQueue(bool from_keepwrite);
+  void ReleaseWriterAndMaybeResume();
+  bool BecomeWriter();  // busy-flag acquire
+  void DispatchMessages();
+  void CloseFd();
+  void FillRemoteAddr();
+
+  // packed (version<<32 | nref); even version = alive
+  std::atomic<uint64_t> _vref{0};
+  SocketId _id = INVALID_SOCKET_ID;
+  int _fd = -1;
+  int _error_code = 0;
+  SocketOptions _opts;
+
+  // write path
+  std::atomic<WriteRequest*> _write_stack{nullptr};
+  std::atomic<bool> _write_busy{false};
+  std::atomic<bool> _waiting_epollout{false};
+  butil::IOBuf _out_buf;  // drainer-owned unwritten bytes
+
+  // read path
+  butil::IOPortal _read_buf;
+  ParseState _parse;
+
+  std::atomic<int64_t> _nread{0}, _nwritten{0}, _nmsg{0};
+  char _remote_ip[46] = {0};
+  int _remote_port = 0;
+};
+
+// Connect to host:port (blocking connect on caller thread; the reference uses
+// bthread_connect, we accept the one-time syscall).  Returns 0 and sets *id.
+int Connect(const char* host, int port, const SocketOptions& opts, SocketId* id);
+
+// Listen on addr:port and accept connections; each accepted socket inherits
+// the message callbacks from `opts` (acceptor role, reference acceptor.cpp).
+int Listen(const char* addr, int port, const SocketOptions& opts, SocketId* id,
+           int* bound_port);
+
+}  // namespace brpc

@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the TPU-build analog of the
+reference's 127.0.0.1 loopback servers, SURVEY.md §4): multi-chip sharding
+logic is validated with ``xla_force_host_platform_device_count=8`` so no real
+pod is needed.  Real-chip benchmarks live in bench.py, not here.
+"""
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
