@@ -1,0 +1,25 @@
+"""tpu-rpc: a TPU-native RPC framework with the capabilities of Apache bRPC.
+
+Built from scratch against the structural analysis in SURVEY.md:
+  * native C++ host core (src/cc/): zero-copy IOBuf, work-stealing executor,
+    timer thread, epoll socket core with wait-free writes, wire framing
+  * Python protocol/API layer: Channel/Controller/Server, combo channels,
+    load balancing, naming, health checking, circuit breaking, streaming,
+    bvar metrics, builtin HTTP console
+  * TPU-native transport (brpc_tpu.ici): IOBuf blocks in HBM, chip-to-chip
+    streaming via XLA collectives, fan-out lowered to ppermute/all_gather
+"""
+__version__ = "0.1.0"
+
+from brpc_tpu import errors  # noqa: F401
+from brpc_tpu.errors import RpcError  # noqa: F401
+from brpc_tpu.rpc import (  # noqa: F401
+    CallManager, Channel, ChannelOptions, Controller, MethodStatus,
+    RetryPolicy, Server, ServerOptions, Service, SocketMap, Stream,
+    StreamHandler, method, stream_accept, stream_create,
+)
+from brpc_tpu.rpc.service import MethodSpec  # noqa: F401
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint  # noqa: F401
+from brpc_tpu import bvar  # noqa: F401
+from brpc_tpu import flags  # noqa: F401
+from brpc_tpu import rpcz  # noqa: F401

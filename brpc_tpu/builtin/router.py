@@ -1,0 +1,118 @@
+"""Builtin HTTP console router (reference src/brpc/builtin/*; SURVEY.md §2.7).
+
+Serves the observability pages on the SAME port as RPC traffic (the native
+core detects HTTP and hands raw requests here).  Endpoints are registered in
+builtin/services.py; this module parses requests and frames responses.
+"""
+from __future__ import annotations
+
+import traceback
+from urllib.parse import parse_qs, urlparse
+
+from brpc_tpu.rpc.transport import Transport
+
+
+class HttpRequest:
+    def __init__(self, raw: bytes):
+        head, _, self.body = raw.partition(b"\r\n\r\n")
+        lines = head.split(b"\r\n")
+        parts = lines[0].decode("latin1").split(" ")
+        self.method = parts[0]
+        target = parts[1] if len(parts) > 1 else "/"
+        u = urlparse(target)
+        self.path = u.path
+        self.query = {k: v[0] for k, v in parse_qs(u.query).items()}
+        self.headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.decode("latin1").partition(":")
+            self.headers[k.strip().lower()] = v.strip()
+
+
+def http_response(status: int, body: bytes | str,
+                  content_type: str = "text/plain; charset=utf-8",
+                  extra_headers: dict | None = None) -> bytes:
+    if isinstance(body, str):
+        body = body.encode()
+    reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error",
+              400: "Bad Request", 302: "Found"}.get(status, "OK")
+    hdr = [f"HTTP/1.1 {status} {reason}",
+           f"Content-Type: {content_type}",
+           f"Content-Length: {len(body)}"]
+    for k, v in (extra_headers or {}).items():
+        hdr.append(f"{k}: {v}")
+    hdr.append("\r\n")
+    return "\r\n".join(hdr).encode() + body
+
+
+class HttpRouter:
+    def __init__(self, server):
+        self.server = server
+        from brpc_tpu.builtin.services import build_routes
+        self.routes = build_routes(server)
+
+    def handle(self, sid: int, raw: bytes) -> None:
+        t = Transport.instance()
+        try:
+            req = HttpRequest(raw)
+        except Exception:
+            t.write_raw(sid, http_response(400, "bad request"))
+            return
+        # exact match, then longest prefix (pprof-style subpaths)
+        handler = self.routes.get(req.path)
+        if handler is None:
+            best = ""
+            for prefix, h in self.routes.items():
+                if len(prefix) > 1 and prefix.endswith("/") and \
+                        req.path.startswith(prefix) and len(prefix) > len(best):
+                    handler, best = h, prefix
+            if handler is None and req.path.startswith("/"):
+                # RESTful RPC access: /ServiceName/Method
+                handler = self._try_rpc(req)
+        if handler is None:
+            t.write_raw(sid, http_response(
+                404, f"no handler for {req.path!r}\n"))
+            return
+        try:
+            resp = handler(req) if callable(handler) else handler
+            if isinstance(resp, bytes) and resp.startswith(b"HTTP/1."):
+                t.write_raw(sid, resp)
+            else:
+                body, ctype = resp if isinstance(resp, tuple) else \
+                    (resp, "text/plain; charset=utf-8")
+                t.write_raw(sid, http_response(200, body, ctype))
+        except Exception:
+            t.write_raw(sid, http_response(500, traceback.format_exc()))
+
+    def _try_rpc(self, req: HttpRequest):
+        """RESTful bridge: POST /Service/Method with a JSON body calls the
+        RPC method (the json2pb RESTful path of the reference, restful.cpp)."""
+        parts = [p for p in req.path.split("/") if p]
+        if len(parts) != 2:
+            return None
+        key = (parts[0], parts[1])
+        spec = self.server._methods.get(key)
+        if spec is None:
+            return None
+
+        def call(req_: HttpRequest):
+            import json
+            from brpc_tpu import errors
+            try:
+                payload = json.loads(req_.body) if req_.body.strip() else None
+            except json.JSONDecodeError as e:
+                return http_response(
+                    400, json.dumps({"error": errors.EREQUEST,
+                                     "text": f"bad JSON body: {e}"}),
+                    "application/json")
+            try:
+                result = self.server.invoke_restful(parts[0], parts[1],
+                                                    payload)
+            except errors.RpcError as e:
+                status = 401 if e.code == errors.ERPCAUTH else \
+                    503 if e.code in (errors.ELIMIT, errors.ELOGOFF) else 500
+                return http_response(
+                    status, json.dumps({"error": e.code, "text": e.text}),
+                    "application/json")
+            return json.dumps(result, default=str), "application/json"
+
+        return call

@@ -1,0 +1,193 @@
+"""Builtin console pages (reference src/brpc/builtin/, 27 services
+auto-registered at server.cpp:484-586; SURVEY.md §2.7).
+
+Implemented: /index (dashboard), /status (per-method qps/latency via
+MethodStatus), /vars (+ wildcard filter), /flags (live edit with ?setvalue=),
+/health, /version, /connections, /sockets, /bthreads (executor stats),
+/rpcz (recent spans, ?trace_id= filter), /brpc_metrics (Prometheus text),
+/services (method inventory — /protobufs analog), /memory, /ici (link
+stats of the ICI transport).
+"""
+from __future__ import annotations
+
+import html
+import json
+import os
+import time
+
+from brpc_tpu import rpcz
+from brpc_tpu.bvar import dump_exposed
+from brpc_tpu.flags import list_flags, set_flag
+from brpc_tpu.builtin.router import HttpRequest, http_response
+from brpc_tpu._core import core
+
+
+def build_routes(server) -> dict:
+    def index(req):
+        rows = "".join(
+            f'<li><a href="{p}">{p}</a></li>'
+            for p in sorted(routes) if p not in ("/", "/index"))
+        return (f"<html><head><title>{server.options.server_info_name}"
+                f"</title></head><body><h1>"
+                f"{server.options.server_info_name}</h1>"
+                f"<p>uptime {server.uptime_s:.0f}s · port {server.port} · "
+                f"{server.connection_count} connections</p>"
+                f"<ul>{rows}</ul></body></html>", "text/html")
+
+    def status(req):
+        lines = [f"server: {server.options.server_info_name}",
+                 f"uptime_s: {server.uptime_s:.0f}",
+                 f"port: {server.port}",
+                 f"connections: {server.connection_count}", ""]
+        for (svc, m), st in sorted(server.method_statuses.items()):
+            r = st.latency_rec
+            lines.append(
+                f"{svc}.{m}: count={r.count()} error={st.nerror.get_value()} "
+                f"qps={r.qps():.1f} concurrency={st.concurrency} "
+                f"latency_avg_us={r.latency():.0f} "
+                f"p99_us={r.latency_percentile(0.99):.0f} "
+                f"max_us={r.max_latency()}")
+        return "\n".join(lines) + "\n"
+
+    def vars_page(req):
+        pattern = req.query.get("filter", "*")
+        data = dump_exposed(pattern)
+        return "".join(f"{k} : {_fmt(v)}\n" for k, v in sorted(data.items()))
+
+    def flags_page(req):
+        name = req.query.get("setvalue")
+        if name is not None:
+            val = req.query.get(name, req.query.get("value", ""))
+            ok = set_flag(name, val)
+            _apply_flag_side_effects(name)
+            return ("ok\n" if ok else
+                    http_response(400, f"cannot set flag {name!r}\n"))
+        out = []
+        for f in list_flags():
+            mark = " (R)" if f.reloadable else ""
+            out.append(f"{f.name}={f.value}{mark}  # {f.help} "
+                       f"(default {f.default})")
+        return "\n".join(out) + "\n"
+
+    def health(req):
+        return ("OK\n" if server.running else
+                http_response(500, "server stopping\n"))
+
+    def version(req):
+        from brpc_tpu import __version__
+        return f"tpu-rpc/{__version__}\n"
+
+    def connections(req):
+        from brpc_tpu.rpc.transport import Transport
+        t = Transport.instance()
+        lines = [f"{'socket_id':>12} {'remote':>22} {'read':>12} "
+                 f"{'written':>12} {'msgs':>8}"]
+        for sid in server.connections():
+            s = t.socket_stats(sid)
+            if s:
+                lines.append(f"{sid:>12} {s['remote']:>22} "
+                             f"{s['bytes_read']:>12} {s['bytes_written']:>12} "
+                             f"{s['messages_read']:>8}")
+        return "\n".join(lines) + "\n"
+
+    def sockets(req):
+        return (f"active_sockets: {core.brpc_socket_active_count()}\n"
+                f"live_iobuf_blocks: {core.brpc_iobuf_live_blocks()}\n")
+
+    def bthreads(req):
+        return (f"workers: {core.brpc_executor_num_workers()}\n"
+                f"tasks_executed: {core.brpc_executor_tasks_executed()}\n"
+                f"steals: {core.brpc_executor_steals()}\n"
+                f"timers_fired: {core.brpc_timer_fired()}\n")
+
+    def rpcz_page(req):
+        tid = req.query.get("trace_id")
+        limit = int(req.query.get("limit", "50"))
+        spans = rpcz.recent_spans(limit, int(tid) if tid else None)
+        lines = []
+        for s in reversed(spans):
+            lines.append(
+                f"{time.strftime('%H:%M:%S', time.localtime(s.start_us/1e6))}"
+                f" trace={s.trace_id} span={s.span_id} "
+                f"parent={s.parent_span_id} {s.kind} "
+                f"{s.service}.{s.method} peer={s.remote_side} "
+                f"lat={s.latency_us}us req={s.request_size}B "
+                f"res={s.response_size}B err={s.error_code}"
+                + ("".join(f"\n    @{t} {html.escape(m)}"
+                           for t, m in s.annotations)))
+        return "\n".join(lines) + "\n"
+
+    def metrics(req):
+        # Prometheus text format (builtin/prometheus_metrics_service.cpp role)
+        out = []
+        for k, v in sorted(dump_exposed("*").items()):
+            name = k.replace("-", "_").replace(".", "_").replace("/", "_")
+            if isinstance(v, bool):
+                v = int(v)
+            if isinstance(v, (int, float)):
+                out.append(f"# TYPE {name} gauge")
+                out.append(f"{name} {v}")
+            elif isinstance(v, dict):  # MultiDimension
+                out.append(f"# TYPE {name} gauge")
+                for labels, lv in v.items():
+                    if isinstance(lv, (int, float)):
+                        out.append(f'{name}{{label="{labels}"}} {lv}')
+        return "\n".join(out) + "\n", "text/plain; version=0.0.4"
+
+    def services_page(req):
+        out = {}
+        for name, svc in server.services.items():
+            out[name] = {m: {
+                "request": spec.request_serializer.name,
+                "response": spec.response_serializer.name,
+            } for m, spec in svc.rpc_methods().items()}
+        return json.dumps(out, indent=1), "application/json"
+
+    def memory(req):
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return (f"max_rss_kb: {ru.ru_maxrss}\n"
+                f"live_iobuf_blocks: {core.brpc_iobuf_live_blocks()}\n")
+
+    def ici(req):
+        try:
+            from brpc_tpu.ici.endpoint import link_stats
+            return json.dumps(link_stats(), indent=1), "application/json"
+        except Exception:
+            return "ici transport not active\n"
+
+    routes = {
+        "/": index, "/index": index,
+        "/status": status,
+        "/vars": vars_page,
+        "/flags": flags_page,
+        "/health": health,
+        "/version": version,
+        "/connections": connections,
+        "/sockets": sockets,
+        "/bthreads": bthreads,
+        "/rpcz": rpcz_page,
+        "/brpc_metrics": metrics,
+        "/services": services_page,
+        "/protobufs": services_page,
+        "/memory": memory,
+        "/ici": ici,
+    }
+    return routes
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return v
+
+
+def _apply_flag_side_effects(name: str) -> None:
+    from brpc_tpu.flags import get_flag
+    if name == "rpcz_enabled" or name == "rpcz_sample_rate":
+        rpcz.set_enabled(get_flag("rpcz_enabled", True),
+                         get_flag("rpcz_sample_rate", 1.0))
+    elif name == "health_check_interval_s":
+        from brpc_tpu.policy import health_check
+        health_check.health_check_interval_s = \
+            get_flag("health_check_interval_s", 1.0)

@@ -1,0 +1,2 @@
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint  # noqa: F401
+from brpc_tpu.butil.doubly_buffered import DoublyBufferedData  # noqa: F401

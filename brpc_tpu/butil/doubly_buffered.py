@@ -1,0 +1,36 @@
+"""DoublyBufferedData — read-mostly data with wait-free reads.
+
+Reference: src/butil/containers/doubly_buffered_data.h:38-75 — readers take a
+thread-local lock on the foreground copy; a writer modifies the background
+copy, atomically flips, then takes every reader lock once to ensure no reader
+still uses the old foreground.  Backs every load balancer's server list.
+
+Python build keeps the same contract with simpler machinery: reads are a
+single attribute load of an immutable snapshot (atomic under the GIL and under
+free-threading, since snapshots are never mutated); writes copy-modify-flip
+under a writer mutex.  Same wait-free read property, idiomatic substrate.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class DoublyBufferedData(Generic[T]):
+    def __init__(self, initial: T):
+        self._fg: T = initial
+        self._mu = threading.Lock()
+
+    def read(self) -> T:
+        """Wait-free: returns the current immutable snapshot."""
+        return self._fg
+
+    def modify(self, fn: Callable[[T], T]) -> T:
+        """Apply fn to a copy of the current value and flip.  fn must treat
+        its input as read-only and return the new snapshot."""
+        with self._mu:
+            new = fn(self._fg)
+            self._fg = new
+            return new

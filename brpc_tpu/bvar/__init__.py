@@ -1,0 +1,7 @@
+from brpc_tpu.bvar.variable import (  # noqa: F401
+    Variable, expose, dump_exposed, describe_exposed, find_exposed,
+)
+from brpc_tpu.bvar.reducer import Adder, Maxer, Miner, PassiveStatus, Status  # noqa: F401
+from brpc_tpu.bvar.window import Window, PerSecond  # noqa: F401
+from brpc_tpu.bvar.recorder import IntRecorder, Percentile, LatencyRecorder  # noqa: F401
+from brpc_tpu.bvar.multi_dimension import MultiDimension  # noqa: F401

@@ -1,0 +1,153 @@
+"""IntRecorder / Percentile / LatencyRecorder.
+
+Reference: compressed-histogram percentiles sampled per second
+(detail/percentile.{h,cpp}) feeding the LatencyRecorder bundle —
+latency avg/max/qps/p50..p99.99 (latency_recorder.h:49-75).
+
+Implementation: log-bucketed histogram (1ns..100s in ~4% steps) — O(1)
+insert, percentile by bucket walk; per-second windows via the sampler
+thread.  Not a port: bucket math chosen for numpy-free speed in Python.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+from brpc_tpu.bvar.reducer import Adder, Maxer
+from brpc_tpu.bvar.variable import Variable
+from brpc_tpu.bvar.window import PerSecond, Window
+
+# log-spaced buckets: value -> bucket index
+_BUCKETS = 512
+_MIN_V = 1.0
+_MAX_V = 1e11      # 100s in us is 1e8; headroom
+_LOG_MIN = math.log(_MIN_V)
+_LOG_RANGE = math.log(_MAX_V) - _LOG_MIN
+
+
+def _bucket_of(v: float) -> int:
+    if v <= _MIN_V:
+        return 0
+    i = int((math.log(v) - _LOG_MIN) / _LOG_RANGE * (_BUCKETS - 1))
+    return min(_BUCKETS - 1, max(0, i))
+
+
+def _bucket_value(i: int) -> float:
+    return math.exp(_LOG_MIN + (i + 0.5) / (_BUCKETS - 1) * _LOG_RANGE)
+
+
+class Percentile:
+    """Thread-safe log-bucket histogram."""
+
+    def __init__(self):
+        self._counts = [0] * _BUCKETS
+        self._n = 0
+        self._mu = threading.Lock()
+
+    def add(self, v: float) -> None:
+        i = _bucket_of(v)
+        with self._mu:
+            self._counts[i] += 1
+            self._n += 1
+
+    def snapshot(self) -> tuple[list[int], int]:
+        with self._mu:
+            return list(self._counts), self._n
+
+    def get_number(self, ratio: float) -> float:
+        counts, n = self.snapshot()
+        if n == 0:
+            return 0.0
+        target = ratio * n
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target:
+                return _bucket_value(i)
+        return _bucket_value(_BUCKETS - 1)
+
+
+class IntRecorder(Variable):
+    """Average of recorded values (reference int_recorder.h)."""
+
+    def __init__(self, name: str = ""):
+        self._sum = Adder()
+        self._count = Adder()
+        super().__init__(name)
+
+    def add(self, v) -> "IntRecorder":
+        self._sum.add(v)
+        self._count.add(1)
+        return self
+
+    def __lshift__(self, v):
+        return self.add(v)
+
+    def get_value(self):
+        c = self._count.get_value()
+        return self._sum.get_value() / c if c else 0
+
+    @property
+    def count(self):
+        return self._count.get_value()
+
+
+class LatencyRecorder(Variable):
+    """The standard per-method bundle: << latency_us records one call.
+
+    Exposes (when named): <name>_latency (avg us, windowed),
+    <name>_max_latency, <name>_qps, <name>_count, and percentiles via
+    latency_percentile(p).
+    """
+
+    def __init__(self, name: str = "", window_size: int = 10):
+        self._sum = Adder()
+        self._num = Adder()
+        self._max = Maxer()
+        self._pct = Percentile()
+        self._win_sum = Window(self._sum, window_size)
+        self._win_num = Window(self._num, window_size)
+        self._qps = PerSecond(self._num, window_size)
+        super().__init__(name)
+
+    def expose(self, name: str):
+        super().expose(name + "_latency")
+        from brpc_tpu.bvar.reducer import PassiveStatus
+        PassiveStatus(lambda: self._max.get_value()).expose(name + "_max_latency")
+        PassiveStatus(lambda: round(self._qps.get_value(), 1)).expose(name + "_qps")
+        PassiveStatus(lambda: self._num.get_value()).expose(name + "_count")
+        for p, label in ((0.5, "50"), (0.9, "90"), (0.99, "99"),
+                         (0.999, "999"), (0.9999, "9999")):
+            PassiveStatus(lambda p=p: round(self.latency_percentile(p), 1)) \
+                .expose(f"{name}_latency_{label}")
+        return self
+
+    def add(self, latency_us) -> "LatencyRecorder":
+        self._sum.add(latency_us)
+        self._num.add(1)
+        self._max.add(latency_us)
+        self._pct.add(latency_us)
+        return self
+
+    def __lshift__(self, latency_us):
+        return self.add(latency_us)
+
+    def get_value(self):
+        """Windowed average latency in us."""
+        n = self._win_num.get_value()
+        return self._win_sum.get_value() / n if n else 0
+
+    def latency(self) -> float:
+        return self.get_value()
+
+    def latency_percentile(self, ratio: float) -> float:
+        return self._pct.get_number(ratio)
+
+    def max_latency(self):
+        return self._max.get_value()
+
+    def qps(self) -> float:
+        return self._qps.get_value()
+
+    def count(self):
+        return self._num.get_value()

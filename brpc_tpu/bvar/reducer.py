@@ -1,0 +1,149 @@
+"""Reducers — write-local, combine-on-read counters.
+
+Reference design (reducer.h:35-40, detail/combiner.h:71-156): each writing
+thread owns an agent cell; << is an uncontended thread-local write; reads
+merge all agents.  Kept here with per-thread cells in a threading.local —
+the write path is a plain attribute add on the caller's own cell (no shared
+mutable state), reads sum the live cells.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from brpc_tpu.bvar.variable import Variable
+
+
+class _AgentGroup:
+    """Tracks all thread cells of one reducer for combine-on-read."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._cells: list = []
+        self._lock = threading.Lock()
+        # sum of cells from dead threads is folded here lazily? cells are
+        # kept alive by the registry; thread death leaves the cell in place
+        # (bounded by thread count, as in the reference's agent list).
+
+    def cell(self, make):
+        c = getattr(self._tls, "cell", None)
+        if c is None:
+            c = make()
+            self._tls.cell = c
+            with self._lock:
+                self._cells.append(c)
+        return c
+
+    def cells(self):
+        with self._lock:
+            return list(self._cells)
+
+
+class _Cell:
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+
+class Adder(Variable):
+    """adder << n — thread-local add, combined sum on read."""
+
+    def __init__(self, name: str = "", initial=0):
+        self._agents = _AgentGroup()
+        self._zero = initial
+        super().__init__(name)
+
+    def add(self, n=1):
+        self._agents.cell(lambda: _Cell(self._zero)).v += n
+        return self
+
+    def __lshift__(self, n):
+        return self.add(n)
+
+    def get_value(self):
+        total = self._zero
+        for c in self._agents.cells():
+            total += c.v
+        return total
+
+    def reset(self):
+        value = self.get_value()
+        for c in self._agents.cells():
+            c.v = self._zero
+        return value
+
+
+class Maxer(Variable):
+    def __init__(self, name: str = ""):
+        self._agents = _AgentGroup()
+        super().__init__(name)
+
+    def add(self, n):
+        c = self._agents.cell(lambda: _Cell(None))
+        if c.v is None or n > c.v:
+            c.v = n
+        return self
+
+    def __lshift__(self, n):
+        return self.add(n)
+
+    def get_value(self):
+        vals = [c.v for c in self._agents.cells() if c.v is not None]
+        return max(vals) if vals else 0
+
+    def reset(self):
+        v = self.get_value()
+        for c in self._agents.cells():
+            c.v = None
+        return v
+
+
+class Miner(Variable):
+    def __init__(self, name: str = ""):
+        self._agents = _AgentGroup()
+        super().__init__(name)
+
+    def add(self, n):
+        c = self._agents.cell(lambda: _Cell(None))
+        if c.v is None or n < c.v:
+            c.v = n
+        return self
+
+    def __lshift__(self, n):
+        return self.add(n)
+
+    def get_value(self):
+        vals = [c.v for c in self._agents.cells() if c.v is not None]
+        return min(vals) if vals else 0
+
+    def reset(self):
+        v = self.get_value()
+        for c in self._agents.cells():
+            c.v = None
+        return v
+
+
+class PassiveStatus(Variable):
+    """Pull-callback variable (reference passive_status.h)."""
+
+    def __init__(self, fn: Callable[[], object], name: str = ""):
+        self._fn = fn
+        super().__init__(name)
+
+    def get_value(self):
+        return self._fn()
+
+
+class Status(Variable):
+    """Directly-set value (reference status.h)."""
+
+    def __init__(self, value=None, name: str = ""):
+        self._value = value
+        super().__init__(name)
+
+    def set_value(self, v):
+        self._value = v
+
+    def get_value(self):
+        return self._value

@@ -1,0 +1,85 @@
+"""bvar variable registry (reference src/bvar/variable.{h,cpp}).
+
+Named, exposable variables with wildcard dump — the backbone every subsystem
+self-reports through (SURVEY.md §2.7, §5.6).  Export paths: /vars builtin,
+Prometheus text (builtin/prometheus_metrics_service in the reference), and
+periodic file dump.
+"""
+from __future__ import annotations
+
+import fnmatch
+import threading
+from typing import Callable, Optional
+
+_registry: dict[str, "Variable"] = {}
+_registry_lock = threading.Lock()
+
+
+class Variable:
+    """Base of every metric.  Subclasses implement get_value()."""
+
+    def __init__(self, name: str = ""):
+        self._name = ""
+        if name:
+            self.expose(name)
+
+    # ---- registry ----
+
+    def expose(self, name: str) -> "Variable":
+        name = name.strip().replace(" ", "_")
+        with _registry_lock:
+            if self._name:
+                _registry.pop(self._name, None)
+            self._name = name
+            _registry[name] = self
+        return self
+
+    def hide(self) -> None:
+        with _registry_lock:
+            if self._name:
+                _registry.pop(self._name, None)
+                self._name = ""
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    # ---- value access ----
+
+    def get_value(self):
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        v = self.get_value()
+        if isinstance(v, float):
+            return f"{v:.6g}"
+        return str(v)
+
+
+def expose(name: str, fn: Callable[[], object]) -> Variable:
+    """Expose a pull-callback as a variable (PassiveStatus shorthand)."""
+    from brpc_tpu.bvar.reducer import PassiveStatus
+    return PassiveStatus(fn).expose(name)
+
+
+def find_exposed(name: str) -> Optional[Variable]:
+    with _registry_lock:
+        return _registry.get(name)
+
+
+def dump_exposed(pattern: str = "*") -> dict[str, object]:
+    """Snapshot of {name: value} for names matching the wildcard."""
+    with _registry_lock:
+        items = list(_registry.items())
+    out = {}
+    for name, var in items:
+        if fnmatch.fnmatch(name, pattern):
+            try:
+                out[name] = var.get_value()
+            except Exception as e:  # pragma: no cover
+                out[name] = f"<error: {e}>"
+    return out
+
+
+def describe_exposed(pattern: str = "*") -> str:
+    return "\n".join(f"{k} : {v}" for k, v in sorted(dump_exposed(pattern).items()))

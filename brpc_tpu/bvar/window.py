@@ -1,0 +1,112 @@
+"""Window / PerSecond — periodic sampling of reducers.
+
+Reference: one sampler thread per process snapshots every reducer once a
+second into a ring; Window<V,N> reports the delta over the last N seconds
+(detail/sampler.h:44-102).  Same design: a singleton daemon thread samples
+registered variables each second.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from brpc_tpu.bvar.variable import Variable
+
+
+class _SamplerThread:
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def instance(cls):
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._samplers: list = []
+        self._mu = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="bvar-sampler")
+        self._thread.start()
+
+    def add(self, sampler) -> None:
+        with self._mu:
+            self._samplers.append(sampler)
+
+    def _run(self):
+        while True:
+            start = time.monotonic()
+            with self._mu:
+                samplers = list(self._samplers)
+            for s in samplers:
+                try:
+                    s.take_sample()
+                except Exception:  # pragma: no cover
+                    pass
+            time.sleep(max(0.0, 1.0 - (time.monotonic() - start)))
+
+
+class Window(Variable):
+    """Value delta over the last `window_size` seconds of a reducer with
+    get_value() (Adder) — max kept samples bound memory like the reference's
+    ring."""
+
+    def __init__(self, var, window_size: int = 10, name: str = ""):
+        self._var = var
+        self._window = max(1, window_size)
+        self._samples: list[tuple[float, object]] = []
+        self._mu = threading.Lock()
+        _SamplerThread.instance().add(self)
+        super().__init__(name)
+
+    def take_sample(self):
+        now = time.monotonic()
+        v = self._var.get_value()
+        with self._mu:
+            self._samples.append((now, v))
+            horizon = now - self._window - 2
+            while self._samples and self._samples[0][0] < horizon:
+                self._samples.pop(0)
+
+    def get_value(self):
+        with self._mu:
+            if not self._samples:
+                return 0
+            newest_t, newest_v = self._samples[-1]
+            target = newest_t - self._window
+            oldest_v = None
+            for t, v in self._samples:
+                if t >= target:
+                    oldest_v = v
+                    break
+            if oldest_v is None:
+                oldest_v = self._samples[0][1]
+            try:
+                return newest_v - oldest_v
+            except TypeError:
+                return newest_v
+
+    def get_span(self) -> float:
+        with self._mu:
+            if len(self._samples) < 2:
+                return 1.0
+            newest_t = self._samples[-1][0]
+            target = newest_t - self._window
+            for t, _ in self._samples:
+                if t >= target:
+                    return max(1e-9, newest_t - t)
+            return max(1e-9, newest_t - self._samples[0][0])
+
+
+class PerSecond(Window):
+    """Windowed delta divided by the window span — qps/throughput."""
+
+    def get_value(self):
+        delta = super().get_value()
+        span = self.get_span()
+        try:
+            return delta / span
+        except TypeError:
+            return 0.0

@@ -1,0 +1,71 @@
+"""RPC error codes, mirroring the reference's errno space.
+
+Reference: /root/reference/src/brpc/errno.proto (codes 1001-2004) — same
+numbering so operators moving from bRPC read identical codes in logs and
+metrics; negative system errnos pass through untouched.
+"""
+from __future__ import annotations
+
+# brpc-compatible error space (errno.proto:20-49)
+ENOSERVICE = 1001        # service not found
+ENOMETHOD = 1002         # method not found
+EREQUEST = 1003          # bad request
+ERPCAUTH = 1004          # authentication failed
+ETOOMANYFAILS = 1005     # too many sub-channel failures (ParallelChannel)
+EPCHANFINISH = 1006      # ParallelChannel finished
+EBACKUPREQUEST = 1007    # backup request timer fired (internal trigger)
+ERPCTIMEDOUT = 1008      # RPC deadline exceeded
+EFAILEDSOCKET = 1009     # the connection broke during the RPC
+EHTTP = 1010             # non-2xx HTTP status
+EOVERCROWDED = 1011      # too many buffered writes / server overcrowded
+ERTMPPUBLISHABLE = 1012
+ERTMPCREATESTREAM = 1013
+EEOF = 1014              # stream EOF
+EUNUSED = 1015
+ESSL = 1016
+EH2RUNOUTSTREAMS = 1017
+EREJECT = 1018           # concurrency limiter rejected the request
+
+EINTERNAL = 2001         # server-side internal error
+ERESPONSE = 2002         # bad response
+ELOGOFF = 2003           # server is stopping
+ELIMIT = 2004            # concurrency limit reached
+
+# Locally-originated (client library) codes
+EINVAL = 22
+ENODATA = 61
+ECONNREFUSED = 111
+
+_DESCRIPTIONS = {
+    ENOSERVICE: "The service was not found",
+    ENOMETHOD: "The method was not found",
+    EREQUEST: "Bad request",
+    ERPCAUTH: "Authentication failed",
+    ETOOMANYFAILS: "Too many sub-channel failures",
+    EPCHANFINISH: "ParallelChannel finished",
+    EBACKUPREQUEST: "Backup request triggered",
+    ERPCTIMEDOUT: "RPC call timed out",
+    EFAILEDSOCKET: "Broken socket during RPC",
+    EHTTP: "HTTP error",
+    EOVERCROWDED: "The server is overcrowded",
+    EEOF: "End of stream",
+    EREJECT: "Request rejected by interceptor",
+    EINTERNAL: "Internal server error",
+    ERESPONSE: "Bad response",
+    ELOGOFF: "Server is stopping",
+    ELIMIT: "Reached server's concurrency limit",
+}
+
+
+def describe(code: int) -> str:
+    import os
+    return _DESCRIPTIONS.get(code) or os.strerror(code) if code else "OK"
+
+
+class RpcError(Exception):
+    """Raised by synchronous call helpers when the RPC failed."""
+
+    def __init__(self, code: int, text: str = ""):
+        self.code = code
+        self.text = text or describe(code)
+        super().__init__(f"[E{code}] {self.text}")

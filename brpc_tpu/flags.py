@@ -1,0 +1,80 @@
+"""Flag system (reference: gflags + reloadable_flags.{h,cpp}; SURVEY.md §5.9).
+
+Every tunable is defined near its use site with define_flag(); the /flags
+builtin lists them and live-edits the ones marked reloadable — same two-tier
+scheme as the reference (typed option structs carry per-instance config).
+bvar export: each flag is visible through dump_exposed("flag_*").
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+_flags: dict[str, "Flag"] = {}
+_mu = threading.Lock()
+
+
+@dataclass
+class Flag:
+    name: str
+    value: Any
+    default: Any
+    help: str = ""
+    reloadable: bool = False
+    validator: Optional[Callable[[Any], bool]] = None
+    type_: type = str
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                reloadable: bool = False,
+                validator: Callable[[Any], bool] | None = None) -> Flag:
+    with _mu:
+        if name in _flags:
+            return _flags[name]
+        f = Flag(name, default, default, help, reloadable, validator,
+                 type(default))
+        _flags[name] = f
+        return f
+
+
+def get_flag(name: str, default: Any = None) -> Any:
+    with _mu:
+        f = _flags.get(name)
+        return f.value if f is not None else default
+
+
+def set_flag(name: str, value: Any, *, force: bool = False) -> bool:
+    with _mu:
+        f = _flags.get(name)
+        if f is None:
+            return False
+        if not f.reloadable and not force:
+            return False
+        try:
+            if f.type_ is bool and isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes", "on")
+            else:
+                value = f.type_(value)
+        except (TypeError, ValueError):
+            return False
+        if f.validator is not None and not f.validator(value):
+            return False
+        f.value = value
+        return True
+
+
+def list_flags() -> list[Flag]:
+    with _mu:
+        return sorted(_flags.values(), key=lambda f: f.name)
+
+
+# Core flags (mirroring prominent reference gflags)
+define_flag("max_body_size", 2 * 1024 * 1024 * 1024,
+            "Maximum frame body bytes accepted")
+define_flag("health_check_interval_s", 1.0,
+            "Seconds between reconnect probes of broken servers",
+            reloadable=True)
+define_flag("rpcz_enabled", True, "Collect per-RPC spans", reloadable=True)
+define_flag("rpcz_sample_rate", 1.0, "Fraction of spans kept",
+            reloadable=True)

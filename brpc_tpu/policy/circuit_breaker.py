@@ -1,0 +1,88 @@
+"""CircuitBreaker — per-endpoint error-rate isolation (reference
+circuit_breaker.h:25-81; SURVEY.md §2.5).
+
+Two EMA windows (long/short) accumulate "error cost"; crossing the threshold
+isolates the endpoint (marked broken → health check takes over revival).
+Repeated isolations back off the revival horizon, like the reference's
+isolation_duration growth.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from brpc_tpu.butil.endpoint import EndPoint
+
+
+class _WindowState:
+    __slots__ = ("ema_error", "samples")
+
+    def __init__(self):
+        self.ema_error = 0.0
+        self.samples = 0
+
+
+class CircuitBreaker:
+    SHORT_DECAY = 0.7       # reacts in ~tens of calls
+    LONG_DECAY = 0.98       # reacts in ~hundreds
+    SHORT_THRESHOLD = 0.5   # >50% recent errors
+    LONG_THRESHOLD = 0.2
+    MIN_SAMPLES = 16
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._short: dict[EndPoint, _WindowState] = {}
+        self._long: dict[EndPoint, _WindowState] = {}
+        self._isolation_count: dict[EndPoint, int] = {}
+
+    def on_call_end(self, ep: EndPoint, error_code: int) -> None:
+        err = 1.0 if error_code != 0 else 0.0
+        isolate = False
+        with self._mu:
+            s = self._short.setdefault(ep, _WindowState())
+            l = self._long.setdefault(ep, _WindowState())
+            s.ema_error = self.SHORT_DECAY * s.ema_error + \
+                (1 - self.SHORT_DECAY) * err
+            l.ema_error = self.LONG_DECAY * l.ema_error + \
+                (1 - self.LONG_DECAY) * err
+            s.samples += 1
+            l.samples += 1
+            if s.samples >= self.MIN_SAMPLES and (
+                    s.ema_error > self.SHORT_THRESHOLD or
+                    l.ema_error > self.LONG_THRESHOLD):
+                isolate = True
+                s.ema_error = 0.0
+                s.samples = 0
+                self._isolation_count[ep] = \
+                    self._isolation_count.get(ep, 0) + 1
+        if isolate:
+            self.mark_as_broken(ep)
+
+    def mark_as_broken(self, ep: EndPoint) -> None:
+        from brpc_tpu.policy.health_check import mark_broken
+        mark_broken(ep)
+
+    def on_socket_failed(self, ep: EndPoint) -> None:
+        with self._mu:
+            self._isolation_count[ep] = self._isolation_count.get(ep, 0) + 1
+
+    def reset(self, ep: EndPoint) -> None:
+        with self._mu:
+            self._short.pop(ep, None)
+            self._long.pop(ep, None)
+
+    def isolation_count(self, ep: EndPoint) -> int:
+        with self._mu:
+            return self._isolation_count.get(ep, 0)
+
+
+_breaker = None
+_breaker_mu = threading.Lock()
+
+
+def global_breaker() -> CircuitBreaker:
+    global _breaker
+    with _breaker_mu:
+        if _breaker is None:
+            _breaker = CircuitBreaker()
+        return _breaker

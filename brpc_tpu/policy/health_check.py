@@ -1,0 +1,79 @@
+"""Health checking + circuit breaking glue (reference
+details/health_check.cpp:146-235, circuit_breaker.{h,cpp}; SURVEY.md §5.4).
+
+When a connection to an endpoint fails, the endpoint is marked broken and a
+probe task reconnects every `health_check_interval_s`; on success the mark
+clears and load balancers resume selecting it (they consult is_broken()).
+The CircuitBreaker tracks per-endpoint error EMAs in long/short windows and
+can isolate an endpoint before the socket actually dies.
+"""
+from __future__ import annotations
+
+import socket as _socket
+import threading
+import time
+
+from brpc_tpu.butil.endpoint import EndPoint
+from brpc_tpu.bvar import Adder
+
+health_check_interval_s = 1.0
+
+_broken: dict[EndPoint, float] = {}     # endpoint -> since (monotonic)
+_mu = threading.Lock()
+_probe_threads: dict[EndPoint, threading.Thread] = {}
+_revived_counter = Adder("rpc_health_check_revived")
+_broken_counter = Adder("rpc_health_check_broken")
+
+
+def is_broken(ep: EndPoint) -> bool:
+    with _mu:
+        return ep in _broken
+
+
+def broken_endpoints() -> list[EndPoint]:
+    with _mu:
+        return list(_broken)
+
+
+def mark_broken(ep: EndPoint) -> None:
+    """Mark and start the probe loop (Socket::SetFailed → StartHealthCheck)."""
+    if ep.scheme != "tcp":
+        return
+    with _mu:
+        if ep in _broken:
+            return
+        _broken[ep] = time.monotonic()
+        _broken_counter.add(1)
+        t = threading.Thread(target=_probe_loop, args=(ep,), daemon=True,
+                             name=f"health-check-{ep}")
+        _probe_threads[ep] = t
+        t.start()
+
+
+def on_connection_failed(ep: EndPoint) -> None:
+    mark_broken(ep)
+    from brpc_tpu.policy.circuit_breaker import global_breaker
+    global_breaker().on_socket_failed(ep)
+
+
+def _probe_loop(ep: EndPoint) -> None:
+    while True:
+        time.sleep(health_check_interval_s)
+        try:
+            with _socket.create_connection((ep.host, ep.port), timeout=1.0):
+                pass
+            break  # connectable again
+        except OSError:
+            continue
+    with _mu:
+        _broken.pop(ep, None)
+        _probe_threads.pop(ep, None)
+    _revived_counter.add(1)
+    from brpc_tpu.policy.circuit_breaker import global_breaker
+    global_breaker().reset(ep)
+
+
+def reset(ep: EndPoint) -> None:
+    """Force-clear (tests / manual revive)."""
+    with _mu:
+        _broken.pop(ep, None)

@@ -1,0 +1,267 @@
+"""Load balancers (reference policy/*_load_balancer.cpp; SURVEY.md §2.5).
+
+All balancers read the server set through a DoublyBufferedData snapshot
+(wait-free reads, like the reference's backing store) and implement
+select_server/feedback.  Registered: rr, wrr, random, wr, c_murmurhash,
+c_md5, la (locality-aware: EWMA latency × inflight, the
+locality_aware_load_balancer.cpp design).
+"""
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import random
+import threading
+from dataclasses import dataclass, field
+
+from brpc_tpu.butil.doubly_buffered import DoublyBufferedData
+from brpc_tpu.butil.endpoint import EndPoint
+
+
+@dataclass(frozen=True)
+class ServerNode:
+    endpoint: EndPoint
+    weight: int = 1
+    tag: str = ""
+
+
+class LoadBalancer:
+    name = "base"
+
+    def __init__(self):
+        self._servers: DoublyBufferedData[tuple[ServerNode, ...]] = \
+            DoublyBufferedData(())
+
+    # ---- membership (pushed by naming services) ----
+
+    def reset_servers(self, nodes: list[ServerNode]) -> None:
+        self._servers.modify(lambda _old: tuple(nodes))
+        self._on_servers_changed()
+
+    def add_server(self, node: ServerNode) -> None:
+        self._servers.modify(lambda old: tuple(list(old) + [node]))
+        self._on_servers_changed()
+
+    def remove_server(self, endpoint: EndPoint) -> None:
+        self._servers.modify(
+            lambda old: tuple(n for n in old if n.endpoint != endpoint))
+        self._on_servers_changed()
+
+    def server_count(self) -> int:
+        return len(self._servers.read())
+
+    def servers(self) -> tuple[ServerNode, ...]:
+        return self._servers.read()
+
+    def _on_servers_changed(self) -> None:
+        pass
+
+    def _alive(self, exclude=None):
+        from brpc_tpu.policy.health_check import is_broken
+        nodes = self._servers.read()
+        out = [n for n in nodes
+               if (exclude is None or n.endpoint not in exclude)
+               and not is_broken(n.endpoint)]
+        if not out and nodes:
+            # all broken/excluded: let the caller retry anything rather than
+            # fast-failing the whole cluster (cluster_recover_policy spirit)
+            out = [n for n in nodes if exclude is None or
+                   n.endpoint not in exclude]
+        return out
+
+    # ---- selection ----
+
+    def select_server(self, exclude: set | None = None,
+                      request_code: int | None = None) -> EndPoint | None:
+        raise NotImplementedError
+
+    def feedback(self, endpoint: EndPoint, error_code: int,
+                 latency_us: int) -> None:
+        pass
+
+
+class RoundRobinLB(LoadBalancer):
+    name = "rr"
+
+    def __init__(self):
+        super().__init__()
+        self._counter = itertools.count()
+
+    def select_server(self, exclude=None, request_code=None):
+        nodes = self._alive(exclude)
+        if not nodes:
+            return None
+        return nodes[next(self._counter) % len(nodes)].endpoint
+
+
+class RandomLB(LoadBalancer):
+    name = "random"
+
+    def select_server(self, exclude=None, request_code=None):
+        nodes = self._alive(exclude)
+        if not nodes:
+            return None
+        return random.choice(nodes).endpoint
+
+
+class WeightedRoundRobinLB(LoadBalancer):
+    """Smooth weighted RR (same behavior class as policy/weighted_round_robin_
+    load_balancer.cpp; smooth-WRR algorithm keeps bursts interleaved)."""
+
+    name = "wrr"
+
+    def __init__(self):
+        super().__init__()
+        self._mu = threading.Lock()
+        self._current: dict[EndPoint, int] = {}
+
+    def select_server(self, exclude=None, request_code=None):
+        nodes = self._alive(exclude)
+        if not nodes:
+            return None
+        with self._mu:
+            total = 0
+            best = None
+            for n in nodes:
+                w = max(1, n.weight)
+                total += w
+                cur = self._current.get(n.endpoint, 0) + w
+                self._current[n.endpoint] = cur
+                if best is None or cur > self._current[best.endpoint]:
+                    best = n
+            self._current[best.endpoint] -= total
+            return best.endpoint
+
+
+class WeightedRandomLB(LoadBalancer):
+    name = "wr"
+
+    def select_server(self, exclude=None, request_code=None):
+        nodes = self._alive(exclude)
+        if not nodes:
+            return None
+        weights = [max(1, n.weight) for n in nodes]
+        return random.choices(nodes, weights=weights, k=1)[0].endpoint
+
+
+def _hash_murmur_like(data: bytes) -> int:
+    # fast stable 64-bit hash (fnv-1a variant; role of murmurhash in c_murmur)
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class ConsistentHashLB(LoadBalancer):
+    """Ketama-style ring (reference policy/consistent_hashing_load_balancer.*):
+    N virtual nodes per server; requests route by request_code."""
+
+    name = "c_murmurhash"
+    VIRTUAL_NODES = 100
+
+    def __init__(self, hash_fn=None):
+        super().__init__()
+        self._hash = hash_fn or _hash_murmur_like
+        self._ring: list[tuple[int, EndPoint]] = []
+        self._ring_keys: list[int] = []
+        self._mu = threading.Lock()
+
+    def _on_servers_changed(self):
+        ring = []
+        for n in self._servers.read():
+            base = str(n.endpoint).encode()
+            for i in range(self.VIRTUAL_NODES * max(1, n.weight)):
+                ring.append((self._hash(base + b"#%d" % i), n.endpoint))
+        ring.sort()
+        with self._mu:
+            self._ring = ring
+            self._ring_keys = [k for k, _ in ring]
+
+    def select_server(self, exclude=None, request_code=None):
+        from brpc_tpu.policy.health_check import is_broken
+        with self._mu:
+            ring, keys = self._ring, self._ring_keys
+        if not ring:
+            return None
+        code = request_code if request_code is not None \
+            else random.getrandbits(63)
+        # hash the request code onto the ring (raw codes would all land at
+        # one end of the 64-bit key space)
+        h = self._hash(int(code).to_bytes(8, "little", signed=False))
+        i = bisect.bisect_left(keys, h) % len(ring)
+        # walk the ring past excluded/broken nodes
+        for step in range(len(ring)):
+            ep = ring[(i + step) % len(ring)][1]
+            if (exclude is None or ep not in exclude) and not is_broken(ep):
+                return ep
+        return ring[i][1]
+
+
+class ConsistentHashMd5LB(ConsistentHashLB):
+    name = "c_md5"
+
+    def __init__(self):
+        super().__init__(hash_fn=lambda d: int.from_bytes(
+            hashlib.md5(d).digest()[:8], "little"))
+
+
+class LocalityAwareLB(LoadBalancer):
+    """Locality-aware: weight ∝ 1 / (EWMA latency × (inflight+1))
+    (reference policy/locality_aware_load_balancer.cpp design: dividing
+    qps by latency while penalizing inflight explorers)."""
+
+    name = "la"
+    DECAY = 0.8
+
+    def __init__(self):
+        super().__init__()
+        self._mu = threading.Lock()
+        self._lat: dict[EndPoint, float] = {}       # EWMA latency us
+        self._inflight: dict[EndPoint, int] = {}
+
+    def select_server(self, exclude=None, request_code=None):
+        nodes = self._alive(exclude)
+        if not nodes:
+            return None
+        with self._mu:
+            weights = []
+            for n in nodes:
+                lat = self._lat.get(n.endpoint, 1000.0)
+                inflight = self._inflight.get(n.endpoint, 0)
+                weights.append(max(1, n.weight) * 1e6 /
+                               (lat * (inflight + 1)))
+            ep = random.choices(nodes, weights=weights, k=1)[0].endpoint
+            self._inflight[ep] = self._inflight.get(ep, 0) + 1
+            return ep
+
+    def feedback(self, endpoint, error_code, latency_us):
+        with self._mu:
+            self._inflight[endpoint] = max(
+                0, self._inflight.get(endpoint, 1) - 1)
+            if error_code == 0:
+                old = self._lat.get(endpoint, float(latency_us))
+                self._lat[endpoint] = (self.DECAY * old +
+                                       (1 - self.DECAY) * latency_us)
+            else:
+                # errors look like huge latency so traffic shifts away
+                self._lat[endpoint] = max(
+                    self._lat.get(endpoint, 1000.0) * 2, 1e5)
+
+
+_LBS = {cls.name: cls for cls in
+        (RoundRobinLB, RandomLB, WeightedRoundRobinLB, WeightedRandomLB,
+         ConsistentHashLB, ConsistentHashMd5LB, LocalityAwareLB)}
+
+
+def create_load_balancer(name: str) -> LoadBalancer:
+    cls = _LBS.get(name or "rr")
+    if cls is None:
+        raise KeyError(f"unknown load balancer {name!r}; "
+                       f"have {sorted(_LBS)}")
+    return cls()
+
+
+def register_load_balancer(name: str, cls) -> None:
+    _LBS[name] = cls

@@ -1,0 +1,175 @@
+"""Naming services (reference policy/*_naming_service.cpp; SURVEY.md §2.5).
+
+A NamingService runs in a dedicated daemon thread per cluster and pushes
+ServerNode lists to its listener (the load balancer) whenever membership
+changes — the cluster is elastic by subscription (naming_service.h:36-61).
+
+Schemes: list://h1:p1,h2:p2[(w)]   static list
+         file://path               one "host:port [weight] [tag]" per line,
+                                   re-read periodically (reference file NS)
+         dns://host:port           resolve A records periodically
+         ici://slice               every chip in the local mesh (TPU-native:
+                                   membership = jax devices, no DNS in a pod)
+"""
+from __future__ import annotations
+
+import os
+import socket as _socket
+import threading
+import time
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.policy.load_balancer import LoadBalancer, ServerNode
+
+DEFAULT_INTERVAL_S = 5.0
+
+
+class NamingService:
+    interval_s = DEFAULT_INTERVAL_S
+
+    def __init__(self, param: str):
+        self.param = param
+
+    def get_servers(self) -> list[ServerNode]:
+        raise NotImplementedError
+
+
+class ListNamingService(NamingService):
+    """list://host:port[(weight)],host:port — static membership."""
+
+    interval_s = 0  # never re-resolves
+
+    def get_servers(self):
+        nodes = []
+        for part in self.param.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            weight = 1
+            if part.endswith(")") and "(" in part:
+                part, _, w = part[:-1].rpartition("(")
+                weight = int(w)
+            nodes.append(ServerNode(str2endpoint(part), weight))
+        return nodes
+
+
+class FileNamingService(NamingService):
+    """file://path — 'host:port [weight] [tag]' per line, # comments."""
+
+    def get_servers(self):
+        nodes = []
+        try:
+            with open(self.param) as f:
+                for line in f:
+                    line = line.split("#", 1)[0].strip()
+                    if not line:
+                        continue
+                    parts = line.split()
+                    weight = int(parts[1]) if len(parts) > 1 and \
+                        parts[1].isdigit() else 1
+                    tag = parts[-1] if len(parts) > 1 and \
+                        not parts[-1].isdigit() else ""
+                    nodes.append(ServerNode(str2endpoint(parts[0]), weight,
+                                            tag))
+        except OSError:
+            return []
+        return nodes
+
+
+class DnsNamingService(NamingService):
+    """dns://host:port — A/AAAA records of host."""
+
+    def get_servers(self):
+        host, _, port = self.param.partition(":")
+        port = int(port or 80)
+        try:
+            infos = _socket.getaddrinfo(host, port, type=_socket.SOCK_STREAM)
+        except OSError:
+            return []
+        seen = set()
+        nodes = []
+        for family, _, _, _, sockaddr in infos:
+            ip = sockaddr[0]
+            if ip not in seen:
+                seen.add(ip)
+                nodes.append(ServerNode(EndPoint(ip, port)))
+        return nodes
+
+
+class IciNamingService(NamingService):
+    """ici://slice — one node per local jax device (TPU-pod membership)."""
+
+    interval_s = 0
+
+    def get_servers(self):
+        import jax
+        return [ServerNode(EndPoint(self.param or "slice0", d.id, "ici"))
+                for d in jax.devices()]
+
+
+_SCHEMES = {
+    "list": ListNamingService,
+    "file": FileNamingService,
+    "dns": DnsNamingService,
+    "ici": IciNamingService,
+}
+
+
+def register_naming_service(scheme: str, cls) -> None:
+    _SCHEMES[scheme] = cls
+
+
+class NamingServiceFilter:
+    """Hook to drop nodes before they reach the LB (naming_service_filter.h)."""
+
+    def accept(self, node: ServerNode) -> bool:
+        return True
+
+
+class NamingServiceThread(threading.Thread):
+    """Dedicated refresher per cluster (details/naming_service_thread.*)."""
+
+    def __init__(self, ns: NamingService, lb: LoadBalancer,
+                 ns_filter: NamingServiceFilter | None = None):
+        super().__init__(daemon=True, name=f"ns-{ns.param}")
+        self.ns = ns
+        self.lb = lb
+        self.filter = ns_filter
+        self._stop = threading.Event()
+        self._resolved_once = threading.Event()
+
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                nodes = self.ns.get_servers()
+                if self.filter is not None:
+                    nodes = [n for n in nodes if self.filter.accept(n)]
+                if nodes or self._resolved_once.is_set():
+                    self.lb.reset_servers(nodes)
+                self._resolved_once.set()
+            except Exception:  # pragma: no cover
+                import traceback
+                traceback.print_exc()
+            if self.ns.interval_s <= 0:
+                break
+            self._stop.wait(self.ns.interval_s)
+
+    def wait_first_resolution(self, timeout: float = 5.0) -> bool:
+        return self._resolved_once.wait(timeout)
+
+    def stop(self):
+        self._stop.set()
+
+
+def start_naming_service(url: str, lb: LoadBalancer,
+                         ns_filter: NamingServiceFilter | None = None,
+                         ) -> NamingServiceThread:
+    scheme, _, param = url.partition("://")
+    cls = _SCHEMES.get(scheme)
+    if cls is None:
+        raise KeyError(f"unknown naming service scheme {scheme!r}; "
+                       f"have {sorted(_SCHEMES)}")
+    t = NamingServiceThread(cls(param), lb, ns_filter)
+    t.start()
+    t.wait_first_resolution()
+    return t

@@ -1,0 +1,10 @@
+from brpc_tpu.rpc.channel import (  # noqa: F401
+    Channel, ChannelOptions, RetryPolicy, SocketMap, CallManager,
+)
+from brpc_tpu.rpc.controller import Controller  # noqa: F401
+from brpc_tpu.rpc.server import Server, ServerOptions, MethodStatus  # noqa: F401
+from brpc_tpu.rpc.service import Service, method  # noqa: F401
+from brpc_tpu.rpc.stream import (  # noqa: F401
+    Stream, StreamHandler, stream_create, stream_accept,
+)
+from brpc_tpu.rpc import meta  # noqa: F401

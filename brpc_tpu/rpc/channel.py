@@ -1,0 +1,474 @@
+"""Channel — the client endpoint (reference channel.{h,cpp}; SURVEY.md §2.5).
+
+Keeps the reference's client machinery shapes:
+  * Channel.init("host:port" | "proto://cluster", lb) — naming service +
+    load balancer resolve per call (channel.h:161).
+  * CallMethod drives a per-call state machine on the Controller:
+    (correlation_id, attempt) versioning so stale attempts can't complete a
+    call twice (the bthread_id range trick, controller.h:692-703), retries
+    re-issued on a different server with failed ones excluded
+    (excluded_servers.h), backup requests racing a second attempt after
+    backup_request_ms (channel.cpp:403-409), one overall deadline timer.
+  * SocketMap: endpoint -> native socket reuse (socket_map.h:147).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.rpc import meta as M
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
+from brpc_tpu.rpc.transport import MSG_TRPC, Transport
+
+_cid_counter = itertools.count(1)
+
+
+@dataclass
+class ChannelOptions:
+    timeout_ms: int = 500                  # same default as ChannelOptions
+    max_retry: int = 3
+    backup_request_ms: int = -1            # <0 disables
+    connection_type: str = "single"        # single | pooled | short
+    protocol: str = "trpc"
+    compress_type: int = M.COMPRESS_NONE
+    load_balancer: str = ""                # "" = single server
+    auth: Optional[Any] = None             # Authenticator
+    retry_policy: Optional[Any] = None
+
+
+class RetryPolicy:
+    """DoRetry(cntl) — reference retry_policy.h semantics: retry connection
+    errors, not deadline misses."""
+
+    RETRYABLE = {errors.EFAILEDSOCKET, errors.EOVERCROWDED, errors.EEOF,
+                 errors.ECONNREFUSED, errors.EINTERNAL}
+
+    def do_retry(self, cntl: Controller) -> bool:
+        return cntl.error_code in self.RETRYABLE
+
+
+DEFAULT_RETRY_POLICY = RetryPolicy()
+
+
+class _ClientConn:
+    __slots__ = ("sid", "endpoint")
+
+    def __init__(self, sid: int, endpoint: EndPoint):
+        self.sid = sid
+        self.endpoint = endpoint
+
+
+class SocketMap:
+    """endpoint -> shared client connection (created lazily, replaced on
+    failure).  All client connections share one response handler."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "SocketMap":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: dict[EndPoint, _ClientConn] = {}
+        self._sid_to_ep: dict[int, EndPoint] = {}
+
+    def get_connection(self, ep: EndPoint) -> _ClientConn:
+        with self._lock:
+            c = self._conns.get(ep)
+            if c is not None:
+                return c
+        t = Transport.instance()
+        sid = t.connect(ep.host, ep.port, CallManager.instance().on_message,
+                        self._on_socket_failed)
+        c = _ClientConn(sid, ep)
+        with self._lock:
+            cur = self._conns.get(ep)
+            if cur is not None:
+                # lost the race; keep the established one, drop ours
+                t.close(sid)
+                return cur
+            self._conns[ep] = c
+            self._sid_to_ep[sid] = ep
+        return c
+
+    def _on_socket_failed(self, sid: int, err: int) -> None:
+        with self._lock:
+            ep = self._sid_to_ep.pop(sid, None)
+            if ep is not None and self._conns.get(ep) is not None and \
+                    self._conns[ep].sid == sid:
+                del self._conns[ep]
+        CallManager.instance().on_socket_failed(sid, err)
+        # health check + LB notification (policy layer)
+        from brpc_tpu.policy.health_check import on_connection_failed
+        if ep is not None:
+            on_connection_failed(ep)
+
+    def drop(self, ep: EndPoint) -> None:
+        with self._lock:
+            c = self._conns.pop(ep, None)
+        if c is not None:
+            Transport.instance().close(c.sid)
+
+
+class _CallState:
+    __slots__ = ("cntl", "channel", "meta_template", "body", "done",
+                 "deadline_timer", "backup_timer", "sids", "tried_servers")
+
+    def __init__(self, cntl, channel, meta_template, body, done):
+        self.cntl = cntl
+        self.channel = channel
+        self.meta_template = meta_template
+        self.body = body
+        self.done = done
+        self.deadline_timer = None
+        self.backup_timer = None
+        self.sids: set[int] = set()
+        self.tried_servers: list[EndPoint] = []
+
+
+class CallManager:
+    """Global pending-call table keyed by correlation id; completes calls
+    exactly once across responses/timeouts/socket failures/retries (the role
+    OnVersionedRPCReturned plays, controller.cpp:593)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "CallManager":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending: dict[int, _CallState] = {}
+        self._by_sid: dict[int, set[int]] = {}
+
+    # ---- registration ----
+
+    def register(self, st: _CallState) -> None:
+        with self._lock:
+            self._pending[st.cntl.correlation_id] = st
+
+    def bind_socket(self, cid: int, sid: int) -> None:
+        with self._lock:
+            st = self._pending.get(cid)
+            if st is not None:
+                st.sids.add(sid)
+                self._by_sid.setdefault(sid, set()).add(cid)
+
+    def _unregister(self, cid: int) -> Optional[_CallState]:
+        with self._lock:
+            st = self._pending.pop(cid, None)
+            if st is not None:
+                for sid in st.sids:
+                    s = self._by_sid.get(sid)
+                    if s is not None:
+                        s.discard(cid)
+                        if not s:
+                            del self._by_sid[sid]
+            return st
+
+    # ---- events ----
+
+    def on_message(self, sid: int, kind: int, meta_bytes: bytes, body) -> None:
+        if kind != MSG_TRPC:
+            return
+        try:
+            meta = M.RpcMeta.decode(meta_bytes)
+        except ValueError:
+            return
+        if meta.msg_type == M.MSG_RESPONSE:
+            self._on_response(meta, body)
+        elif meta.msg_type in (M.MSG_STREAM_DATA, M.MSG_STREAM_FEEDBACK,
+                               M.MSG_STREAM_CLOSE):
+            from brpc_tpu.rpc.stream import StreamRegistry
+            StreamRegistry.instance().on_frame(sid, meta, body)
+
+    def _on_response(self, meta: M.RpcMeta, body) -> None:
+        with self._lock:
+            st = self._pending.get(meta.correlation_id)
+        if st is None:
+            return  # stale attempt after completion — dropped
+        cntl = st.cntl
+        if meta.error_code != 0:
+            # Stale-attempt errors must not touch the live call: only the
+            # current attempt may drive retry/completion (the bthread_id
+            # version check of the reference).  Success from ANY attempt
+            # wins — that's what makes backup requests useful.
+            if meta.attempt < cntl.current_attempt:
+                return
+            cntl.set_failed(meta.error_code, meta.error_text)
+            if st.channel._should_retry(st):
+                return  # re-issued under the same cid, next attempt
+            self._finish(st)
+            return
+        # success: decode body
+        try:
+            raw = body.to_bytes()
+            att_size = meta.attachment_size
+            payload = raw[: len(raw) - att_size] if att_size else raw
+            cntl.response_attachment = raw[len(raw) - att_size:] if att_size else b""
+            payload = decompress(payload, meta.compress_type)
+            serializer = get_serializer(meta.content_type or "raw")
+            cntl.reset_for_retry()
+            cntl.response = serializer.decode(payload, meta.tensor_header)
+            if meta.stream_id and cntl._stream is not None:
+                sbuf = meta.user_fields.get("sbuf")
+                if sbuf:
+                    cntl._stream.peer_buf_size = int(sbuf)
+                cntl._stream.set_remote(meta.stream_id)
+        except Exception as e:  # bad response
+            cntl.set_failed(errors.ERESPONSE, f"cannot decode response: {e}")
+        self._finish(st)
+
+    def on_socket_failed(self, sid: int, err: int) -> None:
+        with self._lock:
+            cids = list(self._by_sid.pop(sid, ()))
+            states = [self._pending[c] for c in cids if c in self._pending]
+        for st in states:
+            st.cntl.set_failed(errors.EFAILEDSOCKET,
+                               f"socket failed (errno {err})")
+            if st.channel._should_retry(st):
+                continue
+            self._finish(st)
+
+    def on_deadline(self, cid: int) -> None:
+        with self._lock:
+            st = self._pending.get(cid)
+        if st is None:
+            return
+        st.cntl.set_failed(errors.ERPCTIMEDOUT,
+                           f"deadline {st.cntl.timeout_ms}ms exceeded")
+        self._finish(st, cancel_deadline=False)
+
+    def _finish(self, st: _CallState, cancel_deadline: bool = True) -> None:
+        if not st.cntl._try_complete():
+            return
+        self._unregister(st.cntl.correlation_id)
+        t = Transport.instance()
+        if cancel_deadline and st.deadline_timer is not None:
+            t.cancel(st.deadline_timer)
+        if st.backup_timer is not None:
+            t.cancel(st.backup_timer)
+        cntl = st.cntl
+        import time
+        cntl.latency_us = int(time.monotonic() * 1e6) - cntl._start_us
+        st.channel._on_call_end(st)
+        if st.done is not None:
+            try:
+                st.done(cntl)
+            except Exception:  # pragma: no cover
+                import traceback
+                traceback.print_exc()
+        if cntl._done_event is not None:
+            cntl._done_event.set()
+
+
+class Channel:
+    """Client channel to one server or a cluster (with a load balancer)."""
+
+    def __init__(self, address: str | EndPoint | None = None,
+                 options: ChannelOptions | None = None, **kw):
+        self.options = options or ChannelOptions(**kw)
+        self._lb = None
+        self._ns_thread = None
+        self._endpoint: Optional[EndPoint] = None
+        if address is not None:
+            self.init(address, self.options.load_balancer)
+
+    # reference Channel::Init(addr, lb_name, opts)
+    def init(self, address: str | EndPoint, load_balancer: str = "") -> "Channel":
+        if isinstance(address, EndPoint):
+            self._endpoint = address
+            return self
+        if "://" in address:
+            from brpc_tpu.policy.naming import start_naming_service
+            from brpc_tpu.policy.load_balancer import create_load_balancer
+            self._lb = create_load_balancer(load_balancer or "rr")
+            self._ns_thread = start_naming_service(address, self._lb)
+        else:
+            self._endpoint = str2endpoint(address)
+        return self
+
+    # ---- server selection (LB hook) ----
+
+    def _select_server(self, st: _CallState) -> Optional[EndPoint]:
+        if self._lb is not None:
+            return self._lb.select_server(exclude=set(st.tried_servers))
+        return self._endpoint
+
+    def _on_call_end(self, st: _CallState) -> None:
+        if not st.tried_servers:
+            return
+        # Every select_server() gets exactly one feedback (LA balancers
+        # track inflight); losing/failed attempts report as socket errors.
+        if self._lb is not None:
+            for ep in st.tried_servers[:-1]:
+                self._lb.feedback(ep, errors.EFAILEDSOCKET, 0)
+            self._lb.feedback(st.tried_servers[-1], st.cntl.error_code,
+                              st.cntl.latency_us)
+        # feed the circuit breaker (reference OnCallEnd, circuit_breaker.h)
+        from brpc_tpu.policy.circuit_breaker import global_breaker
+        breaker = global_breaker()
+        for ep in st.tried_servers[:-1]:
+            if ep.scheme == "tcp":
+                breaker.on_call_end(ep, errors.EFAILEDSOCKET)
+        last = st.tried_servers[-1]
+        if last.scheme == "tcp":
+            breaker.on_call_end(last, st.cntl.error_code)
+
+    # ---- the call path ----
+
+    def call(self, service: str, method_name: str, request: Any = b"",
+             cntl: Controller | None = None,
+             done: Callable[[Controller], None] | None = None,
+             serializer: str = "raw", response_serializer: str | None = None,
+             ) -> Controller:
+        """Issue an RPC.  With done=None this is async-with-join: the
+        returned controller has an event; use .join() or call_sync()."""
+        import time
+        cntl = cntl or Controller()
+        opts = self.options
+        if cntl.timeout_ms is None:
+            cntl.timeout_ms = opts.timeout_ms
+        if cntl.max_retry is None:
+            cntl.max_retry = opts.max_retry
+        if cntl.backup_request_ms is None:
+            cntl.backup_request_ms = opts.backup_request_ms
+        cntl.correlation_id = next(_cid_counter)
+        cntl._start_us = int(time.monotonic() * 1e6)
+        if done is None:
+            cntl._done_event = threading.Event()
+
+        ser = get_serializer(serializer)
+        body, tensor_header = ser.encode(request)
+        body = compress(body, cntl.compress_type)
+        meta = M.RpcMeta(
+            msg_type=M.MSG_REQUEST,
+            correlation_id=cntl.correlation_id,
+            service=service,
+            method=method_name,
+            compress_type=cntl.compress_type,
+            timeout_ms=cntl.timeout_ms or 0,
+            content_type=ser.name,
+            tensor_header=tensor_header,
+        )
+        # response serializer hint rides as a user field
+        if response_serializer:
+            meta.user_fields["rs"] = response_serializer
+        if opts.auth is not None:
+            meta.auth = opts.auth.generate_credential()
+        if cntl.request_attachment:
+            meta.attachment_size = len(cntl.request_attachment)
+            body = body + cntl.request_attachment
+
+        # stream riding this RPC (stream_create was called with this cntl)
+        stream = getattr(cntl, "_stream", None)
+        if stream is not None:
+            meta.stream_id = stream.stream_id
+            meta.user_fields["sbuf"] = str(stream.max_buf_size)
+
+        # rpcz span
+        from brpc_tpu.rpcz import current_trace
+        tid, sid_ = current_trace()
+        meta.trace_id = cntl.trace_id = tid
+        meta.span_id = cntl.span_id = sid_
+
+        st = _CallState(cntl, self, meta, body, done)
+        mgr = CallManager.instance()
+        mgr.register(st)
+
+        t = Transport.instance()
+        if cntl.timeout_ms and cntl.timeout_ms > 0:
+            cid = cntl.correlation_id
+            st.deadline_timer = t.schedule(cntl.timeout_ms / 1e3,
+                                           lambda: mgr.on_deadline(cid))
+        if cntl.backup_request_ms and cntl.backup_request_ms > 0:
+            st.backup_timer = t.schedule(cntl.backup_request_ms / 1e3,
+                                         lambda: self._issue_backup(st))
+        self._issue(st)
+        return cntl
+
+    def call_sync(self, service: str, method_name: str, request: Any = b"",
+                  serializer: str = "raw", **kw) -> Any:
+        cntl = kw.pop("cntl", None)
+        cntl = self.call(service, method_name, request, cntl=cntl,
+                         serializer=serializer, **kw)
+        cntl.join()
+        cntl.raise_if_failed()
+        return cntl.response
+
+    def _issue(self, st: _CallState) -> None:
+        """Send the current attempt.  On immediate failure, walk the retry
+        path (IssueRPC, controller.cpp:1042)."""
+        cntl = st.cntl
+        mgr = CallManager.instance()
+        ep = self._select_server(st)
+        if ep is None:
+            cntl.set_failed(errors.ENODATA, "no available server")
+            mgr._finish(st)
+            return
+        st.tried_servers.append(ep)
+        cntl.remote_side = str(ep)
+        try:
+            conn = SocketMap.instance().get_connection(ep)
+        except (ConnectionError, OSError):
+            cntl.set_failed(errors.ECONNREFUSED, f"cannot connect to {ep}")
+            if self._should_retry(st):
+                return
+            mgr._finish(st)
+            return
+        meta = st.meta_template
+        meta.attempt = cntl.current_attempt
+        mgr.bind_socket(cntl.correlation_id, conn.sid)
+        stream = getattr(cntl, "_stream", None)
+        if stream is not None and not stream.connected:
+            stream.bind(conn.sid)
+        rc = Transport.instance().write_frame(conn.sid, meta.encode(), st.body)
+        if rc != 0:
+            cntl.set_failed(errors.EFAILEDSOCKET, "write failed")
+            if self._should_retry(st):
+                return
+            mgr._finish(st)
+
+    def _should_retry(self, st: _CallState) -> bool:
+        """If allowed, bump the attempt and re-issue.  Returns True when a
+        retry was started (the call stays pending)."""
+        cntl = st.cntl
+        if cntl.completed:
+            return False
+        policy = self.options.retry_policy or DEFAULT_RETRY_POLICY
+        if cntl.current_attempt >= (cntl.max_retry or 0):
+            return False
+        if not policy.do_retry(cntl):
+            return False
+        cntl.current_attempt += 1
+        cntl.retried_count += 1
+        cntl.reset_for_retry()
+        self._issue(st)
+        return True
+
+    def _issue_backup(self, st: _CallState) -> None:
+        """Backup request: race a second attempt; first response wins
+        (channel.cpp:403-409)."""
+        cntl = st.cntl
+        if cntl.completed:
+            return
+        if cntl.current_attempt >= (cntl.max_retry or 0):
+            return  # max_retry=0 disables backups too (single attempt only)
+        cntl.current_attempt += 1
+        cntl.retried_count += 1
+        self._issue(st)

@@ -1,0 +1,112 @@
+"""Controller — per-RPC context and completion state.
+
+Role of the reference's brpc::Controller (controller.h:114; SURVEY.md §2.5):
+carries options in (timeout, retries, compression), results out (error code/
+text, response, attachment), and owns the call's completion state machine.
+The retry/backup versioning trick of bthread_id (each attempt has its own
+slot; stale attempts can't complete the call twice) is kept via the
+(correlation_id, attempt) pair and a completion lock.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.rpc import meta as M
+
+
+class Controller:
+    def __init__(self, *, timeout_ms: Optional[int] = None,
+                 max_retry: Optional[int] = None,
+                 backup_request_ms: Optional[int] = None,
+                 compress_type: int = M.COMPRESS_NONE):
+        # ---- client-side options (None = inherit from ChannelOptions) ----
+        self.timeout_ms = timeout_ms
+        self.max_retry = max_retry
+        self.backup_request_ms = backup_request_ms
+        self.compress_type = compress_type
+        self.request_attachment: bytes = b""
+
+        # ---- result state ----
+        self.error_code: int = 0
+        self.error_text: str = ""
+        self.response: Any = None
+        self.response_attachment: bytes = b""
+        self.trace_id: int = 0
+        self.span_id: int = 0
+
+        # ---- call bookkeeping ----
+        self.correlation_id: int = 0
+        self.current_attempt: int = 0
+        self.retried_count: int = 0
+        self.remote_side: str = ""
+        self.latency_us: int = 0
+        self._start_us: int = 0
+        self._done_event: Optional[threading.Event] = None
+        self._done_cb: Optional[Callable[["Controller"], None]] = None
+        self._completed = False
+        self._lock = threading.Lock()
+        self._timeout_timer = None
+        self._backup_timer = None
+
+        # ---- server-side state ----
+        self.is_server_side = False
+        self.request_meta: Optional[M.RpcMeta] = None
+        self.peer_sid: int = 0
+        # stream riding this RPC (see rpc/stream.py)
+        self._stream = None
+
+    def accept_stream(self, handler=None, max_buf_size: int = 2 * 1024 * 1024):
+        """Server handler: accept the stream the client attached."""
+        from brpc_tpu.rpc.stream import stream_accept
+        return stream_accept(self, handler, max_buf_size)
+
+    # ---- result api (mirrors Controller::Failed/ErrorCode/ErrorText) ----
+
+    def failed(self) -> bool:
+        return self.error_code != 0
+
+    def set_failed(self, code: int, text: str = "") -> None:
+        self.error_code = code
+        self.error_text = text or errors.describe(code)
+
+    def reset_for_retry(self) -> None:
+        self.error_code = 0
+        self.error_text = ""
+
+    # ---- completion (exactly once) ----
+
+    def _try_complete(self) -> bool:
+        """Returns True for the winner; stale attempts/timeouts lose."""
+        with self._lock:
+            if self._completed:
+                return False
+            self._completed = True
+            return True
+
+    @property
+    def completed(self) -> bool:
+        return self._completed
+
+    def join(self, extra_timeout_s: float = 5.0) -> None:
+        """Block until the RPC completes (sync calls).  With timeout_ms=0
+        (deadline disabled) this waits indefinitely."""
+        if self._done_event is None:
+            return
+        if not self.timeout_ms or self.timeout_ms <= 0:
+            self._done_event.wait()
+            return
+        budget = self.timeout_ms / 1e3 + extra_timeout_s
+        if not self._done_event.wait(budget):
+            # The deadline timer should have fired; complete the call
+            # properly (exactly-once, unregisters) instead of mutating a
+            # still-pending controller.
+            from brpc_tpu.rpc.channel import CallManager
+            CallManager.instance().on_deadline(self.correlation_id)
+            self._done_event.wait(1.0)
+
+    def raise_if_failed(self) -> None:
+        if self.failed():
+            raise errors.RpcError(self.error_code, self.error_text)

@@ -1,0 +1,171 @@
+"""RpcMeta — the per-frame protocol metadata codec.
+
+Role of baidu_rpc_meta.proto in the reference (RpcMeta{request,response,
+compress_type,correlation_id,attachment_size,stream_settings,user_fields},
+baidu_rpc_meta.proto:26-36).  Our wire meta is a fixed little header plus
+TLV fields, hand-packed with struct — no protobuf dependency in the framing
+path, and the body/attachment ride after the meta unserialized (zero-copy
+slot for tensor payloads, like baidu_std's attachment).
+
+Layout (after the 16-byte TRPC frame header handled natively):
+  u8 version | u8 msg_type | u16 flags | u64 correlation_id | u16 attempt
+  then TLV: u8 tag | u32 len | bytes
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+MSG_REQUEST = 0
+MSG_RESPONSE = 1
+# streaming frames (§5.7) share the meta codec
+MSG_STREAM_DATA = 2
+MSG_STREAM_FEEDBACK = 3
+MSG_STREAM_CLOSE = 4
+
+_FIXED = struct.Struct("<BBHQH")
+
+# TLV tags
+T_SERVICE = 1
+T_METHOD = 2
+T_ERROR_CODE = 3
+T_ERROR_TEXT = 4
+T_COMPRESS = 5
+T_ATTACHMENT_SIZE = 6
+T_TIMEOUT_MS = 7
+T_TRACE_ID = 8
+T_SPAN_ID = 9
+T_PARENT_SPAN_ID = 10
+T_USER_FIELD = 11
+T_CONTENT_TYPE = 12
+T_STREAM_ID = 13
+T_STREAM_OFFSET = 14
+T_TENSOR_HEADER = 15
+T_AUTH = 16
+
+COMPRESS_NONE = 0
+COMPRESS_GZIP = 1
+COMPRESS_ZLIB = 2
+COMPRESS_SNAPPY = 3  # maps to zstd if snappy unavailable
+
+
+@dataclass
+class RpcMeta:
+    msg_type: int = MSG_REQUEST
+    correlation_id: int = 0
+    attempt: int = 0
+    flags: int = 0
+    service: str = ""
+    method: str = ""
+    error_code: int = 0
+    error_text: str = ""
+    compress_type: int = COMPRESS_NONE
+    attachment_size: int = 0
+    timeout_ms: int = 0
+    trace_id: int = 0
+    span_id: int = 0
+    parent_span_id: int = 0
+    content_type: str = ""
+    stream_id: int = 0
+    stream_offset: int = 0
+    tensor_header: bytes = b""
+    auth: bytes = b""
+    user_fields: dict = field(default_factory=dict)
+
+    def encode(self) -> bytes:
+        parts = [_FIXED.pack(1, self.msg_type, self.flags,
+                             self.correlation_id, self.attempt)]
+
+        def tlv(tag: int, payload: bytes):
+            parts.append(struct.pack("<BI", tag, len(payload)))
+            parts.append(payload)
+
+        if self.service:
+            tlv(T_SERVICE, self.service.encode())
+        if self.method:
+            tlv(T_METHOD, self.method.encode())
+        if self.error_code:
+            tlv(T_ERROR_CODE, struct.pack("<i", self.error_code))
+        if self.error_text:
+            tlv(T_ERROR_TEXT, self.error_text.encode())
+        if self.compress_type:
+            tlv(T_COMPRESS, bytes([self.compress_type]))
+        if self.attachment_size:
+            tlv(T_ATTACHMENT_SIZE, struct.pack("<Q", self.attachment_size))
+        if self.timeout_ms:
+            tlv(T_TIMEOUT_MS, struct.pack("<I", self.timeout_ms))
+        if self.trace_id:
+            tlv(T_TRACE_ID, struct.pack("<Q", self.trace_id))
+        if self.span_id:
+            tlv(T_SPAN_ID, struct.pack("<Q", self.span_id))
+        if self.parent_span_id:
+            tlv(T_PARENT_SPAN_ID, struct.pack("<Q", self.parent_span_id))
+        if self.content_type:
+            tlv(T_CONTENT_TYPE, self.content_type.encode())
+        if self.stream_id:
+            tlv(T_STREAM_ID, struct.pack("<Q", self.stream_id))
+        if self.stream_offset:
+            tlv(T_STREAM_OFFSET, struct.pack("<Q", self.stream_offset))
+        if self.tensor_header:
+            tlv(T_TENSOR_HEADER, self.tensor_header)
+        if self.auth:
+            tlv(T_AUTH, self.auth)
+        for k, v in self.user_fields.items():
+            if isinstance(v, str):
+                v = v.encode()
+            tlv(T_USER_FIELD, k.encode() + b"\x00" + v)
+        return b"".join(parts)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RpcMeta":
+        if len(data) < _FIXED.size:
+            raise ValueError("meta too short")
+        ver, msg_type, flags, cid, attempt = _FIXED.unpack_from(data, 0)
+        if ver != 1:
+            raise ValueError(f"unknown meta version {ver}")
+        m = cls(msg_type=msg_type, correlation_id=cid, attempt=attempt,
+                flags=flags)
+        off = _FIXED.size
+        n = len(data)
+        while off + 5 <= n:
+            tag, ln = struct.unpack_from("<BI", data, off)
+            off += 5
+            if off + ln > n:
+                raise ValueError("truncated TLV")
+            p = data[off : off + ln]
+            off += ln
+            if tag == T_SERVICE:
+                m.service = p.decode()
+            elif tag == T_METHOD:
+                m.method = p.decode()
+            elif tag == T_ERROR_CODE:
+                m.error_code = struct.unpack("<i", p)[0]
+            elif tag == T_ERROR_TEXT:
+                m.error_text = p.decode()
+            elif tag == T_COMPRESS:
+                m.compress_type = p[0]
+            elif tag == T_ATTACHMENT_SIZE:
+                m.attachment_size = struct.unpack("<Q", p)[0]
+            elif tag == T_TIMEOUT_MS:
+                m.timeout_ms = struct.unpack("<I", p)[0]
+            elif tag == T_TRACE_ID:
+                m.trace_id = struct.unpack("<Q", p)[0]
+            elif tag == T_SPAN_ID:
+                m.span_id = struct.unpack("<Q", p)[0]
+            elif tag == T_PARENT_SPAN_ID:
+                m.parent_span_id = struct.unpack("<Q", p)[0]
+            elif tag == T_CONTENT_TYPE:
+                m.content_type = p.decode()
+            elif tag == T_STREAM_ID:
+                m.stream_id = struct.unpack("<Q", p)[0]
+            elif tag == T_STREAM_OFFSET:
+                m.stream_offset = struct.unpack("<Q", p)[0]
+            elif tag == T_TENSOR_HEADER:
+                m.tensor_header = p
+            elif tag == T_AUTH:
+                m.auth = p
+            elif tag == T_USER_FIELD:
+                k, _, v = p.partition(b"\x00")
+                m.user_fields[k.decode()] = v
+            # unknown tags skipped (forward compat)
+        return m

@@ -1,0 +1,202 @@
+"""Body serializers + compression registry.
+
+The reference bridges protobuf to other encodings via json2pb/mcpack2pb and a
+compression registry (SURVEY.md §2.4).  Our registry covers the payload types
+a TPU service actually exchanges:
+
+  raw     opaque bytes (the attachment slot of baidu_std)
+  json    dict/list/str/num via JSON
+  pb      protobuf Message (class supplied per method)
+  tensor  numpy / jax arrays: dtype+shape header in meta, raw device-ready
+          bytes as body — the zero-copy slot (no pickle, bounded trust)
+  pickle  arbitrary python (explicitly opt-in; server must enable)
+
+Compression (reference compress.cpp registry + gzip/snappy policies,
+global.cpp:393-403): gzip, zlib, zstd.
+"""
+from __future__ import annotations
+
+import gzip as _gzip
+import io
+import json
+import struct
+import zlib as _zlib
+from typing import Any
+
+import numpy as np
+
+from brpc_tpu.rpc import meta as M
+
+try:
+    import zstandard as _zstd
+except Exception:  # pragma: no cover
+    _zstd = None
+
+
+# ---- serializers ----
+
+class Serializer:
+    name = "raw"
+
+    def encode(self, obj: Any) -> tuple[bytes, bytes]:
+        """Returns (body, tensor_header)."""
+        raise NotImplementedError
+
+    def decode(self, body: bytes, tensor_header: bytes) -> Any:
+        raise NotImplementedError
+
+
+class RawSerializer(Serializer):
+    name = "raw"
+
+    def encode(self, obj):
+        if isinstance(obj, (bytes, bytearray, memoryview)):
+            return bytes(obj), b""
+        if obj is None:
+            return b"", b""
+        raise TypeError(f"raw serializer needs bytes, got {type(obj)}")
+
+    def decode(self, body, tensor_header):
+        return body
+
+
+class JsonSerializer(Serializer):
+    name = "json"
+
+    def encode(self, obj):
+        return json.dumps(obj, separators=(",", ":")).encode(), b""
+
+    def decode(self, body, tensor_header):
+        return json.loads(body) if body else None
+
+
+class PbSerializer(Serializer):
+    """Protobuf messages; the concrete class comes from the method spec."""
+
+    name = "pb"
+
+    def __init__(self, message_class=None):
+        self.message_class = message_class
+
+    def encode(self, obj):
+        return obj.SerializeToString(), b""
+
+    def decode(self, body, tensor_header):
+        if self.message_class is None:
+            return body
+        msg = self.message_class()
+        msg.ParseFromString(body)
+        return msg
+
+
+class TensorSerializer(Serializer):
+    """ndarray <-> raw bytes + header.  Lists/tuples of arrays supported.
+
+    Header: u8 count, then per tensor: u8 dtype_len, dtype str, u8 ndim,
+    ndim*u64 shape.  Bodies are concatenated C-order bytes — importable into
+    device buffers without a copy (jax.numpy.frombuffer / device_put).
+    """
+
+    name = "tensor"
+
+    def encode(self, obj):
+        arrays = obj if isinstance(obj, (list, tuple)) else [obj]
+        hdr = [struct.pack("<B", len(arrays))]
+        bodies = []
+        for a in arrays:
+            a = np.asarray(a)
+            dt = a.dtype.str.encode()
+            hdr.append(struct.pack("<B", len(dt)) + dt)
+            hdr.append(struct.pack("<B", a.ndim) +
+                       struct.pack(f"<{a.ndim}Q", *a.shape))
+            bodies.append(np.ascontiguousarray(a).tobytes())
+        single = not isinstance(obj, (list, tuple))
+        flag = b"\x01" if single else b"\x00"
+        return b"".join(bodies), flag + b"".join(hdr)
+
+    def decode(self, body, tensor_header):
+        if not tensor_header:
+            return body
+        single = tensor_header[0] == 1
+        off = 1
+        count = tensor_header[off]
+        off += 1
+        out = []
+        pos = 0
+        for _ in range(count):
+            dlen = tensor_header[off]
+            off += 1
+            dt = np.dtype(tensor_header[off : off + dlen].decode())
+            off += dlen
+            ndim = tensor_header[off]
+            off += 1
+            shape = struct.unpack_from(f"<{ndim}Q", tensor_header, off)
+            off += 8 * ndim
+            cnt = int(np.prod(shape)) if ndim else 1  # 0 for empty arrays
+            arr = np.frombuffer(body, dtype=dt, count=cnt, offset=pos)
+            out.append(arr.reshape(shape))
+            pos += cnt * dt.itemsize
+        return out[0] if single and out else out
+
+
+class PickleSerializer(Serializer):
+    name = "pickle"
+
+    def encode(self, obj):
+        import pickle
+        return pickle.dumps(obj), b""
+
+    def decode(self, body, tensor_header):
+        import pickle
+        return pickle.loads(body)
+
+
+_SERIALIZERS: dict[str, Serializer] = {}
+
+
+def register_serializer(s: Serializer) -> None:
+    _SERIALIZERS[s.name] = s
+
+
+def get_serializer(name: str):
+    if isinstance(name, Serializer):
+        return name
+    s = _SERIALIZERS.get(name)
+    if s is None:
+        raise KeyError(f"unknown serializer {name!r}")
+    return s
+
+
+for _s in (RawSerializer(), JsonSerializer(), PbSerializer(),
+           TensorSerializer(), PickleSerializer()):
+    register_serializer(_s)
+
+
+# ---- compression ----
+
+def compress(data: bytes, ctype: int) -> bytes:
+    if ctype == M.COMPRESS_NONE or not data:
+        return data
+    if ctype == M.COMPRESS_GZIP:
+        return _gzip.compress(data, compresslevel=1)
+    if ctype == M.COMPRESS_ZLIB:
+        return _zlib.compress(data, 1)
+    if ctype == M.COMPRESS_SNAPPY:
+        if _zstd is not None:
+            return _zstd.ZstdCompressor(level=1).compress(data)
+        return _zlib.compress(data, 1)
+    raise ValueError(f"unknown compress type {ctype}")
+
+
+def decompress(data: bytes, ctype: int) -> bytes:
+    if ctype == M.COMPRESS_NONE or not data:
+        return data
+    if ctype == M.COMPRESS_GZIP:
+        return _gzip.decompress(data)
+    if ctype == M.COMPRESS_ZLIB:
+        return _zlib.decompress(data)
+    if ctype == M.COMPRESS_SNAPPY:
+        if _zstd is not None:
+            return _zstd.ZstdDecompressor().decompress(data)
+        return _zlib.decompress(data)
+    raise ValueError(f"unknown compress type {ctype}")

@@ -1,0 +1,431 @@
+"""Server — service registry + dispatch (reference server.{h,cpp}; §2.6).
+
+Request path mirrors §3.3: native core parses a frame and hands it to an
+executor thread → verify auth → find method in the method map → concurrency
+limiter OnRequested → decompress/deserialize → user method → serialize,
+compress, write response → MethodStatus::OnResponded feeds per-method
+LatencyRecorders (the /status page data).  HTTP messages on the same port go
+to the builtin console router (SURVEY.md §2.7).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from brpc_tpu import errors, rpcz
+from brpc_tpu.bvar import Adder, LatencyRecorder, PassiveStatus
+from brpc_tpu.rpc import meta as M
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
+from brpc_tpu.rpc.service import MethodSpec, Service
+from brpc_tpu.rpc.transport import MSG_HTTP, MSG_TRPC, Transport
+
+
+@dataclass
+class ServerOptions:
+    num_threads: int = 0                   # 0 = native executor default
+    max_concurrency: int | str = 0         # 0=unlimited, int, or "auto"
+    method_max_concurrency: int | str = 0
+    auth: Optional[Any] = None             # Authenticator (verify side)
+    interceptor: Optional[Any] = None      # pre-dispatch hook
+    internal_port: int = -1                # separate console port (optional)
+    has_builtin_services: bool = True
+    server_info_name: str = "tpu-rpc"
+    graceful_quit_timeout_s: float = 5.0
+
+
+class MethodStatus:
+    """Per-method concurrency + latency tracking
+    (reference details/method_status.{h,cpp})."""
+
+    def __init__(self, full_name: str, limiter=None):
+        safe = full_name.replace("/", "_").replace(".", "_")
+        self.full_name = full_name
+        self.latency_rec = LatencyRecorder(f"rpc_server_{safe}")
+        self.nerror = Adder(f"rpc_server_{safe}_error")
+        self._concurrency = 0
+        self._mu = threading.Lock()
+        self.limiter = limiter
+        PassiveStatus(lambda: self._concurrency).expose(
+            f"rpc_server_{safe}_concurrency")
+
+    def on_requested(self) -> bool:
+        with self._mu:
+            c = self._concurrency + 1
+        if self.limiter is not None and not self.limiter.on_requested(c):
+            return False
+        with self._mu:
+            self._concurrency += 1
+        return True
+
+    def on_responded(self, error_code: int, latency_us: int) -> None:
+        with self._mu:
+            self._concurrency = max(0, self._concurrency - 1)
+        if error_code == 0:
+            self.latency_rec.add(latency_us)
+        else:
+            self.nerror.add(1)
+        if self.limiter is not None:
+            self.limiter.on_responded(error_code, latency_us)
+
+    @property
+    def concurrency(self) -> int:
+        return self._concurrency
+
+
+class Server:
+    def __init__(self, options: ServerOptions | None = None, **kw):
+        self.options = options or ServerOptions(**kw)
+        self._services: dict[str, Service] = {}
+        self._methods: dict[tuple[str, str], MethodSpec] = {}
+        self._method_status: dict[tuple[str, str], MethodStatus] = {}
+        self._listen_sid: Optional[int] = None
+        self._port: Optional[int] = None
+        self._started = False
+        self._stopping = False
+        self._inflight = 0
+        self._inflight_mu = threading.Lock()
+        self._inflight_zero = threading.Event()
+        self._inflight_zero.set()
+        self._connections: set[int] = set()
+        self._conn_mu = threading.Lock()
+        self._start_time = time.time()
+        self._limiter = None
+        # http console router installed at start
+        self._http_router = None
+
+    # ---- registry (Server::AddService, server.h:376) ----
+
+    def add_service(self, service: Service) -> "Server":
+        if self._started:
+            raise RuntimeError("cannot add services after start")
+        name = service.service_name()
+        if name in self._services:
+            raise ValueError(f"service {name!r} already added")
+        self._services[name] = service
+        from brpc_tpu.policy.concurrency_limiter import create_limiter
+        for mname, spec in service.rpc_methods().items():
+            key = (name, mname)
+            self._methods[key] = spec
+            limiter = None
+            limit = spec.max_concurrency \
+                if spec.max_concurrency is not None \
+                else self.options.method_max_concurrency
+            if limit:
+                limiter = create_limiter(limit)
+            self._method_status[key] = MethodStatus(f"{name}/{mname}", limiter)
+        return self
+
+    @property
+    def services(self) -> dict[str, Service]:
+        return dict(self._services)
+
+    @property
+    def method_statuses(self) -> dict[tuple[str, str], MethodStatus]:
+        return dict(self._method_status)
+
+    # ---- lifecycle (Start/Stop/Join, server.cpp:788,1259,1278) ----
+
+    def start(self, addr: str = "0.0.0.0", port: int = 0) -> "Server":
+        if self._started:
+            raise RuntimeError("already started")
+        if self.options.max_concurrency:
+            from brpc_tpu.policy.concurrency_limiter import create_limiter
+            self._limiter = create_limiter(self.options.max_concurrency)
+        if self.options.has_builtin_services:
+            from brpc_tpu.builtin.router import HttpRouter
+            self._http_router = HttpRouter(self)
+        t = Transport.instance()
+        self._listen_sid, self._port = t.listen(
+            addr, port, self._on_message, self._on_conn_failed)
+        self._started = True
+        self._start_time = time.time()
+        _register_server(self)
+        return self
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._port
+
+    @property
+    def running(self) -> bool:
+        return self._started and not self._stopping
+
+    def stop(self) -> None:
+        """Stop accepting; in-flight requests drain in join()."""
+        if not self._started or self._stopping:
+            return
+        self._stopping = True
+        if self._listen_sid is not None:
+            Transport.instance().close(self._listen_sid)
+
+    def join(self) -> None:
+        self._inflight_zero.wait(self.options.graceful_quit_timeout_s)
+        with self._conn_mu:
+            conns = list(self._connections)
+        t = Transport.instance()
+        for sid in conns:
+            t.close(sid)
+        _unregister_server(self)
+        self._started = False
+
+    def run_until_interrupt(self) -> None:  # RunUntilAskedToQuit analog
+        try:
+            while self.running:
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            pass
+        self.stop()
+        self.join()
+
+    # ---- stats for builtins ----
+
+    @property
+    def uptime_s(self) -> float:
+        return time.time() - self._start_time
+
+    @property
+    def connection_count(self) -> int:
+        with self._conn_mu:
+            return len(self._connections)
+
+    def connections(self) -> list[int]:
+        with self._conn_mu:
+            return list(self._connections)
+
+    # ---- dispatch ----
+
+    def _on_conn_failed(self, sid: int, err: int) -> None:
+        with self._conn_mu:
+            self._connections.discard(sid)
+
+    def _track_conn(self, sid: int) -> None:
+        with self._conn_mu:
+            self._connections.add(sid)
+
+    def _on_message(self, sid: int, kind: int, meta_bytes: bytes, body) -> None:
+        self._track_conn(sid)
+        if kind == MSG_HTTP:
+            if self._http_router is not None:
+                self._http_router.handle(sid, body.to_bytes())
+            else:
+                Transport.instance().write_raw(
+                    sid, b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+            return
+        try:
+            meta = M.RpcMeta.decode(meta_bytes)
+        except ValueError:
+            return
+        if meta.msg_type == M.MSG_REQUEST:
+            self._process_request(sid, meta, body)
+        elif meta.msg_type in (M.MSG_STREAM_DATA, M.MSG_STREAM_FEEDBACK,
+                               M.MSG_STREAM_CLOSE):
+            from brpc_tpu.rpc.stream import StreamRegistry
+            StreamRegistry.instance().on_frame(sid, meta, body)
+
+    def _respond_error(self, sid: int, meta: M.RpcMeta, code: int,
+                       text: str = "") -> None:
+        resp = M.RpcMeta(msg_type=M.MSG_RESPONSE,
+                         correlation_id=meta.correlation_id,
+                         attempt=meta.attempt, error_code=code,
+                         error_text=text or errors.describe(code))
+        Transport.instance().write_frame(sid, resp.encode())
+
+    def _process_request(self, sid: int, meta: M.RpcMeta, body) -> None:
+        """ProcessRpcRequest analog (baidu_rpc_protocol.cpp:398)."""
+        start = time.monotonic()
+        if self._stopping:
+            self._respond_error(sid, meta, errors.ELOGOFF)
+            return
+        # auth (§2.5 Auth: first-message piggyback — we verify every frame)
+        if self.options.auth is not None:
+            if not self.options.auth.verify_credential(meta.auth):
+                self._respond_error(sid, meta, errors.ERPCAUTH)
+                return
+        # interceptor (interceptor.h:26)
+        if self.options.interceptor is not None:
+            verdict = self.options.interceptor(meta)
+            if verdict is not None and verdict is not True:
+                code = verdict if isinstance(verdict, int) else errors.EREJECT
+                self._respond_error(sid, meta, code)
+                return
+        key = (meta.service, meta.method)
+        spec = self._methods.get(key)
+        if spec is None:
+            if meta.service not in self._services:
+                self._respond_error(sid, meta, errors.ENOSERVICE,
+                                    f"unknown service {meta.service!r}")
+            else:
+                self._respond_error(sid, meta, errors.ENOMETHOD,
+                                    f"unknown method {meta.method!r}")
+            return
+        # server-level then method-level concurrency (§2.6)
+        if self._limiter is not None and not self._limiter.on_requested(
+                self._total_concurrency() + 1):
+            self._respond_error(sid, meta, errors.ELIMIT)
+            return
+        status = self._method_status[key]
+        if not status.on_requested():
+            if self._limiter is not None:
+                self._limiter.on_responded(errors.ELIMIT, 0)
+            self._respond_error(sid, meta, errors.ELIMIT)
+            return
+
+        with self._inflight_mu:
+            self._inflight += 1
+            self._inflight_zero.clear()
+
+        span = rpcz.new_span("server", meta.service, meta.method,
+                             trace_id=meta.trace_id,
+                             parent_span_id=meta.span_id)
+        cntl = Controller()
+        cntl.is_server_side = True
+        cntl.request_meta = meta
+        cntl.peer_sid = sid
+        cntl.trace_id = span.trace_id
+        cntl.span_id = span.span_id
+        error_code = 0
+        try:
+            raw = body.to_bytes()
+            att = meta.attachment_size
+            payload = raw[: len(raw) - att] if att else raw
+            cntl.request_attachment = raw[len(raw) - att:] if att else b""
+            payload = decompress(payload, meta.compress_type)
+            request = spec.request_serializer.decode(payload, meta.tensor_header)
+            span.request_size = len(raw)
+            rpcz.set_current_span(span)
+            try:
+                response = spec.fn(cntl, request)
+            finally:
+                rpcz.set_current_span(None)
+            if cntl.failed():
+                error_code = cntl.error_code
+                self._respond_error(sid, meta, cntl.error_code, cntl.error_text)
+            else:
+                res_ser = spec.response_serializer
+                rbody, theader = res_ser.encode(response)
+                rbody = compress(rbody, meta.compress_type)
+                resp = M.RpcMeta(msg_type=M.MSG_RESPONSE,
+                                 correlation_id=meta.correlation_id,
+                                 attempt=meta.attempt,
+                                 compress_type=meta.compress_type,
+                                 content_type=res_ser.name,
+                                 tensor_header=theader,
+                                 trace_id=span.trace_id,
+                                 span_id=span.span_id)
+                if cntl._stream is not None:
+                    # tell the client our local stream id + window size
+                    # (StreamSettings exchange in the reference)
+                    resp.stream_id = cntl._stream.stream_id
+                    resp.user_fields["sbuf"] = \
+                        str(cntl._stream.max_buf_size)
+                if cntl.response_attachment:
+                    resp.attachment_size = len(cntl.response_attachment)
+                    rbody = rbody + cntl.response_attachment
+                span.response_size = len(rbody)
+                Transport.instance().write_frame(sid, resp.encode(), rbody)
+        except Exception as e:
+            error_code = errors.EINTERNAL
+            self._respond_error(sid, meta, errors.EINTERNAL,
+                                f"{type(e).__name__}: {e}")
+        finally:
+            latency_us = int((time.monotonic() - start) * 1e6)
+            status.on_responded(error_code, latency_us)
+            if self._limiter is not None:
+                self._limiter.on_responded(error_code, latency_us)
+            span.error_code = error_code
+            span.end_us = rpcz.now_us()
+            rpcz.submit(span)
+            with self._inflight_mu:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._inflight_zero.set()
+
+    def _total_concurrency(self) -> int:
+        return sum(s.concurrency for s in self._method_status.values())
+
+    # ---- RESTful bridge entry (builtin/router.py) ----
+
+    def invoke_restful(self, service: str, method_name: str, payload):
+        """Call a method on behalf of the HTTP JSON bridge, through the SAME
+        gates as RPC traffic: auth (refused — HTTP carries no credential),
+        interceptor, concurrency limiters, MethodStatus and inflight
+        accounting.  Raises RpcError on any refusal."""
+        if self._stopping:
+            raise errors.RpcError(errors.ELOGOFF)
+        if self.options.auth is not None:
+            raise errors.RpcError(
+                errors.ERPCAUTH, "RESTful access disabled on authed server")
+        meta = M.RpcMeta(msg_type=M.MSG_REQUEST, service=service,
+                         method=method_name, content_type="json")
+        if self.options.interceptor is not None:
+            verdict = self.options.interceptor(meta)
+            if verdict is not None and verdict is not True:
+                code = verdict if isinstance(verdict, int) else errors.EREJECT
+                raise errors.RpcError(code)
+        key = (service, method_name)
+        spec = self._methods.get(key)
+        if spec is None:
+            raise errors.RpcError(
+                errors.ENOSERVICE if service not in self._services
+                else errors.ENOMETHOD)
+        if self._limiter is not None and not self._limiter.on_requested(
+                self._total_concurrency() + 1):
+            raise errors.RpcError(errors.ELIMIT)
+        status = self._method_status[key]
+        if not status.on_requested():
+            if self._limiter is not None:
+                self._limiter.on_responded(errors.ELIMIT, 0)
+            raise errors.RpcError(errors.ELIMIT)
+        with self._inflight_mu:
+            self._inflight += 1
+            self._inflight_zero.clear()
+        start = time.monotonic()
+        error_code = 0
+        try:
+            cntl = Controller()
+            cntl.is_server_side = True
+            result = spec.fn(cntl, payload)
+            if cntl.failed():
+                error_code = cntl.error_code
+                raise errors.RpcError(cntl.error_code, cntl.error_text)
+            return result
+        except errors.RpcError:
+            raise
+        except Exception as e:
+            error_code = errors.EINTERNAL
+            raise errors.RpcError(errors.EINTERNAL,
+                                  f"{type(e).__name__}: {e}")
+        finally:
+            latency_us = int((time.monotonic() - start) * 1e6)
+            status.on_responded(error_code, latency_us)
+            if self._limiter is not None:
+                self._limiter.on_responded(error_code, latency_us)
+            with self._inflight_mu:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._inflight_zero.set()
+
+
+# ---- global server registry (builtin services enumerate servers) ----
+
+_servers: list[Server] = []
+_servers_mu = threading.Lock()
+
+
+def _register_server(s: Server) -> None:
+    with _servers_mu:
+        _servers.append(s)
+
+
+def _unregister_server(s: Server) -> None:
+    with _servers_mu:
+        if s in _servers:
+            _servers.remove(s)
+
+
+def list_servers() -> list[Server]:
+    with _servers_mu:
+        return list(_servers)

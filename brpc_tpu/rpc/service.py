@@ -1,0 +1,71 @@
+"""Service definition API.
+
+The reference consumes protobuf-generated service classes
+(Server::AddService, server.h:376); our services are plain Python classes
+whose RPC methods are marked with @method, declaring request/response
+serializers ("raw" | "json" | "pb" | "tensor" | "pickle", see
+serialization.py).  A protobuf service works by passing message classes:
+
+    class Echo(Service):
+        @method(request="json", response="json")
+        def Echo(self, cntl, req):
+            return {"msg": req["msg"]}
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+from brpc_tpu.rpc.serialization import PbSerializer, get_serializer
+
+
+class MethodSpec:
+    __slots__ = ("name", "fn", "request_serializer", "response_serializer",
+                 "max_concurrency")
+
+    def __init__(self, name, fn, request_serializer, response_serializer,
+                 max_concurrency=None):
+        self.name = name
+        self.fn = fn
+        self.request_serializer = request_serializer
+        self.response_serializer = response_serializer
+        self.max_concurrency = max_concurrency
+
+
+def method(request: str | Any = "raw", response: str | Any = "raw",
+           request_class=None, response_class=None, max_concurrency=None):
+    """Mark an RPC method.  request/response name a serializer; pb message
+    classes may be given via request_class/response_class."""
+
+    def deco(fn: Callable):
+        req_s = PbSerializer(request_class) if request_class is not None \
+            else get_serializer(request)
+        res_s = PbSerializer(response_class) if response_class is not None \
+            else get_serializer(response)
+        fn.__rpc_spec__ = MethodSpec(fn.__name__, fn, req_s, res_s,
+                                     max_concurrency)
+        return fn
+
+    return deco
+
+
+class Service:
+    """Base class; NAME defaults to the class name (full service name in
+    method maps, like FindMethodPropertyByFullName in the reference)."""
+
+    NAME: str | None = None
+
+    @classmethod
+    def service_name(cls) -> str:
+        return cls.NAME or cls.__name__
+
+    def rpc_methods(self) -> dict[str, MethodSpec]:
+        out = {}
+        for name, member in inspect.getmembers(self, callable):
+            spec = getattr(member, "__rpc_spec__", None)
+            if spec is not None:
+                out[spec.name] = MethodSpec(spec.name, member,
+                                            spec.request_serializer,
+                                            spec.response_serializer,
+                                            spec.max_concurrency)
+        return out

@@ -1,0 +1,268 @@
+"""Streaming RPC — ordered message pipe with credit-window flow control.
+
+Reference: stream.{h,cpp}, stream_impl.h, policy/streaming_rpc_protocol.cpp
+(SURVEY.md §5.7): a stream piggybacks on an ordinary RPC (stream settings in
+the request meta, accepted server-side), then DATA frames flow with a
+sliding window — the writer blocks once `produced - remote_consumed` exceeds
+the buffer; the consumer sends CONSUMED feedback frames that advance the
+window.  Per-stream delivery is ordered (frames ride one TCP socket and the
+native core preserves arrival order per connection).
+
+This same credit loop is what the ICI transport reuses for HBM→HBM tensor
+streaming (brpc_tpu/ici/stream.py).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.rpc import meta as M
+from brpc_tpu.rpc.transport import Transport
+
+DEFAULT_BUF_SIZE = 2 * 1024 * 1024
+
+_stream_ids = itertools.count(1)
+
+
+class StreamHandler:
+    """Reference StreamInputHandler (stream.h:41-44)."""
+
+    def on_received_messages(self, stream: "Stream", messages: list[bytes]) -> None:
+        pass
+
+    def on_idle_timeout(self, stream: "Stream") -> None:
+        pass
+
+    def on_closed(self, stream: "Stream") -> None:
+        pass
+
+
+class _FnHandler(StreamHandler):
+    def __init__(self, fn, on_closed=None):
+        self._fn = fn
+        self._on_closed = on_closed
+
+    def on_received_messages(self, stream, messages):
+        for m in messages:
+            self._fn(stream, m)
+
+    def on_closed(self, stream):
+        if self._on_closed is not None:
+            self._on_closed(stream)
+
+
+class Stream:
+    """Each side owns a local id (registry key) and learns the peer's id —
+    outgoing frames are addressed to the peer's local id, exactly how the
+    reference exchanges stream ids through StreamSettings in the request/
+    response meta (streaming_rpc_meta.proto)."""
+
+    def __init__(self, stream_id: int, handler: Optional[StreamHandler],
+                 max_buf_size: int = DEFAULT_BUF_SIZE):
+        self.stream_id = stream_id               # local id
+        self.remote_id: Optional[int] = None     # peer's local id
+        self.handler = handler
+        self.max_buf_size = max_buf_size
+        # The WRITER's window size, learned from the StreamSettings exchange:
+        # feedback must fire well before the peer's window fills, regardless
+        # of our own buffer size (a 2MB receiver facing a 256KB writer would
+        # otherwise never send feedback and deadlock the writer).
+        self.peer_buf_size: Optional[int] = None
+        self._sid: Optional[int] = None          # bound host connection
+        self._mu = threading.Lock()
+        self._window_cv = threading.Condition(self._mu)
+        self._produced = 0
+        self._remote_consumed = 0
+        self._consumed_local = 0                 # receiver side
+        self._last_feedback = 0
+        self._pending: list[bytes] = []          # writes before binding
+        self._closed = False
+
+    # ---- binding (the RPC established the host connection) ----
+
+    def bind(self, sid: int) -> None:
+        with self._mu:
+            self._sid = sid
+        self._maybe_flush()
+
+    def set_remote(self, remote_id: int) -> None:
+        with self._mu:
+            self.remote_id = remote_id
+        self._maybe_flush()
+
+    def _maybe_flush(self) -> None:
+        with self._mu:
+            if self._sid is None or self.remote_id is None:
+                return
+            pending, self._pending = self._pending, []
+        for data in pending:
+            self._send_data(data)
+
+    @property
+    def connected(self) -> bool:
+        return self._sid is not None and self.remote_id is not None
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # ---- writer side (StreamWrite, stream.cpp:721/274) ----
+
+    def write(self, data: bytes, timeout_s: float | None = 10.0) -> None:
+        """Blocks while the window is full; raises RpcError(EAGAIN-like) on
+        timeout, EEOF if closed."""
+        if self._closed:
+            raise errors.RpcError(errors.EEOF, "stream closed")
+        with self._window_cv:
+            deadline = None
+            while (self._produced + len(data) - self._remote_consumed
+                   > self.max_buf_size):
+                if self._closed:
+                    raise errors.RpcError(errors.EEOF, "stream closed")
+                import time
+                if deadline is None:
+                    if timeout_s is None:
+                        deadline = float("inf")
+                    else:
+                        deadline = time.monotonic() + timeout_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise errors.RpcError(
+                        errors.EOVERCROWDED,
+                        f"stream window full ({self.max_buf_size}B)")
+                self._window_cv.wait(min(remaining, 1.0))
+            self._produced += len(data)
+            if self._sid is None or self.remote_id is None:
+                self._pending.append(data)
+                return
+        self._send_data(data)
+
+    def _send_data(self, data: bytes) -> None:
+        meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
+                         stream_id=self.remote_id)
+        rc = Transport.instance().write_frame(self._sid, meta.encode(), data)
+        if rc != 0:
+            self._on_closed_internal()
+
+    # ---- receiver side ----
+
+    def _on_data(self, data: bytes) -> None:
+        if self.handler is not None:
+            self.handler.on_received_messages(self, [data])
+        with self._mu:
+            self._consumed_local += len(data)
+            threshold = min(self.max_buf_size,
+                            self.peer_buf_size or self.max_buf_size) // 2
+            send_feedback = (self._consumed_local - self._last_feedback
+                             >= max(1, threshold))
+            if send_feedback:
+                self._last_feedback = self._consumed_local
+        if send_feedback and self._sid is not None and \
+                self.remote_id is not None:
+            meta = M.RpcMeta(msg_type=M.MSG_STREAM_FEEDBACK,
+                             stream_id=self.remote_id,
+                             stream_offset=self._consumed_local)
+            Transport.instance().write_frame(self._sid, meta.encode())
+
+    def _on_feedback(self, consumed: int) -> None:
+        with self._window_cv:
+            self._remote_consumed = max(self._remote_consumed, consumed)
+            self._window_cv.notify_all()
+
+    def _on_closed_internal(self) -> None:
+        with self._window_cv:
+            already = self._closed
+            self._closed = True
+            self._window_cv.notify_all()
+        if not already and self.handler is not None:
+            self.handler.on_closed(self)
+        StreamRegistry.instance().remove(self.stream_id)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        if self._sid is not None and self.remote_id is not None:
+            meta = M.RpcMeta(msg_type=M.MSG_STREAM_CLOSE,
+                             stream_id=self.remote_id)
+            Transport.instance().write_frame(self._sid, meta.encode())
+        self._on_closed_internal()
+
+
+class StreamRegistry:
+    _instance = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "StreamRegistry":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._streams: dict[int, Stream] = {}
+        self._mu = threading.Lock()
+
+    def register(self, stream: Stream) -> None:
+        with self._mu:
+            self._streams[stream.stream_id] = stream
+
+    def get(self, stream_id: int) -> Optional[Stream]:
+        with self._mu:
+            return self._streams.get(stream_id)
+
+    def remove(self, stream_id: int) -> None:
+        with self._mu:
+            self._streams.pop(stream_id, None)
+
+    def count(self) -> int:
+        with self._mu:
+            return len(self._streams)
+
+    def on_frame(self, sid: int, meta: M.RpcMeta, body) -> None:
+        # meta.stream_id addresses the RECEIVER's local stream.
+        s = self.get(meta.stream_id)
+        if s is None:
+            return
+        if s._sid is None:
+            s.bind(sid)
+        if meta.msg_type == M.MSG_STREAM_DATA:
+            s._on_data(body.to_bytes())
+        elif meta.msg_type == M.MSG_STREAM_FEEDBACK:
+            s._on_feedback(meta.stream_offset)
+        elif meta.msg_type == M.MSG_STREAM_CLOSE:
+            s._on_closed_internal()
+
+
+def stream_create(cntl, handler: StreamHandler | Callable | None = None,
+                  max_buf_size: int = DEFAULT_BUF_SIZE) -> Stream:
+    """Client side: create a stream riding the next RPC issued with `cntl`
+    (reference StreamCreate, stream.cpp:772)."""
+    if callable(handler) and not isinstance(handler, StreamHandler):
+        handler = _FnHandler(handler)
+    s = Stream(next(_stream_ids), handler, max_buf_size)
+    StreamRegistry.instance().register(s)
+    cntl._stream = s
+    return s
+
+
+def stream_accept(cntl, handler: StreamHandler | Callable | None = None,
+                  max_buf_size: int = DEFAULT_BUF_SIZE) -> Stream:
+    """Server side, inside a handler: accept the peer's stream
+    (reference StreamAccept, stream.cpp:813)."""
+    meta = cntl.request_meta
+    if meta is None or meta.stream_id == 0:
+        raise errors.RpcError(errors.EREQUEST, "no stream attached")
+    if callable(handler) and not isinstance(handler, StreamHandler):
+        handler = _FnHandler(handler)
+    s = Stream(next(_stream_ids), handler, max_buf_size)
+    s.set_remote(meta.stream_id)     # client's local id from the request
+    sbuf = meta.user_fields.get("sbuf")
+    if sbuf:
+        s.peer_buf_size = int(sbuf)
+    s.bind(cntl.peer_sid)
+    StreamRegistry.instance().register(s)
+    cntl._stream = s                 # response meta carries our local id
+    return s

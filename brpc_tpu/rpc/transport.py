@@ -1,0 +1,165 @@
+"""TransportManager — Python-side hub over the native socket core.
+
+Owns the process-lifetime ctypes callbacks (native sockets keep raw pointers
+to them), routes complete messages by SocketId to the registered handler
+(client connection or server), and wraps the native timer thread for
+timeout/backup timers.  This is the Python face of the reference's
+InputMessenger + SocketMap glue (SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Optional
+
+from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, IOBuf, MESSAGE_CB,
+                            TASK_CB, core, core_init)
+
+MSG_TRPC = 0
+MSG_HTTP = 1
+
+
+class Transport:
+    _instance: Optional["Transport"] = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "Transport":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        core_init()
+        self._lock = threading.Lock()
+        # sid -> (on_message(sid, kind, meta_bytes, body: IOBuf),
+        #         on_failed(sid, err))
+        self._handlers: dict[int, tuple[Callable, Callable]] = {}
+        self._timer_lock = threading.Lock()
+        self._timer_cbs: dict[int, Callable[[], None]] = {}
+        self._timer_token = 1
+
+        # Process-lifetime trampolines (pinned as attributes).
+        @MESSAGE_CB
+        def _on_message(sid, kind, meta, meta_len, body, user):
+            buf = IOBuf(handle=body)  # takes ownership, freed at GC
+            m = ctypes.string_at(meta, meta_len) if meta_len else b""
+            h = self._handlers.get(sid)
+            if h is not None:
+                try:
+                    h[0](sid, kind, m, buf)
+                except Exception:  # pragma: no cover - handler bug guard
+                    import traceback
+                    traceback.print_exc()
+
+        @FAILED_CB
+        def _on_failed(sid, err, user):
+            with self._lock:
+                h = self._handlers.pop(sid, None)
+            if h is not None and h[1] is not None:
+                try:
+                    h[1](sid, err)
+                except Exception:  # pragma: no cover
+                    import traceback
+                    traceback.print_exc()
+
+        @ACCEPTED_CB
+        def _on_accepted(listener, conn, user):
+            h = self._handlers.get(listener)
+            if h is not None:
+                # Accepted connections inherit the listener's handlers.
+                with self._lock:
+                    self._handlers[conn] = h
+
+        @TASK_CB
+        def _on_timer(arg):
+            token = arg or 0
+            with self._timer_lock:
+                fn = self._timer_cbs.pop(token, None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # pragma: no cover
+                    import traceback
+                    traceback.print_exc()
+
+        self._cb_message = _on_message
+        self._cb_failed = _on_failed
+        self._cb_accepted = _on_accepted
+        self._cb_timer = _on_timer
+
+    # ---- sockets ----
+
+    def listen(self, addr: str, port: int, on_message, on_failed=None,
+               native_echo: bool = False) -> tuple[int, int]:
+        sid = ctypes.c_uint64()
+        bound = ctypes.c_int()
+        rc = core.brpc_listen(addr.encode(), port, self._cb_message,
+                              self._cb_failed, self._cb_accepted, None,
+                              1 if native_echo else 0, ctypes.byref(sid),
+                              ctypes.byref(bound))
+        if rc != 0:
+            raise OSError(f"listen on {addr}:{port} failed")
+        with self._lock:
+            self._handlers[sid.value] = (on_message, on_failed)
+        return sid.value, bound.value
+
+    def connect(self, host: str, port: int, on_message, on_failed=None) -> int:
+        sid = ctypes.c_uint64()
+        rc = core.brpc_connect(host.encode(), port, self._cb_message,
+                               self._cb_failed, None, ctypes.byref(sid))
+        if rc != 0:
+            raise ConnectionError(f"connect to {host}:{port} failed")
+        with self._lock:
+            self._handlers[sid.value] = (on_message, on_failed)
+        return sid.value
+
+    def write_frame(self, sid: int, meta: bytes, body: bytes = b"",
+                    body_iobuf: IOBuf | None = None) -> int:
+        return core.brpc_socket_write_frame(
+            sid, meta, len(meta), body, len(body),
+            body_iobuf.handle if body_iobuf is not None else None)
+
+    def write_raw(self, sid: int, data: bytes) -> int:
+        return core.brpc_socket_write_raw(sid, data, len(data), None)
+
+    def close(self, sid: int, err: int = 0) -> None:
+        core.brpc_socket_set_failed(sid, err)
+
+    def alive(self, sid: int) -> bool:
+        return bool(core.brpc_socket_alive(sid))
+
+    def socket_stats(self, sid: int) -> dict | None:
+        nread = ctypes.c_int64()
+        nwritten = ctypes.c_int64()
+        nmsg = ctypes.c_int64()
+        ip = ctypes.create_string_buffer(48)
+        port = ctypes.c_int()
+        rc = core.brpc_socket_stats(sid, ctypes.byref(nread),
+                                    ctypes.byref(nwritten), ctypes.byref(nmsg),
+                                    ip, 48, ctypes.byref(port))
+        if rc != 0:
+            return None
+        return {"bytes_read": nread.value, "bytes_written": nwritten.value,
+                "messages_read": nmsg.value,
+                "remote": f"{ip.value.decode()}:{port.value}"}
+
+    # ---- timers (native TimerThread) ----
+
+    def schedule(self, delay_s: float, fn: Callable[[], None]) -> tuple[int, int]:
+        """Returns (native_timer_id, token) for cancel()."""
+        with self._timer_lock:
+            token = self._timer_token
+            self._timer_token += 1
+            self._timer_cbs[token] = fn
+        tid = core.brpc_timer_add(self._cb_timer, ctypes.c_void_p(token),
+                                  int(delay_s * 1e6))
+        return tid, token
+
+    def cancel(self, timer: tuple[int, int]) -> bool:
+        tid, token = timer
+        ok = core.brpc_timer_cancel(tid) == 0
+        with self._timer_lock:
+            self._timer_cbs.pop(token, None)
+        return ok

@@ -1,0 +1,112 @@
+"""rpcz — per-RPC trace spans (reference src/brpc/span.h; SURVEY.md §5.1).
+
+Span objects record the per-RPC timeline (recv/process/send timestamps,
+sizes, error).  Server-side spans are installed in thread-local storage for
+the duration of the handler, so nested client calls made inside it pick up
+trace_id/parent_span automatically — the reference propagates the same way
+through bthread-local storage (task_meta.h:44).  Collection is sampled and
+bounded (bvar::Collector role): a deque keeps the most recent spans for the
+/rpcz builtin.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+_tls = threading.local()
+_span_counter = itertools.count(1)
+
+_COLLECT_MAX = 2048
+_collected: deque = deque(maxlen=_COLLECT_MAX)
+_collect_lock = threading.Lock()
+_enabled = True
+_sample_rate = 1.0   # 1.0 = keep all (rate-limit knob for hot servers)
+
+
+def set_enabled(on: bool, sample_rate: float = 1.0) -> None:
+    global _enabled, _sample_rate
+    _enabled = on
+    _sample_rate = sample_rate
+
+
+@dataclass
+class Span:
+    trace_id: int = 0
+    span_id: int = 0
+    parent_span_id: int = 0
+    service: str = ""
+    method: str = ""
+    remote_side: str = ""
+    start_us: int = 0
+    end_us: int = 0
+    request_size: int = 0
+    response_size: int = 0
+    error_code: int = 0
+    kind: str = "server"        # server | client
+    annotations: list = field(default_factory=list)
+
+    @property
+    def latency_us(self) -> int:
+        return max(0, self.end_us - self.start_us)
+
+    def annotate(self, msg: str) -> None:
+        self.annotations.append((int(time.time() * 1e6), msg))
+
+
+def now_us() -> int:
+    return int(time.time() * 1e6)
+
+
+def new_span(kind: str, service: str = "", method: str = "",
+             trace_id: int = 0, parent_span_id: int = 0) -> Span:
+    s = Span(kind=kind, service=service, method=method,
+             trace_id=trace_id or random.getrandbits(63),
+             span_id=next(_span_counter),
+             parent_span_id=parent_span_id, start_us=now_us())
+    return s
+
+
+def set_current_span(span: Span | None) -> None:
+    _tls.span = span
+
+
+def get_current_span() -> Span | None:
+    return getattr(_tls, "span", None)
+
+
+def current_trace() -> tuple[int, int]:
+    """(trace_id, parent_span_id) to stamp on an outgoing request: inherits
+    the server span when calling inside a handler (cascaded RPC)."""
+    s = get_current_span()
+    if s is None:
+        return 0, 0
+    return s.trace_id, s.span_id
+
+
+def submit(span: Span) -> None:
+    if not _enabled:
+        return
+    if _sample_rate < 1.0 and random.random() > _sample_rate:
+        return
+    span.end_us = span.end_us or now_us()
+    with _collect_lock:
+        _collected.append(span)
+
+
+def recent_spans(limit: int = 100, trace_id: int | None = None) -> list[Span]:
+    with _collect_lock:
+        spans = list(_collected)
+    if trace_id is not None:
+        spans = [s for s in spans if s.trace_id == trace_id]
+    return spans[-limit:]
+
+
+def traceprintf(msg: str) -> None:
+    """TRACEPRINTF analog: annotate the current span."""
+    s = get_current_span()
+    if s is not None:
+        s.annotate(msg)

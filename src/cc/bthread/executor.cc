@@ -95,8 +95,13 @@ static thread_local Executor* tls_executor = nullptr;
 static thread_local int tls_worker_index = -1;
 
 Executor::Executor(int num_workers, const char* tag) : _tag(tag) {
-  if (num_workers <= 0) num_workers = (int)std::thread::hardware_concurrency();
-  if (num_workers <= 0) num_workers = 4;
+  if (num_workers <= 0) {
+    // User callbacks are run-to-completion and may block (the reference's
+    // FLAGS_usercode_in_pthread problem, SURVEY.md §5.10) — floor the pool
+    // so one blocking handler can't starve dispatch on small machines.
+    const int hw = (int)std::thread::hardware_concurrency();
+    num_workers = hw > 8 ? hw : 8;
+  }
   _workers.reserve(num_workers);
   for (int i = 0; i < num_workers; ++i) _workers.push_back(new Worker());
   for (int i = 0; i < num_workers; ++i)

@@ -1,0 +1,75 @@
+"""bvar unit tests (analog of test_bvar suite, SURVEY.md §4)."""
+import threading
+import time
+
+from brpc_tpu import bvar
+
+
+class TestReducers:
+    def test_adder_across_threads(self):
+        a = bvar.Adder()
+        n_threads, per = 8, 10_000
+
+        def w():
+            for _ in range(per):
+                a.add(1)
+
+        ts = [threading.Thread(target=w) for _ in range(n_threads)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert a.get_value() == n_threads * per
+
+    def test_maxer_miner(self):
+        mx, mn = bvar.Maxer(), bvar.Miner()
+        for v in (5, 3, 9, 1):
+            mx.add(v)
+            mn.add(v)
+        assert mx.get_value() == 9
+        assert mn.get_value() == 1
+
+    def test_lshift_sugar(self):
+        a = bvar.Adder()
+        a << 5 << 7
+        assert a.get_value() == 12
+
+    def test_passive_status(self):
+        p = bvar.PassiveStatus(lambda: 42)
+        assert p.get_value() == 42
+
+    def test_registry_and_dump(self):
+        a = bvar.Adder("test_dump_counter")
+        a.add(3)
+        d = bvar.dump_exposed("test_dump_*")
+        assert d["test_dump_counter"] == 3
+        a.hide()
+        assert "test_dump_counter" not in bvar.dump_exposed("test_dump_*")
+
+
+class TestRecorders:
+    def test_int_recorder_avg(self):
+        r = bvar.IntRecorder()
+        for v in (10, 20, 30):
+            r.add(v)
+        assert r.get_value() == 20
+        assert r.count == 3
+
+    def test_latency_recorder_percentiles(self):
+        r = bvar.LatencyRecorder()
+        for v in range(1, 1001):
+            r.add(v)
+        p50 = r.latency_percentile(0.5)
+        p99 = r.latency_percentile(0.99)
+        assert 350 <= p50 <= 700       # log-bucket resolution ~4%
+        assert 900 <= p99 <= 1100
+        assert r.max_latency() == 1000
+        assert r.count() == 1000
+
+    def test_multi_dimension(self):
+        md = bvar.MultiDimension(["method", "code"], lambda: bvar.Adder())
+        md.get_stats("Echo", "0").add(5)
+        md.get_stats("Echo", "500").add(1)
+        assert md.count_stats() == 2
+        assert md.get_stats("Echo", "0").get_value() == 5
+        assert md.has_stats("Echo", "500")
+        md.delete_stats("Echo", "500")
+        assert not md.has_stats("Echo", "500")

@@ -1,0 +1,98 @@
+"""Cluster-behavior tests: LB channels over real loopback servers, retry on
+server death, backup requests — the reference tests "distributed" behavior
+exactly this way (SURVEY.md §4: many loopback servers as 'the cluster')."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+
+
+class WhoAmI(brpc.Service):
+    NAME = "WhoAmI"
+
+    def __init__(self, tag, delay_s=0.0):
+        self._tag = tag
+        self._delay = delay_s
+
+    @brpc.method(request="json", response="json")
+    def Get(self, cntl, req):
+        if self._delay:
+            time.sleep(self._delay)
+        return {"server": self._tag}
+
+
+def _start(tag, delay_s=0.0):
+    s = brpc.Server()
+    s.add_service(WhoAmI(tag, delay_s))
+    s.start("127.0.0.1", 0)
+    return s
+
+
+class TestClusterChannel:
+    def test_rr_over_cluster(self):
+        servers = [_start(f"s{i}") for i in range(3)]
+        try:
+            addr = "list://" + ",".join(f"127.0.0.1:{s.port}"
+                                        for s in servers)
+            ch = brpc.Channel(addr, options=brpc.ChannelOptions(
+                timeout_ms=5000, load_balancer="rr"))
+            seen = [ch.call_sync("WhoAmI", "Get", {}, serializer="json")
+                    ["server"] for _ in range(9)]
+            assert sorted(set(seen)) == ["s0", "s1", "s2"]
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_retry_when_one_server_dies(self):
+        servers = [_start(f"s{i}") for i in range(2)]
+        addr = "list://" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+        ch = brpc.Channel(addr, options=brpc.ChannelOptions(
+            timeout_ms=5000, load_balancer="rr", max_retry=3))
+        try:
+            # warm both connections
+            for _ in range(4):
+                ch.call_sync("WhoAmI", "Get", {}, serializer="json")
+            # kill server 0: in-flight and future calls must survive via
+            # retry on the living server
+            dead_port = servers[0].port
+            servers[0].stop()
+            servers[0].join()
+            ok = 0
+            for _ in range(12):
+                r = ch.call_sync("WhoAmI", "Get", {}, serializer="json")
+                assert r["server"] == "s1"
+                ok += 1
+            assert ok == 12
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_backup_request_beats_slow_server(self):
+        slow = _start("slow", delay_s=1.0)
+        fast = _start("fast")
+        try:
+            # la LB would avoid the slow one; force rr so the backup path is
+            # what saves latency
+            addr = f"list://127.0.0.1:{slow.port},127.0.0.1:{fast.port}"
+            ch = brpc.Channel(addr, options=brpc.ChannelOptions(
+                timeout_ms=8000, load_balancer="rr",
+                backup_request_ms=100, max_retry=1))
+            latencies = []
+            hit = []
+            for _ in range(4):
+                t0 = time.monotonic()
+                r = ch.call_sync("WhoAmI", "Get", {}, serializer="json")
+                latencies.append(time.monotonic() - t0)
+                hit.append(r["server"])
+            # every call returns well under the slow server's 1s delay
+            assert max(latencies) < 0.9, latencies
+            assert "fast" in hit
+        finally:
+            for s in (slow, fast):
+                s.stop()
+                s.join()

@@ -1,0 +1,118 @@
+"""Builtin HTTP console tests (analog of brpc_builtin_service_unittest)."""
+import http.client
+import json
+
+import pytest
+
+import brpc_tpu as brpc
+
+
+class Hello(brpc.Service):
+    @brpc.method(request="json", response="json")
+    def Say(self, cntl, req):
+        return {"hello": (req or {}).get("name", "world")}
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = brpc.Server()
+    s.add_service(Hello())
+    s.start("127.0.0.1", 0)
+    # generate some traffic for /status
+    ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=5000)
+    ch.call_sync("Hello", "Say", {"name": "x"}, serializer="json")
+    yield s
+    s.stop()
+    s.join()
+
+
+def _get(server, path):
+    c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = r.read()
+    c.close()
+    return r.status, body
+
+
+def test_index(server):
+    status, body = _get(server, "/")
+    assert status == 200 and b"/vars" in body
+
+
+def test_health(server):
+    assert _get(server, "/health") == (200, b"OK\n")
+
+
+def test_status_lists_methods(server):
+    status, body = _get(server, "/status")
+    assert status == 200
+    assert b"Hello.Say" in body
+    assert b"count=1" in body
+
+
+def test_vars(server):
+    status, body = _get(server, "/vars")
+    assert status == 200
+    assert b"rpc_server_Hello_Say" in body
+
+
+def test_vars_filter(server):
+    _, body = _get(server, "/vars?filter=rpc_server_Hello*")
+    assert b"rpc_server_Hello_Say" in body
+    assert b"rpc_health_check" not in body
+
+
+def test_flags_list_and_set(server):
+    _, body = _get(server, "/flags")
+    assert b"rpcz_enabled" in body
+    status, body = _get(server, "/flags?setvalue=rpcz_sample_rate&value=0.5")
+    assert status == 200 and body == b"ok\n"
+    from brpc_tpu.flags import get_flag
+    assert get_flag("rpcz_sample_rate") == 0.5
+    _get(server, "/flags?setvalue=rpcz_sample_rate&value=1.0")
+
+
+def test_flags_reject_non_reloadable(server):
+    status, _ = _get(server, "/flags?setvalue=max_body_size&value=5")
+    assert status == 400
+
+
+def test_rpcz_shows_spans(server):
+    _, body = _get(server, "/rpcz")
+    assert b"Hello.Say" in body
+
+
+def test_prometheus_metrics(server):
+    status, body = _get(server, "/brpc_metrics")
+    assert status == 200
+    assert b"# TYPE" in body
+    assert b"rpc_server_Hello_Say_count" in body
+
+
+def test_services_inventory(server):
+    _, body = _get(server, "/services")
+    data = json.loads(body)
+    assert data["Hello"]["Say"]["request"] == "json"
+
+
+def test_connections_and_bthreads(server):
+    status, body = _get(server, "/connections")
+    assert status == 200 and b"socket_id" in body
+    status, body = _get(server, "/bthreads")
+    assert b"workers:" in body
+
+
+def test_restful_rpc_bridge(server):
+    c = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+    c.request("POST", "/Hello/Say", json.dumps({"name": "rest"}),
+              {"Content-Type": "application/json"})
+    r = c.getresponse()
+    assert r.status == 200
+    assert json.loads(r.read()) == {"hello": "rest"}
+    c.close()
+
+
+def test_404(server):
+    status, _ = _get(server, "/definitely-not-a-page")
+    assert status == 404

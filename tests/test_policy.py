@@ -1,0 +1,177 @@
+"""Load balancer / naming service / limiter / breaker unit tests
+(analog of brpc_load_balancer_unittest etc., SURVEY.md §4)."""
+import collections
+import os
+import tempfile
+import time
+
+import pytest
+
+from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+from brpc_tpu.policy import health_check
+from brpc_tpu.policy.circuit_breaker import CircuitBreaker
+from brpc_tpu.policy.concurrency_limiter import (AutoConcurrencyLimiter,
+                                                 ConstantLimiter,
+                                                 TimeoutLimiter,
+                                                 create_limiter)
+from brpc_tpu.policy.load_balancer import (ServerNode, create_load_balancer)
+from brpc_tpu.policy.naming import (FileNamingService, ListNamingService,
+                                    start_naming_service)
+
+
+def _nodes(*ports, weight=1):
+    return [ServerNode(EndPoint("10.0.0.1", p), weight) for p in ports]
+
+
+class TestEndpoint:
+    def test_parse_forms(self):
+        assert str2endpoint("1.2.3.4:80") == EndPoint("1.2.3.4", 80)
+        assert str2endpoint("[::1]:80").host == "::1"
+        assert str2endpoint("unix:/tmp/s.sock").scheme == "unix"
+        e = str2endpoint("ici://slice0/4")
+        assert e.is_ici and e.port == 4 and e.host == "slice0"
+        assert str(e) == "ici://slice0/4"
+
+
+class TestLoadBalancers:
+    def test_rr_uniform(self):
+        lb = create_load_balancer("rr")
+        lb.reset_servers(_nodes(1, 2, 3))
+        picks = collections.Counter(str(lb.select_server()) for _ in range(300))
+        assert all(c == 100 for c in picks.values())
+
+    def test_wrr_respects_weights(self):
+        lb = create_load_balancer("wrr")
+        lb.reset_servers([ServerNode(EndPoint("h", 1), 3),
+                          ServerNode(EndPoint("h", 2), 1)])
+        picks = collections.Counter(lb.select_server().port
+                                    for _ in range(400))
+        assert picks[1] == 300 and picks[2] == 100
+
+    def test_consistent_hash_sticky(self):
+        lb = create_load_balancer("c_murmurhash")
+        lb.reset_servers(_nodes(1, 2, 3, 4, 5))
+        ep1 = lb.select_server(request_code=12345)
+        for _ in range(10):
+            assert lb.select_server(request_code=12345) == ep1
+        # removing an unrelated server keeps most keys stable
+        moved = 0
+        keys = list(range(2000))
+        before = {k: lb.select_server(request_code=k) for k in keys}
+        lb.remove_server(before[0])
+        for k in keys:
+            after = lb.select_server(request_code=k)
+            if after != before[k]:
+                moved += 1
+        assert moved < len(keys) * 0.5  # only keys of the removed node move
+
+    def test_la_shifts_from_slow_server(self):
+        lb = create_load_balancer("la")
+        lb.reset_servers(_nodes(1, 2))
+        fast, slow = EndPoint("10.0.0.1", 1), EndPoint("10.0.0.1", 2)
+        for _ in range(200):
+            ep = lb.select_server()
+            lb.feedback(ep, 0, 100 if ep == fast else 100_000)
+        picks = collections.Counter(str(lb.select_server())
+                                    for _ in range(200))
+        # bring inflight back down for a fair read
+        assert picks[str(fast)] > picks[str(slow)] * 3
+
+    def test_exclude(self):
+        lb = create_load_balancer("rr")
+        lb.reset_servers(_nodes(1, 2))
+        only = {lb.select_server(exclude={EndPoint("10.0.0.1", 1)})
+                for _ in range(10)}
+        assert only == {EndPoint("10.0.0.1", 2)}
+
+
+class TestNaming:
+    def test_list_ns(self):
+        ns = ListNamingService("a:1,b:2(5)")
+        nodes = ns.get_servers()
+        assert nodes[0].endpoint == EndPoint("a", 1)
+        assert nodes[1].weight == 5
+
+    def test_file_ns(self):
+        with tempfile.NamedTemporaryFile("w", suffix=".list",
+                                         delete=False) as f:
+            f.write("# cluster\nhost1:100\nhost2:200 7\n")
+            path = f.name
+        try:
+            nodes = FileNamingService(path).get_servers()
+            assert len(nodes) == 2
+            assert nodes[1].weight == 7
+        finally:
+            os.unlink(path)
+
+    def test_start_naming_service_pushes_to_lb(self):
+        lb = create_load_balancer("rr")
+        t = start_naming_service("list://x:1,y:2", lb)
+        assert lb.server_count() == 2
+        t.stop()
+
+
+class TestHealthCheck:
+    def test_mark_and_revive(self):
+        import socket as pysock
+        import threading
+        # a real listener that the probe can reach
+        srv = pysock.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        ep = EndPoint("127.0.0.1", port)
+        old = health_check.health_check_interval_s
+        health_check.health_check_interval_s = 0.05
+        try:
+            health_check.mark_broken(ep)
+            assert health_check.is_broken(ep)
+            deadline = time.time() + 5
+            while health_check.is_broken(ep) and time.time() < deadline:
+                time.sleep(0.05)
+            assert not health_check.is_broken(ep), "probe did not revive"
+        finally:
+            health_check.health_check_interval_s = old
+            srv.close()
+
+
+class TestCircuitBreaker:
+    def test_isolates_after_errors(self):
+        cb = CircuitBreaker()
+        ep = EndPoint("10.9.9.9", 1)
+        for _ in range(40):
+            cb.on_call_end(ep, 500)
+        # isolation marks broken through health_check
+        assert health_check.is_broken(ep)
+        health_check.reset(ep)
+
+
+class TestLimiters:
+    def test_constant(self):
+        l = ConstantLimiter(2)
+        assert l.on_requested(1) and l.on_requested(2)
+        assert not l.on_requested(3)
+
+    def test_create_specs(self):
+        assert isinstance(create_limiter("auto"), AutoConcurrencyLimiter)
+        assert isinstance(create_limiter("timeout:200"), TimeoutLimiter)
+        assert create_limiter("constant:9").max_concurrency() == 9
+        assert create_limiter(5).max_concurrency() == 5
+
+    def test_timeout_limiter_rejects_when_backlogged(self):
+        l = TimeoutLimiter(timeout_ms=1.0)  # 1ms budget
+        for _ in range(10):
+            l.on_responded(0, 1000)  # avg 1ms per call
+        assert l.on_requested(1)
+        assert not l.on_requested(50)
+
+    def test_auto_limiter_adapts(self):
+        l = AutoConcurrencyLimiter()
+        start = l.max_concurrency()
+        # simulate a fast healthy server over several windows
+        for _ in range(3):
+            l._window_start -= 2.0  # force window close
+            for _ in range(100):
+                l.on_responded(0, 500)
+        assert l.max_concurrency() >= AutoConcurrencyLimiter.MIN_LIMIT
+        assert l.on_requested(1)
