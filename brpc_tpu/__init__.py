@@ -14,9 +14,11 @@ __version__ = "0.1.0"
 from brpc_tpu import errors  # noqa: F401
 from brpc_tpu.errors import RpcError  # noqa: F401
 from brpc_tpu.rpc import (  # noqa: F401
-    CallManager, Channel, ChannelOptions, Controller, MethodStatus,
-    RetryPolicy, Server, ServerOptions, Service, SocketMap, Stream,
-    StreamHandler, method, stream_accept, stream_create,
+    CallManager, CallMapper, Channel, ChannelOptions, Controller,
+    MethodStatus, ParallelChannel, PartitionChannel, PartitionParser,
+    ResponseMerger, RetryPolicy, SelectiveChannel, Server, ServerOptions,
+    Service, SocketMap, Stream, StreamHandler, SubCall, SumMerger, method,
+    stream_accept, stream_create,
 )
 from brpc_tpu.rpc.service import MethodSpec  # noqa: F401
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint  # noqa: F401

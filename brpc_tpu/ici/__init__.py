@@ -1,0 +1,22 @@
+"""ICI transport — the TPU-native answer to the reference's RDMA subsystem.
+
+Mapping (SURVEY.md §5.8):
+  rdma::BlockPool (pinned, NIC-registered slabs)  -> HBM BlockPool (device
+      buffers in 8KB/64KB/2MB classes, brpc_tpu/ici/block_pool.py)
+  RdmaEndpoint (ibverbs QP send/recv + credit)    -> IciEndpoint (XLA
+      device-to-device transfers over ICI + the same credit window,
+      brpc_tpu/ici/endpoint.py)
+  StreamWrite over RDMA                           -> TensorStream: zero-copy
+      HBM->HBM tensor pipe (brpc_tpu/ici/stream.py)
+  ParallelChannel/PartitionChannel socket fan-out -> ONE jitted shard_map
+      with psum/all_gather/ppermute over the mesh
+      (brpc_tpu/ici/collective.py)
+"""
+from brpc_tpu.ici.mesh import get_mesh, local_devices, device_for  # noqa: F401
+from brpc_tpu.ici.block_pool import BlockPool, get_block_pool  # noqa: F401
+from brpc_tpu.ici.endpoint import IciEndpoint, link_stats  # noqa: F401
+from brpc_tpu.ici.stream import TensorStream  # noqa: F401
+from brpc_tpu.ici.collective import CollectiveGroup  # noqa: F401
+from brpc_tpu.ici.channel import (  # noqa: F401
+    IciChannel, register_device_service, device_service_registry,
+)

@@ -1,0 +1,135 @@
+"""HBM BlockPool — the device-memory analog of rdma::BlockPool.
+
+Reference (rdma/block_pool.cpp:52,69-70): large pinned regions registered
+with the NIC, slab-allocated into 8KB/64KB/2MB blocks, wired in as IOBuf's
+block allocator so payloads are *born registered* — zero copy end-to-end.
+
+TPU build: the pool owns per-device jax buffers in the same size classes.
+A block is a view (offset, length) into a device arena; tensors serialized
+into blocks live in HBM and move chip-to-chip without host round-trips.
+XLA owns physical allocation (there is no cudaMalloc-style API), so the
+arena is a set of device arrays kept alive by the pool; blocks are views
+with a free-list, and donation happens naturally when a transfer consumes
+the arena slice.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from brpc_tpu.bvar import Adder, PassiveStatus
+
+# size classes, mirroring the reference's 8KB/64KB/2MB (block_pool.cpp:52)
+BLOCK_CLASSES = (8 * 1024, 64 * 1024, 2 * 1024 * 1024)
+_ARENA_BLOCKS_PER_CLASS = 64
+
+
+@dataclass
+class Block:
+    """A view into a device arena: arena array index + slot."""
+    pool: "BlockPool"
+    size_class: int
+    slot: int
+    used: int = 0
+
+    @property
+    def nbytes(self) -> int:
+        return self.size_class
+
+    def view(self):
+        """The device buffer of this slot (uint8[size_class])."""
+        with self.pool._lock:
+            return self.pool._slots[self.size_class][self.slot]
+
+    def put(self, data) -> "Block":
+        """Copy host/device bytes into this block's slot (device_put to the
+        pool's device; on-device source stays on device).  The slot buffer
+        is replaced atomically under the pool lock — concurrent puts to
+        different slots never interfere and nothing copies the whole class
+        arena."""
+        buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
+            if not isinstance(data, jax.Array) else data
+        n = buf.size if hasattr(buf, "size") else len(buf)
+        if n > self.size_class:
+            raise ValueError(f"{n}B > block class {self.size_class}")
+        self.used = n
+        padded = jnp.zeros((self.size_class,), jnp.uint8).at[:n].set(
+            jnp.asarray(buf, jnp.uint8))
+        dev = jax.device_put(padded, self.pool.device)
+        with self.pool._lock:
+            self.pool._slots[self.size_class][self.slot] = dev
+        return self
+
+    def get(self) -> bytes:
+        return bytes(np.asarray(self.view())[: self.used])
+
+    def free(self) -> None:
+        self.pool.free(self)
+
+
+class BlockPool:
+    """Per-device slab pool of HBM blocks."""
+
+    def __init__(self, device=None):
+        self.device = device or jax.devices()[0]
+        self._lock = threading.Lock()
+        # one device buffer per slot: replaced wholesale on put() so slots
+        # are independent (XLA owns the physical pages; keeping per-slot
+        # arrays alive is what pins the "arena")
+        self._slots: dict[int, list] = {}
+        self._free: dict[int, list[int]] = {}
+        self._allocated = Adder()
+        self._freed = Adder()
+        for cls in BLOCK_CLASSES:
+            with jax.default_device(self.device):
+                zero = jnp.zeros((cls,), jnp.uint8)
+            self._slots[cls] = [zero] * _ARENA_BLOCKS_PER_CLASS
+            self._free[cls] = list(range(_ARENA_BLOCKS_PER_CLASS))
+
+    def alloc(self, nbytes: int) -> Block:
+        """Smallest class that fits (AllocBlock, block_pool.h:76-88)."""
+        for cls in BLOCK_CLASSES:
+            if nbytes <= cls:
+                with self._lock:
+                    if self._free[cls]:
+                        slot = self._free[cls].pop()
+                        self._allocated.add(1)
+                        return Block(self, cls, slot)
+        raise MemoryError(
+            f"no free HBM block for {nbytes}B "
+            f"(classes {BLOCK_CLASSES}, {_ARENA_BLOCKS_PER_CLASS}/class)")
+
+    def free(self, block: Block) -> None:
+        with self._lock:
+            self._free[block.size_class].append(block.slot)
+            self._freed.add(1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "device": str(self.device),
+                "classes": {str(cls): {
+                    "free": len(self._free[cls]),
+                    "total": _ARENA_BLOCKS_PER_CLASS,
+                } for cls in BLOCK_CLASSES},
+                "allocated": self._allocated.get_value(),
+                "freed": self._freed.get_value(),
+            }
+
+
+_pools: dict[int, BlockPool] = {}
+_pools_lock = threading.Lock()
+
+
+def get_block_pool(device=None) -> BlockPool:
+    device = device or jax.devices()[0]
+    with _pools_lock:
+        p = _pools.get(device.id)
+        if p is None:
+            p = BlockPool(device)
+            _pools[device.id] = p
+        return p

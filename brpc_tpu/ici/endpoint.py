@@ -1,0 +1,128 @@
+"""IciEndpoint — chip-to-chip transfer in RdmaEndpoint's socket slot.
+
+Reference (rdma_endpoint.h; SURVEY.md §5.8): after a TCP-assisted handshake
+the endpoint moves data on an RC queue pair with a credit window =
+min(local SQ, remote RQ), completions surfacing through the dispatcher.
+
+TPU build: the "queue pair" is XLA's device-to-device transfer engine —
+`jax.device_put(x, device)` lowers to an ICI copy on hardware (no host
+bounce), and dispatch is async, so starting a transfer and touching the
+result later gives the same start/wait split as ibverbs post-send/poll-cq.
+The credit window survives unchanged: in-flight bytes are bounded, and
+"completion events" are jax futures observed via block_until_ready in a
+drainer thread that feeds the same bvar counters the socket path uses.
+No handshake is needed inside one process/slice; cross-host setup arrives
+with the DCN path in a later round.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+import jax
+
+from brpc_tpu.bvar import Adder, LatencyRecorder
+
+_send_bytes = Adder("ici_send_bytes")
+_send_count = Adder("ici_send_count")
+_recv_bytes = Adder("ici_recv_bytes")
+_transfer_latency = LatencyRecorder("ici_transfer")
+
+DEFAULT_WINDOW_BYTES = 64 * 1024 * 1024
+
+
+class IciEndpoint:
+    """Point-to-point ordered transfer pipe to one target device."""
+
+    def __init__(self, device, window_bytes: int = DEFAULT_WINDOW_BYTES):
+        self.device = device
+        self.window_bytes = window_bytes
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._inflight = 0
+        self._closed = False
+        # single long-lived completion drainer (the "poll-cq" thread);
+        # started lazily on the first send
+        import queue
+        self._completions: "queue.Queue" = queue.Queue()
+        self._drainer: Optional[threading.Thread] = None
+
+    def _ensure_drainer(self) -> None:
+        if self._drainer is None:
+            with self._mu:
+                if self._drainer is None:
+                    self._drainer = threading.Thread(
+                        target=self._drain_completions, daemon=True,
+                        name=f"ici-cq-{self.device.id}")
+                    self._drainer.start()
+
+    def _drain_completions(self) -> None:
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            out, nbytes, t0 = item
+            try:
+                out.block_until_ready()
+            except Exception:  # transfer failure: free the window anyway
+                pass
+            _transfer_latency.add(int((time.monotonic() - t0) * 1e6))
+            _recv_bytes.add(nbytes)
+            with self._cv:
+                self._inflight -= nbytes
+                self._cv.notify_all()
+
+    def send(self, array: jax.Array, timeout_s: float = 30.0) -> jax.Array:
+        """Start an async transfer of `array` to this endpoint's device;
+        returns the (not-yet-ready) destination array.  Blocks while the
+        credit window is exhausted — same EAGAIN discipline as
+        RdmaEndpoint's SQ/window check (rdma_endpoint.h:235-240)."""
+        nbytes = array.nbytes
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._inflight + nbytes > self.window_bytes:
+                if self._closed:
+                    raise RuntimeError("endpoint closed")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"ICI window full ({self.window_bytes}B) ")
+                self._cv.wait(min(remaining, 1.0))
+            self._inflight += nbytes
+        t0 = time.monotonic()
+        out = jax.device_put(array, self.device)  # async: ICI DMA starts
+        _send_bytes.add(nbytes)
+        _send_count.add(1)
+        self._ensure_drainer()
+        self._completions.put((out, nbytes, t0))
+        return out
+
+    def send_sync(self, array: jax.Array) -> jax.Array:
+        out = self.send(array)
+        out.block_until_ready()
+        return out
+
+    @property
+    def inflight_bytes(self) -> int:
+        with self._mu:
+            return self._inflight
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        if self._drainer is not None:
+            self._completions.put(None)
+
+
+def link_stats() -> dict:
+    """Exported on the /ici console page."""
+    return {
+        "send_bytes": _send_bytes.get_value(),
+        "send_count": _send_count.get_value(),
+        "recv_bytes": _recv_bytes.get_value(),
+        "transfer_avg_us": round(_transfer_latency.latency(), 1),
+        "transfer_p99_us": round(_transfer_latency.latency_percentile(0.99), 1),
+        "devices": [str(d) for d in jax.devices()],
+    }

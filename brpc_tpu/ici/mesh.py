@@ -1,0 +1,48 @@
+"""Device mesh management.
+
+One place decides what "the local slice" is: real TPU chips when present,
+the virtual CPU mesh under tests (conftest forces 8 CPU devices).  Channels
+address chips as ici://<slice>/<chip> (EndPoint scheme "ici").
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_lock = threading.Lock()
+_meshes: dict[tuple, Mesh] = {}
+
+
+def local_devices():
+    return jax.devices()
+
+
+def device_for(chip_index: int):
+    devs = jax.devices()
+    return devs[chip_index % len(devs)]
+
+
+def get_mesh(n_devices: Optional[int] = None,
+             axis_names: tuple[str, ...] = ("chip",),
+             shape: Optional[tuple[int, ...]] = None) -> Mesh:
+    """Mesh over the first n local devices (default: all).  Multi-axis
+    meshes (e.g. ("dp","tp")) reshape the device list row-major."""
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(f"want {n_devices} devices, have {len(devs)}")
+    if shape is None:
+        shape = (n_devices,)
+    key = (n_devices, axis_names, shape)
+    with _lock:
+        m = _meshes.get(key)
+        if m is None:
+            arr = np.array(devs[:n_devices]).reshape(shape)
+            m = Mesh(arr, axis_names)
+            _meshes[key] = m
+        return m

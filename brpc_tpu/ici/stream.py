@@ -1,0 +1,62 @@
+"""TensorStream — StreamWrite as a zero-copy HBM→HBM tensor pipe.
+
+The credit loop of rpc/stream.py (§5.7) applied to device arrays: writer
+pushes tensors, each rides an async ICI transfer (IciEndpoint), consumer
+callbacks run in submission order, the window bounds HBM held by in-flight
+chunks.  Double buffering falls out of the async dispatch: chunk N+1's
+transfer starts while N's consumer runs.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import jax
+
+from brpc_tpu.ici.endpoint import IciEndpoint
+
+
+class TensorStream:
+    def __init__(self, device,
+                 consumer: Optional[Callable[[jax.Array], None]] = None,
+                 window_bytes: int = 64 * 1024 * 1024):
+        self.endpoint = IciEndpoint(device, window_bytes)
+        self._consumer = consumer
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = threading.Event()
+        self._drained = threading.Event()
+        self._drainer = threading.Thread(target=self._drain, daemon=True,
+                                         name=f"tensor-stream-{device.id}")
+        self._drainer.start()
+
+    def write(self, array: jax.Array) -> None:
+        """Queue one tensor; transfer starts immediately (async), order is
+        preserved for the consumer."""
+        if self._closed.is_set():
+            raise RuntimeError("stream closed")
+        out = self.endpoint.send(array)
+        self._q.put(out)
+
+    def _drain(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if self._closed.is_set():
+                    break
+                continue
+            if item is None:
+                break
+            item.block_until_ready()   # ordered completion
+            if self._consumer is not None:
+                self._consumer(item)
+        self._drained.set()
+
+    def close(self, wait: bool = True) -> None:
+        if not self._closed.is_set():
+            self._closed.set()
+            self._q.put(None)
+        if wait:
+            self._drained.wait(30)
+        self.endpoint.close()

@@ -7,4 +7,8 @@ from brpc_tpu.rpc.service import Service, method  # noqa: F401
 from brpc_tpu.rpc.stream import (  # noqa: F401
     Stream, StreamHandler, stream_create, stream_accept,
 )
+from brpc_tpu.rpc.combo_channels import (  # noqa: F401
+    CallMapper, ParallelChannel, PartitionChannel, PartitionParser,
+    ResponseMerger, SelectiveChannel, SubCall, SumMerger,
+)
 from brpc_tpu.rpc import meta  # noqa: F401

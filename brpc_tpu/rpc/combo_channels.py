@@ -1,0 +1,366 @@
+"""Combo channels (reference parallel_channel.{h,cpp},
+selective_channel.{h,cpp}, partition_channel.{h,cpp}; SURVEY.md §2.5).
+
+  ParallelChannel   one call fans out to N sub-channels; CallMapper slices
+                    or clones the request per sub-channel, ResponseMerger
+                    folds sub-responses, fail_limit bounds tolerated
+                    failures (parallel_channel.h:94-110).
+  SelectiveChannel  channel-of-channels with its own balancer; retries a
+                    DIFFERENT sub-channel on failure (selective_channel.h).
+  PartitionChannel  shards requests over partitioned servers via a
+                    PartitionParser on server tags (partition_channel.h).
+
+TPU-native lowering: when every sub-channel targets an ICI endpoint in the
+local mesh, ParallelChannel/PartitionChannel execute as ONE jitted
+shard_map over the device mesh — the fan-out becomes a broadcast/shard and
+the merge becomes a collective (psum / all_gather), never touching sockets
+(SURVEY.md §5.8 target).  See brpc_tpu/ici/collective.py.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+from brpc_tpu import errors
+from brpc_tpu.rpc.channel import Channel, ChannelOptions
+from brpc_tpu.rpc.controller import Controller
+
+
+# CollectiveGroups (and the jitted programs they cache) are shared across
+# ParallelChannel instances: one compile per (device_count, service fn).
+_collective_groups: dict[int, Any] = {}
+_collective_groups_lock = threading.Lock()
+
+
+def _collective_group(n_devices: int):
+    from brpc_tpu.ici.collective import CollectiveGroup
+    from brpc_tpu.ici.mesh import get_mesh
+    with _collective_groups_lock:
+        g = _collective_groups.get(n_devices)
+        if g is None:
+            g = CollectiveGroup(get_mesh(n_devices=n_devices))
+            _collective_groups[n_devices] = g
+        return g
+
+
+class SubCall:
+    """What CallMapper returns for one sub-channel: its request (or SKIP)."""
+
+    __slots__ = ("request", "skip")
+
+    def __init__(self, request: Any = None, skip: bool = False):
+        self.request = request
+        self.skip = skip
+
+    @classmethod
+    def skip_call(cls) -> "SubCall":
+        return cls(skip=True)
+
+
+class CallMapper:
+    """Map(channel_index, request) -> SubCall (parallel_channel.h:94)."""
+
+    def map(self, channel_index: int, nchannels: int, request: Any) -> SubCall:
+        return SubCall(request)   # default: broadcast the same request
+
+
+class ResponseMerger:
+    """merge(responses) -> merged response.  Default returns the list."""
+
+    def merge(self, responses: list) -> Any:
+        return responses
+
+
+class SumMerger(ResponseMerger):
+    """Elementwise sum — lowered to psum when the fan-out is collective."""
+
+    def merge(self, responses: list) -> Any:
+        out = responses[0]
+        for r in responses[1:]:
+            out = out + r
+        return out
+
+
+class ParallelChannel:
+    def __init__(self, fail_limit: int = 0,
+                 call_mapper: CallMapper | None = None,
+                 response_merger: ResponseMerger | None = None):
+        self._channels: list[tuple[Channel, CallMapper | None]] = []
+        self.fail_limit = fail_limit        # 0 = tolerate none
+        self.call_mapper = call_mapper or CallMapper()
+        self.response_merger = response_merger or ResponseMerger()
+
+    def add_channel(self, channel: Channel,
+                    call_mapper: CallMapper | None = None) -> "ParallelChannel":
+        self._channels.append((channel, call_mapper))
+        return self
+
+    @property
+    def channel_count(self) -> int:
+        return len(self._channels)
+
+    def _all_ici(self) -> bool:
+        from brpc_tpu.ici.channel import IciChannel
+        return bool(self._channels) and all(
+            isinstance(ch, IciChannel) for ch, _ in self._channels)
+
+    def _call_lowered(self, service: str, method: str, request: Any,
+                      cntl: Controller,
+                      done: Callable | None) -> Controller:
+        """All targets are chips in the local mesh: run the fan-out as ONE
+        jitted shard_map — broadcast + per-chip service fn + collective
+        merge (SURVEY.md §5.8 lowering).  The merge is "sum" when the
+        ResponseMerger is SumMerger, else per-chip results are stacked and
+        handed to the merger."""
+        from brpc_tpu.ici.channel import device_service_registry
+        import time
+        import jax
+        fn = device_service_registry().get((service, method))
+        if fn is None:
+            cntl.set_failed(errors.ENOMETHOD,
+                            f"no device service {service}.{method}")
+        else:
+            merge = "sum" if isinstance(self.response_merger, SumMerger) \
+                else "stack"
+            t0 = time.monotonic()
+            try:
+                group = _collective_group(len(self._channels))
+                out = group.parallel_apply(fn, request, merge=merge)
+                out = jax.block_until_ready(out)  # real latency + surfaced
+                                                  # device-side failures
+                if merge == "stack":
+                    out = self.response_merger.merge(list(out))
+                cntl.response = out
+            except Exception as e:
+                cntl.set_failed(errors.EINTERNAL,
+                                f"collective lowering failed: {e}")
+            cntl.latency_us = int((time.monotonic() - t0) * 1e6)
+        if done is not None:
+            done(cntl)
+        if cntl._done_event is not None:
+            cntl._done_event.set()
+        return cntl
+
+    def call(self, service: str, method: str, request: Any = b"",
+             cntl: Controller | None = None, serializer: str = "raw",
+             done: Callable[[Controller], None] | None = None) -> Controller:
+        cntl = cntl or Controller()
+        n = len(self._channels)
+        if n == 0:
+            cntl.set_failed(errors.ENODATA, "no sub-channels")
+            if done:
+                done(cntl)
+            return cntl
+        if self._all_ici() and type(self.call_mapper) is CallMapper and \
+                all(m is None for _, m in self._channels):
+            # broadcast fan-out over co-located chips with no per-channel
+            # request mapping: collective lowering applies
+            if done is None:
+                cntl._done_event = threading.Event()
+            return self._call_lowered(service, method, request, cntl, done)
+        if done is None:
+            cntl._done_event = threading.Event()
+
+        sub_cntls: list[Optional[Controller]] = [None] * n
+        results: list[Any] = [None] * n
+        skipped = [False] * n
+        state = {"left": 0, "failed": 0}
+        lock = threading.Lock()
+
+        def finish():
+            fails = state["failed"]
+            if fails > self.fail_limit:
+                first_err = next((c for c in sub_cntls
+                                  if c is not None and c.failed()), None)
+                cntl.set_failed(
+                    errors.ETOOMANYFAILS,
+                    f"{fails}/{n} sub-calls failed"
+                    + (f" (first: E{first_err.error_code} "
+                       f"{first_err.error_text})" if first_err else ""))
+            else:
+                ok = [r for i, r in enumerate(results) if not skipped[i]
+                      and sub_cntls[i] is not None
+                      and not sub_cntls[i].failed()]
+                try:
+                    cntl.response = self.response_merger.merge(ok)
+                except Exception as e:
+                    cntl.set_failed(errors.ERESPONSE, f"merge failed: {e}")
+            if done is not None:
+                done(cntl)
+            if cntl._done_event is not None:
+                cntl._done_event.set()
+
+        # map first so skips don't count toward `left`
+        mapped: list[Optional[SubCall]] = []
+        for i, (ch, mapper) in enumerate(self._channels):
+            m = (mapper or self.call_mapper).map(i, n, request)
+            if m is None or m.skip:
+                skipped[i] = True
+                mapped.append(None)
+            else:
+                mapped.append(m)
+                state["left"] += 1
+        if state["left"] == 0:
+            cntl.set_failed(errors.ENODATA, "all sub-calls skipped")
+            if done:
+                done(cntl)
+            if cntl._done_event is not None:
+                cntl._done_event.set()
+            return cntl
+
+        def make_done(i):
+            def _done(sub):
+                with lock:
+                    if sub.failed():
+                        state["failed"] += 1
+                    else:
+                        results[i] = sub.response
+                    state["left"] -= 1
+                    last = state["left"] == 0
+                if last:
+                    finish()
+            return _done
+
+        for i, (ch, _mapper) in enumerate(self._channels):
+            if skipped[i]:
+                continue
+            sub = Controller(timeout_ms=cntl.timeout_ms,
+                             max_retry=cntl.max_retry)
+            sub_cntls[i] = sub
+            ch.call(service, method, mapped[i].request, cntl=sub,
+                    serializer=serializer, done=make_done(i))
+        return cntl
+
+    def call_sync(self, service: str, method: str, request: Any = b"",
+                  serializer: str = "raw", **kw) -> Any:
+        cntl = self.call(service, method, request, serializer=serializer, **kw)
+        cntl.join()
+        cntl.raise_if_failed()
+        return cntl.response
+
+
+class SelectiveChannel:
+    """Retries a different sub-channel on failure; its own LB over
+    sub-channels (selective_channel.h:52-69)."""
+
+    def __init__(self, max_retry: int = 3):
+        self._channels: list[Channel] = []
+        self.max_retry = max_retry
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def add_channel(self, channel: Channel) -> "SelectiveChannel":
+        self._channels.append(channel)
+        return self
+
+    def _pick(self, exclude: set[int]) -> Optional[int]:
+        with self._lock:
+            n = len(self._channels)
+            for _ in range(n):
+                i = self._counter % n
+                self._counter += 1
+                if i not in exclude:
+                    return i
+        return None
+
+    def call_sync(self, service: str, method: str, request: Any = b"",
+                  serializer: str = "raw", cntl: Controller | None = None) -> Any:
+        if not self._channels:
+            raise errors.RpcError(errors.ENODATA, "no sub-channels")
+        tried: set[int] = set()
+        last: Exception | None = None
+        max_retry = cntl.max_retry if cntl is not None and \
+            cntl.max_retry is not None else self.max_retry
+        for _ in range(min(max_retry + 1, len(self._channels))):
+            i = self._pick(tried)
+            if i is None:
+                break
+            tried.add(i)
+            sub = Controller(timeout_ms=cntl.timeout_ms if cntl else None)
+            try:
+                resp = self._channels[i].call_sync(
+                    service, method, request, serializer=serializer,
+                    cntl=sub)
+                if cntl is not None:
+                    # callers follow the Channel contract: results land on
+                    # the controller they passed in
+                    cntl.reset_for_retry()
+                    cntl.response = sub.response
+                    cntl.response_attachment = sub.response_attachment
+                    cntl.remote_side = sub.remote_side
+                    cntl.latency_us = sub.latency_us
+                    cntl.retried_count = len(tried) - 1
+                return resp
+            except errors.RpcError as e:
+                last = e
+                if cntl is not None:
+                    cntl.set_failed(sub.error_code, sub.error_text)
+                    cntl.remote_side = sub.remote_side
+                    cntl.retried_count = len(tried) - 1
+                continue
+        raise last or errors.RpcError(errors.ETOOMANYFAILS)
+
+
+class PartitionParser:
+    """tag -> (partition_index, partition_count), e.g. "2/8" like the
+    reference's "N/M" scheme (partition_channel.h)."""
+
+    def parse(self, tag: str) -> Optional[tuple[int, int]]:
+        try:
+            idx, _, cnt = tag.partition("/")
+            return int(idx), int(cnt)
+        except ValueError:
+            return None
+
+
+class PartitionChannel:
+    """One channel per partition, built from ONE naming service whose nodes
+    carry partition tags; call() fans out one sub-request per partition via
+    a CallMapper that receives the partition index."""
+
+    def __init__(self, partition_count: int,
+                 call_mapper: CallMapper | None = None,
+                 response_merger: ResponseMerger | None = None,
+                 fail_limit: int = 0):
+        self.partition_count = partition_count
+        self._parallel = ParallelChannel(fail_limit, call_mapper,
+                                         response_merger)
+        self._partitions: dict[int, Channel] = {}
+
+    def init(self, naming_url: str, load_balancer: str = "rr",
+             parser: PartitionParser | None = None,
+             options: ChannelOptions | None = None) -> "PartitionChannel":
+        from brpc_tpu.policy.load_balancer import create_load_balancer
+        from brpc_tpu.policy.naming import (NamingServiceFilter,
+                                            start_naming_service)
+        parser = parser or PartitionParser()
+
+        class _PartFilter(NamingServiceFilter):
+            def __init__(self, idx, count):
+                self.idx = idx
+                self.count = count
+
+            def accept(self, node):
+                p = parser.parse(node.tag)
+                return p is not None and p[0] == self.idx and \
+                    p[1] == self.count
+
+        for idx in range(self.partition_count):
+            lb = create_load_balancer(load_balancer)
+            start_naming_service(naming_url, lb,
+                                 _PartFilter(idx, self.partition_count))
+            ch = Channel(options=options or ChannelOptions())
+            ch._lb = lb
+            self._partitions[idx] = ch
+            self._parallel.add_channel(ch)
+        return self
+
+    def call(self, *a, **kw):
+        return self._parallel.call(*a, **kw)
+
+    def call_sync(self, *a, **kw):
+        return self._parallel.call_sync(*a, **kw)
+
+    @property
+    def channel_count(self):
+        return self._parallel.channel_count

@@ -63,7 +63,8 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
       const int one = 1;
       setsockopt(opts.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     }
-    if (EventDispatcher::GetDispatcher(opts.fd)->AddConsumer(s->_id, opts.fd) != 0) {
+    if (!opts.defer_register &&
+        EventDispatcher::GetDispatcher(opts.fd)->AddConsumer(s->_id, opts.fd) != 0) {
       SetFailed(s->_id, errno);
       return -1;
     }
@@ -346,9 +347,16 @@ void Socket::DoAcceptLoop() {
     SocketOptions copts = _opts;
     copts.fd = fd;
     copts.is_listener = false;
+    copts.defer_register = true;
     SocketId cid;
-    if (Socket::Create(copts, &cid) == 0 && _opts.on_accepted != nullptr) {
+    if (Socket::Create(copts, &cid) != 0) continue;
+    // Callback BEFORE the fd can generate events: the consumer registers
+    // its handler for cid here, so the first message can't outrun it.
+    if (_opts.on_accepted != nullptr) {
       _opts.on_accepted(_id, cid, _opts.user);
+    }
+    if (EventDispatcher::GetDispatcher(fd)->AddConsumer(cid, fd) != 0) {
+      Socket::SetFailed(cid, errno);
     }
   }
 }

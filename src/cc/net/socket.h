@@ -54,6 +54,11 @@ struct SocketOptions {
   // Echo TRPC frames back in native code without surfacing to the callback
   // (benchmark fast path; models a native service implementation).
   bool native_echo = false;
+  // Don't register with the dispatcher inside Create; the caller will.
+  // Accepted sockets need this: their on_accepted callback must run before
+  // any IO event can fire (the fd may land on a DIFFERENT dispatcher thread,
+  // which would otherwise race handler registration with the first message).
+  bool defer_register = false;
 };
 
 struct WriteRequest {

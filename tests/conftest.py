@@ -8,12 +8,23 @@ pod is needed.  Real-chip benchmarks live in bench.py, not here.
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment selects the real TPU
+# (JAX_PLATFORMS=axon): tests validate sharding logic on the virtual
+# 8-device mesh; bench.py uses the real chip.  jax may already be imported
+# by site hooks, so set BOTH the env vars (for a fresh import) and the
+# config (for an existing import) before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    "tests need the 8-device virtual CPU mesh; a jax backend was "
+    "initialized before conftest could configure it")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

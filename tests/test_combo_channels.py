@@ -1,0 +1,144 @@
+"""Combo channel tests (analog of the parallel/selective/partition parts of
+brpc_channel_unittest, SURVEY.md §4)."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+
+
+class Part(brpc.Service):
+    NAME = "Part"
+
+    def __init__(self, tag, fail=False):
+        self._tag = tag
+        self._fail = fail
+
+    @brpc.method(request="json", response="json")
+    def Q(self, cntl, req):
+        if self._fail:
+            cntl.set_failed(errors.EINTERNAL, "down")
+            return None
+        return {"part": self._tag, "got": req}
+
+
+def _start(tag, fail=False):
+    s = brpc.Server()
+    s.add_service(Part(tag, fail))
+    s.start("127.0.0.1", 0)
+    return s
+
+
+class TestParallelChannel:
+    def test_broadcast_and_merge(self):
+        servers = [_start(f"p{i}") for i in range(3)]
+        try:
+            pc = brpc.ParallelChannel()
+            for s in servers:
+                pc.add_channel(brpc.Channel(f"127.0.0.1:{s.port}",
+                                            timeout_ms=5000))
+            resp = pc.call_sync("Part", "Q", {"k": 1}, serializer="json")
+            assert sorted(r["part"] for r in resp) == ["p0", "p1", "p2"]
+            assert all(r["got"] == {"k": 1} for r in resp)
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_call_mapper_slices_request(self):
+        servers = [_start(f"p{i}") for i in range(2)]
+        try:
+            class Slicer(brpc.CallMapper):
+                def map(self, i, n, request):
+                    return brpc.SubCall({"slice": request["items"][i::n]})
+
+            pc = brpc.ParallelChannel(call_mapper=Slicer())
+            for s in servers:
+                pc.add_channel(brpc.Channel(f"127.0.0.1:{s.port}",
+                                            timeout_ms=5000))
+            resp = pc.call_sync("Part", "Q", {"items": [0, 1, 2, 3]},
+                                serializer="json")
+            slices = sorted(tuple(r["got"]["slice"]) for r in resp)
+            assert slices == [(0, 2), (1, 3)]
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_fail_limit(self):
+        ok = _start("ok")
+        bad = _start("bad", fail=True)
+        try:
+            strict = brpc.ParallelChannel(fail_limit=0)
+            strict.add_channel(brpc.Channel(f"127.0.0.1:{ok.port}",
+                                            timeout_ms=5000))
+            strict.add_channel(brpc.Channel(f"127.0.0.1:{bad.port}",
+                                            timeout_ms=5000))
+            with pytest.raises(errors.RpcError) as ei:
+                strict.call_sync("Part", "Q", {}, serializer="json")
+            assert ei.value.code == errors.ETOOMANYFAILS
+
+            tolerant = brpc.ParallelChannel(fail_limit=1)
+            tolerant.add_channel(brpc.Channel(f"127.0.0.1:{ok.port}",
+                                              timeout_ms=5000))
+            tolerant.add_channel(brpc.Channel(f"127.0.0.1:{bad.port}",
+                                              timeout_ms=5000))
+            resp = tolerant.call_sync("Part", "Q", {}, serializer="json")
+            assert len(resp) == 1 and resp[0]["part"] == "ok"
+        finally:
+            for s in (ok, bad):
+                s.stop()
+                s.join()
+
+
+class TestSelectiveChannel:
+    def test_skips_dead_subchannel(self):
+        alive = _start("alive")
+        try:
+            sc = brpc.SelectiveChannel(max_retry=3)
+            sc.add_channel(brpc.Channel("127.0.0.1:1", timeout_ms=400,
+                                        max_retry=0))
+            sc.add_channel(brpc.Channel(f"127.0.0.1:{alive.port}",
+                                        timeout_ms=5000))
+            for _ in range(4):
+                r = sc.call_sync("Part", "Q", {}, serializer="json")
+                assert r["part"] == "alive"
+        finally:
+            alive.stop()
+            alive.join()
+
+
+class TestPartitionChannel:
+    def test_partition_fanout(self):
+        servers = [_start(f"shard{i}") for i in range(2)]
+        try:
+            addr = ",".join(
+                f"127.0.0.1:{s.port}" for s in servers)
+            # tag servers as partitions 0/2 and 1/2 via a list file
+            import tempfile, os
+            with tempfile.NamedTemporaryFile("w", suffix=".list",
+                                             delete=False) as f:
+                f.write(f"127.0.0.1:{servers[0].port} 0/2\n")
+                f.write(f"127.0.0.1:{servers[1].port} 1/2\n")
+                path = f.name
+
+            class KeyMapper(brpc.CallMapper):
+                def map(self, i, n, request):
+                    return brpc.SubCall({"partition": i,
+                                         "keys": request["keys"][i::n]})
+
+            pc = brpc.PartitionChannel(2, call_mapper=KeyMapper())
+            pc.init(f"file://{path}",
+                    options=brpc.ChannelOptions(timeout_ms=5000))
+            resp = pc.call_sync("Part", "Q", {"keys": list(range(6))},
+                                serializer="json")
+            assert len(resp) == 2
+            tags = sorted(r["part"] for r in resp)
+            assert tags == ["shard0", "shard1"]
+            os.unlink(path)
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()

@@ -1,0 +1,150 @@
+"""ICI transport tests on the virtual 8-device CPU mesh (SURVEY.md §4:
+single-host multi-device plays the role 127.0.0.1 plays in the reference).
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+import brpc_tpu as brpc
+from brpc_tpu.ici import (BlockPool, CollectiveGroup, IciChannel,
+                          IciEndpoint, TensorStream, get_block_pool,
+                          get_mesh, link_stats, register_device_service)
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+    mesh = get_mesh()
+    assert mesh.shape["chip"] == 8
+
+
+class TestBlockPool:
+    def test_alloc_classes_and_roundtrip(self):
+        pool = get_block_pool()
+        b = pool.alloc(5000)
+        assert b.nbytes == 8 * 1024
+        data = bytes(range(256)) * 16
+        b.put(data)
+        assert b.get() == data
+        b.free()
+        big = pool.alloc(100_000)
+        assert big.nbytes == 2 * 1024 * 1024
+        big.free()
+
+    def test_exhaustion_and_stats(self):
+        pool = BlockPool()
+        blocks = [pool.alloc(1024) for _ in range(64)]
+        # 8KB class is exhausted; next alloc takes the 64KB class
+        nxt = pool.alloc(1024)
+        assert nxt.nbytes == 64 * 1024
+        st = pool.stats()
+        assert st["classes"]["8192"]["free"] == 0
+        for b in blocks:
+            b.free()
+        nxt.free()
+        assert pool.stats()["classes"]["8192"]["free"] == 64
+
+
+class TestEndpointAndStream:
+    def test_send_between_devices(self):
+        dev = jax.devices()[1]
+        ep = IciEndpoint(dev)
+        x = jnp.arange(1024, dtype=jnp.float32)
+        y = ep.send_sync(x)
+        assert y.devices() == {dev}
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        assert ep.inflight_bytes == 0
+
+    def test_window_backpressure(self):
+        dev = jax.devices()[2]
+        ep = IciEndpoint(dev, window_bytes=1024)
+        with pytest.raises(TimeoutError):
+            # single send larger than the whole window can never fit
+            ep.send(jnp.zeros(4096, jnp.uint8), timeout_s=0.2)
+
+    def test_tensor_stream_ordered(self):
+        dev = jax.devices()[3]
+        got = []
+        ts = TensorStream(dev, consumer=lambda a: got.append(int(a[0])))
+        for i in range(20):
+            ts.write(jnp.full((256,), i, jnp.int32))
+        ts.close(wait=True)
+        assert got == list(range(20))
+
+    def test_link_stats_exported(self):
+        st = link_stats()
+        assert st["send_count"] > 0
+        assert len(st["devices"]) == 8
+
+
+class TestCollective:
+    def test_parallel_apply_stack_and_sum(self):
+        g = CollectiveGroup()
+        x = jnp.ones((4, 8), jnp.float32)
+        stacked = g.parallel_apply(lambda t: t * 2, x, merge="stack")
+        assert stacked.shape == (8, 4, 8)
+        np.testing.assert_allclose(np.asarray(stacked), 2.0)
+        summed = g.parallel_apply(lambda t: t * 2, x, merge="sum")
+        assert summed.shape == (4, 8)
+        np.testing.assert_allclose(np.asarray(summed), 16.0)  # 8 chips × 2
+
+    def test_partition_apply(self):
+        g = CollectiveGroup()
+        x = jnp.arange(16, dtype=jnp.float32).reshape(16, 1)
+        out = g.partition_apply(lambda s: s + 100, x, merge="concat")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) + 100)
+
+    def test_ring_shift(self):
+        g = CollectiveGroup()
+        x = jnp.arange(8, dtype=jnp.int32)          # one element per chip
+        y = g.ring_shift(x, steps=1)
+        np.testing.assert_array_equal(np.asarray(y), np.roll(np.arange(8), 1))
+
+    def test_all_gather_reduce_scatter(self):
+        g = CollectiveGroup()
+        x = jnp.arange(8, dtype=jnp.float32)
+        gathered = g.all_gather(x)
+        assert gathered.shape == (8,)
+        red = g.all_reduce(x)
+        # psum over 1-element shards: replicated result, per-shard shape
+        np.testing.assert_allclose(np.asarray(red), [28.0])
+        rs = g.reduce_scatter(x)
+        # every chip contributed the same x: chip i holds 8*x[i]
+        np.testing.assert_allclose(np.asarray(rs),
+                                   8 * np.arange(8, dtype=np.float32))
+
+
+class TestIciChannel:
+    def test_device_service_call(self):
+        register_device_service("MatSvc", "Double", lambda x: x * 2)
+        ch = IciChannel("ici://slice0/3")
+        x = jnp.arange(64, dtype=jnp.float32)
+        y = ch.call_sync("MatSvc", "Double", x)
+        assert y.devices() == {jax.devices()[3]}
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 2)
+
+    def test_unknown_service(self):
+        ch = IciChannel("ici://slice0/0")
+        with pytest.raises(brpc.RpcError) as ei:
+            ch.call_sync("None", "None", jnp.zeros(4))
+        assert ei.value.code == brpc.errors.ENOMETHOD
+
+    def test_parallel_channel_lowering(self):
+        register_device_service("MatSvc", "Square", lambda x: x * x)
+        pc = brpc.ParallelChannel(response_merger=brpc.SumMerger())
+        for i in range(8):
+            pc.add_channel(IciChannel(f"ici://slice0/{i}"))
+        x = jnp.full((4,), 3.0, jnp.float32)
+        out = pc.call_sync("MatSvc", "Square", x)
+        # 8 chips × 9.0 summed via psum
+        np.testing.assert_allclose(np.asarray(out), 72.0)
+
+    def test_parallel_channel_lowering_stack(self):
+        register_device_service("MatSvc", "Inc", lambda x: x + 1)
+        pc = brpc.ParallelChannel()
+        for i in range(8):
+            pc.add_channel(IciChannel(f"ici://slice0/{i}"))
+        out = pc.call_sync("MatSvc", "Inc", jnp.zeros((2,), jnp.float32))
+        assert len(out) == 8
+        np.testing.assert_allclose(np.asarray(out[0]), 1.0)
