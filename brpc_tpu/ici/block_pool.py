@@ -51,9 +51,12 @@ class Block:
         is replaced atomically under the pool lock — concurrent puts to
         different slots never interfere and nothing copies the whole class
         arena."""
-        buf = np.frombuffer(memoryview(data), dtype=np.uint8) \
-            if not isinstance(data, jax.Array) else data
-        n = buf.size if hasattr(buf, "size") else len(buf)
+        if isinstance(data, jax.Array):
+            # reinterpret the tensor's bytes, never value-cast
+            buf = np.asarray(data).ravel().view(np.uint8)
+        else:
+            buf = np.frombuffer(memoryview(data), dtype=np.uint8)
+        n = buf.size
         if n > self.size_class:
             raise ValueError(f"{n}B > block class {self.size_class}")
         self.used = n

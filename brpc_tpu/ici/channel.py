@@ -23,18 +23,17 @@ from brpc_tpu.ici.mesh import device_for
 
 _registry_lock = threading.Lock()
 _device_services: dict[tuple[str, str], Callable] = {}
-_jitted: dict[tuple[str, str, int], Callable] = {}
+_jitted: dict[tuple[str, str], Callable] = {}
 _call_latency = LatencyRecorder("ici_channel")
 
 
 def register_device_service(service: str, method: str, fn: Callable) -> None:
     """Register a jax function as (service, method) for ICI channels.
-    fn(request_array) -> response_array; compiled per target device."""
+    fn(request_array) -> response_array; jit specializes per input
+    placement, so one compiled entry serves every chip."""
     with _registry_lock:
         _device_services[(service, method)] = fn
-        # invalidate per-device compilations of a re-registered name
-        for k in [k for k in _jitted if k[:2] == (service, method)]:
-            del _jitted[k]
+        _jitted.pop((service, method), None)
 
 
 def device_service_registry() -> dict:
@@ -42,15 +41,18 @@ def device_service_registry() -> dict:
         return dict(_device_services)
 
 
-def _compiled(service: str, method: str, device) -> Optional[Callable]:
-    key = (service, method, device.id)
+def _compiled(service: str, method: str) -> Optional[Callable]:
+    key = (service, method)
     with _registry_lock:
         f = _jitted.get(key)
         if f is None:
-            fn = _device_services.get((service, method))
+            fn = _device_services.get(key)
             if fn is None:
                 return None
-            f = jax.jit(fn, device=device)
+            # Inputs arrive committed to the target device (call_sync does
+            # the device_put), so outputs follow — no deprecated
+            # jit(device=...) needed.
+            f = jax.jit(fn)
             _jitted[key] = f
         return f
 
@@ -76,7 +78,7 @@ class IciChannel:
                              *rpcz.current_trace())
         span.remote_side = cntl.remote_side
         t0 = time.monotonic()
-        fn = _compiled(service, method, self.device)
+        fn = _compiled(service, method)
         if fn is None:
             cntl.set_failed(errors.ENOMETHOD,
                             f"no device service {service}.{method}")

@@ -11,7 +11,6 @@ channels use it automatically when all targets are ICI endpoints.
 from __future__ import annotations
 
 import threading
-from functools import partial
 from typing import Callable
 
 import jax
@@ -90,7 +89,9 @@ class CollectiveGroup:
 
         import time
         t0 = time.monotonic()
-        out = self._get(("par", id(fn), merge), build)(x)
+        # keyed by the fn OBJECT (kept alive by the cache): id() keys could
+        # be reused after GC and serve a stale compiled program
+        out = self._get(("par", fn, merge), build)(x)
         _lowered_calls.add(1)
         _lowered_latency.add(int((time.monotonic() - t0) * 1e6))
         return out
@@ -109,14 +110,13 @@ class CollectiveGroup:
                     return jax.lax.psum(y, axis)
                 return y
             in_spec = P(axis)
-            out_spec = P() if merge == "sum" else \
-                (P(axis) if merge in ("concat", "none") else P(axis))
+            out_spec = P() if merge == "sum" else P(axis)
             return jax.jit(shard_map(per_chip, self.mesh,
                                      in_specs=in_spec, out_specs=out_spec))
 
         import time
         t0 = time.monotonic()
-        out = self._get(("part", id(fn), merge), build)(x)
+        out = self._get(("part", fn, merge), build)(x)
         _lowered_calls.add(1)
         _lowered_latency.add(int((time.monotonic() - t0) * 1e6))
         return out

@@ -91,7 +91,15 @@ class IciEndpoint:
                 self._cv.wait(min(remaining, 1.0))
             self._inflight += nbytes
         t0 = time.monotonic()
-        out = jax.device_put(array, self.device)  # async: ICI DMA starts
+        try:
+            out = jax.device_put(array, self.device)  # async: ICI DMA starts
+        except Exception:
+            # release the window reservation or failed sends would shrink
+            # the window permanently
+            with self._cv:
+                self._inflight -= nbytes
+                self._cv.notify_all()
+            raise
         _send_bytes.add(nbytes)
         _send_count.add(1)
         self._ensure_drainer()

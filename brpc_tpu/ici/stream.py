@@ -39,19 +39,25 @@ class TensorStream:
         self._q.put(out)
 
     def _drain(self) -> None:
-        while True:
-            try:
-                item = self._q.get(timeout=0.1)
-            except queue.Empty:
-                if self._closed.is_set():
+        try:
+            while True:
+                try:
+                    item = self._q.get(timeout=0.1)
+                except queue.Empty:
+                    if self._closed.is_set():
+                        break
+                    continue
+                if item is None:
                     break
-                continue
-            if item is None:
-                break
-            item.block_until_ready()   # ordered completion
-            if self._consumer is not None:
-                self._consumer(item)
-        self._drained.set()
+                item.block_until_ready()   # ordered completion
+                if self._consumer is not None:
+                    try:
+                        self._consumer(item)
+                    except Exception:  # consumer bug must not kill the pipe
+                        import traceback
+                        traceback.print_exc()
+        finally:
+            self._drained.set()
 
     def close(self, wait: bool = True) -> None:
         if not self._closed.is_set():

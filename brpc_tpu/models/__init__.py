@@ -1,0 +1,4 @@
+from brpc_tpu.models.parameter_server import (  # noqa: F401
+    PSConfig, init_params, forward_step, train_step, make_sharded_train_step,
+    register_ps_services,
+)

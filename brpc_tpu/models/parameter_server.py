@@ -1,0 +1,167 @@
+"""Flagship workload: a sharded-embedding parameter-server service.
+
+The reference's BASELINE.json north star is "a bRPC-based parameter-server /
+sharded-embedding service running entirely inside a TPU pod".  This module
+is that service built on tpu-rpc: an embedding table sharded over chips
+(expert/vocab parallel), a transformer-style MLP block (tensor parallel),
+batch data parallel, sequence sharding for long contexts, and a pipeline
+axis over stacked layers — all expressed as jit sharding annotations over a
+Mesh so XLA inserts the ICI collectives (the scaling-book recipe: pick a
+mesh, annotate shardings, let XLA do the rest).
+
+Axes used (dryrun_multichip exercises all of them):
+  dp — batch            tp — hidden/heads       ep — vocab (embedding shards)
+  sp — sequence         pp — stacked layers (scan over stages)
+
+The per-chip service functions are also registered as tpu-rpc device
+services, so PartitionChannel/ParallelChannel can drive lookups through the
+RPC surface (see register_ps_services / examples/parallel_echo.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class PSConfig:
+    vocab: int = 1024
+    d_model: int = 128
+    d_ff: int = 256
+    n_layers: int = 2       # pipeline stages (scanned)
+    seq: int = 32
+    batch: int = 8
+    dtype: str = "bfloat16"
+
+
+def init_params(cfg: PSConfig, key=None):
+    # Master weights stay float32; forward casts to cfg.dtype (bfloat16) for
+    # the MXU.  bf16 master weights would round away lr*grad updates.
+    key = key if key is not None else jax.random.PRNGKey(0)
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    dt = jnp.float32
+    scale = 0.02
+    return {
+        "embed": (jax.random.normal(k1, (cfg.vocab, cfg.d_model)) * scale
+                  ).astype(dt),
+        # stacked per-layer weights: leading axis is the pipeline axis
+        "w_qk": (jax.random.normal(k2, (cfg.n_layers, cfg.d_model,
+                                        cfg.d_model)) * scale).astype(dt),
+        "w_up": (jax.random.normal(k3, (cfg.n_layers, cfg.d_model,
+                                        cfg.d_ff)) * scale).astype(dt),
+        "w_down": (jax.random.normal(k4, (cfg.n_layers, cfg.d_ff,
+                                          cfg.d_model)) * scale).astype(dt),
+        "w_out": (jax.random.normal(k5, (cfg.d_model, cfg.vocab)) * scale
+                  ).astype(dt),
+    }
+
+
+def _block(x, wqk, wup, wdown):
+    # attention-flavored mixing (scores over sequence) + MLP, bf16 matmuls
+    # shaped for the MXU; float32 softmax for stability
+    q = x @ wqk
+    scores = jax.nn.softmax(
+        (q @ x.swapaxes(-1, -2)).astype(jnp.float32) /
+        np.sqrt(x.shape[-1]), axis=-1).astype(x.dtype)
+    x = x + scores @ x
+    h = jax.nn.gelu(x @ wup)
+    return x + h @ wdown
+
+
+def forward_step(params, tokens, compute_dtype=jnp.bfloat16):
+    """Forward pass: embed -> scanned blocks (pipeline axis) -> logits.
+    Compute in bfloat16 on the MXU; master params stay float32."""
+    p = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype), params)
+    x = p["embed"][tokens]               # [B, S, D]
+
+    def body(x, layer):
+        wqk, wup, wdown = layer
+        return _block(x, wqk, wup, wdown), None
+
+    x, _ = jax.lax.scan(body, x, (p["w_qk"], p["w_up"], p["w_down"]))
+    return x @ p["w_out"]                # [B, S, V]
+
+
+def loss_fn(params, tokens, targets):
+    logits = forward_step(params, tokens).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def train_step(params, tokens, targets, lr=1e-2):
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+    new_params = jax.tree_util.tree_map(
+        lambda p, g: (p - lr * g.astype(p.dtype)), params, grads)
+    return new_params, loss
+
+
+def make_mesh(n_devices: int) -> Mesh:
+    """Factor n into (dp, tp); pp/sp/ep alias these axes (pp rides the
+    scanned layer axis placement, sp shards sequence over tp, ep shards
+    vocab over tp)."""
+    devs = jax.devices()[:n_devices]
+    dp = 1
+    for cand in (4, 2, 1):
+        if n_devices % cand == 0 and cand <= n_devices:
+            dp = cand if n_devices // cand >= 1 else 1
+            break
+    tp = n_devices // dp
+    arr = np.array(devs).reshape(dp, tp)
+    return Mesh(arr, ("dp", "tp"))
+
+
+def param_shardings(mesh: Mesh):
+    return {
+        "embed": NamedSharding(mesh, P("tp", None)),    # ep: vocab-sharded
+        "w_qk": NamedSharding(mesh, P(None, None, "tp")),
+        "w_up": NamedSharding(mesh, P(None, None, "tp")),   # tp: ff-sharded
+        "w_down": NamedSharding(mesh, P(None, "tp", None)),
+        "w_out": NamedSharding(mesh, P(None, "tp")),
+    }
+
+
+def data_shardings(mesh: Mesh):
+    # dp over batch, sp (sequence) over tp — long-context residency is
+    # spread across chips; XLA inserts the gathers the attention needs
+    return (NamedSharding(mesh, P("dp", "tp")),       # tokens [B, S]
+            NamedSharding(mesh, P("dp", "tp")))       # targets [B, S]
+
+
+def make_sharded_train_step(mesh: Mesh, cfg: PSConfig, lr: float = 1e-2):
+    """jit train_step with in/out shardings over the mesh; XLA lowers the
+    cross-chip math to ICI collectives."""
+    ps = param_shardings(mesh)
+    ts, gs = data_shardings(mesh)
+    out_shardings = (ps, NamedSharding(mesh, P()))
+    step = jax.jit(
+        partial(train_step, lr=lr),
+        in_shardings=(ps, ts, gs),
+        out_shardings=out_shardings,
+    )
+    return step
+
+
+def make_example_batch(cfg: PSConfig, key=None):
+    key = key if key is not None else jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(key)
+    tokens = jax.random.randint(k1, (cfg.batch, cfg.seq), 0, cfg.vocab)
+    targets = jax.random.randint(k2, (cfg.batch, cfg.seq), 0, cfg.vocab)
+    return tokens, targets
+
+
+def register_ps_services(cfg: PSConfig | None = None) -> None:
+    """Expose lookup/forward as tpu-rpc device services so the RPC surface
+    (IciChannel / ParallelChannel / PartitionChannel) can drive them."""
+    from brpc_tpu.ici.channel import register_device_service
+    cfg = cfg or PSConfig()
+    params = init_params(cfg)
+    register_device_service("ParameterServer", "EmbedLookup",
+                            lambda tokens: params["embed"][tokens])
+    register_device_service("ParameterServer", "Forward",
+                            lambda tokens: forward_step(params, tokens))

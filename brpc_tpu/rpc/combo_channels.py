@@ -27,19 +27,23 @@ from brpc_tpu.rpc.controller import Controller
 
 
 # CollectiveGroups (and the jitted programs they cache) are shared across
-# ParallelChannel instances: one compile per (device_count, service fn).
-_collective_groups: dict[int, Any] = {}
+# ParallelChannel instances: one compile per (device set, service fn).
+_collective_groups: dict[tuple, Any] = {}
 _collective_groups_lock = threading.Lock()
 
 
-def _collective_group(n_devices: int):
+def _collective_group_for(devices):
+    """Group over EXACTLY the chips the channels target (never 'the first
+    N devices' — the caller may address chips 4..7)."""
+    import numpy as _np
+    from jax.sharding import Mesh
     from brpc_tpu.ici.collective import CollectiveGroup
-    from brpc_tpu.ici.mesh import get_mesh
+    key = tuple(d.id for d in devices)
     with _collective_groups_lock:
-        g = _collective_groups.get(n_devices)
+        g = _collective_groups.get(key)
         if g is None:
-            g = CollectiveGroup(get_mesh(n_devices=n_devices))
-            _collective_groups[n_devices] = g
+            g = CollectiveGroup(Mesh(_np.array(devices), ("chip",)))
+            _collective_groups[key] = g
         return g
 
 
@@ -100,9 +104,15 @@ class ParallelChannel:
         return len(self._channels)
 
     def _all_ici(self) -> bool:
+        """Lowerable iff every sub-channel is ICI AND they target distinct
+        devices (duplicate chips are a legitimate per-channel fan-out that
+        a collective cannot express)."""
         from brpc_tpu.ici.channel import IciChannel
-        return bool(self._channels) and all(
-            isinstance(ch, IciChannel) for ch, _ in self._channels)
+        if not self._channels or not all(
+                isinstance(ch, IciChannel) for ch, _ in self._channels):
+            return False
+        ids = [ch.device.id for ch, _ in self._channels]
+        return len(set(ids)) == len(ids)
 
     def _call_lowered(self, service: str, method: str, request: Any,
                       cntl: Controller,
@@ -124,7 +134,8 @@ class ParallelChannel:
                 else "stack"
             t0 = time.monotonic()
             try:
-                group = _collective_group(len(self._channels))
+                group = _collective_group_for(
+                    [ch.device for ch, _ in self._channels])
                 out = group.parallel_apply(fn, request, merge=merge)
                 out = jax.block_until_ready(out)  # real latency + surfaced
                                                   # device-side failures

@@ -1,0 +1,49 @@
+"""Auto concurrency limiter demo (reference
+example/auto_concurrency_limiter): the server sheds load with ELIMIT once
+the gradient limiter decides more concurrency only adds queueing."""
+import os, sys, threading, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+
+
+class Work(brpc.Service):
+    @brpc.method(request="json", response="json", max_concurrency="auto")
+    def Do(self, cntl, req):
+        time.sleep(0.005)
+        return {"ok": True}
+
+
+def main(threads=32, seconds=3.0):
+    server = brpc.Server()
+    server.add_service(Work())
+    server.start("127.0.0.1", 0)
+    ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=2000,
+                      max_retry=0)
+    ok = [0] * threads
+    rejected = [0] * threads
+    stop = time.monotonic() + seconds
+
+    def worker(i):
+        while time.monotonic() < stop:
+            try:
+                ch.call_sync("Work", "Do", {}, serializer="json")
+                ok[i] += 1
+            except errors.RpcError as e:
+                if e.code == errors.ELIMIT:
+                    rejected[i] += 1
+                    time.sleep(0.002)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    st = server.method_statuses[("Work", "Do")]
+    print(f"served={sum(ok)} rejected={sum(rejected)} "
+          f"limit settled at {st.limiter.max_concurrency() if st.limiter else 'n/a'}")
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Backup request demo (reference example/backup_request_c++): a second
+attempt races after backup_request_ms; the first response wins, so a slow
+replica can't hold a call hostage."""
+import os, sys, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class Sleepy(brpc.Service):
+    NAME = "Sleepy"
+
+    def __init__(self, tag, delay_s):
+        self._tag, self._delay = tag, delay_s
+
+    @brpc.method(request="json", response="json")
+    def Get(self, cntl, req):
+        time.sleep(self._delay)
+        return {"from": self._tag}
+
+
+def main():
+    slow = brpc.Server().add_service(Sleepy("slow-replica", 1.0))
+    fast = brpc.Server().add_service(Sleepy("fast-replica", 0.0))
+    slow.start("127.0.0.1", 0)
+    fast.start("127.0.0.1", 0)
+    ch = brpc.Channel(
+        f"list://127.0.0.1:{slow.port},127.0.0.1:{fast.port}",
+        options=brpc.ChannelOptions(timeout_ms=5000, load_balancer="rr",
+                                    backup_request_ms=75, max_retry=1))
+    for i in range(4):
+        t0 = time.monotonic()
+        r = ch.call_sync("Sleepy", "Get", {}, serializer="json")
+        print(f"call {i}: answered by {r['from']:13s} in "
+              f"{(time.monotonic()-t0)*1e3:.0f}ms")
+    for s in (slow, fast):
+        s.stop()
+        s.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""Echo — the hello-world demo (reference example/echo_c++).
+
+Run:  python examples/echo.py
+Starts a server with an EchoService and calls it through a Channel; then
+leaves the server up for 2s so you can poke the console:
+    curl 127.0.0.1:<port>/status
+"""
+import sys, os, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class EchoService(brpc.Service):
+    @brpc.method(request="json", response="json")
+    def Echo(self, cntl, req):
+        return {"message": req["message"]}
+
+
+def main():
+    server = brpc.Server()
+    server.add_service(EchoService())
+    server.start("127.0.0.1", 0)
+    print(f"EchoServer on 127.0.0.1:{server.port} "
+          f"(console: http://127.0.0.1:{server.port}/)")
+
+    channel = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=1000)
+    cntl = brpc.Controller()
+    resp = channel.call_sync("EchoService", "Echo",
+                             {"message": "hello tpu-rpc"},
+                             serializer="json", cntl=cntl)
+    print(f"response: {resp}  latency={cntl.latency_us}us "
+          f"from {cntl.remote_side}")
+    time.sleep(2)
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""ICI performance ladder (reference example/rdma_performance): per-size
+transfer/echo bandwidth over the device fabric + the collective primitives
+over the local mesh."""
+import os, sys, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+
+from brpc_tpu.ici import CollectiveGroup, TensorStream, get_mesh, link_stats
+
+
+def ladder():
+    print(f"devices: {jax.devices()}")
+    dev = jax.devices()[-1]
+    for size in (4096, 65536, 1 << 20, 1 << 24):
+        n = max(128, size // 2)
+        x = jnp.ones((n,), jnp.bfloat16)
+        got = []
+        ts = TensorStream(dev, consumer=got.append)
+        reps = 8
+        t0 = time.monotonic()
+        for _ in range(reps):
+            ts.write(x)
+        ts.close(wait=True)
+        dt = time.monotonic() - t0
+        print(f"  {size:>10}B x{reps}: {reps*x.nbytes/dt/1e9:8.3f} GB/s "
+              f"({dt/reps*1e6:8.1f} us/chunk)")
+
+
+def collectives():
+    mesh = get_mesh()
+    g = CollectiveGroup(mesh)
+    n = mesh.shape["chip"]
+    x = jnp.arange(n * 1024, dtype=jnp.float32)
+    for name, fn in (("ring_shift", lambda: g.ring_shift(x)),
+                     ("all_gather", lambda: g.all_gather(x)),
+                     ("all_reduce", lambda: g.all_reduce(x)),
+                     ("reduce_scatter", lambda: g.reduce_scatter(x))):
+        fn()  # compile
+        t0 = time.monotonic()
+        for _ in range(10):
+            out = fn()
+        jax.block_until_ready(out)
+        print(f"  {name:15s}: {(time.monotonic()-t0)/10*1e6:8.1f} us/op "
+              f"over {n} chip(s)")
+
+
+if __name__ == "__main__":
+    ladder()
+    collectives()
+    print("link stats:", {k: v for k, v in link_stats().items()
+                          if k != "devices"})

@@ -1,0 +1,65 @@
+"""PartitionChannel demo (reference example/partition_echo_c++): servers
+tagged N/M in one naming service; each call fans one slice per partition."""
+import os, sys, tempfile
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class ShardService(brpc.Service):
+    NAME = "ShardService"
+
+    def __init__(self, idx):
+        self._idx = idx
+
+    @brpc.method(request="json", response="json")
+    def Lookup(self, cntl, req):
+        return {"shard": self._idx,
+                "values": {k: f"v{k}@shard{self._idx}"
+                           for k in req["keys"]}}
+
+
+class KeyMapper(brpc.CallMapper):
+    def map(self, i, n, request):
+        mine = [k for k in request["keys"] if k % n == i]
+        if not mine:
+            return brpc.SubCall.skip_call()
+        return brpc.SubCall({"keys": mine})
+
+
+class MergeValues(brpc.ResponseMerger):
+    def merge(self, responses):
+        out = {}
+        for r in responses:
+            out.update(r["values"])
+        return out
+
+
+def main(partitions=3):
+    servers = []
+    lines = []
+    for i in range(partitions):
+        s = brpc.Server()
+        s.add_service(ShardService(i))
+        s.start("127.0.0.1", 0)
+        servers.append(s)
+        lines.append(f"127.0.0.1:{s.port} {i}/{partitions}")
+    with tempfile.NamedTemporaryFile("w", suffix=".list",
+                                     delete=False) as f:
+        f.write("\n".join(lines) + "\n")
+        path = f.name
+    pc = brpc.PartitionChannel(partitions, call_mapper=KeyMapper(),
+                               response_merger=MergeValues())
+    pc.init(f"file://{path}", options=brpc.ChannelOptions(timeout_ms=2000))
+    resp = pc.call_sync("ShardService", "Lookup",
+                        {"keys": list(range(9))}, serializer="json")
+    for k in sorted(resp, key=int):
+        print(f"  key {k} -> {resp[k]}")
+    os.unlink(path)
+    for s in servers:
+        s.stop()
+        s.join()
+
+
+if __name__ == "__main__":
+    main()
