@@ -42,6 +42,7 @@ T_STREAM_ID = 13
 T_STREAM_OFFSET = 14
 T_TENSOR_HEADER = 15
 T_AUTH = 16
+T_STREAM_SEQ = 17
 
 COMPRESS_NONE = 0
 COMPRESS_GZIP = 1
@@ -68,6 +69,7 @@ class RpcMeta:
     content_type: str = ""
     stream_id: int = 0
     stream_offset: int = 0
+    stream_seq: int = 0
     tensor_header: bytes = b""
     auth: bytes = b""
     user_fields: dict = field(default_factory=dict)
@@ -106,6 +108,8 @@ class RpcMeta:
             tlv(T_STREAM_ID, struct.pack("<Q", self.stream_id))
         if self.stream_offset:
             tlv(T_STREAM_OFFSET, struct.pack("<Q", self.stream_offset))
+        if self.stream_seq:
+            tlv(T_STREAM_SEQ, struct.pack("<Q", self.stream_seq))
         if self.tensor_header:
             tlv(T_TENSOR_HEADER, self.tensor_header)
         if self.auth:
@@ -160,6 +164,8 @@ class RpcMeta:
                 m.stream_id = struct.unpack("<Q", p)[0]
             elif tag == T_STREAM_OFFSET:
                 m.stream_offset = struct.unpack("<Q", p)[0]
+            elif tag == T_STREAM_SEQ:
+                m.stream_seq = struct.unpack("<Q", p)[0]
             elif tag == T_TENSOR_HEADER:
                 m.tensor_header = p
             elif tag == T_AUTH:

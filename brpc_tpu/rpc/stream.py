@@ -5,8 +5,10 @@ Reference: stream.{h,cpp}, stream_impl.h, policy/streaming_rpc_protocol.cpp
 the request meta, accepted server-side), then DATA frames flow with a
 sliding window — the writer blocks once `produced - remote_consumed` exceeds
 the buffer; the consumer sends CONSUMED feedback frames that advance the
-window.  Per-stream delivery is ordered (frames ride one TCP socket and the
-native core preserves arrival order per connection).
+window.  Frames ARRIVE in order (one TCP socket per connection) but the
+native core dispatches each parsed message onto the work-stealing executor,
+so handler dispatch may be reordered — the stream_seq/reorder layer below
+restores write order (the reference's per-stream ExecutionQueue).
 
 This same credit loop is what the ICI transport reuses for HBM→HBM tensor
 streaming (brpc_tpu/ici/stream.py).
@@ -14,6 +16,7 @@ streaming (brpc_tpu/ici/stream.py).
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 from typing import Callable, Optional
 
@@ -77,8 +80,20 @@ class Stream:
         self._remote_consumed = 0
         self._consumed_local = 0                 # receiver side
         self._last_feedback = 0
-        self._pending: list[bytes] = []          # writes before binding
+        self._pending: list[tuple[int, bytes]] = []  # writes before binding
         self._closed = False
+        self._close_sent = False
+        # Ordered delivery (the reference's per-stream ExecutionQueue,
+        # stream_impl.h:133): our native core dispatches each parsed message
+        # onto the work-stealing executor, so DATA frames for one stream may
+        # be PROCESSED out of order even though they ARRIVE in order.  The
+        # writer numbers frames (stream_seq, 1-based) and the receiver
+        # reorders + serializes handler delivery with a drain loop.
+        self._send_seq = 1
+        self._recv_next = 1
+        self._reorder: dict[int, bytes] = {}
+        self._close_seq: Optional[int] = None
+        self._delivering = False
 
     # ---- binding (the RPC established the host connection) ----
 
@@ -97,8 +112,8 @@ class Stream:
             if self._sid is None or self.remote_id is None:
                 return
             pending, self._pending = self._pending, []
-        for data in pending:
-            self._send_data(data)
+        for seq, data in pending:
+            self._send_data(data, seq)
 
     @property
     def connected(self) -> bool:
@@ -134,25 +149,85 @@ class Stream:
                         f"stream window full ({self.max_buf_size}B)")
                 self._window_cv.wait(min(remaining, 1.0))
             self._produced += len(data)
+            seq = self._send_seq
+            self._send_seq += 1
             if self._sid is None or self.remote_id is None:
-                self._pending.append(data)
+                self._pending.append((seq, data))
                 return
-        self._send_data(data)
+        self._send_data(data, seq)
 
-    def _send_data(self, data: bytes) -> None:
+    def _send_data(self, data: bytes, seq: int) -> None:
         meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
-                         stream_id=self.remote_id)
+                         stream_id=self.remote_id, stream_seq=seq)
         rc = Transport.instance().write_frame(self._sid, meta.encode(), data)
         if rc != 0:
             self._on_closed_internal()
 
     # ---- receiver side ----
 
-    def _on_data(self, data: bytes) -> None:
-        if self.handler is not None:
-            self.handler.on_received_messages(self, [data])
+    def _on_data(self, data: bytes, seq: int) -> None:
+        if seq == 0:
+            # unsequenced peer (pre-stream_seq wire format): deliver in
+            # arrival order, mirroring the seq==0 CLOSE fallback
+            if self.handler is not None:
+                try:
+                    self.handler.on_received_messages(self, [data])
+                except Exception:
+                    logging.exception("stream handler raised")
+            self._ack(len(data))
+            return
         with self._mu:
-            self._consumed_local += len(data)
+            self._reorder[seq] = data
+        self._drain()
+
+    def _on_close_frame(self, seq: int) -> None:
+        if seq == 0:
+            # pre-stream_seq peer compat — immediate close
+            self._on_closed_internal()
+            return
+        with self._mu:
+            # min(): a duplicate CLOSE with a higher seq must not raise the
+            # latch past what data seqs can ever satisfy
+            if self._close_seq is None or seq < self._close_seq:
+                self._close_seq = seq
+        self._drain()
+
+    def _drain(self) -> None:
+        """Deliver consecutive frames; only one thread drains at a time
+        (per-stream ExecutionQueue semantics)."""
+        with self._mu:
+            if self._delivering:
+                return
+            self._delivering = True
+        while True:
+            with self._mu:
+                ready: list[bytes] = []
+                while self._recv_next in self._reorder:
+                    ready.append(self._reorder.pop(self._recv_next))
+                    self._recv_next += 1
+                close_now = (self._close_seq is not None
+                             and self._recv_next >= self._close_seq)
+                if not ready and not close_now:
+                    self._delivering = False
+                    return
+            if ready and self.handler is not None:
+                try:
+                    self.handler.on_received_messages(self, ready)
+                except Exception:
+                    # a raising handler must not wedge the drain loop
+                    # (_delivering would stay True forever)
+                    logging.exception("stream handler raised")
+            if ready:
+                self._ack(sum(len(d) for d in ready))
+            if close_now:
+                with self._mu:
+                    self._delivering = False
+                self._on_closed_internal()
+                return
+
+    def _ack(self, nbytes: int) -> None:
+        with self._mu:
+            self._consumed_local += nbytes
             threshold = min(self.max_buf_size,
                             self.peer_buf_size or self.max_buf_size) // 2
             send_feedback = (self._consumed_local - self._last_feedback
@@ -181,11 +256,18 @@ class Stream:
         StreamRegistry.instance().remove(self.stream_id)
 
     def close(self) -> None:
-        if self._closed:
-            return
+        with self._mu:
+            if self._closed or self._close_sent:
+                return
+            self._close_sent = True
         if self._sid is not None and self.remote_id is not None:
+            with self._mu:
+                seq = self._send_seq
+                self._send_seq += 1
+            # sequenced CLOSE: the peer closes only after delivering every
+            # DATA frame written before close()
             meta = M.RpcMeta(msg_type=M.MSG_STREAM_CLOSE,
-                             stream_id=self.remote_id)
+                             stream_id=self.remote_id, stream_seq=seq)
             Transport.instance().write_frame(self._sid, meta.encode())
         self._on_closed_internal()
 
@@ -229,11 +311,11 @@ class StreamRegistry:
         if s._sid is None:
             s.bind(sid)
         if meta.msg_type == M.MSG_STREAM_DATA:
-            s._on_data(body.to_bytes())
+            s._on_data(body.to_bytes(), meta.stream_seq)
         elif meta.msg_type == M.MSG_STREAM_FEEDBACK:
             s._on_feedback(meta.stream_offset)
         elif meta.msg_type == M.MSG_STREAM_CLOSE:
-            s._on_closed_internal()
+            s._on_close_frame(meta.stream_seq)
 
 
 def stream_create(cntl, handler: StreamHandler | Callable | None = None,
