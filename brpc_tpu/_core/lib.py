@@ -14,6 +14,7 @@ import threading
 
 MSG_TRPC = 0
 MSG_HTTP = 1
+MSG_REDIS = 2
 
 _here = os.path.dirname(os.path.abspath(__file__))
 _libpath = os.path.join(_here, "libbrpc_core.so")

@@ -11,4 +11,8 @@ from brpc_tpu.rpc.combo_channels import (  # noqa: F401
     CallMapper, ParallelChannel, PartitionChannel, PartitionParser,
     ResponseMerger, SelectiveChannel, SubCall, SumMerger,
 )
+from brpc_tpu.rpc.redis import (  # noqa: F401
+    MemoryRedisService, RedisChannel, RedisError, RedisPipeline,
+    RedisService,
+)
 from brpc_tpu.rpc import meta  # noqa: F401

@@ -1,0 +1,388 @@
+"""Redis protocol — RESP codec, pipelined client, server-side handlers.
+
+Reference: policy/redis_protocol.cpp (parse/pack), redis_command.cpp /
+redis_reply.cpp (codec), redis.h:192,214 (RedisService/RedisCommandHandler
+— build a redis-speaking server), PipelinedInfo (socket.h:159 — client
+pipelining with FIFO reply matching).
+
+The native core frames one complete RESP value per message (MSG_REDIS,
+src/cc/net/parser.cc) and delivers redis messages INLINE on the socket's
+dispatcher thread: RESP has no correlation ids, so per-connection FIFO
+order is the protocol contract (see Socket::DispatchMessages).  That makes
+client reply matching a simple deque pop, and server replies naturally
+ride out in command order — keep server handlers fast for the same reason.
+
+Python value ↔ RESP mapping:
+  reply encode: str → simple string, bytes → bulk, int → integer,
+                None → null bulk, list/tuple → array, RedisError → error
+  reply decode: + → str, $ → bytes, : → int, $-1/*-1 → None, * → list,
+                - → RedisError instance (raised by call(), returned raw
+                by execute() futures via .result())
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.rpc.transport import MSG_REDIS, Transport
+
+CRLF = b"\r\n"
+
+
+class RedisError(Exception):
+    """An -ERR style reply."""
+
+
+# ---- codec ---------------------------------------------------------------
+
+def encode_command(*args) -> bytes:
+    """RESP array of bulk strings (redis_command.cpp analog)."""
+    if not args:
+        raise ValueError("empty command")
+    parts = [b"*%d\r\n" % len(args)]
+    for a in args:
+        if isinstance(a, str):
+            a = a.encode()
+        elif isinstance(a, (int, float)):
+            a = str(a).encode()
+        elif not isinstance(a, (bytes, bytearray)):
+            raise TypeError(f"bad command arg type {type(a)!r}")
+        parts.append(b"$%d\r\n" % len(a))
+        parts.append(bytes(a))
+        parts.append(CRLF)
+    return b"".join(parts)
+
+
+def encode_reply(value) -> bytes:
+    """Python value → RESP reply bytes (server side)."""
+    if value is None:
+        return b"$-1\r\n"
+    if isinstance(value, RedisError):
+        text = str(value).replace("\r", " ").replace("\n", " ")
+        return b"-" + text.encode() + CRLF
+    if isinstance(value, bool):
+        return b":%d\r\n" % int(value)
+    if isinstance(value, int):
+        return b":%d\r\n" % value
+    if isinstance(value, str):
+        if "\r" in value or "\n" in value:
+            b = value.encode()
+            return b"$%d\r\n" % len(b) + b + CRLF
+        return b"+" + value.encode() + CRLF
+    if isinstance(value, (bytes, bytearray)):
+        return b"$%d\r\n" % len(value) + bytes(value) + CRLF
+    if isinstance(value, (list, tuple)):
+        return b"*%d\r\n" % len(value) + b"".join(encode_reply(v) for v in value)
+    raise TypeError(f"cannot encode reply of type {type(value)!r}")
+
+
+def parse_value(data: bytes, off: int = 0):
+    """Parse one RESP value; returns (value, next_off).
+
+    The native parser guarantees completeness, so truncation here is a
+    protocol error rather than a wait-for-more condition."""
+    nl = data.index(b"\r\n", off)
+    line = data[off:nl]
+    off = nl + 2
+    t = line[:1]
+    if t == b"+":
+        return line[1:].decode(errors="replace"), off
+    if t == b"-":
+        return RedisError(line[1:].decode(errors="replace")), off
+    if t == b":":
+        return int(line[1:]), off
+    if t == b"$":
+        n = int(line[1:])
+        if n < 0:
+            return None, off
+        body = data[off : off + n]
+        if len(body) != n or data[off + n : off + n + 2] != CRLF:
+            raise ValueError("truncated bulk string")
+        return bytes(body), off + n + 2
+    if t == b"*":
+        n = int(line[1:])
+        if n < 0:
+            return None, off
+        out = []
+        for _ in range(n):
+            v, off = parse_value(data, off)
+            out.append(v)
+        return out, off
+    raise ValueError(f"bad RESP type byte {t!r}")
+
+
+# ---- client --------------------------------------------------------------
+
+class RedisChannel:
+    """Pipelined redis client over the native socket core.
+
+    Every execute() appends a Future to the pending deque and writes the
+    command under one lock, so reply matching is strict FIFO — the same
+    invariant PipelinedInfo maintains in the reference (socket.h:159)."""
+
+    def __init__(self, address: str, timeout_ms: int = 1000,
+                 password: Optional[str] = None):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.timeout_ms = timeout_ms
+        self._mu = threading.Lock()
+        self._pending: deque[Future] = deque()
+        self._sid: Optional[int] = None
+        self._password = password
+
+    # connection is lazy so a channel can be created before the server is up
+    def _ensure_connected(self) -> int:
+        with self._mu:
+            if self._sid is not None and Transport.instance().alive(self._sid):
+                return self._sid
+            # connection died: fail anything still pending on it
+            self._fail_pending_locked(errors.EFAILEDSOCKET)
+            sid = Transport.instance().connect(
+                self._addr[0], self._addr[1],
+                on_message=self._on_message, on_failed=self._on_failed)
+            if sid == 0:
+                raise errors.RpcError(errors.ECONNREFUSED,
+                                      f"connect {self._addr} failed")
+            self._sid = sid
+            if self._password is not None:
+                f = Future()
+                self._pending.append(f)
+                Transport.instance().write_raw(
+                    sid, encode_command("AUTH", self._password))
+            return sid
+
+    def _fail_pending_locked(self, code: int) -> None:
+        while self._pending:
+            f = self._pending.popleft()
+            if not f.done():
+                f.set_exception(errors.RpcError(code, "connection failed"))
+
+    def _on_failed(self, sid: int, err: int) -> None:
+        with self._mu:
+            if sid == self._sid:
+                self._sid = None
+            self._fail_pending_locked(errors.EFAILEDSOCKET)
+
+    def _on_message(self, sid: int, kind: int, meta: bytes, body) -> None:
+        if kind != MSG_REDIS:
+            return
+        try:
+            value, _ = parse_value(body.to_bytes())
+        except Exception as e:
+            value = RedisError(f"bad reply: {e}")
+        with self._mu:
+            f = self._pending.popleft() if self._pending else None
+        if f is not None and not f.done():
+            f.set_result(value)
+
+    def execute(self, *args) -> Future:
+        """Issue one command; returns a Future of the decoded reply.
+        RedisError replies resolve the future (not raise) so pipelines can
+        inspect per-command errors."""
+        sid = self._ensure_connected()
+        cmd = encode_command(*args)
+        with self._mu:
+            f = Future()
+            self._pending.append(f)
+            rc = Transport.instance().write_raw(sid, cmd)
+            if rc != 0:
+                self._pending.pop()
+                f.set_exception(
+                    errors.RpcError(errors.EFAILEDSOCKET, "write failed"))
+        return f
+
+    def call(self, *args, timeout_ms: Optional[int] = None):
+        """Synchronous command; raises RedisError on -ERR replies."""
+        f = self.execute(*args)
+        t = (timeout_ms if timeout_ms is not None else self.timeout_ms) / 1e3
+        try:
+            value = f.result(timeout=t)
+        except TimeoutError:
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  f"redis call timed out after {t}s")
+        if isinstance(value, RedisError):
+            raise value
+        return value
+
+    def pipeline(self) -> "RedisPipeline":
+        return RedisPipeline(self)
+
+    def close(self) -> None:
+        with self._mu:
+            sid, self._sid = self._sid, None
+        if sid is not None:
+            Transport.instance().close(sid)
+
+
+class RedisPipeline:
+    """Batch many commands into one write; results() waits for all."""
+
+    def __init__(self, channel: RedisChannel):
+        self._ch = channel
+        self._cmds: list[bytes] = []
+        self._futures: list[Future] = []
+
+    def execute(self, *args) -> "RedisPipeline":
+        self._cmds.append(encode_command(*args))
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            self.flush()
+
+    def flush(self) -> list[Future]:
+        if not self._cmds:
+            return self._futures
+        ch = self._ch
+        sid = ch._ensure_connected()
+        with ch._mu:
+            for _ in self._cmds:
+                f = Future()
+                ch._pending.append(f)
+                self._futures.append(f)
+            rc = Transport.instance().write_raw(sid, b"".join(self._cmds))
+            if rc != 0:
+                for f in self._futures:
+                    if not f.done():
+                        ch._pending.remove(f)
+                        f.set_exception(errors.RpcError(
+                            errors.EFAILEDSOCKET, "write failed"))
+        self._cmds.clear()
+        return self._futures
+
+    def results(self, timeout_ms: Optional[int] = None) -> list:
+        self.flush()
+        t = (timeout_ms if timeout_ms is not None else self._ch.timeout_ms) / 1e3
+        return [f.result(timeout=t) for f in self._futures]
+
+
+# ---- server --------------------------------------------------------------
+
+class RedisService:
+    """Server-side command dispatch (reference RedisService/
+    RedisCommandHandler, redis.h:192,214).
+
+    Handlers take (cntl-less) `fn(args: list[bytes]) -> value` and return a
+    Python value encoded by encode_reply; raise RedisError for -ERR replies.
+    Handlers run inline on the socket's dispatcher thread (that's what keeps
+    replies in command order) — keep them fast and non-blocking."""
+
+    def __init__(self):
+        self._handlers: dict[str, Callable] = {}
+
+    def command(self, name: str):
+        def deco(fn):
+            self._handlers[name.upper()] = fn
+            return fn
+        return deco
+
+    def add_handler(self, name: str, fn: Callable) -> None:
+        self._handlers[name.upper()] = fn
+
+    def handle_bytes(self, raw: bytes) -> bytes:
+        """One complete RESP command in, one RESP reply out."""
+        try:
+            cmd, _ = parse_value(raw)
+        except Exception as e:
+            return encode_reply(RedisError(f"ERR protocol error: {e}"))
+        if not isinstance(cmd, list) or not cmd:
+            return encode_reply(RedisError("ERR expected command array"))
+        name = (cmd[0].decode(errors="replace")
+                if isinstance(cmd[0], (bytes, bytearray)) else str(cmd[0]))
+        fn = self._handlers.get(name.upper())
+        if fn is None:
+            return encode_reply(
+                RedisError(f"ERR unknown command '{name}'"))
+        try:
+            return encode_reply(fn(cmd[1:]))
+        except RedisError as e:
+            return encode_reply(e)
+        except Exception as e:  # handler bug — surface as error reply
+            return encode_reply(RedisError(f"ERR internal: {e}"))
+
+
+class MemoryRedisService(RedisService):
+    """A small in-memory redis: GET/SET/DEL/EXISTS/INCR/DECR/MGET/MSET/
+    KEYS/PING/ECHO/FLUSHDB — enough for tests, demos, and as a template for
+    real redis-speaking services (reference example/redis_c++/redis_server).
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._data: dict[bytes, bytes] = {}
+        self._mu = threading.Lock()
+        r = self.add_handler
+        r("PING", lambda a: "PONG" if not a else bytes(a[0]))
+        r("ECHO", lambda a: bytes(a[0]))
+        r("SET", self._set)
+        r("GET", self._get)
+        r("DEL", self._del)
+        r("EXISTS", self._exists)
+        r("INCR", lambda a: self._incrby(a[0], 1))
+        r("DECR", lambda a: self._incrby(a[0], -1))
+        r("INCRBY", lambda a: self._incrby(a[0], int(a[1])))
+        r("MGET", self._mget)
+        r("MSET", self._mset)
+        r("KEYS", self._keys)
+        r("FLUSHDB", self._flush)
+
+    def _set(self, a):
+        with self._mu:
+            self._data[bytes(a[0])] = bytes(a[1])
+        return "OK"
+
+    def _get(self, a):
+        with self._mu:
+            return self._data.get(bytes(a[0]))
+
+    def _del(self, a):
+        n = 0
+        with self._mu:
+            for k in a:
+                n += self._data.pop(bytes(k), None) is not None
+        return n
+
+    def _exists(self, a):
+        with self._mu:
+            return sum(bytes(k) in self._data for k in a)
+
+    def _incrby(self, key, delta):
+        key = bytes(key)
+        with self._mu:
+            try:
+                v = int(self._data.get(key, b"0")) + delta
+            except ValueError:
+                raise RedisError("ERR value is not an integer")
+            self._data[key] = str(v).encode()
+            return v
+
+    def _mget(self, a):
+        with self._mu:
+            return [self._data.get(bytes(k)) for k in a]
+
+    def _mset(self, a):
+        if len(a) % 2:
+            raise RedisError("ERR wrong number of arguments for MSET")
+        with self._mu:
+            for i in range(0, len(a), 2):
+                self._data[bytes(a[i])] = bytes(a[i + 1])
+        return "OK"
+
+    def _keys(self, a):
+        import fnmatch
+        pat = bytes(a[0]) if a else b"*"
+        with self._mu:
+            return [k for k in self._data
+                    if fnmatch.fnmatchcase(k.decode(errors="replace"),
+                                           pat.decode(errors="replace"))]
+
+    def _flush(self, a):
+        with self._mu:
+            self._data.clear()
+        return "OK"

@@ -20,7 +20,8 @@ from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
 from brpc_tpu.rpc.service import MethodSpec, Service
-from brpc_tpu.rpc.transport import MSG_HTTP, MSG_TRPC, Transport
+from brpc_tpu.rpc.transport import (MSG_HTTP, MSG_REDIS, MSG_TRPC,
+                                    Transport)
 
 
 @dataclass
@@ -34,6 +35,10 @@ class ServerOptions:
     has_builtin_services: bool = True
     server_info_name: str = "tpu-rpc"
     graceful_quit_timeout_s: float = 5.0
+    # Serve the redis protocol on the same port (reference
+    # ServerOptions.redis_service, redis.h:192): a RedisService whose
+    # command handlers answer RESP traffic detected by the native parser.
+    redis_service: Optional[Any] = None
 
 
 class MethodStatus:
@@ -213,6 +218,15 @@ class Server:
             else:
                 Transport.instance().write_raw(
                     sid, b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+            return
+        if kind == MSG_REDIS:
+            svc = self.options.redis_service
+            if svc is None:
+                Transport.instance().write_raw(
+                    sid, b"-ERR this server has no redis service\r\n")
+            else:
+                Transport.instance().write_raw(
+                    sid, svc.handle_bytes(body.to_bytes()))
             return
         try:
             meta = M.RpcMeta.decode(meta_bytes)

@@ -17,6 +17,7 @@ from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, IOBuf, MESSAGE_CB,
 
 MSG_TRPC = 0
 MSG_HTTP = 1
+MSG_REDIS = 2
 
 
 class Transport:

@@ -1,0 +1,50 @@
+"""Redis example — a redis-speaking server plus a pipelined client
+(reference example/redis_c++: client against any redis server, and
+redis_server demo built on RedisService/RedisCommandHandler).
+
+The server answers RESP on the SAME port as TRPC and the HTTP console —
+the native parser detects the protocol per connection.
+
+Run: python examples/redis.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import brpc_tpu as brpc
+
+
+def main():
+    # server: in-memory redis + a custom command
+    svc = brpc.MemoryRedisService()
+
+    @svc.command("TOUPPER")
+    def _toupper(args):
+        return bytes(args[0]).upper()
+
+    srv = brpc.Server(redis_service=svc)
+    srv.start("127.0.0.1", 0)
+    print(f"redis-speaking server on 127.0.0.1:{srv.port} "
+          f"(also TRPC + http console)")
+
+    ch = brpc.RedisChannel(f"127.0.0.1:{srv.port}")
+    print("PING         ->", ch.call("PING"))
+    print("SET k hello  ->", ch.call("SET", "k", "hello"))
+    print("GET k        ->", ch.call("GET", "k"))
+    print("TOUPPER k    ->", ch.call("TOUPPER", "hello"))
+    print("INCR visits  ->", ch.call("INCR", "visits"))
+
+    # pipeline: many commands, one write, FIFO-matched replies
+    with ch.pipeline() as p:
+        for i in range(5):
+            p.execute("INCR", "visits")
+    print("pipelined INCR x5 ->", p.results())
+
+    ch.close()
+    srv.stop()
+    srv.join()
+
+
+if __name__ == "__main__":
+    main()

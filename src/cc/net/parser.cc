@@ -98,14 +98,111 @@ static ParseResult parse_http(butil::IOBuf* in, ParseState* st,
   return PARSE_OK;
 }
 
+// ---- RESP (redis serialization protocol, reference policy/redis_protocol
+// .cpp + redis_reply.cpp) -------------------------------------------------
+//
+// Completeness scan over the IOBuf without copying bulk bodies: header lines
+// are read through a small window, $N bodies are skipped arithmetically.
+
+// Reads one CRLF-terminated line starting at *off.  On success stores the
+// line (without CRLF) and advances *off past the CRLF.
+static ParseResult resp_read_line(const butil::IOBuf& in, size_t* off,
+                                  std::string* line) {
+  char buf[256];
+  size_t pos = *off;
+  line->clear();
+  while (pos < in.size()) {
+    const size_t n = in.copy_to(buf, sizeof(buf), pos);
+    for (size_t i = 0; i < n; ++i) {
+      if (buf[i] == '\n') {
+        if (line->empty() && i == 0) return PARSE_ERROR;
+        // strip the '\r' (it may be the last char of the previous chunk)
+        line->append(buf, i);
+        if (line->empty() || line->back() != '\r') return PARSE_ERROR;
+        line->pop_back();
+        *off = pos + i + 1;
+        return PARSE_OK;
+      }
+    }
+    line->append(buf, n);
+    if (line->size() > 65536) return PARSE_ERROR;  // redis line limit
+    pos += n;
+  }
+  return PARSE_NEED_MORE;
+}
+
+// Scans one complete RESP value starting at offset 0; sets *end past it.
+static ParseResult resp_scan(const butil::IOBuf& in, size_t* end) {
+  size_t off = 0;
+  std::string line;
+  // stack of remaining element counts for nested arrays
+  int64_t stack[32];
+  int depth = 0;
+  stack[depth] = 1;
+  while (depth >= 0) {
+    if (stack[depth] == 0) {
+      --depth;
+      continue;
+    }
+    const ParseResult r = resp_read_line(in, &off, &line);
+    if (r != PARSE_OK) return r;
+    if (line.empty()) return PARSE_ERROR;
+    const char t = line[0];
+    if (t == '+' || t == '-' || t == ':') {
+      --stack[depth];
+    } else if (t == '$') {
+      const long long n = atoll(line.c_str() + 1);
+      if (n > (long long)g_max_body_size) return PARSE_ERROR;
+      if (n >= 0) {
+        const size_t body_end = off + (size_t)n + 2;
+        if (in.size() < body_end) return PARSE_NEED_MORE;
+        off = body_end;
+      }
+      --stack[depth];
+    } else if (t == '*') {
+      const long long n = atoll(line.c_str() + 1);
+      --stack[depth];
+      if (n > 0) {
+        if (depth + 1 >= (int)(sizeof(stack) / sizeof(stack[0])))
+          return PARSE_ERROR;  // nesting too deep
+        stack[++depth] = n;
+      }
+    } else {
+      return PARSE_ERROR;
+    }
+  }
+  *end = off;
+  return PARSE_OK;
+}
+
+static ParseResult parse_redis(butil::IOBuf* in, ParsedMessage* out) {
+  size_t end = 0;
+  const ParseResult r = resp_scan(*in, &end);
+  if (r != PARSE_OK) return r;
+  out->kind = MSG_REDIS;
+  out->meta.clear();
+  out->body.clear();
+  in->cutn(&out->body, end);
+  return PARSE_OK;
+}
+
+static bool looks_like_redis(char c) {
+  return c == '*' || c == '+' || c == '-' || c == ':' || c == '$';
+}
+
 ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) {
   if (in->empty()) return PARSE_NEED_MORE;
   if (st->detected == MSG_HTTP) return parse_http(in, st, out);
+  if (st->detected == MSG_REDIS) return parse_redis(in, out);
 
   char hdr[kTrpcHeaderLen];
   const size_t got = in->copy_to(hdr, kTrpcHeaderLen, 0);
   if (memcmp(hdr, kTrpcMagic, got < 4 ? got : 4) != 0) {
     // Not TRPC: try-next-protocol (input_messenger.cpp:144-160 pattern).
+    if (looks_like_redis(hdr[0])) {
+      st->detected = MSG_REDIS;
+      return parse_redis(in, out);
+    }
     if (looks_like_http(hdr, got)) {
       st->detected = MSG_HTTP;
       return parse_http(in, st, out);

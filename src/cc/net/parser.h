@@ -28,6 +28,14 @@ namespace brpc {
 enum MessageKind {
   MSG_TRPC = 0,
   MSG_HTTP = 1,
+  // One complete RESP value (redis wire format) per message; body holds the
+  // raw RESP bytes.  Commands from clients are RESP arrays ('*'), replies
+  // are any of + - : $ *.  Inline commands are not supported (their first
+  // byte is ambiguous with HTTP detection).  RESP has no correlation ids —
+  // per-connection FIFO order is the protocol contract — so the socket
+  // delivers MSG_REDIS inline on its dispatcher thread instead of fanning
+  // out to the executor (see Socket::DispatchMessages).
+  MSG_REDIS = 2,
 };
 
 enum ParseResult {

@@ -326,6 +326,16 @@ void Socket::DispatchMessages() {
       msg.body.clear();
       continue;
     }
+    if (msg.kind == MSG_REDIS) {
+      // RESP has no correlation ids — per-connection FIFO is the protocol
+      // contract.  Deliver inline on the dispatcher thread (sequential per
+      // fd) instead of fanning out to the work-stealing executor, which
+      // would reorder commands/replies.
+      auto* body = new butil::IOBuf(std::move(msg.body));
+      _opts.on_message(_id, msg.kind, msg.meta.data(), msg.meta.size(), body,
+                       _opts.user);
+      continue;
+    }
     auto* pm = new PendingMessage{_id, msg.kind, std::move(msg.meta),
                                   new butil::IOBuf(std::move(msg.body)),
                                   _opts.on_message, _opts.user};

@@ -1,0 +1,166 @@
+"""Redis protocol tests — codec units + loopback server/client integration
+(mirrors the reference's brpc_redis_protocol_unittest pattern: real loopback
+server in-process, SURVEY.md §4)."""
+import threading
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc.redis import (MemoryRedisService, RedisError,
+                                encode_command, encode_reply, parse_value)
+
+
+class TestCodec:
+    def test_encode_command(self):
+        assert encode_command("SET", "k", b"v") == \
+            b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$1\r\nv\r\n"
+        assert encode_command("INCRBY", "k", 5) == \
+            b"*3\r\n$6\r\nINCRBY\r\n$1\r\nk\r\n$1\r\n5\r\n"
+
+    def test_reply_roundtrip(self):
+        cases = [
+            ("OK", b"+OK\r\n"),
+            (7, b":7\r\n"),
+            (b"bulk\r\nwith crlf", b"$15\r\nbulk\r\nwith crlf\r\n"),
+            (None, b"$-1\r\n"),
+            ([b"a", 1, None], b"*3\r\n$1\r\na\r\n:1\r\n$-1\r\n"),
+        ]
+        for value, wire in cases:
+            assert encode_reply(value) == wire
+            decoded, off = parse_value(wire)
+            assert decoded == value and off == len(wire)
+
+    def test_error_reply(self):
+        wire = encode_reply(RedisError("ERR nope"))
+        assert wire == b"-ERR nope\r\n"
+        v, _ = parse_value(wire)
+        assert isinstance(v, RedisError) and str(v) == "ERR nope"
+
+    def test_nested_arrays(self):
+        wire = encode_reply([[1, 2], [b"x"], []])
+        v, off = parse_value(wire)
+        assert v == [[1, 2], [b"x"], []] and off == len(wire)
+
+    def test_bad_type_byte(self):
+        with pytest.raises(ValueError):
+            parse_value(b"?huh\r\n")
+
+
+@pytest.fixture
+def redis_server():
+    srv = brpc.Server(redis_service=MemoryRedisService())
+    srv.start("127.0.0.1", 0)
+    yield srv
+    srv.stop()
+    srv.join()
+
+
+class TestRedisLoopback:
+    def test_basic_commands(self, redis_server):
+        ch = brpc.RedisChannel(f"127.0.0.1:{redis_server.port}",
+                               timeout_ms=5000)
+        assert ch.call("PING") == "PONG"
+        assert ch.call("SET", "k1", "v1") == "OK"
+        assert ch.call("GET", "k1") == b"v1"
+        assert ch.call("GET", "missing") is None
+        assert ch.call("INCR", "ctr") == 1
+        assert ch.call("INCRBY", "ctr", 41) == 42
+        assert ch.call("EXISTS", "k1", "ctr", "nope") == 2
+        assert ch.call("DEL", "k1") == 1
+        assert ch.call("MSET", "a", "1", "b", "2") == "OK"
+        assert ch.call("MGET", "a", "b", "zz") == [b"1", b"2", None]
+        ch.close()
+
+    def test_error_replies(self, redis_server):
+        ch = brpc.RedisChannel(f"127.0.0.1:{redis_server.port}",
+                               timeout_ms=5000)
+        ch.call("SET", "s", "notanum")
+        with pytest.raises(RedisError):
+            ch.call("INCR", "s")
+        with pytest.raises(RedisError):
+            ch.call("NOSUCHCMD")
+        ch.close()
+
+    def test_pipeline_fifo(self, redis_server):
+        """Pipelined replies must match command order (PipelinedInfo)."""
+        ch = brpc.RedisChannel(f"127.0.0.1:{redis_server.port}",
+                               timeout_ms=5000)
+        N = 200
+        with ch.pipeline() as p:
+            for i in range(N):
+                p.execute("SET", f"key{i}", f"val{i}")
+            for i in range(N):
+                p.execute("GET", f"key{i}")
+        res = p.results(timeout_ms=10000)
+        assert res[:N] == ["OK"] * N
+        assert res[N:] == [b"val%d" % i for i in range(N)]
+        ch.close()
+
+    def test_concurrent_clients(self, redis_server):
+        errs = []
+
+        def worker(tag):
+            try:
+                ch = brpc.RedisChannel(
+                    f"127.0.0.1:{redis_server.port}", timeout_ms=5000)
+                for i in range(50):
+                    assert ch.call("INCR", f"c{tag}") == i + 1
+                ch.close()
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(t,)) for t in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs, errs
+
+    def test_large_bulk(self, redis_server):
+        ch = brpc.RedisChannel(f"127.0.0.1:{redis_server.port}",
+                               timeout_ms=10000)
+        blob = b"x" * (2 * 1024 * 1024)
+        assert ch.call("SET", "big", blob) == "OK"
+        assert ch.call("GET", "big") == blob
+        ch.close()
+
+    def test_multiprotocol_one_port(self):
+        """TRPC, HTTP, and RESP share the listener (global.cpp:413-593 /
+        input_messenger try-in-order behavior)."""
+        class Echo(brpc.Service):
+            @brpc.method(request="json", response="json")
+            def Echo(self, cntl, req):
+                return req
+
+        srv = brpc.Server(redis_service=MemoryRedisService())
+        srv.add_service(Echo())
+        srv.start("127.0.0.1", 0)
+        try:
+            rpc_ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            assert rpc_ch.call_sync("Echo", "Echo", {"x": 1},
+                                    serializer="json") == {"x": 1}
+            rch = brpc.RedisChannel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            assert rch.call("PING") == "PONG"
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/health", timeout=5) as r:
+                assert r.status == 200
+            rch.close()
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_custom_service_handlers(self, redis_server):
+        svc = brpc.RedisService()
+
+        @svc.command("SUM")
+        def _sum(args):
+            return sum(int(x) for x in args)
+
+        srv = brpc.Server(redis_service=svc)
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.RedisChannel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            assert ch.call("SUM", 1, 2, 3) == 6
+            ch.close()
+        finally:
+            srv.stop()
+            srv.join()
