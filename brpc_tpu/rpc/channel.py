@@ -64,8 +64,19 @@ class _ClientConn:
 
 
 class SocketMap:
-    """endpoint -> shared client connection (created lazily, replaced on
-    failure).  All client connections share one response handler."""
+    """endpoint -> client connections (reference socket_map.h:147 +
+    ConnectionType, protocol.h:161-180).  Three reuse schemes:
+
+      * single — one shared multiplexed connection per endpoint (our TRPC
+        framing correlates by id, so one socket carries any number of
+        in-flight calls; the reference default for baidu_std).
+      * pooled — a free-list of connections per endpoint; a call checks one
+        out for its attempt and returns it at completion (the reference
+        scheme for non-multiplexable protocols; here it also isolates large
+        transfers from head-of-line blocking on the shared socket).
+      * short  — a fresh connection per attempt, closed at call end.
+
+    All client connections share one response handler (CallManager)."""
 
     _instance = None
     _instance_lock = threading.Lock()
@@ -81,48 +92,105 @@ class SocketMap:
         self._lock = threading.Lock()
         self._conns: dict[EndPoint, _ClientConn] = {}
         self._sid_to_ep: dict[int, EndPoint] = {}
+        self._pool: dict[EndPoint, list[_ClientConn]] = {}
+        self._pooled_sids: dict[int, _ClientConn] = {}
+        self._closing: set[int] = set()   # deliberate local closes
+
+    def _connect(self, ep: EndPoint) -> _ClientConn:
+        sid = Transport.instance().connect(
+            ep.host, ep.port, CallManager.instance().on_message,
+            self._on_socket_failed)
+        with self._lock:
+            self._sid_to_ep[sid] = ep
+        return _ClientConn(sid, ep)
 
     def get_connection(self, ep: EndPoint) -> _ClientConn:
         with self._lock:
             c = self._conns.get(ep)
             if c is not None:
                 return c
-        t = Transport.instance()
-        sid = t.connect(ep.host, ep.port, CallManager.instance().on_message,
-                        self._on_socket_failed)
-        c = _ClientConn(sid, ep)
+        c = self._connect(ep)
         with self._lock:
             cur = self._conns.get(ep)
             if cur is not None:
                 # lost the race; keep the established one, drop ours
-                t.close(sid)
+                Transport.instance().close(c.sid)
                 return cur
             self._conns[ep] = c
-            self._sid_to_ep[sid] = ep
         return c
+
+    # ---- pooled scheme ----
+
+    def get_pooled(self, ep: EndPoint) -> _ClientConn:
+        t = Transport.instance()
+        while True:
+            with self._lock:
+                free = self._pool.get(ep)
+                c = free.pop() if free else None
+            if c is None:
+                return self._connect(ep)
+            if t.alive(c.sid):
+                return c
+            # died while idle in the pool; try the next one
+
+    def return_pooled(self, c: _ClientConn) -> None:
+        if not Transport.instance().alive(c.sid):
+            return
+        with self._lock:
+            self._pooled_sids[c.sid] = c
+            self._pool.setdefault(c.endpoint, []).append(c)
+
+    # ---- short scheme ----
+
+    def make_short(self, ep: EndPoint) -> _ClientConn:
+        return self._connect(ep)
+
+    def close_quietly(self, sid: int) -> None:
+        """Deliberate local close — not a server failure: skips the
+        health-check / circuit-breaker marking that real failures get."""
+        with self._lock:
+            self._closing.add(sid)
+        Transport.instance().close(sid)
 
     def _on_socket_failed(self, sid: int, err: int) -> None:
         with self._lock:
+            deliberate = sid in self._closing
+            self._closing.discard(sid)
             ep = self._sid_to_ep.pop(sid, None)
             if ep is not None and self._conns.get(ep) is not None and \
                     self._conns[ep].sid == sid:
                 del self._conns[ep]
+            pc = self._pooled_sids.pop(sid, None)
+            if pc is not None and ep is not None:
+                free = self._pool.get(ep)
+                if free and pc in free:
+                    free.remove(pc)
         CallManager.instance().on_socket_failed(sid, err)
         # health check + LB notification (policy layer)
         from brpc_tpu.policy.health_check import on_connection_failed
-        if ep is not None:
+        if ep is not None and not deliberate:
             on_connection_failed(ep)
 
     def drop(self, ep: EndPoint) -> None:
         with self._lock:
             c = self._conns.pop(ep, None)
+            free = self._pool.pop(ep, [])
+            for fc in free:
+                self._pooled_sids.pop(fc.sid, None)
         if c is not None:
-            Transport.instance().close(c.sid)
+            self.close_quietly(c.sid)
+        for fc in free:
+            self.close_quietly(fc.sid)
+
+    def pooled_count(self, ep: EndPoint) -> int:
+        with self._lock:
+            return len(self._pool.get(ep, ()))
 
 
 class _CallState:
     __slots__ = ("cntl", "channel", "meta_template", "body", "done",
-                 "deadline_timer", "backup_timer", "sids", "tried_servers")
+                 "deadline_timer", "backup_timer", "sids", "tried_servers",
+                 "pooled_conns", "short_conns")
 
     def __init__(self, cntl, channel, meta_template, body, done):
         self.cntl = cntl
@@ -134,6 +202,11 @@ class _CallState:
         self.backup_timer = None
         self.sids: set[int] = set()
         self.tried_servers: list[EndPoint] = []
+        # connections this call checked out (pooled) or owns (short); given
+        # back / closed at completion — late replies are matched by cid, so
+        # recycling before a stale attempt answers is safe
+        self.pooled_conns: list[_ClientConn] = []
+        self.short_conns: list[_ClientConn] = []
 
 
 class CallManager:
@@ -266,6 +339,18 @@ class CallManager:
         cntl = st.cntl
         import time
         cntl.latency_us = int(time.monotonic() * 1e6) - cntl._start_us
+        # recycle per-call connections (pooled back to the free list,
+        # short closed — ConnectionType semantics, protocol.h:161-180)
+        if st.pooled_conns:
+            smap = SocketMap.instance()
+            for c in st.pooled_conns:
+                smap.return_pooled(c)
+            st.pooled_conns.clear()
+        if st.short_conns:
+            smap = SocketMap.instance()
+            for c in st.short_conns:
+                smap.close_quietly(c.sid)
+            st.short_conns.clear()
         st.channel._on_call_end(st)
         if st.done is not None:
             try:
@@ -424,7 +509,16 @@ class Channel:
         st.tried_servers.append(ep)
         cntl.remote_side = str(ep)
         try:
-            conn = SocketMap.instance().get_connection(ep)
+            smap = SocketMap.instance()
+            ctype = self.options.connection_type
+            if ctype == "pooled":
+                conn = smap.get_pooled(ep)
+                st.pooled_conns.append(conn)
+            elif ctype == "short":
+                conn = smap.make_short(ep)
+                st.short_conns.append(conn)
+            else:
+                conn = smap.get_connection(ep)
         except (ConnectionError, OSError):
             cntl.set_failed(errors.ECONNREFUSED, f"cannot connect to {ep}")
             if self._should_retry(st):
