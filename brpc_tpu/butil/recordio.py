@@ -1,0 +1,84 @@
+"""recordio — length-prefixed, checksummed record files
+(reference butil/recordio.{h,cc}; used by rpc_dump §5.5).
+
+Record layout (little-endian):
+  u32 magic "RIO1" | u32 meta_len | u64 body_len | u32 crc32(meta+body)
+  meta bytes | body bytes
+
+Readers skip to the next magic on corruption, so a truncated tail or a
+damaged record loses only itself.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import BinaryIO, Iterator, Optional
+
+MAGIC = b"RIO1"
+_HDR = struct.Struct("<4sIQI")
+
+
+class RecordWriter:
+    def __init__(self, fp: BinaryIO):
+        self._fp = fp
+
+    def write(self, body: bytes, meta: bytes = b"") -> None:
+        crc = zlib.crc32(meta) & 0xFFFFFFFF
+        crc = zlib.crc32(body, crc) & 0xFFFFFFFF
+        self._fp.write(_HDR.pack(MAGIC, len(meta), len(body), crc))
+        if meta:
+            self._fp.write(meta)
+        if body:
+            self._fp.write(body)
+
+    def flush(self) -> None:
+        self._fp.flush()
+
+
+class RecordReader:
+    def __init__(self, fp: BinaryIO):
+        self._fp = fp
+
+    def read(self) -> Optional[tuple[bytes, bytes]]:
+        """Returns (meta, body) or None at EOF.  Corrupt records are
+        skipped by scanning forward to the next magic."""
+        while True:
+            hdr = self._fp.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                return None
+            magic, meta_len, body_len, crc = _HDR.unpack(hdr)
+            if magic != MAGIC:
+                # resync: find the next magic in this chunk + what follows
+                if not self._resync(hdr):
+                    return None
+                continue
+            meta = self._fp.read(meta_len)
+            body = self._fp.read(body_len)
+            if len(meta) < meta_len or len(body) < body_len:
+                return None  # truncated tail
+            got = zlib.crc32(meta) & 0xFFFFFFFF
+            got = zlib.crc32(body, got) & 0xFFFFFFFF
+            if got != crc:
+                continue  # damaged record — drop it, keep reading
+            return meta, body
+
+    def _resync(self, tail: bytes) -> bool:
+        buf = tail
+        while True:
+            idx = buf.find(MAGIC, 1)
+            if idx >= 0:
+                rest = buf[idx:]
+                # rewind so the next read starts at the magic
+                self._fp.seek(-len(rest), 1)
+                return True
+            chunk = self._fp.read(65536)
+            if not chunk:
+                return False
+            buf = buf[-3:] + chunk
+
+    def __iter__(self) -> Iterator[tuple[bytes, bytes]]:
+        while True:
+            r = self.read()
+            if r is None:
+                return
+            yield r

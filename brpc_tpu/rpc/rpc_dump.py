@@ -1,0 +1,100 @@
+"""rpc_dump — sampled capture of live requests to recordio files
+(reference rpc_dump.{h,cpp}:50-69; replayed by tools/rpc_replay, §5.5).
+
+Enable with flags (live-editable through /flags):
+  rpc_dump            — master switch
+  rpc_dump_dir        — output directory (one file per process)
+  rpc_dump_ratio      — sample 1/N requests (1 = every request)
+  rpc_dump_max_files  — rotation depth
+  rpc_dump_max_requests_in_one_file — rotation threshold
+
+Each record: meta = the request's wire RpcMeta bytes, body = the request
+payload (still compressed/serialized exactly as received) — what's needed
+to re-issue the call byte-for-byte.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from brpc_tpu import flags
+from brpc_tpu.butil.recordio import RecordWriter
+
+flags.define_flag("rpc_dump", False, "sample incoming requests to recordio files")
+flags.define_flag("rpc_dump_dir", "./rpc_dump", "directory for dump files")
+flags.define_flag("rpc_dump_ratio", 1, "sample one of every N requests")
+flags.define_flag("rpc_dump_max_files", 5, "max rotated dump files kept")
+flags.define_flag("rpc_dump_max_requests_in_one_file", 10000,
+             "rotate after this many records")
+
+
+class RpcDumper:
+    _instance: Optional["RpcDumper"] = None
+    _instance_lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "RpcDumper":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._counter = 0
+        self._in_file = 0
+        self._fp = None
+        self._writer: Optional[RecordWriter] = None
+        self._files: list[str] = []
+
+    def sample(self, meta_bytes: bytes, body: bytes) -> None:
+        """Called per request from the server dispatch path; cheap when
+        disabled (one flag read + one int op)."""
+        if not flags.get_flag("rpc_dump"):
+            return
+        with self._mu:
+            self._counter += 1
+            ratio = max(1, int(flags.get_flag("rpc_dump_ratio")))
+            if self._counter % ratio != 0:
+                return
+            try:
+                self._write_locked(meta_bytes, body)
+            except OSError:
+                pass  # dumping must never break serving
+
+    def _write_locked(self, meta_bytes: bytes, body: bytes) -> None:
+        limit = int(flags.get_flag("rpc_dump_max_requests_in_one_file"))
+        if self._writer is None or self._in_file >= limit:
+            self._rotate_locked()
+        self._writer.write(body, meta_bytes)
+        self._writer.flush()
+        self._in_file += 1
+
+    def _rotate_locked(self) -> None:
+        if self._fp is not None:
+            self._fp.close()
+        d = str(flags.get_flag("rpc_dump_dir"))
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"requests.{int(time.time())}.{os.getpid()}."
+               f"{len(self._files)}.rdump")
+        self._fp = open(path, "wb")
+        self._writer = RecordWriter(self._fp)
+        self._in_file = 0
+        self._files.append(path)
+        max_files = int(flags.get_flag("rpc_dump_max_files"))
+        while len(self._files) > max_files:
+            old = self._files.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._mu:
+            if self._fp is not None:
+                self._fp.close()
+                self._fp = None
+                self._writer = None

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from brpc_tpu import errors, rpcz
+from brpc_tpu.rpc import rpc_dump as _rpc_dump  # registers rpc_dump_* flags
 from brpc_tpu.bvar import Adder, LatencyRecorder, PassiveStatus
 from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.controller import Controller
@@ -233,6 +234,12 @@ class Server:
         except ValueError:
             return
         if meta.msg_type == M.MSG_REQUEST:
+            # sampled traffic capture for rpc_replay (rpc_dump.h:69, §5.5);
+            # the body copy happens only when dumping is on
+            from brpc_tpu import flags
+            if flags.get_flag("rpc_dump"):
+                from brpc_tpu.rpc.rpc_dump import RpcDumper
+                RpcDumper.instance().sample(meta_bytes, body.to_bytes())
             self._process_request(sid, meta, body)
         elif meta.msg_type in (M.MSG_STREAM_DATA, M.MSG_STREAM_FEEDBACK,
                                M.MSG_STREAM_CLOSE):

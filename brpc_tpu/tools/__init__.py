@@ -1,0 +1,7 @@
+"""Command-line tools (reference tools/, SURVEY.md §2.8):
+
+  python -m brpc_tpu.tools.rpc_press     — load generator
+  python -m brpc_tpu.tools.rpc_replay    — replay rpc_dump captures
+  python -m brpc_tpu.tools.rpc_view      — fetch a server's builtin pages
+  python -m brpc_tpu.tools.parallel_http — mass concurrent HTTP fetcher
+"""

@@ -1,0 +1,107 @@
+"""rpc_press — protobuf-less load generator
+(reference tools/rpc_press/rpc_press_impl.cpp: sends sample requests from
+JSON at a target qps, reports qps + latency percentiles).
+
+Example:
+  python -m brpc_tpu.tools.rpc_press --server 127.0.0.1:8000 \
+      --service EchoService --method Echo --input '{"msg":"hi"}' \
+      --qps 5000 --duration 10 --threads 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import brpc_tpu as brpc
+from brpc_tpu.bvar import LatencyRecorder
+
+
+def run_press(server: str, service: str, method: str, request,
+              qps: int = 0, duration_s: float = 10.0, threads: int = 4,
+              serializer: str = "json", timeout_ms: int = 1000,
+              connection_type: str = "single", out=sys.stderr) -> dict:
+    """Drives the load; returns a summary dict (also printable)."""
+    ch = brpc.Channel(server, timeout_ms=timeout_ms,
+                      connection_type=connection_type)
+    rec = LatencyRecorder("rpc_press")
+    nerr = [0]
+    nok = [0]
+    stop = threading.Event()
+    # per-thread qps budget; qps<=0 = unthrottled
+    per_thread_interval = threads / qps if qps > 0 else 0.0
+
+    def worker():
+        next_at = time.monotonic()
+        while not stop.is_set():
+            if per_thread_interval > 0:
+                now = time.monotonic()
+                if now < next_at:
+                    time.sleep(min(next_at - now, 0.05))
+                    continue
+                next_at += per_thread_interval
+            t0 = time.monotonic()
+            try:
+                ch.call_sync(service, method, request,
+                             serializer=serializer)
+                rec.add(int((time.monotonic() - t0) * 1e6))
+                nok[0] += 1
+            except Exception:
+                nerr[0] += 1
+
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(threads)]
+    t_start = time.monotonic()
+    [t.start() for t in ts]
+    try:
+        time.sleep(duration_s)
+    finally:
+        stop.set()
+    [t.join(2) for t in ts]
+    elapsed = time.monotonic() - t_start
+    summary = {
+        "sent_ok": nok[0],
+        "errors": nerr[0],
+        "qps": round(nok[0] / elapsed, 1),
+        "avg_us": round(rec.latency(), 1),
+        "p50_us": rec.latency_percentile(0.5),
+        "p90_us": rec.latency_percentile(0.9),
+        "p99_us": rec.latency_percentile(0.99),
+        "p999_us": rec.latency_percentile(0.999),
+        "max_us": rec.max_latency(),
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(summary), file=out)
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--server", required=True, help="host:port")
+    ap.add_argument("--service", required=True)
+    ap.add_argument("--method", required=True)
+    ap.add_argument("--input", default="{}",
+                    help="JSON request body, or @file.json")
+    ap.add_argument("--qps", type=int, default=0, help="0 = unthrottled")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--timeout-ms", type=int, default=1000)
+    ap.add_argument("--serializer", default="json")
+    ap.add_argument("--connection-type", default="single",
+                    choices=["single", "pooled", "short"])
+    a = ap.parse_args(argv)
+    text = a.input
+    if text.startswith("@"):
+        with open(text[1:]) as f:
+            text = f.read()
+    req = json.loads(text)
+    run_press(a.server, a.service, a.method, req, qps=a.qps,
+              duration_s=a.duration, threads=a.threads,
+              serializer=a.serializer, timeout_ms=a.timeout_ms,
+              connection_type=a.connection_type, out=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""rpc_replay — re-send traffic captured by rpc_dump
+(reference tools/rpc_replay/rpc_replay.cpp; capture side rpc_dump.{h,cpp}).
+
+Reads .rdump recordio files (meta = wire RpcMeta bytes, body = payload as
+received) and re-issues each request byte-for-byte against a target server.
+
+Example:
+  python -m brpc_tpu.tools.rpc_replay --server 127.0.0.1:8000 \
+      --dir ./rpc_dump --qps 1000 --times 1
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import threading
+import time
+
+from brpc_tpu import errors
+from brpc_tpu.butil.endpoint import str2endpoint
+from brpc_tpu.butil.recordio import RecordReader
+from brpc_tpu.bvar import LatencyRecorder
+from brpc_tpu.rpc import meta as M
+from brpc_tpu.rpc.channel import CallManager, SocketMap, _CallState
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.transport import Transport
+
+
+def load_records(path_or_dir: str) -> list[tuple[bytes, bytes]]:
+    paths = ([path_or_dir] if os.path.isfile(path_or_dir)
+             else sorted(glob.glob(os.path.join(path_or_dir, "*.rdump"))))
+    records: list[tuple[bytes, bytes]] = []
+    for p in paths:
+        with open(p, "rb") as f:
+            records.extend(RecordReader(f))
+    return records
+
+
+def replay_one(ep, meta_bytes: bytes, body: bytes,
+               timeout_ms: int = 1000) -> Controller:
+    """Re-issues one captured request with a fresh correlation id; returns
+    the controller (join()ed by the caller)."""
+    meta = M.RpcMeta.decode(meta_bytes)
+    cntl = Controller()
+    cntl.timeout_ms = timeout_ms
+    cntl.max_retry = 0
+    from brpc_tpu.rpc.channel import _cid_counter
+    cntl.correlation_id = next(_cid_counter)
+    cntl._start_us = int(time.monotonic() * 1e6)
+    cntl._done_event = threading.Event()
+    meta.correlation_id = cntl.correlation_id
+    meta.attempt = 0
+    mgr = CallManager.instance()
+    st = _CallState(cntl, _NullChannel(), meta, body, None)
+    mgr.register(st)
+    t = Transport.instance()
+    cid = cntl.correlation_id
+    st.deadline_timer = t.schedule(timeout_ms / 1e3,
+                                   lambda: mgr.on_deadline(cid))
+    try:
+        conn = SocketMap.instance().get_connection(ep)
+    except (ConnectionError, OSError):
+        cntl.set_failed(errors.ECONNREFUSED, f"cannot connect {ep}")
+        mgr._finish(st)
+        return cntl
+    mgr.bind_socket(cid, conn.sid)
+    rc = t.write_frame(conn.sid, meta.encode(), body)
+    if rc != 0:
+        cntl.set_failed(errors.EFAILEDSOCKET, "write failed")
+        mgr._finish(st)
+    return cntl
+
+
+class _NullChannel:
+    """Replay has no retry/LB policy — a minimal channel stand-in."""
+    def _should_retry(self, st):
+        return False
+
+    def _on_call_end(self, st):
+        pass
+
+
+def run_replay(server: str, path: str, qps: int = 0, times: int = 1,
+               timeout_ms: int = 1000, out=sys.stderr) -> dict:
+    ep = str2endpoint(server)
+    records = load_records(path)
+    if not records:
+        print(json.dumps({"error": "no records found", "path": path}),
+              file=out)
+        return {"replayed": 0, "errors": 0}
+    rec = LatencyRecorder("rpc_replay")
+    nerr = 0
+    nok = 0
+    interval = 1.0 / qps if qps > 0 else 0.0
+    t_start = time.monotonic()
+    next_at = t_start
+    inflight: list[Controller] = []
+    for _ in range(times):
+        for meta_bytes, body in records:
+            if interval > 0:
+                now = time.monotonic()
+                if now < next_at:
+                    time.sleep(next_at - now)
+                next_at += interval
+            cntl = replay_one(ep, meta_bytes, body, timeout_ms)
+            inflight.append(cntl)
+            if len(inflight) >= 128:  # bounded pipeline window
+                done = inflight.pop(0)
+                done.join()
+                nok, nerr = _account(done, rec, nok, nerr)
+    for cntl in inflight:
+        cntl.join()
+        nok, nerr = _account(cntl, rec, nok, nerr)
+    elapsed = time.monotonic() - t_start
+    summary = {
+        "replayed": nok,
+        "errors": nerr,
+        "qps": round(nok / elapsed, 1) if elapsed > 0 else 0,
+        "p50_us": rec.latency_percentile(0.5),
+        "p99_us": rec.latency_percentile(0.99),
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(summary), file=out)
+    return summary
+
+
+def _account(cntl, rec, nok, nerr):
+    if cntl.error_code == 0:
+        rec.add(cntl.latency_us)
+        return nok + 1, nerr
+    return nok, nerr + 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--server", required=True, help="host:port")
+    ap.add_argument("--dir", dest="path", required=True,
+                    help=".rdump file or directory of them")
+    ap.add_argument("--qps", type=int, default=0, help="0 = unthrottled")
+    ap.add_argument("--times", type=int, default=1,
+                    help="replay the capture N times")
+    ap.add_argument("--timeout-ms", type=int, default=1000)
+    a = ap.parse_args(argv)
+    run_replay(a.server, a.path, qps=a.qps, times=a.times,
+               timeout_ms=a.timeout_ms, out=sys.stdout)
+
+
+if __name__ == "__main__":
+    main()
