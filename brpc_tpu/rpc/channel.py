@@ -112,11 +112,15 @@ class SocketMap:
         c = self._connect(ep)
         with self._lock:
             cur = self._conns.get(ep)
-            if cur is not None:
-                # lost the race; keep the established one, drop ours
-                Transport.instance().close(c.sid)
-                return cur
-            self._conns[ep] = c
+            if cur is None:
+                self._conns[ep] = c
+        # NOTE: never close (or do anything that can fire socket callbacks)
+        # while holding _lock — the native SetFailed invokes on_failed
+        # synchronously on this thread, and _on_socket_failed re-takes _lock.
+        if cur is not None:
+            # lost the race; keep the established one, drop ours
+            self.close_quietly(c.sid)
+            return cur
         return c
 
     # ---- pooled scheme ----

@@ -4,7 +4,6 @@ parallel_http (reference tools/, §2.8 + §5.5)."""
 import io
 import json
 import os
-import sys
 
 import brpc_tpu as brpc
 from brpc_tpu import flags
