@@ -16,10 +16,12 @@ from brpc_tpu.errors import RpcError  # noqa: F401
 from brpc_tpu.rpc import (  # noqa: F401
     CallManager, CallMapper, Channel, ChannelOptions, Controller,
     MethodStatus, ParallelChannel, PartitionChannel, PartitionParser,
-    MemoryRedisService, RedisChannel, RedisError, RedisPipeline,
+    DataFactory, MemoryRedisService, ProgressiveAttachment,
+    ProgressiveResponse, RedisChannel, RedisError, RedisPipeline,
     RedisService, ResponseMerger, RetryPolicy, SelectiveChannel, Server,
-    ServerOptions, Service, SocketMap, Stream, StreamHandler, SubCall,
-    SumMerger, method, stream_accept, stream_create,
+    ServerOptions, Service, SimpleDataPool, SocketMap, Stream,
+    StreamHandler, SubCall, SumMerger, method, stream_accept,
+    stream_create,
 )
 from brpc_tpu.rpc.service import MethodSpec  # noqa: F401
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint  # noqa: F401

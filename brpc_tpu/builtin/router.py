@@ -57,8 +57,10 @@ class HttpRouter:
         except Exception:
             t.write_raw(sid, http_response(400, "bad request"))
             return
-        # exact match, then longest prefix (pprof-style subpaths)
-        handler = self.routes.get(req.path)
+        # user handlers first, then builtins: exact match, then longest
+        # prefix (pprof-style subpaths)
+        handler = self.server._http_handlers.get(req.path) or \
+            self.routes.get(req.path)
         if handler is None:
             best = ""
             for prefix, h in self.routes.items():
@@ -74,7 +76,19 @@ class HttpRouter:
             return
         try:
             resp = handler(req) if callable(handler) else handler
-            if isinstance(resp, bytes) and resp.startswith(b"HTTP/1."):
+            from brpc_tpu.rpc.progressive import (ProgressiveAttachment,
+                                                  ProgressiveResponse)
+            if isinstance(resp, ProgressiveResponse):
+                # chunked server push (progressive_attachment.h)
+                hdr = [f"HTTP/1.1 {resp.status} OK",
+                       f"Content-Type: {resp.content_type}",
+                       "Transfer-Encoding: chunked"]
+                for k, v in resp.extra_headers.items():
+                    hdr.append(f"{k}: {v}")
+                hdr.append("\r\n")
+                t.write_raw(sid, "\r\n".join(hdr).encode())
+                resp.writer(ProgressiveAttachment(sid))
+            elif isinstance(resp, bytes) and resp.startswith(b"HTTP/1."):
                 t.write_raw(sid, resp)
             else:
                 body, ctype = resp if isinstance(resp, tuple) else \

@@ -11,6 +11,12 @@ from brpc_tpu.rpc.combo_channels import (  # noqa: F401
     CallMapper, ParallelChannel, PartitionChannel, PartitionParser,
     ResponseMerger, SelectiveChannel, SubCall, SumMerger,
 )
+from brpc_tpu.rpc.data_pool import (  # noqa: F401
+    DataFactory, SimpleDataPool,
+)
+from brpc_tpu.rpc.progressive import (  # noqa: F401
+    ProgressiveAttachment, ProgressiveResponse,
+)
 from brpc_tpu.rpc.redis import (  # noqa: F401
     MemoryRedisService, RedisChannel, RedisError, RedisPipeline,
     RedisService,

@@ -55,6 +55,8 @@ class Controller:
         self.is_server_side = False
         self.request_meta: Optional[M.RpcMeta] = None
         self.peer_sid: int = 0
+        # pooled per-request data (ServerOptions.session_data_factory)
+        self.session_data = None
         # stream riding this RPC (see rpc/stream.py)
         self._stream = None
 

@@ -1,0 +1,79 @@
+"""SimpleDataPool — pooled per-request session data
+(reference simple_data_pool.{h,cpp} + data_factory.h; the session_data
+example).  A server configured with session_data_factory hands every
+request controller a pooled object via cntl.session_data; the object is
+returned to the pool (after an optional reset) when the request ends, so
+expensive per-session state (buffers, caches, device handles) is reused
+instead of reallocated.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+
+class DataFactory:
+    """Override create/destroy/reset, or pass plain callables to
+    SimpleDataPool directly (data_factory.h analog)."""
+
+    def create(self) -> Any:
+        raise NotImplementedError
+
+    def destroy(self, obj: Any) -> None:
+        pass
+
+    def reset(self, obj: Any) -> None:
+        pass
+
+
+class _CallableFactory(DataFactory):
+    def __init__(self, create: Callable[[], Any],
+                 reset: Optional[Callable[[Any], None]] = None):
+        self._create = create
+        self._reset = reset
+
+    def create(self) -> Any:
+        return self._create()
+
+    def reset(self, obj: Any) -> None:
+        if self._reset is not None:
+            self._reset(obj)
+
+
+class SimpleDataPool:
+    def __init__(self, factory: DataFactory | Callable[[], Any],
+                 reset: Optional[Callable[[Any], None]] = None,
+                 max_size: int = 1024):
+        if not isinstance(factory, DataFactory):
+            factory = _CallableFactory(factory, reset)
+        self._factory = factory
+        self._free: list[Any] = []
+        self._mu = threading.Lock()
+        self._max_size = max_size
+        self._ncreated = 0
+
+    def borrow(self) -> Any:
+        with self._mu:
+            if self._free:
+                return self._free.pop()
+            self._ncreated += 1
+        return self._factory.create()
+
+    def give_back(self, obj: Any) -> None:
+        if obj is None:
+            return
+        try:
+            self._factory.reset(obj)
+        except Exception:
+            self._factory.destroy(obj)
+            return
+        with self._mu:
+            if len(self._free) < self._max_size:
+                self._free.append(obj)
+                return
+        self._factory.destroy(obj)
+
+    @property
+    def stats(self) -> dict:
+        with self._mu:
+            return {"created": self._ncreated, "free": len(self._free)}

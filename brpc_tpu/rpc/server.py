@@ -40,6 +40,15 @@ class ServerOptions:
     # ServerOptions.redis_service, redis.h:192): a RedisService whose
     # command handlers answer RESP traffic detected by the native parser.
     redis_service: Optional[Any] = None
+    # Catch-all service for unmatched (service, method) — the generic
+    # proxy hook (reference baidu_master_service.{h,cpp}).  An object with
+    # process(cntl, request_bytes) -> bytes; the target names are on
+    # cntl.request_meta.service/.method.
+    master_service: Optional[Any] = None
+    # Per-request pooled session data (reference simple_data_pool +
+    # data_factory.h): a DataFactory, or a zero-arg callable; each request
+    # sees the pooled object as cntl.session_data.
+    session_data_factory: Optional[Any] = None
 
 
 class MethodStatus:
@@ -101,6 +110,24 @@ class Server:
         self._limiter = None
         # http console router installed at start
         self._http_router = None
+        # user HTTP handlers served alongside the builtin console
+        self._http_handlers: dict[str, Any] = {}
+        # pooled per-request session data (simple_data_pool analog)
+        self._session_pool = None
+        if self.options.session_data_factory is not None:
+            from brpc_tpu.rpc.data_pool import SimpleDataPool
+            self._session_pool = SimpleDataPool(
+                self.options.session_data_factory)
+        if self.options.master_service is not None:
+            self._method_status[("*", "*")] = \
+                MethodStatus("master_service/process")
+
+    def add_http_handler(self, path: str, fn) -> "Server":
+        """Register a custom HTTP handler on the console port; fn(req) may
+        return str/bytes, (body, content_type), a full HTTP/1.1 response, or
+        a ProgressiveResponse for chunked push."""
+        self._http_handlers[path] = fn
+        return self
 
     # ---- registry (Server::AddService, server.h:376) ----
 
@@ -275,13 +302,25 @@ class Server:
         key = (meta.service, meta.method)
         spec = self._methods.get(key)
         if spec is None:
-            if meta.service not in self._services:
+            master = self.options.master_service
+            if master is not None:
+                # catch-all dispatch (baidu_master_service: generic method
+                # for proxies, baidu_rpc_protocol.cpp:521-560); raw bytes
+                # in/out, target names readable off cntl.request_meta
+                key = ("*", "*")
+                spec = MethodSpec(
+                    name="process",
+                    fn=lambda cntl, req: master.process(cntl, req),
+                    request_serializer=get_serializer("raw"),
+                    response_serializer=get_serializer("raw"))
+            elif meta.service not in self._services:
                 self._respond_error(sid, meta, errors.ENOSERVICE,
                                     f"unknown service {meta.service!r}")
+                return
             else:
                 self._respond_error(sid, meta, errors.ENOMETHOD,
                                     f"unknown method {meta.method!r}")
-            return
+                return
         # server-level then method-level concurrency (§2.6)
         if self._limiter is not None and not self._limiter.on_requested(
                 self._total_concurrency() + 1):
@@ -317,10 +356,15 @@ class Server:
             request = spec.request_serializer.decode(payload, meta.tensor_header)
             span.request_size = len(raw)
             rpcz.set_current_span(span)
+            if self._session_pool is not None:
+                cntl.session_data = self._session_pool.borrow()
             try:
                 response = spec.fn(cntl, request)
             finally:
                 rpcz.set_current_span(None)
+                if self._session_pool is not None:
+                    self._session_pool.give_back(cntl.session_data)
+                    cntl.session_data = None
             if cntl.failed():
                 error_code = cntl.error_code
                 self._respond_error(sid, meta, cntl.error_code, cntl.error_text)

@@ -1,0 +1,145 @@
+"""Server extras: master (catch-all) service, pooled session data,
+progressive attachment / chunked HTTP push, custom HTTP handlers
+(reference baidu_master_service, simple_data_pool, progressive_attachment).
+"""
+import http.client
+import threading
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+
+
+class TestMasterService:
+    def test_catch_all_dispatch(self):
+        seen = []
+
+        class Proxy:
+            def process(self, cntl, request_bytes):
+                m = cntl.request_meta
+                seen.append((m.service, m.method, request_bytes))
+                return b"proxied:" + request_bytes
+
+        srv = brpc.Server(master_service=Proxy())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            out = ch.call_sync("AnyService", "AnyMethod", b"payload")
+            assert out == b"proxied:payload"
+            assert seen == [("AnyService", "AnyMethod", b"payload")]
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_registered_service_wins_over_master(self):
+        class Echo(brpc.Service):
+            @brpc.method(request="raw", response="raw")
+            def Echo(self, cntl, req):
+                return b"real:" + req
+
+        class Proxy:
+            def process(self, cntl, request_bytes):
+                return b"master"
+
+        srv = brpc.Server(master_service=Proxy())
+        srv.add_service(Echo())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            assert ch.call_sync("Echo", "Echo", b"x") == b"real:x"
+            assert ch.call_sync("Other", "M", b"y") == b"master"
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_no_master_still_errors(self):
+        srv = brpc.Server()
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=2000,
+                              max_retry=0)
+            try:
+                ch.call_sync("Nope", "Nope", b"")
+                assert False, "expected ENOSERVICE"
+            except brpc.RpcError as e:
+                assert e.code == errors.ENOSERVICE
+        finally:
+            srv.stop()
+            srv.join()
+
+
+class TestSessionData:
+    def test_pooled_session_objects(self):
+        created = []
+
+        class SessionData:
+            def __init__(self):
+                created.append(self)
+                self.uses = 0
+
+        class Svc(brpc.Service):
+            NAME = "S"
+
+            @brpc.method(request="json", response="json")
+            def Use(self, cntl, req):
+                assert cntl.session_data is not None
+                cntl.session_data.uses += 1
+                return {"uses": cntl.session_data.uses}
+
+        srv = brpc.Server(session_data_factory=SessionData)
+        srv.add_service(Svc())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            for _ in range(10):
+                r = ch.call_sync("S", "Use", {}, serializer="json")
+                assert r["uses"] >= 1
+            # sequential requests reuse pooled objects instead of creating 10
+            assert len(created) < 10
+            assert srv._session_pool.stats["created"] == len(created)
+        finally:
+            srv.stop()
+            srv.join()
+
+
+class TestProgressive:
+    def test_chunked_http_push(self):
+        def handler(req):
+            def writer(pa):
+                # hand off to another thread: chunks flow after return
+                def pump():
+                    with pa:
+                        for i in range(5):
+                            pa.write(f"chunk-{i};")
+                threading.Thread(target=pump, daemon=True).start()
+            return brpc.ProgressiveResponse(writer,
+                                            content_type="text/plain")
+
+        srv = brpc.Server()
+        srv.add_http_handler("/download", handler)
+        srv.start("127.0.0.1", 0)
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                              timeout=5)
+            conn.request("GET", "/download")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.headers.get("Transfer-Encoding") == "chunked"
+            body = resp.read().decode()
+            assert body == "".join(f"chunk-{i};" for i in range(5))
+            conn.close()
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_custom_http_handler_plain(self):
+        srv = brpc.Server()
+        srv.add_http_handler("/custom", lambda req: ("hello", "text/plain"))
+        srv.start("127.0.0.1", 0)
+        try:
+            import urllib.request
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/custom", timeout=5) as r:
+                assert r.read() == b"hello"
+        finally:
+            srv.stop()
+            srv.join()
