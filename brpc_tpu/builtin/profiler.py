@@ -1,0 +1,147 @@
+"""Profilers behind the /hotspots console pages
+(reference builtin/hotspots_service.cpp driving four profilers: CPU,
+heap, growth, contention — §5.2).  The TPU-build analogs:
+
+  * CPU       — a sampling profiler over sys._current_frames(): stacks of
+                every Python thread at ~100Hz for N seconds, reported in
+                pprof-text and collapsed-flamegraph formats.  This covers
+                the host-side Python layer; native executor/dispatcher
+                threads show up at their Python entry points (callbacks).
+  * heap      — tracemalloc snapshot: top allocation sites.
+  * growth    — tracemalloc diff between the profile start and end.
+  * contention — stacks filtered to lock waits (threading acquire/wait
+                frames), the Python analog of sampled mutex contention
+                (bthread/mutex.cpp:62-107).
+
+All are on-demand (nothing runs until the page is hit), like the
+reference's profilers.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+_WAIT_MARKERS = (
+    ("threading", "wait"), ("threading", "acquire"), ("threading", "join"),
+    ("threading", "_wait_for_tstate_lock"), ("queue", "get"),
+)
+
+
+def _collect_stacks(duration_s: float, hz: int = 100,
+                    contention_only: bool = False) -> Counter:
+    """Sample all thread stacks for duration_s; returns
+    Counter{collapsed_stack: samples}."""
+    stacks: Counter = Counter()
+    me = threading.get_ident()
+    interval = 1.0 / hz
+    end = time.monotonic() + duration_s
+    while time.monotonic() < end:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            entries = traceback.extract_stack(frame)
+            if not entries:
+                continue
+            if contention_only and not _is_waiting(entries):
+                continue
+            collapsed = ";".join(
+                f"{_short(e.filename)}:{e.name}" for e in entries)
+            stacks[collapsed] += 1
+        time.sleep(interval)
+    return stacks
+
+
+def _is_waiting(entries) -> bool:
+    tail = entries[-1]
+    mod = _short(tail.filename).rsplit("/", 1)[-1].removesuffix(".py")
+    for m, fn in _WAIT_MARKERS:
+        if mod == m and tail.name == fn:
+            return True
+    return False
+
+
+def _short(path: str) -> str:
+    for marker in ("/site-packages/", "/python3.", "/brpc_tpu/"):
+        i = path.find(marker)
+        if i >= 0:
+            return ("brpc_tpu/" + path[i + len(marker):]
+                    if marker == "/brpc_tpu/" else path[i + 1:])
+    return path
+
+
+def _render(stacks: Counter, title: str, fmt: str) -> str:
+    total = sum(stacks.values())
+    if fmt == "collapsed":
+        # flamegraph.pl / speedscope input format
+        return "".join(f"{s} {n}\n" for s, n in stacks.most_common())
+    lines = [f"--- {title}: {total} samples, {len(stacks)} unique stacks ---",
+             ""]
+    # leaf-function flat profile (pprof --text style)
+    leafs: Counter = Counter()
+    for s, n in stacks.items():
+        leafs[s.rsplit(";", 1)[-1]] += n
+    lines.append(f"{'samples':>8}  {'%':>6}  leaf function")
+    for fn_name, n in leafs.most_common(30):
+        lines.append(f"{n:>8}  {100.0 * n / max(1, total):>5.1f}%  {fn_name}")
+    lines.append("")
+    lines.append("hottest stacks:")
+    for s, n in stacks.most_common(10):
+        lines.append(f"  [{n} samples]")
+        for fr in s.split(";"):
+            lines.append(f"    {fr}")
+    return "\n".join(lines) + "\n"
+
+
+def cpu_profile(duration_s: float = 1.0, fmt: str = "text") -> str:
+    return _render(_collect_stacks(duration_s), "cpu profile", fmt)
+
+
+def contention_profile(duration_s: float = 1.0, fmt: str = "text") -> str:
+    return _render(_collect_stacks(duration_s, contention_only=True),
+                   "contention profile (threads in lock/queue waits)", fmt)
+
+
+def heap_profile(top: int = 30) -> str:
+    import tracemalloc
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return ("tracemalloc was off — tracing enabled now; "
+                "hit this page again to see allocations.\n")
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")
+    total = sum(s.size for s in stats)
+    lines = [f"--- heap profile: {total / 1e6:.1f} MB tracked, "
+             f"{len(stats)} sites ---", ""]
+    for s in stats[:top]:
+        fr = s.traceback[0]
+        lines.append(f"{s.size / 1024:>10.1f} KB  {s.count:>7} blocks  "
+                     f"{_short(fr.filename)}:{fr.lineno}")
+    return "\n".join(lines) + "\n"
+
+
+def growth_profile(duration_s: float = 1.0, top: int = 30) -> str:
+    import tracemalloc
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+    before = tracemalloc.take_snapshot()
+    time.sleep(duration_s)
+    after = tracemalloc.take_snapshot()
+    diff = after.compare_to(before, "lineno")
+    lines = [f"--- heap growth over {duration_s}s ---", ""]
+    shown = 0
+    for s in diff:
+        if s.size_diff <= 0:
+            continue
+        fr = s.traceback[0]
+        lines.append(f"{s.size_diff / 1024:>+10.1f} KB  "
+                     f"{s.count_diff:>+7} blocks  "
+                     f"{_short(fr.filename)}:{fr.lineno}")
+        shown += 1
+        if shown >= top:
+            break
+    if shown == 0:
+        lines.append("(no growth)")
+    return "\n".join(lines) + "\n"

@@ -156,6 +156,39 @@ def build_routes(server) -> dict:
         except Exception:
             return "ici transport not active\n"
 
+    # /hotspots profilers (hotspots_service.cpp; §5.2) — on-demand, the
+    # ?seconds= and ?fmt=collapsed knobs mirror the reference's query args
+    def hotspots_index(req):
+        return ("profilers: /hotspots/cpu /hotspots/contention "
+                "/hotspots/heap /hotspots/growth\n"
+                "args: ?seconds=N (cpu/contention/growth), "
+                "?fmt=collapsed (flamegraph input)\n")
+
+    def _seconds(req, default=1.0):
+        try:
+            return min(60.0, max(0.05, float(req.query.get("seconds",
+                                                           default))))
+        except ValueError:
+            return default
+
+    def hotspots_cpu(req):
+        from brpc_tpu.builtin import profiler
+        return profiler.cpu_profile(_seconds(req),
+                                    req.query.get("fmt", "text"))
+
+    def hotspots_contention(req):
+        from brpc_tpu.builtin import profiler
+        return profiler.contention_profile(_seconds(req),
+                                           req.query.get("fmt", "text"))
+
+    def hotspots_heap(req):
+        from brpc_tpu.builtin import profiler
+        return profiler.heap_profile()
+
+    def hotspots_growth(req):
+        from brpc_tpu.builtin import profiler
+        return profiler.growth_profile(_seconds(req))
+
     routes = {
         "/": index, "/index": index,
         "/status": status,
@@ -172,6 +205,17 @@ def build_routes(server) -> dict:
         "/protobufs": services_page,
         "/memory": memory,
         "/ici": ici,
+        "/hotspots": hotspots_index,
+        "/hotspots/cpu": hotspots_cpu,
+        "/hotspots/contention": hotspots_contention,
+        "/hotspots/heap": hotspots_heap,
+        "/hotspots/growth": hotspots_growth,
+        # remote-pprof style aliases (pprof_service.*): same data under the
+        # /pprof prefix so generic tooling can scrape it
+        "/pprof/profile": hotspots_cpu,
+        "/pprof/contention": hotspots_contention,
+        "/pprof/heap": hotspots_heap,
+        "/pprof/growth": hotspots_growth,
     }
     return routes
 
