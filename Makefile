@@ -14,6 +14,7 @@ SRC := $(wildcard src/cc/butil/*.cc) \
        $(wildcard src/cc/bvar/*.cc) \
        $(wildcard src/cc/*.cc)
 OBJ := $(SRC:.cc=.o)
+DEP := $(OBJ:.o=.d)
 LIB := brpc_tpu/_core/libbrpc_core.so
 
 all: $(LIB)
@@ -21,11 +22,15 @@ all: $(LIB)
 $(LIB): $(OBJ)
 	$(CXX) $(LDFLAGS) -o $@ $(OBJ)
 
+# -MMD -MP: auto header dependencies (a struct-layout change in a header
+# must rebuild every TU that includes it, or TUs disagree on offsets).
 %.o: %.cc
-	$(CXX) $(CXXFLAGS) -Isrc/cc -c -o $@ $<
+	$(CXX) $(CXXFLAGS) -MMD -MP -Isrc/cc -c -o $@ $<
+
+-include $(DEP)
 
 clean:
-	rm -f $(OBJ) $(LIB)
+	rm -f $(OBJ) $(DEP) $(LIB)
 
 test: $(LIB)
 	python -m pytest tests/ -x -q

@@ -16,7 +16,8 @@ from brpc_tpu.errors import RpcError  # noqa: F401
 from brpc_tpu.rpc import (  # noqa: F401
     CallManager, CallMapper, Channel, ChannelOptions, Controller,
     MethodStatus, ParallelChannel, PartitionChannel, PartitionParser,
-    DataFactory, MemoryRedisService, ProgressiveAttachment,
+    DataFactory, HttpChannel, HttpResponse, HttpStreamReader,
+    MemoryRedisService, ProgressiveAttachment,
     ProgressiveResponse, RedisChannel, RedisError, RedisPipeline,
     RedisService, ResponseMerger, RetryPolicy, SelectiveChannel, Server,
     ServerOptions, Service, SimpleDataPool, SocketMap, Stream,

@@ -15,6 +15,12 @@ import threading
 MSG_TRPC = 0
 MSG_HTTP = 1
 MSG_REDIS = 2
+MSG_MEMCACHE = 3
+MSG_THRIFT = 4
+MSG_MONGO = 5
+MSG_H2 = 6
+MSG_RAW = 7
+MSG_NSHEAD = 8
 
 _here = os.path.dirname(os.path.abspath(__file__))
 _libpath = os.path.join(_here, "libbrpc_core.so")
@@ -85,6 +91,7 @@ _sigs = {
                                                ctypes.c_size_t, ctypes.c_void_p]),
     "brpc_socket_write_raw": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_char_p,
                                              ctypes.c_size_t, ctypes.c_void_p]),
+    "brpc_socket_set_protocol": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_int]),
     "brpc_socket_set_failed": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_int]),
     "brpc_socket_alive": (ctypes.c_int, [ctypes.c_uint64]),
     "brpc_socket_stats": (ctypes.c_int, [ctypes.c_uint64,

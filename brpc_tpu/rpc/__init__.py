@@ -17,6 +17,9 @@ from brpc_tpu.rpc.data_pool import (  # noqa: F401
 from brpc_tpu.rpc.progressive import (  # noqa: F401
     ProgressiveAttachment, ProgressiveResponse,
 )
+from brpc_tpu.rpc.http import (  # noqa: F401
+    HttpChannel, HttpResponse, HttpStreamReader,
+)
 from brpc_tpu.rpc.redis import (  # noqa: F401
     MemoryRedisService, RedisChannel, RedisError, RedisPipeline,
     RedisService,

@@ -13,11 +13,9 @@ import threading
 from typing import Callable, Optional
 
 from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, IOBuf, MESSAGE_CB,
-                            TASK_CB, core, core_init)
-
-MSG_TRPC = 0
-MSG_HTTP = 1
-MSG_REDIS = 2
+                            MSG_H2, MSG_HTTP, MSG_MEMCACHE, MSG_MONGO,
+                            MSG_NSHEAD, MSG_RAW, MSG_REDIS, MSG_THRIFT,
+                            MSG_TRPC, TASK_CB, core, core_init)
 
 
 class Transport:
@@ -124,6 +122,12 @@ class Transport:
 
     def write_raw(self, sid: int, data: bytes) -> int:
         return core.brpc_socket_write_raw(sid, data, len(data), None)
+
+    def set_protocol(self, sid: int, kind: int) -> None:
+        """Pre-select the wire protocol a connection's inbound bytes use
+        (h2 / mongo / raw streaming clients whose first inbound bytes are
+        ambiguous)."""
+        core.brpc_socket_set_protocol(sid, kind)
 
     def close(self, sid: int, err: int = 0) -> None:
         core.brpc_socket_set_failed(sid, err)

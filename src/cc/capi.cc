@@ -149,6 +149,15 @@ int brpc_socket_write_raw(uint64_t sid, const void* data, size_t len,
   return rc;
 }
 
+// Pre-select the wire protocol on a connection (parser.h MessageKind).
+int brpc_socket_set_protocol(uint64_t sid, int kind) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  s->set_forced_protocol(kind);
+  s->Dereference();
+  return 0;
+}
+
 int brpc_socket_set_failed(uint64_t sid, int error_code) {
   return brpc::Socket::SetFailed(sid, error_code);
 }

@@ -29,6 +29,11 @@ void make_trpc_header(char out[16], uint32_t meta_size, uint64_t body_size) {
   store_be32(out + 12, (uint32_t)body_size);
 }
 
+static uint32_t load_le32(const char* p) {
+  return ((uint32_t)(uint8_t)p[3] << 24) | ((uint32_t)(uint8_t)p[2] << 16) |
+         ((uint32_t)(uint8_t)p[1] << 8) | (uint32_t)(uint8_t)p[0];
+}
+
 static bool looks_like_http(const char* p, size_t n) {
   // Methods the console/RESTful layer accepts, plus response lines.
   static const char* kTokens[] = {"GET ",  "POST ",   "PUT ",  "DELETE ",
@@ -82,19 +87,85 @@ static ParseResult parse_http(butil::IOBuf* in, ParseState* st,
             return PARSE_ERROR;
         } else if (key == "transfer-encoding" &&
                    val.find("chunked") != std::string::npos) {
-          return PARSE_ERROR;  // chunked unsupported in the native core
+          st->http_body_len = -2;  // chunked: scan chunk sizes below
         }
       }
       line = end;
     }
   }
-  const size_t total = st->http_header_end + (size_t)st->http_body_len;
+  size_t total;
+  if (st->http_body_len == -2) {
+    // Chunked body: walk "SIZE\r\n" + data + "\r\n" until the 0-chunk,
+    // then consume trailers up to the final CRLF.  The whole message
+    // (headers + raw chunked body) is delivered; Python de-chunks.
+    // Scan resumes at http_chunk_off so incremental arrival costs O(n),
+    // not O(n^2), on the dispatcher thread.
+    if (st->http_chunk_off < st->http_header_end)
+      st->http_chunk_off = st->http_header_end;
+    // http_chunk_off always points at the START of a chunk-size line; it
+    // only advances past fully-buffered chunks, so resuming re-reads at
+    // most one size line + the trailers (never chunk payload as a size).
+    size_t off = st->http_chunk_off;
+    char win[4096];  // size line incl. chunk extensions must fit
+    while (true) {
+      const size_t line_start = off;
+      const size_t n = in->copy_to(win, sizeof(win), off);
+      size_t i = 0;
+      // parse hex size up to ';' or CR
+      long long v = 0;
+      bool any = false;
+      for (; i < n; ++i) {
+        const char c = win[i];
+        int d;
+        if (c >= '0' && c <= '9') d = c - '0';
+        else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+        else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+        else break;
+        v = v * 16 + d;
+        any = true;
+        if (v > (long long)g_max_body_size) return PARSE_ERROR;
+      }
+      if (!any) return (n < 1) ? PARSE_NEED_MORE : PARSE_ERROR;
+      // skip chunk extensions to CRLF
+      while (i < n && win[i] != '\n') ++i;
+      if (i >= n) return (n == sizeof(win)) ? PARSE_ERROR : PARSE_NEED_MORE;
+      const long long sz = v;
+      off += i + 1;
+      if (sz == 0) {
+        // trailers: consume lines until empty line
+        while (true) {
+          char tw[4096];
+          const size_t tn = in->copy_to(tw, sizeof(tw), off);
+          size_t j = 0;
+          while (j < tn && tw[j] != '\n') ++j;
+          if (j >= tn)
+            return (tn == sizeof(tw)) ? PARSE_ERROR : PARSE_NEED_MORE;
+          const bool empty_line = (j == 0) || (j == 1 && tw[0] == '\r');
+          off += j + 1;
+          if (empty_line) break;
+        }
+        total = off;
+        break;
+      }
+      off += (size_t)sz + 2;  // data + CRLF
+      if (off > g_max_body_size) return PARSE_ERROR;  // cumulative cap
+      if (in->size() < off) {
+        st->http_chunk_off = line_start;  // resume at this size line
+        return PARSE_NEED_MORE;
+      }
+      st->http_chunk_off = off;  // chunk fully buffered; next size line
+    }
+    if (in->size() < total) return PARSE_NEED_MORE;
+  } else {
+    total = st->http_header_end + (size_t)st->http_body_len;
+  }
   if (in->size() < total) return PARSE_NEED_MORE;
   out->kind = MSG_HTTP;
   out->meta.clear();
   in->cutn(&out->body, total);
   st->http_header_end = 0;
   st->http_body_len = -1;
+  st->http_chunk_off = 0;
   return PARSE_OK;
 }
 
@@ -190,15 +261,169 @@ static bool looks_like_redis(char c) {
   return c == '*' || c == '+' || c == '-' || c == ':' || c == '$';
 }
 
+// ---- memcache binary (reference policy/memcache_binary_protocol.cpp):
+// 24-byte header, total body length big-endian at offset 8. -----------------
+static ParseResult parse_memcache(butil::IOBuf* in, ParsedMessage* out) {
+  char hdr[24];
+  if (in->copy_to(hdr, 24, 0) < 24) return PARSE_NEED_MORE;
+  if ((uint8_t)hdr[0] != 0x80 && (uint8_t)hdr[0] != 0x81) return PARSE_ERROR;
+  const uint32_t body = load_be32(hdr + 8);
+  if (body > g_max_body_size) return PARSE_ERROR;
+  const size_t total = 24 + (size_t)body;
+  if (in->size() < total) return PARSE_NEED_MORE;
+  out->kind = MSG_MEMCACHE;
+  out->meta.clear();
+  out->body.clear();
+  in->cutn(&out->body, total);
+  return PARSE_OK;
+}
+
+// ---- framed thrift (reference policy/thrift_protocol.cpp): u32be length +
+// TBinaryProtocol payload starting 0x80 0x01. ------------------------------
+static ParseResult parse_thrift(butil::IOBuf* in, ParsedMessage* out) {
+  char hdr[6];
+  if (in->copy_to(hdr, 6, 0) < 6) return PARSE_NEED_MORE;
+  const uint32_t len = load_be32(hdr);
+  if ((uint8_t)hdr[4] != 0x80 || (uint8_t)hdr[5] != 0x01) return PARSE_ERROR;
+  if (len > g_max_body_size || len < 2) return PARSE_ERROR;
+  const size_t total = 4 + (size_t)len;
+  if (in->size() < total) return PARSE_NEED_MORE;
+  in->pop_front(4);
+  out->kind = MSG_THRIFT;
+  out->meta.clear();
+  out->body.clear();
+  in->cutn(&out->body, len);
+  return PARSE_OK;
+}
+
+// ---- mongo wire (reference policy/mongo_protocol.cpp): 16-byte LE header
+// {messageLength, requestID, responseTo, opCode}. --------------------------
+static bool mongo_known_opcode(uint32_t op) {
+  return op == 1 /*OP_REPLY*/ || op == 2004 /*OP_QUERY*/ ||
+         op == 2010 /*OP_COMMAND*/ || op == 2011 /*OP_COMMANDREPLY*/ ||
+         op == 2012 /*OP_COMPRESSED*/ || op == 2013 /*OP_MSG*/;
+}
+
+static ParseResult parse_mongo(butil::IOBuf* in, ParsedMessage* out) {
+  char hdr[16];
+  if (in->copy_to(hdr, 16, 0) < 16) return PARSE_NEED_MORE;
+  const uint32_t len = load_le32(hdr);
+  const uint32_t op = load_le32(hdr + 12);
+  if (!mongo_known_opcode(op) || len < 16 || len > g_max_body_size)
+    return PARSE_ERROR;
+  if (in->size() < len) return PARSE_NEED_MORE;
+  out->kind = MSG_MONGO;
+  out->meta.clear();
+  out->body.clear();
+  in->cutn(&out->body, len);
+  return PARSE_OK;
+}
+
+// ---- nshead (reference policy/nshead_protocol.cpp): 36-byte LE header with
+// magic 0xfb709394 at offset 24, body_len at offset 32. --------------------
+static constexpr uint32_t kNsheadMagic = 0xfb709394u;
+
+static ParseResult parse_nshead(butil::IOBuf* in, ParsedMessage* out) {
+  char hdr[36];
+  if (in->copy_to(hdr, 36, 0) < 36) return PARSE_NEED_MORE;
+  if (load_le32(hdr + 24) != kNsheadMagic) return PARSE_ERROR;
+  const uint32_t body = load_le32(hdr + 32);
+  if (body > g_max_body_size) return PARSE_ERROR;
+  const size_t total = 36 + (size_t)body;
+  if (in->size() < total) return PARSE_NEED_MORE;
+  out->kind = MSG_NSHEAD;
+  out->meta.assign(hdr, 36);
+  in->pop_front(36);
+  out->body.clear();
+  in->cutn(&out->body, body);
+  return PARSE_OK;
+}
+
+// ---- HTTP/2 (reference policy/http2_rpc_protocol.cpp): 24-byte client
+// preface then 9-byte-header frames; each frame is one message with the
+// header in meta. ----------------------------------------------------------
+static const char kH2Preface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+static constexpr size_t kH2PrefaceLen = 24;
+static constexpr size_t kH2MaxFrame = 16 * 1024 * 1024;
+
+static ParseResult parse_h2(butil::IOBuf* in, ParseState* st,
+                            ParsedMessage* out) {
+  if (!st->h2_preface_done) {
+    char pre[kH2PrefaceLen];
+    const size_t got = in->copy_to(pre, kH2PrefaceLen, 0);
+    const size_t cmp = got < 4 ? got : 4;
+    if (memcmp(pre, "PRI ", cmp) == 0) {
+      // Looks like (a prefix of) the client preface; don't commit to
+      // frame mode until enough bytes arrive to be sure.
+      if (got < kH2PrefaceLen) return PARSE_NEED_MORE;
+      if (memcmp(pre, kH2Preface, kH2PrefaceLen) != 0) return PARSE_ERROR;
+      in->pop_front(kH2PrefaceLen);
+    }
+    // Server-to-client traffic (and post-preface frames) have no preface.
+    st->h2_preface_done = true;
+  }
+  char hdr[9];
+  if (in->copy_to(hdr, 9, 0) < 9) return PARSE_NEED_MORE;
+  const uint32_t len = ((uint32_t)(uint8_t)hdr[0] << 16) |
+                       ((uint32_t)(uint8_t)hdr[1] << 8) | (uint8_t)hdr[2];
+  if (len > kH2MaxFrame) return PARSE_ERROR;
+  const size_t total = 9 + (size_t)len;
+  if (in->size() < total) return PARSE_NEED_MORE;
+  out->kind = MSG_H2;
+  out->meta.assign(hdr, 9);
+  in->pop_front(9);
+  out->body.clear();
+  in->cutn(&out->body, len);
+  return PARSE_OK;
+}
+
+static ParseResult parse_raw(butil::IOBuf* in, ParsedMessage* out) {
+  out->kind = MSG_RAW;
+  out->meta.clear();
+  out->body.clear();
+  in->cutn(&out->body, in->size());
+  return PARSE_OK;
+}
+
 ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) {
   if (in->empty()) return PARSE_NEED_MORE;
-  if (st->detected == MSG_HTTP) return parse_http(in, st, out);
-  if (st->detected == MSG_REDIS) return parse_redis(in, out);
+  switch (st->detected) {
+    case MSG_HTTP: return parse_http(in, st, out);
+    case MSG_REDIS: return parse_redis(in, out);
+    case MSG_MEMCACHE: return parse_memcache(in, out);
+    case MSG_THRIFT: return parse_thrift(in, out);
+    case MSG_MONGO: return parse_mongo(in, out);
+    case MSG_H2: return parse_h2(in, st, out);
+    case MSG_RAW: return parse_raw(in, out);
+    case MSG_NSHEAD: return parse_nshead(in, out);
+    default: break;
+  }
 
   char hdr[kTrpcHeaderLen];
   const size_t got = in->copy_to(hdr, kTrpcHeaderLen, 0);
   if (memcmp(hdr, kTrpcMagic, got < 4 ? got : 4) != 0) {
     // Not TRPC: try-next-protocol (input_messenger.cpp:144-160 pattern).
+    if (got >= 4 && memcmp(hdr, "PRI ", 4) == 0) {
+      st->detected = MSG_H2;
+      return parse_h2(in, st, out);
+    }
+    // nshead's magic sits at offset 24; when enough bytes are buffered,
+    // check it before the single-byte detectors (an nshead id whose low
+    // byte happens to be '*', 'G', 0x80, … would otherwise misdetect as
+    // redis/http/memcache).  A magic's 2^-32 false-positive rate against
+    // binary redis payloads is far below the ASCII-collision rate of
+    // nshead ids.  If an nshead header trickles in fewer than 28 bytes at
+    // a time AND its id low byte collides, the single-byte detector wins —
+    // same inherent ambiguity the reference resolves by try-order
+    // (input_messenger.cpp:144-160).
+    {
+      char nh[28];
+      if (in->copy_to(nh, 28, 0) >= 28 &&
+          load_le32(nh + 24) == kNsheadMagic) {
+        st->detected = MSG_NSHEAD;
+        return parse_nshead(in, out);
+      }
+    }
     if (looks_like_redis(hdr[0])) {
       st->detected = MSG_REDIS;
       return parse_redis(in, out);
@@ -207,6 +432,38 @@ ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) 
       st->detected = MSG_HTTP;
       return parse_http(in, st, out);
     }
+    if ((uint8_t)hdr[0] == 0x80 || (uint8_t)hdr[0] == 0x81) {
+      // Could still be nshead if fewer than 28 bytes have arrived.  Decide
+      // memcache only once either (a) 28 bytes are here and the nshead
+      // check above failed, or (b) the complete candidate memcache packet
+      // is shorter than 28 bytes and fully buffered (it can never grow to
+      // reveal nshead's magic).
+      if (in->size() < 28) {
+        char mh[12];
+        if (in->copy_to(mh, 12, 0) < 12) return PARSE_NEED_MORE;
+        const uint32_t mc_total = 24 + load_be32(mh + 8);
+        if (in->size() < mc_total) return PARSE_NEED_MORE;
+      }
+      st->detected = MSG_MEMCACHE;
+      return parse_memcache(in, out);
+    }
+    if (got >= 6 && (uint8_t)hdr[4] == 0x80 && (uint8_t)hdr[5] == 0x01) {
+      st->detected = MSG_THRIFT;
+      return parse_thrift(in, out);
+    }
+    if (got >= 16) {
+      const uint32_t op = load_le32(hdr + 12);
+      if (mongo_known_opcode(op) && load_le32(hdr) >= 16) {
+        st->detected = MSG_MONGO;
+        return parse_mongo(in, out);
+      }
+    }
+    // Fewer than 28 bytes can't yet rule out the longer-magic framings
+    // (thrift @6, mongo @16, nshead @28) — same contract as the
+    // reference's PARSE_ERROR_NOT_ENOUGH_DATA: wait rather than guess.
+    // Short pure-garbage connections stay open until idle-close, exactly
+    // like a half-sent frame would.
+    if (in->size() < 28) return PARSE_NEED_MORE;
     return PARSE_ERROR;
   }
   if (got < kTrpcHeaderLen) return PARSE_NEED_MORE;

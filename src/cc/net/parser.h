@@ -3,18 +3,26 @@
 // The extension point in the reference is `struct Protocol` — a function
 // table tried in order until one recognizes the bytes, which is how all
 // protocols share one port (protocol.h:77-166, input_messenger.cpp:144-160).
-// Our native core implements the same try-in-order scheme over two built-in
+// Our native core implements the same try-in-order scheme over the built-in
 // framings, and hands *complete messages* (not bytes) upward; higher-level
-// protocol semantics (method dispatch, JSON↔tensor mapping, redis RESP, …)
-// live in the Python protocol registry which receives (kind, meta, body).
+// protocol semantics (method dispatch, JSON↔tensor mapping, redis RESP,
+// HPACK, BSON, …) live in the Python protocol registry which receives
+// (kind, meta, body).
 //
 //  * TRPC framing (our baidu_std analog, reference baidu_rpc_protocol.cpp:
 //    97-137): 16-byte header = "TRPC" + u32be meta_size + u64be body_size,
 //    then meta bytes, then body bytes.  Meta is opaque to the core.
 //  * HTTP/1.x detection: request/status line + headers until CRLFCRLF +
-//    content-length body, delivered as one raw message (kind HTTP).  Enough
-//    for the builtin debug console and RESTful access; chunked uploads are
-//    handled by the Python layer over streaming reads in a later round.
+//    content-length or chunked body, delivered as one raw message (kind
+//    HTTP).  Enough for the debug console, RESTful access and the HTTP
+//    client channel.
+//  * HTTP/2: the 24-byte client preface is consumed, then each 9-byte-header
+//    frame is delivered as one message (meta = frame header, body =
+//    payload).  Clients pre-select h2 via set_protocol.
+//  * memcache binary / framed thrift / mongo wire / nshead: length-prefixed
+//    framings detected by magic (reference policy/memcache_binary_protocol
+//    .cpp, policy/thrift_protocol.cpp, policy/mongo_protocol.cpp,
+//    policy/nshead_protocol.cpp).
 #pragma once
 
 #include <cstddef>
@@ -36,6 +44,28 @@ enum MessageKind {
   // delivers MSG_REDIS inline on its dispatcher thread instead of fanning
   // out to the executor (see Socket::DispatchMessages).
   MSG_REDIS = 2,
+  // One memcache binary-protocol packet (24-byte header + body), delivered
+  // whole in body.  Detected by magic 0x80/0x81.
+  MSG_MEMCACHE = 3,
+  // One framed thrift message; body holds the payload WITHOUT the 4-byte
+  // frame length.  Detected by TBinaryProtocol version bytes 0x80 0x01 at
+  // offset 4.
+  MSG_THRIFT = 4,
+  // One mongo wire-protocol message including its 16-byte header, delivered
+  // whole in body.  Detected by a plausible little-endian messageLength +
+  // known opCode.  Ambiguous with redis for tiny messages — mongo clients
+  // should set_protocol().
+  MSG_MONGO = 5,
+  // One HTTP/2 frame: meta = the 9-byte frame header, body = payload.  The
+  // connection preface (PRI * HTTP/2.0...) is consumed silently when seen.
+  MSG_H2 = 6,
+  // Raw passthrough: whatever bytes are buffered are delivered as one
+  // message.  Selected only explicitly via set_protocol (progressive /
+  // chunked streaming readers).
+  MSG_RAW = 7,
+  // One nshead message: meta = the 36-byte nshead header, body = body.
+  // Detected by magic 0xfb709394 at offset 24.
+  MSG_NSHEAD = 8,
 };
 
 enum ParseResult {
@@ -59,7 +89,11 @@ struct ParseState {
   int detected = -1;     // -1 unknown, else MessageKind
   // http incremental state
   size_t http_header_end = 0;   // offset past CRLFCRLF once found
-  ssize_t http_body_len = -1;   // from content-length
+  ssize_t http_body_len = -1;   // from content-length; -2 = chunked
+  // chunked-scan resume point: absolute offset of the next unvalidated
+  // chunk-size line (avoids re-walking validated chunks each dispatch)
+  size_t http_chunk_off = 0;
+  bool h2_preface_done = false;
 };
 
 // Try to cut one message off `in`.  On PARSE_OK, fills *out and removes the
@@ -68,5 +102,12 @@ ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out);
 
 // Serialize a TRPC frame header.
 void make_trpc_header(char out[16], uint32_t meta_size, uint64_t body_size);
+
+// Whether a message kind must be delivered inline on the dispatcher thread
+// (per-connection FIFO is part of the protocol contract: RESP pipelining,
+// h2 HPACK state, memcache pipelining, raw streaming, …).
+inline bool kind_requires_fifo(int kind) {
+  return kind != MSG_TRPC && kind != MSG_HTTP;
+}
 
 }  // namespace brpc

@@ -46,6 +46,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->_out_buf.clear();
   s->_read_buf.clear();
   s->_parse = ParseState();
+  s->_forced_protocol.store(-1, std::memory_order_relaxed);
   s->_write_stack.store(nullptr, std::memory_order_relaxed);
   s->_write_busy.store(false, std::memory_order_relaxed);
   s->_waiting_epollout.store(false, std::memory_order_relaxed);
@@ -300,6 +301,10 @@ static void run_message_task(void* arg) {
 
 void Socket::DispatchMessages() {
   ParsedMessage msg;
+  if (_parse.detected == -1) {
+    const int forced = _forced_protocol.load(std::memory_order_acquire);
+    if (forced >= 0) _parse.detected = forced;
+  }
   while (true) {
     const ParseResult r = parse_message(&_read_buf, &_parse, &msg);
     if (r == PARSE_NEED_MORE) return;
@@ -326,11 +331,12 @@ void Socket::DispatchMessages() {
       msg.body.clear();
       continue;
     }
-    if (msg.kind == MSG_REDIS) {
-      // RESP has no correlation ids — per-connection FIFO is the protocol
-      // contract.  Deliver inline on the dispatcher thread (sequential per
-      // fd) instead of fanning out to the work-stealing executor, which
-      // would reorder commands/replies.
+    if (kind_requires_fifo(msg.kind)) {
+      // RESP/memcache pipelining, h2 HPACK + stream state, thrift/mongo
+      // reply order and raw streaming all make per-connection FIFO part of
+      // the protocol contract.  Deliver inline on the dispatcher thread
+      // (sequential per fd) instead of fanning out to the work-stealing
+      // executor, which would reorder messages.
       auto* body = new butil::IOBuf(std::move(msg.body));
       _opts.on_message(_id, msg.kind, msg.meta.data(), msg.meta.size(), body,
                        _opts.user);

@@ -93,6 +93,14 @@ class Socket {
   int64_t remote_port() const { return _remote_port; }
   const char* remote_ip() const { return _remote_ip; }
 
+  // Pre-select the wire protocol for this connection (client sockets whose
+  // peer's first bytes are ambiguous or absent: h2 upgrades, mongo, raw
+  // streaming reads).  Safe to call before the first byte arrives; applied
+  // by the dispatcher thread at next parse.
+  void set_forced_protocol(int kind) {
+    _forced_protocol.store(kind, std::memory_order_release);
+  }
+
   // ---- called by EventDispatcher ----
   void OnReadable();
   void OnWritable();
@@ -126,6 +134,7 @@ class Socket {
   // read path
   butil::IOPortal _read_buf;
   ParseState _parse;
+  std::atomic<int> _forced_protocol{-1};
 
   std::atomic<int64_t> _nread{0}, _nwritten{0}, _nmsg{0};
   char _remote_ip[46] = {0};

@@ -1,0 +1,160 @@
+"""HTTP/1.1 client channel tests (reference http_rpc_protocol client side):
+keep-alive requests against the builtin console, the RESTful JSON bridge,
+chunked responses (both whole-message and incremental streaming reads)."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+
+
+@pytest.fixture(scope="module")
+def server():
+    class Calc(brpc.Service):
+        @brpc.method(request="json", response="json")
+        def Add(self, cntl, req):
+            return {"sum": req["a"] + req["b"]}
+
+        @brpc.method(request="json", response="json")
+        def Fail(self, cntl, req):
+            cntl.set_failed(errors.EINTERNAL, "deliberate")
+            return None
+
+    s = brpc.Server()
+    s.add_service(Calc())
+
+    def chunked_handler(req):
+        def writer(pa):
+            def run():
+                for i in range(5):
+                    pa.write(f"part{i};")
+                pa.close()
+            threading.Thread(target=run, daemon=True).start()
+        return brpc.ProgressiveResponse(writer, content_type="text/plain")
+
+    s.add_http_handler("/chunks", chunked_handler)
+    s.add_http_handler("/plain", lambda req: ("hello http", "text/plain"))
+    s.start("127.0.0.1", 0)
+    yield s
+    s.stop()
+    s.join()
+
+
+def test_get_console_page(server):
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}")
+    r = ch.get("/status")
+    assert r.ok
+    assert b"Calc" in r.body
+    # keep-alive: second request on the same connection
+    r2 = ch.get("/vars")
+    assert r2.ok
+    ch.close()
+
+
+def test_custom_handler_and_headers(server):
+    ch = brpc.HttpChannel(f"http://127.0.0.1:{server.port}")
+    r = ch.get("/plain")
+    assert r.ok and r.body == b"hello http"
+    assert "text/plain" in r.headers["content-type"]
+    ch.close()
+
+
+def test_restful_call(server):
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}")
+    out = ch.call("Calc", "Add", {"a": 2, "b": 40})
+    assert out == {"sum": 42}
+    with pytest.raises(errors.RpcError) as ei:
+        ch.call("Calc", "Fail", {})
+    assert ei.value.code == errors.EINTERNAL
+    with pytest.raises(errors.RpcError):
+        ch.call("Nope", "Nothing", {})
+    ch.close()
+
+
+def test_chunked_whole_message(server):
+    """The native parser frames a complete chunked response; the client
+    de-chunks it into body."""
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}", timeout_ms=5000)
+    r = ch.get("/chunks")
+    assert r.ok
+    assert r.body == b"part0;part1;part2;part3;part4;"
+    ch.close()
+
+
+def test_streaming_reader(server):
+    """Progressive read: chunks delivered incrementally on a raw-mode
+    connection (progressive_attachment reader side)."""
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}")
+    got = []
+    done = threading.Event()
+    reader = ch.request_stream("GET", "/chunks", on_data=got.append,
+                               on_end=done.set)
+    assert reader.wait(5.0)
+    assert done.is_set()
+    assert b"".join(got) == b"part0;part1;part2;part3;part4;"
+    assert reader.response is not None and reader.response.ok
+    ch.close()
+
+
+def test_head_request(server):
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}")
+    r = ch.request("HEAD", "/plain")
+    assert r.ok and r.body == b""
+    assert int(r.headers["content-length"]) == len(b"hello http")
+    ch.close()
+
+
+def test_large_split_chunks(server):
+    """Chunks bigger than one TCP segment must reassemble (the chunk-scan
+    resume bug class: payload re-parsed as a size line)."""
+    big = b"x" * 300_000
+
+    def handler(req):
+        def writer(pa):
+            def run():
+                pa.write(big)
+                pa.write(b"END")
+                pa.close()
+            threading.Thread(target=run, daemon=True).start()
+        return brpc.ProgressiveResponse(writer)
+
+    server.add_http_handler("/big", handler)
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}", timeout_ms=10000)
+    r = ch.get("/big")
+    assert r.ok and r.body == big + b"END"
+    ch.close()
+
+
+def test_stream_reader_truncation_sets_error(server):
+    """A progressive push that dies mid-body must surface an error, not a
+    clean end."""
+    def handler(req):
+        def writer(pa):
+            def run():
+                pa.write(b"partial")
+                # kill the connection without the terminal chunk
+                from brpc_tpu.rpc.transport import Transport
+                Transport.instance().close(pa._sid)
+            threading.Thread(target=run, daemon=True).start()
+        return brpc.ProgressiveResponse(writer)
+
+    server.add_http_handler("/dies", handler)
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}")
+    got = []
+    reader = ch.request_stream("GET", "/dies", on_data=got.append)
+    assert reader.wait(5)
+    assert reader.error is not None
+    ch.close()
+
+
+def test_timeout_and_reconnect(server):
+    ch = brpc.HttpChannel(f"127.0.0.1:{server.port}", timeout_ms=2000)
+    r = ch.get("/plain")
+    assert r.ok
+    # sever the connection under the channel; next request reconnects
+    ch.close()
+    r = ch.get("/plain")
+    assert r.ok and r.body == b"hello http"
+    ch.close()

@@ -1,0 +1,150 @@
+"""Native multi-protocol detection & framing edge cases (reference
+input_messenger.cpp try-in-order contract + per-protocol parsers)."""
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.rpc.transport import (MSG_H2, MSG_MEMCACHE, MSG_MONGO,
+                                    MSG_NSHEAD, MSG_RAW, MSG_THRIFT,
+                                    Transport)
+
+NSHEAD_MAGIC = 0xFB709394
+
+
+@pytest.fixture()
+def listener():
+    frames = []
+    ev = threading.Event()
+
+    def on_msg(sid, kind, meta, body):
+        frames.append((kind, meta, body.to_bytes()))
+        ev.set()
+
+    t = Transport.instance()
+    sid, port = t.listen("127.0.0.1", 0, on_msg)
+    yield port, frames, ev
+    t.close(sid)
+
+
+def _wait_frames(frames, ev, n, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while len(frames) < n and time.monotonic() < deadline:
+        ev.wait(0.1)
+        ev.clear()
+    return len(frames) >= n
+
+
+def test_memcache_packet(listener):
+    port, frames, ev = listener
+    pkt = struct.pack(">BBHBBHIIQ", 0x80, 0x01, 3, 0, 0, 0, 8, 7, 0) + \
+        b"keyvalue"[:8]
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(pkt)
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_MEMCACHE and frames[0][2] == pkt
+
+
+def test_thrift_framed(listener):
+    port, frames, ev = listener
+    payload = b"\x80\x01\x00\x01\x00\x00\x00\x04echo\x00\x00\x00\x01\x00"
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(struct.pack(">I", len(payload)) + payload)
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_THRIFT and frames[0][2] == payload
+
+
+def test_mongo_op_msg(listener):
+    port, frames, ev = listener
+    body = b"\x00\x00\x00\x00\x00" + b"\x05\x00\x00\x00\x00"
+    msg = struct.pack("<iiii", 16 + len(body), 9, 0, 2013) + body
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(msg)
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_MONGO and frames[0][2] == msg
+
+
+def test_nshead_id_collides_with_redis_char(listener):
+    """An nshead header whose id low byte is '*' (0x2A) must still be
+    detected as nshead when the header arrives whole — the magic at offset
+    24 outranks single-byte detection."""
+    port, frames, ev = listener
+    hdr = struct.pack("<HHI16sIII", 0x2A, 1, 7, b"svc", NSHEAD_MAGIC, 0, 4)
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(hdr + b"body")
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_NSHEAD
+    assert frames[0][1] == hdr and frames[0][2] == b"body"
+
+
+def test_nshead_id_collides_with_memcache_magic(listener):
+    port, frames, ev = listener
+    hdr = struct.pack("<HHI16sIII", 0x80, 1, 7, b"svc", NSHEAD_MAGIC, 0, 2)
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(hdr + b"ok")
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_NSHEAD and frames[0][2] == b"ok"
+
+
+def test_h2_preface_trickle(listener):
+    """Preface delivered byte-by-byte must not be misread as a frame."""
+    port, frames, ev = listener
+    c = socket.create_connection(("127.0.0.1", port))
+    preface = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+    for i in range(0, len(preface), 3):
+        c.sendall(preface[i : i + 3])
+        time.sleep(0.01)
+    frame = b"\x00\x00\x02\x00\x01\x00\x00\x00\x01" + b"hi"
+    c.sendall(frame)
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_H2
+    assert frames[0][1] == frame[:9] and frames[0][2] == b"hi"
+
+
+def test_h2_frames_after_preface_same_segment(listener):
+    port, frames, ev = listener
+    c = socket.create_connection(("127.0.0.1", port))
+    settings = b"\x00\x00\x00\x04\x00\x00\x00\x00\x00"
+    data = b"\x00\x00\x03\x00\x00\x00\x00\x00\x01abc"
+    c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + settings + data)
+    assert _wait_frames(frames, ev, 2)
+    assert [f[0] for f in frames[:2]] == [MSG_H2, MSG_H2]
+    assert frames[1][2] == b"abc"
+
+
+def test_forced_raw_mode():
+    t = Transport.instance()
+    got = []
+    ev = threading.Event()
+
+    def on_msg(sid, kind, meta, body):
+        got.append((kind, body.to_bytes()))
+        ev.set()
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    sid = t.connect("127.0.0.1", srv.getsockname()[1], on_msg)
+    t.set_protocol(sid, MSG_RAW)
+    conn, _ = srv.accept()
+    conn.sendall(b"\x00\x01\x02 not any protocol \xff")
+    assert ev.wait(3)
+    assert got[0][0] == MSG_RAW and b"not any protocol" in got[0][1]
+    t.close(sid)
+    srv.close()
+
+
+def test_split_memcache_below_28_bytes(listener):
+    """A 24-byte bodyless memcache packet (total < 28) must be framed once
+    fully buffered even though the nshead disambiguation window (28 bytes)
+    can never fill."""
+    port, frames, ev = listener
+    pkt = struct.pack(">BBHBBHIIQ", 0x81, 0x0A, 0, 0, 0, 0, 0, 1, 0)
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(pkt[:10])
+    time.sleep(0.05)
+    c.sendall(pkt[10:])
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_MEMCACHE and frames[0][2] == pkt
