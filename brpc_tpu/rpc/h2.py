@@ -1,0 +1,650 @@
+"""HTTP/2 + gRPC protocol — frame state machine, flow control, unary gRPC
+client and server dispatch on the shared port.
+
+Reference: policy/http2_rpc_protocol.cpp (1,840 LoC), details/hpack.cpp
+(→ brpc_tpu/rpc/hpack.py), grpc.cpp (status mapping).  The native core
+frames one complete h2 frame per message (MSG_H2, src/cc/net/parser.cc:
+parse_h2 — 9-byte header in meta, payload in body) and auto-detects the
+client preface on the shared port, so any real gRPC client that connects
+to an rpc Server's port lands here.
+
+Scope: full connection management (SETTINGS/PING/GOAWAY/RST_STREAM/
+WINDOW_UPDATE, HEADERS+CONTINUATION assembly, PADDED/PRIORITY flags) and
+unary gRPC calls (the reference's gRPC support is unary pb over h2).
+Flow control: both directions, credit-based per RFC 7540 §5.2 — the same
+producer/consumer windowing the reference uses for StreamWrite (SURVEY §5.7).
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.rpc.hpack import HpackDecoder, HpackEncoder
+from brpc_tpu.rpc.transport import MSG_H2, Transport
+
+# frame types (RFC 7540 §6)
+DATA, HEADERS, PRIORITY, RST_STREAM, SETTINGS, PUSH_PROMISE, PING, GOAWAY, \
+    WINDOW_UPDATE, CONTINUATION = range(10)
+
+FLAG_END_STREAM = 0x1
+FLAG_ACK = 0x1
+FLAG_END_HEADERS = 0x4
+FLAG_PADDED = 0x8
+FLAG_PRIORITY = 0x20
+
+SETTINGS_HEADER_TABLE_SIZE = 0x1
+SETTINGS_ENABLE_PUSH = 0x2
+SETTINGS_MAX_CONCURRENT_STREAMS = 0x3
+SETTINGS_INITIAL_WINDOW_SIZE = 0x4
+SETTINGS_MAX_FRAME_SIZE = 0x5
+SETTINGS_MAX_HEADER_LIST_SIZE = 0x6
+
+DEFAULT_WINDOW = 65535
+OUR_WINDOW = 1 << 20          # per-stream window we advertise
+OUR_CONN_WINDOW = 64 << 20    # connection window we grow to
+OUR_MAX_FRAME = 1 << 20
+
+H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+# h2 error codes (RFC 7540 §7)
+H2_NO_ERROR, H2_PROTOCOL_ERROR, H2_INTERNAL_ERROR, H2_FLOW_CONTROL_ERROR = \
+    0, 1, 2, 3
+
+# gRPC status codes (grpc.cpp's ErrorCodeToGrpcStatus analog)
+GRPC_OK = 0
+GRPC_UNKNOWN = 2
+GRPC_DEADLINE_EXCEEDED = 4
+GRPC_NOT_FOUND = 5
+GRPC_PERMISSION_DENIED = 7
+GRPC_RESOURCE_EXHAUSTED = 8
+GRPC_UNIMPLEMENTED = 12
+GRPC_INTERNAL = 13
+GRPC_UNAVAILABLE = 14
+GRPC_UNAUTHENTICATED = 16
+
+_ERR_TO_GRPC = {
+    0: GRPC_OK,
+    errors.ENOSERVICE: GRPC_UNIMPLEMENTED,
+    errors.ENOMETHOD: GRPC_UNIMPLEMENTED,
+    errors.ERPCTIMEDOUT: GRPC_DEADLINE_EXCEEDED,
+    errors.ELIMIT: GRPC_RESOURCE_EXHAUSTED,
+    errors.ELOGOFF: GRPC_UNAVAILABLE,
+    errors.ERPCAUTH: GRPC_UNAUTHENTICATED,
+    errors.EREJECT: GRPC_PERMISSION_DENIED,
+    errors.EINTERNAL: GRPC_INTERNAL,
+}
+_GRPC_TO_ERR = {
+    GRPC_OK: 0,
+    GRPC_UNIMPLEMENTED: errors.ENOMETHOD,
+    GRPC_DEADLINE_EXCEEDED: errors.ERPCTIMEDOUT,
+    GRPC_RESOURCE_EXHAUSTED: errors.ELIMIT,
+    GRPC_UNAVAILABLE: errors.ELOGOFF,
+    GRPC_UNAUTHENTICATED: errors.ERPCAUTH,
+    GRPC_PERMISSION_DENIED: errors.EREJECT,
+    GRPC_INTERNAL: errors.EINTERNAL,
+}
+
+
+def err_to_grpc(code: int) -> int:
+    return _ERR_TO_GRPC.get(code, GRPC_UNKNOWN)
+
+
+def grpc_to_err(status: int) -> int:
+    return _GRPC_TO_ERR.get(status, errors.EINTERNAL)
+
+
+def build_frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes:
+    n = len(payload)
+    hdr = bytes([(n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF, ftype, flags]) \
+        + struct.pack(">I", stream_id & 0x7FFFFFFF)
+    return hdr + payload
+
+
+def grpc_frame(payload: bytes, compressed: bool = False) -> bytes:
+    """5-byte gRPC length prefix (grpc wire format)."""
+    return bytes([1 if compressed else 0]) + struct.pack(">I", len(payload)) \
+        + payload
+
+
+def parse_grpc_frames(data: bytes) -> list[bytes]:
+    out = []
+    pos = 0
+    while pos + 5 <= len(data):
+        n = struct.unpack(">I", data[pos + 1:pos + 5])[0]
+        if pos + 5 + n > len(data):
+            raise ValueError("truncated grpc frame")
+        out.append(data[pos + 5:pos + 5 + n])
+        pos += 5 + n
+    if pos != len(data):
+        raise ValueError("trailing bytes after grpc frame")
+    return out
+
+
+class _StreamState:
+    __slots__ = ("id", "headers", "data", "trailers", "ended", "send_window",
+                 "header_block", "expect_continuation", "trailer_phase",
+                 "reset")
+
+    def __init__(self, sid: int, initial_window: int):
+        self.id = sid
+        self.headers: list[tuple[str, str]] = []
+        self.data = bytearray()
+        self.trailers: list[tuple[str, str]] = []
+        self.ended = False
+        self.send_window = initial_window
+        self.header_block = bytearray()
+        self.expect_continuation = False
+        self.trailer_phase = False
+        self.reset = False
+
+
+class H2Connection:
+    """One side of an h2 connection over a native socket.
+
+    Subclasses implement on_request_complete (server) / on_response (client).
+    All frame handling runs on the native dispatcher thread for this socket;
+    sends are serialized by _send_lock.
+    """
+
+    def __init__(self, sock_id: Optional[int], is_server: bool):
+        # sock_id may be None for clients that bind after connect() returns
+        # (the socket id also arrives with every message callback)
+        self.sid = sock_id
+        self.is_server = is_server
+        self._tp = Transport.instance()
+        self._enc = HpackEncoder()
+        self._dec = HpackDecoder()
+        self._send_lock = threading.Lock()
+        self._fc = threading.Condition(threading.Lock())
+        self.remote_conn_window = DEFAULT_WINDOW
+        self.remote_initial_window = DEFAULT_WINDOW
+        self.remote_max_frame = 16384
+        self._recv_conn_consumed = 0
+        self._streams: dict[int, _StreamState] = {}
+        self._sent_settings = False
+        self._goaway = False
+        self._cont_stream: Optional[int] = None  # stream awaiting CONTINUATION
+
+    # ---- send side ----
+
+    def send_preface_and_settings(self) -> None:
+        settings = struct.pack(">HI", SETTINGS_INITIAL_WINDOW_SIZE, OUR_WINDOW) \
+            + struct.pack(">HI", SETTINGS_MAX_FRAME_SIZE, OUR_MAX_FRAME) \
+            + struct.pack(">HI", SETTINGS_MAX_CONCURRENT_STREAMS, 1 << 20)
+        wu = struct.pack(">I", OUR_CONN_WINDOW - DEFAULT_WINDOW)
+        first = b"" if self.is_server else H2_PREFACE
+        with self._send_lock:
+            if self._sent_settings:
+                return
+            self._sent_settings = True
+            self._tp.write_raw(
+                self.sid,
+                first + build_frame(SETTINGS, 0, 0, settings)
+                + build_frame(WINDOW_UPDATE, 0, 0, wu))
+
+    def _send(self, data: bytes) -> None:
+        with self._send_lock:
+            self._tp.write_raw(self.sid, data)
+
+    def send_headers(self, stream_id: int, headers: list[tuple[str, str]],
+                     end_stream: bool = False) -> None:
+        # HPACK encoder state must advance in the exact order blocks hit the
+        # wire, so encode under the send lock
+        with self._send_lock:
+            block = self._enc.encode(headers)
+            flags = FLAG_END_HEADERS | (FLAG_END_STREAM if end_stream else 0)
+            self._tp.write_raw(self.sid,
+                               build_frame(HEADERS, flags, stream_id, block))
+
+    def open_stream(self, stream_id: int) -> _StreamState:
+        with self._fc:
+            st = self._streams.get(stream_id)
+            if st is None:
+                st = _StreamState(stream_id, self.remote_initial_window)
+                self._streams[stream_id] = st
+            return st
+
+    def close_stream(self, stream_id: int) -> None:
+        with self._fc:
+            self._streams.pop(stream_id, None)
+
+    def send_data(self, stream_id: int, data: bytes,
+                  end_stream: bool = True, timeout_s: float = 30.0) -> None:
+        """Chunked, flow-controlled DATA send (blocks on zero window —
+        the StreamWrite credit-wait analog, stream.cpp:274-290).  Must NOT
+        be called from the dispatcher thread that feeds on_frame for this
+        socket: the WINDOW_UPDATE that unblocks it arrives there."""
+        pos = 0
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._fc:
+                while True:
+                    st = self._streams.get(stream_id)
+                    if st is None or st.reset:
+                        raise errors.RpcError(errors.EFAILEDSOCKET,
+                                              "h2 stream closed during send")
+                    win = min(self.remote_conn_window, st.send_window)
+                    if win > 0 or pos >= len(data):
+                        break
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._tp.alive(self.sid):
+                        raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                              "h2 flow control stalled")
+                    self._fc.wait(min(left, 1.0))
+                n = min(win, self.remote_max_frame, len(data) - pos)
+                self.remote_conn_window -= n
+                st.send_window -= n
+            chunk = data[pos:pos + n]
+            pos += n
+            last = pos >= len(data)
+            self._send(build_frame(
+                DATA, FLAG_END_STREAM if (end_stream and last) else 0,
+                stream_id, chunk))
+            if last:
+                return
+
+    def send_rst(self, stream_id: int, code: int) -> None:
+        self._send(build_frame(RST_STREAM, 0, stream_id,
+                               struct.pack(">I", code)))
+
+    def send_goaway(self, last_stream: int = 0,
+                    code: int = H2_NO_ERROR) -> None:
+        self._send(build_frame(GOAWAY, 0, 0,
+                               struct.pack(">II", last_stream, code)))
+
+    # ---- receive side ----
+
+    def on_frame(self, hdr9: bytes, payload: bytes) -> None:
+        ftype = hdr9[3]
+        flags = hdr9[4]
+        stream_id = struct.unpack(">I", hdr9[5:9])[0] & 0x7FFFFFFF
+        if self._cont_stream is not None and ftype != CONTINUATION:
+            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            return
+        if ftype == SETTINGS:
+            self._on_settings(flags, payload)
+        elif ftype == WINDOW_UPDATE:
+            self._on_window_update(stream_id, payload)
+        elif ftype == HEADERS:
+            self._on_headers(stream_id, flags, payload)
+        elif ftype == CONTINUATION:
+            self._on_continuation(stream_id, flags, payload)
+        elif ftype == DATA:
+            self._on_data(stream_id, flags, payload)
+        elif ftype == PING:
+            if not (flags & FLAG_ACK):
+                self._send(build_frame(PING, FLAG_ACK, 0, payload))
+        elif ftype == RST_STREAM:
+            with self._fc:
+                st = self._streams.pop(stream_id, None)
+                if st is not None:
+                    st.reset = True
+                self._fc.notify_all()
+            if st is not None:
+                code = struct.unpack(">I", payload[:4])[0] if len(payload) >= 4 \
+                    else H2_PROTOCOL_ERROR
+                self.on_stream_reset(stream_id, code)
+        elif ftype == GOAWAY:
+            self._goaway = True
+            self.on_goaway()
+        # PRIORITY / PUSH_PROMISE ignored (push disabled)
+
+    def _on_settings(self, flags: int, payload: bytes) -> None:
+        if flags & FLAG_ACK:
+            return
+        pos = 0
+        while pos + 6 <= len(payload):
+            ident, value = struct.unpack(">HI", payload[pos:pos + 6])
+            pos += 6
+            if ident == SETTINGS_INITIAL_WINDOW_SIZE:
+                with self._fc:
+                    delta = value - self.remote_initial_window
+                    self.remote_initial_window = value
+                    for st in self._streams.values():
+                        st.send_window += delta
+                    self._fc.notify_all()
+            elif ident == SETTINGS_MAX_FRAME_SIZE:
+                self.remote_max_frame = max(16384, min(value, 1 << 24 - 1))
+            elif ident == SETTINGS_HEADER_TABLE_SIZE:
+                self._enc.set_max_table_size(min(value, 4096))
+        self._send(build_frame(SETTINGS, FLAG_ACK, 0, b""))
+
+    def _on_window_update(self, stream_id: int, payload: bytes) -> None:
+        if len(payload) < 4:
+            return
+        incr = struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF
+        with self._fc:
+            if stream_id == 0:
+                self.remote_conn_window += incr
+            else:
+                st = self._streams.get(stream_id)
+                if st is not None:
+                    st.send_window += incr
+            self._fc.notify_all()
+
+    def _strip_padding(self, flags: int, payload: bytes,
+                       priority: bool) -> bytes:
+        pos = 0
+        pad = 0
+        if flags & FLAG_PADDED:
+            pad = payload[0]
+            pos = 1
+        if priority and (flags & FLAG_PRIORITY):
+            pos += 5
+        end = len(payload) - pad
+        return payload[pos:end]
+
+    def _stream(self, stream_id: int) -> _StreamState:
+        return self.open_stream(stream_id)
+
+    def _on_headers(self, stream_id: int, flags: int, payload: bytes) -> None:
+        if stream_id == 0:
+            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            return
+        st = self._stream(stream_id)
+        block = self._strip_padding(flags, payload, priority=True)
+        st.header_block = bytearray(block)
+        if st.headers:        # second HEADERS on a stream = trailers
+            st.trailer_phase = True
+        if flags & FLAG_END_STREAM:
+            st.ended = True
+        if flags & FLAG_END_HEADERS:
+            self._finish_header_block(st)
+        else:
+            self._cont_stream = stream_id
+
+    def _on_continuation(self, stream_id: int, flags: int,
+                         payload: bytes) -> None:
+        if self._cont_stream != stream_id:
+            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            return
+        st = self._stream(stream_id)
+        st.header_block += payload
+        if flags & FLAG_END_HEADERS:
+            self._cont_stream = None
+            self._finish_header_block(st)
+
+    def _finish_header_block(self, st: _StreamState) -> None:
+        try:
+            headers = self._dec.decode(bytes(st.header_block))
+        except ValueError:
+            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            return
+        st.header_block = bytearray()
+        if st.trailer_phase:
+            st.trailers = headers
+        else:
+            st.headers = headers
+        if st.ended:
+            self._complete(st)
+
+    def _on_data(self, stream_id: int, flags: int, payload: bytes) -> None:
+        st = self._streams.get(stream_id)
+        if st is None:
+            return
+        data = self._strip_padding(flags, payload, priority=False)
+        st.data += data
+        # replenish both windows immediately: we buffer in host RAM, no
+        # backpressure needed at this layer (receiver-side credit return,
+        # the CONSUMED-feedback analog of stream_impl.h:80)
+        if len(payload):
+            wu = struct.pack(">I", len(payload))
+            self._send(build_frame(WINDOW_UPDATE, 0, 0, wu)
+                       + build_frame(WINDOW_UPDATE, 0, stream_id, wu))
+        if flags & FLAG_END_STREAM:
+            st.ended = True
+            self._complete(st)
+
+    def _complete(self, st: _StreamState) -> None:
+        # NOTE: the stream stays in _streams so its send window keeps
+        # tracking WINDOW_UPDATEs while the response goes out; the
+        # subclass closes it (client: immediately; server: after the
+        # response's END_STREAM).
+        self.on_stream_complete(st)
+
+    # ---- overridables ----
+
+    def on_stream_complete(self, st: _StreamState) -> None:
+        raise NotImplementedError
+
+    def on_stream_reset(self, stream_id: int, code: int) -> None:
+        pass
+
+    def on_goaway(self) -> None:
+        pass
+
+
+_GRPC_TIMEOUT_UNITS = {"H": 3600.0, "M": 60.0, "S": 1.0,
+                       "m": 1e-3, "u": 1e-6, "n": 1e-9}
+
+
+def parse_grpc_timeout(value: Optional[str]) -> Optional[float]:
+    """grpc-timeout header ("8-digit value + unit", e.g. '5S', '100m')
+    → seconds, or None if absent/malformed."""
+    if not value or len(value) < 2:
+        return None
+    unit = _GRPC_TIMEOUT_UNITS.get(value[-1])
+    if unit is None or not value[:-1].isdigit():
+        return None
+    return int(value[:-1]) * unit
+
+
+_grpc_pool = None
+_grpc_pool_lock = threading.Lock()
+
+
+def _grpc_executor():
+    """Shared worker pool for server-side gRPC dispatch.  The h2 frame
+    machinery runs FIFO on the dispatcher thread (HPACK state demands it);
+    user handlers + flow-controlled response sends must hop off it —
+    send_data blocks on WINDOW_UPDATEs the dispatcher delivers (the
+    usercode_in_pthread backup-pool pattern, SURVEY §5.10)."""
+    global _grpc_pool
+    with _grpc_pool_lock:
+        if _grpc_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _grpc_pool = ThreadPoolExecutor(max_workers=32,
+                                            thread_name_prefix="grpc-worker")
+        return _grpc_pool
+
+
+class GrpcServerConnection(H2Connection):
+    """Server side of one h2 connection; dispatches unary gRPC requests
+    into the Server's method registry (same gates as native-protocol
+    traffic — see Server.invoke_grpc)."""
+
+    def __init__(self, sock_id: int, server):
+        super().__init__(sock_id, is_server=True)
+        self._server = server
+        self.send_preface_and_settings()
+
+    def on_stream_complete(self, st: _StreamState) -> None:
+        # runs on the dispatcher thread: only parse + hand off
+        _grpc_executor().submit(self._process, st)
+
+    def _process(self, st: _StreamState) -> None:
+        try:
+            h = dict(st.headers)
+            path = h.get(":path", "")
+            try:
+                msgs = parse_grpc_frames(bytes(st.data))
+                payload = msgs[0] if msgs else b""
+            except ValueError:
+                self._respond_error(st.id, GRPC_INTERNAL, "bad grpc framing")
+                return
+            parts = path.strip("/").split("/")
+            if len(parts) != 2:
+                self._respond_error(st.id, GRPC_UNIMPLEMENTED,
+                                    f"bad path {path!r}")
+                return
+            service, method_name = parts
+            timeout_s = parse_grpc_timeout(h.get("grpc-timeout"))
+            deadline = (time.monotonic() + timeout_s) if timeout_s else None
+            resp, code, text = self._server.invoke_grpc(service, method_name,
+                                                        payload, h)
+            if deadline is not None and time.monotonic() > deadline:
+                self._respond_error(st.id, GRPC_DEADLINE_EXCEEDED,
+                                    "deadline exceeded on server")
+                return
+            if code != 0:
+                self._respond_error(st.id, err_to_grpc(code), text)
+                return
+            self.send_headers(st.id, [(":status", "200"),
+                                      ("content-type", "application/grpc")])
+            self.send_data(st.id, grpc_frame(resp), end_stream=False)
+            self.send_headers(st.id, [("grpc-status", "0")], end_stream=True)
+        except errors.RpcError:
+            pass  # stream reset / connection died while responding
+        except Exception:  # pragma: no cover - handler bug guard
+            import traceback
+            traceback.print_exc()
+        finally:
+            self.close_stream(st.id)
+
+    def _respond_error(self, stream_id: int, status: int, msg: str) -> None:
+        self.send_headers(stream_id, [
+            (":status", "200"),
+            ("content-type", "application/grpc"),
+            ("grpc-status", str(status)),
+            ("grpc-message", msg.replace("\n", " ")[:1024]),
+        ], end_stream=True)
+
+
+class GrpcChannel:
+    """Unary gRPC client over one h2 connection (http2_rpc_protocol.cpp
+    client role).  Thread-safe; concurrent calls multiplex as h2 streams
+    with odd ids.
+
+        ch = GrpcChannel("127.0.0.1:8000")
+        resp_bytes = ch.call("example.Echo", "Echo", payload_bytes)
+    """
+
+    def __init__(self, address: str, timeout_ms: int = 5000):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._timeout_ms = timeout_ms
+        self._lock = threading.Lock()
+        self._conn: Optional[_GrpcClientConnection] = None
+
+    def _ensure(self) -> "_GrpcClientConnection":
+        with self._lock:
+            if self._conn is None or not self._conn.alive():
+                self._conn = _GrpcClientConnection(*self._addr)
+            return self._conn
+
+    def acall(self, service: str, method: str, payload: bytes,
+              metadata: Optional[list[tuple[str, str]]] = None) -> Future:
+        return self._ensure().start_call(service, method, payload,
+                                         metadata or [])
+
+    def call(self, service: str, method: str, payload: bytes,
+             timeout_ms: Optional[int] = None,
+             metadata: Optional[list[tuple[str, str]]] = None) -> bytes:
+        fut = self.acall(service, method, payload, metadata)
+        try:
+            return fut.result((timeout_ms or self._timeout_ms) / 1e3)
+        except TimeoutError:
+            raise errors.RpcError(errors.ERPCTIMEDOUT, "grpc call timed out")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+
+class _GrpcClientConnection(H2Connection):
+    def __init__(self, host: str, port: int):
+        # every field the native callbacks touch must exist BEFORE
+        # connect(): the dispatcher thread may fire _on_message/_on_failed
+        # the moment the socket registers
+        super().__init__(None, is_server=False)
+        self._authority = f"{host}:{port}"
+        self._next_stream = 1
+        self._calls: dict[int, Future] = {}
+        self._calls_lock = threading.Lock()
+        tp = Transport.instance()
+        self.sid = tp.connect(host, port, self._on_message, self._on_failed)
+        tp.set_protocol(self.sid, MSG_H2)
+        self.send_preface_and_settings()
+
+    def alive(self) -> bool:
+        return not self._goaway and self._tp.alive(self.sid)
+
+    def close(self) -> None:
+        try:
+            self.send_goaway()
+        except Exception:
+            pass
+        self._tp.close(self.sid)
+
+    def _on_message(self, sid: int, kind: int, meta: bytes, body) -> None:
+        if self.sid is None:
+            self.sid = sid  # connect() hasn't returned yet
+        if kind == MSG_H2:
+            self.on_frame(meta, body.to_bytes())
+
+    def _on_failed(self, sid: int, err: int) -> None:
+        with self._calls_lock:
+            calls, self._calls = self._calls, {}
+        for fut in calls.values():
+            if not fut.done():
+                fut.set_exception(errors.RpcError(
+                    errors.EFAILEDSOCKET, "h2 connection lost"))
+
+    def start_call(self, service: str, method: str, payload: bytes,
+                   metadata: list[tuple[str, str]]) -> Future:
+        fut: Future = Future()
+        with self._calls_lock:
+            stream_id = self._next_stream
+            self._next_stream += 2
+            self._calls[stream_id] = fut
+        self.open_stream(stream_id)  # track our send window for this stream
+        headers = [(":method", "POST"), (":scheme", "http"),
+                   (":path", f"/{service}/{method}"),
+                   (":authority", self._authority),
+                   ("content-type", "application/grpc"),
+                   ("te", "trailers")] + metadata
+        try:
+            self.send_headers(stream_id, headers)
+            self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+        except Exception as e:
+            with self._calls_lock:
+                self._calls.pop(stream_id, None)
+            self.close_stream(stream_id)
+            if not fut.done():
+                fut.set_exception(e)
+        return fut
+
+    def on_stream_complete(self, st: _StreamState) -> None:
+        self.close_stream(st.id)
+        with self._calls_lock:
+            fut = self._calls.pop(st.id, None)
+        if fut is None or fut.done():
+            return
+        h = dict(st.headers)
+        t = dict(st.trailers) if st.trailers else h
+        try:
+            status = int(t.get("grpc-status", "0"))
+        except ValueError:
+            status = GRPC_UNKNOWN
+        if h.get(":status", "200") != "200" or status != 0:
+            msg = t.get("grpc-message", f"grpc-status {status}")
+            fut.set_exception(errors.RpcError(grpc_to_err(status), msg))
+            return
+        try:
+            msgs = parse_grpc_frames(bytes(st.data))
+            fut.set_result(msgs[0] if msgs else b"")
+        except ValueError as e:
+            fut.set_exception(errors.RpcError(errors.EINTERNAL, str(e)))
+
+    def on_stream_reset(self, stream_id: int, code: int) -> None:
+        with self._calls_lock:
+            fut = self._calls.pop(stream_id, None)
+        if fut is not None and not fut.done():
+            fut.set_exception(errors.RpcError(
+                errors.EINTERNAL, f"stream reset by peer (h2 error {code})"))

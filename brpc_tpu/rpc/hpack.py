@@ -1,0 +1,390 @@
+"""HPACK (RFC 7541) header compression for the h2 protocol.
+
+Reference: src/brpc/details/hpack.cpp (878 LoC) — static+dynamic table
+indexing, integer/string primitives, Huffman coding.  This is a clean-room
+implementation from the RFC; the reference file is cited for parity only.
+"""
+from __future__ import annotations
+
+# ---- static table (RFC 7541 Appendix A) ----------------------------------
+
+STATIC_TABLE: list[tuple[str, str]] = [
+    (":authority", ""),
+    (":method", "GET"),
+    (":method", "POST"),
+    (":path", "/"),
+    (":path", "/index.html"),
+    (":scheme", "http"),
+    (":scheme", "https"),
+    (":status", "200"),
+    (":status", "204"),
+    (":status", "206"),
+    (":status", "304"),
+    (":status", "400"),
+    (":status", "404"),
+    (":status", "500"),
+    ("accept-charset", ""),
+    ("accept-encoding", "gzip, deflate"),
+    ("accept-language", ""),
+    ("accept-ranges", ""),
+    ("accept", ""),
+    ("access-control-allow-origin", ""),
+    ("age", ""),
+    ("allow", ""),
+    ("authorization", ""),
+    ("cache-control", ""),
+    ("content-disposition", ""),
+    ("content-encoding", ""),
+    ("content-language", ""),
+    ("content-length", ""),
+    ("content-location", ""),
+    ("content-range", ""),
+    ("content-type", ""),
+    ("cookie", ""),
+    ("date", ""),
+    ("etag", ""),
+    ("expect", ""),
+    ("expires", ""),
+    ("from", ""),
+    ("host", ""),
+    ("if-match", ""),
+    ("if-modified-since", ""),
+    ("if-none-match", ""),
+    ("if-range", ""),
+    ("if-unmodified-since", ""),
+    ("last-modified", ""),
+    ("link", ""),
+    ("location", ""),
+    ("max-forwards", ""),
+    ("proxy-authenticate", ""),
+    ("proxy-authorization", ""),
+    ("range", ""),
+    ("referer", ""),
+    ("refresh", ""),
+    ("retry-after", ""),
+    ("server", ""),
+    ("set-cookie", ""),
+    ("strict-transport-security", ""),
+    ("transfer-encoding", ""),
+    ("user-agent", ""),
+    ("vary", ""),
+    ("via", ""),
+    ("www-authenticate", ""),
+]
+_STATIC_BY_PAIR = {(n, v): i + 1 for i, (n, v) in enumerate(STATIC_TABLE)}
+_STATIC_BY_NAME: dict[str, int] = {}
+for _i, (_n, _v) in enumerate(STATIC_TABLE):
+    _STATIC_BY_NAME.setdefault(_n, _i + 1)
+
+# ---- Huffman code table (RFC 7541 Appendix B): symbol -> (code, nbits) ----
+
+HUFFMAN_TABLE: list[tuple[int, int]] = [
+    (0x1ff8, 13), (0x7fffd8, 23), (0xfffffe2, 28), (0xfffffe3, 28),
+    (0xfffffe4, 28), (0xfffffe5, 28), (0xfffffe6, 28), (0xfffffe7, 28),
+    (0xfffffe8, 28), (0xffffea, 24), (0x3ffffffc, 30), (0xfffffe9, 28),
+    (0xfffffea, 28), (0x3ffffffd, 30), (0xfffffeb, 28), (0xfffffec, 28),
+    (0xfffffed, 28), (0xfffffee, 28), (0xfffffef, 28), (0xffffff0, 28),
+    (0xffffff1, 28), (0xffffff2, 28), (0x3ffffffe, 30), (0xffffff3, 28),
+    (0xffffff4, 28), (0xffffff5, 28), (0xffffff6, 28), (0xffffff7, 28),
+    (0xffffff8, 28), (0xffffff9, 28), (0xffffffa, 28), (0xffffffb, 28),
+    (0x14, 6), (0x3f8, 10), (0x3f9, 10), (0xffa, 12),
+    (0x1ff9, 13), (0x15, 6), (0xf8, 8), (0x7fa, 11),
+    (0x3fa, 10), (0x3fb, 10), (0xf9, 8), (0x7fb, 11),
+    (0xfa, 8), (0x16, 6), (0x17, 6), (0x18, 6),
+    (0x0, 5), (0x1, 5), (0x2, 5), (0x19, 6),
+    (0x1a, 6), (0x1b, 6), (0x1c, 6), (0x1d, 6),
+    (0x1e, 6), (0x1f, 6), (0x5c, 7), (0xfb, 8),
+    (0x7ffc, 15), (0x20, 6), (0xffb, 12), (0x3fc, 10),
+    (0x1ffa, 13), (0x21, 6), (0x5d, 7), (0x5e, 7),
+    (0x5f, 7), (0x60, 7), (0x61, 7), (0x62, 7),
+    (0x63, 7), (0x64, 7), (0x65, 7), (0x66, 7),
+    (0x67, 7), (0x68, 7), (0x69, 7), (0x6a, 7),
+    (0x6b, 7), (0x6c, 7), (0x6d, 7), (0x6e, 7),
+    (0x6f, 7), (0x70, 7), (0x71, 7), (0x72, 7),
+    (0xfc, 8), (0x73, 7), (0xfd, 8), (0x1ffb, 13),
+    (0x7fff0, 19), (0x1ffc, 13), (0x3ffc, 14), (0x22, 6),
+    (0x7ffd, 15), (0x3, 5), (0x23, 6), (0x4, 5),
+    (0x24, 6), (0x5, 5), (0x25, 6), (0x26, 6),
+    (0x27, 6), (0x6, 5), (0x74, 7), (0x75, 7),
+    (0x28, 6), (0x29, 6), (0x2a, 6), (0x7, 5),
+    (0x2b, 6), (0x76, 7), (0x2c, 6), (0x8, 5),
+    (0x9, 5), (0x2d, 6), (0x77, 7), (0x78, 7),
+    (0x79, 7), (0x7a, 7), (0x7b, 7), (0x7ffe, 15),
+    (0x7fc, 11), (0x3ffd, 14), (0x1ffd, 13), (0xffffffc, 28),
+    (0xfffe6, 20), (0x3fffd2, 22), (0xfffe7, 20), (0xfffe8, 20),
+    (0x3fffd3, 22), (0x3fffd4, 22), (0x3fffd5, 22), (0x7fffd9, 23),
+    (0x3fffd6, 22), (0x7fffda, 23), (0x7fffdb, 23), (0x7fffdc, 23),
+    (0x7fffdd, 23), (0x7fffde, 23), (0xffffeb, 24), (0x7fffdf, 23),
+    (0xffffec, 24), (0xffffed, 24), (0x3fffd7, 22), (0x7fffe0, 23),
+    (0xffffee, 24), (0x7fffe1, 23), (0x7fffe2, 23), (0x7fffe3, 23),
+    (0x7fffe4, 23), (0x1fffdc, 21), (0x3fffd8, 22), (0x7fffe5, 23),
+    (0x3fffd9, 22), (0x7fffe6, 23), (0x7fffe7, 23), (0xffffef, 24),
+    (0x3fffda, 22), (0x1fffdd, 21), (0xfffe9, 20), (0x3fffdb, 22),
+    (0x3fffdc, 22), (0x7fffe8, 23), (0x7fffe9, 23), (0x1fffde, 21),
+    (0x7fffea, 23), (0x3fffdd, 22), (0x3fffde, 22), (0xfffff0, 24),
+    (0x1fffdf, 21), (0x3fffdf, 22), (0x7fffeb, 23), (0x7fffec, 23),
+    (0x1fffe0, 21), (0x1fffe1, 21), (0x3fffe0, 22), (0x1fffe2, 21),
+    (0x7fffed, 23), (0x3fffe1, 22), (0x7fffee, 23), (0x7fffef, 23),
+    (0xfffea, 20), (0x3fffe2, 22), (0x3fffe3, 22), (0x3fffe4, 22),
+    (0x7ffff0, 23), (0x3fffe5, 22), (0x3fffe6, 22), (0x7ffff1, 23),
+    (0x3ffffe0, 26), (0x3ffffe1, 26), (0xfffeb, 20), (0x7fff1, 19),
+    (0x3fffe7, 22), (0x7ffff2, 23), (0x3fffe8, 22), (0x1ffffec, 25),
+    (0x3ffffe2, 26), (0x3ffffe3, 26), (0x3ffffe4, 26), (0x7ffffde, 27),
+    (0x7ffffdf, 27), (0x3ffffe5, 26), (0xfffff1, 24), (0x1ffffed, 25),
+    (0x7fff2, 19), (0x1fffe3, 21), (0x3ffffe6, 26), (0x7ffffe0, 27),
+    (0x7ffffe1, 27), (0x3ffffe7, 26), (0x7ffffe2, 27), (0xfffff2, 24),
+    (0x1fffe4, 21), (0x1fffe5, 21), (0x3ffffe8, 26), (0x3ffffe9, 26),
+    (0xffffffd, 28), (0x7ffffe3, 27), (0x7ffffe4, 27), (0x7ffffe5, 27),
+    (0xfffec, 20), (0xfffff3, 24), (0xfffed, 20), (0x1fffe6, 21),
+    (0x3fffe9, 22), (0x1fffe7, 21), (0x1fffe8, 21), (0x7ffff3, 23),
+    (0x3fffea, 22), (0x3fffeb, 22), (0x1ffffee, 25), (0x1ffffef, 25),
+    (0xfffff4, 24), (0xfffff5, 24), (0x3ffffea, 26), (0x7ffff4, 23),
+    (0x3ffffeb, 26), (0x7ffffe6, 27), (0x3ffffec, 26), (0x3ffffed, 26),
+    (0x7ffffe7, 27), (0x7ffffe8, 27), (0x7ffffe9, 27), (0x7ffffea, 27),
+    (0x7ffffeb, 27), (0xffffffe, 28), (0x7ffffec, 27), (0x7ffffed, 27),
+    (0x7ffffee, 27), (0x7ffffef, 27), (0x7fffff0, 27), (0x3ffffee, 26),
+    (0x3fffffff, 30),  # EOS
+]
+assert len(HUFFMAN_TABLE) == 257
+
+# decode trie: dict keyed by (code_prefix, nbits) is slow; build a flat
+# dict code-with-length -> symbol and walk bit by bit
+_HUFF_DECODE: dict[tuple[int, int], int] = {
+    (code, bits): sym for sym, (code, bits) in enumerate(HUFFMAN_TABLE)
+}
+_HUFF_MIN_BITS = min(b for _, b in HUFFMAN_TABLE)
+
+
+def huffman_encode(data: bytes) -> bytes:
+    acc = 0
+    nbits = 0
+    out = bytearray()
+    for b in data:
+        code, n = HUFFMAN_TABLE[b]
+        acc = (acc << n) | code
+        nbits += n
+        while nbits >= 8:
+            nbits -= 8
+            out.append((acc >> nbits) & 0xFF)
+    if nbits:
+        # pad with EOS prefix (all ones)
+        out.append(((acc << (8 - nbits)) | ((1 << (8 - nbits)) - 1)) & 0xFF)
+    return bytes(out)
+
+
+def huffman_decode(data: bytes) -> bytes:
+    out = bytearray()
+    code = 0
+    nbits = 0
+    for byte in data:
+        for shift in range(7, -1, -1):
+            code = (code << 1) | ((byte >> shift) & 1)
+            nbits += 1
+            if nbits < _HUFF_MIN_BITS:
+                continue
+            sym = _HUFF_DECODE.get((code, nbits))
+            if sym is not None:
+                if sym == 256:
+                    raise ValueError("EOS symbol in huffman data")
+                out.append(sym)
+                code = 0
+                nbits = 0
+            elif nbits > 30:
+                raise ValueError("invalid huffman code")
+    # trailing bits must be a prefix of EOS (all ones), <= 7 bits
+    if nbits > 7 or code != (1 << nbits) - 1:
+        raise ValueError("bad huffman padding")
+    return bytes(out)
+
+
+# ---- integer / string primitives (RFC 7541 §5) ----------------------------
+
+def encode_int(value: int, prefix_bits: int, first_byte_flags: int = 0) -> bytes:
+    limit = (1 << prefix_bits) - 1
+    if value < limit:
+        return bytes([first_byte_flags | value])
+    out = bytearray([first_byte_flags | limit])
+    value -= limit
+    while value >= 128:
+        out.append((value & 0x7F) | 0x80)
+        value >>= 7
+    out.append(value)
+    return bytes(out)
+
+
+def decode_int(data: bytes, pos: int, prefix_bits: int) -> tuple[int, int]:
+    limit = (1 << prefix_bits) - 1
+    if pos >= len(data):
+        raise ValueError("truncated integer")
+    value = data[pos] & limit
+    pos += 1
+    if value < limit:
+        return value, pos
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        value += (b & 0x7F) << shift
+        shift += 7
+        if not (b & 0x80):
+            return value, pos
+        if shift > 35:
+            raise ValueError("integer overflow")
+
+
+def encode_str(s: str | bytes, huffman: bool = True) -> bytes:
+    raw = s.encode("utf-8") if isinstance(s, str) else s
+    if huffman:
+        enc = huffman_encode(raw)
+        if len(enc) < len(raw):
+            return encode_int(len(enc), 7, 0x80) + enc
+    return encode_int(len(raw), 7, 0x00) + raw
+
+
+def decode_str(data: bytes, pos: int) -> tuple[bytes, int]:
+    if pos >= len(data):
+        raise ValueError("truncated string")
+    huff = bool(data[pos] & 0x80)
+    length, pos = decode_int(data, pos, 7)
+    if pos + length > len(data):
+        raise ValueError("truncated string body")
+    raw = data[pos:pos + length]
+    pos += length
+    return (huffman_decode(raw) if huff else raw), pos
+
+
+# ---- dynamic table ---------------------------------------------------------
+
+class _DynTable:
+    """FIFO of (name, value); size accounting per RFC 7541 §4.1."""
+
+    def __init__(self, max_size: int = 4096):
+        self.entries: list[tuple[str, str]] = []  # newest first
+        self.size = 0
+        self.max_size = max_size
+
+    @staticmethod
+    def entry_size(n: str, v: str) -> int:
+        return len(n.encode()) + len(v.encode()) + 32
+
+    def add(self, n: str, v: str) -> None:
+        es = self.entry_size(n, v)
+        while self.entries and self.size + es > self.max_size:
+            on, ov = self.entries.pop()
+            self.size -= self.entry_size(on, ov)
+        if es <= self.max_size:
+            self.entries.insert(0, (n, v))
+            self.size += es
+        else:
+            self.entries.clear()
+            self.size = 0
+
+    def resize(self, max_size: int) -> None:
+        self.max_size = max_size
+        while self.entries and self.size > self.max_size:
+            on, ov = self.entries.pop()
+            self.size -= self.entry_size(on, ov)
+
+
+class HpackEncoder:
+    def __init__(self, max_table_size: int = 4096, use_huffman: bool = True):
+        self._table = _DynTable(max_table_size)
+        self._use_huffman = use_huffman
+
+    def set_max_table_size(self, n: int) -> None:
+        # peer lowered SETTINGS_HEADER_TABLE_SIZE; a size-update block
+        # would be emitted on the next header block in a strict impl — we
+        # simply clamp and emit the update eagerly next encode
+        self._pending_resize = n
+
+    def encode(self, headers: list[tuple[str, str]]) -> bytes:
+        out = bytearray()
+        pending = getattr(self, "_pending_resize", None)
+        if pending is not None:
+            self._table.resize(pending)
+            out += encode_int(pending, 5, 0x20)
+            self._pending_resize = None
+        for name, value in headers:
+            name = name.lower()
+            idx = _STATIC_BY_PAIR.get((name, value))
+            if idx is None:
+                for i, (n, v) in enumerate(self._table.entries):
+                    if n == name and v == value:
+                        idx = len(STATIC_TABLE) + i + 1
+                        break
+            if idx is not None:
+                out += encode_int(idx, 7, 0x80)  # indexed field
+                continue
+            name_idx = _STATIC_BY_NAME.get(name)
+            if name_idx is None:
+                for i, (n, _) in enumerate(self._table.entries):
+                    if n == name:
+                        name_idx = len(STATIC_TABLE) + i + 1
+                        break
+            # literal with incremental indexing (01 pattern, 6-bit prefix)
+            if name_idx is not None:
+                out += encode_int(name_idx, 6, 0x40)
+            else:
+                out += encode_int(0, 6, 0x40)
+                out += encode_str(name, self._use_huffman)
+            out += encode_str(value, self._use_huffman)
+            self._table.add(name, value)
+        return bytes(out)
+
+
+class HpackDecoder:
+    def __init__(self, max_table_size: int = 4096):
+        self._table = _DynTable(max_table_size)
+        self._settings_cap = max_table_size
+
+    def set_max_table_size(self, n: int) -> None:
+        self._settings_cap = n
+        if self._table.max_size > n:
+            self._table.resize(n)
+
+    def _lookup(self, idx: int) -> tuple[str, str]:
+        if idx <= 0:
+            raise ValueError("index 0")
+        if idx <= len(STATIC_TABLE):
+            return STATIC_TABLE[idx - 1]
+        di = idx - len(STATIC_TABLE) - 1
+        if di >= len(self._table.entries):
+            raise ValueError(f"dynamic index {idx} out of range")
+        return self._table.entries[di]
+
+    def decode(self, data: bytes) -> list[tuple[str, str]]:
+        headers: list[tuple[str, str]] = []
+        pos = 0
+        while pos < len(data):
+            b = data[pos]
+            if b & 0x80:  # indexed
+                idx, pos = decode_int(data, pos, 7)
+                headers.append(self._lookup(idx))
+            elif b & 0x40:  # literal with incremental indexing
+                idx, pos = decode_int(data, pos, 6)
+                if idx:
+                    name = self._lookup(idx)[0]
+                else:
+                    nb, pos = decode_str(data, pos)
+                    name = nb.decode("utf-8", "replace")
+                vb, pos = decode_str(data, pos)
+                value = vb.decode("utf-8", "replace")
+                self._table.add(name, value)
+                headers.append((name, value))
+            elif b & 0x20:  # dynamic table size update
+                size, pos = decode_int(data, pos, 5)
+                if size > self._settings_cap:
+                    raise ValueError("table size update beyond settings cap")
+                self._table.resize(size)
+            else:  # literal without indexing (0000) / never indexed (0001)
+                idx, pos = decode_int(data, pos, 4)
+                if idx:
+                    name = self._lookup(idx)[0]
+                else:
+                    nb, pos = decode_str(data, pos)
+                    name = nb.decode("utf-8", "replace")
+                vb, pos = decode_str(data, pos)
+                headers.append((name, vb.decode("utf-8", "replace")))
+        return headers

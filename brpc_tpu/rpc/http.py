@@ -196,7 +196,12 @@ class HttpChannel:
                         # 7230 §3.3.3) and the native parser framed only
                         # the headers — fail loudly instead of returning
                         # an empty body.  request_stream() handles these
-                        # via raw-mode EOF.
+                        # via raw-mode EOF.  Drop the connection: its
+                        # pending body bytes would otherwise poison the
+                        # next request on the cached socket.
+                        Transport.instance().close(sid)
+                        if self._sid == sid:
+                            self._sid = None
                         raise errors.RpcError(
                             errors.ERESPONSE,
                             "close-delimited HTTP body unsupported by "

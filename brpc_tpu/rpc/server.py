@@ -21,7 +21,7 @@ from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
 from brpc_tpu.rpc.service import MethodSpec, Service
-from brpc_tpu.rpc.transport import (MSG_HTTP, MSG_REDIS, MSG_TRPC,
+from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_REDIS, MSG_TRPC,
                                     Transport)
 
 
@@ -121,6 +121,9 @@ class Server:
         if self.options.master_service is not None:
             self._method_status[("*", "*")] = \
                 MethodStatus("master_service/process")
+        # h2/gRPC connections on the shared port (auto-detected by the
+        # native parser via the client preface), sid -> GrpcServerConnection
+        self._h2_conns: dict[int, Any] = {}
 
     def add_http_handler(self, path: str, fn) -> "Server":
         """Register a custom HTTP handler on the console port; fn(req) may
@@ -233,6 +236,7 @@ class Server:
     def _on_conn_failed(self, sid: int, err: int) -> None:
         with self._conn_mu:
             self._connections.discard(sid)
+        self._h2_conns.pop(sid, None)
 
     def _track_conn(self, sid: int) -> None:
         with self._conn_mu:
@@ -246,6 +250,13 @@ class Server:
             else:
                 Transport.instance().write_raw(
                     sid, b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+            return
+        if kind == MSG_H2:
+            conn = self._h2_conns.get(sid)
+            if conn is None:
+                from brpc_tpu.rpc.h2 import GrpcServerConnection
+                conn = self._h2_conns[sid] = GrpcServerConnection(sid, self)
+            conn.on_frame(meta_bytes, body.to_bytes())
             return
         if kind == MSG_REDIS:
             svc = self.options.redis_service
@@ -472,6 +483,100 @@ class Server:
                 self._inflight -= 1
                 if self._inflight == 0:
                     self._inflight_zero.set()
+
+    # ---- gRPC entry (policy/http2_rpc_protocol.cpp server role) ----
+
+    def invoke_grpc(self, service: str, method_name: str, payload: bytes,
+                    headers: dict[str, str]) -> tuple[bytes, int, str]:
+        """Dispatch one unary gRPC request through the SAME gates as native
+        traffic.  Returns (response_payload, error_code, error_text); the
+        h2 connection maps error_code to a grpc-status trailer."""
+        if self._stopping:
+            return b"", errors.ELOGOFF, "server stopping"
+        reg_name = service
+        if service not in self._services and "." in service:
+            # gRPC paths carry package-qualified names; fall back to the
+            # bare service name our registry may have used
+            bare = service.rsplit(".", 1)[1]
+            if bare in self._services:
+                reg_name = bare
+        key = (reg_name, method_name)
+        spec = self._methods.get(key)
+        meta = M.RpcMeta(msg_type=M.MSG_REQUEST, service=key[0],
+                         method=method_name, content_type="pb",
+                         auth=headers.get("authorization", "").encode())
+        if self.options.auth is not None:
+            if not self.options.auth.verify_credential(meta.auth):
+                return b"", errors.ERPCAUTH, "bad credential"
+        if self.options.interceptor is not None:
+            verdict = self.options.interceptor(meta)
+            if verdict is not None and verdict is not True:
+                code = verdict if isinstance(verdict, int) else errors.EREJECT
+                return b"", code, errors.describe(code)
+        if spec is None:
+            master = self.options.master_service
+            if master is not None:
+                # catch-all proxy dispatch, same as native traffic
+                # (baidu_master_service, baidu_rpc_protocol.cpp:521-560)
+                key = ("*", "*")
+                spec = MethodSpec(
+                    name="process",
+                    fn=lambda cntl, req: master.process(cntl, req),
+                    request_serializer=get_serializer("raw"),
+                    response_serializer=get_serializer("raw"))
+            elif key[0] not in self._services:
+                return b"", errors.ENOSERVICE, f"unknown service {service!r}"
+            else:
+                return b"", errors.ENOMETHOD, f"unknown method {method_name!r}"
+        if self._limiter is not None and not self._limiter.on_requested(
+                self._total_concurrency() + 1):
+            return b"", errors.ELIMIT, "server concurrency limit"
+        status = self._method_status[key]
+        if not status.on_requested():
+            if self._limiter is not None:
+                self._limiter.on_responded(errors.ELIMIT, 0)
+            return b"", errors.ELIMIT, "method concurrency limit"
+        with self._inflight_mu:
+            self._inflight += 1
+            self._inflight_zero.clear()
+        span = rpcz.new_span("server", key[0], method_name)
+        span.annotate("protocol=grpc")
+        start = time.monotonic()
+        error_code = 0
+        text = ""
+        resp = b""
+        try:
+            request = spec.request_serializer.decode(payload, "")
+            span.request_size = len(payload)
+            cntl = Controller()
+            cntl.is_server_side = True
+            cntl.request_meta = meta
+            rpcz.set_current_span(span)
+            try:
+                result = spec.fn(cntl, request)
+            finally:
+                rpcz.set_current_span(None)
+            if cntl.failed():
+                error_code, text = cntl.error_code, cntl.error_text
+            else:
+                resp, _ = spec.response_serializer.encode(result)
+                span.response_size = len(resp)
+        except Exception as e:
+            error_code = errors.EINTERNAL
+            text = f"{type(e).__name__}: {e}"
+        finally:
+            latency_us = int((time.monotonic() - start) * 1e6)
+            status.on_responded(error_code, latency_us)
+            if self._limiter is not None:
+                self._limiter.on_responded(error_code, latency_us)
+            span.error_code = error_code
+            span.end_us = rpcz.now_us()
+            rpcz.submit(span)
+            with self._inflight_mu:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._inflight_zero.set()
+        return resp, error_code, text
 
 
 # ---- global server registry (builtin services enumerate servers) ----

@@ -428,6 +428,12 @@ ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) 
       st->detected = MSG_REDIS;
       return parse_redis(in, out);
     }
+    if (got < 4 && memcmp(hdr, "PRI ", got) == 0) {
+      // 'P'/'PR'/'PRI' could become either the h2 preface or POST/PUT/
+      // PATCH — don't let the HTTP prefix-match below latch MSG_HTTP
+      // until 4 bytes distinguish them.
+      return PARSE_NEED_MORE;
+    }
     if (looks_like_http(hdr, got)) {
       st->detected = MSG_HTTP;
       return parse_http(in, st, out);
@@ -448,12 +454,23 @@ ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) 
       return parse_memcache(in, out);
     }
     if (got >= 6 && (uint8_t)hdr[4] == 0x80 && (uint8_t)hdr[5] == 0x01) {
+      // Same 28-byte nshead disambiguation window as memcache above: an
+      // nshead whose log_id low bytes are 0x80 0x01 would otherwise be
+      // latched as thrift and its id/version misread as a frame length.
+      if (in->size() < 28) {
+        const uint64_t th_total = 4 + (uint64_t)load_be32(hdr);
+        if (in->size() < th_total) return PARSE_NEED_MORE;
+      }
       st->detected = MSG_THRIFT;
       return parse_thrift(in, out);
     }
     if (got >= 16) {
       const uint32_t op = load_le32(hdr + 12);
       if (mongo_known_opcode(op) && load_le32(hdr) >= 16) {
+        if (in->size() < 28) {
+          const uint32_t mg_total = load_le32(hdr);  // includes header
+          if (in->size() < mg_total) return PARSE_NEED_MORE;
+        }
         st->detected = MSG_MONGO;
         return parse_mongo(in, out);
       }

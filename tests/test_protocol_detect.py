@@ -148,3 +148,44 @@ def test_split_memcache_below_28_bytes(listener):
     c.sendall(pkt[10:])
     assert _wait_frames(frames, ev, 1)
     assert frames[0][0] == MSG_MEMCACHE and frames[0][2] == pkt
+
+
+def test_h2_preface_one_byte_first_segment(listener):
+    """A 1-byte first read ('P') must not be latched as HTTP — it could
+    become POST/PUT/PATCH *or* the h2 preface."""
+    port, frames, ev = listener
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(b"P")
+    time.sleep(0.05)
+    c.sendall(b"RI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+    frame = b"\x00\x00\x02\x00\x01\x00\x00\x00\x01" + b"ok"
+    c.sendall(frame)
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_H2 and frames[0][2] == b"ok"
+
+
+def test_nshead_log_id_collides_with_thrift_magic(listener):
+    """An nshead whose bytes 4-5 are 0x80 0x01 (thrift's binary-protocol
+    magic position) delivered in a short first segment must wait for the
+    28-byte window and detect as nshead."""
+    port, frames, ev = listener
+    # log_id=0x0180 puts 0x80 0x01 at offsets 4-5 (little endian)
+    hdr = struct.pack("<HHI16sIII", 5, 1, 0x0180, b"svc", NSHEAD_MAGIC, 0, 3)
+    assert hdr[4] == 0x80 and hdr[5] == 0x01
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(hdr[:8])          # 8 bytes: thrift detector would have fired
+    time.sleep(0.05)
+    c.sendall(hdr[8:] + b"abc")
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_NSHEAD and frames[0][2] == b"abc"
+
+
+def test_short_thrift_frame_still_detects(listener):
+    """A complete small thrift frame (total < 28 bytes) must be framed
+    once fully buffered, mirroring the memcache rule."""
+    port, frames, ev = listener
+    payload = b"\x80\x01\x00\x01\x00\x00\x00\x02hi\x00\x00\x00\x01\x00"
+    c = socket.create_connection(("127.0.0.1", port))
+    c.sendall(struct.pack(">I", len(payload)) + payload)
+    assert _wait_frames(frames, ev, 1)
+    assert frames[0][0] == MSG_THRIFT and frames[0][2] == payload
