@@ -114,6 +114,10 @@ def parse_grpc_frames(data: bytes) -> list[bytes]:
     out = []
     pos = 0
     while pos + 5 <= len(data):
+        if data[pos] != 0:
+            # compressed flag set without a negotiated grpc-encoding —
+            # the spec mandates UNIMPLEMENTED, not silent passthrough
+            raise NotImplementedError("compressed grpc message")
         n = struct.unpack(">I", data[pos + 1:pos + 5])[0]
         if pos + 5 + n > len(data):
             raise ValueError("truncated grpc frame")
@@ -290,7 +294,9 @@ class H2Connection:
                 self.on_stream_reset(stream_id, code)
         elif ftype == GOAWAY:
             self._goaway = True
-            self.on_goaway()
+            last = struct.unpack(">I", payload[:4])[0] & 0x7FFFFFFF \
+                if len(payload) >= 4 else 0
+            self.on_goaway(last)
         # PRIORITY / PUSH_PROMISE ignored (push disabled)
 
     def _on_settings(self, flags: int, payload: bytes) -> None:
@@ -308,7 +314,7 @@ class H2Connection:
                         st.send_window += delta
                     self._fc.notify_all()
             elif ident == SETTINGS_MAX_FRAME_SIZE:
-                self.remote_max_frame = max(16384, min(value, 1 << 24 - 1))
+                self.remote_max_frame = max(16384, min(value, (1 << 24) - 1))
             elif ident == SETTINGS_HEADER_TABLE_SIZE:
                 self._enc.set_max_table_size(min(value, 4096))
         self._send(build_frame(SETTINGS, FLAG_ACK, 0, b""))
@@ -327,15 +333,23 @@ class H2Connection:
             self._fc.notify_all()
 
     def _strip_padding(self, flags: int, payload: bytes,
-                       priority: bool) -> bytes:
+                       priority: bool) -> Optional[bytes]:
+        """Returns the frame content, or None for a malformed frame (pad
+        length >= remaining payload, RFC 7540 §6.1 connection error)."""
         pos = 0
         pad = 0
         if flags & FLAG_PADDED:
+            if not payload:
+                self.send_goaway(code=H2_PROTOCOL_ERROR)
+                return None
             pad = payload[0]
             pos = 1
         if priority and (flags & FLAG_PRIORITY):
             pos += 5
         end = len(payload) - pad
+        if end < pos:
+            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            return None
         return payload[pos:end]
 
     def _stream(self, stream_id: int) -> _StreamState:
@@ -345,8 +359,10 @@ class H2Connection:
         if stream_id == 0:
             self.send_goaway(code=H2_PROTOCOL_ERROR)
             return
-        st = self._stream(stream_id)
         block = self._strip_padding(flags, payload, priority=True)
+        if block is None:
+            return
+        st = self._stream(stream_id)
         st.header_block = bytearray(block)
         if st.headers:        # second HEADERS on a stream = trailers
             st.trailer_phase = True
@@ -383,18 +399,25 @@ class H2Connection:
             self._complete(st)
 
     def _on_data(self, stream_id: int, flags: int, payload: bytes) -> None:
+        # replenish the connection window even for unknown/reset streams:
+        # in-flight DATA after an RST still consumed connection credit, and
+        # dropping it without a WINDOW_UPDATE would leak the window
+        # permanently.  (Receiver-side credit return, the CONSUMED-feedback
+        # analog of stream_impl.h:80 — we buffer in host RAM, no
+        # backpressure needed at this layer.)
+        if len(payload):
+            wu = struct.pack(">I", len(payload))
+            frames = build_frame(WINDOW_UPDATE, 0, 0, wu)
+            if stream_id in self._streams:
+                frames += build_frame(WINDOW_UPDATE, 0, stream_id, wu)
+            self._send(frames)
         st = self._streams.get(stream_id)
         if st is None:
             return
         data = self._strip_padding(flags, payload, priority=False)
+        if data is None:
+            return
         st.data += data
-        # replenish both windows immediately: we buffer in host RAM, no
-        # backpressure needed at this layer (receiver-side credit return,
-        # the CONSUMED-feedback analog of stream_impl.h:80)
-        if len(payload):
-            wu = struct.pack(">I", len(payload))
-            self._send(build_frame(WINDOW_UPDATE, 0, 0, wu)
-                       + build_frame(WINDOW_UPDATE, 0, stream_id, wu))
         if flags & FLAG_END_STREAM:
             st.ended = True
             self._complete(st)
@@ -414,7 +437,7 @@ class H2Connection:
     def on_stream_reset(self, stream_id: int, code: int) -> None:
         pass
 
-    def on_goaway(self) -> None:
+    def on_goaway(self, last_stream: int) -> None:
         pass
 
 
@@ -473,6 +496,10 @@ class GrpcServerConnection(H2Connection):
             try:
                 msgs = parse_grpc_frames(bytes(st.data))
                 payload = msgs[0] if msgs else b""
+            except NotImplementedError:
+                self._respond_error(st.id, GRPC_UNIMPLEMENTED,
+                                    "grpc message compression not supported")
+                return
             except ValueError:
                 self._respond_error(st.id, GRPC_INTERNAL, "bad grpc framing")
                 return
@@ -485,7 +512,8 @@ class GrpcServerConnection(H2Connection):
             timeout_s = parse_grpc_timeout(h.get("grpc-timeout"))
             deadline = (time.monotonic() + timeout_s) if timeout_s else None
             resp, code, text = self._server.invoke_grpc(service, method_name,
-                                                        payload, h)
+                                                        payload, h,
+                                                        peer_sid=self.sid)
             if deadline is not None and time.monotonic() > deadline:
                 self._respond_error(st.id, GRPC_DEADLINE_EXCEEDED,
                                     "deadline exceeded on server")
@@ -599,18 +627,21 @@ class _GrpcClientConnection(H2Connection):
     def start_call(self, service: str, method: str, payload: bytes,
                    metadata: list[tuple[str, str]]) -> Future:
         fut: Future = Future()
-        with self._calls_lock:
-            stream_id = self._next_stream
-            self._next_stream += 2
-            self._calls[stream_id] = fut
-        self.open_stream(stream_id)  # track our send window for this stream
-        headers = [(":method", "POST"), (":scheme", "http"),
-                   (":path", f"/{service}/{method}"),
-                   (":authority", self._authority),
-                   ("content-type", "application/grpc"),
-                   ("te", "trailers")] + metadata
         try:
-            self.send_headers(stream_id, headers)
+            # allocate the id AND send HEADERS under one lock: RFC 7540
+            # §5.1.1 requires stream ids to hit the wire in increasing
+            # order, so the two steps must not interleave across threads
+            with self._calls_lock:
+                stream_id = self._next_stream
+                self._next_stream += 2
+                self._calls[stream_id] = fut
+                self.open_stream(stream_id)  # track our send window
+                headers = [(":method", "POST"), (":scheme", "http"),
+                           (":path", f"/{service}/{method}"),
+                           (":authority", self._authority),
+                           ("content-type", "application/grpc"),
+                           ("te", "trailers")] + metadata
+                self.send_headers(stream_id, headers)
             self.send_data(stream_id, grpc_frame(payload), end_stream=True)
         except Exception as e:
             with self._calls_lock:
@@ -639,8 +670,8 @@ class _GrpcClientConnection(H2Connection):
         try:
             msgs = parse_grpc_frames(bytes(st.data))
             fut.set_result(msgs[0] if msgs else b"")
-        except ValueError as e:
-            fut.set_exception(errors.RpcError(errors.EINTERNAL, str(e)))
+        except (ValueError, NotImplementedError) as e:
+            fut.set_exception(errors.RpcError(errors.ERESPONSE, str(e)))
 
     def on_stream_reset(self, stream_id: int, code: int) -> None:
         with self._calls_lock:
@@ -648,3 +679,18 @@ class _GrpcClientConnection(H2Connection):
         if fut is not None and not fut.done():
             fut.set_exception(errors.RpcError(
                 errors.EINTERNAL, f"stream reset by peer (h2 error {code})"))
+
+    def on_goaway(self, last_stream: int) -> None:
+        """Fail calls the peer will never process (ids above last_stream)
+        immediately instead of letting them ride out their full timeout."""
+        with self._calls_lock:
+            doomed = {sid: f for sid, f in self._calls.items()
+                      if sid > last_stream}
+            for sid in doomed:
+                del self._calls[sid]
+        for sid, fut in doomed.items():
+            self.close_stream(sid)
+            if not fut.done():
+                fut.set_exception(errors.RpcError(
+                    errors.EFAILEDSOCKET,
+                    "connection going away (h2 GOAWAY)"))

@@ -1,0 +1,422 @@
+"""Memcache binary protocol — pipelined client + server-side handlers.
+
+Reference: policy/memcache_binary_protocol.cpp (parse/pack),
+memcache.cpp:806 (MemcacheRequest/Response command builders; the reference
+is client-only — we add a server-side service so in-process loopback tests
+work, mirroring how RedisService does, redis.h:192).
+
+Wire format (24-byte header, network order):
+  magic(1) opcode(1) keylen(2) extraslen(1) datatype(1) vbucket|status(2)
+  totalbody(4) opaque(4) cas(8)
+The native core frames one complete packet per message (MSG_MEMCACHE,
+src/cc/net/parser.cc:parse_memcache) and delivers packets INLINE in
+per-connection FIFO order — binary memcache has no reordering, so client
+reply matching is a deque pop exactly like redis pipelining
+(PipelinedInfo, socket.h:159).
+"""
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.rpc.transport import MSG_MEMCACHE, Transport
+
+HEADER = struct.Struct(">BBHBBHIIQ")
+MAGIC_REQ = 0x80
+MAGIC_RES = 0x81
+
+# opcodes
+OP_GET = 0x00
+OP_SET = 0x01
+OP_ADD = 0x02
+OP_REPLACE = 0x03
+OP_DELETE = 0x04
+OP_INCR = 0x05
+OP_DECR = 0x06
+OP_FLUSH = 0x08
+OP_NOOP = 0x0A
+OP_VERSION = 0x0B
+OP_APPEND = 0x0E
+OP_PREPEND = 0x0F
+OP_TOUCH = 0x1C
+
+# status codes
+ST_OK = 0x0000
+ST_KEY_ENOENT = 0x0001
+ST_KEY_EEXISTS = 0x0002
+ST_E2BIG = 0x0003
+ST_EINVAL = 0x0004
+ST_NOT_STORED = 0x0005
+ST_DELTA_BADVAL = 0x0006
+ST_UNKNOWN_COMMAND = 0x0081
+
+_STATUS_TEXT = {
+    ST_KEY_ENOENT: "key not found",
+    ST_KEY_EEXISTS: "key exists (cas mismatch)",
+    ST_E2BIG: "value too large",
+    ST_EINVAL: "invalid arguments",
+    ST_NOT_STORED: "item not stored",
+    ST_DELTA_BADVAL: "non-numeric value for incr/decr",
+    ST_UNKNOWN_COMMAND: "unknown command",
+}
+
+
+class MemcacheError(Exception):
+    def __init__(self, status: int, msg: str = ""):
+        self.status = status
+        super().__init__(msg or _STATUS_TEXT.get(status,
+                                                 f"status 0x{status:04x}"))
+
+
+def pack_packet(magic: int, opcode: int, key: bytes = b"",
+                extras: bytes = b"", value: bytes = b"", status: int = 0,
+                opaque: int = 0, cas: int = 0) -> bytes:
+    total = len(extras) + len(key) + len(value)
+    return HEADER.pack(magic, opcode, len(key), len(extras), 0, status,
+                       total, opaque, cas) + extras + key + value
+
+
+class Packet:
+    __slots__ = ("magic", "opcode", "status", "opaque", "cas", "extras",
+                 "key", "value")
+
+    @classmethod
+    def parse(cls, data: bytes) -> "Packet":
+        if len(data) < 24:
+            raise ValueError("short memcache packet")
+        (magic, opcode, keylen, extraslen, _dt, status, total, opaque,
+         cas) = HEADER.unpack_from(data)
+        if len(data) < 24 + total:
+            raise ValueError("truncated memcache packet")
+        p = cls()
+        p.magic, p.opcode, p.status, p.opaque, p.cas = \
+            magic, opcode, status, opaque, cas
+        body = data[24:24 + total]
+        p.extras = body[:extraslen]
+        p.key = body[extraslen:extraslen + keylen]
+        p.value = body[extraslen + keylen:]
+        return p
+
+
+class GetResult:
+    __slots__ = ("value", "flags", "cas")
+
+    def __init__(self, value: bytes, flags: int, cas: int):
+        self.value = value
+        self.flags = flags
+        self.cas = cas
+
+    def __repr__(self):
+        return f"GetResult(value={self.value!r}, flags={self.flags}, " \
+               f"cas={self.cas})"
+
+
+class MemcacheChannel:
+    """Pipelined memcache binary client (reference memcache.cpp command
+    surface: Get/Set/Add/Replace/Append/Prepend/Delete/Flush/Incr/Decr/
+    Touch/Version, memcache.h:40-130)."""
+
+    def __init__(self, address: str, timeout_ms: int = 1000):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.timeout_ms = timeout_ms
+        self._mu = threading.Lock()
+        self._sid: Optional[int] = None
+        self._pending: deque[tuple[Future, int]] = deque()  # (fut, opcode)
+
+    # ---- connection ----
+
+    def _ensure_connected(self) -> int:
+        with self._mu:
+            t = Transport.instance()
+            if self._sid is not None and t.alive(self._sid):
+                return self._sid
+            self._fail_pending_locked(errors.EFAILEDSOCKET)
+            self._sid = t.connect(self.host, self.port, self._on_message,
+                                  self._on_failed)
+            return self._sid
+
+    def _fail_pending_locked(self, code: int) -> None:
+        while self._pending:
+            fut, _ = self._pending.popleft()
+            if not fut.done():
+                fut.set_exception(errors.RpcError(code, "memcache conn lost"))
+
+    def _on_failed(self, sid: int, err: int) -> None:
+        with self._mu:
+            if sid == self._sid:
+                self._sid = None
+            self._fail_pending_locked(errors.EFAILEDSOCKET)
+
+    def _on_message(self, sid: int, kind: int, meta: bytes, body) -> None:
+        if kind != MSG_MEMCACHE:
+            return
+        try:
+            p = Packet.parse(body.to_bytes())
+        except ValueError:
+            return
+        with self._mu:
+            fut = self._pending.popleft()[0] if self._pending else None
+        if fut is not None and not fut.done():
+            fut.set_result(p)
+
+    # ---- raw pipelined op ----
+
+    def execute(self, opcode: int, key: bytes | str = b"",
+                extras: bytes = b"", value: bytes = b"",
+                cas: int = 0) -> Future:
+        if isinstance(key, str):
+            key = key.encode()
+        sid = self._ensure_connected()
+        fut: Future = Future()
+        pkt = pack_packet(MAGIC_REQ, opcode, key, extras, value, cas=cas)
+        with self._mu:
+            self._pending.append((fut, opcode))
+        if Transport.instance().write_raw(sid, pkt) != 0:
+            with self._mu:
+                if self._pending and self._pending[-1][0] is fut:
+                    self._pending.pop()
+            fut.set_exception(errors.RpcError(errors.EFAILEDSOCKET,
+                                              "memcache write failed"))
+        return fut
+
+    def _wait(self, fut: Future, timeout_ms: Optional[int]) -> Packet:
+        try:
+            return fut.result((timeout_ms or self.timeout_ms) / 1e3)
+        except TimeoutError:
+            raise errors.RpcError(errors.ERPCTIMEDOUT, "memcache timed out")
+
+    # ---- command surface ----
+
+    def get(self, key, timeout_ms=None) -> Optional[GetResult]:
+        p = self._wait(self.execute(OP_GET, key), timeout_ms)
+        if p.status == ST_KEY_ENOENT:
+            return None
+        if p.status != ST_OK:
+            raise MemcacheError(p.status, p.value.decode("utf-8", "replace"))
+        flags = struct.unpack(">I", p.extras[:4])[0] if len(p.extras) >= 4 \
+            else 0
+        return GetResult(p.value, flags, p.cas)
+
+    def _store(self, opcode, key, value, flags, exptime, cas,
+               timeout_ms) -> int:
+        if isinstance(value, str):
+            value = value.encode()
+        extras = struct.pack(">II", flags, exptime)
+        p = self._wait(self.execute(opcode, key, extras, value, cas=cas),
+                       timeout_ms)
+        if p.status != ST_OK:
+            raise MemcacheError(p.status, p.value.decode("utf-8", "replace"))
+        return p.cas
+
+    def set(self, key, value, flags=0, exptime=0, cas=0, timeout_ms=None):
+        return self._store(OP_SET, key, value, flags, exptime, cas,
+                           timeout_ms)
+
+    def add(self, key, value, flags=0, exptime=0, timeout_ms=None):
+        return self._store(OP_ADD, key, value, flags, exptime, 0, timeout_ms)
+
+    def replace(self, key, value, flags=0, exptime=0, timeout_ms=None):
+        return self._store(OP_REPLACE, key, value, flags, exptime, 0,
+                           timeout_ms)
+
+    def _concat(self, opcode, key, value, timeout_ms) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        p = self._wait(self.execute(opcode, key, b"", value), timeout_ms)
+        if p.status != ST_OK:
+            raise MemcacheError(p.status)
+
+    def append(self, key, value, timeout_ms=None) -> None:
+        self._concat(OP_APPEND, key, value, timeout_ms)
+
+    def prepend(self, key, value, timeout_ms=None) -> None:
+        self._concat(OP_PREPEND, key, value, timeout_ms)
+
+    def delete(self, key, timeout_ms=None) -> bool:
+        p = self._wait(self.execute(OP_DELETE, key), timeout_ms)
+        if p.status == ST_KEY_ENOENT:
+            return False
+        if p.status != ST_OK:
+            raise MemcacheError(p.status)
+        return True
+
+    def _arith(self, opcode, key, delta, initial, exptime,
+               timeout_ms) -> int:
+        extras = struct.pack(">QQI", delta, initial, exptime)
+        p = self._wait(self.execute(opcode, key, extras), timeout_ms)
+        if p.status != ST_OK:
+            raise MemcacheError(p.status)
+        return struct.unpack(">Q", p.value[:8])[0]
+
+    def incr(self, key, delta=1, initial=0, exptime=0, timeout_ms=None):
+        return self._arith(OP_INCR, key, delta, initial, exptime, timeout_ms)
+
+    def decr(self, key, delta=1, initial=0, exptime=0, timeout_ms=None):
+        return self._arith(OP_DECR, key, delta, initial, exptime, timeout_ms)
+
+    def touch(self, key, exptime, timeout_ms=None) -> bool:
+        extras = struct.pack(">I", exptime)
+        p = self._wait(self.execute(OP_TOUCH, key, extras), timeout_ms)
+        return p.status == ST_OK
+
+    def version(self, timeout_ms=None) -> str:
+        p = self._wait(self.execute(OP_VERSION), timeout_ms)
+        return p.value.decode()
+
+    def flush_all(self, timeout_ms=None) -> None:
+        p = self._wait(self.execute(OP_FLUSH), timeout_ms)
+        if p.status != ST_OK:
+            raise MemcacheError(p.status)
+
+    def noop(self, timeout_ms=None) -> None:
+        self._wait(self.execute(OP_NOOP), timeout_ms)
+
+    def close(self) -> None:
+        # release _mu before the native close: the failed-callback fires
+        # synchronously on this thread and takes _mu (redis.py pattern)
+        with self._mu:
+            sid, self._sid = self._sid, None
+        if sid is not None:
+            Transport.instance().close(sid)
+
+
+# ---- server side ----------------------------------------------------------
+
+class MemcacheService:
+    """Server-side memcache-speaking service: override handle_packet or use
+    MemoryMemcacheService.  Wired via ServerOptions.memcache_service; the
+    Server answers MSG_MEMCACHE frames with handle_bytes()."""
+
+    def handle_bytes(self, raw: bytes) -> bytes:
+        try:
+            req = Packet.parse(raw)
+        except ValueError:
+            return pack_packet(MAGIC_RES, 0, status=ST_EINVAL)
+        return self.handle_packet(req)
+
+    def handle_packet(self, req: Packet) -> bytes:  # pragma: no cover
+        return pack_packet(MAGIC_RES, req.opcode, status=ST_UNKNOWN_COMMAND,
+                           opaque=req.opaque)
+
+
+class MemoryMemcacheService(MemcacheService):
+    """In-memory store speaking the full binary command set (loopback
+    integration tests + demos; plays the role memcached does in the
+    reference's example/memcache_c++)."""
+
+    VERSION = b"tpu-rpc-memcache/1.0"
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # key -> [value, flags, cas, expire_ts(0=never)]
+        self._store: dict[bytes, list] = {}
+        self._cas = 0
+
+    def _next_cas(self) -> int:
+        self._cas += 1
+        return self._cas
+
+    def _alive(self, ent) -> bool:
+        return ent[3] == 0 or ent[3] > time.time()
+
+    def _get(self, key):
+        ent = self._store.get(key)
+        if ent is None or not self._alive(ent):
+            self._store.pop(key, None)
+            return None
+        return ent
+
+    @staticmethod
+    def _exptime_to_ts(exptime: int) -> float:
+        if exptime == 0:
+            return 0
+        # memcache semantics: >30 days means absolute unix time
+        return exptime if exptime > 2592000 else time.time() + exptime
+
+    def handle_packet(self, req: Packet) -> bytes:
+        op = req.opcode
+        oq = req.opaque
+
+        def resp(status=ST_OK, extras=b"", value=b"", cas=0):
+            return pack_packet(MAGIC_RES, op, b"", extras, value,
+                               status=status, opaque=oq, cas=cas)
+
+        with self._mu:
+            if op == OP_GET:
+                ent = self._get(req.key)
+                if ent is None:
+                    return resp(ST_KEY_ENOENT)
+                return resp(extras=struct.pack(">I", ent[1]), value=ent[0],
+                            cas=ent[2])
+            if op in (OP_SET, OP_ADD, OP_REPLACE):
+                flags, exptime = struct.unpack(">II", req.extras[:8]) \
+                    if len(req.extras) >= 8 else (0, 0)
+                ent = self._get(req.key)
+                if op == OP_ADD and ent is not None:
+                    return resp(ST_KEY_EEXISTS)
+                if op == OP_REPLACE and ent is None:
+                    return resp(ST_KEY_ENOENT)
+                if req.cas and (ent is None or ent[2] != req.cas):
+                    return resp(ST_KEY_EEXISTS)
+                cas = self._next_cas()
+                self._store[req.key] = [req.value, flags, cas,
+                                        self._exptime_to_ts(exptime)]
+                return resp(cas=cas)
+            if op in (OP_APPEND, OP_PREPEND):
+                ent = self._get(req.key)
+                if ent is None:
+                    return resp(ST_NOT_STORED)
+                ent[0] = ent[0] + req.value if op == OP_APPEND \
+                    else req.value + ent[0]
+                ent[2] = self._next_cas()
+                return resp(cas=ent[2])
+            if op == OP_DELETE:
+                ent = self._get(req.key)
+                if ent is None:
+                    return resp(ST_KEY_ENOENT)
+                del self._store[req.key]
+                return resp()
+            if op in (OP_INCR, OP_DECR):
+                if len(req.extras) < 20:
+                    return resp(ST_EINVAL)
+                delta, initial, exptime = struct.unpack(">QQI",
+                                                        req.extras[:20])
+                ent = self._get(req.key)
+                if ent is None:
+                    if exptime == 0xFFFFFFFF:
+                        return resp(ST_KEY_ENOENT)
+                    n = initial
+                else:
+                    try:
+                        n = int(ent[0])
+                    except ValueError:
+                        return resp(ST_DELTA_BADVAL)
+                    n = n + delta if op == OP_INCR else max(0, n - delta)
+                cas = self._next_cas()
+                self._store[req.key] = [str(n).encode(),
+                                        ent[1] if ent else 0, cas,
+                                        ent[3] if ent
+                                        else self._exptime_to_ts(exptime)]
+                return resp(value=struct.pack(">Q", n), cas=cas)
+            if op == OP_TOUCH:
+                ent = self._get(req.key)
+                if ent is None:
+                    return resp(ST_KEY_ENOENT)
+                exptime = struct.unpack(">I", req.extras[:4])[0] \
+                    if len(req.extras) >= 4 else 0
+                ent[3] = self._exptime_to_ts(exptime)
+                return resp()
+            if op == OP_FLUSH:
+                self._store.clear()
+                return resp()
+            if op == OP_VERSION:
+                return resp(value=self.VERSION)
+            if op == OP_NOOP:
+                return resp()
+        return resp(ST_UNKNOWN_COMMAND)

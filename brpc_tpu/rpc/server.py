@@ -21,8 +21,9 @@ from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
 from brpc_tpu.rpc.service import MethodSpec, Service
-from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_REDIS, MSG_TRPC,
-                                    Transport)
+from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_MEMCACHE,
+                                    MSG_MONGO, MSG_REDIS, MSG_THRIFT,
+                                    MSG_TRPC, Transport)
 
 
 @dataclass
@@ -40,6 +41,16 @@ class ServerOptions:
     # ServerOptions.redis_service, redis.h:192): a RedisService whose
     # command handlers answer RESP traffic detected by the native parser.
     redis_service: Optional[Any] = None
+    # Serve the memcache binary protocol on the same port (the reference
+    # is client-only for memcache; server side mirrors redis_service so
+    # loopback tests and demos work): a MemcacheService.
+    memcache_service: Optional[Any] = None
+    # Serve framed-binary thrift on the same port (reference
+    # thrift_service.h adaptor): a ThriftService with method handlers.
+    thrift_service: Optional[Any] = None
+    # Serve the mongo wire protocol (reference mongo_service_adaptor.h):
+    # an object with handle_bytes(raw) -> bytes.
+    mongo_service: Optional[Any] = None
     # Catch-all service for unmatched (service, method) — the generic
     # proxy hook (reference baidu_master_service.{h,cpp}).  An object with
     # process(cntl, request_bytes) -> bytes; the target names are on
@@ -267,6 +278,50 @@ class Server:
                 Transport.instance().write_raw(
                     sid, svc.handle_bytes(body.to_bytes()))
             return
+        if kind == MSG_MEMCACHE:
+            svc = self.options.memcache_service
+            if svc is None:
+                # binary "unknown command" so clients fail fast
+                from brpc_tpu.rpc.memcache import (MAGIC_RES,
+                                                   ST_UNKNOWN_COMMAND,
+                                                   pack_packet)
+                Transport.instance().write_raw(
+                    sid, pack_packet(MAGIC_RES, 0,
+                                     status=ST_UNKNOWN_COMMAND))
+            else:
+                Transport.instance().write_raw(
+                    sid, svc.handle_bytes(body.to_bytes()))
+            return
+        if kind == MSG_THRIFT:
+            svc = self.options.thrift_service
+            if svc is None:
+                from brpc_tpu.rpc.thrift import (decode_message,
+                                                 encode_exception)
+                try:
+                    req = decode_message(body.to_bytes())
+                    name, seqid = req.name, req.seqid
+                except ValueError:
+                    name, seqid = "unknown", 0
+                Transport.instance().write_raw(
+                    sid, encode_exception(name, seqid,
+                                          "this server has no thrift "
+                                          "service", 1))
+            else:
+                out = svc.handle_bytes(body.to_bytes())
+                if out:
+                    Transport.instance().write_raw(sid, out)
+            return
+        if kind == MSG_MONGO:
+            svc = self.options.mongo_service
+            if svc is None:
+                # no silent drop: close so mongo drivers fail fast instead
+                # of blocking on recv forever
+                Transport.instance().close(sid)
+            else:
+                out = svc.handle_bytes(body.to_bytes())
+                if out:
+                    Transport.instance().write_raw(sid, out)
+            return
         try:
             meta = M.RpcMeta.decode(meta_bytes)
         except ValueError:
@@ -487,7 +542,8 @@ class Server:
     # ---- gRPC entry (policy/http2_rpc_protocol.cpp server role) ----
 
     def invoke_grpc(self, service: str, method_name: str, payload: bytes,
-                    headers: dict[str, str]) -> tuple[bytes, int, str]:
+                    headers: dict[str, str],
+                    peer_sid: Optional[int] = None) -> tuple[bytes, int, str]:
         """Dispatch one unary gRPC request through the SAME gates as native
         traffic.  Returns (response_payload, error_code, error_text); the
         h2 connection maps error_code to a grpc-status trailer."""
@@ -551,11 +607,17 @@ class Server:
             cntl = Controller()
             cntl.is_server_side = True
             cntl.request_meta = meta
+            cntl.peer_sid = peer_sid
             rpcz.set_current_span(span)
+            if self._session_pool is not None:
+                cntl.session_data = self._session_pool.borrow()
             try:
                 result = spec.fn(cntl, request)
             finally:
                 rpcz.set_current_span(None)
+                if self._session_pool is not None:
+                    self._session_pool.give_back(cntl.session_data)
+                    cntl.session_data = None
             if cntl.failed():
                 error_code, text = cntl.error_code, cntl.error_text
             else:

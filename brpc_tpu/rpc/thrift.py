@@ -1,0 +1,378 @@
+"""Thrift framed-binary protocol — schema-free codec, client channel,
+server-side service adaptor.
+
+Reference: policy/thrift_protocol.cpp:766, thrift_message.{h,cpp}.  The
+native core frames one complete thrift message per MSG_THRIFT (u32be
+frame length + TBinaryProtocol payload, src/cc/net/parser.cc:parse_thrift)
+delivered in per-connection FIFO order; replies additionally match on
+seqid, mirroring the reference's correlation handling.
+
+The codec is schema-free (no IDL compiler): requests are field lists,
+decoded structs come back as {field_id: value} dicts.  This is the same
+positional contract the reference's ThriftFramedMessage raw mode exposes
+when no generated types are linked in.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.rpc.transport import MSG_THRIFT, Transport
+
+VERSION_1 = 0x80010000
+
+# message types
+MT_CALL, MT_REPLY, MT_EXCEPTION, MT_ONEWAY = 1, 2, 3, 4
+
+# field types
+T_STOP = 0
+T_VOID = 1
+T_BOOL = 2
+T_BYTE = 3
+T_DOUBLE = 4
+T_I16 = 6
+T_I32 = 8
+T_I64 = 10
+T_STRING = 11
+T_STRUCT = 12
+T_MAP = 13
+T_SET = 14
+T_LIST = 15
+
+
+class ThriftError(Exception):
+    """TApplicationException from the peer."""
+
+    def __init__(self, message: str = "", etype: int = 0):
+        self.etype = etype
+        super().__init__(message or f"thrift exception type {etype}")
+
+
+class TField:
+    __slots__ = ("id", "ttype", "value")
+
+    def __init__(self, fid: int, ttype: int, value: Any):
+        self.id = fid
+        self.ttype = ttype
+        self.value = value
+
+
+# ---- binary writer ---------------------------------------------------------
+
+def _w_value(out: bytearray, ttype: int, v: Any) -> None:
+    if ttype == T_BOOL:
+        out.append(1 if v else 0)
+    elif ttype == T_BYTE:
+        out += struct.pack(">b", v)
+    elif ttype == T_DOUBLE:
+        out += struct.pack(">d", v)
+    elif ttype == T_I16:
+        out += struct.pack(">h", v)
+    elif ttype == T_I32:
+        out += struct.pack(">i", v)
+    elif ttype == T_I64:
+        out += struct.pack(">q", v)
+    elif ttype == T_STRING:
+        raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        out += struct.pack(">i", len(raw)) + raw
+    elif ttype == T_STRUCT:
+        _w_struct(out, v)
+    elif ttype == T_MAP:
+        ktype, vtype, items = v
+        out += struct.pack(">bbi", ktype, vtype, len(items))
+        for k, val in (items.items() if isinstance(items, dict) else items):
+            _w_value(out, ktype, k)
+            _w_value(out, vtype, val)
+    elif ttype in (T_SET, T_LIST):
+        etype, items = v
+        out += struct.pack(">bi", etype, len(items))
+        for it in items:
+            _w_value(out, etype, it)
+    else:
+        raise ValueError(f"cannot encode thrift type {ttype}")
+
+
+def _w_struct(out: bytearray, fields) -> None:
+    """fields: iterable of TField (or (id, ttype, value) tuples)."""
+    for f in fields:
+        if not isinstance(f, TField):
+            f = TField(*f)
+        out += struct.pack(">bh", f.ttype, f.id)
+        _w_value(out, f.ttype, f.value)
+    out.append(T_STOP)
+
+
+def encode_message(name: str, mtype: int, seqid: int, fields) -> bytes:
+    body = bytearray()
+    body += struct.pack(">I", VERSION_1 | mtype)
+    raw = name.encode()
+    body += struct.pack(">i", len(raw)) + raw
+    body += struct.pack(">i", seqid)
+    _w_struct(body, fields)
+    return struct.pack(">I", len(body)) + bytes(body)
+
+
+# ---- binary reader ---------------------------------------------------------
+
+class _Reader:
+    def __init__(self, data: bytes, pos: int = 0):
+        self.d = data
+        self.p = pos
+
+    def take(self, n: int) -> bytes:
+        if self.p + n > len(self.d):
+            raise ValueError("truncated thrift payload")
+        v = self.d[self.p:self.p + n]
+        self.p += n
+        return v
+
+    def unpack(self, fmt: str):
+        s = struct.Struct(fmt)
+        return s.unpack(self.take(s.size))[0]
+
+    def value(self, ttype: int):
+        if ttype == T_BOOL:
+            return bool(self.take(1)[0])
+        if ttype == T_BYTE:
+            return self.unpack(">b")
+        if ttype == T_DOUBLE:
+            return self.unpack(">d")
+        if ttype == T_I16:
+            return self.unpack(">h")
+        if ttype == T_I32:
+            return self.unpack(">i")
+        if ttype == T_I64:
+            return self.unpack(">q")
+        if ttype == T_STRING:
+            n = self.unpack(">i")
+            if n < 0:
+                raise ValueError("negative string length")
+            return self.take(n)
+        if ttype == T_STRUCT:
+            return self.struct_()
+        if ttype == T_MAP:
+            ktype = self.unpack(">b")
+            vtype = self.unpack(">b")
+            n = self.unpack(">i")
+            return {self.value(ktype): self.value(vtype) for _ in range(n)}
+        if ttype in (T_SET, T_LIST):
+            etype = self.unpack(">b")
+            n = self.unpack(">i")
+            return [self.value(etype) for _ in range(n)]
+        raise ValueError(f"cannot decode thrift type {ttype}")
+
+    def struct_(self) -> dict[int, Any]:
+        out: dict[int, Any] = {}
+        while True:
+            ttype = self.take(1)[0]
+            if ttype == T_STOP:
+                return out
+            fid = self.unpack(">h")
+            out[fid] = self.value(ttype)
+
+
+class ThriftMessage:
+    __slots__ = ("name", "mtype", "seqid", "fields")
+
+    def __init__(self, name: str, mtype: int, seqid: int,
+                 fields: dict[int, Any]):
+        self.name = name
+        self.mtype = mtype
+        self.seqid = seqid
+        self.fields = fields
+
+
+def decode_message(payload: bytes) -> ThriftMessage:
+    """payload = TBinaryProtocol bytes WITHOUT the u32be frame length (the
+    native parser strips it; MSG_THRIFT body is exactly this)."""
+    r = _Reader(payload)
+    ver = r.unpack(">I")
+    if ver & 0xFFFF0000 != VERSION_1:
+        raise ValueError(f"bad thrift version 0x{ver:08x}")
+    mtype = ver & 0xFF
+    nlen = r.unpack(">i")
+    name = r.take(nlen).decode("utf-8", "replace")
+    seqid = r.unpack(">i")
+    fields = r.struct_()
+    return ThriftMessage(name, mtype, seqid, fields)
+
+
+def encode_exception(name: str, seqid: int, message: str,
+                     etype: int = 6) -> bytes:
+    return encode_message(name, MT_EXCEPTION, seqid, [
+        TField(1, T_STRING, message), TField(2, T_I32, etype)])
+
+
+# ---- client ----------------------------------------------------------------
+
+class ThriftChannel:
+    """Framed-binary thrift client with pipelined calls matched by seqid
+    (reference thrift client role of policy/thrift_protocol.cpp).
+
+        ch = ThriftChannel("127.0.0.1:9090")
+        result = ch.call("add", [TField(1, T_I32, 2), TField(2, T_I32, 3)])
+        # result: reply struct dict; result[0] is the conventional
+        # 'success' field
+    """
+
+    def __init__(self, address: str, timeout_ms: int = 1000):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.timeout_ms = timeout_ms
+        self._mu = threading.Lock()
+        self._sid: Optional[int] = None
+        self._seq = 0
+        self._pending: dict[int, Future] = {}
+
+    def _ensure_connected(self) -> int:
+        with self._mu:
+            t = Transport.instance()
+            if self._sid is not None and t.alive(self._sid):
+                return self._sid
+            self._fail_pending_locked()
+            self._sid = t.connect(self.host, self.port, self._on_message,
+                                  self._on_failed)
+            return self._sid
+
+    def _fail_pending_locked(self) -> None:
+        pend, self._pending = self._pending, {}
+        for fut in pend.values():
+            if not fut.done():
+                fut.set_exception(errors.RpcError(errors.EFAILEDSOCKET,
+                                                  "thrift conn lost"))
+
+    def _on_failed(self, sid: int, err: int) -> None:
+        with self._mu:
+            if sid == self._sid:
+                self._sid = None
+            self._fail_pending_locked()
+
+    def _on_message(self, sid: int, kind: int, meta: bytes, body) -> None:
+        if kind != MSG_THRIFT:
+            return
+        try:
+            msg = decode_message(body.to_bytes())
+        except ValueError:
+            return
+        with self._mu:
+            fut = self._pending.pop(msg.seqid, None)
+        if fut is None or fut.done():
+            return
+        if msg.mtype == MT_EXCEPTION:
+            fut.set_exception(ThriftError(
+                msg.fields.get(1, b"").decode("utf-8", "replace")
+                if isinstance(msg.fields.get(1), bytes) else
+                str(msg.fields.get(1, "")),
+                msg.fields.get(2, 0)))
+        else:
+            fut.set_result(msg.fields)
+
+    def acall(self, method: str, fields=(), oneway: bool = False) -> Future:
+        sid = self._ensure_connected()
+        fut: Future = Future()
+        with self._mu:
+            self._seq += 1
+            seqid = self._seq
+            if not oneway:
+                self._pending[seqid] = fut
+        wire = encode_message(method, MT_ONEWAY if oneway else MT_CALL,
+                              seqid, fields)
+        if Transport.instance().write_raw(sid, wire) != 0:
+            with self._mu:
+                self._pending.pop(seqid, None)
+            fut.set_exception(errors.RpcError(errors.EFAILEDSOCKET,
+                                              "thrift write failed"))
+        elif oneway:
+            fut.set_result({})
+        return fut
+
+    def call(self, method: str, fields=(), timeout_ms: Optional[int] = None
+             ) -> dict[int, Any]:
+        fut = self.acall(method, fields)
+        try:
+            return fut.result((timeout_ms or self.timeout_ms) / 1e3)
+        except TimeoutError:
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  f"thrift call {method!r} timed out")
+
+    def close(self) -> None:
+        # release _mu before the native close: the failed-callback fires
+        # synchronously on this thread and takes _mu (redis.py pattern)
+        with self._mu:
+            sid, self._sid = self._sid, None
+        if sid is not None:
+            Transport.instance().close(sid)
+
+
+# ---- server ----------------------------------------------------------------
+
+class ThriftService:
+    """Server-side thrift method registry (the ThriftService adaptor slot of
+    thrift_service.h).  Handlers take the decoded args struct dict and
+    return the reply fields (a TField list, a dict {id: TField}, or a bare
+    value which becomes success field 0 — T_STRING for bytes/str,
+    T_I64 for int, T_DOUBLE for float, T_BOOL for bool).
+
+        svc = ThriftService()
+
+        @svc.method("add")
+        def add(args):
+            return TField(0, T_I32, args[1] + args[2])
+    """
+
+    def __init__(self):
+        self._methods: dict[str, Callable] = {}
+
+    def method(self, name: str):
+        def deco(fn):
+            self._methods[name] = fn
+            return fn
+        return deco
+
+    def add_handler(self, name: str, fn: Callable) -> None:
+        self._methods[name] = fn
+
+    @staticmethod
+    def _to_fields(result) -> list:
+        if result is None:
+            return []
+        if isinstance(result, TField):
+            return [result]
+        if isinstance(result, (list, tuple)):
+            return list(result)
+        if isinstance(result, bool):
+            return [TField(0, T_BOOL, result)]
+        if isinstance(result, int):
+            return [TField(0, T_I64, result)]
+        if isinstance(result, float):
+            return [TField(0, T_DOUBLE, result)]
+        if isinstance(result, (str, bytes)):
+            return [TField(0, T_STRING, result)]
+        raise TypeError(f"cannot infer thrift type for {type(result)!r}")
+
+    def handle_bytes(self, framed: bytes) -> bytes:
+        try:
+            msg = decode_message(framed)
+        except ValueError as e:
+            return encode_exception("unknown", 0, f"bad request: {e}", 7)
+        fn = self._methods.get(msg.name)
+        if fn is None:
+            return encode_exception(msg.name, msg.seqid,
+                                    f"unknown method {msg.name!r}", 1)
+        try:
+            result = fn(msg.fields)
+        except Exception as e:
+            return encode_exception(msg.name, msg.seqid,
+                                    f"{type(e).__name__}: {e}", 6)
+        if msg.mtype == MT_ONEWAY:
+            return b""
+        try:
+            return encode_message(msg.name, MT_REPLY, msg.seqid,
+                                  self._to_fields(result))
+        except (TypeError, ValueError) as e:
+            return encode_exception(msg.name, msg.seqid,
+                                    f"bad reply: {e}", 6)

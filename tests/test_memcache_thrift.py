@@ -1,0 +1,203 @@
+"""Memcache binary client + thrift codec/channel loopback tests
+(reference test pattern: in-process servers on 127.0.0.1, SURVEY.md §4;
+protocol parity with policy/memcache_binary_protocol.cpp and
+policy/thrift_protocol.cpp)."""
+import threading
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.rpc.memcache import (MemcacheChannel, MemcacheError,
+                                   MemoryMemcacheService)
+from brpc_tpu.rpc.thrift import (T_BOOL, T_I32, T_I64, T_LIST, T_MAP,
+                                 T_STRING, T_STRUCT, TField, ThriftChannel,
+                                 ThriftError, ThriftService, decode_message,
+                                 encode_message)
+
+
+# ---- thrift codec (no network) --------------------------------------------
+
+def test_thrift_codec_roundtrip():
+    fields = [
+        TField(1, T_I32, -42),
+        TField(2, T_STRING, "héllo"),
+        TField(3, T_BOOL, True),
+        TField(4, T_I64, 1 << 60),
+        TField(5, T_LIST, (T_I32, [1, 2, 3])),
+        TField(6, T_MAP, (T_STRING, T_I32, {"a": 1, "b": 2})),
+        TField(7, T_STRUCT, [TField(1, T_STRING, "nested")]),
+    ]
+    wire = encode_message("mymethod", 1, 7, fields)
+    msg = decode_message(wire[4:])  # strip frame length like the parser
+    assert msg.name == "mymethod" and msg.seqid == 7 and msg.mtype == 1
+    assert msg.fields[1] == -42
+    assert msg.fields[2] == "héllo".encode()
+    assert msg.fields[3] is True
+    assert msg.fields[4] == 1 << 60
+    assert msg.fields[5] == [1, 2, 3]
+    assert msg.fields[6] == {b"a": 1, b"b": 2}
+    assert msg.fields[7] == {1: b"nested"}
+
+
+def test_thrift_codec_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode_message(b"\x00\x00\x00\x00garbage")
+    with pytest.raises(ValueError):
+        decode_message(b"\x80\x01\x00\x01\x00\x00")  # truncated
+
+
+# ---- loopback servers ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def kv_server():
+    svc = ThriftService()
+
+    @svc.method("add")
+    def add(args):
+        return TField(0, T_I32, args[1] + args[2])
+
+    @svc.method("concat")
+    def concat(args):
+        return (args[1] + args[2]).decode()
+
+    @svc.method("boom")
+    def boom(args):
+        raise RuntimeError("kaboom")
+
+    s = brpc.Server(brpc.ServerOptions(
+        memcache_service=MemoryMemcacheService(),
+        thrift_service=svc))
+    s.start("127.0.0.1", 0)
+    yield s
+    s.stop()
+    s.join()
+
+
+# ---- memcache --------------------------------------------------------------
+
+def test_memcache_set_get_delete(kv_server):
+    ch = MemcacheChannel(f"127.0.0.1:{kv_server.port}")
+    cas = ch.set("k1", b"v1", flags=0xDEAD)
+    assert cas > 0
+    r = ch.get("k1")
+    assert r.value == b"v1" and r.flags == 0xDEAD and r.cas == cas
+    assert ch.delete("k1") is True
+    assert ch.get("k1") is None
+    assert ch.delete("k1") is False
+    ch.close()
+
+
+def test_memcache_add_replace_semantics(kv_server):
+    ch = MemcacheChannel(f"127.0.0.1:{kv_server.port}")
+    ch.delete("k2")
+    with pytest.raises(MemcacheError):
+        ch.replace("k2", b"x")          # replace needs existing
+    ch.add("k2", b"first")
+    with pytest.raises(MemcacheError):
+        ch.add("k2", b"second")         # add refuses existing
+    ch.replace("k2", b"second")
+    assert ch.get("k2").value == b"second"
+    ch.close()
+
+
+def test_memcache_cas_conflict(kv_server):
+    ch = MemcacheChannel(f"127.0.0.1:{kv_server.port}")
+    cas = ch.set("k3", b"a")
+    ch.set("k3", b"b")                  # bumps cas
+    with pytest.raises(MemcacheError):
+        ch.set("k3", b"c", cas=cas)     # stale cas
+    assert ch.get("k3").value == b"b"
+    ch.close()
+
+
+def test_memcache_incr_decr_append(kv_server):
+    ch = MemcacheChannel(f"127.0.0.1:{kv_server.port}")
+    ch.delete("n")
+    assert ch.incr("n", 5, initial=10) == 10    # created at initial
+    assert ch.incr("n", 5) == 15
+    assert ch.decr("n", 20) == 0                # clamps at 0
+    ch.set("s", b"mid")
+    ch.append("s", b"-end")
+    ch.prepend("s", b"start-")
+    assert ch.get("s").value == b"start-mid-end"
+    ch.close()
+
+
+def test_memcache_version_flush_pipelined(kv_server):
+    ch = MemcacheChannel(f"127.0.0.1:{kv_server.port}")
+    assert "tpu-rpc" in ch.version()
+    # pipeline many ops without waiting, then await the final future
+    futs = [ch.execute(0x01, b"p%d" % i,
+                       b"\x00" * 8, b"val%d" % i) for i in range(50)]
+    for f in futs:
+        assert f.result(3).status == 0
+    assert ch.get("p49").value == b"val49"
+    ch.flush_all()
+    assert ch.get("p49") is None
+    ch.close()
+
+
+def test_memcache_concurrent_clients(kv_server):
+    ch = MemcacheChannel(f"127.0.0.1:{kv_server.port}")
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(30):
+                k = f"t{i}"
+                ch.set(k, b"%d" % j)
+                got = ch.get(k)
+                assert got is not None
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert not errs
+    ch.close()
+
+
+# ---- thrift ----------------------------------------------------------------
+
+def test_thrift_call(kv_server):
+    ch = ThriftChannel(f"127.0.0.1:{kv_server.port}")
+    out = ch.call("add", [TField(1, T_I32, 2), TField(2, T_I32, 40)])
+    assert out[0] == 42
+    out = ch.call("concat", [TField(1, T_STRING, "foo"),
+                             TField(2, T_STRING, "bar")])
+    assert out[0] == b"foobar"
+    ch.close()
+
+
+def test_thrift_unknown_method_and_handler_error(kv_server):
+    ch = ThriftChannel(f"127.0.0.1:{kv_server.port}")
+    with pytest.raises(ThriftError):
+        ch.call("nope", [])
+    with pytest.raises(ThriftError) as ei:
+        ch.call("boom", [])
+    assert "kaboom" in str(ei.value)
+    ch.close()
+
+
+def test_thrift_pipelined_seqid_matching(kv_server):
+    ch = ThriftChannel(f"127.0.0.1:{kv_server.port}")
+    futs = [ch.acall("add", [TField(1, T_I32, i), TField(2, T_I32, i)])
+            for i in range(40)]
+    for i, f in enumerate(futs):
+        assert f.result(3)[0] == 2 * i
+    ch.close()
+
+
+def test_thrift_no_service_configured():
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        ch = ThriftChannel(f"127.0.0.1:{s.port}")
+        with pytest.raises(ThriftError):
+            ch.call("anything", [])
+        ch.close()
+    finally:
+        s.stop()
+        s.join()
