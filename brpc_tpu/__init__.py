@@ -14,14 +14,17 @@ __version__ = "0.1.0"
 from brpc_tpu import errors  # noqa: F401
 from brpc_tpu.errors import RpcError  # noqa: F401
 from brpc_tpu.rpc import (  # noqa: F401
-    CallManager, CallMapper, Channel, ChannelOptions, Controller,
+    Authenticator, CallManager, CallMapper, Channel, ChannelOptions,
+    Controller, DynamicPartitionChannel, GrpcChannel, HmacAuthenticator,
     MethodStatus, ParallelChannel, PartitionChannel, PartitionParser,
     DataFactory, HttpChannel, HttpResponse, HttpStreamReader,
-    MemoryRedisService, ProgressiveAttachment,
+    MemcacheChannel, MemcacheError, MemcacheService, MemoryMemcacheService,
+    MemoryRedisService, MongoClient, MongoService, ProgressiveAttachment,
     ProgressiveResponse, RedisChannel, RedisError, RedisPipeline,
     RedisService, ResponseMerger, RetryPolicy, SelectiveChannel, Server,
     ServerOptions, Service, SimpleDataPool, SocketMap, Stream,
-    StreamHandler, SubCall, SumMerger, method, stream_accept,
+    StreamHandler, SubCall, SumMerger, TField, ThriftChannel, ThriftError,
+    ThriftService, TokenAuthenticator, method, stream_accept,
     stream_create,
 )
 from brpc_tpu.rpc.service import MethodSpec  # noqa: F401

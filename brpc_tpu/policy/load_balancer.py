@@ -265,3 +265,31 @@ def create_load_balancer(name: str) -> LoadBalancer:
 
 def register_load_balancer(name: str, cls) -> None:
     _LBS[name] = cls
+
+
+class ExcludedServers:
+    """Bounded record of servers already tried during one RPC's retries;
+    retry selection skips them so a second attempt lands on a different
+    replica (reference excluded_servers.h — pooled, capacity-bounded).
+    Channel retries build one per call from Controller state; this named
+    surface exists for users implementing custom RetryPolicy/LBs."""
+
+    def __init__(self, capacity: int = 8):
+        self._capacity = capacity
+        self._eps: list = []
+
+    def add(self, endpoint) -> None:
+        if len(self._eps) < self._capacity:
+            self._eps.append(endpoint)
+
+    def is_excluded(self, endpoint) -> bool:
+        return endpoint in self._eps
+
+    def as_set(self) -> set:
+        return set(self._eps)
+
+    def __len__(self) -> int:
+        return len(self._eps)
+
+    def __contains__(self, endpoint) -> bool:
+        return endpoint in self._eps

@@ -35,7 +35,9 @@ class NamingService:
 
 
 class ListNamingService(NamingService):
-    """list://host:port[(weight)],host:port — static membership."""
+    """list://host:port[(weight)][ tag],... — static membership; the
+    optional space-separated tag carries partition labels like "0/4"
+    (reference list_naming_service.cpp tag support for PartitionChannel)."""
 
     interval_s = 0  # never re-resolves
 
@@ -45,11 +47,15 @@ class ListNamingService(NamingService):
             part = part.strip()
             if not part:
                 continue
+            tag = ""
+            if " " in part:
+                part, _, tag = part.partition(" ")
+                tag = tag.strip()
             weight = 1
             if part.endswith(")") and "(" in part:
                 part, _, w = part[:-1].rpartition("(")
                 weight = int(w)
-            nodes.append(ServerNode(str2endpoint(part), weight))
+            nodes.append(ServerNode(str2endpoint(part), weight, tag))
         return nodes
 
 

@@ -8,9 +8,22 @@ from brpc_tpu.rpc.stream import (  # noqa: F401
     Stream, StreamHandler, stream_create, stream_accept,
 )
 from brpc_tpu.rpc.combo_channels import (  # noqa: F401
-    CallMapper, ParallelChannel, PartitionChannel, PartitionParser,
-    ResponseMerger, SelectiveChannel, SubCall, SumMerger,
+    CallMapper, DynamicPartitionChannel, ParallelChannel, PartitionChannel,
+    PartitionParser, ResponseMerger, SelectiveChannel, SubCall, SumMerger,
 )
+from brpc_tpu.rpc.auth import (  # noqa: F401
+    Authenticator, HmacAuthenticator, TokenAuthenticator,
+)
+from brpc_tpu.rpc.memcache import (  # noqa: F401
+    MemcacheChannel, MemcacheError, MemcacheService, MemoryMemcacheService,
+)
+from brpc_tpu.rpc.thrift import (  # noqa: F401
+    TField, ThriftChannel, ThriftError, ThriftService,
+)
+from brpc_tpu.rpc.mongo import (  # noqa: F401
+    MongoClient, MongoService,
+)
+from brpc_tpu.rpc.h2 import GrpcChannel  # noqa: F401
 from brpc_tpu.rpc.data_pool import (  # noqa: F401
     DataFactory, SimpleDataPool,
 )

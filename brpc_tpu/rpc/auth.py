@@ -1,0 +1,99 @@
+"""Authentication — credential generation/verification on the first (here:
+every) message of a connection.
+
+Reference: authenticator.h (Authenticator::GenerateCredential/
+VerifyCredential; per-protocol first-message piggyback, SURVEY.md §2.5).
+Our native frame meta carries the credential on every request (meta.auth),
+so verification is per-request rather than per-connection — strictly
+stronger, and it survives connection pooling/multiplexing.
+
+Plug into ChannelOptions.auth (client: generate) and ServerOptions.auth
+(server: verify).  gRPC traffic carries the credential in the standard
+``authorization`` metadata header (server.invoke_grpc).
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import threading
+import time
+from typing import Optional
+
+
+class Authenticator:
+    """Duck-typed interface used by Channel/Server."""
+
+    def generate_credential(self) -> bytes:
+        raise NotImplementedError
+
+    def verify_credential(self, credential: bytes) -> bool:
+        raise NotImplementedError
+
+
+class TokenAuthenticator(Authenticator):
+    """Shared static token (the simplest useful policy)."""
+
+    def __init__(self, token: str | bytes):
+        self._token = token.encode() if isinstance(token, str) else token
+
+    def generate_credential(self) -> bytes:
+        return self._token
+
+    def verify_credential(self, credential: bytes) -> bool:
+        if isinstance(credential, str):
+            credential = credential.encode()
+        return hmac.compare_digest(credential or b"", self._token)
+
+
+class HmacAuthenticator(Authenticator):
+    """Replay-resistant HMAC over a timestamp+nonce: credential =
+    ``ts.nonce.hex(HMAC_SHA256(key, ts.nonce))``.  Verification enforces a
+    clock-skew window AND rejects nonces already seen inside it, so a
+    captured credential cannot be replayed (seen-nonce set is pruned as
+    timestamps age out; memory is bounded by the genuine request rate).
+
+    NOTE: a client must generate a FRESH credential per connection/request
+    (ChannelOptions.auth does — generate_credential is called per call).
+    Reusing one credential object across calls would self-trip the replay
+    check."""
+
+    def __init__(self, key: str | bytes, max_skew_s: float = 300.0,
+                 track_nonces: bool = True):
+        self._key = key.encode() if isinstance(key, str) else key
+        self._max_skew_s = max_skew_s
+        self._track = track_nonces
+        self._seen: dict[bytes, float] = {}   # nonce -> expiry
+        self._seen_lock = threading.Lock()
+
+    def _sign(self, ts: bytes, nonce: bytes) -> str:
+        return hmac.new(self._key, ts + b"." + nonce,
+                        hashlib.sha256).hexdigest()
+
+    def generate_credential(self) -> bytes:
+        ts = str(int(time.time())).encode()
+        nonce = os.urandom(8).hex().encode()
+        return ts + b"." + nonce + b"." + self._sign(ts, nonce).encode()
+
+    def verify_credential(self, credential: bytes) -> bool:
+        if isinstance(credential, str):
+            credential = credential.encode()
+        try:
+            ts, nonce, mac = credential.split(b".", 2)
+            now = time.time()
+            if abs(now - int(ts)) > self._max_skew_s:
+                return False
+            if not hmac.compare_digest(mac.decode(), self._sign(ts, nonce)):
+                return False
+            if self._track:
+                with self._seen_lock:
+                    exp = self._seen.get(nonce)
+                    if exp is not None and exp > now:
+                        return False  # replay inside the window
+                    self._seen[nonce] = now + self._max_skew_s
+                    if len(self._seen) > 65536:
+                        self._seen = {n: e for n, e in self._seen.items()
+                                      if e > now}
+            return True
+        except (ValueError, UnicodeDecodeError):
+            return False

@@ -396,6 +396,9 @@ class Channel:
 
     def _select_server(self, st: _CallState) -> Optional[EndPoint]:
         if self._lb is not None:
+            # exact exclusion of every tried server (the ExcludedServers
+            # role, excluded_servers.h; a plain set — no capacity bound —
+            # so high-retry calls never revisit a failed replica)
             return self._lb.select_server(exclude=set(st.tried_servers))
         return self._endpoint
 
@@ -531,6 +534,11 @@ class Channel:
             return
         meta = st.meta_template
         meta.attempt = cntl.current_attempt
+        if self.options.auth is not None:
+            # fresh credential per attempt: replay-tracking authenticators
+            # (HmacAuthenticator) reject a reused nonce, so retries and
+            # backup requests must not resend the first attempt's
+            meta.auth = self.options.auth.generate_credential()
         mgr.bind_socket(cntl.correlation_id, conn.sid)
         stream = getattr(cntl, "_stream", None)
         if stream is not None and not stream.connected:

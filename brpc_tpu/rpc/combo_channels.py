@@ -375,3 +375,139 @@ class PartitionChannel:
     @property
     def channel_count(self):
         return self._parallel.channel_count
+
+
+class DynamicPartitionChannel:
+    """Mixes multiple partition schemes living in ONE naming service,
+    weighting traffic by each scheme's capacity (reference
+    DynamicPartitionChannel, partition_channel.h:120-168): servers tagged
+    "0/4".."3/4" and "0/8".."7/8" coexist, and calls pick a scheme with
+    probability proportional to its server count, so capacity can migrate
+    between schemes by re-tagging servers — no client restart.
+
+    This object IS the naming-service sink (reset_servers), so membership
+    changes re-group schemes live, the way the reference's sub-channels
+    subscribe to one NamingServiceThread."""
+
+    def __init__(self, call_mapper: CallMapper | None = None,
+                 response_merger: ResponseMerger | None = None,
+                 fail_limit: int = 0,
+                 parser: PartitionParser | None = None,
+                 options: ChannelOptions | None = None):
+        self.call_mapper = call_mapper
+        self.response_merger = response_merger
+        self.fail_limit = fail_limit
+        self._parser = parser or PartitionParser()
+        self._options = options or ChannelOptions()
+        self._mu = threading.Lock()
+        # scheme (partition_count) -> [servers per partition index]
+        self._schemes: dict[int, list[list]] = {}
+        self._channels: dict = {}      # endpoint -> single-server Channel
+        self._rr = 0
+        self._ns_thread = None
+
+    # ---- naming-service sink (NamingServiceActions analog) ----
+
+    def reset_servers(self, nodes) -> None:
+        schemes: dict[int, list[list]] = {}
+        for n in nodes:
+            p = self._parser.parse(n.tag)
+            if p is None:
+                continue
+            idx, cnt = p
+            if cnt <= 0 or not (0 <= idx < cnt):
+                continue
+            parts = schemes.setdefault(cnt, [[] for _ in range(cnt)])
+            parts[idx].append(n.endpoint)
+        # only schemes with every partition populated are callable
+        live = {n.endpoint for n in nodes}
+        with self._mu:
+            self._schemes = {cnt: parts for cnt, parts in schemes.items()
+                             if all(parts)}
+            # evict channels for departed servers so elastic membership
+            # (dns/file naming churn) doesn't leak connections
+            for ep in [ep for ep in self._channels if ep not in live]:
+                del self._channels[ep]
+
+    def init(self, naming_url: str,
+             options: ChannelOptions | None = None
+             ) -> "DynamicPartitionChannel":
+        if options is not None:
+            self._options = options
+        from brpc_tpu.policy.naming import start_naming_service
+        self._ns_thread = start_naming_service(naming_url, self)
+        self._ns_thread.wait_first_resolution()
+        return self
+
+    def stop(self) -> None:
+        if self._ns_thread is not None:
+            self._ns_thread.stop()
+
+    @property
+    def scheme_counts(self) -> dict[int, int]:
+        with self._mu:
+            return {cnt: sum(len(p) for p in parts)
+                    for cnt, parts in self._schemes.items()}
+
+    def _channel_for(self, endpoint) -> Channel:
+        ch = self._channels.get(endpoint)
+        if ch is None:
+            ch = Channel(str(endpoint), options=self._options)
+            self._channels[endpoint] = ch
+        return ch
+
+    def _pick_scheme(self):
+        """Weight by scheme capacity = number of servers carrying its tags
+        (the dynpart weighting, policy/dynpart_load_balancer.cpp)."""
+        import random
+        with self._mu:
+            if not self._schemes:
+                return None, None
+            weights = [(cnt, sum(len(p) for p in parts))
+                       for cnt, parts in self._schemes.items()]
+            total = sum(w for _, w in weights)
+            r = random.uniform(0, total)
+            acc = 0.0
+            for cnt, w in weights:
+                acc += w
+                if r <= acc:
+                    break
+            parts = self._schemes[cnt]
+            self._rr += 1
+            chosen = [p[self._rr % len(p)] for p in parts]
+            return cnt, [self._channel_for(ep) for ep in chosen]
+
+    def call(self, service: str, method: str, request: Any = b"",
+             cntl: Controller | None = None, serializer: str = "raw",
+             done: Callable[[Controller], None] | None = None) -> Controller:
+        cnt, chans = self._pick_scheme()
+        if chans is None:
+            cntl = cntl or Controller()
+            cntl.set_failed(errors.ENODATA,
+                            "no complete partition scheme resolved")
+            if done:
+                done(cntl)
+            else:
+                cntl._done_event = threading.Event()
+                cntl._done_event.set()
+            return cntl
+        pc = ParallelChannel(self.fail_limit, self.call_mapper,
+                             self.response_merger)
+        for ch in chans:
+            pc.add_channel(ch)
+        return pc.call(service, method, request, cntl=cntl,
+                       serializer=serializer, done=done)
+
+    def call_sync(self, service: str, method: str, request: Any = b"",
+                  serializer: str = "raw", timeout_s: float = 10.0, **kw):
+        cntl = kw.pop("cntl", None) or Controller()
+        if cntl.timeout_ms is None:
+            # join() only bounds its wait when the controller carries a
+            # deadline — without this the timeout_s parameter would be a
+            # silent no-op
+            cntl.timeout_ms = int(timeout_s * 1000)
+        cntl = self.call(service, method, request, cntl=cntl,
+                         serializer=serializer, **kw)
+        cntl.join()
+        cntl.raise_if_failed()
+        return cntl.response

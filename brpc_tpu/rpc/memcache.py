@@ -179,8 +179,13 @@ class MemcacheChannel:
             self._pending.append((fut, opcode))
         if Transport.instance().write_raw(sid, pkt) != 0:
             with self._mu:
-                if self._pending and self._pending[-1][0] is fut:
-                    self._pending.pop()
+                # remove by identity — a concurrent append may sit behind
+                # us, and leaving our entry would shift FIFO matching by
+                # one for every later caller
+                try:
+                    self._pending.remove((fut, opcode))
+                except ValueError:
+                    pass
             fut.set_exception(errors.RpcError(errors.EFAILEDSOCKET,
                                               "memcache write failed"))
         return fut

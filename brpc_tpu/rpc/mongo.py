@@ -1,0 +1,306 @@
+"""Mongo wire protocol — server-side adaptor (OP_MSG / OP_QUERY), minimal
+BSON codec, and a small client for loopback tests.
+
+Reference: policy/mongo_protocol.cpp:298 (server-side OP_QUERY handling),
+mongo_head.h (16-byte LE header {messageLength, requestID, responseTo,
+opCode}), mongo_service_adaptor.h.  The native core frames one complete
+mongo message per MSG_MONGO (src/cc/net/parser.cc:parse_mongo, whole
+message incl. header in body).
+
+BSON support covers the types a command router needs: double, string,
+embedded document, array, binary, bool, null, int32, int64.  This is a
+clean-room subset of the BSON spec — no external bson dependency.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Optional
+
+from brpc_tpu import errors
+from brpc_tpu.rpc.transport import MSG_MONGO, Transport
+
+OP_REPLY = 1
+OP_QUERY = 2004
+OP_MSG = 2013
+
+HEADER = struct.Struct("<iiii")  # messageLength, requestID, responseTo, opCode
+
+
+# ---- BSON ------------------------------------------------------------------
+
+def bson_encode(doc: dict) -> bytes:
+    out = bytearray(4)
+    for k, v in doc.items():
+        key = k.encode() if isinstance(k, str) else bytes(k)
+        if isinstance(v, bool):           # before int: bool is an int subtype
+            out += b"\x08" + key + b"\x00" + (b"\x01" if v else b"\x00")
+        elif isinstance(v, float):
+            out += b"\x01" + key + b"\x00" + struct.pack("<d", v)
+        elif isinstance(v, int):
+            if -(1 << 31) <= v < (1 << 31):
+                out += b"\x10" + key + b"\x00" + struct.pack("<i", v)
+            else:
+                out += b"\x12" + key + b"\x00" + struct.pack("<q", v)
+        elif isinstance(v, str):
+            raw = v.encode()
+            out += b"\x02" + key + b"\x00" + \
+                struct.pack("<i", len(raw) + 1) + raw + b"\x00"
+        elif isinstance(v, (bytes, bytearray)):
+            out += b"\x05" + key + b"\x00" + \
+                struct.pack("<i", len(v)) + b"\x00" + bytes(v)
+        elif isinstance(v, dict):
+            out += b"\x03" + key + b"\x00" + bson_encode(v)
+        elif isinstance(v, (list, tuple)):
+            out += b"\x04" + key + b"\x00" + \
+                bson_encode({str(i): e for i, e in enumerate(v)})
+        elif v is None:
+            out += b"\x0a" + key + b"\x00"
+        else:
+            raise TypeError(f"cannot BSON-encode {type(v)!r}")
+    out += b"\x00"
+    struct.pack_into("<i", out, 0, len(out))
+    return bytes(out)
+
+
+def _bson_cstring(data: bytes, pos: int) -> tuple[str, int]:
+    end = data.index(b"\x00", pos)
+    return data[pos:end].decode("utf-8", "replace"), end + 1
+
+
+def bson_decode(data: bytes, pos: int = 0) -> tuple[dict, int]:
+    """Returns (doc, next_pos)."""
+    if pos + 4 > len(data):
+        raise ValueError("truncated bson")
+    size = struct.unpack_from("<i", data, pos)[0]
+    if size < 5 or pos + size > len(data):
+        raise ValueError("bad bson size")
+    end = pos + size
+    p = pos + 4
+    doc: dict = {}
+    while p < end - 1:
+        etype = data[p]
+        p += 1
+        key, p = _bson_cstring(data, p)
+        if etype == 0x01:
+            doc[key] = struct.unpack_from("<d", data, p)[0]
+            p += 8
+        elif etype == 0x02:
+            n = struct.unpack_from("<i", data, p)[0]
+            doc[key] = data[p + 4:p + 4 + n - 1].decode("utf-8", "replace")
+            p += 4 + n
+        elif etype == 0x03:
+            doc[key], p = bson_decode(data, p)
+        elif etype == 0x04:
+            sub, p = bson_decode(data, p)
+            doc[key] = [sub[k] for k in sorted(sub, key=int)]
+        elif etype == 0x05:
+            n = struct.unpack_from("<i", data, p)[0]
+            doc[key] = data[p + 5:p + 5 + n]
+            p += 5 + n
+        elif etype == 0x08:
+            doc[key] = bool(data[p])
+            p += 1
+        elif etype == 0x09:  # UTC datetime as int64 millis
+            doc[key] = struct.unpack_from("<q", data, p)[0]
+            p += 8
+        elif etype == 0x0A:
+            doc[key] = None
+        elif etype == 0x10:
+            doc[key] = struct.unpack_from("<i", data, p)[0]
+            p += 4
+        elif etype == 0x12:
+            doc[key] = struct.unpack_from("<q", data, p)[0]
+            p += 8
+        else:
+            raise ValueError(f"unsupported bson type 0x{etype:02x}")
+    if data[end - 1] != 0:
+        raise ValueError("bson doc missing terminator")
+    return doc, end
+
+
+# ---- wire messages ---------------------------------------------------------
+
+def build_op_msg(doc: dict, request_id: int, response_to: int = 0) -> bytes:
+    body = struct.pack("<I", 0) + b"\x00" + bson_encode(doc)
+    return HEADER.pack(16 + len(body), request_id, response_to, OP_MSG) + body
+
+
+def build_op_reply(docs: list[dict], request_id: int,
+                   response_to: int) -> bytes:
+    body = struct.pack("<iqii", 0, 0, 0, len(docs)) + \
+        b"".join(bson_encode(d) for d in docs)
+    return HEADER.pack(16 + len(body), request_id, response_to,
+                       OP_REPLY) + body
+
+
+class MongoService:
+    """Server-side command router (the mongo_service_adaptor.h slot).
+    Commands dispatch on the FIRST key of the command document (OP_MSG
+    semantics; OP_QUERY against <db>.$cmd routes the same way).
+
+        svc = MongoService()
+
+        @svc.command("ping")
+        def ping(doc):
+            return {"ok": 1}
+
+    Wired via ServerOptions.mongo_service."""
+
+    def __init__(self):
+        self._commands: dict[str, Callable] = {}
+        self._reply_id = 0
+        self._mu = threading.Lock()
+        for name in ("ping", "ismaster", "hello", "buildinfo"):
+            self._commands[name] = self._default_ok
+
+    def _default_ok(self, doc: dict) -> dict:
+        return {"ok": 1, "ismaster": True, "maxWireVersion": 6,
+                "minWireVersion": 0}
+
+    def command(self, name: str):
+        def deco(fn):
+            self._commands[name.lower()] = fn
+            return fn
+        return deco
+
+    def add_handler(self, name: str, fn: Callable) -> None:
+        self._commands[name.lower()] = fn
+
+    def _next_id(self) -> int:
+        with self._mu:
+            self._reply_id += 1
+            return self._reply_id
+
+    def _run(self, doc: dict) -> dict:
+        if not doc:
+            return {"ok": 0, "errmsg": "empty command", "code": 59}
+        cmd = next(iter(doc)).lower()
+        fn = self._commands.get(cmd)
+        if fn is None:
+            return {"ok": 0, "errmsg": f"no such command: '{cmd}'",
+                    "code": 59}
+        try:
+            out = fn(doc)
+            if "ok" not in out:
+                out["ok"] = 1
+            return out
+        except Exception as e:
+            return {"ok": 0, "errmsg": f"{type(e).__name__}: {e}",
+                    "code": 8}
+
+    def handle_bytes(self, raw: bytes) -> bytes:
+        if len(raw) < 16:
+            return b""
+        _, request_id, _, opcode = HEADER.unpack_from(raw)
+        try:
+            if opcode == OP_MSG:
+                # flagBits u32 + section kind 0 doc (kind-1 sequences are
+                # rejected like an unsupported command)
+                kind = raw[20]
+                if kind != 0:
+                    out = {"ok": 0, "errmsg": "unsupported section kind",
+                           "code": 59}
+                else:
+                    doc, _ = bson_decode(raw, 21)
+                    out = self._run(doc)
+                return build_op_msg(out, self._next_id(), request_id)
+            if opcode == OP_QUERY:
+                pos = 16 + 4  # header + flags
+                coll, pos = _bson_cstring(raw, pos)
+                pos += 8  # numberToSkip + numberToReturn
+                doc, _ = bson_decode(raw, pos)
+                out = self._run(doc)
+                return build_op_reply([out], self._next_id(), request_id)
+        except (ValueError, IndexError, struct.error) as e:
+            # truncated headers raise IndexError, truncated BSON elements
+            # raise struct.error — all must yield the error reply, not a
+            # swallowed exception and a silently hung client
+            err = {"ok": 0, "errmsg": f"bad message: {e}", "code": 22}
+            return build_op_msg(err, self._next_id(), request_id)
+        return b""  # unknown opcode: drop (connection stays up)
+
+
+class MongoClient:
+    """Minimal OP_MSG command client for loopback tests/demos (the
+    reference has no mongo client; this exists so the adaptor is testable
+    in-process, SURVEY.md §4 pattern)."""
+
+    def __init__(self, address: str, timeout_ms: int = 2000):
+        host, _, port = address.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.timeout_ms = timeout_ms
+        self._mu = threading.Lock()
+        self._sid: Optional[int] = None
+        self._req = 0
+        self._pending: dict[int, Future] = {}
+
+    def _ensure_connected(self) -> int:
+        with self._mu:
+            t = Transport.instance()
+            if self._sid is not None and t.alive(self._sid):
+                return self._sid
+            self._fail_pending_locked()
+            self._sid = t.connect(self.host, self.port, self._on_message,
+                                  self._on_failed)
+            t.set_protocol(self._sid, MSG_MONGO)
+            return self._sid
+
+    def _fail_pending_locked(self) -> None:
+        pend, self._pending = self._pending, {}
+        for fut in pend.values():
+            if not fut.done():
+                fut.set_exception(errors.RpcError(errors.EFAILEDSOCKET,
+                                                  "mongo conn lost"))
+
+    def _on_failed(self, sid: int, err: int) -> None:
+        with self._mu:
+            if sid == self._sid:
+                self._sid = None
+            self._fail_pending_locked()
+
+    def _on_message(self, sid: int, kind: int, meta: bytes, body) -> None:
+        raw = body.to_bytes()
+        if len(raw) < 16:
+            return
+        _, _, response_to, opcode = HEADER.unpack_from(raw)
+        try:
+            if opcode == OP_MSG:
+                doc, _ = bson_decode(raw, 21)
+            elif opcode == OP_REPLY:
+                doc, _ = bson_decode(raw, 16 + 20)
+            else:
+                return
+        except ValueError:
+            return
+        with self._mu:
+            fut = self._pending.pop(response_to, None)
+        if fut is not None and not fut.done():
+            fut.set_result(doc)
+
+    def command(self, doc: dict, timeout_ms: Optional[int] = None) -> dict:
+        sid = self._ensure_connected()
+        fut: Future = Future()
+        with self._mu:
+            self._req += 1
+            rid = self._req
+            self._pending[rid] = fut
+        if Transport.instance().write_raw(sid, build_op_msg(doc, rid)) != 0:
+            with self._mu:
+                self._pending.pop(rid, None)
+            raise errors.RpcError(errors.EFAILEDSOCKET, "mongo write failed")
+        try:
+            return fut.result((timeout_ms or self.timeout_ms) / 1e3)
+        except TimeoutError:
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  "mongo command timed out")
+
+    def ping(self) -> bool:
+        return self.command({"ping": 1}).get("ok") == 1
+
+    def close(self) -> None:
+        with self._mu:
+            sid, self._sid = self._sid, None
+        if sid is not None:
+            Transport.instance().close(sid)

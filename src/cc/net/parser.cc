@@ -424,6 +424,24 @@ ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) 
         return parse_nshead(in, out);
       }
     }
+    // Mongo before the single-byte detectors: its 16-byte header check
+    // (known LE opcode at offset 12 + plausible length) is a far stronger
+    // signal than redis'/memcache's first-byte match, and a mongo
+    // messageLength whose low byte is 0x24 ('$'), 0x2A ('*'), 0x80 … would
+    // otherwise be latched as redis/memcache.  With fewer than 16 bytes
+    // buffered the weak detectors still win — the reference's inherent
+    // try-order ambiguity (input_messenger.cpp:144-160).
+    if (got >= 16) {
+      const uint32_t mongo_op = load_le32(hdr + 12);
+      if (mongo_known_opcode(mongo_op) && load_le32(hdr) >= 16) {
+        if (in->size() < 28) {
+          const uint32_t mg_total = load_le32(hdr);  // includes header
+          if (in->size() < mg_total) return PARSE_NEED_MORE;
+        }
+        st->detected = MSG_MONGO;
+        return parse_mongo(in, out);
+      }
+    }
     if (looks_like_redis(hdr[0])) {
       st->detected = MSG_REDIS;
       return parse_redis(in, out);
@@ -463,17 +481,6 @@ ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) 
       }
       st->detected = MSG_THRIFT;
       return parse_thrift(in, out);
-    }
-    if (got >= 16) {
-      const uint32_t op = load_le32(hdr + 12);
-      if (mongo_known_opcode(op) && load_le32(hdr) >= 16) {
-        if (in->size() < 28) {
-          const uint32_t mg_total = load_le32(hdr);  // includes header
-          if (in->size() < mg_total) return PARSE_NEED_MORE;
-        }
-        st->detected = MSG_MONGO;
-        return parse_mongo(in, out);
-      }
     }
     // Fewer than 28 bytes can't yet rule out the longer-magic framings
     // (thrift @6, mongo @16, nshead @28) — same contract as the

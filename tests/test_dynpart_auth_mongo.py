@@ -1,0 +1,230 @@
+"""DynamicPartitionChannel, ExcludedServers, Authenticator, mongo adaptor
+(reference partition_channel.h:120-168, excluded_servers.h,
+authenticator.h, policy/mongo_protocol.cpp)."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.policy.load_balancer import ExcludedServers
+from brpc_tpu.rpc.mongo import bson_decode, bson_encode
+
+
+# ---- BSON codec ------------------------------------------------------------
+
+def test_bson_roundtrip():
+    doc = {"str": "héllo", "i32": 42, "i64": 1 << 40, "f": 3.5,
+           "b": True, "none": None, "bin": b"\x00\x01\x02",
+           "sub": {"x": 1}, "arr": [1, "two", {"three": 3}]}
+    enc = bson_encode(doc)
+    out, end = bson_decode(enc)
+    assert end == len(enc)
+    assert out == doc
+
+
+def test_bson_rejects_garbage():
+    with pytest.raises(ValueError):
+        bson_decode(b"\x03\x00\x00")
+    with pytest.raises(ValueError):
+        bson_decode(b"\xff\xff\xff\xff" + b"x" * 10)
+
+
+# ---- ExcludedServers -------------------------------------------------------
+
+def test_excluded_servers_bounded():
+    ex = ExcludedServers(capacity=3)
+    for i in range(10):
+        ex.add(("10.0.0.%d" % i, 80))
+    assert len(ex) == 3
+    assert ("10.0.0.0", 80) in ex
+    assert ex.is_excluded(("10.0.0.2", 80))
+    assert not ex.is_excluded(("10.0.0.9", 80))
+    assert ex.as_set() == {("10.0.0.0", 80), ("10.0.0.1", 80),
+                           ("10.0.0.2", 80)}
+
+
+# ---- Authenticator ---------------------------------------------------------
+
+def test_token_authenticator_roundtrip():
+    a = brpc.TokenAuthenticator("s3cret")
+    assert a.verify_credential(a.generate_credential())
+    assert not a.verify_credential(b"wrong")
+    assert not a.verify_credential(b"")
+
+
+def test_hmac_authenticator():
+    a = brpc.HmacAuthenticator("key1")
+    cred = a.generate_credential()
+    assert a.verify_credential(cred)
+    assert not brpc.HmacAuthenticator("key2").verify_credential(cred)
+    assert not a.verify_credential(b"junk")
+    stale = brpc.HmacAuthenticator("key1", max_skew_s=0.0)
+    time.sleep(1.1)
+    assert not stale.verify_credential(cred)
+
+
+def test_auth_end_to_end():
+    auth = brpc.TokenAuthenticator("tok")
+
+    class S(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return req
+
+    s = brpc.Server(brpc.ServerOptions(auth=auth))
+    s.add_service(S())
+    s.start("127.0.0.1", 0)
+    try:
+        good = brpc.Channel(f"127.0.0.1:{s.port}",
+                            options=brpc.ChannelOptions(auth=auth))
+        assert good.call_sync("S", "Echo", b"x") == b"x"
+        bad = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=2000,
+                           max_retry=0)
+        with pytest.raises(errors.RpcError) as ei:
+            bad.call_sync("S", "Echo", b"x")
+        assert ei.value.code == errors.ERPCAUTH
+        # gRPC path: credential rides the authorization metadata header
+        g = brpc.GrpcChannel(f"127.0.0.1:{s.port}")
+        assert g.call("S", "Echo", b"y",
+                      metadata=[("authorization", "tok")]) == b"y"
+        with pytest.raises(errors.RpcError):
+            g.call("S", "Echo", b"y")
+        g.close()
+    finally:
+        s.stop()
+        s.join()
+
+
+# ---- mongo adaptor ---------------------------------------------------------
+
+def test_mongo_loopback():
+    svc = brpc.MongoService()
+    store = {}
+
+    @svc.command("insert")
+    def insert(doc):
+        coll = doc["insert"]
+        store.setdefault(coll, []).extend(doc.get("documents", []))
+        return {"n": len(doc.get("documents", []))}
+
+    @svc.command("find")
+    def find(doc):
+        docs = store.get(doc["find"], [])
+        return {"cursor": {"id": 0, "firstBatch": docs}}
+
+    s = brpc.Server(brpc.ServerOptions(mongo_service=svc))
+    s.start("127.0.0.1", 0)
+    try:
+        c = brpc.MongoClient(f"127.0.0.1:{s.port}")
+        assert c.ping()
+        assert c.command({"ismaster": 1})["ok"] == 1
+        r = c.command({"insert": "things",
+                       "documents": [{"a": 1}, {"a": 2}]})
+        assert r["ok"] == 1 and r["n"] == 2
+        r = c.command({"find": "things"})
+        assert [d["a"] for d in r["cursor"]["firstBatch"]] == [1, 2]
+        r = c.command({"bogus": 1})
+        assert r["ok"] == 0 and "no such command" in r["errmsg"]
+        c.close()
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_mongo_no_service_closes_connection():
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        c = brpc.MongoClient(f"127.0.0.1:{s.port}", timeout_ms=3000)
+        with pytest.raises(errors.RpcError):
+            c.command({"ping": 1})
+        c.close()
+    finally:
+        s.stop()
+        s.join()
+
+
+# ---- DynamicPartitionChannel ----------------------------------------------
+
+def test_dynamic_partition_channel():
+    """Two schemes (2-way and 4-way) behind one list naming service; calls
+    fan out over exactly one scheme's partitions and capacity shifts when
+    membership changes."""
+    class Part(brpc.Service):
+        NAME = "Part"
+
+        def __init__(self, label):
+            self.label = label
+
+        @brpc.method(request="raw", response="raw")
+        def Which(self, cntl, req):
+            return self.label.encode()
+
+    servers = []
+    nodes = []
+    # 2-way scheme: partitions 0/2, 1/2 ; 4-way scheme: 0/4..3/4
+    for scheme, cnt in (("two", 2), ("four", 4)):
+        for idx in range(cnt):
+            srv = brpc.Server()
+            srv.add_service(Part(f"{scheme}:{idx}"))
+            srv.start("127.0.0.1", 0)
+            servers.append(srv)
+            nodes.append(f"127.0.0.1:{srv.port} {idx}/{cnt}")
+
+    class Concat(brpc.ResponseMerger):
+        def merge(self, results):
+            return b",".join(sorted(results))
+
+    dyn = brpc.DynamicPartitionChannel(response_merger=Concat())
+    dyn.init("list://" + ",".join(nodes))
+    try:
+        assert dyn.scheme_counts == {2: 2, 4: 4}
+        seen = set()
+        for _ in range(40):
+            out = dyn.call_sync("Part", "Which", b"")
+            labels = out.decode().split(",")
+            # all sub-responses come from ONE scheme, covering every
+            # partition exactly once
+            schemes = {l.split(":")[0] for l in labels}
+            assert len(schemes) == 1, labels
+            sch = schemes.pop()
+            assert len(labels) == (2 if sch == "two" else 4)
+            seen.add(sch)
+        # capacity weighting 2 vs 4 → both schemes picked within 40 draws
+        assert seen == {"two", "four"}
+    finally:
+        dyn.stop()
+        for srv in servers:
+            srv.stop()
+            srv.join()
+
+
+def test_hmac_replay_rejected_but_retries_work():
+    a = brpc.HmacAuthenticator("k")
+    cred = a.generate_credential()
+    assert a.verify_credential(cred)
+    assert not a.verify_credential(cred)   # replay inside window
+
+    class S(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return req
+
+    server_auth = brpc.HmacAuthenticator("rkey")
+    s = brpc.Server(brpc.ServerOptions(auth=server_auth))
+    s.add_service(S())
+    s.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(
+            f"127.0.0.1:{s.port}",
+            options=brpc.ChannelOptions(
+                auth=brpc.HmacAuthenticator("rkey"), max_retry=3))
+        # several sequential calls: each attempt generates a fresh nonce,
+        # so none is a replay
+        for i in range(5):
+            assert ch.call_sync("S", "Echo", b"%d" % i) == b"%d" % i
+    finally:
+        s.stop()
+        s.join()
