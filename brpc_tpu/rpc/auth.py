@@ -64,6 +64,12 @@ class HmacAuthenticator(Authenticator):
         self._max_skew_s = max_skew_s
         self._track = track_nonces
         self._seen: dict[bytes, float] = {}   # nonce -> expiry
+        # expiry-ordered FIFO alongside the dict: nonces are appended with
+        # monotonically increasing expiries, so pruning pops from the left
+        # until the head is unexpired — amortized O(1) per verify, never a
+        # full-dict rebuild on the hot path
+        from collections import deque
+        self._seen_order: "deque[tuple[float, bytes]]" = deque()
         self._seen_lock = threading.Lock()
 
     def _sign(self, ts: bytes, nonce: bytes) -> str:
@@ -87,13 +93,16 @@ class HmacAuthenticator(Authenticator):
                 return False
             if self._track:
                 with self._seen_lock:
+                    while self._seen_order and self._seen_order[0][0] <= now:
+                        _, old = self._seen_order.popleft()
+                        if self._seen.get(old, 0) <= now:
+                            self._seen.pop(old, None)
                     exp = self._seen.get(nonce)
                     if exp is not None and exp > now:
                         return False  # replay inside the window
-                    self._seen[nonce] = now + self._max_skew_s
-                    if len(self._seen) > 65536:
-                        self._seen = {n: e for n, e in self._seen.items()
-                                      if e > now}
+                    expiry = now + self._max_skew_s
+                    self._seen[nonce] = expiry
+                    self._seen_order.append((expiry, nonce))
             return True
         except (ValueError, UnicodeDecodeError):
             return False

@@ -461,8 +461,8 @@ class Channel:
         # response serializer hint rides as a user field
         if response_serializer:
             meta.user_fields["rs"] = response_serializer
-        if opts.auth is not None:
-            meta.auth = opts.auth.generate_credential()
+        # credential is generated per ATTEMPT in _issue (replay-tracking
+        # authenticators reject reused nonces), not here
         if cntl.request_attachment:
             meta.attachment_size = len(cntl.request_attachment)
             body = body + cntl.request_attachment

@@ -424,10 +424,15 @@ class DynamicPartitionChannel:
         with self._mu:
             self._schemes = {cnt: parts for cnt, parts in schemes.items()
                              if all(parts)}
-            # evict channels for departed servers so elastic membership
-            # (dns/file naming churn) doesn't leak connections
-            for ep in [ep for ep in self._channels if ep not in live]:
+            departed = [ep for ep in self._channels if ep not in live]
+            for ep in departed:
                 del self._channels[ep]
+        # evict departed servers' CONNECTIONS too (they're owned by the
+        # process-wide SocketMap, not the Channel wrapper) so elastic
+        # membership churn doesn't leak sockets
+        from brpc_tpu.rpc.channel import SocketMap
+        for ep in departed:
+            SocketMap.instance().drop(ep)
 
     def init(self, naming_url: str,
              options: ChannelOptions | None = None

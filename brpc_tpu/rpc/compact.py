@@ -1,0 +1,204 @@
+"""Compact self-describing binary codec — the mcpack2pb slot.
+
+Reference: mcpack2pb/ (4,414 LoC: Baidu's mcpack binary format bridged to
+protobuf with a protoc code generator).  The TPU build fills the same
+design slot — a schema-light compact binary encoding that round-trips to
+JSON-shaped values and plugs into the serializer registry (name
+"compact") — without replicating Baidu's exact wire format; there are no
+legacy mcpack peers to interoperate with.
+
+Wire grammar (all little-endian, varint = LEB128):
+  value   = type:u8 payload
+  types   0x00 null        0x01 false       0x02 true
+          0x03 int (zigzag varint)          0x04 float64
+          0x05 str (varint len + utf8)      0x06 bytes (varint len)
+          0x07 list (varint count + values)
+          0x08 dict (varint count + (str value)*)
+Bounded depth guards against stack-abuse payloads (fuzz surface).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+MAX_DEPTH = 64
+
+
+def _w_varint(out: bytearray, n: int) -> None:
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) ^ (n >> 63) if -(1 << 63) <= n < (1 << 63) else \
+        _raise(ValueError("int out of 64-bit range"))
+
+
+def _unzigzag(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def _raise(e):
+    raise e
+
+
+def _encode_into(out: bytearray, v: Any, depth: int) -> None:
+    if depth > MAX_DEPTH:
+        raise ValueError("nesting too deep")
+    if v is None:
+        out.append(0x00)
+    elif v is False:
+        out.append(0x01)
+    elif v is True:
+        out.append(0x02)
+    elif isinstance(v, int):
+        out.append(0x03)
+        _w_varint(out, _zigzag(v))
+    elif isinstance(v, float):
+        out.append(0x04)
+        out += struct.pack("<d", v)
+    elif isinstance(v, str):
+        raw = v.encode("utf-8")
+        out.append(0x05)
+        _w_varint(out, len(raw))
+        out += raw
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        out.append(0x06)
+        b = bytes(v)
+        _w_varint(out, len(b))
+        out += b
+    elif isinstance(v, (list, tuple)):
+        out.append(0x07)
+        _w_varint(out, len(v))
+        for e in v:
+            _encode_into(out, e, depth + 1)
+    elif isinstance(v, dict):
+        out.append(0x08)
+        _w_varint(out, len(v))
+        for k, e in v.items():
+            if not isinstance(k, str):
+                raise TypeError("compact dict keys must be str")
+            raw = k.encode("utf-8")
+            _w_varint(out, len(raw))
+            out += raw
+            _encode_into(out, e, depth + 1)
+    else:
+        raise TypeError(f"cannot compact-encode {type(v)!r}")
+
+
+def dumps(v: Any) -> bytes:
+    out = bytearray()
+    _encode_into(out, v, 0)
+    return bytes(out)
+
+
+class _Reader:
+    __slots__ = ("d", "p")
+
+    def __init__(self, data: bytes):
+        self.d = data
+        self.p = 0
+
+    def u8(self) -> int:
+        if self.p >= len(self.d):
+            raise ValueError("truncated")
+        b = self.d[self.p]
+        self.p += 1
+        return b
+
+    def varint(self) -> int:
+        n = 0
+        shift = 0
+        while True:
+            b = self.u8()
+            n |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return n
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint overflow")
+
+    def take(self, n: int) -> bytes:
+        if n < 0 or self.p + n > len(self.d):
+            raise ValueError("truncated")
+        v = self.d[self.p:self.p + n]
+        self.p += n
+        return v
+
+    def value(self, depth: int = 0) -> Any:
+        if depth > MAX_DEPTH:
+            raise ValueError("nesting too deep")
+        t = self.u8()
+        if t == 0x00:
+            return None
+        if t == 0x01:
+            return False
+        if t == 0x02:
+            return True
+        if t == 0x03:
+            return _unzigzag(self.varint())
+        if t == 0x04:
+            return struct.unpack("<d", self.take(8))[0]
+        if t == 0x05:
+            return self.take(self.varint()).decode("utf-8")
+        if t == 0x06:
+            return self.take(self.varint())
+        if t == 0x07:
+            n = self.varint()
+            if n > len(self.d):  # cannot have more elements than bytes
+                raise ValueError("bad list count")
+            return [self.value(depth + 1) for _ in range(n)]
+        if t == 0x08:
+            n = self.varint()
+            if n > len(self.d):
+                raise ValueError("bad dict count")
+            out = {}
+            for _ in range(n):
+                k = self.take(self.varint()).decode("utf-8")
+                out[k] = self.value(depth + 1)
+            return out
+        raise ValueError(f"unknown compact type 0x{t:02x}")
+
+
+def loads(data: bytes) -> Any:
+    r = _Reader(data)
+    v = r.value()
+    if r.p != len(data):
+        raise ValueError("trailing bytes")
+    return v
+
+
+# ---- json bridge (json2pb/mcpack2pb bridge role) ---------------------------
+
+def compact_to_json(data: bytes) -> str:
+    import base64
+    import json
+
+    def conv(v):
+        if isinstance(v, bytes):
+            return {"__bytes__": base64.b64encode(v).decode()}
+        if isinstance(v, list):
+            return [conv(e) for e in v]
+        if isinstance(v, dict):
+            return {k: conv(e) for k, e in v.items()}
+        return v
+
+    return json.dumps(conv(loads(data)))
+
+
+def json_to_compact(text: str) -> bytes:
+    import base64
+    import json
+
+    def conv(v):
+        if isinstance(v, dict):
+            if set(v) == {"__bytes__"}:
+                return base64.b64decode(v["__bytes__"])
+            return {k: conv(e) for k, e in v.items()}
+        if isinstance(v, list):
+            return [conv(e) for e in v]
+        return v
+
+    return dumps(conv(json.loads(text)))

@@ -186,8 +186,9 @@ class MemcacheChannel:
                     self._pending.remove((fut, opcode))
                 except ValueError:
                     pass
-            fut.set_exception(errors.RpcError(errors.EFAILEDSOCKET,
-                                              "memcache write failed"))
+            if not fut.done():   # _on_failed may have beaten us to it
+                fut.set_exception(errors.RpcError(errors.EFAILEDSOCKET,
+                                                  "memcache write failed"))
         return fut
 
     def _wait(self, fut: Future, timeout_ms: Optional[int]) -> Packet:

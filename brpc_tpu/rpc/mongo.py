@@ -216,8 +216,11 @@ class MongoService:
         except (ValueError, IndexError, struct.error) as e:
             # truncated headers raise IndexError, truncated BSON elements
             # raise struct.error — all must yield the error reply, not a
-            # swallowed exception and a silently hung client
+            # swallowed exception and a silently hung client.  Reply in the
+            # request's own dialect: OP_QUERY speakers can't parse OP_MSG.
             err = {"ok": 0, "errmsg": f"bad message: {e}", "code": 22}
+            if opcode == OP_QUERY:
+                return build_op_reply([err], self._next_id(), request_id)
             return build_op_msg(err, self._next_id(), request_id)
         return b""  # unknown opcode: drop (connection stays up)
 
@@ -272,7 +275,7 @@ class MongoClient:
                 doc, _ = bson_decode(raw, 16 + 20)
             else:
                 return
-        except ValueError:
+        except (ValueError, IndexError, struct.error):
             return
         with self._mu:
             fut = self._pending.pop(response_to, None)

@@ -167,8 +167,23 @@ def get_serializer(name: str):
     return s
 
 
+class CompactSerializer(Serializer):
+    """Self-describing compact binary (the mcpack2pb slot — see
+    brpc_tpu/rpc/compact.py)."""
+
+    name = "compact"
+
+    def encode(self, obj):
+        from brpc_tpu.rpc.compact import dumps
+        return dumps(obj), b""
+
+    def decode(self, body, tensor_header):
+        from brpc_tpu.rpc.compact import loads
+        return loads(body)
+
+
 for _s in (RawSerializer(), JsonSerializer(), PbSerializer(),
-           TensorSerializer(), PickleSerializer()):
+           TensorSerializer(), PickleSerializer(), CompactSerializer()):
     register_serializer(_s)
 
 

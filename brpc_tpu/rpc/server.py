@@ -184,6 +184,8 @@ class Server:
         if self.options.has_builtin_services:
             from brpc_tpu.builtin.router import HttpRouter
             self._http_router = HttpRouter(self)
+        from brpc_tpu.bvar.default_variables import expose_default_variables
+        expose_default_variables()  # process cpu/rss/fds on /vars (§2.7)
         t = Transport.instance()
         self._listen_sid, self._port = t.listen(
             addr, port, self._on_message, self._on_conn_failed)

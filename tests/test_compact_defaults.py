@@ -1,0 +1,66 @@
+"""Compact codec (mcpack2pb slot) + default process variables."""
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc import compact
+
+
+def test_compact_roundtrip():
+    v = {"s": "héllo", "i": -42, "big": 1 << 62, "f": 2.5, "t": True,
+         "f2": False, "n": None, "b": b"\x00\xff", "l": [1, [2, [3]]],
+         "d": {"x": {"y": "z"}}}
+    assert compact.loads(compact.dumps(v)) == v
+
+
+def test_compact_smaller_than_json():
+    import json
+    v = {"values": list(range(100)), "name": "metrics"}
+    assert len(compact.dumps(v)) < len(json.dumps(v).encode())
+
+
+def test_compact_json_bridge():
+    v = {"k": [1, "two", b"raw"], "ok": True}
+    j = compact.compact_to_json(compact.dumps(v))
+    assert compact.loads(compact.json_to_compact(j)) == v
+
+
+def test_compact_serializer_rpc_roundtrip():
+    class S(brpc.Service):
+        @brpc.method(request="compact", response="compact")
+        def Sum(self, cntl, req):
+            return {"total": sum(req["xs"]), "tag": req["tag"]}
+
+    s = brpc.Server()
+    s.add_service(S())
+    s.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{s.port}")
+        out = ch.call_sync("S", "Sum", {"xs": [1, 2, 3], "tag": b"\x01"},
+                           serializer="compact")
+        assert out == {"total": 6, "tag": b"\x01"}
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_default_process_variables_on_vars_page():
+    from brpc_tpu.bvar.variable import dump_exposed
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        vars_ = dump_exposed("process_*")
+        assert vars_["process_pid"] > 0
+        assert vars_["process_memory_resident_bytes"] > 1 << 20
+        assert vars_["process_fd_count"] > 0
+        assert vars_["process_thread_count"] >= 1
+        assert vars_["process_cpu_seconds"] > 0
+        # and they render on the console
+        from brpc_tpu.rpc.http import HttpChannel
+        h = HttpChannel(f"127.0.0.1:{s.port}")
+        r = h.request("GET", "/vars")
+        assert r.status == 200
+        assert b"process_memory_resident_bytes" in r.body
+        h.close()
+    finally:
+        s.stop()
+        s.join()

@@ -1,0 +1,192 @@
+"""Fuzz-style robustness tests for every parser — the reference's
+test/fuzzing/ analog (libFuzzer targets for http/hpack/redis/… parsers,
+SURVEY.md §4).  Deterministic seeds; every parser must raise a clean
+ValueError-family error or return, never crash or hang, on arbitrary,
+truncated, and bit-flipped inputs."""
+import random
+import socket
+import struct
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+
+SEED = 0xC0FFEE
+ROUNDS = 300
+
+
+def _corpora(encoder_outputs, rng):
+    """Yield random bytes, truncations, and bit-flips of valid outputs."""
+    for _ in range(ROUNDS):
+        yield rng.randbytes(rng.randrange(0, 64))
+    for valid in encoder_outputs:
+        for cut in range(0, len(valid), max(1, len(valid) // 8)):
+            yield valid[:cut]
+        for _ in range(40):
+            b = bytearray(valid)
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+            yield bytes(b)
+
+
+def test_fuzz_hpack_decoder():
+    from brpc_tpu.rpc import hpack
+    rng = random.Random(SEED)
+    enc = hpack.HpackEncoder()
+    valid = [enc.encode([(":method", "POST"), ("x-long", "v" * 100)]),
+             enc.encode([("custom", "pair")])]
+    for data in _corpora(valid, rng):
+        dec = hpack.HpackDecoder()
+        try:
+            dec.decode(data)
+        except ValueError:
+            pass
+
+
+def test_fuzz_huffman():
+    from brpc_tpu.rpc import hpack
+    rng = random.Random(SEED + 1)
+    for data in _corpora([hpack.huffman_encode(b"some text / 1234")], rng):
+        try:
+            hpack.huffman_decode(data)
+        except ValueError:
+            pass
+
+
+def test_fuzz_thrift():
+    from brpc_tpu.rpc import thrift
+    rng = random.Random(SEED + 2)
+    valid = thrift.encode_message(
+        "m", 1, 1, [thrift.TField(1, thrift.T_STRING, "x"),
+                    thrift.TField(2, thrift.T_LIST,
+                                  (thrift.T_I32, [1, 2]))])[4:]
+    for data in _corpora([valid], rng):
+        try:
+            thrift.decode_message(data)
+        except (ValueError, struct.error, MemoryError, OverflowError):
+            pass
+
+
+def test_fuzz_bson():
+    from brpc_tpu.rpc import mongo
+    rng = random.Random(SEED + 3)
+    valid = mongo.bson_encode({"a": 1, "s": "x", "l": [1, {"b": b"\x00"}]})
+    for data in _corpora([valid], rng):
+        try:
+            mongo.bson_decode(data)
+        except (ValueError, struct.error, IndexError):
+            pass
+
+
+def test_fuzz_mongo_service_handle_bytes():
+    from brpc_tpu.rpc import mongo
+    svc = brpc.MongoService()
+    rng = random.Random(SEED + 4)
+    valid = mongo.build_op_msg({"ping": 1}, 3)
+    for data in _corpora([valid], rng):
+        out = svc.handle_bytes(data)   # must never raise
+        assert isinstance(out, bytes)
+
+
+def test_fuzz_memcache_packets():
+    from brpc_tpu.rpc import memcache
+    rng = random.Random(SEED + 5)
+    valid = memcache.pack_packet(0x80, 0x01, b"k", b"\x00" * 8, b"v")
+    svc = brpc.MemoryMemcacheService()
+    for data in _corpora([valid], rng):
+        out = svc.handle_bytes(data)   # must never raise
+        assert isinstance(out, bytes)
+        try:
+            memcache.Packet.parse(data)
+        except ValueError:
+            pass
+
+
+def test_fuzz_redis_values():
+    from brpc_tpu.rpc import redis
+    rng = random.Random(SEED + 6)
+    valid = redis.encode_command("SET", "k", "v")
+    svc = brpc.MemoryRedisService()
+    for data in _corpora([valid], rng):
+        try:
+            redis.parse_value(data)
+        except (ValueError, IndexError):
+            pass
+        out = svc.handle_bytes(data)
+        assert isinstance(out, bytes)
+
+
+def test_fuzz_compact_codec():
+    from brpc_tpu.rpc import compact
+    rng = random.Random(SEED + 7)
+    valid = compact.dumps({"k": [1, 2.5, "s", b"b", None, True,
+                                 {"n": -5}]})
+    for data in _corpora([valid], rng):
+        try:
+            compact.loads(data)
+        except ValueError:
+            pass
+    # deep nesting must be rejected, not recurse to death
+    deep = b"\x07\x01" * 200 + b"\x00"
+    with pytest.raises(ValueError):
+        compact.loads(deep)
+
+
+def test_fuzz_rpc_meta():
+    from brpc_tpu.rpc import meta as M
+    rng = random.Random(SEED + 8)
+    valid = M.RpcMeta(service="s", method="m",
+                      correlation_id=7).encode()
+    for data in _corpora([valid], rng):
+        try:
+            M.RpcMeta.decode(data)
+        except (ValueError, struct.error, UnicodeDecodeError):
+            pass
+
+
+def test_fuzz_native_parser_random_bytes():
+    """Random bytes at a live server socket: the native parser must close
+    bad connections (or wait for more) and the server must stay healthy."""
+    class S(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return req
+
+    s = brpc.Server()
+    s.add_service(S())
+    s.start("127.0.0.1", 0)
+    rng = random.Random(SEED + 9)
+    try:
+        for _ in range(30):
+            c = socket.create_connection(("127.0.0.1", s.port))
+            c.sendall(rng.randbytes(rng.randrange(1, 200)))
+            c.close()
+        # server must still answer real traffic afterwards
+        ch = brpc.Channel(f"127.0.0.1:{s.port}")
+        assert ch.call_sync("S", "Echo", b"alive") == b"alive"
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_fuzz_h2_frames_at_server():
+    """Valid preface + garbage frames must not take the server down."""
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    rng = random.Random(SEED + 10)
+    try:
+        for _ in range(20):
+            c = socket.create_connection(("127.0.0.1", s.port))
+            c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            for _ in range(rng.randrange(1, 5)):
+                n = rng.randrange(0, 40)
+                hdr = bytes([0, 0, n, rng.randrange(12),
+                             rng.randrange(256)]) + rng.randbytes(4)
+                c.sendall(hdr + rng.randbytes(n))
+            c.close()
+        time.sleep(0.1)
+        assert s.running
+    finally:
+        s.stop()
+        s.join()
