@@ -35,6 +35,7 @@ ELIMIT = 2004            # concurrency limit reached
 EINVAL = 22
 ENODATA = 61
 ECONNREFUSED = 111
+ECANCELED = 125          # call canceled by the caller (StartCancel analog)
 
 _DESCRIPTIONS = {
     ENOSERVICE: "The service was not found",
@@ -54,6 +55,7 @@ _DESCRIPTIONS = {
     ERESPONSE: "Bad response",
     ELOGOFF: "Server is stopping",
     ELIMIT: "Reached server's concurrency limit",
+    ECANCELED: "The RPC was canceled by the caller",
 }
 
 

@@ -323,17 +323,37 @@ class CallManager:
             self._finish(st)
 
     def on_deadline(self, cid: int) -> None:
+        self._fail_pending(cid, errors.ERPCTIMEDOUT, "deadline exceeded",
+                           cancel_deadline=False)
+
+    def cancel(self, cid: int) -> bool:
+        """StartCancel analog (reference example/cancel_c++): complete the
+        call NOW with ECANCELED; a late server response is dropped by the
+        (correlation_id, attempt) versioning like any stale attempt.
+        Returns False if the call already completed (including losing the
+        race to a concurrent success)."""
+        return self._fail_pending(cid, errors.ECANCELED,
+                                  "canceled by caller")
+
+    def _fail_pending(self, cid: int, code: int, text: str,
+                      cancel_deadline: bool = True) -> bool:
+        """Shared deadline/cancel path.  The error is applied INSIDE
+        _finish, after winning the exactly-once completion race — setting
+        it first would corrupt a concurrently-arriving success response's
+        state (and misreport the failure as applied)."""
         with self._lock:
             st = self._pending.get(cid)
         if st is None:
-            return
-        st.cntl.set_failed(errors.ERPCTIMEDOUT,
-                           f"deadline {st.cntl.timeout_ms}ms exceeded")
-        self._finish(st, cancel_deadline=False)
+            return False
+        return self._finish(st, cancel_deadline=cancel_deadline,
+                            fail=(code, text))
 
-    def _finish(self, st: _CallState, cancel_deadline: bool = True) -> None:
+    def _finish(self, st: _CallState, cancel_deadline: bool = True,
+                fail: tuple[int, str] | None = None) -> bool:
         if not st.cntl._try_complete():
-            return
+            return False
+        if fail is not None:
+            st.cntl.set_failed(*fail)
         self._unregister(st.cntl.correlation_id)
         t = Transport.instance()
         if cancel_deadline and st.deadline_timer is not None:
@@ -364,6 +384,7 @@ class CallManager:
                 traceback.print_exc()
         if cntl._done_event is not None:
             cntl._done_event.set()
+        return True
 
 
 class Channel:

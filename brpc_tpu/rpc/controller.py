@@ -109,6 +109,13 @@ class Controller:
             CallManager.instance().on_deadline(self.correlation_id)
             self._done_event.wait(1.0)
 
+    def cancel(self) -> bool:
+        """StartCancel analog (reference controller.h StartCancel /
+        example/cancel_c++): fail this in-flight call with ECANCELED now;
+        a late server response is dropped as a stale attempt."""
+        from brpc_tpu.rpc.channel import CallManager
+        return CallManager.instance().cancel(self.correlation_id)
+
     def raise_if_failed(self) -> None:
         if self.failed():
             raise errors.RpcError(self.error_code, self.error_text)

@@ -1,0 +1,39 @@
+"""Async echo (reference example/asynchronous_echo_c++): issue the call
+with a done-callback, do other work, never block a thread."""
+import os, sys, threading
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class EchoService(brpc.Service):
+    @brpc.method(request="json", response="json")
+    def Echo(self, cntl, req):
+        return {"echo": req["msg"]}
+
+
+def main():
+    server = brpc.Server()
+    server.add_service(EchoService())
+    server.start("127.0.0.1", 0)
+    ch = brpc.Channel(f"127.0.0.1:{server.port}")
+    done = threading.Event()
+
+    def on_done(cntl):
+        if cntl.failed():
+            print("failed:", cntl.error_text)
+        else:
+            print(f"async response: {cntl.response} "
+                  f"({cntl.latency_us}us)")
+        done.set()
+
+    ch.call("EchoService", "Echo", {"msg": "fire-and-forget"},
+            serializer="json", done=on_done)
+    print("call issued; main thread free to do other work...")
+    assert done.wait(5)
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Cancel an in-flight RPC (reference example/cancel_c++): StartCancel
+completes the call immediately with ECANCELED; the late server response
+is dropped as a stale attempt."""
+import os, sys, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+
+
+class Slow(brpc.Service):
+    @brpc.method(request="raw", response="raw")
+    def Sleep(self, cntl, req):
+        time.sleep(2.0)
+        return b"too late"
+
+
+def main():
+    server = brpc.Server()
+    server.add_service(Slow())
+    server.start("127.0.0.1", 0)
+    ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=10_000)
+    cntl = ch.call("Slow", "Sleep", b"")
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    assert cntl.cancel()
+    cntl.join()
+    assert cntl.error_code == errors.ECANCELED, cntl.error_code
+    print(f"canceled after {1e3*(time.monotonic()-t0):.1f} ms "
+          f"(server handler still sleeping): E{cntl.error_code} "
+          f"{cntl.error_text}")
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

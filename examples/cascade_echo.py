@@ -1,0 +1,47 @@
+"""Cascade (reference example/cascade_echo_c++): service A calls service B
+from inside its handler; rpcz spans nest across the hop via trace ids."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+from brpc_tpu import rpcz
+
+
+class Backend(brpc.Service):
+    @brpc.method(request="json", response="json")
+    def Echo(self, cntl, req):
+        return {"from": "backend", "msg": req["msg"]}
+
+
+class Frontend(brpc.Service):
+    def __init__(self, backend_addr):
+        self._ch = brpc.Channel(backend_addr)
+
+    @brpc.method(request="json", response="json")
+    def Echo(self, cntl, req):
+        inner = self._ch.call_sync("Backend", "Echo", req,
+                                   serializer="json")
+        return {"from": "frontend", "inner": inner}
+
+
+def main():
+    rpcz.set_enabled(True)
+    backend = brpc.Server()
+    backend.add_service(Backend())
+    backend.start("127.0.0.1", 0)
+    front = brpc.Server()
+    front.add_service(Frontend(f"127.0.0.1:{backend.port}"))
+    front.start("127.0.0.1", 0)
+
+    ch = brpc.Channel(f"127.0.0.1:{front.port}")
+    out = ch.call_sync("Frontend", "Echo", {"msg": "hi"}, serializer="json")
+    print("cascaded response:", out)
+    spans = rpcz.recent_spans(10)
+    print(f"rpcz recorded {len(spans)} spans across the cascade "
+          f"(browse /rpcz on either console)")
+    for s in front, backend:
+        s.stop(); s.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""DynamicPartitionChannel (reference example/dynamic_partition_echo_c++):
+two partition schemes share one naming service; traffic splits by scheme
+capacity and migrates when servers are re-tagged."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class Part(brpc.Service):
+    NAME = "Part"
+    def __init__(self, label): self.label = label
+    @brpc.method(request="raw", response="raw")
+    def Which(self, cntl, req): return self.label.encode()
+
+
+class Concat(brpc.ResponseMerger):
+    def merge(self, results): return b"|".join(sorted(results))
+
+
+def main():
+    servers, nodes = [], []
+    for cnt in (2, 4):
+        for idx in range(cnt):
+            s = brpc.Server()
+            s.add_service(Part(f"{cnt}way:{idx}"))
+            s.start("127.0.0.1", 0)
+            servers.append(s)
+            nodes.append(f"127.0.0.1:{s.port} {idx}/{cnt}")
+    dyn = brpc.DynamicPartitionChannel(response_merger=Concat())
+    dyn.init("list://" + ",".join(nodes))
+    print("schemes (partition_count -> servers):", dyn.scheme_counts)
+    picks = {}
+    for _ in range(20):
+        out = dyn.call_sync("Part", "Which", b"").decode()
+        n = out.count("|") + 1
+        picks[n] = picks.get(n, 0) + 1
+    print("calls per scheme (weighted by capacity):", picks)
+    dyn.stop()
+    for s in servers:
+        s.stop(); s.join()
+
+
+if __name__ == "__main__":
+    main()

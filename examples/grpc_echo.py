@@ -1,0 +1,38 @@
+"""gRPC over HTTP/2 (reference example/grpc_c++): the same Server answers
+gRPC clients on the same port as every other protocol — any stock gRPC
+client that targets /<Service>/<Method> with application/grpc works."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class Greeter(brpc.Service):
+    NAME = "helloworld.Greeter"
+
+    @brpc.method(request="json", response="json")
+    def SayHello(self, cntl, req):
+        return {"message": f"Hello {req['name']}"}
+
+
+def main():
+    import json
+    server = brpc.Server()
+    server.add_service(Greeter())
+    server.start("127.0.0.1", 0)
+    ch = brpc.GrpcChannel(f"127.0.0.1:{server.port}")
+    out = ch.call("helloworld.Greeter", "SayHello",
+                  json.dumps({"name": "tpu"}).encode())
+    print("grpc response:", json.loads(out))
+    futs = [ch.acall("helloworld.Greeter", "SayHello",
+                     json.dumps({"name": f"stream-{i}"}).encode())
+            for i in range(8)]
+    print("8 concurrent h2 streams:",
+          [json.loads(f.result(5))["message"] for f in futs][:3], "...")
+    ch.close()
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""HTTP service + client (reference example/http_c++): custom handlers on
+the console port, RESTful JSON bridge onto RPC methods, HttpChannel."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class Api(brpc.Service):
+    @brpc.method(request="json", response="json")
+    def Add(self, cntl, req):
+        return {"sum": req["a"] + req["b"]}
+
+
+def main():
+    server = brpc.Server()
+    server.add_service(Api())
+    server.add_http_handler("/greet", lambda req: ("hello http\n",
+                                                   "text/plain"))
+    server.start("127.0.0.1", 0)
+    h = brpc.HttpChannel(f"127.0.0.1:{server.port}")
+    print("custom handler:", h.request("GET", "/greet").body.decode().strip())
+    r = h.request("POST", "/Api/Add", '{"a": 40, "b": 2}',
+                  headers={"Content-Type": "application/json"})
+    print("RESTful bridge:", r.body.decode().strip())
+    print("builtin console: /status ->",
+          h.request("GET", "/health").body.decode().strip())
+    h.close()
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

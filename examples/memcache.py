@@ -1,0 +1,28 @@
+"""Memcache binary protocol (reference example/memcache_c++): pipelined
+client against the in-memory memcache-speaking service on the RPC port."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+def main():
+    server = brpc.Server(brpc.ServerOptions(
+        memcache_service=brpc.MemoryMemcacheService()))
+    server.start("127.0.0.1", 0)
+    mc = brpc.MemcacheChannel(f"127.0.0.1:{server.port}")
+    mc.set("greeting", b"hello memcache", flags=42)
+    got = mc.get("greeting")
+    print(f"get -> {got.value!r} flags={got.flags} cas={got.cas}")
+    print("incr counter:", [mc.incr("n", 10, initial=0) for _ in range(3)])
+    futs = [mc.execute(0x01, b"k%d" % i, b"\x00" * 8, b"v%d" % i)
+            for i in range(100)]
+    assert all(f.result(3).status == 0 for f in futs)
+    print("100 pipelined sets OK; version:", mc.version())
+    mc.close()
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""rpcz tracing (reference example/rpcz_echo_c++): per-RPC spans collected
+at sampled rate, browsable at /rpcz on the console."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+from brpc_tpu import rpcz
+
+
+class Echo(brpc.Service):
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        span = rpcz.get_current_span()
+        if span:
+            span.annotate("handler ran")
+        return req
+
+
+def main():
+    rpcz.set_enabled(True, sample_rate=1.0)
+    server = brpc.Server()
+    server.add_service(Echo())
+    server.start("127.0.0.1", 0)
+    ch = brpc.Channel(f"127.0.0.1:{server.port}")
+    for i in range(5):
+        ch.call_sync("Echo", "Echo", b"x%d" % i)
+    spans = rpcz.recent_spans(20)
+    print(f"{len(spans)} spans recorded; latest:")
+    for s in spans[:4]:
+        print(f"  {s.kind:6s} {s.service}.{s.method} "
+              f"{s.latency_us}us trace={s.trace_id:x}")
+    print(f"browse: http://127.0.0.1:{server.port}/rpcz")
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

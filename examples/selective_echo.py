@@ -1,0 +1,41 @@
+"""SelectiveChannel (reference example/selective_echo_c++): a channel of
+channels with its own balancer; failures retry a DIFFERENT sub-channel."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+
+class Who(brpc.Service):
+    NAME = "Who"
+    def __init__(self, label): self.label = label
+    @brpc.method(request="raw", response="raw")
+    def Am(self, cntl, req):
+        return self.label.encode()
+
+
+def main():
+    servers = []
+    sel = brpc.SelectiveChannel()
+    for i in range(3):
+        s = brpc.Server()
+        s.add_service(Who(f"replica-{i}"))
+        s.start("127.0.0.1", 0)
+        servers.append(s)
+        sel.add_channel(brpc.Channel(f"127.0.0.1:{s.port}"))
+    hits = {}
+    for _ in range(30):
+        who = sel.call_sync("Who", "Am", b"").decode()
+        hits[who] = hits.get(who, 0) + 1
+    print("traffic spread:", hits)
+    # kill one replica: calls keep succeeding on the others
+    servers[0].stop(); servers[0].join()
+    for _ in range(10):
+        assert sel.call_sync("Who", "Am", b"").decode() != "replica-0"
+    print("replica-0 down, calls fail over transparently")
+    for s in servers[1:]:
+        s.stop(); s.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Pooled per-request session data (reference example/session_data_and_thread_local):
+a DataFactory-backed pool hands each request a reusable object as
+cntl.session_data."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+
+created = 0
+
+
+class Scratch:
+    def __init__(self):
+        global created
+        created += 1
+        self.buf = bytearray(1 << 16)
+
+
+class S(brpc.Service):
+    @brpc.method(request="raw", response="json")
+    def Use(self, cntl, req):
+        sd = cntl.session_data
+        sd.buf[:len(req)] = req
+        return {"pooled_object_id": id(sd) % 10000}
+
+
+def main():
+    server = brpc.Server(brpc.ServerOptions(session_data_factory=Scratch))
+    server.add_service(S())
+    server.start("127.0.0.1", 0)
+    ch = brpc.Channel(f"127.0.0.1:{server.port}")
+    ids = {ch.call_sync("S", "Use", b"x", response_serializer="json")
+           ["pooled_object_id"] for _ in range(50)}
+    print(f"50 sequential requests used {len(ids)} pooled object(s); "
+          f"{created} Scratch objects ever constructed")
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Thrift framed-binary protocol (reference example/thrift_extension_c++):
+schema-free TBinaryProtocol calls against a method registry."""
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc.thrift import T_I32, T_STRING, TField
+
+
+def main():
+    svc = brpc.ThriftService()
+
+    @svc.method("add")
+    def add(args):
+        return TField(0, T_I32, args[1] + args[2])
+
+    @svc.method("greet")
+    def greet(args):
+        return f"hello {args[1].decode()}"
+
+    server = brpc.Server(brpc.ServerOptions(thrift_service=svc))
+    server.start("127.0.0.1", 0)
+    ch = brpc.ThriftChannel(f"127.0.0.1:{server.port}")
+    print("add(2,40) ->", ch.call("add", [TField(1, T_I32, 2),
+                                          TField(2, T_I32, 40)])[0])
+    print("greet ->", ch.call("greet",
+                              [TField(1, T_STRING, "thrift")])[0].decode())
+    ch.close()
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

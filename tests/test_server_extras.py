@@ -143,3 +143,38 @@ class TestProgressive:
         finally:
             srv.stop()
             srv.join()
+
+
+def test_cancel_inflight_call():
+    """StartCancel analog: cancel() completes the call with ECANCELED and
+    the eventual server response is dropped as stale (cancel_c++)."""
+    import time as _time
+    from brpc_tpu import errors as _errors
+
+    class Slow(brpc.Service):
+        NAME = "CancelSlow"
+
+        @brpc.method(request="raw", response="raw")
+        def Sleep(self, cntl, req):
+            _time.sleep(0.5)
+            return b"late"
+
+    s = brpc.Server()
+    s.add_service(Slow())
+    s.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=5000)
+        cntl = ch.call("CancelSlow", "Sleep", b"")
+        _time.sleep(0.05)
+        assert cntl.cancel() is True
+        cntl.join()
+        assert cntl.error_code == _errors.ECANCELED
+        assert cntl.cancel() is False       # already completed
+        # channel still healthy for the next call after the late response
+        _time.sleep(0.6)
+        c2 = ch.call("CancelSlow", "Sleep", b"")
+        c2.join()
+        assert not c2.failed() and c2.response == b"late"
+    finally:
+        s.stop()
+        s.join()
