@@ -59,27 +59,37 @@ class ListNamingService(NamingService):
         return nodes
 
 
+def _parse_server_lines(text: str) -> list[ServerNode]:
+    """'host:port [weight] [tag]' per line, # comments — THE one parser for
+    file:// and remotefile:// so moving a list between them never changes
+    weights or partition tags."""
+    nodes = []
+    for line in text.splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split()
+        weight, tag = 1, ""
+        if len(parts) >= 2:
+            if parts[1].isdigit():
+                weight = int(parts[1])
+                tag = parts[2] if len(parts) >= 3 else ""
+            else:
+                tag = parts[1]
+        try:
+            nodes.append(ServerNode(str2endpoint(parts[0]), weight, tag))
+        except (ValueError, TypeError, IndexError):
+            continue
+    return nodes
+
+
 class FileNamingService(NamingService):
     """file://path — 'host:port [weight] [tag]' per line, # comments."""
 
     def get_servers(self):
-        nodes = []
-        try:
-            with open(self.param) as f:
-                for line in f:
-                    line = line.split("#", 1)[0].strip()
-                    if not line:
-                        continue
-                    parts = line.split()
-                    weight = int(parts[1]) if len(parts) > 1 and \
-                        parts[1].isdigit() else 1
-                    tag = parts[-1] if len(parts) > 1 and \
-                        not parts[-1].isdigit() else ""
-                    nodes.append(ServerNode(str2endpoint(parts[0]), weight,
-                                            tag))
-        except OSError:
-            return []
-        return nodes
+        with open(self.param) as f:   # OSError propagates: the naming
+            return _parse_server_lines(f.read())  # thread keeps the old
+                                                  # list on refresh errors
 
 
 class DnsNamingService(NamingService):
@@ -113,11 +123,78 @@ class IciNamingService(NamingService):
                 for d in jax.devices()]
 
 
+class RemoteFileNamingService(NamingService):
+    """remotefile://host:port/path — periodically fetch a server list over
+    HTTP in the file:// format: 'host:port [weight] [tag]' per line, #
+    comments (reference policy/remotefile_naming_service.cpp)."""
+
+    interval_s = 5.0
+
+    def _fetch(self) -> str:
+        """Raises on network error or non-200: the NamingServiceThread
+        preserves the last-known-good server list on refresh failures
+        (the reference's behavior) — returning [] here would wipe the LB
+        on a transient registry outage."""
+        from brpc_tpu.rpc.http import HttpChannel
+        addr, slash, path = self.param.partition("/")
+        ch = HttpChannel(addr, timeout_ms=4000)
+        try:
+            r = ch.request("GET", "/" + path if slash else "/")
+            if r.status != 200:
+                raise OSError(f"registry returned HTTP {r.status}")
+            return r.body.decode("utf-8", "replace")
+        finally:
+            ch.close()
+
+    def get_servers(self):
+        return _parse_server_lines(self._fetch())
+
+
+class HttpJsonNamingService(RemoteFileNamingService):
+    """discovery://host:port/path — periodically fetch a JSON server list
+    (the consul/discovery/nacos slot, reference
+    policy/{consul,discovery,nacos}_naming_service.cpp — all three poll an
+    HTTP registry and differ only in JSON shape).  Accepted shapes:
+
+      ["host:port", ...]
+      [{"addr": "host:port", "weight": 2, "tag": "0/4"}, ...]
+      {"servers": [... either of the above ...]}   (nacos/discovery style)
+    """
+
+    interval_s = 5.0
+
+    def get_servers(self):
+        import json
+        # fetch/parse errors propagate: keep the last-known-good list
+        doc = json.loads(self._fetch() or "null")
+        if isinstance(doc, dict):
+            doc = doc.get("servers") or doc.get("hosts") or []
+        if not isinstance(doc, list):
+            raise ValueError("registry JSON is not a server list")
+        nodes = []
+        for item in doc:
+            try:
+                if isinstance(item, str):
+                    nodes.append(ServerNode(str2endpoint(item)))
+                elif isinstance(item, dict):
+                    nodes.append(ServerNode(
+                        str2endpoint(item["addr"]),
+                        int(item.get("weight") or 1),
+                        str(item.get("tag") or "")))
+            except (ValueError, KeyError, TypeError, AttributeError):
+                continue
+        return nodes
+
+
 _SCHEMES = {
     "list": ListNamingService,
     "file": FileNamingService,
     "dns": DnsNamingService,
     "ici": IciNamingService,
+    "remotefile": RemoteFileNamingService,
+    "discovery": HttpJsonNamingService,
+    "consul": HttpJsonNamingService,
+    "nacos": HttpJsonNamingService,
 }
 
 
@@ -153,9 +230,12 @@ class NamingServiceThread(threading.Thread):
                 if nodes or self._resolved_once.is_set():
                     self.lb.reset_servers(nodes)
                 self._resolved_once.set()
-            except Exception:  # pragma: no cover
-                import traceback
-                traceback.print_exc()
+            except Exception as e:
+                # refresh failed: keep the last-known-good list (reference
+                # behavior); one-line note, not a traceback — transient
+                # registry outages are expected in elastic clusters
+                print(f"[naming] refresh of {self.ns.param!r} failed: "
+                      f"{type(e).__name__}: {e} (keeping previous list)")
             if self.ns.interval_s <= 0:
                 break
             self._stop.wait(self.ns.interval_s)

@@ -135,6 +135,11 @@ class Server:
         # h2/gRPC connections on the shared port (auto-detected by the
         # native parser via the client preface), sid -> GrpcServerConnection
         self._h2_conns: dict[int, Any] = {}
+        # bthread-tag analog: isolated per-tag worker pools + service->tag;
+        # sizes recorded so start() can (re)create pools after join()
+        self._tag_pools: dict[str, Any] = {}
+        self._tag_sizes: dict[str, int] = {}
+        self._service_tags: dict[str, str] = {}
 
     def add_http_handler(self, path: str, fn) -> "Server":
         """Register a custom HTTP handler on the console port; fn(req) may
@@ -145,13 +150,30 @@ class Server:
 
     # ---- registry (Server::AddService, server.h:376) ----
 
-    def add_service(self, service: Service) -> "Server":
+    def add_service(self, service: Service,
+                    tag: str | None = None,
+                    tag_workers: int = 4) -> "Server":
+        """Register a service; an optional ``tag`` runs its handlers on an
+        isolated worker pool so one service's load cannot starve another
+        (the bthread tag of the reference, task_control.h:90-147 /
+        example/bthread_tag_echo_c++).  Untagged services run inline on
+        the native dispatch threads."""
         if self._started:
             raise RuntimeError("cannot add services after start")
         name = service.service_name()
         if name in self._services:
             raise ValueError(f"service {name!r} already added")
+        if tag is not None:
+            # validate BEFORE mutating any registry state
+            prev = self._tag_sizes.get(tag)
+            if prev is not None and prev != tag_workers:
+                raise ValueError(
+                    f"tag {tag!r} already sized at {prev} workers; "
+                    f"conflicting tag_workers={tag_workers}")
         self._services[name] = service
+        if tag is not None:
+            self._tag_sizes[tag] = tag_workers
+            self._service_tags[name] = tag
         from brpc_tpu.policy.concurrency_limiter import create_limiter
         for mname, spec in service.rpc_methods().items():
             key = (name, mname)
@@ -186,6 +208,14 @@ class Server:
             self._http_router = HttpRouter(self)
         from brpc_tpu.bvar.default_variables import expose_default_variables
         expose_default_variables()  # process cpu/rss/fds on /vars (§2.7)
+        # (re)create tagged worker pools — join() shuts them down, and a
+        # Server may be started again afterwards
+        from concurrent.futures import ThreadPoolExecutor
+        for tag, workers in self._tag_sizes.items():
+            if tag not in self._tag_pools:
+                self._tag_pools[tag] = ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix=f"svc-tag-{tag}")
         t = Transport.instance()
         self._listen_sid, self._port = t.listen(
             addr, port, self._on_message, self._on_conn_failed)
@@ -217,6 +247,9 @@ class Server:
         t = Transport.instance()
         for sid in conns:
             t.close(sid)
+        for pool in self._tag_pools.values():
+            pool.shutdown(wait=False)
+        self._tag_pools.clear()   # start() recreates from _tag_sizes
         _unregister_server(self)
         self._started = False
 
@@ -335,11 +368,32 @@ class Server:
             if flags.get_flag("rpc_dump"):
                 from brpc_tpu.rpc.rpc_dump import RpcDumper
                 RpcDumper.instance().sample(meta_bytes, body.to_bytes())
-            self._process_request(sid, meta, body)
+            tag = self._service_tags.get(meta.service)
+            pool = self._tag_pools.get(tag) if tag is not None else None
+            if pool is not None:
+                # isolated worker pool for this service (bthread tag);
+                # count the QUEUED request so graceful join() waits for it
+                with self._inflight_mu:
+                    self._inflight += 1
+                    self._inflight_zero.clear()
+                pool.submit(self._process_tagged, sid, meta, body)
+            else:
+                self._process_request(sid, meta, body)
         elif meta.msg_type in (M.MSG_STREAM_DATA, M.MSG_STREAM_FEEDBACK,
                                M.MSG_STREAM_CLOSE):
             from brpc_tpu.rpc.stream import StreamRegistry
             StreamRegistry.instance().on_frame(sid, meta, body)
+
+    def _process_tagged(self, sid: int, meta: M.RpcMeta, body) -> None:
+        try:
+            # pre_accepted: this request entered the queue before any
+            # stop(); graceful join() is waiting for it — serve it
+            self._process_request(sid, meta, body, pre_accepted=True)
+        finally:
+            with self._inflight_mu:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._inflight_zero.set()
 
     def _respond_error(self, sid: int, meta: M.RpcMeta, code: int,
                        text: str = "") -> None:
@@ -349,10 +403,11 @@ class Server:
                          error_text=text or errors.describe(code))
         Transport.instance().write_frame(sid, resp.encode())
 
-    def _process_request(self, sid: int, meta: M.RpcMeta, body) -> None:
+    def _process_request(self, sid: int, meta: M.RpcMeta, body,
+                         pre_accepted: bool = False) -> None:
         """ProcessRpcRequest analog (baidu_rpc_protocol.cpp:398)."""
         start = time.monotonic()
-        if self._stopping:
+        if self._stopping and not pre_accepted:
             self._respond_error(sid, meta, errors.ELOGOFF)
             return
         # auth (§2.5 Auth: first-message piggyback — we verify every frame)
@@ -520,7 +575,13 @@ class Server:
         try:
             cntl = Controller()
             cntl.is_server_side = True
-            result = spec.fn(cntl, payload)
+            tag = self._service_tags.get(service)
+            pool = self._tag_pools.get(tag) if tag is not None else None
+            if pool is not None:
+                # RESTful traffic honors the service's isolated pool too
+                result = pool.submit(spec.fn, cntl, payload).result()
+            else:
+                result = spec.fn(cntl, payload)
             if cntl.failed():
                 error_code = cntl.error_code
                 raise errors.RpcError(cntl.error_code, cntl.error_text)
@@ -614,7 +675,15 @@ class Server:
             if self._session_pool is not None:
                 cntl.session_data = self._session_pool.borrow()
             try:
-                result = spec.fn(cntl, request)
+                tag = self._service_tags.get(key[0])
+                pool = self._tag_pools.get(tag) if tag is not None else None
+                if pool is not None:
+                    # honor the service's isolated pool for gRPC too: the
+                    # calling h2 worker blocks, but handler CONCURRENCY is
+                    # bounded by the tag pool like native traffic
+                    result = pool.submit(spec.fn, cntl, request).result()
+                else:
+                    result = spec.fn(cntl, request)
             finally:
                 rpcz.set_current_span(None)
                 if self._session_pool is not None:

@@ -1,0 +1,133 @@
+"""remotefile:// and discovery:// (consul/nacos slot) naming services:
+the registry itself is one of our HTTP servers — pure loopback
+(reference policy/{remotefile,consul,discovery,nacos}_naming_service.cpp)."""
+import json
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu.policy.naming import (HttpJsonNamingService,
+                                    RemoteFileNamingService)
+
+
+class Echo(brpc.Service):
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return req
+
+
+@pytest.fixture()
+def backend():
+    s = brpc.Server()
+    s.add_service(Echo())
+    s.start("127.0.0.1", 0)
+    yield s
+    s.stop()
+    s.join()
+
+
+def _registry_server(payload, content_type):
+    reg = brpc.Server()
+    reg.add_http_handler("/nodes", lambda req: (payload, content_type))
+    reg.start("127.0.0.1", 0)
+    return reg
+
+
+def test_remotefile_naming(backend):
+    reg = _registry_server(
+        f"# registry\n127.0.0.1:{backend.port} 3 0/1\n", "text/plain")
+    try:
+        ns = RemoteFileNamingService(f"127.0.0.1:{reg.port}/nodes")
+        nodes = ns.get_servers()
+        assert len(nodes) == 1
+        assert nodes[0].endpoint.port == backend.port
+        assert nodes[0].weight == 3 and nodes[0].tag == "0/1"
+        # end-to-end: channel resolves through the remote registry
+        ch = brpc.Channel(f"remotefile://127.0.0.1:{reg.port}/nodes")
+        assert ch.call_sync("Echo", "Echo", b"via-remotefile") == \
+            b"via-remotefile"
+    finally:
+        reg.stop()
+        reg.join()
+
+
+@pytest.mark.parametrize("shape", ["bare", "objects", "wrapped"])
+def test_discovery_json_naming(backend, shape):
+    addr = f"127.0.0.1:{backend.port}"
+    payload = {
+        "bare": json.dumps([addr]),
+        "objects": json.dumps([{"addr": addr, "weight": 2, "tag": "a"}]),
+        "wrapped": json.dumps({"servers": [{"addr": addr}]}),
+    }[shape]
+    reg = _registry_server(payload, "application/json")
+    try:
+        ns = HttpJsonNamingService(f"127.0.0.1:{reg.port}/nodes")
+        nodes = ns.get_servers()
+        assert len(nodes) == 1 and nodes[0].endpoint.port == backend.port
+        if shape == "objects":
+            assert nodes[0].weight == 2 and nodes[0].tag == "a"
+        ch = brpc.Channel(f"discovery://127.0.0.1:{reg.port}/nodes")
+        assert ch.call_sync("Echo", "Echo", b"x") == b"x"
+    finally:
+        reg.stop()
+        reg.join()
+
+
+def test_registry_outage_preserves_last_known_good(backend):
+    """Fetch failures must RAISE (not return []) so the naming thread
+    keeps the last-known-good server list — a transient registry outage
+    must not wipe the LB (reference behavior)."""
+    with pytest.raises(Exception):
+        HttpJsonNamingService("127.0.0.1:1/nodes").get_servers()
+    with pytest.raises(Exception):
+        RemoteFileNamingService("127.0.0.1:1/nodes").get_servers()
+
+    # end-to-end: resolve once, kill the registry, calls keep working
+    addr = f"127.0.0.1:{backend.port}"
+    reg = _registry_server(json.dumps([addr]), "application/json")
+    HttpJsonNamingService.interval_s = 0.2
+    try:
+        ch = brpc.Channel(f"discovery://127.0.0.1:{reg.port}/nodes")
+        assert ch.call_sync("Echo", "Echo", b"1") == b"1"
+        reg.stop()
+        reg.join()
+        import time
+        time.sleep(0.6)   # several failed refresh cycles
+        assert ch.call_sync("Echo", "Echo", b"2") == b"2"
+    finally:
+        HttpJsonNamingService.interval_s = 5.0
+
+
+def test_malformed_registry_entries_skipped(backend):
+    """One bad entry must not poison the document: good entries apply."""
+    addr = f"127.0.0.1:{backend.port}"
+    payload = json.dumps([{"addr": addr, "weight": None},
+                          {"addr": 123}, {"nope": 1}, addr])
+    reg = _registry_server(payload, "application/json")
+    try:
+        ns = HttpJsonNamingService(f"127.0.0.1:{reg.port}/nodes")
+        nodes = ns.get_servers()
+        assert len(nodes) == 2   # the null-weight dict and the bare str
+        assert all(n.endpoint.port == backend.port for n in nodes)
+    finally:
+        reg.stop()
+        reg.join()
+
+
+def test_file_and_remotefile_parse_identically(tmp_path, backend):
+    text = f"127.0.0.1:{backend.port} 5 2/8\n127.0.0.1:{backend.port} t\n"
+    p = tmp_path / "servers.txt"
+    p.write_text(text)
+    from brpc_tpu.policy.naming import FileNamingService
+    fnodes = FileNamingService(str(p)).get_servers()
+    reg = _registry_server(text, "text/plain")
+    try:
+        rnodes = RemoteFileNamingService(
+            f"127.0.0.1:{reg.port}/nodes").get_servers()
+        assert [(n.endpoint, n.weight, n.tag) for n in fnodes] == \
+            [(n.endpoint, n.weight, n.tag) for n in rnodes]
+        assert fnodes[0].weight == 5 and fnodes[0].tag == "2/8"
+        assert fnodes[1].tag == "t"
+    finally:
+        reg.stop()
+        reg.join()

@@ -178,3 +178,97 @@ def test_cancel_inflight_call():
     finally:
         s.stop()
         s.join()
+
+
+def test_service_tag_isolated_pool():
+    """bthread-tag analog: a tagged slow service runs on its own worker
+    pool and does not block the untagged fast service."""
+    import time as _time
+
+    class Fast(brpc.Service):
+        NAME = "TagFast"
+
+        @brpc.method(request="raw", response="raw")
+        def Ping(self, cntl, req):
+            return b"pong"
+
+    class Slow(brpc.Service):
+        NAME = "TagSlow"
+
+        @brpc.method(request="raw", response="raw")
+        def Crunch(self, cntl, req):
+            _time.sleep(0.3)
+            return b"done"
+
+    s = brpc.Server()
+    s.add_service(Fast())
+    s.add_service(Slow(), tag="batch", tag_workers=1)
+    s.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=5000)
+        slow = [ch.call("TagSlow", "Crunch", b"") for _ in range(3)]
+        t0 = _time.monotonic()
+        assert ch.call_sync("TagFast", "Ping", b"") == b"pong"
+        fast_latency = _time.monotonic() - t0
+        assert fast_latency < 0.25, f"fast call blocked {fast_latency}s"
+        for c in slow:
+            c.join()
+            assert c.response == b"done"
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_tagged_requests_drain_on_join_and_server_restarts():
+    import time as _time
+
+    class Slow(brpc.Service):
+        NAME = "DrainSlow"
+
+        @brpc.method(request="raw", response="raw")
+        def Crunch(self, cntl, req):
+            _time.sleep(0.15)
+            return b"done"
+
+    s = brpc.Server()
+    s.add_service(Slow(), tag="drain", tag_workers=1)
+    s.start("127.0.0.1", 0)
+    ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=10000)
+    cntls = [ch.call("DrainSlow", "Crunch", b"") for _ in range(4)]
+    _time.sleep(0.05)           # 1 running, 3 queued in the tag pool
+    s.stop()
+    s.join()                    # must wait for the QUEUED ones too
+    for c in cntls:
+        c.join()
+        assert not c.failed() and c.response == b"done"
+    # restart: tag pool must be recreated, tagged service answers again
+    s.start("127.0.0.1", 0)
+    try:
+        ch2 = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=5000)
+        assert ch2.call_sync("DrainSlow", "Crunch", b"") == b"done"
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_conflicting_tag_workers_rejected():
+    class A(brpc.Service):
+        NAME = "TagA"
+
+        @brpc.method(request="raw", response="raw")
+        def M(self, cntl, req):
+            return b""
+
+    class B(brpc.Service):
+        NAME = "TagB"
+
+        @brpc.method(request="raw", response="raw")
+        def M(self, cntl, req):
+            return b""
+
+    s = brpc.Server()
+    s.add_service(A(), tag="t", tag_workers=2)
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        s.add_service(B(), tag="t", tag_workers=8)
+    s.add_service(B(), tag="t", tag_workers=2)  # matching size is fine
