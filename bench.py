@@ -177,14 +177,23 @@ def bench_tensor_pipe(chunk_mb=8, n_chunks=8):
     dev = jax.devices()[0]
     n = chunk_mb * 1024 * 1024 // 2
     chunk = jnp.ones((n,), jnp.bfloat16)
+    chunk.block_until_ready()
     outs = []
-    ts = TensorStream(dev, consumer=lambda a: outs.append(a))
+    # window = 4 chunks so transfers actually pipeline (a window equal to
+    # one chunk would serialize them and measure nothing but turnaround)
+    ts = TensorStream(dev, consumer=lambda a: outs.append(a),
+                      window_bytes=4 * chunk.nbytes)
+    ts.write(chunk)          # warmup: drainer thread + first dispatch
+    deadline = time.monotonic() + 10
+    while not outs and time.monotonic() < deadline:
+        time.sleep(0.005)    # deterministic: wait until warmup delivered
+    outs.clear()
     t0 = time.monotonic()
     for _ in range(n_chunks):
         ts.write(chunk)
-    ts.close(wait=True)
-    if outs:
-        float(outs[-1][0])  # host-sync the tail
+    ts.close(wait=True)      # drainer has block_until_ready'd the tail;
+    if outs:                 # sync again without compiling a gather op
+        outs[-1].block_until_ready()
     wall = time.monotonic() - t0
     return {"gbps": round(n_chunks * chunk.nbytes / wall / 1e9, 3),
             "chunk_mb": chunk_mb, "chunks": len(outs)}
@@ -221,7 +230,7 @@ def main():
         details["streaming"] = bench_streaming_echo()
         log(f"  {details['streaming']}")
         log("bench: tensor pipe (framework path incl. dispatch)...")
-        details["tensor_pipe"] = bench_tensor_pipe()
+        details["tensor_pipe"] = bench_tensor_pipe(chunk_mb=64)
         log(f"  {details['tensor_pipe']}")
         log("bench: ici ladder...")
         details["ici_ladder"] = bench_ici_ladder()

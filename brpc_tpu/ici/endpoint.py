@@ -16,6 +16,7 @@ with the DCN path in a later round.
 """
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
 import time
 from typing import Optional
@@ -32,6 +33,25 @@ _transfer_latency = LatencyRecorder("ici_transfer")
 DEFAULT_WINDOW_BYTES = 64 * 1024 * 1024
 
 
+def _collect_batch(q, first):
+    """Drain everything already sitting in `q` behind `first` without
+    blocking.  Returns (batch, stop) where stop means the None close
+    sentinel was reached.  Shared by IciEndpoint and TensorStream so the
+    two drain loops cannot diverge."""
+    batch = [first]
+    stop = False
+    while True:
+        try:
+            nxt = q.get_nowait()
+        except queue_mod.Empty:
+            break
+        if nxt is None:
+            stop = True
+            break
+        batch.append(nxt)
+    return batch, stop
+
+
 class IciEndpoint:
     """Point-to-point ordered transfer pipe to one target device."""
 
@@ -40,6 +60,9 @@ class IciEndpoint:
         self.window_bytes = window_bytes
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
+        # serializes dispatch + completion-enqueue so the completion queue
+        # is in dispatch order — the batch drain's tail-sync relies on it
+        self._dispatch_mu = threading.Lock()
         self._inflight = 0
         self._closed = False
         # single long-lived completion drainer (the "poll-cq" thread);
@@ -62,16 +85,32 @@ class IciEndpoint:
             item = self._completions.get()
             if item is None:
                 return
-            out, nbytes, t0 = item
+            # batch drain: collect everything already queued and host-sync
+            # only the NEWEST — send() dispatches AND enqueues under
+            # _dispatch_mu, so queue order == dispatch order, and one
+            # device completes d2d copies in dispatch order; the tail's
+            # readiness therefore implies the whole batch's.  This turns N
+            # host round-trips (ruinous over a tunneled chip, ~RTT each)
+            # into one per drain cycle.
+            batch, stop = _collect_batch(self._completions, item)
+            out, _, t0 = batch[-1]
             try:
                 out.block_until_ready()
             except Exception:  # transfer failure: free the window anyway
                 pass
+            # only the tail's completion was actually observed — record
+            # one latency sample per drain cycle rather than charging
+            # every earlier chunk the full batch duration
             _transfer_latency.add(int((time.monotonic() - t0) * 1e6))
-            _recv_bytes.add(nbytes)
+            total = 0
+            for _, nbytes, _ in batch:
+                _recv_bytes.add(nbytes)
+                total += nbytes
             with self._cv:
-                self._inflight -= nbytes
+                self._inflight -= total
                 self._cv.notify_all()
+            if stop:
+                return
 
     def send(self, array: jax.Array, timeout_s: float = 30.0) -> jax.Array:
         """Start an async transfer of `array` to this endpoint's device;
@@ -92,7 +131,13 @@ class IciEndpoint:
             self._inflight += nbytes
         t0 = time.monotonic()
         try:
-            out = jax.device_put(array, self.device)  # async: ICI DMA starts
+            with self._dispatch_mu:
+                # dispatch and enqueue atomically: with concurrent senders
+                # the completion queue must mirror device dispatch order,
+                # or the drainer's tail-sync would free window credit for
+                # transfers that are still in flight
+                out = jax.device_put(array, self.device)  # async ICI DMA
+                self._completions.put((out, nbytes, t0))
         except Exception:
             # release the window reservation or failed sends would shrink
             # the window permanently
@@ -103,7 +148,6 @@ class IciEndpoint:
         _send_bytes.add(nbytes)
         _send_count.add(1)
         self._ensure_drainer()
-        self._completions.put((out, nbytes, t0))
         return out
 
     def send_sync(self, array: jax.Array) -> jax.Array:

@@ -23,6 +23,7 @@ class TensorStream:
                  window_bytes: int = 64 * 1024 * 1024):
         self.endpoint = IciEndpoint(device, window_bytes)
         self._consumer = consumer
+        self._write_mu = threading.Lock()
         self._q: "queue.Queue" = queue.Queue()
         self._closed = threading.Event()
         self._drained = threading.Event()
@@ -35,8 +36,12 @@ class TensorStream:
         preserved for the consumer."""
         if self._closed.is_set():
             raise RuntimeError("stream closed")
-        out = self.endpoint.send(array)
-        self._q.put(out)
+        with self._write_mu:
+            # dispatch + enqueue atomically so _q mirrors dispatch order —
+            # the drainer's batch tail-sync depends on it (endpoint.py has
+            # the same discipline for its completion queue)
+            out = self.endpoint.send(array)
+            self._q.put(out)
 
     def _drain(self) -> None:
         try:
@@ -49,13 +54,28 @@ class TensorStream:
                     continue
                 if item is None:
                     break
-                item.block_until_ready()   # ordered completion
+                # batch: sync the newest queued chunk once (one device
+                # executes d2d copies in dispatch order, so the tail being
+                # ready implies the earlier ones are) and feed the
+                # consumer in order — N tunnel round-trips become 1
+                from brpc_tpu.ici.endpoint import _collect_batch
+                batch, stop = _collect_batch(self._q, item)
+                try:
+                    batch[-1].block_until_ready()   # ordered completion
+                except Exception:
+                    # one failed transfer must not kill the drainer or
+                    # swallow delivery of the batch's completed chunks
+                    import traceback
+                    traceback.print_exc()
                 if self._consumer is not None:
-                    try:
-                        self._consumer(item)
-                    except Exception:  # consumer bug must not kill the pipe
-                        import traceback
-                        traceback.print_exc()
+                    for chunk in batch:
+                        try:
+                            self._consumer(chunk)
+                        except Exception:  # consumer bug must not kill pipe
+                            import traceback
+                            traceback.print_exc()
+                if stop:
+                    break
         finally:
             self._drained.set()
 
