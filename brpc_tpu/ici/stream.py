@@ -14,7 +14,7 @@ from typing import Callable, Optional
 
 import jax
 
-from brpc_tpu.ici.endpoint import IciEndpoint
+from brpc_tpu.ici.endpoint import IciEndpoint, _collect_batch
 
 
 class TensorStream:
@@ -58,7 +58,6 @@ class TensorStream:
                 # executes d2d copies in dispatch order, so the tail being
                 # ready implies the earlier ones are) and feed the
                 # consumer in order — N tunnel round-trips become 1
-                from brpc_tpu.ici.endpoint import _collect_batch
                 batch, stop = _collect_batch(self._q, item)
                 try:
                     batch[-1].block_until_ready()   # ordered completion

@@ -20,7 +20,7 @@ from brpc_tpu.bvar import Adder, LatencyRecorder, PassiveStatus
 from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.controller import Controller
 from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
-from brpc_tpu.rpc.service import MethodSpec, Service
+from brpc_tpu.rpc.service import MethodSpec, Service, method
 from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_MEMCACHE,
                                     MSG_MONGO, MSG_REDIS, MSG_THRIFT,
                                     MSG_TRPC, Transport)
@@ -200,12 +200,37 @@ class Server:
     def start(self, addr: str = "0.0.0.0", port: int = 0) -> "Server":
         if self._started:
             raise RuntimeError("already started")
+        self._stopping = False   # support stop()/join()/start() again
         if self.options.max_concurrency:
             from brpc_tpu.policy.concurrency_limiter import create_limiter
             self._limiter = create_limiter(self.options.max_concurrency)
         if self.options.has_builtin_services:
             from brpc_tpu.builtin.router import HttpRouter
             self._http_router = HttpRouter(self)
+            # gRPC health protocol (reference grpc_health_check /
+            # builtin grpc health): stock grpc health clients call
+            # /grpc.health.v1.Health/Check and expect
+            # HealthCheckResponse{status: SERVING=1} == pb bytes 08 01
+            if "grpc.health.v1.Health" not in self._services:
+                outer = self
+
+                class _GrpcHealth(Service):
+                    NAME = "grpc.health.v1.Health"
+
+                    @method(request="raw", response="raw")
+                    def Check(self, cntl, req):
+                        # HealthCheckRequest.service is pb field 1
+                        # (length-delimited): empty = whole server
+                        svc = ""
+                        if len(req) >= 2 and req[0] == 0x0A:
+                            n = req[1]
+                            svc = req[2:2 + n].decode("utf-8", "replace")
+                        if svc and svc not in outer._services:
+                            return b"\x08\x03"  # SERVICE_UNKNOWN
+                        return b"\x08\x01" if outer.running \
+                            else b"\x08\x02"  # NOT_SERVING
+
+                self.add_service(_GrpcHealth())
         from brpc_tpu.bvar.default_variables import expose_default_variables
         expose_default_variables()  # process cpu/rss/fds on /vars (§2.7)
         # (re)create tagged worker pools — join() shuts them down, and a
@@ -575,6 +600,22 @@ class Server:
         try:
             cntl = Controller()
             cntl.is_server_side = True
+            # json2pb bridge (reference json2pb/, restful.cpp): pb-typed
+            # methods get the JSON body parsed into their message class,
+            # and pb responses render back as JSON-able dicts
+            from brpc_tpu.rpc.serialization import PbSerializer
+            req_ser = spec.request_serializer
+            if isinstance(req_ser, PbSerializer) and \
+                    req_ser.message_class is not None and \
+                    isinstance(payload, dict):
+                from google.protobuf import json_format
+                try:
+                    payload = json_format.ParseDict(
+                        payload, req_ser.message_class())
+                except json_format.ParseError as e:
+                    # client error (bad field/shape), not a server fault
+                    raise errors.RpcError(errors.EREQUEST,
+                                          f"json2pb: {e}")
             tag = self._service_tags.get(service)
             pool = self._tag_pools.get(tag) if tag is not None else None
             if pool is not None:
@@ -582,6 +623,12 @@ class Server:
                 result = pool.submit(spec.fn, cntl, payload).result()
             else:
                 result = spec.fn(cntl, payload)
+            if result is not None and hasattr(result, "DESCRIPTOR"):
+                from google.protobuf import json_format
+                # proto field names, not camelCase: clients must get back
+                # the same keys they sent (reference json2pb behavior)
+                result = json_format.MessageToDict(
+                    result, preserving_proto_field_name=True)
             if cntl.failed():
                 error_code = cntl.error_code
                 raise errors.RpcError(cntl.error_code, cntl.error_text)

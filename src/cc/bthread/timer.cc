@@ -13,7 +13,9 @@ uint64_t TimerThread::schedule(TimerFn fn, void* arg, int64_t abstime_us) {
   const uint64_t id = _next_id++;
   _heap.push(Item{abstime_us, id, fn, arg});
   _pending_ids.insert(id);
-  _cv.notify_one();
+  // wake only when this timer preempts the current sleep target; a later
+  // deadline will be picked up when the thread next wakes anyway
+  if (abstime_us < _sleeping_until_us) _cv.notify_one();
   return id;
 }
 
@@ -39,13 +41,17 @@ void TimerThread::run() {
   std::unique_lock<std::mutex> g(_mu);
   while (!_stop) {
     if (_heap.empty()) {
+      _sleeping_until_us = INT64_MAX;  // any new timer must wake us
       _cv.wait(g);
+      _sleeping_until_us = 0;
       continue;
     }
     const Item top = _heap.top();
     const int64_t now = butil::monotonic_time_us();
     if (top.when_us > now) {
+      _sleeping_until_us = top.when_us;
       _cv.wait_for(g, std::chrono::microseconds(top.when_us - now));
+      _sleeping_until_us = 0;
       continue;
     }
     _heap.pop();

@@ -58,6 +58,11 @@ class TimerThread {
   std::unordered_set<uint64_t> _cancelled;
   std::unordered_set<uint64_t> _pending_ids;  // scheduled, not yet fired
   uint64_t _next_id = 1;
+  // deadline the run() loop is currently sleeping toward; schedule() only
+  // wakes the thread when a NEW nearest arrives (the reference
+  // TimerThread's nearest_run_time discipline, timer_thread.cpp) — without
+  // this every RPC's deadline arm costs a futex wake + context switch
+  int64_t _sleeping_until_us = 0;
   bool _stop = false;
   std::atomic<int64_t> _fired{0};
   std::thread _thread;

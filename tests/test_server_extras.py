@@ -272,3 +272,105 @@ def test_conflicting_tag_workers_rejected():
     with _pytest.raises(ValueError):
         s.add_service(B(), tag="t", tag_workers=8)
     s.add_service(B(), tag="t", tag_workers=2)  # matching size is fine
+
+
+def test_grpc_health_builtin():
+    """Stock grpc health clients calling /grpc.health.v1.Health/Check get
+    HealthCheckResponse{status: SERVING} (pb bytes 08 01)."""
+    from brpc_tpu.rpc.h2 import GrpcChannel
+
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{s.port}")
+        out = ch.call("grpc.health.v1.Health", "Check", b"")
+        assert out == b"\x08\x01"
+        ch.close()
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_restful_json2pb_bridge():
+    """POST /Service/Method with JSON against a pb-typed method: the json
+    body parses into the message class and the pb response renders back
+    as JSON (json2pb bridge)."""
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    # build a tiny pb message class at runtime (no .proto files in-tree)
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "t_restful.proto"
+    fdp.package = "t"
+    m = fdp.message_type.add()
+    m.name = "Pair"
+    f = m.field.add()
+    f.name = "a"; f.number = 1
+    f.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT64
+    f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    f = m.field.add()
+    f.name = "b"; f.number = 2
+    f.type = descriptor_pb2.FieldDescriptorProto.TYPE_INT64
+    f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    Pair = message_factory.GetMessageClass(
+        pool.FindMessageTypeByName("t.Pair"))
+
+    class S(brpc.Service):
+        NAME = "PbSvc"
+
+        @brpc.method(request_class=Pair, response_class=Pair)
+        def Swap(self, cntl, req):
+            out = Pair()
+            out.a, out.b = req.b, req.a
+            return out
+
+    s = brpc.Server()
+    s.add_service(S())
+    s.start("127.0.0.1", 0)
+    try:
+        import json
+        h = brpc.HttpChannel(f"127.0.0.1:{s.port}")
+        r = h.request("POST", "/PbSvc/Swap", json.dumps({"a": 1, "b": 2}),
+                      headers={"Content-Type": "application/json"})
+        assert r.status == 200, r.body
+        assert json.loads(r.body) == {"a": "2", "b": "1"}  # int64 -> str
+        h.close()
+        # the same method still works over native pb (client passes the
+        # request serializer; response bytes parse back into Pair)
+        ch = brpc.Channel(f"127.0.0.1:{s.port}")
+        req = Pair(); req.a, req.b = 7, 9
+        spec = s._methods[("PbSvc", "Swap")]
+        raw = ch.call_sync("PbSvc", "Swap", req,
+                           serializer=spec.request_serializer)
+        out = Pair()
+        out.ParseFromString(raw)
+        assert out.a == 9 and out.b == 7
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_grpc_health_unknown_service_and_restart_flag():
+    from brpc_tpu.rpc.h2 import GrpcChannel
+
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    port1 = s.port
+    ch = GrpcChannel(f"127.0.0.1:{port1}")
+    # HealthCheckRequest{service: "no.Such"} -> SERVICE_UNKNOWN (08 03)
+    req = b"\x0a\x07no.Such"
+    assert ch.call("grpc.health.v1.Health", "Check", req) == b"\x08\x03"
+    assert ch.call("grpc.health.v1.Health", "Check", b"") == b"\x08\x01"
+    ch.close()
+    s.stop()
+    s.join()
+    # restart: _stopping must reset so the server serves again
+    s.start("127.0.0.1", 0)
+    try:
+        ch2 = GrpcChannel(f"127.0.0.1:{s.port}")
+        assert ch2.call("grpc.health.v1.Health", "Check", b"") == b"\x08\x01"
+        ch2.close()
+    finally:
+        s.stop()
+        s.join()
