@@ -1,0 +1,21 @@
+"""Hot compute ops — sequence-parallel attention for long context.
+
+This fills the "long-context is first-class" slot (SURVEY.md §5.7): the
+reference moves unbounded payloads through bounded memory with credit-
+windowed streams; on TPU the analogous scale axis is sequence length, and
+the framework ships exact sequence-parallel attention over the mesh:
+
+  ring_attention     K/V blocks circulate a ppermute ring; online-softmax
+                     keeps the result exact with each chip holding only
+                     1/n of the sequence (the StreamWrite credit loop in
+                     collective form).
+  ulysses_attention  all_to_all reshard: sequence-sharded -> head-sharded,
+                     full attention locally per head group, reshard back.
+  flash_attention    blockwise local attention; a Pallas TPU kernel with a
+                     lax fallback for non-TPU backends.
+"""
+from brpc_tpu.ops.attention import (flash_attention, local_attention,
+                                    ring_attention, ulysses_attention)
+
+__all__ = ["flash_attention", "local_attention", "ring_attention",
+           "ulysses_attention"]

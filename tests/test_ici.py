@@ -1,6 +1,7 @@
 """ICI transport tests on the virtual 8-device CPU mesh (SURVEY.md §4:
 single-host multi-device plays the role 127.0.0.1 plays in the reference).
 """
+import time
 import numpy as np
 import pytest
 
@@ -54,6 +55,11 @@ class TestEndpointAndStream:
         y = ep.send_sync(x)
         assert y.devices() == {dev}
         np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        # window credit returns on the completion drainer, asynchronously
+        # to send_sync; poll until it settles
+        deadline = time.monotonic() + 5
+        while ep.inflight_bytes > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert ep.inflight_bytes == 0
 
     def test_window_backpressure(self):
