@@ -14,6 +14,7 @@ the arena slice.
 """
 from __future__ import annotations
 
+import functools
 import threading
 from dataclasses import dataclass
 
@@ -22,6 +23,35 @@ import jax.numpy as jnp
 import numpy as np
 
 from brpc_tpu.bvar import Adder, PassiveStatus
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _stage(x, cls: int):
+    """Reinterpret a tensor's bytes as uint8 and pad into a block-class
+    buffer — entirely on device (no host bounce).  Runs on the source
+    array's device; the output is always a fresh buffer."""
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    flat = x.ravel()
+    if flat.dtype != jnp.uint8:
+        flat = jax.lax.bitcast_convert_type(flat, jnp.uint8).ravel()
+    out = jnp.zeros((cls,), jnp.uint8)
+    return jax.lax.dynamic_update_slice(out, flat, (0,))
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _unstage(buf, dtype_name: str, shape: tuple):
+    """Rebuild a tensor from a block's byte buffer, on device."""
+    dt = np.dtype(dtype_name)
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    nbytes = n * (1 if dt == np.bool_ else dt.itemsize)
+    raw = jax.lax.dynamic_slice(buf, (0,), (nbytes,))
+    if dt == np.bool_:
+        return raw.reshape(shape).astype(jnp.bool_)
+    if dt.itemsize == 1:
+        return jax.lax.bitcast_convert_type(raw, dt).reshape(shape)
+    return jax.lax.bitcast_convert_type(
+        raw.reshape(n, dt.itemsize), dt).reshape(shape)
 
 # size classes, mirroring the reference's 8KB/64KB/2MB (block_pool.cpp:52)
 BLOCK_CLASSES = (8 * 1024, 64 * 1024, 2 * 1024 * 1024)
@@ -46,29 +76,61 @@ class Block:
             return self.pool._slots[self.size_class][self.slot]
 
     def put(self, data) -> "Block":
-        """Copy host/device bytes into this block's slot (device_put to the
-        pool's device; on-device source stays on device).  The slot buffer
-        is replaced atomically under the pool lock — concurrent puts to
-        different slots never interfere and nothing copies the whole class
-        arena."""
+        """Stage host/device bytes into this block's slot.  Device-resident
+        sources are reinterpreted and padded entirely on device (`_stage`
+        under jit — no host round-trip), then DMA'd to the pool's device if
+        they live elsewhere; host bytes pad host-side and ship in a single
+        device_put.  The slot buffer is replaced atomically under the pool
+        lock — concurrent puts to different slots never interfere."""
         if isinstance(data, jax.Array):
-            # reinterpret the tensor's bytes, never value-cast
-            buf = np.asarray(data).ravel().view(np.uint8)
+            n = data.nbytes
+            if n > self.size_class:
+                raise ValueError(f"{n}B > block class {self.size_class}")
+            dev = _stage(data, self.size_class)   # on the source device
+            if dev.devices() != {self.pool.device}:
+                dev = jax.device_put(dev, self.pool.device)
+            self._src_meta = (str(data.dtype), tuple(data.shape))
         else:
             buf = np.frombuffer(memoryview(data), dtype=np.uint8)
-        n = buf.size
-        if n > self.size_class:
-            raise ValueError(f"{n}B > block class {self.size_class}")
+            n = buf.size
+            if n > self.size_class:
+                raise ValueError(f"{n}B > block class {self.size_class}")
+            padded = np.zeros((self.size_class,), np.uint8)
+            padded[:n] = buf
+            dev = jax.device_put(padded, self.pool.device)
+            self._src_meta = None
         self.used = n
-        padded = jnp.zeros((self.size_class,), jnp.uint8).at[:n].set(
-            jnp.asarray(buf, jnp.uint8))
-        dev = jax.device_put(padded, self.pool.device)
         with self.pool._lock:
             self.pool._slots[self.size_class][self.slot] = dev
         return self
 
+    def install(self, dev_array: jax.Array, used: int,
+                meta: tuple | None = None) -> "Block":
+        """Adopt an already-transferred device buffer as this block's
+        contents — the receive half of the block pipe (no staging, no
+        copy).  The buffer need not match the slot's class exactly (alloc
+        falls through to a larger class when the preferred one is
+        exhausted); it only has to cover the payload."""
+        if used > dev_array.nbytes:
+            raise ValueError(
+                f"payload {used}B exceeds buffer {dev_array.nbytes}B")
+        self.used = used
+        self._src_meta = meta
+        with self.pool._lock:
+            self.pool._slots[self.size_class][self.slot] = dev_array
+        return self
+
     def get(self) -> bytes:
         return bytes(np.asarray(self.view())[: self.used])
+
+    def get_array(self, dtype=None, shape=None) -> jax.Array:
+        """Rebuild the staged tensor on device.  dtype/shape default to the
+        source tensor's (recorded by put)."""
+        if dtype is None or shape is None:
+            if getattr(self, "_src_meta", None) is None:
+                raise ValueError("no recorded dtype/shape; pass them")
+            dtype, shape = self._src_meta
+        return _unstage(self.view(), str(np.dtype(dtype)), tuple(shape))
 
     def free(self) -> None:
         self.pool.free(self)
@@ -122,6 +184,18 @@ class BlockPool:
                 "allocated": self._allocated.get_value(),
                 "freed": self._freed.get_value(),
             }
+
+
+def stage_chunks(data, src_pool: "BlockPool"):
+    """Yield `data` staged into src_pool Blocks in order, chunked by the
+    largest block class.  The single staging path shared by
+    IciEndpoint.send_bytes and TensorStream.write_bytes; caller frees each
+    block once its transfer is dispatched."""
+    view = memoryview(data)
+    chunk = BLOCK_CLASSES[-1]
+    for off in range(0, len(view), chunk):
+        piece = view[off:off + chunk]
+        yield src_pool.alloc(len(piece)).put(piece)
 
 
 _pools: dict[int, BlockPool] = {}

@@ -28,9 +28,23 @@ from brpc_tpu.bvar import Adder, LatencyRecorder
 _send_bytes = Adder("ici_send_bytes")
 _send_count = Adder("ici_send_count")
 _recv_bytes = Adder("ici_recv_bytes")
+_same_device_copies = Adder("ici_same_device_copies")
+_cross_device_moves = Adder("ici_cross_device_moves")
 _transfer_latency = LatencyRecorder("ici_transfer")
 
 DEFAULT_WINDOW_BYTES = 64 * 1024 * 1024
+
+# Compiled HBM->HBM copy for same-device "transfers".  jax forwards
+# unmodified jit outputs to their input buffers, and device_put to the
+# array's own device is a no-op alias — so a loopback send must go through
+# an explicit copy primitive to actually exercise the memory system and
+# yield a distinct destination buffer (the single-chip analog of
+# RdmaEndpoint moving bytes through the NIC even on loopback).
+# jnp.copy lowers to the copy HLO, which XLA may not alias without
+# donation; tests assert unsafe_buffer_pointer() inequality.
+import jax.numpy as _jnp
+
+_device_copy = jax.jit(_jnp.copy)
 
 
 def _collect_batch(q, first):
@@ -112,6 +126,21 @@ class IciEndpoint:
             if stop:
                 return
 
+    def _transfer(self, array: jax.Array) -> jax.Array:
+        """One async transfer to self.device that provably produces a
+        distinct destination buffer.  Cross-device: device_put (a real ICI
+        DMA / host copy).  Same-device loopback: compiled copy kernel —
+        device_put to the source device would alias, moving zero bytes."""
+        try:
+            src = array.devices()
+        except Exception:  # uncommitted / non-jax input
+            src = set()
+        if src == {self.device}:
+            _same_device_copies.add(1)
+            return _device_copy(array)
+        _cross_device_moves.add(1)
+        return jax.device_put(array, self.device)
+
     def send(self, array: jax.Array, timeout_s: float = 30.0) -> jax.Array:
         """Start an async transfer of `array` to this endpoint's device;
         returns the (not-yet-ready) destination array.  Blocks while the
@@ -136,7 +165,7 @@ class IciEndpoint:
                 # the completion queue must mirror device dispatch order,
                 # or the drainer's tail-sync would free window credit for
                 # transfers that are still in flight
-                out = jax.device_put(array, self.device)  # async ICI DMA
+                out = self._transfer(array)  # async ICI DMA / HBM copy
                 self._completions.put((out, nbytes, t0))
         except Exception:
             # release the window reservation or failed sends would shrink
@@ -155,17 +184,62 @@ class IciEndpoint:
         out.block_until_ready()
         return out
 
+    # ------------------------------------------------------------------
+    # Block pipe: BlockPool-staged byte transfers.  The analog of the
+    # reference's RDMA path where IOBuf blocks come from the registered
+    # BlockPool so payloads are born in NIC-visible memory
+    # (rdma/block_pool.cpp:52 wired in at socket.cpp:1751) — here payloads
+    # are staged into HBM arena slots on the source device, DMA'd to the
+    # target device through the windowed send path, and installed into
+    # destination-pool slots without a host bounce.
+    # ------------------------------------------------------------------
+
+    def send_blocks(self, blocks, timeout_s: float = 30.0) -> list:
+        """Transfer each source Block's device buffer to this endpoint's
+        device, installing results into blocks allocated from the target
+        device's pool.  Returns the destination Blocks (caller frees)."""
+        from brpc_tpu.ici.block_pool import get_block_pool
+        dst_pool = get_block_pool(self.device)
+        out = []
+        for b in blocks:
+            moved = self.send(b.view(), timeout_s=timeout_s)
+            # alloc by the transferred buffer's size (not b.used) so the
+            # destination class always covers the source class, even when
+            # either pool has fallen through to a larger class
+            nb = dst_pool.alloc(moved.nbytes)
+            nb.install(moved, b.used, meta=getattr(b, "_src_meta", None))
+            out.append(nb)
+        return out
+
+    def send_bytes(self, data, src_pool, timeout_s: float = 30.0) -> list:
+        """Chunk `data` into blocks from `src_pool` (staged into that
+        device's HBM arena), move them over this endpoint, and return the
+        destination Blocks.  Frees the staging blocks."""
+        from brpc_tpu.ici.block_pool import stage_chunks
+        staged = []
+        try:
+            staged = list(stage_chunks(data, src_pool))
+            return self.send_blocks(staged, timeout_s=timeout_s)
+        finally:
+            for blk in staged:
+                blk.free()
+
     @property
     def inflight_bytes(self) -> int:
         with self._mu:
             return self._inflight
 
-    def close(self) -> None:
+    def close(self, join: bool = True) -> None:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
         if self._drainer is not None:
             self._completions.put(None)
+            if join:
+                # joining matters: a daemon drainer killed at interpreter
+                # exit while inside PJRT block_until_ready aborts the
+                # process ("FATAL: exception not rethrown" on axon)
+                self._drainer.join(timeout=30)
 
 
 def link_stats() -> dict:
@@ -174,6 +248,8 @@ def link_stats() -> dict:
         "send_bytes": _send_bytes.get_value(),
         "send_count": _send_count.get_value(),
         "recv_bytes": _recv_bytes.get_value(),
+        "same_device_copies": _same_device_copies.get_value(),
+        "cross_device_moves": _cross_device_moves.get_value(),
         "transfer_avg_us": round(_transfer_latency.latency(), 1),
         "transfer_p99_us": round(_transfer_latency.latency_percentile(0.99), 1),
         "devices": [str(d) for d in jax.devices()],

@@ -25,6 +25,7 @@ class TensorStream:
         self._consumer = consumer
         self._write_mu = threading.Lock()
         self._q: "queue.Queue" = queue.Queue()
+        self._error: Exception | None = None
         self._closed = threading.Event()
         self._drained = threading.Event()
         self._drainer = threading.Thread(target=self._drain, daemon=True,
@@ -41,7 +42,25 @@ class TensorStream:
             # the drainer's batch tail-sync depends on it (endpoint.py has
             # the same discipline for its completion queue)
             out = self.endpoint.send(array)
-            self._q.put(out)
+            self._q.put(("tensor", out, 0, None))
+
+    def write_bytes(self, data, src_pool=None) -> None:
+        """Stream a byte payload staged through BlockPool slots on the
+        source side (HBM-born, like the reference's pool-allocated IOBuf
+        blocks — block_pool.cpp:52); the consumer receives destination-pool
+        Blocks in order.  Chunking follows the pool's largest class."""
+        if self._closed.is_set():
+            raise RuntimeError("stream closed")
+        from brpc_tpu.ici.block_pool import get_block_pool, stage_chunks
+        src_pool = src_pool or get_block_pool()
+        for blk in stage_chunks(data, src_pool):
+            with self._write_mu:
+                out = self.endpoint.send(blk.view())
+                self._q.put(("block", out, blk.used,
+                             getattr(blk, "_src_meta", None)))
+            # the dispatched transfer holds its own reference to the staged
+            # buffer; the slot can go back to the free list immediately
+            blk.free()
 
     def _drain(self) -> None:
         try:
@@ -60,16 +79,34 @@ class TensorStream:
                 # consumer in order — N tunnel round-trips become 1
                 batch, stop = _collect_batch(self._q, item)
                 try:
-                    batch[-1].block_until_ready()   # ordered completion
+                    batch[-1][1].block_until_ready()   # ordered completion
                 except Exception:
                     # one failed transfer must not kill the drainer or
                     # swallow delivery of the batch's completed chunks
                     import traceback
                     traceback.print_exc()
                 if self._consumer is not None:
-                    for chunk in batch:
+                    for kind, arr, used, meta in batch:
+                        # pipe-side work (dst-pool alloc/install) is NOT
+                        # covered by the consumer-bug guard: its failure
+                        # means data loss and must surface via close()
+                        if kind == "block":
+                            try:
+                                from brpc_tpu.ici.block_pool import \
+                                    get_block_pool
+                                item = get_block_pool(
+                                    self.endpoint.device).alloc(arr.nbytes)
+                                item.install(arr, used, meta=meta)
+                            except Exception as e:
+                                import traceback
+                                traceback.print_exc()
+                                if self._error is None:
+                                    self._error = e
+                                continue
+                        else:
+                            item = arr
                         try:
-                            self._consumer(chunk)
+                            self._consumer(item)
                         except Exception:  # consumer bug must not kill pipe
                             import traceback
                             traceback.print_exc()
@@ -85,3 +122,6 @@ class TensorStream:
         if wait:
             self._drained.wait(30)
         self.endpoint.close()
+        if self._error is not None:
+            raise RuntimeError(
+                "stream dropped data on the pipe side") from self._error

@@ -84,6 +84,90 @@ class TestEndpointAndStream:
         assert len(st["devices"]) == 8
 
 
+class TestRealByteMovement:
+    """VERDICT r1 #1: transfers must provably copy — distinct destination
+    buffers, checksummed end-to-end, BlockPool as the staging allocator
+    (ref: rdma_endpoint.h:82 + socket.cpp:1751-1757, block_pool.cpp:52)."""
+
+    def test_same_device_send_is_a_real_copy(self):
+        dev = jax.devices()[0]
+        ep = IciEndpoint(dev)
+        x = jax.device_put(jnp.arange(4096, dtype=jnp.float32), dev)
+        y = ep.send_sync(x)
+        # loopback must not alias: a distinct destination buffer proves
+        # bytes moved through the memory system
+        assert y.unsafe_buffer_pointer() != x.unsafe_buffer_pointer()
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        ep.close()
+
+    def test_cross_device_send_lands_on_target(self):
+        src, dst = jax.devices()[1], jax.devices()[6]
+        ep = IciEndpoint(dst)
+        x = jax.device_put(jnp.arange(2048, dtype=jnp.int32), src)
+        y = ep.send_sync(x)
+        assert y.devices() == {dst}
+        assert y.unsafe_buffer_pointer() != x.unsafe_buffer_pointer()
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        ep.close()
+
+    def test_byte_pipe_checksum_across_devices(self):
+        import hashlib
+        src_dev, dst_dev = jax.devices()[0], jax.devices()[5]
+        data = np.random.default_rng(7).bytes(5 * 1024 * 1024 + 333)
+        src_pool = get_block_pool(src_dev)
+        before = src_pool.stats()["allocated"]
+        ep = IciEndpoint(dst_dev)
+        dst_blocks = ep.send_bytes(data, src_pool)
+        # staging went through the source pool's HBM slots
+        assert src_pool.stats()["allocated"] > before
+        got = b"".join(b.get() for b in dst_blocks)
+        assert hashlib.sha256(got).digest() == hashlib.sha256(data).digest()
+        assert dst_blocks[0].view().devices() == {dst_dev}
+        for b in dst_blocks:
+            b.free()
+        ep.close()
+
+    def test_block_put_keeps_device_source_on_device(self):
+        dev = jax.devices()[2]
+        pool = get_block_pool(dev)
+        t = jax.device_put(
+            jnp.arange(512, dtype=jnp.float32).reshape(16, 32), dev)
+        blk = pool.alloc(t.nbytes).put(t)
+        assert blk.view().devices() == {dev}
+        back = blk.get_array()
+        assert back.dtype == t.dtype and back.shape == t.shape
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(t))
+        blk.free()
+
+    def test_send_blocks_moves_tensor_with_meta(self):
+        src_dev, dst_dev = jax.devices()[0], jax.devices()[4]
+        pool = get_block_pool(src_dev)
+        t = jax.device_put(jnp.arange(100, dtype=jnp.int16), src_dev)
+        blk = pool.alloc(t.nbytes).put(t)
+        ep = IciEndpoint(dst_dev)
+        moved = ep.send_blocks([blk])
+        out = moved[0].get_array()
+        assert out.devices() == {dst_dev}
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(t))
+        blk.free()
+        moved[0].free()
+        ep.close()
+
+    def test_stream_write_bytes_checksum(self):
+        import hashlib
+        dst_dev = jax.devices()[7]
+        chunks = []
+        ts = TensorStream(dst_dev, consumer=lambda blk: chunks.append(blk))
+        data = np.random.default_rng(11).bytes(3 * 1024 * 1024 + 99)
+        ts.write_bytes(data, src_pool=get_block_pool(jax.devices()[0]))
+        ts.close(wait=True)
+        got = b"".join(b.get() for b in chunks)
+        assert hashlib.sha256(got).digest() == hashlib.sha256(data).digest()
+        for b in chunks:
+            assert b.view().devices() == {dst_dev}
+            b.free()
+
+
 class TestCollective:
     def test_parallel_apply_stack_and_sum(self):
         g = CollectiveGroup()
