@@ -12,15 +12,22 @@ SRC := $(wildcard src/cc/butil/*.cc) \
        $(wildcard src/cc/bthread/*.cc) \
        $(wildcard src/cc/net/*.cc) \
        $(wildcard src/cc/bvar/*.cc) \
-       $(wildcard src/cc/*.cc)
+       $(filter-out src/cc/fastrpc_module.cc,$(wildcard src/cc/*.cc))
 OBJ := $(SRC:.cc=.o)
 DEP := $(OBJ:.o=.d)
 LIB := brpc_tpu/_core/libbrpc_core.so
+# CPython C-extension for the RPC hot boundary (no ctypes marshalling).
+PYEXT := brpc_tpu/_core/_fastrpc.so
+PYINC := $(shell python3-config --includes)
 
-all: $(LIB)
+all: $(LIB) $(PYEXT)
 
 $(LIB): $(OBJ)
 	$(CXX) $(LDFLAGS) -o $@ $(OBJ)
+
+$(PYEXT): src/cc/fastrpc_module.cc $(LIB)
+	$(CXX) $(CXXFLAGS) $(PYINC) -Isrc/cc -shared -o $@ $< \
+	    -Lbrpc_tpu/_core -lbrpc_core -Wl,-rpath,'$$ORIGIN'
 
 # -MMD -MP: auto header dependencies (a struct-layout change in a header
 # must rebuild every TU that includes it, or TUs disagree on offsets).
@@ -30,7 +37,7 @@ $(LIB): $(OBJ)
 -include $(DEP)
 
 clean:
-	rm -f $(OBJ) $(DEP) $(LIB)
+	rm -f $(OBJ) $(DEP) $(LIB) $(PYEXT)
 
 test: $(LIB)
 	python -m pytest tests/ -x -q

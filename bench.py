@@ -106,75 +106,27 @@ def bench_unary_echo(duration_s=2.0, threads=4):
             "p99_us": round(p99, 1), "threads": threads}
 
 
-def bench_native_echo(n_frames=20000, payload_len=128):
-    """Native-service echo: frames never surface to Python on the server."""
+def bench_native_echo(conns=8, inflight=32, total=500_000, payload_len=128):
+    """C++ client pump against the native unary hot path: meta parse,
+    FlatMap method lookup, handler, response pack all in C++ (net/rpc.h,
+    net/bench.cc).  p50/p99 from send-timestamp correlation ids.  Round 1's
+    number timed a Python ctypes write loop — the client, not the server;
+    this measures the framework's actual dispatch path."""
     import ctypes
+    import os
 
-    from brpc_tpu._core import (FAILED_CB, IOBuf, MESSAGE_CB, ACCEPTED_CB,
-                                core, core_init)
+    from brpc_tpu._core import core, core_init
     core_init()
-    keep = _KEEP
-    msg_cb = MESSAGE_CB(lambda *a: None)
-    fail_cb = FAILED_CB(lambda *a: None)
-    acc_cb = ACCEPTED_CB(lambda *a: None)
-    keep += [msg_cb, fail_cb, acc_cb]
-    sid = ctypes.c_uint64()
-    port = ctypes.c_int()
-    rc = core.brpc_listen(b"127.0.0.1", 0, msg_cb, fail_cb, acc_cb, None, 1,
-                          ctypes.byref(sid), ctypes.byref(port))
-    assert rc == 0
-    got = {"n": 0}
-    done = threading.Event()
-
-    @MESSAGE_CB
-    def on_resp(s, kind, meta, meta_len, body, user):
-        IOBuf(handle=body)
-        got["n"] += 1
-        if got["n"] >= n_frames:
-            done.set()
-
-    keep.append(on_resp)
-    cid = ctypes.c_uint64()
-    assert core.brpc_connect(b"127.0.0.1", port.value, on_resp, fail_cb,
-                             None, ctypes.byref(cid)) == 0
-    payload = b"y" * payload_len
-    t0 = time.monotonic()
-    for _ in range(n_frames):
-        core.brpc_socket_write_frame(cid.value, b"m", 1, payload,
-                                     len(payload), None)
-    ok = done.wait(60)
-    wall = time.monotonic() - t0
-    qps = got["n"] / wall if wall > 0 else 0
-
-    # latency phase: strict ping-pong (one in flight) for p50/p99
-    lats = []
-    pong = threading.Event()
-
-    @MESSAGE_CB
-    def on_pong(s, kind, meta, meta_len, body, user):
-        IOBuf(handle=body)
-        pong.set()
-
-    keep.append(on_pong)
-    cid2 = ctypes.c_uint64()
-    assert core.brpc_connect(b"127.0.0.1", port.value, on_pong, fail_cb,
-                             None, ctypes.byref(cid2)) == 0
-    for _ in range(2000):
-        pong.clear()
-        t1 = time.perf_counter()
-        core.brpc_socket_write_frame(cid2.value, b"m", 1, payload,
-                                     len(payload), None)
-        if not pong.wait(5):
-            break
-        lats.append(time.perf_counter() - t1)
-    lats.sort()
-    p50 = round(lats[len(lats) // 2] * 1e6, 1) if lats else None
-    p99 = round(lats[int(len(lats) * 0.99)] * 1e6, 1) if lats else None
-    core.brpc_socket_set_failed(cid2.value, 0)
-    core.brpc_socket_set_failed(cid.value, 0)
-    core.brpc_socket_set_failed(sid.value, 0)
-    return {"qps": round(qps, 1), "frames": got["n"], "completed": ok,
-            "p50_us": p50, "p99_us": p99}
+    qps = ctypes.c_double()
+    p50 = ctypes.c_double()
+    p99 = ctypes.c_double()
+    rc = core.brpc_bench_echo(conns, inflight, total, payload_len, 1,
+                              ctypes.byref(qps), ctypes.byref(p50),
+                              ctypes.byref(p99))
+    return {"qps": round(qps.value, 1), "p50_us": p50.value,
+            "p99_us": p99.value, "conns": conns, "inflight": inflight,
+            "frames": total, "completed": rc == 0,
+            "cpu_cores": os.cpu_count()}
 
 
 def _per_pass_seconds(x, k_small=8, k_large=108, trials=3):

@@ -27,7 +27,8 @@ _libpath = os.path.join(_here, "libbrpc_core.so")
 
 
 def _build_if_needed() -> None:
-    if os.path.exists(_libpath):
+    if os.path.exists(_libpath) and \
+            os.path.exists(os.path.join(_here, "_fastrpc.so")):
         return
     repo = os.path.dirname(os.path.dirname(_here))
     subprocess.run(["make", "-j8"], cwd=repo, check=True,
@@ -50,6 +51,41 @@ ACCEPTED_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_uint64,
                                ctypes.c_void_p)
 TASK_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
 DELETER_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p)
+
+
+class RequestHeader(ctypes.Structure):
+    """Mirror of brpc::RequestHeader (src/cc/net/rpc.h) — a natively
+    pre-parsed TRPC meta.  Pointer fields alias the native meta buffer and
+    are only valid during the callback."""
+    _fields_ = [
+        ("cid", ctypes.c_uint64),
+        ("timeout_ms", ctypes.c_uint32),
+        ("present_mask", ctypes.c_uint32),
+        ("service", ctypes.c_void_p),
+        ("service_len", ctypes.c_uint32),
+        ("method", ctypes.c_void_p),
+        ("method_len", ctypes.c_uint32),
+        ("attempt", ctypes.c_uint16),
+        ("compress", ctypes.c_uint8),
+        ("msg_type", ctypes.c_uint8),
+        ("content_type", ctypes.c_void_p),
+        ("content_type_len", ctypes.c_uint32),
+        ("error_code", ctypes.c_int32),
+        ("error_text", ctypes.c_void_p),
+        ("error_text_len", ctypes.c_uint32),
+        ("attachment_size", ctypes.c_uint64),
+    ]
+
+
+REQUEST_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64,
+                              ctypes.POINTER(RequestHeader), ctypes.c_void_p,
+                              ctypes.c_void_p)
+RESPONSE_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64,
+                               ctypes.POINTER(RequestHeader), ctypes.c_void_p,
+                               ctypes.c_void_p)
+NATIVE_METHOD_FN = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_uint64,
+                                    ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_void_p)
 
 _sigs = {
     "brpc_core_init": (None, [ctypes.c_int, ctypes.c_int]),
@@ -101,6 +137,42 @@ _sigs = {
                                          ctypes.c_char_p, ctypes.c_int,
                                          ctypes.POINTER(ctypes.c_int)]),
     "brpc_socket_active_count": (ctypes.c_int64, []),
+    # native unary RPC hot path
+    "brpc_register_python_method": (None, [ctypes.c_char_p, ctypes.c_char_p]),
+    "brpc_register_native_method": (None, [ctypes.c_char_p, ctypes.c_char_p,
+                                           NATIVE_METHOD_FN, ctypes.c_void_p,
+                                           ctypes.c_int]),
+    "brpc_unregister_method": (ctypes.c_int, [ctypes.c_char_p,
+                                              ctypes.c_char_p]),
+    "brpc_set_request_callback": (None, [REQUEST_CB, ctypes.c_void_p]),
+    "brpc_rpc_counters": (None, [ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.POINTER(ctypes.c_int64)]),
+    "brpc_send_response": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_uint64,
+                                          ctypes.c_uint16, ctypes.c_int32,
+                                          ctypes.c_char_p, ctypes.c_char_p,
+                                          ctypes.c_char_p, ctypes.c_size_t,
+                                          ctypes.c_void_p]),
+    "brpc_send_request": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_uint64,
+                                         ctypes.c_uint16, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_uint32,
+                                         ctypes.c_uint8, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_size_t,
+                                         ctypes.c_void_p]),
+    "brpc_listen_rpc": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_int,
+                                       MESSAGE_CB, FAILED_CB, ACCEPTED_CB,
+                                       ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint64),
+                                       ctypes.POINTER(ctypes.c_int)]),
+    "brpc_connect_rpc": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_int,
+                                        MESSAGE_CB, FAILED_CB, RESPONSE_CB,
+                                        ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_uint64)]),
+    "brpc_bench_echo": (ctypes.c_int, [ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_uint64, ctypes.c_int,
+                                       ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_double),
+                                       ctypes.POINTER(ctypes.c_double),
+                                       ctypes.POINTER(ctypes.c_double)]),
 }
 for _name, (_res, _args) in _sigs.items():
     fn = getattr(core, _name)

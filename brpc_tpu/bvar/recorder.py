@@ -37,22 +37,40 @@ def _bucket_value(i: int) -> float:
 
 
 class Percentile:
-    """Thread-safe log-bucket histogram."""
+    """Log-bucket histogram with per-thread write cells (combiner design,
+    reference detail/combiner.h): adds touch only the caller's own cell —
+    no shared lock on the per-request path — and reads merge cells."""
 
     def __init__(self):
-        self._counts = [0] * _BUCKETS
-        self._n = 0
-        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._cells: list = []
+        self._mu = threading.Lock()  # guards the cell list only
+
+    def _cell(self):
+        c = getattr(self._tls, "c", None)
+        if c is None:
+            c = [0] * (_BUCKETS + 1)  # [-1] slot holds the count
+            self._tls.c = c
+            with self._mu:
+                self._cells.append(c)
+        return c
 
     def add(self, v: float) -> None:
-        i = _bucket_of(v)
-        with self._mu:
-            self._counts[i] += 1
-            self._n += 1
+        c = self._cell()
+        c[_bucket_of(v)] += 1
+        c[_BUCKETS] += 1
 
     def snapshot(self) -> tuple[list[int], int]:
         with self._mu:
-            return list(self._counts), self._n
+            cells = list(self._cells)
+        counts = [0] * _BUCKETS
+        n = 0
+        for c in cells:
+            for i in range(_BUCKETS):
+                if c[i]:
+                    counts[i] += c[i]
+            n += c[_BUCKETS]
+        return counts, n
 
     def get_number(self, ratio: float) -> float:
         counts, n = self.snapshot()

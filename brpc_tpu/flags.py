@@ -39,9 +39,10 @@ def define_flag(name: str, default: Any, help: str = "",
 
 
 def get_flag(name: str, default: Any = None) -> Any:
-    with _mu:
-        f = _flags.get(name)
-        return f.value if f is not None else default
+    # lock-free read: dict.get is GIL-atomic and flag objects are never
+    # removed — this sits on the per-request hot path (rpc_dump gate)
+    f = _flags.get(name)
+    return f.value if f is not None else default
 
 
 def set_flag(name: str, value: Any, *, force: bool = False) -> bool:
@@ -75,6 +76,8 @@ define_flag("max_body_size", 2 * 1024 * 1024 * 1024,
 define_flag("health_check_interval_s", 1.0,
             "Seconds between reconnect probes of broken servers",
             reloadable=True)
-define_flag("rpcz_enabled", True, "Collect per-RPC spans", reloadable=True)
+define_flag("rpcz_enabled", False, "Collect per-RPC spans (off by default "
+            "like FLAGS_enable_rpcz; span objects are only built when on)",
+            reloadable=True)
 define_flag("rpcz_sample_rate", 1.0, "Fraction of spans kept",
             reloadable=True)

@@ -97,9 +97,10 @@ class SocketMap:
         self._closing: set[int] = set()   # deliberate local closes
 
     def _connect(self, ep: EndPoint) -> _ClientConn:
-        sid = Transport.instance().connect(
-            ep.host, ep.port, CallManager.instance().on_message,
-            self._on_socket_failed)
+        mgr = CallManager.instance()
+        sid = Transport.instance().connect_rpc(
+            ep.host, ep.port, mgr.on_message, self._on_socket_failed,
+            on_response=mgr.on_fast_response)
         with self._lock:
             self._sid_to_ep[sid] = ep
         return _ClientConn(sid, ep)
@@ -274,6 +275,27 @@ class CallManager:
             from brpc_tpu.rpc.stream import StreamRegistry
             StreamRegistry.instance().on_frame(sid, meta, body)
 
+    def on_fast_response(self, sid: int, cid: int, attempt: int,
+                         error_code: int, error_text: str, compress: int,
+                         content_type: str, attachment_size: int,
+                         body: bytes) -> None:
+        """Natively pre-parsed response (net/rpc.h via _fastrpc): no
+        Python TLV walk, body already bytes.  Fast metas can only carry
+        cid/attempt/error/compress/content_type/attachment_size — anything
+        richer (streams, tensor headers, user fields) arrives via
+        on_message with a full decode."""
+        meta = M.RpcMeta(
+            msg_type=M.MSG_RESPONSE,
+            correlation_id=cid,
+            attempt=attempt,
+            error_code=error_code,
+            error_text=error_text,
+            compress_type=compress,
+            content_type=content_type,
+            attachment_size=attachment_size,
+        )
+        self._on_response(meta, body)
+
     def _on_response(self, meta: M.RpcMeta, body) -> None:
         with self._lock:
             st = self._pending.get(meta.correlation_id)
@@ -294,7 +316,7 @@ class CallManager:
             return
         # success: decode body
         try:
-            raw = body.to_bytes()
+            raw = body if isinstance(body, bytes) else body.to_bytes()
             att_size = meta.attachment_size
             payload = raw[: len(raw) - att_size] if att_size else raw
             cntl.response_attachment = raw[len(raw) - att_size:] if att_size else b""
@@ -449,7 +471,7 @@ class Channel:
              cntl: Controller | None = None,
              done: Callable[[Controller], None] | None = None,
              serializer: str = "raw", response_serializer: str | None = None,
-             ) -> Controller:
+             _sync_join: bool = False) -> Controller:
         """Issue an RPC.  With done=None this is async-with-join: the
         returned controller has an event; use .join() or call_sync()."""
         import time
@@ -506,9 +528,17 @@ class Channel:
 
         t = Transport.instance()
         if cntl.timeout_ms and cntl.timeout_ms > 0:
-            cid = cntl.correlation_id
-            st.deadline_timer = t.schedule(cntl.timeout_ms / 1e3,
-                                           lambda: mgr.on_deadline(cid))
+            if _sync_join:
+                # call_sync joins immediately: the joining thread IS the
+                # deadline timer (join() computes the remaining budget from
+                # _start_us and fires on_deadline itself) — saves a native
+                # timer arm+cancel per call on the hot path.  Plain call()
+                # users may never join, so they keep the native timer.
+                cntl._sync_deadline = True
+            else:
+                cid = cntl.correlation_id
+                st.deadline_timer = t.schedule(cntl.timeout_ms / 1e3,
+                                               lambda: mgr.on_deadline(cid))
         if cntl.backup_request_ms and cntl.backup_request_ms > 0:
             st.backup_timer = t.schedule(cntl.backup_request_ms / 1e3,
                                          lambda: self._issue_backup(st))
@@ -519,7 +549,7 @@ class Channel:
                   serializer: str = "raw", **kw) -> Any:
         cntl = kw.pop("cntl", None)
         cntl = self.call(service, method_name, request, cntl=cntl,
-                         serializer=serializer, **kw)
+                         serializer=serializer, _sync_join=True, **kw)
         cntl.join()
         cntl.raise_if_failed()
         return cntl.response
@@ -564,7 +594,17 @@ class Channel:
         stream = getattr(cntl, "_stream", None)
         if stream is not None and not stream.connected:
             stream.bind(conn.sid)
-        rc = Transport.instance().write_frame(conn.sid, meta.encode(), st.body)
+        if (not meta.auth and not meta.trace_id and not meta.span_id
+                and not meta.stream_id and not meta.tensor_header
+                and not meta.user_fields and not meta.attachment_size):
+            # simple request: meta packed + framed natively
+            rc = Transport.send_request(
+                conn.sid, meta.correlation_id, meta.attempt, meta.service,
+                meta.method, meta.timeout_ms, meta.compress_type,
+                meta.content_type, st.body)
+        else:
+            rc = Transport.instance().write_frame(conn.sid, meta.encode(),
+                                                  st.body)
         if rc != 0:
             cntl.set_failed(errors.EFAILEDSOCKET, "write failed")
             if self._should_retry(st):

@@ -100,7 +100,15 @@ class Controller:
         if not self.timeout_ms or self.timeout_ms <= 0:
             self._done_event.wait()
             return
-        budget = self.timeout_ms / 1e3 + extra_timeout_s
+        # when the call was issued without a native deadline timer (sync
+        # fast path), this thread enforces the deadline exactly — measured
+        # from ISSUE time, not join time; otherwise leave slack for the
+        # timer to fire first
+        if getattr(self, "_sync_deadline", False):
+            elapsed = time.monotonic() - self._start_us / 1e6
+            budget = max(0.0, self.timeout_ms / 1e3 - elapsed)
+        else:
+            budget = self.timeout_ms / 1e3 + extra_timeout_s
         if not self._done_event.wait(budget):
             # The deadline timer should have fired; complete the call
             # properly (exactly-once, unregisters) instead of mutating a

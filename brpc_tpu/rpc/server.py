@@ -242,8 +242,17 @@ class Server:
                     max_workers=workers,
                     thread_name_prefix=f"svc-tag-{tag}")
         t = Transport.instance()
-        self._listen_sid, self._port = t.listen(
-            addr, port, self._on_message, self._on_conn_failed)
+        self._listen_sid, self._port = t.listen_rpc(
+            addr, port, self._on_message, self._on_conn_failed,
+            on_request=self._on_fast_request)
+        # native method map (FlatMap behind DoublyBufferedData, net/rpc.h):
+        # requests to these methods are meta-parsed and method-matched in
+        # C++ and arrive pre-parsed; everything else (auth/trace/stream
+        # metas, unknown methods, master-service catch-all) still comes
+        # through _on_message with full Python decode
+        for key in self._methods:
+            _native_method_register(key)
+        self._methods_registered = True
         self._started = True
         self._start_time = time.time()
         _register_server(self)
@@ -266,6 +275,8 @@ class Server:
             Transport.instance().close(self._listen_sid)
 
     def join(self) -> None:
+        if not self._started:
+            return  # idempotent: a second join() must not double-unregister
         self._inflight_zero.wait(self.options.graceful_quit_timeout_s)
         with self._conn_mu:
             conns = list(self._connections)
@@ -275,6 +286,10 @@ class Server:
         for pool in self._tag_pools.values():
             pool.shutdown(wait=False)
         self._tag_pools.clear()   # start() recreates from _tag_sizes
+        if getattr(self, "_methods_registered", False):
+            self._methods_registered = False
+            for key in self._methods:
+                _native_method_unregister(key)
         _unregister_server(self)
         self._started = False
 
@@ -387,27 +402,55 @@ class Server:
         except ValueError:
             return
         if meta.msg_type == M.MSG_REQUEST:
-            # sampled traffic capture for rpc_replay (rpc_dump.h:69, §5.5);
-            # the body copy happens only when dumping is on
-            from brpc_tpu import flags
-            if flags.get_flag("rpc_dump"):
-                from brpc_tpu.rpc.rpc_dump import RpcDumper
-                RpcDumper.instance().sample(meta_bytes, body.to_bytes())
-            tag = self._service_tags.get(meta.service)
-            pool = self._tag_pools.get(tag) if tag is not None else None
-            if pool is not None:
-                # isolated worker pool for this service (bthread tag);
-                # count the QUEUED request so graceful join() waits for it
-                with self._inflight_mu:
-                    self._inflight += 1
-                    self._inflight_zero.clear()
-                pool.submit(self._process_tagged, sid, meta, body)
-            else:
-                self._process_request(sid, meta, body)
+            self._route_request(sid, meta, body, meta_bytes)
         elif meta.msg_type in (M.MSG_STREAM_DATA, M.MSG_STREAM_FEEDBACK,
                                M.MSG_STREAM_CLOSE):
             from brpc_tpu.rpc.stream import StreamRegistry
             StreamRegistry.instance().on_frame(sid, meta, body)
+
+    def _on_fast_request(self, sid: int, cid: int, attempt: int,
+                         service: str, method_name: str, compress: int,
+                         timeout_ms: int, content_type: str,
+                         attachment_size: int, body: bytes) -> None:
+        """Natively pre-parsed request (net/rpc.h fast path via _fastrpc):
+        the meta TLV walk, method lookup and frame cut all happened in C++;
+        only the handler body and response serialization run in Python."""
+        self._track_conn(sid)
+        meta = M.RpcMeta(
+            msg_type=M.MSG_REQUEST,
+            correlation_id=cid,
+            attempt=attempt,
+            service=service,
+            method=method_name,
+            compress_type=compress,
+            timeout_ms=timeout_ms,
+            content_type=content_type,
+            attachment_size=attachment_size,
+        )
+        self._route_request(sid, meta, body, None)
+
+    def _route_request(self, sid: int, meta: M.RpcMeta, body,
+                       meta_bytes: bytes | None) -> None:
+        # sampled traffic capture for rpc_replay (rpc_dump.h:69, §5.5);
+        # the body copy (and the fast path's meta re-encode) happen only
+        # when dumping is on
+        from brpc_tpu import flags
+        if flags.get_flag("rpc_dump"):
+            from brpc_tpu.rpc.rpc_dump import RpcDumper
+            RpcDumper.instance().sample(
+                meta_bytes or meta.encode(),
+                body if isinstance(body, bytes) else body.to_bytes())
+        tag = self._service_tags.get(meta.service)
+        pool = self._tag_pools.get(tag) if tag is not None else None
+        if pool is not None:
+            # isolated worker pool for this service (bthread tag);
+            # count the QUEUED request so graceful join() waits for it
+            with self._inflight_mu:
+                self._inflight += 1
+                self._inflight_zero.clear()
+            pool.submit(self._process_tagged, sid, meta, body)
+        else:
+            self._process_request(sid, meta, body)
 
     def _process_tagged(self, sid: int, meta: M.RpcMeta, body) -> None:
         try:
@@ -422,11 +465,9 @@ class Server:
 
     def _respond_error(self, sid: int, meta: M.RpcMeta, code: int,
                        text: str = "") -> None:
-        resp = M.RpcMeta(msg_type=M.MSG_RESPONSE,
-                         correlation_id=meta.correlation_id,
-                         attempt=meta.attempt, error_code=code,
-                         error_text=text or errors.describe(code))
-        Transport.instance().write_frame(sid, resp.encode())
+        # error responses carry only cid/attempt/error TLVs: pack natively
+        Transport.send_response(sid, meta.correlation_id, meta.attempt,
+                                code, text or errors.describe(code), "", b"")
 
     def _process_request(self, sid: int, meta: M.RpcMeta, body,
                          pre_accepted: bool = False) -> None:
@@ -496,7 +537,9 @@ class Server:
         cntl.span_id = span.span_id
         error_code = 0
         try:
-            raw = body.to_bytes()
+            # fast-path bodies arrive as bytes (converted C-side); the
+            # generic path hands an IOBuf
+            raw = body if isinstance(body, bytes) else body.to_bytes()
             att = meta.attachment_size
             payload = raw[: len(raw) - att] if att else raw
             cntl.request_attachment = raw[len(raw) - att:] if att else b""
@@ -520,25 +563,36 @@ class Server:
                 res_ser = spec.response_serializer
                 rbody, theader = res_ser.encode(response)
                 rbody = compress(rbody, meta.compress_type)
-                resp = M.RpcMeta(msg_type=M.MSG_RESPONSE,
-                                 correlation_id=meta.correlation_id,
-                                 attempt=meta.attempt,
-                                 compress_type=meta.compress_type,
-                                 content_type=res_ser.name,
-                                 tensor_header=theader,
-                                 trace_id=span.trace_id,
-                                 span_id=span.span_id)
-                if cntl._stream is not None:
-                    # tell the client our local stream id + window size
-                    # (StreamSettings exchange in the reference)
-                    resp.stream_id = cntl._stream.stream_id
-                    resp.user_fields["sbuf"] = \
-                        str(cntl._stream.max_buf_size)
-                if cntl.response_attachment:
-                    resp.attachment_size = len(cntl.response_attachment)
-                    rbody = rbody + cntl.response_attachment
-                span.response_size = len(rbody)
-                Transport.instance().write_frame(sid, resp.encode(), rbody)
+                if (cntl._stream is None and not cntl.response_attachment
+                        and not theader and not meta.compress_type
+                        and not span.trace_id):
+                    # plain response: cid/attempt/content_type only — pack
+                    # the meta and frame natively (PackResponseFrame)
+                    span.response_size = len(rbody)
+                    Transport.send_response(
+                        sid, meta.correlation_id, meta.attempt, 0, "",
+                        res_ser.name, rbody)
+                else:
+                    resp = M.RpcMeta(msg_type=M.MSG_RESPONSE,
+                                     correlation_id=meta.correlation_id,
+                                     attempt=meta.attempt,
+                                     compress_type=meta.compress_type,
+                                     content_type=res_ser.name,
+                                     tensor_header=theader,
+                                     trace_id=span.trace_id,
+                                     span_id=span.span_id)
+                    if cntl._stream is not None:
+                        # tell the client our local stream id + window size
+                        # (StreamSettings exchange in the reference)
+                        resp.stream_id = cntl._stream.stream_id
+                        resp.user_fields["sbuf"] = \
+                            str(cntl._stream.max_buf_size)
+                    if cntl.response_attachment:
+                        resp.attachment_size = len(cntl.response_attachment)
+                        rbody = rbody + cntl.response_attachment
+                    span.response_size = len(rbody)
+                    Transport.instance().write_frame(sid, resp.encode(),
+                                                     rbody)
         except Exception as e:
             error_code = errors.EINTERNAL
             self._respond_error(sid, meta, errors.EINTERNAL,
@@ -763,6 +817,29 @@ class Server:
 
 _servers: list[Server] = []
 _servers_mu = threading.Lock()
+
+# process-wide refcounts for the native method registry (several servers
+# may expose the same (service, method); the registry is global)
+_native_reg: dict[tuple[str, str], int] = {}
+_native_reg_mu = threading.Lock()
+
+
+def _native_method_register(key: tuple[str, str]) -> None:
+    with _native_reg_mu:
+        n = _native_reg.get(key, 0)
+        _native_reg[key] = n + 1
+        if n == 0:
+            Transport.register_python_method(*key)
+
+
+def _native_method_unregister(key: tuple[str, str]) -> None:
+    with _native_reg_mu:
+        n = _native_reg.get(key, 0)
+        if n <= 1:
+            _native_reg.pop(key, None)
+            Transport.unregister_method(*key)
+        else:
+            _native_reg[key] = n - 1
 
 
 def _register_server(s: Server) -> None:

@@ -15,7 +15,9 @@ from typing import Callable, Optional
 from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, IOBuf, MESSAGE_CB,
                             MSG_H2, MSG_HTTP, MSG_MEMCACHE, MSG_MONGO,
                             MSG_NSHEAD, MSG_RAW, MSG_REDIS, MSG_THRIFT,
-                            MSG_TRPC, TASK_CB, core, core_init)
+                            MSG_TRPC, REQUEST_CB, RESPONSE_CB, TASK_CB, core,
+                            core_init)
+from brpc_tpu._core import _fastrpc
 
 
 class Transport:
@@ -35,6 +37,10 @@ class Transport:
         # sid -> (on_message(sid, kind, meta_bytes, body: IOBuf),
         #         on_failed(sid, err))
         self._handlers: dict[int, tuple[Callable, Callable]] = {}
+        # sid -> fast-path handlers (natively pre-parsed metas)
+        self._request_handlers: dict[int, Callable] = {}
+        self._response_handlers: dict[int, Callable] = {}
+        self._request_cb_installed = False
         self._timer_lock = threading.Lock()
         self._timer_cbs: dict[int, Callable[[], None]] = {}
         self._timer_token = 1
@@ -56,6 +62,8 @@ class Transport:
         def _on_failed(sid, err, user):
             with self._lock:
                 h = self._handlers.pop(sid, None)
+                self._request_handlers.pop(sid, None)
+                self._response_handlers.pop(sid, None)
             if h is not None and h[1] is not None:
                 try:
                     h[1](sid, err)
@@ -70,6 +78,36 @@ class Transport:
                 # Accepted connections inherit the listener's handlers.
                 with self._lock:
                     self._handlers[conn] = h
+            rh = self._request_handlers.get(listener)
+            if rh is not None:
+                with self._lock:
+                    self._request_handlers[conn] = rh
+
+        # fast-path dispatchers (_fastrpc C extension: natively pre-parsed
+        # metas arrive as flat args with the body already a bytes object)
+        def _on_request(sid, cid, attempt, service, method_, compress,
+                        timeout_ms, content_type, attachment_size, body):
+            h = self._request_handlers.get(sid)
+            if h is not None:
+                try:
+                    h(sid, cid, attempt, service, method_, compress,
+                      timeout_ms, content_type, attachment_size, body)
+                except Exception:  # pragma: no cover - handler bug guard
+                    import traceback
+                    traceback.print_exc()
+
+        def _on_response(sid, cid, attempt, error_code, error_text, compress,
+                         content_type, attachment_size, body):
+            h = self._response_handlers.get(sid)
+            if h is not None:
+                try:
+                    h(sid, cid, attempt, error_code, error_text, compress,
+                      content_type, attachment_size, body)
+                except Exception:  # pragma: no cover
+                    import traceback
+                    traceback.print_exc()
+
+        _fastrpc.set_response_handler(_on_response)
 
         @TASK_CB
         def _on_timer(arg):
@@ -87,6 +125,8 @@ class Transport:
         self._cb_failed = _on_failed
         self._cb_accepted = _on_accepted
         self._cb_timer = _on_timer
+        self._cb_request = _on_request
+        self._cb_response = _on_response
 
     # ---- sockets ----
 
@@ -113,6 +153,71 @@ class Transport:
         with self._lock:
             self._handlers[sid.value] = (on_message, on_failed)
         return sid.value
+
+    def listen_rpc(self, addr: str, port: int, on_message, on_failed=None,
+                   on_request=None) -> tuple[int, int]:
+        """Listen with the native unary fast path enabled: TRPC requests
+        whose meta parses cleanly and whose method is registered
+        (register_python_method) arrive pre-parsed at on_request(sid, hdr,
+        body); everything else falls back to on_message."""
+        if on_request is not None and not self._request_cb_installed:
+            _fastrpc.set_request_handler(self._cb_request)
+            self._request_cb_installed = True
+        sid = ctypes.c_uint64()
+        bound = ctypes.c_int()
+        rc = core.brpc_listen_rpc(addr.encode(), port, self._cb_message,
+                                  self._cb_failed, self._cb_accepted, None,
+                                  ctypes.byref(sid), ctypes.byref(bound))
+        if rc != 0:
+            raise OSError(f"listen on {addr}:{port} failed")
+        with self._lock:
+            self._handlers[sid.value] = (on_message, on_failed)
+            if on_request is not None:
+                self._request_handlers[sid.value] = on_request
+        return sid.value, bound.value
+
+    def connect_rpc(self, host: str, port: int, on_message, on_failed=None,
+                    on_response=None) -> int:
+        """Connect with the pre-parsed response fast path (the C response
+        trampoline from _fastrpc — zero ctypes on the per-response path)."""
+        sid = ctypes.c_uint64()
+        rc = core.brpc_connect_rpc(
+            host.encode(), port, self._cb_message, self._cb_failed,
+            ctypes.cast(_fastrpc.response_cb_ptr(), RESPONSE_CB), None,
+            ctypes.byref(sid))
+        if rc != 0:
+            raise ConnectionError(f"connect to {host}:{port} failed")
+        with self._lock:
+            self._handlers[sid.value] = (on_message, on_failed)
+            if on_response is not None:
+                self._response_handlers[sid.value] = on_response
+        return sid.value
+
+    @staticmethod
+    def register_python_method(service: str, method: str) -> None:
+        core.brpc_register_python_method(service.encode(), method.encode())
+
+    @staticmethod
+    def unregister_method(service: str, method: str) -> None:
+        core.brpc_unregister_method(service.encode(), method.encode())
+
+    @staticmethod
+    def send_request(sid: int, cid: int, attempt: int, service: str,
+                     method: str, timeout_ms: int, compress: int,
+                     content_type: str, body: bytes) -> int:
+        """Pack + write a TRPC request frame natively (no Python meta
+        encode, no ctypes marshalling)."""
+        return _fastrpc.send_request(sid, cid, attempt, service, method,
+                                     timeout_ms or 0, compress, content_type,
+                                     body)
+
+    @staticmethod
+    def send_response(sid: int, cid: int, attempt: int, error_code: int,
+                      error_text: str, content_type: str,
+                      body: bytes) -> int:
+        return _fastrpc.send_response(sid, cid, attempt, error_code,
+                                      error_text or "", content_type or "",
+                                      body)
 
     def write_frame(self, sid: int, meta: bytes, body: bytes = b"",
                     body_iobuf: IOBuf | None = None) -> int:

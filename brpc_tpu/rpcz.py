@@ -23,7 +23,11 @@ _span_counter = itertools.count(1)
 _COLLECT_MAX = 2048
 _collected: deque = deque(maxlen=_COLLECT_MAX)
 _collect_lock = threading.Lock()
-_enabled = True
+# Off by default, like the reference's FLAGS_enable_rpcz: span objects are
+# only materialized when tracing is on; the hot path otherwise touches a
+# shared null span (absorbs writes, reads as zeros).  Enable via
+# set_enabled(True) or the reloadable `rpcz_enabled` flag (/flags).
+_enabled = False
 _sample_rate = 1.0   # 1.0 = keep all (rate-limit knob for hot servers)
 
 
@@ -57,12 +61,43 @@ class Span:
         self.annotations.append((int(time.time() * 1e6), msg))
 
 
+class _NullSpan:
+    """Stand-in when rpcz is off: absorbs attribute writes, reads as
+    zeros/empties.  One shared instance; never collected."""
+    __slots__ = ()
+    trace_id = 0
+    span_id = 0
+    parent_span_id = 0
+    start_us = 0
+    end_us = 0
+    request_size = 0
+    response_size = 0
+    error_code = 0
+    latency_us = 0
+    service = ""
+    method = ""
+    remote_side = ""
+    kind = ""
+    annotations = ()
+
+    def __setattr__(self, k, v):
+        pass
+
+    def annotate(self, msg):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
 def now_us() -> int:
     return int(time.time() * 1e6)
 
 
 def new_span(kind: str, service: str = "", method: str = "",
              trace_id: int = 0, parent_span_id: int = 0) -> Span:
+    if not _enabled:
+        return NULL_SPAN
     s = Span(kind=kind, service=service, method=method,
              trace_id=trace_id or random.getrandbits(63),
              span_id=next(_span_counter),
@@ -82,13 +117,13 @@ def current_trace() -> tuple[int, int]:
     """(trace_id, parent_span_id) to stamp on an outgoing request: inherits
     the server span when calling inside a handler (cascaded RPC)."""
     s = get_current_span()
-    if s is None:
+    if s is None or not s.trace_id:
         return 0, 0
     return s.trace_id, s.span_id
 
 
 def submit(span: Span) -> None:
-    if not _enabled:
+    if not _enabled or span is NULL_SPAN:
         return
     if _sample_rate < 1.0 and random.random() > _sample_rate:
         return

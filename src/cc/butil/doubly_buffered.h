@@ -1,0 +1,104 @@
+// DoublyBufferedData — fg/bg double buffer with near-lock-free reads.
+//
+// The reference's butil/containers/doubly_buffered_data.h:38-75 design:
+// every reader thread owns a thread-local mutex it locks around a read of
+// the foreground copy (uncontended in steady state — one CAS each way);
+// a writer mutates the background copy, flips the index, then serially
+// acquires and releases every reader's mutex — after that no reader can
+// still be inside the old foreground — and finally applies the same
+// mutation to the (new) background so both copies converge.  Backs every
+// hot read-mostly registry (load-balancer server lists, the native method
+// map).
+#pragma once
+
+#include <pthread.h>
+
+#include <atomic>
+#include <mutex>
+#include <vector>
+
+namespace butil {
+
+template <typename T>
+class DoublyBufferedData {
+ public:
+  class ScopedPtr {
+   public:
+    ScopedPtr() = default;
+    ~ScopedPtr() {
+      if (_mu != nullptr) _mu->unlock();
+    }
+    ScopedPtr(const ScopedPtr&) = delete;
+    ScopedPtr& operator=(const ScopedPtr&) = delete;
+    const T* get() const { return _data; }
+    const T& operator*() const { return *_data; }
+    const T* operator->() const { return _data; }
+
+   private:
+    friend class DoublyBufferedData;
+    const T* _data = nullptr;
+    std::mutex* _mu = nullptr;
+  };
+
+  DoublyBufferedData() { pthread_key_create(&_tls_key, nullptr); }
+  ~DoublyBufferedData() {
+    pthread_key_delete(_tls_key);
+    for (Wrapper* w : _wrappers) delete w;
+  }
+
+  // Acquire a read handle to the foreground copy.  The handle holds this
+  // thread's own mutex; destroy it promptly.
+  void Read(ScopedPtr* out) {
+    Wrapper* w = tls_wrapper();
+    w->mu.lock();
+    out->_data = &_data[_index.load(std::memory_order_acquire)];
+    out->_mu = &w->mu;
+  }
+
+  // Apply fn to both copies with the flip protocol.  fn(T&) -> bool
+  // (false = no change, skip the flip).  Serialized across writers.
+  template <typename Fn>
+  bool Modify(Fn&& fn) {
+    std::lock_guard<std::mutex> lk(_modify_mu);
+    const int bg = 1 - _index.load(std::memory_order_relaxed);
+    if (!fn(_data[bg])) return false;
+    _index.store(bg, std::memory_order_release);
+    {
+      // wait out readers still holding the old foreground
+      std::lock_guard<std::mutex> wk(_wrappers_mu);
+      for (Wrapper* w : _wrappers) {
+        w->mu.lock();
+        w->mu.unlock();
+      }
+    }
+    fn(_data[1 - bg]);  // converge the other copy (now background)
+    return true;
+  }
+
+ private:
+  struct Wrapper {
+    std::mutex mu;
+  };
+
+  Wrapper* tls_wrapper() {
+    auto* w = static_cast<Wrapper*>(pthread_getspecific(_tls_key));
+    if (w == nullptr) {
+      w = new Wrapper;
+      pthread_setspecific(_tls_key, w);
+      std::lock_guard<std::mutex> lk(_wrappers_mu);
+      _wrappers.push_back(w);
+    }
+    return w;
+  }
+
+  T _data[2];
+  std::atomic<int> _index{0};
+  pthread_key_t _tls_key;
+  std::mutex _modify_mu;
+  std::mutex _wrappers_mu;
+  // wrappers live until the map dies; threads that exit leave their
+  // wrapper behind (same tradeoff as the reference)
+  std::vector<Wrapper*> _wrappers;
+};
+
+}  // namespace butil

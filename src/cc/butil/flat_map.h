@@ -1,0 +1,139 @@
+// FlatMap — open-addressing (linear-probe) hash map.
+//
+// Role of the reference's butil/containers/flat_map.h: the lookup structure
+// behind the server's service/method maps (reference server.h:399,432).
+// Power-of-two capacity, backward-shift deletion (no tombstones), resize at
+// ~70% load.  Not thread-safe — writers wrap it in DoublyBufferedData.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <utility>
+#include <vector>
+
+namespace butil {
+
+template <typename K, typename V, typename Hash = std::hash<K>,
+          typename Eq = std::equal_to<K>>
+class FlatMap {
+ public:
+  explicit FlatMap(size_t initial_cap = 16) { rehash(pow2_at_least(initial_cap)); }
+
+  // Insert or overwrite.
+  template <typename KeyT>
+  void insert(KeyT&& key, V value) {
+    if ((_size + 1) * 10 >= _buckets.size() * 7) rehash(_buckets.size() * 2);
+    const size_t mask = _buckets.size() - 1;
+    size_t i = _hash(key) & mask;
+    while (true) {
+      Bucket& b = _buckets[i];
+      if (!b.used) {
+        b.used = true;
+        b.kv.first = std::forward<KeyT>(key);
+        b.kv.second = std::move(value);
+        ++_size;
+        return;
+      }
+      if (_eq(b.kv.first, key)) {
+        b.kv.second = std::move(value);
+        return;
+      }
+      i = (i + 1) & mask;
+    }
+  }
+
+  // Heterogeneous lookup: LookupT only needs Hash(LookupT) and
+  // Eq(K, LookupT) — lets string maps be probed with string_view without
+  // allocating.
+  template <typename LookupT>
+  const V* seek(const LookupT& key) const {
+    const size_t mask = _buckets.size() - 1;
+    size_t i = _hash(key) & mask;
+    while (true) {
+      const Bucket& b = _buckets[i];
+      if (!b.used) return nullptr;
+      if (_eq(b.kv.first, key)) return &b.kv.second;
+      i = (i + 1) & mask;
+    }
+  }
+
+  template <typename LookupT>
+  V* seek(const LookupT& key) {
+    return const_cast<V*>(static_cast<const FlatMap*>(this)->seek(key));
+  }
+
+  // Backward-shift deletion keeps probe chains contiguous without
+  // tombstones.  Returns true if the key existed.
+  template <typename LookupT>
+  bool erase(const LookupT& key) {
+    const size_t mask = _buckets.size() - 1;
+    size_t i = _hash(key) & mask;
+    while (true) {
+      Bucket& b = _buckets[i];
+      if (!b.used) return false;
+      if (_eq(b.kv.first, key)) break;
+      i = (i + 1) & mask;
+    }
+    size_t hole = i;
+    while (true) {
+      i = (i + 1) & mask;
+      Bucket& b = _buckets[i];
+      if (!b.used) break;
+      const size_t home = _hash(b.kv.first) & mask;
+      // can b legally move into the hole? (its home must not lie strictly
+      // between hole and current slot in probe order)
+      const size_t dist_home = (i - home) & mask;
+      const size_t dist_hole = (i - hole) & mask;
+      if (dist_home >= dist_hole) {
+        _buckets[hole].kv = std::move(b.kv);
+        hole = i;
+      }
+    }
+    _buckets[hole].used = false;
+    _buckets[hole].kv = {};
+    --_size;
+    return true;
+  }
+
+  size_t size() const { return _size; }
+  bool empty() const { return _size == 0; }
+  void clear() {
+    for (auto& b : _buckets) { b.used = false; b.kv = {}; }
+    _size = 0;
+  }
+
+  // Iterate all entries: fn(const K&, const V&).
+  template <typename Fn>
+  void for_each(Fn&& fn) const {
+    for (const auto& b : _buckets)
+      if (b.used) fn(b.kv.first, b.kv.second);
+  }
+
+ private:
+  struct Bucket {
+    bool used = false;
+    std::pair<K, V> kv;
+  };
+
+  static size_t pow2_at_least(size_t n) {
+    size_t c = 16;
+    while (c < n) c <<= 1;
+    return c;
+  }
+
+  void rehash(size_t new_cap) {
+    std::vector<Bucket> old = std::move(_buckets);
+    _buckets.assign(new_cap, Bucket{});
+    _size = 0;
+    for (auto& b : old)
+      if (b.used) insert(std::move(b.kv.first), std::move(b.kv.second));
+  }
+
+  std::vector<Bucket> _buckets;
+  size_t _size = 0;
+  Hash _hash;
+  Eq _eq;
+};
+
+}  // namespace butil

@@ -187,4 +187,102 @@ int brpc_socket_stats(uint64_t sid, int64_t* nread, int64_t* nwritten,
 
 int64_t brpc_socket_active_count() { return brpc::Socket::active_count(); }
 
+// ---- native unary RPC hot path (net/rpc.h) ----
+
+// ctypes mirrors brpc::RequestHeader field-for-field (lib.py RequestHeader).
+typedef void (*brpc_request_cb)(uint64_t sid, const brpc::RequestHeader* hdr,
+                                void* body_iobuf, void* user);
+typedef void (*brpc_response_cb)(uint64_t sid, const brpc::RequestHeader* hdr,
+                                 void* body_iobuf, void* user);
+
+void brpc_register_python_method(const char* service, const char* method) {
+  brpc::MethodRegistry::global()->RegisterPython(service, method);
+}
+
+typedef int32_t (*brpc_native_method_fn)(uint64_t sid, void* body_iobuf,
+                                         void* resp_iobuf, void* user);
+
+void brpc_register_native_method(const char* service, const char* method,
+                                 brpc_native_method_fn fn, void* user,
+                                 int inline_run) {
+  brpc::MethodRegistry::global()->Register(
+      service, method, (brpc::NativeMethodFn)fn, user, inline_run != 0);
+}
+
+int brpc_unregister_method(const char* service, const char* method) {
+  return brpc::MethodRegistry::global()->Unregister(service, method) ? 0 : -1;
+}
+
+void brpc_set_request_callback(brpc_request_cb cb, void* user) {
+  brpc::SetRequestCallback((brpc::RequestCallback)cb, user);
+}
+
+void brpc_rpc_counters(int64_t* native_calls, int64_t* python_fast_calls) {
+  if (native_calls)
+    *native_calls = brpc::MethodRegistry::global()->native_calls();
+  if (python_fast_calls)
+    *python_fast_calls = brpc::MethodRegistry::global()->python_fast_calls();
+}
+
+// Pack + write a TRPC response frame natively (server -> client).
+int brpc_send_response(uint64_t sid, uint64_t cid, uint16_t attempt,
+                       int32_t error_code, const char* error_text,
+                       const char* content_type, const void* body,
+                       size_t body_len, void* body_iobuf) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  IOBuf b;
+  if (body_iobuf != nullptr) b.append(std::move(*(IOBuf*)body_iobuf));
+  else if (body != nullptr && body_len > 0) b.append(body, body_len);
+  IOBuf frame;
+  brpc::PackResponseFrame(&frame, cid, attempt, error_code,
+                          error_text, error_text ? strlen(error_text) : 0,
+                          content_type, content_type ? strlen(content_type) : 0,
+                          std::move(b));
+  const int rc = s->Write(std::move(frame));
+  s->Dereference();
+  return rc;
+}
+
+// Pack + write a TRPC request frame natively (client -> server).
+int brpc_send_request(uint64_t sid, uint64_t cid, uint16_t attempt,
+                      const char* service, const char* method,
+                      uint32_t timeout_ms, uint8_t compress,
+                      const char* content_type, const void* body,
+                      size_t body_len, void* body_iobuf) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  IOBuf b;
+  if (body_iobuf != nullptr) b.append(std::move(*(IOBuf*)body_iobuf));
+  else if (body != nullptr && body_len > 0) b.append(body, body_len);
+  IOBuf frame;
+  brpc::PackRequestFrame(&frame, cid, attempt, service, strlen(service),
+                         method, strlen(method), timeout_ms, compress,
+                         content_type, content_type ? strlen(content_type) : 0,
+                         std::move(b));
+  const int rc = s->Write(std::move(frame));
+  s->Dereference();
+  return rc;
+}
+
+// Listen with native request dispatch enabled (method registry consulted
+// before the generic on_message callback).
+int brpc_listen_rpc(const char* addr, int port, brpc_message_cb on_msg,
+                    brpc_failed_cb on_fail, brpc_accepted_cb on_accept,
+                    void* user, uint64_t* sid_out, int* bound_port) {
+  brpc::SocketOptions o = make_opts(on_msg, on_fail, on_accept, user, 0);
+  o.enable_rpc_dispatch = true;
+  return brpc::Listen(addr, port, o, sid_out, bound_port);
+}
+
+// Connect with a pre-parsed response fast path.
+int brpc_connect_rpc(const char* host, int port, brpc_message_cb on_msg,
+                     brpc_failed_cb on_fail, brpc_response_cb on_resp,
+                     void* user, uint64_t* sid_out) {
+  brpc::SocketOptions o = make_opts(on_msg, on_fail, nullptr, user, 0);
+  o.on_response = (brpc::ResponseCallback)on_resp;
+  o.response_user = user;
+  return brpc::Connect(host, port, o, sid_out);
+}
+
 }  // extern "C"

@@ -1,0 +1,199 @@
+// _fastrpc — CPython C-extension for the RPC hot boundary.
+//
+// ctypes marshalling costs ~10-20us per crossing (measured via cProfile:
+// send_request alone ~20us tottime) and CFUNCTYPE trampolines are similar
+// on the way back — at ~170us/request end-to-end that is the single
+// largest removable cost.  This module replaces the hot crossings with
+// direct C API calls: request/response frames are packed and written in
+// one call, and natively pre-parsed requests/responses are delivered to
+// Python as plain argument tuples (strings + bytes), with the IOBuf
+// consumed C-side.  The ctypes surface (lib.py) remains for everything
+// cold (listen/connect, timers, stats, streams).
+//
+// Reference analog: the generated pb stub layer sitting directly on the
+// C++ core (baidu_rpc_protocol.cpp pack/process), with no FFI toll booth.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+
+#include "butil/iobuf.h"
+#include "net/rpc.h"
+#include "net/socket.h"
+
+namespace {
+
+PyObject* g_request_handler = nullptr;   // called with 10-tuple args
+PyObject* g_response_handler = nullptr;  // called with 9-tuple args
+
+PyObject* iobuf_steal_bytes(butil::IOBuf* b) {
+  const size_t n = b->size();
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)n);
+  if (out == nullptr) return nullptr;
+  b->copy_to(PyBytes_AS_STRING(out), n, 0);
+  return out;
+}
+
+// ---- native -> Python trampolines (run on executor/dispatcher threads) ----
+
+void fast_request_cb(brpc::SocketId sid, const brpc::RequestHeader* hdr,
+                     butil::IOBuf* body, void* /*user*/) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* handler = g_request_handler;
+  if (handler != nullptr) {
+    PyObject* payload = iobuf_steal_bytes(body);
+    delete body;
+    if (payload != nullptr) {
+      PyObject* r = PyObject_CallFunction(
+          handler, "KKHs#s#BIs#KN", (unsigned long long)sid,
+          (unsigned long long)hdr->cid, (unsigned short)hdr->attempt,
+          hdr->service ? hdr->service : "", (Py_ssize_t)hdr->service_len,
+          hdr->method ? hdr->method : "", (Py_ssize_t)hdr->method_len,
+          hdr->compress, hdr->timeout_ms,
+          hdr->content_type ? hdr->content_type : "",
+          (Py_ssize_t)hdr->content_type_len,
+          (unsigned long long)hdr->attachment_size, payload);
+      if (r == nullptr) PyErr_Print();
+      else Py_DECREF(r);
+    } else {
+      PyErr_Print();
+    }
+  } else {
+    delete body;
+  }
+  PyGILState_Release(g);
+}
+
+void fast_response_cb(brpc::SocketId sid, const brpc::RequestHeader* hdr,
+                      butil::IOBuf* body, void* /*user*/) {
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* handler = g_response_handler;
+  if (handler != nullptr) {
+    PyObject* payload = iobuf_steal_bytes(body);
+    delete body;
+    if (payload != nullptr) {
+      PyObject* r = PyObject_CallFunction(
+          handler, "KKHis#Bs#KN", (unsigned long long)sid,
+          (unsigned long long)hdr->cid, (unsigned short)hdr->attempt,
+          (int)hdr->error_code, hdr->error_text ? hdr->error_text : "",
+          (Py_ssize_t)hdr->error_text_len, hdr->compress,
+          hdr->content_type ? hdr->content_type : "",
+          (Py_ssize_t)hdr->content_type_len,
+          (unsigned long long)hdr->attachment_size, payload);
+      if (r == nullptr) PyErr_Print();
+      else Py_DECREF(r);
+    } else {
+      PyErr_Print();
+    }
+  } else {
+    delete body;
+  }
+  PyGILState_Release(g);
+}
+
+// ---- Python -> native ----
+
+PyObject* py_send_request(PyObject*, PyObject* args) {
+  unsigned long long sid, cid;
+  unsigned short attempt;
+  const char *service, *method, *content_type;
+  Py_ssize_t service_len, method_len, ct_len;
+  unsigned int timeout_ms;
+  unsigned char compress;
+  const char* body;
+  Py_ssize_t body_len;
+  if (!PyArg_ParseTuple(args, "KKHs#s#IBs#y#", &sid, &cid, &attempt, &service,
+                        &service_len, &method, &method_len, &timeout_ms,
+                        &compress, &content_type, &ct_len, &body, &body_len))
+    return nullptr;
+  butil::IOBuf b;
+  if (body_len > 0) b.append(body, (size_t)body_len);
+  butil::IOBuf frame;
+  brpc::PackRequestFrame(&frame, cid, attempt, service, (size_t)service_len,
+                         method, (size_t)method_len, timeout_ms, compress,
+                         content_type, (size_t)ct_len, std::move(b));
+  int rc = -1;
+  Py_BEGIN_ALLOW_THREADS
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s != nullptr) {
+    rc = s->Write(std::move(frame));
+    s->Dereference();
+  }
+  Py_END_ALLOW_THREADS
+  return PyLong_FromLong(rc);
+}
+
+PyObject* py_send_response(PyObject*, PyObject* args) {
+  unsigned long long sid, cid;
+  unsigned short attempt;
+  int error_code;
+  const char *error_text, *content_type;
+  Py_ssize_t et_len, ct_len;
+  const char* body;
+  Py_ssize_t body_len;
+  if (!PyArg_ParseTuple(args, "KKHis#s#y#", &sid, &cid, &attempt, &error_code,
+                        &error_text, &et_len, &content_type, &ct_len, &body,
+                        &body_len))
+    return nullptr;
+  butil::IOBuf b;
+  if (body_len > 0) b.append(body, (size_t)body_len);
+  butil::IOBuf frame;
+  brpc::PackResponseFrame(&frame, cid, attempt, error_code, error_text,
+                          (size_t)et_len, content_type, (size_t)ct_len,
+                          std::move(b));
+  int rc = -1;
+  Py_BEGIN_ALLOW_THREADS
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s != nullptr) {
+    rc = s->Write(std::move(frame));
+    s->Dereference();
+  }
+  Py_END_ALLOW_THREADS
+  return PyLong_FromLong(rc);
+}
+
+PyObject* py_set_request_handler(PyObject*, PyObject* arg) {
+  Py_XINCREF(arg);
+  PyObject* old = g_request_handler;
+  g_request_handler = arg;
+  Py_XDECREF(old);
+  brpc::SetRequestCallback(fast_request_cb, nullptr);
+  Py_RETURN_NONE;
+}
+
+PyObject* py_set_response_handler(PyObject*, PyObject* arg) {
+  Py_XINCREF(arg);
+  PyObject* old = g_response_handler;
+  g_response_handler = arg;
+  Py_XDECREF(old);
+  Py_RETURN_NONE;
+}
+
+// ctypes casts this integer to RESPONSE_CB when calling brpc_connect_rpc,
+// so client sockets get the C trampoline with zero ctypes on the hot path.
+PyObject* py_response_cb_ptr(PyObject*, PyObject*) {
+  return PyLong_FromVoidPtr((void*)fast_response_cb);
+}
+
+PyMethodDef kMethods[] = {
+    {"send_request", py_send_request, METH_VARARGS,
+     "send_request(sid, cid, attempt, service, method, timeout_ms, "
+     "compress, content_type, body) -> rc"},
+    {"send_response", py_send_response, METH_VARARGS,
+     "send_response(sid, cid, attempt, error_code, error_text, "
+     "content_type, body) -> rc"},
+    {"set_request_handler", py_set_request_handler, METH_O,
+     "Install the process-wide pre-parsed request handler."},
+    {"set_response_handler", py_set_response_handler, METH_O,
+     "Install the process-wide pre-parsed response handler."},
+    {"response_cb_ptr", py_response_cb_ptr, METH_NOARGS,
+     "Address of the C response trampoline (for brpc_connect_rpc)."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "_fastrpc",
+                       "Zero-ctypes RPC hot boundary", -1, kMethods};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__fastrpc() { return PyModule_Create(&kModule); }

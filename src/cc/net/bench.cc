@@ -1,0 +1,165 @@
+// In-process loopback echo benchmark: C++ client pump against the native
+// method-registry dispatch path.  The reference measures its hot path the
+// same way — C++ client, C++ server, pipelined connections
+// (docs/cn/benchmark.md methodology; example/multi_threaded_echo_c++).
+// Round 1's "native echo" number timed a Python ctypes write loop, i.e.
+// the client, not the framework.  This pump keeps `inflight` frames per
+// connection in the air, embeds the send timestamp as the correlation id,
+// and computes p50/p99 from response-side timestamps.
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "butil/common.h"
+#include "butil/iobuf.h"
+#include "net/rpc.h"
+#include "net/socket.h"
+
+namespace brpc {
+namespace {
+
+struct BenchState {
+  std::atomic<uint64_t> sent{0};
+  std::atomic<uint64_t> done{0};
+  std::atomic<uint64_t> lat_idx{0};
+  uint64_t total = 0;
+  int payload_len = 0;
+  std::vector<uint32_t> lat_us;  // preallocated, atomically indexed
+  std::mutex mu;
+  std::condition_variable cv;
+  bool finished = false;
+};
+
+int32_t bench_echo_handler(SocketId, butil::IOBuf* body,
+                           butil::IOBuf* resp_body, void*) {
+  resp_body->append(std::move(*body));
+  return 0;
+}
+
+void bench_send_one(SocketId sid, BenchState* st) {
+  butil::IOBuf frame;
+  butil::IOBuf body;
+  static const char kPayload[4096] = {0};
+  body.append(kPayload, st->payload_len);
+  PackRequestFrame(&frame, (uint64_t)butil::monotonic_time_us(), 0, "BenchEcho",
+                   9, "Echo", 4, 0, 0, nullptr, 0, std::move(body));
+  Socket* s = Socket::Address(sid);
+  if (s != nullptr) {
+    s->Write(std::move(frame));
+    s->Dereference();
+  }
+}
+
+void bench_on_response(SocketId sid, const RequestHeader* hdr,
+                       butil::IOBuf* body, void* user) {
+  // body is BORROWED (response_inline mode) — do not free
+  (void)body;
+  auto* st = (BenchState*)user;
+  const uint64_t now = (uint64_t)butil::monotonic_time_us();
+  const uint64_t idx = st->lat_idx.fetch_add(1, std::memory_order_relaxed);
+  if (idx < st->lat_us.size()) {
+    st->lat_us[idx] = (uint32_t)std::min<uint64_t>(now - hdr->cid, 0xffffffff);
+  }
+  // keep the pipe full: claim a send ticket; tickets >= total mean the
+  // pipeline is winding down
+  if (st->sent.fetch_add(1, std::memory_order_relaxed) < st->total) {
+    bench_send_one(sid, st);
+  }
+  const uint64_t d = st->done.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (d >= st->total) {
+    std::lock_guard<std::mutex> lk(st->mu);
+    st->finished = true;
+    st->cv.notify_all();
+  }
+}
+
+void bench_noop_failed(SocketId, int, void*) {}
+
+}  // namespace
+}  // namespace brpc
+
+extern "C" {
+
+// Returns 0 on success.  inline_run selects dispatcher-inline execution of
+// the echo handler (the reference's "last message inline" discipline) vs
+// one executor task per message.
+int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
+                    int inline_run, double* qps_out, double* p50_us,
+                    double* p99_us) {
+  using namespace brpc;
+  if (conns <= 0 || inflight <= 0 || total == 0 || payload_len < 0 ||
+      payload_len > 4096) {
+    return -1;
+  }
+  MethodRegistry::global()->Register("BenchEcho", "Echo", bench_echo_handler,
+                                     nullptr, inline_run != 0);
+  BenchState st;
+  st.total = total;
+  st.payload_len = payload_len;
+  st.lat_us.assign(std::min<uint64_t>(total, 2'000'000), 0);
+
+  SocketOptions server_opts;
+  server_opts.enable_rpc_dispatch = true;
+  SocketId listener = INVALID_SOCKET_ID;
+  int port = 0;
+  if (Listen("127.0.0.1", 0, server_opts, &listener, &port) != 0) return -2;
+
+  std::vector<SocketId> clients;
+  for (int i = 0; i < conns; ++i) {
+    SocketOptions copts;
+    copts.on_response = bench_on_response;
+    copts.response_user = &st;
+    copts.response_inline = true;
+    copts.on_failed = bench_noop_failed;
+    SocketId cid = INVALID_SOCKET_ID;
+    if (Connect("127.0.0.1", port, copts, &cid) != 0) {
+      Socket::SetFailed(listener, 0);
+      return -3;
+    }
+    clients.push_back(cid);
+  }
+
+  const int64_t t0 = butil::monotonic_time_us();
+  // seed the pipeline: `inflight` outstanding frames per connection, each
+  // claiming a ticket exactly like the response path (responses may already
+  // be arriving while we seed)
+  const uint64_t seed_target =
+      std::min<uint64_t>((uint64_t)conns * (uint64_t)inflight, total);
+  for (uint64_t i = 0; i < seed_target; ++i) {
+    if (st.sent.fetch_add(1, std::memory_order_relaxed) < total) {
+      bench_send_one(clients[i % clients.size()], &st);
+    }
+  }
+
+  {
+    std::unique_lock<std::mutex> lk(st.mu);
+    st.cv.wait_for(lk, std::chrono::seconds(120),
+                   [&] { return st.finished; });
+  }
+  const int64_t t1 = butil::monotonic_time_us();
+
+  for (SocketId cid : clients) Socket::SetFailed(cid, 0);
+  Socket::SetFailed(listener, 0);
+  MethodRegistry::global()->Unregister("BenchEcho", "Echo");
+
+  const uint64_t completed = st.done.load();
+  const double wall_s = (t1 - t0) / 1e6;
+  if (qps_out) *qps_out = completed / (wall_s > 0 ? wall_s : 1e-9);
+  const uint64_t n = std::min<uint64_t>(st.lat_idx.load(), st.lat_us.size());
+  if (n > 0) {
+    std::vector<uint32_t> lats(st.lat_us.begin(), st.lat_us.begin() + n);
+    std::sort(lats.begin(), lats.end());
+    if (p50_us) *p50_us = lats[n / 2];
+    if (p99_us) *p99_us = lats[(size_t)(n * 0.99)];
+  } else {
+    if (p50_us) *p50_us = 0;
+    if (p99_us) *p99_us = 0;
+  }
+  return completed >= total ? 0 : -4;
+}
+
+}  // extern "C"

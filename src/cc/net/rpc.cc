@@ -1,0 +1,418 @@
+#include "net/rpc.h"
+
+#include <atomic>
+#include <cstring>
+#include <string>
+#include <string_view>
+
+#include "bthread/executor.h"
+#include "butil/common.h"
+#include "butil/doubly_buffered.h"
+#include "butil/flat_map.h"
+#include "net/parser.h"
+#include "net/socket.h"
+
+namespace brpc {
+
+// ---- meta codec ----
+
+static inline uint16_t rd16(const char* p) {
+  uint16_t v;
+  memcpy(&v, p, 2);
+  return v;  // wire is little-endian, as is every supported host
+}
+static inline uint32_t rd32(const char* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+static inline uint64_t rd64(const char* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+
+bool ParseMeta(const char* p, size_t n, ParsedMeta* out) {
+  if (n < kMetaFixedLen) return false;
+  out->version = (uint8_t)p[0];
+  if (out->version != 1) return false;
+  out->msg_type = (uint8_t)p[1];
+  out->flags = rd16(p + 2);
+  out->cid = rd64(p + 4);
+  out->attempt = rd16(p + 12);
+  size_t off = kMetaFixedLen;
+  while (off + 5 <= n) {
+    const uint8_t tag = (uint8_t)p[off];
+    const uint32_t len = rd32(p + off + 1);
+    off += 5;
+    if (off + len > n) return false;
+    const char* v = p + off;
+    off += len;
+    if (tag < 32) out->present_mask |= (1u << tag);
+    switch (tag) {
+      case TAG_SERVICE:
+        out->service = v;
+        out->service_len = len;
+        break;
+      case TAG_METHOD:
+        out->method = v;
+        out->method_len = len;
+        break;
+      case TAG_ERROR_CODE:
+        if (len == 4) out->error_code = (int32_t)rd32(v);
+        break;
+      case TAG_ERROR_TEXT:
+        out->error_text = v;
+        out->error_text_len = len;
+        break;
+      case TAG_COMPRESS:
+        if (len >= 1) out->compress = (uint8_t)v[0];
+        break;
+      case TAG_ATTACHMENT_SIZE:
+        if (len == 8) out->attachment_size = rd64(v);
+        break;
+      case TAG_TIMEOUT_MS:
+        if (len == 4) out->timeout_ms = rd32(v);
+        break;
+      case TAG_CONTENT_TYPE:
+        out->content_type = v;
+        out->content_type_len = len;
+        break;
+      default:
+        break;  // recorded in present_mask; content skipped
+    }
+  }
+  return off == n || off + 5 > n;  // trailing garbage < one TLV header: ok
+}
+
+static void append_fixed(std::string* meta, uint8_t msg_type, uint64_t cid,
+                         uint16_t attempt) {
+  char fixed[kMetaFixedLen];
+  fixed[0] = 1;  // version
+  fixed[1] = (char)msg_type;
+  fixed[2] = fixed[3] = 0;  // flags
+  memcpy(fixed + 4, &cid, 8);
+  memcpy(fixed + 12, &attempt, 2);
+  meta->append(fixed, sizeof(fixed));
+}
+
+static void append_tlv(std::string* meta, uint8_t tag, const void* v,
+                       uint32_t len) {
+  char hdr[5];
+  hdr[0] = (char)tag;
+  memcpy(hdr + 1, &len, 4);
+  meta->append(hdr, 5);
+  meta->append((const char*)v, len);
+}
+
+void PackResponseFrame(butil::IOBuf* out, uint64_t cid, uint16_t attempt,
+                       int32_t error_code, const char* error_text,
+                       size_t error_text_len, const char* content_type,
+                       size_t content_type_len, butil::IOBuf&& body) {
+  std::string meta;
+  meta.reserve(64);
+  append_fixed(&meta, META_RESPONSE, cid, attempt);
+  if (error_code != 0) append_tlv(&meta, TAG_ERROR_CODE, &error_code, 4);
+  if (error_text_len > 0)
+    append_tlv(&meta, TAG_ERROR_TEXT, error_text, (uint32_t)error_text_len);
+  if (content_type_len > 0)
+    append_tlv(&meta, TAG_CONTENT_TYPE, content_type,
+               (uint32_t)content_type_len);
+  char hdr[kTrpcHeaderLen];
+  make_trpc_header(hdr, (uint32_t)meta.size(), body.size());
+  out->append(hdr, sizeof(hdr));
+  out->append(meta.data(), meta.size());
+  out->append(std::move(body));
+}
+
+void PackRequestFrame(butil::IOBuf* out, uint64_t cid, uint16_t attempt,
+                      const char* service, size_t service_len,
+                      const char* method, size_t method_len,
+                      uint32_t timeout_ms, uint8_t compress,
+                      const char* content_type, size_t content_type_len,
+                      butil::IOBuf&& body) {
+  std::string meta;
+  meta.reserve(64 + service_len + method_len);
+  append_fixed(&meta, META_REQUEST, cid, attempt);
+  if (service_len > 0)
+    append_tlv(&meta, TAG_SERVICE, service, (uint32_t)service_len);
+  if (method_len > 0)
+    append_tlv(&meta, TAG_METHOD, method, (uint32_t)method_len);
+  if (compress != 0) append_tlv(&meta, TAG_COMPRESS, &compress, 1);
+  if (timeout_ms != 0) append_tlv(&meta, TAG_TIMEOUT_MS, &timeout_ms, 4);
+  if (content_type_len > 0)
+    append_tlv(&meta, TAG_CONTENT_TYPE, content_type,
+               (uint32_t)content_type_len);
+  char hdr[kTrpcHeaderLen];
+  make_trpc_header(hdr, (uint32_t)meta.size(), body.size());
+  out->append(hdr, sizeof(hdr));
+  out->append(meta.data(), meta.size());
+  out->append(std::move(body));
+}
+
+// ---- method registry ----
+
+namespace {
+
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(const std::string& s) const {
+    return std::hash<std::string_view>()(s);
+  }
+  size_t operator()(std::string_view s) const {
+    return std::hash<std::string_view>()(s);
+  }
+};
+struct SvEq {
+  bool operator()(const std::string& a, const std::string& b) const {
+    return a == b;
+  }
+  bool operator()(const std::string& a, std::string_view b) const {
+    return a == b;
+  }
+};
+
+using MethodMap =
+    butil::FlatMap<std::string, MethodRegistry::Entry, SvHash, SvEq>;
+
+butil::DoublyBufferedData<MethodMap>* g_methods = nullptr;
+std::atomic<int64_t> g_native_calls{0};
+std::atomic<int64_t> g_python_fast_calls{0};
+std::atomic<RequestCallback> g_request_cb{nullptr};
+std::atomic<void*> g_request_user{nullptr};
+
+std::string make_key(const char* service, size_t service_len,
+                     const char* method, size_t method_len) {
+  std::string k;
+  k.reserve(service_len + method_len + 1);
+  k.append(service, service_len);
+  k.push_back('\0');
+  k.append(method, method_len);
+  return k;
+}
+
+}  // namespace
+
+MethodRegistry* MethodRegistry::global() {
+  static MethodRegistry reg;
+  if (g_methods == nullptr) {
+    static butil::DoublyBufferedData<MethodMap> maps;
+    g_methods = &maps;
+  }
+  return &reg;
+}
+
+void MethodRegistry::Register(const char* service, const char* method,
+                              NativeMethodFn fn, void* user, bool inline_run) {
+  global();
+  std::string key = make_key(service, strlen(service), method, strlen(method));
+  Entry e{fn, user, inline_run};
+  g_methods->Modify([&](MethodMap& m) {
+    m.insert(key, e);
+    return true;
+  });
+}
+
+void MethodRegistry::RegisterPython(const char* service, const char* method) {
+  Register(service, method, nullptr, nullptr, false);
+}
+
+bool MethodRegistry::Unregister(const char* service, const char* method) {
+  global();
+  std::string key = make_key(service, strlen(service), method, strlen(method));
+  bool existed = false;
+  g_methods->Modify([&](MethodMap& m) {
+    existed = m.erase(key);
+    return true;
+  });
+  return existed;
+}
+
+bool MethodRegistry::Lookup(const char* service, size_t service_len,
+                            const char* method, size_t method_len,
+                            Entry* out) {
+  if (g_methods == nullptr) return false;
+  // heterogeneous probe: the key view lives on the stack, no allocation
+  char buf[256];
+  std::string heap_key;
+  std::string_view key;
+  const size_t total = service_len + 1 + method_len;
+  if (total <= sizeof(buf)) {
+    memcpy(buf, service, service_len);
+    buf[service_len] = '\0';
+    memcpy(buf + service_len + 1, method, method_len);
+    key = std::string_view(buf, total);
+  } else {
+    heap_key = make_key(service, service_len, method, method_len);
+    key = heap_key;
+  }
+  butil::DoublyBufferedData<MethodMap>::ScopedPtr ptr;
+  g_methods->Read(&ptr);
+  const Entry* e = ptr->seek(key);
+  if (e == nullptr) return false;
+  *out = *e;
+  return true;
+}
+
+int64_t MethodRegistry::native_calls() const {
+  return g_native_calls.load(std::memory_order_relaxed);
+}
+int64_t MethodRegistry::python_fast_calls() const {
+  return g_python_fast_calls.load(std::memory_order_relaxed);
+}
+
+void SetRequestCallback(RequestCallback cb, void* user) {
+  g_request_user.store(user, std::memory_order_release);
+  g_request_cb.store(cb, std::memory_order_release);
+}
+
+// ---- dispatch ----
+
+namespace {
+
+void fill_header(RequestHeader* hdr, const ParsedMeta& m) {
+  hdr->cid = m.cid;
+  hdr->timeout_ms = m.timeout_ms;
+  hdr->present_mask = m.present_mask;
+  hdr->service = m.service;
+  hdr->service_len = m.service_len;
+  hdr->method = m.method;
+  hdr->method_len = m.method_len;
+  hdr->attempt = m.attempt;
+  hdr->compress = m.compress;
+  hdr->msg_type = m.msg_type;
+  hdr->content_type = m.content_type;
+  hdr->content_type_len = m.content_type_len;
+  hdr->error_code = m.error_code;
+  hdr->error_text = m.error_text;
+  hdr->error_text_len = m.error_text_len;
+  hdr->attachment_size = m.attachment_size;
+}
+
+void run_native(SocketId sid, const MethodRegistry::Entry& e, uint64_t cid,
+                uint16_t attempt, butil::IOBuf* body) {
+  butil::IOBuf resp_body;
+  const int32_t rc = e.fn(sid, body, &resp_body, e.user);
+  g_native_calls.fetch_add(1, std::memory_order_relaxed);
+  butil::IOBuf frame;
+  PackResponseFrame(&frame, cid, attempt, rc, nullptr, 0, nullptr, 0,
+                    std::move(resp_body));
+  Socket* s = Socket::Address(sid);
+  if (s != nullptr) {
+    s->Write(std::move(frame));
+    s->Dereference();
+  }
+}
+
+struct PendingNative {
+  SocketId sid;
+  MethodRegistry::Entry entry;
+  uint64_t cid;
+  uint16_t attempt;
+  butil::IOBuf body;
+};
+
+void run_native_task(void* arg) {
+  auto* p = (PendingNative*)arg;
+  run_native(p->sid, p->entry, p->cid, p->attempt, &p->body);
+  delete p;
+}
+
+struct PendingFastRequest {
+  SocketId sid;
+  std::string meta;  // owned copy; re-parsed on the worker
+  butil::IOBuf* body;
+  RequestCallback cb;
+  void* user;
+};
+
+void run_fast_request_task(void* arg) {
+  auto* p = (PendingFastRequest*)arg;
+  ParsedMeta m;
+  if (ParseMeta(p->meta.data(), p->meta.size(), &m)) {
+    RequestHeader hdr;
+    fill_header(&hdr, m);
+    g_python_fast_calls.fetch_add(1, std::memory_order_relaxed);
+    p->cb(p->sid, &hdr, p->body, p->user);  // callee owns body
+  } else {
+    delete p->body;
+  }
+  delete p;
+}
+
+struct PendingFastResponse {
+  SocketId sid;
+  std::string meta;
+  butil::IOBuf* body;
+  ResponseCallback cb;
+  void* user;
+};
+
+void run_fast_response_task(void* arg) {
+  auto* p = (PendingFastResponse*)arg;
+  ParsedMeta m;
+  if (ParseMeta(p->meta.data(), p->meta.size(), &m)) {
+    RequestHeader hdr;
+    fill_header(&hdr, m);
+    p->cb(p->sid, &hdr, p->body, p->user);
+  } else {
+    delete p->body;
+  }
+  delete p;
+}
+
+}  // namespace
+
+bool TryDispatchTrpc(SocketId sid, const SocketOptions& opts, const char* meta,
+                     size_t meta_len, butil::IOBuf* body) {
+  ParsedMeta m;
+  if (!ParseMeta(meta, meta_len, &m)) return false;
+  if (!MetaIsFastPath(m)) return false;
+
+  if (m.msg_type == META_REQUEST) {
+    if (!opts.enable_rpc_dispatch) return false;
+    if (m.service == nullptr || m.method == nullptr) return false;
+    MethodRegistry::Entry e;
+    if (!MethodRegistry::global()->Lookup(m.service, m.service_len, m.method,
+                                          m.method_len, &e)) {
+      return false;  // unknown method: Python path owns the error reply
+    }
+    if (e.fn != nullptr) {
+      if (e.inline_run) {
+        run_native(sid, e, m.cid, m.attempt, body);
+        body->clear();
+      } else {
+        auto* p = new PendingNative{sid, e, m.cid, m.attempt,
+                                    std::move(*body)};
+        bthread::Executor::global()->submit(run_native_task, p);
+      }
+      return true;
+    }
+    RequestCallback cb = g_request_cb.load(std::memory_order_acquire);
+    if (cb == nullptr) return false;
+    auto* p = new PendingFastRequest{sid, std::string(meta, meta_len),
+                                     new butil::IOBuf(std::move(*body)), cb,
+                                     g_request_user.load()};
+    bthread::Executor::global()->submit(run_fast_request_task, p);
+    return true;
+  }
+
+  if (m.msg_type == META_RESPONSE) {
+    if (opts.on_response == nullptr) return false;
+    if (opts.response_inline) {
+      RequestHeader hdr;
+      fill_header(&hdr, m);
+      opts.on_response(sid, &hdr, body, opts.response_user);  // borrowed
+      body->clear();
+      return true;
+    }
+    auto* p = new PendingFastResponse{sid, std::string(meta, meta_len),
+                                      new butil::IOBuf(std::move(*body)),
+                                      opts.on_response, opts.response_user};
+    bthread::Executor::global()->submit(run_fast_response_task, p);
+    return true;
+  }
+  return false;  // stream frames etc. go to the generic path
+}
+
+}  // namespace brpc

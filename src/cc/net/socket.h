@@ -24,6 +24,7 @@
 #include "butil/iobuf.h"
 #include "butil/resource_pool.h"
 #include "net/parser.h"
+#include "net/rpc.h"
 
 namespace brpc {
 
@@ -59,6 +60,18 @@ struct SocketOptions {
   // any IO event can fire (the fd may land on a DIFFERENT dispatcher thread,
   // which would otherwise race handler registration with the first message).
   bool defer_register = false;
+  // Native RPC fast path (net/rpc.h).  When a TRPC RESPONSE meta parses
+  // cleanly, it is delivered pre-parsed here instead of on_message.
+  ResponseCallback on_response = nullptr;
+  void* response_user = nullptr;
+  // Run on_response inline on the dispatcher thread with a BORROWED body
+  // (callee must not free it) instead of an executor task with an owned
+  // heap body.  Only for non-blocking native callbacks (the bench pump);
+  // writes issued from the callback join the dispatch write batch.
+  bool response_inline = false;
+  // Opt in to native REQUEST dispatch via the MethodRegistry (server
+  // sockets); off by default so raw-frame users see every message.
+  bool enable_rpc_dispatch = false;
 };
 
 struct WriteRequest {

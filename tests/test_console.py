@@ -15,6 +15,11 @@ class Hello(brpc.Service):
 
 @pytest.fixture(scope="module")
 def server():
+    from brpc_tpu import flags, rpcz
+    # rpcz is off by default (FLAGS_enable_rpcz parity); the /rpcz page
+    # test needs spans collected
+    rpcz.set_enabled(True)
+    flags.set_flag("rpcz_enabled", True)
     s = brpc.Server()
     s.add_service(Hello())
     s.start("127.0.0.1", 0)
@@ -24,6 +29,8 @@ def server():
     yield s
     s.stop()
     s.join()
+    rpcz.set_enabled(False)
+    flags.set_flag("rpcz_enabled", False)
 
 
 def _get(server, path):

@@ -1,0 +1,164 @@
+"""Native unary hot path (src/cc/net/rpc.{h,cc}): C++ meta codec, FlatMap
+method map behind DoublyBufferedData, native/python dispatch, and the
+_fastrpc C-extension boundary.
+
+Reference parity targets: baidu_rpc_protocol.cpp:97-137 (parse) + :398
+(ProcessRpcRequest), server.h:399,432 (method maps),
+docs/cn/benchmark.md methodology (C++ client pump).
+"""
+import ctypes
+import threading
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu._core import IOBuf, NATIVE_METHOD_FN, core
+
+
+@pytest.fixture()
+def echo_server():
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return req
+
+    s = brpc.Server()
+    s.add_service(Echo())
+    s.start("127.0.0.1", 0)
+    yield s
+    s.stop()
+    s.join()
+
+
+def _rpc_counters():
+    nat = ctypes.c_int64()
+    pyf = ctypes.c_int64()
+    core.brpc_rpc_counters(ctypes.byref(nat), ctypes.byref(pyf))
+    return nat.value, pyf.value
+
+
+def test_python_fast_path_taken(echo_server):
+    ch = brpc.Channel(f"127.0.0.1:{echo_server.port}", timeout_ms=5000)
+    _, before = _rpc_counters()
+    for i in range(10):
+        assert ch.call_sync("Echo", "Echo", b"x%d" % i,
+                            serializer="raw") == b"x%d" % i
+    _, after = _rpc_counters()
+    # every request went through the native pre-parse + method-map path
+    assert after - before == 10
+
+
+def test_native_method_served_without_python_dispatch(echo_server):
+    """A method registered as a NATIVE handler answers entirely in C++
+    (Python sees nothing); the ctypes handler here stands in for a real C
+    service implementation."""
+    calls = []
+
+    @NATIVE_METHOD_FN
+    def upper(sid, body_iobuf, resp_iobuf, user):
+        b = IOBuf(handle=body_iobuf)
+        b._owned = False   # caller (C++) owns the request body
+        data = b.to_bytes()
+        out = IOBuf(handle=resp_iobuf)
+        out._owned = False
+        out.append(data.upper())
+        calls.append(data)
+        return 0
+
+    core.brpc_register_native_method(b"NativeSvc", b"Upper", upper, None, 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{echo_server.port}", timeout_ms=5000)
+        assert ch.call_sync("NativeSvc", "Upper", b"hello",
+                            serializer="raw") == b"HELLO"
+        assert calls == [b"hello"]
+    finally:
+        core.brpc_unregister_method(b"NativeSvc", b"Upper")
+
+
+def test_native_method_error_code_propagates(echo_server):
+    @NATIVE_METHOD_FN
+    def failing(sid, body_iobuf, resp_iobuf, user):
+        return 1014  # ELIMIT-ish arbitrary nonzero
+
+    core.brpc_register_native_method(b"NativeSvc", b"Fail", failing, None, 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{echo_server.port}", timeout_ms=5000)
+        with pytest.raises(brpc.RpcError) as ei:
+            ch.call_sync("NativeSvc", "Fail", b"x", serializer="raw")
+        assert ei.value.code == 1014
+    finally:
+        core.brpc_unregister_method(b"NativeSvc", b"Fail")
+
+
+def test_unknown_method_still_errors_via_python(echo_server):
+    """Lookup misses fall back to the generic path so the Python server
+    owns the ENOSERVICE/ENOMETHOD reply (master-service hook preserved)."""
+    ch = brpc.Channel(f"127.0.0.1:{echo_server.port}", timeout_ms=5000)
+    with pytest.raises(brpc.RpcError) as ei:
+        ch.call_sync("NoSuch", "Method", b"x", serializer="raw")
+    assert ei.value.code == brpc.errors.ENOSERVICE
+
+
+def test_method_map_register_unregister_churn(echo_server):
+    """FlatMap insert/erase (backward-shift deletion) + DoublyBufferedData
+    flip under concurrent lookups stays consistent."""
+    ch = brpc.Channel(f"127.0.0.1:{echo_server.port}", timeout_ms=5000)
+    stop = threading.Event()
+    errors_seen = []
+
+    def caller():
+        while not stop.is_set():
+            try:
+                ch.call_sync("Echo", "Echo", b"live", serializer="raw")
+            except Exception as e:  # pragma: no cover
+                errors_seen.append(e)
+
+    t = threading.Thread(target=caller)
+    t.start()
+    try:
+        for i in range(60):
+            core.brpc_register_python_method(b"Churn%d" % (i % 7), b"M")
+            if i % 3 == 0:
+                core.brpc_unregister_method(b"Churn%d" % (i % 7), b"M")
+    finally:
+        stop.set()
+        t.join()
+    assert not errors_seen
+    for i in range(7):
+        core.brpc_unregister_method(b"Churn%d" % i, b"M")
+
+
+def test_native_bench_pump_smoke():
+    """The in-process C++ client pump completes and reports sane numbers."""
+    qps = ctypes.c_double()
+    p50 = ctypes.c_double()
+    p99 = ctypes.c_double()
+    rc = core.brpc_bench_echo(2, 8, 5000, 64, 1, ctypes.byref(qps),
+                              ctypes.byref(p50), ctypes.byref(p99))
+    assert rc == 0
+    assert qps.value > 1000
+    assert 0 < p50.value <= p99.value < 5e6
+
+
+def test_error_text_roundtrip_native_pack(echo_server):
+    """Server error replies are packed natively (PackResponseFrame with
+    error TLVs) and decode correctly client-side."""
+
+    class Failing(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Boom(self, cntl, req):
+            cntl.set_failed(brpc.errors.EINTERNAL, "kaboom text")
+            return b""
+
+    s2 = brpc.Server()
+    s2.add_service(Failing())
+    s2.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{s2.port}", timeout_ms=5000)
+        with pytest.raises(brpc.RpcError) as ei:
+            ch.call_sync("Failing", "Boom", b"x", serializer="raw")
+        assert ei.value.code == brpc.errors.EINTERNAL
+        assert "kaboom text" in str(ei.value)
+    finally:
+        s2.stop()
+        s2.join()
