@@ -14,7 +14,8 @@ SRC := $(wildcard src/cc/butil/*.cc) \
        $(wildcard src/cc/bvar/*.cc) \
        $(filter-out src/cc/fastrpc_module.cc,$(wildcard src/cc/*.cc))
 OBJ := $(SRC:.cc=.o)
-DEP := $(OBJ:.o=.d)
+PYOBJ := src/cc/fastrpc_module.o
+DEP := $(OBJ:.o=.d) $(PYOBJ:.o=.d)
 LIB := brpc_tpu/_core/libbrpc_core.so
 # CPython C-extension for the RPC hot boundary (no ctypes marshalling).
 PYEXT := brpc_tpu/_core/_fastrpc.so
@@ -25,8 +26,12 @@ all: $(LIB) $(PYEXT)
 $(LIB): $(OBJ)
 	$(CXX) $(LDFLAGS) -o $@ $(OBJ)
 
-$(PYEXT): src/cc/fastrpc_module.cc $(LIB)
-	$(CXX) $(CXXFLAGS) $(PYINC) -Isrc/cc -shared -o $@ $< \
+# Built via the %.o pattern rule so -MMD tracks net/ and butil/ headers: a
+# struct-layout change must rebuild the extension, not leave a stale .so.
+$(PYOBJ): CXXFLAGS += $(PYINC)
+
+$(PYEXT): $(PYOBJ) $(LIB)
+	$(CXX) $(LDFLAGS) -o $@ $(PYOBJ) \
 	    -Lbrpc_tpu/_core -lbrpc_core -Wl,-rpath,'$$ORIGIN'
 
 # -MMD -MP: auto header dependencies (a struct-layout change in a header
@@ -37,7 +42,7 @@ $(PYEXT): src/cc/fastrpc_module.cc $(LIB)
 -include $(DEP)
 
 clean:
-	rm -f $(OBJ) $(DEP) $(LIB) $(PYEXT)
+	rm -f $(OBJ) $(PYOBJ) $(DEP) $(LIB) $(PYEXT)
 
 test: $(LIB)
 	python -m pytest tests/ -x -q

@@ -88,13 +88,24 @@ class Transport:
         def _on_request(sid, cid, attempt, service, method_, compress,
                         timeout_ms, content_type, attachment_size, body):
             h = self._request_handlers.get(sid)
-            if h is not None:
+            if h is None:
+                # No per-socket handler (listener torn down mid-flight):
+                # reply EINTERNAL rather than leaving the caller to hang
+                # until its deadline.
+                _fastrpc.send_response(sid, cid, attempt, 2001,
+                                       "no request handler", "", b"")
+                return
+            try:
+                h(sid, cid, attempt, service, method_, compress,
+                  timeout_ms, content_type, attachment_size, body)
+            except Exception:  # pragma: no cover - handler bug guard
+                import traceback
+                traceback.print_exc()
                 try:
-                    h(sid, cid, attempt, service, method_, compress,
-                      timeout_ms, content_type, attachment_size, body)
-                except Exception:  # pragma: no cover - handler bug guard
-                    import traceback
-                    traceback.print_exc()
+                    _fastrpc.send_response(sid, cid, attempt, 2001,
+                                           "python handler raised", "", b"")
+                except Exception:
+                    pass
 
         def _on_response(sid, cid, attempt, error_code, error_text, compress,
                          content_type, attachment_size, body):

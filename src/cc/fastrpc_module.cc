@@ -36,10 +36,28 @@ PyObject* iobuf_steal_bytes(butil::IOBuf* b) {
 
 // ---- native -> Python trampolines (run on executor/dispatcher threads) ----
 
+// If the Python handler raises (or the payload can't be materialized), the
+// peer must still get a reply — a silently dropped frame hangs the caller
+// until its RPC deadline.  Pack a native EINTERNAL response instead.
+constexpr int32_t kEInternal = 2001;  // errors.py EINTERNAL
+
+void send_error_response(brpc::SocketId sid, const brpc::RequestHeader* hdr) {
+  static const char kMsg[] = "python handler raised";
+  butil::IOBuf frame;
+  brpc::PackResponseFrame(&frame, hdr->cid, hdr->attempt, kEInternal, kMsg,
+                          sizeof(kMsg) - 1, "", 0, butil::IOBuf());
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s != nullptr) {
+    s->Write(std::move(frame));
+    s->Dereference();
+  }
+}
+
 void fast_request_cb(brpc::SocketId sid, const brpc::RequestHeader* hdr,
                      butil::IOBuf* body, void* /*user*/) {
   PyGILState_STATE g = PyGILState_Ensure();
   PyObject* handler = g_request_handler;
+  bool handled = false;
   if (handler != nullptr) {
     PyObject* payload = iobuf_steal_bytes(body);
     delete body;
@@ -53,14 +71,19 @@ void fast_request_cb(brpc::SocketId sid, const brpc::RequestHeader* hdr,
           hdr->content_type ? hdr->content_type : "",
           (Py_ssize_t)hdr->content_type_len,
           (unsigned long long)hdr->attachment_size, payload);
-      if (r == nullptr) PyErr_Print();
-      else Py_DECREF(r);
+      if (r == nullptr) {
+        PyErr_Print();
+      } else {
+        Py_DECREF(r);
+        handled = true;
+      }
     } else {
       PyErr_Print();
     }
   } else {
     delete body;
   }
+  if (!handled) send_error_response(sid, hdr);
   PyGILState_Release(g);
 }
 
@@ -153,18 +176,28 @@ PyObject* py_send_response(PyObject*, PyObject* args) {
 }
 
 PyObject* py_set_request_handler(PyObject*, PyObject* arg) {
-  Py_XINCREF(arg);
+  if (arg != Py_None && !PyCallable_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "request handler must be callable");
+    return nullptr;
+  }
+  PyObject* next = (arg == Py_None) ? nullptr : arg;
+  Py_XINCREF(next);
   PyObject* old = g_request_handler;
-  g_request_handler = arg;
+  g_request_handler = next;
   Py_XDECREF(old);
   brpc::SetRequestCallback(fast_request_cb, nullptr);
   Py_RETURN_NONE;
 }
 
 PyObject* py_set_response_handler(PyObject*, PyObject* arg) {
-  Py_XINCREF(arg);
+  if (arg != Py_None && !PyCallable_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "response handler must be callable");
+    return nullptr;
+  }
+  PyObject* next = (arg == Py_None) ? nullptr : arg;
+  Py_XINCREF(next);
   PyObject* old = g_response_handler;
-  g_response_handler = arg;
+  g_response_handler = next;
   Py_XDECREF(old);
   Py_RETURN_NONE;
 }

@@ -97,7 +97,12 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
   }
   MethodRegistry::global()->Register("BenchEcho", "Echo", bench_echo_handler,
                                      nullptr, inline_run != 0);
-  BenchState st;
+  // Heap-allocated: on the timeout path, in-flight responses can still hit
+  // bench_on_response on dispatcher threads after we return (SetFailed does
+  // not synchronize with callbacks already executing), so the state must
+  // outlive this frame — it is intentionally leaked in that case.
+  auto* stp = new BenchState;
+  BenchState& st = *stp;
   st.total = total;
   st.payload_len = payload_len;
   st.lat_us.assign(std::min<uint64_t>(total, 2'000'000), 0);
@@ -106,7 +111,10 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
   server_opts.enable_rpc_dispatch = true;
   SocketId listener = INVALID_SOCKET_ID;
   int port = 0;
-  if (Listen("127.0.0.1", 0, server_opts, &listener, &port) != 0) return -2;
+  if (Listen("127.0.0.1", 0, server_opts, &listener, &port) != 0) {
+    delete stp;
+    return -2;
+  }
 
   std::vector<SocketId> clients;
   for (int i = 0; i < conns; ++i) {
@@ -117,7 +125,9 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
     copts.on_failed = bench_noop_failed;
     SocketId cid = INVALID_SOCKET_ID;
     if (Connect("127.0.0.1", port, copts, &cid) != 0) {
+      for (SocketId c : clients) Socket::SetFailed(c, 0);
       Socket::SetFailed(listener, 0);
+      delete stp;
       return -3;
     }
     clients.push_back(cid);
@@ -135,10 +145,11 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
     }
   }
 
+  bool completed_in_time;
   {
     std::unique_lock<std::mutex> lk(st.mu);
-    st.cv.wait_for(lk, std::chrono::seconds(120),
-                   [&] { return st.finished; });
+    completed_in_time = st.cv.wait_for(lk, std::chrono::seconds(120),
+                                       [&] { return st.finished; });
   }
   const int64_t t1 = butil::monotonic_time_us();
 
@@ -159,7 +170,12 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
     if (p50_us) *p50_us = 0;
     if (p99_us) *p99_us = 0;
   }
-  return completed >= total ? 0 : -4;
+  if (completed_in_time) {
+    delete stp;
+    return completed >= total ? 0 : -4;
+  }
+  // Timed out: dispatcher threads may still reference *stp — leak it.
+  return -4;
 }
 
 }  // extern "C"

@@ -175,7 +175,12 @@ struct SvEq {
 using MethodMap =
     butil::FlatMap<std::string, MethodRegistry::Entry, SvHash, SvEq>;
 
-butil::DoublyBufferedData<MethodMap>* g_methods = nullptr;
+// Function-local magic static: thread-safe one-time construction even when
+// the first Register (Python thread) races the first Lookup (dispatcher).
+butil::DoublyBufferedData<MethodMap>& methods() {
+  static butil::DoublyBufferedData<MethodMap> maps;
+  return maps;
+}
 std::atomic<int64_t> g_native_calls{0};
 std::atomic<int64_t> g_python_fast_calls{0};
 std::atomic<RequestCallback> g_request_cb{nullptr};
@@ -195,19 +200,14 @@ std::string make_key(const char* service, size_t service_len,
 
 MethodRegistry* MethodRegistry::global() {
   static MethodRegistry reg;
-  if (g_methods == nullptr) {
-    static butil::DoublyBufferedData<MethodMap> maps;
-    g_methods = &maps;
-  }
   return &reg;
 }
 
 void MethodRegistry::Register(const char* service, const char* method,
                               NativeMethodFn fn, void* user, bool inline_run) {
-  global();
   std::string key = make_key(service, strlen(service), method, strlen(method));
   Entry e{fn, user, inline_run};
-  g_methods->Modify([&](MethodMap& m) {
+  methods().Modify([&](MethodMap& m) {
     m.insert(key, e);
     return true;
   });
@@ -218,10 +218,9 @@ void MethodRegistry::RegisterPython(const char* service, const char* method) {
 }
 
 bool MethodRegistry::Unregister(const char* service, const char* method) {
-  global();
   std::string key = make_key(service, strlen(service), method, strlen(method));
   bool existed = false;
-  g_methods->Modify([&](MethodMap& m) {
+  methods().Modify([&](MethodMap& m) {
     existed = m.erase(key);
     return true;
   });
@@ -231,7 +230,6 @@ bool MethodRegistry::Unregister(const char* service, const char* method) {
 bool MethodRegistry::Lookup(const char* service, size_t service_len,
                             const char* method, size_t method_len,
                             Entry* out) {
-  if (g_methods == nullptr) return false;
   // heterogeneous probe: the key view lives on the stack, no allocation
   char buf[256];
   std::string heap_key;
@@ -247,7 +245,7 @@ bool MethodRegistry::Lookup(const char* service, size_t service_len,
     key = heap_key;
   }
   butil::DoublyBufferedData<MethodMap>::ScopedPtr ptr;
-  g_methods->Read(&ptr);
+  methods().Read(&ptr);
   const Entry* e = ptr->seek(key);
   if (e == nullptr) return false;
   *out = *e;

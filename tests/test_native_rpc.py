@@ -162,3 +162,33 @@ def test_error_text_roundtrip_native_pack(echo_server):
     finally:
         s2.stop()
         s2.join()
+
+
+def test_set_handler_rejects_non_callable():
+    """ADVICE r2: installing a non-callable handler must fail loudly at
+    install time, not silently drop frames at dispatch time."""
+    from brpc_tpu.rpc import transport as tr
+    with pytest.raises(TypeError):
+        tr._fastrpc.set_request_handler(42)
+    with pytest.raises(TypeError):
+        tr._fastrpc.set_response_handler("nope")
+
+
+def test_handler_exception_yields_einternal(echo_server):
+    """If the process-wide Python request trampoline raises, the C side must
+    pack a native EINTERNAL reply instead of dropping the frame (which would
+    hang the caller until its deadline)."""
+    from brpc_tpu.rpc import transport as trmod
+    tr = trmod.Transport.instance()
+
+    def exploding(*args):
+        raise RuntimeError("trampoline bug")
+
+    trmod._fastrpc.set_request_handler(exploding)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{echo_server.port}", timeout_ms=3000)
+        with pytest.raises(brpc.RpcError) as ei:
+            ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+        assert ei.value.code == brpc.errors.EINTERNAL
+    finally:
+        trmod._fastrpc.set_request_handler(tr._cb_request)
