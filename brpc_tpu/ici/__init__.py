@@ -20,3 +20,4 @@ from brpc_tpu.ici.collective import CollectiveGroup  # noqa: F401
 from brpc_tpu.ici.channel import (  # noqa: F401
     IciChannel, register_device_service, device_service_registry,
 )
+from brpc_tpu.ici import rail  # noqa: F401  (RPC data-path rail)

@@ -24,6 +24,13 @@ import numpy as np
 
 from brpc_tpu.bvar import Adder, PassiveStatus
 
+# Host-bounce counters for the rail's zero-host-copy proof
+# (ici/rail.py host_copy_count): staging host bytes into a block and
+# reading a block back to host are the only block-pool paths that touch
+# host memory.
+host_stage_count = Adder("blockpool_host_stages")
+host_read_count = Adder("blockpool_host_reads")
+
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def _stage(x, cls: int):
@@ -91,6 +98,7 @@ class Block:
                 dev = jax.device_put(dev, self.pool.device)
             self._src_meta = (str(data.dtype), tuple(data.shape))
         else:
+            host_stage_count.add(1)
             buf = np.frombuffer(memoryview(data), dtype=np.uint8)
             n = buf.size
             if n > self.size_class:
@@ -121,6 +129,7 @@ class Block:
         return self
 
     def get(self) -> bytes:
+        host_read_count.add(1)
         return bytes(np.asarray(self.view())[: self.used])
 
     def get_array(self, dtype=None, shape=None) -> jax.Array:

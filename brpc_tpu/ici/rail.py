@@ -1,0 +1,311 @@
+"""Device-payload rail — ICI inside the ordinary RPC data path.
+
+Reference: RdmaEndpoint::CutFromIOBufList replaces
+cut_into_file_descriptor inside Socket::StartWrite/KeepWrite
+(/root/reference/src/brpc/socket.cpp:1751-1757, rdma/rdma_endpoint.h:82):
+once both peers complete the RDMA handshake, an ordinary RPC's IOBuf
+payload rides the RC queue pair while TCP carries only control traffic —
+call sites never change.
+
+TPU build: when a Channel.call request (or a handler's response) is made
+of jax device arrays and the target server has advertised an
+ICI-reachable device, the payload is staged into BlockPool HBM slots
+(on-device bitcast, no host bounce), moved through IciEndpoint's
+credit-windowed send path, and parked in the process-wide payload
+registry.  The TRPC frame then carries only a claim ticket in its user
+fields; the receiving side claims the blocks and rebuilds device arrays
+with an on-device unstage.  The payload never exists as host bytes —
+`host_copy_count()` gives tests a provable zero.
+"""
+from __future__ import annotations
+
+import functools
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from brpc_tpu.bvar import Adder
+from brpc_tpu.ici.block_pool import (BLOCK_CLASSES, Block, _stage, _unstage,
+                                     get_block_pool)
+from brpc_tpu.ici.endpoint import IciEndpoint
+from brpc_tpu.ici.mesh import device_for
+
+rail_payloads = Adder("rail_payloads")
+rail_bytes = Adder("rail_bytes")
+rail_fallbacks = Adder("rail_fallbacks")
+_ticket_counter = itertools.count(1)
+
+_CHUNK = BLOCK_CLASSES[-1]
+
+# user-field keys riding the TRPC meta (control plane only)
+F_TICKET = "icit"     # payload ticket to claim
+F_SRC_DEV = "icisrc"  # requester's device id — where the response should land
+
+# ---------------------------------------------------------------------------
+# rail map: which endpoints are ICI-reachable
+# ---------------------------------------------------------------------------
+
+_map_lock = threading.Lock()
+_advertised: dict[int, object] = {}       # port -> jax device
+_LOCAL_HOSTS = {"127.0.0.1", "localhost", "0.0.0.0", "::1"}
+
+
+def advertise(port: int, device) -> None:
+    """Server-side: declare that the RPC server on `port` can receive
+    payloads on `device` (the handshake-complete bit of the RDMA path)."""
+    with _map_lock:
+        _advertised[port] = device
+
+
+def unadvertise(port: int) -> None:
+    with _map_lock:
+        _advertised.pop(port, None)
+
+
+def lookup(endpoint) -> object | None:
+    """Client-side: the device an endpoint receives on, or None when the
+    payload must stay on the socket.  In-process only until the DCN
+    handshake lands (SURVEY §5.8); remote hosts return None."""
+    if getattr(endpoint, "host", None) not in _LOCAL_HOSTS:
+        return None
+    with _map_lock:
+        return _advertised.get(endpoint.port)
+
+
+# ---------------------------------------------------------------------------
+# staging: device arrays <-> BlockPool slots, entirely on device
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _slice_chunk(flat, offset, size: int):
+    return jax.lax.dynamic_slice(flat, (offset,), (size,))
+
+
+@jax.jit
+def _cat(bufs):
+    import jax.numpy as jnp
+    return jnp.concatenate(bufs)
+
+
+@dataclass
+class _Entry:
+    """One staged array: destination blocks + how to rebuild it."""
+    blocks: list
+    dtype: str
+    shape: tuple
+    nbytes: int
+
+    def unstage(self, free: bool = True):
+        if len(self.blocks) == 1:
+            buf = self.blocks[0].view()
+        else:
+            buf = _cat([b.view() for b in self.blocks])
+        out = _unstage(buf, self.dtype, self.shape)
+        if free:
+            for b in self.blocks:
+                b.free()
+        return out
+
+    def free(self) -> None:
+        for b in self.blocks:
+            b.free()
+
+
+def _stage_one(arr: jax.Array, pool) -> list[Block]:
+    """Stage one device array into source-pool blocks without touching the
+    host: small arrays pad into one slot (block_pool._stage), large ones
+    flatten to uint8 on device and slice into 2MB chunks."""
+    n = arr.nbytes
+    if n <= _CHUNK:
+        b = pool.alloc(n)
+        b.put(arr)  # jax.Array branch: on-device _stage
+        return [b]
+    padded = ((n + _CHUNK - 1) // _CHUNK) * _CHUNK
+    flat = _stage(arr, padded)  # uint8[padded] on the source device
+    blocks = []
+    try:
+        for off in range(0, n, _CHUNK):
+            piece = _slice_chunk(flat, off, _CHUNK)
+            b = pool.alloc(_CHUNK)
+            b.install(piece, min(_CHUNK, n - off))
+            blocks.append(b)
+    except Exception:
+        for b in blocks:
+            b.free()
+        raise
+    return blocks
+
+
+def _is_device_array(x) -> bool:
+    if not isinstance(x, jax.Array):
+        return False
+    try:
+        return len(x.devices()) == 1
+    except Exception:
+        return False
+
+
+def railable(obj) -> bool:
+    """True when `obj` is a single-device jax array or a non-empty
+    list/tuple of them — the payload shapes the rail can carry."""
+    if isinstance(obj, (list, tuple)):
+        return len(obj) > 0 and all(_is_device_array(a) for a in obj)
+    return _is_device_array(obj)
+
+
+def source_device(obj):
+    first = obj[0] if isinstance(obj, (list, tuple)) else obj
+    return next(iter(first.devices()))
+
+
+def device_by_id(device_id: int):
+    for d in jax.devices():
+        if d.id == device_id:
+            return d
+    raise KeyError(f"no local device with id {device_id}")
+
+
+# ---------------------------------------------------------------------------
+# payload registry: ticket -> staged entries (the claim table)
+# ---------------------------------------------------------------------------
+
+_REGISTRY_TTL_S = 60.0
+_reg_lock = threading.Lock()
+_registry: dict[str, tuple[list[_Entry], bool, float]] = {}
+_sweeper_started = False
+
+
+def _purge_locked(now: float) -> None:
+    dead = [t for t, (_, _, dl) in _registry.items() if dl < now]
+    for t in dead:
+        entries, _, _ = _registry.pop(t)
+        for e in entries:
+            e.free()
+
+
+def _sweep_loop() -> None:
+    # Orphaned tickets must not pin HBM blocks forever in a process that
+    # stopped depositing — the TTL fires on its own clock, not on traffic.
+    while True:
+        time.sleep(_REGISTRY_TTL_S / 4)
+        with _reg_lock:
+            _purge_locked(time.monotonic())
+
+
+def _ensure_sweeper() -> None:
+    global _sweeper_started
+    if not _sweeper_started:
+        _sweeper_started = True
+        threading.Thread(target=_sweep_loop, daemon=True,
+                         name="rail-ttl-sweeper").start()
+
+
+def deposit(entries: list[_Entry], single: bool) -> str:
+    ticket = f"t{next(_ticket_counter)}"
+    now = time.monotonic()
+    with _reg_lock:
+        _purge_locked(now)
+        _registry[ticket] = (entries, single, now + _REGISTRY_TTL_S)
+    _ensure_sweeper()
+    return ticket
+
+
+def _norm(ticket) -> str:
+    # user-field values come off the wire as bytes (meta.py decode)
+    return ticket.decode() if isinstance(ticket, bytes) else ticket
+
+
+def claim(ticket):
+    """Pop the ticket and rebuild device arrays (frees the blocks)."""
+    ticket = _norm(ticket)
+    with _reg_lock:
+        item = _registry.pop(ticket, None)
+    if item is None:
+        raise KeyError(f"rail ticket {ticket!r} expired or already claimed")
+    entries, single, _ = item
+    arrays = [e.unstage() for e in entries]
+    return arrays[0] if single else arrays
+
+
+def withdraw(ticket) -> None:
+    """Free an unclaimed ticket (failed/abandoned attempt).  Claim is an
+    atomic pop, so racing the receiver cannot double-free."""
+    ticket = _norm(ticket)
+    with _reg_lock:
+        item = _registry.pop(ticket, None)
+    if item is None:
+        return
+    for e in item[0]:
+        e.free()
+
+
+def pending_tickets() -> int:
+    with _reg_lock:
+        return len(_registry)
+
+
+# ---------------------------------------------------------------------------
+# the send half: stage + ICI transfer + deposit
+# ---------------------------------------------------------------------------
+
+_ep_lock = threading.Lock()
+_endpoints: dict[int, IciEndpoint] = {}
+
+
+def _endpoint_for(device) -> IciEndpoint:
+    with _ep_lock:
+        ep = _endpoints.get(device.id)
+        if ep is None:
+            ep = IciEndpoint(device)
+            _endpoints[device.id] = ep
+        return ep
+
+
+def ship(obj, target_device) -> str:
+    """Move a railable payload to `target_device` through the block pipe
+    and park it in the registry; returns the claim ticket for the meta.
+
+    This is the CutFromIOBufList moment: bytes that would have been
+    serialized into the socket ride the ICI send path instead."""
+    arrays = list(obj) if isinstance(obj, (list, tuple)) else [obj]
+    single = not isinstance(obj, (list, tuple))
+    ep = _endpoint_for(target_device)
+    entries = []
+    try:
+        for a in arrays:
+            src_pool = get_block_pool(source_device(a))
+            staged = _stage_one(a, src_pool)
+            try:
+                moved = ep.send_blocks(staged)
+            finally:
+                for b in staged:
+                    b.free()
+            entries.append(_Entry(moved, str(np.dtype(a.dtype)),
+                                  tuple(a.shape), a.nbytes))
+            rail_bytes.add(a.nbytes)
+    except Exception:
+        for e in entries:
+            e.free()
+        raise
+    rail_payloads.add(1)
+    return deposit(entries, single)
+
+
+# ---------------------------------------------------------------------------
+# proof hooks
+# ---------------------------------------------------------------------------
+
+def host_copy_count() -> int:
+    """Total payload-bytes-materialized-on-host events across the tensor
+    serializer and the block pool.  A rail round-trip must leave this
+    unchanged — the test's 'provably never bounced through host bytes'."""
+    from brpc_tpu.ici import block_pool
+    from brpc_tpu.rpc import serialization
+    return (serialization.tensor_host_encodes.get_value()
+            + serialization.tensor_host_decodes.get_value()
+            + block_pool.host_stage_count.get_value()
+            + block_pool.host_read_count.get_value())

@@ -195,7 +195,8 @@ class SocketMap:
 class _CallState:
     __slots__ = ("cntl", "channel", "meta_template", "body", "done",
                  "deadline_timer", "backup_timer", "sids", "tried_servers",
-                 "pooled_conns", "short_conns")
+                 "pooled_conns", "short_conns", "rail_obj", "rail_tickets",
+                 "rail_fallback_cache")
 
     def __init__(self, cntl, channel, meta_template, body, done):
         self.cntl = cntl
@@ -207,6 +208,12 @@ class _CallState:
         self.backup_timer = None
         self.sids: set[int] = set()
         self.tried_servers: list[EndPoint] = []
+        # device-array payload deferred to _issue: staged over ICI when the
+        # selected server advertises a device (ici/rail.py), host-serialized
+        # only as the fallback
+        self.rail_obj = None
+        self.rail_tickets: list[str] = []
+        self.rail_fallback_cache = None  # (body, tensor_header) once encoded
         # connections this call checked out (pooled) or owns (short); given
         # back / closed at completion — late replies are matched by cid, so
         # recycling before a stale attempt answers is safe
@@ -300,7 +307,12 @@ class CallManager:
         with self._lock:
             st = self._pending.get(meta.correlation_id)
         if st is None:
-            return  # stale attempt after completion — dropped
+            # stale attempt after completion — dropped; a rail ticket riding
+            # it must be freed now, not left to the registry TTL
+            if meta.user_fields and meta.user_fields.get("icit"):
+                from brpc_tpu.ici import rail
+                rail.withdraw(meta.user_fields["icit"])
+            return
         cntl = st.cntl
         if meta.error_code != 0:
             # Stale-attempt errors must not touch the live call: only the
@@ -315,6 +327,20 @@ class CallManager:
             self._finish(st)
             return
         # success: decode body
+        rail_ticket = meta.user_fields.get("icit") if meta.user_fields else None
+        if rail_ticket is not None:
+            # response payload rode ICI: claim the device arrays parked in
+            # the rail registry — no body bytes exist to decode
+            from brpc_tpu.ici import rail
+            try:
+                cntl.reset_for_retry()
+                cntl.response = rail.claim(rail_ticket)
+                cntl.response_attachment = b""
+            except Exception as e:
+                cntl.set_failed(errors.ERESPONSE,
+                                f"cannot claim rail payload: {e}")
+            self._finish(st)
+            return
         try:
             raw = body if isinstance(body, bytes) else body.to_bytes()
             att_size = meta.attachment_size
@@ -385,6 +411,14 @@ class CallManager:
         cntl = st.cntl
         import time
         cntl.latency_us = int(time.monotonic() * 1e6) - cntl._start_us
+        if st.rail_tickets:
+            # free staged payloads of attempts the server never claimed
+            # (timeouts, failed sockets); claim is an atomic pop, so a
+            # concurrently-claiming server wins and this no-ops
+            from brpc_tpu.ici import rail
+            for ticket in st.rail_tickets:
+                rail.withdraw(ticket)
+            st.rail_tickets.clear()
         # recycle per-call connections (pooled back to the free list,
         # short closed — ConnectionType semantics, protocol.h:161-180)
         if st.pooled_conns:
@@ -489,8 +523,21 @@ class Channel:
             cntl._done_event = threading.Event()
 
         ser = get_serializer(serializer)
-        body, tensor_header = ser.encode(request)
-        body = compress(body, cntl.compress_type)
+        rail_obj = None
+        if ser.name == "tensor" and not cntl.request_attachment:
+            # attachments ride the socket body; mixing them with a railed
+            # payload would drop them — such calls stay on the host path
+            from brpc_tpu.ici import rail
+            if rail.railable(request):
+                # Defer serialization: the payload may ride ICI instead of
+                # the socket, decided per attempt once the server is known
+                # (the CutFromIOBufList slot — socket.cpp:1751-1757).
+                rail_obj = request
+        if rail_obj is None:
+            body, tensor_header = ser.encode(request)
+            body = compress(body, cntl.compress_type)
+        else:
+            body, tensor_header = b"", b""
         meta = M.RpcMeta(
             msg_type=M.MSG_REQUEST,
             correlation_id=cntl.correlation_id,
@@ -523,6 +570,7 @@ class Channel:
         meta.span_id = cntl.span_id = sid_
 
         st = _CallState(cntl, self, meta, body, done)
+        st.rail_obj = rail_obj
         mgr = CallManager.instance()
         mgr.register(st)
 
@@ -585,6 +633,8 @@ class Channel:
             return
         meta = st.meta_template
         meta.attempt = cntl.current_attempt
+        if st.rail_obj is not None:
+            self._prepare_rail_attempt(st, ep)
         if self.options.auth is not None:
             # fresh credential per attempt: replay-tracking authenticators
             # (HmacAuthenticator) reject a reused nonce, so retries and
@@ -610,6 +660,38 @@ class Channel:
             if self._should_retry(st):
                 return
             mgr._finish(st)
+
+    def _prepare_rail_attempt(self, st: _CallState, ep: EndPoint) -> None:
+        """Decide, per attempt, whether the device-array payload rides ICI
+        (server advertised a device: stage + transfer + deposit, frame
+        carries a ticket) or falls back to host serialization.  Mirrors how
+        the reference picks RdmaEndpoint vs the fd per socket at write
+        time (socket.cpp:1751-1757)."""
+        from brpc_tpu.ici import rail
+        meta = st.meta_template
+        meta.user_fields.pop(rail.F_TICKET, None)
+        meta.user_fields.pop(rail.F_SRC_DEV, None)
+        dev = rail.lookup(ep)
+        if dev is not None:
+            try:
+                ticket = rail.ship(st.rail_obj, dev)
+            except Exception:
+                dev = None  # pool exhausted / transfer failed: host fallback
+            else:
+                st.rail_tickets.append(ticket)
+                meta.user_fields[rail.F_TICKET] = ticket
+                meta.user_fields[rail.F_SRC_DEV] = str(
+                    rail.source_device(st.rail_obj).id)
+                meta.tensor_header = b""
+                st.body = b""
+                return
+        rail.rail_fallbacks.add(1)
+        if st.rail_fallback_cache is None:
+            ser = get_serializer("tensor")
+            body, tensor_header = ser.encode(st.rail_obj)
+            st.rail_fallback_cache = (compress(body, st.cntl.compress_type),
+                                      tensor_header)
+        st.body, meta.tensor_header = st.rail_fallback_cache
 
     def _should_retry(self, st: _CallState) -> bool:
         """If allowed, bump the attempt and re-issue.  Returns True when a

@@ -25,7 +25,14 @@ from typing import Any
 
 import numpy as np
 
+from brpc_tpu.bvar import Adder
 from brpc_tpu.rpc import meta as M
+
+# Host-materialization counters: every tensor body that becomes host bytes
+# is counted, so the ICI rail's zero-host-copy claim is testable
+# (ici/rail.py host_copy_count).
+tensor_host_encodes = Adder("tensor_host_encodes")
+tensor_host_decodes = Adder("tensor_host_decodes")
 
 try:
     import zstandard as _zstd
@@ -103,6 +110,7 @@ class TensorSerializer(Serializer):
         arrays = obj if isinstance(obj, (list, tuple)) else [obj]
         hdr = [struct.pack("<B", len(arrays))]
         bodies = []
+        tensor_host_encodes.add(1)
         for a in arrays:
             a = np.asarray(a)
             dt = a.dtype.str.encode()
@@ -117,6 +125,7 @@ class TensorSerializer(Serializer):
     def decode(self, body, tensor_header):
         if not tensor_header:
             return body
+        tensor_host_decodes.add(1)
         single = tensor_header[0] == 1
         off = 1
         count = tensor_header[off]

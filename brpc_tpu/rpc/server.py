@@ -60,6 +60,11 @@ class ServerOptions:
     # data_factory.h): a DataFactory, or a zero-arg callable; each request
     # sees the pooled object as cntl.session_data.
     session_data_factory: Optional[Any] = None
+    # Advertise this server as ICI-reachable on the given jax device: tensor
+    # payloads from in-process channels then ride the BlockPool/IciEndpoint
+    # rail instead of the socket (the use_rdma switch — channel.h:109,
+    # rdma_endpoint.h:82; see ici/rail.py).
+    ici_device: Optional[Any] = None
 
 
 class MethodStatus:
@@ -253,6 +258,9 @@ class Server:
         for key in self._methods:
             _native_method_register(key)
         self._methods_registered = True
+        if self.options.ici_device is not None:
+            from brpc_tpu.ici import rail
+            rail.advertise(self._port, self.options.ici_device)
         self._started = True
         self._start_time = time.time()
         _register_server(self)
@@ -271,6 +279,9 @@ class Server:
         if not self._started or self._stopping:
             return
         self._stopping = True
+        if self.options.ici_device is not None and self._port is not None:
+            from brpc_tpu.ici import rail
+            rail.unadvertise(self._port)
         if self._listen_sid is not None:
             Transport.instance().close(self._listen_sid)
 
@@ -536,16 +547,26 @@ class Server:
         cntl.trace_id = span.trace_id
         cntl.span_id = span.span_id
         error_code = 0
+        rail_src = meta.user_fields.get("icisrc") if meta.user_fields else None
         try:
-            # fast-path bodies arrive as bytes (converted C-side); the
-            # generic path hands an IOBuf
-            raw = body if isinstance(body, bytes) else body.to_bytes()
-            att = meta.attachment_size
-            payload = raw[: len(raw) - att] if att else raw
-            cntl.request_attachment = raw[len(raw) - att:] if att else b""
-            payload = decompress(payload, meta.compress_type)
-            request = spec.request_serializer.decode(payload, meta.tensor_header)
-            span.request_size = len(raw)
+            if meta.user_fields.get("icit"):
+                # request payload rode ICI: claim the device arrays from the
+                # rail registry (ici/rail.py) — the frame carried only the
+                # ticket, no body bytes exist
+                from brpc_tpu.ici import rail
+                request = rail.claim(meta.user_fields["icit"])
+                span.request_size = 0
+            else:
+                # fast-path bodies arrive as bytes (converted C-side); the
+                # generic path hands an IOBuf
+                raw = body if isinstance(body, bytes) else body.to_bytes()
+                att = meta.attachment_size
+                payload = raw[: len(raw) - att] if att else raw
+                cntl.request_attachment = raw[len(raw) - att:] if att else b""
+                payload = decompress(payload, meta.compress_type)
+                request = spec.request_serializer.decode(payload,
+                                                         meta.tensor_header)
+                span.request_size = len(raw)
             rpcz.set_current_span(span)
             if self._session_pool is not None:
                 cntl.session_data = self._session_pool.borrow()
@@ -559,6 +580,9 @@ class Server:
             if cntl.failed():
                 error_code = cntl.error_code
                 self._respond_error(sid, meta, cntl.error_code, cntl.error_text)
+            elif rail_src is not None and self._ship_rail_response(
+                    sid, meta, span, cntl, response, rail_src):
+                pass  # response rode ICI; control frame already written
             else:
                 res_ser = spec.response_serializer
                 rbody, theader = res_ser.encode(response)
@@ -609,6 +633,39 @@ class Server:
                 self._inflight -= 1
                 if self._inflight == 0:
                     self._inflight_zero.set()
+
+    def _ship_rail_response(self, sid: int, meta: M.RpcMeta, span, cntl,
+                            response, rail_src: str) -> bool:
+        """Return the response over the ICI rail: stage the handler's device
+        arrays, transfer them to the requester's device, and write a
+        control-only response frame carrying the claim ticket.  Returns
+        False (caller host-serializes) when the response isn't device
+        arrays, the transfer fails, or the response needs frame features
+        the rail's control-only frame doesn't carry (stream settings,
+        attachment bytes)."""
+        from brpc_tpu.ici import rail
+        if cntl._stream is not None or cntl.response_attachment:
+            return False
+        if not rail.railable(response):
+            return False
+        try:
+            target = rail.device_by_id(int(rail_src))
+            ticket = rail.ship(response, target)
+        except Exception:
+            rail.rail_fallbacks.add(1)
+            return False
+        resp = M.RpcMeta(msg_type=M.MSG_RESPONSE,
+                         correlation_id=meta.correlation_id,
+                         attempt=meta.attempt,
+                         content_type="tensor",
+                         trace_id=span.trace_id,
+                         span_id=span.span_id)
+        resp.user_fields["icit"] = ticket
+        span.response_size = 0
+        if Transport.instance().write_frame(sid, resp.encode(), b"") != 0:
+            # peer gone: the ticket would leak until TTL — free it now
+            rail.withdraw(ticket)
+        return True
 
     def _total_concurrency(self) -> int:
         return sum(s.concurrency for s in self._method_status.values())
