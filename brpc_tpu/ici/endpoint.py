@@ -46,6 +46,15 @@ import jax.numpy as _jnp
 
 _device_copy = jax.jit(_jnp.copy)
 
+# Pre-compiled MULTI-chunk copy: one XLA program holding k copy HLOs, so a
+# k-chunk batch costs ONE Python->PJRT dispatch instead of k (VERDICT r2
+# task 2 — per-chunk dispatch was the pipe's bottleneck: ~ms of host work
+# per chunk vs ~0.2ms of HBM time for a 64MB copy).  jit specializes and
+# caches per (arity, shapes, dtypes), so this single definition is the
+# whole "transfer program" cache.  No donation here: donating would let
+# XLA alias outputs onto inputs and the copies must provably move bytes.
+_multi_copy = jax.jit(lambda *xs: tuple(_jnp.copy(x) for x in xs))
+
 
 def _collect_batch(q, first):
     """Drain everything already sitting in `q` behind `first` without
@@ -184,6 +193,76 @@ class IciEndpoint:
         out.block_until_ready()
         return out
 
+    def send_batch(self, arrays, timeout_s: float = 30.0) -> list:
+        """Transfer a batch of arrays with ONE dispatch and ONE completion
+        record.  Same-device arrays ride a single pre-compiled multi-copy
+        program (_multi_copy); cross-device arrays ride one device_put of
+        the whole list.  The window is reserved for the batch total, so
+        size batches <= window_bytes (larger batches raise).
+
+        This is the pipe's fast path: per-chunk Python dispatch and
+        per-chunk completion observation — the costs that capped r2's
+        ladder at ~5 GB/s while the chip streams 670 — are amortized over
+        the batch."""
+        arrays = list(arrays)
+        if not arrays:
+            return []
+        total = sum(a.nbytes for a in arrays)
+        if total > self.window_bytes:
+            raise ValueError(
+                f"batch of {total}B exceeds window {self.window_bytes}B; "
+                f"split it or widen the window")
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while self._inflight + total > self.window_bytes:
+                if self._closed:
+                    raise RuntimeError("endpoint closed")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"ICI window full ({self.window_bytes}B)")
+                self._cv.wait(min(remaining, 1.0))
+            self._inflight += total
+        t0 = time.monotonic()
+        try:
+            with self._dispatch_mu:
+                same = []
+                cross = []
+                for i, a in enumerate(arrays):
+                    try:
+                        is_same = a.devices() == {self.device}
+                    except Exception:
+                        is_same = False
+                    (same if is_same else cross).append(i)
+                outs = [None] * len(arrays)
+                # one completion entry per dispatch group (compiled copies
+                # and device_put DMAs may ride different engines, so one
+                # group's tail cannot vouch for the other's)
+                if same:
+                    copied = _multi_copy(*[arrays[i] for i in same])
+                    for i, c in zip(same, copied):
+                        outs[i] = c
+                    _same_device_copies.add(len(same))
+                    same_bytes = sum(arrays[i].nbytes for i in same)
+                    self._completions.put((copied[-1], same_bytes, t0))
+                if cross:
+                    moved = jax.device_put([arrays[i] for i in cross],
+                                           self.device)
+                    for i, m in zip(cross, moved):
+                        outs[i] = m
+                    _cross_device_moves.add(len(cross))
+                    cross_bytes = sum(arrays[i].nbytes for i in cross)
+                    self._completions.put((moved[-1], cross_bytes, t0))
+        except Exception:
+            with self._cv:
+                self._inflight -= total
+                self._cv.notify_all()
+            raise
+        _send_bytes.add(total)
+        _send_count.add(len(arrays))
+        self._ensure_drainer()
+        return outs
+
     # ------------------------------------------------------------------
     # Block pipe: BlockPool-staged byte transfers.  The analog of the
     # reference's RDMA path where IOBuf blocks come from the registered
@@ -195,20 +274,34 @@ class IciEndpoint:
     # ------------------------------------------------------------------
 
     def send_blocks(self, blocks, timeout_s: float = 30.0) -> list:
-        """Transfer each source Block's device buffer to this endpoint's
+        """Transfer the source Blocks' device buffers to this endpoint's
         device, installing results into blocks allocated from the target
-        device's pool.  Returns the destination Blocks (caller frees)."""
+        device's pool.  Returns the destination Blocks (caller frees).
+        Blocks are grouped into window-sized batches so a multi-block
+        payload costs one dispatch per window, not one per block."""
         from brpc_tpu.ici.block_pool import get_block_pool
         dst_pool = get_block_pool(self.device)
         out = []
-        for b in blocks:
-            moved = self.send(b.view(), timeout_s=timeout_s)
-            # alloc by the transferred buffer's size (not b.used) so the
-            # destination class always covers the source class, even when
-            # either pool has fallen through to a larger class
-            nb = dst_pool.alloc(moved.nbytes)
-            nb.install(moved, b.used, meta=getattr(b, "_src_meta", None))
-            out.append(nb)
+        i = 0
+        while i < len(blocks):
+            batch = []
+            batch_bytes = 0
+            while i < len(blocks):
+                nb = blocks[i].view().nbytes
+                if batch and batch_bytes + nb > self.window_bytes:
+                    break
+                batch.append(blocks[i])
+                batch_bytes += nb
+                i += 1
+            moved = self.send_batch([b.view() for b in batch],
+                                    timeout_s=timeout_s)
+            for b, m in zip(batch, moved):
+                # alloc by the transferred buffer's size (not b.used) so the
+                # destination class always covers the source class, even
+                # when either pool has fallen through to a larger class
+                dst = dst_pool.alloc(m.nbytes)
+                dst.install(m, b.used, meta=getattr(b, "_src_meta", None))
+                out.append(dst)
         return out
 
     def send_bytes(self, data, src_pool, timeout_s: float = 30.0) -> list:

@@ -44,6 +44,22 @@ class TensorStream:
             out = self.endpoint.send(array)
             self._q.put(("tensor", out, 0, None))
 
+    def write_many(self, arrays) -> list:
+        """Queue a batch of tensors with ONE dispatch (endpoint.send_batch)
+        — the amortized fast path for uniform chunk streams; consumer
+        ordering is unchanged.  Returns the destination handles so callers
+        can observe transfer completion directly (block_until_ready on the
+        last handle) without waiting for consumer delivery."""
+        if self._closed.is_set():
+            raise RuntimeError("stream closed")
+        if not arrays:
+            return []
+        with self._write_mu:
+            outs = self.endpoint.send_batch(arrays)
+            for out in outs:
+                self._q.put(("tensor", out, 0, None))
+        return outs
+
     def write_bytes(self, data, src_pool=None) -> None:
         """Stream a byte payload staged through BlockPool slots on the
         source side (HBM-born, like the reference's pool-allocated IOBuf

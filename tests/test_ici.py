@@ -1,6 +1,7 @@
 """ICI transport tests on the virtual 8-device CPU mesh (SURVEY.md §4:
 single-host multi-device plays the role 127.0.0.1 plays in the reference).
 """
+import threading
 import time
 import numpy as np
 import pytest
@@ -238,3 +239,79 @@ class TestIciChannel:
         out = pc.call_sync("MatSvc", "Inc", jnp.zeros((2,), jnp.float32))
         assert len(out) == 8
         np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+
+
+class TestBatchedTransfer:
+    """send_batch: k chunks through ONE pre-compiled multi-copy program
+    (VERDICT r2 task 2 — amortize per-chunk dispatch)."""
+
+    def test_send_batch_same_device_real_copies(self):
+        import jax.numpy as jnp
+        from brpc_tpu.ici import IciEndpoint
+        dev = jax.devices()[0]
+        ep = IciEndpoint(dev)
+        xs = [jax.device_put(jnp.full((256,), float(i), jnp.float32), dev)
+              for i in range(6)]
+        outs = ep.send_batch(xs)
+        try:
+            for x, o in zip(xs, outs):
+                o.block_until_ready()
+                assert o.devices() == {dev}
+                assert bool(jnp.array_equal(o, x))
+                # distinct destination buffer — the copy really moved bytes
+                assert (o.unsafe_buffer_pointer()
+                        != x.unsafe_buffer_pointer())
+        finally:
+            ep.close()
+
+    def test_send_batch_mixed_devices(self):
+        import jax.numpy as jnp
+        from brpc_tpu.ici import IciEndpoint
+        devs = jax.devices()
+        target = devs[2]
+        ep = IciEndpoint(target)
+        xs = [jax.device_put(jnp.full((64,), float(i), jnp.float32),
+                             devs[i % 4]) for i in range(8)]
+        outs = ep.send_batch(xs)
+        try:
+            for x, o in zip(xs, outs):
+                o.block_until_ready()
+                assert o.devices() == {target}
+                np.testing.assert_array_equal(np.asarray(o), np.asarray(x))
+        finally:
+            ep.close()
+
+    def test_send_batch_window_accounting(self):
+        import jax.numpy as jnp
+        from brpc_tpu.ici import IciEndpoint
+        dev = jax.devices()[0]
+        ep = IciEndpoint(dev, window_bytes=1 << 20)
+        x = jnp.ones((1024,), jnp.uint8)
+        outs = ep.send_batch([x] * 16)
+        outs[-1].block_until_ready()
+        deadline = time.monotonic() + 5
+        while ep.inflight_bytes and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ep.inflight_bytes == 0
+        with pytest.raises(ValueError):
+            ep.send_batch([jnp.ones((1 << 19,), jnp.uint8)] * 3)
+        ep.close()
+
+    def test_write_many_preserves_order(self):
+        import jax.numpy as jnp
+        from brpc_tpu.ici import TensorStream
+        dev = jax.devices()[1]
+        got = []
+        done = threading.Event()
+        def consume(a):
+            got.append(int(a[0]))
+            if len(got) == 12:
+                done.set()
+        ts = TensorStream(dev, consumer=consume)
+        ts.write_many([jnp.full((16,), float(i), jnp.float32)
+                       for i in range(8)])
+        ts.write_many([jnp.full((16,), float(i), jnp.float32)
+                       for i in range(8, 12)])
+        assert done.wait(20)
+        ts.close()
+        assert got == list(range(12))
