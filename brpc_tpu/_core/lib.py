@@ -173,6 +173,18 @@ _sigs = {
                                        ctypes.POINTER(ctypes.c_double),
                                        ctypes.POINTER(ctypes.c_double),
                                        ctypes.POINTER(ctypes.c_double)]),
+    # fiber / butex (coroutine M:N runtime, src/cc/bthread/fiber.h)
+    "brpc_fiber_demo_start": (ctypes.c_void_p, [ctypes.c_int]),
+    "brpc_fiber_demo_blocked": (ctypes.c_int, [ctypes.c_void_p]),
+    "brpc_fiber_demo_started": (ctypes.c_int64, [ctypes.c_void_p]),
+    "brpc_fiber_demo_release": (None, [ctypes.c_void_p]),
+    "brpc_fiber_demo_join": (ctypes.c_int, [ctypes.c_void_p, ctypes.c_int]),
+    "brpc_fiber_demo_free": (None, [ctypes.c_void_p]),
+    "brpc_fiber_pingpong": (ctypes.c_int, [ctypes.c_int, ctypes.c_int]),
+    "brpc_fiber_mutex_stress": (ctypes.c_int64, [ctypes.c_int, ctypes.c_int,
+                                                 ctypes.c_int]),
+    "brpc_fiber_sleep_probe": (ctypes.c_int64, [ctypes.c_int64,
+                                                ctypes.c_int]),
 }
 for _name, (_res, _args) in _sigs.items():
     fn = getattr(core, _name)

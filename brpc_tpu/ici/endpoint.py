@@ -150,12 +150,11 @@ class IciEndpoint:
         _cross_device_moves.add(1)
         return jax.device_put(array, self.device)
 
-    def send(self, array: jax.Array, timeout_s: float = 30.0) -> jax.Array:
-        """Start an async transfer of `array` to this endpoint's device;
-        returns the (not-yet-ready) destination array.  Blocks while the
-        credit window is exhausted — same EAGAIN discipline as
-        RdmaEndpoint's SQ/window check (rdma_endpoint.h:235-240)."""
-        nbytes = array.nbytes
+    def _reserve_window(self, nbytes: int, timeout_s: float) -> None:
+        """Block until `nbytes` of credit is available, then reserve it —
+        the EAGAIN discipline of RdmaEndpoint's SQ/window check
+        (rdma_endpoint.h:235-240).  Shared by send and send_batch so the
+        credit protocol has exactly one implementation."""
         deadline = time.monotonic() + timeout_s
         with self._cv:
             while self._inflight + nbytes > self.window_bytes:
@@ -164,9 +163,23 @@ class IciEndpoint:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(
-                        f"ICI window full ({self.window_bytes}B) ")
+                        f"ICI window full ({self.window_bytes}B)")
                 self._cv.wait(min(remaining, 1.0))
             self._inflight += nbytes
+
+    def _release_window(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._cv:
+            self._inflight -= nbytes
+            self._cv.notify_all()
+
+    def send(self, array: jax.Array, timeout_s: float = 30.0) -> jax.Array:
+        """Start an async transfer of `array` to this endpoint's device;
+        returns the (not-yet-ready) destination array.  Blocks while the
+        credit window is exhausted."""
+        nbytes = array.nbytes
+        self._reserve_window(nbytes, timeout_s)
         t0 = time.monotonic()
         try:
             with self._dispatch_mu:
@@ -179,9 +192,7 @@ class IciEndpoint:
         except Exception:
             # release the window reservation or failed sends would shrink
             # the window permanently
-            with self._cv:
-                self._inflight -= nbytes
-                self._cv.notify_all()
+            self._release_window(nbytes)
             raise
         _send_bytes.add(nbytes)
         _send_count.add(1)
@@ -212,18 +223,13 @@ class IciEndpoint:
             raise ValueError(
                 f"batch of {total}B exceeds window {self.window_bytes}B; "
                 f"split it or widen the window")
-        deadline = time.monotonic() + timeout_s
-        with self._cv:
-            while self._inflight + total > self.window_bytes:
-                if self._closed:
-                    raise RuntimeError("endpoint closed")
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(
-                        f"ICI window full ({self.window_bytes}B)")
-                self._cv.wait(min(remaining, 1.0))
-            self._inflight += total
+        self._reserve_window(total, timeout_s)
         t0 = time.monotonic()
+        # bytes whose completion entry is already queued: the drainer will
+        # release their window share, so a partial-dispatch failure must
+        # release only the remainder (releasing `total` would double-free
+        # the queued share and drive the window counter negative)
+        queued = 0
         try:
             with self._dispatch_mu:
                 same = []
@@ -245,6 +251,7 @@ class IciEndpoint:
                     _same_device_copies.add(len(same))
                     same_bytes = sum(arrays[i].nbytes for i in same)
                     self._completions.put((copied[-1], same_bytes, t0))
+                    queued += same_bytes
                 if cross:
                     moved = jax.device_put([arrays[i] for i in cross],
                                            self.device)
@@ -253,10 +260,11 @@ class IciEndpoint:
                     _cross_device_moves.add(len(cross))
                     cross_bytes = sum(arrays[i].nbytes for i in cross)
                     self._completions.put((moved[-1], cross_bytes, t0))
+                    queued += cross_bytes
         except Exception:
-            with self._cv:
-                self._inflight -= total
-                self._cv.notify_all()
+            self._release_window(total - queued)
+            if queued:
+                self._ensure_drainer()   # someone must observe the queued part
             raise
         _send_bytes.add(total)
         _send_count.add(len(arrays))
@@ -285,16 +293,17 @@ class IciEndpoint:
         i = 0
         while i < len(blocks):
             batch = []
+            views = []            # one view() (one pool-lock hit) per block
             batch_bytes = 0
             while i < len(blocks):
-                nb = blocks[i].view().nbytes
-                if batch and batch_bytes + nb > self.window_bytes:
+                v = blocks[i].view()
+                if batch and batch_bytes + v.nbytes > self.window_bytes:
                     break
                 batch.append(blocks[i])
-                batch_bytes += nb
+                views.append(v)
+                batch_bytes += v.nbytes
                 i += 1
-            moved = self.send_batch([b.view() for b in batch],
-                                    timeout_s=timeout_s)
+            moved = self.send_batch(views, timeout_s=timeout_s)
             for b, m in zip(batch, moved):
                 # alloc by the transferred buffer's size (not b.used) so the
                 # destination class always covers the source class, even

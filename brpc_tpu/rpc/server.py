@@ -546,8 +546,8 @@ class Server:
         cntl.peer_sid = sid
         cntl.trace_id = span.trace_id
         cntl.span_id = span.span_id
-        error_code = 0
         rail_src = meta.user_fields.get("icisrc") if meta.user_fields else None
+        # ---- decode phase ----
         try:
             if meta.user_fields.get("icit"):
                 # request payload rode ICI: claim the device arrays from the
@@ -567,16 +567,73 @@ class Server:
                 request = spec.request_serializer.decode(payload,
                                                          meta.tensor_header)
                 span.request_size = len(raw)
-            rpcz.set_current_span(span)
+        except Exception as e:
+            self._complete_request(sid, meta, span, cntl, spec, status,
+                                   start, rail_src, None, exc=e)
+            return
+        # ---- handler phase ----
+        # `done` runs the response path exactly once; a handler that calls
+        # cntl.defer() parks the RPC as this closure (data, not a thread)
+        # and any thread releases it later — the reference's done Closure
+        # (svc->CallMethod(..., done) baidu_rpc_protocol.cpp:398).
+        fired = [False]
+        fired_mu = threading.Lock()
+
+        def done(response=None):
+            with fired_mu:
+                if fired[0]:
+                    raise RuntimeError(
+                        f"done() called twice for {meta.service}.{meta.method}"
+                        f" cid={meta.correlation_id}")
+                fired[0] = True
+            self._complete_request(sid, meta, span, cntl, spec, status,
+                                   start, rail_src, response)
+
+        cntl._server_done = done
+        rpcz.set_current_span(span)
+        if self._session_pool is not None:
+            cntl.session_data = self._session_pool.borrow()
+        try:
+            response = spec.fn(cntl, request)
+        except Exception as e:
+            if cntl._deferred:
+                # defer() transferred response ownership to done(); the
+                # raise is a handler bug but completing here would race
+                # the legitimate done() (reference contract: after done is
+                # handed to CallMethod the framework never responds on
+                # handler return — a leaked done hangs, an owned one wins)
+                import traceback
+                traceback.print_exc()
+                return
+            with fired_mu:
+                already = fired[0]
+                fired[0] = True
+            if not already:
+                self._complete_request(sid, meta, span, cntl, spec, status,
+                                       start, rail_src, None, exc=e)
+            return
+        finally:
+            rpcz.set_current_span(None)
             if self._session_pool is not None:
-                cntl.session_data = self._session_pool.borrow()
-            try:
-                response = spec.fn(cntl, request)
-            finally:
-                rpcz.set_current_span(None)
-                if self._session_pool is not None:
-                    self._session_pool.give_back(cntl.session_data)
-                    cntl.session_data = None
+                # deferred handlers must not rely on session_data after
+                # returning: the pooled object goes back with the handler
+                self._session_pool.give_back(cntl.session_data)
+                cntl.session_data = None
+        if cntl._deferred:
+            return  # the parked done() closure completes the RPC later
+        done(response)
+
+    def _complete_request(self, sid: int, meta: M.RpcMeta, span, cntl,
+                          spec, status, start: float, rail_src,
+                          response, exc: Exception | None = None) -> None:
+        """Response path + accounting (SendRpcResponse analog,
+        baidu_rpc_protocol.cpp:187).  Runs exactly once per accepted
+        request — inline for plain handlers, from done() for deferred
+        ones."""
+        error_code = 0
+        try:
+            if exc is not None:
+                raise exc
             if cntl.failed():
                 error_code = cntl.error_code
                 self._respond_error(sid, meta, cntl.error_code, cntl.error_text)

@@ -1,0 +1,178 @@
+#include "bthread/butex.h"
+
+#include <climits>
+
+#include "bthread/executor.h"
+#include "bthread/timer.h"
+
+namespace bthread {
+
+// Heap-allocated, refcounted waiter record.  Two owners can hold a pointer
+// concurrently: the butex list/waker side and the timer callback.  The
+// claim word decides who resumes the coroutine (exactly once); the
+// refcount decides who frees the record (exactly once).  The reference
+// keeps its ButexWaiter on the waiting bthread's stack and relies on the
+// stack outliving the wake (butex.cpp erase_from_butex) — with coroutine
+// frames destroyed on completion we cannot, hence the refcount.
+struct Waiter {
+  std::coroutine_handle<> handle;
+  std::atomic<Butex*> owner{nullptr}; // list the waiter currently sits on
+  Waiter* next = nullptr;
+  Waiter* prev = nullptr;
+  uint64_t timer_id = 0;
+  std::atomic<int> claim{0};          // 0 pending, 1 woken, 2 timed out
+  std::atomic<int> refs{1};
+  WaitResult* result_slot = nullptr;  // points into the Awaiter (frame-owned)
+
+  void unref() {
+    if (refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete this;
+  }
+};
+
+namespace {
+
+void resume_waiter_task(void* arg) {
+  std::coroutine_handle<>::from_address(arg).resume();
+}
+
+// Resume on the executor, never inline: the caller may be the timer thread
+// or an event-dispatcher thread, and user code behind the co_await must
+// run on worker threads only (scheduler discipline — the reference wakes
+// through ready_to_run_general for the same reason).
+void schedule_resume(std::coroutine_handle<> h) {
+  Executor::global()->submit(resume_waiter_task, h.address());
+}
+
+}  // namespace
+
+void Butex::unlink_locked(Waiter* w) {
+  if (w->prev) w->prev->next = w->next; else _head = w->next;
+  if (w->next) w->next->prev = w->prev; else _tail = w->prev;
+  w->prev = w->next = nullptr;
+}
+
+void Butex::TimeoutTask(void* arg) {
+  Waiter* w = (Waiter*)arg;
+  int expected = 0;
+  if (w->claim.compare_exchange_strong(expected, 2,
+                                       std::memory_order_acq_rel)) {
+    // We own the wakeup.  Unlink from whichever butex the waiter sits on —
+    // requeue may have moved it since the timer was armed, so re-read the
+    // owner after taking its lock.
+    for (;;) {
+      Butex* b = w->owner.load(std::memory_order_acquire);
+      std::unique_lock<std::mutex> g(b->_mu);
+      if (w->owner.load(std::memory_order_acquire) != b) continue;
+      b->unlink_locked(w);
+      break;
+    }
+    *w->result_slot = WaitResult::kTimeout;
+    schedule_resume(w->handle);
+  }
+  w->unref();
+}
+
+Butex::~Butex() = default;
+
+bool Butex::Awaiter::await_suspend(std::coroutine_handle<> h) {
+  Butex* b = butex;
+  // Everything that touches the coroutine frame (the Awaiter fields)
+  // happens under the lock: a concurrent wake() cannot claim the waiter —
+  // and therefore cannot resume/destroy the frame — until this unlocks at
+  // return, by which point the frame is fully parked.
+  std::unique_lock<std::mutex> g(b->_mu);
+  if (b->value.load(std::memory_order_relaxed) != expected) {
+    result = WaitResult::kMismatch;
+    return false;  // do not suspend; resume inline
+  }
+  Waiter* w = new Waiter();
+  w->handle = h;
+  w->owner.store(b, std::memory_order_release);
+  w->result_slot = &result;
+  w->prev = b->_tail;                 // append FIFO
+  if (b->_tail) b->_tail->next = w; else b->_head = w;
+  b->_tail = w;
+  waiter = w;
+  if (timeout_us >= 0) {
+    w->refs.fetch_add(1, std::memory_order_relaxed);  // timer's reference
+    w->timer_id = TimerThread::global()->schedule_after(
+        &Butex::TimeoutTask, w, timeout_us);
+  }
+  return true;
+}
+
+WaitResult Butex::Awaiter::await_resume() noexcept {
+  if (waiter != nullptr) {
+    // On the woken path, reclaim the timer's reference if the timer is
+    // still armed; if unschedule fails the callback is running or ran and
+    // will drop its own reference (its claim CAS loses).
+    if (waiter->timer_id != 0 && result == WaitResult::kWoken) {
+      if (TimerThread::global()->unschedule(waiter->timer_id)) {
+        waiter->unref();
+      }
+    }
+    waiter->unref();
+    waiter = nullptr;
+  }
+  return result;
+}
+
+int Butex::wake(int n) {
+  Waiter* resume_list = nullptr;   // singly chained via ->next, LIFO then
+  Waiter* resume_tail = nullptr;   // ...kept FIFO with a tail pointer
+  int woken = 0;
+  {
+    std::lock_guard<std::mutex> g(_mu);
+    Waiter* w = _head;
+    while (w != nullptr && woken < n) {
+      Waiter* next_in_list = w->next;
+      int expected = 0;
+      if (w->claim.compare_exchange_strong(expected, 1,
+                                           std::memory_order_acq_rel)) {
+        unlink_locked(w);
+        if (resume_tail) resume_tail->next = w; else resume_list = w;
+        resume_tail = w;
+        ++woken;
+      }
+      // a timer-claimed waiter stays in the list; TimeoutTask unlinks it
+      w = next_in_list;
+    }
+  }
+  for (Waiter* w = resume_list; w != nullptr;) {
+    Waiter* next = w->next;
+    w->next = nullptr;
+    *w->result_slot = WaitResult::kWoken;
+    schedule_resume(w->handle);
+    w = next;
+  }
+  return woken;
+}
+
+int Butex::wake_all() { return wake(INT_MAX); }
+
+int Butex::requeue(Butex* target, int n_wake) {
+  const int woken = wake(n_wake);
+  if (target == this) return woken;
+  // Lock both in address order to dodge a concurrent opposite requeue.
+  Butex* a = this < target ? this : target;
+  Butex* b = this < target ? target : this;
+  std::scoped_lock g(a->_mu, b->_mu);
+  while (_head != nullptr) {
+    Waiter* w = _head;
+    unlink_locked(w);
+    w->owner.store(target, std::memory_order_release);
+    w->prev = target->_tail;
+    if (target->_tail) target->_tail->next = w; else target->_head = w;
+    target->_tail = w;
+  }
+  return woken;
+}
+
+int Butex::waiter_count() {
+  std::lock_guard<std::mutex> g(_mu);
+  int c = 0;
+  for (Waiter* w = _head; w != nullptr; w = w->next) ++c;
+  return c;
+}
+
+}  // namespace bthread

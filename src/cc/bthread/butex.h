@@ -1,0 +1,94 @@
+// Butex — THE blocking primitive, rebuilt for C++20 coroutines.
+//
+// Reference (src/bthread/butex.h:41-84, butex.cpp ~850 LoC): a 32-bit word
+// that bthreads wait on and any thread can wake; every other blocking
+// construct (mutex, cond, id, join, fd wait) is built on top.  The
+// reference parks a *fiber stack* (fcontext); we park a *coroutine frame*.
+// Same M:N economics — a blocked wait costs a ~100-byte heap frame, not an
+// OS thread — with the suspension point visible in the type system
+// (co_await) instead of hidden behind a stack switch.
+//
+// Semantics kept from the reference:
+//   - wait(expected): atomically "suspend iff *value == expected"; a wake
+//     or a value change between the caller's load and the enqueue is never
+//     missed (the check happens under the waiter lock).
+//   - wake(n)/wake_all: move waiters out under the lock, resume them on
+//     the executor (never inline on the waker's stack — the waker may be
+//     a timer or dispatcher thread, reference butex.cpp wakes through the
+//     scheduler for the same reason).
+//   - timed wait via TimerThread; timeout and wake race through an atomic
+//     claim so a waiter is resumed exactly once.
+//   - requeue: move waiters to another butex without waking (the
+//     cond->mutex handoff, reference butex_requeue).
+//
+// Deliberately not kept: pthread-mode waiters (our blocking Python callers
+// wait on a std::condition_variable bridge instead, see capi.cc) and the
+// bthread interrupt machinery (cancellation composes at the RPC layer).
+#pragma once
+
+#include <atomic>
+#include <coroutine>
+#include <cstdint>
+#include <mutex>
+
+namespace bthread {
+
+enum class WaitResult : int {
+  kWoken = 0,      // a wake() claimed and resumed us
+  kMismatch = 1,   // *value != expected at enqueue time; never suspended
+  kTimeout = 2,    // the deadline fired first
+};
+
+class Butex {
+ public:
+  Butex() : Butex(0) {}
+  explicit Butex(int32_t initial) : value(initial) {}
+  ~Butex();
+
+  Butex(const Butex&) = delete;
+  Butex& operator=(const Butex&) = delete;
+
+  // The waitable word.  Callers mutate it with ordinary atomic ops; the
+  // butex only reads it (under the waiter lock) to decide suspension.
+  std::atomic<int32_t> value;
+
+  struct [[nodiscard]] Awaiter {
+    Butex* butex;
+    int32_t expected;
+    int64_t timeout_us;            // <0: no timeout
+    struct Waiter* waiter = nullptr;
+    WaitResult result = WaitResult::kMismatch;
+
+    bool await_ready() const noexcept { return false; }
+    bool await_suspend(std::coroutine_handle<> h);
+    WaitResult await_resume() noexcept;
+  };
+
+  // co_await b.wait(expected): suspend iff value==expected, until wake()
+  // or timeout.  Spurious wakeups do not happen; re-checking the predicate
+  // is still on the caller (same contract as futex).
+  Awaiter wait(int32_t expected, int64_t timeout_us = -1) {
+    return Awaiter{this, expected, timeout_us};
+  }
+
+  // Wake up to n waiters (FIFO).  Returns the number resumed.
+  int wake(int n = 1);
+  int wake_all();
+  // Move all waiters except up to n_wake woken ones onto `target` without
+  // resuming them.  Returns number woken.
+  int requeue(Butex* target, int n_wake = 1);
+
+  // Waiters currently parked (approximate; for stats/tests).
+  int waiter_count();
+
+ private:
+  friend struct Awaiter;
+  friend struct Waiter;
+  static void TimeoutTask(void* arg);   // TimerThread callback
+  void unlink_locked(struct Waiter* w);
+  std::mutex _mu;
+  struct Waiter* _head = nullptr;  // FIFO: append at tail, pop at head
+  struct Waiter* _tail = nullptr;
+};
+
+}  // namespace bthread

@@ -1,0 +1,176 @@
+// Fiber & Task — C++20 coroutine tasks scheduled on the Executor; the
+// TPU-host answer to bthread's fcontext fibers (reference
+// src/bthread/task_group.cpp:601 sched_to, context.h:84 asm switch).
+//
+// A fiber's suspension points (co_await Butex::wait, FiberMutex::lock,
+// fiber_sleep_us) park a heap frame, not an OS thread: 10k blocked RPCs
+// cost 10k small frames on an 8-thread pool — the M:N economics that are
+// the whole point of bthread (SURVEY.md §2.2).  Where the reference hides
+// the switch behind a pthread-lookalike C API (bthread_start_background /
+// bthread_usleep), we surface it in the type system: anything that can
+// park is a co_await.  We control the ABI; bRPC had to look like pthreads.
+//
+// Two coroutine types:
+//   Fiber — detached root task (a bthread).  Frame self-destroys at
+//           completion; join composes via CountdownEvent, mirroring how
+//           bthread_join is butex_wait on the TaskMeta version word.
+//   Task  — awaitable child coroutine with symmetric transfer; lets
+//           primitives like FiberMutex::lock() loop and re-park.
+#pragma once
+
+#include <coroutine>
+#include <utility>
+
+#include "bthread/butex.h"
+#include "bthread/executor.h"
+
+namespace bthread {
+
+struct Fiber {
+  struct promise_type {
+    Fiber get_return_object() {
+      return Fiber{std::coroutine_handle<promise_type>::from_promise(*this)};
+    }
+    // Lazy start: the creator decides where the first resume runs
+    // (spawn() submits it to the executor).
+    std::suspend_always initial_suspend() noexcept { return {}; }
+    // Self-destroying: no one observes a finished fiber via the handle.
+    std::suspend_never final_suspend() noexcept { return {}; }
+    void return_void() noexcept {}
+    // A fiber body is a top-level task (like a bthread entry fn); an
+    // escaped exception has nowhere to go.  Fail fast.
+    void unhandled_exception() noexcept { std::terminate(); }
+  };
+
+  std::coroutine_handle<promise_type> handle;
+
+  // Start the fiber on the executor's worker pool.  The handle must not
+  // be touched afterwards (the frame may already be gone).
+  void spawn(Executor* ex = nullptr) {
+    auto h = std::exchange(handle, {});
+    (ex ? ex : Executor::global())
+        ->submit([](void* p) {
+          std::coroutine_handle<>::from_address(p).resume();
+        }, h.address());
+  }
+
+  // Run the first step inline on the calling thread (tests / callers
+  // already on a worker).
+  void run_inline() { std::exchange(handle, {}).resume(); }
+};
+
+// Awaitable void coroutine: starts when awaited, resumes the awaiter via
+// symmetric transfer at completion.  Single-shot, must be co_awaited.
+struct [[nodiscard]] Task {
+  struct promise_type {
+    std::coroutine_handle<> continuation;
+    Task get_return_object() {
+      return Task{std::coroutine_handle<promise_type>::from_promise(*this)};
+    }
+    std::suspend_always initial_suspend() noexcept { return {}; }
+    struct FinalAwaiter {
+      bool await_ready() const noexcept { return false; }
+      std::coroutine_handle<> await_suspend(
+          std::coroutine_handle<promise_type> h) noexcept {
+        // Child stays suspended at final; the Task destructor in the
+        // parent frame reclaims it after the parent resumes.
+        return h.promise().continuation;
+      }
+      void await_resume() const noexcept {}
+    };
+    FinalAwaiter final_suspend() noexcept { return {}; }
+    void return_void() noexcept {}
+    void unhandled_exception() noexcept { std::terminate(); }
+  };
+
+  explicit Task(std::coroutine_handle<promise_type> h) : handle(h) {}
+  Task(Task&& o) noexcept : handle(std::exchange(o.handle, {})) {}
+  Task(const Task&) = delete;
+  ~Task() { if (handle) handle.destroy(); }
+
+  bool await_ready() const noexcept { return false; }
+  std::coroutine_handle<> await_suspend(std::coroutine_handle<> cont) {
+    handle.promise().continuation = cont;
+    return handle;  // symmetric transfer into the child
+  }
+  void await_resume() const noexcept {}
+
+  std::coroutine_handle<promise_type> handle;
+};
+
+// ---- sync primitives over Butex (reference mutex.cpp / countdown_event) --
+
+// futex-classic mutex: value 0 unlocked, 1 locked, 2 locked+maybe-waiters.
+// lock() is a Task so the acquire loop can re-park after a wake — the
+// wake hands no ownership (same as futex; reference mutex.cpp).
+class FiberMutex {
+ public:
+  Task lock() {
+    for (;;) {
+      const int32_t prev =
+          _b.value.exchange(2, std::memory_order_acquire);
+      if (prev == 0) co_return;   // acquired (flagged contended: one
+                                  // spurious wake at unlock, never a hang)
+      co_await _b.wait(2);        // kMismatch => value moved; just retry
+    }
+  }
+
+  bool try_lock() {
+    int32_t zero = 0;
+    return _b.value.compare_exchange_strong(
+        zero, 1, std::memory_order_acquire, std::memory_order_relaxed);
+  }
+
+  void unlock() {
+    if (_b.value.exchange(0, std::memory_order_release) == 2) {
+      _b.wake(1);
+    }
+  }
+
+ private:
+  Butex _b{0};
+};
+
+// Countdown to zero; await parks until it hits zero.  The join primitive
+// (reference bthread/countdown_event.{h,cpp}); also how a fiber joins a
+// group of fibers.
+class CountdownEvent {
+ public:
+  explicit CountdownEvent(int initial) : _b(initial) {}
+
+  void signal(int n = 1) {
+    const int32_t prev = _b.value.fetch_sub(n, std::memory_order_acq_rel);
+    if (prev - n <= 0) _b.wake_all();
+  }
+
+  Task wait() {
+    for (;;) {
+      const int32_t cur = _b.value.load(std::memory_order_acquire);
+      if (cur <= 0) co_return;
+      co_await _b.wait(cur);  // woken at zero, or mismatch => re-check
+    }
+  }
+
+  int count() const { return _b.value.load(std::memory_order_acquire); }
+
+ private:
+  Butex _b;
+};
+
+// ---- fiber sleep (reference bthread_usleep -> TimerThread) ----
+
+struct [[nodiscard]] SleepAwaiter {
+  int64_t us;
+  Butex b{0};
+  Butex::Awaiter inner{};
+  bool await_ready() const noexcept { return us <= 0; }
+  bool await_suspend(std::coroutine_handle<> h) {
+    inner = b.wait(0, us);
+    return inner.await_suspend(h);  // value never changes: pure timeout
+  }
+  void await_resume() { (void)inner.await_resume(); }
+};
+
+inline SleepAwaiter fiber_sleep_us(int64_t us) { return SleepAwaiter{us}; }
+
+}  // namespace bthread

@@ -1,0 +1,175 @@
+"""Deferred server handlers (cntl.defer() -> done closure) — the RPC-level
+half of VERDICT r2 task 3: 10k concurrent in-flight RPCs served without
+10k OS threads.
+
+Reference: brpc passes a done Closure into svc->CallMethod
+(baidu_rpc_protocol.cpp:398); the handler may return and any thread runs
+done->Run() later, so an in-flight RPC is parked state, not a parked
+thread.  Here cntl.defer() returns the one-shot done(response) callable.
+"""
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.rpc.channel import Channel
+from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.server import Server
+from brpc_tpu.rpc.service import Service, method
+
+
+def _os_thread_count() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("Threads:"):
+                return int(line.split()[1])
+    raise RuntimeError("no Threads: line")
+
+
+class ParkService(Service):
+    NAME = "Park"
+
+    def __init__(self):
+        self.parked = []
+        self.mu = threading.Lock()
+
+    @method(request="raw", response="raw")
+    def Hold(self, cntl, request):
+        done = cntl.defer()
+        with self.mu:
+            self.parked.append((done, request))
+        return None  # ignored for deferred RPCs
+
+    @method(request="raw", response="raw")
+    def Echo(self, cntl, request):
+        return request
+
+
+@pytest.fixture()
+def server():
+    svc = ParkService()
+    srv = Server()
+    srv.add_service(svc)
+    srv.start("127.0.0.1", 0)
+    yield srv, svc
+    srv.stop()
+    srv.join()
+
+
+class TestDeferredHandlers:
+    def test_single_deferred_roundtrip(self, server):
+        srv, svc = server
+        ch = Channel(f"127.0.0.1:{srv.port}")
+        results = []
+        cntl = ch.call("Park", "Hold", b"ping",
+                       cntl=Controller(timeout_ms=10_000),
+                       done=lambda c: results.append(c))
+        deadline = time.monotonic() + 5
+        while not svc.parked and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(svc.parked) == 1
+        assert not results            # still in flight
+        done, req = svc.parked.pop()
+        done(req + b"-released")
+        deadline = time.monotonic() + 5
+        while not results and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert results and results[0].error_code == 0
+        assert results[0].response == b"ping-released"
+
+
+    def test_done_twice_raises(self, server):
+        srv, svc = server
+        ch = Channel(f"127.0.0.1:{srv.port}")
+        cntl = ch.call("Park", "Hold", b"x",
+                       cntl=Controller(timeout_ms=10_000),
+                       done=lambda c: None)
+        deadline = time.monotonic() + 5
+        while not svc.parked and time.monotonic() < deadline:
+            time.sleep(0.005)
+        done, req = svc.parked.pop()
+        done(req)
+        with pytest.raises(RuntimeError):
+            done(req)
+
+
+    def test_defer_outside_handler_raises(self):
+        with pytest.raises(RuntimeError):
+            Controller().defer()
+
+    def test_raise_after_defer_leaves_completion_to_done(self):
+        """Once defer() hands response ownership to done(), a handler
+        exception is logged, not auto-responded — the parked done() still
+        completes the RPC (the reference's done-Closure contract:
+        svc->CallMethod return never sends the response)."""
+        class Bad(Service):
+            NAME = "Bad"
+
+            @method(request="raw", response="raw")
+            def Boom(self, cntl, request):
+                d = cntl.defer()
+                threading.Timer(0.05, lambda: d(b"late-ok")).start()
+                raise ValueError("handler bug after defer")
+
+        srv = Server()
+        srv.add_service(Bad())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = Channel(f"127.0.0.1:{srv.port}")
+            assert ch.call_sync("Bad", "Boom", b"x") == b"late-ok"
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_10k_inflight_without_10k_threads(self, server):
+        """The task-3 'done' bar, end to end over real sockets: 10,000
+        RPCs accepted and parked server-side while the process thread
+        count stays flat; release them all; every client callback fires
+        with the right payload."""
+        srv, svc = server
+        n = 10_000
+        ch = Channel(f"127.0.0.1:{srv.port}")
+        completed = []
+        completed_mu = threading.Lock()
+
+        def on_done(c):
+            with completed_mu:
+                completed.append(c)
+
+        before = _os_thread_count()
+        cntls = [ch.call("Park", "Hold", str(i).encode(),
+                         cntl=Controller(timeout_ms=120_000), done=on_done)
+                 for i in range(n)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            with svc.mu:
+                if len(svc.parked) == n:
+                    break
+            time.sleep(0.02)
+        with svc.mu:
+            parked = len(svc.parked)
+        during = _os_thread_count()
+        assert parked == n, f"only {parked}/{n} RPCs parked"
+        assert not completed
+        # 10k in-flight RPCs added no per-RPC threads (closures, not
+        # stacks); allowance covers lazily-started runtime threads only
+        assert during - before < 32, (
+            f"thread count grew {before} -> {during} with {n} in-flight")
+        with svc.mu:
+            batch = list(svc.parked)
+            svc.parked.clear()
+        for done, req in batch:
+            done(req + b"!")
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            with completed_mu:
+                if len(completed) == n:
+                    break
+            time.sleep(0.02)
+        with completed_mu:
+            assert len(completed) == n, f"{len(completed)}/{n} completed"
+            errs = [c.error_code for c in completed if c.error_code != 0]
+            assert not errs, f"{len(errs)} failed, first codes {errs[:5]}"
+            bodies = {bytes(c.response) for c in completed}
+        assert bodies == {f"{i}!".encode() for i in range(n)}
+

@@ -1,0 +1,97 @@
+"""Fiber / butex tests — the coroutine M:N runtime (VERDICT r2 task 3).
+
+The reference's blocking primitive is butex (src/bthread/butex.cpp): a
+32-bit word bthreads park on, everything else built above it.  Ours parks
+C++20 coroutine frames on an 8-ish-thread executor; these tests assert the
+two properties that make it an M:N runtime and not a thread pool:
+
+  1. capacity: 10,000 concurrently-parked fibers cost heap frames, not OS
+     threads (the process thread count stays flat);
+  2. correctness under races: ping-pong wake/wait across workers, mutual
+     exclusion under contention, timed wait (reference
+     test/bthread_ping_pong_unittest.cpp, bthread_butex_unittest.cpp).
+"""
+import os
+import time
+
+import pytest
+
+from brpc_tpu._core import core, core_init
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _core():
+    core_init(num_workers=8, num_dispatchers=1)
+    yield
+
+
+def _os_thread_count() -> int:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("Threads:"):
+                return int(line.split()[1])
+    raise RuntimeError("no Threads: line")
+
+
+class TestFiberCapacity:
+    def test_10k_parked_fibers_without_10k_threads(self):
+        """The VERDICT r2 task-3 'done' bar: 10k concurrent in-flight
+        waits served without 10k OS threads."""
+        n = 10_000
+        before = _os_thread_count()
+        demo = core.brpc_fiber_demo_start(n)
+        try:
+            # all fibers reach the butex and park
+            deadline = time.monotonic() + 30
+            while (core.brpc_fiber_demo_blocked(demo) < n
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            blocked = core.brpc_fiber_demo_blocked(demo)
+            during = _os_thread_count()
+            assert blocked == n, f"only {blocked}/{n} fibers parked"
+            # 10k parked waits added ZERO OS threads (frames, not stacks);
+            # allow noise for lazily-started runtime threads
+            assert during - before < 32, (
+                f"thread count grew {before} -> {during}; "
+                f"fibers are pinning threads")
+            core.brpc_fiber_demo_release(demo)
+            assert core.brpc_fiber_demo_join(demo, 30_000) == 0
+            assert core.brpc_fiber_demo_blocked(demo) == 0
+        finally:
+            core.brpc_fiber_demo_free(demo)
+
+    def test_release_before_all_parked_is_not_lost(self):
+        """Wake racing enqueue: release immediately after start; the gate
+        value flip means late arrivals see 1 and never park (butex
+        wait(expected) mismatch semantics)."""
+        n = 500
+        demo = core.brpc_fiber_demo_start(n)
+        try:
+            core.brpc_fiber_demo_release(demo)
+            assert core.brpc_fiber_demo_join(demo, 30_000) == 0
+        finally:
+            core.brpc_fiber_demo_free(demo)
+
+
+class TestFiberRaces:
+    def test_pingpong(self):
+        """Two fibers bounce one butex word 20k times across the worker
+        pool — the wake/wait/claim race mill."""
+        assert core.brpc_fiber_pingpong(20_000, 60_000) == 0
+
+    def test_mutex_mutual_exclusion(self):
+        """64 fibers x 500 unsynchronized increments under FiberMutex ==
+        32000 iff the lock actually excludes."""
+        total = core.brpc_fiber_mutex_stress(64, 500, 60_000)
+        assert total == 64 * 500
+
+    def test_mutex_stress_heavier(self):
+        total = core.brpc_fiber_mutex_stress(128, 1000, 120_000)
+        assert total == 128 * 1000
+
+    def test_timed_sleep_wakes(self):
+        """fiber_sleep_us parks on a never-woken butex and rides the
+        TimerThread timeout path."""
+        woke_us = core.brpc_fiber_sleep_probe(20_000, 10_000)
+        assert woke_us >= 18_000, f"woke early: {woke_us}us"
+        assert woke_us < 5_000_000, f"woke far too late: {woke_us}us"
