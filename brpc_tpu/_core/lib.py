@@ -137,6 +137,9 @@ _sigs = {
                                          ctypes.c_char_p, ctypes.c_int,
                                          ctypes.POINTER(ctypes.c_int)]),
     "brpc_socket_active_count": (ctypes.c_int64, []),
+    "brpc_socket_set_overcrowded_limit": (None, [ctypes.c_int64]),
+    "brpc_socket_overcrowded_limit": (ctypes.c_int64, []),
+    "brpc_socket_pending_write": (ctypes.c_int64, [ctypes.c_uint64]),
     # native unary RPC hot path
     "brpc_register_python_method": (None, [ctypes.c_char_p, ctypes.c_char_p]),
     "brpc_register_native_method": (None, [ctypes.c_char_p, ctypes.c_char_p,

@@ -1,13 +1,31 @@
-"""CircuitBreaker — per-endpoint error-rate isolation (reference
-circuit_breaker.h:25-81; SURVEY.md §2.5).
+"""CircuitBreaker — per-endpoint error *and latency* isolation (reference
+circuit_breaker.h:25-81; SURVEY.md §2.5, §5.4; VERDICT r2 task 6).
 
-Two EMA windows (long/short) accumulate "error cost"; crossing the threshold
-isolates the endpoint (marked broken → health check takes over revival).
-Repeated isolations back off the revival horizon, like the reference's
-isolation_duration growth.
+Two EMA windows per endpoint (short: reacts in tens of calls; long:
+hundreds), each tracking BOTH error rate and latency:
+
+- error isolation: short error EMA > 50% or long error EMA > 20%;
+- latency isolation: the short latency EMA exceeding LATENCY_RATIO x the
+  long (baseline) latency EMA isolates the endpoint even with a 0% error
+  rate — a replica that silently got 5x slower is broken in every way that
+  matters (the reference folds latency into "error cost" for the same
+  effect).
+
+Isolation hands the endpoint to the health checker with a hold duration
+that doubles per consecutive isolation (100ms -> 30s cap, mirroring the
+reference's isolation_duration_ms growth), so a flapping server is kept
+out longer each time.  After revival the endpoint enters a RECOVERY ramp:
+load balancers re-admit it with probability growing linearly over
+RECOVERY_WINDOW_S (gradual recovery — don't dogpile a replica that just
+came back).
+
+A ClusterRecoverPolicy (cluster_recover_policy.py) can veto isolation when
+too few servers would remain — protecting availability over precision,
+like the reference's cluster_recover_policy.{h,cpp}.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 
@@ -15,11 +33,27 @@ from brpc_tpu.butil.endpoint import EndPoint
 
 
 class _WindowState:
-    __slots__ = ("ema_error", "samples")
+    __slots__ = ("ema_error", "ema_latency", "samples", "lat_samples")
 
     def __init__(self):
         self.ema_error = 0.0
+        self.ema_latency = 0.0
         self.samples = 0
+        self.lat_samples = 0
+
+    def add(self, decay: float, err: float, latency_us: int) -> None:
+        keep = 1 - decay
+        self.ema_error = decay * self.ema_error + keep * err
+        # latency EMA tracks successful calls only: a failed call's latency
+        # is its timeout, which would poison the baseline
+        if err == 0.0 and latency_us > 0:
+            if self.ema_latency == 0.0:
+                self.ema_latency = float(latency_us)
+            else:
+                self.ema_latency = decay * self.ema_latency + \
+                    keep * latency_us
+            self.lat_samples += 1
+        self.samples += 1
 
 
 class CircuitBreaker:
@@ -28,48 +62,117 @@ class CircuitBreaker:
     SHORT_THRESHOLD = 0.5   # >50% recent errors
     LONG_THRESHOLD = 0.2
     MIN_SAMPLES = 16
+    # latency isolation: short EMA > RATIO x long (baseline) EMA, with a
+    # floor so micro-latency jitter on sub-ms calls can't trip it
+    LATENCY_RATIO = 4.0
+    MIN_BASELINE_US = 200
+    MIN_LATENCY_SAMPLES = 32      # long-window baseline maturity
+    MIN_SHORT_LATENCY_SAMPLES = 8  # short window must have real evidence —
+    # without this, the first slow success after a reset/revival seeds the
+    # short EMA to its full value and instantly re-isolates on one sample
+    # isolation hold: doubles per consecutive isolation (reference
+    # min/max isolation_duration_ms)
+    BASE_HOLD_S = 0.1
+    MAX_HOLD_S = 30.0
+    # gradual re-admission ramp after revival
+    RECOVERY_WINDOW_S = 3.0
 
     def __init__(self):
         self._mu = threading.Lock()
         self._short: dict[EndPoint, _WindowState] = {}
         self._long: dict[EndPoint, _WindowState] = {}
         self._isolation_count: dict[EndPoint, int] = {}
+        self._recovering_until: dict[EndPoint, float] = {}
 
-    def on_call_end(self, ep: EndPoint, error_code: int) -> None:
+    def on_call_end(self, ep: EndPoint, error_code: int,
+                    latency_us: int = 0, cluster=None) -> None:
+        """Feed one call result (reference OnCallEnd).  `cluster` is an
+        optional ClusterRecoverPolicy-bound guard consulted before
+        isolating."""
         err = 1.0 if error_code != 0 else 0.0
         isolate = False
         with self._mu:
             s = self._short.setdefault(ep, _WindowState())
             l = self._long.setdefault(ep, _WindowState())
-            s.ema_error = self.SHORT_DECAY * s.ema_error + \
-                (1 - self.SHORT_DECAY) * err
-            l.ema_error = self.LONG_DECAY * l.ema_error + \
-                (1 - self.LONG_DECAY) * err
-            s.samples += 1
-            l.samples += 1
+            s.add(self.SHORT_DECAY, err, latency_us)
+            l.add(self.LONG_DECAY, err, latency_us)
             if s.samples >= self.MIN_SAMPLES and (
                     s.ema_error > self.SHORT_THRESHOLD or
                     l.ema_error > self.LONG_THRESHOLD):
                 isolate = True
-                s.ema_error = 0.0
-                s.samples = 0
-                self._isolation_count[ep] = \
-                    self._isolation_count.get(ep, 0) + 1
+            elif (l.lat_samples >= self.MIN_LATENCY_SAMPLES
+                    and s.lat_samples >= self.MIN_SHORT_LATENCY_SAMPLES
+                    and l.ema_latency > 0 and s.ema_latency >
+                    self.LATENCY_RATIO * max(l.ema_latency,
+                                             self.MIN_BASELINE_US)):
+                # pure latency degradation: no errors required
+                isolate = True
+            if isolate:
+                if cluster is not None and not cluster.can_isolate(ep):
+                    isolate = False   # availability floor wins
+                else:
+                    self._short[ep] = _WindowState()
+                    self._isolation_count[ep] = \
+                        self._isolation_count.get(ep, 0) + 1
         if isolate:
             self.mark_as_broken(ep)
 
+    def _hold_s(self, ep: EndPoint) -> float:
+        n = self._isolation_count.get(ep, 1)
+        return min(self.MAX_HOLD_S, self.BASE_HOLD_S * (2 ** (n - 1)))
+
     def mark_as_broken(self, ep: EndPoint) -> None:
         from brpc_tpu.policy.health_check import mark_broken
-        mark_broken(ep)
+        with self._mu:
+            hold = self._hold_s(ep)
+        mark_broken(ep, hold_s=hold)
 
     def on_socket_failed(self, ep: EndPoint) -> None:
         with self._mu:
             self._isolation_count[ep] = self._isolation_count.get(ep, 0) + 1
 
+    def on_revived(self, ep: EndPoint) -> None:
+        """Health check succeeded: start the gradual re-admission ramp.
+        BOTH windows reset — a retained long-window error EMA near 1.0
+        would re-isolate a now-healthy endpoint after its first
+        MIN_SAMPLES successes (0.98-decay needs ~80 successes to cross
+        back under the 0.2 threshold)."""
+        with self._mu:
+            self._short.pop(ep, None)
+            self._long.pop(ep, None)
+            self._recovering_until[ep] = \
+                time.monotonic() + self.RECOVERY_WINDOW_S
+
+    def admit(self, ep: EndPoint) -> bool:
+        """Gradual recovery gate for load balancers: during the ramp a
+        freshly-revived endpoint receives a linearly-growing fraction of
+        selections instead of its full share at once."""
+        if not self._recovering_until:
+            return True   # GIL-atomic empty check: no lock on the hot path
+        with self._mu:
+            until = self._recovering_until.get(ep)
+            if until is None:
+                return True
+            now = time.monotonic()
+            if now >= until:
+                del self._recovering_until[ep]
+                # a survived ramp is one unit of forgiveness, not amnesty:
+                # decrement so a slow flapper (up-time > ramp) still climbs
+                # the exponential hold ladder across cycles
+                n = self._isolation_count.get(ep, 0)
+                if n <= 1:
+                    self._isolation_count.pop(ep, None)
+                else:
+                    self._isolation_count[ep] = n - 1
+                return True
+            frac = 1.0 - (until - now) / self.RECOVERY_WINDOW_S
+        return random.random() < max(0.1, frac)
+
     def reset(self, ep: EndPoint) -> None:
         with self._mu:
             self._short.pop(ep, None)
             self._long.pop(ep, None)
+            self._recovering_until.pop(ep, None)
 
     def isolation_count(self, ep: EndPoint) -> int:
         with self._mu:

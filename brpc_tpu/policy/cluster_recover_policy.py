@@ -1,0 +1,67 @@
+"""ClusterRecoverPolicy — availability floor for circuit breaking
+(reference cluster_recover_policy.{h,cpp}; SURVEY.md §5.4; VERDICT r2
+task 6).
+
+When most of a cluster is already isolated, isolating one more server
+trades a little precision for a lot of availability — the wrong trade.
+The policy vetoes further isolation whenever it would leave fewer than
+`min_working` healthy servers (or fewer than `min_working_ratio` of the
+cluster), and reports `in_recovery()` so operators can see the cluster is
+running degraded.  The reference's DefaultClusterRecoverPolicy plays the
+same role: below the usable-server threshold it suspends isolation and
+lets traffic feel out the cluster until it heals.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+from brpc_tpu.bvar import Adder
+
+_vetoed = Adder("rpc_cluster_recover_vetoed_isolations")
+
+
+class ClusterRecoverPolicy:
+    def __init__(self, min_working: int = 1,
+                 min_working_ratio: float = 0.0):
+        self.min_working = min_working
+        self.min_working_ratio = min_working_ratio
+        self._mu = threading.Lock()
+        self._recovering = False
+
+    def _floor(self, total: int) -> int:
+        return max(self.min_working,
+                   math.ceil(total * self.min_working_ratio))
+
+    def can_isolate(self, total: int, healthy: int) -> bool:
+        """True iff isolating one more server keeps the cluster at or
+        above the availability floor."""
+        ok = healthy - 1 >= self._floor(total)
+        with self._mu:
+            self._recovering = not ok
+        if not ok:
+            _vetoed.add(1)
+        return ok
+
+    def in_recovery(self) -> bool:
+        with self._mu:
+            return self._recovering
+
+
+class _ChannelClusterGuard:
+    """Binds a channel's live server view to the policy so the circuit
+    breaker can ask 'may I isolate this endpoint?' without knowing about
+    clusters (the reference passes the policy into the LB the same way)."""
+
+    def __init__(self, policy: ClusterRecoverPolicy, lb):
+        self._policy = policy
+        self._lb = lb
+
+    def can_isolate(self, ep) -> bool:
+        from brpc_tpu.policy.health_check import is_broken
+        nodes = self._lb.servers()
+        total = len(nodes)
+        if total == 0:
+            return True
+        healthy = sum(1 for n in nodes if not is_broken(n.endpoint))
+        return self._policy.can_isolate(total, healthy)

@@ -19,6 +19,7 @@ from brpc_tpu.bvar import Adder
 health_check_interval_s = 1.0
 
 _broken: dict[EndPoint, float] = {}     # endpoint -> since (monotonic)
+_hold_until: dict[EndPoint, float] = {}  # CB isolation hold deadline
 _mu = threading.Lock()
 _probe_threads: dict[EndPoint, threading.Thread] = {}
 _revived_counter = Adder("rpc_health_check_revived")
@@ -35,11 +36,18 @@ def broken_endpoints() -> list[EndPoint]:
         return list(_broken)
 
 
-def mark_broken(ep: EndPoint) -> None:
-    """Mark and start the probe loop (Socket::SetFailed → StartHealthCheck)."""
+def mark_broken(ep: EndPoint, hold_s: float = 0.0) -> None:
+    """Mark and start the probe loop (Socket::SetFailed → StartHealthCheck).
+
+    `hold_s` is the circuit breaker's isolation duration: the probe loop
+    will not revive the endpoint before it elapses even if the server is
+    already reachable (the reference's isolation_duration_ms backoff)."""
     if ep.scheme != "tcp":
         return
     with _mu:
+        if hold_s > 0.0:
+            _hold_until[ep] = max(_hold_until.get(ep, 0.0),
+                                  time.monotonic() + hold_s)
         if ep in _broken:
             return
         _broken[ep] = time.monotonic()
@@ -59,6 +67,10 @@ def on_connection_failed(ep: EndPoint) -> None:
 def _probe_loop(ep: EndPoint) -> None:
     while True:
         time.sleep(health_check_interval_s)
+        with _mu:
+            hold = _hold_until.get(ep, 0.0)
+        if time.monotonic() < hold:
+            continue   # still inside the CB isolation hold
         try:
             with _socket.create_connection((ep.host, ep.port), timeout=1.0):
                 pass
@@ -67,13 +79,15 @@ def _probe_loop(ep: EndPoint) -> None:
             continue
     with _mu:
         _broken.pop(ep, None)
+        _hold_until.pop(ep, None)
         _probe_threads.pop(ep, None)
     _revived_counter.add(1)
     from brpc_tpu.policy.circuit_breaker import global_breaker
-    global_breaker().reset(ep)
+    global_breaker().on_revived(ep)   # start the gradual re-admission ramp
 
 
 def reset(ep: EndPoint) -> None:
     """Force-clear (tests / manual revive)."""
     with _mu:
         _broken.pop(ep, None)
+        _hold_until.pop(ep, None)

@@ -58,11 +58,22 @@ class LoadBalancer:
         pass
 
     def _alive(self, exclude=None):
+        from brpc_tpu.policy.circuit_breaker import global_breaker
         from brpc_tpu.policy.health_check import is_broken
+        breaker = global_breaker()
         nodes = self._servers.read()
-        out = [n for n in nodes
-               if (exclude is None or n.endpoint not in exclude)
-               and not is_broken(n.endpoint)]
+        # admit() is the gradual-recovery gate: a freshly-revived endpoint
+        # gets a linearly-growing fraction of selections (circuit_breaker
+        # RECOVERY ramp) instead of its full share the instant it revives
+        healthy = [n for n in nodes
+                   if (exclude is None or n.endpoint not in exclude)
+                   and not is_broken(n.endpoint)]
+        out = [n for n in healthy if breaker.admit(n.endpoint)]
+        if not out and healthy:
+            # admit() probabilistically rejected every healthy node (all
+            # are mid-recovery-ramp): prefer a recovering-but-healthy node
+            # over falling through to known-broken ones
+            out = healthy
         if not out and nodes:
             # all broken/excluded: let the caller retry anything rather than
             # fast-failing the whole cluster (cluster_recover_policy spirit)

@@ -39,6 +39,9 @@ class ChannelOptions:
     load_balancer: str = ""                # "" = single server
     auth: Optional[Any] = None             # Authenticator
     retry_policy: Optional[Any] = None
+    # availability floor for circuit breaking (ClusterRecoverPolicy);
+    # None = isolate freely (single-server channels have no cluster)
+    cluster_recover_policy: Optional[Any] = None
 
 
 class RetryPolicy:
@@ -489,15 +492,34 @@ class Channel:
                 self._lb.feedback(ep, errors.EFAILEDSOCKET, 0)
             self._lb.feedback(st.tried_servers[-1], st.cntl.error_code,
                               st.cntl.latency_us)
-        # feed the circuit breaker (reference OnCallEnd, circuit_breaker.h)
+        # feed the circuit breaker (reference OnCallEnd, circuit_breaker.h);
+        # the cluster guard lets ClusterRecoverPolicy veto isolation when
+        # too few healthy servers would remain (cluster_recover_policy.h)
         from brpc_tpu.policy.circuit_breaker import global_breaker
         breaker = global_breaker()
+        guard = self._cluster_guard()
         for ep in st.tried_servers[:-1]:
             if ep.scheme == "tcp":
-                breaker.on_call_end(ep, errors.EFAILEDSOCKET)
+                breaker.on_call_end(ep, errors.EFAILEDSOCKET,
+                                    cluster=guard)
         last = st.tried_servers[-1]
         if last.scheme == "tcp":
-            breaker.on_call_end(last, st.cntl.error_code)
+            breaker.on_call_end(last, st.cntl.error_code,
+                                latency_us=st.cntl.latency_us,
+                                cluster=guard)
+
+    def _cluster_guard(self):
+        """ClusterRecoverPolicy guard bound to this channel's server view
+        (None for single-server channels — there is no cluster to
+        protect)."""
+        if self._lb is None:
+            return None
+        policy = self.options.cluster_recover_policy
+        if policy is None:
+            return None
+        from brpc_tpu.policy.cluster_recover_policy import \
+            _ChannelClusterGuard
+        return _ChannelClusterGuard(policy, self._lb)
 
     # ---- the call path ----
 
@@ -656,7 +678,13 @@ class Channel:
             rc = Transport.instance().write_frame(conn.sid, meta.encode(),
                                                   st.body)
         if rc != 0:
-            cntl.set_failed(errors.EFAILEDSOCKET, "write failed")
+            if rc == -2:
+                # native write-queue bound tripped (Socket::Write -2):
+                # the peer is reading too slowly for this call's bytes
+                cntl.set_failed(errors.EOVERCROWDED,
+                                "socket write queue overcrowded")
+            else:
+                cntl.set_failed(errors.EFAILEDSOCKET, "write failed")
             if self._should_retry(st):
                 return
             mgr._finish(st)

@@ -25,6 +25,11 @@ from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_MEMCACHE,
                                     MSG_MONGO, MSG_REDIS, MSG_THRIFT,
                                     MSG_TRPC, Transport)
 
+# responses whose socket write was rejected (EOVERCROWDED backlog or a
+# dead socket) — the client can only learn via its own deadline, so this
+# counter is the server-side visibility
+_dropped_responses = Adder("rpc_server_dropped_responses")
+
 
 @dataclass
 class ServerOptions:
@@ -650,9 +655,18 @@ class Server:
                     # plain response: cid/attempt/content_type only — pack
                     # the meta and frame natively (PackResponseFrame)
                     span.response_size = len(rbody)
-                    Transport.send_response(
+                    rc = Transport.send_response(
                         sid, meta.correlation_id, meta.attempt, 0, "",
                         res_ser.name, rbody)
+                    if rc != 0:
+                        # the response frame was dropped (overcrowded
+                        # write queue or dead socket): nothing can reach
+                        # this client, but the accounting must not claim
+                        # success (reference SendRpcResponse logs the
+                        # Write failure the same way)
+                        error_code = errors.EOVERCROWDED if rc == -2 \
+                            else errors.EFAILEDSOCKET
+                        _dropped_responses.add(1)
                 else:
                     resp = M.RpcMeta(msg_type=M.MSG_RESPONSE,
                                      correlation_id=meta.correlation_id,
@@ -672,8 +686,12 @@ class Server:
                         resp.attachment_size = len(cntl.response_attachment)
                         rbody = rbody + cntl.response_attachment
                     span.response_size = len(rbody)
-                    Transport.instance().write_frame(sid, resp.encode(),
-                                                     rbody)
+                    rc = Transport.instance().write_frame(sid, resp.encode(),
+                                                          rbody)
+                    if rc != 0:
+                        error_code = errors.EOVERCROWDED if rc == -2 \
+                            else errors.EFAILEDSOCKET
+                        _dropped_responses.add(1)
         except Exception as e:
             error_code = errors.EINTERNAL
             self._respond_error(sid, meta, errors.EINTERNAL,

@@ -187,6 +187,21 @@ int brpc_socket_stats(uint64_t sid, int64_t* nread, int64_t* nwritten,
 
 int64_t brpc_socket_active_count() { return brpc::Socket::active_count(); }
 
+// EOVERCROWDED backpressure controls (reference socket.h:326-380).
+void brpc_socket_set_overcrowded_limit(int64_t bytes) {
+  brpc::Socket::set_overcrowded_limit(bytes);
+}
+int64_t brpc_socket_overcrowded_limit() {
+  return brpc::Socket::overcrowded_limit();
+}
+int64_t brpc_socket_pending_write(uint64_t sid) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  const int64_t v = s->pending_write_bytes();
+  s->Dereference();
+  return v;
+}
+
 // ---- native unary RPC hot path (net/rpc.h) ----
 
 // ctypes mirrors brpc::RequestHeader field-for-field (lib.py RequestHeader).

@@ -20,8 +20,18 @@ using butil::ResourcePool;
 static ResourcePool<Socket>* pool() { return ResourcePool<Socket>::singleton(); }
 
 static std::atomic<int64_t> g_active_sockets{0};
+// Per-socket unwritten-byte cap (reference FLAGS_socket_max_unwritten_bytes;
+// EOVERCROWDED backpressure, socket.h:326-380).
+static std::atomic<int64_t> g_overcrowded_limit{64 << 20};
 
 int64_t Socket::active_count() { return g_active_sockets.load(std::memory_order_relaxed); }
+
+void Socket::set_overcrowded_limit(int64_t bytes) {
+  g_overcrowded_limit.store(bytes, std::memory_order_relaxed);
+}
+int64_t Socket::overcrowded_limit() {
+  return g_overcrowded_limit.load(std::memory_order_relaxed);
+}
 
 static int make_nonblocking(int fd) {
   const int flags = fcntl(fd, F_GETFL, 0);
@@ -50,6 +60,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->_write_stack.store(nullptr, std::memory_order_relaxed);
   s->_write_busy.store(false, std::memory_order_relaxed);
   s->_waiting_epollout.store(false, std::memory_order_relaxed);
+  s->_pending_write.store(0, std::memory_order_relaxed);
   s->_nread.store(0, std::memory_order_relaxed);
   s->_nwritten.store(0, std::memory_order_relaxed);
   s->_nmsg.store(0, std::memory_order_relaxed);
@@ -179,16 +190,32 @@ void Socket::FillRemoteAddr() {
 static thread_local Socket* tls_batch_socket = nullptr;
 static thread_local butil::IOBuf* tls_batch_buf = nullptr;
 
-int Socket::Write(butil::IOBuf&& data) {
+int Socket::Write(butil::IOBuf&& data, bool admitted) {
+  const int64_t limit =
+      admitted ? 0 : g_overcrowded_limit.load(std::memory_order_relaxed);
   if (tls_batch_socket == this) {
     // same failed() contract as the direct path; enqueued-then-failed
     // still drops data with only on_failed as the signal (identical to
     // the MPSC-stack path and the reference's WriteRequest semantics)
     if (failed()) return -1;
+    // batch bytes are accounted when the guard flushes through Write;
+    // the check here includes them so a stalled peer can't hide behind
+    // the thread-local batch
+    if (limit > 0 &&
+        _pending_write.load(std::memory_order_relaxed) +
+                (int64_t)tls_batch_buf->size() + (int64_t)data.size() > limit) {
+      return -2;  // EOVERCROWDED
+    }
     tls_batch_buf->append(std::move(data));
     return 0;
   }
   if (failed()) return -1;
+  if (limit > 0 &&
+      _pending_write.load(std::memory_order_relaxed) + (int64_t)data.size() >
+          limit) {
+    return -2;  // EOVERCROWDED
+  }
+  _pending_write.fetch_add((int64_t)data.size(), std::memory_order_relaxed);
   auto* req = new WriteRequest{std::move(data), nullptr};
   WriteRequest* old = _write_stack.load(std::memory_order_relaxed);
   do {
@@ -207,13 +234,16 @@ int Socket::Write(butil::IOBuf&& data) {
 void Socket::DrainWriteQueue(bool from_keepwrite) {
   while (true) {
     if (failed()) {
+      int64_t dropped = (int64_t)_out_buf.size();
       WriteRequest* head = _write_stack.exchange(nullptr, std::memory_order_acquire);
       while (head != nullptr) {
         WriteRequest* next = head->next;
+        dropped += (int64_t)head->data.size();
         delete head;
         head = next;
       }
       _out_buf.clear();
+      _pending_write.fetch_sub(dropped, std::memory_order_relaxed);
       _write_busy.store(false, std::memory_order_seq_cst);
       return;
     }
@@ -245,6 +275,7 @@ void Socket::DrainWriteQueue(bool from_keepwrite) {
       const ssize_t nw = _out_buf.cut_into_file_descriptor(_fd);
       if (nw >= 0) {
         _nwritten.fetch_add(nw, std::memory_order_relaxed);
+        _pending_write.fetch_sub(nw, std::memory_order_relaxed);
         continue;
       }
       if (errno == EINTR) continue;
@@ -332,7 +363,7 @@ void Socket::DispatchMessages() {
     ~BatchGuard() {
       tls_batch_socket = nullptr;
       tls_batch_buf = nullptr;
-      if (!buf->empty()) s->Write(std::move(*buf));
+      if (!buf->empty()) s->Write(std::move(*buf), /*admitted=*/true);
     }
   } guard{this, &batch_out};
   tls_batch_socket = this;

@@ -93,8 +93,22 @@ class Socket {
 
   // ---- IO ----
   // Queue a frame for writing (wait-free producer side).  Takes ownership of
-  // data's refs.  Returns 0 or -1 if the socket is failed.
-  int Write(butil::IOBuf&& data);
+  // data's refs.  Returns 0, -1 if the socket is failed, or -2
+  // (EOVERCROWDED) when the socket's unwritten backlog exceeds the
+  // overcrowded limit — the reference's EOVERCROWDED backpressure
+  // (socket.h:326-380): a stalled peer must surface as an error to
+  // producers, not as unbounded memory growth.  `admitted` skips the
+  // overcrowded check — only for bytes already admitted per-append by the
+  // dispatch write batch (rejecting its deferred flush would drop them).
+  int Write(butil::IOBuf&& data, bool admitted = false);
+  // Bytes accepted by Write but not yet written to the fd.
+  int64_t pending_write_bytes() const {
+    return _pending_write.load(std::memory_order_relaxed);
+  }
+  // Process-wide backlog cap per socket; 0 disables (reference
+  // FLAGS_socket_max_unwritten_bytes, default 64MB).
+  static void set_overcrowded_limit(int64_t bytes);
+  static int64_t overcrowded_limit();
   int fd() const { return _fd; }
   SocketId id() const { return _id; }
   bool failed() const;
@@ -142,6 +156,7 @@ class Socket {
   std::atomic<WriteRequest*> _write_stack{nullptr};
   std::atomic<bool> _write_busy{false};
   std::atomic<bool> _waiting_epollout{false};
+  std::atomic<int64_t> _pending_write{0};  // queued + _out_buf bytes
   butil::IOBuf _out_buf;  // drainer-owned unwritten bytes
 
   // read path
