@@ -43,8 +43,38 @@ $(PYEXT): $(PYOBJ) $(LIB)
 
 clean:
 	rm -f $(OBJ) $(PYOBJ) $(DEP) $(LIB) $(PYEXT)
+	rm -rf build
 
 test: $(LIB)
 	python -m pytest tests/ -x -q
 
-.PHONY: all clean test
+# Sanitizer stress targets (VERDICT r2 task 7; reference fights lock-free
+# races with stress tests + sanitizer builds, SURVEY.md §5.3).  The whole
+# native core + src/cc/test/stress_main.cc compile as ONE binary with the
+# sanitizer, then run: Chase-Lev pop/steal, executor churn, butex claim
+# races, fiber mutex, timer churn, write-stack drainer handoff vs
+# SetFailed.  A clean exit means no reports (halt_on_error aborts).
+# Known -Wtsan warning: TSAN doesn't model standalone atomic_thread_fence
+# (Chase-Lev pop/steal) — it may MISS fence-dependent races but cannot
+# false-positive here since all racing accesses are atomics.
+STRESS_SRC := $(SRC) src/cc/test/stress_main.cc
+
+tsan:
+	@mkdir -p build
+	$(CXX) -std=c++20 -O1 -g -fsanitize=thread -pthread -Isrc/cc \
+	    $(STRESS_SRC) -o build/stress_tsan
+	TSAN_OPTIONS="halt_on_error=1" ./build/stress_tsan
+
+asan:
+	@mkdir -p build
+	$(CXX) -std=c++20 -O1 -g -fsanitize=address,undefined -pthread -Isrc/cc \
+	    $(STRESS_SRC) -o build/stress_asan
+	ASAN_OPTIONS="detect_leaks=1:halt_on_error=1" ./build/stress_asan
+
+stress:
+	@mkdir -p build
+	$(CXX) -std=c++20 -O2 -g -pthread -Isrc/cc \
+	    $(STRESS_SRC) -o build/stress_plain
+	./build/stress_plain
+
+.PHONY: all clean test tsan asan stress

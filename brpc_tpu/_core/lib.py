@@ -148,6 +148,7 @@ _sigs = {
     "brpc_unregister_method": (ctypes.c_int, [ctypes.c_char_p,
                                               ctypes.c_char_p]),
     "brpc_set_request_callback": (None, [REQUEST_CB, ctypes.c_void_p]),
+    "brpc_rpc_dropped_responses": (ctypes.c_int64, []),
     "brpc_rpc_counters": (None, [ctypes.POINTER(ctypes.c_int64),
                                  ctypes.POINTER(ctypes.c_int64)]),
     "brpc_send_response": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_uint64,

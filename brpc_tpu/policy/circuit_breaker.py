@@ -41,19 +41,17 @@ class _WindowState:
         self.samples = 0
         self.lat_samples = 0
 
-    def add(self, decay: float, err: float, latency_us: int) -> None:
-        keep = 1 - decay
-        self.ema_error = decay * self.ema_error + keep * err
-        # latency EMA tracks successful calls only: a failed call's latency
-        # is its timeout, which would poison the baseline
-        if err == 0.0 and latency_us > 0:
-            if self.ema_latency == 0.0:
-                self.ema_latency = float(latency_us)
-            else:
-                self.ema_latency = decay * self.ema_latency + \
-                    keep * latency_us
-            self.lat_samples += 1
+    def add_error(self, decay: float, err: float) -> None:
+        self.ema_error = decay * self.ema_error + (1 - decay) * err
         self.samples += 1
+
+    def add_latency(self, decay: float, latency_us: int) -> None:
+        if self.ema_latency == 0.0:
+            self.ema_latency = float(latency_us)
+        else:
+            self.ema_latency = decay * self.ema_latency + \
+                (1 - decay) * latency_us
+        self.lat_samples += 1
 
 
 class CircuitBreaker:
@@ -94,8 +92,24 @@ class CircuitBreaker:
         with self._mu:
             s = self._short.setdefault(ep, _WindowState())
             l = self._long.setdefault(ep, _WindowState())
-            s.add(self.SHORT_DECAY, err, latency_us)
-            l.add(self.LONG_DECAY, err, latency_us)
+            s.add_error(self.SHORT_DECAY, err)
+            l.add_error(self.LONG_DECAY, err)
+            # latency tracks successful calls only (a failed call's latency
+            # is its timeout, which would poison the baseline)
+            if err == 0.0 and latency_us > 0:
+                s.add_latency(self.SHORT_DECAY, latency_us)
+                # baseline-poisoning guard: once the long baseline is
+                # mature, suspicious samples (>2x baseline) do NOT feed it.
+                # Without this the degradation contaminates its own
+                # yardstick — with both windows fed, s>4*l is only ever
+                # reachable for slowdowns >~7.7x, and the documented 4-5x
+                # degradation never isolates.  Freezing the baseline under
+                # suspicion makes a sustained r-times slowdown trip once
+                # s -> r*baseline > RATIO*baseline, i.e. any r > RATIO.
+                if (l.lat_samples < self.MIN_LATENCY_SAMPLES
+                        or l.ema_latency == 0.0
+                        or latency_us <= 2 * l.ema_latency):
+                    l.add_latency(self.LONG_DECAY, latency_us)
             if s.samples >= self.MIN_SAMPLES and (
                     s.ema_error > self.SHORT_THRESHOLD or
                     l.ema_error > self.LONG_THRESHOLD):
@@ -109,7 +123,14 @@ class CircuitBreaker:
                 isolate = True
             if isolate:
                 if cluster is not None and not cluster.can_isolate(ep):
-                    isolate = False   # availability floor wins
+                    # availability floor wins.  Reset the short window so
+                    # evidence must re-accumulate (MIN_SAMPLES calls)
+                    # before the next isolation attempt — otherwise every
+                    # subsequent call re-trips this branch and re-walks
+                    # the cluster guard's O(servers) scan while the
+                    # cluster is already degraded
+                    isolate = False
+                    self._short[ep] = _WindowState()
                 else:
                     self._short[ep] = _WindowState()
                     self._isolation_count[ep] = \
@@ -143,6 +164,17 @@ class CircuitBreaker:
             self._recovering_until[ep] = \
                 time.monotonic() + self.RECOVERY_WINDOW_S
 
+    def _ramp_done_locked(self, ep: EndPoint) -> None:
+        del self._recovering_until[ep]
+        # a survived ramp is one unit of forgiveness, not amnesty:
+        # decrement so a slow flapper (up-time > ramp) still climbs
+        # the exponential hold ladder across cycles
+        n = self._isolation_count.get(ep, 0)
+        if n <= 1:
+            self._isolation_count.pop(ep, None)
+        else:
+            self._isolation_count[ep] = n - 1
+
     def admit(self, ep: EndPoint) -> bool:
         """Gradual recovery gate for load balancers: during the ramp a
         freshly-revived endpoint receives a linearly-growing fraction of
@@ -150,20 +182,16 @@ class CircuitBreaker:
         if not self._recovering_until:
             return True   # GIL-atomic empty check: no lock on the hot path
         with self._mu:
+            now = time.monotonic()
+            # sweep ALL expired entries, not just ep's: an endpoint removed
+            # from the cluster mid-ramp is never passed to admit() again,
+            # and a leaked entry would disable the lock-free fast path
+            # above for every selection in the process, forever
+            for other in [e for e, u in self._recovering_until.items()
+                          if now >= u]:
+                self._ramp_done_locked(other)
             until = self._recovering_until.get(ep)
             if until is None:
-                return True
-            now = time.monotonic()
-            if now >= until:
-                del self._recovering_until[ep]
-                # a survived ramp is one unit of forgiveness, not amnesty:
-                # decrement so a slow flapper (up-time > ramp) still climbs
-                # the exponential hold ladder across cycles
-                n = self._isolation_count.get(ep, 0)
-                if n <= 1:
-                    self._isolation_count.pop(ep, None)
-                else:
-                    self._isolation_count[ep] = n - 1
                 return True
             frac = 1.0 - (until - now) / self.RECOVERY_WINDOW_S
         return random.random() < max(0.1, frac)

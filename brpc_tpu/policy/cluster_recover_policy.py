@@ -59,6 +59,11 @@ class _ChannelClusterGuard:
 
     def can_isolate(self, ep) -> bool:
         from brpc_tpu.policy.health_check import is_broken
+        if is_broken(ep):
+            # already isolated: "isolating" again removes nothing from the
+            # pool, so vetoing would only inflate the veto metric and stall
+            # the endpoint's exponential hold ladder
+            return True
         nodes = self._lb.servers()
         total = len(nodes)
         if total == 0:

@@ -232,6 +232,10 @@ void brpc_set_request_callback(brpc_request_cb cb, void* user) {
   brpc::SetRequestCallback((brpc::RequestCallback)cb, user);
 }
 
+int64_t brpc_rpc_dropped_responses() {
+  return brpc::MethodRegistry::global()->dropped_responses();
+}
+
 void brpc_rpc_counters(int64_t* native_calls, int64_t* python_fast_calls) {
   if (native_calls)
     *native_calls = brpc::MethodRegistry::global()->native_calls();

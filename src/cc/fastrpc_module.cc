@@ -48,7 +48,9 @@ void send_error_response(brpc::SocketId sid, const brpc::RequestHeader* hdr) {
                           sizeof(kMsg) - 1, "", 0, butil::IOBuf());
   brpc::Socket* s = brpc::Socket::Address(sid);
   if (s != nullptr) {
-    s->Write(std::move(frame));
+    if (s->Write(std::move(frame)) != 0) {
+      brpc::MethodRegistry::NoteDroppedResponse();
+    }
     s->Dereference();
   }
 }

@@ -183,6 +183,8 @@ butil::DoublyBufferedData<MethodMap>& methods() {
 }
 std::atomic<int64_t> g_native_calls{0};
 std::atomic<int64_t> g_python_fast_calls{0};
+// replies whose socket Write was rejected (EOVERCROWDED / failed socket)
+std::atomic<int64_t> g_dropped_responses{0};
 std::atomic<RequestCallback> g_request_cb{nullptr};
 std::atomic<void*> g_request_user{nullptr};
 
@@ -258,6 +260,12 @@ int64_t MethodRegistry::native_calls() const {
 int64_t MethodRegistry::python_fast_calls() const {
   return g_python_fast_calls.load(std::memory_order_relaxed);
 }
+int64_t MethodRegistry::dropped_responses() const {
+  return g_dropped_responses.load(std::memory_order_relaxed);
+}
+void MethodRegistry::NoteDroppedResponse() {
+  g_dropped_responses.fetch_add(1, std::memory_order_relaxed);
+}
 
 void SetRequestCallback(RequestCallback cb, void* user) {
   g_request_user.store(user, std::memory_order_release);
@@ -297,7 +305,11 @@ void run_native(SocketId sid, const MethodRegistry::Entry& e, uint64_t cid,
                     std::move(resp_body));
   Socket* s = Socket::Address(sid);
   if (s != nullptr) {
-    s->Write(std::move(frame));
+    if (s->Write(std::move(frame)) != 0) {
+      // overcrowded backlog or racing SetFailed: the reply is gone and the
+      // client can only learn via its deadline — keep it visible here
+      g_dropped_responses.fetch_add(1, std::memory_order_relaxed);
+    }
     s->Dereference();
   }
 }

@@ -150,6 +150,10 @@ class MethodRegistry {
               size_t method_len, Entry* out);
 
   int64_t native_calls() const;
+  int64_t dropped_responses() const;
+  // Count a reply whose socket Write was rejected (callers outside this
+  // TU: fastrpc extension, capi response paths).
+  static void NoteDroppedResponse();
   int64_t python_fast_calls() const;
 };
 

@@ -119,6 +119,25 @@ class TestCircuitBreakerLatency:
                 break
         assert isolated == [ep]
 
+    def test_5x_latency_degradation_isolates(self):
+        """The documented 4-5x regime: with the baseline-poisoning guard
+        (degraded samples don't feed the long window once it's mature),
+        any sustained slowdown beyond LATENCY_RATIO trips.  Without the
+        guard the contaminated baseline meant only >7.7x ever could."""
+        from brpc_tpu.butil.endpoint import str2endpoint
+        cb = self._fresh()
+        isolated = []
+        cb.mark_as_broken = lambda ep: isolated.append(ep)
+        ep = str2endpoint("10.0.0.9:80")
+        for _ in range(100):
+            cb.on_call_end(ep, 0, latency_us=1000)
+        assert not isolated
+        for _ in range(60):                # sustained 5x, zero errors
+            cb.on_call_end(ep, 0, latency_us=5000)
+            if isolated:
+                break
+        assert isolated == [ep]
+
     def test_error_rate_still_isolates(self):
         from brpc_tpu.butil.endpoint import str2endpoint
         cb = self._fresh()
