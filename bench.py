@@ -409,6 +409,28 @@ def bench_ici_ladder():
     return out
 
 
+def _device_reachable(timeout_s: int = 180) -> tuple[bool, str]:
+    """Probe jax device init in a SUBPROCESS with a hard timeout.  A
+    wedged tunnel makes jax.devices() block forever inside the PJRT
+    client constructor — in-process there is no way back, so a bench run
+    must discover it out-of-process or hang the whole driver.  Returns
+    (ok, cause) so a missing jax reads as an env problem, not a wedged
+    tunnel."""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, (f"jax device init timed out after {timeout_s}s "
+                       f"(wedged tunnel?)")
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        return False, f"jax init failed (rc={r.returncode}): {tail[0]}"
+    return True, ""
+
+
 def main():
     details = {}
     log("bench: unary echo (python service)...")
@@ -417,11 +439,18 @@ def main():
     log("bench: native echo...")
     details["native_echo"] = bench_native_echo()
     log(f"  {details['native_echo']}")
+    log("bench: probing device reachability...")
+    device_ok, device_err = _device_reachable()
+    if not device_ok:
+        log(f"  {device_err}; skipping device benches")
     # each bench is isolated: a failure in one must not clobber another's
     # already-valid result
     for name, fn in (("tensor_pipe", lambda: bench_tensor_pipe(chunk_mb=64)),
                      ("hbm_stream", bench_hbm_stream),
                      ("ici_ladder", bench_ici_ladder)):
+        if not device_ok:
+            details[name] = {"error": device_err}
+            continue
         log(f"bench: {name}...")
         try:
             details[name] = fn()
@@ -435,6 +464,8 @@ def main():
         details["headline_fallback"] = "native_echo"
     import platform
     try:
+        if not device_ok:
+            raise RuntimeError("device unreachable")
         import jax
         details["platform"] = str(jax.devices()[0])
     except Exception:

@@ -26,9 +26,14 @@ from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_MEMCACHE,
                                     MSG_TRPC, Transport)
 
 # responses whose socket write was rejected (EOVERCROWDED backlog or a
-# dead socket) — the client can only learn via its own deadline, so this
-# counter is the server-side visibility
+# dead socket) — the client can only learn via its own deadline, so these
+# are the server-side visibility: the Adder counts Python-path drops, the
+# PassiveStatus mirrors the native fast path's C++ counter onto /vars
 _dropped_responses = Adder("rpc_server_dropped_responses")
+_native_dropped = PassiveStatus(
+    lambda: __import__("brpc_tpu._core", fromlist=["core"])
+    .core.brpc_rpc_dropped_responses()).expose(
+        "rpc_native_dropped_responses")
 
 
 @dataclass
@@ -70,6 +75,9 @@ class ServerOptions:
     # rail instead of the socket (the use_rdma switch — channel.h:109,
     # rdma_endpoint.h:82; see ici/rail.py).
     ici_device: Optional[Any] = None
+    # register the _dcn service (topology handshake + remote device-service
+    # bridge, ici/dcn.py) at start — the DCN half of SURVEY §5.8
+    enable_dcn: bool = False
 
 
 class MethodStatus:
@@ -251,6 +259,15 @@ class Server:
                 self._tag_pools[tag] = ThreadPoolExecutor(
                     max_workers=workers,
                     thread_name_prefix=f"svc-tag-{tag}")
+        if self.options.enable_dcn:
+            # cross-process device RPC: topology handshake + remote
+            # device-service bridge (ici/dcn.py; the RdmaEndpoint
+            # TCP-assisted-handshake slot, rdma_endpoint.h:112-115).
+            # Added BEFORE the native-registration loop below so DCN
+            # methods ride the same path as every other service.
+            from brpc_tpu.ici.dcn import DCN_SERVICE, DcnService
+            if DCN_SERVICE not in self._services:
+                self.add_service(DcnService())
         t = Transport.instance()
         self._listen_sid, self._port = t.listen_rpc(
             addr, port, self._on_message, self._on_conn_failed,
