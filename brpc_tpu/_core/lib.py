@@ -137,6 +137,23 @@ _sigs = {
                                          ctypes.c_char_p, ctypes.c_int,
                                          ctypes.POINTER(ctypes.c_int)]),
     "brpc_socket_active_count": (ctypes.c_int64, []),
+    "brpc_socket_traffic": (None, [ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64)]),
+    # bvar combiners (per-thread cells, src/cc/bvar/combiner.h)
+    "brpc_adder_new": (ctypes.c_void_p, []),
+    "brpc_adder_free": (None, [ctypes.c_void_p]),
+    "brpc_adder_add": (None, [ctypes.c_void_p, ctypes.c_int64]),
+    "brpc_adder_get": (ctypes.c_int64, [ctypes.c_void_p]),
+    "brpc_latency_new": (ctypes.c_void_p, []),
+    "brpc_latency_free": (None, [ctypes.c_void_p]),
+    "brpc_latency_record": (None, [ctypes.c_void_p, ctypes.c_int64]),
+    "brpc_latency_stats": (None, [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.POINTER(ctypes.c_int64)]),
+    "brpc_latency_percentile": (ctypes.c_double, [ctypes.c_void_p,
+                                                  ctypes.c_double]),
     "brpc_socket_set_overcrowded_limit": (None, [ctypes.c_int64]),
     "brpc_socket_overcrowded_limit": (ctypes.c_int64, []),
     "brpc_socket_pending_write": (ctypes.c_int64, [ctypes.c_uint64]),

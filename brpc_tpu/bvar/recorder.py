@@ -13,7 +13,7 @@ from __future__ import annotations
 import math
 import threading
 
-from brpc_tpu.bvar.reducer import Adder, Maxer
+from brpc_tpu.bvar.reducer import Adder
 from brpc_tpu.bvar.variable import Variable
 from brpc_tpu.bvar.window import PerSecond, Window
 
@@ -110,8 +110,35 @@ class IntRecorder(Variable):
         return self._count.get_value()
 
 
+class _NativeStat:
+    """Variable-shaped view of one field of a native latency recorder —
+    lets Window/PerSecond sample native combiner state like any reducer."""
+
+    __slots__ = ("_handle", "_field")
+
+    def __init__(self, handle, field: str):
+        self._handle = handle
+        self._field = field
+
+    def get_value(self):
+        import ctypes
+        from brpc_tpu._core import core
+        c = ctypes.c_int64()
+        s = ctypes.c_int64()
+        m = ctypes.c_int64()
+        core.brpc_latency_stats(self._handle, ctypes.byref(c),
+                                ctypes.byref(s), ctypes.byref(m))
+        return {"count": c.value, "sum": s.value, "max": m.value}[self._field]
+
+
 class LatencyRecorder(Variable):
     """The standard per-method bundle: << latency_us records one call.
+
+    Backed by the NATIVE combiner (src/cc/bvar/combiner.h): add() is one C
+    call writing the calling thread's own cells — count, sum, max and a
+    log-bucket histogram — with no Python-level lock and no shared
+    cacheline (VERDICT r2 task 5; reference latency_recorder.h:49-75 over
+    detail/combiner.h).  Reads merge cells across threads natively.
 
     Exposes (when named): <name>_latency (avg us, windowed),
     <name>_max_latency, <name>_qps, <name>_count, and percentiles via
@@ -119,10 +146,14 @@ class LatencyRecorder(Variable):
     """
 
     def __init__(self, name: str = "", window_size: int = 10):
-        self._sum = Adder()
-        self._num = Adder()
-        self._max = Maxer()
-        self._pct = Percentile()
+        from brpc_tpu._core import core
+        self._h = core.brpc_latency_new()
+        self._record = core.brpc_latency_record  # bound-method lookup once
+        self._free = core.brpc_latency_free      # cached for __del__ (the
+        # module globals may be torn down before late GC runs)
+        self._num = _NativeStat(self._h, "count")
+        self._sum = _NativeStat(self._h, "sum")
+        self._max = _NativeStat(self._h, "max")
         self._win_sum = Window(self._sum, window_size)
         self._win_num = Window(self._num, window_size)
         self._qps = PerSecond(self._num, window_size)
@@ -131,7 +162,7 @@ class LatencyRecorder(Variable):
     def expose(self, name: str):
         super().expose(name + "_latency")
         from brpc_tpu.bvar.reducer import PassiveStatus
-        PassiveStatus(lambda: self._max.get_value()).expose(name + "_max_latency")
+        PassiveStatus(lambda: self.max_latency()).expose(name + "_max_latency")
         PassiveStatus(lambda: round(self._qps.get_value(), 1)).expose(name + "_qps")
         PassiveStatus(lambda: self._num.get_value()).expose(name + "_count")
         for p, label in ((0.5, "50"), (0.9, "90"), (0.99, "99"),
@@ -141,10 +172,7 @@ class LatencyRecorder(Variable):
         return self
 
     def add(self, latency_us) -> "LatencyRecorder":
-        self._sum.add(latency_us)
-        self._num.add(1)
-        self._max.add(latency_us)
-        self._pct.add(latency_us)
+        self._record(self._h, int(latency_us))
         return self
 
     def __lshift__(self, latency_us):
@@ -159,10 +187,22 @@ class LatencyRecorder(Variable):
         return self.get_value()
 
     def latency_percentile(self, ratio: float) -> float:
-        return self._pct.get_number(ratio)
+        from brpc_tpu._core import core
+        return core.brpc_latency_percentile(self._h, float(ratio))
 
     def max_latency(self):
         return self._max.get_value()
+
+    def __del__(self):
+        # release the native slot (512 process-wide): leaking recorders
+        # would silently dead-end new ones once the pool exhausts
+        h = getattr(self, "_h", None)
+        if h:
+            try:
+                self._free(h)
+            except Exception:
+                pass
+            self._h = None
 
     def qps(self) -> float:
         return self._qps.get_value()

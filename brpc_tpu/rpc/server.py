@@ -82,31 +82,36 @@ class ServerOptions:
 
 class MethodStatus:
     """Per-method concurrency + latency tracking
-    (reference details/method_status.{h,cpp})."""
+    (reference details/method_status.{h,cpp}).
+
+    The per-request path is native combiner cells end to end (VERDICT r2
+    task 5): concurrency is a native Adder (per-thread cells summed on
+    read) and latency rides the native LatencyRecorder backend — no
+    Python-level lock is taken per request."""
 
     def __init__(self, full_name: str, limiter=None):
+        from brpc_tpu._core import core
         safe = full_name.replace("/", "_").replace(".", "_")
         self.full_name = full_name
         self.latency_rec = LatencyRecorder(f"rpc_server_{safe}")
         self.nerror = Adder(f"rpc_server_{safe}_error")
-        self._concurrency = 0
-        self._mu = threading.Lock()
+        self._conc_h = core.brpc_adder_new()
+        self._conc_add = core.brpc_adder_add
+        self._conc_get = core.brpc_adder_get
+        self._conc_free = core.brpc_adder_free   # cached for __del__
         self.limiter = limiter
-        PassiveStatus(lambda: self._concurrency).expose(
+        PassiveStatus(lambda: self.concurrency).expose(
             f"rpc_server_{safe}_concurrency")
 
     def on_requested(self) -> bool:
-        with self._mu:
-            c = self._concurrency + 1
+        c = self._conc_get(self._conc_h) + 1
         if self.limiter is not None and not self.limiter.on_requested(c):
             return False
-        with self._mu:
-            self._concurrency += 1
+        self._conc_add(self._conc_h, 1)
         return True
 
     def on_responded(self, error_code: int, latency_us: int) -> None:
-        with self._mu:
-            self._concurrency = max(0, self._concurrency - 1)
+        self._conc_add(self._conc_h, -1)
         if error_code == 0:
             self.latency_rec.add(latency_us)
         else:
@@ -116,7 +121,16 @@ class MethodStatus:
 
     @property
     def concurrency(self) -> int:
-        return self._concurrency
+        return max(0, self._conc_get(self._conc_h))
+
+    def __del__(self):
+        h = getattr(self, "_conc_h", None)
+        if h:
+            try:
+                self._conc_free(h)
+            except Exception:
+                pass
+            self._conc_h = None
 
 
 class Server:

@@ -118,7 +118,7 @@ void Executor::submit(TaskFn fn, void* arg) {
       _workers[tls_worker_index]->rq.push(t)) {
     // Local fast path still signals so siblings can steal (NOSIGNAL batching
     // would go here; round-1 keeps it simple and always signals once).
-    _signals.fetch_add(1, std::memory_order_relaxed);
+    _signals.add(1);
     _pl.signal(1);
     return;
   }
@@ -126,7 +126,7 @@ void Executor::submit(TaskFn fn, void* arg) {
     std::lock_guard<std::mutex> g(_remote_mu);
     _remote.push_back(t);
   }
-  _signals.fetch_add(1, std::memory_order_relaxed);
+  _signals.add(1);
   _pl.signal(1);
 }
 
@@ -160,7 +160,7 @@ TaskNode* Executor::steal_task(int self) {
     if (v == self) continue;
     TaskNode* t = _workers[v]->rq.steal();
     if (t != nullptr) {
-      _steals.fetch_add(1, std::memory_order_relaxed);
+      _steals.add(1);
       return t;
     }
   }
@@ -187,14 +187,14 @@ void Executor::worker_main(int index) {
     }
     t->fn(t->arg);
     delete t;
-    _executed.fetch_add(1, std::memory_order_relaxed);
+    _executed.add(1);
   }
   // Drain remaining tasks so shutdown doesn't leak work.
   TaskNode* t;
   while ((t = w->rq.pop()) != nullptr || (t = pop_remote()) != nullptr) {
     t->fn(t->arg);
     delete t;
-    _executed.fetch_add(1, std::memory_order_relaxed);
+    _executed.add(1);
   }
   tls_executor = nullptr;
   tls_worker_index = -1;

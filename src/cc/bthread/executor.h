@@ -25,6 +25,8 @@
 #include <thread>
 #include <vector>
 
+#include "bvar/combiner.h"
+
 namespace bthread {
 
 typedef void (*TaskFn)(void*);
@@ -86,10 +88,12 @@ class Executor {
   // True if the calling thread is one of this executor's workers.
   bool in_worker() const;
 
-  // bvar-style counters (exported via the metrics registry).
-  int64_t tasks_executed() const { return _executed.load(std::memory_order_relaxed); }
-  int64_t steals() const { return _steals.load(std::memory_order_relaxed); }
-  int64_t signals() const { return _signals.load(std::memory_order_relaxed); }
+  // bvar combiner counters (per-thread cells, src/cc/bvar/combiner.h):
+  // the per-task increments were shared-cacheline fetch_adds bouncing
+  // across every worker; now each worker writes its own cell.
+  int64_t tasks_executed() const { return _executed.get(); }
+  int64_t steals() const { return _steals.get(); }
+  int64_t signals() const { return _signals.get(); }
 
   static Executor* global();            // lazily started default pool
   static void init_global(int num_workers);
@@ -111,7 +115,7 @@ class Executor {
   std::mutex _remote_mu;
   std::deque<TaskNode*> _remote;
   std::atomic<bool> _stopping{false};
-  std::atomic<int64_t> _executed{0}, _steals{0}, _signals{0};
+  bvar::Adder _executed, _steals, _signals;
 };
 
 // Run std::function tasks through the C-style TaskFn interface.

@@ -1,0 +1,237 @@
+#include "bvar/combiner.h"
+
+#include <mutex>
+#include <vector>
+
+namespace bvar {
+
+// ---- thread block registry (immortal) ----
+
+namespace {
+
+std::atomic<ThreadBlock*> g_blocks{nullptr};
+
+// Blocks from exited threads, recycled for new threads.  A dead thread's
+// counts stay in its block (still on the g_blocks list, still summed);
+// handing the block to a NEW thread just stacks its adds on top — correct
+// for sums, counts, histograms and max alike.  Bounds memory by the PEAK
+// number of concurrent combiner-touching threads, not the total ever
+// created (thread-per-request churn would otherwise leak ~72KB/thread).
+std::mutex g_free_mu;
+std::vector<ThreadBlock*> g_free_blocks;
+
+struct BlockHolder {
+  ThreadBlock* block = nullptr;
+  ThreadBlock* get() {
+    if (block == nullptr) {
+      {
+        std::lock_guard<std::mutex> g(g_free_mu);
+        if (!g_free_blocks.empty()) {
+          block = g_free_blocks.back();   // already on the g_blocks list
+          g_free_blocks.pop_back();
+        }
+      }
+      if (block == nullptr) {
+        block = new ThreadBlock();
+        ThreadBlock* head = g_blocks.load(std::memory_order_acquire);
+        do {
+          block->next = head;
+        } while (!g_blocks.compare_exchange_weak(head, block,
+                                                 std::memory_order_acq_rel,
+                                                 std::memory_order_acquire));
+      }
+    }
+    return block;
+  }
+  ~BlockHolder() {
+    if (block != nullptr) {
+      std::lock_guard<std::mutex> g(g_free_mu);
+      g_free_blocks.push_back(block);
+    }
+  }
+};
+
+thread_local BlockHolder tls_block;
+
+// ---- slot allocators (slot id + per-slot generation) ----
+
+struct SlotAlloc {
+  explicit SlotAlloc(int max) : gens(max, 0), used(max, false) {}
+  std::mutex mu;
+  std::vector<uint32_t> gens;
+  std::vector<bool> used;
+  int hint = 0;
+
+  // returns slot or -1 when exhausted; *gen is the slot's new generation
+  int acquire(uint32_t* gen) {
+    std::lock_guard<std::mutex> g(mu);
+    const int n = (int)gens.size();
+    for (int i = 0; i < n; ++i) {
+      const int s = (hint + i) % n;
+      if (!used[s]) {
+        used[s] = true;
+        hint = s + 1;
+        *gen = ++gens[s];  // bump: every stale cell becomes invisible
+        return s;
+      }
+    }
+    return -1;
+  }
+
+  void release(int slot) {
+    if (slot < 0) return;
+    std::lock_guard<std::mutex> g(mu);
+    used[slot] = false;
+    ++gens[slot];  // invalidate cells immediately
+  }
+};
+
+SlotAlloc& adder_slots() {
+  static SlotAlloc a(kMaxAdders);
+  return a;
+}
+SlotAlloc& latency_slots() {
+  static SlotAlloc a(kMaxLatencyRecs);
+  return a;
+}
+
+}  // namespace
+
+ThreadBlock* this_thread_block() { return tls_block.get(); }
+ThreadBlock* all_blocks() { return g_blocks.load(std::memory_order_acquire); }
+
+// ---- Adder ----
+
+Adder::Adder() {
+  uint32_t gen = 0;
+  _slot = adder_slots().acquire(&gen);
+  // Exhaustion (>4096 live counters) is a misconfiguration; writes become
+  // no-ops rather than UB: park on slot 0 with generation 0, which the
+  // allocator never hands out.
+  if (_slot < 0) {
+    _slot = 0;
+    gen = 0;
+  }
+  _gen.store(gen, std::memory_order_release);
+}
+
+void Adder::close() {
+  const uint32_t gen = _gen.exchange(0, std::memory_order_acq_rel);
+  if (gen != 0) adder_slots().release(_slot);
+}
+
+Adder::~Adder() { close(); }
+
+int64_t Adder::get() const {
+  const uint32_t gen = _gen.load(std::memory_order_acquire);
+  if (gen == 0) return 0;
+  int64_t total = 0;
+  for (ThreadBlock* b = all_blocks(); b != nullptr; b = b->next) {
+    const AdderCell& c = b->adders[_slot];
+    if (c.gen.load(std::memory_order_acquire) == gen) {
+      total += c.v.load(std::memory_order_relaxed);
+    }
+  }
+  return total;
+}
+
+// ---- LatencyRecorder ----
+
+LatencyRecorder::LatencyRecorder() {
+  uint32_t gen = 0;
+  _slot = latency_slots().acquire(&gen);
+  if (_slot < 0) {
+    _slot = 0;
+    gen = 0;
+  }
+  _gen.store(gen, std::memory_order_release);
+}
+
+void LatencyRecorder::close() {
+  const uint32_t gen = _gen.exchange(0, std::memory_order_acq_rel);
+  if (gen != 0) latency_slots().release(_slot);
+}
+
+LatencyRecorder::~LatencyRecorder() { close(); }
+
+LatencyCell* LatencyRecorder::local_cell(uint32_t gen) {
+  ThreadBlock* b = this_thread_block();
+  LatencyCell* c = b->lat[_slot].load(std::memory_order_acquire);
+  if (c == nullptr) {
+    c = new LatencyCell();  // lives with its (recycled) block
+    b->lat[_slot].store(c, std::memory_order_release);
+  }
+  if (c->gen.load(std::memory_order_relaxed) != gen) {
+    c->count.store(0, std::memory_order_relaxed);
+    c->sum.store(0, std::memory_order_relaxed);
+    c->max.store(0, std::memory_order_relaxed);
+    for (auto& h : c->hist) h.store(0, std::memory_order_relaxed);
+    c->gen.store(gen, std::memory_order_release);
+  }
+  return c;
+}
+
+void LatencyRecorder::record(int64_t us) {
+  const uint32_t gen = _gen.load(std::memory_order_relaxed);
+  if (gen == 0) return;
+  LatencyCell* c = local_cell(gen);
+  // single writer per cell: plain read-modify-write, no RMW atomics
+  c->count.store(c->count.load(std::memory_order_relaxed) + 1,
+                 std::memory_order_relaxed);
+  c->sum.store(c->sum.load(std::memory_order_relaxed) + us,
+               std::memory_order_relaxed);
+  if (us > c->max.load(std::memory_order_relaxed)) {
+    c->max.store(us, std::memory_order_relaxed);
+  }
+  auto& h = c->hist[latency_bucket(us)];
+  h.store(h.load(std::memory_order_relaxed) + 1, std::memory_order_relaxed);
+}
+
+LatencyStats LatencyRecorder::stats() const {
+  LatencyStats out;
+  const uint32_t gen = _gen.load(std::memory_order_acquire);
+  if (gen == 0) return out;
+  for (ThreadBlock* b = all_blocks(); b != nullptr; b = b->next) {
+    LatencyCell* c = b->lat[_slot].load(std::memory_order_acquire);
+    if (c == nullptr || c->gen.load(std::memory_order_acquire) != gen) {
+      continue;
+    }
+    out.count += c->count.load(std::memory_order_relaxed);
+    out.sum += c->sum.load(std::memory_order_relaxed);
+    const int64_t m = c->max.load(std::memory_order_relaxed);
+    if (m > out.max) out.max = m;
+  }
+  return out;
+}
+
+double LatencyRecorder::percentile(double ratio) const {
+  const uint32_t gen = _gen.load(std::memory_order_acquire);
+  if (gen == 0) return 0.0;
+  uint64_t merged[kLatencyBuckets] = {0};
+  uint64_t total = 0;
+  for (ThreadBlock* b = all_blocks(); b != nullptr; b = b->next) {
+    LatencyCell* c = b->lat[_slot].load(std::memory_order_acquire);
+    if (c == nullptr || c->gen.load(std::memory_order_acquire) != gen) {
+      continue;
+    }
+    for (int i = 0; i < kLatencyBuckets; ++i) {
+      const uint32_t n = c->hist[i].load(std::memory_order_relaxed);
+      merged[i] += n;
+      total += n;
+    }
+  }
+  if (total == 0) return 0.0;
+  if (ratio < 0) ratio = 0;
+  if (ratio > 1) ratio = 1;
+  uint64_t target = (uint64_t)(ratio * (double)total + 0.5);
+  if (target == 0) target = 1;
+  if (target > total) target = total;
+  uint64_t acc = 0;
+  for (int i = 0; i < kLatencyBuckets; ++i) {
+    acc += merged[i];
+    if (acc >= target) return latency_bucket_mid(i);
+  }
+  return latency_bucket_mid(kLatencyBuckets - 1);
+}
+
+}  // namespace bvar

@@ -1,0 +1,139 @@
+// Per-thread combined counters & latency cells (reference
+// bvar/detail/combiner.h:71-156, agent_group.h, latency_recorder.h:49-75;
+// SURVEY.md §2.7).
+//
+// Write path: one relaxed store to the calling thread's OWN cell — no
+// shared cacheline, no lock, no CAS (each cell has exactly one writer).
+// Read path: sum matching cells across every thread's block under a short
+// registry lock.  The reference's economics exactly.
+//
+// Lifetime scheme (differs from the reference's agent reclamation):
+// thread blocks are IMMORTAL — registered on a global list at first touch
+// and never freed, so readers can walk them without coordinating with
+// thread exit, and a dying thread's final counts are never lost (they
+// simply stay in its block and keep being summed).  Object slots are
+// recycled through a (slot, generation) pair: destroying a counter bumps
+// the slot's generation, making every thread's stale cell invisible to
+// the slot's next owner.  Bounded cost: one block per thread that ever
+// touched a counter (~72KB + lazily-allocated latency cells).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace bvar {
+
+constexpr int kMaxAdders = 4096;       // combiner objects process-wide
+constexpr int kMaxLatencyRecs = 512;   // latency recorders process-wide
+constexpr int kLatencyBuckets = 512;   // 8 sub-buckets/octave log2 hist
+
+struct AdderCell {
+  std::atomic<uint32_t> gen{0};
+  std::atomic<int64_t> v{0};
+};
+
+struct LatencyCell {
+  std::atomic<uint32_t> gen{0};
+  std::atomic<int64_t> count{0};
+  std::atomic<int64_t> sum{0};
+  std::atomic<int64_t> max{0};
+  std::atomic<uint32_t> hist[kLatencyBuckets];
+  LatencyCell() { for (auto& h : hist) h.store(0, std::memory_order_relaxed); }
+};
+
+struct ThreadBlock {
+  AdderCell adders[kMaxAdders];
+  std::atomic<LatencyCell*> lat[kMaxLatencyRecs];  // lazily allocated
+  ThreadBlock* next = nullptr;                     // global immortal list
+};
+
+// The calling thread's block (created + registered on first use) and the
+// global list head for readers.
+ThreadBlock* this_thread_block();
+ThreadBlock* all_blocks();
+
+// value(us) -> histogram bucket: exact below 8, then 8 sub-buckets per
+// power of two (12.5% worst-case resolution).
+inline int latency_bucket(int64_t v) {
+  if (v <= 0) return 0;
+  uint64_t u = (uint64_t)v;
+  if (u < 8) return (int)u;
+  const int oct = 63 - __builtin_clzll(u);
+  const int sub = (int)((u >> (oct - 3)) & 7);
+  const int idx = (oct - 3) * 8 + sub + 8;
+  return idx >= kLatencyBuckets ? kLatencyBuckets - 1 : idx;
+}
+
+inline double latency_bucket_mid(int idx) {
+  if (idx < 8) return (double)idx;
+  const int oct = (idx - 8) / 8 + 3;
+  const int sub = (idx - 8) % 8;
+  const double base = (double)(1ull << oct) * (1.0 + sub / 8.0);
+  return base + (double)(1ull << oct) / 16.0;
+}
+
+// Combined int64 sum.  add() is a single-writer relaxed load+store on the
+// caller's own cell; get() sums cells whose generation matches.
+class Adder {
+ public:
+  Adder();
+  ~Adder();
+  Adder(const Adder&) = delete;
+  Adder& operator=(const Adder&) = delete;
+
+  void add(int64_t d) {
+    const uint32_t gen = _gen.load(std::memory_order_relaxed);
+    if (gen == 0) return;   // closed, or slot pool exhausted: no-op —
+                            // never touch slot 0's legitimate owner
+    AdderCell& c = this_thread_block()->adders[_slot];
+    if (c.gen.load(std::memory_order_relaxed) != gen) {
+      c.v.store(0, std::memory_order_relaxed);
+      c.gen.store(gen, std::memory_order_release);
+    }
+    c.v.store(c.v.load(std::memory_order_relaxed) + d,
+              std::memory_order_relaxed);
+  }
+
+  int64_t get() const;
+
+  // Release the slot and go inert: adds become no-ops, reads return 0.
+  // The C-ABI "free" calls this WITHOUT deleting the object, so stale
+  // readers (a sampler thread holding the handle across a Python GC)
+  // read zeros instead of freed memory; the slot — the scarce resource —
+  // recycles.  close() must not race add() on the same object.
+  void close();
+
+ private:
+  int _slot;
+  std::atomic<uint32_t> _gen;
+};
+
+struct LatencyStats {
+  int64_t count = 0;
+  int64_t sum = 0;
+  int64_t max = 0;
+};
+
+// Combined latency recorder: count/sum/max + log-bucket histogram, all in
+// the caller's own cell; merged on read.
+class LatencyRecorder {
+ public:
+  LatencyRecorder();
+  ~LatencyRecorder();
+  LatencyRecorder(const LatencyRecorder&) = delete;
+  LatencyRecorder& operator=(const LatencyRecorder&) = delete;
+
+  void record(int64_t us);
+  LatencyStats stats() const;
+  // latency at `ratio` (0.5 = p50) from the merged histogram.
+  double percentile(double ratio) const;
+  // See Adder::close().
+  void close();
+
+ private:
+  LatencyCell* local_cell(uint32_t gen);
+  int _slot;
+  std::atomic<uint32_t> _gen;
+};
+
+}  // namespace bvar

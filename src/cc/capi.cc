@@ -8,6 +8,7 @@
 #include "bthread/timer.h"
 #include "butil/common.h"
 #include "butil/iobuf.h"
+#include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
 #include "net/parser.h"
 #include "net/socket.h"
@@ -186,6 +187,39 @@ int brpc_socket_stats(uint64_t sid, int64_t* nread, int64_t* nwritten,
 }
 
 int64_t brpc_socket_active_count() { return brpc::Socket::active_count(); }
+
+void brpc_socket_traffic(int64_t* nread, int64_t* nwritten, int64_t* nmsg) {
+  brpc::Socket::GlobalTraffic(nread, nwritten, nmsg);
+}
+
+// ---- bvar combiners (per-thread cells; src/cc/bvar/combiner.h) ----
+// Handles for the Python bvar registry: the per-request metrics path
+// (MethodStatus, LatencyRecorder) becomes ONE C call into thread-local
+// cells — no Python-level locks (VERDICT r2 task 5).
+
+// "free" releases the SLOT (the scarce resource) but never deletes the
+// object: a Python-side sampler thread may still hold the handle after
+// GC runs __del__ — reads on a closed handle return zeros instead of
+// touching freed memory.  The ~16-byte husk is the price of that safety.
+void* brpc_adder_new() { return new bvar::Adder(); }
+void brpc_adder_free(void* h) { ((bvar::Adder*)h)->close(); }
+void brpc_adder_add(void* h, int64_t v) { ((bvar::Adder*)h)->add(v); }
+int64_t brpc_adder_get(void* h) { return ((bvar::Adder*)h)->get(); }
+
+void* brpc_latency_new() { return new bvar::LatencyRecorder(); }
+void brpc_latency_free(void* h) { ((bvar::LatencyRecorder*)h)->close(); }
+void brpc_latency_record(void* h, int64_t us) {
+  ((bvar::LatencyRecorder*)h)->record(us);
+}
+void brpc_latency_stats(void* h, int64_t* count, int64_t* sum, int64_t* max) {
+  const bvar::LatencyStats s = ((bvar::LatencyRecorder*)h)->stats();
+  if (count) *count = s.count;
+  if (sum) *sum = s.sum;
+  if (max) *max = s.max;
+}
+double brpc_latency_percentile(void* h, double ratio) {
+  return ((bvar::LatencyRecorder*)h)->percentile(ratio);
+}
 
 // EOVERCROWDED backpressure controls (reference socket.h:326-380).
 void brpc_socket_set_overcrowded_limit(int64_t bytes) {

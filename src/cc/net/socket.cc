@@ -11,6 +11,7 @@
 #include <unistd.h>
 
 #include "bthread/executor.h"
+#include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
 
 namespace brpc {
@@ -20,6 +21,19 @@ using butil::ResourcePool;
 static ResourcePool<Socket>* pool() { return ResourcePool<Socket>::singleton(); }
 
 static std::atomic<int64_t> g_active_sockets{0};
+// Process-wide traffic totals as bvar combiners (per-thread cells,
+// bvar/combiner.h): dispatcher and drainer threads each write their own
+// cell instead of bouncing one shared cacheline per read/write/message
+// (reference SocketVarsCollector, socket.h:126-157).
+static bvar::Adder g_total_read_bytes;
+static bvar::Adder g_total_written_bytes;
+static bvar::Adder g_total_messages;
+
+void Socket::GlobalTraffic(int64_t* nread, int64_t* nwritten, int64_t* nmsg) {
+  if (nread) *nread = g_total_read_bytes.get();
+  if (nwritten) *nwritten = g_total_written_bytes.get();
+  if (nmsg) *nmsg = g_total_messages.get();
+}
 // Per-socket unwritten-byte cap (reference FLAGS_socket_max_unwritten_bytes;
 // EOVERCROWDED backpressure, socket.h:326-380).
 static std::atomic<int64_t> g_overcrowded_limit{64 << 20};
@@ -276,6 +290,7 @@ void Socket::DrainWriteQueue(bool from_keepwrite) {
       if (nw >= 0) {
         _nwritten.fetch_add(nw, std::memory_order_relaxed);
         _pending_write.fetch_sub(nw, std::memory_order_relaxed);
+        g_total_written_bytes.add(nw);
         continue;
       }
       if (errno == EINTR) continue;
@@ -318,6 +333,7 @@ void Socket::OnReadable() {
     const ssize_t nr = _read_buf.append_from_file_descriptor(_fd, 256 * 1024);
     if (nr > 0) {
       _nread.fetch_add(nr, std::memory_order_relaxed);
+      g_total_read_bytes.add(nr);
       DispatchMessages();
       // Edge-triggered: must keep reading until EAGAIN.
       continue;
@@ -378,6 +394,7 @@ void Socket::DispatchMessages() {
       return;
     }
     _nmsg.fetch_add(1, std::memory_order_relaxed);
+    g_total_messages.add(1);
     if (_opts.native_echo && msg.kind == MSG_TRPC) {
       // Native echo service: reflect the frame without leaving C++.
       butil::IOBuf out;

@@ -88,6 +88,8 @@ class Socket {
   static Socket* Address(SocketId id);
   static int SetFailed(SocketId id, int error_code);
   static int64_t active_count();
+  // Process-wide traffic totals (bvar combiner cells; SURVEY §2.7).
+  static void GlobalTraffic(int64_t* nread, int64_t* nwritten, int64_t* nmsg);
 
   void Dereference();
 
