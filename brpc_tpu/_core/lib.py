@@ -141,6 +141,10 @@ _sigs = {
                                    ctypes.POINTER(ctypes.c_int64),
                                    ctypes.POINTER(ctypes.c_int64)]),
     # bvar combiners (per-thread cells, src/cc/bvar/combiner.h)
+    "brpc_atomic_new": (ctypes.c_void_p, []),
+    "brpc_atomic_free": (None, [ctypes.c_void_p]),
+    "brpc_atomic_incr": (ctypes.c_int64, [ctypes.c_void_p, ctypes.c_int64]),
+    "brpc_atomic_get": (ctypes.c_int64, [ctypes.c_void_p]),
     "brpc_adder_new": (ctypes.c_void_p, []),
     "brpc_adder_free": (None, [ctypes.c_void_p]),
     "brpc_adder_add": (None, [ctypes.c_void_p, ctypes.c_int64]),

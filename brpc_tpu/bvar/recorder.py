@@ -112,22 +112,26 @@ class IntRecorder(Variable):
 
 class _NativeStat:
     """Variable-shaped view of one field of a native latency recorder —
-    lets Window/PerSecond sample native combiner state like any reducer."""
+    lets Window/PerSecond sample native combiner state like any reducer.
+    The stats C function is cached at init: get_value runs once a second
+    per sampler for the life of the recorder, and per-call module
+    imports would be pure overhead."""
 
-    __slots__ = ("_handle", "_field")
+    __slots__ = ("_handle", "_field", "_stats")
 
     def __init__(self, handle, field: str):
+        from brpc_tpu._core import core
         self._handle = handle
         self._field = field
+        self._stats = core.brpc_latency_stats
 
     def get_value(self):
         import ctypes
-        from brpc_tpu._core import core
         c = ctypes.c_int64()
         s = ctypes.c_int64()
         m = ctypes.c_int64()
-        core.brpc_latency_stats(self._handle, ctypes.byref(c),
-                                ctypes.byref(s), ctypes.byref(m))
+        self._stats(self._handle, ctypes.byref(c), ctypes.byref(s),
+                    ctypes.byref(m))
         return {"count": c.value, "sum": s.value, "max": m.value}[self._field]
 
 
@@ -151,6 +155,7 @@ class LatencyRecorder(Variable):
         self._record = core.brpc_latency_record  # bound-method lookup once
         self._free = core.brpc_latency_free      # cached for __del__ (the
         # module globals may be torn down before late GC runs)
+        self._percentile = core.brpc_latency_percentile
         self._num = _NativeStat(self._h, "count")
         self._sum = _NativeStat(self._h, "sum")
         self._max = _NativeStat(self._h, "max")
@@ -187,8 +192,7 @@ class LatencyRecorder(Variable):
         return self.get_value()
 
     def latency_percentile(self, ratio: float) -> float:
-        from brpc_tpu._core import core
-        return core.brpc_latency_percentile(self._h, float(ratio))
+        return self._percentile(self._h, float(ratio))
 
     def max_latency(self):
         return self._max.get_value()

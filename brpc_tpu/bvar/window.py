@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 
 from brpc_tpu.bvar.variable import Variable
 
@@ -32,19 +33,32 @@ class _SamplerThread:
         self._thread.start()
 
     def add(self, sampler) -> None:
+        # weakref: a Window whose owner was replaced in the bvar registry
+        # (same-name re-expose) must become collectable — a strong ref
+        # here would pin every recorder a process ever created and leak
+        # its native combiner slot forever
         with self._mu:
-            self._samplers.append(sampler)
+            self._samplers.append(weakref.ref(sampler))
 
     def _run(self):
         while True:
             start = time.monotonic()
             with self._mu:
-                samplers = list(self._samplers)
-            for s in samplers:
+                refs = list(self._samplers)
+            dead = []
+            for ref in refs:
+                s = ref()
+                if s is None:
+                    dead.append(ref)
+                    continue
                 try:
                     s.take_sample()
                 except Exception:  # pragma: no cover
                     pass
+            if dead:
+                with self._mu:
+                    self._samplers = [r for r in self._samplers
+                                      if r not in dead]
             time.sleep(max(0.0, 1.0 - (time.monotonic() - start)))
 
 

@@ -84,10 +84,12 @@ class MethodStatus:
     """Per-method concurrency + latency tracking
     (reference details/method_status.{h,cpp}).
 
-    The per-request path is native combiner cells end to end (VERDICT r2
-    task 5): concurrency is a native Adder (per-thread cells summed on
-    read) and latency rides the native LatencyRecorder backend — no
-    Python-level lock is taken per request."""
+    The per-request path is native end to end (VERDICT r2 task 5):
+    concurrency is a native EXACT atomic (admission control needs a
+    linearizable count — a combiner's relaxed cell-walk can transiently
+    undercount and over-admit) and latency rides the native combiner
+    LatencyRecorder backend — no Python-level lock is taken per
+    request."""
 
     def __init__(self, full_name: str, limiter=None):
         from brpc_tpu._core import core
@@ -95,23 +97,27 @@ class MethodStatus:
         self.full_name = full_name
         self.latency_rec = LatencyRecorder(f"rpc_server_{safe}")
         self.nerror = Adder(f"rpc_server_{safe}_error")
-        self._conc_h = core.brpc_adder_new()
-        self._conc_add = core.brpc_adder_add
-        self._conc_get = core.brpc_adder_get
-        self._conc_free = core.brpc_adder_free   # cached for __del__
+        self._conc_h = core.brpc_atomic_new()
+        self._conc_incr = core.brpc_atomic_incr
+        self._conc_get = core.brpc_atomic_get
+        self._conc_free = core.brpc_atomic_free  # cached for __del__
         self.limiter = limiter
         PassiveStatus(lambda: self.concurrency).expose(
             f"rpc_server_{safe}_concurrency")
 
     def on_requested(self) -> bool:
-        c = self._conc_get(self._conc_h) + 1
+        c = self._conc_incr(self._conc_h, 1)
         if self.limiter is not None and not self.limiter.on_requested(c):
+            self._conc_incr(self._conc_h, -1)
             return False
-        self._conc_add(self._conc_h, 1)
         return True
 
     def on_responded(self, error_code: int, latency_us: int) -> None:
-        self._conc_add(self._conc_h, -1)
+        # self-heal at zero (the old locked max(0, c-1)): an unmatched
+        # on_responded must not drive the gauge permanently negative and
+        # disable the limiter
+        if self._conc_incr(self._conc_h, -1) < 0:
+            self._conc_incr(self._conc_h, 1)
         if error_code == 0:
             self.latency_rec.add(latency_us)
         else:

@@ -201,6 +201,19 @@ void brpc_socket_traffic(int64_t* nread, int64_t* nwritten, int64_t* nmsg) {
 // object: a Python-side sampler thread may still hold the handle after
 // GC runs __del__ — reads on a closed handle return zeros instead of
 // touching freed memory.  The ~16-byte husk is the price of that safety.
+// Exact shared atomic counter (NOT a combiner): admission control needs a
+// linearizable count — the combiner's relaxed cell-walk can transiently
+// undercount in-flight requests and over-admit past max_concurrency.
+void* brpc_atomic_new() { return new std::atomic<int64_t>(0); }
+void brpc_atomic_free(void* h) { delete (std::atomic<int64_t>*)h; }
+int64_t brpc_atomic_incr(void* h, int64_t d) {
+  return ((std::atomic<int64_t>*)h)->fetch_add(d,
+                                               std::memory_order_acq_rel) + d;
+}
+int64_t brpc_atomic_get(void* h) {
+  return ((std::atomic<int64_t>*)h)->load(std::memory_order_acquire);
+}
+
 void* brpc_adder_new() { return new bvar::Adder(); }
 void brpc_adder_free(void* h) { ((bvar::Adder*)h)->close(); }
 void brpc_adder_add(void* h, int64_t v) { ((bvar::Adder*)h)->add(v); }

@@ -37,20 +37,54 @@ class TestNativeAdder:
         finally:
             core.brpc_adder_free(h)
 
-    def test_negative_and_reuse(self):
+    def test_negative(self):
         h = core.brpc_adder_new()
         core.brpc_adder_add(h, 10)
         core.brpc_adder_add(h, -3)
         assert core.brpc_adder_get(h) == 7
         core.brpc_adder_free(h)
-        # slot reuse: a new adder must NOT see the old adder's cells
+
+    def test_slot_reuse_generation_invalidation(self):
+        """The lifetime scheme rests on generation bumps making a freed
+        slot's stale cells invisible to its next owner.  The allocator's
+        advancing hint hands out virgin slots first, so force a full wrap
+        (> kMaxAdders create/write/free cycles) to land new adders on
+        RECYCLED slots whose cells still hold old-generation values."""
+        for i in range(4100):
+            h = core.brpc_adder_new()
+            core.brpc_adder_add(h, 7)       # dirty the slot's cell
+            assert core.brpc_adder_get(h) == 7, f"iteration {i}"
+            core.brpc_adder_free(h)
+        # well past the wrap: these slots were all used before
         h2 = core.brpc_adder_new()
         try:
-            assert core.brpc_adder_get(h2) == 0
+            assert core.brpc_adder_get(h2) == 0   # stale cells invisible
             core.brpc_adder_add(h2, 5)
             assert core.brpc_adder_get(h2) == 5
         finally:
             core.brpc_adder_free(h2)
+
+    def test_exact_atomic_counter(self):
+        """brpc_atomic_*: the linearizable counter admission control uses
+        (a combiner's relaxed cell-walk may transiently undercount)."""
+        h = core.brpc_atomic_new()
+        try:
+            assert core.brpc_atomic_incr(h, 1) == 1
+            assert core.brpc_atomic_incr(h, 1) == 2
+            assert core.brpc_atomic_incr(h, -1) == 1
+            assert core.brpc_atomic_get(h) == 1
+            n_threads, per = 8, 20_000
+            def w():
+                for _ in range(per):
+                    core.brpc_atomic_incr(h, 1)
+            ts = [threading.Thread(target=w) for _ in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert core.brpc_atomic_get(h) == 1 + n_threads * per
+        finally:
+            core.brpc_atomic_free(h)
 
     def test_dead_thread_counts_survive(self):
         """A thread's contributions outlive it (immortal blocks): the sum
