@@ -288,9 +288,10 @@ class CallManager:
     def on_fast_response(self, sid: int, cid: int, attempt: int,
                          error_code: int, error_text: str, compress: int,
                          content_type: str, attachment_size: int,
-                         body: bytes) -> None:
+                         body) -> None:
         """Natively pre-parsed response (net/rpc.h via _fastrpc): no
-        Python TLV walk, body already bytes.  Fast metas can only carry
+        Python TLV walk; the body is an IOBuf-backed read-only memoryview
+        (zero copy — pins the blocks while referenced).  Fast metas carry
         cid/attempt/error/compress/content_type/attachment_size — anything
         richer (streams, tensor headers, user fields) arrives via
         on_message with a full decode."""
@@ -345,10 +346,16 @@ class CallManager:
             self._finish(st)
             return
         try:
-            raw = body if isinstance(body, bytes) else body.to_bytes()
+            # fast-path bodies arrive as IOBuf-backed memoryviews (zero
+            # copy, _fastrpc FastBody); slicing memoryviews stays zero-copy
+            raw = body if isinstance(body, (bytes, memoryview)) \
+                else body.to_bytes()
             att_size = meta.attachment_size
             payload = raw[: len(raw) - att_size] if att_size else raw
-            cntl.response_attachment = raw[len(raw) - att_size:] if att_size else b""
+            # attachments keep the documented bytes contract (handlers
+            # .decode()/.startswith() them); materialize off the view
+            cntl.response_attachment = bytes(raw[len(raw) - att_size:]) \
+                if att_size else b""
             payload = decompress(payload, meta.compress_type)
             serializer = get_serializer(meta.content_type or "raw")
             cntl.reset_for_retry()

@@ -64,7 +64,12 @@ class RawSerializer(Serializer):
         raise TypeError(f"raw serializer needs bytes, got {type(obj)}")
 
     def decode(self, body, tensor_header):
-        return body
+        # handlers own raw bodies as bytes (they concatenate, .decode(),
+        # hash them); an IOBuf-backed memoryview from the fast path is
+        # materialized HERE, at the last boundary — upstream slicing
+        # (attachment split, decompress passthrough) stayed zero-copy, and
+        # the tensor serializer consumes the view without any copy at all
+        return bytes(body) if isinstance(body, memoryview) else body
 
 
 class JsonSerializer(Serializer):
@@ -74,6 +79,8 @@ class JsonSerializer(Serializer):
         return json.dumps(obj, separators=(",", ":")).encode(), b""
 
     def decode(self, body, tensor_header):
+        if isinstance(body, memoryview):
+            body = bytes(body)
         return json.loads(body) if body else None
 
 
@@ -90,9 +97,10 @@ class PbSerializer(Serializer):
 
     def decode(self, body, tensor_header):
         if self.message_class is None:
-            return body
+            return bytes(body) if isinstance(body, memoryview) else body
         msg = self.message_class()
-        msg.ParseFromString(body)
+        msg.ParseFromString(bytes(body) if isinstance(body, memoryview)
+                            else body)
         return msg
 
 
@@ -188,7 +196,7 @@ class CompactSerializer(Serializer):
 
     def decode(self, body, tensor_header):
         from brpc_tpu.rpc.compact import loads
-        return loads(body)
+        return loads(bytes(body) if isinstance(body, memoryview) else body)
 
 
 for _s in (RawSerializer(), JsonSerializer(), PbSerializer(),

@@ -492,7 +492,8 @@ class Server:
             from brpc_tpu.rpc.rpc_dump import RpcDumper
             RpcDumper.instance().sample(
                 meta_bytes or meta.encode(),
-                body if isinstance(body, bytes) else body.to_bytes())
+                bytes(body) if isinstance(body, (bytes, memoryview))
+                else body.to_bytes())
         tag = self._service_tags.get(meta.service)
         pool = self._tag_pools.get(tag) if tag is not None else None
         if pool is not None:
@@ -599,12 +600,17 @@ class Server:
                 request = rail.claim(meta.user_fields["icit"])
                 span.request_size = 0
             else:
-                # fast-path bodies arrive as bytes (converted C-side); the
-                # generic path hands an IOBuf
-                raw = body if isinstance(body, bytes) else body.to_bytes()
+                # fast-path bodies arrive as IOBuf-backed memoryviews
+                # (zero copy, _fastrpc FastBody); the generic path hands
+                # an IOBuf.  memoryview slicing keeps it zero-copy.
+                raw = body if isinstance(body, (bytes, memoryview)) \
+                    else body.to_bytes()
                 att = meta.attachment_size
                 payload = raw[: len(raw) - att] if att else raw
-                cntl.request_attachment = raw[len(raw) - att:] if att else b""
+                # bytes contract for attachments (same boundary rule as
+                # the raw serializer): handlers get bytes, not views
+                cntl.request_attachment = bytes(raw[len(raw) - att:]) \
+                    if att else b""
                 payload = decompress(payload, meta.compress_type)
                 request = spec.request_serializer.decode(payload,
                                                          meta.tensor_header)

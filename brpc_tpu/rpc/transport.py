@@ -84,7 +84,8 @@ class Transport:
                     self._request_handlers[conn] = rh
 
         # fast-path dispatchers (_fastrpc C extension: natively pre-parsed
-        # metas arrive as flat args with the body already a bytes object)
+        # metas arrive as flat args; the body is an IOBuf-backed READ-ONLY
+        # memoryview — zero-copy, pins the blocks while referenced)
         def _on_request(sid, cid, attempt, service, method_, compress,
                         timeout_ms, content_type, attachment_size, body):
             h = self._request_handlers.get(sid)

@@ -26,12 +26,85 @@ namespace {
 PyObject* g_request_handler = nullptr;   // called with 10-tuple args
 PyObject* g_response_handler = nullptr;  // called with 9-tuple args
 
-PyObject* iobuf_steal_bytes(butil::IOBuf* b) {
-  const size_t n = b->size();
-  PyObject* out = PyBytes_FromStringAndSize(nullptr, (Py_ssize_t)n);
-  if (out == nullptr) return nullptr;
-  b->copy_to(PyBytes_AS_STRING(out), n, 0);
-  return out;
+// ---- FastBody: IOBuf-backed buffer object (zero-copy boundary) ----
+//
+// VERDICT r2 task 9: fast-path bodies used to be memcpy'd into Python
+// bytes.  FastBody owns the native IOBuf and exposes its bytes through
+// the buffer protocol: single-block bodies (every body <= one 8KB block
+// — the common case) are exposed IN PLACE; multi-block bodies coalesce
+// once on first access.  Python sees a standard memoryview over it, so
+// slicing (payload/attachment split) stays zero-copy and the IOBuf block
+// refs live exactly as long as Python references do — the SURVEY §2.1
+// splice semantics carried across the language boundary.
+
+struct FastBodyObject {
+  PyObject_HEAD
+  butil::IOBuf* buf;
+  char* flat;     // coalesced copy for multi-block bodies (lazy)
+  size_t size;
+};
+
+int fastbody_getbuffer(PyObject* self, Py_buffer* view, int flags) {
+  auto* fb = (FastBodyObject*)self;
+  void* ptr = nullptr;
+  if (fb->flat != nullptr) {
+    ptr = fb->flat;
+  } else if (fb->size == 0) {
+    ptr = (void*)"";  // zero-length: any non-null pointer is fine
+  } else if (fb->buf->backing_block_num() == 1) {
+    const butil::BlockRef& r = fb->buf->backing_block(0);
+    ptr = butil::iobuf::block_data(r.block) + r.offset;
+  } else {
+    fb->flat = (char*)PyMem_Malloc(fb->size);
+    if (fb->flat == nullptr) {
+      PyErr_NoMemory();
+      return -1;
+    }
+    fb->buf->copy_to(fb->flat, fb->size, 0);
+    // the flat copy fully replaces the blocks: release them now rather
+    // than doubling memory for the view's lifetime (dealloc handles null)
+    delete fb->buf;
+    fb->buf = nullptr;
+    ptr = fb->flat;
+  }
+  return PyBuffer_FillInfo(view, self, ptr, (Py_ssize_t)fb->size,
+                           /*readonly=*/1, flags);
+}
+
+void fastbody_dealloc(PyObject* self) {
+  auto* fb = (FastBodyObject*)self;
+  delete fb->buf;
+  if (fb->flat != nullptr) PyMem_Free(fb->flat);
+  Py_TYPE(self)->tp_free(self);
+}
+
+Py_ssize_t fastbody_length(PyObject* self) {
+  return (Py_ssize_t)((FastBodyObject*)self)->size;
+}
+
+PyBufferProcs fastbody_as_buffer = {fastbody_getbuffer, nullptr};
+PySequenceMethods fastbody_as_sequence = {fastbody_length};
+
+PyTypeObject FastBodyType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "_fastrpc.FastBody",            /* tp_name */
+    sizeof(FastBodyObject),         /* tp_basicsize */
+};
+
+// Wrap `b` (ownership taken) as a read-only memoryview whose lifetime
+// keeps the IOBuf blocks alive.  Returns nullptr with an exception set.
+PyObject* iobuf_to_memoryview(butil::IOBuf* b) {
+  auto* fb = PyObject_New(FastBodyObject, &FastBodyType);
+  if (fb == nullptr) {
+    delete b;
+    return nullptr;
+  }
+  fb->buf = b;
+  fb->flat = nullptr;
+  fb->size = b->size();
+  PyObject* mv = PyMemoryView_FromObject((PyObject*)fb);
+  Py_DECREF(fb);  // the memoryview holds the buffer reference
+  return mv;
 }
 
 // ---- native -> Python trampolines (run on executor/dispatcher threads) ----
@@ -61,8 +134,7 @@ void fast_request_cb(brpc::SocketId sid, const brpc::RequestHeader* hdr,
   PyObject* handler = g_request_handler;
   bool handled = false;
   if (handler != nullptr) {
-    PyObject* payload = iobuf_steal_bytes(body);
-    delete body;
+    PyObject* payload = iobuf_to_memoryview(body);  // takes ownership
     if (payload != nullptr) {
       PyObject* r = PyObject_CallFunction(
           handler, "KKHs#s#BIs#KN", (unsigned long long)sid,
@@ -94,8 +166,7 @@ void fast_response_cb(brpc::SocketId sid, const brpc::RequestHeader* hdr,
   PyGILState_STATE g = PyGILState_Ensure();
   PyObject* handler = g_response_handler;
   if (handler != nullptr) {
-    PyObject* payload = iobuf_steal_bytes(body);
-    delete body;
+    PyObject* payload = iobuf_to_memoryview(body);  // takes ownership
     if (payload != nullptr) {
       PyObject* r = PyObject_CallFunction(
           handler, "KKHis#Bs#KN", (unsigned long long)sid,
@@ -118,6 +189,41 @@ void fast_response_cb(brpc::SocketId sid, const brpc::RequestHeader* hdr,
 
 // ---- Python -> native ----
 
+// Zero-copy send threshold: below it a memcpy into the IOBuf beats the
+// Py_buffer bookkeeping + GIL reacquisition in the deleter.
+constexpr Py_ssize_t kZeroCopySendBytes = 4096;
+
+struct PyBufHolder { Py_buffer view; };
+
+void release_pybuf(void* /*data*/, void* arg) {
+  // Runs when the last block ref drops (usually the writer thread after
+  // the bytes hit the fd) — must retake the GIL to release the exporter.
+  PyGILState_STATE g = PyGILState_Ensure();
+  auto* h = (PyBufHolder*)arg;
+  PyBuffer_Release(&h->view);
+  delete h;
+  PyGILState_Release(g);
+}
+
+// Move `view`'s bytes into b: small payloads copy; large ones wrap the
+// Python buffer as a user block that pins the exporter until written.
+void append_pybuffer(butil::IOBuf* b, Py_buffer* view) {
+  if (view->len <= 0) {
+    PyBuffer_Release(view);
+    return;
+  }
+  if (view->len < kZeroCopySendBytes || !view->readonly) {
+    // writable exporters (bytearray, numpy) must be copied: the caller is
+    // free to mutate after we return, and a pinned mutable buffer would
+    // silently corrupt the queued frame if the write queue is backlogged
+    b->append(view->buf, (size_t)view->len);
+    PyBuffer_Release(view);
+    return;
+  }
+  auto* h = new PyBufHolder{*view};
+  b->append_user_data(h->view.buf, (size_t)h->view.len, release_pybuf, h);
+}
+
 PyObject* py_send_request(PyObject*, PyObject* args) {
   unsigned long long sid, cid;
   unsigned short attempt;
@@ -125,14 +231,13 @@ PyObject* py_send_request(PyObject*, PyObject* args) {
   Py_ssize_t service_len, method_len, ct_len;
   unsigned int timeout_ms;
   unsigned char compress;
-  const char* body;
-  Py_ssize_t body_len;
-  if (!PyArg_ParseTuple(args, "KKHs#s#IBs#y#", &sid, &cid, &attempt, &service,
+  Py_buffer body;
+  if (!PyArg_ParseTuple(args, "KKHs#s#IBs#y*", &sid, &cid, &attempt, &service,
                         &service_len, &method, &method_len, &timeout_ms,
-                        &compress, &content_type, &ct_len, &body, &body_len))
+                        &compress, &content_type, &ct_len, &body))
     return nullptr;
   butil::IOBuf b;
-  if (body_len > 0) b.append(body, (size_t)body_len);
+  append_pybuffer(&b, &body);
   butil::IOBuf frame;
   brpc::PackRequestFrame(&frame, cid, attempt, service, (size_t)service_len,
                          method, (size_t)method_len, timeout_ms, compress,
@@ -154,14 +259,12 @@ PyObject* py_send_response(PyObject*, PyObject* args) {
   int error_code;
   const char *error_text, *content_type;
   Py_ssize_t et_len, ct_len;
-  const char* body;
-  Py_ssize_t body_len;
-  if (!PyArg_ParseTuple(args, "KKHis#s#y#", &sid, &cid, &attempt, &error_code,
-                        &error_text, &et_len, &content_type, &ct_len, &body,
-                        &body_len))
+  Py_buffer body;
+  if (!PyArg_ParseTuple(args, "KKHis#s#y*", &sid, &cid, &attempt, &error_code,
+                        &error_text, &et_len, &content_type, &ct_len, &body))
     return nullptr;
   butil::IOBuf b;
-  if (body_len > 0) b.append(body, (size_t)body_len);
+  append_pybuffer(&b, &body);
   butil::IOBuf frame;
   brpc::PackResponseFrame(&frame, cid, attempt, error_code, error_text,
                           (size_t)et_len, content_type, (size_t)ct_len,
@@ -231,4 +334,13 @@ PyModuleDef kModule = {PyModuleDef_HEAD_INIT, "_fastrpc",
 
 }  // namespace
 
-PyMODINIT_FUNC PyInit__fastrpc() { return PyModule_Create(&kModule); }
+PyMODINIT_FUNC PyInit__fastrpc() {
+  FastBodyType.tp_dealloc = fastbody_dealloc;
+  FastBodyType.tp_flags = Py_TPFLAGS_DEFAULT;
+  FastBodyType.tp_as_buffer = &fastbody_as_buffer;
+  FastBodyType.tp_as_sequence = &fastbody_as_sequence;
+  FastBodyType.tp_doc = "IOBuf-backed read-only buffer (zero-copy body)";
+  FastBodyType.tp_new = nullptr;  // only created from C
+  if (PyType_Ready(&FastBodyType) < 0) return nullptr;
+  return PyModule_Create(&kModule);
+}
