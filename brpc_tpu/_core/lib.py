@@ -91,6 +91,12 @@ _sigs = {
     "brpc_core_init": (None, [ctypes.c_int, ctypes.c_int]),
     "brpc_core_shutdown": (None, []),
     "brpc_set_min_log_level": (None, [ctypes.c_int]),
+    # native CPU profiler (butil/profiler.cc)
+    "brpc_prof_start": (ctypes.c_int, [ctypes.c_int]),
+    "brpc_prof_stop": (ctypes.c_int, []),
+    "brpc_prof_dump": (ctypes.c_int, [ctypes.c_char_p]),
+    "brpc_prof_folded": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_size_t]),
+    "brpc_prof_samples": (ctypes.c_int64, []),
     "brpc_iobuf_new": (ctypes.c_void_p, []),
     "brpc_iobuf_free": (None, [ctypes.c_void_p]),
     "brpc_iobuf_clear": (None, [ctypes.c_void_p]),

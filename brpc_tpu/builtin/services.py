@@ -159,8 +159,8 @@ def build_routes(server) -> dict:
     # /hotspots profilers (hotspots_service.cpp; §5.2) — on-demand, the
     # ?seconds= and ?fmt=collapsed knobs mirror the reference's query args
     def hotspots_index(req):
-        return ("profilers: /hotspots/cpu /hotspots/contention "
-                "/hotspots/heap /hotspots/growth\n"
+        return ("profilers: /hotspots/cpu /hotspots/native "
+                "/hotspots/contention /hotspots/heap /hotspots/growth\n"
                 "args: ?seconds=N (cpu/contention/growth), "
                 "?fmt=collapsed (flamegraph input)\n")
 
@@ -175,6 +175,13 @@ def build_routes(server) -> dict:
         from brpc_tpu.builtin import profiler
         return profiler.cpu_profile(_seconds(req),
                                     req.query.get("fmt", "text"))
+
+    def hotspots_native(req):
+        # native-thread sampler (dispatchers/executor/drainers);
+        # ?fmt=pprof returns the legacy pprof binary for pprof tooling
+        from brpc_tpu.builtin import profiler
+        return profiler.native_cpu_profile(_seconds(req),
+                                           req.query.get("fmt", "folded"))
 
     def hotspots_contention(req):
         from brpc_tpu.builtin import profiler
@@ -207,12 +214,14 @@ def build_routes(server) -> dict:
         "/ici": ici,
         "/hotspots": hotspots_index,
         "/hotspots/cpu": hotspots_cpu,
+        "/hotspots/native": hotspots_native,
         "/hotspots/contention": hotspots_contention,
         "/hotspots/heap": hotspots_heap,
         "/hotspots/growth": hotspots_growth,
         # remote-pprof style aliases (pprof_service.*): same data under the
         # /pprof prefix so generic tooling can scrape it
         "/pprof/profile": hotspots_cpu,
+        "/pprof/profile_native": hotspots_native,
         "/pprof/contention": hotspots_contention,
         "/pprof/heap": hotspots_heap,
         "/pprof/growth": hotspots_growth,

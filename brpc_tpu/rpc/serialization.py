@@ -53,6 +53,15 @@ class Serializer:
         raise NotImplementedError
 
 
+def as_bytes(x):
+    """THE zero-copy boundary rule, in one place: IOBuf-backed memoryviews
+    stay views through transport slicing (attachment split, decompress
+    pass-through) and materialize to bytes exactly here, where handler
+    code takes over and expects real bytes (.decode(), dict keys,
+    concatenation)."""
+    return bytes(x) if isinstance(x, memoryview) else x
+
+
 class RawSerializer(Serializer):
     name = "raw"
 
@@ -64,12 +73,9 @@ class RawSerializer(Serializer):
         raise TypeError(f"raw serializer needs bytes, got {type(obj)}")
 
     def decode(self, body, tensor_header):
-        # handlers own raw bodies as bytes (they concatenate, .decode(),
-        # hash them); an IOBuf-backed memoryview from the fast path is
-        # materialized HERE, at the last boundary — upstream slicing
-        # (attachment split, decompress passthrough) stayed zero-copy, and
-        # the tensor serializer consumes the view without any copy at all
-        return bytes(body) if isinstance(body, memoryview) else body
+        # the tensor serializer consumes views with NO copy; every
+        # bytes-contract serializer materializes via as_bytes
+        return as_bytes(body)
 
 
 class JsonSerializer(Serializer):
@@ -79,8 +85,7 @@ class JsonSerializer(Serializer):
         return json.dumps(obj, separators=(",", ":")).encode(), b""
 
     def decode(self, body, tensor_header):
-        if isinstance(body, memoryview):
-            body = bytes(body)
+        body = as_bytes(body)
         return json.loads(body) if body else None
 
 
@@ -96,11 +101,11 @@ class PbSerializer(Serializer):
         return obj.SerializeToString(), b""
 
     def decode(self, body, tensor_header):
+        body = as_bytes(body)
         if self.message_class is None:
-            return bytes(body) if isinstance(body, memoryview) else body
+            return body
         msg = self.message_class()
-        msg.ParseFromString(bytes(body) if isinstance(body, memoryview)
-                            else body)
+        msg.ParseFromString(body)
         return msg
 
 
@@ -196,7 +201,7 @@ class CompactSerializer(Serializer):
 
     def decode(self, body, tensor_header):
         from brpc_tpu.rpc.compact import loads
-        return loads(bytes(body) if isinstance(body, memoryview) else body)
+        return loads(as_bytes(body))
 
 
 for _s in (RawSerializer(), JsonSerializer(), PbSerializer(),

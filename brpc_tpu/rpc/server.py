@@ -490,9 +490,10 @@ class Server:
         from brpc_tpu import flags
         if flags.get_flag("rpc_dump"):
             from brpc_tpu.rpc.rpc_dump import RpcDumper
+            from brpc_tpu.rpc.serialization import as_bytes
             RpcDumper.instance().sample(
                 meta_bytes or meta.encode(),
-                bytes(body) if isinstance(body, (bytes, memoryview))
+                as_bytes(body) if isinstance(body, (bytes, memoryview))
                 else body.to_bytes())
         tag = self._service_tags.get(meta.service)
         pool = self._tag_pools.get(tag) if tag is not None else None

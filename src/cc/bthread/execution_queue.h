@@ -32,11 +32,21 @@ class ExecutionQueue {
     while (_inflight.load(std::memory_order_acquire) != 0) {
       std::this_thread::yield();
     }
+    // Consume (not just delete) leftovers: queued values may own
+    // resources (heap messages, IOBufs) that only the consumer releases.
     Node* head = _head.exchange(nullptr, std::memory_order_acquire);
-    while (head != nullptr) {
+    Node* prev = nullptr;
+    while (head != nullptr) {  // reverse to FIFO for a faithful last drain
       Node* next = head->next;
-      delete head;
+      head->next = prev;
+      prev = head;
       head = next;
+    }
+    while (prev != nullptr) {
+      _consume(prev->value);
+      Node* next = prev->next;
+      delete prev;
+      prev = next;
     }
   }
 
@@ -51,11 +61,20 @@ class ExecutionQueue {
     // and this exchange (and on the drainer's release+recheck) guarantees
     // that either we take the busy flag or the active drainer sees our node.
     if (!_busy.exchange(true, std::memory_order_seq_cst)) {
-      _inflight.fetch_add(1, std::memory_order_acq_rel);
-      _ex->submit([this] {
-        drain();
-        _inflight.fetch_sub(1, std::memory_order_acq_rel);
-      });
+      submit_drain();
+    }
+  }
+
+  // Deferred self-deletion for owners that may be destroying the queue
+  // from INSIDE one of its own callbacks (a delivered message dropping a
+  // socket's last reference): the active drainer — or a freshly submitted
+  // one — consumes every remaining value and then deletes the queue.  No
+  // thread ever blocks or spins waiting for the drain.  The caller must
+  // guarantee no further execute() calls.
+  void destroy() {
+    _delete_requested.store(true, std::memory_order_seq_cst);
+    if (!_busy.exchange(true, std::memory_order_seq_cst)) {
+      submit_drain();
     }
   }
 
@@ -65,18 +84,40 @@ class ExecutionQueue {
     Node* next;
   };
 
-  void drain() {
+  void submit_drain() {
+    _inflight.fetch_add(1, std::memory_order_acq_rel);
+    _ex->submit([](void* arg) {
+      auto* self = (ExecutionQueue*)arg;
+      if (self->drain()) return;  // deleted itself; no further touch
+      self->_inflight.fetch_sub(1, std::memory_order_acq_rel);
+    }, this);
+  }
+
+  // Returns true when the queue deleted itself (destroy() path).
+  bool drain() {
     while (true) {
       Node* head = _head.exchange(nullptr, std::memory_order_seq_cst);
       if (head == nullptr) {
+        if (_delete_requested.load(std::memory_order_acquire)) {
+          // producers are stopped (destroy contract); we own the busy
+          // flag, so nothing else touches the object: balance our
+          // submit_drain's inflight and go
+          _inflight.fetch_sub(1, std::memory_order_acq_rel);
+          delete this;
+          return true;
+        }
         _busy.store(false, std::memory_order_seq_cst);
-        // Recheck: a producer may have pushed between our exchange and the
-        // release; if so and nobody claimed the flag, keep draining.
-        if (_head.load(std::memory_order_seq_cst) != nullptr &&
+        // Recheck BOTH conditions: a producer may have pushed — or
+        // destroy() may have been called — between our exchange and the
+        // release.  seq_cst on the store/loads guarantees that either we
+        // observe the destroy flag here or destroy()'s busy-exchange
+        // succeeds and submits its own final drain.
+        if ((_head.load(std::memory_order_seq_cst) != nullptr ||
+             _delete_requested.load(std::memory_order_seq_cst)) &&
             !_busy.exchange(true, std::memory_order_seq_cst)) {
           continue;
         }
-        return;
+        return false;
       }
       // Reverse to FIFO.
       Node* prev = nullptr;
@@ -100,6 +141,7 @@ class ExecutionQueue {
   std::atomic<Node*> _head{nullptr};
   std::atomic<bool> _busy{false};
   std::atomic<int> _inflight{0};
+  std::atomic<bool> _delete_requested{false};
 };
 
 }  // namespace bthread

@@ -55,6 +55,14 @@ typedef void (*LogSinkFn)(int level, const char* msg, void* arg);
 
 void set_log_sink(LogSinkFn fn, void* arg);
 void set_min_log_level(int level);
+
+// Native CPU profiler (butil/profiler.cc): SIGPROF sampling, legacy
+// pprof binary dump + folded-stacks text.
+int prof_start(int hz);
+int prof_stop();                 // returns samples collected, -1 if idle
+int prof_dump(const char* path); // legacy pprof format + /proc/self/maps
+int prof_folded(char* out, unsigned long cap);
+long long prof_sample_count();
 int min_log_level();
 void log_message(int level, const char* fmt, ...) __attribute__((format(printf, 2, 3)));
 

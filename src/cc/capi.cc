@@ -35,6 +35,15 @@ void brpc_core_shutdown() {
 void brpc_set_log_sink(butil::LogSinkFn fn, void* arg) { butil::set_log_sink(fn, arg); }
 void brpc_set_min_log_level(int level) { butil::set_min_log_level(level); }
 
+// ---- native CPU profiler (/hotspots native view; butil/profiler.cc) ----
+int brpc_prof_start(int hz) { return butil::prof_start(hz); }
+int brpc_prof_stop() { return butil::prof_stop(); }
+int brpc_prof_dump(const char* path) { return butil::prof_dump(path); }
+int brpc_prof_folded(char* out, size_t cap) {
+  return butil::prof_folded(out, cap);
+}
+int64_t brpc_prof_samples() { return butil::prof_sample_count(); }
+
 // ---- IOBuf ----
 
 void* brpc_iobuf_new() { return new IOBuf(); }

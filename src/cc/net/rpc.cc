@@ -403,6 +403,11 @@ bool TryDispatchTrpc(SocketId sid, const SocketOptions& opts, const char* meta,
     auto* p = new PendingFastRequest{sid, std::string(meta, meta_len),
                                      new butil::IOBuf(std::move(*body)), cb,
                                      g_request_user.load()};
+    // one executor task per message (the "one bthread per message" rule,
+    // input_messenger.cpp:175-213): a blocking handler must not
+    // head-of-line-block other requests.  (A serialized global lane was
+    // tried and reverted: one sleeping handler delayed every other
+    // Python upcall in the process, starving backup requests.)
     bthread::Executor::global()->submit(run_fast_request_task, p);
     return true;
   }

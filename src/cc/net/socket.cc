@@ -10,6 +10,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include "bthread/execution_queue.h"
 #include "bthread/executor.h"
 #include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
@@ -37,6 +38,9 @@ void Socket::GlobalTraffic(int64_t* nread, int64_t* nwritten, int64_t* nmsg) {
 // Per-socket unwritten-byte cap (reference FLAGS_socket_max_unwritten_bytes;
 // EOVERCROWDED backpressure, socket.h:326-380).
 static std::atomic<int64_t> g_overcrowded_limit{64 << 20};
+// errno surfaced to on_failed when a backlog bound closes the socket
+// (errors.py EOVERCROWDED).
+constexpr int EOVERCROWDED_ERRNO = 1011;
 
 int64_t Socket::active_count() { return g_active_sockets.load(std::memory_order_relaxed); }
 
@@ -75,6 +79,8 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->_write_busy.store(false, std::memory_order_relaxed);
   s->_waiting_epollout.store(false, std::memory_order_relaxed);
   s->_pending_write.store(0, std::memory_order_relaxed);
+  s->_fifo_q.store(nullptr, std::memory_order_relaxed);  // detached in cleanup
+  s->_fifo_pending_bytes.store(0, std::memory_order_relaxed);
   s->_nread.store(0, std::memory_order_relaxed);
   s->_nwritten.store(0, std::memory_order_relaxed);
   s->_nmsg.store(0, std::memory_order_relaxed);
@@ -137,7 +143,32 @@ int Socket::SetFailed(SocketId id, int error_code) {
     s->_error_code = error_code;
     if (s->_fd >= 0) EventDispatcher::GetDispatcher(s->_fd)->RemoveConsumer(s->_fd);
     if (s->_opts.on_failed != nullptr) {
-      s->_opts.on_failed(id, error_code, s->_opts.user);
+      auto* q = s->_fifo_q.load(std::memory_order_acquire);
+      if (q != nullptr) {
+        // The failure notification must be delivered AFTER messages
+        // already queued on the FIFO lane: a server that replies and
+        // closes must not make the client see EFAILEDSOCKET before the
+        // reply it already received (inline delivery used to give this
+        // ordering for free).  We still hold the Address reference, so
+        // cleanup's destroy() cannot have run: execute() is safe.
+        struct FailNote {
+          SocketFailedCallback cb;
+          SocketId id;
+          int err;
+          void* user;
+        };
+        auto* note = new FailNote{s->_opts.on_failed, id, error_code,
+                                  s->_opts.user};
+        q->execute(bthread::TaskNode{
+            [](void* arg) {
+              auto* n = (FailNote*)arg;
+              n->cb(n->id, n->err, n->user);
+              delete n;
+            },
+            note});
+      } else {
+        s->_opts.on_failed(id, error_code, s->_opts.user);
+      }
     }
     s->Dereference();  // drop the registration ref
   }
@@ -167,6 +198,14 @@ void Socket::Dereference() {
   }
   _out_buf.clear();
   _read_buf.clear();
+  auto* q = _fifo_q.exchange(nullptr, std::memory_order_acq_rel);
+  if (q != nullptr) {
+    // destroy(): the (possibly currently-running) drainer consumes every
+    // leftover message, then the queue deletes itself — no blocking, no
+    // spinning, safe even when this Dereference is running INSIDE one of
+    // the queue's own callbacks.
+    q->destroy();
+  }
   g_active_sockets.fetch_sub(1, std::memory_order_relaxed);
   const uint32_t slot = (uint32_t)_id;
   _vref.store((uint64_t)(ver + 1) << 32, std::memory_order_release);
@@ -356,10 +395,20 @@ struct PendingMessage {
   butil::IOBuf* body;
   MessageCallback cb;
   void* user;
+  Socket* fifo_owner = nullptr;   // non-null: FIFO lane accounting
+  int64_t fifo_bytes = 0;
 };
 
 static void run_message_task(void* arg) {
   auto* m = (PendingMessage*)arg;
+  if (m->fifo_owner != nullptr) {
+    // release backlog credit BEFORE the callback: the callback's work is
+    // the consumer's cost, not queued bytes.  The owner Socket's storage
+    // is pool-backed (never freed), so touching the counter is safe even
+    // if the socket was recycled — worst case a recycled slot's counter
+    // wobbles transiently, and Create re-zeroes it.
+    m->fifo_owner->fifo_release(m->fifo_bytes);
+  }
   m->cb(m->sid, m->kind, m->meta.data(), m->meta.size(), m->body, m->user);
   delete m;  // callback owns *body (freed via C ABI)
 }
@@ -423,13 +472,41 @@ void Socket::DispatchMessages() {
     }
     if (kind_requires_fifo(msg.kind)) {
       // RESP/memcache pipelining, h2 HPACK + stream state, thrift/mongo
-      // reply order and raw streaming all make per-connection FIFO part of
-      // the protocol contract.  Deliver inline on the dispatcher thread
-      // (sequential per fd) instead of fanning out to the work-stealing
-      // executor, which would reorder messages.
-      auto* body = new butil::IOBuf(std::move(msg.body));
-      _opts.on_message(_id, msg.kind, msg.meta.data(), msg.meta.size(), body,
-                       _opts.user);
+      // reply order and raw streaming all make per-connection FIFO part
+      // of the protocol contract.  Deliver through this socket's
+      // ExecutionQueue: order is preserved (serialized drain) but the
+      // GIL-bound Python callback runs on an executor worker, not the
+      // dispatcher thread — one slow connection can no longer stall the
+      // whole event loop (the reference's per-stream ExecutionQueue,
+      // stream_impl.h:133, in the socket's FIFO slot).
+      auto* q = _fifo_q.load(std::memory_order_acquire);
+      if (q == nullptr) {  // creation is dispatcher-thread only: no race
+        q = new bthread::ExecutionQueue<bthread::TaskNode>(
+            bthread::Executor::global(),
+            [](bthread::TaskNode& t) { t.fn(t.arg); });
+        _fifo_q.store(q, std::memory_order_release);
+      }
+      // read-side EOVERCROWDED: inline delivery used to throttle reads
+      // naturally; a queued lane needs an explicit bound or a fast peer
+      // with a slow consumer grows memory without limit (same limit as
+      // the write side)
+      const int64_t limit = g_overcrowded_limit.load(std::memory_order_relaxed);
+      const int64_t msg_bytes =
+          (int64_t)(msg.meta.size() + msg.body.size() + 256);
+      if (limit > 0 &&
+          _fifo_pending_bytes.load(std::memory_order_relaxed) + msg_bytes >
+              limit) {
+        BLOG(WARNING, "socket %llu FIFO backlog over %lld bytes, closing",
+             (unsigned long long)_id, (long long)limit);
+        SetFailed(_id, EOVERCROWDED_ERRNO);
+        return;
+      }
+      _fifo_pending_bytes.fetch_add(msg_bytes, std::memory_order_relaxed);
+      auto* pm = new PendingMessage{_id, msg.kind, std::move(msg.meta),
+                                    new butil::IOBuf(std::move(msg.body)),
+                                    _opts.on_message, _opts.user,
+                                    this, msg_bytes};
+      q->execute(bthread::TaskNode{run_message_task, pm});
       continue;
     }
     auto* pm = new PendingMessage{_id, msg.kind, std::move(msg.meta),

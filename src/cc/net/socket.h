@@ -20,6 +20,7 @@
 #include <mutex>
 #include <vector>
 
+#include "bthread/execution_queue.h"
 #include "butil/common.h"
 #include "butil/iobuf.h"
 #include "butil/resource_pool.h"
@@ -134,6 +135,11 @@ class Socket {
   void OnReadable();
   void OnWritable();
 
+  // FIFO-lane backlog credit return (run_message_task).
+  void fifo_release(int64_t n) {
+    _fifo_pending_bytes.fetch_sub(n, std::memory_order_relaxed);
+  }
+
   Socket() = default;
 
  private:
@@ -165,6 +171,19 @@ class Socket {
   butil::IOPortal _read_buf;
   ParseState _parse;
   std::atomic<int> _forced_protocol{-1};
+  // FIFO-protocol delivery lane (redis/h2/thrift/streams): an
+  // ExecutionQueue per socket preserves per-connection order while
+  // moving Python-bound callbacks OFF the dispatcher thread — the
+  // reference's per-stream ExecutionQueue slot (stream_impl.h:133).
+  // Created lazily by the dispatcher thread; torn down via the queue's
+  // destroy() protocol (the drainer consumes leftovers then deletes
+  // itself) so a callback that drops the socket's last reference can't
+  // deadlock or spin on its own drain.  Atomic: SetFailed (any thread)
+  // routes the failure notification through it to stay ordered AFTER
+  // already-queued messages.
+  std::atomic<bthread::ExecutionQueue<bthread::TaskNode>*> _fifo_q{nullptr};
+  // FIFO backlog accounting for the EOVERCROWDED read-side bound.
+  std::atomic<int64_t> _fifo_pending_bytes{0};
 
   std::atomic<int64_t> _nread{0}, _nwritten{0}, _nmsg{0};
   char _remote_ip[46] = {0};

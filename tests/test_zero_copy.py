@@ -54,19 +54,21 @@ class TestZeroCopyBoundary:
         assert ch.call_sync("Zc", "Echo", b"hello") == b"hello"
         assert svc.seen_types and all(t is bytes for t in svc.seen_types)
 
-    def test_large_send_accepts_memoryview(self, server):
-        """Send side takes any buffer; >=4KB payloads ride as pinned user
-        blocks (append_user_data) instead of being copied into blocks."""
+    def test_large_send_pins_readonly_buffer(self, server):
+        """Send side takes any buffer; READ-ONLY payloads >=4KB ride as
+        pinned user blocks (append_user_data) instead of being copied —
+        a memoryview over bytes is readonly and takes the pin path."""
         srv, svc = server
         ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10_000)
-        payload = bytearray(b"z" * (256 * 1024))
+        payload = b"z" * (256 * 1024)
         out = ch.call_sync("Zc", "Echo", memoryview(payload))
-        assert out == bytes(payload)
+        assert out == payload
 
-    def test_large_send_buffer_not_released_early(self, server):
-        """The pinned send buffer must stay valid until written: mutate
-        the source AFTER the call returns and confirm a second call sees
-        the new contents (no aliasing surprises, no crash)."""
+    def test_writable_buffer_copied_not_pinned(self, server):
+        """WRITABLE exporters (bytearray/numpy) must be copied, never
+        pinned: mutating the source right after the call returns must not
+        corrupt a queued frame.  (memoryview(bytearray) has readonly=0,
+        so this exercises the copy branch of append_pybuffer.)"""
         srv, svc = server
         ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=10_000)
         payload = bytearray(b"a" * 8192)
@@ -84,7 +86,8 @@ class TestZeroCopyBoundary:
         assert bytes(cntl.response_attachment) == b"ATT" * 100
 
     def test_concurrent_large_echoes(self, server):
-        """Many pinned buffers in flight at once: the user-block deleter
+        """Many pinned buffers in flight at once (bytes bodies are
+        readonly, so these take the pin path): the user-block deleter
         (GIL reacquisition from the writer thread) must be re-entrant."""
         srv, svc = server
         ch = Channel(f"127.0.0.1:{srv.port}", timeout_ms=30_000)
