@@ -101,8 +101,11 @@ class SocketMap:
 
     def _connect(self, ep: EndPoint) -> _ClientConn:
         mgr = CallManager.instance()
+        # unix-scheme endpoints carry the path in .host; the native layer
+        # selects AF_UNIX on the "unix:" prefix (butil/unix_socket role)
+        host = f"unix:{ep.host}" if ep.scheme == "unix" else ep.host
         sid = Transport.instance().connect_rpc(
-            ep.host, ep.port, mgr.on_message, self._on_socket_failed,
+            host, ep.port, mgr.on_message, self._on_socket_failed,
             on_response=mgr.on_fast_response)
         with self._lock:
             self._sid_to_ep[sid] = ep

@@ -1,0 +1,223 @@
+"""TLS for the RPC stack — in-process termination/initiation proxies.
+
+Reference: brpc::Socket carries OpenSSL state inline (socket.h SSL
+members; ServerOptions.ssl_options, ChannelOptions has_ssl) — ciphertext
+and plaintext share one fd.  This build's native core has no OpenSSL (no
+C headers in the image), so TLS rides Python's ssl module in the
+termination-proxy shape every production mesh already uses (envoy/
+stunnel): a TLS listener decrypts and pumps plaintext over a loopback
+connection into the native listener, and a client-side initiator does the
+reverse.  The native hot path (parse, dispatch, wait-free writes) is
+unchanged; TLS costs one local hop, which is the honest price of
+userspace TLS without native bindings.
+
+    server:  Server(...).start(...); TlsTerminator(server, cert, key)
+    client:  ch = Channel(tls_channel_address(host, port, cafile=...))
+
+tls_channel_address starts (and caches) a TlsInitiator for the upstream
+and returns the local plaintext address a normal Channel can dial.
+"""
+from __future__ import annotations
+
+import selectors
+import socket
+import ssl
+import threading
+from typing import Optional
+
+from brpc_tpu.bvar import Adder
+
+_tls_conns = Adder("rpc_tls_connections")
+_tls_bytes_in = Adder("rpc_tls_bytes_in")
+_tls_bytes_out = Adder("rpc_tls_bytes_out")
+
+
+class _Pump(threading.Thread):
+    """Bidirectional byte pump between two sockets (one per direction
+    pair; blocking IO with small buffers — TLS connections are the slow
+    path by construction here)."""
+
+    def __init__(self, a: socket.socket, b: socket.socket, counter: Adder):
+        super().__init__(daemon=True)
+        self._a = a
+        self._b = b
+        self._counter = counter
+
+    def run(self):
+        try:
+            while True:
+                data = self._a.recv(65536)
+                if not data:
+                    # half-close: propagate only SHUT_WR so the opposite
+                    # pump can still drain an in-flight response
+                    try:
+                        self._b.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+                    return
+                self._counter.add(len(data))
+                self._b.sendall(data)
+        except OSError:
+            # hard error: tear down both directions
+            for s in (self._a, self._b):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+
+class TlsTerminator:
+    """Server side: TLS listener that forwards plaintext to the native
+    RPC listener.  All protocols multiplexed on the native port work over
+    TLS unchanged (TRPC, HTTP console, redis, ...)."""
+
+    def __init__(self, server, certfile: str, keyfile: str,
+                 address: str = "0.0.0.0", port: int = 0,
+                 require_client_cert: bool = False,
+                 cafile: Optional[str] = None):
+        if not server.port:
+            # UDS-started servers have no port (bound_port=0); terminate
+            # TLS in front of a TCP listener, or add UDS backend support
+            # explicitly — silently dialing port 0 would drop every
+            # connection
+            raise ValueError(
+                "TlsTerminator needs a TCP-started server (server.port is "
+                "0 — unix-socket servers are not a dialable TCP backend)")
+        self._server = server   # port re-read per connection: restart-safe
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile, keyfile)
+        if require_client_cert:
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            if cafile:
+                ctx.load_verify_locations(cafile)
+        self._ctx = ctx
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((address, port))
+        self._lsock.listen(128)
+        self.port = self._lsock.getsockname()[1]
+        self._stopping = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"tls-terminator-{self.port}")
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                raw, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(raw,),
+                             daemon=True).start()
+
+    def _handle(self, raw: socket.socket):
+        try:
+            tls = self._ctx.wrap_socket(raw, server_side=True)
+        except (ssl.SSLError, OSError):
+            raw.close()
+            return
+        try:
+            plain = socket.create_connection(
+                ("127.0.0.1", self._server.port), timeout=10)
+        except OSError:
+            tls.close()
+            return
+        # the connect timeout must not linger: a pumped connection idle
+        # >10s would otherwise die with TimeoutError in the pump
+        plain.settimeout(None)
+        tls.settimeout(None)
+        _tls_conns.add(1)
+        _Pump(tls, plain, _tls_bytes_in).start()
+        _Pump(plain, tls, _tls_bytes_out).start()
+
+    def stop(self):
+        self._stopping.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+class TlsInitiator:
+    """Client side: local plaintext listener that dials the remote over
+    TLS — a normal Channel connects to `local_port` and its bytes ride
+    the encrypted upstream (ChannelOptions ssl in the reference)."""
+
+    def __init__(self, host: str, port: int, cafile: Optional[str] = None,
+                 verify: bool = True,
+                 certfile: Optional[str] = None,
+                 keyfile: Optional[str] = None):
+        self._upstream = (host, port)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        if cafile:
+            ctx.load_verify_locations(cafile)
+        if not verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if certfile:
+            ctx.load_cert_chain(certfile, keyfile)
+        self._ctx = ctx
+        self._host = host
+        self._lsock = socket.socket()
+        self._lsock.bind(("127.0.0.1", 0))
+        self._lsock.listen(64)
+        self.local_port = self._lsock.getsockname()[1]
+        self._stopping = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"tls-initiator-{self.local_port}").start()
+
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                plain, _ = self._lsock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(plain,),
+                             daemon=True).start()
+
+    def _handle(self, plain: socket.socket):
+        try:
+            raw = socket.create_connection(self._upstream, timeout=10)
+            tls = self._ctx.wrap_socket(raw, server_hostname=self._host)
+        except (ssl.SSLError, OSError):
+            plain.close()
+            return
+        tls.settimeout(None)     # see TlsTerminator._handle
+        plain.settimeout(None)
+        _tls_conns.add(1)
+        _Pump(plain, tls, _tls_bytes_out).start()
+        _Pump(tls, plain, _tls_bytes_in).start()
+
+    def stop(self):
+        self._stopping.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+_initiators: dict = {}
+_initiators_mu = threading.Lock()
+
+
+def tls_channel_address(host: str, port: int, cafile: Optional[str] = None,
+                        verify: bool = True,
+                        certfile: Optional[str] = None,
+                        keyfile: Optional[str] = None) -> str:
+    """Address a Channel can dial to reach host:port over TLS.  One
+    initiator per upstream is cached process-wide (like the SocketMap)."""
+    key = (host, port, cafile, verify, certfile, keyfile)
+    with _initiators_mu:
+        init = _initiators.get(key)
+        if init is None:
+            init = TlsInitiator(host, port, cafile=cafile, verify=verify,
+                                certfile=certfile, keyfile=keyfile)
+            _initiators[key] = init
+        return f"127.0.0.1:{init.local_port}"
+
+
+def tls_stats() -> dict:
+    return {"connections": _tls_conns.get_value(),
+            "bytes_in": _tls_bytes_in.get_value(),
+            "bytes_out": _tls_bytes_out.get_value()}

@@ -2,10 +2,14 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <sys/un.h>
 #include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <stddef.h>
+#include <string.h>
+#include <sys/stat.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -546,7 +550,67 @@ void Socket::DoAcceptLoop() {
 
 // ---- connect / listen ----
 
+// "unix:/path" addresses select AF_UNIX (reference butil/unix_socket.*;
+// EndPoint UDS support, SURVEY §2.1) — same Socket machinery, different
+// address family.
+static socklen_t fill_sockaddr_un(const char* path, sockaddr_un* sa) {
+  memset(sa, 0, sizeof(*sa));
+  sa->sun_family = AF_UNIX;
+  const size_t n = strlen(path);
+  if (n >= sizeof(sa->sun_path)) return 0;  // overlong path
+  memcpy(sa->sun_path, path, n);
+  return (socklen_t)(offsetof(sockaddr_un, sun_path) + n + 1);
+}
+
+static int connect_unix(const char* path, const SocketOptions& opts,
+                        SocketId* id) {
+  sockaddr_un sa;
+  const socklen_t len = fill_sockaddr_un(path, &sa);
+  if (len == 0) return -1;
+  const int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  if (connect(fd, (sockaddr*)&sa, len) != 0) {
+    close(fd);
+    return -1;
+  }
+  SocketOptions o = opts;
+  o.fd = fd;
+  return Socket::Create(o, id);
+}
+
+static int listen_unix(const char* path, const SocketOptions& opts,
+                       SocketId* id, int* bound_port) {
+  sockaddr_un sa;
+  const socklen_t len = fill_sockaddr_un(path, &sa);
+  if (len == 0) return -1;
+  // Remove ONLY a stale socket file: unlinking whatever happens to live
+  // at a typo'd path (a regular file, a directory) would destroy user
+  // data before bind even fails.
+  struct stat st;
+  if (lstat(path, &st) == 0) {
+    if (!S_ISSOCK(st.st_mode)) {
+      errno = EEXIST;
+      return -1;
+    }
+    unlink(path);
+  }
+  const int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  if (bind(fd, (sockaddr*)&sa, len) != 0 || listen(fd, 1024) != 0) {
+    close(fd);
+    return -1;
+  }
+  if (bound_port != nullptr) *bound_port = 0;  // no port space on UDS
+  SocketOptions o = opts;
+  o.fd = fd;
+  o.is_listener = true;
+  return Socket::Create(o, id);
+}
+
 int Connect(const char* host, int port, const SocketOptions& opts, SocketId* id) {
+  if (strncmp(host, "unix:", 5) == 0) {
+    return connect_unix(host + 5, opts, id);
+  }
   addrinfo hints = {};
   hints.ai_family = AF_UNSPEC;
   hints.ai_socktype = SOCK_STREAM;
@@ -573,6 +637,9 @@ int Connect(const char* host, int port, const SocketOptions& opts, SocketId* id)
 
 int Listen(const char* addr, int port, const SocketOptions& opts, SocketId* id,
            int* bound_port) {
+  if (addr != nullptr && strncmp(addr, "unix:", 5) == 0) {
+    return listen_unix(addr + 5, opts, id, bound_port);
+  }
   const int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (fd < 0) return -1;
   const int one = 1;

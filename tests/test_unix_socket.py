@@ -1,0 +1,77 @@
+"""Served unix-domain sockets (reference butil/unix_socket.*; the r2
+coverage table's 'unix: can't be served' gap): the native core listens
+and connects over AF_UNIX behind the same Socket machinery, and the whole
+RPC stack — channel, server, fast path — runs over it unchanged.
+"""
+import os
+
+import pytest
+
+import brpc_tpu as brpc
+
+
+class Echo(brpc.Service):
+    NAME = "UEcho"
+
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return req
+
+    @brpc.method(request="json", response="json")
+    def Add(self, cntl, req):
+        return {"sum": req["a"] + req["b"]}
+
+
+@pytest.fixture()
+def uds_server(tmp_path):
+    path = str(tmp_path / "brpc.sock")
+    srv = brpc.Server()
+    srv.add_service(Echo())
+    srv.start(f"unix:{path}", 0)
+    yield srv, path
+    srv.stop()
+    srv.join()
+
+
+class TestUnixSocketServing:
+    def test_rpc_over_uds(self, uds_server):
+        srv, path = uds_server
+        assert os.path.exists(path)          # socket file bound
+        ch = brpc.Channel(f"unix:{path}", timeout_ms=10_000)
+        assert ch.call_sync("UEcho", "Echo", b"over-uds") == b"over-uds"
+        out = ch.call_sync("UEcho", "Add", {"a": 2, "b": 40},
+                           serializer="json", response_serializer="json")
+        assert out == {"sum": 42}
+
+    def test_many_calls_and_large_body(self, uds_server):
+        srv, path = uds_server
+        ch = brpc.Channel(f"unix:{path}", timeout_ms=10_000)
+        big = b"u" * 200_000
+        for _ in range(50):
+            assert ch.call_sync("UEcho", "Echo", big) == big
+
+    def test_stale_socket_file_rebind(self, tmp_path):
+        """A leftover socket file from a dead process must not block a
+        new server (the native listener unlinks before bind)."""
+        path = str(tmp_path / "stale.sock")
+        s1 = brpc.Server()
+        s1.add_service(Echo())
+        s1.start(f"unix:{path}", 0)
+        s1.stop()
+        s1.join()
+        s2 = brpc.Server()
+        s2.add_service(Echo())
+        s2.start(f"unix:{path}", 0)
+        try:
+            ch = brpc.Channel(f"unix:{path}", timeout_ms=10_000)
+            assert ch.call_sync("UEcho", "Echo", b"x") == b"x"
+        finally:
+            s2.stop()
+            s2.join()
+
+    def test_connect_missing_path_fails(self, tmp_path):
+        from brpc_tpu import errors
+        ch = brpc.Channel(f"unix:{tmp_path}/nope.sock", timeout_ms=500,
+                          max_retry=0)
+        with pytest.raises(errors.RpcError):
+            ch.call_sync("UEcho", "Echo", b"x")
