@@ -91,6 +91,8 @@ _sigs = {
     "brpc_core_init": (None, [ctypes.c_int, ctypes.c_int]),
     "brpc_core_shutdown": (None, []),
     "brpc_set_min_log_level": (None, [ctypes.c_int]),
+    "brpc_crc32c": (ctypes.c_uint32, [ctypes.c_char_p, ctypes.c_size_t,
+                                      ctypes.c_uint32]),
     # native CPU profiler (butil/profiler.cc)
     "brpc_prof_start": (ctypes.c_int, [ctypes.c_int]),
     "brpc_prof_stop": (ctypes.c_int, []),

@@ -190,17 +190,26 @@ class ConsistentHashLB(LoadBalancer):
             self._ring = ring
             self._ring_keys = [k for k, _ in ring]
 
+    @staticmethod
+    def _code_bytes(code) -> bytes:
+        # mask into u64: hash()-derived codes are frequently negative and
+        # to_bytes(signed=False) would raise OverflowError
+        return (int(code) & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+
+    def _map_code(self, request_code) -> int:
+        code = request_code if request_code is not None \
+            else random.getrandbits(63)
+        # hash the request code onto the ring (raw codes would all land at
+        # one end of the 64-bit key space)
+        return self._hash(self._code_bytes(code))
+
     def select_server(self, exclude=None, request_code=None):
         from brpc_tpu.policy.health_check import is_broken
         with self._mu:
             ring, keys = self._ring, self._ring_keys
         if not ring:
             return None
-        code = request_code if request_code is not None \
-            else random.getrandbits(63)
-        # hash the request code onto the ring (raw codes would all land at
-        # one end of the 64-bit key space)
-        h = self._hash(int(code).to_bytes(8, "little", signed=False))
+        h = self._map_code(request_code)
         i = bisect.bisect_left(keys, h) % len(ring)
         # walk the ring past excluded/broken nodes
         for step in range(len(ring)):
@@ -216,6 +225,42 @@ class ConsistentHashMd5LB(ConsistentHashLB):
     def __init__(self):
         super().__init__(hash_fn=lambda d: int.from_bytes(
             hashlib.md5(d).digest()[:8], "little"))
+
+
+class KetamaLB(ConsistentHashLB):
+    """libketama-compatible ring (reference c_ketama,
+    policy/consistent_hashing_load_balancer.cpp KetamaReplicaPolicy):
+    per virtual-node GROUP one md5 of "host:port-<g>" yields FOUR ring
+    points (digest split into 4 little-endian u32s), 40 groups => 160
+    points per unit weight — the memcached client ecosystem's exact
+    placement, so a ketama client and this LB agree on key ownership."""
+
+    name = "c_ketama"
+    GROUPS = 40   # x4 points/group = 160 points per weight unit
+
+    def _on_servers_changed(self):
+        ring = []
+        for n in self._servers.read():
+            base = str(n.endpoint)
+            for g in range(self.GROUPS * max(1, n.weight)):
+                digest = hashlib.md5(f"{base}-{g}".encode()).digest()
+                for part in range(4):
+                    point = int.from_bytes(
+                        digest[part * 4:part * 4 + 4], "little")
+                    ring.append((point, n.endpoint))
+        ring.sort()
+        with self._mu:
+            self._ring = ring
+            self._ring_keys = [k for k, _ in ring]
+
+    def _map_code(self, request_code) -> int:
+        if request_code is None:
+            return random.getrandbits(32)
+        # ketama hashes the KEY with md5 and takes the first 4 bytes —
+        # request_code is already the caller's key hash, so map it into
+        # the u32 ring space the same way
+        digest = hashlib.md5(self._code_bytes(request_code)).digest()
+        return int.from_bytes(digest[:4], "little")
 
 
 class LocalityAwareLB(LoadBalancer):
@@ -263,7 +308,7 @@ class LocalityAwareLB(LoadBalancer):
 
 _LBS = {cls.name: cls for cls in
         (RoundRobinLB, RandomLB, WeightedRoundRobinLB, WeightedRandomLB,
-         ConsistentHashLB, ConsistentHashMd5LB, LocalityAwareLB)}
+         ConsistentHashLB, ConsistentHashMd5LB, KetamaLB, LocalityAwareLB)}
 
 
 def create_load_balancer(name: str) -> LoadBalancer:

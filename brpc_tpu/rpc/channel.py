@@ -360,7 +360,8 @@ class CallManager:
             cntl.response_attachment = bytes(raw[len(raw) - att_size:]) \
                 if att_size else b""
             payload = decompress(payload, meta.compress_type)
-            serializer = get_serializer(meta.content_type or "raw")
+            serializer = getattr(cntl, "_response_serializer", None) or \
+                get_serializer(meta.content_type or "raw")
             cntl.reset_for_retry()
             cntl.response = serializer.decode(payload, meta.tensor_header)
             if meta.stream_id and cntl._stream is not None:
@@ -489,7 +490,9 @@ class Channel:
             # exact exclusion of every tried server (the ExcludedServers
             # role, excluded_servers.h; a plain set — no capacity bound —
             # so high-retry calls never revisit a failed replica)
-            return self._lb.select_server(exclude=set(st.tried_servers))
+            return self._lb.select_server(
+                exclude=set(st.tried_servers),
+                request_code=st.cntl.request_code)
         return self._endpoint
 
     def _on_call_end(self, st: _CallState) -> None:
@@ -580,9 +583,14 @@ class Channel:
             content_type=ser.name,
             tensor_header=tensor_header,
         )
-        # response serializer hint rides as a user field
+        # the client-side response serializer: typed instances (e.g. a
+        # PbSerializer bound to a generated message class) must decode the
+        # response locally — the wire's content_type can only name the
+        # generic codec.  Deliberately NOT a user field: nothing consumes
+        # it on the wire, and any user field disqualifies the call from
+        # the native fast-send path.
         if response_serializer:
-            meta.user_fields["rs"] = response_serializer
+            cntl._response_serializer = get_serializer(response_serializer)
         # credential is generated per ATTEMPT in _issue (replay-tracking
         # authenticators reject reused nonces), not here
         if cntl.request_attachment:

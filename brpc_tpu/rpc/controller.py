@@ -28,6 +28,9 @@ class Controller:
         self.backup_request_ms = backup_request_ms
         self.compress_type = compress_type
         self.request_attachment: bytes = b""
+        # consistent-hashing affinity key (reference
+        # Controller::set_request_code): c_* balancers route by it
+        self.request_code: Optional[int] = None
 
         # ---- result state ----
         self.error_code: int = 0

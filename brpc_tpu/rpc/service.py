@@ -63,6 +63,17 @@ class Service:
         out = {}
         for name, member in inspect.getmembers(self, callable):
             spec = getattr(member, "__rpc_spec__", None)
+            if spec is None:
+                # an UNdecorated override still implements the rpc when a
+                # base class declared it (@method in the generated Base,
+                # plain `def Add(...)` in the subclass — the protoc
+                # codegen pattern): inherit the base's spec, bind the
+                # subclass's implementation
+                for klass in type(self).__mro__[1:]:
+                    base_fn = klass.__dict__.get(name)
+                    spec = getattr(base_fn, "__rpc_spec__", None)
+                    if spec is not None:
+                        break
             if spec is not None:
                 out[spec.name] = MethodSpec(spec.name, member,
                                             spec.request_serializer,

@@ -56,6 +56,11 @@ typedef void (*LogSinkFn)(int level, const char* msg, void* arg);
 void set_log_sink(LogSinkFn fn, void* arg);
 void set_min_log_level(int level);
 
+// crc32c (Castagnoli; butil/crc32c.cc) — chained: pass the previous
+// call's result as init_crc to checksum split buffers.
+unsigned int crc32c(const void* data, unsigned long n,
+                    unsigned int init_crc = 0);
+
 // Native CPU profiler (butil/profiler.cc): SIGPROF sampling, legacy
 // pprof binary dump + folded-stacks text.
 int prof_start(int hz);

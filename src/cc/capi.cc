@@ -35,6 +35,10 @@ void brpc_core_shutdown() {
 void brpc_set_log_sink(butil::LogSinkFn fn, void* arg) { butil::set_log_sink(fn, arg); }
 void brpc_set_min_log_level(int level) { butil::set_min_log_level(level); }
 
+uint32_t brpc_crc32c(const void* data, size_t n, uint32_t init_crc) {
+  return butil::crc32c(data, n, init_crc);
+}
+
 // ---- native CPU profiler (/hotspots native view; butil/profiler.cc) ----
 int brpc_prof_start(int hz) { return butil::prof_start(hz); }
 int brpc_prof_stop() { return butil::prof_stop(); }

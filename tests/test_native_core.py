@@ -118,3 +118,20 @@ class TestTimer:
         assert done.wait(5)
         time.sleep(0.05)
         assert fired == [1, 2]
+
+
+class TestCrc32c:
+    """crc32c vectors (reference butil/crc32c.cc role; RFC 3720 + the
+    canonical '123456789' check value)."""
+
+    def test_vectors(self):
+        assert core.brpc_crc32c(b"\x00" * 32, 32, 0) == 0x8A9136AA
+        assert core.brpc_crc32c(b"123456789", 9, 0) == 0xE3069283
+        assert core.brpc_crc32c(b"", 0, 0) == 0
+
+    def test_chaining(self):
+        a, b = b"chunk-one|", b"chunk-two"
+        whole = core.brpc_crc32c(a + b, len(a + b), 0)
+        chained = core.brpc_crc32c(b, len(b),
+                                   core.brpc_crc32c(a, len(a), 0))
+        assert whole == chained

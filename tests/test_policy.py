@@ -175,3 +175,49 @@ class TestLimiters:
                 l.on_responded(0, 500)
         assert l.max_concurrency() >= AutoConcurrencyLimiter.MIN_LIMIT
         assert l.on_requested(1)
+
+
+class TestKetamaLB:
+    def _lb(self, n=4):
+        from brpc_tpu.policy.load_balancer import (KetamaLB, ServerNode,
+                                                   create_load_balancer)
+        lb = create_load_balancer("c_ketama")
+        assert isinstance(lb, KetamaLB)
+        from brpc_tpu.butil.endpoint import str2endpoint
+        lb.reset_servers([ServerNode(str2endpoint(f"10.0.0.{i}:80"))
+                          for i in range(n)])
+        return lb
+
+    def test_stable_mapping(self):
+        lb = self._lb()
+        picks = {code: lb.select_server(request_code=code)
+                 for code in range(200)}
+        for code, ep in picks.items():
+            assert lb.select_server(request_code=code) == ep
+
+    def test_ring_density(self):
+        """160 points per unit weight (40 md5 groups x 4 u32 splits) —
+        the libketama placement."""
+        lb = self._lb(n=3)
+        assert len(lb._ring) == 3 * 160
+
+    def test_minimal_remap_on_removal(self):
+        """Consistent hashing's point: removing one of 4 servers remaps
+        only the keys that lived on it (~1/4), not everything."""
+        from brpc_tpu.butil.endpoint import str2endpoint
+        lb = self._lb(n=4)
+        before = {c: lb.select_server(request_code=c) for c in range(400)}
+        lb.remove_server(str2endpoint("10.0.0.3:80"))
+        moved = sum(
+            1 for c in range(400)
+            if before[c] != lb.select_server(request_code=c)
+            and str(before[c]) != "10.0.0.3:80")
+        assert moved == 0, f"{moved} keys moved off surviving servers"
+
+    def test_distribution_roughly_even(self):
+        from collections import Counter
+        lb = self._lb(n=4)
+        counts = Counter(lb.select_server(request_code=c)
+                         for c in range(4000))
+        assert len(counts) == 4
+        assert min(counts.values()) > 4000 / 4 * 0.5   # no starved server
