@@ -118,20 +118,46 @@ def build_routes(server) -> dict:
         return "\n".join(lines) + "\n"
 
     def metrics(req):
-        # Prometheus text format (builtin/prometheus_metrics_service.cpp role)
+        # Prometheus text format (builtin/prometheus_metrics_service.cpp
+        # role).  MultiDimension variables render with their REAL label
+        # names — name{method="Echo",code="0"} — the mbvar contract.
+        from brpc_tpu.bvar.multi_dimension import MultiDimension
+        from brpc_tpu.bvar.variable import exposed_variables
+
+        def esc(v):
+            # exposition-format label escaping: one bad value must not
+            # invalidate the whole scrape
+            return (str(v).replace("\\", "\\\\")
+                    .replace('"', '\\"').replace("\n", "\\n"))
+
         out = []
-        for k, v in sorted(dump_exposed("*").items()):
+        for k, var in sorted(exposed_variables("*").items()):
             name = k.replace("-", "_").replace(".", "_").replace("/", "_")
+            try:
+                if isinstance(var, MultiDimension):
+                    out.append(f"# TYPE {name} gauge")
+                    label_names = var.labels
+                    for key, lvar in var.items():
+                        lv = lvar.get_value()
+                        if isinstance(lv, bool):
+                            lv = int(lv)
+                        if not isinstance(lv, (int, float)):
+                            continue
+                        pairs = ",".join(
+                            f'{ln}="{esc(kv)}"'
+                            for ln, kv in zip(label_names, key))
+                        out.append(f"{name}{{{pairs}}} {lv}")
+                    continue
+                v = var.get_value()
+            except Exception:
+                # one throwing variable (torn-down PassiveStatus callback)
+                # must not 500 the whole scrape
+                continue
             if isinstance(v, bool):
                 v = int(v)
             if isinstance(v, (int, float)):
                 out.append(f"# TYPE {name} gauge")
                 out.append(f"{name} {v}")
-            elif isinstance(v, dict):  # MultiDimension
-                out.append(f"# TYPE {name} gauge")
-                for labels, lv in v.items():
-                    if isinstance(lv, (int, float)):
-                        out.append(f'{name}{{label="{labels}"}} {lv}')
         return "\n".join(out) + "\n", "text/plain; version=0.0.4"
 
     def services_page(req):

@@ -56,6 +56,15 @@ class Variable:
         return str(v)
 
 
+def exposed_variables(pattern: str = "*") -> dict:
+    """Variable OBJECTS by name (dump_exposed gives values) — exporters
+    that need type information (e.g. Prometheus label rendering for
+    MultiDimension) go through this."""
+    with _registry_lock:
+        return {k: v for k, v in _registry.items()
+                if fnmatch.fnmatch(k, pattern)}
+
+
 def expose(name: str, fn: Callable[[], object]) -> Variable:
     """Expose a pull-callback as a variable (PassiveStatus shorthand)."""
     from brpc_tpu.bvar.reducer import PassiveStatus

@@ -1,0 +1,75 @@
+"""Deferred (asynchronous) server handlers — the done-Closure contract
+(reference: svc->CallMethod(..., done) in baidu_rpc_protocol.cpp:398;
+see README "the blocking model").
+
+The handler calls cntl.defer() and returns immediately; a worker thread
+completes the RPC later.  In-flight RPCs park as closures, not threads —
+this demo holds 1000 concurrent calls open at once on ordinary pools.
+
+Run:  python examples/deferred_echo.py
+"""
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc.controller import Controller
+
+
+class BatchEcho(brpc.Service):
+    """Parks every request; a ticker releases them in batches — the
+    shape of a server that waits on an external event (a device step,
+    an upstream call) without holding worker threads."""
+
+    def __init__(self):
+        self.parked = []
+        self.mu = threading.Lock()
+
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        done = cntl.defer()
+        with self.mu:
+            self.parked.append((done, req))
+
+
+def main():
+    svc = BatchEcho()
+    server = brpc.Server()
+    server.add_service(svc)
+    server.start("127.0.0.1", 0)
+    print(f"server on 127.0.0.1:{server.port}")
+
+    def releaser():
+        while True:
+            time.sleep(0.05)
+            with svc.mu:
+                batch, svc.parked = svc.parked, []
+            for done, req in batch:
+                done(b"deferred:" + req)
+
+    threading.Thread(target=releaser, daemon=True).start()
+
+    ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=10_000)
+    n = 1000
+    got = []
+    t0 = time.monotonic()
+    for i in range(n):
+        ch.call(
+            "BatchEcho", "Echo", str(i).encode(),
+            cntl=Controller(timeout_ms=10_000),
+            done=lambda c: got.append(c))
+    while len(got) < n and time.monotonic() - t0 < 30:
+        time.sleep(0.01)
+    ok = sum(1 for c in got if c.error_code == 0)
+    print(f"{ok}/{n} deferred RPCs completed in "
+          f"{time.monotonic() - t0:.2f}s "
+          f"(process threads: {threading.active_count()})")
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

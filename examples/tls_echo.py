@@ -1,0 +1,53 @@
+"""TLS-encrypted RPC (reference ServerOptions.ssl_options role; see
+README "TLS and unix sockets" for why this build terminates TLS with
+in-process proxies over Python's ssl).
+
+Generates a throwaway self-signed cert, stands up a server + TLS
+terminator, and calls through an encrypted channel.
+
+Run:  python examples/tls_echo.py
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc.tls import TlsTerminator, tls_channel_address, tls_stats
+
+
+class Echo(brpc.Service):
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return req
+
+
+def main():
+    d = tempfile.mkdtemp()
+    cert, key = f"{d}/cert.pem", f"{d}/key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "1", "-nodes", "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=DNS:localhost"],
+        check=True, capture_output=True)
+
+    server = brpc.Server()
+    server.add_service(Echo())
+    server.start("127.0.0.1", 0)
+    term = TlsTerminator(server, cert, key, address="127.0.0.1")
+    print(f"plaintext backend :{server.port}; TLS front :{term.port}")
+
+    addr = tls_channel_address("localhost", term.port, cafile=cert)
+    ch = brpc.Channel(addr, timeout_ms=10_000)
+    for i in range(100):
+        assert ch.call_sync("Echo", "Echo", b"x" * 4096) == b"x" * 4096
+    print(f"100 encrypted echoes OK; {tls_stats()}")
+    term.stop()
+    server.stop()
+    server.join()
+
+
+if __name__ == "__main__":
+    main()

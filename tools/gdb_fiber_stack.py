@@ -1,0 +1,88 @@
+"""gdb helper for the native runtime (the reference ships
+tools/gdb_bthread_stack.py to walk parked bthread stacks; SURVEY §2.8).
+
+Our fibers are C++20 coroutines — parked frames are heap objects, not
+switched stacks, so "walking" them means inspecting runtime state rather
+than swapping $rsp.  This script surfaces what an operator debugging a
+hung process needs:
+
+    (gdb) source tools/gdb_fiber_stack.py
+    (gdb) brpc-threads        # classify runtime threads (workers,
+                              # dispatchers, timer, drainers) with stacks
+    (gdb) brpc-counters       # executor/timer counters via the C ABI
+
+Works on a live process or a core with libbrpc_core symbols loaded.
+"""
+import gdb  # noqa: F401  (only importable inside gdb)
+
+RUNTIME_HINTS = (
+    ("worker_main", "executor worker"),
+    ("EventDispatcher::Run", "event dispatcher"),
+    ("TimerThread::run", "timer thread"),
+    ("drain", "queue drainer"),
+    ("epoll_wait", "epoll wait"),
+    ("Butex", "butex path"),
+)
+
+
+def _classify(frames):
+    for needle, label in RUNTIME_HINTS:
+        if any(needle in f for f in frames):
+            return label
+    return None
+
+
+class BrpcThreads(gdb.Command):
+    """Classify process threads by native-runtime role and show stacks."""
+
+    def __init__(self):
+        super().__init__("brpc-threads", gdb.COMMAND_USER)
+
+    def invoke(self, arg, from_tty):
+        inferior = gdb.selected_inferior()
+        cur = gdb.selected_thread()
+        try:
+            for t in inferior.threads():
+                t.switch()
+                frames = []
+                frame = gdb.newest_frame()
+                depth = 0
+                while frame is not None and depth < 24:
+                    name = frame.name() or "??"
+                    frames.append(name)
+                    frame = frame.older()
+                    depth += 1
+                role = _classify(frames) or "other"
+                print(f"--- thread {t.num} [{role}] ---")
+                for i, f in enumerate(frames[:10]):
+                    print(f"  #{i} {f}")
+        finally:
+            if cur is not None:
+                cur.switch()
+
+
+class BrpcCounters(gdb.Command):
+    """Executor/timer/socket counters through the C ABI (live only)."""
+
+    def __init__(self):
+        super().__init__("brpc-counters", gdb.COMMAND_USER)
+
+    def invoke(self, arg, from_tty):
+        for expr, label in (
+                ("brpc_executor_tasks_executed()", "tasks executed"),
+                ("brpc_executor_steals()", "steals"),
+                ("brpc_executor_num_workers()", "workers"),
+                ("brpc_timer_fired()", "timers fired"),
+                ("brpc_socket_active_count()", "active sockets"),
+                ("brpc_rpc_dropped_responses()", "dropped responses"),
+                ("brpc_prof_samples()", "profiler samples")):
+            try:
+                v = gdb.parse_and_eval(expr)
+                print(f"{label:>20}: {v}")
+            except gdb.error as e:
+                print(f"{label:>20}: <unavailable: {e}>")
+
+
+BrpcThreads()
+BrpcCounters()
+print("brpc gdb helpers loaded: brpc-threads, brpc-counters")

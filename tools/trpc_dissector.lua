@@ -1,0 +1,131 @@
+-- Wireshark dissector for the TRPC wire protocol (the reference ships a
+-- baidu_std dissector in tools/ the same way; SURVEY §2.8).
+--
+-- Frame: 16-byte header = "TRPC" + u32be meta_size + u64be body_size,
+-- then meta (fixed 14 bytes: u8 version, u8 msg_type, u16le flags,
+-- u64le correlation_id, u16le attempt; then TLVs: u8 tag, u32le len,
+-- value) and body.
+--
+-- Usage: wireshark -X lua_script:tools/trpc_dissector.lua
+-- then "Decode As…" the TCP port as TRPC (or rely on the heuristic).
+
+local trpc = Proto("trpc", "TPU-RPC TRPC Protocol")
+
+local f_meta_size = ProtoField.uint32("trpc.meta_size", "Meta size", base.DEC)
+local f_body_size = ProtoField.uint64("trpc.body_size", "Body size", base.DEC)
+local f_version = ProtoField.uint8("trpc.version", "Version", base.DEC)
+local f_msg_type = ProtoField.uint8("trpc.msg_type", "Message type", base.DEC,
+                                    {[0] = "REQUEST", [1] = "RESPONSE"})
+local f_cid = ProtoField.uint64("trpc.correlation_id", "Correlation id",
+                                base.DEC)
+local f_attempt = ProtoField.uint16("trpc.attempt", "Attempt", base.DEC)
+local f_service = ProtoField.string("trpc.service", "Service")
+local f_method = ProtoField.string("trpc.method", "Method")
+local f_error_code = ProtoField.int32("trpc.error_code", "Error code",
+                                      base.DEC)
+local f_error_text = ProtoField.string("trpc.error_text", "Error text")
+local f_compress = ProtoField.uint8("trpc.compress", "Compress type",
+                                    base.DEC)
+local f_timeout = ProtoField.uint32("trpc.timeout_ms", "Timeout ms",
+                                    base.DEC)
+local f_content_type = ProtoField.string("trpc.content_type", "Content type")
+local f_att_size = ProtoField.uint64("trpc.attachment_size",
+                                     "Attachment size", base.DEC)
+local f_body = ProtoField.bytes("trpc.body", "Body")
+
+trpc.fields = {f_meta_size, f_body_size, f_version, f_msg_type, f_cid,
+               f_attempt, f_service, f_method, f_error_code, f_error_text,
+               f_compress, f_timeout, f_content_type, f_att_size, f_body}
+
+local TAGS = {
+  [1] = {f_service, "string"},
+  [2] = {f_method, "string"},
+  [3] = {f_error_code, "i32"},
+  [4] = {f_error_text, "string"},
+  [5] = {f_compress, "u8"},
+  [6] = {f_att_size, "u64"},
+  [7] = {f_timeout, "u32"},
+  [12] = {f_content_type, "string"},
+}
+
+local function dissect_one(buf, pinfo, tree, offset)
+  local remaining = buf:len() - offset
+  if remaining < 16 then return -1 end            -- need more bytes
+  if buf(offset, 4):string() ~= "TRPC" then return 0 end
+  local meta_size = buf(offset + 4, 4):uint()
+  local body_size = buf(offset + 8, 8):uint64():tonumber()
+  local total = 16 + meta_size + body_size
+  if remaining < total then
+    pinfo.desegment_len = total - remaining       -- TCP reassembly
+    pinfo.desegment_offset = offset
+    return -1
+  end
+
+  local sub = tree:add(trpc, buf(offset, total), "TRPC Frame")
+  sub:add(f_meta_size, buf(offset + 4, 4))
+  sub:add(f_body_size, buf(offset + 8, 8))
+
+  local m = offset + 16
+  local info = "TRPC"
+  if meta_size >= 14 then
+    sub:add(f_version, buf(m, 1))
+    sub:add(f_msg_type, buf(m + 1, 1))
+    sub:add_le(f_cid, buf(m + 4, 8))
+    sub:add_le(f_attempt, buf(m + 12, 2))
+    local mtype = buf(m + 1, 1):uint()
+    info = (mtype == 0) and "TRPC request" or "TRPC response"
+    -- TLVs
+    local p = m + 14
+    local meta_end = m + meta_size
+    while p + 5 <= meta_end do
+      local tag = buf(p, 1):uint()
+      local len = buf(p + 1, 4):le_uint()
+      if p + 5 + len > meta_end then break end
+      local spec = TAGS[tag]
+      if spec then
+        local field, kind = spec[1], spec[2]
+        if kind == "string" then
+          sub:add(field, buf(p + 5, len))
+          if tag == 1 then info = info .. " " .. buf(p + 5, len):string() end
+          if tag == 2 then info = info .. "." .. buf(p + 5, len):string() end
+        elseif kind == "i32" and len == 4 then
+          sub:add_le(field, buf(p + 5, 4))
+        elseif kind == "u32" and len == 4 then
+          sub:add_le(field, buf(p + 5, 4))
+        elseif kind == "u64" and len == 8 then
+          sub:add_le(field, buf(p + 5, 8))
+        elseif kind == "u8" and len >= 1 then
+          sub:add(field, buf(p + 5, 1))
+        end
+      end
+      p = p + 5 + len
+    end
+  end
+  if body_size > 0 then
+    sub:add(f_body, buf(offset + 16 + meta_size, body_size))
+  end
+  pinfo.cols.info = info
+  return total
+end
+
+function trpc.dissector(buf, pinfo, tree)
+  pinfo.cols.protocol = "TRPC"
+  local offset = 0
+  while offset < buf:len() do
+    local n = dissect_one(buf, pinfo, tree, offset)
+    if n == 0 then return 0 end       -- not TRPC
+    if n < 0 then return end          -- waiting for reassembly
+    offset = offset + n
+  end
+  return offset
+end
+
+-- Heuristic: any TCP payload starting with the magic
+local function trpc_heuristic(buf, pinfo, tree)
+  if buf:len() < 16 then return false end
+  if buf(0, 4):string() ~= "TRPC" then return false end
+  trpc.dissector(buf, pinfo, tree)
+  return true
+end
+
+trpc:register_heuristic("tcp", trpc_heuristic)
