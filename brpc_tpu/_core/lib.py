@@ -118,6 +118,10 @@ _sigs = {
     "brpc_executor_submit": (None, [TASK_CB, ctypes.c_void_p]),
     "brpc_executor_tasks_executed": (ctypes.c_int64, []),
     "brpc_executor_steals": (ctypes.c_int64, []),
+    "brpc_fiber_counters": (None, [ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64)]),
     "brpc_executor_num_workers": (ctypes.c_int, []),
     "brpc_timer_add": (ctypes.c_uint64, [TASK_CB, ctypes.c_void_p, ctypes.c_int64]),
     "brpc_timer_cancel": (ctypes.c_int, [ctypes.c_uint64]),

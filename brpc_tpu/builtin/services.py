@@ -13,6 +13,7 @@ from __future__ import annotations
 import html
 import json
 import os
+import threading
 import time
 
 from brpc_tpu import rpcz
@@ -31,8 +32,47 @@ def build_routes(server) -> dict:
                 f"</title></head><body><h1>"
                 f"{server.options.server_info_name}</h1>"
                 f"<p>uptime {server.uptime_s:.0f}s · port {server.port} · "
-                f"{server.connection_count} connections</p>"
+                f"{server.connection_count} connections · "
+                f'<a href="/dashboard">dashboard</a></p>'
                 f"<ul>{rows}</ul></body></html>", "text/html")
+
+    # ---- /dashboard (see module-level _DashHistory/_spark) ----
+    hist = _dash_history_for(server)
+
+    def dashboard(req):
+        hist.ensure()
+        samples = list(hist.samples)
+        blocks = []
+        for key, st in sorted(server.method_statuses.items()):
+            svc, m = key
+            qps, lat = [], []
+            for (t0, s0), (t1, s1) in zip(samples, samples[1:]):
+                c0, sum0 = s0.get(key, (0, 0))
+                c1, sum1 = s1.get(key, (0, 0))
+                dt = max(1e-6, t1 - t0)
+                dc = max(0, c1 - c0)
+                qps.append(dc / dt)
+                lat.append((sum1 - sum0) / dc if dc else 0.0)
+            r = st.latency_rec
+            blocks.append(
+                f"<tr><td>{svc}.{m}</td>"
+                f"<td>{r.qps():.1f}</td>"
+                f"<td>{_spark(qps)}</td>"
+                f"<td>{r.latency():.0f}us / "
+                f"p99 {r.latency_percentile(0.99):.0f}us</td>"
+                f"<td>{_spark(lat)}</td>"
+                f"<td>{st.nerror.get_value()}</td></tr>")
+        note = ("" if len(samples) > 2 else
+                "<p>(collecting history — refresh in a few seconds)</p>")
+        return (f"<html><head><title>dashboard</title>"
+                f"<meta http-equiv='refresh' content='5'></head><body>"
+                f"<h1>{server.options.server_info_name} dashboard</h1>"
+                f"<p>last {len(samples)}s · auto-refreshes</p>{note}"
+                f"<table border='0' cellpadding='4'>"
+                f"<tr><th>method</th><th>qps</th><th>qps (2m)</th>"
+                f"<th>latency</th><th>avg latency (2m)</th>"
+                f"<th>errors</th></tr>"
+                f"{''.join(blocks)}</table></body></html>", "text/html")
 
     def status(req):
         lines = [f"server: {server.options.server_info_name}",
@@ -95,10 +135,21 @@ def build_routes(server) -> dict:
                 f"live_iobuf_blocks: {core.brpc_iobuf_live_blocks()}\n")
 
     def bthreads(req):
+        import ctypes
+        w = ctypes.c_int64()
+        k = ctypes.c_int64()
+        t = ctypes.c_int64()
+        m = ctypes.c_int64()
+        core.brpc_fiber_counters(ctypes.byref(w), ctypes.byref(k),
+                                 ctypes.byref(t), ctypes.byref(m))
         return (f"workers: {core.brpc_executor_num_workers()}\n"
                 f"tasks_executed: {core.brpc_executor_tasks_executed()}\n"
                 f"steals: {core.brpc_executor_steals()}\n"
-                f"timers_fired: {core.brpc_timer_fired()}\n")
+                f"timers_fired: {core.brpc_timer_fired()}\n"
+                f"butex_waits: {w.value}\n"
+                f"butex_wakes: {k.value}\n"
+                f"butex_timeouts: {t.value}\n"
+                f"fiber_mutex_contended: {m.value}\n")
 
     def rpcz_page(req):
         tid = req.query.get("trace_id")
@@ -224,6 +275,7 @@ def build_routes(server) -> dict:
 
     routes = {
         "/": index, "/index": index,
+        "/dashboard": dashboard,
         "/status": status,
         "/vars": vars_page,
         "/flags": flags_page,
@@ -253,6 +305,62 @@ def build_routes(server) -> dict:
         "/pprof/growth": hotspots_growth,
     }
     return routes
+
+
+class _DashHistory:
+    """2-minute per-second (count, sum_us) history per method — the data
+    behind /dashboard's sparklines (the reference /index embeds
+    jquery+flot charts; ours are dependency-free inline SVG)."""
+
+    def __init__(self, server):
+        from collections import deque
+        self._server = server
+        self.samples = deque(maxlen=120)   # (ts, {key: (count, sum_us)})
+        self._started = False
+        self._mu = threading.Lock()
+
+    def ensure(self):
+        with self._mu:
+            if self._started:
+                return
+            self._started = True
+            threading.Thread(target=self._run, daemon=True,
+                             name="console-dashboard").start()
+
+    def _run(self):
+        while self._server.running:
+            snap = {}
+            for key, st in self._server.method_statuses.items():
+                c, s_us, _ = st.latency_rec.snapshot()  # one native call
+                snap[key] = (c, s_us)
+            self.samples.append((time.time(), snap))
+            time.sleep(1.0)
+
+
+def _dash_history_for(server) -> _DashHistory:
+    """One history (and one sampler thread) per Server instance, however
+    many routers are built for it."""
+    h = getattr(server, "_dash_history", None)
+    if h is None or h._server is not server:
+        h = _DashHistory(server)
+        server._dash_history = h
+    return h
+
+
+def _spark(points, width=240, height=36):
+    if len(points) < 2:
+        return "<svg width='240' height='36'></svg>"
+    top = max(points) or 1
+    n = len(points)
+    coords = " ".join(
+        f"{i * width / (n - 1):.1f},"
+        f"{height - 2 - (v / top) * (height - 6):.1f}"
+        for i, v in enumerate(points))
+    return (f"<svg width='{width}' height='{height}'>"
+            f"<polyline points='{coords}' fill='none' "
+            f"stroke='#36c' stroke-width='1.5'/>"
+            f"<text x='{width - 4}' y='10' text-anchor='end' "
+            f"font-size='9' fill='#666'>{top:.4g}</text></svg>")
 
 
 def _fmt(v):

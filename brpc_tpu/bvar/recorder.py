@@ -197,6 +197,18 @@ class LatencyRecorder(Variable):
     def max_latency(self):
         return self._max.get_value()
 
+    def snapshot(self):
+        """(count, sum_us, max_us) in ONE native stats call — for pollers
+        (the console dashboard samples every method once a second)."""
+        import ctypes
+        from brpc_tpu._core import core
+        c = ctypes.c_int64()
+        s = ctypes.c_int64()
+        m = ctypes.c_int64()
+        core.brpc_latency_stats(self._h, ctypes.byref(c), ctypes.byref(s),
+                                ctypes.byref(m))
+        return c.value, s.value, m.value
+
     def __del__(self):
         # release the native slot (512 process-wide): leaking recorders
         # would silently dead-end new ones once the pool exhausts

@@ -4,8 +4,25 @@
 
 #include "bthread/executor.h"
 #include "bthread/timer.h"
+#include "bvar/combiner.h"
 
 namespace bthread {
+
+// butex traffic stats (per-thread combiner cells; /bthreads console row).
+static bvar::Adder g_butex_waits;
+static bvar::Adder g_butex_wakes;
+static bvar::Adder g_butex_timeouts;
+static bvar::Adder g_mutex_contended;
+
+void Butex::counters(int64_t* waits, int64_t* wakes, int64_t* timeouts,
+                     int64_t* mutex_contended) {
+  if (waits) *waits = g_butex_waits.get();
+  if (wakes) *wakes = g_butex_wakes.get();
+  if (timeouts) *timeouts = g_butex_timeouts.get();
+  if (mutex_contended) *mutex_contended = g_mutex_contended.get();
+}
+
+void Butex::note_mutex_contention() { g_mutex_contended.add(1); }
 
 // Heap-allocated, refcounted waiter record.  Two owners can hold a pointer
 // concurrently: the butex list/waker side and the timer callback.  The
@@ -67,6 +84,7 @@ void Butex::TimeoutTask(void* arg) {
       break;
     }
     *w->result_slot = WaitResult::kTimeout;
+    g_butex_timeouts.add(1);
     schedule_resume(w->handle);
   }
   w->unref();
@@ -98,6 +116,7 @@ bool Butex::Awaiter::await_suspend(std::coroutine_handle<> h) {
     w->timer_id = TimerThread::global()->schedule_after(
         &Butex::TimeoutTask, w, timeout_us);
   }
+  g_butex_waits.add(1);
   return true;
 }
 
@@ -138,6 +157,7 @@ int Butex::wake(int n) {
       w = next_in_list;
     }
   }
+  if (woken > 0) g_butex_wakes.add(woken);
   for (Waiter* w = resume_list; w != nullptr;) {
     Waiter* next = w->next;
     w->next = nullptr;

@@ -81,6 +81,14 @@ class Butex {
   // Waiters currently parked (approximate; for stats/tests).
   int waiter_count();
 
+  // Process-wide butex stats (bvar combiners): parks, wakes, timeouts,
+  // and FiberMutex contention events.  The reference instruments
+  // bthread_mutex for its contention profiler (mutex.cpp:62-107); these
+  // counters are that role's first stage, surfaced on /bthreads.
+  static void counters(int64_t* waits, int64_t* wakes, int64_t* timeouts,
+                       int64_t* mutex_contended);
+  static void note_mutex_contention();
+
  private:
   friend struct Awaiter;
   friend struct Waiter;

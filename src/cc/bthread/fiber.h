@@ -111,6 +111,7 @@ class FiberMutex {
           _b.value.exchange(2, std::memory_order_acquire);
       if (prev == 0) co_return;   // acquired (flagged contended: one
                                   // spurious wake at unlock, never a hang)
+      Butex::note_mutex_contention();  // /bthreads contention stat
       co_await _b.wait(2);        // kMismatch => value moved; just retry
     }
   }

@@ -4,6 +4,7 @@
 // the executor are native; protocol semantics live above.
 #include <cstring>
 
+#include "bthread/butex.h"
 #include "bthread/executor.h"
 #include "bthread/timer.h"
 #include "butil/common.h"
@@ -85,6 +86,10 @@ int64_t brpc_executor_tasks_executed() {
   return bthread::Executor::global()->tasks_executed();
 }
 int64_t brpc_executor_steals() { return bthread::Executor::global()->steals(); }
+void brpc_fiber_counters(int64_t* waits, int64_t* wakes, int64_t* timeouts,
+                         int64_t* mutex_contended) {
+  bthread::Butex::counters(waits, wakes, timeouts, mutex_contended);
+}
 int brpc_executor_num_workers() { return bthread::Executor::global()->num_workers(); }
 
 uint64_t brpc_timer_add(brpc_task_fn fn, void* arg, int64_t delay_us) {

@@ -95,3 +95,45 @@ class TestFiberRaces:
         woke_us = core.brpc_fiber_sleep_probe(20_000, 10_000)
         assert woke_us >= 18_000, f"woke early: {woke_us}us"
         assert woke_us < 5_000_000, f"woke far too late: {woke_us}us"
+
+
+class TestButexCounters:
+    def test_counters_track_parks_and_wakes(self):
+        """/bthreads stats: parked fibers count as butex waits; release
+        counts wakes.  (Mutex contention needs real core parallelism to
+        occur, so this asserts the deterministic park path.)"""
+        import ctypes
+
+        def counters():
+            w = ctypes.c_int64()
+            k = ctypes.c_int64()
+            t = ctypes.c_int64()
+            m = ctypes.c_int64()
+            core.brpc_fiber_counters(ctypes.byref(w), ctypes.byref(k),
+                                     ctypes.byref(t), ctypes.byref(m))
+            return w.value, k.value, t.value, m.value
+
+        w0, k0, t0, _ = counters()
+        demo = core.brpc_fiber_demo_start(200)
+        try:
+            deadline = time.monotonic() + 20
+            while (core.brpc_fiber_demo_blocked(demo) < 200
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            w1, _, _, _ = counters()
+            assert w1 - w0 >= 200
+            core.brpc_fiber_demo_release(demo)
+            assert core.brpc_fiber_demo_join(demo, 20_000) == 0
+            _, k1, _, _ = counters()
+            assert k1 - k0 >= 200
+        finally:
+            core.brpc_fiber_demo_free(demo)
+
+    def test_timeout_counter(self):
+        import ctypes
+        t0 = ctypes.c_int64()
+        core.brpc_fiber_counters(None, None, ctypes.byref(t0), None)
+        assert core.brpc_fiber_sleep_probe(5_000, 10_000) >= 4_000
+        t1 = ctypes.c_int64()
+        core.brpc_fiber_counters(None, None, ctypes.byref(t1), None)
+        assert t1.value > t0.value   # sleep rides the timeout path
