@@ -16,6 +16,7 @@
 
 #include "bthread/execution_queue.h"
 #include "bthread/executor.h"
+#include "bthread/fiber.h"
 #include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
 
@@ -145,6 +146,10 @@ int Socket::SetFailed(SocketId id, int error_code) {
   }
   if (won) {
     s->_error_code = error_code;
+    // a KeepWrite fiber parked on writability must not sleep through the
+    // failure (the dispatcher is being detached; no EPOLLOUT will come)
+    s->_epollout_butex.value.fetch_add(1, std::memory_order_acq_rel);
+    s->_epollout_butex.wake_all();
     if (s->_fd >= 0) EventDispatcher::GetDispatcher(s->_fd)->RemoveConsumer(s->_fd);
     if (s->_opts.on_failed != nullptr) {
       auto* q = s->_fifo_q.load(std::memory_order_acquire);
@@ -338,13 +343,30 @@ void Socket::DrainWriteQueue(bool from_keepwrite) {
       }
       if (errno == EINTR) continue;
       if (errno == EAGAIN || errno == EWOULDBLOCK) {
-        // Wait for EPOLLOUT.  EPOLL_CTL_MOD re-arms edge-triggered readiness,
-        // so a writability edge between our failed write and the MOD is not
-        // lost (reference RegisterEvent, socket.cpp:1800-1920 role).  After
-        // the MOD we must not touch socket state — the resume task may
-        // already be running.
+        // Hand the remainder to the KeepWrite FIBER: it parks on the
+        // writability butex until OnWritable (or SetFailed) wakes it,
+        // then continues draining — the reference's KeepWrite bthread
+        // (socket.cpp:1800-1920) on the coroutine runtime.  Snapshot the
+        // butex word BEFORE the epoll re-arm so an EPOLLOUT edge firing
+        // between the re-arm and the fiber's park is never missed (the
+        // wake bumps the word; the park's expected-value check fails and
+        // the fiber proceeds immediately).
+        Socket* self = Socket::Address(_id);
+        if (self == nullptr) {
+          // lost a race with SetFailed: the failed() branch on the next
+          // KeepWrite pass would clean up, but there is no next pass —
+          // drop the leftovers now
+          int64_t dropped = (int64_t)_out_buf.size();
+          _out_buf.clear();
+          _pending_write.fetch_sub(dropped, std::memory_order_relaxed);
+          _write_busy.store(false, std::memory_order_seq_cst);
+          return;
+        }
+        const int32_t seq =
+            _epollout_butex.value.load(std::memory_order_acquire);
         _waiting_epollout.store(true, std::memory_order_seq_cst);
         EventDispatcher::GetDispatcher(_fd)->Rearm(_id, _fd);
+        KeepWriteFiber(self, seq).spawn();
         return;
       }
       SetFailed(_id, errno);
@@ -355,14 +377,20 @@ void Socket::DrainWriteQueue(bool from_keepwrite) {
 
 void Socket::OnWritable() {
   if (_waiting_epollout.exchange(false, std::memory_order_seq_cst)) {
-    // Resume the drain off the dispatcher thread.
-    Socket* self = Socket::Address(_id);
-    if (self == nullptr) return;
-    bthread::Executor::global()->submit([self] {
-      self->DrainWriteQueue(true);
-      self->Dereference();
-    });
+    // Wake the parked KeepWrite fiber (resumes on the executor).
+    _epollout_butex.value.fetch_add(1, std::memory_order_acq_rel);
+    _epollout_butex.wake_all();
   }
+}
+
+// KeepWrite: park until writable (or failed), then resume the drain.
+// Holds a socket reference for its whole life, so the slot cannot recycle
+// under the parked frame; the 500ms timeout is a safety net that rechecks
+// failed() even if a wake was somehow lost.
+bthread::Fiber Socket::KeepWriteFiber(Socket* self, int32_t seq) {
+  co_await self->_epollout_butex.wait(seq, 500 * 1000);
+  self->DrainWriteQueue(true);
+  self->Dereference();
 }
 
 // ---- read path ----

@@ -20,7 +20,9 @@
 #include <mutex>
 #include <vector>
 
+#include "bthread/butex.h"
 #include "bthread/execution_queue.h"
+#include "bthread/fiber.h"
 #include "butil/common.h"
 #include "butil/iobuf.h"
 #include "butil/resource_pool.h"
@@ -146,6 +148,7 @@ class Socket {
   friend class EventDispatcher;
 
   void DoAcceptLoop();
+  static bthread::Fiber KeepWriteFiber(Socket* self, int32_t seq);
   void DrainWriteQueue(bool from_keepwrite);
   void ReleaseWriterAndMaybeResume();
   bool BecomeWriter();  // busy-flag acquire
@@ -164,6 +167,11 @@ class Socket {
   std::atomic<WriteRequest*> _write_stack{nullptr};
   std::atomic<bool> _write_busy{false};
   std::atomic<bool> _waiting_epollout{false};
+  // Writability butex: the KeepWrite FIBER parks here on EAGAIN and
+  // OnWritable / SetFailed bump + wake it — the reference's KeepWrite is
+  // a bthread blocking on EPOLLOUT (socket.cpp:1800-1920), and this is
+  // that shape on the coroutine runtime (in-core user of butex).
+  bthread::Butex _epollout_butex;
   std::atomic<int64_t> _pending_write{0};  // queued + _out_buf bytes
   butil::IOBuf _out_buf;  // drainer-owned unwritten bytes
 

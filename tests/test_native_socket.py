@@ -144,3 +144,57 @@ def test_stale_socket_id_fails():
     # Versioned id: the stale handle can never address the slot again.
     assert core.brpc_socket_alive(sid.value) == 0
     assert core.brpc_socket_set_failed(sid.value, 0) == -1
+
+
+class TestKeepWriteFiber:
+    def test_eagain_parks_fiber_and_resumes(self):
+        """The KeepWrite path is a FIBER parked on the writability butex
+        (the reference's KeepWrite bthread, socket.cpp:1800-1920): a
+        stalled reader drives EAGAIN -> the fiber parks (butex wait count
+        moves), and the backlog drains after the reader resumes."""
+        import ctypes
+        import socket as pysock
+        import threading
+        import time
+
+        from brpc_tpu.rpc.transport import Transport
+        from brpc_tpu._core import core
+
+        tr = Transport.instance()
+        w0 = ctypes.c_int64()
+        core.brpc_fiber_counters(ctypes.byref(w0), None, None, None)
+        srv = pysock.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        conns = []
+        threading.Thread(target=lambda: conns.append(srv.accept()[0]),
+                         daemon=True).start()
+        sid = tr.connect("127.0.0.1", srv.getsockname()[1], lambda *a: None)
+        total_bytes = 0
+        for _ in range(400):                 # >> any kernel socket buffer
+            if tr.write_raw(sid, b"q" * 60_000) == 0:
+                total_bytes += 60_000
+        assert total_bytes > 0
+        time.sleep(0.3)
+        assert core.brpc_socket_pending_write(sid) > 0, "no EAGAIN backlog"
+        w1 = ctypes.c_int64()
+        core.brpc_fiber_counters(ctypes.byref(w1), None, None, None)
+        assert w1.value > w0.value, "KeepWrite fiber never parked"
+        deadline = time.monotonic() + 20
+        while not conns and time.monotonic() < deadline:
+            time.sleep(0.01)
+        conns[0].settimeout(20)
+        got = 0
+        while got < total_bytes:
+            chunk = conns[0].recv(1 << 20)
+            assert chunk, (f"EOF after {got}/{total_bytes} bytes — "
+                           f"socket failed mid-test")
+            got += len(chunk)
+        deadline = time.monotonic() + 15
+        while (core.brpc_socket_pending_write(sid) > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert core.brpc_socket_pending_write(sid) == 0
+        tr.close(sid)
+        conns[0].close()
+        srv.close()
