@@ -20,6 +20,10 @@ health_check_interval_s = 1.0
 
 _broken: dict[EndPoint, float] = {}     # endpoint -> since (monotonic)
 _hold_until: dict[EndPoint, float] = {}  # CB isolation hold deadline
+# bumped by reset_all(); probe loops from an older generation exit instead
+# of reviving endpoints into state that was deliberately cleared (tests,
+# operator resets)
+_generation = 0
 _mu = threading.Lock()
 _probe_threads: dict[EndPoint, threading.Thread] = {}
 _revived_counter = Adder("rpc_health_check_revived")
@@ -52,8 +56,8 @@ def mark_broken(ep: EndPoint, hold_s: float = 0.0) -> None:
             return
         _broken[ep] = time.monotonic()
         _broken_counter.add(1)
-        t = threading.Thread(target=_probe_loop, args=(ep,), daemon=True,
-                             name=f"health-check-{ep}")
+        t = threading.Thread(target=_probe_loop, args=(ep, _generation),
+                             daemon=True, name=f"health-check-{ep}")
         _probe_threads[ep] = t
         t.start()
 
@@ -64,10 +68,13 @@ def on_connection_failed(ep: EndPoint) -> None:
     global_breaker().on_socket_failed(ep)
 
 
-def _probe_loop(ep: EndPoint) -> None:
+def _probe_loop(ep: EndPoint, gen: int) -> None:
     while True:
         time.sleep(health_check_interval_s)
         with _mu:
+            if gen != _generation:
+                _probe_threads.pop(ep, None)
+                return              # state was reset under us: stand down
             hold = _hold_until.get(ep, 0.0)
         if time.monotonic() < hold:
             continue   # still inside the CB isolation hold
@@ -78,6 +85,9 @@ def _probe_loop(ep: EndPoint) -> None:
         except OSError:
             continue
     with _mu:
+        if gen != _generation:
+            _probe_threads.pop(ep, None)
+            return
         _broken.pop(ep, None)
         _hold_until.pop(ep, None)
         _probe_threads.pop(ep, None)
@@ -91,3 +101,14 @@ def reset(ep: EndPoint) -> None:
     with _mu:
         _broken.pop(ep, None)
         _hold_until.pop(ep, None)
+
+
+def reset_all() -> None:
+    """Clear every endpoint's state and retire in-flight probe loops (the
+    generation bump makes them exit instead of reviving endpoints into
+    the cleared state)."""
+    global _generation
+    with _mu:
+        _generation += 1
+        _broken.clear()
+        _hold_until.clear()

@@ -139,6 +139,15 @@ class TensorSerializer(Serializer):
         if not tensor_header:
             return body
         tensor_host_decodes.add(1)
+        try:
+            return self._decode_checked(body, tensor_header)
+        except IndexError as e:
+            # walking past a truncated header is bad INPUT, not a bug:
+            # every malformed-header path raises ValueError (the contract
+            # callers like the DCN envelope rely on for clean EREQUEST)
+            raise ValueError(f"truncated tensor header: {e}")
+
+    def _decode_checked(self, body, tensor_header):
         single = tensor_header[0] == 1
         off = 1
         count = tensor_header[off]
@@ -148,13 +157,30 @@ class TensorSerializer(Serializer):
         for _ in range(count):
             dlen = tensor_header[off]
             off += 1
-            dt = np.dtype(tensor_header[off : off + dlen].decode())
+            try:
+                dt = np.dtype(
+                    tensor_header[off : off + dlen].decode("ascii"))
+            except (TypeError, UnicodeDecodeError) as e:
+                # malformed header = bad input, not a programming error
+                raise ValueError(f"bad dtype in tensor header: {e}")
             off += dlen
             ndim = tensor_header[off]
             off += 1
-            shape = struct.unpack_from(f"<{ndim}Q", tensor_header, off)
+            try:
+                shape = struct.unpack_from(f"<{ndim}Q", tensor_header, off)
+            except struct.error as e:
+                raise ValueError(f"truncated tensor header: {e}")
             off += 8 * ndim
-            cnt = int(np.prod(shape)) if ndim else 1  # 0 for empty arrays
+            # exact Python-int element count (np.prod silently wraps), then
+            # bound against the actual body: a hostile header must raise
+            # ValueError, not drive numpy into OverflowError/overallocation
+            cnt = 1
+            for d in shape:
+                cnt *= int(d)
+            if cnt * dt.itemsize > len(body) - pos:
+                raise ValueError(
+                    f"tensor header claims {cnt} x {dt} at offset {pos} "
+                    f"but body has {len(body) - pos} bytes")
             arr = np.frombuffer(body, dtype=dt, count=cnt, offset=pos)
             out.append(arr.reshape(shape))
             pos += cnt * dt.itemsize

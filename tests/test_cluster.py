@@ -10,6 +10,25 @@ import brpc_tpu as brpc
 from brpc_tpu import errors
 
 
+@pytest.fixture(autouse=True)
+def _fresh_cluster_state():
+    """Health-check and circuit-breaker state is process-global and keyed
+    by endpoint; an ephemeral port REUSED from an earlier test would
+    inherit its broken/ramp state and make these timing-sensitive tests
+    flake.  Start each one clean."""
+    from brpc_tpu.policy import circuit_breaker, health_check
+    # generation bump: stale probe loops from earlier tests stand down
+    # instead of reviving endpoints into the cleared state
+    health_check.reset_all()
+    b = circuit_breaker.global_breaker()
+    with b._mu:
+        b._short.clear()
+        b._long.clear()
+        b._isolation_count.clear()
+        b._recovering_until.clear()
+    yield
+
+
 class WhoAmI(brpc.Service):
     NAME = "WhoAmI"
 

@@ -190,3 +190,39 @@ def test_fuzz_h2_frames_at_server():
     finally:
         s.stop()
         s.join()
+
+
+def test_fuzz_dcn_envelope():
+    """The DCN CallDevice envelope parser (ici/dcn._unpack_envelope) must
+    reject arbitrary bytes with ValueError-class errors, never crash or
+    over-read — it faces the network on any enable_dcn server."""
+    from brpc_tpu.ici.dcn import _pack_envelope, _unpack_envelope
+    import numpy as np
+
+    rng = random.Random(SEED + 11)
+    # random garbage
+    for _ in range(300):
+        data = rng.randbytes(rng.randrange(0, 200))
+        try:
+            _unpack_envelope(data)
+        except Exception as e:
+            assert isinstance(e, (ValueError, KeyError, UnicodeDecodeError,
+                                  IndexError)), type(e)
+    # structured mutations of a valid envelope
+    good = _pack_envelope({"svc": "S", "method": "M", "chip": 0},
+                          [np.arange(16, dtype=np.float32)])
+    for _ in range(300):
+        b = bytearray(good)
+        for _ in range(rng.randrange(1, 6)):
+            b[rng.randrange(len(b))] = rng.randrange(256)
+        try:
+            hdr, arrays = _unpack_envelope(bytes(b))
+            # parsed despite mutation: results must still be safe shapes
+            assert isinstance(hdr, dict)
+        except Exception as e:
+            assert isinstance(e, (ValueError, KeyError, UnicodeDecodeError,
+                                  IndexError)), type(e)
+    # round-trip sanity stays intact
+    hdr, arrays = _unpack_envelope(good)
+    assert hdr["svc"] == "S"
+    np.testing.assert_array_equal(arrays[0], np.arange(16, dtype=np.float32))
