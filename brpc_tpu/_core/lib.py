@@ -222,6 +222,12 @@ _sigs = {
                                                  ctypes.c_int]),
     "brpc_fiber_sleep_probe": (ctypes.c_int64, [ctypes.c_int64,
                                                 ctypes.c_int]),
+    "brpc_fiber_cond_stress": (ctypes.c_int64, [ctypes.c_int64,
+                                                ctypes.c_int]),
+    "brpc_fiber_sem_stress": (ctypes.c_int, [ctypes.c_int, ctypes.c_int,
+                                             ctypes.c_int, ctypes.c_int]),
+    "brpc_fiber_rw_stress": (ctypes.c_int64, [ctypes.c_int, ctypes.c_int,
+                                              ctypes.c_int]),
 }
 for _name, (_res, _args) in _sigs.items():
     fn = getattr(core, _name)

@@ -129,6 +129,142 @@ class FiberMutex {
   }
 
  private:
+  friend class FiberCond;   // wait-morphing requeues onto _b
+  Butex _b{0};
+};
+
+// Condition variable with WAIT-MORPHING: notify_all wakes one waiter and
+// requeues the rest onto the mutex's butex, so they wake one-at-a-time as
+// the lock hands over instead of thundering onto it (the reference's
+// bthread_cond is butex_requeue for the same reason; butex.h requeue).
+class FiberCond {
+ public:
+  // Caller HOLDS m.  Atomically releases it, parks, and re-acquires
+  // before returning (missed-wake-safe: notify bumps the sequence word
+  // between our snapshot and the park, which turns the park into a
+  // no-op mismatch).
+  Task wait(FiberMutex& m) {
+    const int32_t seq = _seq.value.load(std::memory_order_acquire);
+    m.unlock();
+    co_await _seq.wait(seq);
+    co_await m.lock();
+  }
+
+  void notify_one() {
+    _seq.value.fetch_add(1, std::memory_order_acq_rel);
+    _seq.wake(1);
+  }
+
+  // m is the mutex waiters passed to wait(); requeue survivors onto it.
+  // Best called with m held (the classic discipline); also safe without:
+  // if the mutex is FREE there is no holder to hand waiters to, so we
+  // fall back to waking everyone (they re-contend through lock()).
+  void notify_all(FiberMutex& m) {
+    _seq.value.fetch_add(1, std::memory_order_acq_rel);
+    // mark the mutex contended (1 -> 2) so the holder's unlock wakes the
+    // requeued waiters; blindly storing 2 on a FREE mutex would brick it
+    // (every future lock() would park with nobody left to unlock)
+    int32_t one = 1;
+    if (m._b.value.compare_exchange_strong(one, 2,
+                                           std::memory_order_acq_rel,
+                                           std::memory_order_acquire) ||
+        one == 2) {
+      _seq.requeue(&m._b, /*n_wake=*/1);
+    } else {
+      _seq.wake_all();   // mutex free: no handoff possible; thunder
+    }
+  }
+
+ private:
+  Butex _seq{0};
+};
+
+// Counting semaphore (reference bthread/semaphore.cpp shape).
+class FiberSemaphore {
+ public:
+  explicit FiberSemaphore(int permits) : _b(permits) {}
+
+  Task acquire() {
+    for (;;) {
+      int32_t cur = _b.value.load(std::memory_order_acquire);
+      if (cur > 0 &&
+          _b.value.compare_exchange_weak(cur, cur - 1,
+                                         std::memory_order_acquire,
+                                         std::memory_order_relaxed)) {
+        co_return;
+      }
+      if (cur > 0) continue;          // CAS raced; retry the grab
+      co_await _b.wait(cur);          // park while empty
+    }
+  }
+
+  bool try_acquire() {
+    int32_t cur = _b.value.load(std::memory_order_acquire);
+    while (cur > 0) {
+      if (_b.value.compare_exchange_weak(cur, cur - 1,
+                                         std::memory_order_acquire,
+                                         std::memory_order_relaxed)) {
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void release(int n = 1) {
+    _b.value.fetch_add(n, std::memory_order_acq_rel);
+    _b.wake(n);
+  }
+
+  int permits() const { return _b.value.load(std::memory_order_acquire); }
+
+ private:
+  Butex _b;
+};
+
+// Reader/writer lock: state -1 = writer, 0 = free, n>0 = n readers
+// (reference bthread/rwlock.cpp role; simple reader-preferring form).
+class FiberRwLock {
+ public:
+  Task lock_shared() {
+    for (;;) {
+      int32_t s = _b.value.load(std::memory_order_acquire);
+      if (s >= 0 &&
+          _b.value.compare_exchange_weak(s, s + 1,
+                                         std::memory_order_acquire,
+                                         std::memory_order_relaxed)) {
+        co_return;
+      }
+      if (s >= 0) continue;           // CAS raced; retry
+      co_await _b.wait(s);            // writer holds it: park
+    }
+  }
+
+  void unlock_shared() {
+    if (_b.value.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      _b.wake_all();                  // last reader out: writers may go
+    }
+  }
+
+  Task lock() {
+    for (;;) {
+      int32_t s = 0;
+      if (_b.value.compare_exchange_weak(s, -1, std::memory_order_acquire,
+                                         std::memory_order_relaxed)) {
+        co_return;
+      }
+      if (s == 0) continue;  // spurious CAS failure (weak, LL/SC): the
+                             // lock IS free — parking on expected==0
+                             // would sleep forever on an unheld lock
+      co_await _b.wait(s);   // s holds the observed non-zero value
+    }
+  }
+
+  void unlock() {
+    _b.value.store(0, std::memory_order_release);
+    _b.wake_all();
+  }
+
+ private:
   Butex _b{0};
 };
 

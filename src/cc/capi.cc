@@ -467,6 +467,113 @@ Fiber mutex_stress_body(MutexStress* s, int iters) {
   unref(s);
 }
 
+// Bounded producer/consumer over FiberCond (wait-morphing via
+// butex_requeue) + FiberMutex — the classic cond-var correctness mill.
+struct CondPipe {
+  bthread::FiberMutex mu;
+  bthread::FiberCond not_empty;
+  bthread::FiberCond not_full;
+  std::vector<int64_t> q;
+  size_t cap = 8;
+  int64_t produced = 0, consumed = 0, checksum = 0;
+  int64_t total;
+  CountdownEvent done;
+  std::atomic<int> refs;
+  CondPipe(int64_t n, int parties) : total(n), done(parties),
+                                     refs(parties + 1) {}
+};
+
+Fiber cond_producer(CondPipe* p) {
+  for (int64_t i = 0; i < p->total; ++i) {
+    co_await p->mu.lock();
+    while (p->q.size() >= p->cap) {
+      co_await p->not_full.wait(p->mu);
+    }
+    p->q.push_back(i);
+    ++p->produced;
+    p->not_empty.notify_all(p->mu);   // held: wait-morph contract
+    p->mu.unlock();
+  }
+  p->done.signal();
+  unref(p);
+}
+
+Fiber cond_consumer(CondPipe* p) {
+  for (int64_t i = 0; i < p->total; ++i) {
+    co_await p->mu.lock();
+    while (p->q.empty()) {
+      co_await p->not_empty.wait(p->mu);
+    }
+    p->checksum += p->q.back();
+    p->q.pop_back();
+    ++p->consumed;
+    p->not_full.notify_all(p->mu);
+    p->mu.unlock();
+  }
+  p->done.signal();
+  unref(p);
+}
+
+// Semaphore as a permit-bounded critical region: at most `permits`
+// fibers inside at once; returns max concurrency observed.
+struct SemProbe {
+  bthread::FiberSemaphore sem;
+  std::atomic<int> inside{0};
+  std::atomic<int> max_inside{0};
+  CountdownEvent done;
+  std::atomic<int> refs;
+  SemProbe(int permits, int fibers) : sem(permits), done(fibers),
+                                      refs(fibers + 1) {}
+};
+
+Fiber sem_body(SemProbe* s, int iters) {
+  for (int i = 0; i < iters; ++i) {
+    co_await s->sem.acquire();
+    const int now = s->inside.fetch_add(1, std::memory_order_acq_rel) + 1;
+    int prev = s->max_inside.load(std::memory_order_relaxed);
+    while (now > prev &&
+           !s->max_inside.compare_exchange_weak(prev, now)) {
+    }
+    co_await bthread::fiber_sleep_us(0);
+    s->inside.fetch_sub(1, std::memory_order_acq_rel);
+    s->sem.release();
+  }
+  s->done.signal();
+  unref(s);
+}
+
+// RwLock: readers verify the invariant datum is stable; one writer
+// mutates it under the exclusive lock.
+struct RwProbe {
+  bthread::FiberRwLock rw;
+  int64_t a = 0, b = 0;           // invariant: a == b
+  std::atomic<int64_t> violations{0};
+  CountdownEvent done;
+  std::atomic<int> refs;
+  explicit RwProbe(int parties) : done(parties), refs(parties + 1) {}
+};
+
+Fiber rw_reader(RwProbe* p, int iters) {
+  for (int i = 0; i < iters; ++i) {
+    co_await p->rw.lock_shared();
+    if (p->a != p->b) p->violations.fetch_add(1);
+    p->rw.unlock_shared();
+  }
+  p->done.signal();
+  unref(p);
+}
+
+Fiber rw_writer(RwProbe* p, int iters) {
+  for (int i = 0; i < iters; ++i) {
+    co_await p->rw.lock();
+    ++p->a;
+    ++p->b;                        // non-atomic on purpose: the lock is
+    p->rw.unlock();                // the synchronization under test
+  }
+  p->done.signal();
+  unref(p);
+}
+
 struct SleepProbe {
   CountdownEvent done{1};
   std::atomic<int> refs{2};
@@ -530,6 +637,43 @@ int64_t brpc_fiber_mutex_stress(int fibers, int iters, int timeout_ms) {
   const bool ok = poll_countdown(&s->done, timeout_ms);
   const int64_t v = ok ? s->counter : -1;
   unref(s);
+  return v;
+}
+
+// FiberCond producer/consumer: returns the checksum (== n*(n-1)/2 iff
+// every produced item was consumed exactly once), or -1 on timeout.
+int64_t brpc_fiber_cond_stress(int64_t n, int timeout_ms) {
+  auto* p = new CondPipe(n, 2);
+  cond_producer(p).spawn();
+  cond_consumer(p).spawn();
+  const bool ok = poll_countdown(&p->done, timeout_ms);
+  const int64_t v = ok ? p->checksum : -1;
+  unref(p);
+  return v;
+}
+
+// FiberSemaphore: `fibers` contenders over `permits` permits; returns the
+// max concurrency observed inside the region (must be <= permits), or -1.
+int brpc_fiber_sem_stress(int permits, int fibers, int iters,
+                          int timeout_ms) {
+  auto* s = new SemProbe(permits, fibers);
+  for (int i = 0; i < fibers; ++i) sem_body(s, iters).spawn();
+  const bool ok = poll_countdown(&s->done, timeout_ms);
+  const int v = ok ? s->max_inside.load() : -1;
+  unref(s);
+  return v;
+}
+
+// FiberRwLock: `readers` checking an invariant vs 1 writer mutating it;
+// returns invariant violations seen under shared locks (must be 0), -1
+// on timeout.
+int64_t brpc_fiber_rw_stress(int readers, int iters, int timeout_ms) {
+  auto* p = new RwProbe(readers + 1);
+  for (int i = 0; i < readers; ++i) rw_reader(p, iters).spawn();
+  rw_writer(p, iters).spawn();
+  const bool ok = poll_countdown(&p->done, timeout_ms);
+  const int64_t v = ok ? p->violations.load() : -1;
+  unref(p);
   return v;
 }
 

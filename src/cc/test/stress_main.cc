@@ -280,6 +280,102 @@ static void stress_socket_writes() {
   printf("socket: 8 rounds of 4-producer writes vs SetFailed survived\n");
 }
 
+// ---- 7. FiberCond wait-morphing + semaphore + rwlock ----
+struct CondState {
+  FiberMutex mu;
+  bthread::FiberCond cv;
+  int turn = 0;
+  CountdownEvent done{4};
+  std::atomic<int> refs{5};
+};
+static Fiber cond_round_robin(CondState* s, int me, int parties, int laps) {
+  for (int i = 0; i < laps; ++i) {
+    co_await s->mu.lock();
+    while (s->turn % parties != me) {
+      co_await s->cv.wait(s->mu);
+    }
+    ++s->turn;
+    s->cv.notify_all(s->mu);
+    s->mu.unlock();
+  }
+  s->done.signal();
+  if (s->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete s;
+}
+static void stress_cond_sem_rw() {
+  auto* s = new CondState();
+  const int parties = 4, laps = 5000;
+  for (int i = 0; i < parties; ++i)
+    cond_round_robin(s, i, parties, laps).spawn();
+  wait_countdown(&s->done, 120);
+  CHECK_EQ(s->turn, parties * laps);
+  if (s->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete s;
+  printf("fiber_cond: %d round-robin handoffs in order\n", parties * laps);
+
+  struct SemState {
+    bthread::FiberSemaphore sem{2};
+    std::atomic<int> inside{0};
+    std::atomic<int> overflows{0};
+    CountdownEvent done{16};
+    std::atomic<int> refs{17};
+  };
+  auto* q = new SemState();
+  for (int i = 0; i < 16; ++i) {
+    [](SemState* q, int iters) -> Fiber {
+      for (int k = 0; k < iters; ++k) {
+        co_await q->sem.acquire();
+        if (q->inside.fetch_add(1, std::memory_order_acq_rel) + 1 > 2) {
+          q->overflows.fetch_add(1);
+        }
+        co_await bthread::fiber_sleep_us(0);
+        q->inside.fetch_sub(1, std::memory_order_acq_rel);
+        q->sem.release();
+      }
+      q->done.signal();
+      if (q->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete q;
+    }(q, 1000).spawn();
+  }
+  wait_countdown(&q->done, 120);
+  CHECK_EQ(q->overflows.load(), 0);
+  if (q->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete q;
+  printf("fiber_sem: 16 fibers x 1000 never exceeded 2 permits\n");
+
+  struct RwState {
+    bthread::FiberRwLock rw;
+    int64_t a = 0, b = 0;          // invariant: a == b under any lock
+    std::atomic<int64_t> violations{0};
+    CountdownEvent done{10};
+    std::atomic<int> refs{11};
+  };
+  auto* r = new RwState();
+  for (int i = 0; i < 8; ++i) {
+    [](RwState* r, int iters) -> Fiber {
+      for (int k = 0; k < iters; ++k) {
+        co_await r->rw.lock_shared();
+        if (r->a != r->b) r->violations.fetch_add(1);
+        r->rw.unlock_shared();
+      }
+      r->done.signal();
+      if (r->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete r;
+    }(r, 4000).spawn();
+  }
+  for (int i = 0; i < 2; ++i) {
+    [](RwState* r, int iters) -> Fiber {
+      for (int k = 0; k < iters; ++k) {
+        co_await r->rw.lock();
+        ++r->a;
+        ++r->b;                     // non-atomic: the lock is the sync
+        r->rw.unlock();
+      }
+      r->done.signal();
+      if (r->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete r;
+    }(r, 4000).spawn();
+  }
+  wait_countdown(&r->done, 120);
+  CHECK_EQ(r->violations.load(), 0);
+  if (r->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete r;
+  printf("fiber_rwlock: 8 readers + 2 writers, invariant held\n");
+}
+
 int main() {
   // writes to a peer that parse-error-closed must surface as EPIPE, not
   // kill the process (the Python embedding ignores SIGPIPE for us; a
@@ -293,6 +389,7 @@ int main() {
   stress_executor();
   stress_butex();
   stress_fiber_mutex();
+  stress_cond_sem_rw();
   stress_timer();
   stress_socket_writes();
   printf("ALL STRESS SECTIONS PASSED\n");

@@ -137,3 +137,23 @@ class TestButexCounters:
         t1 = ctypes.c_int64()
         core.brpc_fiber_counters(None, None, ctypes.byref(t1), None)
         assert t1.value > t0.value   # sleep rides the timeout path
+
+
+class TestFiberSyncPrimitives:
+    """FiberCond (wait-morphing via butex requeue), FiberSemaphore,
+    FiberRwLock — the rest of the reference's bthread synchronization
+    surface (mutex.cpp / condition_variable.cpp / rwlock.cpp /
+    semaphore) on the coroutine runtime."""
+
+    def test_cond_producer_consumer(self):
+        n = 20_000
+        checksum = core.brpc_fiber_cond_stress(n, 60_000)
+        assert checksum == n * (n - 1) // 2, checksum
+
+    def test_semaphore_bounds_concurrency(self):
+        got = core.brpc_fiber_sem_stress(3, 32, 500, 60_000)
+        assert 1 <= got <= 3, f"semaphore admitted {got} > 3 permits"
+
+    def test_rwlock_invariant(self):
+        violations = core.brpc_fiber_rw_stress(8, 3000, 60_000)
+        assert violations == 0, f"{violations} invariant breaks"
