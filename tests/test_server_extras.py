@@ -233,9 +233,29 @@ def test_tagged_requests_drain_on_join_and_server_restarts():
     s = brpc.Server()
     s.add_service(Slow(), tag="drain", tag_workers=1)
     s.start("127.0.0.1", 0)
+    import ctypes
+
+    from brpc_tpu._core import core
+
+    def fast_calls():
+        # MONOTONIC count of requests delivered to Python by the native
+        # fast path — unlike the live _inflight gauge (double-counted
+        # while running, decremented at completion), this can only grow,
+        # so "delta >= 4" really means all four requests were accepted
+        n = ctypes.c_int64()
+        p = ctypes.c_int64()
+        core.brpc_rpc_counters(ctypes.byref(n), ctypes.byref(p))
+        return p.value
+
+    base = fast_calls()
     ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=10000)
     cntls = [ch.call("DrainSlow", "Crunch", b"") for _ in range(4)]
-    _time.sleep(0.05)           # 1 running, 3 queued in the tag pool
+    # a fixed sleep flakes under load: a request still in flight at
+    # stop() would be ELOGOFF'd
+    deadline = _time.monotonic() + 5
+    while fast_calls() - base < 4 and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert fast_calls() - base >= 4, "not all requests accepted before stop"
     s.stop()
     s.join()                    # must wait for the QUEUED ones too
     for c in cntls:
