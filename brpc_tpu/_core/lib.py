@@ -224,6 +224,18 @@ _sigs = {
                                                 ctypes.c_int]),
     "brpc_fiber_cond_stress": (ctypes.c_int64, [ctypes.c_int64,
                                                 ctypes.c_int]),
+    # CallId (bthread_id analog, src/cc/bthread/id.h)
+    "brpc_id_create": (ctypes.c_uint64, [ctypes.c_uint32]),
+    "brpc_id_valid": (ctypes.c_int, [ctypes.c_uint64]),
+    "brpc_id_trylock": (ctypes.c_int, [ctypes.c_uint64]),
+    "brpc_id_unlock": (ctypes.c_int, [ctypes.c_uint64]),
+    "brpc_id_unlock_and_destroy": (ctypes.c_int, [ctypes.c_uint64]),
+    "brpc_id_join": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_int]),
+    "brpc_id_live_count": (ctypes.c_int64, []),
+    "brpc_id_lock_stress": (ctypes.c_int64, [ctypes.c_int, ctypes.c_int,
+                                             ctypes.c_int]),
+    "brpc_id_destroy_stress": (ctypes.c_int64, [ctypes.c_int,
+                                                ctypes.c_int]),
     "brpc_fiber_sem_stress": (ctypes.c_int, [ctypes.c_int, ctypes.c_int,
                                              ctypes.c_int, ctypes.c_int]),
     "brpc_fiber_rw_stress": (ctypes.c_int64, [ctypes.c_int, ctypes.c_int,

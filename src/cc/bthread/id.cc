@@ -1,0 +1,179 @@
+#include "bthread/id.h"
+
+#include <atomic>
+#include <chrono>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "butil/resource_pool.h"
+
+namespace bthread {
+
+namespace {
+
+// One pooled slot.  `first_ver` is the base of the LIVE version range;
+// destroy advances it past the whole range, invalidating every
+// outstanding handle in one store (the ABA-proof property,
+// reference id.cpp Id::first_ver/locked_ver design).
+struct IdSlot {
+  std::mutex mu;                 // guards the fields below (slow path)
+  uint32_t first_ver = 1;        // live range = [first_ver, first_ver+range)
+  uint32_t range = 0;            // 0 = dead
+  bool locked = false;
+  void* data = nullptr;
+  Butex lock_butex;              // word bumps on unlock; lockers park
+  Butex join_butex;              // word bumps on destroy; joiners park
+};
+
+butil::ResourcePool<IdSlot>* pool() {
+  return butil::ResourcePool<IdSlot>::singleton();
+}
+
+std::atomic<int64_t> g_live{0};
+
+inline IdSlot* slot_of(CallId id, uint32_t* ver) {
+  *ver = (uint32_t)(id >> 32);
+  return pool()->address((uint32_t)id);
+}
+
+inline bool version_live(const IdSlot* s, uint32_t ver) {
+  return s->range != 0 && ver >= s->first_ver &&
+         ver < s->first_ver + s->range;
+}
+
+}  // namespace
+
+CallId id_create(void* data, uint32_t range) {
+  if (range == 0) range = 1;
+  uint32_t slot_index = 0;
+  IdSlot* s = pool()->get_resource(&slot_index);
+  if (s == nullptr) return INVALID_CALL_ID;
+  std::lock_guard<std::mutex> g(s->mu);
+  s->range = range;
+  s->locked = false;
+  s->data = data;
+  g_live.fetch_add(1, std::memory_order_relaxed);
+  return ((CallId)s->first_ver << 32) | slot_index;
+}
+
+bool id_valid(CallId id) {
+  uint32_t ver;
+  IdSlot* s = slot_of(id, &ver);
+  if (s == nullptr) return false;
+  std::lock_guard<std::mutex> g(s->mu);
+  return version_live(s, ver);
+}
+
+int id_trylock(CallId id, void** data_out) {
+  uint32_t ver;
+  IdSlot* s = slot_of(id, &ver);
+  if (s == nullptr) return ID_EINVAL;
+  std::lock_guard<std::mutex> g(s->mu);
+  if (!version_live(s, ver)) return ID_EINVAL;
+  if (s->locked) return ID_EBUSY;
+  s->locked = true;
+  if (data_out != nullptr) *data_out = s->data;
+  return ID_OK;
+}
+
+Task id_lock(CallId id, int* rc_out, void** data_out) {
+  uint32_t ver;
+  IdSlot* s = slot_of(id, &ver);
+  if (s == nullptr) {
+    *rc_out = ID_EINVAL;
+    co_return;
+  }
+  for (;;) {
+    int32_t seq;
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      if (!version_live(s, ver)) {
+        *rc_out = ID_EINVAL;
+        co_return;
+      }
+      if (!s->locked) {
+        s->locked = true;
+        if (data_out != nullptr) *data_out = s->data;
+        *rc_out = ID_OK;
+        co_return;
+      }
+      // snapshot the wake sequence UNDER the slot lock: an unlock after
+      // we release the mutex bumps the word and the park mismatches
+      seq = s->lock_butex.value.load(std::memory_order_acquire);
+    }
+    co_await s->lock_butex.wait(seq);
+  }
+}
+
+int id_unlock(CallId id) {
+  uint32_t ver;
+  IdSlot* s = slot_of(id, &ver);
+  if (s == nullptr) return ID_EINVAL;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (!version_live(s, ver) || !s->locked) return ID_EINVAL;
+    s->locked = false;
+    s->lock_butex.value.fetch_add(1, std::memory_order_acq_rel);
+  }
+  s->lock_butex.wake(1);
+  return ID_OK;
+}
+
+int id_unlock_and_destroy(CallId id) {
+  uint32_t ver;
+  IdSlot* s = slot_of(id, &ver);
+  if (s == nullptr) return ID_EINVAL;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    if (!version_live(s, ver)) return ID_EINVAL;
+    if (!s->locked) return ID_EPERM;   // destroy IS an unlock: the caller
+                                       // must hold the lock, or an active
+                                       // critical section could be ripped
+                                       // out from under its owner
+                                       // (reference id.cpp contract)
+    // advance past the whole range: every handle in [first_ver,
+    // first_ver+range) goes stale in one step.  Keep versions growing so
+    // a recycled slot never reuses an old version (ABA-proof).
+    s->first_ver += s->range;
+    s->range = 0;
+    s->locked = false;
+    s->data = nullptr;
+    s->lock_butex.value.fetch_add(1, std::memory_order_acq_rel);
+    s->join_butex.value.fetch_add(1, std::memory_order_acq_rel);
+  }
+  s->lock_butex.wake_all();    // parked lockers resume, see stale, EINVAL
+  s->join_butex.wake_all();    // joiners proceed
+  g_live.fetch_sub(1, std::memory_order_relaxed);
+  pool()->return_resource((uint32_t)id);
+  return ID_OK;
+}
+
+Task id_join(CallId id) {
+  uint32_t ver;
+  IdSlot* s = slot_of(id, &ver);
+  if (s == nullptr) co_return;
+  for (;;) {
+    int32_t seq;
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      if (!version_live(s, ver)) co_return;   // destroyed (or never live)
+      seq = s->join_butex.value.load(std::memory_order_acquire);
+    }
+    co_await s->join_butex.wait(seq);
+  }
+}
+
+int id_join_blocking(CallId id, int timeout_ms) {
+  const auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeout_ms);
+  while (id_valid(id)) {
+    if (std::chrono::steady_clock::now() > deadline) return ID_ETIMEDOUT;
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+  }
+  return ID_OK;
+}
+
+int64_t id_live_count() { return g_live.load(std::memory_order_relaxed); }
+
+}  // namespace bthread

@@ -1,0 +1,73 @@
+// CallId — versioned lockable handles (reference src/bthread/id.{h,cpp},
+// list_of_abafree_id.h; SURVEY §2.2 "bthread_id").
+//
+// The reference's trickiest primitive: a 64-bit handle = version⊕slot
+// over a pool.  One live handle maps to one RPC call; lock() serializes
+// access to the call's state; unlock_and_destroy() bumps the version so
+// every outstanding copy of the handle goes stale ATOMICALLY (the
+// ABA-proof property — a late response addressing a finished call fails
+// validation instead of racing the next call that reused the slot);
+// join() parks until destruction.  RANGED ids give each retry attempt
+// its own id value addressing the same slot (controller.h:692-703), so a
+// stale attempt can be told apart from the live one by value while both
+// still reach the same call state.
+//
+// Lockers and joiners park as coroutine fibers on the slot's butexes
+// (the reference parks bthreads the same way).  Non-blocking try_ and
+// polling variants serve pthread/Python callers.
+#pragma once
+
+#include <cstdint>
+
+#include "bthread/butex.h"
+#include "bthread/fiber.h"
+
+namespace bthread {
+
+typedef uint64_t CallId;
+constexpr CallId INVALID_CALL_ID = 0;
+
+// Error codes (subset of errno-style, matching the reference's returns).
+enum IdError {
+  ID_OK = 0,
+  ID_EPERM = 1,      // unlock_and_destroy without holding the lock
+  ID_EINVAL = 22,    // stale/invalid handle
+  ID_EBUSY = 16,     // try_lock: locked by someone else
+  ID_ETIMEDOUT = 110,
+};
+
+// Create a live handle covering `range` consecutive versions (range >= 1);
+// data rides the slot and comes back from lock().
+CallId id_create(void* data = nullptr, uint32_t range = 1);
+
+// The id addressing version v within [id, id+range): id + k.
+// (Plain arithmetic — provided for symmetry with the reference's
+// bthread_id_ranged API.)
+
+// Validity check (cheap, racy-by-nature like the reference's).
+bool id_valid(CallId id);
+
+// Lock the slot through any id in the live range.  Returns ID_OK with
+// *data_out set, or ID_EINVAL when stale.  Fiber-awaitable.
+Task id_lock(CallId id, int* rc_out, void** data_out = nullptr);
+// Non-blocking variant for pthread/Python callers.
+int id_trylock(CallId id, void** data_out = nullptr);
+
+int id_unlock(CallId id);
+
+// Unlock + kill every version in the range: outstanding handles go
+// stale, parked lockers resume with ID_EINVAL, joiners wake.  The caller
+// MUST hold the lock (ID_EPERM otherwise) — destroy races an active
+// critical section otherwise.
+int id_unlock_and_destroy(CallId id);
+
+// Park until the id's range is destroyed (returns immediately if
+// already stale).  Fiber-awaitable.
+Task id_join(CallId id);
+// Polling join for pthread/Python callers; ID_OK or ID_ETIMEDOUT.
+int id_join_blocking(CallId id, int timeout_ms);
+
+// live slots (tests / console)
+int64_t id_live_count();
+
+}  // namespace bthread

@@ -58,11 +58,18 @@ class ResourcePool {
     }
   }
 
-  // O(1) slot → object.  Valid for any slot ever returned by get_resource.
+  // O(1) slot → object.  Valid for any slot ever returned by
+  // get_resource; an arbitrary/corrupt slot (a handle forged or damaged
+  // upstream) returns nullptr instead of dereferencing an unallocated
+  // chunk — versioned-handle validity checks depend on this being safe
+  // to call with garbage.
   // Lock-free: the chunk table is a fixed array of pointers published with
   // release stores, so it never moves under a reader.
   T* address(uint32_t slot) {
-    Chunk* c = _chunks[slot / kChunkItems].load(std::memory_order_acquire);
+    const uint32_t chunk_idx = slot / kChunkItems;
+    if (chunk_idx >= kMaxChunks) return nullptr;
+    Chunk* c = _chunks[chunk_idx].load(std::memory_order_acquire);
+    if (c == nullptr) return nullptr;
     return &c->items[slot % kChunkItems];
   }
 

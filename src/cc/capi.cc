@@ -380,6 +380,7 @@ int brpc_connect_rpc(const char* host, int port, brpc_message_cb on_msg,
 #include <chrono>
 
 #include "bthread/fiber.h"
+#include "bthread/id.h"
 
 namespace {
 
@@ -674,6 +675,122 @@ int64_t brpc_fiber_rw_stress(int readers, int iters, int timeout_ms) {
   const bool ok = poll_countdown(&p->done, timeout_ms);
   const int64_t v = ok ? p->violations.load() : -1;
   unref(p);
+  return v;
+}
+
+// ---- CallId (bthread_id analog; bthread/id.h) ----
+
+}  // extern "C"
+
+namespace {
+
+struct IdLockSt {
+  uint64_t id;
+  int64_t counter = 0;
+  CountdownEvent done;
+  std::atomic<int> refs;
+  IdLockSt(int n) : done(n), refs(n + 1) {}
+};
+
+Fiber id_lock_body(IdLockSt* st, int iters) {
+  for (int k = 0; k < iters; ++k) {
+    int rc = -1;
+    co_await bthread::id_lock(st->id, &rc);
+    if (rc == bthread::ID_OK) {
+      ++st->counter;
+      bthread::id_unlock(st->id);
+    }
+  }
+  st->done.signal();
+  unref(st);
+}
+
+struct IdDestroySt {
+  uint64_t id;
+  std::atomic<int64_t> einval{0};
+  std::atomic<int64_t> parked{0};
+  CountdownEvent done;
+  std::atomic<int> refs;
+  IdDestroySt(int n) : done(n + 1), refs(n + 2) {}
+};
+
+Fiber id_destroy_locker(IdDestroySt* st) {
+  st->parked.fetch_add(1, std::memory_order_acq_rel);
+  int rc = -1;
+  co_await bthread::id_lock(st->id, &rc);   // parks: id is held
+  if (rc == bthread::ID_EINVAL) st->einval.fetch_add(1);
+  st->done.signal();
+  unref(st);
+}
+
+Fiber id_destroy_joiner(IdDestroySt* st) {
+  co_await bthread::id_join(st->id);
+  st->done.signal();
+  unref(st);
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t brpc_id_create(uint32_t range) {
+  return bthread::id_create(nullptr, range);
+}
+int brpc_id_valid(uint64_t id) { return bthread::id_valid(id) ? 1 : 0; }
+int brpc_id_trylock(uint64_t id) { return bthread::id_trylock(id); }
+int brpc_id_unlock(uint64_t id) { return bthread::id_unlock(id); }
+int brpc_id_unlock_and_destroy(uint64_t id) {
+  return bthread::id_unlock_and_destroy(id);
+}
+int brpc_id_join(uint64_t id, int timeout_ms) {
+  return bthread::id_join_blocking(id, timeout_ms);
+}
+int64_t brpc_id_live_count() { return bthread::id_live_count(); }
+
+// Locker storm: `fibers` fibers each lock/increment/unlock the id
+// `iters` times (fiber-awaitable id_lock under contention); returns the
+// protected counter, or -1 on timeout.
+int64_t brpc_id_lock_stress(int fibers, int iters, int timeout_ms) {
+  auto* st = new IdLockSt(fibers);
+  st->id = bthread::id_create(nullptr, 1);
+  for (int i = 0; i < fibers; ++i) id_lock_body(st, iters).spawn();
+  const bool ok = poll_countdown(&st->done, timeout_ms);
+  int64_t v = -1;
+  if (ok) v = st->counter;
+  // destroy requires holding the lock; best-effort on the timeout path
+  // too so the slot is not leaked out of the pool
+  if (bthread::id_trylock(st->id) == bthread::ID_OK) {
+    bthread::id_unlock_and_destroy(st->id);
+  }
+  unref(st);
+  return v;
+}
+
+// Destroy-under-contention: lockers park on a HELD id; destroy flushes
+// them all out with EINVAL and wakes the joiners.  Returns the number of
+// lockers that saw EINVAL (must be `fibers`), or -1 on timeout.
+int64_t brpc_id_destroy_stress(int fibers, int timeout_ms) {
+  auto* st = new IdDestroySt(fibers);
+  st->id = bthread::id_create(nullptr, 1);
+  if (bthread::id_trylock(st->id) != bthread::ID_OK) {
+    unref(st);
+    return -1;
+  }
+  for (int i = 0; i < fibers; ++i) id_destroy_locker(st).spawn();
+  // joiner fiber: must wake when destroy runs
+  id_destroy_joiner(st).spawn();
+  // give lockers a moment to reach the park, then pull the rug
+  const auto until = std::chrono::steady_clock::now() +
+                     std::chrono::milliseconds(500);
+  while (st->parked.load() < fibers &&
+         std::chrono::steady_clock::now() < until) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  bthread::id_unlock_and_destroy(st->id);   // we hold the trylock above
+  const bool ok = poll_countdown(&st->done, timeout_ms);
+  const int64_t v = ok ? st->einval.load() : -1;
+  unref(st);
   return v;
 }
 

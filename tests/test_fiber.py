@@ -12,6 +12,7 @@ two properties that make it an M:N runtime and not a thread pool:
      test/bthread_ping_pong_unittest.cpp, bthread_butex_unittest.cpp).
 """
 import os
+import threading
 import time
 
 import pytest
@@ -157,3 +158,84 @@ class TestFiberSyncPrimitives:
     def test_rwlock_invariant(self):
         violations = core.brpc_fiber_rw_stress(8, 3000, 60_000)
         assert violations == 0, f"{violations} invariant breaks"
+
+
+class TestCallId:
+    """CallId — the bthread_id analog (reference src/bthread/id.{h,cpp}):
+    versioned lockable handles where destroy invalidates every
+    outstanding copy atomically (ABA-proof), with ranged versions for
+    retry attempts (controller.h:692-703)."""
+
+    def test_lifecycle(self):
+        i = core.brpc_id_create(1)
+        assert i != 0 and core.brpc_id_valid(i)
+        assert core.brpc_id_trylock(i) == 0
+        assert core.brpc_id_trylock(i) == 16        # EBUSY
+        assert core.brpc_id_unlock(i) == 0
+        assert core.brpc_id_trylock(i) == 0         # relockable
+        assert core.brpc_id_unlock_and_destroy(i) == 0
+        assert not core.brpc_id_valid(i)
+
+    def test_destroy_requires_holding_the_lock(self):
+        i = core.brpc_id_create(1)
+        assert core.brpc_id_unlock_and_destroy(i) == 1     # EPERM: unheld
+        assert core.brpc_id_valid(i)                        # still alive
+        assert core.brpc_id_trylock(i) == 0
+        assert core.brpc_id_unlock_and_destroy(i) == 0
+
+    def test_destroy_invalidates_all_copies(self):
+        i = core.brpc_id_create(1)
+        assert core.brpc_id_trylock(i) == 0
+        assert core.brpc_id_unlock_and_destroy(i) == 0
+        assert not core.brpc_id_valid(i)
+        assert core.brpc_id_trylock(i) == 22        # EINVAL: stale
+        assert core.brpc_id_unlock(i) == 22
+
+    def test_ranged_ids_share_one_slot(self):
+        """id..id+range-1 all address the same call (each retry attempt
+        gets its own value); destroy kills the whole range at once."""
+        base = core.brpc_id_create(4)
+        for k in range(4):
+            assert core.brpc_id_valid(base + (k << 32)), k
+        assert not core.brpc_id_valid(base + (4 << 32))
+        assert core.brpc_id_trylock(base + (2 << 32)) == 0
+        assert core.brpc_id_trylock(base + (3 << 32)) == 16   # same slot
+        assert core.brpc_id_unlock_and_destroy(base + (2 << 32)) == 0
+        for k in range(4):
+            assert not core.brpc_id_valid(base + (k << 32)), k
+
+    def test_slot_reuse_is_aba_proof(self):
+        """A handle from before destroy must stay stale even after the
+        slot is recycled into a new id."""
+        old = core.brpc_id_create(1)
+        assert core.brpc_id_trylock(old) == 0
+        core.brpc_id_unlock_and_destroy(old)
+        ids = [core.brpc_id_create(1) for _ in range(64)]
+        try:
+            assert not core.brpc_id_valid(old)
+            assert core.brpc_id_trylock(old) == 22
+        finally:
+            for i in ids:
+                core.brpc_id_trylock(i)
+                core.brpc_id_unlock_and_destroy(i)
+
+    def test_join_wakes_on_destroy(self):
+        i = core.brpc_id_create(1)
+        assert core.brpc_id_trylock(i) == 0
+        done = []
+        t = threading.Thread(
+            target=lambda: done.append(core.brpc_id_join(i, 10_000)))
+        t.start()
+        time.sleep(0.1)
+        assert not done                      # joiner parked
+        core.brpc_id_unlock_and_destroy(i)
+        t.join(10)
+        assert done == [0]
+
+    def test_lock_storm(self):
+        total = core.brpc_id_lock_stress(32, 500, 60_000)
+        assert total == 32 * 500, total
+
+    def test_destroy_under_contention(self):
+        einval = core.brpc_id_destroy_stress(64, 60_000)
+        assert einval == 64, einval
