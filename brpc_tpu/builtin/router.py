@@ -9,6 +9,7 @@ from __future__ import annotations
 import traceback
 from urllib.parse import parse_qs, urlparse
 
+from brpc_tpu.butil.containers import CaseIgnoredDict, MRUCache
 from brpc_tpu.rpc.transport import Transport
 
 
@@ -22,10 +23,12 @@ class HttpRequest:
         u = urlparse(target)
         self.path = u.path
         self.query = {k: v[0] for k, v in parse_qs(u.query).items()}
-        self.headers = {}
+        # case-insensitive lookup, original casing preserved (the
+        # case_ignored_flat_map slot backing the reference's HttpHeader)
+        self.headers = CaseIgnoredDict()
         for ln in lines[1:]:
             k, _, v = ln.decode("latin1").partition(":")
-            self.headers[k.strip().lower()] = v.strip()
+            self.headers[k.strip()] = v.strip()
 
 
 def http_response(status: int, body: bytes | str,
@@ -45,10 +48,18 @@ def http_response(status: int, body: bytes | str,
 
 
 class HttpRouter:
+    _MISS = object()   # sentinel: "path not yet resolved" (None is a
+                       # valid, cacheable "no prefix route" outcome)
+
     def __init__(self, server):
         self.server = server
         from brpc_tpu.builtin.services import build_routes
         self.routes = build_routes(server)
+        # longest-prefix resolution is a linear scan over every route;
+        # console paths repeat heavily (sparkline polls, pprof subpaths),
+        # so memoize path -> prefix handler.  self.routes is immutable
+        # after build, which is what makes the cache sound.
+        self._prefix_cache = MRUCache(capacity=256)
 
     def handle(self, sid: int, raw: bytes) -> None:
         t = Transport.instance()
@@ -62,11 +73,15 @@ class HttpRouter:
         handler = self.server._http_handlers.get(req.path) or \
             self.routes.get(req.path)
         if handler is None:
-            best = ""
-            for prefix, h in self.routes.items():
-                if len(prefix) > 1 and prefix.endswith("/") and \
-                        req.path.startswith(prefix) and len(prefix) > len(best):
-                    handler, best = h, prefix
+            handler = self._prefix_cache.get(req.path, self._MISS)
+            if handler is self._MISS:
+                handler, best = None, ""
+                for prefix, h in self.routes.items():
+                    if len(prefix) > 1 and prefix.endswith("/") and \
+                            req.path.startswith(prefix) and \
+                            len(prefix) > len(best):
+                        handler, best = h, prefix
+                self._prefix_cache.put(req.path, handler)
             if handler is None and req.path.startswith("/"):
                 # RESTful RPC access: /ServiceName/Method
                 handler = self._try_rpc(req)

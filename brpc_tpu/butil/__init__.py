@@ -1,2 +1,3 @@
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint  # noqa: F401
 from brpc_tpu.butil.doubly_buffered import DoublyBufferedData  # noqa: F401
+from brpc_tpu.butil.containers import CaseIgnoredDict, MRUCache  # noqa: F401

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from brpc_tpu import errors
+from brpc_tpu.butil.containers import CaseIgnoredDict
 from brpc_tpu.rpc.transport import MSG_RAW, Transport
 
 
@@ -29,7 +30,9 @@ class HttpResponse:
     status: int = 0
     reason: str = ""
     version: str = "HTTP/1.1"
-    headers: dict = field(default_factory=dict)   # lower-cased keys
+    # case-insensitive lookup, original casing preserved on iteration
+    # (case_ignored_flat_map slot; reference http_header.h)
+    headers: CaseIgnoredDict = field(default_factory=CaseIgnoredDict)
     body: bytes = b""
 
     def json(self):
@@ -68,7 +71,7 @@ def _parse_head(head: bytes) -> HttpResponse:
         if not ln:
             continue
         k, _, v = ln.decode("latin1").partition(":")
-        r.headers[k.strip().lower()] = v.strip()
+        r.headers[k.strip()] = v.strip()
     return r
 
 

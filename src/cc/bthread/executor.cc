@@ -114,17 +114,41 @@ bool Executor::in_worker() const { return tls_executor == this; }
 
 void Executor::submit(TaskFn fn, void* arg) {
   auto* t = new TaskNode{fn, arg};
-  if (tls_executor == this && tls_worker_index >= 0 &&
-      _workers[tls_worker_index]->rq.push(t)) {
+  const bool is_worker = (tls_executor == this && tls_worker_index >= 0);
+  if (is_worker && _workers[tls_worker_index]->rq.push(t)) {
     // Local fast path still signals so siblings can steal (NOSIGNAL batching
     // would go here; round-1 keeps it simple and always signals once).
     _signals.add(1);
     _pl.signal(1);
     return;
   }
-  {
-    std::lock_guard<std::mutex> g(_remote_mu);
-    _remote.push_back(t);
+  // Remote path: bounded ring.  On full, a FOREIGN thread backpressures
+  // (wake workers, yield, retry — the reference spins its remote push the
+  // same way, task_group start_background<REMOTE>); a WORKER must never
+  // spin waiting for other workers — if every worker is inside submit
+  // (tasks spawning tasks at full backlog) nobody is left to drain, so a
+  // worker whose local AND remote queues are full runs the task inline.
+  // The stopping check lives UNDER the remote mutex: stop_and_join's
+  // final drain takes the same mutex after setting _stopping, so a push
+  // either lands before that drain (and is consumed by it) or observes
+  // _stopping and runs inline — no task can strand in the ring.
+  for (;;) {
+    bool stopped;
+    {
+      std::lock_guard<std::mutex> g(_remote_mu);
+      stopped = _stopping.load(std::memory_order_acquire);
+      if (!stopped && _remote.push(t)) {
+        break;
+      }
+    }
+    if (stopped || is_worker) {
+      t->fn(t->arg);
+      delete t;
+      _executed.add(1);
+      return;
+    }
+    _pl.signal(2);
+    std::this_thread::yield();
   }
   _signals.add(1);
   _pl.signal(1);
@@ -146,10 +170,8 @@ void Executor::submit(std::function<void()> fn) {
 
 TaskNode* Executor::pop_remote() {
   std::lock_guard<std::mutex> g(_remote_mu);
-  if (_remote.empty()) return nullptr;
-  TaskNode* t = _remote.front();
-  _remote.pop_front();
-  return t;
+  TaskNode* t = nullptr;
+  return _remote.pop(&t) ? t : nullptr;
 }
 
 TaskNode* Executor::steal_task(int self) {
@@ -208,6 +230,20 @@ void Executor::stop_and_join() {
   _pl.stop();
   for (auto* w : _workers)
     if (w->thread.joinable()) w->thread.join();
+  // Final drain: a submit may have pushed into the ring after the last
+  // worker's exit drain but before observing _stopping.  Taking the same
+  // mutex the push used makes this drain see every such task; submits
+  // serialized after it observe _stopping and run inline.
+  for (;;) {
+    TaskNode* t = nullptr;
+    {
+      std::lock_guard<std::mutex> g(_remote_mu);
+      if (!_remote.pop(&t)) break;
+    }
+    t->fn(t->arg);
+    delete t;
+    _executed.add(1);
+  }
 }
 
 static std::mutex g_global_mu;

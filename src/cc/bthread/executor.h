@@ -19,12 +19,12 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
-#include <deque>
 #include <functional>
 #include <mutex>
 #include <thread>
 #include <vector>
 
+#include "butil/containers.h"
 #include "bvar/combiner.h"
 
 namespace bthread {
@@ -112,8 +112,13 @@ class Executor {
   std::string _tag;
   std::vector<Worker*> _workers;
   ParkingLot _pl;
+  // Remote submissions: bounded ring under a mutex, the reference's
+  // RemoteTaskQueue shape (task_group.h:261).  A full ring backpressures
+  // the submitter (signal + yield + retry) instead of growing without
+  // bound while workers are wedged.
   std::mutex _remote_mu;
-  std::deque<TaskNode*> _remote;
+  butil::BoundedQueue<TaskNode*> _remote{kRemoteCapacity};
+  static constexpr size_t kRemoteCapacity = 1 << 16;
   std::atomic<bool> _stopping{false};
   bvar::Adder _executed, _steals, _signals;
 };

@@ -33,8 +33,9 @@ butil::ResourcePool<IdSlot>* pool() {
 std::atomic<int64_t> g_live{0};
 
 inline IdSlot* slot_of(CallId id, uint32_t* ver) {
-  *ver = (uint32_t)(id >> 32);
-  return pool()->address((uint32_t)id);
+  const butil::VersionedId v{id};
+  *ver = v.version();
+  return pool()->address(v.slot());
 }
 
 inline bool version_live(const IdSlot* s, uint32_t ver) {
@@ -54,7 +55,7 @@ CallId id_create(void* data, uint32_t range) {
   s->locked = false;
   s->data = data;
   g_live.fetch_add(1, std::memory_order_relaxed);
-  return ((CallId)s->first_ver << 32) | slot_index;
+  return butil::VersionedId::make(s->first_ver, slot_index).value;
 }
 
 bool id_valid(CallId id) {
@@ -145,7 +146,7 @@ int id_unlock_and_destroy(CallId id) {
   s->lock_butex.wake_all();    // parked lockers resume, see stale, EINVAL
   s->join_butex.wake_all();    // joiners proceed
   g_live.fetch_sub(1, std::memory_order_relaxed);
-  pool()->return_resource((uint32_t)id);
+  pool()->return_resource(butil::VersionedId{id}.slot());
   return ID_OK;
 }
 

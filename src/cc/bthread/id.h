@@ -40,9 +40,11 @@ enum IdError {
 // data rides the slot and comes back from lock().
 CallId id_create(void* data = nullptr, uint32_t range = 1);
 
-// The id addressing version v within [id, id+range): id + k.
-// (Plain arithmetic — provided for symmetry with the reference's
-// bthread_id_ranged API.)
+// The id addressing version k (0-based) within the range is
+// id + ((CallId)k << 32): the version lives in the HIGH 32 bits of the
+// handle (butil::VersionedId layout), the slot in the low 32 — attempt
+// ids differ in version while addressing the same slot (the reference's
+// bthread_id_ranged arithmetic, controller.h:692-703).
 
 // Validity check (cheap, racy-by-nature like the reference's).
 bool id_valid(CallId id);

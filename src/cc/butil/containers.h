@@ -1,0 +1,70 @@
+// Small containers (SURVEY.md §2.1 "other containers" row; reference
+// src/butil/containers/bounded_queue.h, mpsc_queue.h).
+//
+// BoundedQueue: fixed-capacity ring over raw storage.  NOT thread-safe —
+// callers bring their own lock, exactly like the reference's
+// RemoteTaskQueue (bounded_queue under the TaskGroup's remote mutex,
+// task_group.h:261).  Used here as Executor's remote submission queue so a
+// burst of foreign-thread submissions is backpressured at a fixed memory
+// bound instead of growing a deque without limit.
+#pragma once
+
+#include <cstddef>
+#include <new>
+#include <utility>
+
+namespace butil {
+
+template <typename T>
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t cap)
+      : _cap(cap),
+        _buf(static_cast<T*>(::operator new[](sizeof(T) * cap))) {}
+
+  BoundedQueue(const BoundedQueue&) = delete;
+  BoundedQueue& operator=(const BoundedQueue&) = delete;
+
+  ~BoundedQueue() {
+    clear();
+    ::operator delete[](_buf);
+  }
+
+  bool push(T v) {
+    if (_size >= _cap) return false;
+    new (&_buf[(_start + _size) % _cap]) T(std::move(v));
+    ++_size;
+    return true;
+  }
+
+  bool pop(T* out) {
+    if (_size == 0) return false;
+    T& slot = _buf[_start];
+    *out = std::move(slot);
+    slot.~T();
+    _start = (_start + 1) % _cap;
+    --_size;
+    return true;
+  }
+
+  void clear() {
+    while (_size > 0) {
+      _buf[_start].~T();
+      _start = (_start + 1) % _cap;
+      --_size;
+    }
+  }
+
+  bool empty() const { return _size == 0; }
+  bool full() const { return _size >= _cap; }
+  size_t size() const { return _size; }
+  size_t capacity() const { return _cap; }
+
+ private:
+  size_t _cap;
+  T* _buf;
+  size_t _start = 0;
+  size_t _size = 0;
+};
+
+}  // namespace butil

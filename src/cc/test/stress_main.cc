@@ -43,6 +43,32 @@
 
 using namespace bthread;
 
+// ---- 0. BoundedQueue: ring arithmetic + value lifetime ----
+static void stress_bounded_queue() {
+  butil::BoundedQueue<int> q(7);
+  int out = 0;
+  CHECK_EQ(q.pop(&out), false);
+  // wrap the ring several times with interleaved push/pop
+  int pushed = 0, popped = 0;
+  for (int round = 0; round < 100; ++round) {
+    while (q.push(pushed)) ++pushed;
+    CHECK_EQ(q.full(), true);
+    CHECK_EQ((long long)q.size(), 7LL);
+    for (int i = 0; i < 4; ++i) {
+      CHECK_EQ(q.pop(&out), true);
+      CHECK_EQ(out, popped);
+      ++popped;
+    }
+  }
+  while (q.pop(&out)) {
+    CHECK_EQ(out, popped);
+    ++popped;
+  }
+  CHECK_EQ(pushed, popped);
+  CHECK_EQ(q.empty(), true);
+  printf("bounded_queue: %d values through a 7-slot ring in order\n", pushed);
+}
+
 // ---- 1. Chase-Lev: owner pops + thieves steal must conserve tasks ----
 static void stress_wsq() {
   WorkStealingQueue q(1024);
@@ -385,6 +411,7 @@ int main() {
   butil::set_min_log_level(3);  // expected parse-error closes are noise here
   Executor::init_global(8);
   (void)Executor::global();
+  stress_bounded_queue();
   stress_wsq();
   stress_executor();
   stress_butex();

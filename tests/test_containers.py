@@ -1,0 +1,121 @@
+"""butil container tests (SURVEY.md §2.1 'other containers' row; reference
+test/flat_map_unittest.cpp case-ignored section + mru_cache usage)."""
+import threading
+
+from brpc_tpu.butil import CaseIgnoredDict, MRUCache
+
+
+class TestCaseIgnoredDict:
+    def test_case_insensitive_lookup(self):
+        d = CaseIgnoredDict()
+        d["Content-Type"] = "text/plain"
+        assert d["content-type"] == "text/plain"
+        assert d["CONTENT-TYPE"] == "text/plain"
+        assert "CoNtEnT-tYpE" in d
+        assert d.get("content-type") == "text/plain"
+
+    def test_preserves_original_casing(self):
+        d = CaseIgnoredDict()
+        d["X-Request-Id"] = "42"
+        d["Content-Length"] = "10"
+        assert list(d) == ["X-Request-Id", "Content-Length"]
+        assert dict(d.items())["X-Request-Id"] == "42"
+
+    def test_last_set_casing_wins(self):
+        d = CaseIgnoredDict()
+        d["accept"] = "a"
+        d["Accept"] = "b"
+        assert len(d) == 1
+        assert d["ACCEPT"] == "b"
+        assert list(d) == ["Accept"]
+
+    def test_delete_and_update(self):
+        d = CaseIgnoredDict({"Host": "x"})
+        del d["hOsT"]
+        assert len(d) == 0
+        d.update({"A": 1, "b": 2})
+        assert d["a"] == 1 and d["B"] == 2
+
+    def test_non_string_keys_pass_through(self):
+        d = CaseIgnoredDict()
+        d[(1, 2)] = "t"
+        assert d[(1, 2)] == "t"
+        assert len(d) == 1
+
+    def test_copy_independent(self):
+        d = CaseIgnoredDict({"K": "v"})
+        c = d.copy()
+        c["K"] = "w"
+        assert d["k"] == "v" and c["k"] == "w"
+
+
+class TestMRUCache:
+    def test_eviction_order_is_lru(self):
+        c = MRUCache(capacity=3)
+        c.put("a", 1)
+        c.put("b", 2)
+        c.put("c", 3)
+        assert c.get("a") == 1          # refresh 'a'
+        c.put("d", 4)                   # evicts 'b' (least recent)
+        assert "b" not in c
+        assert c.get("a") == 1 and c.get("c") == 3 and c.get("d") == 4
+
+    def test_overwrite_refreshes(self):
+        c = MRUCache(capacity=2)
+        c.put("a", 1)
+        c.put("b", 2)
+        c.put("a", 10)                  # refresh + new value
+        c.put("c", 3)                   # evicts 'b'
+        assert "b" not in c and c.get("a") == 10
+
+    def test_hit_miss_counters(self):
+        c = MRUCache(capacity=2)
+        c.put("x", 1)
+        c.get("x")
+        c.get("y")
+        assert c.hits == 1 and c.misses == 1
+
+    def test_none_is_a_cacheable_value(self):
+        c = MRUCache(capacity=2)
+        sentinel = object()
+        c.put("k", None)
+        assert c.get("k", sentinel) is None
+
+    def test_capacity_validation(self):
+        import pytest
+        with pytest.raises(ValueError):
+            MRUCache(capacity=0)
+
+    def test_concurrent_access_no_crash(self):
+        c = MRUCache(capacity=16)
+
+        def worker(seed):
+            for i in range(2000):
+                k = (seed * 7 + i) % 64
+                c.put(k, i)
+                c.get((k + 1) % 64)
+
+        ts = [threading.Thread(target=worker, args=(s,)) for s in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(c) <= 16
+
+
+class TestHeaderIntegration:
+    def test_router_request_headers_case_insensitive(self):
+        from brpc_tpu.builtin.router import HttpRequest
+        raw = (b"GET /vars HTTP/1.1\r\nHost: x\r\n"
+               b"X-Custom-Header: yes\r\n\r\n")
+        req = HttpRequest(raw)
+        assert req.headers["x-custom-header"] == "yes"
+        assert req.headers["X-CUSTOM-HEADER"] == "yes"
+        # original casing preserved for proxying
+        assert "X-Custom-Header" in list(req.headers)
+
+    def test_http_response_headers_case_insensitive(self):
+        from brpc_tpu.rpc.http import parse_http_response
+        raw = (b"HTTP/1.1 200 OK\r\nContent-Type: text/html\r\n"
+               b"Content-Length: 2\r\n\r\nhi")
+        r = parse_http_response(raw)
+        assert r.headers["CONTENT-TYPE"] == "text/html"
+        assert list(r.headers) == ["Content-Type", "Content-Length"]
