@@ -93,6 +93,17 @@ _sigs = {
     "brpc_set_min_log_level": (None, [ctypes.c_int]),
     "brpc_crc32c": (ctypes.c_uint32, [ctypes.c_char_p, ctypes.c_size_t,
                                       ctypes.c_uint32]),
+    # snappy block-format codec (butil/snappy.cc)
+    "brpc_snappy_max_compressed_length": (ctypes.c_size_t,
+                                          [ctypes.c_size_t]),
+    "brpc_snappy_compress": (ctypes.c_size_t,
+                             [ctypes.c_char_p, ctypes.c_size_t,
+                              ctypes.c_void_p]),
+    "brpc_snappy_uncompressed_length": (ctypes.c_int64,
+                                        [ctypes.c_char_p, ctypes.c_size_t]),
+    "brpc_snappy_decompress": (ctypes.c_int,
+                               [ctypes.c_char_p, ctypes.c_size_t,
+                                ctypes.c_void_p, ctypes.c_size_t]),
     # native CPU profiler (butil/profiler.cc)
     "brpc_prof_start": (ctypes.c_int, [ctypes.c_int]),
     "brpc_prof_stop": (ctypes.c_int, []),

@@ -47,7 +47,8 @@ T_STREAM_SEQ = 17
 COMPRESS_NONE = 0
 COMPRESS_GZIP = 1
 COMPRESS_ZLIB = 2
-COMPRESS_SNAPPY = 3  # maps to zstd if snappy unavailable
+COMPRESS_SNAPPY = 3  # native block-format codec (src/cc/butil/snappy.cc)
+COMPRESS_ZSTD = 4
 
 
 @dataclass

@@ -12,7 +12,8 @@ a TPU service actually exchanges:
   pickle  arbitrary python (explicitly opt-in; server must enable)
 
 Compression (reference compress.cpp registry + gzip/snappy policies,
-global.cpp:393-403): gzip, zlib, zstd.
+global.cpp:393-403): gzip, zlib, snappy (native block-format codec,
+src/cc/butil/snappy.cc), zstd.
 """
 from __future__ import annotations
 
@@ -237,6 +238,42 @@ for _s in (RawSerializer(), JsonSerializer(), PbSerializer(),
 
 # ---- compression ----
 
+def snappy_compress(data) -> bytes:
+    """Native snappy block format (src/cc/butil/snappy.cc; the reference's
+    snappy compression policy, global.cpp:393-403)."""
+    import ctypes
+
+    from brpc_tpu._core import core
+    data = bytes(data)
+    if len(data) > 0xFFFFFFFF:
+        raise ValueError("snappy length header is 32-bit; chunk upstream")
+    cap = core.brpc_snappy_max_compressed_length(len(data))
+    buf = ctypes.create_string_buffer(cap)
+    n = core.brpc_snappy_compress(data, len(data), buf)
+    return buf.raw[:n]
+
+
+def snappy_decompress(data) -> bytes:
+    import ctypes
+
+    from brpc_tpu._core import core
+    data = bytes(data)
+    ulen = core.brpc_snappy_uncompressed_length(data, len(data))
+    if ulen < 0:
+        raise ValueError("malformed snappy header")
+    # Reject length amplification BEFORE allocating: the densest legal
+    # element (3-byte copy-2) emits 64 bytes, so output can never exceed
+    # ~22x input — a tiny wire message claiming gigabytes is hostile, not
+    # compressed (the decode would fail anyway, but only after the
+    # allocation it was crafted to trigger).
+    if ulen > len(data) * 22 + 64:
+        raise ValueError("implausible snappy uncompressed length")
+    buf = ctypes.create_string_buffer(max(int(ulen), 1))
+    if core.brpc_snappy_decompress(data, len(data), buf, ulen) != 0:
+        raise ValueError("malformed snappy body")
+    return buf.raw[:ulen]
+
+
 def compress(data: bytes, ctype: int) -> bytes:
     if ctype == M.COMPRESS_NONE or not data:
         return data
@@ -245,9 +282,11 @@ def compress(data: bytes, ctype: int) -> bytes:
     if ctype == M.COMPRESS_ZLIB:
         return _zlib.compress(data, 1)
     if ctype == M.COMPRESS_SNAPPY:
-        if _zstd is not None:
-            return _zstd.ZstdCompressor(level=1).compress(data)
-        return _zlib.compress(data, 1)
+        return snappy_compress(data)
+    if ctype == M.COMPRESS_ZSTD:
+        if _zstd is None:
+            raise ValueError("zstd not available in this environment")
+        return _zstd.ZstdCompressor(level=1).compress(data)
     raise ValueError(f"unknown compress type {ctype}")
 
 
@@ -259,7 +298,15 @@ def decompress(data: bytes, ctype: int) -> bytes:
     if ctype == M.COMPRESS_ZLIB:
         return _zlib.decompress(data)
     if ctype == M.COMPRESS_SNAPPY:
-        if _zstd is not None:
-            return _zstd.ZstdDecompressor().decompress(data)
-        return _zlib.decompress(data)
+        # Mixed-version tolerance: builds before the native codec shipped
+        # zstd frames under wire value 3.  A zstd frame can never be valid
+        # snappy here (its magic 0x28B52FFD parses as an implausible
+        # varint), so sniffing the magic is unambiguous.
+        if bytes(data[:4]) == b"\x28\xb5\x2f\xfd" and _zstd is not None:
+            return _zstd.ZstdDecompressor().decompress(bytes(data))
+        return snappy_decompress(data)
+    if ctype == M.COMPRESS_ZSTD:
+        if _zstd is None:
+            raise ValueError("zstd not available in this environment")
+        return _zstd.ZstdDecompressor().decompress(data)
     raise ValueError(f"unknown compress type {ctype}")

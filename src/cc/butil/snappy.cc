@@ -1,0 +1,224 @@
+#include "butil/snappy.h"
+
+#include <cstring>
+
+namespace butil {
+
+namespace {
+
+// Emission helpers -----------------------------------------------------
+
+inline uint8_t* emit_varint(uint8_t* dst, uint32_t v) {
+  while (v >= 0x80) {
+    *dst++ = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  *dst++ = (uint8_t)v;
+  return dst;
+}
+
+inline uint8_t* emit_literal(uint8_t* dst, const uint8_t* src, size_t len) {
+  // tag: low 2 bits 00, upper 6 bits encode len-1 (<60) or a byte count
+  // 60..62 for 1..3 little-endian extra length bytes.
+  const size_t n = len - 1;
+  if (n < 60) {
+    *dst++ = (uint8_t)(n << 2);
+  } else if (n < (1u << 8)) {
+    *dst++ = 60 << 2;
+    *dst++ = (uint8_t)n;
+  } else if (n < (1u << 16)) {
+    *dst++ = 61 << 2;
+    *dst++ = (uint8_t)n;
+    *dst++ = (uint8_t)(n >> 8);
+  } else {
+    *dst++ = 62 << 2;
+    *dst++ = (uint8_t)n;
+    *dst++ = (uint8_t)(n >> 8);
+    *dst++ = (uint8_t)(n >> 16);
+  }
+  std::memcpy(dst, src, len);
+  return dst + len;
+}
+
+// One copy element, 4 <= len <= 64, offset < 65536.
+inline uint8_t* emit_copy_upto64(uint8_t* dst, size_t offset, size_t len) {
+  if (len <= 11 && offset < 2048) {
+    // copy-1: 3-bit len-4, 11-bit offset (high 3 bits in the tag)
+    *dst++ = (uint8_t)(0x01 | ((len - 4) << 2) | ((offset >> 8) << 5));
+    *dst++ = (uint8_t)offset;
+  } else {
+    // copy-2: 6-bit len-1, 16-bit LE offset
+    *dst++ = (uint8_t)(0x02 | ((len - 1) << 2));
+    *dst++ = (uint8_t)offset;
+    *dst++ = (uint8_t)(offset >> 8);
+  }
+  return dst;
+}
+
+inline uint8_t* emit_copy(uint8_t* dst, size_t offset, size_t len) {
+  // Long matches become several elements; keep every remainder >= 4.
+  while (len >= 68) {
+    dst = emit_copy_upto64(dst, offset, 64);
+    len -= 64;
+  }
+  if (len > 64) {
+    dst = emit_copy_upto64(dst, offset, 60);
+    len -= 60;
+  }
+  return emit_copy_upto64(dst, offset, len);
+}
+
+inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash32(uint32_t v) { return (v * 0x1e35a7bdu) >> 18; }  // 14b
+
+constexpr size_t kBlockSize = 1 << 16;
+constexpr size_t kHashSize = 1 << 14;
+
+}  // namespace
+
+size_t snappy_max_compressed_length(size_t n) {
+  // varint header (<=5) + worst-case literal framing: one 3-byte tag per
+  // 64KB block plus the bytes themselves.  Google's own bound.
+  return 32 + n + n / 6;
+}
+
+size_t snappy_compress(const uint8_t* src, size_t n, uint8_t* dst) {
+  // The format's length header is 32-bit; refuse instead of silently
+  // truncating (the decompressor rejects >32-bit varints for the same
+  // reason).  Callers chunk payloads this large far upstream.
+  if (n > 0xffffffffu) return 0;
+  uint8_t* op = emit_varint(dst, (uint32_t)n);
+  uint16_t table[kHashSize];
+
+  for (size_t block = 0; block < n || block == 0; block += kBlockSize) {
+    const size_t block_len = (n - block < kBlockSize) ? n - block
+                                                      : kBlockSize;
+    const uint8_t* base = src + block;
+    std::memset(table, 0, sizeof(table));
+    size_t i = 0;          // scan position within block
+    size_t lit_start = 0;  // first unemitted literal byte
+    if (block_len >= 4) {
+      while (i + 4 <= block_len) {
+        const uint32_t h = hash32(load32(base + i));
+        const size_t cand = table[h];
+        table[h] = (uint16_t)i;
+        if (cand < i && load32(base + cand) == load32(base + i)) {
+          // extend the match
+          size_t len = 4;
+          while (i + len < block_len && base[cand + len] == base[i + len]) {
+            ++len;
+          }
+          if (lit_start < i) {
+            op = emit_literal(op, base + lit_start, i - lit_start);
+          }
+          op = emit_copy(op, i - cand, len);
+          i += len;
+          lit_start = i;
+        } else {
+          ++i;
+        }
+      }
+    }
+    if (lit_start < block_len) {
+      op = emit_literal(op, base + lit_start, block_len - lit_start);
+    }
+    if (n == 0) break;  // the block==0 pass for empty input
+  }
+  return (size_t)(op - dst);
+}
+
+namespace {
+
+bool read_varint(const uint8_t** p, const uint8_t* end, uint32_t* out) {
+  uint32_t v = 0;
+  int shift = 0;
+  const uint8_t* ip = *p;
+  while (ip < end && shift < 35) {
+    const uint8_t b = *ip++;
+    v |= (uint32_t)(b & 0x7f) << shift;
+    if ((b & 0x80) == 0) {
+      // reject bits above 32 (shift 28 with a byte > 0x0f)
+      if (shift == 28 && (b & 0x70) != 0) return false;
+      *p = ip;
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+}  // namespace
+
+bool snappy_uncompressed_length(const uint8_t* src, size_t n, size_t* out) {
+  uint32_t v = 0;
+  const uint8_t* p = src;
+  if (!read_varint(&p, src + n, &v)) return false;
+  *out = v;
+  return true;
+}
+
+bool snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst,
+                       size_t dst_cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const end = src + n;
+  uint32_t expected = 0;
+  if (!read_varint(&ip, end, &expected)) return false;
+  if (expected > dst_cap) return false;
+  size_t op = 0;
+
+  while (ip < end) {
+    const uint8_t tag = *ip++;
+    if ((tag & 3) == 0) {
+      // literal
+      size_t len = (size_t)(tag >> 2) + 1;
+      if (len > 60) {
+        const size_t extra = len - 60;  // 1..3 (64 would need 4; tag>>2
+                                        // caps at 63 so extra <= 3... but
+                                        // the format allows 63 = 4 bytes)
+        if (extra > 4 || ip + extra > end) return false;
+        uint32_t l = 0;
+        for (size_t k = 0; k < extra; ++k) l |= (uint32_t)ip[k] << (8 * k);
+        ip += extra;
+        len = (size_t)l + 1;
+      }
+      if ((size_t)(end - ip) < len || expected - op < len) return false;
+      std::memcpy(dst + op, ip, len);
+      ip += len;
+      op += len;
+    } else {
+      size_t len, offset;
+      if ((tag & 3) == 1) {
+        if (ip >= end) return false;
+        len = ((size_t)(tag >> 2) & 7) + 4;
+        offset = ((size_t)(tag >> 5) << 8) | *ip++;
+      } else if ((tag & 3) == 2) {
+        if (ip + 2 > end) return false;
+        len = (size_t)(tag >> 2) + 1;
+        offset = (size_t)ip[0] | ((size_t)ip[1] << 8);
+        ip += 2;
+      } else {
+        if (ip + 4 > end) return false;
+        len = (size_t)(tag >> 2) + 1;
+        offset = (size_t)ip[0] | ((size_t)ip[1] << 8) |
+                 ((size_t)ip[2] << 16) | ((size_t)ip[3] << 24);
+        ip += 4;
+      }
+      if (offset == 0 || offset > op) return false;      // hostile offset
+      if (expected - op < len) return false;             // output overrun
+      // overlap-safe: offset < len duplicates the tail as it grows
+      const uint8_t* from = dst + op - offset;
+      uint8_t* to = dst + op;
+      for (size_t k = 0; k < len; ++k) to[k] = from[k];
+      op += len;
+    }
+  }
+  return op == expected;
+}
+
+}  // namespace butil

@@ -1,0 +1,33 @@
+// Snappy block-format codec (SURVEY.md §2.1 crypto/encoding row; the
+// reference vendors Google snappy under butil/third_party and registers it
+// as a compression policy, global.cpp:393-403).  Clean-room implementation
+// from the public format description (format_description.txt): varint
+// uncompressed length, then literal / copy-1 / copy-2 / copy-4 tagged
+// elements.  The compressor is a greedy 4-byte-hash LZ within 64KB blocks
+// (offsets always fit copy-2); the decompressor is strictly bounds-checked
+// and rejects hostile input instead of reading or writing out of range.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace butil {
+
+// Worst-case output size for n input bytes (all-literal emission).
+size_t snappy_max_compressed_length(size_t n);
+
+// Compress src[0..n) into dst (capacity >= snappy_max_compressed_length(n)).
+// Returns bytes written.
+size_t snappy_compress(const uint8_t* src, size_t n, uint8_t* dst);
+
+// Parse the uncompressed-length header.  Returns false on a malformed
+// varint (or one exceeding 32 bits).
+bool snappy_uncompressed_length(const uint8_t* src, size_t n, size_t* out);
+
+// Decompress src[0..n) into dst (capacity dst_cap).  Returns false on any
+// malformed input: bad varint, truncated element, offset outside the
+// produced output, or output size mismatch.
+bool snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst,
+                       size_t dst_cap);
+
+}  // namespace butil

@@ -9,6 +9,7 @@
 #include "bthread/timer.h"
 #include "butil/common.h"
 #include "butil/iobuf.h"
+#include "butil/snappy.h"
 #include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
 #include "net/parser.h"
@@ -38,6 +39,28 @@ void brpc_set_min_log_level(int level) { butil::set_min_log_level(level); }
 
 uint32_t brpc_crc32c(const void* data, size_t n, uint32_t init_crc) {
   return butil::crc32c(data, n, init_crc);
+}
+
+// ---- snappy block-format codec (butil/snappy.cc) ----
+size_t brpc_snappy_max_compressed_length(size_t n) {
+  return butil::snappy_max_compressed_length(n);
+}
+size_t brpc_snappy_compress(const void* src, size_t n, void* dst) {
+  return butil::snappy_compress((const uint8_t*)src, n, (uint8_t*)dst);
+}
+int64_t brpc_snappy_uncompressed_length(const void* src, size_t n) {
+  size_t out = 0;
+  if (!butil::snappy_uncompressed_length((const uint8_t*)src, n, &out)) {
+    return -1;
+  }
+  return (int64_t)out;
+}
+int brpc_snappy_decompress(const void* src, size_t n, void* dst,
+                           size_t dst_cap) {
+  return butil::snappy_decompress((const uint8_t*)src, n, (uint8_t*)dst,
+                                  dst_cap)
+             ? 0
+             : -1;
 }
 
 // ---- native CPU profiler (/hotspots native view; butil/profiler.cc) ----
