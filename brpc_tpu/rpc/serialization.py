@@ -250,7 +250,9 @@ def snappy_compress(data) -> bytes:
     cap = core.brpc_snappy_max_compressed_length(len(data))
     buf = ctypes.create_string_buffer(cap)
     n = core.brpc_snappy_compress(data, len(data), buf)
-    return buf.raw[:n]
+    # string_at copies exactly n bytes; buf.raw[:n] would materialize the
+    # full worst-case buffer a second time before slicing
+    return ctypes.string_at(buf, n)
 
 
 def snappy_decompress(data) -> bytes:

@@ -293,6 +293,14 @@ void IOBuf::append(IOBuf&& other) {
 
 void IOBuf::append_user_data(void* data, size_t n, void (*deleter)(void*, void*),
                              void* arg) {
+  if (n == 0) {
+    // Nothing to reference; still honor the ownership contract (the
+    // deleter releases the caller's resource exactly once).  Pushing a
+    // zero-length ref would plant a degenerate span for every cursor to
+    // trip over.
+    if (deleter != nullptr) deleter(data, arg);
+    return;
+  }
   Block* b = iobuf::create_user_block(data, n, deleter, arg);
   push_ref(BlockRef{0, (uint32_t)n, b});  // takes the creation ref
 }
@@ -409,6 +417,181 @@ ssize_t IOBuf::cut_into_file_descriptor(int fd, size_t max_refs) {
   const ssize_t nw = writev(fd, vec, (int)nvec);
   if (nw > 0) pop_front((size_t)nw);
   return nw;
+}
+
+// ---- IOBufBytesIterator ----
+
+IOBufBytesIterator::IOBufBytesIterator(const IOBuf& buf)
+    : _buf(&buf), _bytes_left(buf.size()) {
+  load_ref();
+}
+
+void IOBufBytesIterator::load_ref() {
+  while (_ref < _buf->backing_block_num()) {
+    const BlockRef& r = _buf->backing_block(_ref);
+    if (r.length > 0) {
+      _ptr = iobuf::block_data(r.block) + r.offset;
+      _end = _ptr + r.length;
+      return;
+    }
+    ++_ref;
+  }
+  _ptr = _end = nullptr;
+}
+
+void IOBufBytesIterator::operator++() {
+  ++_ptr;
+  --_bytes_left;
+  if (_ptr == _end) {
+    ++_ref;
+    load_ref();
+  }
+}
+
+size_t IOBufBytesIterator::copy_and_forward(void* out, size_t n) {
+  char* dst = (char*)out;
+  size_t copied = 0;
+  while (n > 0 && _bytes_left > 0) {
+    const size_t span = (size_t)(_end - _ptr);
+    const size_t m = std::min(n, span);
+    memcpy(dst, _ptr, m);
+    dst += m;
+    copied += m;
+    n -= m;
+    _ptr += m;
+    _bytes_left -= m;
+    if (_ptr == _end) {
+      ++_ref;
+      load_ref();
+    }
+  }
+  return copied;
+}
+
+size_t IOBufBytesIterator::forward(size_t n) {
+  size_t skipped = 0;
+  while (n > 0 && _bytes_left > 0) {
+    const size_t span = (size_t)(_end - _ptr);
+    const size_t m = std::min(n, span);
+    skipped += m;
+    n -= m;
+    _ptr += m;
+    _bytes_left -= m;
+    if (_ptr == _end) {
+      ++_ref;
+      load_ref();
+    }
+  }
+  return skipped;
+}
+
+// ---- IOBufCutter ----
+
+IOBufCutter::IOBufCutter(IOBuf* buf) : _buf(buf) {}
+
+IOBufCutter::~IOBufCutter() { flush(); }
+
+void IOBufCutter::flush() {
+  const size_t consumed = consumed_pending();
+  if (consumed > 0) _buf->pop_front(consumed);
+  _span_begin = _ptr = _end = nullptr;
+}
+
+bool IOBufCutter::refill() {
+  flush();
+  // Zero-length refs are producible (append_user_data with n == 0);
+  // loading one would make cut1 read out of bounds and cutn spin — skip
+  // them like IOBufBytesIterator::load_ref does.
+  while (_buf->backing_block_num() > 0) {
+    const BlockRef& r = _buf->backing_block(0);
+    if (r.length == 0) {
+      _buf->pop_front_ref();
+      continue;
+    }
+    _span_begin = _ptr = iobuf::block_data(r.block) + r.offset;
+    _end = _ptr + r.length;
+    return true;
+  }
+  return false;
+}
+
+bool IOBufCutter::cut1(char* c) {
+  if (_ptr == _end && !refill()) return false;
+  *c = *_ptr++;
+  return true;
+}
+
+size_t IOBufCutter::cutn(void* out, size_t n) {
+  char* dst = (char*)out;
+  size_t cut = 0;
+  while (n > 0) {
+    if (_ptr == _end && !refill()) break;
+    const size_t m = std::min(n, (size_t)(_end - _ptr));
+    memcpy(dst, _ptr, m);
+    dst += m;
+    _ptr += m;
+    cut += m;
+    n -= m;
+  }
+  return cut;
+}
+
+size_t IOBufCutter::cutn(IOBuf* out, size_t n) {
+  flush();  // hand back the cached span before the zero-copy move
+  return _buf->cutn(out, n);
+}
+
+// ---- IOBufAppender ----
+
+IOBufAppender::~IOBufAppender() {
+  commit();
+  if (_block != nullptr) iobuf::block_dec_ref(_block);
+}
+
+void IOBufAppender::grab_block() {
+  commit();
+  if (_block != nullptr) {
+    iobuf::block_dec_ref(_block);
+    _block = nullptr;
+  }
+  Block* b = iobuf::tls_write_block_with_room();  // thread-shared tail
+  iobuf::block_inc_ref(b);                        // appender's own ref
+  _block = b;
+  _begin = (uint32_t)iobuf::block_size(b);
+  _cur = iobuf::block_data(b) + _begin;
+  _end = iobuf::block_data(b) + iobuf::block_cap(b);
+}
+
+void IOBufAppender::commit() {
+  if (_block == nullptr) return;
+  const uint32_t end_off = (uint32_t)(_cur - iobuf::block_data(_block));
+  const uint32_t len = end_off - _begin;
+  if (len == 0) return;
+  _buf->add_block_ref(BlockRef{_begin, len, _block});
+  _begin = end_off;
+}
+
+void IOBufAppender::append(const void* data, size_t n) {
+  const char* p = (const char*)data;
+  while (n > 0) {
+    // Re-grab when the span is exhausted OR someone else advanced the
+    // shared block's claim cursor since our last write (a plain
+    // IOBuf::append or another appender on this thread): our staged
+    // bytes are safe (claimed eagerly below) but writing past a foreign
+    // claim would corrupt theirs.
+    if (_block == nullptr || _cur == _end ||
+        _cur != iobuf::block_data(_block) + iobuf::block_size(_block)) {
+      grab_block();
+    }
+    const size_t m = std::min(n, (size_t)(_end - _cur));
+    memcpy(_cur, p, m);
+    _cur += m;
+    // claim eagerly: interleaved appends on this thread must see the
+    // span as taken, or they would overwrite staged bytes
+    iobuf::block_set_size(_block, (size_t)(_cur - iobuf::block_data(_block)));
+    p += m;
+    n -= m;
+  }
 }
 
 // ---- IOPortal ----

@@ -104,6 +104,7 @@ class IOBuf {
   void push_ref(const BlockRef& r);      // takes ownership of the count
 
  private:
+  friend class IOBufCutter;  // pops skipped zero-length refs in refill()
   void unref_all();
   BlockRef& ref_at(size_t i);
   const BlockRef& ref_at(size_t i) const;
@@ -130,6 +131,80 @@ class IOPortal : public IOBuf {
   ssize_t append_from_file_descriptor(int fd, size_t max_bytes);
   // Append from memory through the same tail-block machinery.
   void append_from_memory(const void* data, size_t n) { append(data, n); }
+};
+
+// IOBufBytesIterator — non-destructive forward cursor (reference iobuf.h
+// IOBufBytesIterator): caches the current ref's span so sequential scans
+// cost O(total bytes), where repeated copy_to(pos) walks the ref chain
+// from the start each call (O(refs) per read — quadratic over a long
+// multi-block message).  The buf must not be mutated while iterating.
+class IOBufBytesIterator {
+ public:
+  explicit IOBufBytesIterator(const IOBuf& buf);
+  size_t bytes_left() const { return _bytes_left; }
+  char operator*() const { return *_ptr; }
+  void operator++();
+  // Copy up to n bytes and advance; returns copied count.
+  size_t copy_and_forward(void* out, size_t n);
+  // Skip up to n bytes; returns skipped count.
+  size_t forward(size_t n);
+
+ private:
+  void load_ref();
+  const IOBuf* _buf;
+  const char* _ptr = nullptr;
+  const char* _end = nullptr;
+  size_t _ref = 0;
+  size_t _bytes_left = 0;
+};
+
+// IOBufCutter — destructive sequential reader with a cached front span
+// (reference iobuf_inl.h IOBufCutter): cut1/cutn without a front-ref
+// lookup per call.  Consumed bytes are popped from the buf lazily (on
+// span refill / destruction); cutn(IOBuf*) flushes first so zero-copy
+// handoff and cached reads interleave correctly.
+class IOBufCutter {
+ public:
+  explicit IOBufCutter(IOBuf* buf);
+  ~IOBufCutter();
+  size_t remaining() const { return _buf->size() - consumed_pending(); }
+  bool cut1(char* c);
+  size_t cutn(void* out, size_t n);
+  size_t cutn(IOBuf* out, size_t n);   // zero-copy
+
+ private:
+  size_t consumed_pending() const { return (size_t)(_ptr - _span_begin); }
+  void flush();                        // pop consumed prefix off the buf
+  bool refill();
+  IOBuf* _buf;
+  const char* _span_begin = nullptr;
+  const char* _ptr = nullptr;
+  const char* _end = nullptr;
+};
+
+// IOBufAppender — staged writer with a cached tail span (reference
+// iobuf_inl.h IOBufAppender): repeated small writes go through a raw
+// cursor and publish to the IOBuf as ONE ref on commit() / destruction.
+// Spans are claimed eagerly from the thread-shared write block (the
+// block's append cursor advances as bytes land), so frames stay densely
+// packed — a queue of small frames shares blocks instead of pinning one
+// block each, which keeps EOVERCROWDED's byte accounting honest.
+class IOBufAppender {
+ public:
+  explicit IOBufAppender(IOBuf* buf) : _buf(buf) {}
+  ~IOBufAppender();
+  void append(const void* data, size_t n);
+  void append(const std::string& s) { append(s.data(), s.size()); }
+  void push_back(char c) { append(&c, 1); }
+  void commit();
+
+ private:
+  void grab_block();
+  IOBuf* _buf;
+  iobuf::Block* _block = nullptr;  // one ref held while staging
+  uint32_t _begin = 0;             // start of the uncommitted span
+  char* _cur = nullptr;
+  char* _end = nullptr;
 };
 
 }  // namespace butil

@@ -175,36 +175,32 @@ static ParseResult parse_http(butil::IOBuf* in, ParseState* st,
 // Completeness scan over the IOBuf without copying bulk bodies: header lines
 // are read through a small window, $N bodies are skipped arithmetically.
 
-// Reads one CRLF-terminated line starting at *off.  On success stores the
-// line (without CRLF) and advances *off past the CRLF.
-static ParseResult resp_read_line(const butil::IOBuf& in, size_t* off,
+// Reads one CRLF-terminated line at the iterator.  On success stores the
+// line (without CRLF) and leaves the iterator past the LF.  The iterator
+// (IOBufBytesIterator, a cached-span cursor) makes the whole scan
+// O(total bytes); the previous copy_to(pos)-per-line version re-walked
+// the ref chain from the start for every line — quadratic over a large
+// pipelined batch spanning many blocks.
+static ParseResult resp_read_line(butil::IOBufBytesIterator& it,
                                   std::string* line) {
-  char buf[256];
-  size_t pos = *off;
   line->clear();
-  while (pos < in.size()) {
-    const size_t n = in.copy_to(buf, sizeof(buf), pos);
-    for (size_t i = 0; i < n; ++i) {
-      if (buf[i] == '\n') {
-        if (line->empty() && i == 0) return PARSE_ERROR;
-        // strip the '\r' (it may be the last char of the previous chunk)
-        line->append(buf, i);
-        if (line->empty() || line->back() != '\r') return PARSE_ERROR;
-        line->pop_back();
-        *off = pos + i + 1;
-        return PARSE_OK;
-      }
+  while (it.bytes_left() > 0) {
+    const char c = *it;
+    ++it;
+    if (c == '\n') {
+      if (line->empty() || line->back() != '\r') return PARSE_ERROR;
+      line->pop_back();
+      return PARSE_OK;
     }
-    line->append(buf, n);
+    line->push_back(c);
     if (line->size() > 65536) return PARSE_ERROR;  // redis line limit
-    pos += n;
   }
   return PARSE_NEED_MORE;
 }
 
 // Scans one complete RESP value starting at offset 0; sets *end past it.
 static ParseResult resp_scan(const butil::IOBuf& in, size_t* end) {
-  size_t off = 0;
+  butil::IOBufBytesIterator it(in);
   std::string line;
   // stack of remaining element counts for nested arrays
   int64_t stack[32];
@@ -215,7 +211,7 @@ static ParseResult resp_scan(const butil::IOBuf& in, size_t* end) {
       --depth;
       continue;
     }
-    const ParseResult r = resp_read_line(in, &off, &line);
+    const ParseResult r = resp_read_line(it, &line);
     if (r != PARSE_OK) return r;
     if (line.empty()) return PARSE_ERROR;
     const char t = line[0];
@@ -225,9 +221,9 @@ static ParseResult resp_scan(const butil::IOBuf& in, size_t* end) {
       const long long n = atoll(line.c_str() + 1);
       if (n > (long long)g_max_body_size) return PARSE_ERROR;
       if (n >= 0) {
-        const size_t body_end = off + (size_t)n + 2;
-        if (in.size() < body_end) return PARSE_NEED_MORE;
-        off = body_end;
+        const size_t body = (size_t)n + 2;  // payload + CRLF
+        if (it.bytes_left() < body) return PARSE_NEED_MORE;
+        it.forward(body);
       }
       --stack[depth];
     } else if (t == '*') {
@@ -242,7 +238,7 @@ static ParseResult resp_scan(const butil::IOBuf& in, size_t* end) {
       return PARSE_ERROR;
     }
   }
-  *end = off;
+  *end = in.size() - it.bytes_left();
   return PARSE_OK;
 }
 

@@ -85,43 +85,51 @@ bool ParseMeta(const char* p, size_t n, ParsedMeta* out) {
   return off == n || off + 5 > n;  // trailing garbage < one TLV header: ok
 }
 
-static void append_fixed(std::string* meta, uint8_t msg_type, uint64_t cid,
-                         uint16_t attempt) {
+// Meta writes go through an IOBufAppender: header + fixed part + TLVs
+// land in the shared write block as one staged span committed as ONE ref
+// — no intermediate std::string, no second copy.  Sizes are computed up
+// front (the frame header carries meta_size before the meta bytes).
+static void append_fixed(butil::IOBufAppender* ap, uint8_t msg_type,
+                         uint64_t cid, uint16_t attempt) {
   char fixed[kMetaFixedLen];
   fixed[0] = 1;  // version
   fixed[1] = (char)msg_type;
   fixed[2] = fixed[3] = 0;  // flags
   memcpy(fixed + 4, &cid, 8);
   memcpy(fixed + 12, &attempt, 2);
-  meta->append(fixed, sizeof(fixed));
+  ap->append(fixed, sizeof(fixed));
 }
 
-static void append_tlv(std::string* meta, uint8_t tag, const void* v,
+static void append_tlv(butil::IOBufAppender* ap, uint8_t tag, const void* v,
                        uint32_t len) {
   char hdr[5];
   hdr[0] = (char)tag;
   memcpy(hdr + 1, &len, 4);
-  meta->append(hdr, 5);
-  meta->append((const char*)v, len);
+  ap->append(hdr, 5);
+  ap->append((const char*)v, len);
 }
 
 void PackResponseFrame(butil::IOBuf* out, uint64_t cid, uint16_t attempt,
                        int32_t error_code, const char* error_text,
                        size_t error_text_len, const char* content_type,
                        size_t content_type_len, butil::IOBuf&& body) {
-  std::string meta;
-  meta.reserve(64);
-  append_fixed(&meta, META_RESPONSE, cid, attempt);
-  if (error_code != 0) append_tlv(&meta, TAG_ERROR_CODE, &error_code, 4);
-  if (error_text_len > 0)
-    append_tlv(&meta, TAG_ERROR_TEXT, error_text, (uint32_t)error_text_len);
-  if (content_type_len > 0)
-    append_tlv(&meta, TAG_CONTENT_TYPE, content_type,
-               (uint32_t)content_type_len);
+  const uint32_t meta_size =
+      kMetaFixedLen + (error_code != 0 ? 5u + 4u : 0u) +
+      (error_text_len > 0 ? 5u + (uint32_t)error_text_len : 0u) +
+      (content_type_len > 0 ? 5u + (uint32_t)content_type_len : 0u);
   char hdr[kTrpcHeaderLen];
-  make_trpc_header(hdr, (uint32_t)meta.size(), body.size());
-  out->append(hdr, sizeof(hdr));
-  out->append(meta.data(), meta.size());
+  make_trpc_header(hdr, meta_size, body.size());
+  {
+    butil::IOBufAppender ap(out);
+    ap.append(hdr, sizeof(hdr));
+    append_fixed(&ap, META_RESPONSE, cid, attempt);
+    if (error_code != 0) append_tlv(&ap, TAG_ERROR_CODE, &error_code, 4);
+    if (error_text_len > 0)
+      append_tlv(&ap, TAG_ERROR_TEXT, error_text, (uint32_t)error_text_len);
+    if (content_type_len > 0)
+      append_tlv(&ap, TAG_CONTENT_TYPE, content_type,
+                 (uint32_t)content_type_len);
+  }
   out->append(std::move(body));
 }
 
@@ -131,22 +139,27 @@ void PackRequestFrame(butil::IOBuf* out, uint64_t cid, uint16_t attempt,
                       uint32_t timeout_ms, uint8_t compress,
                       const char* content_type, size_t content_type_len,
                       butil::IOBuf&& body) {
-  std::string meta;
-  meta.reserve(64 + service_len + method_len);
-  append_fixed(&meta, META_REQUEST, cid, attempt);
-  if (service_len > 0)
-    append_tlv(&meta, TAG_SERVICE, service, (uint32_t)service_len);
-  if (method_len > 0)
-    append_tlv(&meta, TAG_METHOD, method, (uint32_t)method_len);
-  if (compress != 0) append_tlv(&meta, TAG_COMPRESS, &compress, 1);
-  if (timeout_ms != 0) append_tlv(&meta, TAG_TIMEOUT_MS, &timeout_ms, 4);
-  if (content_type_len > 0)
-    append_tlv(&meta, TAG_CONTENT_TYPE, content_type,
-               (uint32_t)content_type_len);
+  const uint32_t meta_size =
+      kMetaFixedLen +
+      (service_len > 0 ? 5u + (uint32_t)service_len : 0u) +
+      (method_len > 0 ? 5u + (uint32_t)method_len : 0u) +
+      (compress != 0 ? 5u + 1u : 0u) + (timeout_ms != 0 ? 5u + 4u : 0u) +
+      (content_type_len > 0 ? 5u + (uint32_t)content_type_len : 0u);
   char hdr[kTrpcHeaderLen];
-  make_trpc_header(hdr, (uint32_t)meta.size(), body.size());
-  out->append(hdr, sizeof(hdr));
-  out->append(meta.data(), meta.size());
+  make_trpc_header(hdr, meta_size, body.size());
+  butil::IOBufAppender ap(out);
+  ap.append(hdr, sizeof(hdr));
+  append_fixed(&ap, META_REQUEST, cid, attempt);
+  if (service_len > 0)
+    append_tlv(&ap, TAG_SERVICE, service, (uint32_t)service_len);
+  if (method_len > 0)
+    append_tlv(&ap, TAG_METHOD, method, (uint32_t)method_len);
+  if (compress != 0) append_tlv(&ap, TAG_COMPRESS, &compress, 1);
+  if (timeout_ms != 0) append_tlv(&ap, TAG_TIMEOUT_MS, &timeout_ms, 4);
+  if (content_type_len > 0)
+    append_tlv(&ap, TAG_CONTENT_TYPE, content_type,
+               (uint32_t)content_type_len);
+  ap.commit();
   out->append(std::move(body));
 }
 

@@ -27,6 +27,7 @@
 #include "bthread/executor.h"
 #include "bthread/fiber.h"
 #include "bthread/timer.h"
+#include "butil/iobuf.h"
 #include "net/event_dispatcher.h"
 #include "net/socket.h"
 
@@ -67,6 +68,71 @@ static void stress_bounded_queue() {
   CHECK_EQ(pushed, popped);
   CHECK_EQ(q.empty(), true);
   printf("bounded_queue: %d values through a 7-slot ring in order\n", pushed);
+}
+
+// ---- 0b. IOBuf cutter / appender / bytes-iterator ----
+static void stress_iobuf_companions() {
+  // Appender: interleave two appenders and a plain append on one thread;
+  // eager span claiming must keep all three byte streams intact.
+  butil::IOBuf buf;
+  {
+    butil::IOBufAppender a(&buf), b(&buf);
+    for (int i = 0; i < 1000; ++i) {
+      char ca = (char)('a' + (i % 26));
+      a.append(&ca, 1);
+      a.commit();
+      char cb = (char)('A' + (i % 26));
+      b.append(&cb, 1);
+      b.commit();
+      if (i % 97 == 0) buf.append("|", 1);
+    }
+  }
+  std::string s = buf.to_string();
+  CHECK_EQ((long long)s.size(), 2011LL);  // 2000 staged + 11 separators
+  // spot-check order: first three bytes are a0, A0, then a1 or separator
+  if (s[0] != 'a' || s[1] != 'A') {
+    fprintf(stderr, "FAIL: appender interleave order\n");
+    exit(1);
+  }
+
+  // Iterator: multi-block content reads back exactly.
+  butil::IOBuf big;
+  std::string expect;
+  for (int i = 0; i < 5000; ++i) {
+    char w[16];
+    int n = snprintf(w, sizeof(w), "%d,", i);
+    big.append(w, (size_t)n);
+    expect.append(w, (size_t)n);
+  }
+  butil::IOBufBytesIterator it(big);
+  CHECK_EQ((long long)it.bytes_left(), (long long)expect.size());
+  std::string got;
+  got.resize(expect.size());
+  CHECK_EQ((long long)it.copy_and_forward(got.data(), got.size()),
+           (long long)expect.size());
+  CHECK_EQ((long long)it.bytes_left(), 0LL);
+  if (got != expect) {
+    fprintf(stderr, "FAIL: iterator content mismatch\n");
+    exit(1);
+  }
+
+  // Cutter: cut1/cutn across block boundaries, then zero-copy cutn.
+  butil::IOBufCutter cutter(&big);
+  char c0 = 0, c1 = 0;
+  CHECK_EQ(cutter.cut1(&c0), true);
+  CHECK_EQ(cutter.cut1(&c1), true);
+  if (c0 != '0' || c1 != ',') {
+    fprintf(stderr, "FAIL: cutter cut1\n");
+    exit(1);
+  }
+  char word[8] = {0};
+  CHECK_EQ((long long)cutter.cutn(word, 2), 2LL);  // "1,"
+  butil::IOBuf rest;
+  const size_t left = cutter.remaining();
+  CHECK_EQ((long long)cutter.cutn(&rest, left), (long long)left);
+  CHECK_EQ((long long)big.size(), 0LL);
+  CHECK_EQ((long long)rest.size(), (long long)(expect.size() - 4));
+  printf("iobuf companions: appender/iterator/cutter invariants held\n");
 }
 
 // ---- 1. Chase-Lev: owner pops + thieves steal must conserve tasks ----
@@ -412,6 +478,7 @@ int main() {
   Executor::init_global(8);
   (void)Executor::global();
   stress_bounded_queue();
+  stress_iobuf_companions();
   stress_wsq();
   stress_executor();
   stress_butex();
