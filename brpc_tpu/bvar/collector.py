@@ -1,0 +1,133 @@
+"""Collector — shared, speed-limited sampling infrastructure (reference
+src/bvar/collector.{h,cpp}; SURVEY.md §2.7 "Collector" row).
+
+The reference funnels every "sampled heavyweight record" — rpcz spans,
+mutex-contention samples, rpc_dump captures — through one global collector:
+submission is a cheap, speed-limited handoff on the hot path, and the
+expensive part (serialization, file IO, indexing) runs on a background
+thread over batches.  This is that design:
+
+  * `Collected` — base class for sample objects; `dump_and_destroy()` runs
+    on the collector thread, never on the submitter.
+  * `CollectorSpeedLimit` — per-family token bucket (default 1000
+    samples/s, the reference's collector_max_sampling_overhead spirit):
+    `grab()` is one lock + two int ops; beyond the budget samples are
+    dropped, counted, and serving is unaffected.
+  * `Collector` — global pending list + one daemon drainer; `flush()`
+    drains synchronously for readers that need everything submitted so
+    far (the /rpcz page, dump-file close).
+
+Consumers here: rpcz spans (brpc_tpu/rpcz.py) and rpc_dump captures
+(brpc_tpu/rpc/rpc_dump.py) — file IO for dumps moved off the dispatch
+path onto the collector thread.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from brpc_tpu.bvar.reducer import Adder
+
+
+class Collected:
+    """A sample.  Subclasses implement dump_and_destroy(); it runs on the
+    collector thread (or inside flush()), exactly once."""
+
+    def dump_and_destroy(self) -> None:
+        raise NotImplementedError
+
+
+class CollectorSpeedLimit:
+    """Token bucket: at most `max_per_second` grabs per rolling second.
+
+    The reference adapts a sampling probability instead
+    (collector.h:30-60 _sampling_range); a bucket gives the same property
+    — bounded collection overhead under load — with simpler, testable
+    state.
+    """
+
+    def __init__(self, name: str, max_per_second: int = 1000):
+        self.name = name
+        self.max_per_second = max_per_second
+        self._mu = threading.Lock()
+        self._window_start = time.monotonic()
+        self._in_window = 0
+        self.grabbed = Adder(f"collector_{name}_grabbed")
+        self.denied = Adder(f"collector_{name}_denied")
+
+    def grab(self) -> bool:
+        now = time.monotonic()
+        with self._mu:
+            if now - self._window_start >= 1.0:
+                self._window_start = now
+                self._in_window = 0
+            if self._in_window >= self.max_per_second:
+                self.denied.add(1)
+                return False
+            self._in_window += 1
+        self.grabbed.add(1)
+        return True
+
+
+class Collector:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    GRAB_INTERVAL_S = 0.1   # drain cadence (reference COLLECTOR_GRAB_...)
+
+    @classmethod
+    def instance(cls) -> "Collector":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._drain_mu = threading.Lock()  # serializes drains so flush()
+        self._pending: list[Collected] = []  # waits out an in-flight batch
+        self._wake = threading.Event()
+        self._stopped = False
+        self._thread: threading.Thread | None = None
+
+    def submit(self, sample: Collected,
+               limit: CollectorSpeedLimit | None = None) -> bool:
+        """Hot-path handoff.  Returns False when the speed limit dropped
+        the sample (dump_and_destroy will never run for it)."""
+        if limit is not None and not limit.grab():
+            return False
+        with self._mu:
+            self._pending.append(sample)
+            if self._thread is None and not self._stopped:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="bvar-collector")
+                self._thread.start()
+        self._wake.set()
+        return True
+
+    def flush(self) -> None:
+        """Drain everything submitted so far on THIS thread.  Readers that
+        must observe all prior submissions (the /rpcz page, dump close)
+        call this instead of sleeping a drain interval."""
+        self._drain()
+
+    def _drain(self) -> None:
+        with self._drain_mu:
+            with self._mu:
+                batch, self._pending = self._pending, []
+            for s in batch:
+                try:
+                    s.dump_and_destroy()
+                except Exception:
+                    pass  # a broken sample must never kill the drainer
+
+    def _run(self) -> None:
+        while not self._stopped:
+            self._wake.wait(self.GRAB_INTERVAL_S)
+            self._wake.clear()
+            self._drain()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._wake.set()
+        self._drain()

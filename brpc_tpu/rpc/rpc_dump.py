@@ -50,8 +50,11 @@ class RpcDumper:
         self._files: list[str] = []
 
     def sample(self, meta_bytes: bytes, body: bytes) -> None:
-        """Called per request from the server dispatch path; cheap when
-        disabled (one flag read + one int op)."""
+        """Called per request from the server dispatch path.  Cheap when
+        disabled (one flag read); when enabled, the record is handed to
+        the shared bvar Collector and the file IO runs on its background
+        thread, not here (the reference's rpc_dump rides
+        bvar::Collector the same way, rpc_dump.h:50-69)."""
         if not flags.get_flag("rpc_dump"):
             return
         with self._mu:
@@ -59,6 +62,30 @@ class RpcDumper:
             ratio = max(1, int(flags.get_flag("rpc_dump_ratio")))
             if self._counter % ratio != 0:
                 return
+        # Consult the speed limit BEFORE materializing the record: a
+        # denied sample must cost nothing — bytes() copies of a large
+        # body on the dispatch thread are exactly the overhead the
+        # collector handoff exists to avoid.
+        if not self._speed_limit().grab():
+            return
+        from brpc_tpu.bvar.collector import Collector
+        Collector.instance().submit(_DumpSample(self, meta_bytes, body))
+
+    _limit = None
+    _limit_lock = threading.Lock()
+
+    @classmethod
+    def _speed_limit(cls):
+        from brpc_tpu.bvar.collector import CollectorSpeedLimit
+        if cls._limit is None:
+            with cls._limit_lock:
+                if cls._limit is None:
+                    cls._limit = CollectorSpeedLimit("rpc_dump",
+                                                     max_per_second=1000)
+        return cls._limit
+
+    def _write_sample(self, meta_bytes: bytes, body: bytes) -> None:
+        with self._mu:
             try:
                 self._write_locked(meta_bytes, body)
             except OSError:
@@ -93,8 +120,25 @@ class RpcDumper:
                 pass
 
     def close(self) -> None:
+        # drain records still queued on the collector before closing
+        from brpc_tpu.bvar.collector import Collector
+        Collector.instance().flush()
         with self._mu:
             if self._fp is not None:
                 self._fp.close()
                 self._fp = None
                 self._writer = None
+
+
+class _DumpSample:
+    """Collected record: writes on the collector thread."""
+
+    __slots__ = ("dumper", "meta", "body")
+
+    def __init__(self, dumper: "RpcDumper", meta: bytes, body: bytes):
+        self.dumper = dumper
+        self.meta = bytes(meta)
+        self.body = bytes(body)
+
+    def dump_and_destroy(self) -> None:
+        self.dumper._write_sample(self.meta, self.body)

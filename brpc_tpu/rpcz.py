@@ -4,9 +4,10 @@ Span objects record the per-RPC timeline (recv/process/send timestamps,
 sizes, error).  Server-side spans are installed in thread-local storage for
 the duration of the handler, so nested client calls made inside it pick up
 trace_id/parent_span automatically — the reference propagates the same way
-through bthread-local storage (task_meta.h:44).  Collection is sampled and
-bounded (bvar::Collector role): a deque keeps the most recent spans for the
-/rpcz builtin.
+through bthread-local storage (task_meta.h:44).  Collection rides the
+shared bvar Collector (brpc_tpu/bvar/collector.py, reference
+bvar/collector.{h,cpp}): submission is a speed-limited handoff; the
+bounded recent-span store is filled on the collector thread.
 """
 from __future__ import annotations
 
@@ -122,17 +123,46 @@ def current_trace() -> tuple[int, int]:
     return s.trace_id, s.span_id
 
 
+class _SpanSample:
+    """Collected wrapper: moves the store append (and any future
+    indexing/serialization) off the RPC thread."""
+
+    __slots__ = ("span",)
+
+    def __init__(self, span: Span):
+        self.span = span
+
+    def dump_and_destroy(self) -> None:
+        with _collect_lock:
+            _collected.append(self.span)
+
+
+def _collector():
+    from brpc_tpu.bvar.collector import Collector, CollectorSpeedLimit
+    global _speed_limit
+    if _speed_limit is None:
+        with _limit_lock:
+            if _speed_limit is None:
+                _speed_limit = CollectorSpeedLimit("rpcz",
+                                                   max_per_second=2000)
+    return Collector.instance()
+
+
+_speed_limit = None
+_limit_lock = threading.Lock()
+
+
 def submit(span: Span) -> None:
     if not _enabled or span is NULL_SPAN:
         return
     if _sample_rate < 1.0 and random.random() > _sample_rate:
         return
     span.end_us = span.end_us or now_us()
-    with _collect_lock:
-        _collected.append(span)
+    _collector().submit(_SpanSample(span), _speed_limit)
 
 
 def recent_spans(limit: int = 100, trace_id: int | None = None) -> list[Span]:
+    _collector().flush()  # observe everything submitted before this call
     with _collect_lock:
         spans = list(_collected)
     if trace_id is not None:
