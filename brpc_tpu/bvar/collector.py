@@ -9,13 +9,16 @@ thread over batches.  This is that design:
 
   * `Collected` — base class for sample objects; `dump_and_destroy()` runs
     on the collector thread, never on the submitter.
-  * `CollectorSpeedLimit` — per-family token bucket (default 1000
-    samples/s, the reference's collector_max_sampling_overhead spirit):
-    `grab()` is one lock + two int ops; beyond the budget samples are
-    dropped, counted, and serving is unaffected.
-  * `Collector` — global pending list + one daemon drainer; `flush()`
-    drains synchronously for readers that need everything submitted so
-    far (the /rpcz page, dump-file close).
+  * `CollectorSpeedLimit` — per-family budget: at most max_per_second
+    grabs per FIXED one-second window (a window boundary therefore admits
+    a burst of up to 2x in a short instant — bounded overhead is the
+    contract, not smoothness; the reference's adaptive sampling_range is
+    approximate the same way).  `grab()` is one small lock + two int ops.
+  * `Collector` — pending samples bucketed per family + one daemon
+    drainer; `flush(family)` drains ONE family synchronously so a reader
+    (the /rpcz page, dump-file close) observes its own prior submissions
+    without doing other families' heavyweight work (a console thread must
+    never end up writing rpc_dump files).
 
 Consumers here: rpcz spans (brpc_tpu/rpcz.py) and rpc_dump captures
 (brpc_tpu/rpc/rpc_dump.py) — file IO for dumps moved off the dispatch
@@ -38,25 +41,24 @@ class Collected:
 
 
 class CollectorSpeedLimit:
-    """Token bucket: at most `max_per_second` grabs per rolling second.
+    """Fixed-window budget: at most `max_per_second` grabs per window.
 
-    The reference adapts a sampling probability instead
-    (collector.h:30-60 _sampling_range); a bucket gives the same property
-    — bounded collection overhead under load — with simpler, testable
-    state.
+    `clock` is injectable for deterministic tests.
     """
 
-    def __init__(self, name: str, max_per_second: int = 1000):
+    def __init__(self, name: str, max_per_second: int = 1000,
+                 clock=time.monotonic):
         self.name = name
         self.max_per_second = max_per_second
+        self._clock = clock
         self._mu = threading.Lock()
-        self._window_start = time.monotonic()
+        self._window_start = clock()
         self._in_window = 0
         self.grabbed = Adder(f"collector_{name}_grabbed")
         self.denied = Adder(f"collector_{name}_denied")
 
     def grab(self) -> bool:
-        now = time.monotonic()
+        now = self._clock()
         with self._mu:
             if now - self._window_start >= 1.0:
                 self._window_start = now
@@ -69,6 +71,24 @@ class CollectorSpeedLimit:
         return True
 
 
+_limits: dict[str, CollectorSpeedLimit] = {}
+_limits_lock = threading.Lock()
+
+
+def get_or_create_limit(name: str,
+                        max_per_second: int = 1000) -> CollectorSpeedLimit:
+    """Shared per-family limit registry — one place for the init-race
+    handling instead of double-checked-locking boilerplate per consumer."""
+    limit = _limits.get(name)
+    if limit is None:
+        with _limits_lock:
+            limit = _limits.get(name)
+            if limit is None:
+                limit = CollectorSpeedLimit(name, max_per_second)
+                _limits[name] = limit
+    return limit
+
+
 class Collector:
     _instance = None
     _instance_lock = threading.Lock()
@@ -77,6 +97,10 @@ class Collector:
 
     @classmethod
     def instance(cls) -> "Collector":
+        # lock-free fast path: this runs on every submission
+        inst = cls._instance
+        if inst is not None:
+            return inst
         with cls._instance_lock:
             if cls._instance is None:
                 cls._instance = cls()
@@ -85,19 +109,29 @@ class Collector:
     def __init__(self):
         self._mu = threading.Lock()
         self._drain_mu = threading.Lock()  # serializes drains so flush()
-        self._pending: list[Collected] = []  # waits out an in-flight batch
+        # family -> pending samples     # waits out an in-flight batch
+        self._pending: dict[str, list[Collected]] = {}
         self._wake = threading.Event()
         self._stopped = False
         self._thread: threading.Thread | None = None
 
     def submit(self, sample: Collected,
-               limit: CollectorSpeedLimit | None = None) -> bool:
+               limit: CollectorSpeedLimit | None = None,
+               family: str = "default") -> bool:
         """Hot-path handoff.  Returns False when the speed limit dropped
         the sample (dump_and_destroy will never run for it)."""
         if limit is not None and not limit.grab():
             return False
+        if self._stopped:
+            # no drainer will ever run again; honor the accept contract
+            # inline rather than stranding the sample
+            try:
+                sample.dump_and_destroy()
+            except Exception:
+                pass
+            return True
         with self._mu:
-            self._pending.append(sample)
+            self._pending.setdefault(family, []).append(sample)
             if self._thread is None and not self._stopped:
                 self._thread = threading.Thread(
                     target=self._run, daemon=True, name="bvar-collector")
@@ -105,21 +139,28 @@ class Collector:
         self._wake.set()
         return True
 
-    def flush(self) -> None:
-        """Drain everything submitted so far on THIS thread.  Readers that
-        must observe all prior submissions (the /rpcz page, dump close)
-        call this instead of sleeping a drain interval."""
-        self._drain()
+    def flush(self, family: str | None = None) -> None:
+        """Drain one family (or all, family=None) on THIS thread.  Readers
+        that must observe their own prior submissions (the /rpcz page,
+        dump close) flush their family only — never another consumer's
+        pending IO."""
+        self._drain(family)
 
-    def _drain(self) -> None:
+    def _drain(self, family: str | None = None) -> None:
         with self._drain_mu:
             with self._mu:
-                batch, self._pending = self._pending, []
-            for s in batch:
-                try:
-                    s.dump_and_destroy()
-                except Exception:
-                    pass  # a broken sample must never kill the drainer
+                if family is None:
+                    batches = list(self._pending.values())
+                    self._pending = {}
+                else:
+                    b = self._pending.pop(family, None)
+                    batches = [b] if b else []
+            for batch in batches:
+                for s in batch:
+                    try:
+                        s.dump_and_destroy()
+                    except Exception:
+                        pass  # a broken sample must never kill the drainer
 
     def _run(self) -> None:
         while not self._stopped:

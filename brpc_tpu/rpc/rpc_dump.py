@@ -66,23 +66,11 @@ class RpcDumper:
         # denied sample must cost nothing — bytes() copies of a large
         # body on the dispatch thread are exactly the overhead the
         # collector handoff exists to avoid.
-        if not self._speed_limit().grab():
+        from brpc_tpu.bvar.collector import Collector, get_or_create_limit
+        if not get_or_create_limit("rpc_dump", 1000).grab():
             return
-        from brpc_tpu.bvar.collector import Collector
-        Collector.instance().submit(_DumpSample(self, meta_bytes, body))
-
-    _limit = None
-    _limit_lock = threading.Lock()
-
-    @classmethod
-    def _speed_limit(cls):
-        from brpc_tpu.bvar.collector import CollectorSpeedLimit
-        if cls._limit is None:
-            with cls._limit_lock:
-                if cls._limit is None:
-                    cls._limit = CollectorSpeedLimit("rpc_dump",
-                                                     max_per_second=1000)
-        return cls._limit
+        Collector.instance().submit(_DumpSample(self, meta_bytes, body),
+                                    family="rpc_dump")
 
     def _write_sample(self, meta_bytes: bytes, body: bytes) -> None:
         with self._mu:
@@ -122,7 +110,7 @@ class RpcDumper:
     def close(self) -> None:
         # drain records still queued on the collector before closing
         from brpc_tpu.bvar.collector import Collector
-        Collector.instance().flush()
+        Collector.instance().flush(family="rpc_dump")
         with self._mu:
             if self._fp is not None:
                 self._fp.close()

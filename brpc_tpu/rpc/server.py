@@ -540,7 +540,10 @@ class Server:
         if self.options.interceptor is not None:
             verdict = self.options.interceptor(meta)
             if verdict is not None and verdict is not True:
-                code = verdict if isinstance(verdict, int) else errors.EREJECT
+                # bool is an int subtype: a plain `False` must mean
+                # EREJECT, not error code 0 (which reads as success)
+                code = verdict if isinstance(verdict, int) \
+                    and not isinstance(verdict, bool) else errors.EREJECT
                 self._respond_error(sid, meta, code)
                 return
         key = (meta.service, meta.method)

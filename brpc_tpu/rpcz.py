@@ -137,32 +137,23 @@ class _SpanSample:
             _collected.append(self.span)
 
 
-def _collector():
-    from brpc_tpu.bvar.collector import Collector, CollectorSpeedLimit
-    global _speed_limit
-    if _speed_limit is None:
-        with _limit_lock:
-            if _speed_limit is None:
-                _speed_limit = CollectorSpeedLimit("rpcz",
-                                                   max_per_second=2000)
-    return Collector.instance()
-
-
-_speed_limit = None
-_limit_lock = threading.Lock()
-
-
 def submit(span: Span) -> None:
     if not _enabled or span is NULL_SPAN:
         return
     if _sample_rate < 1.0 and random.random() > _sample_rate:
         return
     span.end_us = span.end_us or now_us()
-    _collector().submit(_SpanSample(span), _speed_limit)
+    from brpc_tpu.bvar.collector import Collector, get_or_create_limit
+    Collector.instance().submit(_SpanSample(span),
+                                get_or_create_limit("rpcz", 2000),
+                                family="rpcz")
 
 
 def recent_spans(limit: int = 100, trace_id: int | None = None) -> list[Span]:
-    _collector().flush()  # observe everything submitted before this call
+    # observe our own prior submissions; flushing ONLY the rpcz family
+    # keeps this (console) thread away from other consumers' IO
+    from brpc_tpu.bvar.collector import Collector
+    Collector.instance().flush(family="rpcz")
     with _collect_lock:
         spans = list(_collected)
     if trace_id is not None:

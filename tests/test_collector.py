@@ -36,17 +36,22 @@ class TestCollector:
         assert len(sink) == 100
 
     def test_speed_limit_bounds_grabs(self):
-        limit = CollectorSpeedLimit("test_family", max_per_second=50)
+        # injected clock: the 500-grab loop can never straddle a window
+        now = [100.0]
+        limit = CollectorSpeedLimit("test_family", max_per_second=50,
+                                    clock=lambda: now[0])
         granted = sum(1 for _ in range(500) if limit.grab())
         assert granted == 50
         # counters add up
         assert limit.grabbed.get_value() + limit.denied.get_value() >= 500
 
     def test_speed_limit_window_refills(self):
-        limit = CollectorSpeedLimit("test_refill", max_per_second=2)
+        now = [5.0]
+        limit = CollectorSpeedLimit("test_refill", max_per_second=2,
+                                    clock=lambda: now[0])
         assert limit.grab() and limit.grab()
         assert not limit.grab()
-        limit._window_start -= 1.1  # simulate the window rolling over
+        now[0] += 1.1                       # the window rolls over
         assert limit.grab()
 
     def test_broken_sample_does_not_kill_the_drainer(self):
@@ -65,23 +70,23 @@ class TestCollector:
         sink = []
         c = Collector.instance()
         stop = time.monotonic() + 0.5
+        counts = [0] * 4
 
-        def producer():
-            n = 0
+        def producer(i):
             while time.monotonic() < stop:
                 c.submit(_Probe(sink))
-                n += 1
-            return n
+                counts[i] += 1
 
-        ts = [threading.Thread(target=producer) for _ in range(4)]
+        ts = [threading.Thread(target=producer, args=(i,))
+              for i in range(4)]
         [t.start() for t in ts]
         while time.monotonic() < stop:
             c.flush()
         [t.join() for t in ts]
         c.flush()
-        # every submitted sample was dumped exactly once: len(sink) can't
-        # exceed submissions, and after the final flush nothing pends
-        assert c._pending == []
+        # exactly once: every submission dumped, none duplicated/lost
+        assert len(sink) == sum(counts)
+        assert not c._pending
 
 
 class TestRpczThroughCollector:

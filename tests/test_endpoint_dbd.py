@@ -1,0 +1,92 @@
+"""EndPoint parse/format matrix + DoublyBufferedData semantics (reference
+test/endpoint_unittest.cpp and containers/doubly_buffered_data tests)."""
+import threading
+
+import pytest
+
+from brpc_tpu.butil import DoublyBufferedData, EndPoint, str2endpoint
+
+
+class TestEndPointParse:
+    @pytest.mark.parametrize("s,host,port,scheme", [
+        ("10.0.0.3:8000", "10.0.0.3", 8000, "tcp"),
+        ("localhost:80", "localhost", 80, "tcp"),
+        (":9000", "127.0.0.1", 9000, "tcp"),
+        ("[::1]:8000", "::1", 8000, "tcp"),
+        ("[fe80::1%lo]:443", "fe80::1%lo", 443, "tcp"),
+        ("unix:/tmp/sock", "/tmp/sock", 0, "unix"),
+        ("ici://slice0/4", "slice0", 4, "ici"),
+        ("ici://pod", "pod", 0, "ici"),
+        ("bare-host", "bare-host", 0, "tcp"),
+        ("  10.0.0.1:1  ", "10.0.0.1", 1, "tcp"),
+    ])
+    def test_parse(self, s, host, port, scheme):
+        ep = str2endpoint(s)
+        assert (ep.host, ep.port, ep.scheme) == (host, port, scheme)
+
+    @pytest.mark.parametrize("s", [
+        "host:notaport",
+        "[::1]:bad",
+        "ici://slice/notachip",
+    ])
+    def test_parse_errors(self, s):
+        with pytest.raises(ValueError):
+            str2endpoint(s)
+
+    @pytest.mark.parametrize("s", [
+        "10.0.0.3:8000",
+        "[::1]:8000",
+        "unix:/tmp/sock",
+        "ici://slice0/4",
+    ])
+    def test_round_trip_through_str(self, s):
+        ep = str2endpoint(s)
+        assert str2endpoint(str(ep)) == ep
+
+    def test_value_semantics(self):
+        a = str2endpoint("1.2.3.4:5")
+        b = EndPoint("1.2.3.4", 5)
+        assert a == b
+        assert hash(a) == hash(b)
+        assert len({a, b}) == 1
+
+
+class TestDoublyBufferedData:
+    def test_read_sees_modify(self):
+        d = DoublyBufferedData([1, 2])
+        d.modify(lambda v: v + [3])
+        assert d.read() == [1, 2, 3]
+
+    def test_modify_is_copy_on_write(self):
+        d = DoublyBufferedData((1,))
+        before = d.read()
+        d.modify(lambda v: v + (2,))
+        # the old snapshot a reader may still hold is untouched
+        assert before == (1,)
+        assert d.read() == (1, 2)
+
+    def test_concurrent_readers_never_see_torn_state(self):
+        # invariant: the list is always [0..n) for some n
+        d = DoublyBufferedData(list(range(1)))
+        bad = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                v = d.read()
+                if v != list(range(len(v))):
+                    bad.append(list(v))
+                    return
+
+        ts = [threading.Thread(target=reader) for _ in range(4)]
+        [t.start() for t in ts]
+        for n in range(2, 300):
+            d.modify(lambda v, n=n: list(range(n)))
+        stop.set()
+        [t.join() for t in ts]
+        assert not bad
+
+    def test_modify_returns_new_value(self):
+        d = DoublyBufferedData(5)
+        out = d.modify(lambda v: v + 1)
+        assert out == 6 and d.read() == 6

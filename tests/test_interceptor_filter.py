@@ -1,0 +1,124 @@
+"""Interceptor + NamingServiceFilter coverage (reference interceptor.h:26,
+naming_service_filter.h — both extension hooks had no tests)."""
+import threading
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.policy.load_balancer import RoundRobinLB, ServerNode
+from brpc_tpu.policy.naming import (NamingServiceFilter,
+                                    start_naming_service)
+from brpc_tpu.rpc.server import ServerOptions
+
+
+class Echo(brpc.Service):
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return req
+
+
+class TestInterceptor:
+    def _serve(self, interceptor):
+        srv = brpc.Server(options=ServerOptions(interceptor=interceptor))
+        srv.add_service(Echo())
+        srv.start("127.0.0.1", 0)
+        return srv
+
+    def test_true_and_none_admit(self):
+        for verdict in (True, None):
+            srv = self._serve(lambda meta, v=verdict: v)
+            try:
+                ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+                assert ch.call_sync("Echo", "Echo", b"ok",
+                                    serializer="raw") == b"ok"
+            finally:
+                srv.stop()
+                srv.join()
+
+    def test_false_rejects_with_ereject(self):
+        srv = self._serve(lambda meta: False)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            with pytest.raises(errors.RpcError) as ei:
+                ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+            assert ei.value.code == errors.EREJECT
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_custom_error_code(self):
+        srv = self._serve(lambda meta: errors.ERPCAUTH)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            with pytest.raises(errors.RpcError) as ei:
+                ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+            assert ei.value.code == errors.ERPCAUTH
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_interceptor_sees_request_meta(self):
+        seen = []
+
+        def spy(meta):
+            seen.append((meta.service, meta.method))
+            return True
+
+        srv = self._serve(spy)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+            assert ("Echo", "Echo") in seen
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_rejection_leaves_server_healthy(self):
+        calls = []
+        gate = {"open": False}
+
+        def toggle(meta):
+            calls.append(1)
+            return True if gate["open"] else False
+
+        srv = self._serve(toggle)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            for _ in range(3):
+                with pytest.raises(errors.RpcError):
+                    ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+            gate["open"] = True
+            assert ch.call_sync("Echo", "Echo", b"y",
+                                serializer="raw") == b"y"
+            assert len(calls) == 4
+        finally:
+            srv.stop()
+            srv.join()
+
+
+class TestNamingServiceFilter:
+    def test_filter_drops_nodes_before_lb(self):
+        class OnlyEven(NamingServiceFilter):
+            def accept(self, node: ServerNode) -> bool:
+                return node.endpoint.port % 2 == 0
+
+        lb = RoundRobinLB()
+        t = start_naming_service(
+            "list://h:1000,h:1001,h:1002,h:1003", lb, OnlyEven())
+        try:
+            assert t.wait_first_resolution(5)
+            ports = sorted(n.endpoint.port for n in lb.servers())
+            assert ports == [1000, 1002]
+        finally:
+            t.stop()
+
+    def test_default_filter_accepts_everything(self):
+        lb = RoundRobinLB()
+        t = start_naming_service("list://h:1,h:2", lb,
+                                 NamingServiceFilter())
+        try:
+            assert t.wait_first_resolution(5)
+            assert len(lb.servers()) == 2
+        finally:
+            t.stop()

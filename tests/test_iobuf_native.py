@@ -1,0 +1,190 @@
+"""Native IOBuf behavioral matrix through the C API (mirrors the
+reference's test/iobuf_unittest.cpp scope: append/cut/copy/pop across
+block boundaries, zero-copy sharing, user-memory blocks, block
+accounting — SURVEY.md §2.1, §4)."""
+import ctypes
+import gc
+
+import pytest
+
+from brpc_tpu._core import core
+from brpc_tpu._core.lib import DELETER_CB
+
+BLOCK_PAYLOAD = 8192 - 64  # iobuf::kDefaultPayload
+
+
+class Buf:
+    """RAII wrapper for a native IOBuf handle."""
+
+    def __init__(self):
+        self.h = core.brpc_iobuf_new()
+
+    def free(self):
+        if self.h:
+            core.brpc_iobuf_free(self.h)
+            self.h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.free()
+
+    # convenience
+    def append(self, data: bytes):
+        core.brpc_iobuf_append(self.h, data, len(data))
+
+    def size(self) -> int:
+        return core.brpc_iobuf_size(self.h)
+
+    def blocks(self) -> int:
+        return core.brpc_iobuf_block_num(self.h)
+
+    def tostr(self) -> bytes:
+        n = self.size()
+        out = ctypes.create_string_buffer(max(n, 1))
+        got = core.brpc_iobuf_copy_to(self.h, out, n, 0)
+        return ctypes.string_at(out, got)
+
+
+class TestAppendCut:
+    def test_small_appends_merge_refs(self):
+        with Buf() as b:
+            for i in range(100):
+                b.append(b"a" * 10)
+            assert b.size() == 1000
+            # contiguous writes through the shared block merge into few refs
+            assert b.blocks() <= 2
+            assert b.tostr() == b"a" * 1000
+
+    def test_cross_block_content(self):
+        with Buf() as b:
+            pattern = bytes(range(256))
+            total = BLOCK_PAYLOAD * 3 + 17
+            reps = total // 256 + 1
+            data = (pattern * reps)[:total]
+            b.append(data)
+            assert b.size() == total
+            assert b.blocks() >= 3
+            assert b.tostr() == data
+
+    def test_copy_to_offsets(self):
+        with Buf() as b:
+            data = bytes(range(256)) * 40  # 10240 bytes, > 1 block
+            b.append(data)
+            win = ctypes.create_string_buffer(100)
+            for pos in (0, 1, 255, 256, 8000, 10200):
+                got = core.brpc_iobuf_copy_to(b.h, win, 100, pos)
+                assert ctypes.string_at(win, got) == data[pos:pos + 100]
+
+    def test_cutn_zero_copy_moves_refs(self):
+        with Buf() as src, Buf() as dst:
+            data = b"0123456789" * 2000
+            src.append(data)
+            moved = core.brpc_iobuf_cutn(src.h, dst.h, 12345)
+            assert moved == 12345
+            assert src.size() == len(data) - 12345
+            assert dst.size() == 12345
+            assert dst.tostr() == data[:12345]
+            assert src.tostr() == data[12345:]
+
+    def test_cutn_more_than_size(self):
+        with Buf() as src, Buf() as dst:
+            src.append(b"abc")
+            moved = core.brpc_iobuf_cutn(src.h, dst.h, 100)
+            assert moved == 3
+            assert src.size() == 0
+
+    def test_pop_front_partial_and_whole_refs(self):
+        with Buf() as b:
+            b.append(b"x" * 100)
+            assert core.brpc_iobuf_pop_front(b.h, 40) == 40
+            assert b.size() == 60
+            assert b.tostr() == b"x" * 60
+            assert core.brpc_iobuf_pop_front(b.h, 1000) == 60
+            assert b.size() == 0
+
+    def test_append_iobuf_shares_blocks(self):
+        with Buf() as a, Buf() as b:
+            a.append(b"hello world" * 100)
+            before = core.brpc_iobuf_live_blocks()
+            core.brpc_iobuf_append_iobuf(b.h, a.h)
+            after = core.brpc_iobuf_live_blocks()
+            assert after == before            # shared, not copied
+            assert b.tostr() == a.tostr()
+            # source still intact (refcount sharing, not steal)
+            assert a.size() == 1100
+
+    def test_clear_resets(self):
+        with Buf() as b:
+            b.append(b"data")
+            core.brpc_iobuf_clear(b.h)
+            assert b.size() == 0
+            assert b.blocks() == 0
+
+
+class TestUserData:
+    def test_user_block_deleter_runs_on_release(self):
+        freed = []
+        raw = ctypes.create_string_buffer(b"user-memory-payload")
+
+        def deleter(data, arg):
+            freed.append(True)
+
+        cb = DELETER_CB(deleter)
+        with Buf() as b:
+            core.brpc_iobuf_append_user_data(
+                b.h, ctypes.cast(raw, ctypes.c_void_p), 19, cb, None)
+            assert b.size() == 19
+            assert b.tostr() == b"user-memory-payload"
+            assert not freed
+        gc.collect()
+        assert freed == [True]
+
+    def test_zero_length_user_data_runs_deleter_immediately(self):
+        freed = []
+
+        def deleter(data, arg):
+            freed.append(True)
+
+        cb = DELETER_CB(deleter)
+        with Buf() as b:
+            core.brpc_iobuf_append_user_data(b.h, None, 0, cb, None)
+            assert b.size() == 0
+            assert b.blocks() == 0
+            assert freed == [True]   # ownership honored exactly once
+
+    def test_user_block_shared_across_cut(self):
+        freed = []
+        raw = ctypes.create_string_buffer(b"A" * 1000)
+        cb = DELETER_CB(lambda d, a: freed.append(1))
+        with Buf() as src, Buf() as dst:
+            core.brpc_iobuf_append_user_data(
+                src.h, ctypes.cast(raw, ctypes.c_void_p), 1000, cb, None)
+            core.brpc_iobuf_cutn(src.h, dst.h, 400)
+            assert not freed             # dst still references the block
+            assert dst.tostr() == b"A" * 400
+        assert freed == [1]
+
+
+class TestBlockAccounting:
+    def test_no_leak_over_churn(self):
+        base = core.brpc_iobuf_live_blocks()
+        for _ in range(50):
+            with Buf() as b:
+                b.append(b"z" * (BLOCK_PAYLOAD * 2))
+                with Buf() as c:
+                    core.brpc_iobuf_cutn(b.h, c.h, BLOCK_PAYLOAD)
+        # TLS cache may retain up to its cap, but growth must be bounded
+        assert core.brpc_iobuf_live_blocks() - base <= 80
+
+
+@pytest.mark.parametrize("n", [0, 1, 255, BLOCK_PAYLOAD,
+                               BLOCK_PAYLOAD + 1, BLOCK_PAYLOAD * 2 + 7])
+def test_roundtrip_sizes(n):
+    with Buf() as b:
+        data = bytes((i * 7) & 0xFF for i in range(n))
+        if n:
+            b.append(data)
+        assert b.size() == n
+        assert b.tostr() == data

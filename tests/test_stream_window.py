@@ -1,0 +1,119 @@
+"""Streaming credit-window flow control (reference stream.cpp:274-290:
+writer blocks/fails once produced - remote_consumed exceeds the window;
+CONSUMED feedback advances it — SURVEY.md §5.7)."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+
+
+def _start_consumer_server(consume_gate: threading.Event, received):
+    """Server whose stream handler blocks until `consume_gate` is set —
+    the 'slow consumer' end of the window."""
+
+    class Slow(brpc.Service):
+        NAME = "SlowStream"
+
+        @brpc.method(request="json", response="json")
+        def Start(self, cntl, req):
+            def on_msg(stream, data):
+                consume_gate.wait(20)
+                received.append(data)
+            cntl.accept_stream(on_msg, max_buf_size=16 * 1024)
+            return {"ok": True}
+
+    srv = brpc.Server()
+    srv.add_service(Slow())
+    srv.start("127.0.0.1", 0)
+    return srv
+
+
+class TestCreditWindow:
+    def test_writer_blocks_until_feedback_advances(self):
+        gate = threading.Event()
+        received = []
+        srv = _start_consumer_server(gate, received)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            cntl = brpc.Controller()
+            stream = brpc.stream_create(cntl, lambda s, d: None,
+                                        max_buf_size=16 * 1024)
+            ch.call_sync("SlowStream", "Start", {}, serializer="json",
+                         cntl=cntl)
+            chunk = b"c" * 4096
+            # fill the 16KB window (4 chunks); the 5th must block
+            for _ in range(4):
+                stream.write(chunk, timeout_s=5)
+            t0 = time.monotonic()
+            blocked = threading.Event()
+            unblocked = threading.Event()
+
+            def fifth():
+                blocked.set()
+                stream.write(chunk, timeout_s=15)
+                unblocked.set()
+
+            t = threading.Thread(target=fifth)
+            t.start()
+            blocked.wait(5)
+            # writer must still be parked after a grace period
+            assert not unblocked.wait(0.5), \
+                "write returned with the window full"
+            gate.set()                      # consumer drains -> feedback
+            assert unblocked.wait(15), "feedback never advanced the window"
+            t.join()
+            assert time.monotonic() - t0 >= 0.4
+            stream.close()
+        finally:
+            gate.set()
+            srv.stop()
+            srv.join()
+
+    def test_write_times_out_when_peer_never_consumes(self):
+        gate = threading.Event()          # never set during the writes
+        received = []
+        srv = _start_consumer_server(gate, received)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            cntl = brpc.Controller()
+            stream = brpc.stream_create(cntl, lambda s, d: None,
+                                        max_buf_size=16 * 1024)
+            ch.call_sync("SlowStream", "Start", {}, serializer="json",
+                         cntl=cntl)
+            chunk = b"c" * 8192
+            with pytest.raises(errors.RpcError) as ei:
+                for _ in range(8):        # window is 2 chunks deep
+                    stream.write(chunk, timeout_s=1.0)
+            assert "window full" in str(ei.value)
+            stream.close()
+        finally:
+            gate.set()
+            srv.stop()
+            srv.join()
+
+    def test_all_bytes_delivered_after_slow_drain(self):
+        gate = threading.Event()
+        received = []
+        srv = _start_consumer_server(gate, received)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            cntl = brpc.Controller()
+            stream = brpc.stream_create(cntl, lambda s, d: None,
+                                        max_buf_size=16 * 1024)
+            ch.call_sync("SlowStream", "Start", {}, serializer="json",
+                         cntl=cntl)
+            gate.set()                    # consumer runs freely
+            chunks = [b"%04d" % i + b"p" * 2000 for i in range(40)]
+            for c in chunks:
+                stream.write(c, timeout_s=10)
+            deadline = time.monotonic() + 15
+            while len(received) < len(chunks) and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert received == chunks     # exact order, nothing dropped
+            stream.close()
+        finally:
+            srv.stop()
+            srv.join()
