@@ -236,6 +236,12 @@ _sigs = {
     "brpc_fiber_cond_stress": (ctypes.c_int64, [ctypes.c_int64,
                                                 ctypes.c_int]),
     # CallId (bthread_id analog, src/cc/bthread/id.h)
+    # fd wait (net/fd_wait.h): events bit1=read, bit2=write
+    "brpc_fd_wait": (ctypes.c_int, [ctypes.c_int, ctypes.c_uint32,
+                                    ctypes.c_int]),
+    "brpc_fiber_fd_wait_probe": (ctypes.c_int, [ctypes.c_int,
+                                                ctypes.c_uint32,
+                                                ctypes.c_int]),
     "brpc_id_create": (ctypes.c_uint64, [ctypes.c_uint32]),
     "brpc_id_valid": (ctypes.c_int, [ctypes.c_uint64]),
     "brpc_id_trylock": (ctypes.c_int, [ctypes.c_uint64]),

@@ -108,8 +108,11 @@ class Collector:
 
     def __init__(self):
         self._mu = threading.Lock()
-        self._drain_mu = threading.Lock()  # serializes drains so flush()
-        # family -> pending samples     # waits out an in-flight batch
+        # per-family drain locks: flush("rpcz") must neither perform NOR
+        # wait on another family's in-flight IO (a console thread parked
+        # behind a disk-stalled rpc_dump batch is the same outage as
+        # doing the writes itself)
+        self._drain_locks: dict[str, threading.Lock] = {}
         self._pending: dict[str, list[Collected]] = {}
         self._wake = threading.Event()
         self._stopped = False
@@ -122,7 +125,19 @@ class Collector:
         the sample (dump_and_destroy will never run for it)."""
         if limit is not None and not limit.grab():
             return False
-        if self._stopped:
+        with self._mu:
+            # the stopped check must be under the lock: stop()'s final
+            # drain holds it too, so a sample either lands before that
+            # drain (and is consumed by it) or observes _stopped here
+            stopped = self._stopped
+            if not stopped:
+                self._pending.setdefault(family, []).append(sample)
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._run, daemon=True,
+                        name="bvar-collector")
+                    self._thread.start()
+        if stopped:
             # no drainer will ever run again; honor the accept contract
             # inline rather than stranding the sample
             try:
@@ -130,12 +145,6 @@ class Collector:
             except Exception:
                 pass
             return True
-        with self._mu:
-            self._pending.setdefault(family, []).append(sample)
-            if self._thread is None and not self._stopped:
-                self._thread = threading.Thread(
-                    target=self._run, daemon=True, name="bvar-collector")
-                self._thread.start()
         self._wake.set()
         return True
 
@@ -146,21 +155,28 @@ class Collector:
         pending IO."""
         self._drain(family)
 
+    def _drain_lock(self, family: str) -> threading.Lock:
+        with self._mu:
+            lock = self._drain_locks.get(family)
+            if lock is None:
+                lock = self._drain_locks[family] = threading.Lock()
+            return lock
+
     def _drain(self, family: str | None = None) -> None:
-        with self._drain_mu:
+        if family is None:
             with self._mu:
-                if family is None:
-                    batches = list(self._pending.values())
-                    self._pending = {}
-                else:
-                    b = self._pending.pop(family, None)
-                    batches = [b] if b else []
-            for batch in batches:
-                for s in batch:
-                    try:
-                        s.dump_and_destroy()
-                    except Exception:
-                        pass  # a broken sample must never kill the drainer
+                families = list(self._pending.keys())
+            for f in families:
+                self._drain(f)
+            return
+        with self._drain_lock(family):
+            with self._mu:
+                batch = self._pending.pop(family, None)
+            for s in batch or ():
+                try:
+                    s.dump_and_destroy()
+                except Exception:
+                    pass  # a broken sample must never kill the drainer
 
     def _run(self) -> None:
         while not self._stopped:
@@ -169,6 +185,7 @@ class Collector:
             self._drain()
 
     def stop(self) -> None:
-        self._stopped = True
+        with self._mu:          # order against submit's locked check
+            self._stopped = True
         self._wake.set()
         self._drain()

@@ -540,10 +540,12 @@ class Server:
         if self.options.interceptor is not None:
             verdict = self.options.interceptor(meta)
             if verdict is not None and verdict is not True:
-                # bool is an int subtype: a plain `False` must mean
-                # EREJECT, not error code 0 (which reads as success)
+                # bool is an int subtype and error code 0 reads as
+                # success on the client: both `False` and a C-style 0
+                # must mean EREJECT, not a silent empty success
                 code = verdict if isinstance(verdict, int) \
-                    and not isinstance(verdict, bool) else errors.EREJECT
+                    and not isinstance(verdict, bool) and verdict != 0 \
+                    else errors.EREJECT
                 self._respond_error(sid, meta, code)
                 return
         key = (meta.service, meta.method)

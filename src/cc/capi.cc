@@ -13,6 +13,7 @@
 #include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
 #include "net/parser.h"
+#include "net/fd_wait.h"
 #include "net/socket.h"
 
 using butil::IOBuf;
@@ -612,6 +613,20 @@ Fiber sleep_probe_body(SleepProbe* p, int64_t us) {
   unref(p);
 }
 
+struct FdWaitProbe {
+  CountdownEvent done{1};
+  std::atomic<int> refs{2};
+  std::atomic<int> rc{-1};
+};
+
+Fiber fd_wait_probe_body(FdWaitProbe* p, int fd, uint32_t events, int to) {
+  int rc = -1;
+  co_await brpc::fiber_fd_wait(fd, events, to, &rc);
+  p->rc.store(rc, std::memory_order_release);
+  p->done.signal();
+  unref(p);
+}
+
 }  // namespace
 
 extern "C" {
@@ -814,6 +829,29 @@ int64_t brpc_id_destroy_stress(int fibers, int timeout_ms) {
   const bool ok = poll_countdown(&st->done, timeout_ms);
   const int64_t v = ok ? st->einval.load() : -1;
   unref(st);
+  return v;
+}
+
+// ---- fd wait (net/fd_wait.h; reference bthread_fd_wait fd.cpp:343) ----
+
+int brpc_fd_wait(int fd, uint32_t events, int timeout_ms) {
+  return brpc::fd_wait(fd, events, timeout_ms);
+}
+
+// Spawns a fiber running fiber_fd_wait and joins it from this pthread:
+// proves the park/deliver path from Python.  Returns the wait rc, or -1
+// when the fiber never finished inside the poll budget.
+int brpc_fiber_fd_wait_probe(int fd, uint32_t events, int timeout_ms) {
+  auto* p = new FdWaitProbe();
+  // Clamp "wait forever" to below the poll budget: a fiber outliving the
+  // poll would leak the probe AND leave the fd armed in the registry,
+  // poisoning every later wait on it with EEXIST.
+  const int fiber_to = (timeout_ms < 0 || timeout_ms > 55000) ? 55000
+                                                              : timeout_ms;
+  fd_wait_probe_body(p, fd, events, fiber_to).spawn();
+  const bool ok = poll_countdown(&p->done, fiber_to + 5000);
+  const int v = ok ? p->rc.load(std::memory_order_acquire) : -1;
+  unref(p);
   return v;
 }
 

@@ -1,0 +1,159 @@
+#include "net/fd_wait.h"
+
+#include <errno.h>
+#include <poll.h>
+#include <sys/epoll.h>
+#include <unistd.h>
+
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "bthread/butex.h"
+#include "butil/common.h"
+
+namespace brpc {
+
+int fd_wait(int fd, uint32_t events, int timeout_ms) {
+  pollfd p;
+  p.fd = fd;
+  p.events = 0;
+  if (events & FD_WAIT_READ) p.events |= POLLIN;
+  if (events & FD_WAIT_WRITE) p.events |= POLLOUT;
+  // EINTR restarts must not extend the deadline (a SIGPROF storm would
+  // otherwise make a 150ms wait unbounded)
+  const int64_t deadline_us =
+      timeout_ms < 0 ? -1 : butil::monotonic_time_us() +
+                                (int64_t)timeout_ms * 1000;
+  for (;;) {
+    int remaining = -1;
+    if (deadline_us >= 0) {
+      const int64_t left = deadline_us - butil::monotonic_time_us();
+      if (left <= 0) return ETIMEDOUT;
+      remaining = (int)((left + 999) / 1000);
+    }
+    const int rc = poll(&p, 1, remaining);
+    if (rc > 0) {
+      // an invalid fd is an error, not readiness (POLLERR/POLLHUP count
+      // as ready: the caller's IO surfaces the condition, like epoll)
+      return (p.revents & POLLNVAL) ? EBADF : 0;
+    }
+    if (rc == 0) return ETIMEDOUT;
+    if (errno != EINTR) return errno;
+  }
+}
+
+namespace {
+
+struct FdWaiter {
+  bthread::Butex ready{0};
+};
+
+// One shared epoll + thread watching fibers' one-shot fd waits.  ALL
+// waiter touches by the epoll thread happen under the registry lock —
+// including the butex bump and wake_all — so a timed-out fiber that
+// takes the lock and finds itself already claimed can safely free its
+// frame after returning: the claimer is provably done with it.
+class WaitRegistry {
+ public:
+  static WaitRegistry* instance() {
+    static WaitRegistry reg;
+    return &reg;
+  }
+
+  // 0 on success; EEXIST when the fd already has a waiter; errno else.
+  int arm(int fd, uint32_t events, FdWaiter* w) {
+    std::lock_guard<std::mutex> g(_mu);
+    if (!_map.emplace(fd, w).second) return EEXIST;
+    epoll_event ev;
+    ev.events = EPOLLONESHOT | EPOLLRDHUP;
+    if (events & FD_WAIT_READ) ev.events |= EPOLLIN;
+    if (events & FD_WAIT_WRITE) ev.events |= EPOLLOUT;
+    ev.data.fd = fd;
+    if (epoll_ctl(_epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
+      const int err = errno;
+      _map.erase(fd);
+      return err;
+    }
+    return 0;
+  }
+
+  // Timeout/cancel path: true when WE removed the waiter (not yet
+  // claimed by the epoll thread); false when delivery already happened.
+  bool disarm(int fd, FdWaiter* w) {
+    std::lock_guard<std::mutex> g(_mu);
+    auto it = _map.find(fd);
+    if (it == _map.end() || it->second != w) return false;
+    _map.erase(it);
+    epoll_ctl(_epfd, EPOLL_CTL_DEL, fd, nullptr);
+    return true;
+  }
+
+ private:
+  WaitRegistry() {
+    _epfd = epoll_create1(EPOLL_CLOEXEC);
+    _thread = std::thread([this] { run(); });
+    _thread.detach();  // process-lifetime singleton
+  }
+
+  void run() {
+    epoll_event events[32];
+    for (;;) {
+      const int n = epoll_wait(_epfd, events, 32, -1);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        BLOG(ERROR, "fd_wait epoll_wait failed: %d", errno);
+        return;
+      }
+      for (int i = 0; i < n; ++i) {
+        const int fd = events[i].data.fd;
+        std::lock_guard<std::mutex> g(_mu);
+        auto it = _map.find(fd);
+        if (it == _map.end()) continue;  // raced with disarm
+        FdWaiter* w = it->second;
+        _map.erase(it);
+        epoll_ctl(_epfd, EPOLL_CTL_DEL, fd, nullptr);
+        w->ready.value.fetch_add(1, std::memory_order_release);
+        w->ready.wake_all();
+        // no touches of w after the lock drops — see class comment
+      }
+    }
+  }
+
+  int _epfd = -1;
+  std::mutex _mu;
+  std::unordered_map<int, FdWaiter*> _map;
+  std::thread _thread;
+};
+
+}  // namespace
+
+bthread::Task fiber_fd_wait(int fd, uint32_t events, int timeout_ms,
+                            int* rc_out) {
+  FdWaiter w;
+  const int arm_rc = WaitRegistry::instance()->arm(fd, events, &w);
+  if (arm_rc != 0) {
+    *rc_out = arm_rc;
+    co_return;
+  }
+  const int64_t timeout_us =
+      timeout_ms < 0 ? -1 : (int64_t)timeout_ms * 1000;
+  const auto r = co_await w.ready.wait(0, timeout_us);
+  // EVERY exit path must pass through disarm's registry lock before the
+  // frame (and the butex inside it) dies: the epoll thread bumps the
+  // value and calls wake_all while holding that lock, so a fiber that
+  // raced past the wait (kMismatch: the bump landed before we enqueued;
+  // kWoken: resumed while wake_all was still returning) would otherwise
+  // free the butex out from under the waker — the lock acquisition
+  // proves the claimer is completely done with the waiter.
+  const bool we_removed = WaitRegistry::instance()->disarm(fd, &w);
+  if (r == bthread::WaitResult::kTimeout) {
+    // losing the disarm race means the event arrived between our
+    // timeout and the lock — that is a delivery
+    *rc_out = we_removed ? ETIMEDOUT : 0;
+  } else {
+    *rc_out = 0;
+  }
+}
+
+}  // namespace brpc

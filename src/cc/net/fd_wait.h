@@ -1,0 +1,30 @@
+// fd_wait — general readiness-wait API (SURVEY.md §2.2 "fd wait" row;
+// reference src/bthread/fd.cpp:343,442 bthread_fd_wait).
+//
+// Two forms:
+//   * fd_wait()        — pthread-blocking, for Python/foreign threads.
+//     A plain poll(2): the calling OS thread sleeps in the kernel.
+//   * fiber_fd_wait()  — parks the calling COROUTINE on a butex while a
+//     shared epoll watches the fd: a blocked wait costs a heap frame,
+//     not an OS thread, exactly the reference's bthread_fd_wait
+//     economics.  One waiter per fd at a time (EEXIST otherwise).
+#pragma once
+
+#include <cstdint>
+
+#include "bthread/fiber.h"
+
+namespace brpc {
+
+// Event bits (deliberately not raw EPOLL* so the C API is stable).
+constexpr uint32_t FD_WAIT_READ = 1;
+constexpr uint32_t FD_WAIT_WRITE = 2;
+
+// Returns 0 when ready, ETIMEDOUT, or a positive errno.
+int fd_wait(int fd, uint32_t events, int timeout_ms);
+
+// Fiber form: *rc_out receives the same codes as fd_wait.
+bthread::Task fiber_fd_wait(int fd, uint32_t events, int timeout_ms,
+                            int* rc_out);
+
+}  // namespace brpc

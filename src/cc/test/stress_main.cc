@@ -29,6 +29,7 @@
 #include "bthread/timer.h"
 #include "butil/iobuf.h"
 #include "net/event_dispatcher.h"
+#include "net/fd_wait.h"
 #include "net/socket.h"
 
 #define CHECK_EQ(a, b)                                                     \
@@ -133,6 +134,59 @@ static void stress_iobuf_companions() {
   CHECK_EQ((long long)big.size(), 0LL);
   CHECK_EQ((long long)rest.size(), (long long)(expect.size() - 4));
   printf("iobuf companions: appender/iterator/cutter invariants held\n");
+}
+
+// ---- 0c. fiber fd_wait: parked fibers vs racing writers/timeouts ----
+static void wait_countdown(CountdownEvent* e, int seconds);
+struct FdwSt {
+  CountdownEvent done;
+  std::atomic<int> ready{0};
+  std::atomic<int> timed_out{0};
+  std::atomic<int> refs;
+  explicit FdwSt(int n) : done(n), refs(n + 1) {}
+};
+static Fiber fdw_body(FdwSt* s, int fd, int timeout_ms) {
+  int rc = -1;
+  co_await brpc::fiber_fd_wait(fd, brpc::FD_WAIT_READ, timeout_ms, &rc);
+  if (rc == 0) s->ready.fetch_add(1);
+  if (rc == ETIMEDOUT) s->timed_out.fetch_add(1);
+  s->done.signal();
+  if (s->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete s;
+}
+static void stress_fd_wait() {
+  const int kPairs = 32;
+  int rfd[kPairs], wfd[kPairs];
+  for (int i = 0; i < kPairs; ++i) {
+    int p[2];
+    if (pipe(p) != 0) { perror("pipe"); exit(1); }
+    rfd[i] = p[0];
+    wfd[i] = p[1];
+  }
+  auto* s = new FdwSt(kPairs);
+  // even pipes get a racing writer (should deliver), odd ones time out
+  for (int i = 0; i < kPairs; ++i) {
+    fdw_body(s, rfd[i], (i % 2 == 0) ? 5000 : 120).spawn();
+  }
+  std::vector<std::thread> writers;
+  for (int i = 0; i < kPairs; i += 2) {
+    writers.emplace_back([fd = wfd[i]] {
+      std::this_thread::sleep_for(std::chrono::milliseconds(5));
+      const char c = 1;
+      ssize_t rc = write(fd, &c, 1);
+      (void)rc;
+    });
+  }
+  for (auto& t : writers) t.join();
+  wait_countdown(&s->done, 60);
+  CHECK_EQ(s->ready.load(), kPairs / 2);
+  CHECK_EQ(s->timed_out.load(), kPairs / 2);
+  if (s->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) delete s;
+  for (int i = 0; i < kPairs; ++i) {
+    close(rfd[i]);
+    close(wfd[i]);
+  }
+  printf("fd_wait: %d delivered + %d timed out, frames reclaimed\n",
+         kPairs / 2, kPairs / 2);
 }
 
 // ---- 1. Chase-Lev: owner pops + thieves steal must conserve tasks ----
@@ -479,6 +533,7 @@ int main() {
   (void)Executor::global();
   stress_bounded_queue();
   stress_iobuf_companions();
+  stress_fd_wait();
   stress_wsq();
   stress_executor();
   stress_butex();

@@ -1,0 +1,132 @@
+"""fd_wait — the general readiness-wait API (reference bthread_fd_wait,
+src/bthread/fd.cpp:343,442; SURVEY.md §2.2 "fd wait" row).
+
+Blocking form = poll(2) for pthread callers; fiber form parks a coroutine
+frame on a shared epoll (brpc_fiber_fd_wait_probe spawns the fiber and
+joins it, proving park + delivery end to end)."""
+import errno
+import os
+import socket
+import threading
+import time
+
+from brpc_tpu._core import core, core_init
+
+FD_READ = 1
+FD_WRITE = 2
+
+ETIMEDOUT = errno.ETIMEDOUT
+
+
+def setup_module(m):
+    core_init()
+
+
+class TestBlockingForm:
+    def test_ready_immediately(self):
+        r, w = os.pipe()
+        try:
+            os.write(w, b"x")
+            assert core.brpc_fd_wait(r, FD_READ, 1000) == 0
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_write_side_ready(self):
+        r, w = os.pipe()
+        try:
+            assert core.brpc_fd_wait(w, FD_WRITE, 1000) == 0
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_timeout(self):
+        r, w = os.pipe()
+        try:
+            t0 = time.monotonic()
+            assert core.brpc_fd_wait(r, FD_READ, 150) == ETIMEDOUT
+            assert time.monotonic() - t0 >= 0.14
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_becomes_ready_while_waiting(self):
+        r, w = os.pipe()
+        try:
+            threading.Timer(0.1, lambda: os.write(w, b"go")).start()
+            t0 = time.monotonic()
+            assert core.brpc_fd_wait(r, FD_READ, 5000) == 0
+            assert time.monotonic() - t0 < 4
+        finally:
+            os.close(r)
+            os.close(w)
+
+
+class TestFiberForm:
+    def test_fiber_parks_then_delivers(self):
+        r, w = os.pipe()
+        try:
+            threading.Timer(0.15, lambda: os.write(w, b"go")).start()
+            t0 = time.monotonic()
+            assert core.brpc_fiber_fd_wait_probe(r, FD_READ, 5000) == 0
+            dt = time.monotonic() - t0
+            assert 0.1 <= dt < 4
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_fiber_timeout(self):
+        r, w = os.pipe()
+        try:
+            assert core.brpc_fiber_fd_wait_probe(r, FD_READ, 200) == \
+                ETIMEDOUT
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_fiber_immediate_ready(self):
+        r, w = os.pipe()
+        try:
+            os.write(w, b"x")
+            assert core.brpc_fiber_fd_wait_probe(r, FD_READ, 2000) == 0
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_second_waiter_on_same_fd_rejected(self):
+        r, w = os.pipe()
+        try:
+            results = []
+            t = threading.Thread(
+                target=lambda: results.append(
+                    core.brpc_fiber_fd_wait_probe(r, FD_READ, 2000)))
+            t.start()
+            time.sleep(0.15)           # first fiber is parked on r
+            rc2 = core.brpc_fiber_fd_wait_probe(r, FD_READ, 300)
+            assert rc2 == errno.EEXIST
+            os.write(w, b"release")
+            t.join(5)
+            assert results == [0]
+        finally:
+            os.close(r)
+            os.close(w)
+
+    def test_socket_readiness(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        cli = socket.socket()
+        cli.connect(srv.getsockname())
+        conn, _ = srv.accept()
+        try:
+            # nothing to read yet
+            assert core.brpc_fiber_fd_wait_probe(
+                conn.fileno(), FD_READ, 150) == ETIMEDOUT
+            threading.Timer(0.1, lambda: cli.send(b"data")).start()
+            assert core.brpc_fiber_fd_wait_probe(
+                conn.fileno(), FD_READ, 5000) == 0
+            assert conn.recv(16) == b"data"
+        finally:
+            conn.close()
+            cli.close()
+            srv.close()
