@@ -583,6 +583,23 @@ class Channel:
             content_type=ser.name,
             tensor_header=tensor_header,
         )
+        if cntl.user_fields:
+            # caller-supplied opaque metadata (request_user_fields slot);
+            # copied so a reused Controller can't mutate an issued frame.
+            # bytes pass through untouched (str(b"..") would send the
+            # repr); internal transport keys are reserved — a spoofed
+            # "icit" would make the server claim a rail ticket instead of
+            # decoding the body
+            from brpc_tpu.ici import rail
+            reserved = {rail.F_TICKET, rail.F_SRC_DEV, "sbuf"}
+            for k, v in cntl.user_fields.items():
+                k = str(k)
+                if k in reserved:
+                    raise ValueError(
+                        f"user_fields key {k!r} is reserved by the "
+                        f"transport")
+                meta.user_fields[k] = \
+                    v if isinstance(v, (bytes, bytearray)) else str(v)
         # the client-side response serializer: typed instances (e.g. a
         # PbSerializer bound to a generated message class) must decode the
         # response locally — the wire's content_type can only name the

@@ -31,6 +31,11 @@ class Controller:
         # consistent-hashing affinity key (reference
         # Controller::set_request_code): c_* balancers route by it
         self.request_code: Optional[int] = None
+        # opaque per-request key/values riding the RpcMeta (reference
+        # Controller::request_user_fields, baidu_rpc_meta.proto
+        # user_fields); server handlers read cntl.request_meta.user_fields
+        # — VALUES arrive there as bytes (wire convention, meta.py decode)
+        self.user_fields: dict = {}
 
         # ---- result state ----
         self.error_code: int = 0

@@ -30,6 +30,20 @@ from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_MEMCACHE,
 # are the server-side visibility: the Adder counts Python-path drops, the
 # PassiveStatus mirrors the native fast path's C++ counter onto /vars
 _dropped_responses = Adder("rpc_server_dropped_responses")
+
+
+def _interceptor_code(verdict):
+    """Maps an interceptor verdict to an error code, or None to admit.
+    ONE implementation for every dispatch path (native, RESTful, gRPC):
+    bool is an int subtype and error code 0 reads as success on the
+    client, so both `False` and a C-style 0 must mean EREJECT — not a
+    silent empty success (interceptor.h:26)."""
+    if verdict is None or verdict is True:
+        return None
+    if isinstance(verdict, int) and not isinstance(verdict, bool) \
+            and verdict != 0:
+        return verdict
+    return errors.EREJECT
 _native_dropped = PassiveStatus(
     lambda: __import__("brpc_tpu._core", fromlist=["core"])
     .core.brpc_rpc_dropped_responses()).expose(
@@ -538,14 +552,8 @@ class Server:
                 return
         # interceptor (interceptor.h:26)
         if self.options.interceptor is not None:
-            verdict = self.options.interceptor(meta)
-            if verdict is not None and verdict is not True:
-                # bool is an int subtype and error code 0 reads as
-                # success on the client: both `False` and a C-style 0
-                # must mean EREJECT, not a silent empty success
-                code = verdict if isinstance(verdict, int) \
-                    and not isinstance(verdict, bool) and verdict != 0 \
-                    else errors.EREJECT
+            code = _interceptor_code(self.options.interceptor(meta))
+            if code is not None:
                 self._respond_error(sid, meta, code)
                 return
         key = (meta.service, meta.method)
@@ -809,9 +817,8 @@ class Server:
         meta = M.RpcMeta(msg_type=M.MSG_REQUEST, service=service,
                          method=method_name, content_type="json")
         if self.options.interceptor is not None:
-            verdict = self.options.interceptor(meta)
-            if verdict is not None and verdict is not True:
-                code = verdict if isinstance(verdict, int) else errors.EREJECT
+            code = _interceptor_code(self.options.interceptor(meta))
+            if code is not None:
                 raise errors.RpcError(code)
         key = (service, method_name)
         spec = self._methods.get(key)
@@ -910,9 +917,8 @@ class Server:
             if not self.options.auth.verify_credential(meta.auth):
                 return b"", errors.ERPCAUTH, "bad credential"
         if self.options.interceptor is not None:
-            verdict = self.options.interceptor(meta)
-            if verdict is not None and verdict is not True:
-                code = verdict if isinstance(verdict, int) else errors.EREJECT
+            code = _interceptor_code(self.options.interceptor(meta))
+            if code is not None:
                 return b"", code, errors.describe(code)
         if spec is None:
             master = self.options.master_service

@@ -5,6 +5,7 @@
 #include <sys/epoll.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -47,6 +48,7 @@ namespace {
 
 struct FdWaiter {
   bthread::Butex ready{0};
+  uint32_t armed_events = 0;  // epoll mask, for the staleness probe
 };
 
 // One shared epoll + thread watching fibers' one-shot fd waits.  ALL
@@ -64,12 +66,34 @@ class WaitRegistry {
   // 0 on success; EEXIST when the fd already has a waiter; errno else.
   int arm(int fd, uint32_t events, FdWaiter* w) {
     std::lock_guard<std::mutex> g(_mu);
-    if (!_map.emplace(fd, w).second) return EEXIST;
+    auto it = _map.find(fd);
+    if (it != _map.end()) {
+      // A map entry whose fd the kernel no longer tracks means the
+      // waited fd was close()d (the kernel auto-removes it from the
+      // epoll set) and the NUMBER was recycled: the old waiter can
+      // never be delivered.  Probe with a same-mask MOD — ENOENT is
+      // the stale signature; release the orphan (it wakes, its caller's
+      // IO surfaces EBADF) instead of poisoning this fd with EEXIST
+      // forever.
+      epoll_event probe;
+      probe.events = it->second->armed_events;
+      probe.data.fd = fd;
+      if (epoll_ctl(_epfd, EPOLL_CTL_MOD, fd, &probe) == 0 ||
+          errno != ENOENT) {
+        return EEXIST;  // genuinely armed
+      }
+      FdWaiter* old = it->second;
+      _map.erase(it);
+      old->ready.value.fetch_add(1, std::memory_order_release);
+      old->ready.wake_all();
+    }
     epoll_event ev;
     ev.events = EPOLLONESHOT | EPOLLRDHUP;
     if (events & FD_WAIT_READ) ev.events |= EPOLLIN;
     if (events & FD_WAIT_WRITE) ev.events |= EPOLLOUT;
     ev.data.fd = fd;
+    w->armed_events = ev.events;
+    _map.emplace(fd, w);
     if (epoll_ctl(_epfd, EPOLL_CTL_ADD, fd, &ev) != 0) {
       const int err = errno;
       _map.erase(fd);
@@ -102,8 +126,12 @@ class WaitRegistry {
       const int n = epoll_wait(_epfd, events, 32, -1);
       if (n < 0) {
         if (errno == EINTR) continue;
+        // Never exit: a dead delivery thread turns every future fiber
+        // wait into a silent park (arm() would keep succeeding).  Log,
+        // back off, keep serving.
         BLOG(ERROR, "fd_wait epoll_wait failed: %d", errno);
-        return;
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+        continue;
       }
       for (int i = 0; i < n; ++i) {
         const int fd = events[i].data.fd;

@@ -1,0 +1,227 @@
+"""Channel behavior matrix (the reference's largest suite,
+test/brpc_channel_unittest.cpp: 64 TESTs over cancel/timeout/retry/backup
+— SURVEY.md §4).  Loopback servers play the cluster."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.rpc.channel import ChannelOptions, RetryPolicy
+
+
+class Echo(brpc.Service):
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return req
+
+    @brpc.method(request="json", response="json")
+    def Sleep(self, cntl, req):
+        time.sleep(req.get("s", 0))
+        return {"slept": req.get("s", 0)}
+
+    @brpc.method(request="json", response="json")
+    def Fail(self, cntl, req):
+        cntl.set_failed(int(req.get("code", errors.EINTERNAL)),
+                        "requested failure")
+        return None
+
+
+@pytest.fixture
+def server():
+    srv = brpc.Server()
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    yield srv
+    srv.stop()
+    srv.join()
+
+
+class TestDeadlines:
+    def test_deadline_enforced_for_async_calls(self, server):
+        ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=200)
+        done = threading.Event()
+        out = {}
+
+        def on_done(cntl):
+            out["code"] = cntl.error_code
+            done.set()
+
+        ch.call("Echo", "Sleep", {"s": 2}, serializer="json",
+                done=on_done)
+        assert done.wait(5)
+        assert out["code"] == errors.ERPCTIMEDOUT
+
+    def test_server_side_failure_code_propagates(self, server):
+        ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=2000)
+        with pytest.raises(errors.RpcError) as ei:
+            ch.call_sync("Echo", "Fail", {"code": 1234}, serializer="json")
+        assert ei.value.code == 1234
+
+    def test_deadline_not_consumed_by_fast_calls(self, server):
+        ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=500)
+        for _ in range(20):
+            assert ch.call_sync("Echo", "Echo", b"q",
+                                serializer="raw") == b"q"
+
+
+class TestRetry:
+    def test_no_retry_on_application_error(self, server):
+        """EINTERNAL set by the HANDLER must not be retried (the reference
+        retries transport errors, not app errors)."""
+        calls = []
+
+        class Counting(brpc.Service):
+            NAME = "Count"
+
+            @brpc.method(request="json", response="json")
+            def Hit(self, cntl, req):
+                calls.append(1)
+                cntl.set_failed(errors.EPERM_RPC
+                                if hasattr(errors, "EPERM_RPC") else 1008,
+                                "app error")
+                return None
+
+        srv = brpc.Server()
+        srv.add_service(Counting())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}",
+                              options=ChannelOptions(timeout_ms=2000,
+                                                     max_retry=3))
+            with pytest.raises(errors.RpcError):
+                ch.call_sync("Count", "Hit", {}, serializer="json")
+            assert len(calls) == 1
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_connection_refused_retries_then_fails(self):
+        # a dead port: every attempt fails with a retryable error; the
+        # call must exhaust max_retry and surface a connection error
+        ch = brpc.Channel("127.0.0.1:1",   # reserved port, nothing listens
+                          options=ChannelOptions(timeout_ms=2000,
+                                                 max_retry=2))
+        with pytest.raises(errors.RpcError) as ei:
+            ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+        assert ei.value.code in (errors.ECONNREFUSED,
+                                 errors.EFAILEDSOCKET)
+
+    def test_custom_retry_policy_consulted(self, server):
+        consulted = []
+
+        class Never(RetryPolicy):
+            def do_retry(self, cntl):
+                consulted.append(cntl.error_code)
+                return False
+
+        ch = brpc.Channel("127.0.0.1:1",
+                          options=ChannelOptions(timeout_ms=2000,
+                                                 max_retry=3,
+                                                 retry_policy=Never()))
+        with pytest.raises(errors.RpcError):
+            ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+        assert len(consulted) == 1   # failed once, policy said stop
+
+
+class TestBackup:
+    def test_backup_fires_and_first_response_wins(self, server):
+        """backup_request_ms on a slow call: the backup attempt answers
+        first; exactly one response reaches the caller."""
+
+        hits = []
+
+        class Lazy(brpc.Service):
+            NAME = "Lazy"
+
+            @brpc.method(request="json", response="json")
+            def Get(self, cntl, req):
+                hits.append(time.monotonic())
+                if len(hits) == 1:
+                    time.sleep(1.0)      # first attempt dawdles
+                return {"n": len(hits)}
+
+        srv = brpc.Server()
+        srv.add_service(Lazy())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(
+                f"127.0.0.1:{srv.port}",
+                options=ChannelOptions(timeout_ms=5000,
+                                       backup_request_ms=100))
+            t0 = time.monotonic()
+            out = ch.call_sync("Lazy", "Get", {}, serializer="json")
+            dt = time.monotonic() - t0
+            assert out["n"] >= 2          # backup attempt served it
+            assert dt < 0.9               # did not wait for the dawdler
+        finally:
+            srv.stop()
+            srv.join()
+
+
+class TestCancellation:
+    def test_cancel_inflight_surfaces_ecanceled(self, server):
+        ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=5000)
+        cntl = brpc.Controller()
+        done = threading.Event()
+        out = {}
+
+        def on_done(c):
+            out["code"] = c.error_code
+            done.set()
+
+        ch.call("Echo", "Sleep", {"s": 2}, serializer="json", cntl=cntl,
+                done=on_done)
+        time.sleep(0.1)
+        assert cntl.cancel()
+        assert done.wait(5)
+        assert out["code"] == errors.ECANCELED
+
+    def test_cancel_after_completion_is_noop(self, server):
+        ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=2000)
+        cntl = brpc.Controller()
+        ch.call_sync("Echo", "Echo", b"x", serializer="raw", cntl=cntl)
+        assert not cntl.cancel()
+        assert cntl.error_code == 0
+
+
+class TestAttachmentAndMeta:
+    def test_large_attachment_roundtrip(self, server):
+        ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=5000)
+        att = bytes(range(256)) * 1000   # 256 KB
+
+        class _:
+            pass
+
+        cntl = brpc.Controller()
+        cntl.request_attachment = att
+        out = ch.call_sync("Echo", "Echo", b"body", serializer="raw",
+                           cntl=cntl)
+        assert out == b"body"
+
+    def test_user_fields_reach_the_server(self, server):
+        seen = {}
+
+        class Meta(brpc.Service):
+            NAME = "Meta"
+
+            @brpc.method(request="json", response="json")
+            def Peek(self, cntl, req):
+                seen.update(cntl.request_meta.user_fields or {})
+                return {}
+
+        srv = brpc.Server()
+        srv.add_service(Meta())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=2000)
+            cntl = brpc.Controller()
+            cntl.user_fields["shard"] = "7"
+            ch.call_sync("Meta", "Peek", {}, serializer="json", cntl=cntl)
+            # wire convention: user-field VALUES arrive as bytes
+            # (meta.py decode; rail._norm documents the same)
+            assert seen.get("shard") == b"7"
+        finally:
+            srv.stop()
+            srv.join()

@@ -130,3 +130,31 @@ class TestFiberForm:
             conn.close()
             cli.close()
             srv.close()
+
+
+class TestStaleFdRecovery:
+    def test_closed_then_recycled_fd_is_rearmable(self):
+        """Closing an armed fd kernel-removes it from the epoll set; a
+        later wait on the recycled number must not see EEXIST forever."""
+        r, w = os.pipe()
+        t = threading.Thread(
+            target=lambda: core.brpc_fiber_fd_wait_probe(r, FD_READ, 2000))
+        t.start()
+        time.sleep(0.15)             # fiber armed and parked on r
+        os.close(r)                  # kernel auto-removes; map goes stale
+        os.close(w)
+        # recycle: dup a fresh pipe onto the same descriptor number
+        r2, w2 = os.pipe()
+        os.dup2(r2, r) if r2 != r else None
+        try:
+            threading.Timer(0.1, lambda: os.write(w2, b"z")).start()
+            fd = r if r2 != r else r2
+            rc = core.brpc_fiber_fd_wait_probe(fd, FD_READ, 3000)
+            assert rc == 0, rc       # stale entry released, wait delivered
+        finally:
+            t.join(10)
+            for f in {r2, w2, r} if r2 != r else {r2, w2}:
+                try:
+                    os.close(f)
+                except OSError:
+                    pass
