@@ -593,7 +593,13 @@ class Channel:
             from brpc_tpu.ici import rail
             reserved = {rail.F_TICKET, rail.F_SRC_DEV, "sbuf"}
             for k, v in cntl.user_fields.items():
-                k = str(k)
+                # keys must be clean strings: bytes would be sent as
+                # their repr, and a NUL corrupts the key\0value TLV
+                # framing on decode
+                if not isinstance(k, str) or "\x00" in k:
+                    raise ValueError(
+                        f"user_fields key {k!r} must be a str without "
+                        f"NUL bytes")
                 if k in reserved:
                     raise ValueError(
                         f"user_fields key {k!r} is reserved by the "

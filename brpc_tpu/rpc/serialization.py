@@ -110,6 +110,47 @@ class PbSerializer(Serializer):
         return msg
 
 
+class PbMessagePool:
+    """Pooled protobuf request messages (reference RpcPBMessageFactory,
+    rpc_pb_message_factory.{h,cpp}: arena-pooled Get/Return around each
+    call).  Messages are Clear()ed on return and reused, cutting the
+    per-request allocation for large message types.
+
+    Contract (same as the reference): the framework owns the request
+    message; a handler that stashes it past `done` must copy it first.
+    Pooling is opt-in per server (ServerOptions.pb_message_pooling).
+    """
+
+    MAX_PER_CLASS = 64
+
+    def __init__(self):
+        import threading
+        self._mu = threading.Lock()
+        self._free: dict[type, list] = {}
+        self.reused = Adder("pb_pool_reused")
+        self.created = Adder("pb_pool_created")
+
+    def get(self, message_class):
+        with self._mu:
+            lst = self._free.get(message_class)
+            if lst:
+                self.reused.add(1)
+                return lst.pop()
+        self.created.add(1)
+        return message_class()
+
+    def give_back(self, msg) -> None:
+        msg.Clear()
+        cls = type(msg)
+        with self._mu:
+            lst = self._free.setdefault(cls, [])
+            if len(lst) < self.MAX_PER_CLASS:
+                lst.append(msg)
+
+
+pb_message_pool = PbMessagePool()
+
+
 class TensorSerializer(Serializer):
     """ndarray <-> raw bytes + header.  Lists/tuples of arrays supported.
 

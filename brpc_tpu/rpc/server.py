@@ -19,7 +19,9 @@ from brpc_tpu.rpc import rpc_dump as _rpc_dump  # registers rpc_dump_* flags
 from brpc_tpu.bvar import Adder, LatencyRecorder, PassiveStatus
 from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.controller import Controller
-from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
+from brpc_tpu.rpc.serialization import (PbSerializer, as_bytes, compress,
+                                        decompress, get_serializer,
+                                        pb_message_pool)
 from brpc_tpu.rpc.service import MethodSpec, Service, method
 from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_MEMCACHE,
                                     MSG_MONGO, MSG_REDIS, MSG_THRIFT,
@@ -84,6 +86,11 @@ class ServerOptions:
     # data_factory.h): a DataFactory, or a zero-arg callable; each request
     # sees the pooled object as cntl.session_data.
     session_data_factory: Optional[Any] = None
+    # pooled pb request messages (reference RpcPBMessageFactory arena
+    # pooling, rpc_pb_message_factory.{h,cpp}).  Opt-in: the framework
+    # owns the request message and reuses it after done — handlers that
+    # stash the message past completion must copy it first.
+    pb_message_pooling: bool = False
     # Advertise this server as ICI-reachable on the given jax device: tensor
     # payloads from in-process channels then ride the BlockPool/IciEndpoint
     # rail instead of the socket (the use_rdma switch — channel.h:109,
@@ -626,8 +633,18 @@ class Server:
                 cntl.request_attachment = bytes(raw[len(raw) - att:]) \
                     if att else b""
                 payload = decompress(payload, meta.compress_type)
-                request = spec.request_serializer.decode(payload,
-                                                         meta.tensor_header)
+                req_ser = spec.request_serializer
+                if (self.options.pb_message_pooling
+                        and isinstance(req_ser, PbSerializer)
+                        and req_ser.message_class is not None):
+                    # pooled request message (RpcPBMessageFactory slot);
+                    # returned to the pool after done fires
+                    request = pb_message_pool.get(req_ser.message_class)
+                    cntl._pooled_request = request  # BEFORE parse: a
+                    # parse failure path still returns it to the pool
+                    request.ParseFromString(as_bytes(payload))
+                else:
+                    request = req_ser.decode(payload, meta.tensor_header)
                 span.request_size = len(raw)
         except Exception as e:
             self._complete_request(sid, meta, span, cntl, spec, status,
@@ -754,6 +771,12 @@ class Server:
             self._respond_error(sid, meta, errors.EINTERNAL,
                                 f"{type(e).__name__}: {e}")
         finally:
+            pooled = getattr(cntl, "_pooled_request", None)
+            if pooled is not None:
+                # the framework owns the request message; done has fired,
+                # so return it (RpcPBMessageFactory Return semantics)
+                cntl._pooled_request = None
+                pb_message_pool.give_back(pooled)
             latency_us = int((time.monotonic() - start) * 1e6)
             status.on_responded(error_code, latency_us)
             if self._limiter is not None:

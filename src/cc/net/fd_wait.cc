@@ -49,6 +49,10 @@ namespace {
 struct FdWaiter {
   bthread::Butex ready{0};
   uint32_t armed_events = 0;  // epoll mask, for the staleness probe
+  // Set (under the registry lock) when a stale-release woke this waiter:
+  // its fd NUMBER was recycled to an unrelated descriptor, so reporting
+  // "ready" would have the caller do IO on someone else's fd.
+  std::atomic<bool> orphaned{false};
 };
 
 // One shared epoll + thread watching fibers' one-shot fd waits.  ALL
@@ -84,6 +88,7 @@ class WaitRegistry {
       }
       FdWaiter* old = it->second;
       _map.erase(it);
+      old->orphaned.store(true, std::memory_order_release);
       old->ready.value.fetch_add(1, std::memory_order_release);
       old->ready.wake_all();
     }
@@ -175,7 +180,11 @@ bthread::Task fiber_fd_wait(int fd, uint32_t events, int timeout_ms,
   // free the butex out from under the waker — the lock acquisition
   // proves the claimer is completely done with the waiter.
   const bool we_removed = WaitRegistry::instance()->disarm(fd, &w);
-  if (r == bthread::WaitResult::kTimeout) {
+  if (w.orphaned.load(std::memory_order_acquire)) {
+    // our fd was close()d and its number recycled; "ready" would send
+    // the caller to IO on an unrelated descriptor
+    *rc_out = EBADF;
+  } else if (r == bthread::WaitResult::kTimeout) {
     // losing the disarm race means the event arrived between our
     // timeout and the lock — that is a delivery
     *rc_out = we_removed ? ETIMEDOUT : 0;

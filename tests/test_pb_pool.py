@@ -1,0 +1,111 @@
+"""Pooled pb request messages (reference RpcPBMessageFactory,
+rpc_pb_message_factory.{h,cpp}: arena Get/Return around each call)."""
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc.serialization import (PbMessagePool, PbSerializer,
+                                        pb_message_pool)
+from brpc_tpu.rpc.server import ServerOptions
+
+
+def _make_message_class():
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "pbpool_test.proto"
+    fdp.package = "pbpool"
+    m = fdp.message_type.add()
+    m.name = "Ping"
+    f = m.field.add()
+    f.name = "text"
+    f.number = 1
+    f.type = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+    f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    pool = descriptor_pool.DescriptorPool()
+    fd = pool.Add(fdp)
+    return message_factory.GetMessageClass(
+        fd.message_types_by_name["Ping"])
+
+
+Ping = _make_message_class()
+
+
+class TestPool:
+    def test_get_reuses_returned_instances(self):
+        p = PbMessagePool()
+        a = p.get(Ping)
+        a.text = "hello"
+        p.give_back(a)
+        b = p.get(Ping)
+        assert b is a
+        assert b.text == ""          # cleared on return
+
+    def test_bounded(self):
+        p = PbMessagePool()
+        msgs = [p.get(Ping) for _ in range(100)]
+        for m in msgs:
+            p.give_back(m)
+        assert len(p._free[Ping]) <= PbMessagePool.MAX_PER_CLASS
+
+
+class TestServerIntegration:
+    @pytest.mark.parametrize("pooling", [False, True])
+    def test_pb_echo_with_and_without_pooling(self, pooling):
+        seen_ids = []
+
+        class Svc(brpc.Service):
+            NAME = "PB"
+
+            @brpc.method(request="pb", response="raw")
+            def Shout(self, cntl, req):
+                seen_ids.append(id(req))
+                return req.text.upper().encode()
+
+        # bind the concrete class to the method spec
+        srv = brpc.Server(options=ServerOptions(pb_message_pooling=pooling))
+        svc = Svc()
+        srv.add_service(svc)
+        srv._methods[("PB", "Shout")].request_serializer = \
+            PbSerializer(Ping)
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            for i in range(8):
+                req = Ping()
+                req.text = f"msg{i}"
+                out = ch.call_sync("PB", "Shout", req, serializer="pb")
+                assert out == f"MSG{i}".upper().encode()
+            if pooling:
+                # sequential calls reuse the pooled instance
+                assert len(set(seen_ids)) < len(seen_ids)
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_parse_failure_returns_message_to_pool(self):
+        created0 = pb_message_pool.created.get_value()
+
+        class Svc(brpc.Service):
+            NAME = "PB2"
+
+            @brpc.method(request="pb", response="raw")
+            def M(self, cntl, req):
+                return b"ok"
+
+        srv = brpc.Server(options=ServerOptions(pb_message_pooling=True))
+        srv.add_service(Svc())
+        srv._methods[("PB2", "M")].request_serializer = PbSerializer(Ping)
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            for _ in range(4):
+                with pytest.raises(Exception):
+                    # garbage body: ParseFromString fails server-side
+                    ch.call_sync("PB2", "M", b"\xff\xff\xff\xff\xff",
+                                 serializer="raw")
+            # failed parses must not leak pool instances: at most one
+            # fresh message was ever created for this class
+            assert pb_message_pool.created.get_value() - created0 <= 1
+        finally:
+            srv.stop()
+            srv.join()
