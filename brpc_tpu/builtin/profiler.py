@@ -99,6 +99,16 @@ def cpu_profile(duration_s: float = 1.0, fmt: str = "text") -> str:
     return _render(_collect_stacks(duration_s), "cpu profile", fmt)
 
 
+def cpu_profile_pb(duration_s: float = 1.0, hz: int = 100,
+                   contention_only: bool = False) -> bytes:
+    """Gzipped profile.proto — the wire format `go tool pprof` fetches
+    from /pprof/profile (builtin/pprof_proto.py)."""
+    from brpc_tpu.builtin.pprof_proto import encode_profile
+    stacks = _collect_stacks(duration_s, hz, contention_only)
+    return encode_profile(stacks, period_ns=int(1e9 / hz),
+                          duration_ns=int(duration_s * 1e9))
+
+
 def contention_profile(duration_s: float = 1.0, fmt: str = "text") -> str:
     return _render(_collect_stacks(duration_s, contention_only=True),
                    "contention profile (threads in lock/queue waits)", fmt)

@@ -248,10 +248,22 @@ def build_routes(server) -> dict:
         except ValueError:
             return default
 
-    def hotspots_cpu(req):
+    def _cpu_profile(req, default_fmt):
         from brpc_tpu.builtin import profiler
-        return profiler.cpu_profile(_seconds(req),
-                                    req.query.get("fmt", "text"))
+        fmt = req.query.get("fmt", default_fmt)
+        if fmt in ("pb", "proto"):
+            return (profiler.cpu_profile_pb(_seconds(req)),
+                    "application/octet-stream")
+        return profiler.cpu_profile(_seconds(req), fmt)
+
+    def hotspots_cpu(req):
+        return _cpu_profile(req, "text")
+
+    def pprof_profile(req):
+        # `go tool pprof http://host:port/pprof/profile` expects a
+        # gzipped profile.proto by default (pprof_service.* role);
+        # ?fmt=text keeps the human view
+        return _cpu_profile(req, "pb")
 
     def hotspots_native(req):
         # native-thread sampler (dispatchers/executor/drainers);
@@ -298,7 +310,7 @@ def build_routes(server) -> dict:
         "/hotspots/growth": hotspots_growth,
         # remote-pprof style aliases (pprof_service.*): same data under the
         # /pprof prefix so generic tooling can scrape it
-        "/pprof/profile": hotspots_cpu,
+        "/pprof/profile": pprof_profile,
         "/pprof/profile_native": hotspots_native,
         "/pprof/contention": hotspots_contention,
         "/pprof/heap": hotspots_heap,

@@ -1,0 +1,95 @@
+"""pprof protobuf profile format (/pprof/profile wire format; reference
+pprof_service.* makes any server a remote pprof target)."""
+import threading
+import time
+from collections import Counter
+
+import brpc_tpu as brpc
+from brpc_tpu.builtin.pprof_proto import decode_profile, encode_profile
+
+
+class TestEncoder:
+    def test_round_trip_structure(self):
+        stacks = Counter({"a.py:main;b.py:work": 10,
+                          "a.py:main;c.py:idle": 5,
+                          "d.py:solo": 1})
+        blob = encode_profile(stacks, period_ns=10_000_000,
+                              duration_ns=2_000_000_000)
+        assert blob[:2] == b"\x1f\x8b"            # gzip magic
+        d = decode_profile(blob)
+        st = d["string_table"]
+        assert st[0] == ""                        # index 0 contract
+        for name in ("a.py:main", "b.py:work", "c.py:idle", "d.py:solo",
+                     "samples", "count", "cpu", "nanoseconds"):
+            assert name in st, name
+        assert sum(v[0] for _, v in d["samples"]) == 16
+        assert d["period"] == 10_000_000
+        # every sample's location ids resolve through locations->functions
+        for locs, _ in d["samples"]:
+            for lid in locs:
+                assert st[d["functions"][d["locations"][lid]]]
+
+    def test_leaf_first_ordering(self):
+        blob = encode_profile({"root.py:r;mid.py:m;leaf.py:l": 3}, 1, 1)
+        d = decode_profile(blob)
+        locs, vals = d["samples"][0]
+        st = d["string_table"]
+        names = [st[d["functions"][d["locations"][i]]] for i in locs]
+        assert names == ["leaf.py:l", "mid.py:m", "root.py:r"]
+        assert vals == [3]
+
+    def test_empty_profile(self):
+        d = decode_profile(encode_profile({}, 1_000, 0))
+        assert d["samples"] == []
+        assert d["string_table"][0] == ""
+
+
+class TestServed:
+    def test_pprof_profile_endpoint_serves_pb_gzip(self):
+        class Busy(brpc.Service):
+            @brpc.method(request="raw", response="raw")
+            def Spin(self, cntl, req):
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 0.05:
+                    pass
+                return b"done"
+
+        srv = brpc.Server()
+        srv.add_service(Busy())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+            # background load so the profile has stacks to sample
+            stop = threading.Event()
+
+            def load():
+                while not stop.is_set():
+                    ch.call_sync("Busy", "Spin", b"", serializer="raw")
+
+            t = threading.Thread(target=load, daemon=True)
+            t.start()
+            try:
+                from brpc_tpu.rpc.http import HttpChannel
+                hc = HttpChannel(f"127.0.0.1:{srv.port}", timeout_ms=15000)
+                r = hc.request("GET", "/pprof/profile?seconds=0.4")
+                assert r.status == 200
+                assert "octet-stream" in r.headers["content-type"]
+                d = decode_profile(r.body)
+                assert d["samples"], "no samples collected under load"
+                hc.close()
+            finally:
+                stop.set()
+                t.join(5)
+        finally:
+            srv.stop()
+            srv.join()
+
+
+class TestHostileDecode:
+    def test_truncated_and_overrun_inputs_raise_valueerror(self):
+        import gzip
+        import pytest
+        for payload in (b"\x0a", b"\x0a\xff", b"\x80" * 12,
+                        b"\x32\x05ab"):
+            with pytest.raises(ValueError):
+                decode_profile(gzip.compress(payload))

@@ -91,7 +91,10 @@ class TestHotspots:
         srv = brpc.Server()
         srv.start("127.0.0.1", 0)
         try:
-            assert "samples" in _get(srv.port, "/pprof/profile?seconds=0.1")
+            # default is now the pprof protobuf wire format (what
+            # `go tool pprof` fetches); text stays behind ?fmt=text
+            assert "samples" in _get(srv.port,
+                                     "/pprof/profile?seconds=0.1&fmt=text")
             assert "/hotspots/cpu" in _get(srv.port, "/hotspots")
         finally:
             srv.stop()
