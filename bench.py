@@ -21,6 +21,7 @@ under the driver; CPU elsewhere).  All progress goes to stderr; stdout is
 exactly one JSON object.
 """
 import json
+import math
 import os
 import sys
 import threading
@@ -245,13 +246,21 @@ def _readback_baseline(arr, trials=9):
     return times[len(times) // 2], spread
 
 
-def bench_tensor_pipe(chunk_mb=64, n_chunks=96):
+def bench_tensor_pipe(chunk_mb=64, iter_chunks=80, max_total_gb=24):
     """HEADLINE: TensorStream -> IciEndpoint framework path.  Same-device
     chunks go through the endpoint's compiled copy kernel, so every chunk
     provably lands in a distinct destination buffer; cross-device
-    (multi-chip) chunks ride device_put ICI DMA.  Timing: batch ending in
-    a forced scalar readback, minus the measured fixed readback cost —
-    what remains is dispatch + actual copy time."""
+    (multi-chip) chunks ride device_put ICI DMA.
+
+    Timing: ITERATIONS of `iter_chunks` chunks, each sized to fit the
+    credit window (no mid-measurement stalls on completion observation —
+    a tunnel RTT each) and each ending in a forced scalar readback; the
+    copy phases (wall - readback baseline) are SUMMED across iterations
+    until they clear a jitter floor that scales with sqrt(iterations).
+    One iteration of 5GB finishes in ~15ms on the real chip — under the
+    floor — so a single-shot measurement cannot resolve; accumulation
+    keeps in-flight memory bounded by the window while moving enough
+    total bytes to measure honestly (r3 first cut published null here)."""
     import jax
     import jax.numpy as jnp
 
@@ -263,19 +272,13 @@ def bench_tensor_pipe(chunk_mb=64, n_chunks=96):
     chunk = jnp.ones((n,), jnp.bfloat16)
     _readback_sync(chunk)
     outs = []
-    # keep only the ordered tail alive (48x64MB would pin 3GB of HBM);
-    # window = 16 chunks so the writer isn't serialized on completion
-    # observation — over the tunneled dev chip each completion check is a
-    # ~65ms round trip, so a small window measures tunnel RTT, not the pipe
     def consume(a):
         outs[:] = [a]
         consume.n += 1
     consume.n = 0
-    # window covers the whole trial: the writer must never stall on
-    # completion observation (a tunnel RTT each) mid-measurement — r2's
-    # 64KB-ladder cliff was exactly that stall
+    # window covers ONE iteration; iterations drain (untimed) in between
     ts = TensorStream(dev, consumer=consume,
-                      window_bytes=(n_chunks + 2) * chunk.nbytes)
+                      window_bytes=(iter_chunks + 2) * chunk.nbytes)
     stats0 = link_stats()
     # warmup: drainer thread + the SAME 16-chunk batched copy program the
     # timed loop uses (jit caches per arity — r3's first cut warmed an
@@ -317,39 +320,64 @@ def bench_tensor_pipe(chunk_mb=64, n_chunks=96):
             alias_check = "DONATION-SENTINEL-FAILED"
         del sentinel, dst, probe
     base, jitter = _readback_baseline(outs[0] if outs else chunk)
-    outs.clear()
-    consume.n = 0
-    t0 = time.perf_counter()
-    # batched dispatch: 16 chunks per pre-compiled multi-copy program
-    # (endpoint.send_batch) — one Python->PJRT call per 1GB
-    last = None
-    for i in range(0, n_chunks, 16):
-        last = ts.write_many([chunk] * min(16, n_chunks - i))[-1]
-    # timed region ends when the LAST transfer provably completed (scalar
-    # readback of the final destination buffer).  Consumer delivery runs on
-    # the drainer thread and overlaps; each of its completion observations
-    # costs a tunnel RTT and is pipeline machinery, not byte movement —
-    # close() below still waits for it (untimed) and the chunk count is
-    # asserted, so delivery integrity is preserved.
-    _readback_sync(last)
-    wall = time.perf_counter() - t0
+    delivered_before = consume.n
+    copy_sum = 0.0
+    wall_sum = 0.0
+    moved = 0
+    iters = 0
+    max_total = max_total_gb << 30
+    issues = []
+    while True:
+        # untimed inter-iteration drain: the next timed run must start
+        # with full window credit, or it measures stalls, not the pipe
+        deadline = time.monotonic() + 120
+        want = delivered_before + iters * iter_chunks
+        while consume.n < want and time.monotonic() < deadline:
+            time.sleep(0.002)
+        if consume.n < want:
+            # a timed run without full window credit measures stalls,
+            # not the pipe — never publish that as a valid number
+            issues.append(
+                f"drainer wedged: {consume.n - delivered_before} of "
+                f"{want - delivered_before} chunks delivered after 120s")
+            break
+        t0 = time.perf_counter()
+        # batched dispatch: 16 chunks per pre-compiled multi-copy program
+        # (endpoint.send_batch) — one Python->PJRT call per 1GB.  The
+        # timed region ends when the LAST transfer provably completed
+        # (scalar readback of the final destination buffer); consumer
+        # delivery overlaps on the drainer thread.
+        last = None
+        for i in range(0, iter_chunks, 16):
+            last = ts.write_many([chunk] * min(16, iter_chunks - i))[-1]
+        _readback_sync(last)
+        wall = time.perf_counter() - t0
+        copy_sum += wall - base
+        wall_sum += wall
+        moved += iter_chunks * chunk.nbytes
+        iters += 1
+        floor = max(0.010, 4 * jitter * math.sqrt(iters))
+        if copy_sum >= floor:
+            break
+        if moved >= max_total:
+            issues.append(
+                f"copy phase {copy_sum * 1e3:.1f}ms not resolvable above "
+                f"readback jitter ({jitter * 1e3:.1f}ms x {iters} iters) "
+                f"at traffic cap {max_total_gb}GB")
+            break
     ts.close(wait=True)
     stats1 = link_stats()
-    copy_time = wall - base
-    issues = []
-    if copy_time < max(0.010, 4 * jitter):
-        issues.append(
-            f"copy phase {copy_time * 1e3:.1f}ms not resolvable above "
-            f"readback baseline {base * 1e3:.1f}ms (jitter "
-            f"{jitter * 1e3:.1f}ms)")
-    gbps, gate_issues = _gated(n_chunks * chunk.nbytes, max(copy_time, 1e-9))
+    gbps, gate_issues = _gated(moved, max(copy_sum, 1e-9))
     issues += gate_issues
     if aliased:
         issues.append("destination buffer aliased the source")
     if issues:
         gbps = None
-    return {"gbps": gbps, "chunk_mb": chunk_mb, "chunks": consume.n,
-            "wall_s": round(wall, 4),
+    return {"gbps": gbps, "chunk_mb": chunk_mb,
+            "chunks": consume.n - delivered_before,   # timed deliveries
+            "iterations": iters, "moved_gb": round(moved / (1 << 30), 2),
+            "wall_s": round(wall_sum, 4),
+            "copy_s": round(copy_sum, 4),
             "readback_baseline_ms": round(base * 1e3, 1),
             "alias_check": alias_check,
             "same_device_copies":
@@ -391,33 +419,59 @@ def bench_ici_ladder():
         # (the drainer frees in bulk, one tunnel RTT per cycle); 6GB keeps
         # a comfortable margin on a 16GB chip while letting rungs push
         # enough traffic to clear the tunnel-RTT noise floor
-        ep = IciEndpoint(dev, window_bytes=6 << 30)
+        window = 6 << 30
+        ep = IciEndpoint(dev, window_bytes=window)
         warm = ep.send_batch([x] * k)        # compile the k-copy program
         warm[-1].block_until_ready()
         base, jitter = _readback_baseline(warm[-1])
-        floor = max(0.004, 4 * jitter)
-        # doubling m (dispatches per trial) until the copy phase clears
-        # the confidence floor.  The cap is on TOTAL TRAFFIC (24GB), not
-        # in-flight memory — destination buffers are freed as the trial
-        # proceeds (only each batch's tail is retained), so big rungs can
-        # move enough bytes to resolve above the ~10ms readback jitter
-        # floor (r3's first cut capped traffic at 2GB: 3ms of HBM time,
-        # unresolvable, published null)
+        # Total-traffic cap (24GB) — NOT in-flight memory: destinations
+        # are freed as the trial proceeds.  A single timed run is bounded
+        # by the WINDOW (m_window dispatches) so the writer never stalls
+        # on a completion-observation tunnel RTT mid-measurement (r3's
+        # first cut let the 64MB rung outrun the window and the stall
+        # halved its published bandwidth — the "non-monotonic" artifact);
+        # rungs needing more traffic than one window accumulate ITERATED
+        # timed runs with untimed drains between, gated on a floor that
+        # grows with sqrt(iterations).
         m_cap = max(1, (24 << 30) // (k * size))
+        m_window = max(1, (window - k * size) // (k * size))
         m = 1
         rung = None
         while True:
-            last = None
-            t0 = time.perf_counter()
-            for _ in range(m):
-                last = ep.send_batch([x] * k)[-1]
-            _readback_sync(last)
-            wall = time.perf_counter() - t0
-            copy_time = wall - base
-            if copy_time >= floor:
-                gbps, issues = _gated(m * k * size, copy_time)
-                rung = {"lat_us": round(copy_time / (m * k) * 1e6, 2),
+            iters = 0
+            remaining = m
+            copy_sum = 0.0
+            stalled = False
+            while remaining > 0:
+                mi = min(remaining, m_window)
+                # untimed drain: start each timed run with full credit
+                deadline = time.monotonic() + 120
+                while ep.inflight_bytes > 0 and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.002)
+                if ep.inflight_bytes > 0:
+                    stalled = True
+                    break
+                last = None
+                t0 = time.perf_counter()
+                for _ in range(mi):
+                    last = ep.send_batch([x] * k)[-1]
+                _readback_sync(last)
+                copy_sum += time.perf_counter() - t0 - base
+                remaining -= mi
+                iters += 1
+            if stalled:
+                rung = {"lat_us": None, "gbps": None, "batch": k,
+                        "dispatches": m,
+                        "invalid": ["drainer wedged: window credit not "
+                                    "released within 120s"]}
+                break
+            floor = max(0.004, 4 * jitter * math.sqrt(iters))
+            if copy_sum >= floor:
+                gbps, issues = _gated(m * k * size, max(copy_sum, 1e-9))
+                rung = {"lat_us": round(copy_sum / (m * k) * 1e6, 2),
                         "gbps": gbps, "batch": k, "dispatches": m,
+                        "iterations": iters,
                         **({"invalid": issues} if issues else {})}
                 if issues:
                     rung["lat_us"] = None
@@ -426,7 +480,7 @@ def bench_ici_ladder():
                 rung = {"lat_us": None, "gbps": None, "batch": k,
                         "dispatches": m,
                         "invalid": [
-                            f"copy phase {copy_time * 1e3:.1f}ms below "
+                            f"copy phase {copy_sum * 1e3:.1f}ms below "
                             f"confidence floor {floor * 1e3:.1f}ms at "
                             f"max dispatches {m}"]}
                 break
