@@ -16,11 +16,15 @@ producer/consumer windowing the reference uses for StreamWrite (SURVEY §5.7).
 """
 from __future__ import annotations
 
+import queue
 import struct
 import threading
 import time
 from concurrent.futures import Future
 from typing import Callable, Optional
+
+# sentinel closing a client-side streaming sink (trailers seen, status 0)
+_STREAM_END = object()
 
 from brpc_tpu import errors
 from brpc_tpu.rpc.hpack import HpackDecoder, HpackEncoder
@@ -418,7 +422,11 @@ class H2Connection:
         if data is None:
             return
         st.data += data
-        if flags & FLAG_END_STREAM:
+        if not (flags & FLAG_END_STREAM):
+            # incremental delivery hook (server-streaming gRPC consumes
+            # complete length-prefixed messages as they arrive)
+            self.on_stream_data(st)
+        else:
             st.ended = True
             self._complete(st)
 
@@ -430,6 +438,11 @@ class H2Connection:
         self.on_stream_complete(st)
 
     # ---- overridables ----
+
+    def on_stream_data(self, st: _StreamState) -> None:
+        """Called as DATA accumulates on a still-open stream (no-op by
+        default; streaming consumers override to drain complete
+        messages incrementally)."""
 
     def on_stream_complete(self, st: _StreamState) -> None:
         raise NotImplementedError
@@ -490,6 +503,7 @@ class GrpcServerConnection(H2Connection):
         _grpc_executor().submit(self._process, st)
 
     def _process(self, st: _StreamState) -> None:
+        resp = None
         try:
             h = dict(st.headers)
             path = h.get(":path", "")
@@ -523,7 +537,26 @@ class GrpcServerConnection(H2Connection):
                 return
             self.send_headers(st.id, [(":status", "200"),
                                       ("content-type", "application/grpc")])
-            self.send_data(st.id, grpc_frame(resp), end_stream=False)
+            if isinstance(resp, (bytes, bytearray, memoryview)):
+                self.send_data(st.id, grpc_frame(bytes(resp)),
+                               end_stream=False)
+            else:
+                # SERVER-STREAMING: the handler returned an iterator of
+                # messages; each becomes one length-prefixed gRPC frame.
+                # A mid-stream handler exception becomes a trailers-only
+                # error status — the stream ends cleanly either way.
+                try:
+                    for item in resp:
+                        self.send_data(st.id, grpc_frame(bytes(item)),
+                                       end_stream=False)
+                except Exception as e:
+                    self.send_headers(
+                        st.id,
+                        [("grpc-status", str(GRPC_INTERNAL)),
+                         ("grpc-message",
+                          f"{type(e).__name__}: {e}"[:1024])],
+                        end_stream=True)
+                    return
             self.send_headers(st.id, [("grpc-status", "0")], end_stream=True)
         except errors.RpcError:
             pass  # stream reset / connection died while responding
@@ -531,6 +564,14 @@ class GrpcServerConnection(H2Connection):
             import traceback
             traceback.print_exc()
         finally:
+            # a streaming response abandoned mid-transmission must run
+            # its finallys NOW (deferred accounting, session give-back)
+            # — not whenever GC collects the suspended generator
+            if hasattr(resp, "close"):
+                try:
+                    resp.close()
+                except Exception:
+                    pass
             self.close_stream(st.id)
 
     def _respond_error(self, stream_id: int, status: int, msg: str) -> None:
@@ -578,6 +619,39 @@ class GrpcChannel:
         except TimeoutError:
             raise errors.RpcError(errors.ERPCTIMEDOUT, "grpc call timed out")
 
+    def call_stream(self, service: str, method: str, payload: bytes,
+                    timeout_ms: Optional[int] = None,
+                    metadata: Optional[list[tuple[str, str]]] = None):
+        """SERVER-STREAMING call: yields each response message as its
+        gRPC frame arrives (incremental — messages are consumed off the
+        open h2 stream, not buffered until trailers).  Raises RpcError on
+        a non-zero grpc-status trailer; the per-message timeout is the
+        channel timeout."""
+        per_msg_s = (timeout_ms or self._timeout_ms) / 1e3
+        conn = self._ensure()
+        sink, stream_id = conn.start_stream_call(service, method, payload,
+                                                 metadata or [])
+        finished = False
+        try:
+            while True:
+                try:
+                    item = sink.get(timeout=per_msg_s)
+                except queue.Empty:
+                    raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                          "grpc stream message timed out")
+                if item is _STREAM_END:
+                    finished = True
+                    return
+                if isinstance(item, Exception):
+                    finished = True
+                    raise item
+                yield item
+        finally:
+            if not finished and stream_id:
+                # consumer abandoned the iterator (break / close / error
+                # in the loop body): cancel so the server stops sending
+                conn.cancel_stream_call(stream_id)
+
     def close(self) -> None:
         with self._lock:
             if self._conn is not None:
@@ -594,6 +668,7 @@ class _GrpcClientConnection(H2Connection):
         self._authority = f"{host}:{port}"
         self._next_stream = 1
         self._calls: dict[int, Future] = {}
+        self._sinks: dict[int, "queue.Queue"] = {}   # streaming calls
         self._calls_lock = threading.Lock()
         tp = Transport.instance()
         self.sid = tp.connect(host, port, self._on_message, self._on_failed)
@@ -619,51 +694,148 @@ class _GrpcClientConnection(H2Connection):
     def _on_failed(self, sid: int, err: int) -> None:
         with self._calls_lock:
             calls, self._calls = self._calls, {}
+            sinks, self._sinks = self._sinks, {}
         for fut in calls.values():
             if not fut.done():
                 fut.set_exception(errors.RpcError(
                     errors.EFAILEDSOCKET, "h2 connection lost"))
+        for sink in sinks.values():
+            sink.put(errors.RpcError(errors.EFAILEDSOCKET,
+                                     "h2 connection lost"))
+
+    def _begin_call(self, service: str, method: str, payload: bytes,
+                    metadata: list[tuple[str, str]], registry: dict,
+                    completion) -> int:
+        """Shared open-and-send for unary and streaming calls: allocate
+        the id AND send HEADERS under one lock (RFC 7540 §5.1.1 requires
+        stream ids to hit the wire in increasing order, so the two steps
+        must not interleave across threads), register the completion in
+        `registry`, then ship the single request frame.  Returns the
+        stream id; raises after unregistering on a send failure."""
+        with self._calls_lock:
+            stream_id = self._next_stream
+            self._next_stream += 2
+            registry[stream_id] = completion
+            self.open_stream(stream_id)  # track our send window
+            headers = [(":method", "POST"), (":scheme", "http"),
+                       (":path", f"/{service}/{method}"),
+                       (":authority", self._authority),
+                       ("content-type", "application/grpc"),
+                       ("te", "trailers")] + metadata
+            self.send_headers(stream_id, headers)
+        try:
+            self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+        except Exception:
+            with self._calls_lock:
+                registry.pop(stream_id, None)
+            self.close_stream(stream_id)
+            raise
+        return stream_id
 
     def start_call(self, service: str, method: str, payload: bytes,
                    metadata: list[tuple[str, str]]) -> Future:
         fut: Future = Future()
         try:
-            # allocate the id AND send HEADERS under one lock: RFC 7540
-            # §5.1.1 requires stream ids to hit the wire in increasing
-            # order, so the two steps must not interleave across threads
-            with self._calls_lock:
-                stream_id = self._next_stream
-                self._next_stream += 2
-                self._calls[stream_id] = fut
-                self.open_stream(stream_id)  # track our send window
-                headers = [(":method", "POST"), (":scheme", "http"),
-                           (":path", f"/{service}/{method}"),
-                           (":authority", self._authority),
-                           ("content-type", "application/grpc"),
-                           ("te", "trailers")] + metadata
-                self.send_headers(stream_id, headers)
-            self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+            self._begin_call(service, method, payload, metadata,
+                             self._calls, fut)
         except Exception as e:
-            with self._calls_lock:
-                self._calls.pop(stream_id, None)
-            self.close_stream(stream_id)
             if not fut.done():
                 fut.set_exception(e)
         return fut
+
+    def start_stream_call(self, service: str, method: str, payload: bytes,
+                          metadata: list[tuple[str, str]]):
+        """Open a server-streaming call; returns (sink, stream_id): the
+        queue call_stream drains (messages, then _STREAM_END or an
+        exception) and the id used to cancel an abandoned stream."""
+        sink: "queue.Queue" = queue.Queue()
+        stream_id = 0
+        try:
+            stream_id = self._begin_call(service, method, payload,
+                                         metadata, self._sinks, sink)
+        except Exception as e:
+            sink.put(e if isinstance(e, errors.RpcError) else
+                     errors.RpcError(errors.EFAILEDSOCKET, str(e)))
+        return sink, stream_id
+
+    def cancel_stream_call(self, stream_id: int) -> None:
+        """Abandoned streaming call: stop delivery and tell the server to
+        stop transmitting (RST_STREAM CANCEL) instead of letting it ship
+        the rest of the response into an unread queue."""
+        with self._calls_lock:
+            sink = self._sinks.pop(stream_id, None)
+        if sink is None:
+            return
+        try:
+            self.send_rst(stream_id, 0x8)   # CANCEL
+        except Exception:
+            pass
+        self.close_stream(stream_id)
+
+    def _drain_stream_frames(self, st: _StreamState, sink) -> bool:
+        """Pop complete length-prefixed messages off the stream buffer
+        into the sink.  Returns False on a framing error (sink fed the
+        exception)."""
+        data = st.data
+        off = 0
+        while len(data) - off >= 5:
+            compressed = data[off]
+            (ln,) = struct.unpack_from(">I", data, off + 1)
+            if compressed not in (0, 1):
+                sink.put(errors.RpcError(errors.ERESPONSE,
+                                         "bad grpc frame flag"))
+                return False
+            if len(data) - off - 5 < ln:
+                break
+            if compressed:
+                sink.put(errors.RpcError(
+                    errors.ERESPONSE, "compressed grpc message"))
+                return False
+            sink.put(bytes(data[off + 5:off + 5 + ln]))
+            off += 5 + ln
+        if off:
+            del data[:off]
+        return True
+
+    def on_stream_data(self, st: _StreamState) -> None:
+        with self._calls_lock:
+            sink = self._sinks.get(st.id)
+        if sink is not None and not self._drain_stream_frames(st, sink):
+            with self._calls_lock:
+                self._sinks.pop(st.id, None)
+            self.send_rst(st.id, 0x2)
+            self.close_stream(st.id)
 
     def on_stream_complete(self, st: _StreamState) -> None:
         self.close_stream(st.id)
         with self._calls_lock:
             fut = self._calls.pop(st.id, None)
-        if fut is None or fut.done():
-            return
+            sink = self._sinks.pop(st.id, None)
         h = dict(st.headers)
         t = dict(st.trailers) if st.trailers else h
         try:
             status = int(t.get("grpc-status", "0"))
         except ValueError:
             status = GRPC_UNKNOWN
-        if h.get(":status", "200") != "200" or status != 0:
+        failed = h.get(":status", "200") != "200" or status != 0
+        if sink is not None:
+            if failed:
+                msg = t.get("grpc-message", f"grpc-status {status}")
+                sink.put(errors.RpcError(grpc_to_err(status), msg))
+            elif not self._drain_stream_frames(st, sink):
+                pass  # framing error already fed to the sink
+            elif st.data:
+                # clean trailers with a partial frame still buffered:
+                # the unary path calls this 'truncated grpc frame' —
+                # never report a clean end with a message silently lost
+                sink.put(errors.RpcError(errors.ERESPONSE,
+                                         "truncated grpc frame"))
+            else:
+                sink.put(_STREAM_END)
+            return
+        if fut is None or fut.done():
+            return
+        if failed:
             msg = t.get("grpc-message", f"grpc-status {status}")
             fut.set_exception(errors.RpcError(grpc_to_err(status), msg))
             return
@@ -676,8 +848,12 @@ class _GrpcClientConnection(H2Connection):
     def on_stream_reset(self, stream_id: int, code: int) -> None:
         with self._calls_lock:
             fut = self._calls.pop(stream_id, None)
+            sink = self._sinks.pop(stream_id, None)
         if fut is not None and not fut.done():
             fut.set_exception(errors.RpcError(
+                errors.EINTERNAL, f"stream reset by peer (h2 error {code})"))
+        if sink is not None:
+            sink.put(errors.RpcError(
                 errors.EINTERNAL, f"stream reset by peer (h2 error {code})"))
 
     def on_goaway(self, last_stream: int) -> None:
@@ -688,9 +864,16 @@ class _GrpcClientConnection(H2Connection):
                       if sid > last_stream}
             for sid in doomed:
                 del self._calls[sid]
+            doomed_sinks = {sid: s for sid, s in self._sinks.items()
+                            if sid > last_stream}
+            for sid in doomed_sinks:
+                del self._sinks[sid]
+        err = errors.RpcError(errors.EFAILEDSOCKET,
+                              "connection going away (h2 GOAWAY)")
         for sid, fut in doomed.items():
             self.close_stream(sid)
             if not fut.done():
-                fut.set_exception(errors.RpcError(
-                    errors.EFAILEDSOCKET,
-                    "connection going away (h2 GOAWAY)"))
+                fut.set_exception(err)
+        for sid, sink in doomed_sinks.items():
+            self.close_stream(sid)
+            sink.put(err)

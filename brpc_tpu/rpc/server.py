@@ -975,6 +975,27 @@ class Server:
         error_code = 0
         text = ""
         resp = b""
+        streaming = False
+
+        def _finish(code: int) -> None:
+            # accounting + resource release, exactly once per call.  For
+            # unary calls it runs in this function's finally; a STREAMING
+            # call defers it to the end of frame transmission so graceful
+            # join() waits for in-flight streams and the session object
+            # stays borrowed while the generator body still runs.
+            latency_us = int((time.monotonic() - start) * 1e6)
+            status.on_responded(code, latency_us)
+            if self._limiter is not None:
+                self._limiter.on_responded(code, latency_us)
+            span.error_code = code
+            span.end_us = rpcz.now_us()
+            rpcz.submit(span)
+            with self._inflight_mu:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._inflight_zero.set()
+
+        cntl = None
         try:
             request = spec.request_serializer.decode(payload, "")
             span.request_size = len(payload)
@@ -985,9 +1006,10 @@ class Server:
             rpcz.set_current_span(span)
             if self._session_pool is not None:
                 cntl.session_data = self._session_pool.borrow()
+            tag = self._service_tags.get(key[0])
+            pool = self._tag_pools.get(tag) if tag is not None else None
+            result = None
             try:
-                tag = self._service_tags.get(key[0])
-                pool = self._tag_pools.get(tag) if tag is not None else None
                 if pool is not None:
                     # honor the service's isolated pool for gRPC too: the
                     # calling h2 worker blocks, but handler CONCURRENCY is
@@ -997,11 +1019,52 @@ class Server:
                     result = spec.fn(cntl, request)
             finally:
                 rpcz.set_current_span(None)
-                if self._session_pool is not None:
+                # a streaming result keeps its session until the
+                # generator finishes (the body runs per-item, later)
+                if self._session_pool is not None and \
+                        not hasattr(result, "__next__"):
                     self._session_pool.give_back(cntl.session_data)
                     cntl.session_data = None
             if cntl.failed():
                 error_code, text = cntl.error_code, cntl.error_text
+            elif hasattr(result, "__next__"):
+                # SERVER-STREAMING: each item is encoded lazily as the h2
+                # layer pulls it into one gRPC frame.  Item production
+                # stays bounded by the service's tag pool (one submit per
+                # item); _finish and session give-back run when the
+                # stream ends, however it ends.
+                streaming = True
+                span.annotate("server-streaming")
+                res_ser = spec.response_serializer
+                sentinel = object()
+
+                def _encode_stream(gen=result, ser=res_ser, cn=cntl,
+                                   pl=pool, end=sentinel):
+                    code = 0
+                    try:
+                        while True:
+                            if pl is not None:
+                                item = pl.submit(next, gen, end).result()
+                            else:
+                                item = next(gen, end)
+                            if item is end:
+                                return
+                            body, _ = ser.encode(item)
+                            yield body
+                    except GeneratorExit:
+                        # closed early (peer gone / client cancelled)
+                        code = errors.ECANCELED
+                        raise
+                    except BaseException:
+                        code = errors.EINTERNAL
+                        raise
+                    finally:
+                        if self._session_pool is not None:
+                            self._session_pool.give_back(cn.session_data)
+                            cn.session_data = None
+                        _finish(code)
+
+                resp = _encode_stream()
             else:
                 resp, _ = spec.response_serializer.encode(result)
                 span.response_size = len(resp)
@@ -1009,17 +1072,8 @@ class Server:
             error_code = errors.EINTERNAL
             text = f"{type(e).__name__}: {e}"
         finally:
-            latency_us = int((time.monotonic() - start) * 1e6)
-            status.on_responded(error_code, latency_us)
-            if self._limiter is not None:
-                self._limiter.on_responded(error_code, latency_us)
-            span.error_code = error_code
-            span.end_us = rpcz.now_us()
-            rpcz.submit(span)
-            with self._inflight_mu:
-                self._inflight -= 1
-                if self._inflight == 0:
-                    self._inflight_zero.set()
+            if not streaming:
+                _finish(error_code)
         return resp, error_code, text
 
 

@@ -1,7 +1,9 @@
 """HTTP/2 + gRPC tests: HPACK against RFC 7541 appendix vectors, then
 loopback gRPC calls through a real Server on 127.0.0.1 (the reference's
 in-process integration-test pattern, SURVEY.md §4)."""
+import json
 import threading
+import time
 
 import pytest
 
@@ -234,3 +236,191 @@ def test_grpc_timeout_header_parsing():
     assert parse_grpc_timeout("") is None
     assert parse_grpc_timeout("xx") is None
     assert parse_grpc_timeout("5") is None
+
+
+# ---- server-streaming gRPC (reference h2 supports streaming calls;
+# handler returns an iterator, each item = one length-prefixed frame) ----
+
+class GrpcStreamer(brpc.Service):
+    NAME = "test.Streamer"
+
+    @brpc.method(request="json", response="raw")
+    def Count(self, cntl, req):
+        def gen():
+            for i in range(int(req["n"])):
+                yield b"msg-%d" % i
+        return gen()
+
+    @brpc.method(request="json", response="json")
+    def CountJson(self, cntl, req):
+        return ({"i": i} for i in range(int(req["n"])))
+
+    @brpc.method(request="json", response="raw")
+    def Explode(self, cntl, req):
+        def gen():
+            yield b"one"
+            raise RuntimeError("mid-stream failure")
+        return gen()
+
+    @brpc.method(request="json", response="raw")
+    def Slowly(self, cntl, req):
+        def gen():
+            for i in range(3):
+                time.sleep(0.15)
+                yield b"tick-%d" % i
+        return gen()
+
+
+@pytest.fixture(scope="module")
+def stream_server():
+    s = brpc.Server()
+    s.add_service(GrpcStreamer())
+    s.start("127.0.0.1", 0)
+    yield s
+    s.stop()
+    s.join()
+
+
+def test_grpc_server_streaming_basic(stream_server):
+    ch = GrpcChannel(f"127.0.0.1:{stream_server.port}")
+    msgs = list(ch.call_stream("test.Streamer", "Count",
+                               json.dumps({"n": 20}).encode()))
+    assert msgs == [b"msg-%d" % i for i in range(20)]
+    ch.close()
+
+
+def test_grpc_server_streaming_json_items(stream_server):
+    ch = GrpcChannel(f"127.0.0.1:{stream_server.port}")
+    msgs = list(ch.call_stream("test.Streamer", "CountJson",
+                               json.dumps({"n": 5}).encode()))
+    assert [json.loads(m) for m in msgs] == [{"i": i} for i in range(5)]
+    ch.close()
+
+
+def test_grpc_streaming_messages_arrive_incrementally(stream_server):
+    """Each message must be yielded as its frame arrives — not buffered
+    until trailers: three ticks at 150ms spacing must surface with
+    increasing arrival times, the first well before the stream ends."""
+    ch = GrpcChannel(f"127.0.0.1:{stream_server.port}", timeout_ms=10000)
+    arrivals = []
+    for m in ch.call_stream("test.Streamer", "Slowly", b"{}"):
+        arrivals.append((m, time.monotonic()))
+    assert [m for m, _ in arrivals] == [b"tick-0", b"tick-1", b"tick-2"]
+    spans = [t2 - t1 for (_, t1), (_, t2) in zip(arrivals, arrivals[1:])]
+    assert all(s > 0.05 for s in spans), spans  # spaced, not one burst
+    ch.close()
+
+
+def test_grpc_streaming_midstream_error_surfaces(stream_server):
+    ch = GrpcChannel(f"127.0.0.1:{stream_server.port}")
+    got = []
+    with pytest.raises(errors.RpcError):
+        for m in ch.call_stream("test.Streamer", "Explode", b"{}"):
+            got.append(m)
+    assert got == [b"one"]          # delivered before the failure
+    ch.close()
+
+
+def test_grpc_unary_still_works_alongside_streaming(stream_server):
+    ch = GrpcChannel(f"127.0.0.1:{stream_server.port}")
+    msgs = list(ch.call_stream("test.Streamer", "Count",
+                               json.dumps({"n": 3}).encode()))
+    assert len(msgs) == 3
+    ch.close()
+
+
+def test_grpc_stream_early_break_cancels_server(stream_server):
+    """Abandoning the iterator must RST the stream; the server's
+    generator stops instead of shipping the whole response."""
+    produced = []
+
+    class Big(brpc.Service):
+        NAME = "test.Big"
+
+        @brpc.method(request="json", response="raw")
+        def Flood(self, cntl, req):
+            def gen():
+                for i in range(5000):
+                    produced.append(i)
+                    yield b"x" * 4096
+            return gen()
+
+    srv = brpc.Server()
+    srv.add_service(Big())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        got = 0
+        for m in ch.call_stream("test.Big", "Flood", b"{}"):
+            got += 1
+            if got == 5:
+                break               # abandon -> RST CANCEL
+        deadline = time.monotonic() + 5
+        # the server generator must stop well short of 5000 items
+        last = None
+        while time.monotonic() < deadline:
+            n = len(produced)
+            if n == last:
+                break               # production stopped
+            last = n
+            time.sleep(0.2)
+        assert len(produced) < 5000, len(produced)
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_grpc_streaming_through_tag_pool():
+    """A service with an isolated worker tag keeps per-item production
+    bounded by its pool (items still arrive, in order)."""
+    class Tagged(brpc.Service):
+        NAME = "test.Tagged"
+
+        @brpc.method(request="json", response="raw")
+        def Gen(self, cntl, req):
+            return (b"i%d" % i for i in range(10))
+
+    srv = brpc.Server()
+    srv.add_service(Tagged(), tag="grpc-stream-tag", tag_workers=1)
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        msgs = list(ch.call_stream("test.Tagged", "Gen", b"{}"))
+        assert msgs == [b"i%d" % i for i in range(10)]
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_grpc_streaming_graceful_join_waits(stream_server):
+    """stop()/join() must wait for an in-flight stream (deferred
+    accounting keeps it in _inflight until transmission ends)."""
+    srv = brpc.Server()
+
+    class Slow(brpc.Service):
+        NAME = "test.SlowJoin"
+
+        @brpc.method(request="json", response="raw")
+        def Drip(self, cntl, req):
+            def gen():
+                for i in range(4):
+                    time.sleep(0.1)
+                    yield b"d%d" % i
+            return gen()
+
+    srv.add_service(Slow())
+    srv.start("127.0.0.1", 0)
+    ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+    msgs = []
+    t = threading.Thread(
+        target=lambda: msgs.extend(
+            ch.call_stream("test.SlowJoin", "Drip", b"{}")))
+    t.start()
+    time.sleep(0.15)                # stream is mid-flight
+    srv.stop()
+    srv.join()                      # must wait for the drip to finish
+    t.join(10)
+    assert msgs == [b"d%d" % i for i in range(4)], msgs
+    ch.close()
