@@ -441,13 +441,14 @@ def bench_ici_ladder():
         # bench_tensor_pipe)
         m_cap = max(1, (96 << 30) // (k * size))
         m_window = max(1, (window - k * size) // (k * size))
-        m = 1
-        rung = None
-        while True:
+
+        def run_trial(m):
+            """One timed trial of m dispatches, split into window-bounded
+            iterations.  Returns (copy_sum, iters); copy_sum None on a
+            wedged drainer."""
             iters = 0
             remaining = m
             copy_sum = 0.0
-            stalled = False
             while remaining > 0:
                 mi = min(remaining, m_window)
                 # untimed drain: start each timed run with full credit
@@ -456,8 +457,7 @@ def bench_ici_ladder():
                         time.monotonic() < deadline:
                     time.sleep(0.002)
                 if ep.inflight_bytes > 0:
-                    stalled = True
-                    break
+                    return None, iters
                 last = None
                 t0 = time.perf_counter()
                 for _ in range(mi):
@@ -466,7 +466,13 @@ def bench_ici_ladder():
                 copy_sum += time.perf_counter() - t0 - base
                 remaining -= mi
                 iters += 1
-            if stalled:
+            return copy_sum, iters
+
+        m = 1
+        rung = None
+        while True:
+            copy_sum, iters = run_trial(m)
+            if copy_sum is None:
                 rung = {"lat_us": None, "gbps": None, "batch": k,
                         "dispatches": m,
                         "invalid": ["drainer wedged: window credit not "
@@ -474,6 +480,16 @@ def bench_ici_ladder():
                 break
             floor = max(0.004, 4 * jitter * math.sqrt(iters))
             if copy_sum >= floor:
+                # best-of-3 at the accepted size: a single trial can eat
+                # a one-off allocator or tunnel hiccup and publish a
+                # misleading dip (the r3 full-run 64MB rung resolved from
+                # ONE dispatch and broke monotonicity); the minimum copy
+                # time is the standard bandwidth estimator, and the
+                # confidence floor still applies to the kept trial
+                for _ in range(2):
+                    c2, _ = run_trial(m)
+                    if c2 is not None and c2 >= floor and c2 < copy_sum:
+                        copy_sum = c2
                 gbps, issues = _gated(m * k * size, max(copy_sum, 1e-9))
                 rung = {"lat_us": round(copy_sum / (m * k) * 1e6, 2),
                         "gbps": gbps, "batch": k, "dispatches": m,

@@ -504,6 +504,7 @@ class GrpcServerConnection(H2Connection):
 
     def _process(self, st: _StreamState) -> None:
         resp = None
+        handed_off = False
         try:
             h = dict(st.headers)
             path = h.get(":path", "")
@@ -541,22 +542,18 @@ class GrpcServerConnection(H2Connection):
                 self.send_data(st.id, grpc_frame(bytes(resp)),
                                end_stream=False)
             else:
-                # SERVER-STREAMING: the handler returned an iterator of
-                # messages; each becomes one length-prefixed gRPC frame.
-                # A mid-stream handler exception becomes a trailers-only
-                # error status — the stream ends cleanly either way.
-                try:
-                    for item in resp:
-                        self.send_data(st.id, grpc_frame(bytes(item)),
-                                       end_stream=False)
-                except Exception as e:
-                    self.send_headers(
-                        st.id,
-                        [("grpc-status", str(GRPC_INTERNAL)),
-                         ("grpc-message",
-                          f"{type(e).__name__}: {e}"[:1024])],
-                        end_stream=True)
-                    return
+                # SERVER-STREAMING: transmission runs on a DEDICATED
+                # thread — a long stream (or a slow reader holding the h2
+                # window at zero) must not park one of the bounded shared
+                # grpc workers for its whole lifetime and starve unary
+                # dispatch.  The thread takes ownership of resp and the
+                # stream close.
+                body, resp = resp, None
+                handed_off = True   # the thread owns the stream close
+                threading.Thread(target=self._transmit_stream,
+                                 args=(st, body), daemon=True,
+                                 name=f"grpc-stream-tx-{st.id}").start()
+                return
             self.send_headers(st.id, [("grpc-status", "0")], end_stream=True)
         except errors.RpcError:
             pass  # stream reset / connection died while responding
@@ -564,12 +561,52 @@ class GrpcServerConnection(H2Connection):
             import traceback
             traceback.print_exc()
         finally:
-            # a streaming response abandoned mid-transmission must run
-            # its finallys NOW (deferred accounting, session give-back)
-            # — not whenever GC collects the suspended generator
-            if hasattr(resp, "close"):
+            if not handed_off:
+                # a streaming response abandoned BEFORE hand-off (error
+                # branch, deadline branch, send failure) must run its
+                # cleanup NOW — close() works even on a never-started
+                # body (deferred accounting, session give-back)
+                if hasattr(resp, "close"):
+                    try:
+                        resp.close()
+                    except Exception:
+                        pass
+                self.close_stream(st.id)
+
+    def _transmit_stream(self, st: _StreamState, body) -> None:
+        """Send one streaming response to its end: each item one
+        length-prefixed frame, then trailers.  A transport error (stream
+        reset by the client's cancel, dead connection) stops quietly —
+        trailers on a reset stream would be a protocol violation; a
+        handler error becomes an error-trailer.  Cleanup (body.close(),
+        which runs the server's deferred accounting) is unconditional."""
+        try:
+            try:
+                for item in body:
+                    self.send_data(st.id, grpc_frame(bytes(item)),
+                                   end_stream=False)
+            except errors.RpcError:
+                return  # reset / dead connection: no trailers possible
+            except Exception as e:
                 try:
-                    resp.close()
+                    self.send_headers(
+                        st.id,
+                        [("grpc-status", str(GRPC_INTERNAL)),
+                         ("grpc-message",
+                          f"{type(e).__name__}: {e}"[:1024])],
+                        end_stream=True)
+                except errors.RpcError:
+                    pass
+                return
+            try:
+                self.send_headers(st.id, [("grpc-status", "0")],
+                                  end_stream=True)
+            except errors.RpcError:
+                pass
+        finally:
+            if hasattr(body, "close"):
+                try:
+                    body.close()
                 except Exception:
                     pass
             self.close_stream(st.id)

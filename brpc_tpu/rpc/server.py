@@ -34,6 +34,61 @@ from brpc_tpu.rpc.transport import (MSG_H2, MSG_HTTP, MSG_MEMCACHE,
 _dropped_responses = Adder("rpc_server_dropped_responses")
 
 
+class _StreamBody:
+    """Server-streaming response body: iterates the handler's generator,
+    encoding one item per __next__ (bounded by the service's tag pool),
+    and guarantees the cleanup callback runs EXACTLY once however the
+    stream ends — exhaustion, mid-stream error, or close() before the
+    first item (where a plain generator's finally would never run)."""
+
+    _END = object()
+
+    def __init__(self, gen, serializer, pool, cleanup):
+        self._gen = gen
+        self._ser = serializer
+        self._pool = pool
+        self._cleanup = cleanup
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        try:
+            if self._pool is not None:
+                item = self._pool.submit(next, self._gen, self._END).result()
+            else:
+                item = next(self._gen, self._END)
+        except BaseException:
+            self._settle(errors.EINTERNAL)
+            raise
+        if item is self._END:
+            self._settle(0)
+            raise StopIteration
+        try:
+            body, _ = self._ser.encode(item)
+        except BaseException:
+            self._settle(errors.EINTERNAL)
+            raise
+        return body
+
+    def close(self) -> None:
+        if self._done:
+            return
+        try:
+            self._gen.close()
+        except Exception:
+            pass
+        self._settle(errors.ECANCELED)
+
+    def _settle(self, code: int) -> None:
+        if not self._done:
+            self._done = True
+            self._cleanup(code)
+
+
 def _interceptor_code(verdict):
     """Maps an interceptor verdict to an error code, or None to admit.
     ONE implementation for every dispatch path (native, RESTful, gRPC):
@@ -1027,44 +1082,38 @@ class Server:
                     cntl.session_data = None
             if cntl.failed():
                 error_code, text = cntl.error_code, cntl.error_text
+                if hasattr(result, "__next__"):
+                    # failed AND returned a generator: the streaming
+                    # branch below won't run, so release its resources
+                    # here (the generator body never executes)
+                    try:
+                        result.close()
+                    except Exception:
+                        pass
+                    if self._session_pool is not None:
+                        self._session_pool.give_back(cntl.session_data)
+                        cntl.session_data = None
             elif hasattr(result, "__next__"):
                 # SERVER-STREAMING: each item is encoded lazily as the h2
                 # layer pulls it into one gRPC frame.  Item production
                 # stays bounded by the service's tag pool (one submit per
-                # item); _finish and session give-back run when the
-                # stream ends, however it ends.
+                # item); cleanup (session give-back + _finish accounting)
+                # runs when the stream ends HOWEVER it ends — including
+                # close() before the first item (a plain generator's
+                # finally never runs if iteration never starts, which
+                # leaked the inflight slot when the h2 layer bailed
+                # between handler return and transmission).
                 streaming = True
                 span.annotate("server-streaming")
-                res_ser = spec.response_serializer
-                sentinel = object()
 
-                def _encode_stream(gen=result, ser=res_ser, cn=cntl,
-                                   pl=pool, end=sentinel):
-                    code = 0
-                    try:
-                        while True:
-                            if pl is not None:
-                                item = pl.submit(next, gen, end).result()
-                            else:
-                                item = next(gen, end)
-                            if item is end:
-                                return
-                            body, _ = ser.encode(item)
-                            yield body
-                    except GeneratorExit:
-                        # closed early (peer gone / client cancelled)
-                        code = errors.ECANCELED
-                        raise
-                    except BaseException:
-                        code = errors.EINTERNAL
-                        raise
-                    finally:
-                        if self._session_pool is not None:
-                            self._session_pool.give_back(cn.session_data)
-                            cn.session_data = None
-                        _finish(code)
+                def _cleanup(code, cn=cntl):
+                    if self._session_pool is not None:
+                        self._session_pool.give_back(cn.session_data)
+                        cn.session_data = None
+                    _finish(code)
 
-                resp = _encode_stream()
+                resp = _StreamBody(result, spec.response_serializer,
+                                   pool, _cleanup)
             else:
                 resp, _ = spec.response_serializer.encode(result)
                 span.response_size = len(resp)

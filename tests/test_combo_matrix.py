@@ -1,0 +1,183 @@
+"""Deeper combo-channel matrix (reference brpc_channel_unittest's
+parallel/selective sections: mapper skip, merger errors, failover order,
+all-dead clusters — SURVEY.md §2.5, §4)."""
+import threading
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.rpc.combo_channels import (CallMapper, ParallelChannel,
+                                         ResponseMerger, SelectiveChannel,
+                                         SubCall)
+
+
+class SkipIndex(CallMapper):
+    def __init__(self, skip_i):
+        self.skip_i = skip_i
+
+    def map(self, i, n, request):
+        return SubCall(skip=True) if i == self.skip_i else SubCall(request)
+
+
+class TagFold(ResponseMerger):
+    def merge(self, responses):
+        return {"tags": sorted(r["tag"] for r in responses if r)}
+
+
+class Node(brpc.Service):
+    NAME = "Node"
+
+    def __init__(self, tag, fail=False, calls=None):
+        self._tag = tag
+        self._fail = fail
+        self._calls = calls if calls is not None else []
+
+    @brpc.method(request="json", response="json")
+    def Q(self, cntl, req):
+        self._calls.append(self._tag)
+        if self._fail:
+            # app-level code outside RetryPolicy.RETRYABLE: the inner
+            # Channel must NOT retry it, so `calls` counts exactly the
+            # combo layer's attempts
+            cntl.set_failed(1234, f"{self._tag} down")
+            return None
+        return {"tag": self._tag}
+
+
+def _srv(tag, fail=False, calls=None):
+    s = brpc.Server()
+    s.add_service(Node(tag, fail, calls))
+    s.start("127.0.0.1", 0)
+    return s
+
+
+class TestParallelMapperMerger:
+    def test_mapper_skip_excludes_subchannel(self):
+        calls = []
+        servers = [_srv(f"n{i}", calls=calls) for i in range(3)]
+        try:
+            pc = ParallelChannel(call_mapper=SkipIndex(1))
+            for s in servers:
+                pc.add_channel(brpc.Channel(f"127.0.0.1:{s.port}",
+                                            timeout_ms=3000))
+            out = pc.call_sync("Node", "Q", {"x": 1}, serializer="json")
+            tags = sorted(r["tag"] for r in out if r is not None)
+            assert tags == ["n0", "n2"]
+            assert "n1" not in calls          # never contacted
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_custom_merger_folds(self):
+        servers = [_srv(f"n{i}") for i in range(3)]
+        try:
+            pc = ParallelChannel(response_merger=TagFold())
+            for s in servers:
+                pc.add_channel(brpc.Channel(f"127.0.0.1:{s.port}",
+                                            timeout_ms=3000))
+            out = pc.call_sync("Node", "Q", {}, serializer="json")
+            assert out == {"tags": ["n0", "n1", "n2"]}
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_fail_limit_exceeded_raises(self):
+        servers = [_srv("ok0"), _srv("bad1", fail=True),
+                   _srv("bad2", fail=True)]
+        try:
+            pc = ParallelChannel(fail_limit=1)
+            for s in servers:
+                pc.add_channel(brpc.Channel(f"127.0.0.1:{s.port}",
+                                            timeout_ms=3000))
+            with pytest.raises(errors.RpcError):
+                pc.call_sync("Node", "Q", {}, serializer="json")
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_all_subchannels_dead(self):
+        pc = ParallelChannel(fail_limit=0)
+        for port in (1, 2):
+            pc.add_channel(brpc.Channel(f"127.0.0.1:{port}",
+                                        timeout_ms=1500))
+        with pytest.raises(errors.RpcError):
+            pc.call_sync("Node", "Q", {}, serializer="json")
+
+
+class TestSelectiveFailover:
+    def test_failover_skips_failed_subchannel(self):
+        calls = []
+        bad = _srv("bad", fail=True, calls=calls)
+        good = _srv("good", calls=calls)
+        try:
+            sc = SelectiveChannel(max_retry=2)
+            sc.add_channel(brpc.Channel(f"127.0.0.1:{bad.port}",
+                                        timeout_ms=3000))
+            sc.add_channel(brpc.Channel(f"127.0.0.1:{good.port}",
+                                        timeout_ms=3000))
+            cntl = brpc.Controller()
+            out = sc.call_sync("Node", "Q", {}, serializer="json",
+                               cntl=cntl)
+            assert out == {"tag": "good"}
+            assert cntl.retried_count == 1
+            assert cntl.error_code == 0       # reset after the winner
+        finally:
+            bad.stop(); bad.join()
+            good.stop(); good.join()
+
+    def test_each_subchannel_tried_once(self):
+        calls = []
+        servers = [_srv(f"b{i}", fail=True, calls=calls) for i in range(3)]
+        try:
+            sc = SelectiveChannel(max_retry=10)   # more than channels
+            for s in servers:
+                sc.add_channel(brpc.Channel(f"127.0.0.1:{s.port}",
+                                            timeout_ms=3000))
+            with pytest.raises(errors.RpcError):
+                sc.call_sync("Node", "Q", {}, serializer="json")
+            assert sorted(calls) == ["b0", "b1", "b2"]  # no double-tries
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()
+
+    def test_empty_selective_raises_enodata(self):
+        sc = SelectiveChannel()
+        with pytest.raises(errors.RpcError) as ei:
+            sc.call_sync("Node", "Q", {}, serializer="json")
+        assert ei.value.code == errors.ENODATA
+
+
+class TestParallelConcurrency:
+    def test_concurrent_fanouts(self):
+        servers = [_srv(f"n{i}") for i in range(3)]
+        try:
+            pc = ParallelChannel()
+            for s in servers:
+                pc.add_channel(brpc.Channel(f"127.0.0.1:{s.port}",
+                                            timeout_ms=5000))
+            results = []
+            errs = []
+
+            def worker():
+                try:
+                    for _ in range(20):
+                        out = pc.call_sync("Node", "Q", {},
+                                           serializer="json")
+                        results.append(len([r for r in out if r]))
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker) for _ in range(4)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs, errs[:2]
+            assert results and all(n == 3 for n in results)
+        finally:
+            for s in servers:
+                s.stop()
+                s.join()

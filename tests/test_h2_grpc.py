@@ -424,3 +424,33 @@ def test_grpc_streaming_graceful_join_waits(stream_server):
     t.join(10)
     assert msgs == [b"d%d" % i for i in range(4)], msgs
     ch.close()
+
+
+def test_grpc_streaming_deadline_expired_releases_inflight():
+    """A stream abandoned BEFORE transmission (server-side deadline
+    already expired when the handler returned) must still release its
+    in-flight slot — join() hangs forever otherwise (the never-started
+    generator's finally would never run without _StreamBody.close)."""
+    srv = brpc.Server()
+
+    class Tardy(brpc.Service):
+        NAME = "test.Tardy"
+
+        @brpc.method(request="json", response="raw")
+        def Late(self, cntl, req):
+            time.sleep(0.3)          # outlive the grpc-timeout
+            return (b"never-%d" % i for i in range(3))
+
+    srv.add_service(Tardy())
+    srv.start("127.0.0.1", 0)
+    ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    with pytest.raises(errors.RpcError):
+        # 100ms grpc-timeout: the server takes the deadline-exceeded
+        # branch after the handler returns its generator
+        list(ch.call_stream("test.Tardy", "Late", b"{}",
+                            metadata=[("grpc-timeout", "100m")]))
+    ch.close()
+    t0 = time.monotonic()
+    srv.stop()
+    srv.join()                       # must not hang on _inflight_zero
+    assert time.monotonic() - t0 < 5

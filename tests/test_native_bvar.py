@@ -8,6 +8,7 @@ metrics path (MethodStatus) takes no Python-level lock.
 """
 import ctypes
 import threading
+import time
 
 import pytest
 
@@ -205,4 +206,11 @@ class TestPythonBindings:
         cb = TASK_CB(lambda arg: done.set())
         core.brpc_executor_submit(cb, None)
         assert done.wait(10)
+        # the callback fires BEFORE the worker bumps its combiner cell
+        # (worker_main: fn -> delete -> _executed.add), so give the
+        # counter a moment to land instead of racing the read
+        deadline = time.monotonic() + 10
+        while core.brpc_executor_tasks_executed() <= before and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
         assert core.brpc_executor_tasks_executed() > before
