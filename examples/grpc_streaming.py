@@ -1,0 +1,61 @@
+"""Server-streaming gRPC demo (reference example/grpc_c++ streaming role).
+
+A handler returning an iterator streams one length-prefixed gRPC frame
+per item; the client consumes messages as their frames arrive off the
+open h2 stream.  Abandoning the iterator early RSTs the stream and the
+server's generator stops.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc.h2 import GrpcChannel
+
+
+class Market(brpc.Service):
+    NAME = "demo.Market"
+
+    @brpc.method(request="json", response="raw")
+    def Watch(self, cntl, req):
+        symbol = req.get("symbol", "TPU")
+
+        def ticks():
+            price = 100.0
+            for i in range(req.get("n", 10)):
+                price *= 1.0 + ((i * 2654435761) % 100 - 50) / 5000.0
+                yield json.dumps({"symbol": symbol, "seq": i,
+                                  "price": round(price, 2)}).encode()
+                time.sleep(0.05)
+        return ticks()
+
+
+def main():
+    server = brpc.Server()
+    server.add_service(Market())
+    server.start("127.0.0.1", 0)
+    print(f"serving on 127.0.0.1:{server.port}")
+
+    ch = GrpcChannel(f"127.0.0.1:{server.port}", timeout_ms=5000)
+    print("watching demo.Market/Watch (full stream):")
+    for msg in ch.call_stream("demo.Market", "Watch",
+                              json.dumps({"symbol": "TPU", "n": 8}).encode()):
+        print("  tick:", json.loads(msg))
+
+    print("early cancel after 3 ticks:")
+    for i, msg in enumerate(ch.call_stream(
+            "demo.Market", "Watch",
+            json.dumps({"symbol": "BIG", "n": 1000}).encode())):
+        print("  tick:", json.loads(msg))
+        if i == 2:
+            break      # RST CANCEL: the server stops generating
+    ch.close()
+    server.stop()
+    server.join()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()
