@@ -510,7 +510,15 @@ class GrpcServerConnection(H2Connection):
             path = h.get(":path", "")
             try:
                 msgs = parse_grpc_frames(bytes(st.data))
-                payload = msgs[0] if msgs else b""
+                # the request header — not frame counting — decides the
+                # handler contract: a marked client-stream delivers the
+                # full message LIST (even with 0 or 1 messages); an
+                # unmarked multi-frame body still delivers the list so
+                # messages are never silently dropped
+                if h.get("grpc-client-streaming") == "1" or len(msgs) > 1:
+                    payload = msgs
+                else:
+                    payload = msgs[0] if msgs else b""
             except NotImplementedError:
                 self._respond_error(st.id, GRPC_UNIMPLEMENTED,
                                     "grpc message compression not supported")
@@ -656,6 +664,49 @@ class GrpcChannel:
         except TimeoutError:
             raise errors.RpcError(errors.ERPCTIMEDOUT, "grpc call timed out")
 
+    def call_client_stream(self, service: str, method: str, requests,
+                           timeout_ms: Optional[int] = None,
+                           metadata: Optional[list[tuple[str, str]]] = None
+                           ) -> bytes:
+        """CLIENT-STREAMING call: ships one length-prefixed frame per
+        item of `requests`, ends the stream, and returns the single
+        response.  The server handler receives the full message list."""
+        conn = self._ensure()
+        fut: Future = Future()
+        stream_id = 0
+        try:
+            # the explicit marker (not frame counting) makes a 1- or
+            # 0-message client stream deliver a LIST to the handler,
+            # indistinguishable from the N-message case
+            md = [("grpc-client-streaming", "1")] + (metadata or [])
+            stream_id = conn._begin_call(service, method, None, md,
+                                         conn._calls, fut)
+            for msg in requests:
+                conn.send_data(stream_id, grpc_frame(bytes(msg)),
+                               end_stream=False)
+            conn.send_data(stream_id, b"", end_stream=True)
+        except Exception as e:
+            with conn._calls_lock:
+                conn._calls.pop(stream_id, None)
+            if stream_id:
+                # the server has HEADERS + partial DATA: an abandoned
+                # stream must be RESET (RFC 7540 §6.4), or its state
+                # leaks server-side until the connection dies
+                try:
+                    conn.send_rst(stream_id, 0x8)   # CANCEL
+                except Exception:
+                    pass
+                conn.close_stream(stream_id)
+            if not fut.done():
+                fut.set_exception(
+                    e if isinstance(e, errors.RpcError) else
+                    errors.RpcError(errors.EFAILEDSOCKET, str(e)))
+        try:
+            return fut.result((timeout_ms or self._timeout_ms) / 1e3)
+        except TimeoutError:
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  "grpc client-stream call timed out")
+
     def call_stream(self, service: str, method: str, payload: bytes,
                     timeout_ms: Optional[int] = None,
                     metadata: Optional[list[tuple[str, str]]] = None):
@@ -740,15 +791,18 @@ class _GrpcClientConnection(H2Connection):
             sink.put(errors.RpcError(errors.EFAILEDSOCKET,
                                      "h2 connection lost"))
 
-    def _begin_call(self, service: str, method: str, payload: bytes,
+    def _begin_call(self, service: str, method: str,
+                    payload: Optional[bytes],
                     metadata: list[tuple[str, str]], registry: dict,
                     completion) -> int:
         """Shared open-and-send for unary and streaming calls: allocate
         the id AND send HEADERS under one lock (RFC 7540 §5.1.1 requires
         stream ids to hit the wire in increasing order, so the two steps
         must not interleave across threads), register the completion in
-        `registry`, then ship the single request frame.  Returns the
-        stream id; raises after unregistering on a send failure."""
+        `registry`, then ship the single request frame.  payload=None
+        opens the stream WITHOUT ending it (client-streaming: the caller
+        ships request frames itself).  Returns the stream id; raises
+        after unregistering on a send failure."""
         with self._calls_lock:
             stream_id = self._next_stream
             self._next_stream += 2
@@ -760,6 +814,8 @@ class _GrpcClientConnection(H2Connection):
                        ("content-type", "application/grpc"),
                        ("te", "trailers")] + metadata
             self.send_headers(stream_id, headers)
+        if payload is None:
+            return stream_id
         try:
             self.send_data(stream_id, grpc_frame(payload), end_stream=True)
         except Exception:

@@ -1052,8 +1052,15 @@ class Server:
 
         cntl = None
         try:
-            request = spec.request_serializer.decode(payload, "")
-            span.request_size = len(payload)
+            if isinstance(payload, list):
+                # CLIENT-STREAMING: one decoded message per request
+                # frame; the handler receives the list
+                request = [spec.request_serializer.decode(p, "")
+                           for p in payload]
+                span.request_size = sum(len(p) for p in payload)
+            else:
+                request = spec.request_serializer.decode(payload, "")
+                span.request_size = len(payload)
             cntl = Controller()
             cntl.is_server_side = True
             cntl.request_meta = meta

@@ -6,10 +6,11 @@ open h2 stream.  Abandoning the iterator early RSTs the stream and the
 server's generator stops.
 """
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import brpc_tpu as brpc
 from brpc_tpu.rpc.h2 import GrpcChannel

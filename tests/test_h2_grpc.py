@@ -454,3 +454,114 @@ def test_grpc_streaming_deadline_expired_releases_inflight():
     srv.stop()
     srv.join()                       # must not hang on _inflight_zero
     assert time.monotonic() - t0 < 5
+
+
+# ---- client-streaming gRPC ----
+
+def test_grpc_client_streaming_sum():
+    """Client ships N request frames; the handler receives the full
+    message list and returns one response."""
+    srv = brpc.Server()
+
+    class Acc(brpc.Service):
+        NAME = "test.Acc"
+
+        @brpc.method(request="json", response="json")
+        def Sum(self, cntl, reqs):
+            assert isinstance(reqs, list), type(reqs)
+            return {"total": sum(r["v"] for r in reqs), "n": len(reqs)}
+
+    srv.add_service(Acc())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        out = ch.call_client_stream(
+            "test.Acc", "Sum",
+            (json.dumps({"v": i}).encode() for i in range(1, 11)))
+        assert json.loads(out) == {"total": 55, "n": 10}
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_grpc_client_stream_then_server_stream():
+    """Non-interleaved bidi: all requests up, then a streamed response
+    derived from them."""
+    srv = brpc.Server()
+
+    class Rev(brpc.Service):
+        NAME = "test.Rev"
+
+        @brpc.method(request="raw", response="raw")
+        def Replay(self, cntl, reqs):
+            msgs = reqs if isinstance(reqs, list) else [reqs]
+            return (bytes(m)[::-1] for m in reversed(msgs))
+
+    srv.add_service(Rev())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        out = ch.call_client_stream("test.Rev", "Replay",
+                                    [b"abc", b"def", b"ghi"])
+        # unary-future path returns the FIRST streamed frame for a
+        # streaming response consumed unary-style; use call_stream for
+        # multi-frame responses (covered above) — here just assert the
+        # handler saw the list
+        assert out == b"ihg"
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_grpc_single_frame_still_unary():
+    srv = brpc.Server()
+
+    class One(brpc.Service):
+        NAME = "test.One"
+
+        @brpc.method(request="json", response="json")
+        def Id(self, cntl, req):
+            assert isinstance(req, dict), type(req)
+            return req
+
+    srv.add_service(One())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}")
+        out = ch.call("test.One", "Id", json.dumps({"k": 1}).encode())
+        assert json.loads(out) == {"k": 1}
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_grpc_client_streaming_single_and_empty():
+    """The streaming marker — not frame counting — decides the handler
+    contract: 1-message and 0-message client streams still deliver a
+    LIST."""
+    srv = brpc.Server()
+
+    class Acc2(brpc.Service):
+        NAME = "test.Acc2"
+
+        @brpc.method(request="json", response="json")
+        def Sum(self, cntl, reqs):
+            assert isinstance(reqs, list), type(reqs)
+            return {"total": sum(r["v"] for r in reqs), "n": len(reqs)}
+
+    srv.add_service(Acc2())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        out = ch.call_client_stream("test.Acc2", "Sum",
+                                    [json.dumps({"v": 7}).encode()])
+        assert json.loads(out) == {"total": 7, "n": 1}
+        out = ch.call_client_stream("test.Acc2", "Sum", [])
+        assert json.loads(out) == {"total": 0, "n": 0}
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
