@@ -25,11 +25,19 @@ class TestIOBuf:
         b.append(b"world")
         assert len(b) == 11
         assert b.to_bytes() == b"hello world"
-        # contiguous appends from one thread merge into one block ref —
-        # unless this thread's shared write block happens to fill between
-        # the two appends (state left by earlier tests), which legally
-        # splits them across the block boundary
-        assert b.block_count <= 2
+        # contiguous appends from one thread merge into one block ref.
+        # A single attempt can legally split across the shared write
+        # block's boundary (state left by earlier tests), but three
+        # consecutive 11-byte regions cannot ALL straddle a boundary —
+        # so if merging works at all, at least one attempt shows it,
+        # and if merging is broken every attempt shows 2 refs.
+        counts = [b.block_count]
+        for _ in range(2):
+            c = IOBuf()
+            c.append(b"hello ")
+            c.append(b"world")
+            counts.append(c.block_count)
+        assert min(counts) == 1, counts
 
     def test_large_append_spans_blocks(self):
         b = IOBuf()
