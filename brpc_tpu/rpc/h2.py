@@ -650,15 +650,42 @@ class GrpcChannel:
                 self._conn = _GrpcClientConnection(*self._addr)
             return self._conn
 
+    def _with_deadline(self, metadata, timeout_ms):
+        """DEADLINE PROPAGATION (unary calls only): stamp grpc-timeout so
+        the server stops working on a call the client has abandoned.
+        Streaming calls do NOT auto-stamp — their channel timeout is a
+        per-message/production budget, not a whole-call deadline, and
+        advertising it would have spec-compliant peers kill any stream
+        outliving one timeout span.  Callers may always supply their own
+        grpc-timeout in metadata."""
+        md = list(metadata or [])
+        ms = timeout_ms or self._timeout_ms
+        if ms and ms > 0 and not any(k == "grpc-timeout" for k, _ in md):
+            # TimeoutValue is at most 8 digits: promote the unit until
+            # the number fits (m -> S -> M -> H)
+            value = int(ms)
+            for unit, div in (("m", 1), ("S", 1000), ("M", 60_000),
+                              ("H", 3_600_000)):
+                v = int(ms) // div
+                if v < 10**8:
+                    value, out_unit = v, unit
+                    break
+            else:
+                value, out_unit = 10**8 - 1, "H"   # saturate: ~11kyr
+            md.append(("grpc-timeout", f"{value}{out_unit}"))
+        return md
+
     def acall(self, service: str, method: str, payload: bytes,
-              metadata: Optional[list[tuple[str, str]]] = None) -> Future:
-        return self._ensure().start_call(service, method, payload,
-                                         metadata or [])
+              metadata: Optional[list[tuple[str, str]]] = None,
+              timeout_ms: Optional[int] = None) -> Future:
+        return self._ensure().start_call(
+            service, method, payload,
+            self._with_deadline(metadata, timeout_ms))
 
     def call(self, service: str, method: str, payload: bytes,
              timeout_ms: Optional[int] = None,
              metadata: Optional[list[tuple[str, str]]] = None) -> bytes:
-        fut = self.acall(service, method, payload, metadata)
+        fut = self.acall(service, method, payload, metadata, timeout_ms)
         try:
             return fut.result((timeout_ms or self._timeout_ms) / 1e3)
         except TimeoutError:
@@ -677,8 +704,10 @@ class GrpcChannel:
         try:
             # the explicit marker (not frame counting) makes a 1- or
             # 0-message client stream deliver a LIST to the handler,
-            # indistinguishable from the N-message case
-            md = [("grpc-client-streaming", "1")] + (metadata or [])
+            # indistinguishable from the N-message case.  No auto
+            # grpc-timeout: request production time is unbounded (see
+            # _with_deadline).
+            md = [("grpc-client-streaming", "1")] + list(metadata or [])
             stream_id = conn._begin_call(service, method, None, md,
                                          conn._calls, fut)
             for msg in requests:
@@ -717,6 +746,8 @@ class GrpcChannel:
         channel timeout."""
         per_msg_s = (timeout_ms or self._timeout_ms) / 1e3
         conn = self._ensure()
+        # no auto grpc-timeout: the channel timeout is PER MESSAGE here,
+        # not a whole-stream deadline (see _with_deadline)
         sink, stream_id = conn.start_stream_call(service, method, payload,
                                                  metadata or [])
         finished = False

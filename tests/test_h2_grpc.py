@@ -565,3 +565,71 @@ def test_grpc_client_streaming_single_and_empty():
     finally:
         srv.stop()
         srv.join()
+
+
+def test_grpc_timeout_header_auto_propagated(grpc_server):
+    """The client stamps grpc-timeout from its timeout so the server can
+    stop working on abandoned calls (deadline propagation)."""
+    ch = GrpcChannel(f"127.0.0.1:{grpc_server.port}", timeout_ms=1234)
+    conn = ch._ensure()
+    seen = []
+    orig = conn.send_headers
+
+    def spy(sid, headers, **kw):
+        seen.append(list(headers))
+        return orig(sid, headers, **kw)
+
+    conn.send_headers = spy
+    try:
+        assert ch.call("test.GrpcEcho", "Echo", b"x") == b"x"
+        req_headers = seen[0]
+        assert ("grpc-timeout", "1234m") in req_headers
+        # explicit caller metadata wins
+        seen.clear()
+        ch.call("test.GrpcEcho", "Echo", b"x",
+                metadata=[("grpc-timeout", "9S")])
+        assert ("grpc-timeout", "9S") in seen[0]
+        assert ("grpc-timeout", "1234m") not in seen[0]
+    finally:
+        conn.send_headers = orig
+        ch.close()
+
+
+def test_grpc_server_enforces_propagated_deadline():
+    """A handler that outlives the propagated deadline gets its response
+    discarded server-side (DEADLINE_EXCEEDED), even when the client would
+    still be waiting."""
+    srv = brpc.Server()
+
+    class Slowpoke(brpc.Service):
+        NAME = "test.Slowpoke"
+
+        @brpc.method(request="raw", response="raw")
+        def Nap(self, cntl, req):
+            time.sleep(0.4)
+            return b"done"
+
+    srv.add_service(Slowpoke())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=30000)
+        with pytest.raises(errors.RpcError) as ei:
+            ch.call("test.Slowpoke", "Nap", b"",
+                    metadata=[("grpc-timeout", "100m")])
+        assert "deadline" in str(ei.value).lower()
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_grpc_timeout_unit_promotion():
+    """TimeoutValue is at most 8 digits: huge timeouts promote the unit
+    instead of emitting a malformed header."""
+    ch = GrpcChannel("127.0.0.1:1", timeout_ms=10**9)  # never connects
+    md = ch._with_deadline(None, None)
+    (k, v), = [kv for kv in md if kv[0] == "grpc-timeout"]
+    assert v == "1000000S"
+    assert len(v[:-1]) <= 8
+    md2 = ch._with_deadline(None, 500)
+    assert ("grpc-timeout", "500m") in md2
