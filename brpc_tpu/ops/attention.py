@@ -29,6 +29,24 @@ import jax.numpy as jnp
 from jax import lax
 
 
+# ---- GQA (grouped-query) broadcast ----------------------------------------
+
+def _expand_kv(q, k, v):
+    """Grouped-query attention: when K/V carry fewer heads than Q
+    (n_kv_heads divides n_heads — LLaMA/Mistral-style GQA, MQA at
+    n_kv_heads=1), repeat each K/V head across its query-head group.
+    XLA lowers the repeat to a broadcast that fuses into the einsum, so
+    the expanded tensors are a view of the computation, not 8x HBM."""
+    h_q, h_kv = q.shape[2], k.shape[2]
+    if h_kv == h_q:
+        return k, v
+    if h_q % h_kv:
+        raise ValueError(
+            f"n_heads ({h_q}) must be a multiple of n_kv_heads ({h_kv})")
+    g = h_q // h_kv
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 # ---- local (single-chip) reference ----------------------------------------
 
 def local_attention(q, k, v, causal: bool = False, q_offset: int = 0,
@@ -36,7 +54,9 @@ def local_attention(q, k, v, causal: bool = False, q_offset: int = 0,
     """Plain softmax(QK^T/sqrt(d))V on one chip.  Offsets give the global
     sequence positions of the q and k/v blocks for causal masking; rows
     whose mask hides every key yield zeros (not NaN) so blockwise callers
-    can fold partial blocks safely."""
+    can fold partial blocks safely.  Supports GQA/MQA (fewer K/V heads
+    than Q heads)."""
+    k, v = _expand_kv(q, k, v)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = 1.0 / math.sqrt(d)
@@ -93,9 +113,12 @@ def flash_attention(q, k, v, blk_q: int = 256, blk_k: int = 256,
                     interpret: Optional[bool] = None):
     """Blockwise (flash) attention as a Pallas TPU kernel; non-causal.
     Falls back to interpret mode off-TPU so the same code path tests on
-    the virtual CPU mesh.  Shapes [B, S, H, D] -> [B, S, H, D]."""
+    the virtual CPU mesh.  Shapes [B, S, H, D] -> [B, S, H, D].
+    GQA/MQA K/V are expanded up front (the kernel's grid is per
+    query-head)."""
     from jax.experimental import pallas as pl
 
+    k, v = _expand_kv(q, k, v)
     b, s, h, d = q.shape
     blk_q = min(blk_q, s)
     blk_k = min(blk_k, s)
@@ -132,7 +155,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     the shards around the ring while an online-softmax accumulator folds
     each block in.  Memory per chip stays O(S/n); the full S x S score
     matrix never materializes anywhere.  Must be called inside shard_map
-    with q/k/v sequence-sharded on `axis_name`.
+    with q/k/v sequence-sharded on `axis_name`.  Supports GQA/MQA: K/V
+    with fewer heads are expanded AFTER each ring hop, so the ring moves
+    the small grouped shards (g-times less ICI traffic than expanded).
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
@@ -146,7 +171,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     def step(i, carry):
         o, m, l, k_blk, v_blk = carry
         src = (my - i) % n                   # whose shard we now hold
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32),
+        # expand grouped K/V heads AFTER the hop (ICI carries the small
+        # tensors; the broadcast fuses into the einsum)
+        ke, ve = _expand_kv(qf, k_blk, v_blk)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, ke.astype(jnp.float32),
                        preferred_element_type=jnp.float32) * scale
         if causal:
             kpos = src * sq + jnp.arange(k_blk.shape[1])
@@ -161,7 +189,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
         p = jnp.where(jnp.isneginf(s), 0.0, p)
         l_new = l * alpha + p.sum(axis=-1)
         pv = jnp.einsum("bhqk,bkhd->bqhd", p,
-                        v_blk.astype(jnp.float32),
+                        ve.astype(jnp.float32),
                         preferred_element_type=jnp.float32)
         o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
@@ -190,7 +218,11 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
     sequence to heads, each chip runs FULL-sequence attention for its head
     group, and a second all_to_all swaps back.  Heads must divide the axis
     size.  Exact; two collectives instead of n-1 ring hops — better when
-    heads >= chips and the fabric favors all_to_all."""
+    heads >= chips and the fabric favors all_to_all.  GQA/MQA K/V are
+    expanded BEFORE the reshard (the head-split needs n to divide the
+    head count; grouped counts usually don't — ring_attention keeps the
+    traffic saving when that matters)."""
+    k, v = _expand_kv(q, k, v)
     n = lax.psum(1, axis_name)
     b, sq, h, d = q.shape
 

@@ -663,10 +663,10 @@ class GrpcChannel:
         if ms and ms > 0 and not any(k == "grpc-timeout" for k, _ in md):
             # TimeoutValue is at most 8 digits: promote the unit until
             # the number fits (m -> S -> M -> H)
-            value = int(ms)
+            ms_i = int(ms)
             for unit, div in (("m", 1), ("S", 1000), ("M", 60_000),
                               ("H", 3_600_000)):
-                v = int(ms) // div
+                v = ms_i // div
                 if v < 10**8:
                     value, out_unit = v, unit
                     break

@@ -127,3 +127,52 @@ def test_sequence_parallel_exact_across_mesh_sizes(n, fn):
               jax.device_put(v, sh))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=3e-5, atol=3e-5)
+
+
+# ---- grouped-query attention (GQA / MQA) ----
+
+class TestGQA:
+    def _qkv(self, h_q, h_kv, b=2, s=32, d=16, seed=3):
+        key = jax.random.PRNGKey(seed)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, s, h_q, d), jnp.float32) * 0.3
+        k = jax.random.normal(kk, (b, s, h_kv, d), jnp.float32) * 0.3
+        v = jax.random.normal(kv, (b, s, h_kv, d), jnp.float32) * 0.3
+        return q, k, v
+
+    @pytest.mark.parametrize("h_q,h_kv", [(8, 2), (8, 1), (4, 4)])
+    def test_local_gqa_matches_expanded(self, h_q, h_kv):
+        q, k, v = self._qkv(h_q, h_kv)
+        out = local_attention(q, k, v, causal=True)
+        ke = jnp.repeat(k, h_q // h_kv, axis=2)
+        ve = jnp.repeat(v, h_q // h_kv, axis=2)
+        ref = local_attention(q, ke, ve, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_bad_head_ratio_rejected(self):
+        q, k, v = self._qkv(6, 4)
+        with pytest.raises(ValueError):
+            local_attention(q, k, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_gqa_exact(self, causal):
+        q, k, v = self._qkv(8, 2, s=8 * N)
+        out = _run_sharded(ring_attention, q, k, v, causal=causal)
+        ref = local_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_ulysses_gqa_exact(self):
+        q, k, v = self._qkv(8, 2, s=8 * N)
+        out = _run_sharded(ulysses_attention, q, k, v)
+        ref = local_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+
+    def test_flash_gqa_matches_expanded(self):
+        q, k, v = self._qkv(8, 2, b=1, s=64, d=16)
+        out = flash_attention(q, k, v, blk_q=32, blk_k=32)
+        ref = local_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
