@@ -114,6 +114,30 @@ def grpc_frame(payload: bytes, compressed: bool = False) -> bytes:
         + payload
 
 
+def pop_grpc_frames(data: bytearray) -> tuple[list[bytes], Optional[str]]:
+    """Pop every COMPLETE length-prefixed message off the front of a
+    stream buffer (in place).  Returns (messages, error): error is set on
+    a bad/compressed flag byte — ONE implementation for the client sink
+    drain and the server bidi feed."""
+    msgs: list[bytes] = []
+    off = 0
+    while len(data) - off >= 5:
+        flag = data[off]
+        (ln,) = struct.unpack_from(">I", data, off + 1)
+        if flag != 0:
+            if off:
+                del data[:off]
+            return msgs, ("compressed grpc message" if flag == 1
+                          else "bad grpc frame flag")
+        if len(data) - off - 5 < ln:
+            break
+        msgs.append(bytes(data[off + 5:off + 5 + ln]))
+        off += 5 + ln
+    if off:
+        del data[:off]
+    return msgs, None
+
+
 def parse_grpc_frames(data: bytes) -> list[bytes]:
     out = []
     pos = 0
@@ -399,6 +423,10 @@ class H2Connection:
             st.trailers = headers
         else:
             st.headers = headers
+            if not st.ended:
+                # headers done, request body still open: bidi consumers
+                # dispatch HERE instead of waiting for END_STREAM
+                self.on_stream_headers(st)
         if st.ended:
             self._complete(st)
 
@@ -438,6 +466,11 @@ class H2Connection:
         self.on_stream_complete(st)
 
     # ---- overridables ----
+
+    def on_stream_headers(self, st: _StreamState) -> None:
+        """Called when the request HEADERS block completes on a stream
+        whose body is still open (no-op by default; bidi consumers
+        dispatch here)."""
 
     def on_stream_data(self, st: _StreamState) -> None:
         """Called as DATA accumulates on a still-open stream (no-op by
@@ -496,11 +529,149 @@ class GrpcServerConnection(H2Connection):
     def __init__(self, sock_id: int, server):
         super().__init__(sock_id, is_server=True)
         self._server = server
+        # bidi request queues: stream id -> queue fed by on_stream_data
+        self._bidi_rx: dict[int, "queue.Queue"] = {}
+        self._bidi_lock = threading.Lock()
         self.send_preface_and_settings()
 
+    # ---- BIDI: dispatch at headers, feed request frames as they arrive --
+
+    def on_stream_headers(self, st: _StreamState) -> None:
+        h = dict(st.headers)
+        if h.get("grpc-bidi") != "1":
+            return                      # unary/client-stream: wait for end
+        rx: "queue.Queue" = queue.Queue()
+        with self._bidi_lock:
+            self._bidi_rx[st.id] = rx
+        # dedicated thread: a bidi handler legitimately blocks waiting
+        # for its peer's next message — that must not park one of the
+        # bounded shared grpc workers for the call's lifetime
+        threading.Thread(target=self._process_bidi, args=(st, rx),
+                         daemon=True,
+                         name=f"grpc-bidi-rx-{st.id}").start()
+
+    def on_stream_data(self, st: _StreamState) -> None:
+        with self._bidi_lock:
+            rx = self._bidi_rx.get(st.id)
+        if rx is None:
+            return
+        msgs, err = pop_grpc_frames(st.data)
+        for m in msgs:
+            rx.put(m)
+        if err is not None:
+            # framing is unrecoverable: error the handler ONCE, stop
+            # feeding (pop the entry so later DATA can't re-queue), drop
+            # the garbage, and RST so the peer stops sending
+            rx.put(errors.RpcError(errors.EREQUEST, err))
+            with self._bidi_lock:
+                self._bidi_rx.pop(st.id, None)
+            del st.data[:]
+            self.send_rst(st.id, 0x1)    # PROTOCOL_ERROR
+
     def on_stream_complete(self, st: _StreamState) -> None:
+        with self._bidi_lock:
+            rx = self._bidi_rx.get(st.id)
+        if rx is not None:
+            self.on_stream_data(st)     # tail frames
+            rx.put(_STREAM_END)         # half-close: request side done
+            with self._bidi_lock:       # feeding is over; drop the entry
+                self._bidi_rx.pop(st.id, None)
+            return                      # handler already running
         # runs on the dispatcher thread: only parse + hand off
         _grpc_executor().submit(self._process, st)
+
+    def on_stream_reset(self, stream_id: int, code: int) -> None:
+        with self._bidi_lock:
+            rx = self._bidi_rx.pop(stream_id, None)
+        if rx is not None:
+            rx.put(errors.RpcError(errors.ECANCELED,
+                                   f"stream reset (h2 error {code})"))
+
+    def abort_bidi(self) -> None:
+        """Connection died: unblock every parked bidi handler — a
+        request_iter waiting in rx.get() would otherwise hang forever,
+        leaking the inflight slot and wedging graceful join()."""
+        with self._bidi_lock:
+            queues, self._bidi_rx = dict(self._bidi_rx), {}
+        for rx in queues.values():
+            rx.put(errors.RpcError(errors.ECANCELED,
+                                   "h2 connection lost"))
+
+    def _process_bidi(self, st: _StreamState, rx: "queue.Queue") -> None:
+        """BIDI: the handler runs while the request side is still open,
+        consuming an iterator of request messages and returning an
+        iterator of responses; transmission rides the same dedicated
+        thread as server-streaming."""
+        resp = None
+        handed_off = False
+        try:
+            h = dict(st.headers)
+            parts = h.get(":path", "").strip("/").split("/")
+            if len(parts) != 2:
+                self._respond_error(st.id, GRPC_UNIMPLEMENTED, "bad path")
+                return
+
+            # honor a client-supplied deadline for the whole call: the
+            # request iterator stops waiting once it passes
+            timeout_s = parse_grpc_timeout(h.get("grpc-timeout"))
+            deadline = (time.monotonic() + timeout_s) if timeout_s else None
+
+            def request_iter():
+                while True:
+                    if deadline is None:
+                        item = rx.get()
+                    else:
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            raise errors.RpcError(
+                                errors.ERPCTIMEDOUT,
+                                "bidi deadline exceeded on server")
+                        try:
+                            item = rx.get(timeout=left)
+                        except queue.Empty:
+                            raise errors.RpcError(
+                                errors.ERPCTIMEDOUT,
+                                "bidi deadline exceeded on server")
+                    if item is _STREAM_END:
+                        return
+                    if isinstance(item, Exception):
+                        raise item
+                    yield item
+
+            resp, code, text = self._server.invoke_grpc(
+                parts[0], parts[1], b"", h, peer_sid=self.sid,
+                payload_iter=request_iter())
+            if code != 0:
+                self._respond_error(st.id, err_to_grpc(code), text)
+                return
+            self.send_headers(st.id, [(":status", "200"),
+                                      ("content-type", "application/grpc")])
+            if isinstance(resp, (bytes, bytearray, memoryview)):
+                self.send_data(st.id, grpc_frame(bytes(resp)),
+                               end_stream=False)
+                self.send_headers(st.id, [("grpc-status", "0")],
+                                  end_stream=True)
+            else:
+                body, resp = resp, None
+                handed_off = True
+                threading.Thread(target=self._transmit_stream,
+                                 args=(st, body), daemon=True,
+                                 name=f"grpc-bidi-tx-{st.id}").start()
+        except errors.RpcError:
+            pass
+        except Exception:  # pragma: no cover - handler bug guard
+            import traceback
+            traceback.print_exc()
+        finally:
+            if not handed_off:
+                with self._bidi_lock:
+                    self._bidi_rx.pop(st.id, None)
+                if hasattr(resp, "close"):
+                    try:
+                        resp.close()
+                    except Exception:
+                        pass
+                self.close_stream(st.id)
 
     def _process(self, st: _StreamState) -> None:
         resp = None
@@ -736,6 +907,21 @@ class GrpcChannel:
             raise errors.RpcError(errors.ERPCTIMEDOUT,
                                   "grpc client-stream call timed out")
 
+    def call_bidi(self, service: str, method: str,
+                  timeout_ms: Optional[int] = None,
+                  metadata: Optional[list[tuple[str, str]]] = None
+                  ) -> "GrpcBidiCall":
+        """INTERLEAVED BIDI call: returns a handle with send() /
+        done_writing() for the request side and iterator semantics for
+        the response side — both directions live on one open h2 stream,
+        so a conversational handler can answer each message as it
+        arrives."""
+        conn = self._ensure()
+        md = [("grpc-bidi", "1")] + list(metadata or [])
+        sink, stream_id = conn.start_stream_call(service, method, None, md)
+        return GrpcBidiCall(conn, stream_id, sink,
+                            (timeout_ms or self._timeout_ms) / 1e3)
+
     def call_stream(self, service: str, method: str, payload: bytes,
                     timeout_ms: Optional[int] = None,
                     metadata: Optional[list[tuple[str, str]]] = None):
@@ -776,6 +962,66 @@ class GrpcChannel:
             if self._conn is not None:
                 self._conn.close()
                 self._conn = None
+
+
+class GrpcBidiCall:
+    """Client handle for one interleaved bidi stream: send() request
+    messages (done_writing() half-closes), iterate responses as their
+    frames arrive.  Abandoning the iterator cancels the stream."""
+
+    def __init__(self, conn: "_GrpcClientConnection", stream_id: int,
+                 sink: "queue.Queue", per_msg_timeout_s: float):
+        self._conn = conn
+        self._sid = stream_id
+        self._sink = sink
+        self._timeout_s = per_msg_timeout_s
+        self._write_closed = False
+        self._finished = False
+
+    def send(self, msg: bytes) -> None:
+        if self._write_closed:
+            raise errors.RpcError(errors.EREQUEST,
+                                  "bidi request side already closed")
+        self._conn.send_data(self._sid, grpc_frame(bytes(msg)),
+                             end_stream=False)
+
+    def done_writing(self) -> None:
+        if not self._write_closed:
+            self._write_closed = True
+            self._conn.send_data(self._sid, b"", end_stream=True)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        try:
+            item = self._sink.get(timeout=self._timeout_s)
+        except queue.Empty:
+            self.cancel()
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  "bidi response message timed out")
+        if item is _STREAM_END:
+            self._finished = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._finished = True
+            raise item
+        return item
+
+    def cancel(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self._conn.cancel_stream_call(self._sid)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if not self._finished:
+            # drained or abandoned: make sure the stream dies either way
+            self.cancel()
 
 
 class _GrpcClientConnection(H2Connection):
@@ -900,25 +1146,12 @@ class _GrpcClientConnection(H2Connection):
         """Pop complete length-prefixed messages off the stream buffer
         into the sink.  Returns False on a framing error (sink fed the
         exception)."""
-        data = st.data
-        off = 0
-        while len(data) - off >= 5:
-            compressed = data[off]
-            (ln,) = struct.unpack_from(">I", data, off + 1)
-            if compressed not in (0, 1):
-                sink.put(errors.RpcError(errors.ERESPONSE,
-                                         "bad grpc frame flag"))
-                return False
-            if len(data) - off - 5 < ln:
-                break
-            if compressed:
-                sink.put(errors.RpcError(
-                    errors.ERESPONSE, "compressed grpc message"))
-                return False
-            sink.put(bytes(data[off + 5:off + 5 + ln]))
-            off += 5 + ln
-        if off:
-            del data[:off]
+        msgs, err = pop_grpc_frames(st.data)
+        for m in msgs:
+            sink.put(m)
+        if err is not None:
+            sink.put(errors.RpcError(errors.ERESPONSE, err))
+            return False
         return True
 
     def on_stream_data(self, st: _StreamState) -> None:

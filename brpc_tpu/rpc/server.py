@@ -451,7 +451,11 @@ class Server:
     def _on_conn_failed(self, sid: int, err: int) -> None:
         with self._conn_mu:
             self._connections.discard(sid)
-        self._h2_conns.pop(sid, None)
+        conn = self._h2_conns.pop(sid, None)
+        if conn is not None:
+            # unblock bidi handlers parked on this connection's request
+            # queues, or they leak their inflight slots forever
+            conn.abort_bidi()
 
     def _track_conn(self, sid: int) -> None:
         with self._conn_mu:
@@ -973,10 +977,14 @@ class Server:
 
     def invoke_grpc(self, service: str, method_name: str, payload: bytes,
                     headers: dict[str, str],
-                    peer_sid: Optional[int] = None) -> tuple[bytes, int, str]:
-        """Dispatch one unary gRPC request through the SAME gates as native
+                    peer_sid: Optional[int] = None,
+                    payload_iter=None) -> tuple[bytes, int, str]:
+        """Dispatch one gRPC request through the SAME gates as native
         traffic.  Returns (response_payload, error_code, error_text); the
-        h2 connection maps error_code to a grpc-status trailer."""
+        h2 connection maps error_code to a grpc-status trailer.
+        payload_iter (BIDI): a live iterator of raw request messages —
+        the handler receives a lazily-decoding iterator and may consume
+        it while producing responses."""
         if self._stopping:
             return b"", errors.ELOGOFF, "server stopping"
         reg_name = service
@@ -1052,7 +1060,12 @@ class Server:
 
         cntl = None
         try:
-            if isinstance(payload, list):
+            if payload_iter is not None:
+                # BIDI: decode lazily as the handler pulls
+                req_ser = spec.request_serializer
+                request = (req_ser.decode(p, "") for p in payload_iter)
+                span.request_size = 0
+            elif isinstance(payload, list):
                 # CLIENT-STREAMING: one decoded message per request
                 # frame; the handler receives the list
                 request = [spec.request_serializer.decode(p, "")
@@ -1119,8 +1132,14 @@ class Server:
                         cn.session_data = None
                     _finish(code)
 
+                # BIDI handlers legitimately block awaiting the peer's
+                # next message; pulling their items through the bounded
+                # tag pool would park a pool worker for the call's
+                # lifetime — the per-call dedicated thread is their
+                # isolation instead
                 resp = _StreamBody(result, spec.response_serializer,
-                                   pool, _cleanup)
+                                   None if payload_iter is not None
+                                   else pool, _cleanup)
             else:
                 resp, _ = spec.response_serializer.encode(result)
                 span.response_size = len(resp)
