@@ -1,0 +1,137 @@
+"""Hostile/broken-peer behavior on the native socket core (the
+brpc_socket_unittest role, SURVEY.md §4): oversized declared frames,
+byte-at-a-time trickle, mid-frame disconnects, connect floods — the
+server must shed the peer, never the process, and keep serving."""
+import socket as pysock
+import struct
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+
+
+class Echo(brpc.Service):
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return req
+
+
+@pytest.fixture
+def server():
+    srv = brpc.Server()
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    yield srv
+    srv.stop()
+    srv.join()
+
+
+def _healthy(port) -> bool:
+    ch = brpc.Channel(f"127.0.0.1:{port}", timeout_ms=3000)
+    return ch.call_sync("Echo", "Echo", b"ping", serializer="raw") == b"ping"
+
+
+def _trpc_header(meta_size: int, body_size: int) -> bytes:
+    return b"TRPC" + struct.pack(">I", meta_size) + \
+        struct.pack(">Q", body_size)
+
+
+class TestHostilePeers:
+    def test_huge_declared_body_rejected(self, server):
+        """A frame header claiming a multi-GB body must not allocate it;
+        the peer gets closed, the server keeps serving."""
+        s = pysock.create_connection(("127.0.0.1", server.port), timeout=5)
+        s.sendall(_trpc_header(16, 16 << 30))     # claims 16GB
+        s.settimeout(5)
+        try:
+            closed = s.recv(1) == b""             # EOF = closed
+        except ConnectionResetError:
+            closed = True
+        except pysock.timeout:
+            closed = False                        # still open: the bug
+        s.close()
+        assert closed, "oversized frame left the connection open"
+        assert _healthy(server.port)
+
+    def test_garbage_preamble_closed(self, server):
+        s = pysock.create_connection(("127.0.0.1", server.port), timeout=5)
+        s.sendall(b"\x00\xff\x13\x37" * 8)
+        s.settimeout(5)
+        try:
+            closed = s.recv(1) == b""             # EOF = closed
+        except ConnectionResetError:
+            closed = True
+        except pysock.timeout:
+            closed = False                        # still open: the bug
+        s.close()
+        assert closed, "garbage preamble left the connection open"
+        assert _healthy(server.port)
+
+    def test_midframe_disconnect_cleans_up(self, server):
+        for _ in range(20):
+            s = pysock.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            s.sendall(_trpc_header(64, 4096)[:10])  # partial header
+            s.close()                               # vanish mid-frame
+        time.sleep(0.2)
+        assert _healthy(server.port)
+
+    def test_trickled_valid_frame_still_parses(self, server):
+        """Slow but legal: a COMPLETE valid request arrives one byte at
+        a time; the reassembly path must dispatch it (a TRPC response
+        comes back on the same socket), while a normal client is served
+        concurrently."""
+        from brpc_tpu.rpc import meta as M
+        ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=8000)
+        meta = M.RpcMeta(msg_type=M.MSG_REQUEST, correlation_id=77,
+                         service="Echo", method="Echo",
+                         content_type="raw").encode()
+        # header: meta_size + body_size where body EXCLUDES the meta
+        frame = _trpc_header(len(meta), 5) + meta + b"hello"
+        result = {}
+
+        def slow_valid():
+            s = pysock.create_connection(("127.0.0.1", server.port),
+                                         timeout=15)
+            for b in frame:
+                s.sendall(bytes([b]))
+                time.sleep(0.002)
+            s.settimeout(10)
+            hdr = b""
+            while len(hdr) < 16:
+                chunk = s.recv(16 - len(hdr))
+                if not chunk:
+                    break
+                hdr += chunk
+            result["hdr"] = hdr
+            s.close()
+
+        t = threading.Thread(target=slow_valid)
+        t.start()
+        for i in range(10):
+            assert ch.call_sync("Echo", "Echo", b"x%d" % i,
+                                serializer="raw") == b"x%d" % i
+        t.join(20)
+        assert result.get("hdr", b"")[:4] == b"TRPC", \
+            "trickled frame was never dispatched"
+
+    def test_connect_close_flood(self, server):
+        for _ in range(200):
+            s = pysock.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            s.close()
+        assert _healthy(server.port)
+
+    def test_many_concurrent_half_open(self, server):
+        socks = [pysock.create_connection(("127.0.0.1", server.port),
+                                          timeout=5) for _ in range(64)]
+        try:
+            for s in socks:
+                s.sendall(b"TR")          # two bytes of magic, forever
+            assert _healthy(server.port)
+        finally:
+            for s in socks:
+                s.close()
+        assert _healthy(server.port)
