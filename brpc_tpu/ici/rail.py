@@ -42,8 +42,9 @@ _ticket_counter = itertools.count(1)
 _CHUNK = BLOCK_CLASSES[-1]
 
 # user-field keys riding the TRPC meta (control plane only)
-F_TICKET = "icit"     # payload ticket to claim
-F_SRC_DEV = "icisrc"  # requester's device id — where the response should land
+# canonical definitions live with the wire format (rpc/meta.py); aliased
+# here so rail code reads naturally
+from brpc_tpu.rpc.meta import F_SRC_DEV, F_TICKET  # noqa: E402,F401
 
 # ---------------------------------------------------------------------------
 # rail map: which endpoints are ICI-reachable

@@ -328,6 +328,11 @@ class CallManager:
             # wins — that's what makes backup requests useful.
             if meta.attempt < cntl.current_attempt:
                 return
+            if meta.user_fields:
+                # fields attached to FAILED completions surface too (the
+                # reference packs response user fields on errors as well)
+                cntl.response_user_fields = \
+                    M.strip_reserved_user_fields(meta.user_fields)
             cntl.set_failed(meta.error_code, meta.error_text)
             if st.channel._should_retry(st):
                 return  # re-issued under the same cid, next attempt
@@ -364,6 +369,10 @@ class CallManager:
                 get_serializer(meta.content_type or "raw")
             cntl.reset_for_retry()
             cntl.response = serializer.decode(payload, meta.tensor_header)
+            if meta.user_fields:
+                # surface server-set user fields, minus transport keys
+                cntl.response_user_fields = \
+                    M.strip_reserved_user_fields(meta.user_fields)
             if meta.stream_id and cntl._stream is not None:
                 sbuf = meta.user_fields.get("sbuf")
                 if sbuf:
@@ -586,26 +595,12 @@ class Channel:
         if cntl.user_fields:
             # caller-supplied opaque metadata (request_user_fields slot);
             # copied so a reused Controller can't mutate an issued frame.
-            # bytes pass through untouched (str(b"..") would send the
-            # repr); internal transport keys are reserved — a spoofed
-            # "icit" would make the server claim a rail ticket instead of
-            # decoding the body
-            from brpc_tpu.ici import rail
-            reserved = {rail.F_TICKET, rail.F_SRC_DEV, "sbuf"}
-            for k, v in cntl.user_fields.items():
-                # keys must be clean strings: bytes would be sent as
-                # their repr, and a NUL corrupts the key\0value TLV
-                # framing on decode
-                if not isinstance(k, str) or "\x00" in k:
-                    raise ValueError(
-                        f"user_fields key {k!r} must be a str without "
-                        f"NUL bytes")
-                if k in reserved:
-                    raise ValueError(
-                        f"user_fields key {k!r} is reserved by the "
-                        f"transport")
-                meta.user_fields[k] = \
-                    v if isinstance(v, (bytes, bytearray)) else str(v)
+            # ONE shared validation (meta.normalize_user_fields): clean
+            # str keys, reserved transport keys rejected — a spoofed
+            # rail ticket would make the server claim device blocks
+            # instead of decoding the body
+            meta.user_fields.update(
+                M.normalize_user_fields(cntl.user_fields))
         # the client-side response serializer: typed instances (e.g. a
         # PbSerializer bound to a generated message class) must decode the
         # response locally — the wire's content_type can only name the

@@ -36,6 +36,10 @@ class Controller:
         # user_fields); server handlers read cntl.request_meta.user_fields
         # — VALUES arrive there as bytes (wire convention, meta.py decode)
         self.user_fields: dict = {}
+        # the response direction (Controller::response_user_fields):
+        # server handlers SET this; the client reads it after completion
+        # (values arrive as bytes, internal transport keys stripped)
+        self.response_user_fields: dict = {}
 
         # ---- result state ----
         self.error_code: int = 0

@@ -50,6 +50,38 @@ COMPRESS_ZLIB = 2
 COMPRESS_SNAPPY = 3  # native block-format codec (src/cc/butil/snappy.cc)
 COMPRESS_ZSTD = 4
 
+# Transport-reserved user-field keys (the rail ticket/source and the
+# stream buffer exchange ride user_fields; caller-supplied fields must
+# never collide).  brpc_tpu.ici.rail aliases the first two.
+F_TICKET = "icit"
+F_SRC_DEV = "icisrc"
+F_SBUF = "sbuf"
+RESERVED_USER_FIELD_KEYS = frozenset({F_TICKET, F_SRC_DEV, F_SBUF})
+
+
+def normalize_user_fields(fields: dict) -> dict:
+    """ONE validation/normalization for caller-supplied user fields, both
+    directions: keys must be str without NULs (a NUL corrupts the
+    key\\0value TLV framing; bytes keys would be sent as reprs) and must
+    not be transport-reserved; bytes values pass through, everything else
+    is str()ed."""
+    out = {}
+    for k, v in (fields or {}).items():
+        if not isinstance(k, str) or "\x00" in k:
+            raise ValueError(
+                f"user_fields key {k!r} must be a str without NUL bytes")
+        if k in RESERVED_USER_FIELD_KEYS:
+            raise ValueError(
+                f"user_fields key {k!r} is reserved by the transport")
+        out[k] = v if isinstance(v, (bytes, bytearray)) else str(v)
+    return out
+
+
+def strip_reserved_user_fields(fields: dict) -> dict:
+    """Drop transport keys before surfacing received fields to callers."""
+    return {k: v for k, v in (fields or {}).items()
+            if k not in RESERVED_USER_FIELD_KEYS}
+
 
 @dataclass
 class RpcMeta:

@@ -774,7 +774,22 @@ class Server:
                 raise exc
             if cntl.failed():
                 error_code = cntl.error_code
-                self._respond_error(sid, meta, cntl.error_code, cntl.error_text)
+                if cntl.response_user_fields:
+                    # fields ride FAILED completions too (the reference
+                    # packs user fields on error responses): rich meta
+                    # instead of the minimal native error pack
+                    err = M.RpcMeta(msg_type=M.MSG_RESPONSE,
+                                    correlation_id=meta.correlation_id,
+                                    attempt=meta.attempt,
+                                    error_code=cntl.error_code,
+                                    error_text=cntl.error_text or
+                                    errors.describe(cntl.error_code))
+                    err.user_fields.update(M.normalize_user_fields(
+                        cntl.response_user_fields))
+                    Transport.instance().write_frame(sid, err.encode(), b"")
+                else:
+                    self._respond_error(sid, meta, cntl.error_code,
+                                        cntl.error_text)
             elif rail_src is not None and self._ship_rail_response(
                     sid, meta, span, cntl, response, rail_src):
                 pass  # response rode ICI; control frame already written
@@ -784,7 +799,8 @@ class Server:
                 rbody = compress(rbody, meta.compress_type)
                 if (cntl._stream is None and not cntl.response_attachment
                         and not theader and not meta.compress_type
-                        and not span.trace_id):
+                        and not span.trace_id
+                        and not cntl.response_user_fields):
                     # plain response: cid/attempt/content_type only — pack
                     # the meta and frame natively (PackResponseFrame)
                     span.response_size = len(rbody)
@@ -809,6 +825,11 @@ class Server:
                                      tensor_header=theader,
                                      trace_id=span.trace_id,
                                      span_id=span.span_id)
+                    if cntl.response_user_fields:
+                        # same contract as the request side — ONE shared
+                        # validation (meta.normalize_user_fields)
+                        resp.user_fields.update(M.normalize_user_fields(
+                            cntl.response_user_fields))
                     if cntl._stream is not None:
                         # tell the client our local stream id + window size
                         # (StreamSettings exchange in the reference)
@@ -856,9 +877,12 @@ class Server:
         False (caller host-serializes) when the response isn't device
         arrays, the transfer fails, or the response needs frame features
         the rail's control-only frame doesn't carry (stream settings,
-        attachment bytes)."""
+        attachment bytes, user fields)."""
         from brpc_tpu.ici import rail
-        if cntl._stream is not None or cntl.response_attachment:
+        if cntl._stream is not None or cntl.response_attachment \
+                or cntl.response_user_fields:
+            # user fields would be silently lost on the control-only
+            # frame; the host path carries them
             return False
         if not rail.railable(response):
             return False

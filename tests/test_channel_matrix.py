@@ -225,3 +225,93 @@ class TestAttachmentAndMeta:
         finally:
             srv.stop()
             srv.join()
+
+
+class TestResponseUserFields:
+    def test_round_trip(self):
+        srv = brpc.Server()
+
+        class Tagger(brpc.Service):
+            NAME = "Tagger"
+
+            @brpc.method(request="json", response="json")
+            def Get(self, cntl, req):
+                cntl.response_user_fields["served-by"] = "replica-3"
+                cntl.response_user_fields["blob"] = b"\x01\x02"
+                return {}
+
+        srv.add_service(Tagger())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            cntl = brpc.Controller()
+            ch.call_sync("Tagger", "Get", {}, serializer="json", cntl=cntl)
+            assert cntl.response_user_fields["served-by"] == b"replica-3"
+            assert cntl.response_user_fields["blob"] == b"\x01\x02"
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_reserved_key_is_a_handler_error(self):
+        srv = brpc.Server()
+
+        class Bad(brpc.Service):
+            NAME = "BadTag"
+
+            @brpc.method(request="json", response="json")
+            def Get(self, cntl, req):
+                cntl.response_user_fields["icit"] = "spoof"
+                return {}
+
+        srv.add_service(Bad())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            with pytest.raises(errors.RpcError) as ei:
+                ch.call_sync("BadTag", "Get", {}, serializer="json")
+            assert ei.value.code == errors.EINTERNAL
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_plain_responses_keep_the_native_fast_path(self):
+        """No user fields -> the response still packs natively (the
+        fast-path condition must not regress for the common case)."""
+        srv = brpc.Server()
+        srv.add_service(Echo())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            cntl = brpc.Controller()
+            assert ch.call_sync("Echo", "Echo", b"q", serializer="raw",
+                                cntl=cntl) == b"q"
+            assert cntl.response_user_fields == {}
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_fields_survive_failed_completion(self):
+        srv = brpc.Server()
+
+        class FailTag(brpc.Service):
+            NAME = "FailTag"
+
+            @brpc.method(request="json", response="json")
+            def Get(self, cntl, req):
+                cntl.response_user_fields["hint"] = "try-replica-2"
+                cntl.set_failed(1404, "not here")
+                return None
+
+        srv.add_service(FailTag())
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+            cntl = brpc.Controller()
+            with pytest.raises(errors.RpcError) as ei:
+                ch.call_sync("FailTag", "Get", {}, serializer="json",
+                             cntl=cntl)
+            assert ei.value.code == 1404
+            assert cntl.response_user_fields == {"hint": b"try-replica-2"}
+        finally:
+            srv.stop()
+            srv.join()
