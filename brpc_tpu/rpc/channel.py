@@ -316,9 +316,9 @@ class CallManager:
         if st is None:
             # stale attempt after completion — dropped; a rail ticket riding
             # it must be freed now, not left to the registry TTL
-            if meta.user_fields and meta.user_fields.get("icit"):
+            if meta.user_fields and meta.user_fields.get(M.F_TICKET):
                 from brpc_tpu.ici import rail
-                rail.withdraw(meta.user_fields["icit"])
+                rail.withdraw(meta.user_fields[M.F_TICKET])
             return
         cntl = st.cntl
         if meta.error_code != 0:
@@ -339,7 +339,8 @@ class CallManager:
             self._finish(st)
             return
         # success: decode body
-        rail_ticket = meta.user_fields.get("icit") if meta.user_fields else None
+        rail_ticket = meta.user_fields.get(M.F_TICKET) \
+            if meta.user_fields else None
         if rail_ticket is not None:
             # response payload rode ICI: claim the device arrays parked in
             # the rail registry — no body bytes exist to decode
@@ -374,7 +375,7 @@ class CallManager:
                 cntl.response_user_fields = \
                     M.strip_reserved_user_fields(meta.user_fields)
             if meta.stream_id and cntl._stream is not None:
-                sbuf = meta.user_fields.get("sbuf")
+                sbuf = meta.user_fields.get(M.F_SBUF)
                 if sbuf:
                     cntl._stream.peer_buf_size = int(sbuf)
                 cntl._stream.set_remote(meta.stream_id)
@@ -619,7 +620,7 @@ class Channel:
         stream = getattr(cntl, "_stream", None)
         if stream is not None:
             meta.stream_id = stream.stream_id
-            meta.user_fields["sbuf"] = str(stream.max_buf_size)
+            meta.user_fields[M.F_SBUF] = str(stream.max_buf_size)
 
         # rpcz span
         from brpc_tpu.rpcz import current_trace

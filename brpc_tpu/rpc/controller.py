@@ -38,7 +38,9 @@ class Controller:
         self.user_fields: dict = {}
         # the response direction (Controller::response_user_fields):
         # server handlers SET this; the client reads it after completion
-        # (values arrive as bytes, internal transport keys stripped)
+        # (values arrive as bytes, internal transport keys stripped).
+        # Carried on native TRPC responses — including failed ones; gRPC
+        # responses do not carry it (h2 trailers are status-only here)
         self.response_user_fields: dict = {}
 
         # ---- result state ----
@@ -66,6 +68,10 @@ class Controller:
         # ---- server-side state ----
         self.is_server_side = False
         self.request_meta: Optional[M.RpcMeta] = None
+        # gRPC only: the request's h2 headers/metadata (":path",
+        # "authorization", caller metadata...) — the reference exposes
+        # gRPC metadata to handlers the same way
+        self.request_headers: dict = {}
         self.peer_sid: int = 0
         # pooled per-request data (ServerOptions.session_data_factory)
         self.session_data = None
@@ -110,6 +116,9 @@ class Controller:
     def reset_for_retry(self) -> None:
         self.error_code = 0
         self.error_text = ""
+        # fields from a FAILED attempt must not leak into a later
+        # successful completion
+        self.response_user_fields = {}
 
     # ---- completion (exactly once) ----
 

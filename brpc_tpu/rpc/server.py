@@ -669,15 +669,16 @@ class Server:
         cntl.peer_sid = sid
         cntl.trace_id = span.trace_id
         cntl.span_id = span.span_id
-        rail_src = meta.user_fields.get("icisrc") if meta.user_fields else None
+        rail_src = meta.user_fields.get(M.F_SRC_DEV) \
+            if meta.user_fields else None
         # ---- decode phase ----
         try:
-            if meta.user_fields.get("icit"):
+            if meta.user_fields.get(M.F_TICKET):
                 # request payload rode ICI: claim the device arrays from the
                 # rail registry (ici/rail.py) — the frame carried only the
                 # ticket, no body bytes exist
                 from brpc_tpu.ici import rail
-                request = rail.claim(meta.user_fields["icit"])
+                request = rail.claim(meta.user_fields[M.F_TICKET])
                 span.request_size = 0
             else:
                 # fast-path bodies arrive as IOBuf-backed memoryviews
@@ -834,7 +835,7 @@ class Server:
                         # tell the client our local stream id + window size
                         # (StreamSettings exchange in the reference)
                         resp.stream_id = cntl._stream.stream_id
-                        resp.user_fields["sbuf"] = \
+                        resp.user_fields[M.F_SBUF] = \
                             str(cntl._stream.max_buf_size)
                     if cntl.response_attachment:
                         resp.attachment_size = len(cntl.response_attachment)
@@ -898,7 +899,7 @@ class Server:
                          content_type="tensor",
                          trace_id=span.trace_id,
                          span_id=span.span_id)
-        resp.user_fields["icit"] = ticket
+        resp.user_fields[M.F_TICKET] = ticket
         span.response_size = 0
         if Transport.instance().write_frame(sid, resp.encode(), b"") != 0:
             # peer gone: the ticket would leak until TTL — free it now
@@ -1101,6 +1102,7 @@ class Server:
             cntl = Controller()
             cntl.is_server_side = True
             cntl.request_meta = meta
+            cntl.request_headers = dict(headers)   # gRPC metadata surface
             cntl.peer_sid = peer_sid
             rpcz.set_current_span(span)
             if self._session_pool is not None:

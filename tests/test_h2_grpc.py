@@ -761,3 +761,33 @@ def test_grpc_bidi_conn_death_releases_inflight():
     srv.stop()
     srv.join()                          # must not hang on _inflight_zero
     assert time.monotonic() - t0 < 10
+
+
+def test_grpc_handler_sees_request_metadata():
+    """gRPC handlers read caller metadata (and :path etc.) off
+    cntl.request_headers — the reference's metadata surface."""
+    seen = {}
+    srv = brpc.Server()
+
+    class MetaSvc(brpc.Service):
+        NAME = "test.MetaSvc"
+
+        @brpc.method(request="raw", response="raw")
+        def Peek(self, cntl, req):
+            seen.update(cntl.request_headers)
+            return b"ok"
+
+    srv.add_service(MetaSvc())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}")
+        assert ch.call("test.MetaSvc", "Peek", b"",
+                       metadata=[("x-request-id", "abc-123"),
+                                 ("x-shard", "7")]) == b"ok"
+        assert seen.get("x-request-id") == "abc-123"
+        assert seen.get("x-shard") == "7"
+        assert seen.get(":path") == "/test.MetaSvc/Peek"
+        ch.close()
+    finally:
+        srv.stop()
+        srv.join()
