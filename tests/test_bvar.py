@@ -73,3 +73,60 @@ class TestRecorders:
         assert md.has_stats("Echo", "500")
         md.delete_stats("Echo", "500")
         assert not md.has_stats("Echo", "500")
+
+
+class TestWindowSemantics:
+    """Window/PerSecond delta math driven by synthetic samples (the
+    bvar_window_unittest role) — take_sample() is called directly so the
+    tests are deterministic, no sampler-thread sleeps."""
+
+    def test_window_reports_delta_over_window(self):
+        from brpc_tpu.bvar.reducer import Adder
+        from brpc_tpu.bvar.window import Window
+        a = Adder()
+        w = Window(a, window_size=10)
+        for add in (100, 50, 25):
+            a.add(add)
+            w.take_sample()
+        # newest (175) minus the sample at/after newest_t - 10s; all
+        # samples are within the window here, so delta vs the oldest
+        assert w.get_value() == 75      # 175 - 100
+
+    def test_window_drops_samples_past_horizon(self):
+        from brpc_tpu.bvar.reducer import Adder
+        from brpc_tpu.bvar.window import Window
+        a = Adder()
+        w = Window(a, window_size=1)
+        a.add(10)
+        w.take_sample()
+        # age the first sample beyond window+2s; next sample must evict it
+        with w._mu:
+            w._samples[0] = (w._samples[0][0] - 4.0, w._samples[0][1])
+        a.add(5)
+        w.take_sample()
+        assert len(w._samples) == 1     # horizon eviction
+        assert w.get_value() == 0       # single sample: no delta yet
+
+    def test_per_second_rate(self):
+        from brpc_tpu.bvar.reducer import Adder
+        from brpc_tpu.bvar.window import PerSecond
+        a = Adder()
+        p = PerSecond(a, window_size=10)
+        a.add(0)
+        p.take_sample()
+        # fake 2 seconds of age on the first sample, then +300
+        with p._mu:
+            p._samples[0] = (p._samples[0][0] - 2.0, p._samples[0][1])
+        a.add(300)
+        p.take_sample()
+        rate = p.get_value()
+        assert 140 <= rate <= 160       # 300 over ~2s
+
+    def test_window_non_numeric_passthrough(self):
+        from brpc_tpu.bvar.reducer import PassiveStatus
+        from brpc_tpu.bvar.window import Window
+        v = PassiveStatus(lambda: "status-string")
+        w = Window(v, window_size=5)
+        w.take_sample()
+        w.take_sample()
+        assert w.get_value() == "status-string"   # TypeError fallback
