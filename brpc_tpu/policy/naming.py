@@ -23,6 +23,11 @@ from brpc_tpu.policy.load_balancer import LoadBalancer, ServerNode
 
 DEFAULT_INTERVAL_S = 5.0
 
+from brpc_tpu import flags as _flags  # noqa: E402
+
+_flags.define_flag("naming_log_refresh_failures", True,
+                   "log naming-service refresh failures (kept-list notes)")
+
 
 class NamingService:
     interval_s = DEFAULT_INTERVAL_S
@@ -233,9 +238,14 @@ class NamingServiceThread(threading.Thread):
             except Exception as e:
                 # refresh failed: keep the last-known-good list (reference
                 # behavior); one-line note, not a traceback — transient
-                # registry outages are expected in elastic clusters
-                print(f"[naming] refresh of {self.ns.param!r} failed: "
-                      f"{type(e).__name__}: {e} (keeping previous list)")
+                # registry outages are expected in elastic clusters.
+                # Reloadable flag: test suites silence it (dead loopback
+                # registries from finished tests are pure noise there)
+                from brpc_tpu import flags
+                if flags.get_flag("naming_log_refresh_failures"):
+                    print(f"[naming] refresh of {self.ns.param!r} failed: "
+                          f"{type(e).__name__}: {e} "
+                          f"(keeping previous list)")
             if self.ns.interval_s <= 0:
                 break
             self._stop.wait(self.ns.interval_s)

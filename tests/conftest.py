@@ -8,6 +8,8 @@ pod is needed.  Real-chip benchmarks live in bench.py, not here.
 import os
 import sys
 
+import pytest
+
 # Force CPU even when the environment selects the real TPU
 # (JAX_PLATFORMS=axon): tests validate sharding logic on the virtual
 # 8-device mesh; bench.py uses the real chip.  jax may already be imported
@@ -28,3 +30,13 @@ assert len(jax.devices()) == 8, (
     "initialized before conftest could configure it")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _quiet_naming_refresh_noise():
+    """Dead loopback registries from already-finished tests would spam
+    '[naming] refresh failed' across the whole run."""
+    from brpc_tpu import flags
+    from brpc_tpu.policy import naming  # noqa: F401 — defines the flag
+    flags.set_flag("naming_log_refresh_failures", False, force=True)
+    yield
