@@ -26,7 +26,8 @@ DEFAULT_INTERVAL_S = 5.0
 from brpc_tpu import flags as _flags  # noqa: E402
 
 _flags.define_flag("naming_log_refresh_failures", True,
-                   "log naming-service refresh failures (kept-list notes)")
+                   "log naming-service refresh failures (kept-list notes)",
+                   reloadable=True)
 
 
 class NamingService:
@@ -241,8 +242,7 @@ class NamingServiceThread(threading.Thread):
                 # registry outages are expected in elastic clusters.
                 # Reloadable flag: test suites silence it (dead loopback
                 # registries from finished tests are pure noise there)
-                from brpc_tpu import flags
-                if flags.get_flag("naming_log_refresh_failures"):
+                if _flags.get_flag("naming_log_refresh_failures"):
                     print(f"[naming] refresh of {self.ns.param!r} failed: "
                           f"{type(e).__name__}: {e} "
                           f"(keeping previous list)")
