@@ -27,6 +27,7 @@
 #include "bthread/executor.h"
 #include "bthread/fiber.h"
 #include "bthread/timer.h"
+#include "butil/doubly_buffered.h"
 #include "butil/iobuf.h"
 #include "net/event_dispatcher.h"
 #include "net/fd_wait.h"
@@ -187,6 +188,48 @@ static void stress_fd_wait() {
   }
   printf("fd_wait: %d delivered + %d timed out, frames reclaimed\n",
          kPairs / 2, kPairs / 2);
+}
+
+// ---- 0d. DoublyBufferedData: readers vs the writer flip protocol ----
+static void stress_doubly_buffered() {
+  // invariant: the vector is always {k, k+1, ..., k+9} for some k —
+  // a torn read (old foreground observed mid-flip) breaks it
+  butil::DoublyBufferedData<std::vector<int>> dbd;
+  dbd.Modify([](std::vector<int>& v) {
+    v.clear();
+    for (int i = 0; i < 10; ++i) v.push_back(i);
+    return true;
+  });
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> reads{0};
+  std::atomic<int64_t> violations{0};
+  std::vector<std::thread> readers;
+  for (int r = 0; r < 6; ++r) {
+    readers.emplace_back([&] {
+      while (!stop.load(std::memory_order_acquire)) {
+        butil::DoublyBufferedData<std::vector<int>>::ScopedPtr p;
+        dbd.Read(&p);
+        const std::vector<int>& v = *p;
+        const int base = v.empty() ? 0 : v[0];
+        for (size_t i = 0; i < v.size(); ++i) {
+          if (v[i] != base + (int)i) violations.fetch_add(1);
+        }
+        reads.fetch_add(1);
+      }
+    });
+  }
+  for (int k = 1; k <= 500; ++k) {
+    dbd.Modify([k](std::vector<int>& v) {
+      v.clear();
+      for (int i = 0; i < 10; ++i) v.push_back(k + i);
+      return true;
+    });
+  }
+  stop.store(true, std::memory_order_release);
+  for (auto& t : readers) t.join();
+  CHECK_EQ(violations.load(), 0);
+  printf("doubly_buffered: %lld reads across 500 flips, no torn state\n",
+         (long long)reads.load());
 }
 
 // ---- 1. Chase-Lev: owner pops + thieves steal must conserve tasks ----
@@ -534,6 +577,7 @@ int main() {
   stress_bounded_queue();
   stress_iobuf_companions();
   stress_fd_wait();
+  stress_doubly_buffered();
   stress_wsq();
   stress_executor();
   stress_butex();
