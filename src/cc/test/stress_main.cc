@@ -218,6 +218,10 @@ static void stress_doubly_buffered() {
       }
     });
   }
+  // flips must actually race reads: wait for every reader to be live
+  while (reads.load(std::memory_order_acquire) < 6) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
   for (int k = 1; k <= 500; ++k) {
     dbd.Modify([k](std::vector<int>& v) {
       v.clear();
