@@ -598,12 +598,24 @@ def main():
         details["platform"] = str(jax.devices()[0])
     except Exception:
         details["platform"] = platform.machine()
+    # Details are deliberately NOT on stdout: round 3's single giant JSON
+    # line outgrew the driver's tail buffer and the headline was lost
+    # (BENCH_r03 parsed: null).  Per-bench results go to stderr line by
+    # line plus a sidecar file; the LAST stdout line is the compact
+    # machine-readable headline only.
+    for name, d in details.items():
+        log(f"detail {name}: {json.dumps(d)}")
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_DETAILS.json"), "w") as f:
+            json.dump(details, f, indent=1)
+    except OSError as e:
+        log(f"could not write BENCH_DETAILS.json: {e}")
     print(json.dumps({
         "metric": "tensor_pipe_throughput",
         "value": headline,
         "unit": "GB/s",
         "vs_baseline": round(headline / BASELINE_GBPS, 2),
-        "details": details,
     }))
 
 

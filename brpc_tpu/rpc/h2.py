@@ -108,58 +108,159 @@ def build_frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes
     return hdr + payload
 
 
-def grpc_frame(payload: bytes, compressed: bool = False) -> bytes:
-    """5-byte gRPC length prefix (grpc wire format)."""
-    return bytes([1 if compressed else 0]) + struct.pack(">I", len(payload)) \
-        + payload
+# ---- per-message compression (grpc.cpp grpc-encoding negotiation) ----
+#
+# Standard codecs only (gzip + deflate); names travel on the wire in
+# grpc-encoding / grpc-accept-encoding.  A message whose compressed flag
+# is set decompresses with the STREAM's negotiated codec; a set flag with
+# no negotiated codec is the spec's "compressed-flag without grpc-encoding"
+# protocol error.
+
+import gzip as _gzip
+import zlib as _zlib
+
+# Ceiling on ONE message's decompressed size: tiny compressed frames can
+# expand ~1000:1 (decompression bomb); anything bigger than this is
+# rejected as corrupt instead of materialized (grpc max-receive-size
+# analog).
+GRPC_MAX_DECOMPRESSED = 64 << 20
 
 
-def pop_grpc_frames(data: bytearray) -> tuple[list[bytes], Optional[str]]:
+def _bounded_inflate(wbits: int, data: bytes) -> bytes:
+    """zlib-family decompress capped at GRPC_MAX_DECOMPRESSED — never
+    materializes more than the cap no matter the claimed expansion.
+    Loops over members: a gzip body may legally concatenate several
+    (RFC 1952), and stopping at the first would silently truncate."""
+    budget = GRPC_MAX_DECOMPRESSED
+    out = []
+    remaining = data
+    while True:
+        d = _zlib.decompressobj(wbits)
+        chunk = d.decompress(remaining, budget + 1)
+        if len(chunk) > budget or d.unconsumed_tail:
+            raise ValueError("decompressed grpc message exceeds limit")
+        if not d.eof:
+            raise ValueError("truncated compressed grpc message")
+        out.append(chunk)
+        budget -= len(chunk)
+        remaining = d.unused_data
+        if not remaining:
+            return b"".join(out)
+
+
+_GRPC_CODECS: dict[str, tuple[Callable[[bytes], bytes],
+                              Callable[[bytes], bytes]]] = {
+    "gzip": (lambda b: _gzip.compress(b, 6),
+             lambda b: _bounded_inflate(16 + _zlib.MAX_WBITS, b)),
+    "deflate": (_zlib.compress,
+                lambda b: _bounded_inflate(_zlib.MAX_WBITS, b)),
+}
+GRPC_ACCEPT_ENCODING = "identity," + ",".join(_GRPC_CODECS)
+
+
+def grpc_codec(name: Optional[str]):
+    """grpc-encoding header value -> (compress, decompress) or None for
+    identity.  Raises NotImplementedError on an unknown codec (mapped to
+    UNIMPLEMENTED at the call sites, per the gRPC compression spec)."""
+    if not name or name == "identity":
+        return None
+    codec = _GRPC_CODECS.get(name)
+    if codec is None:
+        raise NotImplementedError(f"unsupported grpc-encoding {name!r}")
+    return codec
+
+
+def negotiated_codec(headers: dict) -> Optional[tuple]:
+    """Codec for a peer's DATA per its grpc-encoding header."""
+    return grpc_codec(headers.get("grpc-encoding"))
+
+
+def grpc_frame(payload: bytes, codec: Optional[tuple] = None) -> bytes:
+    """5-byte gRPC length prefix (grpc wire format).  With a codec the
+    message ships compressed (flag byte 1) — used only after the
+    corresponding grpc-encoding header went out."""
+    flag = 0
+    if codec is not None:
+        payload = codec[0](payload)
+        flag = 1
+    return bytes([flag]) + struct.pack(">I", len(payload)) + payload
+
+
+def _inflate(flag: int, payload: bytes, codec: Optional[tuple]) -> bytes:
+    """Apply the stream codec to one popped message body."""
+    if flag == 0:
+        return payload
+    return codec[1](payload)
+
+
+def pop_grpc_frames(data: bytearray, codec: Optional[tuple] = None
+                    ) -> tuple[list[bytes], Optional[str]]:
     """Pop every COMPLETE length-prefixed message off the front of a
     stream buffer (in place).  Returns (messages, error): error is set on
-    a bad/compressed flag byte — ONE implementation for the client sink
-    drain and the server bidi feed."""
+    a bad flag byte or a compressed message without a negotiated codec —
+    ONE implementation for the client sink drain and the server bidi
+    feed."""
     msgs: list[bytes] = []
     off = 0
+    err: Optional[str] = None
     while len(data) - off >= 5:
         flag = data[off]
         (ln,) = struct.unpack_from(">I", data, off + 1)
-        if flag != 0:
-            if off:
-                del data[:off]
-            return msgs, ("compressed grpc message" if flag == 1
-                          else "bad grpc frame flag")
+        if flag > 1 or (flag == 1 and codec is None):
+            err = ("compressed grpc message without grpc-encoding"
+                   if flag == 1 else "bad grpc frame flag")
+            break
         if len(data) - off - 5 < ln:
             break
-        msgs.append(bytes(data[off + 5:off + 5 + ln]))
+        try:
+            msgs.append(_inflate(flag, bytes(data[off + 5:off + 5 + ln]),
+                                 codec))
+        except ValueError as e:   # oversized expansion keeps its message
+            err = str(e)
+            break
+        except Exception:
+            err = "corrupt compressed grpc message"
+            break
         off += 5 + ln
     if off:
         del data[:off]
-    return msgs, None
+    return msgs, err
 
 
-def parse_grpc_frames(data: bytes) -> list[bytes]:
+def parse_grpc_frames(data: bytes, codec: Optional[tuple] = None
+                      ) -> list[bytes]:
     out = []
     pos = 0
     while pos + 5 <= len(data):
-        if data[pos] != 0:
+        flag = data[pos]
+        if flag > 1 or (flag == 1 and codec is None):
             # compressed flag set without a negotiated grpc-encoding —
             # the spec mandates UNIMPLEMENTED, not silent passthrough
-            raise NotImplementedError("compressed grpc message")
+            raise NotImplementedError(
+                "compressed grpc message without grpc-encoding"
+                if flag == 1 else "bad grpc frame flag")
         n = struct.unpack(">I", data[pos + 1:pos + 5])[0]
         if pos + 5 + n > len(data):
             raise ValueError("truncated grpc frame")
-        out.append(data[pos + 5:pos + 5 + n])
+        try:
+            out.append(_inflate(flag, data[pos + 5:pos + 5 + n], codec))
+        except (NotImplementedError, ValueError):
+            raise              # oversized expansion keeps its message
+        except Exception:
+            raise ValueError("corrupt compressed grpc message")
         pos += 5 + n
     if pos != len(data):
         raise ValueError("trailing bytes after grpc frame")
     return out
 
 
+_CODEC_UNSET = ("unset",)
+
+
 class _StreamState:
     __slots__ = ("id", "headers", "data", "trailers", "ended", "send_window",
                  "header_block", "expect_continuation", "trailer_phase",
-                 "reset")
+                 "reset", "rx_codec")
 
     def __init__(self, sid: int, initial_window: int):
         self.id = sid
@@ -172,6 +273,9 @@ class _StreamState:
         self.expect_continuation = False
         self.trailer_phase = False
         self.reset = False
+        # peer's grpc-encoding codec, resolved once at HEADERS time
+        # (deriving it per DATA frame is O(headers) on the hot path)
+        self.rx_codec = _CODEC_UNSET
 
 
 class H2Connection:
@@ -203,10 +307,18 @@ class H2Connection:
 
     # ---- send side ----
 
+    # advertised SETTINGS_MAX_CONCURRENT_STREAMS — deliberately high:
+    # capping it would throttle compliant clients' UNARY concurrency,
+    # which we don't bound per-stream.  The server's enforced bound on
+    # streaming calls is the separate GrpcServerConnection
+    # .max_streaming_calls, backed by grpc-status 8.
+    max_concurrent_streams = 1 << 20
+
     def send_preface_and_settings(self) -> None:
         settings = struct.pack(">HI", SETTINGS_INITIAL_WINDOW_SIZE, OUR_WINDOW) \
             + struct.pack(">HI", SETTINGS_MAX_FRAME_SIZE, OUR_MAX_FRAME) \
-            + struct.pack(">HI", SETTINGS_MAX_CONCURRENT_STREAMS, 1 << 20)
+            + struct.pack(">HI", SETTINGS_MAX_CONCURRENT_STREAMS,
+                          self.max_concurrent_streams)
         wu = struct.pack(">I", OUR_CONN_WINDOW - DEFAULT_WINDOW)
         first = b"" if self.is_server else H2_PREFACE
         with self._send_lock:
@@ -491,6 +603,33 @@ _GRPC_TIMEOUT_UNITS = {"H": 3600.0, "M": 60.0, "S": 1.0,
                        "m": 1e-3, "u": 1e-6, "n": 1e-9}
 
 
+# Messages below this ship uncompressed even on a compressing stream
+# (per-message flag, gRPC compression spec) — tiny payloads inflate.
+GRPC_COMPRESS_MIN = 1024
+
+
+def grpc_frame_auto(payload: bytes, codec: Optional[tuple]) -> bytes:
+    """Length-prefix one message, compressing only when the stream has a
+    codec AND the message is big enough to benefit."""
+    if codec is not None and len(payload) >= GRPC_COMPRESS_MIN:
+        return grpc_frame(payload, codec)
+    return grpc_frame(payload)
+
+
+def response_codec_for(h: dict) -> tuple[Optional[str], Optional[tuple]]:
+    """Server response codec: MIRROR the request's encoding (the gRPC
+    default — a client that didn't compress gets identity back even
+    though it advertises accept-encoding; one that did compress gets its
+    own codec, which its accept list necessarily covers)."""
+    name = h.get("grpc-encoding")
+    if not name or name == "identity" or name not in _GRPC_CODECS:
+        return None, None
+    accept = h.get("grpc-accept-encoding")
+    if accept and name not in {tok.strip() for tok in accept.split(",")}:
+        return None, None
+    return name, _GRPC_CODECS[name]
+
+
 def parse_grpc_timeout(value: Optional[str]) -> Optional[float]:
     """grpc-timeout header ("8-digit value + unit", e.g. '5S', '100m')
     → seconds, or None if absent/malformed."""
@@ -526,13 +665,39 @@ class GrpcServerConnection(H2Connection):
     into the Server's method registry (same gates as native-protocol
     traffic — see Server.invoke_grpc)."""
 
+    # the enforced bound on concurrently-SERVED streaming calls per
+    # connection (each holds 1-2 dedicated rx/tx threads); unary dispatch
+    # rides the bounded shared pool and is NOT slot-gated, so the
+    # SETTINGS advertisement stays high (capping it would throttle
+    # compliant clients' unary concurrency) — excess streaming calls get
+    # grpc-status 8 instead.
+    max_streaming_calls = 128
+
     def __init__(self, sock_id: int, server):
         super().__init__(sock_id, is_server=True)
         self._server = server
-        # bidi request queues: stream id -> queue fed by on_stream_data
-        self._bidi_rx: dict[int, "queue.Queue"] = {}
+        # bidi request feeds: stream id -> (queue, request codec)
+        self._bidi_rx: dict[int, tuple["queue.Queue", Optional[tuple]]] = {}
         self._bidi_lock = threading.Lock()
+        self._stream_slots: set[int] = set()   # streams holding a slot
         self.send_preface_and_settings()
+
+    # ---- streaming budget, one slot PER STREAM (a HEADERS frame is
+    # cheap for the peer; an unbounded thread per stream is not —
+    # advisor r3 #2).  A stream's rx AND tx threads share its slot. ----
+
+    def _acquire_stream_slot(self, stream_id: int) -> bool:
+        with self._bidi_lock:
+            if stream_id in self._stream_slots:
+                return True
+            if len(self._stream_slots) >= self.max_streaming_calls:
+                return False
+            self._stream_slots.add(stream_id)
+            return True
+
+    def _release_stream_slot(self, stream_id: int) -> None:
+        with self._bidi_lock:
+            self._stream_slots.discard(stream_id)
 
     # ---- BIDI: dispatch at headers, feed request frames as they arrive --
 
@@ -540,9 +705,20 @@ class GrpcServerConnection(H2Connection):
         h = dict(st.headers)
         if h.get("grpc-bidi") != "1":
             return                      # unary/client-stream: wait for end
+        try:
+            codec = negotiated_codec(h)
+        except NotImplementedError as e:
+            self._respond_error(st.id, GRPC_UNIMPLEMENTED, str(e))
+            self.close_stream(st.id)
+            return
+        if not self._acquire_stream_slot(st.id):
+            self._respond_error(st.id, GRPC_RESOURCE_EXHAUSTED,
+                                "too many concurrent streams")
+            self.close_stream(st.id)
+            return
         rx: "queue.Queue" = queue.Queue()
         with self._bidi_lock:
-            self._bidi_rx[st.id] = rx
+            self._bidi_rx[st.id] = (rx, codec)
         # dedicated thread: a bidi handler legitimately blocks waiting
         # for its peer's next message — that must not park one of the
         # bounded shared grpc workers for the call's lifetime
@@ -552,48 +728,58 @@ class GrpcServerConnection(H2Connection):
 
     def on_stream_data(self, st: _StreamState) -> None:
         with self._bidi_lock:
-            rx = self._bidi_rx.get(st.id)
-        if rx is None:
+            entry = self._bidi_rx.get(st.id)
+        if entry is None:
             return
-        msgs, err = pop_grpc_frames(st.data)
+        rx, codec = entry
+        msgs, err = pop_grpc_frames(st.data, codec)
         for m in msgs:
             rx.put(m)
         if err is not None:
             # framing is unrecoverable: error the handler ONCE, stop
             # feeding (pop the entry so later DATA can't re-queue), drop
-            # the garbage, and RST so the peer stops sending
+            # the garbage, RST so the peer stops sending, and CLOSE the
+            # stream so an in-flight END_STREAM can't re-dispatch it
             rx.put(errors.RpcError(errors.EREQUEST, err))
             with self._bidi_lock:
                 self._bidi_rx.pop(st.id, None)
             del st.data[:]
             self.send_rst(st.id, 0x1)    # PROTOCOL_ERROR
+            self.close_stream(st.id)
 
     def on_stream_complete(self, st: _StreamState) -> None:
         with self._bidi_lock:
-            rx = self._bidi_rx.get(st.id)
-        if rx is not None:
+            entry = self._bidi_rx.get(st.id)
+        if entry is not None:
             self.on_stream_data(st)     # tail frames
-            rx.put(_STREAM_END)         # half-close: request side done
+            entry[0].put(_STREAM_END)   # half-close: request side done
             with self._bidi_lock:       # feeding is over; drop the entry
                 self._bidi_rx.pop(st.id, None)
             return                      # handler already running
+        if any(k == "grpc-bidi" and v == "1" for k, v in st.headers):
+            # bidi stream whose feed entry is already gone: the call was
+            # served (tx finished before the client's half-close arrived)
+            # — dispatching _process here would invoke the handler a
+            # SECOND time on an empty payload (race vs _transmit_stream's
+            # cleanup)
+            return
         # runs on the dispatcher thread: only parse + hand off
         _grpc_executor().submit(self._process, st)
 
     def on_stream_reset(self, stream_id: int, code: int) -> None:
         with self._bidi_lock:
-            rx = self._bidi_rx.pop(stream_id, None)
-        if rx is not None:
-            rx.put(errors.RpcError(errors.ECANCELED,
-                                   f"stream reset (h2 error {code})"))
+            entry = self._bidi_rx.pop(stream_id, None)
+        if entry is not None:
+            entry[0].put(errors.RpcError(errors.ECANCELED,
+                                         f"stream reset (h2 error {code})"))
 
     def abort_bidi(self) -> None:
         """Connection died: unblock every parked bidi handler — a
         request_iter waiting in rx.get() would otherwise hang forever,
         leaking the inflight slot and wedging graceful join()."""
         with self._bidi_lock:
-            queues, self._bidi_rx = dict(self._bidi_rx), {}
-        for rx in queues.values():
+            entries, self._bidi_rx = dict(self._bidi_rx), {}
+        for rx, _codec in entries.values():
             rx.put(errors.RpcError(errors.ECANCELED,
                                    "h2 connection lost"))
 
@@ -644,18 +830,18 @@ class GrpcServerConnection(H2Connection):
             if code != 0:
                 self._respond_error(st.id, err_to_grpc(code), text)
                 return
-            self.send_headers(st.id, [(":status", "200"),
-                                      ("content-type", "application/grpc")])
+            enc_name, tx_codec = response_codec_for(h)
+            self.send_headers(st.id, self._resp_headers(enc_name))
             if isinstance(resp, (bytes, bytearray, memoryview)):
-                self.send_data(st.id, grpc_frame(bytes(resp)),
+                self.send_data(st.id, grpc_frame_auto(bytes(resp), tx_codec),
                                end_stream=False)
                 self.send_headers(st.id, [("grpc-status", "0")],
                                   end_stream=True)
             else:
                 body, resp = resp, None
-                handed_off = True
+                handed_off = True   # the tx thread inherits this
                 threading.Thread(target=self._transmit_stream,
-                                 args=(st, body), daemon=True,
+                                 args=(st, body, tx_codec), daemon=True,
                                  name=f"grpc-bidi-tx-{st.id}").start()
         except errors.RpcError:
             pass
@@ -671,6 +857,7 @@ class GrpcServerConnection(H2Connection):
                         resp.close()
                     except Exception:
                         pass
+                self._release_stream_slot(st.id)
                 self.close_stream(st.id)
 
     def _process(self, st: _StreamState) -> None:
@@ -680,7 +867,7 @@ class GrpcServerConnection(H2Connection):
             h = dict(st.headers)
             path = h.get(":path", "")
             try:
-                msgs = parse_grpc_frames(bytes(st.data))
+                msgs = parse_grpc_frames(bytes(st.data), negotiated_codec(h))
                 # the request header — not frame counting — decides the
                 # handler contract: a marked client-stream delivers the
                 # full message LIST (even with 0 or 1 messages); an
@@ -690,9 +877,8 @@ class GrpcServerConnection(H2Connection):
                     payload = msgs
                 else:
                     payload = msgs[0] if msgs else b""
-            except NotImplementedError:
-                self._respond_error(st.id, GRPC_UNIMPLEMENTED,
-                                    "grpc message compression not supported")
+            except NotImplementedError as e:
+                self._respond_error(st.id, GRPC_UNIMPLEMENTED, str(e))
                 return
             except ValueError:
                 self._respond_error(st.id, GRPC_INTERNAL, "bad grpc framing")
@@ -715,22 +901,30 @@ class GrpcServerConnection(H2Connection):
             if code != 0:
                 self._respond_error(st.id, err_to_grpc(code), text)
                 return
-            self.send_headers(st.id, [(":status", "200"),
-                                      ("content-type", "application/grpc")])
+            enc_name, tx_codec = response_codec_for(h)
+            self.send_headers(st.id, self._resp_headers(enc_name))
             if isinstance(resp, (bytes, bytearray, memoryview)):
-                self.send_data(st.id, grpc_frame(bytes(resp)),
+                self.send_data(st.id, grpc_frame_auto(bytes(resp), tx_codec),
                                end_stream=False)
             else:
                 # SERVER-STREAMING: transmission runs on a DEDICATED
                 # thread — a long stream (or a slow reader holding the h2
                 # window at zero) must not park one of the bounded shared
                 # grpc workers for its whole lifetime and starve unary
-                # dispatch.  The thread takes ownership of resp and the
-                # stream close.
+                # dispatch.  The thread takes ownership of resp, the
+                # stream slot, and the stream close.
                 body, resp = resp, None
-                handed_off = True   # the thread owns the stream close
+                if not self._acquire_stream_slot(st.id):
+                    resp = body     # finally-close; trailers report it
+                    self.send_headers(
+                        st.id,
+                        [("grpc-status", str(GRPC_RESOURCE_EXHAUSTED)),
+                         ("grpc-message", "too many concurrent streams")],
+                        end_stream=True)
+                    return
+                handed_off = True
                 threading.Thread(target=self._transmit_stream,
-                                 args=(st, body), daemon=True,
+                                 args=(st, body, tx_codec), daemon=True,
                                  name=f"grpc-stream-tx-{st.id}").start()
                 return
             self.send_headers(st.id, [("grpc-status", "0")], end_stream=True)
@@ -752,7 +946,18 @@ class GrpcServerConnection(H2Connection):
                         pass
                 self.close_stream(st.id)
 
-    def _transmit_stream(self, st: _StreamState, body) -> None:
+    def _resp_headers(self, enc_name: Optional[str]) -> list[tuple[str, str]]:
+        """Response HEADERS: status, content type, our codec menu, and
+        the negotiated response encoding when one was picked."""
+        headers = [(":status", "200"),
+                   ("content-type", "application/grpc"),
+                   ("grpc-accept-encoding", GRPC_ACCEPT_ENCODING)]
+        if enc_name:
+            headers.append(("grpc-encoding", enc_name))
+        return headers
+
+    def _transmit_stream(self, st: _StreamState, body,
+                         codec: Optional[tuple] = None) -> None:
         """Send one streaming response to its end: each item one
         length-prefixed frame, then trailers.  A transport error (stream
         reset by the client's cancel, dead connection) stops quietly —
@@ -762,7 +967,7 @@ class GrpcServerConnection(H2Connection):
         try:
             try:
                 for item in body:
-                    self.send_data(st.id, grpc_frame(bytes(item)),
+                    self.send_data(st.id, grpc_frame_auto(bytes(item), codec),
                                    end_stream=False)
             except errors.RpcError:
                 return  # reset / dead connection: no trailers possible
@@ -788,6 +993,9 @@ class GrpcServerConnection(H2Connection):
                     body.close()
                 except Exception:
                     pass
+            with self._bidi_lock:
+                self._bidi_rx.pop(st.id, None)
+            self._release_stream_slot(st.id)
             self.close_stream(st.id)
 
     def _respond_error(self, stream_id: int, status: int, msg: str) -> None:
@@ -806,14 +1014,42 @@ class GrpcChannel:
 
         ch = GrpcChannel("127.0.0.1:8000")
         resp_bytes = ch.call("example.Echo", "Echo", payload_bytes)
+
+    compression="gzip"/"deflate" compresses request messages ≥1KB and
+    advertises the codec via grpc-encoding; responses decompress per the
+    server's grpc-encoding header either way (grpc.cpp negotiation).
     """
 
-    def __init__(self, address: str, timeout_ms: int = 5000):
+    def __init__(self, address: str, timeout_ms: int = 5000,
+                 compression: Optional[str] = None):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout_ms = timeout_ms
+        self._enc_name = None if compression in (None, "identity") \
+            else compression
+        self._tx_codec = grpc_codec(compression)   # raises on unknown
         self._lock = threading.Lock()
         self._conn: Optional[_GrpcClientConnection] = None
+
+    def _with_encoding(self, md: list[tuple[str, str]]
+                       ) -> tuple[list[tuple[str, str]], Optional[tuple]]:
+        """(metadata, effective tx codec).  A user-supplied grpc-encoding
+        header WINS over the channel's compression setting — the frames
+        must match whatever header actually goes on the wire (sending
+        gzip bytes under an 'identity' header is a protocol error the
+        server rightly rejects)."""
+        for k, v in md:
+            if k == "grpc-encoding":
+                try:
+                    return md, grpc_codec(v)
+                except NotImplementedError:
+                    # codec we can't produce: ship frames uncompressed
+                    # (flag 0 is legal under any grpc-encoding header)
+                    # and let the server's negotiation answer
+                    return md, None
+        if self._enc_name:
+            return [("grpc-encoding", self._enc_name)] + md, self._tx_codec
+        return md, None
 
     def _ensure(self) -> "_GrpcClientConnection":
         with self._lock:
@@ -849,9 +1085,10 @@ class GrpcChannel:
     def acall(self, service: str, method: str, payload: bytes,
               metadata: Optional[list[tuple[str, str]]] = None,
               timeout_ms: Optional[int] = None) -> Future:
-        return self._ensure().start_call(
-            service, method, payload,
+        md, codec = self._with_encoding(
             self._with_deadline(metadata, timeout_ms))
+        return self._ensure().start_call(service, method, payload, md,
+                                         codec=codec)
 
     def call(self, service: str, method: str, payload: bytes,
              timeout_ms: Optional[int] = None,
@@ -878,11 +1115,13 @@ class GrpcChannel:
             # indistinguishable from the N-message case.  No auto
             # grpc-timeout: request production time is unbounded (see
             # _with_deadline).
-            md = [("grpc-client-streaming", "1")] + list(metadata or [])
+            md, codec = self._with_encoding(
+                [("grpc-client-streaming", "1")] + list(metadata or []))
             stream_id = conn._begin_call(service, method, None, md,
                                          conn._calls, fut)
             for msg in requests:
-                conn.send_data(stream_id, grpc_frame(bytes(msg)),
+                conn.send_data(stream_id,
+                               grpc_frame_auto(bytes(msg), codec),
                                end_stream=False)
             conn.send_data(stream_id, b"", end_stream=True)
         except Exception as e:
@@ -917,10 +1156,12 @@ class GrpcChannel:
         so a conversational handler can answer each message as it
         arrives."""
         conn = self._ensure()
-        md = [("grpc-bidi", "1")] + list(metadata or [])
+        md, codec = self._with_encoding(
+            [("grpc-bidi", "1")] + list(metadata or []))
         sink, stream_id = conn.start_stream_call(service, method, None, md)
         return GrpcBidiCall(conn, stream_id, sink,
-                            (timeout_ms or self._timeout_ms) / 1e3)
+                            (timeout_ms or self._timeout_ms) / 1e3,
+                            codec=codec)
 
     def call_stream(self, service: str, method: str, payload: bytes,
                     timeout_ms: Optional[int] = None,
@@ -929,33 +1170,19 @@ class GrpcChannel:
         gRPC frame arrives (incremental — messages are consumed off the
         open h2 stream, not buffered until trailers).  Raises RpcError on
         a non-zero grpc-status trailer; the per-message timeout is the
-        channel timeout."""
+        channel timeout.
+
+        The stream opens (and the request ships) EAGERLY, before the
+        first iteration — a plain function returning an inner generator,
+        so call latency/timeouts start at call time, not first-next."""
         per_msg_s = (timeout_ms or self._timeout_ms) / 1e3
         conn = self._ensure()
         # no auto grpc-timeout: the channel timeout is PER MESSAGE here,
         # not a whole-stream deadline (see _with_deadline)
+        md, codec = self._with_encoding(list(metadata or []))
         sink, stream_id = conn.start_stream_call(service, method, payload,
-                                                 metadata or [])
-        finished = False
-        try:
-            while True:
-                try:
-                    item = sink.get(timeout=per_msg_s)
-                except queue.Empty:
-                    raise errors.RpcError(errors.ERPCTIMEDOUT,
-                                          "grpc stream message timed out")
-                if item is _STREAM_END:
-                    finished = True
-                    return
-                if isinstance(item, Exception):
-                    finished = True
-                    raise item
-                yield item
-        finally:
-            if not finished and stream_id:
-                # consumer abandoned the iterator (break / close / error
-                # in the loop body): cancel so the server stops sending
-                conn.cancel_stream_call(stream_id)
+                                                 md, codec=codec)
+        return GrpcServerStreamCall(conn, stream_id, sink, per_msg_s)
 
     def close(self) -> None:
         with self._lock:
@@ -964,10 +1191,12 @@ class GrpcChannel:
                 self._conn = None
 
 
-class GrpcBidiCall:
-    """Client handle for one interleaved bidi stream: send() request
-    messages (done_writing() half-closes), iterate responses as their
-    frames arrive.  Abandoning the iterator cancels the stream."""
+class GrpcServerStreamCall:
+    """Iterator over one server-streaming response.  An ITERATOR OBJECT,
+    not a generator: a call that is dropped without ever being iterated
+    still cancels the server-side stream (close() works pre-start, and
+    __del__ backstops a leaked handle) — a generator's finally would
+    never run in that case."""
 
     def __init__(self, conn: "_GrpcClientConnection", stream_id: int,
                  sink: "queue.Queue", per_msg_timeout_s: float):
@@ -975,6 +1204,61 @@ class GrpcBidiCall:
         self._sid = stream_id
         self._sink = sink
         self._timeout_s = per_msg_timeout_s
+        self._finished = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        try:
+            item = self._sink.get(timeout=self._timeout_s)
+        except queue.Empty:
+            self.close()
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  "grpc stream message timed out")
+        if item is _STREAM_END:
+            self._finished = True
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._finished = True
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Abandon the stream: tell the server to stop transmitting."""
+        if not self._finished:
+            self._finished = True
+            if self._sid:
+                self._conn.cancel_stream_call(self._sid)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # leaked handle backstop; close() is the real path
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class GrpcBidiCall:
+    """Client handle for one interleaved bidi stream: send() request
+    messages (done_writing() half-closes), iterate responses as their
+    frames arrive.  Abandoning the iterator cancels the stream."""
+
+    def __init__(self, conn: "_GrpcClientConnection", stream_id: int,
+                 sink: "queue.Queue", per_msg_timeout_s: float,
+                 codec: Optional[tuple] = None):
+        self._conn = conn
+        self._sid = stream_id
+        self._sink = sink
+        self._timeout_s = per_msg_timeout_s
+        self._codec = codec
         self._write_closed = False
         self._finished = False
 
@@ -982,7 +1266,8 @@ class GrpcBidiCall:
         if self._write_closed:
             raise errors.RpcError(errors.EREQUEST,
                                   "bidi request side already closed")
-        self._conn.send_data(self._sid, grpc_frame(bytes(msg)),
+        self._conn.send_data(self._sid,
+                             grpc_frame_auto(bytes(msg), self._codec),
                              end_stream=False)
 
     def done_writing(self) -> None:
@@ -1071,7 +1356,7 @@ class _GrpcClientConnection(H2Connection):
     def _begin_call(self, service: str, method: str,
                     payload: Optional[bytes],
                     metadata: list[tuple[str, str]], registry: dict,
-                    completion) -> int:
+                    completion, codec: Optional[tuple] = None) -> int:
         """Shared open-and-send for unary and streaming calls: allocate
         the id AND send HEADERS under one lock (RFC 7540 §5.1.1 requires
         stream ids to hit the wire in increasing order, so the two steps
@@ -1079,22 +1364,31 @@ class _GrpcClientConnection(H2Connection):
         `registry`, then ship the single request frame.  payload=None
         opens the stream WITHOUT ending it (client-streaming: the caller
         ships request frames itself).  Returns the stream id; raises
-        after unregistering on a send failure."""
+        after unregistering on ANY failure — including a send_headers
+        failure inside the lock, which must not leak the registry entry
+        or the open_stream window state."""
         with self._calls_lock:
             stream_id = self._next_stream
             self._next_stream += 2
             registry[stream_id] = completion
             self.open_stream(stream_id)  # track our send window
-            headers = [(":method", "POST"), (":scheme", "http"),
-                       (":path", f"/{service}/{method}"),
-                       (":authority", self._authority),
-                       ("content-type", "application/grpc"),
-                       ("te", "trailers")] + metadata
-            self.send_headers(stream_id, headers)
+            try:
+                headers = [(":method", "POST"), (":scheme", "http"),
+                           (":path", f"/{service}/{method}"),
+                           (":authority", self._authority),
+                           ("content-type", "application/grpc"),
+                           ("grpc-accept-encoding", GRPC_ACCEPT_ENCODING),
+                           ("te", "trailers")] + metadata
+                self.send_headers(stream_id, headers)
+            except Exception:
+                registry.pop(stream_id, None)
+                self.close_stream(stream_id)
+                raise
         if payload is None:
             return stream_id
         try:
-            self.send_data(stream_id, grpc_frame(payload), end_stream=True)
+            self.send_data(stream_id, grpc_frame_auto(payload, codec),
+                           end_stream=True)
         except Exception:
             with self._calls_lock:
                 registry.pop(stream_id, None)
@@ -1103,18 +1397,20 @@ class _GrpcClientConnection(H2Connection):
         return stream_id
 
     def start_call(self, service: str, method: str, payload: bytes,
-                   metadata: list[tuple[str, str]]) -> Future:
+                   metadata: list[tuple[str, str]],
+                   codec: Optional[tuple] = None) -> Future:
         fut: Future = Future()
         try:
             self._begin_call(service, method, payload, metadata,
-                             self._calls, fut)
+                             self._calls, fut, codec=codec)
         except Exception as e:
             if not fut.done():
                 fut.set_exception(e)
         return fut
 
     def start_stream_call(self, service: str, method: str, payload: bytes,
-                          metadata: list[tuple[str, str]]):
+                          metadata: list[tuple[str, str]],
+                          codec: Optional[tuple] = None):
         """Open a server-streaming call; returns (sink, stream_id): the
         queue call_stream drains (messages, then _STREAM_END or an
         exception) and the id used to cancel an abandoned stream."""
@@ -1122,7 +1418,8 @@ class _GrpcClientConnection(H2Connection):
         stream_id = 0
         try:
             stream_id = self._begin_call(service, method, payload,
-                                         metadata, self._sinks, sink)
+                                         metadata, self._sinks, sink,
+                                         codec=codec)
         except Exception as e:
             sink.put(e if isinstance(e, errors.RpcError) else
                      errors.RpcError(errors.EFAILEDSOCKET, str(e)))
@@ -1144,9 +1441,16 @@ class _GrpcClientConnection(H2Connection):
 
     def _drain_stream_frames(self, st: _StreamState, sink) -> bool:
         """Pop complete length-prefixed messages off the stream buffer
-        into the sink.  Returns False on a framing error (sink fed the
-        exception)."""
-        msgs, err = pop_grpc_frames(st.data)
+        into the sink, decompressing per the response's grpc-encoding.
+        Returns False on a framing error (sink fed the exception)."""
+        if st.rx_codec is _CODEC_UNSET:
+            try:
+                st.rx_codec = negotiated_codec(dict(st.headers))
+            except NotImplementedError as e:
+                st.rx_codec = None
+                sink.put(errors.RpcError(errors.ERESPONSE, str(e)))
+                return False
+        msgs, err = pop_grpc_frames(st.data, st.rx_codec)
         for m in msgs:
             sink.put(m)
         if err is not None:
@@ -1197,7 +1501,7 @@ class _GrpcClientConnection(H2Connection):
             fut.set_exception(errors.RpcError(grpc_to_err(status), msg))
             return
         try:
-            msgs = parse_grpc_frames(bytes(st.data))
+            msgs = parse_grpc_frames(bytes(st.data), negotiated_codec(h))
             fut.set_result(msgs[0] if msgs else b"")
         except (ValueError, NotImplementedError) as e:
             fut.set_exception(errors.RpcError(errors.ERESPONSE, str(e)))

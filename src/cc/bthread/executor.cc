@@ -126,8 +126,12 @@ void Executor::submit(TaskFn fn, void* arg) {
   // (wake workers, yield, retry — the reference spins its remote push the
   // same way, task_group start_background<REMOTE>); a WORKER must never
   // spin waiting for other workers — if every worker is inside submit
-  // (tasks spawning tasks at full backlog) nobody is left to drain, so a
-  // worker whose local AND remote queues are full runs the task inline.
+  // (tasks spawning tasks at full backlog) nobody is left to drain — so a
+  // worker whose local AND remote queues are full parks the task on the
+  // unbounded overflow deque.  submit() therefore never executes the task
+  // inline on a live executor (inline execution deadlocks a submitter
+  // holding a non-reentrant lock the task also takes); only the
+  // post-stop path runs inline, when no worker will ever drain.
   // The stopping check lives UNDER the remote mutex: stop_and_join's
   // final drain takes the same mutex after setting _stopping, so a push
   // either lands before that drain (and is consumed by it) or observes
@@ -140,8 +144,12 @@ void Executor::submit(TaskFn fn, void* arg) {
       if (!stopped && _remote.push(t)) {
         break;
       }
+      if (!stopped && is_worker) {
+        _overflow.push_back(t);
+        break;
+      }
     }
-    if (stopped || is_worker) {
+    if (stopped) {
       t->fn(t->arg);
       delete t;
       _executed.add(1);
@@ -170,8 +178,25 @@ void Executor::submit(std::function<void()> fn) {
 
 TaskNode* Executor::pop_remote() {
   std::lock_guard<std::mutex> g(_remote_mu);
+  // Alternate ring/overflow: either source alone can be refilled faster
+  // than it drains (spinning foreign submitters keep the ring full;
+  // self-feeding workers at full backlog keep overflow growing), so a
+  // fixed priority starves the other side.  Taking turns bounds both
+  // waits at one pop each.
+  _overflow_turn = !_overflow_turn;
   TaskNode* t = nullptr;
-  return _remote.pop(&t) ? t : nullptr;
+  if (_overflow_turn && !_overflow.empty()) {
+    t = _overflow.front();
+    _overflow.pop_front();
+    return t;
+  }
+  if (_remote.pop(&t)) return t;
+  if (!_overflow.empty()) {
+    t = _overflow.front();
+    _overflow.pop_front();
+    return t;
+  }
+  return nullptr;
 }
 
 TaskNode* Executor::steal_task(int self) {
@@ -238,7 +263,11 @@ void Executor::stop_and_join() {
     TaskNode* t = nullptr;
     {
       std::lock_guard<std::mutex> g(_remote_mu);
-      if (!_remote.pop(&t)) break;
+      if (!_remote.pop(&t)) {
+        if (_overflow.empty()) break;
+        t = _overflow.front();
+        _overflow.pop_front();
+      }
     }
     t->fn(t->arg);
     delete t;

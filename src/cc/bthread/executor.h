@@ -19,6 +19,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <functional>
 #include <mutex>
 #include <thread>
@@ -118,6 +119,14 @@ class Executor {
   // bound while workers are wedged.
   std::mutex _remote_mu;
   butil::BoundedQueue<TaskNode*> _remote{kRemoteCapacity};
+  // Worker-side overflow: when a WORKER's local deque and the remote ring
+  // are both full, the task lands here (unbounded, same mutex) instead of
+  // running inline — inline execution made submit() synchronous under
+  // load, which deadlocks a submitter holding a non-reentrant lock the
+  // task also takes.  Only workers push here, and only at full backlog,
+  // so growth is bounded by the burst the workers themselves generate.
+  std::deque<TaskNode*> _overflow;
+  bool _overflow_turn = false;  // pop_remote alternates ring/overflow
   static constexpr size_t kRemoteCapacity = 1 << 16;
   std::atomic<bool> _stopping{false};
   bvar::Adder _executed, _steals, _signals;
