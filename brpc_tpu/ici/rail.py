@@ -170,6 +170,38 @@ def device_by_id(device_id: int):
     raise KeyError(f"no local device with id {device_id}")
 
 
+# The rail's claim registry is PER-PROCESS: a ticket shipped to a peer in
+# another process can never be claimed (its blocks would pin HBM until
+# the TTL sweeper).  Device advertisements on the wire therefore carry
+# this process token; resolution fails closed for any other process.
+import uuid as _uuid
+
+_PROCESS_TOKEN = _uuid.uuid4().hex[:16]
+
+
+def device_advert(device) -> str:
+    """Wire value advertising `device` as a tensor receive endpoint
+    (stream settings F_SDEV): process token + device id."""
+    return f"{_PROCESS_TOKEN}:{device.id}"
+
+
+def device_from_wire(value):
+    """Resolve a peer's device advertisement.  None unless the advert
+    came from THIS process (token match) and names a local device — the
+    single gate keeping rail tickets off cross-process streams."""
+    if value is None:
+        return None
+    if isinstance(value, bytes):
+        value = value.decode()
+    token, _, dev_id = value.partition(":")
+    if token != _PROCESS_TOKEN or not dev_id:
+        return None
+    try:
+        return device_by_id(int(dev_id))
+    except (KeyError, ValueError):
+        return None
+
+
 # ---------------------------------------------------------------------------
 # payload registry: ticket -> staged entries (the claim table)
 # ---------------------------------------------------------------------------

@@ -378,6 +378,16 @@ class CallManager:
                 sbuf = meta.user_fields.get(M.F_SBUF)
                 if sbuf:
                     cntl._stream.peer_buf_size = int(sbuf)
+                sdev = meta.user_fields.get(M.F_SDEV)
+                if sdev:
+                    # the server's EXPLICIT stream advertisement wins
+                    # over the pre-bind unary-map guess — the accepting
+                    # handler may have picked a different device than
+                    # the server-wide ici_device
+                    from brpc_tpu.ici import rail
+                    dev = rail.device_from_wire(sdev)
+                    if dev is not None:
+                        cntl._stream.peer_device = dev
                 cntl._stream.set_remote(meta.stream_id)
         except Exception as e:  # bad response
             cntl.set_failed(errors.ERESPONSE, f"cannot decode response: {e}")
@@ -621,6 +631,12 @@ class Channel:
         if stream is not None:
             meta.stream_id = stream.stream_id
             meta.user_fields[M.F_SBUF] = str(stream.max_buf_size)
+            if stream.device is not None:
+                # advertise OUR tensor receive device (rail settings);
+                # the embedded process token scopes it to this process
+                from brpc_tpu.ici import rail
+                meta.user_fields[M.F_SDEV] = rail.device_advert(
+                    stream.device)
 
         # rpcz span
         from brpc_tpu.rpcz import current_trace
@@ -702,6 +718,15 @@ class Channel:
         mgr.bind_socket(cntl.correlation_id, conn.sid)
         stream = getattr(cntl, "_stream", None)
         if stream is not None and not stream.connected:
+            if stream.peer_device is None:
+                # same slide-under decision the rail makes for unary
+                # payloads: an advertised server device means tensor
+                # writes ride ICI from the first write, before the
+                # settings response arrives.  Resolve BEFORE bind —
+                # bind flushes pending writes, which must already know
+                # their transport
+                from brpc_tpu.ici import rail
+                stream.peer_device = rail.lookup(ep)
             stream.bind(conn.sid)
         if (not meta.auth and not meta.trace_id and not meta.span_id
                 and not meta.stream_id and not meta.tensor_header

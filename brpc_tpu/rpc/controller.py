@@ -83,10 +83,12 @@ class Controller:
         self._server_done: Optional[Callable[[Any], None]] = None
         self._deferred = False
 
-    def accept_stream(self, handler=None, max_buf_size: int = 2 * 1024 * 1024):
-        """Server handler: accept the stream the client attached."""
+    def accept_stream(self, handler=None, max_buf_size: int = 2 * 1024 * 1024,
+                      device=None):
+        """Server handler: accept the stream the client attached.
+        `device` = where this side receives tensor payloads (rail)."""
         from brpc_tpu.rpc.stream import stream_accept
-        return stream_accept(self, handler, max_buf_size)
+        return stream_accept(self, handler, max_buf_size, device=device)
 
     def defer(self) -> Callable[[Any], None]:
         """Server handler: switch this RPC to asynchronous completion.

@@ -56,7 +56,10 @@ COMPRESS_ZSTD = 4
 F_TICKET = "icit"
 F_SRC_DEV = "icisrc"
 F_SBUF = "sbuf"
-RESERVED_USER_FIELD_KEYS = frozenset({F_TICKET, F_SRC_DEV, F_SBUF})
+# stream tensor-rail advertisement: the device id this side of a stream
+# can RECEIVE tensor payloads on (StreamSettings exchange)
+F_SDEV = "sdev"
+RESERVED_USER_FIELD_KEYS = frozenset({F_TICKET, F_SRC_DEV, F_SBUF, F_SDEV})
 
 
 def normalize_user_fields(fields: dict) -> dict:

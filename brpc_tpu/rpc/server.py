@@ -837,6 +837,10 @@ class Server:
                         resp.stream_id = cntl._stream.stream_id
                         resp.user_fields[M.F_SBUF] = \
                             str(cntl._stream.max_buf_size)
+                        if cntl._stream.device is not None:
+                            from brpc_tpu.ici import rail
+                            resp.user_fields[M.F_SDEV] = \
+                                rail.device_advert(cntl._stream.device)
                     if cntl.response_attachment:
                         resp.attachment_size = len(cntl.response_attachment)
                         rbody = rbody + cntl.response_attachment

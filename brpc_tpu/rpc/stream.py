@@ -10,8 +10,16 @@ native core dispatches each parsed message onto the work-stealing executor,
 so handler dispatch may be reordered — the stream_seq/reorder layer below
 restores write order (the reference's per-stream ExecutionQueue).
 
-This same credit loop is what the ICI transport reuses for HBM→HBM tensor
-streaming (brpc_tpu/ici/stream.py).
+ONE stream abstraction for host bytes AND device tensors: `write()` also
+accepts jax device arrays.  When the peer has an ICI-reachable device, the
+tensor payload slides under the socket exactly the way the reference
+slides RDMA under Socket::StartWrite (socket.cpp:1751-1757, the
+CutFromIOBufList swap): blocks stage on device, ride IciEndpoint's
+credit-windowed transfer (brpc_tpu/ici/rail.py), and the DATA frame
+carries only a claim ticket — CONSUMED feedback stays on the host socket
+either way, and `rail.host_copy_count()` proves the zero-copy path.  A
+peer without a reachable device gets the tensor-serializer fallback
+(host bytes, still arrays at the far end).
 """
 from __future__ import annotations
 
@@ -63,11 +71,17 @@ class Stream:
     response meta (streaming_rpc_meta.proto)."""
 
     def __init__(self, stream_id: int, handler: Optional[StreamHandler],
-                 max_buf_size: int = DEFAULT_BUF_SIZE):
+                 max_buf_size: int = DEFAULT_BUF_SIZE, device=None):
         self.stream_id = stream_id               # local id
         self.remote_id: Optional[int] = None     # peer's local id
         self.handler = handler
         self.max_buf_size = max_buf_size
+        # tensor rail endpoints: `device` is where WE receive tensor
+        # payloads (advertised to the peer in the settings exchange,
+        # F_SDEV); `peer_device` is where the PEER receives — learned
+        # from its settings/rail map, None = host-serialize fallback
+        self.device = device
+        self.peer_device = None
         # The WRITER's window size, learned from the StreamSettings exchange:
         # feedback must fire well before the peer's window fills, regardless
         # of our own buffer size (a 2MB receiver facing a 256KB writer would
@@ -80,7 +94,8 @@ class Stream:
         self._remote_consumed = 0
         self._consumed_local = 0                 # receiver side
         self._last_feedback = 0
-        self._pending: list[tuple[int, bytes]] = []  # writes before binding
+        # writes before binding: (seq, "bytes"|"tensor", payload)
+        self._pending: list[tuple[int, str, object]] = []
         self._closed = False
         self._close_sent = False
         # Ordered delivery (the reference's per-stream ExecutionQueue,
@@ -112,8 +127,11 @@ class Stream:
             if self._sid is None or self.remote_id is None:
                 return
             pending, self._pending = self._pending, []
-        for seq, data in pending:
-            self._send_data(data, seq)
+        for seq, kind, payload in pending:
+            if kind == "bytes":
+                self._send_data(payload, seq)
+            else:
+                self._send_tensor(payload, seq)
 
     @property
     def connected(self) -> bool:
@@ -125,14 +143,27 @@ class Stream:
 
     # ---- writer side (StreamWrite, stream.cpp:721/274) ----
 
-    def write(self, data: bytes, timeout_s: float | None = 10.0) -> None:
-        """Blocks while the window is full; raises RpcError(EAGAIN-like) on
-        timeout, EEOF if closed."""
+    def write(self, data, timeout_s: float | None = 10.0) -> None:
+        """Write one message: host bytes OR a jax device array (or a
+        list/tuple of them).  Blocks while the window is full; raises
+        RpcError(EAGAIN-like) on timeout, EEOF if closed.  Device
+        payloads count their device nbytes against the same window."""
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            kind, payload, nbytes = "bytes", bytes(data), len(data)
+        else:
+            from brpc_tpu.ici import rail
+            if not rail.railable(data):
+                raise TypeError(
+                    "stream write takes bytes or jax device arrays, "
+                    f"not {type(data).__name__}")
+            arrays = data if isinstance(data, (list, tuple)) else [data]
+            kind, payload = "tensor", data
+            nbytes = sum(a.nbytes for a in arrays)
         if self._closed:
             raise errors.RpcError(errors.EEOF, "stream closed")
         with self._window_cv:
             deadline = None
-            while (self._produced + len(data) - self._remote_consumed
+            while (self._produced + nbytes - self._remote_consumed
                    > self.max_buf_size):
                 if self._closed:
                     raise errors.RpcError(errors.EEOF, "stream closed")
@@ -148,13 +179,16 @@ class Stream:
                         errors.EOVERCROWDED,
                         f"stream window full ({self.max_buf_size}B)")
                 self._window_cv.wait(min(remaining, 1.0))
-            self._produced += len(data)
+            self._produced += nbytes
             seq = self._send_seq
             self._send_seq += 1
             if self._sid is None or self.remote_id is None:
-                self._pending.append((seq, data))
+                self._pending.append((seq, kind, payload))
                 return
-        self._send_data(data, seq)
+        if kind == "bytes":
+            self._send_data(payload, seq)
+        else:
+            self._send_tensor(payload, seq)
 
     def _send_data(self, data: bytes, seq: int) -> None:
         meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
@@ -163,21 +197,50 @@ class Stream:
         if rc != 0:
             self._on_closed_internal()
 
+    def _send_tensor(self, obj, seq: int) -> None:
+        """StreamWrite for device payloads — the RDMA slide-under
+        (socket.cpp:1751-1757): with a reachable peer device the tensors
+        move HBM→HBM through the rail and the socket frame carries only
+        the claim ticket; otherwise the tensor serializer produces a host
+        fallback frame that still rebuilds arrays at the far end."""
+        from brpc_tpu.ici import rail
+        meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
+                         stream_id=self.remote_id, stream_seq=seq)
+        body = b""
+        ticket = None
+        if self.peer_device is not None:
+            try:
+                ticket = rail.ship(obj, self.peer_device)
+            except Exception:
+                logging.exception("stream rail ship failed; host fallback")
+        if ticket is not None:
+            meta.user_fields[M.F_TICKET] = ticket
+            meta.user_fields[M.F_SRC_DEV] = str(rail.source_device(obj).id)
+        else:
+            rail.rail_fallbacks.add(1)
+            from brpc_tpu.rpc.serialization import get_serializer
+            body, meta.tensor_header = get_serializer("tensor").encode(obj)
+        rc = Transport.instance().write_frame(self._sid, meta.encode(), body)
+        if rc != 0:
+            if ticket is not None:
+                rail.withdraw(ticket)   # atomic pop: cannot double-free
+            self._on_closed_internal()
+
     # ---- receiver side ----
 
-    def _on_data(self, data: bytes, seq: int) -> None:
+    def _on_data(self, payload, nbytes: int, seq: int) -> None:
         if seq == 0:
             # unsequenced peer (pre-stream_seq wire format): deliver in
             # arrival order, mirroring the seq==0 CLOSE fallback
             if self.handler is not None:
                 try:
-                    self.handler.on_received_messages(self, [data])
+                    self.handler.on_received_messages(self, [payload])
                 except Exception:
                     logging.exception("stream handler raised")
-            self._ack(len(data))
+            self._ack(nbytes)
             return
         with self._mu:
-            self._reorder[seq] = data
+            self._reorder[seq] = (payload, nbytes)
         self._drain()
 
     def _on_close_frame(self, seq: int) -> None:
@@ -201,9 +264,12 @@ class Stream:
             self._delivering = True
         while True:
             with self._mu:
-                ready: list[bytes] = []
+                ready: list = []
+                ready_bytes = 0
                 while self._recv_next in self._reorder:
-                    ready.append(self._reorder.pop(self._recv_next))
+                    payload, nbytes = self._reorder.pop(self._recv_next)
+                    ready.append(payload)
+                    ready_bytes += nbytes
                     self._recv_next += 1
                 close_now = (self._close_seq is not None
                              and self._recv_next >= self._close_seq)
@@ -218,7 +284,7 @@ class Stream:
                     # (_delivering would stay True forever)
                     logging.exception("stream handler raised")
             if ready:
-                self._ack(sum(len(d) for d in ready))
+                self._ack(ready_bytes)
             if close_now:
                 with self._mu:
                     self._delivering = False
@@ -307,43 +373,89 @@ class StreamRegistry:
         # meta.stream_id addresses the RECEIVER's local stream.
         s = self.get(meta.stream_id)
         if s is None:
+            # a ticket on a dead stream must still be withdrawn, or its
+            # HBM blocks sit pinned until the registry TTL fires
+            if meta.msg_type == M.MSG_STREAM_DATA and meta.user_fields \
+                    and meta.user_fields.get(M.F_TICKET):
+                from brpc_tpu.ici import rail
+                rail.withdraw(meta.user_fields[M.F_TICKET])
             return
         if s._sid is None:
             s.bind(sid)
         if meta.msg_type == M.MSG_STREAM_DATA:
-            s._on_data(body.to_bytes(), meta.stream_seq)
+            try:
+                payload, nbytes = _decode_data_frame(meta, body)
+            except Exception:
+                # an expired ticket / corrupt tensor header poisons the
+                # SEQUENCE (a message is unrecoverably lost): close
+                logging.exception("stream data frame undecodable")
+                s._on_closed_internal()
+                return
+            s._on_data(payload, nbytes, meta.stream_seq)
         elif meta.msg_type == M.MSG_STREAM_FEEDBACK:
             s._on_feedback(meta.stream_offset)
         elif meta.msg_type == M.MSG_STREAM_CLOSE:
             s._on_close_frame(meta.stream_seq)
 
 
+def _decode_data_frame(meta: M.RpcMeta, body):
+    """One DATA frame -> (payload, window_bytes).  Three wire shapes:
+    rail ticket (device arrays HBM->HBM, zero host copies), tensor
+    header (host-serialized arrays, the no-reachable-device fallback),
+    plain bytes."""
+    if meta.user_fields and meta.user_fields.get(M.F_TICKET):
+        from brpc_tpu.ici import rail
+        obj = rail.claim(meta.user_fields[M.F_TICKET])
+        arrays = obj if isinstance(obj, list) else [obj]
+        return obj, sum(a.nbytes for a in arrays)
+    if meta.tensor_header:
+        from brpc_tpu.rpc.serialization import get_serializer
+        obj = get_serializer("tensor").decode(body.to_bytes(),
+                                              meta.tensor_header)
+        arrays = obj if isinstance(obj, (list, tuple)) else [obj]
+        return obj, sum(a.nbytes for a in arrays)
+    data = body.to_bytes()
+    return data, len(data)
+
+
 def stream_create(cntl, handler: StreamHandler | Callable | None = None,
-                  max_buf_size: int = DEFAULT_BUF_SIZE) -> Stream:
+                  max_buf_size: int = DEFAULT_BUF_SIZE,
+                  device=None) -> Stream:
     """Client side: create a stream riding the next RPC issued with `cntl`
-    (reference StreamCreate, stream.cpp:772)."""
+    (reference StreamCreate, stream.cpp:772).  `device` is where THIS side
+    receives tensor payloads (advertised to the peer); the peer's receive
+    device is learned from the rail map / settings response."""
     if callable(handler) and not isinstance(handler, StreamHandler):
         handler = _FnHandler(handler)
-    s = Stream(next(_stream_ids), handler, max_buf_size)
+    s = Stream(next(_stream_ids), handler, max_buf_size, device=device)
     StreamRegistry.instance().register(s)
     cntl._stream = s
     return s
 
 
 def stream_accept(cntl, handler: StreamHandler | Callable | None = None,
-                  max_buf_size: int = DEFAULT_BUF_SIZE) -> Stream:
+                  max_buf_size: int = DEFAULT_BUF_SIZE,
+                  device=None) -> Stream:
     """Server side, inside a handler: accept the peer's stream
-    (reference StreamAccept, stream.cpp:813)."""
+    (reference StreamAccept, stream.cpp:813).  `device` is this side's
+    tensor receive device (advertised back in the settings response)."""
     meta = cntl.request_meta
     if meta is None or meta.stream_id == 0:
         raise errors.RpcError(errors.EREQUEST, "no stream attached")
     if callable(handler) and not isinstance(handler, StreamHandler):
         handler = _FnHandler(handler)
-    s = Stream(next(_stream_ids), handler, max_buf_size)
+    s = Stream(next(_stream_ids), handler, max_buf_size, device=device)
     s.set_remote(meta.stream_id)     # client's local id from the request
     sbuf = meta.user_fields.get("sbuf")
     if sbuf:
         s.peer_buf_size = int(sbuf)
+    sdev = meta.user_fields.get(M.F_SDEV)
+    if sdev:
+        # the client's advertised receive device: the process token in
+        # the advert makes this fail closed for out-of-process peers,
+        # whose rail tickets could never be claimed
+        from brpc_tpu.ici import rail as _rail
+        s.peer_device = _rail.device_from_wire(sdev)
     s.bind(cntl.peer_sid)
     StreamRegistry.instance().register(s)
     cntl._stream = s                 # response meta carries our local id

@@ -6,11 +6,22 @@ Part 2 shows the ICI rail (the use_rdma analog, rdma_endpoint.h:82): the
 server advertises a device, and an ordinary `Channel.call_sync` carrying a
 jax device tensor moves its payload over BlockPool + IciEndpoint — zero
 host copies, only the control frame touches the socket.
+
+Part 3 is the unified StreamWrite: the SAME stream.write() that carried
+bytes in part 1 carries jax device arrays HBM->HBM — tensors ride the
+rail under the socket (socket.cpp:1751-1757's RDMA slide-under), the
+socket sees only claim tickets, and host_copy_count() stays zero.
 """
 import os, sys, threading
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
+
+if os.environ.get("BRPC_FORCE_CPU"):
+    # demo on the virtual mesh even where a site hook pre-pinned a real
+    # accelerator (same escape hatch as tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 
 import brpc_tpu as brpc
@@ -23,6 +34,15 @@ class StreamEcho(brpc.Service):
         def on_msg(stream, data):
             stream.write(b"echo:" + data)
         cntl.accept_stream(on_msg)
+        return {"accepted": True}
+
+    @brpc.method(request="json", response="json")
+    def OpenTensor(self, cntl, req):
+        # tensor echo: receives device arrays on the advertised chip and
+        # writes them straight back through the same stream
+        def on_msg(stream, payload):
+            stream.write(payload)
+        cntl.accept_stream(on_msg, device=jax.devices()[-1])
         return {"accepted": True}
 
     @brpc.method(request="tensor", response="tensor")
@@ -38,8 +58,8 @@ def main(n_chunks=20):
     server.add_service(StreamEcho())
     server.start("127.0.0.1", 0)
     # generous deadline: on a tunneled real chip the first jit compile of
-    # the stage/unstage kernels takes seconds (cached afterwards)
-    ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=60000)
+    # the stage/unstage kernels takes tens of seconds (cached afterwards)
+    ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=180000)
 
     # --- part 1: byte streaming over the credit-windowed stream pipe ---
     got = []
@@ -72,6 +92,36 @@ def main(n_chunks=20):
           f"{devs[0]} with {hc} host copies "
           f"(payloads so far: {rail.rail_payloads.get_value()})")
     assert hc == 0
+
+    # --- part 3: the SAME StreamWrite carries device tensors zero-copy ---
+    tensors_back = []
+    tdone = threading.Event()
+
+    def on_tensor(stream, payload):
+        tensors_back.append(payload)
+        if len(tensors_back) == 8:
+            tdone.set()
+
+    cntl2 = brpc.Controller()
+    tstream = brpc.stream_create(cntl2, on_tensor, device=devs[0])
+    print("open tensor stream:",
+          ch.call_sync("StreamEcho", "OpenTensor", {}, serializer="json",
+                       cntl=cntl2))
+    before = rail.host_copy_count()
+    chunks = [jax.device_put(jnp.full((1 << 16,), i, dtype=jnp.float32),
+                             devs[0]) for i in range(8)]
+    for c in chunks:
+        tstream.write(c)                 # same API as the byte writes
+    assert tdone.wait(30), f"got {len(tensors_back)}/8 tensors"
+    for i, t in enumerate(tensors_back):
+        assert isinstance(t, jax.Array) and t.devices() == {devs[0]}
+        assert bool(jnp.array_equal(t, chunks[i]))
+    hc = rail.host_copy_count() - before
+    total = sum(c.nbytes for c in chunks)
+    print(f"stream: {total} tensor bytes {devs[0]}->{devs[-1]}->{devs[0]} "
+          f"through StreamWrite with {hc} host copies")
+    assert hc == 0
+    tstream.close()
 
     server.stop()
     server.join()

@@ -1,0 +1,193 @@
+"""Unified streaming: StreamWrite carries device tensors zero-copy.
+
+The north-star parity case (SURVEY header; VERDICT r3 #1): ONE stream
+abstraction whose write path transparently switches transports, the way
+the reference slides RDMA under Socket::StartWrite
+(src/brpc/socket.cpp:1751-1757, stream.cpp:274).  A Stream created from
+an RPC carries jax device arrays HBM->HBM through the rail (claim
+tickets on the socket, tensors through IciEndpoint) with
+`rail.host_copy_count()` provably unchanged; peers without a reachable
+device fall back to host tensor serialization but still deliver arrays.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.ici import rail
+
+
+D0, D1 = jax.devices()[0], jax.devices()[1]
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _arr(device, seed, n=1024):
+    return jax.device_put(
+        jnp.arange(seed, seed + n, dtype=jnp.float32), device)
+
+
+@pytest.fixture()
+def tensor_stream_server():
+    """Echo server: accepts the stream on device D1 and writes every
+    received message straight back (tensors stay tensors)."""
+    received = []
+
+    class StreamEcho(brpc.Service):
+        NAME = "TensorStreamSvc"
+
+        @brpc.method(request="json", response="json")
+        def Open(self, cntl, req):
+            def on_msg(stream, payload):
+                received.append(payload)
+                stream.write(payload)      # echo: same transport choice
+            cntl.accept_stream(on_msg, device=D1)
+            return {"ok": True}
+
+    srv = brpc.Server(brpc.ServerOptions(ici_device=D1))
+    srv.add_service(StreamEcho())
+    srv.start("127.0.0.1", 0)
+    yield srv, received
+    srv.stop()
+    srv.join()
+
+
+def test_stream_tensor_roundtrip_zero_host_copies(tensor_stream_server):
+    srv, received = tensor_stream_server
+    got_back = []
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    cntl = brpc.Controller()
+    stream = brpc.stream_create(cntl, lambda s, p: got_back.append(p),
+                                device=D0)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    assert stream.peer_device == D1      # learned from the rail map
+    before = rail.host_copy_count()
+    arrays = [_arr(D0, i) for i in range(4)]
+    for a in arrays:
+        stream.write(a)
+    assert _wait(lambda: len(received) == 4)
+    # server saw device arrays ON ITS DEVICE, in write order
+    for sent, seen in zip(arrays, received):
+        assert isinstance(seen, jax.Array)
+        assert next(iter(seen.devices())) == D1
+        np.testing.assert_array_equal(np.asarray(seen), np.asarray(sent))
+    # echo came back to the CLIENT's device (server learned D0 via F_SDEV)
+    assert _wait(lambda: len(got_back) == 4)
+    for sent, back in zip(arrays, got_back):
+        assert isinstance(back, jax.Array)
+        assert next(iter(back.devices())) == D0
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(sent))
+    # the whole bidirectional exchange never materialized host bytes
+    assert rail.host_copy_count() == before
+    stream.close()
+
+
+def test_stream_mixes_bytes_and_tensors_in_order(tensor_stream_server):
+    srv, received = tensor_stream_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    cntl = brpc.Controller()
+    stream = brpc.stream_create(cntl, None, device=D0)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    t0, t1 = _arr(D0, 100), _arr(D0, 200)
+    stream.write(b"head")
+    stream.write(t0)
+    stream.write(b"mid")
+    stream.write(t1)
+    stream.write(b"tail")
+    assert _wait(lambda: len(received) == 5)
+    assert received[0] == b"head"
+    assert isinstance(received[1], jax.Array)
+    assert received[2] == b"mid"
+    assert isinstance(received[3], jax.Array)
+    assert received[4] == b"tail"
+    np.testing.assert_array_equal(np.asarray(received[1]), np.asarray(t0))
+    np.testing.assert_array_equal(np.asarray(received[3]), np.asarray(t1))
+    stream.close()
+
+
+def test_stream_tensor_window_accounting(tensor_stream_server):
+    """Device writes consume the same credit window as byte writes: a
+    tensor bigger than the remaining window must block until feedback."""
+    srv, received = tensor_stream_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    cntl = brpc.Controller()
+    # tiny window: one 4KB tensor fills it
+    stream = brpc.stream_create(cntl, None, max_buf_size=4096, device=D0)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    big = _arr(D0, 0, n=1024)            # 4096 bytes of f32
+    stream.write(big)                     # fills the window exactly
+    with pytest.raises(errors.RpcError):
+        # second write exceeds the window and no consumer feedback can
+        # arrive faster than this short timeout ONLY if the first is
+        # unconsumed; the echo server does consume, so use a tensor
+        # larger than the whole window to guarantee the overflow
+        stream.write([_arr(D0, 0, n=1024), _arr(D0, 0, n=512)],
+                     timeout_s=0.2)
+    stream.close()
+
+
+def test_stream_tensor_host_fallback_without_device():
+    """A server that never advertised a device still receives arrays —
+    via host serialization (rail_fallbacks counts it)."""
+    received = []
+
+    class PlainSvc(brpc.Service):
+        NAME = "PlainStreamSvc"
+
+        @brpc.method(request="json", response="json")
+        def Open(self, cntl, req):
+            cntl.accept_stream(lambda s, p: received.append(p))
+            return {"ok": True}
+
+    srv = brpc.Server()                  # no ici_device
+    srv.add_service(PlainSvc())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        cntl = brpc.Controller()
+        stream = brpc.stream_create(cntl, None, device=D0)
+        ch.call_sync("PlainStreamSvc", "Open", {}, serializer="json",
+                     cntl=cntl)
+        assert stream.peer_device is None
+        before = rail.rail_fallbacks.get_value()
+        a = _arr(D0, 7)
+        stream.write(a)
+        assert _wait(lambda: len(received) == 1)
+        np.testing.assert_array_equal(np.asarray(received[0]),
+                                      np.asarray(a))
+        assert rail.rail_fallbacks.get_value() == before + 1
+        stream.close()
+
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_stream_close_releases_unclaimed_tickets(tensor_stream_server):
+    """A tensor DATA frame landing on a dead stream withdraws its ticket
+    instead of pinning HBM blocks until the TTL sweeper."""
+    srv, received = tensor_stream_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    cntl = brpc.Controller()
+    stream = brpc.stream_create(cntl, None, device=D0)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    stream.write(_arr(D0, 1))
+    assert _wait(lambda: len(received) == 1)
+    stream.close()
+    assert _wait(lambda: rail.pending_tickets() == 0, timeout=5)
