@@ -129,7 +129,7 @@ def bench_echo_scaling(thread_counts=(1, 4, 16, 64), duration_s=1.5):
 
 
 def bench_native_echo_scaling(conn_counts=(1, 2, 4, 8, 16),
-                              per_conn_frames=60_000):
+                              per_conn_frames=150_000):
     """QPS vs connection count for the native unary hot path (the
     multi-connection half of the reference's same-host chart,
     docs/cn/benchmark.md:104)."""
@@ -410,9 +410,14 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
     chunk = jnp.ones((n,), jnp.bfloat16)
     _readback_sync(chunk)
 
-    delivered = []
+    # count + most-recent only: retaining every delivered chunk would
+    # pin up to max_total_gb of HBM for the whole run
+    class _Sink:
+        count = 0
+        last = None
     def on_msg(stream, payload):
-        delivered.append(payload)
+        _Sink.last = payload
+        _Sink.count += 1
 
     class StreamSink(brpc.Service):
         @brpc.method(request="json", response="json")
@@ -434,12 +439,12 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
         # warmup: compile the stage/slice/unstage kernels
         stream.write(chunk)
         deadline = time.monotonic() + 120
-        while not delivered and time.monotonic() < deadline:
+        while _Sink.count == 0 and time.monotonic() < deadline:
             time.sleep(0.005)
-        if not delivered:
+        if _Sink.count == 0:
             return {"error": "warmup chunk never delivered"}
-        base, jitter = _readback_baseline(delivered[-1])
-        warm = len(delivered)
+        base, jitter = _readback_baseline(_Sink.last)
+        warm = _Sink.count
         copy_sum = 0.0
         moved = 0
         iters = 0
@@ -447,11 +452,11 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
         while True:
             want = warm + iters * iter_chunks
             deadline = time.monotonic() + 120
-            while len(delivered) < want and time.monotonic() < deadline:
+            while _Sink.count < want and time.monotonic() < deadline:
                 time.sleep(0.002)
-            if len(delivered) < want:
+            if _Sink.count < want:
                 issues.append(
-                    f"stream wedged: {len(delivered) - warm} of "
+                    f"stream wedged: {_Sink.count - warm} of "
                     f"{want - warm} chunks delivered after 120s")
                 break
             t0 = time.perf_counter()
@@ -459,7 +464,7 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
                 stream.write(chunk, timeout_s=120)
             # completion = delivery through the whole framework path
             wedged = False
-            while len(delivered) < want + iter_chunks:
+            while _Sink.count < want + iter_chunks:
                 if time.perf_counter() - t0 > 120:
                     wedged = True
                     break
@@ -469,9 +474,9 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
                 # crediting its bytes would publish a bogus valid number
                 issues.append(
                     f"stream wedged mid-batch: "
-                    f"{len(delivered) - want}/{iter_chunks} delivered")
+                    f"{_Sink.count - want}/{iter_chunks} delivered")
                 break
-            _readback_sync(delivered[-1])
+            _readback_sync(_Sink.last)
             wall = time.perf_counter() - t0
             copy_sum += wall - base
             moved += iter_chunks * chunk.nbytes
@@ -492,7 +497,7 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
         if issues:
             gbps = None
         return {"gbps": gbps, "chunk_mb": chunk_mb,
-                "chunks": len(delivered) - warm, "iterations": iters,
+                "chunks": _Sink.count - warm, "iterations": iters,
                 "moved_gb": round(moved / (1 << 30), 2),
                 "copy_s": round(copy_sum, 4),
                 "host_copies": host_copies,

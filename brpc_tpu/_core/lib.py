@@ -267,8 +267,11 @@ _init_lock = threading.Lock()
 _initialized = False
 
 
-def core_init(num_workers: int = 0, num_dispatchers: int = 2) -> None:
-    """Start the native executor, dispatchers and timer thread (idempotent)."""
+def core_init(num_workers: int = 0, num_dispatchers: int = 0) -> None:
+    """Start the native executor, dispatchers and timer thread (idempotent).
+    num_dispatchers=0 lets the native core size the epoll pool by CPU
+    count (1 on small hosts — extra epoll threads only time-slice and
+    inflate the p99 tail by whole scheduler quanta)."""
     global _initialized
     with _init_lock:
         if not _initialized:

@@ -41,12 +41,19 @@ int32_t bench_echo_handler(SocketId, butil::IOBuf* body,
 }
 
 void bench_send_one(SocketId sid, BenchState* st) {
-  butil::IOBuf frame;
-  butil::IOBuf body;
   static const char kPayload[4096] = {0};
-  body.append(kPayload, st->payload_len);
-  PackRequestFrame(&frame, (uint64_t)butil::monotonic_time_us(), 0, "BenchEcho",
-                   9, "Echo", 4, 0, 0, nullptr, 0, std::move(body));
+  const uint64_t cid = (uint64_t)butil::monotonic_time_us();
+  // Inside this socket's dispatch drain (pipelined next-send from the
+  // response callback): stage the whole frame into the write batch.
+  butil::IOBuf* batch = Socket::CurrentBatchFor(sid, st->payload_len + 96);
+  if (batch != nullptr) {
+    PackRequestFrameFlat(batch, cid, 0, "BenchEcho", 9, "Echo", 4, 0, 0,
+                         nullptr, 0, kPayload, st->payload_len);
+    return;
+  }
+  butil::IOBuf frame;
+  PackRequestFrameFlat(&frame, cid, 0, "BenchEcho", 9, "Echo", 4, 0, 0,
+                       nullptr, 0, kPayload, st->payload_len);
   Socket* s = Socket::Address(sid);
   if (s != nullptr) {
     s->Write(std::move(frame));

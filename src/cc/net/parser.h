@@ -100,6 +100,16 @@ struct ParseState {
 // consumed bytes from `in`; PARSE_NEED_MORE leaves `in` intact.
 ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out);
 
+// In-place TRPC fast path for the dispatch loop (zero-copy meta view).
+// On PARSE_OK with *viewed=true: header+meta are consumed, the meta view
+// is valid while *guard lives, and exactly *body_size bytes of body sit
+// at the buffer front.  PARSE_OK with *viewed=false: nothing consumed —
+// caller must use the generic parse_message (split frame / other
+// protocol).  PARSE_NEED_MORE / PARSE_ERROR as usual.
+ParseResult parse_trpc_view(butil::IOBuf* in, const char** meta,
+                            size_t* meta_len, uint64_t* body_size,
+                            butil::IOBuf* guard, bool* viewed);
+
 // Serialize a TRPC frame header.
 void make_trpc_header(char out[16], uint32_t meta_size, uint64_t body_size);
 

@@ -94,6 +94,15 @@ void PackRequestFrame(butil::IOBuf* out, uint64_t cid, uint16_t attempt,
                       const char* content_type, size_t content_type_len,
                       butil::IOBuf&& body);
 
+// Same, but the body is raw bytes staged through the one appender — for
+// small payloads this skips the body IOBuf's block-ref round entirely.
+void PackRequestFrameFlat(butil::IOBuf* out, uint64_t cid, uint16_t attempt,
+                          const char* service, size_t service_len,
+                          const char* method, size_t method_len,
+                          uint32_t timeout_ms, uint8_t compress,
+                          const char* content_type, size_t content_type_len,
+                          const void* body, size_t body_len);
+
 // ---- method registry ----
 
 // Pure-native handler: fills *resp_body, returns an error code (0 = ok).
