@@ -221,6 +221,15 @@ _sigs = {
                                        ctypes.POINTER(ctypes.c_double),
                                        ctypes.POINTER(ctypes.c_double),
                                        ctypes.POINTER(ctypes.c_double)]),
+    # native client pump against an EXISTING server (Python handlers):
+    # port, service, method, conns, inflight, total, payload_len, out x3
+    "brpc_bench_pump": (ctypes.c_int, [ctypes.c_int, ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_int, ctypes.c_uint64,
+                                       ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_double),
+                                       ctypes.POINTER(ctypes.c_double),
+                                       ctypes.POINTER(ctypes.c_double)]),
     # fiber / butex (coroutine M:N runtime, src/cc/bthread/fiber.h)
     "brpc_fiber_demo_start": (ctypes.c_void_p, [ctypes.c_int]),
     "brpc_fiber_demo_blocked": (ctypes.c_int, [ctypes.c_void_p]),

@@ -139,7 +139,10 @@ class CircuitBreaker:
             self.mark_as_broken(ep)
 
     def _hold_s(self, ep: EndPoint) -> float:
-        n = self._isolation_count.get(ep, 1)
+        # cap the exponent BEFORE exponentiating: a flapping endpoint can
+        # accumulate thousands of isolations and 2**n overflows float
+        # (OverflowError on the response thread under sustained timeouts)
+        n = min(self._isolation_count.get(ep, 1), 32)
         return min(self.MAX_HOLD_S, self.BASE_HOLD_S * (2 ** (n - 1)))
 
     def mark_as_broken(self, ep: EndPoint) -> None:

@@ -81,6 +81,7 @@ class Controller:
         # runs when the handler calls done->Run(), not when it returns —
         # baidu_rpc_protocol.cpp:398 passes done into svc->CallMethod)
         self._server_done: Optional[Callable[[Any], None]] = None
+        self._done_factory: Optional[Callable[[], Callable]] = None
         self._deferred = False
 
     def accept_stream(self, handler=None, max_buf_size: int = 2 * 1024 * 1024,
@@ -100,11 +101,25 @@ class Controller:
         which is how 10k concurrent in-flight RPCs are served by a small
         worker pool (reference: brpc's done Closure + bthread parking;
         SURVEY.md §2.2, VERDICT r2 task 3)."""
-        if not self.is_server_side or self._server_done is None:
-            raise RuntimeError("defer() is only valid inside a server "
-                               "handler invocation")
-        self._deferred = True
-        return self._server_done
+        with self._lock:
+            if not self.is_server_side or (self._server_done is None
+                                           and self._done_factory is None):
+                # also the LATE-defer case: inline completion consumed
+                # the factory, so a handler that already responded and
+                # defers afterwards fails loudly instead of silently
+                # double-sending
+                raise RuntimeError("defer() is only valid inside a server "
+                                   "handler invocation")
+            self._deferred = True
+            if self._server_done is None:
+                # the done closure (once-guard lock included) is built ON
+                # DEMAND: the common non-deferred path completes inline
+                # without allocating it per request.  One-shot: the
+                # factory is consumed under the lock so concurrent
+                # defer() calls share one closure/once-guard
+                factory, self._done_factory = self._done_factory, None
+                self._server_done = factory()
+            return self._server_done
 
     # ---- result api (mirrors Controller::Failed/ErrorCode/ErrorText) ----
 

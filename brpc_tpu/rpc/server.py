@@ -14,7 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-from brpc_tpu import errors, rpcz
+from brpc_tpu import errors, flags as _flags, rpcz
 from brpc_tpu.rpc import rpc_dump as _rpc_dump  # registers rpc_dump_* flags
 from brpc_tpu.bvar import Adder, LatencyRecorder, PassiveStatus
 from brpc_tpu.rpc import meta as M
@@ -567,8 +567,7 @@ class Server:
         # sampled traffic capture for rpc_replay (rpc_dump.h:69, §5.5);
         # the body copy (and the fast path's meta re-encode) happen only
         # when dumping is on
-        from brpc_tpu import flags
-        if flags.get_flag("rpc_dump"):
+        if _flags.get_flag("rpc_dump"):
             from brpc_tpu.rpc.rpc_dump import RpcDumper
             from brpc_tpu.rpc.serialization import as_bytes
             RpcDumper.instance().sample(
@@ -582,7 +581,8 @@ class Server:
             # count the QUEUED request so graceful join() waits for it
             with self._inflight_mu:
                 self._inflight += 1
-                self._inflight_zero.clear()
+                if self._inflight == 1:
+                    self._inflight_zero.clear()
             pool.submit(self._process_tagged, sid, meta, body)
         else:
             self._process_request(sid, meta, body)
@@ -658,7 +658,8 @@ class Server:
 
         with self._inflight_mu:
             self._inflight += 1
-            self._inflight_zero.clear()
+            if self._inflight == 1:
+                self._inflight_zero.clear()
 
         span = rpcz.new_span("server", meta.service, meta.method,
                              trace_id=meta.trace_id,
@@ -711,24 +712,15 @@ class Server:
                                    start, rail_src, None, exc=e)
             return
         # ---- handler phase ----
-        # `done` runs the response path exactly once; a handler that calls
-        # cntl.defer() parks the RPC as this closure (data, not a thread)
-        # and any thread releases it later — the reference's done Closure
-        # (svc->CallMethod(..., done) baidu_rpc_protocol.cpp:398).
-        fired = [False]
-        fired_mu = threading.Lock()
-
-        def done(response=None):
-            with fired_mu:
-                if fired[0]:
-                    raise RuntimeError(
-                        f"done() called twice for {meta.service}.{meta.method}"
-                        f" cid={meta.correlation_id}")
-                fired[0] = True
-            self._complete_request(sid, meta, span, cntl, spec, status,
-                                   start, rail_src, response)
-
-        cntl._server_done = done
+        # The done closure runs the response path exactly once; a handler
+        # that calls cntl.defer() parks the RPC as that closure (data,
+        # not a thread) and any thread releases it later — the
+        # reference's done Closure (svc->CallMethod(..., done),
+        # baidu_rpc_protocol.cpp:398).  It is built LAZILY by defer():
+        # the common synchronous path completes inline below without
+        # paying a closure + once-guard lock per request.
+        cntl._done_factory = lambda: self._make_server_done(
+            sid, meta, span, cntl, spec, status, start, rail_src)
         rpcz.set_current_span(span)
         if self._session_pool is not None:
             cntl.session_data = self._session_pool.borrow()
@@ -744,12 +736,8 @@ class Server:
                 import traceback
                 traceback.print_exc()
                 return
-            with fired_mu:
-                already = fired[0]
-                fired[0] = True
-            if not already:
-                self._complete_request(sid, meta, span, cntl, spec, status,
-                                       start, rail_src, None, exc=e)
+            self._complete_request(sid, meta, span, cntl, spec, status,
+                                   start, rail_src, None, exc=e)
             return
         finally:
             rpcz.set_current_span(None)
@@ -760,7 +748,28 @@ class Server:
                 cntl.session_data = None
         if cntl._deferred:
             return  # the parked done() closure completes the RPC later
-        done(response)
+        self._complete_request(sid, meta, span, cntl, spec, status,
+                               start, rail_src, response)
+
+    def _make_server_done(self, sid, meta, span, cntl, spec, status,
+                          start, rail_src):
+        """One-shot done(response) closure for DEFERRED completion —
+        built only when a handler actually calls cntl.defer()."""
+        fired = [False]
+        fired_mu = threading.Lock()
+
+        def done(response=None):
+            with fired_mu:
+                if fired[0]:
+                    raise RuntimeError(
+                        f"done() called twice for "
+                        f"{meta.service}.{meta.method}"
+                        f" cid={meta.correlation_id}")
+                fired[0] = True
+            self._complete_request(sid, meta, span, cntl, spec, status,
+                                   start, rail_src, response)
+
+        return done
 
     def _complete_request(self, sid: int, meta: M.RpcMeta, span, cntl,
                           spec, status, start: float, rail_src,
@@ -769,6 +778,11 @@ class Server:
         baidu_rpc_protocol.cpp:187).  Runs exactly once per accepted
         request — inline for plain handlers, from done() for deferred
         ones."""
+        # completion consumes the lazy done factory: a handler that
+        # already responded and calls defer() afterwards now fails
+        # loudly in defer() instead of minting a fresh once-guard and
+        # double-sending
+        cntl._done_factory = None
         error_code = 0
         try:
             if exc is not None:
@@ -947,7 +961,8 @@ class Server:
             raise errors.RpcError(errors.ELIMIT)
         with self._inflight_mu:
             self._inflight += 1
-            self._inflight_zero.clear()
+            if self._inflight == 1:
+                self._inflight_zero.clear()
         start = time.monotonic()
         error_code = 0
         try:
@@ -1060,7 +1075,8 @@ class Server:
             return b"", errors.ELIMIT, "method concurrency limit"
         with self._inflight_mu:
             self._inflight += 1
-            self._inflight_zero.clear()
+            if self._inflight == 1:
+                self._inflight_zero.clear()
         span = rpcz.new_span("server", key[0], method_name)
         span.annotate("protocol=grpc")
         start = time.monotonic()

@@ -224,6 +224,33 @@ void append_pybuffer(butil::IOBuf* b, Py_buffer* view) {
   b->append_user_data(h->view.buf, (size_t)h->view.len, release_pybuf, h);
 }
 
+// Write one framed buffer to a socket, deciding whether to yield the
+// GIL: Socket::Write is wait-free-producer + nonblocking inline drain,
+// so a SMALL frame onto a SMALL backlog finishes in microseconds and
+// dropping the GIL around it costs a full handoff cycle per call under
+// load (measured ~17us/req at 64 concurrent on 1 core).  Yield when this
+// frame is big OR the socket's backlog is — winning _write_busy there
+// can inline-drain the whole multi-thread backlog, and that must not run
+// with the GIL held.
+static int write_frame_gil_aware(unsigned long long sid,
+                                 butil::IOBuf&& frame) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  const bool yield_gil = frame.size() > 64 * 1024 ||
+                         s->pending_write_bytes() > 256 * 1024;
+  int rc;
+  if (yield_gil) {
+    Py_BEGIN_ALLOW_THREADS
+    rc = s->Write(std::move(frame));
+    s->Dereference();
+    Py_END_ALLOW_THREADS
+  } else {
+    rc = s->Write(std::move(frame));
+    s->Dereference();
+  }
+  return rc;
+}
+
 PyObject* py_send_request(PyObject*, PyObject* args) {
   unsigned long long sid, cid;
   unsigned short attempt;
@@ -242,15 +269,7 @@ PyObject* py_send_request(PyObject*, PyObject* args) {
   brpc::PackRequestFrame(&frame, cid, attempt, service, (size_t)service_len,
                          method, (size_t)method_len, timeout_ms, compress,
                          content_type, (size_t)ct_len, std::move(b));
-  int rc = -1;
-  Py_BEGIN_ALLOW_THREADS
-  brpc::Socket* s = brpc::Socket::Address(sid);
-  if (s != nullptr) {
-    rc = s->Write(std::move(frame));
-    s->Dereference();
-  }
-  Py_END_ALLOW_THREADS
-  return PyLong_FromLong(rc);
+  return PyLong_FromLong(write_frame_gil_aware(sid, std::move(frame)));
 }
 
 PyObject* py_send_response(PyObject*, PyObject* args) {
@@ -269,15 +288,7 @@ PyObject* py_send_response(PyObject*, PyObject* args) {
   brpc::PackResponseFrame(&frame, cid, attempt, error_code, error_text,
                           (size_t)et_len, content_type, (size_t)ct_len,
                           std::move(b));
-  int rc = -1;
-  Py_BEGIN_ALLOW_THREADS
-  brpc::Socket* s = brpc::Socket::Address(sid);
-  if (s != nullptr) {
-    rc = s->Write(std::move(frame));
-    s->Dereference();
-  }
-  Py_END_ALLOW_THREADS
-  return PyLong_FromLong(rc);
+  return PyLong_FromLong(write_frame_gil_aware(sid, std::move(frame)));
 }
 
 PyObject* py_set_request_handler(PyObject*, PyObject* arg) {

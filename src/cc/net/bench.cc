@@ -28,6 +28,8 @@ struct BenchState {
   std::atomic<uint64_t> lat_idx{0};
   uint64_t total = 0;
   int payload_len = 0;
+  std::string service = "BenchEcho";
+  std::string method = "Echo";
   std::vector<uint32_t> lat_us;  // preallocated, atomically indexed
   std::mutex mu;
   std::condition_variable cv;
@@ -47,13 +49,17 @@ void bench_send_one(SocketId sid, BenchState* st) {
   // response callback): stage the whole frame into the write batch.
   butil::IOBuf* batch = Socket::CurrentBatchFor(sid, st->payload_len + 96);
   if (batch != nullptr) {
-    PackRequestFrameFlat(batch, cid, 0, "BenchEcho", 9, "Echo", 4, 0, 0,
-                         nullptr, 0, kPayload, st->payload_len);
+    PackRequestFrameFlat(batch, cid, 0, st->service.data(),
+                         st->service.size(), st->method.data(),
+                         st->method.size(), 0, 0, nullptr, 0, kPayload,
+                         st->payload_len);
     return;
   }
   butil::IOBuf frame;
-  PackRequestFrameFlat(&frame, cid, 0, "BenchEcho", 9, "Echo", 4, 0, 0,
-                       nullptr, 0, kPayload, st->payload_len);
+  PackRequestFrameFlat(&frame, cid, 0, st->service.data(),
+                       st->service.size(), st->method.data(),
+                       st->method.size(), 0, 0, nullptr, 0, kPayload,
+                       st->payload_len);
   Socket* s = Socket::Address(sid);
   if (s != nullptr) {
     s->Write(std::move(frame));
@@ -91,37 +97,25 @@ void bench_noop_failed(SocketId, int, void*) {}
 
 extern "C" {
 
-// Returns 0 on success.  inline_run selects dispatcher-inline execution of
-// the echo handler (the reference's "last message inline" discipline) vs
-// one executor task per message.
-int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
-                    int inline_run, double* qps_out, double* p50_us,
-                    double* p99_us) {
-  using namespace brpc;
-  if (conns <= 0 || inflight <= 0 || total == 0 || payload_len < 0 ||
-      payload_len > 4096) {
-    return -1;
-  }
-  MethodRegistry::global()->Register("BenchEcho", "Echo", bench_echo_handler,
-                                     nullptr, inline_run != 0);
-  // Heap-allocated: on the timeout path, in-flight responses can still hit
-  // bench_on_response on dispatcher threads after we return (SetFailed does
-  // not synchronize with callbacks already executing), so the state must
-  // outlive this frame — it is intentionally leaked in that case.
+namespace {
+using namespace brpc;
+
+// Client pump core shared by the self-contained echo bench and the
+// external-server pump: `conns` pipelined connections to 127.0.0.1:port,
+// `inflight` frames outstanding each, p50/p99 from send-timestamp cids.
+int run_pump(int port, const char* service, const char* method, int conns,
+             int inflight, uint64_t total, int payload_len, double* qps_out,
+             double* p50_us, double* p99_us) {
+  // Heap-allocated: on the timeout path, in-flight responses can still
+  // hit bench_on_response on dispatcher threads after we return, so the
+  // state must outlive this frame — it is intentionally leaked then.
   auto* stp = new BenchState;
   BenchState& st = *stp;
   st.total = total;
   st.payload_len = payload_len;
+  st.service = service;
+  st.method = method;
   st.lat_us.assign(std::min<uint64_t>(total, 2'000'000), 0);
-
-  SocketOptions server_opts;
-  server_opts.enable_rpc_dispatch = true;
-  SocketId listener = INVALID_SOCKET_ID;
-  int port = 0;
-  if (Listen("127.0.0.1", 0, server_opts, &listener, &port) != 0) {
-    delete stp;
-    return -2;
-  }
 
   std::vector<SocketId> clients;
   for (int i = 0; i < conns; ++i) {
@@ -133,7 +127,6 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
     SocketId cid = INVALID_SOCKET_ID;
     if (Connect("127.0.0.1", port, copts, &cid) != 0) {
       for (SocketId c : clients) Socket::SetFailed(c, 0);
-      Socket::SetFailed(listener, 0);
       delete stp;
       return -3;
     }
@@ -142,8 +135,8 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
 
   const int64_t t0 = butil::monotonic_time_us();
   // seed the pipeline: `inflight` outstanding frames per connection, each
-  // claiming a ticket exactly like the response path (responses may already
-  // be arriving while we seed)
+  // claiming a ticket exactly like the response path (responses may
+  // already be arriving while we seed)
   const uint64_t seed_target =
       std::min<uint64_t>((uint64_t)conns * (uint64_t)inflight, total);
   for (uint64_t i = 0; i < seed_target; ++i) {
@@ -161,8 +154,6 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
   const int64_t t1 = butil::monotonic_time_us();
 
   for (SocketId cid : clients) Socket::SetFailed(cid, 0);
-  Socket::SetFailed(listener, 0);
-  MethodRegistry::global()->Unregister("BenchEcho", "Echo");
 
   const uint64_t completed = st.done.load();
   const double wall_s = (t1 - t0) / 1e6;
@@ -183,6 +174,50 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
   }
   // Timed out: dispatcher threads may still reference *stp — leak it.
   return -4;
+}
+
+}  // namespace
+
+// Returns 0 on success.  inline_run selects dispatcher-inline execution of
+// the echo handler (the reference's "last message inline" discipline) vs
+// one executor task per message.
+int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
+                    int inline_run, double* qps_out, double* p50_us,
+                    double* p99_us) {
+  using namespace brpc;
+  if (conns <= 0 || inflight <= 0 || total == 0 || payload_len < 0 ||
+      payload_len > 4096) {
+    return -1;
+  }
+  MethodRegistry::global()->Register("BenchEcho", "Echo", bench_echo_handler,
+                                     nullptr, inline_run != 0);
+  SocketOptions server_opts;
+  server_opts.enable_rpc_dispatch = true;
+  SocketId listener = INVALID_SOCKET_ID;
+  int port = 0;
+  if (Listen("127.0.0.1", 0, server_opts, &listener, &port) != 0) {
+    return -2;
+  }
+  const int rc = run_pump(port, "BenchEcho", "Echo", conns, inflight, total,
+                          payload_len, qps_out, p50_us, p99_us);
+  Socket::SetFailed(listener, 0);
+  MethodRegistry::global()->Unregister("BenchEcho", "Echo");
+  return rc;
+}
+
+// Pump an EXISTING server (e.g. a Python-handler service on `port`) with
+// the same native client: measures the SERVER's dispatch + handler path
+// with zero client-side Python cost — the reference's C++-client
+// methodology (docs/cn/benchmark.md) pointed at user handlers.
+int brpc_bench_pump(int port, const char* service, const char* method,
+                    int conns, int inflight, uint64_t total, int payload_len,
+                    double* qps_out, double* p50_us, double* p99_us) {
+  if (port <= 0 || service == nullptr || method == nullptr || conns <= 0 ||
+      inflight <= 0 || total == 0 || payload_len < 0 || payload_len > 4096) {
+    return -1;
+  }
+  return run_pump(port, service, method, conns, inflight, total, payload_len,
+                  qps_out, p50_us, p99_us);
 }
 
 }  // extern "C"

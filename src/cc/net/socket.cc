@@ -488,9 +488,9 @@ void Socket::DispatchMessages() {
       size_t mlen = 0;
       uint64_t blen = 0;
       bool viewed = false;
-      butil::IOBuf guard;
-      const ParseResult r =
-          parse_trpc_view(&_read_buf, &mview, &mlen, &blen, &guard, &viewed);
+      butil::IOBuf meta_guard;  // NOT the write-batch RAII guard above
+      const ParseResult r = parse_trpc_view(&_read_buf, &mview, &mlen, &blen,
+                                            &meta_guard, &viewed);
       if (r == PARSE_NEED_MORE) return;
       if (r == PARSE_ERROR) {
         BLOG(WARNING, "parse error on socket %llu, closing",
@@ -510,7 +510,7 @@ void Socket::DispatchMessages() {
         // Python path): materialize the meta and take generic delivery
         msg.kind = MSG_TRPC;
         msg.meta.assign(mview, mlen);
-        guard.clear();
+        meta_guard.clear();
         goto generic_delivery;
       }
       // viewed==false: split frame or protocol re-detection — fall

@@ -221,3 +221,20 @@ class TestKetamaLB:
                          for c in range(4000))
         assert len(counts) == 4
         assert min(counts.values()) > 4000 / 4 * 0.5   # no starved server
+
+
+def test_circuit_breaker_hold_never_overflows():
+    """A flapping endpoint accumulating thousands of isolations must not
+    overflow the exponential hold (2**n blew past float range and raised
+    OverflowError ON THE RESPONSE THREAD, poisoning every completion —
+    the round-3 'negative thread scaling' was largely this bug)."""
+    from brpc_tpu.butil.endpoint import EndPoint
+    from brpc_tpu.policy.circuit_breaker import CircuitBreaker
+
+    cb = CircuitBreaker()
+    ep = EndPoint("127.0.0.1", 65001)
+    with cb._mu:
+        cb._isolation_count[ep] = 5000
+    assert cb._hold_s(ep) == cb.MAX_HOLD_S
+    # and the mark path goes through without raising
+    cb.mark_as_broken(ep)
