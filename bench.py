@@ -314,13 +314,17 @@ def bench_tensor_pipe(chunk_mb=64, iter_chunks=80, max_total_gb=96):
     ts = TensorStream(dev, consumer=consume,
                       window_bytes=(iter_chunks + 2) * chunk.nbytes)
     stats0 = link_stats()
-    # warmup: drainer thread + the SAME 16-chunk batched copy program the
+    # batch size bounded by per-dispatch live memory (in + out <= 512MB
+    # total): 16x64MB batches kept 1GB live per dispatch and the
+    # allocator churn depressed the measured bandwidth (r3 weak #4)
+    bs = max(1, min(16, (256 << 20) // chunk.nbytes))
+    # warmup: drainer thread + the SAME bs-chunk batched copy program the
     # timed loop uses (jit caches per arity — r3's first cut warmed an
-    # 8-arity program and then paid an arity-16 compile INSIDE the timed
-    # region, which is seconds over the tunnel)
-    ts.write_many([chunk] * 16)
+    # 8-arity program and then paid a different-arity compile INSIDE the
+    # timed region, which is seconds over the tunnel)
+    ts.write_many([chunk] * bs)
     deadline = time.monotonic() + 60
-    while consume.n < 16 and time.monotonic() < deadline:
+    while consume.n < bs and time.monotonic() < deadline:
         time.sleep(0.005)    # deterministic: wait until warmup delivered
     # the transfer must not alias the source — this is the "really moved
     # bytes" proof the r1 bench lacked.  Two proofs, strongest available:
@@ -379,14 +383,14 @@ def bench_tensor_pipe(chunk_mb=64, iter_chunks=80, max_total_gb=96):
                 f"{want - delivered_before} chunks delivered after 120s")
             break
         t0 = time.perf_counter()
-        # batched dispatch: 16 chunks per pre-compiled multi-copy program
-        # (endpoint.send_batch) — one Python->PJRT call per 1GB.  The
+        # batched dispatch: bs chunks per pre-compiled multi-copy program
+        # (endpoint.send_batch) — one Python->PJRT call per <=256MB.  The
         # timed region ends when the LAST transfer provably completed
         # (scalar readback of the final destination buffer); consumer
         # delivery overlaps on the drainer thread.
         last = None
-        for i in range(0, iter_chunks, 16):
-            last = ts.write_many([chunk] * min(16, iter_chunks - i))[-1]
+        for i in range(0, iter_chunks, bs):
+            last = ts.write_many([chunk] * min(bs, iter_chunks - i))[-1]
         _readback_sync(last)
         wall = time.perf_counter() - t0
         copy_sum += wall - base
@@ -411,6 +415,12 @@ def bench_tensor_pipe(chunk_mb=64, iter_chunks=80, max_total_gb=96):
     if issues:
         gbps = None
     return {"gbps": gbps, "chunk_mb": chunk_mb,
+            # hbm_stream counts READ+WRITE traffic; each pipe chunk also
+            # reads the source and writes the destination, so the
+            # traffic-basis number (2x moved bytes) is the one comparable
+            # to hbm_stream.  Same-run measurement: 584 vs 715 GB/s = 82%
+            # of raw HBM through the full framework pipe.
+            "hbm_traffic_gbps": round(gbps * 2, 3) if gbps else None,
             "chunks": consume.n - delivered_before,   # timed deliveries
             "iterations": iters, "moved_gb": round(moved / (1 << 30), 2),
             "wall_s": round(wall_sum, 4),
@@ -542,7 +552,7 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
         server.join()
 
 
-def bench_ici_ladder():
+def bench_ici_ladder(sizes=(64, 4096, 65536, 1 << 20, 1 << 24, 1 << 26)):
     """rdma_performance 64B-64MB ladder over the REAL endpoint path, now
     through the pre-compiled batched transfer program (send_batch: k copy
     HLOs in ONE XLA program, one dispatch) instead of k Python dispatches.
@@ -564,12 +574,17 @@ def bench_ici_ladder():
 
     dev = jax.devices()[0]
     out = {}
-    sizes = (64, 4096, 65536, 1 << 20, 1 << 24, 1 << 26)
     for size in sizes:
         x = jnp.ones((size,), jnp.uint8)     # exactly `size` bytes
         # chunks per dispatch: big enough to amortize the program call,
-        # small enough to keep compile size sane and batches <= 512MB
-        k = max(8, min(128, (256 << 20) // size))
+        # small enough to keep per-dispatch live memory <= 256MB in +
+        # 256MB out AND the multi-copy program's arity compile-cheap —
+        # arity-128 programs took ~a minute each to compile over the
+        # tunnel and the small rungs are overhead-dominated either way.
+        # NO floor above the memory cap: the old k floor of 8 made the
+        # 64MB rung dispatch 512MB batches (1GB live each), and the
+        # allocator churn showed up as the r3 "ladder dip".
+        k = max(1, min(32, (256 << 20) // size))
         # the window bounds destination HBM held by unobserved transfers
         # (the drainer frees in bulk, one tunnel RTT per cycle); 6GB keeps
         # a comfortable margin on a 16GB chip while letting rungs push
@@ -622,6 +637,8 @@ def bench_ici_ladder():
 
         m = 1
         rung = None
+        escalations = 0
+        rung_deadline = time.monotonic() + 45
         while True:
             copy_sum, iters = run_trial(m)
             if copy_sum is None:
@@ -632,31 +649,61 @@ def bench_ici_ladder():
                 break
             floor = max(0.004, 4 * jitter * math.sqrt(iters))
             if copy_sum >= floor:
-                # best-of-3 at the accepted size: a single trial can eat
-                # a one-off allocator or tunnel hiccup and publish a
-                # misleading dip (the r3 full-run 64MB rung resolved from
-                # ONE dispatch and broke monotonicity); the minimum copy
-                # time is the standard bandwidth estimator, and the
-                # confidence floor still applies to the kept trial
-                for _ in range(2):
-                    c2, _ = run_trial(m)
-                    if c2 is not None and c2 >= floor and c2 < copy_sum:
-                        copy_sum = c2
+                # Re-measure at the accepted size.  A retrial BELOW the
+                # floor is evidence the first trial only cleared it via a
+                # one-off jitter spike (tunnel hiccup, allocator stall) —
+                # the r4 64MB "dip" published 66 GB/s off exactly such a
+                # spike while fresh trials measured 515.  In that case
+                # the honest response is MORE TRAFFIC (double m), never
+                # keeping the inflated number; when all trials clear the
+                # floor, the minimum is the standard bandwidth estimator.
+                # Escalation is BOUNDED (2 doublings + the rung budget)
+                # so one noisy rung can't eat the whole bench window;
+                # confirmation trials run only on the >=16MB rungs, where
+                # a spike-induced dip would break the monotonic gate (the
+                # sub-MB rungs are overhead-dominated and cheap to trust).
+                trials = [copy_sum]
+                spiked = False
+                if size >= (1 << 24):
+                    for _ in range(2):
+                        if time.monotonic() > rung_deadline:
+                            break
+                        c2, _ = run_trial(m)
+                        if c2 is None:
+                            continue
+                        if c2 < floor:
+                            spiked = True
+                        trials.append(c2)
+                if spiked and m < m_cap and escalations < 2 \
+                        and time.monotonic() < rung_deadline:
+                    escalations += 1
+                    m = min(m_cap, m * 2)
+                    continue
+                note = None
+                copy_sum = min(trials)
+                if copy_sum < floor:
+                    # escalation exhausted with sub-floor trials: the
+                    # MEDIAN is the low-bias estimator here (min would
+                    # overstate bandwidth by up to the jitter)
+                    copy_sum = sorted(trials)[len(trials) // 2]
+                    note = "jitter-limited: median of trials"
                 gbps, issues = _gated(m * k * size, max(copy_sum, 1e-9))
                 rung = {"lat_us": round(copy_sum / (m * k) * 1e6, 2),
                         "gbps": gbps, "batch": k, "dispatches": m,
                         "iterations": iters,
+                        **({"note": note} if note else {}),
                         **({"invalid": issues} if issues else {})}
                 if issues:
                     rung["lat_us"] = None
                 break
-            if m >= m_cap:
+            if m >= m_cap or time.monotonic() > rung_deadline:
                 rung = {"lat_us": None, "gbps": None, "batch": k,
                         "dispatches": m,
                         "invalid": [
                             f"copy phase {copy_sum * 1e3:.1f}ms below "
                             f"confidence floor {floor * 1e3:.1f}ms at "
-                            f"max dispatches {m}"]}
+                            f"dispatches {m} "
+                            f"({'rung budget' if m < m_cap else 'cap'})"]}
                 break
             m = min(m_cap, m * 2)
         ep.close()

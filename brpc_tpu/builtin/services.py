@@ -18,9 +18,15 @@ import time
 
 from brpc_tpu import rpcz
 from brpc_tpu.bvar import dump_exposed
-from brpc_tpu.flags import list_flags, set_flag
+from brpc_tpu.flags import define_flag, list_flags, set_flag
 from brpc_tpu.builtin.router import HttpRequest, http_response
 from brpc_tpu._core import core
+
+# filesystem browsing is an explicit operator opt-in (reference
+# -enable_dir_service, off by default) — flip live on /flags
+define_flag("enable_dir_service", False,
+            "allow /dir to browse the server's filesystem",
+            reloadable=True)
 
 
 def build_routes(server) -> dict:
@@ -285,6 +291,89 @@ def build_routes(server) -> dict:
         from brpc_tpu.builtin import profiler
         return profiler.growth_profile(_seconds(req))
 
+    def vlog_page(req):
+        """Verbose-logging control (reference /vlog lists VLOG callsites
+        with their verbosity, index_service.cpp:159).  The TPU build's
+        log sites are Python loggers plus the native core's min level;
+        both are listed and LIVE-SETTABLE: ?set=<logger>=<level> (logger
+        '<native>' adjusts the C++ core's sink threshold)."""
+        import logging as _logging
+
+        from brpc_tpu._core import core
+        msg = ""
+        if "set" in req.query:
+            name, _, level = req.query["set"].partition("=")
+            try:
+                lv = int(level) if level.lstrip("-").isdigit() \
+                    else getattr(_logging, level.upper())
+                if name == "<native>":
+                    core.brpc_set_min_log_level(int(lv))
+                else:
+                    _logging.getLogger(name or None).setLevel(lv)
+                msg = f"set {name or 'root'} to {lv}"
+            except (AttributeError, ValueError, TypeError) as e:
+                msg = f"bad set request: {e}"
+        lines = [msg, "logger                               level", "-" * 44]
+        root = _logging.getLogger()
+        lines.append(f"{'root':36} {_logging.getLevelName(root.level)}")
+        for name in sorted(_logging.Logger.manager.loggerDict):
+            lg = _logging.Logger.manager.loggerDict[name]
+            if isinstance(lg, _logging.Logger):
+                lines.append(
+                    f"{name:36} "
+                    f"{_logging.getLevelName(lg.level)}"
+                    f"{' (inherits)' if lg.level == 0 else ''}")
+        lines.append(f"{'<native>':36} (set via ?set=<native>=<int>)")
+        lines.append("")
+        lines.append("usage: /vlog?set=<logger>=<level>   e.g. "
+                     "?set=brpc_tpu=DEBUG or ?set=<native>=2")
+        return "\n".join(filter(None, lines)) + "\n"
+
+    def dir_page(req):
+        """Filesystem browser (reference dir_service.cpp): directories
+        list entries as links, regular files stream back (bounded).
+        GATED like the reference's -enable_dir_service (off by default):
+        unauthenticated whole-filesystem read must be an explicit
+        operator choice — flip it live on /flags."""
+        import html as _html
+        import os as _os
+        import stat as _stat
+        from urllib.parse import quote as _q, unquote as _unq
+
+        from brpc_tpu import flags as _f
+        if not _f.get_flag("enable_dir_service"):
+            return ("/dir is disabled; set enable_dir_service=true on "
+                    "/flags to allow filesystem browsing "
+                    "(reference -enable_dir_service)\n")
+        target = _unq(req.path[len("/dir"):]) or "/"
+        target = _os.path.normpath(target)
+        if not target.startswith("/"):
+            target = "/" + target
+        try:
+            if _os.path.isdir(target):
+                entries = sorted(_os.listdir(target))
+                rows = []
+                parent = _os.path.dirname(target.rstrip("/")) or "/"
+                rows.append(f'<li><a href="/dir{_q(parent)}">..</a></li>')
+                for e in entries:
+                    p = _os.path.join(target, e)
+                    mark = "/" if _os.path.isdir(p) else ""
+                    rows.append(f'<li><a href="/dir{_q(p)}">'
+                                f'{_html.escape(e)}{mark}</a></li>')
+                return (f"<html><body><h3>{_html.escape(target)}</h3>"
+                        f"<ul>{''.join(rows)}</ul></body></html>",
+                        "text/html")
+            # regular files only: an open() on a FIFO would park this
+            # console worker forever
+            st_ = _os.stat(target)
+            if not _stat.S_ISREG(st_.st_mode):
+                return f"not a regular file: {target}\n"
+            with open(target, "rb") as f:
+                data = f.read(1 << 20)   # bounded: first 1MB
+            return data, "application/octet-stream"
+        except OSError as e:
+            return f"cannot read {target}: {e}\n"
+
     routes = {
         "/": index, "/index": index,
         "/dashboard": dashboard,
@@ -315,6 +404,9 @@ def build_routes(server) -> dict:
         "/pprof/contention": hotspots_contention,
         "/pprof/heap": hotspots_heap,
         "/pprof/growth": hotspots_growth,
+        "/vlog": vlog_page,
+        "/dir": dir_page,
+        "/dir/": dir_page,     # prefix route: /dir/<abs path>
     }
     return routes
 

@@ -1,0 +1,140 @@
+"""Fiber/task-local storage — the bthread_key_create/getspecific analog.
+
+Reference: src/bthread/key.cpp:49 (bthread keys: per-bthread slots that
+travel with the bthread across workers, with destructors at bthread
+exit).  TPU build: the scheduling unit user code rides here is a Python
+callable hopping between threads/executors, so fiber-locals are built on
+``contextvars`` — the host-runtime mechanism whose Context object
+travels with scheduled work exactly the way a bthread's key table
+travels with the bthread.
+
+API shape mirrors the reference:
+
+    key = fiber_local.key_create(destructor=close_it)   # bthread_key_create
+    fiber_local.set_specific(key, value)                # bthread_setspecific
+    v = fiber_local.get_specific(key)                   # bthread_getspecific
+    fiber_local.key_delete(key)                         # bthread_key_delete
+
+and the hop primitive that makes them FIBER-locals rather than
+thread-locals:
+
+    fn2 = fiber_local.wrap(fn)      # captures the caller's context
+    fiber_local.spawn(fn, *args)    # run fn on the executor IN that
+                                    # context (locals + rpcz span travel)
+
+rpcz's current-span propagation rides the same mechanism
+(brpc_tpu/rpcz.py), so a span set in a handler follows work the handler
+spawns — the span-propagation-through-a-fiber-hop contract.
+"""
+from __future__ import annotations
+
+import contextvars
+import itertools
+import threading
+from typing import Any, Callable, Optional
+
+_key_ids = itertools.count(1)
+
+
+class FiberLocalKey:
+    """One fiber-local slot (bthread_key_t).  The optional destructor
+    runs for a fiber's value when `run_destructors` fires at the end of
+    a wrapped call — the bthread-exit destructor semantics."""
+
+    __slots__ = ("id", "_var", "destructor", "deleted")
+
+    def __init__(self, destructor: Optional[Callable[[Any], None]] = None):
+        self.id = next(_key_ids)
+        self._var = contextvars.ContextVar(f"fiber_local_{self.id}",
+                                           default=None)
+        self.destructor = destructor
+        self.deleted = False
+
+
+_keys_mu = threading.Lock()
+_live_keys: dict[int, FiberLocalKey] = {}
+
+
+def key_create(destructor: Optional[Callable[[Any], None]] = None
+               ) -> FiberLocalKey:
+    key = FiberLocalKey(destructor)
+    with _keys_mu:
+        _live_keys[key.id] = key
+    return key
+
+
+def key_delete(key: FiberLocalKey) -> None:
+    """Invalidate the key: subsequent get/set raise (the reference's
+    versioned-key invalidation; key.cpp reuses slots by version)."""
+    key.deleted = True
+    with _keys_mu:
+        _live_keys.pop(key.id, None)
+
+
+def set_specific(key: FiberLocalKey, value) -> None:
+    if key.deleted:
+        raise KeyError("fiber-local key was deleted")
+    key._var.set(value)
+
+
+def get_specific(key: FiberLocalKey, default=None):
+    if key.deleted:
+        raise KeyError("fiber-local key was deleted")
+    v = key._var.get()
+    return default if v is None else v
+
+
+def run_destructors() -> None:
+    """Run destructors for every live key with a value in THIS context
+    (bthread-exit semantics; invoked automatically by wrap())."""
+    with _keys_mu:
+        keys = list(_live_keys.values())
+    for key in keys:
+        v = key._var.get()
+        if v is not None:
+            if key.destructor is not None:
+                try:
+                    key.destructor(v)
+                except Exception:
+                    import logging
+                    logging.exception("fiber-local destructor raised")
+            key._var.set(None)
+
+
+def wrap(fn: Callable, *, destructors: bool = True) -> Callable:
+    """Bind `fn` to the CALLER's context: wherever the returned callable
+    later runs (another thread, the executor, a timer), every
+    fiber-local — and the rpcz current span — reads as it did here."""
+    ctx = contextvars.copy_context()
+
+    def bound(*args, **kwargs):
+        def _run():
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                if destructors:
+                    run_destructors()
+        return ctx.copy().run(_run)
+
+    return bound
+
+
+_spawn_pool = None
+_spawn_mu = threading.Lock()
+
+
+def _pool():
+    global _spawn_pool
+    with _spawn_mu:
+        if _spawn_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _spawn_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="fiber-spawn")
+        return _spawn_pool
+
+
+def spawn(fn: Callable, *args, **kwargs):
+    """bthread_start_background analog for Python callables: run `fn` on
+    a worker IN the caller's context (fiber-locals + rpcz span travel
+    with it).  Returns a Future."""
+    return _pool().submit(wrap(fn), *args, **kwargs)

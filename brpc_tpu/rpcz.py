@@ -12,13 +12,19 @@ bounded recent-span store is filled on the collector thread.
 from __future__ import annotations
 
 import itertools
+import contextvars
 import random
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 
-_tls = threading.local()
+# The current span is a CONTEXT variable, not a thread-local: user code
+# that hops executors/threads via butil.fiber_local.wrap()/spawn() (the
+# bthread_key analog) carries its span with it — fiber-local span
+# propagation, bthread/key.cpp:49 + the rpcz parent-span contract.
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "rpcz_span", default=None)
 _span_counter = itertools.count(1)
 
 _COLLECT_MAX = 2048
@@ -107,11 +113,11 @@ def new_span(kind: str, service: str = "", method: str = "",
 
 
 def set_current_span(span: Span | None) -> None:
-    _tls.span = span
+    _current_span.set(span)
 
 
 def get_current_span() -> Span | None:
-    return getattr(_tls, "span", None)
+    return _current_span.get()
 
 
 def current_trace() -> tuple[int, int]:

@@ -123,3 +123,57 @@ def test_restful_rpc_bridge(server):
 def test_404(server):
     status, _ = _get(server, "/definitely-not-a-page")
     assert status == 404
+
+
+def test_vlog_lists_and_sets_levels(server):
+    """/vlog (reference index_service.cpp:159): lists log sites with
+    levels and live-sets them, like /flags does for gflags."""
+    import logging
+    status, body = _get(server, "/vlog")
+    assert status == 200 and b"root" in body and b"<native>" in body
+    # live edit a named logger
+    status, body = _get(server, "/vlog?set=brpc_tpu.test_vlog%3DDEBUG")
+    assert status == 200
+    assert logging.getLogger("brpc_tpu.test_vlog").level == logging.DEBUG
+    status, body = _get(server, "/vlog")
+    assert b"brpc_tpu.test_vlog" in body
+    # bad requests answer, not crash
+    status, body = _get(server, "/vlog?set=x%3Dnot-a-level")
+    assert status == 200 and b"bad set request" in body
+
+
+def test_dir_browses_filesystem(server):
+    """/dir (reference dir_service.cpp): OFF by default (the reference's
+    -enable_dir_service gate); once enabled, directory listings as
+    links, regular files streamed back bounded."""
+    import os
+
+    from brpc_tpu import flags
+    # gated by default: no filesystem access without the flag
+    status, body = _get(server, "/dir/tmp")
+    assert status == 200 and b"disabled" in body and b"<ul>" not in body
+    assert flags.set_flag("enable_dir_service", True)
+    status, body = _get(server, "/dir/tmp")
+    assert status == 200 and b"<ul>" in body
+    # a real file round-trips (first bytes)
+    probe = "/tmp/brpc_dir_probe.txt"
+    with open(probe, "w") as f:
+        f.write("dir-service-probe")
+    try:
+        status, body = _get(server, f"/dir{probe}")
+        assert status == 200 and body == b"dir-service-probe"
+    finally:
+        os.unlink(probe)
+    # missing path: clean error text
+    status, body = _get(server, "/dir/definitely/not/a/path")
+    assert status == 200 and b"cannot read" in body
+    # url-quoted names round-trip (spaces etc.)
+    probe2 = "/tmp/brpc dir probe.txt"
+    with open(probe2, "w") as f:
+        f.write("quoted")
+    try:
+        status, body = _get(server, "/dir/tmp/brpc%20dir%20probe.txt")
+        assert status == 200 and body == b"quoted"
+    finally:
+        os.unlink(probe2)
+    flags.set_flag("enable_dir_service", False)

@@ -1,0 +1,138 @@
+"""Fiber-local storage (bthread_key_create/getspecific analog,
+reference src/bthread/key.cpp:49) and span propagation through a fiber
+hop — VERDICT r3 #9."""
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import rpcz
+from brpc_tpu.butil import fiber_local
+
+
+def test_key_create_set_get_delete():
+    key = fiber_local.key_create()
+    assert fiber_local.get_specific(key) is None
+    assert fiber_local.get_specific(key, default="d") == "d"
+    fiber_local.set_specific(key, {"x": 1})
+    assert fiber_local.get_specific(key) == {"x": 1}
+    fiber_local.key_delete(key)
+    with pytest.raises(KeyError):
+        fiber_local.get_specific(key)
+    with pytest.raises(KeyError):
+        fiber_local.set_specific(key, 1)
+
+
+def test_locals_travel_with_wrap_not_threads():
+    """A wrapped callable sees the CAPTURING fiber's locals wherever it
+    runs; a plain thread does not — that's the fiber/thread distinction
+    (bthread keys travel with the bthread, not the worker)."""
+    key = fiber_local.key_create()
+    fiber_local.set_specific(key, "origin-value")
+    seen = {}
+
+    def probe(tag):
+        seen[tag] = fiber_local.get_specific(key)
+
+    # plain thread: does NOT inherit by default... contextvars actually
+    # copy at Thread start in 3.12?  No: threads start with a fresh
+    # context — prove it
+    t = threading.Thread(target=probe, args=("bare-thread",))
+    t.start()
+    t.join()
+    assert seen["bare-thread"] is None
+    # wrapped hop: locals travel
+    t = threading.Thread(target=fiber_local.wrap(probe),
+                         args=("wrapped-thread",))
+    t.start()
+    t.join()
+    assert seen["wrapped-thread"] == "origin-value"
+    # spawn: same, on the pool
+    fiber_local.spawn(probe, "spawned").result(5)
+    assert seen["spawned"] == "origin-value"
+
+
+def test_wrap_isolates_mutations():
+    """Mutations inside the hop stay in the hop's context (a fiber's
+    key table is its own)."""
+    key = fiber_local.key_create()
+    fiber_local.set_specific(key, "outer")
+
+    def mutate():
+        fiber_local.set_specific(key, "inner")
+        return fiber_local.get_specific(key)
+
+    assert fiber_local.spawn(mutate).result(5) == "inner"
+    assert fiber_local.get_specific(key) == "outer"
+
+
+def test_destructors_run_at_hop_exit():
+    closed = []
+    key = fiber_local.key_create(destructor=closed.append)
+
+    def work():
+        fiber_local.set_specific(key, "resource-A")
+
+    fiber_local.spawn(work).result(5)
+    assert closed == ["resource-A"]
+    # the origin context's value is untouched (none was set here)
+    assert fiber_local.get_specific(key) is None
+    fiber_local.key_delete(key)
+
+
+def test_span_propagates_through_fiber_hop():
+    """The rpcz current span follows spawned work: a cascaded call made
+    from a hop inherits the server span's trace — the span-propagation
+    contract (reference: bthread-local span + rpcz parent links)."""
+    rpcz.set_enabled(True)
+    try:
+        span = rpcz.new_span("server", "Svc", "M")
+        rpcz.set_current_span(span)
+        got = fiber_local.spawn(rpcz.current_trace).result(5)
+        assert got == (span.trace_id, span.span_id)
+        # and a handler-style cascade: spawned work opening a client call
+        # stamps the inherited trace ids
+        def cascaded():
+            return rpcz.current_trace()
+        tid, psid = fiber_local.spawn(cascaded).result(5)
+        assert tid == span.trace_id and psid == span.span_id
+    finally:
+        rpcz.set_current_span(None)
+        rpcz.set_enabled(False)
+
+
+def test_span_propagates_from_rpc_handler():
+    """End to end: a handler spawns work via fiber_local; the work's
+    trace matches the request's server span."""
+    rpcz.set_enabled(True)
+    result = {}
+    done = threading.Event()
+
+    class Svc(brpc.Service):
+        NAME = "FiberHop"
+
+        @brpc.method(request="json", response="json")
+        def Go(self, cntl, req):
+            here = rpcz.current_trace()
+
+            def offloaded():
+                result["hop"] = rpcz.current_trace()
+                done.set()
+
+            fiber_local.spawn(offloaded)
+            return {"trace": here[0], "span": here[1]}
+
+    srv = brpc.Server()
+    srv.add_service(Svc())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        resp = ch.call_sync("FiberHop", "Go", {}, serializer="json")
+        assert done.wait(5)
+        assert result["hop"] == (resp["trace"], resp["span"])
+        assert resp["trace"] != 0
+    finally:
+        srv.stop()
+        srv.join()
+        rpcz.set_enabled(False)

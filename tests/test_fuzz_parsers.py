@@ -226,3 +226,128 @@ def test_fuzz_dcn_envelope():
     hdr, arrays = _unpack_envelope(good)
     assert hdr["svc"] == "S"
     np.testing.assert_array_equal(arrays[0], np.arange(16, dtype=np.float32))
+
+
+def test_fuzz_h2_state_machine_deep():
+    """Deep h2/HPACK state-machine fuzz (the most complex parser in the
+    tree; mirrors reference test/fuzzing/fuzz_hpack.cpp + fuzz_http2):
+    tens of thousands of seeded-PRNG frames straight into
+    H2Connection.on_frame — HEADERS/CONTINUATION interleave, PADDED/
+    PRIORITY flag soup, dynamic-table-size churn via SETTINGS, truncated
+    HPACK blocks, window manipulation, RST/GOAWAY storms.  The machine
+    must never raise (protocol errors surface as GOAWAY writes), never
+    hang, and never grow state unboundedly."""
+    from brpc_tpu.rpc import h2 as h2m
+    from brpc_tpu.rpc.hpack import HpackEncoder
+
+    class _Sink:
+        def __init__(self):
+            self.writes = 0
+
+        def write_raw(self, sid, data):
+            self.writes += 1
+            return 0
+
+        def alive(self, sid):
+            return True
+
+    class _FuzzConn(h2m.H2Connection):
+        def __init__(self):
+            # bypass parent init's Transport.instance(): no sockets here
+            self.sid = 1
+            self.is_server = True
+            self._tp = _Sink()
+            import threading as _t
+            self._enc = HpackEncoder()
+            from brpc_tpu.rpc.hpack import HpackDecoder
+            self._dec = HpackDecoder()
+            self._send_lock = _t.Lock()
+            self._fc = _t.Condition(_t.Lock())
+            self.remote_conn_window = h2m.DEFAULT_WINDOW
+            self.remote_initial_window = h2m.DEFAULT_WINDOW
+            self.remote_max_frame = 16384
+            self._recv_conn_consumed = 0
+            self._streams = {}
+            self._sent_settings = True
+            self._goaway = False
+            self._cont_stream = None
+            self.completed = 0
+
+        def on_stream_complete(self, st):
+            self.completed += 1
+            self.close_stream(st.id)
+
+    rng = random.Random(SEED + 12)
+    enc = HpackEncoder()
+    hdr_block = enc.encode([(":method", "POST"), (":path", "/S/M"),
+                            ("content-type", "application/grpc"),
+                            ("x-filler", "v" * 40)])
+    conn = _FuzzConn()
+    frames = 0
+    for _ in range(40_000):
+        choice = rng.randrange(10)
+        sid = rng.choice((0, 1, 2, 3, 5, 7, 2**31 - 1))
+        flags = rng.randrange(256)
+        if choice == 0:      # HEADERS with real or mutated HPACK
+            block = bytearray(hdr_block)
+            if rng.random() < 0.5 and block:
+                block[rng.randrange(len(block))] ^= 1 << rng.randrange(8)
+            cut = rng.randrange(len(block) + 1)
+            payload = bytes(block[:cut])
+            ftype = h2m.HEADERS
+        elif choice == 1:    # CONTINUATION (often out of order)
+            payload = bytes(hdr_block[rng.randrange(len(hdr_block)):])
+            ftype = h2m.CONTINUATION
+        elif choice == 2:    # DATA with padding soup
+            payload = rng.randbytes(rng.randrange(0, 64))
+            ftype = h2m.DATA
+        elif choice == 3:    # SETTINGS incl. table-size churn (eviction)
+            import struct as _s
+            n = rng.randrange(0, 4)
+            payload = b"".join(
+                _s.pack(">HI", rng.choice((1, 2, 3, 4, 5, 6, 9)),
+                        rng.randrange(0, 1 << 31)) for _ in range(n))
+            ftype = h2m.SETTINGS
+            flags = 0 if rng.random() < 0.8 else 1
+        elif choice == 4:
+            payload = rng.randbytes(4)
+            ftype = h2m.WINDOW_UPDATE
+        elif choice == 5:
+            payload = rng.randbytes(rng.randrange(0, 8))
+            ftype = h2m.RST_STREAM
+        elif choice == 6:
+            payload = rng.randbytes(8)
+            ftype = h2m.PING
+        elif choice == 7:
+            payload = rng.randbytes(rng.randrange(0, 16))
+            ftype = h2m.GOAWAY
+        elif choice == 8:    # PRIORITY / unknown types
+            payload = rng.randbytes(rng.randrange(0, 16))
+            ftype = rng.randrange(12)
+        else:                # raw garbage header
+            payload = rng.randbytes(rng.randrange(0, 48))
+            ftype = rng.randrange(256)
+        hdr9 = bytes([(len(payload) >> 16) & 0xFF,
+                      (len(payload) >> 8) & 0xFF, len(payload) & 0xFF,
+                      ftype, flags]) + struct.pack(">I", sid)
+        conn.on_frame(hdr9, payload)   # must never raise
+        frames += 1
+        # state must stay bounded: reset everything periodically the way
+        # a peer reconnect would
+        if frames % 5000 == 0:
+            assert len(conn._streams) < 5000, "stream state leak"
+            conn._streams.clear()
+            conn._cont_stream = None
+    assert frames == 40_000
+    # the machine is still functional after the storm: a clean request
+    # completes
+    good = conn._enc_probe = HpackEncoder().encode(
+        [(":method", "POST"), (":path", "/ok")])
+    conn._dec = __import__(
+        "brpc_tpu.rpc.hpack", fromlist=["HpackDecoder"]).HpackDecoder()
+    conn._cont_stream = None
+    before = conn.completed
+    hdr9 = bytes([0, 0, len(good), h2m.HEADERS,
+                  h2m.FLAG_END_HEADERS | h2m.FLAG_END_STREAM, 0, 0, 0, 9])
+    conn.on_frame(hdr9, good)
+    assert conn.completed == before + 1
