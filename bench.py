@@ -317,14 +317,20 @@ def bench_tensor_pipe(chunk_mb=64, iter_chunks=80, max_total_gb=96):
     # batch size bounded by per-dispatch live memory (in + out <= 512MB
     # total): 16x64MB batches kept 1GB live per dispatch and the
     # allocator churn depressed the measured bandwidth (r3 weak #4)
-    bs = max(1, min(16, (256 << 20) // chunk.nbytes))
-    # warmup: drainer thread + the SAME bs-chunk batched copy program the
-    # timed loop uses (jit caches per arity — r3's first cut warmed an
-    # 8-arity program and then paid a different-arity compile INSIDE the
-    # timed region, which is seconds over the tunnel)
+    bs = max(1, min(16, iter_chunks, (256 << 20) // chunk.nbytes))
+    # warmup: drainer thread + EVERY batch arity the timed loop will use
+    # (jit caches per arity — r3's first cut warmed one arity and then
+    # paid a different-arity compile INSIDE the timed region, which is
+    # seconds over the tunnel).  iter_chunks % bs != 0 means the loop's
+    # final batch has a remainder arity: warm that too.
+    warm_target = bs
     ts.write_many([chunk] * bs)
+    rem = iter_chunks % bs
+    if rem:
+        ts.write_many([chunk] * rem)
+        warm_target += rem
     deadline = time.monotonic() + 60
-    while consume.n < bs and time.monotonic() < deadline:
+    while consume.n < warm_target and time.monotonic() < deadline:
         time.sleep(0.005)    # deterministic: wait until warmup delivered
     # the transfer must not alias the source — this is the "really moved
     # bytes" proof the r1 bench lacked.  Two proofs, strongest available:

@@ -36,17 +36,23 @@ from typing import Any, Callable, Optional
 _key_ids = itertools.count(1)
 
 
+# distinguishes "never set" from an explicitly stored None (bthread
+# keys distinguish NULL-set from unset via the key table)
+_UNSET = object()
+
+
 class FiberLocalKey:
     """One fiber-local slot (bthread_key_t).  The optional destructor
-    runs for a fiber's value when `run_destructors` fires at the end of
-    a wrapped call — the bthread-exit destructor semantics."""
+    runs for values the FIBER ITSELF set when the hop exits — inherited
+    values belong to the parent (bthread-exit destructor semantics,
+    key.cpp: a bthread destroys only its own key table)."""
 
     __slots__ = ("id", "_var", "destructor", "deleted")
 
     def __init__(self, destructor: Optional[Callable[[Any], None]] = None):
         self.id = next(_key_ids)
         self._var = contextvars.ContextVar(f"fiber_local_{self.id}",
-                                           default=None)
+                                           default=_UNSET)
         self.destructor = destructor
         self.deleted = False
 
@@ -81,24 +87,37 @@ def get_specific(key: FiberLocalKey, default=None):
     if key.deleted:
         raise KeyError("fiber-local key was deleted")
     v = key._var.get()
-    return default if v is None else v
+    return default if v is _UNSET else v
 
 
-def run_destructors() -> None:
-    """Run destructors for every live key with a value in THIS context
-    (bthread-exit semantics; invoked automatically by wrap())."""
+def _snapshot() -> dict:
+    with _keys_mu:
+        keys = list(_live_keys.values())
+    return {k.id: k._var.get() for k in keys}
+
+
+def run_destructors(entry_snapshot: Optional[dict] = None) -> None:
+    """Run destructors for values THIS fiber set (bthread-exit
+    semantics; invoked automatically by wrap()).  With an entry
+    snapshot, values inherited unchanged from the parent context are
+    SKIPPED — destroying a parent's live resource from a side hop (and
+    once per hop) is exactly what bthread keys don't do."""
     with _keys_mu:
         keys = list(_live_keys.values())
     for key in keys:
         v = key._var.get()
-        if v is not None:
-            if key.destructor is not None:
-                try:
-                    key.destructor(v)
-                except Exception:
-                    import logging
-                    logging.exception("fiber-local destructor raised")
-            key._var.set(None)
+        if v is _UNSET or v is None:
+            continue
+        if entry_snapshot is not None and \
+                v is entry_snapshot.get(key.id, _UNSET):
+            continue            # inherited, not ours to destroy
+        if key.destructor is not None:
+            try:
+                key.destructor(v)
+            except Exception:
+                import logging
+                logging.exception("fiber-local destructor raised")
+        key._var.set(_UNSET)
 
 
 def wrap(fn: Callable, *, destructors: bool = True) -> Callable:
@@ -109,11 +128,12 @@ def wrap(fn: Callable, *, destructors: bool = True) -> Callable:
 
     def bound(*args, **kwargs):
         def _run():
+            snap = _snapshot() if destructors else None
             try:
                 return fn(*args, **kwargs)
             finally:
                 if destructors:
-                    run_destructors()
+                    run_destructors(snap)
         return ctx.copy().run(_run)
 
     return bound

@@ -1,44 +1,183 @@
-"""DCN groundwork — cross-process/cross-host device RPC (VERDICT r2 task 4).
+"""DCN — cross-process/cross-host device RPC with an out-of-band data
+plane (VERDICT r2 task 4 groundwork; r3 #5 the real data path).
 
 Reference pattern (rdma_endpoint.h:112-115,180; SURVEY §5.8): RdmaEndpoint
 rides an existing TCP connection for its handshake — a magic preamble and
-an exchange of lid/gid/qp_num — after which data moves out-of-band and TCP
-stays as the control/fallback channel.
+an exchange of lid/gid/qp_num — after which data moves out-of-band on the
+RC queue pair and TCP stays as the control/fallback channel.
 
 TPU build, two processes that do NOT share a jax runtime (separate hosts,
 or separate processes on one host):
 
   1. **Handshake**: the `_dcn` service's `Hello` method exchanges device
-     topology (pid, platform, device inventory, advertised device) over
-     the ordinary TRPC connection — the lid/gid/qp_num analog.
-  2. **Data path**: `DcnChannel.call_sync` invokes a *device service*
-     registered in the remote process (ici/channel.py registry); the
-     payload moves host-serialized over the socket (the explicit fallback
-     path — XLA cross-host collectives need a shared runtime, which two
-     independent processes don't have), lands on the target chip via
-     device_put, the jitted service runs there, and the result returns.
-  3. Addressing: ``ici://host:port/chip`` — host:port is the remote RPC
+     topology AND this process's transfer-fabric address (the
+     lid/gid/qp_num analog) over the ordinary TRPC connection.
+  2. **Data path**: each process runs a `jax.experimental.transfer`
+     server — XLA's cross-host device transfer fabric (DCN/RDMA-backed
+     on real pods).  A `DcnChannel.call_sync` registers its device
+     arrays with the local fabric under a ticket, sends a CONTROL
+     envelope (service, method, chip, ticket, shape/dtype specs — no
+     tensor bytes) over the socket; the remote pulls the buffers
+     device-to-device, runs the jitted device service on the target
+     chip, registers the results, and the client pulls them back.  The
+     tensor serializer never touches the payload.
+  3. **Fallback**: when either side has no fabric (old peer, failed
+     init), payloads move host-serialized over the socket — same
+     wire-compatible envelope, flagged in the reply.
+  4. Addressing: ``ici://host:port/chip`` — host:port is the remote RPC
      server, chip the device index in the REMOTE process's mesh.
-
-This makes `Channel on A calls device service on B` work today and pins
-the handshake/addressing surface that a zero-copy DCN transport can slot
-under later without touching call sites (exactly how RdmaEndpoint slid
-under Socket::Write).
 """
 from __future__ import annotations
 
+import itertools
 import os
+import threading
+import time
 from typing import Any, Optional
 
 import numpy as np
 
 from brpc_tpu import errors
+from brpc_tpu.bvar import Adder
 from brpc_tpu.rpc.service import Service, method
 
 DCN_SERVICE = "_dcn"
 DCN_MAGIC = "DCN1"          # handshake version tag (the "RDMA" preamble)
 
+import uuid as _uuid
+
+_PROCESS_NONCE = _uuid.uuid4().hex[:16]
+
 _MAX_HEADER = 64 * 1024     # envelope header bound (bounded trust)
+
+dcn_zero_copy_calls = Adder("dcn_zero_copy_calls")
+dcn_fallback_calls = Adder("dcn_fallback_calls")
+
+# ---------------------------------------------------------------------------
+# transfer fabric: jax.experimental.transfer server + cached connections
+# ---------------------------------------------------------------------------
+
+_xfer_mu = threading.Lock()
+_xfer_server = None
+_xfer_failed = False
+_xfer_conns: dict[str, Any] = {}
+# tickets must be unique across processes sharing a fabric: salt with pid
+_ticket_counter = itertools.count((os.getpid() & 0xFFFF) << 32)
+# offered arrays are pinned until the peer pulled them; the control-plane
+# round-trip normally confirms that, and a TTL bounds leaks from peers
+# that die mid-call (the rail registry's discipline)
+_OFFER_TTL_S = 120.0
+_offers_mu = threading.Lock()
+_offers: dict[int, tuple[list, float]] = {}
+
+
+def _bind_host() -> str:
+    # multi-host pods set the routable interface; loopback covers
+    # same-host multi-process (and tests)
+    return os.environ.get("BRPC_DCN_BIND_HOST", "127.0.0.1")
+
+
+def transfer_server():
+    """This process's transfer-fabric server (lazily started); None when
+    the fabric is unavailable — callers fall back to host serialization."""
+    global _xfer_server, _xfer_failed
+    with _xfer_mu:
+        if _xfer_server is not None or _xfer_failed:
+            return _xfer_server
+        try:
+            import jax
+            from jax.experimental import transfer
+            backend = jax.devices()[0].client
+            host = _bind_host()
+            _xfer_server = transfer.start_transfer_server(
+                backend, f"{host}:0", [f"{host}:0"])
+        except Exception:
+            import logging
+            logging.getLogger(__name__).info(
+                "DCN transfer fabric unavailable; host-serialized "
+                "fallback in effect", exc_info=True)
+            _xfer_failed = True
+        return _xfer_server
+
+
+def transfer_address() -> Optional[str]:
+    s = transfer_server()
+    return s.address() if s is not None else None
+
+
+def _connect(address: str):
+    with _xfer_mu:
+        conn = _xfer_conns.get(address)
+    if conn is not None:
+        return conn
+    s = transfer_server()
+    if s is None:
+        raise RuntimeError("no local transfer fabric")
+    conn = s.connect(address)
+    with _xfer_mu:
+        # two threads can race here: keep ONE connection per peer (the
+        # loser's is dropped and GC'd, never used)
+        conn = _xfer_conns.setdefault(address, conn)
+    return conn
+
+
+def _purge_offers_locked(now: float) -> None:
+    dead = [t for t, (_, dl) in _offers.items() if dl < now]
+    for t in dead:
+        del _offers[t]
+
+
+_sweeper_started = False
+
+
+def _ensure_sweeper() -> None:
+    # offered-but-never-pulled arrays must not stay pinned past the TTL
+    # just because no further offer() ever runs (the rail registry's
+    # own-clock discipline)
+    global _sweeper_started
+    if not _sweeper_started:
+        _sweeper_started = True
+
+        def _loop():
+            while True:
+                time.sleep(_OFFER_TTL_S / 4)
+                with _offers_mu:
+                    _purge_offers_locked(time.monotonic())
+
+        threading.Thread(target=_loop, daemon=True,
+                         name="dcn-offer-sweeper").start()
+
+
+def offer(arrays: list) -> tuple[int, list[dict]]:
+    """Register device arrays for a remote pull.  Returns (ticket,
+    specs) where specs describe shape/dtype for the peer's pull call."""
+    s = transfer_server()
+    assert s is not None
+    ticket = next(_ticket_counter)
+    s.await_pull(ticket, list(arrays))
+    now = time.monotonic()
+    with _offers_mu:
+        _purge_offers_locked(now)
+        _offers[ticket] = (list(arrays), now + _OFFER_TTL_S)
+    _ensure_sweeper()
+    return ticket, [{"shape": list(a.shape), "dtype": str(np.dtype(a.dtype))}
+                    for a in arrays]
+
+
+def release_offer(ticket: int) -> None:
+    with _offers_mu:
+        _offers.pop(ticket, None)
+
+
+def pull(address: str, ticket: int, specs: list[dict], device) -> list:
+    """Pull the peer's offered arrays straight onto `device`."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+    sh = SingleDeviceSharding(device)
+    shaped = [jax.ShapeDtypeStruct(tuple(sp["shape"]),
+                                   np.dtype(sp["dtype"]), sharding=sh)
+              for sp in specs]
+    return list(_connect(address).pull(ticket, shaped))
 
 
 def _pack_envelope(header: dict, arrays: list) -> bytes:
@@ -48,9 +187,13 @@ def _pack_envelope(header: dict, arrays: list) -> bytes:
     so nothing on this path interprets network bytes as code."""
     import json as _json
     import struct
+    hdr = _json.dumps(header).encode()
+    if not arrays:
+        # control-only envelope (zero-copy mode): no serializer touch,
+        # so the host-encode counters provably stay flat
+        return struct.pack("<I", len(hdr)) + hdr + struct.pack("<I", 0)
     from brpc_tpu.rpc.serialization import TensorSerializer
     tbody, theader = TensorSerializer().encode(arrays)
-    hdr = _json.dumps(header).encode()
     return (struct.pack("<I", len(hdr)) + hdr +
             struct.pack("<I", len(theader)) + theader + tbody)
 
@@ -58,7 +201,6 @@ def _pack_envelope(header: dict, arrays: list) -> bytes:
 def _unpack_envelope(data: bytes) -> tuple[dict, list]:
     import json as _json
     import struct
-    from brpc_tpu.rpc.serialization import TensorSerializer
     if len(data) < 8:
         raise ValueError("envelope too short")
     (hlen,) = struct.unpack_from("<I", data, 0)
@@ -69,6 +211,9 @@ def _unpack_envelope(data: bytes) -> tuple[dict, list]:
     off = 8 + hlen
     if off + tlen > len(data):
         raise ValueError("bad tensor header length")
+    if tlen == 0:
+        return header, []           # control-only (zero-copy mode)
+    from brpc_tpu.rpc.serialization import TensorSerializer
     theader = data[off:off + tlen]
     arrays = TensorSerializer().decode(data[off + tlen:], theader)
     if not isinstance(arrays, (list, tuple)):
@@ -83,6 +228,9 @@ def local_topology() -> dict:
     return {
         "magic": DCN_MAGIC,
         "pid": os.getpid(),
+        # process identity for the same-process check: pids collide
+        # across hosts/containers (both pid 1), a random nonce does not
+        "nonce": _PROCESS_NONCE,
         "platform": devs[0].platform if devs else "none",
         "devices": [{"id": d.id, "kind": getattr(d, "device_kind", "")}
                     for d in devs],
@@ -103,7 +251,11 @@ class DcnService(Service):
         if peer.get("magic") != DCN_MAGIC:
             cntl.set_failed(errors.EREQUEST, "bad DCN handshake magic")
             return None
-        return local_topology()
+        topo = local_topology()
+        # the qp_num analog: advertise this process's transfer-fabric
+        # address so the peer can move payloads out-of-band
+        topo["xfer"] = transfer_address()
+        return topo
 
     @method(request="raw", response="raw")
     def CallDevice(self, cntl, req):
@@ -132,13 +284,41 @@ class DcnService(Service):
         except Exception:
             cntl.set_failed(errors.EREQUEST, f"no local chip {chip}")
             return None
-        placed = [jax.device_put(a, dev) for a in arrays]
+        if hdr.get("ack") is not None:
+            # client confirmed pulling a previous response: unpin it
+            try:
+                release_offer(int(hdr["ack"]))
+            except (TypeError, ValueError):
+                pass
+        peer_xfer = hdr.get("xfer")
+        if peer_xfer and hdr.get("ticket") is not None:
+            # ZERO-COPY request: pull the client's device buffers
+            # straight onto the target chip over the transfer fabric —
+            # the socket carried only the control header
+            try:
+                placed = pull(peer_xfer, int(hdr["ticket"]),
+                              hdr.get("specs") or [], dev)
+            except Exception as e:
+                cntl.set_failed(errors.EINTERNAL,
+                                f"DCN pull failed: {e}")
+                return None
+        else:
+            placed = [jax.device_put(a, dev) for a in arrays]
         out = fn(placed[0] if len(placed) == 1 else placed)
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
-        return _pack_envelope(
-            {"single": not isinstance(out, (list, tuple)),
-             "devices": [next(iter(o.devices())).id for o in outs]},
-            [np.asarray(o) for o in outs])
+        resp_hdr = {"single": not isinstance(out, (list, tuple)),
+                    "devices": [next(iter(o.devices())).id for o in outs]}
+        if peer_xfer and transfer_server() is not None:
+            # ZERO-COPY response: offer the results for the client's
+            # pull; only the control header rides back
+            ticket, specs = offer(outs)
+            resp_hdr["xfer"] = transfer_address()
+            resp_hdr["ticket"] = ticket
+            resp_hdr["specs"] = specs
+            dcn_zero_copy_calls.add(1)
+            return _pack_envelope(resp_hdr, [])
+        dcn_fallback_calls.add(1)
+        return _pack_envelope(resp_hdr, [np.asarray(o) for o in outs])
 
 
 def parse_dcn_address(address: str) -> tuple[str, int, Optional[int]]:
@@ -172,6 +352,7 @@ class DcnChannel:
         self.default_chip = chip if chip is not None else default_chip
         self._ch = Channel(self.remote, timeout_ms=timeout_ms)
         self.topology: Optional[dict] = None
+        self._unacked_resp: Optional[int] = None
 
     def handshake(self) -> dict:
         """Exchange topologies (idempotent); returns the remote's."""
@@ -196,11 +377,54 @@ class DcnChannel:
                 f"remote has no chip {target_chip} "
                 f"(topology: {len(topo['devices'])} devices)")
         arrays = request if isinstance(request, (list, tuple)) else [request]
-        body = _pack_envelope(
-            {"svc": service, "method": method_name, "chip": target_chip},
-            [np.asarray(a) for a in arrays])
-        raw = self._ch.call_sync(DCN_SERVICE, "CallDevice", body,
-                                 serializer="raw", response_serializer="raw")
+        header = {"svc": service, "method": method_name,
+                  "chip": target_chip}
+        if self._unacked_resp is not None:
+            # piggyback ACK: the previous call's response was pulled, so
+            # the server can unpin those result buffers now instead of
+            # waiting out the TTL
+            header["ack"] = self._unacked_resp
+            self._unacked_resp = None
+        ticket = None
+        # zero-copy when BOTH fabrics exist (handshaked like qp_nums):
+        # device buffers stay registered locally; the socket carries
+        # control only.  Same-process peers keep the fallback — the
+        # fabric's loopback-to-self bulk transport is not supported (and
+        # in-process callers should ride IciChannel anyway).
+        if topo.get("xfer") and topo.get("nonce") != _PROCESS_NONCE \
+                and transfer_server() is not None:
+            jarrs = [a if isinstance(a, jax.Array) else jax.numpy.asarray(a)
+                     for a in arrays]
+            ticket, specs = offer(jarrs)
+            header["xfer"] = transfer_address()
+            header["ticket"] = ticket
+            header["specs"] = specs
+            body = _pack_envelope(header, [])
+        else:
+            body = _pack_envelope(header, [np.asarray(a) for a in arrays])
+        try:
+            raw = self._ch.call_sync(DCN_SERVICE, "CallDevice", body,
+                                     serializer="raw",
+                                     response_serializer="raw")
+        finally:
+            if ticket is not None:
+                # the reply means the server pulled (it needed the
+                # request to compute); on failure this unpins early
+                release_offer(ticket)
         hdr, out_arrays = _unpack_envelope(bytes(raw))
-        outs = [jax.numpy.asarray(a) for a in out_arrays]
+        if hdr.get("xfer") and hdr.get("ticket") is not None:
+            # pull results straight onto the local device the request
+            # came from (or the default device)
+            local_dev = None
+            for a in arrays:
+                if isinstance(a, jax.Array):
+                    local_dev = next(iter(a.devices()))
+                    break
+            if local_dev is None:
+                local_dev = jax.devices()[0]
+            outs = pull(hdr["xfer"], int(hdr["ticket"]),
+                        hdr.get("specs") or [], local_dev)
+            self._unacked_resp = int(hdr["ticket"])
+        else:
+            outs = [jax.numpy.asarray(a) for a in out_arrays]
         return outs[0] if hdr.get("single", True) else outs

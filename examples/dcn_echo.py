@@ -54,11 +54,17 @@ def main():
 
     ch = DcnChannel(f"ici://127.0.0.1:{port}/3")
     topo = ch.handshake()
+    mode = "zero-copy fabric" if topo.get("xfer") else "host fallback"
     print(f"peer pid {topo['pid']}: {len(topo['devices'])} "
-          f"{topo['platform']} devices")
+          f"{topo['platform']} devices; data plane: {mode} "
+          f"(xfer addr {topo.get('xfer')})")
+    from brpc_tpu.rpc import serialization
+    enc0 = serialization.tensor_host_encodes.get_value()
     out = ch.call_sync("Mat", "Scale",
                        jax.numpy.arange(8, dtype=jax.numpy.float32))
-    print(f"Scale on remote chip 3 -> {list(map(float, out))}")
+    hc = serialization.tensor_host_encodes.get_value() - enc0
+    print(f"Scale on remote chip 3 -> {list(map(float, out))} "
+          f"({hc} host tensor encodes on the data path)")
     child.terminate()
     child.wait(10)
 

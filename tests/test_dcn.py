@@ -152,3 +152,50 @@ class TestDcnAddressParsing:
         assert parse_dcn_address("ici://h:80/3") == ("h", 80, 3)
         assert parse_dcn_address("ici://h:80") == ("h", 80, None)
         assert parse_dcn_address("h:80") == ("h", 80, None)
+
+
+class TestDcnZeroCopyDataPlane:
+    def test_zero_copy_pull_no_host_serialization(self, remote_server):
+        """The real DCN data plane (VERDICT r3 #5): with both fabrics up,
+        CallDevice payloads move device-to-device over
+        jax.experimental.transfer — the socket carries control only, and
+        the tensor serializer provably never touches the payload."""
+        from brpc_tpu.ici.dcn import (DcnChannel, dcn_zero_copy_calls,
+                                      transfer_address)
+        from brpc_tpu.rpc import serialization
+
+        port, _proc = remote_server
+        ch = DcnChannel(f"ici://127.0.0.1:{port}/0")
+        topo = ch.handshake()
+        assert topo.get("xfer"), "server advertised no transfer fabric"
+        assert transfer_address(), "local transfer fabric unavailable"
+        x = jax.device_put(np.arange(64, dtype=np.float32),
+                           jax.devices()[0])
+        enc0 = serialization.tensor_host_encodes.get_value()
+        dec0 = serialization.tensor_host_decodes.get_value()
+        out = ch.call_sync("MatSvc", "Inc", x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.arange(64, dtype=np.float32) + 1.0)
+        # result landed on OUR device, straight from the fabric
+        assert next(iter(out.devices())) == jax.devices()[0]
+        # the host tensor serializer was never involved in this process
+        assert serialization.tensor_host_encodes.get_value() == enc0
+        assert serialization.tensor_host_decodes.get_value() == dec0
+
+    def test_fallback_without_local_fabric(self, remote_server):
+        """A client whose fabric failed still completes calls — host
+        serialization, wire-compatible (the RDMA-unavailable fallback)."""
+        from brpc_tpu.ici import dcn
+
+        port, _proc = remote_server
+        real_server = dcn.transfer_server
+        dcn_mod_server = lambda: None
+        dcn.transfer_server = dcn_mod_server
+        try:
+            ch = dcn.DcnChannel(f"ici://127.0.0.1:{port}/0")
+            out = ch.call_sync("MatSvc", "Inc",
+                               np.arange(8, dtype=np.float32))
+            np.testing.assert_allclose(
+                np.asarray(out), np.arange(8, dtype=np.float32) + 1.0)
+        finally:
+            dcn.transfer_server = real_server
