@@ -2,3 +2,7 @@ from brpc_tpu.models.parameter_server import (  # noqa: F401
     PSConfig, init_params, forward_step, train_step, make_sharded_train_step,
     register_ps_services,
 )
+from brpc_tpu.models.moe import (  # noqa: F401
+    MoEConfig, init_moe_params, make_ep_mesh, make_sharded_moe_layer,
+    moe_layer_reference, place_moe_params,
+)
