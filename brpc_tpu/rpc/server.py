@@ -154,6 +154,20 @@ class ServerOptions:
     # register the _dcn service (topology handshake + remote device-service
     # bridge, ici/dcn.py) at start — the DCN half of SURVEY §5.8
     enable_dcn: bool = False
+    # Run handlers in a WIDE dedicated thread pool instead of the
+    # fixed-width native executor workers (the reference's
+    # FLAGS_usercode_in_pthread + usercode_backup_pool,
+    # details/usercode_backup_pool.cpp): handlers that BLOCK (nested
+    # RPCs, IO, long sleeps) stop competing for the executor's cores+1
+    # workers, so blocking user code cannot starve dispatch of other
+    # requests.  Costs a thread hop per request — off by default,
+    # exactly like the reference flag.  NOTE: unlike the reference's
+    # grow-on-demand backup pool this pool is FIXED-CAP
+    # (usercode_pool_workers, default 64) — beyond that many
+    # simultaneously blocked handlers, requests queue behind them.
+    usercode_in_pthread: bool = False
+    # pool width when usercode_in_pthread is on (0 = 64)
+    usercode_pool_workers: int = 0
 
 
 class MethodStatus:
@@ -355,6 +369,14 @@ class Server:
                 self._tag_pools[tag] = ThreadPoolExecutor(
                     max_workers=workers,
                     thread_name_prefix=f"svc-tag-{tag}")
+        if self.options.usercode_in_pthread:
+            # the usercode pool IS a tag pool under the reserved ""
+            # tag: creation here, recreation after join(), shutdown and
+            # inflight accounting all ride the one mechanism
+            if "" not in self._tag_pools:
+                self._tag_pools[""] = ThreadPoolExecutor(
+                    max_workers=self.options.usercode_pool_workers or 64,
+                    thread_name_prefix="usercode")
         if self.options.enable_dcn:
             # cross-process device RPC: topology handshake + remote
             # device-service bridge (ici/dcn.py; the RdmaEndpoint
@@ -576,7 +598,18 @@ class Server:
                 else body.to_bytes())
         tag = self._service_tags.get(meta.service)
         pool = self._tag_pools.get(tag) if tag is not None else None
+        if pool is None:
+            # usercode_in_pthread (usercode_backup_pool.cpp): BLOCKING
+            # handlers hop to the wide "" tag pool so they never park
+            # the fixed-width executor workers dispatching everyone else
+            pool = self._tag_pools.get("")
         if pool is not None:
+            if self._stopping:
+                # the pre_accepted contract covers requests QUEUED
+                # before stop(); a request ARRIVING after stop() gets
+                # ELOGOFF here, same as the non-pool path's gate
+                self._respond_error(sid, meta, errors.ELOGOFF)
+                return
             # isolated worker pool for this service (bthread tag);
             # count the QUEUED request so graceful join() waits for it
             with self._inflight_mu:
