@@ -18,7 +18,7 @@ import jax
 from brpc_tpu import errors, rpcz
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
 from brpc_tpu.bvar import LatencyRecorder
-from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.controller import Controller, OneShotEvent
 from brpc_tpu.ici.mesh import device_for
 
 _registry_lock = threading.Lock()
@@ -107,7 +107,7 @@ class IciChannel:
         async; the thread only exists to run `done` off the caller)."""
         cntl = cntl or Controller()
         if done is None:
-            cntl._done_event = threading.Event()
+            cntl._done_event = OneShotEvent()
 
         def run():
             try:

@@ -21,7 +21,7 @@ from typing import Any, Callable, Optional
 from brpc_tpu import errors
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
 from brpc_tpu.rpc import meta as M
-from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.controller import Controller, OneShotEvent
 from brpc_tpu.rpc.serialization import compress, decompress, get_serializer
 from brpc_tpu.rpc.transport import MSG_TRPC, Transport
 
@@ -575,7 +575,7 @@ class Channel:
         cntl.correlation_id = next(_cid_counter)
         cntl._start_us = int(time.monotonic() * 1e6)
         if done is None:
-            cntl._done_event = threading.Event()
+            cntl._done_event = OneShotEvent()
 
         ser = get_serializer(serializer)
         rail_obj = None

@@ -23,7 +23,7 @@ from typing import Any, Callable, Optional, Sequence
 
 from brpc_tpu import errors
 from brpc_tpu.rpc.channel import Channel, ChannelOptions
-from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.controller import Controller, OneShotEvent
 
 
 # CollectiveGroups (and the jitted programs they cache) are shared across
@@ -167,10 +167,10 @@ class ParallelChannel:
             # broadcast fan-out over co-located chips with no per-channel
             # request mapping: collective lowering applies
             if done is None:
-                cntl._done_event = threading.Event()
+                cntl._done_event = OneShotEvent()
             return self._call_lowered(service, method, request, cntl, done)
         if done is None:
-            cntl._done_event = threading.Event()
+            cntl._done_event = OneShotEvent()
 
         sub_cntls: list[Optional[Controller]] = [None] * n
         results: list[Any] = [None] * n
@@ -493,7 +493,7 @@ class DynamicPartitionChannel:
             if done:
                 done(cntl)
             else:
-                cntl._done_event = threading.Event()
+                cntl._done_event = OneShotEvent()
                 cntl._done_event.set()
             return cntl
         pc = ParallelChannel(self.fail_limit, self.call_mapper,

@@ -17,6 +17,48 @@ from brpc_tpu import errors
 from brpc_tpu.rpc import meta as M
 
 
+class OneShotEvent:
+    """threading.Event specialized for exactly-once RPC completion: a
+    pre-acquired raw lock released by set().  Half the primitive lock
+    operations of Event's Condition dance per sync call — the wait is
+    ONE acquire on the completer's release, not an allocate/append/
+    reacquire cycle.  set() is called once (the completion path is
+    exactly-once via Controller._try_complete); a benign double-set is
+    absorbed."""
+
+    __slots__ = ("_lock", "_flag")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._lock.acquire()
+        self._flag = False
+
+    def set(self) -> None:
+        if not self._flag:
+            self._flag = True
+            try:
+                self._lock.release()
+            except RuntimeError:   # benign double-set race
+                pass
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def wait(self, timeout: float | None = None) -> bool:
+        if self._flag:
+            return True
+        if timeout is None:
+            acquired = self._lock.acquire()
+        else:
+            acquired = self._lock.acquire(True, timeout)
+        if acquired:
+            try:
+                self._lock.release()   # pass the baton to other waiters
+            except RuntimeError:       # absorbed the same double-set race
+                pass                   # set() guards against
+        return self._flag
+
+
 class Controller:
     def __init__(self, *, timeout_ms: Optional[int] = None,
                  max_retry: Optional[int] = None,
@@ -58,7 +100,7 @@ class Controller:
         self.remote_side: str = ""
         self.latency_us: int = 0
         self._start_us: int = 0
-        self._done_event: Optional[threading.Event] = None
+        self._done_event: Optional["OneShotEvent"] = None
         self._done_cb: Optional[Callable[["Controller"], None]] = None
         self._completed = False
         self._lock = threading.Lock()

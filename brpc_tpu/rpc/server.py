@@ -292,6 +292,12 @@ class Server:
         if name in self._services:
             raise ValueError(f"service {name!r} already added")
         if tag is not None:
+            if tag == "":
+                # "" is the usercode_in_pthread pool's reserved key; a
+                # user tag colliding with it would silently replace the
+                # wide pool with this tag's width
+                raise ValueError('tag "" is reserved (usercode pool); '
+                                 'pick a non-empty tag name')
             # validate BEFORE mutating any registry state
             prev = self._tag_sizes.get(tag)
             if prev is not None and prev != tag_workers:

@@ -15,7 +15,6 @@ import glob
 import json
 import os
 import sys
-import threading
 import time
 
 from brpc_tpu import errors
@@ -24,7 +23,7 @@ from brpc_tpu.butil.recordio import RecordReader
 from brpc_tpu.bvar import LatencyRecorder
 from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.channel import CallManager, SocketMap, _CallState
-from brpc_tpu.rpc.controller import Controller
+from brpc_tpu.rpc.controller import Controller, OneShotEvent
 from brpc_tpu.rpc.transport import Transport
 
 
@@ -49,7 +48,7 @@ def replay_one(ep, meta_bytes: bytes, body: bytes,
     from brpc_tpu.rpc.channel import _cid_counter
     cntl.correlation_id = next(_cid_counter)
     cntl._start_us = int(time.monotonic() * 1e6)
-    cntl._done_event = threading.Event()
+    cntl._done_event = OneShotEvent()
     meta.correlation_id = cntl.correlation_id
     meta.attempt = 0
     mgr = CallManager.instance()

@@ -400,9 +400,12 @@ def test_grpc_health_unknown_service_and_restart_flag():
 
 def test_usercode_in_pthread_blocking_handlers_parallelize():
     """FLAGS_usercode_in_pthread analog (usercode_backup_pool.cpp):
-    blocking handlers hop to the elastic pool instead of parking the
-    fixed-width executor workers.  16 handlers sleeping 0.25s must
-    finish in ~one sleep (parallel), not executor-width waves."""
+    blocking handlers hop to the wide pool instead of parking the
+    fixed-width executor workers.  MORE handlers than the executor's
+    width (cores+1) sleeping 0.25s must finish in ~one sleep (parallel),
+    not executor-width waves — sized off cpu_count so the proof holds on
+    wide CI machines too."""
+    import os as _os
     import time as _time
 
     class Block(brpc.Service):
@@ -418,15 +421,16 @@ def test_usercode_in_pthread_blocking_handlers_parallelize():
     s.start("127.0.0.1", 0)
     try:
         ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=15000)
+        n = max(16, ((_os.cpu_count() or 1) + 1) * 2)
         t0 = _time.monotonic()
-        cntls = [ch.call("PthreadSleep", "Nap", b"") for _ in range(16)]
+        cntls = [ch.call("PthreadSleep", "Nap", b"") for _ in range(n)]
         for c in cntls:
             c.join()
             assert not c.failed() and c.response == b"up"
         wall = _time.monotonic() - t0
-        # 16 x 0.25s serialized through ~4 executor workers would take
-        # >=1.0s; the elastic pool runs them all concurrently
-        assert wall < 0.9, f"blocking handlers serialized: {wall:.2f}s"
+        # n > executor width: without the pool hop the handlers would
+        # run in >=2 waves (>=0.5s); the wide pool runs them all at once
+        assert wall < 0.45, f"blocking handlers serialized: {wall:.2f}s"
     finally:
         s.stop()
         s.join()
