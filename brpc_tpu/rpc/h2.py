@@ -51,12 +51,16 @@ DEFAULT_WINDOW = 65535
 OUR_WINDOW = 1 << 20          # per-stream window we advertise
 OUR_CONN_WINDOW = 64 << 20    # connection window we grow to
 OUR_MAX_FRAME = 1 << 20
+# assembled header-block cap (SETTINGS_MAX_HEADER_LIST_SIZE analog): a
+# CONTINUATION storm must not grow one stream's block without bound
+MAX_HEADER_BLOCK = 1 << 20
 
 H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
 
 # h2 error codes (RFC 7540 §7)
 H2_NO_ERROR, H2_PROTOCOL_ERROR, H2_INTERNAL_ERROR, H2_FLOW_CONTROL_ERROR = \
     0, 1, 2, 3
+H2_FRAME_SIZE_ERROR = 6
 
 # gRPC status codes (grpc.cpp's ErrorCodeToGrpcStatus analog)
 GRPC_OK = 0
@@ -303,6 +307,11 @@ class H2Connection:
         self._streams: dict[int, _StreamState] = {}
         self._sent_settings = False
         self._goaway = False
+        # fatal local condition (oversized/undecodable header block):
+        # the HPACK dynamic table may be desynced, so NO further frame
+        # may be decoded on this connection (RFC 7540 §4.3 connection
+        # error semantics)
+        self._fatal = False
         self._cont_stream: Optional[int] = None  # stream awaiting CONTINUATION
 
     # ---- send side ----
@@ -403,9 +412,19 @@ class H2Connection:
     # ---- receive side ----
 
     def on_frame(self, hdr9: bytes, payload: bytes) -> None:
+        if self._fatal:
+            return      # desynced HPACK state: nothing more is decodable
         ftype = hdr9[3]
         flags = hdr9[4]
         stream_id = struct.unpack(">I", hdr9[5:9])[0] & 0x7FFFFFFF
+        if len(payload) > OUR_MAX_FRAME:
+            # larger than our advertised SETTINGS_MAX_FRAME_SIZE: a
+            # compliant peer never sends this, and an oversized HEADERS
+            # would bypass MAX_HEADER_BLOCK 16x (the native parser caps
+            # frames at 16MB, not at our advertisement)
+            self._fatal = True
+            self.send_goaway(code=H2_FRAME_SIZE_ERROR)
+            return
         if self._cont_stream is not None and ftype != CONTINUATION:
             self.send_goaway(code=H2_PROTOCOL_ERROR)
             return
@@ -520,6 +539,16 @@ class H2Connection:
             return
         st = self._stream(stream_id)
         st.header_block += payload
+        if len(st.header_block) > MAX_HEADER_BLOCK:
+            # SETTINGS_MAX_HEADER_LIST_SIZE enforcement: an unbounded
+            # CONTINUATION run must not grow memory without limit.
+            # FATAL: the discarded block's dynamic-table inserts were
+            # never applied, so later blocks would decode wrongly
+            st.header_block = bytearray()
+            self._cont_stream = None
+            self._fatal = True
+            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            return
         if flags & FLAG_END_HEADERS:
             self._cont_stream = None
             self._finish_header_block(st)
@@ -528,6 +557,8 @@ class H2Connection:
         try:
             headers = self._dec.decode(bytes(st.header_block))
         except ValueError:
+            # undecodable block = desynced dynamic table: fatal (§4.3)
+            self._fatal = True
             self.send_goaway(code=H2_PROTOCOL_ERROR)
             return
         st.header_block = bytearray()

@@ -228,6 +228,54 @@ def test_fuzz_dcn_envelope():
     np.testing.assert_array_equal(arrays[0], np.arange(16, dtype=np.float32))
 
 
+def _make_h2_test_conn(on_complete=None):
+    """Socketless H2Connection for state-machine fuzzing: a no-op
+    transport sink capturing writes, shared by every h2 fuzz test (one
+    stub to keep in sync with H2Connection.__init__)."""
+    import threading as _t
+
+    from brpc_tpu.rpc import h2 as h2m
+    from brpc_tpu.rpc.hpack import HpackDecoder, HpackEncoder
+
+    sent = []
+
+    class _Sink:
+        def write_raw(self, sid, data):
+            sent.append(bytes(data))
+            return 0
+
+        def alive(self, sid):
+            return True
+
+    class _Conn(h2m.H2Connection):
+        def __init__(self):
+            self.sid = 1
+            self.is_server = True
+            self._tp = _Sink()
+            self._enc = HpackEncoder()
+            self._dec = HpackDecoder()
+            self._send_lock = _t.Lock()
+            self._fc = _t.Condition(_t.Lock())
+            self.remote_conn_window = h2m.DEFAULT_WINDOW
+            self.remote_initial_window = h2m.DEFAULT_WINDOW
+            self.remote_max_frame = 16384
+            self._recv_conn_consumed = 0
+            self._streams = {}
+            self._sent_settings = True
+            self._goaway = False
+            self._fatal = False
+            self._cont_stream = None
+            self.completed = 0
+
+        def on_stream_complete(self, st):
+            self.completed += 1
+            if on_complete is not None:
+                on_complete(st)
+            self.close_stream(st.id)
+
+    return _Conn(), sent
+
+
 def test_fuzz_h2_state_machine_deep():
     """Deep h2/HPACK state-machine fuzz (the most complex parser in the
     tree; mirrors reference test/fuzzing/fuzz_hpack.cpp + fuzz_http2):
@@ -240,49 +288,12 @@ def test_fuzz_h2_state_machine_deep():
     from brpc_tpu.rpc import h2 as h2m
     from brpc_tpu.rpc.hpack import HpackEncoder
 
-    class _Sink:
-        def __init__(self):
-            self.writes = 0
-
-        def write_raw(self, sid, data):
-            self.writes += 1
-            return 0
-
-        def alive(self, sid):
-            return True
-
-    class _FuzzConn(h2m.H2Connection):
-        def __init__(self):
-            # bypass parent init's Transport.instance(): no sockets here
-            self.sid = 1
-            self.is_server = True
-            self._tp = _Sink()
-            import threading as _t
-            self._enc = HpackEncoder()
-            from brpc_tpu.rpc.hpack import HpackDecoder
-            self._dec = HpackDecoder()
-            self._send_lock = _t.Lock()
-            self._fc = _t.Condition(_t.Lock())
-            self.remote_conn_window = h2m.DEFAULT_WINDOW
-            self.remote_initial_window = h2m.DEFAULT_WINDOW
-            self.remote_max_frame = 16384
-            self._recv_conn_consumed = 0
-            self._streams = {}
-            self._sent_settings = True
-            self._goaway = False
-            self._cont_stream = None
-            self.completed = 0
-
-        def on_stream_complete(self, st):
-            self.completed += 1
-            self.close_stream(st.id)
-
+    conn, _sent = _make_h2_test_conn()
     rng = random.Random(SEED + 12)
     enc = HpackEncoder()
     hdr_block = enc.encode([(":method", "POST"), (":path", "/S/M"),
                             ("content-type", "application/grpc"),
                             ("x-filler", "v" * 40)])
-    conn = _FuzzConn()
     frames = 0
     for _ in range(40_000):
         choice = rng.randrange(10)
@@ -338,6 +349,7 @@ def test_fuzz_h2_state_machine_deep():
             assert len(conn._streams) < 5000, "stream state leak"
             conn._streams.clear()
             conn._cont_stream = None
+            conn._fatal = False    # peer-reconnect analog
     assert frames == 40_000
     # the machine is still functional after the storm: a clean request
     # completes
@@ -351,3 +363,32 @@ def test_fuzz_h2_state_machine_deep():
                   h2m.FLAG_END_HEADERS | h2m.FLAG_END_STREAM, 0, 0, 0, 9])
     conn.on_frame(hdr9, good)
     assert conn.completed == before + 1
+
+
+def test_h2_continuation_storm_bounded():
+    """A CONTINUATION storm must hit the header-block cap and GOAWAY,
+    not grow memory without bound (SETTINGS_MAX_HEADER_LIST_SIZE
+    enforcement)."""
+    from brpc_tpu.rpc import h2 as h2m
+
+    def _never(st):
+        raise AssertionError("storm must never complete a stream")
+
+    conn, sent = _make_h2_test_conn(on_complete=_never)
+    hdr = bytes([0, 0, 4, h2m.HEADERS, 0, 0, 0, 0, 1])   # no END_HEADERS
+    conn.on_frame(hdr, b"\x00" * 4)
+    chunk = b"\x00" * 16384
+    frames = 0
+    while frames < 200:                   # 200 x 16KB > 1MB cap
+        h = bytes([(len(chunk) >> 16) & 0xFF, (len(chunk) >> 8) & 0xFF,
+                   len(chunk) & 0xFF, h2m.CONTINUATION, 0, 0, 0, 0, 1])
+        conn.on_frame(h, chunk)
+        frames += 1
+        if conn._cont_stream is None:     # cap hit: GOAWAY sent
+            break
+    assert conn._cont_stream is None, "storm never bounded"
+    assert frames < 200
+    st = conn._streams.get(1)
+    assert st is None or len(st.header_block) <= h2m.MAX_HEADER_BLOCK
+    assert any(data[3:4] == bytes([h2m.GOAWAY]) for data in sent
+               if len(data) >= 4)
