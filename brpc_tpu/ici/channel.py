@@ -22,23 +22,35 @@ from brpc_tpu.rpc.controller import Controller, OneShotEvent
 from brpc_tpu.ici.mesh import device_for
 
 _registry_lock = threading.Lock()
-_device_services: dict[tuple[str, str], Callable] = {}
+# (fn, jit): jit=False marks services that manage their own
+# compilation/sharding and must never be wrapped in an outer jit
+_device_services: dict[tuple[str, str], tuple[Callable, bool]] = {}
 _jitted: dict[tuple[str, str], Callable] = {}
 _call_latency = LatencyRecorder("ici_channel")
 
 
-def register_device_service(service: str, method: str, fn: Callable) -> None:
+def register_device_service(service: str, method: str, fn: Callable,
+                            *, jit: bool = True) -> None:
     """Register a jax function as (service, method) for ICI channels.
     fn(request_array) -> response_array; jit specializes per input
-    placement, so one compiled entry serves every chip."""
+    placement, so one compiled entry serves every chip.  jit=False for
+    services that manage their own compilation/sharding (an
+    already-jitted shard_map program re-placing inputs onto a mesh must
+    not be wrapped in an outer single-device jit)."""
     with _registry_lock:
-        _device_services[(service, method)] = fn
+        _device_services[(service, method)] = (fn, jit)
         _jitted.pop((service, method), None)
 
 
 def device_service_registry() -> dict:
+    """(service, method) -> fn for services that tolerate an OUTER jit
+    wrap (the collective-lowering contract: ParallelChannel fan-out
+    wraps these in shard_map+jit).  jit=False services are deliberately
+    EXCLUDED — wrapping a self-sharding program in an outer jit raises
+    at trace time; those targets take the per-channel call path."""
     with _registry_lock:
-        return dict(_device_services)
+        return {k: fn for k, (fn, jit_it) in _device_services.items()
+                if jit_it}
 
 
 def _compiled(service: str, method: str) -> Optional[Callable]:
@@ -46,13 +58,14 @@ def _compiled(service: str, method: str) -> Optional[Callable]:
     with _registry_lock:
         f = _jitted.get(key)
         if f is None:
-            fn = _device_services.get(key)
-            if fn is None:
+            entry = _device_services.get(key)
+            if entry is None:
                 return None
+            fn, jit_it = entry
             # Inputs arrive committed to the target device (call_sync does
             # the device_put), so outputs follow — no deprecated
             # jit(device=...) needed.
-            f = jax.jit(fn)
+            f = jax.jit(fn) if jit_it else fn
             _jitted[key] = f
         return f
 

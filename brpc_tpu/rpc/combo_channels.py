@@ -165,10 +165,16 @@ class ParallelChannel:
         if self._all_ici() and type(self.call_mapper) is CallMapper and \
                 all(m is None for _, m in self._channels):
             # broadcast fan-out over co-located chips with no per-channel
-            # request mapping: collective lowering applies
-            if done is None:
-                cntl._done_event = OneShotEvent()
-            return self._call_lowered(service, method, request, cntl, done)
+            # request mapping: collective lowering applies — but ONLY for
+            # services that tolerate an outer jit wrap (the registry
+            # excludes jit=False self-sharding services; those take the
+            # per-channel path below)
+            from brpc_tpu.ici.channel import device_service_registry
+            if device_service_registry().get((service, method)) is not None:
+                if done is None:
+                    cntl._done_event = OneShotEvent()
+                return self._call_lowered(service, method, request, cntl,
+                                          done)
         if done is None:
             cntl._done_event = OneShotEvent()
 

@@ -142,3 +142,36 @@ class TestPartitionChannel:
             for s in servers:
                 s.stop()
                 s.join()
+
+
+def test_parallel_channel_jit_false_service_takes_per_channel_path():
+    """A self-sharding device service (registered jit=False) cannot be
+    wrapped in the collective lowering's outer jit; an all-ICI
+    ParallelChannel must fall back to per-channel calls and still
+    deliver merged results."""
+    import jax
+
+    from brpc_tpu.ici import IciChannel, register_device_service
+    from brpc_tpu.ici.channel import device_service_registry
+
+    def self_managed(x):
+        # eager (unjitted) service doing its own placement
+        return jax.device_put(x * 2.0, next(iter(x.devices())))
+
+    register_device_service("SelfSharded", "Double", self_managed,
+                            jit=False)
+    # excluded from the lowering registry...
+    assert ("SelfSharded", "Double") not in device_service_registry()
+    from brpc_tpu.rpc.combo_channels import ParallelChannel
+    pc = ParallelChannel()
+    for i in range(2):
+        pc.add_channel(IciChannel(f"ici://slice0/{i}"))
+    x = jax.numpy.arange(8, dtype=jax.numpy.float32)
+    cntl = pc.call("SelfSharded", "Double", x)
+    cntl.join()
+    assert not cntl.failed(), cntl.error_text
+    # ...but the per-channel path still served both chips
+    merged = cntl.response
+    assert len(merged) == 2
+    for out in merged:
+        assert jax.numpy.allclose(out, x * 2.0)
