@@ -4,5 +4,5 @@ from brpc_tpu.models.parameter_server import (  # noqa: F401
 )
 from brpc_tpu.models.moe import (  # noqa: F401
     MoEConfig, init_moe_params, make_ep_mesh, make_sharded_moe_layer,
-    moe_layer_reference, place_moe_params,
+    make_sharded_moe_train_step, moe_layer_reference, place_moe_params,
 )

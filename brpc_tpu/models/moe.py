@@ -92,6 +92,29 @@ def make_ep_mesh(n_devices: int) -> Mesh:
     return Mesh(np.array(jax.devices()[:n_devices]), ("ep",))
 
 
+def _check_divisible(cfg: MoEConfig, ep: int) -> None:
+    if cfg.n_experts % ep:
+        raise ValueError(f"n_experts {cfg.n_experts} must divide by ep={ep}")
+
+
+def _shard_forward(router_w, wup, wdown, x, cfg: MoEConfig):
+    """ONE per-shard forward shared by the inference layer and the train
+    step (training and serving must compute identical math): dispatch,
+    all_to_all out, local expert FFN, all_to_all back, combine."""
+    dispatch, combine = _dispatch_tensors(x, router_w, cfg.n_experts,
+                                          cfg.capacity)
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, x)       # [E, C, D]
+    # exchange: split the expert axis across ep, concat the slots —
+    # each chip ends with ITS experts' buffers from EVERY shard
+    gathered = lax.all_to_all(expert_in, "ep", split_axis=0,
+                              concat_axis=1, tiled=True)
+    out = _expert_ffn(gathered, wup, wdown)   # [E/ep, ep*C, D] locally
+    # reverse exchange: send each shard its tokens back
+    returned = lax.all_to_all(out, "ep", split_axis=1, concat_axis=0,
+                              tiled=True)                    # [E, C, D]
+    return jnp.einsum("sec,ecd->sd", combine, returned)
+
+
 def make_sharded_moe_layer(mesh: Mesh, cfg: MoEConfig):
     """The expert-parallel layer: tokens sharded over ``ep``, experts
     sharded over ``ep``, two ICI all_to_alls exchanging capacity
@@ -106,25 +129,11 @@ def make_sharded_moe_layer(mesh: Mesh, cfg: MoEConfig):
     flow through (each shard routes ITS tokens with the full router).
     """
     ep = mesh.shape["ep"]
-    if cfg.n_experts % ep:
-        raise ValueError(f"n_experts {cfg.n_experts} must divide by "
-                         f"ep={ep}")
+    _check_divisible(cfg, ep)
 
     def shard_fn(router_w, wup, wdown, x):
         # x: [S_local, D]; wup/wdown: [E/ep, ...] (this shard's experts)
-        dispatch, combine = _dispatch_tensors(x, router_w, cfg.n_experts,
-                                              cfg.capacity)
-        expert_in = jnp.einsum("sec,sd->ecd", dispatch, x)   # [E, C, D]
-        # exchange: split the expert axis across ep, concat the slots —
-        # each chip ends with ITS experts' buffers from EVERY shard
-        gathered = lax.all_to_all(expert_in, "ep", split_axis=0,
-                                  concat_axis=1, tiled=True)
-        # gathered: [E/ep, ep*C, D] through this shard's experts
-        out = _expert_ffn(gathered, wup, wdown)
-        # reverse exchange: send each shard its tokens back
-        returned = lax.all_to_all(out, "ep", split_axis=1, concat_axis=0,
-                                  tiled=True)                # [E, C, D]
-        return jnp.einsum("sec,ecd->sd", combine, returned)
+        return _shard_forward(router_w, wup, wdown, x, cfg)
 
     from brpc_tpu.ici.collective import shard_map
     return jax.jit(shard_map(
@@ -132,6 +141,50 @@ def make_sharded_moe_layer(mesh: Mesh, cfg: MoEConfig):
         in_specs=(P(), P("ep", None, None), P("ep", None, None),
                   P("ep", None)),
         out_specs=P("ep", None)))
+
+
+def make_sharded_moe_train_step(mesh: Mesh, cfg: MoEConfig,
+                                lr: float = 1e-2):
+    """One SGD step through the expert-parallel layer: the loss runs the
+    sharded forward (all_to_alls included) and jax.grad differentiates
+    THROUGH the collectives — the backward pass's token returns are the
+    transposed all_to_alls, which XLA emits as ICI traffic exactly like
+    the forward.  Router gradients flow through the gate weights (the
+    dispatch one-hots are straight-through: argmax itself has no
+    gradient, matching Switch)."""
+    ep = mesh.shape["ep"]
+    _check_divisible(cfg, ep)
+
+    def shard_loss(router_w, wup, wdown, x, target):
+        y = _shard_forward(router_w, wup, wdown, x, cfg)
+        # this shard's CONTRIBUTION to the global mean — the psum is
+        # deliberately OUTSIDE the differentiated function: psum
+        # transposes to psum, so a psum'd loss inflates every cotangent
+        # by ep (measured exactly ep x vs the single-device reference)
+        local = jnp.sum((y - target) ** 2)
+        n = cfg.seq * ep * cfg.d_model
+        return local / n
+
+    def shard_step(router_w, wup, wdown, x, target):
+        contrib, grads = jax.value_and_grad(shard_loss,
+                                            argnums=(0, 1, 2))(
+            router_w, wup, wdown, x, target)
+        gr, gu, gd = grads
+        # report the GLOBAL loss; gradients through the all_to_alls are
+        # already the true global-mean grads (the collectives transpose
+        # cotangents back to the experts that produced them)
+        loss = lax.psum(contrib, "ep")
+        # router is REPLICATED: each shard's gr is its tokens'
+        # contribution — the true grad is their sum
+        gr = lax.psum(gr, "ep")
+        return (router_w - lr * gr, wup - lr * gu, wdown - lr * gd, loss)
+
+    from brpc_tpu.ici.collective import shard_map
+    return jax.jit(shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), P("ep", None, None), P("ep", None, None),
+                  P("ep", None), P("ep", None)),
+        out_specs=(P(), P("ep", None, None), P("ep", None, None), P())))
 
 
 def place_moe_params(params, mesh: Mesh):
