@@ -107,7 +107,7 @@ def bench_unary_echo(duration_s=2.0, threads=4):
             "p99_us": round(p99, 1), "threads": threads}
 
 
-def bench_echo_scaling(conn_counts=(1, 4, 16, 64), per_conn_frames=6_000):
+def bench_echo_scaling(conn_counts=(1, 4, 16, 64), per_conn_frames=15_000):
     """PYTHON-HANDLER scaling under the native C++ client pump — the
     reference's methodology (C++ client, docs/cn/benchmark.md:110-121)
     pointed at user handlers.  Each connection keeps one frame in flight,

@@ -162,8 +162,10 @@ def make_sharded_moe_train_step(mesh: Mesh, cfg: MoEConfig,
         # transposes to psum, so a psum'd loss inflates every cotangent
         # by ep (measured exactly ep x vs the single-device reference)
         local = jnp.sum((y - target) ** 2)
-        n = cfg.seq * ep * cfg.d_model
-        return local / n
+        # normalize by the ACTUAL global element count (the layer is
+        # shape-polymorphic in S; cfg.seq here would silently mis-scale
+        # loss and gradients for any other batch length)
+        return local / (y.size * ep)
 
     def shard_step(router_w, wup, wdown, x, target):
         contrib, grads = jax.value_and_grad(shard_loss,
