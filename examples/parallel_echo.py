@@ -3,6 +3,12 @@ both over TCP servers and collective-lowered over the device mesh."""
 import os, sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+if os.environ.get("BRPC_FORCE_CPU"):
+    # demo on the virtual mesh even where a site hook pre-pinned a real
+    # accelerator (same escape hatch as tests/conftest.py)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import brpc_tpu as brpc
 
 
