@@ -197,6 +197,14 @@ const BlockRef& IOBuf::ref_at(size_t i) const {
 const BlockRef& IOBuf::backing_block(size_t i) const { return ref_at(i); }
 
 void IOBuf::unref_all() {
+  // empty-buffer fast path: destroying/clearing empty IOBufs is the
+  // single most frequent call on the echo hot path (~half the 11M
+  // unref_all calls per 1M echoes were no-ops) — one check here gives
+  // the dtor, clear(), and move-assignment the fast path alike
+  if (_nref == 0 && _ring == nullptr) {
+    _nbytes = 0;
+    return;
+  }
   for (size_t i = 0; i < _nref; ++i) iobuf::block_dec_ref(ref_at(i).block);
   free(_ring);
   _ring = nullptr;
