@@ -537,7 +537,29 @@ bool TryDispatchTrpc(SocketId sid, const SocketOptions& opts, const char* meta,
     auto* p = new PendingFastResponse{sid, std::string(meta, meta_len),
                                       new butil::IOBuf(std::move(*body)),
                                       opts.on_response, opts.response_user};
-    bthread::Executor::global()->submit(run_fast_response_task, p);
+    // ORDERING: responses ride the socket's FIFO lane, the same queue
+    // SetFailed delivers on_failed through — so a peer close arriving
+    // right after the final responses can never overtake them and fail
+    // calls that actually completed (the graceful-shutdown race: the
+    // server closes the moment its last response is queued).
+    brpc::Socket* s = brpc::Socket::Address(sid);
+    if (s == nullptr) {
+      delete p->body;
+      delete p;
+      return true;
+    }
+    // bytes=0: response backlog is bounded by the CALLER's own
+    // in-flight count (unlike server reads fed by a foreign peer), and
+    // the old executor path never killed a socket for slow local
+    // completion — the lane is for ORDERING only here.  Completions
+    // serialize per connection; done-callbacks must stay light (same
+    // contract as response handling in general).
+    const bool queued = s->FifoSubmit(run_fast_response_task, p, 0);
+    s->Dereference();
+    if (!queued) {  // overcrowded: socket failed, task not queued
+      delete p->body;
+      delete p;
+    }
     return true;
   }
   return false;  // stream frames etc. go to the generic path

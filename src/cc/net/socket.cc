@@ -90,6 +90,12 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->_nwritten.store(0, std::memory_order_relaxed);
   s->_nmsg.store(0, std::memory_order_relaxed);
   s->FillRemoteAddr();
+  if (opts.on_response != nullptr && !opts.response_inline) {
+    // rpc client socket: responses ride the FIFO lane; create it HERE,
+    // before the fd is armed, so SetFailed can never observe a missing
+    // lane and deliver on_failed ahead of queued responses
+    s->EnsureFifoLane();
+  }
   // Publish with one "registration" ref (dropped by SetFailed).
   s->_vref.store(((uint64_t)version << 32) | 1, std::memory_order_release);
   g_active_sockets.fetch_add(1, std::memory_order_relaxed);
@@ -439,22 +445,61 @@ struct PendingMessage {
   butil::IOBuf* body;
   MessageCallback cb;
   void* user;
-  Socket* fifo_owner = nullptr;   // non-null: FIFO lane accounting
-  int64_t fifo_bytes = 0;
 };
 
 static void run_message_task(void* arg) {
   auto* m = (PendingMessage*)arg;
-  if (m->fifo_owner != nullptr) {
-    // release backlog credit BEFORE the callback: the callback's work is
-    // the consumer's cost, not queued bytes.  The owner Socket's storage
-    // is pool-backed (never freed), so touching the counter is safe even
-    // if the socket was recycled — worst case a recycled slot's counter
-    // wobbles transiently, and Create re-zeroes it.
-    m->fifo_owner->fifo_release(m->fifo_bytes);
-  }
   m->cb(m->sid, m->kind, m->meta.data(), m->meta.size(), m->body, m->user);
   delete m;  // callback owns *body (freed via C ABI)
+}
+
+struct FifoTask {
+  Socket* owner;
+  int64_t bytes;
+  bthread::TaskFn fn;
+  void* arg;
+};
+
+static void run_fifo_task(void* a) {
+  auto* w = (FifoTask*)a;
+  // release backlog credit BEFORE the callback (its work is the
+  // consumer's cost, not queued bytes) — same discipline as
+  // run_message_task
+  w->owner->fifo_release(w->bytes);
+  w->fn(w->arg);
+  delete w;
+}
+
+bthread::ExecutionQueue<bthread::TaskNode>* Socket::EnsureFifoLane() {
+  auto* q = _fifo_q.load(std::memory_order_acquire);
+  if (q == nullptr) {
+    // Creation sites: Create() (before the fd is armed — no concurrent
+    // SetFailed can exist yet) and the dispatcher thread.  Without the
+    // eager Create()-time lane for response sockets, a cross-thread
+    // SetFailed racing the FIRST response's lazy creation could read
+    // nullptr and deliver on_failed inline, overtaking that response.
+    q = new bthread::ExecutionQueue<bthread::TaskNode>(
+        bthread::Executor::global(),
+        [](bthread::TaskNode& t) { t.fn(t.arg); });
+    _fifo_q.store(q, std::memory_order_release);
+  }
+  return q;
+}
+
+bool Socket::FifoSubmit(bthread::TaskFn fn, void* arg, int64_t bytes) {
+  auto* q = EnsureFifoLane();
+  const int64_t limit = g_overcrowded_limit.load(std::memory_order_relaxed);
+  if (bytes > 0 && limit > 0 &&
+      _fifo_pending_bytes.load(std::memory_order_relaxed) + bytes > limit) {
+    BLOG(WARNING, "socket %llu FIFO backlog over %lld bytes, closing",
+         (unsigned long long)_id, (long long)limit);
+    SetFailed(_id, EOVERCROWDED_ERRNO);
+    return false;
+  }
+  _fifo_pending_bytes.fetch_add(bytes, std::memory_order_relaxed);
+  q->execute(bthread::TaskNode{run_fifo_task,
+                               new FifoTask{this, bytes, fn, arg}});
+  return true;
 }
 
 void Socket::DispatchMessages() {
@@ -564,34 +609,20 @@ void Socket::DispatchMessages() {
       // dispatcher thread — one slow connection can no longer stall the
       // whole event loop (the reference's per-stream ExecutionQueue,
       // stream_impl.h:133, in the socket's FIFO slot).
-      auto* q = _fifo_q.load(std::memory_order_acquire);
-      if (q == nullptr) {  // creation is dispatcher-thread only: no race
-        q = new bthread::ExecutionQueue<bthread::TaskNode>(
-            bthread::Executor::global(),
-            [](bthread::TaskNode& t) { t.fn(t.arg); });
-        _fifo_q.store(q, std::memory_order_release);
-      }
       // read-side EOVERCROWDED: inline delivery used to throttle reads
       // naturally; a queued lane needs an explicit bound or a fast peer
       // with a slow consumer grows memory without limit (same limit as
       // the write side)
-      const int64_t limit = g_overcrowded_limit.load(std::memory_order_relaxed);
       const int64_t msg_bytes =
           (int64_t)(msg.meta.size() + msg.body.size() + 256);
-      if (limit > 0 &&
-          _fifo_pending_bytes.load(std::memory_order_relaxed) + msg_bytes >
-              limit) {
-        BLOG(WARNING, "socket %llu FIFO backlog over %lld bytes, closing",
-             (unsigned long long)_id, (long long)limit);
-        SetFailed(_id, EOVERCROWDED_ERRNO);
-        return;
-      }
-      _fifo_pending_bytes.fetch_add(msg_bytes, std::memory_order_relaxed);
       auto* pm = new PendingMessage{_id, msg.kind, std::move(msg.meta),
                                     new butil::IOBuf(std::move(msg.body)),
-                                    _opts.on_message, _opts.user,
-                                    this, msg_bytes};
-      q->execute(bthread::TaskNode{run_message_task, pm});
+                                    _opts.on_message, _opts.user};
+      if (!FifoSubmit(run_message_task, pm, msg_bytes)) {
+        delete pm->body;   // overcrowded: socket failed, task not queued
+        delete pm;
+        return;
+      }
       continue;
     }
     auto* pm = new PendingMessage{_id, msg.kind, std::move(msg.meta),

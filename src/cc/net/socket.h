@@ -115,6 +115,17 @@ class Socket {
   // is enforced HERE (nullptr on exceed → caller takes the Write path,
   // which drops with -2), since the batch flushes with admitted=true.
   static butil::IOBuf* CurrentBatchFor(SocketId sid, size_t more = 0);
+  // Enqueue a task on this socket's per-connection FIFO lane
+  // (ExecutionQueue), creating the lane on first use.  DISPATCHER-THREAD
+  // ONLY (lane creation and ordering assume it).  `bytes` counts against
+  // the read-side EOVERCROWDED bound; on overflow the socket is failed
+  // and false is returned (the task was NOT queued).  Tasks run in
+  // submission order, and SetFailed's on_failed notification rides the
+  // SAME lane — so a peer close can never overtake queued deliveries.
+  bool FifoSubmit(bthread::TaskFn fn, void* arg, int64_t bytes);
+  // Create the FIFO lane if absent.  Safe only from Create() (pre-arm)
+  // or the dispatcher thread.
+  bthread::ExecutionQueue<bthread::TaskNode>* EnsureFifoLane();
   // Bytes accepted by Write but not yet written to the fd.
   int64_t pending_write_bytes() const {
     return _pending_write.load(std::memory_order_relaxed);

@@ -10,7 +10,6 @@ tickets on the socket, tensors through IciEndpoint) with
 device fall back to host tensor serialization but still deliver arrays.
 """
 import threading
-import time
 
 import jax
 import jax.numpy as jnp
@@ -25,13 +24,7 @@ from brpc_tpu.ici import rail
 D0, D1 = jax.devices()[0], jax.devices()[1]
 
 
-def _wait(pred, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(0.01)
-    return False
+from testutil import wait_until as _wait
 
 
 def _arr(device, seed, n=1024):

@@ -1,0 +1,14 @@
+"""Shared test helpers (pytest adds tests/ to sys.path for no-package
+layouts, so `from testutil import wait_until` works under both bare
+pytest and python -m pytest)."""
+import time
+
+
+def wait_until(pred, timeout=10.0, interval=0.01):
+    """Deadline poll: True once pred() holds, False at the deadline."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
