@@ -5,6 +5,11 @@ import os, sys, time
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import jax
+
+if os.environ.get("BRPC_FORCE_CPU"):
+    # demo on the virtual mesh even where a site hook pre-pinned a real
+    # accelerator (same escape hatch as tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 import brpc_tpu as brpc

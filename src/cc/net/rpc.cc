@@ -554,12 +554,9 @@ bool TryDispatchTrpc(SocketId sid, const SocketOptions& opts, const char* meta,
     // completion — the lane is for ORDERING only here.  Completions
     // serialize per connection; done-callbacks must stay light (same
     // contract as response handling in general).
-    const bool queued = s->FifoSubmit(run_fast_response_task, p, 0);
+    // bytes=0 cannot trip the overcrowded bound, so this always queues
+    s->FifoSubmit(run_fast_response_task, p, 0);
     s->Dereference();
-    if (!queued) {  // overcrowded: socket failed, task not queued
-      delete p->body;
-      delete p;
-    }
     return true;
   }
   return false;  // stream frames etc. go to the generic path

@@ -496,6 +496,12 @@ bool Socket::FifoSubmit(bthread::TaskFn fn, void* arg, int64_t bytes) {
     SetFailed(_id, EOVERCROWDED_ERRNO);
     return false;
   }
+  if (bytes == 0) {
+    // no accounting to release: skip the wrapper allocation entirely
+    // (the rpc response hot path runs here once per call)
+    q->execute(bthread::TaskNode{fn, arg});
+    return true;
+  }
   _fifo_pending_bytes.fetch_add(bytes, std::memory_order_relaxed);
   q->execute(bthread::TaskNode{run_fifo_task,
                                new FifoTask{this, bytes, fn, arg}});

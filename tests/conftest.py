@@ -40,4 +40,3 @@ def _quiet_naming_refresh_noise():
     from brpc_tpu.policy import naming  # noqa: F401 — defines the flag
     flags.set_flag("naming_log_refresh_failures", False, force=True)
     yield
-

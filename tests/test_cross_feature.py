@@ -16,10 +16,10 @@ from brpc_tpu import errors
 from testutil import wait_until as _wait
 
 
-def test_grpc_compression_through_auth_and_limiter():
-    """Compressed gRPC requests pass the SAME gates as native traffic:
-    a wrong token is rejected before decompression work, a right token
-    round-trips gzip both ways, and the method limiter still counts."""
+def test_grpc_compression_through_auth_gate():
+    """Compressed gRPC requests pass the SAME auth gate as native
+    traffic: missing AND wrong tokens are rejected, a right token
+    round-trips gzip both ways."""
     from brpc_tpu.rpc.auth import TokenAuthenticator
     from brpc_tpu.rpc.h2 import GrpcChannel
 
@@ -40,6 +40,10 @@ def test_grpc_compression_through_auth_and_limiter():
         # no token: rejected
         with pytest.raises(errors.RpcError):
             ch.call("XGate", "Echo", payload)
+        # wrong token: rejected too
+        with pytest.raises(errors.RpcError):
+            ch.call("XGate", "Echo", payload,
+                    metadata=[("authorization", "wr0ng")])
         # with token (gRPC carries it as metadata the server verifies)
         out = ch.call("XGate", "Echo", payload,
                       metadata=[("authorization", "sekrit")])
