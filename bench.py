@@ -736,26 +736,43 @@ def bench_ici_ladder(sizes=(64, 4096, 65536, 1 << 20, 1 << 24, 1 << 26)):
     return out
 
 
-def _device_reachable(timeout_s: int = 180) -> tuple[bool, str]:
+def _device_reachable(timeouts_s: tuple = (60, 90, 150)) -> tuple[bool, str]:
     """Probe jax device init in a SUBPROCESS with a hard timeout.  A
     wedged tunnel makes jax.devices() block forever inside the PJRT
     client constructor — in-process there is no way back, so a bench run
-    must discover it out-of-process or hang the whole driver.  Returns
-    (ok, cause) so a missing jax reads as an env problem, not a wedged
-    tunnel."""
+    must discover it out-of-process or hang the whole driver.  The probe
+    runs a tiny computation (not just devices()) because init can succeed
+    while the data path is wedged.  Bounded retries in FRESH subprocesses:
+    a transiently flaky tunnel often recovers between attempts, and each
+    attempt starts a clean PJRT client.  Timeouts ESCALATE (60/90/150s)
+    so a cold-but-working tunnel whose init+first-compile legitimately
+    takes >60s still passes on a later attempt, while a wedged tunnel
+    costs a bounded ~5 min total.  Returns (ok, cause) so a missing jax
+    reads as an env problem, not a wedged tunnel."""
     import subprocess
     import sys
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return False, (f"jax device init timed out after {timeout_s}s "
-                       f"(wedged tunnel?)")
-    if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
-        return False, f"jax init failed (rc={r.returncode}): {tail[0]}"
-    return True, ""
+    cause = ""
+    n = len(timeouts_s)
+    for i, timeout_s in enumerate(timeouts_s):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; "
+                 "jnp.ones((8,)).block_until_ready()"],
+                timeout=timeout_s, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            cause = (f"jax device probe timed out after {timeout_s}s "
+                     f"(wedged tunnel?), attempt {i + 1}/{n}")
+            log(f"  {cause}")
+            continue
+        if r.returncode != 0:
+            tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+            cause = (f"jax probe failed (rc={r.returncode}): {tail[0]}, "
+                     f"attempt {i + 1}/{n}")
+            log(f"  {cause}")
+            continue
+        return True, ""
+    return False, cause
 
 
 def main():
@@ -793,9 +810,13 @@ def main():
             log(f"  {name} unavailable: {e}")
             details[name] = {"error": f"{type(e).__name__}: {e}"}
     headline = details["tensor_pipe"].get("gbps")
-    if headline is None:  # gated/failed: fall back to native echo GB/s
-        headline = details["native_echo"]["qps"] * 128 / 1e9
-        details["headline_fallback"] = "native_echo"
+    # VERDICT r4 weak #1: a skipped device bench must SAY "skipped" — never
+    # publish a fallback value wearing the device metric's name.  The
+    # native-echo figure rides along under its own explicit key.
+    skipped = headline is None
+    if skipped:
+        details["headline_skip_reason"] = details["tensor_pipe"].get(
+            "error") or "tensor_pipe gated/failed"
     import platform
     try:
         if not device_ok:
@@ -817,12 +838,19 @@ def main():
             json.dump(details, f, indent=1)
     except OSError as e:
         log(f"could not write BENCH_DETAILS.json: {e}")
-    print(json.dumps({
+    line = {
         "metric": "tensor_pipe_throughput",
         "value": headline,
         "unit": "GB/s",
-        "vs_baseline": round(headline / BASELINE_GBPS, 2),
-    }))
+        "vs_baseline": (round(headline / BASELINE_GBPS, 2)
+                        if headline is not None else None),
+    }
+    if skipped:
+        line["skipped"] = True
+        line["skip_reason"] = details["headline_skip_reason"]
+        line["fallback_native_echo_gbps"] = round(
+            details["native_echo"]["qps"] * 128 / 1e9, 6)
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

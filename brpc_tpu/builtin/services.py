@@ -23,10 +23,12 @@ from brpc_tpu.builtin.router import HttpRequest, http_response
 from brpc_tpu._core import core
 
 # filesystem browsing is an explicit operator opt-in (reference
-# -enable_dir_service, off by default) — flip live on /flags
+# -enable_dir_service, a process-start gflag, off by default).  NOT
+# reloadable: a live-flippable gate would let anyone with console access
+# turn on arbitrary-file reads via /flags, so the flag guards nothing.
 define_flag("enable_dir_service", False,
-            "allow /dir to browse the server's filesystem",
-            reloadable=True)
+            "allow /dir to browse the server's filesystem (start-time only)",
+            reloadable=False)
 
 
 def build_routes(server) -> dict:

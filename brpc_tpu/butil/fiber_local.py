@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextvars
 import itertools
+import os
 import threading
 from typing import Any, Callable, Optional
 
@@ -144,12 +145,17 @@ _spawn_mu = threading.Lock()
 
 
 def _pool():
+    # Elastic: spawn()'s advertised use is offloading BLOCKING work, so a
+    # fixed tiny pool lets 8 parked spawns starve every later done().
+    # ThreadPoolExecutor only grows on demand, so a generous max costs
+    # nothing while idle; mirror usercode_backup_pool's grow-on-demand.
     global _spawn_pool
     with _spawn_mu:
         if _spawn_pool is None:
             from concurrent.futures import ThreadPoolExecutor
+            workers = max(32, 4 * (os.cpu_count() or 1))
             _spawn_pool = ThreadPoolExecutor(
-                max_workers=8, thread_name_prefix="fiber-spawn")
+                max_workers=workers, thread_name_prefix="fiber-spawn")
         return _spawn_pool
 
 

@@ -150,7 +150,15 @@ def _ensure_sweeper() -> None:
 
 def offer(arrays: list) -> tuple[int, list[dict]]:
     """Register device arrays for a remote pull.  Returns (ticket,
-    specs) where specs describe shape/dtype for the peer's pull call."""
+    specs) where specs describe shape/dtype for the peer's pull call.
+
+    Pinning caveat (ADVICE r4): ``TransferServer`` exposes no
+    cancel/deregister (only address/await_pull/connect — verified against
+    the installed jax), so the fabric-side ``await_pull`` registration
+    for a never-pulled ticket lives until the transfer server itself is
+    torn down.  The TTL sweeper and release_offer() bound only the
+    PYTHON-side strong reference; the fabric may keep the buffers pinned
+    past the TTL.  Offer sparingly for speculative sends."""
     s = transfer_server()
     assert s is not None
     ticket = next(_ticket_counter)
@@ -268,6 +276,26 @@ class DcnService(Service):
         from brpc_tpu.ici.mesh import device_for
         try:
             hdr, arrays = _unpack_envelope(bytes(req))
+        except Exception as e:
+            cntl.set_failed(errors.EREQUEST, f"bad DCN envelope: {e}")
+            return None
+        if hdr.get("ack") is not None:
+            # client confirmed pulling a previous response: unpin it.
+            # Processed FIRST so an ack piggybacks on any envelope —
+            # including the ack-only "Ack" form a concurrent caller sends
+            # when the piggyback slot is already taken.
+            try:
+                release_offer(int(hdr["ack"]))
+            except (TypeError, ValueError):
+                pass
+        if hdr.get("method") == "Ack" and hdr.get("svc") == DCN_SERVICE:
+            # svc-qualified so a user device service with a method
+            # literally named "Ack" is still dispatched normally
+            # control-only reply: the caller discards the body, and a
+            # tensor payload here would dirty the host-encode counters a
+            # pure control message must keep flat
+            return _pack_envelope({"single": True, "control": True}, [])
+        try:
             svc = str(hdr["svc"])
             meth = str(hdr["method"])
             chip = int(hdr["chip"])
@@ -284,12 +312,6 @@ class DcnService(Service):
         except Exception:
             cntl.set_failed(errors.EREQUEST, f"no local chip {chip}")
             return None
-        if hdr.get("ack") is not None:
-            # client confirmed pulling a previous response: unpin it
-            try:
-                release_offer(int(hdr["ack"]))
-            except (TypeError, ValueError):
-                pass
         peer_xfer = hdr.get("xfer")
         if peer_xfer and hdr.get("ticket") is not None:
             # ZERO-COPY request: pull the client's device buffers
@@ -352,7 +374,12 @@ class DcnChannel:
         self.default_chip = chip if chip is not None else default_chip
         self._ch = Channel(self.remote, timeout_ms=timeout_ms)
         self.topology: Optional[dict] = None
+        # piggyback-ack ticket from the last pulled response; guarded by
+        # _ack_mu so concurrent call_sync on one channel can't lose or
+        # double-send an ack (ADVICE r4 — lost acks leave server offers
+        # pinned until TTL)
         self._unacked_resp: Optional[int] = None
+        self._ack_mu = threading.Lock()
 
     def handshake(self) -> dict:
         """Exchange topologies (idempotent); returns the remote's."""
@@ -379,12 +406,16 @@ class DcnChannel:
         arrays = request if isinstance(request, (list, tuple)) else [request]
         header = {"svc": service, "method": method_name,
                   "chip": target_chip}
-        if self._unacked_resp is not None:
-            # piggyback ACK: the previous call's response was pulled, so
-            # the server can unpin those result buffers now instead of
-            # waiting out the TTL
-            header["ack"] = self._unacked_resp
-            self._unacked_resp = None
+        ack_ticket = None
+        with self._ack_mu:
+            if self._unacked_resp is not None:
+                # piggyback ACK: the previous call's response was pulled,
+                # so the server can unpin those result buffers now instead
+                # of waiting out the TTL
+                ack_ticket = self._unacked_resp
+                self._unacked_resp = None
+        if ack_ticket is not None:
+            header["ack"] = ack_ticket
         ticket = None
         # zero-copy when BOTH fabrics exist (handshaked like qp_nums):
         # device buffers stay registered locally; the socket carries
@@ -406,6 +437,15 @@ class DcnChannel:
             raw = self._ch.call_sync(DCN_SERVICE, "CallDevice", body,
                                      serializer="raw",
                                      response_serializer="raw")
+        except BaseException:
+            if ack_ticket is not None:
+                # the piggybacked ack may never have reached the server;
+                # re-park it so the next call retries (release_offer is an
+                # idempotent pop, so a duplicate ack is harmless)
+                with self._ack_mu:
+                    if self._unacked_resp is None:
+                        self._unacked_resp = ack_ticket
+            raise
         finally:
             if ticket is not None:
                 # the reply means the server pulled (it needed the
@@ -424,7 +464,28 @@ class DcnChannel:
                 local_dev = jax.devices()[0]
             outs = pull(hdr["xfer"], int(hdr["ticket"]),
                         hdr.get("specs") or [], local_dev)
-            self._unacked_resp = int(hdr["ticket"])
+            oob_ticket = None
+            with self._ack_mu:
+                if self._unacked_resp is None:
+                    self._unacked_resp = int(hdr["ticket"])
+                else:
+                    # a concurrent call already parked a ticket; ack this
+                    # one out-of-band rather than dropping either
+                    oob_ticket = int(hdr["ticket"])
+            if oob_ticket is not None:
+                # fire-and-forget OUTSIDE _ack_mu and off the caller's
+                # critical path: a blocking ack round-trip would add up to
+                # the channel timeout before returning already-pulled
+                # results.  Failure is fine — the TTL backstop reclaims.
+                try:
+                    self._ch.call(
+                        DCN_SERVICE, "CallDevice",
+                        _pack_envelope({"svc": DCN_SERVICE, "method": "Ack",
+                                        "ack": oob_ticket}, []),
+                        done=lambda c: None,
+                        serializer="raw", response_serializer="raw")
+                except errors.RpcError:
+                    pass  # TTL backstop reclaims it
         else:
             outs = [jax.numpy.asarray(a) for a in out_arrays]
         return outs[0] if hdr.get("single", True) else outs

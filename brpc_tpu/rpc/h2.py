@@ -400,6 +400,31 @@ class H2Connection:
             if last:
                 return
 
+    def _enter_fatal(self, code: int) -> None:
+        """Mark the connection unrecoverable (desynced HPACK / protocol
+        violation), GOAWAY the peer, and notify the subclass so in-flight
+        work fails NOW instead of by timeout (ADVICE r4: a client conn
+        that reported alive() after _fatal kept being reused until the
+        peer closed the socket)."""
+        self._fatal = True
+        try:
+            self.send_goaway(code=code)
+        except Exception:
+            pass
+        self.on_fatal()
+
+    def on_fatal(self) -> None:
+        """Subclass hook: fail registered calls/sinks, stop advertising
+        alive().  Default: close the socket — relying on the peer's
+        reaction to GOAWAY would let a peer that ignores it pin the fd,
+        stream buffers, and dispatcher registration forever (one
+        malformed frame per connection, then hold it open)."""
+        if self.sid is not None:
+            try:
+                self._tp.close(self.sid)
+            except Exception:
+                pass
+
     def send_rst(self, stream_id: int, code: int) -> None:
         self._send(build_frame(RST_STREAM, 0, stream_id,
                                struct.pack(">I", code)))
@@ -422,11 +447,13 @@ class H2Connection:
             # compliant peer never sends this, and an oversized HEADERS
             # would bypass MAX_HEADER_BLOCK 16x (the native parser caps
             # frames at 16MB, not at our advertisement)
-            self._fatal = True
-            self.send_goaway(code=H2_FRAME_SIZE_ERROR)
+            self._enter_fatal(H2_FRAME_SIZE_ERROR)
             return
         if self._cont_stream is not None and ftype != CONTINUATION:
-            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            # RFC 7540 §6.10: interleaving inside a header block is a
+            # CONNECTION error — and the partial block's dynamic-table
+            # inserts were never applied, so later decodes would desync
+            self._enter_fatal(H2_PROTOCOL_ERROR)
             return
         if ftype == SETTINGS:
             self._on_settings(flags, payload)
@@ -499,7 +526,9 @@ class H2Connection:
         pad = 0
         if flags & FLAG_PADDED:
             if not payload:
-                self.send_goaway(code=H2_PROTOCOL_ERROR)
+                # §6.1 connection error; for HEADERS the dropped block
+                # also desyncs HPACK, so the connection is unrecoverable
+                self._enter_fatal(H2_PROTOCOL_ERROR)
                 return None
             pad = payload[0]
             pos = 1
@@ -507,7 +536,7 @@ class H2Connection:
             pos += 5
         end = len(payload) - pad
         if end < pos:
-            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            self._enter_fatal(H2_PROTOCOL_ERROR)
             return None
         return payload[pos:end]
 
@@ -516,7 +545,9 @@ class H2Connection:
 
     def _on_headers(self, stream_id: int, flags: int, payload: bytes) -> None:
         if stream_id == 0:
-            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            # §6.2 connection error; the undecoded block's table inserts
+            # would desync every later decode
+            self._enter_fatal(H2_PROTOCOL_ERROR)
             return
         block = self._strip_padding(flags, payload, priority=True)
         if block is None:
@@ -535,7 +566,10 @@ class H2Connection:
     def _on_continuation(self, stream_id: int, flags: int,
                          payload: bytes) -> None:
         if self._cont_stream != stream_id:
-            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            # CONTINUATION for the wrong stream (or none pending): §6.10
+            # connection error, and the pending block (if any) is now
+            # unfinishable without desyncing HPACK
+            self._enter_fatal(H2_PROTOCOL_ERROR)
             return
         st = self._stream(stream_id)
         st.header_block += payload
@@ -546,8 +580,7 @@ class H2Connection:
             # never applied, so later blocks would decode wrongly
             st.header_block = bytearray()
             self._cont_stream = None
-            self._fatal = True
-            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            self._enter_fatal(H2_PROTOCOL_ERROR)
             return
         if flags & FLAG_END_HEADERS:
             self._cont_stream = None
@@ -558,8 +591,7 @@ class H2Connection:
             headers = self._dec.decode(bytes(st.header_block))
         except ValueError:
             # undecodable block = desynced dynamic table: fatal (§4.3)
-            self._fatal = True
-            self.send_goaway(code=H2_PROTOCOL_ERROR)
+            self._enter_fatal(H2_PROTOCOL_ERROR)
             return
         st.header_block = bytearray()
         if st.trailer_phase:
@@ -1357,7 +1389,17 @@ class _GrpcClientConnection(H2Connection):
         self.send_preface_and_settings()
 
     def alive(self) -> bool:
-        return not self._goaway and self._tp.alive(self.sid)
+        return (not self._goaway and not self._fatal
+                and self._tp.alive(self.sid))
+
+    def on_fatal(self) -> None:
+        # fail every in-flight call/sink immediately; alive() is already
+        # False (self._fatal), so GrpcChannel._ensure reconnects next
+        # call.  Then close the socket (base behavior): _ensure drops the
+        # reference without closing, so leaving it open would leak the fd
+        # until the peer reacts to GOAWAY.
+        self._on_failed(self.sid, errors.EFAILEDSOCKET)
+        super().on_fatal()
 
     def close(self) -> None:
         try:

@@ -152,7 +152,13 @@ def test_dir_browses_filesystem(server):
     # gated by default: no filesystem access without the flag
     status, body = _get(server, "/dir/tmp")
     assert status == 200 and b"disabled" in body and b"<ul>" not in body
-    assert flags.set_flag("enable_dir_service", True)
+    # NOT reloadable (ADVICE r4): the console's /flags route must refuse,
+    # or console access alone would grant arbitrary-file reads
+    assert not flags.set_flag("enable_dir_service", True)
+    status, body = _get(server, "/dir/tmp")
+    assert status == 200 and b"disabled" in body
+    # the process-start path (reference: -enable_dir_service gflag)
+    assert flags.set_flag("enable_dir_service", True, force=True)
     status, body = _get(server, "/dir/tmp")
     assert status == 200 and b"<ul>" in body
     # a real file round-trips (first bytes)
@@ -176,4 +182,4 @@ def test_dir_browses_filesystem(server):
         assert status == 200 and body == b"quoted"
     finally:
         os.unlink(probe2)
-    flags.set_flag("enable_dir_service", False)
+    flags.set_flag("enable_dir_service", False, force=True)

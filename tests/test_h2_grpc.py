@@ -791,3 +791,32 @@ def test_grpc_handler_sees_request_metadata():
     finally:
         srv.stop()
         srv.join()
+
+
+def test_grpc_fatal_fails_inflight_and_reconnects(grpc_server):
+    """ADVICE r4: after a client-side fatal h2 condition (HPACK desync /
+    oversized frame) the connection must (a) fail every in-flight
+    call/sink NOW — not by timeout — and (b) stop reporting alive() so
+    GrpcChannel._ensure opens a fresh connection."""
+    from concurrent.futures import Future
+
+    ch = GrpcChannel(f"127.0.0.1:{grpc_server.port}")
+    assert ch.call("test.GrpcEcho", "Echo", b"warm") == b"warm"
+    conn = ch._ensure()
+    fut = Future()
+    with conn._calls_lock:
+        conn._calls[9999] = fut
+    import queue as _q
+    sink = _q.Queue()
+    with conn._calls_lock:
+        conn._sinks[9997] = sink
+    conn._enter_fatal(0x9)          # H2_COMPRESSION_ERROR-class condition
+    assert not conn.alive()
+    with pytest.raises(errors.RpcError):
+        fut.result(timeout=2)       # failed immediately, not by timeout
+    got = sink.get(timeout=2)
+    assert isinstance(got, errors.RpcError)
+    # channel transparently reconnects: next call works on a NEW conn
+    assert ch.call("test.GrpcEcho", "Echo", b"again") == b"again"
+    assert ch._ensure() is not conn
+    ch.close()
