@@ -22,6 +22,32 @@ inline int64_t monotonic_time_ns() {
 
 inline int64_t monotonic_time_us() { return monotonic_time_ns() / 1000; }
 
+// Cheap cycle counter for hot-loop timestamping (reference butil
+// cpuwide_time_us, src/butil/time.h — TSC with calibrated frequency).
+// x86 rdtsc is ~8ns vs ~25ns for the vdso clock_gettime; on other arches
+// fall back to the clock.  Use cpuwide_time_us() ONLY for intervals (the
+// epoch is arbitrary); calibration is one-time, invariant-TSC assumed
+// (every x86_64 this decade).
+#if defined(__x86_64__)
+inline uint64_t rdtsc() { return __builtin_ia32_rdtsc(); }
+// Calibration data, eagerly initialized at library load (logging.cc) so
+// the read path below is branch-and-guard-free.
+struct TscCalib {
+  uint64_t tsc0;
+  int64_t ns0;
+  double ns_per_tick;
+};
+extern TscCalib g_tsc_calib;
+inline int64_t cpuwide_time_us() {
+  return g_tsc_calib.ns0 / 1000 +
+         int64_t(double(rdtsc() - g_tsc_calib.tsc0) *
+                 g_tsc_calib.ns_per_tick) /
+             1000;
+}
+#else
+inline int64_t cpuwide_time_us() { return monotonic_time_us(); }
+#endif
+
 inline int64_t realtime_time_us() {
   timespec ts;
   clock_gettime(CLOCK_REALTIME, &ts);

@@ -272,6 +272,20 @@ void IOBuf::append(const void* data, size_t n) {
     const uint32_t off = (uint32_t)iobuf::block_size(b);
     memcpy(iobuf::block_data(b) + off, p, m);
     iobuf::block_set_size(b, off + m);
+    // Tail-merge FIRST: consecutive appends through the TLS write block
+    // are the hot path, and going through inc_ref + push_ref's merge
+    // (which dec_refs right back) cost two atomic RMWs per call for
+    // nothing.  Only a genuinely new ref touches the refcount.
+    if (_nref > 0) {
+      BlockRef& tail = ref_at(_nref - 1);
+      if (tail.block == b && tail.offset + tail.length == off) {
+        tail.length += (uint32_t)m;
+        _nbytes += m;
+        p += m;
+        n -= m;
+        continue;
+      }
+    }
     iobuf::block_inc_ref(b);
     push_ref(BlockRef{off, (uint32_t)m, b});
     p += m;

@@ -33,4 +33,25 @@ void log_message(int level, const char* fmt, ...) {
   if (level >= LOG_FATAL) abort();
 }
 
+#if defined(__x86_64__)
+// One-time TSC calibration against CLOCK_MONOTONIC at library load:
+// sample both clocks ~10ms apart, derive ns-per-tick.  Invariant TSC
+// keeps the rate constant across cores/frequency states on any modern
+// x86_64 (the same assumption the reference's butil::cpuwide_time makes,
+// src/butil/time.h).
+static TscCalib make_tsc_calib() {
+  TscCalib c;
+  c.tsc0 = rdtsc();
+  c.ns0 = monotonic_time_ns();
+  timespec req{0, 10 * 1000 * 1000};
+  nanosleep(&req, nullptr);
+  const uint64_t tsc1 = rdtsc();
+  const int64_t ns1 = monotonic_time_ns();
+  c.ns_per_tick =
+      tsc1 > c.tsc0 ? double(ns1 - c.ns0) / double(tsc1 - c.tsc0) : 1.0;
+  return c;
+}
+TscCalib g_tsc_calib = make_tsc_calib();
+#endif
+
 }  // namespace butil

@@ -42,9 +42,18 @@ int32_t bench_echo_handler(SocketId, butil::IOBuf* body,
   return 0;
 }
 
+// Zero-ref form: request viewed in the read block, response memcpy'd into
+// the dispatch loop's flat stage (net/rpc.h NativeMethodFlatFn).
+int32_t bench_echo_handler_flat(SocketId, const char* req, size_t req_len,
+                                char* resp, size_t resp_cap, void*) {
+  if (req_len > resp_cap) return -1;  // oversized: IOBuf fallback
+  memcpy(resp, req, req_len);
+  return (int32_t)req_len;
+}
+
 void bench_send_one(SocketId sid, BenchState* st) {
   static const char kPayload[4096] = {0};
-  const uint64_t cid = (uint64_t)butil::monotonic_time_us();
+  const uint64_t cid = (uint64_t)butil::cpuwide_time_us();
   // Inside this socket's dispatch drain (pipelined next-send from the
   // response callback): stage the whole frame into the write batch.
   butil::IOBuf* batch = Socket::CurrentBatchFor(sid, st->payload_len + 96);
@@ -67,12 +76,9 @@ void bench_send_one(SocketId sid, BenchState* st) {
   }
 }
 
-void bench_on_response(SocketId sid, const RequestHeader* hdr,
-                       butil::IOBuf* body, void* user) {
-  // body is BORROWED (response_inline mode) — do not free
-  (void)body;
+void bench_note_response(SocketId sid, const RequestHeader* hdr, void* user) {
   auto* st = (BenchState*)user;
-  const uint64_t now = (uint64_t)butil::monotonic_time_us();
+  const uint64_t now = (uint64_t)butil::cpuwide_time_us();
   const uint64_t idx = st->lat_idx.fetch_add(1, std::memory_order_relaxed);
   if (idx < st->lat_us.size()) {
     st->lat_us[idx] = (uint32_t)std::min<uint64_t>(now - hdr->cid, 0xffffffff);
@@ -88,6 +94,20 @@ void bench_on_response(SocketId sid, const RequestHeader* hdr,
     st->finished = true;
     st->cv.notify_all();
   }
+}
+
+void bench_on_response(SocketId sid, const RequestHeader* hdr,
+                       butil::IOBuf* body, void* user) {
+  // body is BORROWED (response_inline mode) — do not free
+  (void)body;
+  bench_note_response(sid, hdr, user);
+}
+
+void bench_on_response_flat(SocketId sid, const RequestHeader* hdr,
+                            const char* body, size_t body_len, void* user) {
+  (void)body;
+  (void)body_len;
+  bench_note_response(sid, hdr, user);
 }
 
 void bench_noop_failed(SocketId, int, void*) {}
@@ -121,6 +141,7 @@ int run_pump(int port, const char* service, const char* method, int conns,
   for (int i = 0; i < conns; ++i) {
     SocketOptions copts;
     copts.on_response = bench_on_response;
+    copts.on_response_flat = bench_on_response_flat;
     copts.response_user = &st;
     copts.response_inline = true;
     copts.on_failed = bench_noop_failed;
@@ -189,8 +210,10 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
       payload_len > 4096) {
     return -1;
   }
-  MethodRegistry::global()->Register("BenchEcho", "Echo", bench_echo_handler,
-                                     nullptr, inline_run != 0);
+  MethodRegistry::global()->RegisterFlat("BenchEcho", "Echo",
+                                         bench_echo_handler,
+                                         bench_echo_handler_flat, nullptr,
+                                         inline_run != 0);
   SocketOptions server_opts;
   server_opts.enable_rpc_dispatch = true;
   SocketId listener = INVALID_SOCKET_ID;

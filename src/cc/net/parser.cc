@@ -506,18 +506,18 @@ ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out) 
   return PARSE_OK;
 }
 
-ParseResult parse_trpc_view(butil::IOBuf* in, const char** meta,
-                            size_t* meta_len, uint64_t* body_size,
-                            butil::IOBuf* guard, bool* viewed) {
-  // ZERO-COPY meta: the common case has header+meta contiguous in the
-  // read buffer's first block (8KB blocks vs ~50B metas), so the meta
-  // can be VIEWED in place instead of copied into a std::string — the
-  // copy machinery (resize + cutn + ref churn) was a top-3 cost of the
-  // echo hot path.  `guard` takes one block ref keeping the view alive
-  // after header+meta are popped; *viewed=false with PARSE_OK means
-  // "not contiguous / not TRPC — use the generic parse_message", with
-  // NOTHING consumed.
-  *viewed = false;
+ParseResult parse_trpc_peek(butil::IOBuf* in, const char** meta,
+                            size_t* meta_len, const char** body,
+                            uint64_t* body_size, uint64_t* total_len) {
+  // ZERO-COPY, ZERO-REF peek: the common case has header+meta (and for
+  // small frames the body too) contiguous in the read buffer's first
+  // block (8KB blocks vs ~50B metas + ~100B bodies).  Nothing is
+  // consumed and no block ref is taken — the bytes stay at the front of
+  // `in` while the dispatch runs, so the views are naturally alive; the
+  // caller pops after dispatch.  *meta == nullptr with PARSE_OK means
+  // "not contiguous / not TRPC — use the generic parse_message".
+  *meta = nullptr;
+  *body = nullptr;
   if (in->size() < kTrpcHeaderLen) return PARSE_NEED_MORE;
   if (in->backing_block_num() == 0) return PARSE_NEED_MORE;
   const butil::BlockRef& r0 = in->backing_block(0);
@@ -531,13 +531,11 @@ ParseResult parse_trpc_view(butil::IOBuf* in, const char** meta,
   if (in->size() < total) return PARSE_NEED_MORE;
   if ((uint64_t)r0.length < kTrpcHeaderLen + (uint64_t)msz)
     return PARSE_OK;                                   // meta split
-  guard->clear();
-  guard->add_block_ref(r0);        // view stays valid past the pops
   *meta = p + kTrpcHeaderLen;
   *meta_len = msz;
   *body_size = bsz;
-  in->pop_front(kTrpcHeaderLen + msz);
-  *viewed = true;
+  *total_len = total;
+  if ((uint64_t)r0.length >= total) *body = p + kTrpcHeaderLen + msz;
   return PARSE_OK;
 }
 

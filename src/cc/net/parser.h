@@ -100,15 +100,18 @@ struct ParseState {
 // consumed bytes from `in`; PARSE_NEED_MORE leaves `in` intact.
 ParseResult parse_message(butil::IOBuf* in, ParseState* st, ParsedMessage* out);
 
-// In-place TRPC fast path for the dispatch loop (zero-copy meta view).
-// On PARSE_OK with *viewed=true: header+meta are consumed, the meta view
-// is valid while *guard lives, and exactly *body_size bytes of body sit
-// at the buffer front.  PARSE_OK with *viewed=false: nothing consumed —
-// caller must use the generic parse_message (split frame / other
-// protocol).  PARSE_NEED_MORE / PARSE_ERROR as usual.
-ParseResult parse_trpc_view(butil::IOBuf* in, const char** meta,
-                            size_t* meta_len, uint64_t* body_size,
-                            butil::IOBuf* guard, bool* viewed);
+// In-place TRPC fast path for the dispatch loop — a pure PEEK: nothing
+// is consumed and NO block refs are taken (the per-frame guard
+// inc_ref/dec_ref pair was 17% of the echo hot path).  On PARSE_OK with
+// *meta != nullptr: header+meta are contiguous and viewed in place;
+// *body is additionally non-null when the body is contiguous too;
+// *total_len is the full frame length for the caller's pop_front after
+// dispatch.  Views stay valid only while the caller has not consumed
+// the front of `in`.  PARSE_OK with *meta == nullptr: not TRPC / split
+// header or meta — use the generic parse_message.
+ParseResult parse_trpc_peek(butil::IOBuf* in, const char** meta,
+                            size_t* meta_len, const char** body,
+                            uint64_t* body_size, uint64_t* total_len);
 
 // Serialize a TRPC frame header.
 void make_trpc_header(char out[16], uint32_t meta_size, uint64_t body_size);

@@ -285,6 +285,10 @@ butil::DoublyBufferedData<MethodMap>& methods() {
   static butil::DoublyBufferedData<MethodMap> maps;
   return maps;
 }
+// Bumped on every registry mutation; validates the per-thread last-hit
+// cache below (consecutive requests on a connection overwhelmingly name
+// the same method — the hash+DBD probe was a visible hot-path cost).
+std::atomic<uint64_t> g_registry_version{1};
 std::atomic<int64_t> g_native_calls{0};
 std::atomic<int64_t> g_python_fast_calls{0};
 // replies whose socket Write was rejected (EOVERCROWDED / failed socket)
@@ -311,12 +315,19 @@ MethodRegistry* MethodRegistry::global() {
 
 void MethodRegistry::Register(const char* service, const char* method,
                               NativeMethodFn fn, void* user, bool inline_run) {
+  RegisterFlat(service, method, fn, nullptr, user, inline_run);
+}
+
+void MethodRegistry::RegisterFlat(const char* service, const char* method,
+                                  NativeMethodFn fn, NativeMethodFlatFn flat,
+                                  void* user, bool inline_run) {
   std::string key = make_key(service, strlen(service), method, strlen(method));
-  Entry e{fn, user, inline_run};
+  Entry e{fn, flat, user, inline_run};
   methods().Modify([&](MethodMap& m) {
     m.insert(key, e);
     return true;
   });
+  g_registry_version.fetch_add(1, std::memory_order_release);
 }
 
 void MethodRegistry::RegisterPython(const char* service, const char* method) {
@@ -330,6 +341,7 @@ bool MethodRegistry::Unregister(const char* service, const char* method) {
     existed = m.erase(key);
     return true;
   });
+  g_registry_version.fetch_add(1, std::memory_order_release);
   return existed;
 }
 
@@ -341,11 +353,28 @@ bool MethodRegistry::Lookup(const char* service, size_t service_len,
   std::string heap_key;
   std::string_view key;
   const size_t total = service_len + 1 + method_len;
+  // per-thread last-hit cache: a connection's requests overwhelmingly
+  // repeat one method, so a 20-byte memcmp replaces hash + DBD read +
+  // probe.  Only HITS are cached; any registry mutation bumps
+  // g_registry_version and invalidates every thread's entry.
+  struct LastHit {
+    uint64_t version = 0;
+    size_t len = 0;
+    Entry e;
+    char key[128];
+  };
+  static thread_local LastHit tls_hit;
+  const uint64_t ver = g_registry_version.load(std::memory_order_acquire);
   if (total <= sizeof(buf)) {
     memcpy(buf, service, service_len);
     buf[service_len] = '\0';
     memcpy(buf + service_len + 1, method, method_len);
     key = std::string_view(buf, total);
+    if (tls_hit.version == ver && tls_hit.len == total &&
+        memcmp(tls_hit.key, buf, total) == 0) {
+      *out = tls_hit.e;
+      return true;
+    }
   } else {
     heap_key = make_key(service, service_len, method, method_len);
     key = heap_key;
@@ -355,6 +384,12 @@ bool MethodRegistry::Lookup(const char* service, size_t service_len,
   const Entry* e = ptr->seek(key);
   if (e == nullptr) return false;
   *out = *e;
+  if (total <= sizeof(tls_hit.key)) {
+    tls_hit.version = ver;
+    tls_hit.len = total;
+    memcpy(tls_hit.key, key.data(), total);
+    tls_hit.e = *e;
+  }
   return true;
 }
 
@@ -526,7 +561,20 @@ bool TryDispatchTrpc(SocketId sid, const SocketOptions& opts, const char* meta,
   }
 
   if (m.msg_type == META_RESPONSE) {
-    if (opts.on_response == nullptr) return false;
+    if (opts.on_response == nullptr && opts.on_response_flat == nullptr)
+      return false;
+    if (opts.on_response == nullptr) {
+      // flat-only client: deliver borrowed multi-block body inline (the
+      // flat path handles the contiguous common case; this is the
+      // split-frame tail of the same contract)
+      RequestHeader hdr;
+      fill_header(&hdr, m);
+      std::string tmp = body->to_string();
+      opts.on_response_flat(sid, &hdr, tmp.data(), tmp.size(),
+                            opts.response_user);
+      body->clear();
+      return true;
+    }
     if (opts.response_inline) {
       RequestHeader hdr;
       fill_header(&hdr, m);
@@ -560,6 +608,64 @@ bool TryDispatchTrpc(SocketId sid, const SocketOptions& opts, const char* meta,
     return true;
   }
   return false;  // stream frames etc. go to the generic path
+}
+
+bool TryDispatchTrpcFlat(SocketId sid, const SocketOptions& opts,
+                         const char* meta, size_t meta_len, const char* body,
+                         size_t body_len) {
+  ParsedMeta m;
+  if (!ParseMeta(meta, meta_len, &m)) return false;
+  if (!MetaIsFastPath(m)) return false;
+
+  if (m.msg_type == META_RESPONSE) {
+    if (opts.on_response_flat == nullptr) return false;
+    RequestHeader hdr;
+    fill_header(&hdr, m);
+    opts.on_response_flat(sid, &hdr, body, body_len, opts.response_user);
+    return true;
+  }
+  if (m.msg_type != META_REQUEST) return false;
+  if (!opts.enable_rpc_dispatch) return false;
+  if (m.service == nullptr || m.method == nullptr) return false;
+  MethodRegistry::Entry e;
+  if (!MethodRegistry::global()->Lookup(m.service, m.service_len, m.method,
+                                        m.method_len, &e)) {
+    return false;
+  }
+  if (e.fn_flat == nullptr || !e.inline_run) return false;
+  // One stack stage holds the whole response frame:
+  //   [16B trpc header][14B rc==0 response meta][resp body]
+  // so the write batch gets ONE contiguous append — no body IOBuf on
+  // either side of the handler, no block refs, one iovec span.
+  char stage[kTrpcHeaderLen + kMetaFixedLen + kFlatRespCap];
+  char* const meta_p = stage + kTrpcHeaderLen;
+  char* const resp_p = meta_p + kMetaFixedLen;
+  const int32_t rlen =
+      e.fn_flat(sid, body, body_len, resp_p, kFlatRespCap, e.user);
+  if (rlen < 0) return false;  // declined pre-side-effect: IOBuf path
+  g_native_calls.fetch_add(1, std::memory_order_relaxed);
+  meta_p[0] = 1;  // version
+  meta_p[1] = (char)META_RESPONSE;
+  meta_p[2] = meta_p[3] = 0;  // flags
+  memcpy(meta_p + 4, &m.cid, 8);
+  memcpy(meta_p + 12, &m.attempt, 2);
+  make_trpc_header(stage, kMetaFixedLen, (uint64_t)rlen);
+  const size_t frame_len = kTrpcHeaderLen + kMetaFixedLen + (size_t)rlen;
+  butil::IOBuf* batch = Socket::CurrentBatchFor(sid, frame_len);
+  if (batch != nullptr) {
+    batch->append(stage, frame_len);
+    return true;
+  }
+  butil::IOBuf frame;
+  frame.append(stage, frame_len);
+  Socket* s = Socket::Address(sid);
+  if (s != nullptr) {
+    if (s->Write(std::move(frame)) != 0) {
+      g_dropped_responses.fetch_add(1, std::memory_order_relaxed);
+    }
+    s->Dereference();
+  }
+  return true;
 }
 
 }  // namespace brpc

@@ -110,6 +110,21 @@ void PackRequestFrameFlat(butil::IOBuf* out, uint64_t cid, uint16_t attempt,
 typedef int32_t (*NativeMethodFn)(SocketId sid, butil::IOBuf* body,
                                   butil::IOBuf* resp_body, void* user);
 
+// Flat inline handler (the zero-ref hot path): the request body is a VIEW
+// into the socket's read block (valid only for the duration of the call)
+// and the response body is written straight into a stack stage that lands
+// in the dispatch write batch as ONE contiguous span — no IOBuf, no
+// block refs, no extra iovecs on either side.  Returns the response
+// length (>= 0, rc 0 implied), or -1 to fall back to the IOBuf handler
+// `fn` (only allowed BEFORE any side effect: the request is re-delivered).
+typedef int32_t (*NativeMethodFlatFn)(SocketId sid, const char* req,
+                                      size_t req_len, char* resp,
+                                      size_t resp_cap, void* user);
+
+// Response stage capacity offered to flat handlers (stack-allocated in
+// the dispatch loop; responses above this take the IOBuf path).
+constexpr size_t kFlatRespCap = 4096;
+
 // Pre-parsed request surfaced to Python.  hdr fields alias raw_meta, which
 // is only valid during the call; body ownership transfers to the callee.
 struct RequestHeader {
@@ -136,6 +151,11 @@ typedef void (*RequestCallback)(SocketId sid, const RequestHeader* hdr,
 // Client side: pre-parsed response.  Same aliasing rules.
 typedef void (*ResponseCallback)(SocketId sid, const RequestHeader* hdr,
                                  butil::IOBuf* body, void* user);
+// Flat inline response: body is a view into the read block, valid only
+// for the duration of the call (zero-ref client hot path).
+typedef void (*ResponseFlatCallback)(SocketId sid, const RequestHeader* hdr,
+                                     const char* body, size_t body_len,
+                                     void* user);
 
 class MethodRegistry {
  public:
@@ -146,11 +166,18 @@ class MethodRegistry {
   // an executor task (only for handlers that never block).
   void Register(const char* service, const char* method, NativeMethodFn fn,
                 void* user, bool inline_run);
+  // Register both forms: `flat` runs when the request body is contiguous
+  // in the read block and the response fits kFlatRespCap; `fn` is the
+  // fallback for split/oversized frames (and MUST be provided).
+  void RegisterFlat(const char* service, const char* method,
+                    NativeMethodFn fn, NativeMethodFlatFn flat, void* user,
+                    bool inline_run);
   void RegisterPython(const char* service, const char* method);
   bool Unregister(const char* service, const char* method);
 
   struct Entry {
     NativeMethodFn fn = nullptr;  // null => python
+    NativeMethodFlatFn fn_flat = nullptr;
     void* user = nullptr;
     bool inline_run = false;
   };
@@ -178,5 +205,13 @@ struct SocketOptions;
 // generic on_message path and still owns body.
 bool TryDispatchTrpc(SocketId sid, const SocketOptions& opts,
                      const char* meta, size_t meta_len, butil::IOBuf* body);
+
+// Zero-ref variant: meta AND body are views into the read block.  Returns
+// true when fully handled (caller pops the body bytes); false => caller
+// takes the IOBuf path (cutn + TryDispatchTrpc) with NOTHING consumed —
+// flat handlers must not have had side effects before falling back.
+bool TryDispatchTrpcFlat(SocketId sid, const SocketOptions& opts,
+                         const char* meta, size_t meta_len, const char* body,
+                         size_t body_len);
 
 }  // namespace brpc

@@ -534,14 +534,15 @@ void Socket::DispatchMessages() {
     // Falls back to the generic parser for split frames / other
     // protocols with nothing consumed.
     if (_parse.detected == MSG_TRPC && !_opts.native_echo &&
-        (_opts.enable_rpc_dispatch || _opts.on_response != nullptr)) {
+        (_opts.enable_rpc_dispatch || _opts.on_response != nullptr ||
+         _opts.on_response_flat != nullptr)) {
       const char* mview = nullptr;
       size_t mlen = 0;
+      const char* bview = nullptr;
       uint64_t blen = 0;
-      bool viewed = false;
-      butil::IOBuf meta_guard;  // NOT the write-batch RAII guard above
-      const ParseResult r = parse_trpc_view(&_read_buf, &mview, &mlen, &blen,
-                                            &meta_guard, &viewed);
+      uint64_t total = 0;
+      const ParseResult r = parse_trpc_peek(&_read_buf, &mview, &mlen,
+                                            &bview, &blen, &total);
       if (r == PARSE_NEED_MORE) return;
       if (r == PARSE_ERROR) {
         BLOG(WARNING, "parse error on socket %llu, closing",
@@ -549,9 +550,25 @@ void Socket::DispatchMessages() {
         SetFailed(_id, EPROTO);
         return;
       }
-      if (viewed) {
+      if (mview != nullptr) {
         _nmsg.fetch_add(1, std::memory_order_relaxed);
         g_total_messages.add(1);
+        // body also contiguous (the common case for small frames):
+        // zero-ref flat dispatch — no pops yet, no body IOBuf, no block
+        // refs; the response is staged flat into the write batch
+        if (bview != nullptr || blen == 0) {
+          if (TryDispatchTrpcFlat(_id, _opts, mview, mlen,
+                                  bview != nullptr ? bview : "",
+                                  (size_t)blen)) {
+            _read_buf.pop_front(total);
+            continue;
+          }
+        }
+        // IOBuf path: take ONE guard ref so the meta view survives the
+        // pops, then cut the body out
+        butil::IOBuf meta_guard;  // NOT the write-batch RAII guard above
+        meta_guard.add_block_ref(_read_buf.backing_block(0));
+        _read_buf.pop_front(kTrpcHeaderLen + mlen);
         msg.body.clear();
         _read_buf.cutn(&msg.body, blen);
         if (TryDispatchTrpc(_id, _opts, mview, mlen, &msg.body)) {
@@ -564,7 +581,7 @@ void Socket::DispatchMessages() {
         meta_guard.clear();
         goto generic_delivery;
       }
-      // viewed==false: split frame or protocol re-detection — fall
+      // mview==nullptr: split frame or protocol re-detection — fall
       // through to the full parser
     }
     {
@@ -592,9 +609,13 @@ void Socket::DispatchMessages() {
       continue;
     }
     if (msg.kind == MSG_TRPC &&
-        (_opts.enable_rpc_dispatch || _opts.on_response != nullptr)) {
+        (_opts.enable_rpc_dispatch || _opts.on_response != nullptr ||
+         _opts.on_response_flat != nullptr)) {
       // Native unary hot path (net/rpc.h): parse meta, method lookup and
       // response packing in C++; Python sees pre-parsed requests only.
+      // The gate must match the peek-path gate above: a flat-only client
+      // still needs split-frame responses delivered (rpc.cc's flat-only
+      // to_string branch), not dropped at generic_delivery.
       if (TryDispatchTrpc(_id, _opts, msg.meta.data(), msg.meta.size(),
                           &msg.body)) {
         continue;

@@ -72,6 +72,11 @@ struct SocketOptions {
   // heap body.  Only for non-blocking native callbacks (the bench pump);
   // writes issued from the callback join the dispatch write batch.
   bool response_inline = false;
+  // Zero-ref inline response delivery: when set (implies the
+  // response_inline contract), a response whose body is contiguous in
+  // the read block is delivered as a flat view — no body IOBuf, no
+  // block refs.  Split/oversized bodies still arrive via on_response.
+  ResponseFlatCallback on_response_flat = nullptr;
   // Opt in to native REQUEST dispatch via the MethodRegistry (server
   // sockets); off by default so raw-frame users see every message.
   bool enable_rpc_dispatch = false;

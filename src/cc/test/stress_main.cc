@@ -715,26 +715,29 @@ static void stress_parser_fuzz() {
         if (st.detected == brpc::MSG_TRPC && (rng() & 1)) {
           const char* mv = nullptr;
           size_t ml = 0;
+          const char* bv = nullptr;
           uint64_t bl = 0;
-          bool viewed = false;
-          butil::IOBuf guard;
-          const size_t before_v = in.size();
-          const ParseResult r = brpc::parse_trpc_view(&in, &mv, &ml, &bl,
-                                                      &guard, &viewed);
+          uint64_t total = 0;
+          const ParseResult r = brpc::parse_trpc_peek(&in, &mv, &ml, &bv,
+                                                      &bl, &total);
           if (r == brpc::PARSE_ERROR) { dead = true; break; }
           if (r == brpc::PARSE_NEED_MORE) break;
-          if (viewed) {
-            CHECK_EQ(in.size() < before_v, true);  // fabrication guard
-            // touch every meta byte (ASAN validates the view) + cut body
+          if (mv != nullptr) {
+            // fabrication guard: the peeked frame must fit the buffer
+            CHECK_EQ(total <= in.size(), true);
+            CHECK_EQ(total >= ml, true);
+            // touch every meta byte (ASAN validates the view); touch the
+            // body view too when contiguous
             unsigned acc = 0;
             for (size_t i = 0; i < ml; ++i) acc += (unsigned char)mv[i];
+            if (bv != nullptr)
+              for (size_t i = 0; i < bl; ++i) acc += (unsigned char)bv[i];
             (void)acc;
-            butil::IOBuf body;
-            in.cutn(&body, bl);
+            in.pop_front(total);  // consume exactly one frame
             ++parsed_total;
             continue;
           }
-          // viewed=false: fall through to the generic parser
+          // mv==nullptr: fall through to the generic parser
         }
         const size_t before = in.size();
         const ParseResult r = brpc::parse_message(&in, &st, &msg);
