@@ -107,18 +107,21 @@ def bench_unary_echo(duration_s=2.0, threads=4):
             "p99_us": round(p99, 1), "threads": threads}
 
 
-def bench_echo_scaling(conn_counts=(1, 4, 16, 64), per_conn_frames=15_000):
+def bench_echo_scaling(conn_counts=(1, 4, 16, 64), per_conn_frames=15_000,
+                       trials=3, budget_ms=3.0):
     """PYTHON-HANDLER scaling under the native C++ client pump — the
     reference's methodology (C++ client, docs/cn/benchmark.md:110-121)
     pointed at user handlers.  Each connection keeps one frame in flight,
     so N conns model N concurrent synchronous clients and the measured
     cost is the SERVER's dispatch + Python handler path only.
 
-    r3 measured this with Python CLIENT threads, which mostly measured
-    the client's own GIL convoy — and its catastrophic negative scaling
-    was a circuit-breaker exponent overflow (fixed) poisoning the
-    response path.  The client-side convenience path is still covered by
-    the `echo` rung (bench_unary_echo)."""
+    Admission control (VERDICT r4 #4): the server runs with a usercode
+    latency budget, so when the GIL lane's estimated wait exceeds
+    `budget_ms` the excess load is shed natively with ELIMIT instead of
+    queueing.  qps counts SUCCESSES only; sheds surface as err_frac.
+    p50/p99 are success latencies.  Each rung runs `trials` times,
+    median + spread reported (same jitter discipline as the native
+    ladder)."""
     import ctypes
 
     import brpc_tpu as brpc
@@ -131,34 +134,57 @@ def bench_echo_scaling(conn_counts=(1, 4, 16, 64), per_conn_frames=15_000):
         def Echo(self, cntl, req):
             return req
 
-    server = brpc.Server()
+    server = brpc.Server(brpc.ServerOptions(
+        usercode_latency_budget_ms=budget_ms,
+        # echo never blocks: run it on the dispatcher (single-threaded
+        # event loop) — on a core-starved box the executor hop's
+        # cross-thread GIL convoy dominated the tail
+        usercode_inline=True))
     server.add_service(Echo())
     server.start("127.0.0.1", 0)
     core_init()
     out = {}
     try:
         for c in conn_counts:
-            qps = ctypes.c_double()
-            p50 = ctypes.c_double()
-            p99 = ctypes.c_double()
-            rc = core.brpc_bench_pump(
-                server.port, b"ScaleEcho", b"Echo", c, 1,
-                per_conn_frames * c, 128,
-                ctypes.byref(qps), ctypes.byref(p50), ctypes.byref(p99))
-            out[f"{c}c"] = {"qps": round(qps.value, 1), "p50_us": p50.value,
-                            "p99_us": p99.value, "completed": rc == 0}
+            rs = []
+            for _ in range(trials):
+                qps = ctypes.c_double()
+                p50 = ctypes.c_double()
+                p99 = ctypes.c_double()
+                ef = ctypes.c_double()
+                rc = core.brpc_bench_pump(
+                    server.port, b"ScaleEcho", b"Echo", c, 1,
+                    per_conn_frames * c, 128,
+                    ctypes.byref(qps), ctypes.byref(p50),
+                    ctypes.byref(p99), ctypes.byref(ef))
+                rs.append({"qps": qps.value, "p50_us": p50.value,
+                           "p99_us": p99.value, "err_frac": ef.value,
+                           "completed": rc == 0})
+            qs = sorted(r["qps"] for r in rs)
+            p50s = sorted(r["p50_us"] for r in rs)
+            p99s = sorted(r["p99_us"] for r in rs)
+            mid = len(rs) // 2
+            out[f"{c}c"] = {
+                "qps": round(qs[mid], 1), "p50_us": p50s[mid],
+                "p99_us": p99s[mid],
+                "qps_spread": [round(qs[0], 1), round(qs[-1], 1)],
+                "p99_spread": [p99s[0], p99s[-1]],
+                "shed_frac": round(
+                    sorted(r["err_frac"] for r in rs)[mid], 4),
+                "trials": trials,
+                "completed": all(r["completed"] for r in rs)}
     finally:
         server.stop()
         server.join()
     base = out[f"{conn_counts[0]}c"]["qps"]
-    peak = max(v["qps"] for v in out.values()
-               if isinstance(v, dict) and "qps" in v)
+    peak = max(out[f"{c}c"]["qps"] for c in conn_counts)
     out["speedup_at_peak"] = round(peak / base, 2) if base else None
+    out["usercode_budget_ms"] = budget_ms
     out["cpu_cores"] = os.cpu_count()
-    out["note"] = ("native C++ client pump vs Python handlers: isolates "
-                   "the server-side handler path; handlers stay GIL-bound "
-                   "so per-core saturation is the ceiling, but added load "
-                   "must not DEGRADE throughput")
+    out["note"] = ("native C++ client pump vs Python handlers; success-"
+                   "qps only, ELIMIT sheds in shed_frac; handlers stay "
+                   "GIL-bound so per-core saturation is the ceiling, but "
+                   "added load must not DEGRADE throughput or tails")
     return out
 
 

@@ -222,14 +222,24 @@ _sigs = {
                                        ctypes.POINTER(ctypes.c_double),
                                        ctypes.POINTER(ctypes.c_double)]),
     # native client pump against an EXISTING server (Python handlers):
-    # port, service, method, conns, inflight, total, payload_len, out x3
+    # port, service, method, conns, inflight, total, payload_len,
+    # out: success qps, p50, p99, err_frac (sheds/errors; nullable)
     "brpc_bench_pump": (ctypes.c_int, [ctypes.c_int, ctypes.c_char_p,
                                        ctypes.c_char_p, ctypes.c_int,
                                        ctypes.c_int, ctypes.c_uint64,
                                        ctypes.c_int,
                                        ctypes.POINTER(ctypes.c_double),
                                        ctypes.POINTER(ctypes.c_double),
+                                       ctypes.POINTER(ctypes.c_double),
                                        ctypes.POINTER(ctypes.c_double)]),
+    # usercode admission control (net/rpc.h; latency-budget ELIMIT sheds)
+    "brpc_set_usercode_budget_us": (None, [ctypes.c_int64]),
+    "brpc_usercode_budget_us": (ctypes.c_int64, []),
+    "brpc_usercode_shed_count": (ctypes.c_int64, []),
+    "brpc_usercode_pending": (ctypes.c_int64, []),
+    "brpc_usercode_ema_us": (ctypes.c_double, []),
+    "brpc_set_usercode_inline": (None, [ctypes.c_int]),
+    "brpc_usercode_inline": (ctypes.c_int, []),
     # fiber / butex (coroutine M:N runtime, src/cc/bthread/fiber.h)
     "brpc_fiber_demo_start": (ctypes.c_void_p, [ctypes.c_int]),
     "brpc_fiber_demo_blocked": (ctypes.c_int, [ctypes.c_void_p]),

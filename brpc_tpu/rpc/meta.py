@@ -86,7 +86,7 @@ def strip_reserved_user_fields(fields: dict) -> dict:
             if k not in RESERVED_USER_FIELD_KEYS}
 
 
-@dataclass
+@dataclass(slots=True)
 class RpcMeta:
     msg_type: int = MSG_REQUEST
     correlation_id: int = 0

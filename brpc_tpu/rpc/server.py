@@ -168,6 +168,21 @@ class ServerOptions:
     usercode_in_pthread: bool = False
     # pool width when usercode_in_pthread is on (0 = 64)
     usercode_pool_workers: int = 0
+    # Native admission control for the GIL-serialized Python lane
+    # (reference ELIMIT fail-fast semantics, expressed as a latency
+    # budget): when > 0, a request whose estimated queue wait (pending x
+    # EMA upcall time, tracked in C++) exceeds this many milliseconds is
+    # answered ELIMIT natively — it never reaches Python.  0 = off, the
+    # reference's default.  Process-wide (the native lane is shared).
+    usercode_latency_budget_ms: float = 0.0
+    # Single-threaded event-loop mode: run handlers INLINE on the native
+    # dispatcher thread (no executor hop, no cross-thread GIL convoy —
+    # the lowest-variance path on core-starved hosts).  STRICTLY for
+    # handlers that never block: a blocking handler stalls every socket
+    # on that dispatcher, and a nested RPC through it can deadlock.
+    # Process-wide.  Mutually exclusive in spirit with
+    # usercode_in_pthread (which exists FOR blocking handlers).
+    usercode_inline: bool = False
 
 
 class MethodStatus:
@@ -383,6 +398,26 @@ class Server:
                 self._tag_pools[""] = ThreadPoolExecutor(
                     max_workers=self.options.usercode_pool_workers or 64,
                     thread_name_prefix="usercode")
+        if self.options.usercode_inline and (
+                self.options.usercode_in_pthread or self._tag_sizes):
+            # pooled handlers under inline dispatch would defeat BOTH
+            # features: the inline upcall measures only the pool-submit
+            # cost (admission control silently dead while the pool queue
+            # grows) and the pool hop reintroduces the cross-thread
+            # convoy inline mode exists to remove
+            raise ValueError(
+                "usercode_inline is for handlers that run inline and "
+                "never block; it cannot be combined with "
+                "usercode_in_pthread or per-service tag pools")
+        if self.options.usercode_latency_budget_ms > 0 or \
+                self.options.usercode_inline:
+            from brpc_tpu._core import core as _core
+            if self.options.usercode_latency_budget_ms > 0:
+                _core.brpc_set_usercode_budget_us(
+                    int(self.options.usercode_latency_budget_ms * 1000))
+            if self.options.usercode_inline:
+                _core.brpc_set_usercode_inline(1)
+            _usercode_policy_owners.add(id(self))
         if self.options.enable_dcn:
             # cross-process device RPC: topology handshake + remote
             # device-service bridge (ici/dcn.py; the RdmaEndpoint
@@ -434,6 +469,12 @@ class Server:
     def join(self) -> None:
         if not self._started:
             return  # idempotent: a second join() must not double-unregister
+        self._stopping = True  # decrements only signal the event when stopping
+        with self._inflight_mu:
+            if self._inflight == 0:
+                self._inflight_zero.set()
+            else:
+                self._inflight_zero.clear()
         self._inflight_zero.wait(self.options.graceful_quit_timeout_s)
         with self._conn_mu:
             conns = list(self._connections)
@@ -447,6 +488,16 @@ class Server:
             self._methods_registered = False
             for key in self._methods:
                 _native_method_unregister(key)
+        if self.options.usercode_latency_budget_ms > 0 or \
+                self.options.usercode_inline:
+            # budget/inline are process-wide native state: clear only
+            # when the LAST owning server leaves, so stopping one server
+            # can't strip admission control from another still running
+            from brpc_tpu._core import core as _core
+            _usercode_policy_owners.discard(id(self))
+            if not _usercode_policy_owners:
+                _core.brpc_set_usercode_budget_us(0)
+                _core.brpc_set_usercode_inline(0)
         _unregister_server(self)
         self._started = False
 
@@ -486,6 +537,8 @@ class Server:
             conn.abort_bidi()
 
     def _track_conn(self, sid: int) -> None:
+        if sid in self._connections:  # GIL-safe read; hot path skips the lock
+            return
         with self._conn_mu:
             self._connections.add(sid)
 
@@ -569,6 +622,20 @@ class Server:
             from brpc_tpu.rpc.stream import StreamRegistry
             StreamRegistry.instance().on_frame(sid, meta, body)
 
+    def _inflight_inc(self) -> None:
+        # Hot path: a bare counter under the lock.  The zero-event is only
+        # observed by join(), so Event.set()/clear() churn (measured
+        # ~6us/request — notify_all allocates and wakes) happens ONLY while
+        # stopping, not per request.
+        with self._inflight_mu:
+            self._inflight += 1
+
+    def _inflight_dec(self) -> None:
+        with self._inflight_mu:
+            self._inflight -= 1
+            if self._inflight == 0 and self._stopping:
+                self._inflight_zero.set()
+
     def _on_fast_request(self, sid: int, cid: int, attempt: int,
                          service: str, method_name: str, compress: int,
                          timeout_ms: int, content_type: str,
@@ -618,10 +685,7 @@ class Server:
                 return
             # isolated worker pool for this service (bthread tag);
             # count the QUEUED request so graceful join() waits for it
-            with self._inflight_mu:
-                self._inflight += 1
-                if self._inflight == 1:
-                    self._inflight_zero.clear()
+            self._inflight_inc()
             pool.submit(self._process_tagged, sid, meta, body)
         else:
             self._process_request(sid, meta, body)
@@ -632,10 +696,7 @@ class Server:
             # stop(); graceful join() is waiting for it — serve it
             self._process_request(sid, meta, body, pre_accepted=True)
         finally:
-            with self._inflight_mu:
-                self._inflight -= 1
-                if self._inflight == 0:
-                    self._inflight_zero.set()
+            self._inflight_dec()
 
     def _respond_error(self, sid: int, meta: M.RpcMeta, code: int,
                        text: str = "") -> None:
@@ -695,10 +756,7 @@ class Server:
             self._respond_error(sid, meta, errors.ELIMIT)
             return
 
-        with self._inflight_mu:
-            self._inflight += 1
-            if self._inflight == 1:
-                self._inflight_zero.clear()
+        self._inflight_inc()
 
         span = rpcz.new_span("server", meta.service, meta.method,
                              trace_id=meta.trace_id,
@@ -732,7 +790,8 @@ class Server:
                 # the raw serializer): handlers get bytes, not views
                 cntl.request_attachment = bytes(raw[len(raw) - att:]) \
                     if att else b""
-                payload = decompress(payload, meta.compress_type)
+                if meta.compress_type:
+                    payload = decompress(payload, meta.compress_type)
                 req_ser = spec.request_serializer
                 if (self.options.pb_message_pooling
                         and isinstance(req_ser, PbSerializer)
@@ -760,7 +819,9 @@ class Server:
         # paying a closure + once-guard lock per request.
         cntl._done_factory = lambda: self._make_server_done(
             sid, meta, span, cntl, spec, status, start, rail_src)
-        rpcz.set_current_span(span)
+        traced = span is not rpcz.NULL_SPAN
+        if traced:  # with rpcz off, skip the contextvar pair per request
+            rpcz.set_current_span(span)
         if self._session_pool is not None:
             cntl.session_data = self._session_pool.borrow()
         try:
@@ -779,7 +840,8 @@ class Server:
                                    start, rail_src, None, exc=e)
             return
         finally:
-            rpcz.set_current_span(None)
+            if traced:
+                rpcz.set_current_span(None)
             if self._session_pool is not None:
                 # deferred handlers must not rely on session_data after
                 # returning: the pooled object goes back with the handler
@@ -850,7 +912,8 @@ class Server:
             else:
                 res_ser = spec.response_serializer
                 rbody, theader = res_ser.encode(response)
-                rbody = compress(rbody, meta.compress_type)
+                if meta.compress_type:
+                    rbody = compress(rbody, meta.compress_type)
                 if (cntl._stream is None and not cntl.response_attachment
                         and not theader and not meta.compress_type
                         and not span.trace_id
@@ -922,10 +985,7 @@ class Server:
             span.error_code = error_code
             span.end_us = rpcz.now_us()
             rpcz.submit(span)
-            with self._inflight_mu:
-                self._inflight -= 1
-                if self._inflight == 0:
-                    self._inflight_zero.set()
+            self._inflight_dec()
 
     def _ship_rail_response(self, sid: int, meta: M.RpcMeta, span, cntl,
                             response, rail_src: str) -> bool:
@@ -998,10 +1058,7 @@ class Server:
             if self._limiter is not None:
                 self._limiter.on_responded(errors.ELIMIT, 0)
             raise errors.RpcError(errors.ELIMIT)
-        with self._inflight_mu:
-            self._inflight += 1
-            if self._inflight == 1:
-                self._inflight_zero.clear()
+        self._inflight_inc()
         start = time.monotonic()
         error_code = 0
         try:
@@ -1051,10 +1108,7 @@ class Server:
             status.on_responded(error_code, latency_us)
             if self._limiter is not None:
                 self._limiter.on_responded(error_code, latency_us)
-            with self._inflight_mu:
-                self._inflight -= 1
-                if self._inflight == 0:
-                    self._inflight_zero.set()
+            self._inflight_dec()
 
     # ---- gRPC entry (policy/http2_rpc_protocol.cpp server role) ----
 
@@ -1112,10 +1166,7 @@ class Server:
             if self._limiter is not None:
                 self._limiter.on_responded(errors.ELIMIT, 0)
             return b"", errors.ELIMIT, "method concurrency limit"
-        with self._inflight_mu:
-            self._inflight += 1
-            if self._inflight == 1:
-                self._inflight_zero.clear()
+        self._inflight_inc()
         span = rpcz.new_span("server", key[0], method_name)
         span.annotate("protocol=grpc")
         start = time.monotonic()
@@ -1137,10 +1188,7 @@ class Server:
             span.error_code = code
             span.end_us = rpcz.now_us()
             rpcz.submit(span)
-            with self._inflight_mu:
-                self._inflight -= 1
-                if self._inflight == 0:
-                    self._inflight_zero.set()
+            self._inflight_dec()
 
         cntl = None
         try:
@@ -1241,6 +1289,9 @@ class Server:
 
 _servers: list[Server] = []
 _servers_mu = threading.Lock()
+# servers that installed the process-wide usercode budget/inline policy;
+# the native flags are cleared only when the last owner joins
+_usercode_policy_owners: set[int] = set()
 
 # process-wide refcounts for the native method registry (several servers
 # may expose the same (service, method); the registry is global)

@@ -332,6 +332,17 @@ void brpc_rpc_counters(int64_t* native_calls, int64_t* python_fast_calls) {
     *python_fast_calls = brpc::MethodRegistry::global()->python_fast_calls();
 }
 
+// Usercode admission control (net/rpc.h; VERDICT r4 #4).
+void brpc_set_usercode_budget_us(int64_t us) {
+  brpc::SetUsercodeLatencyBudgetUs(us);
+}
+int64_t brpc_usercode_budget_us() { return brpc::UsercodeLatencyBudgetUs(); }
+int64_t brpc_usercode_shed_count() { return brpc::UsercodeShedCount(); }
+int64_t brpc_usercode_pending() { return brpc::UsercodePending(); }
+double brpc_usercode_ema_us() { return brpc::UsercodeEmaUs(); }
+void brpc_set_usercode_inline(int on) { brpc::SetUsercodeInline(on != 0); }
+int brpc_usercode_inline() { return brpc::UsercodeInline() ? 1 : 0; }
+
 // Pack + write a TRPC response frame natively (server -> client).
 int brpc_send_response(uint64_t sid, uint64_t cid, uint16_t attempt,
                        int32_t error_code, const char* error_text,

@@ -25,6 +25,7 @@ namespace {
 struct BenchState {
   std::atomic<uint64_t> sent{0};
   std::atomic<uint64_t> done{0};
+  std::atomic<uint64_t> errs{0};  // error responses (e.g. ELIMIT sheds)
   std::atomic<uint64_t> lat_idx{0};
   uint64_t total = 0;
   int payload_len = 0;
@@ -78,10 +79,18 @@ void bench_send_one(SocketId sid, BenchState* st) {
 
 void bench_note_response(SocketId sid, const RequestHeader* hdr, void* user) {
   auto* st = (BenchState*)user;
-  const uint64_t now = (uint64_t)butil::cpuwide_time_us();
-  const uint64_t idx = st->lat_idx.fetch_add(1, std::memory_order_relaxed);
-  if (idx < st->lat_us.size()) {
-    st->lat_us[idx] = (uint32_t)std::min<uint64_t>(now - hdr->cid, 0xffffffff);
+  if (hdr->error_code != 0) {
+    // shed/error replies keep the pipeline moving but are counted (and
+    // timed) separately: mixing fail-fast latencies into the success
+    // distribution would flatter p99 dishonestly
+    st->errs.fetch_add(1, std::memory_order_relaxed);
+  } else {
+    const uint64_t now = (uint64_t)butil::cpuwide_time_us();
+    const uint64_t idx = st->lat_idx.fetch_add(1, std::memory_order_relaxed);
+    if (idx < st->lat_us.size()) {
+      st->lat_us[idx] =
+          (uint32_t)std::min<uint64_t>(now - hdr->cid, 0xffffffff);
+    }
   }
   // keep the pipe full: claim a send ticket; tickets >= total mean the
   // pipeline is winding down
@@ -125,7 +134,7 @@ using namespace brpc;
 // `inflight` frames outstanding each, p50/p99 from send-timestamp cids.
 int run_pump(int port, const char* service, const char* method, int conns,
              int inflight, uint64_t total, int payload_len, double* qps_out,
-             double* p50_us, double* p99_us) {
+             double* p50_us, double* p99_us, double* err_frac = nullptr) {
   // Heap-allocated: on the timeout path, in-flight responses can still
   // hit bench_on_response on dispatcher threads after we return, so the
   // state must outlive this frame — it is intentionally leaked then.
@@ -177,8 +186,13 @@ int run_pump(int port, const char* service, const char* method, int conns,
   for (SocketId cid : clients) Socket::SetFailed(cid, 0);
 
   const uint64_t completed = st.done.load();
+  const uint64_t errs = st.errs.load();
   const double wall_s = (t1 - t0) / 1e6;
-  if (qps_out) *qps_out = completed / (wall_s > 0 ? wall_s : 1e-9);
+  // qps counts SUCCESSFUL responses only; sheds are reported as err_frac
+  if (qps_out)
+    *qps_out = (completed > errs ? completed - errs : 0) /
+               (wall_s > 0 ? wall_s : 1e-9);
+  if (err_frac) *err_frac = completed > 0 ? double(errs) / completed : 0.0;
   const uint64_t n = std::min<uint64_t>(st.lat_idx.load(), st.lat_us.size());
   if (n > 0) {
     std::vector<uint32_t> lats(st.lat_us.begin(), st.lat_us.begin() + n);
@@ -234,13 +248,14 @@ int brpc_bench_echo(int conns, int inflight, uint64_t total, int payload_len,
 // methodology (docs/cn/benchmark.md) pointed at user handlers.
 int brpc_bench_pump(int port, const char* service, const char* method,
                     int conns, int inflight, uint64_t total, int payload_len,
-                    double* qps_out, double* p50_us, double* p99_us) {
+                    double* qps_out, double* p50_us, double* p99_us,
+                    double* err_frac) {
   if (port <= 0 || service == nullptr || method == nullptr || conns <= 0 ||
       inflight <= 0 || total == 0 || payload_len < 0 || payload_len > 4096) {
     return -1;
   }
   return run_pump(port, service, method, conns, inflight, total, payload_len,
-                  qps_out, p50_us, p99_us);
+                  qps_out, p50_us, p99_us, err_frac);
 }
 
 }  // extern "C"

@@ -197,6 +197,26 @@ class MethodRegistry {
 // (server role; responses are per-socket via SocketOptions.on_response).
 void SetRequestCallback(RequestCallback cb, void* user);
 
+// Usercode admission control (reference ELIMIT fail-fast semantics with a
+// time-denominated bound): when a budget is set and the estimated wait
+// for the GIL-serialized Python lane (pending x EMA upcall time) exceeds
+// it, new requests are answered ELIMIT natively instead of queueing.
+void SetUsercodeLatencyBudgetUs(int64_t us);  // 0 disables (default)
+int64_t UsercodeLatencyBudgetUs();
+int64_t UsercodeShedCount();
+int64_t UsercodePending();
+double UsercodeEmaUs();
+
+// Inline usercode mode (single-threaded event loop): Python upcalls run
+// synchronously on the dispatcher thread.  Lowest possible latency
+// variance on core-starved hosts; STRICTLY for non-blocking handlers.
+void SetUsercodeInline(bool on);
+bool UsercodeInline();
+// Called by the event dispatcher at the top of each epoll sweep: resets
+// the per-sweep inline-upcall counter that the inline admission control
+// uses to estimate how long a request sat behind this sweep's handlers.
+void NoteDispatchSweepStart();
+
 struct SocketOptions;
 
 // Socket::DispatchMessages hook for MSG_TRPC.  Returns true if the message
