@@ -188,6 +188,92 @@ def bench_echo_scaling(conn_counts=(1, 4, 16, 64), per_conn_frames=15_000,
     return out
 
 
+def bench_grpc_echo(total=8000, inflight=32, payload_len=128,
+                    stream_items=2000):
+    """gRPC (h2) unary + server-streaming qps on the shared port — the
+    reference benchmarks gRPC as a native protocol
+    (src/brpc/policy/http2_rpc_protocol.cpp); ours is a Python h2 data
+    plane over the native socket layer.  Stated target (VERDICT r4 #5):
+    >= 4k unary qps pipelined on the 1-core box (median of 3), ~350x
+    below the native TRPC path by design — full native h2 framing is
+    future work; the rung exists so the gap is MEASURED, not assumed.
+    (r5 lifted the floor ~2.5x: joined HEADERS+DATA+trailers writes,
+    coalesced WINDOW_UPDATEs, HPACK repeated-block cache, single-copy
+    IOBuf->bytes.)"""
+    import time as _t
+    from collections import deque
+
+    import brpc_tpu as brpc
+    from brpc_tpu.rpc.h2 import GrpcChannel
+
+    class Echo(brpc.Service):
+        NAME = "bench.Grpc"
+
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return bytes(req)
+
+        @brpc.method(request="raw", response="raw")
+        def Stream(self, cntl, req):
+            n = int(bytes(req) or b"1")
+            payload = b"s" * 128
+            return (payload for _ in range(n))
+
+    server = brpc.Server()
+    server.add_service(Echo())
+    server.start("127.0.0.1", 0)
+    out = {}
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{server.port}")
+        payload = b"x" * payload_len
+        for _ in range(100):
+            ch.call("bench.Grpc", "Echo", payload)
+
+        def one_trial():
+            lat = []
+            pend = deque()
+            t0 = _t.perf_counter()
+            for _ in range(total):
+                pend.append((ch.acall("bench.Grpc", "Echo", payload),
+                             _t.perf_counter()))
+                if len(pend) >= inflight:
+                    f, ts = pend.popleft()
+                    f.result(30)
+                    lat.append(_t.perf_counter() - ts)
+            while pend:
+                f, ts = pend.popleft()
+                f.result(30)
+                lat.append(_t.perf_counter() - ts)
+            wall = _t.perf_counter() - t0
+            lat.sort()
+            return (total / wall, lat[len(lat) // 2] * 1e6,
+                    lat[int(len(lat) * 0.99)] * 1e6)
+
+        trials = sorted(one_trial() for _ in range(3))
+        qps = trials[1][0]
+        out["unary"] = {
+            "qps": round(qps, 1), "inflight": inflight,
+            "p50_us": round(trials[1][1], 1),
+            "p99_us": round(trials[1][2], 1),
+            "qps_spread": [round(trials[0][0], 1), round(trials[2][0], 1)],
+            "target_qps": 4000,
+            "met": qps >= 4000}
+        # server-streaming: one call, many items (message throughput)
+        got = 0
+        t0 = _t.perf_counter()
+        for item in ch.call_stream("bench.Grpc", "Stream",
+                                   str(stream_items).encode()):
+            got += 1
+        wall = _t.perf_counter() - t0
+        out["streaming"] = {"items": got,
+                            "items_per_s": round(got / wall, 1)}
+        ch.close()
+    finally:
+        server.stop()
+        server.join()
+    return out
+
+
 def bench_native_echo_scaling(conn_counts=(1, 2, 4, 8, 16),
                               per_conn_frames=150_000, trials=3):
     """QPS vs connection count for the native unary hot path (the
@@ -784,6 +870,119 @@ def bench_ici_ladder(sizes=(64, 4096, 65536, 1 << 20, 1 << 24, 1 << 26)):
     return out
 
 
+_DCN_SERVER_SRC = """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from brpc_tpu.ici.channel import register_device_service
+from brpc_tpu.rpc.server import Server
+register_device_service("Bench", "Echo", lambda x: x)
+srv = Server(enable_dcn=True)
+srv.start("127.0.0.1", 0)
+print(f"PORT={{srv.port}}", flush=True)
+srv.run_until_interrupt()
+"""
+
+_DCN_CLIENT_SRC = """
+import json, sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from brpc_tpu.ici import dcn
+ch = dcn.DcnChannel("ici://127.0.0.1:{port}/0")
+topo = ch.handshake()
+mode = "zero-copy" if topo.get("xfer") else "host-serialized"
+mb = {mb}
+x = np.random.default_rng(0).standard_normal(mb * 262144,
+                                             dtype=np.float32)  # mb MiB
+assert x.nbytes == mb * 1024 * 1024
+import jax.numpy as jnp
+xd = jnp.asarray(x)
+out = ch.call_sync("Bench", "Echo", xd)       # warm both directions
+best = None
+for _ in range(5):
+    t0 = time.perf_counter()
+    out = ch.call_sync("Bench", "Echo", xd)
+    jax.block_until_ready(out)   # async dispatch: force the pulled
+    dt = time.perf_counter() - t0  # bytes to LAND inside the timing
+    best = dt if best is None or dt < best else best
+np.testing.assert_allclose(np.asarray(out)[:8], x[:8])
+# request + response both move mb MB
+print(json.dumps({{"mode": mode, "gbps": round(2 * mb / 1024 / best, 3),
+                   "roundtrip_s": round(best, 4)}}))
+"""
+
+
+def bench_dcn(mb: int = 32) -> dict:
+    """DCN data-plane rung (VERDICT r4 #10): two PROCESSES over loopback
+    TCP, echoing a device array through the `_dcn` service — zero-copy
+    fabric pull (jax.experimental.transfer) vs the host-serialized
+    fallback (BRPC_DCN_DISABLE_XFER=1).  Both processes run forced-CPU:
+    the rung measures the TRANSPORT path (control frames, fabric pulls,
+    serializer), not HBM — chip-side numbers live in tensor_pipe.  The
+    axon tunnel does not admit two clients, so CPU is also what keeps
+    this rung runnable when the chip is."""
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out = {"payload_mb": mb, "platform": "cpu (forced; transport-path rung)"}
+    env_base = dict(os.environ)
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base.pop("BRPC_DCN_DISABLE_XFER", None)
+    for label, extra in (("zero_copy", {}),
+                         ("host_fallback", {"BRPC_DCN_DISABLE_XFER": "1"})):
+        env = dict(env_base, **extra)
+        server = subprocess.Popen(
+            [sys.executable, "-c", _DCN_SERVER_SRC.format(repo=repo)],
+            stdout=subprocess.PIPE, env=env, text=True)
+        try:
+            port = None
+            deadline = time.monotonic() + 90
+            import selectors
+            sel = selectors.DefaultSelector()
+            sel.register(server.stdout, selectors.EVENT_READ)
+            while time.monotonic() < deadline and port is None:
+                if server.poll() is not None:
+                    break  # crashed before printing PORT=
+                # bounded-wait poll: EOF would make readline() return ""
+                # in a hot spin, a wedged-but-alive child would block it
+                # past the deadline
+                if not sel.select(timeout=1.0):
+                    continue
+                line = server.stdout.readline()
+                if not line:
+                    break
+                if line.startswith("PORT="):
+                    port = int(line.strip().split("=")[1])
+            sel.close()
+            if port is None:
+                out[label] = {"error": "dcn server never came up"}
+                continue
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 _DCN_CLIENT_SRC.format(repo=repo, port=port, mb=mb)],
+                capture_output=True, text=True, env=env, timeout=240)
+            if r.returncode != 0:
+                tail = (r.stderr or "").strip().splitlines()[-1:]
+                out[label] = {"error": tail[0] if tail else "client failed"}
+            else:
+                out[label] = json.loads(r.stdout.strip().splitlines()[-1])
+        except subprocess.TimeoutExpired:
+            out[label] = {"error": "dcn client timed out"}
+        finally:
+            server.terminate()
+            server.wait(timeout=10)
+    zc = out.get("zero_copy", {})
+    fb = out.get("host_fallback", {})
+    if isinstance(zc, dict) and zc.get("gbps") and \
+            isinstance(fb, dict) and fb.get("gbps"):
+        out["zero_copy_speedup"] = round(zc["gbps"] / fb["gbps"], 2)
+    return out
+
+
 def _device_reachable(timeouts_s: tuple = (60, 90, 150)) -> tuple[bool, str]:
     """Probe jax device init in a SUBPROCESS with a hard timeout.  A
     wedged tunnel makes jax.devices() block forever inside the PJRT
@@ -837,6 +1036,18 @@ def main():
     log("bench: native echo connection-scaling...")
     details["native_echo_scaling"] = bench_native_echo_scaling()
     log(f"  {details['native_echo_scaling']}")
+    log("bench: grpc echo (h2 python data plane)...")
+    try:
+        details["grpc_echo"] = bench_grpc_echo()
+    except Exception as e:
+        details["grpc_echo"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['grpc_echo']}")
+    log("bench: dcn data plane (two processes, loopback)...")
+    try:
+        details["dcn"] = bench_dcn()
+    except Exception as e:
+        details["dcn"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['dcn']}")
     log("bench: probing device reachability...")
     device_ok, device_err = _device_reachable()
     if not device_ok:

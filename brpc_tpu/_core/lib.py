@@ -286,6 +286,28 @@ _init_lock = threading.Lock()
 _initialized = False
 
 
+_fastrpc_cache = None
+_fastrpc_attempts = 0
+
+
+def _fastrpc_mod():
+    """The _fastrpc C extension, or None while it is still being built
+    (lazy: importing it at module scope would recurse through the
+    build-on-import path).  Permanent failure is cached after a few
+    tries — failed imports aren't in sys.modules, and paying the import
+    machinery + ImportError on every to_bytes would tax the very hot
+    path this accelerates."""
+    global _fastrpc_cache, _fastrpc_attempts
+    if _fastrpc_cache is None and _fastrpc_attempts < 3:
+        _fastrpc_attempts += 1
+        try:
+            from brpc_tpu._core import _fastrpc as fb
+            _fastrpc_cache = fb
+        except Exception:
+            return None
+    return _fastrpc_cache
+
+
 def core_init(num_workers: int = 0, num_dispatchers: int = 0) -> None:
     """Start the native executor, dispatchers and timer thread (idempotent).
     num_dispatchers=0 lets the native core size the epoll pool by CPU
@@ -354,6 +376,11 @@ class IOBuf:
         return core.brpc_iobuf_pop_front(self.handle, n)
 
     def to_bytes(self, n: int | None = None, pos: int = 0) -> bytes:
+        fb = _fastrpc_mod()
+        if fb is not None:
+            # single copy straight into the bytes object (the ctypes
+            # fallback below pays two copies plus a zero-init)
+            return fb.iobuf_bytes(self.handle, pos, -1 if n is None else n)
         size = len(self)
         if n is None:
             n = size - pos

@@ -79,9 +79,13 @@ def _bind_host() -> str:
 
 def transfer_server():
     """This process's transfer-fabric server (lazily started); None when
-    the fabric is unavailable — callers fall back to host serialization."""
+    the fabric is unavailable — callers fall back to host serialization.
+    BRPC_DCN_DISABLE_XFER=1 forces the fallback (benchmark A/B and
+    debugging)."""
     global _xfer_server, _xfer_failed
     with _xfer_mu:
+        if os.environ.get("BRPC_DCN_DISABLE_XFER"):
+            return None
         if _xfer_server is not None or _xfer_failed:
             return _xfer_server
         try:

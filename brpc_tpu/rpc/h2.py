@@ -264,7 +264,7 @@ _CODEC_UNSET = ("unset",)
 class _StreamState:
     __slots__ = ("id", "headers", "data", "trailers", "ended", "send_window",
                  "header_block", "expect_continuation", "trailer_phase",
-                 "reset", "rx_codec")
+                 "reset", "rx_codec", "recv_unacked")
 
     def __init__(self, sid: int, initial_window: int):
         self.id = sid
@@ -280,6 +280,8 @@ class _StreamState:
         # peer's grpc-encoding codec, resolved once at HEADERS time
         # (deriving it per DATA frame is O(headers) on the hot path)
         self.rx_codec = _CODEC_UNSET
+        # received-but-unacked bytes (coalesced stream WINDOW_UPDATEs)
+        self.recv_unacked = 0
 
 
 class H2Connection:
@@ -348,7 +350,7 @@ class H2Connection:
         # HPACK encoder state must advance in the exact order blocks hit the
         # wire, so encode under the send lock
         with self._send_lock:
-            block = self._enc.encode(headers)
+            block = self._enc.encode_cached(tuple(headers))
             flags = FLAG_END_HEADERS | (FLAG_END_STREAM if end_stream else 0)
             self._tp.write_raw(self.sid,
                                build_frame(HEADERS, flags, stream_id, block))
@@ -424,6 +426,55 @@ class H2Connection:
                 self._tp.close(self.sid)
             except Exception:
                 pass
+
+    def _claim_window(self, stream_id: int, n: int) -> bool:
+        """Atomically claim `n` bytes of conn+stream send window for a
+        single-frame body, or return False (caller takes the chunked
+        send_data path).  Raises if the stream is gone."""
+        with self._fc:
+            st = self._streams.get(stream_id)
+            if st is None or st.reset:
+                raise errors.RpcError(errors.EFAILEDSOCKET,
+                                      "h2 stream closed during send")
+            if n and (n > self.remote_max_frame or
+                      self.remote_conn_window < n or st.send_window < n):
+                return False
+            self.remote_conn_window -= n
+            st.send_window -= n
+        return True
+
+    def send_request_joined(self, stream_id: int,
+                            headers: list[tuple[str, str]],
+                            data: bytes) -> bool:
+        """HEADERS + DATA(END_STREAM) in ONE socket write — the unary
+        client fast path (each write_raw costs ~40us on a busy host).
+        False = window too small now; caller falls back to send_headers
+        + send_data."""
+        if not self._claim_window(stream_id, len(data)):
+            return False
+        with self._send_lock:
+            buf = build_frame(HEADERS, FLAG_END_HEADERS, stream_id,
+                              self._enc.encode_cached(tuple(headers)))
+            buf += build_frame(DATA, FLAG_END_STREAM, stream_id, data)
+            self._tp.write_raw(self.sid, buf)
+        return True
+
+    def send_response_joined(self, stream_id: int,
+                             headers: list[tuple[str, str]], data: bytes,
+                             trailers: list[tuple[str, str]]) -> bool:
+        """HEADERS + DATA + trailing HEADERS(END_STREAM) in ONE write —
+        the unary server fast path.  Same fallback contract."""
+        if not self._claim_window(stream_id, len(data)):
+            return False
+        with self._send_lock:
+            buf = build_frame(HEADERS, FLAG_END_HEADERS, stream_id,
+                              self._enc.encode_cached(tuple(headers)))
+            buf += build_frame(DATA, 0, stream_id, data)
+            buf += build_frame(HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                               stream_id,
+                               self._enc.encode_cached(tuple(trailers)))
+            self._tp.write_raw(self.sid, buf)
+        return True
 
     def send_rst(self, stream_id: int, code: int) -> None:
         self._send(build_frame(RST_STREAM, 0, stream_id,
@@ -606,18 +657,41 @@ class H2Connection:
             self._complete(st)
 
     def _on_data(self, stream_id: int, flags: int, payload: bytes) -> None:
-        # replenish the connection window even for unknown/reset streams:
+        # Replenish the connection window even for unknown/reset streams:
         # in-flight DATA after an RST still consumed connection credit, and
         # dropping it without a WINDOW_UPDATE would leak the window
         # permanently.  (Receiver-side credit return, the CONSUMED-feedback
         # analog of stream_impl.h:80 — we buffer in host RAM, no
-        # backpressure needed at this layer.)
+        # backpressure needed at this layer.)  COALESCED: the conn-level
+        # ack goes out once per OUR_CONN_WINDOW/4 consumed bytes rather
+        # than per frame (the peer's window floor stays at 3/4 capacity),
+        # and ended streams skip the stream-level ack entirely — per-frame
+        # WINDOW_UPDATE writes were one of the top per-call costs of the
+        # unary gRPC path.  Frames arrive on this connection's FIFO lane,
+        # so the counter is single-threaded.
         if len(payload):
-            wu = struct.pack(">I", len(payload))
-            frames = build_frame(WINDOW_UPDATE, 0, 0, wu)
-            if stream_id in self._streams:
-                frames += build_frame(WINDOW_UPDATE, 0, stream_id, wu)
-            self._send(frames)
+            self._recv_conn_consumed += len(payload)
+            frames = b""
+            if self._recv_conn_consumed >= OUR_CONN_WINDOW // 4:
+                frames += build_frame(
+                    WINDOW_UPDATE, 0, 0,
+                    struct.pack(">I", self._recv_conn_consumed))
+                self._recv_conn_consumed = 0
+            if not (flags & FLAG_END_STREAM):
+                # stream-level credit, also coalesced: ack at half the
+                # advertised window so the peer's floor stays at
+                # OUR_WINDOW/2 (unary responses never reach it — their
+                # stream dies with the trailers anyway)
+                sst = self._streams.get(stream_id)
+                if sst is not None:
+                    sst.recv_unacked += len(payload)
+                    if sst.recv_unacked >= OUR_WINDOW // 2:
+                        frames += build_frame(
+                            WINDOW_UPDATE, 0, stream_id,
+                            struct.pack(">I", sst.recv_unacked))
+                        sst.recv_unacked = 0
+            if frames:
+                self._send(frames)
         st = self._streams.get(stream_id)
         if st is None:
             return
@@ -965,11 +1039,17 @@ class GrpcServerConnection(H2Connection):
                 self._respond_error(st.id, err_to_grpc(code), text)
                 return
             enc_name, tx_codec = response_codec_for(h)
-            self.send_headers(st.id, self._resp_headers(enc_name))
             if isinstance(resp, (bytes, bytearray, memoryview)):
-                self.send_data(st.id, grpc_frame_auto(bytes(resp), tx_codec),
-                               end_stream=False)
+                framed = grpc_frame_auto(bytes(resp), tx_codec)
+                # unary fast path: whole response in one socket write
+                if self.send_response_joined(st.id,
+                                             self._resp_headers(enc_name),
+                                             framed, [("grpc-status", "0")]):
+                    return
+                self.send_headers(st.id, self._resp_headers(enc_name))
+                self.send_data(st.id, framed, end_stream=False)
             else:
+                self.send_headers(st.id, self._resp_headers(enc_name))
                 # SERVER-STREAMING: transmission runs on a DEDICATED
                 # thread — a long stream (or a slow reader holding the h2
                 # window at zero) must not park one of the bounded shared
@@ -1440,6 +1520,8 @@ class _GrpcClientConnection(H2Connection):
         after unregistering on ANY failure — including a send_headers
         failure inside the lock, which must not leak the registry entry
         or the open_stream window state."""
+        framed = grpc_frame_auto(payload, codec) if payload is not None \
+            else None
         with self._calls_lock:
             stream_id = self._next_stream
             self._next_stream += 2
@@ -1452,16 +1534,21 @@ class _GrpcClientConnection(H2Connection):
                            ("content-type", "application/grpc"),
                            ("grpc-accept-encoding", GRPC_ACCEPT_ENCODING),
                            ("te", "trailers")] + metadata
+                # unary fast path: HEADERS + DATA in one socket write
+                # (still under _calls_lock — stream ids must hit the
+                # wire in increasing order)
+                if framed is not None and \
+                        self.send_request_joined(stream_id, headers, framed):
+                    return stream_id
                 self.send_headers(stream_id, headers)
             except Exception:
                 registry.pop(stream_id, None)
                 self.close_stream(stream_id)
                 raise
-        if payload is None:
+        if framed is None:
             return stream_id
         try:
-            self.send_data(stream_id, grpc_frame_auto(payload, codec),
-                           end_stream=True)
+            self.send_data(stream_id, framed, end_stream=True)
         except Exception:
             with self._calls_lock:
                 registry.pop(stream_id, None)

@@ -292,18 +292,26 @@ class HpackEncoder:
     def __init__(self, max_table_size: int = 4096, use_huffman: bool = True):
         self._table = _DynTable(max_table_size)
         self._use_huffman = use_huffman
+        # mutation counter + repeated-block cache (see encode_cached)
+        self._version = 0
+        self._cache: dict[tuple, tuple[int, bytes]] = {}
 
     def set_max_table_size(self, n: int) -> None:
         # peer lowered SETTINGS_HEADER_TABLE_SIZE; a size-update block
         # would be emitted on the next header block in a strict impl — we
-        # simply clamp and emit the update eagerly next encode
+        # simply clamp and emit the update eagerly next encode.  The
+        # version bump invalidates encode_cached NOW: a cached block
+        # replayed after the peer resized would skip the mandatory §6.3
+        # size-update prefix and desync both tables.
         self._pending_resize = n
+        self._version += 1
 
     def encode(self, headers: list[tuple[str, str]]) -> bytes:
         out = bytearray()
         pending = getattr(self, "_pending_resize", None)
         if pending is not None:
             self._table.resize(pending)
+            self._version += 1
             out += encode_int(pending, 5, 0x20)
             self._pending_resize = None
         for name, value in headers:
@@ -331,7 +339,28 @@ class HpackEncoder:
                 out += encode_str(name, self._use_huffman)
             out += encode_str(value, self._use_huffman)
             self._table.add(name, value)
+            self._version += 1
         return bytes(out)
+
+    def encode_cached(self, headers: tuple) -> bytes:
+        """Encoded bytes for a REPEATED header tuple.  Unary RPC re-sends
+        identical header lists every call; after the first call inserts
+        them into the dynamic table, later encodes are pure index bytes
+        and deterministic — as long as the table hasn't mutated since.
+        Cache entries are keyed on the header tuple and validated against
+        the mutation counter; an encode that itself mutates the table is
+        never cached (replaying its bytes would double-insert and desync
+        the peer's table)."""
+        v = self._version
+        hit = self._cache.get(headers)
+        if hit is not None and hit[0] == v:
+            return hit[1]
+        out = self.encode(list(headers))
+        if self._version == v:
+            if len(self._cache) >= 128:
+                self._cache.clear()
+            self._cache[headers] = (v, out)
+        return out
 
 
 class HpackDecoder:

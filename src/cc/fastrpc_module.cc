@@ -324,6 +324,30 @@ PyObject* py_response_cb_ptr(PyObject*, PyObject*) {
   return PyLong_FromVoidPtr((void*)fast_response_cb);
 }
 
+// Single-copy IOBuf -> bytes (lib.py IOBuf.to_bytes rode
+// create_string_buffer + .raw slice: two copies plus a zero-init per
+// call — visible on the h2 frame path at 6 frames/unary-call).
+PyObject* py_iobuf_bytes(PyObject*, PyObject* args) {
+  unsigned long long handle;
+  Py_ssize_t pos = 0;
+  Py_ssize_t n = -1;
+  if (!PyArg_ParseTuple(args, "K|nn", &handle, &pos, &n)) return nullptr;
+  auto* b = (butil::IOBuf*)(uintptr_t)handle;
+  const Py_ssize_t size = (Py_ssize_t)b->size();
+  if (pos < 0 || pos > size) pos = size;
+  Py_ssize_t avail = size - pos;
+  if (n < 0 || n > avail) n = avail;
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, n);
+  if (out == nullptr) return nullptr;
+  if (n > 0) {
+    const size_t got = b->copy_to(PyBytes_AS_STRING(out), (size_t)n,
+                                  (size_t)pos);
+    if ((Py_ssize_t)got != n && _PyBytes_Resize(&out, (Py_ssize_t)got) < 0)
+      return nullptr;
+  }
+  return out;
+}
+
 PyMethodDef kMethods[] = {
     {"send_request", py_send_request, METH_VARARGS,
      "send_request(sid, cid, attempt, service, method, timeout_ms, "
@@ -337,6 +361,8 @@ PyMethodDef kMethods[] = {
      "Install the process-wide pre-parsed response handler."},
     {"response_cb_ptr", py_response_cb_ptr, METH_NOARGS,
      "Address of the C response trampoline (for brpc_connect_rpc)."},
+    {"iobuf_bytes", py_iobuf_bytes, METH_VARARGS,
+     "iobuf_bytes(handle, pos=0, n=-1) -> bytes (single copy)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

@@ -574,12 +574,19 @@ def test_grpc_timeout_header_auto_propagated(grpc_server):
     conn = ch._ensure()
     seen = []
     orig = conn.send_headers
+    orig_joined = conn.send_request_joined
 
     def spy(sid, headers, **kw):
         seen.append(list(headers))
         return orig(sid, headers, **kw)
 
+    def spy_joined(sid, headers, data):
+        # unary fast path sends HEADERS+DATA in one write
+        seen.append(list(headers))
+        return orig_joined(sid, headers, data)
+
     conn.send_headers = spy
+    conn.send_request_joined = spy_joined
     try:
         assert ch.call("test.GrpcEcho", "Echo", b"x") == b"x"
         req_headers = seen[0]
@@ -592,6 +599,7 @@ def test_grpc_timeout_header_auto_propagated(grpc_server):
         assert ("grpc-timeout", "1234m") not in seen[0]
     finally:
         conn.send_headers = orig
+        conn.send_request_joined = orig_joined
         ch.close()
 
 
