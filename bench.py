@@ -974,7 +974,13 @@ def bench_dcn(mb: int = 32) -> dict:
             out[label] = {"error": "dcn client timed out"}
         finally:
             server.terminate()
-            server.wait(timeout=10)
+            try:
+                server.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # a child wedged in PJRT teardown must not discard the
+                # measurements already collected
+                server.kill()
+                server.wait(timeout=10)
     zc = out.get("zero_copy", {})
     fb = out.get("host_fallback", {})
     if isinstance(zc, dict) and zc.get("gbps") and \

@@ -240,6 +240,14 @@ _sigs = {
     "brpc_usercode_ema_us": (ctypes.c_double, []),
     "brpc_set_usercode_inline": (None, [ctypes.c_int]),
     "brpc_usercode_inline": (ctypes.c_int, []),
+    # contention sampler (per-site stacks on contended FiberMutex locks)
+    "brpc_contention_folded": (ctypes.c_int, [ctypes.c_char_p,
+                                              ctypes.c_size_t]),
+    "brpc_contention_events": (ctypes.c_int64, []),
+    "brpc_contention_samples": (ctypes.c_int64, []),
+    "brpc_contention_reset": (None, []),
+    "brpc_contention_selftest": (ctypes.c_int, [ctypes.c_int, ctypes.c_int,
+                                                ctypes.c_int]),
     # fiber / butex (coroutine M:N runtime, src/cc/bthread/fiber.h)
     "brpc_fiber_demo_start": (ctypes.c_void_p, [ctypes.c_int]),
     "brpc_fiber_demo_blocked": (ctypes.c_int, [ctypes.c_void_p]),

@@ -4,6 +4,7 @@
 
 #include "bthread/executor.h"
 #include "bthread/timer.h"
+#include "butil/common.h"
 #include "bvar/combiner.h"
 
 namespace bthread {
@@ -23,6 +24,10 @@ void Butex::counters(int64_t* waits, int64_t* wakes, int64_t* timeouts,
 }
 
 void Butex::note_mutex_contention() { g_mutex_contended.add(1); }
+
+void Butex::note_contended_unlock(const void* lock) {
+  butil::contention_note(lock);
+}
 
 // Heap-allocated, refcounted waiter record.  Two owners can hold a pointer
 // concurrently: the butex list/waker side and the timer callback.  The

@@ -88,6 +88,11 @@ class Butex {
   static void counters(int64_t* waits, int64_t* wakes, int64_t* timeouts,
                        int64_t* mutex_contended);
   static void note_mutex_contention();
+  // Contended UNLOCK (waiters existed): samples a stack for
+  // /hotspots/contention — the unlocker's physical stack names the lock
+  // SITE (the waiter's would name the scheduler's resume path), which
+  // is exactly why the reference samples on unlock (mutex.cpp:122-145).
+  static void note_contended_unlock(const void* lock);
 
  private:
   friend struct Awaiter;

@@ -106,14 +106,18 @@ struct [[nodiscard]] Task {
 class FiberMutex {
  public:
   Task lock() {
-    for (;;) {
-      const int32_t prev =
-          _b.value.exchange(2, std::memory_order_acquire);
-      if (prev == 0) co_return;   // acquired (flagged contended: one
-                                  // spurious wake at unlock, never a hang)
-      Butex::note_mutex_contention();  // /bthreads contention stat
-      co_await _b.wait(2);        // kMismatch => value moved; just retry
+    // two-phase futex mutex (Drepper): uncontended acquire leaves 1, so
+    // unlock can tell "nobody ever waited" (prev 1: no wake, no
+    // contention sample) from "waiters may exist" (prev 2).  The old
+    // always-exchange-2 form made EVERY unlock look contended — it paid
+    // a wake() on an empty list per uncontended unlock and flooded the
+    // contention sampler with non-events.
+    int32_t zero = 0;
+    if (_b.value.compare_exchange_strong(zero, 1, std::memory_order_acquire,
+                                         std::memory_order_relaxed)) {
+      co_return;
     }
+    co_await lock_contended();
   }
 
   bool try_lock() {
@@ -122,8 +126,27 @@ class FiberMutex {
         zero, 1, std::memory_order_acquire, std::memory_order_relaxed);
   }
 
+  // Contended-path acquire: always leaves the value at 2 so the next
+  // unlock wakes the butex list.  REQUIRED for waiters that may have
+  // been requeued onto this mutex (FiberCond wait-morphing): acquiring
+  // via the CAS 0->1 fast path would erase the waiters flag while
+  // parked waiters still sit on the list, and their wake would never
+  // come (found by the stress suite's countdown section).
+  Task lock_contended() {
+    for (;;) {
+      const int32_t prev = _b.value.exchange(2, std::memory_order_acquire);
+      if (prev == 0) co_return;
+      Butex::note_mutex_contention();
+      co_await _b.wait(2);
+    }
+  }
+
   void unlock() {
     if (_b.value.exchange(0, std::memory_order_release) == 2) {
+      // waiters existed: sample for /hotspots/contention with THIS
+      // mutex's address as the site identity (see profiler.cc — the
+      // caller frames alone can be eaten by coroutine tail calls)
+      Butex::note_contended_unlock(this);
       _b.wake(1);
     }
   }
@@ -147,7 +170,9 @@ class FiberCond {
     const int32_t seq = _seq.value.load(std::memory_order_acquire);
     m.unlock();
     co_await _seq.wait(seq);
-    co_await m.lock();
+    // re-acquire via the CONTENDED path: this waiter may have been
+    // requeued onto m's butex alongside others — see lock_contended()
+    co_await m.lock_contended();
   }
 
   void notify_one() {

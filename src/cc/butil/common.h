@@ -94,6 +94,14 @@ int prof_stop();                 // returns samples collected, -1 if idle
 int prof_dump(const char* path); // legacy pprof format + /proc/self/maps
 int prof_folded(char* out, unsigned long cap);
 long long prof_sample_count();
+// Contention sampler (event-driven; FiberMutex contended-lock hook).
+// Always armed — capture is rate-bounded, so steady state costs one
+// atomic per contention event.
+void contention_note(const void* lock_addr);
+int contention_folded(char* out, unsigned long cap);
+int64_t contention_event_count();
+int64_t contention_sample_count();
+void contention_reset();
 int min_log_level();
 void log_message(int level, const char* fmt, ...) __attribute__((format(printf, 2, 3)));
 

@@ -19,6 +19,7 @@
 // exactly like gperftools.
 #include "butil/common.h"
 
+#include <dlfcn.h>
 #include <execinfo.h>
 #include <signal.h>
 #include <stdio.h>
@@ -209,6 +210,174 @@ int prof_folded(char* out, unsigned long cap) {
     } else {
       text.resize(cap - sizeof(kMark));
       text += kMark;            // sizeof includes the NUL slot
+    }
+  }
+  memcpy(out, text.data(), text.size());
+  out[text.size()] = 0;
+  return (int)text.size();
+}
+
+// ---- contention sampler (VERDICT r4 #8) ----
+//
+// Event-driven, not time-driven: like the reference's
+// ContentionProfiler (src/bthread/mutex.cpp:66,122-145) ours captures
+// on the contended UNLOCK path.  The caller stack at that point is
+// usually the executor's resume loop (coroutine symmetric transfer is
+// tail-called, the awaiting body's frame is gone), so the stack alone
+// cannot name the site — the LOCK'S OWN ADDRESS rides each sample as
+// the leaf frame instead (symbolized via dladdr; see contention_folded).
+// A token bucket bounds the capture rate: backtrace(3) is ~1-2us, and a
+// pathological convoy must cost samples, not throughput.  Samples live
+// in a ring so the page reflects RECENT contention.
+namespace {
+
+constexpr int kCMaxDepth = 32;
+constexpr int kCMaxSamples = 8192;
+constexpr int64_t kCSamplePeriodNs = 1000000;  // >= 1ms apart => <=1k/s
+
+struct CSample {
+  std::atomic<uint64_t> seq{0};  // even = stable, odd = being written
+  int depth;
+  const void* lock;  // identity of the contended lock (the leaf frame)
+  void* pcs[kCMaxDepth];
+};
+
+CSample g_csamples[kCMaxSamples];
+std::atomic<int64_t> g_cevents{0};   // every contention event, sampled or not
+std::atomic<int64_t> g_ccaptured{0};
+std::atomic<int64_t> g_clast_ns{0};  // token-bucket: last capture time
+
+}  // namespace
+
+void contention_note(const void* lock_addr) {
+  g_cevents.fetch_add(1, std::memory_order_relaxed);
+  const int64_t now = monotonic_time_ns();
+  int64_t last = g_clast_ns.load(std::memory_order_relaxed);
+  if (now - last < kCSamplePeriodNs) return;
+  if (!g_clast_ns.compare_exchange_strong(last, now,
+                                          std::memory_order_relaxed)) {
+    return;  // another thread took this token
+  }
+  const int64_t i = g_ccaptured.fetch_add(1, std::memory_order_relaxed);
+  CSample& s = g_csamples[i % kCMaxSamples];
+  const uint64_t seq = s.seq.load(std::memory_order_relaxed) | 1;
+  s.seq.store(seq, std::memory_order_release);     // mark mid-write
+  std::atomic_thread_fence(std::memory_order_release);
+  s.lock = lock_addr;
+  const int n = backtrace(s.pcs, kCMaxDepth);
+  const int skip = n > 1 ? 1 : 0;  // drop contention_note itself
+  s.depth = n - skip;
+  if (skip > 0) memmove(s.pcs, s.pcs + skip, sizeof(void*) * (size_t)s.depth);
+  // fences pair with the reader's acquire fence: payload writes cannot
+  // sink below the stable-marking store, and the reader's copies cannot
+  // hoist above its seq check (the seqlock protocol TSAN understands)
+  std::atomic_thread_fence(std::memory_order_release);
+  s.seq.store(seq + 1, std::memory_order_release);  // stable
+}
+
+int64_t contention_event_count() {
+  return g_cevents.load(std::memory_order_relaxed);
+}
+int64_t contention_sample_count() {
+  const int64_t n = g_ccaptured.load(std::memory_order_relaxed);
+  return n > kCMaxSamples ? kCMaxSamples : n;
+}
+
+void contention_reset() {
+  g_ccaptured.store(0, std::memory_order_relaxed);
+  g_cevents.store(0, std::memory_order_relaxed);
+  for (auto& s : g_csamples) s.seq.store(0, std::memory_order_relaxed);
+}
+
+// Folded stacks over the sample ring (same symbolization as prof_folded).
+int contention_folded(char* out, unsigned long cap) {
+  const int n = (int)contention_sample_count();
+  std::map<std::string, int> folded;
+  for (int i = 0; i < n; ++i) {
+    CSample& s = g_csamples[i];
+    const uint64_t seq0 = s.seq.load(std::memory_order_acquire);
+    if (seq0 == 0 || (seq0 & 1)) continue;  // empty or mid-write
+    std::atomic_thread_fence(std::memory_order_acquire);
+    int depth = s.depth;
+    const void* lock = s.lock;
+    void* pcs[kCMaxDepth];
+    if (depth <= 0 || depth > kCMaxDepth) continue;
+    memcpy(pcs, s.pcs, sizeof(void*) * (size_t)depth);
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s.seq.load(std::memory_order_relaxed) != seq0) continue;  // torn
+    std::string key;
+    for (int d = depth - 1; d >= 0; --d) {  // root first
+      // dladdr-based naming: exported functions get their symbol;
+      // local/coroutine-clone frames (not in dynsym) get
+      // "module+0xoffset", which `addr2line -e module 0xoffset`
+      // resolves to the exact lock site — without this every
+      // contended coroutine frame collapsed into one opaque
+      // "libbrpc_core.so" bucket and the page could not answer
+      // "WHICH lock".
+      Dl_info info;
+      char buf[160];
+      std::string frame;
+      if (dladdr(pcs[d], &info) != 0 && info.dli_fname != nullptr) {
+        if (info.dli_sname != nullptr) {
+          frame = info.dli_sname;
+        } else {
+          const char* sl = strrchr(info.dli_fname, '/');
+          snprintf(buf, sizeof(buf), "%s+0x%zx", sl ? sl + 1 : info.dli_fname,
+                   (size_t)((char*)pcs[d] - (char*)info.dli_fbase));
+          frame = buf;
+        }
+      } else {
+        snprintf(buf, sizeof(buf), "0x%zx", (size_t)pcs[d]);
+        frame = buf;
+      }
+      if (!key.empty()) key += ';';
+      key += frame;
+    }
+    // The LOCK IDENTITY is the leaf: coroutine symmetric transfer is
+    // tail-called by GCC, so the awaiting body's frame is often gone by
+    // unlock time and caller frames alone cannot name the site.  A
+    // global/static mutex resolves to its symbol (or module+offset) via
+    // dladdr; heap mutexes print their address.
+    {
+      Dl_info info;
+      char buf[160];
+      if (lock != nullptr && dladdr(lock, &info) != 0 &&
+          info.dli_fname != nullptr) {
+        if (info.dli_sname != nullptr) {
+          snprintf(buf, sizeof(buf), "lock:%s", info.dli_sname);
+        } else {
+          const char* sl = strrchr(info.dli_fname, '/');
+          snprintf(buf, sizeof(buf), "lock:%s+0x%zx",
+                   sl ? sl + 1 : info.dli_fname,
+                   (size_t)((const char*)lock - (char*)info.dli_fbase));
+        }
+      } else {
+        snprintf(buf, sizeof(buf), "lock:%p", lock);
+      }
+      if (!key.empty()) key += ';';
+      key += buf;
+    }
+    folded[key] += 1;
+  }
+  std::string text;
+  text += "# contention events: " +
+          std::to_string(contention_event_count()) +
+          ", stacks sampled: " + std::to_string(n) +
+          " (rate-bounded 1/ms)\n";
+  for (const auto& [k, c] : folded) {
+    text += k;
+    text += ' ';
+    text += std::to_string(c);
+    text += '\n';
+  }
+  if (cap == 0) return -1;
+  if (text.size() + 1 > cap) {
+    static const char kMark[] = "\n...truncated\n";
+    if (cap <= sizeof(kMark)) {
+      text.clear();
+    } else {
+      text.resize(cap - sizeof(kMark));
+      text += kMark;
     }
   }
   memcpy(out, text.data(), text.size());

@@ -3,8 +3,10 @@
 // protobuf stubs over a native core: transport, framing, buffers, timers and
 // the executor are native; protocol semantics live above.
 #include <cstring>
+#include <unistd.h>
 
 #include "bthread/butex.h"
+#include "bthread/fiber.h"
 #include "bthread/executor.h"
 #include "bthread/timer.h"
 #include "butil/common.h"
@@ -72,6 +74,71 @@ int brpc_prof_folded(char* out, size_t cap) {
   return butil::prof_folded(out, cap);
 }
 int64_t brpc_prof_samples() { return butil::prof_sample_count(); }
+
+// ---- contention sampler (/hotspots/contention per-site stacks) ----
+int brpc_contention_folded(char* out, size_t cap) {
+  return butil::contention_folded(out, cap);
+}
+int64_t brpc_contention_events() { return butil::contention_event_count(); }
+int64_t brpc_contention_samples() { return butil::contention_sample_count(); }
+void brpc_contention_reset() { butil::contention_reset(); }
+
+}  // extern "C" (coroutines need C++ linkage: with C linkage the ramp
+   // and its clones collide on one unmangled symbol)
+
+namespace {
+// Deliberately contended FiberMutexes behind two DISTINCT coroutine
+// bodies — the "two deliberately contended locks" acceptance test.
+// The coroutine resume clones are local symbols, so the folded output
+// distinguishes the sites as module+0xoffset (addr2line-able), not by
+// name; the test asserts two distinct stacks appear.
+bthread::FiberMutex g_ctest_mu_a;
+bthread::FiberMutex g_ctest_mu_b;
+std::atomic<int64_t> g_ctest_done{0};
+
+bthread::Fiber contention_fiber_alpha(int hold_us) {
+  co_await g_ctest_mu_a.lock();
+  // hold across a SUSPENSION: on a single core a spinning hold never
+  // spans a timeslice, so no other worker ever observes the lock taken
+  // and zero contention gets recorded — parking the holder guarantees
+  // the waiters pile up
+  co_await bthread::fiber_sleep_us(hold_us);
+  g_ctest_mu_a.unlock();
+  g_ctest_done.fetch_add(1, std::memory_order_release);
+}
+
+bthread::Fiber contention_fiber_beta(int hold_us) {
+  co_await g_ctest_mu_b.lock();
+  // deliberately different hold time: with EQUAL holds the two unlock
+  // chains stay phase-locked and one of them wins every 1/ms sample
+  // token — the page then shows a single site no matter how long the
+  // test runs
+  co_await bthread::fiber_sleep_us(hold_us + hold_us / 3 + 137);
+  g_ctest_mu_b.unlock();
+  g_ctest_done.fetch_add(1, std::memory_order_release);
+}
+}  // namespace
+
+extern "C" {
+
+// Spawn `tasks` fibers split across two lock sites and wait for them —
+// the contention self-test driver for tests/test_native_profiler.py.
+int brpc_contention_selftest(int tasks, int hold_us, int timeout_ms) {
+  g_ctest_done.store(0, std::memory_order_relaxed);
+  for (int i = 0; i < tasks; ++i) {
+    if (i & 1) {
+      contention_fiber_beta(hold_us).spawn();
+    } else {
+      contention_fiber_alpha(hold_us).spawn();
+    }
+  }
+  const int64_t deadline = butil::monotonic_time_us() + timeout_ms * 1000ll;
+  while (g_ctest_done.load(std::memory_order_acquire) < tasks) {
+    if (butil::monotonic_time_us() > deadline) return -1;
+    usleep(1000);
+  }
+  return 0;
+}
 
 // ---- IOBuf ----
 

@@ -392,3 +392,26 @@ def test_h2_continuation_storm_bounded():
     assert st is None or len(st.header_block) <= h2m.MAX_HEADER_BLOCK
     assert any(data[3:4] == bytes([h2m.GOAWAY]) for data in sent
                if len(data) >= 4)
+
+
+def test_fuzz_h2_coverage_guided():
+    """Coverage-GUIDED fuzz of the h2 state machine (VERDICT r4 #7;
+    reference test/fuzzing/* libFuzzer targets).  The engine
+    (tools/fuzz_h2_cov.py) tracks new-line coverage via sys.monitoring
+    and grows its corpus from inputs that light up new lines.  CI runs a
+    bounded slice; the tool's CLI runs the long campaigns.  Asserts the
+    feedback signal WORKS (corpus grows beyond the seeds) and nothing
+    raises."""
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "fuzz_h2_cov",
+        _os.path.join(_os.path.dirname(__file__), "..", "tools",
+                      "fuzz_h2_cov.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    r = mod.fuzz(6000, seed=SEED, log=lambda *a: None)
+    assert not r["crashes"], r["crashes"]
+    assert r["corpus_size"] > 5, "coverage feedback never grew the corpus"
+    assert r["covered_lines"] > 150

@@ -147,3 +147,52 @@ class TestFifoLane:
         finally:
             srv.stop()
             srv.join()
+
+
+def test_contention_stacks_two_distinct_sites():
+    """VERDICT r4 #8: /hotspots/contention must answer WHICH lock.  Two
+    deliberately contended FiberMutexes behind distinct coroutine bodies
+    (brpc_contention_selftest) must yield at least two DISTINCT sampled
+    stacks, and the event counter must move."""
+    import ctypes
+
+    from brpc_tpu._core import core, core_init
+    core_init()
+    core.brpc_contention_reset()
+    ev0 = core.brpc_contention_events()
+    # each holder parks 1ms while holding; waiters of both sites pile up
+    # well past the 1/ms sample rate bound
+    rc = core.brpc_contention_selftest(120, 1000, 30_000)
+    assert rc == 0, "selftest fibers did not finish"
+    assert core.brpc_contention_events() > ev0, "no contention noted"
+    assert core.brpc_contention_samples() > 0, "no stacks sampled"
+    buf = ctypes.create_string_buffer(1 << 20)
+    n = core.brpc_contention_folded(buf, len(buf))
+    assert n > 0
+    text = buf.value.decode()
+    stacks = [ln for ln in text.splitlines()
+              if ln and not ln.startswith("#")]
+    assert len(stacks) >= 2, f"expected >=2 distinct sites, got:\n{text}"
+
+
+def test_contention_page_renders():
+    import brpc_tpu as brpc
+    import urllib.request
+
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return req
+
+    srv = brpc.Server()
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/hotspots/contention"
+                f"?seconds=0.2", timeout=10) as r:
+            body = r.read().decode()
+        assert "native FiberMutex contention sites" in body
+    finally:
+        srv.stop()
+        srv.join()

@@ -57,7 +57,10 @@ class TestHotspots:
         srv.start("127.0.0.1", 0)
         try:
             body = _get(srv.port, "/hotspots/contention?seconds=0.3")
-            assert "contention profile" in body
+            # page now has two sections: native per-site stacks + the
+            # python sampling view
+            assert "native FiberMutex contention sites" in body
+            assert "lock/queue waits" in body
             assert "cond_waiter" in body
         finally:
             stop.set()
