@@ -21,6 +21,7 @@ MSG_MONGO = 5
 MSG_H2 = 6
 MSG_RAW = 7
 MSG_NSHEAD = 8
+MSG_FILTERED = 9   # transport-filter ciphertext (in-socket TLS)
 
 _here = os.path.dirname(os.path.abspath(__file__))
 _libpath = os.path.join(_here, "libbrpc_core.so")
@@ -151,6 +152,10 @@ _sigs = {
     "brpc_socket_write_raw": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_char_p,
                                              ctypes.c_size_t, ctypes.c_void_p]),
     "brpc_socket_set_protocol": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_int]),
+    # transport filter (in-socket TLS)
+    "brpc_socket_set_filter": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_int]),
+    "brpc_socket_inject": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_char_p,
+                                          ctypes.c_size_t]),
     "brpc_socket_set_failed": (ctypes.c_int, [ctypes.c_uint64, ctypes.c_int]),
     "brpc_socket_alive": (ctypes.c_int, [ctypes.c_uint64]),
     "brpc_socket_stats": (ctypes.c_int, [ctypes.c_uint64,

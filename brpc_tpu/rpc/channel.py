@@ -42,6 +42,12 @@ class ChannelOptions:
     # availability floor for circuit breaking (ClusterRecoverPolicy);
     # None = isolate freely (single-server channels have no cluster)
     cluster_recover_policy: Optional[Any] = None
+    # In-socket TLS (rpc/tls_engine.py): an ssl.SSLContext for client-side
+    # TLS to this channel's servers.  Registered per endpoint on the
+    # shared SocketMap (mirrors the reference's per-Channel
+    # ChannelSSLOptions, socket.h SSL integration).
+    tls_context: Optional[Any] = None
+    tls_server_hostname: Optional[str] = None
 
 
 class RetryPolicy:
@@ -93,6 +99,9 @@ class SocketMap:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # ep -> (ssl_context, server_hostname): connections to these
+        # endpoints are TLS-wrapped at connect time (in-socket TLS)
+        self._tls_eps: dict[EndPoint, tuple] = {}
         self._conns: dict[EndPoint, _ClientConn] = {}
         self._sid_to_ep: dict[int, EndPoint] = {}
         self._pool: dict[EndPoint, list[_ClientConn]] = {}
@@ -107,9 +116,18 @@ class SocketMap:
         sid = Transport.instance().connect_rpc(
             host, ep.port, mgr.on_message, self._on_socket_failed,
             on_response=mgr.on_fast_response)
+        tls = self._tls_eps.get(ep)
+        if tls is not None:
+            # wrap BEFORE returning: no caller may write plaintext first
+            Transport.instance().enable_tls(
+                sid, tls[0], server_side=False, server_hostname=tls[1])
         with self._lock:
             self._sid_to_ep[sid] = ep
         return _ClientConn(sid, ep)
+
+    def set_endpoint_tls(self, ep, context, server_hostname=None) -> None:
+        with self._lock:
+            self._tls_eps[ep] = (context, server_hostname)
 
     def get_connection(self, ep: EndPoint) -> _ClientConn:
         with self._lock:
@@ -495,12 +513,24 @@ class Channel:
             self._endpoint = address
             return self
         if "://" in address:
+            if self.options.tls_context is not None:
+                # silently sending cleartext to every LB-resolved server
+                # would be worse than failing loudly; per-resolved-endpoint
+                # TLS registration is future work
+                raise ValueError(
+                    "tls_context is not yet supported with naming-service "
+                    "addresses; use a direct host:port channel per server")
             from brpc_tpu.policy.naming import start_naming_service
             from brpc_tpu.policy.load_balancer import create_load_balancer
             self._lb = create_load_balancer(load_balancer or "rr")
             self._ns_thread = start_naming_service(address, self._lb)
         else:
             self._endpoint = str2endpoint(address)
+        if self.options.tls_context is not None and \
+                self._endpoint is not None:
+            SocketMap.instance().set_endpoint_tls(
+                self._endpoint, self.options.tls_context,
+                self.options.tls_server_hostname or self._endpoint.host)
         return self
 
     # ---- server selection (LB hook) ----

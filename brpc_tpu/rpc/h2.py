@@ -1164,13 +1164,17 @@ class GrpcChannel:
     """
 
     def __init__(self, address: str, timeout_ms: int = 5000,
-                 compression: Optional[str] = None):
+                 compression: Optional[str] = None, tls_context=None,
+                 tls_server_hostname: Optional[str] = None):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self._timeout_ms = timeout_ms
         self._enc_name = None if compression in (None, "identity") \
             else compression
         self._tx_codec = grpc_codec(compression)   # raises on unknown
+        # in-socket TLS (h2 over TLS; rpc/tls_engine.py)
+        self._tls = (tls_context, tls_server_hostname or self._addr[0]) \
+            if tls_context is not None else None
         self._lock = threading.Lock()
         self._conn: Optional[_GrpcClientConnection] = None
 
@@ -1197,7 +1201,8 @@ class GrpcChannel:
     def _ensure(self) -> "_GrpcClientConnection":
         with self._lock:
             if self._conn is None or not self._conn.alive():
-                self._conn = _GrpcClientConnection(*self._addr)
+                self._conn = _GrpcClientConnection(*self._addr,
+                                                   tls=self._tls)
             return self._conn
 
     def _with_deadline(self, metadata, timeout_ms):
@@ -1453,7 +1458,7 @@ class GrpcBidiCall:
 
 
 class _GrpcClientConnection(H2Connection):
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, tls=None):
         # every field the native callbacks touch must exist BEFORE
         # connect(): the dispatcher thread may fire _on_message/_on_failed
         # the moment the socket registers
@@ -1465,6 +1470,11 @@ class _GrpcClientConnection(H2Connection):
         self._calls_lock = threading.Lock()
         tp = Transport.instance()
         self.sid = tp.connect(host, port, self._on_message, self._on_failed)
+        if tls is not None:
+            # h2-over-TLS: wrap before the preface leaves (the preface
+            # below is plaintext to US but rides the engine encrypted)
+            tp.enable_tls(self.sid, tls[0], server_side=False,
+                          server_hostname=tls[1])
         tp.set_protocol(self.sid, MSG_H2)
         self.send_preface_and_settings()
 

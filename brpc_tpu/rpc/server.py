@@ -183,6 +183,13 @@ class ServerOptions:
     # Process-wide.  Mutually exclusive in spirit with
     # usercode_in_pthread (which exists FOR blocking handlers).
     usercode_inline: bool = False
+    # In-socket TLS for the main port (reference ServerSSLOptions /
+    # socket.h SSL integration): an ssl.SSLContext with a loaded cert
+    # chain; every accepted connection is TLS-wrapped before its first
+    # byte parses, and every protocol on the port rides it.  NOTE: do
+    # not combine with usercode_latency_budget_ms (its native-packed
+    # ELIMIT shed would bypass the TLS engine).
+    tls_context: Optional[Any] = None
 
 
 class MethodStatus:
@@ -431,6 +438,17 @@ class Server:
         self._listen_sid, self._port = t.listen_rpc(
             addr, port, self._on_message, self._on_conn_failed,
             on_request=self._on_fast_request)
+        if self.options.tls_context is not None:
+            if self.options.usercode_latency_budget_ms > 0:
+                # the native ELIMIT shed packs and writes PLAINTEXT
+                # directly, bypassing the TLS engine: under overload the
+                # error response would leak in cleartext and kill the
+                # session — refuse the combination up front
+                raise ValueError(
+                    "tls_context cannot be combined with "
+                    "usercode_latency_budget_ms (the native shed path "
+                    "bypasses the TLS engine)")
+            t.enable_tls_listener(self._listen_sid, self.options.tls_context)
         # native method map (FlatMap behind DoublyBufferedData, net/rpc.h):
         # requests to these methods are meta-parsed and method-matched in
         # C++ and arrive pre-parsed; everything else (auth/trace/stream

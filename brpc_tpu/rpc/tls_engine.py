@@ -1,0 +1,155 @@
+"""In-socket TLS: a per-connection ``ssl.MemoryBIO`` engine pumped
+through the native socket's transport filter (VERDICT r4 #9; reference
+integrates SSL into the Socket itself, src/brpc/socket.h:276-278 +
+details/ssl_helper.h — our image has no OpenSSL headers, so the record
+layer runs on Python's ``ssl`` while framing/parse/dispatch stay
+native).
+
+Flow, per connection:
+  inbound   fd -> native read -> MSG_FILTERED ciphertext (FIFO lane)
+            -> TlsEngine.feed_ciphertext -> SSLObject.read plaintext
+            -> brpc_socket_inject -> native parse -> normal dispatch
+  outbound  protocol bytes -> Transport interception -> write_plain
+            -> SSLObject.write -> ciphertext -> native write_raw
+
+Unlike the stunnel-shaped proxies in rpc/tls.py (kept for compat), the
+SAME socket carries TLS: no loopback hop, no second fd, and every
+protocol on the port (TRPC, HTTP console, h2/gRPC, redis, ...) rides it
+transparently.
+
+Known limitation: native-packed writes that bypass the Python transport
+(the usercode latency-budget ELIMIT shed and pure-native inline_run
+method handlers) would emit plaintext — do not combine those features
+with TLS on the same port; the Python handler path (the normal server
+configuration) is fully intercepted.
+"""
+from __future__ import annotations
+
+import ssl
+import threading
+from typing import Optional
+
+from brpc_tpu._core import core
+
+
+class TlsError(Exception):
+    pass
+
+
+class TlsEngine:
+    """One side of a TLS connection over a filtered native socket.
+
+    Thread-safety: ``feed_ciphertext`` runs on the socket's FIFO lane
+    (serialized); ``write_plain`` may come from any caller thread — the
+    RLock serializes the SSLObject, whose BIO pairs are not
+    thread-safe."""
+
+    def __init__(self, sid: int, context: ssl.SSLContext, server_side: bool,
+                 server_hostname: Optional[str] = None):
+        self.sid = sid
+        self._in = ssl.MemoryBIO()
+        self._out = ssl.MemoryBIO()
+        self._obj = context.wrap_bio(self._in, self._out,
+                                     server_side=server_side,
+                                     server_hostname=server_hostname)
+        self._mu = threading.RLock()
+        self._handshaken = False
+        self._failed: Optional[str] = None
+        self._pending_plain: list[bytes] = []
+
+    # ---- inbound (FIFO-lane thread) ----
+
+    def feed_ciphertext(self, data: bytes) -> None:
+        with self._mu:
+            if self._failed is not None:
+                return
+            self._in.write(data)
+            self._pump_locked()
+
+    # ---- outbound (any thread) ----
+
+    def write_plain(self, data: bytes) -> int:
+        """Queue plaintext for the peer.  Before the handshake finishes
+        the bytes are buffered and flushed the moment it does — callers
+        never block on the handshake."""
+        with self._mu:
+            if self._failed is not None:
+                return -1
+            if not self._handshaken:
+                self._pending_plain.append(bytes(data))
+                # opportunistically advance the handshake (client hello
+                # on a fresh client engine rides this path)
+                self._pump_locked()
+                return 0
+            self._obj.write(data)
+            return self._flush_out_locked()
+
+    def start(self) -> None:
+        """Kick the handshake (client side: emits ClientHello)."""
+        with self._mu:
+            self._pump_locked()
+
+    # ---- internals (call with _mu held) ----
+
+    def _pump_locked(self) -> None:
+        if not self._handshaken:
+            try:
+                self._obj.do_handshake()
+                self._handshaken = True
+                for p in self._pending_plain:
+                    self._obj.write(p)
+                self._pending_plain.clear()
+            except ssl.SSLWantReadError:
+                self._flush_out_locked()
+                return
+            except ssl.SSLError as e:
+                self._fail_locked(f"handshake failed: {e}")
+                return
+        # drain decrypted application data back into the native parser
+        while True:
+            try:
+                chunk = self._obj.read(1 << 16)
+            except ssl.SSLWantReadError:
+                break
+            except ssl.SSLZeroReturnError:
+                # close_notify: orderly TLS shutdown == connection EOF
+                self._flush_out_locked()
+                core.brpc_socket_set_failed(self.sid, 0)
+                return
+            except ssl.SSLError as e:
+                self._fail_locked(f"record layer failed: {e}")
+                return
+            if not chunk:
+                break
+            core.brpc_socket_inject(self.sid, chunk, len(chunk))
+        self._flush_out_locked()
+
+    def _flush_out_locked(self) -> int:
+        data = self._out.read()
+        if data:
+            return core.brpc_socket_write_raw(self.sid, data, len(data),
+                                              None)
+        return 0
+
+    def _fail_locked(self, why: str) -> None:
+        self._failed = why
+        # EPROTO-shaped close: the peer sees a dead connection, local
+        # callers see EFAILEDSOCKET via the normal failure path
+        core.brpc_socket_set_failed(self.sid, 71)
+
+
+def make_server_context(certfile: str, keyfile: str) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(certfile, keyfile)
+    return ctx
+
+
+def make_client_context(cafile: Optional[str] = None,
+                        insecure: bool = False) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    if cafile:
+        ctx.load_verify_locations(cafile)
+    if insecure:
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+    return ctx

@@ -13,10 +13,10 @@ import threading
 from typing import Callable, Optional
 
 from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, IOBuf, MESSAGE_CB,
-                            MSG_H2, MSG_HTTP, MSG_MEMCACHE, MSG_MONGO,
-                            MSG_NSHEAD, MSG_RAW, MSG_REDIS, MSG_THRIFT,
-                            MSG_TRPC, REQUEST_CB, RESPONSE_CB, TASK_CB, core,
-                            core_init)
+                            MSG_FILTERED, MSG_H2, MSG_HTTP, MSG_MEMCACHE,
+                            MSG_MONGO, MSG_NSHEAD, MSG_RAW, MSG_REDIS,
+                            MSG_THRIFT, MSG_TRPC, REQUEST_CB, RESPONSE_CB,
+                            TASK_CB, core, core_init)
 from brpc_tpu._core import _fastrpc
 
 
@@ -44,11 +44,22 @@ class Transport:
         self._timer_lock = threading.Lock()
         self._timer_cbs: dict[int, Callable[[], None]] = {}
         self._timer_token = 1
+        # in-socket TLS (rpc/tls_engine.py): sid -> TlsEngine, and TLS
+        # listeners whose accepted connections auto-wrap
+        self._tls: dict[int, object] = {}
+        self._tls_listener_ctx: dict[int, object] = {}
 
         # Process-lifetime trampolines (pinned as attributes).
         @MESSAGE_CB
         def _on_message(sid, kind, meta, meta_len, body, user):
             buf = IOBuf(handle=body)  # takes ownership, freed at GC
+            if kind == MSG_FILTERED:
+                # in-socket TLS: ciphertext for this connection's engine;
+                # decrypted bytes re-enter the native parser via inject
+                eng = self._tls.get(sid)
+                if eng is not None:
+                    eng.feed_ciphertext(buf.to_bytes())
+                return
             m = ctypes.string_at(meta, meta_len) if meta_len else b""
             h = self._handlers.get(sid)
             if h is not None:
@@ -64,6 +75,8 @@ class Transport:
                 h = self._handlers.pop(sid, None)
                 self._request_handlers.pop(sid, None)
                 self._response_handlers.pop(sid, None)
+                self._tls.pop(sid, None)
+                self._tls_listener_ctx.pop(sid, None)
             if h is not None and h[1] is not None:
                 try:
                     h[1](sid, err)
@@ -82,6 +95,12 @@ class Transport:
             if rh is not None:
                 with self._lock:
                     self._request_handlers[conn] = rh
+            ctx = self._tls_listener_ctx.get(listener)
+            if ctx is not None:
+                # TLS listener: wrap the accepted connection BEFORE any
+                # byte parses (accepted sockets are defer-registered, so
+                # the filter flag is in place when the fd is armed)
+                self.enable_tls(conn, ctx, server_side=True)
 
         # fast-path dispatchers (_fastrpc C extension: natively pre-parsed
         # metas arrive as flat args; the body is an IOBuf-backed READ-ONLY
@@ -205,6 +224,38 @@ class Transport:
                 self._response_handlers[sid.value] = on_response
         return sid.value
 
+    # ---- in-socket TLS (rpc/tls_engine.py) ----
+
+    def enable_tls(self, sid: int, context, server_side: bool,
+                   server_hostname: str | None = None) -> None:
+        """Switch `sid` into TLS mode: the native socket delivers raw
+        ciphertext to a per-connection MemoryBIO engine and plaintext is
+        re-injected into its parser; all outbound writes through this
+        transport are encrypted.  Call before any traffic (right after
+        connect, or from the accept hook)."""
+        from brpc_tpu.rpc.tls_engine import TlsEngine
+        eng = TlsEngine(sid, context, server_side, server_hostname)
+        with self._lock:
+            self._tls[sid] = eng
+        core.brpc_socket_set_filter(sid, 1)
+        if not server_side:
+            eng.start()   # emit ClientHello
+
+    def enable_tls_listener(self, listener_sid: int, context) -> None:
+        """Every connection accepted by `listener_sid` is TLS-wrapped
+        (server side) before its first byte parses."""
+        with self._lock:
+            self._tls_listener_ctx[listener_sid] = context
+
+    def tls_engine(self, sid: int):
+        return self._tls.get(sid)
+
+    @staticmethod
+    def _pack_trpc(meta: bytes, body: bytes) -> bytes:
+        import struct
+        return (b"TRPC" + struct.pack(">I", len(meta))
+                + struct.pack(">Q", len(body)) + meta + body)
+
     @staticmethod
     def register_python_method(service: str, method: str) -> None:
         core.brpc_register_python_method(service.encode(), method.encode())
@@ -218,7 +269,19 @@ class Transport:
                      method: str, timeout_ms: int, compress: int,
                      content_type: str, body: bytes) -> int:
         """Pack + write a TRPC request frame natively (no Python meta
-        encode, no ctypes marshalling)."""
+        encode, no ctypes marshalling).  TLS connections pack in Python
+        and ride the engine instead (the native writer would emit
+        plaintext)."""
+        inst = Transport._instance
+        eng = inst._tls.get(sid) if inst is not None else None
+        if eng is not None:
+            from brpc_tpu.rpc import meta as M
+            m = M.RpcMeta(msg_type=M.MSG_REQUEST, correlation_id=cid,
+                          attempt=attempt, service=service, method=method,
+                          timeout_ms=timeout_ms or 0, compress_type=compress,
+                          content_type=content_type or "")
+            return eng.write_plain(
+                Transport._pack_trpc(m.encode(), bytes(body)))
         return _fastrpc.send_request(sid, cid, attempt, service, method,
                                      timeout_ms or 0, compress, content_type,
                                      body)
@@ -227,17 +290,36 @@ class Transport:
     def send_response(sid: int, cid: int, attempt: int, error_code: int,
                       error_text: str, content_type: str,
                       body: bytes) -> int:
+        inst = Transport._instance
+        eng = inst._tls.get(sid) if inst is not None else None
+        if eng is not None:
+            from brpc_tpu.rpc import meta as M
+            m = M.RpcMeta(msg_type=M.MSG_RESPONSE, correlation_id=cid,
+                          attempt=attempt, error_code=error_code,
+                          error_text=error_text or "",
+                          content_type=content_type or "")
+            return eng.write_plain(
+                Transport._pack_trpc(m.encode(), bytes(body)))
         return _fastrpc.send_response(sid, cid, attempt, error_code,
                                       error_text or "", content_type or "",
                                       body)
 
     def write_frame(self, sid: int, meta: bytes, body: bytes = b"",
                     body_iobuf: IOBuf | None = None) -> int:
+        eng = self._tls.get(sid)
+        if eng is not None:
+            full = bytes(body)
+            if body_iobuf is not None:
+                full += body_iobuf.to_bytes()
+            return eng.write_plain(self._pack_trpc(bytes(meta), full))
         return core.brpc_socket_write_frame(
             sid, meta, len(meta), body, len(body),
             body_iobuf.handle if body_iobuf is not None else None)
 
     def write_raw(self, sid: int, data: bytes) -> int:
+        eng = self._tls.get(sid)
+        if eng is not None:
+            return eng.write_plain(bytes(data))
         return core.brpc_socket_write_raw(sid, data, len(data), None)
 
     def set_protocol(self, sid: int, kind: int) -> None:

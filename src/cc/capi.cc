@@ -268,6 +268,47 @@ int brpc_socket_set_protocol(uint64_t sid, int kind) {
   return 0;
 }
 
+// ---- transport filter (in-socket TLS; net/socket.h set_filter_mode) ----
+
+int brpc_socket_set_filter(uint64_t sid, int on) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  s->set_filter_mode(on != 0);
+  s->Dereference();
+  return 0;
+}
+
+namespace {
+struct InjectTask {
+  uint64_t sid;
+  butil::IOBuf data;
+};
+
+void run_inject(void* arg) {
+  auto* t = (InjectTask*)arg;
+  brpc::Socket* s = brpc::Socket::Address(t->sid);
+  if (s != nullptr) {
+    s->InjectBytes(std::move(t->data));
+    s->Dereference();
+  }
+  delete t;
+}
+}  // namespace
+
+// Feed decrypted plaintext back into `sid`'s parse/dispatch path.  Runs
+// on the socket's dispatcher loop thread (the only thread allowed to
+// touch its read buffer); safe from any caller.
+int brpc_socket_inject(uint64_t sid, const void* data, size_t len) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  const int shard = s->dispatcher_shard();
+  s->Dereference();
+  auto* t = new InjectTask{sid, butil::IOBuf()};
+  t->data.append(data, len);
+  brpc::EventDispatcher::GetDispatcher(shard)->RunOnLoop(run_inject, t);
+  return 0;
+}
+
 int brpc_socket_set_failed(uint64_t sid, int error_code) {
   return brpc::Socket::SetFailed(sid, error_code);
 }

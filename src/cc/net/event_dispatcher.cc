@@ -1,6 +1,7 @@
 #include "net/event_dispatcher.h"
 
 #include <errno.h>
+#include <fcntl.h>
 #include <sys/epoll.h>
 #include <unistd.h>
 
@@ -16,6 +17,10 @@ EventDispatcher::EventDispatcher() {
   _epfd = epoll_create1(EPOLL_CLOEXEC);
   if (pipe(_wakeup) != 0) {
     BLOG(ERROR, "EventDispatcher: pipe() failed: %d", errno);
+  } else {
+    // read end must be non-blocking: the loop drains it until empty
+    fcntl(_wakeup[0], F_SETFL,
+          fcntl(_wakeup[0], F_GETFL, 0) | O_NONBLOCK);
   }
   epoll_event ev;
   ev.events = EPOLLIN;
@@ -63,6 +68,29 @@ void EventDispatcher::Join() {
   if (_thread.joinable()) _thread.join();
 }
 
+void EventDispatcher::RunOnLoop(void (*fn)(void*), void* arg) {
+  {
+    std::lock_guard<std::mutex> g(_tasks_mu);
+    _tasks.emplace_back(fn, arg);
+  }
+  const char c = 1;
+  ssize_t rc = write(_wakeup[1], &c, 1);
+  (void)rc;
+}
+
+void EventDispatcher::DrainLoopTasks() {
+  for (;;) {
+    std::pair<void (*)(void*), void*> t;
+    {
+      std::lock_guard<std::mutex> g(_tasks_mu);
+      if (_tasks.empty()) return;
+      t = _tasks.front();
+      _tasks.pop_front();
+    }
+    t.first(t.second);
+  }
+}
+
 void EventDispatcher::Run() {
   // NOTE: boosting this thread's priority (nice -10) was tried and
   // REVERTED: on a core-starved host it starves the usercode workers —
@@ -83,7 +111,15 @@ void EventDispatcher::Run() {
     NoteDispatchSweepStart();  // inline-usercode admission window
     for (int i = 0; i < n; ++i) {
       const SocketId sid = events[i].data.u64;
-      if (sid == (uint64_t)-1) continue;  // wakeup pipe
+      if (sid == (uint64_t)-1) {
+        // wakeup pipe: drain it (level-triggered registration — leftover
+        // bytes would spin the loop) and run queued loop tasks
+        char buf[256];
+        while (read(_wakeup[0], buf, sizeof(buf)) > 0) {
+        }
+        DrainLoopTasks();
+        continue;
+      }
       Socket* s = Socket::Address(sid);
       if (s == nullptr) continue;  // stale: slot recycled, drop
       if (events[i].events & (EPOLLIN | EPOLLRDHUP | EPOLLHUP | EPOLLERR)) {

@@ -9,6 +9,8 @@
 #pragma once
 
 #include <atomic>
+#include <deque>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -29,17 +31,26 @@ class EventDispatcher {
   void Stop();
   void Join();
 
+  // Run `fn(arg)` on this dispatcher's loop thread between epoll sweeps
+  // (wakes the loop).  The ONLY way foreign threads may touch
+  // loop-thread-owned socket state (e.g. InjectBytes for the TLS
+  // filter); fns must be quick and non-blocking.
+  void RunOnLoop(void (*fn)(void*), void* arg);
+
   static void InitGlobal(int num);        // idempotent; default 2
   static EventDispatcher* GetDispatcher(int fd);
   static void ShutdownGlobal();
 
  private:
   void Run();
+  void DrainLoopTasks();
 
   int _epfd = -1;
   int _wakeup[2] = {-1, -1};
   std::atomic<bool> _stop{false};
   std::thread _thread;
+  std::mutex _tasks_mu;
+  std::deque<std::pair<void (*)(void*), void*>> _tasks;
 };
 
 }  // namespace brpc

@@ -66,6 +66,12 @@ enum MessageKind {
   // One nshead message: meta = the 36-byte nshead header, body = body.
   // Detected by magic 0xfb709394 at offset 24.
   MSG_NSHEAD = 8,
+  // Transport-filter delivery (in-socket TLS): ALL buffered inbound
+  // bytes handed to the filter callback as ciphertext; the filter
+  // decrypts and re-injects plaintext via Socket::InjectBytes, which
+  // runs the normal parse/dispatch over it.  Selected only via
+  // set_filter_mode; never auto-detected.
+  MSG_FILTERED = 9,
 };
 
 enum ParseResult {

@@ -80,6 +80,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->_read_buf.clear();
   s->_parse = ParseState();
   s->_forced_protocol.store(-1, std::memory_order_relaxed);
+  s->_filter_mode.store(false, std::memory_order_relaxed);  // recycled slot
   s->_write_stack.store(nullptr, std::memory_order_relaxed);
   s->_write_busy.store(false, std::memory_order_relaxed);
   s->_waiting_epollout.store(false, std::memory_order_relaxed);
@@ -413,17 +414,43 @@ bthread::Fiber Socket::KeepWriteFiber(Socket* self, int32_t seq) {
 
 // ---- read path ----
 
+struct PendingMessage {
+  SocketId sid;
+  int kind;
+  std::string meta;
+  butil::IOBuf* body;
+  MessageCallback cb;
+  void* user;
+};
+
+static void run_message_task(void* arg) {
+  auto* m = (PendingMessage*)arg;
+  m->cb(m->sid, m->kind, m->meta.data(), m->meta.size(), m->body, m->user);
+  delete m;  // callback owns *body (freed via C ABI)
+}
+
 void Socket::OnReadable() {
   if (_opts.is_listener) {
     DoAcceptLoop();
     return;
   }
+  const bool filtered = _filter_mode.load(std::memory_order_acquire);
   while (true) {
-    const ssize_t nr = _read_buf.append_from_file_descriptor(_fd, 256 * 1024);
+    // Filter mode (in-socket TLS): ciphertext reads go into a LOCAL
+    // portal and straight to the filter callback — _read_buf holds ONLY
+    // injected plaintext, so split plaintext frames can never
+    // interleave with later ciphertext reads.
+    butil::IOPortal local;
+    butil::IOPortal& buf = filtered ? local : _read_buf;
+    const ssize_t nr = buf.append_from_file_descriptor(_fd, 256 * 1024);
     if (nr > 0) {
       _nread.fetch_add(nr, std::memory_order_relaxed);
       g_total_read_bytes.add(nr);
-      DispatchMessages();
+      if (filtered) {
+        DeliverFiltered(&local);
+      } else {
+        DispatchMessages();
+      }
       // Edge-triggered: must keep reading until EAGAIN.
       continue;
     }
@@ -438,19 +465,28 @@ void Socket::OnReadable() {
   }
 }
 
-struct PendingMessage {
-  SocketId sid;
-  int kind;
-  std::string meta;
-  butil::IOBuf* body;
-  MessageCallback cb;
-  void* user;
-};
+void Socket::DeliverFiltered(butil::IOPortal* cipher) {
+  if (_opts.on_message == nullptr) {
+    cipher->clear();
+    return;
+  }
+  const int64_t bytes = (int64_t)cipher->size() + 256;
+  auto* pm = new PendingMessage{_id, MSG_FILTERED, std::string(),
+                                new butil::IOBuf(std::move(*cipher)),
+                                _opts.on_message, _opts.user};
+  // the FIFO lane keeps ciphertext chunks ordered for the TLS engine
+  // (and orders them ahead of the failure notification)
+  if (!FifoSubmit(run_message_task, pm, bytes)) {
+    delete pm->body;
+    delete pm;
+  }
+}
 
-static void run_message_task(void* arg) {
-  auto* m = (PendingMessage*)arg;
-  m->cb(m->sid, m->kind, m->meta.data(), m->meta.size(), m->body, m->user);
-  delete m;  // callback owns *body (freed via C ABI)
+void Socket::InjectBytes(butil::IOBuf&& data) {
+  // dispatcher-loop thread only (EventDispatcher::RunOnLoop): append the
+  // filter's plaintext and run the normal parse/dispatch over it
+  _read_buf.append(std::move(data));
+  DispatchMessages();
 }
 
 struct FifoTask {

@@ -158,6 +158,19 @@ class Socket {
     _forced_protocol.store(kind, std::memory_order_release);
   }
 
+  // Transport filter (in-socket TLS): ALL inbound bytes are delivered
+  // as MSG_FILTERED ciphertext to on_message (per-connection FIFO lane)
+  // instead of being parsed; the filter re-injects plaintext via
+  // InjectBytes.  Set BEFORE the first byte parses (accepted-callback /
+  // right after connect).
+  void set_filter_mode(bool on) {
+    _filter_mode.store(on, std::memory_order_release);
+  }
+  // Dispatcher-loop-thread ONLY (route via EventDispatcher::RunOnLoop):
+  // append plaintext to the read buffer and run the normal parse.
+  void InjectBytes(butil::IOBuf&& data);
+  int dispatcher_shard() const { return _fd; }  // for GetDispatcher routing
+
   // ---- called by EventDispatcher ----
   void OnReadable();
   void OnWritable();
@@ -173,6 +186,7 @@ class Socket {
   friend class EventDispatcher;
 
   void DoAcceptLoop();
+  void DeliverFiltered(butil::IOPortal* cipher);
   static bthread::Fiber KeepWriteFiber(Socket* self, int32_t seq);
   void DrainWriteQueue(bool from_keepwrite);
   void ReleaseWriterAndMaybeResume();
@@ -204,6 +218,7 @@ class Socket {
   butil::IOPortal _read_buf;
   ParseState _parse;
   std::atomic<int> _forced_protocol{-1};
+  std::atomic<bool> _filter_mode{false};
   // FIFO-protocol delivery lane (redis/h2/thrift/streams): an
   // ExecutionQueue per socket preserves per-connection order while
   // moving Python-bound callbacks OFF the dispatcher thread — the

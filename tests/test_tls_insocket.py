@@ -1,0 +1,167 @@
+"""In-socket TLS (rpc/tls_engine.py; VERDICT r4 #9): the SAME native
+socket carries TLS — ciphertext filtered to a MemoryBIO engine,
+plaintext re-injected into the native parser — with no stunnel-shaped
+proxy hop.  Covers: TRPC-over-TLS, h2/gRPC-over-TLS, HTTP console over
+TLS, and interop with a VANILLA `ssl`-wrapped client socket (proof the
+wire is real TLS, not a lookalike)."""
+import json
+import socket
+import ssl
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu.rpc.h2 import GrpcChannel
+from brpc_tpu.rpc.tls_engine import make_client_context, make_server_context
+
+
+@pytest.fixture(scope="module")
+def certpair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
+         "-out", cert, "-days", "2", "-nodes", "-subj",
+         "/CN=127.0.0.1", "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+@pytest.fixture(scope="module")
+def tls_server(certpair):
+    cert, key = certpair
+
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return bytes(req)
+
+        @brpc.method(request="json", response="json")
+        def Add(self, cntl, req):
+            return {"sum": req["a"] + req["b"]}
+
+    srv = brpc.Server(brpc.ServerOptions(
+        tls_context=make_server_context(cert, key)))
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    yield srv, cert
+    srv.stop()
+    srv.join()
+
+
+def test_trpc_over_tls_roundtrip(tls_server):
+    srv, cert = tls_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=10_000,
+                      tls_context=make_client_context(cafile=cert))
+    for sz in (0, 1, 128, 5000, 300_000):
+        p = bytes([sz % 251]) * sz
+        got = ch.call_sync("Echo", "Echo", p, serializer="raw")
+        assert bytes(got) == p, f"size {sz}"
+    # json serializer path too
+    r = ch.call_sync("Echo", "Add", {"a": 2, "b": 40}, serializer="json",
+                     response_serializer="json")
+    assert r["sum"] == 42
+
+
+def test_trpc_over_tls_concurrent(tls_server):
+    srv, cert = tls_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=10_000,
+                      tls_context=make_client_context(cafile=cert))
+    errs = []
+
+    def worker(k):
+        try:
+            for i in range(30):
+                p = b"%d-%d" % (k, i)
+                assert bytes(ch.call_sync("Echo", "Echo", p,
+                                          serializer="raw")) == p
+        except Exception as e:
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+
+
+def test_grpc_over_tls(tls_server):
+    srv, cert = tls_server
+    ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=10_000,
+                     tls_context=make_client_context(cafile=cert))
+    assert ch.call("Echo", "Echo", b"h2-over-tls") == b"h2-over-tls"
+    ch.close()
+
+
+def test_http_console_over_tls_with_vanilla_ssl_client(tls_server):
+    """Interop proof: a STOCK ssl-wrapped socket (no framework code on
+    the client side) speaks HTTP to the console through the TLS port."""
+    srv, cert = tls_server
+    ctx = make_client_context(cafile=cert)
+    raw = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+    s = ctx.wrap_socket(raw, server_hostname="127.0.0.1")
+    s.sendall(b"GET /health HTTP/1.1\r\nHost: x\r\n"
+              b"Connection: close\r\n\r\n")
+    data = b""
+    s.settimeout(10)
+    try:
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+    except (ssl.SSLError, OSError):
+        pass
+    s.close()
+    assert b"200" in data.split(b"\r\n", 1)[0], data[:120]
+    assert b"OK" in data or b"ok" in data.lower()
+
+
+def test_plaintext_client_rejected_by_tls_port(tls_server):
+    """A plaintext TRPC frame at a TLS port must not elicit a plaintext
+    response (the handshake fails instead) — the port is really TLS."""
+    srv, _ = tls_server
+    s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+    s.sendall(b"TRPC" + b"\x00" * 12 + b"junk-not-tls")
+    s.settimeout(3)
+    try:
+        data = s.recv(4096)
+    except (socket.timeout, ConnectionResetError):
+        data = b""
+    s.close()
+    assert b"TRPC" not in data, "plaintext response from a TLS port!"
+
+
+def test_tls_and_plain_servers_coexist(certpair):
+    cert, key = certpair
+
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return bytes(req)
+
+    tls_srv = brpc.Server(brpc.ServerOptions(
+        tls_context=make_server_context(cert, key)))
+    tls_srv.add_service(Echo())
+    tls_srv.start("127.0.0.1", 0)
+    plain_srv = brpc.Server()
+    plain_srv.add_service(Echo())
+    plain_srv.start("127.0.0.1", 0)
+    try:
+        cht = brpc.Channel(f"127.0.0.1:{tls_srv.port}", timeout_ms=10_000,
+                           tls_context=make_client_context(cafile=cert))
+        chp = brpc.Channel(f"127.0.0.1:{plain_srv.port}", timeout_ms=10_000)
+        assert bytes(cht.call_sync("Echo", "Echo", b"secure",
+                                   serializer="raw")) == b"secure"
+        assert bytes(chp.call_sync("Echo", "Echo", b"plain",
+                                   serializer="raw")) == b"plain"
+    finally:
+        tls_srv.stop()
+        tls_srv.join()
+        plain_srv.stop()
+        plain_srv.join()

@@ -256,6 +256,7 @@ def fuzz(execs: int, seed: int = 7, log=print) -> dict:
     covered += tracker.take_new()
     t0 = time.monotonic()
     crashes = []
+    i = -1  # execs=0: the loop never binds i; the result math still needs it
     for i in range(execs):
         data = mutate(rng, corpus)
         try:
