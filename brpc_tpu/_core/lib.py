@@ -253,6 +253,11 @@ _sigs = {
     "brpc_contention_reset": (None, []),
     "brpc_contention_selftest": (ctypes.c_int, [ctypes.c_int, ctypes.c_int,
                                                 ctypes.c_int]),
+    # IOBuf block-allocation-site sampler (/memory)
+    "brpc_iobuf_alloc_folded": (ctypes.c_int, [ctypes.c_char_p,
+                                               ctypes.c_size_t]),
+    "brpc_iobuf_alloc_events": (ctypes.c_int64, []),
+    "brpc_iobuf_alloc_reset": (None, []),
     # fiber / butex (coroutine M:N runtime, src/cc/bthread/fiber.h)
     "brpc_fiber_demo_start": (ctypes.c_void_p, [ctypes.c_int]),
     "brpc_fiber_demo_blocked": (ctypes.c_int, [ctypes.c_void_p]),

@@ -229,10 +229,19 @@ def build_routes(server) -> dict:
         return json.dumps(out, indent=1), "application/json"
 
     def memory(req):
+        import ctypes
         import resource
         ru = resource.getrusage(resource.RUSAGE_SELF)
+        buf = ctypes.create_string_buffer(1 << 18)
+        n = core.brpc_iobuf_alloc_folded(buf, len(buf))
+        sites = buf.value.decode("utf-8", "replace") if n > 0 else ""
         return (f"max_rss_kb: {ru.ru_maxrss}\n"
-                f"live_iobuf_blocks: {core.brpc_iobuf_live_blocks()}\n")
+                f"live_iobuf_blocks: {core.brpc_iobuf_live_blocks()}\n"
+                f"iobuf_block_handouts: {core.brpc_iobuf_alloc_events()}\n"
+                f"\n--- iobuf block allocation sites (sampled 1/ms; "
+                f"reference iobuf_profiler analog; addr2line -e "
+                f"libbrpc_core.so <offset> for local frames) ---\n"
+                f"{sites}")
 
     def ici(req):
         try:
@@ -480,6 +489,8 @@ def _apply_flag_side_effects(name: str) -> None:
     if name == "rpcz_enabled" or name == "rpcz_sample_rate":
         rpcz.set_enabled(get_flag("rpcz_enabled", True),
                          get_flag("rpcz_sample_rate", 1.0))
+    elif name == "rpcz_database_dir":
+        rpcz.set_database_dir(get_flag("rpcz_database_dir", "") or None)
     elif name == "health_check_interval_s":
         from brpc_tpu.policy import health_check
         health_check.health_check_interval_s = \

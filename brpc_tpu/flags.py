@@ -81,3 +81,6 @@ define_flag("rpcz_enabled", False, "Collect per-RPC spans (off by default "
             reloadable=True)
 define_flag("rpcz_sample_rate", 1.0, "Fraction of spans kept",
             reloadable=True)
+define_flag("rpcz_database_dir", "", "Persist collected spans to recordio "
+            "segments under this directory (reference on-disk SpanDB, "
+            "span.h:227); empty = in-memory only", reloadable=True)

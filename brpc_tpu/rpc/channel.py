@@ -65,11 +65,12 @@ DEFAULT_RETRY_POLICY = RetryPolicy()
 
 
 class _ClientConn:
-    __slots__ = ("sid", "endpoint")
+    __slots__ = ("sid", "endpoint", "tls")
 
     def __init__(self, sid: int, endpoint: EndPoint):
         self.sid = sid
         self.endpoint = endpoint
+        self.tls = False   # set by SocketMap._connect when TLS-wrapped
 
 
 class SocketMap:
@@ -123,7 +124,9 @@ class SocketMap:
                 sid, tls[0], server_side=False, server_hostname=tls[1])
         with self._lock:
             self._sid_to_ep[sid] = ep
-        return _ClientConn(sid, ep)
+        conn = _ClientConn(sid, ep)
+        conn.tls = tls is not None
+        return conn
 
     def set_endpoint_tls(self, ep, context, server_hostname=None) -> None:
         with self._lock:
@@ -132,8 +135,20 @@ class SocketMap:
     def get_connection(self, ep: EndPoint) -> _ClientConn:
         with self._lock:
             c = self._conns.get(ep)
+            want_tls = ep in self._tls_eps
+            if c is not None and getattr(c, "tls", False) != want_tls:
+                # TLS was registered for this endpoint AFTER a plaintext
+                # connection was cached (or vice versa): reusing it would
+                # send bytes in the wrong cryptographic mode — drop it
+                # and reconnect in the registered mode
+                self._conns.pop(ep, None)
+                stale, c = c, None
+            else:
+                stale = None
             if c is not None:
                 return c
+        if stale is not None:
+            self.close_quietly(stale.sid)
         c = self._connect(ep)
         with self._lock:
             cur = self._conns.get(ep)
@@ -513,24 +528,12 @@ class Channel:
             self._endpoint = address
             return self
         if "://" in address:
-            if self.options.tls_context is not None:
-                # silently sending cleartext to every LB-resolved server
-                # would be worse than failing loudly; per-resolved-endpoint
-                # TLS registration is future work
-                raise ValueError(
-                    "tls_context is not yet supported with naming-service "
-                    "addresses; use a direct host:port channel per server")
             from brpc_tpu.policy.naming import start_naming_service
             from brpc_tpu.policy.load_balancer import create_load_balancer
             self._lb = create_load_balancer(load_balancer or "rr")
             self._ns_thread = start_naming_service(address, self._lb)
         else:
             self._endpoint = str2endpoint(address)
-        if self.options.tls_context is not None and \
-                self._endpoint is not None:
-            SocketMap.instance().set_endpoint_tls(
-                self._endpoint, self.options.tls_context,
-                self.options.tls_server_hostname or self._endpoint.host)
         return self
 
     # ---- server selection (LB hook) ----
@@ -721,6 +724,13 @@ class Channel:
         cntl.remote_side = str(ep)
         try:
             smap = SocketMap.instance()
+            if self.options.tls_context is not None:
+                # NS/LB channels resolve endpoints dynamically: register
+                # TLS for whichever server this attempt selected BEFORE
+                # the connection is (possibly) created
+                smap.set_endpoint_tls(
+                    ep, self.options.tls_context,
+                    self.options.tls_server_hostname or ep.host)
             ctype = self.options.connection_type
             if ctype == "pooled":
                 conn = smap.get_pooled(ep)

@@ -129,9 +129,125 @@ def current_trace() -> tuple[int, int]:
     return s.trace_id, s.span_id
 
 
+# ---- on-disk SpanDB (reference span.h:227-230 keeps rpcz spans in an
+# on-disk database so traces survive the in-memory window/restarts; ours
+# is recordio-framed json with size rotation, written on the COLLECTOR
+# thread so the RPC path never touches disk) ----
+_db_lock = threading.Lock()
+_db_dir: str | None = None
+_db_writer = None
+_db_file = None
+_db_bytes = 0
+_DB_ROTATE_BYTES = 16 << 20
+_DB_KEEP_FILES = 4
+
+
+def set_database_dir(path: str | None) -> None:
+    """Enable (or disable with None) span persistence under `path`."""
+    global _db_dir, _db_writer, _db_file, _db_bytes
+    import os
+    with _db_lock:
+        if _db_file is not None:
+            try:
+                _db_file.close()
+            except OSError:
+                pass
+        _db_writer = _db_file = None
+        _db_bytes = 0
+        _db_dir = path or None
+        if _db_dir:
+            os.makedirs(_db_dir, exist_ok=True)
+
+
+def _db_append_locked(span: Span) -> None:
+    import json
+    import os
+
+    from brpc_tpu.butil.recordio import RecordWriter
+    global _db_writer, _db_file, _db_bytes
+    if _db_writer is None or _db_bytes >= _DB_ROTATE_BYTES:
+        if _db_file is not None:
+            try:
+                _db_file.close()
+            except OSError:
+                pass
+        # prune BEFORE creating the new segment (covers restart into a
+        # dir full of old segments too): keep the newest KEEP-1 so the
+        # steady state is KEEP files including the one about to open
+        segs = sorted(f for f in os.listdir(_db_dir)
+                      if f.startswith("spans-"))
+        for old in segs[:-(_DB_KEEP_FILES - 1)] if _DB_KEEP_FILES > 1 \
+                else segs:
+            try:
+                os.unlink(os.path.join(_db_dir, old))
+            except OSError:
+                pass
+        name = os.path.join(_db_dir, f"spans-{now_us()}.rio")
+        _db_file = open(name, "ab")
+        _db_writer = RecordWriter(_db_file)
+        _db_bytes = 0
+    rec = json.dumps({
+        "trace_id": span.trace_id, "span_id": span.span_id,
+        "parent_span_id": span.parent_span_id, "service": span.service,
+        "method": span.method, "remote_side": span.remote_side,
+        "start_us": span.start_us, "end_us": span.end_us,
+        "request_size": span.request_size,
+        "response_size": span.response_size,
+        "error_code": span.error_code, "kind": span.kind,
+        "annotations": list(span.annotations)}).encode()
+    _db_writer.write(rec)
+    # no per-span flush: a write(2) per span would defeat buffering; the
+    # reader flushes the live writer before scanning, and RecordReader
+    # resyncs past any torn tail after a crash
+    _db_bytes += len(rec) + 20
+
+
+def load_disk_spans(limit: int = 200,
+                    trace_id: int | None = None) -> list[Span]:
+    """Read persisted spans back (newest segments last; resyncs past
+    torn tails via RecordReader)."""
+    import json
+    import os
+
+    from brpc_tpu.butil.recordio import RecordReader
+    with _db_lock:
+        d = _db_dir
+        if _db_writer is not None:
+            try:
+                _db_writer.flush()   # make the live segment readable
+            except OSError:
+                pass
+    if not d or not os.path.isdir(d):
+        return []
+    # newest segments first, stop as soon as `limit` spans are found —
+    # older 16MB segments are never parsed for the common recent-N query
+    out: list[Span] = []
+    for name in sorted((f for f in os.listdir(d)
+                        if f.startswith("spans-")), reverse=True):
+        seg: list[Span] = []
+        try:
+            with open(os.path.join(d, name), "rb") as f:
+                for _meta, body in RecordReader(f):
+                    try:
+                        rec = json.loads(body.decode())
+                    except ValueError:
+                        continue
+                    if trace_id is not None and \
+                            rec.get("trace_id") != trace_id:
+                        continue
+                    ann = [tuple(a) for a in rec.pop("annotations", [])]
+                    seg.append(Span(annotations=ann, **rec))
+        except OSError:
+            continue
+        out = seg + out
+        if len(out) >= limit:
+            break
+    return out[-limit:]
+
+
 class _SpanSample:
-    """Collected wrapper: moves the store append (and any future
-    indexing/serialization) off the RPC thread."""
+    """Collected wrapper: moves the store append (and on-disk SpanDB
+    persistence) off the RPC thread — both run on the collector."""
 
     __slots__ = ("span",)
 
@@ -141,6 +257,12 @@ class _SpanSample:
     def dump_and_destroy(self) -> None:
         with _collect_lock:
             _collected.append(self.span)
+        with _db_lock:
+            if _db_dir is not None:
+                try:
+                    _db_append_locked(self.span)
+                except OSError:
+                    pass  # disk trouble must never break collection
 
 
 def submit(span: Span) -> None:

@@ -102,6 +102,13 @@ int contention_folded(char* out, unsigned long cap);
 int64_t contention_event_count();
 int64_t contention_sample_count();
 void contention_reset();
+// IOBuf block-allocation-site sampler (reference butil/iobuf_profiler.h
+// analog): sampled in iobuf create_block, same ring/rate machinery.
+void iobuf_alloc_note();
+int iobuf_alloc_folded(char* out, unsigned long cap);
+int64_t iobuf_alloc_event_count();
+int64_t iobuf_alloc_sample_count();
+void iobuf_alloc_reset();
 int min_log_level();
 void log_message(int level, const char* fmt, ...) __attribute__((format(printf, 2, 3)));
 

@@ -1,5 +1,7 @@
 #include "butil/iobuf.h"
 
+#include "butil/common.h"
+
 #include <errno.h>
 #include <sys/uio.h>
 #include <unistd.h>
@@ -47,6 +49,7 @@ static void destroy_block(Block* b) {
 }
 
 Block* create_block(size_t payload_cap) {
+  iobuf_alloc_note();  // sampled alloc-site stacks (/memory)
   TlsBlockCache& c = tls_cache;
   if (payload_cap == kDefaultPayload && c.head != nullptr) {
     Block* b = c.head;

@@ -217,18 +217,19 @@ int prof_folded(char* out, unsigned long cap) {
   return (int)text.size();
 }
 
-// ---- contention sampler (VERDICT r4 #8) ----
+// ---- event samplers (contention + IOBuf alloc sites) ----
 //
-// Event-driven, not time-driven: like the reference's
-// ContentionProfiler (src/bthread/mutex.cpp:66,122-145) ours captures
-// on the contended UNLOCK path.  The caller stack at that point is
-// usually the executor's resume loop (coroutine symmetric transfer is
-// tail-called, the awaiting body's frame is gone), so the stack alone
-// cannot name the site — the LOCK'S OWN ADDRESS rides each sample as
-// the leaf frame instead (symbolized via dladdr; see contention_folded).
-// A token bucket bounds the capture rate: backtrace(3) is ~1-2us, and a
-// pathological convoy must cost samples, not throughput.  Samples live
-// in a ring so the page reflects RECENT contention.
+// Shared shape: event-driven (not time-driven) stack capture into a
+// seqlock-protected ring, rate-bounded by a token bucket so a hot path
+// costs one relaxed atomic per event in steady state.  Two instances:
+//  * contention (VERDICT r4 #8): like the reference ContentionProfiler
+//    (src/bthread/mutex.cpp:66,122-145) capture happens on the
+//    contended UNLOCK; the caller stack there is usually the executor's
+//    resume loop (coroutine symmetric transfer is tail-called), so the
+//    LOCK'S OWN ADDRESS rides each sample as the leaf frame.
+//  * iobuf_alloc (reference butil/iobuf_profiler.h): block allocation
+//    sites, sampled in iobuf.cc create_block — answers WHERE buffer
+//    memory is being minted when /sockets' live-block count grows.
 namespace {
 
 constexpr int kCMaxDepth = 32;
@@ -238,68 +239,97 @@ constexpr int64_t kCSamplePeriodNs = 1000000;  // >= 1ms apart => <=1k/s
 struct CSample {
   std::atomic<uint64_t> seq{0};  // even = stable, odd = being written
   int depth;
-  const void* lock;  // identity of the contended lock (the leaf frame)
+  const void* leaf;  // event identity (lock address; null for allocs)
   void* pcs[kCMaxDepth];
 };
 
-CSample g_csamples[kCMaxSamples];
-std::atomic<int64_t> g_cevents{0};   // every contention event, sampled or not
-std::atomic<int64_t> g_ccaptured{0};
-std::atomic<int64_t> g_clast_ns{0};  // token-bucket: last capture time
+struct EventSampler {
+  CSample ring[kCMaxSamples];
+  std::atomic<int64_t> events{0};    // every event, sampled or not
+  std::atomic<int64_t> captured{0};
+  std::atomic<int64_t> last_ns{0};   // token bucket
 
-}  // namespace
-
-void contention_note(const void* lock_addr) {
-  g_cevents.fetch_add(1, std::memory_order_relaxed);
-  const int64_t now = monotonic_time_ns();
-  int64_t last = g_clast_ns.load(std::memory_order_relaxed);
-  if (now - last < kCSamplePeriodNs) return;
-  if (!g_clast_ns.compare_exchange_strong(last, now,
-                                          std::memory_order_relaxed)) {
-    return;  // another thread took this token
+  void note(const void* leaf_addr, int skip_frames, int64_t clock_every) {
+    const int64_t ev = events.fetch_add(1, std::memory_order_relaxed);
+    // hot-event instances (block allocs) only consult the clock every
+    // Nth event, keeping steady-state cost at one relaxed atomic; rare-
+    // event instances (contention) pass 1 and check every time
+    if (clock_every > 1 && (ev % clock_every) != 0) return;
+    const int64_t now = monotonic_time_ns();
+    int64_t last = last_ns.load(std::memory_order_relaxed);
+    if (now - last < kCSamplePeriodNs) return;
+    if (!last_ns.compare_exchange_strong(last, now,
+                                         std::memory_order_relaxed)) {
+      return;  // another thread took this token
+    }
+    const int64_t i = captured.fetch_add(1, std::memory_order_relaxed);
+    CSample& s = ring[i % kCMaxSamples];
+    const uint64_t seq = s.seq.load(std::memory_order_relaxed) | 1;
+    s.seq.store(seq, std::memory_order_release);     // mark mid-write
+    std::atomic_thread_fence(std::memory_order_release);
+    s.leaf = leaf_addr;
+    const int n = backtrace(s.pcs, kCMaxDepth);
+    const int skip = n > skip_frames ? skip_frames : 0;
+    s.depth = n - skip;
+    if (skip > 0) {
+      memmove(s.pcs, s.pcs + skip, sizeof(void*) * (size_t)s.depth);
+    }
+    // fences pair with the reader's acquire fence: payload writes cannot
+    // sink below the stable-marking store, and the reader's copies
+    // cannot hoist above its seq check (the seqlock protocol)
+    std::atomic_thread_fence(std::memory_order_release);
+    s.seq.store(seq + 1, std::memory_order_release);  // stable
   }
-  const int64_t i = g_ccaptured.fetch_add(1, std::memory_order_relaxed);
-  CSample& s = g_csamples[i % kCMaxSamples];
-  const uint64_t seq = s.seq.load(std::memory_order_relaxed) | 1;
-  s.seq.store(seq, std::memory_order_release);     // mark mid-write
-  std::atomic_thread_fence(std::memory_order_release);
-  s.lock = lock_addr;
-  const int n = backtrace(s.pcs, kCMaxDepth);
-  const int skip = n > 1 ? 1 : 0;  // drop contention_note itself
-  s.depth = n - skip;
-  if (skip > 0) memmove(s.pcs, s.pcs + skip, sizeof(void*) * (size_t)s.depth);
-  // fences pair with the reader's acquire fence: payload writes cannot
-  // sink below the stable-marking store, and the reader's copies cannot
-  // hoist above its seq check (the seqlock protocol TSAN understands)
-  std::atomic_thread_fence(std::memory_order_release);
-  s.seq.store(seq + 1, std::memory_order_release);  // stable
+
+  int64_t sample_count() const {
+    const int64_t n = captured.load(std::memory_order_relaxed);
+    return n > kCMaxSamples ? kCMaxSamples : n;
+  }
+
+  void reset() {
+    captured.store(0, std::memory_order_relaxed);
+    events.store(0, std::memory_order_relaxed);
+    for (auto& s : ring) s.seq.store(0, std::memory_order_relaxed);
+  }
+};
+
+EventSampler g_contention;
+EventSampler g_iobuf_alloc;
+
+// dladdr-based naming: exported functions get their symbol; local/
+// coroutine-clone frames (not in dynsym) get "module+0xoffset", which
+// `addr2line -e module 0xoffset` resolves to the exact site — without
+// this every local frame collapsed into one opaque "libbrpc_core.so"
+// bucket.
+std::string symbolize_pc(const void* pc, const char* prefix) {
+  Dl_info info;
+  char buf[160];
+  if (pc != nullptr && dladdr(pc, &info) != 0 && info.dli_fname != nullptr) {
+    if (info.dli_sname != nullptr) {
+      snprintf(buf, sizeof(buf), "%s%s", prefix, info.dli_sname);
+    } else {
+      const char* sl = strrchr(info.dli_fname, '/');
+      snprintf(buf, sizeof(buf), "%s%s+0x%zx", prefix,
+               sl ? sl + 1 : info.dli_fname,
+               (size_t)((const char*)pc - (char*)info.dli_fbase));
+    }
+  } else {
+    snprintf(buf, sizeof(buf), "%s%p", prefix, pc);
+  }
+  return buf;
 }
 
-int64_t contention_event_count() {
-  return g_cevents.load(std::memory_order_relaxed);
-}
-int64_t contention_sample_count() {
-  const int64_t n = g_ccaptured.load(std::memory_order_relaxed);
-  return n > kCMaxSamples ? kCMaxSamples : n;
-}
-
-void contention_reset() {
-  g_ccaptured.store(0, std::memory_order_relaxed);
-  g_cevents.store(0, std::memory_order_relaxed);
-  for (auto& s : g_csamples) s.seq.store(0, std::memory_order_relaxed);
-}
-
-// Folded stacks over the sample ring (same symbolization as prof_folded).
-int contention_folded(char* out, unsigned long cap) {
-  const int n = (int)contention_sample_count();
+int render_ring(EventSampler& es, const char* what, bool leaf_is_identity,
+                const char* leaf_prefix, char* out, unsigned long cap) {
+  const int n = (int)es.sample_count();
   std::map<std::string, int> folded;
   for (int i = 0; i < n; ++i) {
-    CSample& s = g_csamples[i];
+    CSample& s = es.ring[i];
     const uint64_t seq0 = s.seq.load(std::memory_order_acquire);
     if (seq0 == 0 || (seq0 & 1)) continue;  // empty or mid-write
     std::atomic_thread_fence(std::memory_order_acquire);
     int depth = s.depth;
-    const void* lock = s.lock;
+    const void* leaf = s.leaf;
     void* pcs[kCMaxDepth];
     if (depth <= 0 || depth > kCMaxDepth) continue;
     memcpy(pcs, s.pcs, sizeof(void*) * (size_t)depth);
@@ -307,61 +337,22 @@ int contention_folded(char* out, unsigned long cap) {
     if (s.seq.load(std::memory_order_relaxed) != seq0) continue;  // torn
     std::string key;
     for (int d = depth - 1; d >= 0; --d) {  // root first
-      // dladdr-based naming: exported functions get their symbol;
-      // local/coroutine-clone frames (not in dynsym) get
-      // "module+0xoffset", which `addr2line -e module 0xoffset`
-      // resolves to the exact lock site — without this every
-      // contended coroutine frame collapsed into one opaque
-      // "libbrpc_core.so" bucket and the page could not answer
-      // "WHICH lock".
-      Dl_info info;
-      char buf[160];
-      std::string frame;
-      if (dladdr(pcs[d], &info) != 0 && info.dli_fname != nullptr) {
-        if (info.dli_sname != nullptr) {
-          frame = info.dli_sname;
-        } else {
-          const char* sl = strrchr(info.dli_fname, '/');
-          snprintf(buf, sizeof(buf), "%s+0x%zx", sl ? sl + 1 : info.dli_fname,
-                   (size_t)((char*)pcs[d] - (char*)info.dli_fbase));
-          frame = buf;
-        }
-      } else {
-        snprintf(buf, sizeof(buf), "0x%zx", (size_t)pcs[d]);
-        frame = buf;
-      }
       if (!key.empty()) key += ';';
-      key += frame;
+      key += symbolize_pc(pcs[d], "");
     }
-    // The LOCK IDENTITY is the leaf: coroutine symmetric transfer is
-    // tail-called by GCC, so the awaiting body's frame is often gone by
-    // unlock time and caller frames alone cannot name the site.  A
-    // global/static mutex resolves to its symbol (or module+offset) via
-    // dladdr; heap mutexes print their address.
-    {
-      Dl_info info;
-      char buf[160];
-      if (lock != nullptr && dladdr(lock, &info) != 0 &&
-          info.dli_fname != nullptr) {
-        if (info.dli_sname != nullptr) {
-          snprintf(buf, sizeof(buf), "lock:%s", info.dli_sname);
-        } else {
-          const char* sl = strrchr(info.dli_fname, '/');
-          snprintf(buf, sizeof(buf), "lock:%s+0x%zx",
-                   sl ? sl + 1 : info.dli_fname,
-                   (size_t)((const char*)lock - (char*)info.dli_fbase));
-        }
-      } else {
-        snprintf(buf, sizeof(buf), "lock:%p", lock);
-      }
+    if (leaf_is_identity) {
+      // e.g. a mutex address as the site identity: a global/static
+      // object resolves to its symbol via dladdr; heap ones print raw
       if (!key.empty()) key += ';';
-      key += buf;
+      key += symbolize_pc(leaf, leaf_prefix);
     }
     folded[key] += 1;
   }
   std::string text;
-  text += "# contention events: " +
-          std::to_string(contention_event_count()) +
+  text += "# ";
+  text += what;
+  text += " events: " +
+          std::to_string(es.events.load(std::memory_order_relaxed)) +
           ", stacks sampled: " + std::to_string(n) +
           " (rate-bounded 1/ms)\n";
   for (const auto& [k, c] : folded) {
@@ -383,6 +374,35 @@ int contention_folded(char* out, unsigned long cap) {
   memcpy(out, text.data(), text.size());
   out[text.size()] = 0;
   return (int)text.size();
+}
+
+}  // namespace
+
+void contention_note(const void* lock_addr) {
+  g_contention.note(lock_addr, /*skip=*/1, /*clock_every=*/1);
+}
+int64_t contention_event_count() {
+  return g_contention.events.load(std::memory_order_relaxed);
+}
+int64_t contention_sample_count() { return g_contention.sample_count(); }
+void contention_reset() { g_contention.reset(); }
+int contention_folded(char* out, unsigned long cap) {
+  return render_ring(g_contention, "contention", /*leaf=*/true, "lock:",
+                     out, cap);
+}
+
+void iobuf_alloc_note() {
+  // skip 2: this function + create_block (the caller IS the site)
+  g_iobuf_alloc.note(nullptr, /*skip=*/2, /*clock_every=*/64);
+}
+int64_t iobuf_alloc_event_count() {
+  return g_iobuf_alloc.events.load(std::memory_order_relaxed);
+}
+int64_t iobuf_alloc_sample_count() { return g_iobuf_alloc.sample_count(); }
+void iobuf_alloc_reset() { g_iobuf_alloc.reset(); }
+int iobuf_alloc_folded(char* out, unsigned long cap) {
+  return render_ring(g_iobuf_alloc, "iobuf block alloc", /*leaf=*/false,
+                     "", out, cap);
 }
 
 }  // namespace butil

@@ -83,6 +83,13 @@ int64_t brpc_contention_events() { return butil::contention_event_count(); }
 int64_t brpc_contention_samples() { return butil::contention_sample_count(); }
 void brpc_contention_reset() { butil::contention_reset(); }
 
+// ---- IOBuf alloc-site sampler (/memory; butil/iobuf_profiler analog) ----
+int brpc_iobuf_alloc_folded(char* out, size_t cap) {
+  return butil::iobuf_alloc_folded(out, cap);
+}
+int64_t brpc_iobuf_alloc_events() { return butil::iobuf_alloc_event_count(); }
+void brpc_iobuf_alloc_reset() { butil::iobuf_alloc_reset(); }
+
 }  // extern "C" (coroutines need C++ linkage: with C linkage the ramp
    // and its clones collide on one unmangled symbol)
 

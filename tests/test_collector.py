@@ -101,3 +101,33 @@ class TestRpczThroughCollector:
                        for x in spans)
         finally:
             rpcz.set_enabled(False)
+
+
+def test_rpcz_on_disk_spandb(tmp_path):
+    """On-disk SpanDB (reference span.h:227-230): spans persist to
+    recordio segments and load back, surviving the in-memory window."""
+    from brpc_tpu import rpcz
+
+    rpcz.set_database_dir(str(tmp_path))
+    rpcz.set_enabled(True)
+    try:
+        for i in range(40):
+            s = rpcz.new_span("server", "DbSvc", f"M{i % 4}")
+            s.request_size = i
+            s.annotate("persisted")
+            rpcz.submit(s)
+        # collector flush drives dump_and_destroy (disk write included)
+        spans = rpcz.recent_spans(limit=50)
+        assert len(spans) >= 40
+        disk = rpcz.load_disk_spans(limit=100)
+        assert len(disk) >= 40
+        by_method = {d.method for d in disk}
+        assert {"M0", "M1", "M2", "M3"} <= by_method
+        assert any(d.annotations for d in disk)
+        # trace filter works on the disk path too
+        one = disk[-1]
+        got = rpcz.load_disk_spans(trace_id=one.trace_id)
+        assert got and all(g.trace_id == one.trace_id for g in got)
+    finally:
+        rpcz.set_enabled(False)
+        rpcz.set_database_dir(None)

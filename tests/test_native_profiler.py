@@ -196,3 +196,36 @@ def test_contention_page_renders():
     finally:
         srv.stop()
         srv.join()
+
+
+def test_iobuf_alloc_sites_on_memory_page():
+    """IOBuf alloc-site sampler (reference butil/iobuf_profiler.h analog):
+    block handouts are counted and sampled with stacks; /memory renders
+    them."""
+    import urllib.request
+
+    import brpc_tpu as brpc
+    from brpc_tpu._core import core
+
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return req
+
+    core.brpc_iobuf_alloc_reset()
+    srv = brpc.Server()
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        for i in range(200):
+            ch.call_sync("Echo", "Echo", b"x" * 4096, serializer="raw")
+        assert core.brpc_iobuf_alloc_events() > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/memory", timeout=10) as r:
+            body = r.read().decode()
+        assert "iobuf block allocation sites" in body
+        assert "iobuf_block_handouts:" in body
+    finally:
+        srv.stop()
+        srv.join()

@@ -165,3 +165,34 @@ def test_tls_and_plain_servers_coexist(certpair):
         tls_srv.join()
         plain_srv.stop()
         plain_srv.join()
+
+
+def test_tls_with_load_balancer(certpair):
+    """NS/LB channel with tls_context: every resolved server gets TLS
+    (registered per selected endpoint at call time)."""
+    cert, key = certpair
+
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return bytes(req)
+
+    servers = []
+    for _ in range(2):
+        s = brpc.Server(brpc.ServerOptions(
+            tls_context=make_server_context(cert, key)))
+        s.add_service(Echo())
+        s.start("127.0.0.1", 0)
+        servers.append(s)
+    try:
+        addr = "list://" + ",".join(f"127.0.0.1:{s.port}" for s in servers)
+        ch = brpc.Channel(addr, load_balancer="rr", timeout_ms=10_000,
+                          tls_context=make_client_context(cafile=cert))
+        for i in range(8):   # rr walks both servers
+            p = b"lb-%d" % i
+            assert bytes(ch.call_sync("Echo", "Echo", p,
+                                      serializer="raw")) == p
+    finally:
+        for s in servers:
+            s.stop()
+            s.join()
