@@ -1,52 +1,69 @@
-"""TLS-encrypted RPC (reference ServerOptions.ssl_options role; see
-README "TLS and unix sockets" for why this build terminates TLS with
-in-process proxies over Python's ssl).
+"""In-socket TLS demo (rpc/tls_engine.py; the reference integrates SSL
+into the Socket itself, socket.h:276-278): ONE TLS port carries every
+protocol — TRPC echo calls, a gRPC call, and an HTTPS console fetch —
+with no proxy hop.  The older stunnel-shaped proxy topology
+(rpc/tls.py TlsTerminator) still exists — this file's own pre-round-5
+git history demos that shape.
 
-Generates a throwaway self-signed cert, stands up a server + TLS
-terminator, and calls through an encrypted channel.
+Generates a throwaway self-signed cert, stands up a TLS server, and
+drives three protocols through the encrypted port.
 
 Run:  python examples/tls_echo.py
 """
 import os
+import ssl
 import subprocess
 import sys
 import tempfile
+import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-import brpc_tpu as brpc
-from brpc_tpu.rpc.tls import TlsTerminator, tls_channel_address, tls_stats
+import brpc_tpu as brpc  # noqa: E402
+from brpc_tpu.rpc.h2 import GrpcChannel  # noqa: E402
+from brpc_tpu.rpc.tls_engine import (make_client_context,  # noqa: E402
+                                     make_server_context)
 
 
 class Echo(brpc.Service):
     @brpc.method(request="raw", response="raw")
     def Echo(self, cntl, req):
-        return req
+        return bytes(req)
 
 
 def main():
-    d = tempfile.mkdtemp()
-    cert, key = f"{d}/cert.pem", f"{d}/key.pem"
+    d = tempfile.mkdtemp(prefix="tls-demo-")
+    cert, key = os.path.join(d, "cert.pem"), os.path.join(d, "key.pem")
     subprocess.run(
         ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-keyout", key,
-         "-out", cert, "-days", "1", "-nodes", "-subj", "/CN=localhost",
-         "-addext", "subjectAltName=DNS:localhost"],
+         "-out", cert, "-days", "2", "-nodes", "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
         check=True, capture_output=True)
 
-    server = brpc.Server()
-    server.add_service(Echo())
-    server.start("127.0.0.1", 0)
-    term = TlsTerminator(server, cert, key, address="127.0.0.1")
-    print(f"plaintext backend :{server.port}; TLS front :{term.port}")
+    srv = brpc.Server(brpc.ServerOptions(
+        tls_context=make_server_context(cert, key)))
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    print(f"TLS server on 127.0.0.1:{srv.port} (every protocol encrypted)")
 
-    addr = tls_channel_address("localhost", term.port, cafile=cert)
-    ch = brpc.Channel(addr, timeout_ms=10_000)
-    for i in range(100):
-        assert ch.call_sync("Echo", "Echo", b"x" * 4096) == b"x" * 4096
-    print(f"100 encrypted echoes OK; {tls_stats()}")
-    term.stop()
-    server.stop()
-    server.join()
+    ctx = make_client_context(cafile=cert)
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=10_000,
+                      tls_context=ctx)
+    out = ch.call_sync("Echo", "Echo", b"hello over TLS", serializer="raw")
+    print(f"TRPC over TLS : {bytes(out)!r}")
+
+    g = GrpcChannel(f"127.0.0.1:{srv.port}", tls_context=ctx)
+    print(f"gRPC over TLS : {g.call('Echo', 'Echo', b'h2 says hi')!r}")
+    g.close()
+
+    sctx = ssl.create_default_context(cafile=cert)
+    with urllib.request.urlopen(f"https://127.0.0.1:{srv.port}/health",
+                                context=sctx, timeout=10) as r:
+        print(f"HTTPS console : {r.read().decode().strip()!r}")
+
+    srv.stop()
+    srv.join()
+    print("done.")
 
 
 if __name__ == "__main__":
