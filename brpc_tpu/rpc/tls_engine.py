@@ -112,17 +112,41 @@ class TlsEngine:
             except ssl.SSLWantReadError:
                 break
             except ssl.SSLZeroReturnError:
-                # close_notify: orderly TLS shutdown == connection EOF
-                self._flush_out_locked()
-                core.brpc_socket_set_failed(self.sid, 0)
+                self._orderly_eof_locked()
                 return
             except ssl.SSLError as e:
                 self._fail_locked(f"record layer failed: {e}")
                 return
             if not chunk:
-                break
+                # SSLObject.read returns b"" (rather than raising
+                # ZeroReturn on this CPython) when the peer's
+                # close_notify arrives
+                self._orderly_eof_locked()
+                return
             core.brpc_socket_inject(self.sid, chunk, len(chunk))
         self._flush_out_locked()
+
+    def _orderly_eof_locked(self) -> None:
+        """Peer sent close_notify: answer with ours (a vanilla peer's
+        unwrap() blocks waiting for it), mark the engine closed so any
+        concurrent write_plain returns -1 instead of touching the
+        shut-down SSLObject, and fail the socket after a short grace —
+        an immediate SetFailed would discard queued-but-unwritten bytes
+        (including the answering close_notify) under write
+        backpressure."""
+        self._failed = "closed by peer (close_notify)"
+        try:
+            self._obj.unwrap()
+        except ssl.SSLError:
+            pass
+        self._flush_out_locked()
+        sid = self.sid
+        try:
+            from brpc_tpu.rpc.transport import Transport
+            Transport.instance().schedule(
+                0.05, lambda: core.brpc_socket_set_failed(sid, 0))
+        except Exception:
+            core.brpc_socket_set_failed(sid, 0)
 
     def _flush_out_locked(self) -> int:
         data = self._out.read()

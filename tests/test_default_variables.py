@@ -1,0 +1,50 @@
+"""Process default variables (bvar/default_variables.py; reference
+bvar/default_variables.cpp): every server exposes process health on
+/vars and /brpc_metrics."""
+import urllib.request
+
+import brpc_tpu as brpc
+from brpc_tpu.bvar import dump_exposed
+from brpc_tpu.bvar.default_variables import (_cpu_seconds, _fd_count,
+                                             _rss_bytes, _thread_count,
+                                             expose_default_variables)
+
+
+def test_raw_probes_are_sane():
+    assert _cpu_seconds() > 0.0
+    assert _rss_bytes() > 1 << 20          # a python process is >1MB
+    assert _fd_count() >= 3                 # stdio at minimum
+    assert _thread_count() >= 1
+
+
+def test_exposed_idempotent_and_dumped():
+    expose_default_variables()
+    expose_default_variables()              # second call must not raise
+    data = dump_exposed("process_*")
+    for key in ("process_cpu_seconds", "process_memory_resident_bytes",
+                "process_fd_count", "process_thread_count", "process_pid",
+                "process_uptime_seconds"):
+        assert key in data, f"{key} missing from /vars dump"
+    assert data["process_memory_resident_bytes"] > 1 << 20
+    assert data["process_fd_count"] >= 3
+
+
+def test_server_vars_page_carries_process_health():
+    srv = brpc.Server()
+    srv.start("127.0.0.1", 0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/vars?filter=process_*",
+                timeout=10) as r:
+            body = r.read().decode()
+        assert "process_cpu_usage" in body
+        assert "process_memory_resident_bytes" in body
+        # prometheus rendering too
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/brpc_metrics",
+                timeout=10) as r:
+            metrics = r.read().decode()
+        assert "process_fd_count" in metrics
+    finally:
+        srv.stop()
+        srv.join()

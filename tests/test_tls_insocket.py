@@ -196,3 +196,74 @@ def test_tls_with_load_balancer(certpair):
         for s in servers:
             s.stop()
             s.join()
+
+
+def test_untrusted_cert_fails_loudly(certpair):
+    """Client with an empty trust store must fail the handshake — calls
+    error instead of silently proceeding unverified.  Fresh server: a
+    cached already-verified connection to a shared endpoint would
+    otherwise be reused (endpoint-scoped TLS registry semantics)."""
+    cert, key = certpair
+    from brpc_tpu import errors
+
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return bytes(req)
+
+    srv = brpc.Server(brpc.ServerOptions(
+        tls_context=make_server_context(cert, key)))
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    try:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)  # no CA loaded
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=4000,
+                          max_retry=0, tls_context=ctx)
+        with pytest.raises(errors.RpcError):
+            ch.call_sync("Echo", "Echo", b"x", serializer="raw")
+    finally:
+        srv.stop()
+        srv.join()
+
+
+def test_large_write_queued_before_handshake(tls_server):
+    """write_plain before the handshake finishes must buffer and flush —
+    the first call on a fresh TLS channel carries its payload through
+    the ClientHello window without loss."""
+    srv, cert = tls_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=15_000,
+                      tls_context=make_client_context(cafile=cert))
+    p = b"\x5a" * 1_000_000   # 1MB on the very first call (cold engine)
+    got = ch.call_sync("Echo", "Echo", p, serializer="raw")
+    assert bytes(got) == p
+
+
+def test_tls_close_notify_is_clean_eof(certpair):
+    """A vanilla client that completes the handshake and sends
+    close_notify tears the connection down cleanly (no stuck engine, no
+    error spew; server keeps serving others)."""
+    cert, key = certpair
+
+    class Echo(brpc.Service):
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return bytes(req)
+
+    srv = brpc.Server(brpc.ServerOptions(
+        tls_context=make_server_context(cert, key)))
+    srv.add_service(Echo())
+    srv.start("127.0.0.1", 0)
+    try:
+        ctx = make_client_context(cafile=cert)
+        raw = socket.create_connection(("127.0.0.1", srv.port), timeout=10)
+        s = ctx.wrap_socket(raw, server_hostname="127.0.0.1")
+        s.unwrap()   # TLS close_notify
+        s.close()
+        # server must still answer new TLS connections afterwards
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=10_000,
+                          tls_context=ctx)
+        assert bytes(ch.call_sync("Echo", "Echo", b"after-eof",
+                                  serializer="raw")) == b"after-eof"
+    finally:
+        srv.stop()
+        srv.join()
