@@ -194,12 +194,13 @@ def bench_grpc_echo(total=8000, inflight=32, payload_len=128,
     reference benchmarks gRPC as a native protocol
     (src/brpc/policy/http2_rpc_protocol.cpp); ours is a Python h2 data
     plane over the native socket layer.  Stated target (VERDICT r4 #5):
-    >= 4k unary qps pipelined on the 1-core box (median of 3), ~350x
+    >= 7k unary qps pipelined on the 1-core box (median of 3), ~170x
     below the native TRPC path by design — full native h2 framing is
     future work; the rung exists so the gap is MEASURED, not assumed.
-    (r5 lifted the floor ~2.5x: joined HEADERS+DATA+trailers writes,
-    coalesced WINDOW_UPDATEs, HPACK repeated-block cache, single-copy
-    IOBuf->bytes.)"""
+    (r5 lifted the floor ~4.5x: native frame COALESCING — consecutive h2
+    frames ride one FIFO delivery/GIL cycle — joined
+    HEADERS+DATA+trailers writes, coalesced WINDOW_UPDATEs, HPACK
+    repeated-block cache, single-copy IOBuf->bytes.)"""
     import time as _t
     from collections import deque
 

@@ -3,10 +3,12 @@ client and server dispatch on the shared port.
 
 Reference: policy/http2_rpc_protocol.cpp (1,840 LoC), details/hpack.cpp
 (→ brpc_tpu/rpc/hpack.py), grpc.cpp (status mapping).  The native core
-frames one complete h2 frame per message (MSG_H2, src/cc/net/parser.cc:
-parse_h2 — 9-byte header in meta, payload in body) and auto-detects the
-client preface on the shared port, so any real gRPC client that connects
-to an rpc Server's port lands here.
+delivers complete h2 frames as MSG_H2 — possibly SEVERAL frames
+COALESCED per delivery (meta = the 9-byte headers concatenated, body =
+payloads in order; consumers must walk them via feed_frames, never pass
+the delivery straight to on_frame) — and auto-detects the client preface
+on the shared port, so any real gRPC client that connects to an rpc
+Server's port lands here.
 
 Scope: full connection management (SETTINGS/PING/GOAWAY/RST_STREAM/
 WINDOW_UPDATE, HEADERS+CONTINUATION assembly, PADDED/PRIORITY flags) and
@@ -110,6 +112,25 @@ def build_frame(ftype: int, flags: int, stream_id: int, payload: bytes) -> bytes
     hdr = bytes([(n >> 16) & 0xFF, (n >> 8) & 0xFF, n & 0xFF, ftype, flags]) \
         + struct.pack(">I", stream_id & 0x7FFFFFFF)
     return hdr + payload
+
+def feed_frames(conn, meta: bytes, body: bytes) -> None:
+    """Deliver one or MORE h2 frames to `conn.on_frame`.  The native
+    drain coalesces consecutive frames into one FIFO task (meta = the
+    9-byte headers concatenated, body = payloads in order; the payload
+    length is the first 3 bytes of each header)."""
+    if len(meta) == 9:
+        conn.on_frame(meta, body)
+        return
+    mp = 0
+    bp = 0
+    n = len(meta)
+    while mp + 9 <= n:
+        hdr9 = meta[mp:mp + 9]
+        ln = (hdr9[0] << 16) | (hdr9[1] << 8) | hdr9[2]
+        conn.on_frame(hdr9, body[bp:bp + ln])
+        mp += 9
+        bp += ln
+
 
 
 # ---- per-message compression (grpc.cpp grpc-encoding negotiation) ----
@@ -1502,7 +1523,7 @@ class _GrpcClientConnection(H2Connection):
         if self.sid is None:
             self.sid = sid  # connect() hasn't returned yet
         if kind == MSG_H2:
-            self.on_frame(meta, body.to_bytes())
+            feed_frames(self, meta, body.to_bytes())
 
     def _on_failed(self, sid: int, err: int) -> None:
         with self._calls_lock:

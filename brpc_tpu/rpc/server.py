@@ -572,9 +572,11 @@ class Server:
         if kind == MSG_H2:
             conn = self._h2_conns.get(sid)
             if conn is None:
-                from brpc_tpu.rpc.h2 import GrpcServerConnection
+                from brpc_tpu.rpc.h2 import GrpcServerConnection, \
+                    feed_frames
+                self._h2_feed = feed_frames   # hot path: no per-msg import
                 conn = self._h2_conns[sid] = GrpcServerConnection(sid, self)
-            conn.on_frame(meta_bytes, body.to_bytes())
+            self._h2_feed(conn, meta_bytes, body.to_bytes())
             return
         if kind == MSG_REDIS:
             svc = self.options.redis_service

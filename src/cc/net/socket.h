@@ -184,6 +184,7 @@ class Socket {
 
  private:
   friend class EventDispatcher;
+  friend struct H2Accum;   // frame-coalescing helper in socket.cc
 
   void DoAcceptLoop();
   void DeliverFiltered(butil::IOPortal* cipher);

@@ -55,9 +55,7 @@ def test_sharded_step_places_arrays_on_mesh(cfg):
     tokens, targets = make_example_batch(cfg, key=jax.random.PRNGKey(1))
     p_sh = param_shardings(mesh)
     d_sh = data_shardings(mesh)
-    params = jax.tree.map(
-        lambda a, s: jax.device_put(a, s), params,
-        jax.tree.map(lambda s: s, p_sh))
+    params = jax.tree.map(lambda a, s: jax.device_put(a, s), params, p_sh)
     tokens = jax.device_put(tokens, d_sh["tokens"]) \
         if isinstance(d_sh, dict) else tokens
     out_params, loss = step(params, tokens, targets)
