@@ -87,6 +87,15 @@ RESPONSE_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64,
 NATIVE_METHOD_FN = ctypes.CFUNCTYPE(ctypes.c_int32, ctypes.c_uint64,
                                     ctypes.c_void_p, ctypes.c_void_p,
                                     ctypes.c_void_p)
+# Native h2 session event (src/cc/net/h2.h H2EventCallback): sid,
+# stream_id, kind, service/len, method/len, headers/len ("k\0v\0" pairs),
+# body IOBuf* (owned by callee; may be NULL), grpc message flags, user.
+H2_EVENT_CB = ctypes.CFUNCTYPE(None, ctypes.c_uint64, ctypes.c_uint32,
+                               ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_size_t, ctypes.c_void_p,
+                               ctypes.c_size_t, ctypes.c_void_p,
+                               ctypes.c_size_t, ctypes.c_void_p,
+                               ctypes.c_int, ctypes.c_void_p)
 
 _sigs = {
     "brpc_core_init": (None, [ctypes.c_int, ctypes.c_int]),
@@ -220,6 +229,48 @@ _sigs = {
                                         MESSAGE_CB, FAILED_CB, RESPONSE_CB,
                                         ctypes.c_void_p,
                                         ctypes.POINTER(ctypes.c_uint64)]),
+    # native h2/gRPC server data plane (src/cc/net/h2.h)
+    "brpc_listen_rpc_h2": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_int,
+                                          MESSAGE_CB, FAILED_CB, ACCEPTED_CB,
+                                          ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_uint64),
+                                          ctypes.POINTER(ctypes.c_int)]),
+    "brpc_h2_set_event_cb": (None, [H2_EVENT_CB, ctypes.c_void_p]),
+    "brpc_h2_respond_unary": (ctypes.c_int, [ctypes.c_uint64,
+                                             ctypes.c_uint32, ctypes.c_int,
+                                             ctypes.c_char_p,
+                                             ctypes.c_size_t,
+                                             ctypes.c_char_p,
+                                             ctypes.c_size_t,
+                                             ctypes.c_char_p,
+                                             ctypes.c_size_t]),
+    "brpc_h2_send_response_headers": (ctypes.c_int, [ctypes.c_uint64,
+                                                     ctypes.c_uint32,
+                                                     ctypes.c_char_p,
+                                                     ctypes.c_size_t]),
+    "brpc_h2_send_message": (ctypes.c_int, [ctypes.c_uint64,
+                                            ctypes.c_uint32,
+                                            ctypes.c_char_p, ctypes.c_size_t,
+                                            ctypes.c_int]),
+    "brpc_h2_send_trailers": (ctypes.c_int, [ctypes.c_uint64,
+                                             ctypes.c_uint32, ctypes.c_int,
+                                             ctypes.c_char_p,
+                                             ctypes.c_size_t,
+                                             ctypes.c_char_p,
+                                             ctypes.c_size_t]),
+    "brpc_h2_native_stats": (None, [ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_int64)]),
+    # gRPC unary pump against an existing server's NATIVE h2 plane
+    "brpc_bench_register_native_echo": (None, [ctypes.c_char_p,
+                                               ctypes.c_char_p,
+                                               ctypes.c_int]),
+    "brpc_bench_pump_h2": (ctypes.c_int, [ctypes.c_int, ctypes.c_char_p,
+                                          ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_uint64, ctypes.c_int,
+                                          ctypes.POINTER(ctypes.c_double),
+                                          ctypes.POINTER(ctypes.c_double),
+                                          ctypes.POINTER(ctypes.c_double)]),
     "brpc_bench_echo": (ctypes.c_int, [ctypes.c_int, ctypes.c_int,
                                        ctypes.c_uint64, ctypes.c_int,
                                        ctypes.c_int,

@@ -190,6 +190,15 @@ class ServerOptions:
     # not combine with usercode_latency_budget_ms (its native-packed
     # ELIMIT shed would bypass the TLS engine).
     tls_context: Optional[Any] = None
+    # NATIVE h2/gRPC data plane (src/cc/net/h2.cc + rpc/h2_native.py,
+    # mirroring the reference's native http2_rpc_protocol.cpp): h2
+    # framing, HPACK, flow control and gRPC framing run in C++; Python
+    # is upcalled once per message.  Off → the pure-Python plane
+    # (rpc/h2.py GrpcServerConnection) serves h2 on the port instead.
+    # Forced off under in-socket TLS: the TLS engine re-injects
+    # plaintext through the generic parser path on the LISTENER's
+    # options, and the native session would bypass the record layer.
+    h2_native: bool = True
 
 
 class MethodStatus:
@@ -435,9 +444,19 @@ class Server:
             if DCN_SERVICE not in self._services:
                 self.add_service(DcnService())
         t = Transport.instance()
-        self._listen_sid, self._port = t.listen_rpc(
-            addr, port, self._on_message, self._on_conn_failed,
-            on_request=self._on_fast_request)
+        use_native_h2 = (self.options.h2_native
+                         and self.options.tls_context is None)
+        if use_native_h2:
+            from brpc_tpu.rpc.h2_native import NativeH2Bridge
+            self._h2_bridge = NativeH2Bridge(self)
+            self._listen_sid, self._port = t.listen_rpc_h2(
+                addr, port, self._on_message, self._h2_bridge,
+                on_failed=self._on_conn_failed,
+                on_request=self._on_fast_request)
+        else:
+            self._listen_sid, self._port = t.listen_rpc(
+                addr, port, self._on_message, self._on_conn_failed,
+                on_request=self._on_fast_request)
         if self.options.tls_context is not None:
             if self.options.usercode_latency_budget_ms > 0:
                 # the native ELIMIT shed packs and writes PLAINTEXT

@@ -12,7 +12,8 @@ import ctypes
 import threading
 from typing import Callable, Optional
 
-from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, IOBuf, MESSAGE_CB,
+from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, H2_EVENT_CB, IOBuf,
+                            MESSAGE_CB,
                             MSG_FILTERED, MSG_H2, MSG_HTTP, MSG_MEMCACHE,
                             MSG_MONGO, MSG_NSHEAD, MSG_RAW, MSG_REDIS,
                             MSG_THRIFT, MSG_TRPC, REQUEST_CB, RESPONSE_CB,
@@ -40,7 +41,11 @@ class Transport:
         # sid -> fast-path handlers (natively pre-parsed metas)
         self._request_handlers: dict[int, Callable] = {}
         self._response_handlers: dict[int, Callable] = {}
+        # sid -> NativeH2Bridge (listener entries inherited by accepted
+        # connections, exactly like _handlers)
+        self._h2_bridges: dict[int, object] = {}
         self._request_cb_installed = False
+        self._h2_cb_installed = False
         self._timer_lock = threading.Lock()
         self._timer_cbs: dict[int, Callable[[], None]] = {}
         self._timer_token = 1
@@ -75,8 +80,15 @@ class Transport:
                 h = self._handlers.pop(sid, None)
                 self._request_handlers.pop(sid, None)
                 self._response_handlers.pop(sid, None)
+                bridge = self._h2_bridges.pop(sid, None)
                 self._tls.pop(sid, None)
                 self._tls_listener_ctx.pop(sid, None)
+            if bridge is not None:
+                try:
+                    bridge.on_connection_failed(sid)
+                except Exception:  # pragma: no cover
+                    import traceback
+                    traceback.print_exc()
             if h is not None and h[1] is not None:
                 try:
                     h[1](sid, err)
@@ -95,6 +107,10 @@ class Transport:
             if rh is not None:
                 with self._lock:
                     self._request_handlers[conn] = rh
+            br = self._h2_bridges.get(listener)
+            if br is not None:
+                with self._lock:
+                    self._h2_bridges[conn] = br
             ctx = self._tls_listener_ctx.get(listener)
             if ctx is not None:
                 # TLS listener: wrap the accepted connection BEFORE any
@@ -206,6 +222,63 @@ class Transport:
             if on_request is not None:
                 self._request_handlers[sid.value] = on_request
         return sid.value, bound.value
+
+    def listen_rpc_h2(self, addr: str, port: int, on_message, bridge,
+                      on_failed=None, on_request=None) -> tuple[int, int]:
+        """listen_rpc + the NATIVE h2/gRPC data plane: accepted
+        connections run framing/HPACK/flow control in C++ (net/h2.cc)
+        and surface per-message events to `bridge`
+        (rpc/h2_native.NativeH2Bridge)."""
+        if on_request is not None and not self._request_cb_installed:
+            _fastrpc.set_request_handler(self._cb_request)
+            self._request_cb_installed = True
+        self._ensure_h2_event_cb()
+        sid = ctypes.c_uint64()
+        bound = ctypes.c_int()
+        rc = core.brpc_listen_rpc_h2(addr.encode(), port, self._cb_message,
+                                     self._cb_failed, self._cb_accepted,
+                                     None, ctypes.byref(sid),
+                                     ctypes.byref(bound))
+        if rc != 0:
+            raise OSError(f"listen on {addr}:{port} failed")
+        with self._lock:
+            self._handlers[sid.value] = (on_message, on_failed)
+            self._h2_bridges[sid.value] = bridge
+            if on_request is not None:
+                self._request_handlers[sid.value] = on_request
+        return sid.value, bound.value
+
+    def _ensure_h2_event_cb(self) -> None:
+        if self._h2_cb_installed:
+            return
+        self._h2_cb_installed = True
+
+        @H2_EVENT_CB
+        def _on_h2_event(sid, stream_id, kind, service, service_len,
+                         method, method_len, headers, headers_len,
+                         body_iobuf, mflags, user):
+            svc = ctypes.string_at(service, service_len).decode(
+                "utf-8", "replace") if service_len else ""
+            meth = ctypes.string_at(method, method_len).decode(
+                "utf-8", "replace") if method_len else ""
+            hdrs = ctypes.string_at(headers, headers_len) if headers_len \
+                else b""
+            body = None
+            if body_iobuf:
+                buf = IOBuf(handle=body_iobuf)  # owns; freed at GC
+                body = buf.to_bytes()
+            bridge = self._h2_bridges.get(sid)
+            if bridge is None:
+                return
+            try:
+                bridge.on_event(sid, stream_id, kind, svc, meth, hdrs,
+                                body, mflags)
+            except Exception:  # pragma: no cover - bridge bug guard
+                import traceback
+                traceback.print_exc()
+
+        self._cb_h2_event = _on_h2_event      # pin for process lifetime
+        core.brpc_h2_set_event_cb(_on_h2_event, None)
 
     def connect_rpc(self, host: str, port: int, on_message, on_failed=None,
                     on_response=None) -> int:

@@ -16,6 +16,7 @@
 #include "net/event_dispatcher.h"
 #include "net/parser.h"
 #include "net/fd_wait.h"
+#include "net/h2.h"
 #include "net/socket.h"
 
 using butil::IOBuf;
@@ -517,6 +518,105 @@ int brpc_connect_rpc(const char* host, int port, brpc_message_cb on_msg,
   o.on_response = (brpc::ResponseCallback)on_resp;
   o.response_user = user;
   return brpc::Connect(host, port, o, sid_out);
+}
+
+// ---- native h2/gRPC server data plane (net/h2.h) ----
+
+// Listen with BOTH the native TRPC dispatch and the native h2 session
+// enabled on accepted connections.
+int brpc_listen_rpc_h2(const char* addr, int port, brpc_message_cb on_msg,
+                       brpc_failed_cb on_fail, brpc_accepted_cb on_accept,
+                       void* user, uint64_t* sid_out, int* bound_port) {
+  brpc::SocketOptions o = make_opts(on_msg, on_fail, on_accept, user, 0);
+  o.enable_rpc_dispatch = true;
+  o.h2_native = true;
+  return brpc::Listen(addr, port, o, sid_out, bound_port);
+}
+
+// body_iobuf is an owned IOBuf* handle (free with brpc_iobuf_free after
+// reading) or NULL.  mflags: gRPC message flag byte; kind: h2.h EventKind.
+typedef void (*brpc_h2_event_cb)(uint64_t sid, uint32_t stream_id, int kind,
+                                 const char* service, size_t service_len,
+                                 const char* method, size_t method_len,
+                                 const char* headers, size_t headers_len,
+                                 void* body_iobuf, int mflags, void* user);
+
+void brpc_h2_set_event_cb(brpc_h2_event_cb cb, void* user) {
+  brpc::h2::SetH2EventCallback((brpc::h2::H2EventCallback)cb, user);
+}
+
+namespace {
+// "name\0value\0" pairs -> pointer array (the buffer's own NULs make
+// each piece a C string).  Returns the pair count.
+size_t split_kv(const char* extra, size_t extra_len,
+                std::vector<const char*>* out) {
+  size_t off = 0;
+  while (off < extra_len) {
+    const char* k = extra + off;
+    const size_t klen = strnlen(k, extra_len - off);
+    if (off + klen >= extra_len) break;  // key's NUL not in range
+    off += klen + 1;
+    const char* v = extra + off;
+    const size_t vlen = strnlen(v, extra_len - off);
+    if (off + vlen >= extra_len) break;  // value's NUL not in range:
+                                         // downstream strlen would read
+                                         // past the caller's buffer
+    out->push_back(k);
+    out->push_back(v);
+    off += vlen + 1;
+  }
+  return out->size() / 2;
+}
+}  // namespace
+
+int brpc_h2_respond_unary(uint64_t sid, uint32_t stream_id, int grpc_status,
+                          const char* grpc_message, size_t grpc_message_len,
+                          const char* payload, size_t payload_len,
+                          const char* extra, size_t extra_len) {
+  std::vector<const char*> kv;
+  const size_t n = extra != nullptr ? split_kv(extra, extra_len, &kv) : 0;
+  return brpc::h2::H2RespondUnary(sid, stream_id, grpc_status, grpc_message,
+                                  grpc_message_len, payload, payload_len,
+                                  n ? kv.data() : nullptr, n)
+             ? 0
+             : -1;
+}
+
+int brpc_h2_send_response_headers(uint64_t sid, uint32_t stream_id,
+                                  const char* extra, size_t extra_len) {
+  std::vector<const char*> kv;
+  const size_t n = extra != nullptr ? split_kv(extra, extra_len, &kv) : 0;
+  return brpc::h2::H2SendResponseHeaders(sid, stream_id,
+                                         n ? kv.data() : nullptr, n)
+             ? 0
+             : -1;
+}
+
+int brpc_h2_send_message(uint64_t sid, uint32_t stream_id,
+                         const char* payload, size_t len, int mflags) {
+  return brpc::h2::H2SendGrpcMessage(sid, stream_id, payload, len,
+                                     (uint8_t)mflags)
+             ? 0
+             : -1;
+}
+
+int brpc_h2_send_trailers(uint64_t sid, uint32_t stream_id, int grpc_status,
+                          const char* grpc_message, size_t grpc_message_len,
+                          const char* extra, size_t extra_len) {
+  std::vector<const char*> kv;
+  const size_t n = extra != nullptr ? split_kv(extra, extra_len, &kv) : 0;
+  return brpc::h2::H2SendTrailers(sid, stream_id, grpc_status, grpc_message,
+                                  grpc_message_len,
+                                  n ? kv.data() : nullptr, n)
+             ? 0
+             : -1;
+}
+
+void brpc_h2_native_stats(int64_t* requests, int64_t* responses,
+                          int64_t* python_events) {
+  if (requests != nullptr) *requests = brpc::h2::h2_native_requests();
+  if (responses != nullptr) *responses = brpc::h2::h2_native_responses();
+  if (python_events != nullptr) *python_events = brpc::h2::h2_python_events();
 }
 
 }  // extern "C"

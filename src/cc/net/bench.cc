@@ -259,3 +259,311 @@ int brpc_bench_pump(int port, const char* service, const char* method,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Native h2/gRPC client pump: measures the native h2 SERVER data plane
+// (net/h2.cc) the way run_pump measures the TRPC path — a C++ client
+// with `inflight` open streams per connection, canned stateless-HPACK
+// request header blocks, completions counted at END_STREAM trailers.
+// ---------------------------------------------------------------------------
+
+#include <deque>
+
+#include "net/h2.h"
+
+namespace brpc {
+namespace {
+
+struct H2PumpShared {
+  std::atomic<uint64_t> sent{0};
+  std::atomic<uint64_t> done{0};
+  std::atomic<uint64_t> lat_idx{0};
+  uint64_t total = 0;
+  int payload_len = 0;
+  std::string header_block;  // canned request HEADERS block
+  std::vector<uint32_t> lat_us;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool finished = false;
+};
+
+struct H2PumpConn {
+  H2PumpShared* st = nullptr;
+  SocketId sid = INVALID_SOCKET_ID;
+  std::mutex mu;                  // guards next_stream + t_send
+  uint32_t next_stream = 1;
+  std::deque<uint64_t> t_send;    // echo servers respond in order
+  int64_t unacked_data = 0;       // server DATA bytes since last topup
+};
+
+void h2_pump_send_one(H2PumpConn* c) {
+  H2PumpShared* st = c->st;
+  char prefix[5];
+  prefix[0] = 0;
+  prefix[1] = (char)(st->payload_len >> 24);
+  prefix[2] = (char)(st->payload_len >> 16);
+  prefix[3] = (char)(st->payload_len >> 8);
+  prefix[4] = (char)st->payload_len;
+  static const char kPayload[4096] = {0};
+  uint32_t stream_id;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    stream_id = c->next_stream;
+    c->next_stream += 2;
+    c->t_send.push_back((uint64_t)butil::cpuwide_time_us());
+  }
+  butil::IOBuf out;
+  char hdr[9];
+  // HEADERS (END_HEADERS)
+  hdr[0] = (char)(st->header_block.size() >> 16);
+  hdr[1] = (char)(st->header_block.size() >> 8);
+  hdr[2] = (char)st->header_block.size();
+  hdr[3] = 0x1;
+  hdr[4] = 0x4;
+  hdr[5] = (char)(stream_id >> 24);
+  hdr[6] = (char)(stream_id >> 16);
+  hdr[7] = (char)(stream_id >> 8);
+  hdr[8] = (char)stream_id;
+  out.append(hdr, 9);
+  out.append(st->header_block.data(), st->header_block.size());
+  // DATA (END_STREAM): 5-byte gRPC prefix + payload
+  const uint32_t dlen = (uint32_t)st->payload_len + 5;
+  hdr[0] = (char)(dlen >> 16);
+  hdr[1] = (char)(dlen >> 8);
+  hdr[2] = (char)dlen;
+  hdr[3] = 0x0;
+  hdr[4] = 0x1;
+  out.append(hdr, 9);
+  out.append(prefix, 5);
+  if (st->payload_len > 0) out.append(kPayload, st->payload_len);
+  Socket* s = Socket::Address(c->sid);
+  if (s != nullptr) {
+    s->Write(std::move(out));
+    s->Dereference();
+  }
+}
+
+// MSG_H2 delivery on the client socket: meta = concatenated 9-byte frame
+// headers (H2Accum), body = payloads.  Completions are END_STREAM
+// HEADERS (trailers); sends are pipelined from here.
+void h2_pump_on_message(SocketId sid, int kind, const char* meta,
+                        size_t meta_len, butil::IOBuf* body, void* user) {
+  auto* c = (H2PumpConn*)user;
+  H2PumpShared* st = c->st;
+  size_t boff = 0;
+  int completions = 0;
+  int64_t data_bytes = 0;
+  for (size_t off = 0; off + 9 <= meta_len; off += 9) {
+    const uint8_t* h = (const uint8_t*)meta + off;
+    const uint32_t len =
+        ((uint32_t)h[0] << 16) | ((uint32_t)h[1] << 8) | h[2];
+    const uint8_t type = h[3];
+    const uint8_t flags = h[4];
+    boff += len;
+    if (type == 0x0) data_bytes += len;                  // DATA
+    if (type == 0x1 && (flags & 0x1)) ++completions;     // trailers
+  }
+  (void)boff;
+  delete body;
+  if (data_bytes > 0) {
+    // top up the connection recv window every 16MB so long runs don't
+    // stall the server's sender
+    bool topup = false;
+    int64_t n = 0;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      c->unacked_data += data_bytes;
+      if (c->unacked_data >= (16 << 20)) {
+        n = c->unacked_data;
+        c->unacked_data = 0;
+        topup = true;
+      }
+    }
+    if (topup) {
+      butil::IOBuf wu;
+      char f[13];
+      f[0] = 0;
+      f[1] = 0;
+      f[2] = 4;
+      f[3] = 0x8;
+      f[4] = 0;
+      f[5] = f[6] = f[7] = f[8] = 0;  // stream 0
+      f[9] = (char)(n >> 24);
+      f[10] = (char)(n >> 16);
+      f[11] = (char)(n >> 8);
+      f[12] = (char)n;
+      wu.append(f, 13);
+      Socket* s = Socket::Address(sid);
+      if (s != nullptr) {
+        s->Write(std::move(wu));
+        s->Dereference();
+      }
+    }
+  }
+  for (int i = 0; i < completions; ++i) {
+    uint64_t t0 = 0;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      if (!c->t_send.empty()) {
+        t0 = c->t_send.front();
+        c->t_send.pop_front();
+      }
+    }
+    if (t0 != 0) {
+      const uint64_t now = (uint64_t)butil::cpuwide_time_us();
+      const uint64_t idx =
+          st->lat_idx.fetch_add(1, std::memory_order_relaxed);
+      if (idx < st->lat_us.size())
+        st->lat_us[idx] =
+            (uint32_t)std::min<uint64_t>(now - t0, 0xffffffff);
+    }
+    if (st->sent.fetch_add(1, std::memory_order_relaxed) < st->total) {
+      h2_pump_send_one(c);
+    }
+    const uint64_t d = st->done.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (d >= st->total) {
+      std::lock_guard<std::mutex> lk(st->mu);
+      st->finished = true;
+      st->cv.notify_all();
+    }
+  }
+}
+
+}  // namespace
+}  // namespace brpc
+
+extern "C" {
+
+// Register a C++ echo handler under (service, method) so the h2 pump
+// can measure the PURE-NATIVE gRPC path (session dispatch -> native
+// handler -> native response pack; Python never runs).
+static int32_t h2_bench_native_echo(brpc::SocketId, butil::IOBuf* body,
+                                    butil::IOBuf* resp_body, void*) {
+  resp_body->append(std::move(*body));
+  return 0;
+}
+
+void brpc_bench_register_native_echo(const char* service, const char* method,
+                                     int inline_run) {
+  brpc::MethodRegistry::global()->Register(service, method,
+                                           h2_bench_native_echo, nullptr,
+                                           inline_run != 0);
+}
+
+// gRPC unary pump against an existing server's native h2 plane.
+// path = "/Service/Method".  Returns 0 on success.
+int brpc_bench_pump_h2(int port, const char* path, int conns, int inflight,
+                       uint64_t total, int payload_len, double* qps_out,
+                       double* p50_us, double* p99_us) {
+  using namespace brpc;
+  if (port <= 0 || path == nullptr || path[0] != '/' || conns <= 0 ||
+      inflight <= 0 || total == 0 || payload_len < 0 || payload_len > 4096) {
+    return -1;
+  }
+  auto* stp = new H2PumpShared;  // leaked on timeout (in-flight callbacks)
+  H2PumpShared& st = *stp;
+  st.total = total;
+  st.payload_len = payload_len;
+  st.lat_us.assign(std::min<uint64_t>(total, 2'000'000), 0);
+  // canned request block: stateless encoder, identical for every request
+  h2::EncodeHeader(&st.header_block, ":method", 7, "POST", 4);
+  h2::EncodeHeader(&st.header_block, ":scheme", 7, "http", 4);
+  h2::EncodeHeader(&st.header_block, ":path", 5, path, strlen(path));
+  h2::EncodeHeader(&st.header_block, ":authority", 10, "bench", 5);
+  h2::EncodeHeader(&st.header_block, "content-type", 12,
+                   "application/grpc", 16);
+  h2::EncodeHeader(&st.header_block, "te", 2, "trailers", 8);
+
+  std::vector<H2PumpConn*> cs;
+  for (int i = 0; i < conns; ++i) {
+    auto* c = new H2PumpConn;
+    c->st = &st;
+    SocketOptions copts;
+    copts.on_message = h2_pump_on_message;
+    copts.on_failed = bench_noop_failed;
+    copts.user = c;
+    SocketId cid = INVALID_SOCKET_ID;
+    if (Connect("127.0.0.1", port, copts, &cid) != 0) {
+      for (auto* cc : cs) Socket::SetFailed(cc->sid, 0);
+      return -3;
+    }
+    c->sid = cid;
+    Socket* s = Socket::Address(cid);
+    if (s != nullptr) {
+      s->set_forced_protocol(MSG_H2);
+      // preface + SETTINGS(max initial window) + conn WINDOW_UPDATE
+      butil::IOBuf first;
+      first.append("PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n", 24);
+      char sf[9 + 6];
+      sf[0] = 0;
+      sf[1] = 0;
+      sf[2] = 6;
+      sf[3] = 0x4;
+      sf[4] = 0;
+      sf[5] = sf[6] = sf[7] = sf[8] = 0;
+      sf[9] = 0;
+      sf[10] = 0x4;  // INITIAL_WINDOW_SIZE
+      sf[11] = 0x7f;
+      sf[12] = (char)0xff;
+      sf[13] = (char)0xff;
+      sf[14] = (char)0xff;
+      first.append(sf, sizeof(sf));
+      char wu[13];
+      wu[0] = 0;
+      wu[1] = 0;
+      wu[2] = 4;
+      wu[3] = 0x8;
+      wu[4] = 0;
+      wu[5] = wu[6] = wu[7] = wu[8] = 0;
+      const uint32_t inc = 0x7fffffffu - 65535u;
+      wu[9] = (char)(inc >> 24);
+      wu[10] = (char)(inc >> 16);
+      wu[11] = (char)(inc >> 8);
+      wu[12] = (char)inc;
+      first.append(wu, 13);
+      s->Write(std::move(first));
+      s->Dereference();
+    }
+    cs.push_back(c);
+  }
+
+  const int64_t t0 = butil::monotonic_time_us();
+  const uint64_t seed_target =
+      std::min<uint64_t>((uint64_t)conns * (uint64_t)inflight, total);
+  for (uint64_t i = 0; i < seed_target; ++i) {
+    if (st.sent.fetch_add(1, std::memory_order_relaxed) < total) {
+      h2_pump_send_one(cs[i % cs.size()]);
+    }
+  }
+  bool completed_in_time;
+  {
+    std::unique_lock<std::mutex> lk(st.mu);
+    completed_in_time = st.cv.wait_for(lk, std::chrono::seconds(120),
+                                       [&] { return st.finished; });
+  }
+  const int64_t t1 = butil::monotonic_time_us();
+  for (auto* c : cs) Socket::SetFailed(c->sid, 0);
+
+  const uint64_t completed = st.done.load();
+  const double wall_s = (t1 - t0) / 1e6;
+  if (qps_out) *qps_out = completed / (wall_s > 0 ? wall_s : 1e-9);
+  const uint64_t n = std::min<uint64_t>(st.lat_idx.load(), st.lat_us.size());
+  if (n > 0) {
+    std::vector<uint32_t> lats(st.lat_us.begin(), st.lat_us.begin() + n);
+    std::sort(lats.begin(), lats.end());
+    if (p50_us) *p50_us = lats[n / 2];
+    if (p99_us) *p99_us = lats[(size_t)(n * 0.99)];
+  } else {
+    if (p50_us) *p50_us = 0;
+    if (p99_us) *p99_us = 0;
+  }
+  if (!completed_in_time) return -4;  // st leaked deliberately
+  // conn structs may still be referenced by in-flight FIFO callbacks for
+  // a beat after SetFailed; the failure notification rides the same lane
+  // as deliveries, so once it runs the lane is drained — small leak on
+  // timeout, clean delete otherwise is still unsafe; leak both (bench
+  // process scope).
+  return 0;
+}
+
+}  // extern "C"

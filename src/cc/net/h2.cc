@@ -1,0 +1,1062 @@
+// Native HTTP/2 + gRPC server data plane (see h2.h).
+#include "net/h2.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+
+#include "bthread/executor.h"
+#include "butil/common.h"
+#include "net/rpc.h"
+#include "net/socket.h"
+
+namespace brpc {
+namespace h2 {
+
+namespace {
+
+// frame types (RFC 7540 §6)
+constexpr uint8_t FT_DATA = 0x0;
+constexpr uint8_t FT_HEADERS = 0x1;
+constexpr uint8_t FT_PRIORITY = 0x2;
+constexpr uint8_t FT_RST_STREAM = 0x3;
+constexpr uint8_t FT_SETTINGS = 0x4;
+constexpr uint8_t FT_PUSH_PROMISE = 0x5;
+constexpr uint8_t FT_PING = 0x6;
+constexpr uint8_t FT_GOAWAY = 0x7;
+constexpr uint8_t FT_WINDOW_UPDATE = 0x8;
+constexpr uint8_t FT_CONTINUATION = 0x9;
+
+// flags
+constexpr uint8_t FLAG_END_STREAM = 0x1;  // DATA / HEADERS
+constexpr uint8_t FLAG_ACK = 0x1;         // SETTINGS / PING
+constexpr uint8_t FLAG_END_HEADERS = 0x4;
+constexpr uint8_t FLAG_PADDED = 0x8;
+constexpr uint8_t FLAG_PRIORITY = 0x20;
+
+// error codes (RFC 7540 §7)
+constexpr uint32_t EC_PROTOCOL_ERROR = 0x1;
+constexpr uint32_t EC_REFUSED_STREAM = 0x7;
+
+// settings ids
+constexpr uint16_t SET_MAX_CONCURRENT_STREAMS = 0x3;
+constexpr uint16_t SET_INITIAL_WINDOW_SIZE = 0x4;
+constexpr uint16_t SET_MAX_FRAME_SIZE = 0x5;
+
+std::atomic<H2EventCallback> g_event_cb{nullptr};
+std::atomic<void*> g_event_user{nullptr};
+std::atomic<int64_t> g_native_requests{0};
+std::atomic<int64_t> g_native_responses{0};
+std::atomic<int64_t> g_python_events{0};
+
+inline uint32_t rd32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | p[3];
+}
+
+inline void put_frame_header(char* b, uint32_t len, uint8_t type,
+                             uint8_t flags, uint32_t stream_id) {
+  b[0] = (char)(len >> 16);
+  b[1] = (char)(len >> 8);
+  b[2] = (char)len;
+  b[3] = (char)type;
+  b[4] = (char)flags;
+  b[5] = (char)(stream_id >> 24);
+  b[6] = (char)(stream_id >> 16);
+  b[7] = (char)(stream_id >> 8);
+  b[8] = (char)stream_id;
+}
+
+void append_frame(butil::IOBuf* out, uint8_t type, uint8_t flags,
+                  uint32_t stream_id, const void* payload, size_t len) {
+  char hdr[9];
+  put_frame_header(hdr, (uint32_t)len, type, flags, stream_id);
+  out->append(hdr, 9);
+  if (len > 0) out->append(payload, len);
+}
+
+void append_window_update(butil::IOBuf* out, uint32_t stream_id,
+                          uint32_t increment) {
+  char p[4] = {(char)(increment >> 24), (char)(increment >> 16),
+               (char)(increment >> 8), (char)increment};
+  append_frame(out, FT_WINDOW_UPDATE, 0, stream_id, p, 4);
+}
+
+// The unary hot path's header blocks are CONSTANT — encode them once.
+const std::string& ok_response_headers_block() {
+  static const std::string block = [] {
+    std::string b;
+    EncodeHeader(&b, ":status", 7, "200", 3);
+    EncodeHeader(&b, "content-type", 12, "application/grpc", 16);
+    return b;
+  }();
+  return block;
+}
+
+const std::string& ok_trailers_block() {
+  static const std::string block = [] {
+    std::string b;
+    EncodeHeader(&b, "grpc-status", 11, "0", 1);
+    return b;
+  }();
+  return block;
+}
+
+void encode_response_headers(std::string* block, const char* const* extra_kv,
+                             size_t n_extra) {
+  block->append(ok_response_headers_block());
+  for (size_t i = 0; i + 1 < 2 * n_extra; i += 2)
+    EncodeHeader(block, extra_kv[i], std::strlen(extra_kv[i]),
+                 extra_kv[i + 1], std::strlen(extra_kv[i + 1]));
+}
+
+void encode_trailers(std::string* block, int grpc_status,
+                     const char* grpc_message, size_t grpc_message_len,
+                     const char* const* extra_kv, size_t n_extra) {
+  if (grpc_status == 0 && grpc_message_len == 0 && n_extra == 0) {
+    block->append(ok_trailers_block());
+    return;
+  }
+  char st[12];
+  const int n = std::snprintf(st, sizeof(st), "%d", grpc_status);
+  EncodeHeader(block, "grpc-status", 11, st, (size_t)n);
+  if (grpc_message_len > 0)
+    EncodeHeader(block, "grpc-message", 12, grpc_message, grpc_message_len);
+  for (size_t i = 0; i + 1 < 2 * n_extra; i += 2)
+    EncodeHeader(block, extra_kv[i], std::strlen(extra_kv[i]),
+                 extra_kv[i + 1], std::strlen(extra_kv[i + 1]));
+}
+
+// Python event, delivered on the socket's FIFO lane so per-connection
+// order (headers -> messages -> end) survives the executor hop.
+struct PendingH2Event {
+  SocketId sid;
+  uint32_t stream_id;
+  int kind;
+  int mflags;
+  std::string service;
+  std::string method;
+  std::string headers;
+  butil::IOBuf* body;  // owned; may be nullptr
+};
+
+// FIFO-lane backlog accounting for one event.  A single admissible
+// message can legitimately exceed the socket's whole overcrowded limit
+// (the gRPC message cap is 256MB, the backlog limit 64MB); accounting
+// the full size would make such a message undeliverable no matter how
+// idle the consumer.  Cap one event's charge at half the limit:
+// delivery is always possible, and a sustained pile-up (2+ undrained
+// big events) still trips the bound.
+int64_t event_bytes(size_t body_size) {
+  const int64_t cap = Socket::overcrowded_limit() / 2;
+  const int64_t n = 256 + (int64_t)body_size;
+  return (cap > 0 && n > cap) ? cap : n;
+}
+
+void run_h2_event_task(void* arg) {
+  auto* p = (PendingH2Event*)arg;
+  H2EventCallback cb = g_event_cb.load(std::memory_order_acquire);
+  if (cb != nullptr) {
+    g_python_events.fetch_add(1, std::memory_order_relaxed);
+    cb(p->sid, p->stream_id, p->kind, p->service.data(), p->service.size(),
+       p->method.data(), p->method.size(), p->headers.data(),
+       p->headers.size(), p->body, p->mflags,
+       g_event_user.load(std::memory_order_acquire));
+  } else {
+    delete p->body;
+  }
+  delete p;
+}
+
+// Native handler run off the dispatch thread (non-inline registrations).
+struct PendingH2Native {
+  SocketId sid;
+  uint32_t stream_id;
+  MethodRegistry::Entry entry;
+  butil::IOBuf message;
+};
+
+void run_h2_native_task(void* arg) {
+  auto* p = (PendingH2Native*)arg;
+  butil::IOBuf resp;
+  const int32_t rc = p->entry.fn(p->sid, &p->message, &resp, p->entry.user);
+  std::string flat = resp.to_string();
+  if (rc == 0) {
+    H2RespondUnary(p->sid, p->stream_id, 0, nullptr, 0, flat.data(),
+                   flat.size(), nullptr, 0);
+  } else {
+    H2RespondUnary(p->sid, p->stream_id, 2, "native handler error", 20,
+                   nullptr, 0, nullptr, 0);
+  }
+  delete p;
+}
+
+}  // namespace
+
+void SetH2EventCallback(H2EventCallback cb, void* user) {
+  g_event_user.store(user, std::memory_order_release);
+  g_event_cb.store(cb, std::memory_order_release);
+}
+
+int64_t h2_native_requests() {
+  return g_native_requests.load(std::memory_order_relaxed);
+}
+int64_t h2_native_responses() {
+  return g_native_responses.load(std::memory_order_relaxed);
+}
+int64_t h2_python_events() {
+  return g_python_events.load(std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------------------
+// send helpers
+// ---------------------------------------------------------------------------
+
+bool H2Session::WriteOut(butil::IOBuf&& out) {
+  if (out.empty()) return true;
+  // dispatch-thread writes join the drain's write batch for free
+  butil::IOBuf* batch = Socket::CurrentBatchFor(sid_, out.size());
+  if (batch != nullptr) {
+    batch->append(std::move(out));
+    return true;
+  }
+  Socket* s = Socket::Address(sid_);
+  if (s == nullptr) return false;
+  const int rc = s->Write(std::move(out));
+  s->Dereference();
+  return rc == 0;
+}
+
+H2Session::Stream* H2Session::FindStream(uint32_t stream_id) {
+  auto it = streams_.find(stream_id);
+  return it == streams_.end() ? nullptr : &it->second;
+}
+
+// lock held.  Mark a stream whose BOTH halves are now closed for
+// reaping.  Response threads must never erase directly: the dispatch
+// thread may hold a Stream reference across its frame processing, and
+// unordered_map::erase would invalidate it mid-use.  The dispatch
+// thread reaps at the top of the next OnFrames call.
+void H2Session::MarkDeadLocked(uint32_t stream_id) {
+  dead_streams_.push_back(stream_id);
+}
+
+void H2Session::ReapDeadStreams() {
+  std::lock_guard<std::mutex> lk(send_mu_);
+  for (uint32_t id : dead_streams_) streams_.erase(id);
+  dead_streams_.clear();
+}
+
+// lock held.  Append one gRPC message as DATA frames, splitting at the
+// peer's max frame size and respecting both flow-control windows;
+// window-starved bytes queue on the stream and drain on WINDOW_UPDATE.
+void H2Session::AppendData(butil::IOBuf* out, Stream& st, uint32_t stream_id,
+                           const void* payload, size_t len, uint8_t mflags) {
+  char prefix[5];
+  prefix[0] = (char)mflags;
+  prefix[1] = (char)(len >> 24);
+  prefix[2] = (char)(len >> 16);
+  prefix[3] = (char)(len >> 8);
+  prefix[4] = (char)len;
+  if (!st.send_queue.empty()) {
+    // already blocked: preserve byte order
+    st.send_queue.append(prefix, 5);
+    if (len > 0) st.send_queue.append(payload, len);
+    return;
+  }
+  // fast path: whole message fits the windows and one frame
+  const int64_t window = conn_send_window_ < st.send_window
+                             ? conn_send_window_
+                             : st.send_window;
+  const size_t total = len + 5;
+  if ((int64_t)total <= window && total <= peer_max_frame_) {
+    char hdr[9];
+    put_frame_header(hdr, (uint32_t)total, FT_DATA, 0, stream_id);
+    out->append(hdr, 9);
+    out->append(prefix, 5);
+    if (len > 0) out->append(payload, len);
+    conn_send_window_ -= (int64_t)total;
+    st.send_window -= (int64_t)total;
+    return;
+  }
+  butil::IOBuf whole;
+  whole.append(prefix, 5);
+  if (len > 0) whole.append(payload, len);
+  st.send_queue.append(std::move(whole));
+  DrainSendQueueLocked(st, stream_id, out);
+}
+
+// lock held
+void H2Session::DrainSendQueueLocked(Stream& st, uint32_t stream_id,
+                                     butil::IOBuf* out) {
+  while (!st.send_queue.empty()) {
+    const int64_t window = conn_send_window_ < st.send_window
+                               ? conn_send_window_
+                               : st.send_window;
+    if (window <= 0) return;
+    size_t n = st.send_queue.size();
+    if ((int64_t)n > window) n = (size_t)window;
+    if (n > peer_max_frame_) n = peer_max_frame_;
+    butil::IOBuf chunk;
+    st.send_queue.cutn(&chunk, n);
+    char hdr[9];
+    put_frame_header(hdr, (uint32_t)n, FT_DATA, 0, stream_id);
+    out->append(hdr, 9);
+    out->append(std::move(chunk));
+    conn_send_window_ -= (int64_t)n;
+    st.send_window -= (int64_t)n;
+  }
+  if (st.send_queue.empty() && st.trailers_queued) {
+    out->append(st.queued_trailers);
+    st.queued_trailers.clear();
+    st.trailers_queued = false;
+    st.closed_local = true;
+    if (st.end_received) MarkDeadLocked(stream_id);
+  }
+}
+
+bool H2Session::RespondUnary(uint32_t stream_id, int grpc_status,
+                             const char* grpc_message,
+                             size_t grpc_message_len, const void* payload,
+                             size_t payload_len, const char* const* extra_kv,
+                             size_t n_extra) {
+  butil::IOBuf out;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    Stream* st = FindStream(stream_id);
+    if (st == nullptr || st->closed_local) return false;
+    if (grpc_status != 0 && !st->resp_headers_sent) {
+      // trailers-only response: one HEADERS frame, END_STREAM
+      std::string block;
+      block.append(ok_response_headers_block());
+      encode_trailers(&block, grpc_status, grpc_message, grpc_message_len,
+                      extra_kv, n_extra);
+      append_frame(&out, FT_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                   stream_id, block.data(), block.size());
+      st->closed_local = true;
+    } else {
+      if (!st->resp_headers_sent) {
+        const std::string& block = ok_response_headers_block();
+        append_frame(&out, FT_HEADERS, FLAG_END_HEADERS, stream_id,
+                     block.data(), block.size());
+        st->resp_headers_sent = true;
+      }
+      AppendData(&out, *st, stream_id, payload, payload_len, 0);
+      std::string tblock;
+      encode_trailers(&tblock, grpc_status, grpc_message, grpc_message_len,
+                      extra_kv, n_extra);
+      if (st->send_queue.empty() && !st->trailers_queued) {
+        append_frame(&out, FT_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                     stream_id, tblock.data(), tblock.size());
+        st->closed_local = true;
+      } else {
+        butil::IOBuf tb;
+        append_frame(&tb, FT_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                     stream_id, tblock.data(), tblock.size());
+        st->queued_trailers = tb.to_string();
+        st->trailers_queued = true;
+      }
+    }
+    if (st->closed_local && st->end_received) MarkDeadLocked(stream_id);
+  }
+  g_native_responses.fetch_add(1, std::memory_order_relaxed);
+  return WriteOut(std::move(out));
+}
+
+bool H2Session::SendResponseHeaders(uint32_t stream_id,
+                                    const char* const* extra_kv,
+                                    size_t n_extra) {
+  butil::IOBuf out;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    Stream* st = FindStream(stream_id);
+    if (st == nullptr || st->closed_local || st->resp_headers_sent)
+      return false;
+    std::string block;
+    encode_response_headers(&block, extra_kv, n_extra);
+    append_frame(&out, FT_HEADERS, FLAG_END_HEADERS, stream_id, block.data(),
+                 block.size());
+    st->resp_headers_sent = true;
+  }
+  return WriteOut(std::move(out));
+}
+
+bool H2Session::SendGrpcMessage(uint32_t stream_id, const void* payload,
+                                size_t len, uint8_t mflags) {
+  butil::IOBuf out;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    Stream* st = FindStream(stream_id);
+    if (st == nullptr || st->closed_local) return false;
+    if (!st->resp_headers_sent) {
+      const std::string& block = ok_response_headers_block();
+      append_frame(&out, FT_HEADERS, FLAG_END_HEADERS, stream_id,
+                   block.data(), block.size());
+      st->resp_headers_sent = true;
+    }
+    AppendData(&out, *st, stream_id, payload, len, mflags);
+  }
+  return WriteOut(std::move(out));
+}
+
+bool H2Session::SendTrailers(uint32_t stream_id, int grpc_status,
+                             const char* grpc_message,
+                             size_t grpc_message_len,
+                             const char* const* extra_kv, size_t n_extra) {
+  butil::IOBuf out;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    Stream* st = FindStream(stream_id);
+    if (st == nullptr || st->closed_local) return false;
+    std::string tblock;
+    if (!st->resp_headers_sent) {
+      // no messages were sent: degenerate to trailers-only
+      tblock.append(ok_response_headers_block());
+      st->resp_headers_sent = true;
+    }
+    encode_trailers(&tblock, grpc_status, grpc_message, grpc_message_len,
+                    extra_kv, n_extra);
+    if (st->send_queue.empty() && !st->trailers_queued) {
+      append_frame(&out, FT_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                   stream_id, tblock.data(), tblock.size());
+      st->closed_local = true;
+    } else {
+      butil::IOBuf tb;
+      append_frame(&tb, FT_HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
+                   stream_id, tblock.data(), tblock.size());
+      st->queued_trailers = tb.to_string();
+      st->trailers_queued = true;
+    }
+    if (st->closed_local && st->end_received) MarkDeadLocked(stream_id);
+  }
+  g_native_responses.fetch_add(1, std::memory_order_relaxed);
+  return WriteOut(std::move(out));
+}
+
+// ---------------------------------------------------------------------------
+// receive side (dispatch thread)
+// ---------------------------------------------------------------------------
+
+void H2Session::MaybeSendInitialFrames() {
+  if (sent_initial_) return;
+  sent_initial_ = true;
+  butil::IOBuf out;
+  char s[12];
+  s[0] = 0;
+  s[1] = (char)SET_INITIAL_WINDOW_SIZE;
+  s[2] = (char)(kInitialStreamWindow >> 24);
+  s[3] = (char)(kInitialStreamWindow >> 16);
+  s[4] = (char)(kInitialStreamWindow >> 8);
+  s[5] = (char)kInitialStreamWindow;
+  s[6] = 0;
+  s[7] = (char)SET_MAX_CONCURRENT_STREAMS;
+  s[8] = (char)(kMaxStreams >> 24);
+  s[9] = (char)(kMaxStreams >> 16);
+  s[10] = (char)(kMaxStreams >> 8);
+  s[11] = (char)kMaxStreams;
+  append_frame(&out, FT_SETTINGS, 0, 0, s, sizeof(s));
+  // the connection window starts at 64KB and only WINDOW_UPDATE raises
+  // it: top it up immediately so clients never stall on upload
+  append_window_update(&out, 0, 16 * 1024 * 1024);
+  WriteOut(std::move(out));
+}
+
+void H2Session::WriteRst(uint32_t stream_id, uint32_t error_code) {
+  butil::IOBuf out;
+  char p[4] = {(char)(error_code >> 24), (char)(error_code >> 16),
+               (char)(error_code >> 8), (char)error_code};
+  append_frame(&out, FT_RST_STREAM, 0, stream_id, p, 4);
+  WriteOut(std::move(out));
+}
+
+void H2Session::WriteGoaway(uint32_t error_code) {
+  if (goaway_sent_) return;
+  goaway_sent_ = true;
+  butil::IOBuf out;
+  char p[8];
+  p[0] = (char)(last_stream_id_ >> 24);
+  p[1] = (char)(last_stream_id_ >> 16);
+  p[2] = (char)(last_stream_id_ >> 8);
+  p[3] = (char)last_stream_id_;
+  p[4] = (char)(error_code >> 24);
+  p[5] = (char)(error_code >> 16);
+  p[6] = (char)(error_code >> 8);
+  p[7] = (char)error_code;
+  append_frame(&out, FT_GOAWAY, 0, 0, p, 8);
+  WriteOut(std::move(out));
+}
+
+bool H2Session::OnSettings(uint8_t flags, const uint8_t* p, size_t n) {
+  if (flags & FLAG_ACK) return n == 0;
+  if (n % 6 != 0) return false;
+  butil::IOBuf drained;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    for (size_t off = 0; off + 6 <= n; off += 6) {
+      const uint16_t id = (uint16_t)((p[off] << 8) | p[off + 1]);
+      const uint32_t val = rd32(p + off + 2);
+      switch (id) {
+        case SET_INITIAL_WINDOW_SIZE: {
+          if (val > 0x7fffffffu) return false;  // FLOW_CONTROL_ERROR
+          const int64_t delta = (int64_t)val - peer_initial_window_;
+          peer_initial_window_ = val;
+          for (auto& kv : streams_) kv.second.send_window += delta;
+          if (delta > 0) {
+            // RFC 7540 §6.9.2: a window made positive by SETTINGS must
+            // resume blocked senders, exactly like WINDOW_UPDATE
+            for (auto& kv : streams_)
+              DrainSendQueueLocked(kv.second, kv.first, &drained);
+          }
+          break;
+        }
+        case SET_MAX_FRAME_SIZE:
+          if (val < 16384 || val > 16777215) return false;
+          peer_max_frame_ = val;
+          break;
+        default:
+          break;  // HEADER_TABLE_SIZE etc: our encoder is stateless
+      }
+    }
+  }
+  butil::IOBuf out;
+  append_frame(&out, FT_SETTINGS, FLAG_ACK, 0, nullptr, 0);
+  out.append(std::move(drained));
+  WriteOut(std::move(out));
+  return true;
+}
+
+bool H2Session::OnWindowUpdate(uint32_t stream_id, const uint8_t* p,
+                               size_t n) {
+  if (n != 4) return false;
+  const uint32_t inc = rd32(p) & 0x7fffffffu;
+  if (inc == 0) return false;
+  butil::IOBuf out;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    if (stream_id == 0) {
+      conn_send_window_ += inc;
+      if (conn_send_window_ > 0x7fffffffll) return false;
+      // credit may unblock any stream's queue
+      for (auto& kv : streams_)
+        DrainSendQueueLocked(kv.second, kv.first, &out);
+    } else {
+      Stream* st = FindStream(stream_id);
+      if (st != nullptr) {
+        st->send_window += inc;
+        DrainSendQueueLocked(*st, stream_id, &out);
+      }
+    }
+  }
+  return WriteOut(std::move(out));
+}
+
+// Track consumed DATA bytes and top up the peer's view of our windows.
+void H2Session::SendConnWindowUpdates(uint32_t stream_id, Stream* st,
+                                      size_t bytes) {
+  conn_recv_consumed_ += (int64_t)bytes;
+  butil::IOBuf out;
+  if (conn_recv_consumed_ >= kConnWindowTopup) {
+    append_window_update(&out, 0, (uint32_t)conn_recv_consumed_);
+    conn_recv_consumed_ = 0;
+  }
+  if (st != nullptr && !st->end_received) {
+    st->recv_consumed += (int64_t)bytes;
+    if (st->recv_consumed >= kStreamWindowTopup) {
+      append_window_update(&out, stream_id, (uint32_t)st->recv_consumed);
+      st->recv_consumed = 0;
+    }
+  }
+  WriteOut(std::move(out));
+}
+
+bool H2Session::OnHeadersPayload(uint32_t stream_id, uint8_t flags,
+                                 const uint8_t* p, size_t n) {
+  // strip padding / priority
+  if (flags & FLAG_PADDED) {
+    if (n < 1) return false;
+    const uint8_t pad = p[0];
+    ++p;
+    --n;
+    if (pad > n) return false;
+    n -= pad;
+  }
+  if (flags & FLAG_PRIORITY) {
+    if (n < 5) return false;
+    p += 5;
+    n -= 5;
+  }
+  // the block budget applies to a single END_HEADERS frame too — the
+  // parser admits frames far larger than the budget, and an unbounded
+  // block is a memory-amplification hole (the Python plane's
+  // OUR_MAX_FRAME guard, rpc/h2.py)
+  if (n > kMaxHeaderBlock) return false;
+  header_block_.assign((const char*)p, n);
+  cont_stream_ = stream_id;
+  cont_flags_ = flags;
+  in_headers_ = true;
+  if (flags & FLAG_END_HEADERS) return FinishHeaderBlock();
+  return true;
+}
+
+bool H2Session::FinishHeaderBlock() {
+  in_headers_ = false;
+  const uint32_t stream_id = cont_stream_;
+  std::vector<Header> headers;
+  if (!hpack_.Decode((const uint8_t*)header_block_.data(),
+                     header_block_.size(), &headers)) {
+    header_block_.clear();
+    return false;  // COMPRESSION_ERROR: connection dies
+  }
+  header_block_.clear();
+
+  bool exists;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    exists = FindStream(stream_id) != nullptr;
+  }
+  if (exists) {
+    // trailers on an open request stream: gRPC clients don't send
+    // these; accept only as an end-of-stream marker
+    if (cont_flags_ & FLAG_END_STREAM)
+      return OnData(stream_id, FLAG_END_STREAM, butil::IOBuf());
+    WriteRst(stream_id, EC_PROTOCOL_ERROR);
+    return true;
+  }
+  if ((stream_id & 1) == 0 || stream_id <= last_stream_id_) return false;
+  bool live_streaming = false;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    if (streams_.size() >= kMaxStreams) {
+      WriteRst(stream_id, EC_REFUSED_STREAM);
+      return true;
+    }
+    last_stream_id_ = stream_id;
+    Stream st;
+    st.send_window = peer_initial_window_;
+    for (const Header& h : headers) {
+      // a request marked bidi must dispatch at HEADERS time (the
+      // handler consumes messages while responding) — holding its
+      // first message for the unary decision would deadlock it
+      if (h.name == "grpc-bidi" && h.value == "1") live_streaming = true;
+      if (h.name == ":path") {
+        // "/pkg.Service/Method"
+        const std::string& path = h.value;
+        const size_t slash = path.rfind('/');
+        if (!path.empty() && path[0] == '/' && slash > 0) {
+          st.service = path.substr(1, slash - 1);
+          st.method = path.substr(slash + 1);
+        }
+      }
+      // expose pseudo headers the bridge routes on plus every regular
+      // header (metadata, authorization, grpc-encoding, grpc-timeout)
+      if (h.name.empty()) continue;
+      if (h.name[0] == ':' && h.name != ":path" && h.name != ":method" &&
+          h.name != ":authority")
+        continue;
+      st.headers_flat.append(h.name);
+      st.headers_flat.push_back('\0');
+      st.headers_flat.append(h.value);
+      st.headers_flat.push_back('\0');
+    }
+    st.headers_done = true;
+    if (live_streaming) {
+      st.streaming = true;
+      st.delivered = true;
+    }
+    streams_.emplace(stream_id, std::move(st));
+  }
+  if (live_streaming) {
+    Stream* st2;
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      st2 = FindStream(stream_id);
+    }
+    if (st2 != nullptr) {
+      auto* ev = new PendingH2Event{sid_, stream_id, H2_EV_HEADERS, 0,
+                                    st2->service, st2->method,
+                                    st2->headers_flat, nullptr};
+      Socket* s = Socket::Address(sid_);
+      if (s == nullptr) {
+        delete ev;
+        return false;
+      }
+      const bool ok = s->FifoSubmit(run_h2_event_task, ev, 256);
+      s->Dereference();
+      if (!ok) return false;
+    }
+  }
+  if (cont_flags_ & FLAG_END_STREAM)
+    return OnData(stream_id, FLAG_END_STREAM, butil::IOBuf());
+  return true;
+}
+
+// Extract complete gRPC messages from st.data.  Streaming requests get
+// incremental MESSAGE events; the first message of a
+// not-yet-classified stream is HELD so a request that turns out to be
+// unary (END_STREAM right after one message) costs ONE Python upcall.
+bool H2Session::DeliverMessages(Stream& st, uint32_t stream_id) {
+  std::vector<std::pair<butil::IOBuf, uint8_t>> msgs;
+  bool went_streaming = false;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    while (st.data.size() >= 5) {
+      char pfx[5];
+      st.data.copy_to(pfx, 5, 0);
+      const uint32_t mlen = rd32((const uint8_t*)pfx + 1);
+      if (mlen > kMaxGrpcMessage) return false;
+      if (st.data.size() < 5 + (size_t)mlen) break;
+      st.data.pop_front(5);
+      butil::IOBuf msg;
+      st.data.cutn(&msg, mlen);
+      msgs.emplace_back(std::move(msg), (uint8_t)pfx[0]);
+    }
+    if (msgs.empty()) return true;
+    if (!st.streaming) {
+      if (!st.have_first && msgs.size() == 1 && st.data.empty()) {
+        // single complete message on an open stream: unary candidate
+        st.first_msg = std::move(msgs[0].first);
+        st.first_flags = msgs[0].second;
+        st.have_first = true;
+        return true;
+      }
+      // a second message (or bytes behind the first): streaming request
+      st.streaming = true;
+      went_streaming = true;
+      if (st.have_first) {
+        msgs.emplace(msgs.begin(), std::move(st.first_msg), st.first_flags);
+        st.first_msg.clear();
+        st.have_first = false;
+      }
+    }
+  }
+  Socket* s = Socket::Address(sid_);
+  if (s == nullptr) return false;
+  bool ok = true;
+  if (went_streaming && !st.delivered) {
+    st.delivered = true;
+    auto* ev = new PendingH2Event{sid_, stream_id, H2_EV_HEADERS, 0,
+                                  st.service, st.method, st.headers_flat,
+                                  nullptr};
+    ok = s->FifoSubmit(run_h2_event_task, ev, 256);
+    if (!ok) {
+      delete ev;
+    }
+  }
+  for (auto& m : msgs) {
+    if (!ok) break;
+    auto* ev = new PendingH2Event{
+        sid_, stream_id, H2_EV_MESSAGE, (int)m.second, std::string(),
+        std::string(), std::string(), new butil::IOBuf(std::move(m.first))};
+    ok = s->FifoSubmit(run_h2_event_task, ev, event_bytes(ev->body->size()));
+    if (!ok) {
+      delete ev->body;
+      delete ev;
+    }
+  }
+  s->Dereference();
+  return ok;
+}
+
+void H2Session::DispatchNative(Stream& st, uint32_t stream_id,
+                               butil::IOBuf&& message, int mflags) {
+  MethodRegistry::Entry e;
+  bool found = MethodRegistry::global()->Lookup(
+      st.service.data(), st.service.size(), st.method.data(),
+      st.method.size(), &e);
+  if (!found) {
+    const size_t dot = st.service.rfind('.');
+    if (dot != std::string::npos) {
+      // gRPC paths carry package-qualified names; the registry may hold
+      // the bare service name (mirrors server.py invoke_grpc fallback)
+      found = MethodRegistry::global()->Lookup(
+          st.service.data() + dot + 1, st.service.size() - dot - 1,
+          st.method.data(), st.method.size(), &e);
+    }
+  }
+  if (found && e.fn != nullptr) {
+    g_native_requests.fetch_add(1, std::memory_order_relaxed);
+    if (e.inline_run) {
+      butil::IOBuf resp;
+      const int32_t rc = e.fn(sid_, &message, &resp, e.user);
+      std::string flat = resp.to_string();
+      if (rc == 0) {
+        RespondUnary(stream_id, 0, nullptr, 0, flat.data(), flat.size(),
+                     nullptr, 0);
+      } else {
+        RespondUnary(stream_id, 2, "native handler error", 20, nullptr, 0,
+                     nullptr, 0);
+      }
+    } else {
+      auto* p = new PendingH2Native{sid_, stream_id, e, std::move(message)};
+      bthread::Executor::global()->submit(run_h2_native_task, p);
+    }
+    return;
+  }
+  // Python-owned (registered python method, unknown service, non-gRPC
+  // h2 request): surface the whole unary request in ONE event
+  if (g_event_cb.load(std::memory_order_acquire) == nullptr) {
+    RespondUnary(stream_id, 12, "unimplemented", 13, nullptr, 0, nullptr, 0);
+    return;
+  }
+  auto* ev = new PendingH2Event{
+      sid_, stream_id, H2_EV_UNARY, mflags, st.service,
+      st.method, st.headers_flat, new butil::IOBuf(std::move(message))};
+  Socket* s = Socket::Address(sid_);
+  if (s == nullptr) {
+    delete ev->body;
+    delete ev;
+    return;
+  }
+  if (!s->FifoSubmit(run_h2_event_task, ev,
+                     event_bytes(ev->body->size()))) {
+    // socket failed; nothing left to respond to
+  }
+  s->Dereference();
+}
+
+// The request half closed: dispatch (unary) or emit END (streaming).
+void H2Session::DeliverTerminal(Stream& st, uint32_t stream_id) {
+  bool unary = false;
+  butil::IOBuf message;
+  int mflags = -1;  // -1 = request ended with NO message (the bridge
+                    // must tell an absent message from one empty one)
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    if (!st.streaming) {
+      unary = true;
+      st.delivered = true;
+      if (st.have_first) {
+        message = std::move(st.first_msg);
+        st.first_msg.clear();
+        mflags = st.first_flags;
+        st.have_first = false;
+      }
+    }
+  }
+  if (unary) {
+    DispatchNative(st, stream_id, std::move(message), mflags);
+    return;
+  }
+  auto* ev = new PendingH2Event{sid_,          stream_id,     H2_EV_END, 0,
+                                std::string(), std::string(), std::string(),
+                                nullptr};
+  Socket* s = Socket::Address(sid_);
+  if (s == nullptr) {
+    delete ev;
+    return;
+  }
+  s->FifoSubmit(run_h2_event_task, ev, 256);
+  s->Dereference();
+}
+
+bool H2Session::OnData(uint32_t stream_id, uint8_t flags,
+                       butil::IOBuf&& payload) {
+  Stream* st;
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    st = FindStream(stream_id);
+  }
+  // flow control counts the whole payload, padding included
+  const size_t flow_bytes = payload.size();
+  if (st == nullptr) {
+    // closed/unknown stream (e.g. reaped after reset): account the
+    // connection window so the peer's credit view stays consistent
+    if (flow_bytes > 0) SendConnWindowUpdates(stream_id, nullptr, flow_bytes);
+    return true;
+  }
+  if (st->end_received) return false;  // DATA after END_STREAM
+  if (flags & FLAG_PADDED) {
+    if (payload.size() < 1) return false;
+    char padc;
+    payload.copy_to(&padc, 1, 0);
+    const uint8_t pad = (uint8_t)padc;
+    payload.pop_front(1);
+    if (pad > payload.size()) return false;
+    payload.pop_back(pad);
+  }
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    st->data.append(std::move(payload));
+    if (st->data.size() > kMaxGrpcMessage + 5) return false;
+  }
+  if (!DeliverMessages(*st, stream_id)) return false;
+  if (flow_bytes > 0) SendConnWindowUpdates(stream_id, st, flow_bytes);
+  if (flags & FLAG_END_STREAM) {
+    bool already_closed;
+    {
+      std::lock_guard<std::mutex> lk(send_mu_);
+      st->end_received = true;
+      already_closed = st->closed_local;
+      if (already_closed) MarkDeadLocked(stream_id);
+    }
+    if (!already_closed) DeliverTerminal(*st, stream_id);
+  }
+  return true;
+}
+
+bool H2Session::OnFrames(const char* meta, size_t meta_len,
+                         butil::IOBuf* body) {
+  ReapDeadStreams();
+  MaybeSendInitialFrames();
+  size_t off = 0;
+  while (off + 9 <= meta_len) {
+    const uint8_t* h = (const uint8_t*)meta + off;
+    const uint32_t len =
+        ((uint32_t)h[0] << 16) | ((uint32_t)h[1] << 8) | h[2];
+    const uint8_t type = h[3];
+    const uint8_t flags = h[4];
+    const uint32_t stream_id = rd32(h + 5) & 0x7fffffffu;
+    off += 9;
+    butil::IOBuf payload;
+    if (len > 0) {
+      if (body->size() < len) return false;  // H2Accum contract broken
+      body->cutn(&payload, len);
+    }
+    // CONTINUATION must directly follow its HEADERS frame
+    if (in_headers_ && type != FT_CONTINUATION) {
+      WriteGoaway(EC_PROTOCOL_ERROR);
+      return false;
+    }
+    bool ok = true;
+    switch (type) {
+      case FT_DATA:
+        ok = OnData(stream_id, flags, std::move(payload));
+        break;
+      case FT_HEADERS: {
+        std::string flat = payload.to_string();
+        ok = stream_id != 0 &&
+             OnHeadersPayload(stream_id, flags, (const uint8_t*)flat.data(),
+                              flat.size());
+        break;
+      }
+      case FT_CONTINUATION: {
+        if (!in_headers_ || stream_id != cont_stream_) {
+          ok = false;
+          break;
+        }
+        std::string flat = payload.to_string();
+        header_block_.append(flat);
+        if (header_block_.size() > kMaxHeaderBlock) {
+          ok = false;
+          break;
+        }
+        if (flags & FLAG_END_HEADERS) ok = FinishHeaderBlock();
+        break;
+      }
+      case FT_SETTINGS: {
+        std::string flat = payload.to_string();
+        ok = stream_id == 0 &&
+             OnSettings(flags, (const uint8_t*)flat.data(), flat.size());
+        break;
+      }
+      case FT_WINDOW_UPDATE: {
+        std::string flat = payload.to_string();
+        ok = OnWindowUpdate(stream_id, (const uint8_t*)flat.data(),
+                            flat.size());
+        break;
+      }
+      case FT_PING: {
+        if (len != 8 || stream_id != 0) {
+          ok = false;
+          break;
+        }
+        if (!(flags & FLAG_ACK)) {
+          std::string flat = payload.to_string();
+          butil::IOBuf out;
+          append_frame(&out, FT_PING, FLAG_ACK, 0, flat.data(), flat.size());
+          WriteOut(std::move(out));
+        }
+        break;
+      }
+      case FT_RST_STREAM: {
+        if (len != 4 || stream_id == 0) {
+          ok = false;
+          break;
+        }
+        bool notify = false;
+        {
+          std::lock_guard<std::mutex> lk(send_mu_);
+          Stream* st = FindStream(stream_id);
+          if (st != nullptr) {
+            notify = st->delivered && st->streaming;
+            st->closed_local = true;
+            st->end_received = true;
+            MarkDeadLocked(stream_id);
+          }
+        }
+        if (notify) {
+          auto* ev = new PendingH2Event{sid_, stream_id, H2_EV_RESET, 0,
+                                        std::string(), std::string(),
+                                        std::string(), nullptr};
+          Socket* s = Socket::Address(sid_);
+          if (s != nullptr) {
+            if (!s->FifoSubmit(run_h2_event_task, ev, 256)) delete ev;
+            s->Dereference();
+          } else {
+            delete ev;
+          }
+        }
+        break;
+      }
+      case FT_GOAWAY:
+      case FT_PRIORITY:
+      case FT_PUSH_PROMISE:  // clients must not push; tolerate + ignore
+      default:
+        break;  // unknown frame types are ignored per RFC 7540 §4.1
+    }
+    if (!ok) {
+      BLOG(WARNING,
+           "h2 fatal frame: type=%u flags=%u stream=%u len=%u",
+           (unsigned)type, (unsigned)flags, (unsigned)stream_id,
+           (unsigned)len);
+      WriteGoaway(EC_PROTOCOL_ERROR);
+      return false;
+    }
+  }
+  return off == meta_len && body->empty();
+}
+
+// ---------------------------------------------------------------------------
+// sid-addressed helpers
+// ---------------------------------------------------------------------------
+
+#define H2_SID_FORWARD(expr)                  \
+  Socket* s = Socket::Address(sid);           \
+  if (s == nullptr) return false;             \
+  H2Session* sess = s->h2_session();          \
+  if (sess == nullptr) {                      \
+    s->Dereference();                         \
+    return false;                             \
+  }                                           \
+  const bool rc = (expr);                     \
+  s->Dereference();                           \
+  return rc
+
+bool H2RespondUnary(SocketId sid, uint32_t stream_id, int grpc_status,
+                    const char* grpc_message, size_t grpc_message_len,
+                    const void* payload, size_t payload_len,
+                    const char* const* extra_kv, size_t n_extra) {
+  H2_SID_FORWARD(sess->RespondUnary(stream_id, grpc_status, grpc_message,
+                                    grpc_message_len, payload, payload_len,
+                                    extra_kv, n_extra));
+}
+
+bool H2SendResponseHeaders(SocketId sid, uint32_t stream_id,
+                           const char* const* extra_kv, size_t n_extra) {
+  H2_SID_FORWARD(sess->SendResponseHeaders(stream_id, extra_kv, n_extra));
+}
+
+bool H2SendGrpcMessage(SocketId sid, uint32_t stream_id, const void* payload,
+                       size_t len, uint8_t mflags) {
+  H2_SID_FORWARD(sess->SendGrpcMessage(stream_id, payload, len, mflags));
+}
+
+bool H2SendTrailers(SocketId sid, uint32_t stream_id, int grpc_status,
+                    const char* grpc_message, size_t grpc_message_len,
+                    const char* const* extra_kv, size_t n_extra) {
+  H2_SID_FORWARD(sess->SendTrailers(stream_id, grpc_status, grpc_message,
+                                    grpc_message_len, extra_kv, n_extra));
+}
+
+}  // namespace h2
+}  // namespace brpc

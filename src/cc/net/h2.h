@@ -1,0 +1,200 @@
+// Native HTTP/2 + gRPC server data plane.
+//
+// Reference: src/brpc/policy/http2_rpc_protocol.cpp (SURVEY.md §2.4) — the
+// reference parses h2 frames, HPACK and gRPC framing natively and only
+// surfaces whole requests to service code.  Our round-4 plane was pure
+// Python (brpc_tpu/rpc/h2.py, ~9k qps with native frame coalescing); this
+// module moves the per-frame work — frame state machine, HPACK, flow
+// control, gRPC message framing, response packing — into C++.  Python is
+// upcalled once per MESSAGE (or once per unary REQUEST), not per frame,
+// and natively-registered methods never surface to Python at all.
+//
+// Threading: OnFrames() runs only on the socket's dispatch thread (frames
+// of one connection are inherently ordered).  Send-side state (windows,
+// pending response data) is guarded by a mutex because Python handler
+// threads respond concurrently.  The Python h2 client (h2.py GrpcChannel)
+// is unchanged — this is the server role.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "butil/iobuf.h"
+#include "net/hpack.h"
+
+namespace brpc {
+
+typedef uint64_t SocketId;
+class Socket;
+
+namespace h2 {
+
+// ---- events surfaced to the Python bridge ----
+//
+// UNARY: a complete one-message request (the hot path — one upcall).
+// HEADERS/MESSAGE/END: a streaming request, surfaced incrementally so
+// bidi handlers can consume while responding.  RESET: the stream (or
+// whole connection) died; the bridge cancels the handler.
+enum EventKind {
+  H2_EV_UNARY = 0,
+  H2_EV_HEADERS = 1,
+  H2_EV_MESSAGE = 2,
+  H2_EV_END = 3,
+  H2_EV_RESET = 4,
+};
+
+// headers: concatenated "name\0value\0" pairs (non-pseudo headers).
+// body ownership passes to the callee (may be nullptr for no-body
+// events).  mflags: gRPC message flag byte (bit 0 = compressed) for
+// UNARY/MESSAGE events.
+typedef void (*H2EventCallback)(SocketId sid, uint32_t stream_id, int kind,
+                                const char* service, size_t service_len,
+                                const char* method, size_t method_len,
+                                const char* headers, size_t headers_len,
+                                butil::IOBuf* body, int mflags, void* user);
+
+void SetH2EventCallback(H2EventCallback cb, void* user);
+
+// ---- counters (exported on /ici-style console pages) ----
+int64_t h2_native_requests();   // requests dispatched by native sessions
+int64_t h2_native_responses();  // responses packed natively
+int64_t h2_python_events();     // events surfaced to the Python bridge
+
+class H2Session {
+ public:
+  explicit H2Session(SocketId sid) : sid_(sid) {}
+
+  // Feed a coalesced run of complete h2 frames (meta = concatenated
+  // 9-byte headers, body = payloads in order — the exact shape
+  // Socket::DispatchMessages' H2Accum builds).  Dispatch-thread only.
+  // Returns false on a fatal connection error (caller closes).
+  // Connection failure cleanup is the Python bridge's job: it already
+  // receives the socket-failed notification and cancels live streams.
+  bool OnFrames(const char* meta, size_t meta_len, butil::IOBuf* body);
+
+  // ---- response paths (any thread; sid-addressed helpers below) ----
+
+  // One-shot unary response: HEADERS + DATA(grpc frame) + trailers in a
+  // single write.  grpc_status != 0 sends trailers-only (no DATA).
+  bool RespondUnary(uint32_t stream_id, int grpc_status,
+                    const char* grpc_message, size_t grpc_message_len,
+                    const void* payload, size_t payload_len,
+                    const char* const* extra_kv, size_t n_extra);
+
+  // Streaming response: headers once, then messages, then trailers.
+  bool SendResponseHeaders(uint32_t stream_id, const char* const* extra_kv,
+                           size_t n_extra);
+  bool SendGrpcMessage(uint32_t stream_id, const void* payload, size_t len,
+                       uint8_t mflags);
+  bool SendTrailers(uint32_t stream_id, int grpc_status,
+                    const char* grpc_message, size_t grpc_message_len,
+                    const char* const* extra_kv, size_t n_extra);
+
+ private:
+  struct Stream {
+    std::string service;
+    std::string method;
+    std::string headers_flat;  // "name\0value\0" pairs
+    butil::IOBuf data;         // undelivered DATA bytes (gRPC framing)
+    butil::IOBuf first_msg;    // first complete message, pending the
+    uint8_t first_flags = 0;   // unary-vs-streaming decision
+    bool have_first = false;
+    bool streaming = false;    // python saw H2_EV_HEADERS
+    bool headers_done = false;
+    bool end_received = false;
+    bool delivered = false;    // terminal event sent to python/native
+    // send side (guarded by session send mutex)
+    int64_t send_window;
+    bool resp_headers_sent = false;
+    bool closed_local = false;
+    int64_t recv_consumed = 0;  // stream-level WINDOW_UPDATE accounting
+    butil::IOBuf send_queue;    // DATA bytes waiting for window credit
+    bool trailers_queued = false;
+    std::string queued_trailers;  // encoded trailer HEADERS frame
+  };
+
+  // frame handlers (dispatch thread)
+  bool OnHeadersPayload(uint32_t stream_id, uint8_t flags,
+                        const uint8_t* p, size_t n);
+  bool OnData(uint32_t stream_id, uint8_t flags, butil::IOBuf&& payload);
+  bool OnSettings(uint8_t flags, const uint8_t* p, size_t n);
+  bool OnWindowUpdate(uint32_t stream_id, const uint8_t* p, size_t n);
+  bool FinishHeaderBlock();
+  bool DeliverMessages(Stream& st, uint32_t stream_id);
+  void DeliverTerminal(Stream& st, uint32_t stream_id);
+  // mflags: the request message's gRPC flag byte, or -1 when the
+  // request ended with no message at all
+  void DispatchNative(Stream& st, uint32_t stream_id,
+                      butil::IOBuf&& message, int mflags);
+  void MaybeSendInitialFrames();
+  void SendConnWindowUpdates(uint32_t stream_id, Stream* st, size_t bytes);
+  void WriteRst(uint32_t stream_id, uint32_t error_code);
+  void WriteGoaway(uint32_t error_code);
+  // deferred stream reaping: response threads mark, the dispatch thread
+  // erases (a direct erase could invalidate a Stream& the dispatch
+  // thread still holds)
+  void MarkDeadLocked(uint32_t stream_id);
+  void ReapDeadStreams();
+
+  // send helpers (any thread; lock held by caller where noted)
+  bool WriteOut(butil::IOBuf&& out);
+  void AppendData(butil::IOBuf* out, Stream& st, uint32_t stream_id,
+                  const void* payload, size_t len,
+                  uint8_t mflags);  // lock held
+  void DrainSendQueueLocked(Stream& st, uint32_t stream_id,
+                            butil::IOBuf* out);
+  Stream* FindStream(uint32_t stream_id);
+
+  SocketId sid_;
+  HpackDecoder hpack_;
+  std::unordered_map<uint32_t, Stream> streams_;
+  std::vector<uint32_t> dead_streams_;  // guarded by send_mu_
+  uint32_t last_stream_id_ = 0;
+  // CONTINUATION accumulation
+  std::string header_block_;
+  uint32_t cont_stream_ = 0;
+  uint8_t cont_flags_ = 0;
+  bool in_headers_ = false;
+  bool sent_initial_ = false;
+  bool goaway_sent_ = false;
+  int64_t conn_recv_consumed_ = 0;
+  // peer-controlled send parameters
+  std::mutex send_mu_;
+  int64_t conn_send_window_ = 65535;
+  int64_t peer_initial_window_ = 65535;
+  uint32_t peer_max_frame_ = 16384;
+  // budgets (mirror rpc/h2.py bounds)
+  static constexpr size_t kMaxHeaderBlock = 256 * 1024;
+  // per-message bound: generous (the Python plane bounds decompression
+  // expansion, not raw size — tests echo 72MB payloads); the flow
+  // control windows bound per-connection memory growth rate
+  static constexpr size_t kMaxGrpcMessage = 256 * 1024 * 1024;
+  static constexpr size_t kMaxStreams = 1024;
+  static constexpr int64_t kConnWindowTopup = 8 * 1024 * 1024;
+  static constexpr int64_t kStreamWindowTopup = 1 * 1024 * 1024;
+  static constexpr uint32_t kInitialStreamWindow = 4 * 1024 * 1024;
+};
+
+// sid-addressed response helpers for the C API / Python bridge: resolve
+// the socket, take its session, forward.  Safe on dead sockets (no-op
+// false).
+bool H2RespondUnary(SocketId sid, uint32_t stream_id, int grpc_status,
+                    const char* grpc_message, size_t grpc_message_len,
+                    const void* payload, size_t payload_len,
+                    const char* const* extra_kv, size_t n_extra);
+bool H2SendResponseHeaders(SocketId sid, uint32_t stream_id,
+                           const char* const* extra_kv, size_t n_extra);
+bool H2SendGrpcMessage(SocketId sid, uint32_t stream_id, const void* payload,
+                       size_t len, uint8_t mflags);
+bool H2SendTrailers(SocketId sid, uint32_t stream_id, int grpc_status,
+                    const char* grpc_message, size_t grpc_message_len,
+                    const char* const* extra_kv, size_t n_extra);
+
+}  // namespace h2
+}  // namespace brpc

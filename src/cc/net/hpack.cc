@@ -1,0 +1,284 @@
+// Native HPACK codec (see hpack.h).  Clean-room from RFC 7541; tables
+// generated from the Python codec (tools/gen_hpack_tables.py).
+#include "net/hpack.h"
+
+#include <cstring>
+#include <mutex>
+
+namespace brpc {
+namespace h2 {
+
+struct StaticEntry {
+  const char* name;
+  const char* value;
+};
+struct HuffCode {
+  uint32_t code;
+  uint8_t bits;
+};
+
+#include "net/hpack_tables.inc"
+
+// ---- integers ----
+
+bool DecodeInt(const uint8_t** p, const uint8_t* end, uint8_t prefix_mask,
+               uint64_t* out) {
+  if (*p >= end) return false;
+  uint64_t v = **p & prefix_mask;
+  ++*p;
+  if (v < prefix_mask) {
+    *out = v;
+    return true;
+  }
+  int shift = 0;
+  while (true) {
+    if (*p >= end || shift > 28) return false;  // > 2^32: reject
+    const uint8_t b = **p;
+    ++*p;
+    v += (uint64_t)(b & 0x7f) << shift;
+    if ((b & 0x80) == 0) break;
+    shift += 7;
+  }
+  if (v > 0xffffffffull) return false;
+  *out = v;
+  return true;
+}
+
+void EncodeInt(std::string* out, uint8_t first, uint8_t prefix_mask,
+               uint64_t v) {
+  if (v < prefix_mask) {
+    out->push_back((char)(first | v));
+    return;
+  }
+  out->push_back((char)(first | prefix_mask));
+  v -= prefix_mask;
+  while (v >= 0x80) {
+    out->push_back((char)(0x80 | (v & 0x7f)));
+    v >>= 7;
+  }
+  out->push_back((char)v);
+}
+
+// ---- Huffman decode: binary trie built once from the code table ----
+
+namespace {
+
+struct HuffNode {
+  int16_t child[2];  // node index, or -1
+  int16_t sym;       // decoded symbol, or -1
+};
+
+// 257 codes, <= 30 bits each => < 2*257*30 nodes; 8192 is generous.
+static HuffNode g_huff_nodes[8192];
+static int g_huff_node_count = 0;
+static std::once_flag g_huff_once;
+
+void BuildHuffTrie() {
+  g_huff_node_count = 1;
+  g_huff_nodes[0] = {{-1, -1}, -1};
+  for (int sym = 0; sym < 257; ++sym) {
+    const uint32_t code = kHuffTable[sym].code;
+    const int bits = kHuffTable[sym].bits;
+    int node = 0;
+    for (int i = bits - 1; i >= 0; --i) {
+      const int b = (code >> i) & 1;
+      int16_t next = g_huff_nodes[node].child[b];
+      if (next < 0) {
+        next = (int16_t)g_huff_node_count++;
+        g_huff_nodes[next] = {{-1, -1}, -1};
+        g_huff_nodes[node].child[b] = next;
+      }
+      node = next;
+    }
+    g_huff_nodes[node].sym = (int16_t)sym;
+  }
+}
+
+}  // namespace
+
+bool HuffmanDecode(const uint8_t* p, size_t n, std::string* out) {
+  std::call_once(g_huff_once, BuildHuffTrie);
+  int node = 0;
+  int depth = 0;       // bits consumed since the last emitted symbol
+  bool all_ones = true;  // those bits were all 1s (valid padding prefix)
+  out->reserve(out->size() + n * 2);
+  for (size_t i = 0; i < n; ++i) {
+    const uint8_t byte = p[i];
+    for (int bit = 7; bit >= 0; --bit) {
+      const int b = (byte >> bit) & 1;
+      const int16_t next = g_huff_nodes[node].child[b];
+      if (next < 0) return false;  // invalid code
+      node = next;
+      ++depth;
+      all_ones = all_ones && (b == 1);
+      const int16_t sym = g_huff_nodes[node].sym;
+      if (sym >= 0) {
+        if (sym == 256) return false;  // EOS in the data is an error
+        out->push_back((char)sym);
+        node = 0;
+        depth = 0;
+        all_ones = true;
+      }
+    }
+  }
+  // trailing bits must be a prefix of EOS (all ones), < 8 bits
+  return depth < 8 && all_ones;
+}
+
+// ---- decoder ----
+
+bool HpackDecoder::ReadString(const uint8_t** p, const uint8_t* end,
+                              std::string* out) {
+  if (*p >= end) return false;
+  const bool huff = (**p & 0x80) != 0;
+  uint64_t len;
+  if (!DecodeInt(p, end, 0x7f, &len)) return false;
+  if (len > (uint64_t)(end - *p)) return false;
+  if (huff) {
+    if (!HuffmanDecode(*p, (size_t)len, out)) return false;
+  } else {
+    out->append((const char*)*p, (size_t)len);
+  }
+  *p += len;
+  return true;
+}
+
+bool HpackDecoder::LookupIndex(uint64_t idx, Header* out) const {
+  if (idx == 0) return false;
+  if (idx <= 61) {
+    out->name = kStaticTable[idx - 1].name;
+    out->value = kStaticTable[idx - 1].value;
+    return true;
+  }
+  const uint64_t di = idx - 62;
+  if (di >= dyn_.size()) return false;
+  out->name = dyn_[di].name;
+  out->value = dyn_[di].value;
+  return true;
+}
+
+void HpackDecoder::EvictTo(size_t limit) {
+  while (size_ > limit && !dyn_.empty()) {
+    size_ -= dyn_.back().name.size() + dyn_.back().value.size() + 32;
+    dyn_.pop_back();
+  }
+}
+
+void HpackDecoder::Insert(std::string name, std::string value) {
+  const size_t esz = name.size() + value.size() + 32;
+  if (esz > cap_) {  // larger than the table: clears it (RFC §4.4)
+    EvictTo(0);
+    return;
+  }
+  EvictTo(cap_ - esz);
+  dyn_.push_front(Entry{std::move(name), std::move(value)});
+  size_ += esz;
+}
+
+bool HpackDecoder::Decode(const uint8_t* p, size_t n,
+                          std::vector<Header>* out, size_t max_decoded) {
+  const uint8_t* end = p + n;
+  size_t decoded = 0;
+  const auto charge = [&decoded, max_decoded](const Header& h) {
+    decoded += h.name.size() + h.value.size() + 32;
+    return decoded <= max_decoded;
+  };
+  while (p < end) {
+    const uint8_t b = *p;
+    if (b & 0x80) {
+      // indexed field
+      uint64_t idx;
+      if (!DecodeInt(&p, end, 0x7f, &idx)) return false;
+      Header h;
+      if (!LookupIndex(idx, &h)) return false;
+      if (!charge(h)) return false;
+      out->push_back(std::move(h));
+    } else if (b & 0x40) {
+      // literal with incremental indexing
+      uint64_t idx;
+      if (!DecodeInt(&p, end, 0x3f, &idx)) return false;
+      Header h;
+      if (idx != 0) {
+        if (!LookupIndex(idx, &h)) return false;
+        h.value.clear();
+      } else if (!ReadString(&p, end, &h.name)) {
+        return false;
+      }
+      if (!ReadString(&p, end, &h.value)) return false;
+      if (!charge(h)) return false;
+      Insert(h.name, h.value);
+      out->push_back(std::move(h));
+    } else if (b & 0x20) {
+      // dynamic table size update
+      uint64_t sz;
+      if (!DecodeInt(&p, end, 0x1f, &sz)) return false;
+      if (sz > cap_limit_) return false;
+      cap_ = (size_t)sz;
+      EvictTo(cap_);
+    } else {
+      // literal without indexing (0x00) / never indexed (0x10)
+      uint64_t idx;
+      if (!DecodeInt(&p, end, 0x0f, &idx)) return false;
+      Header h;
+      if (idx != 0) {
+        if (!LookupIndex(idx, &h)) return false;
+        h.value.clear();
+      } else if (!ReadString(&p, end, &h.name)) {
+        return false;
+      }
+      if (!ReadString(&p, end, &h.value)) return false;
+      if (!charge(h)) return false;
+      out->push_back(std::move(h));
+    }
+  }
+  return true;
+}
+
+// ---- encoder ----
+
+namespace {
+
+// (name, value) -> static index for the pairs worth matching on the
+// response path; name -> first static index for name-only refs.
+int StaticPairIndex(const char* name, size_t nl, const char* value,
+                    size_t vl) {
+  for (int i = 0; i < 61; ++i) {
+    const StaticEntry& e = kStaticTable[i];
+    if (std::strlen(e.name) == nl && std::memcmp(e.name, name, nl) == 0 &&
+        std::strlen(e.value) == vl && std::memcmp(e.value, value, vl) == 0)
+      return i + 1;
+  }
+  return 0;
+}
+
+int StaticNameIndex(const char* name, size_t nl) {
+  for (int i = 0; i < 61; ++i) {
+    const StaticEntry& e = kStaticTable[i];
+    if (std::strlen(e.name) == nl && std::memcmp(e.name, name, nl) == 0)
+      return i + 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
+void EncodeHeader(std::string* out, const char* name, size_t name_len,
+                  const char* value, size_t value_len) {
+  const int pair = StaticPairIndex(name, name_len, value, value_len);
+  if (pair > 0) {
+    EncodeInt(out, 0x80, 0x7f, (uint64_t)pair);
+    return;
+  }
+  const int nidx = StaticNameIndex(name, name_len);
+  // literal without indexing
+  EncodeInt(out, 0x00, 0x0f, (uint64_t)nidx);
+  if (nidx == 0) {
+    EncodeInt(out, 0x00, 0x7f, name_len);  // no Huffman
+    out->append(name, name_len);
+  }
+  EncodeInt(out, 0x00, 0x7f, value_len);
+  out->append(value, value_len);
+}
+
+}  // namespace h2
+}  // namespace brpc

@@ -1,0 +1,98 @@
+// Native HPACK (RFC 7541) — the h2 data plane's header codec.
+//
+// Reference: src/brpc/details/hpack.cpp (SURVEY.md §2.4) implements the
+// same RFC natively for its h2 protocol; this is a clean-room build from
+// the RFC.  The Python codec (brpc_tpu/rpc/hpack.py) remains the client
+// side and the fallback; the wire-spec tables are generated from it
+// (hpack_tables.inc) so the two can never drift.
+//
+// Decoder: full RFC — static + dynamic table, incremental indexing,
+// table-size updates, Huffman-coded strings.
+// Encoder: stateless strategy (static-table refs + literals without
+// indexing, no Huffman) — legal HPACK any peer must accept, and it keeps
+// response encoding lock-free across threads.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace brpc {
+namespace h2 {
+
+struct Header {
+  std::string name;
+  std::string value;
+};
+
+// ---- integer primitives (RFC 7541 §5.1) ----
+
+// Decode an integer with an N-bit prefix starting at *p (the prefix bits
+// of **p are masked by the caller via `prefix_mask`).  Advances *p past
+// the integer.  Returns false on truncation/overflow (> 2^32).
+bool DecodeInt(const uint8_t** p, const uint8_t* end, uint8_t prefix_mask,
+               uint64_t* out);
+
+// Append an integer with an N-bit prefix; `first` carries the pattern
+// bits above the prefix (e.g. 0x80 for an indexed field).
+void EncodeInt(std::string* out, uint8_t first, uint8_t prefix_mask,
+               uint64_t v);
+
+// ---- Huffman (RFC 7541 §5.2, Appendix B) ----
+
+// Decode `n` Huffman bytes into *out.  Returns false on an invalid
+// code, embedded EOS, or padding longer than 7 bits / not all-ones.
+bool HuffmanDecode(const uint8_t* p, size_t n, std::string* out);
+
+// ---- decoder ----
+
+class HpackDecoder {
+ public:
+  explicit HpackDecoder(size_t max_table = 4096)
+      : cap_limit_(max_table), cap_(max_table) {}
+
+  // Decode one complete header block.  Appends to *out.  Returns false
+  // on any malformed input (the connection must then die, RFC 7540 §4.3
+  // COMPRESSION_ERROR — dynamic-table state is unrecoverable) or when
+  // the DECODED size exceeds `max_decoded` bytes — indexed fields
+  // expand (1 wire byte -> a full dynamic-table entry), so bounding the
+  // input block alone still allows ~4000x memory amplification.
+  bool Decode(const uint8_t* p, size_t n, std::vector<Header>* out,
+              size_t max_decoded = 4 * 1024 * 1024);
+
+  size_t dynamic_size() const { return size_; }
+
+ private:
+  struct Entry {
+    std::string name;
+    std::string value;
+  };
+  bool LookupIndex(uint64_t idx, Header* out) const;
+  void Insert(std::string name, std::string value);
+  void EvictTo(size_t limit);
+  static bool ReadString(const uint8_t** p, const uint8_t* end,
+                         std::string* out);
+
+  std::deque<Entry> dyn_;  // front = most recent (index 62)
+  size_t size_ = 0;        // RFC size: sum(name+value+32)
+  size_t cap_limit_;       // SETTINGS_HEADER_TABLE_SIZE we advertised
+  size_t cap_;             // current cap (<= cap_limit_, set by updates)
+};
+
+// ---- encoder (stateless) ----
+
+// Append one header field: indexed when (name, value) is in the static
+// table, literal-without-indexing (static name ref when possible)
+// otherwise.  Never touches dynamic state — safe concurrently.
+void EncodeHeader(std::string* out, const char* name, size_t name_len,
+                  const char* value, size_t value_len);
+
+inline void EncodeHeader(std::string* out, const std::string& name,
+                         const std::string& value) {
+  EncodeHeader(out, name.data(), name.size(), value.data(), value.size());
+}
+
+}  // namespace h2
+}  // namespace brpc

@@ -19,6 +19,7 @@
 #include "bthread/fiber.h"
 #include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
+#include "net/h2.h"
 
 namespace brpc {
 
@@ -214,6 +215,10 @@ void Socket::Dereference() {
   }
   _out_buf.clear();
   _read_buf.clear();
+  // no references can exist here (last deref): the session dies with us
+  h2::H2Session* sess = _h2_session.exchange(nullptr,
+                                             std::memory_order_acq_rel);
+  delete sess;
   auto* q = _fifo_q.exchange(nullptr, std::memory_order_acq_rel);
   if (q != nullptr) {
     // destroy(): the (possibly currently-running) drainer consumes every
@@ -705,6 +710,34 @@ void Socket::DispatchMessages() {
       // false: body untouched, fall through to the generic path
     }
   generic_delivery:
+    if (msg.kind == MSG_H2 && _opts.h2_native) {
+      // native h2 data plane: frames feed the in-socket session
+      // (framing/HPACK/flow control/gRPC dispatch in C++; Python is
+      // upcalled per message, not per frame)
+      h2::H2Session* sess = _h2_session.load(std::memory_order_relaxed);
+      if (sess == nullptr) {
+        sess = new h2::H2Session(_id);
+        _h2_session.store(sess, std::memory_order_release);
+      }
+      if (!sess->OnFrames(msg.meta.data(), msg.meta.size(), &msg.body)) {
+        BLOG(WARNING, "h2 session error on socket %llu, closing",
+             (unsigned long long)_id);
+        msg.body.clear();
+        // flush the batch NOW (it holds the session's GOAWAY): the
+        // guard's exit-path flush would be rejected once the socket is
+        // failed, and the peer would never learn why it died.  Clear
+        // the TLS batch pointers FIRST (the guard's order): Write's
+        // drain can re-enter dispatch-adjacent code that must not see
+        // a half-flushed batch as current.
+        tls_batch_socket = nullptr;
+        tls_batch_buf = nullptr;
+        if (!batch_out.empty()) Write(std::move(batch_out), true);
+        SetFailed(_id, EPROTO);
+        return;
+      }
+      msg.body.clear();
+      continue;
+    }
     if (_opts.on_message == nullptr) {
       msg.body.clear();
       continue;

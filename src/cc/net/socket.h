@@ -36,6 +36,9 @@ constexpr SocketId INVALID_SOCKET_ID = (SocketId)-1;
 
 class EventDispatcher;
 class Socket;
+namespace h2 {
+class H2Session;
+}
 
 // Complete-message callback.  kind: see parser.h MessageKind.
 // meta/meta_len: contiguous protocol meta bytes (frame header payload).
@@ -80,6 +83,10 @@ struct SocketOptions {
   // Opt in to native REQUEST dispatch via the MethodRegistry (server
   // sockets); off by default so raw-frame users see every message.
   bool enable_rpc_dispatch = false;
+  // Native h2/gRPC server data plane (net/h2.h): MSG_H2 frames feed an
+  // in-socket H2Session (framing, HPACK, flow control, gRPC dispatch in
+  // C++) instead of being delivered to on_message.  Server sockets only.
+  bool h2_native = false;
 };
 
 struct WriteRequest {
@@ -142,6 +149,12 @@ class Socket {
   int fd() const { return _fd; }
   SocketId id() const { return _id; }
   bool failed() const;
+  // The native h2 server session, if this socket has one (created by the
+  // dispatch thread on the first MSG_H2 frame when opts.h2_native).
+  // Callers must hold an Address() reference.
+  h2::H2Session* h2_session() const {
+    return _h2_session.load(std::memory_order_acquire);
+  }
 
   // stats (exported through bvar)
   int64_t bytes_read() const { return _nread.load(std::memory_order_relaxed); }
@@ -235,6 +248,10 @@ class Socket {
   std::atomic<int64_t> _fifo_pending_bytes{0};
 
   std::atomic<int64_t> _nread{0}, _nwritten{0}, _nmsg{0};
+  // Native h2 server session (opts.h2_native): created on the dispatch
+  // thread, read by response threads under an Address() reference,
+  // deleted at slot recycle (when no references can exist).
+  std::atomic<h2::H2Session*> _h2_session{nullptr};
   char _remote_ip[46] = {0};
   int _remote_port = 0;
 };

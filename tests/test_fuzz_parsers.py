@@ -178,12 +178,16 @@ def test_fuzz_h2_frames_at_server():
     try:
         for _ in range(20):
             c = socket.create_connection(("127.0.0.1", s.port))
-            c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
-            for _ in range(rng.randrange(1, 5)):
-                n = rng.randrange(0, 40)
-                hdr = bytes([0, 0, n, rng.randrange(12),
-                             rng.randrange(256)]) + rng.randbytes(4)
-                c.sendall(hdr + rng.randbytes(n))
+            try:
+                c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+                for _ in range(rng.randrange(1, 5)):
+                    n = rng.randrange(0, 40)
+                    hdr = bytes([0, 0, n, rng.randrange(12),
+                                 rng.randrange(256)]) + rng.randbytes(4)
+                    c.sendall(hdr + rng.randbytes(n))
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # the native session GOAWAYs + closes on a fatal
+                      # frame before we finish writing — correct behavior
             c.close()
         time.sleep(0.1)
         assert s.running

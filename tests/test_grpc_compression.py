@@ -269,8 +269,12 @@ def test_call_stream_opens_eagerly(echo_server):
 def test_stream_cap_rejects_excess_bidi(echo_server, monkeypatch):
     """A peer opening streams with cheap HEADERS frames hits the
     per-connection budget: excess bidi calls get RESOURCE_EXHAUSTED
-    instead of a new thread each (advisor r3 finding)."""
+    instead of a new thread each (advisor r3 finding).  Both h2 planes
+    carry the budget: the pure-Python connection class and the native
+    bridge (rpc/h2_native)."""
     monkeypatch.setattr(GrpcServerConnection, "max_streaming_calls", 2)
+    from brpc_tpu.rpc import h2_native
+    monkeypatch.setattr(h2_native, "MAX_STREAMING_CALLS", 2)
     ch = GrpcChannel(f"127.0.0.1:{echo_server.port}", timeout_ms=3000)
     calls = []
     try:

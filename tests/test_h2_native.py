@@ -1,0 +1,258 @@
+"""The NATIVE h2/gRPC data plane (src/cc/net/h2.{h,cc} + rpc/h2_native.py).
+
+The full gRPC matrix (tests/test_h2_grpc.py, test_grpc_compression.py)
+already runs against this plane — servers default to h2_native=True.
+These tests cover what the matrix can't see: the native/Python tier
+split, raw-frame protocol behavior (PING, GOAWAY on garbage), the
+opt-out fallback plane, and the native client pump.
+
+Reference: src/brpc/policy/http2_rpc_protocol.cpp (the native h2 slot
+this plane fills).
+"""
+import ctypes
+import socket
+import struct
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu._core.lib import core
+from brpc_tpu.rpc.h2 import GrpcChannel
+
+
+def _stats():
+    r = ctypes.c_int64()
+    s = ctypes.c_int64()
+    p = ctypes.c_int64()
+    core.brpc_h2_native_stats(ctypes.byref(r), ctypes.byref(s),
+                              ctypes.byref(p))
+    return r.value, s.value, p.value
+
+
+class _Echo(brpc.Service):
+    NAME = "nh2.Echo"
+
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return bytes(req)
+
+
+@pytest.fixture()
+def server():
+    s = brpc.Server()
+    s.add_service(_Echo())
+    s.start("127.0.0.1", 0)
+    yield s
+    s.stop()
+    s.join()
+
+
+def test_unary_rides_native_plane(server):
+    """A unary gRPC call costs exactly ONE python event (not ~6 frame
+    upcalls) and one native response pack."""
+    ch = GrpcChannel(f"127.0.0.1:{server.port}")
+    r0, s0, p0 = _stats()
+    for i in range(10):
+        assert ch.call("nh2.Echo", "Echo", b"m%d" % i) == b"m%d" % i
+    r1, s1, p1 = _stats()
+    assert p1 - p0 == 10          # one event per request
+    assert s1 - s0 == 10          # responses packed natively
+    ch.close()
+
+
+def test_pure_native_method_skips_python(server):
+    """A natively-registered method answers gRPC with ZERO Python per
+    request — the reference's native-handler path."""
+    core.brpc_bench_register_native_echo(b"nh2.Native", b"Echo", 1)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{server.port}")
+        r0, s0, p0 = _stats()
+        for i in range(10):
+            assert ch.call("nh2.Native", "Echo", b"x%d" % i) == b"x%d" % i
+        r1, s1, p1 = _stats()
+        assert r1 - r0 == 10      # native dispatches
+        assert p1 - p0 == 0       # python never ran
+        ch.close()
+    finally:
+        core.brpc_unregister_method(b"nh2.Native", b"Echo")
+
+
+def test_fallback_python_plane_still_serves():
+    """h2_native=False keeps the round-4 pure-Python plane working (the
+    TLS path depends on it)."""
+    s = brpc.Server(brpc.ServerOptions(h2_native=False))
+    s.add_service(_Echo())
+    s.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{s.port}")
+        r0, s0, p0 = _stats()
+        assert ch.call("nh2.Echo", "Echo", b"via-python") == b"via-python"
+        r1, s1, p1 = _stats()
+        assert (r1, s1, p1) == (r0, s0, p0)   # native plane untouched
+        ch.close()
+    finally:
+        s.stop()
+        s.join()
+
+
+def test_ping_gets_native_pong(server):
+    """PING is answered by the session without any Python."""
+    c = socket.create_connection(("127.0.0.1", server.port))
+    try:
+        c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+        # SETTINGS (empty) then PING
+        c.sendall(bytes([0, 0, 0, 0x4, 0]) + struct.pack(">I", 0))
+        payload = b"pingpong"
+        c.sendall(bytes([0, 0, 8, 0x6, 0]) + struct.pack(">I", 0) + payload)
+        c.settimeout(5)
+        buf = b""
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            buf += c.recv(4096)
+            # scan frames for PING ACK carrying our payload
+            off = 0
+            found = False
+            while off + 9 <= len(buf):
+                ln = (buf[off] << 16) | (buf[off + 1] << 8) | buf[off + 2]
+                ftype, flags = buf[off + 3], buf[off + 4]
+                if off + 9 + ln > len(buf):
+                    break
+                if ftype == 0x6 and (flags & 1) and \
+                        buf[off + 9:off + 9 + ln] == payload:
+                    found = True
+                    break
+                off += 9 + ln
+            if found:
+                break
+        assert found, "no PING ACK with our payload"
+    finally:
+        c.close()
+
+
+def test_garbage_after_preface_goaway_close(server):
+    """A fatally malformed frame draws GOAWAY and a close, and the
+    server keeps serving other connections."""
+    c = socket.create_connection(("127.0.0.1", server.port))
+    try:
+        c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+        # HEADERS on stream 0 is a connection error
+        c.sendall(bytes([0, 0, 3, 0x1, 0x4]) + struct.pack(">I", 0) +
+                  b"abc")
+        c.settimeout(5)
+        buf = b""
+        try:
+            while True:
+                got = c.recv(4096)
+                if not got:
+                    break
+                buf += got
+        except (socket.timeout, ConnectionResetError):
+            pass
+        # a GOAWAY frame (type 0x7) appears somewhere before the close
+        off = 0
+        saw_goaway = False
+        while off + 9 <= len(buf):
+            ln = (buf[off] << 16) | (buf[off + 1] << 8) | buf[off + 2]
+            if buf[off + 3] == 0x7:
+                saw_goaway = True
+            off += 9 + ln
+        assert saw_goaway
+    finally:
+        c.close()
+    # the listener is unaffected
+    ch = GrpcChannel(f"127.0.0.1:{server.port}")
+    assert ch.call("nh2.Echo", "Echo", b"still-up") == b"still-up"
+    ch.close()
+
+
+def test_native_pump_matches_channel_results(server):
+    """The C++ pump completes against both Python-bridge and native
+    methods with sane latency accounting."""
+    qps = ctypes.c_double()
+    p50 = ctypes.c_double()
+    p99 = ctypes.c_double()
+    rc = core.brpc_bench_pump_h2(server.port, b"/nh2.Echo/Echo", 2, 8,
+                                 2000, 64, ctypes.byref(qps),
+                                 ctypes.byref(p50), ctypes.byref(p99))
+    assert rc == 0
+    assert qps.value > 100
+    assert 0 < p50.value <= p99.value
+
+
+def test_native_session_frame_soup(server):
+    """Deep structured fuzz of the NATIVE session (mirror of the Python
+    plane's test_fuzz_h2_state_machine_deep, over a real socket): seeded
+    frame soup — real/mutated HPACK blocks, CONTINUATION misorder,
+    padding soup, SETTINGS churn, window manipulation, RST/PING/GOAWAY
+    storms.  Fatal connections must die with GOAWAY, the process must
+    never crash, and the server must keep serving."""
+    import random
+
+    from brpc_tpu.rpc.hpack import HpackEncoder
+
+    rng = random.Random(0xC0FFEE + 77)
+    enc = HpackEncoder()
+    hdr_block = enc.encode([(":method", "POST"), (":path", "/nh2.Echo/Echo"),
+                            ("content-type", "application/grpc"),
+                            ("x-filler", "v" * 40)])
+    for conn_i in range(30):
+        c = socket.create_connection(("127.0.0.1", server.port))
+        try:
+            c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            for _ in range(rng.randrange(2, 30)):
+                choice = rng.randrange(9)
+                sid = rng.choice((0, 1, 2, 3, 5, 7, 2**31 - 1))
+                flags = rng.randrange(256)
+                if choice == 0:
+                    block = bytearray(hdr_block)
+                    if rng.random() < 0.5 and block:
+                        block[rng.randrange(len(block))] ^= \
+                            1 << rng.randrange(8)
+                    payload = bytes(block[:rng.randrange(len(block) + 1)])
+                    ftype = 0x1
+                elif choice == 1:
+                    payload = bytes(
+                        hdr_block[rng.randrange(len(hdr_block)):])
+                    ftype = 0x9
+                elif choice == 2:
+                    payload = rng.randbytes(rng.randrange(0, 64))
+                    ftype = 0x0
+                elif choice == 3:
+                    n = rng.randrange(0, 4)
+                    payload = b"".join(
+                        struct.pack(">HI",
+                                    rng.choice((1, 2, 3, 4, 5, 6, 9)),
+                                    rng.randrange(0, 1 << 31))
+                        for _ in range(n))
+                    ftype = 0x4
+                    flags = 0 if rng.random() < 0.8 else 1
+                elif choice == 4:
+                    payload = rng.randbytes(4)
+                    ftype = 0x8
+                elif choice == 5:
+                    payload = rng.randbytes(rng.randrange(0, 8))
+                    ftype = 0x3
+                elif choice == 6:
+                    payload = rng.randbytes(8)
+                    ftype = 0x6
+                elif choice == 7:
+                    payload = rng.randbytes(rng.randrange(0, 16))
+                    ftype = 0x7
+                else:
+                    payload = rng.randbytes(rng.randrange(0, 16))
+                    ftype = rng.choice((0x2, 0x5, 0xA, 0xFF))
+                frame = (bytes([len(payload) >> 16,
+                                (len(payload) >> 8) & 0xFF,
+                                len(payload) & 0xFF, ftype, flags])
+                         + struct.pack(">I", sid) + payload)
+                try:
+                    c.sendall(frame)
+                except (BrokenPipeError, ConnectionResetError):
+                    break     # GOAWAY'd — correct fatal-frame behavior
+        finally:
+            c.close()
+    # the server survived 30 hostile connections and still serves
+    ch = GrpcChannel(f"127.0.0.1:{server.port}")
+    assert ch.call("nh2.Echo", "Echo", b"survived") == b"survived"
+    ch.close()

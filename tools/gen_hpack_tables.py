@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Regenerate src/cc/net/hpack_tables.inc from the canonical RFC 7541
+tables in brpc_tpu/rpc/hpack.py, so the native and Python HPACK codecs
+can never drift on the wire-spec constants."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from brpc_tpu.rpc.hpack import HUFFMAN_TABLE, STATIC_TABLE  # noqa: E402
+
+out = []
+out.append("// Generated from brpc_tpu/rpc/hpack.py (RFC 7541 Appendix A/B")
+out.append("// wire-spec constants).  Regenerate: python tools/gen_hpack_tables.py")
+out.append("static const StaticEntry kStaticTable[61] = {")
+for n, v in STATIC_TABLE:
+    out.append(f'    {{"{n}", "{v}"}},')
+out.append("};")
+out.append("")
+out.append("// symbol -> (code, bits); symbol 256 = EOS")
+out.append("static const HuffCode kHuffTable[257] = {")
+for code, bits in HUFFMAN_TABLE:
+    out.append(f"    {{0x{code:x}u, {bits}}},")
+out.append("};")
+
+path = os.path.join(os.path.dirname(__file__), "..", "src", "cc", "net",
+                    "hpack_tables.inc")
+with open(path, "w") as f:
+    f.write("\n".join(out) + "\n")
+print(f"wrote {path}")
