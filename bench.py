@@ -666,6 +666,13 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
             time.sleep(0.005)
         if _Sink.count == 0:
             return {"error": "warmup chunk never delivered"}
+        # warm the coalesced-dispatch programs: the stream sender batches
+        # adjacent writes into power-of-2 send_batch arities, each a
+        # distinct XLA program — compile them OUTSIDE the timed region
+        # (VERDICT r4 #1a: warm every arity before measuring)
+        for k in (2, 4, 8, 16, 32):
+            for tk in rail.ship_many([chunk] * k, dev):
+                rail.withdraw(tk)
         base, jitter = _readback_baseline(_Sink.last)
         warm = _Sink.count
         copy_sum = 0.0

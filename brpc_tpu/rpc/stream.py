@@ -109,6 +109,17 @@ class Stream:
         self._reorder: dict[int, bytes] = {}
         self._close_seq: Optional[int] = None
         self._delivering = False
+        # Tensor write coalescing: rail-bound writes go through a
+        # per-stream sender thread that drains its queue in batches, so N
+        # back-to-back stream.write(array) calls become ONE batched
+        # device dispatch (rail.ship_many) instead of N — on a tunneled
+        # chip each dispatch costs a host round-trip, which made
+        # per-message shipping the whole streaming-tensor cost.  Frames
+        # still go out one per message (the receiver's seq-reorder layer
+        # already tolerates any arrival order).
+        self._tq = None
+        self._tq_thread: Optional[threading.Thread] = None
+        self._tq_closing = False
 
     # ---- binding (the RPC established the host connection) ----
 
@@ -159,7 +170,7 @@ class Stream:
             arrays = data if isinstance(data, (list, tuple)) else [data]
             kind, payload = "tensor", data
             nbytes = sum(a.nbytes for a in arrays)
-        if self._closed:
+        if self._closed or self._close_sent:
             raise errors.RpcError(errors.EEOF, "stream closed")
         with self._window_cv:
             deadline = None
@@ -202,29 +213,71 @@ class Stream:
         (socket.cpp:1751-1757): with a reachable peer device the tensors
         move HBM→HBM through the rail and the socket frame carries only
         the claim ticket; otherwise the tensor serializer produces a host
-        fallback frame that still rebuilds arrays at the far end."""
+        fallback frame that still rebuilds arrays at the far end.
+
+        Rail-bound writes are queued to the per-stream sender thread so
+        adjacent messages share one batched dispatch (ship_many); the
+        no-device fallback serializes inline as before.  Enqueue order
+        vs the close sentinel is serialized under _mu: a write that loses
+        the race to close() sends inline instead of landing in a queue no
+        thread will drain."""
+        if self.peer_device is not None:
+            self._ensure_tensor_sender()
+            with self._mu:
+                closing = self._tq_closing
+                if not closing:
+                    self._tq.put((seq, obj))
+            if closing:
+                self._send_tensor_fallback(obj, seq)
+            return
+        self._send_tensor_fallback(obj, seq)
+
+    def _send_tensor_fallback(self, obj, seq: int) -> None:
+        """Host-serialized tensor frame — the no-reachable-device shape,
+        also the escape hatch when the rail or the sender queue is gone."""
         from brpc_tpu.ici import rail
+        rail.rail_fallbacks.add(1)
+        from brpc_tpu.rpc.serialization import get_serializer
         meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
                          stream_id=self.remote_id, stream_seq=seq)
-        body = b""
-        ticket = None
-        if self.peer_device is not None:
-            try:
-                ticket = rail.ship(obj, self.peer_device)
-            except Exception:
-                logging.exception("stream rail ship failed; host fallback")
-        if ticket is not None:
-            meta.user_fields[M.F_TICKET] = ticket
-            meta.user_fields[M.F_SRC_DEV] = str(rail.source_device(obj).id)
-        else:
-            rail.rail_fallbacks.add(1)
-            from brpc_tpu.rpc.serialization import get_serializer
-            body, meta.tensor_header = get_serializer("tensor").encode(obj)
+        body, meta.tensor_header = get_serializer("tensor").encode(obj)
         rc = Transport.instance().write_frame(self._sid, meta.encode(), body)
         if rc != 0:
-            if ticket is not None:
-                rail.withdraw(ticket)   # atomic pop: cannot double-free
             self._on_closed_internal()
+
+    def _ensure_tensor_sender(self) -> None:
+        if self._tq is None:
+            with self._mu:
+                if self._tq is None:
+                    import queue as _qm
+                    import weakref
+                    q = _qm.Queue()
+                    # the thread must NOT keep the Stream alive: it holds
+                    # only a weakref and exits when the stream is gone —
+                    # an abandoned stream (no close(), no peer CLOSE) must
+                    # stay garbage-collectable, not pin a thread forever
+                    t = threading.Thread(
+                        target=_tensor_send_loop,
+                        args=(weakref.ref(self), q),
+                        daemon=True, name=f"stream-tsend-{self.stream_id}")
+                    self._tq = q
+                    self._tq_thread = t
+                    t.start()
+
+    def _flush_tensor_sender(self) -> None:
+        """Drain queued tensor writes and stop the sender — close() must
+        not race CLOSE past data still sitting in the queue.  _tq_closing
+        is set under _mu BEFORE the sentinel goes in, so any concurrent
+        write either precedes the sentinel (flushed here) or observes
+        _tq_closing and sends inline."""
+        t = self._tq_thread
+        if t is None or t is threading.current_thread():
+            return
+        with self._mu:
+            self._tq_closing = True
+            self._tq.put(None)
+        t.join(timeout=30)
+        self._tq_thread = None
 
     # ---- receiver side ----
 
@@ -317,6 +370,8 @@ class Stream:
             already = self._closed
             self._closed = True
             self._window_cv.notify_all()
+        if not already and self._tq is not None:
+            self._tq.put(None)    # stop the tensor sender (it may be us)
         if not already and self.handler is not None:
             self.handler.on_closed(self)
         StreamRegistry.instance().remove(self.stream_id)
@@ -326,6 +381,7 @@ class Stream:
             if self._closed or self._close_sent:
                 return
             self._close_sent = True
+        self._flush_tensor_sender()
         if self._sid is not None and self.remote_id is not None:
             with self._mu:
                 seq = self._send_seq
@@ -336,6 +392,71 @@ class Stream:
                              stream_id=self.remote_id, stream_seq=seq)
             Transport.instance().write_frame(self._sid, meta.encode())
         self._on_closed_internal()
+
+
+def _tensor_send_loop(wref, q) -> None:
+    """Per-stream tensor sender (module-level: holds NO strong reference
+    to the Stream between batches).  Exits on the close sentinel, when
+    the stream dies, or when the weakref clears — whichever comes first."""
+    import queue as _qm
+    from brpc_tpu.ici import rail
+    while True:
+        try:
+            item = q.get(timeout=5.0)
+        except _qm.Empty:
+            s = wref()
+            if s is None or s._closed:
+                return
+            del s
+            continue
+        if item is None:
+            return
+        batch = [item]
+        stop = False
+        while True:
+            try:
+                nxt = q.get_nowait()
+            except _qm.Empty:
+                break
+            if nxt is None:
+                stop = True   # flush what's collected, then exit
+                break
+            batch.append(nxt)
+        s = wref()
+        if s is None or s._closed:
+            # stream gone / transport dead: nothing was shipped yet for
+            # this batch, so dropping it leaks no tickets
+            return
+        tickets = None
+        try:
+            tickets = rail.ship_many([obj for _, obj in batch],
+                                     s.peer_device)
+        except Exception:
+            logging.exception("stream rail ship failed; host fallback")
+        for k, (seq, obj) in enumerate(batch):
+            meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
+                             stream_id=s.remote_id, stream_seq=seq)
+            body = b""
+            if tickets is not None:
+                meta.user_fields[M.F_TICKET] = tickets[k]
+                meta.user_fields[M.F_SRC_DEV] = str(
+                    rail.source_device(obj).id)
+            else:
+                rail.rail_fallbacks.add(1)
+                from brpc_tpu.rpc.serialization import get_serializer
+                body, meta.tensor_header = \
+                    get_serializer("tensor").encode(obj)
+            rc = Transport.instance().write_frame(
+                s._sid, meta.encode(), body)
+            if rc != 0:
+                if tickets is not None:
+                    for t in tickets[k:]:   # atomic pops: no double-free
+                        rail.withdraw(t)
+                s._on_closed_internal()
+                return
+        if stop:
+            return
+        del s    # drop the strong ref while parked in q.get
 
 
 class StreamRegistry:

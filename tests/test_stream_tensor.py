@@ -171,6 +171,65 @@ def test_stream_tensor_host_fallback_without_device():
         srv.join()
 
 
+def test_stream_tensor_writes_coalesce_into_batched_ship(
+        tensor_stream_server, monkeypatch):
+    """Back-to-back tensor writes share one rail.ship_many call (one
+    batched device dispatch) instead of one per message.  The first
+    ship_many is stalled briefly so the remaining writes pile up in the
+    sender queue; they must then go out as a single batch, and delivery
+    order must survive the coalescing."""
+    import time as _time
+    srv, received = tensor_stream_server
+    calls = []
+    real = rail.ship_many
+
+    def slow_ship_many(objs, dev):
+        # count only client->server ships; the echo server's write-backs
+        # (target D0) ride the same function
+        if dev == D1:
+            calls.append(len(objs))
+            if len(calls) == 1:
+                _time.sleep(0.25)   # let the main thread queue the rest
+        return real(objs, dev)
+
+    monkeypatch.setattr(rail, "ship_many", slow_ship_many)
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    cntl = brpc.Controller()
+    stream = brpc.stream_create(cntl, None, device=D0)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    arrays = [_arr(D0, i * 10) for i in range(8)]
+    before = rail.host_copy_count()
+    for a in arrays:
+        stream.write(a)
+    assert _wait(lambda: len(received) == 8)
+    # writes 2..8 queued behind the stalled first ship -> at most 2 calls
+    assert len(calls) <= 2 and sum(calls) == 8
+    for sent, seen in zip(arrays, received):
+        np.testing.assert_array_equal(np.asarray(seen), np.asarray(sent))
+    assert rail.host_copy_count() == before
+    stream.close()
+
+
+def test_stream_close_flushes_queued_tensor_writes(tensor_stream_server):
+    """close() drains the tensor sender queue before the CLOSE frame's
+    semantics take effect: every write issued before close() is
+    delivered."""
+    srv, received = tensor_stream_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    cntl = brpc.Controller()
+    stream = brpc.stream_create(cntl, None, device=D0)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    arrays = [_arr(D0, i) for i in range(16)]
+    for a in arrays:
+        stream.write(a)
+    stream.close()                       # immediately, no settle wait
+    assert _wait(lambda: len(received) == 16)
+    for sent, seen in zip(arrays, received):
+        np.testing.assert_array_equal(np.asarray(seen), np.asarray(sent))
+
+
 def test_stream_close_releases_unclaimed_tickets(tensor_stream_server):
     """A tensor DATA frame landing on a dead stream withdraws its ticket
     instead of pinning HBM blocks until the TTL sweeper."""
