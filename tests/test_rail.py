@@ -197,3 +197,60 @@ def test_timeout_withdraws_staged_payload():
     finally:
         s.stop()
         s.join()
+
+
+def test_ship_many_mixed_oversize_and_small(monkeypatch):
+    """ship_many with payloads straddling the endpoint window: oversize
+    arrays ride the block pipe, small ones share batched direct sends,
+    and every payload still gets its own claimable ticket with values
+    and order intact."""
+    from brpc_tpu.ici import rail as r
+    dev = jax.devices()[1]
+    src = jax.devices()[0]
+    # shrink the endpoint window so a modest array counts as oversize —
+    # but keep it >= the block pool's largest class (2MB), the block
+    # pipe's minimum transfer unit
+    ep = r._endpoint_for(dev)
+    monkeypatch.setattr(ep, "window_bytes", 4 * 1024 * 1024)
+    small = [jax.device_put(jnp.full((128,), i, jnp.float32), src)
+             for i in range(5)]
+    big = jax.device_put(jnp.arange(2 * 1024 * 1024, dtype=jnp.float32),
+                         src)                      # 8MB > 4MB window
+    payloads = [small[0], small[1], big, small[2],
+                [small[3], small[4]]]          # list payload stays a list
+    tickets = r.ship_many(payloads, dev)
+    assert len(tickets) == len(payloads)
+    out = [r.claim(t) for t in tickets]
+    for i in (0, 1, 3):
+        np.testing.assert_array_equal(
+            np.asarray(out[i]), np.asarray(payloads[i]))
+        assert next(iter(out[i].devices())) == dev
+    np.testing.assert_array_equal(np.asarray(out[2]), np.asarray(big))
+    assert isinstance(out[4], list) and len(out[4]) == 2
+    np.testing.assert_array_equal(np.asarray(out[4][1]),
+                                  np.asarray(small[4]))
+
+
+def test_ship_many_power_of_two_decomposition(monkeypatch):
+    """A 27-message batch dispatches as 16+8+2+1 (bounded arity set), and
+    a batch above the cap never exceeds _MAX_ARITY per dispatch."""
+    from brpc_tpu.ici import rail as r
+    dev = jax.devices()[1]
+    src = jax.devices()[0]
+    ep = r._endpoint_for(dev)
+    sizes = []
+    real = ep.send_batch
+
+    def spy(arrays, timeout_s=30.0):
+        sizes.append(len(list(arrays)))
+        return real(arrays, timeout_s=timeout_s)
+
+    monkeypatch.setattr(ep, "send_batch", spy)
+    arrs = [jax.device_put(jnp.full((64,), i, jnp.float32), src)
+            for i in range(27)]
+    tickets = r.ship_many(arrs, dev)
+    assert sizes == [16, 8, 2]       # + one single-array ep.send for the 1
+    out = [r.claim(t) for t in tickets]
+    for i, o in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(arrs[i]))
+    assert all(s <= r._MAX_ARITY for s in sizes)

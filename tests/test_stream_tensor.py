@@ -243,3 +243,63 @@ def test_stream_close_releases_unclaimed_tickets(tensor_stream_server):
     assert _wait(lambda: len(received) == 1)
     stream.close()
     assert _wait(lambda: rail.pending_tickets() == 0, timeout=5)
+
+
+def test_stream_write_after_close_raises(tensor_stream_server):
+    srv, received = tensor_stream_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+    cntl = brpc.Controller()
+    stream = brpc.stream_create(cntl, None, device=D0)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    stream.write(_arr(D0, 1))
+    stream.close()
+    with pytest.raises(errors.RpcError):
+        stream.write(_arr(D0, 2))
+    with pytest.raises(errors.RpcError):
+        stream.write(b"bytes-after-close")
+
+
+def test_abandoned_stream_sender_thread_exits():
+    """A stream dropped without close() must not pin its sender thread
+    (or itself) forever: the sender holds only a weakref and exits once
+    the stream is collected."""
+    import gc
+    import weakref
+
+    received = []
+
+    class AbandonSvc(brpc.Service):
+        NAME = "AbandonSvc"
+
+        @brpc.method(request="json", response="json")
+        def Open(self, cntl, req):
+            cntl.accept_stream(lambda s, p: received.append(p), device=D1)
+            return {"ok": True}
+
+    srv = brpc.Server(brpc.ServerOptions(ici_device=D1))
+    srv.add_service(AbandonSvc())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        cntl = brpc.Controller()
+        stream = brpc.stream_create(cntl, None, device=D0)
+        ch.call_sync("AbandonSvc", "Open", {}, serializer="json",
+                     cntl=cntl)
+        stream.write(_arr(D0, 3))          # starts the sender thread
+        assert _wait(lambda: len(received) == 1)
+        t = stream._tq_thread
+        assert t is not None and t.is_alive()
+        # abandon: deregister + drop every strong ref, no close()
+        from brpc_tpu.rpc.stream import StreamRegistry
+        StreamRegistry.instance().remove(stream.stream_id)
+        cntl._stream = None
+        wref = weakref.ref(stream)
+        del stream
+        gc.collect()
+        assert wref() is None, "sender thread kept the stream alive"
+        # the weakref-holding sender notices within its 5s idle poll
+        assert _wait(lambda: not t.is_alive(), timeout=8)
+    finally:
+        srv.stop()
+        srv.join()
