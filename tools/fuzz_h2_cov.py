@@ -200,6 +200,17 @@ def seeds() -> list[bytes]:
                                b"\x04" + block + b"\x00" * 4))  # PADDED
     out.append(h2m.build_frame(h2m.RST_STREAM, 0, 1, struct.pack(">I", 8))
                + h2m.build_frame(h2m.GOAWAY, 0, 0, struct.pack(">II", 0, 2)))
+    # evolved corpus from past campaigns (tests/fuzz_corpus/h2): inputs
+    # that earned their place by lighting up new coverage — checked in
+    # like the reference's OSS-Fuzz corpora so every later campaign and
+    # the CI replay start from the deepest known frontier
+    cdir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "tests", "fuzz_corpus", "h2")
+    if os.path.isdir(cdir):
+        for name in sorted(os.listdir(cdir)):
+            if name.endswith(".bin"):
+                with open(os.path.join(cdir, name), "rb") as f:
+                    out.append(f.read())
     return out
 
 
