@@ -613,7 +613,7 @@ def bench_tensor_pipe(chunk_mb=64, iter_chunks=80, max_total_gb=96):
             **({"invalid": issues} if issues else {})}
 
 
-def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
+def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=32):
     """Unified StreamWrite carrying device tensors (VERDICT r3 #1): a
     REAL loopback RPC server accepts a stream on the chip, the client's
     stream.write() pushes device arrays, and each chunk rides the rail
@@ -645,7 +645,11 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
     class StreamSink(brpc.Service):
         @brpc.method(request="json", response="json")
         def Open(self, cntl, req):
-            cntl.accept_stream(on_msg, max_buf_size=256 << 20, device=dev)
+            # 1GB window: the stream credit loop prices its releases at a
+            # delivery round-trip, so the window must cover the link's
+            # bandwidth-delay product or the writer stalls once per batch
+            # (measured: 256MB capped the rung at 2 GB/s on a 64ms tunnel)
+            cntl.accept_stream(on_msg, max_buf_size=1 << 30, device=dev)
             return {"ok": True}
 
     server = brpc.Server(brpc.ServerOptions(ici_device=dev))
@@ -653,7 +657,7 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
     server.start("127.0.0.1", 0)
     ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=120000)
     cntl = brpc.Controller()
-    stream = brpc.stream_create(cntl, None, max_buf_size=256 << 20,
+    stream = brpc.stream_create(cntl, None, max_buf_size=1 << 30,
                                 device=dev)
     issues = []
     try:
@@ -675,26 +679,29 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
                 rail.withdraw(tk)
         base, jitter = _readback_baseline(_Sink.last)
         warm = _Sink.count
-        copy_sum = 0.0
         moved = 0
         iters = 0
         max_total = max_total_gb << 30
+        # ONE timed region, ONE readback fence at the very end.  A fence
+        # per batch made the confidence floor scale as jitter*sqrt(iters),
+        # which a fast chip behind a noisy tunnel can never outrun (r5 dev
+        # session: 1.4ms of copy per batch vs a 30ms tunnel hiccup
+        # spread).  Between batches, delivery is confirmed by the
+        # framework's own CONSUMED feedback (_Sink.count) — part of the
+        # path being measured — and the elapsed check needs no fence.
+        # at least 1s of timed streaming: the tunnel's throughput drifts
+        # phase-to-phase (measured 10 vs 18 GB/s on back-to-back 0.2s
+        # windows), and a longer region averages across phases as well as
+        # clearing the jitter-confidence floor
+        floor = max(1.0, 4 * jitter)
+        t0 = time.perf_counter()
         while True:
-            want = warm + iters * iter_chunks
-            deadline = time.monotonic() + 120
-            while _Sink.count < want and time.monotonic() < deadline:
-                time.sleep(0.002)
-            if _Sink.count < want:
-                issues.append(
-                    f"stream wedged: {_Sink.count - warm} of "
-                    f"{want - warm} chunks delivered after 120s")
-                break
-            t0 = time.perf_counter()
             for _ in range(iter_chunks):
                 stream.write(chunk, timeout_s=120)
             # completion = delivery through the whole framework path
+            want = warm + (iters + 1) * iter_chunks
             wedged = False
-            while _Sink.count < want + iter_chunks:
+            while _Sink.count < want:
                 if time.perf_counter() - t0 > 120:
                     wedged = True
                     break
@@ -704,21 +711,26 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=16):
                 # crediting its bytes would publish a bogus valid number
                 issues.append(
                     f"stream wedged mid-batch: "
-                    f"{_Sink.count - want}/{iter_chunks} delivered")
+                    f"{_Sink.count - warm - iters * iter_chunks}"
+                    f"/{iter_chunks} delivered")
                 break
-            _readback_sync(_Sink.last)
-            wall = time.perf_counter() - t0
-            copy_sum += wall - base
             moved += iter_chunks * chunk.nbytes
             iters += 1
-            floor = max(0.010, 4 * jitter * math.sqrt(iters))
-            if copy_sum >= floor:
+            if time.perf_counter() - t0 - base >= floor:
                 break
             if moved >= max_total:
-                issues.append(
-                    f"copy phase {copy_sum * 1e3:.1f}ms not resolvable "
-                    f"above jitter ({jitter * 1e3:.1f}ms, {iters} iters)")
+                # byte cap first: fine (a fast link outruns the 1s
+                # drift-averaging target) UNLESS the phase is still inside
+                # the jitter-confidence floor — then the number is noise
+                if time.perf_counter() - t0 - base < max(0.010, 4 * jitter):
+                    issues.append(
+                        f"copy phase {time.perf_counter() - t0 - base:.4f}s "
+                        f"not resolvable above jitter "
+                        f"({jitter * 1e3:.1f}ms, {iters} iters)")
                 break
+        if not any("wedged" in i for i in issues):
+            _readback_sync(_Sink.last)
+        copy_sum = time.perf_counter() - t0 - base
         host_copies = rail.host_copy_count() - host_copies0
         if host_copies:
             issues.append(f"{host_copies} host copies on the tensor path")

@@ -315,9 +315,48 @@ _endpoints: dict[int, IciEndpoint] = {}
 # Rail endpoints get a wider credit window than the 64MB transport
 # default: stream writers burst whole messages (the streaming bench's
 # batch is 128MB), and releasing credit costs a completion sync — a full
-# tunnel RTT on axon.  256MB in-flight (+ destinations) is comfortable
-# on a 16GB chip and lets a burst land with zero mid-batch stalls.
-_RAIL_WINDOW_BYTES = 256 * 1024 * 1024
+# tunnel RTT on axon.  The window is BANDWIDTH-DELAY sized per device
+# (the rdma_endpoint.h:235-240 SQ/window discipline, solved the way TCP
+# solves it): only `window` bytes can be in flight during the RTT it
+# takes to observe a completion, so steady-state throughput is capped at
+# window/RTT.  A fixed 256MB window on a 64ms tunnel caps the rail at
+# 4 GB/s while the same chip streams 30+; sizing the window to
+# measured_rtt x target bandwidth restores the ceiling, and the floor/cap
+# keep HBM pinning bounded on well-connected (rtt~us) and pathological
+# links alike.
+_RAIL_WINDOW_FLOOR = 256 * 1024 * 1024
+_RAIL_WINDOW_CAP = 2 * 1024 * 1024 * 1024
+_RAIL_TARGET_BW = 32e9  # bytes/s the BDP sizing budgets for
+
+
+def _completion_rtt(device) -> float:
+    """Median seconds to dispatch a tiny same-device copy and observe its
+    completion — the credit-release cost the BDP window must cover.  On
+    directly attached hardware this is ~us; over a tunneled runtime it is
+    a network RTT."""
+    import jax.numpy as jnp
+    with jax.default_device(device):
+        x = jnp.zeros((256,), jnp.uint8)
+    x.block_until_ready()
+    samples = []
+    for _ in range(3):
+        t0 = time.monotonic()
+        _device_copy_probe(x).block_until_ready()
+        samples.append(time.monotonic() - t0)
+    samples.sort()
+    return samples[len(samples) // 2]
+
+
+_device_copy_probe = jax.jit(lambda x: x + np.uint8(0))
+
+
+def _window_for(device) -> int:
+    try:
+        rtt = _completion_rtt(device)
+    except Exception:
+        return _RAIL_WINDOW_FLOOR
+    return int(min(max(_RAIL_WINDOW_FLOOR, rtt * _RAIL_TARGET_BW),
+                   _RAIL_WINDOW_CAP))
 
 # Largest send_batch arity ship_many will emit: bounds both the XLA
 # program cache (log2 entries per chunk shape) and single-program size.
@@ -327,10 +366,47 @@ _MAX_ARITY = 32
 def _endpoint_for(device) -> IciEndpoint:
     with _ep_lock:
         ep = _endpoints.get(device.id)
-        if ep is None:
-            ep = IciEndpoint(device, window_bytes=_RAIL_WINDOW_BYTES)
-            _endpoints[device.id] = ep
+    if ep is not None:
         return ep
+    # probe OUTSIDE the lock: the RTT measurement blocks on the device
+    # (3 round-trips + a possible first-call compile, ~200ms+ over a
+    # tunnel) and must not serialize endpoint creation for OTHER devices
+    window = _window_for(device)
+    with _ep_lock:
+        ep = _endpoints.get(device.id)   # double-checked: lost race reuses
+        if ep is None:
+            ep = IciEndpoint(device, window_bytes=window)
+            _endpoints[device.id] = ep
+            _ensure_atexit()
+        return ep
+
+
+_atexit_registered = False
+
+
+def _ensure_atexit() -> None:
+    """Join every rail drainer before the interpreter finalizes.  A daemon
+    drainer killed at exit while inside PJRT block_until_ready aborts the
+    whole process ('FATAL: exception not rethrown' on axon) — which would
+    turn a clean bench/driver run into a nonzero exit AFTER the results
+    printed."""
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    _atexit_registered = True
+    import atexit
+
+    def _close_endpoints():
+        with _ep_lock:
+            eps = list(_endpoints.values())
+            _endpoints.clear()
+        for ep in eps:
+            try:
+                ep.close(join=True)
+            except Exception:
+                pass
+
+    atexit.register(_close_endpoints)
 
 
 def ship(obj, target_device) -> str:

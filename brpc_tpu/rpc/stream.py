@@ -20,6 +20,15 @@ carries only a claim ticket — CONSUMED feedback stays on the host socket
 either way, and `rail.host_copy_count()` proves the zero-copy path.  A
 peer without a reachable device gets the tensor-serializer fallback
 (host bytes, still arrays at the far end).
+
+Sizing max_buf_size: the window is a bandwidth-delay product.  Credit
+releases cost one delivery round-trip (DATA frame -> claim -> handler ->
+CONSUMED), so sustained throughput is capped at max_buf_size / RTT —
+size the window to target_bandwidth x link RTT.  On a directly attached
+chip the RTT is ~us and the default is generous; over a tunneled or DCN
+link (tens of ms) a 256MB window caps the pipe at single-digit GB/s
+while 1GB restores it (measured on the r5 dev tunnel: 2 -> 34 GB/s).
+The rail's own credit window self-sizes the same way (rail._window_for).
 """
 from __future__ import annotations
 

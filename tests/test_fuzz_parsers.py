@@ -415,9 +415,17 @@ def test_fuzz_h2_coverage_guided():
                       "fuzz_h2_cov.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    r = mod.fuzz(6000, seed=SEED, log=lambda *a: None)
+    # growth is judged from the SYNTHETIC seeds only: the 41 checked-in
+    # evolved entries already saturate the short slice's reachable
+    # frontier, so growth on top of them is not guaranteed — and a fixed
+    # ">5" against the full corpus would pass trivially even with the
+    # coverage feedback broken
+    n_seeds = len(mod.seeds(base_only=True))
+    r = mod.fuzz(6000, seed=SEED, log=lambda *a: None,
+                 base_seeds_only=True)
     assert not r["crashes"], r["crashes"]
-    assert r["corpus_size"] > 5, "coverage feedback never grew the corpus"
+    assert r["corpus_size"] > n_seeds, \
+        "coverage feedback never grew the corpus"
     assert r["covered_lines"] > 150
 
 
