@@ -12,6 +12,7 @@ this plane fills).
 import ctypes
 import socket
 import struct
+import threading
 import time
 
 import pytest
@@ -256,3 +257,81 @@ def test_native_session_frame_soup(server):
     ch = GrpcChannel(f"127.0.0.1:{server.port}")
     assert ch.call("nh2.Echo", "Echo", b"survived") == b"survived"
     ch.close()
+
+
+def test_bidi_deadline_enforced_serverside():
+    """A bidi handler parked on its request iterator must be unparked by
+    the grpc-timeout deadline (h2_native request_iter's timed get): the
+    call fails DEADLINE_EXCEEDED instead of pinning the handler thread
+    until the client goes away."""
+    entered = threading.Event()
+
+    class Chat(brpc.Service):
+        NAME = "nh2.DeadlineChat"
+
+        @brpc.method(request="raw", response="raw")
+        def Talk(self, cntl, req_iter):
+            entered.set()
+            for _ in req_iter:      # client never sends END: parks here
+                pass
+            return b"drained"
+
+    s = brpc.Server()
+    s.add_service(Chat())
+    s.start("127.0.0.1", 0)
+    ch = GrpcChannel(f"127.0.0.1:{s.port}", timeout_ms=10000)
+    try:
+        call = ch.call_bidi("nh2.DeadlineChat", "Talk",
+                            metadata=[("grpc-timeout", "200m")])
+        call.send(b"hello")          # open the stream, then go silent
+        assert entered.wait(5), "handler never dispatched"
+        t0 = time.monotonic()
+        with pytest.raises(Exception) as ei:
+            next(call)
+        # the SERVER's deadline fired (well before the 10s client
+        # timeout) and surfaced as a grpc error, not a client timeout
+        assert time.monotonic() - t0 < 5
+        assert "deadline" in str(ei.value).lower()
+    finally:
+        ch.close()
+        s.stop()
+        s.join()
+
+
+def test_connection_loss_unparks_bidi_handler():
+    """Killing the connection under a parked bidi handler must feed the
+    request iterator an error (bridge on_connection_failed) — the
+    handler thread exits instead of leaking parked forever."""
+    entered = threading.Event()
+    released = threading.Event()
+
+    class Park(brpc.Service):
+        NAME = "nh2.Park"
+
+        @brpc.method(request="raw", response="raw")
+        def Hold(self, cntl, req_iter):
+            entered.set()
+            try:
+                for _ in req_iter:
+                    pass
+            except Exception:
+                released.set()
+                raise
+            released.set()
+            return b"ok"
+
+    s = brpc.Server()
+    s.add_service(Park())
+    s.start("127.0.0.1", 0)
+    ch = GrpcChannel(f"127.0.0.1:{s.port}", timeout_ms=30000)
+    try:
+        call = ch.call_bidi("nh2.Park", "Hold")
+        call.send(b"x")
+        assert entered.wait(5), "handler never dispatched"
+        assert not released.is_set()
+        ch.close()                   # connection dies under the handler
+        assert released.wait(10), \
+            "bidi handler still parked after connection loss"
+    finally:
+        s.stop()
+        s.join()
