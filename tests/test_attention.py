@@ -80,6 +80,30 @@ def test_flash_attention_matches_full():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_flash_attention_causal_matches_full():
+    """The causal kernel cuts the K-block loop at each q block's
+    diagonal (trip count depends on program_id) and position-masks the
+    straddling block; it must match the masked reference exactly —
+    including q rows in the FIRST block, whose only visible key is the
+    diagonal."""
+    q, k, v = _qkv(seed=6)
+    ref = local_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, blk_q=64, blk_k=64, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_causal_uneven_blocks():
+    """blk_q != blk_k exercises diagonal blocks that straddle unevenly
+    (the trip-count formula's rounding); both orderings must match."""
+    q, k, v = _qkv(seed=7)
+    ref = local_attention(q, k, v, causal=True)
+    for bq, bk in ((32, 64), (64, 32)):
+        out = flash_attention(q, k, v, blk_q=bq, blk_k=bk, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
 def test_ring_attention_bf16():
     q, k, v = _qkv(seed=5, dtype=jnp.bfloat16)
     ref = local_attention(q.astype(jnp.float32), k.astype(jnp.float32),
