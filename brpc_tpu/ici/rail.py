@@ -261,11 +261,14 @@ def _ensure_sweeper() -> None:
 
 
 def deposit(entries: list, single: bool) -> str:
+    # TTL purging belongs to the sweeper thread alone: purging inline
+    # here scanned the WHOLE registry under the lock on every deposit —
+    # O(pending) per message, measured at ~19us/msg with 2k outstanding
+    # stream chunks (a quadratic drag exactly when streaming is busiest)
     ticket = f"t{next(_ticket_counter)}"
-    now = time.monotonic()
     with _reg_lock:
-        _purge_locked(now)
-        _registry[ticket] = (entries, single, now + _REGISTRY_TTL_S)
+        _registry[ticket] = (entries, single,
+                             time.monotonic() + _REGISTRY_TTL_S)
     _ensure_sweeper()
     return ticket
 
@@ -428,18 +431,21 @@ def ship_many(objs, target_device) -> list[str]:
     round-trip this is the difference between per-message and per-batch
     transfer cost (the h2 frame-coalescing story, applied to tensors)."""
     ep = _endpoint_for(target_device)
-    flat: list[tuple[int, jax.Array]] = []    # (payload idx, array)
+    # (payload idx, array, nbytes): jax.Array.nbytes is a COMPUTED
+    # property (prod(shape) * itemsize per access) — cache it once per
+    # array; the run-packing loop below reads it repeatedly
+    flat: list[tuple[int, jax.Array, int]] = []
     singles = []
     for oi, obj in enumerate(objs):
         singles.append(not isinstance(obj, (list, tuple)))
         for a in (obj if isinstance(obj, (list, tuple)) else [obj]):
-            flat.append((oi, a))
+            flat.append((oi, a, a.nbytes))
     per_obj: list[list] = [[] for _ in objs]
     try:
         i = 0
         while i < len(flat):
-            oi, a = flat[i]
-            if a.nbytes > ep.window_bytes:
+            oi, a, a_nbytes = flat[i]
+            if a_nbytes > ep.window_bytes:
                 # oversize payloads still ride the block pipe so the
                 # credit window keeps bounding in-flight HBM per chunk
                 src_pool = get_block_pool(source_device(a))
@@ -450,21 +456,21 @@ def ship_many(objs, target_device) -> list[str]:
                     for b in staged:
                         b.free()
                 per_obj[oi].append(_Entry(moved, str(np.dtype(a.dtype)),
-                                          tuple(a.shape), a.nbytes))
-                rail_bytes.add(a.nbytes)
+                                          tuple(a.shape), a_nbytes))
+                rail_bytes.add(a_nbytes)
                 i += 1
                 continue
             # whole-array fast path: group a window-fitting run of arrays
             # into ONE batched dispatch (send_batch compiles k copy HLOs
             # into one program); the moved arrays are the deliverables
             run = [flat[i]]
-            run_bytes = a.nbytes
+            run_bytes = a_nbytes
             while (i + len(run) < len(flat)
-                   and flat[i + len(run)][1].nbytes <= ep.window_bytes
-                   and run_bytes + flat[i + len(run)][1].nbytes
+                   and flat[i + len(run)][2] <= ep.window_bytes
+                   and run_bytes + flat[i + len(run)][2]
                        <= ep.window_bytes):
                 run.append(flat[i + len(run)])
-                run_bytes += run[-1][1].nbytes
+                run_bytes += run[-1][2]
             # Power-of-2 sub-batches: send_batch compiles one XLA program
             # per (arity, shapes), and adaptive coalescing would otherwise
             # produce an unbounded set of arities — every new one a fresh
@@ -475,13 +481,13 @@ def ship_many(objs, target_device) -> list[str]:
             j = 0
             while j < len(run):
                 k = min(1 << ((len(run) - j).bit_length() - 1), _MAX_ARITY)
-                sub = [x for _, x in run[j:j + k]]
+                sub = [x for _, x, _ in run[j:j + k]]
                 moved_run.extend(ep.send_batch(sub) if k > 1
                                  else [ep.send(sub[0])])
                 j += k
-            for (roi, src), m in zip(run, moved_run):
-                per_obj[roi].append(_DirectEntry(m, src.nbytes))
-                rail_bytes.add(src.nbytes)
+            for (roi, _, src_nb), m in zip(run, moved_run):
+                per_obj[roi].append(_DirectEntry(m, src_nb))
+                rail_bytes.add(src_nb)
             i += len(run)
     except Exception:
         for es in per_obj:
