@@ -259,6 +259,33 @@ def test_native_session_frame_soup(server):
     ch.close()
 
 
+def test_lean_pool_survives_base_exceptions():
+    """The gRPC dispatch pool's workers are never replaced, so a task
+    raising SystemExit (sys.exit in a handler) must not kill them —
+    32 such tasks would otherwise empty the pool and hang every later
+    request silently."""
+    from brpc_tpu.rpc.h2 import _LeanPool
+
+    pool = _LeanPool(2, "lean-test")
+    ran = []
+    done = threading.Event()
+
+    def bad():
+        raise SystemExit(1)
+
+    def good(i):
+        ran.append(i)
+        if len(ran) >= 8:
+            done.set()
+
+    for _ in range(4):          # more BaseExceptions than workers
+        pool.submit(bad)
+    for i in range(8):
+        pool.submit(good, i)
+    assert done.wait(5), f"only {len(ran)} tasks ran after SystemExits"
+    assert sorted(ran) == list(range(8))
+
+
 def test_bidi_deadline_enforced_serverside():
     """A bidi handler parked on its request iterator must be unparked by
     the grpc-timeout deadline (h2_native request_iter's timed get): the
