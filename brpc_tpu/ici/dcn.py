@@ -167,10 +167,10 @@ def offer(arrays: list) -> tuple[int, list[dict]]:
     assert s is not None
     ticket = next(_ticket_counter)
     s.await_pull(ticket, list(arrays))
-    now = time.monotonic()
+    # TTL purging belongs to the sweeper alone (same O(pending)-scan
+    # reasoning as rail.deposit)
     with _offers_mu:
-        _purge_offers_locked(now)
-        _offers[ticket] = (list(arrays), now + _OFFER_TTL_S)
+        _offers[ticket] = (list(arrays), time.monotonic() + _OFFER_TTL_S)
     _ensure_sweeper()
     return ticket, [{"shape": list(a.shape), "dtype": str(np.dtype(a.dtype))}
                     for a in arrays]
