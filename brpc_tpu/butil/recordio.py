@@ -41,8 +41,17 @@ class RecordReader:
 
     def read(self) -> Optional[tuple[bytes, bytes]]:
         """Returns (meta, body) or None at EOF.  Corrupt records are
-        skipped by scanning forward to the next magic."""
+        skipped by scanning forward to the next magic.
+
+        A damaged record must lose ONLY itself: if its length fields are
+        the corrupted part, trusting them would either swallow the next
+        record (crc fails, but the file position is already past it) or
+        hit EOF and drop everything after the damage.  So any failed
+        record rewinds to just past its own magic and rescans — the scan
+        lands on the NEXT record's magic (fuzz-proven in
+        test_fuzz_recordio_reader_recovers)."""
         while True:
+            start = self._fp.tell()
             hdr = self._fp.read(_HDR.size)
             if len(hdr) < _HDR.size:
                 return None
@@ -52,20 +61,64 @@ class RecordReader:
                 if not self._resync(hdr):
                     return None
                 continue
-            meta = self._fp.read(meta_len)
-            body = self._fp.read(body_len)
+            try:
+                meta = self._fp.read(meta_len)
+                body = self._fp.read(body_len)
+            except (OverflowError, MemoryError):
+                # a corrupted u64 length can exceed Py_ssize_t: damage,
+                # not a record (found by the recordio fuzz target)
+                if not self._recover(start):
+                    return None
+                continue
             if len(meta) < meta_len or len(body) < body_len:
-                return None  # truncated tail
+                # short read: EITHER a truncated tail or a lying length —
+                # rescan past this magic; a true tail yields no further
+                # magic and ends the stream
+                if not self._recover(start):
+                    return None
+                continue
             got = zlib.crc32(meta) & 0xFFFFFFFF
             got = zlib.crc32(body, got) & 0xFFFFFFFF
             if got != crc:
-                continue  # damaged record — drop it, keep reading
-            return meta, body
+                # Damaged record.  If the frame still LINES UP (the next
+                # bytes are a magic, or this was the last record), the
+                # lengths were intact and the damage is body bit-rot:
+                # trust them and skip in O(1).  Rescanning from inside a
+                # well-framed record would let MAGIC bytes embedded in
+                # its payload (rpc_dump bodies are raw network bytes)
+                # surface as a fabricated top-level record.  Only when
+                # the frame does NOT line up — the lengths themselves are
+                # the damage — rewind past this magic and rescan.
+                nxt = self._fp.read(len(MAGIC))
+                if nxt == MAGIC:
+                    self._fp.seek(-len(MAGIC), 1)
+                    continue
+                if nxt == b"":          # damaged record was the tail
+                    return None
+                if not self._recover(start):
+                    return None
+            else:
+                return meta, body
+
+    def _recover(self, start: int) -> bool:
+        """Shared damaged-record recovery: rewind to just past the failed
+        record's magic and scan for the next one, so a record whose
+        LENGTH fields are the corrupted part loses only itself (trusting
+        a lying length would swallow the following record, or hit EOF
+        and drop everything after the damage)."""
+        self._fp.seek(start + len(MAGIC))
+        return self._resync(b"")
 
     def _resync(self, tail: bytes) -> bool:
+        """Scan forward for the next magic.  Every caller guarantees the
+        scan cannot re-find the record it just failed on: the bad-header
+        path's `tail` does not begin with MAGIC (that's why it's here),
+        and the damaged-record paths seek past their own magic before
+        calling.  Scanning from 0 also catches a magic that STARTS in
+        the 3-byte carry spanning two chunk reads."""
         buf = tail
         while True:
-            idx = buf.find(MAGIC, 1)
+            idx = buf.find(MAGIC)
             if idx >= 0:
                 rest = buf[idx:]
                 # rewind so the next read starts at the magic
