@@ -442,27 +442,40 @@ def _tensor_send_loop(wref, q) -> None:
                                      s.peer_device)
         except Exception:
             logging.exception("stream rail ship failed; host fallback")
-        for k, (seq, obj) in enumerate(batch):
-            meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
-                             stream_id=s.remote_id, stream_seq=seq)
-            body = b""
-            if tickets is not None:
+        if tickets is not None:
+            # ticket frames are tiny (meta only, empty bodies): ship the
+            # whole batch as ONE socket write — one ctypes crossing and
+            # one write-stack push instead of len(batch), ordering
+            # preserved.  Tiny frames can never trip the per-write
+            # EOVERCROWDED bound the way coalesced big bodies would.
+            frames = []
+            for k, (seq, obj) in enumerate(batch):
+                meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
+                                 stream_id=s.remote_id, stream_seq=seq)
                 meta.user_fields[M.F_TICKET] = tickets[k]
                 meta.user_fields[M.F_SRC_DEV] = str(
                     rail.source_device(obj).id)
-            else:
-                rail.rail_fallbacks.add(1)
-                from brpc_tpu.rpc.serialization import get_serializer
-                body, meta.tensor_header = \
-                    get_serializer("tensor").encode(obj)
-            rc = Transport.instance().write_frame(
-                s._sid, meta.encode(), body)
-            if rc != 0:
-                if tickets is not None:
-                    for t in tickets[k:]:   # atomic pops: no double-free
-                        rail.withdraw(t)
+                frames.append((meta.encode(), b""))
+            if Transport.instance().write_frames(s._sid, frames) != 0:
+                for t in tickets:       # atomic pops: no double-free
+                    rail.withdraw(t)
                 s._on_closed_internal()
                 return
+        else:
+            # host fallback: bodies are full serialized tensors — write
+            # per frame so each passes the overcrowded bound on its own
+            # and no giant contiguous join is materialized
+            from brpc_tpu.rpc.serialization import get_serializer
+            for seq, obj in batch:
+                meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
+                                 stream_id=s.remote_id, stream_seq=seq)
+                rail.rail_fallbacks.add(1)
+                body, meta.tensor_header = \
+                    get_serializer("tensor").encode(obj)
+                if Transport.instance().write_frame(
+                        s._sid, meta.encode(), body) != 0:
+                    s._on_closed_internal()
+                    return
         if stop:
             return
         del s    # drop the strong ref while parked in q.get

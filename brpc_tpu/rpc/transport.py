@@ -389,6 +389,22 @@ class Transport:
             sid, meta, len(meta), body, len(body),
             body_iobuf.handle if body_iobuf is not None else None)
 
+    def write_frames(self, sid: int, frames: list[tuple[bytes, bytes]]
+                     ) -> int:
+        """Write a run of (meta, body) frames as ONE socket write — one
+        ctypes crossing and one write-stack push instead of N (the h2
+        frame-coalescing story at the TRPC layer; the parser side
+        already cuts multiple frames per buffer).  One rc for the whole
+        run: ordering is preserved by the single write, and a failure
+        means none/all-prefix delivery exactly like N sequential writes
+        on a dead socket.  For SMALL frames: the coalesced payload is
+        checked against the per-write EOVERCROWDED bound as one unit and
+        is materialized contiguously — big bodies should go per-frame
+        (the stream sender coalesces ticket frames only)."""
+        payload = b"".join(self._pack_trpc(bytes(m), bytes(b))
+                           for m, b in frames)
+        return self.write_raw(sid, payload)
+
     def write_raw(self, sid: int, data: bytes) -> int:
         eng = self._tls.get(sid)
         if eng is not None:
