@@ -226,6 +226,33 @@ def test_fuzz_recordio_reader_recovers():
                 f"round {round_i}: lost {len(goods) - len(out)} records"
 
 
+def test_fuzz_endpoint_grammar():
+    """str2endpoint over random/mutated address strings: every input
+    either parses to an EndPoint or raises ValueError-family — never
+    crashes, and valid grammars survive round-trips."""
+    from brpc_tpu.butil.endpoint import EndPoint, str2endpoint
+
+    rng = random.Random(SEED + 42)
+    valid = ["10.1.2.3:8080", "[::1]:443", "unix:/tmp/x.sock",
+             "ici://pod-a/3", "ici://slice", "host.name:0", "bare",
+             ":9", "127.0.0.1:65535"]
+    for s in valid:
+        ep = str2endpoint(s)
+        assert isinstance(ep, EndPoint)
+    alphabet = "abc:/[]0123456789.%-_ \t\x00\xff"
+    for _ in range(ROUNDS * 3):
+        s = "".join(rng.choice(alphabet)
+                    for _ in range(rng.randrange(0, 30)))
+        if rng.random() < 0.4:      # mutate a valid one instead
+            base = list(rng.choice(valid))
+            base[rng.randrange(len(base))] = rng.choice(alphabet)
+            s = "".join(base)
+        try:
+            str2endpoint(s)
+        except (ValueError, IndexError):
+            pass
+
+
 def test_recordio_embedded_record_not_fabricated():
     """A record whose BODY contains a complete well-formed inner record
     (rpc_dump bodies are raw network bytes — adversary-shaped) must

@@ -260,6 +260,81 @@ def test_stream_write_after_close_raises(tensor_stream_server):
         stream.write(b"bytes-after-close")
 
 
+def test_concurrent_mixed_writers_deliver_in_seq_order():
+    """Racing writer threads interleaving BYTES and TENSOR messages on
+    ONE stream: seq assignment is serialized under the window lock, but
+    tensor frames ride the sender thread (now batch-coalesced) while
+    bytes frames are written inline — the receiver's reorder layer must
+    still deliver strictly in seq order, one transport's frames never
+    overtaking the other's."""
+    received = []
+    done = threading.Event()
+    TOTAL = 120
+
+    class Sink(brpc.Service):
+        NAME = "MixSink"
+
+        @brpc.method(request="json", response="json")
+        def Open(self, cntl, req):
+            def on_msg(stream, payload):
+                received.append(payload)
+                if len(received) >= TOTAL:
+                    done.set()
+            cntl.accept_stream(on_msg, device=D1, max_buf_size=64 << 20)
+            return {"ok": True}
+
+    srv = brpc.Server(brpc.ServerOptions(ici_device=D1))
+    srv.add_service(Sink())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=30000)
+        cntl = brpc.Controller()
+        stream = brpc.stream_create(cntl, None, max_buf_size=64 << 20,
+                                    device=D1)
+        ch.call_sync("MixSink", "Open", {}, serializer="json", cntl=cntl)
+        # writers tag each message with a GLOBAL ticket taken under the
+        # same race as the write itself, so delivered order must match
+        # ticket order exactly
+        tick_mu = threading.Lock()
+        ticket = [0]
+
+        def writer(kind):
+            for _ in range(TOTAL // 4):
+                with tick_mu:
+                    t = ticket[0]
+                    ticket[0] += 1
+                    # take the ticket and WRITE inside the lock: the
+                    # stream's own seq is assigned under its window
+                    # lock, so ticket order == seq order
+                    if kind == "bytes":
+                        stream.write(b"%08d" % t, timeout_s=30)
+                    else:
+                        stream.write(
+                            jnp.full((64,), float(t), jnp.float32),
+                            timeout_s=30)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in ("bytes", "tensor", "bytes", "tensor")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert done.wait(60), f"only {len(received)}/{TOTAL} delivered"
+        got = []
+        for p in received:
+            if isinstance(p, bytes):
+                got.append(int(p))
+            else:
+                got.append(int(np.asarray(p)[0]))
+        assert got == list(range(TOTAL)), \
+            f"delivery order broke: first mismatch at " \
+            f"{next(i for i, (a, b) in enumerate(zip(got, range(TOTAL))) if a != b)}"
+        stream.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
 def test_abandoned_stream_sender_thread_exits():
     """A stream dropped without close() must not pin its sender thread
     (or itself) forever: the sender holds only a weakref and exits once
