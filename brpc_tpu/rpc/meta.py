@@ -86,6 +86,13 @@ def strip_reserved_user_fields(fields: dict) -> dict:
             if k not in RESERVED_USER_FIELD_KEYS}
 
 
+# constant byte prefixes of the stream-DATA fast encoder (all arguments
+# are literals; packing them per message was pure waste on the hot path)
+_STREAM_DATA_HDR = (_FIXED.pack(1, MSG_STREAM_DATA, 0, 0, 0)
+                    + struct.pack("<BI", T_STREAM_ID, 8))
+_STREAM_SEQ_TL = struct.pack("<BI", T_STREAM_SEQ, 8)
+
+
 @dataclass(slots=True)
 class RpcMeta:
     msg_type: int = MSG_REQUEST
@@ -154,6 +161,32 @@ class RpcMeta:
             if isinstance(v, str):
                 v = v.encode()
             tlv(T_USER_FIELD, k.encode() + b"\x00" + v)
+        return b"".join(parts)
+
+    @staticmethod
+    def encode_stream_data(stream_id: int, seq: int,
+                           ticket: str | None = None,
+                           src_dev: str | None = None) -> bytes:
+        """Direct encoder for the stream-DATA hot shape (the only meta a
+        busy tensor stream produces, thousands per second): identical
+        bytes to RpcMeta(msg_type=MSG_STREAM_DATA, stream_id=..,
+        stream_seq=..) with the rail user fields, without the dataclass
+        construction and 17-branch generic encode (measured ~26% of
+        per-message stream cost; equality pinned by
+        test_encode_stream_data_fast_path_identical)."""
+        parts = [_STREAM_DATA_HDR,
+                 struct.pack("<Q", stream_id)]
+        if seq:
+            parts.append(_STREAM_SEQ_TL)
+            parts.append(struct.pack("<Q", seq))
+        if ticket is not None:
+            p = F_TICKET.encode() + b"\x00" + ticket.encode()
+            parts.append(struct.pack("<BI", T_USER_FIELD, len(p)))
+            parts.append(p)
+        if src_dev is not None:
+            p = F_SRC_DEV.encode() + b"\x00" + src_dev.encode()
+            parts.append(struct.pack("<BI", T_USER_FIELD, len(p)))
+            parts.append(p)
         return b"".join(parts)
 
     @classmethod

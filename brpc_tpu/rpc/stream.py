@@ -211,9 +211,9 @@ class Stream:
             self._send_tensor(payload, seq)
 
     def _send_data(self, data: bytes, seq: int) -> None:
-        meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
-                         stream_id=self.remote_id, stream_seq=seq)
-        rc = Transport.instance().write_frame(self._sid, meta.encode(), data)
+        rc = Transport.instance().write_frame(
+            self._sid, M.RpcMeta.encode_stream_data(self.remote_id, seq),
+            data)
         if rc != 0:
             self._on_closed_internal()
 
@@ -450,12 +450,9 @@ def _tensor_send_loop(wref, q) -> None:
             # EOVERCROWDED bound the way coalesced big bodies would.
             frames = []
             for k, (seq, obj) in enumerate(batch):
-                meta = M.RpcMeta(msg_type=M.MSG_STREAM_DATA,
-                                 stream_id=s.remote_id, stream_seq=seq)
-                meta.user_fields[M.F_TICKET] = tickets[k]
-                meta.user_fields[M.F_SRC_DEV] = str(
-                    rail.source_device(obj).id)
-                frames.append((meta.encode(), b""))
+                frames.append((M.RpcMeta.encode_stream_data(
+                    s.remote_id, seq, ticket=tickets[k],
+                    src_dev=str(rail.source_device(obj).id)), b""))
             if Transport.instance().write_frames(s._sid, frames) != 0:
                 for t in tickets:       # atomic pops: no double-free
                     rail.withdraw(t)

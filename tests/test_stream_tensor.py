@@ -335,6 +335,33 @@ def test_concurrent_mixed_writers_deliver_in_seq_order():
         srv.join()
 
 
+def test_encode_stream_data_fast_path_identical():
+    """The direct stream-DATA encoder must produce byte-identical wire
+    output to the generic RpcMeta encode for every shape the stream
+    sender emits — including seq 0 (which the generic encoder OMITS) and
+    multi-byte tickets/device ids."""
+    from brpc_tpu.rpc import meta as M
+
+    for sid_ in (1, 7, 2**31):
+        for seq in (0, 1, 255, 2**40):
+            for ticket, dev in ((None, None), ("t1", "0"),
+                                ("t123456", "1048576")):
+                m = M.RpcMeta(msg_type=M.MSG_STREAM_DATA, stream_id=sid_,
+                              stream_seq=seq)
+                if ticket is not None:
+                    m.user_fields[M.F_TICKET] = ticket
+                    m.user_fields[M.F_SRC_DEV] = dev
+                fast = M.RpcMeta.encode_stream_data(sid_, seq,
+                                                    ticket=ticket,
+                                                    src_dev=dev)
+                assert fast == m.encode(), (sid_, seq, ticket)
+                # and it round-trips through the generic decoder
+                d = M.RpcMeta.decode(fast)
+                assert (d.stream_id, d.stream_seq) == (sid_, seq)
+                if ticket is not None:
+                    assert d.user_fields[M.F_TICKET] == ticket.encode()
+
+
 def test_sustained_streaming_leaks_nothing():
     """Steady-state resource proof: after 400 tensor messages and a
     drain, every rail/endpoint resource counter returns to zero —
