@@ -91,6 +91,8 @@ def strip_reserved_user_fields(fields: dict) -> dict:
 _STREAM_DATA_HDR = (_FIXED.pack(1, MSG_STREAM_DATA, 0, 0, 0)
                     + struct.pack("<BI", T_STREAM_ID, 8))
 _STREAM_SEQ_TL = struct.pack("<BI", T_STREAM_SEQ, 8)
+_TICKET_KEY = F_TICKET.encode() + b"\x00"
+_SRC_DEV_KEY = F_SRC_DEV.encode() + b"\x00"
 
 
 @dataclass(slots=True)
@@ -180,11 +182,11 @@ class RpcMeta:
             parts.append(_STREAM_SEQ_TL)
             parts.append(struct.pack("<Q", seq))
         if ticket is not None:
-            p = F_TICKET.encode() + b"\x00" + ticket.encode()
+            p = _TICKET_KEY + ticket.encode()
             parts.append(struct.pack("<BI", T_USER_FIELD, len(p)))
             parts.append(p)
         if src_dev is not None:
-            p = F_SRC_DEV.encode() + b"\x00" + src_dev.encode()
+            p = _SRC_DEV_KEY + src_dev.encode()
             parts.append(struct.pack("<BI", T_USER_FIELD, len(p)))
             parts.append(p)
         return b"".join(parts)

@@ -116,6 +116,7 @@ class Stream:
         self._send_seq = 1
         self._recv_next = 1
         self._reorder: dict[int, bytes] = {}
+        self._reorder_bytes = 0
         self._close_seq: Optional[int] = None
         self._delivering = False
         # Tensor write coalescing: rail-bound writes go through a
@@ -302,7 +303,43 @@ class Stream:
             self._ack(nbytes)
             return
         with self._mu:
+            if seq < self._recv_next or seq in self._reorder:
+                # replay of a delivered or in-flight seq: a sub-
+                # _recv_next entry would park in the dict FOREVER (the
+                # drain only pops forward), so a replaying peer could
+                # grow it without bound — drop duplicates outright
+                return
             self._reorder[seq] = (payload, nbytes)
+            self._reorder_bytes += nbytes
+            # a CORRECT peer can never have more unacked bytes in flight
+            # than the WRITER's credit window (peer_buf_size, learned in
+            # the settings exchange; our own max_buf_size when the peer
+            # is bigger-bounded or unknown); a writer ignoring the
+            # window (or spraying far-future seqs that can never drain)
+            # is a protocol violation, not backpressure — close before
+            # the buffer becomes a memory DoS (the h2 header-block/
+            # frame-bound discipline, applied to the stream reorder
+            # buffer).  2x allows device payloads whose nbytes
+            # accounting straddles the window.
+            window = max(self.max_buf_size, self.peer_buf_size or 0)
+            overflow = self._reorder_bytes > 2 * window + (64 << 10)
+        if overflow:
+            logging.warning("stream %d: reorder buffer exceeded 2x the "
+                            "credit window; closing (protocol violation)",
+                            self.stream_id)
+            # tell the live peer (seq 0 = immediate close on receipt) so
+            # its writer fails EEOF instead of blocking out its window
+            # against a stream that no longer exists
+            if self._sid is not None and self.remote_id is not None:
+                try:
+                    Transport.instance().write_frame(
+                        self._sid,
+                        M.RpcMeta(msg_type=M.MSG_STREAM_CLOSE,
+                                  stream_id=self.remote_id).encode())
+                except Exception:
+                    pass
+            self._on_closed_internal()
+            return
         self._drain()
 
     def _on_close_frame(self, seq: int) -> None:
@@ -330,6 +367,7 @@ class Stream:
                 ready_bytes = 0
                 while self._recv_next in self._reorder:
                     payload, nbytes = self._reorder.pop(self._recv_next)
+                    self._reorder_bytes -= nbytes
                     ready.append(payload)
                     ready_bytes += nbytes
                     self._recv_next += 1

@@ -117,3 +117,72 @@ class TestCreditWindow:
         finally:
             srv.stop()
             srv.join()
+
+
+class TestHostileReorder:
+    """The reorder buffer is attacker-facing: DATA frames carry peer-
+    chosen seqs.  Replays must not grow it; a writer ignoring the credit
+    window entirely must be closed, not buffered without bound."""
+
+    def _stream(self, max_buf=4096):
+        from brpc_tpu.rpc.stream import Stream
+        s = Stream(1, handler=None, max_buf_size=max_buf)
+        delivered = []
+
+        class H:
+            def on_received_messages(self, st, msgs):
+                delivered.extend(msgs)
+
+            def on_closed(self, st):
+                pass
+        s.handler = H()
+        return s, delivered
+
+    def test_replayed_and_duplicate_seqs_do_not_accumulate(self):
+        s, delivered = self._stream()
+        for seq in (1, 2, 3):
+            s._on_data(b"x%d" % seq, 2, seq)
+        assert delivered == [b"x1", b"x2", b"x3"]
+        # replay every delivered seq many times: the dict must stay empty
+        for _ in range(50):
+            for seq in (1, 2, 3):
+                s._on_data(b"evil", 4, seq)
+        assert s._reorder == {} and s._reorder_bytes == 0
+        # duplicate of an IN-FLIGHT gap seq keeps the first copy only
+        s._on_data(b"gap5", 4, 5)
+        s._on_data(b"dup5", 4, 5)
+        assert s._reorder[5][0] == b"gap5" and len(s._reorder) == 1
+        s._on_data(b"x4", 2, 4)           # fill the gap: both deliver
+        assert delivered[-2:] == [b"x4", b"gap5"]
+        assert s._reorder == {} and s._reorder_bytes == 0
+
+    def test_window_ignoring_writer_is_closed_not_buffered(self):
+        s, delivered = self._stream(max_buf=4096)
+        # spray far-future frames (seq 2..N, never seq 1) well past 2x
+        # the window: the stream must CLOSE, and buffered bytes must
+        # stay bounded by the violation threshold
+        blob = b"A" * 1024
+        for seq in range(2, 200):
+            s._on_data(blob, len(blob), seq)
+            if s.closed:
+                break
+        assert s.closed, "stream buffered an unbounded reorder backlog"
+        assert s._reorder_bytes <= 2 * 4096 + (64 << 10) + len(blob)
+        assert delivered == []            # nothing ever became ready
+
+    def test_asymmetric_windows_use_the_writers_bound(self):
+        """A compliant writer's in-flight bytes are bounded by the
+        WRITER's window (peer_buf_size), not the receiver's: a small
+        receiver facing a big writer must tolerate a legitimate burst
+        beyond its own max_buf_size without calling it a violation."""
+        s, delivered = self._stream(max_buf=4096)
+        s.peer_buf_size = 1 << 21         # 2MB writer, learned via sbuf
+        blob = b"B" * 1024
+        # 200KB burst parked behind a gap: within the writer's window,
+        # far beyond the receiver's — must stay open
+        for seq in range(2, 202):
+            s._on_data(blob, len(blob), seq)
+        assert not s.closed
+        s._on_data(b"first", 5, 1)        # gap fills: all delivered
+        assert delivered[0] == b"first" and len(delivered) == 201
+        assert s._reorder == {} and s._reorder_bytes == 0
