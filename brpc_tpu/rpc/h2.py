@@ -57,6 +57,12 @@ OUR_MAX_FRAME = 1 << 20
 # assembled header-block cap (SETTINGS_MAX_HEADER_LIST_SIZE analog): a
 # CONTINUATION storm must not grow one stream's block without bound
 MAX_HEADER_BLOCK = 1 << 20
+# per-call bound on rx messages parked ahead of a bidi handler, and on
+# raw bytes buffered for a client-streaming call before END: window
+# credit is granted on PARSE (both planes), so these caps are the only
+# thing between a slow/never-consuming handler and unbounded memory
+MAX_BUFFERED_BIDI_MSGS = 1024
+MAX_CLIENT_STREAM_RX_BYTES = 64 << 20
 
 H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
 
@@ -926,10 +932,34 @@ class GrpcServerConnection(H2Connection):
         with self._bidi_lock:
             entry = self._bidi_rx.get(st.id)
         if entry is None:
+            # non-bidi stream accumulating toward END (client-streaming
+            # collect, or a unary body): window credit was granted on
+            # receipt, so cap the buffered bytes — the native plane's
+            # kMaxGrpcMessage discipline
+            if len(st.data) > MAX_CLIENT_STREAM_RX_BYTES:
+                del st.data[:]
+                self._respond_error(st.id, GRPC_RESOURCE_EXHAUSTED,
+                                    "request stream backlog exceeded")
+                self.send_rst(st.id, 0x8)    # CANCEL
+                self.close_stream(st.id)
             return
         rx, codec = entry
         msgs, err = pop_grpc_frames(st.data, codec)
         for m in msgs:
+            if rx.qsize() >= MAX_BUFFERED_BIDI_MSGS:
+                rx.put(errors.RpcError(
+                    errors.ELIMIT, "bidi rx backlog exceeded"))
+                with self._bidi_lock:
+                    self._bidi_rx.pop(st.id, None)
+                del st.data[:]
+                self._respond_error(st.id, GRPC_RESOURCE_EXHAUSTED,
+                                    "bidi rx backlog exceeded")
+                # RST too: a flooder ignoring the trailers would otherwise
+                # keep burning receive bandwidth on the dead stream (the
+                # framing-error branch below does the same)
+                self.send_rst(st.id, 0x8)    # CANCEL
+                self.close_stream(st.id)
+                return
             rx.put(m)
         if err is not None:
             # framing is unrecoverable: error the handler ONCE, stop
@@ -1026,6 +1056,9 @@ class GrpcServerConnection(H2Connection):
             if code != 0:
                 self._respond_error(st.id, err_to_grpc(code), text)
                 return
+            with self._fc:
+                if st.id not in self._streams:
+                    return   # shed/reset while the handler ran: stay silent
             enc_name, tx_codec = response_codec_for(h)
             self.send_headers(st.id, self._resp_headers(enc_name))
             if isinstance(resp, (bytes, bytearray, memoryview)):
@@ -1201,6 +1234,15 @@ class GrpcServerConnection(H2Connection):
             self.close_stream(st.id)
 
     def _respond_error(self, stream_id: int, status: int, msg: str) -> None:
+        # liveness guard: once a stream is shed/RST/closed (popped from
+        # _streams), a late responder — e.g. a parked bidi handler that
+        # unparks AFTER the backlog shed already sent trailers — must
+        # stay silent.  A second HEADERS on a closed stream is a
+        # connection-level PROTOCOL_ERROR to a conforming peer (the
+        # native plane guards this with st->closed_local).
+        with self._fc:
+            if stream_id not in self._streams:
+                return
         self.send_headers(stream_id, [
             (":status", "200"),
             ("content-type", "application/grpc"),

@@ -44,6 +44,12 @@ EV_RESET = 4
 # holds a dedicated thread) — mirrors GrpcServerConnection
 MAX_STREAMING_CALLS = 128
 
+# per-call rx backlog bound shared with the Python plane (defined next
+# to the other h2 bounds): the native session tops up flow-control
+# windows on PARSE (not handler consumption), so without this a client
+# can flood a slow handler's queue without ever hitting h2 flow control
+from brpc_tpu.rpc.h2 import MAX_BUFFERED_BIDI_MSGS  # noqa: E402
+
 
 def _expose_native_counters() -> None:
     """Native session counters on /vars (console parity: the gRPC plane's
@@ -236,8 +242,32 @@ class NativeH2Bridge:
                                         str(err))
                 return
             if call.rx is not None:
+                # budget check, not a bounded queue: a blocking put would
+                # stall the socket FIFO lane (head-of-line blocking every
+                # stream on the connection), and the error/END sentinels
+                # below must never be droppable.  qsize is approximate —
+                # fine for a DoS bound.
+                if call.rx.qsize() >= MAX_BUFFERED_BIDI_MSGS:
+                    call.bad = True
+                    with self._mu:
+                        self._calls.pop(key, None)
+                    call.rx.put(errors.RpcError(
+                        errors.ELIMIT,
+                        "bidi rx backlog exceeded: handler too slow "
+                        "for the send rate"))
+                    self._respond_error(sid, stream_id,
+                                        GRPC_RESOURCE_EXHAUSTED,
+                                        "bidi rx backlog exceeded")
+                    return
                 call.rx.put(msg)
             elif call.collect is not None:
+                if len(call.collect) >= MAX_BUFFERED_BIDI_MSGS:
+                    call.bad = True
+                    call.collect = None
+                    self._respond_error(sid, stream_id,
+                                        GRPC_RESOURCE_EXHAUSTED,
+                                        "client-stream backlog exceeded")
+                    return
                 call.collect.append(msg)
             return
         if kind == EV_END:

@@ -286,6 +286,107 @@ def test_lean_pool_survives_base_exceptions():
     assert sorted(ran) == list(range(8))
 
 
+def test_bidi_rx_backlog_bounded():
+    """A client spraying bidi messages at a handler that never consumes
+    must be failed RESOURCE_EXHAUSTED once the rx backlog passes the
+    budget — the native session grants window credit on PARSE, so this
+    cap is the only thing between a slow handler and unbounded memory.
+    The connection and server must survive the shed."""
+    from brpc_tpu.rpc import h2_native
+
+    parked = threading.Event()
+    release = threading.Event()
+
+    class Hold(brpc.Service):
+        NAME = "nh2.Backlog"
+
+        @brpc.method(request="raw", response="raw")
+        def Sink(self, cntl, req_iter):
+            parked.set()
+            release.wait(30)         # never consumes while the spray runs
+            for _ in req_iter:
+                pass
+            return b"drained"
+
+        @brpc.method(request="raw", response="raw")
+        def Echo(self, cntl, req):
+            return bytes(req)
+
+    s = brpc.Server()
+    s.add_service(Hold())
+    s.start("127.0.0.1", 0)
+    ch = GrpcChannel(f"127.0.0.1:{s.port}", timeout_ms=15000)
+    try:
+        call = ch.call_bidi("nh2.Backlog", "Sink")
+        call.send(b"first")
+        assert parked.wait(5)
+        # spray well past the budget; the server must shed, not buffer
+        shed = False
+        try:
+            for i in range(h2_native.MAX_BUFFERED_BIDI_MSGS * 3):
+                call.send(b"x%d" % i)
+        except Exception:
+            shed = True              # RST reached us mid-send
+        if not shed:
+            with pytest.raises(Exception) as ei:
+                next(call)
+            assert "exhausted" in str(ei.value).lower() or \
+                   "backlog" in str(ei.value).lower() or \
+                   "reset" in str(ei.value).lower(), ei.value
+        release.set()
+        # the connection (or a fresh one) still serves
+        ch2 = GrpcChannel(f"127.0.0.1:{s.port}", timeout_ms=10000)
+        assert ch2.call("nh2.Backlog", "Echo", b"alive") == b"alive"
+        ch2.close()
+    finally:
+        release.set()
+        ch.close()
+        s.stop()
+        s.join()
+
+
+def test_client_stream_byte_backlog_bounded_python_plane(monkeypatch):
+    """The pure-Python plane buffers client-streaming bytes until END
+    with window credit granted on receipt; a sender that never ENDs must
+    be shed once the byte cap passes (native plane enforces its own
+    kMaxGrpcMessage bound in C++)."""
+    from brpc_tpu.rpc import h2 as h2mod
+
+    monkeypatch.setattr(h2mod, "MAX_CLIENT_STREAM_RX_BYTES", 64 * 1024)
+
+    class Acc(brpc.Service):
+        NAME = "nh2.Acc"
+
+        @brpc.method(request="raw", response="raw")
+        def Sum(self, cntl, msgs):
+            return b"%d" % sum(len(m) for m in msgs)
+
+    s = brpc.Server(brpc.ServerOptions(h2_native=False))
+    s.add_service(Acc())
+    s.start("127.0.0.1", 0)
+    ch = GrpcChannel(f"127.0.0.1:{s.port}", timeout_ms=15000)
+    try:
+        def endless():
+            for _ in range(4000):          # ~4MB, far past the 64KB cap
+                yield b"B" * 1024
+
+        with pytest.raises(Exception) as ei:
+            ch.call_client_stream("nh2.Acc", "Sum", endless())
+        msg = str(ei.value).lower()
+        assert ("backlog" in msg or "exhausted" in msg or "reset" in msg
+                or "closed" in msg or "timed" in msg), ei.value
+        # connection-level health: a fresh call still works
+        ch2 = GrpcChannel(f"127.0.0.1:{s.port}", timeout_ms=10000)
+        out = ch2.call_client_stream("nh2.Acc", "Sum",
+                                     iter([b"ab", b"cd"]))
+        assert out == b"4"
+        ch2.close()
+    finally:
+        ch.close()
+        s.stop()
+        s.join()
+
+
 def test_bidi_deadline_enforced_serverside():
     """A bidi handler parked on its request iterator must be unparked by
     the grpc-timeout deadline (h2_native request_iter's timed get): the
