@@ -14,9 +14,9 @@ B, S, H, D = 2, 256, 8, 32
 N = 8
 
 
-def _qkv(seed=0, dtype=jnp.float32):
+def _qkv(seed=0, dtype=jnp.float32, s=None):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)
-    shape = (B, S, H, D)
+    shape = (B, s if s is not None else S, H, D)
     return tuple(jax.random.normal(k, shape, dtype) * 0.5 for k in ks)
 
 
@@ -87,6 +87,17 @@ def test_flash_attention_causal_matches_full():
     including q rows in the FIRST block, whose only visible key is the
     diagonal."""
     q, k, v = _qkv(seed=6)
+    ref = local_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, blk_q=64, blk_k=64, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_indivisible_seq_falls_back():
+    """S not divisible by the block sizes routes to local_attention —
+    with the causal flag FORWARDED (a silently non-causal fallback would
+    be a correctness bug, not a perf one)."""
+    q, k, v = _qkv(seed=8, s=100)
     ref = local_attention(q, k, v, causal=True)
     out = flash_attention(q, k, v, blk_q=64, blk_k=64, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
