@@ -202,8 +202,13 @@ class TensorSerializer(Serializer):
             try:
                 dt = np.dtype(
                     tensor_header[off : off + dlen].decode("ascii"))
-            except (TypeError, UnicodeDecodeError) as e:
-                # malformed header = bad input, not a programming error
+            except ValueError:
+                raise              # already the contract's error family
+            except Exception as e:
+                # malformed header = bad input, not a programming error.
+                # Catch BROADLY: np.dtype ast-parses some spec strings
+                # and can raise SyntaxError (found by the decode fuzz
+                # target), TypeError, UnicodeDecodeError, ...
                 raise ValueError(f"bad dtype in tensor header: {e}")
             off += dlen
             ndim = tensor_header[off]
@@ -216,6 +221,10 @@ class TensorSerializer(Serializer):
             # exact Python-int element count (np.prod silently wraps), then
             # bound against the actual body: a hostile header must raise
             # ValueError, not drive numpy into OverflowError/overallocation
+            if dt.itemsize == 0:
+                # V0/U0/S0: cnt * 0 == 0 would pass the body bound below
+                # while a huge cnt still overflows frombuffer's ssize_t
+                raise ValueError(f"zero-itemsize dtype {dt} in header")
             cnt = 1
             for d in shape:
                 cnt *= int(d)

@@ -226,6 +226,47 @@ def test_fuzz_recordio_reader_recovers():
                 f"round {round_i}: lost {len(goods) - len(out)} records"
 
 
+def test_fuzz_tensor_serializer_decode():
+    """The tensor serializer's decode takes PEER-CONTROLLED headers on
+    the DCN/stream host-fallback path: mutated dtype strings, lying
+    shapes (incl. multiplicative-overflow shapes), truncations and
+    random bytes must raise ValueError-family only — never allocate
+    past the body, wrap, or crash."""
+    import numpy as np
+
+    from brpc_tpu.rpc.serialization import get_serializer
+
+    ser = get_serializer("tensor")
+    rng = random.Random(SEED + 43)
+    valid = [ser.encode(np.arange(12, dtype=np.float32).reshape(3, 4)),
+             ser.encode([np.ones((2, 2), np.int64),
+                         np.zeros((5,), np.uint8)])]
+    for body, hdr in valid:      # sanity: valid inputs still decode
+        ser.decode(body, hdr)
+    # header mutations via the shared corpus generator (random bytes,
+    # truncations, bit-flips); pair-specific cases hand-written
+    cases = [(valid[0][0], h)
+             for h in _corpora([hdr for _, hdr in valid], rng)]
+    for body, hdr in valid:
+        cases.append((body[: len(body) // 2], hdr))
+        cases.append((b"", hdr))
+    # hand-crafted overflow shapes: 2^32 x 2^32 elements whose byte size
+    # "fits" u64 math (f8), and whose ZERO itemsize (V0) would slip the
+    # body bound while the count overflows frombuffer's ssize_t
+    shape2_32 = b"\x02" + (1 << 32).to_bytes(8, "little") * 2
+    cases.append((b"\x00" * 64, b"\x01\x01" + b"\x03<f8" + shape2_32))
+    cases.append((b"\x00" * 64, b"\x01\x01" + b"\x02V0" + shape2_32))
+    for body, hdr in cases:
+        try:
+            out = ser.decode(bytes(body), bytes(hdr))
+            # anything that decodes must be real arrays bounded by body
+            arrs = out if isinstance(out, list) else [out]
+            assert sum(a.nbytes if hasattr(a, "nbytes") else len(a)
+                       for a in arrs) <= len(body)
+        except ValueError:
+            pass
+
+
 def test_fuzz_endpoint_grammar():
     """str2endpoint over random/mutated address strings: every input
     either parses to an EndPoint or raises ValueError-family — never
