@@ -238,6 +238,36 @@ class TensorSerializer(Serializer):
         return out[0] if single and out else out
 
 
+# EXACT (module, name) pairs a pickled payload may reference — the
+# globals that builtin containers/scalars and numpy arrays actually emit
+# (enumerated with pickletools against this numpy).  pickle.loads on
+# peer bytes is arbitrary code execution by design (__reduce__ ->
+# os.system); module-prefix wildcards cannot work either: numpy itself
+# ships exec gadgets (numpy.testing...runstring is literally exec), and
+# dotted STACK_GLOBAL names resolve via attribute traversal so
+# "builtins", "eval.__call__" slips any name-based deny list — both
+# bypasses live-proven in review.  Deployments that truly trust their
+# peers can flip rpc_pickle_unrestricted.
+_PICKLE_SAFE = {
+    ("builtins", "bytearray"), ("builtins", "complex"),
+    ("builtins", "set"), ("builtins", "frozenset"),
+    ("collections", "OrderedDict"),
+    ("numpy", "dtype"), ("numpy", "ndarray"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "_reconstruct"),   # numpy 1.x payloads
+    ("numpy.core.multiarray", "scalar"),
+}
+
+
+from brpc_tpu.flags import define_flag as _define_flag
+
+_define_flag("rpc_pickle_unrestricted", False,
+             "allow pickle payloads to reference ANY class (arbitrary "
+             "code execution for whoever can reach the port; only for "
+             "fully trusted peers)", reloadable=False)
+
+
 class PickleSerializer(Serializer):
     name = "pickle"
 
@@ -246,8 +276,34 @@ class PickleSerializer(Serializer):
         return pickle.dumps(obj), b""
 
     def decode(self, body, tensor_header):
+        import io
         import pickle
-        return pickle.loads(body)
+
+        from brpc_tpu import flags
+        if flags.get_flag("rpc_pickle_unrestricted", False):
+            return pickle.loads(body)
+        return _RestrictedUnpickler(io.BytesIO(body)).load()
+
+
+import pickle as _pickle  # noqa: E402
+
+
+class _RestrictedUnpickler(_pickle.Unpickler):
+    def find_class(self, module, name):
+        # dotted names resolve via attribute traversal in CPython's
+        # find_class ("eval.__call__" under an allowed module) — reject
+        # them outright; legitimate payload globals are plain names
+        if "." not in name:
+            if (module, name) in _PICKLE_SAFE:
+                return super().find_class(module, name)
+            # numpy 2 pickles some dtype instances through their
+            # numpy.dtypes.<X>DType classes — a closed, data-only family
+            if (module == "numpy.dtypes" and name.endswith("DType")
+                    and name.isidentifier()):
+                return super().find_class(module, name)
+        raise ValueError(
+            f"pickle payload references {module}.{name}; refused "
+            "(set -rpc_pickle_unrestricted for trusted peers)")
 
 
 _SERIALIZERS: dict[str, Serializer] = {}

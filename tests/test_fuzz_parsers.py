@@ -267,6 +267,74 @@ def test_fuzz_tensor_serializer_decode():
             pass
 
 
+def test_pickle_serializer_refuses_gadget_payloads():
+    """pickle.loads on peer bytes is RCE by design (__reduce__ ->
+    os.system); the serializer must refuse payloads referencing
+    non-allowlisted classes while round-tripping the data shapes it
+    exists for, and honor the trusted-peer opt-out flag."""
+    import pickle
+
+    import numpy as np
+
+    from brpc_tpu import flags
+    from brpc_tpu.rpc.serialization import get_serializer
+
+    ser = get_serializer("pickle")
+    # legitimate shapes round-trip
+    for obj in ({"a": [1, 2.5, "s", None, True]},
+                np.arange(6, dtype=np.float32).reshape(2, 3),
+                (b"bytes", {7, 8}, {"nested": {"d": [np.int64(3)]}})):
+        out = ser.decode(ser.encode(obj)[0], b"")
+        if isinstance(obj, np.ndarray):
+            assert np.array_equal(out, obj)
+        else:
+            assert out == obj
+
+    import os
+    import tempfile
+    marker = tempfile.mktemp(prefix="pickle_gadget_")
+
+    class _Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {marker}",))
+
+    class _EvilEval:   # dotted-name bypass: eval.__call__ under builtins
+        def __reduce__(self):
+            return (eval, (f"__import__('os').system('touch {marker}')",))
+
+    class _EvilNumpy:  # module-wildcard bypass: numpy's own exec gadget
+        def __reduce__(self):
+            from numpy.testing._private.utils import runstring
+            return (runstring,
+                    (f"import os; os.system('touch {marker}')", {}))
+
+    payloads = [pickle.dumps(_Evil()), pickle.dumps(_EvilEval()),
+                pickle.dumps(_EvilNumpy())]
+
+    # hand-build the dotted STACK_GLOBAL shape (pickle.dumps emits plain
+    # "eval"; the live bypass smuggled "eval.__call__", which CPython's
+    # find_class resolves by attribute traversal)
+    def _short_unicode(s: bytes) -> bytes:
+        return b"\x8c" + bytes([len(s)]) + s
+    expr = f"__import__('os').system('touch {marker}')".encode()
+    payloads.append(b"\x80\x04"
+                    + _short_unicode(b"builtins")
+                    + _short_unicode(b"eval.__call__")
+                    + b"\x93"                 # STACK_GLOBAL
+                    + _short_unicode(expr)
+                    + b"\x85R.")              # TUPLE1 REDUCE STOP
+    for payload in payloads:
+        with pytest.raises(ValueError, match="refused"):
+            ser.decode(payload, b"")
+        assert not os.path.exists(marker), "GADGET EXECUTED"
+    # trusted-peer opt-out restores plain loads
+    flags.set_flag("rpc_pickle_unrestricted", True, force=True)
+    try:
+        assert ser.decode(pickle.dumps({"x": 1}), b"") == {"x": 1}
+    finally:
+        flags.set_flag("rpc_pickle_unrestricted", False, force=True)
+
+
 def test_fuzz_endpoint_grammar():
     """str2endpoint over random/mutated address strings: every input
     either parses to an EndPoint or raises ValueError-family — never
