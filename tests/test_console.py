@@ -183,3 +183,30 @@ def test_dir_browses_filesystem(server):
     finally:
         os.unlink(probe2)
     flags.set_flag("enable_dir_service", False, force=True)
+
+
+def test_every_console_route_answers(server):
+    """Route matrix: every registered console page returns 200 with a
+    non-empty body (profilers get short sampling windows).  A route that
+    500s or hangs is a console regression no matter how exotic the
+    page."""
+    routes = [
+        "/", "/index", "/status", "/vars", "/flags", "/health",
+        "/version", "/connections", "/sockets", "/bthreads", "/services",
+        "/protobufs", "/memory", "/ici", "/rpcz", "/brpc_metrics",
+        "/dashboard", "/vlog", "/hotspots",
+        "/hotspots/cpu?seconds=0.05",
+        "/hotspots/contention?seconds=0.05",
+        "/hotspots/growth?seconds=0.05",
+        "/hotspots/heap",
+        "/hotspots/native?seconds=0.05",
+        "/pprof/heap",
+        "/pprof/profile?seconds=0.05",
+        "/pprof/profile_native?seconds=0.05",
+        "/pprof/contention?seconds=0.05",
+        "/pprof/growth?seconds=0.05",
+    ]
+    for path in routes:
+        status, body = _get(server, path)
+        assert status == 200, (path, status, body[:120])
+        assert body, path
