@@ -134,6 +134,31 @@ def test_stream_tensor_window_accounting(tensor_stream_server):
     stream.close()
 
 
+def test_stream_list_payload_delivered_as_list(tensor_stream_server):
+    """ONE stream message carrying a LIST of arrays arrives as a list,
+    order and shapes intact, still zero-copy (ship_many deposits the
+    whole message under one ticket; claim rebuilds the list)."""
+    srv, received = tensor_stream_server
+    ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=15000)
+    cntl = brpc.Controller()
+    stream = brpc.stream_create(cntl, None, max_buf_size=16 << 20,
+                                device=D1)
+    ch.call_sync("TensorStreamSvc", "Open", {}, serializer="json",
+                 cntl=cntl)
+    hc0 = rail.host_copy_count()
+    parts = [_arr(D0, 100, n=256), _arr(D0, 200, n=512),
+             _arr(D0, 300, n=128)]
+    stream.write(parts)
+    assert _wait(lambda: len(received) >= 1, timeout=15)
+    got = received[0]
+    assert isinstance(got, list) and len(got) == 3
+    for want, have in zip(parts, got):
+        assert have.shape == want.shape
+        np.testing.assert_array_equal(np.asarray(have), np.asarray(want))
+    assert rail.host_copy_count() == hc0
+    stream.close()
+
+
 def test_stream_tensor_host_fallback_without_device():
     """A server that never advertised a device still receives arrays —
     via host serialization (rail_fallbacks counts it)."""
