@@ -63,6 +63,9 @@ MAX_HEADER_BLOCK = 1 << 20
 # thing between a slow/never-consuming handler and unbounded memory
 MAX_BUFFERED_BIDI_MSGS = 1024
 MAX_CLIENT_STREAM_RX_BYTES = 64 << 20
+# shed events on /vars (both gRPC planes increment this)
+from brpc_tpu.bvar import Adder as _Adder  # noqa: E402
+grpc_backlog_sheds = _Adder("grpc_rx_backlog_sheds")
 
 H2_PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
 
@@ -937,6 +940,7 @@ class GrpcServerConnection(H2Connection):
             # receipt, so cap the buffered bytes — the native plane's
             # kMaxGrpcMessage discipline
             if len(st.data) > MAX_CLIENT_STREAM_RX_BYTES:
+                grpc_backlog_sheds.add(1)
                 del st.data[:]
                 self._respond_error(st.id, GRPC_RESOURCE_EXHAUSTED,
                                     "request stream backlog exceeded")
@@ -947,6 +951,7 @@ class GrpcServerConnection(H2Connection):
         msgs, err = pop_grpc_frames(st.data, codec)
         for m in msgs:
             if rx.qsize() >= MAX_BUFFERED_BIDI_MSGS:
+                grpc_backlog_sheds.add(1)
                 rx.put(errors.RpcError(
                     errors.ELIMIT, "bidi rx backlog exceeded"))
                 with self._bidi_lock:

@@ -48,7 +48,8 @@ MAX_STREAMING_CALLS = 128
 # to the other h2 bounds): the native session tops up flow-control
 # windows on PARSE (not handler consumption), so without this a client
 # can flood a slow handler's queue without ever hitting h2 flow control
-from brpc_tpu.rpc.h2 import MAX_BUFFERED_BIDI_MSGS  # noqa: E402
+from brpc_tpu.rpc.h2 import (MAX_BUFFERED_BIDI_MSGS,  # noqa: E402
+                             grpc_backlog_sheds)
 
 
 def _expose_native_counters() -> None:
@@ -246,8 +247,12 @@ class NativeH2Bridge:
                 # stall the socket FIFO lane (head-of-line blocking every
                 # stream on the connection), and the error/END sentinels
                 # below must never be droppable.  qsize is approximate —
-                # fine for a DoS bound.
+                # fine for a DoS bound.  (Defense in depth: on this
+                # plane the socket FIFO's own 256-event depth usually
+                # sheds a flood first; this cap stands when events drain
+                # into rx faster than the handler consumes.)
                 if call.rx.qsize() >= MAX_BUFFERED_BIDI_MSGS:
+                    grpc_backlog_sheds.add(1)
                     call.bad = True
                     with self._mu:
                         self._calls.pop(key, None)
@@ -262,6 +267,7 @@ class NativeH2Bridge:
                 call.rx.put(msg)
             elif call.collect is not None:
                 if len(call.collect) >= MAX_BUFFERED_BIDI_MSGS:
+                    grpc_backlog_sheds.add(1)
                     call.bad = True
                     call.collect = None
                     self._respond_error(sid, stream_id,

@@ -38,8 +38,14 @@ import threading
 from typing import Callable, Optional
 
 from brpc_tpu import errors
+from brpc_tpu.bvar import Adder
 from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.transport import Transport
+
+# hostile-peer shed events, on /vars next to EOVERCROWDED (a bound that
+# fires silently is a bound operators can't see tripping)
+reorder_replays_dropped = Adder("stream_reorder_replays_dropped")
+reorder_overflow_closes = Adder("stream_reorder_overflow_closes")
 
 DEFAULT_BUF_SIZE = 2 * 1024 * 1024
 
@@ -308,6 +314,7 @@ class Stream:
                 # _recv_next entry would park in the dict FOREVER (the
                 # drain only pops forward), so a replaying peer could
                 # grow it without bound — drop duplicates outright
+                reorder_replays_dropped.add(1)
                 return
             self._reorder[seq] = (payload, nbytes)
             self._reorder_bytes += nbytes
@@ -324,6 +331,7 @@ class Stream:
             window = max(self.max_buf_size, self.peer_buf_size or 0)
             overflow = self._reorder_bytes > 2 * window + (64 << 10)
         if overflow:
+            reorder_overflow_closes.add(1)
             logging.warning("stream %d: reorder buffer exceeded 2x the "
                             "credit window; closing (protocol violation)",
                             self.stream_id)

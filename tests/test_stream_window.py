@@ -144,10 +144,13 @@ class TestHostileReorder:
             s._on_data(b"x%d" % seq, 2, seq)
         assert delivered == [b"x1", b"x2", b"x3"]
         # replay every delivered seq many times: the dict must stay empty
+        from brpc_tpu.rpc.stream import reorder_replays_dropped
+        drops0 = reorder_replays_dropped.get_value()
         for _ in range(50):
             for seq in (1, 2, 3):
                 s._on_data(b"evil", 4, seq)
         assert s._reorder == {} and s._reorder_bytes == 0
+        assert reorder_replays_dropped.get_value() - drops0 == 150
         # duplicate of an IN-FLIGHT gap seq keeps the first copy only
         s._on_data(b"gap5", 4, 5)
         s._on_data(b"dup5", 4, 5)
