@@ -32,7 +32,6 @@ from brpc_tpu.bvar import Adder
 from brpc_tpu.ici.block_pool import (BLOCK_CLASSES, Block, _stage, _unstage,
                                      get_block_pool)
 from brpc_tpu.ici.endpoint import IciEndpoint
-from brpc_tpu.ici.mesh import device_for
 
 rail_payloads = Adder("rail_payloads")
 rail_bytes = Adder("rail_bytes")
