@@ -6,6 +6,12 @@
 
 CXX      ?= g++
 CXXFLAGS ?= -O2 -g -std=c++20 -fPIC -Wall -Wextra -Wno-unused-parameter -pthread
+# g++ 10 gates C++20 coroutines behind -fcoroutines (11+ turn them on with
+# -std=c++20 alone; clang rejects the flag) — probe instead of hardcoding.
+# := so the compiler probe runs ONCE, not on every $(CXXFLAGS) expansion.
+COROUTINE_FLAG := $(shell echo 'int main(){}' | $(CXX) -std=c++20 \
+    -fcoroutines -x c++ - -o /dev/null 2>/dev/null && echo -fcoroutines)
+CXXFLAGS += $(COROUTINE_FLAG)
 LDFLAGS  ?= -shared -pthread
 
 SRC := $(wildcard src/cc/butil/*.cc) \
@@ -46,7 +52,14 @@ clean:
 	rm -rf build
 
 test: $(LIB)
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -x -q -m "not slow"
+
+# Chaos suite (README "Fault injection"): seeded fault-injection
+# scenarios over the full RPC/ICI data path, three fixed seeds so every
+# run replays the same schedule.  Includes slow-marked scenarios.
+chaos: $(LIB) $(PYEXT)
+	BRPC_CHAOS_SEEDS=101,202,303 JAX_PLATFORMS=cpu \
+	    python -m pytest tests/test_chaos.py -q
 
 # Sanitizer stress targets (VERDICT r2 task 7; reference fights lock-free
 # races with stress tests + sanitizer builds, SURVEY.md §5.3).  The whole
@@ -77,4 +90,4 @@ stress:
 	    $(STRESS_SRC) -o build/stress_plain
 	./build/stress_plain
 
-.PHONY: all clean test tsan asan stress
+.PHONY: all clean test chaos tsan asan stress

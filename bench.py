@@ -51,6 +51,20 @@ def _gated(nbytes_moved, wall_s):
                       f"{PHYS_BW_CAP_GBPS} GB/s")
     return (None if issues else round(gbps, 3)), issues
 
+
+# streaming_tensor's mid-batch liveness deadline, measured from the start
+# of the CURRENT batch (ADVICE r5 — against the whole timed region's t0 a
+# healthy late batch would be misflagged once the region outgrows it).
+WEDGE_TIMEOUT_S = 120.0
+
+
+def _batch_wedged(batch_t0, now, timeout_s=WEDGE_TIMEOUT_S):
+    """True when the current batch has made no complete delivery for
+    `timeout_s` — a per-batch bound, independent of how long the whole
+    timed region has run."""
+    return now - batch_t0 > timeout_s
+
+
 # Native sockets hold raw pointers to ctypes trampolines; pin every callback
 # for process lifetime (EOF callbacks fire after the bench function returns).
 _KEEP = []
@@ -696,13 +710,18 @@ def bench_streaming_tensor(chunk_mb=4, iter_chunks=32, max_total_gb=32):
         floor = max(1.0, 4 * jitter)
         t0 = time.perf_counter()
         while True:
+            # the wedge deadline is PER BATCH (ADVICE r5): measured from
+            # the start of the whole timed region, a healthy late batch
+            # on a jittery link would be misflagged once the region
+            # outgrows 120s (floor = 4*jitter can approach it)
+            batch_t0 = time.perf_counter()
             for _ in range(iter_chunks):
                 stream.write(chunk, timeout_s=120)
             # completion = delivery through the whole framework path
             want = warm + (iters + 1) * iter_chunks
             wedged = False
             while _Sink.count < want:
-                if time.perf_counter() - t0 > 120:
+                if _batch_wedged(batch_t0, time.perf_counter()):
                     wedged = True
                     break
                 time.sleep(0.001)

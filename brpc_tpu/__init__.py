@@ -30,5 +30,6 @@ from brpc_tpu.rpc import (  # noqa: F401
 from brpc_tpu.rpc.service import MethodSpec  # noqa: F401
 from brpc_tpu.butil.endpoint import EndPoint, str2endpoint  # noqa: F401
 from brpc_tpu import bvar  # noqa: F401
+from brpc_tpu import fault  # noqa: F401
 from brpc_tpu import flags  # noqa: F401
 from brpc_tpu import rpcz  # noqa: F401

@@ -93,7 +93,14 @@ class RecordReader:
                 if nxt == MAGIC:
                     self._fp.seek(-len(MAGIC), 1)
                     continue
-                if nxt == b"":          # damaged record was the tail
+                if len(nxt) < len(MAGIC):
+                    # damaged record was the tail (ADVICE r5): a short
+                    # non-empty lookahead (1-3 trailing bytes at EOF) is
+                    # the same situation as nxt == b"" — too few bytes
+                    # left for another record to exist.  Rescanning from
+                    # inside this record's payload would let embedded
+                    # MAGIC bytes (rpc_dump bodies are raw network bytes)
+                    # fabricate a top-level record.
                     return None
                 if not self._recover(start):
                     return None

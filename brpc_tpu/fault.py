@@ -1,0 +1,242 @@
+"""Deterministic fault injection for the full RPC/ICI data path.
+
+Chaos testing needs two properties production failures lack:
+*determinism* (a seeded schedule produces the same fault sequence every
+run, so a chaos test is a regression test) and *observability* (every
+injected fault is counted per site on /vars, so rpcz/console can show
+what chaos actually ran).  This module provides both as a process-global
+layer with NAMED INJECTION SITES threaded through the transport and ICI
+layers:
+
+    site               layer   faults
+    transport.connect  L3      refuse, latency
+    transport.send     L3      error, overcrowd, reset, partial, corrupt,
+                               latency
+    transport.recv     L3      drop, corrupt, latency  (see caveat below)
+    stream.frame       L4      drop, dup, latency        (rpc/stream.py)
+    stream.feedback    L4      drop                      (credit loss)
+    h2.send            L4      error, corrupt, latency   (rpc/h2.py)
+    h2.recv            L4      drop, latency
+    ici.send           ICI     error, latency            (ici/endpoint.py)
+    ici.alloc          ICI     exhaust                   (ici/block_pool.py)
+    dcn.call           DCN     error, latency            (ici/dcn.py)
+    dcn.serve          DCN     error, latency
+
+Disabled (the default), every site is a single module-attribute check —
+``if fault.ENABLED:`` — before ANY per-site work, so the production data
+path pays one predicted-not-taken branch and nothing else.  Enabled,
+``hit(site)`` consults the installed :class:`FaultPlan`: rules fire
+deterministically by per-site hit index (``after``/``times``) or by a
+per-rule seeded RNG (``prob``) — never by wall clock or thread identity.
+
+    plan = fault.FaultPlan(seed=7)
+    plan.on("transport.send", fault.RESET, times=1, after=2)
+    plan.on("stream.frame", fault.DROP, prob=0.05)
+    with fault.injected(plan):
+        ...run traffic...
+    assert plan.injected["transport.send"] == 1
+
+Sites interpret a fired fault in their OWN failure convention (an rc for
+the socket writers, ConnectionError for connect, MemoryError for the
+block pool) — this module only decides *whether* and *what*; LATENCY is
+the one kind applied here (sleep, then proceed) so it composes with any
+site.
+
+CAVEAT — transport.recv sees only messages delivered through the Python
+message trampoline (stream frames, full-meta fallback messages, server
+messages without the fast path).  Pre-parsed unary requests/responses
+ride the C fastrpc trampolines and never pass this site: to lose or
+delay a unary RESPONSE, inject at the sender (`transport.send` scoped to
+the server-side sid), as the chaos backup-request scenario does.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import random
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from brpc_tpu.bvar import Adder
+
+# ---------------------------------------------------------------------------
+# fault kinds
+# ---------------------------------------------------------------------------
+
+REFUSE = "refuse"        # connect: raise ConnectionError
+RESET = "reset"          # send: fail the socket mid-call (ECONNRESET)
+ERROR = "error"          # generic failure in the site's own convention
+OVERCROWD = "overcrowd"  # send: the native -2 write-queue-bound rc
+LATENCY = "latency"      # sleep latency_s, then proceed (applied here)
+PARTIAL = "partial"      # send: torn prefix on the wire, then socket death
+CORRUPT = "corrupt"      # mangle the payload (site applies mangle())
+DROP = "drop"            # recv/frame: swallow the message
+DUP = "dup"              # stream frame: deliver twice (transport replay).
+#                          Only SEQUENCED DATA frames duplicate — scope
+#                          DUP rules with match=... on msg_type/stream_seq
+#                          or a firing on another frame is a counted no-op
+EXHAUST = "exhaust"      # block pool: alloc raises MemoryError
+
+# Module-level fast gate.  Sites check this BEFORE any per-site work;
+# install()/clear() are the only writers.  Reading a module attribute is
+# the whole disabled-path cost.
+ENABLED = False
+
+_plan: Optional["FaultPlan"] = None
+_mu = threading.Lock()
+
+# per-site injected counters on /vars (created once per process, reused
+# across plans — bvar names must stay unique)
+_counters: dict[str, Adder] = {}
+_counters_mu = threading.Lock()
+
+
+def _counter(site: str) -> Adder:
+    with _counters_mu:
+        c = _counters.get(site)
+        if c is None:
+            c = Adder("fault_injected_" + site.replace(".", "_"))
+            _counters[site] = c
+        return c
+
+
+def injected_counts() -> dict[str, int]:
+    """Process-lifetime injected counts per site (the /vars view)."""
+    with _counters_mu:
+        return {site: c.get_value() for site, c in _counters.items()}
+
+
+@dataclass
+class Fault:
+    """One fired decision, handed to the site for interpretation."""
+    site: str
+    kind: str
+    latency_s: float = 0.0
+    rc: int = -1
+
+
+class _Rule:
+    __slots__ = ("kind", "times", "after", "prob", "latency_s", "rc",
+                 "match", "seen", "fired", "rng")
+
+    def __init__(self, kind: str, times: int, after: int, prob: float,
+                 latency_s: float, rc: int,
+                 match: Optional[Callable[[dict], bool]], rng_seed: str):
+        self.kind = kind
+        self.times = times          # fire at most this many; <0 = forever
+        self.after = after          # skip the first `after` matching hits
+        self.prob = prob
+        self.latency_s = latency_s
+        self.rc = rc
+        self.match = match
+        self.seen = 0
+        self.fired = 0
+        # per-rule RNG: decisions at one site never perturb another's
+        # sequence, and re-running the same plan replays the same schedule
+        self.rng = random.Random(rng_seed)
+
+
+class FaultPlan:
+    """A seeded schedule of faults.  Thread-safe; rules are evaluated in
+    the order added and the FIRST matching rule fires."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._mu = threading.Lock()
+        self._rules: dict[str, list[_Rule]] = {}
+        # per-plan fired counts, for test assertions (the bvar counters
+        # are process-cumulative)
+        self.injected: dict[str, int] = {}
+
+    def on(self, site: str, kind: str, *, times: int = 1, after: int = 0,
+           prob: float = 1.0, latency_s: float = 0.01, rc: int = -1,
+           match: Optional[Callable[[dict], bool]] = None) -> "FaultPlan":
+        """Schedule `kind` at `site`.  `times` bounds total firings (<0 =
+        persistent), `after` skips the first N matching hits (one-shot
+        mid-sequence faults), `prob` gates each hit through the rule's
+        seeded RNG, `match` (a predicate over the site's context kwargs,
+        e.g. ``lambda ctx: ctx.get("port") == p``) scopes the rule so
+        unrelated in-process traffic cannot consume its budget."""
+        with self._mu:
+            idx = sum(len(r) for r in self._rules.values())
+            self._rules.setdefault(site, []).append(
+                _Rule(kind, times, after, prob, latency_s, rc, match,
+                      f"{self.seed}:{site}:{idx}"))
+        return self
+
+    def _hit(self, site: str, ctx: dict) -> Optional[Fault]:
+        with self._mu:
+            rules = self._rules.get(site)
+            if not rules:
+                return None
+            for r in rules:
+                if r.match is not None and not r.match(ctx):
+                    continue
+                r.seen += 1
+                if r.seen <= r.after:
+                    continue
+                if r.times >= 0 and r.fired >= r.times:
+                    continue
+                if r.prob < 1.0 and r.rng.random() >= r.prob:
+                    continue
+                r.fired += 1
+                self.injected[site] = self.injected.get(site, 0) + 1
+                return Fault(site, r.kind, r.latency_s, r.rc)
+        return None
+
+
+def install(plan: FaultPlan) -> None:
+    global _plan, ENABLED
+    with _mu:
+        _plan = plan
+        ENABLED = True
+
+
+def clear() -> None:
+    global _plan, ENABLED
+    with _mu:
+        ENABLED = False
+        _plan = None
+
+
+@contextmanager
+def injected(plan: FaultPlan):
+    """``with fault.injected(plan): ...`` — installs the plan for the
+    block and always clears it (a leaked ENABLED flag would poison every
+    later test in the process)."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def hit(site: str, **ctx) -> Optional[Fault]:
+    """Decide whether a fault fires at `site` (call ONLY behind an
+    ``if fault.ENABLED:`` guard).  LATENCY is applied here — sleep, then
+    return None so the site proceeds; every other kind returns the Fault
+    for the site to interpret in its own failure convention."""
+    plan = _plan
+    if plan is None:
+        return None
+    f = plan._hit(site, ctx)
+    if f is None:
+        return None
+    _counter(site).add(1)
+    if f.kind == LATENCY:
+        time.sleep(f.latency_s)
+        return None
+    return f
+
+
+def mangle(data: bytes) -> bytes:
+    """Deterministically corrupt a payload: flip every bit of the middle
+    byte.  Enough to break any CRC/framing check downstream; position and
+    value are functions of the payload alone so runs replay exactly."""
+    if not data:
+        return data
+    b = bytearray(data)
+    i = len(b) // 2
+    b[i] ^= 0xFF
+    return bytes(b)

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from brpc_tpu import fault
 from brpc_tpu.bvar import Adder, PassiveStatus
 
 # Host-bounce counters for the rail's zero-host-copy proof
@@ -166,6 +167,13 @@ class BlockPool:
 
     def alloc(self, nbytes: int) -> Block:
         """Smallest class that fits (AllocBlock, block_pool.h:76-88)."""
+        if fault.ENABLED and fault.hit(
+                "ici.alloc", device=self.device.id,
+                nbytes=nbytes) is not None:
+            # injected arena exhaustion: same shape as every class being
+            # out of slots, so callers walk their real fallback paths
+            raise MemoryError(
+                f"injected HBM block exhaustion ({nbytes}B)")
         for cls in BLOCK_CLASSES:
             if nbytes <= cls:
                 with self._lock:
@@ -204,7 +212,16 @@ def stage_chunks(data, src_pool: "BlockPool"):
     chunk = BLOCK_CLASSES[-1]
     for off in range(0, len(view), chunk):
         piece = view[off:off + chunk]
-        yield src_pool.alloc(len(piece)).put(piece)
+        blk = src_pool.alloc(len(piece))
+        try:
+            blk.put(piece)
+        except BaseException:
+            # a failed put must not leak the freshly-allocated block
+            # (error-path discipline: the block is only the consumer's
+            # once it has been yielded)
+            blk.free()
+            raise
+        yield blk
 
 
 _pools: dict[int, BlockPool] = {}

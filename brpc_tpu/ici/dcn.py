@@ -37,7 +37,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from brpc_tpu import errors
+from brpc_tpu import errors, fault
 from brpc_tpu.bvar import Adder
 from brpc_tpu.rpc.service import Service, method
 
@@ -275,6 +275,13 @@ class DcnService(Service):
         # bytes, _pack_envelope) — NOT pickle: this method is reachable by
         # anything that can open the RPC port, and unpickling network
         # bytes is arbitrary code execution
+        if fault.ENABLED and fault.hit("dcn.serve") is not None:
+            # injected server-side hop loss: the caller gets a definite
+            # EINTERNAL instead of silence (the transport owns failure
+            # semantics — "RPC Considered Harmful" discipline)
+            cntl.set_failed(errors.EINTERNAL,
+                            "injected DCN hop loss (server)")
+            return None
         import jax
         from brpc_tpu.ici.channel import _compiled
         from brpc_tpu.ici.mesh import device_for
@@ -400,6 +407,10 @@ class DcnChannel:
     def call_sync(self, service: str, method_name: str, request: Any,
                   chip: Optional[int] = None):
         import jax
+        if fault.ENABLED and fault.hit("dcn.call",
+                                       remote=self.remote) is not None:
+            raise errors.RpcError(errors.EINTERNAL,
+                                  f"injected DCN hop loss to {self.remote}")
         topo = self.handshake()
         target_chip = chip if chip is not None else (self.default_chip or 0)
         if target_chip not in {d["id"] for d in topo["devices"]}:

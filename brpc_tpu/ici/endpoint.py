@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 
+from brpc_tpu import fault
 from brpc_tpu.bvar import Adder, LatencyRecorder
 
 _send_bytes = Adder("ici_send_bytes")
@@ -183,6 +184,11 @@ class IciEndpoint:
         t0 = time.monotonic()
         try:
             with self._dispatch_mu:
+                if fault.ENABLED and fault.hit(
+                        "ici.send", device=self.device.id) is not None:
+                    # injected transfer failure BEFORE dispatch: the
+                    # except below must release the window reservation
+                    raise RuntimeError("injected ici transfer fault")
                 # dispatch and enqueue atomically: with concurrent senders
                 # the completion queue must mirror device dispatch order,
                 # or the drainer's tail-sync would free window credit for
@@ -231,6 +237,10 @@ class IciEndpoint:
         # the queued share and drive the window counter negative)
         queued = 0
         try:
+            if fault.ENABLED and fault.hit(
+                    "ici.send", device=self.device.id) is not None:
+                # nothing queued yet: the except releases the full total
+                raise RuntimeError("injected ici transfer fault")
             with self._dispatch_mu:
                 same = []
                 cross = []
@@ -316,11 +326,17 @@ class IciEndpoint:
     def send_bytes(self, data, src_pool, timeout_s: float = 30.0) -> list:
         """Chunk `data` into blocks from `src_pool` (staged into that
         device's HBM arena), move them over this endpoint, and return the
-        destination Blocks.  Frees the staging blocks."""
+        destination Blocks.  Frees the staging blocks — INCLUDING on a
+        mid-staging failure: blocks are collected as the generator yields
+        them, so an alloc exhaustion on chunk k still frees chunks 1..k-1
+        (with `staged = list(...)` the partial list was discarded and the
+        already-staged blocks leaked; found by the chaos suite's injected
+        block-pool exhaustion)."""
         from brpc_tpu.ici.block_pool import stage_chunks
-        staged = []
+        staged: list = []
         try:
-            staged = list(stage_chunks(data, src_pool))
+            for blk in stage_chunks(data, src_pool):
+                staged.append(blk)
             return self.send_blocks(staged, timeout_s=timeout_s)
         finally:
             for blk in staged:

@@ -215,6 +215,19 @@ class SocketMap:
         if ep is not None and not deliberate:
             on_connection_failed(ep)
 
+    def evict(self, ep: EndPoint, sid: int) -> None:
+        """Drop the cached single-connection mapping for `ep` iff it
+        still points at `sid` — no close, no failure marking.  Used when
+        a write already failed on `sid`: the socket is dying, but its
+        failed-callback cleanup may still be in flight on another
+        thread, and a retry that re-checks out the same dying
+        connection burns every attempt on it (found by chaos injection,
+        tests/test_chaos.py mid-call reset)."""
+        with self._lock:
+            c = self._conns.get(ep)
+            if c is not None and c.sid == sid:
+                del self._conns[ep]
+
     def drop(self, ep: EndPoint) -> None:
         with self._lock:
             c = self._conns.pop(ep, None)
@@ -768,6 +781,14 @@ class Channel:
                 from brpc_tpu.ici import rail
                 stream.peer_device = rail.lookup(ep)
             stream.bind(conn.sid)
+        # attempt version at write time: failing the socket below can
+        # run the failed-socket callback SYNCHRONOUSLY, whose retry path
+        # bumps current_attempt and re-issues — after which THIS frame's
+        # failure is stale and must stay silent (the reference's
+        # bthread_id versioning, OnVersionedRPCReturned; chaos-pinned:
+        # a stale path that kept going either finished the call with no
+        # response or issued a duplicate attempt)
+        attempt = cntl.current_attempt
         if (not meta.auth and not meta.trace_id and not meta.span_id
                 and not meta.stream_id and not meta.tensor_header
                 and not meta.user_fields and not meta.attachment_size):
@@ -783,10 +804,30 @@ class Channel:
             if rc == -2:
                 # native write-queue bound tripped (Socket::Write -2):
                 # the peer is reading too slowly for this call's bytes
-                cntl.set_failed(errors.EOVERCROWDED,
-                                "socket write queue overcrowded")
+                # (the socket is healthy — keep it cached).  The guard
+                # is ATOMIC under the completion lock: an unlocked
+                # check-then-act here could still stomp a concurrently
+                # completing call's state
+                cntl.set_failed_if_current(attempt, errors.EOVERCROWDED,
+                                           "socket write queue overcrowded")
             else:
-                cntl.set_failed(errors.EFAILEDSOCKET, "write failed")
+                cntl.set_failed_if_current(attempt, errors.EFAILEDSOCKET,
+                                           "write failed")
+                if self.options.connection_type == "single":
+                    # the socket is dying but its failed-callback
+                    # cleanup may still be in flight on another thread:
+                    # evict the cached mapping NOW so the retry below
+                    # reconnects instead of re-checking out the same
+                    # dying connection and burning every attempt on it
+                    smap.evict(ep, conn.sid)
+                # and make sure the socket IS failed: a real rc=-1 means
+                # it already is (a no-op then), but an evicted-yet-open
+                # socket (e.g. an injected plain write error) would leak
+                # its fd + handler entries forever.  May synchronously
+                # hand the call to the failed-callback's retry path.
+                Transport.instance().close(conn.sid, 0)
+            if cntl.current_attempt > attempt or cntl.completed:
+                return   # a newer attempt or a completion owns the call
             if self._should_retry(st):
                 return
             mgr._finish(st)

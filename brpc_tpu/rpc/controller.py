@@ -172,12 +172,36 @@ class Controller:
         self.error_code = code
         self.error_text = text or errors.describe(code)
 
+    def set_failed_if_current(self, attempt: int, code: int,
+                              text: str = "") -> bool:
+        """set_failed iff the call is not completed AND `attempt` is
+        still the current attempt — check and set atomically under the
+        completion lock, so a stale failure path (a failed write racing
+        a concurrently-completing response) can never overwrite a
+        finished call's state.  Same discipline as reset_for_retry."""
+        with self._lock:
+            if self._completed or self.current_attempt != attempt:
+                return False
+            self.error_code = code
+            self.error_text = text or errors.describe(code)
+            return True
+
     def reset_for_retry(self) -> None:
-        self.error_code = 0
-        self.error_text = ""
-        # fields from a FAILED attempt must not leak into a later
-        # successful completion
-        self.response_user_fields = {}
+        # Guarded by the completion lock: a retry path that loses the
+        # race to a concurrently-arriving completion (success response on
+        # the dispatcher thread vs the failed-write retry on the caller
+        # thread) must NOT wipe the finished call's error/response state
+        # — the chaos suite's exactly-once invariant (the doomed extra
+        # attempt it goes on to issue is dropped by the pending-table
+        # lookup like any stale attempt).
+        with self._lock:
+            if self._completed:
+                return
+            self.error_code = 0
+            self.error_text = ""
+            # fields from a FAILED attempt must not leak into a later
+            # successful completion
+            self.response_user_fields = {}
 
     # ---- completion (exactly once) ----
 

@@ -29,7 +29,7 @@ from typing import Callable, Optional
 # sentinel closing a client-side streaming sink (trailers seen, status 0)
 _STREAM_END = object()
 
-from brpc_tpu import errors
+from brpc_tpu import errors, fault
 from brpc_tpu.rpc.hpack import HpackDecoder, HpackEncoder
 from brpc_tpu.rpc.transport import MSG_H2, Transport
 
@@ -295,7 +295,7 @@ _CODEC_UNSET = ("unset",)
 class _StreamState:
     __slots__ = ("id", "headers", "data", "trailers", "ended", "send_window",
                  "header_block", "expect_continuation", "trailer_phase",
-                 "reset", "rx_codec", "recv_unacked")
+                 "reset", "rx_codec", "recv_unacked", "responded")
 
     def __init__(self, sid: int, initial_window: int):
         self.id = sid
@@ -308,6 +308,10 @@ class _StreamState:
         self.expect_continuation = False
         self.trailer_phase = False
         self.reset = False
+        # server side: set atomically under _fc by the responder that
+        # claims this stream's response HEADERS (claim_responder) — the
+        # duplicate-trailers guard for shed-vs-handler races
+        self.responded = False
         # peer's grpc-encoding codec, resolved once at HEADERS time
         # (deriving it per DATA frame is O(headers) on the hot path)
         self.rx_codec = _CODEC_UNSET
@@ -372,7 +376,36 @@ class H2Connection:
                 first + build_frame(SETTINGS, 0, 0, settings)
                 + build_frame(WINDOW_UPDATE, 0, 0, wu))
 
+    def _chaos_frames(self, data: bytes) -> Optional[bytes]:
+        """h2.send fault interpretation, shared by _send AND the joined
+        unary fast paths (which write_raw directly): returns the bytes
+        to put on the wire — mangled by a CORRUPT fault — or None for an
+        injected send failure (a counted injection is never a no-op).
+        On None the CALLER must invoke _chaos_kill OUTSIDE _send_lock:
+        failure callbacks fire synchronously and may send (GOAWAY), so
+        closing under the non-reentrant send lock would self-deadlock."""
+        f = fault.hit("h2.send", sid=self.sid)
+        if f is None:
+            return data
+        if f.kind == fault.CORRUPT:
+            # one flipped byte: the peer's framing/HPACK checks must
+            # catch it (protocol error -> fatal/GOAWAY), or it surfaces
+            # as a corrupted grpc message body
+            return fault.mangle(data)
+        return None
+
+    def _chaos_kill(self) -> None:
+        """Injected send failure: the connection dies the way a real
+        mid-write failure kills it."""
+        if self.sid is not None:
+            self._tp.close(self.sid)
+
     def _send(self, data: bytes) -> None:
+        if fault.ENABLED:
+            data = self._chaos_frames(data)
+            if data is None:
+                self._chaos_kill()
+                return
         with self._send_lock:
             self._tp.write_raw(self.sid, data)
 
@@ -397,6 +430,22 @@ class H2Connection:
     def close_stream(self, stream_id: int) -> None:
         with self._fc:
             self._streams.pop(stream_id, None)
+
+    def claim_responder(self, stream_id: int) -> bool:
+        """Atomically claim the right to open the response on
+        `stream_id` (ADVICE r5).  The liveness check and the claim
+        happen under ONE _fc hold, so a backlog shed and a concurrently
+        finishing handler can never BOTH emit response/trailers HEADERS
+        on the same stream — the old check-then-act guard released _fc
+        before send_headers, leaving that window open.  Returns False
+        when the stream is gone (shed/RST/closed) or another responder
+        already won; the loser stays silent."""
+        with self._fc:
+            st = self._streams.get(stream_id)
+            if st is None or st.responded:
+                return False
+            st.responded = True
+            return True
 
     def send_data(self, stream_id: int, data: bytes,
                   end_stream: bool = True, timeout_s: float = 30.0) -> None:
@@ -487,7 +536,12 @@ class H2Connection:
             buf = build_frame(HEADERS, FLAG_END_HEADERS, stream_id,
                               self._enc.encode_cached(tuple(headers)))
             buf += build_frame(DATA, FLAG_END_STREAM, stream_id, data)
-            self._tp.write_raw(self.sid, buf)
+            if fault.ENABLED:
+                buf = self._chaos_frames(buf)
+            if buf is not None:
+                self._tp.write_raw(self.sid, buf)
+        if buf is None:
+            self._chaos_kill()    # outside _send_lock (callbacks may send)
         return True
 
     def send_response_joined(self, stream_id: int,
@@ -504,7 +558,12 @@ class H2Connection:
             buf += build_frame(HEADERS, FLAG_END_HEADERS | FLAG_END_STREAM,
                                stream_id,
                                self._enc.encode_cached(tuple(trailers)))
-            self._tp.write_raw(self.sid, buf)
+            if fault.ENABLED:
+                buf = self._chaos_frames(buf)
+            if buf is not None:
+                self._tp.write_raw(self.sid, buf)
+        if buf is None:
+            self._chaos_kill()    # outside _send_lock (callbacks may send)
         return True
 
     def send_rst(self, stream_id: int, code: int) -> None:
@@ -521,6 +580,10 @@ class H2Connection:
     def on_frame(self, hdr9: bytes, payload: bytes) -> None:
         if self._fatal:
             return      # desynced HPACK state: nothing more is decodable
+        if fault.ENABLED:
+            f = fault.hit("h2.recv", sid=self.sid)
+            if f is not None and f.kind == fault.DROP:
+                return  # frame lost above the transport
         ftype = hdr9[3]
         flags = hdr9[4]
         stream_id = struct.unpack(">I", hdr9[5:9])[0] & 0x7FFFFFFF
@@ -1061,9 +1124,8 @@ class GrpcServerConnection(H2Connection):
             if code != 0:
                 self._respond_error(st.id, err_to_grpc(code), text)
                 return
-            with self._fc:
-                if st.id not in self._streams:
-                    return   # shed/reset while the handler ran: stay silent
+            if not self.claim_responder(st.id):
+                return   # shed/reset while the handler ran: stay silent
             enc_name, tx_codec = response_codec_for(h)
             self.send_headers(st.id, self._resp_headers(enc_name))
             if isinstance(resp, (bytes, bytearray, memoryview)):
@@ -1135,6 +1197,8 @@ class GrpcServerConnection(H2Connection):
             if code != 0:
                 self._respond_error(st.id, err_to_grpc(code), text)
                 return
+            if not self.claim_responder(st.id):
+                return   # shed/reset while the handler ran: stay silent
             enc_name, tx_codec = response_codec_for(h)
             if isinstance(resp, (bytes, bytearray, memoryview)):
                 framed = grpc_frame_auto(bytes(resp), tx_codec)
@@ -1240,14 +1304,16 @@ class GrpcServerConnection(H2Connection):
 
     def _respond_error(self, stream_id: int, status: int, msg: str) -> None:
         # liveness guard: once a stream is shed/RST/closed (popped from
-        # _streams), a late responder — e.g. a parked bidi handler that
-        # unparks AFTER the backlog shed already sent trailers — must
-        # stay silent.  A second HEADERS on a closed stream is a
-        # connection-level PROTOCOL_ERROR to a conforming peer (the
-        # native plane guards this with st->closed_local).
-        with self._fc:
-            if stream_id not in self._streams:
-                return
+        # _streams) or another responder claimed it, a late responder —
+        # e.g. a parked bidi handler that unparks AFTER the backlog shed
+        # already sent trailers — must stay silent.  A second HEADERS on
+        # a closed stream is a connection-level PROTOCOL_ERROR to a
+        # conforming peer (the native plane guards this with
+        # st->closed_local).  The claim is atomic under _fc (ADVICE r5):
+        # check-then-act here used to race a finishing handler whose own
+        # guard passed before close_stream ran.
+        if not self.claim_responder(stream_id):
+            return
         self.send_headers(stream_id, [
             (":status", "200"),
             ("content-type", "application/grpc"),

@@ -37,7 +37,7 @@ import logging
 import threading
 from typing import Callable, Optional
 
-from brpc_tpu import errors
+from brpc_tpu import errors, fault
 from brpc_tpu.bvar import Adder
 from brpc_tpu.rpc import meta as M
 from brpc_tpu.rpc.transport import Transport
@@ -46,6 +46,14 @@ from brpc_tpu.rpc.transport import Transport
 # fires silently is a bound operators can't see tripping)
 reorder_replays_dropped = Adder("stream_reorder_replays_dropped")
 reorder_overflow_closes = Adder("stream_reorder_overflow_closes")
+# Bytes of dropped replayed/duplicate DATA frames (ADVICE r5): dropped
+# duplicates are never acked, so their bytes permanently consume the
+# SENDER's credit window.  Intentional for hostile peers on today's
+# no-retransmit transport — but if transport-level redelivery is ever
+# introduced, a wedged writer's credit shortfall must be explainable by
+# this counter instead of being silent (the chaos drain test asserts
+# exactly that).
+reorder_replay_bytes_dropped = Adder("stream_reorder_replay_bytes_dropped")
 
 DEFAULT_BUF_SIZE = 2 * 1024 * 1024
 
@@ -313,8 +321,13 @@ class Stream:
                 # replay of a delivered or in-flight seq: a sub-
                 # _recv_next entry would park in the dict FOREVER (the
                 # drain only pops forward), so a replaying peer could
-                # grow it without bound — drop duplicates outright
+                # grow it without bound — drop duplicates outright.
+                # NOTE: dropped bytes are never acked, so they consume
+                # the sender's credit window permanently — counted so a
+                # credit shortfall under (future) redelivery is visible
+                # on /vars rather than a silent writer wedge.
                 reorder_replays_dropped.add(1)
+                reorder_replay_bytes_dropped.add(nbytes)
                 return
             self._reorder[seq] = (payload, nbytes)
             self._reorder_bytes += nbytes
@@ -410,6 +423,13 @@ class Stream:
                 self._last_feedback = self._consumed_local
         if send_feedback and self._sid is not None and \
                 self.remote_id is not None:
+            if fault.ENABLED and fault.hit(
+                    "stream.feedback", stream_id=self.stream_id) is not None:
+                # injected feedback loss: the sender's credit stays
+                # consumed until the NEXT threshold crossing — offsets
+                # are cumulative, so one lost frame delays credit return
+                # rather than leaking it
+                return
             meta = M.RpcMeta(msg_type=M.MSG_STREAM_FEEDBACK,
                              stream_id=self.remote_id,
                              stream_offset=self._consumed_local)
@@ -555,16 +575,40 @@ class StreamRegistry:
         with self._mu:
             return len(self._streams)
 
+    @staticmethod
+    def _withdraw_ticket(meta: M.RpcMeta) -> None:
+        """An undeliverable DATA frame's rail ticket must still be
+        withdrawn, or its HBM blocks sit pinned until the registry TTL
+        fires — shared by the dead-stream path and the injected-DROP
+        path, so the discipline lives in one place."""
+        if meta.msg_type == M.MSG_STREAM_DATA and meta.user_fields \
+                and meta.user_fields.get(M.F_TICKET):
+            from brpc_tpu.ici import rail
+            rail.withdraw(meta.user_fields[M.F_TICKET])
+
     def on_frame(self, sid: int, meta: M.RpcMeta, body) -> None:
         # meta.stream_id addresses the RECEIVER's local stream.
+        dup = False
+        if fault.ENABLED:
+            # ctx carries msg_type AND stream_seq so plans can scope
+            # rules to the frames a kind is meaningful for — DUP in
+            # particular only duplicates SEQUENCED data (the seq==0
+            # compat branch delivers in arrival order with no dedup);
+            # scope DUP rules with match=... on msg_type/stream_seq or
+            # the firing is a counted no-op on other frames
+            f = fault.hit("stream.frame", stream_id=meta.stream_id,
+                          msg_type=meta.msg_type,
+                          stream_seq=meta.stream_seq)
+            if f is not None:
+                if f.kind == fault.DROP:
+                    self._withdraw_ticket(meta)
+                    return
+                dup = (f.kind == fault.DUP
+                       and meta.msg_type == M.MSG_STREAM_DATA
+                       and meta.stream_seq != 0)
         s = self.get(meta.stream_id)
         if s is None:
-            # a ticket on a dead stream must still be withdrawn, or its
-            # HBM blocks sit pinned until the registry TTL fires
-            if meta.msg_type == M.MSG_STREAM_DATA and meta.user_fields \
-                    and meta.user_fields.get(M.F_TICKET):
-                from brpc_tpu.ici import rail
-                rail.withdraw(meta.user_fields[M.F_TICKET])
+            self._withdraw_ticket(meta)
             return
         if s._sid is None:
             s.bind(sid)
@@ -578,6 +622,11 @@ class StreamRegistry:
                 s._on_closed_internal()
                 return
             s._on_data(payload, nbytes, meta.stream_seq)
+            if dup:
+                # injected transport-level redelivery: the duplicate must
+                # be dropped by the reorder layer and its bytes counted
+                # (reorder_replay_bytes_dropped), never delivered twice
+                s._on_data(payload, nbytes, meta.stream_seq)
         elif meta.msg_type == M.MSG_STREAM_FEEDBACK:
             s._on_feedback(meta.stream_offset)
         elif meta.msg_type == M.MSG_STREAM_CLOSE:

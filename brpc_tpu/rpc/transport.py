@@ -19,6 +19,36 @@ from brpc_tpu._core import (ACCEPTED_CB, FAILED_CB, H2_EVENT_CB, IOBuf,
                             MSG_THRIFT, MSG_TRPC, REQUEST_CB, RESPONSE_CB,
                             TASK_CB, core, core_init)
 from brpc_tpu._core import _fastrpc
+from brpc_tpu import fault
+
+
+def _apply_send_fault(sid: int, payload):
+    """ONE interpreter for every transport.send site (call only behind
+    ``fault.ENABLED``).  Returns (rc, payload): a non-None rc
+    short-circuits the write; otherwise the caller writes `payload`,
+    which a CORRUPT fault mangles in place.  Each site passes the bytes
+    whose corruption is meaningful there — the meta for framed writes
+    (peer-side decode discards the frame), the raw buffer or the body
+    for the others — so a counted injection is never a no-op."""
+    f = fault.hit("transport.send", sid=sid)
+    if f is None:
+        return None, payload
+    if f.kind == fault.CORRUPT:
+        return None, fault.mangle(bytes(payload)) if payload else payload
+    if f.kind == fault.OVERCROWD:
+        return -2, payload
+    if f.kind in (fault.RESET, fault.PARTIAL):
+        if f.kind == fault.PARTIAL:
+            # a torn prefix reaches the peer's parser before the close —
+            # the classic half-written frame of a mid-write process death
+            torn = b"TRPC\x00\x00\x00\x08"
+            try:
+                core.brpc_socket_write_raw(sid, torn, len(torn), None)
+            except Exception:
+                pass
+        core.brpc_socket_set_failed(sid, 104)   # ECONNRESET
+        return -1, payload
+    return f.rc, payload   # ERROR: plain write failure
 
 
 class Transport:
@@ -66,6 +96,15 @@ class Transport:
                     eng.feed_ciphertext(buf.to_bytes())
                 return
             m = ctypes.string_at(meta, meta_len) if meta_len else b""
+            if fault.ENABLED:
+                f = fault.hit("transport.recv", sid=sid, kind=kind)
+                if f is not None:
+                    if f.kind == fault.DROP:
+                        return          # delivered by TCP, lost above it
+                    if f.kind == fault.CORRUPT:
+                        # mangled meta fails RpcMeta.decode downstream —
+                        # the frame is discarded exactly like line noise
+                        m = fault.mangle(m)
             h = self._handlers.get(sid)
             if h is not None:
                 try:
@@ -192,6 +231,10 @@ class Transport:
         return sid.value, bound.value
 
     def connect(self, host: str, port: int, on_message, on_failed=None) -> int:
+        if fault.ENABLED and fault.hit("transport.connect", host=host,
+                                       port=port) is not None:
+            raise ConnectionError(
+                f"injected connect refusal to {host}:{port}")
         sid = ctypes.c_uint64()
         rc = core.brpc_connect(host.encode(), port, self._cb_message,
                                self._cb_failed, None, ctypes.byref(sid))
@@ -284,6 +327,10 @@ class Transport:
                     on_response=None) -> int:
         """Connect with the pre-parsed response fast path (the C response
         trampoline from _fastrpc — zero ctypes on the per-response path)."""
+        if fault.ENABLED and fault.hit("transport.connect", host=host,
+                                       port=port) is not None:
+            raise ConnectionError(
+                f"injected connect refusal to {host}:{port}")
         sid = ctypes.c_uint64()
         rc = core.brpc_connect_rpc(
             host.encode(), port, self._cb_message, self._cb_failed,
@@ -345,6 +392,10 @@ class Transport:
         encode, no ctypes marshalling).  TLS connections pack in Python
         and ride the engine instead (the native writer would emit
         plaintext)."""
+        if fault.ENABLED:
+            rc, body = _apply_send_fault(sid, body)
+            if rc is not None:
+                return rc
         inst = Transport._instance
         eng = inst._tls.get(sid) if inst is not None else None
         if eng is not None:
@@ -363,6 +414,10 @@ class Transport:
     def send_response(sid: int, cid: int, attempt: int, error_code: int,
                       error_text: str, content_type: str,
                       body: bytes) -> int:
+        if fault.ENABLED:
+            rc, body = _apply_send_fault(sid, body)
+            if rc is not None:
+                return rc
         inst = Transport._instance
         eng = inst._tls.get(sid) if inst is not None else None
         if eng is not None:
@@ -379,6 +434,13 @@ class Transport:
 
     def write_frame(self, sid: int, meta: bytes, body: bytes = b"",
                     body_iobuf: IOBuf | None = None) -> int:
+        if fault.ENABLED:
+            # CORRUPT mangles the META: the frame arrives, parses as
+            # TRPC, fails decode at the peer and is discarded —
+            # in-flight corruption the framing cannot catch
+            rc, meta = _apply_send_fault(sid, meta)
+            if rc is not None:
+                return rc
         eng = self._tls.get(sid)
         if eng is not None:
             full = bytes(body)
@@ -406,6 +468,10 @@ class Transport:
         return self.write_raw(sid, payload)
 
     def write_raw(self, sid: int, data: bytes) -> int:
+        if fault.ENABLED:
+            rc, data = _apply_send_fault(sid, data)
+            if rc is not None:
+                return rc
         eng = self._tls.get(sid)
         if eng is not None:
             return eng.write_plain(bytes(data))

@@ -32,6 +32,15 @@ assert len(jax.devices()) == 8, (
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; chaos scenarios that outgrow ~5s
+    # carry this marker so the fast suite stays fast (make chaos runs
+    # everything)
+    config.addinivalue_line(
+        "markers", "slow: long-running chaos/scenario tests excluded "
+                   "from the tier-1 fast suite")
+
+
 @pytest.fixture(autouse=True, scope="session")
 def _quiet_naming_refresh_noise():
     """Dead loopback registries from already-finished tests would spam

@@ -1,0 +1,826 @@
+"""Chaos test suite — seeded fault injection over the full RPC/ICI data
+path (brpc_tpu/fault.py).
+
+Each scenario runs REAL client/server pairs over loopback under a
+deterministic fault schedule and asserts the hard invariants the
+recovery stack promises:
+
+  * every call finishes exactly once, with a definite success or error
+    (never a hang, never a double completion);
+  * no leaked deadline/backup timers after calls complete;
+  * block-pool occupancy and stream credit return to baseline after
+    drain (duplicate-frame credit loss is explained by the
+    reorder_replay_bytes_dropped counter, never silent);
+  * broken endpoints get probed and revived once reachable, and the
+    circuit-breaker isolation hold is respected while broken.
+
+Scenarios are parametrized over three fixed seeds (override with
+BRPC_CHAOS_SEEDS=..., comma-separated) so the schedule is a regression
+artifact, not a dice roll.  `make chaos` runs exactly this file.
+"""
+import io
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors, fault
+from brpc_tpu.butil.endpoint import str2endpoint
+from brpc_tpu.rpc import meta as M
+from brpc_tpu.rpc.channel import CallManager, SocketMap
+from brpc_tpu.rpc.transport import Transport
+
+from testutil import wait_until
+
+SEEDS = [int(s) for s in
+         os.environ.get("BRPC_CHAOS_SEEDS", "101,202,303").split(",")]
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    """Fast health probes for the duration, and NEVER leak an installed
+    plan or broken-endpoint state into the rest of the suite."""
+    from brpc_tpu.policy import health_check as hc
+    old = hc.health_check_interval_s
+    hc.health_check_interval_s = 0.05
+    fault.clear()
+    yield
+    fault.clear()
+    hc.health_check_interval_s = old
+    hc.reset_all()
+
+
+class EchoService(brpc.Service):
+    NAME = "ChaosEcho"
+
+    @brpc.method(request="json", response="json")
+    def Echo(self, cntl, req):
+        return {"msg": req["msg"]}
+
+
+@pytest.fixture()
+def server():
+    s = brpc.Server()
+    s.add_service(EchoService())
+    s.start("127.0.0.1", 0)
+    yield s
+    s.stop()
+    s.join()
+
+
+class DoneCounter:
+    """Counts completions — the exactly-once probe.  Locked: a double
+    completion is by definition two threads racing into __call__, and an
+    unsynchronized += could lose exactly the increment that proves it."""
+
+    def __init__(self):
+        self.n = 0
+        self.cntl = None
+        self.event = threading.Event()
+        self._mu = threading.Lock()
+
+    def __call__(self, cntl):
+        with self._mu:
+            self.n += 1
+        self.cntl = cntl
+        self.event.set()
+
+
+def _timer_count() -> int:
+    return len(Transport.instance()._timer_cbs)
+
+
+def _pending_calls() -> int:
+    return len(CallManager.instance()._pending)
+
+
+def assert_quiesced(timers_before: int) -> None:
+    """No call left pending, no deadline/backup timer leaked."""
+    assert wait_until(lambda: _pending_calls() == 0, 10), \
+        f"{_pending_calls()} calls still pending after chaos"
+    assert wait_until(lambda: _timer_count() <= timers_before, 10), \
+        f"timers leaked: {_timer_count()} > baseline {timers_before}"
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: connection refused, retry succeeds
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_connect_refused_then_retry(server, seed):
+    port = server.port
+    plan = fault.FaultPlan(seed).on(
+        "transport.connect", fault.REFUSE, times=1,
+        match=lambda ctx: ctx.get("port") == port)
+    timers0 = _timer_count()
+    ch = brpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000, max_retry=3)
+    done = DoneCounter()
+    with fault.injected(plan):
+        ch.call("ChaosEcho", "Echo", {"msg": "hi"}, serializer="json",
+                done=done)
+        assert done.event.wait(10), "call hung under connect fault"
+    time.sleep(0.05)           # a double completion would land here
+    assert done.n == 1
+    assert not done.cntl.failed()
+    assert done.cntl.response == {"msg": "hi"}
+    assert plan.injected["transport.connect"] == 1
+    assert_quiesced(timers0)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_connect_refused_persistent_definite_error(server, seed):
+    port = server.port
+    plan = fault.FaultPlan(seed).on(
+        "transport.connect", fault.REFUSE, times=-1,
+        match=lambda ctx: ctx.get("port") == port)
+    timers0 = _timer_count()
+    ch = brpc.Channel(f"127.0.0.1:{port}", timeout_ms=2000, max_retry=2)
+    done = DoneCounter()
+    with fault.injected(plan):
+        ch.call("ChaosEcho", "Echo", {"msg": "hi"}, serializer="json",
+                done=done)
+        assert done.event.wait(10), "call hung under persistent refusal"
+    time.sleep(0.05)
+    assert done.n == 1
+    assert done.cntl.failed()
+    assert done.cntl.error_code == errors.ECONNREFUSED
+    # every attempt (first + 2 retries) was refused
+    assert plan.injected["transport.connect"] == 3
+    assert_quiesced(timers0)
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: mid-call connection reset -> retry + probe revival
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_midcall_reset_retries_and_endpoint_revives(server, seed):
+    from brpc_tpu.policy import health_check as hc
+    port = server.port
+    ep = str2endpoint(f"127.0.0.1:{port}")
+    ch = brpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000, max_retry=3)
+    assert ch.call_sync("ChaosEcho", "Echo", {"msg": "warm"},
+                        serializer="json") == {"msg": "warm"}
+    sid = SocketMap.instance()._conns[ep].sid
+    plan = fault.FaultPlan(seed).on(
+        "transport.send", fault.RESET, times=1,
+        match=lambda ctx: ctx.get("sid") == sid)
+    timers0 = _timer_count()
+    with fault.injected(plan):
+        resp = ch.call_sync("ChaosEcho", "Echo", {"msg": "again"},
+                            serializer="json")
+    assert resp == {"msg": "again"}
+    assert plan.injected["transport.send"] == 1
+    # the reset marked the endpoint broken; the server is alive, so the
+    # probe loop must revive it
+    assert wait_until(lambda: not hc.is_broken(ep), 10), \
+        "endpoint never revived after injected reset"
+    assert_quiesced(timers0)
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: corrupt frame on the gRPC/h2 plane -> definite outcome
+# ---------------------------------------------------------------------------
+
+class GrpcEcho(brpc.Service):
+    NAME = "chaos.Grpc"
+
+    @brpc.method(request="raw", response="raw")
+    def Echo(self, cntl, req):
+        return req
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_corrupt_h2_frame_definite_outcome(seed):
+    from brpc_tpu.rpc.h2 import GrpcChannel
+    srv = brpc.Server()
+    srv.add_service(GrpcEcho())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=3000)
+        payload = b"chaos-payload-" * 8
+        assert ch.call("chaos.Grpc", "Echo", payload) == payload   # warm
+        sid = ch._conn.sid
+        plan = fault.FaultPlan(seed).on(
+            "transport.send", fault.CORRUPT, times=1,
+            match=lambda ctx: ctx.get("sid") == sid)
+        with fault.injected(plan):
+            # one flipped byte mid-request: either the h2/HPACK framing
+            # catches it (connection error -> RpcError) or it lands in
+            # the opaque payload and the echo returns promptly — a
+            # DEFINITE outcome within the deadline either way, never a
+            # hang or a wedged connection
+            try:
+                ch.call("chaos.Grpc", "Echo", payload, timeout_ms=3000)
+            except errors.RpcError:
+                pass
+        assert plan.injected["transport.send"] == 1
+        # the plane must recover: a fresh call (reconnecting if the
+        # corruption killed the connection) succeeds
+        assert ch.call("chaos.Grpc", "Echo", b"after-chaos") == b"after-chaos"
+        # the h2.send site covers the JOINED unary fast path too: an
+        # injected send failure kills the connection -> definite error,
+        # then the channel reconnects
+        sid2 = ch._conn.sid
+        plan2 = fault.FaultPlan(seed).on(
+            "h2.send", fault.ERROR, times=1,
+            match=lambda ctx: ctx.get("sid") == sid2)
+        with fault.injected(plan2):
+            with pytest.raises(errors.RpcError):
+                ch.call("chaos.Grpc", "Echo", payload, timeout_ms=3000)
+        assert plan2.injected["h2.send"] == 1
+        assert ch.call("chaos.Grpc", "Echo", b"final") == b"final"
+    finally:
+        srv.stop()
+        srv.join()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_injected_write_error_does_not_leak_sockets(server, seed):
+    """A plain injected write error (rc=-1, socket left open by the
+    fault) must not leak the evicted connection: the retry path fails
+    the socket so its fd + handler entries are reclaimed."""
+    port = server.port
+    ep = str2endpoint(f"127.0.0.1:{port}")
+    ch = brpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000, max_retry=3)
+    assert ch.call_sync("ChaosEcho", "Echo", {"msg": "warm"},
+                        serializer="json") == {"msg": "warm"}
+    handlers0 = len(Transport.instance()._handlers)
+    for k in range(3):
+        sid = SocketMap.instance()._conns[ep].sid
+        plan = fault.FaultPlan(seed + k).on(
+            "transport.send", fault.ERROR, times=1,
+            match=lambda ctx, s=sid: ctx.get("sid") == s)
+        with fault.injected(plan):
+            resp = ch.call_sync("ChaosEcho", "Echo", {"msg": f"r{k}"},
+                                serializer="json")
+        assert resp == {"msg": f"r{k}"}
+        assert plan.injected["transport.send"] == 1
+    # each failed-write socket (and its server-side accepted twin) must
+    # be reclaimed through the normal failure path — at most the one
+    # live replacement pair outlasts the loop
+    assert wait_until(
+        lambda: len(Transport.instance()._handlers) <= handlers0 + 2,
+        10), (f"leaked socket handlers: "
+              f"{len(Transport.instance()._handlers)} > {handlers0} + 2")
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_corrupt_unary_body_definite_outcome(server, seed):
+    """CORRUPT on transport.send mangles the body even on the native
+    fast-send path (a counted injection is never a no-op): the call ends
+    definitively — either an error or a promptly-delivered (possibly
+    altered) response — and the channel recovers."""
+    port = server.port
+    ep = str2endpoint(f"127.0.0.1:{port}")
+    ch = brpc.Channel(f"127.0.0.1:{port}", timeout_ms=3000, max_retry=3)
+    assert ch.call_sync("ChaosEcho", "Echo", {"msg": "warm"},
+                        serializer="json") == {"msg": "warm"}
+    sid = SocketMap.instance()._conns[ep].sid
+    plan = fault.FaultPlan(seed).on(
+        "transport.send", fault.CORRUPT, times=1,
+        match=lambda ctx: ctx.get("sid") == sid)
+    timers0 = _timer_count()
+    with fault.injected(plan):
+        try:
+            ch.call_sync("ChaosEcho", "Echo", {"msg": "x" * 64},
+                         serializer="json")
+        except errors.RpcError:
+            pass
+    assert plan.injected["transport.send"] == 1
+    assert ch.call_sync("ChaosEcho", "Echo", {"msg": "after"},
+                        serializer="json") == {"msg": "after"}
+    assert_quiesced(timers0)
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: slow peer (delayed response) triggers the backup request
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_slow_response_triggers_backup_request(server, seed):
+    port = server.port
+    ep = str2endpoint(f"127.0.0.1:{port}")
+    ch = brpc.Channel(f"127.0.0.1:{port}", timeout_ms=5000, max_retry=3,
+                      backup_request_ms=100)
+    assert ch.call_sync("ChaosEcho", "Echo", {"msg": "warm"},
+                        serializer="json") == {"msg": "warm"}
+    client_sid = SocketMap.instance()._conns[ep].sid
+    # delay the SERVER's response send for the first attempt (the server
+    # writes on its accepted socket, not client_sid); the backup attempt
+    # races past it
+    plan = fault.FaultPlan(seed).on(
+        "transport.send", fault.LATENCY, latency_s=1.5, times=1,
+        match=lambda ctx: ctx.get("sid") != client_sid)
+    timers0 = _timer_count()
+    cntl = brpc.Controller()
+    with fault.injected(plan):
+        t0 = time.monotonic()
+        resp = ch.call_sync("ChaosEcho", "Echo", {"msg": "slowpoke"},
+                            serializer="json", cntl=cntl)
+        elapsed = time.monotonic() - t0
+    assert resp == {"msg": "slowpoke"}
+    assert cntl.retried_count >= 1, "backup request never fired"
+    assert elapsed < 1.2, \
+        f"call waited out the slow attempt ({elapsed:.2f}s) instead of " \
+        "completing via the backup request"
+    assert plan.injected["transport.send"] == 1
+    # the delayed first response is a stale attempt: it must not
+    # double-complete the call or leak its timers
+    time.sleep(1.7 - elapsed if elapsed < 1.7 else 0)
+    assert_quiesced(timers0)
+
+
+# ---------------------------------------------------------------------------
+# scenario 5: HBM block-pool exhaustion -> host-serialized fallback
+# ---------------------------------------------------------------------------
+
+class TensorEcho(brpc.Service):
+    NAME = "ChaosTensor"
+
+    @brpc.method(request="tensor", response="tensor")
+    def Double(self, cntl, req):
+        return req * 2
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_rail_transfer_fault_falls_back_to_host(seed):
+    """An injected ICI transfer failure on the rail's fast path must
+    degrade the call to host serialization, not fail it."""
+    import jax
+    import jax.numpy as jnp
+    from brpc_tpu.ici import rail
+
+    dev = jax.devices()[0]
+    srv = brpc.Server(brpc.ServerOptions(ici_device=dev))
+    srv.add_service(TensorEcho())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=10000)
+        x = jnp.arange(1024, dtype=jnp.float32)
+        # warm call: compiles staging kernels, proves the rail path works
+        warm = ch.call_sync("ChaosTensor", "Double", x, serializer="tensor")
+        np.testing.assert_allclose(np.asarray(warm), np.asarray(x) * 2)
+        fb0 = rail.rail_fallbacks.get_value()
+        plan = fault.FaultPlan(seed).on("ici.send", fault.ERROR, times=1)
+        timers0 = _timer_count()
+        with fault.injected(plan):
+            resp = ch.call_sync("ChaosTensor", "Double", x,
+                                serializer="tensor")
+        np.testing.assert_allclose(np.asarray(resp), np.asarray(x) * 2)
+        assert plan.injected["ici.send"] == 1
+        assert rail.rail_fallbacks.get_value() > fb0, \
+            "failed rail transfer did not fall back to host serialization"
+        assert_quiesced(timers0)
+    finally:
+        srv.stop()
+        srv.join()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_block_pool_exhaustion_releases_credit_and_blocks(seed):
+    """Injected HBM block exhaustion mid-staging: the block pipe must
+    fail definitively, release its window credit, leak no blocks — and
+    the SAME transfer succeeds once the pool recovers."""
+    import jax
+    from brpc_tpu.ici.block_pool import get_block_pool
+    from brpc_tpu.ici.endpoint import IciEndpoint
+
+    dev = jax.devices()[0]
+    pool = get_block_pool(dev)
+
+    def occupancy():
+        with pool._lock:
+            return {c: len(pool._free[c]) for c in pool._free}
+
+    free0 = occupancy()
+    ep = IciEndpoint(dev)
+    payload = bytes(range(256)) * (20 * 1024)   # 5MB -> three 2MB chunks
+    try:
+        # exhaustion strikes on the SECOND block of the staging run, so
+        # the first block is already allocated and must be freed on the
+        # error path
+        plan = fault.FaultPlan(seed).on("ici.alloc", fault.EXHAUST,
+                                        times=1, after=1)
+        with fault.injected(plan):
+            with pytest.raises(MemoryError):
+                ep.send_bytes(payload, pool)
+        assert plan.injected["ici.alloc"] == 1
+        # invariants: no leaked blocks, no stuck window credit
+        assert wait_until(lambda: occupancy() == free0, 10), \
+            f"pool leaked blocks: {occupancy()} != {free0}"
+        assert wait_until(lambda: ep.inflight_bytes == 0, 10), \
+            f"window credit stuck: {ep.inflight_bytes}B in flight"
+        # recovery: the same transfer succeeds with the fault cleared
+        out = ep.send_bytes(payload, pool)
+        got = b"".join(b.get() for b in out)
+        assert got == payload
+        for b in out:
+            b.free()
+        assert wait_until(lambda: occupancy() == free0, 10)
+        assert wait_until(lambda: ep.inflight_bytes == 0, 10)
+    finally:
+        ep.close()
+
+
+# ---------------------------------------------------------------------------
+# scenario 6: DCN hop loss (client- and server-side) -> definite errors,
+# next hop succeeds
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_dcn_hop_loss_definite_error_then_recovery(seed):
+    import jax.numpy as jnp
+    from brpc_tpu.ici.channel import register_device_service
+    from brpc_tpu.ici.dcn import DcnChannel
+
+    register_device_service("ChaosMat", "Inc", lambda x: x + 1.0)
+    srv = brpc.Server(enable_dcn=True)
+    srv.start("127.0.0.1", 0)
+    try:
+        dch = DcnChannel(f"ici://127.0.0.1:{srv.port}/0", timeout_ms=10000)
+        plan = (fault.FaultPlan(seed)
+                .on("dcn.call", fault.ERROR, times=1)
+                .on("dcn.serve", fault.ERROR, times=1))
+        x = jnp.ones((8,), jnp.float32)
+        timers0 = _timer_count()
+        with fault.injected(plan):
+            with pytest.raises(errors.RpcError):    # client-side hop loss
+                dch.call_sync("ChaosMat", "Inc", x)
+            # server-side hop loss: EINTERNAL is retryable, so the
+            # channel re-issues and the second attempt lands — the hop
+            # loss is healed TRANSPARENTLY by the recovery stack
+            out = dch.call_sync("ChaosMat", "Inc", x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) + 1.0)
+        assert plan.injected == {"dcn.call": 1, "dcn.serve": 1}
+        # persistent hop loss must end in a DEFINITE error (retries
+        # exhausted), never a hang
+        plan2 = fault.FaultPlan(seed).on("dcn.serve", fault.ERROR, times=-1)
+        with fault.injected(plan2):
+            with pytest.raises(errors.RpcError) as ei:
+                dch.call_sync("ChaosMat", "Inc", x)
+            assert ei.value.code == errors.EINTERNAL
+        assert plan2.injected["dcn.serve"] >= 1
+        # and the data path recovers once the chaos clears
+        out2 = dch.call_sync("ChaosMat", "Inc", x)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(x) + 1.0)
+        assert_quiesced(timers0)
+    finally:
+        srv.stop()
+        srv.join()
+
+
+# ---------------------------------------------------------------------------
+# scenario 7: duplicate DATA frames (transport replay) — dropped, counted,
+# and the drain still balances the credit ledger
+# ---------------------------------------------------------------------------
+
+class StreamSink(brpc.Service):
+    NAME = "ChaosStream"
+    WINDOW = 1024
+    received: list = []
+    got_all = threading.Event()
+    want = 0
+
+    @brpc.method(request="json", response="json")
+    def Open(self, cntl, req):
+        def on_msg(stream, data):
+            StreamSink.received.append(data)
+            if len(StreamSink.received) >= StreamSink.want:
+                StreamSink.got_all.set()
+        cntl.accept_stream(on_msg, max_buf_size=self.WINDOW)
+        return {"ok": True}
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_stream_duplicate_frames_credit_explained(seed):
+    from brpc_tpu.rpc import stream as stream_mod
+    N, MSG = 8, 512
+    StreamSink.received = []
+    StreamSink.got_all = threading.Event()
+    StreamSink.want = N
+    srv = brpc.Server()
+    srv.add_service(StreamSink())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        cntl = brpc.Controller()
+        stream = brpc.stream_create(cntl, None,
+                                    max_buf_size=StreamSink.WINDOW)
+        assert ch.call_sync("ChaosStream", "Open", {}, serializer="json",
+                            cntl=cntl) == {"ok": True}
+        drops0 = stream_mod.reorder_replays_dropped.get_value()
+        bytes0 = stream_mod.reorder_replay_bytes_dropped.get_value()
+        # every DATA frame to the SERVER's stream is delivered twice
+        # (injected transport-level redelivery); the reorder layer must
+        # drop each duplicate.  Scoped to this stream so concurrent
+        # in-process streams can't consume the schedule.
+        sink_id = stream.remote_id
+        plan = fault.FaultPlan(seed).on(
+            "stream.frame", fault.DUP, times=-1,
+            match=lambda ctx: (ctx.get("msg_type") == M.MSG_STREAM_DATA
+                               and ctx.get("stream_seq", 0) != 0
+                               and ctx.get("stream_id") == sink_id))
+        with fault.injected(plan):
+            for i in range(N):
+                stream.write(bytes([i]) * MSG, timeout_s=10)
+            assert StreamSink.got_all.wait(10), \
+                f"only {len(StreamSink.received)}/{N} delivered"
+            # exactly-once, in-order delivery despite duplicates
+            assert StreamSink.received == [bytes([i]) * MSG
+                                           for i in range(N)]
+            # the last frame's duplicate may still be in flight when the
+            # handler fires got_all — wait for the full drop count
+            assert wait_until(
+                lambda: stream_mod.reorder_replays_dropped.get_value()
+                - drops0 == N, 10), "duplicates not all dropped"
+            dup_drops = stream_mod.reorder_replays_dropped.get_value() \
+                - drops0
+            dup_bytes = stream_mod.reorder_replay_bytes_dropped.get_value() \
+                - bytes0
+            assert dup_drops == N
+            # the credit ledger: every byte of shortfall is explained by
+            # the replay counter (ADVICE r5 — never a silent wedge)
+            assert dup_bytes == dup_drops * MSG
+            # delivered credit is acked back: the writer drains to zero
+            # outstanding (window 1024, msg 512 -> feedback every msg)
+            assert wait_until(
+                lambda: stream._produced - stream._remote_consumed == 0,
+                10), ("writer credit never returned: "
+                      f"{stream._produced - stream._remote_consumed}B "
+                      f"outstanding, {dup_bytes}B explained by replays")
+        stream.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+# ---------------------------------------------------------------------------
+# scenario 8: lost CONSUMED feedback — credit return is delayed, not leaked
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_feedback_loss_heals_via_cumulative_offsets(seed):
+    """Feedback offsets are CUMULATIVE: one lost CONSUMED frame delays
+    credit return until the next crossing, it never leaks it.  The
+    writer's window is sized above the total payload so it can always
+    produce the traffic that forces that next crossing (a writer wedged
+    at a full window can't — which is exactly why feedback rides the
+    reliable socket in production)."""
+    N, MSG = 6, 512
+    StreamSink.received = []
+    StreamSink.got_all = threading.Event()
+    StreamSink.want = 2 * N
+    srv = brpc.Server()
+    srv.add_service(StreamSink())        # server recv window: 1024
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        cntl = brpc.Controller()
+        stream = brpc.stream_create(cntl, None, max_buf_size=8192)
+        assert ch.call_sync("ChaosStream", "Open", {}, serializer="json",
+                            cntl=cntl) == {"ok": True}
+        sink_id = stream.remote_id
+        # the FIRST feedback frame from the server's stream is lost
+        # (scoped to this stream — see scenario 7)
+        plan = fault.FaultPlan(seed).on(
+            "stream.feedback", fault.DROP, times=1,
+            match=lambda ctx: ctx.get("stream_id") == sink_id)
+        with fault.injected(plan):
+            # phase 1 guarantees at least one feedback crossing (3072B
+            # consumed vs a 512B threshold) — the drop lands here
+            for i in range(N):
+                stream.write(bytes([i]) * MSG, timeout_s=10)
+            assert wait_until(lambda: len(StreamSink.received) >= N, 10), \
+                f"only {len(StreamSink.received)}/{N} delivered"
+            assert plan.injected["stream.feedback"] == 1
+            # phase 2 forces the NEXT crossing; its cumulative offset
+            # must return phase 1's lost credit too
+            for i in range(N):
+                stream.write(bytes([N + i]) * MSG, timeout_s=10)
+            assert StreamSink.got_all.wait(10), \
+                f"only {len(StreamSink.received)}/{2 * N} delivered"
+            assert wait_until(
+                lambda: stream._produced - stream._remote_consumed == 0,
+                10), "credit lost with the dropped feedback frame never " \
+                     "returned (cumulative offsets should heal it)"
+        stream.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+
+# ---------------------------------------------------------------------------
+# health-check revival under faults (satellite): CB hold + generation bump
+# ---------------------------------------------------------------------------
+
+class TestHealthCheckRevival:
+    def test_probe_respects_isolation_hold_while_reachable(self, server):
+        """The circuit breaker's isolation hold (_hold_until) must be
+        respected even when the endpoint is ALREADY reachable — the
+        probe may connect, but revival waits out the hold."""
+        from brpc_tpu.policy import health_check as hc
+        ep = str2endpoint(f"127.0.0.1:{server.port}")
+        t0 = time.monotonic()
+        hc.mark_broken(ep, hold_s=0.6)
+        assert hc.is_broken(ep)
+        time.sleep(0.3)
+        assert hc.is_broken(ep), "revived inside the CB isolation hold"
+        assert wait_until(lambda: not hc.is_broken(ep), 10), \
+            "reachable endpoint never revived after the hold elapsed"
+        assert time.monotonic() - t0 >= 0.6
+
+    def test_reset_all_generation_stands_down_probes(self):
+        """A probe loop started before reset_all() must exit WITHOUT
+        reviving the endpoint into the deliberately-cleared state, even
+        if the endpoint becomes reachable afterwards."""
+        from brpc_tpu.policy import health_check as hc
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        ep = str2endpoint(f"127.0.0.1:{port}")
+        revived0 = hc._revived_counter.get_value()
+        hc.mark_broken(ep)          # unreachable: probe loop spins
+        assert hc.is_broken(ep)
+        hc.reset_all()              # generation bump clears everything
+        assert not hc.is_broken(ep)
+        # NOW the endpoint comes up: the old-generation probe connects,
+        # sees the bump, and stands down without touching state
+        lst = socket.socket()
+        lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        lst.bind(("127.0.0.1", port))
+        lst.listen(8)
+        try:
+            assert wait_until(lambda: ep not in hc._probe_threads, 10), \
+                "stale-generation probe thread never stood down"
+            assert hc._revived_counter.get_value() == revived0, \
+                "stale-generation probe fired a revival"
+            assert not hc.is_broken(ep)
+        finally:
+            lst.close()
+
+
+# ---------------------------------------------------------------------------
+# the fault layer itself: determinism + disabled-by-default
+# ---------------------------------------------------------------------------
+
+class TestFaultLayer:
+    def test_disabled_by_default_and_noop(self):
+        assert fault.ENABLED is False
+        assert fault.hit("transport.send") is None
+
+    def test_seeded_schedule_replays_exactly(self):
+        def run(seed):
+            plan = fault.FaultPlan(seed)
+            plan.on("chaos.unit", fault.DROP, times=-1, prob=0.3)
+            with fault.injected(plan):
+                return [fault.hit("chaos.unit") is not None
+                        for _ in range(64)]
+        assert run(7) == run(7), "same seed must replay the same schedule"
+        assert run(7) != run(8), "different seeds must differ"
+
+    def test_after_and_times_fire_by_hit_index(self):
+        plan = fault.FaultPlan(0)
+        plan.on("chaos.idx", fault.ERROR, times=2, after=3)
+        with fault.injected(plan):
+            fired = [fault.hit("chaos.idx") is not None for _ in range(8)]
+        assert fired == [False, False, False, True, True,
+                         False, False, False]
+
+    def test_match_scopes_rules(self):
+        plan = fault.FaultPlan(0)
+        plan.on("chaos.match", fault.ERROR, times=1,
+                match=lambda ctx: ctx.get("who") == "target")
+        with fault.injected(plan):
+            assert fault.hit("chaos.match", who="bystander") is None
+            assert fault.hit("chaos.match", who="target") is not None
+            assert fault.hit("chaos.match", who="target") is None
+
+    def test_injected_counts_reach_bvar(self):
+        before = fault.injected_counts().get("chaos.bvar", 0)
+        plan = fault.FaultPlan(0).on("chaos.bvar", fault.DROP, times=2)
+        with fault.injected(plan):
+            fault.hit("chaos.bvar")
+            fault.hit("chaos.bvar")
+        assert fault.injected_counts()["chaos.bvar"] == before + 2
+
+
+# ---------------------------------------------------------------------------
+# ADVICE r5 regressions
+# ---------------------------------------------------------------------------
+
+class TestAdviceRegressions:
+    def test_recordio_crc_fail_short_tail_returns_none(self):
+        """A damaged record at EOF followed by a sub-magic-sized tail
+        must end the stream (return None) — NOT rescan its own payload
+        and fabricate a record from embedded MAGIC bytes."""
+        from brpc_tpu.butil.recordio import RecordReader, RecordWriter
+        buf = io.BytesIO()
+        w = RecordWriter(buf)
+        w.write(b"first-record")
+        rec1_len = buf.tell()
+        # second record's body EMBEDS a complete valid record — the
+        # fabrication bait (rpc_dump bodies are raw network bytes)
+        inner = io.BytesIO()
+        RecordWriter(inner).write(b"FAKE")
+        w.write(b"xx" + inner.getvalue() + b"yy")
+        data = bytearray(buf.getvalue())
+        # corrupt one body byte OUTSIDE the embedded record: crc fails,
+        # lengths stay intact
+        data[rec1_len + 20] ^= 0xFF          # the leading 'x'
+        data += b"Zq"                        # short (<4B) damaged tail
+        r = RecordReader(io.BytesIO(bytes(data)))
+        assert r.read() == (b"", b"first-record")
+        assert r.read() is None, \
+            "fabricated a record from bytes inside a damaged tail record"
+
+    def test_recordio_crc_fail_aligned_next_record_still_skips(self):
+        """Counter-case: when the next bytes ARE a magic, the damaged
+        record is skipped in place and the next record survives."""
+        from brpc_tpu.butil.recordio import RecordReader, RecordWriter
+        buf = io.BytesIO()
+        w = RecordWriter(buf)
+        w.write(b"victim")
+        next_off = buf.tell()
+        w.write(b"survivor")
+        data = bytearray(buf.getvalue())
+        data[next_off - 1] ^= 0xFF           # corrupt victim's body tail
+        r = RecordReader(io.BytesIO(bytes(data)))
+        assert r.read() == (b"", b"survivor")
+        assert r.read() is None
+
+    def test_h2_respond_error_claims_stream_atomically(self):
+        """Only ONE responder may emit trailers HEADERS on a stream: the
+        claim happens under _fc, so a backlog shed and a finishing
+        handler can never both respond (ADVICE r5)."""
+        from brpc_tpu.rpc.h2 import GrpcServerConnection
+
+        class _RecordingTp:
+            def __init__(self):
+                self.writes = []
+
+            def write_raw(self, sid, data):
+                self.writes.append(bytes(data))
+                return 0
+
+            def close(self, sid, err=0):
+                pass
+
+            def alive(self, sid):
+                return True
+
+        conn = GrpcServerConnection(sock_id=(1 << 62), server=None)
+        tp = _RecordingTp()
+        conn._tp = tp
+        conn.open_stream(1)
+        conn._respond_error(1, 13, "boom")
+        assert len(tp.writes) == 1, "error trailers not sent"
+        conn._respond_error(1, 13, "again")
+        assert len(tp.writes) == 1, "duplicate trailers HEADERS emitted"
+        # handler wins the claim first: a late shed stays silent
+        conn.open_stream(3)
+        assert conn.claim_responder(3) is True
+        conn._respond_error(3, 13, "late shed")
+        assert len(tp.writes) == 1
+        assert conn.claim_responder(3) is False
+        # closed streams are unclaimable
+        conn.close_stream(3)
+        assert conn.claim_responder(3) is False
+
+    def test_stream_duplicate_data_bytes_counted(self):
+        """Dropped replayed DATA frames consume sender credit forever;
+        the byte counter must account for them (ADVICE r5)."""
+        from brpc_tpu.rpc import stream as sm
+        got = []
+        s = sm.Stream(999_999_001, sm._FnHandler(
+            lambda st, m: got.append(m)))
+        c0 = sm.reorder_replays_dropped.get_value()
+        b0 = sm.reorder_replay_bytes_dropped.get_value()
+        s._on_data(b"abc", 3, 1)
+        s._on_data(b"abc", 3, 1)         # transport replay
+        assert got == [b"abc"], "duplicate delivered to the handler"
+        assert sm.reorder_replays_dropped.get_value() == c0 + 1
+        assert sm.reorder_replay_bytes_dropped.get_value() == b0 + 3
+
+    def test_bench_wedge_deadline_is_per_batch(self):
+        """The mid-batch wedge check must measure from the CURRENT
+        batch's start, not the whole timed region's (ADVICE r5)."""
+        import bench
+        region_t0, now = 0.0, 200.0      # region older than the deadline
+        batch_t0 = 195.0                 # current batch is 5s old
+        assert not bench._batch_wedged(batch_t0, now), \
+            "healthy late batch misflagged as wedged"
+        assert bench._batch_wedged(
+            batch_t0, batch_t0 + bench.WEDGE_TIMEOUT_S + 1)
+        # the old bug, kept as documentation: region-relative time flags
+        assert bench._batch_wedged(region_t0, now)
