@@ -61,6 +61,11 @@ chaos: $(LIB) $(PYEXT)
 	BRPC_CHAOS_SEEDS=101,202,303 JAX_PLATFORMS=cpu \
 	    python -m pytest tests/test_chaos.py -q
 
+# Serving suite (README "Serving"): dynamic batcher + continuous-decode
+# engine + RPC/HTTP glue, on the CPU jit path (no device needed).
+serving: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+
 # Sanitizer stress targets (VERDICT r2 task 7; reference fights lock-free
 # races with stress tests + sanitizer builds, SURVEY.md §5.3).  The whole
 # native core + src/cc/test/stress_main.cc compile as ONE binary with the
@@ -90,4 +95,4 @@ stress:
 	    $(STRESS_SRC) -o build/stress_plain
 	./build/stress_plain
 
-.PHONY: all clean test chaos tsan asan stress
+.PHONY: all clean test chaos serving tsan asan stress

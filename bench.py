@@ -423,6 +423,83 @@ def _per_pass_seconds(x, k_small=8, k_large=108, trials=3):
     return max(1e-9, (d_large - d_small) / (k_large - k_small)), d_small
 
 
+def bench_serving(batch_sizes=(1, 4, 16), threads_per_slot=3,
+                  duration_s=1.0, trials=3):
+    """Serving rung: dynamic-batcher qps and p99 queue delay vs
+    max_batch_size through `brpc_tpu/serving` on jit scoring (a 2-layer
+    MLP).  Same jitter discipline as the other rungs: `trials` runs per
+    batch size, median + spread.  Runs on whatever jax platform the
+    environment provides; the caller publishes {"skipped": true} when no
+    device is reachable."""
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu.serving import DynamicBatcher
+
+    D, H = 256, 4096
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((D, H)).astype(np.float32))
+    w2 = jnp.asarray(rng.standard_normal((H, H)).astype(np.float32))
+    w3 = jnp.asarray(rng.standard_normal((H, 1)).astype(np.float32))
+
+    @jax.jit
+    def score(x):
+        return jnp.tanh(jnp.tanh(x @ w1) @ w2) @ w3
+
+    item = np.ones((D,), np.float32)
+    max_delay_us = 20_000
+
+    def one_trial(bs: int, k: int):
+        threads = max(4, threads_per_slot * bs)
+        b = DynamicBatcher(score, max_batch_size=bs,
+                           max_delay_us=max_delay_us,
+                           batch_buckets=(bs,), length_buckets=(D,),
+                           name=f"bench_bs{bs}_{k}")
+        try:
+            b.submit_wait(item, timeout_s=300)   # compile outside timing
+            stop = time.monotonic() + duration_s
+            counts = [0] * threads
+
+            def worker(i):
+                while time.monotonic() < stop:
+                    b.submit_wait(item, timeout_s=60)
+                    counts[i] += 1
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(threads)]
+            t0 = time.monotonic()
+            [t.start() for t in ts]
+            [t.join(120) for t in ts]
+            wall = time.monotonic() - t0
+            return (sum(counts) / wall,
+                    b.queue_delay_rec.latency_percentile(0.99))
+        finally:
+            b.close()
+
+    out = {}
+    for bs in batch_sizes:
+        rs = sorted(one_trial(bs, k) for k in range(trials))
+        mid = len(rs) // 2
+        out[f"bs{bs}"] = {
+            "qps": round(rs[mid][0], 1),
+            "queue_p99_us": round(rs[mid][1], 1),
+            "qps_spread": [round(rs[0][0], 1), round(rs[-1][0], 1)],
+            "trials": trials,
+        }
+    base = out[f"bs{batch_sizes[0]}"]["qps"]
+    peak = max(out[f"bs{bs}"]["qps"] for bs in batch_sizes)
+    out["speedup_at_peak"] = round(peak / base, 2) if base else None
+    out["max_delay_us"] = max_delay_us
+    out["note"] = ("dynamic-batcher rung (brpc_tpu/serving): per-item "
+                   "qps through bucket-padded jit scoring vs "
+                   "max_batch_size; queue_p99_us is time queued before "
+                   "batch formation")
+    return out
+
+
 def bench_hbm_stream(chunk_mb=64):
     """SECONDARY chip sanity number: raw on-chip HBM read+write bandwidth
     of a jitted roll+add loop.  No framework code runs here — this bounds
@@ -1135,6 +1212,17 @@ def main():
     device_ok, device_err = _device_reachable()
     if not device_ok:
         log(f"  {device_err}; skipping device benches")
+    log("bench: serving dynamic batcher...")
+    if not device_ok:
+        # r5 bench discipline: a rung that cannot run must SAY so —
+        # never publish a fallback wearing the metric's name
+        details["serving"] = {"skipped": True, "reason": device_err}
+    else:
+        try:
+            details["serving"] = bench_serving()
+        except Exception as e:
+            details["serving"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['serving']}")
     # each bench is isolated: a failure in one must not clobber another's
     # already-valid result
     for name, fn in (("tensor_pipe", lambda: bench_tensor_pipe(chunk_mb=64)),

@@ -6,7 +6,8 @@ MethodStatus), /vars (+ wildcard filter), /flags (live edit with ?setvalue=),
 /health, /version, /connections, /sockets, /bthreads (executor stats),
 /rpcz (recent spans, ?trace_id= filter), /brpc_metrics (Prometheus text),
 /services (method inventory — /protobufs analog), /memory, /ici (link
-stats of the ICI transport).
+stats of the ICI transport), /serving (dynamic-batcher occupancy +
+decode slot map, brpc_tpu/serving).
 """
 from __future__ import annotations
 
@@ -250,6 +251,21 @@ def build_routes(server) -> dict:
         except Exception:
             return "ici transport not active\n"
 
+    def serving_page(req):
+        # inference-serving introspection (brpc_tpu/serving): batch
+        # occupancy, decode slot map, shed/pad stats.  Import lazily —
+        # the serving layer (and its jax dependency chain) loads only
+        # when something registered a batcher/engine or the operator
+        # asks for the page.
+        import sys
+        if "brpc_tpu.serving" not in sys.modules:
+            return "no serving components registered\n"
+        from brpc_tpu.serving import serving_snapshot
+        snap = serving_snapshot()
+        if not snap["batchers"] and not snap["engines"]:
+            return "no serving components registered\n"
+        return json.dumps(snap, indent=1), "application/json"
+
     # /hotspots profilers (hotspots_service.cpp; §5.2) — on-demand, the
     # ?seconds= and ?fmt=collapsed knobs mirror the reference's query args
     def hotspots_index(req):
@@ -402,6 +418,7 @@ def build_routes(server) -> dict:
         "/protobufs": services_page,
         "/memory": memory,
         "/ici": ici,
+        "/serving": serving_page,
         "/hotspots": hotspots_index,
         "/hotspots/cpu": hotspots_cpu,
         "/hotspots/native": hotspots_native,

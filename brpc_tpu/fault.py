@@ -21,6 +21,12 @@ layers:
     ici.alloc          ICI     exhaust                   (ici/block_pool.py)
     dcn.call           DCN     error, latency            (ici/dcn.py)
     dcn.serve          DCN     error, latency
+    serving.batch      L6      error  (serving/batcher.py: mid-batch
+                               failure — every member completes with a
+                               definite error, never a partial scatter)
+    serving.slot_alloc L6      error  (serving/engine.py: KV slot lease
+                               fails; that request errors, the loop and
+                               the block pool stay healthy)
 
 Disabled (the default), every site is a single module-attribute check —
 ``if fault.ENABLED:`` — before ANY per-site work, so the production data

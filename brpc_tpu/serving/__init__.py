@@ -1,0 +1,55 @@
+"""brpc_tpu.serving — inference serving on the RPC/ICI stack.
+
+Three cooperating pieces (see README "Serving"):
+
+  * :class:`DynamicBatcher` (batcher.py) — deadline-aware dynamic
+    batching of concurrent unary RPCs into bucket-padded tensor calls;
+  * :class:`DecodeEngine` (engine.py) — continuous-batching
+    autoregressive decode over a fixed slot pool with KV blocks leased
+    from the ICI BlockPool;
+  * :func:`register_serving` (service.py) — server glue exposing
+    ``Serving.Score`` (batched unary) and ``Serving.Generate``
+    (streaming decode) plus the chunked-HTTP generate route.
+
+Every live batcher/engine self-registers here (weakly, by name) so the
+``/serving`` builtin-console page can render batch occupancy, the slot
+map, and shed/pad statistics without holding components alive.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+_reg_mu = threading.Lock()
+_batchers: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+_engines: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+
+
+def _register_batcher(b) -> None:
+    with _reg_mu:
+        _batchers[b.name] = b
+
+
+def _register_engine(e) -> None:
+    with _reg_mu:
+        _engines[e.name] = e
+
+
+def serving_snapshot() -> dict:
+    """Live components' stats — the /serving console page's data."""
+    with _reg_mu:
+        batchers = dict(_batchers)
+        engines = dict(_engines)
+    return {
+        "batchers": {name: b.stats() for name, b in sorted(batchers.items())},
+        "engines": {name: e.stats() for name, e in sorted(engines.items())},
+    }
+
+
+from brpc_tpu.serving.batcher import DynamicBatcher  # noqa: E402,F401
+from brpc_tpu.serving.engine import DecodeEngine  # noqa: E402,F401
+from brpc_tpu.serving.service import (  # noqa: E402,F401
+    ServingService, http_generate_handler, register_serving,
+)

@@ -1,0 +1,443 @@
+"""Deadline-aware dynamic batcher — coalesces concurrent unary RPCs into
+batched tensor calls.
+
+"RPC Considered Harmful" (PAPERS.md) quantifies why per-request tensor
+RPC wastes the fabric: each call pays the full dispatch overhead for one
+row of work.  The batcher gathers concurrent requests under a
+``max_batch_size`` / ``max_delay_us`` policy, pads them to a SMALL FIXED
+SET of bucket shapes so the jit cache is hit (never a per-shape
+recompile), runs the batch through one user-supplied jitted function,
+and scatters the rows back to each caller.
+
+Admission is deadline-aware and rides the existing limiter/ELIMIT
+machinery rather than a new error path: a queued request whose
+Controller deadline would expire before the predicted batch completion
+(window wait + EMA batch execution time x batches ahead) is shed
+IMMEDIATELY with ELIMIT — the caller learns "would have missed" in
+microseconds instead of burning a queue slot to learn it at its
+deadline.  An optional concurrency limiter (the same
+``create_limiter`` specs servers use: int, "auto", "timeout[:ms]")
+gates queue depth the same way.
+
+Instrumented per batcher on /vars (and the /serving console page):
+batch-size IntRecorder, queue-delay LatencyRecorder, pad-waste ratio,
+shed counter.
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from brpc_tpu import errors, fault
+from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
+
+# default sequence-length buckets: small fixed ladder so any raw length
+# maps to one of a handful of compiled shapes
+DEFAULT_LENGTH_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
+def _bucket_up(n: int, buckets: Sequence[int]) -> Optional[int]:
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+def _default_batch_buckets(max_batch_size: int) -> tuple:
+    out = []
+    b = 1
+    while b < max_batch_size:
+        out.append(b)
+        b *= 2
+    out.append(max_batch_size)
+    return tuple(out)
+
+
+class _Pending:
+    """One queued request: the padded-batch row it will occupy plus an
+    exactly-once completion (error or result, never neither, never
+    both)."""
+
+    __slots__ = ("item", "length", "enqueue_t", "deadline_s", "_fire",
+                 "_fired", "_mu")
+
+    def __init__(self, item: np.ndarray, length: int,
+                 deadline_s: Optional[float],
+                 fire: Callable[[int, str, object], None]):
+        self.item = item
+        self.length = length
+        self.enqueue_t = time.monotonic()
+        self.deadline_s = deadline_s
+        self._fire = fire
+        self._fired = False
+        self._mu = threading.Lock()
+
+    def complete(self, code: int, text: str, result) -> None:
+        with self._mu:
+            if self._fired:
+                return
+            self._fired = True
+        try:
+            self._fire(code, text, result)
+        except Exception:
+            # a raising completion callback must never kill the batch
+            # drainer (it would wedge every other queued request); the
+            # callback owner's bug is logged, the loop lives on
+            import logging
+            logging.getLogger(__name__).exception(
+                "batcher completion callback raised")
+
+
+class _Future:
+    """Local (non-RPC) completion for submit_wait()."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self.code = 0
+        self.text = ""
+        self.result = None
+
+    def fire(self, code: int, text: str, result) -> None:
+        self.code, self.text, self.result = code, text, result
+        self._ev.set()
+
+    def wait(self, timeout_s: float):
+        if not self._ev.wait(timeout_s):
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  "batcher result not ready")
+        if self.code:
+            raise errors.RpcError(self.code, self.text)
+        return self.result
+
+
+class DynamicBatcher:
+    """Per-method dynamic batcher.
+
+    ``batch_fn(padded)`` receives a ``[batch_bucket, length_bucket]``
+    array (row i = request i's item, zero-padded) and returns either a
+    per-row vector (``[batch]``) or a padded matrix (``[batch,
+    length_bucket]``, trimmed back to each request's raw length on
+    scatter).  Supply a ``jax.jit``-wrapped function: because inputs are
+    always bucket shapes, it compiles once per bucket and never again.
+    """
+
+    def __init__(self, batch_fn: Callable, *,
+                 max_batch_size: int = 16,
+                 max_delay_us: int = 2000,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS,
+                 limiter=None,
+                 name: str = "default",
+                 dtype=np.float32,
+                 padded_output: Optional[bool] = None):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self.batch_fn = batch_fn
+        self.max_batch_size = int(max_batch_size)
+        self.max_delay_us = int(max_delay_us)
+        self.batch_buckets = tuple(sorted(
+            batch_buckets or _default_batch_buckets(max_batch_size)))
+        if self.batch_buckets[-1] < self.max_batch_size:
+            raise ValueError("largest batch bucket must cover "
+                             "max_batch_size")
+        self.length_buckets = tuple(sorted(length_buckets))
+        self.name = name
+        self.dtype = np.dtype(dtype)
+        # How to scatter batch_fn's output back to callers:
+        #   True  — output is [batch, length_bucket]: trim row i to the
+        #           request's raw length;
+        #   False — output rows are per-request values of fixed width
+        #           (or scalars): hand row i back whole;
+        #   None  — infer per batch (trim iff the trailing dim equals
+        #           the length bucket).  Pass it explicitly when a
+        #           fixed-width output could COINCIDE with a length
+        #           bucket — the heuristic cannot tell those apart and
+        #           would silently truncate.
+        self.padded_output = padded_output
+        if limiter is not None:
+            from brpc_tpu.policy.concurrency_limiter import create_limiter
+            limiter = create_limiter(limiter)
+        self.limiter = limiter
+
+        safe = re.sub(r"\W", "_", name)
+        # record the EXACT names exposed below so close() hides only
+        # this batcher's variables — a prefix wildcard would also strip
+        # a sibling component whose name merely starts with ours
+        from brpc_tpu.bvar.variable import exposed_variables
+        _pre_bvars = set(exposed_variables(f"serving_{safe}*"))
+        self.batch_size_rec = IntRecorder(f"serving_{safe}_batch_size")
+        self.queue_delay_rec = LatencyRecorder(
+            f"serving_{safe}_queue_delay")
+        self.shed = Adder(f"serving_{safe}_shed")
+        self.n_batches = Adder(f"serving_{safe}_batches")
+        self.n_completed = Adder(f"serving_{safe}_completed")
+        self.n_errors = Adder(f"serving_{safe}_errors")
+        self._pad_elems = Adder()    # padded-but-unused elements
+        self._real_elems = Adder()   # useful elements
+        PassiveStatus(self._pad_waste).expose(
+            f"serving_{safe}_pad_waste_ratio")
+        self._bvar_names = [n for n in exposed_variables(f"serving_{safe}*")
+                            if n not in _pre_bvars]
+
+        self._cv = threading.Condition()
+        self._q: list[_Pending] = []
+        self._exec_ema_s = 0.0
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"serving-batcher-{safe}")
+        self._thread.start()
+        from brpc_tpu import serving as _serving
+        _serving._register_batcher(self)
+
+    # ---- admission ----
+
+    def submit(self, cntl, item, transform: Optional[Callable] = None,
+               ) -> None:
+        """Server-handler entry: defers the RPC, enqueues the item, and
+        completes the call from the batch drainer.  The request's
+        deadline is read off the Controller's request meta (timeout_ms);
+        ``transform(row)`` maps the scattered row to the response
+        object."""
+        done = cntl.defer()
+
+        def fire(code: int, text: str, result) -> None:
+            if code:
+                cntl.set_failed(code, text)
+                done(None)
+                return
+            if transform is not None:
+                # a raising transform must still complete the RPC — the
+                # client gets a definite EINTERNAL instead of a timeout
+                try:
+                    result = transform(result)
+                except Exception as e:
+                    cntl.set_failed(errors.EINTERNAL,
+                                    f"response transform failed: "
+                                    f"{type(e).__name__}: {e}")
+                    done(None)
+                    return
+            done(result)
+
+        meta = cntl.request_meta
+        tmo_ms = meta.timeout_ms if meta is not None else 0
+        deadline_s = (time.monotonic() + tmo_ms / 1e3) if tmo_ms > 0 \
+            else None
+        self.enqueue(item, fire, deadline_s=deadline_s)
+
+    def submit_wait(self, item, timeout_s: float = 30.0,
+                    deadline_s: Optional[float] = None):
+        """Local blocking submission (tests, tools, non-RPC callers):
+        returns the scattered row or raises RpcError."""
+        fut = _Future()
+        self.enqueue(item, fut.fire, deadline_s=deadline_s)
+        return fut.wait(timeout_s)
+
+    def enqueue(self, item, fire: Callable[[int, str, object], None],
+                deadline_s: Optional[float] = None) -> None:
+        """Core admission: validates the item, predicts completion, and
+        either queues or sheds.  ``fire(code, text, result)`` runs
+        exactly once."""
+        arr = np.asarray(item, dtype=self.dtype)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        p = _Pending(arr, 0, deadline_s, fire)
+        if arr.ndim != 1:
+            p.complete(errors.EREQUEST,
+                       f"batcher items must be 1-D, got shape {arr.shape}",
+                       None)
+            self.n_errors.add(1)
+            return
+        p.length = arr.shape[0]
+        if _bucket_up(p.length, self.length_buckets) is None:
+            p.complete(errors.EREQUEST,
+                       f"item length {p.length} exceeds largest bucket "
+                       f"{self.length_buckets[-1]}", None)
+            self.n_errors.add(1)
+            return
+        shed_code = 0
+        shed_text = ""
+        with self._cv:
+            if not self._running:
+                shed_code, shed_text = errors.ELOGOFF, "batcher closed"
+            elif self.limiter is not None and not self.limiter.on_requested(
+                    len(self._q) + 1):
+                # the SAME admission machinery servers use: limiter said
+                # no -> ELIMIT, counted as a shed
+                shed_code = errors.ELIMIT
+                shed_text = "batcher queue limiter rejected the request"
+            elif p.deadline_s is not None:
+                # predicted completion: the full batching window (worst
+                # case for a fresh queue) plus one EMA execution per
+                # batch already ahead of us, plus our own
+                batches_ahead = len(self._q) // self.max_batch_size
+                predicted_s = (self.max_delay_us / 1e6 +
+                               (batches_ahead + 1) *
+                               max(self._exec_ema_s, 0.0))
+                if p.deadline_s < p.enqueue_t + predicted_s:
+                    shed_code = errors.ELIMIT
+                    shed_text = (
+                        f"deadline-aware shed: deadline in "
+                        f"{(p.deadline_s - p.enqueue_t) * 1e3:.1f}ms but "
+                        f"predicted batch completion in "
+                        f"{predicted_s * 1e3:.1f}ms")
+            if shed_code == 0:
+                self._q.append(p)
+                self._cv.notify()
+        if shed_code != 0:
+            if shed_code == errors.ELIMIT:
+                self.shed.add(1)
+                if self.limiter is not None:
+                    self.limiter.on_responded(errors.ELIMIT, 0)
+            self.n_errors.add(1)
+            p.complete(shed_code, shed_text, None)
+
+    # ---- the batch loop ----
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._q:
+                    self._cv.wait()
+                if not self._q:
+                    if not self._running:
+                        return
+                    continue
+                # batch window: first-enqueued request anchors the delay
+                deadline_t = self._q[0].enqueue_t + self.max_delay_us / 1e6
+                while self._running and len(self._q) < self.max_batch_size:
+                    rem = deadline_t - time.monotonic()
+                    if rem <= 0:
+                        break
+                    self._cv.wait(rem)
+                batch = self._q[: self.max_batch_size]
+                del self._q[: self.max_batch_size]
+            if not batch:
+                continue
+            try:
+                self._run_batch(batch)
+            except Exception:
+                # belt over _run_batch's own error handling: the drainer
+                # thread must survive ANY failure or the batcher wedges
+                import logging
+                logging.getLogger(__name__).exception(
+                    "batch drainer iteration failed")
+                for p in batch:
+                    p.complete(errors.EINTERNAL, "batch drainer error",
+                               None)
+
+    def _run_batch(self, batch: list[_Pending]) -> None:
+        now = time.monotonic()
+        live: list[_Pending] = []
+        for p in batch:
+            if p.deadline_s is not None and p.deadline_s < now:
+                # expired while queued (a burst pushed it past its
+                # deadline): shed at dequeue rather than computing a row
+                # nobody is waiting for
+                self.shed.add(1)
+                self.n_errors.add(1)
+                if self.limiter is not None:
+                    self.limiter.on_responded(errors.ELIMIT, 0)
+                p.complete(errors.ELIMIT,
+                           "deadline expired before batch formation", None)
+            else:
+                self.queue_delay_rec.add(int((now - p.enqueue_t) * 1e6))
+                live.append(p)
+        if not live:
+            return
+        n = len(live)
+        bshape = _bucket_up(n, self.batch_buckets)
+        lbucket = _bucket_up(max(p.length for p in live),
+                             self.length_buckets)
+        padded = np.zeros((bshape, lbucket), dtype=self.dtype)
+        real = 0
+        for i, p in enumerate(live):
+            padded[i, : p.length] = p.item
+            real += p.length
+        self._real_elems.add(real)
+        self._pad_elems.add(bshape * lbucket - real)
+        self.batch_size_rec.add(n)
+        self.n_batches.add(1)
+        t0 = time.monotonic()
+        try:
+            if fault.ENABLED and fault.hit(
+                    "serving.batch", name=self.name, batch=n) is not None:
+                raise RuntimeError("injected mid-batch failure")
+            out = np.asarray(self.batch_fn(padded))
+        except Exception as e:
+            # a failed batch completes EVERY member exactly once with a
+            # definite error — never a hang, never a partial scatter
+            self.n_errors.add(n)
+            for p in live:
+                if self.limiter is not None:
+                    self.limiter.on_responded(errors.EINTERNAL, 0)
+                p.complete(errors.EINTERNAL,
+                           f"batch execution failed: "
+                           f"{type(e).__name__}: {e}", None)
+            return
+        dt = time.monotonic() - t0
+        self._exec_ema_s = dt if self._exec_ema_s == 0.0 \
+            else 0.7 * self._exec_ema_s + 0.3 * dt
+        trim = self.padded_output if self.padded_output is not None \
+            else (out.ndim >= 2 and out.shape[-1] == lbucket)
+        for i, p in enumerate(live):
+            row = out[i, : p.length] if trim else out[i]
+            lat_us = int((time.monotonic() - p.enqueue_t) * 1e6)
+            if self.limiter is not None:
+                self.limiter.on_responded(0, lat_us)
+            self.n_completed.add(1)
+            p.complete(0, "", row)
+
+    # ---- lifecycle / introspection ----
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop accepting; the drainer flushes queued batches (no window
+        wait) and exits."""
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        self._thread.join(timeout_s)
+        # anything still queued (drainer died / timeout): definite error
+        with self._cv:
+            leftovers, self._q = self._q, []
+        for p in leftovers:
+            p.complete(errors.ELOGOFF, "batcher closed", None)
+        # unpin from the global bvar registry: the exposed PassiveStatus
+        # objects hold bound methods, which would keep a closed batcher
+        # (and everything its batch_fn captures) alive forever and
+        # defeat the serving registry's weakrefs
+        from brpc_tpu.bvar.variable import find_exposed
+        for n in self._bvar_names:
+            v = find_exposed(n)
+            if v is not None:
+                v.hide()
+
+    def _pad_waste(self) -> float:
+        real = self._real_elems.get_value()
+        pad = self._pad_elems.get_value()
+        total = real + pad
+        return round(pad / total, 4) if total else 0.0
+
+    def stats(self) -> dict:
+        with self._cv:
+            queued = len(self._q)
+        return {
+            "max_batch_size": self.max_batch_size,
+            "max_delay_us": self.max_delay_us,
+            "batch_buckets": list(self.batch_buckets),
+            "length_buckets": list(self.length_buckets),
+            "queued": queued,
+            "batches": self.n_batches.get_value(),
+            "completed": self.n_completed.get_value(),
+            "errors": self.n_errors.get_value(),
+            "shed": self.shed.get_value(),
+            "avg_batch_size": round(self.batch_size_rec.get_value(), 2),
+            "pad_waste_ratio": self._pad_waste(),
+            "queue_delay_avg_us": round(self.queue_delay_rec.latency(), 1),
+            "queue_delay_p99_us": round(
+                self.queue_delay_rec.latency_percentile(0.99), 1),
+            "exec_ema_ms": round(self._exec_ema_s * 1e3, 3),
+        }

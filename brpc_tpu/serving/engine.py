@@ -1,0 +1,329 @@
+"""Continuous-batching autoregressive decode engine.
+
+A fixed pool of decode slots steps together through ONE jitted step
+function; requests join and leave the step loop mid-flight (continuous
+batching — no waiting for the slowest member of a static batch), and
+each generated token streams back to its caller per step.
+
+KV-cache residency follows the `ici/block_pool.py` discipline: every
+admitted request leases one HBM block for its slot's KV cache
+(``pool.alloc``) and releases it at retirement (``block.free``) —
+occupancy returns to baseline after drain, so the chaos suite can
+leak-check the engine exactly like the transport.
+
+The step function sees FIXED shapes — ``step_fn(tokens[num_slots],
+positions[num_slots])`` — so the jit cache compiles once for the life
+of the engine regardless of how requests churn through the slots.
+Inactive slots carry zeros; their outputs are ignored.
+
+Emission: ``emit(token)`` runs on the engine thread once per generated
+token — hand it a ``Stream.write`` (rpc/stream.py credit window) for
+TRPC callers or a ``ProgressiveAttachment.write`` for HTTP clients.
+``on_done(err)`` fires exactly once per request, success or failure.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from brpc_tpu import errors, fault
+from brpc_tpu.bvar import Adder, IntRecorder, PassiveStatus
+
+_req_ids = itertools.count(1)
+
+
+class _Request:
+    __slots__ = ("req_id", "prompt", "max_new_tokens", "emit", "on_done",
+                 "_done_fired", "_mu")
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 emit: Callable[[int], None],
+                 on_done: Optional[Callable]):
+        self.req_id = next(_req_ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.emit = emit
+        self.on_done = on_done
+        self._done_fired = False
+        self._mu = threading.Lock()
+
+    def finish(self, err: Optional[errors.RpcError]) -> None:
+        """Exactly-once terminal notification."""
+        with self._mu:
+            if self._done_fired:
+                return
+            self._done_fired = True
+        if self.on_done is not None:
+            try:
+                self.on_done(err)
+            except Exception:
+                # an on_done bug must not kill the engine thread, but it
+                # must leave a trace — a silently-lost terminal message
+                # reads as a hung client with no server-side evidence
+                import logging
+                logging.getLogger(__name__).exception(
+                    "engine on_done callback raised")
+
+
+class _Slot:
+    __slots__ = ("req", "block", "last_token", "position", "generated")
+
+    def __init__(self, req: _Request, block):
+        self.req = req
+        self.block = block                    # leased KV-cache block
+        self.last_token = req.prompt[-1] if req.prompt else 0
+        self.position = len(req.prompt)
+        self.generated = 0
+
+
+class DecodeEngine:
+    """Continuous-decode loop over a fixed slot pool."""
+
+    def __init__(self, step_fn: Callable, *,
+                 num_slots: int = 8,
+                 kv_bytes_per_slot: int = 4096,
+                 pool=None,
+                 device=None,
+                 eos_token: Optional[int] = None,
+                 max_new_tokens_cap: int = 65536,
+                 name: str = "engine"):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.step_fn = step_fn
+        self.num_slots = int(num_slots)
+        self.kv_bytes_per_slot = int(kv_bytes_per_slot)
+        self.eos_token = eos_token
+        # hard per-request ceiling: a hostile/buggy max_new_tokens must
+        # not pin a decode slot effectively forever (the glue layers
+        # pass client-supplied values straight through)
+        self.max_new_tokens_cap = int(max_new_tokens_cap)
+        self.name = name
+        if pool is None:
+            from brpc_tpu.ici.block_pool import get_block_pool
+            pool = get_block_pool(device)
+        self.pool = pool
+
+        safe = re.sub(r"\W", "_", name)
+        # record the EXACT names exposed here so close() hides only this
+        # engine's variables — a prefix wildcard would also strip a
+        # sibling component whose name merely starts with ours
+        from brpc_tpu.bvar.variable import exposed_variables
+        pre = set(exposed_variables(f"serving_{safe}*"))
+        self.steps = Adder(f"serving_{safe}_steps")
+        self.tokens_out = Adder(f"serving_{safe}_tokens")
+        self.retired = Adder(f"serving_{safe}_retired")
+        self.admit_errors = Adder(f"serving_{safe}_admit_errors")
+        self.occupancy_rec = IntRecorder(f"serving_{safe}_occupancy")
+        PassiveStatus(self.active_count).expose(
+            f"serving_{safe}_active_slots")
+        self._bvar_names = [n for n in exposed_variables(f"serving_{safe}*")
+                            if n not in pre]
+
+        self._cv = threading.Condition()
+        self._slots: list[Optional[_Slot]] = [None] * self.num_slots
+        self._waiters: deque[_Request] = deque()
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"serving-engine-{safe}")
+        self._thread.start()
+        from brpc_tpu import serving as _serving
+        _serving._register_engine(self)
+
+    # ---- submission ----
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               emit: Callable[[int], None],
+               on_done: Optional[Callable] = None) -> int:
+        """Queue a request; it is admitted into the step loop at the next
+        step boundary with a free slot (in-flight requests are never
+        restarted).  Returns the request id; terminal state arrives via
+        ``on_done(err)`` exactly once."""
+        req = _Request(prompt, min(int(max_new_tokens),
+                                   self.max_new_tokens_cap),
+                       emit, on_done)
+        if req.max_new_tokens <= 0:
+            req.finish(errors.RpcError(errors.EREQUEST,
+                                       "max_new_tokens must be > 0"))
+            return req.req_id
+        with self._cv:
+            if not self._running:
+                closed = True
+            else:
+                closed = False
+                self._waiters.append(req)
+                self._cv.notify()
+        if closed:
+            req.finish(errors.RpcError(errors.ELOGOFF, "engine closed"))
+        return req.req_id
+
+    def _admit_locked(self) -> None:
+        """Move waiters into free slots (called at step boundaries under
+        the cv).  A failed KV lease completes THAT request with a
+        definite error and leaves the loop healthy."""
+        for i in range(self.num_slots):
+            if self._slots[i] is not None or not self._waiters:
+                continue
+            req = self._waiters.popleft()
+            try:
+                if fault.ENABLED and fault.hit(
+                        "serving.slot_alloc", name=self.name,
+                        slot=i) is not None:
+                    raise MemoryError("injected KV slot alloc failure")
+                block = self.pool.alloc(self.kv_bytes_per_slot)
+            except Exception as e:
+                self.admit_errors.add(1)
+                req.finish(errors.RpcError(
+                    errors.ELIMIT,
+                    f"KV slot lease failed: {type(e).__name__}: {e}"))
+                continue
+            self._slots[i] = _Slot(req, block)
+
+    # ---- the step loop ----
+
+    def _loop(self) -> None:
+        import jax.numpy as jnp
+        while True:
+            with self._cv:
+                if not self._running:
+                    # close() retires in-flight slots (with ELOGOFF) after
+                    # joining this thread — exit at the step boundary
+                    return
+                self._admit_locked()
+                active = [(i, s) for i, s in enumerate(self._slots)
+                          if s is not None]
+                if not active:
+                    self._cv.wait()
+                    continue
+            tok = np.zeros((self.num_slots,), np.int32)
+            pos = np.zeros((self.num_slots,), np.int32)
+            for i, s in active:
+                tok[i] = s.last_token
+                pos[i] = s.position
+            try:
+                out = np.asarray(
+                    self.step_fn(jnp.asarray(tok), jnp.asarray(pos)))
+            except Exception as e:
+                # a broken step function must not wedge callers: retire
+                # every active request with a definite error
+                err = errors.RpcError(
+                    errors.EINTERNAL,
+                    f"decode step failed: {type(e).__name__}: {e}")
+                with self._cv:
+                    reqs = [self._release_slot_locked(i)
+                            for i, s in active]
+                for req in filter(None, reqs):
+                    req.finish(err)
+                continue
+            self.steps.add(1)
+            self.occupancy_rec.add(len(active))
+            for i, s in active:
+                nxt = int(out[i])
+                s.last_token = nxt
+                s.position += 1
+                s.generated += 1
+                self.tokens_out.add(1)
+                try:
+                    s.req.emit(nxt)
+                except Exception as e:
+                    self._retire(i, errors.RpcError(
+                        errors.EINTERNAL,
+                        f"emit failed: {type(e).__name__}: {e}"))
+                    continue
+                if s.generated >= s.req.max_new_tokens or \
+                        (self.eos_token is not None
+                         and nxt == self.eos_token):
+                    self._retire(i, None)
+
+    def _release_slot_locked(self, i: int):
+        """Release slot i under the cv: free the KV block back to the
+        pool exactly once and return the request for the CALLER to
+        finish OUTSIDE the lock — on_done may do a blocking network
+        write (stream credit window), and firing it under the cv would
+        stall the step loop, submit(), stats() and the exposed
+        active-slots bvar for the whole write."""
+        s = self._slots[i]
+        if s is None:
+            return None
+        self._slots[i] = None
+        self.retired.add(1)
+        try:
+            s.block.free()
+        except Exception:
+            pass
+        return s.req
+
+    def _retire(self, i: int, err) -> None:
+        with self._cv:
+            req = self._release_slot_locked(i)
+        if req is not None:
+            req.finish(err)
+
+    # ---- lifecycle / introspection ----
+
+    def active_count(self) -> int:
+        with self._cv:
+            return sum(1 for s in self._slots if s is not None)
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop the loop; in-flight and queued requests complete with
+        ELOGOFF and every leased KV block returns to the pool."""
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        self._thread.join(timeout_s)
+        err = errors.RpcError(errors.ELOGOFF, "engine closed")
+        with self._cv:
+            reqs = [self._release_slot_locked(i)
+                    for i in range(self.num_slots)]
+            waiters, self._waiters = list(self._waiters), deque()
+        for req in filter(None, reqs):
+            req.finish(err)
+        for req in waiters:
+            req.finish(err)
+        # unpin exposed bvars (bound-method PassiveStatus would keep a
+        # closed engine alive in the global registry forever)
+        from brpc_tpu.bvar.variable import find_exposed
+        for n in self._bvar_names:
+            v = find_exposed(n)
+            if v is not None:
+                v.hide()
+
+    def join_idle(self, timeout_s: float = 30.0) -> bool:
+        """Block until no request is active or queued (drain helper for
+        tests and graceful shutdown)."""
+        import time
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._cv:
+                if not self._waiters and all(
+                        s is None for s in self._slots):
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def stats(self) -> dict:
+        with self._cv:
+            slot_map = [
+                None if s is None else {
+                    "req_id": s.req.req_id,
+                    "generated": s.generated,
+                    "max_new_tokens": s.req.max_new_tokens,
+                    "position": s.position,
+                } for s in self._slots]
+            queued = len(self._waiters)
+        return {
+            "num_slots": self.num_slots,
+            "kv_bytes_per_slot": self.kv_bytes_per_slot,
+            "slots": slot_map,
+            "queued": queued,
+            "steps": self.steps.get_value(),
+            "tokens": self.tokens_out.get_value(),
+            "retired": self.retired.get_value(),
+            "admit_errors": self.admit_errors.get_value(),
+            "avg_step_occupancy": round(self.occupancy_rec.get_value(), 2),
+        }

@@ -1,0 +1,139 @@
+"""Serving registration glue — exposes the batcher and the decode engine
+on an ordinary rpc/server.py.
+
+Two methods ride the normal dispatch path (auth, interceptor, limiters,
+MethodStatus accounting all apply):
+
+  * ``Serving.Score`` — unary, JSON ``{"x": [floats...]}``; the handler
+    defers the RPC into the DynamicBatcher and the batch drainer
+    completes it (``{"y": ...}``), ELIMIT-shedding deadline-doomed
+    requests up front.
+  * ``Serving.Generate`` — streaming, JSON ``{"prompt": [ints...],
+    "max_new_tokens": N}`` with a client stream attached
+    (``stream_create``); each generated token arrives as one stream
+    message ``{"token": t}``, terminated by ``{"done": true}`` and
+    stream close.
+
+HTTP clients get the same decode stream without a TRPC stack:
+``/serving/generate?prompt=1,2,3&max_new_tokens=8`` answers chunked
+(ProgressiveAttachment), one JSON line per token.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from brpc_tpu import errors
+from brpc_tpu.rpc.service import Service, method
+
+
+class ServingService(Service):
+    NAME = "Serving"
+
+    def __init__(self, batcher=None, engine=None):
+        self._batcher = batcher
+        self._engine = engine
+
+    @method(request="json", response="json")
+    def Score(self, cntl, req):
+        if self._batcher is None:
+            cntl.set_failed(errors.ENOMETHOD, "no batcher registered")
+            return None
+        x = (req or {}).get("x")
+        if x is None:
+            cntl.set_failed(errors.EREQUEST, 'missing "x"')
+            return None
+        self._batcher.submit(
+            cntl, np.asarray(x, dtype=np.float32),
+            transform=lambda row: {"y": np.asarray(row).tolist()})
+        return None   # deferred: the batch drainer completes the RPC
+
+    @method(request="json", response="json")
+    def Generate(self, cntl, req):
+        if self._engine is None:
+            cntl.set_failed(errors.ENOMETHOD, "no decode engine registered")
+            return None
+        req = req or {}
+        prompt = req.get("prompt") or [0]
+        max_new = int(req.get("max_new_tokens", 16))
+        stream = cntl.accept_stream()
+
+        def emit(tok: int) -> None:
+            # Bounded write: emit runs on the SHARED engine step thread,
+            # so a consumer that stops draining its credit window may
+            # stall every decode slot — but only for this timeout, after
+            # which the raise retires this request and the loop resumes
+            # (per-request emit buffering is a ROADMAP follow-on).
+            stream.write(json.dumps({"token": tok}).encode(),
+                         timeout_s=2.0)
+
+        def on_done(err) -> None:
+            msg = {"done": True}
+            if err is not None:
+                msg["error"] = err.code
+                msg["error_text"] = err.text
+            try:
+                # same stall bound as emit: this runs on the shared
+                # engine thread, and a consumer whose window is already
+                # full would otherwise block the default 10s here
+                stream.write(json.dumps(msg).encode(), timeout_s=2.0)
+            except errors.RpcError:
+                pass   # peer already gone; nothing to tell it
+            stream.close()
+
+        rid = self._engine.submit(prompt, max_new, emit, on_done)
+        return {"accepted": True, "req_id": rid}
+
+
+def http_generate_handler(engine):
+    """Build an HTTP handler streaming decode tokens as chunked JSON
+    lines through a ProgressiveAttachment — the no-TRPC client path."""
+    from brpc_tpu.rpc.progressive import ProgressiveResponse
+
+    def handler(req):
+        try:
+            prompt = [int(t) for t in
+                      (req.query.get("prompt") or "0").split(",") if t]
+            max_new = int(req.query.get("max_new_tokens", "16"))
+        except ValueError as e:
+            from brpc_tpu.builtin.router import http_response
+            return http_response(400, f"bad query: {e}\n")
+
+        def writer(pa):
+            def emit(tok: int) -> None:
+                # ProgressiveAttachment.write returns -1 (never raises)
+                # once the connection died; raising here makes the
+                # engine retire the slot instead of decoding to nobody
+                if pa.write(json.dumps({"token": tok}) + "\n") != 0:
+                    raise errors.RpcError(errors.EFAILEDSOCKET,
+                                          "http client gone")
+
+            def on_done(err) -> None:
+                msg = {"done": True}
+                if err is not None:
+                    msg["error"] = err.code
+                pa.write(json.dumps(msg) + "\n")
+                pa.close()
+
+            engine.submit(prompt, max_new, emit, on_done)
+
+        return ProgressiveResponse(writer,
+                                   content_type="application/json-seq")
+
+    return handler
+
+
+def register_serving(server, batcher=None, engine=None,
+                     http_generate_path: Optional[str]
+                     = "/serving/generate") -> ServingService:
+    """Register the serving surface on a Server: the Serving service
+    (Score/Generate) plus the chunked HTTP generate route.  Call before
+    ``server.start()``."""
+    svc = ServingService(batcher, engine)
+    server.add_service(svc)
+    if engine is not None and http_generate_path:
+        server.add_http_handler(http_generate_path,
+                                http_generate_handler(engine))
+    return svc

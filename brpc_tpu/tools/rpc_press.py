@@ -2,10 +2,16 @@
 (reference tools/rpc_press/rpc_press_impl.cpp: sends sample requests from
 JSON at a target qps, reports qps + latency percentiles).
 
-Example:
+Unary example:
   python -m brpc_tpu.tools.rpc_press --server 127.0.0.1:8000 \
       --service EchoService --method Echo --input '{"msg":"hi"}' \
       --qps 5000 --duration 10 --threads 8
+
+Streaming mode (--streaming) drives a method that streams items back
+over the credit-windowed stream layer (e.g. Serving.Generate): each
+worker attaches a client stream per call, counts delivered items, and
+reports items/s plus time-to-first-item percentiles — the serving-path
+analog of unary qps/latency.
 """
 from __future__ import annotations
 
@@ -77,6 +83,92 @@ def run_press(server: str, service: str, method: str, request,
     return summary
 
 
+class _PressStreamHandler(brpc.StreamHandler):
+    """Counts delivered items, stamps the first one, latches close."""
+
+    def __init__(self):
+        self.items = 0
+        self.first_at = None
+        self.closed = threading.Event()
+
+    def on_received_messages(self, stream, messages):
+        if self.first_at is None:
+            self.first_at = time.monotonic()
+        self.items += len(messages)
+
+    def on_closed(self, stream):
+        self.closed.set()
+
+
+def run_streaming_press(server: str, service: str, method: str, request,
+                        duration_s: float = 10.0, threads: int = 4,
+                        serializer: str = "json", timeout_ms: int = 5000,
+                        connection_type: str = "single",
+                        out=sys.stderr) -> dict:
+    """Streaming load: one client stream per call, looped per worker for
+    `duration_s`.  Reports aggregate items/s and time-to-first-item
+    (TTFI) percentiles; a stream that never closes within the timeout
+    counts as an error."""
+    ch = brpc.Channel(server, timeout_ms=timeout_ms,
+                      connection_type=connection_type)
+    ttfi = LatencyRecorder("rpc_press_ttfi")
+    items = [0]
+    streams_ok = [0]
+    nerr = [0]
+    mu = threading.Lock()
+    stop = threading.Event()
+
+    def worker():
+        while not stop.is_set():
+            h = _PressStreamHandler()
+            cntl = brpc.Controller()
+            stream = brpc.stream_create(cntl, h)
+            t0 = time.monotonic()
+            try:
+                ch.call_sync(service, method, request,
+                             serializer=serializer, cntl=cntl)
+            except Exception:
+                with mu:
+                    nerr[0] += 1
+                stream.close()
+                continue
+            ok = h.closed.wait(timeout_ms / 1e3)
+            with mu:
+                if ok:
+                    streams_ok[0] += 1
+                    items[0] += h.items
+                    if h.first_at is not None:
+                        ttfi.add(int((h.first_at - t0) * 1e6))
+                else:
+                    nerr[0] += 1
+            if not ok:
+                stream.close()
+
+    ts = [threading.Thread(target=worker, daemon=True)
+          for _ in range(threads)]
+    t_start = time.monotonic()
+    [t.start() for t in ts]
+    try:
+        time.sleep(duration_s)
+    finally:
+        stop.set()
+    [t.join(timeout_ms / 1e3 + 2) for t in ts]
+    elapsed = time.monotonic() - t_start
+    summary = {
+        "streams_ok": streams_ok[0],
+        "errors": nerr[0],
+        "items": items[0],
+        "items_per_s": round(items[0] / elapsed, 1),
+        "ttfi_avg_us": round(ttfi.latency(), 1),
+        "ttfi_p50_us": ttfi.latency_percentile(0.5),
+        "ttfi_p90_us": ttfi.latency_percentile(0.9),
+        "ttfi_p99_us": ttfi.latency_percentile(0.99),
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(summary), file=out)
+    return summary
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--server", required=True, help="host:port")
@@ -84,23 +176,36 @@ def main(argv=None):
     ap.add_argument("--method", required=True)
     ap.add_argument("--input", default="{}",
                     help="JSON request body, or @file.json")
-    ap.add_argument("--qps", type=int, default=0, help="0 = unthrottled")
+    ap.add_argument("--qps", type=int, default=0,
+                    help="0 = unthrottled (unary mode only)")
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--timeout-ms", type=int, default=1000)
     ap.add_argument("--serializer", default="json")
     ap.add_argument("--connection-type", default="single",
                     choices=["single", "pooled", "short"])
+    ap.add_argument("--streaming", action="store_true",
+                    help="drive a streaming method: attach a client "
+                         "stream per call, report items/s and "
+                         "time-to-first-item percentiles")
     a = ap.parse_args(argv)
     text = a.input
     if text.startswith("@"):
         with open(text[1:]) as f:
             text = f.read()
     req = json.loads(text)
-    run_press(a.server, a.service, a.method, req, qps=a.qps,
-              duration_s=a.duration, threads=a.threads,
-              serializer=a.serializer, timeout_ms=a.timeout_ms,
-              connection_type=a.connection_type, out=sys.stdout)
+    if a.streaming:
+        run_streaming_press(a.server, a.service, a.method, req,
+                            duration_s=a.duration, threads=a.threads,
+                            serializer=a.serializer,
+                            timeout_ms=a.timeout_ms,
+                            connection_type=a.connection_type,
+                            out=sys.stdout)
+    else:
+        run_press(a.server, a.service, a.method, req, qps=a.qps,
+                  duration_s=a.duration, threads=a.threads,
+                  serializer=a.serializer, timeout_ms=a.timeout_ms,
+                  connection_type=a.connection_type, out=sys.stdout)
 
 
 if __name__ == "__main__":

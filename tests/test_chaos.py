@@ -824,3 +824,127 @@ class TestAdviceRegressions:
             batch_t0, batch_t0 + bench.WEDGE_TIMEOUT_S + 1)
         # the old bug, kept as documentation: region-relative time flags
         assert bench._batch_wedged(region_t0, now)
+
+
+# ---------------------------------------------------------------------------
+# scenario 9: serving layer — mid-batch failure + KV slot lease failure
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_serving_midbatch_fault_exactly_once_and_kv_baseline(seed):
+    """Injected serving faults uphold the serving layer's invariants:
+
+    * `serving.batch` fires mid-batch -> EVERY member call of that batch
+      completes exactly once with a definite error (never neither, never
+      a partial scatter), calls in other batches succeed, and the
+      batcher's queue accounting returns to baseline;
+    * `serving.slot_alloc` fails one KV lease -> that request gets a
+      definite error, the step loop keeps serving the others, and
+      block-pool occupancy returns to baseline (no leaked KV blocks).
+    """
+    import jax
+    import numpy as np
+
+    from brpc_tpu.serving import DecodeEngine, DynamicBatcher, \
+        register_serving
+
+    traces = []
+
+    def _fn(x):
+        traces.append(tuple(x.shape))
+        return x.sum(axis=1)
+
+    batcher = DynamicBatcher(
+        jax.jit(_fn), max_batch_size=4, max_delay_us=30_000,
+        length_buckets=(16,), name=f"chaos_b{seed}")
+
+    @jax.jit
+    def step(tokens, positions):
+        return tokens + 1
+
+    from brpc_tpu.ici.block_pool import get_block_pool
+    pool = get_block_pool(jax.devices()[0])
+
+    def occupancy():
+        with pool._lock:
+            return {c: len(pool._free[c]) for c in pool._free}
+
+    free0 = occupancy()
+    engine = DecodeEngine(step, num_slots=2, kv_bytes_per_slot=1024,
+                          pool=pool, name=f"chaos_e{seed}")
+    s = brpc.Server()
+    register_serving(s, batcher=batcher, engine=engine,
+                     http_generate_path=None)
+    s.start("127.0.0.1", 0)
+    # max_retry=0: the injected batch failure must surface as the
+    # definite error it is, not be papered over by a client retry
+    ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=10_000,
+                      max_retry=0)
+    try:
+        plan = fault.FaultPlan(seed)
+        plan.on("serving.batch", fault.ERROR, times=1)
+        plan.on("serving.slot_alloc", fault.ERROR, times=1)
+        with fault.injected(plan):
+            # ---- phase 1: mid-batch failure over real RPC ----
+            n = 12
+            outcomes = []
+            mu = threading.Lock()
+
+            def one():
+                try:
+                    r = ch.call_sync("Serving", "Score", {"x": [1.0, 2.0]},
+                                     serializer="json")
+                    code = 0
+                    assert r["y"] == 3.0
+                except errors.RpcError as e:
+                    code = e.code
+                with mu:
+                    outcomes.append(code)
+
+            ts = [threading.Thread(target=one) for _ in range(n)]
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            # exactly once each: every call has ONE definite outcome
+            assert len(outcomes) == n, f"{n - len(outcomes)} calls hung"
+            assert plan.injected["serving.batch"] == 1
+            nerr = sum(1 for c in outcomes if c != 0)
+            assert nerr >= 1, "injected batch failure reached no caller"
+            assert all(c in (0, errors.EINTERNAL) for c in outcomes)
+            st = batcher.stats()
+            assert st["queued"] == 0
+            assert st["completed"] + st["errors"] == n
+
+            # ---- phase 2: KV slot lease failure mid-admission ----
+            sinks = []
+            for i in range(4):
+                done = threading.Event()
+                toks = []
+                errbox = []
+                sinks.append((done, toks, errbox))
+                engine.submit(
+                    [i * 10], 3, toks.append,
+                    lambda err, d=done, eb=errbox: (eb.append(err),
+                                                    d.set()))
+            for done, _, _ in sinks:
+                assert done.wait(30), "engine request hung"
+            assert plan.injected["serving.slot_alloc"] == 1
+            errs = [eb[0] for _, _, eb in sinks]
+            failed = [e for e in errs if e is not None]
+            assert len(failed) == 1 and failed[0].code == errors.ELIMIT
+            for (_, toks, eb), i in zip(sinks, range(4)):
+                if eb[0] is None:
+                    assert toks == [i * 10 + 1, i * 10 + 2, i * 10 + 3]
+        # post-chaos: occupancy back to baseline, engine still serves
+        assert engine.join_idle(10)
+        assert wait_until(lambda: occupancy() == free0, 10), \
+            f"KV blocks leaked: {occupancy()} != {free0}"
+        done = threading.Event()
+        toks = []
+        engine.submit([7], 2, toks.append, lambda err: done.set())
+        assert done.wait(20) and toks == [8, 9]
+    finally:
+        s.stop()
+        s.join()
+        batcher.close()
+        engine.close()
+        assert wait_until(lambda: occupancy() == free0, 10)

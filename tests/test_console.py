@@ -193,7 +193,8 @@ def test_every_console_route_answers(server):
     routes = [
         "/", "/index", "/status", "/vars", "/flags", "/health",
         "/version", "/connections", "/sockets", "/bthreads", "/services",
-        "/protobufs", "/memory", "/ici", "/rpcz", "/brpc_metrics",
+        "/protobufs", "/memory", "/ici", "/serving", "/rpcz",
+        "/brpc_metrics",
         "/dashboard", "/vlog", "/hotspots",
         "/hotspots/cpu?seconds=0.05",
         "/hotspots/contention?seconds=0.05",

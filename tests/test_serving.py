@@ -1,0 +1,496 @@
+"""Serving layer tests — dynamic batcher, continuous decode, RPC glue.
+
+Covers the ISSUE 2 acceptance criteria directly:
+  * deadline-aware ELIMIT shed BEFORE batch formation, accounting back
+    to baseline;
+  * bucket padding hits the jit cache (one compile per bucket shape,
+    however many raw lengths flow through);
+  * >= 3x the qps of batch=1 issuance at max_batch_size=16 with p99
+    queue delay <= 2x max_delay_us;
+  * continuous decode admits a new request into an IN-FLIGHT step loop
+    and streams its tokens without restarting existing requests.
+"""
+import http.client
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.serving import (DecodeEngine, DynamicBatcher, ServingService,
+                              register_serving)
+
+from testutil import wait_until
+
+
+def _sum_fn():
+    """Jitted per-row sum with a trace counter: `traces` records one
+    entry per COMPILE (the python body runs only while tracing)."""
+    traces = []
+
+    def _fn(x):
+        traces.append(tuple(x.shape))
+        return x.sum(axis=1)
+
+    return jax.jit(_fn), traces
+
+
+# ---------------------------------------------------------------------------
+# batcher core
+# ---------------------------------------------------------------------------
+
+def test_batcher_scatter_correctness():
+    fn, _ = _sum_fn()
+    b = DynamicBatcher(fn, max_batch_size=4, max_delay_us=2000,
+                       length_buckets=(16, 64), name="t_scatter")
+    try:
+        results = {}
+        ts = []
+
+        def one(i, ln):
+            results[i] = float(b.submit_wait(np.full((ln,), i + 1.0,
+                                                     np.float32)))
+
+        for i, ln in enumerate((3, 7, 20, 1, 40)):
+            t = threading.Thread(target=one, args=(i, ln))
+            t.start()
+            ts.append(t)
+        [t.join(15) for t in ts]
+        assert results == {0: 3.0, 1: 14.0, 2: 60.0, 3: 4.0, 4: 200.0}
+    finally:
+        b.close()
+
+
+def test_batcher_bucket_padding_compiles_once_per_bucket():
+    """Many raw lengths, few compiled shapes: the jit cache must see only
+    bucket shapes (the whole point of padding)."""
+    fn, traces = _sum_fn()
+    b = DynamicBatcher(fn, max_batch_size=4, max_delay_us=500,
+                       batch_buckets=(4,), length_buckets=(16, 64),
+                       name="t_buckets")
+    try:
+        for ln in range(1, 41):            # 40 distinct raw lengths
+            got = b.submit_wait(np.ones((ln,), np.float32))
+            assert float(got) == pytest.approx(float(ln))
+        # every batch was padded to batch-bucket 4 and one of two length
+        # buckets -> at most 2 compiles for 40 raw lengths
+        assert sorted(set(traces)) == sorted(traces), traces
+        assert set(traces) <= {(4, 16), (4, 64)}, traces
+        assert len(traces) == 2, traces
+        assert b.stats()["pad_waste_ratio"] > 0
+    finally:
+        b.close()
+
+
+def test_batcher_rejects_oversized_and_bad_rank():
+    fn, _ = _sum_fn()
+    b = DynamicBatcher(fn, max_batch_size=2, max_delay_us=500,
+                       length_buckets=(16,), name="t_reject")
+    try:
+        with pytest.raises(errors.RpcError) as ei:
+            b.submit_wait(np.ones((17,), np.float32))
+        assert ei.value.code == errors.EREQUEST
+        with pytest.raises(errors.RpcError) as ei:
+            b.submit_wait(np.ones((2, 2), np.float32))
+        assert ei.value.code == errors.EREQUEST
+    finally:
+        b.close()
+
+
+def test_batcher_deadline_shed_local():
+    """A local deadline shorter than the batching window sheds
+    immediately with ELIMIT — before any batch forms."""
+    fn, _ = _sum_fn()
+    b = DynamicBatcher(fn, max_batch_size=16, max_delay_us=700_000,
+                       length_buckets=(16,), name="t_shed_local")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(errors.RpcError) as ei:
+            b.submit_wait(np.ones((4,), np.float32),
+                          deadline_s=time.monotonic() + 0.05)
+        elapsed = time.monotonic() - t0
+        assert ei.value.code == errors.ELIMIT
+        assert elapsed < 0.35, f"shed took {elapsed:.3f}s (not immediate)"
+        st = b.stats()
+        assert st["shed"] == 1 and st["queued"] == 0 and st["batches"] == 0
+    finally:
+        b.close()
+
+
+def test_batcher_throughput_and_queue_delay():
+    """ISSUE 2 acceptance: >= 3x the qps of batch=1 issuance at
+    max_batch_size=16, p99 queue delay <= 2x max_delay_us."""
+    D, H = 256, 4096
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((D, H)).astype(np.float32))
+    w2 = jnp.asarray(rng.standard_normal((H, H)).astype(np.float32))
+    w3 = jnp.asarray(rng.standard_normal((H, 1)).astype(np.float32))
+
+    @jax.jit
+    def score(x):
+        return jnp.tanh(jnp.tanh(x @ w1) @ w2) @ w3
+
+    item = np.ones((D,), np.float32)
+    max_delay_us = 20_000
+
+    def drive(bs: int, threads: int, duration_s: float = 0.8):
+        b = DynamicBatcher(score, max_batch_size=bs,
+                           max_delay_us=max_delay_us,
+                           batch_buckets=(bs,), length_buckets=(D,),
+                           name=f"t_tp_{bs}")
+        try:
+            b.submit_wait(item)            # warm the jit cache
+            stop = time.monotonic() + duration_s
+            counts = [0] * threads
+
+            def worker(k):
+                while time.monotonic() < stop:
+                    b.submit_wait(item)
+                    counts[k] += 1
+
+            ts = [threading.Thread(target=worker, args=(k,))
+                  for k in range(threads)]
+            t0 = time.monotonic()
+            [t.start() for t in ts]
+            [t.join(30) for t in ts]
+            wall = time.monotonic() - t0
+            qps = sum(counts) / wall
+            p99_us = b.queue_delay_rec.latency_percentile(0.99)
+            return qps, p99_us
+        finally:
+            b.close()
+
+    # measured ~9x / ~10ms on an idle box — wide margin over the 3x /
+    # 20ms bounds; one retry absorbs a loaded-CI fluke without blunting
+    # the assertion
+    for attempt in (0, 1):
+        qps1, _ = drive(1, threads=16)
+        qps16, p99_us = drive(16, threads=48)
+        if qps16 >= 3.0 * qps1 and p99_us <= 2 * max_delay_us:
+            break
+    assert qps16 >= 3.0 * qps1, (qps16, qps1)
+    assert p99_us <= 2 * max_delay_us, (p99_us, max_delay_us)
+
+
+def test_batcher_limiter_integration():
+    """The optional queue limiter rides the SAME create_limiter specs
+    servers use and answers ELIMIT like any admission refusal."""
+    fn, _ = _sum_fn()
+    b = DynamicBatcher(fn, max_batch_size=4, max_delay_us=200_000,
+                       length_buckets=(16,), limiter=2, name="t_limiter")
+    try:
+        outcomes = []
+        mu = threading.Lock()
+
+        def fire(code, text, result):
+            with mu:
+                outcomes.append(code)
+
+        for _ in range(5):
+            b.enqueue(np.ones((4,), np.float32), fire)
+        assert wait_until(lambda: len(outcomes) == 5, 10)
+        assert outcomes.count(errors.ELIMIT) == 3   # queue capped at 2
+    finally:
+        b.close()
+
+
+def test_batcher_survives_raising_completion_and_transform():
+    """A raising completion callback (or response transform) must
+    complete with a definite error / be swallowed — never kill the
+    drainer and wedge the other requests."""
+    fn, _ = _sum_fn()
+    b = DynamicBatcher(fn, max_batch_size=4, max_delay_us=1000,
+                       length_buckets=(16,), name="t_raising")
+    try:
+        b.enqueue(np.ones((4,), np.float32),
+                  lambda code, text, result: 1 / 0)
+        # the drainer survived: later traffic still completes
+        assert float(b.submit_wait(np.ones((3,), np.float32))) == 3.0
+    finally:
+        b.close()
+
+
+def test_batcher_padded_output_flag_overrides_heuristic():
+    """A fixed-width per-row output whose width coincides with a length
+    bucket must NOT be trimmed when padded_output=False."""
+    @jax.jit
+    def fixed16(x):                      # [B, 16] -> [B, 16] fixed-width
+        return jnp.tile(x.sum(axis=1, keepdims=True), (1, 16))
+
+    b = DynamicBatcher(fixed16, max_batch_size=2, max_delay_us=500,
+                       length_buckets=(16,), padded_output=False,
+                       name="t_fixedw")
+    try:
+        row = b.submit_wait(np.ones((3,), np.float32))
+        assert row.shape == (16,)        # full width, not trimmed to 3
+        assert row == pytest.approx(np.full((16,), 3.0))
+    finally:
+        b.close()
+
+
+def test_close_unpins_bvars_and_registry_entry():
+    """close() must hide the exposed bvars, or the bound-method
+    PassiveStatus pins every dead batcher/engine in the global registry
+    forever (and /vars grows without bound)."""
+    import gc
+
+    from brpc_tpu import serving as serving_mod
+    from brpc_tpu.bvar.variable import exposed_variables
+    fn, _ = _sum_fn()
+    b = DynamicBatcher(fn, max_batch_size=2, max_delay_us=500,
+                       length_buckets=(16,), name="t_unpin")
+    eng = _mk_engine(num_slots=1, name="t_unpin_e")
+    assert exposed_variables("serving_t_unpin_*")
+    assert exposed_variables("serving_t_unpin_e_*")
+    b.close()
+    # closing the batcher must hide ONLY its own names — the engine is a
+    # prefix sibling ("t_unpin_e" starts with "t_unpin") and must keep
+    # its live metrics
+    assert exposed_variables("serving_t_unpin_e_*")
+    eng.close()
+    assert not exposed_variables("serving_t_unpin_*")
+    assert not exposed_variables("serving_t_unpin_e_*")
+    del b, eng
+    gc.collect()
+    snap = serving_mod.serving_snapshot()
+    assert "t_unpin" not in snap["batchers"]
+    assert "t_unpin_e" not in snap["engines"]
+
+
+# ---------------------------------------------------------------------------
+# deadline shed over real RPC
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def serving_server():
+    fn, _ = _sum_fn()
+    batcher = DynamicBatcher(fn, max_batch_size=16, max_delay_us=700_000,
+                             length_buckets=(16,), name="t_rpc")
+
+    @jax.jit
+    def step(tokens, positions):
+        return tokens + 1
+
+    engine = DecodeEngine(step, num_slots=4, kv_bytes_per_slot=1024,
+                          name="t_rpc_engine")
+    s = brpc.Server()
+    register_serving(s, batcher=batcher, engine=engine)
+    s.start("127.0.0.1", 0)
+    yield s, batcher, engine
+    s.stop()
+    s.join()
+    batcher.close()
+    engine.close()
+
+
+def test_rpc_deadline_shed_elimit(serving_server):
+    """A request whose Controller deadline is shorter than the batch
+    window is ELIMIT-shed before batch formation, and queue/slot
+    accounting returns to baseline."""
+    s, batcher, _ = serving_server
+    ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=5000)
+    t0 = time.monotonic()
+    with pytest.raises(errors.RpcError) as ei:
+        ch.call_sync("Serving", "Score", {"x": [1.0, 2.0]},
+                     serializer="json",
+                     cntl=brpc.Controller(timeout_ms=150))
+    elapsed = time.monotonic() - t0
+    assert ei.value.code == errors.ELIMIT
+    assert elapsed < 0.35, f"shed took {elapsed:.3f}s (not before window)"
+    st = batcher.stats()
+    assert st["shed"] == 1 and st["queued"] == 0 and st["batches"] == 0
+    # a request that CAN make its deadline is admitted and served
+    got = ch.call_sync("Serving", "Score", {"x": [1.0, 2.0, 3.0]},
+                       serializer="json",
+                       cntl=brpc.Controller(timeout_ms=5000))
+    assert got["y"] == pytest.approx(6.0)
+    st = batcher.stats()
+    assert st["queued"] == 0 and st["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# continuous decode engine
+# ---------------------------------------------------------------------------
+
+def _mk_engine(num_slots=4, name="t_engine"):
+    @jax.jit
+    def step(tokens, positions):
+        return tokens + 1
+
+    return DecodeEngine(step, num_slots=num_slots, kv_bytes_per_slot=1024,
+                        name=name)
+
+
+class _Sink:
+    def __init__(self):
+        self.tokens = []
+        self.err = "UNSET"
+        self.done = threading.Event()
+
+    def emit(self, tok):
+        self.tokens.append(tok)
+
+    def on_done(self, err):
+        self.err = err
+        self.done.set()
+
+
+def test_engine_streams_and_pool_baseline():
+    eng = _mk_engine(name="t_engine_base")
+    base = {k: v["free"] for k, v in eng.pool.stats()["classes"].items()}
+    a = _Sink()
+    eng.submit([10], 5, a.emit, a.on_done)
+    assert a.done.wait(20) and a.err is None
+    assert a.tokens == [11, 12, 13, 14, 15]
+    assert eng.join_idle(10)
+    now = {k: v["free"] for k, v in eng.pool.stats()["classes"].items()}
+    assert now == base, "KV blocks leaked"
+    eng.close()
+
+
+def test_engine_continuous_admission_mid_flight():
+    """A new request joins the step loop while another is mid-flight;
+    neither restarts, both stream their full token sequences."""
+    eng = _mk_engine(name="t_engine_cont")
+    try:
+        a, b = _Sink(), _Sink()
+        b_started_at_a_count = []
+
+        def b_emit(tok):
+            if not b.tokens:
+                b_started_at_a_count.append(len(a.tokens))
+            b.tokens.append(tok)
+
+        n_a = 2000   # long enough that B demonstrably overlaps it
+        eng.submit([100], n_a, a.emit, a.on_done)
+        # wait until A is demonstrably mid-flight, then admit B
+        assert wait_until(lambda: 3 <= len(a.tokens), 20)
+        eng.submit([500], 10, b_emit, b.on_done)
+        assert a.done.wait(60) and b.done.wait(60)
+        assert a.err is None and b.err is None
+        assert a.tokens == list(range(101, 101 + n_a))  # never restarted
+        assert b.tokens == list(range(501, 511))
+        # B's first token arrived while A was still decoding
+        assert 0 < b_started_at_a_count[0] < n_a
+    finally:
+        eng.close()
+
+
+def test_engine_queues_beyond_slots():
+    eng = _mk_engine(num_slots=2, name="t_engine_queue")
+    try:
+        sinks = [_Sink() for _ in range(5)]
+        for i, s in enumerate(sinks):
+            eng.submit([i * 100], 4, s.emit, s.on_done)
+        for s in sinks:
+            assert s.done.wait(30) and s.err is None
+        for i, s in enumerate(sinks):
+            assert s.tokens == list(range(i * 100 + 1, i * 100 + 5))
+        assert eng.join_idle(10)
+    finally:
+        eng.close()
+
+
+def test_engine_close_completes_inflight_with_elogoff():
+    eng = _mk_engine(num_slots=1, name="t_engine_close")
+    a = _Sink()
+    eng.submit([0], 10_000_000, a.emit, a.on_done)   # effectively endless
+    assert wait_until(lambda: len(a.tokens) > 2, 20)
+    eng.close()
+    assert a.done.wait(10)
+    assert a.err is not None and a.err.code == errors.ELOGOFF
+
+
+# ---------------------------------------------------------------------------
+# streaming generate over RPC + press tool + console
+# ---------------------------------------------------------------------------
+
+class _GenCollector(brpc.StreamHandler):
+    def __init__(self):
+        self.msgs = []
+        self.done = threading.Event()
+
+    def on_received_messages(self, stream, messages):
+        for m in messages:
+            d = json.loads(m)
+            self.msgs.append(d)
+            if d.get("done"):
+                self.done.set()
+
+    def on_closed(self, stream):
+        self.done.set()
+
+
+def test_rpc_generate_streams_tokens(serving_server):
+    s, _, _ = serving_server
+    ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=5000)
+    col = _GenCollector()
+    cntl = brpc.Controller()
+    brpc.stream_create(cntl, col)
+    resp = ch.call_sync("Serving", "Generate",
+                        {"prompt": [7], "max_new_tokens": 5},
+                        serializer="json", cntl=cntl)
+    assert resp["accepted"] is True
+    assert col.done.wait(20)
+    toks = [m["token"] for m in col.msgs if "token" in m]
+    assert toks == [8, 9, 10, 11, 12]
+    assert any(m.get("done") for m in col.msgs)
+
+
+def test_press_streaming_mode(serving_server):
+    """tools/rpc_press --streaming drives the generate path and reports
+    items/s + time-to-first-item percentiles."""
+    import io
+
+    from brpc_tpu.tools.rpc_press import run_streaming_press
+    s, _, _ = serving_server
+    out = io.StringIO()
+    summary = run_streaming_press(
+        f"127.0.0.1:{s.port}", "Serving", "Generate",
+        {"prompt": [1], "max_new_tokens": 4},
+        duration_s=0.6, threads=2, timeout_ms=5000, out=out)
+    assert summary["streams_ok"] > 0
+    assert summary["items"] >= 5 * summary["streams_ok"]  # 4 tokens + done
+    assert summary["items_per_s"] > 0
+    assert summary["ttfi_p99_us"] > 0
+    assert json.loads(out.getvalue())  # one machine-readable line
+
+
+def _http_get(port, path):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = r.read()
+    c.close()
+    return r.status, body
+
+
+def test_http_generate_progressive(serving_server):
+    """HTTP clients stream tokens through ProgressiveAttachment chunks —
+    no TRPC stack needed."""
+    s, _, _ = serving_server
+    status, body = _http_get(
+        s.port, "/serving/generate?prompt=3&max_new_tokens=4")
+    assert status == 200
+    lines = [json.loads(ln) for ln in body.decode().splitlines() if ln]
+    toks = [d["token"] for d in lines if "token" in d]
+    assert toks == [4, 5, 6, 7]
+    assert lines[-1].get("done") is True
+
+
+def test_console_serving_page(serving_server):
+    s, batcher, engine = serving_server
+    status, body = _http_get(s.port, "/serving")
+    assert status == 200
+    snap = json.loads(body)
+    assert "t_rpc" in snap["batchers"]
+    assert "t_rpc_engine" in snap["engines"]
+    st = snap["engines"]["t_rpc_engine"]
+    assert st["num_slots"] == 4 and len(st["slots"]) == 4
+    assert "shed" in snap["batchers"]["t_rpc"]
+    assert "pad_waste_ratio" in snap["batchers"]["t_rpc"]
