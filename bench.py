@@ -500,6 +500,101 @@ def bench_serving(batch_sizes=(1, 4, 16), threads_per_slot=3,
     return out
 
 
+def bench_kvcache(shared_ratios=(0.0, 0.5, 0.9), n_requests=24,
+                  prefix_tokens=32, suffix_tokens=16, new_tokens=8,
+                  trials=3):
+    """Paged-KV-cache rung: decode tokens/s and prefill-skip ratio vs
+    shared-prefix ratio through `brpc_tpu/kvcache` + the DecodeEngine.
+
+    Workload: `n_requests` prompts; a `shared_ratios` fraction open
+    with ONE fixed `prefix_tokens`-token prefix (the shared-system-
+    prompt shape) plus a unique suffix, the rest are fully distinct.
+    The radix tree warms as early requests retire, so later admits of
+    the shared prefix reuse its pages and skip that prefill — the
+    prefill_skip ratio is the store's own hit-rate gauge, and tokens/s
+    is end-to-end through admit/prefill/decode/retire.  Same jitter
+    discipline as the other rungs: `trials` runs per ratio, median +
+    spread.  The caller publishes {"skipped": true} when no device is
+    reachable."""
+    import threading
+
+    import jax
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine
+
+    pt = 16
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return tokens + 1
+
+    @jax.jit
+    def prefill(tokens, start):
+        return tokens.sum()
+
+    def one_trial(ratio: float, k: int):
+        store = KVCacheStore(page_tokens=pt, page_bytes=pt * 64,
+                             max_blocks=32,
+                             name=f"bench_r{int(ratio * 100)}_{k}")
+        eng = DecodeEngine(step, num_slots=4, store=store,
+                           prefill_fn=prefill,
+                           name=f"bench_kv_r{int(ratio * 100)}_{k}")
+        shared = list(range(1000, 1000 + prefix_tokens))
+        n_shared = int(n_requests * ratio)
+        prompts = []
+        for i in range(n_requests):
+            suffix = [2000 + i * suffix_tokens + j
+                      for j in range(suffix_tokens)]
+            head = shared if i < n_shared else \
+                [3000 + i * prefix_tokens + j
+                 for j in range(prefix_tokens)]
+            prompts.append(head + suffix)
+        try:
+            # warm the jit caches outside timing — with a THROWAWAY
+            # prompt disjoint from the measured set, so the shared0
+            # rung really sees 0% prefix reuse
+            eng.submit([9_000_000 + j for j in range(prefix_tokens)],
+                       1, lambda t: None)
+            assert eng.join_idle(60)
+            # measure the skip ratio over the TIMED workload only (the
+            # warm-up request's tokens would dilute the denominator)
+            h0 = store.hit_tokens.get_value()
+            p0 = store.prompt_tokens.get_value()
+            done = [threading.Event() for _ in prompts]
+            t0 = time.monotonic()
+            for i, p in enumerate(prompts):
+                eng.submit(p, new_tokens, lambda t: None,
+                           (lambda err, d=done[i]: d.set()))
+            for d in done:
+                assert d.wait(120), "kvcache bench request hung"
+            wall = time.monotonic() - t0
+            toks = n_requests * new_tokens
+            dp = store.prompt_tokens.get_value() - p0
+            skip = (store.hit_tokens.get_value() - h0) / dp if dp else 0.0
+            return toks / wall, skip
+        finally:
+            eng.close()
+            store.close()
+
+    out = {}
+    for ratio in shared_ratios:
+        rs = sorted(one_trial(ratio, k) for k in range(trials))
+        mid = len(rs) // 2
+        out[f"shared{int(ratio * 100)}"] = {
+            "tokens_per_s": round(rs[mid][0], 1),
+            "prefill_skip_ratio": round(rs[mid][1], 4),
+            "tokens_per_s_spread": [round(rs[0][0], 1),
+                                    round(rs[-1][0], 1)],
+            "trials": trials,
+        }
+    out["note"] = ("paged-KV rung (brpc_tpu/kvcache): decode tokens/s "
+                   "and prefill-skip (radix hit-rate) vs shared-prefix "
+                   "ratio; skip ratio climbs with sharing because "
+                   "admits reuse cached pages instead of prefilling")
+    return out
+
+
 def bench_hbm_stream(chunk_mb=64):
     """SECONDARY chip sanity number: raw on-chip HBM read+write bandwidth
     of a jitted roll+add loop.  No framework code runs here — this bounds
@@ -1223,6 +1318,15 @@ def main():
         except Exception as e:
             details["serving"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['serving']}")
+    log("bench: paged kv cache...")
+    if not device_ok:
+        details["kvcache"] = {"skipped": True, "reason": device_err}
+    else:
+        try:
+            details["kvcache"] = bench_kvcache()
+        except Exception as e:
+            details["kvcache"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['kvcache']}")
     # each bench is isolated: a failure in one must not clobber another's
     # already-valid result
     for name, fn in (("tensor_pipe", lambda: bench_tensor_pipe(chunk_mb=64)),

@@ -7,7 +7,8 @@ MethodStatus), /vars (+ wildcard filter), /flags (live edit with ?setvalue=),
 /rpcz (recent spans, ?trace_id= filter), /brpc_metrics (Prometheus text),
 /services (method inventory — /protobufs analog), /memory, /ici (link
 stats of the ICI transport), /serving (dynamic-batcher occupancy +
-decode slot map, brpc_tpu/serving).
+decode slot map, brpc_tpu/serving), /kvcache (paged-KV hit-rate, page
+occupancy, radix-tree size, eviction counters, brpc_tpu/kvcache).
 """
 from __future__ import annotations
 
@@ -266,6 +267,21 @@ def build_routes(server) -> dict:
             return "no serving components registered\n"
         return json.dumps(snap, indent=1), "application/json"
 
+    def kvcache_page(req):
+        # paged-KV-cache introspection (brpc_tpu/kvcache): hit-rate,
+        # page occupancy, radix-tree size, eviction/COW counters.
+        # Lazy import, same discipline as /serving: the kvcache layer
+        # loads only when something created a store or the operator
+        # asks for the page.
+        import sys
+        if "brpc_tpu.kvcache" not in sys.modules:
+            return "no kv-cache stores registered\n"
+        from brpc_tpu.kvcache import kvcache_snapshot
+        snap = kvcache_snapshot()
+        if not snap["stores"]:
+            return "no kv-cache stores registered\n"
+        return json.dumps(snap, indent=1), "application/json"
+
     # /hotspots profilers (hotspots_service.cpp; §5.2) — on-demand, the
     # ?seconds= and ?fmt=collapsed knobs mirror the reference's query args
     def hotspots_index(req):
@@ -419,6 +435,7 @@ def build_routes(server) -> dict:
         "/memory": memory,
         "/ici": ici,
         "/serving": serving_page,
+        "/kvcache": kvcache_page,
         "/hotspots": hotspots_index,
         "/hotspots/cpu": hotspots_cpu,
         "/hotspots/native": hotspots_native,

@@ -27,6 +27,14 @@ layers:
     serving.slot_alloc L6      error  (serving/engine.py: KV slot lease
                                fails; that request errors, the loop and
                                the block pool stay healthy)
+    kvcache.page_alloc KV      exhaust (kvcache/pages.py: page alloc
+                               raises MemoryError — the store evicts
+                               LRU radix leaves and retries; still dry
+                               -> that request errors mid-decode)
+    kvcache.evict      KV      error  (kvcache/radix.py: eviction
+                               itself fails — pressure relief is
+                               unavailable, allocation pressure
+                               surfaces to the caller)
 
 Disabled (the default), every site is a single module-attribute check —
 ``if fault.ENABLED:`` — before ANY per-site work, so the production data

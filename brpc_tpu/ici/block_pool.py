@@ -61,6 +61,22 @@ def _unstage(buf, dtype_name: str, shape: tuple):
     return jax.lax.bitcast_convert_type(
         raw.reshape(n, dt.itemsize), dt).reshape(shape)
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _slice_bytes(buf, off, nbytes: int):
+    """Read nbytes out of a block buffer at a dynamic byte offset, on
+    device (the page-granularity read half of splice)."""
+    return jax.lax.dynamic_slice(buf, (off,), (nbytes,))
+
+
+@jax.jit
+def _splice_bytes(buf, piece, off):
+    """Write `piece` into a block buffer at a dynamic byte offset, on
+    device — the rest of the buffer is untouched, so several sub-block
+    regions (KV pages) can share one block without clobbering each
+    other the way a wholesale put() would."""
+    return jax.lax.dynamic_update_slice(buf, piece, (off,))
+
+
 # size classes, mirroring the reference's 8KB/64KB/2MB (block_pool.cpp:52)
 BLOCK_CLASSES = (8 * 1024, 64 * 1024, 2 * 1024 * 1024)
 _ARENA_BLOCKS_PER_CLASS = 64

@@ -1,0 +1,312 @@
+"""KVCacheStore — the engine-facing paged KV cache.
+
+Ties :class:`~brpc_tpu.kvcache.pages.PagePool` (refcounted pages in
+leased HBM blocks) and :class:`~brpc_tpu.kvcache.radix.RadixTree`
+(longest-prefix reuse) behind the lifecycle the DecodeEngine drives:
+
+  admit(prompt)  -> KVSeq whose cached-prefix pages are SHARED (the
+                    engine prefills only the suffix — a cache hit is
+                    compute skipped, not recomputed);
+  extend(seq, t) -> one generated token's KV appended; allocates a new
+                    page at page boundaries and copies-on-write when
+                    the tail page is shared with the tree or a fork;
+  fork(seq)      -> a second sequence sharing every page (speculative /
+                    divergent continuations); divergence is isolated by
+                    the extend-path COW;
+  retire(seq)    -> full-page chunks are offered to the radix tree
+                    (future admits hit them), every seq ref drops, and
+                    idle blocks return to the BlockPool.
+
+Pool pressure: when the page pool is exhausted the store evicts
+LRU-by-leaf from the radix tree and retries once — eviction can only
+free pages nothing else references, so exhaustion under load degrades
+hit-rate, never correctness.
+
+Instrumented on /vars (and the /kvcache console page): hit-rate
+(prefix tokens reused / prompt tokens seen), pages in use, evictions,
+copy-on-write forks, admit/retire/fork counters, radix-tree size.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+from typing import Optional, Sequence
+
+from brpc_tpu.bvar import Adder, PassiveStatus
+from brpc_tpu.kvcache.pages import KVPage, PagePool
+from brpc_tpu.kvcache.radix import RadixTree
+
+_seq_ids = itertools.count(1)
+
+
+class KVSeq:
+    """One sequence's view of the cache: its materialized tokens and
+    the page table covering them.  ``prefill_from`` is where compute
+    must start — everything before it was served from shared pages."""
+
+    __slots__ = ("seq_id", "tokens", "pages", "prefill_from", "retired")
+
+    def __init__(self):
+        self.seq_id = next(_seq_ids)
+        self.tokens: list[int] = []
+        self.pages: list[KVPage] = []
+        self.prefill_from = 0
+        self.retired = False
+
+    @property
+    def prefix_hit_tokens(self) -> int:
+        return self.prefill_from
+
+    def page_ids(self) -> list[int]:
+        return [p.pid for p in self.pages]
+
+
+class KVCacheStore:
+    """Paged KV cache with radix prefix reuse (see module docstring)."""
+
+    def __init__(self, pool=None, device=None, *,
+                 page_bytes: int = 1024, page_tokens: int = 16,
+                 max_blocks: int = 8, name: str = "kv"):
+        self.pagepool = PagePool(pool, device, page_bytes=page_bytes,
+                                 page_tokens=page_tokens,
+                                 max_blocks=max_blocks, name=name)
+        self.radix = RadixTree(self.pagepool, name=name)
+        self.page_tokens = self.pagepool.page_tokens
+        self.name = name
+        self._mu = threading.RLock()
+        self._live = 0                   # admitted-but-not-retired seqs
+
+        safe = re.sub(r"\W", "_", name)
+        # record the EXACT names exposed here so close() hides only this
+        # store's variables (the serving-layer discipline)
+        from brpc_tpu.bvar.variable import exposed_variables
+        pre = set(exposed_variables(f"kvcache_{safe}*"))
+        self.hit_tokens = Adder(f"kvcache_{safe}_hit_tokens")
+        self.prompt_tokens = Adder(f"kvcache_{safe}_prompt_tokens")
+        self.evictions = Adder(f"kvcache_{safe}_evictions")
+        self.cow = Adder(f"kvcache_{safe}_cow_forks")
+        self.admitted = Adder(f"kvcache_{safe}_admitted")
+        self.retired = Adder(f"kvcache_{safe}_retired")
+        self.forks = Adder(f"kvcache_{safe}_forks")
+        PassiveStatus(self.hit_rate).expose(f"kvcache_{safe}_hit_rate")
+        PassiveStatus(self.pagepool.pages_in_use).expose(
+            f"kvcache_{safe}_pages_in_use")
+        PassiveStatus(self.radix.node_count).expose(
+            f"kvcache_{safe}_radix_nodes")
+        self._bvar_names = [n for n in exposed_variables(f"kvcache_{safe}*")
+                            if n not in pre]
+        from brpc_tpu import kvcache as _kvcache
+        _kvcache._register_store(self)
+
+    # ---- lifecycle ----
+
+    def admit(self, prompt: Sequence[int]) -> KVSeq:
+        """Start a sequence for `prompt`: its longest cached prefix is
+        served by SHARED pages (capped at len(prompt)-1 so at least one
+        token always computes — the model needs the last position's
+        output), fresh pages hold the suffix's KV."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        with self._mu:
+            max_chunks = (len(prompt) - 1) // self.page_tokens
+            shared = self.radix.match(prompt, max_chunks=max_chunks)
+            seq = KVSeq()
+            for p in shared:
+                self.pagepool.ref(p)
+                seq.pages.append(p)
+            hit = len(shared) * self.page_tokens
+            seq.tokens = prompt[:hit]
+            seq.prefill_from = hit
+            try:
+                self._append_run(seq, prompt[hit:])
+            except BaseException:
+                # a failed admit must not leak the refs already taken
+                for p in seq.pages:
+                    self.pagepool.unref(p)
+                raise
+            # count the hit only once the admit SUCCEEDS — a failed
+            # admit skipped no compute and must not inflate hit-rate
+            self.hit_tokens.add(hit)
+            self.prompt_tokens.add(len(prompt))
+            self.admitted.add(1)
+            self._live += 1
+            return seq
+
+    def extend(self, seq: KVSeq, token: int) -> None:
+        """Append one generated token's KV to `seq`."""
+        with self._mu:
+            if seq.retired:
+                raise RuntimeError(f"extend on retired seq {seq.seq_id}")
+            self._append(seq, int(token))
+
+    def fork(self, seq: KVSeq) -> KVSeq:
+        """A second sequence sharing every page of `seq` (divergent
+        continuations isolate via copy-on-write on extend)."""
+        with self._mu:
+            if seq.retired:
+                raise RuntimeError(f"fork on retired seq {seq.seq_id}")
+            child = KVSeq()
+            child.tokens = list(seq.tokens)
+            child.prefill_from = len(seq.tokens)
+            for p in seq.pages:
+                self.pagepool.ref(p)
+                child.pages.append(p)
+            self.forks.add(1)
+            self._live += 1
+            return child
+
+    def retire(self, seq: KVSeq, *, cache: bool = True) -> None:
+        """End a sequence.  With ``cache=True`` its full-page chunks
+        are offered to the radix tree (the tree takes its own refs), so
+        the next prompt sharing this prefix hits.  All of the
+        sequence's refs drop either way; fully-idle blocks return to
+        the BlockPool."""
+        with self._mu:
+            if seq.retired:
+                return
+            seq.retired = True
+            if cache:
+                nfull = len(seq.tokens) // self.page_tokens
+                if nfull:
+                    self.radix.insert(seq.tokens[:nfull * self.page_tokens],
+                                      seq.pages[:nfull])
+            for p in seq.pages:
+                self.pagepool.unref(p)
+            seq.pages = []
+            self.retired.add(1)
+            self._live -= 1
+
+    # ---- internals ----
+
+    def _append(self, seq: KVSeq, token: int) -> None:
+        self._append_run(seq, [token])
+
+    def _append_run(self, seq: KVSeq, tokens: Sequence[int]) -> None:
+        """Append tokens in PAGE-SIZED runs: one device splice per page
+        touched, not one per token — the difference dominates cold-admit
+        latency for long uncached suffixes."""
+        idx, n = 0, len(tokens)
+        while idx < n:
+            pos = len(seq.tokens)
+            slot = pos % self.page_tokens
+            if slot == 0:
+                seq.pages.append(self._alloc_page())
+            else:
+                tail = seq.pages[-1]
+                if tail.refs > 1:
+                    # copy-on-write: the tail page is shared (radix tree
+                    # or a forked sequence) — writing in place would
+                    # corrupt the other holder's KV.  Copy device-to-
+                    # device, swap our table entry, drop our ref on the
+                    # shared page.
+                    fresh = self._alloc_page()
+                    try:
+                        self.pagepool.copy_page(fresh, tail)
+                    except BaseException:
+                        self.pagepool.unref(fresh)
+                        raise
+                    seq.pages[-1] = fresh
+                    self.pagepool.unref(tail)
+                    self.cow.add(1)
+            k = min(self.page_tokens - slot, n - idx)
+            run = [int(t) for t in tokens[idx:idx + k]]
+            self.pagepool.write(seq.pages[-1], slot, run)
+            seq.tokens.extend(run)
+            idx += k
+
+    def _alloc_page(self) -> KVPage:
+        """Page allocation with pressure-driven eviction: on
+        exhaustion, evict one block's worth of LRU leaves from the
+        radix tree and retry once."""
+        try:
+            return self.pagepool.alloc_page()
+        except MemoryError:
+            freed = self.radix.evict(self.pagepool.pages_per_block)
+            self.evictions.add(freed)
+            if freed == 0:
+                raise
+            return self.pagepool.alloc_page()
+
+    # ---- probes / maintenance ----
+
+    def probe(self, tokens: Sequence[int]) -> int:
+        """Non-mutating prefix-hit length in TOKENS for `tokens` (an
+        ADVISORY answer — admission decisions only; nothing is pinned,
+        so the pages may be evicted a microsecond later).  Takes no
+        refs; bumps LRU so hot prefixes stay."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            return 0
+        max_chunks = (len(tokens) - 1) // self.page_tokens
+        return len(self.radix.match(tokens, max_chunks=max_chunks)) \
+            * self.page_tokens
+
+    def acquire_prefix(self, tokens: Sequence[int]) -> tuple:
+        """PINNED prefix lookup for compute that relies on the cached
+        KV staying resident (the batcher's formation-time trim): like
+        :meth:`probe`, but takes a ref on every matched page so
+        eviction cannot free them mid-batch.  Returns ``(hit_tokens,
+        pages)``; the caller MUST hand `pages` back to
+        :meth:`release` once its compute finishes."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            return 0, []
+        with self._mu:
+            max_chunks = (len(tokens) - 1) // self.page_tokens
+            pages = self.radix.match(tokens, max_chunks=max_chunks)
+            for p in pages:
+                self.pagepool.ref(p)
+            return len(pages) * self.page_tokens, list(pages)
+
+    def release(self, pages) -> None:
+        """Drop the refs taken by :meth:`acquire_prefix`."""
+        with self._mu:
+            for p in pages:
+                self.pagepool.unref(p)
+
+    def clear(self) -> int:
+        """Evict every cached (tree-only) page — after all sequences
+        retire this returns block-pool occupancy to baseline.  Returns
+        pages freed."""
+        with self._mu:
+            freed = self.radix.evict_all()
+            self.evictions.add(freed)
+            return freed
+
+    def hit_rate(self) -> float:
+        seen = self.prompt_tokens.get_value()
+        return round(self.hit_tokens.get_value() / seen, 4) if seen else 0.0
+
+    def close(self) -> None:
+        """Drop the cache and unpin this store's bvars (bound-method
+        PassiveStatus would otherwise keep it alive in the registry)."""
+        self.clear()
+        from brpc_tpu.bvar.variable import find_exposed
+        for n in self._bvar_names:
+            v = find_exposed(n)
+            if v is not None:
+                v.hide()
+
+    def stats(self) -> dict:
+        # deliberately lock-free: every value is a thread-safe bvar,
+        # a sub-lock'd component, or an atomic int read — the console
+        # and registry snapshots must not stall behind a long admit's
+        # device writes (which hold _mu)
+        live = self._live
+        return {
+            "page_tokens": self.page_tokens,
+            "live_seqs": live,
+            "hit_rate": self.hit_rate(),
+            "hit_tokens": self.hit_tokens.get_value(),
+            "prompt_tokens": self.prompt_tokens.get_value(),
+            "admitted": self.admitted.get_value(),
+            "retired": self.retired.get_value(),
+            "forks": self.forks.get_value(),
+            "cow_forks": self.cow.get_value(),
+            "evictions": self.evictions.get_value(),
+            "radix_nodes": self.radix.node_count(),
+            "cached_tokens": self.radix.cached_tokens(),
+            "pages": self.pagepool.stats(),
+        }

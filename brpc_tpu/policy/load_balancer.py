@@ -3,8 +3,11 @@
 All balancers read the server set through a DoublyBufferedData snapshot
 (wait-free reads, like the reference's backing store) and implement
 select_server/feedback.  Registered: rr, wrr, random, wr, c_murmurhash,
-c_md5, la (locality-aware: EWMA latency × inflight, the
-locality_aware_load_balancer.cpp design).
+c_md5, c_ketama, la (locality-aware: EWMA latency × inflight, the
+locality_aware_load_balancer.cpp design), and prefix_affinity
+(cache-aware: consistent-hash on the prompt's prefix fingerprint so
+repeat prefixes land on the replica holding their KV pages —
+kvcache/radix.py).
 """
 from __future__ import annotations
 
@@ -263,6 +266,45 @@ class KetamaLB(ConsistentHashLB):
         return int.from_bytes(digest[:4], "little")
 
 
+def prefix_fingerprint(tokens, chunk_tokens: int = 16) -> int:
+    """Stable 64-bit fingerprint of a prompt's leading page-aligned
+    chunk(s) — the routing key for prefix-affinity balancing.  Prompts
+    sharing their first ``chunk_tokens``-aligned prefix (the unit the
+    paged KV cache shares at, `kvcache/pages.py`) produce the SAME
+    fingerprint; anything shorter than one chunk fingerprints whole.
+    """
+    # only the FIRST chunk decides affinity: a shared system prompt
+    # routes all its continuations to one replica's radix tree even
+    # though their tails diverge
+    head = [int(t) for t in tokens[:chunk_tokens]]
+    if not head:
+        return 0
+    return _hash_murmur_like(b"".join(
+        (t & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little") for t in head))
+
+
+class PrefixAffinityLB(ConsistentHashLB):
+    """Cache-aware routing: consistent-hash on the PREFIX FINGERPRINT
+    (``prefix_fingerprint``) so repeat prefixes land on the replica
+    whose radix tree already holds their pages — a cache hit on the
+    right machine instead of a recompute on the wrong one.  The
+    virtual-node ring underneath means replica churn only remaps the
+    departed replica's share of prefixes (the rest keep their warm
+    caches), which is the first step toward cross-host serving over
+    DCN.
+
+    Use ``select_server(request_code=prefix_fingerprint(prompt))``, or
+    :meth:`select_for_prompt` as sugar."""
+
+    name = "prefix_affinity"
+
+    def select_for_prompt(self, prompt, exclude=None,
+                          chunk_tokens: int = 16):
+        return self.select_server(
+            exclude=exclude,
+            request_code=prefix_fingerprint(prompt, chunk_tokens))
+
+
 class LocalityAwareLB(LoadBalancer):
     """Locality-aware: weight ∝ 1 / (EWMA latency × (inflight+1))
     (reference policy/locality_aware_load_balancer.cpp design: dividing
@@ -308,7 +350,8 @@ class LocalityAwareLB(LoadBalancer):
 
 _LBS = {cls.name: cls for cls in
         (RoundRobinLB, RandomLB, WeightedRoundRobinLB, WeightedRandomLB,
-         ConsistentHashLB, ConsistentHashMd5LB, KetamaLB, LocalityAwareLB)}
+         ConsistentHashLB, ConsistentHashMd5LB, KetamaLB, LocalityAwareLB,
+         PrefixAffinityLB)}
 
 
 def create_load_balancer(name: str) -> LoadBalancer:

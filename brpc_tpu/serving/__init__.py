@@ -5,8 +5,9 @@ Three cooperating pieces (see README "Serving"):
   * :class:`DynamicBatcher` (batcher.py) — deadline-aware dynamic
     batching of concurrent unary RPCs into bucket-padded tensor calls;
   * :class:`DecodeEngine` (engine.py) — continuous-batching
-    autoregressive decode over a fixed slot pool with KV blocks leased
-    from the ICI BlockPool;
+    autoregressive decode over a fixed slot pool with KV state leased
+    from the ICI BlockPool (raw blocks, or paged sequences through a
+    :class:`brpc_tpu.kvcache.KVCacheStore` for radix prefix reuse);
   * :func:`register_serving` (service.py) — server glue exposing
     ``Serving.Score`` (batched unary) and ``Serving.Generate``
     (streaming decode) plus the chunked-HTTP generate route.

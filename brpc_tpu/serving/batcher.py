@@ -19,9 +19,30 @@ deadline.  An optional concurrency limiter (the same
 ``create_limiter`` specs servers use: int, "auto", "timeout[:ms]")
 gates queue depth the same way.
 
+PRIORITY LANES: batch formation is earliest-deadline-first within the
+batching window, not FIFO.  When more requests are queued than one
+batch holds, the FIFO head always takes one seat (bounded wait for
+everyone — a deadline-less request can never be starved by a stream
+of deadlined arrivals) and the nearest deadlines fill the rest
+(deadline-less requests rank last, FIFO among themselves); a request
+that jumps an earlier-enqueued one counts as a lane promotion on
+/vars.
+
+PREFIX-AWARE PREFILL (``prefix_cache=``, a
+:class:`~brpc_tpu.kvcache.KVCacheStore`): token prompts whose prefix
+the paged KV cache already holds are trimmed to their uncached SUFFIX
+at batch formation — the batch computes (and pads) only what the
+cache can't serve, so a 90%-shared workload rides smaller length
+buckets and the skip ratio shows up per batcher on /vars.  The
+matched pages are PINNED (``acquire_prefix``/``release``) for the
+batch's lifetime, so eviction under pool pressure can never free the
+prefix KV the trim relies on, and a ``batch_fn(padded, offsets)``
+that accepts a second argument receives each row's start position
+(rows are suffixes — a position-dependent scorer needs the offset).
+
 Instrumented per batcher on /vars (and the /serving console page):
 batch-size IntRecorder, queue-delay LatencyRecorder, pad-waste ratio,
-shed counter.
+shed counter, lane promotions, prefix-skip ratio.
 """
 from __future__ import annotations
 
@@ -38,6 +59,25 @@ from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
 # default sequence-length buckets: small fixed ladder so any raw length
 # maps to one of a handful of compiled shapes
 DEFAULT_LENGTH_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
+def required_positional_args(fn) -> int:
+    """How many REQUIRED positional parameters `fn` takes (-1 when its
+    signature is unreadable).  Used to decide whether a user function
+    gets the optional extra array (batcher offsets / engine page
+    table): a parameter WITH a default is not counted — passing the
+    extra into e.g. ``temperature=1.0`` would silently corrupt compute
+    — and ``*args`` counts for nothing (pass the explicit flag for
+    those)."""
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters.values()
+    except (TypeError, ValueError):
+        return -1
+    return sum(1 for p in params
+               if p.kind in (p.POSITIONAL_ONLY,
+                             p.POSITIONAL_OR_KEYWORD)
+               and p.default is p.empty)
 
 
 def _bucket_up(n: int, buckets: Sequence[int]) -> Optional[int]:
@@ -62,14 +102,15 @@ class _Pending:
     exactly-once completion (error or result, never neither, never
     both)."""
 
-    __slots__ = ("item", "length", "enqueue_t", "deadline_s", "_fire",
-                 "_fired", "_mu")
+    __slots__ = ("item", "length", "skip", "enqueue_t", "deadline_s",
+                 "_fire", "_fired", "_mu")
 
     def __init__(self, item: np.ndarray, length: int,
                  deadline_s: Optional[float],
                  fire: Callable[[int, str, object], None]):
         self.item = item
         self.length = length
+        self.skip = 0              # prefix tokens served from KV cache
         self.enqueue_t = time.monotonic()
         self.deadline_s = deadline_s
         self._fire = fire
@@ -131,6 +172,8 @@ class DynamicBatcher:
                  batch_buckets: Optional[Sequence[int]] = None,
                  length_buckets: Sequence[int] = DEFAULT_LENGTH_BUCKETS,
                  limiter=None,
+                 prefix_cache=None,
+                 pass_offsets: Optional[bool] = None,
                  name: str = "default",
                  dtype=np.float32,
                  padded_output: Optional[bool] = None):
@@ -162,6 +205,21 @@ class DynamicBatcher:
             from brpc_tpu.policy.concurrency_limiter import create_limiter
             limiter = create_limiter(limiter)
         self.limiter = limiter
+        # a KVCacheStore (or anything with probe/acquire_prefix/
+        # release): items are trimmed to their uncached suffix at batch
+        # formation with the matched pages pinned for the batch's
+        # lifetime (see module docstring)
+        self.prefix_cache = prefix_cache
+        # a batch_fn with TWO required positionals receives per-row
+        # start offsets alongside the suffix matrix (needed for
+        # position-dependent compute); pass_offsets overrides the
+        # detection for *args functions or optional-parameter shapes
+        if pass_offsets is not None:
+            self._fn_wants_offsets = bool(pass_offsets)
+        else:
+            self._fn_wants_offsets = (
+                prefix_cache is not None
+                and required_positional_args(batch_fn) >= 2)
 
         safe = re.sub(r"\W", "_", name)
         # record the EXACT names exposed below so close() hides only
@@ -176,10 +234,15 @@ class DynamicBatcher:
         self.n_batches = Adder(f"serving_{safe}_batches")
         self.n_completed = Adder(f"serving_{safe}_completed")
         self.n_errors = Adder(f"serving_{safe}_errors")
+        self.lane_promotions = Adder(f"serving_{safe}_lane_promotions")
         self._pad_elems = Adder()    # padded-but-unused elements
         self._real_elems = Adder()   # useful elements
+        self._skip_elems = Adder()   # prefix elements served from cache
+        self._seen_elems = Adder()   # total elements offered
         PassiveStatus(self._pad_waste).expose(
             f"serving_{safe}_pad_waste_ratio")
+        PassiveStatus(self._prefix_skip_ratio).expose(
+            f"serving_{safe}_prefix_skip_ratio")
         self._bvar_names = [n for n in exposed_variables(f"serving_{safe}*")
                             if n not in _pre_bvars]
 
@@ -253,11 +316,25 @@ class DynamicBatcher:
             return
         p.length = arr.shape[0]
         if _bucket_up(p.length, self.length_buckets) is None:
-            p.complete(errors.EREQUEST,
-                       f"item length {p.length} exceeds largest bucket "
-                       f"{self.length_buckets[-1]}", None)
-            self.n_errors.add(1)
-            return
+            # an over-length item is still admissible when the prefix
+            # cache holds enough of it that the SUFFIX fits a bucket
+            # (advisory probe here; the binding, page-pinning trim
+            # happens at batch formation)
+            fits = False
+            if self.prefix_cache is not None and p.length > 1:
+                try:
+                    hit = int(self.prefix_cache.probe(arr))
+                except Exception:
+                    hit = 0
+                hit = max(0, min(hit, p.length - 1))
+                fits = _bucket_up(p.length - hit,
+                                  self.length_buckets) is not None
+            if not fits:
+                p.complete(errors.EREQUEST,
+                           f"item length {p.length} exceeds largest "
+                           f"bucket {self.length_buckets[-1]}", None)
+                self.n_errors.add(1)
+                return
         shed_code = 0
         shed_text = ""
         with self._cv:
@@ -313,8 +390,7 @@ class DynamicBatcher:
                     if rem <= 0:
                         break
                     self._cv.wait(rem)
-                batch = self._q[: self.max_batch_size]
-                del self._q[: self.max_batch_size]
+                batch = self._form_batch_locked()
             if not batch:
                 continue
             try:
@@ -328,6 +404,35 @@ class DynamicBatcher:
                 for p in batch:
                     p.complete(errors.EINTERNAL, "batch drainer error",
                                None)
+
+    def _form_batch_locked(self) -> list[_Pending]:
+        """Pick this batch's members: earliest-deadline-first among the
+        queued requests (priority lanes), FIFO among equals and the
+        deadline-less.  A member selected over an earlier-enqueued
+        request that stays queued counts as one lane promotion."""
+        if len(self._q) <= self.max_batch_size:
+            batch, self._q = self._q, []
+            return batch
+        # the FIFO head ALWAYS takes one seat: the queue front advances
+        # every batch, so a deadline-less request has bounded wait even
+        # under a sustained stream of deadlined arrivals (EDF alone
+        # would starve it)
+        order = sorted(
+            range(1, len(self._q)),
+            key=lambda i: (self._q[i].deadline_s
+                           if self._q[i].deadline_s is not None
+                           else float("inf"), i))
+        taken = {0} | set(order[: self.max_batch_size - 1])
+        take = sorted(taken)
+        first_left = min(i for i in range(len(self._q))
+                         if i not in taken)
+        promoted = sum(1 for i in take if i > first_left)
+        if promoted:
+            self.lane_promotions.add(promoted)
+        batch = [self._q[i] for i in take]
+        for i in reversed(take):
+            del self._q[i]
+        return batch
 
     def _run_batch(self, batch: list[_Pending]) -> None:
         now = time.monotonic()
@@ -348,17 +453,74 @@ class DynamicBatcher:
                 live.append(p)
         if not live:
             return
+        pinned: list = []
+        try:
+            live = self._trim_prefixes(live, pinned)
+            if live:
+                self._execute(live)
+        finally:
+            # the pinned prefix pages outlive the compute, never less:
+            # eviction cannot free KV a row's trim relied on mid-batch
+            if pinned and self.prefix_cache is not None:
+                try:
+                    self.prefix_cache.release(pinned)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "prefix page release failed")
+
+    def _trim_prefixes(self, live: list[_Pending], pinned: list) -> list:
+        """Formation-time prefix trim: pin each item's cached prefix
+        pages and keep only its uncached suffix for compute.  A row
+        whose suffix no longer fits any bucket (the advisory enqueue
+        probe's pages were evicted since) completes with a definite
+        error instead of computing garbage."""
+        if self.prefix_cache is None:
+            return live
+        kept = []
+        for p in live:
+            hit, pages = 0, []
+            if p.length > 1:
+                try:
+                    hit, pages = self.prefix_cache.acquire_prefix(p.item)
+                except Exception:
+                    hit, pages = 0, []
+            pinned.extend(pages)
+            hit = max(0, min(hit, p.length - 1))
+            if hit:
+                p.skip = hit
+                p.item = p.item[hit:]
+                p.length -= hit
+            if _bucket_up(p.length, self.length_buckets) is None:
+                self.n_errors.add(1)
+                if self.limiter is not None:
+                    self.limiter.on_responded(errors.EREQUEST, 0)
+                p.complete(errors.EREQUEST,
+                           f"suffix length {p.length} exceeds largest "
+                           f"bucket (cached prefix evicted since "
+                           f"admission)", None)
+            else:
+                kept.append(p)
+        return kept
+
+    def _execute(self, live: list[_Pending]) -> None:
         n = len(live)
         bshape = _bucket_up(n, self.batch_buckets)
         lbucket = _bucket_up(max(p.length for p in live),
                              self.length_buckets)
         padded = np.zeros((bshape, lbucket), dtype=self.dtype)
         real = 0
+        skipped = 0
         for i, p in enumerate(live):
             padded[i, : p.length] = p.item
             real += p.length
+            skipped += p.skip
         self._real_elems.add(real)
         self._pad_elems.add(bshape * lbucket - real)
+        # skip metrics count EXECUTED rows only (like pad-waste): a
+        # shed or rejected request saved no compute
+        self._skip_elems.add(skipped)
+        self._seen_elems.add(real + skipped)
         self.batch_size_rec.add(n)
         self.n_batches.add(1)
         t0 = time.monotonic()
@@ -366,7 +528,13 @@ class DynamicBatcher:
             if fault.ENABLED and fault.hit(
                     "serving.batch", name=self.name, batch=n) is not None:
                 raise RuntimeError("injected mid-batch failure")
-            out = np.asarray(self.batch_fn(padded))
+            if self._fn_wants_offsets:
+                offsets = np.zeros((bshape,), np.int32)
+                for i, p in enumerate(live):
+                    offsets[i] = p.skip
+                out = np.asarray(self.batch_fn(padded, offsets))
+            else:
+                out = np.asarray(self.batch_fn(padded))
         except Exception as e:
             # a failed batch completes EVERY member exactly once with a
             # definite error — never a hang, never a partial scatter
@@ -421,6 +589,11 @@ class DynamicBatcher:
         total = real + pad
         return round(pad / total, 4) if total else 0.0
 
+    def _prefix_skip_ratio(self) -> float:
+        seen = self._seen_elems.get_value()
+        return round(self._skip_elems.get_value() / seen, 4) if seen \
+            else 0.0
+
     def stats(self) -> dict:
         with self._cv:
             queued = len(self._q)
@@ -434,8 +607,10 @@ class DynamicBatcher:
             "completed": self.n_completed.get_value(),
             "errors": self.n_errors.get_value(),
             "shed": self.shed.get_value(),
+            "lane_promotions": self.lane_promotions.get_value(),
             "avg_batch_size": round(self.batch_size_rec.get_value(), 2),
             "pad_waste_ratio": self._pad_waste(),
+            "prefix_skip_ratio": self._prefix_skip_ratio(),
             "queue_delay_avg_us": round(self.queue_delay_rec.latency(), 1),
             "queue_delay_p99_us": round(
                 self.queue_delay_rec.latency_percentile(0.99), 1),

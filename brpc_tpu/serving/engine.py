@@ -5,21 +5,38 @@ function; requests join and leave the step loop mid-flight (continuous
 batching — no waiting for the slowest member of a static batch), and
 each generated token streams back to its caller per step.
 
-KV-cache residency follows the `ici/block_pool.py` discipline: every
-admitted request leases one HBM block for its slot's KV cache
-(``pool.alloc``) and releases it at retirement (``block.free``) —
-occupancy returns to baseline after drain, so the chaos suite can
-leak-check the engine exactly like the transport.
+KV-cache residency has two modes:
+
+  * raw block leases (default, the PR 2 discipline): every admitted
+    request leases one HBM block from `ici/block_pool.py`
+    (``pool.alloc`` at admit, ``block.free`` at retire) — occupancy
+    returns to baseline after drain, so the chaos suite can leak-check
+    the engine exactly like the transport;
+  * a paged KV cache (``store=`` a
+    :class:`~brpc_tpu.kvcache.KVCacheStore`): admission goes through
+    ``store.admit`` — the prompt's longest cached prefix is served by
+    SHARED pages and only the suffix is prefilled (``prefill_fn``, if
+    given, runs once per admit on the bucket-padded suffix, so the jit
+    cache sees a handful of shapes however prompts vary); each
+    generated token extends the sequence's page table (copy-on-write
+    when a page is shared), and the step function — when it accepts a
+    third argument — receives the gathered per-slot page tables as a
+    fixed-shape int32 ``[num_slots, max_pages_per_slot]`` array (-1
+    padded), compiled once for the life of the engine.
 
 The step function sees FIXED shapes — ``step_fn(tokens[num_slots],
-positions[num_slots])`` — so the jit cache compiles once for the life
-of the engine regardless of how requests churn through the slots.
-Inactive slots carry zeros; their outputs are ignored.
+positions[num_slots])`` (+ optional page table) — so the jit cache
+compiles once for the life of the engine regardless of how requests
+churn through the slots.  Inactive slots carry zeros; their outputs
+are ignored.
 
-Emission: ``emit(token)`` runs on the engine thread once per generated
-token — hand it a ``Stream.write`` (rpc/stream.py credit window) for
-TRPC callers or a ``ProgressiveAttachment.write`` for HTTP clients.
-``on_done(err)`` fires exactly once per request, success or failure.
+Emission: each admitted request gets a BOUNDED emit buffer drained by
+its own emitter thread — the shared step loop never blocks in
+``emit``.  A consumer that stops draining (stream credit exhausted,
+dead HTTP peer) fills its buffer and is CUT with EOVERCROWDED at the
+next step boundary while every other slot keeps streaming; a raising
+``emit`` retires just that request.  ``on_done(err)`` fires exactly
+once per request, success or failure, after its buffered tokens flush.
 """
 from __future__ import annotations
 
@@ -37,20 +54,68 @@ from brpc_tpu.bvar import Adder, IntRecorder, PassiveStatus
 _req_ids = itertools.count(1)
 
 
+class _EmitBuf:
+    """Bounded token buffer between the shared step loop and one
+    request's emitter thread.  ``push`` never blocks (the step loop
+    must not stall on a slow consumer); the terminal marker is always
+    accepted so a cut/finished request can flush and notify."""
+
+    __slots__ = ("cap", "q", "cv", "terminal", "has_terminal")
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self.q: deque = deque()
+        self.cv = threading.Condition()
+        self.terminal = None
+        self.has_terminal = False
+
+    def push(self, tok: int) -> bool:
+        with self.cv:
+            if len(self.q) >= self.cap:
+                return False
+            self.q.append(tok)
+            self.cv.notify()
+            return True
+
+    def push_terminal(self, err) -> None:
+        with self.cv:
+            if not self.has_terminal:
+                self.has_terminal = True
+                self.terminal = err
+            self.cv.notify()
+
+    def pop(self, timeout_s: float):
+        """Next item: ``("tok", t)``, ``("done", err)`` once drained,
+        or None on timeout."""
+        with self.cv:
+            if not self.q and not self.has_terminal:
+                self.cv.wait(timeout_s)
+            if self.q:
+                return ("tok", self.q.popleft())
+            if self.has_terminal:
+                return ("done", self.terminal)
+            return None
+
+
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "emit", "on_done",
-                 "_done_fired", "_mu")
+                 "buf", "_done_fired", "_mu")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  emit: Callable[[int], None],
-                 on_done: Optional[Callable]):
+                 on_done: Optional[Callable], emit_buffer: int):
         self.req_id = next(_req_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.emit = emit
         self.on_done = on_done
+        self.buf = _EmitBuf(emit_buffer)
         self._done_fired = False
         self._mu = threading.Lock()
+
+    @property
+    def done_fired(self) -> bool:
+        return self._done_fired
 
     def finish(self, err: Optional[errors.RpcError]) -> None:
         """Exactly-once terminal notification."""
@@ -62,20 +127,22 @@ class _Request:
             try:
                 self.on_done(err)
             except Exception:
-                # an on_done bug must not kill the engine thread, but it
-                # must leave a trace — a silently-lost terminal message
-                # reads as a hung client with no server-side evidence
+                # an on_done bug must not kill its thread, but it must
+                # leave a trace — a silently-lost terminal message reads
+                # as a hung client with no server-side evidence
                 import logging
                 logging.getLogger(__name__).exception(
                     "engine on_done callback raised")
 
 
 class _Slot:
-    __slots__ = ("req", "block", "last_token", "position", "generated")
+    __slots__ = ("req", "block", "seq", "last_token", "position",
+                 "generated")
 
-    def __init__(self, req: _Request, block):
+    def __init__(self, req: _Request, block=None, seq=None):
         self.req = req
-        self.block = block                    # leased KV-cache block
+        self.block = block                    # leased KV-cache block, or
+        self.seq = seq                        # paged KVSeq (store mode)
         self.last_token = req.prompt[-1] if req.prompt else 0
         self.position = len(req.prompt)
         self.generated = 0
@@ -89,11 +156,20 @@ class DecodeEngine:
                  kv_bytes_per_slot: int = 4096,
                  pool=None,
                  device=None,
+                 store=None,
+                 prefill_fn: Optional[Callable] = None,
+                 prefill_buckets: Sequence[int] = (16, 64, 256, 1024,
+                                                   4096),
+                 max_pages_per_slot: int = 64,
+                 pass_page_table: Optional[bool] = None,
+                 emit_buffer: int = 256,
                  eos_token: Optional[int] = None,
                  max_new_tokens_cap: int = 65536,
                  name: str = "engine"):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if emit_buffer < 1:
+            raise ValueError("emit_buffer must be >= 1")
         self.step_fn = step_fn
         self.num_slots = int(num_slots)
         self.kv_bytes_per_slot = int(kv_bytes_per_slot)
@@ -102,11 +178,30 @@ class DecodeEngine:
         # not pin a decode slot effectively forever (the glue layers
         # pass client-supplied values straight through)
         self.max_new_tokens_cap = int(max_new_tokens_cap)
+        self.emit_buffer = int(emit_buffer)
         self.name = name
-        if pool is None:
+        # the paged KV cache is CALLER-owned (it outlives engines so the
+        # radix tree keeps serving prefix hits across engine restarts);
+        # close() never touches it
+        self.store = store
+        self.prefill_fn = prefill_fn
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        if pool is None and store is None:
             from brpc_tpu.ici.block_pool import get_block_pool
             pool = get_block_pool(device)
         self.pool = pool
+        # pass the gathered page tables only to a step_fn built for
+        # them — a 2-arg step_fn keeps the PR 2 contract unchanged.
+        # Detection counts REQUIRED positionals (an optional third
+        # parameter like rng=None must not silently receive the
+        # table); pass_page_table overrides for *args step functions
+        if pass_page_table is not None:
+            self._wants_pages = bool(pass_page_table)
+        else:
+            from brpc_tpu.serving.batcher import required_positional_args
+            self._wants_pages = (store is not None and
+                                 required_positional_args(step_fn) >= 3)
 
         safe = re.sub(r"\W", "_", name)
         # record the EXACT names exposed here so close() hides only this
@@ -118,6 +213,7 @@ class DecodeEngine:
         self.tokens_out = Adder(f"serving_{safe}_tokens")
         self.retired = Adder(f"serving_{safe}_retired")
         self.admit_errors = Adder(f"serving_{safe}_admit_errors")
+        self.emit_cut = Adder(f"serving_{safe}_emit_cut")
         self.occupancy_rec = IntRecorder(f"serving_{safe}_occupancy")
         PassiveStatus(self.active_count).expose(
             f"serving_{safe}_active_slots")
@@ -127,6 +223,10 @@ class DecodeEngine:
         self._cv = threading.Condition()
         self._slots: list[Optional[_Slot]] = [None] * self.num_slots
         self._waiters: deque[_Request] = deque()
+        # requests popped from _waiters but not yet installed in a slot
+        # (admission runs outside the cv): counted so join_idle()/
+        # stats() never report idle while an admit is mid-flight
+        self._admitting = 0
         self._running = True
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name=f"serving-engine-{safe}")
@@ -145,10 +245,14 @@ class DecodeEngine:
         ``on_done(err)`` exactly once."""
         req = _Request(prompt, min(int(max_new_tokens),
                                    self.max_new_tokens_cap),
-                       emit, on_done)
+                       emit, on_done, self.emit_buffer)
         if req.max_new_tokens <= 0:
             req.finish(errors.RpcError(errors.EREQUEST,
                                        "max_new_tokens must be > 0"))
+            return req.req_id
+        if self.store is not None and not req.prompt:
+            req.finish(errors.RpcError(errors.EREQUEST,
+                                       "empty prompt (paged KV mode)"))
             return req.req_id
         with self._cv:
             if not self._running:
@@ -161,29 +265,159 @@ class DecodeEngine:
             req.finish(errors.RpcError(errors.ELOGOFF, "engine closed"))
         return req.req_id
 
-    def _admit_locked(self) -> None:
-        """Move waiters into free slots (called at step boundaries under
-        the cv).  A failed KV lease completes THAT request with a
-        definite error and leaves the loop healthy."""
-        for i in range(self.num_slots):
-            if self._slots[i] is not None or not self._waiters:
-                continue
-            req = self._waiters.popleft()
-            try:
-                if fault.ENABLED and fault.hit(
-                        "serving.slot_alloc", name=self.name,
-                        slot=i) is not None:
-                    raise MemoryError("injected KV slot alloc failure")
+    def _claim_waiters_locked(self) -> list:
+        """Pop as many waiters as there are free slots (under the cv).
+        Only the engine thread admits, so the free count can't shrink
+        between the claim and the install — it can only grow if an
+        emitter cancels a slot meanwhile."""
+        free = sum(1 for s in self._slots if s is None)
+        claimed = []
+        while len(claimed) < free and self._waiters:
+            claimed.append(self._waiters.popleft())
+        self._admitting += len(claimed)
+        return claimed
+
+    def _admit(self, req: _Request):
+        """Lease KV state for one claimed request OUTSIDE the cv — in
+        store mode admit writes the whole prompt suffix to device, and
+        holding the lock through that would stall submit()/stats() and
+        the console exactly like an in-lock prefill would.  A failed
+        lease completes THAT request with a definite error and leaves
+        the loop healthy.  Returns the installed (index, slot) pair or
+        None."""
+        seq = block = None
+        try:
+            if fault.ENABLED and fault.hit(
+                    "serving.slot_alloc", name=self.name) is not None:
+                raise MemoryError("injected KV slot alloc failure")
+            if self.store is not None:
+                # reject BEFORE admit writes anything: a prompt that
+                # cannot fit the page table would otherwise burn device
+                # splices (and evict healthy sequences' warm cache)
+                # only to be rolled back — and installing it anyway
+                # would silently truncate the gathered table and decode
+                # on wrong KV
+                need = -(-len(req.prompt) // self.store.page_tokens)
+                if need > self.max_pages_per_slot:
+                    raise MemoryError(
+                        f"prompt needs {need} pages "
+                        f"(> max_pages_per_slot="
+                        f"{self.max_pages_per_slot})")
+                seq = self.store.admit(req.prompt)
+            else:
                 block = self.pool.alloc(self.kv_bytes_per_slot)
-            except Exception as e:
-                self.admit_errors.add(1)
-                req.finish(errors.RpcError(
-                    errors.ELIMIT,
-                    f"KV slot lease failed: {type(e).__name__}: {e}"))
+        except Exception as e:
+            if seq is not None:
+                try:
+                    self.store.retire(seq, cache=False)
+                except Exception:
+                    pass
+            self.admit_errors.add(1)
+            req.finish(errors.RpcError(
+                errors.ELIMIT,
+                f"KV admit failed: {type(e).__name__}: {e}"))
+            return None
+        slot = _Slot(req, block=block, seq=seq)
+        with self._cv:
+            if self._running:
+                for i in range(self.num_slots):
+                    if self._slots[i] is None:
+                        self._slots[i] = slot
+                        return (i, slot)
+        # the engine closed while we leased (close() already drained the
+        # waiters deque, so nobody else will finish this request)
+        try:
+            if block is not None:
+                block.free()
+            if seq is not None:
+                self.store.retire(seq, cache=False)
+        except Exception:
+            pass
+        req.finish(errors.RpcError(errors.ELOGOFF, "engine closed"))
+        return None
+
+    # ---- emitter threads (one per admitted request) ----
+
+    def _start_emitter(self, slot: _Slot) -> None:
+        t = threading.Thread(target=self._emit_pump, args=(slot.req,),
+                             daemon=True,
+                             name=f"serving-emit-{slot.req.req_id}")
+        t.start()
+
+    def _emit_pump(self, req: _Request) -> None:
+        """Drain one request's emit buffer.  Only THIS request stalls
+        when its consumer blocks; emit failures retire just this
+        request; the terminal marker flushes after the tokens and fires
+        on_done exactly once."""
+        while True:
+            item = req.buf.pop(0.25)
+            if item is None:
+                if req.done_fired:
+                    return        # finished elsewhere (close timeout path)
                 continue
-            self._slots[i] = _Slot(req, block)
+            kind, val = item
+            if kind == "done":
+                req.finish(val)
+                return
+            try:
+                req.emit(val)
+            except Exception as e:
+                self._cancel(req, errors.RpcError(
+                    errors.EINTERNAL,
+                    f"emit failed: {type(e).__name__}: {e}"))
+                return
+
+    def _cancel(self, req: _Request, err) -> None:
+        """Retire `req`'s slot from OFF the engine thread (emitter saw
+        its consumer die).  The engine thread may retire it first —
+        exactly-once on finish makes the race benign."""
+        with self._cv:
+            for i, s in enumerate(self._slots):
+                if s is not None and s.req is req:
+                    self._release_slot_locked(i, cache_ok=False)
+                    break
+        req.finish(err)
+
+    # ---- prefill (store mode) ----
+
+    def _prefill(self, i: int, slot: _Slot) -> None:
+        """Run the user prefill on the UNCACHED suffix of the prompt,
+        bucket-padded so the jit cache compiles once per bucket.  The
+        cached prefix — ``seq.prefix_hit_tokens`` tokens — is skipped
+        entirely: that compute is what a cache hit buys.  A raising
+        prefill retires the request (its emitter still drains the
+        terminal)."""
+        if self.prefill_fn is None or slot.seq is None:
+            return
+        suffix = slot.req.prompt[slot.seq.prefill_from:]
+        if not suffix:
+            return
+        import jax.numpy as jnp
+        n = len(suffix)
+        bucket = next((b for b in self.prefill_buckets if n <= b), n)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = suffix
+        try:
+            self.prefill_fn(jnp.asarray(padded),
+                            jnp.int32(slot.seq.prefill_from))
+        except Exception as e:
+            self._retire(i, errors.RpcError(
+                errors.EINTERNAL,
+                f"prefill failed: {type(e).__name__}: {e}"))
 
     # ---- the step loop ----
+
+    def _gather_page_tables(self, active) -> Optional[np.ndarray]:
+        if not self._wants_pages:
+            return None
+        table = np.full((self.num_slots, self.max_pages_per_slot), -1,
+                        np.int32)
+        for i, s in active:
+            if s.seq is None:
+                continue
+            ids = s.seq.page_ids()
+            table[i, : len(ids)] = ids[: self.max_pages_per_slot]
+        return table
 
     def _loop(self) -> None:
         import jax.numpy as jnp
@@ -193,20 +427,42 @@ class DecodeEngine:
                     # close() retires in-flight slots (with ELOGOFF) after
                     # joining this thread — exit at the step boundary
                     return
-                self._admit_locked()
+                claimed = self._claim_waiters_locked()
+            # admission, prefill, and emitter start all run OUTSIDE the
+            # cv: both are device calls and must not stall
+            # submit()/stats() or the console
+            for req in claimed:
+                installed = self._admit(req)
+                with self._cv:
+                    self._admitting -= 1
+                if installed is None:
+                    continue
+                i, s = installed
+                self._prefill(i, s)
+                self._start_emitter(s)
+            with self._cv:
+                if not self._running:
+                    return
                 active = [(i, s) for i, s in enumerate(self._slots)
                           if s is not None]
                 if not active:
-                    self._cv.wait()
+                    if not self._waiters:
+                        self._cv.wait()
                     continue
             tok = np.zeros((self.num_slots,), np.int32)
             pos = np.zeros((self.num_slots,), np.int32)
             for i, s in active:
                 tok[i] = s.last_token
                 pos[i] = s.position
+            pages = self._gather_page_tables(active)
             try:
-                out = np.asarray(
-                    self.step_fn(jnp.asarray(tok), jnp.asarray(pos)))
+                if pages is not None:
+                    out = np.asarray(self.step_fn(
+                        jnp.asarray(tok), jnp.asarray(pos),
+                        jnp.asarray(pages)))
+                else:
+                    out = np.asarray(
+                        self.step_fn(jnp.asarray(tok), jnp.asarray(pos)))
             except Exception as e:
                 # a broken step function must not wedge callers: retire
                 # every active request with a definite error
@@ -214,54 +470,80 @@ class DecodeEngine:
                     errors.EINTERNAL,
                     f"decode step failed: {type(e).__name__}: {e}")
                 with self._cv:
-                    reqs = [self._release_slot_locked(i)
+                    reqs = [self._release_slot_locked(i, cache_ok=False)
                             for i, s in active]
                 for req in filter(None, reqs):
-                    req.finish(err)
+                    req.buf.push_terminal(err)
                 continue
             self.steps.add(1)
             self.occupancy_rec.add(len(active))
             for i, s in active:
+                if self._slots[i] is not s:
+                    continue    # an emitter cancelled it mid-step
                 nxt = int(out[i])
                 s.last_token = nxt
                 s.position += 1
                 s.generated += 1
                 self.tokens_out.add(1)
-                try:
-                    s.req.emit(nxt)
-                except Exception as e:
+                if s.seq is not None:
+                    try:
+                        self.store.extend(s.seq, nxt)
+                    except MemoryError as e:
+                        # pool exhausted and nothing evictable: THIS
+                        # request errors, the loop and its peers go on
+                        self._retire(i, errors.RpcError(
+                            errors.ELIMIT,
+                            f"KV page alloc failed: {e}"))
+                        continue
+                    except Exception as e:
+                        self._retire(i, errors.RpcError(
+                            errors.EINTERNAL,
+                            f"KV extend failed: {type(e).__name__}: {e}"))
+                        continue
+                    if len(s.seq.pages) > self.max_pages_per_slot:
+                        self._retire(i, errors.RpcError(
+                            errors.ELIMIT,
+                            f"page table overflow "
+                            f"(> {self.max_pages_per_slot} pages)"))
+                        continue
+                if not s.req.buf.push(nxt):
+                    # consumer stopped draining: cut it HERE, without
+                    # the step loop ever blocking in a write
+                    self.emit_cut.add(1)
                     self._retire(i, errors.RpcError(
-                        errors.EINTERNAL,
-                        f"emit failed: {type(e).__name__}: {e}"))
+                        errors.EOVERCROWDED,
+                        "slow stream consumer: emit buffer overflow"))
                     continue
                 if s.generated >= s.req.max_new_tokens or \
                         (self.eos_token is not None
                          and nxt == self.eos_token):
                     self._retire(i, None)
 
-    def _release_slot_locked(self, i: int):
-        """Release slot i under the cv: free the KV block back to the
-        pool exactly once and return the request for the CALLER to
-        finish OUTSIDE the lock — on_done may do a blocking network
-        write (stream credit window), and firing it under the cv would
-        stall the step loop, submit(), stats() and the exposed
-        active-slots bvar for the whole write."""
+    def _release_slot_locked(self, i: int, cache_ok: bool = True):
+        """Release slot i under the cv: return the KV lease exactly once
+        (raw block freed, or paged seq retired — cached into the radix
+        tree only on clean completion) and return the request for the
+        CALLER to finish OUTSIDE the lock via its emit buffer's
+        terminal marker."""
         s = self._slots[i]
         if s is None:
             return None
         self._slots[i] = None
         self.retired.add(1)
         try:
-            s.block.free()
+            if s.block is not None:
+                s.block.free()
+            if s.seq is not None:
+                self.store.retire(s.seq, cache=cache_ok)
         except Exception:
             pass
         return s.req
 
     def _retire(self, i: int, err) -> None:
         with self._cv:
-            req = self._release_slot_locked(i)
+            req = self._release_slot_locked(i, cache_ok=err is None)
         if req is not None:
-            req.finish(err)
+            req.buf.push_terminal(err)
 
     # ---- lifecycle / introspection ----
 
@@ -271,20 +553,23 @@ class DecodeEngine:
 
     def close(self, timeout_s: float = 10.0) -> None:
         """Stop the loop; in-flight and queued requests complete with
-        ELOGOFF and every leased KV block returns to the pool."""
+        ELOGOFF and every KV lease (block or paged seq) returns to its
+        pool.  The KV store itself is caller-owned and stays up."""
         with self._cv:
             self._running = False
             self._cv.notify_all()
         self._thread.join(timeout_s)
         err = errors.RpcError(errors.ELOGOFF, "engine closed")
         with self._cv:
-            reqs = [self._release_slot_locked(i)
+            reqs = [self._release_slot_locked(i, cache_ok=False)
                     for i in range(self.num_slots)]
             waiters, self._waiters = list(self._waiters), deque()
         for req in filter(None, reqs):
-            req.finish(err)
+            # the emitter drains buffered tokens then fires on_done;
+            # finish() is exactly-once so a racing emitter is benign
+            req.buf.push_terminal(err)
         for req in waiters:
-            req.finish(err)
+            req.finish(err)   # never admitted: no emitter exists
         # unpin exposed bvars (bound-method PassiveStatus would keep a
         # closed engine alive in the global registry forever)
         from brpc_tpu.bvar.variable import find_exposed
@@ -300,7 +585,7 @@ class DecodeEngine:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             with self._cv:
-                if not self._waiters and all(
+                if not self._waiters and not self._admitting and all(
                         s is None for s in self._slots):
                     return True
             time.sleep(0.005)
@@ -314,9 +599,12 @@ class DecodeEngine:
                     "generated": s.generated,
                     "max_new_tokens": s.req.max_new_tokens,
                     "position": s.position,
+                    **({"pages": len(s.seq.pages),
+                        "prefix_hit": s.seq.prefix_hit_tokens}
+                       if s.seq is not None else {}),
                 } for s in self._slots]
-            queued = len(self._waiters)
-        return {
+            queued = len(self._waiters) + self._admitting
+        out = {
             "num_slots": self.num_slots,
             "kv_bytes_per_slot": self.kv_bytes_per_slot,
             "slots": slot_map,
@@ -325,5 +613,10 @@ class DecodeEngine:
             "tokens": self.tokens_out.get_value(),
             "retired": self.retired.get_value(),
             "admit_errors": self.admit_errors.get_value(),
+            "emit_buffer": self.emit_buffer,
+            "emit_cut": self.emit_cut.get_value(),
             "avg_step_occupancy": round(self.occupancy_rec.get_value(), 2),
         }
+        if self.store is not None:
+            out["kvcache"] = self.store.name
+        return out

@@ -61,11 +61,13 @@ class ServingService(Service):
         stream = cntl.accept_stream()
 
         def emit(tok: int) -> None:
-            # Bounded write: emit runs on the SHARED engine step thread,
-            # so a consumer that stops draining its credit window may
-            # stall every decode slot — but only for this timeout, after
-            # which the raise retires this request and the loop resumes
-            # (per-request emit buffering is a ROADMAP follow-on).
+            # emit runs on THIS request's emitter thread (the engine's
+            # per-request bounded emit buffer), so a consumer that
+            # stops draining its credit window stalls only itself: the
+            # shared step loop keeps decoding every other slot, and
+            # once this request's buffer overflows the engine cuts it
+            # with EOVERCROWDED.  The bounded write keeps the emitter
+            # itself from wedging forever on a dead-but-open peer.
             stream.write(json.dumps({"token": tok}).encode(),
                          timeout_s=2.0)
 
@@ -75,9 +77,8 @@ class ServingService(Service):
                 msg["error"] = err.code
                 msg["error_text"] = err.text
             try:
-                # same stall bound as emit: this runs on the shared
-                # engine thread, and a consumer whose window is already
-                # full would otherwise block the default 10s here
+                # same bound as emit (also on the per-request emitter
+                # thread, after the buffered tokens flush)
                 stream.write(json.dumps(msg).encode(), timeout_s=2.0)
             except errors.RpcError:
                 pass   # peer already gone; nothing to tell it

@@ -12,6 +12,14 @@ over the credit-windowed stream layer (e.g. Serving.Generate): each
 worker attaches a client stream per call, counts delivered items, and
 reports items/s plus time-to-first-item percentiles — the serving-path
 analog of unary qps/latency.
+
+Prefix-skewed load (--shared-prefix-ratio R): each call's "prompt"
+field is regenerated — with probability R it opens with ONE fixed
+shared prefix (--prefix-tokens long) followed by a random suffix,
+otherwise it is fully random.  R=0.9 models a shared-system-prompt
+workload and drives the paged KV cache's radix hit-rate (watch
+/kvcache while pressing); R=0 is the worst case for prefix reuse.
+The schedule is seeded per worker, so runs replay.
 """
 from __future__ import annotations
 
@@ -25,11 +33,43 @@ import brpc_tpu as brpc
 from brpc_tpu.bvar import LatencyRecorder
 
 
+def make_prefix_skew(request, ratio: float, prefix_tokens: int = 32,
+                     suffix_tokens: int = 8, vocab: int = 1000,
+                     seed: int = 0):
+    """Per-worker request factory for prefix-skewed generate load: with
+    probability `ratio` the "prompt" opens with one fixed shared prefix
+    (the page-aligned unit the KV radix tree caches), else it is fully
+    random.  ``make_prefix_skew(...)(k)`` returns worker k's factory —
+    each worker gets its own seeded rng so the schedule replays."""
+    import random as _random
+    shared = [(seed * 1009 + i * 37) % vocab for i in range(prefix_tokens)]
+
+    def for_worker(k: int):
+        rng = _random.Random((seed << 16) ^ k)
+
+        def next_request():
+            req = dict(request)
+            suffix = [rng.randrange(vocab) for _ in range(suffix_tokens)]
+            if rng.random() < ratio:
+                req["prompt"] = shared + suffix
+            else:
+                req["prompt"] = [rng.randrange(vocab) for _ in
+                                 range(prefix_tokens)] + suffix
+            return req
+
+        return next_request
+
+    return for_worker
+
+
 def run_press(server: str, service: str, method: str, request,
               qps: int = 0, duration_s: float = 10.0, threads: int = 4,
               serializer: str = "json", timeout_ms: int = 1000,
-              connection_type: str = "single", out=sys.stderr) -> dict:
-    """Drives the load; returns a summary dict (also printable)."""
+              connection_type: str = "single", request_factory=None,
+              out=sys.stderr) -> dict:
+    """Drives the load; returns a summary dict (also printable).
+    ``request_factory(k)`` (e.g. ``make_prefix_skew(...)``), when
+    given, builds worker k's per-call request generator."""
     ch = brpc.Channel(server, timeout_ms=timeout_ms,
                       connection_type=connection_type)
     rec = LatencyRecorder("rpc_press")
@@ -39,7 +79,8 @@ def run_press(server: str, service: str, method: str, request,
     # per-thread qps budget; qps<=0 = unthrottled
     per_thread_interval = threads / qps if qps > 0 else 0.0
 
-    def worker():
+    def worker(k: int):
+        gen = request_factory(k) if request_factory is not None else None
         next_at = time.monotonic()
         while not stop.is_set():
             if per_thread_interval > 0:
@@ -48,17 +89,18 @@ def run_press(server: str, service: str, method: str, request,
                     time.sleep(min(next_at - now, 0.05))
                     continue
                 next_at += per_thread_interval
+            req = gen() if gen is not None else request
             t0 = time.monotonic()
             try:
-                ch.call_sync(service, method, request,
+                ch.call_sync(service, method, req,
                              serializer=serializer)
                 rec.add(int((time.monotonic() - t0) * 1e6))
                 nok[0] += 1
             except Exception:
                 nerr[0] += 1
 
-    ts = [threading.Thread(target=worker, daemon=True)
-          for _ in range(threads)]
+    ts = [threading.Thread(target=worker, args=(k,), daemon=True)
+          for k in range(threads)]
     t_start = time.monotonic()
     [t.start() for t in ts]
     try:
@@ -104,6 +146,7 @@ def run_streaming_press(server: str, service: str, method: str, request,
                         duration_s: float = 10.0, threads: int = 4,
                         serializer: str = "json", timeout_ms: int = 5000,
                         connection_type: str = "single",
+                        request_factory=None,
                         out=sys.stderr) -> dict:
     """Streaming load: one client stream per call, looped per worker for
     `duration_s`.  Reports aggregate items/s and time-to-first-item
@@ -118,14 +161,16 @@ def run_streaming_press(server: str, service: str, method: str, request,
     mu = threading.Lock()
     stop = threading.Event()
 
-    def worker():
+    def worker(k: int):
+        gen = request_factory(k) if request_factory is not None else None
         while not stop.is_set():
             h = _PressStreamHandler()
             cntl = brpc.Controller()
             stream = brpc.stream_create(cntl, h)
+            req = gen() if gen is not None else request
             t0 = time.monotonic()
             try:
-                ch.call_sync(service, method, request,
+                ch.call_sync(service, method, req,
                              serializer=serializer, cntl=cntl)
             except Exception:
                 with mu:
@@ -144,8 +189,8 @@ def run_streaming_press(server: str, service: str, method: str, request,
             if not ok:
                 stream.close()
 
-    ts = [threading.Thread(target=worker, daemon=True)
-          for _ in range(threads)]
+    ts = [threading.Thread(target=worker, args=(k,), daemon=True)
+          for k in range(threads)]
     t_start = time.monotonic()
     [t.start() for t in ts]
     try:
@@ -188,24 +233,40 @@ def main(argv=None):
                     help="drive a streaming method: attach a client "
                          "stream per call, report items/s and "
                          "time-to-first-item percentiles")
+    ap.add_argument("--shared-prefix-ratio", type=float, default=0.0,
+                    help="regenerate each call's \"prompt\" field: with "
+                         "this probability it opens with one fixed "
+                         "shared prefix (prefix-skewed KV-cache load); "
+                         "0 disables")
+    ap.add_argument("--prefix-tokens", type=int, default=32,
+                    help="shared-prefix length for --shared-prefix-ratio")
+    ap.add_argument("--prefix-seed", type=int, default=0,
+                    help="seed for the prefix-skew schedule")
     a = ap.parse_args(argv)
     text = a.input
     if text.startswith("@"):
         with open(text[1:]) as f:
             text = f.read()
     req = json.loads(text)
+    factory = None
+    if a.shared_prefix_ratio > 0:
+        factory = make_prefix_skew(req, a.shared_prefix_ratio,
+                                   prefix_tokens=a.prefix_tokens,
+                                   seed=a.prefix_seed)
     if a.streaming:
         run_streaming_press(a.server, a.service, a.method, req,
                             duration_s=a.duration, threads=a.threads,
                             serializer=a.serializer,
                             timeout_ms=a.timeout_ms,
                             connection_type=a.connection_type,
+                            request_factory=factory,
                             out=sys.stdout)
     else:
         run_press(a.server, a.service, a.method, req, qps=a.qps,
                   duration_s=a.duration, threads=a.threads,
                   serializer=a.serializer, timeout_ms=a.timeout_ms,
-                  connection_type=a.connection_type, out=sys.stdout)
+                  connection_type=a.connection_type,
+                  request_factory=factory, out=sys.stdout)
 
 
 if __name__ == "__main__":

@@ -622,6 +622,113 @@ def test_feedback_loss_heals_via_cumulative_offsets(seed):
 # health-check revival under faults (satellite): CB hold + generation bump
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# scenario 10: paged KV cache — pool exhaustion + eviction failure mid-decode
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_kvcache_exhaustion_mid_decode_exactly_once_and_baseline(seed):
+    """Injected KV faults uphold the paged-cache invariants (ISSUE 3):
+
+    * `kvcache.page_alloc` exhausts the page pool mid-decode and
+      `kvcache.evict` kills one pressure-relief attempt -> the affected
+      requests complete exactly once with a definite error (ELIMIT),
+      the untouched ones stream their full token sequences;
+    * no shared page is freed while a forked sequence still references
+      it — the fork's contents survive the chaos run bit-exact;
+    * refcounts and BLOCK-POOL occupancy return to baseline once the
+      sequences retire and the radix cache is dropped.
+    """
+    import jax
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine
+
+    store = KVCacheStore(page_bytes=256, page_tokens=4, max_blocks=16,
+                         name=f"chaos_kv{seed}")
+    device_pool = store.pagepool.pool
+
+    def occupancy():
+        with device_pool._lock:
+            return {c: len(device_pool._free[c])
+                    for c in device_pool._free}
+
+    free0 = occupancy()
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return tokens + 1
+
+    engine = DecodeEngine(step, num_slots=3, store=store,
+                          max_pages_per_slot=16,
+                          name=f"chaos_kve{seed}")
+    try:
+        # a forked pair held LIVE across the whole chaos run: its shared
+        # pages must never be reclaimed out from under it
+        held = store.admit([1, 2, 3, 4, 5, 6])
+        forked = store.fork(held)
+        store.extend(held, 70)       # COW: tails diverge pre-chaos
+        store.extend(forked, 80)
+        held_words = store.pagepool.read(held.pages[-1], 3).tolist()
+        fork_words = store.pagepool.read(forked.pages[-1], 3).tolist()
+
+        plan = fault.FaultPlan(seed)
+        plan.on("kvcache.page_alloc", fault.EXHAUST, times=2, after=6)
+        plan.on("kvcache.evict", fault.ERROR, times=1)
+        shared = list(range(100, 108))
+        with fault.injected(plan):
+            n = 12
+            outcomes = []
+            mu = threading.Lock()
+            events = []
+            for i in range(n):
+                done = threading.Event()
+                events.append(done)
+                prompt = shared + [300 + i]
+
+                def on_done(err, d=done):
+                    with mu:
+                        outcomes.append(0 if err is None else err.code)
+                    d.set()
+
+                engine.submit(prompt, 4, lambda t: None, on_done)
+            for done in events:
+                assert done.wait(30), "kvcache chaos request hung"
+            # exactly once each: every request has ONE definite outcome
+            assert len(outcomes) == n, f"{n - len(outcomes)} calls hung"
+            assert plan.injected["kvcache.page_alloc"] == 2
+            nerr = sum(1 for c in outcomes if c != 0)
+            assert nerr >= 1, "injected exhaustion reached no request"
+            assert all(c in (0, errors.ELIMIT) for c in outcomes), outcomes
+        # the forked pair's shared prefix and diverged tails are intact:
+        # eviction under pressure never touched referenced pages
+        assert store.pagepool.read(held.pages[0]).tolist() == [1, 2, 3, 4]
+        assert store.pagepool.read(held.pages[-1], 3).tolist() == held_words
+        assert store.pagepool.read(forked.pages[-1], 3).tolist() == \
+            fork_words
+        store.pagepool.assert_consistent()
+        # post-chaos the engine still serves
+        assert engine.join_idle(10)
+        done = threading.Event()
+        toks = []
+        engine.submit([7, 8, 9], 2, toks.append, lambda err: done.set())
+        assert done.wait(20) and len(toks) == 2
+        assert engine.join_idle(10)
+        # baseline: retire everything, drop the cache -> refcounts zero
+        # and every HBM block back in the device pool
+        store.retire(held, cache=False)
+        store.retire(forked, cache=False)
+        assert store.stats()["live_seqs"] == 0
+        store.clear()
+        store.pagepool.assert_consistent()
+        assert store.pagepool.blocks_leased() == 0
+        assert wait_until(lambda: occupancy() == free0, 10), \
+            f"KV blocks leaked: {occupancy()} != {free0}"
+    finally:
+        engine.close()
+        store.close()
+
+
 class TestHealthCheckRevival:
     def test_probe_respects_isolation_hold_while_reachable(self, server):
         """The circuit breaker's isolation hold (_hold_until) must be

@@ -193,7 +193,7 @@ def test_every_console_route_answers(server):
     routes = [
         "/", "/index", "/status", "/vars", "/flags", "/health",
         "/version", "/connections", "/sockets", "/bthreads", "/services",
-        "/protobufs", "/memory", "/ici", "/serving", "/rpcz",
+        "/protobufs", "/memory", "/ici", "/serving", "/kvcache", "/rpcz",
         "/brpc_metrics",
         "/dashboard", "/vlog", "/hotspots",
         "/hotspots/cpu?seconds=0.05",

@@ -1,0 +1,524 @@
+"""Paged KV-cache subsystem tests (ISSUE 3 acceptance criteria).
+
+Covers, in order:
+  * page/refcount/block<->page-table discipline (pages.py) — a block
+    returns to the BlockPool exactly when its last page frees;
+  * radix prefix reuse (radix.py + store.py) — a request whose prompt
+    extends a cached prefix reuses the SHARED pages, and the engine
+    prefills only the suffix (pinned by a trace/compile counter);
+  * copy-on-write forks isolate divergent continuations at the page-
+    content level;
+  * eviction never frees a page with refcount > 1, and pressure-driven
+    eviction keeps allocation alive;
+  * DecodeEngine occupancy returns to baseline after a mixed
+    admit/fork/retire run; the gathered page table reaches a 3-arg
+    step function with a fixed shape;
+  * DynamicBatcher prefix_probe trims prefill to the uncached suffix
+    (smaller length buckets, skip ratio on /vars);
+  * earliest-deadline-first priority lanes in the batcher;
+  * prefix-affinity load balancing (consistent-hash on the prefix
+    fingerprint);
+  * the /kvcache console page.
+"""
+import http.client
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.kvcache import KVCacheStore, PagePool, RadixTree
+from brpc_tpu.serving import DecodeEngine, DynamicBatcher
+
+from testutil import wait_until
+
+PT = 4          # page_tokens for most tests
+PB = 64         # page_bytes (16B per token slot)
+
+
+def _mk_store(name, max_blocks=8, page_bytes=PB, page_tokens=PT):
+    return KVCacheStore(page_bytes=page_bytes, page_tokens=page_tokens,
+                        max_blocks=max_blocks, name=name)
+
+
+# ---------------------------------------------------------------------------
+# pages: refcounts + block<->page table
+# ---------------------------------------------------------------------------
+
+def test_pages_refcount_and_block_baseline():
+    pool = PagePool(page_bytes=PB, page_tokens=PT, max_blocks=2,
+                    name="t_pages")
+    base = {k: v["free"] for k, v in pool.pool.stats()["classes"].items()}
+    pages = [pool.alloc_page() for _ in range(3)]
+    assert pool.blocks_leased() == 1          # all carved from one block
+    assert pool.pages_in_use() == 3
+    pool.ref(pages[0])                        # shared now (refs=2)
+    pool.unref(pages[1])
+    pool.unref(pages[2])
+    assert pool.pages_in_use() == 1
+    assert pool.blocks_leased() == 1          # pages[0] still pins it
+    pool.unref(pages[0])
+    assert pool.blocks_leased() == 1          # one ref left
+    pool.unref(pages[0])
+    assert pool.blocks_leased() == 0          # last page freed -> released
+    now = {k: v["free"] for k, v in pool.pool.stats()["classes"].items()}
+    assert now == base, "block leaked past its last page"
+    pool.assert_consistent()
+    with pytest.raises(RuntimeError):
+        pool.unref(pages[0])                  # double free is loud
+
+
+def test_pages_write_read_roundtrip_and_isolation():
+    pool = PagePool(page_bytes=PB, page_tokens=PT, max_blocks=2,
+                    name="t_pages_rw")
+    a, b = pool.alloc_page(), pool.alloc_page()
+    pool.write(a, 0, [11, 12, 13, 14])
+    pool.write(b, 0, [21, 22])
+    pool.write(b, 2, [23, 24])
+    # sibling pages share one block buffer: a's splice must not clobber b
+    assert pool.read(a).tolist() == [11, 12, 13, 14]
+    assert pool.read(b).tolist() == [21, 22, 23, 24]
+    c = pool.alloc_page()
+    pool.copy_page(c, a)
+    assert pool.read(c).tolist() == [11, 12, 13, 14]
+    for p in (a, b, c):
+        pool.unref(p)
+    assert pool.blocks_leased() == 0
+
+
+# ---------------------------------------------------------------------------
+# radix prefix reuse through the store
+# ---------------------------------------------------------------------------
+
+def test_store_prefix_reuse_shares_pages():
+    st = _mk_store("t_reuse_store")
+    try:
+        prompt = list(range(10))
+        s1 = st.admit(prompt)
+        assert s1.prefix_hit_tokens == 0     # cold cache
+        for t in (100, 101):                 # decode 2 tokens -> 12 total
+            st.extend(s1, t)
+        s1_ids = s1.page_ids()
+        st.retire(s1)                        # full pages enter the tree
+        assert st.radix.node_count() == 3    # 12 tokens / 4 per page
+        ext = prompt + [100, 101, 7, 8]      # extends the cached prefix
+        s2 = st.admit(ext)
+        # the shared pages are THE SAME handles, not copies
+        assert s2.prefix_hit_tokens == 12
+        assert s2.page_ids()[:3] == s1_ids[:3]
+        assert st.hit_rate() > 0
+        # a diverging prompt shares only the chunks it matches
+        s3 = st.admit(prompt[:4] + [999] * 6)
+        assert s3.prefix_hit_tokens == 4
+        assert s3.page_ids()[0] == s1_ids[0]
+        assert s3.page_ids()[1] != s1_ids[1]
+        st.retire(s2, cache=False)
+        st.retire(s3, cache=False)
+        st.pagepool.assert_consistent()
+    finally:
+        st.close()
+
+
+def test_store_cow_fork_isolates_divergence():
+    st = _mk_store("t_cow_store")
+    try:
+        s = st.admit([1, 2, 3, 4, 5, 6])     # 1.5 pages
+        f = st.fork(s)
+        shared_tail = s.pages[-1]
+        assert shared_tail.refs == 2
+        st.extend(s, 700)                    # tail shared -> COW copies
+        st.extend(f, 800)
+        assert s.pages[-1].pid != f.pages[-1].pid
+        # content-level isolation: each side sees its own continuation,
+        # and the common prefix survives in both
+        assert st.pagepool.read(s.pages[-1], 3).tolist() == [5, 6, 700]
+        assert st.pagepool.read(f.pages[-1], 3).tolist() == [5, 6, 800]
+        assert st.stats()["cow_forks"] >= 1
+        st.retire(s, cache=False)
+        st.retire(f, cache=False)
+        st.pagepool.assert_consistent()
+        assert st.pagepool.blocks_leased() == 0
+    finally:
+        st.close()
+
+
+def test_eviction_never_frees_referenced_pages():
+    """LRU eviction under pool pressure frees only tree-held (refs==1)
+    pages; a page a live sequence still references survives any demand,
+    and allocation keeps succeeding off the reclaimed space."""
+    # 8KB block / 2048B pages -> 4 pages per block; 1 block max = 4 pages
+    st = KVCacheStore(page_bytes=2048, page_tokens=4, max_blocks=1,
+                      name="t_evict")
+    try:
+        live = st.admit([1, 2, 3, 4, 5])     # 2 pages, held live
+        live_ids = set(live.page_ids())
+        cold = st.admit([9, 9, 9, 9, 9])     # 2 pages
+        st.retire(cold)                      # 1 full page cached in tree
+        # pool is now: 2 live + 1 tree + 1 free.  Demand 2 fresh pages:
+        # the tree page must be evicted, the live ones must not.
+        s = st.admit([7] * 8)                # needs 2 pages
+        assert st.stats()["evictions"] >= 1
+        assert live_ids <= set(live.page_ids())
+        # the live sequence's content is intact post-eviction
+        assert st.pagepool.read(live.pages[0]).tolist() == [1, 2, 3, 4]
+        # and at TOTAL exhaustion (everything referenced) the failure is
+        # a definite MemoryError, not a freed-in-use page
+        with pytest.raises(MemoryError):
+            st.admit([5] * 9)
+        st.pagepool.assert_consistent()
+        st.retire(s, cache=False)
+        st.retire(live, cache=False)
+    finally:
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# engine integration: suffix-only prefill + page tables + baseline
+# ---------------------------------------------------------------------------
+
+def test_engine_prefill_only_suffix_trace_pinned():
+    """ISSUE 3 acceptance: a prompt extending a cached prefix reuses the
+    shared pages — prefill runs ONLY on the uncached suffix, and the
+    jit cache sees bucket shapes only (one compile per bucket)."""
+    st = _mk_store("t_prefill_store")
+    prefill_traces = []
+    prefill_calls = []
+
+    @jax.jit
+    def _prefill_jit(tokens, start):
+        prefill_traces.append(tuple(tokens.shape))
+        return tokens.sum()
+
+    def prefill(tokens, start):
+        prefill_calls.append((int(tokens.shape[0]), int(start)))
+        return _prefill_jit(tokens, start)
+
+    step_traces = []
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        step_traces.append(tuple(pages.shape))
+        return tokens + 1
+
+    eng = DecodeEngine(step, num_slots=2, store=st, prefill_fn=prefill,
+                       prefill_buckets=(8, 32), max_pages_per_slot=8,
+                       name="t_prefill_e")
+    try:
+        done = threading.Event()
+        toks = []
+        prompt = list(range(10))
+        eng.submit(prompt, 2, toks.append, lambda err: done.set())
+        assert done.wait(30) and len(toks) == 2
+        # cold admit: the whole 10-token prompt prefilled (bucket 32)
+        assert prefill_calls == [(32, 0)]
+        assert eng.join_idle(10)
+        # seq cached 12 tokens (10 prompt + 2 generated) = 3 full pages
+        ext = prompt + toks + [77, 78]       # extends the cached prefix
+        done2 = threading.Event()
+        eng.submit(ext, 2, lambda t: None, lambda err: done2.set())
+        assert done2.wait(30)
+        # warm admit: 12 tokens hit -> ONLY the 2-token suffix prefills
+        # (bucket 8, starting at position 12)
+        assert prefill_calls == [(32, 0), (8, 12)]
+        # compile-pinned: one trace per bucket, none per raw length
+        assert sorted(prefill_traces) == [(8,), (32,)]
+        # the step function received the fixed-shape page table
+        assert step_traces == [(2, 8)]
+        assert st.stats()["hit_tokens"] == 12
+    finally:
+        eng.close()
+        st.close()
+
+
+def test_engine_rejects_prompt_exceeding_page_table_at_admit():
+    """A prompt needing more pages than max_pages_per_slot is rejected
+    AT ADMIT with a definite ELIMIT — installing it would silently
+    truncate the gathered page table and decode on wrong KV."""
+    st = _mk_store("t_cap_store", max_blocks=16)
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return tokens + 1
+
+    eng = DecodeEngine(step, num_slots=2, store=st, max_pages_per_slot=3,
+                       name="t_cap_e")
+    try:
+        done = threading.Event()
+        errbox = []
+        # 13 tokens / 4 per page = 4 pages > cap of 3
+        eng.submit(list(range(13)), 2, lambda t: None,
+                   lambda err: (errbox.append(err), done.set()))
+        assert done.wait(20)
+        assert errbox[0] is not None and errbox[0].code == errors.ELIMIT
+        assert "pages" in errbox[0].text
+        # the rejected admit leaked nothing and the engine still serves
+        assert st.stats()["live_seqs"] == 0
+        done2 = threading.Event()
+        toks = []
+        eng.submit(list(range(8)), 2, toks.append,
+                   lambda err: done2.set())
+        assert done2.wait(20) and len(toks) == 2
+    finally:
+        eng.close()
+        st.close()
+
+
+def test_engine_mixed_admit_fork_retire_occupancy_baseline():
+    """ISSUE 3 acceptance: engine + store occupancy returns to baseline
+    after a mixed admit/fork/retire run (forks at the store level ride
+    alongside live engine traffic)."""
+    st = _mk_store("t_mixed_store", max_blocks=16)
+    device_pool = st.pagepool.pool
+    base = {k: v["free"] for k, v in device_pool.stats()["classes"].items()}
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return tokens + 1
+
+    eng = DecodeEngine(step, num_slots=3, store=st, name="t_mixed_e")
+    try:
+        sinks = []
+        shared = list(range(8))
+        for i in range(9):
+            done = threading.Event()
+            errbox = []
+            sinks.append((done, errbox))
+            prompt = shared + [100 + i, 200 + i]
+            eng.submit(prompt, 3, lambda t: None,
+                       lambda err, d=done, eb=errbox: (eb.append(err),
+                                                       d.set()))
+            if i % 3 == 0:
+                # store-level fork/extend/retire churn mid-decode
+                s = st.admit(shared + [999, i])
+                f = st.fork(s)
+                st.extend(f, 31337)
+                st.retire(s, cache=False)
+                st.retire(f, cache=False)
+        for done, errbox in sinks:
+            assert done.wait(30), "request hung"
+            assert errbox[0] is None
+        assert eng.join_idle(10)
+        assert st.stats()["live_seqs"] == 0
+        st.pagepool.assert_consistent()
+        st.clear()                       # drop the radix cache
+        assert st.pagepool.blocks_leased() == 0
+        now = {k: v["free"]
+               for k, v in device_pool.stats()["classes"].items()}
+        assert now == base, "HBM blocks leaked through the page cache"
+        assert st.stats()["forks"] == 3
+    finally:
+        eng.close()
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# batcher: prefix-aware prefill bucketing
+# ---------------------------------------------------------------------------
+
+def test_batcher_prefix_probe_trims_to_suffix():
+    st = _mk_store("t_probe_store", page_tokens=16, page_bytes=256)
+    traces = []
+
+    def _fn(x):
+        traces.append(tuple(x.shape))
+        return x.sum(axis=1)
+
+    b = DynamicBatcher(jax.jit(_fn), max_batch_size=2, max_delay_us=500,
+                       batch_buckets=(2,), length_buckets=(16, 64),
+                       prefix_cache=st, dtype=np.int32,
+                       name="t_probe")
+    try:
+        # warm the cache: one retired 48-token sequence = 3 cached pages
+        s = st.admit(list(range(48)) + [1])
+        st.retire(s)
+        # cold prompt (disjoint token range): full 40 tokens -> bucket 64
+        cold = np.arange(40, dtype=np.int32) + 1000
+        got = b.submit_wait(cold)
+        assert int(got) == int(cold.sum())
+        # warm prompt: 48 cached + 6 new -> only the suffix computes,
+        # riding the SMALL bucket a 54-token item could never fit
+        warm = np.asarray(list(range(48)) + [5, 5, 5, 5, 5, 5], np.int32)
+        got = b.submit_wait(warm)
+        assert int(got) == 30                 # suffix-only sum
+        assert set(traces) == {(2, 64), (2, 16)}
+        st_b = b.stats()
+        assert st_b["prefix_skip_ratio"] > 0.4
+        # acquire/release balanced: after the batches the tree pages
+        # are held by the tree alone (no pin leaked by the batcher)
+        st.pagepool.assert_consistent()
+        assert st.stats()["pages"]["pages_in_use"] == \
+            st.stats()["radix_nodes"]
+    finally:
+        b.close()
+        st.close()
+
+
+def test_batcher_prefix_offsets_reach_batch_fn():
+    """A 2-arg batch_fn receives each row's start position — rows are
+    suffixes, so position-dependent compute needs the offset."""
+    st = _mk_store("t_offs_store", page_tokens=16, page_bytes=256)
+    seen_offsets = []
+
+    def fn(x, offsets):
+        seen_offsets.append(np.asarray(offsets).tolist())
+        return np.asarray(x).sum(axis=1) + np.asarray(offsets)
+
+    b = DynamicBatcher(fn, max_batch_size=2, max_delay_us=500,
+                       length_buckets=(16,), prefix_cache=st,
+                       dtype=np.int32, name="t_offs")
+    try:
+        s = st.admit(list(range(32)) + [1])
+        st.retire(s)
+        warm = np.asarray(list(range(32)) + [5, 5, 5], np.int32)
+        got = b.submit_wait(warm)
+        assert int(got) == 15 + 32          # suffix sum + its offset
+        assert any(32 in row for row in seen_offsets), seen_offsets
+    finally:
+        b.close()
+        st.close()
+
+
+def test_batcher_offsets_not_passed_into_optional_param():
+    """A batch_fn whose second parameter has a DEFAULT (e.g. a
+    temperature knob) must not silently receive the offsets array —
+    only two REQUIRED positionals opt in."""
+    st = _mk_store("t_noffs_store", page_tokens=16, page_bytes=256)
+
+    def fn(x, temperature=1.0):
+        assert temperature == 1.0, "offsets leaked into temperature"
+        return np.asarray(x).sum(axis=1) * temperature
+
+    b = DynamicBatcher(fn, max_batch_size=2, max_delay_us=500,
+                       length_buckets=(16,), prefix_cache=st,
+                       dtype=np.int32, name="t_noffs")
+    try:
+        assert not b._fn_wants_offsets
+        s = st.admit(list(range(32)) + [1])
+        st.retire(s)
+        got = b.submit_wait(np.asarray(list(range(32)) + [5, 5], np.int32))
+        assert int(got) == 10               # suffix-only sum, no offset
+    finally:
+        b.close()
+        st.close()
+
+
+# ---------------------------------------------------------------------------
+# batcher: EDF priority lanes
+# ---------------------------------------------------------------------------
+
+def test_batcher_priority_lanes_edf():
+    """With more queued than one batch holds, the FIFO head keeps one
+    seat (no starvation) and the nearest deadlines fill the rest,
+    counted as lane promotions."""
+    gate = threading.Event()
+    ncalls = [0]
+
+    def fn(x):
+        ncalls[0] += 1
+        if ncalls[0] == 1:
+            gate.wait(10)     # hold batch 1 while the queue builds up
+        return np.asarray(x).sum(axis=1)
+
+    b = DynamicBatcher(fn, max_batch_size=2, max_delay_us=1000,
+                       length_buckets=(16,), name="t_lanes")
+    order = []
+    mu = threading.Lock()
+
+    def fire_for(tag):
+        def fire(code, text, result):
+            with mu:
+                order.append(tag)
+        return fire
+
+    try:
+        b.enqueue(np.ones((4,), np.float32), fire_for("w1"))
+        b.enqueue(np.ones((4,), np.float32), fire_for("w2"))
+        # wait until batch 1 is actually executing so the next three
+        # queue up behind it
+        assert wait_until(lambda: ncalls[0] == 1, 10)
+        now = time.monotonic()
+        b.enqueue(np.ones((4,), np.float32), fire_for("no_deadline"))
+        b.enqueue(np.ones((4,), np.float32), fire_for("late"),
+                  deadline_s=now + 60)
+        b.enqueue(np.ones((4,), np.float32), fire_for("urgent"),
+                  deadline_s=now + 20)
+        gate.set()
+        assert wait_until(lambda: len(order) == 5, 15)
+        # batch 2 = {no_deadline (FIFO head, starvation-proof), urgent
+        # (EDF promoted over late)}; batch 3 = {late}
+        assert set(order[2:4]) == {"no_deadline", "urgent"}
+        assert order[4] == "late"
+        assert b.stats()["lane_promotions"] == 1
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# prefix-affinity load balancing
+# ---------------------------------------------------------------------------
+
+def test_prefix_affinity_lb_routes_repeat_prefixes_together():
+    from brpc_tpu.butil.endpoint import EndPoint
+    from brpc_tpu.policy.load_balancer import (ServerNode,
+                                               create_load_balancer,
+                                               prefix_fingerprint)
+    lb = create_load_balancer("prefix_affinity")
+    lb.reset_servers([ServerNode(EndPoint("10.9.0.1", p))
+                      for p in range(1, 6)])
+    shared = list(range(40, 56))             # one 16-token page chunk
+    # every continuation of the shared prefix lands on ONE replica —
+    # the one whose radix tree will hold its pages
+    eps = {lb.select_for_prompt(shared + [i, i + 1]) for i in range(30)}
+    assert len(eps) == 1
+    # distinct prefixes spread over the fleet
+    spread = {lb.select_for_prompt([i * 17 + j for j in range(16)])
+              for i in range(40)}
+    assert len(spread) >= 3
+    # fingerprints are stable and page-aligned: the suffix never matters
+    assert prefix_fingerprint(shared + [1]) == \
+        prefix_fingerprint(shared + [2, 3])
+    # replica churn remaps ONLY the departed replica's share
+    keys = [[i * 31 + j for j in range(16)] for i in range(60)]
+    before = {tuple(k): lb.select_for_prompt(k) for k in keys}
+    victim = next(iter(before.values()))
+    lb.remove_server(victim)
+    after = {tuple(k): lb.select_for_prompt(k) for k in keys}
+    for k, ep in before.items():
+        if ep != victim:
+            assert after[k] == ep, "unrelated prefix lost its warm cache"
+
+
+# ---------------------------------------------------------------------------
+# /kvcache console page
+# ---------------------------------------------------------------------------
+
+def test_console_kvcache_page():
+    st = _mk_store("t_console_store")
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        seq = st.admit(list(range(9)))
+        st.retire(seq)
+        seq2 = st.admit(list(range(9)) + [1, 2])
+        st.retire(seq2, cache=False)
+        c = http.client.HTTPConnection("127.0.0.1", s.port, timeout=10)
+        c.request("GET", "/kvcache")
+        r = c.getresponse()
+        body = r.read()
+        c.close()
+        assert r.status == 200
+        snap = json.loads(body)
+        stc = snap["stores"]["t_console_store"]
+        assert stc["hit_rate"] > 0
+        assert stc["radix_nodes"] == 2
+        for key in ("pages", "evictions", "cow_forks", "cached_tokens"):
+            assert key in stc
+    finally:
+        s.stop()
+        s.join()
+        st.close()

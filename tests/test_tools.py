@@ -104,6 +104,55 @@ class TestPress:
             srv.stop()
             srv.join()
 
+    def test_press_shared_prefix_skew(self):
+        """--shared-prefix-ratio: prompts are regenerated per call with
+        a seeded prefix-skew schedule — the shared fraction opens with
+        ONE fixed prefix, the schedule replays, and the prompts reach
+        the server."""
+        from brpc_tpu.tools.rpc_press import make_prefix_skew, run_press
+        factory = make_prefix_skew({"max_new_tokens": 4}, 0.5,
+                                   prefix_tokens=8, suffix_tokens=2,
+                                   seed=7)
+        gen = factory(0)
+        reqs = [gen() for _ in range(200)]
+        heads = [tuple(r["prompt"][:8]) for r in reqs]
+        shared_head = max(set(heads), key=heads.count)
+        frac = heads.count(shared_head) / len(heads)
+        assert 0.35 < frac < 0.65          # seeded coin near the ratio
+        assert all(len(r["prompt"]) == 10 for r in reqs)
+        # deterministic replay per worker, independent across workers
+        gen2 = factory(0)
+        assert [gen2() for _ in range(200)] == reqs
+        assert factory(1)() != reqs[0]
+
+        seen = []
+
+        class Gen(brpc.Service):
+            @brpc.method(request="json", response="json")
+            def Echo(self, cntl, req):
+                seen.append(req["prompt"])
+                return {"n": len(req["prompt"])}
+
+        srv = brpc.Server()
+        srv.add_service(Gen())
+        srv.start("127.0.0.1", 0)
+        try:
+            from brpc_tpu.tools.rpc_press import run_press
+            s = run_press(f"127.0.0.1:{srv.port}", "Gen", "Echo",
+                          {"max_new_tokens": 4}, qps=0, duration_s=0.4,
+                          threads=2, request_factory=make_prefix_skew(
+                              {"max_new_tokens": 4}, 0.9,
+                              prefix_tokens=8, suffix_tokens=2),
+                          out=io.StringIO())
+            assert s["sent_ok"] > 0 and s["errors"] == 0
+            assert seen and all(len(p) == 10 for p in seen)
+            heads = [tuple(p[:8]) for p in seen]
+            top = max(set(heads), key=heads.count)
+            assert heads.count(top) / len(heads) > 0.6   # skewed load
+        finally:
+            srv.stop()
+            srv.join()
+
 
 class TestViewAndParallelHttp:
     def test_view_and_fetch(self):
