@@ -72,6 +72,13 @@ serving: $(LIB) $(PYEXT)
 kvcache: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_kvcache.py -q
 
+# Recovery suite (README "Fault tolerance & degradation"): engine
+# supervision, crash/wedge failover over the surviving KV cache,
+# degradation ladder, flapping-replica quarantine.  CPU jit path; the
+# timed recovery rung runs via `python bench.py` (recovery section).
+recovery: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q
+
 # Sanitizer stress targets (VERDICT r2 task 7; reference fights lock-free
 # races with stress tests + sanitizer builds, SURVEY.md §5.3).  The whole
 # native core + src/cc/test/stress_main.cc compile as ONE binary with the
@@ -101,4 +108,4 @@ stress:
 	    $(STRESS_SRC) -o build/stress_plain
 	./build/stress_plain
 
-.PHONY: all clean test chaos serving kvcache tsan asan stress
+.PHONY: all clean test chaos serving kvcache recovery tsan asan stress

@@ -595,6 +595,121 @@ def bench_kvcache(shared_ratios=(0.0, 0.5, 0.9), n_requests=24,
     return out
 
 
+def bench_recovery(committed_ratios=(0.0, 0.5, 0.9), n_requests=6,
+                   total_prompt_tokens=40, new_tokens=10, trials=3):
+    """Recovery rung: supervised engine-crash failover through
+    `brpc_tpu/serving/supervisor.py` + the paged KV cache.
+
+    Workload: `n_requests` concurrent generations whose prompts share a
+    COMMITTED prefix covering `committed_ratios` of the prompt (the
+    prefix is committed to the radix tree by a clean completion before
+    the wave; the rest of each prompt is unique).  A seeded
+    `serving.step` fault crashes the engine mid-decode; the supervisor
+    detects it, rebuilds against the surviving store, and re-admits
+    every generation from its last emitted token.  Reported per ratio:
+
+      * time-to-recover: crash detection -> first post-restart token
+        (the supervisor's own detect_to_first_token_ms);
+      * re-decoded-token ratio: (prompt tokens prefilled - cache-hit
+        tokens) / prompt tokens over the wave+recovery window — 1.0
+        means recovery replayed everything from scratch, lower means
+        the committed prefix pages did their job.
+
+    Same jitter discipline as the other rungs: `trials` runs per
+    ratio, median + spread.  The caller publishes {"skipped": true}
+    when no device is reachable."""
+    import threading
+
+    import jax
+
+    from brpc_tpu import fault
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine, EngineSupervisor
+
+    pt = 8
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return (tokens * 7 + positions) % 997
+
+    calm = ({"queue_delay_us": float("inf"), "pool_ratio": 9.9,
+             "queue_depth": 1e9},) * 3
+
+    def one_trial(ratio: float, k: int):
+        tag = f"rec_r{int(ratio * 100)}_{k}"
+        store = KVCacheStore(page_tokens=pt, page_bytes=pt * 64,
+                             max_blocks=64, name=f"bench_{tag}")
+        sup = EngineSupervisor(
+            lambda: DecodeEngine(step, num_slots=4, store=store,
+                                 max_pages_per_slot=64,
+                                 name=f"bench_{tag}_eng"),
+            store=store, heartbeat_deadline_s=10.0,
+            check_interval_s=0.01, ladder=calm, name=f"bench_{tag}_sup")
+        # page-align the committed share so "committed" means whole
+        # pages the radix tree can actually serve
+        shared_n = int(total_prompt_tokens * ratio) // pt * pt
+        shared = [5000 + k * 1000 + j for j in range(shared_n)]
+        prompts = []
+        for i in range(n_requests):
+            uniq = [7000 + k * 1000 + i * total_prompt_tokens + j
+                    for j in range(total_prompt_tokens - shared_n)]
+            prompts.append(shared + uniq)
+        try:
+            # warm the jit cache AND commit the shared prefix
+            done = threading.Event()
+            warm = (shared + [9]) if shared else [9_000_000 + k, 1, 2]
+            sup.submit(warm, 1, lambda t: None, lambda e: done.set())
+            assert done.wait(120)
+            assert sup.join_idle(60)
+            h0 = store.hit_tokens.get_value()
+            p0 = store.prompt_tokens.get_value()
+            plan = fault.FaultPlan(900 + k).on(
+                "serving.step", fault.ERROR, times=1, after=3)
+            events = [threading.Event() for _ in prompts]
+            with fault.injected(plan):
+                for p, ev in zip(prompts, events):
+                    sup.submit(p, new_tokens, lambda t: None,
+                               (lambda err, d=ev: d.set()))
+                for ev in events:
+                    assert ev.wait(120), "recovery bench request hung"
+            assert sup.stats()["restarts"] == 1, "crash never fired"
+            rec = sup.stats()["last_recovery"] or {}
+            ttr_ms = rec.get("detect_to_first_token_ms")
+            dp = store.prompt_tokens.get_value() - p0
+            dh = store.hit_tokens.get_value() - h0
+            redecode = (dp - dh) / dp if dp else 1.0
+            return ttr_ms, redecode
+        finally:
+            sup.close()
+            store.clear()
+            store.close()
+
+    out = {}
+    for ratio in committed_ratios:
+        rs = []
+        for k in range(trials):
+            rs.append(one_trial(ratio, k))
+        ttrs = sorted(r[0] for r in rs if r[0] is not None)
+        reds = sorted(r[1] for r in rs)
+        out[f"committed{int(ratio * 100)}"] = {
+            "time_to_recover_ms": (round(ttrs[len(ttrs) // 2], 2)
+                                   if ttrs else None),
+            "time_to_recover_spread_ms": ([round(ttrs[0], 2),
+                                           round(ttrs[-1], 2)]
+                                          if ttrs else None),
+            "redecoded_token_ratio": round(reds[len(reds) // 2], 4),
+            "redecoded_token_ratio_spread": [round(reds[0], 4),
+                                             round(reds[-1], 4)],
+            "trials": trials,
+        }
+    out["note"] = ("recovery rung (brpc_tpu/serving/supervisor.py): "
+                   "detect->first-post-restart-token latency and "
+                   "re-decoded-token ratio vs committed-prefix share; "
+                   "the ratio falls as committed pages turn recovery "
+                   "prefill into cache hits")
+    return out
+
+
 def bench_hbm_stream(chunk_mb=64):
     """SECONDARY chip sanity number: raw on-chip HBM read+write bandwidth
     of a jitted roll+add loop.  No framework code runs here — this bounds
@@ -1327,6 +1442,15 @@ def main():
         except Exception as e:
             details["kvcache"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['kvcache']}")
+    log("bench: engine crash recovery...")
+    if not device_ok:
+        details["recovery"] = {"skipped": True, "reason": device_err}
+    else:
+        try:
+            details["recovery"] = bench_recovery()
+        except Exception as e:
+            details["recovery"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['recovery']}")
     # each bench is isolated: a failure in one must not clobber another's
     # already-valid result
     for name, fn in (("tensor_pipe", lambda: bench_tensor_pipe(chunk_mb=64)),

@@ -7,7 +7,8 @@ MethodStatus), /vars (+ wildcard filter), /flags (live edit with ?setvalue=),
 /rpcz (recent spans, ?trace_id= filter), /brpc_metrics (Prometheus text),
 /services (method inventory — /protobufs analog), /memory, /ici (link
 stats of the ICI transport), /serving (dynamic-batcher occupancy +
-decode slot map, brpc_tpu/serving), /kvcache (paged-KV hit-rate, page
+decode slot map + supervisor state/restart/recovery stats,
+brpc_tpu/serving), /kvcache (paged-KV hit-rate, page
 occupancy, radix-tree size, eviction counters, brpc_tpu/kvcache).
 """
 from __future__ import annotations
@@ -263,7 +264,8 @@ def build_routes(server) -> dict:
             return "no serving components registered\n"
         from brpc_tpu.serving import serving_snapshot
         snap = serving_snapshot()
-        if not snap["batchers"] and not snap["engines"]:
+        if not snap["batchers"] and not snap["engines"] \
+                and not snap.get("supervisors"):
             return "no serving components registered\n"
         return json.dumps(snap, indent=1), "application/json"
 

@@ -27,6 +27,18 @@ layers:
     serving.slot_alloc L6      error  (serving/engine.py: KV slot lease
                                fails; that request errors, the loop and
                                the block pool stay healthy)
+    serving.step       L6      error, latency  (serving/engine.py: the
+                               decode step itself fails — supervised
+                               engines crash and the EngineSupervisor
+                               fails over the in-flight generations;
+                               unsupervised engines fail their
+                               requests definitively)
+    serving.heartbeat  L6      error  (serving/engine.py: SUPPRESSES
+                               the step-progress heartbeat while the
+                               loop keeps running — from the
+                               supervisor's watchdog this is exactly a
+                               wedged loop, so takeover-from-a-live-
+                               loop is deterministically testable)
     kvcache.page_alloc KV      exhaust (kvcache/pages.py: page alloc
                                raises MemoryError — the store evicts
                                LRU radix leaves and retries; still dry

@@ -22,6 +22,22 @@ LRU-by-leaf from the radix tree and retries once — eviction can only
 free pages nothing else references, so exhaustion under load degrades
 hit-rate, never correctness.
 
+Crash recovery (``detach``): a supervisor tearing down a crashed
+engine detaches each in-flight sequence — its full-page chunks are
+committed to the radix tree ATOMICALLY with a recovery pin (extra
+refs), so re-admitting the request hits the committed prefix and
+re-decodes only the uncommitted tail, and pressure eviction cannot
+free that prefix in the detach->re-admit window.
+
+Locking is fine-grained: the store-wide lock covers only the
+match/ref/insert/evict compositions (where a ref must be taken before
+eviction could observe the page) and the seq-lifecycle bookkeeping.
+The cold-admit device splice — writing a long uncached suffix to HBM —
+runs OUTSIDE it: the suffix pages are exclusively owned and the
+PagePool serializes raw splices itself, so a long uncached prompt no
+longer stalls concurrent ``acquire_prefix``/``extend``/batch
+formation behind its device writes.
+
 Instrumented on /vars (and the /kvcache console page): hit-rate
 (prefix tokens reused / prompt tokens seen), pages in use, evictions,
 copy-on-write forks, admit/retire/fork counters, radix-tree size.
@@ -38,6 +54,29 @@ from brpc_tpu.kvcache.pages import KVPage, PagePool
 from brpc_tpu.kvcache.radix import RadixTree
 
 _seq_ids = itertools.count(1)
+
+
+class RecoveryPin:
+    """Refs taken by :meth:`KVCacheStore.detach` on a crashed
+    sequence's committed prefix pages.  While held, pressure eviction
+    cannot free that prefix; ``release()`` (idempotent) drops the refs
+    once the request has been re-admitted (admission takes its own
+    refs on the pages it matches)."""
+
+    __slots__ = ("_store", "_pages", "tokens")
+
+    def __init__(self, store, pages, tokens: int):
+        self._store = store
+        self._pages = list(pages)
+        self.tokens = tokens          # committed prefix length pinned
+
+    def release(self) -> None:
+        pages, self._pages = self._pages, []
+        if pages:
+            self._store.release(pages)
+
+    def __len__(self) -> int:
+        return len(self._pages)
 
 
 class KVSeq:
@@ -89,6 +128,7 @@ class KVCacheStore:
         self.admitted = Adder(f"kvcache_{safe}_admitted")
         self.retired = Adder(f"kvcache_{safe}_retired")
         self.forks = Adder(f"kvcache_{safe}_forks")
+        self.detached = Adder(f"kvcache_{safe}_detached")
         PassiveStatus(self.hit_rate).expose(f"kvcache_{safe}_hit_rate")
         PassiveStatus(self.pagepool.pages_in_use).expose(
             f"kvcache_{safe}_pages_in_use")
@@ -110,29 +150,38 @@ class KVCacheStore:
         if not prompt:
             raise ValueError("empty prompt")
         with self._mu:
+            # match+ref is the one composition that MUST be atomic
+            # against eviction: between match returning a tree-only
+            # page (refs==1) and our ref, an evict could free it
             max_chunks = (len(prompt) - 1) // self.page_tokens
             shared = self.radix.match(prompt, max_chunks=max_chunks)
             seq = KVSeq()
             for p in shared:
                 self.pagepool.ref(p)
                 seq.pages.append(p)
-            hit = len(shared) * self.page_tokens
-            seq.tokens = prompt[:hit]
-            seq.prefill_from = hit
-            try:
-                self._append_run(seq, prompt[hit:])
-            except BaseException:
-                # a failed admit must not leak the refs already taken
-                for p in seq.pages:
-                    self.pagepool.unref(p)
-                raise
-            # count the hit only once the admit SUCCEEDS — a failed
-            # admit skipped no compute and must not inflate hit-rate
-            self.hit_tokens.add(hit)
-            self.prompt_tokens.add(len(prompt))
-            self.admitted.add(1)
+        hit = len(shared) * self.page_tokens
+        seq.tokens = prompt[:hit]
+        seq.prefill_from = hit
+        try:
+            # the cold-admit device splice runs OUTSIDE the store lock
+            # (ROADMAP open item): the suffix pages are exclusively
+            # ours and the PagePool serializes raw splices itself, so
+            # a long uncached prompt cannot stall concurrent
+            # acquire_prefix/extend/batch formation behind its writes
+            self._append_run(seq, prompt[hit:])
+        except BaseException:
+            # a failed admit must not leak the refs already taken
+            for p in seq.pages:
+                self.pagepool.unref(p)
+            raise
+        # count the hit only once the admit SUCCEEDS — a failed
+        # admit skipped no compute and must not inflate hit-rate
+        self.hit_tokens.add(hit)
+        self.prompt_tokens.add(len(prompt))
+        self.admitted.add(1)
+        with self._mu:
             self._live += 1
-            return seq
+        return seq
 
     def extend(self, seq: KVSeq, token: int) -> None:
         """Append one generated token's KV to `seq`."""
@@ -178,6 +227,41 @@ class KVCacheStore:
             self.retired.add(1)
             self._live -= 1
 
+    def detach(self, seq: KVSeq) -> RecoveryPin:
+        """Crash-recovery re-attach API: atomically commit a LIVE
+        sequence's full-page chunks to the radix tree, take a recovery
+        ref on the committed pages, and retire the sequence.  The next
+        ``admit`` of ``seq.tokens + ...`` prefix-hits the committed
+        pages (prefill-skip on recovery — only the uncommitted tail
+        re-decodes), and the returned pin guarantees pressure eviction
+        cannot free that prefix before the re-admit lands.  Atomicity
+        matters: done as separate retire(cache=True) + acquire_prefix
+        calls, eviction could strike between them and recovery would
+        silently degrade to a full replay."""
+        with self._mu:
+            if seq.retired:
+                return RecoveryPin(self, [], 0)
+            nfull = len(seq.tokens) // self.page_tokens
+            pinned: list = []
+            if nfull:
+                toks = seq.tokens[:nfull * self.page_tokens]
+                self.radix.insert(toks, seq.pages[:nfull])
+                # pin the pages the TREE actually holds (an already-
+                # cached chunk keeps the tree's page, not this seq's
+                # copy) — those are the ones a re-admit will match
+                pinned = self.radix.match(toks, max_chunks=nfull)
+                for p in pinned:
+                    self.pagepool.ref(p)
+            seq.retired = True
+            for p in seq.pages:
+                self.pagepool.unref(p)
+            seq.pages = []
+            self.detached.add(1)
+            self.retired.add(1)
+            self._live -= 1
+            return RecoveryPin(self, pinned,
+                               len(pinned) * self.page_tokens)
+
     # ---- internals ----
 
     def _append(self, seq: KVSeq, token: int) -> None:
@@ -219,15 +303,25 @@ class KVCacheStore:
     def _alloc_page(self) -> KVPage:
         """Page allocation with pressure-driven eviction: on
         exhaustion, evict one block's worth of LRU leaves from the
-        radix tree and retry once."""
-        try:
-            return self.pagepool.alloc_page()
-        except MemoryError:
-            freed = self.radix.evict(self.pagepool.pages_per_block)
-            self.evictions.add(freed)
-            if freed == 0:
-                raise
-            return self.pagepool.alloc_page()
+        radix tree and retry — LOOPING while eviction keeps freeing,
+        because with the cold-admit path outside the store lock a
+        CONCURRENT allocator may steal the pages this thread's evict
+        just freed (the thief made progress; this thread evicts more).
+        Exhaustion degrades hit-rate, never correctness, until the
+        tree is genuinely dry.  Each evict runs under the store lock —
+        every eviction path does, so a concurrent
+        admit/acquire_prefix can never ref a page eviction is mid-way
+        through freeing."""
+        while True:
+            try:
+                return self.pagepool.alloc_page()
+            except MemoryError:
+                with self._mu:
+                    freed = self.radix.evict(
+                        self.pagepool.pages_per_block)
+                self.evictions.add(freed)
+                if freed == 0:
+                    raise
 
     # ---- probes / maintenance ----
 
@@ -265,6 +359,15 @@ class KVCacheStore:
         with self._mu:
             for p in pages:
                 self.pagepool.unref(p)
+
+    def evict_pages(self, n: int) -> int:
+        """Evict up to `n` LRU cached pages (degradation-ladder
+        pressure relief — an overloaded supervisor trades hit-rate for
+        headroom).  Returns pages actually freed."""
+        with self._mu:
+            freed = self.radix.evict(n)
+        self.evictions.add(freed)
+        return freed
 
     def clear(self) -> int:
         """Evict every cached (tree-only) page — after all sequences
@@ -304,6 +407,7 @@ class KVCacheStore:
             "admitted": self.admitted.get_value(),
             "retired": self.retired.get_value(),
             "forks": self.forks.get_value(),
+            "detached": self.detached.get_value(),
             "cow_forks": self.cow.get_value(),
             "evictions": self.evictions.get_value(),
             "radix_nodes": self.radix.node_count(),

@@ -246,9 +246,9 @@ class SocketMap:
 
 class _CallState:
     __slots__ = ("cntl", "channel", "meta_template", "body", "done",
-                 "deadline_timer", "backup_timer", "sids", "tried_servers",
-                 "pooled_conns", "short_conns", "rail_obj", "rail_tickets",
-                 "rail_fallback_cache")
+                 "deadline_timer", "backup_timer", "sids", "sid_attempts",
+                 "tried_servers", "pooled_conns", "short_conns", "rail_obj",
+                 "rail_tickets", "rail_fallback_cache")
 
     def __init__(self, cntl, channel, meta_template, body, done):
         self.cntl = cntl
@@ -259,6 +259,11 @@ class _CallState:
         self.deadline_timer = None
         self.backup_timer = None
         self.sids: set[int] = set()
+        # sid -> the attempt number that wrote on it, recorded at bind
+        # time: the failed-socket callback retries a call only if the
+        # failed socket still carries its CURRENT attempt (a stale
+        # socket's death must not preempt a live retry chain)
+        self.sid_attempts: dict[int, int] = {}
         self.tried_servers: list[EndPoint] = []
         # device-array payload deferred to _issue: staged over ICI when the
         # selected server advertises a device (ici/rail.py), host-serialized
@@ -299,11 +304,15 @@ class CallManager:
         with self._lock:
             self._pending[st.cntl.correlation_id] = st
 
-    def bind_socket(self, cid: int, sid: int) -> None:
+    def bind_socket(self, cid: int, sid: int,
+                    attempt: int = 0) -> None:
         with self._lock:
             st = self._pending.get(cid)
             if st is not None:
                 st.sids.add(sid)
+                # latest attempt wins: a retry re-using the same healthy
+                # socket moves the sid's ownership to the new attempt
+                st.sid_attempts[sid] = attempt
                 self._by_sid.setdefault(sid, set()).add(cid)
 
     def _unregister(self, cid: int) -> Optional[_CallState]:
@@ -379,9 +388,19 @@ class CallManager:
                 # reference packs response user fields on errors as well)
                 cntl.response_user_fields = \
                     M.strip_reserved_user_fields(meta.user_fields)
-            cntl.set_failed(meta.error_code, meta.error_text)
-            if st.channel._should_retry(st):
+            # versioned, like every other failure path: a concurrent
+            # retry claim (failed-write / failed-socket) may already own
+            # a newer attempt, and this error response is then stale —
+            # it must neither stomp the claimed attempt's state nor
+            # finish the call under the live attempt
+            if not cntl.set_failed_if_current(meta.attempt,
+                                              meta.error_code,
+                                              meta.error_text):
+                return
+            if st.channel._should_retry(st, meta.attempt):
                 return  # re-issued under the same cid, next attempt
+            if cntl.current_attempt > meta.attempt or cntl.completed:
+                return  # a racing path claimed the retry first
             self._finish(st)
             return
         # success: decode body
@@ -442,13 +461,28 @@ class CallManager:
     def on_socket_failed(self, sid: int, err: int) -> None:
         with self._lock:
             cids = list(self._by_sid.pop(sid, ()))
-            states = [self._pending[c] for c in cids if c in self._pending]
-        for st in states:
-            st.cntl.set_failed(errors.EFAILEDSOCKET,
-                               f"socket failed (errno {err})")
-            if st.channel._should_retry(st):
+            states = [(self._pending[c],
+                       self._pending[c].sid_attempts.get(sid, 0))
+                      for c in cids if c in self._pending]
+        for st, owner in states:
+            # the failed socket carries attempt `owner`.  If a newer
+            # attempt already owns the call (the failed-write path
+            # claimed the retry first, or a backup request is in
+            # flight), this death is STALE: acting on it would stomp
+            # the live attempt's state and burn a second retry —
+            # chaos-pinned as the cluster-retry flake where the doomed
+            # extra retry excluded every server and failed a call whose
+            # live attempt was about to succeed.  The versioned
+            # set_failed runs FIRST (the retry policy reads error_code)
+            # and doubles as the staleness gate.
+            if not st.cntl.set_failed_if_current(
+                    owner, errors.EFAILEDSOCKET,
+                    f"socket failed (errno {err})"):
                 continue
-            self._finish(st)
+            if st.channel._should_retry(st, owner):
+                continue
+            if st.cntl.current_attempt == owner and not st.cntl.completed:
+                self._finish(st)
 
     def on_deadline(self, cid: int) -> None:
         self._fail_pending(cid, errors.ERPCTIMEDOUT, "deadline exceeded",
@@ -728,10 +762,16 @@ class Channel:
         path (IssueRPC, controller.cpp:1042)."""
         cntl = st.cntl
         mgr = CallManager.instance()
+        # the attempt number THIS _issue call issues: every failure
+        # below is versioned against it, so a stale path (a concurrent
+        # retry already owns a newer attempt) can neither overwrite the
+        # live attempt's state nor finish the call under it
+        attempt = cntl.current_attempt
         ep = self._select_server(st)
         if ep is None:
-            cntl.set_failed(errors.ENODATA, "no available server")
-            mgr._finish(st)
+            if cntl.set_failed_if_current(attempt, errors.ENODATA,
+                                          "no available server"):
+                mgr._finish(st)
             return
         st.tried_servers.append(ep)
         cntl.remote_side = str(ep)
@@ -754,10 +794,16 @@ class Channel:
             else:
                 conn = smap.get_connection(ep)
         except (ConnectionError, OSError):
-            cntl.set_failed(errors.ECONNREFUSED, f"cannot connect to {ep}")
-            if self._should_retry(st):
+            # versioned set BEFORE the retry check (the retry policy
+            # reads error_code); a False return means a newer attempt
+            # owns the call and this refusal is stale
+            if not cntl.set_failed_if_current(attempt, errors.ECONNREFUSED,
+                                              f"cannot connect to {ep}"):
                 return
-            mgr._finish(st)
+            if self._should_retry(st, attempt):
+                return
+            if cntl.current_attempt == attempt and not cntl.completed:
+                mgr._finish(st)
             return
         meta = st.meta_template
         meta.attempt = cntl.current_attempt
@@ -768,7 +814,7 @@ class Channel:
             # (HmacAuthenticator) reject a reused nonce, so retries and
             # backup requests must not resend the first attempt's
             meta.auth = self.options.auth.generate_credential()
-        mgr.bind_socket(cntl.correlation_id, conn.sid)
+        mgr.bind_socket(cntl.correlation_id, conn.sid, attempt)
         stream = getattr(cntl, "_stream", None)
         if stream is not None and not stream.connected:
             if stream.peer_device is None:
@@ -781,14 +827,14 @@ class Channel:
                 from brpc_tpu.ici import rail
                 stream.peer_device = rail.lookup(ep)
             stream.bind(conn.sid)
-        # attempt version at write time: failing the socket below can
-        # run the failed-socket callback SYNCHRONOUSLY, whose retry path
-        # bumps current_attempt and re-issues — after which THIS frame's
-        # failure is stale and must stay silent (the reference's
-        # bthread_id versioning, OnVersionedRPCReturned; chaos-pinned:
-        # a stale path that kept going either finished the call with no
-        # response or issued a duplicate attempt)
-        attempt = cntl.current_attempt
+        # `attempt` (captured at entry) versions the write: failing the
+        # socket below can run the failed-socket callback SYNCHRONOUSLY
+        # or on the transport thread, whose retry path claims the next
+        # attempt — after which THIS frame's failure is stale and must
+        # stay silent (the reference's bthread_id versioning,
+        # OnVersionedRPCReturned; chaos-pinned: a stale path that kept
+        # going either finished the call with no response or issued a
+        # duplicate attempt)
         if (not meta.auth and not meta.trace_id and not meta.span_id
                 and not meta.stream_id and not meta.tensor_header
                 and not meta.user_fields and not meta.attachment_size):
@@ -828,8 +874,10 @@ class Channel:
                 Transport.instance().close(conn.sid, 0)
             if cntl.current_attempt > attempt or cntl.completed:
                 return   # a newer attempt or a completion owns the call
-            if self._should_retry(st):
+            if self._should_retry(st, attempt):
                 return
+            if cntl.current_attempt > attempt or cntl.completed:
+                return   # a racing path claimed the retry first
             mgr._finish(st)
 
     def _prepare_rail_attempt(self, st: _CallState, ep: EndPoint) -> None:
@@ -864,9 +912,14 @@ class Channel:
                                       tensor_header)
         st.body, meta.tensor_header = st.rail_fallback_cache
 
-    def _should_retry(self, st: _CallState) -> bool:
-        """If allowed, bump the attempt and re-issue.  Returns True when a
-        retry was started (the call stays pending)."""
+    def _should_retry(self, st: _CallState,
+                      owner_attempt: int | None = None) -> bool:
+        """If allowed, claim the next attempt and re-issue.  Returns
+        True when a retry was started (the call stays pending).  The
+        claim is ATOMIC against the attempt version (`owner_attempt`,
+        defaulting to the current attempt): of two failure paths racing
+        to retry the same attempt, exactly one wins — the loser must
+        re-check attempt/completion before finishing the call."""
         cntl = st.cntl
         if cntl.completed:
             return False
@@ -875,9 +928,10 @@ class Channel:
             return False
         if not policy.do_retry(cntl):
             return False
-        cntl.current_attempt += 1
-        cntl.retried_count += 1
-        cntl.reset_for_retry()
+        owner = cntl.current_attempt if owner_attempt is None \
+            else owner_attempt
+        if not cntl.claim_retry(owner):
+            return False
         self._issue(st)
         return True
 
@@ -889,6 +943,6 @@ class Channel:
             return
         if cntl.current_attempt >= (cntl.max_retry or 0):
             return  # max_retry=0 disables backups too (single attempt only)
-        cntl.current_attempt += 1
-        cntl.retried_count += 1
+        if not cntl.claim_backup():
+            return
         self._issue(st)

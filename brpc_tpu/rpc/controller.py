@@ -186,6 +186,41 @@ class Controller:
             self.error_text = text or errors.describe(code)
             return True
 
+    def claim_retry(self, owner_attempt: int) -> bool:
+        """Atomically claim ownership of the NEXT attempt: succeeds iff
+        the call is not completed and `owner_attempt` is still current.
+        The winner bumps current_attempt and clears the failed
+        attempt's state (the reset_for_retry discipline) in the same
+        critical section.  Two failure paths racing to retry the same
+        attempt — the writer's failed-write path and the transport's
+        failed-socket callback — resolve here to exactly ONE retry
+        chain: the loser sees a stale attempt and stands down instead
+        of issuing a duplicate attempt (or burning the retry budget
+        twice and failing a call whose live attempt was about to
+        succeed)."""
+        with self._lock:
+            if self._completed or self.current_attempt != owner_attempt:
+                return False
+            self.current_attempt += 1
+            self.retried_count += 1
+            self.error_code = 0
+            self.error_text = ""
+            self.response_user_fields = {}
+            return True
+
+    def claim_backup(self) -> bool:
+        """Atomically take the next attempt number for a backup request
+        (no error-state reset — the primary attempt stays live and the
+        first response wins).  An unlocked += here would let a backup
+        and a concurrent retry claim share one version number, and the
+        stale-failure gates built on current_attempt stop gating."""
+        with self._lock:
+            if self._completed:
+                return False
+            self.current_attempt += 1
+            self.retried_count += 1
+            return True
+
     def reset_for_retry(self) -> None:
         # Guarded by the completion lock: a retry path that loses the
         # race to a concurrently-arriving completion (success response on

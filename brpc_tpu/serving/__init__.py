@@ -10,11 +10,17 @@ Three cooperating pieces (see README "Serving"):
     :class:`brpc_tpu.kvcache.KVCacheStore` for radix prefix reuse);
   * :func:`register_serving` (service.py) — server glue exposing
     ``Serving.Score`` (batched unary) and ``Serving.Generate``
-    (streaming decode) plus the chunked-HTTP generate route.
+    (streaming decode) plus the chunked-HTTP generate route;
+  * :class:`EngineSupervisor` (supervisor.py) — step-loop watchdog,
+    crash recovery (in-flight decode failover over the surviving KV
+    cache) and the overload degradation ladder; its ``submit`` has the
+    engine's signature so it drops into ``register_serving``
+    unchanged.
 
-Every live batcher/engine self-registers here (weakly, by name) so the
-``/serving`` builtin-console page can render batch occupancy, the slot
-map, and shed/pad statistics without holding components alive.
+Every live batcher/engine/supervisor self-registers here (weakly, by
+name) so the ``/serving`` builtin-console page can render batch
+occupancy, the slot map, shed/pad statistics, and supervisor state
+without holding components alive.
 """
 from __future__ import annotations
 
@@ -25,6 +31,8 @@ _reg_mu = threading.Lock()
 _batchers: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
 _engines: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+_supervisors: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
 
 
@@ -38,14 +46,22 @@ def _register_engine(e) -> None:
         _engines[e.name] = e
 
 
+def _register_supervisor(s) -> None:
+    with _reg_mu:
+        _supervisors[s.name] = s
+
+
 def serving_snapshot() -> dict:
     """Live components' stats — the /serving console page's data."""
     with _reg_mu:
         batchers = dict(_batchers)
         engines = dict(_engines)
+        supervisors = dict(_supervisors)
     return {
         "batchers": {name: b.stats() for name, b in sorted(batchers.items())},
         "engines": {name: e.stats() for name, e in sorted(engines.items())},
+        "supervisors": {name: s.stats()
+                        for name, s in sorted(supervisors.items())},
     }
 
 
@@ -54,3 +70,4 @@ from brpc_tpu.serving.engine import DecodeEngine  # noqa: E402,F401
 from brpc_tpu.serving.service import (  # noqa: E402,F401
     ServingService, http_generate_handler, register_serving,
 )
+from brpc_tpu.serving.supervisor import EngineSupervisor  # noqa: E402,F401

@@ -19,6 +19,14 @@ deadline.  An optional concurrency limiter (the same
 ``create_limiter`` specs servers use: int, "auto", "timeout[:ms]")
 gates queue depth the same way.
 
+BROWNOUT (``brownout`` attribute, set by an EngineSupervisor's
+degradation ladder): at level >= 1 the LOWEST-priority lane —
+deadline-less requests, the ones EDF already ranks last — is shed at
+admission with ELIMIT, so under overload the queue carries only work
+someone is waiting on with a deadline.  Shedding at admission (not at
+formation) keeps the refusal latency in microseconds, the same
+philosophy as the deadline-aware shed.
+
 PRIORITY LANES: batch formation is earliest-deadline-first within the
 batching window, not FIFO.  When more requests are queued than one
 batch holds, the FIFO head always takes one seat (bounded wait for
@@ -231,6 +239,7 @@ class DynamicBatcher:
         self.queue_delay_rec = LatencyRecorder(
             f"serving_{safe}_queue_delay")
         self.shed = Adder(f"serving_{safe}_shed")
+        self.brownout_shed = Adder(f"serving_{safe}_brownout_shed")
         self.n_batches = Adder(f"serving_{safe}_batches")
         self.n_completed = Adder(f"serving_{safe}_completed")
         self.n_errors = Adder(f"serving_{safe}_errors")
@@ -245,6 +254,10 @@ class DynamicBatcher:
             f"serving_{safe}_prefix_skip_ratio")
         self._bvar_names = [n for n in exposed_variables(f"serving_{safe}*")
                             if n not in _pre_bvars]
+
+        # overload-ladder level (0 = healthy), written by a supervisor;
+        # read once per enqueue — plain attribute, GIL-atomic
+        self.brownout = 0
 
         self._cv = threading.Condition()
         self._q: list[_Pending] = []
@@ -337,9 +350,19 @@ class DynamicBatcher:
                 return
         shed_code = 0
         shed_text = ""
+        brownout = 0
         with self._cv:
             if not self._running:
                 shed_code, shed_text = errors.ELOGOFF, "batcher closed"
+            elif self.brownout >= 1 and p.deadline_s is None:
+                # degradation ladder level >= 1: the lowest-priority
+                # lane (deadline-less — EDF already ranks it last) is
+                # refused at the door so the queue drains toward work
+                # with a deadline someone is actually waiting out
+                shed_code = errors.ELIMIT
+                shed_text = (f"brownout level {self.brownout}: "
+                             f"lowest-priority lane shed")
+                brownout = 1
             elif self.limiter is not None and not self.limiter.on_requested(
                     len(self._q) + 1):
                 # the SAME admission machinery servers use: limiter said
@@ -367,7 +390,10 @@ class DynamicBatcher:
         if shed_code != 0:
             if shed_code == errors.ELIMIT:
                 self.shed.add(1)
-                if self.limiter is not None:
+                if brownout:
+                    self.brownout_shed.add(1)
+                if self.limiter is not None and not brownout:
+                    # a brownout shed never consumed a limiter slot
                     self.limiter.on_responded(errors.ELIMIT, 0)
             self.n_errors.add(1)
             p.complete(shed_code, shed_text, None)
@@ -607,6 +633,8 @@ class DynamicBatcher:
             "completed": self.n_completed.get_value(),
             "errors": self.n_errors.get_value(),
             "shed": self.shed.get_value(),
+            "brownout": self.brownout,
+            "brownout_shed": self.brownout_shed.get_value(),
             "lane_promotions": self.lane_promotions.get_value(),
             "avg_batch_size": round(self.batch_size_rec.get_value(), 2),
             "pad_waste_ratio": self._pad_waste(),

@@ -37,12 +37,24 @@ dead HTTP peer) fills its buffer and is CUT with EOVERCROWDED at the
 next step boundary while every other slot keeps streaming; a raising
 ``emit`` retires just that request.  ``on_done(err)`` fires exactly
 once per request, success or failure, after its buffered tokens flush.
+
+Supervision (serving/supervisor.py): the step loop publishes a
+step-progress HEARTBEAT every iteration (suppressible by the
+``serving.heartbeat`` fault site so a wedged loop can be simulated
+deterministically).  With an ``on_crash`` handler installed, a step
+failure — including the ``serving.step`` fault site — does NOT retire
+the in-flight requests with errors: the loop stops with every slot
+intact and the handler is told, so a supervisor can ``takeover()`` the
+slots/waiters, re-attach their KV to the store, and re-admit them into
+a replacement engine.  Unsupervised engines keep the PR 2 behavior (a
+broken step function fails its requests definitively).
 """
 from __future__ import annotations
 
 import itertools
 import re
 import threading
+import time
 from collections import deque
 from typing import Callable, Optional, Sequence
 
@@ -165,6 +177,7 @@ class DecodeEngine:
                  emit_buffer: int = 256,
                  eos_token: Optional[int] = None,
                  max_new_tokens_cap: int = 65536,
+                 on_crash: Optional[Callable] = None,
                  name: str = "engine"):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -220,6 +233,18 @@ class DecodeEngine:
         self._bvar_names = [n for n in exposed_variables(f"serving_{safe}*")
                             if n not in pre]
 
+        # supervision state: the crash handler is told (with every slot
+        # left intact) instead of failing in-flight requests; the
+        # heartbeat lets a watchdog distinguish a busy loop from a
+        # wedged or dead one; degraded_clamp is the overload ladder's
+        # max_new_tokens brownout, applied to NEW submissions only
+        self._on_crash = on_crash
+        self._crashed: Optional[BaseException] = None
+        self._taken_over = False
+        self.degraded_clamp: Optional[int] = None
+        self._beat_steps = 0
+        self._beat_t = time.monotonic()
+
         self._cv = threading.Condition()
         self._slots: list[Optional[_Slot]] = [None] * self.num_slots
         self._waiters: deque[_Request] = deque()
@@ -238,13 +263,24 @@ class DecodeEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                emit: Callable[[int], None],
-               on_done: Optional[Callable] = None) -> int:
+               on_done: Optional[Callable] = None, *,
+               clamp: bool = True) -> int:
         """Queue a request; it is admitted into the step loop at the next
         step boundary with a free slot (in-flight requests are never
         restarted).  Returns the request id; terminal state arrives via
-        ``on_done(err)`` exactly once."""
-        req = _Request(prompt, min(int(max_new_tokens),
-                                   self.max_new_tokens_cap),
+        ``on_done(err)`` exactly once.  ``clamp=False`` exempts the
+        submission from the overload ladder's ``degraded_clamp`` — the
+        supervisor's crash re-admissions use it so a restart cannot
+        silently truncate a budget the request was already admitted
+        with."""
+        limit = self.max_new_tokens_cap
+        brownout = self.degraded_clamp
+        if clamp and brownout is not None:
+            # overload-ladder brownout: new requests get shorter
+            # generations so slots churn faster; in-flight requests
+            # keep the budget they were admitted with
+            limit = min(limit, int(brownout))
+        req = _Request(prompt, min(int(max_new_tokens), limit),
                        emit, on_done, self.emit_buffer)
         if req.max_new_tokens <= 0:
             req.finish(errors.RpcError(errors.EREQUEST,
@@ -325,15 +361,22 @@ class DecodeEngine:
                         self._slots[i] = slot
                         return (i, slot)
         # the engine closed while we leased (close() already drained the
-        # waiters deque, so nobody else will finish this request)
+        # waiters deque, so nobody else will finish this request).
+        # Under a TAKEOVER the prompt's pages are worth caching: the
+        # supervisor will resubmit this exact prompt, and the committed
+        # pages turn its re-admission into a prefix hit
+        taken = self._taken_over
         try:
             if block is not None:
                 block.free()
             if seq is not None:
-                self.store.retire(seq, cache=False)
+                self.store.retire(seq, cache=taken)
         except Exception:
             pass
-        req.finish(errors.RpcError(errors.ELOGOFF, "engine closed"))
+        req.finish(errors.RpcError(
+            errors.ELOGOFF,
+            "engine restarting (supervisor takeover)" if taken
+            else "engine closed"))
         return None
 
     # ---- emitter threads (one per admitted request) ----
@@ -407,6 +450,53 @@ class DecodeEngine:
 
     # ---- the step loop ----
 
+    def _touch_beat(self) -> None:
+        """Publish step-loop progress for the supervisor's watchdog.
+        The ``serving.heartbeat`` fault site SUPPRESSES the update —
+        the loop keeps running but reports no progress, which is
+        exactly what a wedged loop looks like from outside (so wedge
+        detection and takeover-from-a-live-loop are deterministically
+        testable without actually wedging a thread)."""
+        if fault.ENABLED and fault.hit(
+                "serving.heartbeat", name=self.name) is not None:
+            return
+        self._beat_steps += 1
+        self._beat_t = time.monotonic()
+
+    def heartbeat(self) -> tuple:
+        """(progress counter, monotonic time of the last beat)."""
+        return self._beat_steps, self._beat_t
+
+    def has_work(self) -> bool:
+        with self._cv:
+            return (self._admitting > 0 or bool(self._waiters)
+                    or any(s is not None for s in self._slots))
+
+    def set_crash_handler(self, fn: Optional[Callable]) -> None:
+        self._on_crash = fn
+
+    @property
+    def crashed(self) -> Optional[BaseException]:
+        return self._crashed
+
+    def _crash(self, exc: BaseException) -> None:
+        """Supervised step failure: stop the loop with every slot
+        INTACT (their requests are neither finished nor their KV
+        leases released — the supervisor takes both over) and tell the
+        crash handler.  Runs on the engine thread; the handler must
+        only signal (the supervisor's watchdog does the heavy
+        lifting)."""
+        with self._cv:
+            self._crashed = exc
+            self._running = False
+            self._cv.notify_all()
+        try:
+            self._on_crash(self, exc)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "engine crash handler raised")
+
     def _gather_page_tables(self, active) -> Optional[np.ndarray]:
         if not self._wants_pages:
             return None
@@ -422,6 +512,7 @@ class DecodeEngine:
     def _loop(self) -> None:
         import jax.numpy as jnp
         while True:
+            self._touch_beat()
             with self._cv:
                 if not self._running:
                     # close() retires in-flight slots (with ELOGOFF) after
@@ -440,6 +531,8 @@ class DecodeEngine:
                 i, s = installed
                 self._prefill(i, s)
                 self._start_emitter(s)
+                # a long cold prefill is PROGRESS, not a wedge
+                self._touch_beat()
             with self._cv:
                 if not self._running:
                     return
@@ -447,7 +540,10 @@ class DecodeEngine:
                           if s is not None]
                 if not active:
                     if not self._waiters:
-                        self._cv.wait()
+                        # bounded idle wait so the heartbeat keeps
+                        # ticking: an idle-but-alive loop must stay
+                        # distinguishable from a wedged one
+                        self._cv.wait(0.25)
                     continue
             tok = np.zeros((self.num_slots,), np.int32)
             pos = np.zeros((self.num_slots,), np.int32)
@@ -456,6 +552,9 @@ class DecodeEngine:
                 pos[i] = s.position
             pages = self._gather_page_tables(active)
             try:
+                if fault.ENABLED and fault.hit(
+                        "serving.step", name=self.name) is not None:
+                    raise RuntimeError("injected decode step crash")
                 if pages is not None:
                     out = np.asarray(self.step_fn(
                         jnp.asarray(tok), jnp.asarray(pos),
@@ -464,8 +563,15 @@ class DecodeEngine:
                     out = np.asarray(
                         self.step_fn(jnp.asarray(tok), jnp.asarray(pos)))
             except Exception as e:
-                # a broken step function must not wedge callers: retire
-                # every active request with a definite error
+                if self._on_crash is not None:
+                    # supervised: this is an ENGINE failure, not the
+                    # requests' — leave every slot intact for takeover
+                    # and re-admission into the replacement engine
+                    self._crash(e)
+                    return
+                # unsupervised: a broken step function must not wedge
+                # callers — retire every active request with a definite
+                # error
                 err = errors.RpcError(
                     errors.EINTERNAL,
                     f"decode step failed: {type(e).__name__}: {e}")
@@ -547,6 +653,26 @@ class DecodeEngine:
 
     # ---- lifecycle / introspection ----
 
+    def takeover(self) -> tuple:
+        """Stop a crashed/wedged engine WITHOUT completing its
+        requests: detach every in-flight slot and queued waiter so a
+        supervisor can re-attach their KV to the store and re-admit
+        them into a replacement engine.  Returns ``(slots, waiters)``
+        — the caller now OWNS each slot's KV lease (block or seq) and
+        each request's terminal notification.  Safe against a loop
+        thread still stuck inside ``step_fn``: its post-step writes
+        check slot identity, so a stolen slot's request can never
+        receive another token from the old loop."""
+        with self._cv:
+            self._running = False
+            self._taken_over = True
+            self._cv.notify_all()
+            stolen = [s for s in self._slots if s is not None]
+            for i in range(self.num_slots):
+                self._slots[i] = None
+            waiters, self._waiters = list(self._waiters), deque()
+        return stolen, waiters
+
     def active_count(self) -> int:
         with self._cv:
             return sum(1 for s in self._slots if s is not None)
@@ -616,6 +742,10 @@ class DecodeEngine:
             "emit_buffer": self.emit_buffer,
             "emit_cut": self.emit_cut.get_value(),
             "avg_step_occupancy": round(self.occupancy_rec.get_value(), 2),
+            "heartbeat_steps": self._beat_steps,
+            "heartbeat_age_s": round(time.monotonic() - self._beat_t, 3),
+            "crashed": self._crashed is not None,
+            "degraded_clamp": self.degraded_clamp,
         }
         if self.store is not None:
             out["kvcache"] = self.store.name

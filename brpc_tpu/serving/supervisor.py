@@ -1,0 +1,673 @@
+"""EngineSupervisor — crash recovery and overload degradation for the
+decode engine.
+
+bRPC's resilience machinery (health-check revival, circuit breaking,
+backup requests) lives at the CHANNEL boundary; the serving stack has
+its own failure domain with nothing watching it: if the DecodeEngine's
+step loop crashes or wedges mid-decode, every in-flight generation is
+lost even though their KV pages sit safely in a KVCacheStore that
+outlives the engine.  The supervisor closes that gap — "the framework
+heals itself" applied to the data path:
+
+WATCHDOG.  The engine publishes a step-progress heartbeat every loop
+iteration.  The supervisor flags a failure when (a) the engine's crash
+handler fires (a step exception, e.g. the ``serving.step`` fault
+site), (b) the loop thread has died, or (c) work is pending but the
+heartbeat has not advanced within ``heartbeat_deadline_s`` — a WEDGED
+loop (simulated deterministically by the ``serving.heartbeat`` fault
+site, which suppresses beats while the loop runs).
+
+RECOVERY.  On failure the supervisor takes over the engine's slots and
+waiters WITHOUT completing them, re-attaches each in-flight sequence's
+committed full pages to the radix tree under a recovery pin
+(``KVCacheStore.detach`` — pressure eviction cannot free the prefix
+before re-admission), tears the engine down, rebuilds a fresh
+``DecodeEngine`` against the SAME store, and re-admits every request
+resuming from its last emitted token: the resume prompt is
+``original_prompt + emitted_tokens``, so admission prefix-hits the
+committed pages and only the uncommitted tail re-decodes.
+Exactly-once emission holds across the seam by construction: the
+per-request emitted-token CURSOR advances only when a token reaches
+the consumer, tokens buffered at crash time flush through the old
+emitter before the restart marker, and the resumed decode starts
+after the cursor — no duplicated and no dropped tokens.
+
+DEGRADATION LADDER.  Each watchdog tick reads the batcher's queue
+delay, the engine's queue depth, and the page pool's occupancy, and
+maps them onto brownout levels:
+
+  level 1  shed the lowest-priority lane (deadline-less requests)
+           at batcher admission;
+  level 2  + clamp ``max_new_tokens`` for new engine submissions;
+  level 3  + aggressively evict cached (tree-only) KV pages each tick.
+
+Levels step UP immediately and step DOWN one at a time only after
+``hysteresis_ticks`` consecutive calm ticks, so an oscillating load
+cannot flap the ladder.
+
+FLAPPING REPLICAS.  Every crash is reported to the global circuit
+breaker; once ``quarantine_after`` crashes accumulate inside
+``restart_window_s`` the supervisor's advertised ``endpoint`` is
+marked broken with the breaker's exponential isolation hold — load
+balancers (including ``prefix_affinity``) stop selecting it, and the
+consistent-hash ring remaps ONLY the quarantined replica's share of
+prefixes.  After ``max_restarts`` crashes in the window the
+supervisor stops rebuilding and fails pending requests definitively
+(a permanently broken engine must not burn the machine rebuilding
+forever).
+
+``submit`` has the DecodeEngine signature, so a supervisor drops into
+``register_serving(engine=...)`` unchanged and the ``/serving``
+console page shows its state, restart count, and last recovery stats.
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from brpc_tpu import errors
+from brpc_tpu.bvar import Adder, PassiveStatus
+
+_sup_req_ids = itertools.count(1)
+
+# default ladder thresholds per level (1..3): queue-delay p99 (us),
+# page-pool occupancy ratio, engine queue depth per slot
+DEFAULT_LADDER = (
+    {"queue_delay_us": 50_000.0, "pool_ratio": 0.75, "queue_depth": 2.0},
+    {"queue_delay_us": 100_000.0, "pool_ratio": 0.88, "queue_depth": 4.0},
+    {"queue_delay_us": 200_000.0, "pool_ratio": 0.96, "queue_depth": 8.0},
+)
+
+
+class _SupReq:
+    """One supervised generation: the original request plus the
+    emitted-token cursor that makes recovery exactly-once."""
+
+    __slots__ = ("sid", "prompt", "max_new_tokens", "user_emit",
+                 "user_done", "emitted", "restarts", "finished", "pin",
+                 "resumed", "mu", "delivery_mu")
+
+    def __init__(self, prompt, max_new_tokens, emit, on_done):
+        self.sid = next(_sup_req_ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.user_emit = emit
+        self.user_done = on_done
+        self.emitted: list[int] = []   # the exactly-once cursor
+        self.restarts = 0
+        self.finished = False
+        self.pin = None                # RecoveryPin while re-admitting
+        # True between a post-crash re-admission and its first token:
+        # distinguishes the NEW engine's first token (recovery proven:
+        # release the pin, stamp time-to-recover) from pre-crash tokens
+        # still flushing out of the old emitter's buffer
+        self.resumed = False
+        self.mu = threading.Lock()
+        # serializes token delivery against the terminal: user_done
+        # must WAIT for an in-flight user_emit and no token may follow
+        # it.  Separate from `mu` (never held during user callbacks)
+        # and always acquired FIRST when both are needed.
+        self.delivery_mu = threading.Lock()
+
+
+class EngineSupervisor:
+    """Watchdog + crash recovery + overload ladder for a DecodeEngine
+    (see module docstring)."""
+
+    def __init__(self, engine_factory: Callable, *,
+                 store=None,
+                 batcher=None,
+                 heartbeat_deadline_s: float = 5.0,
+                 check_interval_s: float = 0.1,
+                 max_restarts: int = 8,
+                 restart_window_s: float = 60.0,
+                 quarantine_after: int = 3,
+                 endpoint=None,
+                 ladder: Sequence[dict] = DEFAULT_LADDER,
+                 clamp_new_tokens: int = 32,
+                 ladder_evict_pages: Optional[int] = None,
+                 hysteresis_ticks: int = 5,
+                 name: str = "supervisor"):
+        self.engine_factory = engine_factory
+        # the store is CALLER-owned and shared across engine
+        # incarnations — that is the whole point: radix-tree
+        # persistence across restarts makes recovery prefill-skip free
+        self.store = store
+        self.batcher = batcher
+        self.heartbeat_deadline_s = float(heartbeat_deadline_s)
+        self.check_interval_s = float(check_interval_s)
+        self.max_restarts = int(max_restarts)
+        self.restart_window_s = float(restart_window_s)
+        self.quarantine_after = int(quarantine_after)
+        self.endpoint = endpoint
+        self.ladder = tuple(ladder)
+        self.clamp_new_tokens = int(clamp_new_tokens)
+        self.ladder_evict_pages = ladder_evict_pages
+        self.hysteresis_ticks = int(hysteresis_ticks)
+        self.name = name
+
+        self.level = 0                  # current degradation level
+        self._calm_ticks = 0
+        self.state = "healthy"          # healthy|degraded|restarting|failed
+        self.last_recovery: Optional[dict] = None
+        self._restart_times: list[float] = []
+        self._await_first_token_t: Optional[float] = None
+
+        self._mu = threading.Lock()
+        self._live: dict[int, _SupReq] = {}      # sid -> request
+        self._by_rid: dict[int, _SupReq] = {}    # engine req_id -> request
+        self._closing = False
+        self._failed = False
+
+        safe = re.sub(r"\W", "_", name)
+        from brpc_tpu.bvar.variable import exposed_variables
+        pre = set(exposed_variables(f"serving_{safe}*"))
+        self.restarts_total = Adder(f"serving_{safe}_restarts")
+        self.readmitted = Adder(f"serving_{safe}_readmitted")
+        self.resumed_tokens = Adder(f"serving_{safe}_resumed_tokens")
+        self.ladder_evictions = Adder(f"serving_{safe}_ladder_evictions")
+        PassiveStatus(lambda: self.level).expose(
+            f"serving_{safe}_brownout_level")
+        self._bvar_names = [n for n in exposed_variables(f"serving_{safe}*")
+                            if n not in pre]
+
+        # engine handoff: _engine is None while a rebuild is in flight;
+        # re-admissions wait on the condition instead of failing
+        self._ecv = threading.Condition()
+        self._engine = None
+        self._wake = threading.Event()
+        self._running = True
+        self._engine = self._build_engine()
+        self._thread = threading.Thread(
+            target=self._watchdog, daemon=True,
+            name=f"serving-supervisor-{safe}")
+        self._thread.start()
+        from brpc_tpu import serving as _serving
+        _serving._register_supervisor(self)
+
+    # ---- engine lifecycle ----
+
+    def _build_engine(self):
+        eng = self.engine_factory()
+        eng.set_crash_handler(self._on_engine_crash)
+        eng.degraded_clamp = self.clamp_new_tokens if self.level >= 2 \
+            else None
+        return eng
+
+    def _on_engine_crash(self, engine, exc) -> None:
+        # runs on the dying engine thread: only signal the watchdog
+        self._wake.set()
+
+    def _engine_now(self, timeout_s: float = 30.0):
+        """The current engine, waiting out an in-flight rebuild."""
+        deadline = time.monotonic() + timeout_s
+        with self._ecv:
+            while self._engine is None and not self._failed \
+                    and not self._closing:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    return None
+                self._ecv.wait(rem)
+            return self._engine
+
+    @property
+    def engine(self):
+        return self._engine
+
+    # ---- submission (DecodeEngine-compatible) ----
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               emit: Callable[[int], None],
+               on_done: Optional[Callable] = None) -> int:
+        """Supervised generation: same contract as DecodeEngine.submit
+        — tokens via ``emit`` (exactly once each, across any number of
+        engine restarts), one terminal ``on_done(err)`` — plus
+        automatic re-admission if the engine dies mid-decode."""
+        # the ladder's clamp is decided ONCE, here: the budget a
+        # request is admitted with is the budget it keeps through any
+        # number of restarts (engine-level clamping is bypassed below,
+        # or a level-2 brownout at restart time would silently truncate
+        # an in-flight generation — and a brownout at ADMISSION time
+        # would silently un-clamp on the first restart)
+        if self.level >= 2:
+            max_new_tokens = min(int(max_new_tokens),
+                                 self.clamp_new_tokens)
+        sreq = _SupReq(prompt, max_new_tokens, emit, on_done)
+        with self._mu:
+            if self._closing or self._failed:
+                closing = True
+            else:
+                closing = False
+                self._live[sreq.sid] = sreq
+        if closing:
+            self._finish(sreq, errors.RpcError(
+                errors.ELOGOFF, "supervisor closed"))
+            return sreq.sid
+        self._submit_to_engine(sreq)
+        return sreq.sid
+
+    def _submit_to_engine(self, sreq: _SupReq) -> bool:
+        with sreq.mu:
+            emitted = list(sreq.emitted)
+        remaining = sreq.max_new_tokens - len(emitted)
+        if remaining <= 0:
+            # the full budget was generated before the crash: nothing
+            # to re-decode, the request simply completes
+            self._finish(sreq, None)
+            return True
+        eng = self._engine_now()
+        if eng is None:
+            self._finish(sreq, errors.RpcError(
+                errors.EINTERNAL,
+                "supervisor gave up rebuilding the engine"))
+            return False
+        # resume prompt = original + emitted: admission prefix-hits the
+        # pages detach() committed, so only the uncommitted tail
+        # re-decodes — and decode restarts from the exact (token,
+        # position) the crashed loop would have used next, making the
+        # resumed stream bit-exact for any position/token step function
+        with sreq.mu:
+            sreq.resumed = sreq.restarts > 0
+        rid = eng.submit(sreq.prompt + emitted, remaining,
+                         lambda tok, s=sreq: self._emit(s, tok),
+                         lambda err, s=sreq: self._req_done(s, err),
+                         clamp=False)
+        with self._mu:
+            self._by_rid[rid] = sreq
+        return True
+
+    # ---- per-request plumbing ----
+
+    def _emit(self, sreq: _SupReq, tok: int) -> None:
+        with sreq.delivery_mu:
+            with sreq.mu:
+                if sreq.finished:
+                    # terminal already delivered (close / give-up raced
+                    # a flushing old emitter): a token after on_done
+                    # would break every consumer's teardown contract
+                    return
+                sreq.emitted.append(tok)  # cursor first: delivered-once
+                first_resumed = sreq.resumed
+                sreq.resumed = False
+                pin = None
+                if first_resumed:
+                    # this token came from the REBUILT engine, so
+                    # admission has re-taken its own refs — the
+                    # recovery pin has done its job.  A pre-crash token
+                    # flushing from the old emitter proves nothing and
+                    # must keep the pin held.
+                    pin, sreq.pin = sreq.pin, None
+            if pin is not None:
+                pin.release()
+            if first_resumed:
+                t0 = self._await_first_token_t
+                if t0 is not None:
+                    self._await_first_token_t = None
+                    if self.last_recovery is not None:
+                        self.last_recovery["detect_to_first_token_ms"] \
+                            = round((time.monotonic() - t0) * 1e3, 2)
+            # delivered INSIDE delivery_mu (but outside the state
+            # lock): a concurrent _finish blocks on delivery_mu until
+            # this write lands, so the terminal can never overtake it
+            sreq.user_emit(tok)
+
+    def _req_done(self, sreq: _SupReq, err) -> None:
+        if err is not None and err.code == errors.ELOGOFF \
+                and not self._closing and not self._failed:
+            # the ENGINE died under this request, the request itself is
+            # fine: re-admit it, resuming after the emitted cursor.
+            # Bounded by the supervisor's own restart budget — a
+            # permanently-failing engine flips _failed and the next
+            # terminal passes through as a definite error.
+            with sreq.mu:
+                sreq.restarts += 1
+                give_up = sreq.restarts > self.max_restarts
+            if not give_up:
+                self.readmitted.add(1)
+                with sreq.mu:
+                    self.resumed_tokens.add(len(sreq.emitted))
+                self._submit_to_engine(sreq)
+                return
+        self._finish(sreq, err)
+
+    def _finish(self, sreq: _SupReq, err) -> None:
+        with sreq.delivery_mu:
+            # taking delivery_mu FIRST (same order as _emit) waits out
+            # an in-flight token delivery and fences later ones: once
+            # finished flips under the state lock below, _emit's
+            # check sees it before any further user_emit
+            with sreq.mu:
+                if sreq.finished:
+                    return
+                sreq.finished = True
+                pin, sreq.pin = sreq.pin, None
+            if pin is not None:
+                pin.release()
+            with self._mu:
+                self._live.pop(sreq.sid, None)
+            if sreq.user_done is not None:
+                try:
+                    sreq.user_done(err)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).exception(
+                        "supervised on_done callback raised")
+
+    # ---- the watchdog ----
+
+    def _watchdog(self) -> None:
+        while True:
+            self._wake.wait(self.check_interval_s)
+            self._wake.clear()
+            if not self._running:
+                return
+            eng = self._engine
+            reason = None
+            if eng is not None:
+                if eng.crashed is not None:
+                    reason = (f"step crash: "
+                              f"{type(eng.crashed).__name__}: "
+                              f"{eng.crashed}")
+                elif not eng._thread.is_alive():
+                    reason = "engine thread died"
+                else:
+                    _, beat_t = eng.heartbeat()
+                    age = time.monotonic() - beat_t
+                    if age > self.heartbeat_deadline_s and eng.has_work():
+                        reason = (f"wedged step loop: no progress for "
+                                  f"{age:.2f}s with work pending")
+            try:
+                if reason is not None:
+                    self._recover(reason)
+                if not self._running:
+                    return
+                self._update_degradation()
+            except Exception:
+                # the watchdog IS the robustness feature: it must
+                # survive its own bugs or the supervisor silently
+                # stops supervising
+                import logging
+                logging.getLogger(__name__).exception(
+                    "supervisor watchdog tick failed")
+
+    # ---- crash recovery ----
+
+    def _recover(self, reason: str) -> None:
+        t_detect = time.monotonic()
+        self.state = "restarting"
+        self.restarts_total.add(1)
+        self._restart_times.append(t_detect)
+        self._restart_times = [t for t in self._restart_times
+                               if t > t_detect - self.restart_window_s]
+        old = self._engine
+        with self._ecv:
+            self._engine = None         # re-admissions park on _engine_now
+        stolen, waiters = old.takeover()
+        restart_err = errors.RpcError(
+            errors.ELOGOFF, "engine restarting (supervisor takeover)")
+        pinned = 0
+        for slot in stolen:
+            with self._mu:
+                sreq = self._by_rid.pop(slot.req.req_id, None)
+            if slot.seq is not None and self.store is not None:
+                try:
+                    pin = self.store.detach(slot.seq)
+                except Exception:
+                    pin = None
+                if pin is not None and len(pin):
+                    pinned += 1
+                    if sreq is not None:
+                        with sreq.mu:
+                            old_pin, sreq.pin = sreq.pin, pin
+                            # new recovery epoch: tokens this engine
+                            # generation buffered-but-never-delivered
+                            # are about to flush, and they must not be
+                            # mistaken for the NEXT generation's first
+                            # token (premature pin release)
+                            sreq.resumed = False
+                        if old_pin is not None:
+                            old_pin.release()
+                    else:
+                        pin.release()   # nobody to re-admit (direct user)
+            elif slot.block is not None:
+                try:
+                    slot.block.free()
+                except Exception:
+                    pass
+            # the old emitter flushes every token already decoded into
+            # the buffer (the cursor counts them — they are NOT
+            # re-decoded), then delivers the restart marker, whose
+            # _req_done re-admits the request.  Emission stays a single
+            # ordered stream per request across the seam.  Emitters run
+            # on their own threads; their resubmissions park in
+            # _engine_now until the rebuild below lands.
+            slot.req.buf.push_terminal(restart_err)
+        with self._mu:
+            # any rid not stolen/queued (e.g. mid-admission) belongs to
+            # the dead engine too; its ELOGOFF terminal re-admits via
+            # the wrapper, the stale mapping must not linger
+            self._by_rid.clear()
+        old.close(timeout_s=1.0)
+        self._report_crash()
+        gave_up = len(self._restart_times) > self.max_restarts
+        if not gave_up:
+            try:
+                new = self._build_engine()
+            except Exception as e:
+                # a factory that cannot produce an engine strands every
+                # parked re-admission in _engine_now: fail DEFINITIVELY
+                # instead of leaving state 'restarting' forever
+                gave_up = True
+                reason = (f"{reason}; rebuild failed: "
+                          f"{type(e).__name__}: {e}")
+        if gave_up:
+            self._fail_permanently(reason)
+        else:
+            # stamp the recovery record BEFORE publishing the engine:
+            # parked re-admissions wake on the publish, and a fast
+            # first token must find _await_first_token_t/last_recovery
+            # already in place or the time-to-recover stat is lost
+            self.last_recovery = {
+                "reason": reason,
+                "stolen_slots": len(stolen),
+                "queued_waiters": len(waiters),
+                "pinned_seqs": pinned,
+                "detect_to_rebuild_ms": round(
+                    (time.monotonic() - t_detect) * 1e3, 2),
+            }
+            self._await_first_token_t = t_detect
+            with self._ecv:
+                self._engine = new
+                self._ecv.notify_all()
+            self.state = "degraded" if self.level else "healthy"
+        # finish the never-admitted waiters LAST: finish() runs the
+        # resubmission wrapper synchronously on THIS thread, which must
+        # not park in _engine_now before the rebuild above publishes
+        # the replacement engine (deadlock: the parked thread would be
+        # the one owing the rebuild)
+        for req in waiters:
+            req.finish(restart_err)
+
+    def _fail_permanently(self, reason: str) -> None:
+        """Too many crashes inside the window: stop rebuilding.  Every
+        pending request gets a definite error — a permanently broken
+        engine must fail fast, not rebuild forever."""
+        with self._ecv:
+            self._failed = True
+            self._ecv.notify_all()
+        self.state = "failed"
+        err = errors.RpcError(
+            errors.EINTERNAL,
+            f"engine supervisor gave up after "
+            f"{len(self._restart_times)} restarts in "
+            f"{self.restart_window_s:.0f}s: {reason}")
+        with self._mu:
+            live = list(self._live.values())
+        for sreq in live:
+            self._finish(sreq, err)
+
+    def _report_crash(self) -> None:
+        """Wire repeated crashes into the channel-level recovery stack:
+        the breaker's isolation counter grows per crash (so holds
+        double), and past `quarantine_after` crashes in the window the
+        replica's endpoint is marked broken — prefix_affinity and every
+        other balancer stop selecting it, remapping only ITS share of
+        the consistent-hash ring until the health probe revives it."""
+        if self.endpoint is None:
+            return
+        try:
+            from brpc_tpu.policy.circuit_breaker import global_breaker
+            breaker = global_breaker()
+            breaker.on_socket_failed(self.endpoint)   # isolation count +1
+            if len(self._restart_times) >= self.quarantine_after:
+                breaker.mark_as_broken(self.endpoint)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "supervisor crash report failed")
+
+    # ---- the degradation ladder ----
+
+    def _pressures(self) -> dict:
+        q_us = 0.0
+        if self.batcher is not None:
+            try:
+                q_us = float(
+                    self.batcher.queue_delay_rec.latency_percentile(0.99))
+            except Exception:
+                q_us = 0.0
+        pool = 0.0
+        if self.store is not None:
+            try:
+                st = self.store.pagepool.stats()
+                cap = st["max_blocks"] * st["pages_per_block"]
+                pool = st["pages_in_use"] / cap if cap else 0.0
+            except Exception:
+                pool = 0.0
+        depth = 0.0
+        eng = self._engine
+        if eng is not None:
+            try:
+                with eng._cv:
+                    queued = len(eng._waiters) + eng._admitting
+                depth = queued / max(1, eng.num_slots)
+            except Exception:
+                depth = 0.0
+        return {"queue_delay_us": q_us, "pool_ratio": pool,
+                "queue_depth": depth}
+
+    def _target_level(self, p: dict) -> int:
+        lvl = 0
+        for i, th in enumerate(self.ladder, start=1):
+            if any(p[k] >= th[k] for k in th):
+                lvl = i
+        return lvl
+
+    def _update_degradation(self) -> None:
+        p = self._pressures()
+        target = self._target_level(p)
+        if target > self.level:
+            self.level = target          # escalate immediately
+            self._calm_ticks = 0
+        elif target < self.level:
+            # de-escalate one level per `hysteresis_ticks` calm ticks:
+            # a load oscillating around a threshold must not flap the
+            # ladder (shedding churn is its own overload)
+            self._calm_ticks += 1
+            if self._calm_ticks >= self.hysteresis_ticks:
+                self.level -= 1
+                self._calm_ticks = 0
+        else:
+            self._calm_ticks = 0
+        self._apply_level()
+        if self.state in ("healthy", "degraded"):
+            self.state = "degraded" if self.level else "healthy"
+
+    def _apply_level(self) -> None:
+        lvl = self.level
+        if self.batcher is not None:
+            self.batcher.brownout = lvl
+        eng = self._engine
+        if eng is not None:
+            eng.degraded_clamp = self.clamp_new_tokens if lvl >= 2 \
+                else None
+        if lvl >= 3 and self.store is not None:
+            n = self.ladder_evict_pages
+            if n is None:
+                n = self.store.pagepool.pages_per_block
+            freed = self.store.evict_pages(n)
+            if freed:
+                self.ladder_evictions.add(freed)
+
+    # ---- lifecycle / introspection ----
+
+    def join_idle(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._mu:
+                if not self._live:
+                    return True
+            time.sleep(0.005)
+        return False
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop supervising and close the engine; pending requests
+        complete with ELOGOFF (passed through — a closing supervisor
+        does NOT re-admit).  The KV store stays up, caller-owned."""
+        self._closing = True
+        self._running = False
+        self._wake.set()
+        self._thread.join(timeout_s)
+        # undo the ladder's side effects on CALLER-owned components: a
+        # batcher that outlives its supervisor must not keep shedding
+        # its lowest lane forever with nothing left to de-escalate it
+        if self.batcher is not None:
+            self.batcher.brownout = 0
+        eng = self._engine
+        with self._ecv:
+            self._engine = None
+            self._ecv.notify_all()
+        if eng is not None:
+            eng.close(timeout_s)
+        # anything the engine close missed (e.g. mid-resubmission)
+        err = errors.RpcError(errors.ELOGOFF, "supervisor closed")
+        with self._mu:
+            live = list(self._live.values())
+        for sreq in live:
+            self._finish(sreq, err)
+        from brpc_tpu.bvar.variable import find_exposed
+        for n in self._bvar_names:
+            v = find_exposed(n)
+            if v is not None:
+                v.hide()
+
+    def stats(self) -> dict:
+        with self._mu:
+            live = len(self._live)
+        eng = self._engine
+        quarantined = False
+        if self.endpoint is not None:
+            try:
+                from brpc_tpu.policy.health_check import is_broken
+                quarantined = is_broken(self.endpoint)
+            except Exception:
+                pass
+        out = {
+            "state": self.state,
+            "degradation_level": self.level,
+            "restarts": self.restarts_total.get_value(),
+            "readmitted": self.readmitted.get_value(),
+            "resumed_tokens": self.resumed_tokens.get_value(),
+            "ladder_evictions": self.ladder_evictions.get_value(),
+            "live_requests": live,
+            "engine": None if eng is None else eng.name,
+            "heartbeat_deadline_s": self.heartbeat_deadline_s,
+            "last_recovery": self.last_recovery,
+            "quarantined": quarantined,
+        }
+        if self.endpoint is not None:
+            out["endpoint"] = str(self.endpoint)
+        return out

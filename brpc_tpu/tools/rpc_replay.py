@@ -74,7 +74,7 @@ def replay_one(ep, meta_bytes: bytes, body: bytes,
 
 class _NullChannel:
     """Replay has no retry/LB policy — a minimal channel stand-in."""
-    def _should_retry(self, st):
+    def _should_retry(self, st, owner_attempt=None):
         return False
 
     def _on_call_end(self, st):

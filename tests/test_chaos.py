@@ -729,6 +729,127 @@ def test_kvcache_exhaustion_mid_decode_exactly_once_and_baseline(seed):
         store.close()
 
 
+# ---------------------------------------------------------------------------
+# scenario 11: engine crash mid-decode -> supervised failover over the
+# surviving KV cache (ISSUE 4)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_engine_crash_midstream_failover_exactly_once(seed):
+    """Injected `serving.step` crash mid-decode under an
+    EngineSupervisor upholds the recovery invariants (ISSUE 4):
+
+    * every in-flight generation completes with an exactly-once,
+      BIT-EXACT token stream — no duplicated and no dropped token at
+      the restart seam (the emitted-token cursor + resume from the
+      last emitted token);
+    * recovery re-decodes STRICTLY fewer tokens than a from-scratch
+      replay whenever committed prefix pages existed: the detached
+      sequences' full pages are committed to the radix tree, so
+      re-admission prefix-hits and only the uncommitted tail
+      re-prefills (re-decoded-token ratio < 1.0);
+    * refcounts and BLOCK-POOL occupancy return to baseline once the
+      wave retires and the cache is dropped — recovery pins are
+      released, nothing leaks across the engine generations.
+    """
+    import jax
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine, EngineSupervisor
+
+    store = KVCacheStore(page_bytes=256, page_tokens=4, max_blocks=32,
+                         name=f"sup_chaos_kv{seed}")
+    device_pool = store.pagepool.pool
+
+    def occupancy():
+        with device_pool._lock:
+            return {c: len(device_pool._free[c])
+                    for c in device_pool._free}
+
+    free0 = occupancy()
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        # position-dependent: the resumed stream is bit-exact ONLY if
+        # recovery restores the exact (last token, position) cursor
+        return (tokens * 7 + positions) % 997
+
+    def expected(prompt, n):
+        last, pos, out = prompt[-1], len(prompt), []
+        for _ in range(n):
+            last = (last * 7 + pos) % 997
+            out.append(last)
+            pos += 1
+        return out
+
+    calm = ({"queue_delay_us": float("inf"), "pool_ratio": 9.9,
+             "queue_depth": 1e9},) * 3
+    sup = EngineSupervisor(
+        lambda: DecodeEngine(step, num_slots=3, store=store,
+                             max_pages_per_slot=32,
+                             name=f"sup_chaos_e{seed}"),
+        store=store, heartbeat_deadline_s=5.0, check_interval_s=0.02,
+        ladder=calm, name=f"sup_chaos{seed}")
+    try:
+        # warm the jit cache; commit the shared prefix by retiring one
+        # clean completion into the radix tree
+        shared = list(range(700, 708))           # two full pages
+        done = threading.Event()
+        sup.submit(shared + [1], 2, lambda t: None, lambda e: done.set())
+        assert done.wait(30)
+        assert sup.join_idle(10)
+        h0 = store.hit_tokens.get_value()
+        p0 = store.prompt_tokens.get_value()
+
+        plan = fault.FaultPlan(seed)
+        plan.on("serving.step", fault.ERROR, times=1, after=2)
+        n = 9
+        sinks = []
+        with fault.injected(plan):
+            for i in range(n):
+                ev = threading.Event()
+                toks: list = []
+                errs: list = []
+                sinks.append((ev, toks, errs))
+                sup.submit(shared + [800 + i], 6, toks.append,
+                           lambda e, ev=ev, errs=errs: (errs.append(e),
+                                                        ev.set()))
+            for ev, _, _ in sinks:
+                assert ev.wait(60), "generation hung across the restart"
+        assert plan.injected["serving.step"] == 1
+        st = sup.stats()
+        assert st["restarts"] == 1
+        assert st["last_recovery"]["stolen_slots"] >= 1
+        assert st["last_recovery"]["pinned_seqs"] >= 1, \
+            "no committed prefix pages pinned at takeover"
+        # exactly-once + bit-exact across the seam, for every request
+        for i, (ev, toks, errs) in enumerate(sinks):
+            assert errs == [None], f"req {i}: {errs}"
+            assert toks == expected(shared + [800 + i], 6), \
+                f"req {i}: stream diverged at the restart seam"
+        # re-decoded-token ratio < 1.0: a from-scratch replay would
+        # prefill every prompt token of every (re-)admission; the
+        # committed prefix pages made some of that compute a cache hit
+        dp = store.prompt_tokens.get_value() - p0
+        dh = store.hit_tokens.get_value() - h0
+        assert dp > 0
+        ratio = (dp - dh) / dp
+        assert ratio < 1.0, \
+            "recovery re-decoded as much as a from-scratch replay"
+        # baseline: pins released, sequences retired, cache dropped ->
+        # refcounts consistent and every HBM block back in the pool
+        assert sup.join_idle(10)
+        assert store.stats()["live_seqs"] == 0
+        store.clear()
+        store.pagepool.assert_consistent()
+        assert store.pagepool.blocks_leased() == 0
+        assert wait_until(lambda: occupancy() == free0, 10), \
+            f"KV blocks leaked across restart: {occupancy()} != {free0}"
+    finally:
+        sup.close()
+        store.close()
+
+
 class TestHealthCheckRevival:
     def test_probe_respects_isolation_hold_while_reachable(self, server):
         """The circuit breaker's isolation hold (_hold_until) must be

@@ -211,3 +211,63 @@ def test_every_console_route_answers(server):
         status, body = _get(server, path)
         assert status == 200, (path, status, body[:120])
         assert body, path
+
+
+def test_serving_page_shows_supervisor_state():
+    """/serving renders EngineSupervisor state (healthy/degraded
+    level/restarting), restart count, and last recovery stats alongside
+    the batcher/engine sections (ISSUE 4)."""
+    import threading
+
+    import jax
+
+    from brpc_tpu import fault
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine, EngineSupervisor
+
+    store = KVCacheStore(page_tokens=4, page_bytes=256, max_blocks=16,
+                         name="console_sup_kv")
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return tokens + 1
+
+    calm = ({"queue_delay_us": float("inf"), "pool_ratio": 9.9,
+             "queue_depth": 1e9},) * 3
+    sup = EngineSupervisor(
+        lambda: DecodeEngine(step, num_slots=2, store=store,
+                             max_pages_per_slot=16,
+                             name="console_sup_eng"),
+        store=store, heartbeat_deadline_s=5.0, check_interval_s=0.02,
+        ladder=calm, name="console_sup")
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        done = threading.Event()
+        sup.submit([1, 2, 3], 2, lambda t: None, lambda e: done.set())
+        assert done.wait(30)
+        status, body = _get(s, "/serving")
+        assert status == 200
+        snap = json.loads(body)
+        sv = snap["supervisors"]["console_sup"]
+        assert sv["state"] == "healthy"
+        assert sv["degradation_level"] == 0
+        assert sv["restarts"] == 0
+        assert sv["engine"] == "console_sup_eng"
+        # after an injected crash the page shows the recovery stats
+        plan = fault.FaultPlan(1).on("serving.step", fault.ERROR, times=1)
+        ev = threading.Event()
+        with fault.injected(plan):
+            sup.submit([5, 6, 7], 3, lambda t: None, lambda e: ev.set())
+            assert ev.wait(30)
+        status, body = _get(s, "/serving")
+        sv = json.loads(body)["supervisors"]["console_sup"]
+        assert sv["restarts"] == 1
+        assert sv["last_recovery"] is not None
+        assert "reason" in sv["last_recovery"]
+    finally:
+        s.stop()
+        s.join()
+        sup.close()
+        store.clear()
+        store.close()

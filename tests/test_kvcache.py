@@ -522,3 +522,102 @@ def test_console_kvcache_page():
         s.stop()
         s.join()
         st.close()
+
+
+# ---------------------------------------------------------------------------
+# fine-grained store locking (ISSUE 4 satellite / ROADMAP open item)
+# ---------------------------------------------------------------------------
+
+def test_slow_cold_admit_overlaps_concurrent_store_ops():
+    """The cold-admit device splice must NOT serialize the store: while
+    one thread admits a long uncached prompt through an artificially
+    slow page-write path, a concurrent acquire_prefix (the batcher's
+    formation-time trim) and a concurrent extend on a live sequence
+    both finish orders of magnitude sooner than the admit."""
+    st = _mk_store("t_finelock", max_blocks=32)
+    real_write = st.pagepool.write
+    try:
+        # a cached prefix for acquire_prefix to pin, and a live seq to
+        # extend, both created BEFORE the slow path is installed
+        warm = st.admit(list(range(8)))          # two full pages
+        st.retire(warm)                          # -> radix tree
+        live = st.admit([50, 51, 52])
+
+        slow_pages = 6
+
+        def slow_write(page, slot, tokens):
+            time.sleep(0.12)                     # "long device splice"
+            real_write(page, slot, tokens)
+
+        st.pagepool.write = slow_write
+        admit_done = threading.Event()
+        admitted = []
+
+        def cold_admit():
+            # 24 uncached tokens = 6 pages => >= 0.7s of device writes
+            admitted.append(st.admit([900 + i for i in range(slow_pages
+                                                             * PT)]))
+            admit_done.set()
+
+        t = threading.Thread(target=cold_admit)
+        t.start()
+        time.sleep(0.05)                         # admit is mid-splice
+        t0 = time.monotonic()
+        hit, pages = st.acquire_prefix(list(range(8)) + [77])
+        acq_s = time.monotonic() - t0
+        assert hit == 8 and len(pages) == 2
+        st.release(pages)
+        t1 = time.monotonic()
+        st.extend(live, 53)
+        ext_s = time.monotonic() - t1
+        assert not admit_done.is_set(), \
+            "admit finished too fast to prove overlap — slow path broken"
+        # both ops overlapped the admit instead of queuing behind it
+        assert acq_s < 0.35, \
+            f"acquire_prefix serialized behind cold admit ({acq_s:.2f}s)"
+        assert ext_s < 0.35, \
+            f"extend serialized behind cold admit ({ext_s:.2f}s)"
+        assert admit_done.wait(30)
+        st.pagepool.write = real_write
+        # the overlapped admit produced a correct sequence
+        seq = admitted[0]
+        assert seq.tokens == [900 + i for i in range(slow_pages * PT)]
+        assert st.pagepool.read(seq.pages[0]).tolist() == [900, 901,
+                                                           902, 903]
+        st.retire(seq, cache=False)
+        st.retire(live, cache=False)
+        st.pagepool.assert_consistent()
+    finally:
+        st.pagepool.write = real_write
+        st.close()
+
+
+def test_detach_commits_prefix_and_pins_against_eviction():
+    """KVCacheStore.detach (the crash-recovery re-attach API): a LIVE
+    sequence's full pages land in the radix tree atomically with a
+    recovery pin, so (a) a re-admit of the same tokens prefix-hits,
+    and (b) pressure eviction cannot free the pinned prefix before the
+    re-admit; releasing the pin makes the pages ordinarily
+    evictable."""
+    st = _mk_store("t_detach", max_blocks=4)
+    try:
+        seq = st.admit(list(range(10)))          # 2 full pages + tail
+        pin = st.detach(seq)
+        assert seq.retired and seq.pages == []
+        assert len(pin) == 2 and pin.tokens == 8
+        # committed: a re-admit hits the detached prefix
+        re = st.admit(list(range(10)) + [99])
+        assert re.prefix_hit_tokens == 8
+        st.retire(re, cache=False)
+        # pinned: refs==2 (tree + pin) -> eviction must skip them
+        freed = st.evict_pages(1 << 20)
+        assert freed == 0, "eviction freed a recovery-pinned page"
+        assert st.radix.node_count() == 2
+        pin.release()
+        pin.release()                            # idempotent
+        assert st.evict_pages(1 << 20) == 2
+        assert st.pagepool.blocks_leased() == 0
+        # detach on an already-retired seq is a no-op pin
+        assert len(st.detach(re)) == 0
+    finally:
+        st.close()
