@@ -79,6 +79,12 @@ kvcache: $(LIB) $(PYEXT)
 recovery: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q
 
+# Tracing suite (README "Observability"): rpcz generation tracing —
+# per-trace head sampling, span-tree timelines, TTFT/ITL math, trace
+# continuity across crash recovery, DCN span joins, console pages.
+trace: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q
+
 # Sanitizer stress targets (VERDICT r2 task 7; reference fights lock-free
 # races with stress tests + sanitizer builds, SURVEY.md §5.3).  The whole
 # native core + src/cc/test/stress_main.cc compile as ONE binary with the
@@ -108,4 +114,4 @@ stress:
 	    $(STRESS_SRC) -o build/stress_plain
 	./build/stress_plain
 
-.PHONY: all clean test chaos serving kvcache recovery tsan asan stress
+.PHONY: all clean test chaos serving kvcache recovery trace tsan asan stress

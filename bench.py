@@ -710,6 +710,81 @@ def bench_recovery(committed_ratios=(0.0, 0.5, 0.9), n_requests=6,
     return out
 
 
+def bench_trace_overhead(duration_s=1.0, threads=8, trials=3):
+    """Tracing-overhead rung (ISSUE 5): serving qps through the dynamic
+    batcher with rpcz OFF (the NULL_SPAN fast path every production
+    default rides), ON at sample rate 1.0 (every trace kept), and ON at
+    0.01 (per-trace head sampling).  Same jitter discipline as the
+    other rungs: `trials` runs per mode, median + spread.  The claim
+    under test: the disabled path costs nothing measurable, and
+    sampling bounds the enabled cost."""
+    import threading
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu import rpcz
+    from brpc_tpu.serving import DynamicBatcher
+
+    D = 128
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((D, D)).astype(np.float32))
+
+    @jax.jit
+    def score(x):
+        return jnp.tanh(x @ w).sum(axis=-1)
+
+    item = np.ones((D,), np.float32)
+    modes = (("off", False, 1.0), ("on_1.0", True, 1.0),
+             ("on_0.01", True, 0.01))
+
+    def one_trial(mode_k, on, rate, k):
+        b = DynamicBatcher(score, max_batch_size=16, max_delay_us=500,
+                           batch_buckets=(16,), length_buckets=(D,),
+                           name=f"bench_trace_{mode_k}_{k}")
+        try:
+            b.submit_wait(item, timeout_s=300)   # compile outside timing
+            rpcz.set_enabled(on, rate)
+            stop = time.monotonic() + duration_s
+            counts = [0] * threads
+
+            def worker(i):
+                while time.monotonic() < stop:
+                    b.submit_wait(item, timeout_s=60)
+                    counts[i] += 1
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(threads)]
+            t0 = time.monotonic()
+            [t.start() for t in ts]
+            [t.join(120) for t in ts]
+            return sum(counts) / (time.monotonic() - t0)
+        finally:
+            rpcz.set_enabled(False)
+            b.close()
+
+    out = {}
+    for mode_k, on, rate in modes:
+        qps = sorted(one_trial(mode_k, on, rate, k) for k in range(trials))
+        out[mode_k] = {
+            "qps": round(qps[len(qps) // 2], 1),
+            "qps_spread": [round(qps[0], 1), round(qps[-1], 1)],
+            "trials": trials,
+        }
+    base = out["off"]["qps"]
+    if base:
+        for mode_k, _, _ in modes[1:]:
+            out[mode_k]["overhead_pct_vs_off"] = round(
+                (base - out[mode_k]["qps"]) / base * 100.0, 2)
+    out["note"] = ("trace-overhead rung (brpc_tpu/rpcz): batcher qps "
+                   "with rpcz off / on@1.0 / on@0.01; 'off' rides the "
+                   "NULL_SPAN fast path — its spread vs the other "
+                   "modes bounds the cost of shipping the tracing "
+                   "hooks disabled")
+    return out
+
+
 def bench_hbm_stream(chunk_mb=64):
     """SECONDARY chip sanity number: raw on-chip HBM read+write bandwidth
     of a jitted roll+add loop.  No framework code runs here — this bounds
@@ -1451,6 +1526,15 @@ def main():
         except Exception as e:
             details["recovery"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['recovery']}")
+    log("bench: rpcz trace overhead...")
+    if not device_ok:
+        details["trace_overhead"] = {"skipped": True, "reason": device_err}
+    else:
+        try:
+            details["trace_overhead"] = bench_trace_overhead()
+        except Exception as e:
+            details["trace_overhead"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['trace_overhead']}")
     # each bench is isolated: a failure in one must not clobber another's
     # already-valid result
     for name, fn in (("tensor_pipe", lambda: bench_tensor_pipe(chunk_mb=64)),

@@ -165,7 +165,18 @@ def build_routes(server) -> dict:
     def rpcz_page(req):
         tid = req.query.get("trace_id")
         limit = int(req.query.get("limit", "50"))
-        spans = rpcz.recent_spans(limit, int(tid) if tid else None)
+        if tid:
+            # TIMELINE view (ISSUE 5): every collected span of ONE
+            # trace, tree-ordered with relative offsets — the
+            # generation-tracing read path (ingress -> batch -> prefill
+            # -> decode -> kv annotations -> post-crash continuation)
+            spans = rpcz.recent_spans(2048, int(tid))
+            if not spans:
+                spans = rpcz.load_disk_spans(2048, int(tid))
+            if not spans:
+                return f"no spans collected for trace {tid}\n"
+            return rpcz.format_trace(spans)
+        spans = rpcz.recent_spans(limit)
         lines = []
         for s in reversed(spans):
             lines.append(
@@ -175,8 +186,13 @@ def build_routes(server) -> dict:
                 f"{s.service}.{s.method} peer={s.remote_side} "
                 f"lat={s.latency_us}us req={s.request_size}B "
                 f"res={s.response_size}B err={s.error_code}"
+                + (f" recovered_from={s.recovered_from}"
+                   if s.recovered_from else "")
                 + ("".join(f"\n    @{t} {html.escape(m)}"
                            for t, m in s.annotations)))
+        lines.append("")
+        lines.append("(append ?trace_id=<id> for the tree-ordered "
+                     "timeline of one trace)")
         return "\n".join(lines) + "\n"
 
     def metrics(req):
@@ -268,6 +284,20 @@ def build_routes(server) -> dict:
                 and not snap.get("supervisors"):
             return "no serving components registered\n"
         return json.dumps(snap, indent=1), "application/json"
+
+    def serving_generations_page(req):
+        # per-request generation console (ISSUE 5): recent generations
+        # (TTFT, inter-token latency, prefill-skip, restart count, and
+        # the trace_id to paste into /rpcz?trace_id=) plus the aggregate
+        # serving_ttft_us / serving_itl_us percentiles.  Same lazy-
+        # import discipline as /serving.
+        import sys
+        if "brpc_tpu.serving" not in sys.modules:
+            return "no serving components registered\n"
+        from brpc_tpu.serving import generations_snapshot
+        limit = int(req.query.get("limit", "50"))
+        return json.dumps(generations_snapshot(limit), indent=1), \
+            "application/json"
 
     def kvcache_page(req):
         # paged-KV-cache introspection (brpc_tpu/kvcache): hit-rate,
@@ -437,6 +467,7 @@ def build_routes(server) -> dict:
         "/memory": memory,
         "/ici": ici,
         "/serving": serving_page,
+        "/serving/generations": serving_generations_page,
         "/kvcache": kvcache_page,
         "/hotspots": hotspots_index,
         "/hotspots/cpu": hotspots_cpu,

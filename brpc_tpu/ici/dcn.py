@@ -37,7 +37,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from brpc_tpu import errors, fault
+from brpc_tpu import errors, fault, rpcz
 from brpc_tpu.bvar import Adder
 from brpc_tpu.rpc.service import Service, method
 
@@ -323,6 +323,38 @@ class DcnService(Service):
         except Exception:
             cntl.set_failed(errors.EREQUEST, f"no local chip {chip}")
             return None
+        # device-execution span: joins the caller's trace.  The ingress
+        # span (this handler's server span) is preferred as parent when
+        # it already belongs to the trace the envelope names; otherwise
+        # the envelope's trace_id/parent_span_id/trace_sampled fields
+        # carry the join — the DCN call metadata path for deployments
+        # where the socket meta did not propagate the trace.
+        try:
+            env_tid = int(hdr.get("trace_id") or 0)
+            env_psid = int(hdr.get("parent_span_id") or 0)
+        except (TypeError, ValueError):
+            env_tid = env_psid = 0
+        cur = rpcz.get_current_span()
+        cur_tid = getattr(cur, "trace_id", 0) if cur is not None else 0
+        if cur_tid and (not env_tid or cur_tid == env_tid):
+            # the ingress span already belongs to the caller's trace
+            # (socket meta propagated): nest under it for a clean tree
+            span = rpcz.new_span("device", svc, meth,
+                                 trace_id=cur_tid,
+                                 parent_span_id=cur.span_id,
+                                 sampled=cur.sampled)
+        elif env_tid:
+            # the socket hop did NOT carry the caller's trace (the
+            # ingress span rooted a fresh local one, or rpcz is off on
+            # the transport path): the envelope is authoritative
+            span = rpcz.new_span("device", svc, meth,
+                                 trace_id=env_tid,
+                                 parent_span_id=env_psid,
+                                 sampled=bool(hdr.get("trace_sampled",
+                                                      True)))
+        else:
+            span = rpcz.new_span("device", svc, meth)
+        span.annotate(f"chip {chip}")
         peer_xfer = hdr.get("xfer")
         if peer_xfer and hdr.get("ticket") is not None:
             # ZERO-COPY request: pull the client's device buffers
@@ -331,13 +363,29 @@ class DcnService(Service):
             try:
                 placed = pull(peer_xfer, int(hdr["ticket"]),
                               hdr.get("specs") or [], dev)
+                span.annotate(f"zero-copy pull: ticket {hdr['ticket']}")
             except Exception as e:
+                span.error_code = errors.EINTERNAL
+                rpcz.submit(span)
                 cntl.set_failed(errors.EINTERNAL,
                                 f"DCN pull failed: {e}")
                 return None
         else:
-            placed = [jax.device_put(a, dev) for a in arrays]
-        out = fn(placed[0] if len(placed) == 1 else placed)
+            try:
+                placed = [jax.device_put(a, dev) for a in arrays]
+            except BaseException:
+                # the failing hop must still appear on the timeline —
+                # same discipline as the pull and execute paths
+                span.error_code = errors.EINTERNAL
+                rpcz.submit(span)
+                raise
+        try:
+            out = fn(placed[0] if len(placed) == 1 else placed)
+        except BaseException:
+            span.error_code = errors.EINTERNAL
+            rpcz.submit(span)
+            raise
+        rpcz.submit(span)
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
         resp_hdr = {"single": not isinstance(out, (list, tuple)),
                     "devices": [next(iter(o.devices())).id for o in outs]}
@@ -406,6 +454,32 @@ class DcnChannel:
 
     def call_sync(self, service: str, method_name: str, request: Any,
                   chip: Optional[int] = None):
+        # rpcz client span for the whole DCN call (handshake amortized,
+        # offer/pull/fallback annotated).  Installed as the CURRENT span
+        # for the duration, so the inner socket RPC's meta inherits this
+        # trace and the remote ingress span joins it; the control
+        # envelope ALSO carries the trace (trace_id/parent_span_id/
+        # trace_sampled header fields), so the remote device-execution
+        # span joins even where the socket meta does not follow.
+        span = rpcz.child_span("client", service, method_name)
+        span.remote_side = self.remote
+        if span is rpcz.NULL_SPAN:
+            return self._call_sync_traced(service, method_name, request,
+                                          chip, span)
+        prev = rpcz.get_current_span()
+        rpcz.set_current_span(span)
+        try:
+            return self._call_sync_traced(service, method_name, request,
+                                          chip, span)
+        except errors.RpcError as e:
+            span.error_code = e.code
+            raise
+        finally:
+            rpcz.set_current_span(prev)
+            rpcz.submit(span)
+
+    def _call_sync_traced(self, service: str, method_name: str,
+                          request: Any, chip: Optional[int], span):
         import jax
         if fault.ENABLED and fault.hit("dcn.call",
                                        remote=self.remote) is not None:
@@ -421,6 +495,13 @@ class DcnChannel:
         arrays = request if isinstance(request, (list, tuple)) else [request]
         header = {"svc": service, "method": method_name,
                   "chip": target_chip}
+        if span.trace_id:
+            # cross-host trace join (ISSUE 5): the control envelope
+            # carries the trace so the remote's device-execution span
+            # lands in THIS trace with the root's sampling decision
+            header["trace_id"] = span.trace_id
+            header["parent_span_id"] = span.span_id
+            header["trace_sampled"] = span.sampled
         ack_ticket = None
         with self._ack_mu:
             if self._unacked_resp is not None:
@@ -446,8 +527,12 @@ class DcnChannel:
             header["ticket"] = ticket
             header["specs"] = specs
             body = _pack_envelope(header, [])
+            span.annotate(f"zero-copy request: offered ticket {ticket}, "
+                          f"{len(jarrs)} device arrays")
         else:
             body = _pack_envelope(header, [np.asarray(a) for a in arrays])
+            span.annotate("host-serialized request (fallback data path)")
+        span.request_size = len(body)
         try:
             raw = self._ch.call_sync(DCN_SERVICE, "CallDevice", body,
                                      serializer="raw",
@@ -467,7 +552,10 @@ class DcnChannel:
                 # request to compute); on failure this unpins early
                 release_offer(ticket)
         hdr, out_arrays = _unpack_envelope(bytes(raw))
+        span.response_size = len(raw)
         if hdr.get("xfer") and hdr.get("ticket") is not None:
+            span.annotate(f"zero-copy response: pulling ticket "
+                          f"{hdr['ticket']}")
             # pull results straight onto the local device the request
             # came from (or the default device)
             local_dev = None

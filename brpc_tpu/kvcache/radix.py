@@ -107,12 +107,15 @@ class RadixTree:
 
     # ---- eviction ----
 
-    def evict(self, min_pages: int) -> int:
+    def evict(self, min_pages: int, span=None) -> int:
         """Free at least `min_pages` cached pages, LRU leaves first.
         Only pages with refcount 1 (tree-only) are candidates — a page
         an active/forked sequence still references is untouchable, as
         is every ancestor it pins.  Returns pages actually freed (may
-        be < min_pages when the tree runs out of evictable leaves)."""
+        be < min_pages when the tree runs out of evictable leaves).
+        ``span`` (the rpcz span of whoever forced the eviction — a
+        page-alloc retry under pool pressure) gets the freed page ids
+        annotated, so a timeline shows WHOSE cached prefixes paid."""
         if fault.ENABLED and fault.hit(
                 "kvcache.evict", tree=self.name) is not None:
             raise MemoryError("injected KV eviction failure")
@@ -140,6 +143,11 @@ class RadixTree:
                 pages = [v.page for v in victims]
             if not pages:
                 break
+            if span is not None and getattr(span, "trace_id", 0):
+                pids = [p.pid for p in pages[:8]]
+                span.annotate(
+                    f"kv evict: freed {len(pages)} LRU cached pages "
+                    f"(pids {pids}{'...' if len(pages) > 8 else ''})")
             # unref outside _mu: it may release whole blocks back to
             # the BlockPool (its own locking)
             for page in pages:

@@ -49,6 +49,7 @@ import re
 import threading
 from typing import Optional, Sequence
 
+from brpc_tpu import rpcz
 from brpc_tpu.bvar import Adder, PassiveStatus
 from brpc_tpu.kvcache.pages import KVPage, PagePool
 from brpc_tpu.kvcache.radix import RadixTree
@@ -84,7 +85,8 @@ class KVSeq:
     the page table covering them.  ``prefill_from`` is where compute
     must start — everything before it was served from shared pages."""
 
-    __slots__ = ("seq_id", "tokens", "pages", "prefill_from", "retired")
+    __slots__ = ("seq_id", "tokens", "pages", "prefill_from", "retired",
+                 "span")
 
     def __init__(self):
         self.seq_id = next(_seq_ids)
@@ -92,6 +94,11 @@ class KVSeq:
         self.pages: list[KVPage] = []
         self.prefill_from = 0
         self.retired = False
+        # the owning generation's rpcz span (ISSUE 5): KV events on this
+        # sequence — COW, page-alloc retries, pressure evictions, detach
+        # — annotate it.  NULL_SPAN when tracing is off: every annotate
+        # below is a guarded no-op.
+        self.span = rpcz.NULL_SPAN
 
     @property
     def prefix_hit_tokens(self) -> int:
@@ -141,11 +148,14 @@ class KVCacheStore:
 
     # ---- lifecycle ----
 
-    def admit(self, prompt: Sequence[int]) -> KVSeq:
+    def admit(self, prompt: Sequence[int], *,
+              span=None) -> KVSeq:
         """Start a sequence for `prompt`: its longest cached prefix is
         served by SHARED pages (capped at len(prompt)-1 so at least one
         token always computes — the model needs the last position's
-        output), fresh pages hold the suffix's KV."""
+        output), fresh pages hold the suffix's KV.  ``span`` (an rpcz
+        span) becomes the sequence's owning span: prefix hit/miss, COW,
+        eviction and page-alloc-retry events annotate it."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -156,12 +166,19 @@ class KVCacheStore:
             max_chunks = (len(prompt) - 1) // self.page_tokens
             shared = self.radix.match(prompt, max_chunks=max_chunks)
             seq = KVSeq()
+            if span is not None:
+                seq.span = span
             for p in shared:
                 self.pagepool.ref(p)
                 seq.pages.append(p)
         hit = len(shared) * self.page_tokens
         seq.tokens = prompt[:hit]
         seq.prefill_from = hit
+        if seq.span is not rpcz.NULL_SPAN:
+            seq.span.annotate(
+                f"kv admit: prefix_hit={hit}/{len(prompt)} tokens "
+                f"({len(shared)} shared pages)" if hit else
+                f"kv admit: prefix miss ({len(prompt)} tokens uncached)")
         try:
             # the cold-admit device splice runs OUTSIDE the store lock
             # (ROADMAP open item): the suffix pages are exclusively
@@ -259,6 +276,11 @@ class KVCacheStore:
             self.detached.add(1)
             self.retired.add(1)
             self._live -= 1
+            if seq.span is not rpcz.NULL_SPAN:
+                seq.span.annotate(
+                    f"kv detach: {nfull} full pages committed to the "
+                    f"radix tree, {len(pinned)} pinned for recovery "
+                    f"({len(pinned) * self.page_tokens} tokens)")
             return RecoveryPin(self, pinned,
                                len(pinned) * self.page_tokens)
 
@@ -276,7 +298,7 @@ class KVCacheStore:
             pos = len(seq.tokens)
             slot = pos % self.page_tokens
             if slot == 0:
-                seq.pages.append(self._alloc_page())
+                seq.pages.append(self._alloc_page(span=seq.span))
             else:
                 tail = seq.pages[-1]
                 if tail.refs > 1:
@@ -285,7 +307,11 @@ class KVCacheStore:
                     # corrupt the other holder's KV.  Copy device-to-
                     # device, swap our table entry, drop our ref on the
                     # shared page.
-                    fresh = self._alloc_page()
+                    if seq.span is not rpcz.NULL_SPAN:
+                        seq.span.annotate(
+                            f"kv cow: tail page {tail.pid} shared "
+                            f"(refs={tail.refs}), copied before write")
+                    fresh = self._alloc_page(span=seq.span)
                     try:
                         self.pagepool.copy_page(fresh, tail)
                     except BaseException:
@@ -300,7 +326,7 @@ class KVCacheStore:
             seq.tokens.extend(run)
             idx += k
 
-    def _alloc_page(self) -> KVPage:
+    def _alloc_page(self, span=None) -> KVPage:
         """Page allocation with pressure-driven eviction: on
         exhaustion, evict one block's worth of LRU leaves from the
         radix tree and retry — LOOPING while eviction keeps freeing,
@@ -311,15 +337,21 @@ class KVCacheStore:
         tree is genuinely dry.  Each evict runs under the store lock —
         every eviction path does, so a concurrent
         admit/acquire_prefix can never ref a page eviction is mid-way
-        through freeing."""
+        through freeing.  ``span`` (the allocating sequence's owning
+        rpcz span) gets one annotation per retry — a slow extend under
+        pool pressure shows WHY on the timeline."""
         while True:
             try:
                 return self.pagepool.alloc_page()
             except MemoryError:
                 with self._mu:
                     freed = self.radix.evict(
-                        self.pagepool.pages_per_block)
+                        self.pagepool.pages_per_block, span=span)
                 self.evictions.add(freed)
+                if span is not None and span is not rpcz.NULL_SPAN:
+                    span.annotate(
+                        f"kv page_alloc retry: pool exhausted, evicted "
+                        f"{freed} LRU cached pages")
                 if freed == 0:
                     raise
 

@@ -718,11 +718,14 @@ class Channel:
                 meta.user_fields[M.F_SDEV] = rail.device_advert(
                     stream.device)
 
-        # rpcz span
-        from brpc_tpu.rpcz import current_trace
-        tid, sid_ = current_trace()
+        # rpcz span (the sampled bit rides a meta flag so the callee
+        # inherits the trace-root decision instead of re-rolling)
+        from brpc_tpu.rpcz import current_trace_ctx
+        tid, sid_, smp = current_trace_ctx()
         meta.trace_id = cntl.trace_id = tid
         meta.span_id = cntl.span_id = sid_
+        if tid and smp:
+            meta.flags |= M.FLAG_TRACE_SAMPLED
 
         st = _CallState(cntl, self, meta, body, done)
         st.rail_obj = rail_obj

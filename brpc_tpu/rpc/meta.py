@@ -23,6 +23,13 @@ MSG_STREAM_DATA = 2
 MSG_STREAM_FEEDBACK = 3
 MSG_STREAM_CLOSE = 4
 
+# flags bits (u16 in the fixed header)
+# rpcz head-sampling is decided ONCE at the trace root and inherited by
+# every span of the trace; this bit carries the decision across the wire
+# alongside T_TRACE_ID so a cascaded server keeps (or drops) the WHOLE
+# trace instead of re-rolling per hop
+FLAG_TRACE_SAMPLED = 0x0001
+
 _FIXED = struct.Struct("<BBHQH")
 
 # TLV tags

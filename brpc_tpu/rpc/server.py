@@ -799,7 +799,13 @@ class Server:
 
         span = rpcz.new_span("server", meta.service, meta.method,
                              trace_id=meta.trace_id,
-                             parent_span_id=meta.span_id)
+                             parent_span_id=meta.span_id,
+                             # a joined trace inherits the root's
+                             # head-sampling decision from the wire;
+                             # a fresh trace (no id) decides locally
+                             sampled=bool(meta.flags
+                                          & M.FLAG_TRACE_SAMPLED)
+                             if meta.trace_id else None)
         cntl = Controller()
         cntl.is_server_side = True
         cntl.request_meta = meta

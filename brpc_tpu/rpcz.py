@@ -57,8 +57,15 @@ class Span:
     request_size: int = 0
     response_size: int = 0
     error_code: int = 0
-    kind: str = "server"        # server | client
+    kind: str = "server"   # server | client | batch | prefill | decode |
+    #                        generation | device (serving/DCN stage spans)
     annotations: list = field(default_factory=list)
+    # head-sampling decision, made ONCE at the trace root and inherited
+    # by every child (per-TRACE sampling: a kept trace has no holes)
+    sampled: bool = True
+    # crash-recovery link: the span_id of the pre-crash attempt this
+    # span resumes (supervisor re-admission) — 0 when not a resumption
+    recovered_from: int = 0
 
     @property
     def latency_us(self) -> int:
@@ -86,6 +93,8 @@ class _NullSpan:
     remote_side = ""
     kind = ""
     annotations = ()
+    sampled = True
+    recovered_from = 0
 
     def __setattr__(self, k, v):
         pass
@@ -101,15 +110,50 @@ def now_us() -> int:
     return int(time.time() * 1e6)
 
 
+def enabled() -> bool:
+    return _enabled
+
+
+def sample_rate() -> float:
+    return _sample_rate
+
+
 def new_span(kind: str, service: str = "", method: str = "",
-             trace_id: int = 0, parent_span_id: int = 0) -> Span:
+             trace_id: int = 0, parent_span_id: int = 0,
+             sampled: bool | None = None) -> Span:
+    """Create a span.  Head sampling is PER-TRACE: a fresh root (no
+    trace_id) rolls the sample-rate die exactly once; a span joining an
+    existing trace inherits the root's decision — either from the
+    explicit ``sampled`` argument (wire propagation: the
+    FLAG_TRACE_SAMPLED meta bit, the DCN envelope) or from the current
+    span when it belongs to the same trace.  A kept trace therefore
+    arrives whole; a dropped one leaves nothing, never holes."""
     if not _enabled:
         return NULL_SPAN
+    if sampled is None:
+        if trace_id:
+            cur = _current_span.get()
+            sampled = cur.sampled if (cur is not None
+                                      and cur.trace_id == trace_id) else True
+        else:
+            sampled = _sample_rate >= 1.0 or random.random() <= _sample_rate
     s = Span(kind=kind, service=service, method=method,
              trace_id=trace_id or random.getrandbits(63),
              span_id=next(_span_counter),
-             parent_span_id=parent_span_id, start_us=now_us())
+             parent_span_id=parent_span_id, start_us=now_us(),
+             sampled=bool(sampled))
     return s
+
+
+def child_span(kind: str, service: str = "", method: str = "") -> Span:
+    """A span under the CURRENT span (trace id, parentage and sampling
+    inherited); a fresh root when no span is current.  The serving
+    layers use this to hang stage spans off the RPC ingress span."""
+    if not _enabled:
+        return NULL_SPAN
+    tid, psid, smp = current_trace_ctx()
+    return new_span(kind, service, method, trace_id=tid,
+                    parent_span_id=psid, sampled=smp if tid else None)
 
 
 def set_current_span(span: Span | None) -> None:
@@ -127,6 +171,17 @@ def current_trace() -> tuple[int, int]:
     if s is None or not s.trace_id:
         return 0, 0
     return s.trace_id, s.span_id
+
+
+def current_trace_ctx() -> tuple[int, int, bool]:
+    """(trace_id, parent_span_id, sampled) — current_trace plus the
+    root's head-sampling decision, for callers that carry trace context
+    across threads (the batcher queue, the decode slot pool, DCN call
+    metadata) where the contextvar does not follow."""
+    s = get_current_span()
+    if s is None or not s.trace_id:
+        return 0, 0, True
+    return s.trace_id, s.span_id, s.sampled
 
 
 # ---- on-disk SpanDB (reference span.h:227-230 keeps rpcz spans in an
@@ -194,6 +249,7 @@ def _db_append_locked(span: Span) -> None:
         "request_size": span.request_size,
         "response_size": span.response_size,
         "error_code": span.error_code, "kind": span.kind,
+        "recovered_from": span.recovered_from,
         "annotations": list(span.annotations)}).encode()
     _db_writer.write(rec)
     # no per-span flush: a write(2) per span would defeat buffering; the
@@ -268,7 +324,10 @@ class _SpanSample:
 def submit(span: Span) -> None:
     if not _enabled or span is NULL_SPAN:
         return
-    if _sample_rate < 1.0 and random.random() > _sample_rate:
+    if not span.sampled:
+        # the head-sampling decision was made at the TRACE root and
+        # inherited (new_span); dropping here keeps whole traces —
+        # re-rolling per span would leave a kept trace with holes
         return
     span.end_us = span.end_us or now_us()
     from brpc_tpu.bvar.collector import Collector, get_or_create_limit
@@ -294,3 +353,81 @@ def traceprintf(msg: str) -> None:
     s = get_current_span()
     if s is not None:
         s.annotate(msg)
+
+
+# ---- timeline reconstruction (the /rpcz?trace_id= tree view and
+# rpc_press --dump-traces both render one trace as an indented,
+# time-offset span tree) ----
+
+def trace_tree(spans: list[Span]) -> list[tuple[int, int, Span]]:
+    """Order one trace's spans as a tree: ``[(depth, offset_us, span)]``
+    with offsets relative to the trace's earliest start.  Children sort
+    under their parent by start time; a span whose parent was not
+    collected (sampling off at that hop, eviction from the bounded
+    store) surfaces as an extra root rather than disappearing."""
+    spans = sorted(spans, key=lambda s: (s.start_us, s.span_id))
+    if not spans:
+        return []
+    by_id = {s.span_id: s for s in spans}
+    children: dict[int, list[Span]] = {}
+    roots: list[Span] = []
+    for s in spans:
+        p = s.parent_span_id
+        if p and p in by_id and p != s.span_id:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    t0 = spans[0].start_us
+    out: list[tuple[int, int, Span]] = []
+
+    def walk(s: Span, depth: int) -> None:
+        out.append((depth, s.start_us - t0, s))
+        for c in children.get(s.span_id, ()):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return out
+
+
+def format_trace(spans: list[Span], indent: str = "  ") -> str:
+    """Human-readable timeline for ONE trace: tree-ordered spans with
+    relative start offsets, per-span latency, recovery links, and the
+    annotations at their relative timestamps."""
+    tree = trace_tree(spans)
+    if not tree:
+        return "no spans\n"
+    t0 = min(s.start_us for _, _, s in tree)
+    total = max(s.end_us for _, _, s in tree) - t0
+    lines = [f"trace {tree[0][2].trace_id} — {len(tree)} spans, "
+             f"{total}us total"]
+    for depth, off, s in tree:
+        pad = indent * depth
+        link = f" recovered_from=span {s.recovered_from}" \
+            if s.recovered_from else ""
+        err = f" err={s.error_code}" if s.error_code else ""
+        lines.append(
+            f"{pad}+{off}us [{s.kind}] {s.service}.{s.method} "
+            f"span={s.span_id} {s.latency_us}us{err}{link}"
+            + (f" peer={s.remote_side}" if s.remote_side else ""))
+        for t, msg in s.annotations:
+            lines.append(f"{pad}{indent}@+{max(0, t - t0)}us {msg}")
+    return "\n".join(lines) + "\n"
+
+
+def slowest_traces(spans: list[Span], n: int = 3) -> list[list[Span]]:
+    """Group `spans` by trace and return the n slowest traces (by their
+    root span's latency; widest span when no root was collected),
+    slowest first — the rpc_press --dump-traces selection."""
+    by_trace: dict[int, list[Span]] = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s)
+
+    def root_latency(group: list[Span]) -> int:
+        ids = {s.span_id for s in group}
+        roots = [s for s in group
+                 if not s.parent_span_id or s.parent_span_id not in ids]
+        return max(s.latency_us for s in roots or group)
+
+    ranked = sorted(by_trace.values(), key=root_latency, reverse=True)
+    return ranked[:n]

@@ -21,11 +21,19 @@ Every live batcher/engine/supervisor self-registers here (weakly, by
 name) so the ``/serving`` builtin-console page can render batch
 occupancy, the slot map, shed/pad statistics, and supervisor state
 without holding components alive.
+
+GENERATION TIMELINE (ISSUE 5): every retired decode attempt (engine)
+and every completed supervised generation (supervisor) appends a
+summary record to a bounded ring here — request/trace ids, TTFT,
+inter-token latency, prefill-skip, restart count — which the
+``/serving/generations`` console page renders alongside the aggregate
+``serving_ttft_us`` / ``serving_itl_us`` recorders.
 """
 from __future__ import annotations
 
 import threading
 import weakref
+from collections import deque
 
 _reg_mu = threading.Lock()
 _batchers: "weakref.WeakValueDictionary[str, object]" = \
@@ -62,6 +70,62 @@ def serving_snapshot() -> dict:
         "engines": {name: e.stats() for name, e in sorted(engines.items())},
         "supervisors": {name: s.stats()
                         for name, s in sorted(supervisors.items())},
+    }
+
+
+# ---- recent-generation ring (the /serving/generations console page) ----
+
+_GEN_KEEP = 256
+_gen_mu = threading.Lock()
+_recent_gens: deque = deque(maxlen=_GEN_KEEP)
+
+
+def record_generation(rec: dict) -> None:
+    """Append one finished generation/attempt summary (bounded ring)."""
+    with _gen_mu:
+        _recent_gens.append(rec)
+
+
+def recent_generations(limit: int = 50) -> list[dict]:
+    with _gen_mu:
+        gens = list(_recent_gens)
+    return gens[-limit:]
+
+
+def generations_snapshot(limit: int = 50) -> dict:
+    """The /serving/generations page data: aggregate TTFT/ITL
+    percentiles from the global recorders, prefill-skip over the recent
+    window, supervisor recovery counts, and the recent records
+    themselves (newest last)."""
+    from brpc_tpu.serving.engine import ITL_REC, TTFT_REC
+    recent = recent_generations(limit)
+    # skip-ratio over ENGINE attempt records only (they carry
+    # prefix_hit); supervisor rows describe the same generations again
+    # and would double-count every prompt in the denominator
+    prompt = sum(r["prompt_len"] for r in recent if "prefix_hit" in r)
+    hit = sum(r["prefix_hit"] for r in recent if "prefix_hit" in r)
+    with _reg_mu:
+        supervisors = dict(_supervisors)
+    recoveries = sum(s.restarts_total.get_value()
+                     for s in supervisors.values())
+    return {
+        "aggregates": {
+            "ttft_us": {
+                "count": TTFT_REC.count(),
+                "avg": round(TTFT_REC.latency(), 1),
+                "p50": round(TTFT_REC.latency_percentile(0.5), 1),
+                "p99": round(TTFT_REC.latency_percentile(0.99), 1),
+            },
+            "itl_us": {
+                "count": ITL_REC.count(),
+                "avg": round(ITL_REC.latency(), 1),
+                "p50": round(ITL_REC.latency_percentile(0.5), 1),
+                "p99": round(ITL_REC.latency_percentile(0.99), 1),
+            },
+            "prefill_skip_ratio": round(hit / prompt, 4) if prompt else 0.0,
+            "recoveries": recoveries,
+        },
+        "recent": recent,
     }
 
 

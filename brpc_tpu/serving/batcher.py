@@ -61,7 +61,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from brpc_tpu import errors, fault
+from brpc_tpu import errors, fault, rpcz
 from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
 
 # default sequence-length buckets: small fixed ladder so any raw length
@@ -111,7 +111,7 @@ class _Pending:
     both)."""
 
     __slots__ = ("item", "length", "skip", "enqueue_t", "deadline_s",
-                 "_fire", "_fired", "_mu")
+                 "span", "_fire", "_fired", "_mu")
 
     def __init__(self, item: np.ndarray, length: int,
                  deadline_s: Optional[float],
@@ -121,6 +121,11 @@ class _Pending:
         self.skip = 0              # prefix tokens served from KV cache
         self.enqueue_t = time.monotonic()
         self.deadline_s = deadline_s
+        # per-request batch span (ISSUE 5): opened at enqueue under the
+        # caller's trace (the RPC ingress span), so queue delay is the
+        # span's head and shed/promotion/trim decisions annotate it;
+        # NULL_SPAN when rpcz is off
+        self.span = rpcz.NULL_SPAN
         self._fire = fire
         self._fired = False
         self._mu = threading.Lock()
@@ -130,6 +135,14 @@ class _Pending:
             if self._fired:
                 return
             self._fired = True
+        span = self.span
+        if span is not rpcz.NULL_SPAN:
+            # exactly-once completion also finalizes the span exactly
+            # once (the _fired guard above is the submission guard)
+            if code:
+                span.error_code = code
+                span.annotate(f"completed with error {code}: {text}")
+            rpcz.submit(span)
         try:
             self._fire(code, text, result)
         except Exception:
@@ -321,6 +334,11 @@ class DynamicBatcher:
         if arr.ndim == 0:
             arr = arr.reshape(1)
         p = _Pending(arr, 0, deadline_s, fire)
+        # spans inherit the enqueuing thread's trace (the RPC ingress
+        # span when coming through submit()); assigned before ANY
+        # complete() path so every outcome — shed, reject, scatter —
+        # finalizes it
+        p.span = rpcz.child_span("batch", "Serving", self.name)
         if arr.ndim != 1:
             p.complete(errors.EREQUEST,
                        f"batcher items must be 1-D, got shape {arr.shape}",
@@ -455,6 +473,12 @@ class DynamicBatcher:
         promoted = sum(1 for i in take if i > first_left)
         if promoted:
             self.lane_promotions.add(promoted)
+            for i in take:
+                if i > first_left and \
+                        self._q[i].span is not rpcz.NULL_SPAN:
+                    self._q[i].span.annotate(
+                        "lane promotion: EDF selected this request "
+                        "ahead of an earlier-enqueued one")
         batch = [self._q[i] for i in take]
         for i in reversed(take):
             del self._q[i]
@@ -475,7 +499,11 @@ class DynamicBatcher:
                 p.complete(errors.ELIMIT,
                            "deadline expired before batch formation", None)
             else:
-                self.queue_delay_rec.add(int((now - p.enqueue_t) * 1e6))
+                qd_us = int((now - p.enqueue_t) * 1e6)
+                self.queue_delay_rec.add(qd_us)
+                if p.span is not rpcz.NULL_SPAN:
+                    p.span.annotate(f"batch formed: queue_delay_us={qd_us}"
+                                    f" members={len(batch)}")
                 live.append(p)
         if not live:
             return
@@ -514,6 +542,10 @@ class DynamicBatcher:
             pinned.extend(pages)
             hit = max(0, min(hit, p.length - 1))
             if hit:
+                if p.span is not rpcz.NULL_SPAN:
+                    p.span.annotate(
+                        f"kv prefix trim: {hit}/{p.length} tokens served "
+                        f"from {len(pages)} pinned cached pages")
                 p.skip = hit
                 p.item = p.item[hit:]
                 p.length -= hit

@@ -60,10 +60,21 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from brpc_tpu import errors, fault
-from brpc_tpu.bvar import Adder, IntRecorder, PassiveStatus
+from brpc_tpu import errors, fault, rpcz
+from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
 
 _req_ids = itertools.count(1)
+
+# Serving-wide latency recorders (ISSUE 5): TTFT (submit -> first token
+# reaching the emit buffer), inter-token latency, and the per-stage
+# breakdown (queue = submit -> slot install, prefill, decode = install
+# -> retire).  LatencyRecorder exposes *_latency/_qps/_count and the
+# percentile ladder, so /brpc_metrics scrapes them with no extra glue.
+TTFT_REC = LatencyRecorder("serving_ttft_us")
+ITL_REC = LatencyRecorder("serving_itl_us")
+STAGE_QUEUE_REC = LatencyRecorder("serving_stage_queue_us")
+STAGE_PREFILL_REC = LatencyRecorder("serving_stage_prefill_us")
+STAGE_DECODE_REC = LatencyRecorder("serving_stage_decode_us")
 
 
 class _EmitBuf:
@@ -111,17 +122,26 @@ class _EmitBuf:
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "emit", "on_done",
-                 "buf", "_done_fired", "_mu")
+                 "buf", "t_submit", "trace", "_done_fired", "_mu")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  emit: Callable[[int], None],
-                 on_done: Optional[Callable], emit_buffer: int):
+                 on_done: Optional[Callable], emit_buffer: int,
+                 trace_ctx: Optional[tuple] = None):
         self.req_id = next(_req_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.emit = emit
         self.on_done = on_done
         self.buf = _EmitBuf(emit_buffer)
+        self.t_submit = time.monotonic()
+        # (trace_id, parent_span_id, sampled): captured at submit from
+        # the caller's current span (the RPC ingress span when coming
+        # through Serving.Generate) or handed down explicitly (the
+        # supervisor's generation-attempt span) — the decode slot runs
+        # on the engine thread where the contextvar does not follow
+        self.trace = trace_ctx if trace_ctx is not None \
+            else rpcz.current_trace_ctx()
         self._done_fired = False
         self._mu = threading.Lock()
 
@@ -149,15 +169,24 @@ class _Request:
 
 class _Slot:
     __slots__ = ("req", "block", "seq", "last_token", "position",
-                 "generated")
+                 "generated", "span", "t_install", "t_first_tok",
+                 "last_tok_t", "itl_n", "itl_sum_s", "itl_max_s")
 
-    def __init__(self, req: _Request, block=None, seq=None):
+    def __init__(self, req: _Request, block=None, seq=None,
+                 span=rpcz.NULL_SPAN):
         self.req = req
         self.block = block                    # leased KV-cache block, or
         self.seq = seq                        # paged KVSeq (store mode)
         self.last_token = req.prompt[-1] if req.prompt else 0
         self.position = len(req.prompt)
         self.generated = 0
+        self.span = span                      # per-slot decode span
+        self.t_install = time.monotonic()
+        self.t_first_tok = 0.0
+        self.last_tok_t = 0.0
+        self.itl_n = 0                        # inter-token gaps recorded
+        self.itl_sum_s = 0.0
+        self.itl_max_s = 0.0
 
 
 class DecodeEngine:
@@ -264,7 +293,8 @@ class DecodeEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                emit: Callable[[int], None],
                on_done: Optional[Callable] = None, *,
-               clamp: bool = True) -> int:
+               clamp: bool = True,
+               trace_ctx: Optional[tuple] = None) -> int:
         """Queue a request; it is admitted into the step loop at the next
         step boundary with a free slot (in-flight requests are never
         restarted).  Returns the request id; terminal state arrives via
@@ -272,7 +302,10 @@ class DecodeEngine:
         submission from the overload ladder's ``degraded_clamp`` — the
         supervisor's crash re-admissions use it so a restart cannot
         silently truncate a budget the request was already admitted
-        with."""
+        with.  ``trace_ctx=(trace_id, parent_span_id, sampled)``
+        overrides the rpcz trace context captured from the calling
+        thread (the supervisor passes its generation-attempt span so
+        pre- and post-crash decode spans share one trace)."""
         limit = self.max_new_tokens_cap
         brownout = self.degraded_clamp
         if clamp and brownout is not None:
@@ -281,7 +314,8 @@ class DecodeEngine:
             # keep the budget they were admitted with
             limit = min(limit, int(brownout))
         req = _Request(prompt, min(int(max_new_tokens), limit),
-                       emit, on_done, self.emit_buffer)
+                       emit, on_done, self.emit_buffer,
+                       trace_ctx=trace_ctx)
         if req.max_new_tokens <= 0:
             req.finish(errors.RpcError(errors.EREQUEST,
                                        "max_new_tokens must be > 0"))
@@ -321,6 +355,16 @@ class DecodeEngine:
         lease completes THAT request with a definite error and leaves
         the loop healthy.  Returns the installed (index, slot) pair or
         None."""
+        # per-slot decode span (ISSUE 5): child of the request's trace
+        # (RPC ingress or supervisor attempt span); carries TTFT, ITL
+        # and the KV-cache annotations for the whole slot residency.
+        # NULL_SPAN when rpcz is off — every write below absorbs free.
+        tid, psid, smp = req.trace
+        span = rpcz.new_span("decode", "Serving", self.name,
+                             trace_id=tid, parent_span_id=psid,
+                             sampled=smp if tid else None)
+        queue_us = int((time.monotonic() - req.t_submit) * 1e6)
+        STAGE_QUEUE_REC.add(queue_us)
         seq = block = None
         try:
             if fault.ENABLED and fault.hit(
@@ -339,7 +383,7 @@ class DecodeEngine:
                         f"prompt needs {need} pages "
                         f"(> max_pages_per_slot="
                         f"{self.max_pages_per_slot})")
-                seq = self.store.admit(req.prompt)
+                seq = self.store.admit(req.prompt, span=span)
             else:
                 block = self.pool.alloc(self.kv_bytes_per_slot)
         except Exception as e:
@@ -349,11 +393,19 @@ class DecodeEngine:
                 except Exception:
                     pass
             self.admit_errors.add(1)
+            if span is not rpcz.NULL_SPAN:
+                span.error_code = errors.ELIMIT
+                span.annotate(f"kv admit failed: {type(e).__name__}: {e}")
+                rpcz.submit(span)
             req.finish(errors.RpcError(
                 errors.ELIMIT,
                 f"KV admit failed: {type(e).__name__}: {e}"))
             return None
-        slot = _Slot(req, block=block, seq=seq)
+        if span is not rpcz.NULL_SPAN:
+            span.annotate(f"slot install: queue_us={queue_us} "
+                          f"prompt={len(req.prompt)} "
+                          f"budget={req.max_new_tokens}")
+        slot = _Slot(req, block=block, seq=seq, span=span)
         with self._cv:
             if self._running:
                 for i in range(self.num_slots):
@@ -373,6 +425,11 @@ class DecodeEngine:
                 self.store.retire(seq, cache=taken)
         except Exception:
             pass
+        if span is not rpcz.NULL_SPAN:
+            span.error_code = errors.ELOGOFF
+            span.annotate("engine closed mid-admit"
+                          + (" (supervisor takeover)" if taken else ""))
+            rpcz.submit(span)
         req.finish(errors.RpcError(
             errors.ELOGOFF,
             "engine restarting (supervisor takeover)" if taken
@@ -414,11 +471,14 @@ class DecodeEngine:
         """Retire `req`'s slot from OFF the engine thread (emitter saw
         its consumer die).  The engine thread may retire it first —
         exactly-once on finish makes the race benign."""
+        released = None
         with self._cv:
             for i, s in enumerate(self._slots):
                 if s is not None and s.req is req:
-                    self._release_slot_locked(i, cache_ok=False)
+                    released = self._release_slot_locked(i, cache_ok=False)
                     break
+        if released is not None:
+            self._finalize_slot(released, err.code)
         req.finish(err)
 
     # ---- prefill (store mode) ----
@@ -440,13 +500,32 @@ class DecodeEngine:
         bucket = next((b for b in self.prefill_buckets if n <= b), n)
         padded = np.zeros((bucket,), np.int32)
         padded[:n] = suffix
+        # prefill child span: the cached/uncached split IS the story —
+        # a cache hit is prefill compute skipped, and this span shows
+        # exactly how much
+        pspan = rpcz.NULL_SPAN
+        if slot.span is not rpcz.NULL_SPAN:
+            pspan = rpcz.new_span("prefill", "Serving", self.name,
+                                  trace_id=slot.span.trace_id,
+                                  parent_span_id=slot.span.span_id,
+                                  sampled=slot.span.sampled)
+            pspan.annotate(f"prefill: cached={slot.seq.prefill_from} "
+                           f"uncached={n} bucket={bucket}")
+        t0 = time.monotonic()
         try:
             self.prefill_fn(jnp.asarray(padded),
                             jnp.int32(slot.seq.prefill_from))
         except Exception as e:
+            if pspan is not rpcz.NULL_SPAN:
+                pspan.error_code = errors.EINTERNAL
+                pspan.annotate(f"prefill failed: {type(e).__name__}: {e}")
+                rpcz.submit(pspan)
             self._retire(i, errors.RpcError(
                 errors.EINTERNAL,
                 f"prefill failed: {type(e).__name__}: {e}"))
+            return
+        STAGE_PREFILL_REC.add(int((time.monotonic() - t0) * 1e6))
+        rpcz.submit(pspan)
 
     # ---- the step loop ----
 
@@ -576,13 +655,16 @@ class DecodeEngine:
                     errors.EINTERNAL,
                     f"decode step failed: {type(e).__name__}: {e}")
                 with self._cv:
-                    reqs = [self._release_slot_locked(i, cache_ok=False)
-                            for i, s in active]
-                for req in filter(None, reqs):
-                    req.buf.push_terminal(err)
+                    released = [self._release_slot_locked(i,
+                                                          cache_ok=False)
+                                for i, s in active]
+                for s in filter(None, released):
+                    self._finalize_slot(s, errors.EINTERNAL)
+                    s.req.buf.push_terminal(err)
                 continue
             self.steps.add(1)
             self.occupancy_rec.add(len(active))
+            t_tok = time.monotonic()
             for i, s in active:
                 if self._slots[i] is not s:
                     continue    # an emitter cancelled it mid-step
@@ -591,6 +673,20 @@ class DecodeEngine:
                 s.position += 1
                 s.generated += 1
                 self.tokens_out.add(1)
+                if s.last_tok_t:
+                    gap = t_tok - s.last_tok_t
+                    ITL_REC.add(int(gap * 1e6))
+                    s.itl_n += 1
+                    s.itl_sum_s += gap
+                    if gap > s.itl_max_s:
+                        s.itl_max_s = gap
+                else:
+                    s.t_first_tok = t_tok
+                    ttft_us = int((t_tok - s.req.t_submit) * 1e6)
+                    TTFT_REC.add(ttft_us)
+                    if s.span is not rpcz.NULL_SPAN:
+                        s.span.annotate(f"first token: ttft_us={ttft_us}")
+                s.last_tok_t = t_tok
                 if s.seq is not None:
                     try:
                         self.store.extend(s.seq, nxt)
@@ -616,6 +712,10 @@ class DecodeEngine:
                     # consumer stopped draining: cut it HERE, without
                     # the step loop ever blocking in a write
                     self.emit_cut.add(1)
+                    if s.span is not rpcz.NULL_SPAN:
+                        s.span.annotate(
+                            f"emit-buffer stall: {self.emit_buffer} "
+                            f"buffered tokens undrained, consumer cut")
                     self._retire(i, errors.RpcError(
                         errors.EOVERCROWDED,
                         "slow stream consumer: emit buffer overflow"))
@@ -628,9 +728,10 @@ class DecodeEngine:
     def _release_slot_locked(self, i: int, cache_ok: bool = True):
         """Release slot i under the cv: return the KV lease exactly once
         (raw block freed, or paged seq retired — cached into the radix
-        tree only on clean completion) and return the request for the
-        CALLER to finish OUTSIDE the lock via its emit buffer's
-        terminal marker."""
+        tree only on clean completion) and return the SLOT for the
+        CALLER to finalize (span/generation record) and finish (emit
+        buffer's terminal marker) OUTSIDE the lock — collector handoff
+        and the generation ring must not serialize the step loop."""
         s = self._slots[i]
         if s is None:
             return None
@@ -643,13 +744,51 @@ class DecodeEngine:
                 self.store.retire(s.seq, cache=cache_ok)
         except Exception:
             pass
-        return s.req
+        return s
+
+    def _finalize_slot(self, s: _Slot, err_code: int) -> None:
+        """Close out a retiring slot's observability state: the decode
+        span (ITL summary annotation, error code) and one
+        recent-generation record for the /serving/generations page."""
+        now = time.monotonic()
+        dur_us = int((now - s.t_install) * 1e6)
+        STAGE_DECODE_REC.add(dur_us)
+        ttft_us = int((s.t_first_tok - s.req.t_submit) * 1e6) \
+            if s.t_first_tok else 0
+        itl_avg_us = int(s.itl_sum_s / s.itl_n * 1e6) if s.itl_n else 0
+        itl_max_us = int(s.itl_max_s * 1e6)
+        span = s.span
+        if span is not rpcz.NULL_SPAN:
+            span.error_code = span.error_code or err_code
+            span.annotate(
+                f"retired: generated={s.generated} ttft_us={ttft_us} "
+                f"itl_avg_us={itl_avg_us} itl_max_us={itl_max_us}")
+            rpcz.submit(span)
+        try:
+            from brpc_tpu import serving as _serving
+            _serving.record_generation({
+                "engine": self.name,
+                "req_id": s.req.req_id,
+                "trace_id": span.trace_id,
+                "prompt_len": len(s.req.prompt),
+                "prefix_hit": s.seq.prefix_hit_tokens
+                if s.seq is not None else 0,
+                "generated": s.generated,
+                "ttft_us": ttft_us,
+                "itl_avg_us": itl_avg_us,
+                "itl_max_us": itl_max_us,
+                "duration_us": dur_us,
+                "error_code": err_code,
+            })
+        except Exception:
+            pass  # a console-ring bug must never break a retire
 
     def _retire(self, i: int, err) -> None:
         with self._cv:
-            req = self._release_slot_locked(i, cache_ok=err is None)
-        if req is not None:
-            req.buf.push_terminal(err)
+            s = self._release_slot_locked(i, cache_ok=err is None)
+        if s is not None:
+            self._finalize_slot(s, err.code if err is not None else 0)
+            s.req.buf.push_terminal(err)
 
     # ---- lifecycle / introspection ----
 
@@ -687,13 +826,14 @@ class DecodeEngine:
         self._thread.join(timeout_s)
         err = errors.RpcError(errors.ELOGOFF, "engine closed")
         with self._cv:
-            reqs = [self._release_slot_locked(i, cache_ok=False)
-                    for i in range(self.num_slots)]
+            released = [self._release_slot_locked(i, cache_ok=False)
+                        for i in range(self.num_slots)]
             waiters, self._waiters = list(self._waiters), deque()
-        for req in filter(None, reqs):
+        for s in filter(None, released):
             # the emitter drains buffered tokens then fires on_done;
             # finish() is exactly-once so a racing emitter is benign
-            req.buf.push_terminal(err)
+            self._finalize_slot(s, errors.ELOGOFF)
+            s.req.buf.push_terminal(err)
         for req in waiters:
             req.finish(err)   # never admitted: no emitter exists
         # unpin exposed bvars (bound-method PassiveStatus would keep a

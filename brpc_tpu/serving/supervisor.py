@@ -68,7 +68,7 @@ import threading
 import time
 from typing import Callable, Optional, Sequence
 
-from brpc_tpu import errors
+from brpc_tpu import errors, rpcz
 from brpc_tpu.bvar import Adder, PassiveStatus
 
 _sup_req_ids = itertools.count(1)
@@ -88,7 +88,8 @@ class _SupReq:
 
     __slots__ = ("sid", "prompt", "max_new_tokens", "user_emit",
                  "user_done", "emitted", "restarts", "finished", "pin",
-                 "resumed", "mu", "delivery_mu")
+                 "resumed", "trace", "attempt_span", "last_span_id",
+                 "t_start", "mu", "delivery_mu")
 
     def __init__(self, prompt, max_new_tokens, emit, on_done):
         self.sid = next(_sup_req_ids)
@@ -100,6 +101,14 @@ class _SupReq:
         self.restarts = 0
         self.finished = False
         self.pin = None                # RecoveryPin while re-admitting
+        # rpcz generation trace (ISSUE 5): the trace context captured at
+        # submission is STABLE across engine restarts, so the pre-crash
+        # and post-crash attempt spans share ONE trace_id; each attempt
+        # span links its predecessor via recovered_from
+        self.trace = rpcz.current_trace_ctx()
+        self.attempt_span = rpcz.NULL_SPAN   # current attempt's span
+        self.last_span_id = 0                # previous attempt's span id
+        self.t_start = time.monotonic()
         # True between a post-crash re-admission and its first token:
         # distinguishes the NEW engine's first token (recovery proven:
         # release the pin, stamp time-to-recover) from pre-crash tokens
@@ -269,15 +278,81 @@ class EngineSupervisor:
         # re-decodes — and decode restarts from the exact (token,
         # position) the crashed loop would have used next, making the
         # resumed stream bit-exact for any position/token step function
+        resume_prompt = sreq.prompt + emitted
+        ctx = self._open_attempt_span(sreq, resume_prompt, remaining,
+                                      len(emitted))
         with sreq.mu:
             sreq.resumed = sreq.restarts > 0
-        rid = eng.submit(sreq.prompt + emitted, remaining,
+        rid = eng.submit(resume_prompt, remaining,
                          lambda tok, s=sreq: self._emit(s, tok),
                          lambda err, s=sreq: self._req_done(s, err),
-                         clamp=False)
+                         clamp=False, trace_ctx=ctx)
         with self._mu:
             self._by_rid[rid] = sreq
         return True
+
+    # ---- generation tracing (ISSUE 5) ----
+
+    def _open_attempt_span(self, sreq: _SupReq, resume_prompt,
+                           remaining: int, cursor: int) -> tuple:
+        """One rpcz span per engine attempt of a supervised generation.
+        Every attempt joins the SAME trace (the context captured at
+        submit, made stable on the first attempt); a post-crash attempt
+        links its predecessor via ``recovered_from`` and annotates the
+        resume cursor and the re-decoded-token count, so a single
+        ``/rpcz?trace_id=`` timeline shows the full pre-crash/post-crash
+        story.  Returns the trace_ctx to hand the engine so decode and
+        prefill spans nest under the attempt."""
+        with sreq.mu:
+            tid, psid, smp = sreq.trace
+            restarts = sreq.restarts
+            last_sid = sreq.last_span_id
+        span = rpcz.new_span("generation", "Serving", self.name,
+                             trace_id=tid, parent_span_id=psid,
+                             sampled=smp if tid else None)
+        if span is rpcz.NULL_SPAN:
+            return (tid, psid, smp)
+        if restarts:
+            span.recovered_from = last_sid
+            span.annotate(
+                f"recovered_from=span {last_sid}: restart {restarts}, "
+                f"resume_cursor={cursor} tokens already emitted, "
+                f"{remaining} remaining")
+            if self.store is not None:
+                # how much of the resume prompt the committed pages
+                # cover (advisory probe): the uncovered tail is what
+                # this recovery actually re-decodes
+                try:
+                    hit = int(self.store.probe(resume_prompt))
+                except Exception:
+                    hit = 0
+                span.annotate(
+                    f"re_decoded_tokens={len(resume_prompt) - hit} "
+                    f"(committed prefix hit={hit} of "
+                    f"{len(resume_prompt)})")
+        with sreq.mu:
+            sreq.attempt_span = span
+            sreq.last_span_id = span.span_id
+            if not tid:
+                # first attempt rooted the trace: later attempts (and
+                # this generation only) must reuse it, or each restart
+                # would start an unlinked fresh trace
+                sreq.trace = (span.trace_id, psid, span.sampled)
+        return (span.trace_id, span.span_id, span.sampled)
+
+    def _close_attempt_span(self, sreq: _SupReq, err,
+                            note: Optional[str] = None) -> None:
+        """Submit the current attempt span exactly once (the swap to
+        NULL_SPAN under the lock is the once-guard)."""
+        with sreq.mu:
+            span, sreq.attempt_span = sreq.attempt_span, rpcz.NULL_SPAN
+        if span is rpcz.NULL_SPAN:
+            return
+        if err is not None:
+            span.error_code = err.code
+        if note:
+            span.annotate(note)
+        rpcz.submit(span)
 
     # ---- per-request plumbing ----
 
@@ -292,6 +367,7 @@ class EngineSupervisor:
                 sreq.emitted.append(tok)  # cursor first: delivered-once
                 first_resumed = sreq.resumed
                 sreq.resumed = False
+                aspan = sreq.attempt_span if first_resumed else None
                 pin = None
                 if first_resumed:
                     # this token came from the REBUILT engine, so
@@ -302,6 +378,9 @@ class EngineSupervisor:
                     pin, sreq.pin = sreq.pin, None
             if pin is not None:
                 pin.release()
+            if aspan is not None and aspan is not rpcz.NULL_SPAN:
+                aspan.annotate("first post-recovery token delivered "
+                               "(recovery pin released)")
             if first_resumed:
                 t0 = self._await_first_token_t
                 if t0 is not None:
@@ -326,6 +405,9 @@ class EngineSupervisor:
                 sreq.restarts += 1
                 give_up = sreq.restarts > self.max_restarts
             if not give_up:
+                self._close_attempt_span(
+                    sreq, err, "engine died mid-decode; re-admitting "
+                    "after the emitted cursor")
                 self.readmitted.add(1)
                 with sreq.mu:
                     self.resumed_tokens.add(len(sreq.emitted))
@@ -348,6 +430,25 @@ class EngineSupervisor:
                 pin.release()
             with self._mu:
                 self._live.pop(sreq.sid, None)
+            self._close_attempt_span(sreq, err)
+            with sreq.mu:
+                emitted = len(sreq.emitted)
+                restarts = sreq.restarts
+            try:
+                from brpc_tpu import serving as _serving
+                _serving.record_generation({
+                    "supervisor": self.name,
+                    "sid": sreq.sid,
+                    "trace_id": sreq.trace[0],
+                    "prompt_len": len(sreq.prompt),
+                    "emitted": emitted,
+                    "restarts": restarts,
+                    "duration_us": int(
+                        (time.monotonic() - sreq.t_start) * 1e6),
+                    "error_code": err.code if err is not None else 0,
+                })
+            except Exception:
+                pass  # the console ring must never break a terminal
             if sreq.user_done is not None:
                 try:
                     sreq.user_done(err)
@@ -437,6 +538,16 @@ class EngineSupervisor:
                     slot.block.free()
                 except Exception:
                     pass
+            # the pre-crash decode span ends HERE (the slot will never
+            # retire through the dead engine): it stays part of the
+            # generation's trace, so the timeline shows decode-up-to-
+            # crash followed by the recovered_from-linked re-attempt
+            if slot.span is not rpcz.NULL_SPAN:
+                slot.span.error_code = errors.ELOGOFF
+                slot.span.annotate(
+                    f"engine takeover: {reason}; {slot.generated} "
+                    f"tokens decoded pre-crash")
+                rpcz.submit(slot.span)
             # the old emitter flushes every token already decoded into
             # the buffer (the cursor counts them — they are NOT
             # re-decoded), then delivers the restart marker, whose

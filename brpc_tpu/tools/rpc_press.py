@@ -20,6 +20,13 @@ otherwise it is fully random.  R=0.9 models a shared-system-prompt
 workload and drives the paged KV cache's radix hit-rate (watch
 /kvcache while pressing); R=0 is the worst case for prefix reuse.
 The schedule is seeded per worker, so runs replay.
+
+Trace dumping (--dump-traces N): rpcz is enabled in the press process
+and every call runs under a client root span, so each press call is
+one trace; after the run the N SLOWEST traces print as tree-ordered
+indented timelines (relative offsets, annotations).  Against an
+in-process or rpcz-enabled server the timelines include the server-side
+stage spans — the fastest way from "it's slow" to WHICH stage is slow.
 """
 from __future__ import annotations
 
@@ -30,7 +37,25 @@ import threading
 import time
 
 import brpc_tpu as brpc
+from brpc_tpu import rpcz
 from brpc_tpu.bvar import LatencyRecorder
+
+
+def dump_slowest_traces(n: int, trace_ids=None, out=sys.stderr) -> None:
+    """Print the n slowest collected traces as indented timelines
+    (--dump-traces).  ``trace_ids`` restricts ranking to THIS run's
+    traces — the shared in-process span store may hold unrelated
+    history (a co-located server's own traffic)."""
+    spans = rpcz.recent_spans(limit=2048)
+    if trace_ids is not None:
+        spans = [s for s in spans if s.trace_id in trace_ids]
+    groups = rpcz.slowest_traces(spans, n)
+    if not groups:
+        print("no traces collected (is rpcz enabled?)", file=out)
+        return
+    print(f"--- {len(groups)} slowest traces ---", file=out)
+    for group in groups:
+        print(rpcz.format_trace(group), end="", file=out)
 
 
 def make_prefix_skew(request, ratio: float, prefix_tokens: int = 32,
@@ -66,15 +91,38 @@ def run_press(server: str, service: str, method: str, request,
               qps: int = 0, duration_s: float = 10.0, threads: int = 4,
               serializer: str = "json", timeout_ms: int = 1000,
               connection_type: str = "single", request_factory=None,
-              out=sys.stderr) -> dict:
+              dump_traces: int = 0, out=sys.stderr) -> dict:
     """Drives the load; returns a summary dict (also printable).
     ``request_factory(k)`` (e.g. ``make_prefix_skew(...)``), when
-    given, builds worker k's per-call request generator."""
+    given, builds worker k's per-call request generator.
+    ``dump_traces=N`` enables rpcz for the run (each call becomes one
+    trace rooted at a press client span) and prints the N slowest
+    traces as indented timelines afterwards."""
+    traced = dump_traces > 0
+    rpcz_state = (rpcz.enabled(), rpcz.sample_rate())
+    if traced:
+        rpcz.set_enabled(True)
+    try:
+        return _run_press_body(server, service, method, request, qps,
+                               duration_s, threads, serializer,
+                               timeout_ms, connection_type,
+                               request_factory, dump_traces, traced, out)
+    finally:
+        # restore BOTH knobs, even on a mid-run exception: a press must
+        # not leave a co-located server force-traced at rate 1.0
+        if traced:
+            rpcz.set_enabled(*rpcz_state)
+
+
+def _run_press_body(server, service, method, request, qps, duration_s,
+                    threads, serializer, timeout_ms, connection_type,
+                    request_factory, dump_traces, traced, out) -> dict:
     ch = brpc.Channel(server, timeout_ms=timeout_ms,
                       connection_type=connection_type)
     rec = LatencyRecorder("rpc_press")
     nerr = [0]
     nok = [0]
+    press_tids: list = []   # this run's trace ids (GIL-atomic appends)
     stop = threading.Event()
     # per-thread qps budget; qps<=0 = unthrottled
     per_thread_interval = threads / qps if qps > 0 else 0.0
@@ -90,14 +138,25 @@ def run_press(server: str, service: str, method: str, request,
                     continue
                 next_at += per_thread_interval
             req = gen() if gen is not None else request
+            span = rpcz.new_span("client", service, method) if traced \
+                else rpcz.NULL_SPAN
+            if span is not rpcz.NULL_SPAN:
+                span.remote_side = server
+                press_tids.append(span.trace_id)
+                rpcz.set_current_span(span)
             t0 = time.monotonic()
             try:
                 ch.call_sync(service, method, req,
                              serializer=serializer)
                 rec.add(int((time.monotonic() - t0) * 1e6))
                 nok[0] += 1
-            except Exception:
+            except Exception as e:
                 nerr[0] += 1
+                span.error_code = getattr(e, "code", -1) or -1
+            finally:
+                if span is not rpcz.NULL_SPAN:
+                    rpcz.set_current_span(None)
+                    rpcz.submit(span)
 
     ts = [threading.Thread(target=worker, args=(k,), daemon=True)
           for k in range(threads)]
@@ -122,6 +181,9 @@ def run_press(server: str, service: str, method: str, request,
         "elapsed_s": round(elapsed, 2),
     }
     print(json.dumps(summary), file=out)
+    if traced:
+        dump_slowest_traces(dump_traces, trace_ids=set(press_tids),
+                            out=out)
     return summary
 
 
@@ -146,18 +208,39 @@ def run_streaming_press(server: str, service: str, method: str, request,
                         duration_s: float = 10.0, threads: int = 4,
                         serializer: str = "json", timeout_ms: int = 5000,
                         connection_type: str = "single",
-                        request_factory=None,
+                        request_factory=None, dump_traces: int = 0,
                         out=sys.stderr) -> dict:
     """Streaming load: one client stream per call, looped per worker for
     `duration_s`.  Reports aggregate items/s and time-to-first-item
     (TTFI) percentiles; a stream that never closes within the timeout
-    counts as an error."""
+    counts as an error.  ``dump_traces=N`` prints the N slowest traces
+    afterwards (each stream call is one trace)."""
+    traced = dump_traces > 0
+    rpcz_state = (rpcz.enabled(), rpcz.sample_rate())
+    if traced:
+        rpcz.set_enabled(True)
+    try:
+        return _run_streaming_body(server, service, method, request,
+                                   duration_s, threads, serializer,
+                                   timeout_ms, connection_type,
+                                   request_factory, dump_traces, traced,
+                                   out)
+    finally:
+        if traced:
+            rpcz.set_enabled(*rpcz_state)
+
+
+def _run_streaming_body(server, service, method, request, duration_s,
+                        threads, serializer, timeout_ms, connection_type,
+                        request_factory, dump_traces, traced,
+                        out) -> dict:
     ch = brpc.Channel(server, timeout_ms=timeout_ms,
                       connection_type=connection_type)
     ttfi = LatencyRecorder("rpc_press_ttfi")
     items = [0]
     streams_ok = [0]
     nerr = [0]
+    press_tids: list = []
     mu = threading.Lock()
     stop = threading.Event()
 
@@ -168,16 +251,32 @@ def run_streaming_press(server: str, service: str, method: str, request,
             cntl = brpc.Controller()
             stream = brpc.stream_create(cntl, h)
             req = gen() if gen is not None else request
+            span = rpcz.new_span("client", service, method) if traced \
+                else rpcz.NULL_SPAN
+            if span is not rpcz.NULL_SPAN:
+                span.remote_side = server
+                press_tids.append(span.trace_id)
+                rpcz.set_current_span(span)
             t0 = time.monotonic()
             try:
                 ch.call_sync(service, method, req,
                              serializer=serializer, cntl=cntl)
-            except Exception:
+            except Exception as e:
                 with mu:
                     nerr[0] += 1
+                span.error_code = getattr(e, "code", -1) or -1
+                if span is not rpcz.NULL_SPAN:
+                    rpcz.set_current_span(None)
+                    rpcz.submit(span)
                 stream.close()
                 continue
+            finally:
+                if span is not rpcz.NULL_SPAN:
+                    rpcz.set_current_span(None)
             ok = h.closed.wait(timeout_ms / 1e3)
+            if span is not rpcz.NULL_SPAN:
+                span.annotate(f"stream closed: items={h.items} ok={ok}")
+                rpcz.submit(span)
             with mu:
                 if ok:
                     streams_ok[0] += 1
@@ -211,6 +310,9 @@ def run_streaming_press(server: str, service: str, method: str, request,
         "elapsed_s": round(elapsed, 2),
     }
     print(json.dumps(summary), file=out)
+    if traced:
+        dump_slowest_traces(dump_traces, trace_ids=set(press_tids),
+                            out=out)
     return summary
 
 
@@ -242,6 +344,10 @@ def main(argv=None):
                     help="shared-prefix length for --shared-prefix-ratio")
     ap.add_argument("--prefix-seed", type=int, default=0,
                     help="seed for the prefix-skew schedule")
+    ap.add_argument("--dump-traces", type=int, default=0,
+                    help="enable rpcz for the run and print the N "
+                         "slowest traces as indented timelines after "
+                         "the summary; 0 disables")
     a = ap.parse_args(argv)
     text = a.input
     if text.startswith("@"):
@@ -260,13 +366,15 @@ def main(argv=None):
                             timeout_ms=a.timeout_ms,
                             connection_type=a.connection_type,
                             request_factory=factory,
+                            dump_traces=a.dump_traces,
                             out=sys.stdout)
     else:
         run_press(a.server, a.service, a.method, req, qps=a.qps,
                   duration_s=a.duration, threads=a.threads,
                   serializer=a.serializer, timeout_ms=a.timeout_ms,
                   connection_type=a.connection_type,
-                  request_factory=factory, out=sys.stdout)
+                  request_factory=factory, dump_traces=a.dump_traces,
+                  out=sys.stdout)
 
 
 if __name__ == "__main__":

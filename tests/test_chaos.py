@@ -850,6 +850,111 @@ def test_engine_crash_midstream_failover_exactly_once(seed):
         store.close()
 
 
+# ---------------------------------------------------------------------------
+# scenario 12: engine crash mid-decode -> ONE generation trace linking
+# pre- and post-crash spans (ISSUE 5, same seeds as scenario 11)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_engine_crash_yields_one_linked_trace(seed):
+    """rpcz generation tracing upholds trace CONTINUITY across crash
+    recovery: an injected `serving.step` crash mid-decode yields, for
+    each interrupted generation, ONE trace whose post-crash attempt
+    span carries the SAME trace_id, links its predecessor via
+    ``recovered_from``, and annotates the resume cursor and the
+    re-decoded-token count — the timeline a person debugging "why was
+    this generation slow" actually needs."""
+    import jax
+
+    from brpc_tpu import rpcz
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine, EngineSupervisor
+
+    store = KVCacheStore(page_bytes=256, page_tokens=4, max_blocks=32,
+                         name=f"tr_chaos_kv{seed}")
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return (tokens * 7 + positions) % 997
+
+    calm = ({"queue_delay_us": float("inf"), "pool_ratio": 9.9,
+             "queue_depth": 1e9},) * 3
+    sup = EngineSupervisor(
+        lambda: DecodeEngine(step, num_slots=3, store=store,
+                             max_pages_per_slot=32,
+                             name=f"tr_chaos_e{seed}"),
+        store=store, heartbeat_deadline_s=5.0, check_interval_s=0.02,
+        ladder=calm, name=f"tr_chaos{seed}")
+    rpcz.set_enabled(True)
+    try:
+        shared = list(range(300, 308))
+        done = threading.Event()
+        sup.submit(shared + [1], 2, lambda t: None, lambda e: done.set())
+        assert done.wait(30)
+        assert sup.join_idle(10)
+
+        plan = fault.FaultPlan(seed)
+        plan.on("serving.step", fault.ERROR, times=1, after=2)
+        sinks = []
+        with fault.injected(plan):
+            for i in range(6):
+                ev = threading.Event()
+                errs: list = []
+                sinks.append((ev, errs))
+                sup.submit(shared + [400 + i], 6, lambda t: None,
+                           lambda e, ev=ev, errs=errs: (errs.append(e),
+                                                        ev.set()))
+            for ev, _ in sinks:
+                assert ev.wait(60), "generation hung across the restart"
+        assert plan.injected["serving.step"] == 1
+        for ev, errs in sinks:
+            assert errs == [None]
+        assert sup.stats()["restarts"] == 1
+
+        # every interrupted generation produced one recovered_from-
+        # linked trace: >= 1 such trace exists, each holding BOTH
+        # attempt spans under one trace_id plus both decode spans
+        spans = rpcz.recent_spans(limit=2048)
+        gens: dict = {}
+        for s in spans:
+            if s.kind == "generation" and s.method == f"tr_chaos{seed}":
+                gens.setdefault(s.trace_id, []).append(s)
+        linked = []
+        for tid, group in gens.items():
+            if len(group) < 2:
+                continue
+            group.sort(key=lambda s: s.span_id)
+            if group[1].recovered_from == group[0].span_id:
+                linked.append((tid, group))
+        assert linked, \
+            f"seed {seed}: no trace links pre- and post-crash attempts"
+        full_seam = 0
+        for tid, group in linked:
+            notes = " | ".join(m for _, m in group[1].annotations)
+            assert "recovered_from=span" in notes
+            assert "resume_cursor=" in notes
+            assert "re_decoded_tokens=" in notes, \
+                f"seed {seed}: re-decoded tokens not annotated: {notes}"
+            trace = [s for s in spans if s.trace_id == tid]
+            decode_spans = [s for s in trace if s.kind == "decode"]
+            # a generation that was IN a slot at crash time shows both
+            # decode attempts: the pre-crash span closed ELOGOFF at
+            # takeover plus the post-crash one; a generation still
+            # QUEUED at the crash legitimately has only the second
+            if len(decode_spans) >= 2 and any(
+                    s.error_code == errors.ELOGOFF for s in decode_spans):
+                full_seam += 1
+        assert full_seam >= 1, \
+            f"seed {seed}: no trace shows the full pre-crash/post-crash " \
+            f"decode seam (stolen slots: " \
+            f"{sup.stats()['last_recovery']['stolen_slots']})"
+    finally:
+        rpcz.set_enabled(False)
+        sup.close()
+        store.clear()
+        store.close()
+
+
 class TestHealthCheckRevival:
     def test_probe_respects_isolation_hold_while_reachable(self, server):
         """The circuit breaker's isolation hold (_hold_until) must be

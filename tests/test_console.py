@@ -90,6 +90,81 @@ def test_rpcz_shows_spans(server):
     assert b"Hello.Say" in body
 
 
+def test_rpcz_trace_timeline_view(server):
+    """/rpcz?trace_id= renders ONE trace as a tree-ordered timeline
+    (ISSUE 5): relative offsets, span kinds, parent indentation."""
+    from brpc_tpu import rpcz
+    ch = brpc.Channel(f"127.0.0.1:{server.port}", timeout_ms=5000)
+    root = rpcz.new_span("client", "console", "timeline")
+    rpcz.set_current_span(root)
+    ch.call_sync("Hello", "Say", {"name": "t"}, serializer="json")
+    rpcz.set_current_span(None)
+    rpcz.submit(root)
+    try:
+        deadline = __import__("time").monotonic() + 5
+        body = b""
+        while __import__("time").monotonic() < deadline:
+            status, body = _get(server, f"/rpcz?trace_id={root.trace_id}")
+            if b"[server] Hello.Say" in body:
+                break
+        assert status == 200
+        assert f"trace {root.trace_id}".encode() in body
+        assert b"[client] console.timeline" in body
+        assert b"[server] Hello.Say" in body
+        # the server span is a CHILD: indented under the client root
+        lines = body.decode().splitlines()
+        c_line = next(ln for ln in lines if "[client]" in ln)
+        s_line = next(ln for ln in lines if "[server]" in ln)
+        assert (len(s_line) - len(s_line.lstrip())
+                > len(c_line) - len(c_line.lstrip()))
+    finally:
+        rpcz.set_current_span(None)
+
+
+def test_serving_generations_page():
+    """/serving/generations renders the recent-generation ring and the
+    aggregate TTFT/ITL percentiles (ISSUE 5)."""
+    import threading
+
+    import jax
+
+    from brpc_tpu.serving import DecodeEngine
+
+    @jax.jit
+    def step(tokens, positions):
+        return tokens + 1
+
+    eng = DecodeEngine(step, num_slots=2, kv_bytes_per_slot=256,
+                       name="console_gen_eng")
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        done = threading.Event()
+        eng.submit([1, 2, 3], 4, lambda t: None, lambda e: done.set())
+        assert done.wait(30)
+        status, body = _get(s, "/serving/generations")
+        assert status == 200
+        snap = json.loads(body)
+        assert "aggregates" in snap and "recent" in snap
+        agg = snap["aggregates"]
+        assert {"ttft_us", "itl_us", "prefill_skip_ratio",
+                "recoveries"} <= set(agg)
+        assert agg["ttft_us"]["count"] >= 1
+        mine = [r for r in snap["recent"]
+                if r.get("engine") == "console_gen_eng"]
+        assert mine and mine[-1]["generated"] == 4
+        # the serving recorders ride the EXISTING Prometheus endpoint
+        status, body = _get(s, "/brpc_metrics")
+        assert status == 200
+        assert b"serving_ttft_us_latency" in body
+        assert b"serving_itl_us_latency" in body
+        assert b"serving_stage_decode_us_latency" in body
+    finally:
+        s.stop()
+        s.join()
+        eng.close()
+
+
 def test_prometheus_metrics(server):
     status, body = _get(server, "/brpc_metrics")
     assert status == 200
@@ -193,8 +268,9 @@ def test_every_console_route_answers(server):
     routes = [
         "/", "/index", "/status", "/vars", "/flags", "/health",
         "/version", "/connections", "/sockets", "/bthreads", "/services",
-        "/protobufs", "/memory", "/ici", "/serving", "/kvcache", "/rpcz",
-        "/brpc_metrics",
+        "/protobufs", "/memory", "/ici", "/serving",
+        "/serving/generations", "/kvcache", "/rpcz",
+        "/rpcz?trace_id=1", "/brpc_metrics",
         "/dashboard", "/vlog", "/hotspots",
         "/hotspots/cpu?seconds=0.05",
         "/hotspots/contention?seconds=0.05",
