@@ -85,6 +85,30 @@ recovery: $(LIB) $(PYEXT)
 trace: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q
 
+# Hotspot attribution (README "Observability", ISSUE 6): burst-profile
+# a local serving run — always-on stage-tagged sampler ring, a 100Hz
+# burst, the lock-contention ledger, and the host-CPU-per-token rollup.
+hotspots: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python tools/hotspots_burst.py
+
+# Per-stage host micro-benchmark suite (bench.py microbench): frame
+# pump, batch assembly, radix prefix match, page alloc/release, emit
+# fan-out, span submit, sampler overhead — CPU-valid, 3-trial
+# median+spread.  The de-GIL work (ROADMAP item 4) gates on these.
+microbench: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python bench.py microbench
+
+# Full bench run ending in a delta-vs-previous-round table: perf_diff
+# compares the freshest BENCH_r*.json against this run's
+# BENCH_DETAILS.json and flags beyond-spread regressions (the leading
+# `-` keeps the table from failing the build; run perf_diff directly
+# for the gating exit code).
+bench: $(LIB) $(PYEXT)
+	python bench.py
+	-python tools/perf_diff.py \
+	    "$$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)" \
+	    BENCH_DETAILS.json
+
 # Sanitizer stress targets (VERDICT r2 task 7; reference fights lock-free
 # races with stress tests + sanitizer builds, SURVEY.md §5.3).  The whole
 # native core + src/cc/test/stress_main.cc compile as ONE binary with the
@@ -114,4 +138,5 @@ stress:
 	    $(STRESS_SRC) -o build/stress_plain
 	./build/stress_plain
 
-.PHONY: all clean test chaos serving kvcache recovery trace tsan asan stress
+.PHONY: all clean test chaos serving kvcache recovery trace hotspots \
+    microbench bench tsan asan stress

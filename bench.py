@@ -1428,43 +1428,374 @@ def bench_dcn(mb: int = 32) -> dict:
     return out
 
 
-def _device_reachable(timeouts_s: tuple = (60, 90, 150)) -> tuple[bool, str]:
+def _med_spread(vals, key: str, nd: int = 1) -> dict:
+    """median + min/max spread over trials — the rung family's shared
+    jitter discipline (and the shape tools/perf_diff.py gates on)."""
+    vs = sorted(vals)
+    return {key: round(vs[len(vs) // 2], nd),
+            f"{key}_spread": [round(vs[0], nd), round(vs[-1], nd)],
+            "trials": len(vs)}
+
+
+def bench_microbench(trials=3, duration_s=0.4, quick=False):
+    """Per-stage host micro-benchmark suite (ISSUE 6; in the spirit of
+    PAPERS.md "Designing a Micro-Benchmark Suite to Evaluate gRPC for
+    TensorFlow": attribute the RPC path's host overhead PER STAGE
+    before optimizing any of it).  Each rung isolates ONE serving
+    stage on the host:
+
+      * frame_pump        — the native C++ client pump -> native echo
+                            loop (the non-Python ceiling);
+      * batch_assembly    — DynamicBatcher formation/scatter with a
+                            trivial numpy batch_fn (no jit, no device);
+      * radix_prefix_match — KVCacheStore.probe longest-prefix match
+                            against a warmed radix tree;
+      * page_alloc_release — store admit/retire cycles of uncached
+                            prompts (page alloc, splice bookkeeping,
+                            release);
+      * emit_fanout       — _EmitBuf push/pop through a producer/
+                            consumer pair (the per-token delivery path);
+      * span_submit       — rpcz span create/annotate/submit + collector
+                            drain;
+      * sampler_overhead  — window-limited batcher qps with the
+                            always-on profiler stopped vs running at its
+                            default rate (the <2% always-on claim).
+
+    Every number is CPU-valid by construction: no rung touches an
+    accelerator (the kvcache rungs run on the jax CPU backend), so the
+    suite publishes on every round and the de-GIL trajectory
+    (ROADMAP item 4) never goes blind.  3-trial median + spread, like
+    every other rung family."""
+    import threading
+
+    import numpy as np
+
+    from brpc_tpu import rpcz
+    from brpc_tpu.serving import DynamicBatcher
+
+    if quick:
+        trials, duration_s = 2, 0.15
+    out = {}
+
+    # ---- frame_pump ----
+    frames = 30_000 if quick else 100_000
+    rs = []
+    for _ in range(trials):
+        r = bench_native_echo(conns=2, inflight=16, total=frames)
+        if r["completed"]:
+            rs.append(r["qps"])
+    if rs:
+        out["frame_pump"] = {**_med_spread(rs, "qps"),
+                             "unit": "frames/s", "frames": frames}
+    else:
+        # the rung discipline: a rung that cannot run must SAY so —
+        # a 0.0 wearing the metric's name would read as a real
+        # collapse to perf_diff and poison the round as a baseline
+        out["frame_pump"] = {"error": "native echo pump completed no "
+                                      "trial", "frames": frames}
+
+    # shared batcher-hammer: `threads` workers submit_wait against a
+    # numpy-fn batcher for duration_s, returns items/s (used by the
+    # batch_assembly and sampler_overhead rungs)
+    def batcher_hammer(name, *, max_batch_size, max_delay_us, length,
+                       threads):
+        b = DynamicBatcher(lambda x: x.sum(axis=1),
+                           max_batch_size=max_batch_size,
+                           max_delay_us=max_delay_us,
+                           batch_buckets=(max_batch_size,),
+                           length_buckets=(length,), name=name)
+        item = np.ones((length,), np.float32)
+        try:
+            b.submit_wait(item, timeout_s=30)
+            stop = time.monotonic() + duration_s
+            counts = [0] * threads
+
+            def w(i):
+                while time.monotonic() < stop:
+                    b.submit_wait(item, timeout_s=30)
+                    counts[i] += 1
+
+            ts = [threading.Thread(target=w, args=(i,))
+                  for i in range(threads)]
+            t0 = time.monotonic()
+            [t.start() for t in ts]
+            [t.join(60) for t in ts]
+            return sum(counts) / (time.monotonic() - t0)
+        finally:
+            b.close()
+
+    # ---- batch_assembly ----
+    out["batch_assembly"] = {
+        **_med_spread([batcher_hammer(f"microbench_ba_{k}",
+                                      max_batch_size=16, max_delay_us=200,
+                                      length=64, threads=8)
+                       for k in range(trials)], "qps"),
+        "unit": "items/s through formation+scatter (numpy batch_fn)"}
+
+    # ---- radix_prefix_match + page_alloc_release (share a store) ----
+    from brpc_tpu.kvcache import KVCacheStore
+
+    def radix_trial(k):
+        pt = 16
+        store = KVCacheStore(page_tokens=pt, page_bytes=pt * 64,
+                             max_blocks=64,
+                             name=f"microbench_radix_{k}")
+        try:
+            # warm the tree with 8 cached prompts
+            prompts = [[1000 * j + i for i in range(4 * pt)]
+                       for j in range(8)]
+            for p in prompts:
+                store.retire(store.admit(p), cache=True)
+            probe = np.asarray(prompts[3] + [7] * pt)
+            n = 500 if quick else 3000
+            t0 = time.monotonic()
+            for _ in range(n):
+                store.probe(probe)
+            return n / (time.monotonic() - t0)
+        finally:
+            store.clear()
+            store.close()
+
+    out["radix_prefix_match"] = {
+        **_med_spread([radix_trial(k) for k in range(trials)], "qps"),
+        "unit": "longest-prefix probes/s (warm radix, 64-token prompts)"}
+
+    def page_trial(k):
+        pt = 16
+        store = KVCacheStore(page_tokens=pt, page_bytes=pt * 64,
+                             max_blocks=64,
+                             name=f"microbench_page_{k}")
+        try:
+            n = 30 if quick else 120
+            t0 = time.monotonic()
+            for i in range(n):
+                # unique prompts: every admit allocs+splices 2 fresh
+                # pages, every retire releases them (cache=False)
+                seq = store.admit([9_000_000 + i * 2 * pt + j
+                                   for j in range(2 * pt)])
+                store.retire(seq, cache=False)
+            return n / (time.monotonic() - t0)
+        finally:
+            store.clear()
+            store.close()
+
+    out["page_alloc_release"] = {
+        **_med_spread([page_trial(k) for k in range(trials)], "qps"),
+        "unit": "admit+retire cycles/s (2 pages alloc/release each)"}
+
+    # ---- emit_fanout ----
+    from brpc_tpu.serving.engine import _EmitBuf
+
+    def emit_trial(k):
+        buf = _EmitBuf(1024)
+        n = 3000 if quick else 20_000
+        drained = [0]
+
+        def consumer():
+            while True:
+                item = buf.pop(5.0)
+                if item is None or item[0] == "done":
+                    return
+                drained[0] += 1
+
+        t = threading.Thread(target=consumer)
+        t0 = time.monotonic()
+        t.start()
+        pushed = 0
+        while pushed < n:
+            if buf.push(pushed):
+                pushed += 1
+        buf.push_terminal(None)
+        t.join(60)
+        return drained[0] / (time.monotonic() - t0)
+
+    out["emit_fanout"] = {
+        **_med_spread([emit_trial(k) for k in range(trials)], "qps"),
+        "unit": "tokens/s through one bounded emit buffer pair"}
+
+    # ---- span_submit ----
+    def span_trial(k):
+        was = (rpcz.enabled(), rpcz.sample_rate())
+        rpcz.set_enabled(True, 1.0)
+        try:
+            from brpc_tpu.bvar.collector import Collector
+            n = 500 if quick else 2000
+            t0 = time.monotonic()
+            for i in range(n):
+                sp = rpcz.new_span("client", "Micro", "Bench")
+                sp.annotate("microbench span")
+                rpcz.submit(sp)
+            Collector.instance().flush("rpcz")
+            return n / (time.monotonic() - t0)
+        finally:
+            rpcz.set_enabled(*was)
+
+    out["span_submit"] = {
+        **_med_spread([span_trial(k) for k in range(trials)], "qps"),
+        "unit": "spans/s (create+annotate+submit+collector drain; the "
+                "2000/s collector speed limit applies beyond it)"}
+
+    # ---- sampler_overhead ----
+    from brpc_tpu.builtin.sampler import HotspotSampler
+
+    def window_limited_qps(k, label):
+        # threads << max_batch_size: every batch forms at WINDOW
+        # expiry, so qps ~ threads/window — nearly deterministic, which
+        # is what makes a small overhead measurable at all
+        return batcher_hammer(f"microbench_so_{label}_{k}",
+                              max_batch_size=64, max_delay_us=2000,
+                              length=16, threads=4)
+
+    samp = HotspotSampler.instance()
+    was_running = samp.running
+    samp.stop()
+    off = [window_limited_qps(k, "off") for k in range(trials)]
+    samp.start()
+    try:
+        on = [window_limited_qps(k, "on") for k in range(trials)]
+    finally:
+        if not was_running:
+            samp.stop()
+    off_med = sorted(off)[len(off) // 2]
+    on_med = sorted(on)[len(on) // 2]
+    out["sampler_overhead"] = {
+        "qps_off": round(off_med, 1),
+        "qps_off_spread": [round(min(off), 1), round(max(off), 1)],
+        "qps_on": round(on_med, 1),
+        "qps_on_spread": [round(min(on), 1), round(max(on), 1)],
+        "overhead_pct": round((off_med - on_med) / off_med * 100.0, 2)
+        if off_med else None,
+        "trials": trials,
+        "unit": "window-limited batcher qps, always-on sampler off vs "
+                "on at its default rate",
+    }
+
+    out["cpu_valid"] = True
+    out["note"] = ("per-stage host microbenches (ISSUE 6): every rung "
+                   "isolates one serving stage on the host with no "
+                   "accelerator dependency, so these numbers publish "
+                   "on every round; 3-trial median+spread")
+    return out
+
+
+def _run_microbench_subprocess(timeout_s: float = 900) -> dict:
+    """Run the microbench family in a FRESH forced-CPU subprocess: the
+    kvcache rungs import jax, and importing jax in the driver process
+    on a wedged-tunnel box would hang the whole bench (the same reason
+    _probe_device subprocesses)."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "microbench"],
+        capture_output=True, text=True, env=env, timeout=timeout_s)
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+        return {"error": f"microbench subprocess rc={r.returncode}: "
+                         f"{tail[0]}"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return {"error": "microbench subprocess produced no JSON"}
+
+
+def _classify_probe_failure(stderr: str, timed_out: bool,
+                            phase: str) -> tuple[str, str]:
+    """Map one probe attempt's outcome to a skip_reason KIND (ISSUE 6
+    bench hygiene: a skipped rung must say WHY — "no device" is a very
+    different trajectory signal from "device present but hung").
+
+      * wedge-deadline — the probe subprocess blew its hard timeout
+        (enumeration hung = wedged tunnel; compute hung = device
+        present but its data path is wedged);
+      * no-device     — jax answered cleanly that there is no usable
+        accelerator (backend init failure, zero devices);
+      * exception     — anything else (missing jax, import error, a
+        crash that isn't a backend-absence message).
+    """
+    if timed_out:
+        return "wedge-deadline", (
+            f"device {'enumeration' if phase == 'enum' else 'compute'} "
+            f"hung past the deadline "
+            f"({'wedged tunnel?' if phase == 'enum' else 'device present but hung'})")
+    tail = (stderr or "").strip().splitlines()[-1:] or ["no stderr"]
+    msg = tail[0]
+    lowered = msg.lower()
+    if ("unable to initialize backend" in lowered
+            or "no devices" in lowered
+            or "failed to get device" in lowered
+            or "no visible device" in lowered):
+        return "no-device", msg
+    return "exception", msg
+
+
+def _skip_entry(kind: str, detail: str) -> dict:
+    """The honest-skip publication shape every device rung uses: a
+    machine-readable skip_reason kind plus the human detail (the old
+    `reason` key is kept so earlier-round tooling still parses)."""
+    return {"skipped": True, "skip_reason": kind, "skip_detail": detail,
+            "reason": detail}
+
+
+def _probe_device(timeouts_s: tuple = (60, 90, 150)) -> tuple[bool, str, str]:
     """Probe jax device init in a SUBPROCESS with a hard timeout.  A
     wedged tunnel makes jax.devices() block forever inside the PJRT
     client constructor — in-process there is no way back, so a bench run
-    must discover it out-of-process or hang the whole driver.  The probe
-    runs a tiny computation (not just devices()) because init can succeed
-    while the data path is wedged.  Bounded retries in FRESH subprocesses:
+    must discover it out-of-process or hang the whole driver.
+
+    TWO PHASES per attempt (ISSUE 6): device ENUMERATION first, then a
+    tiny COMPUTATION — init can succeed while the data path is wedged,
+    and the two failures must publish differently ("no device" vs
+    "device present but hung").  Bounded retries in FRESH subprocesses:
     a transiently flaky tunnel often recovers between attempts, and each
     attempt starts a clean PJRT client.  Timeouts ESCALATE (60/90/150s)
     so a cold-but-working tunnel whose init+first-compile legitimately
     takes >60s still passes on a later attempt, while a wedged tunnel
-    costs a bounded ~5 min total.  Returns (ok, cause) so a missing jax
-    reads as an env problem, not a wedged tunnel."""
+    costs a bounded ~5 min total.
+
+    Returns ``(ok, skip_kind, cause)`` — skip_kind one of
+    "no-device" / "wedge-deadline" / "exception" when not ok."""
     import subprocess
     import sys
-    cause = ""
+    kind = cause = ""
     n = len(timeouts_s)
     for i, timeout_s in enumerate(timeouts_s):
+        # phase 1: enumeration only — distinguishes "tunnel wedged at
+        # init" from "no device" without paying a compile
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s, capture_output=True, text=True)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            r, timed_out = None, True
+        if timed_out or r.returncode != 0:
+            kind, msg = _classify_probe_failure(
+                r.stderr if r is not None else "", timed_out, "enum")
+            cause = (f"jax device probe ({kind}): {msg} after "
+                     f"{timeout_s}s budget, attempt {i + 1}/{n}")
+            log(f"  {cause}")
+            continue
+        # phase 2: a tiny computation through the data path
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax, jax.numpy as jnp; "
                  "jnp.ones((8,)).block_until_ready()"],
                 timeout=timeout_s, capture_output=True, text=True)
+            timed_out = False
         except subprocess.TimeoutExpired:
-            cause = (f"jax device probe timed out after {timeout_s}s "
-                     f"(wedged tunnel?), attempt {i + 1}/{n}")
+            r, timed_out = None, True
+        if timed_out or r.returncode != 0:
+            kind, msg = _classify_probe_failure(
+                r.stderr if r is not None else "", timed_out, "compute")
+            cause = (f"jax compute probe ({kind}): {msg} after "
+                     f"{timeout_s}s budget, attempt {i + 1}/{n}")
             log(f"  {cause}")
             continue
-        if r.returncode != 0:
-            tail = (r.stderr or "").strip().splitlines()[-1:] or ["no stderr"]
-            cause = (f"jax probe failed (rc={r.returncode}): {tail[0]}, "
-                     f"attempt {i + 1}/{n}")
-            log(f"  {cause}")
-            continue
-        return True, ""
-    return False, cause
+        return True, "", ""
+    return False, kind, cause
 
 
 def main():
@@ -1493,15 +1824,23 @@ def main():
     except Exception as e:
         details["dcn"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['dcn']}")
+    log("bench: per-stage host microbenches (subprocess, forced CPU)...")
+    try:
+        details["microbench"] = _run_microbench_subprocess()
+    except Exception as e:
+        details["microbench"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['microbench']}")
     log("bench: probing device reachability...")
-    device_ok, device_err = _device_reachable()
+    device_ok, skip_kind, device_err = _probe_device()
     if not device_ok:
         log(f"  {device_err}; skipping device benches")
     log("bench: serving dynamic batcher...")
     if not device_ok:
         # r5 bench discipline: a rung that cannot run must SAY so —
-        # never publish a fallback wearing the metric's name
-        details["serving"] = {"skipped": True, "reason": device_err}
+        # never publish a fallback wearing the metric's name; ISSUE 6
+        # adds the skip_reason KIND (no-device / wedge-deadline /
+        # exception) so the trajectory records WHY
+        details["serving"] = _skip_entry(skip_kind, device_err)
     else:
         try:
             details["serving"] = bench_serving()
@@ -1510,7 +1849,7 @@ def main():
     log(f"  {details['serving']}")
     log("bench: paged kv cache...")
     if not device_ok:
-        details["kvcache"] = {"skipped": True, "reason": device_err}
+        details["kvcache"] = _skip_entry(skip_kind, device_err)
     else:
         try:
             details["kvcache"] = bench_kvcache()
@@ -1519,7 +1858,7 @@ def main():
     log(f"  {details['kvcache']}")
     log("bench: engine crash recovery...")
     if not device_ok:
-        details["recovery"] = {"skipped": True, "reason": device_err}
+        details["recovery"] = _skip_entry(skip_kind, device_err)
     else:
         try:
             details["recovery"] = bench_recovery()
@@ -1528,7 +1867,7 @@ def main():
     log(f"  {details['recovery']}")
     log("bench: rpcz trace overhead...")
     if not device_ok:
-        details["trace_overhead"] = {"skipped": True, "reason": device_err}
+        details["trace_overhead"] = _skip_entry(skip_kind, device_err)
     else:
         try:
             details["trace_overhead"] = bench_trace_overhead()
@@ -1542,7 +1881,8 @@ def main():
                      ("hbm_stream", bench_hbm_stream),
                      ("ici_ladder", bench_ici_ladder)):
         if not device_ok:
-            details[name] = {"error": device_err}
+            details[name] = {"error": device_err,
+                             **_skip_entry(skip_kind, device_err)}
             continue
         log(f"bench: {name}...")
         try:
@@ -1595,5 +1935,22 @@ def main():
     print(json.dumps(line))
 
 
+def microbench_main(argv) -> None:
+    """`python bench.py microbench [--quick]`: run ONLY the per-stage
+    host microbench suite and print one JSON object on stdout (progress
+    on stderr) — the `make microbench` entry and the subprocess the
+    full bench run shells out to."""
+    quick = "--quick" in argv
+    log(f"microbench: per-stage host suite{' (quick)' if quick else ''}...")
+    out = bench_microbench(quick=quick)
+    for k, v in out.items():
+        if isinstance(v, dict):
+            log(f"  {k}: {json.dumps(v)}")
+    print(json.dumps(out))
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "microbench":
+        microbench_main(sys.argv[2:])
+    else:
+        main()

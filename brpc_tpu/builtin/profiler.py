@@ -27,6 +27,9 @@ from collections import Counter
 _WAIT_MARKERS = (
     ("threading", "wait"), ("threading", "acquire"), ("threading", "join"),
     ("threading", "_wait_for_tstate_lock"), ("queue", "get"),
+    # a thread blocked inside an instrumented hot lock (butil/lockprof)
+    # is a lock wait like any other
+    ("lockprof", "acquire"), ("lockprof", "_acquire_restore"),
 )
 
 

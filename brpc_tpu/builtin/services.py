@@ -197,9 +197,15 @@ def build_routes(server) -> dict:
 
     def metrics(req):
         # Prometheus text format (builtin/prometheus_metrics_service.cpp
-        # role).  MultiDimension variables render with their REAL label
-        # names — name{method="Echo",code="0"} — the mbvar contract.
+        # role) with honest TYPEs (ISSUE 6): LatencyRecorders export as
+        # quantile-labeled SUMMARY families, Adders (monotonic event
+        # counters throughout this codebase) as `counter`, the rest as
+        # `gauge`; every family gets a # HELP line.  MultiDimension
+        # variables render with their REAL label names —
+        # name{method="Echo",code="0"} — the mbvar contract.
         from brpc_tpu.bvar.multi_dimension import MultiDimension
+        from brpc_tpu.bvar.recorder import IntRecorder, LatencyRecorder
+        from brpc_tpu.bvar.reducer import Adder, PassiveStatus
         from brpc_tpu.bvar.variable import exposed_variables
 
         def esc(v):
@@ -208,11 +214,45 @@ def build_routes(server) -> dict:
             return (str(v).replace("\\", "\\\\")
                     .replace('"', '\\"').replace("\n", "\\n"))
 
+        def mangle(k):
+            return k.replace("-", "_").replace(".", "_").replace("/", "_")
+
+        all_vars = sorted(exposed_variables("*").items())
+        # a recorder registers <base>_latency (itself) plus satellite
+        # percentile/count gauges; the summary family subsumes those —
+        # emitting both would publish two TYPEs for one family
+        recorders = {}
+        suppress = set()
+        for k, var in all_vars:
+            if isinstance(var, LatencyRecorder) and k.endswith("_latency"):
+                base = k[: -len("_latency")]
+                recorders[base] = var
+                suppress.add(k)
+                suppress.add(base + "_count")
+                for q in ("50", "90", "99", "999", "9999"):
+                    suppress.add(f"{base}_latency_{q}")
         out = []
-        for k, var in sorted(exposed_variables("*").items()):
-            name = k.replace("-", "_").replace(".", "_").replace("/", "_")
+        for base, rec in sorted(recorders.items()):
+            name = mangle(base)
+            try:
+                c, s, _m = rec.snapshot()
+                quants = [(q, rec.latency_percentile(q))
+                          for q in (0.5, 0.9, 0.99, 0.999)]
+            except Exception:
+                continue
+            out.append(f"# HELP {name} latency recorder (microseconds)")
+            out.append(f"# TYPE {name} summary")
+            for q, v in quants:
+                out.append(f'{name}{{quantile="{q}"}} {v}')
+            out.append(f"{name}_sum {s}")
+            out.append(f"{name}_count {c}")
+        for k, var in all_vars:
+            if k in suppress:
+                continue
+            name = mangle(k)
             try:
                 if isinstance(var, MultiDimension):
+                    out.append(f"# HELP {name} bvar MultiDimension")
                     out.append(f"# TYPE {name} gauge")
                     label_names = var.labels
                     for key, lvar in var.items():
@@ -234,7 +274,16 @@ def build_routes(server) -> dict:
             if isinstance(v, bool):
                 v = int(v)
             if isinstance(v, (int, float)):
-                out.append(f"# TYPE {name} gauge")
+                if isinstance(var, Adder):
+                    kind, what = "counter", "monotonic event counter"
+                elif isinstance(var, IntRecorder):
+                    kind, what = "gauge", "average of recorded values"
+                elif isinstance(var, PassiveStatus):
+                    kind, what = "gauge", "pull-callback status"
+                else:
+                    kind, what = "gauge", type(var).__name__
+                out.append(f"# HELP {name} bvar {what}")
+                out.append(f"# TYPE {name} {kind}")
                 out.append(f"{name} {v}")
         return "\n".join(out) + "\n", "text/plain; version=0.0.4"
 
@@ -314,13 +363,91 @@ def build_routes(server) -> dict:
             return "no kv-cache stores registered\n"
         return json.dumps(snap, indent=1), "application/json"
 
-    # /hotspots profilers (hotspots_service.cpp; §5.2) — on-demand, the
-    # ?seconds= and ?fmt=collapsed knobs mirror the reference's query args
+    # /hotspots (hotspots_service.cpp; §5.2): the landing page now
+    # serves the ALWAYS-ON stage-tagged sampling profiler's ring
+    # (ISSUE 6) — folded stacks rooted at their serving stage, the
+    # gil_wait_ratio headline, and a per-stage run/wait table.
+    # ?seconds=N switches to a synchronous 100Hz burst resample;
+    # ?fmt=collapsed emits flamegraph input, ?fmt=pb the pprof proto
+    # (reusing the cpu_profile_pb encoder).  The on-demand profilers
+    # stay at /hotspots/{cpu,native,contention,heap,growth}.
     def hotspots_index(req):
-        return ("profilers: /hotspots/cpu /hotspots/native "
-                "/hotspots/contention /hotspots/heap /hotspots/growth\n"
-                "args: ?seconds=N (cpu/contention/growth), "
-                "?fmt=collapsed (flamegraph input)\n")
+        from brpc_tpu.builtin import sampler as _sampler
+        fmt = req.query.get("fmt", "text")
+        if "seconds" in req.query:
+            seconds = _seconds(req)
+            hz = 100
+            stacks = _sampler.burst(seconds, hz)
+            if fmt in ("pb", "proto"):
+                from brpc_tpu.builtin.pprof_proto import encode_profile
+                return (encode_profile(stacks, period_ns=int(1e9 / hz),
+                                       duration_ns=int(seconds * 1e9)),
+                        "application/octet-stream")
+            if fmt == "collapsed":
+                return "".join(f"{s} {n}\n"
+                               for s, n in stacks.most_common())
+            return _sampler.render_folded(
+                stacks, f"hotspot burst: {seconds}s @ {hz}Hz, "
+                        f"stage-tagged")
+        samp = _sampler.HotspotSampler.instance()
+        stacks = samp.folded()
+        if fmt in ("pb", "proto"):
+            from brpc_tpu.builtin.pprof_proto import encode_profile
+            hz = float(samp.snapshot()["hz"]) or 10.0
+            return (encode_profile(stacks, period_ns=int(1e9 / hz),
+                                   duration_ns=int(
+                                       samp.window_s * len(samp._windows())
+                                       * 1e9)),
+                    "application/octet-stream")
+        if fmt == "collapsed":
+            return "".join(f"{s} {n}\n" for s, n in stacks.most_common())
+        snap = samp.snapshot()
+        lines = [
+            f"--- always-on hotspot sampler: "
+            f"{'RUNNING' if snap['running'] else 'STOPPED'} "
+            f"@ {snap['hz']:g}Hz, {snap['windows']} windows x "
+            f"{snap['window_s']:g}s, {snap['samples']} samples ---",
+            f"gil_wait_ratio: {snap['gil_wait_ratio']} "
+            f"(lock/queue-wait samples / all samples; also a bvar on "
+            f"/brpc_metrics)",
+            "",
+        ]
+        body = _sampler.render_folded(stacks,
+                                      "ring profile (stage-tagged)") \
+            if stacks else ("(no samples yet — sampler disabled or "
+                            "just started; flip hotspot_sampler_enabled "
+                            "on /flags)\n")
+        tail = ("\nargs: ?seconds=N (synchronous 100Hz burst) "
+                "?fmt=collapsed|pb\n"
+                "locks: /hotspots/locks (contention ledger)\n"
+                "on-demand profilers: /hotspots/cpu /hotspots/native "
+                "/hotspots/contention /hotspots/heap /hotspots/growth\n")
+        return "\n".join(lines) + body + tail
+
+    def hotspots_locks(req):
+        # the lock-contention ledger (ISSUE 6; butil/lockprof.py):
+        # per-named-lock acquisitions, contended acquisitions, wait and
+        # hold latencies, and the last holder's serving stage
+        from brpc_tpu.butil.lockprof import locks_snapshot
+        snap = locks_snapshot()
+        if req.query.get("fmt") == "json":
+            return json.dumps(snap, indent=1), "application/json"
+        if not snap:
+            return "no instrumented locks registered yet\n"
+        cols = ("acquisitions", "contentions", "contention_ratio",
+                "wait_avg_us", "wait_p99_us", "wait_max_us",
+                "hold_avg_us", "hold_p99_us", "hold_max_us")
+        lines = ["--- lock-contention ledger (named hot locks; "
+                 "wait/hold recorders also on /brpc_metrics as "
+                 "lock_<name>_{wait,hold}_us) ---", "",
+                 f"{'lock':<18}" + "".join(f"{c:>18}" for c in cols)
+                 + f"  {'last_holder_stage'}"]
+        for name, st in snap.items():
+            lines.append(
+                f"{name:<18}"
+                + "".join(f"{st[c]:>18}" for c in cols)
+                + f"  {st['last_holder_stage']}")
+        return "\n".join(lines) + "\n"
 
     def _seconds(req, default=1.0):
         try:
@@ -470,6 +597,7 @@ def build_routes(server) -> dict:
         "/serving/generations": serving_generations_page,
         "/kvcache": kvcache_page,
         "/hotspots": hotspots_index,
+        "/hotspots/locks": hotspots_locks,
         "/hotspots/cpu": hotspots_cpu,
         "/hotspots/native": hotspots_native,
         "/hotspots/contention": hotspots_contention,
@@ -562,3 +690,9 @@ def _apply_flag_side_effects(name: str) -> None:
         from brpc_tpu.policy import health_check
         health_check.health_check_interval_s = \
             get_flag("health_check_interval_s", 1.0)
+    elif name == "hotspot_sampler_enabled":
+        from brpc_tpu.builtin.sampler import HotspotSampler
+        if get_flag("hotspot_sampler_enabled", True):
+            HotspotSampler.ensure_started()
+        else:
+            HotspotSampler.instance().stop()

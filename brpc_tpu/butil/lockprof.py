@@ -1,0 +1,199 @@
+"""Lock-contention ledger (ISSUE 6) — instrumented lock wrappers for
+the NAMED hot locks of the serving path.
+
+The reference profiles mutex contention by sampling contended
+pthread/bthread mutex acquisitions into folded stacks
+(bthread/mutex.cpp ContentionProfiler).  The Python-layer analog here
+is a LEDGER, not a sampler: each named hot lock (batcher queue,
+KVCacheStore, engine slot map, per-request emit buffers, rpcz submit)
+is wrapped in an :class:`InstrumentedLock` that records
+
+  * acquisitions and CONTENDED acquisitions (the fast try-acquire hit
+    means zero cost beyond one C call when uncontended),
+  * wait time per contended acquisition (LatencyRecorder — avg/p99/max
+    ride the existing /brpc_metrics scrape as a summary),
+  * hold time per critical section,
+  * the last holder's serving stage (butil/stagetag.py) — when a lock
+    is hot, "who holds it" is the actionable half of the answer.
+
+Stats are shared PER NAME, not per instance: a thousand per-request
+emit buffers aggregate into one "serving.emit_buf" ledger row, so the
+native LatencyRecorder slot pool is never exhausted by lock churn.
+
+The wrapper satisfies the ``threading.Condition`` lock protocol
+(acquire/release/_release_save/_acquire_restore/_is_owned), so a
+Condition built over it keeps correct semantics while every reacquire
+after ``wait()`` is accounted like any other acquisition.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from brpc_tpu.butil import stagetag
+
+_registry: dict[str, "LockStats"] = {}
+_registry_mu = threading.Lock()
+
+
+class LockStats:
+    """Aggregated ledger entry for one named lock (class)."""
+
+    __slots__ = ("name", "wait_rec", "hold_rec", "acquisitions",
+                 "contentions", "last_holder_stage")
+
+    def __init__(self, name: str):
+        # import here, not at module top: bvar's LatencyRecorder binds
+        # the native core, and this module must stay importable for
+        # stage tagging alone
+        from brpc_tpu.bvar import Adder, LatencyRecorder
+        self.name = name
+        safe = name.replace(".", "_").replace("-", "_")
+        self.wait_rec = LatencyRecorder(f"lock_{safe}_wait_us")
+        self.hold_rec = LatencyRecorder(f"lock_{safe}_hold_us")
+        self.acquisitions = Adder(f"lock_{safe}_acquisitions")
+        self.contentions = Adder(f"lock_{safe}_contentions")
+        self.last_holder_stage = ""
+
+    def snapshot(self) -> dict:
+        acq = self.acquisitions.get_value()
+        con = self.contentions.get_value()
+        return {
+            "acquisitions": acq,
+            "contentions": con,
+            "contention_ratio": round(con / acq, 4) if acq else 0.0,
+            "wait_avg_us": round(self.wait_rec.latency(), 1),
+            "wait_p99_us": round(self.wait_rec.latency_percentile(0.99), 1),
+            "wait_max_us": self.wait_rec.max_latency(),
+            "hold_avg_us": round(self.hold_rec.latency(), 1),
+            "hold_p99_us": round(self.hold_rec.latency_percentile(0.99), 1),
+            "hold_max_us": self.hold_rec.max_latency(),
+            "last_holder_stage": self.last_holder_stage,
+        }
+
+
+def lock_stats(name: str) -> LockStats:
+    """Get-or-create the shared ledger entry for `name`."""
+    st = _registry.get(name)
+    if st is None:
+        with _registry_mu:
+            st = _registry.get(name)
+            if st is None:
+                st = _registry[name] = LockStats(name)
+    return st
+
+
+def locks_snapshot() -> dict[str, dict]:
+    """All ledger rows — the /hotspots/locks console page's data."""
+    with _registry_mu:
+        entries = dict(_registry)
+    return {name: st.snapshot() for name, st in sorted(entries.items())}
+
+
+class InstrumentedLock:
+    """A Lock/RLock wrapper feeding the shared ledger entry `name`.
+
+    ``inner`` defaults to a plain ``threading.Lock``; pass
+    ``threading.RLock()`` for reentrant use.  Multiple wrapper
+    instances may (and for per-request locks, should) share one name.
+    """
+
+    __slots__ = ("_inner", "_is_rlock", "stats", "_depth", "_t_hold")
+
+    def __init__(self, name: str, inner=None):
+        self._inner = inner if inner is not None else threading.Lock()
+        # RLocks carry the Condition protocol natively; plain Locks
+        # need our emulation below
+        self._is_rlock = hasattr(self._inner, "_is_owned")
+        self.stats = lock_stats(name)
+        self._depth = 0          # touched only while holding the lock
+        self._t_hold = 0.0
+
+    # ---- core protocol ----
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._inner.acquire(False):
+            got = True
+        elif not blocking:
+            return False
+        else:
+            st = self.stats
+            st.contentions.add(1)
+            t0 = time.monotonic()
+            got = self._inner.acquire(True, timeout)
+            if got:
+                st.wait_rec.add(int((time.monotonic() - t0) * 1e6))
+        if got:
+            self._begin_hold()
+        return got
+
+    def release(self) -> None:
+        self._end_hold()
+        self._inner.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        locked = getattr(self._inner, "locked", None)
+        return locked() if locked is not None else self._depth > 0
+
+    # ---- hold accounting (caller holds the lock at both sites) ----
+
+    def _begin_hold(self) -> None:
+        self._depth += 1
+        if self._depth == 1:
+            self._t_hold = time.monotonic()
+            st = self.stats
+            st.acquisitions.add(1)
+            st.last_holder_stage = stagetag.current_stage()
+
+    def _end_hold(self) -> None:
+        self._depth -= 1
+        if self._depth == 0:
+            self.stats.hold_rec.add(
+                int((time.monotonic() - self._t_hold) * 1e6))
+
+    # ---- threading.Condition protocol ----
+
+    def _release_save(self):
+        """Full release (all recursion levels) for Condition.wait."""
+        depth, self._depth = self._depth, 1
+        self._end_hold()
+        if self._is_rlock:
+            return (self._inner._release_save(), depth)
+        self._inner.release()
+        return (None, depth)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, depth = state
+        st = self.stats
+        t0 = time.monotonic()
+        if self._is_rlock:
+            self._inner._acquire_restore(inner_state)
+        else:
+            self._inner.acquire()
+        waited = time.monotonic() - t0
+        # a reacquire that had to park behind another holder is real
+        # contention; an immediate reacquire is not worth a record
+        if waited >= 50e-6:
+            st.contentions.add(1)
+            st.wait_rec.add(int(waited * 1e6))
+        self._begin_hold()
+        self._depth = depth
+
+    def _is_owned(self) -> bool:
+        if self._is_rlock:
+            return self._inner._is_owned()
+        # plain-Lock emulation (mirrors threading.Condition's fallback),
+        # on the INNER lock so the probe never pollutes the ledger
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def __repr__(self) -> str:
+        return (f"<InstrumentedLock {self.stats.name!r} "
+                f"depth={self._depth}>")

@@ -1,0 +1,82 @@
+"""Serving-stage tags for host hot-path attribution (ISSUE 6).
+
+Every thread in the serving hot path belongs to a STAGE — frame pump,
+batch formation, prefill, decode step, emit fan-out, span submit — and
+both the always-on sampling profiler (builtin/sampler.py) and the
+lock-contention ledger (butil/lockprof.py) label what they observe
+with it, so a folded stack or a lock-wait spike reads as "which stage
+burned the CPU / held the lock", not just "which thread id".
+
+Two sources, explicit beats implicit:
+
+  * explicit — code that KNOWS its stage marks a region with the
+    ``stage("prefill")`` context manager (the engine thread runs
+    admit/prefill/decode on one thread, so the thread name alone
+    cannot split them);
+  * implicit — the thread-name prefix map below.  Threads the runtime
+    names (serving-batcher-*, serving-emit-*, bvar-collector) resolve
+    without any marking; foreign threads the native core registers on
+    their first Python callback show up as Dummy-N and are the frame
+    pump's Python entry points.
+
+Lookups are GIL-atomic dict reads — no lock on any hot path.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+# thread ident -> explicitly marked stage (only the owning thread
+# writes its slot; single dict ops are GIL-atomic)
+_explicit: dict[int, str] = {}
+
+# thread-name prefix -> stage (first match wins)
+_NAME_STAGES = (
+    ("serving-batcher", "batch_formation"),
+    ("serving-engine", "decode_step"),
+    ("serving-supervisor", "decode_step"),
+    ("serving-emit", "emit_fanout"),
+    ("bvar-collector", "span_submit"),
+    ("bvar-sampler", "bvar_sampler"),
+    ("hotspot-sampler", "hotspot_sampler"),
+    # native executor/dispatcher threads (the C++ frame pump) have no
+    # Python-side Thread object; threading registers them as Dummy-N
+    # the first time a callback runs Python on them
+    ("Dummy", "frame_pump"),
+    ("svc-tag-", "rpc_handler"),
+    ("usercode", "rpc_handler"),
+    ("grpc-", "rpc_handler"),
+    ("console-dashboard", "console"),
+    ("MainThread", "main"),
+)
+
+
+def stage_of(tid: int, thread_name: str = "") -> str:
+    """Stage of thread `tid` (explicit mark wins over the name map)."""
+    s = _explicit.get(tid)
+    if s is not None:
+        return s
+    for prefix, stage_name in _NAME_STAGES:
+        if thread_name.startswith(prefix):
+            return stage_name
+    return "other"
+
+
+def current_stage() -> str:
+    t = threading.current_thread()
+    return stage_of(t.ident or 0, t.name)
+
+
+@contextmanager
+def stage(name: str):
+    """Mark the calling thread as running `name` for the duration."""
+    tid = threading.get_ident()
+    prev = _explicit.get(tid)
+    _explicit[tid] = name
+    try:
+        yield
+    finally:
+        if prev is None:
+            _explicit.pop(tid, None)
+        else:
+            _explicit[tid] = prev

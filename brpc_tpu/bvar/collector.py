@@ -172,11 +172,18 @@ class Collector:
         with self._drain_lock(family):
             with self._mu:
                 batch = self._pending.pop(family, None)
+            t_cpu0 = time.thread_time()
             for s in batch or ():
                 try:
                     s.dump_and_destroy()
                 except Exception:
                     pass  # a broken sample must never kill the drainer
+            if batch and family == "rpcz":
+                # span-submit host-CPU accounting (ISSUE 6): the
+                # heavyweight half of rpcz submission runs here
+                from brpc_tpu.butil import hostcpu
+                hostcpu.add("span_submit",
+                            (time.thread_time() - t_cpu0) * 1e6)
 
     def _run(self) -> None:
         while not self._stopped:

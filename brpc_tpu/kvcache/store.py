@@ -120,7 +120,11 @@ class KVCacheStore:
         self.radix = RadixTree(self.pagepool, name=name)
         self.page_tokens = self.pagepool.page_tokens
         self.name = name
-        self._mu = threading.RLock()
+        # NAMED hot lock (ISSUE 6): acquire_prefix/extend/evict/retire
+        # all serialize here — its wait/hold ledger row on
+        # /hotspots/locks is the fine-grained-locking scorecard
+        from brpc_tpu.butil.lockprof import InstrumentedLock
+        self._mu = InstrumentedLock("kvcache.store", threading.RLock())
         self._live = 0                   # admitted-but-not-retired seqs
 
         safe = re.sub(r"\W", "_", name)

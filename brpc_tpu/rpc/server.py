@@ -398,6 +398,11 @@ class Server:
                 self.add_service(_GrpcHealth())
         from brpc_tpu.bvar.default_variables import expose_default_variables
         expose_default_variables()  # process cpu/rss/fds on /vars (§2.7)
+        # always-on stage-tagged sampling profiler (ISSUE 6): the
+        # /hotspots ring starts with the first server; flag-gated
+        # (hotspot_sampler_enabled), live-flippable on /flags
+        from brpc_tpu.builtin.sampler import HotspotSampler
+        HotspotSampler.ensure_started()
         # (re)create tagged worker pools — join() shuts them down, and a
         # Server may be started again afterwards
         from concurrent.futures import ThreadPoolExecutor

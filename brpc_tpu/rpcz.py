@@ -29,7 +29,11 @@ _span_counter = itertools.count(1)
 
 _COLLECT_MAX = 2048
 _collected: deque = deque(maxlen=_COLLECT_MAX)
-_collect_lock = threading.Lock()
+# NAMED hot lock (ISSUE 6): every submitted span's collector handoff
+# lands here — ledger row "rpcz.collect" on /hotspots/locks
+from brpc_tpu.butil.lockprof import InstrumentedLock  # noqa: E402
+
+_collect_lock = InstrumentedLock("rpcz.collect")
 # Off by default, like the reference's FLAGS_enable_rpcz: span objects are
 # only materialized when tracing is on; the hot path otherwise touches a
 # shared null span (absorbs writes, reads as zeros).  Enable via

@@ -62,6 +62,8 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from brpc_tpu import errors, fault, rpcz
+from brpc_tpu.butil import hostcpu
+from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
 
 # default sequence-length buckets: small fixed ladder so any raw length
@@ -272,7 +274,10 @@ class DynamicBatcher:
         # read once per enqueue — plain attribute, GIL-atomic
         self.brownout = 0
 
-        self._cv = threading.Condition()
+        # the batcher queue lock is a NAMED hot lock (ISSUE 6): every
+        # enqueue/formation contends here, so its wait/hold times ride
+        # the lock-contention ledger (/hotspots/locks)
+        self._cv = threading.Condition(InstrumentedLock("batcher.queue"))
         self._q: list[_Pending] = []
         self._exec_ema_s = 0.0
         self._running = True
@@ -485,6 +490,21 @@ class DynamicBatcher:
         return batch
 
     def _run_batch(self, batch: list[_Pending]) -> None:
+        # per-stage host-CPU accounting (ISSUE 6): everything this
+        # method burns on the drainer thread EXCEPT the user batch_fn
+        # call (timed separately in _execute) is batch-formation host
+        # work — the de-GIL target ROADMAP item 4 needs sized
+        t_cpu0 = time.thread_time()
+        self._fn_cpu_s = 0.0
+        try:
+            self._run_batch_inner(batch)
+        finally:
+            hostcpu.add("batch_formation",
+                        (time.thread_time() - t_cpu0 - self._fn_cpu_s)
+                        * 1e6)
+            hostcpu.add("model_compute", self._fn_cpu_s * 1e6)
+
+    def _run_batch_inner(self, batch: list[_Pending]) -> None:
         now = time.monotonic()
         live: list[_Pending] = []
         for p in batch:
@@ -582,6 +602,7 @@ class DynamicBatcher:
         self.batch_size_rec.add(n)
         self.n_batches.add(1)
         t0 = time.monotonic()
+        t_fn_cpu = time.thread_time()
         try:
             if fault.ENABLED and fault.hit(
                     "serving.batch", name=self.name, batch=n) is not None:
@@ -594,6 +615,7 @@ class DynamicBatcher:
             else:
                 out = np.asarray(self.batch_fn(padded))
         except Exception as e:
+            self._fn_cpu_s = time.thread_time() - t_fn_cpu
             # a failed batch completes EVERY member exactly once with a
             # definite error — never a hang, never a partial scatter
             self.n_errors.add(n)
@@ -604,6 +626,7 @@ class DynamicBatcher:
                            f"batch execution failed: "
                            f"{type(e).__name__}: {e}", None)
             return
+        self._fn_cpu_s = time.thread_time() - t_fn_cpu
         dt = time.monotonic() - t0
         self._exec_ema_s = dt if self._exec_ema_s == 0.0 \
             else 0.7 * self._exec_ema_s + 0.3 * dt

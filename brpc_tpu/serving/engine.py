@@ -61,6 +61,8 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from brpc_tpu import errors, fault, rpcz
+from brpc_tpu.butil import hostcpu, stagetag
+from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
 
 _req_ids = itertools.count(1)
@@ -88,7 +90,11 @@ class _EmitBuf:
     def __init__(self, cap: int):
         self.cap = cap
         self.q: deque = deque()
-        self.cv = threading.Condition()
+        # every request's emit buffer shares ONE ledger entry (ISSUE
+        # 6): per-instance stats would churn native recorder slots,
+        # and the actionable number is the class-wide step-loop-vs-
+        # emitter contention anyway
+        self.cv = threading.Condition(InstrumentedLock("serving.emit_buf"))
         self.terminal = None
         self.has_terminal = False
 
@@ -271,10 +277,13 @@ class DecodeEngine:
         self._crashed: Optional[BaseException] = None
         self._taken_over = False
         self.degraded_clamp: Optional[int] = None
+        self._prefill_fn_cpu_s = 0.0   # model-fn CPU of the last admit
         self._beat_steps = 0
         self._beat_t = time.monotonic()
 
-        self._cv = threading.Condition()
+        # the engine slot lock is a NAMED hot lock (ISSUE 6): submit,
+        # the step loop, emitter cancels and the console all meet here
+        self._cv = threading.Condition(InstrumentedLock("engine.slots"))
         self._slots: list[Optional[_Slot]] = [None] * self.num_slots
         self._waiters: deque[_Request] = deque()
         # requests popped from _waiters but not yet installed in a slot
@@ -455,8 +464,14 @@ class DecodeEngine:
                 if req.done_fired:
                     return        # finished elsewhere (close timeout path)
                 continue
+            # emit fan-out host-CPU accounting (ISSUE 6): the pop wait
+            # burns no thread_time, so measuring from here captures
+            # exactly the per-token delivery work
+            t_cpu0 = time.thread_time()
             kind, val = item
             if kind == "done":
+                hostcpu.add("emit_fanout",
+                            (time.thread_time() - t_cpu0) * 1e6)
                 req.finish(val)
                 return
             try:
@@ -466,6 +481,9 @@ class DecodeEngine:
                     errors.EINTERNAL,
                     f"emit failed: {type(e).__name__}: {e}"))
                 return
+            finally:
+                hostcpu.add("emit_fanout",
+                            (time.thread_time() - t_cpu0) * 1e6)
 
     def _cancel(self, req: _Request, err) -> None:
         """Retire `req`'s slot from OFF the engine thread (emitter saw
@@ -490,6 +508,7 @@ class DecodeEngine:
         entirely: that compute is what a cache hit buys.  A raising
         prefill retires the request (its emitter still drains the
         terminal)."""
+        self._prefill_fn_cpu_s = 0.0
         if self.prefill_fn is None or slot.seq is None:
             return
         suffix = slot.req.prompt[slot.seq.prefill_from:]
@@ -512,10 +531,13 @@ class DecodeEngine:
             pspan.annotate(f"prefill: cached={slot.seq.prefill_from} "
                            f"uncached={n} bucket={bucket}")
         t0 = time.monotonic()
+        t_fn_cpu = time.thread_time()
         try:
             self.prefill_fn(jnp.asarray(padded),
                             jnp.int32(slot.seq.prefill_from))
+            self._prefill_fn_cpu_s = time.thread_time() - t_fn_cpu
         except Exception as e:
+            self._prefill_fn_cpu_s = time.thread_time() - t_fn_cpu
             if pspan is not rpcz.NULL_SPAN:
                 pspan.error_code = errors.EINTERNAL
                 pspan.annotate(f"prefill failed: {type(e).__name__}: {e}")
@@ -602,13 +624,26 @@ class DecodeEngine:
             # cv: both are device calls and must not stall
             # submit()/stats() or the console
             for req in claimed:
-                installed = self._admit(req)
-                with self._cv:
-                    self._admitting -= 1
-                if installed is None:
-                    continue
-                i, s = installed
-                self._prefill(i, s)
+                # stage override for the sampler (ISSUE 6): admission
+                # device splices + prefill are prefill-side work even
+                # though they run on the engine thread, whose NAME maps
+                # to decode_step
+                with stagetag.stage("prefill"):
+                    t_cpu0 = time.thread_time()
+                    installed = self._admit(req)
+                    with self._cv:
+                        self._admitting -= 1
+                    if installed is None:
+                        hostcpu.add("prefill",
+                                    (time.thread_time() - t_cpu0) * 1e6)
+                        continue
+                    i, s = installed
+                    self._prefill(i, s)
+                    hostcpu.add("prefill",
+                                (time.thread_time() - t_cpu0
+                                 - self._prefill_fn_cpu_s) * 1e6)
+                    hostcpu.add("model_compute",
+                                self._prefill_fn_cpu_s * 1e6)
                 self._start_emitter(s)
                 # a long cold prefill is PROGRESS, not a wedge
                 self._touch_beat()
@@ -624,12 +659,14 @@ class DecodeEngine:
                         # distinguishable from a wedged one
                         self._cv.wait(0.25)
                     continue
+            t_cpu0 = time.thread_time()
             tok = np.zeros((self.num_slots,), np.int32)
             pos = np.zeros((self.num_slots,), np.int32)
             for i, s in active:
                 tok[i] = s.last_token
                 pos[i] = s.position
             pages = self._gather_page_tables(active)
+            t_fn_cpu = time.thread_time()
             try:
                 if fault.ENABLED and fault.hit(
                         "serving.step", name=self.name) is not None:
@@ -662,6 +699,7 @@ class DecodeEngine:
                     self._finalize_slot(s, errors.EINTERNAL)
                     s.req.buf.push_terminal(err)
                 continue
+            fn_cpu_s = time.thread_time() - t_fn_cpu
             self.steps.add(1)
             self.occupancy_rec.add(len(active))
             t_tok = time.monotonic()
@@ -673,6 +711,7 @@ class DecodeEngine:
                 s.position += 1
                 s.generated += 1
                 self.tokens_out.add(1)
+                hostcpu.tokens_total.add(1)
                 if s.last_tok_t:
                     gap = t_tok - s.last_tok_t
                     ITL_REC.add(int(gap * 1e6))
@@ -724,6 +763,11 @@ class DecodeEngine:
                         (self.eos_token is not None
                          and nxt == self.eos_token):
                     self._retire(i, None)
+            # per-stage host-CPU accounting (ISSUE 6): this iteration's
+            # step-loop bookkeeping minus the model step itself
+            hostcpu.add("decode_step",
+                        (time.thread_time() - t_cpu0 - fn_cpu_s) * 1e6)
+            hostcpu.add("model_compute", fn_cpu_s * 1e6)
 
     def _release_slot_locked(self, i: int, cache_ok: bool = True):
         """Release slot i under the cv: return the KV lease exactly once

@@ -27,6 +27,13 @@ one trace; after the run the N SLOWEST traces print as tree-ordered
 indented timelines (relative offsets, annotations).  Against an
 in-process or rpcz-enabled server the timelines include the server-side
 stage spans — the fastest way from "it's slow" to WHICH stage is slow.
+
+Hotspot attribution (--hotspots N, ISSUE 6): while the press runs, the
+SERVER's /hotspots console is asked for a stage-tagged burst profile
+covering the press duration, and the top-N folded stacks print
+alongside the latency report — load test and CPU attribution in one
+command ("it's slow" -> "decode_step is 60% lock-wait" without a
+second tool).
 """
 from __future__ import annotations
 
@@ -56,6 +63,62 @@ def dump_slowest_traces(n: int, trace_ids=None, out=sys.stderr) -> None:
     print(f"--- {len(groups)} slowest traces ---", file=out)
     for group in groups:
         print(rpcz.format_trace(group), end="", file=out)
+
+
+class HotspotFetcher:
+    """Background fetch of the target server's stage-tagged burst
+    profile (``/hotspots?seconds=N&fmt=collapsed``) for the press
+    window; ``report(top_n)`` prints the hottest folded stacks."""
+
+    def __init__(self, server: str, seconds: float):
+        self.server = server
+        self.seconds = max(0.2, min(60.0, seconds))
+        self.folded: str | None = None
+        self.error: str | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self) -> "HotspotFetcher":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        import http.client
+        host, _, port = self.server.rpartition(":")
+        try:
+            c = http.client.HTTPConnection(host or "127.0.0.1", int(port),
+                                           timeout=self.seconds + 60)
+            c.request("GET", f"/hotspots?seconds={self.seconds}"
+                             f"&fmt=collapsed")
+            r = c.getresponse()
+            body = r.read().decode("utf-8", "replace")
+            c.close()
+            if r.status != 200:
+                self.error = f"/hotspots returned {r.status}"
+            else:
+                self.folded = body
+        except Exception as e:
+            self.error = f"{type(e).__name__}: {e}"
+
+    def report(self, top_n: int, out=sys.stderr) -> None:
+        self._thread.join(self.seconds + 90)
+        if self.folded is None:
+            print(f"(no server hotspot profile: "
+                  f"{self.error or 'fetch still pending'})", file=out)
+            return
+        rows = []
+        for line in self.folded.splitlines():
+            stack, _, n = line.rpartition(" ")
+            if stack and n.isdigit():
+                rows.append((int(n), stack))
+        rows.sort(reverse=True)
+        total = sum(n for n, _ in rows) or 1
+        print(f"--- server hotspots during press "
+              f"({self.seconds:g}s burst @100Hz, {total} samples; "
+              f"top {min(top_n, len(rows))} stage-tagged stacks) ---",
+              file=out)
+        for n, stack in rows[:top_n]:
+            print(f"  [{n:>5} samples {100.0 * n / total:>5.1f}%] "
+                  f"{stack}", file=out)
 
 
 def make_prefix_skew(request, ratio: float, prefix_tokens: int = 32,
@@ -91,13 +154,16 @@ def run_press(server: str, service: str, method: str, request,
               qps: int = 0, duration_s: float = 10.0, threads: int = 4,
               serializer: str = "json", timeout_ms: int = 1000,
               connection_type: str = "single", request_factory=None,
-              dump_traces: int = 0, out=sys.stderr) -> dict:
+              dump_traces: int = 0, hotspots: int = 0,
+              out=sys.stderr) -> dict:
     """Drives the load; returns a summary dict (also printable).
     ``request_factory(k)`` (e.g. ``make_prefix_skew(...)``), when
     given, builds worker k's per-call request generator.
     ``dump_traces=N`` enables rpcz for the run (each call becomes one
     trace rooted at a press client span) and prints the N slowest
-    traces as indented timelines afterwards."""
+    traces as indented timelines afterwards.  ``hotspots=N`` runs the
+    server-side burst profiler for the press duration and prints the
+    top-N stage-tagged folded stacks alongside the latency report."""
     traced = dump_traces > 0
     rpcz_state = (rpcz.enabled(), rpcz.sample_rate())
     if traced:
@@ -106,7 +172,8 @@ def run_press(server: str, service: str, method: str, request,
         return _run_press_body(server, service, method, request, qps,
                                duration_s, threads, serializer,
                                timeout_ms, connection_type,
-                               request_factory, dump_traces, traced, out)
+                               request_factory, dump_traces, traced,
+                               hotspots, out)
     finally:
         # restore BOTH knobs, even on a mid-run exception: a press must
         # not leave a co-located server force-traced at rate 1.0
@@ -116,9 +183,12 @@ def run_press(server: str, service: str, method: str, request,
 
 def _run_press_body(server, service, method, request, qps, duration_s,
                     threads, serializer, timeout_ms, connection_type,
-                    request_factory, dump_traces, traced, out) -> dict:
+                    request_factory, dump_traces, traced, hotspots,
+                    out) -> dict:
     ch = brpc.Channel(server, timeout_ms=timeout_ms,
                       connection_type=connection_type)
+    fetcher = HotspotFetcher(server, duration_s).start() \
+        if hotspots > 0 else None
     rec = LatencyRecorder("rpc_press")
     nerr = [0]
     nok = [0]
@@ -181,6 +251,8 @@ def _run_press_body(server, service, method, request, qps, duration_s,
         "elapsed_s": round(elapsed, 2),
     }
     print(json.dumps(summary), file=out)
+    if fetcher is not None:
+        fetcher.report(hotspots, out=out)
     if traced:
         dump_slowest_traces(dump_traces, trace_ids=set(press_tids),
                             out=out)
@@ -209,7 +281,7 @@ def run_streaming_press(server: str, service: str, method: str, request,
                         serializer: str = "json", timeout_ms: int = 5000,
                         connection_type: str = "single",
                         request_factory=None, dump_traces: int = 0,
-                        out=sys.stderr) -> dict:
+                        hotspots: int = 0, out=sys.stderr) -> dict:
     """Streaming load: one client stream per call, looped per worker for
     `duration_s`.  Reports aggregate items/s and time-to-first-item
     (TTFI) percentiles; a stream that never closes within the timeout
@@ -224,7 +296,7 @@ def run_streaming_press(server: str, service: str, method: str, request,
                                    duration_s, threads, serializer,
                                    timeout_ms, connection_type,
                                    request_factory, dump_traces, traced,
-                                   out)
+                                   hotspots, out)
     finally:
         if traced:
             rpcz.set_enabled(*rpcz_state)
@@ -232,10 +304,12 @@ def run_streaming_press(server: str, service: str, method: str, request,
 
 def _run_streaming_body(server, service, method, request, duration_s,
                         threads, serializer, timeout_ms, connection_type,
-                        request_factory, dump_traces, traced,
+                        request_factory, dump_traces, traced, hotspots,
                         out) -> dict:
     ch = brpc.Channel(server, timeout_ms=timeout_ms,
                       connection_type=connection_type)
+    fetcher = HotspotFetcher(server, duration_s).start() \
+        if hotspots > 0 else None
     ttfi = LatencyRecorder("rpc_press_ttfi")
     items = [0]
     streams_ok = [0]
@@ -310,6 +384,8 @@ def _run_streaming_body(server, service, method, request, duration_s,
         "elapsed_s": round(elapsed, 2),
     }
     print(json.dumps(summary), file=out)
+    if fetcher is not None:
+        fetcher.report(hotspots, out=out)
     if traced:
         dump_slowest_traces(dump_traces, trace_ids=set(press_tids),
                             out=out)
@@ -348,6 +424,11 @@ def main(argv=None):
                     help="enable rpcz for the run and print the N "
                          "slowest traces as indented timelines after "
                          "the summary; 0 disables")
+    ap.add_argument("--hotspots", type=int, default=0,
+                    help="burst-profile the SERVER for the press "
+                         "duration (/hotspots?seconds=) and print its "
+                         "top-N stage-tagged folded stacks alongside "
+                         "the latency report; 0 disables")
     a = ap.parse_args(argv)
     text = a.input
     if text.startswith("@"):
@@ -367,6 +448,7 @@ def main(argv=None):
                             connection_type=a.connection_type,
                             request_factory=factory,
                             dump_traces=a.dump_traces,
+                            hotspots=a.hotspots,
                             out=sys.stdout)
     else:
         run_press(a.server, a.service, a.method, req, qps=a.qps,
@@ -374,7 +456,7 @@ def main(argv=None):
                   serializer=a.serializer, timeout_ms=a.timeout_ms,
                   connection_type=a.connection_type,
                   request_factory=factory, dump_traces=a.dump_traces,
-                  out=sys.stdout)
+                  hotspots=a.hotspots, out=sys.stdout)
 
 
 if __name__ == "__main__":

@@ -153,12 +153,14 @@ def test_serving_generations_page():
         mine = [r for r in snap["recent"]
                 if r.get("engine") == "console_gen_eng"]
         assert mine and mine[-1]["generated"] == 4
-        # the serving recorders ride the EXISTING Prometheus endpoint
+        # the serving recorders ride the EXISTING Prometheus endpoint —
+        # since ISSUE 6 as quantile-labeled summary families
         status, body = _get(s, "/brpc_metrics")
         assert status == 200
-        assert b"serving_ttft_us_latency" in body
-        assert b"serving_itl_us_latency" in body
-        assert b"serving_stage_decode_us_latency" in body
+        assert b"# TYPE serving_ttft_us summary" in body
+        assert b"# TYPE serving_itl_us summary" in body
+        assert b"# TYPE serving_stage_decode_us summary" in body
+        assert b'serving_ttft_us{quantile="0.99"}' in body
     finally:
         s.stop()
         s.join()
@@ -272,6 +274,10 @@ def test_every_console_route_answers(server):
         "/serving/generations", "/kvcache", "/rpcz",
         "/rpcz?trace_id=1", "/brpc_metrics",
         "/dashboard", "/vlog", "/hotspots",
+        "/hotspots?seconds=0.05",
+        "/hotspots?seconds=0.05&fmt=collapsed",
+        "/hotspots/locks",
+        "/hotspots/locks?fmt=json",
         "/hotspots/cpu?seconds=0.05",
         "/hotspots/contention?seconds=0.05",
         "/hotspots/growth?seconds=0.05",

@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""hotspots_burst — `make hotspots`: burst-profile a local serving run.
+
+Stands up a real Server + DynamicBatcher + DecodeEngine, drives mixed
+score/generate load for a few seconds, and prints what the operator
+would see on a production box:
+
+  * /hotspots           — the always-on sampler's stage-tagged ring
+  * /hotspots?seconds=N — a synchronous 100Hz burst over live load
+  * /hotspots/locks     — the lock-contention ledger
+  * the host-CPU-per-token rollup (serving_host_us_per_token)
+
+No accelerator needed: run it as `JAX_PLATFORMS=cpu python
+tools/hotspots_burst.py [--seconds N]`.
+"""
+from __future__ import annotations
+
+import argparse
+import http.client
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _get(port: int, path: str) -> str:
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    c.request("GET", path)
+    r = c.getresponse()
+    body = r.read().decode("utf-8", "replace")
+    c.close()
+    return body
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=2.0,
+                    help="burst-profile duration (load runs throughout)")
+    a = ap.parse_args(argv)
+
+    import numpy as np
+
+    import brpc_tpu as brpc
+    from brpc_tpu.butil import hostcpu
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine, DynamicBatcher
+
+    server = brpc.Server()
+    server.start("127.0.0.1", 0)
+    batcher = DynamicBatcher(lambda x: x.sum(axis=1), max_batch_size=16,
+                             max_delay_us=500, batch_buckets=(16,),
+                             length_buckets=(64,), name="hotspots_demo")
+    store = KVCacheStore(page_tokens=16, page_bytes=16 * 64,
+                         max_blocks=64, name="hotspots_demo")
+    eng = DecodeEngine(lambda t, p, g: t + 1, num_slots=4, store=store,
+                       pass_page_table=True, name="hotspots_demo")
+    stop = threading.Event()
+    item = np.ones((64,), np.float32)
+
+    def score_load():
+        while not stop.is_set():
+            try:
+                batcher.submit_wait(item, timeout_s=10)
+            except Exception:
+                pass
+
+    def gen_load():
+        shared = list(range(100, 132))
+        i = 0
+        while not stop.is_set():
+            done = threading.Event()
+            eng.submit(shared + [1000 + i, 1001 + i], 8,
+                       lambda t: None, lambda e, d=done: d.set())
+            done.wait(10)
+            i += 1
+
+    workers = [threading.Thread(target=score_load) for _ in range(3)] \
+        + [threading.Thread(target=gen_load) for _ in range(2)]
+    [t.start() for t in workers]
+    try:
+        time.sleep(1.0)   # let the ring collect a little history first
+        print(f"=== /hotspots?seconds={a.seconds} (100Hz burst over "
+              f"live serving load) ===")
+        print(_get(server.port, f"/hotspots?seconds={a.seconds}"))
+        print("=== /hotspots (always-on ring) ===")
+        print(_get(server.port, "/hotspots"))
+        print("=== /hotspots/locks (contention ledger) ===")
+        print(_get(server.port, "/hotspots/locks"))
+        print("=== host CPU per stage ===")
+        snap = hostcpu.snapshot()
+        for stage, us in snap["per_stage_us"].items():
+            print(f"  {stage:<18} {us:>12} us")
+        print(f"  tokens emitted: {snap['tokens']}  ->  "
+              f"host_us_per_token={snap['host_us_per_token']}")
+    finally:
+        stop.set()
+        [t.join(15) for t in workers]
+        eng.close()
+        store.close()
+        batcher.close()
+        server.stop()
+        server.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
