@@ -79,6 +79,20 @@ kvcache: $(LIB) $(PYEXT)
 recovery: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_supervisor.py -q
 
+# Migration suite (README "Cross-host data plane"): KV page migration
+# over the _kvmig wire — export/splice round-trips, rollback on
+# mid-splice faults, offer-table bounds, migrate-on-rebalance, the
+# /migration console page.  CPU jit path; the timed migrate-vs-
+# recompute rung runs via `python bench.py migrate`.
+migrate: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_migrate.py -q
+
+# Disaggregation suite (README "Cross-host data plane"): the
+# prefill/decode split over DcnChannel + cross-process failover
+# through the standby's write-ahead record.  CPU jit path.
+disagg: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py -q
+
 # Tracing suite (README "Observability"): rpcz generation tracing —
 # per-trace head sampling, span-tree timelines, TTFT/ITL math, trace
 # continuity across crash recovery, DCN span joins, console pages.
@@ -138,5 +152,5 @@ stress:
 	    $(STRESS_SRC) -o build/stress_plain
 	./build/stress_plain
 
-.PHONY: all clean test chaos serving kvcache recovery trace hotspots \
-    microbench bench tsan asan stress
+.PHONY: all clean test chaos serving kvcache recovery migrate disagg \
+    trace hotspots microbench bench tsan asan stress

@@ -188,6 +188,8 @@ def build_routes(server) -> dict:
                 f"res={s.response_size}B err={s.error_code}"
                 + (f" recovered_from={s.recovered_from}"
                    if s.recovered_from else "")
+                + (f" migrated_from={s.migrated_from}"
+                   if getattr(s, "migrated_from", 0) else "")
                 + ("".join(f"\n    @{t} {html.escape(m)}"
                            for t, m in s.annotations)))
         lines.append("")
@@ -361,6 +363,22 @@ def build_routes(server) -> dict:
         snap = kvcache_snapshot()
         if not snap["stores"]:
             return "no kv-cache stores registered\n"
+        return json.dumps(snap, indent=1), "application/json"
+
+    def migration_page(req):
+        # cross-host KV data plane introspection (brpc_tpu/migrate):
+        # global migrate counters, outbound/inbound route matrices,
+        # standby sync state, and the live offer-table size (idles at
+        # zero under the ack-on-pull discipline).  Lazy import, same
+        # discipline as /serving and /kvcache.
+        import sys
+        if "brpc_tpu.migrate" not in sys.modules:
+            return "no migration components registered\n"
+        from brpc_tpu.migrate import migration_snapshot
+        snap = migration_snapshot()
+        if not snap["outbound"] and not snap["inbound"] \
+                and not snap["standby"]:
+            return "no migration components registered\n"
         return json.dumps(snap, indent=1), "application/json"
 
     # /hotspots (hotspots_service.cpp; §5.2): the landing page now
@@ -596,6 +614,7 @@ def build_routes(server) -> dict:
         "/serving": serving_page,
         "/serving/generations": serving_generations_page,
         "/kvcache": kvcache_page,
+        "/migration": migration_page,
         "/hotspots": hotspots_index,
         "/hotspots/locks": hotspots_locks,
         "/hotspots/cpu": hotspots_cpu,

@@ -36,6 +36,7 @@ _NAME_STAGES = (
     ("serving-engine", "decode_step"),
     ("serving-supervisor", "decode_step"),
     ("serving-emit", "emit_fanout"),
+    ("kv-migrate", "migrate"),
     ("bvar-collector", "span_submit"),
     ("bvar-sampler", "bvar_sampler"),
     ("hotspot-sampler", "hotspot_sampler"),

@@ -47,6 +47,18 @@ layers:
                                itself fails — pressure relief is
                                unavailable, allocation pressure
                                surfaces to the caller)
+    dcn.migrate_send   MIG     error  (migrate/plane.py: the source
+                               loses the page offer before anything
+                               leaves the process — pins released,
+                               caller falls back to recompute)
+    dcn.migrate_recv   MIG     error  (migrate/plane.py: the
+                               destination refuses the Offer before
+                               pulling — the source gets a definite
+                               error, nothing was spliced)
+    migrate.splice     MIG     error  (kvcache/store.py import_prefix:
+                               the splice fails mid-import — every
+                               already-spliced page rolls back, the
+                               tree never holds a partial chain)
 
 Disabled (the default), every site is a single module-attribute check —
 ``if fault.ENABLED:`` — before ANY per-site work, so the production data

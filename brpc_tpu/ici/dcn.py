@@ -181,6 +181,15 @@ def release_offer(ticket: int) -> None:
         _offers.pop(ticket, None)
 
 
+def live_offer_count() -> int:
+    """Offers still pinned Python-side (not yet acked or TTL-swept) —
+    the offer-table bound a migration burst must leave at zero: every
+    migrate/call path acks on pull completion, the TTL sweeper is the
+    backstop for dead peers, not the steady state."""
+    with _offers_mu:
+        return len(_offers)
+
+
 def pull(address: str, ticket: int, specs: list[dict], device) -> list:
     """Pull the peer's offered arrays straight onto `device`."""
     import jax
@@ -439,6 +448,14 @@ class DcnChannel:
         # pinned until TTL)
         self._unacked_resp: Optional[int] = None
         self._ack_mu = threading.Lock()
+
+    @property
+    def channel(self):
+        """The underlying control-plane RPC channel — services that
+        ride beside the DCN data plane (the ``_kvmig`` page stream, the
+        disagg pairing RPCs) issue their control calls over the same
+        connection the handshake used."""
+        return self._ch
 
     def handshake(self) -> dict:
         """Exchange topologies (idempotent); returns the remote's."""

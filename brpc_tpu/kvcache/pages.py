@@ -209,6 +209,35 @@ class PagePool:
         return raw.reshape(count, self.kv_bytes_per_token)[:, :4] \
             .copy().view("<i4").ravel()
 
+    def page_slice(self, page: KVPage):
+        """This page's raw bytes as a DEVICE array (uint8, page_bytes
+        long) — the migration export path's zero-copy payload: sliced
+        out of the block buffer on device, it rides the DCN transfer
+        fabric without a host bounce."""
+        from brpc_tpu.ici.block_pool import _slice_bytes
+        return _slice_bytes(page.block.view(), self._offset(page),
+                            self.page_bytes)
+
+    def read_raw(self, page: KVPage) -> np.ndarray:
+        """Host copy of the page's raw bytes (the migration FALLBACK
+        payload when no transfer fabric exists, and the test oracle for
+        splice round-trips)."""
+        from brpc_tpu.ici.block_pool import host_read_count
+        host_read_count.add(1)
+        return np.asarray(self.page_slice(page)).copy()
+
+    def write_raw(self, page: KVPage, data) -> None:
+        """Splice a full page of raw bytes into `page` — the import
+        half of page migration: whatever KV layout the source page
+        held (token-id stand-ins today, real K/V vectors under a
+        pallas kernel) lands bit-exact without this module
+        interpreting it."""
+        arr = np.asarray(data, np.uint8).ravel()
+        if arr.shape[0] != self.page_bytes:
+            raise ValueError(f"raw page payload is {arr.shape[0]}B, "
+                             f"page_bytes={self.page_bytes}")
+        self._splice(page.block, arr, self._offset(page))
+
     def copy_page(self, dst: KVPage, src: KVPage) -> None:
         """Device-to-device page copy — the copy half of copy-on-write.
         Slices the source page out of its block buffer and splices it

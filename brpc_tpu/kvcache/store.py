@@ -49,12 +49,18 @@ import re
 import threading
 from typing import Optional, Sequence
 
-from brpc_tpu import rpcz
+from brpc_tpu import fault, rpcz
 from brpc_tpu.bvar import Adder, PassiveStatus
 from brpc_tpu.kvcache.pages import KVPage, PagePool
 from brpc_tpu.kvcache.radix import RadixTree
 
 _seq_ids = itertools.count(1)
+
+
+class MissingShippedPrefix(ValueError):
+    """An incremental migration import (``import_prefix(have > 0)``)
+    found the peer's already-shipped prefix chunks evicted — the peer
+    must fall back to a full send."""
 
 
 class RecoveryPin:
@@ -86,7 +92,7 @@ class KVSeq:
     must start — everything before it was served from shared pages."""
 
     __slots__ = ("seq_id", "tokens", "pages", "prefill_from", "retired",
-                 "span")
+                 "span", "committed_full")
 
     def __init__(self):
         self.seq_id = next(_seq_ids)
@@ -94,6 +100,10 @@ class KVSeq:
         self.pages: list[KVPage] = []
         self.prefill_from = 0
         self.retired = False
+        # full pages already committed LIVE to the radix tree (the
+        # commit_live_pages streaming-commit cursor) — counts pages,
+        # monotone, so each boundary commits only the new chunk
+        self.committed_full = 0
         # the owning generation's rpcz span (ISSUE 5): KV events on this
         # sequence — COW, page-alloc retries, pressure evictions, detach
         # — annotate it.  NULL_SPAN when tracing is off: every annotate
@@ -113,12 +123,21 @@ class KVCacheStore:
 
     def __init__(self, pool=None, device=None, *,
                  page_bytes: int = 1024, page_tokens: int = 16,
-                 max_blocks: int = 8, name: str = "kv"):
+                 max_blocks: int = 8, commit_live_pages: bool = False,
+                 name: str = "kv"):
         self.pagepool = PagePool(pool, device, page_bytes=page_bytes,
                                  page_tokens=page_tokens,
                                  max_blocks=max_blocks, name=name)
         self.radix = RadixTree(self.pagepool, name=name)
         self.page_tokens = self.pagepool.page_tokens
+        # streaming commit (ISSUE 7): every page a live sequence FILLS
+        # is inserted into the radix tree right away instead of at
+        # retire/detach, so a StandbySync (or a reader racing a long
+        # generation) can acquire_prefix the finished pages while the
+        # sequence is still decoding.  Safe: only FULL pages commit, the
+        # tree takes its own refs, and the partially-written tail stays
+        # exclusive — the next extend never COWs against the tree.
+        self.commit_live_pages = bool(commit_live_pages)
         self.name = name
         # NAMED hot lock (ISSUE 6): acquire_prefix/extend/evict/retire
         # all serialize here — its wait/hold ledger row on
@@ -140,6 +159,7 @@ class KVCacheStore:
         self.retired = Adder(f"kvcache_{safe}_retired")
         self.forks = Adder(f"kvcache_{safe}_forks")
         self.detached = Adder(f"kvcache_{safe}_detached")
+        self.imported = Adder(f"kvcache_{safe}_imported_pages")
         PassiveStatus(self.hit_rate).expose(f"kvcache_{safe}_hit_rate")
         PassiveStatus(self.pagepool.pages_in_use).expose(
             f"kvcache_{safe}_pages_in_use")
@@ -288,6 +308,84 @@ class KVCacheStore:
             return RecoveryPin(self, pinned,
                                len(pinned) * self.page_tokens)
 
+    def import_prefix(self, tokens: Sequence[int], payloads,
+                      *, have: int = 0, span=None) -> int:
+        """Migration splice (ISSUE 7): install `payloads` — one raw
+        page of KV bytes per full-page chunk of `tokens` past the
+        first `have`, exported by a PEER store's
+        :meth:`~brpc_tpu.kvcache.pages.PagePool.page_slice` — as
+        COMMITTED radix nodes, so the next ``admit`` of a prompt
+        opening with `tokens` prefix-hits state this process never
+        computed.  ``have`` is the incremental-shipping offset: the
+        peer believes this store already holds the first `have`
+        chunks; if eviction has since dropped any of them the import
+        raises ``MissingShippedPrefix`` (a DEFINITE signal — the peer
+        falls back to a full send) rather than splicing a chain whose
+        head is gone.
+
+        All-or-nothing: pages are allocated and spliced first, then
+        the whole chunk chain inserts into the tree under the store
+        lock (the `have`-prefix check is atomic with the insert); ANY
+        failure (allocation pressure with a dry tree, a bad payload,
+        the ``migrate.splice`` fault site) rolls every already-spliced
+        page back to the pool — a half-imported radix chain would
+        serve a prefix whose tail was never written.  Chunks the tree
+        already holds keep their existing pages (the arriving copy is
+        dropped — refcounts stay baseline).  Returns how many pages
+        the tree newly retained."""
+        tokens = [int(t) for t in tokens]
+        nfull = len(tokens) // self.page_tokens
+        payloads = list(payloads)
+        have = int(have)
+        if have < 0 or have >= nfull or nfull == 0 \
+                or len(payloads) != nfull - have:
+            raise ValueError(
+                f"import_prefix: {len(payloads)} payload pages for "
+                f"chunks {have}..{nfull} ({len(tokens)} tokens at "
+                f"{self.page_tokens}/page)")
+        fresh: list[KVPage] = []
+        try:
+            for i in range(nfull - have):
+                if fault.ENABLED and fault.hit(
+                        "migrate.splice", store=self.name,
+                        page=have + i) is not None:
+                    raise MemoryError(
+                        "injected migration splice failure")
+                page = self._alloc_page(span=span)
+                fresh.append(page)
+                self.pagepool.write_raw(page, payloads[i])
+            with self._mu:
+                pre: list = []
+                if have:
+                    # the peer skipped these chunks as already-shipped;
+                    # verify atomically with the insert — between its
+                    # last send and now, eviction may have dropped them
+                    pre = self.radix.match(tokens, max_chunks=have)
+                    if len(pre) < have:
+                        raise MissingShippedPrefix(
+                            f"incremental import expected {have} "
+                            f"resident chunks, found {len(pre)}")
+                retained = self.radix.insert(
+                    tokens[:nfull * self.page_tokens],
+                    list(pre) + fresh)
+        except BaseException:
+            # rollback: every allocated page returns to the pool; the
+            # tree never saw a partial chain
+            for page in fresh:
+                self.pagepool.unref(page)
+            raise
+        # drop the allocation refs — retained pages live on the tree's
+        # own refs; duplicate chunks' pages go straight back to the pool
+        for page in fresh:
+            self.pagepool.unref(page)
+        self.imported.add(retained)
+        if span is not None and span is not rpcz.NULL_SPAN:
+            span.annotate(
+                f"kv import: {retained}/{nfull - have} migrated pages "
+                f"spliced as committed radix nodes (chunks "
+                f"{have}..{nfull}, {nfull * self.page_tokens} tokens)")
+        return retained
+
     # ---- internals ----
 
     def _append(self, seq: KVSeq, token: int) -> None:
@@ -329,6 +427,16 @@ class KVCacheStore:
             self.pagepool.write(seq.pages[-1], slot, run)
             seq.tokens.extend(run)
             idx += k
+        if self.commit_live_pages:
+            # streaming commit: every newly FILLED page joins the radix
+            # tree now (the tree refs it; this seq keeps its own ref),
+            # so acquire_prefix/export sees a live generation's finished
+            # pages without waiting for retire/detach
+            nfull = len(seq.tokens) // self.page_tokens
+            if nfull > seq.committed_full:
+                self.radix.insert(seq.tokens[:nfull * self.page_tokens],
+                                  seq.pages[:nfull])
+                seq.committed_full = nfull
 
     def _alloc_page(self, span=None) -> KVPage:
         """Page allocation with pressure-driven eviction: on
@@ -373,22 +481,33 @@ class KVCacheStore:
         return len(self.radix.match(tokens, max_chunks=max_chunks)) \
             * self.page_tokens
 
-    def acquire_prefix(self, tokens: Sequence[int]) -> tuple:
+    def acquire_prefix(self, tokens: Sequence[int], *,
+                       full_pages: bool = False) -> tuple:
         """PINNED prefix lookup for compute that relies on the cached
         KV staying resident (the batcher's formation-time trim): like
         :meth:`probe`, but takes a ref on every matched page so
-        eviction cannot free them mid-batch.  Returns ``(hit_tokens,
-        pages)``; the caller MUST hand `pages` back to
+        eviction cannot free them mid-batch.  The default match is
+        capped one token short of the prompt — admission semantics, at
+        least one position always computes; ``full_pages=True`` lifts
+        the cap to cover a final exactly-full page (the migration
+        export wants the complete committed prefix).  Returns
+        ``(hit_tokens, pages)``; the caller MUST hand `pages` back to
         :meth:`release` once its compute finishes."""
         tokens = [int(t) for t in tokens]
         if not tokens:
             return 0, []
         with self._mu:
-            max_chunks = (len(tokens) - 1) // self.page_tokens
+            max_chunks = (len(tokens) if full_pages
+                          else len(tokens) - 1) // self.page_tokens
             pages = self.radix.match(tokens, max_chunks=max_chunks)
             for p in pages:
                 self.pagepool.ref(p)
             return len(pages) * self.page_tokens, list(pages)
+
+    def acquire_pages(self, tokens: Sequence[int]) -> tuple:
+        """Sugar for ``acquire_prefix(tokens, full_pages=True)`` — the
+        migration-export spelling."""
+        return self.acquire_prefix(tokens, full_pages=True)
 
     def release(self, pages) -> None:
         """Drop the refs taken by :meth:`acquire_prefix`."""
@@ -444,6 +563,7 @@ class KVCacheStore:
             "retired": self.retired.get_value(),
             "forks": self.forks.get_value(),
             "detached": self.detached.get_value(),
+            "imported_pages": self.imported.get_value(),
             "cow_forks": self.cow.get_value(),
             "evictions": self.evictions.get_value(),
             "radix_nodes": self.radix.node_count(),

@@ -1,0 +1,95 @@
+"""brpc_tpu.migrate — the cross-host KV data plane (ISSUE 7).
+
+Three capabilities on one page-shipping core (README "Cross-host data
+plane"):
+
+  * :class:`PageMigrator` / :class:`MigrateService` (plane.py) — a
+    committed radix prefix's pages (plus token runs, fingerprints and
+    refcounts-at-source) ship over the DCN offer/pull fabric and
+    splice into a peer :class:`~brpc_tpu.kvcache.KVCacheStore` as
+    committed radix nodes; ``migrate_on_rebalance`` wires the
+    prefix-affinity balancer's remap path to push warm prefixes to
+    their new owner instead of recomputing;
+  * disaggregated prefill/decode (disagg.py) — a
+    :class:`PrefillReplica` runs admit+prefill and streams finished
+    pages to a decode process (which installs them via the migration
+    splice and runs only the decode loop), paired by a
+    :class:`DisaggCoordinator` over DcnChannel;
+  * cross-process failover (disagg.py) — :class:`StandbySync`
+    write-ahead-streams emitted-token cursors and the live radix state
+    to a :class:`StandbyReplica`, so a process death recovers the way
+    an engine death does: exactly-once, bit-exact.
+
+Every live migrator/service self-registers here (weakly) so the
+``/migration`` console page renders route matrices and the
+kvcache_migrate_* counters without holding components alive.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+_reg_mu = threading.Lock()
+_migrators: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+_services: "weakref.WeakValueDictionary[int, object]" = \
+    weakref.WeakValueDictionary()
+_standby: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+
+
+def _register_migrator(m) -> None:
+    with _reg_mu:
+        _migrators[m.name] = m
+
+
+def _register_service(s) -> None:
+    with _reg_mu:
+        _services[id(s)] = s
+
+
+def _register_standby(s) -> None:
+    with _reg_mu:
+        _standby[s.name] = s
+
+
+def migration_snapshot() -> dict:
+    """Live migration state — the /migration console page's data:
+    global counters, per-migrator outbound route matrices, per-service
+    inbound matrices, standby sync state, and the live offer-table
+    size (must idle at zero — the ack-on-pull discipline)."""
+    from brpc_tpu.ici import dcn
+    from brpc_tpu.migrate import plane
+    with _reg_mu:
+        migrators = dict(_migrators)
+        services = dict(_services)
+        standby = dict(_standby)
+    return {
+        "counters": {
+            "pages": plane.migrate_pages.get_value(),
+            "bytes": plane.migrate_bytes.get_value(),
+            "migrations_ok": plane.migrations_ok.get_value(),
+            "migrations_failed": plane.migrations_failed.get_value(),
+            "rollbacks": plane.migrate_rollbacks.get_value(),
+            "zero_copy": plane.migrate_zero_copy.get_value(),
+            "fallback": plane.migrate_fallback.get_value(),
+            "splice_p99_us": round(
+                plane.migrate_splice_rec.latency_percentile(0.99), 1),
+            "live_offers": dcn.live_offer_count(),
+        },
+        "outbound": {name: m.stats()
+                     for name, m in sorted(migrators.items())},
+        "inbound": [s.stats() for _, s in sorted(services.items())],
+        "standby": {name: s.stats()
+                    for name, s in sorted(standby.items())},
+    }
+
+
+from brpc_tpu.migrate.plane import (  # noqa: E402,F401
+    MIGRATE_SERVICE, MigrateService, PageMigrator, chunk_fingerprints,
+    rebalance_pusher, register_migration,
+)
+from brpc_tpu.migrate.disagg import (  # noqa: E402,F401
+    DisaggCoordinator, PrefillReplica, StandbyReplica, StandbySync,
+    register_disagg_decode, register_disagg_prefill, register_standby,
+)

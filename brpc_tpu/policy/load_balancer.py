@@ -16,6 +16,7 @@ import hashlib
 import itertools
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 
 from brpc_tpu.butil.doubly_buffered import DoublyBufferedData
@@ -293,16 +294,135 @@ class PrefixAffinityLB(ConsistentHashLB):
     caches), which is the first step toward cross-host serving over
     DCN.
 
+    MIGRATE-ON-REBALANCE (ISSUE 7): with a hook installed via
+    :meth:`migrate_on_rebalance`, the balancer remembers which replica
+    each routed prefix landed on (bounded LRU of fingerprints); when a
+    ring change remaps a tracked prefix to a NEW owner, the hook fires
+    with ``(tokens, old_ep, new_ep)`` — the default
+    (`brpc_tpu.migrate.rebalance_pusher`) asks the old owner to PUSH
+    its warm pages to the new one over the ``_kvmig`` service, so the
+    remapped replica prefix-hits instead of re-prefilling.  Hooks run
+    on a dedicated ``migrate``-stage-tagged thread; a failing push
+    degrades to recompute, never blocks the remap.
+
     Use ``select_server(request_code=prefix_fingerprint(prompt))``, or
     :meth:`select_for_prompt` as sugar."""
 
     name = "prefix_affinity"
 
+    def __init__(self):
+        super().__init__()
+        self._aff_mu = threading.Lock()
+        # fingerprint -> [longest prompt seen, current owner] (ordered
+        # for LRU bounding; populated only while a hook is installed)
+        from collections import OrderedDict
+        self._routed: "OrderedDict[int, list]" = OrderedDict()
+        self._routed_cap = 1024
+        self._migrate_hook = None
+        self._migration_threads: list = []
+        self.remaps = 0
+        self.remap_migrations = 0
+        self.remap_failures = 0
+
+    def migrate_on_rebalance(self, hook, *,
+                             track_capacity: int = 1024) -> None:
+        """Install ``hook(tokens, old_ep, new_ep)`` to fire for every
+        tracked prefix a ring change hands to a new owner.  Pass
+        ``None`` to uninstall (tracking stops and the table drops)."""
+        with self._aff_mu:
+            self._migrate_hook = hook
+            self._routed_cap = int(track_capacity)
+            if hook is None:
+                self._routed.clear()
+
     def select_for_prompt(self, prompt, exclude=None,
                           chunk_tokens: int = 16):
-        return self.select_server(
-            exclude=exclude,
-            request_code=prefix_fingerprint(prompt, chunk_tokens))
+        code = prefix_fingerprint(prompt, chunk_tokens)
+        ep = self.select_server(exclude=exclude, request_code=code)
+        if ep is not None and self._migrate_hook is not None:
+            with self._aff_mu:
+                rec = self._routed.get(code)
+                if rec is None:
+                    self._routed[code] = [
+                        [int(t) for t in prompt], ep]
+                    while len(self._routed) > self._routed_cap:
+                        self._routed.popitem(last=False)
+                else:
+                    # keep the LONGEST prompt seen for this prefix:
+                    # migration ships whole committed pages, and the
+                    # longest continuation names the most of them
+                    if len(prompt) > len(rec[0]):
+                        rec[0] = [int(t) for t in prompt]
+                    rec[1] = ep
+                    self._routed.move_to_end(code)
+        return ep
+
+    def _on_servers_changed(self):
+        super()._on_servers_changed()
+        hook = self._migrate_hook
+        if hook is None:
+            return
+        with self._aff_mu:
+            snapshot = [(fp, list(rec[0]), rec[1])
+                        for fp, rec in self._routed.items()]
+        remaps = []
+        for fp, toks, old_ep in snapshot:
+            new_ep = self.select_server(request_code=fp)
+            if new_ep is None or new_ep == old_ep:
+                continue
+            remaps.append((toks, old_ep, new_ep))
+            with self._aff_mu:
+                rec = self._routed.get(fp)
+                if rec is not None:
+                    rec[1] = new_ep
+        if not remaps:
+            return
+        self.remaps += len(remaps)
+        # hooks do network IO (PushTo to the old owner): a dedicated
+        # migrate-stage thread keeps the membership-update path fast
+        # and shows up on /hotspots under its own stage
+        t = threading.Thread(target=self._run_migrations,
+                             args=(hook, remaps), daemon=True,
+                             name="kv-migrate-rebalance")
+        with self._aff_mu:
+            # keep EVERY live batch: back-to-back ring changes each
+            # spawn one, and join_migrations must wait them all out
+            self._migration_threads = [
+                x for x in self._migration_threads if x.is_alive()]
+            self._migration_threads.append(t)
+        t.start()
+
+    def _run_migrations(self, hook, remaps) -> None:
+        from brpc_tpu.butil import stagetag
+        with stagetag.stage("migrate"):
+            for toks, old_ep, new_ep in remaps:
+                try:
+                    hook(toks, old_ep, new_ep)
+                    self.remap_migrations += 1
+                except Exception:
+                    # the new owner recomputes — degraded, not broken
+                    self.remap_failures += 1
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "rebalance migration %s -> %s failed",
+                        old_ep, new_ep, exc_info=True)
+
+    def join_migrations(self, timeout_s: float = 10.0) -> bool:
+        """Wait out EVERY outstanding remap migration batch (tests,
+        graceful membership changes — tearing an old owner down while
+        an earlier batch is still pushing would fail those pushes)."""
+        deadline = threading.TIMEOUT_MAX if timeout_s is None \
+            else time.monotonic() + timeout_s
+        with self._aff_mu:
+            threads = list(self._migration_threads)
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                return False
+        with self._aff_mu:
+            self._migration_threads = [
+                x for x in self._migration_threads if x.is_alive()]
+        return True
 
 
 class LocalityAwareLB(LoadBalancer):

@@ -70,6 +70,10 @@ class Span:
     # crash-recovery link: the span_id of the pre-crash attempt this
     # span resumes (supervisor re-admission) — 0 when not a resumption
     recovered_from: int = 0
+    # cross-host migration link (ISSUE 7), mirroring recovered_from:
+    # the SOURCE process's migrate span whose pages this span spliced
+    # in — 0 when this span is not a migration destination
+    migrated_from: int = 0
 
     @property
     def latency_us(self) -> int:
@@ -99,6 +103,7 @@ class _NullSpan:
     annotations = ()
     sampled = True
     recovered_from = 0
+    migrated_from = 0
 
     def __setattr__(self, k, v):
         pass
@@ -254,6 +259,7 @@ def _db_append_locked(span: Span) -> None:
         "response_size": span.response_size,
         "error_code": span.error_code, "kind": span.kind,
         "recovered_from": span.recovered_from,
+        "migrated_from": span.migrated_from,
         "annotations": list(span.annotations)}).encode()
     _db_writer.write(rec)
     # no per-span flush: a write(2) per span would defeat buffering; the
@@ -409,6 +415,8 @@ def format_trace(spans: list[Span], indent: str = "  ") -> str:
         pad = indent * depth
         link = f" recovered_from=span {s.recovered_from}" \
             if s.recovered_from else ""
+        if s.migrated_from:
+            link += f" migrated_from=span {s.migrated_from}"
         err = f" err={s.error_code}" if s.error_code else ""
         lines.append(
             f"{pad}+{off}us [{s.kind}] {s.service}.{s.method} "

@@ -392,11 +392,99 @@ def _run_streaming_body(server, service, method, request, duration_s,
     return summary
 
 
+def run_disagg_press(prefill_addr: str, decode_addr: str, request,
+                     duration_s: float = 10.0, threads: int = 4,
+                     timeout_ms: int = 20_000, request_factory=None,
+                     out=sys.stderr) -> dict:
+    """``--disagg`` mode: drive full generations through the SPLIT
+    topology — each call runs Prefill on the prefill process (whose
+    finished pages stream to the decode store over the ``_kvmig``
+    plane) and then streams tokens from the decode process — so heavy
+    traffic exercises the page stream under load.  Reports
+    generations/s, tokens/s, time-to-first-token percentiles, and how
+    many prefills fell back to recompute (failed migrations)."""
+    from brpc_tpu.migrate import DisaggCoordinator
+    rec_ttft = LatencyRecorder("rpc_press_disagg_ttft")
+    mu = threading.Lock()
+    gens_ok = [0]
+    nerr = [0]
+    tokens = [0]
+    fallbacks = [0]
+    stop = threading.Event()
+
+    def worker(k: int):
+        # one coordinator (its own channel pair) per worker: the page
+        # stream and the token stream both scale with concurrency
+        co = DisaggCoordinator(prefill_addr, decode_addr,
+                               timeout_ms=timeout_ms)
+        gen = request_factory(k) if request_factory is not None else None
+        while not stop.is_set():
+            req = gen() if gen is not None else request
+            prompt = req.get("prompt") or [1]
+            n = int(req.get("max_new_tokens", 16))
+            first = [None]
+
+            def emit(tok, first=first):
+                if first[0] is None:
+                    first[0] = time.monotonic()
+
+            t0 = time.monotonic()
+            try:
+                res = co.generate(prompt, n, emit=emit,
+                                  timeout_s=timeout_ms / 1e3)
+            except Exception:
+                with mu:
+                    nerr[0] += 1
+                continue
+            with mu:
+                if res["error"]:
+                    nerr[0] += 1
+                    continue
+                gens_ok[0] += 1
+                tokens[0] += len(res["tokens"])
+                if res["prefill"].get("recompute_fallback"):
+                    fallbacks[0] += 1
+            if first[0] is not None:
+                rec_ttft.add(int((first[0] - t0) * 1e6))
+
+    ts = [threading.Thread(target=worker, args=(k,), daemon=True)
+          for k in range(threads)]
+    t_start = time.monotonic()
+    [t.start() for t in ts]
+    try:
+        time.sleep(duration_s)
+    finally:
+        stop.set()
+    [t.join(timeout_ms / 1e3 + 2) for t in ts]
+    elapsed = time.monotonic() - t_start
+    summary = {
+        "generations_ok": gens_ok[0],
+        "errors": nerr[0],
+        "tokens": tokens[0],
+        "generations_per_s": round(gens_ok[0] / elapsed, 1),
+        "tokens_per_s": round(tokens[0] / elapsed, 1),
+        "recompute_fallbacks": fallbacks[0],
+        "ttft_avg_us": round(rec_ttft.latency(), 1),
+        "ttft_p50_us": rec_ttft.latency_percentile(0.5),
+        "ttft_p99_us": rec_ttft.latency_percentile(0.99),
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(summary), file=out)
+    return summary
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--server", required=True, help="host:port")
-    ap.add_argument("--service", required=True)
-    ap.add_argument("--method", required=True)
+    ap.add_argument("--server", help="host:port (unary/streaming modes)")
+    ap.add_argument("--service")
+    ap.add_argument("--method")
+    ap.add_argument("--disagg", metavar="PREFILL_ADDR,DECODE_ADDR",
+                    help="drive a disaggregated prefill/decode split: "
+                         "each call runs DisaggPrefill.Prefill on the "
+                         "first address (pages stream to the decode "
+                         "store) then streams Serving.Generate tokens "
+                         "from the second; reports generations/s, "
+                         "tokens/s and TTFT percentiles")
     ap.add_argument("--input", default="{}",
                     help="JSON request body, or @file.json")
     ap.add_argument("--qps", type=int, default=0,
@@ -430,6 +518,13 @@ def main(argv=None):
                          "top-N stage-tagged folded stacks alongside "
                          "the latency report; 0 disables")
     a = ap.parse_args(argv)
+    if a.disagg is None:
+        missing = [n for n, v in (("--server", a.server),
+                                  ("--service", a.service),
+                                  ("--method", a.method)) if not v]
+        if missing:
+            ap.error(f"{', '.join(missing)} required "
+                     f"(unless --disagg is used)")
     text = a.input
     if text.startswith("@"):
         with open(text[1:]) as f:
@@ -440,7 +535,16 @@ def main(argv=None):
         factory = make_prefix_skew(req, a.shared_prefix_ratio,
                                    prefix_tokens=a.prefix_tokens,
                                    seed=a.prefix_seed)
-    if a.streaming:
+    if a.disagg:
+        try:
+            prefill_addr, decode_addr = a.disagg.split(",", 1)
+        except ValueError:
+            ap.error("--disagg needs PREFILL_ADDR,DECODE_ADDR")
+        run_disagg_press(prefill_addr.strip(), decode_addr.strip(), req,
+                         duration_s=a.duration, threads=a.threads,
+                         timeout_ms=max(a.timeout_ms, 5000),
+                         request_factory=factory, out=sys.stdout)
+    elif a.streaming:
         run_streaming_press(a.server, a.service, a.method, req,
                             duration_s=a.duration, threads=a.threads,
                             serializer=a.serializer,

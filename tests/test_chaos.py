@@ -1281,3 +1281,259 @@ def test_serving_midbatch_fault_exactly_once_and_kv_baseline(seed):
         batcher.close()
         engine.close()
         assert wait_until(lambda: occupancy() == free0, 10)
+
+
+# ---------------------------------------------------------------------------
+# scenario 13: cross-host KV migration faults + prefill-process death ->
+# standby failover (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_migration_faults_exactly_once_with_recompute_fallback(seed):
+    """Injected faults at every migration site mid-disagg uphold the
+    data-plane invariants (ISSUE 7):
+
+    * `dcn.migrate_send` / `dcn.migrate_recv` / `migrate.splice` fire
+      mid-migration -> the SOURCE's pinned pages are released (refcounts
+      and occupancy to baseline), the DESTINATION either fully splices
+      or fully rolls back (its radix tree never serves a half-imported
+      chain), and every generation completes exactly once, bit-exact,
+      via the recompute fallback;
+    * after the chaos window, migration works again and both pools
+      return to block baseline once caches drop.
+    """
+    import jax
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.migrate import (DisaggCoordinator,
+                                  register_disagg_decode,
+                                  register_disagg_prefill)
+    from brpc_tpu.serving import DecodeEngine
+
+    PT = 4
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return (tokens * 7 + positions) % 997
+
+    def expected(prompt, n):
+        last, pos, out = prompt[-1], len(prompt), []
+        for _ in range(n):
+            last = (last * 7 + pos) % 997
+            out.append(last)
+            pos += 1
+        return out
+
+    dstore = KVCacheStore(page_tokens=PT, page_bytes=256, max_blocks=32,
+                          name=f"mig_chaos_dec{seed}")
+    device_pool = dstore.pagepool.pool
+
+    def occupancy():
+        with device_pool._lock:
+            return {c: len(device_pool._free[c])
+                    for c in device_pool._free}
+
+    free0 = occupancy()
+    eng = DecodeEngine(step, num_slots=4, store=dstore,
+                       max_pages_per_slot=32,
+                       name=f"mig_chaos_eng{seed}")
+    dsrv = brpc.Server(enable_dcn=True)
+    register_disagg_decode(dsrv, dstore, eng)
+    dsrv.start("127.0.0.1", 0)
+    pstore = KVCacheStore(page_tokens=PT, page_bytes=256, max_blocks=32,
+                          name=f"mig_chaos_pre{seed}")
+    psrv = brpc.Server(enable_dcn=True)
+    replica = register_disagg_prefill(psrv, pstore,
+                                      f"127.0.0.1:{dsrv.port}")
+    psrv.start("127.0.0.1", 0)
+    try:
+        co = DisaggCoordinator(f"127.0.0.1:{psrv.port}",
+                               f"127.0.0.1:{dsrv.port}")
+        # warm the jit cache outside the fault window
+        warm = [9_000_000 + seed, 1, 2]
+        out = co.generate(warm, 1)
+        assert out["error"] is None
+
+        n = 8
+        prompts = [[seed * 100 + 1000 * g + j for j in range(13)]
+                   for g in range(n)]
+        # one fault per site, staggered by seeded offsets so different
+        # migrations (and different phases) take the hit each seed
+        plan = fault.FaultPlan(seed)
+        plan.on("dcn.migrate_send", fault.ERROR, times=1,
+                after=seed % 3)
+        plan.on("dcn.migrate_recv", fault.ERROR, times=2,
+                after=(seed // 3) % 3)
+        plan.on("migrate.splice", fault.ERROR, times=2,
+                after=1 + seed % 2)
+        fallbacks = 0
+        with fault.injected(plan):
+            for p in prompts:
+                out = co.generate(p, 5, timeout_s=60)
+                # exactly-once + bit-exact REGARDLESS of what the
+                # migration plane suffered: a failed page stream means
+                # recompute, never a wrong or missing token
+                assert out["error"] is None
+                assert out["tokens"] == expected(p, 5), \
+                    "stream diverged under migration chaos"
+                if out["prefill"]["recompute_fallback"]:
+                    fallbacks += 1
+        fired = sum(plan.injected.values())
+        assert fired >= 3, f"chaos never fired: {plan.injected}"
+        # the destination never serves a HALF-imported chain: each
+        # prompt's prefix probe is all-or-nothing at full pages
+        for p in prompts:
+            hit = dstore.probe(p + [1])
+            assert hit in (0, 3 * PT) or hit % PT == 0
+        # source pins were released under every outcome: with no live
+        # sequence, every page's only ref is the radix tree's — a
+        # leaked export pin would show as refs > 1
+        with pstore.pagepool._mu:
+            extra = [p for _, pages in pstore.pagepool._blocks.values()
+                     for p in pages if p.refs > 1]
+        assert not extra, \
+            f"migration chaos leaked source page pins: {extra}"
+        pstore.pagepool.assert_consistent()
+        dstore.pagepool.assert_consistent()
+        # post-chaos the plane works again
+        clean = [seed * 100 + 77_000 + j for j in range(13)]
+        out = co.generate(clean, 3)
+        assert out["error"] is None
+        assert out["tokens"] == expected(clean, 3)
+        assert out["prefill"]["recompute_fallback"] is False
+        assert out["prefill"]["migrated_pages"] == 3
+        # baseline once the caches drop, on BOTH ends
+        assert eng.join_idle(10)
+        pstore.clear()
+        dstore.clear()
+        assert pstore.pagepool.blocks_leased() == 0
+        assert dstore.pagepool.blocks_leased() == 0
+        assert wait_until(lambda: occupancy() == free0, 10), \
+            f"migration chaos leaked blocks: {occupancy()} != {free0}"
+    finally:
+        eng.close()
+        psrv.stop()
+        psrv.join()
+        dsrv.stop()
+        dsrv.join()
+        pstore.close()
+        dstore.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_primary_death_standby_completes_exactly_once(seed):
+    """ISSUE 7 acceptance: killing the primary process mid-generation
+    (simulated by a seeded `serving.step` crash of its unsupervised
+    engine — the in-process analog of process death, like scenario 11's
+    engine crash) yields an exactly-once, BIT-EXACT token stream
+    completed by the standby side, with `migrated_from`-linked spans
+    visible on /rpcz?trace_id= for the generation's trace."""
+    import jax
+
+    from brpc_tpu import rpcz
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.migrate import StandbySync, register_standby
+    from brpc_tpu.migrate.disagg import assume_stream
+    from brpc_tpu.serving import DecodeEngine
+
+    PT = 4
+
+    @jax.jit
+    def step(tokens, positions, pages):
+        return (tokens * 7 + positions) % 997
+
+    def expected(prompt, n):
+        last, pos, out = prompt[-1], len(prompt), []
+        for _ in range(n):
+            last = (last * 7 + pos) % 997
+            out.append(last)
+            pos += 1
+        return out
+
+    sstore = KVCacheStore(page_tokens=PT, page_bytes=256, max_blocks=32,
+                          name=f"sb_chaos_s{seed}")
+    seng = DecodeEngine(step, num_slots=4, store=sstore,
+                        max_pages_per_slot=32,
+                        name=f"sb_chaos_se{seed}")
+    ssrv = brpc.Server(enable_dcn=True)
+    replica = register_standby(ssrv, sstore, seng)
+    ssrv.start("127.0.0.1", 0)
+    standby_addr = f"127.0.0.1:{ssrv.port}"
+    pstore = KVCacheStore(page_tokens=PT, page_bytes=256, max_blocks=32,
+                          commit_live_pages=True,
+                          name=f"sb_chaos_p{seed}")
+    peng = DecodeEngine(step, num_slots=4, store=pstore,
+                        max_pages_per_slot=32,
+                        name=f"sb_chaos_pe{seed}")
+    sync = StandbySync(pstore, standby_addr, submit_fn=peng.submit,
+                       name=f"sb_chaos_sync{seed}")
+    was = (rpcz.enabled(), rpcz.sample_rate())
+    rpcz.set_enabled(True, 1.0)
+    try:
+        prompt = [seed * 10 + j for j in range(13)]
+        budget = 9
+        got, errs = [], []
+        done = threading.Event()
+        # the primary's engine crashes at a seeded step mid-generation
+        plan = fault.FaultPlan(seed).on("serving.step", fault.ERROR,
+                                        times=1, after=2 + seed % 4)
+        root = rpcz.new_span("client", "Chaos", "Failover")
+        rpcz.set_current_span(root)
+        try:
+            with fault.injected(plan):
+                sid = sync.submit(prompt, budget, got.append,
+                                  lambda e: (errs.append(e), done.set()))
+                assert done.wait(60), "primary terminal never fired"
+        finally:
+            rpcz.set_current_span(None)
+            rpcz.submit(root)
+        assert plan.injected["serving.step"] == 1
+        assert errs[0] is not None and errs[0].code == errors.EINTERNAL
+        n_before = len(got)
+        assert n_before < budget, "crash fired after the budget"
+        sync.flush(15)
+
+        out = assume_stream(standby_addr, sid, n_before, timeout_s=60)
+        assert out["error"] is None
+        full = got + out["tokens"]
+        # exactly-once and bit-exact across the process seam
+        assert full == expected(prompt, budget), \
+            f"seed {seed}: stream diverged across failover"
+        assert len(out["tokens"]) == budget - n_before
+        assert replica.stats()["assumed"] == 1
+        # the migrated pages made the resume a partial re-decode
+        # whenever at least one full page had shipped
+        if n_before + len(prompt) >= 2 * PT:
+            assert out.get("resume_prefix_hit", 0) >= PT
+
+        # migrated_from-linked spans are on the generation's trace and
+        # the console timeline renders the link
+        spans = rpcz.recent_spans(4096, root.trace_id)
+        linked = [s for s in spans if s.migrated_from]
+        assert linked, "no migrated_from-linked span on the trace"
+        import http.client
+        c = http.client.HTTPConnection("127.0.0.1", ssrv.port,
+                                       timeout=10)
+        c.request("GET", f"/rpcz?trace_id={root.trace_id}")
+        r = c.getresponse()
+        body = r.read().decode()
+        c.close()
+        assert r.status == 200
+        assert "migrated_from=span" in body
+        # baseline on both ends
+        assert seng.join_idle(10)
+        pstore.clear()
+        sstore.clear()
+        pstore.pagepool.assert_consistent()
+        sstore.pagepool.assert_consistent()
+        assert pstore.pagepool.blocks_leased() == 0
+        assert sstore.pagepool.blocks_leased() == 0
+    finally:
+        rpcz.set_enabled(*was)
+        sync.close()
+        peng.close()
+        seng.close()
+        ssrv.stop()
+        ssrv.join()
+        pstore.close()
+        sstore.close()

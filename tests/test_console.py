@@ -271,7 +271,7 @@ def test_every_console_route_answers(server):
         "/", "/index", "/status", "/vars", "/flags", "/health",
         "/version", "/connections", "/sockets", "/bthreads", "/services",
         "/protobufs", "/memory", "/ici", "/serving",
-        "/serving/generations", "/kvcache", "/rpcz",
+        "/serving/generations", "/kvcache", "/migration", "/rpcz",
         "/rpcz?trace_id=1", "/brpc_metrics",
         "/dashboard", "/vlog", "/hotspots",
         "/hotspots?seconds=0.05",
