@@ -93,6 +93,15 @@ migrate: $(LIB) $(PYEXT)
 disagg: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py -q
 
+# Cluster suite (README "Cluster front door"): the ClusterRouter —
+# resumable client sessions (drop/reconnect, replica kill, router
+# restart), prefix-affinity routing with quarantine remap, and the
+# 4-level overload gradient's ordering proof.  CPU jit path; the timed
+# router-vs-direct rung runs via `python bench.py cluster` and feeds
+# the same perf_diff gate `make bench` ends with.
+cluster: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q
+
 # Tracing suite (README "Observability"): rpcz generation tracing —
 # per-trace head sampling, span-tree timelines, TTFT/ITL math, trace
 # continuity across crash recovery, DCN span joins, console pages.
@@ -153,4 +162,4 @@ stress:
 	./build/stress_plain
 
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
-    trace hotspots microbench bench tsan asan stress
+    cluster trace hotspots microbench bench tsan asan stress

@@ -365,6 +365,21 @@ def build_routes(server) -> dict:
             return "no kv-cache stores registered\n"
         return json.dumps(snap, indent=1), "application/json"
 
+    def cluster_page(req):
+        # cluster front door introspection (ISSUE 8): per router the
+        # replica table (health / breaker isolation / quarantine /
+        # ladder level), session counts + resume stats, and the
+        # overload gradient's per-level fire counters.  Lazy import,
+        # same discipline as /serving.
+        import sys
+        if "brpc_tpu.serving" not in sys.modules:
+            return "no cluster routers registered\n"
+        from brpc_tpu.serving import cluster_snapshot
+        snap = cluster_snapshot()
+        if not snap["routers"]:
+            return "no cluster routers registered\n"
+        return json.dumps(snap, indent=1), "application/json"
+
     def migration_page(req):
         # cross-host KV data plane introspection (brpc_tpu/migrate):
         # global migrate counters, outbound/inbound route matrices,
@@ -615,6 +630,7 @@ def build_routes(server) -> dict:
         "/serving/generations": serving_generations_page,
         "/kvcache": kvcache_page,
         "/migration": migration_page,
+        "/cluster": cluster_page,
         "/hotspots": hotspots_index,
         "/hotspots/locks": hotspots_locks,
         "/hotspots/cpu": hotspots_cpu,

@@ -59,6 +59,19 @@ layers:
                                the splice fails mid-import — every
                                already-spliced page rolls back, the
                                tree never holds a partial chain)
+    router.admit       L7      error  (serving/router.py: session
+                               admission fails before any forward —
+                               the client gets a definite error,
+                               nothing crossed DCN)
+    router.forward     L7      error  (serving/router.py: one forward
+                               attempt fails pre-flight — counted as a
+                               replica failure, the driver re-routes
+                               and the session resumes after its
+                               cursor)
+    router.resume      L7      error  (serving/router.py: a client
+                               reconnect/attach fails — the session
+                               record is untouched, the client's retry
+                               replays from its cursor)
 
 Disabled (the default), every site is a single module-attribute check —
 ``if fault.ENABLED:`` — before ANY per-site work, so the production data

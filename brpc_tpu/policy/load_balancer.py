@@ -220,6 +220,17 @@ class ConsistentHashLB(LoadBalancer):
             ep = ring[(i + step) % len(ring)][1]
             if (exclude is None or ep not in exclude) and not is_broken(ep):
                 return ep
+        # nothing is both healthy and unexcluded: prefer a BROKEN but
+        # unexcluded node (it may be mid-recovery — e.g. latency-
+        # isolated yet alive) over one the caller JUST failed on.
+        # Without this, a cluster whose survivors are transiently
+        # isolated hands every retry back to the known-dead endpoint
+        # the exclusion was recording (ISSUE 8 router churn).
+        if exclude:
+            for step in range(len(ring)):
+                ep = ring[(i + step) % len(ring)][1]
+                if ep not in exclude:
+                    return ep
         return ring[i][1]
 
 

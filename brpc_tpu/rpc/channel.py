@@ -210,6 +210,12 @@ class SocketMap:
                 if free and pc in free:
                     free.remove(pc)
         CallManager.instance().on_socket_failed(sid, err)
+        # streams riding the dead connection are unrecoverable: close
+        # them so their handlers learn now (ISSUE 8 — the router's
+        # replica failover keys off on_closed, and a silently-dead
+        # peer sends no CLOSE frame)
+        from brpc_tpu.rpc.stream import StreamRegistry
+        StreamRegistry.instance().on_socket_failed(sid)
         # health check + LB notification (policy layer)
         from brpc_tpu.policy.health_check import on_connection_failed
         if ep is not None and not deliberate:

@@ -258,17 +258,81 @@ class ParallelChannel:
 
 class SelectiveChannel:
     """Retries a different sub-channel on failure; its own LB over
-    sub-channels (selective_channel.h:52-69)."""
+    sub-channels (selective_channel.h:52-69).
 
-    def __init__(self, max_retry: int = 3):
+    By default selection is round-robin over the registered
+    sub-channels.  With ``lb=`` (any
+    :class:`~brpc_tpu.policy.load_balancer.LoadBalancer`, e.g.
+    ``prefix_affinity``) and endpoints supplied to ``add_channel``,
+    selection is DELEGATED to the balancer — health-check broken
+    endpoints are skipped, the circuit breaker's recovery ramp
+    applies, and ``request_code`` routes consistently (the cluster
+    router's forward path, ISSUE 8).  ``pick``/``feedback`` expose the
+    per-attempt machinery to callers (streaming RPCs) that must drive
+    each attempt themselves rather than through ``call_sync``."""
+
+    def __init__(self, max_retry: int = 3, lb=None):
         self._channels: list[Channel] = []
+        self._endpoints: list = []       # parallel to _channels (or None)
         self.max_retry = max_retry
+        self._lb = lb
         self._counter = 0
         self._lock = threading.Lock()
 
-    def add_channel(self, channel: Channel) -> "SelectiveChannel":
+    def add_channel(self, channel: Channel,
+                    endpoint=None) -> "SelectiveChannel":
+        if endpoint is None:
+            endpoint = getattr(channel, "_endpoint", None)
         self._channels.append(channel)
+        self._endpoints.append(endpoint)
+        if self._lb is not None and endpoint is not None:
+            from brpc_tpu.policy.load_balancer import ServerNode
+            self._lb.add_server(ServerNode(endpoint))
         return self
+
+    @property
+    def channel_count(self) -> int:
+        return len(self._channels)
+
+    def _index_of(self, endpoint) -> Optional[int]:
+        for i, ep in enumerate(self._endpoints):
+            if ep == endpoint:
+                return i
+        return None
+
+    def pick(self, exclude=None, request_code: Optional[int] = None):
+        """One selection: ``(index, channel, endpoint)`` or ``None``
+        when nothing is selectable.  ``exclude`` is a set of endpoints
+        (lb mode) or indices (round-robin mode) already tried."""
+        if self._lb is not None:
+            ep = self._lb.select_server(exclude=exclude or set(),
+                                        request_code=request_code)
+            if ep is None:
+                return None
+            i = self._index_of(ep)
+            if i is None:
+                return None
+            return i, self._channels[i], ep
+        i = self._pick(exclude or set())
+        if i is None:
+            return None
+        return i, self._channels[i], self._endpoints[i]
+
+    def feedback(self, endpoint, error_code: int,
+                 latency_us: int = 0, *, breaker: bool = True) -> None:
+        """Report one attempt's outcome: the balancer adjusts its
+        weights and (with ``breaker=True``) the global circuit breaker
+        accumulates the endpoint's error/latency evidence.  Callers
+        whose attempt already rode a sub-channel ``call_sync`` pass
+        ``breaker=False`` — the channel layer fed the breaker itself,
+        and double-counting would halve its isolation thresholds."""
+        if endpoint is None:
+            return
+        if self._lb is not None:
+            self._lb.feedback(endpoint, error_code, latency_us)
+        if breaker:
+            from brpc_tpu.policy.circuit_breaker import global_breaker
+            global_breaker().on_call_end(endpoint, error_code, latency_us)
 
     def _pick(self, exclude: set[int]) -> Optional[int]:
         with self._lock:
@@ -285,19 +349,29 @@ class SelectiveChannel:
         if not self._channels:
             raise errors.RpcError(errors.ENODATA, "no sub-channels")
         tried: set[int] = set()
+        tried_eps: set = set()
         last: Exception | None = None
         max_retry = cntl.max_retry if cntl is not None and \
             cntl.max_retry is not None else self.max_retry
+        req_code = cntl.request_code if cntl is not None else None
         for _ in range(min(max_retry + 1, len(self._channels))):
-            i = self._pick(tried)
-            if i is None:
+            picked = self.pick(
+                exclude=tried_eps if self._lb is not None else tried,
+                request_code=req_code)
+            if picked is None:
                 break
+            i, _chan, ep = picked
+            if i in tried:
+                break     # balancer re-offered an already-tried replica
             tried.add(i)
+            if ep is not None:
+                tried_eps.add(ep)
             sub = Controller(timeout_ms=cntl.timeout_ms if cntl else None)
             try:
                 resp = self._channels[i].call_sync(
                     service, method, request, serializer=serializer,
                     cntl=sub)
+                self.feedback(ep, 0, sub.latency_us or 0, breaker=False)
                 if cntl is not None:
                     # callers follow the Channel contract: results land on
                     # the controller they passed in
@@ -310,6 +384,8 @@ class SelectiveChannel:
                 return resp
             except errors.RpcError as e:
                 last = e
+                self.feedback(ep, e.code, sub.latency_us or 0,
+                              breaker=False)
                 if cntl is not None:
                     cntl.set_failed(sub.error_code, sub.error_text)
                     cntl.remote_side = sub.remote_side

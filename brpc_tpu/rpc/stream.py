@@ -575,6 +575,19 @@ class StreamRegistry:
         with self._mu:
             return len(self._streams)
 
+    def on_socket_failed(self, sid: int) -> None:
+        """The bound host connection died: every stream riding it is
+        unrecoverable — DATA frames can neither arrive nor leave — so
+        each one closes NOW and its handler's ``on_closed`` fires.
+        Without this, a stream whose peer process died silently (no
+        CLOSE frame) waits forever: the cluster router's failover
+        (ISSUE 8) depends on learning about a dead replica at socket
+        speed, not at application-timeout speed."""
+        with self._mu:
+            dead = [s for s in self._streams.values() if s._sid == sid]
+        for s in dead:
+            s._on_closed_internal()
+
     @staticmethod
     def _withdraw_ticket(meta: M.RpcMeta) -> None:
         """An undeliverable DATA frame's rail ticket must still be

@@ -42,6 +42,8 @@ _engines: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
 _supervisors: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
+_routers: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
 
 
 def _register_batcher(b) -> None:
@@ -57,6 +59,23 @@ def _register_engine(e) -> None:
 def _register_supervisor(s) -> None:
     with _reg_mu:
         _supervisors[s.name] = s
+
+
+def _register_router(r) -> None:
+    with _reg_mu:
+        _routers[r.name] = r
+
+
+def cluster_snapshot() -> dict:
+    """Live routers' stats — the /cluster console page's data: per
+    router the replica table (health / breaker / quarantine / ladder
+    level), session counts, resume stats, and the gradient's per-level
+    fire counters."""
+    with _reg_mu:
+        routers = dict(_routers)
+    return {
+        "routers": {name: r.stats() for name, r in sorted(routers.items())},
+    }
 
 
 def serving_snapshot() -> dict:
@@ -135,3 +154,8 @@ from brpc_tpu.serving.service import (  # noqa: E402,F401
     ServingService, http_generate_handler, register_serving,
 )
 from brpc_tpu.serving.supervisor import EngineSupervisor  # noqa: E402,F401
+from brpc_tpu.serving.ladder import OverloadLadder  # noqa: E402,F401
+from brpc_tpu.serving.router import (  # noqa: E402,F401
+    ClusterRouter, ReplicaHandle, RouterClient, RouterService,
+    SessionTable, register_router,
+)

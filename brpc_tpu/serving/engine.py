@@ -901,6 +901,14 @@ class DecodeEngine:
             time.sleep(0.005)
         return False
 
+    def queue_depth(self) -> float:
+        """Admission backlog per slot (queued waiters + mid-admit over
+        num_slots) — the queue-depth pressure the degradation ladders
+        (supervisor and cluster router) escalate on."""
+        with self._cv:
+            queued = len(self._waiters) + self._admitting
+        return queued / max(1, self.num_slots)
+
     def stats(self) -> dict:
         with self._cv:
             slot_map = [

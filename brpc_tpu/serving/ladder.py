@@ -1,0 +1,114 @@
+"""OverloadLadder — the shared escalation/hysteresis policy behind the
+cluster's ONE overload gradient (ISSUE 8).
+
+Before this module, overload was shed at four uncoordinated points:
+the batcher's limiter, the supervisor's private degradation levels,
+the engine's clamp, and the store's pressure eviction.  The ladder is
+the policy those points now share: a list of per-level pressure
+thresholds plus the escalate/de-escalate state machine the supervisor
+grew in PR 4 —
+
+  * ESCALATION IS IMMEDIATE: the moment any pressure metric crosses a
+    level's threshold, the ladder jumps straight to that level (an
+    overloaded system must not wait out a hysteresis window to start
+    shedding);
+  * DE-ESCALATION IS HYSTERETIC: one level at a time, and only after
+    ``hysteresis_ticks`` consecutive calm ticks — a load oscillating
+    around a threshold must not flap the ladder, because shedding
+    churn is its own overload.
+
+Both the :class:`~brpc_tpu.serving.supervisor.EngineSupervisor` (three
+in-process levels: brownout / clamp / evict) and the
+:class:`~brpc_tpu.serving.router.ClusterRouter` (four cluster levels:
+shed-at-router / brownout-at-batcher / clamp-at-engine /
+evict-at-store) consult a ladder instance, so the millions-of-users
+story degrades along one coherent gradient — always shedding at the
+cheapest layer first (a refused admission costs microseconds and no
+DCN crossing; an evicted page costs a future recompute).
+
+Each level keeps a fire counter (``escalations[level]``) so tests and
+the ``/cluster`` console can PROVE the gradient ordering rather than
+assert it from vibes.
+"""
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+
+class OverloadLadder:
+    """The escalate/hysteresis state machine over per-level pressure
+    thresholds (see module docstring).
+
+    ``thresholds`` is a sequence of dicts, one per level 1..N; a level
+    is *pressed* when ANY of its metrics meets or exceeds its
+    threshold.  ``update(pressures)`` advances the machine one tick
+    and returns the (possibly unchanged) current level.  ``floor``
+    lets an outer coordinator (the cluster router) hold a component at
+    a minimum level regardless of its local pressures — the mechanism
+    that makes the router's cluster-wide gradient coherent with each
+    replica's local one.
+    """
+
+    def __init__(self, thresholds: Sequence[Mapping[str, float]], *,
+                 hysteresis_ticks: int = 5):
+        self.thresholds = tuple(dict(t) for t in thresholds)
+        self.hysteresis_ticks = int(hysteresis_ticks)
+        self.level = 0
+        self.floor = 0
+        self._calm_ticks = 0
+        # fire counters per level (index 0 unused): incremented each
+        # time an escalation first REACHES that level, so a ramp that
+        # jumps 0 -> 3 counts levels 1, 2 and 3 — the gradient-order
+        # proof reads these
+        self.escalations = [0] * (len(self.thresholds) + 1)
+        self.de_escalations = 0
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.thresholds)
+
+    def target_level(self, pressures: Mapping[str, float]) -> int:
+        """The highest level whose threshold dict has ANY metric at or
+        above its bound (0 when none are).  Metrics missing from
+        ``pressures`` don't press."""
+        lvl = 0
+        for i, th in enumerate(self.thresholds, start=1):
+            if any(k in pressures and pressures[k] >= th[k] for k in th):
+                lvl = i
+        return lvl
+
+    def update(self, pressures: Mapping[str, float]) -> int:
+        """One tick: escalate immediately to the pressed level,
+        de-escalate one level per ``hysteresis_ticks`` calm ticks,
+        never below ``floor``."""
+        target = max(self.target_level(pressures), self.floor)
+        if target > self.level:
+            for lvl in range(self.level + 1, target + 1):
+                self.escalations[lvl] += 1
+            self.level = target
+            self._calm_ticks = 0
+        elif target < self.level:
+            self._calm_ticks += 1
+            if self._calm_ticks >= self.hysteresis_ticks:
+                self.level -= 1
+                self.de_escalations += 1
+                self._calm_ticks = 0
+        else:
+            self._calm_ticks = 0
+        return self.level
+
+    def reset(self) -> None:
+        self.level = 0
+        self.floor = 0
+        self._calm_ticks = 0
+
+    def stats(self) -> dict:
+        return {
+            "level": self.level,
+            "floor": self.floor,
+            "num_levels": self.num_levels,
+            "hysteresis_ticks": self.hysteresis_ticks,
+            "calm_ticks": self._calm_ticks,
+            "escalations": list(self.escalations[1:]),
+            "de_escalations": self.de_escalations,
+        }

@@ -1,0 +1,1288 @@
+"""Cluster front door — resumable client sessions and one coherent
+overload gradient across N serving replicas (ISSUE 8 tentpole).
+
+The blueprint's client machinery (combo channels, circuit breakers,
+health checks, quarantine) and the serving stack's data-plane
+robustness (PR 4 in-process failover, PR 7 cross-process page
+migration) existed side by side with nothing composing them: a client
+talking to a dead replica still lost its generation, and overload was
+shed at four uncoordinated points.  The :class:`ClusterRouter` is that
+composition — assembled from the EXISTING pieces, not new transport:
+
+ROUTING.  A :class:`~brpc_tpu.rpc.combo_channels.SelectiveChannel`
+over one ``Channel`` per replica, its selection delegated to a
+:class:`~brpc_tpu.policy.load_balancer.PrefixAffinityLB`: prompts
+route by prefix fingerprint to the replica whose radix tree holds
+their pages, health-check-broken and quarantined replicas are walked
+past on the ring (remapping ONLY their share of prefixes), and every
+attempt outcome feeds the balancer and the global circuit breaker.
+Repeated forward failures quarantine the replica exactly the way the
+supervisor quarantines a flapping engine.
+
+RESUMABLE SESSIONS ("RPC Considered Harmful": the transport must not
+re-do work the data plane preserved).  Every generation through the
+router is a SESSION — a durable ``session_id`` plus the emitted-token
+cursor record, the same cursor discipline as
+:class:`~brpc_tpu.migrate.StandbySync`.  The session record lives in a
+caller-owned :class:`SessionTable` that survives router restarts.  On
+any interruption —
+
+  * the CLIENT drops: the generation keeps decoding; tokens accumulate
+    in the session record;
+  * the REPLICA dies mid-decode: the router re-routes (prefix-affinity
+    first, any healthy replica as fallback) and resumes the generation
+    from ``prompt + emitted`` — bit-exact, because decode restarts at
+    the exact (token, position) cursor — riding prefill-skip/page
+    migration for the committed prefix rather than re-decoding it;
+  * the ROUTER restarts: a new router adopting the same SessionTable
+    marks in-flight sessions suspended and resumes them on reconnect —
+
+the client reconnects with its ``session_id`` + cursor and receives
+exactly the tokens past its cursor: replayed from the record first,
+live after.  Exactly-once to any client view, by the cursor argument.
+
+With ``replicate_sessions=True`` the router doubles as a migration
+coordinator: at page boundaries it asks the serving replica to push
+the session's committed full pages to its ring BUDDY (the replica a
+failover would land on) over the ``_kvmig`` ``PushTo`` RPC — so a
+resume after a replica kill prefix-hits pages that crossed DCN before
+the crash, and ``re_decoded_tokens < total``.
+
+THE OVERLOAD GRADIENT.  One :class:`~brpc_tpu.serving.ladder.
+OverloadLadder` (the escalation/hysteresis policy extracted from the
+supervisor) over cluster-wide pressures, four levels, each shedding at
+the cheapest layer that still relieves the pressure:
+
+  level 1  SHED AT ROUTER — new sessions refused with ELIMIT and a
+           ``retry_after_s`` hint, before the request ever crosses DCN
+           (driven by the server-level concurrency limiter and the
+           session-capacity ratio);
+  level 2  + BROWNOUT AT BATCHER — every replica sheds its
+           deadline-less lane at admission;
+  level 3  + CLAMP AT ENGINE — new generations' budgets clamped;
+  level 4  + EVICT AT STORE — aggressive cache eviction each tick.
+
+Replica supervisors keep their own local ladders; the router holds
+them at a FLOOR (``EngineSupervisor.set_level_floor``) so the cluster
+gradient and the local ones are one coherent ordering, and per-level
+fire counters prove shed fires before brownout before clamp before
+evict (and hysteresis de-escalates in reverse).
+
+Fault sites ``router.admit`` / ``router.forward`` / ``router.resume``
+thread the router into the chaos suite (scenario 14).  The ``/cluster``
+console page renders the replica table, session stats, and the ladder.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+from brpc_tpu import errors, fault, rpcz
+from brpc_tpu.bvar import Adder, PassiveStatus
+from brpc_tpu.rpc.service import Service, method
+from brpc_tpu.serving.ladder import OverloadLadder
+
+ROUTER_SERVICE = "Router"
+
+# what each gradient level DOES, cheapest first — the /cluster page and
+# the ordering tests key off these names
+LEVEL_ACTIONS = ("shed_at_router", "brownout_at_batcher",
+                 "clamp_at_engine", "evict_at_store")
+
+# default cluster ladder: level i fires when ANY metric crosses its
+# bound.  sessions_ratio = live sessions / capacity; the replica_*
+# metrics are the MAX over local replica handles (same quantities the
+# supervisor's in-process ladder reads).
+DEFAULT_ROUTER_LADDER = (
+    {"sessions_ratio": 0.80, "replica_queue_delay_us": 50_000.0,
+     "replica_pool_ratio": 0.75, "replica_queue_depth": 2.0},
+    {"sessions_ratio": 0.88, "replica_queue_delay_us": 100_000.0,
+     "replica_pool_ratio": 0.85, "replica_queue_depth": 4.0},
+    {"sessions_ratio": 0.94, "replica_queue_delay_us": 150_000.0,
+     "replica_pool_ratio": 0.92, "replica_queue_depth": 6.0},
+    {"sessions_ratio": 0.98, "replica_queue_delay_us": 200_000.0,
+     "replica_pool_ratio": 0.96, "replica_queue_depth": 8.0},
+)
+
+# terminal codes that mean THE REPLICA broke, not the generation: the
+# session survives and the driver re-routes (EOVERCROWDED means the
+# ROUTER fell behind as a consumer — re-route rather than kill the
+# session; tokens already recorded are never re-delivered)
+FAILOVER_CODES = frozenset({errors.EFAILEDSOCKET, errors.ELOGOFF,
+                            errors.EINTERNAL, errors.ERPCTIMEDOUT,
+                            errors.EOVERCROWDED})
+
+
+class ReplicaHandle:
+    """One serving replica behind the router: its address, plus — when
+    the replica lives in this process — the local components the
+    cluster gradient drives directly (supervisor floor, batcher
+    brownout, engine clamp, store evict).  Remote replicas are routing
+    targets only; their local ladders still follow the router's shed
+    because less traffic is forwarded to them."""
+
+    def __init__(self, addr: str, *, name: Optional[str] = None,
+                 supervisor=None, batcher=None, engine=None, store=None,
+                 server=None):
+        from brpc_tpu.butil.endpoint import str2endpoint
+        self.addr = str(addr)
+        self.endpoint = str2endpoint(self.addr)
+        self.name = name or self.addr
+        self.supervisor = supervisor
+        self.batcher = batcher
+        self.engine = engine
+        self.store = store
+        self.server = server
+
+    def pressures(self) -> dict:
+        """This replica's local pressure triple (empty when remote)."""
+        out = {}
+        if self.batcher is not None:
+            try:
+                out["queue_delay_us"] = float(
+                    self.batcher.queue_delay_rec.latency_percentile(0.99))
+            except Exception:
+                pass
+        st = self.store
+        if st is None and self.supervisor is not None:
+            st = self.supervisor.store
+        if st is not None:
+            try:
+                s = st.pagepool.stats()
+                cap = s["max_blocks"] * s["pages_per_block"]
+                if cap:
+                    out["pool_ratio"] = s["pages_in_use"] / cap
+            except Exception:
+                pass
+        eng = self.engine
+        if eng is None and self.supervisor is not None:
+            eng = self.supervisor.engine
+        if eng is not None:
+            try:
+                out["queue_depth"] = eng.queue_depth()
+            except Exception:
+                pass
+        return out
+
+
+class Session:
+    """One durable generation through the router: the prompt, the
+    budget, and the emitted-token record that IS the resume cursor.
+    Token delivery to the (at most one) attached client is serialized
+    by ``delivery_mu`` — replay-on-attach and live appends form one
+    ordered stream, so a reconnecting client can neither miss nor
+    double-receive a token."""
+
+    __slots__ = ("sid", "prompt", "budget", "emitted", "state",
+                 "error_code", "replica", "resumes", "re_decoded_tokens",
+                 "replicated_pages", "shipped_pages", "created_t",
+                 "finished_t", "trace", "mu", "delivery_mu", "_sink",
+                 "_sink_done", "attach_epoch")
+
+    def __init__(self, sid: str, prompt: Sequence[int], budget: int):
+        self.sid = sid
+        self.prompt = [int(t) for t in prompt]
+        self.budget = int(budget)
+        self.emitted: list[int] = []     # the durable cursor record
+        self.state = "running"           # running|suspended|finished|failed
+        self.error_code = 0
+        self.replica: Optional[str] = None
+        self.resumes = 0
+        self.re_decoded_tokens = 0
+        self.replicated_pages = 0        # pushed to the ring buddy
+        self.shipped_pages = 0           # full pages already enqueued
+        self.created_t = time.monotonic()
+        self.finished_t: Optional[float] = None
+        self.trace = rpcz.current_trace_ctx()
+        self.mu = threading.Lock()
+        # ordering lock for sink delivery: acquired FIRST when both are
+        # needed, never held while holding mu is required by others
+        self.delivery_mu = threading.Lock()
+        self._sink: Optional[Callable[[int], None]] = None
+        self._sink_done: Optional[Callable] = None
+        self.attach_epoch = 0
+
+    @property
+    def cursor(self) -> int:
+        return len(self.emitted)
+
+    def append(self, tok: int) -> int:
+        """Record one decoded token (the write-ahead: the record is
+        always a superset of any client's view) and deliver it to the
+        attached client, detaching on a dead sink.  Returns the new
+        cursor."""
+        with self.delivery_mu:
+            with self.mu:
+                self.emitted.append(int(tok))
+                cur = len(self.emitted)
+                sink = self._sink
+            if sink is not None:
+                try:
+                    sink(int(tok))
+                except Exception:
+                    # the client died mid-delivery: detach, keep
+                    # decoding — its reconnect replays from its cursor
+                    with self.mu:
+                        if self._sink is sink:
+                            self._sink = None
+                            self._sink_done = None
+            return cur
+
+    def attach(self, cursor: int, sink: Callable[[int], None],
+               sink_done: Optional[Callable] = None) -> int:
+        """Attach (or re-attach) a client at ``cursor``: replay every
+        recorded token past it, then subscribe for live tokens.  A
+        newer attach wins (the previous client is detached).  Returns
+        the number of tokens replayed.  If the session already
+        finished, the terminal is delivered after the replay."""
+        if cursor < 0 or cursor > len(self.emitted):
+            raise errors.RpcError(
+                errors.EREQUEST,
+                f"cursor {cursor} outside the recorded stream "
+                f"({len(self.emitted)} tokens)")
+        with self.delivery_mu:
+            with self.mu:
+                self.attach_epoch += 1
+                self._sink = None        # fence the previous client
+                self._sink_done = None
+                backlog = self.emitted[cursor:]
+                state, err_code = self.state, self.error_code
+            for t in backlog:
+                sink(t)
+            if state in ("finished", "failed"):
+                if sink_done is not None:
+                    err = None if not err_code else errors.RpcError(
+                        err_code, "session terminal (replayed)")
+                    sink_done(err)
+            else:
+                with self.mu:
+                    self._sink = sink
+                    self._sink_done = sink_done
+            return len(backlog)
+
+    def detach(self) -> None:
+        with self.delivery_mu:
+            with self.mu:
+                self._sink = None
+                self._sink_done = None
+
+    def finish(self, err) -> bool:
+        """Deliver the terminal exactly once.  Returns False when the
+        session already reached a terminal state."""
+        with self.delivery_mu:
+            with self.mu:
+                if self.state in ("finished", "failed"):
+                    return False
+                self.state = "failed" if err is not None else "finished"
+                self.error_code = err.code if err is not None else 0
+                self.finished_t = time.monotonic()
+                sink_done = self._sink_done
+                self._sink = None
+                self._sink_done = None
+            if sink_done is not None:
+                try:
+                    sink_done(err)
+                except Exception:
+                    pass
+            return True
+
+    def snapshot(self) -> dict:
+        with self.mu:
+            return {
+                "session_id": self.sid,
+                "state": self.state,
+                "prompt_len": len(self.prompt),
+                "budget": self.budget,
+                "cursor": len(self.emitted),
+                "replica": self.replica,
+                "resumes": self.resumes,
+                "re_decoded_tokens": self.re_decoded_tokens,
+                "replicated_pages": self.replicated_pages,
+                "error_code": self.error_code,
+            }
+
+
+class SessionTable:
+    """The durable session record store — CALLER-owned, like the KV
+    store is to the engine: a router restart builds a new
+    :class:`ClusterRouter` over the SAME table and every in-flight
+    session resumes instead of recomputing.  Finished sessions are
+    kept (bounded ring) so a late reconnect can still replay its
+    tail."""
+
+    def __init__(self, *, keep_finished: int = 512):
+        self._mu = threading.Lock()
+        self._sessions: dict[str, Session] = {}
+        self._finished: deque = deque(maxlen=max(keep_finished, 1))
+        self.keep_finished = int(keep_finished)
+        self.opened_total = 0
+
+    def new_session(self, prompt: Sequence[int], budget: int) -> Session:
+        sid = uuid.uuid4().hex[:16]
+        s = Session(sid, prompt, budget)
+        with self._mu:
+            self._sessions[sid] = s
+            self.opened_total += 1
+        return s
+
+    def get(self, sid: str) -> Optional[Session]:
+        with self._mu:
+            return self._sessions.get(sid)
+
+    def note_finished(self, s: Session) -> None:
+        """Move a finished session into the bounded keep-ring (evicting
+        the oldest finished record past capacity)."""
+        with self._mu:
+            if s.sid not in self._sessions:
+                return
+            if len(self._finished) == self._finished.maxlen:
+                old = self._finished[0]
+                self._sessions.pop(old.sid, None)
+            self._finished.append(s)
+
+    def suspend_running(self) -> int:
+        """Mark every running session suspended (router shutdown /
+        crash adoption): a later attach restarts its driver."""
+        n = 0
+        with self._mu:
+            sessions = list(self._sessions.values())
+        for s in sessions:
+            with s.mu:
+                if s.state == "running":
+                    s.state = "suspended"
+                    n += 1
+        return n
+
+    def counts(self) -> dict:
+        with self._mu:
+            sessions = list(self._sessions.values())
+        out = {"running": 0, "suspended": 0, "finished": 0, "failed": 0}
+        for s in sessions:
+            out[s.state] = out.get(s.state, 0) + 1
+        out["total"] = len(sessions)
+        out["opened_total"] = self.opened_total
+        return out
+
+    def live_count(self) -> int:
+        with self._mu:
+            sessions = list(self._sessions.values())
+        return sum(1 for s in sessions
+                   if s.state in ("running", "suspended"))
+
+    def snapshot(self, limit: int = 50) -> list[dict]:
+        with self._mu:
+            sessions = list(self._sessions.values())
+        sessions.sort(key=lambda s: s.created_t)
+        return [s.snapshot() for s in sessions[-limit:]]
+
+
+class _ForwardCollector:
+    """Stream handler for ONE forward attempt: tokens go straight into
+    the session record (which fans them to the attached client), the
+    terminal latches here for the driver to classify."""
+
+    def __init__(self, router: "ClusterRouter", session: Session):
+        self.router = router
+        self.session = session
+        self.error: Optional[int] = None
+        self.prefix_hit = 0
+        self.done = threading.Event()
+        self._terminal_seen = False
+
+    def on_received_messages(self, stream, messages):
+        for m in messages:
+            try:
+                d = json.loads(m)
+            except ValueError:
+                continue
+            if "token" in d:
+                cur = self.session.append(int(d["token"]))
+                self.router._on_session_progress(self.session, cur)
+            if d.get("done"):
+                self._terminal_seen = True
+                if d.get("error"):
+                    self.error = int(d["error"])
+                self.done.set()
+
+    def on_closed(self, stream):
+        if not self._terminal_seen and self.error is None:
+            # the stream died under the generation (replica kill,
+            # socket loss): a truncated stream is a FAILOVER, never a
+            # completed generation (an error already latched — e.g.
+            # the driver's progress deadline — is kept)
+            self.error = errors.EFAILEDSOCKET
+        self.done.set()
+
+    def on_idle_timeout(self, stream):
+        pass
+
+
+class ClusterRouter:
+    """The routing service in front of N serving replicas (see module
+    docstring).  ``replicas`` is a sequence of addresses or
+    :class:`ReplicaHandle`\\ s; pass ``sessions=`` an existing
+    :class:`SessionTable` to adopt a previous router's sessions."""
+
+    def __init__(self, replicas: Sequence, *,
+                 sessions: Optional[SessionTable] = None,
+                 limiter=None,
+                 max_sessions: int = 256,
+                 ladder: Sequence[dict] = DEFAULT_ROUTER_LADDER,
+                 hysteresis_ticks: int = 3,
+                 check_interval_s: float = 0.05,
+                 auto_tick: bool = True,
+                 replicate_sessions: bool = False,
+                 page_tokens: int = 16,
+                 chunk_tokens: int = 16,
+                 clamp_new_tokens: int = 32,
+                 ladder_evict_pages: Optional[int] = None,
+                 quarantine_after: int = 3,
+                 failure_window_s: float = 60.0,
+                 name: str = "router",
+                 timeout_ms: int = 10_000,
+                 progress_timeout_s: float = 30.0):
+        from brpc_tpu.policy.load_balancer import PrefixAffinityLB
+        from brpc_tpu.rpc.channel import Channel
+        from brpc_tpu.rpc.combo_channels import SelectiveChannel
+
+        self.name = name
+        self.timeout_ms = int(timeout_ms)
+        self.progress_timeout_s = float(progress_timeout_s)
+        self.chunk_tokens = int(chunk_tokens)
+        self.page_tokens = int(page_tokens)
+        self.max_sessions = int(max_sessions)
+        self.clamp_new_tokens = int(clamp_new_tokens)
+        self.ladder_evict_pages = ladder_evict_pages
+        self.quarantine_after = int(quarantine_after)
+        self.failure_window_s = float(failure_window_s)
+        self.replicate_sessions = bool(replicate_sessions)
+        self.check_interval_s = float(check_interval_s)
+
+        self.replicas: list[ReplicaHandle] = [
+            r if isinstance(r, ReplicaHandle) else ReplicaHandle(r)
+            for r in replicas]
+        if not self.replicas:
+            raise ValueError("a router needs at least one replica")
+        self._lb = PrefixAffinityLB()
+        self._sel = SelectiveChannel(max_retry=len(self.replicas),
+                                     lb=self._lb)
+        self._by_ep: dict = {}
+        self._chan_by_ep: dict = {}
+        self._ep_by_name: dict = {}      # str(endpoint) / addr -> endpoint
+        for h in self.replicas:
+            ch = Channel(h.addr, timeout_ms=self.timeout_ms)
+            self._sel.add_channel(ch, endpoint=h.endpoint)
+            self._by_ep[h.endpoint] = h
+            self._chan_by_ep[h.endpoint] = ch
+            self._ep_by_name[str(h.endpoint)] = h.endpoint
+            self._ep_by_name[h.addr] = h.endpoint
+
+        self.sessions = sessions if sessions is not None else SessionTable()
+        # adopting a table from a dead router: its running sessions have
+        # no driver anymore — suspend them so attach restarts the drive
+        self.sessions.suspend_running()
+
+        if limiter is not None:
+            from brpc_tpu.policy.concurrency_limiter import create_limiter
+            limiter = create_limiter(limiter)
+        self.limiter = limiter
+
+        self._ladder = OverloadLadder(ladder,
+                                      hysteresis_ticks=hysteresis_ticks)
+        self._applied_level = 0
+        self._mu = threading.Lock()
+        self._failures: dict = {}        # endpoint -> [monotonic times]
+        self._drivers: dict[str, threading.Thread] = {}
+
+        safe = re.sub(r"\W", "_", name)
+        from brpc_tpu.bvar.variable import exposed_variables
+        pre = set(exposed_variables(f"router_{safe}*"))
+        self.shed_total = Adder(f"router_{safe}_shed")
+        self.forwards = Adder(f"router_{safe}_forwards")
+        self.resumes_total = Adder(f"router_{safe}_resumes")
+        self.replays_total = Adder(f"router_{safe}_replayed_tokens")
+        self.reconnects = Adder(f"router_{safe}_reconnects")
+        # per-level gradient action counters — the ordering proof
+        self.gradient_fired = {
+            a: Adder(f"router_{safe}_{a}") for a in LEVEL_ACTIONS}
+        PassiveStatus(lambda: self._ladder.level).expose(
+            f"router_{safe}_level")
+        self._bvar_names = [n for n in exposed_variables(f"router_{safe}*")
+                            if n not in pre]
+
+        # buddy replication worker (resume-over-migration): PushTo jobs
+        # coalesce per session, never ride the token path
+        self._ship_cv = threading.Condition()
+        self._ship_q: deque = deque()
+        self._ship_pending: set[str] = set()
+
+        self._running = True
+        self._threads: list[threading.Thread] = []
+        if self.replicate_sessions:
+            t = threading.Thread(target=self._ship_loop, daemon=True,
+                                 name=f"router-ship-{safe}")
+            t.start()
+            self._threads.append(t)
+        if auto_tick:
+            t = threading.Thread(target=self._tick_loop, daemon=True,
+                                 name=f"router-ladder-{safe}")
+            t.start()
+            self._threads.append(t)
+
+        from brpc_tpu import serving as _serving
+        _serving._register_router(self)
+
+    # ---- admission (gradient level 1 lives here) ----
+
+    def retry_after_s(self) -> float:
+        """The Retry-After hint attached to a router shed: one full
+        de-escalation window — earlier retries would land inside the
+        same overload plateau and be shed again."""
+        return round(max(0.25, self._ladder.hysteresis_ticks *
+                         self.check_interval_s), 3)
+
+    def open_session(self, prompt: Sequence[int],
+                     max_new_tokens: int) -> Session:
+        """Admit one generation: shed-at-router (ELIMIT with a
+        ``retry_after_s`` hint in the error text) before anything
+        crosses DCN, else create the durable session and start its
+        driver."""
+        if fault.ENABLED and fault.hit("router.admit",
+                                       name=self.name) is not None:
+            raise errors.RpcError(errors.EINTERNAL,
+                                  "injected router admit failure")
+        live = self.sessions.live_count()
+        shed_text = None
+        if not self._running:
+            raise errors.RpcError(errors.ELOGOFF, "router closed")
+        if self._ladder.level >= 1:
+            shed_text = (f"overload gradient level {self._ladder.level}: "
+                         f"shed at router")
+        elif self.limiter is not None and \
+                not self.limiter.on_requested(live + 1):
+            shed_text = "router concurrency limiter rejected the session"
+        elif live + 1 > self.max_sessions:
+            shed_text = (f"session capacity {self.max_sessions} reached")
+        if shed_text is not None:
+            self.shed_total.add(1)
+            self.gradient_fired["shed_at_router"].add(1)
+            raise errors.RpcError(
+                errors.ELIMIT,
+                f"{shed_text}; retry_after_s={self.retry_after_s()}")
+        s = self.sessions.new_session(prompt, max_new_tokens)
+        self._start_driver(s)
+        return s
+
+    def attach(self, sid: str, cursor: int,
+               sink: Callable[[int], None],
+               sink_done: Optional[Callable] = None) -> dict:
+        """Client (re)connect: replay the recorded tokens past
+        ``cursor``, subscribe for live ones, and — when the session was
+        suspended (router restart / dead driver) — restart the drive.
+        Returns ``{"replayed": n, "cursor": new_cursor}``."""
+        if not self._running:
+            # a closed router can no longer drive a suspended session:
+            # tell the client now (reconnect to the successor) instead
+            # of replaying a backlog that never reaches a terminal
+            raise errors.RpcError(errors.ELOGOFF, "router closed")
+        if fault.ENABLED and fault.hit("router.resume", sid=sid) is not None:
+            raise errors.RpcError(errors.EINTERNAL,
+                                  "injected router resume failure")
+        s = self.sessions.get(sid)
+        if s is None:
+            raise errors.RpcError(errors.EREQUEST,
+                                  f"unknown session {sid!r}")
+        replayed = s.attach(cursor, sink, sink_done)
+        if replayed:
+            self.replays_total.add(replayed)
+        self.reconnects.add(1)
+        restart = False
+        with s.mu:
+            if s.state == "suspended":
+                s.state = "running"
+                restart = True
+        if restart:
+            self._start_driver(s)
+        return {"replayed": replayed, "cursor": cursor + replayed}
+
+    # ---- the session driver (forward + failover) ----
+
+    def _start_driver(self, s: Session) -> None:
+        t = threading.Thread(target=self._drive, args=(s,), daemon=True,
+                             name=f"router-session-{s.sid[:8]}")
+        with self._mu:
+            self._drivers[s.sid] = t
+        t.start()
+
+    def _drive(self, s: Session) -> None:
+        from brpc_tpu.policy.load_balancer import prefix_fingerprint
+        from brpc_tpu.rpc.controller import Controller
+        from brpc_tpu.rpc.stream import stream_create
+        fp = prefix_fingerprint(s.prompt, self.chunk_tokens)
+        excluded: set = set()
+        attempts = 0
+        max_attempts = 3 * len(self.replicas) + 3
+        first_attempt = True
+        try:
+            while self._running:
+                with s.mu:
+                    if s.state != "running":
+                        return
+                    remaining = s.budget - len(s.emitted)
+                    resume_prompt = s.prompt + s.emitted
+                if remaining <= 0:
+                    self._finish_session(s, None)
+                    return
+                attempts += 1
+                if attempts > max_attempts:
+                    self._finish_session(s, errors.RpcError(
+                        errors.EINTERNAL,
+                        f"router gave up after {attempts - 1} forward "
+                        f"attempts"))
+                    return
+                if attempts > 1:
+                    # bounded backoff between attempts: a refusal storm
+                    # must not burn the whole attempt budget before
+                    # health marking / breaker recovery can land
+                    time.sleep(min(0.25, 0.01 * (attempts - 1)))
+                picked = self._sel.pick(exclude=excluded, request_code=fp)
+                if picked is None and excluded:
+                    # everything healthy was tried this round: start a
+                    # fresh round (a probe may have revived someone)
+                    excluded = set()
+                    picked = self._sel.pick(exclude=excluded,
+                                            request_code=fp)
+                if picked is None:
+                    self._finish_session(s, errors.RpcError(
+                        errors.ENODATA, "no routable replica"))
+                    return
+                _i, chan, ep = picked
+                if not first_attempt:
+                    with s.mu:
+                        s.resumes += 1
+                    self.resumes_total.add(1)
+                if fault.ENABLED and fault.hit(
+                        "router.forward", replica=str(ep)) is not None:
+                    self._note_replica_failure(ep, errors.EINTERNAL)
+                    excluded.add(ep)
+                    first_attempt = False
+                    continue
+                col = _ForwardCollector(self, s)
+                cntl = Controller(timeout_ms=self.timeout_ms)
+                stream = stream_create(cntl, col)
+                t0 = time.monotonic()
+                try:
+                    resp = chan.call_sync(
+                        "Serving", "Generate",
+                        {"prompt": resume_prompt,
+                         "max_new_tokens": remaining},
+                        serializer="json", cntl=cntl)
+                except errors.RpcError as e:
+                    # the forward RPC itself failed (replica server
+                    # gone): channel layer already fed the breaker.
+                    # The never-bound stream must close here or it
+                    # leaks in the StreamRegistry forever (no socket
+                    # failure can ever reap it)
+                    try:
+                        stream.close()
+                    except Exception:
+                        pass
+                    self._sel.feedback(ep, e.code, breaker=False)
+                    self._note_replica_failure(ep, e.code)
+                    excluded.add(ep)
+                    first_attempt = False
+                    continue
+                self.forwards.add(1)
+                hit = int((resp or {}).get("prefix_hit", 0))
+                with s.mu:
+                    s.replica = str(ep)
+                    if not first_attempt:
+                        # what this failover actually re-decodes: the
+                        # resume prompt minus what the new replica's
+                        # cache already held (committed prefix ridden
+                        # via prefill-skip / page migration)
+                        s.re_decoded_tokens += max(
+                            0, len(resume_prompt) - hit)
+                # wait out the attempt; wake periodically so a closing
+                # router suspends instead of blocking forever, and
+                # watch a PROGRESS deadline — a replica that accepted
+                # the forward but neither emits nor closes (server
+                # alive, engine wedged) must read as a failover, not
+                # hang the session until router close
+                last_cursor = s.cursor
+                last_progress = time.monotonic()
+                while self._running:
+                    if col.done.wait(0.1):
+                        break
+                    cur = s.cursor
+                    if cur != last_cursor:
+                        last_cursor = cur
+                        last_progress = time.monotonic()
+                    elif (time.monotonic() - last_progress
+                          > self.progress_timeout_s):
+                        col.error = errors.ERPCTIMEDOUT
+                        try:
+                            stream.close()
+                        except Exception:
+                            pass
+                        break
+                    with s.mu:
+                        if s.state != "running":
+                            break
+                with s.mu:
+                    still_running = s.state == "running"
+                if not self._running or not still_running:
+                    try:
+                        stream.close()
+                    except Exception:
+                        pass
+                    return
+                latency_us = int((time.monotonic() - t0) * 1e6)
+                if col.error is None:
+                    self._sel.feedback(ep, 0, latency_us, breaker=False)
+                    self._finish_session(s, None)
+                    return
+                if col.error in FAILOVER_CODES:
+                    # replica failure mid-stream: quarantine evidence,
+                    # re-route, resume after the recorded cursor
+                    self._sel.feedback(ep, col.error, latency_us,
+                                       breaker=True)
+                    self._note_replica_failure(ep, col.error)
+                    excluded = {ep}
+                    first_attempt = False
+                    continue
+                # the generation's own terminal error: definite
+                self._finish_session(s, errors.RpcError(
+                    col.error, "replica terminal error"))
+                return
+            # router closing: suspend (a successor adopts the table)
+            with s.mu:
+                if s.state == "running":
+                    s.state = "suspended"
+        finally:
+            with self._mu:
+                self._drivers.pop(s.sid, None)
+
+    def cancel_session(self, s: Session, err=None) -> None:
+        """Abort a session no client can ever reach (e.g. its Generate
+        attach failed after admission): deliver the terminal, release
+        the limiter slot, and let the driver notice the state flip and
+        stop forwarding — without this, the orphan decodes its whole
+        budget for nobody while counting against ``max_sessions``."""
+        if err is None:
+            err = errors.RpcError(errors.ELOGOFF, "session cancelled")
+        self._finish_session(s, err)
+
+    def _finish_session(self, s: Session, err) -> None:
+        if s.finish(err):
+            code = err.code if err is not None else 0
+            if self.limiter is not None:
+                dur_us = int((time.monotonic() - s.created_t) * 1e6)
+                self.limiter.on_responded(code, dur_us)
+            self.sessions.note_finished(s)
+
+    def _note_replica_failure(self, ep, code: int) -> None:
+        """Forward-failure evidence: feeds the breaker's isolation
+        counter and — past ``quarantine_after`` failures inside the
+        window — marks the endpoint broken, exactly the supervisor's
+        flapping-replica discipline.  The prefix-affinity ring then
+        walks past it, remapping only ITS share of prefixes."""
+        now = time.monotonic()
+        with self._mu:
+            times = self._failures.setdefault(ep, [])
+            times.append(now)
+            times[:] = [t for t in times
+                        if t > now - self.failure_window_s]
+            n = len(times)
+        try:
+            from brpc_tpu.policy.circuit_breaker import global_breaker
+            breaker = global_breaker()
+            breaker.on_socket_failed(ep)
+            if n >= self.quarantine_after:
+                breaker.mark_as_broken(ep)
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "router replica-failure report failed")
+
+    # ---- buddy replication (resume-over-migration) ----
+
+    def _on_session_progress(self, s: Session, cursor: int) -> None:
+        if not self.replicate_sessions:
+            return
+        with s.mu:
+            full = (len(s.prompt) + cursor) // self.page_tokens
+            ship = full > s.shipped_pages
+            if ship:
+                s.shipped_pages = full
+        if ship:
+            with self._ship_cv:
+                if s.sid not in self._ship_pending:
+                    self._ship_pending.add(s.sid)
+                    self._ship_q.append(s.sid)
+                    self._ship_cv.notify()
+
+    def _ship_loop(self) -> None:
+        from brpc_tpu.butil import stagetag
+        while True:
+            with self._ship_cv:
+                while self._running and not self._ship_q:
+                    self._ship_cv.wait(0.25)
+                if not self._running:
+                    return
+                sid = self._ship_q.popleft()
+                self._ship_pending.discard(sid)
+            with stagetag.stage("migrate"):
+                try:
+                    self._ship_one(sid)
+                except Exception:
+                    import logging
+                    logging.getLogger(__name__).info(
+                        "session buddy replication failed", exc_info=True)
+
+    def _ship_one(self, sid: str) -> None:
+        """Ask the session's serving replica to push its committed
+        full pages to the ring BUDDY — the replica a failover of this
+        prefix would land on — over the ``_kvmig`` PushTo RPC.  A
+        failing push degrades the future resume to recompute; it never
+        touches the token path."""
+        from brpc_tpu.policy.load_balancer import prefix_fingerprint
+        s = self.sessions.get(sid)
+        if s is None:
+            return
+        with s.mu:
+            if s.state != "running" or s.replica is None:
+                return
+            toks = s.prompt + s.emitted
+            cur_addr = s.replica
+        cur_ep = self._ep_by_name.get(cur_addr)
+        fp = prefix_fingerprint(s.prompt, self.chunk_tokens)
+        buddy = self._lb.select_server(
+            exclude={cur_ep} if cur_ep is not None else set(),
+            request_code=fp)
+        if buddy is None or str(buddy) == cur_addr:
+            return
+        picked = self._chan_by_ep.get(cur_ep)
+        if picked is None:
+            return
+        full = len(toks) // self.page_tokens * self.page_tokens
+        if not full:
+            return
+        buddy_h = self._by_ep.get(buddy)
+        dest = buddy_h.addr if buddy_h is not None else str(buddy)
+        out = picked.call_sync(
+            "_kvmig", "PushTo",
+            {"tokens": toks[:full], "dest": dest},
+            serializer="json", response_serializer="json")
+        pages = int((out or {}).get("migrated_pages", 0))
+        if pages:
+            with s.mu:
+                s.replicated_pages = max(s.replicated_pages, pages)
+
+    # ---- the cluster overload gradient ----
+
+    def _tick_loop(self) -> None:
+        while self._running:
+            time.sleep(self.check_interval_s)
+            if not self._running:
+                return
+            try:
+                self._tick()
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "router ladder tick failed")
+
+    def _pressures(self) -> dict:
+        cap = self.max_sessions
+        if self.limiter is not None:
+            lim = self.limiter.max_concurrency()
+            if lim > 0:
+                cap = min(cap, lim)
+        out = {"sessions_ratio": self.sessions.live_count() / max(1, cap)}
+        qd = pool = depth = 0.0
+        for h in self.replicas:
+            p = h.pressures()
+            qd = max(qd, p.get("queue_delay_us", 0.0))
+            pool = max(pool, p.get("pool_ratio", 0.0))
+            depth = max(depth, p.get("queue_depth", 0.0))
+        out["replica_queue_delay_us"] = qd
+        out["replica_pool_ratio"] = pool
+        out["replica_queue_depth"] = depth
+        return out
+
+    def _tick(self) -> int:
+        lvl = self._ladder.update(self._pressures())
+        self._apply_level(lvl)
+        return lvl
+
+    def _apply_level(self, lvl: int) -> None:
+        prev = self._applied_level
+        if lvl > prev:
+            # count each action the FIRST time the ramp reaches it —
+            # the gradient-ordering proof (shed counted at the actual
+            # refusals in open_session; the flag here marks the level
+            # transition itself for levels without a local component)
+            for step in range(prev + 1, lvl + 1):
+                if 2 <= step <= len(LEVEL_ACTIONS):
+                    self.gradient_fired[LEVEL_ACTIONS[step - 1]].add(1)
+        self._applied_level = lvl
+        for h in self.replicas:
+            if h.supervisor is not None:
+                # replica supervisors keep their own ladders; the
+                # cluster holds them at a floor so both gradients agree
+                h.supervisor.set_level_floor(max(0, lvl - 1))
+                continue
+            if h.batcher is not None:
+                h.batcher.brownout = max(h.batcher.brownout, 1) \
+                    if lvl >= 2 else 0
+            if h.engine is not None:
+                h.engine.degraded_clamp = self.clamp_new_tokens \
+                    if lvl >= 3 else None
+            if lvl >= 4 and h.store is not None:
+                n = self.ladder_evict_pages
+                if n is None:
+                    try:
+                        n = h.store.pagepool.pages_per_block
+                    except Exception:
+                        n = 4
+                try:
+                    h.store.evict_pages(n)
+                except Exception:
+                    pass
+
+    @property
+    def level(self) -> int:
+        return self._ladder.level
+
+    # ---- lifecycle / introspection ----
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        """Stop routing.  Running sessions are SUSPENDED (their records
+        stay in the caller-owned SessionTable for the next router),
+        replica-side gradient effects are undone, and this router's
+        bvars are hidden."""
+        self._running = False
+        with self._ship_cv:
+            self._ship_cv.notify_all()
+        deadline = time.monotonic() + timeout_s
+        with self._mu:
+            drivers = list(self._drivers.values())
+        for t in self._threads + drivers:
+            t.join(max(0.0, deadline - time.monotonic()))
+        self.sessions.suspend_running()
+        # undo gradient side effects on caller-owned components
+        self._ladder.reset()
+        self._apply_level(0)
+        for h in self.replicas:
+            if h.supervisor is not None:
+                h.supervisor.set_level_floor(0)
+        from brpc_tpu.bvar.variable import find_exposed
+        for n in self._bvar_names:
+            v = find_exposed(n)
+            if v is not None:
+                v.hide()
+
+    def replica_table(self) -> list[dict]:
+        from brpc_tpu.policy.circuit_breaker import global_breaker
+        from brpc_tpu.policy.health_check import is_broken
+        breaker = global_breaker()
+        with self._mu:
+            fail_counts = {ep: len(ts) for ep, ts in self._failures.items()}
+        out = []
+        for h in self.replicas:
+            row = {
+                "name": h.name,
+                "addr": h.addr,
+                "healthy": not is_broken(h.endpoint),
+                "quarantined": is_broken(h.endpoint),
+                "breaker_isolations": breaker.isolation_count(h.endpoint),
+                "recent_failures": fail_counts.get(h.endpoint, 0),
+                "local": any(x is not None for x in
+                             (h.supervisor, h.batcher, h.engine, h.store)),
+            }
+            if h.supervisor is not None:
+                row["ladder_level"] = h.supervisor.level
+                row["state"] = h.supervisor.state
+            out.append(row)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "name": self.name,
+            "replicas": self.replica_table(),
+            "sessions": self.sessions.counts(),
+            "ladder": self._ladder.stats(),
+            "level_actions": list(LEVEL_ACTIONS),
+            "gradient_fired": {a: c.get_value()
+                               for a, c in self.gradient_fired.items()},
+            "shed": self.shed_total.get_value(),
+            "forwards": self.forwards.get_value(),
+            "resumes": self.resumes_total.get_value(),
+            "reconnects": self.reconnects.get_value(),
+            "replayed_tokens": self.replays_total.get_value(),
+            "retry_after_s": self.retry_after_s(),
+            "replicate_sessions": self.replicate_sessions,
+        }
+
+
+class RouterService(Service):
+    """RPC surface of a ClusterRouter: streaming ``Generate`` (fresh
+    session) and ``Resume`` (reconnect with ``session_id`` + cursor).
+    Token messages carry the cursor — ``{"token": t, "cursor": i}`` —
+    so clients can checkpoint without counting."""
+
+    NAME = ROUTER_SERVICE
+
+    def __init__(self, router: ClusterRouter):
+        self._router = router
+
+    def _attach_stream(self, cntl, sess_or_sid, cursor: int):
+        router = self._router
+        stream = cntl.accept_stream()
+        state = {"cursor": cursor}
+
+        def emit(tok: int) -> None:
+            state["cursor"] += 1
+            stream.write(json.dumps(
+                {"token": int(tok),
+                 "cursor": state["cursor"]}).encode(), timeout_s=2.0)
+
+        def on_done(err) -> None:
+            msg = {"done": True, "session_id": sid}
+            if err is not None:
+                msg["error"] = err.code
+                msg["error_text"] = err.text
+            try:
+                stream.write(json.dumps(msg).encode(), timeout_s=2.0)
+            except errors.RpcError:
+                pass
+            stream.close()
+
+        if isinstance(sess_or_sid, Session):
+            sid = sess_or_sid.sid
+            info = router.attach(sid, cursor, emit, on_done)
+        else:
+            sid = str(sess_or_sid)
+            info = router.attach(sid, cursor, emit, on_done)
+        return sid, info
+
+    @method(request="json", response="json")
+    def Generate(self, cntl, req):
+        req = req or {}
+        prompt = req.get("prompt") or [0]
+        max_new = int(req.get("max_new_tokens", 16))
+        try:
+            sess = self._router.open_session(prompt, max_new)
+        except errors.RpcError as e:
+            cntl.set_failed(e.code, e.text)    # ELIMIT text carries
+            return None                        # retry_after_s=<hint>
+        try:
+            sid, _ = self._attach_stream(cntl, sess, 0)
+        except errors.RpcError as e:
+            # the client never learned the session_id: an admitted-but-
+            # unattachable session would decode its whole budget for
+            # nobody — cancel it
+            self._router.cancel_session(sess, e)
+            cntl.set_failed(e.code, e.text)
+            return None
+        return {"accepted": True, "session_id": sid}
+
+    @method(request="json", response="json")
+    def Resume(self, cntl, req):
+        req = req or {}
+        sid = req.get("session_id")
+        if not sid:
+            cntl.set_failed(errors.EREQUEST, 'missing "session_id"')
+            return None
+        cursor = int(req.get("cursor", 0))
+        try:
+            sid, info = self._attach_stream(cntl, str(sid), cursor)
+        except errors.RpcError as e:
+            cntl.set_failed(e.code, e.text)
+            return None
+        return {"accepted": True, "session_id": sid, **info}
+
+    @method(request="json", response="json")
+    def Stats(self, cntl, req):
+        return self._router.stats()
+
+
+def register_router(server, router: ClusterRouter) -> RouterService:
+    """Expose `router` on `server` (call before ``server.start()``)."""
+    svc = RouterService(router)
+    server.add_service(svc)
+    return svc
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+class _ClientCollector:
+    """Client stream handler: tokens + cursors + the terminal, with the
+    session_id latched from the done message."""
+
+    def __init__(self, emit: Optional[Callable[[int], None]] = None):
+        self.tokens: list[int] = []
+        self.cursor = 0
+        self.session_id: Optional[str] = None
+        self.error: Optional[int] = None
+        self.done = threading.Event()
+        self._emit = emit
+        self._terminal_seen = False
+
+    def on_received_messages(self, stream, messages):
+        for m in messages:
+            try:
+                d = json.loads(m)
+            except ValueError:
+                continue
+            if "token" in d:
+                t = int(d["token"])
+                self.tokens.append(t)
+                self.cursor = int(d.get("cursor", self.cursor + 1))
+                if self._emit is not None:
+                    self._emit(t)
+            if d.get("done"):
+                self._terminal_seen = True
+                if d.get("session_id"):
+                    self.session_id = str(d["session_id"])
+                if d.get("error"):
+                    self.error = int(d["error"])
+                self.done.set()
+
+    def on_closed(self, stream):
+        if not self._terminal_seen:
+            self.error = errors.EFAILEDSOCKET
+        self.done.set()
+
+    def on_idle_timeout(self, stream):
+        pass
+
+
+class LiveGeneration:
+    """One in-flight client-side generation: collects tokens, exposes
+    the cursor, and can DROP the connection mid-stream (the client-
+    failure half of the chaos scenario)."""
+
+    def __init__(self, session_id: str, collector: _ClientCollector,
+                 stream):
+        self.session_id = session_id
+        self._col = collector
+        self._stream = stream
+
+    @property
+    def tokens(self) -> list[int]:
+        return list(self._col.tokens)
+
+    @property
+    def cursor(self) -> int:
+        return self._col.cursor
+
+    @property
+    def error(self) -> Optional[int]:
+        return self._col.error
+
+    def wait(self, timeout_s: float = 30.0) -> bool:
+        return self._col.done.wait(timeout_s)
+
+    def wait_tokens(self, n: int, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(self._col.tokens) >= n or self._col.done.is_set():
+                return len(self._col.tokens) >= n
+            time.sleep(0.005)
+        return False
+
+    def drop(self) -> None:
+        """Simulate the client dying: close the stream.  The session
+        keeps decoding server-side; reconnect with ``session_id`` +
+        ``cursor`` to resume."""
+        try:
+            self._stream.close()
+        except Exception:
+            pass
+        self._col.done.set()
+
+
+class RouterClient:
+    """Thin client for the Router service: ``generate`` (blocking),
+    ``start`` (live handle with ``drop()``), ``resume`` (reconnect)."""
+
+    def __init__(self, addr: str, *, timeout_ms: int = 10_000):
+        from brpc_tpu.rpc.channel import Channel
+        self.addr = addr
+        self.timeout_ms = int(timeout_ms)
+        self._ch = Channel(addr, timeout_ms=self.timeout_ms)
+
+    def start(self, prompt: Sequence[int], max_new_tokens: int, *,
+              emit: Optional[Callable[[int], None]] = None
+              ) -> LiveGeneration:
+        from brpc_tpu.rpc.controller import Controller
+        from brpc_tpu.rpc.stream import stream_create
+        col = _ClientCollector(emit)
+        cntl = Controller(timeout_ms=self.timeout_ms)
+        stream = stream_create(cntl, col)
+        try:
+            resp = self._ch.call_sync(
+                "Router", "Generate",
+                {"prompt": [int(t) for t in prompt],
+                 "max_new_tokens": int(max_new_tokens)},
+                serializer="json", cntl=cntl)
+        except errors.RpcError:
+            # shed (ELIMIT) or dead router: the never-bound stream
+            # must close or it leaks in the StreamRegistry
+            try:
+                stream.close()
+            except Exception:
+                pass
+            raise
+        sid = str((resp or {}).get("session_id", ""))
+        return LiveGeneration(sid, col, stream)
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int, *,
+                 emit: Optional[Callable[[int], None]] = None,
+                 timeout_s: float = 30.0) -> dict:
+        gen = self.start(prompt, max_new_tokens, emit=emit)
+        if not gen.wait(timeout_s):
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  "router generation never finished")
+        return {"session_id": gen.session_id, "tokens": gen.tokens,
+                "cursor": gen.cursor, "error": gen.error}
+
+    def resume(self, session_id: str, cursor: int, *,
+               emit: Optional[Callable[[int], None]] = None
+               ) -> LiveGeneration:
+        from brpc_tpu.rpc.controller import Controller
+        from brpc_tpu.rpc.stream import stream_create
+        col = _ClientCollector(emit)
+        col.cursor = int(cursor)
+        cntl = Controller(timeout_ms=self.timeout_ms)
+        stream = stream_create(cntl, col)
+        try:
+            self._ch.call_sync(
+                "Router", "Resume",
+                {"session_id": str(session_id), "cursor": int(cursor)},
+                serializer="json", cntl=cntl)
+        except errors.RpcError:
+            try:
+                stream.close()
+            except Exception:
+                pass
+            raise
+        return LiveGeneration(str(session_id), col, stream)
+
+    def resume_wait(self, session_id: str, cursor: int, *,
+                    timeout_s: float = 30.0) -> dict:
+        gen = self.resume(session_id, cursor)
+        if not gen.wait(timeout_s):
+            raise errors.RpcError(errors.ERPCTIMEDOUT,
+                                  "router resume never finished")
+        return {"session_id": session_id, "tokens": gen.tokens,
+                "cursor": gen.cursor, "error": gen.error}

@@ -84,8 +84,21 @@ class ServingService(Service):
                 pass   # peer already gone; nothing to tell it
             stream.close()
 
+        # advisory prefix probe BEFORE submit: how many prompt tokens
+        # the local KV cache can serve without re-decoding.  The
+        # cluster router's resume path reads this to account the
+        # re-decoded-token cost of a failover (ISSUE 8) — a resume that
+        # lands on a replica holding the committed prefix reports
+        # prefix_hit > 0 and re-prefills only the tail.
+        hit = 0
+        store = getattr(self._engine, "store", None)
+        if store is not None and len(prompt) > 1:
+            try:
+                hit = int(store.probe(prompt))
+            except Exception:
+                hit = 0
         rid = self._engine.submit(prompt, max_new, emit, on_done)
-        return {"accepted": True, "req_id": rid}
+        return {"accepted": True, "req_id": rid, "prefix_hit": hit}
 
 
 def http_generate_handler(engine):

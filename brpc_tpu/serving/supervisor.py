@@ -70,6 +70,7 @@ from typing import Callable, Optional, Sequence
 
 from brpc_tpu import errors, rpcz
 from brpc_tpu.bvar import Adder, PassiveStatus
+from brpc_tpu.serving.ladder import OverloadLadder
 
 _sup_req_ids = itertools.count(1)
 
@@ -158,8 +159,11 @@ class EngineSupervisor:
         self.hysteresis_ticks = int(hysteresis_ticks)
         self.name = name
 
-        self.level = 0                  # current degradation level
-        self._calm_ticks = 0
+        # escalation/hysteresis policy shared with the cluster router
+        # (serving/ladder.py, ISSUE 8): the supervisor keeps its three
+        # in-process levels, the state machine is the common one
+        self._ladder = OverloadLadder(self.ladder,
+                                      hysteresis_ticks=self.hysteresis_ticks)
         self.state = "healthy"          # healthy|degraded|restarting|failed
         self.last_recovery: Optional[dict] = None
         self._restart_times: list[float] = []
@@ -662,37 +666,33 @@ class EngineSupervisor:
         eng = self._engine
         if eng is not None:
             try:
-                with eng._cv:
-                    queued = len(eng._waiters) + eng._admitting
-                depth = queued / max(1, eng.num_slots)
+                depth = eng.queue_depth()
             except Exception:
                 depth = 0.0
         return {"queue_delay_us": q_us, "pool_ratio": pool,
                 "queue_depth": depth}
 
+    @property
+    def level(self) -> int:
+        """Current degradation level — the shared ladder's state."""
+        return self._ladder.level
+
+    def set_level_floor(self, floor: int) -> None:
+        """Hold this replica at a minimum degradation level regardless
+        of its local pressures — the cluster router's lever: when the
+        CLUSTER gradient escalates past shed-at-router, every replica
+        browns out / clamps / evicts together.  Applied on the next
+        watchdog tick (or immediately by an explicit
+        ``_update_degradation`` call)."""
+        self._ladder.floor = max(0, min(int(floor), len(self.ladder)))
+
     def _target_level(self, p: dict) -> int:
-        lvl = 0
-        for i, th in enumerate(self.ladder, start=1):
-            if any(p[k] >= th[k] for k in th):
-                lvl = i
-        return lvl
+        return self._ladder.target_level(p)
 
     def _update_degradation(self) -> None:
-        p = self._pressures()
-        target = self._target_level(p)
-        if target > self.level:
-            self.level = target          # escalate immediately
-            self._calm_ticks = 0
-        elif target < self.level:
-            # de-escalate one level per `hysteresis_ticks` calm ticks:
-            # a load oscillating around a threshold must not flap the
-            # ladder (shedding churn is its own overload)
-            self._calm_ticks += 1
-            if self._calm_ticks >= self.hysteresis_ticks:
-                self.level -= 1
-                self._calm_ticks = 0
-        else:
-            self._calm_ticks = 0
+        # escalation immediate, de-escalation hysteretic — the policy
+        # lives in the shared OverloadLadder (serving/ladder.py)
+        self._ladder.update(self._pressures())
         self._apply_level()
         if self.state in ("healthy", "degraded"):
             self.state = "degraded" if self.level else "healthy"
@@ -774,6 +774,7 @@ class EngineSupervisor:
             "resumed_tokens": self.resumed_tokens.get_value(),
             "ladder_evictions": self.ladder_evictions.get_value(),
             "live_requests": live,
+            "ladder": self._ladder.stats(),
             "engine": None if eng is None else eng.name,
             "heartbeat_deadline_s": self.heartbeat_deadline_s,
             "last_recovery": self.last_recovery,

@@ -473,11 +473,217 @@ def run_disagg_press(prefill_addr: str, decode_addr: str, request,
     return summary
 
 
+def spin_up_cluster(n_replicas: int, *, page_tokens: int = 8,
+                    step_delay_s: float = 0.0, num_slots: int = 8,
+                    max_blocks: int = 64, page_bytes: int = 512,
+                    max_pages_per_slot: int = 64,
+                    name_prefix: str = "cluster",
+                    commit_live_pages: bool = False,
+                    replicate_sessions: bool = False,
+                    max_sessions: int = 256,
+                    timeout_ms: int = 20_000):
+    """Build an in-process cluster: N serving replicas (paged KV store +
+    decode engine + server with the Serving and ``_kvmig`` services)
+    behind a :class:`~brpc_tpu.serving.ClusterRouter` exposed on its own
+    router server.  The step function is plain numpy (CPU-valid), each
+    step optionally sleeping ``step_delay_s`` so generations are
+    decode-bound.  Shared by ``--cluster`` press mode and ``bench.py
+    cluster`` (which differ only in knobs: the press turns on
+    ``commit_live_pages``/``replicate_sessions`` to exercise resume
+    under a replica kill; the bench leaves replication off so the
+    router-overhead number isn't polluted by page shipping).
+
+    Returns ``(replicas, router, rsrv, raddr)`` with ``replicas`` a
+    list of ``(store, engine, server, addr)``; tear down with
+    :func:`tear_down_cluster`."""
+    import numpy as np
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.migrate import register_migration
+    from brpc_tpu.serving import (ClusterRouter, DecodeEngine,
+                                  ReplicaHandle, register_router,
+                                  register_serving)
+
+    def step(tokens, positions, pages=None):
+        if step_delay_s:
+            time.sleep(step_delay_s)
+        return (np.asarray(tokens) * 7 + np.asarray(positions)) % 997
+
+    replicas = []
+    for i in range(n_replicas):
+        store = KVCacheStore(page_tokens=page_tokens,
+                             page_bytes=page_bytes,
+                             max_blocks=max_blocks,
+                             name=f"{name_prefix}_{i}",
+                             commit_live_pages=commit_live_pages)
+        eng = DecodeEngine(step, num_slots=num_slots, store=store,
+                           max_pages_per_slot=max_pages_per_slot,
+                           name=f"{name_prefix}_eng_{i}")
+        srv = brpc.Server(enable_dcn=True)
+        register_serving(srv, engine=eng)
+        register_migration(srv, store)
+        srv.start("127.0.0.1", 0)
+        replicas.append((store, eng, srv, f"127.0.0.1:{srv.port}"))
+    router = ClusterRouter(
+        [ReplicaHandle(addr, name=f"{name_prefix}_{i}", engine=eng,
+                       store=store, server=srv)
+         for i, (store, eng, srv, addr) in enumerate(replicas)],
+        page_tokens=page_tokens, replicate_sessions=replicate_sessions,
+        max_sessions=max_sessions, name=f"{name_prefix}_router",
+        timeout_ms=timeout_ms)
+    rsrv = brpc.Server()
+    register_router(rsrv, router)
+    rsrv.start("127.0.0.1", 0)
+    return replicas, router, rsrv, f"127.0.0.1:{rsrv.port}"
+
+
+def tear_down_cluster(replicas, router, rsrv,
+                      timeout_s: float = 3.0) -> None:
+    """Close everything :func:`spin_up_cluster` built (replicas that
+    were already killed mid-run tear down quietly)."""
+    router.close(timeout_s=timeout_s)
+    rsrv.stop()
+    rsrv.join()
+    for store, eng, srv, _addr in replicas:
+        try:
+            eng.close(timeout_s=2.0)
+        except Exception:
+            pass
+        try:
+            srv.stop()
+            srv.join()
+        except Exception:
+            pass
+        store.clear()
+        store.close()
+
+
+def run_cluster_press(n_replicas: int, request,
+                      duration_s: float = 10.0, threads: int = 4,
+                      timeout_ms: int = 20_000, request_factory=None,
+                      kill_replica_after: float | None = None,
+                      out=sys.stderr) -> dict:
+    """``--cluster N`` mode: spin up N in-process serving replicas
+    behind a :class:`~brpc_tpu.serving.ClusterRouter` and press full
+    generations through the front door — ROADMAP item 3's "heavy
+    traffic" scenario driver.  Reports generations/s, tokens/s,
+    time-to-first-token percentiles, the RESUME count (replica
+    failovers ridden by sessions), and the overload gradient's
+    per-level shed counts.  ``kill_replica_after=S`` kills one replica
+    mid-run so the resume path runs under load.  CPU-valid: the step
+    function is plain numpy."""
+    from brpc_tpu.serving import RouterClient
+
+    replicas, router, rsrv, raddr = spin_up_cluster(
+        n_replicas, page_tokens=8, commit_live_pages=True,
+        replicate_sessions=True, max_sessions=max(64, 8 * threads),
+        name_prefix="press_cl", timeout_ms=timeout_ms)
+
+    rec_ttft = LatencyRecorder("rpc_press_cluster_ttft")
+    mu = threading.Lock()
+    gens_ok = [0]
+    nerr = [0]
+    nshed = [0]
+    tokens = [0]
+    stop = threading.Event()
+
+    def worker(k: int):
+        cli = RouterClient(raddr, timeout_ms=timeout_ms)
+        gen = request_factory(k) if request_factory is not None else None
+        while not stop.is_set():
+            req = gen() if gen is not None else request
+            prompt = req.get("prompt") or [1]
+            n = int(req.get("max_new_tokens", 16))
+            first = [None]
+
+            def emit(tok, first=first):
+                if first[0] is None:
+                    first[0] = time.monotonic()
+
+            t0 = time.monotonic()
+            try:
+                res = cli.generate(prompt, n, emit=emit,
+                                   timeout_s=timeout_ms / 1e3)
+            except brpc.RpcError as e:
+                with mu:
+                    if e.code == brpc.errors.ELIMIT:
+                        nshed[0] += 1   # shed-at-router, by design
+                    else:
+                        nerr[0] += 1
+                continue
+            except Exception:
+                with mu:
+                    nerr[0] += 1
+                continue
+            with mu:
+                if res["error"]:
+                    nerr[0] += 1
+                    continue
+                gens_ok[0] += 1
+                tokens[0] += len(res["tokens"])
+            if first[0] is not None:
+                rec_ttft.add(int((first[0] - t0) * 1e6))
+
+    ts = [threading.Thread(target=worker, args=(k,), daemon=True)
+          for k in range(threads)]
+    t_start = time.monotonic()
+    [t.start() for t in ts]
+    try:
+        if kill_replica_after is not None and \
+                kill_replica_after < duration_s:
+            time.sleep(kill_replica_after)
+            _store, keng, ksrv, kaddr = replicas[0]
+            print(f"cluster press: killing replica {kaddr}",
+                  file=sys.stderr)
+            ksrv.stop()
+            ksrv.join()
+            keng.close(timeout_s=2.0)
+            time.sleep(max(0.0, duration_s - kill_replica_after))
+        else:
+            time.sleep(duration_s)
+    finally:
+        stop.set()
+    [t.join(timeout_ms / 1e3 + 2) for t in ts]
+    elapsed = time.monotonic() - t_start
+    rstats = router.stats()
+    summary = {
+        "replicas": n_replicas,
+        "generations_ok": gens_ok[0],
+        "errors": nerr[0],
+        "client_sheds": nshed[0],
+        "tokens": tokens[0],
+        "generations_per_s": round(gens_ok[0] / elapsed, 1),
+        "tokens_per_s": round(tokens[0] / elapsed, 1),
+        "ttft_avg_us": round(rec_ttft.latency(), 1),
+        "ttft_p50_us": rec_ttft.latency_percentile(0.5),
+        "ttft_p90_us": rec_ttft.latency_percentile(0.9),
+        "ttft_p99_us": rec_ttft.latency_percentile(0.99),
+        "resumes": rstats["resumes"],
+        "shed_counts": rstats["gradient_fired"],
+        "router_level": rstats["ladder"]["level"],
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(summary), file=out)
+    tear_down_cluster(replicas, router, rsrv)
+    return summary
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--server", help="host:port (unary/streaming modes)")
     ap.add_argument("--service")
     ap.add_argument("--method")
+    ap.add_argument("--cluster", type=int, default=0, metavar="N",
+                    help="spin up N in-process serving replicas behind "
+                         "a ClusterRouter and press generations "
+                         "through the front door (generations/s, TTFT "
+                         "percentiles, resume count, per-level shed "
+                         "counts)")
+    ap.add_argument("--kill-replica-after", type=float, default=None,
+                    metavar="S",
+                    help="with --cluster: kill one replica S seconds "
+                         "into the run so session resume runs under "
+                         "load")
     ap.add_argument("--disagg", metavar="PREFILL_ADDR,DECODE_ADDR",
                     help="drive a disaggregated prefill/decode split: "
                          "each call runs DisaggPrefill.Prefill on the "
@@ -518,13 +724,13 @@ def main(argv=None):
                          "top-N stage-tagged folded stacks alongside "
                          "the latency report; 0 disables")
     a = ap.parse_args(argv)
-    if a.disagg is None:
+    if a.disagg is None and not a.cluster:
         missing = [n for n, v in (("--server", a.server),
                                   ("--service", a.service),
                                   ("--method", a.method)) if not v]
         if missing:
             ap.error(f"{', '.join(missing)} required "
-                     f"(unless --disagg is used)")
+                     f"(unless --disagg or --cluster is used)")
     text = a.input
     if text.startswith("@"):
         with open(text[1:]) as f:
@@ -535,7 +741,14 @@ def main(argv=None):
         factory = make_prefix_skew(req, a.shared_prefix_ratio,
                                    prefix_tokens=a.prefix_tokens,
                                    seed=a.prefix_seed)
-    if a.disagg:
+    if a.cluster:
+        run_cluster_press(a.cluster, req, duration_s=a.duration,
+                          threads=a.threads,
+                          timeout_ms=max(a.timeout_ms, 5000),
+                          request_factory=factory,
+                          kill_replica_after=a.kill_replica_after,
+                          out=sys.stdout)
+    elif a.disagg:
         try:
             prefill_addr, decode_addr = a.disagg.split(",", 1)
         except ValueError:

@@ -1537,3 +1537,159 @@ def test_primary_death_standby_completes_exactly_once(seed):
         ssrv.join()
         pstore.close()
         sstore.close()
+
+
+# ---------------------------------------------------------------------------
+# scenario 14: replica kill mid-generation under a client that also drops
+# and reconnects through the cluster front door (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_router_replica_kill_client_drop_resume(seed):
+    """The cluster front door's failure drill: while a generation
+    streams through the ClusterRouter, an injected ``router.forward``
+    fault forces one re-route, the SERVING replica is killed
+    mid-decode, and the client drops its connection too.  Invariants:
+
+    * the reconnecting client (session_id + cursor) receives EXACTLY
+      the tokens past its cursor — the assembled stream is bit-exact
+      (token-for-token equal to an uninterrupted run), never a
+      duplicate, never a hole;
+    * the resume rode the buddy page migration: ``re_decoded_tokens``
+      is strictly less than the generation's total tokens;
+    * the killed replica is quarantined and its prefixes REMAPPED (the
+      affinity ring now answers with a healthy replica);
+    * pools and refcounts return to baseline on the survivor.
+    """
+    import random
+
+    import numpy as np
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.migrate import register_migration
+    from brpc_tpu.serving import (ClusterRouter, DecodeEngine,
+                                  ReplicaHandle, RouterClient,
+                                  SessionTable, register_router,
+                                  register_serving)
+
+    PT = 4
+
+    def step(tokens, positions, pages=None):
+        time.sleep(0.03)           # slow decode: the kill lands mid-gen
+        return (np.asarray(tokens) * 7 + np.asarray(positions)) % 997
+
+    def expected(prompt, n):
+        last, pos, out = prompt[-1], len(prompt), []
+        for _ in range(n):
+            last = (last * 7 + pos) % 997
+            out.append(last)
+            pos += 1
+        return out
+
+    replicas = []
+    for tag in ("a", "b"):
+        store = KVCacheStore(page_tokens=PT, page_bytes=256,
+                             max_blocks=32,
+                             name=f"rt_chaos_{tag}{seed}",
+                             commit_live_pages=True)
+        eng = DecodeEngine(step, num_slots=2, store=store,
+                           max_pages_per_slot=32,
+                           name=f"rt_chaos_eng_{tag}{seed}")
+        srv = brpc.Server(enable_dcn=True)
+        register_serving(srv, engine=eng)
+        register_migration(srv, store)
+        srv.start("127.0.0.1", 0)
+        replicas.append((store, eng, srv,
+                         f"127.0.0.1:{srv.port}"))
+
+    table = SessionTable()
+    router = ClusterRouter(
+        [ReplicaHandle(addr, name=f"rt_{tag}", engine=eng, store=st,
+                       server=srv)
+         for (st, eng, srv, addr), tag in zip(replicas, "ab")],
+        sessions=table, page_tokens=PT, replicate_sessions=True,
+        quarantine_after=1, name=f"rt_chaos_router{seed}",
+        check_interval_s=0.02)
+    rsrv = brpc.Server()
+    register_router(rsrv, router)
+    rsrv.start("127.0.0.1", 0)
+    cli = RouterClient(f"127.0.0.1:{rsrv.port}")
+
+    rng = random.Random(seed)
+    base = rng.randrange(100, 800)
+    prompt = [base + i for i in range(13)]      # 3 full pages
+    budget = 10
+    plan = fault.FaultPlan(seed=seed)
+    plan.on("router.forward", fault.ERROR, times=1)
+    victim = survivor = None
+    try:
+        with fault.injected(plan):
+            gen = cli.start(prompt, budget)
+            assert gen.wait_tokens(3, timeout_s=20), \
+                f"seed {seed}: no tokens before the kill"
+            sid = gen.session_id
+            s = table.get(sid)
+            assert wait_until(lambda: s.replicated_pages > 0, 10), \
+                f"seed {seed}: no buddy replication before the kill"
+            cursor, seen = gen.cursor, gen.tokens
+            victim = next(r for r in replicas
+                          if r[3] == s.replica
+                          or str(ReplicaHandle(r[3]).endpoint)
+                          == s.replica)
+            survivor = next(r for r in replicas if r is not victim)
+            gen.drop()                      # the client dies...
+            victim[2].stop()                # ...and the replica too
+            victim[2].join()
+            victim[1].close(timeout_s=2.0)
+            assert wait_until(
+                lambda: s.state in ("finished", "failed"), 30), \
+                f"seed {seed}: session never completed after the kill"
+            assert s.state == "finished", \
+                f"seed {seed}: session failed E{s.error_code}"
+            assert plan.injected.get("router.forward", 0) == 1
+            assert s.resumes >= 2           # injected re-route + kill
+            out = cli.resume_wait(sid, cursor, timeout_s=20)
+        assert out["error"] is None
+        full = seen[:cursor] + out["tokens"]
+        assert full == expected(prompt, budget), \
+            f"seed {seed}: stream diverged across the router seam"
+        # exactly-once: a later reconnect replays the same suffix, no
+        # token appears twice
+        again = cli.resume_wait(sid, cursor, timeout_s=10)
+        assert again["tokens"] == out["tokens"]
+        total = len(prompt) + budget
+        assert 0 < s.re_decoded_tokens < total, \
+            f"seed {seed}: re_decoded={s.re_decoded_tokens} of {total}"
+        # quarantine + remap: the ring no longer answers with the dead
+        # replica for this prefix
+        from brpc_tpu.policy.health_check import is_broken
+        from brpc_tpu.policy.load_balancer import prefix_fingerprint
+        victim_ep = ReplicaHandle(victim[3]).endpoint
+        assert is_broken(victim_ep), \
+            f"seed {seed}: killed replica not quarantined"
+        remapped = router._lb.select_server(
+            request_code=prefix_fingerprint(prompt))
+        assert remapped != victim_ep
+        # survivor baseline: no leaked sequences, pools consistent
+        sstore = survivor[0]
+        assert wait_until(
+            lambda: sstore.stats()["live_seqs"] == 0, 10)
+        sstore.clear()
+        sstore.pagepool.assert_consistent()
+        assert sstore.pagepool.blocks_leased() == 0
+    finally:
+        router.close(timeout_s=3.0)
+        rsrv.stop()
+        rsrv.join()
+        for st, eng, srv, _addr in replicas:
+            try:
+                eng.close(timeout_s=2.0)
+            except Exception:
+                pass
+            try:
+                srv.stop()
+                srv.join()
+            except Exception:
+                pass
+            st.clear()
+            st.close()

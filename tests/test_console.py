@@ -271,7 +271,8 @@ def test_every_console_route_answers(server):
         "/", "/index", "/status", "/vars", "/flags", "/health",
         "/version", "/connections", "/sockets", "/bthreads", "/services",
         "/protobufs", "/memory", "/ici", "/serving",
-        "/serving/generations", "/kvcache", "/migration", "/rpcz",
+        "/serving/generations", "/kvcache", "/migration", "/cluster",
+        "/rpcz",
         "/rpcz?trace_id=1", "/brpc_metrics",
         "/dashboard", "/vlog", "/hotspots",
         "/hotspots?seconds=0.05",
@@ -353,3 +354,36 @@ def test_serving_page_shows_supervisor_state():
         sup.close()
         store.clear()
         store.close()
+
+
+def test_cluster_page_shows_replica_table_and_gradient():
+    """/cluster renders the router's replica table (health / breaker /
+    quarantine / ladder level), session counts, resume stats, and the
+    overload gradient's per-level fire counters (ISSUE 8)."""
+    from brpc_tpu.serving import ClusterRouter
+
+    router = ClusterRouter(["127.0.0.1:9", "127.0.0.1:11"],
+                           auto_tick=False, name="console_router")
+    s = brpc.Server()
+    s.start("127.0.0.1", 0)
+    try:
+        status, body = _get(s, "/cluster")
+        assert status == 200
+        snap = json.loads(body)
+        r = snap["routers"]["console_router"]
+        assert len(r["replicas"]) == 2
+        row = r["replicas"][0]
+        for key in ("addr", "healthy", "quarantined",
+                    "breaker_isolations"):
+            assert key in row, row
+        assert r["sessions"]["total"] == 0
+        assert r["ladder"]["level"] == 0
+        assert set(r["gradient_fired"]) == {
+            "shed_at_router", "brownout_at_batcher",
+            "clamp_at_engine", "evict_at_store"}
+        assert r["level_actions"][0] == "shed_at_router"
+        assert "retry_after_s" in r
+    finally:
+        s.stop()
+        s.join()
+        router.close(timeout_s=1.0)

@@ -1,0 +1,672 @@
+"""Cluster front door tests (ISSUE 8 tentpole): ClusterRouter —
+resumable client sessions and the coherent overload gradient.
+
+Covers, in order:
+  * routing: a generation through the router is bit-exact and lands on
+    a prefix-affine replica; repeat prefixes stick;
+  * shed-at-router: at gradient level >= 1 (or a limiter refusal) new
+    sessions get ELIMIT with a ``retry_after_s`` hint BEFORE anything
+    crosses DCN;
+  * resumable sessions: a client that drops mid-stream reconnects with
+    its session_id + cursor and receives exactly the tokens past the
+    cursor (replayed from the durable record, live after) — never a
+    duplicate, never a hole;
+  * replica kill: the serving replica dies mid-decode AND the client
+    drops; on reconnect the stream resumes bit-exact through a healthy
+    replica, riding the buddy page replication (PushTo at page
+    boundaries) so ``re_decoded_tokens < total``;
+  * router restart: a new router adopting the same SessionTable
+    resumes a suspended session bit-exact;
+  * the gradient ordering: under a synthetic ramp the four actions
+    fire strictly in order (shed -> brownout -> clamp -> evict) and
+    hysteresis de-escalates in reverse order.
+
+`make cluster` runs exactly this file.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors, fault
+from brpc_tpu.kvcache import KVCacheStore
+from brpc_tpu.migrate import register_migration
+from brpc_tpu.serving import (ClusterRouter, DecodeEngine, ReplicaHandle,
+                              RouterClient, SessionTable, register_router,
+                              register_serving)
+
+from testutil import wait_until
+
+PT = 4          # page tokens: small so short prompts cross boundaries
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    """Never leak fault plans, broken endpoints, or breaker state."""
+    from brpc_tpu.policy import health_check as hc
+    from brpc_tpu.policy.circuit_breaker import global_breaker
+    fault.clear()
+    yield
+    fault.clear()
+    hc.reset_all()
+    b = global_breaker()
+    with b._mu:
+        b._short.clear()
+        b._long.clear()
+        b._isolation_count.clear()
+        b._recovering_until.clear()
+
+
+def _expected(prompt, n):
+    last, pos, out = prompt[-1], len(prompt), []
+    for _ in range(n):
+        last = (last * 7 + pos) % 997
+        out.append(last)
+        pos += 1
+    return out
+
+
+def _step_fn(delay_s=0.0):
+    """Position-dependent step (bit-exactness probe); optionally slow,
+    so a kill can land mid-generation deterministically."""
+    def step(tokens, positions, pages=None):
+        if delay_s:
+            time.sleep(delay_s)
+        return (np.asarray(tokens) * 7 + np.asarray(positions)) % 997
+    return step
+
+
+class _Replica:
+    """One in-process serving replica: store + engine + server with the
+    Serving and _kvmig services."""
+
+    def __init__(self, name, *, delay_s=0.0, num_slots=4, max_blocks=64):
+        self.name = name
+        self.store = KVCacheStore(page_tokens=PT, page_bytes=256,
+                                  max_blocks=max_blocks,
+                                  name=f"{name}_store",
+                                  commit_live_pages=True)
+        self.engine = DecodeEngine(_step_fn(delay_s), num_slots=num_slots,
+                                   store=self.store, max_pages_per_slot=32,
+                                   name=f"{name}_eng")
+        self.server = brpc.Server(enable_dcn=True)
+        register_serving(self.server, engine=self.engine)
+        register_migration(self.server, self.store)
+        self.server.start("127.0.0.1", 0)
+        self.addr = f"127.0.0.1:{self.server.port}"
+
+    def handle(self):
+        return ReplicaHandle(self.addr, name=self.name,
+                             batcher=None, engine=self.engine,
+                             store=self.store, server=self.server)
+
+    def kill(self):
+        """Process-death analog: the server socket goes away and the
+        engine stops — in-flight streams break mid-generation."""
+        self.server.stop()
+        self.server.join()
+        self.engine.close(timeout_s=2.0)
+
+    def close(self):
+        try:
+            self.engine.close(timeout_s=2.0)
+        except Exception:
+            pass
+        try:
+            self.server.stop()
+            self.server.join()
+        except Exception:
+            pass
+        self.store.clear()
+        self.store.close()
+
+
+@pytest.fixture()
+def cluster():
+    """Two live replicas + a router server, with buddy replication on."""
+    reps = [_Replica("cl_a", delay_s=0.004), _Replica("cl_b",
+                                                      delay_s=0.004)]
+    table = SessionTable()
+    router = ClusterRouter([r.handle() for r in reps], sessions=table,
+                           page_tokens=PT, replicate_sessions=True,
+                           quarantine_after=1, name="cl_router",
+                           check_interval_s=0.02)
+    rsrv = brpc.Server()
+    register_router(rsrv, router)
+    rsrv.start("127.0.0.1", 0)
+    raddr = f"127.0.0.1:{rsrv.port}"
+    yield reps, router, table, raddr
+    router.close(timeout_s=3.0)
+    rsrv.stop()
+    rsrv.join()
+    for r in reps:
+        r.close()
+
+
+def test_generate_through_router_bit_exact(cluster):
+    reps, router, table, raddr = cluster
+    cli = RouterClient(raddr)
+    prompt = list(range(50, 63))
+    out = cli.generate(prompt, 6, timeout_s=20)
+    assert out["error"] is None
+    assert out["tokens"] == _expected(prompt, 6)
+    assert out["cursor"] == 6
+    s = table.get(out["session_id"])
+    assert s is not None and s.state == "finished"
+    assert router.stats()["forwards"] >= 1
+    assert router.stats()["sessions"]["finished"] >= 1
+
+
+def test_prefix_affinity_repeat_prompts_stick(cluster):
+    reps, router, table, raddr = cluster
+    cli = RouterClient(raddr)
+    prompt = [7, 8, 9, 10, 11]
+    replicas_used = set()
+    for _ in range(3):
+        out = cli.generate(prompt, 3, timeout_s=20)
+        assert out["error"] is None
+        replicas_used.add(table.get(out["session_id"]).replica)
+    assert len(replicas_used) == 1, \
+        f"repeat prefix bounced across replicas: {replicas_used}"
+
+
+def test_shed_at_router_has_retry_after(cluster):
+    reps, router, table, raddr = cluster
+    router._ladder.level = 1          # synthetic overload
+    try:
+        cli = RouterClient(raddr)
+        with pytest.raises(errors.RpcError) as ei:
+            cli.generate([1, 2, 3], 4, timeout_s=10)
+        assert ei.value.code == errors.ELIMIT
+        assert "retry_after_s=" in ei.value.text
+        assert router.shed_total.get_value() >= 1
+        assert router.stats()["gradient_fired"]["shed_at_router"] >= 1
+    finally:
+        router._ladder.level = 0
+
+
+def test_client_drop_reconnect_replays_exactly_once(cluster):
+    reps, router, table, raddr = cluster
+    cli = RouterClient(raddr)
+    prompt = list(range(20, 29))
+    budget = 10
+    gen = cli.start(prompt, budget)
+    assert gen.wait_tokens(3, timeout_s=10)
+    sid, cursor = gen.session_id, gen.cursor
+    seen = gen.tokens
+    gen.drop()                         # the client dies; the session
+    s = table.get(sid)                 # keeps decoding server-side
+    assert wait_until(lambda: s.state in ("finished", "failed"), 10)
+    assert s.state == "finished"
+    out = cli.resume_wait(sid, cursor, timeout_s=10)
+    assert out["error"] is None
+    assert seen[:cursor] + out["tokens"] == _expected(prompt, budget)
+    assert router.replays_total.get_value() >= len(out["tokens"])
+    # a second reconnect at a later cursor replays only the tail
+    out2 = cli.resume_wait(sid, budget - 2, timeout_s=10)
+    assert out2["tokens"] == _expected(prompt, budget)[-2:]
+
+
+def test_replica_kill_client_drop_resume_bit_exact(cluster):
+    """The ISSUE 8 acceptance scenario: the serving replica is killed
+    mid-decode AND the client disconnects; on reconnect the stream
+    resumes bit-exact through the surviving replica, riding the buddy
+    page migration so re_decoded_tokens < total."""
+    reps, router, table, raddr = cluster
+    cli = RouterClient(raddr)
+    prompt = list(range(100, 113))      # 13 tokens: 3 full pages
+    budget = 12
+    gen = cli.start(prompt, budget)
+    assert gen.wait_tokens(4, timeout_s=10)
+    sid = gen.session_id
+    s = table.get(sid)
+    # the buddy must hold some of the committed prefix BEFORE the kill
+    assert wait_until(lambda: s.replicated_pages > 0, 10), \
+        "no pages were replicated to the ring buddy"
+    serving = s.replica
+    victim = next(r for r in reps
+                  if str(r.handle().endpoint) == serving
+                  or r.addr == serving)
+    survivor = next(r for r in reps if r is not victim)
+    cursor = gen.cursor
+    seen = gen.tokens
+    gen.drop()                          # client dies...
+    victim.kill()                       # ...and so does the replica
+    assert wait_until(lambda: s.state in ("finished", "failed"), 20)
+    assert s.state == "finished", f"session failed: E{s.error_code}"
+    assert s.resumes >= 1
+    out = cli.resume_wait(sid, cursor, timeout_s=10)
+    assert out["error"] is None
+    full = seen[:cursor] + out["tokens"]
+    assert full == _expected(prompt, budget), \
+        "resumed stream is not bit-exact"
+    # the committed prefix rode the page migration: the failover
+    # re-decoded strictly less than the whole resume prompt
+    total = len(prompt) + budget
+    assert 0 < s.re_decoded_tokens < total, \
+        (s.re_decoded_tokens, total)
+    assert s.re_decoded_tokens <= total - PT, \
+        "no committed page was skipped on resume"
+    # the killed replica is quarantined and its prefixes remapped
+    from brpc_tpu.policy.health_check import is_broken
+    victim_ep = victim.handle().endpoint
+    assert is_broken(victim_ep)
+    from brpc_tpu.policy.load_balancer import prefix_fingerprint
+    remapped = router._lb.select_server(
+        request_code=prefix_fingerprint(prompt))
+    assert remapped != victim_ep
+    # surviving replica's store is quiescent: no live seqs leaked
+    assert wait_until(
+        lambda: survivor.store.stats()["live_seqs"] == 0, 10)
+
+
+def test_router_restart_adopts_sessions_and_resumes():
+    reps = [_Replica("rr_a", delay_s=0.004), _Replica("rr_b",
+                                                      delay_s=0.004)]
+    table = SessionTable()
+    r1 = ClusterRouter([r.handle() for r in reps], sessions=table,
+                       page_tokens=PT, name="rr_router1",
+                       check_interval_s=0.02)
+    srv1 = brpc.Server()
+    register_router(srv1, r1)
+    srv1.start("127.0.0.1", 0)
+    cli1 = RouterClient(f"127.0.0.1:{srv1.port}")
+    prompt = list(range(40, 49))
+    budget = 10
+    try:
+        gen = cli1.start(prompt, budget)
+        assert gen.wait_tokens(3, timeout_s=10)
+        sid, cursor = gen.session_id, gen.cursor
+        seen = gen.tokens
+        gen.drop()
+        # the router process "dies": sessions suspend into the table
+        r1.close(timeout_s=3.0)
+        srv1.stop()
+        srv1.join()
+        s = table.get(sid)
+        assert s.state == "suspended"
+        # a successor router adopts the SAME table
+        r2 = ClusterRouter([r.handle() for r in reps], sessions=table,
+                           page_tokens=PT, name="rr_router2",
+                           check_interval_s=0.02)
+        srv2 = brpc.Server()
+        register_router(srv2, r2)
+        srv2.start("127.0.0.1", 0)
+        try:
+            cli2 = RouterClient(f"127.0.0.1:{srv2.port}")
+            out = cli2.resume_wait(sid, cursor, timeout_s=15)
+            assert out["error"] is None
+            assert seen[:cursor] + out["tokens"] == \
+                _expected(prompt, budget)
+            assert s.state == "finished"
+        finally:
+            r2.close(timeout_s=3.0)
+            srv2.stop()
+            srv2.join()
+    finally:
+        if table.get(sid) and table.get(sid).state == "running":
+            table.get(sid).finish(None)
+        try:
+            r1.close(timeout_s=1.0)
+        except Exception:
+            pass
+        for r in reps:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# the overload gradient
+# ---------------------------------------------------------------------------
+
+class TestGradientOrdering:
+    def _mk(self):
+        rep = _Replica("grad_a")
+        # seed the replica's radix with cached pages so level 4 has
+        # something to evict
+        seq = rep.store.admit(list(range(300, 300 + 4 * PT)) + [1])
+        rep.store.retire(seq, cache=True)
+        router = ClusterRouter([rep.handle()], page_tokens=PT,
+                               auto_tick=False, hysteresis_ticks=2,
+                               name="grad_router")
+        return rep, router
+
+    def test_ramp_fires_in_order_and_de_escalates_in_reverse(self):
+        rep, router = self._mk()
+        try:
+            ramp = {1: 0.85, 2: 0.90, 3: 0.95, 4: 0.99}
+            pressures = {"sessions_ratio": 0.0}
+            router._pressures = lambda: dict(pressures)
+
+            def try_admit():
+                try:
+                    s = router.open_session([1, 2, 3], 1)
+                    s.finish(None)      # don't actually decode
+                    return True
+                except errors.RpcError as e:
+                    assert e.code == errors.ELIMIT
+                    return False
+
+            evict0 = rep.store.evictions.get_value()
+            first_fired = []
+            # level 0: everything admits, nothing degraded
+            router._tick()
+            assert try_admit()
+            assert rep.engine.degraded_clamp is None
+            for lvl in (1, 2, 3, 4):
+                pressures["sessions_ratio"] = ramp[lvl]
+                router._tick()
+                assert router.level == lvl
+                shed = not try_admit()
+                if shed and "shed_at_router" not in first_fired:
+                    first_fired.append("shed_at_router")
+                if rep.engine.degraded_clamp is not None and \
+                        "clamp_at_engine" not in first_fired:
+                    # brownout precedes clamp: with no batcher on this
+                    # handle the brownout level is the fired counter
+                    pass
+                if router.gradient_fired["brownout_at_batcher"]\
+                        .get_value() and \
+                        "brownout_at_batcher" not in first_fired:
+                    first_fired.append("brownout_at_batcher")
+                if rep.engine.degraded_clamp is not None and \
+                        "clamp_at_engine" not in first_fired:
+                    first_fired.append("clamp_at_engine")
+                if rep.store.evictions.get_value() > evict0 and \
+                        "evict_at_store" not in first_fired:
+                    first_fired.append("evict_at_store")
+            assert first_fired == ["shed_at_router",
+                                   "brownout_at_batcher",
+                                   "clamp_at_engine",
+                                   "evict_at_store"], first_fired
+            # every level's fire counter is non-zero exactly once
+            fired = router.stats()["gradient_fired"]
+            assert fired["brownout_at_batcher"] == 1
+            assert fired["clamp_at_engine"] == 1
+            assert fired["evict_at_store"] == 1
+            # ---- de-escalation: reverse order, one level per
+            # hysteresis window ----
+            pressures["sessions_ratio"] = 0.0
+            order_down = []
+            evict_hi = rep.store.evictions.get_value()
+            for expect_lvl in (3, 2, 1, 0):
+                for _ in range(router._ladder.hysteresis_ticks):
+                    router._tick()
+                assert router.level == expect_lvl, \
+                    (router.level, expect_lvl)
+                if expect_lvl == 3:
+                    # evict stopped first: no new evictions this tick
+                    assert rep.store.evictions.get_value() == evict_hi
+                    assert rep.engine.degraded_clamp is not None
+                    order_down.append("evict_stopped")
+                elif expect_lvl == 2:
+                    assert rep.engine.degraded_clamp is None
+                    order_down.append("clamp_cleared")
+                elif expect_lvl == 1:
+                    # still shedding at the router, cheapest layer last
+                    assert not try_admit()
+                    order_down.append("brownout_cleared")
+                else:
+                    assert try_admit()
+                    order_down.append("shed_stopped")
+            assert order_down == ["evict_stopped", "clamp_cleared",
+                                  "brownout_cleared", "shed_stopped"]
+        finally:
+            router.close(timeout_s=1.0)
+            rep.close()
+
+    def test_supervisor_floor_follows_cluster_level(self):
+        """A replica WITH a supervisor follows the cluster gradient
+        through its level floor (cluster level N => local floor N-1),
+        so both ladders stay one coherent ordering."""
+        from brpc_tpu.serving import EngineSupervisor
+        store = KVCacheStore(page_tokens=PT, page_bytes=256,
+                             max_blocks=32, name="grad_sup_store")
+        calm = ({"queue_delay_us": float("inf"), "pool_ratio": 9.9,
+                 "queue_depth": 1e9},) * 3
+        sup = EngineSupervisor(
+            lambda: DecodeEngine(_step_fn(), num_slots=2, store=store,
+                                 max_pages_per_slot=16,
+                                 name="grad_sup_eng"),
+            store=store, ladder=calm, check_interval_s=30.0,
+            hysteresis_ticks=1, name="grad_sup")
+        srv = brpc.Server()
+        register_serving(srv, engine=sup)
+        srv.start("127.0.0.1", 0)
+        handle = ReplicaHandle(f"127.0.0.1:{srv.port}", supervisor=sup,
+                               store=store)
+        router = ClusterRouter([handle], auto_tick=False,
+                               hysteresis_ticks=1, name="grad_sup_router")
+        try:
+            pressures = {"sessions_ratio": 0.0}
+            router._pressures = lambda: dict(pressures)
+            pressures["sessions_ratio"] = 0.99      # level 4
+            router._tick()
+            assert router.level == 4
+            sup._update_degradation()
+            assert sup.level == 3      # floor = cluster level - 1
+            pressures["sessions_ratio"] = 0.0
+            router._tick()             # hysteresis=1: one calm tick/level
+            sup._update_degradation()
+            assert sup.level == max(0, router.level - 1)
+            for _ in range(8):
+                router._tick()
+            assert router.level == 0
+            sup._update_degradation()
+            sup._update_degradation()
+            sup._update_degradation()
+            assert sup.level == 0
+        finally:
+            router.close(timeout_s=1.0)
+            sup.close(timeout_s=2.0)
+            srv.stop()
+            srv.join()
+            store.clear()
+            store.close()
+
+
+def test_fault_sites_shed_and_reroute():
+    """router.admit fails the admission definitively; router.forward
+    makes the first forward attempt fail and the driver re-route."""
+    reps = [_Replica("fs_a"), _Replica("fs_b")]
+    router = ClusterRouter([r.handle() for r in reps], page_tokens=PT,
+                           auto_tick=False, name="fs_router")
+    try:
+        plan = fault.FaultPlan(seed=11)
+        plan.on("router.admit", fault.ERROR, times=1)
+        with fault.injected(plan):
+            with pytest.raises(errors.RpcError):
+                router.open_session([1, 2, 3], 2)
+        assert plan.injected.get("router.admit") == 1
+        plan2 = fault.FaultPlan(seed=12)
+        plan2.on("router.forward", fault.ERROR, times=1)
+        with fault.injected(plan2):
+            s = router.open_session([9, 9, 9, 9], 4)
+            assert wait_until(
+                lambda: s.state in ("finished", "failed"), 15)
+        assert s.state == "finished"
+        assert s.emitted == _expected([9, 9, 9, 9], 4)
+        assert plan2.injected.get("router.forward") == 1
+        assert s.resumes >= 1          # the re-route was counted
+    finally:
+        router.close(timeout_s=2.0)
+        for r in reps:
+            r.close()
+
+
+def test_press_cluster_mode():
+    """tools/rpc_press --cluster N drives generations through an
+    in-process cluster and reports generations/s, TTFT percentiles,
+    the resume count, and per-level shed counts."""
+    import io
+
+    from brpc_tpu.tools.rpc_press import run_cluster_press
+    import json as _json
+
+    out = io.StringIO()
+    summary = run_cluster_press(
+        2, {"prompt": [3, 1, 4, 1, 5], "max_new_tokens": 4},
+        duration_s=0.8, threads=2, timeout_ms=8000, out=out)
+    assert summary["generations_ok"] > 0
+    assert summary["generations_per_s"] > 0
+    assert summary["ttft_p99_us"] > 0
+    assert summary["errors"] == 0
+    assert "resumes" in summary
+    assert set(summary["shed_counts"]) == {
+        "shed_at_router", "brownout_at_batcher", "clamp_at_engine",
+        "evict_at_store"}
+    assert _json.loads(out.getvalue())   # machine-readable line
+
+
+def test_wedged_replica_progress_deadline_failover():
+    """A replica whose SERVER is alive but whose engine never emits
+    (accepts the forward, writes nothing, never closes) must read as a
+    failover at the driver's progress deadline — not hang the session
+    until router close.  The session completes bit-exact on the
+    healthy replica."""
+    from brpc_tpu.policy.load_balancer import prefix_fingerprint
+    from brpc_tpu.rpc.service import Service, method
+
+    held = []                      # keep wedged server streams alive
+
+    class _WedgedServing(Service):
+        NAME = "Serving"
+
+        @method(request="json", response="json")
+        def Generate(self, cntl, req):
+            held.append(cntl.accept_stream())
+            return {"accepted": True}
+
+    wsrv = brpc.Server()
+    wsrv.add_service(_WedgedServing())
+    wsrv.start("127.0.0.1", 0)
+    waddr = f"127.0.0.1:{wsrv.port}"
+    healthy = _Replica("wedge_ok", delay_s=0.002)
+    router = ClusterRouter(
+        [ReplicaHandle(waddr, name="wedged"), healthy.handle()],
+        page_tokens=PT, name="wedge_router",
+        progress_timeout_s=0.5, auto_tick=False)
+    try:
+        wep = router._ep_by_name[waddr]
+        # craft a prompt the affinity ring routes to the WEDGED replica
+        prompt = None
+        for base in range(40, 400):
+            cand = [base + j for j in range(9)]
+            if router._lb.select_server(
+                    request_code=prefix_fingerprint(
+                        cand, router.chunk_tokens)) == wep:
+                prompt = cand
+                break
+        assert prompt is not None
+        t0 = time.monotonic()
+        s = router.open_session(prompt, 5)
+        assert wait_until(
+            lambda: s.state in ("finished", "failed"), 20), \
+            "session hung on the wedged replica"
+        assert s.state == "finished"
+        assert s.emitted == _expected(prompt, 5)
+        assert s.resumes >= 1          # the deadline forced a re-route
+        assert time.monotonic() - t0 < 15
+    finally:
+        router.close(timeout_s=2.0)
+        wsrv.stop()
+        wsrv.join()
+        healthy.close()
+
+
+def test_no_stream_leak_on_shed_or_dead_replica():
+    """Streams created before a forward/Generate RPC that FAILS must be
+    closed, not left in the StreamRegistry forever: (a) a client whose
+    Generate is shed with ELIMIT, (b) a session driver whose first
+    forward lands on a dead replica (connect refused) before failing
+    over."""
+    from brpc_tpu.policy.load_balancer import prefix_fingerprint
+    from brpc_tpu.rpc.stream import StreamRegistry
+
+    reg = StreamRegistry.instance()
+    healthy = _Replica("leak_ok", delay_s=0.002)
+    dead_addr = "127.0.0.1:1"
+    router = ClusterRouter(
+        [ReplicaHandle(dead_addr, name="dead"), healthy.handle()],
+        page_tokens=PT, name="leak_router", auto_tick=False)
+    rsrv = brpc.Server()
+    register_router(rsrv, router)
+    rsrv.start("127.0.0.1", 0)
+    cli = RouterClient(f"127.0.0.1:{rsrv.port}")
+    try:
+        baseline = reg.count()
+        # (a) shed at router: the client's never-bound stream closes
+        router._ladder.level = 1
+        with pytest.raises(errors.RpcError):
+            cli.generate([1, 2, 3], 2, timeout_s=5)
+        router._ladder.level = 0
+        assert wait_until(lambda: reg.count() <= baseline, 5), \
+            f"shed leaked streams: {reg.count()} > {baseline}"
+        # (b) forward to a dead replica: the driver's stream closes,
+        # the session fails over and completes
+        dep = router._ep_by_name[dead_addr]
+        prompt = None
+        for base in range(40, 400):
+            cand = [base + j for j in range(7)]
+            if router._lb.select_server(
+                    request_code=prefix_fingerprint(
+                        cand, router.chunk_tokens)) == dep:
+                prompt = cand
+                break
+        assert prompt is not None
+        out = cli.generate(prompt, 3, timeout_s=20)
+        assert out["error"] is None
+        assert out["tokens"] == _expected(prompt, 3)
+        assert wait_until(lambda: reg.count() <= baseline, 5), \
+            f"dead-replica forward leaked: {reg.count()} > {baseline}"
+    finally:
+        router.close(timeout_s=2.0)
+        rsrv.stop()
+        rsrv.join()
+        healthy.close()
+
+
+def test_generate_attach_failure_cancels_session(cluster):
+    """If admission succeeds but the Generate ATTACH fails (the client
+    never learns its session_id), the router cancels the session —
+    an orphan must not decode its whole budget for nobody while
+    counting against max_sessions."""
+    reps, router, table, raddr = cluster
+    cli = RouterClient(raddr)
+    plan = fault.FaultPlan(seed=5)
+    plan.on("router.resume", fault.ERROR, times=1)
+    with fault.injected(plan):
+        # the channel layer transparently retries the failed Generate:
+        # the client still gets a (fresh) session and a full stream
+        out = cli.generate([11, 12, 13, 14], 6, timeout_s=10)
+    assert out["error"] is None
+    assert out["tokens"] == _expected([11, 12, 13, 14], 6)
+    assert plan.injected.get("router.resume") == 1
+    # ...while the attach-orphaned first session was CANCELLED, not
+    # left decoding its budget for nobody
+    assert wait_until(lambda: table.live_count() == 0, 10), \
+        "orphaned session still live after attach failure"
+    counts = table.counts()
+    assert counts["failed"] == 1 and counts["finished"] == 1
+
+
+def test_attach_after_close_raises_elogoff():
+    """Resume against a CLOSED router tells the client now (ELOGOFF:
+    reconnect to the successor) instead of replaying a backlog that
+    never reaches a terminal."""
+    healthy = _Replica("close_ok", delay_s=0.002)
+    router = ClusterRouter([healthy.handle()], page_tokens=PT,
+                           name="close_router", auto_tick=False)
+    try:
+        s = router.open_session([1, 2, 3, 4], 3)
+        assert wait_until(
+            lambda: s.state in ("finished", "failed"), 15)
+        sid = s.sid
+        router.close(timeout_s=2.0)
+        with pytest.raises(errors.RpcError) as ei:
+            router.attach(sid, 0, lambda t: None)
+        assert ei.value.code == errors.ELOGOFF
+    finally:
+        router.close(timeout_s=1.0)
+        healthy.close()

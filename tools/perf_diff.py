@@ -32,11 +32,13 @@ import sys
 # latency/duration/overhead.  Ratios and counts are informational only.
 _LOWER_BETTER_SUFFIXES = ("_us", "_ms", "_s")
 _LOWER_BETTER_KEYS = {"overhead_pct", "overhead_pct_vs_off",
-                      "lat_us", "shed_frac", "err_frac"}
+                      "lat_us", "shed_frac", "err_frac",
+                      "router_overhead_pct"}
 _HIGHER_BETTER_KEYS = {"qps", "gbps", "tokens_per_s", "items_per_s",
                        "hbm_traffic_gbps", "qps_off", "qps_on",
                        "speedup_at_peak", "zero_copy_speedup",
-                       "prefill_skip_ratio"}
+                       "prefill_skip_ratio",
+                       "direct_gens_per_s", "router_gens_per_s"}
 
 
 def direction(key: str) -> str | None:
