@@ -116,10 +116,28 @@ hotspots: $(LIB) $(PYEXT)
 
 # Per-stage host micro-benchmark suite (bench.py microbench): frame
 # pump, batch assembly, radix prefix match, page alloc/release, emit
-# fan-out, span submit, sampler overhead — CPU-valid, 3-trial
-# median+spread.  The de-GIL work (ROADMAP item 4) gates on these.
+# fan-out, span submit, host-us-per-token, stream scaling, sampler
+# overhead — CPU-valid, 3-trial median+spread.  The de-GIL'd stages
+# publish a native-vs-python A/B per round (ISSUE 9, README "Native
+# host path").
 microbench: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python bench.py microbench
+
+# De-GIL perf gate (ISSUE 9): run the per-stage host microbench suite,
+# nest its output under "microbench" to match the round wrappers'
+# detail tree, and perf_diff it against the freshest BENCH_r*.json —
+# exits 1 on any beyond-spread regression, so the per-stage trajectory
+# (emit_fanout, batch_assembly, span_submit, host_us_per_token and
+# their native_speedup A/Bs) gates future PRs by default.  Wire this
+# next to `make test` in a verify loop; MICROBENCH.json is the
+# sidecar a later round can diff against directly.
+perf: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python bench.py microbench \
+	    | python -c "import json,sys; json.dump({'microbench': \
+	    json.load(sys.stdin)}, open('MICROBENCH.json','w'), indent=1)"
+	python tools/perf_diff.py \
+	    "$$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)" \
+	    MICROBENCH.json
 
 # Full bench run ending in a delta-vs-previous-round table: perf_diff
 # compares the freshest BENCH_r*.json against this run's
@@ -162,4 +180,4 @@ stress:
 	./build/stress_plain
 
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
-    cluster trace hotspots microbench bench tsan asan stress
+    cluster trace hotspots microbench perf bench tsan asan stress

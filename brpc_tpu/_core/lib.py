@@ -345,6 +345,36 @@ _sigs = {
                                              ctypes.c_int, ctypes.c_int]),
     "brpc_fiber_rw_stress": (ctypes.c_int64, [ctypes.c_int, ctypes.c_int,
                                               ctypes.c_int]),
+    # native serving hot path (ISSUE 9; src/cc/serving_hotpath.cc):
+    # bounded emit token rings with batch push/pop, batch-formation
+    # pad, page-table gather — ctypes releases the GIL for each call
+    "brpc_tokring_new": (ctypes.c_void_p, [ctypes.c_int]),
+    "brpc_tokring_free": (None, [ctypes.c_void_p]),
+    "brpc_tokring_live": (ctypes.c_int64, []),
+    "brpc_tokring_push": (ctypes.c_int, [ctypes.c_void_p, ctypes.c_int32]),
+    "brpc_tokring_push_many": (ctypes.c_int,
+                               [ctypes.POINTER(ctypes.c_void_p),
+                                ctypes.POINTER(ctypes.c_int32),
+                                ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_uint8)]),
+    "brpc_tokring_push_terminal": (ctypes.c_int, [ctypes.c_void_p,
+                                                  ctypes.c_int32]),
+    "brpc_tokring_pop_many": (ctypes.c_int,
+                              [ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_int32),
+                               ctypes.c_int, ctypes.c_int64,
+                               ctypes.POINTER(ctypes.c_int),
+                               ctypes.POINTER(ctypes.c_int32)]),
+    "brpc_tokring_size": (ctypes.c_int64, [ctypes.c_void_p]),
+    "brpc_batch_pad": (None, [ctypes.POINTER(ctypes.c_void_p),
+                              ctypes.POINTER(ctypes.c_int64),
+                              ctypes.c_int, ctypes.c_void_p,
+                              ctypes.c_int64, ctypes.c_int64]),
+    "brpc_page_table_fill": (None, [ctypes.POINTER(ctypes.c_void_p),
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.c_int, ctypes.c_void_p,
+                                    ctypes.c_int, ctypes.c_int]),
 }
 for _name, (_res, _args) in _sigs.items():
     fn = getattr(core, _name)
@@ -460,3 +490,72 @@ class IOBuf:
 
     def clear(self) -> None:
         core.brpc_iobuf_clear(self.handle)
+
+
+class TokenRing:
+    """Python handle on one native bounded emit ring (ISSUE 9;
+    src/cc/serving_hotpath.cc).  The hot calls — batch push from the
+    decode step loop, batch pop from the emitter — run with the GIL
+    released for the call's duration; the terminal marker's Python
+    error OBJECT rides a wrapper slot whose exactly-once owner is
+    decided by the native ring (first push_terminal wins), so native
+    and Python state can never disagree about which error a consumer
+    observes."""
+
+    __slots__ = ("handle", "cap", "_terminal_obj", "_terminal_set",
+                 "_tmu")
+
+    def __init__(self, cap: int):
+        self.cap = int(cap)
+        self.handle = core.brpc_tokring_new(self.cap)
+        self._terminal_obj = None
+        self._terminal_set = False
+        # terminal is once-per-request (cold): a tiny Python lock keeps
+        # the error OBJECT slot and the native marker exactly-once
+        # together; the per-token path never touches it
+        self._tmu = threading.Lock()
+
+    def __del__(self):
+        h = getattr(self, "handle", None)
+        if h:
+            core.brpc_tokring_free(h)
+            self.handle = None
+
+    def push(self, tok: int) -> bool:
+        # prefer the C-extension entry: it HOLDS the GIL (the ring
+        # mutex is held for nanoseconds, so a ctypes GIL drop/reacquire
+        # per token costs more than the push — and under N producer
+        # threads becomes a handoff convoy)
+        fb = _fastrpc_mod()
+        if fb is not None:
+            return bool(fb.tokring_push(self.handle, tok))
+        return bool(core.brpc_tokring_push(self.handle, tok))
+
+    def push_terminal(self, err) -> None:
+        with self._tmu:
+            if self._terminal_set:
+                return
+            # object BEFORE the native marker: a consumer that observes
+            # the native terminal must find the winner's object in place
+            self._terminal_obj = err
+            self._terminal_set = True
+            core.brpc_tokring_push_terminal(
+                self.handle, getattr(err, "code", 0) or 0)
+
+    def pop_many(self, out, timeout_s: float):
+        """Drain into the caller's ctypes int32 array `out`; returns
+        ``(count, terminal_seen, err_obj)``."""
+        term = ctypes.c_int(0)
+        errc = ctypes.c_int32(0)
+        n = core.brpc_tokring_pop_many(
+            self.handle, out, len(out), int(timeout_s * 1e6),
+            ctypes.byref(term), ctypes.byref(errc))
+        return n, bool(term.value), self._terminal_obj
+
+    def __len__(self) -> int:
+        return core.brpc_tokring_size(self.handle)
+
+
+def tokring_live() -> int:
+    """Globally live native emit rings (chaos-suite leak baseline)."""
+    return core.brpc_tokring_live()

@@ -58,6 +58,38 @@ _WAIT_MARKERS = frozenset([
     ("lockprof", "acquire"), ("lockprof", "_acquire_restore"),
 ])
 
+# leaf frames that mean "inside a GIL-released native call" (ISSUE 9):
+# a ctypes foreign call adds NO Python frame, so a thread spending its
+# time in the de-GIL'd hot path samples at the binding-layer call site.
+# Without this class those stacks would read as Python "run" time —
+# exactly the time the rewrite moved OFF Python — so they fold into a
+# `;[native]` leaf and count as their own column: not GIL-bound run
+# time, not lock-wait.
+_NATIVE_LEAF_PREFIXES = ("brpc_tpu/_core/", "brpc_tpu/native_path")
+# native calls issued directly from hot-path frames (the engine's
+# batched token push runs the foreign call from its own frame)
+_NATIVE_MARKERS = frozenset([
+    ("engine", "_push_tokens"),
+])
+# binding-layer call sites that deliberately HOLD the GIL (the
+# _fastrpc fast entries: a per-token ctypes GIL drop/reacquire costs
+# more than the push) — a thread sampled here is GIL-bound Python run
+# time, and classing it "native" would overstate gil_wait_ratio's
+# de-GIL story exactly where this measurement judges it
+_GIL_HELD_BINDING = frozenset([
+    ("lib", "push"),            # TokenRing.push -> fb.tokring_push
+    ("lib", "push_terminal"),   # cold, Python-mutex-held
+])
+
+
+def _is_native_leaf(leaf_code) -> bool:
+    key = (_modname(leaf_code.co_filename), leaf_code.co_name)
+    if key in _NATIVE_MARKERS:
+        return True
+    if key in _GIL_HELD_BINDING:
+        return False
+    return _short(leaf_code.co_filename).startswith(_NATIVE_LEAF_PREFIXES)
+
 
 def _modname(filename: str) -> str:
     base = filename.rsplit("/", 1)[-1]
@@ -73,10 +105,12 @@ def _short(path: str) -> str:
     return path
 
 
-def _fold(frame, skip_tids=None) -> tuple[str, bool]:
-    """(folded root;..;leaf stack, is_waiting) for one thread frame —
-    a raw f_back walk: no linecache, no source IO, cheap enough for an
-    always-on path."""
+def _fold(frame, skip_tids=None) -> tuple[str, str]:
+    """(folded root;..;leaf stack, class) for one thread frame — a raw
+    f_back walk: no linecache, no source IO, cheap enough for an
+    always-on path.  class is one of "run" (executing Python), "wait"
+    (parked on a lock/queue) or "native" (inside a GIL-released
+    foreign call in the de-GIL'd hot path)."""
     parts: list[str] = []
     f = frame
     while f is not None:
@@ -85,35 +119,45 @@ def _fold(frame, skip_tids=None) -> tuple[str, bool]:
         f = f.f_back
     parts.reverse()
     leaf = frame.f_code
-    waiting = (_modname(leaf.co_filename), leaf.co_name) in _WAIT_MARKERS
-    return ";".join(parts), waiting
+    if (_modname(leaf.co_filename), leaf.co_name) in _WAIT_MARKERS:
+        cls = "wait"
+    elif _is_native_leaf(leaf):
+        cls = "native"
+    else:
+        cls = "run"
+    return ";".join(parts), cls
+
+
+_CLS_SUFFIX = {"run": "", "wait": ";[lock-wait]", "native": ";[native]"}
 
 
 def sample_once(exclude: frozenset = frozenset()) -> list[tuple]:
-    """One pass over every live thread: [(stage, folded, waiting)].
+    """One pass over every live thread: [(stage, folded, class)].
     ``exclude`` filters thread idents (the sampler excludes itself)."""
     names = {t.ident: t.name for t in threading.enumerate()}
     out = []
     for tid, frame in sys._current_frames().items():
         if tid in exclude:
             continue
-        folded, waiting = _fold(frame)
+        folded, cls = _fold(frame)
         stage_name = stagetag.stage_of(tid, names.get(tid, ""))
-        out.append((stage_name, folded, waiting))
+        out.append((stage_name, folded, cls))
     return out
 
 
 class _Window:
-    __slots__ = ("start", "samples", "run", "wait", "stage_run",
-                 "stage_wait")
+    __slots__ = ("start", "samples", "run", "wait", "native",
+                 "stage_run", "stage_wait", "stage_native")
 
     def __init__(self, start: float):
         self.start = start
-        self.samples: Counter = Counter()   # "stage;folded[ (waiting)]"
+        self.samples: Counter = Counter()   # "stage;folded[;class]"
         self.run = 0
         self.wait = 0
+        self.native = 0
         self.stage_run: Counter = Counter()
         self.stage_wait: Counter = Counter()
+        self.stage_native: Counter = Counter()
 
 
 class HotspotSampler:
@@ -199,13 +243,15 @@ class HotspotSampler:
                 if t0 - win.start >= self.window_s:
                     self._ring.append(win)
                     win = self._win = _Window(t0)
-                for stage_name, folded, waiting in observed:
+                for stage_name, folded, cls in observed:
                     win.samples[
-                        f"{stage_name};{folded}"
-                        + (";[lock-wait]" if waiting else "")] += 1
-                    if waiting:
+                        f"{stage_name};{folded}{_CLS_SUFFIX[cls]}"] += 1
+                    if cls == "wait":
                         win.wait += 1
                         win.stage_wait[stage_name] += 1
+                    elif cls == "native":
+                        win.native += 1
+                        win.stage_native[stage_name] += 1
                     else:
                         win.run += 1
                         win.stage_run[stage_name] += 1
@@ -230,9 +276,13 @@ class HotspotSampler:
         return merged
 
     def gil_wait_ratio(self) -> float:
+        # native samples stay in the denominator: a thread inside a
+        # GIL-released foreign call is making progress WITHOUT the GIL,
+        # and dropping it would inflate the ratio exactly where the
+        # de-GIL rewrite (ISSUE 9) succeeded
         run = wait = 0
         for w in self._windows():
-            run += w.run
+            run += w.run + w.native
             wait += w.wait
         total = run + wait
         return round(wait / total, 4) if total else 0.0
@@ -240,15 +290,19 @@ class HotspotSampler:
     def stage_table(self) -> dict[str, dict]:
         run: Counter = Counter()
         wait: Counter = Counter()
+        native: Counter = Counter()
         for w in self._windows():
             run.update(w.stage_run)
             wait.update(w.stage_wait)
+            native.update(w.stage_native)
         out = {}
-        for stage_name in sorted(set(run) | set(wait)):
-            r, wt = run[stage_name], wait[stage_name]
+        for stage_name in sorted(set(run) | set(wait) | set(native)):
+            r, wt, nv = run[stage_name], wait[stage_name], \
+                native[stage_name]
+            total = r + wt + nv
             out[stage_name] = {
-                "run": r, "wait": wt,
-                "wait_ratio": round(wt / (r + wt), 4) if r + wt else 0.0,
+                "run": r, "wait": wt, "native": nv,
+                "wait_ratio": round(wt / total, 4) if total else 0.0,
             }
         return out
 
@@ -273,9 +327,8 @@ def burst(duration_s: float, hz: int = 100) -> Counter:
     interval = 1.0 / max(1, hz)
     end = time.monotonic() + min(60.0, max(0.05, duration_s))
     while time.monotonic() < end:
-        for stage_name, folded, waiting in sample_once(exclude=me):
-            stacks[f"{stage_name};{folded}"
-                   + (";[lock-wait]" if waiting else "")] += 1
+        for stage_name, folded, cls in sample_once(exclude=me):
+            stacks[f"{stage_name};{folded}{_CLS_SUFFIX[cls]}"] += 1
         time.sleep(interval)
     return stacks
 
@@ -286,18 +339,24 @@ def render_folded(stacks: Counter, title: str, top: int = 25) -> str:
     total = sum(stacks.values())
     by_stage: Counter = Counter()
     wait_by_stage: Counter = Counter()
+    native_by_stage: Counter = Counter()
     for s, n in stacks.items():
         stage_name = s.split(";", 1)[0]
         by_stage[stage_name] += n
         if s.endswith(";[lock-wait]"):
             wait_by_stage[stage_name] += n
+        elif s.endswith(";[native]"):
+            native_by_stage[stage_name] += n
     lines = [f"--- {title}: {total} samples, {len(stacks)} unique "
              f"stage-tagged stacks ---", "",
-             f"{'samples':>8}  {'%':>6}  {'lock-wait%':>10}  stage"]
+             f"{'samples':>8}  {'%':>6}  {'lock-wait%':>10}  "
+             f"{'native%':>7}  stage"]
     for stage_name, n in by_stage.most_common():
         w = wait_by_stage[stage_name]
+        nv = native_by_stage[stage_name]
         lines.append(f"{n:>8}  {100.0 * n / max(1, total):>5.1f}%  "
-                     f"{100.0 * w / max(1, n):>9.1f}%  {stage_name}")
+                     f"{100.0 * w / max(1, n):>9.1f}%  "
+                     f"{100.0 * nv / max(1, n):>6.1f}%  {stage_name}")
     lines.append("")
     lines.append("hottest stacks (stage;root;..;leaf):")
     for s, n in stacks.most_common(top):

@@ -38,6 +38,7 @@ _NAME_STAGES = (
     ("serving-emit", "emit_fanout"),
     ("kv-migrate", "migrate"),
     ("bvar-collector", "span_submit"),
+    ("rpcz-spanq", "span_submit"),
     ("bvar-sampler", "bvar_sampler"),
     ("hotspot-sampler", "hotspot_sampler"),
     # native executor/dispatcher threads (the C++ frame pump) have no

@@ -1,0 +1,133 @@
+"""Native hot-path gate (ISSUE 9).
+
+One switchboard for the serving stack's de-GIL'd paths — the native
+emit token rings (engine), GIL-released batch assembly (batcher) and
+the native span queue (rpcz) all ask HERE whether to take the native
+road:
+
+  * the reloadable flag ``native_hot_path_enabled`` (default True,
+    flip live on /flags) is the operator's kill switch — platforms
+    where ``libbrpc_core.so`` cannot build, or a suspected native bug,
+    fall back to the pure-Python implementations with identical
+    semantics (tier-1 passes either way);
+  * availability is probed lazily and cached: importing ``_core``
+    builds the library on first use, and a failed build must degrade
+    to the Python path, not break serving.
+
+The pure-Python fallbacks are the PR 2/3 implementations, kept in
+place (``serving/engine.py`` ``_EmitBuf``, the batcher's numpy pad
+loop, the collector submit path) — the flag chooses per REQUEST /
+per BATCH / per SPAN, so flipping it live is safe: in-flight native
+rings keep draining natively while new requests take the Python path.
+"""
+from __future__ import annotations
+
+from brpc_tpu.flags import define_flag, get_flag
+
+define_flag("native_hot_path_enabled", True,
+            "serve the per-token hot path (emit rings, batch assembly, "
+            "span queue) through the native core; off = pure-Python "
+            "fallback with identical semantics", reloadable=True)
+
+_lib = None
+_lib_failed = False
+_fastrpc = None
+
+
+def _core_lib():
+    """brpc_tpu._core.lib, or None when the native build is
+    unavailable (cached either way)."""
+    global _lib, _lib_failed
+    if _lib is None and not _lib_failed:
+        try:
+            from brpc_tpu._core import lib as _l
+            _lib = _l
+        except Exception:
+            _lib_failed = True
+    return _lib
+
+
+def _fastrpc_mod():
+    # cache only SUCCESS: lib._fastrpc_mod returns None while the
+    # extension is still building (and caps its own import attempts),
+    # so a first call landing mid-build must not freeze this process
+    # on the slow path forever — keep asking until the module loads
+    global _fastrpc
+    if _fastrpc is None:
+        lib = _core_lib()
+        if lib is not None:
+            _fastrpc = lib._fastrpc_mod()
+    return _fastrpc
+
+
+def enabled() -> bool:
+    """True when the flag is on AND the native core loaded."""
+    return bool(get_flag("native_hot_path_enabled", True)) \
+        and _core_lib() is not None
+
+
+def spanq() -> object | None:
+    """The _fastrpc module exposing spanq_push/drain, or None when the
+    native span queue should not be used."""
+    if not get_flag("native_hot_path_enabled", True):
+        return None
+    return _fastrpc_mod()
+
+
+def token_ring(cap: int):
+    """A native TokenRing, or None to use the Python _EmitBuf."""
+    if not enabled():
+        return None
+    return _core_lib().TokenRing(cap)
+
+
+def tokring_live() -> int:
+    lib = _core_lib()
+    return lib.tokring_live() if lib is not None else 0
+
+
+def batch_pad_available() -> bool:
+    return enabled()
+
+
+def batch_pad(out, rows, lengths) -> None:
+    """Zero-fill the 2-D C-contiguous numpy array ``out`` and copy
+    ``rows[i]`` (1-D arrays of out.dtype, C-contiguous, exactly
+    ``lengths[i]`` elements long — the batcher's enqueue coercion
+    guarantees it) into ``out[i, :lengths[i]]`` — one native call, GIL
+    released for the memset+memcpy pass."""
+    fb = _fastrpc_mod()
+    if fb is not None:
+        # buffer-protocol arg parsing: no per-row .ctypes view objects
+        # (the ctypes path below pays ~25us of marshalling per call,
+        # which swamps the copy for serving-sized batches)
+        fb.batch_pad(out, rows)
+        return
+    import ctypes
+    lib = _core_lib()
+    n = len(rows)
+    ptrs = (ctypes.c_void_p * n)(
+        *[r.ctypes.data for r in rows])
+    itemsize = out.itemsize
+    nbytes = (ctypes.c_int64 * n)(
+        *[int(ln) * itemsize for ln in lengths])
+    lib.core.brpc_batch_pad(ptrs, nbytes, n, out.ctypes.data,
+                            out.shape[1] * itemsize, out.nbytes)
+
+
+def page_table_fill(table, lists, slot_idx) -> None:
+    """Fill the fixed-shape int32 ``table`` with -1 and copy each
+    int32 page-id array ``lists[k]`` into row ``slot_idx[k]``
+    (truncated to the table width) — one GIL-released native call."""
+    fb = _fastrpc_mod()
+    if fb is not None:
+        fb.page_table_fill(table, lists, slot_idx)
+        return
+    import ctypes
+    lib = _core_lib()
+    n = len(lists)
+    ptrs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in lists])
+    lens = (ctypes.c_int64 * n)(*[len(a) for a in lists])
+    idx = (ctypes.c_int32 * n)(*slot_idx)
+    lib.core.brpc_page_table_fill(ptrs, lens, idx, n, table.ctypes.data,
+                                  table.shape[0], table.shape[1])

@@ -331,6 +331,78 @@ class _SpanSample:
                     pass  # disk trouble must never break collection
 
 
+# ---- native span queue (ISSUE 9): the submit hot path is ONE
+# lock-free push of the span object onto a native MPSC stack
+# (_fastrpc.spanq_push); the rate-limit grab, recent-store append and
+# SpanDB IO all run on the drainer thread, so tracing leaves the token
+# path entirely.  The Collector path below remains the fallback when
+# the native extension is unavailable or the flag is off. ----
+_spanq_mu = threading.Lock()
+_spanq_thread: threading.Thread | None = None
+_SPANQ_INTERVAL_S = 0.05
+# exclusive access to the native queue for callers that need the
+# drainer to keep its hands off (the spanq unit tests push non-Span
+# probes; a concurrent drainer steal would both flake the test and
+# poison _collected with foreign objects)
+_spanq_pause = threading.Lock()
+
+
+def _drain_native_spanq() -> None:
+    """Move every natively queued span into the recent-span store
+    (speed-limited, SpanDB-persisted).  Runs on the drainer thread and
+    synchronously from flush(); spanq_drain's atomic exchange makes
+    concurrent drains hand each span to exactly one caller."""
+    from brpc_tpu import native_path
+    fb = native_path._fastrpc_mod()
+    if fb is None:
+        return
+    spans = fb.spanq_drain()
+    if not spans:
+        return
+    from brpc_tpu.bvar.collector import get_or_create_limit
+    from brpc_tpu.butil import hostcpu
+    limit = get_or_create_limit("rpcz", 2000)
+    t_cpu0 = time.thread_time()
+    # same bounded-overhead contract as the Collector (the speed limit
+    # drops the excess, keeping the EARLIEST spans — FIFO), but ONE
+    # budget grab per drained batch: per-span grab() here held the GIL
+    # for milliseconds on a 2000-span drain, stealing it from the very
+    # token path this queue exists to protect
+    kept = spans[:limit.grab_n(len(spans))]
+    if kept:
+        with _collect_lock:
+            _collected.extend(kept)
+        with _db_lock:
+            if _db_dir is not None:
+                for span in kept:
+                    try:
+                        _db_append_locked(span)
+                    except OSError:
+                        pass  # disk trouble must never break collection
+    # span-submit host-CPU accounting (ISSUE 6) stays honest: the
+    # heavyweight half now burns THIS thread, not the token path
+    hostcpu.add("span_submit", (time.thread_time() - t_cpu0) * 1e6)
+
+
+def _spanq_loop() -> None:
+    while True:
+        time.sleep(_SPANQ_INTERVAL_S)
+        try:
+            with _spanq_pause:
+                _drain_native_spanq()
+        except Exception:
+            pass   # a torn drain must never kill the drainer
+
+
+def _ensure_spanq_drainer() -> None:
+    global _spanq_thread
+    with _spanq_mu:
+        if _spanq_thread is None or not _spanq_thread.is_alive():
+            _spanq_thread = threading.Thread(
+                target=_spanq_loop, daemon=True, name="rpcz-spanq")
+            _spanq_thread.start()
+
+
 def submit(span: Span) -> None:
     if not _enabled or span is NULL_SPAN:
         return
@@ -340,17 +412,39 @@ def submit(span: Span) -> None:
         # re-rolling per span would leave a kept trace with holes
         return
     span.end_us = span.end_us or now_us()
+    from brpc_tpu import native_path
+    fb = native_path.spanq()
+    if fb is not None:
+        # ISSUE 9 hot path: one lock-free native push; everything
+        # heavier happens on the rpcz-spanq drainer
+        fb.spanq_push(span)
+        t = _spanq_thread
+        if t is None or not t.is_alive():
+            # covers first use AND a dead-but-non-None thread (a fork's
+            # child inherits the module state but not the drainer)
+            _ensure_spanq_drainer()
+        return
     from brpc_tpu.bvar.collector import Collector, get_or_create_limit
     Collector.instance().submit(_SpanSample(span),
                                 get_or_create_limit("rpcz", 2000),
                                 family="rpcz")
 
 
-def recent_spans(limit: int = 100, trace_id: int | None = None) -> list[Span]:
-    # observe our own prior submissions; flushing ONLY the rpcz family
-    # keeps this (console) thread away from other consumers' IO
+def flush() -> None:
+    """Synchronously land this thread's prior submissions in the
+    recent-span store — drains the native span queue AND the rpcz
+    Collector family (whichever path each span took)."""
+    with _spanq_pause:
+        _drain_native_spanq()
     from brpc_tpu.bvar.collector import Collector
     Collector.instance().flush(family="rpcz")
+
+
+def recent_spans(limit: int = 100, trace_id: int | None = None) -> list[Span]:
+    # observe our own prior submissions; flushing ONLY the rpcz family
+    # (plus the native queue) keeps this (console) thread away from
+    # other consumers' IO
+    flush()
     with _collect_lock:
         spans = list(_collected)
     if trace_id is not None:

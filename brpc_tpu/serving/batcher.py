@@ -61,7 +61,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from brpc_tpu import errors, fault, rpcz
+from brpc_tpu import errors, fault, native_path, rpcz
 from brpc_tpu.butil import hostcpu
 from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
@@ -581,16 +581,42 @@ class DynamicBatcher:
                 kept.append(p)
         return kept
 
+    def _form_batch(self, live: list[_Pending], bshape: int,
+                    lbucket: int) -> np.ndarray:
+        """Formation gather/pad — MECHANISM only (bucket choice, EDF
+        lanes and shed policy are decided above, in Python, where
+        policy lives): returns the (bshape, lbucket) padded batch with
+        live[i] scattered into row i.
+
+        Native path (ISSUE 9): zero-fill + every row memcpy run as ONE
+        GIL-released native pass, so concurrent submitters keep running
+        through formation.  Fallback: the numpy per-row scatter loop.
+        The `batch_assembly` microbench rung hammers THIS method."""
+        if native_path.batch_pad_available():
+            padded = np.empty((bshape, lbucket), dtype=self.dtype)
+            # enqueue() already coerced every item to a 1-D array of
+            # self.dtype, so ascontiguousarray is a no-op for the
+            # common case (suffix trims of contiguous arrays stay
+            # contiguous); it protects the native memcpy from a strided
+            # array a caller snuck through
+            rows = [np.ascontiguousarray(p.item) for p in live]
+            native_path.batch_pad(padded, rows,
+                                  [p.length for p in live])
+            return padded
+        padded = np.zeros((bshape, lbucket), dtype=self.dtype)
+        for i, p in enumerate(live):
+            padded[i, : p.length] = p.item
+        return padded
+
     def _execute(self, live: list[_Pending]) -> None:
         n = len(live)
         bshape = _bucket_up(n, self.batch_buckets)
         lbucket = _bucket_up(max(p.length for p in live),
                              self.length_buckets)
-        padded = np.zeros((bshape, lbucket), dtype=self.dtype)
+        padded = self._form_batch(live, bshape, lbucket)
         real = 0
         skipped = 0
-        for i, p in enumerate(live):
-            padded[i, : p.length] = p.item
+        for p in live:
             real += p.length
             skipped += p.skip
         self._real_elems.add(real)

@@ -51,6 +51,7 @@ broken step function fails its requests definitively).
 """
 from __future__ import annotations
 
+import ctypes
 import itertools
 import re
 import threading
@@ -60,7 +61,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from brpc_tpu import errors, fault, rpcz
+from brpc_tpu import errors, fault, native_path, rpcz
 from brpc_tpu.butil import hostcpu, stagetag
 from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.bvar import Adder, IntRecorder, LatencyRecorder, PassiveStatus
@@ -126,6 +127,58 @@ class _EmitBuf:
             return None
 
 
+class _NativeEmitBuf:
+    """Native bounded emit ring (ISSUE 9) with the _EmitBuf protocol
+    plus batch pop.  The step loop pushes through ONE GIL-released
+    ``brpc_tokring_push_many`` call per step across all slots (the
+    engine batches; ``push`` here is the single-slot/fallback entry),
+    and the emitter drains MANY tokens per wakeup via ``pop_batch``
+    instead of a Python lock round-trip per token.  Semantics are
+    identical to _EmitBuf: push never blocks, a full ring means the
+    consumer is cut with EOVERCROWDED, the terminal is always accepted
+    and only surfaces after every buffered token."""
+
+    __slots__ = ("ring", "cap", "popbuf")
+
+    def __init__(self, ring, cap: int):
+        self.ring = ring
+        self.cap = cap
+        # the emitter thread owns this scratch array (single consumer)
+        self.popbuf = (ctypes.c_int32 * min(int(cap), 512))()
+
+    @property
+    def handle(self):
+        return self.ring.handle
+
+    def push(self, tok: int) -> bool:
+        return self.ring.push(int(tok))
+
+    def push_terminal(self, err) -> None:
+        self.ring.push_terminal(err)
+
+    def pop_batch(self, timeout_s: float):
+        """(count, terminal_seen, err) — tokens land in ``popbuf``."""
+        return self.ring.pop_many(self.popbuf, timeout_s)
+
+    def pop(self, timeout_s: float):
+        """Single-item _EmitBuf-protocol pop (compat path for callers
+        that drain one token at a time)."""
+        one = (ctypes.c_int32 * 1)()
+        n, term, err = self.ring.pop_many(one, timeout_s)
+        if n:
+            return ("tok", int(one[0]))
+        if term:
+            return ("done", err)
+        return None
+
+
+def _make_emit_buf(cap: int):
+    ring = native_path.token_ring(cap)
+    if ring is not None:
+        return _NativeEmitBuf(ring, cap)
+    return _EmitBuf(cap)
+
+
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "emit", "on_done",
                  "buf", "t_submit", "trace", "_done_fired", "_mu")
@@ -139,7 +192,7 @@ class _Request:
         self.max_new_tokens = int(max_new_tokens)
         self.emit = emit
         self.on_done = on_done
-        self.buf = _EmitBuf(emit_buffer)
+        self.buf = _make_emit_buf(emit_buffer)
         self.t_submit = time.monotonic()
         # (trace_id, parent_span_id, sampled): captured at submit from
         # the caller's current span (the RPC ingress span when coming
@@ -280,6 +333,12 @@ class DecodeEngine:
         self._prefill_fn_cpu_s = 0.0   # model-fn CPU of the last admit
         self._beat_steps = 0
         self._beat_t = time.monotonic()
+
+        # scratch for the per-step batched native emit push (ISSUE 9):
+        # sized once at the slot count, owned by the engine thread
+        self._push_handles = (ctypes.c_void_p * self.num_slots)()
+        self._push_toks = (ctypes.c_int32 * self.num_slots)()
+        self._push_ok = (ctypes.c_uint8 * self.num_slots)()
 
         # the engine slot lock is a NAMED hot lock (ISSUE 6): submit,
         # the step loop, emitter cancels and the console all meet here
@@ -458,6 +517,8 @@ class DecodeEngine:
         when its consumer blocks; emit failures retire just this
         request; the terminal marker flushes after the tokens and fires
         on_done exactly once."""
+        if isinstance(req.buf, _NativeEmitBuf):
+            return self._emit_pump_native(req)
         while True:
             item = req.buf.pop(0.25)
             if item is None:
@@ -484,6 +545,38 @@ class DecodeEngine:
             finally:
                 hostcpu.add("emit_fanout",
                             (time.thread_time() - t_cpu0) * 1e6)
+
+    def _emit_pump_native(self, req: _Request) -> None:
+        """Native-ring emitter: each wakeup drains a BATCH of tokens in
+        one GIL-released call (the pop wait parks in native code, off
+        the GIL), then delivers them through the request's emit
+        callback.  Terminal semantics are byte-for-byte the _EmitBuf
+        pump's: every buffered token flushes before on_done fires
+        exactly once."""
+        buf: _NativeEmitBuf = req.buf
+        out = buf.popbuf
+        while True:
+            n, term, err = buf.pop_batch(0.25)
+            if n == 0 and not term:
+                if req.done_fired:
+                    return        # finished elsewhere (close timeout path)
+                continue
+            t_cpu0 = time.thread_time()
+            try:
+                for k in range(n):
+                    req.emit(int(out[k]))
+            except Exception as e:
+                hostcpu.add("emit_fanout",
+                            (time.thread_time() - t_cpu0) * 1e6)
+                self._cancel(req, errors.RpcError(
+                    errors.EINTERNAL,
+                    f"emit failed: {type(e).__name__}: {e}"))
+                return
+            hostcpu.add("emit_fanout",
+                        (time.thread_time() - t_cpu0) * 1e6)
+            if term:
+                req.finish(err)
+                return
 
     def _cancel(self, req: _Request, err) -> None:
         """Retire `req`'s slot from OFF the engine thread (emitter saw
@@ -601,6 +694,17 @@ class DecodeEngine:
     def _gather_page_tables(self, active) -> Optional[np.ndarray]:
         if not self._wants_pages:
             return None
+        if native_path.enabled():
+            # fixed-shape gather as one GIL-released native fill
+            # (ISSUE 9); the row arrays stay referenced until the call
+            # returns so their buffers cannot move
+            table = np.empty((self.num_slots, self.max_pages_per_slot),
+                             np.int32)
+            rows = [(i, np.asarray(s.seq.page_ids(), np.int32))
+                    for i, s in active if s.seq is not None]
+            native_path.page_table_fill(
+                table, [r for _, r in rows], [i for i, _ in rows])
+            return table
         table = np.full((self.num_slots, self.max_pages_per_slot), -1,
                         np.int32)
         for i, s in active:
@@ -703,6 +807,7 @@ class DecodeEngine:
             self.steps.add(1)
             self.occupancy_rec.add(len(active))
             t_tok = time.monotonic()
+            deliver: list = []   # (slot index, slot, token) surviving
             for i, s in active:
                 if self._slots[i] is not s:
                     continue    # an emitter cancelled it mid-step
@@ -747,7 +852,15 @@ class DecodeEngine:
                             f"page table overflow "
                             f"(> {self.max_pages_per_slot} pages)"))
                         continue
-                if not s.req.buf.push(nxt):
+                deliver.append((i, s, nxt))
+            # emit fan-out: ONE GIL-released native push across every
+            # surviving slot's ring (ISSUE 9) — the per-token Python
+            # lock acquire/notify this replaces was the step loop's
+            # biggest fixed cost.  Python _EmitBuf requests (flag off /
+            # no native lib / flipped mid-flight) push individually.
+            pushed = self._push_tokens(deliver)
+            for (i, s, nxt), ok in zip(deliver, pushed):
+                if not ok:
                     # consumer stopped draining: cut it HERE, without
                     # the step loop ever blocking in a write
                     self.emit_cut.add(1)
@@ -768,6 +881,35 @@ class DecodeEngine:
             hostcpu.add("decode_step",
                         (time.thread_time() - t_cpu0 - fn_cpu_s) * 1e6)
             hostcpu.add("model_compute", fn_cpu_s * 1e6)
+
+    def _push_tokens(self, deliver: list) -> list:
+        """Push one generated token per surviving slot: every native
+        ring rides ONE GIL-released ``brpc_tokring_push_many`` call,
+        Python _EmitBufs push individually.  Returns per-entry success
+        aligned with ``deliver``; False = ring full = consumer cut.
+        The slot objects in ``deliver`` hold their requests (and so the
+        ring wrappers) alive across the native call — a racing emitter
+        cancel can retire the slot but never free the ring under us."""
+        if not deliver:
+            return []
+        ok = [True] * len(deliver)
+        native = []
+        for k, (i, s, nxt) in enumerate(deliver):
+            buf = s.req.buf
+            if isinstance(buf, _NativeEmitBuf):
+                native.append(k)
+            else:
+                ok[k] = buf.push(nxt)
+        if native:
+            h, t = self._push_handles, self._push_toks
+            for j, k in enumerate(native):
+                h[j] = deliver[k][1].req.buf.handle
+                t[j] = deliver[k][2]
+            native_path._core_lib().core.brpc_tokring_push_many(
+                h, t, len(native), self._push_ok)
+            for j, k in enumerate(native):
+                ok[k] = bool(self._push_ok[j])
+        return ok
 
     def _release_slot_locked(self, i: int, cache_ok: bool = True):
         """Release slot i under the cv: return the KV lease exactly once

@@ -1211,19 +1211,85 @@ class LiveGeneration:
         self._col.done.set()
 
 
+_RETRY_AFTER_RE = re.compile(r"retry_after_s=([0-9]+(?:\.[0-9]+)?)")
+
+
+def parse_retry_after_s(text: str | None) -> Optional[float]:
+    """The ``retry_after_s=<x>`` hint a router shed attaches to its
+    ELIMIT text, or None when the error carries no hint."""
+    if not text:
+        return None
+    m = _RETRY_AFTER_RE.search(text)
+    return float(m.group(1)) if m else None
+
+
 class RouterClient:
     """Thin client for the Router service: ``generate`` (blocking),
-    ``start`` (live handle with ``drop()``), ``resume`` (reconnect)."""
+    ``start`` (live handle with ``drop()``), ``resume`` (reconnect).
 
-    def __init__(self, addr: str, *, timeout_ms: int = 10_000):
+    ROADMAP 3(c): a router shed (ELIMIT carrying a ``retry_after_s``
+    hint) is no longer just a text hint — ``start``/``generate`` back
+    off for the HINTED delay (plus bounded jitter so a shed burst's
+    clients don't re-arrive in lockstep) and retry, up to
+    ``shed_retries`` attempts.  An ELIMIT without a hint, any other
+    error, or an exhausted budget surfaces to the caller unchanged,
+    and backoff sleeps count against the caller's deadline
+    (``generate(timeout_s=...)`` / ``start(deadline_s=...)``): a
+    retry whose delay would overshoot it surfaces the shed
+    immediately instead of sleeping past the budget.  Set
+    ``shed_retries=0`` to restore the raw single-attempt
+    behavior."""
+
+    def __init__(self, addr: str, *, timeout_ms: int = 10_000,
+                 shed_retries: int = 3, max_backoff_s: float = 30.0,
+                 jitter_frac: float = 0.1):
         from brpc_tpu.rpc.channel import Channel
         self.addr = addr
         self.timeout_ms = int(timeout_ms)
+        self.shed_retries = int(shed_retries)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter_frac = float(jitter_frac)
+        # observability for callers/tests: every backoff this client
+        # actually slept, as (hinted_s, slept_s)
+        self.backoffs: list = []
         self._ch = Channel(addr, timeout_ms=self.timeout_ms)
 
+    def _shed_backoff_s(self, hint_s: float) -> float:
+        import random
+        jitter = random.uniform(0.0, self.jitter_frac * hint_s)
+        return min(hint_s + jitter, self.max_backoff_s)
+
     def start(self, prompt: Sequence[int], max_new_tokens: int, *,
-              emit: Optional[Callable[[int], None]] = None
-              ) -> LiveGeneration:
+              emit: Optional[Callable[[int], None]] = None,
+              deadline_s: Optional[float] = None) -> LiveGeneration:
+        attempt = 0
+        deadline = (time.monotonic() + deadline_s) \
+            if deadline_s is not None else None
+        while True:
+            try:
+                return self._start_once(prompt, max_new_tokens,
+                                        emit=emit)
+            except errors.RpcError as e:
+                hint = parse_retry_after_s(e.text) \
+                    if e.code == errors.ELIMIT else None
+                if hint is None or attempt >= self.shed_retries:
+                    raise
+                delay = self._shed_backoff_s(hint)
+                if deadline is not None and \
+                        time.monotonic() + delay > deadline:
+                    # honoring the hint would overshoot the caller's
+                    # budget: surface the shed now instead of sleeping
+                    # past the deadline
+                    raise
+                attempt += 1
+                self.backoffs.append((hint, delay))
+                # honor the hint: earlier re-arrival would land inside
+                # the same overload plateau and be shed again
+                time.sleep(delay)
+
+    def _start_once(self, prompt: Sequence[int], max_new_tokens: int, *,
+                    emit: Optional[Callable[[int], None]] = None
+                    ) -> LiveGeneration:
         from brpc_tpu.rpc.controller import Controller
         from brpc_tpu.rpc.stream import stream_create
         col = _ClientCollector(emit)
@@ -1249,8 +1315,10 @@ class RouterClient:
     def generate(self, prompt: Sequence[int], max_new_tokens: int, *,
                  emit: Optional[Callable[[int], None]] = None,
                  timeout_s: float = 30.0) -> dict:
-        gen = self.start(prompt, max_new_tokens, emit=emit)
-        if not gen.wait(timeout_s):
+        deadline = time.monotonic() + timeout_s
+        gen = self.start(prompt, max_new_tokens, emit=emit,
+                         deadline_s=timeout_s)
+        if not gen.wait(max(0.0, deadline - time.monotonic())):
             raise errors.RpcError(errors.ERPCTIMEDOUT,
                                   "router generation never finished")
         return {"session_id": gen.session_id, "tokens": gen.tokens,

@@ -15,6 +15,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <atomic>
 #include <cstring>
 
 #include "butil/iobuf.h"
@@ -348,7 +349,285 @@ PyObject* py_iobuf_bytes(PyObject*, PyObject* args) {
   return out;
 }
 
+// ---- native span queue (ISSUE 9: off-thread rpcz recording) ----
+//
+// rpcz.submit used to pay two Python lock acquisitions (speed-limit
+// grab + collector pending append) plus a wrapper allocation per span,
+// ON the token path.  Now the hot side is ONE lock-free Treiber push of
+// the span object (incref under the GIL we already hold, CAS, done);
+// the collector thread drains the stack in FIFO order and does the
+// rate-limiting, store append and SpanDB IO there.  Same shape as
+// bthread's ExecutionQueue producer half — a drain-side-serialized MPSC
+// stack — holding PyObject* instead of nodes on an Executor.
+
+struct SpanNode {
+  PyObject* obj;
+  SpanNode* next;
+};
+
+std::atomic<SpanNode*> g_span_head{nullptr};
+std::atomic<int64_t> g_span_pending{0};
+
+PyObject* py_spanq_push(PyObject*, PyObject* arg) {
+  Py_INCREF(arg);
+  auto* n = new SpanNode{arg, nullptr};
+  SpanNode* old = g_span_head.load(std::memory_order_relaxed);
+  do {
+    n->next = old;
+  } while (!g_span_head.compare_exchange_weak(old, n,
+                                              std::memory_order_release,
+                                              std::memory_order_relaxed));
+  g_span_pending.fetch_add(1, std::memory_order_relaxed);
+  Py_RETURN_NONE;
+}
+
+PyObject* py_spanq_drain(PyObject*, PyObject*) {
+  SpanNode* head = g_span_head.exchange(nullptr, std::memory_order_acquire);
+  // reverse to FIFO so the collector observes submission order
+  SpanNode* prev = nullptr;
+  Py_ssize_t count = 0;
+  while (head != nullptr) {
+    SpanNode* next = head->next;
+    head->next = prev;
+    prev = head;
+    head = next;
+    ++count;
+  }
+  g_span_pending.fetch_sub(count, std::memory_order_relaxed);
+  PyObject* out = PyList_New(count);
+  if (out == nullptr) {
+    // push the reversed chain back so the spans are not lost (order
+    // within this failed batch is preserved relative to itself)
+    while (prev != nullptr) {
+      SpanNode* next = prev->next;
+      prev->next = g_span_head.load(std::memory_order_relaxed);
+      while (!g_span_head.compare_exchange_weak(
+          prev->next, prev, std::memory_order_release,
+          std::memory_order_relaxed)) {
+      }
+      g_span_pending.fetch_add(1, std::memory_order_relaxed);
+      prev = next;
+    }
+    return nullptr;
+  }
+  Py_ssize_t i = 0;
+  while (prev != nullptr) {
+    PyList_SET_ITEM(out, i++, prev->obj);  // steals the push's ref
+    SpanNode* next = prev->next;
+    delete prev;
+    prev = next;
+  }
+  return out;
+}
+
+PyObject* py_spanq_pending(PyObject*, PyObject*) {
+  return PyLong_FromLongLong(g_span_pending.load(std::memory_order_relaxed));
+}
+
+// ---- native batch assembly + token-ring fast entries (ISSUE 9) ----
+//
+// The ctypes bindings in _core/lib.py pay ~25us of marshalling per
+// call (a .ctypes view object per numpy row) and ALWAYS drop the GIL —
+// right for a bulk or blocking call, fatally wrong for the per-token
+// and per-formation hot path.  These entries parse via the buffer
+// protocol (no per-row Python objects) and choose per call whether the
+// GIL is worth releasing: batch_pad/page_table_fill release it for the
+// memset+memcpy pass only; tokring_push HOLDS it — a sub-microsecond
+// mutex push is cheaper than a GIL handoff convoy.
+
+extern "C" int brpc_tokring_push(void* h, int32_t tok);  // serving_hotpath.cc
+
+// batch_pad(out2d, rows) -> None.  Zero-fill the C-contiguous 2-D
+// buffer `out2d`, then copy rows[i]'s bytes into row i (truncated to
+// the row stride).  Rows must be C-contiguous 1-D buffers of out's
+// dtype (the batcher's enqueue coercion guarantees this).
+PyObject* py_batch_pad(PyObject*, PyObject* args) {
+  PyObject* out_obj;
+  PyObject* rows_obj;
+  if (!PyArg_ParseTuple(args, "OO", &out_obj, &rows_obj)) return nullptr;
+  Py_buffer out;
+  if (PyObject_GetBuffer(out_obj, &out,
+                         PyBUF_WRITABLE | PyBUF_STRIDES) != 0) {
+    return nullptr;
+  }
+  if (out.ndim != 2 || !PyBuffer_IsContiguous(&out, 'C')) {
+    PyBuffer_Release(&out);
+    PyErr_SetString(PyExc_ValueError, "out must be C-contiguous 2-D");
+    return nullptr;
+  }
+  PyObject* fast = PySequence_Fast(rows_obj, "rows must be a sequence");
+  if (fast == nullptr) {
+    PyBuffer_Release(&out);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  if (n > out.shape[0]) {
+    Py_DECREF(fast);
+    PyBuffer_Release(&out);
+    PyErr_SetString(PyExc_ValueError, "more rows than out has");
+    return nullptr;
+  }
+  // collect every row buffer under the GIL, then copy without it
+  Py_buffer* rows = (Py_buffer*)PyMem_Malloc(sizeof(Py_buffer) * (n ? n : 1));
+  if (rows == nullptr) {
+    Py_DECREF(fast);
+    PyBuffer_Release(&out);
+    return PyErr_NoMemory();
+  }
+  Py_ssize_t got = 0;
+  for (; got < n; ++got) {
+    if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(fast, got),
+                           &rows[got], PyBUF_SIMPLE) != 0) {
+      break;
+    }
+  }
+  if (got < n) {
+    for (Py_ssize_t i = 0; i < got; ++i) PyBuffer_Release(&rows[i]);
+    PyMem_Free(rows);
+    Py_DECREF(fast);
+    PyBuffer_Release(&out);
+    return nullptr;
+  }
+  const Py_ssize_t stride = out.strides[0];
+  Py_BEGIN_ALLOW_THREADS
+  memset(out.buf, 0, (size_t)out.len);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    Py_ssize_t m = rows[i].len < stride ? rows[i].len : stride;
+    if (m > 0) memcpy((char*)out.buf + i * stride, rows[i].buf, (size_t)m);
+  }
+  Py_END_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; ++i) PyBuffer_Release(&rows[i]);
+  PyMem_Free(rows);
+  Py_DECREF(fast);
+  PyBuffer_Release(&out);
+  Py_RETURN_NONE;
+}
+
+// page_table_fill(table2d_int32, lists, slot_idx) -> None.  Fill the
+// C-contiguous int32 table with -1, then copy int32 buffer lists[k]
+// into row slot_idx[k] (truncated to the table width).
+PyObject* py_page_table_fill(PyObject*, PyObject* args) {
+  PyObject* table_obj;
+  PyObject* lists_obj;
+  PyObject* idx_obj;
+  if (!PyArg_ParseTuple(args, "OOO", &table_obj, &lists_obj, &idx_obj)) {
+    return nullptr;
+  }
+  Py_buffer table;
+  if (PyObject_GetBuffer(table_obj, &table,
+                         PyBUF_WRITABLE | PyBUF_STRIDES) != 0) {
+    return nullptr;
+  }
+  if (table.ndim != 2 || !PyBuffer_IsContiguous(&table, 'C') ||
+      table.itemsize != 4) {
+    PyBuffer_Release(&table);
+    PyErr_SetString(PyExc_ValueError,
+                    "table must be C-contiguous 2-D int32");
+    return nullptr;
+  }
+  PyObject* lists = PySequence_Fast(lists_obj, "lists must be a sequence");
+  PyObject* idx = lists ? PySequence_Fast(idx_obj,
+                                          "slot_idx must be a sequence")
+                        : nullptr;
+  if (idx == nullptr) {
+    Py_XDECREF(lists);
+    PyBuffer_Release(&table);
+    return nullptr;
+  }
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(lists);
+  const Py_ssize_t rows = table.shape[0];
+  const Py_ssize_t width_bytes = table.strides[0];
+  if (PySequence_Fast_GET_SIZE(idx) != n) {
+    Py_DECREF(lists);
+    Py_DECREF(idx);
+    PyBuffer_Release(&table);
+    PyErr_SetString(PyExc_ValueError, "lists/slot_idx length mismatch");
+    return nullptr;
+  }
+  // collect every row index and id buffer under the GIL, then do the
+  // -1 fill + row copies without it (same discipline as batch_pad —
+  // the module header and the engine call site both promise it)
+  Py_buffer* ids =
+      (Py_buffer*)PyMem_Malloc(sizeof(Py_buffer) * (n ? n : 1));
+  long* rowidx = (long*)PyMem_Malloc(sizeof(long) * (n ? n : 1));
+  if (ids == nullptr || rowidx == nullptr) {
+    PyMem_Free(ids);
+    PyMem_Free(rowidx);
+    Py_DECREF(lists);
+    Py_DECREF(idx);
+    PyBuffer_Release(&table);
+    return PyErr_NoMemory();
+  }
+  Py_ssize_t got = 0;
+  for (; got < n; ++got) {
+    long row = PyLong_AsLong(PySequence_Fast_GET_ITEM(idx, got));
+    if ((row == -1 && PyErr_Occurred()) || row < 0 || row >= rows) {
+      if (!PyErr_Occurred()) {
+        PyErr_SetString(PyExc_ValueError, "slot index out of range");
+      }
+      break;
+    }
+    rowidx[got] = row;
+    if (PyObject_GetBuffer(PySequence_Fast_GET_ITEM(lists, got),
+                           &ids[got], PyBUF_SIMPLE) != 0) {
+      break;
+    }
+  }
+  if (got < n) {
+    for (Py_ssize_t i = 0; i < got; ++i) PyBuffer_Release(&ids[i]);
+    PyMem_Free(ids);
+    PyMem_Free(rowidx);
+    Py_DECREF(lists);
+    Py_DECREF(idx);
+    PyBuffer_Release(&table);
+    return nullptr;
+  }
+  Py_BEGIN_ALLOW_THREADS
+  int32_t* base = (int32_t*)table.buf;
+  const Py_ssize_t total = table.len / 4;
+  for (Py_ssize_t i = 0; i < total; ++i) base[i] = -1;
+  for (Py_ssize_t k = 0; k < n; ++k) {
+    Py_ssize_t m = ids[k].len < width_bytes ? ids[k].len : width_bytes;
+    if (m > 0) {
+      memcpy((char*)table.buf + rowidx[k] * width_bytes, ids[k].buf,
+             (size_t)m);
+    }
+  }
+  Py_END_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; ++i) PyBuffer_Release(&ids[i]);
+  PyMem_Free(ids);
+  PyMem_Free(rowidx);
+  Py_DECREF(lists);
+  Py_DECREF(idx);
+  PyBuffer_Release(&table);
+  Py_RETURN_NONE;
+}
+
+// tokring_push(handle, tok) -> 1 pushed / 0 full.  Deliberately HOLDS
+// the GIL: the ring mutex is held for nanoseconds and never blocks, so
+// a GIL release/reacquire per token would cost more than the push (and
+// under N producer threads becomes a handoff convoy).
+PyObject* py_tokring_push(PyObject*, PyObject* args) {
+  unsigned long long handle;
+  int tok;
+  if (!PyArg_ParseTuple(args, "Ki", &handle, &tok)) return nullptr;
+  return PyLong_FromLong(
+      brpc_tokring_push((void*)(uintptr_t)handle, (int32_t)tok));
+}
+
 PyMethodDef kMethods[] = {
+    {"spanq_push", py_spanq_push, METH_O,
+     "Push one span object onto the native MPSC queue (lock-free)."},
+    {"spanq_drain", py_spanq_drain, METH_NOARGS,
+     "Drain every queued span, FIFO order -> list."},
+    {"spanq_pending", py_spanq_pending, METH_NOARGS,
+     "Spans pushed but not yet drained."},
+    {"batch_pad", py_batch_pad, METH_VARARGS,
+     "batch_pad(out2d, rows): zero-fill + row gather, GIL released."},
+    {"page_table_fill", py_page_table_fill, METH_VARARGS,
+     "page_table_fill(table2d, lists, slot_idx): -1 fill + row copy."},
+    {"tokring_push", py_tokring_push, METH_VARARGS,
+     "tokring_push(handle, tok) -> 1 pushed / 0 full (GIL held)."},
     {"send_request", py_send_request, METH_VARARGS,
      "send_request(sid, cid, attempt, service, method, timeout_ms, "
      "compress, content_type, body) -> rc"},

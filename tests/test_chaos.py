@@ -752,8 +752,11 @@ def test_engine_crash_midstream_failover_exactly_once(seed):
       wave retires and the cache is dropped — recovery pins are
       released, nothing leaks across the engine generations.
     """
+    import gc
+
     import jax
 
+    from brpc_tpu import native_path
     from brpc_tpu.kvcache import KVCacheStore
     from brpc_tpu.serving import DecodeEngine, EngineSupervisor
 
@@ -767,6 +770,8 @@ def test_engine_crash_midstream_failover_exactly_once(seed):
                     for c in device_pool._free}
 
     free0 = occupancy()
+    gc.collect()
+    ring0 = native_path.tokring_live()
 
     @jax.jit
     def step(tokens, positions, pages):
@@ -848,6 +853,11 @@ def test_engine_crash_midstream_failover_exactly_once(seed):
     finally:
         sup.close()
         store.close()
+    # ISSUE 9: the restart seam must not strand native emit rings —
+    # every re-admitted request's old ring is freed with its request
+    assert wait_until(
+        lambda: (gc.collect(), native_path.tokring_live())[1] <= ring0,
+        10), "native emit rings leaked across the engine restart"
 
 
 # ---------------------------------------------------------------------------
@@ -1203,6 +1213,11 @@ def test_serving_midbatch_fault_exactly_once_and_kv_baseline(seed):
             return {c: len(pool._free[c]) for c in pool._free}
 
     free0 = occupancy()
+    import gc
+
+    from brpc_tpu import native_path
+    gc.collect()
+    ring0 = native_path.tokring_live()
     engine = DecodeEngine(step, num_slots=2, kv_bytes_per_slot=1024,
                           pool=pool, name=f"chaos_e{seed}")
     s = brpc.Server()
@@ -1281,6 +1296,10 @@ def test_serving_midbatch_fault_exactly_once_and_kv_baseline(seed):
         batcher.close()
         engine.close()
         assert wait_until(lambda: occupancy() == free0, 10)
+        # ISSUE 9: zero leaked native emit rings across the wave
+        assert wait_until(
+            lambda: (gc.collect(), native_path.tokring_live())[1]
+            <= ring0, 10), "native emit rings leaked"
 
 
 # ---------------------------------------------------------------------------

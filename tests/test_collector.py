@@ -54,6 +54,24 @@ class TestCollector:
         now[0] += 1.1                       # the window rolls over
         assert limit.grab()
 
+    def test_grab_n_batches_the_window_budget(self):
+        """grab_n (ISSUE 9: the rpcz spanq drainer's batch grab) grants
+        from the same fixed-window budget grab() uses — partial grants
+        at the boundary, denial counted, window refill honored."""
+        now = [50.0]
+        limit = CollectorSpeedLimit("test_batch", max_per_second=100,
+                                    clock=lambda: now[0])
+        assert limit.grab_n(60) == 60
+        assert limit.grab_n(60) == 40       # partial: budget boundary
+        assert limit.grab_n(10) == 0        # exhausted window
+        assert limit.grabbed.get_value() == 100
+        assert limit.denied.get_value() == 30
+        now[0] += 1.1                       # the window rolls over
+        assert limit.grab_n(10) == 10
+        # grab() and grab_n() share one budget, either order
+        assert limit.grab_n(89) == 89
+        assert limit.grab() and not limit.grab()
+
     def test_broken_sample_does_not_kill_the_drainer(self):
         class Bad(Collected):
             def dump_and_destroy(self):

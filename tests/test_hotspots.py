@@ -135,8 +135,11 @@ def test_instrumented_lock_backs_a_condition():
 
 def test_named_hot_locks_populate_ledger():
     """Exercising batcher/store/engine/rpcz lands rows for every named
-    hot lock in locks_snapshot()."""
-    from brpc_tpu import rpcz
+    hot lock in locks_snapshot().  Runs with the native hot path OFF:
+    the ledger's serving.emit_buf row belongs to the pure-Python
+    _EmitBuf fallback — the native emit ring (ISSUE 9) has no Python
+    lock to ledger, which is the point of the rewrite."""
+    from brpc_tpu import flags, rpcz
     from brpc_tpu.butil.lockprof import locks_snapshot
     from brpc_tpu.kvcache import KVCacheStore
     from brpc_tpu.serving import DecodeEngine, DynamicBatcher
@@ -150,6 +153,11 @@ def test_named_hot_locks_populate_ledger():
                        pass_page_table=False, name="ledger_probe")
     was = (rpcz.enabled(), rpcz.sample_rate())
     rpcz.set_enabled(True, 1.0)
+    # flag flipped AFTER the constructors (the flag is read per
+    # request/batch, not at construction) so a constructor exception
+    # cannot strand the session on the python fallback
+    was_native = flags.get_flag("native_hot_path_enabled", True)
+    flags.set_flag("native_hot_path_enabled", False)
     try:
         b.submit_wait(np.ones(8, np.float32), timeout_s=30)
         done = threading.Event()
@@ -161,6 +169,7 @@ def test_named_hot_locks_populate_ledger():
         rpcz.recent_spans(5)
     finally:
         rpcz.set_enabled(*was)
+        flags.set_flag("native_hot_path_enabled", was_native)
         eng.close()
         store.close()
         b.close()
@@ -292,6 +301,81 @@ def test_blocked_instrumented_lock_samples_as_lock_wait():
          + "\n".join(k for k in stacks if k.startswith("emit_fanout;")))
 
 
+def test_native_hot_path_samples_fold_to_native_leaf():
+    """A thread inside a GIL-released native call (the emit ring's pop
+    wait) folds to a ``;[native]`` leaf — not Python run time, not
+    lock-wait — so gil_wait_ratio and the per-stage table stay honest
+    after the de-GIL rewrite (ISSUE 9)."""
+    import ctypes
+
+    from brpc_tpu import native_path
+    from brpc_tpu.builtin import sampler
+    ring = native_path.token_ring(8)
+    if ring is None:
+        pytest.skip("native core unavailable")
+    out = (ctypes.c_int32 * 8)()
+    stop = threading.Event()
+
+    def consumer():
+        # parks inside brpc_tokring_pop_many with the GIL released;
+        # the sampled leaf Python frame is the ctypes binding call site
+        while not stop.is_set():
+            ring.pop_many(out, 0.2)
+
+    t = threading.Thread(target=consumer,
+                         name="serving-emit-nativeprobe")
+    t.start()
+    try:
+        time.sleep(0.05)
+        stacks = sampler.burst(0.25, hz=100)
+    finally:
+        stop.set()
+        t.join(5)
+    native = [k for k in stacks
+              if k.startswith("emit_fanout;") and k.endswith(";[native]")
+              and "_core/lib" in k]
+    assert native, \
+        ("native pop wait did not fold to a ;[native] leaf: "
+         + "\n".join(k for k in stacks if k.startswith("emit_fanout;")))
+    assert not any(k.startswith("emit_fanout;")
+                   and k.endswith(";[lock-wait]") and "_core/lib" in k
+                   for k in stacks), \
+        "native pop wait misclassified as lock-wait"
+
+
+def test_gil_held_binding_sites_not_classed_native():
+    """TokenRing.push rides the _fastrpc C entry that deliberately
+    HOLDS the GIL — a thread sampled there is GIL-bound run time, and
+    classing it ``;[native]`` would overstate gil_wait_ratio's de-GIL
+    story.  The GIL-released binding sites (pop_many's ctypes call)
+    stay native."""
+    from brpc_tpu import native_path
+    from brpc_tpu.builtin import sampler
+    if native_path._core_lib() is None:
+        pytest.skip("native core unavailable")
+    from brpc_tpu._core import lib
+    assert not sampler._is_native_leaf(lib.TokenRing.push.__code__)
+    assert not sampler._is_native_leaf(
+        lib.TokenRing.push_terminal.__code__)
+    assert sampler._is_native_leaf(lib.TokenRing.pop_many.__code__)
+
+
+def test_stage_table_carries_native_column():
+    from brpc_tpu.builtin.sampler import HotspotSampler, _Window
+    samp = HotspotSampler()   # fresh, not the singleton
+    win = samp._win
+    win.run, win.wait, win.native = 6, 2, 2
+    win.stage_run["decode_step"] = 6
+    win.stage_wait["decode_step"] = 2
+    win.stage_native["decode_step"] = 2
+    table = samp.stage_table()
+    assert table["decode_step"] == {
+        "run": 6, "wait": 2, "native": 2, "wait_ratio": 0.2}
+    # native samples are GIL-free progress: they stay in the ratio's
+    # denominator (2 wait / 10 total), they don't vanish from it
+    assert samp.gil_wait_ratio() == 0.2
+
+
 def _window_limited_qps(name: str, duration_s: float = 0.7) -> float:
     """Batcher qps with threads << max_batch_size: every batch forms at
     WINDOW expiry, so throughput is set by the 2ms window, not compute
@@ -353,6 +437,13 @@ def test_always_on_sampler_overhead_under_2pct():
 # ---------------------------------------------------------------------------
 
 def test_host_cpu_per_token_accounting():
+    """Python-path accounting mechanics (ISSUE 6).  Runs with the
+    native hot path OFF: the de-GIL'd step loop's remaining Python
+    bookkeeping per step can round to ZERO on a coarse thread_time
+    clock, making the stage_us('decode_step') > d0 assert flaky —
+    and the python fallback is the path whose accounting this test
+    pins.  Native-path sampler visibility has its own tests above."""
+    from brpc_tpu import flags
     from brpc_tpu.butil import hostcpu
     from brpc_tpu.kvcache import KVCacheStore
     from brpc_tpu.serving import DecodeEngine
@@ -365,6 +456,8 @@ def test_host_cpu_per_token_accounting():
     eng = DecodeEngine(lambda t, p: (t * 3 + p) % 101, num_slots=2,
                        store=store, pass_page_table=False,
                        name="hostcpu_probe")
+    was_native = flags.get_flag("native_hot_path_enabled", True)
+    flags.set_flag("native_hot_path_enabled", False)
     try:
         done = [threading.Event() for _ in range(4)]
         for i, d in enumerate(done):
@@ -373,6 +466,7 @@ def test_host_cpu_per_token_accounting():
         for d in done:
             assert d.wait(60)
     finally:
+        flags.set_flag("native_hot_path_enabled", was_native)
         eng.close()
         store.close()
     assert hostcpu.tokens_total.get_value() >= t0 + 4 * 24
@@ -598,6 +692,50 @@ def test_perf_diff_flags_beyond_spread_regressions(tmp_path):
     assert pd.main([str(a), str(b)]) == 1
     assert pd.main([str(a), str(c)]) == 0
     assert pd.main([str(a), str(b), "--no-fail"]) == 0
+
+
+def test_cluster_spread_floor_stops_collapsed_spread_false_alarms():
+    """ISSUE 9 deflake: a deterministic cluster run's per-trial spread
+    can collapse to ~0.2%; without a minimum-spread floor, perf_diff's
+    disjoint-interval rule reads a run landing at the 5-6% overhead
+    end as a beyond-spread regression.  The floor widens published
+    spreads to the known admission-quantization jitter, so the same
+    pair of rounds compares as within-noise."""
+    import bench
+    pd = _load_perf_diff()
+    # ± half a step period per generation at max_new=16 => ±3.125 pts
+    pad = 100.0 / (2 * 16)
+    lo, hi = bench._floor_spread(2.8, 2.7, 2.9, pad)
+    assert lo <= 2.8 - pad and hi >= 2.8 + pad
+    # an already-wide spread is left alone
+    assert bench._floor_spread(2.8, -9.0, 9.0, pad) == [-9.0, 9.0]
+    raw_old = {"cluster": {"router_overhead_pct": 2.8,
+                           "router_overhead_pct_spread": [2.7, 2.9]}}
+    raw_new = {"cluster": {"router_overhead_pct": 5.6,
+                           "router_overhead_pct_spread": [5.5, 5.7]}}
+    rows = pd.diff(pd.extract_metrics(raw_old),
+                   pd.extract_metrics(raw_new))
+    assert rows[0]["verdict"] == "regressed", \
+        "collapsed spreads SHOULD flag (that is the bug being fixed)"
+    floored_old = {"cluster": {
+        "router_overhead_pct": 2.8,
+        "router_overhead_pct_spread": bench._floor_spread(
+            2.8, 2.7, 2.9, pad)}}
+    floored_new = {"cluster": {
+        "router_overhead_pct": 5.6,
+        "router_overhead_pct_spread": bench._floor_spread(
+            5.6, 5.5, 5.7, pad)}}
+    rows = pd.diff(pd.extract_metrics(floored_old),
+                   pd.extract_metrics(floored_new))
+    assert rows[0]["verdict"] == "ok", \
+        "floored spreads must read the 5-6%-end run as within noise"
+    # a REAL regression still fires through the floor
+    real = {"cluster": {"router_overhead_pct": 25.0,
+                        "router_overhead_pct_spread": bench._floor_spread(
+                            25.0, 24.0, 26.0, pad)}}
+    rows = pd.diff(pd.extract_metrics(floored_old),
+                   pd.extract_metrics(real))
+    assert rows[0]["verdict"] == "regressed"
 
 
 def test_perf_diff_parses_driver_round_wrapper(tmp_path):

@@ -176,7 +176,9 @@ def test_shed_at_router_has_retry_after(cluster):
     reps, router, table, raddr = cluster
     router._ladder.level = 1          # synthetic overload
     try:
-        cli = RouterClient(raddr)
+        # shed_retries=0: this test is about the ELIMIT hint TEXT; the
+        # backoff behavior has its own test below
+        cli = RouterClient(raddr, shed_retries=0)
         with pytest.raises(errors.RpcError) as ei:
             cli.generate([1, 2, 3], 4, timeout_s=10)
         assert ei.value.code == errors.ELIMIT
@@ -184,6 +186,91 @@ def test_shed_at_router_has_retry_after(cluster):
         assert router.shed_total.get_value() >= 1
         assert router.stats()["gradient_fired"]["shed_at_router"] >= 1
     finally:
+        router._ladder.level = 0
+
+
+def test_shed_backoff_retries_after_hint_not_hammering(cluster):
+    """ROADMAP 3(c): a shed burst's client honors the router's
+    ``retry_after_s`` hint — it sleeps at least the hinted delay
+    between attempts (bounded, jittered) instead of hammering, and
+    succeeds once the overload clears."""
+    from brpc_tpu.serving.router import parse_retry_after_s
+    reps, router, table, raddr = cluster
+    # floor pins the synthetic overload against the check loop's own
+    # hysteresis de-escalation: only clear() below ends the plateau
+    router._ladder.floor = 1
+    router._ladder.level = 1
+    hint = router.retry_after_s()
+    assert parse_retry_after_s(f"shed; retry_after_s={hint}") == hint
+    cli = RouterClient(raddr, shed_retries=4)
+
+    def clear():
+        time.sleep(hint * 1.5)
+        router._ladder.floor = 0
+        router._ladder.level = 0
+
+    t = threading.Thread(target=clear)
+    t0 = time.monotonic()
+    t.start()
+    try:
+        out = cli.generate([1, 2, 3], 4, timeout_s=30)
+    finally:
+        t.join(10)
+        router._ladder.floor = 0
+        router._ladder.level = 0
+    elapsed = time.monotonic() - t0
+    assert out["error"] is None
+    assert out["tokens"] == _expected([1, 2, 3], 4)
+    # it backed off (>= the hint each time) rather than hammering: the
+    # ~1.5-hint overload window admits at most a handful of attempts
+    assert cli.backoffs, "client never backed off"
+    assert all(slept >= hinted >= hint
+               for hinted, slept in cli.backoffs)
+    sheds = router.shed_total.get_value()
+    assert 1 <= sheds <= 3, f"client hammered the router: {sheds} sheds"
+    assert len(cli.backoffs) == sheds
+    assert elapsed >= hint
+
+
+def test_shed_retries_zero_surfaces_elimit_immediately(cluster):
+    reps, router, table, raddr = cluster
+    router._ladder.level = 1
+    try:
+        cli = RouterClient(raddr, shed_retries=0)
+        t0 = time.monotonic()
+        with pytest.raises(errors.RpcError) as ei:
+            cli.generate([1, 2, 3], 4, timeout_s=10)
+        assert ei.value.code == errors.ELIMIT
+        assert time.monotonic() - t0 < router.retry_after_s()
+        assert cli.backoffs == []
+    finally:
+        router._ladder.level = 0
+
+
+def test_shed_backoff_bounded_by_caller_deadline(cluster):
+    """Default-on shed retries must not sleep past the caller's
+    budget: under a SUSTAINED overload, ``generate(timeout_s=N)``
+    with N smaller than the hinted delay surfaces the shed ELIMIT
+    within ~N instead of blocking shed_retries*hint seconds first."""
+    reps, router, table, raddr = cluster
+    router._ladder.floor = 1
+    router._ladder.level = 1
+    hint = router.retry_after_s()
+    try:
+        cli = RouterClient(raddr, shed_retries=3)   # retries ON
+        budget = min(0.5, hint / 2)
+        t0 = time.monotonic()
+        with pytest.raises(errors.RpcError) as ei:
+            cli.generate([1, 2, 3], 4, timeout_s=budget)
+        elapsed = time.monotonic() - t0
+        assert ei.value.code == errors.ELIMIT
+        # one immediate shed, zero sleeps: honoring the hint would
+        # overshoot the deadline, so the client surfaced the shed
+        assert cli.backoffs == []
+        assert elapsed < hint, \
+            f"client slept {elapsed:.1f}s past its {budget}s budget"
+    finally:
+        router._ladder.floor = 0
         router._ladder.level = 0
 
 
