@@ -102,6 +102,17 @@ disagg: $(LIB) $(PYEXT)
 cluster: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q
 
+# Real model serving (README "Real model serving", ISSUE 10): the
+# paged-attention equivalence suite (gather + pallas-interpret vs the
+# dense reference at page boundaries / COW forks / evict-readmit), the
+# ModelRunner protocol + TransformerRunner end-to-end tests, then the
+# timed runner-vs-harness tokens/s rung (3-trial median+spread, feeds
+# perf_diff).  CPU jit path throughout.
+model: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_paged_attention.py \
+	    tests/test_model_runner.py -q
+	JAX_PLATFORMS=cpu python bench.py model
+
 # Tracing suite (README "Observability"): rpcz generation tracing —
 # per-trace head sampling, span-tree timelines, TTFT/ITL math, trace
 # continuity across crash recovery, DCN span joins, console pages.
@@ -135,9 +146,15 @@ perf: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python bench.py microbench \
 	    | python -c "import json,sys; json.dump({'microbench': \
 	    json.load(sys.stdin)}, open('MICROBENCH.json','w'), indent=1)"
+	JAX_PLATFORMS=cpu python bench.py model \
+	    | python -c "import json,sys; json.dump({'model': \
+	    json.load(sys.stdin)}, open('MODELBENCH.json','w'), indent=1)"
 	python tools/perf_diff.py \
 	    "$$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)" \
 	    MICROBENCH.json
+	python tools/perf_diff.py \
+	    "$$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)" \
+	    MODELBENCH.json
 
 # Full bench run ending in a delta-vs-previous-round table: perf_diff
 # compares the freshest BENCH_r*.json against this run's
@@ -180,4 +197,4 @@ stress:
 	./build/stress_plain
 
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
-    cluster trace hotspots microbench perf bench tsan asan stress
+    cluster model trace hotspots microbench perf bench tsan asan stress

@@ -2082,6 +2082,159 @@ def bench_migrate(shared_ratios=(0.0, 0.5, 0.9), n_requests=12,
     return out
 
 
+def bench_model(shared_ratios=(0.0, 0.5, 0.9), n_requests=6,
+                prompt_tokens=32, gen_tokens=12, trials=3):
+    """Real-model serving rung (ISSUE 10): the TransformerRunner — a
+    real transformer whose K/V live in the paged HBM layout and whose
+    attention reads through the engine's page tables — vs the
+    token-id HARNESS (the PR 2/3 stand-in step function) on the same
+    engine/kvcache machinery, at 0/50/90% shared prefix.
+
+    Per ratio, per mode:
+
+      * tokens_per_s — generated tokens over the wave's wall time
+        (3-trial median + spread; perf_diff gates both series);
+      * prefill_skip_ratio — prompt tokens served by the radix cache /
+        prompt tokens seen (higher = prefill compute actually skipped;
+        for the REAL runner this is genuine attention-K/V reuse, not
+        token bookkeeping — the prefill-skip savings ROADMAP item 1
+        asked the bench to measure);
+      * runner_vs_harness — runner/harness tokens_per_s (informational:
+        the gap IS the model's FLOPs + kernel cost on this backend).
+
+    CPU-valid by construction (the gather backend of the paged kernel
+    is jax CPU ops); the full bench shells out here exactly like the
+    microbench/migrate rungs."""
+    import jax
+
+    from brpc_tpu.models.runner import (TransformerConfig,
+                                        TransformerRunner,
+                                        init_runner_params,
+                                        make_store_for)
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.serving import DecodeEngine
+
+    cfg = TransformerConfig()
+    params = init_runner_params(cfg)
+    pt = 8
+    buckets = (16, 32, 64)
+
+    def mk_real(tag):
+        store = make_store_for(cfg, page_tokens=pt, max_blocks=64,
+                               name=f"{tag}_rkv")
+        runner = TransformerRunner(params, cfg, store=store,
+                                   name=f"{tag}_m")
+        eng = DecodeEngine(runner=runner, num_slots=4, store=store,
+                           max_pages_per_slot=16,
+                           prefill_buckets=buckets, name=f"{tag}_re")
+        return store, eng
+
+    def mk_harness(tag):
+        store = KVCacheStore(page_tokens=pt, page_bytes=pt * 64,
+                             max_blocks=64, name=f"{tag}_hkv")
+
+        @jax.jit
+        def step(tokens, positions, pages):
+            return (tokens * 7 + positions) % 997
+
+        eng = DecodeEngine(step, num_slots=4, store=store,
+                           max_pages_per_slot=16,
+                           prefill_buckets=buckets, name=f"{tag}_he")
+        return store, eng
+
+    def wave(eng, prompts):
+        evs = []
+        for p in prompts:
+            ev = threading.Event()
+            evs.append(ev)
+            eng.submit(p, gen_tokens, lambda t: None,
+                       lambda e, ev=ev: ev.set())
+        for ev in evs:
+            if not ev.wait(600):
+                raise RuntimeError("model bench wave hung")
+
+    def one_trial(ratio, k, mk):
+        tag = f"bench_model_r{int(ratio * 100)}_{k}"
+        shared_n = int(prompt_tokens * ratio) // pt * pt
+        shared = [(5000 + k * 131 + j) % 997 for j in range(shared_n)]
+
+        def prompts(base):
+            return [shared
+                    + [(base + i * prompt_tokens + j) % 997
+                       for j in range(prompt_tokens - shared_n)]
+                    for i in range(n_requests)]
+
+        store, eng = mk(tag)
+        try:
+            # warm: compiles the bucket shapes AND seeds the radix
+            # tree with the shared prefix (the steady-state the ratio
+            # models), outside the timed window
+            wave(eng, prompts(900_000)[:2])
+            h0 = store.hit_tokens.get_value()
+            p0 = store.prompt_tokens.get_value()
+            t0 = time.monotonic()
+            wave(eng, prompts(1_000_000))
+            dt = time.monotonic() - t0
+            dp = store.prompt_tokens.get_value() - p0
+            dh = store.hit_tokens.get_value() - h0
+            skip = dh / dp if dp else 0.0
+            return n_requests * gen_tokens / dt, skip
+        finally:
+            eng.close()
+            store.clear()
+            store.close()
+
+    def series(mk):
+        out = {}
+        for ratio in shared_ratios:
+            rs = [one_trial(ratio, k, mk) for k in range(trials)]
+            tps = sorted(r[0] for r in rs)
+            skips = sorted(r[1] for r in rs)
+            out[f"shared{int(ratio * 100)}"] = {
+                "tokens_per_s": round(tps[len(tps) // 2], 1),
+                "tokens_per_s_spread": [round(tps[0], 1),
+                                        round(tps[-1], 1)],
+                "prefill_skip_ratio": round(skips[len(skips) // 2], 4),
+                "prefill_skip_ratio_spread": [round(skips[0], 4),
+                                              round(skips[-1], 4)],
+                "trials": trials,
+            }
+        return out
+
+    out = {"runner": series(mk_real), "harness": series(mk_harness)}
+    for key in out["runner"]:
+        r = out["runner"][key]["tokens_per_s"]
+        h = out["harness"][key]["tokens_per_s"]
+        out["runner"][key]["runner_vs_harness"] = \
+            round(r / h, 4) if h else None
+    out["cpu_valid"] = True
+    out["config"] = {"prompt_tokens": prompt_tokens,
+                     "gen_tokens": gen_tokens,
+                     "n_requests": n_requests,
+                     "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                     "n_heads": cfg.n_heads,
+                     "kv_bytes_per_token": cfg.kv_bytes_per_token}
+    out["note"] = ("real-model serving rung (ISSUE 10): tokens/s and "
+                   "prefill-skip with the TransformerRunner's paged "
+                   "attention over the HBM page tables vs the token-id "
+                   "harness on identical machinery; CPU gather backend "
+                   "— device rounds A/B the pallas kernel path")
+    return out
+
+
+def model_main(argv) -> None:
+    """`python bench.py model`: run ONLY the real-model serving rung
+    and print one JSON object on stdout (progress on stderr) — the
+    `make model` bench entry and the subprocess the full bench run
+    shells out to."""
+    log("model: real-runner vs harness serving rung...")
+    out = bench_model()
+    for k, v in out.items():
+        if isinstance(v, dict):
+            log(f"  {k}: {json.dumps(v)}")
+    print(json.dumps(out))
+
+
 def _floor_spread(med, lo, hi, pad):
     """Widen a published [lo, hi] spread to at least ±``pad`` around
     the median (ISSUE 9 deflake): a deterministic workload's few-trial
@@ -2458,6 +2611,12 @@ def main():
     except Exception as e:
         details["cluster"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['cluster']}")
+    log("bench: real-model serving (subprocess, forced CPU)...")
+    try:
+        details["model"] = _run_cpu_subcommand("model")
+    except Exception as e:
+        details["model"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['model']}")
     log("bench: probing device reachability...")
     device_ok, skip_kind, device_err = _probe_device()
     if not device_ok:
@@ -2584,5 +2743,7 @@ if __name__ == "__main__":
         migrate_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "cluster":
         cluster_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "model":
+        model_main(sys.argv[2:])
     else:
         main()

@@ -17,14 +17,27 @@ discipline for KV caches:
     discipline PR 2 established for raw slot leases.
 
 Page layout: ``page_tokens`` slots of ``kv_bytes_per_token`` bytes.  A
-token's slot holds its token id as a little-endian int32 in the leading
-bytes (the stand-in for the real K/V vectors — the layout arithmetic,
-refcounts, and copy-on-write are what every later inference PR builds
-on; a pallas paged-attention kernel swaps in real vectors without
-touching this module's lifecycle).  All page writes and page-to-page
-copies are on-device ``dynamic_update_slice`` splices into the block
-buffer — sibling pages in the same block are never clobbered and no
-full-block host bounce happens on the extend path.
+token's slot holds EITHER its token id as a little-endian int32 in the
+leading bytes (the pure-token harness stand-in) OR the token's real
+packed K/V vectors (``write_slots`` — the ModelRunner path, ISSUE 10:
+``[n_layers, 2, n_kv_heads, head_dim]`` f32 per slot, written by the
+transformer and read back by the paged-attention kernel).  All page
+writes and page-to-page copies are on-device ``dynamic_update_slice``
+splices into the block buffer — sibling pages in the same block are
+never clobbered and no full-block host bounce happens on the extend
+path.
+
+ARENA VIEW (ISSUE 10): the paged-attention kernel wants ONE fixed-shape
+device array indexable by page, compiled once for the life of the
+model.  Blocks come and go, so each leased block is pinned to a STABLE
+row in ``[0, max_blocks)`` for its lifetime and every page gets a flat
+arena index ``row * pages_per_block + page.index``; :meth:`arena`
+stacks the live block buffers (zeros for unleased rows) into
+``[max_blocks * pages_per_block, page_bytes]`` and :meth:`flat_ids`
+translates the engine's pid page tables into arena indices.  The stack
+is O(arena bytes) per call — on TPU a production path would pin one
+arena buffer; the layout contract (stable flat index per live page) is
+what the kernel compiles against either way.
 """
 from __future__ import annotations
 
@@ -100,6 +113,15 @@ class PagePool:
         # block<->page table: block key -> the pages carved from it
         self._blocks: dict[tuple, tuple] = {}   # key -> (block, [pages])
         self._free: list[KVPage] = []
+        # stable arena rows (ISSUE 10): a leased block keeps one row in
+        # [0, max_blocks) for its whole lease, so every live page's
+        # flat arena index is constant and the paged-attention kernel
+        # compiles once against the [max_blocks * pages_per_block]
+        # layout
+        self._row_of: dict[tuple, int] = {}     # block key -> arena row
+        self._free_rows: list[int] = list(range(self.max_blocks))[::-1]
+        self._pid_flat: dict[int, int] = {}     # pid -> flat arena index
+        self._zero_row = None                   # cached empty-row buffer
         self.page_allocs = Adder()
         self.page_frees = Adder()
         self.block_leases = Adder()
@@ -128,7 +150,13 @@ class PagePool:
                 self.block_leases.add(1)
                 pages = [KVPage(block, i)
                          for i in range(self.pages_per_block)]
-                self._blocks[self._bkey(block)] = (block, pages)
+                key = self._bkey(block)
+                self._blocks[key] = (block, pages)
+                row = self._free_rows.pop()
+                self._row_of[key] = row
+                for p in pages:
+                    self._pid_flat[p.pid] = \
+                        row * self.pages_per_block + p.index
                 self._free.extend(reversed(pages))
             page = self._free.pop()
             assert page.refs == 0, f"free-list page with refs: {page}"
@@ -170,6 +198,9 @@ class PagePool:
                 del self._blocks[key]
                 self._free = [p for p in self._free
                               if self._bkey(p.block) != key]
+                self._free_rows.append(self._row_of.pop(key))
+                for p in pages:
+                    self._pid_flat.pop(p.pid, None)
                 self.block_releases.add(1)
                 release = block
             else:
@@ -196,6 +227,62 @@ class PagePool:
         piece.reshape(n, self.kv_bytes_per_token)[:, :4] = \
             ids.reshape(n, 4)
         self._splice(page.block, piece, self._offset(page, slot))
+
+    def write_slots(self, page: KVPage, slot: int, rows) -> None:
+        """Write RAW per-token vector payloads (the ModelRunner path,
+        ISSUE 10) into consecutive slots of `page` starting at `slot`:
+        ``rows`` is ``[n, kv_bytes_per_token]`` uint8 — each row is one
+        token's packed K/V vectors, spliced on device exactly like the
+        stand-in :meth:`write` (one splice per contiguous run)."""
+        rows = np.ascontiguousarray(rows, np.uint8)
+        if rows.ndim != 2 or rows.shape[1] != self.kv_bytes_per_token:
+            raise ValueError(
+                f"write_slots rows must be [n, {self.kv_bytes_per_token}]"
+                f" uint8, got {rows.shape}")
+        n = rows.shape[0]
+        if slot < 0 or slot + n > self.page_tokens:
+            raise ValueError(f"write_slots [{slot},{slot + n}) exceeds "
+                             f"page_tokens={self.page_tokens}")
+        self._splice(page.block, rows.reshape(-1),
+                     self._offset(page, slot))
+
+    def flat_ids(self, pids) -> list:
+        """Translate page ids (the engine's gathered page tables) into
+        FLAT ARENA indices for :meth:`arena`; -1 (padding) and dead
+        pids map to -1."""
+        with self._mu:
+            return [self._pid_flat.get(int(p), -1) for p in pids]
+
+    def arena(self):
+        """The whole pool as ONE fixed-shape device array
+        ``[max_blocks * pages_per_block, page_bytes]`` uint8 — the
+        paged-attention kernel's K/V substrate.  Row assignment is
+        stable per leased block (see module docstring), unleased rows
+        read as zeros, so the shape (and thus the kernel's compilation)
+        never changes however blocks churn."""
+        import jax.numpy as jnp
+        nbytes = self.pages_per_block * self.page_bytes
+        with self._mu:
+            if self._zero_row is None:
+                import jax
+                with jax.default_device(self.pool.device):
+                    self._zero_row = jnp.zeros((nbytes,), jnp.uint8)
+            by_row = {row: self._blocks[key][0]
+                      for key, row in self._row_of.items()}
+            # snapshot the slot buffers under the pool lock (Block.view
+            # would retake it per row)
+            with self.pool._lock:
+                bufs = []
+                for row in range(self.max_blocks):
+                    blk = by_row.get(row)
+                    if blk is None:
+                        bufs.append(self._zero_row)
+                    else:
+                        buf = self.pool._slots[blk.size_class][blk.slot]
+                        bufs.append(buf[:nbytes] if buf.shape[0] != nbytes
+                                    else buf)
+        return jnp.stack(bufs).reshape(
+            self.max_blocks * self.pages_per_block, self.page_bytes)
 
     def read(self, page: KVPage, count: Optional[int] = None) -> np.ndarray:
         """Token ids stored in `page` (host read — test/debug path, the

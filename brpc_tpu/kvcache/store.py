@@ -49,6 +49,8 @@ import re
 import threading
 from typing import Optional, Sequence
 
+import numpy as np
+
 from brpc_tpu import fault, rpcz
 from brpc_tpu.bvar import Adder, PassiveStatus
 from brpc_tpu.kvcache.pages import KVPage, PagePool
@@ -92,7 +94,7 @@ class KVSeq:
     must start — everything before it was served from shared pages."""
 
     __slots__ = ("seq_id", "tokens", "pages", "prefill_from", "retired",
-                 "span", "committed_full")
+                 "span", "committed_full", "kv_filled")
 
     def __init__(self):
         self.seq_id = next(_seq_ids)
@@ -104,6 +106,15 @@ class KVSeq:
         # commit_live_pages streaming-commit cursor) — counts pages,
         # monotone, so each boundary commits only the new chunk
         self.committed_full = 0
+        # MATERIALIZATION cursor (ISSUE 10): how many leading positions
+        # hold real KV bytes.  Harness mode writes the token-id
+        # stand-in at append, so it tracks len(tokens); vector mode
+        # (a real ModelRunner) materializes a position only when
+        # ``write_kv`` lands its packed K/V vectors — the final
+        # generated token is never stepped, so its slot never fills,
+        # and every caching path caps at this cursor so the radix tree
+        # can never serve a page whose tail slot was never written
+        self.kv_filled = 0
         # the owning generation's rpcz span (ISSUE 5): KV events on this
         # sequence — COW, page-alloc retries, pressure evictions, detach
         # — annotate it.  NULL_SPAN when tracing is off: every annotate
@@ -124,12 +135,20 @@ class KVCacheStore:
     def __init__(self, pool=None, device=None, *,
                  page_bytes: int = 1024, page_tokens: int = 16,
                  max_blocks: int = 8, commit_live_pages: bool = False,
+                 vector_kv: bool = False,
                  name: str = "kv"):
         self.pagepool = PagePool(pool, device, page_bytes=page_bytes,
                                  page_tokens=page_tokens,
                                  max_blocks=max_blocks, name=name)
         self.radix = RadixTree(self.pagepool, name=name)
         self.page_tokens = self.pagepool.page_tokens
+        # vector-KV mode (ISSUE 10): pages hold REAL packed K/V vectors
+        # written by a ModelRunner through write_kv, so the append path
+        # skips the token-id stand-in splice (lifecycle/COW/radix
+        # bookkeeping unchanged — the tree is keyed on token ids either
+        # way) and materialization is tracked by seq.kv_filled instead
+        # of len(tokens)
+        self.vector_kv = bool(vector_kv)
         # streaming commit (ISSUE 7): every page a live sequence FILLS
         # is inserted into the radix tree right away instead of at
         # retire/detach, so a StandbySync (or a reader racing a long
@@ -198,6 +217,7 @@ class KVCacheStore:
         hit = len(shared) * self.page_tokens
         seq.tokens = prompt[:hit]
         seq.prefill_from = hit
+        seq.kv_filled = hit     # cached pages hold materialized KV
         if seq.span is not rpcz.NULL_SPAN:
             seq.span.annotate(
                 f"kv admit: prefix_hit={hit}/{len(prompt)} tokens "
@@ -231,6 +251,66 @@ class KVCacheStore:
                 raise RuntimeError(f"extend on retired seq {seq.seq_id}")
             self._append(seq, int(token))
 
+    def write_kv(self, seq: KVSeq, pos: int, rows, *,
+                 final: bool = True) -> None:
+        """Materialize REAL K/V vectors (ISSUE 10): splice ``rows`` —
+        ``[n, kv_bytes_per_token]`` uint8, one packed K/V payload per
+        token — into `seq`'s pages at positions ``[pos, pos + n)``.
+        Positions must already be appended (admit/extend own the page
+        table; this writes payloads, it never grows the table).  A
+        target page shared with the radix tree or a fork is
+        copied-on-write first, exactly like the extend-path tail COW —
+        a runner rewriting a committed position can never corrupt
+        another holder's KV.
+
+        ``final=True`` (the default) declares the slots COMPLETE:
+        ``seq.kv_filled`` advances (the caching cap) and the streaming
+        commit runs.  A multi-pass writer — the runner's per-layer
+        prefill, which rewrites the same slots once per layer — MUST
+        pass ``final=False`` until its last pass, or a half-written
+        slot (upper layers still zero) could be committed to the radix
+        tree / pinned by a detach and served to a future admit as
+        valid KV."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        n = rows.shape[0]
+        with self._mu:
+            if seq.retired:
+                raise RuntimeError(f"write_kv on retired seq {seq.seq_id}")
+            if pos < 0 or pos + n > len(seq.tokens):
+                raise ValueError(
+                    f"write_kv [{pos},{pos + n}) exceeds materialized "
+                    f"tokens ({len(seq.tokens)})")
+            idx = 0
+            while idx < n:
+                p = pos + idx
+                pi = p // self.page_tokens
+                slot = p % self.page_tokens
+                page = seq.pages[pi]
+                if page.refs > 1:
+                    # copy-on-write: the target page is shared (radix
+                    # tree, fork, live commit) — writing in place would
+                    # corrupt the other holder's view
+                    if seq.span is not rpcz.NULL_SPAN:
+                        seq.span.annotate(
+                            f"kv cow: page {page.pid} shared "
+                            f"(refs={page.refs}), copied before KV write")
+                    fresh = self._alloc_page(span=seq.span)
+                    try:
+                        self.pagepool.copy_page(fresh, page)
+                    except BaseException:
+                        self.pagepool.unref(fresh)
+                        raise
+                    seq.pages[pi] = fresh
+                    self.pagepool.unref(page)
+                    self.cow.add(1)
+                    page = fresh
+                k = min(self.page_tokens - slot, n - idx)
+                self.pagepool.write_slots(page, slot, rows[idx:idx + k])
+                idx += k
+            if final:
+                seq.kv_filled = max(seq.kv_filled, pos + n)
+                self._commit_live(seq)
+
     def fork(self, seq: KVSeq) -> KVSeq:
         """A second sequence sharing every page of `seq` (divergent
         continuations isolate via copy-on-write on extend)."""
@@ -240,6 +320,7 @@ class KVCacheStore:
             child = KVSeq()
             child.tokens = list(seq.tokens)
             child.prefill_from = len(seq.tokens)
+            child.kv_filled = min(seq.kv_filled, len(seq.tokens))
             for p in seq.pages:
                 self.pagepool.ref(p)
                 child.pages.append(p)
@@ -258,7 +339,7 @@ class KVCacheStore:
                 return
             seq.retired = True
             if cache:
-                nfull = len(seq.tokens) // self.page_tokens
+                nfull = self._cacheable_full(seq)
                 if nfull:
                     self.radix.insert(seq.tokens[:nfull * self.page_tokens],
                                       seq.pages[:nfull])
@@ -282,7 +363,7 @@ class KVCacheStore:
         with self._mu:
             if seq.retired:
                 return RecoveryPin(self, [], 0)
-            nfull = len(seq.tokens) // self.page_tokens
+            nfull = self._cacheable_full(seq)
             pinned: list = []
             if nfull:
                 toks = seq.tokens[:nfull * self.page_tokens]
@@ -388,6 +469,15 @@ class KVCacheStore:
 
     # ---- internals ----
 
+    def _cacheable_full(self, seq: KVSeq) -> int:
+        """Full pages eligible for the radix tree: bounded by the
+        MATERIALIZED prefix (ISSUE 10) — in vector-KV mode the last
+        generated token's slot never holds real vectors (it is never
+        stepped), so a page it lands in must not be cached and later
+        served as valid KV.  Harness mode: kv_filled == len(tokens),
+        identical behavior to before."""
+        return min(len(seq.tokens), seq.kv_filled) // self.page_tokens
+
     def _append(self, seq: KVSeq, token: int) -> None:
         self._append_run(seq, [token])
 
@@ -424,19 +514,33 @@ class KVCacheStore:
                     self.cow.add(1)
             k = min(self.page_tokens - slot, n - idx)
             run = [int(t) for t in tokens[idx:idx + k]]
-            self.pagepool.write(seq.pages[-1], slot, run)
+            if not self.vector_kv:
+                # harness mode: the token-id stand-in IS the KV payload
+                # — the splice materializes the slot.  Vector mode skips
+                # it entirely: the ModelRunner's write_kv fills the slot
+                # with real vectors (and skipping saves one splice per
+                # appended page)
+                self.pagepool.write(seq.pages[-1], slot, run)
             seq.tokens.extend(run)
             idx += k
-        if self.commit_live_pages:
-            # streaming commit: every newly FILLED page joins the radix
-            # tree now (the tree refs it; this seq keeps its own ref),
-            # so acquire_prefix/export sees a live generation's finished
-            # pages without waiting for retire/detach
-            nfull = len(seq.tokens) // self.page_tokens
-            if nfull > seq.committed_full:
-                self.radix.insert(seq.tokens[:nfull * self.page_tokens],
-                                  seq.pages[:nfull])
-                seq.committed_full = nfull
+        if not self.vector_kv:
+            seq.kv_filled = len(seq.tokens)
+        self._commit_live(seq)
+
+    def _commit_live(self, seq: KVSeq) -> None:
+        if not self.commit_live_pages:
+            return
+        # streaming commit: every newly FILLED page joins the radix
+        # tree now (the tree refs it; this seq keeps its own ref),
+        # so acquire_prefix/export sees a live generation's finished
+        # pages without waiting for retire/detach.  Capped at the
+        # materialized prefix (vector mode: a page whose tail slot
+        # lacks real vectors commits one write_kv later)
+        nfull = self._cacheable_full(seq)
+        if nfull > seq.committed_full:
+            self.radix.insert(seq.tokens[:nfull * self.page_tokens],
+                              seq.pages[:nfull])
+            seq.committed_full = nfull
 
     def _alloc_page(self, span=None) -> KVPage:
         """Page allocation with pressure-driven eviction: on

@@ -82,7 +82,7 @@ class PrefillReplica:
     the decode process (see module docstring)."""
 
     def __init__(self, store, decode_addr: str, *,
-                 batcher=None, name: str = "prefill",
+                 batcher=None, runner=None, name: str = "prefill",
                  timeout_ms: int = 10_000):
         self.store = store
         self.decode_addr = decode_addr
@@ -90,6 +90,12 @@ class PrefillReplica:
         # fn): concurrent Prefill RPCs coalesce into bucket-padded
         # batches exactly like the unary serving path
         self.batcher = batcher
+        # a ModelRunner (ISSUE 10): the prefill replica runs the REAL
+        # model's prefill against its admitted sequence — each layer's
+        # suffix K/V splices into the local pages, and the migration
+        # plane then ships pages holding real attention state the
+        # decode process's paged kernel reads directly
+        self.runner = runner
         self.name = name
         self.migrator = PageMigrator(store, name=f"{name}_migrator",
                                      timeout_ms=timeout_ms)
@@ -110,7 +116,19 @@ class PrefillReplica:
             seq = self.store.admit(prompt)
             hit = seq.prefix_hit_tokens
             suffix = prompt[hit:]
-            if self.batcher is not None and suffix:
+            if self.runner is not None and suffix:
+                try:
+                    from brpc_tpu.models.runner import run_prefill
+                    run_prefill(self.runner, seq, prompt)
+                except Exception as e:
+                    self.store.retire(seq, cache=False)
+                    if isinstance(e, errors.RpcError):
+                        raise
+                    raise errors.RpcError(
+                        errors.EINTERNAL,
+                        f"model prefill failed: "
+                        f"{type(e).__name__}: {e}")
+            elif self.batcher is not None and suffix:
                 try:
                     self.batcher.submit_wait(
                         np.asarray(suffix, np.float32), timeout_s=60)
@@ -162,12 +180,14 @@ class DisaggPrefillService(Service):
 
 
 def register_disagg_prefill(server, store, decode_addr: str, *,
-                            batcher=None, name: str = "prefill",
+                            batcher=None, runner=None,
+                            name: str = "prefill",
                             timeout_ms: int = 10_000) -> PrefillReplica:
     """Stand up the PREFILL role on `server`: the DisaggPrefill service
     over a PrefillReplica shipping pages to `decode_addr`."""
     replica = PrefillReplica(store, decode_addr, batcher=batcher,
-                             name=name, timeout_ms=timeout_ms)
+                             runner=runner, name=name,
+                             timeout_ms=timeout_ms)
     server.add_service(DisaggPrefillService(replica))
     return replica
 

@@ -6,3 +6,8 @@ from brpc_tpu.models.moe import (  # noqa: F401
     MoEConfig, init_moe_params, make_ep_mesh, make_sharded_moe_layer,
     make_sharded_moe_train_step, moe_layer_reference, place_moe_params,
 )
+from brpc_tpu.models.runner import (  # noqa: F401
+    LegacyFnRunner, ModelRunner, TransformerConfig, TransformerRunner,
+    as_runner, dense_forward, dense_generate, init_runner_params,
+    make_store_for, make_tp_mesh, place_runner_params, run_prefill,
+)

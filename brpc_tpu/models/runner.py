@@ -1,0 +1,627 @@
+"""ModelRunner — real model serving over the paged KV cache (ISSUE 10).
+
+PRs 2–9 built the serving stack around two ad-hoc model protocols: the
+engine's 2-arg/3-arg ``step_fn``/``prefill_fn`` and the batcher's
+1-arg/2-arg ``batch_fn``, all driven with token ids standing in for KV.
+This module replaces them with ONE interface and ships the first model
+that actually uses the paged HBM layout:
+
+  :class:`ModelRunner`       the interface: ``prefill(tokens, positions,
+                             pages)`` / ``step(tokens, positions, pages)``
+                             — fixed shapes, one compile per bucket, the
+                             engine's trace-counter discipline unchanged;
+  :class:`LegacyFnRunner`    the adapter wrapping the old fn protocols
+                             byte-for-byte (required-positional
+                             detection, jnp conversion, pass_page_table
+                             override), so every existing test and the
+                             pure-token harness keep passing unmodified;
+  :class:`TransformerRunner` a small real transformer (GQA attention +
+                             gelu MLP, RMS-norm, tied embeddings, greedy
+                             decode) whose K/V live IN the KV cache's
+                             pages: prefill writes each layer's suffix
+                             K/V through ``KVCacheStore.write_kv`` (the
+                             PagePool splice path — COW and refcounts
+                             apply) then attends over the page table
+                             with :func:`~brpc_tpu.ops.paged_attention`;
+                             decode steps attend over the arena plus the
+                             position's in-flight K/V (the self key) and
+                             return packed K/V rows the engine splices
+                             back — so prefix reuse, COW forks, radix
+                             eviction and crash recovery all operate on
+                             REAL attention state.
+
+Position/materialization contract (the whole stack hinges on it):
+
+  * a sequence at ``position p`` has tokens 0..p-1 appended and REAL
+    K/V materialized for positions 0..p-2 at minimum (``seq.kv_filled``);
+  * ``step(tok=t_{p-1}, pos=p)`` recomputes position p-1's hidden state
+    (embedding + per-layer q/k/v), attends over arena keys 0..p-2 PLUS
+    its own in-flight k/v, and returns (next token, position p-1's
+    packed K/V rows) — the engine writes the rows before extending, so
+    the NEXT step reads them from the arena;
+  * prefill covers suffix positions f..n-1 write-then-attend per layer:
+    layer l's K/V are spliced into the pages FIRST, then the layer
+    attends over the page table (cached prefix pages + just-written
+    suffix) with per-row causal lengths.  Cold (f=0) and warm (f>0)
+    prefill therefore run the SAME kernel over the SAME fixed arena
+    shapes — prefix reuse changes which pages already hold bytes, not
+    the compute path — which is what makes prefill-skip produce
+    identical tokens to cold prefill.
+
+Sharding: parameters place over an ICI ``tp`` mesh axis with
+``NamedSharding`` (:func:`place_runner_params` — q/k/v/o projections and
+the MLP shard on the head/ff dim, embeddings replicate) and the jitted
+step partitions under GSPMD exactly like the pjit pattern in
+SNIPPETS.md [1]/[3]; a 1-device mesh (the CPU tier-1 path) is the
+degenerate case of the same code.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from brpc_tpu import fault
+
+DEFAULT_PREFILL_BUCKETS = (16, 64, 256, 1024, 4096)
+
+
+# ---------------------------------------------------------------------------
+# the interface + legacy adapter
+# ---------------------------------------------------------------------------
+
+class ModelRunner:
+    """The model interface the serving stack drives (see module
+    docstring).  ``wants_pages`` tells the engine to gather per-slot
+    page tables; ``kv_bytes_per_token`` > 0 means the runner produces
+    REAL packed K/V rows (the engine writes step rows via
+    ``KVCacheStore.write_kv``; prefill writes its own, layer by layer);
+    ``has_prefill`` gates the engine's prefill stage."""
+
+    wants_pages: bool = False
+    kv_bytes_per_token: int = 0
+    has_prefill: bool = False
+    name: str = "runner"
+
+    def bind(self, store) -> None:
+        """Called by the engine at construction with its KV store (may
+        be None for raw-block engines).  Idempotent."""
+
+    def prefill(self, tokens, positions, pages, seq=None):
+        """Prefill one sequence's uncached suffix: ``tokens`` is the
+        bucket-padded suffix (int32), ``positions`` the matching global
+        positions, ``pages`` the slot's page-id table (-1 padded),
+        ``seq`` the owning KVSeq (vector runners write K/V through
+        it).  Returns nothing; K/V lands in the pages."""
+        raise NotImplementedError
+
+    def step(self, tokens, positions, pages):
+        """One decode step across every slot: fixed-shape ``tokens`` /
+        ``positions`` ``[num_slots]`` plus the gathered page table
+        ``[num_slots, max_pages_per_slot]`` (None unless
+        ``wants_pages``).  Returns ``(next_tokens, kv_rows)`` — int32
+        per-slot next tokens and the query positions' packed K/V rows
+        (``[num_slots, kv_bytes_per_token]`` uint8, or None for
+        token-harness runners)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LegacyFnRunner(ModelRunner):
+    """Adapter for the PR 2/3 fn protocols: a 2-arg
+    ``step_fn(tokens, positions)`` or 3-arg ``step_fn(tokens,
+    positions, pages)`` plus an optional ``prefill_fn(padded_suffix,
+    prefill_from)``.  Behavior is byte-for-byte the engine's old
+    inline calls — required-positional detection included — so the
+    pure-token harness and every existing test ride through
+    unchanged."""
+
+    def __init__(self, step_fn: Callable,
+                 prefill_fn: Optional[Callable] = None, *,
+                 store=None, pass_page_table: Optional[bool] = None,
+                 name: str = "legacy"):
+        self.step_fn = step_fn
+        self.prefill_fn = prefill_fn
+        self.has_prefill = prefill_fn is not None
+        self.name = name
+        # pass the gathered page tables only to a step_fn built for
+        # them — a 2-arg step_fn keeps the PR 2 contract unchanged.
+        # Detection counts REQUIRED positionals (an optional third
+        # parameter like rng=None must not silently receive the
+        # table); pass_page_table overrides for *args step functions
+        if pass_page_table is not None:
+            self.wants_pages = bool(pass_page_table)
+        else:
+            from brpc_tpu.serving.batcher import required_positional_args
+            self.wants_pages = (store is not None and
+                                required_positional_args(step_fn) >= 3)
+
+    def prefill(self, tokens, positions, pages, seq=None):
+        import jax.numpy as jnp
+        self.prefill_fn(jnp.asarray(tokens),
+                        jnp.int32(int(positions[0])))
+
+    def step(self, tokens, positions, pages):
+        import jax.numpy as jnp
+        if pages is not None:
+            out = self.step_fn(jnp.asarray(tokens),
+                               jnp.asarray(positions),
+                               jnp.asarray(pages))
+        else:
+            out = self.step_fn(jnp.asarray(tokens),
+                               jnp.asarray(positions))
+        return np.asarray(out), None
+
+
+def as_runner(step_fn=None, prefill_fn=None, *, runner=None, store=None,
+              pass_page_table=None) -> ModelRunner:
+    """The engine's construction shim: hand back ``runner`` as-is, or
+    wrap legacy fns in a :class:`LegacyFnRunner`."""
+    if runner is not None:
+        if step_fn is not None or prefill_fn is not None:
+            raise ValueError("pass either runner= or step_fn/prefill_fn,"
+                             " not both")
+        return runner
+    if step_fn is None:
+        raise ValueError("a step_fn or a runner is required")
+    return LegacyFnRunner(step_fn, prefill_fn, store=store,
+                          pass_page_table=pass_page_table)
+
+
+# ---------------------------------------------------------------------------
+# the real transformer
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 128
+    d_model: int = 32
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: int = 2
+    head_dim: int = 8
+    d_ff: int = 64
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """One token slot: all layers' K then V vectors, f32, the
+        token-major layout ``[n_layers, 2, n_kv_heads, head_dim]``
+        (``ops.paged_attention.arena_kv_view``)."""
+        return self.n_layers * 2 * self.n_kv_heads * self.head_dim * 4
+
+
+def init_runner_params(cfg: TransformerConfig, key=None) -> dict:
+    """Seeded random parameters, stacked per layer (every layer shares
+    one compiled step: params index by layer inside the jit)."""
+    import jax
+    import jax.numpy as jnp
+    key = key if key is not None else jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 7)
+    dm, h, hkv, d, ff = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads,
+                         cfg.head_dim, cfg.d_ff)
+    L = cfg.n_layers
+
+    def init(k, shape, fan_in):
+        return jax.random.normal(k, shape, jnp.float32) \
+            / math.sqrt(fan_in)
+
+    return {
+        "emb": init(ks[0], (cfg.vocab, dm), dm),
+        "wq": init(ks[1], (L, dm, h * d), dm),
+        "wk": init(ks[2], (L, dm, hkv * d), dm),
+        "wv": init(ks[3], (L, dm, hkv * d), dm),
+        "wo": init(ks[4], (L, h * d, dm), h * d),
+        "w1": init(ks[5], (L, dm, ff), dm),
+        "w2": init(ks[6], (L, ff, dm), ff),
+    }
+
+
+def make_tp_mesh(n_devices: Optional[int] = None):
+    """A 1-D ``tp`` (tensor-parallel) ICI mesh — the moe.py ``ep``
+    pattern applied to attention heads."""
+    import jax
+    from jax.sharding import Mesh
+    n = n_devices or len(jax.devices())
+    return Mesh(np.array(jax.devices()[:n]), ("tp",))
+
+
+def place_runner_params(params: dict, mesh) -> dict:
+    """Shard the parameter tree over the ``tp`` axis with
+    NamedSharding (the SNIPPETS.md [1]/[3] pjit partitioning applied
+    here under GSPMD): q/k/v projections and the MLP up-projection
+    shard their OUTPUT (head/ff) dim, the o/down projections their
+    INPUT dim, embeddings replicate.  The jitted step inherits the
+    layout — no per-call resharding."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    specs = {
+        "emb": P(),
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "w1": P(None, None, "tp"),
+        "w2": P(None, "tp", None),
+    }
+    tp = mesh.shape["tp"]
+    for name, dim in (("wq", params["wq"].shape[2]),
+                      ("wk", params["wk"].shape[2]),
+                      ("wv", params["wv"].shape[2]),
+                      ("w1", params["w1"].shape[2])):
+        if dim % tp:
+            raise ValueError(f"{name} dim {dim} must divide tp={tp}")
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+def _posenc(pos, dm: int):
+    """Parameter-free sinusoidal position encoding (deterministic, so
+    the dense reference and the paged path agree by construction)."""
+    import jax.numpy as jnp
+    half = dm // 2
+    freq = jnp.exp(-math.log(10000.0)
+                   * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[..., None] * freq
+    return jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+
+
+def _rms(x):
+    import jax.numpy as jnp
+    return x / jnp.sqrt(jnp.mean(x * x, axis=-1, keepdims=True) + 1e-6)
+
+
+def _mlp(x, w1, w2):
+    import jax
+    import jax.numpy as jnp
+    return jax.nn.gelu(x @ w1) @ w2
+
+
+def dense_forward(params: dict, cfg: TransformerConfig, tokens,
+                  positions, use_flash: bool = True):
+    """The DENSE reference forward: full causal self-attention over the
+    whole sequence, no cache — the oracle the paged path is validated
+    against, and the batcher's scoring path.  ``tokens``/``positions``
+    are ``[B, S]``; returns per-position logits ``[B, S, vocab]``.
+    Attention runs through the ops/attention.py flash kernel (the
+    pallas TPU path with its CPU fallback) — the prefill-compute reuse
+    the ISSUE names."""
+    import jax.numpy as jnp
+
+    from brpc_tpu.ops.attention import flash_attention, local_attention
+    b, s = tokens.shape
+    h_, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = params["emb"][tokens] + _posenc(positions, cfg.d_model)
+    for l in range(cfg.n_layers):
+        x = _rms(h)
+        q = (x @ params["wq"][l]).reshape(b, s, h_, d)
+        k = (x @ params["wk"][l]).reshape(b, s, hkv, d)
+        v = (x @ params["wv"][l]).reshape(b, s, hkv, d)
+        attn = flash_attention if use_flash else local_attention
+        o = attn(q, k, v, causal=True)
+        h = h + o.reshape(b, s, h_ * d) @ params["wo"][l]
+        h = h + _mlp(_rms(h), params["w1"][l], params["w2"][l])
+    return _rms(h) @ params["emb"].T
+
+
+def dense_generate(params: dict, cfg: TransformerConfig,
+                   prompt: Sequence[int], max_new_tokens: int) -> list:
+    """Greedy decode with NO cache: the full sequence recomputes every
+    step through :func:`dense_forward`.  The equivalence oracle for
+    the paged runner — same math, none of the paging machinery."""
+    import jax.numpy as jnp
+    out = [int(t) for t in prompt]
+    for _ in range(max_new_tokens):
+        toks = jnp.asarray([out], jnp.int32)
+        pos = jnp.arange(len(out), dtype=jnp.int32)[None]
+        logits = dense_forward(params, cfg, toks, pos)
+        out.append(int(jnp.argmax(logits[0, -1])))
+    return out[len(prompt):]
+
+
+# ---- jitted compute (module level, cfg static: the compile cache is
+# shared by every runner instance with the same config — a supervisor
+# rebuilding engines, the chaos seeds, and the bench trials all reuse
+# one trace per bucket shape) ----
+
+def _kv_view(arena_u8, cfg: TransformerConfig, page_tokens: int):
+    from brpc_tpu.ops.paged_attention import arena_kv_view
+    return arena_kv_view(arena_u8, page_tokens, cfg.n_layers,
+                         cfg.n_kv_heads, cfg.head_dim)
+
+
+def _jit(fn):
+    import jax
+    return jax.jit(fn, static_argnames=("cfg", "page_tokens", "backend"))
+
+
+@functools.cache
+def _jits():
+    """Build the jitted kernels lazily (first runner construction), so
+    importing brpc_tpu.models costs no jax tracing."""
+    import jax
+    import jax.numpy as jnp
+
+    from brpc_tpu.ops.paged_attention import paged_attention
+
+    def embed(params, tokens, positions, *, cfg, page_tokens, backend):
+        return params["emb"][tokens] + _posenc(positions, cfg.d_model)
+
+    def proj(params, h, l, *, cfg, page_tokens, backend):
+        n = h.shape[0]
+        x = _rms(h)
+        q = (x @ params["wq"][l]).reshape(n, cfg.n_heads, cfg.head_dim)
+        k = (x @ params["wk"][l]).reshape(n, cfg.n_kv_heads,
+                                          cfg.head_dim)
+        v = (x @ params["wv"][l]).reshape(n, cfg.n_kv_heads,
+                                          cfg.head_dim)
+        return q, k, v
+
+    def attend(params, h, q, arena_u8, tables, lengths, l, *,
+               cfg, page_tokens, backend):
+        kv = _kv_view(arena_u8, cfg, page_tokens)
+        o = paged_attention(q, kv[:, :, l, 0], kv[:, :, l, 1],
+                            tables, lengths, backend=backend)
+        h = h + o.reshape(h.shape[0], cfg.n_heads * cfg.head_dim) \
+            @ params["wo"][l]
+        return h + _mlp(_rms(h), params["w1"][l], params["w2"][l])
+
+    def step(params, tokens, positions, tables, arena_u8, *,
+             cfg, page_tokens, backend):
+        s = tokens.shape[0]
+        qpos = positions - 1      # the query position (see contract)
+        kv = _kv_view(arena_u8, cfg, page_tokens)
+        h = params["emb"][tokens] + _posenc(qpos, cfg.d_model)
+        new_k, new_v = [], []
+        for l in range(cfg.n_layers):
+            x = _rms(h)
+            q = (x @ params["wq"][l]).reshape(s, cfg.n_heads,
+                                              cfg.head_dim)
+            k = (x @ params["wk"][l]).reshape(s, cfg.n_kv_heads,
+                                              cfg.head_dim)
+            v = (x @ params["wv"][l]).reshape(s, cfg.n_kv_heads,
+                                              cfg.head_dim)
+            new_k.append(k)
+            new_v.append(v)
+            # arena keys 0..qpos-1 plus the in-flight self key: the
+            # query position's slot may hold stale bytes (it is
+            # written only after this step returns), so lengths
+            # EXCLUDE it and extra_k/extra_v supply the value computed
+            # right here
+            o = paged_attention(q, kv[:, :, l, 0], kv[:, :, l, 1],
+                                tables, qpos, extra_k=k, extra_v=v,
+                                backend=backend)
+            h = h + o.reshape(s, cfg.n_heads * cfg.head_dim) \
+                @ params["wo"][l]
+            h = h + _mlp(_rms(h), params["w1"][l], params["w2"][l])
+        logits = _rms(h) @ params["emb"].T
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # pack this position's K/V rows in the token-major slot layout
+        kv_rows = jnp.stack(
+            [jnp.stack(new_k, axis=1), jnp.stack(new_v, axis=1)],
+            axis=2)                     # [S, L, 2, Hkv, D]
+        rows_u8 = jax.lax.bitcast_convert_type(
+            kv_rows, jnp.uint8).reshape(s, cfg.kv_bytes_per_token)
+        return nxt, rows_u8
+
+    return {"embed": _jit(embed), "proj": _jit(proj),
+            "attend": _jit(attend), "step": _jit(step)}
+
+
+def make_store_for(cfg: TransformerConfig, *, page_tokens: int = 8,
+                   max_blocks: int = 8, pool=None, device=None,
+                   commit_live_pages: bool = False, name: str = "kv"):
+    """A KVCacheStore whose page geometry matches ``cfg``'s packed
+    K/V slots (``vector_kv=True`` — the runner owns materialization)."""
+    from brpc_tpu.kvcache import KVCacheStore
+    return KVCacheStore(
+        pool, device, page_bytes=page_tokens * cfg.kv_bytes_per_token,
+        page_tokens=page_tokens, max_blocks=max_blocks,
+        commit_live_pages=commit_live_pages, vector_kv=True, name=name)
+
+
+class TransformerRunner(ModelRunner):
+    """The real model (see module docstring).  One instance may serve
+    any number of engine incarnations (the supervisor's factory reuses
+    it across restarts — parameters and jit caches survive the
+    rebuild)."""
+
+    wants_pages = True
+    has_prefill = True
+
+    def __init__(self, params: dict, cfg: TransformerConfig, *,
+                 store=None, mesh=None,
+                 attn_backend: Optional[str] = None,
+                 name: str = "model"):
+        import jax
+        self.cfg = cfg
+        self.kv_bytes_per_token = cfg.kv_bytes_per_token
+        self.name = name
+        if mesh is not None:
+            self.mesh = mesh
+            self.params = place_runner_params(params, mesh)
+        else:
+            # params already placed by the caller (place_runner_params)
+            # carry their mesh — the runner must know it to place the
+            # arena consistently (below)
+            sh = getattr(params.get("wq"), "sharding", None)
+            self.mesh = getattr(sh, "mesh", None)
+            self.params = params
+        self.store = None
+        self._mu = threading.Lock()
+        # backend=None lets ops/paged_attention pick (pallas on TPU,
+        # gather on CPU) at TRACE time, inside the shared jits
+        self._backend = attn_backend
+        self._fns = _jits()
+        if store is not None:
+            self.bind(store)
+
+    def _statics(self) -> dict:
+        return {"cfg": self.cfg, "page_tokens": self.store.page_tokens,
+                "backend": self._backend}
+
+    # ---- binding / validation ----
+
+    def bind(self, store) -> None:
+        if store is None:
+            raise ValueError(
+                "TransformerRunner needs a paged KVCacheStore "
+                "(store=) — raw-block engines have no page layout "
+                "for the kernel to read")
+        with self._mu:
+            if self.store is store:
+                return
+            if self.store is not None:
+                raise ValueError("runner already bound to a store")
+            if not getattr(store, "vector_kv", False):
+                raise ValueError(
+                    "store must be vector_kv=True (make_store_for) — "
+                    "token-id stand-in pages are not attendable KV")
+            kbpt = store.pagepool.kv_bytes_per_token
+            if kbpt != self.cfg.kv_bytes_per_token:
+                raise ValueError(
+                    f"store kv_bytes_per_token={kbpt} != model slot "
+                    f"{self.cfg.kv_bytes_per_token} "
+                    f"(page_bytes/page_tokens must match the packed "
+                    f"[L, 2, Hkv, D] f32 layout)")
+            self.store = store
+
+    # ---- the ModelRunner surface ----
+
+    def _flat_tables(self, pages) -> np.ndarray:
+        """pid page tables -> flat arena indices (fixed shape)."""
+        pages = np.asarray(pages, np.int32)
+        flat = self.store.pagepool.flat_ids(pages.ravel().tolist())
+        return np.asarray(flat, np.int32).reshape(pages.shape)
+
+    def _arena(self):
+        """The pool arena, placed CONSISTENTLY with the params: page
+        buffers are committed to the pool's single device, and a jit
+        whose params shard over a tp mesh rejects mixed placements —
+        replicate the arena over the mesh (plain single-device serving
+        returns it untouched).  Sharding the K/V pages themselves over
+        the mesh heads is the ROADMAP follow-on; replication is the
+        correct-if-wasteful tensor-parallel baseline."""
+        import jax
+        arena = self.store.pagepool.arena()
+        if self.mesh is None:
+            return arena
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(arena, NamedSharding(self.mesh, P()))
+
+    def step(self, tokens, positions, pages):
+        import jax.numpy as jnp
+        if fault.ENABLED and fault.hit(
+                "model.step_compute", runner=self.name) is not None:
+            raise RuntimeError("injected model step-compute failure")
+        tables = self._flat_tables(pages)
+        arena = self._arena()
+        nxt, rows = self._fns["step"](self.params,
+                                      jnp.asarray(tokens, jnp.int32),
+                                      jnp.asarray(positions, jnp.int32),
+                                      jnp.asarray(tables), arena,
+                                      **self._statics())
+        return np.asarray(nxt), np.asarray(rows)
+
+    def prefill(self, tokens, positions, pages, seq=None):
+        """Write-then-attend per layer (see module docstring): layer
+        l's suffix K/V splice into the pages BEFORE the layer attends,
+        so every query reads every key — its own included — from the
+        ONE arena layout, cold and warm alike."""
+        import jax.numpy as jnp
+        if seq is None:
+            raise ValueError("TransformerRunner.prefill needs the "
+                             "owning KVSeq (seq=)")
+        cfg = self.cfg
+        start = int(positions[0])
+        n = len(seq.tokens) - start       # valid (un-padded) rows
+        if n <= 0:
+            return
+        b = len(tokens)
+        toks = jnp.asarray(tokens, jnp.int32)
+        pos = jnp.asarray(positions, jnp.int32)
+        lengths = np.asarray(positions, np.int32) + 1   # causal: 0..i
+        statics = self._statics()
+        h = self._fns["embed"](self.params, toks, pos, **statics)
+        # host-side running slot buffer: after layer l, each valid
+        # row's slot holds layers 0..l — layers above are zeros, which
+        # layer l never reads
+        kvbuf = np.zeros((b, cfg.n_layers, 2, cfg.n_kv_heads,
+                          cfg.head_dim), np.float32)
+        for l in range(cfg.n_layers):
+            q, k, v = self._fns["proj"](self.params, h, l, **statics)
+            kvbuf[:, l, 0] = np.asarray(k)
+            kvbuf[:, l, 1] = np.asarray(v)
+            rows = kvbuf[:n].reshape(n, -1).view(np.uint8)
+            # only the LAST layer's pass completes the slots: advancing
+            # kv_filled (or live-committing) earlier would publish
+            # pages whose upper layers are still zeros
+            self.store.write_kv(seq, start, rows,
+                                final=(l == cfg.n_layers - 1))
+            # re-gather after the write: a COW inside write_kv swaps
+            # page identities, and the arena must reflect the splice
+            tab_row = self._flat_tables(seq.page_ids())
+            mp = len(pages) if pages is not None else len(tab_row)
+            padded = np.full((mp,), -1, np.int32)
+            padded[:min(len(tab_row), mp)] = tab_row[:mp]
+            tables = np.broadcast_to(padded, (b, mp))
+            arena = self._arena()
+            h = self._fns["attend"](self.params, h, q, arena,
+                                    jnp.asarray(np.ascontiguousarray(
+                                        tables)),
+                                    jnp.asarray(lengths), l, **statics)
+
+    # ---- the batcher surface (Serving.Score over the real model) ----
+
+    def score(self, padded):
+        """1-arg batch_fn: per-position greedy next-token ids
+        ``[B, L]`` over the dense forward (flash-kernel prefill
+        compute) — the batcher trims row i back to the request's raw
+        length."""
+        return self._score(padded, None)
+
+    def score_with_offsets(self, padded, offsets):
+        """2-arg batch_fn for prefix-trimmed batchers: rows are
+        suffixes, ``offsets`` their global start positions."""
+        return self._score(padded, offsets)
+
+    def _score(self, padded, offsets):
+        import jax.numpy as jnp
+        toks = np.asarray(padded)
+        if toks.dtype != np.int32:
+            toks = toks.astype(np.int32)
+        b, s = toks.shape
+        pos = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+        if offsets is not None:
+            pos = pos + np.asarray(offsets, np.int32)[:b, None]
+        logits = dense_forward(self.params, self.cfg,
+                               jnp.asarray(toks), jnp.asarray(pos))
+        return np.asarray(jnp.argmax(logits, axis=-1),
+                          dtype=np.float32)
+
+
+def run_prefill(runner: ModelRunner, seq, prompt: Sequence[int], *,
+                buckets: Sequence[int] = DEFAULT_PREFILL_BUCKETS,
+                max_pages: int = 64) -> int:
+    """Standalone prefill driver for callers OUTSIDE the engine (the
+    disagg PrefillReplica): bucket-pad the uncached suffix and run
+    ``runner.prefill`` against the admitted ``seq``.  Returns the
+    suffix length prefilled."""
+    suffix = [int(t) for t in prompt[seq.prefill_from:]]
+    if not suffix or not runner.has_prefill:
+        return 0
+    n = len(suffix)
+    bucket = next((x for x in sorted(buckets) if n <= x), n)
+    padded = np.zeros((bucket,), np.int32)
+    padded[:n] = suffix
+    positions = seq.prefill_from + np.arange(bucket, dtype=np.int32)
+    ids = seq.page_ids()
+    pages = np.full((max(max_pages, len(ids)),), -1, np.int32)
+    pages[:len(ids)] = ids
+    runner.prefill(padded, positions, pages, seq=seq)
+    return n

@@ -15,9 +15,19 @@ the framework ships exact sequence-parallel attention over the mesh:
                      lax fallback for non-TPU backends.  causal=True cuts
                      the K loop at the diagonal (~2x fewer FLOPs); 69.7
                      TFLOP/s measured on a v5 lite vs 23.6 for fused XLA.
+  paged_attention    attention over the KV cache's HBM page layout
+                     (ISSUE 10): queries gather K/V through the engine's
+                     per-slot page tables — a scalar-prefetch Pallas
+                     kernel on TPU, a pure-jax gather on CPU, bit-equal
+                     contracts (see ops/paged_attention.py).
 """
 from brpc_tpu.ops.attention import (flash_attention, local_attention,
                                     ring_attention, ulysses_attention)
+from brpc_tpu.ops.paged_attention import (arena_kv_view, paged_attention,
+                                          paged_attention_gather,
+                                          paged_attention_pallas)
 
 __all__ = ["flash_attention", "local_attention", "ring_attention",
-           "ulysses_attention"]
+           "ulysses_attention", "paged_attention",
+           "paged_attention_gather", "paged_attention_pallas",
+           "arena_kv_view"]

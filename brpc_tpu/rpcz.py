@@ -339,7 +339,18 @@ class _SpanSample:
 # the native extension is unavailable or the flag is off. ----
 _spanq_mu = threading.Lock()
 _spanq_thread: threading.Thread | None = None
-_SPANQ_INTERVAL_S = 0.05
+# SAFETY-NET park bound only (ISSUE 10): the drainer is event-woken —
+# it drains while the queue is nonempty and parks on _spanq_wake when
+# it runs dry, so a submitted span reaches the recent-span store in
+# wakeup latency (~ms), not a fixed poll period.  The timeout below
+# merely bounds the damage of a hypothetically missed wakeup.
+_SPANQ_PARK_S = 0.5
+_spanq_wake = threading.Event()
+# written only by the drainer, read by submit(): True while the
+# drainer is (about to be) parked — the ExecutionQueue idiom, so the
+# token path pays one plain attribute read per span and an Event.set
+# only on the empty->nonempty transition window
+_spanq_parked = False
 # exclusive access to the native queue for callers that need the
 # drainer to keep its hands off (the spanq unit tests push non-Span
 # probes; a concurrent drainer steal would both flake the test and
@@ -385,13 +396,35 @@ def _drain_native_spanq() -> None:
 
 
 def _spanq_loop() -> None:
+    """ExecutionQueue-style cadence (ISSUE 10, PR 9 follow-on d):
+    drain while the native queue is nonempty, park on the wake event
+    when it runs dry.  The parked/park-check ordering makes a missed
+    wakeup impossible under the GIL's sequential consistency: the
+    drainer publishes ``_spanq_parked = True`` BEFORE its final
+    pending check, and submit() pushes BEFORE reading the flag — so
+    either the drainer's check sees the span, or the submitter sees
+    the flag and sets the event.  A spurious set (span drained between
+    push and flag read) costs one empty drain."""
+    global _spanq_parked
+    from brpc_tpu import native_path
     while True:
-        time.sleep(_SPANQ_INTERVAL_S)
         try:
             with _spanq_pause:
                 _drain_native_spanq()
+            fb = native_path._fastrpc_mod()
+            if fb is not None and fb.spanq_pending():
+                continue          # drain again: the queue refilled
+            _spanq_parked = True
+            try:
+                if fb is not None and fb.spanq_pending():
+                    continue      # raced a push; drain immediately
+                _spanq_wake.wait(_SPANQ_PARK_S)
+            finally:
+                _spanq_parked = False
+                _spanq_wake.clear()
         except Exception:
-            pass   # a torn drain must never kill the drainer
+            time.sleep(0.05)   # a torn drain must never kill (or spin)
+            #                    the drainer
 
 
 def _ensure_spanq_drainer() -> None:
@@ -418,6 +451,12 @@ def submit(span: Span) -> None:
         # ISSUE 9 hot path: one lock-free native push; everything
         # heavier happens on the rpcz-spanq drainer
         fb.spanq_push(span)
+        # ISSUE 10: wake a parked drainer — one GIL-atomic flag read on
+        # the common (drainer busy) path, an Event.set only on the
+        # empty->nonempty transition (see _spanq_loop for the ordering
+        # argument)
+        if _spanq_parked:
+            _spanq_wake.set()
         t = _spanq_thread
         if t is None or not t.is_alive():
             # covers first use AND a dead-but-non-None thread (a fork's

@@ -202,6 +202,22 @@ class DynamicBatcher:
                  padded_output: Optional[bool] = None):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
+        # a ModelRunner instance cannot exist unless its module is
+        # already imported — the sys.modules probe keeps plain-numpy
+        # batchers from paying the models-package (jax) import
+        import sys as _sys
+        _runner_mod = _sys.modules.get("brpc_tpu.models.runner")
+        if _runner_mod is not None and \
+                isinstance(batch_fn, _runner_mod.ModelRunner):
+            # Serving.Score over a REAL model (ISSUE 10): a ModelRunner
+            # drops in as the batch_fn — its dense scoring path (the
+            # flash-kernel forward) computes per-position next-token
+            # ids, trimmed back per row by the padded-output scatter.
+            # With a prefix cache the 2-arg offsets variant rides the
+            # formation-time trim exactly like any other offset-aware
+            # batch_fn.
+            batch_fn = (batch_fn.score_with_offsets
+                        if prefix_cache is not None else batch_fn.score)
         self.batch_fn = batch_fn
         self.max_batch_size = int(max_batch_size)
         self.max_delay_us = int(max_delay_us)

@@ -30,6 +30,15 @@ compiles once for the life of the engine regardless of how requests
 churn through the slots.  Inactive slots carry zeros; their outputs
 are ignored.
 
+The MODEL surface is a :class:`~brpc_tpu.models.runner.ModelRunner`
+(ISSUE 10): pass ``runner=`` for a real model — a
+``TransformerRunner`` attends over THIS engine's gathered page tables
+with the paged-attention kernel and returns packed K/V rows the step
+loop splices back into the store's pages (``write_kv``), so prefix
+reuse, COW forks and crash recovery operate on real attention state.
+The legacy ``step_fn``/``prefill_fn`` protocols wrap in a
+``LegacyFnRunner`` adapter with byte-identical behavior.
+
 Emission: each admitted request gets a BOUNDED emit buffer drained by
 its own emitter thread — the shared step loop never blocks in
 ``emit``.  A consumer that stops draining (stream credit exhausted,
@@ -251,7 +260,8 @@ class _Slot:
 class DecodeEngine:
     """Continuous-decode loop over a fixed slot pool."""
 
-    def __init__(self, step_fn: Callable, *,
+    def __init__(self, step_fn: Optional[Callable] = None, *,
+                 runner=None,
                  num_slots: int = 8,
                  kv_bytes_per_slot: int = 4096,
                  pool=None,
@@ -271,7 +281,6 @@ class DecodeEngine:
             raise ValueError("num_slots must be >= 1")
         if emit_buffer < 1:
             raise ValueError("emit_buffer must be >= 1")
-        self.step_fn = step_fn
         self.num_slots = int(num_slots)
         self.kv_bytes_per_slot = int(kv_bytes_per_slot)
         self.eos_token = eos_token
@@ -285,24 +294,33 @@ class DecodeEngine:
         # radix tree keeps serving prefix hits across engine restarts);
         # close() never touches it
         self.store = store
-        self.prefill_fn = prefill_fn
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.max_pages_per_slot = int(max_pages_per_slot)
         if pool is None and store is None:
             from brpc_tpu.ici.block_pool import get_block_pool
             pool = get_block_pool(device)
         self.pool = pool
-        # pass the gathered page tables only to a step_fn built for
-        # them — a 2-arg step_fn keeps the PR 2 contract unchanged.
-        # Detection counts REQUIRED positionals (an optional third
-        # parameter like rng=None must not silently receive the
-        # table); pass_page_table overrides for *args step functions
-        if pass_page_table is not None:
-            self._wants_pages = bool(pass_page_table)
-        else:
-            from brpc_tpu.serving.batcher import required_positional_args
-            self._wants_pages = (store is not None and
-                                 required_positional_args(step_fn) >= 3)
+        # the MODEL surface is a ModelRunner (ISSUE 10): legacy
+        # 2-arg/3-arg step_fn / prefill_fn protocols wrap in a
+        # LegacyFnRunner adapter with byte-identical behavior
+        # (required-positional detection, pass_page_table override),
+        # while a real runner (TransformerRunner) brings paged
+        # attention over this engine's gathered page tables and packed
+        # K/V rows the step loop splices back into the store's pages
+        from brpc_tpu.models.runner import as_runner
+        self.runner = as_runner(step_fn, prefill_fn, runner=runner,
+                                store=store,
+                                pass_page_table=pass_page_table)
+        self._wants_pages = self.runner.wants_pages
+        # vector-KV mode: the runner produces REAL packed K/V rows per
+        # step; they must land in a store whose page slots carry that
+        # exact layout
+        self._vector_kv = self.runner.kv_bytes_per_token > 0
+        if self._vector_kv:
+            if store is None:
+                raise ValueError("a vector-KV runner needs store= "
+                                 "(its K/V live in the paged cache)")
+            self.runner.bind(store)
 
         safe = re.sub(r"\W", "_", name)
         # record the EXACT names exposed here so close() hides only this
@@ -602,16 +620,20 @@ class DecodeEngine:
         prefill retires the request (its emitter still drains the
         terminal)."""
         self._prefill_fn_cpu_s = 0.0
-        if self.prefill_fn is None or slot.seq is None:
+        if not self.runner.has_prefill or slot.seq is None:
             return
         suffix = slot.req.prompt[slot.seq.prefill_from:]
         if not suffix:
             return
-        import jax.numpy as jnp
         n = len(suffix)
         bucket = next((b for b in self.prefill_buckets if n <= b), n)
         padded = np.zeros((bucket,), np.int32)
         padded[:n] = suffix
+        positions = slot.seq.prefill_from + np.arange(bucket,
+                                                      dtype=np.int32)
+        pages_row = np.full((self.max_pages_per_slot,), -1, np.int32)
+        ids = slot.seq.page_ids()
+        pages_row[:len(ids)] = ids[:self.max_pages_per_slot]
         # prefill child span: the cached/uncached split IS the story —
         # a cache hit is prefill compute skipped, and this span shows
         # exactly how much
@@ -626,8 +648,8 @@ class DecodeEngine:
         t0 = time.monotonic()
         t_fn_cpu = time.thread_time()
         try:
-            self.prefill_fn(jnp.asarray(padded),
-                            jnp.int32(slot.seq.prefill_from))
+            self.runner.prefill(padded, positions, pages_row,
+                                seq=slot.seq)
             self._prefill_fn_cpu_s = time.thread_time() - t_fn_cpu
         except Exception as e:
             self._prefill_fn_cpu_s = time.thread_time() - t_fn_cpu
@@ -715,7 +737,6 @@ class DecodeEngine:
         return table
 
     def _loop(self) -> None:
-        import jax.numpy as jnp
         while True:
             self._touch_beat()
             with self._cv:
@@ -775,13 +796,7 @@ class DecodeEngine:
                 if fault.ENABLED and fault.hit(
                         "serving.step", name=self.name) is not None:
                     raise RuntimeError("injected decode step crash")
-                if pages is not None:
-                    out = np.asarray(self.step_fn(
-                        jnp.asarray(tok), jnp.asarray(pos),
-                        jnp.asarray(pages)))
-                else:
-                    out = np.asarray(
-                        self.step_fn(jnp.asarray(tok), jnp.asarray(pos)))
+                out, kv_rows = self.runner.step(tok, pos, pages)
             except Exception as e:
                 if self._on_crash is not None:
                     # supervised: this is an ENGINE failure, not the
@@ -811,6 +826,20 @@ class DecodeEngine:
             for i, s in active:
                 if self._slots[i] is not s:
                     continue    # an emitter cancelled it mid-step
+                if kv_rows is not None and s.seq is not None:
+                    # materialize the query position's REAL K/V (the
+                    # packed row the runner just computed) before
+                    # anything else: the next step's arena gather — and
+                    # any radix commit of this page — must see it
+                    try:
+                        self.store.write_kv(s.seq, s.position - 1,
+                                            kv_rows[i:i + 1])
+                    except Exception as e:
+                        self._retire(i, errors.RpcError(
+                            errors.EINTERNAL,
+                            f"KV write failed: "
+                            f"{type(e).__name__}: {e}"))
+                        continue
                 nxt = int(out[i])
                 s.last_token = nxt
                 s.position += 1
@@ -1080,6 +1109,8 @@ class DecodeEngine:
             "heartbeat_age_s": round(time.monotonic() - self._beat_t, 3),
             "crashed": self._crashed is not None,
             "degraded_clamp": self.degraded_clamp,
+            "runner": self.runner.name,
+            "vector_kv": self._vector_kv,
         }
         if self.store is not None:
             out["kvcache"] = self.store.name

@@ -861,6 +861,144 @@ def test_engine_crash_midstream_failover_exactly_once(seed):
 
 
 # ---------------------------------------------------------------------------
+# scenario 11b (ISSUE 10): engine crash mid-step with the REAL model
+# runner -> recovery resumes bit-exact over real paged attention state
+# ---------------------------------------------------------------------------
+
+_mr_chaos_cache: dict = {}
+
+
+def _mr_chaos_model():
+    """One shared (cfg, params, dense-oracle cache) across the three
+    seeds — the module-level jit cache in models/runner.py makes every
+    seed after the first compile-free."""
+    if not _mr_chaos_cache:
+        from brpc_tpu.models.runner import (TransformerConfig,
+                                            init_runner_params)
+        cfg = TransformerConfig()
+        _mr_chaos_cache["cfg"] = cfg
+        _mr_chaos_cache["params"] = init_runner_params(cfg)
+        _mr_chaos_cache["oracle"] = {}
+    return _mr_chaos_cache
+
+
+def _mr_expected(prompt, n) -> list:
+    """Dense cache-less oracle for one prompt (memoized: the same
+    prompts recur across seeds)."""
+    from brpc_tpu.models.runner import dense_generate
+    m = _mr_chaos_model()
+    key = (tuple(prompt), n)
+    if key not in m["oracle"]:
+        m["oracle"][key] = dense_generate(m["params"], m["cfg"],
+                                          prompt, n)
+    return m["oracle"][key]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_engine_crash_with_real_runner_resumes_bit_exact(seed):
+    """The scenario 11 invariants upgraded from the token harness to
+    the REAL TransformerRunner, with the crash injected INSIDE the
+    model (`model.step_compute`, the ISSUE 10 fault site):
+
+    * every stream completes exactly-once and matches the cache-less
+      dense oracle token for token — recovery resumed from the emitted
+      cursor over real paged K/V, re-prefilling only what the detached
+      radix commit didn't cover;
+    * the re-decode was cheaper than a from-scratch replay
+      (hit-token delta > 0 across the restart);
+    * page-pool refcounts and HBM block occupancy return to baseline.
+    """
+    import gc
+
+    from brpc_tpu import native_path
+    from brpc_tpu.models.runner import (TransformerRunner,
+                                        make_store_for)
+    from brpc_tpu.serving import DecodeEngine, EngineSupervisor
+
+    m = _mr_chaos_model()
+    cfg, params = m["cfg"], m["params"]
+    store = make_store_for(cfg, page_tokens=4, max_blocks=32,
+                           name=f"mr_chaos_kv{seed}")
+    device_pool = store.pagepool.pool
+
+    def occupancy():
+        with device_pool._lock:
+            return {c: len(device_pool._free[c])
+                    for c in device_pool._free}
+
+    free0 = occupancy()
+    gc.collect()
+    ring0 = native_path.tokring_live()
+    runner = TransformerRunner(params, cfg, store=store,
+                               name=f"mr_chaos_m{seed}")
+    calm = ({"queue_delay_us": float("inf"), "pool_ratio": 9.9,
+             "queue_depth": 1e9},) * 3
+    sup = EngineSupervisor(
+        lambda: DecodeEngine(runner=runner, num_slots=2, store=store,
+                             max_pages_per_slot=24,
+                             prefill_buckets=(8, 16),
+                             name=f"mr_chaos_e{seed}"),
+        store=store, heartbeat_deadline_s=10.0, check_interval_s=0.02,
+        ladder=calm, name=f"mr_chaos{seed}")
+    try:
+        # jit warm + commit a shared 2-page prefix into the radix tree
+        shared = [50, 61, 12, 73, 24, 85, 36, 97]
+        done = threading.Event()
+        sup.submit(shared + [1], 2, lambda t: None,
+                   lambda e: done.set())
+        assert done.wait(120)
+        assert sup.join_idle(30)
+        h0 = store.hit_tokens.get_value()
+        p0 = store.prompt_tokens.get_value()
+
+        plan = fault.FaultPlan(seed)
+        plan.on("model.step_compute", fault.ERROR, times=1, after=2)
+        prompts = [shared + [100 + i] for i in range(4)]
+        sinks = []
+        with fault.injected(plan):
+            for p in prompts:
+                ev = threading.Event()
+                toks: list = []
+                errs: list = []
+                sinks.append((ev, toks, errs))
+                sup.submit(p, 5, toks.append,
+                           lambda e, ev=ev, errs=errs: (errs.append(e),
+                                                        ev.set()))
+            for ev, _, _ in sinks:
+                assert ev.wait(180), \
+                    "generation hung across the restart"
+        assert plan.injected["model.step_compute"] == 1
+        st = sup.stats()
+        assert st["restarts"] == 1
+        assert st["last_recovery"]["stolen_slots"] >= 1
+        # exactly-once + bit-exact vs the DENSE oracle: the resumed
+        # stream rode real paged K/V across detach/re-admit/prefill
+        for p, (ev, toks, errs) in zip(prompts, sinks):
+            assert errs == [None], f"{p[-1]}: {errs}"
+            assert toks == _mr_expected(p, 5), \
+                f"req {p[-1]}: real-runner stream diverged at the seam"
+        # cheaper than a from-scratch replay: some prompt tokens were
+        # served by committed pages (shared prefix and/or recovery)
+        dp = store.prompt_tokens.get_value() - p0
+        dh = store.hit_tokens.get_value() - h0
+        assert dp > 0 and (dp - dh) / dp < 1.0, \
+            "recovery re-decoded as much as a from-scratch replay"
+        assert sup.join_idle(30)
+        assert store.stats()["live_seqs"] == 0
+        store.clear()
+        store.pagepool.assert_consistent()
+        assert store.pagepool.blocks_leased() == 0
+        assert wait_until(lambda: occupancy() == free0, 10), \
+            f"KV blocks leaked: {occupancy()} != {free0}"
+    finally:
+        sup.close()
+        store.close()
+    assert wait_until(
+        lambda: (gc.collect(), native_path.tokring_live())[1] <= ring0,
+        10), "native emit rings leaked across the real-runner restart"
+
+
+# ---------------------------------------------------------------------------
 # scenario 12: engine crash mid-decode -> ONE generation trace linking
 # pre- and post-crash spans (ISSUE 5, same seeds as scenario 11)
 # ---------------------------------------------------------------------------

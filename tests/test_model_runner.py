@@ -1,0 +1,354 @@
+"""ModelRunner protocol + real-model serving (ISSUE 10).
+
+  * the LegacyFnRunner adapter reproduces the PR 2/3 fn protocols
+    EXACTLY — 2-arg step_fns never see a page table, 3-arg ones do,
+    optional third parameters don't, pass_page_table overrides, and
+    prefill_fn receives (bucket-padded suffix, prefill_from) with the
+    same jnp types as before;
+  * the real TransformerRunner through a DecodeEngine produces the
+    SAME tokens as a cache-less dense reference, and a warm (prefix
+    hit) generation produces IDENTICAL tokens to the cold one — prefix
+    reuse changes cost, not output — end-to-end through
+    Serving.Generate;
+  * the batcher scores through the runner's dense path;
+  * the disagg prefill helper materializes real K/V a peer can hit.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.models.runner import (LegacyFnRunner, ModelRunner,
+                                    TransformerConfig, TransformerRunner,
+                                    as_runner, dense_forward,
+                                    dense_generate, init_runner_params,
+                                    make_store_for, make_tp_mesh,
+                                    place_runner_params, run_prefill)
+from brpc_tpu.serving import DecodeEngine, DynamicBatcher
+
+from testutil import wait_until
+
+jax.config.update("jax_platforms", "cpu")
+
+CFG = TransformerConfig()
+PARAMS = init_runner_params(CFG)
+
+
+def _gen(engine, prompt, n, timeout=120):
+    toks, errs, ev = [], [], threading.Event()
+    engine.submit(prompt, n, toks.append,
+                  lambda e: (errs.append(e), ev.set()))
+    assert ev.wait(timeout), "generation hung"
+    assert errs == [None], errs
+    return toks
+
+
+# ---------------------------------------------------------------------------
+# the legacy adapter: unchanged 2-arg/3-arg behavior
+# ---------------------------------------------------------------------------
+
+def test_legacy_adapter_2arg_never_sees_pages():
+    calls = []
+
+    def step2(tokens, positions):
+        calls.append((tokens, positions))
+        return tokens + 1
+
+    r = as_runner(step2)
+    assert isinstance(r, LegacyFnRunner)
+    assert not r.wants_pages and not r.has_prefill
+    assert r.kv_bytes_per_token == 0
+    out, kv = r.step(np.array([3, 4], np.int32),
+                     np.array([1, 1], np.int32), None)
+    assert kv is None
+    np.testing.assert_array_equal(out, [4, 5])
+    # same jnp conversion the engine used to do inline
+    import jax.numpy as jnp
+    assert isinstance(calls[0][0], jnp.ndarray)
+
+
+def test_legacy_adapter_3arg_gets_pages_only_with_store():
+    def step3(tokens, positions, pages):
+        return tokens * 0 + pages.shape[1]
+
+    # without a store the third arg must NOT be wired (PR 2 contract)
+    assert not as_runner(step3).wants_pages
+    assert as_runner(step3, store=object()).wants_pages
+
+    # an OPTIONAL third parameter is not a page-table slot
+    def step_opt(tokens, positions, temperature=1.0):
+        return tokens
+    assert not as_runner(step_opt, store=object()).wants_pages
+    # ...unless the caller says so explicitly
+    assert as_runner(step_opt, store=object(),
+                     pass_page_table=True).wants_pages
+
+    r = as_runner(step3, store=object())
+    pages = np.full((2, 5), -1, np.int32)
+    out, kv = r.step(np.zeros(2, np.int32), np.ones(2, np.int32), pages)
+    assert kv is None
+    np.testing.assert_array_equal(out, [5, 5])
+
+
+def test_legacy_adapter_prefill_passes_padded_and_start():
+    seen = {}
+
+    def prefill(padded, start):
+        seen["padded"] = np.asarray(padded)
+        seen["start"] = int(start)
+
+    r = as_runner(lambda t, p: t, prefill)
+    assert r.has_prefill
+    padded = np.zeros((16,), np.int32)
+    padded[:3] = [7, 8, 9]
+    r.prefill(padded, 4 + np.arange(16, dtype=np.int32), None, seq=None)
+    assert seen["start"] == 4
+    np.testing.assert_array_equal(seen["padded"], padded)
+
+
+def test_as_runner_rejects_ambiguous_and_empty():
+    with pytest.raises(ValueError):
+        as_runner()
+    with pytest.raises(ValueError):
+        as_runner(lambda t, p: t, runner=ModelRunner())
+
+
+def test_engine_rejects_vector_runner_without_store():
+    r = TransformerRunner(PARAMS, CFG, name="t_mr_nostore")
+    with pytest.raises(ValueError):
+        DecodeEngine(runner=r, name="t_mr_nostore_eng")
+
+
+def test_runner_rejects_mismatched_store_geometry():
+    from brpc_tpu.kvcache import KVCacheStore
+    r = TransformerRunner(PARAMS, CFG, name="t_mr_geom")
+    tokenid_store = KVCacheStore(page_bytes=256, page_tokens=4,
+                                 name="t_mr_geom_kv")
+    try:
+        with pytest.raises(ValueError):
+            r.bind(tokenid_store)       # not vector_kv
+    finally:
+        tokenid_store.close()
+    wrong = make_store_for(TransformerConfig(n_layers=1),
+                           name="t_mr_geom_kv2")
+    try:
+        with pytest.raises(ValueError):
+            r.bind(wrong)               # slot layout mismatch
+    finally:
+        wrong.close()
+
+
+# ---------------------------------------------------------------------------
+# the real model end-to-end
+# ---------------------------------------------------------------------------
+
+def test_transformer_runner_matches_dense_and_warm_is_identical():
+    """Cold paged generation == cache-less dense reference, token for
+    token; a second (warm, prefix-hit) generation is identical to the
+    cold one while provably skipping prefill compute."""
+    store = make_store_for(CFG, page_tokens=4, max_blocks=16,
+                           name="t_mr_e2e_kv")
+    runner = TransformerRunner(PARAMS, CFG, store=store,
+                               name="t_mr_e2e")
+    eng = DecodeEngine(runner=runner, num_slots=2, store=store,
+                       max_pages_per_slot=24, prefill_buckets=(8, 16),
+                       name="t_mr_e2e_eng")
+    try:
+        prompt = [5, 17, 42, 9, 77, 3]
+        cold = _gen(eng, prompt, 6)
+        assert cold == dense_generate(PARAMS, CFG, prompt, 6), \
+            "paged decode diverged from the dense reference"
+        h0 = store.hit_tokens.get_value()
+        warm = _gen(eng, prompt, 6)
+        assert warm == cold, "prefix reuse changed the OUTPUT"
+        assert store.hit_tokens.get_value() - h0 >= 4, \
+            "warm run did not actually hit the cached prefix"
+    finally:
+        eng.close()
+        store.clear()
+        store.close()
+
+
+def test_transformer_runner_mixed_slots_match_solo_runs():
+    """Continuous batching: two different prompts decoding in the SAME
+    fixed-shape step must each produce exactly their solo streams
+    (slot interference would show up instantly)."""
+    store = make_store_for(CFG, page_tokens=4, max_blocks=16,
+                           name="t_mr_mix_kv")
+    runner = TransformerRunner(PARAMS, CFG, store=store, name="t_mr_mix")
+    eng = DecodeEngine(runner=runner, num_slots=2, store=store,
+                       max_pages_per_slot=24, prefill_buckets=(8, 16),
+                       name="t_mr_mix_eng")
+    try:
+        pa, pb = [5, 17, 42, 9, 77, 3], [88, 12, 54]
+        ra, rb = {}, {}
+        eva, evb = threading.Event(), threading.Event()
+        ra["t"], rb["t"] = [], []
+        eng.submit(pa, 5, ra["t"].append, lambda e: eva.set())
+        eng.submit(pb, 5, rb["t"].append, lambda e: evb.set())
+        assert eva.wait(120) and evb.wait(120)
+        assert ra["t"] == dense_generate(PARAMS, CFG, pa, 5)
+        assert rb["t"] == dense_generate(PARAMS, CFG, pb, 5)
+    finally:
+        eng.close()
+        store.clear()
+        store.close()
+
+
+class _GenCollector(brpc.StreamHandler):
+    def __init__(self):
+        self.msgs = []
+        self.done = threading.Event()
+
+    def on_received_messages(self, stream, messages):
+        for m in messages:
+            d = json.loads(m)
+            self.msgs.append(d)
+            if d.get("done"):
+                self.done.set()
+
+    def on_closed(self, stream):
+        self.done.set()
+
+
+def test_serving_generate_real_runner_prefill_skip_identical_tokens():
+    """The acceptance path: a real transformer ModelRunner behind
+    Serving.Generate — the SECOND call reports a prefix hit and
+    streams exactly the first call's tokens."""
+    from brpc_tpu.serving.service import register_serving
+    store = make_store_for(CFG, page_tokens=4, max_blocks=16,
+                           name="t_mr_rpc_kv")
+    runner = TransformerRunner(PARAMS, CFG, store=store, name="t_mr_rpc")
+    eng = DecodeEngine(runner=runner, num_slots=2, store=store,
+                       max_pages_per_slot=24, prefill_buckets=(8, 16),
+                       name="t_mr_rpc_eng")
+    s = brpc.Server()
+    register_serving(s, engine=eng)
+    s.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=10_000)
+
+        def call(prompt, n):
+            col = _GenCollector()
+            cntl = brpc.Controller()
+            brpc.stream_create(cntl, col)
+            resp = ch.call_sync("Serving", "Generate",
+                                {"prompt": prompt, "max_new_tokens": n},
+                                serializer="json", cntl=cntl)
+            assert resp["accepted"] is True
+            assert col.done.wait(120)
+            return ([m["token"] for m in col.msgs if "token" in m],
+                    resp["prefix_hit"])
+
+        prompt = [11, 29, 63, 2, 90, 41]
+        cold, hit0 = call(prompt, 5)
+        assert hit0 == 0
+        assert cold == dense_generate(PARAMS, CFG, prompt, 5)
+        warm, hit1 = call(prompt, 5)
+        assert hit1 > 0, "no advisory prefix hit on the warm call"
+        assert warm == cold, \
+            "prefix reuse changed Serving.Generate output"
+    finally:
+        s.stop()
+        s.join()
+        eng.close()
+        store.clear()
+        store.close()
+
+
+def test_commit_live_store_warm_tokens_still_identical():
+    """Regression (review finding): with commit_live_pages=True (the
+    StandbySync pairing) the per-layer prefill must NOT live-commit
+    half-materialized pages — before the write_kv(final=) contract,
+    layer 0's pass committed pages whose upper layers were zeros, the
+    layer-1 rewrite COW'd away from them, and every warm admit then
+    attended over garbage."""
+    store = make_store_for(CFG, page_tokens=4, max_blocks=16,
+                           commit_live_pages=True, name="t_mr_live_kv")
+    runner = TransformerRunner(PARAMS, CFG, store=store,
+                               name="t_mr_live")
+    eng = DecodeEngine(runner=runner, num_slots=2, store=store,
+                       max_pages_per_slot=24, prefill_buckets=(8, 16),
+                       name="t_mr_live_eng")
+    try:
+        prompt = [31, 7, 64, 20, 95, 48]
+        cold = _gen(eng, prompt, 5)
+        assert cold == dense_generate(PARAMS, CFG, prompt, 5)
+        warm = _gen(eng, prompt, 5)
+        assert warm == cold, \
+            "live-committed pages served half-materialized KV"
+    finally:
+        eng.close()
+        store.clear()
+        store.close()
+
+
+def test_batcher_scores_through_runner_dense_path():
+    """DynamicBatcher accepts a ModelRunner as its batch_fn: rows are
+    int token prompts, the scatter returns each row's per-position
+    greedy next-token ids (trimmed to the raw length), matching the
+    dense forward directly."""
+    runner = TransformerRunner(PARAMS, CFG, name="t_mr_score")
+    b = DynamicBatcher(runner, max_batch_size=4, max_delay_us=500,
+                       length_buckets=(8, 16), dtype=np.int32,
+                       name="t_mr_score_b")
+    try:
+        prompt = np.array([5, 17, 42, 9], np.int32)
+        got = b.submit_wait(prompt, timeout_s=120)
+        import jax.numpy as jnp
+        logits = dense_forward(
+            PARAMS, CFG, jnp.asarray(prompt[None]),
+            jnp.arange(4, dtype=jnp.int32)[None])
+        ref = np.asarray(jnp.argmax(logits, axis=-1))[0]
+        np.testing.assert_array_equal(np.asarray(got, np.int64),
+                                      ref.astype(np.int64))
+    finally:
+        b.close()
+
+
+def test_run_prefill_materializes_real_kv_for_disagg():
+    """The disagg PrefillReplica path: run_prefill against an admitted
+    seq materializes the WHOLE prompt's K/V (kv_filled), so
+    retire-commit caches pages a decode peer can prefix-hit."""
+    store = make_store_for(CFG, page_tokens=4, max_blocks=16,
+                           name="t_mr_disagg_kv")
+    runner = TransformerRunner(PARAMS, CFG, store=store,
+                               name="t_mr_disagg")
+    try:
+        prompt = [61, 5, 33, 70, 8, 24, 19, 2]   # 2 full pages
+        seq = store.admit(prompt)
+        n = run_prefill(runner, seq, prompt)
+        assert n == len(prompt)
+        assert seq.kv_filled == len(prompt)
+        store.retire(seq, cache=True)
+        assert store.probe(prompt + [1]) == 8
+    finally:
+        store.clear()
+        store.close()
+
+
+def test_sharded_params_produce_identical_tokens():
+    """place_runner_params over a tp mesh (1-device on CPU — the
+    degenerate case of the SNIPPETS pjit pattern) changes placement,
+    not math."""
+    mesh = make_tp_mesh(1)
+    sharded = place_runner_params(PARAMS, mesh)
+    store = make_store_for(CFG, page_tokens=4, max_blocks=16,
+                           name="t_mr_tp_kv")
+    runner = TransformerRunner(sharded, CFG, store=store, name="t_mr_tp")
+    eng = DecodeEngine(runner=runner, num_slots=2, store=store,
+                       max_pages_per_slot=24, prefill_buckets=(8, 16),
+                       name="t_mr_tp_eng")
+    try:
+        prompt = [5, 17, 42, 9, 77, 3]
+        assert _gen(eng, prompt, 4) == dense_generate(PARAMS, CFG,
+                                                      prompt, 4)
+    finally:
+        eng.close()
+        store.clear()
+        store.close()

@@ -1,0 +1,354 @@
+"""Paged-attention equivalence suite (ISSUE 10).
+
+The kernel (both backends: the pure-jax gather and the pallas
+scalar-prefetch kernel in interpret mode) must be bit-exact — allclose
+atol=1e-5 — against a dense reference assembled from the SAME K/V:
+
+  * at exact-page-multiple lengths (the page-boundary case),
+  * at mid-page lengths (partial final page),
+  * across mixed per-row lengths in one fixed-shape call,
+  * over K/V living in REAL KVCacheStore pages — including after a
+    copy-on-write fork diverges two sequences sharing a tail page, and
+    after radix-evict/re-admit churns which physical pages hold the
+    prefix.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from brpc_tpu.ops.attention import local_attention
+from brpc_tpu.ops.paged_attention import (arena_kv_view, paged_attention,
+                                          paged_attention_gather,
+                                          paged_attention_pallas)
+
+jax.config.update("jax_platforms", "cpu")
+
+BACKENDS = ("gather", "pallas")
+
+
+def _run(backend, q, kp, vp, tables, lengths, ek=None, ev=None):
+    args = [jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lengths)]
+    extra = [None if ek is None else jnp.asarray(ek),
+             None if ev is None else jnp.asarray(ev)]
+    if backend == "gather":
+        return np.asarray(paged_attention_gather(*args, *extra))
+    return np.asarray(paged_attention_pallas(*args, *extra,
+                                             interpret=True))
+
+
+def _dense_row(q_row, kp, vp, table, length, ek=None, ev=None):
+    """Dense oracle for ONE row: flatten the row's pages in table
+    order, truncate to `length` keys, optionally append the self key,
+    full softmax attention via ops/attention.local_attention."""
+    t = kp.shape[1]
+    ids = [int(x) for x in table if x >= 0]
+    k = kp[ids].reshape(-1, kp.shape[2], kp.shape[3])[:length]
+    v = vp[ids].reshape(-1, vp.shape[2], vp.shape[3])[:length]
+    if ek is not None:
+        k = np.concatenate([k, ek[None]])
+        v = np.concatenate([v, ev[None]])
+    o = local_attention(jnp.asarray(q_row[None, None]),
+                        jnp.asarray(k[None]), jnp.asarray(v[None]))
+    return np.asarray(o)[0, 0]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_kernel_matches_dense_across_lengths_and_gqa(backend):
+    """Page-boundary, mid-page and mixed lengths in ONE fixed-shape
+    call; K/V heads grouped (GQA) under 4 query heads."""
+    rng = np.random.default_rng(7)
+    P, T, Hkv, D, H, MP = 12, 4, 2, 8, 4, 6
+    kp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    vp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    q = rng.standard_normal((4, H, D)).astype(np.float32)
+    tables = np.full((4, MP), -1, np.int32)
+    tables[0, :2] = [3, 7]          # exactly 2 full pages
+    tables[1, :3] = [1, 0, 9]       # mid-page (10 of 12 slots)
+    tables[2, :6] = [11, 2, 4, 5, 6, 8]   # long row
+    tables[3, :1] = [10]            # single partial page
+    lengths = np.array([8, 10, 23, 1], np.int32)
+    out = _run(backend, q, kp, vp, tables, lengths)
+    for i in range(4):
+        ref = _dense_row(q[i], kp, vp, tables[i], lengths[i])
+        np.testing.assert_allclose(out[i], ref, atol=1e-5,
+                                   err_msg=f"row {i}")
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_kernel_self_key_merge_matches_dense(backend):
+    """The decode path's in-flight self key folds into the same
+    softmax as the paged keys (including rows with ZERO paged keys —
+    a fresh slot's first step attends only to itself)."""
+    rng = np.random.default_rng(11)
+    P, T, Hkv, D, H, MP = 6, 4, 2, 8, 4, 3
+    kp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    vp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    q = rng.standard_normal((3, H, D)).astype(np.float32)
+    ek = rng.standard_normal((3, Hkv, D)).astype(np.float32)
+    ev = rng.standard_normal((3, Hkv, D)).astype(np.float32)
+    tables = np.full((3, MP), -1, np.int32)
+    tables[0, :2] = [0, 1]
+    tables[1, :1] = [5]
+    lengths = np.array([7, 2, 0], np.int32)   # row 2: self key only
+    out = _run(backend, q, kp, vp, tables, lengths, ek, ev)
+    for i in range(3):
+        ref = _dense_row(q[i], kp, vp, tables[i], lengths[i],
+                         ek[i], ev[i])
+        np.testing.assert_allclose(out[i], ref, atol=1e-5,
+                                   err_msg=f"row {i}")
+
+
+def test_gather_masks_dead_table_entries_like_pallas():
+    """A -1 table entry NOT covered by the length cut (a page freed
+    between the engine's gather and the kernel call) must be excluded
+    by BOTH backends — the gather path clips -1 to page 0 for the take
+    and must mask it back out, or the two 'bit-equal' backends
+    diverge."""
+    rng = np.random.default_rng(13)
+    P, T, Hkv, D, H, MP = 5, 4, 2, 8, 4, 3
+    kp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    vp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    q = rng.standard_normal((1, H, D)).astype(np.float32)
+    tables = np.array([[2, -1, 4]], np.int32)    # dead entry MID-table
+    lengths = np.array([12, ], np.int32)         # covers all 3 pages
+    g = _run("gather", q, kp, vp, tables, lengths)
+    pw = _run("pallas", q, kp, vp, tables, lengths)
+    # oracle: pages 2 and 4 only — the dead middle page contributes
+    # nothing (its 4 key positions are simply absent)
+    k = np.concatenate([kp[2], kp[4]])
+    v = np.concatenate([vp[2], vp[4]])
+    o = local_attention(jnp.asarray(q[0][None, None]),
+                        jnp.asarray(k[None]), jnp.asarray(v[None]))
+    ref = np.asarray(o)[0, 0]
+    np.testing.assert_allclose(g[0], ref, atol=1e-5)
+    np.testing.assert_allclose(pw[0], ref, atol=1e-5)
+
+
+def test_write_kv_final_false_defers_materialization():
+    """The multi-pass writer contract (the runner's per-layer
+    prefill): final=False passes splice bytes but advance NEITHER
+    kv_filled nor the live commit — a half-written slot (upper layers
+    still zero) can never be published as cacheable KV."""
+    from brpc_tpu.models.runner import TransformerConfig, make_store_for
+    cfg = TransformerConfig(n_layers=2, n_kv_heads=2, head_dim=8,
+                            n_heads=4)
+    store = make_store_for(cfg, page_tokens=4, max_blocks=8,
+                           commit_live_pages=True, name="t_pa_final")
+    try:
+        prompt = list(range(10, 18))            # 2 full pages
+        seq = store.admit(prompt)
+        rows = np.ones((8, cfg.kv_bytes_per_token), np.uint8)
+        store.write_kv(seq, 0, rows, final=False)   # layer-0 pass
+        assert seq.kv_filled == 0
+        assert store.probe(prompt + [1]) == 0, \
+            "half-materialized pages live-committed to the radix tree"
+        store.write_kv(seq, 0, rows)                # final pass
+        assert seq.kv_filled == 8
+        assert store.probe(prompt + [1]) == 8       # live commit ran
+        store.retire(seq, cache=False)
+    finally:
+        store.clear()
+        store.close()
+
+
+def test_zero_length_rows_yield_zeros_never_nan():
+    q = np.ones((2, 4, 8), np.float32)
+    kp = np.zeros((2, 4, 2, 8), np.float32)
+    vp = np.zeros((2, 4, 2, 8), np.float32)
+    tables = np.full((2, 3), -1, np.int32)
+    for backend in BACKENDS:
+        out = _run(backend, q, kp, vp, tables,
+                   np.zeros((2,), np.int32))
+        assert not np.any(np.isnan(out))
+        np.testing.assert_array_equal(out, 0)
+
+
+# ---------------------------------------------------------------------------
+# over REAL store pages: COW forks and radix evict/re-admit
+# ---------------------------------------------------------------------------
+
+def _mk_cfg_store(name, page_tokens=4, max_blocks=8):
+    from brpc_tpu.models.runner import TransformerConfig, make_store_for
+    cfg = TransformerConfig(n_layers=1, n_kv_heads=2, head_dim=8,
+                            n_heads=4)
+    store = make_store_for(cfg, page_tokens=page_tokens,
+                           max_blocks=max_blocks, name=name)
+    return cfg, store
+
+
+def _rows_for(rng, cfg, n):
+    """n random packed K/V slot payloads + their float views."""
+    f = rng.standard_normal(
+        (n, cfg.n_layers, 2, cfg.n_kv_heads, cfg.head_dim)
+    ).astype(np.float32)
+    return f, f.reshape(n, -1).view(np.uint8)
+
+
+def _attend_seq(store, cfg, seq, q, length, backend="gather"):
+    """Paged attention for one seq through the REAL arena + flat
+    tables (layer 0)."""
+    arena = store.pagepool.arena()
+    kv = arena_kv_view(arena, store.page_tokens, cfg.n_layers,
+                       cfg.n_kv_heads, cfg.head_dim)
+    flat = store.pagepool.flat_ids(seq.page_ids())
+    tables = np.full((1, 8), -1, np.int32)
+    tables[0, :len(flat)] = flat
+    out = paged_attention(jnp.asarray(q[None]), kv[:, :, 0, 0],
+                          kv[:, :, 0, 1], jnp.asarray(tables),
+                          jnp.asarray(np.array([length], np.int32)),
+                          backend=backend,
+                          interpret=True if backend == "pallas"
+                          else None)
+    return np.asarray(out)[0]
+
+
+def _dense_from(f_rows, q, length):
+    k = f_rows[:length, 0, 0]       # [n, Hkv, D]
+    v = f_rows[:length, 0, 1]
+    o = local_attention(jnp.asarray(q[None, None]),
+                        jnp.asarray(k[None]), jnp.asarray(v[None]))
+    return np.asarray(o)[0, 0]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_store_pages_cow_fork_isolates_and_matches_dense(backend):
+    """K/V written through KVCacheStore.write_kv reads back through
+    the arena bit-exact; a COW fork's divergent tail page never
+    perturbs the parent's attention."""
+    rng = np.random.default_rng(23)
+    cfg, store = _mk_cfg_store(f"t_pa_cow_{backend}")
+    try:
+        prompt = list(range(100, 110))          # 10 tokens, 2.5 pages
+        seq = store.admit(prompt)
+        f, rows = _rows_for(rng, cfg, len(prompt))
+        store.write_kv(seq, 0, rows)
+        q = rng.standard_normal((cfg.n_heads, cfg.head_dim)) \
+            .astype(np.float32)
+        np.testing.assert_allclose(
+            _attend_seq(store, cfg, seq, q, 10, backend),
+            _dense_from(f, q, 10), atol=1e-5)
+
+        # fork shares every page; extend + write_kv on the child COWs
+        # the tail page, so the two sequences diverge at position 10
+        child = store.fork(seq)
+        store.extend(child, 999)
+        fc, rc = _rows_for(rng, cfg, 1)
+        store.write_kv(child, 10, rc)
+        cow0 = store.cow.get_value()
+        assert cow0 >= 1, "divergent tail write did not COW"
+        assert child.pages[-1].pid != seq.pages[-1].pid
+        # parent: bit-identical to before the fork
+        np.testing.assert_allclose(
+            _attend_seq(store, cfg, seq, q, 10, backend),
+            _dense_from(f, q, 10), atol=1e-5)
+        # child: parent's 10 rows + its own divergent row
+        fboth = np.concatenate([f, fc])
+        np.testing.assert_allclose(
+            _attend_seq(store, cfg, child, q, 11, backend),
+            _dense_from(fboth, q, 11), atol=1e-5)
+        store.retire(child, cache=False)
+        store.retire(seq, cache=False)
+    finally:
+        store.clear()
+        store.close()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_store_pages_radix_evict_readmit(backend):
+    """A retired-cached prefix prefix-hits on re-admit and attends
+    bit-exact through the SHARED pages; after a forced radix evict the
+    re-admit misses, rewrites fresh pages (different pids, possibly a
+    different arena layout), and attention still matches the oracle."""
+    rng = np.random.default_rng(31)
+    cfg, store = _mk_cfg_store(f"t_pa_evict_{backend}")
+    try:
+        prompt = list(range(50, 58))            # exactly 2 full pages
+        seq = store.admit(prompt)
+        f, rows = _rows_for(rng, cfg, len(prompt))
+        store.write_kv(seq, 0, rows)
+        assert seq.kv_filled == 8
+        store.retire(seq, cache=True)
+
+        q = rng.standard_normal((cfg.n_heads, cfg.head_dim)) \
+            .astype(np.float32)
+        # re-admit: page-granular prefix hit (capped one token short)
+        seq2 = store.admit(prompt + [1234])
+        assert seq2.prefix_hit_tokens == 8
+        store.write_kv(seq2, 8, _rows_for(rng, cfg, 1)[1])
+        np.testing.assert_allclose(
+            _attend_seq(store, cfg, seq2, q, 8, backend),
+            _dense_from(f, q, 8), atol=1e-5)
+        store.retire(seq2, cache=False)
+
+        # evict everything; the next admit must MISS and recompute
+        assert store.clear() > 0
+        seq3 = store.admit(prompt + [1234])
+        assert seq3.prefix_hit_tokens == 0
+        f3, rows3 = _rows_for(rng, cfg, 9)
+        store.write_kv(seq3, 0, rows3)
+        np.testing.assert_allclose(
+            _attend_seq(store, cfg, seq3, q, 9, backend),
+            _dense_from(f3, q, 9), atol=1e-5)
+        store.retire(seq3, cache=False)
+    finally:
+        store.clear()
+        store.close()
+
+
+def test_vector_store_caps_caching_at_materialized_boundary():
+    """The kv_filled cursor: a vector-mode page whose tail slot never
+    materialized must NOT be cached — re-admitting would otherwise
+    serve garbage KV as a valid prefix."""
+    rng = np.random.default_rng(41)
+    cfg, store = _mk_cfg_store("t_pa_kvfill")
+    try:
+        prompt = list(range(70, 78))            # 2 full pages
+        seq = store.admit(prompt)
+        _, rows = _rows_for(rng, cfg, 7)
+        store.write_kv(seq, 0, rows)            # one slot short
+        assert seq.kv_filled == 7
+        store.retire(seq, cache=True)
+        # only the fully-materialized first page may be cached
+        probe = store.probe(prompt + [1])
+        assert probe == 4, f"cached {probe} tokens, 1 page materialized"
+    finally:
+        store.clear()
+        store.close()
+
+
+def test_arena_rows_stable_across_block_churn():
+    """A page's flat arena index never changes while it is live, and a
+    released block's row is recycled for the next lease — the layout
+    contract the compiled kernel depends on."""
+    from brpc_tpu.kvcache.pages import PagePool
+    # page_bytes == the 8KB block class -> one page per block, so each
+    # alloc leases a fresh block and unref churns whole blocks
+    pool = PagePool(page_bytes=8192, page_tokens=4, max_blocks=4,
+                    name="t_pa_rows")
+    assert pool.pages_per_block == 1
+    a = pool.alloc_page()
+    b = pool.alloc_page()
+    fa = pool.flat_ids([a.pid])[0]
+    fb = pool.flat_ids([b.pid])[0]
+    assert fa != fb
+    pool.write_slots(a, 0, np.full((1, 2048), 7, np.uint8))
+    arena = np.asarray(pool.arena())
+    assert arena.shape == (4, 8192)
+    assert arena[fa, 0] == 7
+    # release b's BLOCK; a's flat index must not move, and the freed
+    # row recycles for the next lease
+    pool.unref(b)
+    assert pool.flat_ids([a.pid])[0] == fa
+    assert pool.flat_ids([b.pid]) == [-1]
+    c = pool.alloc_page()
+    assert pool.flat_ids([c.pid])[0] == fb, "freed row not recycled"
+    # an unleased row reads as zeros, not stale bytes
+    arena = np.asarray(pool.arena())
+    assert arena[fa, 0] == 7
+    pool.unref(c)
+    pool.unref(a)
+    assert pool.blocks_leased() == 0
+    assert pool.flat_ids([a.pid]) == [-1]
