@@ -113,6 +113,15 @@ model: $(LIB) $(PYEXT)
 	    tests/test_model_runner.py -q
 	JAX_PLATFORMS=cpu python bench.py model
 
+# Speculative decoding (README "Speculative decoding", ISSUE 11): the
+# identity suite (spec output == plain greedy at depths 2/4/8 — cold,
+# warm, mixed slots, draft trees, through Serving.Generate), the
+# draft-lease/fork lifecycle units, then the timed plain-vs-spec
+# tokens/s rung (3-trial interleaved median+spread, feeds perf_diff).
+speculative: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q
+	JAX_PLATFORMS=cpu python bench.py speculative
+
 # Tracing suite (README "Observability"): rpcz generation tracing —
 # per-trace head sampling, span-tree timelines, TTFT/ITL math, trace
 # continuity across crash recovery, DCN span joins, console pages.
@@ -197,4 +206,5 @@ stress:
 	./build/stress_plain
 
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
-    cluster model trace hotspots microbench perf bench tsan asan stress
+    cluster model speculative trace hotspots microbench perf bench \
+    tsan asan stress

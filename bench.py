@@ -2235,6 +2235,169 @@ def model_main(argv) -> None:
     print(json.dumps(out))
 
 
+def bench_speculative(depths=(2, 4, 8), n_requests=4, prompt_tokens=16,
+                      gen_tokens=48, trials=3):
+    """Speculative-decoding rung (ISSUE 11): tokens/s of the
+    TransformerRunner engine PLAIN vs SPECULATIVE at draft depths
+    2/4/8, on the same store/engine machinery.
+
+    Operating point: the draft is the host-side NGramProposer (prompt
+    lookup) — draft cost ≪ target cost, the regime the ISSUE names —
+    and the workload decodes long enough (``gen_tokens``) that the
+    target's greedy output becomes self-repeating, so drafts actually
+    accept (``accept_rate`` is published per depth; a rung whose
+    drafts never accepted would be measuring nothing).  Per depth:
+    tokens/s 3-trial median+spread (perf_diff gates the series) plus
+    accept_rate / tokens_per_step medians and the ISSUE acceptance
+    probe ``spec_beats_plain_beyond_spread`` (spread intervals
+    disjoint in the faster direction at >=1 depth).
+
+    CPU-valid by construction — the gather backend of the paged
+    kernel; the full bench shells out here exactly like the
+    microbench/migrate/model rungs."""
+    from brpc_tpu.models.runner import (TransformerConfig,
+                                        TransformerRunner,
+                                        init_runner_params,
+                                        make_store_for)
+    from brpc_tpu.serving import DecodeEngine, NGramProposer
+    from brpc_tpu.serving.engine import SPEC_ACCEPTED, SPEC_PROPOSED
+
+    cfg = TransformerConfig()
+    params = init_runner_params(cfg)
+    pt = 8
+    buckets = (16, 32)
+
+    def prompts(k):
+        return [[(100 + k * 131 + i * 37 + j) % 997
+                 for j in range(prompt_tokens)]
+                for i in range(n_requests)]
+
+    def wave(eng, ps, n):
+        evs = []
+        errs: list = []
+        for p in ps:
+            ev = threading.Event()
+            evs.append(ev)
+            eng.submit(p, n, lambda t: None,
+                       lambda e, ev=ev: (errs.append(e) if e is not None
+                                         else None, ev.set()))
+        for ev in evs:
+            if not ev.wait(600):
+                raise RuntimeError("speculative bench wave hung")
+        if errs:
+            # a failed generation must fail the TRIAL: counting its
+            # full token budget over a shortened wall time would
+            # inflate the series the acceptance gate reads
+            raise RuntimeError(f"speculative bench wave errored: "
+                               f"{errs[0]}")
+
+    def one_trial(depth, k):
+        tag = f"bench_spec_d{depth}_{k}"
+        store = make_store_for(cfg, page_tokens=pt, max_blocks=64,
+                               name=f"{tag}_kv")
+        runner = TransformerRunner(params, cfg, store=store,
+                                   name=f"{tag}_m")
+        kw = {}
+        if depth:
+            kw = dict(draft_runner=NGramProposer(), draft_len=depth)
+        eng = DecodeEngine(runner=runner, num_slots=n_requests,
+                           store=store, max_pages_per_slot=24,
+                           prefill_buckets=buckets, name=f"{tag}_e",
+                           **kw)
+        try:
+            # full-length warm wave: the splice/verify jit shapes vary
+            # with ACCEPT DEPTH (a kept-k commit splices k+1 rows), so
+            # a short warm leaves compiles to fall inside the timing
+            wave(eng, prompts(k), gen_tokens)
+            a0, p0 = SPEC_ACCEPTED.get_value(), SPEC_PROPOSED.get_value()
+            s0 = eng.steps.get_value()
+            t0 = time.monotonic()
+            wave(eng, prompts(k), gen_tokens)
+            dt = time.monotonic() - t0
+            da = SPEC_ACCEPTED.get_value() - a0
+            dp = SPEC_PROPOSED.get_value() - p0
+            ds = eng.steps.get_value() - s0
+            # tokens_per_step is PER SLOT (emitted tokens per verify
+            # iteration of one generation): the number the per-
+            # generation span annotation carries, comparable across
+            # slot counts
+            return (n_requests * gen_tokens / dt,
+                    da / dp if dp else 0.0,
+                    gen_tokens / ds if ds else 0.0)
+        finally:
+            eng.close()
+            store.clear()
+            store.close()
+
+    # trials INTERLEAVE across configs (round-robin plain/depths) so
+    # load drift lands on every series instead of skewing whichever
+    # config happened to run during the spike; one UNRECORDED warm
+    # trial per config first retires every process-wide one-off
+    # (arena growth, first-shape compiles) outside the measurement
+    raw: dict = {0: []}
+    for d in depths:
+        raw[d] = []
+    for d in raw:
+        one_trial(d, 0)
+    for k in range(trials):
+        for d in raw:
+            raw[d].append(one_trial(d, k))
+
+    def series(depth):
+        rs = raw[depth]
+        tps = sorted(r[0] for r in rs)
+        acc = sorted(r[1] for r in rs)
+        tpstep = sorted(r[2] for r in rs)
+        return {
+            "tokens_per_s": round(tps[len(tps) // 2], 1),
+            "tokens_per_s_spread": [round(tps[0], 1),
+                                    round(tps[-1], 1)],
+            "accept_rate": round(acc[len(acc) // 2], 4),
+            "tokens_per_step": round(tpstep[len(tpstep) // 2], 2),
+            "trials": trials,
+        }
+
+    out = {"plain": series(0)}
+    plain_hi = out["plain"]["tokens_per_s_spread"][1]
+    any_beyond = False
+    for d in depths:
+        s = series(d)
+        s["speedup_vs_plain"] = round(
+            s["tokens_per_s"] / out["plain"]["tokens_per_s"], 3) \
+            if out["plain"]["tokens_per_s"] else None
+        s["beats_plain_beyond_spread"] = \
+            s["tokens_per_s_spread"][0] > plain_hi
+        any_beyond = any_beyond or s["beats_plain_beyond_spread"]
+        out[f"depth{d}"] = s
+    out["spec_beats_plain_beyond_spread"] = any_beyond
+    out["cpu_valid"] = True
+    out["config"] = {"prompt_tokens": prompt_tokens,
+                     "gen_tokens": gen_tokens,
+                     "n_requests": n_requests, "draft": "ngram"}
+    out["note"] = ("speculative decoding rung (ISSUE 11): plain vs "
+                   "draft-tree verify tokens/s at depths 2/4/8 with a "
+                   "host-side ngram draft (draft cost << target cost); "
+                   "accept_rate/tokens_per_step medians ride along; "
+                   "the acceptance gate is beyond-spread faster at "
+                   ">=1 depth")
+    return out
+
+
+def speculative_main(argv) -> None:
+    """`python bench.py speculative`: run ONLY the speculative-decoding
+    rung and print one JSON object on stdout (progress on stderr) —
+    the `make speculative` bench entry and the subprocess the full
+    bench run shells out to."""
+    log("speculative: plain vs draft-verify tokens/s rung...")
+    out = bench_speculative()
+    for k, v in out.items():
+        if isinstance(v, dict):
+            log(f"  {k}: {json.dumps(v)}")
+        else:
+            log(f"  {k}: {v}")
+    print(json.dumps(out))
+
+
 def _floor_spread(med, lo, hi, pad):
     """Widen a published [lo, hi] spread to at least ±``pad`` around
     the median (ISSUE 9 deflake): a deterministic workload's few-trial
@@ -2617,6 +2780,12 @@ def main():
     except Exception as e:
         details["model"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['model']}")
+    log("bench: speculative decoding (subprocess, forced CPU)...")
+    try:
+        details["speculative"] = _run_cpu_subcommand("speculative")
+    except Exception as e:
+        details["speculative"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['speculative']}")
     log("bench: probing device reachability...")
     device_ok, skip_kind, device_err = _probe_device()
     if not device_ok:
@@ -2745,5 +2914,7 @@ if __name__ == "__main__":
         cluster_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "model":
         model_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "speculative":
+        speculative_main(sys.argv[2:])
     else:
         main()

@@ -69,7 +69,7 @@ _NATIVE_LEAF_PREFIXES = ("brpc_tpu/_core/", "brpc_tpu/native_path")
 # native calls issued directly from hot-path frames (the engine's
 # batched token push runs the foreign call from its own frame)
 _NATIVE_MARKERS = frozenset([
-    ("engine", "_push_tokens"),
+    ("engine", "_push_token_runs"),
 ])
 # binding-layer call sites that deliberately HOLD the GIL (the
 # _fastrpc fast entries: a per-token ctypes GIL drop/reacquire costs

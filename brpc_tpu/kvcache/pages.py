@@ -126,6 +126,7 @@ class PagePool:
         self.page_frees = Adder()
         self.block_leases = Adder()
         self.block_releases = Adder()
+        self.batch_splices = Adder()
 
     @staticmethod
     def _bkey(block) -> tuple:
@@ -246,6 +247,44 @@ class PagePool:
         self._splice(page.block, rows.reshape(-1),
                      self._offset(page, slot))
 
+    def write_slots_batch(self, runs) -> None:
+        """Splice MANY per-token vector runs as ONE batch (ISSUE 11 —
+        the decode-side write primitive): ``runs`` is a sequence of
+        ``(page, slot, rows)`` triples with the :meth:`write_slots`
+        shapes.  The whole batch ships host-to-device in ONE
+        ``device_put`` of the concatenated payload and splices under
+        ONE ``_io_mu`` acquisition — a verify-commit (or a plain decode
+        step) pays one call across every slot instead of a lock +
+        transfer round-trip per slot.  Runs are validated up front; a
+        bad run fails the whole batch before any byte lands."""
+        import jax
+        staged = []
+        for page, slot, rows in runs:
+            rows = np.ascontiguousarray(rows, np.uint8)
+            if rows.ndim != 2 or rows.shape[1] != self.kv_bytes_per_token:
+                raise ValueError(
+                    f"write_slots_batch rows must be "
+                    f"[n, {self.kv_bytes_per_token}] uint8, "
+                    f"got {rows.shape}")
+            n = rows.shape[0]
+            if slot < 0 or slot + n > self.page_tokens:
+                raise ValueError(
+                    f"write_slots_batch [{slot},{slot + n}) exceeds "
+                    f"page_tokens={self.page_tokens}")
+            staged.append((page, slot, rows))
+        if not staged:
+            return
+        payload = np.concatenate([r.reshape(-1) for _, _, r in staged])
+        dev = jax.device_put(payload, self.pool.device)
+        self.batch_splices.add(1)
+        off = 0
+        with self._io_mu:
+            for page, slot, rows in staged:
+                nb = rows.size
+                self._splice_locked(page.block, dev[off:off + nb],
+                                    self._offset(page, slot))
+                off += nb
+
     def flat_ids(self, pids) -> list:
         """Translate page ids (the engine's gathered page tables) into
         FLAT ARENA indices for :meth:`arena`; -1 (padding) and dead
@@ -343,17 +382,20 @@ class PagePool:
         it, concurrent splices into sibling pages of one block would
         silently drop one write."""
         import jax
-
-        from brpc_tpu.ici.block_pool import _splice_bytes
         if not isinstance(piece, jax.Array):
             piece = jax.device_put(np.ascontiguousarray(piece),
                                    self.pool.device)
         with self._io_mu:
-            with self.pool._lock:
-                buf = self.pool._slots[block.size_class][block.slot]
-            out = _splice_bytes(buf, piece, off)
-            with self.pool._lock:
-                self.pool._slots[block.size_class][block.slot] = out
+            self._splice_locked(block, piece, off)
+
+    def _splice_locked(self, block, piece, off: int) -> None:
+        """One read-modify-write splice; caller holds ``_io_mu``."""
+        from brpc_tpu.ici.block_pool import _splice_bytes
+        with self.pool._lock:
+            buf = self.pool._slots[block.size_class][block.slot]
+        out = _splice_bytes(buf, piece, off)
+        with self.pool._lock:
+            self.pool._slots[block.size_class][block.slot] = out
 
     # ---- introspection / invariants ----
 
@@ -399,6 +441,7 @@ class PagePool:
                 "pages_free": total - in_use,
                 "page_allocs": self.page_allocs.get_value(),
                 "page_frees": self.page_frees.get_value(),
+                "batch_splices": self.batch_splices.get_value(),
                 "block_leases": self.block_leases.get_value(),
                 "block_releases": self.block_releases.get_value(),
             }

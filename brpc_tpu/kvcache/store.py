@@ -17,6 +17,26 @@ leased HBM blocks) and :class:`~brpc_tpu.kvcache.radix.RadixTree`
                     (future admits hit them), every seq ref drops, and
                     idle blocks return to the BlockPool.
 
+Draft leases (ISSUE 11 — speculative decoding): the engine's
+propose->verify->commit loop appends DRAFT tokens it may throw away:
+
+  speculate(seq, toks) -> append draft tokens WITHOUT materializing
+                    (``kv_filled`` does not advance, nothing
+                    live-commits — the radix tree can never serve an
+                    unverified draft);
+  rollback(seq, n)  -> truncate back to `n` tokens, releasing the
+                    rejected tail's pages to the pool (never below the
+                    materialized prefix);
+  commit_draft(seq, n) -> accept: materialization advances over the
+                    verified prefix (vector-KV callers advance it via
+                    ``write_kv_batch`` instead — splicing the verified
+                    rows IS the commit).
+
+Tree-shaped drafts put side branches on ``fork``: the fork shares the
+base pages, its first speculate copies-on-write the shared tail, and a
+rejected branch retires — refcounts return to baseline by the same
+discipline every other holder uses.
+
 Pool pressure: when the page pool is exhausted the store evicts
 LRU-by-leaf from the radix tree and retries once — eviction can only
 free pages nothing else references, so exhaustion under load degrades
@@ -177,6 +197,8 @@ class KVCacheStore:
         self.admitted = Adder(f"kvcache_{safe}_admitted")
         self.retired = Adder(f"kvcache_{safe}_retired")
         self.forks = Adder(f"kvcache_{safe}_forks")
+        self.speculated = Adder(f"kvcache_{safe}_speculated_tokens")
+        self.rolled_back = Adder(f"kvcache_{safe}_rolled_back_pages")
         self.detached = Adder(f"kvcache_{safe}_detached")
         self.imported = Adder(f"kvcache_{safe}_imported_pages")
         PassiveStatus(self.hit_rate).expose(f"kvcache_{safe}_hit_rate")
@@ -271,45 +293,86 @@ class KVCacheStore:
         slot (upper layers still zero) could be committed to the radix
         tree / pinned by a detach and served to a future admit as
         valid KV."""
-        rows = np.ascontiguousarray(rows, dtype=np.uint8)
-        n = rows.shape[0]
+        failures = self.write_kv_batch([(seq, pos, rows)], final=final)
+        if failures:
+            raise failures[0][1]
+
+    def write_kv_batch(self, writes, *, final: bool = True) -> list:
+        """The BATCHED decode-side write primitive (ISSUE 11): splice
+        many sequences' K/V rows — ``writes`` is a sequence of
+        ``(seq, pos, rows)`` with :meth:`write_kv` semantics — in ONE
+        pool batch (one host-to-device transfer, one splice critical
+        section; :meth:`~brpc_tpu.kvcache.pages.PagePool.write_slots_batch`)
+        instead of a device round-trip per slot.  Both the plain decode
+        step and the speculative verify-commit ride this.
+
+        Per-item isolation: a write whose validation or COW fails is
+        SKIPPED and reported — the healthy slots' rows still land, so
+        one exhausted sequence cannot starve its step-mates.  Returns
+        ``[(index, exception), ...]`` for the failed items (empty when
+        all landed); a pool-level batch failure fails every surviving
+        item."""
+        staged = []               # (write index, seq, pos, rows, runs)
+        failures: list = []
         with self._mu:
-            if seq.retired:
-                raise RuntimeError(f"write_kv on retired seq {seq.seq_id}")
-            if pos < 0 or pos + n > len(seq.tokens):
-                raise ValueError(
-                    f"write_kv [{pos},{pos + n}) exceeds materialized "
-                    f"tokens ({len(seq.tokens)})")
-            idx = 0
-            while idx < n:
-                p = pos + idx
-                pi = p // self.page_tokens
-                slot = p % self.page_tokens
-                page = seq.pages[pi]
-                if page.refs > 1:
-                    # copy-on-write: the target page is shared (radix
-                    # tree, fork, live commit) — writing in place would
-                    # corrupt the other holder's view
-                    if seq.span is not rpcz.NULL_SPAN:
-                        seq.span.annotate(
-                            f"kv cow: page {page.pid} shared "
-                            f"(refs={page.refs}), copied before KV write")
-                    fresh = self._alloc_page(span=seq.span)
-                    try:
-                        self.pagepool.copy_page(fresh, page)
-                    except BaseException:
-                        self.pagepool.unref(fresh)
-                        raise
-                    seq.pages[pi] = fresh
-                    self.pagepool.unref(page)
-                    self.cow.add(1)
-                    page = fresh
-                k = min(self.page_tokens - slot, n - idx)
-                self.pagepool.write_slots(page, slot, rows[idx:idx + k])
-                idx += k
+            for wi, (seq, pos, rows) in enumerate(writes):
+                try:
+                    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+                    n = rows.shape[0]
+                    if seq.retired:
+                        raise RuntimeError(
+                            f"write_kv on retired seq {seq.seq_id}")
+                    if pos < 0 or pos + n > len(seq.tokens):
+                        raise ValueError(
+                            f"write_kv [{pos},{pos + n}) exceeds "
+                            f"materialized tokens ({len(seq.tokens)})")
+                    runs = []
+                    idx = 0
+                    while idx < n:
+                        p = pos + idx
+                        pi = p // self.page_tokens
+                        slot = p % self.page_tokens
+                        page = seq.pages[pi]
+                        if page.refs > 1:
+                            # copy-on-write: the target page is shared
+                            # (radix tree, fork, live commit) — writing
+                            # in place would corrupt the other
+                            # holder's view
+                            if seq.span is not rpcz.NULL_SPAN:
+                                seq.span.annotate(
+                                    f"kv cow: page {page.pid} shared "
+                                    f"(refs={page.refs}), copied "
+                                    f"before KV write")
+                            fresh = self._alloc_page(span=seq.span)
+                            try:
+                                self.pagepool.copy_page(fresh, page)
+                            except BaseException:
+                                self.pagepool.unref(fresh)
+                                raise
+                            seq.pages[pi] = fresh
+                            self.pagepool.unref(page)
+                            self.cow.add(1)
+                            page = fresh
+                        k = min(self.page_tokens - slot, n - idx)
+                        runs.append((page, slot, rows[idx:idx + k]))
+                        idx += k
+                except Exception as e:
+                    failures.append((wi, e))
+                    continue
+                staged.append((wi, seq, pos, rows.shape[0], runs))
+            if not staged:
+                return failures
+            try:
+                self.pagepool.write_slots_batch(
+                    [r for _, _, _, _, runs in staged for r in runs])
+            except Exception as e:
+                failures.extend((wi, e) for wi, _, _, _, _ in staged)
+                return failures
             if final:
-                seq.kv_filled = max(seq.kv_filled, pos + n)
-                self._commit_live(seq)
+                for _, seq, pos, n, _ in staged:
+                    seq.kv_filled = max(seq.kv_filled, pos + n)
+                    self._commit_live(seq)
+        return failures
 
     def fork(self, seq: KVSeq) -> KVSeq:
         """A second sequence sharing every page of `seq` (divergent
@@ -327,6 +390,73 @@ class KVCacheStore:
             self.forks.add(1)
             self._live += 1
             return child
+
+    # ---- draft leases (ISSUE 11: speculative decoding) ----
+
+    def speculate(self, seq: KVSeq, tokens: Sequence[int]) -> None:
+        """Append DRAFT tokens to `seq` without materializing them:
+        pages are allocated (and a shared tail copies-on-write) exactly
+        like :meth:`extend`, but ``kv_filled`` holds and nothing
+        live-commits — verification decides whether these positions
+        ever become real.  Pair with :meth:`rollback` (reject) and
+        :meth:`commit_draft` / ``write_kv_batch`` (accept)."""
+        if not tokens:
+            return
+        with self._mu:
+            if seq.retired:
+                raise RuntimeError(
+                    f"speculate on retired seq {seq.seq_id}")
+            self._append_run(seq, tokens, materialize=False)
+            self.speculated.add(len(tokens))
+
+    def rollback(self, seq: KVSeq, keep_tokens: int) -> int:
+        """Reject a draft tail: truncate `seq` back to its first
+        `keep_tokens` tokens and release the pages past the boundary
+        to the pool (the chaos suite's zero-leaked-draft-pages
+        discipline).  Never cuts below the materialized prefix — real
+        KV is not un-written by a rejected speculation.  Returns the
+        pages released."""
+        keep = int(keep_tokens)
+        with self._mu:
+            if seq.retired:
+                raise RuntimeError(
+                    f"rollback on retired seq {seq.seq_id}")
+            if keep > len(seq.tokens):
+                raise ValueError(
+                    f"rollback to {keep} > {len(seq.tokens)} tokens")
+            if keep < seq.kv_filled:
+                raise ValueError(
+                    f"rollback to {keep} would cut the materialized "
+                    f"prefix (kv_filled={seq.kv_filled})")
+            del seq.tokens[keep:]
+            need = -(-keep // self.page_tokens)
+            dropped, seq.pages = seq.pages[need:], seq.pages[:need]
+            for p in dropped:
+                self.pagepool.unref(p)
+            if dropped:
+                self.rolled_back.add(len(dropped))
+            return len(dropped)
+
+    def commit_draft(self, seq: KVSeq, upto: int) -> None:
+        """Accept a verified draft prefix: the materialization cursor
+        advances to `upto` tokens and the streaming commit runs.  The
+        harness path's commit — the token-id stand-in bytes were
+        already spliced at :meth:`speculate` time.  Vector-KV callers
+        commit by splicing the verified rows through
+        :meth:`write_kv_batch` instead (``final=True`` advances the
+        cursor); calling this without real bytes in the slots would
+        declare garbage attendable."""
+        upto = int(upto)
+        with self._mu:
+            if seq.retired:
+                raise RuntimeError(
+                    f"commit_draft on retired seq {seq.seq_id}")
+            if upto > len(seq.tokens):
+                raise ValueError(
+                    f"commit_draft to {upto} > {len(seq.tokens)} tokens")
+            if upto > seq.kv_filled:
+                seq.kv_filled = upto
+                self._commit_live(seq)
 
     def retire(self, seq: KVSeq, *, cache: bool = True) -> None:
         """End a sequence.  With ``cache=True`` its full-page chunks
@@ -481,7 +611,8 @@ class KVCacheStore:
     def _append(self, seq: KVSeq, token: int) -> None:
         self._append_run(seq, [token])
 
-    def _append_run(self, seq: KVSeq, tokens: Sequence[int]) -> None:
+    def _append_run(self, seq: KVSeq, tokens: Sequence[int],
+                    materialize: bool = True) -> None:
         """Append tokens in PAGE-SIZED runs: one device splice per page
         touched, not one per token — the difference dominates cold-admit
         latency for long uncached suffixes."""
@@ -523,6 +654,12 @@ class KVCacheStore:
                 self.pagepool.write(seq.pages[-1], slot, run)
             seq.tokens.extend(run)
             idx += k
+        if not materialize:
+            # draft append (speculate): the token-id stand-in bytes are
+            # in place (harness mode) but the MATERIALIZATION cursor
+            # holds — an unverified draft must never live-commit, cache
+            # at retire, or be pinned by a detach
+            return
         if not self.vector_kv:
             seq.kv_filled = len(seq.tokens)
         self._commit_live(seq)
@@ -666,6 +803,8 @@ class KVCacheStore:
             "admitted": self.admitted.get_value(),
             "retired": self.retired.get_value(),
             "forks": self.forks.get_value(),
+            "speculated_tokens": self.speculated.get_value(),
+            "rolled_back_pages": self.rolled_back.get_value(),
             "detached": self.detached.get_value(),
             "imported_pages": self.imported.get_value(),
             "cow_forks": self.cow.get_value(),

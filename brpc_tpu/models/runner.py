@@ -109,6 +109,27 @@ class ModelRunner:
         token-harness runners)."""
         raise NotImplementedError
 
+    def verify(self, tokens, positions, tables, base_len, mask):
+        """Speculative-verify (ISSUE 11): score a whole draft tree in
+        ONE call.  ``tokens``/``positions`` are ``[num_slots, K1]`` —
+        per slot, row 0 is the normal decode query (the last real
+        token) and rows 1.. are draft positions (engine position
+        convention: a token at sequence index p rides position p+1,
+        exactly what :meth:`step` would have been handed when that
+        token was newest).  ``tables`` ``[num_slots*K1,
+        max_pages_per_slot]`` is the PER-ROW page-id table (tree side
+        branches ride their fork's table), ``base_len``
+        ``[num_slots*K1]`` the per-row count of MATERIALIZED arena
+        keys, and ``mask`` ``[num_slots, K1, K1]`` the draft-tree
+        ancestry mask (row i sees local row j's in-call K/V iff
+        ``mask[s, i, j]``; always includes self).  Returns
+        ``(out_tokens, kv_rows)`` — per-ROW greedy next tokens
+        ``[num_slots, K1]`` (the accept rule is greedy match against
+        these) and the rows' packed K/V ``[num_slots, K1,
+        kv_bytes_per_token]`` uint8 (None for token-harness runners);
+        only the ACCEPTED rows' K/V should ever be spliced."""
+        raise NotImplementedError
+
     def close(self) -> None:
         pass
 
@@ -157,6 +178,26 @@ class LegacyFnRunner(ModelRunner):
             out = self.step_fn(jnp.asarray(tokens),
                                jnp.asarray(positions))
         return np.asarray(out), None
+
+    def verify(self, tokens, positions, tables, base_len, mask):
+        """Speculative-verify for the fn protocols: the PR 2 step_fn
+        contract is elementwise over its slot axis (each slot is an
+        independent (token, position) query — that independence is
+        what lets requests share a fixed-shape batch at all), so a
+        draft tree verifies as ONE step_fn call with the rows flattened
+        onto the slot axis.  kv_rows is None — token-harness pages
+        materialize at append time."""
+        import jax.numpy as jnp
+        tokens = np.asarray(tokens, np.int32)
+        s, k1 = tokens.shape
+        flat_t = jnp.asarray(tokens.reshape(-1))
+        flat_p = jnp.asarray(np.asarray(positions,
+                                        np.int32).reshape(-1))
+        if self.wants_pages and tables is not None:
+            out = self.step_fn(flat_t, flat_p, jnp.asarray(tables))
+        else:
+            out = self.step_fn(flat_t, flat_p)
+        return np.asarray(out).reshape(s, k1), None
 
 
 def as_runner(step_fn=None, prefill_fn=None, *, runner=None, store=None,
@@ -409,8 +450,53 @@ def _jits():
             kv_rows, jnp.uint8).reshape(s, cfg.kv_bytes_per_token)
         return nxt, rows_u8
 
+    def verify(params, tokens, positions, tables, base_len, mask,
+               arena_u8, *, cfg, page_tokens, backend):
+        """Draft-tree verify (ISSUE 11): every row of every slot in ONE
+        paged-attention call.  The arena part covers each slot's
+        MATERIALIZED keys (per-row ``base_len`` — draft pages in the
+        table hold nothing attendable and stay masked); the draft
+        positions' K/V, computed right here, fold in as the kernel's
+        LOCAL BLOCK under the ancestry ``mask`` — the multi-key
+        generalization of the decode step's self-key merge, so a slot
+        with zero drafts reduces exactly to a plain step row."""
+        s, k1 = tokens.shape
+        r = s * k1
+        qpos = positions.reshape(r) - 1    # engine position convention
+        kv = _kv_view(arena_u8, cfg, page_tokens)
+        h = params["emb"][tokens.reshape(r)] \
+            + _posenc(qpos, cfg.d_model)
+        new_k, new_v = [], []
+        for l in range(cfg.n_layers):
+            x = _rms(h)
+            q = (x @ params["wq"][l]).reshape(r, cfg.n_heads,
+                                              cfg.head_dim)
+            k = (x @ params["wk"][l]).reshape(r, cfg.n_kv_heads,
+                                              cfg.head_dim)
+            v = (x @ params["wv"][l]).reshape(r, cfg.n_kv_heads,
+                                              cfg.head_dim)
+            new_k.append(k)
+            new_v.append(v)
+            o = paged_attention(
+                q, kv[:, :, l, 0], kv[:, :, l, 1], tables, base_len,
+                local_k=k.reshape(s, k1, cfg.n_kv_heads, cfg.head_dim),
+                local_v=v.reshape(s, k1, cfg.n_kv_heads, cfg.head_dim),
+                local_mask=mask, backend=backend)
+            h = h + o.reshape(r, cfg.n_heads * cfg.head_dim) \
+                @ params["wo"][l]
+            h = h + _mlp(_rms(h), params["w1"][l], params["w2"][l])
+        logits = _rms(h) @ params["emb"].T
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        kv_rows = jnp.stack(
+            [jnp.stack(new_k, axis=1), jnp.stack(new_v, axis=1)],
+            axis=2)                     # [R, L, 2, Hkv, D]
+        rows_u8 = jax.lax.bitcast_convert_type(
+            kv_rows, jnp.uint8).reshape(s, k1, cfg.kv_bytes_per_token)
+        return nxt.reshape(s, k1), rows_u8
+
     return {"embed": _jit(embed), "proj": _jit(proj),
-            "attend": _jit(attend), "step": _jit(step)}
+            "attend": _jit(attend), "step": _jit(step),
+            "verify": _jit(verify)}
 
 
 def make_store_for(cfg: TransformerConfig, *, page_tokens: int = 8,
@@ -526,6 +612,20 @@ class TransformerRunner(ModelRunner):
                                       jnp.asarray(positions, jnp.int32),
                                       jnp.asarray(tables), arena,
                                       **self._statics())
+        return np.asarray(nxt), np.asarray(rows)
+
+    def verify(self, tokens, positions, tables, base_len, mask):
+        import jax.numpy as jnp
+        flat = self._flat_tables(tables)
+        arena = self._arena()
+        nxt, rows = self._fns["verify"](
+            self.params,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(flat),
+            jnp.asarray(base_len, jnp.int32),
+            jnp.asarray(mask, bool),
+            arena, **self._statics())
         return np.asarray(nxt), np.asarray(rows)
 
     def prefill(self, tokens, positions, pages, seq=None):

@@ -37,6 +37,23 @@ prefill batches rows across suffix positions):
                             decode step's own just-computed K/V, merged
                             into the same softmax (its key position is
                             lengths[i], i.e. always visible)
+  local_k/local_v [G, W, Hkv, D] optional LOCAL KEY BLOCK (ISSUE 11):
+                            rows reshape into G groups of W queries
+                            (N == G*W — a speculative-verify batch is
+                            one group per decode slot, W = draft rows),
+                            and every query in group g may additionally
+                            attend over that group's W in-call keys —
+                            the draft positions' K/V, computed in the
+                            same forward pass, never materialized into
+                            pages.  Visibility is the boolean
+  local_mask      [G, W, W]  ancestry mask: query row i of group g sees
+                            local key j iff ``local_mask[g, i, j]`` —
+                            lower-triangular for a linear draft chain,
+                            the tree mask for branching drafts.  The
+                            fold is one more online-softmax merge, so a
+                            row with one visible local key (itself) is
+                            numerically the ``extra_k`` decode-step
+                            fold.  Mutually exclusive with extra_k.
 
 GQA/MQA: fewer K/V heads than query heads are expanded per group, the
 ``_expand_kv`` contract of ops/attention.py.
@@ -85,11 +102,25 @@ def _expand_heads(x, n_heads: int):
 
 # ---- gather backend (pure jax; the CPU-valid default) ----------------------
 
+def _check_local(extra_k, local_k, local_v, local_mask, n):
+    if local_k is None:
+        return
+    if extra_k is not None:
+        raise ValueError("extra_k and local_k are mutually exclusive")
+    if local_v is None or local_mask is None:
+        raise ValueError("local_k needs local_v and local_mask")
+    g, w = local_mask.shape[0], local_mask.shape[1]
+    if g * w != n:
+        raise ValueError(f"local block groups {g}x{w} != {n} query rows")
+
+
 def paged_attention_gather(q, k_pages, v_pages, tables, lengths,
-                           extra_k=None, extra_v=None):
+                           extra_k=None, extra_v=None,
+                           local_k=None, local_v=None, local_mask=None):
     n, h, d = q.shape
     p, t, hkv, _ = k_pages.shape
     mp = tables.shape[1]
+    _check_local(extra_k, local_k, local_v, local_mask, n)
     scale = 1.0 / math.sqrt(d)
     safe = jnp.clip(tables, 0, p - 1)
     # [N, MP, T, Hkv, D] -> [N, MP*T, H, D]; clipped -1 rows are masked
@@ -116,6 +147,20 @@ def paged_attention_gather(q, k_pages, v_pages, tables, lengths,
         es = jnp.einsum("nhd,nhd->nh", qf, ek)[..., None]   # [N, H, 1]
         s = jnp.concatenate([s, es], axis=-1)
         v = jnp.concatenate([v, ev[:, None]], axis=1)       # [N, K+1, H, D]
+    if local_k is not None:
+        g, w = local_mask.shape[0], local_mask.shape[1]
+        lk = _expand_heads(local_k, h).astype(jnp.float32)  # [G, W, H, D]
+        lv = _expand_heads(local_v, h)
+        qg = qf.reshape(g, w, h, d)
+        # [G, Wq, H, Wk]: every query row of the group scores every
+        # local key; the ancestry mask decides visibility (a masked
+        # entry folds in as exp(-inf)=0, bit-preserving the visible sum)
+        ls = jnp.einsum("gihd,gjhd->gihj", qg, lk,
+                        preferred_element_type=jnp.float32)
+        ls = jnp.where(local_mask[:, :, None, :], ls, -jnp.inf)
+        s = jnp.concatenate([s, ls.reshape(n, h, w)], axis=-1)
+        lvb = jnp.broadcast_to(lv[:, None], (g, w, w, h, d))
+        v = jnp.concatenate([v, lvb.reshape(n, w, h, d)], axis=1)
     # -inf-safe softmax: rows with no visible key yield zeros, not NaN
     m = s.max(axis=-1, keepdims=True)
     m = jnp.where(jnp.isneginf(m), 0.0, m)
@@ -182,12 +227,14 @@ def _paged_kernel(tab_ref, len_ref, q_ref, k_ref, v_ref,
 
 def paged_attention_pallas(q, k_pages, v_pages, tables, lengths,
                            extra_k=None, extra_v=None,
+                           local_k=None, local_v=None, local_mask=None,
                            interpret: Optional[bool] = None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     n, h, d = q.shape
     p, t, hkv, _ = k_pages.shape
     mp = tables.shape[1]
+    _check_local(extra_k, local_k, local_v, local_mask, n)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = 1.0 / math.sqrt(d)
@@ -232,6 +279,28 @@ def paged_attention_pallas(q, k_pages, v_pages, tables, lengths,
         pe = jnp.exp(es - m_new)
         o = o * alpha[..., None] + pe[..., None] * ev
         l = l * alpha + pe
+    if local_k is not None:
+        # fold the whole local key block at once — the multi-key
+        # generalization of the extra_k merge, masked by ancestry
+        g, w = local_mask.shape[0], local_mask.shape[1]
+        lk = _expand_heads(local_k, h).astype(jnp.float32)
+        lv = _expand_heads(local_v, h).astype(jnp.float32)
+        qg = (q.astype(jnp.float32) * scale).reshape(g, w, h, d)
+        ls = jnp.einsum("gihd,gjhd->gihj", qg, lk,
+                        preferred_element_type=jnp.float32)
+        ls = jnp.where(local_mask[:, :, None, :], ls,
+                       -jnp.inf).reshape(n, h, w)
+        m_new = jnp.maximum(mx, ls.max(axis=-1))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        alpha = jnp.where(jnp.isneginf(mx), 0.0, jnp.exp(mx - m_safe))
+        pe = jnp.exp(ls - m_safe[..., None])
+        pe = jnp.where(jnp.isneginf(ls), 0.0, pe)
+        lvb = jnp.broadcast_to(lv[:, None],
+                               (g, w, w, h, d)).reshape(n, w, h, d)
+        o = o * alpha[..., None] + jnp.einsum(
+            "nhw,nwhd->nhd", pe, lvb,
+            preferred_element_type=jnp.float32)
+        l = l * alpha + pe.sum(axis=-1)
     l = jnp.where(l == 0.0, 1.0, l)
     return (o / l[..., None]).astype(q.dtype)
 
@@ -240,6 +309,7 @@ def paged_attention_pallas(q, k_pages, v_pages, tables, lengths,
 
 def paged_attention(q, k_pages, v_pages, tables, lengths,
                     extra_k=None, extra_v=None,
+                    local_k=None, local_v=None, local_mask=None,
                     backend: Optional[str] = None,
                     interpret: Optional[bool] = None):
     """Paged attention (see module docstring).  ``backend`` picks
@@ -250,9 +320,11 @@ def paged_attention(q, k_pages, v_pages, tables, lengths,
         backend = "pallas" if jax.default_backend() == "tpu" else "gather"
     if backend == "gather":
         return paged_attention_gather(q, k_pages, v_pages, tables,
-                                      lengths, extra_k, extra_v)
+                                      lengths, extra_k, extra_v,
+                                      local_k, local_v, local_mask)
     if backend == "pallas":
         return paged_attention_pallas(q, k_pages, v_pages, tables,
                                       lengths, extra_k, extra_v,
+                                      local_k, local_v, local_mask,
                                       interpret=interpret)
     raise ValueError(f"unknown paged_attention backend {backend!r}")

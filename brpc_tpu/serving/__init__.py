@@ -127,8 +127,23 @@ def generations_snapshot(limit: int = 50) -> dict:
         supervisors = dict(_supervisors)
     recoveries = sum(s.restarts_total.get_value()
                      for s in supervisors.values())
+    # speculative-decoding acceptance over the recent window (ISSUE
+    # 11): engine records carry per-generation accept_rate /
+    # tokens_per_step when a draft proposer ran
+    spec_rows = [r for r in recent if "accept_rate" in r]
+    proposed = sum(r.get("spec_proposed", 0) for r in spec_rows)
+    accepted = sum(r.get("spec_accepted", 0) for r in spec_rows)
+    speculative = {
+        "generations": len(spec_rows),
+        "accept_rate": round(accepted / proposed, 4) if proposed
+        else 0.0,
+        "avg_tokens_per_step": round(
+            sum(r["tokens_per_step"] for r in spec_rows)
+            / len(spec_rows), 2) if spec_rows else 0.0,
+    }
     return {
         "aggregates": {
+            "speculative": speculative,
             "ttft_us": {
                 "count": TTFT_REC.count(),
                 "avg": round(TTFT_REC.latency(), 1),
@@ -155,6 +170,9 @@ from brpc_tpu.serving.service import (  # noqa: E402,F401
 )
 from brpc_tpu.serving.supervisor import EngineSupervisor  # noqa: E402,F401
 from brpc_tpu.serving.ladder import OverloadLadder  # noqa: E402,F401
+from brpc_tpu.serving.speculative import (  # noqa: E402,F401
+    DraftModelProposer, DraftProposer, NGramProposer, as_proposer,
+)
 from brpc_tpu.serving.router import (  # noqa: E402,F401
     ClusterRouter, ReplicaHandle, RouterClient, RouterService,
     SessionTable, register_router,

@@ -88,6 +88,20 @@ STAGE_QUEUE_REC = LatencyRecorder("serving_stage_queue_us")
 STAGE_PREFILL_REC = LatencyRecorder("serving_stage_prefill_us")
 STAGE_DECODE_REC = LatencyRecorder("serving_stage_decode_us")
 
+# speculative decoding (ISSUE 11): serving-wide draft acceptance.  The
+# ratio rides /brpc_metrics as one scrapeable gauge; per-generation
+# acceptance is annotated on the decode spans and the generation ring.
+SPEC_PROPOSED = Adder("serving_spec_proposed_tokens")
+SPEC_ACCEPTED = Adder("serving_spec_accepted_tokens")
+
+
+def _spec_accept_rate() -> float:
+    p = SPEC_PROPOSED.get_value()
+    return round(SPEC_ACCEPTED.get_value() / p, 4) if p else 0.0
+
+
+PassiveStatus(_spec_accept_rate).expose("serving_spec_accept_rate")
+
 
 class _EmitBuf:
     """Bounded token buffer between the shared step loop and one
@@ -190,17 +204,22 @@ def _make_emit_buf(cap: int):
 
 class _Request:
     __slots__ = ("req_id", "prompt", "max_new_tokens", "emit", "on_done",
-                 "buf", "t_submit", "trace", "_done_fired", "_mu")
+                 "buf", "t_submit", "trace", "speculative",
+                 "_done_fired", "_mu")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  emit: Callable[[int], None],
                  on_done: Optional[Callable], emit_buffer: int,
-                 trace_ctx: Optional[tuple] = None):
+                 trace_ctx: Optional[tuple] = None,
+                 speculative: bool = True):
         self.req_id = next(_req_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.emit = emit
         self.on_done = on_done
+        # opt-out flag: a False request rides a speculative engine as
+        # a plain (zero-draft) member of the verify batch
+        self.speculative = bool(speculative)
         self.buf = _make_emit_buf(emit_buffer)
         self.t_submit = time.monotonic()
         # (trace_id, parent_span_id, sampled): captured at submit from
@@ -238,7 +257,9 @@ class _Request:
 class _Slot:
     __slots__ = ("req", "block", "seq", "last_token", "position",
                  "generated", "span", "t_install", "t_first_tok",
-                 "last_tok_t", "itl_n", "itl_sum_s", "itl_max_s")
+                 "last_tok_t", "itl_n", "itl_sum_s", "itl_max_s",
+                 "steps_run", "spec_steps", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, req: _Request, block=None, seq=None,
                  span=rpcz.NULL_SPAN):
@@ -255,6 +276,27 @@ class _Slot:
         self.itl_n = 0                        # inter-token gaps recorded
         self.itl_sum_s = 0.0
         self.itl_max_s = 0.0
+        self.steps_run = 0                    # engine iterations ridden
+        self.spec_steps = 0                   # verify iterations of those
+        self.spec_proposed = 0                # draft tokens proposed
+        self.spec_accepted = 0                # draft tokens accepted
+
+
+class _SpecPlan:
+    """One slot's draft lease for one verify iteration: the proposed
+    branches, the side-branch forks holding their pages, and the row
+    layout inside the fixed-shape verify batch."""
+
+    __slots__ = ("slot", "base", "branches", "forks", "rows",
+                 "speculated")
+
+    def __init__(self, slot: _Slot):
+        self.slot = slot
+        self.base = slot.position       # len(seq.tokens) pre-draft
+        self.branches: list = []        # token chains (branch 0 in-seq)
+        self.forks: list = []           # KVSeq per side branch
+        self.rows: list = []            # per branch: its local row idxs
+        self.speculated = False         # branch 0 appended to the seq
 
 
 class DecodeEngine:
@@ -276,6 +318,8 @@ class DecodeEngine:
                  eos_token: Optional[int] = None,
                  max_new_tokens_cap: int = 65536,
                  on_crash: Optional[Callable] = None,
+                 draft_runner=None,
+                 draft_len: int = 4,
                  name: str = "engine"):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
@@ -321,6 +365,19 @@ class DecodeEngine:
                 raise ValueError("a vector-KV runner needs store= "
                                  "(its K/V live in the paged cache)")
             self.runner.bind(store)
+        # speculative decoding (ISSUE 11): a draft proposer turns the
+        # step loop into propose -> verify -> commit; the plain path is
+        # byte-identical when no draft is configured
+        from brpc_tpu.serving.speculative import as_proposer
+        self._draft = as_proposer(draft_runner)
+        self.draft_len = int(draft_len)
+        if self._draft is not None:
+            if store is None:
+                raise ValueError("speculative decoding needs store= "
+                                 "(draft leases live in the paged "
+                                 "KV cache)")
+            if self.draft_len < 1:
+                raise ValueError("draft_len must be >= 1")
 
         safe = re.sub(r"\W", "_", name)
         # record the EXACT names exposed here so close() hides only this
@@ -353,10 +410,15 @@ class DecodeEngine:
         self._beat_t = time.monotonic()
 
         # scratch for the per-step batched native emit push (ISSUE 9):
-        # sized once at the slot count, owned by the engine thread
-        self._push_handles = (ctypes.c_void_p * self.num_slots)()
-        self._push_toks = (ctypes.c_int32 * self.num_slots)()
-        self._push_ok = (ctypes.c_uint8 * self.num_slots)()
+        # sized once at the slot count — times the per-slot burst in
+        # speculative mode (accepted drafts + bonus land in ONE
+        # GIL-released push_many, consecutive entries per ring) —
+        # owned by the engine thread
+        pushcap = self.num_slots * \
+            (self.draft_len + 1 if self._draft is not None else 1)
+        self._push_handles = (ctypes.c_void_p * pushcap)()
+        self._push_toks = (ctypes.c_int32 * pushcap)()
+        self._push_ok = (ctypes.c_uint8 * pushcap)()
 
         # the engine slot lock is a NAMED hot lock (ISSUE 6): submit,
         # the step loop, emitter cancels and the console all meet here
@@ -380,7 +442,8 @@ class DecodeEngine:
                emit: Callable[[int], None],
                on_done: Optional[Callable] = None, *,
                clamp: bool = True,
-               trace_ctx: Optional[tuple] = None) -> int:
+               trace_ctx: Optional[tuple] = None,
+               speculative: bool = True) -> int:
         """Queue a request; it is admitted into the step loop at the next
         step boundary with a free slot (in-flight requests are never
         restarted).  Returns the request id; terminal state arrives via
@@ -391,7 +454,10 @@ class DecodeEngine:
         with.  ``trace_ctx=(trace_id, parent_span_id, sampled)``
         overrides the rpcz trace context captured from the calling
         thread (the supervisor passes its generation-attempt span so
-        pre- and post-crash decode spans share one trace)."""
+        pre- and post-crash decode spans share one trace).
+        ``speculative=False`` opts this request out of draft proposals
+        on a speculative engine (it rides the verify batch as a plain
+        zero-draft member; a no-draft engine ignores the flag)."""
         limit = self.max_new_tokens_cap
         brownout = self.degraded_clamp
         if clamp and brownout is not None:
@@ -401,7 +467,7 @@ class DecodeEngine:
             limit = min(limit, int(brownout))
         req = _Request(prompt, min(int(max_new_tokens), limit),
                        emit, on_done, self.emit_buffer,
-                       trace_ctx=trace_ctx)
+                       trace_ctx=trace_ctx, speculative=speculative)
         if req.max_new_tokens <= 0:
             req.finish(errors.RpcError(errors.EREQUEST,
                                        "max_new_tokens must be > 0"))
@@ -784,160 +850,542 @@ class DecodeEngine:
                         # distinguishable from a wedged one
                         self._cv.wait(0.25)
                     continue
-            t_cpu0 = time.thread_time()
-            tok = np.zeros((self.num_slots,), np.int32)
-            pos = np.zeros((self.num_slots,), np.int32)
-            for i, s in active:
-                tok[i] = s.last_token
-                pos[i] = s.position
-            pages = self._gather_page_tables(active)
-            t_fn_cpu = time.thread_time()
-            try:
-                if fault.ENABLED and fault.hit(
-                        "serving.step", name=self.name) is not None:
-                    raise RuntimeError("injected decode step crash")
-                out, kv_rows = self.runner.step(tok, pos, pages)
-            except Exception as e:
-                if self._on_crash is not None:
-                    # supervised: this is an ENGINE failure, not the
-                    # requests' — leave every slot intact for takeover
-                    # and re-admission into the replacement engine
-                    self._crash(e)
+            if self._draft is not None:
+                if not self._spec_step(active):
                     return
-                # unsupervised: a broken step function must not wedge
-                # callers — retire every active request with a definite
-                # error
-                err = errors.RpcError(
-                    errors.EINTERNAL,
-                    f"decode step failed: {type(e).__name__}: {e}")
-                with self._cv:
-                    released = [self._release_slot_locked(i,
-                                                          cache_ok=False)
-                                for i, s in active]
-                for s in filter(None, released):
-                    self._finalize_slot(s, errors.EINTERNAL)
-                    s.req.buf.push_terminal(err)
-                continue
-            fn_cpu_s = time.thread_time() - t_fn_cpu
-            self.steps.add(1)
-            self.occupancy_rec.add(len(active))
-            t_tok = time.monotonic()
-            deliver: list = []   # (slot index, slot, token) surviving
-            for i, s in active:
-                if self._slots[i] is not s:
-                    continue    # an emitter cancelled it mid-step
-                if kv_rows is not None and s.seq is not None:
-                    # materialize the query position's REAL K/V (the
-                    # packed row the runner just computed) before
-                    # anything else: the next step's arena gather — and
-                    # any radix commit of this page — must see it
-                    try:
-                        self.store.write_kv(s.seq, s.position - 1,
-                                            kv_rows[i:i + 1])
-                    except Exception as e:
-                        self._retire(i, errors.RpcError(
-                            errors.EINTERNAL,
-                            f"KV write failed: "
-                            f"{type(e).__name__}: {e}"))
-                        continue
-                nxt = int(out[i])
-                s.last_token = nxt
-                s.position += 1
-                s.generated += 1
-                self.tokens_out.add(1)
-                hostcpu.tokens_total.add(1)
-                if s.last_tok_t:
-                    gap = t_tok - s.last_tok_t
-                    ITL_REC.add(int(gap * 1e6))
-                    s.itl_n += 1
-                    s.itl_sum_s += gap
-                    if gap > s.itl_max_s:
-                        s.itl_max_s = gap
-                else:
-                    s.t_first_tok = t_tok
-                    ttft_us = int((t_tok - s.req.t_submit) * 1e6)
-                    TTFT_REC.add(ttft_us)
-                    if s.span is not rpcz.NULL_SPAN:
-                        s.span.annotate(f"first token: ttft_us={ttft_us}")
-                s.last_tok_t = t_tok
-                if s.seq is not None:
-                    try:
-                        self.store.extend(s.seq, nxt)
-                    except MemoryError as e:
-                        # pool exhausted and nothing evictable: THIS
-                        # request errors, the loop and its peers go on
-                        self._retire(i, errors.RpcError(
-                            errors.ELIMIT,
-                            f"KV page alloc failed: {e}"))
-                        continue
-                    except Exception as e:
-                        self._retire(i, errors.RpcError(
-                            errors.EINTERNAL,
-                            f"KV extend failed: {type(e).__name__}: {e}"))
-                        continue
-                    if len(s.seq.pages) > self.max_pages_per_slot:
-                        self._retire(i, errors.RpcError(
-                            errors.ELIMIT,
-                            f"page table overflow "
-                            f"(> {self.max_pages_per_slot} pages)"))
-                        continue
-                deliver.append((i, s, nxt))
-            # emit fan-out: ONE GIL-released native push across every
-            # surviving slot's ring (ISSUE 9) — the per-token Python
-            # lock acquire/notify this replaces was the step loop's
-            # biggest fixed cost.  Python _EmitBuf requests (flag off /
-            # no native lib / flipped mid-flight) push individually.
-            pushed = self._push_tokens(deliver)
-            for (i, s, nxt), ok in zip(deliver, pushed):
-                if not ok:
-                    # consumer stopped draining: cut it HERE, without
-                    # the step loop ever blocking in a write
-                    self.emit_cut.add(1)
-                    if s.span is not rpcz.NULL_SPAN:
-                        s.span.annotate(
-                            f"emit-buffer stall: {self.emit_buffer} "
-                            f"buffered tokens undrained, consumer cut")
-                    self._retire(i, errors.RpcError(
-                        errors.EOVERCROWDED,
-                        "slow stream consumer: emit buffer overflow"))
-                    continue
-                if s.generated >= s.req.max_new_tokens or \
-                        (self.eos_token is not None
-                         and nxt == self.eos_token):
-                    self._retire(i, None)
-            # per-stage host-CPU accounting (ISSUE 6): this iteration's
-            # step-loop bookkeeping minus the model step itself
-            hostcpu.add("decode_step",
-                        (time.thread_time() - t_cpu0 - fn_cpu_s) * 1e6)
-            hostcpu.add("model_compute", fn_cpu_s * 1e6)
+            elif not self._plain_step(active):
+                return
 
-    def _push_tokens(self, deliver: list) -> list:
-        """Push one generated token per surviving slot: every native
-        ring rides ONE GIL-released ``brpc_tokring_push_many`` call,
-        Python _EmitBufs push individually.  Returns per-entry success
-        aligned with ``deliver``; False = ring full = consumer cut.
-        The slot objects in ``deliver`` hold their requests (and so the
-        ring wrappers) alive across the native call — a racing emitter
-        cancel can retire the slot but never free the ring under us."""
+    def _plain_step(self, active) -> bool:
+        """One plain decode iteration (the no-draft path, byte-for-byte
+        the pre-ISSUE-11 loop body except that the per-slot KV row
+        writes ride ONE ``write_kv_batch``).  Returns False when the
+        loop must stop (supervised crash)."""
+        t_cpu0 = time.thread_time()
+        tok = np.zeros((self.num_slots,), np.int32)
+        pos = np.zeros((self.num_slots,), np.int32)
+        for i, s in active:
+            tok[i] = s.last_token
+            pos[i] = s.position
+        pages = self._gather_page_tables(active)
+        t_fn_cpu = time.thread_time()
+        try:
+            if fault.ENABLED and fault.hit(
+                    "serving.step", name=self.name) is not None:
+                raise RuntimeError("injected decode step crash")
+            out, kv_rows = self.runner.step(tok, pos, pages)
+        except Exception as e:
+            if self._on_crash is not None:
+                # supervised: this is an ENGINE failure, not the
+                # requests' — leave every slot intact for takeover
+                # and re-admission into the replacement engine
+                self._crash(e)
+                return False
+            # unsupervised: a broken step function must not wedge
+            # callers — retire every active request with a definite
+            # error
+            err = errors.RpcError(
+                errors.EINTERNAL,
+                f"decode step failed: {type(e).__name__}: {e}")
+            with self._cv:
+                released = [self._release_slot_locked(i,
+                                                      cache_ok=False)
+                            for i, s in active]
+            for s in filter(None, released):
+                self._finalize_slot(s, errors.EINTERNAL)
+                s.req.buf.push_terminal(err)
+            return True
+        fn_cpu_s = time.thread_time() - t_fn_cpu
+        self.steps.add(1)
+        self.occupancy_rec.add(len(active))
+        t_tok = time.monotonic()
+        # the per-slot KV row writes ride ONE batched splice
+        # (ISSUE 11): one H2D transfer + one I/O critical section
+        # across every surviving slot instead of one per slot
+        wrote_bad: set = set()
+        if kv_rows is not None:
+            items = [(i, s) for i, s in active
+                     if self._slots[i] is s and s.seq is not None]
+            fails = self.store.write_kv_batch(
+                [(s.seq, s.position - 1, kv_rows[i:i + 1])
+                 for i, s in items])
+            for wi, e in fails:
+                i, _ = items[wi]
+                wrote_bad.add(i)
+                self._retire(i, errors.RpcError(
+                    errors.EINTERNAL,
+                    f"KV write failed: {type(e).__name__}: {e}"))
+        deliver: list = []   # (slot index, slot, token) surviving
+        for i, s in active:
+            if i in wrote_bad or self._slots[i] is not s:
+                continue    # an emitter cancelled it mid-step
+            nxt = int(out[i])
+            s.last_token = nxt
+            s.position += 1
+            s.generated += 1
+            s.steps_run += 1
+            self.tokens_out.add(1)
+            hostcpu.tokens_total.add(1)
+            if s.last_tok_t:
+                gap = t_tok - s.last_tok_t
+                ITL_REC.add(int(gap * 1e6))
+                s.itl_n += 1
+                s.itl_sum_s += gap
+                if gap > s.itl_max_s:
+                    s.itl_max_s = gap
+            else:
+                s.t_first_tok = t_tok
+                ttft_us = int((t_tok - s.req.t_submit) * 1e6)
+                TTFT_REC.add(ttft_us)
+                if s.span is not rpcz.NULL_SPAN:
+                    s.span.annotate(f"first token: ttft_us={ttft_us}")
+            s.last_tok_t = t_tok
+            if s.seq is not None:
+                try:
+                    self.store.extend(s.seq, nxt)
+                except MemoryError as e:
+                    # pool exhausted and nothing evictable: THIS
+                    # request errors, the loop and its peers go on
+                    self._retire(i, errors.RpcError(
+                        errors.ELIMIT,
+                        f"KV page alloc failed: {e}"))
+                    continue
+                except Exception as e:
+                    self._retire(i, errors.RpcError(
+                        errors.EINTERNAL,
+                        f"KV extend failed: {type(e).__name__}: {e}"))
+                    continue
+                if len(s.seq.pages) > self.max_pages_per_slot:
+                    self._retire(i, errors.RpcError(
+                        errors.ELIMIT,
+                        f"page table overflow "
+                        f"(> {self.max_pages_per_slot} pages)"))
+                    continue
+            deliver.append((i, s, nxt))
+        # emit fan-out: ONE GIL-released native push across every
+        # surviving slot's ring (ISSUE 9) — the per-token Python
+        # lock acquire/notify this replaces was the step loop's
+        # biggest fixed cost.  Python _EmitBuf requests (flag off /
+        # no native lib / flipped mid-flight) push individually.
+        # One-token runs of the speculative path's batched push — one
+        # emit fan-out implementation for both loops.
+        pushed = self._push_token_runs(
+            [(i, s, (nxt,)) for i, s, nxt in deliver])
+        for (i, s, nxt), ok in zip(deliver, pushed):
+            if not ok:
+                # consumer stopped draining: cut it HERE, without
+                # the step loop ever blocking in a write
+                self.emit_cut.add(1)
+                if s.span is not rpcz.NULL_SPAN:
+                    s.span.annotate(
+                        f"emit-buffer stall: {self.emit_buffer} "
+                        f"buffered tokens undrained, consumer cut")
+                self._retire(i, errors.RpcError(
+                    errors.EOVERCROWDED,
+                    "slow stream consumer: emit buffer overflow"))
+                continue
+            if s.generated >= s.req.max_new_tokens or \
+                    (self.eos_token is not None
+                     and nxt == self.eos_token):
+                self._retire(i, None)
+        # per-stage host-CPU accounting (ISSUE 6): this iteration's
+        # step-loop bookkeeping minus the model step itself
+        hostcpu.add("decode_step",
+                    (time.thread_time() - t_cpu0 - fn_cpu_s) * 1e6)
+        hostcpu.add("model_compute", fn_cpu_s * 1e6)
+        return True
+
+    # ---- speculative decoding (ISSUE 11) ----
+
+    def _spec_release(self, plan: "_SpecPlan") -> None:
+        """Return one slot's draft lease to baseline: roll the main
+        sequence back to its pre-draft length (unless something else —
+        an emitter cancel's retire, a supervisor detach — already
+        owns/released it) and retire every side-branch fork.  Runs on
+        every non-commit exit path, so a crashed or cancelled verify
+        can never leak a draft page."""
+        s = plan.slot
+        try:
+            # unconditional: a speculate that raised MID-APPEND left a
+            # partial draft tail the `speculated` flag never saw
+            if s.seq is not None and not s.seq.retired \
+                    and len(s.seq.tokens) > plan.base:
+                self.store.rollback(s.seq, plan.base)
+        except Exception:
+            pass
+        plan.speculated = False
+        for f in plan.forks:
+            if f is None:
+                continue
+            try:
+                self.store.retire(f, cache=False)
+            except Exception:
+                pass
+        plan.forks = []
+
+    def _spec_propose(self, s: _Slot) -> list:
+        """Draft branches for one slot, clamped to the row budget, the
+        remaining token budget, and the fixed page-table width.  Empty
+        when the slot opted out, has no headroom, or the proposer has
+        nothing to say — the slot then rides the verify batch as a
+        plain zero-draft member."""
+        rem = s.req.max_new_tokens - s.generated
+        if not s.req.speculative or rem <= 1 or s.seq is None:
+            return []
+        # the drafts (plus the bonus token) must fit the FIXED page
+        # table the verify rows gather — never speculate past it
+        avail = self.max_pages_per_slot * self.store.page_tokens \
+            - s.position - 1
+        cap = min(self.draft_len, rem - 1, avail)
+        if cap < 1:
+            return []
+        try:
+            branches = self._draft.propose(s.seq.tokens, cap)
+        except Exception:
+            return []      # a broken proposer degrades, never crashes
+        kept, total = [], 0
+        for b in branches:
+            b = [int(t) for t in b][:cap - total]
+            if not b:
+                break
+            kept.append(b)
+            total += len(b)
+        return kept
+
+    def _spec_step(self, active) -> bool:
+        """One speculative iteration: PROPOSE draft branches per slot,
+        lease their pages (branch 0 rides the in-sequence draft cursor,
+        side branches ride ``fork`` — COW isolates the divergent
+        tails), VERIFY every row of every slot in ONE runner call, then
+        COMMIT the longest greedy-matching prefix per slot: accepted
+        rows' K/V splice in one ``write_kv_batch`` (page commit —
+        ``kv_filled`` advances), rejected tails roll back (pages return
+        to the pool), and the accepted tokens plus the target's bonus
+        token fan out in one batched ring push.  Slots at different
+        accept depths — including zero-draft plain slots — coexist in
+        the one fixed-shape batch.  Returns False when the loop must
+        stop (supervised crash)."""
+        t_cpu0 = time.thread_time()
+        k1 = self.draft_len + 1
+        mp = self.max_pages_per_slot
+        # ---- propose + lease ----
+        plans: dict[int, _SpecPlan] = {}
+        for i, s in active:
+            plan = _SpecPlan(s)
+            plans[i] = plan
+            branches = self._spec_propose(s)
+            if not branches:
+                continue
+            try:
+                # forks FIRST (they must share only the base pages);
+                # the branch-0 speculate then COWs the shared tail
+                for b in branches[1:]:
+                    f = self.store.fork(s.seq)
+                    plan.forks.append(f)
+                    self.store.speculate(f, b)
+                self.store.speculate(s.seq, branches[0])
+                plan.speculated = True
+                plan.branches = branches
+            except Exception:
+                # lease pressure (pool exhausted mid-speculate):
+                # degrade THIS slot to a plain step, peers keep their
+                # drafts
+                self._spec_release(plan)
+                plan.branches = []
+        if not any(p.branches for p in plans.values()):
+            # nobody proposed (cold context the proposer has no basis
+            # for, or every slot opted out): a (draft_len+1)-wide
+            # verify would pay ~k1x the model FLOPs to emit one token
+            # per slot — run the plain step instead.  No leases were
+            # taken (empty branches lease nothing), and both paths
+            # keep the same position/kv_filled invariants, so
+            # iterations can alternate freely within one generation.
+            return self._plain_step(active)
+        # ---- build the fixed-shape verify batch ----
+        tok = np.zeros((self.num_slots, k1), np.int32)
+        pos = np.zeros((self.num_slots, k1), np.int32)
+        tables = np.full((self.num_slots * k1, mp), -1, np.int32)
+        base_len = np.zeros((self.num_slots * k1,), np.int32)
+        mask = np.zeros((self.num_slots, k1, k1), bool)
+        for i, s in active:
+            plan = plans[i]
+            base = s.position - 1          # materialized arena keys
+            main_ids = np.full((mp,), -1, np.int32)
+            ids = s.seq.page_ids() if s.seq is not None else []
+            main_ids[:min(len(ids), mp)] = ids[:mp]
+            tok[i, 0] = s.last_token
+            pos[i, 0] = s.position
+            mask[i, 0, 0] = True
+            tables[i * k1] = main_ids
+            base_len[i * k1] = base
+            r = 1
+            plan.rows = []
+            for bi, b in enumerate(plan.branches):
+                if bi == 0:
+                    owner_ids = main_ids
+                else:
+                    owner_ids = np.full((mp,), -1, np.int32)
+                    fids = plan.forks[bi - 1].page_ids()
+                    owner_ids[:min(len(fids), mp)] = fids[:mp]
+                rows = []
+                for c, t in enumerate(b):
+                    tok[i, r] = t
+                    pos[i, r] = s.position + c + 1
+                    tables[i * k1 + r] = owner_ids
+                    base_len[i * k1 + r] = base
+                    mask[i, r, 0] = True          # the shared root
+                    for pr in rows:
+                        mask[i, r, pr] = True     # branch ancestors
+                    mask[i, r, r] = True          # self (in-call key)
+                    rows.append(r)
+                    r += 1
+                plan.rows.append(rows)
+        # ---- verify: the whole draft tree, one call ----
+        t_fn_cpu = time.thread_time()
+        try:
+            if fault.ENABLED and fault.hit(
+                    "serving.spec_verify", name=self.name) is not None:
+                raise RuntimeError("injected speculative verify crash")
+            out, kv_rows = self.runner.verify(tok, pos, tables,
+                                              base_len, mask)
+        except Exception as e:
+            # draft leases FIRST — a crashed verify must leave zero
+            # draft pages behind whether the supervisor takes over or
+            # the requests fail definitively
+            for plan in plans.values():
+                self._spec_release(plan)
+            if self._on_crash is not None:
+                self._crash(e)
+                return False
+            err = errors.RpcError(
+                errors.EINTERNAL,
+                f"speculative verify failed: {type(e).__name__}: {e}")
+            with self._cv:
+                released = [self._release_slot_locked(i, cache_ok=False)
+                            for i, s in active]
+            for s in filter(None, released):
+                self._finalize_slot(s, errors.EINTERNAL)
+                s.req.buf.push_terminal(err)
+            return True
+        fn_cpu_s = time.thread_time() - t_fn_cpu
+        self.steps.add(1)
+        self.occupancy_rec.add(len(active))
+        t_tok = time.monotonic()
+        # ---- accept + commit ----
+        writes: list = []         # (seq, pos, rows) for the batch splice
+        write_owner: list = []    # slot index per staged write
+        staged: dict[int, dict] = {}
+        for i, s in active:
+            plan = plans[i]
+            if self._slots[i] is not s:
+                # an emitter cancelled it mid-verify (its retire
+                # already released the main lease); forks remain ours
+                self._spec_release(plan)
+                continue
+            # greedy tree walk: the true next token at each row is the
+            # target's argmax there; the winning branch is the longest
+            # chain whose tokens match truth step by step
+            t_star = int(out[i, 0])
+            path: list = []
+            winner = -1
+            for bi, rows in enumerate(plan.rows):
+                if not rows or int(tok[i, rows[0]]) != t_star:
+                    continue
+                sel = [rows[0]]
+                for nxt_row in rows[1:]:
+                    if int(tok[i, nxt_row]) == int(out[i, sel[-1]]):
+                        sel.append(nxt_row)
+                    else:
+                        break
+                if len(sel) > len(path):
+                    path, winner = sel, bi
+            a = len(path)
+            bonus = int(out[i, path[-1]]) if path else t_star
+            raw = [int(tok[i, r]) for r in path] + [bonus]
+            if self.eos_token is not None and self.eos_token in raw:
+                raw = raw[:raw.index(self.eos_token) + 1]
+            rem = s.req.max_new_tokens - s.generated
+            raw = raw[:rem]
+            n = len(raw)
+            kept = min(n, a)
+            bonus_emitted = n == a + 1
+            proposed = sum(len(b) for b in plan.branches)
+            try:
+                if winner > 0:
+                    # a side branch won: the slot ADOPTS its fork (the
+                    # fork owns base refs + the branch's draft pages);
+                    # the original — and branch 0's draft tail with it
+                    # — retires uncached
+                    f = plan.forks[winner - 1]
+                    plan.forks[winner - 1] = None
+                    f.prefill_from = s.seq.prefill_from
+                    f.span = s.seq.span
+                    self.store.retire(s.seq, cache=False)
+                    s.seq = f
+                    plan.speculated = True   # fork tail rolls back below
+                # reject: truncate to the accepted prefix, releasing
+                # the rejected tail's pages
+                self.store.rollback(s.seq, plan.base + kept)
+                plan.speculated = False
+                for f in plan.forks:
+                    if f is not None:
+                        self.store.retire(f, cache=False)
+                plan.forks = []
+                if kv_rows is None:
+                    # token-harness pages: the stand-in bytes landed at
+                    # speculate time — accepting IS the cursor advance
+                    self.store.commit_draft(s.seq, plan.base + kept)
+            except Exception as e:
+                self._spec_release(plan)
+                self._retire(i, errors.RpcError(
+                    errors.EINTERNAL,
+                    f"spec commit failed: {type(e).__name__}: {e}"))
+                continue
+            if kv_rows is not None:
+                # accepted rows' REAL K/V (row 0 = the query position,
+                # exactly the plain step's write) — staged for ONE
+                # batched splice across all slots
+                rows_sel = np.take(kv_rows[i], [0] + path[:kept],
+                                   axis=0)
+                writes.append((s.seq, plan.base - 1, rows_sel))
+                write_owner.append(i)
+            staged[i] = {"emit": raw, "kept": kept,
+                         "bonus_emitted": bonus_emitted,
+                         "proposed": proposed}
+        fails = self.store.write_kv_batch(writes) if writes else []
+        for wi, e in fails:
+            i = write_owner[wi]
+            staged.pop(i, None)
+            self._retire(i, errors.RpcError(
+                errors.EINTERNAL,
+                f"KV write failed: {type(e).__name__}: {e}"))
+        # ---- bookkeeping + emission ----
+        deliver: list = []        # (slot index, slot, [tokens])
+        for i, s in active:
+            st = staged.get(i)
+            if st is None:
+                continue
+            if self._slots[i] is not s:
+                # an emitter CANCELLED the slot mid-commit: its release
+                # retired whichever seq the slot held when it ran — if
+                # that was before a side-branch adopt swapped s.seq,
+                # the adopted fork is still ours to release.  A
+                # supervisor TAKEOVER instead keeps the seq alive for
+                # detach/re-admission.
+                if not self._taken_over:
+                    try:
+                        if s.seq is not None and not s.seq.retired:
+                            self.store.retire(s.seq, cache=False)
+                    except Exception:
+                        pass
+                continue
+            raw, kept = st["emit"], st["kept"]
+            n = len(raw)
+            if st["bonus_emitted"]:
+                try:
+                    self.store.extend(s.seq, raw[-1])
+                except MemoryError as e:
+                    self._retire(i, errors.RpcError(
+                        errors.ELIMIT, f"KV page alloc failed: {e}"))
+                    continue
+                except Exception as e:
+                    self._retire(i, errors.RpcError(
+                        errors.EINTERNAL,
+                        f"KV extend failed: {type(e).__name__}: {e}"))
+                    continue
+            if len(s.seq.pages) > self.max_pages_per_slot:
+                self._retire(i, errors.RpcError(
+                    errors.ELIMIT,
+                    f"page table overflow "
+                    f"(> {self.max_pages_per_slot} pages)"))
+                continue
+            s.last_token = raw[-1]
+            s.position = len(s.seq.tokens)
+            s.generated += n
+            s.steps_run += 1
+            s.spec_steps += 1
+            s.spec_proposed += st["proposed"]
+            s.spec_accepted += kept
+            SPEC_PROPOSED.add(st["proposed"])
+            SPEC_ACCEPTED.add(kept)
+            self.tokens_out.add(n)
+            hostcpu.tokens_total.add(n)
+            if s.last_tok_t:
+                # one inter-BURST gap per verify: tokens genuinely
+                # arrive together, so per-token zeros would only bury
+                # the real cadence
+                gap = t_tok - s.last_tok_t
+                ITL_REC.add(int(gap * 1e6))
+                s.itl_n += 1
+                s.itl_sum_s += gap
+                if gap > s.itl_max_s:
+                    s.itl_max_s = gap
+            else:
+                s.t_first_tok = t_tok
+                ttft_us = int((t_tok - s.req.t_submit) * 1e6)
+                TTFT_REC.add(ttft_us)
+                if s.span is not rpcz.NULL_SPAN:
+                    s.span.annotate(f"first token: ttft_us={ttft_us}")
+            s.last_tok_t = t_tok
+            deliver.append((i, s, raw))
+        pushed = self._push_token_runs(deliver)
+        for (i, s, raw), ok in zip(deliver, pushed):
+            if not ok:
+                self.emit_cut.add(1)
+                if s.span is not rpcz.NULL_SPAN:
+                    s.span.annotate(
+                        f"emit-buffer stall: {self.emit_buffer} "
+                        f"buffered tokens undrained, consumer cut")
+                self._retire(i, errors.RpcError(
+                    errors.EOVERCROWDED,
+                    "slow stream consumer: emit buffer overflow"))
+                continue
+            if s.generated >= s.req.max_new_tokens or \
+                    (self.eos_token is not None
+                     and raw[-1] == self.eos_token):
+                self._retire(i, None)
+        hostcpu.add("decode_step",
+                    (time.thread_time() - t_cpu0 - fn_cpu_s) * 1e6)
+        hostcpu.add("model_compute", fn_cpu_s * 1e6)
+        return True
+
+    def _push_token_runs(self, deliver: list) -> list:
+        """THE emit fan-out (ISSUE 9/11): each entry is ``(i, slot,
+        [tokens])`` — one token per slot from the plain step, a verify
+        burst from the speculative step.  Every native ring's run rides
+        the one GIL-released ``push_many`` as consecutive (handle,
+        token) pairs (the ring preserves call order), Python _EmitBufs
+        push token by token.  An entry reads False when ANY of its
+        tokens failed to land — the consumer is cut with EOVERCROWDED,
+        so a partially-delivered burst only ever precedes an error
+        terminal, never a silent gap in a healthy stream.  The slot
+        objects in ``deliver`` hold their requests (and so the ring
+        wrappers) alive across the native call — a racing emitter
+        cancel can retire the slot but never free the ring under
+        us."""
         if not deliver:
             return []
         ok = [True] * len(deliver)
-        native = []
-        for k, (i, s, nxt) in enumerate(deliver):
+        native = []               # flat (entry idx, token) pairs
+        for k, (i, s, toks) in enumerate(deliver):
             buf = s.req.buf
             if isinstance(buf, _NativeEmitBuf):
-                native.append(k)
+                native.extend((k, t) for t in toks)
             else:
-                ok[k] = buf.push(nxt)
+                for t in toks:
+                    if not buf.push(t):
+                        ok[k] = False
+                        break
         if native:
             h, t = self._push_handles, self._push_toks
-            for j, k in enumerate(native):
+            for j, (k, tk) in enumerate(native):
                 h[j] = deliver[k][1].req.buf.handle
-                t[j] = deliver[k][2]
+                t[j] = tk
             native_path._core_lib().core.brpc_tokring_push_many(
                 h, t, len(native), self._push_ok)
-            for j, k in enumerate(native):
-                ok[k] = bool(self._push_ok[j])
+            for j, (k, _) in enumerate(native):
+                if not self._push_ok[j]:
+                    ok[k] = False
         return ok
 
     def _release_slot_locked(self, i: int, cache_ok: bool = True):
@@ -972,12 +1420,40 @@ class DecodeEngine:
             if s.t_first_tok else 0
         itl_avg_us = int(s.itl_sum_s / s.itl_n * 1e6) if s.itl_n else 0
         itl_max_us = int(s.itl_max_s * 1e6)
+        # per-generation speculative-decoding summary (ISSUE 11):
+        # acceptance and depth for the decode span and the
+        # /serving/generations ring — the numbers that say whether the
+        # draft is earning its keep for THIS traffic
+        spec = None
+        if self._draft is not None and s.spec_steps:
+            spec = {
+                "spec_proposed": s.spec_proposed,
+                "spec_accepted": s.spec_accepted,
+                "accept_rate": round(
+                    s.spec_accepted / s.spec_proposed, 4)
+                if s.spec_proposed else 0.0,
+                "draft_depth": round(
+                    s.spec_proposed / s.spec_steps, 2),
+                # over ALL engine iterations, including the plain-step
+                # fallbacks a cold context rides before drafts land —
+                # the number that says what speculation bought the
+                # whole generation
+                "tokens_per_step": round(
+                    s.generated / max(1, s.steps_run), 2),
+            }
         span = s.span
         if span is not rpcz.NULL_SPAN:
             span.error_code = span.error_code or err_code
             span.annotate(
                 f"retired: generated={s.generated} ttft_us={ttft_us} "
                 f"itl_avg_us={itl_avg_us} itl_max_us={itl_max_us}")
+            if spec is not None:
+                span.annotate(
+                    f"speculative: accept_rate={spec['accept_rate']} "
+                    f"draft_depth={spec['draft_depth']} "
+                    f"tokens_per_step={spec['tokens_per_step']} "
+                    f"({spec['spec_accepted']}/{spec['spec_proposed']} "
+                    f"drafts accepted over {s.spec_steps} verifies)")
             rpcz.submit(span)
         try:
             from brpc_tpu import serving as _serving
@@ -994,6 +1470,7 @@ class DecodeEngine:
                 "itl_max_us": itl_max_us,
                 "duration_us": dur_us,
                 "error_code": err_code,
+                **(spec or {}),
             })
         except Exception:
             pass  # a console-ring bug must never break a retire
@@ -1111,7 +1588,11 @@ class DecodeEngine:
             "degraded_clamp": self.degraded_clamp,
             "runner": self.runner.name,
             "vector_kv": self._vector_kv,
+            "speculative": self._draft is not None,
         }
+        if self._draft is not None:
+            out["draft"] = getattr(self._draft, "name", "draft")
+            out["draft_len"] = self.draft_len
         if self.store is not None:
             out["kvcache"] = self.store.name
         return out

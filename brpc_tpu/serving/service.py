@@ -97,7 +97,13 @@ class ServingService(Service):
                 hit = int(store.probe(prompt))
             except Exception:
                 hit = 0
-        rid = self._engine.submit(prompt, max_new, emit, on_done)
+        kw = {}
+        if "speculative" in req:
+            # per-request opt-out of the engine's draft proposals
+            # (ISSUE 11); only forwarded when the client says so, so
+            # engine-shaped submitters without the keyword still work
+            kw["speculative"] = bool(req["speculative"])
+        rid = self._engine.submit(prompt, max_new, emit, on_done, **kw)
         return {"accepted": True, "req_id": rid, "prefix_hit": hit}
 
 

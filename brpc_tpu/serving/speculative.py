@@ -1,0 +1,202 @@
+"""Draft proposers for speculative decoding (ISSUE 11).
+
+The DecodeEngine's speculative mode is propose -> verify -> commit:
+something cheap PROPOSES the next k tokens (a linear chain, or a
+shallow tree of alternative continuations), the TARGET model verifies
+the whole proposal in one paged-attention call, and the longest
+greedy-matching prefix commits — output is bit-identical to plain
+greedy decode, only the cost per emitted token changes.  This module
+is the "something cheap":
+
+  :class:`DraftProposer`       the contract — ``propose(tokens, k)``
+                               returns a list of BRANCHES (each a
+                               token chain continuing the context;
+                               branch order is priority, total tokens
+                               across branches <= k).  One branch is a
+                               linear chain; several are a draft tree.
+  :class:`NGramProposer`       prompt-lookup decoding: the longest
+                               recent n-gram suffix match in the
+                               context predicts what follows.  Pure
+                               host work — the draft cost the ISSUE's
+                               "draft runner ≪ target runner" bench
+                               operating point assumes — and very
+                               accurate on self-repeating output
+                               (which greedy decode produces in
+                               abundance).
+  :class:`DraftModelProposer`  a small draft MODEL: greedy chains via
+                               the cache-less dense forward of
+                               ``models/runner.py``.  ``width > 1``
+                               branches from the top-w first tokens —
+                               the draft-tree shape.
+
+``as_proposer`` adapts what the engine is handed: a proposer passes
+through, a :class:`~brpc_tpu.models.runner.TransformerRunner` (or
+anything carrying ``params``/``cfg``) wraps as a DraftModelProposer.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["DraftProposer", "NGramProposer", "DraftModelProposer",
+           "as_proposer"]
+
+
+class DraftProposer:
+    """The proposer contract (see module docstring)."""
+
+    name = "draft"
+
+    def propose(self, tokens: Sequence[int],
+                k: int) -> list[list[int]]:
+        """Up to ``k`` draft tokens continuing ``tokens``, as a list
+        of branches (possibly empty — propose nothing when there is no
+        basis for a guess; the engine then runs a plain step)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class NGramProposer(DraftProposer):
+    """Prompt-lookup drafts: find the most recent earlier occurrence
+    of the context's longest suffix n-gram (n down to 1) and propose
+    the tokens that followed it.  ``width > 1`` proposes one branch
+    per DISTINCT continuation over the most recent matches — a shallow
+    draft tree for contexts whose history diverges."""
+
+    def __init__(self, n: int = 3, width: int = 1,
+                 window: int = 256, name: str = "ngram"):
+        if n < 1 or width < 1 or window < 2:
+            raise ValueError("n, width and window must be sane")
+        self.n = int(n)
+        self.width = int(width)
+        # bounded LOOKBACK: propose from the last `window` tokens only
+        # — the scan runs on the engine's step-loop thread every
+        # iteration, and an unbounded match over a 32k context would
+        # make proposer cost grow with sequence length.  Self-repeating
+        # output (the regime where drafts accept at all) cycles well
+        # inside a few hundred tokens.
+        self.window = int(window)
+        self.name = name
+
+    def _matches(self, toks: list, g: int) -> list[int]:
+        """End positions (exclusive) of earlier occurrences of the
+        length-``g`` suffix, most recent first."""
+        suf = toks[-g:]
+        out = []
+        for j in range(len(toks) - g - 1, -1, -1):
+            if toks[j:j + g] == suf:
+                out.append(j + g)
+        return out
+
+    def propose(self, tokens: Sequence[int],
+                k: int) -> list[list[int]]:
+        toks = [int(t) for t in tokens[-self.window:]]
+        if k < 1 or len(toks) < 2:
+            return []
+        for g in range(min(self.n, len(toks) - 1), 0, -1):
+            ends = self._matches(toks, g)
+            if not ends:
+                continue
+            per = max(1, k // self.width)
+            branches: list[list[int]] = []
+            seen_first = set()
+            budget = k
+            for e in ends:
+                if len(branches) >= self.width or budget <= 0:
+                    break
+                want = min(per, budget)
+                cont = toks[e:e + want]
+                if not cont or cont[0] in seen_first:
+                    continue
+                if len(cont) < want:
+                    # the most recent occurrence sits too close to the
+                    # end to supply a full chain (the common case on a
+                    # short-period cycle); prefer an EARLIER occurrence
+                    # of the same continuation with more road ahead
+                    for e2 in ends:
+                        c2 = toks[e2:e2 + want]
+                        if c2 and c2[0] == cont[0] \
+                                and len(c2) > len(cont):
+                            cont = c2
+                            if len(cont) >= want:
+                                break
+                seen_first.add(cont[0])
+                branches.append(cont)
+                budget -= len(cont)
+            if branches:
+                return branches
+        return []
+
+
+class DraftModelProposer(DraftProposer):
+    """A small draft model as the proposer: greedy continuation chains
+    through the cache-less dense forward (``models/runner.py``).  Cost
+    scales with the draft model's size — the point is a draft much
+    smaller than the target.  ``width > 1`` branches on the top-w
+    first tokens, each extended greedily (the draft-tree shape)."""
+
+    def __init__(self, params: dict, cfg, *, width: int = 1,
+                 name: str = "draft-model"):
+        if width < 1:
+            raise ValueError("width must be >= 1")
+        self.params = params
+        self.cfg = cfg
+        self.width = int(width)
+        self.name = name
+
+    def _next_logits(self, toks: list):
+        import jax.numpy as jnp
+
+        from brpc_tpu.models.runner import dense_forward
+        t = jnp.asarray([toks], jnp.int32)
+        p = jnp.arange(len(toks), dtype=jnp.int32)[None]
+        return dense_forward(self.params, self.cfg, t, p)[0, -1]
+
+    def propose(self, tokens: Sequence[int],
+                k: int) -> list[list[int]]:
+        import jax.numpy as jnp
+        toks = [int(t) for t in tokens]
+        if k < 1 or not toks:
+            return []
+        logits = self._next_logits(toks)
+        width = min(self.width, k)
+        if width == 1:
+            firsts = [int(jnp.argmax(logits))]
+        else:
+            firsts = [int(i) for i in
+                      jnp.argsort(logits)[::-1][:width]]
+        per = max(1, k // len(firsts))
+        branches = []
+        budget = k
+        for t0 in firsts:
+            if budget <= 0:
+                break
+            b = [t0]
+            cur = toks + [t0]
+            while len(b) < min(per, budget):
+                nxt = int(jnp.argmax(self._next_logits(cur)))
+                b.append(nxt)
+                cur.append(nxt)
+            branches.append(b)
+            budget -= len(b)
+        return branches
+
+
+def as_proposer(draft) -> Optional[DraftProposer]:
+    """Adapt the engine's ``draft_runner=`` argument: None passes
+    through, a proposer passes through, a model runner carrying
+    ``params``/``cfg`` (TransformerRunner) wraps as a
+    :class:`DraftModelProposer`."""
+    if draft is None:
+        return None
+    if hasattr(draft, "propose"):
+        return draft
+    params = getattr(draft, "params", None)
+    cfg = getattr(draft, "cfg", None)
+    if params is not None and cfg is not None:
+        return DraftModelProposer(params, cfg,
+                                  name=f"draft:{getattr(draft, 'name', 'model')}")
+    raise ValueError(
+        f"draft_runner must be a DraftProposer or a model runner with "
+        f"params/cfg, got {type(draft).__name__}")

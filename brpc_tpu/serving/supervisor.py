@@ -90,14 +90,18 @@ class _SupReq:
     __slots__ = ("sid", "prompt", "max_new_tokens", "user_emit",
                  "user_done", "emitted", "restarts", "finished", "pin",
                  "resumed", "trace", "attempt_span", "last_span_id",
-                 "t_start", "mu", "delivery_mu")
+                 "t_start", "mu", "delivery_mu", "speculative")
 
-    def __init__(self, prompt, max_new_tokens, emit, on_done):
+    def __init__(self, prompt, max_new_tokens, emit, on_done,
+                 speculative: bool = True):
         self.sid = next(_sup_req_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.user_emit = emit
         self.user_done = on_done
+        # sticky across restarts: a re-admission keeps the request's
+        # speculative opt-in/out (ISSUE 11)
+        self.speculative = bool(speculative)
         self.emitted: list[int] = []   # the exactly-once cursor
         self.restarts = 0
         self.finished = False
@@ -234,7 +238,8 @@ class EngineSupervisor:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                emit: Callable[[int], None],
-               on_done: Optional[Callable] = None) -> int:
+               on_done: Optional[Callable] = None, *,
+               speculative: bool = True) -> int:
         """Supervised generation: same contract as DecodeEngine.submit
         — tokens via ``emit`` (exactly once each, across any number of
         engine restarts), one terminal ``on_done(err)`` — plus
@@ -248,7 +253,8 @@ class EngineSupervisor:
         if self.level >= 2:
             max_new_tokens = min(int(max_new_tokens),
                                  self.clamp_new_tokens)
-        sreq = _SupReq(prompt, max_new_tokens, emit, on_done)
+        sreq = _SupReq(prompt, max_new_tokens, emit, on_done,
+                       speculative=speculative)
         with self._mu:
             if self._closing or self._failed:
                 closing = True
@@ -290,7 +296,8 @@ class EngineSupervisor:
         rid = eng.submit(resume_prompt, remaining,
                          lambda tok, s=sreq: self._emit(s, tok),
                          lambda err, s=sreq: self._req_done(s, err),
-                         clamp=False, trace_ctx=ctx)
+                         clamp=False, trace_ctx=ctx,
+                         speculative=sreq.speculative)
         with self._mu:
             self._by_rid[rid] = sreq
         return True

@@ -1850,3 +1850,122 @@ def test_router_replica_kill_client_drop_resume(seed):
                 pass
             st.clear()
             st.close()
+
+
+# ---------------------------------------------------------------------------
+# scenario 15 (ISSUE 11): crash MID-VERIFY in the speculative engine ->
+# supervisor resumes every stream bit-exact vs the plain-decode oracle
+# with ZERO leaked draft pages
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_spec_verify_crash_resumes_bit_exact_no_draft_leaks(seed):
+    """The speculative engine under supervision, crash injected at the
+    ``serving.spec_verify`` fault site — between the draft LEASES
+    (in-seq cursor pages + side-branch forks) being taken and the
+    verify committing any of them:
+
+    * every stream completes exactly-once and matches the plain greedy
+      dense oracle token for token (speculation changes cost, never
+      output — including across a crash/restart seam);
+    * ZERO leaked draft pages: live_seqs, page refcounts, the page
+      free-list, HBM block occupancy and the native emit rings all
+      return to baseline (a rejected-or-crashed draft lease releases
+      like any other holder);
+    * the resumed decode was cheaper than a from-scratch replay
+      (committed pages prefix-hit across the restart).
+    """
+    import gc
+
+    from brpc_tpu import native_path
+    from brpc_tpu.models.runner import (TransformerRunner,
+                                        make_store_for)
+    from brpc_tpu.serving import (DecodeEngine, EngineSupervisor,
+                                  NGramProposer)
+
+    m = _mr_chaos_model()
+    cfg, params = m["cfg"], m["params"]
+    store = make_store_for(cfg, page_tokens=4, max_blocks=32,
+                           name=f"spec_chaos_kv{seed}")
+    device_pool = store.pagepool.pool
+
+    def occupancy():
+        with device_pool._lock:
+            return {c: len(device_pool._free[c])
+                    for c in device_pool._free}
+
+    free0 = occupancy()
+    gc.collect()
+    ring0 = native_path.tokring_live()
+    runner = TransformerRunner(params, cfg, store=store,
+                               name=f"spec_chaos_m{seed}")
+    calm = ({"queue_delay_us": float("inf"), "pool_ratio": 9.9,
+             "queue_depth": 1e9},) * 3
+    sup = EngineSupervisor(
+        lambda: DecodeEngine(runner=runner, num_slots=2, store=store,
+                             max_pages_per_slot=24,
+                             prefill_buckets=(8, 16),
+                             draft_runner=NGramProposer(width=2),
+                             draft_len=4,
+                             name=f"spec_chaos_e{seed}"),
+        store=store, heartbeat_deadline_s=10.0, check_interval_s=0.02,
+        ladder=calm, name=f"spec_chaos{seed}")
+    try:
+        # jit warm + commit a shared 2-page prefix into the radix tree
+        shared = [50, 61, 12, 73, 24, 85, 36, 97]
+        done = threading.Event()
+        sup.submit(shared + [1], 2, lambda t: None,
+                   lambda e: done.set())
+        assert done.wait(180)
+        assert sup.join_idle(30)
+        h0 = store.hit_tokens.get_value()
+        p0 = store.prompt_tokens.get_value()
+
+        plan = fault.FaultPlan(seed)
+        plan.on("serving.spec_verify", fault.ERROR, times=1, after=2)
+        prompts = [shared + [100 + i] for i in range(4)]
+        sinks = []
+        with fault.injected(plan):
+            for p in prompts:
+                ev = threading.Event()
+                toks: list = []
+                errs: list = []
+                sinks.append((ev, toks, errs))
+                sup.submit(p, 6, toks.append,
+                           lambda e, ev=ev, errs=errs: (errs.append(e),
+                                                        ev.set()))
+            for ev, _, _ in sinks:
+                assert ev.wait(240), \
+                    "generation hung across the mid-verify crash"
+        assert plan.injected["serving.spec_verify"] == 1
+        st = sup.stats()
+        assert st["restarts"] == 1
+        assert st["last_recovery"]["stolen_slots"] >= 1
+        # exactly-once + bit-exact vs the plain greedy oracle across
+        # the crash seam
+        for p, (ev, toks, errs) in zip(prompts, sinks):
+            assert errs == [None], f"{p[-1]}: {errs}"
+            assert toks == _mr_expected(p, 6), \
+                f"req {p[-1]}: speculative stream diverged at the seam"
+        # the resume prefix-hit committed pages (cheaper than replay)
+        dp = store.prompt_tokens.get_value() - p0
+        dh = store.hit_tokens.get_value() - h0
+        assert dp > 0 and (dp - dh) / dp < 1.0, \
+            "recovery re-decoded as much as a from-scratch replay"
+        # zero leaked draft pages: every lease (in-seq cursor, forks)
+        # released across crash + takeover + rebuild
+        assert sup.join_idle(30)
+        assert wait_until(
+            lambda: store.stats()["live_seqs"] == 0, 10), \
+            "a draft lease (fork or main seq) out-lived its request"
+        store.clear()
+        store.pagepool.assert_consistent()
+        assert store.pagepool.blocks_leased() == 0
+        assert wait_until(lambda: occupancy() == free0, 10), \
+            f"KV blocks leaked: {occupancy()} != {free0}"
+    finally:
+        sup.close()
+        store.close()
+    assert wait_until(
+        lambda: (gc.collect(), native_path.tokring_live())[1] <= ring0,
+        10), "native emit rings leaked across the speculative restart"

@@ -621,3 +621,146 @@ def test_detach_commits_prefix_and_pins_against_eviction():
         assert len(st.detach(re)) == 0
     finally:
         st.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11: draft leases — speculate / rollback / commit_draft, and the
+# fork lifecycle exercised in anger
+# ---------------------------------------------------------------------------
+
+def test_speculate_rollback_releases_pages_to_baseline():
+    """The in-seq draft cursor: speculate appends across page
+    boundaries WITHOUT materializing (kv_filled holds, nothing can
+    cache), rollback releases exactly the rejected tail's pages, and
+    commit_draft advances the cursor over an accepted prefix."""
+    st = _mk_store("t_spec_rb")
+    try:
+        seq = st.admit([1, 2, 3, 4, 5])          # 1 full page + 1 slot
+        assert seq.kv_filled == 5
+        before = st.pagepool.pages_in_use()
+        st.speculate(seq, [10, 11, 12, 13, 14, 15, 16])   # 3 pages now
+        assert len(seq.tokens) == 12 and len(seq.pages) == 3
+        assert seq.kv_filled == 5, "a draft must not materialize"
+        # an unverified draft can never reach the radix tree
+        st.retire(st.fork(seq), cache=True)
+        assert st.probe([1, 2, 3, 4, 5, 10, 11, 12, 99]) == 4
+        # accept 3 drafts, reject the rest: tokens truncate, the
+        # rejected pages return, the cursor covers the accepted run
+        st.rollback(seq, 8)
+        st.commit_draft(seq, 8)
+        assert seq.tokens == [1, 2, 3, 4, 5, 10, 11, 12]
+        assert seq.kv_filled == 8 and len(seq.pages) == 2
+        assert st.pagepool.pages_in_use() == before
+        assert st.stats()["rolled_back_pages"] >= 1
+        # guard rails: never below the materialized prefix, never past
+        # the appended tokens
+        with pytest.raises(ValueError):
+            st.rollback(seq, 7)
+        with pytest.raises(ValueError):
+            st.commit_draft(seq, 99)
+        st.retire(seq, cache=False)
+        st.clear()      # drop the tree's ref from the fork's commit
+        assert st.pagepool.blocks_leased() == 0
+    finally:
+        st.close()
+
+
+def test_fork_extend_reject_release_refcount_math():
+    """The fork lifecycle unit suite (ISSUE 11): fork -> speculate
+    (COW isolates the shared tail) -> reject (retire) returns every
+    refcount and block to baseline, and the base sequence's bytes
+    survive untouched."""
+    st = _mk_store("t_fork_math", max_blocks=16)
+    try:
+        seq = st.admit([1, 2, 3, 4, 5, 6])       # page0 full, page1 half
+        tail = seq.pages[-1]
+        assert tail.refs == 1
+        f = st.fork(seq)
+        assert tail.refs == 2, "fork must share the tail page"
+        assert [p.pid for p in f.pages] == [p.pid for p in seq.pages]
+        # divergence: the fork's first append COWs the shared tail
+        st.speculate(f, [70, 71, 72])
+        assert f.pages[1].pid != tail.pid, "no COW on shared tail"
+        assert tail.refs == 1
+        assert st.stats()["cow_forks"] >= 1
+        # base unpolluted: its tail slot order/content unchanged
+        assert st.pagepool.read(seq.pages[1], 2).tolist() == [5, 6]
+        # reject the whole branch: fork pages all release
+        st.retire(f, cache=False)
+        assert tail.refs == 1 and seq.pages[0].refs == 1
+        st.retire(seq, cache=False)
+        st.pagepool.assert_consistent()
+        assert st.pagepool.blocks_leased() == 0
+    finally:
+        st.close()
+
+
+def test_fork_lifecycle_under_concurrent_load():
+    """Fork in anger: a thread storm of fork -> speculate -> rollback
+    -> retire churn against live base sequences — refcounts, the
+    free list and block occupancy all return to baseline, and no
+    base sequence's tokens are disturbed."""
+    st = _mk_store("t_fork_storm", max_blocks=32)
+    try:
+        bases = [st.admit([100 * k + j for j in range(6)])
+                 for k in range(4)]
+        errs: list = []
+
+        def storm(k):
+            try:
+                for i in range(25):
+                    b = bases[(k + i) % len(bases)]
+                    f = st.fork(b)
+                    st.speculate(f, [1000 + k * 100 + i + j
+                                     for j in range(5)])
+                    if i % 3 == 0:
+                        st.rollback(f, len(b.tokens))
+                    st.retire(f, cache=False)
+            except Exception as e:     # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=storm, args=(k,))
+                   for k in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert errs == [], errs
+        for k, b in enumerate(bases):
+            assert b.tokens == [100 * k + j for j in range(6)]
+            st.retire(b, cache=False)
+        assert st.stats()["live_seqs"] == 0
+        st.clear()
+        st.pagepool.assert_consistent()
+        assert st.pagepool.blocks_leased() == 0
+    finally:
+        st.close()
+
+
+def test_speculate_vector_store_never_commits_unverified_tail():
+    """vector_kv + commit_live_pages (the StandbySync pairing): a
+    draft that fills whole pages must not stream-commit them — only
+    write_kv_batch's final advance (the verify commit) publishes, and
+    only over the accepted prefix."""
+    st = KVCacheStore(page_tokens=PT, page_bytes=PB, max_blocks=8,
+                      vector_kv=True, commit_live_pages=True,
+                      name="t_spec_live")
+    try:
+        seq = st.admit([1, 2, 3, 4, 5])
+        rows = np.arange(5 * 16, dtype=np.uint8).reshape(5, 16)
+        assert st.write_kv_batch([(seq, 0, rows)]) == []
+        assert seq.kv_filled == 5
+        nodes0 = st.radix.node_count()
+        st.speculate(seq, [10, 11, 12])          # fills page 2 exactly
+        assert st.radix.node_count() == nodes0, \
+            "an unverified draft page was live-committed"
+        acc = np.arange(3 * 16, dtype=np.uint8).reshape(3, 16) + 7
+        assert st.write_kv_batch([(seq, 5, acc)]) == []
+        assert seq.kv_filled == 8
+        assert st.radix.node_count() > nodes0, \
+            "the verified commit should live-publish the filled page"
+        st.retire(seq, cache=False)
+        st.clear()
+        assert st.pagepool.blocks_leased() == 0
+    finally:
+        st.close()

@@ -352,3 +352,104 @@ def test_arena_rows_stable_across_block_churn():
     pool.unref(a)
     assert pool.blocks_leased() == 0
     assert pool.flat_ids([a.pid]) == [-1]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_kernel_local_block_mixed_accept_depths(backend):
+    """ISSUE 11: the speculative-verify LOCAL KEY BLOCK — slots at
+    DIFFERENT accept depths batched in one fixed-shape call.  Rows
+    group into (slot, draft-row) pairs; each row attends over its
+    arena prefix (per-row lengths) plus the group's in-call keys under
+    the ancestry mask.  Per-row dense oracle: arena keys truncated to
+    the row's length, then exactly the masked-visible local keys
+    appended.  Covers a zero-draft slot (self only — the plain-step
+    shape), a linear chain at full depth, a short chain, and a TREE
+    (two branches sharing the root), plus a fully-padded row (no
+    arena, no visible local keys -> zeros, never NaN)."""
+    rng = np.random.default_rng(23)
+    P, T, Hkv, D, H, MP = 10, 4, 2, 8, 4, 4
+    S, K1 = 4, 4                      # 4 slots x (1 + up to 3 drafts)
+    N = S * K1
+    kp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    vp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    q = rng.standard_normal((N, H, D)).astype(np.float32)
+    lk = rng.standard_normal((S, K1, Hkv, D)).astype(np.float32)
+    lv = rng.standard_normal((S, K1, Hkv, D)).astype(np.float32)
+    tables = np.full((N, MP), -1, np.int32)
+    lengths = np.zeros((N,), np.int32)
+    mask = np.zeros((S, K1, K1), bool)
+
+    def slot(s, pages, base, rows_mask):
+        for r, vis in enumerate(rows_mask):
+            if vis is None:
+                continue              # padded row
+            i = s * K1 + r
+            tables[i, :len(pages)] = pages
+            lengths[i] = base
+            for j in vis:
+                mask[s, r, j] = True
+
+    # slot 0: zero drafts — row 0 sees arena + itself (plain step)
+    slot(0, [2, 5], 6, [[0], None, None, None])
+    # slot 1: full linear chain, accept depth 3
+    slot(1, [1, 3, 7], 9, [[0], [0, 1], [0, 1, 2], [0, 1, 2, 3]])
+    # slot 2: short chain (depth 1), rest padded
+    slot(2, [8], 2, [[0], [0, 1], None, None])
+    # slot 3: TREE — two single-token branches off the shared root
+    slot(3, [0, 9], 5, [[0], [0, 1], [0, 2], None])
+    out = _run_local(backend, q, kp, vp, tables, lengths, lk, lv, mask)
+    for s in range(S):
+        for r in range(K1):
+            i = s * K1 + r
+            vis = [j for j in range(K1) if mask[s, r, j]]
+            if not vis and lengths[i] == 0:
+                np.testing.assert_array_equal(
+                    out[i], np.zeros_like(out[i]),
+                    err_msg=f"padded row {i} must yield zeros")
+                continue
+            ids = [int(x) for x in tables[i] if x >= 0]
+            k = kp[ids].reshape(-1, Hkv, D)[:lengths[i]]
+            v = vp[ids].reshape(-1, Hkv, D)[:lengths[i]]
+            k = np.concatenate([k, lk[s, vis]])
+            v = np.concatenate([v, lv[s, vis]])
+            ref = np.asarray(local_attention(
+                jnp.asarray(q[i][None, None]),
+                jnp.asarray(k[None]), jnp.asarray(v[None])))[0, 0]
+            np.testing.assert_allclose(
+                out[i], ref, atol=1e-5,
+                err_msg=f"slot {s} draft row {r} (accept-depth mix)")
+
+
+def _run_local(backend, q, kp, vp, tables, lengths, lk, lv, mask):
+    args = [jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(tables), jnp.asarray(lengths)]
+    kw = dict(local_k=jnp.asarray(lk), local_v=jnp.asarray(lv),
+              local_mask=jnp.asarray(mask))
+    if backend == "gather":
+        return np.asarray(paged_attention_gather(*args, **kw))
+    return np.asarray(paged_attention_pallas(*args, interpret=True,
+                                             **kw))
+
+
+def test_kernel_local_block_rejects_bad_shapes():
+    """extra_k and local_k are mutually exclusive; the local block's
+    groups must tile the query rows exactly."""
+    rng = np.random.default_rng(3)
+    P, T, Hkv, D, H = 2, 4, 2, 8, 4
+    kp = rng.standard_normal((P, T, Hkv, D)).astype(np.float32)
+    q = rng.standard_normal((4, H, D)).astype(np.float32)
+    tables = np.zeros((4, 1), np.int32)
+    lengths = np.ones((4,), np.int32)
+    lk = rng.standard_normal((2, 2, Hkv, D)).astype(np.float32)
+    ek = rng.standard_normal((4, Hkv, D)).astype(np.float32)
+    with pytest.raises(ValueError):
+        paged_attention(q, kp, kp, tables, lengths,
+                        extra_k=ek, extra_v=ek,
+                        local_k=lk, local_v=lk,
+                        local_mask=np.ones((2, 2, 2), bool))
+    with pytest.raises(ValueError):
+        paged_attention(q, kp, kp, tables, lengths,
+                        local_k=lk, local_v=lk,
+                        local_mask=np.ones((3, 2, 2), bool))
+    with pytest.raises(ValueError):
+        paged_attention(q, kp, kp, tables, lengths, local_k=lk)

@@ -1,0 +1,387 @@
+"""Speculative decoding (ISSUE 11).
+
+The acceptance bar is IDENTITY: a speculative engine must emit
+token-for-token exactly what plain greedy decode emits — the draft
+changes cost, never output — at every draft depth, cold and warm,
+mixed with non-speculative slots, through Serving.Generate, and for
+both the real TransformerRunner and the legacy fn harness.  Plus the
+machinery around it: the batched KV splice primitive, the draft-tree
+(fork) path, budget/eos clamps, lease release on a crashed verify,
+and the acceptance observability.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import brpc_tpu as brpc
+from brpc_tpu import errors, fault
+from brpc_tpu.kvcache import KVCacheStore
+from brpc_tpu.models.runner import (TransformerConfig, TransformerRunner,
+                                    dense_generate, init_runner_params,
+                                    make_store_for)
+from brpc_tpu.serving import (DecodeEngine, DraftModelProposer,
+                              NGramProposer)
+from brpc_tpu.serving.speculative import as_proposer
+
+jax.config.update("jax_platforms", "cpu")
+
+CFG = TransformerConfig()
+PARAMS = init_runner_params(CFG)
+DEPTHS = (2, 4, 8)
+
+
+def _gen(engine, prompt, n, timeout=180, **kw):
+    toks, errs, ev = [], [], threading.Event()
+    engine.submit(prompt, n, toks.append,
+                  lambda e: (errs.append(e), ev.set()), **kw)
+    assert ev.wait(timeout), "generation hung"
+    assert errs == [None], errs
+    return toks
+
+
+def _spec_engine(tag, depth, proposer=None, **kw):
+    store = make_store_for(CFG, page_tokens=4, max_blocks=32,
+                           name=f"{tag}_kv")
+    runner = TransformerRunner(PARAMS, CFG, store=store, name=f"{tag}_m")
+    eng = DecodeEngine(runner=runner, num_slots=2, store=store,
+                       max_pages_per_slot=24, prefill_buckets=(8, 16),
+                       draft_runner=proposer or NGramProposer(),
+                       draft_len=depth, name=f"{tag}_e", **kw)
+    return store, eng
+
+
+def _close(eng, store):
+    eng.close()
+    store.clear()
+    store.close()
+    assert store.pagepool.blocks_leased() == 0, "KV blocks leaked"
+
+
+# ---------------------------------------------------------------------------
+# identity: speculative == plain greedy, token for token
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth", DEPTHS)
+def test_spec_matches_plain_greedy_cold_and_warm(depth):
+    """The tentpole bar at every draft depth: cold speculative decode
+    == the cache-less dense oracle, and a warm (prefix-hit) rerun is
+    identical again — drafts and prefix reuse both change cost, not
+    output."""
+    store, eng = _spec_engine(f"t_sp_id{depth}", depth)
+    try:
+        prompt = [5, 17, 42, 9, 77, 3]
+        oracle = dense_generate(PARAMS, CFG, prompt, 12)
+        cold = _gen(eng, prompt, 12)
+        assert cold == oracle, \
+            f"depth {depth}: speculative diverged from plain greedy"
+        h0 = store.hit_tokens.get_value()
+        warm = _gen(eng, prompt, 12)
+        assert warm == oracle, f"depth {depth}: warm rerun diverged"
+        assert store.hit_tokens.get_value() > h0, \
+            "warm run did not prefix-hit"
+    finally:
+        _close(eng, store)
+
+
+@pytest.mark.parametrize("depth", DEPTHS)
+def test_spec_tree_draft_model_matches_plain(depth):
+    """Tree-shaped drafts (width-2 DraftModelProposer — side branches
+    ride KVCacheStore.fork, COW isolating the divergent tails) keep
+    the identity bar, and actually exercise fork."""
+    store, eng = _spec_engine(
+        f"t_sp_tree{depth}", depth,
+        proposer=DraftModelProposer(PARAMS, CFG, width=2))
+    try:
+        prompt = [11, 29, 63, 2, 90, 41]
+        assert _gen(eng, prompt, 10) == dense_generate(PARAMS, CFG,
+                                                       prompt, 10)
+        if depth >= 4:
+            assert store.stats()["forks"] > 0, \
+                "width-2 tree never took a side-branch fork"
+    finally:
+        _close(eng, store)
+
+
+def test_spec_mixed_with_plain_slots_in_one_engine():
+    """A speculative and an opted-out request decode in the SAME
+    fixed-shape verify batch; both match their solo oracles."""
+    store, eng = _spec_engine("t_sp_mix", 4)
+    try:
+        pa, pb = [5, 17, 42, 9, 77, 3], [88, 12, 54]
+        ra, rb = [], []
+        eva, evb = threading.Event(), threading.Event()
+        eng.submit(pa, 8, ra.append, lambda e: eva.set())
+        eng.submit(pb, 8, rb.append, lambda e: evb.set(),
+                   speculative=False)
+        assert eva.wait(180) and evb.wait(180)
+        assert ra == dense_generate(PARAMS, CFG, pa, 8)
+        assert rb == dense_generate(PARAMS, CFG, pb, 8)
+        # the opted-out request must never have been drafted for
+        recs = [r for r in brpc.serving.recent_generations(256)
+                if r.get("engine") == "t_sp_mix_e"]
+        by_len = {r["prompt_len"]: r for r in recs}
+        assert by_len[len(pb)].get("spec_proposed", 0) == 0
+    finally:
+        _close(eng, store)
+
+
+def test_spec_legacy_harness_identity_and_acceptance():
+    """The fn-protocol harness rides the same propose->verify->commit
+    loop: a short-cycle step function (so the n-gram draft actually
+    accepts) emits exactly the plain recurrence, and acceptance is
+    surfaced."""
+    store = KVCacheStore(page_tokens=4, page_bytes=4 * 64,
+                         max_blocks=32, name="t_sp_leg_kv")
+
+    @jax.jit
+    def stepfn(tokens, positions, pages):
+        return (tokens * 3 + 11) % 8      # period-4 cycle: drafts hit
+
+    eng = DecodeEngine(stepfn, num_slots=2, store=store,
+                       max_pages_per_slot=24,
+                       draft_runner=NGramProposer(), draft_len=4,
+                       name="t_sp_leg_e")
+    try:
+        t, expect = 3, []
+        for _ in range(16):
+            t = (t * 3 + 11) % 8
+            expect.append(t)
+        assert _gen(eng, [1, 2, 3], 16) == expect
+        rec = [r for r in brpc.serving.recent_generations(256)
+               if r.get("engine") == "t_sp_leg_e"][-1]
+        assert rec["accept_rate"] > 0.3, rec
+        assert rec["tokens_per_step"] > 1.0, rec
+    finally:
+        _close(eng, store)
+
+
+def test_spec_through_serving_generate_with_opt_out():
+    """End-to-end through the RPC surface: Serving.Generate over a
+    speculative engine streams exactly the dense oracle, the
+    per-request ``speculative: false`` opt-out is honored, and the
+    generation ring carries the acceptance aggregate the
+    /serving/generations page renders."""
+    from brpc_tpu.serving.service import register_serving
+
+    class _Collector(brpc.StreamHandler):
+        def __init__(self):
+            self.msgs = []
+            self.done = threading.Event()
+
+        def on_received_messages(self, stream, messages):
+            for m in messages:
+                d = json.loads(m)
+                self.msgs.append(d)
+                if d.get("done"):
+                    self.done.set()
+
+        def on_closed(self, stream):
+            self.done.set()
+
+    store, eng = _spec_engine("t_sp_rpc", 4)
+    s = brpc.Server()
+    register_serving(s, engine=eng)
+    s.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=10_000)
+
+        def call(prompt, n, **extra):
+            col = _Collector()
+            cntl = brpc.Controller()
+            brpc.stream_create(cntl, col)
+            resp = ch.call_sync("Serving", "Generate",
+                                {"prompt": prompt, "max_new_tokens": n,
+                                 **extra},
+                                serializer="json", cntl=cntl)
+            assert resp["accepted"] is True
+            assert col.done.wait(180)
+            return [m["token"] for m in col.msgs if "token" in m]
+
+        prompt = [11, 29, 63, 2, 90, 41]
+        oracle = dense_generate(PARAMS, CFG, prompt, 10)
+        assert call(prompt, 10) == oracle
+        assert call(prompt, 10, speculative=False) == oracle
+        from brpc_tpu.serving import generations_snapshot
+        agg = generations_snapshot()["aggregates"]["speculative"]
+        assert agg["generations"] >= 1
+        assert agg["accept_rate"] > 0.0
+    finally:
+        s.stop()
+        s.join()
+        _close(eng, store)
+
+
+def test_spec_eos_and_budget_clamps_match_plain():
+    """eos mid-draft-burst and a 1-token budget both clamp exactly as
+    plain decode would: the stream stops at eos / the budget, never
+    emits past it, and a budget of 1 never drafts at all."""
+    prompt = [5, 17, 42, 9, 77, 3]
+    oracle = dense_generate(PARAMS, CFG, prompt, 12)
+    eos = oracle[5]     # stop mid-stream, likely inside a burst
+    plain_expect = oracle[:oracle.index(eos) + 1]
+
+    store, eng = _spec_engine("t_sp_eos", 4, eos_token=eos)
+    try:
+        assert _gen(eng, prompt, 12) == plain_expect
+    finally:
+        _close(eng, store)
+
+    store, eng = _spec_engine("t_sp_b1", 4)
+    try:
+        assert _gen(eng, prompt, 1) == oracle[:1]
+        rec = [r for r in brpc.serving.recent_generations(256)
+               if r.get("engine") == "t_sp_b1_e"][-1]
+        assert rec.get("spec_proposed", 0) == 0, \
+            "a 1-token budget must not propose drafts"
+    finally:
+        _close(eng, store)
+
+
+# ---------------------------------------------------------------------------
+# draft-lease hygiene and crash paths
+# ---------------------------------------------------------------------------
+
+def test_spec_verify_crash_unsupervised_definitive_and_baseline():
+    """An unsupervised verify failure (the ``serving.spec_verify``
+    fault site) fails every in-flight request DEFINITIVELY — and every
+    draft lease (in-seq cursor pages and side-branch forks) is
+    released: live_seqs, refcounts and block occupancy return to
+    baseline."""
+    store, eng = _spec_engine(
+        "t_sp_crash", 4,
+        proposer=DraftModelProposer(PARAMS, CFG, width=2))
+    try:
+        plan = fault.FaultPlan(7)
+        plan.on("serving.spec_verify", fault.ERROR, times=1, after=1)
+        errs, ev = [], threading.Event()
+        with fault.injected(plan):
+            eng.submit([5, 17, 42, 9, 77, 3], 12, lambda t: None,
+                       lambda e: (errs.append(e), ev.set()))
+            assert ev.wait(180), "crash terminal never arrived"
+        assert plan.injected["serving.spec_verify"] == 1
+        assert errs and errs[0] is not None
+        assert errs[0].code == errors.EINTERNAL
+        assert eng.join_idle(30)
+        assert store.stats()["live_seqs"] == 0, \
+            "a draft lease survived the crashed verify"
+        store.clear()
+        store.pagepool.assert_consistent()
+        assert store.pagepool.blocks_leased() == 0
+    finally:
+        eng.close()
+        store.close()
+
+
+def test_spec_requires_store_and_valid_depth():
+    runner = TransformerRunner(PARAMS, CFG, name="t_sp_req_m")
+    with pytest.raises(ValueError):
+        DecodeEngine(lambda t, p: t, draft_runner=NGramProposer(),
+                     name="t_sp_req_e")      # no store
+    store = make_store_for(CFG, page_tokens=4, max_blocks=8,
+                           name="t_sp_req_kv")
+    try:
+        with pytest.raises(ValueError):
+            DecodeEngine(runner=runner, store=store,
+                         draft_runner=NGramProposer(), draft_len=0,
+                         name="t_sp_req_e2")
+    finally:
+        store.close()
+    with pytest.raises(ValueError):
+        as_proposer(object())
+
+
+# ---------------------------------------------------------------------------
+# proposers
+# ---------------------------------------------------------------------------
+
+def test_ngram_proposer_prompt_lookup():
+    p = NGramProposer(n=3)
+    # a repeating context: the suffix [1, 2] last occurred followed by
+    # 3, 4, ...
+    assert p.propose([1, 2, 3, 4, 1, 2], 2) == [[3, 4]]
+    # no earlier occurrence of any suffix gram -> no proposal
+    assert p.propose([1, 2, 3], 4) == []
+    assert p.propose([7], 4) == []
+    # width 2 proposes distinct continuations, most recent first
+    p2 = NGramProposer(n=1, width=2)
+    bs = p2.propose([5, 8, 5, 9, 5], 2)
+    assert [b[0] for b in bs] == [9, 8]
+    # total across branches bounded by k
+    assert sum(len(b) for b in p2.propose([5, 8, 5, 9, 5], 1)) <= 1
+
+
+def test_draft_model_proposer_greedy_chain_matches_oracle():
+    p = DraftModelProposer(PARAMS, CFG)
+    ctx = [5, 17, 42, 9, 77, 3]
+    assert p.propose(ctx, 3) == [dense_generate(PARAMS, CFG, ctx, 3)]
+    # a TransformerRunner adapts via as_proposer
+    r = TransformerRunner(PARAMS, CFG, name="t_sp_adapt")
+    ad = as_proposer(r)
+    assert isinstance(ad, DraftModelProposer)
+    assert ad.propose(ctx, 2) == [dense_generate(PARAMS, CFG, ctx, 2)]
+
+
+# ---------------------------------------------------------------------------
+# the batched splice primitive (the plain decode path rides it too)
+# ---------------------------------------------------------------------------
+
+def test_write_kv_batch_equivalent_to_sequential_and_isolated():
+    """One write_kv_batch call lands byte-identical pages to
+    sequential write_kv calls — and a bad item is skipped and
+    reported while its batch-mates' rows still land."""
+    def mk(tag):
+        return KVCacheStore(page_tokens=4, page_bytes=4 * 16,
+                            max_blocks=8, vector_kv=True, name=tag)
+
+    rng = np.random.default_rng(11)
+    sa = mk("t_wb_a")
+    sb = mk("t_wb_b")
+    try:
+        rows = [rng.integers(0, 256, (6, 16), dtype=np.uint8)
+                for _ in range(2)]
+        seqs_a = [sa.admit([10 * k + j for j in range(6)])
+                  for k in range(2)]
+        seqs_b = [sb.admit([10 * k + j for j in range(6)])
+                  for k in range(2)]
+        for q, r in zip(seqs_a, rows):
+            sa.write_kv(q, 0, r)
+        fails = sb.write_kv_batch(
+            [(q, 0, r) for q, r in zip(seqs_b, rows)])
+        assert fails == []
+        # compare the WRITTEN slots only: recycled blocks carry stale
+        # bytes in never-written tail slots (harmless — kv_filled caps
+        # what is ever attended or cached), so full-page equality
+        # would compare undefined memory
+        for qa, qb, r in zip(seqs_a, seqs_b, rows):
+            assert qa.kv_filled == qb.kv_filled == 6
+            for st, q in ((sa, qa), (sb, qb)):
+                got = np.concatenate([st.pagepool.read_raw(p)
+                                      for p in q.pages])[:6 * 16]
+                np.testing.assert_array_equal(
+                    got, r.reshape(-1),
+                    err_msg=f"{st.name}: batched/sequential write "
+                            f"bytes diverged")
+        # isolation: an out-of-range item fails alone
+        good = rng.integers(0, 256, (1, 16), dtype=np.uint8)
+        fails = sb.write_kv_batch([
+            (seqs_b[0], 99, good),          # invalid
+            (seqs_b[1], 0, good),           # healthy
+        ])
+        assert len(fails) == 1 and fails[0][0] == 0
+        assert isinstance(fails[0][1], ValueError)
+        np.testing.assert_array_equal(
+            sb.pagepool.read_raw(seqs_b[1].pages[0])[:16], good[0])
+        assert sb.pagepool.stats()["batch_splices"] >= 2
+        for q in seqs_a:
+            sa.retire(q, cache=False)
+        for q in seqs_b:
+            sb.retire(q, cache=False)
+    finally:
+        for st in (sa, sb):
+            st.clear()
+            st.close()
+            assert st.pagepool.blocks_leased() == 0
