@@ -113,6 +113,16 @@ model: $(LIB) $(PYEXT)
 	    tests/test_model_runner.py -q
 	JAX_PLATFORMS=cpu python bench.py model
 
+# Parameter server (README "Parameter server", ISSUE 12): the sharded
+# embedding service — PSClient bit-identity vs the dense oracle at
+# partition counts 1/2/4/8 (RPC fan-out AND collective lowering),
+# batcher coalescing, idempotent updates — then the timed
+# batched-vs-unbatched + framework-vs-raw-collectives rung (3-trial
+# median+spread, feeds perf_diff).
+psserve: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_psserve.py -q
+	JAX_PLATFORMS=cpu python bench.py embedding
+
 # Speculative decoding (README "Speculative decoding", ISSUE 11): the
 # identity suite (spec output == plain greedy at depths 2/4/8 — cold,
 # warm, mixed slots, draft trees, through Serving.Generate), the

@@ -2398,6 +2398,175 @@ def speculative_main(argv) -> None:
     print(json.dumps(out))
 
 
+def bench_embedding(trials=3, duration_s=1.0, vocab=4096, dim=256,
+                    n_keys=64, partitions=4, batch_size=32,
+                    threads=64):
+    """Sharded parameter-server rung (ISSUE 12), two questions:
+
+    1. **Does batching pay?** lookups/s through the DynamicBatcher at
+       max_batch_size=32 vs batch=1 issuance of the SAME jitted gather
+       under the SAME offered load (equal thread counts — only the
+       coalescing differs; the cleanest apples-to-apples form of the
+       claim).  The coalescing win the service leans on; acceptance
+       >= 3x.
+    2. **What does the framework cost over raw collectives?** Per-
+       lookup latency through the FULL stack (PSClient -> JSON RPC ->
+       PartitionChannel fan-out -> server batcher -> jitted gather ->
+       reassembly) vs the same keys through one compiled
+       shard_map+psum program on the same mesh — the honest "framework
+       tax" number PAPERS.md ("RPC Considered Harmful") demands,
+       published with spread, not hidden.
+
+    3-trial median+spread throughout; CPU-valid (the full bench runs it
+    in a forced-CPU subprocess like microbench/migrate)."""
+    import numpy as np
+
+    from brpc_tpu.psserve import EmbeddingShardServer
+    from brpc_tpu.serving import DynamicBatcher
+
+    out = {"vocab": vocab, "dim": dim, "n_keys": n_keys}
+
+    # ---- rung 1: batched-through-batcher vs unbatched issuance ----
+    shard = EmbeddingShardServer(0, 1, vocab, dim, seed=0,
+                                 key_buckets=(n_keys,),
+                                 name="bench_emb")
+    rng = np.random.default_rng(0)
+
+    def one_trial(bs: int, k: int) -> float:
+        nthreads = threads
+        buckets = (bs,) if bs == 1 else (bs // 4, bs // 2, bs)
+        b = DynamicBatcher(shard.lookup_batch_fn, max_batch_size=bs,
+                           max_delay_us=20_000, batch_buckets=buckets,
+                           length_buckets=(n_keys,), dtype=np.int64,
+                           padded_output=True,
+                           name=f"bench_emb_bs{bs}_{k}")
+        keys = rng.integers(0, vocab, n_keys).astype(np.int64)
+        try:
+            b.submit_wait(keys, timeout_s=300)   # compile outside timing
+            stop = time.monotonic() + duration_s
+            counts = [0] * nthreads
+
+            def worker(i):
+                while time.monotonic() < stop:
+                    b.submit_wait(keys, timeout_s=60)
+                    counts[i] += 1
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(nthreads)]
+            t0 = time.monotonic()
+            [t.start() for t in ts]
+            [t.join(120) for t in ts]
+            return sum(counts) / (time.monotonic() - t0)
+        finally:
+            b.close()
+
+    un = [one_trial(1, k) for k in range(trials)]
+    ba = [one_trial(batch_size, k) for k in range(trials)]
+    rung1 = {}
+    rung1.update(_med_spread(un, "unbatched_lookups_per_s"))
+    rung1.update(_med_spread(ba, "batched_lookups_per_s"))
+    rung1["batch_speedup"] = round(
+        rung1["batched_lookups_per_s"]
+        / max(rung1["unbatched_lookups_per_s"], 1e-9), 2)
+    rung1["batch_size"] = batch_size
+    out["batcher"] = rung1
+    log(f"  batcher: {json.dumps(rung1)}")
+
+    # ---- rung 2: framework vs raw collectives on the same mesh ----
+    import jax
+    if len(jax.devices()) < partitions:
+        out["collective"] = {
+            "skipped": True,
+            "skip_reason": "no-mesh",
+            "skip_detail": f"{len(jax.devices())} devices < "
+                           f"{partitions} partitions",
+        }
+        return out
+    from brpc_tpu.psserve import PSClient, ShardedEmbeddingTable
+    from brpc_tpu.tools.rpc_press import (spin_up_psserve,
+                                          tear_down_psserve)
+
+    lowered = ShardedEmbeddingTable(vocab, dim, n_shards=partitions,
+                                    seed=0, key_buckets=(n_keys,))
+    servers, svcs, shards, pc = spin_up_psserve(
+        partitions, vocab=vocab, dim=dim, max_delay_us=200,
+        name_prefix="bench_emb")
+    cli = PSClient(pc, vocab=vocab, dim=dim, name="bench_emb_cli")
+    try:
+        keysets = [rng.integers(0, vocab, n_keys).astype(np.int64)
+                   for _ in range(8)]
+        # warm both paths (compiles) outside timing
+        for ks in keysets[:2]:
+            cli.lookup(ks)
+            lowered.lookup(ks)
+
+        def time_path(fn, k: int) -> float:
+            """median per-lookup us over one trial window"""
+            lats = []
+            stop = time.monotonic() + duration_s / 2
+            i = 0
+            while time.monotonic() < stop:
+                ks = keysets[(i + k) % len(keysets)]
+                t0 = time.monotonic()
+                fn(ks)
+                lats.append((time.monotonic() - t0) * 1e6)
+                i += 1
+            return float(np.median(lats))
+
+        fw = [time_path(cli.lookup, k) for k in range(trials)]
+        raw = [time_path(lambda ks: lowered.lookup(ks), k)
+               for k in range(trials)]
+        rung2 = {"partitions": partitions, "mode": lowered.mode}
+        rung2.update(_med_spread(fw, "framework_us"))
+        rung2.update(_med_spread(raw, "raw_collective_us"))
+        # tax spread from the worst/best pairings so the interval is
+        # honest about cross-path jitter, not just within-path
+        taxes = sorted(f / r for f in fw for r in raw if r > 0)
+        rung2["framework_tax_ratio"] = round(
+            rung2["framework_us"] / max(rung2["raw_collective_us"],
+                                        1e-9), 1)
+        rung2["framework_tax_spread"] = [round(taxes[0], 1),
+                                         round(taxes[-1], 1)]
+        out["collective"] = rung2
+        log(f"  collective: {json.dumps(rung2)}")
+    finally:
+        tear_down_psserve(servers, svcs, pc)
+        cli.close()
+    out["note"] = (
+        "sharded parameter-server rung (ISSUE 12): batched-through-"
+        "batcher vs batch=1 issuance of the same jitted gather "
+        "(>=3x target), and per-lookup latency through the FULL RPC "
+        "stack vs one compiled shard_map+psum collective on the same "
+        "mesh — framework_tax_ratio is the honest overhead number, "
+        "big on CPU loopback by design (JSON + sockets + batching "
+        "windows vs one compiled program); the ratio's trajectory, "
+        "not its magnitude, is the signal")
+    return out
+
+
+def embedding_main(argv) -> None:
+    """`python bench.py embedding`: run ONLY the parameter-server rung
+    and print one JSON object on stdout (progress on stderr) — the
+    `make psserve` bench entry and the subprocess the full bench run
+    shells out to.  Forces the virtual 8-device CPU mesh BEFORE jax
+    loads so the collective rung has partitions to lower onto."""
+    _force_virtual_mesh()
+    log("embedding: sharded parameter-server rung...")
+    out = bench_embedding()
+    print(json.dumps(out))
+
+
+def _force_virtual_mesh(n: int = 8) -> None:
+    """Give this process n virtual CPU devices (no-op if jax already
+    initialized with them)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
 def _floor_spread(med, lo, hi, pad):
     """Widen a published [lo, hi] spread to at least ±``pad`` around
     the median (ISSUE 9 deflake): a deterministic workload's few-trial
@@ -2786,6 +2955,12 @@ def main():
     except Exception as e:
         details["speculative"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['speculative']}")
+    log("bench: sharded parameter server (subprocess, forced CPU)...")
+    try:
+        details["embedding"] = _run_cpu_subcommand("embedding")
+    except Exception as e:
+        details["embedding"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['embedding']}")
     log("bench: probing device reachability...")
     device_ok, skip_kind, device_err = _probe_device()
     if not device_ok:
@@ -2916,5 +3091,7 @@ if __name__ == "__main__":
         model_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "speculative":
         speculative_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "embedding":
+        embedding_main(sys.argv[2:])
     else:
         main()

@@ -380,6 +380,22 @@ def build_routes(server) -> dict:
             return "no cluster routers registered\n"
         return json.dumps(snap, indent=1), "application/json"
 
+    def psserve_page(req):
+        # sharded parameter-server introspection (brpc_tpu/psserve,
+        # ISSUE 12): per-shard row ranges + version counters + hot-key
+        # histograms, the Lookup/Update batchers' coalescing stats, and
+        # client routing/retry/stale-read counters.  Lazy import, same
+        # discipline as /serving.
+        import sys
+        if "brpc_tpu.psserve" not in sys.modules:
+            return "no parameter-server components registered\n"
+        from brpc_tpu.psserve import psserve_snapshot
+        snap = psserve_snapshot()
+        if not snap["shards"] and not snap["clients"] \
+                and not snap["lowered"]:
+            return "no parameter-server components registered\n"
+        return json.dumps(snap, indent=1), "application/json"
+
     def migration_page(req):
         # cross-host KV data plane introspection (brpc_tpu/migrate):
         # global migrate counters, outbound/inbound route matrices,
@@ -631,6 +647,7 @@ def build_routes(server) -> dict:
         "/kvcache": kvcache_page,
         "/migration": migration_page,
         "/cluster": cluster_page,
+        "/psserve": psserve_page,
         "/hotspots": hotspots_index,
         "/hotspots/locks": hotspots_locks,
         "/hotspots/cpu": hotspots_cpu,

@@ -117,8 +117,15 @@ def make_mesh(n_devices: int) -> Mesh:
 
 
 def param_shardings(mesh: Mesh):
+    # ep: the embedding table is ROW-sharded over the WHOLE mesh
+    # (("dp","tp") — every chip owns a distinct contiguous vocab range,
+    # the parameter-server ownership map psserve serves over RPC), not
+    # merely tp-sharded-and-dp-replicated: at dp=4,tp=2 the old spec
+    # left 4 replicas of each row shard, which is exactly the layout a
+    # sharded-embedding service cannot tolerate (an Update would have
+    # to write 4 places)
     return {
-        "embed": NamedSharding(mesh, P("tp", None)),    # ep: vocab-sharded
+        "embed": NamedSharding(mesh, P(("dp", "tp"), None)),
         "w_qk": NamedSharding(mesh, P(None, None, "tp")),
         "w_up": NamedSharding(mesh, P(None, None, "tp")),   # tp: ff-sharded
         "w_down": NamedSharding(mesh, P(None, "tp", None)),

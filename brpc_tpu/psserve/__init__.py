@@ -1,0 +1,97 @@
+"""brpc_tpu.psserve — sharded embedding / parameter-server service.
+
+The BASELINE.json north-star workload running on the full stack
+(ROADMAP item 1): an embedding table row-sharded over partitions,
+served as ``PS.Lookup`` / ``PS.Update`` (sparse scatter-add) plus
+dense ``PS.Pull``/``PS.Push`` RPCs, with
+
+  * client-side routing through **PartitionChannel** — each request's
+    key-set split by shard ownership, fanned out sub-call-per-
+    partition, reassembled in key order (client.py),
+  * the co-located lowering: the same fan-out as ONE compiled
+    ``shard_map`` collective program over the ``tp`` ICI mesh
+    (lowered.py — ppermute/psum key exchange + local gather, the
+    SNIPPETS.md [2] shape),
+  * server-side coalescing through the **DynamicBatcher** (service.py
+    — bucketed key-count padding, one compile per bucket; the first
+    non-generate traffic shape the batcher has coalesced),
+  * idempotent updates (53-bit update_ids) + per-shard version
+    counters giving read-your-writes and chaos-provable exactly-once
+    apply.
+
+The ``/psserve`` console page renders :func:`psserve_snapshot`;
+``psserve_*`` bvars ride /brpc_metrics.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+_mu = threading.Lock()
+_shards: list = []      # weakrefs to (EmbeddingShardServer, PSService)
+_clients: list = []     # weakrefs to PSClient
+_tables: list = []      # weakrefs to ShardedEmbeddingTable
+
+
+def _register_shard(shard, svc=None) -> None:
+    with _mu:
+        _shards.append((weakref.ref(shard),
+                        weakref.ref(svc) if svc is not None else None))
+
+
+def _register_client(client) -> None:
+    with _mu:
+        _clients.append(weakref.ref(client))
+
+
+def _register_table(table) -> None:
+    with _mu:
+        _tables.append(weakref.ref(table))
+
+
+def psserve_snapshot() -> dict:
+    """Live PS components' stats — the /psserve console page's data:
+    per-shard row counts + version counters + hot-key histograms,
+    batcher coalescing stats, client routing counters."""
+    shards = []
+    clients = []
+    tables = []
+    with _mu:
+        shard_refs = list(_shards)
+        client_refs = list(_clients)
+        table_refs = list(_tables)
+    for sref, vref in shard_refs:
+        s = sref()
+        if s is None:
+            continue
+        entry = s.stats()
+        svc = vref() if vref is not None else None
+        if svc is not None:
+            entry["batchers"] = {
+                b.name: b.stats() for b in
+                (svc._lookup_b, svc._update_b) if b is not None}
+        shards.append(entry)
+    for cref in client_refs:
+        c = cref()
+        if c is not None:
+            clients.append(c.stats())
+    for tref in table_refs:
+        t = tref()
+        if t is not None:
+            tables.append(t.stats())
+    # prune dead refs opportunistically
+    with _mu:
+        _shards[:] = [e for e in _shards if e[0]() is not None]
+        _clients[:] = [r for r in _clients if r() is not None]
+        _tables[:] = [r for r in _tables if r() is not None]
+    return {"shards": shards, "clients": clients, "lowered": tables}
+
+
+from brpc_tpu.psserve.shard import (  # noqa: E402,F401
+    EmbeddingShardServer, init_embedding_table, owners_for, shard_bounds,
+)
+from brpc_tpu.psserve.lowered import ShardedEmbeddingTable  # noqa: E402,F401
+from brpc_tpu.psserve.client import PSClient  # noqa: E402,F401
+from brpc_tpu.psserve.service import (  # noqa: E402,F401
+    PSService, register_psserve, unregister_psserve,
+)

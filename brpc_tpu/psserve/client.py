@@ -1,0 +1,281 @@
+"""PSClient — the embedding service's client-side router.
+
+``lookup(keys)`` / ``update(keys, grads)`` take GLOBAL keys in request
+order.  The client splits each request's key-set by shard ownership
+(:func:`~brpc_tpu.psserve.shard.owners_for` over the contiguous range
+map), fans the owned subsets out sub-call-per-partition through a
+:class:`~brpc_tpu.rpc.combo_channels.PartitionChannel` (retry/backup:
+failed partitions re-issue, rotating replicas under ``lb=``), and
+reassembles responses IN KEY ORDER — duplicates and shard-straddling
+key-sets fall out of the position bookkeeping naturally.
+
+Updates are idempotent end-to-end: every sub-call carries a distinct
+53-bit ``update_id`` (per-process random salt + process-wide counter +
+partition), so a retry after a lost ack re-acks the ORIGINAL apply
+instead of double scatter-adding; the shard's version counters prove
+it.
+
+With a co-located mesh the same client surface runs over a
+:class:`~brpc_tpu.psserve.lowered.ShardedEmbeddingTable` instead: the
+split/fan-out/merge plan is lowered to one compiled collective program
+(all-to-all / ppermute key exchange + local gather) and never touches a
+socket.  ``Pull``/``Push`` route dense parameters to an owner shard by
+stable name hash.
+"""
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from brpc_tpu import errors
+from brpc_tpu.bvar import Adder, LatencyRecorder
+from brpc_tpu.psserve.shard import owners_for, shard_bounds
+
+CLIENT_LOOKUPS = Adder("psserve_client_lookups")
+CLIENT_UPDATES = Adder("psserve_client_updates")
+CLIENT_RETRIES = Adder("psserve_client_retries")
+CLIENT_STALE_READS = Adder("psserve_client_stale_reads")
+LOOKUP_LATENCY = LatencyRecorder("psserve_client_lookup")
+
+# update_id construction: ids must stay unique across every client in
+# every process sharing the shards (a collision silently drops a fresh
+# update as a "duplicate"), and must survive float64 packing exactly
+# (<= 2^53, the largest float64-exact integer).  Layout: (18-bit
+# per-process random salt << 30 | 30-bit process-wide counter)
+# * n_shards + partition + 1 — 48 bits of sequence * up to 32 shards
+# tops out at exactly 2^53 (saturated salt/counter/partition), which
+# the service's inclusive bound accepts; the salt makes
+# cross-process collisions ~2^-18 per process pair, and the counter is
+# process-wide so client construction churn can never wrap it back
+# onto a live id.
+import os as _os
+
+_uid_mu = threading.Lock()
+_uid_salt = int.from_bytes(_os.urandom(3), "big") & 0x3FFFF
+_uid_counter = [0]
+
+
+def _next_uid_seq() -> int:
+    with _uid_mu:
+        _uid_counter[0] += 1
+        if _uid_counter[0] >= (1 << 30):
+            # re-salt rather than wrap onto ids that may still sit in
+            # a shard's applied window
+            globals()["_uid_salt"] = \
+                int.from_bytes(_os.urandom(3), "big") & 0x3FFFF
+            _uid_counter[0] = 1
+        return (_uid_salt << 30) | _uid_counter[0]
+
+
+class PSClient:
+    """Route Lookup/Update/Pull/Push over a partitioned embedding
+    service.
+
+    ``backend`` is either a PartitionChannel (RPC fan-out; needs
+    ``n_shards`` partitions registered) or a ShardedEmbeddingTable
+    (collective lowering, co-located mesh).
+    """
+
+    def __init__(self, backend, *, vocab: int, dim: int,
+                 n_shards: Optional[int] = None,
+                 timeout_ms: int = 5000, max_retry: int = 2,
+                 name: str = "psclient"):
+        from brpc_tpu.rpc.combo_channels import PartitionChannel
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.name = name
+        self.timeout_ms = int(timeout_ms)
+        self.max_retry = int(max_retry)
+        self._pc = None
+        self._lowered = None
+        if isinstance(backend, PartitionChannel):
+            self._pc = backend
+            self.n_shards = int(n_shards or backend.partition_count)
+            # only the RPC path mints update_ids; the lowered backend
+            # (which may legitimately span >32 chips) never does
+            if self.n_shards > 32:
+                raise ValueError("update_id space covers <= 32 shards")
+        else:       # duck-typed lowered table (lookup/update/stats)
+            self._lowered = backend
+            self.n_shards = int(getattr(backend, "p", n_shards or 1))
+        self.bounds = shard_bounds(self.vocab, self.n_shards)
+        self._mu = threading.Lock()
+        # read-your-writes bookkeeping: highest acked version per shard
+        self.acked_version = [0] * self.n_shards
+        self.n_lookups = 0
+        self.n_updates = 0
+        self.n_retries = 0
+        self.n_stale_reads = 0
+        from brpc_tpu import psserve as _ps
+        _ps._register_client(self)
+
+    # ---- id + split helpers ----
+
+    def _uid_for(self, token: int, part: int) -> int:
+        """Per-partition update_id for one LOGICAL update: pure
+        function of (token, partition), so replaying a token re-sends
+        the same ids and already-applied partitions dedup."""
+        return token * self.n_shards + part + 1
+
+    def _split(self, keys: np.ndarray) -> dict[int, np.ndarray]:
+        """partition -> positions (indices into the request) owned."""
+        owner = owners_for(keys, self.bounds)
+        return {int(s): np.flatnonzero(owner == s)
+                for s in np.unique(owner)}
+
+    # ---- Lookup ----
+
+    def lookup(self, keys) -> np.ndarray:
+        """rows [n, dim] for GLOBAL keys, reassembled in key order."""
+        import time
+        keys = np.asarray(keys, np.int64)
+        if keys.ndim != 1:
+            raise ValueError("keys must be 1-D")
+        if keys.size and (keys.min() < 0 or keys.max() >= self.vocab):
+            raise ValueError(f"keys outside [0, {self.vocab})")
+        t0 = time.monotonic()
+        if self._lowered is not None:
+            rows, _ver = self._lowered.lookup(keys)
+        else:
+            split = self._split(keys)
+            sub = {part: {"keys": keys[pos].tolist()}
+                   for part, pos in split.items()}
+            resp = self._call(sub, "Lookup")
+            rows = np.empty((keys.shape[0], self.dim), np.float32)
+            for part, pos in split.items():
+                r = resp[part]
+                rows[pos] = np.asarray(r["rows"], np.float32)
+                self._note_version(part, int(r.get("version", 0)))
+        with self._mu:
+            self.n_lookups += 1
+        CLIENT_LOOKUPS.add(1)
+        LOOKUP_LATENCY.add(int((time.monotonic() - t0) * 1e6))
+        return rows
+
+    # ---- Update ----
+
+    def update(self, keys, grads,
+               update_token: Optional[int] = None) -> dict[int, int]:
+        """Sparse scatter-add; returns {partition: acked version}.
+        Exactly-once per partition even across retries (update_ids).
+
+        If the fan-out fails PARTIALLY (some partitions acked, some
+        exhausted their retries), the raised RpcError carries
+        ``update_token`` — replay the SAME logical update with
+        ``update(keys, grads, update_token=e.update_token)`` and the
+        partitions that already applied will dedup instead of double
+        scatter-adding.  A retry WITHOUT the token mints fresh ids and
+        re-applies everywhere."""
+        keys = np.asarray(keys, np.int64)
+        grads = np.asarray(grads, np.float32)
+        if keys.ndim != 1:
+            raise ValueError("keys must be 1-D")
+        if keys.size and (keys.min() < 0 or keys.max() >= self.vocab):
+            # same validation as lookup: a clear local error, not a
+            # permanent server EREQUEST retried max_retry times (or a
+            # baffling ENODATA for a negative key's partition)
+            raise ValueError(f"keys outside [0, {self.vocab})")
+        if grads.shape != (keys.shape[0], self.dim):
+            raise ValueError(f"grads shape {grads.shape} != "
+                             f"({keys.shape[0]}, {self.dim})")
+        if self._lowered is not None:
+            ver = self._lowered.update(keys, grads)
+            with self._mu:
+                self.n_updates += 1
+            CLIENT_UPDATES.add(1)
+            return {0: ver}
+        token = update_token if update_token is not None \
+            else _next_uid_seq()
+        split = self._split(keys)
+        sub = {}
+        for part, pos in split.items():
+            sub[part] = {"keys": keys[pos].tolist(),
+                         "grads": grads[pos].tolist(),
+                         "update_id": self._uid_for(token, part)}
+        try:
+            resp = self._call(sub, "Update")
+        except errors.RpcError as e:
+            # stamp the token so the caller can replay THIS logical
+            # update idempotently (partitions that acked will dedup)
+            e.update_token = token
+            raise
+        out = {}
+        for part, r in resp.items():
+            ver = int(r["version"])
+            out[part] = ver
+            self._note_ack(part, ver)
+        with self._mu:
+            self.n_updates += 1
+        CLIENT_UPDATES.add(1)
+        return out
+
+    # ---- dense Pull/Push ----
+
+    def _owner_of(self, pname: str) -> int:
+        return zlib.crc32(pname.encode()) % self.n_shards
+
+    def pull(self, pname: str) -> np.ndarray:
+        if self._lowered is not None:
+            raise errors.RpcError(errors.ENOMETHOD,
+                                  "lowered backend serves embeddings only")
+        part = self._owner_of(pname)
+        r = self._call({part: {"name": pname}}, "Pull")[part]
+        return np.asarray(r["value"], np.float32)
+
+    def push(self, pname: str, delta) -> int:
+        if self._lowered is not None:
+            raise errors.RpcError(errors.ENOMETHOD,
+                                  "lowered backend serves embeddings only")
+        part = self._owner_of(pname)
+        req = {part: {"name": pname,
+                      "delta": np.asarray(delta, np.float32).tolist(),
+                      "update_id": self._uid_for(_next_uid_seq(), part)}}
+        r = self._call(req, "Push")[part]
+        ver = int(r["version"])
+        self._note_ack(part, ver)
+        return ver
+
+    # ---- fan-out plumbing ----
+
+    def _call(self, sub_requests: dict, method: str) -> dict:
+        def on_retry(idx, err):
+            with self._mu:
+                self.n_retries += 1
+            CLIENT_RETRIES.add(1)
+        return self._pc.call_partitioned(
+            "PS", method, sub_requests, serializer="json",
+            timeout_ms=self.timeout_ms, max_retry=self.max_retry,
+            on_retry=on_retry)
+
+    def _note_ack(self, part: int, ver: int) -> None:
+        with self._mu:
+            if ver > self.acked_version[part]:
+                self.acked_version[part] = ver
+
+    def _note_version(self, part: int, ver: int) -> None:
+        """Read-your-writes check: a lookup must observe every update
+        THIS client already got acked on that shard."""
+        with self._mu:
+            if ver < self.acked_version[part]:
+                self.n_stale_reads += 1
+                CLIENT_STALE_READS.add(1)
+
+    def close(self) -> None:
+        if self._pc is not None:
+            self._pc.close()
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "name": self.name,
+                "n_shards": self.n_shards,
+                "backend": "lowered" if self._lowered is not None
+                           else "partition_channel",
+                "lookups": self.n_lookups,
+                "updates": self.n_updates,
+                "stale_reads": self.n_stale_reads,
+                "acked_versions": list(self.acked_version),
+            }

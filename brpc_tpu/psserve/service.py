@@ -1,0 +1,252 @@
+"""PS service registration — one shard's RPC surface.
+
+``PS.Lookup`` / ``PS.Update`` / ``PS.Pull`` / ``PS.Push`` / ``PS.Stats``
+ride the normal dispatch path (auth, interceptors, limiters,
+MethodStatus all apply).  With ``batch=True`` (the default) concurrent
+Lookup and Update RPCs COALESCE through two DynamicBatchers — the first
+non-autoregressive traffic shape the batcher has ever coalesced:
+
+  * lookups queue as int64 key vectors, bucket-padded by KEY COUNT; one
+    jitted [B, Lb] -> [B, Lb, D] gather serves the whole batch (one
+    compile per bucket pair, the serving discipline);
+  * updates queue as packed float64 rows (update_id + interleaved
+    key/grad groups, length buckets 1 + k*(1+D)); one jitted scatter-add
+    applies the whole batch, with idempotence decided per row at apply
+    time under the shard lock.
+
+Fault sites ``psserve.lookup`` / ``psserve.update`` cover the fan-out's
+failure modes: ``stage="pre"`` fails a sub-call before any apply,
+``stage="post"`` drops the ack AFTER the apply — the retried sub-call
+must then dedup (chaos scenario 16 proves the version counter advances
+exactly once).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from brpc_tpu import errors, fault
+from brpc_tpu.rpc.service import Service, method
+from brpc_tpu.psserve.shard import EmbeddingShardServer
+
+
+class PSService(Service):
+    NAME = "PS"
+
+    def __init__(self, shard: EmbeddingShardServer,
+                 lookup_batcher=None, update_batcher=None):
+        self.shard = shard
+        self._lookup_b = lookup_batcher
+        self._update_b = update_batcher
+
+    # ---- Lookup ----
+
+    @method(request="json", response="json")
+    def Lookup(self, cntl, req):
+        keys = (req or {}).get("keys")
+        if keys is None:
+            cntl.set_failed(errors.EREQUEST, 'missing "keys"')
+            return None
+        if fault.ENABLED and fault.hit(
+                "psserve.lookup", shard=self.shard.shard_index,
+                n_keys=len(keys)) is not None:
+            cntl.set_failed(errors.EINTERNAL,
+                            "injected psserve.lookup fault")
+            return None
+        try:
+            local = self.shard._to_local(np.asarray(keys, np.int64))
+        except ValueError as e:
+            cntl.set_failed(errors.EREQUEST, str(e))
+            return None
+        if self._lookup_b is None:
+            try:
+                rows, ver = self.shard.lookup(keys)  # counts + hot keys
+            except ValueError as e:
+                # e.g. a key-set larger than the biggest bucket: a
+                # deterministic bad request, never an EINTERNAL crash
+                cntl.set_failed(errors.EREQUEST, str(e))
+                return None
+            return {"rows": rows.tolist(), "version": ver}
+
+        shard = self.shard
+
+        def transform(row):
+            # row: [n_keys, D] trimmed by the batcher's padded-output
+            # scatter; version read at COMPLETION so any update acked
+            # before this lookup's batch executed is covered.  Hot-key
+            # and counter accounting happens HERE — only lookups that
+            # were actually served shape the histogram (a shed/ELIMIT
+            # reject never runs the transform), matching the unbatched
+            # path
+            shard._note_hot(local)
+            with shard._mu:
+                ver = shard.version
+                shard.n_lookups += 1
+            from brpc_tpu.psserve.shard import LOOKUPS, LOOKUP_KEYS
+            LOOKUPS.add(1)
+            LOOKUP_KEYS.add(int(row.shape[0]))
+            return {"rows": np.asarray(row).tolist(), "version": ver}
+
+        self._lookup_b.submit(cntl, local, transform=transform)
+        return None     # deferred: the batch drainer completes the RPC
+
+    # ---- Update ----
+
+    @method(request="json", response="json")
+    def Update(self, cntl, req):
+        req = req or {}
+        keys = req.get("keys")
+        grads = req.get("grads")
+        uid = req.get("update_id")
+        if keys is None or grads is None:
+            cntl.set_failed(errors.EREQUEST, 'missing "keys"/"grads"')
+            return None
+        if uid is not None:
+            # the batched apply packs ids into float64 rows and uses 0
+            # as the padding sentinel — an id outside (0, 2^53) would
+            # be silently discarded (acked but never applied) or
+            # rounded onto another id; refuse it loudly instead
+            try:
+                uid = int(uid)
+            except (TypeError, ValueError):
+                cntl.set_failed(errors.EREQUEST,
+                                "update_id must be an integer")
+                return None
+            if not (0 < uid <= (1 << 53)):
+                # inclusive upper bound: 2**53 itself is exactly
+                # representable in float64 (it's 2**53 + 1 that isn't),
+                # and PSClient's max mintable id lands exactly there
+                # (salt/counter saturated at n_shards=32)
+                cntl.set_failed(errors.EREQUEST,
+                                "update_id must be in (0, 2**53]")
+                return None
+        if fault.ENABLED and fault.hit(
+                "psserve.update", shard=self.shard.shard_index,
+                stage="pre") is not None:
+            # pre-apply failure: nothing was written; a retry applies
+            # normally
+            cntl.set_failed(errors.EINTERNAL,
+                            "injected psserve.update fault (pre-apply)")
+            return None
+        try:
+            local = self.shard._to_local(np.asarray(keys, np.int64))
+            g = np.asarray(grads, np.float32)
+            if g.shape != (local.shape[0], self.shard.dim):
+                raise ValueError(f"grads shape {g.shape} != "
+                                 f"({local.shape[0]}, {self.shard.dim})")
+        except ValueError as e:
+            cntl.set_failed(errors.EREQUEST, str(e))
+            return None
+
+        def ack(ver: int, dup: bool):
+            if fault.ENABLED and fault.hit(
+                    "psserve.update", shard=self.shard.shard_index,
+                    stage="post") is not None:
+                # post-apply ack drop: the update IS in the table; the
+                # client's retry must be deduped by update_id or the
+                # scatter-add doubles (chaos proves it doesn't)
+                raise RuntimeError(
+                    "injected psserve.update fault (post-apply)")
+            return {"version": int(ver), "duplicate": bool(dup)}
+
+        if self._update_b is None or uid is None:
+            try:
+                ver, dup = self.shard.update(keys, grads, update_id=uid)
+            except ValueError as e:
+                # oversize key-set etc.: deterministic bad request
+                cntl.set_failed(errors.EREQUEST, str(e))
+                return None
+            try:
+                return ack(ver, dup)
+            except RuntimeError as e:
+                cntl.set_failed(errors.EINTERNAL, str(e))
+                return None
+        row = EmbeddingShardServer.pack_update(int(uid), local, g)
+        n_keys = int(local.shape[0])
+
+        def transform(a):
+            # a raising transform completes the RPC with EINTERNAL —
+            # the post-apply ack-drop path above rides that contract.
+            # UPDATE_KEYS counts here (the batch fn can't recover live
+            # key counts from zero-padded rows), applied rows only
+            if not bool(a[1]):
+                from brpc_tpu.psserve.shard import UPDATE_KEYS
+                UPDATE_KEYS.add(n_keys)
+            return ack(int(a[0]), bool(a[1]))
+
+        self._update_b.submit(cntl, row, transform=transform)
+        return None
+
+    # ---- dense params ----
+
+    @method(request="json", response="json")
+    def Pull(self, cntl, req):
+        pname = (req or {}).get("name")
+        if not pname:
+            cntl.set_failed(errors.EREQUEST, 'missing "name"')
+            return None
+        try:
+            v = self.shard.pull(pname)
+        except KeyError:
+            cntl.set_failed(errors.ENODATA, f"no dense param {pname!r}")
+            return None
+        return {"name": pname, "value": v.tolist(),
+                "shape": list(v.shape)}
+
+    @method(request="json", response="json")
+    def Push(self, cntl, req):
+        req = req or {}
+        pname = req.get("name")
+        delta = req.get("delta")
+        if not pname or delta is None:
+            cntl.set_failed(errors.EREQUEST, 'missing "name"/"delta"')
+            return None
+        try:
+            ver, dup = self.shard.push(pname, delta,
+                                       update_id=req.get("update_id"))
+        except ValueError as e:
+            cntl.set_failed(errors.EREQUEST, str(e))
+            return None
+        return {"version": int(ver), "duplicate": bool(dup)}
+
+    @method(request="json", response="json")
+    def Stats(self, cntl, req):
+        return self.shard.stats()
+
+
+def register_psserve(server, shard: EmbeddingShardServer, *,
+                     batch: bool = True, max_batch_size: int = 16,
+                     max_delay_us: int = 1000,
+                     name: Optional[str] = None):
+    """Expose one shard on an rpc Server; returns the PSService (its
+    batchers close with ``unregister_psserve``)."""
+    from brpc_tpu import psserve as _ps
+    lookup_b = update_b = None
+    safe = name or f"{shard.name}_{shard.shard_index}"
+    if batch:
+        from brpc_tpu.serving.batcher import DynamicBatcher
+        lookup_b = DynamicBatcher(
+            shard.lookup_batch_fn,
+            max_batch_size=max_batch_size, max_delay_us=max_delay_us,
+            length_buckets=shard.key_buckets,
+            dtype=np.int64, padded_output=True,
+            name=f"ps_lookup_{safe}")
+        update_b = DynamicBatcher(
+            shard.update_batch_fn,
+            max_batch_size=max_batch_size, max_delay_us=max_delay_us,
+            length_buckets=shard.update_length_buckets(),
+            dtype=np.float64, padded_output=False,
+            name=f"ps_update_{safe}")
+    svc = PSService(shard, lookup_batcher=lookup_b,
+                    update_batcher=update_b)
+    server.add_service(svc)
+    _ps._register_shard(shard, svc)
+    return svc
+
+
+def unregister_psserve(svc: PSService) -> None:
+    """Close the service's batchers (flushes queued batches)."""
+    for b in (svc._lookup_b, svc._update_b):
+        if b is not None:
+            b.close()

@@ -1,0 +1,396 @@
+"""EmbeddingShardServer — one partition of a sharded embedding table.
+
+The parameter-server ownership map is contiguous row ranges
+(:func:`shard_bounds`): shard i of n owns global rows ``[lo, hi)``.  A
+shard answers
+
+  * ``Lookup(keys) -> rows`` — gather of OWNED rows (the client routed
+    the keys; duplicates are legal and each occurrence is served),
+  * ``Update(keys, grads)`` — sparse scatter-add into the owned rows,
+    idempotent by ``update_id`` so a retried sub-call (lost ack, chaos
+    fault mid-fanout) can never double-apply,
+  * ``Pull/Push(name)`` — dense whole-parameter read / delta-add for
+    the rest of the model (owner chosen by name hash, client-side).
+
+Every applied update advances the shard's VERSION counter, and every
+lookup response carries the counter: an Update acked at version v is
+visible to any Lookup issued afterwards (the batchers swap the table
+reference before completing the RPC), which is the read-your-writes
+contract the chaos suite leans on to prove exactly-once apply.
+
+The gather/scatter hot paths are jitted once per key-count bucket
+(requests pad up to ``key_buckets``), which is also the shape contract
+the DynamicBatcher coalesces under (service.py).
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import numpy as np
+
+from brpc_tpu.bvar import Adder
+
+DEFAULT_KEY_BUCKETS = (8, 32, 128, 512)
+
+# process-wide counters (per-shard numbers live on the instance and the
+# /psserve page; these feed /brpc_metrics as psserve_*)
+LOOKUPS = Adder("psserve_lookups")
+LOOKUP_KEYS = Adder("psserve_lookup_keys")
+UPDATES = Adder("psserve_updates")
+UPDATE_KEYS = Adder("psserve_update_keys")
+DUP_UPDATES = Adder("psserve_dup_updates")
+PULLS = Adder("psserve_pulls")
+PUSHES = Adder("psserve_pushes")
+
+
+def shard_bounds(vocab: int, n_shards: int) -> list[tuple[int, int]]:
+    """Contiguous ownership ranges: shard i owns rows [lo, hi).  The
+    remainder spreads over the FIRST shards so every shard's size
+    differs by at most one row."""
+    if n_shards < 1 or vocab < n_shards:
+        raise ValueError(f"need 1 <= n_shards <= vocab, got "
+                         f"{n_shards}/{vocab}")
+    base, rem = divmod(vocab, n_shards)
+    bounds = []
+    lo = 0
+    for i in range(n_shards):
+        hi = lo + base + (1 if i < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
+def owners_for(keys: np.ndarray, bounds: Sequence[tuple[int, int]]
+               ) -> np.ndarray:
+    """Owning shard index per key (vectorized over the range table)."""
+    los = np.asarray([b[0] for b in bounds])
+    return (np.searchsorted(los, np.asarray(keys), side="right") - 1
+            ).astype(np.int64)
+
+
+def init_embedding_table(vocab: int, dim: int, seed: int = 0) -> np.ndarray:
+    """The deterministic full table every shard slices its rows from —
+    also the test oracle's starting point."""
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((vocab, dim)) * 0.02).astype(np.float32)
+
+
+def _bucket_up(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} keys exceed largest bucket {buckets[-1]}")
+
+
+class EmbeddingShardServer:
+    """One partition's state + the jitted gather/scatter hot paths."""
+
+    def __init__(self, shard_index: int, n_shards: int, vocab: int,
+                 dim: int, *, seed: int = 0,
+                 table: Optional[np.ndarray] = None,
+                 dense_params: Optional[dict] = None,
+                 mesh=None,
+                 key_buckets: Sequence[int] = DEFAULT_KEY_BUCKETS,
+                 applied_cap: int = 65536,
+                 name: str = "ps"):
+        import jax
+        import jax.numpy as jnp
+        self._jax, self._jnp = jax, jnp
+        self.shard_index = int(shard_index)
+        self.n_shards = int(n_shards)
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.name = name
+        self.key_buckets = tuple(sorted(key_buckets))
+        self.bounds = shard_bounds(vocab, n_shards)
+        self.lo, self.hi = self.bounds[self.shard_index]
+        full = table if table is not None else \
+            init_embedding_table(vocab, dim, seed)
+        rows = np.asarray(full[self.lo:self.hi], dtype=np.float32)
+        if mesh is not None:
+            # row-shard THIS partition's rows over the tp ICI mesh (the
+            # PR 10 NamedSharding machinery): a co-located pod splits
+            # each partition again across its chips
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            tp = mesh.shape.get("tp", 1)
+            if rows.shape[0] % tp == 0:
+                self._rows = jax.device_put(
+                    rows, NamedSharding(mesh, P("tp", None)))
+            else:   # uneven rows: keep replicated rather than refuse
+                self._rows = jax.device_put(
+                    rows, NamedSharding(mesh, P()))
+        else:
+            self._rows = jnp.asarray(rows)
+        self.mesh = mesh
+        # dense parameters (the non-embedding rest of the model); the
+        # CLIENT routes each name to its owner shard by hash
+        self._dense: dict[str, np.ndarray] = {
+            k: np.asarray(v, np.float32)
+            for k, v in (dense_params or {}).items()}
+        self._mu = threading.RLock()
+        self.version = 0
+        self._applied: OrderedDict[int, int] = OrderedDict()  # uid -> ver
+        self._applied_cap = int(applied_cap)
+        # per-shard counters (process-wide Adders above aggregate)
+        self.n_lookups = 0
+        self.n_updates = 0
+        self.n_dup_updates = 0
+        self.n_pulls = 0
+        self.n_pushes = 0
+        # hot-key histogram (bounded: prune to the top half at 4096)
+        self._hot: dict[int, int] = {}
+
+        # one jit each; bucket padding bounds the compile count
+        self._gather = jax.jit(lambda t, k: t[k])
+        self._scatter = jax.jit(lambda t, k, g: t.at[k].add(g))
+
+    # ---- ownership helpers ----
+
+    @property
+    def n_rows(self) -> int:
+        return self.hi - self.lo
+
+    def owns(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.asarray(keys)
+        return (keys >= self.lo) & (keys < self.hi)
+
+    def _to_local(self, keys) -> np.ndarray:
+        keys = np.asarray(keys, dtype=np.int64)
+        if keys.size and (keys.min() < self.lo or keys.max() >= self.hi):
+            raise ValueError(
+                f"shard {self.shard_index} owns [{self.lo},{self.hi}), "
+                f"got keys outside the range")
+        return keys - self.lo
+
+    def _note_hot(self, local_keys: np.ndarray) -> None:
+        uniq, counts = np.unique(local_keys, return_counts=True)
+        with self._mu:      # RLock: callers inside the lock re-enter
+            hot = self._hot
+            for k, c in zip(uniq.tolist(), counts.tolist()):
+                hot[k + self.lo] = hot.get(k + self.lo, 0) + c
+            if len(hot) > 4096:
+                keep = sorted(hot.items(), key=lambda kv: -kv[1])[:2048]
+                self._hot = dict(keep)
+
+    # ---- direct (unbatched) entry points ----
+
+    def lookup(self, keys) -> tuple[np.ndarray, int]:
+        """Gather owned rows for GLOBAL keys; returns (rows [n, dim],
+        shard version at serve time)."""
+        local = self._to_local(keys)
+        n = local.shape[0]
+        b = _bucket_up(max(n, 1), self.key_buckets)
+        padded = np.zeros((b,), np.int64)
+        padded[:n] = local
+        rows = np.asarray(self._gather(self._rows, padded))[:n]
+        with self._mu:
+            ver = self.version
+            self.n_lookups += 1
+            self._note_hot(local)
+        LOOKUPS.add(1)
+        LOOKUP_KEYS.add(int(n))
+        return rows, ver
+
+    def update(self, keys, grads, update_id: Optional[int] = None
+               ) -> tuple[int, bool]:
+        """Sparse scatter-add for GLOBAL keys; returns (version after
+        the apply, was_duplicate).  A duplicate ``update_id`` acks with
+        the ORIGINAL apply's version and touches nothing."""
+        local = self._to_local(keys)
+        grads = np.asarray(grads, np.float32)
+        if grads.shape != (local.shape[0], self.dim):
+            raise ValueError(f"grads shape {grads.shape} != "
+                             f"({local.shape[0]}, {self.dim})")
+        with self._mu:
+            if update_id is not None and update_id in self._applied:
+                self.n_dup_updates += 1
+                DUP_UPDATES.add(1)
+                return self._applied[update_id], True
+            self._apply_locked(local, grads)
+            ver = self.version
+            if update_id is not None:
+                self._record_applied_locked(update_id, ver)
+            self.n_updates += 1
+        UPDATES.add(1)
+        UPDATE_KEYS.add(int(local.shape[0]))
+        return ver, False
+
+    def _apply_locked(self, local: np.ndarray, grads: np.ndarray) -> None:
+        n = local.shape[0]
+        b = _bucket_up(max(n, 1), self.key_buckets)
+        pk = np.zeros((b,), np.int64)
+        pg = np.zeros((b, self.dim), np.float32)
+        pk[:n] = local
+        pg[:n] = grads          # padded rows add 0 to row 0: a no-op
+        self._rows = self._scatter(self._rows, pk, pg)
+        self.version += 1
+
+    def _record_applied_locked(self, uid: int, ver: int) -> None:
+        self._applied[uid] = ver
+        while len(self._applied) > self._applied_cap:
+            self._applied.popitem(last=False)
+
+    # ---- dense Pull/Push ----
+
+    def pull(self, pname: str) -> np.ndarray:
+        with self._mu:
+            if pname not in self._dense:
+                raise KeyError(pname)
+            self.n_pulls += 1
+            out = self._dense[pname].copy()
+        PULLS.add(1)
+        return out
+
+    def push(self, pname: str, delta, update_id: Optional[int] = None,
+             ) -> tuple[int, bool]:
+        delta = np.asarray(delta, np.float32)
+        with self._mu:
+            if update_id is not None and update_id in self._applied:
+                self.n_dup_updates += 1
+                DUP_UPDATES.add(1)
+                return self._applied[update_id], True
+            cur = self._dense.get(pname)
+            if cur is None:
+                self._dense[pname] = delta.copy()
+            else:
+                if cur.shape != delta.shape:
+                    raise ValueError(f"push {pname}: shape {delta.shape} "
+                                     f"!= {cur.shape}")
+                self._dense[pname] = cur + delta
+            self.version += 1
+            ver = self.version
+            if update_id is not None:
+                self._record_applied_locked(update_id, ver)
+            self.n_pushes += 1
+        PUSHES.add(1)
+        return ver, False
+
+    # ---- DynamicBatcher batch_fns (service.py wires these) ----
+    #
+    # Lookup rows are int64 key vectors; the batch gather is ONE jitted
+    # [B, Lb] -> [B, Lb, D] op per bucket pair (padded key 0 gathers
+    # row 0 and is trimmed away by the batcher's padded-output scatter).
+
+    def lookup_batch_fn(self, padded: np.ndarray) -> np.ndarray:
+        # per-request accounting (live-row counts, hot keys) happens in
+        # the service handler — this fn sees bucket-padded rows and
+        # cannot tell live from padding
+        k = np.asarray(padded, np.int64)
+        with self._mu:
+            rows = self._rows
+        return np.asarray(self._gather(rows, k))
+
+    # Update rows pack (update_id, then per key [key, grad...]) into ONE
+    # float64 vector: [uid, k0, g0_0..g0_{D-1}, k1, g1_0..].  float64
+    # carries 53-bit update ids and float32 grads exactly; the length
+    # buckets are 1 + k*(1+D) so the padded batch reshapes to
+    # [B, kb, 1+D] (zero rows scatter grad 0 into row 0: a no-op).
+    # Dedup is decided here, at APPLY time under the shard lock — the
+    # only point where "already applied" is unambiguous.
+
+    def update_length_buckets(self) -> tuple:
+        return tuple(1 + k * (1 + self.dim) for k in self.key_buckets)
+
+    @staticmethod
+    def pack_update(update_id: int, local_keys: np.ndarray,
+                    grads: np.ndarray) -> np.ndarray:
+        n, d = grads.shape
+        row = np.empty((1 + n * (1 + d),), np.float64)
+        row[0] = float(update_id)
+        body = row[1:].reshape(n, 1 + d)
+        body[:, 0] = local_keys
+        body[:, 1:] = grads
+        return row
+
+    def update_batch_fn(self, padded: np.ndarray) -> np.ndarray:
+        """One coalesced scatter-add for every update row in the batch;
+        returns per-row [version, dup_flag] acks."""
+        B, Lb = padded.shape
+        kb = (Lb - 1) // (1 + self.dim)
+        body = np.ascontiguousarray(
+            padded[:, 1:1 + kb * (1 + self.dim)]
+        ).reshape(B, kb, 1 + self.dim)
+        keys = body[:, :, 0].astype(np.int64)
+        grads = body[:, :, 1:].astype(np.float32)
+        acks = np.zeros((B, 2), np.float64)
+        with self._mu:
+            # dedup against the applied set AND within this batch: a
+            # retry can land in the SAME batch as its original (reply
+            # lost before the batch formed) — both rows would pass the
+            # applied-set check, and double-applying here is exactly
+            # the violation update_ids exist to prevent
+            first_row: dict[int, int] = {}
+            batch_dups: list[tuple[int, int]] = []   # (row, first row)
+            for i in range(B):
+                uid = int(padded[i, 0])
+                if uid == 0:
+                    continue            # batch padding, not a request
+                if uid in self._applied:
+                    self.n_dup_updates += 1
+                    DUP_UPDATES.add(1)
+                    acks[i] = (self._applied[uid], 1.0)
+                    # zero the row out of the scatter: served from the
+                    # applied set, never re-added
+                    keys[i] = 0
+                    grads[i] = 0.0
+                    continue
+                if uid in first_row:
+                    batch_dups.append((i, first_row[uid]))
+                    keys[i] = 0
+                    grads[i] = 0.0
+                    continue
+                first_row[uid] = i
+            # ONE compiled scatter for the whole batch (compile per
+            # (batch bucket, key bucket) pair); dup/padding rows are
+            # zeroed above so they contribute nothing
+            self._rows = self._scatter(
+                self._rows, keys.reshape(-1),
+                grads.reshape(-1, self.dim))
+            for uid, i in first_row.items():
+                self.version += 1
+                self._record_applied_locked(uid, self.version)
+                acks[i] = (self.version, 0.0)
+                self.n_updates += 1
+                UPDATES.add(1)
+            for i, j in batch_dups:
+                # ack the retry with the ORIGINAL apply's version
+                self.n_dup_updates += 1
+                DUP_UPDATES.add(1)
+                acks[i] = (acks[j, 0], 1.0)
+        return acks
+
+    # ---- introspection (/psserve) ----
+
+    def hot_keys(self, top: int = 10) -> list[tuple[int, int]]:
+        with self._mu:
+            return sorted(self._hot.items(), key=lambda kv: -kv[1])[:top]
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "name": self.name,
+                "shard_index": self.shard_index,
+                "n_shards": self.n_shards,
+                "rows": self.n_rows,
+                "range": [self.lo, self.hi],
+                "dim": self.dim,
+                "version": self.version,
+                "lookups": self.n_lookups,
+                "updates": self.n_updates,
+                "dup_updates": self.n_dup_updates,
+                "pulls": self.n_pulls,
+                "pushes": self.n_pushes,
+                "dense_params": sorted(self._dense),
+                "applied_ids": len(self._applied),
+                "hot_keys": self.hot_keys(),
+                "mesh": (dict(self.mesh.shape) if self.mesh is not None
+                         else None),
+            }
+
+    def snapshot_rows(self) -> np.ndarray:
+        """The shard's current rows as numpy (tests compare against the
+        dense oracle)."""
+        with self._mu:
+            return np.asarray(self._rows)

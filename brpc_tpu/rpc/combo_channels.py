@@ -409,16 +409,45 @@ class PartitionParser:
 class PartitionChannel:
     """One channel per partition, built from ONE naming service whose nodes
     carry partition tags; call() fans out one sub-request per partition via
-    a CallMapper that receives the partition index."""
+    a CallMapper that receives the partition index.
+
+    Partitions can also be registered DIRECTLY (``add_partition``) —
+    the psserve client path, where the caller computes ownership and
+    drives one sub-call per partition itself.  With ``lb=`` (a
+    ``create_load_balancer`` spec or a factory returning LoadBalancer
+    instances) a partition with several replicas selects through its
+    own balancer exactly the way SelectiveChannel does since ISSUE 8:
+    ``pick``/``feedback`` expose the per-attempt machinery, health-
+    broken replicas are skipped, and the circuit breaker's evidence
+    accumulates.  ``call_partitioned`` is the retrying fan-out driver:
+    one sub-call per partition, failed partitions re-issued (a replica
+    rotation under ``lb=``) up to ``max_retry`` times — callers make
+    retries safe with idempotent sub-requests (psserve update_ids).
+    NOTE: idempotence-by-id only holds when a partition's replicas
+    SHARE the dedup state (one shard object, or replicated applied
+    sets) — replicas with independent state will double-apply a
+    rotated retry of a mutating sub-call; register independent
+    replicas for read traffic only."""
 
     def __init__(self, partition_count: int,
                  call_mapper: CallMapper | None = None,
                  response_merger: ResponseMerger | None = None,
-                 fail_limit: int = 0):
+                 fail_limit: int = 0, lb=None):
         self.partition_count = partition_count
         self._parallel = ParallelChannel(fail_limit, call_mapper,
                                          response_merger)
         self._partitions: dict[int, Channel] = {}
+        self._lb_spec = lb
+        self._pool = None
+        self._pool_mu = threading.Lock()
+
+    def _make_lb(self):
+        if self._lb_spec is None:
+            return None
+        if callable(self._lb_spec) and not isinstance(self._lb_spec, str):
+            return self._lb_spec()
+        from brpc_tpu.policy.load_balancer import create_load_balancer
+        return create_load_balancer(self._lb_spec)
 
     def init(self, naming_url: str, load_balancer: str = "rr",
              parser: PartitionParser | None = None,
@@ -447,6 +476,159 @@ class PartitionChannel:
             self._partitions[idx] = ch
             self._parallel.add_channel(ch)
         return self
+
+    def add_partition(self, idx: int, channel: Channel,
+                      endpoint=None) -> "PartitionChannel":
+        """Register one replica of partition ``idx`` directly (no
+        naming service).  A second replica for the same partition
+        promotes it to a SelectiveChannel (balancer = ``lb=`` when
+        given, round-robin otherwise) so the fan-out retries a
+        DIFFERENT replica on failure."""
+        if not (0 <= idx < self.partition_count):
+            raise ValueError(f"partition {idx} out of range "
+                             f"0..{self.partition_count - 1}")
+        cur = self._partitions.get(idx)
+        if cur is None:
+            if self._lb_spec is not None:
+                sc = SelectiveChannel(lb=self._make_lb())
+                sc.add_channel(channel, endpoint=endpoint)
+                self._partitions[idx] = sc
+            else:
+                self._partitions[idx] = channel
+            # keep the ParallelChannel fan-out path coherent with the
+            # direct registration (call()/call_sync() still work)
+            self._parallel.add_channel(self._partitions[idx])
+        elif isinstance(cur, SelectiveChannel):
+            cur.add_channel(channel, endpoint=endpoint)
+        else:
+            sc = SelectiveChannel(lb=self._make_lb())
+            sc.add_channel(cur, endpoint=getattr(cur, "_endpoint", None))
+            sc.add_channel(channel, endpoint=endpoint)
+            self._partitions[idx] = sc
+            # swap inside the parallel fan-out list too
+            for i, (ch, m) in enumerate(self._parallel._channels):
+                if ch is cur:
+                    self._parallel._channels[i] = (sc, m)
+                    break
+        return self
+
+    def channel_for(self, idx: int) -> Optional[Channel]:
+        return self._partitions.get(idx)
+
+    def pick(self, idx: int, exclude=None, request_code=None):
+        """One replica selection for partition ``idx`` — delegates to
+        the partition's SelectiveChannel when it has one (lb mode),
+        else returns the partition's only channel."""
+        ch = self._partitions.get(idx)
+        if ch is None:
+            return None
+        if isinstance(ch, SelectiveChannel):
+            return ch.pick(exclude=exclude, request_code=request_code)
+        return 0, ch, getattr(ch, "_endpoint", None)
+
+    def feedback(self, idx: int, endpoint, error_code: int,
+                 latency_us: int = 0, *, breaker: bool = True) -> None:
+        """Report one sub-call attempt's outcome for partition ``idx``
+        (the SelectiveChannel parity surface, ISSUE 8)."""
+        ch = self._partitions.get(idx)
+        if isinstance(ch, SelectiveChannel):
+            ch.feedback(endpoint, error_code, latency_us,
+                        breaker=breaker)
+
+    # ---- the retrying sub-call-per-partition driver ----
+
+    def _executor(self):
+        with self._pool_mu:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(32, 2 * max(self.partition_count, 1)),
+                    thread_name_prefix="partition-fanout")
+            return self._pool
+
+    def call_partitioned(self, service: str, method: str,
+                         sub_requests: dict,
+                         serializer: str = "json",
+                         timeout_ms: Optional[int] = None,
+                         max_retry: int = 2,
+                         on_retry: Callable | None = None) -> dict:
+        """Fan ``sub_requests[idx]`` out as one sub-call per partition
+        (concurrently), retrying each failed partition up to
+        ``max_retry`` more times — under ``lb=`` every retry rotates to
+        a different replica via the partition's balancer, which also
+        receives each attempt's outcome.  Returns ``{idx: response}``;
+        raises
+        ETOOMANYFAILS when any partition exhausts its attempts (callers
+        keep retried sub-requests idempotent)."""
+        if not sub_requests:
+            return {}
+        missing = [i for i in sub_requests if i not in self._partitions]
+        if missing:
+            raise errors.RpcError(errors.ENODATA,
+                                  f"no channel for partitions {missing}")
+
+        from brpc_tpu.rpc.channel import RetryPolicy
+
+        def one(idx):
+            req = sub_requests[idx]
+            ch = self._partitions[idx]
+            last: Exception | None = None
+            for _attempt in range(max_retry + 1):
+                cntl = Controller(timeout_ms=timeout_ms)
+                try:
+                    # lb-mode partitions (SelectiveChannel) feed their
+                    # balancer + the breaker per attempt themselves;
+                    # plain partitions have no balancer to feed and the
+                    # channel layer already fed the breaker
+                    return ch.call_sync(service, method, req,
+                                        serializer=serializer, cntl=cntl)
+                except errors.RpcError as e:
+                    last = e
+                    if e.code not in RetryPolicy.RETRYABLE:
+                        # EREQUEST/ENODATA/ENOMETHOD/... are
+                        # deterministic: re-issuing the identical
+                        # sub-call cannot succeed (reference
+                        # retry_policy.h semantics)
+                        break
+                    if on_retry is not None and _attempt < max_retry:
+                        on_retry(idx, e)   # another attempt follows
+                    continue
+            raise last if last is not None else errors.RpcError(
+                errors.ETOOMANYFAILS)
+
+        futs = {idx: self._executor().submit(one, idx)
+                for idx in sub_requests}
+        out: dict = {}
+        failed: dict = {}
+        for idx, f in futs.items():
+            try:
+                out[idx] = f.result()
+            except Exception as e:
+                failed[idx] = e
+        if failed:
+            first = next(iter(failed.values()))
+            codes = {e.code for e in failed.values()
+                     if isinstance(e, errors.RpcError)}
+            # one distinct underlying code: surface IT (a caller
+            # switching on e.code must see ENODATA for a missing
+            # param, not a generic ETOOMANYFAILS); mixed codes keep
+            # the aggregate
+            code = codes.pop() if len(codes) == 1 \
+                else errors.ETOOMANYFAILS
+            err = errors.RpcError(
+                code,
+                f"{len(failed)}/{len(sub_requests)} partitions failed"
+                f" (first: partition {next(iter(failed))}: {first})")
+            err.failed_partitions = dict(failed)
+            err.partial_responses = dict(out)
+            raise err
+        return out
+
+    def close(self) -> None:
+        with self._pool_mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def call(self, *a, **kw):
         return self._parallel.call(*a, **kw)

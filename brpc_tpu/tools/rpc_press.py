@@ -44,7 +44,7 @@ import threading
 import time
 
 import brpc_tpu as brpc
-from brpc_tpu import rpcz
+from brpc_tpu import errors, rpcz
 from brpc_tpu.bvar import LatencyRecorder
 
 
@@ -558,6 +558,156 @@ def tear_down_cluster(replicas, router, rsrv,
         store.close()
 
 
+def zipf_key_sampler(vocab: int, s: float, seed: int = 0):
+    """Seeded zipf-skewed key sampler: key k's probability is
+    proportional to 1/(rank+1)^s under a seeded permutation (so hot
+    keys spread across shard ranges instead of piling on shard 0).
+    s=0 is uniform; s~1 is classic web skew."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    ranks = rng.permutation(vocab)
+    p = 1.0 / np.power(np.arange(vocab, dtype=np.float64) + 1.0,
+                       max(float(s), 0.0))
+    p /= p.sum()
+    probs = np.empty(vocab)
+    probs[ranks] = p
+
+    def sample(n: int) -> np.ndarray:
+        return rng.choice(vocab, size=n, p=probs).astype(np.int64)
+
+    return sample
+
+
+def spin_up_psserve(n_shards: int, *, vocab: int = 1024, dim: int = 32,
+                    max_delay_us: int = 1000, name_prefix: str = "press"):
+    """In-process sharded parameter-server fleet + a PartitionChannel
+    over it (shared by --embedding mode and bench.py embedding)."""
+    from brpc_tpu.psserve import EmbeddingShardServer, register_psserve
+    from brpc_tpu.rpc.combo_channels import PartitionChannel
+
+    servers, svcs, shards = [], [], []
+    pc = PartitionChannel(n_shards)
+    for i in range(n_shards):
+        sh = EmbeddingShardServer(i, n_shards, vocab, dim, seed=0,
+                                  name=f"{name_prefix}_ps")
+        shards.append(sh)
+        s = brpc.Server()
+        svcs.append(register_psserve(s, sh, max_delay_us=max_delay_us,
+                                     name=f"{name_prefix}_{i}"))
+        s.start("127.0.0.1", 0)
+        servers.append(s)
+        pc.add_partition(i, brpc.Channel(f"127.0.0.1:{s.port}",
+                                         timeout_ms=10_000))
+    return servers, svcs, shards, pc
+
+
+def tear_down_psserve(servers, svcs, pc) -> None:
+    from brpc_tpu.psserve import unregister_psserve
+    for svc in svcs:
+        unregister_psserve(svc)
+    for s in servers:
+        try:
+            s.stop()
+            s.join()
+        except Exception:
+            pass
+    pc.close()
+
+
+def run_embedding_press(n_shards: int, *, vocab: int = 1024,
+                        dim: int = 32, zipf_s: float = 1.0,
+                        update_ratio: float = 0.1,
+                        key_counts=(4, 16, 64),
+                        duration_s: float = 10.0, threads: int = 4,
+                        out=sys.stderr) -> dict:
+    """``--embedding N`` mode (ISSUE 12): zipf-skewed key load over an
+    in-process N-shard parameter-server service through PSClient's
+    PartitionChannel fan-out.  Reports lookups/s, updates/s, the
+    update/lookup mix actually served, and latency p50/p99 BY KEY-COUNT
+    BUCKET (small lookups shouldn't pay big lookups' padding), plus the
+    shards' version/dup counters so exactly-once holds under load."""
+    import numpy as np
+
+    from brpc_tpu.psserve import PSClient
+
+    servers, svcs, shards, pc = spin_up_psserve(
+        n_shards, vocab=vocab, dim=dim, name_prefix="press_ps")
+    counts = {"lookups": 0, "updates": 0}
+    lat_by_bucket: dict[int, list] = {k: [] for k in key_counts}
+    mu = threading.Lock()
+    stop_t = time.monotonic() + duration_s
+
+    counts["errors"] = 0
+
+    def worker(widx: int):
+        rng = np.random.default_rng(1000 + widx)
+        sample = zipf_key_sampler(vocab, zipf_s, seed=widx)
+        cli = PSClient(pc, vocab=vocab, dim=dim,
+                       name=f"press_cli_{widx}")
+        ones = {k: np.ones((k, dim), np.float32) for k in key_counts}
+        while time.monotonic() < stop_t:
+            n = int(rng.choice(key_counts))
+            keys = sample(n)
+            t0 = time.monotonic()
+            try:
+                if rng.random() < update_ratio:
+                    cli.update(keys, ones[n])
+                    kind = "updates"
+                else:
+                    cli.lookup(keys)
+                    kind = "lookups"
+            except errors.RpcError:
+                # an exhausted-retries failure under load is DATA, not
+                # a reason to silently lose this worker for the rest
+                # of the run (which would understate throughput with
+                # no trace): count it and keep pressing
+                with mu:
+                    counts["errors"] += 1
+                continue
+            dt_us = (time.monotonic() - t0) * 1e6
+            with mu:
+                counts[kind] += 1
+                lat_by_bucket[n].append(dt_us)
+
+    ts = [threading.Thread(target=worker, args=(i,))
+          for i in range(threads)]
+    t0 = time.monotonic()
+    [t.start() for t in ts]
+    [t.join(duration_s + 60) for t in ts]
+    elapsed = time.monotonic() - t0
+    try:
+        by_bucket = {}
+        for k, lats in lat_by_bucket.items():
+            if not lats:
+                continue
+            a = np.asarray(lats)
+            by_bucket[str(k)] = {
+                "n": int(a.size),
+                "p50_us": round(float(np.percentile(a, 50)), 1),
+                "p99_us": round(float(np.percentile(a, 99)), 1),
+            }
+        total = counts["lookups"] + counts["updates"]
+        summary = {
+            "mode": "embedding",
+            "shards": n_shards, "vocab": vocab, "dim": dim,
+            "zipf_s": zipf_s,
+            "lookups_per_s": round(counts["lookups"] / elapsed, 1),
+            "updates_per_s": round(counts["updates"] / elapsed, 1),
+            "update_mix": round(counts["updates"] / total, 3)
+            if total else 0.0,
+            "errors": counts["errors"],
+            "latency_by_key_count": by_bucket,
+            "shard_versions": [sh.version for sh in shards],
+            "dup_updates": sum(sh.n_dup_updates for sh in shards),
+            "hot_keys": shards[0].hot_keys(5),
+            "elapsed_s": round(elapsed, 2),
+        }
+        print(json.dumps(summary), file=out)
+        return summary
+    finally:
+        tear_down_psserve(servers, svcs, pc)
+
+
 def run_cluster_press(n_replicas: int, request,
                       duration_s: float = 10.0, threads: int = 4,
                       timeout_ms: int = 20_000, request_factory=None,
@@ -684,6 +834,22 @@ def main(argv=None):
                     help="with --cluster: kill one replica S seconds "
                          "into the run so session resume runs under "
                          "load")
+    ap.add_argument("--embedding", type=int, default=0, metavar="N",
+                    help="spin up N in-process parameter-server shards "
+                         "and press zipf-skewed Lookup/Update key load "
+                         "through PSClient's PartitionChannel fan-out "
+                         "(lookups/s, update mix, p99 by key-count "
+                         "bucket)")
+    ap.add_argument("--zipf", type=float, default=1.0, metavar="S",
+                    help="with --embedding: zipf skew exponent for the "
+                         "key distribution (0 = uniform)")
+    ap.add_argument("--update-ratio", type=float, default=0.1,
+                    help="with --embedding: fraction of requests that "
+                         "are sparse Updates instead of Lookups")
+    ap.add_argument("--vocab", type=int, default=1024,
+                    help="with --embedding: embedding table rows")
+    ap.add_argument("--dim", type=int, default=32,
+                    help="with --embedding: embedding row width")
     ap.add_argument("--disagg", metavar="PREFILL_ADDR,DECODE_ADDR",
                     help="drive a disaggregated prefill/decode split: "
                          "each call runs DisaggPrefill.Prefill on the "
@@ -724,6 +890,12 @@ def main(argv=None):
                          "top-N stage-tagged folded stacks alongside "
                          "the latency report; 0 disables")
     a = ap.parse_args(argv)
+    if a.embedding:
+        run_embedding_press(a.embedding, vocab=a.vocab, dim=a.dim,
+                            zipf_s=a.zipf, update_ratio=a.update_ratio,
+                            duration_s=a.duration, threads=a.threads,
+                            out=sys.stdout)
+        return
     if a.disagg is None and not a.cluster:
         missing = [n for n, v in (("--server", a.server),
                                   ("--service", a.service),

@@ -1969,3 +1969,132 @@ def test_spec_verify_crash_resumes_bit_exact_no_draft_leaks(seed):
     assert wait_until(
         lambda: (gc.collect(), native_path.tokring_live())[1] <= ring0,
         10), "native emit rings leaked across the speculative restart"
+
+
+# ---------------------------------------------------------------------------
+# scenario 16 (ISSUE 12): partition failures mid-fanout over the sharded
+# parameter-server service -> PartitionChannel sub-call retry gives
+# exactly-once apply (version counters prove no double scatter-add)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_psserve_partition_faults_exactly_once_apply(seed):
+    """Injected `psserve.lookup` / `psserve.update` faults fail
+    individual PARTITION sub-calls mid-fanout (pre-apply failures AND
+    post-apply ack drops).  The client's partition-level retry must
+    heal every request, and the invariants hold:
+
+    * every Update applies EXACTLY once — the per-shard version
+      counters advance once per distinct update_id, post-apply retries
+      dedup (dup counter > 0 when an ack dropped), and the final table
+      is bit-identical to applying each acked update once;
+    * every Lookup eventually serves rows bit-identical to the oracle;
+    * pools return to baseline: batcher queues drain to zero and the
+      shards' applied-id sets hold exactly the distinct updates.
+    """
+    import numpy as np
+
+    from brpc_tpu.psserve import (EmbeddingShardServer, PSClient,
+                                  init_embedding_table, register_psserve,
+                                  unregister_psserve)
+    from brpc_tpu.rpc.combo_channels import PartitionChannel
+
+    V, D, P = 64, 8, 4
+    # integer base + integer grads: every association of float32 adds
+    # is exact, so exactly-once shows up as bit-identity
+    base = np.round(init_embedding_table(V, D, seed=3) * 100)
+    servers, svcs, shards = [], [], []
+    pc = PartitionChannel(P)
+    for i in range(P):
+        sh = EmbeddingShardServer(i, P, V, D, table=base,
+                                  name=f"chaos16_{seed}")
+        shards.append(sh)
+        s = brpc.Server()
+        svcs.append(register_psserve(s, sh, max_delay_us=500,
+                                     name=f"c16_{seed}_{i}"))
+        s.start("127.0.0.1", 0)
+        servers.append(s)
+        # channel retry OFF: the injected sub-call failure must be
+        # healed by the PARTITION-level retry under test, not papered
+        # over inside the socket channel
+        pc.add_partition(i, brpc.Channel(f"127.0.0.1:{s.port}",
+                                         timeout_ms=10_000, max_retry=0))
+    rng = np.random.default_rng(seed)
+    n_threads, n_updates = 4, 3
+    keysets = [rng.integers(0, V, size=6).astype(np.int64)
+               for _ in range(n_threads)]
+    gradsets = [rng.integers(-3, 4, (6, D)).astype(np.float32)
+                for _ in range(n_threads)]
+    plan = fault.FaultPlan(seed)
+    # drift the firing point with the seed so pre-apply failures,
+    # post-apply ack drops and lookup failures all get coverage
+    plan.on("psserve.update", fault.ERROR, times=2, after=seed % 3)
+    plan.on("psserve.lookup", fault.ERROR, times=2, after=seed % 2)
+    results: dict = {}
+    mu = threading.Lock()
+    try:
+        with fault.injected(plan):
+            def worker(t):
+                cli = PSClient(pc, vocab=V, dim=D, max_retry=3,
+                               name=f"c16cli_{seed}_{t}")
+                try:
+                    for _ in range(n_updates):
+                        cli.update(keysets[t], gradsets[t])
+                        cli.lookup(keysets[t])
+                    with mu:
+                        results[t] = (cli.n_retries, cli.n_stale_reads)
+                except errors.RpcError as e:   # pragma: no cover
+                    with mu:
+                        results[t] = e
+
+            ts = [threading.Thread(target=worker, args=(t,))
+                  for t in range(n_threads)]
+            [t.start() for t in ts]
+            [t.join(60) for t in ts]
+        # every request healed: no worker surfaced an error or hung
+        assert len(results) == n_threads
+        failed = {t: r for t, r in results.items()
+                  if isinstance(r, Exception)}
+        assert not failed, f"workers failed despite retries: {failed}"
+        # the schedule actually fired
+        assert sum(plan.injected.values()) >= 1
+        # exactly-once: version counters advance once per DISTINCT
+        # update (n_threads * n_updates sub-applies per owning shard),
+        # and any post-apply ack drop shows up as a dedup, never a
+        # double add
+        import jax.numpy as jnp
+        want = jnp.asarray(base)
+        for t in range(n_threads):
+            for _ in range(n_updates):
+                want = want.at[keysets[t]].add(jnp.asarray(gradsets[t]))
+        got = np.concatenate([sh.snapshot_rows() for sh in shards])
+        np.testing.assert_array_equal(got, np.asarray(want))
+        total_applies = sum(sh.n_updates for sh in shards)
+        total_version = sum(sh.version for sh in shards)
+        assert total_version == total_applies, \
+            "version advanced without a distinct apply (double add?)"
+        # read-your-writes held through the chaos
+        assert all(r[1] == 0 for r in results.values())
+        # quiescent lookups (all writers joined) bit-identical to the
+        # oracle — through the service, not snapshot_rows
+        wantn = np.asarray(want)
+        final_cli = PSClient(pc, vocab=V, dim=D, max_retry=3,
+                             name=f"c16fin_{seed}")
+        for t in range(n_threads):
+            np.testing.assert_array_equal(final_cli.lookup(keysets[t]),
+                                          wantn[keysets[t]])
+        # pools/refcounts to baseline: queues drained, applied-id sets
+        # hold exactly the distinct applies (every dup was served from
+        # the set, not re-added)
+        for svc in svcs:
+            for b in (svc._lookup_b, svc._update_b):
+                assert wait_until(
+                    lambda b=b: b.stats()["queued"] == 0, 10)
+        assert sum(len(sh._applied) for sh in shards) == total_applies
+    finally:
+        for svc in svcs:
+            unregister_psserve(svc)
+        for s in servers:
+            s.stop()
+            s.join()
+        pc.close()

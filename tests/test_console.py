@@ -272,6 +272,7 @@ def test_every_console_route_answers(server):
         "/version", "/connections", "/sockets", "/bthreads", "/services",
         "/protobufs", "/memory", "/ici", "/serving",
         "/serving/generations", "/kvcache", "/migration", "/cluster",
+        "/psserve",
         "/rpcz",
         "/rpcz?trace_id=1", "/brpc_metrics",
         "/dashboard", "/vlog", "/hotspots",
@@ -387,3 +388,56 @@ def test_cluster_page_shows_replica_table_and_gradient():
         s.stop()
         s.join()
         router.close(timeout_s=1.0)
+
+
+def test_psserve_page_shows_shards_batchers_and_hot_keys():
+    """/psserve renders per-shard row ranges + version counters +
+    hot-key histograms, the Lookup/Update batchers' coalescing stats,
+    and client counters (ISSUE 12); psserve_* bvars ride
+    /brpc_metrics."""
+    import numpy as np
+
+    from brpc_tpu.psserve import (EmbeddingShardServer, PSClient,
+                                  register_psserve, unregister_psserve)
+    from brpc_tpu.rpc.combo_channels import PartitionChannel
+
+    sh = EmbeddingShardServer(0, 1, 64, 8, seed=3,
+                              name="console_ps")
+    s = brpc.Server()
+    svc = register_psserve(s, sh, name="console_ps_0")
+    s.start("127.0.0.1", 0)
+    pc = PartitionChannel(1)
+    pc.add_partition(0, brpc.Channel(f"127.0.0.1:{s.port}",
+                                     timeout_ms=5000))
+    cli = PSClient(pc, vocab=64, dim=8, name="console_cli")
+    try:
+        cli.lookup(np.array([1, 1, 7], np.int64))
+        cli.update(np.array([7], np.int64),
+                   np.ones((1, 8), np.float32))
+        status, body = _get(s, "/psserve")
+        assert status == 200
+        snap = json.loads(body)
+        ours = [e for e in snap["shards"] if e["name"] == "console_ps"]
+        assert len(ours) == 1
+        e = ours[0]
+        assert e["range"] == [0, 64] and e["rows"] == 64
+        assert e["version"] == 1 and e["updates"] == 1
+        # hot-key histogram counted the duplicate
+        assert dict(map(tuple, e["hot_keys"])).get(1) == 2
+        assert set(e["batchers"]) == {"ps_lookup_console_ps_0",
+                                      "ps_update_console_ps_0"}
+        for b in e["batchers"].values():
+            assert "avg_batch_size" in b and "queued" in b
+        mine = [c for c in snap["clients"] if c["name"] == "console_cli"]
+        assert mine and mine[0]["lookups"] == 1 \
+            and mine[0]["updates"] == 1
+        # psserve_* counters on the Prometheus scrape
+        status, metrics = _get(s, "/brpc_metrics")
+        assert status == 200
+        assert b"psserve_lookups" in metrics
+        assert b"psserve_updates" in metrics
+    finally:
+        unregister_psserve(svc)
+        s.stop()
+        s.join()
+        cli.close()

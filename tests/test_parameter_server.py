@@ -68,8 +68,18 @@ def test_sharded_step_places_arrays_on_mesh(cfg):
         emb = max(leaves, key=lambda a: a.size)
     shards = emb.addressable_shards
     assert len(shards) > 1
-    assert len({s.index for s in shards}) > 1, \
+    # slice objects are unhashable before Python 3.12 — reduce each
+    # shard's index to a hashable (start, stop) tuple per dimension
+    ranges = {tuple((sl.start, sl.stop) for sl in s.index)
+              for s in shards}
+    assert len(ranges) > 1, \
         "largest parameter is fully replicated — no sharding applied"
+    # ...and the row ranges must be DISTINCT per chip (true row
+    # sharding over the whole mesh, the psserve ownership map), not a
+    # handful of ranges each replicated across a spare axis
+    assert len(ranges) == len(shards), (
+        f"embedding rows replicated: {len(ranges)} distinct ranges "
+        f"over {len(shards)} shards")
     # a second invocation reuses the compiled executable (no retrace):
     out_params2, loss2 = step(out_params, tokens, targets)
     assert np.isfinite(float(loss2))

@@ -154,6 +154,37 @@ class TestPress:
             srv.join()
 
 
+    def test_press_embedding_zipf_mode(self):
+        """--embedding N --zipf S (ISSUE 12): zipf-skewed key load over
+        an in-process sharded PS fleet through the PartitionChannel
+        fan-out; the summary reports rates, the update mix, per-key-
+        count-bucket percentiles, and per-shard version counters."""
+        import io
+
+        from brpc_tpu.tools.rpc_press import (run_embedding_press,
+                                              zipf_key_sampler)
+
+        # the sampler is seeded (replayable) and actually skewed
+        sample = zipf_key_sampler(256, 1.2, seed=7)
+        a = sample(500)
+        b = zipf_key_sampler(256, 1.2, seed=7)(500)
+        import numpy as np
+        np.testing.assert_array_equal(a, b)
+        _, counts = np.unique(a, return_counts=True)
+        assert counts.max() >= 5 * max(counts.min(), 1)
+
+        s = run_embedding_press(2, vocab=128, dim=8, zipf_s=1.0,
+                                update_ratio=0.3, key_counts=(4, 16),
+                                duration_s=0.8, threads=2,
+                                out=io.StringIO())
+        assert s["lookups_per_s"] > 0
+        assert s["latency_by_key_count"]
+        for b in s["latency_by_key_count"].values():
+            assert b["p50_us"] <= b["p99_us"]
+        assert sum(s["shard_versions"]) >= 1
+        assert s["dup_updates"] == 0
+
+
 class TestViewAndParallelHttp:
     def test_view_and_fetch(self):
         srv = brpc.Server()

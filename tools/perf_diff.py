@@ -40,7 +40,9 @@ _HIGHER_BETTER_KEYS = {"qps", "gbps", "tokens_per_s", "items_per_s",
                        "speedup_at_peak", "zero_copy_speedup",
                        "prefill_skip_ratio",
                        "direct_gens_per_s", "router_gens_per_s",
-                       "native_speedup"}
+                       "native_speedup",
+                       "batched_lookups_per_s",
+                       "unbatched_lookups_per_s"}
 
 
 def direction(key: str) -> str | None:
