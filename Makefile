@@ -123,6 +123,16 @@ psserve: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_psserve.py -q
 	JAX_PLATFORMS=cpu python bench.py embedding
 
+# Binary tensor wire (README "Binary tensor wire", ISSUE 13): the
+# frame identity/golden/fuzz suite + PS bit-identity over tensorframe
+# vs JSON vs the dense oracle + the ICI fast path, then the embedding
+# bench rung's serializer axis (json vs tensorframe vs lowered,
+# tax_reduction_x >= 5x beyond spread is the acceptance bar).
+tensorframe: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_tensorframe.py \
+	  tests/test_fuzz_parsers.py::test_fuzz_tensorframe_frames -q
+	JAX_PLATFORMS=cpu python bench.py embedding
+
 # Speculative decoding (README "Speculative decoding", ISSUE 11): the
 # identity suite (spec output == plain greedy at depths 2/4/8 — cold,
 # warm, mixed slots, draft trees, through Serving.Generate), the

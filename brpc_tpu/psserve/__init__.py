@@ -49,6 +49,49 @@ def _register_table(table) -> None:
         _tables.append(weakref.ref(table))
 
 
+# ---- the ICI fast path's local-table registry (ISSUE 13) ----
+#
+# A process that co-locates a ShardedEmbeddingTable with its PSClients
+# registers the table here (ShardedEmbeddingTable(serve_local=True) or
+# an explicit register_local_table call); PSClient(ici="auto") then
+# short-circuits Lookup/Update to the lowered shard_map program behind
+# the unchanged client API.  Registration is the explicit opt-in: a
+# table constructed for tests/oracles never hijacks RPC clients.
+
+_local_tables: dict[str, "weakref.ref"] = {}
+# bumped on every register/unregister: clients cache a MISS against
+# this generation so the common no-local-table case never takes _mu
+# on the lookup/update hot path
+_local_tables_gen = 0
+
+
+def register_local_table(table, name: str = "ps") -> None:
+    """Publish ``table`` as THE local lowered table for PS clients
+    named after the same logical table (default ``"ps"``)."""
+    global _local_tables_gen
+    with _mu:
+        _local_tables[str(name)] = weakref.ref(table)
+        _local_tables_gen += 1
+
+
+def unregister_local_table(name: str = "ps") -> None:
+    global _local_tables_gen
+    with _mu:
+        _local_tables.pop(str(name), None)
+        _local_tables_gen += 1
+
+
+def find_local_table(name: str, vocab: int, dim: int):
+    """The registered local table matching (name, vocab, dim), or None
+    — geometry must match exactly or the fast path stays off."""
+    with _mu:
+        ref = _local_tables.get(str(name))
+    t = ref() if ref is not None else None
+    if t is None or t.vocab != int(vocab) or t.dim != int(dim):
+        return None
+    return t
+
+
 def psserve_snapshot() -> dict:
     """Live PS components' stats — the /psserve console page's data:
     per-shard row counts + version counters + hot-key histograms,
@@ -69,7 +112,8 @@ def psserve_snapshot() -> dict:
         if svc is not None:
             entry["batchers"] = {
                 b.name: b.stats() for b in
-                (svc._lookup_b, svc._update_b) if b is not None}
+                (svc._lookup_b, svc._update_b, svc._update_tb)
+                if b is not None}
         shards.append(entry)
     for cref in client_refs:
         c = cref()
@@ -84,7 +128,9 @@ def psserve_snapshot() -> dict:
         _shards[:] = [e for e in _shards if e[0]() is not None]
         _clients[:] = [r for r in _clients if r() is not None]
         _tables[:] = [r for r in _tables if r() is not None]
-    return {"shards": shards, "clients": clients, "lowered": tables}
+    from brpc_tpu.psserve.service import wire_counters
+    return {"shards": shards, "clients": clients, "lowered": tables,
+            "wire": wire_counters()}
 
 
 from brpc_tpu.psserve.shard import (  # noqa: E402,F401

@@ -25,6 +25,7 @@ stable name hash.
 from __future__ import annotations
 
 import threading
+import weakref
 import zlib
 from typing import Optional
 
@@ -38,6 +39,14 @@ CLIENT_LOOKUPS = Adder("psserve_client_lookups")
 CLIENT_UPDATES = Adder("psserve_client_updates")
 CLIENT_RETRIES = Adder("psserve_client_retries")
 CLIENT_STALE_READS = Adder("psserve_client_stale_reads")
+# binary-wire negotiation (ISSUE 13): a partition answering ENOMETHOD
+# to LookupT/UpdateT is an old peer — it falls back to JSON, sticky
+# per partition, and this counts each such downgrade
+CLIENT_NEGOTIATION_FALLBACKS = Adder(
+    "psserve_client_negotiation_fallbacks")
+# calls short-circuited to a co-located lowered table (the ICI fast
+# path) instead of the RPC fan-out
+CLIENT_ICI_CALLS = Adder("psserve_client_ici_calls")
 LOOKUP_LATENCY = LatencyRecorder("psserve_client_lookup")
 
 # update_id construction: ids must stay unique across every client in
@@ -82,13 +91,32 @@ class PSClient:
     def __init__(self, backend, *, vocab: int, dim: int,
                  n_shards: Optional[int] = None,
                  timeout_ms: int = 5000, max_retry: int = 2,
-                 name: str = "psclient"):
+                 name: str = "psclient",
+                 serializer: str = "tensorframe",
+                 ici: object = "auto", table_name: str = "ps"):
         from brpc_tpu.rpc.combo_channels import PartitionChannel
+        if serializer not in ("tensorframe", "json"):
+            raise ValueError("serializer must be tensorframe|json, got "
+                             f"{serializer!r}")
         self.vocab = int(vocab)
         self.dim = int(dim)
         self.name = name
         self.timeout_ms = int(timeout_ms)
         self.max_retry = int(max_retry)
+        # preferred wire format; per-partition negotiation downgrades
+        # to "json" (sticky) when a partition answers ENOMETHOD to the
+        # binary methods (an old peer)
+        self.serializer = serializer
+        self._wire_mode: dict[int, str] = {}
+        # ICI fast path: "auto" engages when a ShardedEmbeddingTable
+        # matching (table_name, vocab, dim) is registered locally
+        # (psserve.register_local_table / serve_local=True); "off"
+        # never; a table instance pins it explicitly
+        self._ici_mode = ici
+        self.table_name = str(table_name)
+        self._ici_ref = None
+        self._ici_gen = None        # registry generation of cached miss
+        self._ici_acked_version = 0
         self._pc = None
         self._lowered = None
         if isinstance(backend, PartitionChannel):
@@ -109,6 +137,8 @@ class PSClient:
         self.n_updates = 0
         self.n_retries = 0
         self.n_stale_reads = 0
+        self.n_negotiation_fallbacks = 0
+        self.n_ici_calls = 0
         from brpc_tpu import psserve as _ps
         _ps._register_client(self)
 
@@ -126,6 +156,50 @@ class PSClient:
         return {int(s): np.flatnonzero(owner == s)
                 for s in np.unique(owner)}
 
+    # ---- the ICI fast path (ISSUE 13) ----
+
+    def _ici_table(self):
+        """The co-located lowered table this client short-circuits to,
+        or None.  "auto" resolves against the psserve local-table
+        registry (geometry must match); hits cache by weakref, misses
+        cache by registry GENERATION — the common no-local-table case
+        costs one plain attribute read per call, never the registry
+        lock (a hot-path client must not serialize on a process-wide
+        mutex that exists for the rare co-located case)."""
+        if self._pc is None:
+            return None         # already a lowered backend
+        mode = self._ici_mode
+        if mode in (None, False, "off"):
+            return None
+        if not isinstance(mode, str):   # an explicit table instance
+            return mode
+        from brpc_tpu import psserve as _ps
+        gen = _ps._local_tables_gen     # plain int read, GIL-atomic
+        if self._ici_gen == gen:
+            # registry unchanged since the cached resolution — hit or
+            # miss, the cache is authoritative (an unregister/replace
+            # bumps the generation, so a stale hit can never keep
+            # short-circuiting to an orphaned table)
+            return self._ici_ref() if self._ici_ref is not None else None
+        t = _ps.find_local_table(self.table_name, self.vocab, self.dim)
+        self._ici_gen = gen
+        self._ici_ref = weakref.ref(t) if t is not None else None
+        return t
+
+    def _note_ici(self, ver: int, acked: bool) -> None:
+        """Fast-path read-your-writes bookkeeping — tracked apart from
+        the per-shard RPC counters (the lowered table's version is one
+        counter, not n_shards of them)."""
+        with self._mu:
+            self.n_ici_calls += 1
+            if acked:
+                if ver > self._ici_acked_version:
+                    self._ici_acked_version = ver
+            elif ver < self._ici_acked_version:
+                self.n_stale_reads += 1
+                CLIENT_STALE_READS.add(1)
+        CLIENT_ICI_CALLS.add(1)
+
     # ---- Lookup ----
 
     def lookup(self, keys) -> np.ndarray:
@@ -140,15 +214,23 @@ class PSClient:
         if self._lowered is not None:
             rows, _ver = self._lowered.lookup(keys)
         else:
-            split = self._split(keys)
-            sub = {part: {"keys": keys[pos].tolist()}
-                   for part, pos in split.items()}
-            resp = self._call(sub, "Lookup")
-            rows = np.empty((keys.shape[0], self.dim), np.float32)
-            for part, pos in split.items():
-                r = resp[part]
-                rows[pos] = np.asarray(r["rows"], np.float32)
-                self._note_version(part, int(r.get("version", 0)))
+            tbl = self._ici_table()
+            if tbl is not None:
+                # co-located lowered table: one compiled collective
+                # program, no socket — same client API, same rows
+                rows, ver = tbl.lookup(keys)
+                self._note_ici(ver, acked=False)
+            else:
+                split = self._split(keys)
+                resp = self._fan_out(
+                    split, "Lookup",
+                    lambda part, pos: {"keys": keys[pos].tolist()},
+                    lambda part, pos: {"keys": keys[pos]})
+                rows = np.empty((keys.shape[0], self.dim), np.float32)
+                for part, pos in split.items():
+                    r = resp[part]
+                    rows[pos] = np.asarray(r["rows"], np.float32)
+                    self._note_version(part, int(r.get("version", 0)))
         with self._mu:
             self.n_lookups += 1
         CLIENT_LOOKUPS.add(1)
@@ -189,14 +271,33 @@ class PSClient:
             return {0: ver}
         token = update_token if update_token is not None \
             else _next_uid_seq()
+        tbl = self._ici_table()
+        if tbl is not None:
+            # fast path: ONE atomic apply against the lowered table,
+            # idempotent by the token itself (a replayed update_token
+            # hits the table's applied set and acks the original —
+            # the same discipline the RPC shards run per partition)
+            ver = tbl.update(keys, grads, update_id=token)
+            self._note_ici(ver, acked=True)
+            with self._mu:
+                self.n_updates += 1
+            CLIENT_UPDATES.add(1)
+            return {0: ver}
         split = self._split(keys)
-        sub = {}
-        for part, pos in split.items():
-            sub[part] = {"keys": keys[pos].tolist(),
-                         "grads": grads[pos].tolist(),
-                         "update_id": self._uid_for(token, part)}
+
+        def make_json(part, pos):
+            return {"keys": keys[pos].tolist(),
+                    "grads": grads[pos].tolist(),
+                    "update_id": self._uid_for(token, part)}
+
+        def make_frame(part, pos):
+            # tensors ride as raw int64/float32 bytes (fancy-index
+            # slices, one vectorized copy each), never Python lists
+            return {"keys": keys[pos], "grads": grads[pos],
+                    "update_id": self._uid_for(token, part)}
+
         try:
-            resp = self._call(sub, "Update")
+            resp = self._fan_out(split, "Update", make_json, make_frame)
         except errors.RpcError as e:
             # stamp the token so the caller can replay THIS logical
             # update idempotently (partitions that acked will dedup)
@@ -240,15 +341,138 @@ class PSClient:
 
     # ---- fan-out plumbing ----
 
-    def _call(self, sub_requests: dict, method: str) -> dict:
+    def _call(self, sub_requests: dict, method: str,
+              serializer: str = "json") -> dict:
         def on_retry(idx, err):
             with self._mu:
                 self.n_retries += 1
             CLIENT_RETRIES.add(1)
         return self._pc.call_partitioned(
-            "PS", method, sub_requests, serializer="json",
+            "PS", method, sub_requests, serializer=serializer,
             timeout_ms=self.timeout_ms, max_retry=self.max_retry,
             on_retry=on_retry)
+
+    def _mode_for(self, part: int) -> str:
+        return self._wire_mode.get(part, self.serializer)
+
+    def _mark_json(self, part: int) -> None:
+        with self._mu:
+            if self._wire_mode.get(part) == "json":
+                return      # already downgraded (a concurrent fan-out
+                            # won the race) — count the change once
+            self._wire_mode[part] = "json"
+            self.n_negotiation_fallbacks += 1
+        CLIENT_NEGOTIATION_FALLBACKS.add(1)
+
+    @staticmethod
+    def _group_failures(e, parts, out) -> dict:
+        """One group call raised: absorb its partial responses into
+        ``out`` and return {part: error} for the parts that failed (an
+        error with no per-partition detail blames every unanswered
+        part)."""
+        out.update(getattr(e, "partial_responses", {}) or {})
+        fj = getattr(e, "failed_partitions", None)
+        if fj:
+            return dict(fj)
+        return {p: e for p in parts if p not in out}
+
+    def _fan_out(self, split: dict, base_method: str,
+                 make_json, make_frame) -> dict:
+        """Issue one sub-call per partition in each partition's
+        negotiated wire format: ``base_method`` + JSON for "json"
+        partitions, ``base_method + "T"`` + tensorframe for binary
+        ones — the two groups run CONCURRENTLY (a steady-state mixed
+        fleet after a rolling upgrade must pay max of the two
+        fan-outs, not their sum).  A binary partition failing
+        ENOMETHOD is an OLD PEER: it downgrades to JSON (sticky) and
+        its sub-call re-issues — sub-requests are idempotent
+        (per-partition update_ids are a pure function of the logical
+        token), so the re-issue is safe even if the first attempt
+        applied.  On any partition failing for real, ONE error
+        aggregates the whole fan-out (single shared code preserved,
+        else ETOOMANYFAILS; failed_partitions + partial_responses
+        carry the detail)."""
+        modes = {part: self._mode_for(part) for part in split}
+        out: dict = {}
+        failures: dict = {}
+        bin_parts = [p for p in split if modes[p] == "tensorframe"]
+        json_parts = [p for p in split if modes[p] == "json"]
+
+        json_out: dict = {}
+        json_exc: list = [None]
+
+        def run_json(parts):
+            sub = {p: make_json(p, split[p]) for p in parts}
+            try:
+                json_out.update(self._call(sub, base_method,
+                                           serializer="json"))
+            except errors.RpcError as e:
+                json_exc[0] = e
+            except Exception as e:     # a non-Rpc bug must not leave
+                # the group silently unanswered (the caller would then
+                # KeyError outside the RpcError/update_token contract)
+                json_exc[0] = errors.RpcError(
+                    errors.EINTERNAL,
+                    f"json fan-out failed: {type(e).__name__}: {e}")
+
+        jt = None
+        if json_parts:
+            if bin_parts:
+                # one short-lived thread per MIXED-fleet call: mixed
+                # wire modes are the rolling-upgrade transitional state
+                # (steady fleets take one group and never spawn), and
+                # the thread buys max-of-the-two-fan-outs latency
+                jt = threading.Thread(target=run_json,
+                                      args=(json_parts,), daemon=True)
+                jt.start()
+            else:
+                run_json(json_parts)
+
+        fallback = []
+        if bin_parts:
+            sub = {p: make_frame(p, split[p]) for p in bin_parts}
+            try:
+                out.update(self._call(sub, base_method + "T",
+                                      serializer="tensorframe"))
+            except errors.RpcError as e:
+                for p, err in self._group_failures(
+                        e, bin_parts, out).items():
+                    if isinstance(err, errors.RpcError) \
+                            and err.code == errors.ENOMETHOD:
+                        self._mark_json(p)
+                        fallback.append(p)
+                    else:
+                        failures[p] = err
+        if fallback:
+            # one-time re-issue for freshly-downgraded old peers
+            # (first contact only; steady state rides the concurrent
+            # JSON group above)
+            sub = {p: make_json(p, split[p]) for p in fallback}
+            try:
+                out.update(self._call(sub, base_method,
+                                      serializer="json"))
+            except errors.RpcError as e:
+                failures.update(self._group_failures(e, fallback, out))
+        if jt is not None:
+            jt.join()
+        out.update(json_out)
+        if json_exc[0] is not None:
+            failures.update(self._group_failures(json_exc[0],
+                                                 json_parts, out))
+        if failures:
+            codes = {err.code for err in failures.values()
+                     if isinstance(err, errors.RpcError)}
+            code = codes.pop() if len(codes) == 1 \
+                else errors.ETOOMANYFAILS
+            first_p = next(iter(failures))
+            err = errors.RpcError(
+                code, f"{len(failures)}/{len(split)} partitions "
+                      f"failed (first: partition {first_p}: "
+                      f"{failures[first_p]})")
+            err.failed_partitions = dict(failures)
+            err.partial_responses = dict(out)
+            raise err
+        return out
 
     def _note_ack(self, part: int, ver: int) -> None:
         with self._mu:
@@ -274,6 +498,10 @@ class PSClient:
                 "n_shards": self.n_shards,
                 "backend": "lowered" if self._lowered is not None
                            else "partition_channel",
+                "serializer": self.serializer,
+                "wire_modes": dict(self._wire_mode),
+                "negotiation_fallbacks": self.n_negotiation_fallbacks,
+                "ici_calls": self.n_ici_calls,
                 "lookups": self.n_lookups,
                 "updates": self.n_updates,
                 "stale_reads": self.n_stale_reads,

@@ -51,7 +51,8 @@ class ShardedEmbeddingTable:
                  n_shards: Optional[int] = None, seed: int = 0,
                  table: Optional[np.ndarray] = None,
                  key_buckets: Sequence[int] = DEFAULT_KEY_BUCKETS,
-                 mode: str = "psum"):
+                 mode: str = "psum", serve_local: bool = False,
+                 name: str = "ps", applied_cap: int = 65536):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -84,8 +85,21 @@ class ShardedEmbeddingTable:
         self.version = 0
         self.n_lookups = 0
         self.n_updates = 0
+        self.n_dup_updates = 0
+        self.name = str(name)
+        # the ICI fast path's idempotence (ISSUE 13): the same
+        # update_id-checked-against-an-applied-set discipline the RPC
+        # shards run, so a co-located client's replayed update_token
+        # acks the ORIGINAL apply instead of double scatter-adding
+        from collections import OrderedDict
+        self._applied: "OrderedDict[int, int]" = OrderedDict()
+        self._applied_cap = int(applied_cap)
         from brpc_tpu import psserve as _ps
         _ps._register_table(self)
+        if serve_local:
+            # explicit opt-in: THIS table serves co-located PSClients
+            # (PSClient(ici="auto") short-circuits to it)
+            _ps.register_local_table(self, name=self.name)
 
         jnp_ = jnp
         rows_per = self.rows_per
@@ -167,16 +181,26 @@ class ShardedEmbeddingTable:
         LOWERED_LOOKUPS.add(1)
         return np.asarray(out)[:n], ver
 
-    def update(self, keys, grads) -> int:
+    def update(self, keys, grads,
+               update_id: Optional[int] = None) -> int:
         """Scatter-add grads into the sharded table; one compiled
-        program, table stays sharded."""
+        program, table stays sharded.  With ``update_id`` the apply is
+        idempotent exactly like the RPC shards: a duplicate id acks
+        the ORIGINAL apply's version and touches nothing."""
         padded, n = self._pad_keys(keys)
         g = np.zeros((padded.shape[0], self.dim), np.float32)
         g[:n] = np.asarray(grads, np.float32)
         with self._mu:
+            if update_id is not None and update_id in self._applied:
+                self.n_dup_updates += 1
+                return self._applied[update_id]
             self._table = self._update(self._table, padded, g)
             self.version += 1
             ver = self.version
+            if update_id is not None:
+                self._applied[update_id] = ver
+                while len(self._applied) > self._applied_cap:
+                    self._applied.popitem(last=False)
             self.n_updates += 1
         LOWERED_UPDATES.add(1)
         return ver
@@ -191,6 +215,7 @@ class ShardedEmbeddingTable:
     def stats(self) -> dict:
         with self._mu:
             return {
+                "name": self.name,
                 "partitions": self.p,
                 "vocab": self.vocab,
                 "dim": self.dim,
@@ -198,5 +223,7 @@ class ShardedEmbeddingTable:
                 "version": self.version,
                 "lookups": self.n_lookups,
                 "updates": self.n_updates,
+                "dup_updates": self.n_dup_updates,
+                "applied_ids": len(self._applied),
                 "mesh": dict(self.mesh.shape),
             }

@@ -145,6 +145,13 @@ class EmbeddingShardServer:
         # one jit each; bucket padding bounds the compile count
         self._gather = jax.jit(lambda t, k: t[k])
         self._scatter = jax.jit(lambda t, k, g: t.at[k].add(g))
+        # CPU fast path (ISSUE 13): with no device mesh, a bucketed
+        # gather is a plain numpy fancy-index over a zero-copy view of
+        # the (immutable, swap-on-update) jax array — bit-identical to
+        # the jitted gather, without ~200us of dispatch per call.  On a
+        # real mesh the jit path stays (the gather must run where the
+        # rows live).
+        self._cpu_fast = mesh is None and jax.default_backend() == "cpu"
 
     # ---- ownership helpers ----
 
@@ -182,9 +189,14 @@ class EmbeddingShardServer:
         local = self._to_local(keys)
         n = local.shape[0]
         b = _bucket_up(max(n, 1), self.key_buckets)
-        padded = np.zeros((b,), np.int64)
-        padded[:n] = local
-        rows = np.asarray(self._gather(self._rows, padded))[:n]
+        if self._cpu_fast:
+            with self._mu:
+                tbl = self._rows
+            rows = np.asarray(tbl)[local]
+        else:
+            padded = np.zeros((b,), np.int64)
+            padded[:n] = local
+            rows = np.asarray(self._gather(self._rows, padded))[:n]
         with self._mu:
             ver = self.version
             self.n_lookups += 1
@@ -280,6 +292,10 @@ class EmbeddingShardServer:
         k = np.asarray(padded, np.int64)
         with self._mu:
             rows = self._rows
+        if self._cpu_fast:
+            # numpy fancy-index over the zero-copy CPU view: exact same
+            # rows as the jitted gather, none of the dispatch
+            return np.asarray(rows)[k]
         return np.asarray(self._gather(rows, k))
 
     # Update rows pack (update_id, then per key [key, grad...]) into ONE
@@ -314,6 +330,60 @@ class EmbeddingShardServer:
         ).reshape(B, kb, 1 + self.dim)
         keys = body[:, :, 0].astype(np.int64)
         grads = body[:, :, 1:].astype(np.float32)
+        uids = padded[:, 0].astype(np.int64)
+        return self._apply_update_batch(uids, keys, grads)
+
+    # The BINARY update path (tensorframe wire, ISSUE 13) packs bytes,
+    # not float64: one record is [update_id u64][key i64, grad f32*D] x k
+    # — vectorized byte views in and out, no per-element float64
+    # conversion and no 53-bit packing ceiling on the row format.
+    # Padding bytes are zero = key 0 grad 0 groups, a scatter no-op,
+    # exactly the float64 scheme's discipline; both paths share
+    # _apply_update_batch, so dedup is decided against ONE applied set
+    # no matter which wire a retry arrives on.
+
+    def update_record_buckets(self) -> tuple:
+        return tuple(8 + k * (8 + 4 * self.dim) for k in self.key_buckets)
+
+    @staticmethod
+    def pack_update_record(update_id: int, local_keys: np.ndarray,
+                           grads: np.ndarray) -> np.ndarray:
+        """One uint8 record from int64 keys + float32 grads (views in:
+        the frame's decoded tensors splice by vectorized byte copy)."""
+        import struct as _struct
+        n, d = grads.shape
+        rec = np.empty((8 + n * (8 + 4 * d),), np.uint8)
+        rec[:8] = np.frombuffer(_struct.pack("<Q", update_id), np.uint8)
+        body = rec[8:].reshape(n, 8 + 4 * d)
+        body[:, :8] = np.ascontiguousarray(
+            local_keys, "<i8").view(np.uint8).reshape(n, 8)
+        body[:, 8:] = np.ascontiguousarray(
+            grads, "<f4").view(np.uint8).reshape(n, 4 * d)
+        return rec
+
+    def update_batch_fn_binary(self, padded: np.ndarray) -> np.ndarray:
+        """update_batch_fn for uint8 records: reinterpret the byte
+        columns as (uids, keys, grads) with three vectorized copies,
+        then the shared apply."""
+        B, Lb = padded.shape
+        kb = (Lb - 8) // (8 + 4 * self.dim)
+        uids = np.ascontiguousarray(
+            padded[:, :8]).view("<u8").reshape(B).astype(np.int64)
+        body = np.ascontiguousarray(
+            padded[:, 8:8 + kb * (8 + 4 * self.dim)]
+        ).reshape(B, kb, 8 + 4 * self.dim)
+        keys = np.ascontiguousarray(
+            body[:, :, :8]).view("<i8").reshape(B, kb)
+        grads = np.ascontiguousarray(
+            body[:, :, 8:]).view("<f4").reshape(B, kb, self.dim)
+        return self._apply_update_batch(uids, keys, grads)
+
+    def _apply_update_batch(self, uids: np.ndarray, keys: np.ndarray,
+                            grads: np.ndarray) -> np.ndarray:
+        """The ONE coalesced apply both wire formats feed: per-row
+        dedup (applied set + intra-batch), one compiled scatter, acks
+        [version, dup_flag] per row.  uid 0 marks batch padding."""
+        B = keys.shape[0]
         acks = np.zeros((B, 2), np.float64)
         with self._mu:
             # dedup against the applied set AND within this batch: a
@@ -324,7 +394,7 @@ class EmbeddingShardServer:
             first_row: dict[int, int] = {}
             batch_dups: list[tuple[int, int]] = []   # (row, first row)
             for i in range(B):
-                uid = int(padded[i, 0])
+                uid = int(uids[i])
                 if uid == 0:
                     continue            # batch padding, not a request
                 if uid in self._applied:

@@ -438,8 +438,6 @@ class PartitionChannel:
                                          response_merger)
         self._partitions: dict[int, Channel] = {}
         self._lb_spec = lb
-        self._pool = None
-        self._pool_mu = threading.Lock()
 
     def _make_lb(self):
         if self._lb_spec is None:
@@ -537,14 +535,38 @@ class PartitionChannel:
 
     # ---- the retrying sub-call-per-partition driver ----
 
-    def _executor(self):
-        with self._pool_mu:
-            if self._pool is None:
-                from concurrent.futures import ThreadPoolExecutor
-                self._pool = ThreadPoolExecutor(
-                    max_workers=min(32, 2 * max(self.partition_count, 1)),
-                    thread_name_prefix="partition-fanout")
-            return self._pool
+    def _issue_one(self, idx, ch, req, cntl, service, method,
+                   serializer, tried_eps, failed, pending) -> None:
+        """Issue one partition's attempt without blocking (the round
+        driver joins later).  lb-mode partitions pick a replica with
+        rotation; once every replica was tried this rotation, the
+        exclusion set RESETS so the retry budget stays max_retry+1
+        attempts (the old per-attempt driver used a fresh exclusion
+        set per attempt), not the replica count."""
+        if isinstance(ch, SelectiveChannel):
+            picked = ch.pick(exclude=tried_eps[idx])
+            if picked is None and tried_eps[idx]:
+                tried_eps[idx].clear()
+                picked = ch.pick(exclude=tried_eps[idx])
+            if picked is None:
+                failed.setdefault(
+                    idx, errors.RpcError(errors.ENODATA,
+                                         "no selectable replica left"))
+                return
+            _i, sub_ch, ep = picked
+            # exclusion keys match pick()'s contract: endpoints in lb
+            # mode, channel indices in round-robin mode
+            tried_eps[idx].add(ep if ch._lb is not None else _i)
+            # _sync_join: the round driver's join IS the deadline timer
+            # (the call_sync discipline) — no native timer arm+cancel
+            # per sub-call
+            sub_ch.call(service, method, req, cntl=cntl,
+                        serializer=serializer, _sync_join=True)
+            pending.append((idx, cntl, (ch, ep)))
+        else:
+            ch.call(service, method, req, cntl=cntl,
+                    serializer=serializer, _sync_join=True)
+            pending.append((idx, cntl, None))
 
     def call_partitioned(self, service: str, method: str,
                          sub_requests: dict,
@@ -569,42 +591,71 @@ class PartitionChannel:
 
         from brpc_tpu.rpc.channel import RetryPolicy
 
-        def one(idx):
-            req = sub_requests[idx]
-            ch = self._partitions[idx]
-            last: Exception | None = None
-            for _attempt in range(max_retry + 1):
-                cntl = Controller(timeout_ms=timeout_ms)
-                try:
-                    # lb-mode partitions (SelectiveChannel) feed their
-                    # balancer + the breaker per attempt themselves;
-                    # plain partitions have no balancer to feed and the
-                    # channel layer already fed the breaker
-                    return ch.call_sync(service, method, req,
-                                        serializer=serializer, cntl=cntl)
-                except errors.RpcError as e:
-                    last = e
-                    if e.code not in RetryPolicy.RETRYABLE:
-                        # EREQUEST/ENODATA/ENOMETHOD/... are
-                        # deterministic: re-issuing the identical
-                        # sub-call cannot succeed (reference
-                        # retry_policy.h semantics)
-                        break
-                    if on_retry is not None and _attempt < max_retry:
-                        on_retry(idx, e)   # another attempt follows
-                    continue
-            raise last if last is not None else errors.RpcError(
-                errors.ETOOMANYFAILS)
-
-        futs = {idx: self._executor().submit(one, idx)
-                for idx in sub_requests}
+        # ROUND-BASED ASYNC fan-out (ISSUE 13): every round ISSUES all
+        # still-pending sub-calls without blocking (Channel.call with a
+        # join handle — no pool thread per partition; the old
+        # thread-per-sub-call driver cost ~1ms of GIL-contended wakeups
+        # per fan-out on loopback), then joins them in order.  Failed
+        # retryable partitions re-issue in the NEXT round, up to
+        # max_retry extra rounds — identical attempt/rotation semantics
+        # to the per-partition retry loop, batched by round (retries
+        # are the exception path; paying round latency there is free).
+        # lb-mode partitions (SelectiveChannel) drive pick()/feedback()
+        # per attempt — the exposed per-attempt machinery — so replica
+        # rotation and balancer/breaker evidence behave exactly as the
+        # SelectiveChannel.call_sync loop (breaker fed by the channel
+        # layer; feedback(breaker=False)).
         out: dict = {}
         failed: dict = {}
-        for idx, f in futs.items():
-            try:
-                out[idx] = f.result()
-            except Exception as e:
+        tried_eps: dict = {idx: set() for idx in sub_requests}
+        todo = list(sub_requests)
+        for _round in range(max_retry + 1):
+            pending = []    # (idx, cntl, endpoint-for-feedback)
+            for idx in todo:
+                req = sub_requests[idx]
+                ch = self._partitions[idx]
+                cntl = Controller(timeout_ms=timeout_ms)
+                try:
+                    self._issue_one(idx, ch, req, cntl, service, method,
+                                    serializer, tried_eps, failed,
+                                    pending)
+                except errors.RpcError as e:
+                    failed[idx] = e
+                except Exception as e:
+                    # an issue-phase bug (encode failure, ...) must not
+                    # escape raw and abandon the already-issued
+                    # sub-calls un-joined — classify it and keep
+                    # draining the round
+                    failed[idx] = errors.RpcError(
+                        errors.EINTERNAL,
+                        f"sub-call issue failed: "
+                        f"{type(e).__name__}: {e}")
+            todo = []
+            for idx, cntl, fb in pending:
+                cntl.join()
+                if fb is not None:
+                    sel, ep = fb
+                    sel.feedback(ep, cntl.error_code,
+                                 cntl.latency_us or 0, breaker=False)
+                if not cntl.failed():
+                    out[idx] = cntl.response
+                    failed.pop(idx, None)
+                    continue
+                e = errors.RpcError(cntl.error_code,
+                                    cntl.error_text
+                                    or errors.describe(cntl.error_code))
                 failed[idx] = e
+                if e.code not in RetryPolicy.RETRYABLE:
+                    # EREQUEST/ENODATA/ENOMETHOD/... are deterministic:
+                    # re-issuing the identical sub-call cannot succeed
+                    # (reference retry_policy.h semantics)
+                    continue
+                if _round < max_retry:
+                    if on_retry is not None:
+                        on_retry(idx, e)   # another attempt follows
+                    todo.append(idx)
+            if not todo:
+                break
         if failed:
             first = next(iter(failed.values()))
             codes = {e.code for e in failed.values()
@@ -625,10 +676,9 @@ class PartitionChannel:
         return out
 
     def close(self) -> None:
-        with self._pool_mu:
-            pool, self._pool = self._pool, None
-        if pool is not None:
-            pool.shutdown(wait=False)
+        # the fan-out driver is async (join handles) since ISSUE 13 —
+        # no pool to shut down; kept for caller symmetry
+        pass
 
     def call(self, *a, **kw):
         return self._parallel.call(*a, **kw)

@@ -90,6 +90,10 @@ class Controller:
         self.error_text: str = ""
         self.response: Any = None
         self.response_attachment: bytes = b""
+        # server-side: the request body's wire size (set in the decode
+        # phase) — handlers doing per-serializer wire-bytes accounting
+        # (psserve_wire_bytes_*) read it instead of re-encoding
+        self.request_body_size: int = 0
         self.trace_id: int = 0
         self.span_id: int = 0
 

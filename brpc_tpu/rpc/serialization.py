@@ -322,6 +322,27 @@ def get_serializer(name: str):
     return s
 
 
+class TensorFrameSerializer(Serializer):
+    """Mixed-payload binary frames (ISSUE 13): inline scalars/strings
+    plus dtype/shape-tagged tensors decoded as ZERO-COPY numpy views
+    over the transport's IOBuf-backed memoryview — see
+    brpc_tpu/rpc/tensorframe.py for the layout and the bounded-decode
+    discipline.  Deliberately does NOT touch tensor_host_encodes/
+    decodes: those counters belong to the host-materializing tensor
+    serializer, and the loopback bench pins their zero growth on this
+    path."""
+
+    name = "tensorframe"
+
+    def encode(self, obj):
+        from brpc_tpu.rpc.tensorframe import encode_frame
+        return encode_frame(obj), b""
+
+    def decode(self, body, tensor_header):
+        from brpc_tpu.rpc.tensorframe import decode_frame
+        return decode_frame(body)
+
+
 class CompactSerializer(Serializer):
     """Self-describing compact binary (the mcpack2pb slot — see
     brpc_tpu/rpc/compact.py)."""
@@ -338,7 +359,8 @@ class CompactSerializer(Serializer):
 
 
 for _s in (RawSerializer(), JsonSerializer(), PbSerializer(),
-           TensorSerializer(), PickleSerializer(), CompactSerializer()):
+           TensorSerializer(), PickleSerializer(), CompactSerializer(),
+           TensorFrameSerializer()):
     register_serializer(_s)
 
 

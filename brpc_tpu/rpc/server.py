@@ -855,7 +855,18 @@ class Server:
                 else:
                     request = req_ser.decode(payload, meta.tensor_header)
                 span.request_size = len(raw)
+                # request wire size surfaced to handlers (per-serializer
+                # wire-bytes accounting, e.g. psserve_wire_bytes_*)
+                cntl.request_body_size = len(raw)
         except Exception as e:
+            if isinstance(e, ValueError):
+                # malformed payload = bad INPUT, not a server bug: every
+                # serializer's malformed-body path raises ValueError (the
+                # contract serialization.py documents), and the peer must
+                # see a clean EREQUEST instead of EINTERNAL — the
+                # tensorframe fuzz surface pins this
+                e = errors.RpcError(errors.EREQUEST,
+                                    f"cannot decode request: {e}")
             self._complete_request(sid, meta, span, cntl, spec, status,
                                    start, rail_src, None, exc=e)
             return
@@ -1017,6 +1028,12 @@ class Server:
                         error_code = errors.EOVERCROWDED if rc == -2 \
                             else errors.EFAILEDSOCKET
                         _dropped_responses.add(1)
+        except errors.RpcError as e:
+            # a typed failure keeps its code on the wire (the decode
+            # phase wraps malformed payloads as EREQUEST; EINTERNAL for
+            # those would misreport bad input as a server bug)
+            error_code = e.code
+            self._respond_error(sid, meta, e.code, str(e))
         except Exception as e:
             error_code = errors.EINTERNAL
             self._respond_error(sid, meta, errors.EINTERNAL,

@@ -199,7 +199,8 @@ class DynamicBatcher:
                  pass_offsets: Optional[bool] = None,
                  name: str = "default",
                  dtype=np.float32,
-                 padded_output: Optional[bool] = None):
+                 padded_output: Optional[bool] = None,
+                 eager: bool = False):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         # a ModelRunner instance cannot exist unless its module is
@@ -273,6 +274,7 @@ class DynamicBatcher:
         self.brownout_shed = Adder(f"serving_{safe}_brownout_shed")
         self.n_batches = Adder(f"serving_{safe}_batches")
         self.n_completed = Adder(f"serving_{safe}_completed")
+        self.n_bypassed = Adder(f"serving_{safe}_bypassed")
         self.n_errors = Adder(f"serving_{safe}_errors")
         self.lane_promotions = Adder(f"serving_{safe}_lane_promotions")
         self._pad_elems = Adder()    # padded-but-unused elements
@@ -285,6 +287,26 @@ class DynamicBatcher:
             f"serving_{safe}_prefix_skip_ratio")
         self._bvar_names = [n for n in exposed_variables(f"serving_{safe}*")
                             if n not in _pre_bvars]
+
+        # EAGER mode (ISSUE 13, the PS surface's latency shape): the
+        # batching WINDOW exists to gather concurrency, and when the
+        # system is idle it is pure added latency — measured ~1ms per
+        # request on CPU loopback (200us condvar timeout + GIL-contended
+        # wakeups).  With eager=True:
+        #   * an arrival finding the queue EMPTY and no batch executing
+        #     runs INLINE on the submitting thread — batch of one, zero
+        #     cross-thread hops (the cut-through);
+        #   * the drainer forms whatever is queued IMMEDIATELY (no
+        #     window wait) — coalescing comes from accumulation while
+        #     the previous batch executes, the continuous-batching
+        #     discipline (vLLM's shape): under load the drainer is
+        #     always busy, so arrivals pile up and batches stay large.
+        # Default False: generative scoring keeps the windowed policy.
+        self.eager = bool(eager)
+        # one batch in flight at a time in eager mode (inline OR
+        # drainer — batch_fns keep the windowed mode's serial-execution
+        # contract); guarded by self._cv's lock
+        self._executing = False
 
         # overload-ladder level (0 = healthy), written by a supervisor;
         # read once per enqueue — plain attribute, GIL-atomic
@@ -302,6 +324,31 @@ class DynamicBatcher:
         self._thread.start()
         from brpc_tpu import serving as _serving
         _serving._register_batcher(self)
+
+    # ---- the idle cut-through claim (eager mode) ----
+
+    def try_claim_idle(self) -> bool:
+        """Claim the execution slot for ONE request a caller will serve
+        OUTSIDE the batcher (the PS handler bypass): succeeds only in
+        eager mode, with no queue, no batch in flight, no brownout
+        (degraded batchers must route everything through admission so
+        the shed policy applies), and the batcher still running.  While
+        claimed, concurrent arrivals queue and coalesce behind the
+        bypassed request exactly as behind an inline cut-through batch.
+        Pair with :meth:`release_idle`."""
+        if not self.eager or self.brownout >= 1:
+            return False
+        with self._cv:
+            if not self._running or self._q or self._executing:
+                return False
+            self._executing = True
+        self.n_bypassed.add(1)
+        return True
+
+    def release_idle(self) -> None:
+        with self._cv:
+            self._executing = False
+            self._cv.notify_all()
 
     # ---- admission ----
 
@@ -390,6 +437,7 @@ class DynamicBatcher:
         shed_code = 0
         shed_text = ""
         brownout = 0
+        inline = False
         with self._cv:
             if not self._running:
                 shed_code, shed_text = errors.ELOGOFF, "batcher closed"
@@ -411,9 +459,14 @@ class DynamicBatcher:
             elif p.deadline_s is not None:
                 # predicted completion: the full batching window (worst
                 # case for a fresh queue) plus one EMA execution per
-                # batch already ahead of us, plus our own
+                # batch already ahead of us, plus our own.  Eager mode
+                # never waits the window (cut-through / immediate
+                # formation), so charging it would spuriously shed
+                # tight-deadline requests an idle batcher would serve
+                # well inside their budget
                 batches_ahead = len(self._q) // self.max_batch_size
-                predicted_s = (self.max_delay_us / 1e6 +
+                window_s = 0.0 if self.eager else self.max_delay_us / 1e6
+                predicted_s = (window_s +
                                (batches_ahead + 1) *
                                max(self._exec_ema_s, 0.0))
                 if p.deadline_s < p.enqueue_t + predicted_s:
@@ -424,8 +477,17 @@ class DynamicBatcher:
                         f"predicted batch completion in "
                         f"{predicted_s * 1e3:.1f}ms")
             if shed_code == 0:
-                self._q.append(p)
-                self._cv.notify()
+                if self.eager and not self._q and not self._executing:
+                    # cut-through: the system is idle, so this request
+                    # IS the batch — run it on the submitting thread,
+                    # zero cross-thread hops (claims the execution slot
+                    # under the lock; concurrent arrivals queue for the
+                    # drainer and coalesce behind us)
+                    self._executing = True
+                    inline = True
+                else:
+                    self._q.append(p)
+                    self._cv.notify()
         if shed_code != 0:
             if shed_code == errors.ELIMIT:
                 self.shed.add(1)
@@ -436,26 +498,61 @@ class DynamicBatcher:
                     self.limiter.on_responded(errors.ELIMIT, 0)
             self.n_errors.add(1)
             p.complete(shed_code, shed_text, None)
+            return
+        if inline:
+            try:
+                self._run_batch([p])
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception(
+                    "inline batch execution failed")
+                p.complete(errors.EINTERNAL, "batch drainer error", None)
+            finally:
+                with self._cv:
+                    self._executing = False
+                    self._cv.notify_all()
 
     # ---- the batch loop ----
 
     def _loop(self) -> None:
         while True:
             with self._cv:
-                while self._running and not self._q:
-                    self._cv.wait()
+                while True:
+                    if self.eager and self._executing:
+                        # park while an inline (or our own previous)
+                        # batch executes — one batch in flight, arrivals
+                        # accumulate into the NEXT batch.  Checked even
+                        # during shutdown: the close() flush must not
+                        # run batch_fn concurrently with an in-flight
+                        # inline batch (the serial-execution contract);
+                        # the inline finally-block always clears the
+                        # slot and notifies, so this wait is bounded
+                        self._cv.wait()
+                        continue
+                    if self._running and not self._q:
+                        self._cv.wait()
+                        continue
+                    break
                 if not self._q:
                     if not self._running:
                         return
                     continue
-                # batch window: first-enqueued request anchors the delay
-                deadline_t = self._q[0].enqueue_t + self.max_delay_us / 1e6
-                while self._running and len(self._q) < self.max_batch_size:
-                    rem = deadline_t - time.monotonic()
-                    if rem <= 0:
-                        break
-                    self._cv.wait(rem)
+                if not self.eager:
+                    # batch window: first-enqueued request anchors the
+                    # delay.  Eager mode skips the window entirely —
+                    # whatever queued while the last batch executed IS
+                    # the batch (continuous-batching accumulation).
+                    deadline_t = self._q[0].enqueue_t \
+                        + self.max_delay_us / 1e6
+                    while self._running and \
+                            len(self._q) < self.max_batch_size:
+                        rem = deadline_t - time.monotonic()
+                        if rem <= 0:
+                            break
+                        self._cv.wait(rem)
                 batch = self._form_batch_locked()
+                if batch and self.eager:
+                    self._executing = True
             if not batch:
                 continue
             try:
@@ -469,6 +566,11 @@ class DynamicBatcher:
                 for p in batch:
                     p.complete(errors.EINTERNAL, "batch drainer error",
                                None)
+            finally:
+                if self.eager:
+                    with self._cv:
+                        self._executing = False
+                        self._cv.notify_all()
 
     def _form_batch_locked(self) -> list[_Pending]:
         """Pick this batch's members: earliest-deadline-first among the
@@ -723,11 +825,13 @@ class DynamicBatcher:
         return {
             "max_batch_size": self.max_batch_size,
             "max_delay_us": self.max_delay_us,
+            "eager": self.eager,
             "batch_buckets": list(self.batch_buckets),
             "length_buckets": list(self.length_buckets),
             "queued": queued,
             "batches": self.n_batches.get_value(),
             "completed": self.n_completed.get_value(),
+            "bypassed": self.n_bypassed.get_value(),
             "errors": self.n_errors.get_value(),
             "shed": self.shed.get_value(),
             "brownout": self.brownout,

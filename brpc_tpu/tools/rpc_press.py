@@ -190,6 +190,14 @@ def _run_press_body(server, service, method, request, qps, duration_s,
     fetcher = HotspotFetcher(server, duration_s).start() \
         if hotspots > 0 else None
     rec = LatencyRecorder("rpc_press")
+    # python-side latency reservoir: the native recorder pool is 512
+    # slots process-wide, and deep in a churn-heavy suite a freshly
+    # created recorder can transiently miss a slot (GC lag holds
+    # freed-but-uncollected recorders' slots) — its percentiles then
+    # read 0 despite real traffic.  The press must report honest
+    # latency regardless, so it keeps a bounded sample of its own.
+    lats: list = []          # GIL-atomic appends; bounded below
+    _LATS_CAP = 200_000
     nerr = [0]
     nok = [0]
     press_tids: list = []   # this run's trace ids (GIL-atomic appends)
@@ -218,7 +226,10 @@ def _run_press_body(server, service, method, request, qps, duration_s,
             try:
                 ch.call_sync(service, method, req,
                              serializer=serializer)
-                rec.add(int((time.monotonic() - t0) * 1e6))
+                dt_us = int((time.monotonic() - t0) * 1e6)
+                rec.add(dt_us)
+                if len(lats) < _LATS_CAP:
+                    lats.append(dt_us)
                 nok[0] += 1
             except Exception as e:
                 nerr[0] += 1
@@ -238,16 +249,32 @@ def _run_press_body(server, service, method, request, qps, duration_s,
         stop.set()
     [t.join(2) for t in ts]
     elapsed = time.monotonic() - t_start
+    srt = sorted(lats)
+
+    def pctl(p: float) -> float:
+        v = rec.latency_percentile(p)
+        if v <= 0 and srt:
+            # native recorder never got a slot: serve the percentile
+            # from the press's own reservoir
+            v = float(srt[min(len(srt) - 1, int(p * len(srt)))])
+        return v
+
+    avg = rec.latency()
+    if avg <= 0 and srt:
+        avg = sum(srt) / len(srt)
+    mx = rec.max_latency()
+    if mx <= 0 and srt:
+        mx = srt[-1]
     summary = {
         "sent_ok": nok[0],
         "errors": nerr[0],
         "qps": round(nok[0] / elapsed, 1),
-        "avg_us": round(rec.latency(), 1),
-        "p50_us": rec.latency_percentile(0.5),
-        "p90_us": rec.latency_percentile(0.9),
-        "p99_us": rec.latency_percentile(0.99),
-        "p999_us": rec.latency_percentile(0.999),
-        "max_us": rec.max_latency(),
+        "avg_us": round(avg, 1),
+        "p50_us": pctl(0.5),
+        "p90_us": pctl(0.9),
+        "p99_us": pctl(0.99),
+        "p999_us": pctl(0.999),
+        "max_us": mx,
         "elapsed_s": round(elapsed, 2),
     }
     print(json.dumps(summary), file=out)
@@ -619,19 +646,41 @@ def run_embedding_press(n_shards: int, *, vocab: int = 1024,
                         update_ratio: float = 0.1,
                         key_counts=(4, 16, 64),
                         duration_s: float = 10.0, threads: int = 4,
+                        serializer: str = "json",
                         out=sys.stderr) -> dict:
     """``--embedding N`` mode (ISSUE 12): zipf-skewed key load over an
     in-process N-shard parameter-server service through PSClient's
     PartitionChannel fan-out.  Reports lookups/s, updates/s, the
     update/lookup mix actually served, and latency p50/p99 BY KEY-COUNT
     BUCKET (small lookups shouldn't pay big lookups' padding), plus the
-    shards' version/dup counters so exactly-once holds under load."""
+    shards' version/dup counters so exactly-once holds under load.
+
+    ``--serializer json|tensorframe`` (ISSUE 13) picks the wire format
+    and the report adds WIRE BYTES/REQUEST — request-direction bytes
+    exact from the psserve_wire_bytes_* server counters, response bytes
+    measured by re-encoding one received response per key-count bucket
+    (byte-identical to what the server sent: both wires' encodes are
+    deterministic) — so the binary-vs-JSON A/B is reproducible outside
+    the bench."""
     import numpy as np
 
     from brpc_tpu.psserve import PSClient
+    from brpc_tpu.psserve import service as ps_service
+    from brpc_tpu.rpc.serialization import get_serializer
 
+    if serializer not in ("json", "tensorframe"):
+        raise ValueError("--serializer must be json|tensorframe")
     servers, svcs, shards, pc = spin_up_psserve(
         n_shards, vocab=vocab, dim=dim, name_prefix="press_ps")
+    if serializer == "json":
+        req0 = ps_service.REQUESTS_JSON.get_value()
+        wb0 = ps_service.WIRE_BYTES_JSON.get_value()
+    else:
+        req0 = ps_service.REQUESTS_TENSORFRAME.get_value()
+        wb0 = ps_service.WIRE_BYTES_TENSORFRAME.get_value()
+    # one decoded response per (kind, key-count), re-encoded after the
+    # run to measure exact response wire bytes
+    resp_samples: dict = {}
     counts = {"lookups": 0, "updates": 0}
     lat_by_bucket: dict[int, list] = {k: [] for k in key_counts}
     mu = threading.Lock()
@@ -643,6 +692,7 @@ def run_embedding_press(n_shards: int, *, vocab: int = 1024,
         rng = np.random.default_rng(1000 + widx)
         sample = zipf_key_sampler(vocab, zipf_s, seed=widx)
         cli = PSClient(pc, vocab=vocab, dim=dim,
+                       serializer=serializer, ici="off",
                        name=f"press_cli_{widx}")
         ones = {k: np.ones((k, dim), np.float32) for k in key_counts}
         while time.monotonic() < stop_t:
@@ -668,6 +718,10 @@ def run_embedding_press(n_shards: int, *, vocab: int = 1024,
             with mu:
                 counts[kind] += 1
                 lat_by_bucket[n].append(dt_us)
+                if kind == "lookups" and n not in resp_samples:
+                    # keep one keyset per bucket for the exact
+                    # response-bytes re-encode after the run
+                    resp_samples[n] = keys
 
     ts = [threading.Thread(target=worker, args=(i,))
           for i in range(threads)]
@@ -686,11 +740,56 @@ def run_embedding_press(n_shards: int, *, vocab: int = 1024,
                 "p50_us": round(float(np.percentile(a, 50)), 1),
                 "p99_us": round(float(np.percentile(a, 99)), 1),
             }
+        # wire bytes/request (ISSUE 13): request direction exact from
+        # the per-serializer server Adders; response direction measured
+        # by re-encoding one REAL per-partition response per key-count
+        # (both wires' encodes are deterministic, so these are the
+        # bytes the server actually sent for that shape)
+        if serializer == "json":
+            req_d = ps_service.REQUESTS_JSON.get_value() - req0
+            wb_d = ps_service.WIRE_BYTES_JSON.get_value() - wb0
+        else:
+            req_d = ps_service.REQUESTS_TENSORFRAME.get_value() - req0
+            wb_d = ps_service.WIRE_BYTES_TENSORFRAME.get_value() - wb0
+        ser_obj = get_serializer(serializer)
+        from brpc_tpu.psserve.shard import owners_for, shard_bounds
+        bounds = shard_bounds(vocab, n_shards)
+        resp_bytes = {}
+        for k, keys in sorted(resp_samples.items()):
+            owner = owners_for(keys, bounds)
+            total_b = 0
+            for part in np.unique(owner):
+                pos = np.flatnonzero(owner == part)
+                sub = keys[pos]
+                # rows straight off the table snapshot — NOT
+                # shard.lookup, which would pollute the hot-key
+                # histogram and lookup counters the summary reports
+                # with synthetic probe traffic
+                sh = shards[int(part)]
+                rows = sh.snapshot_rows()[sub - sh.lo]
+                # the shard's REAL version: JSON response size varies
+                # with its digit count, and the probe's claim is
+                # byte-identical re-encoding
+                if serializer == "json":
+                    obj = {"rows": rows.tolist(),
+                           "version": int(sh.version)}
+                else:
+                    obj = {"rows": np.ascontiguousarray(rows),
+                           "version": int(sh.version)}
+                total_b += len(ser_obj.encode(obj)[0])
+            resp_bytes[str(k)] = int(total_b)
         total = counts["lookups"] + counts["updates"]
         summary = {
             "mode": "embedding",
             "shards": n_shards, "vocab": vocab, "dim": dim,
             "zipf_s": zipf_s,
+            "serializer": serializer,
+            "wire": {
+                "req_bytes_per_call": round(wb_d / req_d, 1)
+                if req_d else 0.0,
+                "requests": int(req_d),
+                "lookup_resp_bytes_by_key_count": resp_bytes,
+            },
             "lookups_per_s": round(counts["lookups"] / elapsed, 1),
             "updates_per_s": round(counts["updates"] / elapsed, 1),
             "update_mix": round(counts["updates"] / total, 3)
@@ -864,7 +963,10 @@ def main(argv=None):
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--threads", type=int, default=4)
     ap.add_argument("--timeout-ms", type=int, default=1000)
-    ap.add_argument("--serializer", default="json")
+    ap.add_argument("--serializer", default="json",
+                    help="request serializer; with --embedding: "
+                         "json|tensorframe picks the PS wire format "
+                         "and the report adds wire bytes/request")
     ap.add_argument("--connection-type", default="single",
                     choices=["single", "pooled", "short"])
     ap.add_argument("--streaming", action="store_true",
@@ -892,6 +994,7 @@ def main(argv=None):
     a = ap.parse_args(argv)
     if a.embedding:
         run_embedding_press(a.embedding, vocab=a.vocab, dim=a.dim,
+                            serializer=a.serializer,
                             zipf_s=a.zipf, update_ratio=a.update_ratio,
                             duration_s=a.duration, threads=a.threads,
                             out=sys.stdout)

@@ -425,17 +425,25 @@ def test_psserve_page_shows_shards_batchers_and_hot_keys():
         # hot-key histogram counted the duplicate
         assert dict(map(tuple, e["hot_keys"])).get(1) == 2
         assert set(e["batchers"]) == {"ps_lookup_console_ps_0",
-                                      "ps_update_console_ps_0"}
+                                      "ps_update_console_ps_0",
+                                      "ps_updatet_console_ps_0"}
         for b in e["batchers"].values():
             assert "avg_batch_size" in b and "queued" in b
         mine = [c for c in snap["clients"] if c["name"] == "console_cli"]
         assert mine and mine[0]["lookups"] == 1 \
             and mine[0]["updates"] == 1
+        # per-serializer wire section (ISSUE 13): the default client
+        # spoke tensorframe, so binary requests + bytes advanced
+        wire = snap["wire"]
+        assert wire["requests_tensorframe"] >= 2
+        assert wire["wire_bytes_tensorframe"] > 0
         # psserve_* counters on the Prometheus scrape
         status, metrics = _get(s, "/brpc_metrics")
         assert status == 200
         assert b"psserve_lookups" in metrics
         assert b"psserve_updates" in metrics
+        assert b"psserve_wire_bytes_tensorframe" in metrics
+        assert b"psserve_wire_bytes_json" in metrics
     finally:
         unregister_psserve(svc)
         s.stop()

@@ -267,6 +267,58 @@ def test_fuzz_tensor_serializer_decode():
             pass
 
 
+def test_fuzz_tensorframe_frames():
+    """The tensorframe decoder (ISSUE 13) takes PEER-CONTROLLED bodies
+    on the PS binary wire: random bytes, truncations, bit-flips of
+    valid frames, absurd shape products, unknown kinds/dtype codes and
+    lying arena sizes must raise ValueError only — bounded allocation
+    (a frame claiming 2**80 elements never allocates), no crash, no
+    hang.  Same bounded-decode discipline as rpc/compact.py."""
+    import numpy as np
+
+    from brpc_tpu.rpc.tensorframe import decode_frame, encode_frame
+
+    rng = random.Random(SEED + 44)
+    valid = [
+        encode_frame({"keys": np.arange(16, dtype=np.int64),
+                      "grads": np.ones((16, 8), np.float32),
+                      "update_id": 12345}),
+        encode_frame({"rows": np.zeros((3, 4), np.float32),
+                      "version": 7, "ok": True, "tag": "x",
+                      "blob": b"\x00\x01"}),
+    ]
+    for v in valid:              # sanity: valid frames still decode
+        decode_frame(v)
+    for data in _corpora(valid, rng):
+        try:
+            out = decode_frame(data)
+            # anything that decodes must be real values bounded by the
+            # frame (tensors are VIEWS over it)
+            total = sum(v.nbytes for v in out.values()
+                        if hasattr(v, "nbytes"))
+            assert total <= len(data)
+        except ValueError:
+            pass
+    # hand-crafted hostile frames: absurd shape product (2^40 x 2^40
+    # f64 "fits" u64 byte math), huge inline length, unknown dtype
+    # code / kind, arena shorter and longer than declared
+    big = (1 << 40).to_bytes(8, "little")
+    hostile = [
+        b"TFr1\x01\x01k" + bytes([6, 3, 2]) + big * 2,
+        b"TFr1\x01\x01s" + bytes([4]) + (1 << 31).to_bytes(4, "little"),
+        b"TFr1\x01\x01t" + bytes([6, 99, 1]) + (8).to_bytes(8, "little"),
+        b"TFr1\x01\x01x" + bytes([7]),
+        b"TFr1\x01\x01a" + bytes([6, 2, 1])
+        + (2).to_bytes(8, "little") + b"\x00" * 4,      # arena short
+        b"TFr1\x01\x01a" + bytes([6, 2, 1])
+        + (1).to_bytes(8, "little") + b"\x00" * 64,     # arena long
+        b"TFr1\xff",                                    # field count lie
+    ]
+    for data in hostile:
+        with pytest.raises(ValueError):
+            decode_frame(data)
+
+
 def test_pickle_serializer_refuses_gadget_payloads():
     """pickle.loads on peer bytes is RCE by design (__reduce__ ->
     os.system); the serializer must refuse payloads referencing

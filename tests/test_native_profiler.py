@@ -32,63 +32,30 @@ def _burn_native(frames=120_000):
                          ctypes.byref(a), ctypes.byref(b))
 
 
-# Wedge deadline around the profiler's native entries (the ADVICE-r5
-# bench discipline applied to the TEST): deep in a full tier-1 run's
-# accumulated executor state, the echo burn — and intermittently the
-# SIGPROF start/stop entries themselves — can wedge inside the ctypes
-# call indefinitely (observed on the UNMODIFIED tree; bench.cc's
-# run_pump bounds its own wait at 120s and the wedge outlives even
-# that).  An unbounded call then turns one wedged entry into a hung
-# suite.  Every wedge-able native call in this module runs on a daemon
-# thread with a deadline ~20-60x its normal runtime; a wedge SKIPS
-# (never fails) and short-circuits the module's remaining native-
-# profiler work so the suite stays bounded.
-_WEDGED = {"hit": False}
-_DEADLINE_S = 60.0
+# Wedge deadline around the profiler's native entries — the shared
+# guard (tests/wedge_guard.py, ISSUE 13 satellite): every wedge-able
+# native call runs on a daemon thread with a deadline; a wedge SKIPS
+# (never fails, never hangs) and short-circuits the module's remaining
+# native-profiler work so the suite stays bounded.
+from wedge_guard import WedgeGuard
+
+_GUARD = WedgeGuard("native profiler call")
 
 
 def _skip_if_wedged():
-    if _WEDGED["hit"]:
-        pytest.skip("native profiler machinery wedged earlier in this "
-                    "module (pre-existing native flake); keeping the "
-                    "suite bounded")
+    _GUARD.skip_if_wedged()
 
 
 def _deadline(fn, *args, what="native profiler call"):
-    """Run one native entry on a daemon thread with the wedge
-    deadline; returns its value, or SKIPS the test (marking the
-    module wedged) if it never comes back."""
-    _skip_if_wedged()
-    out: dict = {}
-
-    def run():
-        out["rc"] = fn(*args)
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    t.join(_DEADLINE_S)
-    if "rc" not in out:
-        _WEDGED["hit"] = True
-        pytest.skip(f"{what} wedged past {_DEADLINE_S:.0f}s "
-                    f"(pre-existing native flake)")
-    return out["rc"]
+    return _GUARD.deadline(fn, *args, what=what)
 
 
 def _start_burn(frames=120_000):
-    _skip_if_wedged()
-    t = threading.Thread(target=_burn_native, args=(frames,),
-                         daemon=True)
-    t.start()
-    return t
+    return _GUARD.start_thread(_burn_native, frames)
 
 
 def _join_burn(t):
-    t.join(_DEADLINE_S)
-    if t.is_alive():
-        _WEDGED["hit"] = True
-        pytest.skip(f"native echo bench wedged past "
-                    f"{_DEADLINE_S:.0f}s (pre-existing native "
-                    f"flake; run_pump's own 120s bound did not fire)")
+    _GUARD.join_thread(t, what="native echo bench")
 
 
 class TestNativeProfiler:

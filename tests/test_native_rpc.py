@@ -14,43 +14,24 @@ import pytest
 import brpc_tpu as brpc
 from brpc_tpu._core import IOBuf, NATIVE_METHOD_FN, core
 
-# Wedge deadline around this module's direct native entries — the same
-# daemon-thread guard test_native_profiler got in PR 11 (the
-# intermittent full-tier-1 wedge drifts BETWEEN these two modules:
-# deep in an accumulated executor state a ctypes call — the echo bench
-# pump especially — can wedge indefinitely, reproduced on the
-# unmodified tree).  A wedged entry SKIPS (never fails, never hangs)
-# and short-circuits the module's remaining direct-native work so the
+# Wedge deadline around this module's direct native entries — the
+# shared guard (tests/wedge_guard.py, ISSUE 13 satellite; the
+# intermittent full-tier-1 wedge drifts BETWEEN this module and
+# test_native_profiler, so both ride one helper with per-module wedged
+# state).  A wedged entry SKIPS (never fails, never hangs) and
+# short-circuits the module's remaining direct-native work so the
 # suite stays bounded; the RPC-level tests keep their own timeouts.
-_WEDGED = {"hit": False}
-_DEADLINE_S = 60.0
+from wedge_guard import WedgeGuard
+
+_GUARD = WedgeGuard("native rpc call")
 
 
 def _skip_if_wedged():
-    if _WEDGED["hit"]:
-        pytest.skip("native rpc machinery wedged earlier in this "
-                    "module (pre-existing native flake); keeping the "
-                    "suite bounded")
+    _GUARD.skip_if_wedged()
 
 
 def _deadline(fn, *args, what="native rpc call"):
-    """Run one native entry on a daemon thread with the wedge
-    deadline; returns its value, or SKIPS the test (marking the module
-    wedged) if it never comes back."""
-    _skip_if_wedged()
-    out: dict = {}
-
-    def run():
-        out["rc"] = fn(*args)
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    t.join(_DEADLINE_S)
-    if "rc" not in out:
-        _WEDGED["hit"] = True
-        pytest.skip(f"{what} wedged past {_DEADLINE_S:.0f}s "
-                    f"(pre-existing native flake)")
-    return out["rc"]
+    return _GUARD.deadline(fn, *args, what=what)
 
 
 @pytest.fixture()
@@ -169,11 +150,7 @@ def test_method_map_register_unregister_churn(echo_server):
         _deadline(churn, what="method-map churn")
     finally:
         stop.set()
-        t.join(_DEADLINE_S)
-    if t.is_alive():
-        _WEDGED["hit"] = True
-        pytest.skip("caller thread wedged in native call "
-                    "(pre-existing native flake)")
+    _GUARD.join_thread(t, what="caller thread in native call")
     assert not errors_seen
     for i in range(7):
         core.brpc_unregister_method(b"Churn%d" % i, b"M")

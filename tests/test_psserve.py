@@ -150,7 +150,7 @@ def test_shard_rejects_unowned_keys():
 # ---- the RPC fan-out path (PartitionChannel + batchers) ----
 
 def _spin_up(p, *, batch=True, max_delay_us=500, replicas=1, lb=None,
-             table=None):
+             table=None, eager=True):
     servers, svcs, shards = [], [], []
     pc = PartitionChannel(p, lb=lb)
     for i in range(p):
@@ -161,6 +161,7 @@ def _spin_up(p, *, batch=True, max_delay_us=500, replicas=1, lb=None,
             s = brpc.Server()
             svcs.append(register_psserve(s, sh, batch=batch,
                                          max_delay_us=max_delay_us,
+                                         eager=eager,
                                          name=f"t{i}_{_r}_{id(pc)}"))
             s.start("127.0.0.1", 0)
             servers.append(s)
@@ -206,10 +207,13 @@ def test_psclient_bit_identical_through_rpc(p):
         _tear_down(servers, svcs, cli)
 
 
-def test_update_batcher_coalesces_and_applies_exactly_once():
+@pytest.mark.parametrize("serializer", ["json", "tensorframe"])
+def test_update_batcher_coalesces_and_applies_exactly_once(serializer):
     """Concurrent Update RPCs coalesce into shared scatter batches —
     the first non-generate workload the DynamicBatcher has coalesced —
-    and every update applies exactly once."""
+    and every update applies exactly once, on BOTH wire formats (the
+    float64-packed JSON batcher and the byte-record tensorframe one,
+    ISSUE 13)."""
     import jax.numpy as jnp
     # INTEGER-valued base table: 32 sequential float32 adds onto a
     # non-integer base round differently than one base + 32g — with an
@@ -217,15 +221,17 @@ def test_update_batcher_coalesces_and_applies_exactly_once():
     # stay bit-identical
     base = np.round(init_embedding_table(V, D, seed=3) * 100)
     dense = jnp.asarray(base)
+    # eager=False: these assertions pin the WINDOWED coalescing policy
+    # (eager's cut-through makes batch counts timing-dependent)
     servers, svcs, shards, pc, cli = _spin_up(1, max_delay_us=20_000,
-                                              table=base)
+                                              table=base, eager=False)
     try:
         n_updates, n_threads = 4, 8
         grads = _int_grads(2, seed=9)
         keys = np.array([3, 9], np.int64)
 
         def worker():
-            c = PSClient(pc, vocab=V, dim=D)
+            c = PSClient(pc, vocab=V, dim=D, serializer=serializer)
             for _ in range(n_updates):
                 c.update(keys, grads)
 
@@ -237,7 +243,9 @@ def test_update_batcher_coalesces_and_applies_exactly_once():
         want = np.asarray(dense.at[keys].add(
             jnp.asarray(grads) * float(total)))
         np.testing.assert_array_equal(shards[0].snapshot_rows(), want)
-        ub = svcs[0]._update_b
+        # the batcher matching the wire format did the serving
+        ub = svcs[0]._update_b if serializer == "json" \
+            else svcs[0]._update_tb
         assert ub.n_completed.get_value() == total
         # coalescing actually happened: fewer batches than updates
         assert ub.n_batches.get_value() < total
@@ -247,7 +255,8 @@ def test_update_batcher_coalesces_and_applies_exactly_once():
 
 def test_lookup_batcher_coalesces_mixed_key_counts():
     dense = _oracle()
-    servers, svcs, shards, pc, cli = _spin_up(2, max_delay_us=20_000)
+    servers, svcs, shards, pc, cli = _spin_up(2, max_delay_us=20_000,
+                                              eager=False)
     try:
         results = {}
 
@@ -296,6 +305,51 @@ def test_partition_retry_rotates_replica_under_lb():
         pc.feedback(0, ep, 0, 100)
     finally:
         _tear_down(servers, svcs, cli)
+
+
+def test_retry_budget_exceeds_replica_count_via_rotation_reset():
+    """ISSUE-13 regression (async round-based call_partitioned): a
+    partition whose replicas ALL failed transiently must keep retrying
+    up to max_retry+1 total attempts — the per-round exclusion set
+    resets once every replica was tried, matching the old per-attempt
+    driver's fresh-exclusion semantics (without the reset, the budget
+    silently capped at the replica count)."""
+    calls = {"n": 0}
+
+    class Flaky(brpc.Service):
+        NAME = "FlakyPS"
+
+        @brpc.method(request="json", response="json")
+        def Get(self, cntl, req):
+            calls["n"] += 1
+            if calls["n"] <= 3:
+                cntl.set_failed(errors.EINTERNAL, "transient")
+                return None
+            return {"ok": True}
+
+    svc = Flaky()       # ONE instance behind both replicas
+    servers = []
+    pc = PartitionChannel(1, lb="rr")
+    for _ in range(2):
+        s = brpc.Server()
+        s.add_service(svc)
+        s.start("127.0.0.1", 0)
+        servers.append(s)
+        pc.add_partition(0, brpc.Channel(f"127.0.0.1:{s.port}",
+                                         timeout_ms=2000, max_retry=0),
+                         endpoint=f"127.0.0.1:{s.port}")
+    try:
+        # 2 replicas, first 3 attempts fail: only a 4th attempt (a
+        # SECOND rotation over the replicas) can succeed
+        out = pc.call_partitioned("FlakyPS", "Get", {0: {}},
+                                  timeout_ms=2000, max_retry=3)
+        assert out[0]["ok"] is True
+        assert calls["n"] == 4
+    finally:
+        for s in servers:
+            s.stop()
+            s.join()
+        pc.close()
 
 
 def test_injected_post_apply_fault_retries_without_double_add():

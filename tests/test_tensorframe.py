@@ -1,0 +1,593 @@
+"""tensorframe — the binary tensor wire for the PS surface (ISSUE 13).
+
+Three claims under test:
+
+1. **Layout is pinned.**  A golden hex fixture locks the frame bytes
+   (magic, field table, tensor arena) so the wire format cannot drift
+   silently; the bounded-decode contract turns every malformed frame
+   into ``ValueError`` (``EREQUEST`` through a live server), with
+   allocation bounded BEFORE any array exists.
+
+2. **Bit identity.**  PSClient Lookup/Update over tensorframe ==
+   the JSON path == the dense single-host oracle at partition counts
+   {1, 2, 4, 8}, boundary-straddling + duplicate keys included; a
+   partition served by an OLD peer (no binary methods) negotiates
+   down to JSON per channel and the answers stay identical.
+
+3. **The ICI fast path.**  A co-located ``ShardedEmbeddingTable``
+   registered with ``serve_local=True`` short-circuits the same
+   PSClient API to one compiled collective program — results match
+   the RPC path, and a replayed ``update_token`` acks exactly once
+   against the table's applied set (the RPC shards' idempotence
+   discipline).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors
+from brpc_tpu.psserve import (EmbeddingShardServer, PSClient, PSService,
+                              ShardedEmbeddingTable, init_embedding_table,
+                              register_psserve, unregister_psserve)
+from brpc_tpu.psserve import service as ps_service
+from brpc_tpu.psserve import unregister_local_table
+from brpc_tpu.rpc.combo_channels import PartitionChannel
+from brpc_tpu.rpc.tensorframe import (FRAME_HOST_COPIES, decode_frame,
+                                      encode_frame, is_frame)
+
+V, D = 64, 8
+PARTS = (1, 2, 4, 8)
+# duplicates, shard-boundary straddles (31|32 at p=2), first/last rows
+KEYS = np.array([0, 5, 5, 31, 32, 63, 7, 5, 16, 48], np.int64)
+
+# the golden wire fixture: layout drift fails THIS, not production
+GOLDEN_FIELDS_HEX = (
+    "5446723105097570646174655f6964014d000000000000000364757003000374"
+    "616704020000007073046b65797306010102000000000000000567726164730602"
+    "0202000000000000000200000000000000010000000000000001020000000000"
+    "000000c03f000000c00000803e00008040")
+
+
+def _oracle():
+    import jax.numpy as jnp
+    return jnp.asarray(init_embedding_table(V, D, seed=3))
+
+
+def _int_grads(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(-3, 4, (n, D)).astype(np.float32)
+
+
+# ---- the frame itself ----
+
+def test_golden_wire_format():
+    f = encode_frame({"update_id": 77, "dup": False, "tag": "ps",
+                      "keys": np.array([1, 513], np.int64),
+                      "grads": np.array([[1.5, -2.0], [0.25, 4.0]],
+                                        np.float32)})
+    assert f.hex() == GOLDEN_FIELDS_HEX
+    assert is_frame(f) and not is_frame(b'{"keys": [1]}')
+    d = decode_frame(f)
+    assert d["update_id"] == 77 and d["dup"] is False and d["tag"] == "ps"
+    np.testing.assert_array_equal(d["keys"], [1, 513])
+    np.testing.assert_array_equal(
+        d["grads"], np.array([[1.5, -2.0], [0.25, 4.0]], np.float32))
+    assert d["keys"].dtype == np.int64 and d["grads"].dtype == np.float32
+
+
+def test_roundtrip_types_and_views():
+    fields = {"i": -5, "f": 2.75, "b": True, "s": "héllo", "by": b"\x00x",
+              "t0": np.full((), 3.5, np.float64),
+              "big": np.arange(1000, dtype=np.int32).reshape(10, 100)}
+    out = decode_frame(memoryview(encode_frame(fields)))
+    assert out["i"] == -5 and out["f"] == 2.75 and out["b"] is True
+    assert out["s"] == "héllo" and out["by"] == b"\x00x"
+    assert out["t0"].shape == () and float(out["t0"]) == 3.5
+    np.testing.assert_array_equal(out["big"], fields["big"])
+    # decoded tensors are VIEWS over the frame buffer, not copies
+    assert out["big"].base is not None
+    # and encoding contiguous native-endian arrays never host-copies
+    before = FRAME_HOST_COPIES.get_value()
+    encode_frame({"k": np.arange(64, dtype=np.int64)})
+    assert FRAME_HOST_COPIES.get_value() == before
+
+
+def test_bounded_decode_rejects_malformed():
+    f = encode_frame({"keys": np.arange(8, dtype=np.int64), "v": 1})
+    for cut in range(1, len(f)):
+        with pytest.raises(ValueError):
+            decode_frame(f[:cut])
+    with pytest.raises(ValueError):        # trailing garbage
+        decode_frame(f + b"\x00")
+    # absurd shape product: must raise BEFORE allocating
+    big = (1 << 40).to_bytes(8, "little")
+    with pytest.raises(ValueError):
+        decode_frame(b"TFr1\x01\x01k" + bytes([6, 1, 2]) + big * 2)
+    # duplicate field names are malformed, not last-wins
+    dup = (b"TFr1\x02" + b"\x01a" + bytes([1]) + (1).to_bytes(8, "little")
+           + b"\x01a" + bytes([1]) + (2).to_bytes(8, "little"))
+    with pytest.raises(ValueError):
+        decode_frame(dup)
+
+
+def test_malformed_frame_is_erequest_through_live_server():
+    """A hostile/corrupt frame at a real PS endpoint surfaces EREQUEST
+    (bad input), never EINTERNAL (server bug) — the server's decode
+    phase maps the ValueError family."""
+    sh = EmbeddingShardServer(0, 1, V, D, seed=3, name="tf_ereq")
+    s = brpc.Server()
+    svc = register_psserve(s, sh, name="tf_ereq_0")
+    s.start("127.0.0.1", 0)
+    ch = brpc.Channel(f"127.0.0.1:{s.port}", timeout_ms=5000, max_retry=0)
+    try:
+        # valid frame works
+        r = ch.call_sync("PS", "LookupT",
+                         {"keys": np.array([1, 2], np.int64)},
+                         serializer="tensorframe")
+        assert r["rows"].shape == (2, D)
+        # malformed bytes at the same method: EREQUEST
+        for evil in (b"TFr1\x01\x01k" + bytes([6, 1, 2])
+                     + ((1 << 40).to_bytes(8, "little")) * 2,
+                     b"TFr1garbage", b"\x00" * 32):
+            with pytest.raises(errors.RpcError) as ei:
+                ch.call_sync("PS", "LookupT", evil, serializer="raw")
+            assert ei.value.code == errors.EREQUEST, ei.value
+    finally:
+        unregister_psserve(svc)
+        s.stop()
+        s.join()
+
+
+def test_update_record_binary_path_equals_float64_path():
+    """The byte-record apply (no float64 packing) and the float64 row
+    apply produce identical acks and identical tables."""
+    base = np.round(init_embedding_table(V, D, seed=3) * 100)
+    sh_a = EmbeddingShardServer(0, 1, V, D, seed=3, table=base, name="a")
+    sh_b = EmbeddingShardServer(0, 1, V, D, seed=3, table=base, name="b")
+    rng = np.random.default_rng(5)
+    rows_f64, rows_u8 = [], []
+    for uid in (7, 8, 7, 9):        # 7 twice: intra-batch dup dedups
+        keys = rng.integers(0, V, 3)
+        grads = _int_grads(3, seed=uid)
+        rng2 = np.random.default_rng(uid)
+        keys = rng2.integers(0, V, 3).astype(np.int64)
+        rows_f64.append(EmbeddingShardServer.pack_update(uid, keys, grads))
+        rows_u8.append(EmbeddingShardServer.pack_update_record(
+            uid, keys, grads))
+    Lb = sh_a.update_length_buckets()[0]
+    padded = np.zeros((4, Lb), np.float64)
+    for i, r in enumerate(rows_f64):
+        padded[i, : r.shape[0]] = r
+    acks_a = sh_a.update_batch_fn(padded)
+    Lb8 = sh_b.update_record_buckets()[0]
+    padded8 = np.zeros((4, Lb8), np.uint8)
+    for i, r in enumerate(rows_u8):
+        padded8[i, : r.shape[0]] = r
+    acks_b = sh_b.update_batch_fn_binary(padded8)
+    np.testing.assert_array_equal(acks_a, acks_b)
+    np.testing.assert_array_equal(sh_a.snapshot_rows(),
+                                  sh_b.snapshot_rows())
+    assert sh_a.version == sh_b.version == 3    # dup row applied once
+
+
+# ---- the PS surface over the wire ----
+
+def _spin_up(p, *, svc_cls=None, max_delay_us=500, serializer="tensorframe"):
+    servers, svcs, shards = [], [], []
+    pc = PartitionChannel(p)
+    for i in range(p):
+        sh = EmbeddingShardServer(i, p, V, D, seed=3, name=f"tf{id(pc)}")
+        shards.append(sh)
+        s = brpc.Server()
+        if svc_cls is None:
+            svc = register_psserve(s, sh, max_delay_us=max_delay_us,
+                                   name=f"tf{i}_{id(pc)}")
+        else:
+            # the "old peer" simulation: a service class without the
+            # binary methods, registered directly (unbatched — identity
+            # is what's under test, not coalescing)
+            svc = svc_cls(sh)
+            s.add_service(svc)
+        svcs.append(svc)
+        s.start("127.0.0.1", 0)
+        servers.append(s)
+        pc.add_partition(i, brpc.Channel(f"127.0.0.1:{s.port}",
+                                         timeout_ms=5000, max_retry=0))
+    cli = PSClient(pc, vocab=V, dim=D, serializer=serializer)
+    return servers, svcs, shards, pc, cli
+
+
+def _tear_down(servers, svcs, cli):
+    for svc in svcs:
+        unregister_psserve(svc)
+    for s in servers:
+        s.stop()
+        s.join()
+    cli.close()
+
+
+@pytest.mark.parametrize("p", PARTS)
+def test_tensorframe_bit_identical_to_json_and_oracle(p):
+    import jax.numpy as jnp
+    dense = _oracle()
+    grads = _int_grads(KEYS.size)
+    sj = _spin_up(p, serializer="json")
+    st = _spin_up(p, serializer="tensorframe")
+    try:
+        rows_j = sj[4].lookup(KEYS)
+        rows_t = st[4].lookup(KEYS)
+        np.testing.assert_array_equal(rows_t, np.asarray(dense[KEYS]))
+        np.testing.assert_array_equal(rows_t, rows_j)
+        sj[4].update(KEYS, grads)
+        st[4].update(KEYS, grads)
+        want = np.asarray(dense.at[KEYS].add(jnp.asarray(grads)))
+        got_j = np.concatenate([sh.snapshot_rows() for sh in sj[2]])
+        got_t = np.concatenate([sh.snapshot_rows() for sh in st[2]])
+        np.testing.assert_array_equal(got_t, want)
+        np.testing.assert_array_equal(got_t, got_j)
+        # read-your-writes + zero negotiation fallbacks on a new fleet
+        rows2 = st[4].lookup(KEYS)
+        np.testing.assert_array_equal(rows2, want[KEYS])
+        assert st[4].n_stale_reads == 0
+        assert st[4].n_negotiation_fallbacks == 0
+        assert st[4].stats()["serializer"] == "tensorframe"
+    finally:
+        _tear_down(sj[0], sj[1], sj[4])
+        _tear_down(st[0], st[1], st[4])
+
+
+class OldPSService(PSService):
+    """A PR-12-era peer: no binary methods on the wire."""
+
+    LookupT = None
+    UpdateT = None
+
+
+def test_negotiation_falls_back_to_json_on_old_peer():
+    import jax.numpy as jnp
+    dense = _oracle()
+    grads = _int_grads(KEYS.size)
+    servers, svcs, shards, pc, cli = _spin_up(2, svc_cls=OldPSService)
+    try:
+        rows = cli.lookup(KEYS)     # first call probes, falls back
+        np.testing.assert_array_equal(rows, np.asarray(dense[KEYS]))
+        assert cli.n_negotiation_fallbacks == 2     # both partitions
+        assert set(cli.stats()["wire_modes"].values()) == {"json"}
+        # sticky: the next calls go straight to JSON and stay identical
+        before = cli.n_negotiation_fallbacks
+        cli.update(KEYS, grads)
+        want = np.asarray(dense.at[KEYS].add(jnp.asarray(grads)))
+        got = np.concatenate([sh.snapshot_rows() for sh in shards])
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(cli.lookup(KEYS), want[KEYS])
+        assert cli.n_negotiation_fallbacks == before
+        assert shards[0].version == 1 and shards[1].version == 1
+    finally:
+        _tear_down(servers, svcs, cli)
+
+
+def test_wire_counters_advance_per_serializer():
+    servers, svcs, shards, pc, cli = _spin_up(1)
+    cli_j = PSClient(pc, vocab=V, dim=D, serializer="json")
+    try:
+        t0 = ps_service.REQUESTS_TENSORFRAME.get_value()
+        tb0 = ps_service.WIRE_BYTES_TENSORFRAME.get_value()
+        j0 = ps_service.REQUESTS_JSON.get_value()
+        jb0 = ps_service.WIRE_BYTES_JSON.get_value()
+        cli.lookup(KEYS)
+        cli_j.lookup(KEYS)
+        assert ps_service.REQUESTS_TENSORFRAME.get_value() == t0 + 1
+        assert ps_service.REQUESTS_JSON.get_value() == j0 + 1
+        assert ps_service.WIRE_BYTES_TENSORFRAME.get_value() > tb0
+        assert ps_service.WIRE_BYTES_JSON.get_value() > jb0
+        from brpc_tpu.psserve import psserve_snapshot
+        wire = psserve_snapshot()["wire"]
+        for k in ("requests_json", "requests_tensorframe",
+                  "wire_bytes_json", "wire_bytes_tensorframe"):
+            assert isinstance(wire[k], int)
+    finally:
+        _tear_down(servers, svcs, cli)
+        cli_j.close()
+
+
+def test_no_tensor_host_encodes_on_binary_path():
+    """The zero-copy claim, pinned at the unit level: a binary-wire
+    lookup+update round trip never touches the host-materializing
+    tensor serializer's counters."""
+    from brpc_tpu.rpc import serialization as ser
+    servers, svcs, shards, pc, cli = _spin_up(2)
+    try:
+        cli.lookup(KEYS)            # warm (negotiation settled)
+        e0 = ser.tensor_host_encodes.get_value()
+        d0 = ser.tensor_host_decodes.get_value()
+        cli.lookup(KEYS)
+        cli.update(KEYS, _int_grads(KEYS.size))
+        assert ser.tensor_host_encodes.get_value() == e0
+        assert ser.tensor_host_decodes.get_value() == d0
+    finally:
+        _tear_down(servers, svcs, cli)
+
+
+# ---- the ICI fast path ----
+
+@pytest.fixture
+def _clean_local_table():
+    yield
+    unregister_local_table("tf_ici")
+
+
+def test_ici_fast_path_matches_rpc_path(_clean_local_table):
+    """With a serve_local lowered table registered, the SAME PSClient
+    API short-circuits to the compiled collective program — results
+    identical to the RPC fan-out, retry/dedup semantics included."""
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual 8-device mesh")
+    import jax.numpy as jnp
+    dense = _oracle()
+    grads = _int_grads(KEYS.size)
+    servers, svcs, shards, pc, cli_rpc = _spin_up(4)
+    tbl = ShardedEmbeddingTable(V, D, n_shards=4, seed=3,
+                                serve_local=True, name="tf_ici")
+    cli_ici = PSClient(pc, vocab=V, dim=D, table_name="tf_ici")
+    try:
+        rows_r = cli_rpc.lookup(KEYS)
+        rows_i = cli_ici.lookup(KEYS)
+        np.testing.assert_array_equal(rows_i, rows_r)
+        np.testing.assert_array_equal(rows_i, np.asarray(dense[KEYS]))
+        assert cli_ici.n_ici_calls == 1
+        assert cli_rpc.n_ici_calls == 0     # different table_name: "ps"
+        r_rpc = cli_rpc.update(KEYS, grads)
+        r_ici = cli_ici.update(KEYS, grads)
+        assert r_ici == {0: 1}
+        want = np.asarray(dense.at[KEYS].add(jnp.asarray(grads)))
+        got_rpc = np.concatenate([sh.snapshot_rows() for sh in shards])
+        np.testing.assert_array_equal(tbl.snapshot(), want)
+        np.testing.assert_array_equal(got_rpc, want)
+        # lookup after update: read-your-writes on the fast path
+        np.testing.assert_array_equal(cli_ici.lookup(KEYS), want[KEYS])
+        assert cli_ici.n_stale_reads == 0
+        assert cli_ici.stats()["ici_calls"] == 3
+        assert r_rpc  # fan-out acked every partition
+    finally:
+        _tear_down(servers, svcs, cli_rpc)
+        cli_ici.close()
+
+
+def test_ici_fast_path_replayed_update_token_acks_once(_clean_local_table):
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual 8-device mesh")
+    tbl = ShardedEmbeddingTable(V, D, n_shards=4, seed=3,
+                                serve_local=True, name="tf_ici")
+    # a real client over a PartitionChannel that is never reached
+    pc = PartitionChannel(4)
+    for i in range(4):
+        pc.add_partition(i, brpc.Channel("127.0.0.1:1", timeout_ms=200,
+                                         max_retry=0))
+    cli = PSClient(pc, vocab=V, dim=D, table_name="tf_ici")
+    try:
+        grads = _int_grads(KEYS.size, seed=11)
+        acks = cli.update(KEYS, grads, update_token=424242)
+        before = tbl.snapshot().copy()
+        # replaying the SAME logical update dedups against the table's
+        # applied set: same version back, table untouched
+        acks2 = cli.update(KEYS, grads, update_token=424242)
+        assert acks == acks2 == {0: 1}
+        assert tbl.version == 1 and tbl.n_dup_updates == 1
+        np.testing.assert_array_equal(tbl.snapshot(), before)
+        # a FRESH token applies again
+        acks3 = cli.update(KEYS, grads, update_token=424243)
+        assert acks3 == {0: 2}
+    finally:
+        cli.close()
+
+
+def test_ici_fast_path_unregister_disengages_resolved_client(
+        _clean_local_table):
+    """Review regression: a client that already resolved the local
+    table must fall back to RPC the moment the table is unregistered
+    (generation check on the HIT path) — a kept-alive reference must
+    not keep swallowing updates into an orphaned table."""
+    import jax
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the virtual 8-device mesh")
+    servers, svcs, shards, pc, _c = _spin_up(2)
+    tbl = ShardedEmbeddingTable(V, D, n_shards=2, seed=3,
+                                serve_local=True, name="tf_ici")
+    cli = PSClient(pc, vocab=V, dim=D, table_name="tf_ici")
+    try:
+        cli.lookup(KEYS)
+        assert cli.n_ici_calls == 1
+        from brpc_tpu.psserve import unregister_local_table
+        unregister_local_table("tf_ici")
+        cli.lookup(KEYS)                # must ride RPC now
+        assert cli.n_ici_calls == 1
+        assert shards[0].n_lookups >= 1
+        assert tbl.n_lookups == 1       # the orphan saw only call 1
+    finally:
+        _tear_down(servers, svcs, _c)
+        cli.close()
+
+
+def test_ici_fast_path_disengages_without_table(_clean_local_table):
+    """No registered (or geometry-matching) local table: the client
+    stays on the RPC path."""
+    servers, svcs, shards, pc, cli = _spin_up(2)
+    try:
+        # wrong geometry registered under the client's table name
+        wrong = ShardedEmbeddingTable(V * 2, D, n_shards=2, seed=3,
+                                      serve_local=True, name="tf_ici")
+        cli2 = PSClient(pc, vocab=V, dim=D, table_name="tf_ici")
+        cli2.lookup(KEYS)
+        assert cli2.n_ici_calls == 0 and cli2.n_lookups == 1
+        assert wrong.n_lookups == 0
+        cli2.close()
+    finally:
+        _tear_down(servers, svcs, cli)
+
+
+# ---- eager batcher semantics (the PS default) ----
+
+def test_eager_batcher_cuts_through_when_idle_and_coalesces_under_load():
+    from brpc_tpu.serving.batcher import DynamicBatcher
+    calls = []
+    ev = threading.Event()
+
+    def fn(padded):
+        calls.append(padded.shape[0])
+        ev.wait(0.2)        # hold the slot so concurrent items queue
+        return np.asarray(padded[:, :1], np.float32)
+
+    b = DynamicBatcher(fn, max_batch_size=8, max_delay_us=500_000,
+                       length_buckets=(4,), dtype=np.int64,
+                       padded_output=False, eager=True,
+                       name="tf_eager_test")
+    try:
+        ev.set()
+        # idle: runs inline, no 500ms window wait (the test would time
+        # out if the window applied)
+        b.submit_wait(np.arange(4, dtype=np.int64), timeout_s=5)
+        assert calls and calls[-1] >= 1
+        # under load: requests arriving while a batch executes coalesce
+        # into the NEXT batch without waiting the window
+        ev.clear()
+        results = []
+
+        def one():
+            results.append(b.submit_wait(np.arange(4, dtype=np.int64),
+                                         timeout_s=10))
+
+        ts = [threading.Thread(target=one) for _ in range(6)]
+        first = threading.Thread(target=one)
+        first.start()
+        import time
+        time.sleep(0.05)        # first request holds the slot
+        [t.start() for t in ts]
+        time.sleep(0.05)
+        ev.set()
+        first.join(10)
+        [t.join(10) for t in ts]
+        assert len(results) == 7
+        assert max(calls) > 1       # the queued 6 formed a shared batch
+        assert b.stats()["eager"] is True
+    finally:
+        b.close()
+
+
+def test_handler_bypass_still_coalesces_under_concurrent_load():
+    """Review regression: the handler-level idle bypass CLAIMS the
+    batcher's execution slot, so concurrent RPCs arriving while a
+    bypassed request executes queue through the batcher and coalesce —
+    server-side batching must engage under load, not stay idle
+    forever."""
+    servers, svcs, shards, pc, cli = _spin_up(1)
+    try:
+        n_threads, n_iter = 8, 12
+        ks = np.arange(16, dtype=np.int64)
+
+        def worker(i):
+            c = PSClient(pc, vocab=V, dim=D, serializer="tensorframe",
+                         ici="off")
+            for _ in range(n_iter):
+                c.lookup(ks)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_threads)]
+        [t.start() for t in ts]
+        [t.join(60) for t in ts]
+        lb = svcs[0]._lookup_b
+        served_by_batcher = lb.n_completed.get_value()
+        total = n_threads * n_iter
+        assert shards[0].n_lookups == total
+        # the batcher actually served a share of the concurrent load
+        # (the bypass takes only the idle case) AND coalesced it
+        assert served_by_batcher > 0, \
+            "server-side batching never engaged under concurrent load"
+        assert lb.n_batches.get_value() < served_by_batcher or \
+            served_by_batcher < total
+    finally:
+        _tear_down(servers, svcs, cli)
+
+
+def test_eager_deadline_shed_does_not_charge_the_window():
+    """Review regression: eager mode never waits the batching window,
+    so the deadline-aware shed must not charge it — a tight-deadline
+    request an idle eager batcher would serve inline stays served; the
+    WINDOWED batcher with the same parameters sheds it."""
+    import time
+
+    from brpc_tpu import errors
+    from brpc_tpu.serving.batcher import DynamicBatcher
+
+    def fn(padded):
+        return np.asarray(padded[:, :1], np.float32)
+
+    kw = dict(max_batch_size=8, max_delay_us=500_000,
+              length_buckets=(4,), dtype=np.int64, padded_output=False)
+    be = DynamicBatcher(fn, eager=True, name="tf_shed_eager", **kw)
+    bw = DynamicBatcher(fn, eager=False, name="tf_shed_windowed", **kw)
+    try:
+        deadline = time.monotonic() + 0.1      # well inside eager's
+        out = be.submit_wait(np.arange(4, dtype=np.int64),
+                             deadline_s=deadline, timeout_s=5)
+        assert out is not None
+        with pytest.raises(errors.RpcError) as ei:
+            bw.submit_wait(np.arange(4, dtype=np.int64),
+                           deadline_s=time.monotonic() + 0.1,
+                           timeout_s=5)
+        assert ei.value.code == errors.ELIMIT
+    finally:
+        be.close()
+        bw.close()
+
+
+def test_eager_close_never_overlaps_inline_and_drainer_batches():
+    """Review regression: close()'s flush must respect the one-batch-
+    in-flight contract — a queued batch may not run concurrently with
+    an in-flight inline cut-through batch during shutdown."""
+    import time
+
+    from brpc_tpu.serving.batcher import DynamicBatcher
+
+    mu = threading.Lock()
+    active = [0]
+    max_active = [0]
+
+    def fn(padded):
+        with mu:
+            active[0] += 1
+            max_active[0] = max(max_active[0], active[0])
+        time.sleep(0.2)
+        with mu:
+            active[0] -= 1
+        return np.asarray(padded[:, :1], np.float32)
+
+    b = DynamicBatcher(fn, max_batch_size=8, max_delay_us=100,
+                       length_buckets=(4,), dtype=np.int64,
+                       padded_output=False, eager=True,
+                       name="tf_eager_close_test")
+    outcomes = []
+
+    def one():
+        try:
+            outcomes.append(("ok", b.submit_wait(
+                np.arange(4, dtype=np.int64), timeout_s=10)))
+        except Exception as e:
+            outcomes.append(("err", e))
+
+    t1 = threading.Thread(target=one)   # inline, holds the slot 200ms
+    t1.start()
+    import time as _t
+    _t.sleep(0.05)
+    t2 = threading.Thread(target=one)   # queues behind the inline batch
+    t2.start()
+    _t.sleep(0.02)
+    b.close()                           # flush DURING the inline batch
+    t1.join(10)
+    t2.join(10)
+    assert len(outcomes) == 2
+    assert max_active[0] == 1, \
+        f"batches overlapped at shutdown (max concurrent={max_active[0]})"

@@ -1,0 +1,86 @@
+"""Shared daemon-thread wedge-deadline guard for direct native entries
+(ISSUE 13 satellite; extracted from the PR-11/PR-12 copies in
+test_native_profiler.py and test_native_rpc.py).
+
+Deep in a full tier-1 run's accumulated executor state, a ctypes call
+into the native core — the echo bench pump especially, and
+intermittently the SIGPROF start/stop entries — can wedge indefinitely
+(reproduced on the UNMODIFIED tree; bench.cc's run_pump bounds its own
+wait at 120s and the wedge outlives even that).  An unbounded call then
+turns one wedged entry into a hung suite.
+
+Each consuming module instantiates ONE guard (per-module wedged state,
+matching the old module-global dicts): every wedge-able native call
+runs on a daemon thread with a deadline ~20-60x its normal runtime; a
+wedge SKIPS (never fails, never hangs) and short-circuits the module's
+remaining guarded work so the suite stays bounded.
+"""
+import threading
+
+import pytest
+
+
+class WedgeGuard:
+    """deadline()/join_thread() with skip-not-fail semantics; one
+    instance per test module keeps the wedged latch module-scoped."""
+
+    def __init__(self, what: str = "native call",
+                 deadline_s: float = 60.0):
+        self.what = what
+        self.deadline_s = float(deadline_s)
+        self._wedged = False
+
+    @property
+    def wedged(self) -> bool:
+        return self._wedged
+
+    def skip_if_wedged(self) -> None:
+        if self._wedged:
+            pytest.skip(f"{self.what} machinery wedged earlier in this "
+                        "module (pre-existing native flake); keeping "
+                        "the suite bounded")
+
+    def deadline(self, fn, *args, what: str | None = None):
+        """Run one native entry on a daemon thread with the wedge
+        deadline; returns its value, or SKIPS the test (marking the
+        module wedged) if it never comes back.  An entry that RAISES
+        re-raises here — a genuine native failure must fail the test,
+        never read as a flake-skip."""
+        self.skip_if_wedged()
+        what = what or self.what
+        out: dict = {}
+
+        def run():
+            try:
+                out["rc"] = fn(*args)
+            except BaseException as e:
+                out["exc"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(self.deadline_s)
+        if "exc" in out:
+            raise out["exc"]
+        if "rc" not in out:
+            self._wedged = True
+            pytest.skip(f"{what} wedged past {self.deadline_s:.0f}s "
+                        f"(pre-existing native flake)")
+        return out["rc"]
+
+    def start_thread(self, fn, *args) -> threading.Thread:
+        """Start a guarded daemon worker (e.g. the echo burn);
+        pair with join_thread."""
+        self.skip_if_wedged()
+        t = threading.Thread(target=fn, args=args, daemon=True)
+        t.start()
+        return t
+
+    def join_thread(self, t: threading.Thread,
+                    what: str | None = None) -> None:
+        t.join(self.deadline_s)
+        if t.is_alive():
+            self._wedged = True
+            pytest.skip(f"{what or self.what} wedged past "
+                        f"{self.deadline_s:.0f}s (pre-existing native "
+                        f"flake; run_pump's own 120s bound did not "
+                        f"fire)")

@@ -42,7 +42,11 @@ _HIGHER_BETTER_KEYS = {"qps", "gbps", "tokens_per_s", "items_per_s",
                        "direct_gens_per_s", "router_gens_per_s",
                        "native_speedup",
                        "batched_lookups_per_s",
-                       "unbatched_lookups_per_s"}
+                       "unbatched_lookups_per_s",
+                       "tensorframe_lookups_per_s",
+                       "json_lookups_per_s",
+                       "lowered_lookups_per_s",
+                       "tax_reduction_x"}
 
 
 def direction(key: str) -> str | None:
