@@ -476,13 +476,27 @@ def build_routes(server) -> dict:
     def hotspots_locks(req):
         # the lock-contention ledger (ISSUE 6; butil/lockprof.py):
         # per-named-lock acquisitions, contended acquisitions, wait and
-        # hold latencies, and the last holder's serving stage
+        # hold latencies, and the last holder's serving stage — plus
+        # the lock-order WITNESS (ISSUE 14): live held sets per thread
+        # and any ABBA cycles the observed acquisition orders close
+        from brpc_tpu.butil import lockprof
         from brpc_tpu.butil.lockprof import locks_snapshot
         snap = locks_snapshot()
         if req.query.get("fmt") == "json":
-            return json.dumps(snap, indent=1), "application/json"
+            return json.dumps({
+                "ledger": snap,
+                "witness": {
+                    "enabled": lockprof.witness_enabled(),
+                    "held": lockprof.held_locks_snapshot(),
+                    "edges": lockprof.lock_order_edges(),
+                    "violations": [
+                        {k: v for k, v in viol.items() if k != "stack"}
+                        for viol in lockprof.order_violations()],
+                },
+            }, indent=1), "application/json"
         if not snap:
-            return "no instrumented locks registered yet\n"
+            return ("no instrumented locks registered yet\n\n"
+                    + lockprof.witness_report())
         cols = ("acquisitions", "contentions", "contention_ratio",
                 "wait_avg_us", "wait_p99_us", "wait_max_us",
                 "hold_avg_us", "hold_p99_us", "hold_max_us")
@@ -496,7 +510,7 @@ def build_routes(server) -> dict:
                 f"{name:<18}"
                 + "".join(f"{st[c]:>18}" for c in cols)
                 + f"  {st['last_holder_stage']}")
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n\n" + lockprof.witness_report()
 
     def _seconds(req, default=1.0):
         try:

@@ -27,8 +27,11 @@ after ``wait()`` is accounted like any other acquisition.
 """
 from __future__ import annotations
 
+import os
+import sys
 import threading
 import time
+import traceback
 
 from brpc_tpu.butil import stagetag
 
@@ -112,6 +115,11 @@ class InstrumentedLock:
     # ---- core protocol ----
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _witness_on:
+            # order edges are recorded at acquire ATTEMPT, not success:
+            # a genuine ABBA deadlock never completes its second
+            # acquire, and the attempt is exactly the evidence we need
+            _witness_attempt(self.stats.name)
         if self._inner.acquire(False):
             got = True
         elif not blocking:
@@ -120,7 +128,13 @@ class InstrumentedLock:
             st = self.stats
             st.contentions.add(1)
             t0 = time.monotonic()
-            got = self._inner.acquire(True, timeout)
+            if _witness_on:
+                _witness_waiting(st.name)
+            try:
+                got = self._inner.acquire(True, timeout)
+            finally:
+                if _witness_on:
+                    _witness_waiting(None)
             if got:
                 st.wait_rec.add(int((time.monotonic() - t0) * 1e6))
         if got:
@@ -149,12 +163,16 @@ class InstrumentedLock:
             st = self.stats
             st.acquisitions.add(1)
             st.last_holder_stage = stagetag.current_stage()
+            if _witness_on:
+                _witness_acquired(st.name)
 
     def _end_hold(self) -> None:
         self._depth -= 1
         if self._depth == 0:
             self.stats.hold_rec.add(
                 int((time.monotonic() - self._t_hold) * 1e6))
+            if _witness_on:
+                _witness_released(self.stats.name)
 
     # ---- threading.Condition protocol ----
 
@@ -170,6 +188,8 @@ class InstrumentedLock:
     def _acquire_restore(self, state) -> None:
         inner_state, depth = state
         st = self.stats
+        if _witness_on:
+            _witness_attempt(st.name)
         t0 = time.monotonic()
         if self._is_rlock:
             self._inner._acquire_restore(inner_state)
@@ -197,3 +217,246 @@ class InstrumentedLock:
     def __repr__(self) -> str:
         return (f"<InstrumentedLock {self.stats.name!r} "
                 f"depth={self._depth}>")
+
+
+# ---------------------------------------------------------------------------
+# Runtime lock-order witness (ISSUE 14)
+#
+# The ledger above answers "which lock is hot"; the witness answers
+# "which locks can DEADLOCK".  Every InstrumentedLock acquisition
+# records, per thread, the set of named locks already held; the first
+# time lock B is acquired while A is held, the ordered edge A->B enters
+# a process-global lock-order graph.  A new edge that closes a cycle
+# (some path B->...->A already exists) is an ABBA violation: a
+# POTENTIAL deadlock, reported the first time the two orders are ever
+# observed -- no actual hang is needed, which is the whole point (the
+# PR 11 tier-1 wedge produced a silent hang and zero evidence).
+#
+# Cost discipline: the steady-state per-acquisition work is one module
+# flag read, one thread-local lookup, a list append/pop and -- only
+# while other locks are held -- a dict membership probe per held lock.
+# The witness lock (_wit_mu) is taken only when a NEVER-SEEN edge
+# appears, which happens a bounded number of times per process
+# (distinct name pairs), so the hot path never serializes on it.
+#
+# The held-set tables are also the WEDGE DUMP substrate:
+# ``held_locks_snapshot()`` shows every thread's held names and, for a
+# thread parked in a contended acquire, the name it is waiting for --
+# tests/wedge_guard.py prints this when a native call blows its
+# deadline, and /hotspots/locks renders it live.
+# ---------------------------------------------------------------------------
+
+_witness_on = os.environ.get("BRPC_LOCK_WITNESS", "1") not in ("0", "", "off")
+_wit_mu = threading.Lock()
+_wit_tls = threading.local()
+_wit_edges: dict[tuple, dict] = {}        # (a, b) -> {"site", "count"}
+_wit_adj: dict[str, set] = {}             # a -> {b, ...}
+_wit_violations: list = []
+_wit_seen_cycles: set = set()
+_wit_threads: dict[int, list] = {}        # ident -> held-name list
+_wit_waiting: dict[int, str] = {}         # ident -> name being waited on
+_wit_viol_adder = None                    # lazy bvar Adder
+MAX_WITNESS_EDGES = 4096
+MAX_WITNESS_VIOLATIONS = 64
+
+
+def set_witness_enabled(on: bool) -> None:
+    global _witness_on
+    _witness_on = bool(on)
+
+
+def witness_enabled() -> bool:
+    return _witness_on
+
+
+def _wit_held() -> list:
+    held = getattr(_wit_tls, "held", None)
+    if held is None:
+        held = _wit_tls.held = []
+    ident = threading.get_ident()
+    # re-register whenever the table lost us — reset_witness() clears
+    # it, and a thread whose TLS list predates the reset must come
+    # back, or every post-reset wedge dump reads "(none held)".  The
+    # steady-state cost is one dict hit.
+    if _wit_threads.get(ident) is not held:
+        with _wit_mu:
+            if len(_wit_threads) > 512:
+                alive = {t.ident for t in threading.enumerate()}
+                for k in [k for k, v in _wit_threads.items()
+                          if not v and k not in alive]:
+                    del _wit_threads[k]
+            _wit_threads[ident] = held
+    return held
+
+
+def _wit_site() -> str:
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "?"
+    return f"{os.path.relpath(f.f_code.co_filename)}:{f.f_lineno}"
+
+
+def _witness_waiting(name) -> None:
+    ident = threading.get_ident()
+    if name is None:
+        _wit_waiting.pop(ident, None)
+    else:
+        _wit_waiting[ident] = name
+
+
+def _witness_attempt(name: str) -> None:
+    """Record order edges held->name the first time each is seen."""
+    held = _wit_held()
+    if held:
+        for h in held:
+            if h != name and (h, name) not in _wit_edges:
+                _wit_new_edge(h, name)
+
+
+def _witness_acquired(name: str) -> None:
+    _wit_held().append(name)
+
+
+def _witness_released(name: str) -> None:
+    held = getattr(_wit_tls, "held", None)
+    if held:
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+
+
+def _wit_new_edge(a: str, b: str) -> None:
+    """First observation of order a->b: insert, then cycle-check."""
+    with _wit_mu:
+        if (a, b) in _wit_edges or len(_wit_edges) >= MAX_WITNESS_EDGES:
+            return
+        site = _wit_site()
+        _wit_edges[(a, b)] = {"site": site, "count": 1}
+        _wit_adj.setdefault(a, set()).add(b)
+        # does a path b -> ... -> a already exist?  (iterative DFS with
+        # parent links so the violation report carries the cycle path)
+        parent = {b: None}
+        stack = [b]
+        found = False
+        while stack and not found:
+            n = stack.pop()
+            for m in _wit_adj.get(n, ()):
+                if m not in parent:
+                    parent[m] = n
+                    if m == a:
+                        found = True
+                        break
+                    stack.append(m)
+        if not found:
+            return
+        path = [a]
+        n = parent[a]
+        while n is not None:
+            path.append(n)
+            n = parent[n]
+        path.reverse()               # b ... a
+        cycle = path + [b]           # b ... a -> b closes it
+        key = frozenset(cycle)
+        if key in _wit_seen_cycles:
+            return
+        _wit_seen_cycles.add(key)
+        if len(_wit_violations) >= MAX_WITNESS_VIOLATIONS:
+            return
+        edge_sites = {
+            f"{x}->{y}": _wit_edges.get((x, y), {}).get("site", "?")
+            for x, y in zip(cycle, cycle[1:])}
+        _wit_violations.append({
+            "cycle": cycle,
+            "edge": [a, b],
+            "site": site,
+            "thread": threading.current_thread().name,
+            "stage": stagetag.current_stage(),
+            "edge_sites": edge_sites,
+            "stack": "".join(traceback.format_stack(
+                sys._getframe(1), limit=12)),
+        })
+    global _wit_viol_adder
+    try:
+        if _wit_viol_adder is None:
+            from brpc_tpu.bvar import Adder
+            _wit_viol_adder = Adder("lock_order_violations")
+        _wit_viol_adder.add(1)
+    except Exception:
+        pass
+
+
+def held_locks_snapshot() -> dict:
+    """Every tracked thread's held named locks (+ the lock it is
+    blocked acquiring, when contended) -- the wedge dump's payload."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    with _wit_mu:
+        rows = [(i, list(h)) for i, h in _wit_threads.items()]
+    waiting = dict(_wit_waiting)
+    for ident, held in rows:
+        wait = waiting.get(ident)
+        if not held and wait is None:
+            continue
+        label = names.get(ident, f"thread-{ident}")
+        out[label] = {"held": held, "waiting_for": wait}
+    return out
+
+
+def lock_order_edges() -> dict:
+    """The observed order graph: {'a->b': {'site': ..}}."""
+    with _wit_mu:
+        return {f"{a}->{b}": dict(info)
+                for (a, b), info in sorted(_wit_edges.items())}
+
+
+def order_violations() -> list:
+    """ABBA cycles observed so far (potential deadlocks)."""
+    with _wit_mu:
+        return [dict(v) for v in _wit_violations]
+
+
+def reset_witness() -> None:
+    """Drop the graph, violations and held-set tables (tests)."""
+    with _wit_mu:
+        _wit_edges.clear()
+        _wit_adj.clear()
+        _wit_violations.clear()
+        _wit_seen_cycles.clear()
+        _wit_threads.clear()
+    _wit_waiting.clear()
+    _wit_tls.held = []
+
+
+def witness_report() -> str:
+    """Human-readable dump: held sets per thread, the order graph's
+    size, and every ABBA cycle with its edge sites.  Wired into
+    tests/wedge_guard.py deadline misses and /hotspots/locks."""
+    lines = ["--- lock-order witness ---"]
+    snap = held_locks_snapshot()
+    if snap:
+        lines.append("held locks by thread:")
+        for tname, row in sorted(snap.items()):
+            wait = (f"  (BLOCKED acquiring {row['waiting_for']!r})"
+                    if row["waiting_for"] else "")
+            lines.append(f"  {tname}: {row['held'] or '[]'}{wait}")
+    else:
+        lines.append("held locks by thread: (none held)")
+    with _wit_mu:
+        n_edges = len(_wit_edges)
+        viols = [dict(v) for v in _wit_violations]
+    lines.append(f"order graph: {n_edges} edge(s)")
+    if viols:
+        lines.append(f"ABBA violations: {len(viols)}")
+        for v in viols:
+            lines.append("  cycle: " + " -> ".join(v["cycle"]))
+            for edge, site in sorted(v["edge_sites"].items()):
+                lines.append(f"    {edge} first seen at {site}")
+            lines.append(f"    closing thread: {v['thread']} "
+                         f"(stage {v['stage'] or '-'})")
+    else:
+        lines.append("ABBA violations: none")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,22 @@
+"""brpc-check (ISSUE 14) — the repo-invariant static-analysis suite.
+
+bRPC ships its own correctness tooling beside the runtime (contention
+profiler, rpcz, builtin diagnostics); this package is that idea turned
+on the REPO: six AST passes encode the load-bearing conventions the
+tree has grown — the static lock-order graph must be acyclic
+(lock-order), wire parsers bounds-check before sizing
+(bounded-decode), jit programs compile once per bucket (jit-hot-path),
+every fault site is registered and test-referenced (fault-sites), hot
+modules use the InstrumentedLock ledger (lock-hygiene), and tests
+bound their joins/native entries (wedge-hygiene).  `make check` runs
+them all against the committed CHECK_BASELINE.json: frozen findings
+pass, new ones exit 1.
+
+CLI: ``python tools/brpc_check.py`` (``--json`` for machine output,
+``--write-baseline`` / ``--write-fault-registry`` to regenerate the
+committed artifacts).  The runtime complement — the lock-order
+WITNESS that observes executed acquisition orders and flags ABBA
+cycles live — is butil/lockprof.py.
+"""
+from brpc_tpu.check.base import Finding, Repo  # noqa: F401
+from brpc_tpu.check.runner import all_passes, run_checks  # noqa: F401

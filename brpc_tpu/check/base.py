@@ -1,0 +1,169 @@
+"""brpc-check infrastructure (ISSUE 14) — findings, source cache,
+suppression comments.
+
+The suite is AST-based and repo-local: every pass walks parsed Python
+sources under a repo root and returns :class:`Finding`s.  A finding's
+``key`` is its BASELINE IDENTITY — built from the pass id plus stable
+symbols (paths, qualnames, lock/site names), never line numbers, so a
+committed baseline survives unrelated edits while a genuinely new
+violation of the same kind in the same function still matches its
+frozen twin (one finding per (pass, symbol) is the granularity the
+baseline freezes; the messages carry lines for humans).
+
+Suppressions: a ``# brpc-check: allow(<pass-id>)`` comment on the
+flagged line or the line above waives that pass there — for the rare
+case where the invariant is deliberately broken and a comment
+explaining why belongs in the source anyway.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+ALLOW_RE = re.compile(r"#\s*brpc-check:\s*allow\(([a-z0-9_,\- ]+)\)")
+
+
+@dataclasses.dataclass
+class Finding:
+    pass_id: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    key: str           # stable baseline identity (no line numbers)
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self) -> str:
+        return f"[{self.pass_id}] {self.path}:{self.line}: {self.message}"
+
+
+class SourceFile:
+    """One parsed source file; parse errors surface as a finding from
+    the runner, not an exception (a syntax-broken tree must fail the
+    check, not crash it)."""
+
+    def __init__(self, root: str, rel: str):
+        self.rel = rel.replace(os.sep, "/")
+        self.path = os.path.join(root, rel)
+        with open(self.path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.rel)
+        except SyntaxError as e:
+            self.parse_error = f"syntax error: {e}"
+
+    def allowed(self, line: int, pass_id: str) -> bool:
+        for ln in (line, line - 1):
+            if 1 <= ln <= len(self.lines):
+                m = ALLOW_RE.search(self.lines[ln - 1])
+                if m and pass_id in [s.strip()
+                                     for s in m.group(1).split(",")]:
+                    return True
+        return False
+
+
+class Repo:
+    """Root + cached parsed sources.  Passes share one parse per file
+    so the whole six-pass suite stays well under the 30s budget."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._cache: dict[str, SourceFile] = {}
+
+    def file(self, rel: str) -> SourceFile | None:
+        rel = rel.replace(os.sep, "/")
+        sf = self._cache.get(rel)
+        if sf is None:
+            if not os.path.isfile(os.path.join(self.root, rel)):
+                return None
+            sf = self._cache[rel] = SourceFile(self.root, rel)
+        return sf
+
+    def files(self, subdirs=("brpc_tpu",)) -> list[SourceFile]:
+        out = []
+        for sub in subdirs:
+            base = os.path.join(self.root, sub)
+            if os.path.isfile(base) and sub.endswith(".py"):
+                sf = self.file(sub)
+                if sf is not None:
+                    out.append(sf)
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          self.root)
+                    sf = self.file(rel)
+                    if sf is not None:
+                        out.append(sf)
+        return out
+
+
+def last_segment(func: ast.expr) -> str | None:
+    """The trailing name of a call target: jax.jit -> 'jit'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def base_name(func: ast.expr) -> str | None:
+    """The leading name of a dotted call target: jax.jit -> 'jax'."""
+    node = func
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def qualname_stack(stack: list[str]) -> str:
+    return ".".join(stack) if stack else "<module>"
+
+
+class FuncIndexer(ast.NodeVisitor):
+    """Yields (qualname, class_name, FunctionDef) for every function in
+    a module, tracking the lexical class/function stack."""
+
+    def __init__(self):
+        self.out: list[tuple[str, str | None, ast.AST]] = []
+        self._stack: list[tuple[str, str]] = []  # (kind, name)
+
+    def _cls(self) -> str | None:
+        for kind, name in reversed(self._stack):
+            if kind == "class":
+                return name
+            return None          # nested inside a function: no class
+        return None
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._stack.append(("class", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_func(self, node):
+        qual = ".".join(n for _, n in self._stack + [("func", node.name)])
+        self.out.append((qual, self._cls(), node))
+        self._stack.append(("func", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+def iter_functions(tree: ast.Module):
+    ix = FuncIndexer()
+    ix.visit(tree)
+    return ix.out

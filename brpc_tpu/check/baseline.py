@@ -1,0 +1,54 @@
+"""Baseline handling for brpc-check (ISSUE 14).
+
+The suite runs on every PR; pre-existing violations must not block
+unrelated work, but NEW ones must exit 1.  The committed baseline
+(CHECK_BASELINE.json at the repo root) freezes each known finding by
+its stable key; `tools/brpc_check.py` reports
+
+  * NEW findings (not in the baseline)        -> exit 1
+  * SUPPRESSED findings (frozen)              -> counted, exit 0
+  * STALE baseline entries (no longer firing) -> nagged, exit 0 —
+    burn them out with --write-baseline so the frozen set only ever
+    shrinks.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+BASELINE_REL = "CHECK_BASELINE.json"
+
+
+def load_baseline(path: str) -> dict[str, dict]:
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return dict(data.get("findings", {}))
+
+
+def write_baseline(path: str, findings) -> None:
+    data = {
+        "comment": ("brpc-check frozen findings (ISSUE 14). "
+                    "Pre-existing violations only — new findings fail "
+                    "`make check`. Regenerate (shrink-only, please) "
+                    "with `python tools/brpc_check.py --write-baseline`."),
+        "findings": {
+            f.key: {"path": f.path, "message": f.message}
+            for f in sorted(findings, key=lambda f: f.key)
+        },
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def split_findings(findings, baseline: dict):
+    """(new, suppressed, stale_keys)."""
+    new, suppressed = [], []
+    fired = set()
+    for f in findings:
+        fired.add(f.key)
+        (suppressed if f.key in baseline else new).append(f)
+    stale = sorted(k for k in baseline if k not in fired)
+    return new, suppressed, stale

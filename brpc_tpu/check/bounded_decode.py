@@ -1,0 +1,220 @@
+"""Pass 2: bounded-decode discipline in wire-parser modules (ISSUE 14).
+
+The repo's parser contract (rpc/compact.py, rpc/tensorframe.py and the
+protocol codecs): every integer read off the wire is BOUNDS-CHECKED in
+exact Python ints before it sizes anything — a slice, a frombuffer, an
+allocation.  A hostile peer otherwise drives `bytearray(length_field)`
+to an 8 EiB allocation or a silent short-read.  This pass flags, per
+function, any sizing use of a wire-read integer with no preceding
+check.
+
+Taint, intraprocedurally: a variable is wire-read when assigned from
+``struct.unpack/unpack_from`` (or a subscript of one),
+``int.from_bytes``, or a reader-shaped call (``u8/u16/u32/u64``,
+``varint``, ``read_*``/``_read*``); arithmetic on tainted stays
+tainted.  A check is any ``if``/``while``/``assert`` whose test
+compares the tainted name (the `if n > len(buf): raise` idiom), or
+passing it to a ``*check*/*need*/*require*/*bound*/*expect*`` helper;
+``min(n, CAP)`` launders the taint by construction.  Sized sinks:
+slice bounds, ``frombuffer(count=n)``, ``bytearray/bytes/zeros/empty/
+full`` allocation args, and ``seq * n`` repetition.
+
+Intraprocedural by design: a helper like ``take(n)`` that does its own
+bounds check inside is the SANCTIONED pattern, and flagging its call
+sites would punish exactly the discipline we want.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from brpc_tpu.check.base import Finding, Repo, iter_functions, last_segment
+
+PASS_ID = "bounded-decode"
+
+# the wire-parser modules under the contract (rpc/compact.py's Reader
+# is the exemplar; tensorframe's decode is the newest adopter)
+PARSER_MODULES = (
+    "brpc_tpu/rpc/compact.py",
+    "brpc_tpu/rpc/tensorframe.py",
+    "brpc_tpu/rpc/hpack.py",
+    "brpc_tpu/rpc/h2.py",
+    "brpc_tpu/rpc/redis.py",
+    "brpc_tpu/rpc/memcache.py",
+    "brpc_tpu/rpc/mongo.py",
+)
+
+_READER_RE = re.compile(
+    r"^(u|i)(8|16|32|64)$|^(read_|_read|peek_)|^(varint|unpack|"
+    r"unpack_from|from_bytes)$")
+_CHECK_RE = re.compile(r"check|need|require|bound|expect|validate|_fits")
+_ALLOC_NAMES = {"bytearray", "bytes", "zeros", "empty", "full", "ones"}
+
+
+def _is_reader_call(node: ast.expr) -> bool:
+    if isinstance(node, ast.Subscript):
+        return _is_reader_call(node.value)
+    if not isinstance(node, ast.Call):
+        return False
+    seg = last_segment(node.func)
+    return bool(seg and _READER_RE.search(seg))
+
+
+class _TaintState:
+    def __init__(self):
+        self.tainted: set[str] = set()
+        self.checked: set[str] = set()
+
+    def expr_tainted(self, node: ast.expr) -> set[str]:
+        """Names through which `node` is tainted-and-unchecked; a
+        direct reader call reports the pseudo-name '<wire-read>'."""
+        if isinstance(node, ast.Call):
+            if _is_reader_call(node):
+                return {"<wire-read>"}
+            seg = last_segment(node.func)
+            if seg in ("min", "len"):
+                # min() bounds by construction; len() is host-side
+                # truth — either one laundering the expression is the
+                # sanctioned fix this pass points at
+                return set()
+            out: set[str] = set()
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                out |= self.expr_tainted(a)
+            return out
+        if isinstance(node, ast.Name):
+            if node.id in self.tainted and node.id not in self.checked:
+                return {node.id}
+            return set()
+        out = set()
+        for child in ast.iter_child_nodes(node):
+            out |= self.expr_tainted(child)
+        return out
+
+
+class BoundedDecodePass:
+    pass_id = PASS_ID
+    title = "wire-read integers are bounds-checked before sizing"
+
+    def __init__(self, modules=PARSER_MODULES):
+        self.modules = modules
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for rel in self.modules:
+            sf = repo.file(rel)
+            if sf is None or sf.tree is None:
+                continue
+            for qual, _cls, fn in iter_functions(sf.tree):
+                out.extend(self._scan_function(sf, qual, fn))
+        return out
+
+    # ---- per-function scan ----
+
+    def _scan_function(self, sf, qual, fn) -> list[Finding]:
+        st = _TaintState()
+        findings: dict[str, Finding] = {}
+
+        def flag(node, names, what):
+            name = sorted(names)[0]
+            key = f"{PASS_ID}:{sf.rel}:{qual}:{name}"
+            if key in findings or sf.allowed(node.lineno, PASS_ID):
+                return
+            findings[key] = Finding(
+                pass_id=PASS_ID, path=sf.rel, line=node.lineno, key=key,
+                message=(f"{what} sized by wire-read integer "
+                         f"{name!r} with no preceding bounds check "
+                         f"(in {qual})"))
+
+        def mark_checked(test):
+            for sub in ast.walk(test):
+                if isinstance(sub, ast.Name) and sub.id in st.tainted:
+                    st.checked.add(sub.id)
+
+        def scan_sinks(node):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Subscript) and \
+                        isinstance(sub.slice, ast.Slice):
+                    names = set()
+                    for bound in (sub.slice.lower, sub.slice.upper):
+                        if bound is not None:
+                            names |= st.expr_tainted(bound)
+                    if names:
+                        flag(sub, names, "slice")
+                elif isinstance(sub, ast.Call):
+                    seg = last_segment(sub.func)
+                    if seg == "frombuffer":
+                        for kw in sub.keywords:
+                            if kw.arg == "count":
+                                names = st.expr_tainted(kw.value)
+                                if names:
+                                    flag(sub, names, "frombuffer")
+                    elif seg in _ALLOC_NAMES:
+                        for a in sub.args:
+                            names = st.expr_tainted(a)
+                            if names:
+                                flag(sub, names, f"{seg}() allocation")
+                elif isinstance(sub, ast.BinOp) and \
+                        isinstance(sub.op, ast.Mult):
+                    # b"\x00" * n / [0] * n repetition
+                    for side, other in ((sub.left, sub.right),
+                                        (sub.right, sub.left)):
+                        if isinstance(other, (ast.Constant, ast.List,
+                                              ast.Tuple)):
+                            names = st.expr_tainted(side)
+                            if names:
+                                flag(sub, names, "sequence repetition")
+
+        def visit(stmts):
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                    continue
+                if isinstance(s, (ast.If, ast.While)):
+                    scan_sinks(s.test)
+                    mark_checked(s.test)
+                    visit(s.body)
+                    visit(s.orelse)
+                    continue
+                if isinstance(s, ast.Assert):
+                    mark_checked(s.test)
+                    continue
+                if isinstance(s, ast.Assign) and len(s.targets) >= 1:
+                    scan_sinks(s.value)
+                    tainted_by = st.expr_tainted(s.value) or \
+                        ({"<wire-read>"} if _is_reader_call(s.value)
+                         else set())
+                    for t in s.targets:
+                        names = [n.id for n in ast.walk(t)
+                                 if isinstance(n, ast.Name)]
+                        for n in names:
+                            if tainted_by:
+                                st.tainted.add(n)
+                                st.checked.discard(n)
+                            else:
+                                st.tainted.discard(n)
+                                st.checked.discard(n)
+                    continue
+                if isinstance(s, ast.AugAssign):
+                    scan_sinks(s.value)
+                    continue
+                if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+                    seg = last_segment(s.value.func) or ""
+                    if _CHECK_RE.search(seg):
+                        for a in s.value.args:
+                            for sub in ast.walk(a):
+                                if isinstance(sub, ast.Name) and \
+                                        sub.id in st.tainted:
+                                    st.checked.add(sub.id)
+                        continue
+                    scan_sinks(s)
+                    continue
+                scan_sinks(s)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, attr, None)
+                    if sub:
+                        visit(sub)
+                for h in getattr(s, "handlers", []):
+                    visit(h.body)
+
+        visit(fn.body)
+        return list(findings.values())

@@ -1,0 +1,106 @@
+"""Pass 3: one-compile-per-bucket jit discipline (ISSUE 14).
+
+The serving stack's compile-count invariants (trace-counter-pinned in
+the batcher/engine tests) all flow from one convention: ``jax.jit`` /
+``shard_map`` / ``pjit`` / ``pmap`` programs are constructed ONCE — at
+module level, in ``__init__`` (per bucket), or in an explicitly-cached
+builder — never inside a per-call function, where every request would
+pay a retrace (and the jit cache grows without bound when shapes
+vary).  This pass flags jit construction inside function bodies unless
+the enclosing function is constructor-shaped (``__init__``,
+``make_*``/``build_*``/``*compile*``) or wrapped in
+``functools.lru_cache``/``cache``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from brpc_tpu.check.base import (Finding, Repo, base_name, last_segment,
+                                 qualname_stack)
+
+PASS_ID = "jit-hot-path"
+
+_JIT_NAMES = {"jit", "pjit", "pmap", "shard_map"}
+_SETUP_RE = re.compile(r"^(__init__|__init_subclass__|make|build|_make|"
+                       r"_build|_?jit)|compile")
+_CACHE_DECOS = {"lru_cache", "cache", "cached_property"}
+
+
+def _decorated_cached(fn) -> bool:
+    for d in fn.decorator_list:
+        seg = last_segment(d.func if isinstance(d, ast.Call) else d)
+        if seg in _CACHE_DECOS:
+            return True
+    return False
+
+
+class JitHotPathPass:
+    pass_id = PASS_ID
+    title = "jit/shard_map constructed at module level, not per call"
+
+    def __init__(self, subdirs=("brpc_tpu",)):
+        self.subdirs = subdirs
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in repo.files(self.subdirs):
+            if sf.tree is None:
+                continue
+            imports_jax = any(
+                (isinstance(n, ast.Import)
+                 and any(a.name.split(".")[0] == "jax" for a in n.names))
+                or (isinstance(n, ast.ImportFrom) and n.module
+                    and n.module.split(".")[0] == "jax")
+                for n in ast.walk(sf.tree))
+            if not imports_jax:
+                continue
+            out.extend(self._scan(sf))
+        return out
+
+    def _scan(self, sf) -> list[Finding]:
+        found: dict[str, Finding] = {}
+
+        def walk(node, name_stack, in_func, exempt):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    child_exempt = exempt or \
+                        bool(_SETUP_RE.search(child.name)) or \
+                        _decorated_cached(child)
+                    # decorators evaluate in the ENCLOSING scope
+                    for d in child.decorator_list:
+                        walk(d, name_stack, in_func, exempt)
+                    walk(child, name_stack + [child.name], True,
+                         child_exempt)
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    # class bodies execute at import time: the name
+                    # rides the qualname, per-call-ness does not
+                    walk(child, name_stack + [child.name], in_func,
+                         exempt)
+                    continue
+                if isinstance(child, ast.Call) and not exempt \
+                        and in_func:
+                    seg = last_segment(child.func)
+                    base = base_name(child.func)
+                    if seg in _JIT_NAMES and base in (
+                            "jax", "pjit", "jit", "pmap", "shard_map",
+                            "shmap", None):
+                        qual = qualname_stack(name_stack)
+                        key = f"{PASS_ID}:{sf.rel}:{qual}:{seg}"
+                        if key not in found and \
+                                not sf.allowed(child.lineno, PASS_ID):
+                            found[key] = Finding(
+                                pass_id=PASS_ID, path=sf.rel,
+                                line=child.lineno, key=key,
+                                message=(
+                                    f"{seg}(...) constructed inside "
+                                    f"per-call function {qual} — hoist "
+                                    f"to module level or a bucketed "
+                                    f"__init__ cache (one compile per "
+                                    f"bucket)"))
+                walk(child, name_stack, in_func, exempt)
+
+        walk(sf.tree, [], False, False)
+        return list(found.values())

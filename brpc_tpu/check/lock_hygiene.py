@@ -1,0 +1,101 @@
+"""Pass 5: InstrumentedLock hygiene in the serving hot modules
+(ISSUE 14).
+
+The lock-contention ledger (/hotspots/locks, ``lock_*_{wait,hold}_us``)
+and the runtime lock-order witness only see locks that go through
+``butil.lockprof.InstrumentedLock``.  A raw ``threading.Lock`` in a
+hot subsystem is invisible to both — exactly how psserve/ grew five
+modules of exactly-once logic with zero ledger coverage.  This pass
+flags raw ``threading.Lock()``/``RLock()``/bare ``Condition()``
+construction in the hot directories; an RLock passed as
+``InstrumentedLock(name, threading.RLock())`` (the wrapper's inner)
+and ``Condition(InstrumentedLock(...))`` are the sanctioned forms.
+"""
+from __future__ import annotations
+
+import ast
+
+from brpc_tpu.check.base import (Finding, Repo, base_name, last_segment,
+                                 qualname_stack)
+
+PASS_ID = "lock-hygiene"
+
+HOT_PREFIXES = (
+    "brpc_tpu/serving/",
+    "brpc_tpu/kvcache/",
+    "brpc_tpu/psserve/",
+    "brpc_tpu/migrate/",
+)
+
+
+class LockHygienePass:
+    pass_id = PASS_ID
+    title = "hot modules use InstrumentedLock, not raw threading locks"
+
+    def __init__(self, prefixes=HOT_PREFIXES):
+        self.prefixes = prefixes
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in repo.files(("brpc_tpu",)):
+            if sf.tree is None or \
+                    not sf.rel.startswith(tuple(self.prefixes)):
+                continue
+            out.extend(self._scan(sf))
+        return out
+
+    def _scan(self, sf) -> list[Finding]:
+        found: dict[str, Finding] = {}
+
+        def target_of(stack_parents, call) -> str:
+            # nearest Assign ancestor names the lock for the key
+            for p in reversed(stack_parents):
+                if isinstance(p, ast.Assign) and len(p.targets) == 1:
+                    t = p.targets[0]
+                    if isinstance(t, ast.Attribute):
+                        return t.attr
+                    if isinstance(t, ast.Name):
+                        return t.id
+            return f"anon@{call.lineno}"
+
+        def walk(node, func_stack, parents):
+            for child in ast.iter_child_nodes(node):
+                fs = func_stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    fs = func_stack + [child.name]
+                if isinstance(child, ast.Call):
+                    seg = last_segment(child.func)
+                    base = base_name(child.func)
+                    raw = (seg in ("Lock", "RLock")
+                           or (seg == "Condition" and not child.args)) \
+                        and (base == "threading"
+                             or isinstance(child.func, ast.Name))
+                    if raw:
+                        # sanctioned: the inner of InstrumentedLock(...)
+                        wrapped = any(
+                            isinstance(p, ast.Call) and
+                            last_segment(p.func) == "InstrumentedLock"
+                            for p in parents)
+                        if not wrapped and \
+                                not sf.allowed(child.lineno, PASS_ID):
+                            qual = qualname_stack(func_stack)
+                            tgt = target_of(parents, child)
+                            key = f"{PASS_ID}:{sf.rel}:{qual}:{tgt}"
+                            if key not in found:
+                                kind = seg if seg != "Condition" \
+                                    else "bare Condition"
+                                found[key] = Finding(
+                                    pass_id=PASS_ID, path=sf.rel,
+                                    line=child.lineno, key=key,
+                                    message=(
+                                        f"raw threading.{kind}() for "
+                                        f"{tgt!r} in hot module (in "
+                                        f"{qual}) — use a named "
+                                        f"InstrumentedLock so the "
+                                        f"ledger and the lock-order "
+                                        f"witness can see it"))
+                walk(child, fs, parents + [child])
+
+        walk(sf.tree, [], [])
+        return list(found.values())

@@ -1,0 +1,429 @@
+"""Pass 1: static lock-order graph + cycle detection (ISSUE 14).
+
+The runtime witness (butil/lockprof.py) observes orders that actually
+executed; this pass extracts the orders the CODE permits.  It
+
+  1. identifies lock objects syntactically — ``InstrumentedLock("n")``
+     (canonical id: the shared ledger name), raw ``threading.Lock/
+     RLock`` and ``Condition`` (canonical id: ``module:Class.attr``),
+     and ``Condition(InstrumentedLock("n"))`` (the inner name) — bound
+     to ``self.attr`` or module/function variables;
+  2. summarises every function: which locks it acquires (``with l:``
+     spans and paired ``l.acquire()``/``l.release()`` calls) under
+     which statically-held set, and which repo functions it calls while
+     holding locks;
+  3. propagates transitively — a call made while holding A contributes
+     A -> L for every lock L the callee's transitive closure acquires.
+     Calls resolve conservatively: ``self.m()`` to the same class,
+     bare names to the same module, ``alias.f()`` through brpc_tpu
+     module imports, and ``obj.m()`` only when exactly one method of
+     that name exists in the module (else repo-wide unique) — an
+     unresolvable call contributes nothing rather than guessing;
+  4. reports every strongly-connected component of the resulting
+     lock-order graph with > 1 lock as a cycle finding, with the
+     source site that first contributed each edge.
+
+An under-approximation by construction (unresolved calls drop edges),
+so a reported cycle is worth believing; the committed baseline freezes
+any pre-existing ones.
+"""
+from __future__ import annotations
+
+import ast
+import re as _re
+import threading as _threading
+
+from brpc_tpu.check.base import (Finding, Repo, base_name, iter_functions,
+                                 last_segment)
+
+PASS_ID = "lock-order"
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+# method names that collide with builtin/stdlib types: `s.replace()` or
+# `pat.match()` must NEVER resolve to a same-named repo method through
+# the repo-wide-unique fallback — one such false edge fuses unrelated
+# lock clusters into a giant bogus SCC
+_BUILTIN_METHODS = (
+    set(dir(str)) | set(dir(bytes)) | set(dir(bytearray))
+    | set(dir(dict)) | set(dir(list)) | set(dir(set)) | set(dir(tuple))
+    | set(dir(frozenset)) | set(dir(int)) | set(dir(float))
+    | set(dir(_re.compile(""))) | set(dir(_re.match("", "")))
+    | set(dir(_threading.Thread)) | set(dir(_threading.Condition()))
+    | set(dir(Exception)))
+
+
+def _lock_ctor_id(call: ast.expr, rel: str, cls: str | None,
+                  target: str) -> str | None:
+    """Canonical lock id when `call` constructs a lock, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    seg = last_segment(call.func)
+    if seg == "InstrumentedLock":
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str):
+            return call.args[0].value
+        return f"{rel}:{cls + '.' if cls else ''}{target}"
+    if seg in _LOCK_CTORS:
+        base = base_name(call.func)
+        # accept threading.Lock() and bare Lock() (from-import); a
+        # dotted base other than `threading` is someone else's Lock
+        if not (base == "threading" or isinstance(call.func, ast.Name)):
+            return None
+        if seg == "Condition" and call.args:
+            inner = _lock_ctor_id(call.args[0], rel, cls, target)
+            if inner is not None:
+                return inner
+            # Condition(self._mu): same lock as the referenced attr —
+            # leave to the attr's own binding (alias unresolved here)
+            return None
+        return f"{rel}:{cls + '.' if cls else ''}{target}"
+    return None
+
+
+class _ModuleLocks:
+    """Lock bindings of one module: (class, attr) and bare names."""
+
+    def __init__(self, sf):
+        self.attr: dict[tuple[str | None, str], str] = {}
+        self.var: dict[str, str] = {}
+        for qual, cls, fn in [("<module>", None, sf.tree)] \
+                + iter_functions(sf.tree):
+            for node in ast.walk(fn) if fn is not sf.tree else \
+                    list(ast.iter_child_nodes(sf.tree)):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                t = node.targets[0]
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    lid = _lock_ctor_id(node.value, sf.rel, cls, t.attr)
+                    if lid is not None:
+                        self.attr[(cls, t.attr)] = lid
+                elif isinstance(t, ast.Name):
+                    lid = _lock_ctor_id(node.value, sf.rel, None, t.id)
+                    if lid is not None:
+                        self.var[t.id] = lid
+        # class-body assignments (rare) ride the walk above via
+        # iter_functions only for funcs; add module-tree class bodies
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                for st in node.body:
+                    if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                            and isinstance(st.targets[0], ast.Name):
+                        lid = _lock_ctor_id(st.value, sf.rel, node.name,
+                                            st.targets[0].id)
+                        if lid is not None:
+                            self.attr[(node.name, st.targets[0].id)] = lid
+
+
+def _module_imports(tree: ast.Module) -> dict[str, str]:
+    """alias -> brpc_tpu module rel path (best effort)."""
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("brpc_tpu"):
+                    out[a.asname or a.name.split(".")[-1]] = \
+                        a.name.replace(".", "/") + ".py"
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.startswith("brpc_tpu"):
+            for a in node.names:
+                cand = node.module.replace(".", "/") + "/" + a.name + ".py"
+                out[a.asname or a.name] = cand
+    return out
+
+
+class _FuncSummary:
+    __slots__ = ("key", "acquires", "calls")
+
+    def __init__(self, key):
+        self.key = key
+        # acquires: (lock_id, frozenset(held), "rel:line")
+        self.acquires: list[tuple[str, frozenset, str]] = []
+        # calls: (callee_name_info, frozenset(held), "rel:line")
+        self.calls: list[tuple[tuple, frozenset, str]] = []
+
+
+class _FuncWalker:
+    """Walks one function body in order, tracking the statically-held
+    lock set through `with` nesting and acquire()/release() pairs."""
+
+    def __init__(self, summary, locks: _ModuleLocks, cls, rel,
+                 imports: dict[str, str]):
+        self.s = summary
+        self.locks = locks
+        self.cls = cls
+        self.rel = rel
+        self.imports = imports
+        self.held: list[str] = []
+
+    def _resolve_lock(self, expr) -> str | None:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            lid = self.locks.attr.get((self.cls, expr.attr))
+            if lid is not None:
+                return lid
+            # single class defining that attr in this module
+            cands = {v for (c, a), v in self.locks.attr.items()
+                     if a == expr.attr}
+            return cands.pop() if len(cands) == 1 else None
+        if isinstance(expr, ast.Name):
+            return self.locks.var.get(expr.id)
+        return None
+
+    def _site(self, node) -> str:
+        return f"{self.rel}:{node.lineno}"
+
+    def _note_acquire(self, lid, node):
+        self.s.acquires.append((lid, frozenset(self.held),
+                                self._site(node)))
+
+    def _resolve_call(self, func) -> tuple | None:
+        if isinstance(func, ast.Name):
+            return ("local", self.rel, None, func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name):
+                if func.value.id == "self":
+                    return ("method", self.rel, self.cls, func.attr)
+                mod = self.imports.get(func.value.id)
+                if mod is not None:
+                    return ("local", mod, None, func.attr)
+            return ("unique", None, None, func.attr)
+        return None
+
+    def _scan_expr(self, node):
+        """Record calls inside an expression tree (held set applies),
+        skipping nested function/lambda bodies."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                seg = last_segment(sub.func)
+                if seg in ("acquire", "release"):
+                    continue        # handled as events by the caller
+                ref = self._resolve_call(sub.func)
+                if ref is not None and self.held:
+                    self.s.calls.append((ref, frozenset(self.held),
+                                         self._site(sub)))
+                elif ref is not None:
+                    self.s.calls.append((ref, frozenset(), self._site(sub)))
+
+    def walk(self, body: list[ast.stmt]):
+        for st in body:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt):
+        if isinstance(st, ast.With) or isinstance(st, ast.AsyncWith):
+            pushed = []
+            for item in st.items:
+                expr = item.context_expr
+                self._scan_expr(expr)
+                lid = self._resolve_lock(expr)
+                if lid is None and isinstance(expr, ast.Call):
+                    # with lock.acquire_timeout(...) style: ignore;
+                    # with self._mu: is the Name/Attribute case above
+                    lid = None
+                if lid is not None:
+                    self._note_acquire(lid, st)
+                    self.held.append(lid)
+                    pushed.append(lid)
+            for sub in st.body:
+                self._stmt(sub)
+            for lid in reversed(pushed):
+                self.held.remove(lid)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return          # nested defs are summarised separately
+        compound = any(getattr(st, a, None)
+                       for a in ("body", "orelse", "finalbody", "handlers"))
+        if compound:
+            # scan only the HEADER expression here; the blocks recurse
+            # below (scanning the whole subtree now would double-count
+            # events and pair locks across branches)
+            for header in ("test", "iter", "subject"):
+                expr = getattr(st, header, None)
+                if expr is not None:
+                    self._scan_expr(expr)
+            for attr in ("body", "orelse", "finalbody"):
+                for sub in getattr(st, attr, []):
+                    self._stmt(sub)
+            for h in getattr(st, "handlers", []):
+                for sub in h.body:
+                    self._stmt(sub)
+            return
+        # simple statement: acquire()/release() events + calls
+        for sub in ast.walk(st):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute):
+                if sub.func.attr == "acquire":
+                    lid = self._resolve_lock(sub.func.value)
+                    if lid is not None:
+                        self._note_acquire(lid, sub)
+                        self.held.append(lid)
+                elif sub.func.attr == "release":
+                    lid = self._resolve_lock(sub.func.value)
+                    if lid is not None and lid in self.held:
+                        self.held.remove(lid)
+        self._scan_expr(st)
+
+
+class LockOrderPass:
+    pass_id = PASS_ID
+    title = "static lock-order graph is acyclic"
+
+    def __init__(self, subdirs=("brpc_tpu",)):
+        self.subdirs = subdirs
+
+    def run(self, repo: Repo) -> list[Finding]:
+        files = [sf for sf in repo.files(self.subdirs)
+                 if sf.tree is not None]
+        mod_locks = {sf.rel: _ModuleLocks(sf) for sf in files}
+        summaries: dict[tuple, _FuncSummary] = {}
+        by_name: dict[str, list[tuple]] = {}
+        for sf in files:
+            imports = _module_imports(sf.tree)
+            for qual, cls, fn in iter_functions(sf.tree):
+                key = (sf.rel, cls, fn.name)
+                s = _FuncSummary(key)
+                w = _FuncWalker(s, mod_locks[sf.rel], cls, sf.rel, imports)
+                w.walk(fn.body)
+                # last summary of a key wins (overloads are rare and
+                # an either/or choice is fine for an under-approx)
+                summaries[key] = s
+                by_name.setdefault(fn.name, []).append(key)
+
+        def resolve(ref) -> tuple | None:
+            kind, rel, cls, name = ref
+            if kind == "method":
+                if (rel, cls, name) in summaries:
+                    return (rel, cls, name)
+                kind = "local"      # fall through: module function
+            if kind == "local":
+                if (rel, None, name) in summaries:
+                    return (rel, None, name)
+                cands = [k for k in by_name.get(name, ()) if k[0] == rel]
+                if len(cands) == 1:
+                    return cands[0]
+                return None
+            # unique: obj.m() — resolve only when m names exactly one
+            # function in the whole repo AND cannot be a builtin-type
+            # method (str.replace, pattern.match, thread.start ...)
+            if name in _BUILTIN_METHODS:
+                return None
+            cands = by_name.get(name, ())
+            return cands[0] if len(cands) == 1 else None
+
+        # transitive acquired-lock closure per function
+        closure: dict[tuple, set] = {}
+
+        def acq(key, stack) -> set:
+            got = closure.get(key)
+            if got is not None:
+                return got
+            if key in stack:
+                return set()        # recursion: partial is fine
+            stack = stack | {key}
+            out = set()
+            s = summaries[key]
+            for lid, _, _ in s.acquires:
+                out.add(lid)
+            for ref, _, _ in s.calls:
+                ck = resolve(ref)
+                if ck is not None:
+                    out |= acq(ck, stack)
+            closure[key] = out
+            return out
+
+        edges: dict[tuple, str] = {}    # (a,b) -> first site
+        for key, s in summaries.items():
+            for lid, held, site in s.acquires:
+                for h in held:
+                    if h != lid:
+                        edges.setdefault((h, lid), site)
+            for ref, held, site in s.calls:
+                if not held:
+                    continue
+                ck = resolve(ref)
+                if ck is None:
+                    continue
+                callee = (ck[1] + "." if ck[1] else "") + ck[2]
+                for lid in acq(ck, frozenset()):
+                    for h in held:
+                        if h != lid:
+                            edges.setdefault((h, lid),
+                                             f"{site} (via {callee})")
+
+        return _cycle_findings(edges)
+
+
+def _cycle_findings(edges: dict[tuple, str]) -> list[Finding]:
+    adj: dict[str, set] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    # Tarjan SCC, iterative
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v0):
+        work = [(v0, iter(sorted(adj[v0])))]
+        index[v0] = low[v0] = counter[0]
+        counter[0] += 1
+        stack.append(v0)
+        on.add(v0)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    out = []
+    for scc in sccs:
+        inner = [((a, b), site) for (a, b), site in sorted(edges.items())
+                 if a in scc and b in scc]
+        detail = "; ".join(f"{a}->{b} at {site}" for (a, b), site in inner)
+        site0 = inner[0][1] if inner else "?:0"
+        relpath, _, line = site0.partition(":")
+        try:
+            lineno = int(line.split()[0].rstrip(")"))
+        except ValueError:
+            lineno = 0
+        out.append(Finding(
+            pass_id=PASS_ID, path=relpath, line=lineno,
+            key=f"{PASS_ID}:cycle:" + "|".join(scc),
+            message=(f"lock-order cycle between {', '.join(scc)} — a "
+                     f"thread taking these in one order can deadlock a "
+                     f"thread taking the other ({detail})")))
+    return out

@@ -1,0 +1,45 @@
+"""brpc-check pass registry + orchestration (ISSUE 14)."""
+from __future__ import annotations
+
+import time
+
+from brpc_tpu.check.base import Finding, Repo
+from brpc_tpu.check.bounded_decode import BoundedDecodePass
+from brpc_tpu.check.fault_sites import FaultSitePass
+from brpc_tpu.check.jit_hot_path import JitHotPathPass
+from brpc_tpu.check.lock_hygiene import LockHygienePass
+from brpc_tpu.check.lock_order import LockOrderPass
+from brpc_tpu.check.wedge_hygiene import WedgeHygienePass
+
+
+def all_passes() -> list:
+    return [
+        LockOrderPass(),
+        BoundedDecodePass(),
+        JitHotPathPass(),
+        FaultSitePass(),
+        LockHygienePass(),
+        WedgeHygienePass(),
+    ]
+
+
+def run_checks(root: str, pass_ids=None):
+    """Run the suite; returns (findings, timings: {pass_id: seconds}).
+
+    A file that no longer parses is itself a finding (the tree must
+    fail the check, not crash it)."""
+    repo = Repo(root)
+    findings: list[Finding] = []
+    timings: dict[str, float] = {}
+    for p in all_passes():
+        if pass_ids and p.pass_id not in pass_ids:
+            continue
+        t0 = time.monotonic()
+        findings.extend(p.run(repo))
+        timings[p.pass_id] = time.monotonic() - t0
+    for rel, sf in sorted(repo._cache.items()):
+        if sf.parse_error is not None:
+            findings.append(Finding(
+                pass_id="parse", path=rel, line=0,
+                key=f"parse:{rel}", message=sf.parse_error))
+    return findings, timings

@@ -1,0 +1,89 @@
+"""Pass 6: wedge hygiene in tests (ISSUE 14).
+
+The PR 11/12/13 tier-1 wedge class: a ctypes entry into the native
+core intermittently never returns deep in a full run, and an unbounded
+``.join()`` behind it turns one wedged call into a hung suite.  The
+discipline (tests/wedge_guard.py) is: every direct native entry in a
+test module runs under a WedgeGuard deadline, and thread joins carry a
+timeout.  This pass flags, in tests/:
+
+  * ``.join()`` calls with no timeout (positional or keyword) — an
+    unbounded join is the amplifier that turns a wedge into a hang;
+    joins on server-shaped receivers (``srv``/``server``/...) are
+    exempt: ``Server.join()`` takes no timeout and is internally
+    bounded by ``graceful_quit_timeout_s``;
+  * direct native entries (``*.brpc_*`` attribute calls — the ctypes
+    surface of libbrpc_core) in modules that never touch WedgeGuard.
+"""
+from __future__ import annotations
+
+import ast
+
+from brpc_tpu.check.base import Finding, Repo, qualname_stack
+
+PASS_ID = "wedge-hygiene"
+
+
+class WedgeHygienePass:
+    pass_id = PASS_ID
+    title = "test joins are bounded; native entries ride WedgeGuard"
+
+    def __init__(self, subdirs=("tests",)):
+        self.subdirs = subdirs
+
+    def run(self, repo: Repo) -> list[Finding]:
+        out: list[Finding] = []
+        for sf in repo.files(self.subdirs):
+            if sf.tree is None or "/" in sf.rel.replace("tests/", "", 1) \
+                    or not sf.rel.split("/")[-1].startswith("test_"):
+                # only test modules proper (not fixtures/corpus dirs)
+                continue
+            out.extend(self._scan(sf))
+        return out
+
+    def _scan(self, sf) -> list[Finding]:
+        has_guard = "WedgeGuard" in sf.text
+        found: dict[str, Finding] = {}
+
+        def flag(node, qual, what, message):
+            key = f"{PASS_ID}:{sf.rel}:{qual}:{what}"
+            if key in found or sf.allowed(node.lineno, PASS_ID):
+                return
+            found[key] = Finding(pass_id=PASS_ID, path=sf.rel,
+                                 line=node.lineno, key=key, message=message)
+
+        def walk(node, func_stack):
+            for child in ast.iter_child_nodes(node):
+                fs = func_stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    fs = func_stack + [child.name]
+                if isinstance(child, ast.Call) and \
+                        isinstance(child.func, ast.Attribute):
+                    attr = child.func.attr
+                    qual = qualname_stack(func_stack)
+                    recv = child.func.value
+                    recv_name = recv.attr if isinstance(recv, ast.Attribute) \
+                        else (recv.id if isinstance(recv, ast.Name) else "")
+                    server_like = any(s in recv_name.lower()
+                                      for s in ("srv", "server", "router",
+                                                "replica"))
+                    if attr == "join" and not child.args and \
+                            not any(k.arg in ("timeout", None)
+                                    for k in child.keywords) and \
+                            not server_like:
+                        flag(child, qual, "join",
+                             f".join() with no timeout in {qual} — an "
+                             f"unbounded join turns one wedged native "
+                             f"call into a hung suite; pass a deadline "
+                             f"or use WedgeGuard.join_thread")
+                    elif attr.startswith("brpc_") and not has_guard:
+                        flag(child, qual, f"native:{attr}",
+                             f"direct native entry {attr} in {qual} "
+                             f"without a WedgeGuard in the module — a "
+                             f"wedged ctypes call must skip, not hang "
+                             f"(tests/wedge_guard.py)")
+                walk(child, fs)
+
+        walk(sf.tree, [])
+        return list(found.values())

@@ -17,9 +17,10 @@ radix-tree size, and eviction counters without holding stores alive.
 from __future__ import annotations
 
 import threading
+from brpc_tpu.butil.lockprof import InstrumentedLock
 import weakref
 
-_reg_mu = threading.Lock()
+_reg_mu = InstrumentedLock("kvcache.registry")
 _stores: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
 

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+from brpc_tpu.butil.lockprof import InstrumentedLock
 from typing import Optional, Sequence
 
 import numpy as np
@@ -104,12 +105,12 @@ class PagePool:
         self.pages_per_block = self.block_class // self.page_bytes
         self.max_blocks = int(max_blocks)
         self.name = name
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("kvcache.pool")
         # serializes _splice's read-modify-write: two concurrent
         # splices into sibling pages of ONE block would otherwise each
         # rebuild the block buffer from the same base and the loser's
         # write would vanish
-        self._io_mu = threading.Lock()
+        self._io_mu = InstrumentedLock("kvcache.pool_io")
         # block<->page table: block key -> the pages carved from it
         self._blocks: dict[tuple, tuple] = {}   # key -> (block, [pages])
         self._free: list[KVPage] = []

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+from brpc_tpu.butil.lockprof import InstrumentedLock
 from typing import Optional, Sequence
 
 from brpc_tpu import fault
@@ -46,7 +47,7 @@ class RadixTree:
         self.pagepool = pagepool
         self.page_tokens = pagepool.page_tokens
         self.name = name
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("kvcache.radix")
         self._root = _Node((), None, None)
         self._clock = itertools.count(1)
         self._nodes = 0

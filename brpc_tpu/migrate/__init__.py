@@ -26,10 +26,12 @@ kvcache_migrate_* counters without holding components alive.
 """
 from __future__ import annotations
 
-import threading
+import threading  # noqa: F401  (weakref tables below)
 import weakref
 
-_reg_mu = threading.Lock()
+from brpc_tpu.butil.lockprof import InstrumentedLock
+
+_reg_mu = InstrumentedLock("migrate.registry")
 _migrators: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
 _services: "weakref.WeakValueDictionary[int, object]" = \

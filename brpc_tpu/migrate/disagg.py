@@ -63,6 +63,7 @@ import numpy as np
 
 from brpc_tpu import errors, rpcz
 from brpc_tpu.butil import stagetag
+from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.ici.dcn import DcnChannel
 from brpc_tpu.migrate.plane import PageMigrator, register_migration
 from brpc_tpu.rpc.service import Service, method
@@ -101,7 +102,7 @@ class PrefillReplica:
                                      timeout_ms=timeout_ms)
         self.prefills = 0
         self.fallbacks = 0
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("migrate.prefill")
 
     def prefill(self, prompt: Sequence[int]) -> dict:
         """Run one prompt's prefill and ship its pages.  Returns the
@@ -329,7 +330,7 @@ class _StandbyGen:
         self.error_code = 0
         self.assumed = False
         self.trace = trace          # (trace_id, parent_span_id, sampled)
-        self.mu = threading.Lock()
+        self.mu = InstrumentedLock("migrate.standby_gen")
 
 
 class StandbyReplica:
@@ -342,7 +343,7 @@ class StandbyReplica:
         self.store = store
         self.engine = engine
         self.name = name
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("migrate.standby")
         self._gens: dict[int, _StandbyGen] = {}
         self.assumed_total = 0
         self.replayed_tokens = 0
@@ -645,7 +646,7 @@ class StandbySync:
         self._ch = DcnChannel(standby_addr, timeout_ms=timeout_ms)
         self.migrator = PageMigrator(store, name=f"{name}_migrator",
                                      timeout_ms=timeout_ms)
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("migrate.standby_sync")
         self._toks: dict[int, list[int]] = {}     # sid -> prompt+emitted
         self._shipped: dict[int, int] = {}        # sid -> full pages sent
         self._traces: dict[int, tuple] = {}
@@ -656,7 +657,8 @@ class StandbySync:
         # one ship worker: page exports are device reads + an RPC and
         # must not ride the emit path; jobs coalesce per sid to the
         # newest prefix
-        self._ship_cv = threading.Condition()
+        self._ship_cv = threading.Condition(
+            InstrumentedLock("migrate.ship"))
         self._ship_q: deque[int] = deque()
         self._ship_pending: set[int] = set()
         self._ship_inflight = 0     # jobs popped but not yet migrated
@@ -690,7 +692,7 @@ class StandbySync:
                              "budget": int(max_new_tokens),
                              "trace": list(trace)})
         self._enqueue_ship(sid)   # the prompt's own pages, once admitted
-        state_mu = threading.Lock()
+        state_mu = InstrumentedLock("migrate.sync_state")
         synced = [0]               # tokens the standby ACKED
         pending: list[int] = []    # emitted but not yet acked
 

@@ -52,6 +52,7 @@ import numpy as np
 
 from brpc_tpu import errors, fault, rpcz
 from brpc_tpu.butil import stagetag
+from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.bvar import Adder, LatencyRecorder
 from brpc_tpu.ici import dcn
 from brpc_tpu.kvcache.store import MissingShippedPrefix
@@ -105,7 +106,7 @@ class PageMigrator:
         self.store = store
         self.name = name
         self.timeout_ms = int(timeout_ms)
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("migrate.plane")
         self._chans: dict[str, dcn.DcnChannel] = {}
         # per-destination route matrix for the /migration console page
         self.routes: dict[str, dict] = {}
@@ -349,7 +350,7 @@ class MigrateService(Service):
         self.store = store
         self.migrator = migrator or PageMigrator(
             store, name=f"{store.name}_pusher")
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("migrate.service")
         # per-source route matrix (the inbound half of /migration)
         self.inbound: dict[str, dict] = {}
         from brpc_tpu import migrate as _migrate
@@ -519,7 +520,7 @@ def rebalance_pusher(timeout_ms: int = 10_000):
     wraps hook calls so one dead replica cannot wedge the remap)."""
     from brpc_tpu.rpc.channel import Channel
     chans: dict[str, Channel] = {}
-    mu = threading.Lock()
+    mu = InstrumentedLock("migrate.rebalance")
 
     def hook(tokens, old_ep, new_ep) -> int:
         src = str(old_ep)

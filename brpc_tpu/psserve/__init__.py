@@ -27,7 +27,9 @@ from __future__ import annotations
 import threading
 import weakref
 
-_mu = threading.Lock()
+from brpc_tpu.butil.lockprof import InstrumentedLock
+
+_mu = InstrumentedLock("psserve.registry")
 _shards: list = []      # weakrefs to (EmbeddingShardServer, PSService)
 _clients: list = []     # weakrefs to PSClient
 _tables: list = []      # weakrefs to ShardedEmbeddingTable

@@ -25,6 +25,7 @@ stable name hash.
 from __future__ import annotations
 
 import threading
+from brpc_tpu.butil.lockprof import InstrumentedLock
 import weakref
 import zlib
 from typing import Optional
@@ -62,7 +63,7 @@ LOOKUP_LATENCY = LatencyRecorder("psserve_client_lookup")
 # onto a live id.
 import os as _os
 
-_uid_mu = threading.Lock()
+_uid_mu = InstrumentedLock("psserve.uid")
 _uid_salt = int.from_bytes(_os.urandom(3), "big") & 0x3FFFF
 _uid_counter = [0]
 
@@ -130,7 +131,7 @@ class PSClient:
             self._lowered = backend
             self.n_shards = int(getattr(backend, "p", n_shards or 1))
         self.bounds = shard_bounds(self.vocab, self.n_shards)
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("psserve.client")
         # read-your-writes bookkeeping: highest acked version per shard
         self.acked_version = [0] * self.n_shards
         self.n_lookups = 0

@@ -36,6 +36,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from brpc_tpu.bvar import Adder
+from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.psserve.shard import (DEFAULT_KEY_BUCKETS, _bucket_up,
                                     init_embedding_table)
 
@@ -81,7 +82,7 @@ class ShardedEmbeddingTable:
         self.rows_per = self.vpad // self.p
         self._table = jax.device_put(
             full, NamedSharding(mesh, P("tp", None)))
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("psserve.table")
         self.version = 0
         self.n_lookups = 0
         self.n_updates = 0

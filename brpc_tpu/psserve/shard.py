@@ -31,6 +31,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from brpc_tpu.bvar import Adder
+from brpc_tpu.butil.lockprof import InstrumentedLock
 
 DEFAULT_KEY_BUCKETS = (8, 32, 128, 512)
 
@@ -129,7 +130,8 @@ class EmbeddingShardServer:
         self._dense: dict[str, np.ndarray] = {
             k: np.asarray(v, np.float32)
             for k, v in (dense_params or {}).items()}
-        self._mu = threading.RLock()
+        self._mu = InstrumentedLock("psserve.shard_apply",
+                                    threading.RLock())
         self.version = 0
         self._applied: OrderedDict[int, int] = OrderedDict()  # uid -> ver
         self._applied_cap = int(applied_cap)

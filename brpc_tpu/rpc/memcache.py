@@ -93,6 +93,11 @@ class Packet:
          cas) = HEADER.unpack_from(data)
         if len(data) < 24 + total:
             raise ValueError("truncated memcache packet")
+        # bounded decode: extras/key lengths are wire-controlled — when
+        # they exceed the body the slices below mis-split silently
+        # (extras swallows the value) instead of refusing the packet
+        if extraslen + keylen > total:
+            raise ValueError("memcache header lengths exceed body")
         p = cls()
         p.magic, p.opcode, p.status, p.opaque, p.cas = \
             magic, opcode, status, opaque, cas

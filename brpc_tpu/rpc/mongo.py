@@ -88,6 +88,11 @@ def bson_decode(data: bytes, pos: int = 0) -> tuple[dict, int]:
             p += 8
         elif etype == 0x02:
             n = struct.unpack_from("<i", data, p)[0]
+            # bounded decode: n is wire-controlled and SIGNED — a
+            # negative n walks p backwards (infinite loop), an oversize
+            # one silently short-reads past the doc
+            if n < 1 or p + 4 + n > end:
+                raise ValueError("bad bson string length")
             doc[key] = data[p + 4:p + 4 + n - 1].decode("utf-8", "replace")
             p += 4 + n
         elif etype == 0x03:
@@ -97,6 +102,8 @@ def bson_decode(data: bytes, pos: int = 0) -> tuple[dict, int]:
             doc[key] = [sub[k] for k in sorted(sub, key=int)]
         elif etype == 0x05:
             n = struct.unpack_from("<i", data, p)[0]
+            if n < 0 or p + 5 + n > end:
+                raise ValueError("bad bson binary length")
             doc[key] = data[p + 5:p + 5 + n]
             p += 5 + n
         elif etype == 0x08:

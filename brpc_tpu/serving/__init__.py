@@ -32,10 +32,11 @@ inter-token latency, prefill-skip, restart count — which the
 from __future__ import annotations
 
 import threading
+from brpc_tpu.butil.lockprof import InstrumentedLock
 import weakref
 from collections import deque
 
-_reg_mu = threading.Lock()
+_reg_mu = InstrumentedLock("serving.registry")
 _batchers: "weakref.WeakValueDictionary[str, object]" = \
     weakref.WeakValueDictionary()
 _engines: "weakref.WeakValueDictionary[str, object]" = \
@@ -95,7 +96,7 @@ def serving_snapshot() -> dict:
 # ---- recent-generation ring (the /serving/generations console page) ----
 
 _GEN_KEEP = 256
-_gen_mu = threading.Lock()
+_gen_mu = InstrumentedLock("serving.generations")
 _recent_gens: deque = deque(maxlen=_GEN_KEEP)
 
 

@@ -77,6 +77,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+from brpc_tpu.butil.lockprof import InstrumentedLock
 import time
 import uuid
 from collections import deque
@@ -316,7 +317,7 @@ class SessionTable:
     tail."""
 
     def __init__(self, *, keep_finished: int = 512):
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("router.sessions")
         self._sessions: dict[str, Session] = {}
         self._finished: deque = deque(maxlen=max(keep_finished, 1))
         self.keep_finished = int(keep_finished)
@@ -495,7 +496,7 @@ class ClusterRouter:
         self._ladder = OverloadLadder(ladder,
                                       hysteresis_ticks=hysteresis_ticks)
         self._applied_level = 0
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("router.state")
         self._failures: dict = {}        # endpoint -> [monotonic times]
         self._drivers: dict[str, threading.Thread] = {}
 
@@ -517,7 +518,8 @@ class ClusterRouter:
 
         # buddy replication worker (resume-over-migration): PushTo jobs
         # coalesce per session, never ride the token path
-        self._ship_cv = threading.Condition()
+        self._ship_cv = threading.Condition(
+            InstrumentedLock("router.ship"))
         self._ship_q: deque = deque()
         self._ship_pending: set[str] = set()
 

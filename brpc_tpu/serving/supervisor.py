@@ -65,6 +65,7 @@ from __future__ import annotations
 import itertools
 import re
 import threading
+from brpc_tpu.butil.lockprof import InstrumentedLock
 import time
 from typing import Callable, Optional, Sequence
 
@@ -173,7 +174,7 @@ class EngineSupervisor:
         self._restart_times: list[float] = []
         self._await_first_token_t: Optional[float] = None
 
-        self._mu = threading.Lock()
+        self._mu = InstrumentedLock("supervisor.state")
         self._live: dict[int, _SupReq] = {}      # sid -> request
         self._by_rid: dict[int, _SupReq] = {}    # engine req_id -> request
         self._closing = False
@@ -193,7 +194,8 @@ class EngineSupervisor:
 
         # engine handoff: _engine is None while a rebuild is in flight;
         # re-admissions wait on the condition instead of failing
-        self._ecv = threading.Condition()
+        self._ecv = threading.Condition(
+            InstrumentedLock("supervisor.engine"))
         self._engine = None
         self._wake = threading.Event()
         self._running = True

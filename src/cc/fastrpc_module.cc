@@ -21,6 +21,7 @@
 #include "butil/iobuf.h"
 #include "net/rpc.h"
 #include "net/socket.h"
+#include "spanq.h"
 
 namespace {
 
@@ -360,68 +361,42 @@ PyObject* py_iobuf_bytes(PyObject*, PyObject* args) {
 // bthread's ExecutionQueue producer half — a drain-side-serialized MPSC
 // stack — holding PyObject* instead of nodes on an Executor.
 
-struct SpanNode {
-  PyObject* obj;
-  SpanNode* next;
-};
-
-std::atomic<SpanNode*> g_span_head{nullptr};
-std::atomic<int64_t> g_span_pending{0};
+// The stack itself lives in spanq.h (ISSUE 14) so `make tsan`'s ring
+// stress exercises the exact producer/drain algorithm without Python.
+brpc_spanq::Stack g_spanq;
 
 PyObject* py_spanq_push(PyObject*, PyObject* arg) {
   Py_INCREF(arg);
-  auto* n = new SpanNode{arg, nullptr};
-  SpanNode* old = g_span_head.load(std::memory_order_relaxed);
-  do {
-    n->next = old;
-  } while (!g_span_head.compare_exchange_weak(old, n,
-                                              std::memory_order_release,
-                                              std::memory_order_relaxed));
-  g_span_pending.fetch_add(1, std::memory_order_relaxed);
+  g_spanq.push(arg);
   Py_RETURN_NONE;
 }
 
 PyObject* py_spanq_drain(PyObject*, PyObject*) {
-  SpanNode* head = g_span_head.exchange(nullptr, std::memory_order_acquire);
-  // reverse to FIFO so the collector observes submission order
-  SpanNode* prev = nullptr;
-  Py_ssize_t count = 0;
-  while (head != nullptr) {
-    SpanNode* next = head->next;
-    head->next = prev;
-    prev = head;
-    head = next;
-    ++count;
-  }
-  g_span_pending.fetch_sub(count, std::memory_order_relaxed);
-  PyObject* out = PyList_New(count);
+  int64_t count = 0;
+  brpc_spanq::Node* chain = g_spanq.drain_fifo(&count);
+  PyObject* out = PyList_New((Py_ssize_t)count);
   if (out == nullptr) {
-    // push the reversed chain back so the spans are not lost (order
-    // within this failed batch is preserved relative to itself)
-    while (prev != nullptr) {
-      SpanNode* next = prev->next;
-      prev->next = g_span_head.load(std::memory_order_relaxed);
-      while (!g_span_head.compare_exchange_weak(
-          prev->next, prev, std::memory_order_release,
-          std::memory_order_relaxed)) {
-      }
-      g_span_pending.fetch_add(1, std::memory_order_relaxed);
-      prev = next;
+    // push the chain back so the spans are not lost (order within
+    // this failed batch is preserved relative to itself)
+    while (chain != nullptr) {
+      brpc_spanq::Node* next = chain->next;
+      g_spanq.push_node(chain);
+      chain = next;
     }
     return nullptr;
   }
   Py_ssize_t i = 0;
-  while (prev != nullptr) {
-    PyList_SET_ITEM(out, i++, prev->obj);  // steals the push's ref
-    SpanNode* next = prev->next;
-    delete prev;
-    prev = next;
+  while (chain != nullptr) {
+    PyList_SET_ITEM(out, i++, (PyObject*)chain->obj);  // steals the ref
+    brpc_spanq::Node* next = chain->next;
+    delete chain;
+    chain = next;
   }
   return out;
 }
 
 PyObject* py_spanq_pending(PyObject*, PyObject*) {
-  return PyLong_FromLongLong(g_span_pending.load(std::memory_order_relaxed));
+  return PyLong_FromLongLong(g_spanq.count());
 }
 
 // ---- native batch assembly + token-ring fast entries (ISSUE 9) ----

@@ -1,0 +1,64 @@
+// Lock-free MPSC span queue (ISSUE 9's Treiber stack, extracted in
+// ISSUE 14 so the SAME producer/drain algorithm the Python extension
+// runs (fastrpc_module.cc py_spanq_*) is exercisable under
+// -fsanitize=thread without linking Python — src/cc/test/
+// ring_stress_main.cc churns it beside the TokenRing (`make tsan`).
+//
+// Shape: many producers CAS-push nodes (release); one drainer
+// exchanges the whole stack (acquire) and reverses to FIFO.  Payloads
+// are opaque void* — the extension stores PyObject* (incref'd under
+// the GIL before push, ref stolen by the drained list).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace brpc_spanq {
+
+struct Node {
+  void* obj;
+  Node* next;
+};
+
+struct Stack {
+  std::atomic<Node*> head{nullptr};
+  std::atomic<int64_t> pending{0};
+
+  // Re-link an existing node (the drain failure path re-pushes a
+  // detached chain without reallocating).
+  void push_node(Node* n) {
+    Node* old = head.load(std::memory_order_relaxed);
+    do {
+      n->next = old;
+    } while (!head.compare_exchange_weak(old, n, std::memory_order_release,
+                                         std::memory_order_relaxed));
+    pending.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void push(void* obj) { push_node(new Node{obj, nullptr}); }
+
+  // Detach everything and reverse to FIFO submission order.  The
+  // caller owns the returned chain (and must delete its nodes);
+  // `pending` drops by the returned count.
+  Node* drain_fifo(int64_t* count_out = nullptr) {
+    Node* h = head.exchange(nullptr, std::memory_order_acquire);
+    Node* prev = nullptr;
+    int64_t count = 0;
+    while (h != nullptr) {
+      Node* next = h->next;
+      h->next = prev;
+      prev = h;
+      h = next;
+      ++count;
+    }
+    pending.fetch_sub(count, std::memory_order_relaxed);
+    if (count_out != nullptr) *count_out = count;
+    return prev;
+  }
+
+  int64_t count() const {
+    return pending.load(std::memory_order_relaxed);
+  }
+};
+
+}  // namespace brpc_spanq

@@ -244,6 +244,79 @@ def test_corrupt_h2_frame_definite_outcome(seed):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+def test_recv_drop_definite_outcome(seed):
+    """`transport.recv` / `h2.recv` DROP (ISSUE 14: the fault-sites
+    pass found both sites with ZERO referencing tests — injection
+    surface that silently stopped being exercised).  transport.recv
+    sees the Python message trampoline (stream traffic — the fault.py
+    caveat: unary rides the C fast path), so the scenario drops one
+    stream FEEDBACK frame at the TRANSPORT level and the cumulative-
+    offset healing of scenario 8 must still hold; h2.recv drops one
+    h2 frame on a live gRPC connection -> definite outcome, then the
+    connection recovers."""
+    N, MSG = 6, 512
+    StreamSink.received = []
+    StreamSink.got_all = threading.Event()
+    StreamSink.want = 2 * N
+    srv = brpc.Server()
+    srv.add_service(StreamSink())
+    srv.start("127.0.0.1", 0)
+    try:
+        ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+        cntl = brpc.Controller()
+        stream = brpc.stream_create(cntl, None, max_buf_size=8192)
+        assert ch.call_sync("ChaosStream", "Open", {}, serializer="json",
+                            cntl=cntl) == {"ok": True}
+        # one stream frame swallowed BELOW the stream layer, at the
+        # client transport's recv trampoline — scoped by sid to the
+        # CLIENT connection, where the only trampoline traffic is the
+        # server's CONSUMED feedback; loss heals via the next
+        # cumulative offset exactly like scenario 8
+        client_sid = stream._sid
+        plan = fault.FaultPlan(seed).on(
+            "transport.recv", fault.DROP, times=1,
+            match=lambda ctx: ctx.get("sid") == client_sid)
+        with fault.injected(plan):
+            for i in range(N):
+                stream.write(bytes([i]) * MSG, timeout_s=10)
+            assert wait_until(lambda: len(StreamSink.received) >= N, 10), \
+                f"only {len(StreamSink.received)}/{N} delivered"
+            assert plan.injected["transport.recv"] == 1
+            for i in range(N):
+                stream.write(bytes([N + i]) * MSG, timeout_s=10)
+            assert StreamSink.got_all.wait(10), \
+                f"only {len(StreamSink.received)}/{2 * N} delivered"
+            assert wait_until(
+                lambda: stream._produced - stream._remote_consumed == 0,
+                10), "credit lost with the transport-dropped feedback " \
+                     "frame never returned"
+        stream.close()
+    finally:
+        srv.stop()
+        srv.join()
+
+    # the h2 layer's own recv site, over a live gRPC connection
+    from brpc_tpu.rpc.h2 import GrpcChannel
+    srv = brpc.Server()
+    srv.add_service(GrpcEcho())
+    srv.start("127.0.0.1", 0)
+    try:
+        gch = GrpcChannel(f"127.0.0.1:{srv.port}", timeout_ms=2000)
+        assert gch.call("chaos.Grpc", "Echo", b"warm") == b"warm"
+        plan2 = fault.FaultPlan(seed).on("h2.recv", fault.DROP, times=1)
+        with fault.injected(plan2):
+            try:
+                gch.call("chaos.Grpc", "Echo", b"payload", timeout_ms=2000)
+            except errors.RpcError:
+                pass               # dropped frame -> definite error
+        assert plan2.injected["h2.recv"] == 1
+        assert gch.call("chaos.Grpc", "Echo", b"after") == b"after"
+    finally:
+        srv.stop()
+        srv.join()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 def test_injected_write_error_does_not_leak_sockets(server, seed):
     """A plain injected write error (rc=-1, socket left open by the
     fault) must not leak the evicted connection: the retry path fails

@@ -1,0 +1,566 @@
+"""brpc-check suite + lock-order witness tests (ISSUE 14).
+
+Each static pass gets a positive/negative synthetic fixture proving it
+fires exactly on its seeded violation; the runtime witness tests prove
+a live two-thread ABBA is flagged while ordered nesting stays silent,
+and that a wedge-guard deadline miss dumps held-lock state.  The
+repo-self-check test runs the full suite against the committed
+CHECK_BASELINE.json, making `make check`'s guarantee a tier-1 fact.
+"""
+import json
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from brpc_tpu.butil import lockprof
+from brpc_tpu.check import run_checks
+from brpc_tpu.check.base import Repo
+from brpc_tpu.check.baseline import (load_baseline, split_findings,
+                                     write_baseline)
+from brpc_tpu.check.bounded_decode import BoundedDecodePass
+from brpc_tpu.check.fault_sites import FaultSitePass, render_registry
+from brpc_tpu.check.jit_hot_path import JitHotPathPass
+from brpc_tpu.check.lock_hygiene import LockHygienePass
+from brpc_tpu.check.lock_order import LockOrderPass
+from brpc_tpu.check.wedge_hygiene import WedgeHygienePass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_repo(tmp_path, files: dict) -> Repo:
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return Repo(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# pass 1: lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_order_flags_seeded_abba_cycle(tmp_path):
+    repo = make_repo(tmp_path, {"brpc_tpu/mod.py": """
+        import threading
+        from brpc_tpu.butil.lockprof import InstrumentedLock
+
+        class S:
+            def __init__(self):
+                self.a = InstrumentedLock("fix.a")
+                self.b = InstrumentedLock("fix.b")
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """})
+    out = LockOrderPass().run(repo)
+    assert len(out) == 1
+    assert "fix.a" in out[0].message and "fix.b" in out[0].message
+    assert out[0].key.startswith("lock-order:cycle:")
+
+
+def test_lock_order_interprocedural_cycle_and_ordered_silent(tmp_path):
+    # the cycle closes only ACROSS a call: one() holds a and calls
+    # helper() which takes b; two() holds b then takes a directly
+    repo = make_repo(tmp_path, {"brpc_tpu/mod.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def helper(self):
+                with self.b:
+                    pass
+
+            def one(self):
+                with self.a:
+                    self.helper()
+
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """})
+    out = LockOrderPass().run(repo)
+    assert len(out) == 1 and "via" in out[0].message
+
+    repo2 = make_repo(tmp_path / "ordered", {"brpc_tpu/mod.py": """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def also_ordered(self):
+                self.a.acquire()
+                try:
+                    with self.b:
+                        pass
+                finally:
+                    self.a.release()
+    """})
+    assert LockOrderPass().run(repo2) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: bounded-decode
+# ---------------------------------------------------------------------------
+
+_WIRE_BAD = """
+    import struct
+    import numpy as np
+
+    def parse(data):
+        n = struct.unpack("<I", data[:4])[0]
+        payload = data[4:4 + n]
+        return payload
+
+    def alloc(data):
+        n = int.from_bytes(data[:4], "little")
+        return bytearray(n)
+"""
+
+_WIRE_GOOD = """
+    import struct
+    import numpy as np
+
+    def parse(data):
+        n = struct.unpack("<I", data[:4])[0]
+        if 4 + n > len(data):
+            raise ValueError("truncated")
+        payload = data[4:4 + n]
+        return payload
+
+    def alloc(data):
+        n = int.from_bytes(data[:4], "little")
+        return bytearray(min(n, 65536))
+"""
+
+
+def test_bounded_decode_flags_unchecked_wire_length(tmp_path):
+    repo = make_repo(tmp_path, {"pkg/wire.py": _WIRE_BAD})
+    out = BoundedDecodePass(modules=("pkg/wire.py",)).run(repo)
+    kinds = {f.key.rsplit(":", 2)[-2:][0] for f in out}
+    assert len(out) == 2                       # slice in parse, alloc
+    assert {"parse", "alloc"} == kinds
+    assert all(f.pass_id == "bounded-decode" for f in out)
+
+
+def test_bounded_decode_silent_when_checked_or_bounded(tmp_path):
+    repo = make_repo(tmp_path, {"pkg/wire.py": _WIRE_GOOD})
+    assert BoundedDecodePass(modules=("pkg/wire.py",)).run(repo) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 3: jit-in-hot-path
+# ---------------------------------------------------------------------------
+
+def test_jit_hot_path_flags_per_call_jit_only(tmp_path):
+    repo = make_repo(tmp_path, {"brpc_tpu/mod.py": """
+        import jax
+        from jax.experimental.shard_map import shard_map
+
+        STEP = jax.jit(lambda x: x + 1)          # module level: fine
+
+        class Engine:
+            def __init__(self):
+                self._fn = jax.jit(self._step)   # bucketed init: fine
+
+            def _step(self, x):
+                return x
+
+            def hot(self, x):
+                f = jax.jit(lambda y: y * 2)     # per call: FLAGGED
+                return f(x)
+
+        def build_program(mesh):
+            return shard_map(lambda x: x, mesh)  # builder: fine
+    """})
+    out = JitHotPathPass().run(repo)
+    assert len(out) == 1
+    assert "Engine.hot" in out[0].key and out[0].pass_id == "jit-hot-path"
+
+
+# ---------------------------------------------------------------------------
+# pass 4: fault-site registry
+# ---------------------------------------------------------------------------
+
+def _fault_repo(tmp_path, *, with_test=True, registry=True, extra_reg=""):
+    files = {"brpc_tpu/mod.py": """
+        from brpc_tpu import fault
+
+        def op():
+            if fault.ENABLED and fault.hit("fix.site") is not None:
+                raise RuntimeError
+    """}
+    if with_test:
+        files["tests/test_fix.py"] = """
+        def test_site():
+            assert "fix.site"
+        """
+    repo = make_repo(tmp_path, files)
+    if registry:
+        reg = render_registry(repo) + extra_reg
+        p = tmp_path / "docs" / "fault_sites.md"
+        p.parent.mkdir(exist_ok=True)
+        p.write_text(reg)
+    return repo
+
+
+def test_fault_sites_clean_when_registered_and_tested(tmp_path):
+    repo = _fault_repo(tmp_path)
+    assert FaultSitePass().run(repo) == []
+
+
+def test_fault_sites_flags_unregistered_orphaned_untested(tmp_path):
+    # unknown: site in code, registry generated WITHOUT it
+    repo = _fault_repo(tmp_path, registry=False)
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "fault_sites.md").write_text(
+        "| site | defined in | referencing tests |\n|---|---|---|\n"
+        "| `ghost.site` | brpc_tpu/gone.py | test_fix |\n")
+    keys = {f.key for f in FaultSitePass().run(repo)}
+    assert "fault-sites:unknown:fix.site" in keys
+    assert "fault-sites:orphaned:ghost.site" in keys
+
+    # untested: registered but no referencing test
+    repo2 = _fault_repo(tmp_path / "untested", with_test=False)
+    keys2 = {f.key for f in FaultSitePass().run(repo2)}
+    assert "fault-sites:untested:fix.site" in keys2
+
+    # missing registry entirely
+    repo3 = _fault_repo(tmp_path / "noreg", registry=False)
+    keys3 = {f.key for f in FaultSitePass().run(repo3)}
+    assert "fault-sites:missing-registry" in keys3
+
+
+# ---------------------------------------------------------------------------
+# pass 5: lock hygiene
+# ---------------------------------------------------------------------------
+
+def test_lock_hygiene_flags_raw_lock_not_instrumented(tmp_path):
+    repo = make_repo(tmp_path, {"brpc_tpu/serving/mod.py": """
+        import threading
+        from brpc_tpu.butil.lockprof import InstrumentedLock
+
+        class Hot:
+            def __init__(self):
+                self._raw = threading.Lock()                  # FLAGGED
+                self._cv = threading.Condition()              # FLAGGED
+                self._ok = InstrumentedLock("fix.ok")
+                self._rok = InstrumentedLock("fix.rok",
+                                             threading.RLock())
+                self._cok = threading.Condition(
+                    InstrumentedLock("fix.cok"))
+    """})
+    out = LockHygienePass().run(repo)
+    targets = {f.key.rsplit(":", 1)[-1] for f in out}
+    assert targets == {"_raw", "_cv"}
+    assert all(f.pass_id == "lock-hygiene" for f in out)
+
+
+# ---------------------------------------------------------------------------
+# pass 6: wedge hygiene
+# ---------------------------------------------------------------------------
+
+def test_wedge_hygiene_flags_guardless_join_and_native(tmp_path):
+    repo = make_repo(tmp_path, {"tests/test_fix.py": """
+        import threading
+        from brpc_tpu._core.lib import load
+
+        lib = load()
+
+        def test_burn():
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join()                     # FLAGGED: unbounded
+            lib.brpc_rpc_counters(0)     # FLAGGED: module has no guard
+
+        def test_bounded(srv):
+            t = threading.Thread(target=lambda: None)
+            t.start()
+            t.join(5)                    # fine
+            t.join(timeout=5)            # fine
+            srv.join()                   # fine: Server.join is bounded
+    """})
+    out = WedgeHygienePass().run(repo)
+    whats = {f.key.split(":", 3)[-1] for f in out}
+    assert whats == {"join", "native:brpc_rpc_counters"}
+    assert all(":test_burn:" in f.key for f in out)
+
+    # same module WITH a WedgeGuard: native call no longer flagged
+    repo2 = make_repo(tmp_path / "guarded", {"tests/test_fix.py": """
+        from wedge_guard import WedgeGuard
+        GUARD = WedgeGuard("native", deadline_s=60)
+
+        def test_burn(lib):
+            GUARD.deadline(lib.brpc_rpc_counters, 0)
+    """})
+    assert WedgeHygienePass().run(repo2) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+def test_baseline_freezes_old_flags_new_reports_stale(tmp_path):
+    repo = make_repo(tmp_path, {"pkg/wire.py": _WIRE_BAD})
+    findings = BoundedDecodePass(modules=("pkg/wire.py",)).run(repo)
+    assert len(findings) == 2
+    path = str(tmp_path / "BASE.json")
+    write_baseline(path, findings[:1])
+    base = load_baseline(path)
+    new, suppressed, stale = split_findings(findings, base)
+    assert len(new) == 1 and len(suppressed) == 1 and stale == []
+    # the frozen finding stops firing -> reported stale, never hidden
+    new2, sup2, stale2 = split_findings(findings[1:], base)
+    assert len(new2) == 1 and sup2 == [] and len(stale2) == 1
+
+
+def test_repo_self_check_is_clean_against_committed_baseline():
+    """`make check`'s guarantee as a tier-1 fact: the tree as committed
+    has NO non-baseline findings, the semantic passes are baseline-
+    EMPTY (all frozen findings are hygiene-pass debt), and the suite
+    stays well inside its 30s budget."""
+    t0 = time.monotonic()
+    findings, timings = run_checks(REPO_ROOT)
+    took = time.monotonic() - t0
+    base = load_baseline(os.path.join(REPO_ROOT, "CHECK_BASELINE.json"))
+    new, suppressed, _stale = split_findings(findings, base)
+    assert new == [], "new brpc-check findings:\n" + \
+        "\n".join(str(f) for f in new)
+    assert set(timings) == {"lock-order", "bounded-decode", "jit-hot-path",
+                            "fault-sites", "lock-hygiene", "wedge-hygiene"}
+    for key in base:
+        assert key.split(":")[0] in ("lock-hygiene", "wedge-hygiene"), \
+            f"semantic-pass finding frozen in baseline: {key}"
+    assert took < 30, f"brpc-check took {took:.1f}s (budget 30s)"
+
+
+def test_cli_json_output_shape():
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "brpc_check.py"),
+         "--json", "--pass", "lock-order"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    data = json.loads(out.stdout)
+    assert data["counts"]["new"] == 0
+    assert "lock-order" in data["timings_s"]
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def fresh_witness():
+    lockprof.reset_witness()
+    yield
+    lockprof.reset_witness()
+
+
+def test_witness_flags_two_thread_abba(fresh_witness):
+    """Opposite acquisition orders across two threads close a cycle —
+    flagged from the order history alone, NO actual deadlock needed."""
+    a = lockprof.InstrumentedLock("tcw.a")
+    b = lockprof.InstrumentedLock("tcw.b")
+    with a:
+        with b:
+            pass
+    done = threading.Event()
+
+    def other():
+        with b:
+            with a:
+                pass
+        done.set()
+
+    t = threading.Thread(target=other, daemon=True)
+    t.start()
+    assert done.wait(10)
+    t.join(10)
+    viols = [v for v in lockprof.order_violations()
+             if set(v["cycle"]) == {"tcw.a", "tcw.b"}]
+    assert len(viols) == 1
+    v = viols[0]
+    assert v["edge"] == ["tcw.a", "tcw.b"] or v["edge"] == ["tcw.b", "tcw.a"]
+    assert "test_check.py" in v["site"]
+    assert set(v["edge_sites"]) == {"tcw.a->tcw.b", "tcw.b->tcw.a"}
+    # duplicate observations never double-report
+    with b:
+        with a:
+            pass
+    assert len([v for v in lockprof.order_violations()
+                if set(v["cycle"]) == {"tcw.a", "tcw.b"}]) == 1
+
+
+def test_witness_silent_on_ordered_nesting(fresh_witness):
+    a = lockprof.InstrumentedLock("tcw.oa")
+    b = lockprof.InstrumentedLock("tcw.ob")
+
+    def worker():
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join(20) for t in ts]
+    assert lockprof.order_violations() == []
+    assert "tcw.oa->tcw.ob" in lockprof.lock_order_edges()
+
+
+def test_witness_condition_reacquire_accounted(fresh_witness):
+    """Condition.wait over an InstrumentedLock keeps the held set
+    coherent (released during the wait, re-held after)."""
+    outer = lockprof.InstrumentedLock("tcw.outer")
+    cv = threading.Condition(lockprof.InstrumentedLock("tcw.cvl"))
+    with outer:
+        with cv:
+            cv.wait(0.01)
+    assert lockprof.order_violations() == []
+    snap = lockprof.held_locks_snapshot()
+    for row in snap.values():
+        assert "tcw.cvl" not in row["held"]
+
+
+def test_witness_snapshot_shows_held_and_waiting(fresh_witness):
+    lock = lockprof.InstrumentedLock("tcw.held")
+    other = lockprof.InstrumentedLock("tcw.wanted")
+    holding = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with other:
+            holding.set()
+            release.wait(10)
+
+    def blocked():
+        with lock:
+            holding.wait(10)
+            with other:        # parks behind holder
+                pass
+
+    t1 = threading.Thread(target=holder, name="tcw-holder", daemon=True)
+    t2 = threading.Thread(target=blocked, name="tcw-blocked", daemon=True)
+    t1.start()
+    t2.start()
+    assert holding.wait(10)
+    deadline = time.monotonic() + 10
+    snap = {}
+    while time.monotonic() < deadline:
+        snap = lockprof.held_locks_snapshot()
+        row = snap.get("tcw-blocked")
+        if row and row["waiting_for"] == "tcw.wanted":
+            break
+        time.sleep(0.01)
+    assert snap["tcw-blocked"]["held"] == ["tcw.held"]
+    assert snap["tcw-blocked"]["waiting_for"] == "tcw.wanted"
+    assert snap["tcw-holder"]["held"] == ["tcw.wanted"]
+    release.set()
+    t1.join(10)
+    t2.join(10)
+
+
+def test_wedge_guard_timeout_dumps_held_locks(fresh_witness, capsys):
+    """The acceptance scenario: a synthetic ABBA DEADLOCK wedges a
+    guarded call past its deadline -> the guard SKIPS (bounded suite)
+    and dumps every thread's held locks + the witness's cycle to
+    stderr — the PR 11 silent-hang class now leaves evidence."""
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from wedge_guard import WedgeGuard
+
+    a = lockprof.InstrumentedLock("tcw.da")
+    b = lockprof.InstrumentedLock("tcw.db")
+    got_a = threading.Event()
+    got_b = threading.Event()
+
+    def left():
+        with a:
+            got_a.set()
+            got_b.wait(30)
+            with b:            # deadlocks against right()
+                pass
+
+    t_left = threading.Thread(target=left, name="tcw-left", daemon=True)
+    t_left.start()
+
+    def right():
+        with b:
+            got_b.set()
+            got_a.wait(30)
+            with a:            # deadlocks against left()
+                pass
+
+    guard = WedgeGuard("synthetic abba", deadline_s=1.0)
+    t_right = guard.start_thread(right)
+    with pytest.raises(pytest.skip.Exception) as si:
+        guard.join_thread(t_right, what="synthetic abba")
+    assert "wedged past" in str(si.value)
+    assert guard.wedged
+    err = capsys.readouterr().err
+    assert "lock-order witness dump" in err
+    assert "tcw.da" in err and "tcw.db" in err
+    assert "BLOCKED acquiring" in err
+    # the witness ALSO flagged the cycle itself (edges recorded at
+    # acquire-attempt time — a deadlock that never completes its second
+    # acquire still closes the graph)
+    viols = [v for v in lockprof.order_violations()
+             if set(v["cycle"]) == {"tcw.da", "tcw.db"}]
+    assert len(viols) == 1
+    # a subsequent guarded call short-circuits instead of hanging
+    with pytest.raises(pytest.skip.Exception):
+        guard.deadline(lambda: None)
+
+
+def test_witness_reregisters_threads_after_reset(fresh_witness):
+    """Review-pass regression: reset_witness() clears the held-set
+    table, and a thread whose thread-local list PREDATES the reset
+    must re-register on its next acquisition — otherwise every
+    post-reset wedge dump reads '(none held)' exactly when the
+    diagnostic matters."""
+    lock = lockprof.InstrumentedLock("tcw.rr")
+    with lock:
+        pass                       # main thread's TLS list now exists
+    lockprof.reset_witness()
+    with lock:
+        snap = lockprof.held_locks_snapshot()
+        assert any("tcw.rr" in row["held"] for row in snap.values()), snap
+
+
+def test_witness_report_renders_cycles(fresh_witness):
+    a = lockprof.InstrumentedLock("tcw.ra")
+    b = lockprof.InstrumentedLock("tcw.rb")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = lockprof.witness_report()
+    assert "ABBA violations: 1" in rep
+    assert "tcw.ra" in rep and "tcw.rb" in rep
+    assert "first seen at" in rep
+    lockprof.reset_witness()
+    assert "ABBA violations: none" in lockprof.witness_report()

@@ -79,6 +79,54 @@ def test_fuzz_bson():
             pass
 
 
+def test_bson_negative_string_length_terminates():
+    """brpc-check bounded-decode regression (ISSUE 14): a crafted 0x02
+    string element with a NEGATIVE length walked the cursor backwards —
+    `p += 4 + n` with n <= -6 nets zero forward progress per element,
+    an infinite parse loop off 20 wire bytes.  Oversize lengths
+    silently short-read past the doc instead of refusing."""
+    from brpc_tpu.rpc import mongo
+    # doc: [i32 size][0x02 "k\x00" [i32 n=-6] ...][0x00 terminator]
+    body = b"\x02k\x00" + struct.pack("<i", -6) + b"abcd"
+    doc = struct.pack("<i", 4 + len(body) + 1) + body + b"\x00"
+    with pytest.raises(ValueError):
+        mongo.bson_decode(doc)          # must raise, never spin
+    # oversize: n far past the doc end must refuse, not short-read
+    body = b"\x02k\x00" + struct.pack("<i", 1 << 30) + b"ab\x00"
+    doc = struct.pack("<i", 4 + len(body) + 1) + body + b"\x00"
+    with pytest.raises(ValueError):
+        mongo.bson_decode(doc)
+    # same contract for 0x05 binary lengths
+    body = b"\x05k\x00" + struct.pack("<i", -1) + b"\x00ab"
+    doc = struct.pack("<i", 4 + len(body) + 1) + body + b"\x00"
+    with pytest.raises(ValueError):
+        mongo.bson_decode(doc)
+    # a well-formed doc still round-trips
+    ok = mongo.bson_encode({"s": "hello", "b": b"\x01\x02"})
+    decoded, _ = mongo.bson_decode(ok)
+    assert decoded == {"s": "hello", "b": b"\x01\x02"}
+
+
+def test_memcache_header_lengths_bounded():
+    """brpc-check bounded-decode regression (ISSUE 14): extras/key
+    lengths exceeding the body made Packet.parse mis-split silently
+    (extras swallowed the value); it must refuse the packet."""
+    from brpc_tpu.rpc import memcache
+    valid = memcache.pack_packet(0x80, 0x01, b"k", b"\x00" * 8, b"v")
+    parsed = memcache.Packet.parse(valid)
+    assert parsed.key == b"k" and parsed.value == b"v"
+    # keylen lies: points past the body
+    bad = bytearray(valid)
+    struct.pack_into(">H", bad, 2, 0xFFFF)
+    with pytest.raises(ValueError):
+        memcache.Packet.parse(bytes(bad))
+    # extraslen lies
+    bad = bytearray(valid)
+    bad[4] = 0xFF
+    with pytest.raises(ValueError):
+        memcache.Packet.parse(bytes(bad))
+
+
 def test_fuzz_mongo_service_handle_bytes():
     from brpc_tpu.rpc import mongo
     svc = brpc.MongoService()

@@ -544,10 +544,17 @@ def test_hotspots_pages_show_live_serving_attribution(server):
         text = body.decode()
         assert "batcher.queue" in text
         status, body = _get(server, "/hotspots/locks?fmt=json")
-        snap = json.loads(body)
+        payload = json.loads(body)
+        # ISSUE 14: the json page nests the ledger beside the
+        # lock-order witness (held sets, order edges, ABBA violations)
+        snap = payload["ledger"]
         assert snap["batcher.queue"]["acquisitions"] > 0
         assert "wait_p99_us" in snap["batcher.queue"]
         assert "hold_avg_us" in snap["batcher.queue"]
+        wit = payload["witness"]
+        assert wit["enabled"] is True
+        assert isinstance(wit["edges"], dict)
+        assert wit["violations"] == []     # serving stack stays acyclic
     finally:
         stop.set()
         [t.join(15) for t in ts]

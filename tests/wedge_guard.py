@@ -14,10 +14,29 @@ matching the old module-global dicts): every wedge-able native call
 runs on a daemon thread with a deadline ~20-60x its normal runtime; a
 wedge SKIPS (never fails, never hangs) and short-circuits the module's
 remaining guarded work so the suite stays bounded.
+
+ISSUE 14: a deadline miss now DUMPS the lock-order witness state
+(butil/lockprof.py — every thread's held InstrumentedLocks, who is
+blocked acquiring what, and any ABBA cycles observed this process) to
+stderr before skipping, so the next tier-1 wedge leaves evidence
+instead of a silent hang.
 """
+import sys
 import threading
 
 import pytest
+
+
+def _witness_dump(what: str) -> None:
+    """Best-effort held-lock/cycle dump on a wedge (never raises)."""
+    try:
+        from brpc_tpu.butil import lockprof
+        sys.stderr.write(
+            f"\n=== wedge_guard: {what} blew its deadline — lock-order "
+            f"witness dump ===\n" + lockprof.witness_report() + "\n")
+        sys.stderr.flush()
+    except Exception:
+        pass
 
 
 class WedgeGuard:
@@ -63,8 +82,10 @@ class WedgeGuard:
             raise out["exc"]
         if "rc" not in out:
             self._wedged = True
+            _witness_dump(what)
             pytest.skip(f"{what} wedged past {self.deadline_s:.0f}s "
-                        f"(pre-existing native flake)")
+                        f"(pre-existing native flake; held-lock witness "
+                        f"dump on stderr)")
         return out["rc"]
 
     def start_thread(self, fn, *args) -> threading.Thread:
@@ -80,7 +101,8 @@ class WedgeGuard:
         t.join(self.deadline_s)
         if t.is_alive():
             self._wedged = True
+            _witness_dump(what or self.what)
             pytest.skip(f"{what or self.what} wedged past "
                         f"{self.deadline_s:.0f}s (pre-existing native "
                         f"flake; run_pump's own 120s bound did not "
-                        f"fire)")
+                        f"fire; held-lock witness dump on stderr)")
