@@ -195,6 +195,15 @@ perf: $(LIB) $(PYEXT)
 check:
 	python tools/brpc_check.py
 
+# Wedge hunt (ISSUE 15): loop the native test modules with the flight
+# recorder armed and archive the first wedge-guard deadline-miss dump
+# (lock witness + native flight tail) under build/wedge_hunt/ — turns
+# the "intermittent, ~half of 8 runs" tier-1 wedge into a harvestable
+# artifact.  Exits 0 with the artifact path on a catch, 3 on a clean
+# hunt.
+wedge-hunt: $(LIB) $(PYEXT)
+	python tools/wedge_hunt.py
+
 # Full bench run ending in a delta-vs-previous-round table: perf_diff
 # compares the freshest BENCH_r*.json against this run's
 # BENCH_DETAILS.json and flags beyond-spread regressions (the leading
@@ -229,8 +238,11 @@ TSAN_FLAG := $(shell echo 'int main(){}' | $(CXX) -fsanitize=thread \
 # racing terminals exactly-once, live-count baseline) and the spanq
 # MPSC Treiber stack (src/cc/spanq.h — the exact algorithm
 # fastrpc_module.cc's py_spanq_* run on PyObject*, extracted so it
-# links without Python) under TSAN.
-RING_STRESS_SRC := src/cc/serving_hotpath.cc src/cc/test/ring_stress_main.cc
+# links without Python) under TSAN.  ISSUE 15 adds the flight-recorder
+# ring (butil/flight.cc): concurrent writers + dump-while-writing —
+# the seqlock slots are all relaxed atomics, so TSAN stays sound here.
+RING_STRESS_SRC := src/cc/serving_hotpath.cc src/cc/butil/flight.cc \
+    src/cc/test/ring_stress_main.cc
 
 tsan:
 	@if [ -z "$(TSAN_FLAG)" ]; then \
@@ -280,4 +292,4 @@ stress:
 
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
     cluster model speculative trace hotspots microbench perf bench \
-    tsan tsan-core asan stress check ring-stress
+    tsan tsan-core asan stress check ring-stress wedge-hunt

@@ -1935,6 +1935,43 @@ def bench_microbench(trials=3, duration_s=0.4, quick=False):
                 "on at its default rate",
     }
 
+    # ---- flight_recorder overhead (ISSUE 15 acceptance) ----
+    # The recorder is ALWAYS-ON; this rung proves it can be: the echo
+    # pump (per-frame socket/executor events) and the emit fan-out
+    # (per-batch TokenRing events) re-run with recording off, and the
+    # on/off delta must stay within 2% beyond spread.
+    from brpc_tpu.butil import flight as _flight
+    if _flight.available():
+        def _fl_ab(trial, unit):
+            _flight.set_enabled(True)
+            on = [trial(k) for k in range(trials)]
+            _flight.set_enabled(False)
+            try:
+                off = [trial(k) for k in range(trials)]
+            finally:
+                _flight.set_enabled(True)
+            on_m = _med_spread(on, "qps_on")
+            off_m = _med_spread(off, "qps_off")
+            entry = {**on_m, **off_m, "unit": unit}
+            if off_m["qps_off"]:
+                entry["overhead_pct"] = round(
+                    (off_m["qps_off"] - on_m["qps_on"])
+                    / off_m["qps_off"] * 100.0, 2)
+            return entry
+
+        fl = {}
+        fl["emit_fanout"] = _fl_ab(
+            lambda k: _with_flag(True, lambda: emit_trial(k)),
+            "tokens/s through one native emit buffer pair, "
+            "recorder on vs off")
+        ec_frames = 10_000 if quick else 40_000
+        def _echo_trial(k):
+            r = bench_native_echo(conns=2, inflight=16, total=ec_frames)
+            return r["qps"] if r["completed"] else 0.0
+        fl["echo"] = _fl_ab(
+            _echo_trial, "native echo frames/s, recorder on vs off")
+        out["flight_recorder"] = fl
+
     out["cpu_valid"] = True
     out["note"] = ("per-stage host microbenches (ISSUE 6): every rung "
                    "isolates one serving stage on the host with no "

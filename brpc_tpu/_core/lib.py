@@ -366,6 +366,30 @@ _sigs = {
                                ctypes.POINTER(ctypes.c_int),
                                ctypes.POINTER(ctypes.c_int32)]),
     "brpc_tokring_size": (ctypes.c_int64, [ctypes.c_void_p]),
+    # native flight recorder (ISSUE 15; src/cc/butil/flight.h):
+    # always-on per-thread event rings in the C++ core — merged dump,
+    # per-thread last-event table, stats, and the forced-stall probe
+    "brpc_flight_enable": (None, [ctypes.c_int]),
+    "brpc_flight_enabled": (ctypes.c_int, []),
+    "brpc_flight_dump": (ctypes.c_int, [ctypes.c_char_p, ctypes.c_size_t,
+                                        ctypes.c_int]),
+    "brpc_flight_threads": (ctypes.c_int, [ctypes.c_char_p,
+                                           ctypes.c_size_t]),
+    "brpc_flight_stats": (None, [ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.POINTER(ctypes.c_int64),
+                                 ctypes.POINTER(ctypes.c_int64)]),
+    "brpc_flight_selftest_emit": (None, [ctypes.c_int, ctypes.c_uint64]),
+    "brpc_flight_stall_probe": (ctypes.c_int, [ctypes.c_int]),
+    # syscall attribution (ISSUE 15 satellite; ROADMAP 1(e))
+    "brpc_syscall_counters": (None, [ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.POINTER(ctypes.c_int64)]),
+    "brpc_write_size_hist": (ctypes.c_int, [ctypes.POINTER(ctypes.c_int64),
+                                            ctypes.c_int]),
+    "brpc_socket_syscalls": (ctypes.c_int, [ctypes.c_uint64,
+                                            ctypes.POINTER(ctypes.c_int64),
+                                            ctypes.POINTER(ctypes.c_int64)]),
     "brpc_batch_pad": (None, [ctypes.POINTER(ctypes.c_void_p),
                               ctypes.POINTER(ctypes.c_int64),
                               ctypes.c_int, ctypes.c_void_p,

@@ -9,7 +9,9 @@ MethodStatus), /vars (+ wildcard filter), /flags (live edit with ?setvalue=),
 stats of the ICI transport), /serving (dynamic-batcher occupancy +
 decode slot map + supervisor state/restart/recovery stats,
 brpc_tpu/serving), /kvcache (paged-KV hit-rate, page
-occupancy, radix-tree size, eviction counters, brpc_tpu/kvcache).
+occupancy, radix-tree size, eviction counters, brpc_tpu/kvcache),
+/flightrecorder (the native core's always-on per-thread event rings:
+merged tail, per-thread state, syscall attribution — ISSUE 15).
 """
 from __future__ import annotations
 
@@ -512,6 +514,43 @@ def build_routes(server) -> dict:
                 + f"  {st['last_holder_stage']}")
         return "\n".join(lines) + "\n\n" + lockprof.witness_report()
 
+    def flightrecorder_page(req):
+        # native flight recorder (ISSUE 15; src/cc/butil/flight.h):
+        # the always-on per-thread event rings inside the C++ core —
+        # merged time-ordered tail, per-thread "what is every native
+        # thread doing RIGHT NOW" table, recorder stats, and the
+        # syscall-attribution counters (ROADMAP 1(e)).  ?limit=N sizes
+        # the tail; ?fmt=json returns the structured snapshot.
+        from brpc_tpu.butil import flight
+        try:
+            limit = min(4096, max(1, int(req.query.get("limit", "200"))))
+        except ValueError:
+            limit = 200
+        if not flight.available():
+            body = ("native flight recorder unavailable "
+                    "(native core not built)\n")
+            if req.query.get("fmt") == "json":
+                return json.dumps({"available": False}), "application/json"
+            return body
+        if req.query.get("fmt") == "json":
+            return json.dumps({
+                "available": True,
+                "enabled": flight.enabled(),
+                "stats": flight.stats(),
+                "syscalls": flight.syscall_counters(),
+                "bytes_per_write": flight.write_size_hist(),
+                "threads": flight.threads(),
+                "events": flight.events(limit),
+            }, indent=1), "application/json"
+        hist = flight.write_size_hist()
+        hist_line = "  ".join(f"le_{k}={v}" for k, v in hist.items()
+                              if v) or "(no writes yet)"
+        return (flight.report(limit)
+                + f"\nbytes_per_write: {hist_line}\n"
+                + "\nargs: ?limit=N (tail size) ?fmt=json\n"
+                + "flip recording live: /flags?setvalue="
+                + "flight_recorder_enabled&value=false\n")
+
     def _seconds(req, default=1.0):
         try:
             return min(60.0, max(0.05, float(req.query.get("seconds",
@@ -662,6 +701,7 @@ def build_routes(server) -> dict:
         "/migration": migration_page,
         "/cluster": cluster_page,
         "/psserve": psserve_page,
+        "/flightrecorder": flightrecorder_page,
         "/hotspots": hotspots_index,
         "/hotspots/locks": hotspots_locks,
         "/hotspots/cpu": hotspots_cpu,
@@ -762,3 +802,6 @@ def _apply_flag_side_effects(name: str) -> None:
             HotspotSampler.ensure_started()
         else:
             HotspotSampler.instance().stop()
+    elif name == "flight_recorder_enabled":
+        from brpc_tpu.butil import flight
+        flight.apply_flag()

@@ -1,0 +1,252 @@
+"""Python surface of the native flight recorder (ISSUE 15;
+src/cc/butil/flight.h).
+
+The C++ core records every load-bearing transition — executor
+task-begin/end, steal, park/unpark, butex wait/wake/timeout, timer
+fire/cancel, socket lifecycle + read/write syscalls, TokenRing batch
+push/pop/terminal — into always-on per-thread overwrite-oldest rings.
+This module parses the native text dumps into structured events, feeds
+the ``/flightrecorder`` console page, exposes the recorder + syscall
+attribution counters on ``/vars`` / ``/brpc_metrics``, and renders the
+wedge-autopsy report ``tests/wedge_guard.py`` prints on every deadline
+miss.
+
+Everything degrades to empty results when the native core is
+unavailable — the recorder is an observability surface, never a
+dependency.
+"""
+from __future__ import annotations
+
+import ctypes
+
+from brpc_tpu.flags import define_flag, get_flag
+
+define_flag("flight_recorder_enabled", True,
+            "record native-core transitions (executor/butex/timer/"
+            "socket/token-ring) into the always-on per-thread flight "
+            "rings; off = the record hook is a single relaxed-load "
+            "no-op", reloadable=True)
+
+# bytes-per-write histogram bucket labels (log2 from 64B; the last
+# bucket is open-ended) — must match Socket::kWriteHistBuckets.
+WRITE_HIST_BUCKETS = 16
+WRITE_HIST_LABELS = tuple(
+    str(64 << i) for i in range(WRITE_HIST_BUCKETS - 1)) + ("+inf",)
+
+
+def _core():
+    """The raw CDLL, or None when the native build is unavailable."""
+    from brpc_tpu import native_path
+    lib = native_path._core_lib()
+    return lib.core if lib is not None else None
+
+
+def available() -> bool:
+    return _core() is not None
+
+
+def enabled() -> bool:
+    c = _core()
+    return bool(c.brpc_flight_enabled()) if c is not None else False
+
+
+def set_enabled(on: bool) -> None:
+    c = _core()
+    if c is not None:
+        c.brpc_flight_enable(1 if on else 0)
+
+
+def apply_flag() -> None:
+    """Push the reloadable flag's value into the native core (the
+    /flags side-effect hook in builtin/services.py)."""
+    set_enabled(bool(get_flag("flight_recorder_enabled", True)))
+
+
+def stats() -> dict:
+    c = _core()
+    if c is None:
+        return {"events": 0, "threads": 0, "dropped": 0}
+    ev, th, dr = (ctypes.c_int64(), ctypes.c_int64(), ctypes.c_int64())
+    c.brpc_flight_stats(ctypes.byref(ev), ctypes.byref(th),
+                        ctypes.byref(dr))
+    return {"events": ev.value, "threads": th.value, "dropped": dr.value}
+
+
+def events(limit: int = 512) -> list[dict]:
+    """Merged time-ordered tail across every native thread's ring,
+    oldest first."""
+    c = _core()
+    if c is None:
+        return []
+    buf = ctypes.create_string_buffer(1 << 20)
+    n = c.brpc_flight_dump(buf, len(buf), int(limit))
+    out = []
+    if n <= 0:
+        return out
+    for line in buf.value.decode("utf-8", "replace").splitlines():
+        parts = line.split()
+        # <ts_us> <tid> <name> <kind> a=0x<hex> b=<dec>
+        if len(parts) != 6:
+            continue
+        try:
+            out.append({
+                "ts_us": int(parts[0]),
+                "tid": int(parts[1]),
+                "thread": parts[2],
+                "kind": parts[3],
+                "a": int(parts[4][2:], 16),
+                "b": int(parts[5][2:]),
+            })
+        except ValueError:
+            continue
+    return out
+
+
+def threads() -> list[dict]:
+    """Per-thread state table: what every native thread last did and
+    how long ago."""
+    c = _core()
+    if c is None:
+        return []
+    buf = ctypes.create_string_buffer(1 << 18)
+    n = c.brpc_flight_threads(buf, len(buf))
+    out = []
+    if n <= 0:
+        return out
+    for line in buf.value.decode("utf-8", "replace").splitlines():
+        parts = line.split()
+        # <tid> <name> <live|exited> events= dropped= last= age_us=
+        if len(parts) != 7:
+            continue
+        try:
+            kv = dict(p.split("=", 1) for p in parts[3:])
+            out.append({
+                "tid": int(parts[0]),
+                "thread": parts[1],
+                "live": parts[2] == "live",
+                "events": int(kv["events"]),
+                "dropped": int(kv["dropped"]),
+                "last": kv["last"],
+                "age_us": int(kv["age_us"]),
+            })
+        except (ValueError, KeyError):
+            continue
+    return out
+
+
+def syscall_counters() -> dict:
+    """Process-wide read/write syscall counts + the dispatch write
+    batch's coalescing hit/miss counters (ROADMAP 1(e): the
+    frame-coalescing before/after metric)."""
+    c = _core()
+    if c is None:
+        return {"read_syscalls": 0, "write_syscalls": 0,
+                "batch_hits": 0, "batch_misses": 0}
+    vals = [ctypes.c_int64() for _ in range(4)]
+    c.brpc_syscall_counters(*[ctypes.byref(v) for v in vals])
+    return {"read_syscalls": vals[0].value,
+            "write_syscalls": vals[1].value,
+            "batch_hits": vals[2].value,
+            "batch_misses": vals[3].value}
+
+
+def write_size_hist() -> dict:
+    """bytes-per-write histogram: {bucket_upper_bound_label: count}."""
+    c = _core()
+    if c is None:
+        return {}
+    arr = (ctypes.c_int64 * WRITE_HIST_BUCKETS)()
+    n = c.brpc_write_size_hist(arr, WRITE_HIST_BUCKETS)
+    return {WRITE_HIST_LABELS[i]: arr[i] for i in range(n)}
+
+
+def socket_syscalls(sid: int) -> dict | None:
+    """Per-socket syscall attribution, or None for a stale/failed id."""
+    c = _core()
+    if c is None:
+        return None
+    rd, wr = ctypes.c_int64(), ctypes.c_int64()
+    if c.brpc_socket_syscalls(ctypes.c_uint64(sid), ctypes.byref(rd),
+                              ctypes.byref(wr)) != 0:
+        return None
+    return {"read_syscalls": rd.value, "write_syscalls": wr.value}
+
+
+def report(limit: int = 120) -> str:
+    """The wedge-autopsy text: recorder stats, the per-thread table
+    (every native thread's LAST event and its age), then the merged
+    event tail — what wedge_guard prints to stderr on a deadline miss
+    so the next tier-1 wedge names which worker/socket/butex stopped
+    advancing and what it last did."""
+    if not available():
+        return "native flight recorder unavailable (no native core)\n"
+    st = stats()
+    sc = syscall_counters()
+    lines = [
+        f"flight recorder: {'ENABLED' if enabled() else 'DISABLED'} · "
+        f"{st['threads']} threads · {st['events']} events recorded "
+        f"({st['dropped']} overwritten)",
+        f"syscalls: read={sc['read_syscalls']} "
+        f"write={sc['write_syscalls']} "
+        f"batch_hits={sc['batch_hits']} "
+        f"batch_misses={sc['batch_misses']}",
+        "",
+        "--- per-thread state (last event of every native thread) ---",
+    ]
+    for t in threads():
+        lines.append(
+            f"  tid={t['tid']:<8} {t['thread']:<12} "
+            f"{'live' if t['live'] else 'exited':<7} "
+            f"last={t['last']:<14} age_us={t['age_us']:<12} "
+            f"events={t['events']} dropped={t['dropped']}")
+    lines.append("")
+    lines.append(f"--- merged event tail (oldest first, "
+                 f"last {limit}) ---")
+    for e in events(limit):
+        lines.append(f"  {e['ts_us']} {e['thread']:<12} "
+                     f"{e['kind']:<14} a=0x{e['a']:x} b={e['b']}")
+    return "\n".join(lines) + "\n"
+
+
+_exposed = False
+
+
+def expose_flight_variables() -> None:
+    """Recorder + syscall-attribution counters on /vars and
+    /brpc_metrics (idempotent; called from Server.start next to
+    expose_default_variables).  The PassiveStatus getters read the
+    native counters directly and return zeros when the core is absent,
+    so exposure is always safe."""
+    global _exposed
+    if _exposed:
+        return
+    _exposed = True
+    from brpc_tpu.bvar.multi_dimension import MultiDimension
+    from brpc_tpu.bvar.reducer import PassiveStatus
+
+    PassiveStatus(lambda: stats()["events"]) \
+        .expose("flight_events_recorded")
+    PassiveStatus(lambda: stats()["threads"]) \
+        .expose("flight_threads_tracked")
+    PassiveStatus(lambda: stats()["dropped"]) \
+        .expose("flight_events_overwritten")
+    PassiveStatus(lambda: int(enabled())).expose("flight_enabled")
+    PassiveStatus(lambda: syscall_counters()["read_syscalls"]) \
+        .expose("socket_read_syscalls")
+    PassiveStatus(lambda: syscall_counters()["write_syscalls"]) \
+        .expose("socket_write_syscalls")
+    PassiveStatus(lambda: syscall_counters()["batch_hits"]) \
+        .expose("socket_write_batch_hits")
+    PassiveStatus(lambda: syscall_counters()["batch_misses"]) \
+        .expose("socket_write_batch_misses")
+
+    # bytes-per-write histogram as an mbvar: renders on /brpc_metrics
+    # as socket_bytes_per_write{le="64"} ... — Prometheus-histogram
+    # shaped without a new exporter branch
+    md = MultiDimension(["le"], lambda: None,
+                        name="socket_bytes_per_write")
+    for label in WRITE_HIST_LABELS:
+        cell = PassiveStatus(
+            (lambda lb: lambda: write_size_hist().get(lb, 0))(label))
+        md._stats[(label,)] = cell
+    md.expose("socket_bytes_per_write")

@@ -25,6 +25,9 @@ HOT_PREFIXES = (
     "brpc_tpu/kvcache/",
     "brpc_tpu/psserve/",
     "brpc_tpu/migrate/",
+    # ISSUE 15: the flight-recorder surface feeds every wedge autopsy —
+    # a raw lock here would be invisible to the very dump it renders
+    "brpc_tpu/butil/flight.py",
 )
 
 

@@ -1,9 +1,9 @@
 """Native hot-path gate (ISSUE 9).
 
 One switchboard for the serving stack's de-GIL'd paths — the native
-emit token rings (engine), GIL-released batch assembly (batcher) and
-the native span queue (rpcz) all ask HERE whether to take the native
-road:
+emit token rings (engine), GIL-released batch assembly (batcher), the
+native span queue (rpcz) and the flight-recorder surface (ISSUE 15)
+all ask HERE whether the native road is available:
 
   * the reloadable flag ``native_hot_path_enabled`` (default True,
     flip live on /flags) is the operator's kill switch — platforms
@@ -84,6 +84,19 @@ def token_ring(cap: int):
 def tokring_live() -> int:
     lib = _core_lib()
     return lib.tokring_live() if lib is not None else 0
+
+
+def flight_recorder():
+    """The native flight-recorder surface (brpc_tpu.butil.flight over
+    src/cc/butil/flight.h), or None when the native core is
+    unavailable.  Unlike the hot paths above, the recorder has no
+    pure-Python fallback — it observes the native core, so without the
+    core there is nothing to observe; callers treat None as "no
+    evidence", never as an error (ISSUE 15)."""
+    if _core_lib() is None:
+        return None
+    from brpc_tpu.butil import flight
+    return flight
 
 
 def batch_pad_available() -> bool:

@@ -398,6 +398,8 @@ class Server:
                 self.add_service(_GrpcHealth())
         from brpc_tpu.bvar.default_variables import expose_default_variables
         expose_default_variables()  # process cpu/rss/fds on /vars (§2.7)
+        from brpc_tpu.butil.flight import expose_flight_variables
+        expose_flight_variables()   # flight recorder + syscall attribution
         # always-on stage-tagged sampling profiler (ISSUE 6): the
         # /hotspots ring starts with the first server; flag-gated
         # (hotspot_sampler_enabled), live-flippable on /flags

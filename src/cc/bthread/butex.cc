@@ -5,6 +5,7 @@
 #include "bthread/executor.h"
 #include "bthread/timer.h"
 #include "butil/common.h"
+#include "butil/flight.h"
 #include "bvar/combiner.h"
 
 namespace bthread {
@@ -90,6 +91,9 @@ void Butex::TimeoutTask(void* arg) {
     }
     *w->result_slot = WaitResult::kTimeout;
     g_butex_timeouts.add(1);
+    butil::flight::record(butil::flight::EV_BUTEX_TIMEOUT,
+                          (uint64_t)(uintptr_t)w->owner.load(
+                              std::memory_order_relaxed));
     schedule_resume(w->handle);
   }
   w->unref();
@@ -122,6 +126,8 @@ bool Butex::Awaiter::await_suspend(std::coroutine_handle<> h) {
         &Butex::TimeoutTask, w, timeout_us);
   }
   g_butex_waits.add(1);
+  butil::flight::record(butil::flight::EV_BUTEX_WAIT,
+                        (uint64_t)(uintptr_t)b, timeout_us);
   return true;
 }
 
@@ -162,7 +168,11 @@ int Butex::wake(int n) {
       w = next_in_list;
     }
   }
-  if (woken > 0) g_butex_wakes.add(woken);
+  if (woken > 0) {
+    g_butex_wakes.add(woken);
+    butil::flight::record(butil::flight::EV_BUTEX_WAKE,
+                          (uint64_t)(uintptr_t)this, woken);
+  }
   for (Waiter* w = resume_list; w != nullptr;) {
     Waiter* next = w->next;
     w->next = nullptr;

@@ -1,6 +1,7 @@
 #include "bthread/executor.h"
 
 #include "butil/common.h"
+#include "butil/flight.h"
 
 namespace bthread {
 
@@ -211,6 +212,7 @@ TaskNode* Executor::steal_task(int self) {
     TaskNode* t = _workers[v]->rq.steal();
     if (t != nullptr) {
       _steals.add(1);
+      butil::flight::record(butil::flight::EV_STEAL, (uint64_t)v);
       return t;
     }
   }
@@ -220,6 +222,7 @@ TaskNode* Executor::steal_task(int self) {
 void Executor::worker_main(int index) {
   tls_executor = this;
   tls_worker_index = index;
+  butil::flight::set_thread_name("worker/%d", index);
   Worker* w = _workers[index];
   while (!_stopping.load(std::memory_order_acquire)) {
     TaskNode* t = w->rq.pop();
@@ -231,11 +234,17 @@ void Executor::worker_main(int index) {
       t = pop_remote();
       if (t == nullptr) t = steal_task(index);
       if (t == nullptr) {
+        butil::flight::record(butil::flight::EV_PARK, (uint64_t)state);
         _pl.wait(state);
+        butil::flight::record(butil::flight::EV_UNPARK);
         continue;
       }
     }
+    butil::flight::record(butil::flight::EV_TASK_BEGIN,
+                          (uint64_t)(uintptr_t)t->fn);
     t->fn(t->arg);
+    butil::flight::record(butil::flight::EV_TASK_END,
+                          (uint64_t)(uintptr_t)t->fn);
     delete t;
     _executed.add(1);
   }

@@ -1,6 +1,7 @@
 #include "bthread/timer.h"
 
 #include "butil/common.h"
+#include "butil/flight.h"
 
 namespace bthread {
 
@@ -29,6 +30,7 @@ bool TimerThread::unschedule(uint64_t id) {
   // timers are removed from _pending_ids, so both sets stay bounded.
   if (_pending_ids.erase(id) == 0) return false;
   _cancelled.insert(id);
+  butil::flight::record(butil::flight::EV_TIMER_CANCEL, id);
   return true;
 }
 
@@ -38,6 +40,7 @@ size_t TimerThread::pending() const {
 }
 
 void TimerThread::run() {
+  butil::flight::set_thread_name("timer");
   std::unique_lock<std::mutex> g(_mu);
   while (!_stop) {
     if (_heap.empty()) {
@@ -62,6 +65,7 @@ void TimerThread::run() {
     }
     _pending_ids.erase(top.id);
     g.unlock();
+    butil::flight::record(butil::flight::EV_TIMER_FIRE, top.id);
     top.fn(top.arg);  // fired outside the lock
     _fired.fetch_add(1, std::memory_order_relaxed);
     g.lock();

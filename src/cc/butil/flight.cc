@@ -1,0 +1,329 @@
+#include "butil/flight.h"
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <mutex>
+#include <vector>
+
+#include "butil/common.h"
+
+// NOTE: this TU is linked both into libbrpc_core.so and (standalone,
+// with serving_hotpath.cc) into the `make tsan` ring-stress binary — it
+// must not reference logging.cc/profiler.cc symbols (no BLOG here).
+
+namespace butil {
+namespace flight {
+
+namespace {
+
+std::atomic<bool> g_enabled{true};
+std::atomic<ThreadRing*> g_rings{nullptr};
+std::atomic<int64_t> g_ring_count{0};
+// Rings retired by exited threads, awaiting reuse (plain mutex: thread
+// birth/death is cold).  Events recorded on rings that were later
+// recycled accumulate here so stats() stays cumulative.
+std::mutex g_free_mu;
+ThreadRing* g_free = nullptr;
+std::atomic<int64_t> g_retired_events{0};
+std::atomic<int64_t> g_retired_dropped{0};
+
+void pack_name(ThreadRing* r, const char* name) {
+  char tmp[16];
+  memset(tmp, 0, sizeof(tmp));
+  strncpy(tmp, name, sizeof(tmp) - 1);
+  uint64_t lo, hi;
+  memcpy(&lo, tmp, 8);
+  memcpy(&hi, tmp + 8, 8);
+  r->name_lo.store(lo, std::memory_order_relaxed);
+  r->name_hi.store(hi, std::memory_order_relaxed);
+}
+
+void unpack_name(const ThreadRing* r, char out[16]) {
+  uint64_t lo = r->name_lo.load(std::memory_order_relaxed);
+  uint64_t hi = r->name_hi.load(std::memory_order_relaxed);
+  memcpy(out, &lo, 8);
+  memcpy(out + 8, &hi, 8);
+  out[15] = 0;
+  if (out[0] == 0) strcpy(out, "ext");
+}
+
+ThreadRing* register_thread() {
+  const uint64_t tid = (uint64_t)syscall(SYS_gettid);
+  {
+    // reuse a retired ring first: per-request threads register at
+    // serving rates and must not leak 64KB each
+    std::lock_guard<std::mutex> g(g_free_mu);
+    if (g_free != nullptr) {
+      ThreadRing* r = g_free;
+      g_free = r->free_next;
+      r->free_next = nullptr;
+      const uint64_t h = r->head.load(std::memory_order_relaxed);
+      g_retired_events.fetch_add((int64_t)h, std::memory_order_relaxed);
+      if (h > kRingCap) {
+        g_retired_dropped.fetch_add((int64_t)(h - kRingCap),
+                                    std::memory_order_relaxed);
+      }
+      // head back to 0 republishes the ring empty: collect() only
+      // reads slots below head, so the previous occupant's events
+      // become unreachable without touching the 2048 version words
+      r->head.store(0, std::memory_order_release);
+      r->name_lo.store(0, std::memory_order_relaxed);
+      r->name_hi.store(0, std::memory_order_relaxed);
+      r->tid.store(tid, std::memory_order_relaxed);
+      r->live.store(true, std::memory_order_release);
+      return r;
+    }
+  }
+  auto* r = new ThreadRing();
+  r->tid.store(tid, std::memory_order_relaxed);
+  ThreadRing* head = g_rings.load(std::memory_order_acquire);
+  do {
+    r->next = head;
+  } while (!g_rings.compare_exchange_weak(head, r,
+                                          std::memory_order_acq_rel,
+                                          std::memory_order_acquire));
+  g_ring_count.fetch_add(1, std::memory_order_relaxed);
+  return r;
+}
+
+// A ring whose thread exited stays on the registration list (marked
+// !live, events intact — a wedge autopsy can still show what a dead
+// thread last did) AND goes onto the recycle list for the next
+// registering thread, so the ring population is bounded by the peak
+// CONCURRENT thread count, not by thread churn.
+struct TlsHolder {
+  ThreadRing* ring = nullptr;
+  ~TlsHolder() {
+    if (ring != nullptr) {
+      ring->live.store(false, std::memory_order_release);
+      std::lock_guard<std::mutex> g(g_free_mu);
+      ring->free_next = g_free;
+      g_free = ring;
+    }
+  }
+};
+thread_local TlsHolder tls_holder;
+
+inline ThreadRing* my_ring() {
+  ThreadRing* r = tls_holder.ring;
+  if (r == nullptr) {
+    r = register_thread();
+    tls_holder.ring = r;
+  }
+  return r;
+}
+
+// Validated read of one slot: true when the copy is a complete event
+// (version even, unchanged across the field reads).  *seq_out is the
+// event's ring sequence.
+bool read_slot(const Event& e, int64_t* ts, uint64_t* a, int32_t* b,
+               uint16_t* kind, uint64_t* seq_out) {
+  const uint64_t v1 = e.ver.load(std::memory_order_acquire);
+  if (v1 == 0 || (v1 & 1) != 0) return false;  // empty or mid-write
+  *ts = e.ts_us.load(std::memory_order_relaxed);
+  *a = e.a.load(std::memory_order_relaxed);
+  *b = e.b.load(std::memory_order_relaxed);
+  *kind = e.kind.load(std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_acquire);
+  const uint64_t v2 = e.ver.load(std::memory_order_relaxed);
+  if (v1 != v2) return false;                  // overwritten mid-copy
+  if (*kind >= EV_KIND_MAX) return false;      // belt and braces
+  *seq_out = v2 / 2 - 1;
+  return true;
+}
+
+struct DumpEvent {
+  int64_t ts;
+  uint64_t seq;
+  uint64_t tid;
+  uint64_t a;
+  int32_t b;
+  uint16_t kind;
+  char name[16];
+};
+
+}  // namespace
+
+const char* kind_name(uint16_t k) {
+  switch (k) {
+    case EV_NONE: return "none";
+    case EV_TASK_BEGIN: return "task_begin";
+    case EV_TASK_END: return "task_end";
+    case EV_STEAL: return "steal";
+    case EV_PARK: return "park";
+    case EV_UNPARK: return "unpark";
+    case EV_BUTEX_WAIT: return "butex_wait";
+    case EV_BUTEX_WAKE: return "butex_wake";
+    case EV_BUTEX_TIMEOUT: return "butex_timeout";
+    case EV_TIMER_FIRE: return "timer_fire";
+    case EV_TIMER_CANCEL: return "timer_cancel";
+    case EV_SOCK_CREATE: return "sock_create";
+    case EV_SOCK_EPOLLIN: return "sock_epollin";
+    case EV_READ_ENTER: return "read_enter";
+    case EV_READ_EXIT: return "read_exit";
+    case EV_WRITE_ENTER: return "write_enter";
+    case EV_WRITE_EXIT: return "write_exit";
+    case EV_SOCK_FAILED: return "sock_failed";
+    case EV_SOCK_CLOSE: return "sock_close";
+    case EV_RING_PUSH: return "ring_push";
+    case EV_RING_FULL: return "ring_full";
+    case EV_RING_POP: return "ring_pop";
+    case EV_RING_TERMINAL: return "ring_terminal";
+    case EV_SPANQ_DRAIN: return "spanq_drain";
+    case EV_PROBE: return "probe";
+    default: return "?";
+  }
+}
+
+bool enabled() { return g_enabled.load(std::memory_order_relaxed); }
+
+void set_enabled(bool on) {
+  g_enabled.store(on, std::memory_order_relaxed);
+}
+
+void record(uint16_t kind, uint64_t a, int64_t b) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  ThreadRing* r = my_ring();
+  const uint64_t h = r->head.load(std::memory_order_relaxed);
+  Event& e = r->buf[h & (kRingCap - 1)];
+  // seqlock write: odd while the fields are in flux, even when done.
+  e.ver.store(2 * (h + 1) - 1, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  e.ts_us.store(monotonic_time_us(), std::memory_order_relaxed);
+  e.a.store(a, std::memory_order_relaxed);
+  const int64_t clamped =
+      b > INT32_MAX ? INT32_MAX : (b < INT32_MIN ? INT32_MIN : b);
+  e.b.store((int32_t)clamped, std::memory_order_relaxed);
+  e.kind.store(kind, std::memory_order_relaxed);
+  e.ver.store(2 * (h + 1), std::memory_order_release);
+  r->head.store(h + 1, std::memory_order_release);
+}
+
+void set_thread_name(const char* fmt, ...) {
+  char buf[16];
+  va_list ap;
+  va_start(ap, fmt);
+  vsnprintf(buf, sizeof(buf), fmt, ap);
+  va_end(ap);
+  pack_name(my_ring(), buf);
+}
+
+namespace {
+
+// Collect every consistent event from every ring into `out`.
+void collect(std::vector<DumpEvent>* out) {
+  for (ThreadRing* r = g_rings.load(std::memory_order_acquire);
+       r != nullptr; r = r->next) {
+    char name[16];
+    unpack_name(r, name);
+    const uint64_t h = r->head.load(std::memory_order_acquire);
+    const uint64_t n = h < kRingCap ? h : kRingCap;
+    for (uint64_t i = 0; i < n; ++i) {
+      const Event& e = r->buf[i];
+      DumpEvent d;
+      if (!read_slot(e, &d.ts, &d.a, &d.b, &d.kind, &d.seq)) continue;
+      d.tid = r->tid.load(std::memory_order_relaxed);
+      memcpy(d.name, name, sizeof(d.name));
+      out->push_back(d);
+    }
+  }
+}
+
+}  // namespace
+
+int dump(char* out, size_t cap, int max_events) {
+  if (out == nullptr || cap == 0) return 0;
+  out[0] = 0;
+  std::vector<DumpEvent> evs;
+  evs.reserve(1024);
+  collect(&evs);
+  std::sort(evs.begin(), evs.end(),
+            [](const DumpEvent& x, const DumpEvent& y) {
+              if (x.ts != y.ts) return x.ts < y.ts;
+              if (x.tid != y.tid) return x.tid < y.tid;
+              return x.seq < y.seq;
+            });
+  size_t first = 0;
+  if (max_events > 0 && evs.size() > (size_t)max_events) {
+    first = evs.size() - (size_t)max_events;
+  }
+  size_t off = 0;
+  for (size_t i = first; i < evs.size(); ++i) {
+    const DumpEvent& d = evs[i];
+    const int n = snprintf(out + off, cap - off,
+                           "%lld %llu %s %s a=0x%llx b=%d\n",
+                           (long long)d.ts, (unsigned long long)d.tid,
+                           d.name, kind_name(d.kind),
+                           (unsigned long long)d.a, (int)d.b);
+    if (n < 0 || (size_t)n >= cap - off) {
+      out[off] = 0;  // truncate at a line boundary
+      break;
+    }
+    off += (size_t)n;
+  }
+  return (int)off;
+}
+
+int threads_table(char* out, size_t cap) {
+  if (out == nullptr || cap == 0) return 0;
+  out[0] = 0;
+  const int64_t now = monotonic_time_us();
+  size_t off = 0;
+  for (ThreadRing* r = g_rings.load(std::memory_order_acquire);
+       r != nullptr; r = r->next) {
+    char name[16];
+    unpack_name(r, name);
+    const uint64_t h = r->head.load(std::memory_order_acquire);
+    const int64_t dropped =
+        h > kRingCap ? (int64_t)(h - kRingCap) : 0;
+    const char* last_kind = "-";
+    int64_t age_us = -1;
+    if (h > 0) {
+      const Event& e = r->buf[(h - 1) & (kRingCap - 1)];
+      int64_t ts;
+      uint64_t a, seq;
+      int32_t b;
+      uint16_t kind;
+      if (read_slot(e, &ts, &a, &b, &kind, &seq)) {
+        last_kind = kind_name(kind);
+        age_us = now - ts;
+      }
+    }
+    const int n = snprintf(
+        out + off, cap - off,
+        "%llu %s %s events=%llu dropped=%lld last=%s age_us=%lld\n",
+        (unsigned long long)r->tid.load(std::memory_order_relaxed), name,
+        r->live.load(std::memory_order_acquire) ? "live" : "exited",
+        (unsigned long long)h, (long long)dropped, last_kind,
+        (long long)age_us);
+    if (n < 0 || (size_t)n >= cap - off) {
+      out[off] = 0;
+      break;
+    }
+    off += (size_t)n;
+  }
+  return (int)off;
+}
+
+void stats(int64_t* events, int64_t* threads, int64_t* dropped) {
+  // cumulative: live ring heads + events retired when rings recycled
+  int64_t ev = g_retired_events.load(std::memory_order_relaxed);
+  int64_t dr = g_retired_dropped.load(std::memory_order_relaxed);
+  for (ThreadRing* r = g_rings.load(std::memory_order_acquire);
+       r != nullptr; r = r->next) {
+    const uint64_t h = r->head.load(std::memory_order_acquire);
+    ev += (int64_t)h;
+    if (h > kRingCap) dr += (int64_t)(h - kRingCap);
+  }
+  if (events) *events = ev;
+  if (threads) *threads = g_ring_count.load(std::memory_order_relaxed);
+  if (dropped) *dropped = dr;
+}
+
+}  // namespace flight
+}  // namespace butil

@@ -10,6 +10,7 @@
 #include "bthread/executor.h"
 #include "bthread/timer.h"
 #include "butil/common.h"
+#include "butil/flight.h"
 #include "butil/iobuf.h"
 #include "butil/snappy.h"
 #include "bvar/combiner.h"
@@ -1089,6 +1090,96 @@ int64_t brpc_fiber_sleep_probe(int64_t us, int timeout_ms) {
   const int64_t v = ok ? p->woke_after_us : -1;
   unref(p);
   return v;
+}
+
+// ---- native flight recorder (ISSUE 15; butil/flight.h) ----
+
+void brpc_flight_enable(int on) { butil::flight::set_enabled(on != 0); }
+int brpc_flight_enabled() { return butil::flight::enabled() ? 1 : 0; }
+
+// Merged time-ordered tail of every native thread's event ring; one
+// text line per event.  Returns bytes written.
+int brpc_flight_dump(char* out, size_t cap, int max_events) {
+  return butil::flight::dump(out, cap, max_events);
+}
+
+// Per-thread last-event-age table ("what is every native thread doing
+// RIGHT NOW").  Returns bytes written.
+int brpc_flight_threads(char* out, size_t cap) {
+  return butil::flight::threads_table(out, cap);
+}
+
+void brpc_flight_stats(int64_t* events, int64_t* threads,
+                       int64_t* dropped) {
+  butil::flight::stats(events, threads, dropped);
+}
+
+// Test driver: record `n` probe events tagged `tag` on the CALLING
+// thread's ring (ring-semantics tests: wrap, concurrent writers,
+// dump-while-writing, disabled no-op).
+void brpc_flight_selftest_emit(int n, uint64_t tag) {
+  for (int i = 0; i < n; ++i) {
+    butil::flight::record(butil::flight::EV_PROBE, tag, i);
+  }
+}
+
+}  // extern "C" (the stall task below is a plain C++ internal helper)
+
+namespace {
+struct StallSt {
+  std::atomic<int> done{0};
+  int hold_ms;
+};
+
+void stall_task(void* arg) {
+  auto* s = (StallSt*)arg;
+  // a recognizable last event for the stalled worker: the autopsy test
+  // asserts a worker ring whose newest event is this probe
+  butil::flight::record(butil::flight::EV_PROBE, 0x57A11, s->hold_ms);
+  usleep((useconds_t)s->hold_ms * 1000);
+  s->done.store(1, std::memory_order_release);
+}
+}  // namespace
+
+extern "C" {
+
+// Forced-stall probe (the wedge-autopsy acceptance test): occupies one
+// executor worker with a fault-injected native delay and BLOCKS the
+// caller until it completes — run it under a WedgeGuard deadline
+// shorter than hold_ms and the deadline miss dumps a flight tail whose
+// per-thread table names the stalled worker and its last event.
+int brpc_flight_stall_probe(int hold_ms) {
+  StallSt st;
+  st.hold_ms = hold_ms;
+  bthread::Executor::global()->submit(stall_task, &st);
+  while (!st.done.load(std::memory_order_acquire)) {
+    usleep(1000);
+  }
+  return 0;
+}
+
+// ---- syscall attribution (ISSUE 15 satellite; ROADMAP 1(e)) ----
+
+void brpc_syscall_counters(int64_t* read_sys, int64_t* write_sys,
+                           int64_t* batch_hits, int64_t* batch_misses) {
+  brpc::Socket::SyscallCounters(read_sys, write_sys, batch_hits,
+                                batch_misses);
+}
+
+// Fills up to n log2 buckets of the bytes-per-write histogram
+// (<=64B, <=128B, ... open-ended); returns the bucket count.
+int brpc_write_size_hist(int64_t* out, int n) {
+  return brpc::Socket::WriteSizeHist(out, n);
+}
+
+int brpc_socket_syscalls(uint64_t sid, int64_t* read_sys,
+                         int64_t* write_sys) {
+  brpc::Socket* s = brpc::Socket::Address(sid);
+  if (s == nullptr) return -1;
+  if (read_sys) *read_sys = s->read_syscalls();
+  if (write_sys) *write_sys = s->write_syscalls();
+  s->Dereference();
+  return 0;
 }
 
 }  // extern "C"

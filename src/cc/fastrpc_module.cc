@@ -18,6 +18,7 @@
 #include <atomic>
 #include <cstring>
 
+#include "butil/flight.h"
 #include "butil/iobuf.h"
 #include "net/rpc.h"
 #include "net/socket.h"
@@ -374,6 +375,11 @@ PyObject* py_spanq_push(PyObject*, PyObject* arg) {
 PyObject* py_spanq_drain(PyObject*, PyObject*) {
   int64_t count = 0;
   brpc_spanq::Node* chain = g_spanq.drain_fifo(&count);
+  if (count > 0) {
+    // drain cadence on the collector thread (one event per BATCH; the
+    // per-span push stays event-free, same discipline as TokenRing)
+    butil::flight::record(butil::flight::EV_SPANQ_DRAIN, 0, count);
+  }
   PyObject* out = PyList_New((Py_ssize_t)count);
   if (out == nullptr) {
     // push the chain back so the spans are not lost (order within

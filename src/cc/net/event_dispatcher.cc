@@ -9,9 +9,14 @@
 #include <thread>
 
 #include "butil/common.h"
+#include "butil/flight.h"
 #include "net/rpc.h"
 
 namespace brpc {
+
+// Monotonic naming index for the flight recorder's per-thread table
+// ("epoll/0", "epoll/1", ...).
+static std::atomic<int> g_dispatcher_seq{0};
 
 EventDispatcher::EventDispatcher() {
   _epfd = epoll_create1(EPOLL_CLOEXEC);
@@ -101,6 +106,8 @@ void EventDispatcher::Run() {
   // leaves half the ready sockets for the NEXT epoll round — every
   // affected request eats a whole extra drain cycle, which showed up as
   // a clean 2x p50 tail.
+  butil::flight::set_thread_name(
+      "epoll/%d", g_dispatcher_seq.fetch_add(1, std::memory_order_relaxed));
   epoll_event events[512];
   while (!_stop.load(std::memory_order_acquire)) {
     const int n = epoll_wait(_epfd, events, 512, 1000);
@@ -123,6 +130,8 @@ void EventDispatcher::Run() {
       Socket* s = Socket::Address(sid);
       if (s == nullptr) continue;  // stale: slot recycled, drop
       if (events[i].events & (EPOLLIN | EPOLLRDHUP | EPOLLHUP | EPOLLERR)) {
+        butil::flight::record(butil::flight::EV_SOCK_EPOLLIN, sid,
+                              (int64_t)events[i].events);
         s->OnReadable();
       }
       if (events[i].events & EPOLLOUT) {

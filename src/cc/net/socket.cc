@@ -17,6 +17,7 @@
 #include "bthread/execution_queue.h"
 #include "bthread/executor.h"
 #include "bthread/fiber.h"
+#include "butil/flight.h"
 #include "bvar/combiner.h"
 #include "net/event_dispatcher.h"
 #include "net/h2.h"
@@ -40,6 +41,46 @@ void Socket::GlobalTraffic(int64_t* nread, int64_t* nwritten, int64_t* nmsg) {
   if (nread) *nread = g_total_read_bytes.get();
   if (nwritten) *nwritten = g_total_written_bytes.get();
   if (nmsg) *nmsg = g_total_messages.get();
+}
+
+// Syscall attribution (ISSUE 15 / ROADMAP 1(e)): on this class of box a
+// 64-byte loopback send costs the same ~260us as a 16KB one — syscall
+// COUNT, not bytes, is the floor — so the frame-coalescing work needs
+// these as its before/after metric.
+static bvar::Adder g_read_syscalls;
+static bvar::Adder g_write_syscalls;
+static bvar::Adder g_batch_hits;    // writes coalesced into the TLS batch
+static bvar::Adder g_batch_misses;  // writes that had to take their own path
+// log2-bucketed bytes-per-write histogram; exact atomics, not combiner
+// cells — 16 counters bumped once per SYSCALL are not a hot cacheline.
+static std::atomic<int64_t> g_write_size_hist[Socket::kWriteHistBuckets];
+
+static void note_write_syscall(ssize_t nw) {
+  g_write_syscalls.add(1);
+  if (nw <= 0) return;
+  int idx = 0;
+  uint64_t bound = 64;
+  while (idx < Socket::kWriteHistBuckets - 1 && (uint64_t)nw > bound) {
+    bound <<= 1;
+    ++idx;
+  }
+  g_write_size_hist[idx].fetch_add(1, std::memory_order_relaxed);
+}
+
+void Socket::SyscallCounters(int64_t* read_sys, int64_t* write_sys,
+                             int64_t* batch_hits, int64_t* batch_misses) {
+  if (read_sys) *read_sys = g_read_syscalls.get();
+  if (write_sys) *write_sys = g_write_syscalls.get();
+  if (batch_hits) *batch_hits = g_batch_hits.get();
+  if (batch_misses) *batch_misses = g_batch_misses.get();
+}
+
+int Socket::WriteSizeHist(int64_t* out, int n) {
+  const int m = n < kWriteHistBuckets ? n : kWriteHistBuckets;
+  for (int i = 0; i < m; ++i) {
+    out[i] = g_write_size_hist[i].load(std::memory_order_relaxed);
+  }
+  return m;
 }
 // Per-socket unwritten-byte cap (reference FLAGS_socket_max_unwritten_bytes;
 // EOVERCROWDED backpressure, socket.h:326-380).
@@ -91,6 +132,8 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->_nread.store(0, std::memory_order_relaxed);
   s->_nwritten.store(0, std::memory_order_relaxed);
   s->_nmsg.store(0, std::memory_order_relaxed);
+  s->_read_sys.store(0, std::memory_order_relaxed);
+  s->_write_sys.store(0, std::memory_order_relaxed);
   s->FillRemoteAddr();
   if (opts.on_response != nullptr && !opts.response_inline) {
     // rpc client socket: responses ride the FIFO lane; create it HERE,
@@ -102,6 +145,7 @@ int Socket::Create(const SocketOptions& opts, SocketId* id_out) {
   s->_vref.store(((uint64_t)version << 32) | 1, std::memory_order_release);
   g_active_sockets.fetch_add(1, std::memory_order_relaxed);
   *id_out = s->_id;
+  butil::flight::record(butil::flight::EV_SOCK_CREATE, s->_id, opts.fd);
   if (opts.fd >= 0) {
     make_nonblocking(opts.fd);
     if (!opts.is_listener) {
@@ -154,6 +198,7 @@ int Socket::SetFailed(SocketId id, int error_code) {
   }
   if (won) {
     s->_error_code = error_code;
+    butil::flight::record(butil::flight::EV_SOCK_FAILED, id, error_code);
     // a KeepWrite fiber parked on writability must not sleep through the
     // failure (the dispatcher is being detached; no EPOLLOUT will come)
     s->_epollout_butex.value.fetch_add(1, std::memory_order_acq_rel);
@@ -195,6 +240,7 @@ int Socket::SetFailed(SocketId id, int error_code) {
 
 void Socket::CloseFd() {
   if (_fd >= 0) {
+    butil::flight::record(butil::flight::EV_SOCK_CLOSE, _id, _fd);
     close(_fd);
     _fd = -1;
   }
@@ -273,6 +319,7 @@ butil::IOBuf* Socket::CurrentBatchFor(SocketId sid, size_t more) {
               (int64_t)tls_batch_buf->size() + (int64_t)more > limit) {
     return nullptr;  // stalled peer: Write path drops with EOVERCROWDED
   }
+  g_batch_hits.add(1);
   return tls_batch_buf;
 }
 
@@ -293,6 +340,7 @@ int Socket::Write(butil::IOBuf&& data, bool admitted) {
       return -2;  // EOVERCROWDED
     }
     tls_batch_buf->append(std::move(data));
+    g_batch_hits.add(1);
     return 0;
   }
   if (failed()) return -1;
@@ -301,6 +349,10 @@ int Socket::Write(butil::IOBuf&& data, bool admitted) {
           limit) {
     return -2;  // EOVERCROWDED
   }
+  // `admitted` writes are the batch's own deferred flush — one write
+  // carrying many coalesced frames — so only unadmitted direct writes
+  // count as coalescing misses.
+  if (!admitted) g_batch_misses.add(1);
   _pending_write.fetch_add((int64_t)data.size(), std::memory_order_relaxed);
   auto* req = new WriteRequest{std::move(data), nullptr};
   WriteRequest* old = _write_stack.load(std::memory_order_relaxed);
@@ -358,7 +410,13 @@ void Socket::DrainWriteQueue(bool from_keepwrite) {
       return;
     }
     while (!_out_buf.empty()) {
+      butil::flight::record(butil::flight::EV_WRITE_ENTER, _id,
+                            (int64_t)_out_buf.size());
       const ssize_t nw = _out_buf.cut_into_file_descriptor(_fd);
+      note_write_syscall(nw);
+      _write_sys.fetch_add(1, std::memory_order_relaxed);
+      butil::flight::record(butil::flight::EV_WRITE_EXIT, _id,
+                            nw >= 0 ? (int64_t)nw : (int64_t)-errno);
       if (nw >= 0) {
         _nwritten.fetch_add(nw, std::memory_order_relaxed);
         _pending_write.fetch_sub(nw, std::memory_order_relaxed);
@@ -447,7 +505,12 @@ void Socket::OnReadable() {
     // interleave with later ciphertext reads.
     butil::IOPortal local;
     butil::IOPortal& buf = filtered ? local : _read_buf;
+    butil::flight::record(butil::flight::EV_READ_ENTER, _id);
     const ssize_t nr = buf.append_from_file_descriptor(_fd, 256 * 1024);
+    g_read_syscalls.add(1);
+    _read_sys.fetch_add(1, std::memory_order_relaxed);
+    butil::flight::record(butil::flight::EV_READ_EXIT, _id,
+                          nr >= 0 ? (int64_t)nr : (int64_t)-errno);
     if (nr > 0) {
       _nread.fetch_add(nr, std::memory_order_relaxed);
       g_total_read_bytes.add(nr);

@@ -105,6 +105,16 @@ class Socket {
   static int64_t active_count();
   // Process-wide traffic totals (bvar combiner cells; SURVEY §2.7).
   static void GlobalTraffic(int64_t* nread, int64_t* nwritten, int64_t* nmsg);
+  // Syscall attribution (ISSUE 15 / ROADMAP 1(e)): process-wide read/
+  // write syscall counts plus the dispatch write batch's coalescing
+  // hit/miss counters — the before/after metric for frame coalescing.
+  static void SyscallCounters(int64_t* read_sys, int64_t* write_sys,
+                              int64_t* batch_hits, int64_t* batch_misses);
+  // bytes-per-write histogram: log2 buckets starting at <=64B; bucket i
+  // counts writes of size in (64*2^(i-1), 64*2^i], the last bucket is
+  // open-ended.  Fills up to n buckets, returns the bucket count.
+  static constexpr int kWriteHistBuckets = 16;
+  static int WriteSizeHist(int64_t* out, int n);
 
   void Dereference();
 
@@ -160,6 +170,12 @@ class Socket {
   int64_t bytes_read() const { return _nread.load(std::memory_order_relaxed); }
   int64_t bytes_written() const { return _nwritten.load(std::memory_order_relaxed); }
   int64_t messages_read() const { return _nmsg.load(std::memory_order_relaxed); }
+  int64_t read_syscalls() const {
+    return _read_sys.load(std::memory_order_relaxed);
+  }
+  int64_t write_syscalls() const {
+    return _write_sys.load(std::memory_order_relaxed);
+  }
   int64_t remote_port() const { return _remote_port; }
   const char* remote_ip() const { return _remote_ip; }
 
@@ -248,6 +264,9 @@ class Socket {
   std::atomic<int64_t> _fifo_pending_bytes{0};
 
   std::atomic<int64_t> _nread{0}, _nwritten{0}, _nmsg{0};
+  // per-socket syscall attribution (ISSUE 15): how many read/write
+  // syscalls this connection has cost, next to the byte totals above
+  std::atomic<int64_t> _read_sys{0}, _write_sys{0};
   // Native h2 server session (opts.h2_native): created on the dispatch
   // thread, read by response threads under an Address() reference,
   // deleted at slot recycle (when no references can exist).

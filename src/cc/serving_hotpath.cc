@@ -28,6 +28,8 @@
 #include <cstring>
 #include <mutex>
 
+#include "butil/flight.h"
+
 namespace {
 
 struct TokenRing {
@@ -44,6 +46,12 @@ struct TokenRing {
   int count = 0;  // tokens buffered
   bool terminal = false;
   int32_t terminal_err = 0;  // 0 = clean completion
+  // flight-recorder sampling counters (ISSUE 15): pop and full-ring
+  // events record 1-in-64 — the autopsy needs "is this ring still
+  // advancing, roughly when did it last", not a per-token ledger, and
+  // a per-token event would blow the <2% emit_fanout overhead gate.
+  std::atomic<uint64_t> pops{0};
+  std::atomic<uint64_t> fulls{0};
 
   // push under mu; returns false when full (never blocks, never grows)
   bool push_locked(int32_t tok) {
@@ -82,7 +90,17 @@ int brpc_tokring_push(void* h, int32_t tok) {
     std::lock_guard<std::mutex> g(r->mu);
     ok = r->push_locked(tok);
   }
-  if (ok) r->cv.notify_one();
+  if (ok) {
+    r->cv.notify_one();
+  } else if ((r->fulls.fetch_add(1, std::memory_order_relaxed) & 63) ==
+             0) {
+    // flight granularity (butil/flight.h): the per-token success path
+    // records nothing — only the interesting transition (ring full,
+    // the engine is about to cut this consumer) leaves an event, and
+    // sampled at that, since a spinning producer hits full per token
+    butil::flight::record(butil::flight::EV_RING_FULL,
+                          (uint64_t)(uintptr_t)h);
+  }
   return ok ? 1 : 0;
 }
 
@@ -107,6 +125,10 @@ int brpc_tokring_push_many(void** rings, const int32_t* toks, int n,
     }
     if (ok_out != nullptr) ok_out[i] = pushed ? 1 : 0;
   }
+  // one event per STEP CALL, not per ring — what the wedge autopsy
+  // needs ("did the step loop keep advancing?") at batch cost
+  butil::flight::record(butil::flight::EV_RING_PUSH,
+                        n > 0 ? (uint64_t)(uintptr_t)rings[0] : 0, ok);
   return ok;
 }
 
@@ -126,6 +148,8 @@ int brpc_tokring_push_terminal(void* h, int32_t err_code) {
     }
   }
   r->cv.notify_all();
+  butil::flight::record(butil::flight::EV_RING_TERMINAL,
+                        (uint64_t)(uintptr_t)h, err_code);
   return first ? 1 : 0;
 }
 
@@ -151,9 +175,19 @@ int brpc_tokring_pop_many(void* h, int32_t* out, int cap,
     r->head = (r->head + 1) % r->cap;
     --r->count;
   }
+  bool saw_term = false;
   if (r->count == 0 && r->terminal && terminal_out != nullptr) {
     *terminal_out = 1;
     if (err_out != nullptr) *err_out = r->terminal_err;
+    saw_term = true;
+  }
+  g.unlock();  // the record below must not stretch the ring mutex
+  if (n > 0 || saw_term) {
+    const uint64_t k = r->pops.fetch_add(1, std::memory_order_relaxed);
+    if (saw_term || (k & 63) == 0) {
+      butil::flight::record(butil::flight::EV_RING_POP,
+                            (uint64_t)(uintptr_t)h, n);
+    }
   }
   return n;
 }

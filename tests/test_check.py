@@ -482,7 +482,8 @@ def test_witness_snapshot_shows_held_and_waiting(fresh_witness):
     t2.join(10)
 
 
-def test_wedge_guard_timeout_dumps_held_locks(fresh_witness, capsys):
+def test_wedge_guard_timeout_dumps_held_locks(fresh_witness, capsys,
+                                              tmp_path, monkeypatch):
     """The acceptance scenario: a synthetic ABBA DEADLOCK wedges a
     guarded call past its deadline -> the guard SKIPS (bounded suite)
     and dumps every thread's held locks + the witness's cycle to
@@ -490,6 +491,11 @@ def test_wedge_guard_timeout_dumps_held_locks(fresh_witness, capsys):
     import sys
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from wedge_guard import WedgeGuard
+
+    # ISSUE 15: the dump is also archived to a file artifact; keep this
+    # DELIBERATE wedge's artifact out of build/wedge_autopsy so real
+    # harvests stay unpolluted
+    monkeypatch.setenv("BRPC_WEDGE_DUMP_DIR", str(tmp_path))
 
     a = lockprof.InstrumentedLock("tcw.da")
     b = lockprof.InstrumentedLock("tcw.db")

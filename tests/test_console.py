@@ -275,6 +275,9 @@ def test_every_console_route_answers(server):
         "/psserve",
         "/rpcz",
         "/rpcz?trace_id=1", "/brpc_metrics",
+        "/flightrecorder",
+        "/flightrecorder?fmt=json",
+        "/flightrecorder?limit=5",
         "/dashboard", "/vlog", "/hotspots",
         "/hotspots?seconds=0.05",
         "/hotspots?seconds=0.05&fmt=collapsed",

@@ -20,20 +20,85 @@ ISSUE 14: a deadline miss now DUMPS the lock-order witness state
 blocked acquiring what, and any ABBA cycles observed this process) to
 stderr before skipping, so the next tier-1 wedge leaves evidence
 instead of a silent hang.
+
+ISSUE 15: PR 14's witness proved the wedge class is NOT a Python lock
+cycle, so a deadline miss now ALSO dumps the native flight recorder
+(butil/flight.py over src/cc/butil/flight.h): the per-thread table
+naming the LAST event of every native thread (worker/timer/epoll —
+what stopped advancing) plus the merged time-ordered event tail
+(which socket/butex/task it last touched).  And because pytest's
+fd-level capture DISCARDS a skipped test's stderr (the PR 14 dump
+only ever surfaced under `-s`), the same report is also archived to a
+file — $BRPC_WEDGE_DUMP_DIR, default build/wedge_autopsy/ — so a
+deadline miss deep in a captured tier-1 run still leaves the artifact
+on disk (tools/wedge_hunt.py harvests exactly these).
 """
+import os
 import sys
 import threading
+import time
 
 import pytest
 
 
+def _autopsy_dir() -> str:
+    return os.environ.get(
+        "BRPC_WEDGE_DUMP_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "build", "wedge_autopsy"))
+
+
 def _witness_dump(what: str) -> None:
-    """Best-effort held-lock/cycle dump on a wedge (never raises)."""
+    """Best-effort held-lock/cycle + native-flight + python-stack dump
+    on a wedge: to stderr (visible under -s / plain drivers) AND to an
+    artifact file (survives pytest capture).  Never raises."""
+    parts = []
+    try:
+        # every Python thread's stack, from whatever thread calls this:
+        # a main thread blocked inside a wedged ctypes entry shows the
+        # exact call site as its innermost Python frame
+        import traceback
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks = []
+        for ident, frame in frames.items():
+            stacks.append(f"--- thread {names.get(ident, '?')} "
+                          f"({ident}) ---\n"
+                          + "".join(traceback.format_stack(frame)))
+        parts.append(f"\n=== wedge_guard: {what} — python thread "
+                     f"stacks ===\n" + "\n".join(stacks) + "\n")
+    except Exception:
+        pass
     try:
         from brpc_tpu.butil import lockprof
-        sys.stderr.write(
+        parts.append(
             f"\n=== wedge_guard: {what} blew its deadline — lock-order "
             f"witness dump ===\n" + lockprof.witness_report() + "\n")
+    except Exception:
+        pass
+    try:
+        from brpc_tpu.butil import flight
+        if flight.available():
+            parts.append(
+                f"\n=== wedge_guard: {what} — native flight recorder "
+                f"dump (last event of every native thread, then the "
+                f"merged tail) ===\n" + flight.report(limit=120) + "\n")
+    except Exception:
+        pass
+    report = "".join(parts)
+    try:
+        sys.stderr.write(report)
+        sys.stderr.flush()
+    except Exception:
+        pass
+    try:
+        d = _autopsy_dir()
+        os.makedirs(d, exist_ok=True)
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        path = os.path.join(d, f"wedge_{stamp}_pid{os.getpid()}.log")
+        with open(path, "a") as f:
+            f.write(report)
+        sys.stderr.write(f"\n(wedge autopsy archived to {path})\n")
         sys.stderr.flush()
     except Exception:
         pass

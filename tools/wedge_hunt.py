@@ -1,0 +1,158 @@
+"""wedge_hunt — loop the native test modules with the flight recorder
+armed until a wedge leaves evidence (ISSUE 15 satellite).
+
+The intermittent tier-1 native wedge reproduces roughly every other
+full run but never standalone, which made it unharvestable: by the time
+anyone looked, the hang was gone and nothing was written down.  Every
+wedge_guard deadline miss now dumps the lock-order witness AND the
+native flight recorder to stderr (tests/wedge_guard.py), so the missing
+piece is just a driver that keeps running the native modules and
+ARCHIVES the first dump it sees.
+
+Usage:
+    python tools/wedge_hunt.py [--max-runs N] [--out-dir DIR]
+                               [--run-timeout SECONDS] [--modules ...]
+    make wedge-hunt
+
+Each iteration runs the native test modules (the PR 11 wedge's habitat:
+test_native_{core,rpc,profiler,socket,hotpath,bvar} + test_iobuf_native)
+in a pytest subprocess.  On the first run whose output carries a
+wedge-guard dump marker — or that blows the whole-run timeout, the
+wedge outliving even the guards — the full output is archived under
+--out-dir with a timestamp and the hunt stops (exit 0, artifact path on
+stdout).  A hunt that completes --max-runs clean exits 3.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_MODULES = [
+    "tests/test_native_core.py",
+    "tests/test_native_rpc.py",
+    "tests/test_native_profiler.py",
+    "tests/test_native_socket.py",
+    "tests/test_native_hotpath.py",
+    "tests/test_native_bvar.py",
+    "tests/test_iobuf_native.py",
+]
+
+# wedge_guard.py's stderr markers: the deadline-miss skip text and the
+# two dump headers it prints before skipping
+WEDGE_MARKERS = (
+    "blew its deadline",
+    "wedged past",
+    "native flight recorder dump",
+)
+
+
+def run_once(modules: list[str], timeout_s: float,
+             autopsy_dir: str) -> tuple[str, str]:
+    """One pytest pass over the native modules.  Returns
+    (outcome, combined_output) with outcome in {clean, wedge-dump,
+    run-timeout, failures}.
+
+    Detection is belt and braces: wedge_guard archives every
+    deadline-miss dump into $BRPC_WEDGE_DUMP_DIR (pytest's fd capture
+    would otherwise swallow the stderr copy on a skipped test), so a
+    wedge shows up as files in `autopsy_dir` even when the -rs skip
+    summary is the only thing on stdout."""
+    cmd = [sys.executable, "-m", "pytest", "-q", "-rs",
+           "-p", "no:cacheprovider", "-p", "no:randomly",
+           *modules]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               BRPC_WEDGE_DUMP_DIR=autopsy_dir)
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # the wedge outlived every per-call guard: take whatever output
+        # exists and kill the whole process group (pytest + any wedged
+        # daemon threads' process)
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, _ = proc.communicate()
+        return "run-timeout", out or ""
+    dumps = sorted(os.listdir(autopsy_dir)) if os.path.isdir(
+        autopsy_dir) else []
+    if dumps or any(m in out for m in WEDGE_MARKERS):
+        for name in dumps:
+            try:
+                with open(os.path.join(autopsy_dir, name)) as f:
+                    out += (f"\n\n===== archived autopsy {name} "
+                            f"=====\n" + f.read())
+            except OSError:
+                pass
+        return "wedge-dump", out
+    if proc.returncode != 0:
+        return "failures", out
+    return "clean", out
+
+
+def archive(out_dir: str, outcome: str, output: str, run_idx: int) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    stamp = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    path = os.path.join(out_dir, f"wedge_{stamp}_run{run_idx}_{outcome}.log")
+    with open(path, "w") as f:
+        f.write(f"# wedge_hunt artifact · outcome={outcome} · "
+                f"run={run_idx}\n")
+        f.write(f"# the flight-recorder dump below names the last "
+                f"event of every native thread at the miss\n\n")
+        f.write(output)
+    return path
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--max-runs", type=int, default=8,
+                    help="stop after N clean runs (the wedge historically "
+                         "hits ~half of 8)")
+    ap.add_argument("--out-dir", default=os.path.join(REPO, "build",
+                                                      "wedge_hunt"))
+    ap.add_argument("--run-timeout", type=float, default=900.0,
+                    help="whole-run kill timeout per iteration (s)")
+    ap.add_argument("--modules", nargs="*", default=DEFAULT_MODULES)
+    args = ap.parse_args()
+
+    for i in range(1, args.max_runs + 1):
+        t0 = time.monotonic()
+        print(f"wedge_hunt: run {i}/{args.max_runs} over "
+              f"{len(args.modules)} native modules...", flush=True)
+        autopsy_dir = os.path.join(args.out_dir, f"autopsy_run{i}")
+        # fresh per-run dir: stale artifacts from a PREVIOUS hunt must
+        # not read as this run's catch
+        shutil.rmtree(autopsy_dir, ignore_errors=True)
+        os.makedirs(autopsy_dir, exist_ok=True)
+        outcome, out = run_once(args.modules, args.run_timeout,
+                                autopsy_dir)
+        dt = time.monotonic() - t0
+        if outcome in ("wedge-dump", "run-timeout"):
+            path = archive(args.out_dir, outcome, out, i)
+            print(f"wedge_hunt: HARVESTED a {outcome} after {dt:.0f}s "
+                  f"on run {i} — artifact:\n{path}")
+            return 0
+        if outcome == "failures":
+            # real test failures are not the quarry but are evidence of
+            # something; archive and keep hunting
+            path = archive(args.out_dir, outcome, out, i)
+            print(f"wedge_hunt: run {i} had non-wedge failures "
+                  f"({dt:.0f}s); archived to {path}, continuing")
+            continue
+        print(f"wedge_hunt: run {i} clean ({dt:.0f}s)")
+    print(f"wedge_hunt: {args.max_runs} runs, no wedge observed — "
+          f"nothing archived")
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
