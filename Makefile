@@ -102,6 +102,15 @@ disagg: $(LIB) $(PYEXT)
 cluster: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q
 
+# Durable control plane (README "Durable control plane", ISSUE 16):
+# the session-WAL suite (write-ahead discipline, torn tails,
+# compaction, adoption) plus the timed WAL-tax / crash->first-token
+# rung (3-trial median+spread, feeds the same perf_diff gate `make
+# bench` ends with).  CPU jit path.
+durable: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_session_wal.py -q
+	JAX_PLATFORMS=cpu python bench.py durable
+
 # Real model serving (README "Real model serving", ISSUE 10): the
 # paged-attention equivalence suite (gather + pallas-interpret vs the
 # dense reference at page boundaries / COW forks / evict-readmit), the
@@ -291,5 +300,5 @@ stress:
 	./build/stress_plain
 
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
-    cluster model speculative trace hotspots microbench perf bench \
-    tsan tsan-core asan stress check ring-stress wedge-hunt
+    cluster durable model speculative trace hotspots microbench perf \
+    bench tsan tsan-core asan stress check ring-stress wedge-hunt

@@ -89,7 +89,7 @@ def migration_snapshot() -> dict:
 
 from brpc_tpu.migrate.plane import (  # noqa: E402,F401
     MIGRATE_SERVICE, MigrateService, PageMigrator, chunk_fingerprints,
-    rebalance_pusher, register_migration,
+    make_prefix_fetcher, rebalance_pusher, register_migration,
 )
 from brpc_tpu.migrate.disagg import (  # noqa: E402,F401
     DisaggCoordinator, PrefillReplica, StandbyReplica, StandbySync,

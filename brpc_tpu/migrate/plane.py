@@ -114,6 +114,8 @@ class PageMigrator:
         # a repeat prefix (the 90%-shared steady state) ships only its
         # UN-shipped suffix pages, not the whole chain again
         self._shipped: dict[str, set] = {}
+        # per-source pull-fetch matrix (ISSUE 16, /migration page)
+        self.fetch_routes: dict[str, dict] = {}
         from brpc_tpu import migrate as _migrate
         _migrate._register_migrator(self)
 
@@ -330,10 +332,55 @@ class PageMigrator:
             route["bytes"] += len(send) * pb
         return nfull
 
+    def fetch(self, tokens: Sequence[int], src: str, dest: str) -> int:
+        """PULL-based prefix warm-up (ISSUE 16): ask `src`'s
+        ``_kvmig`` service to push `tokens`' committed prefix to
+        `dest` — normally this process's own migration address, so a
+        cache-MISS replica fetches the prefix from its owner instead
+        of recomputing it.  Returns pages landed (0 when the owner
+        holds none of the prefix); raises RpcError on a dead or
+        refusing owner — the caller's recompute path is the fallback,
+        exactly the ``migrate()`` contract in the other direction."""
+        with stagetag.stage("migrate"):
+            if fault.ENABLED and fault.hit(
+                    "migrate.prefix_fetch", src=src) is not None:
+                with self._mu:
+                    self._fetch_route(src)["failed"] += 1
+                raise errors.RpcError(
+                    errors.EINTERNAL,
+                    f"injected prefix fetch failure from {src}")
+            ch = self._channel(str(src))
+            try:
+                out = ch.channel.call_sync(
+                    MIGRATE_SERVICE, "PushTo",
+                    {"tokens": [int(t) for t in tokens],
+                     "dest": str(dest)},
+                    serializer="json", response_serializer="json")
+            except errors.RpcError:
+                with self._mu:
+                    self._fetch_route(src)["failed"] += 1
+                raise
+            pages = int((out or {}).get("migrated_pages", 0))
+            with self._mu:
+                r = self._fetch_route(src)
+                r["fetches"] += 1
+                r["pages"] += pages
+            return pages
+
+    def _fetch_route(self, src: str) -> dict:
+        # caller holds self._mu
+        r = self.fetch_routes.get(src)
+        if r is None:
+            r = {"fetches": 0, "pages": 0, "failed": 0}
+            self.fetch_routes[src] = r
+        return r
+
     def stats(self) -> dict:
         with self._mu:
             routes = {d: dict(r) for d, r in self.routes.items()}
-        return {"store": self.store.name, "routes": routes}
+            fetches = {s: dict(r) for s, r in self.fetch_routes.items()}
+        return {"store": self.store.name, "routes": routes,
+                "fetch_routes": fetches}
 
 
 class MigrateService(Service):
@@ -509,6 +556,31 @@ def register_migration(server, store,
     svc = MigrateService(store, migrator=migrator)
     server.add_service(svc)
     return svc
+
+
+def make_prefix_fetcher(migrator: PageMigrator, self_addr: str):
+    """Build the ``prefix_fetcher`` hook Serving.Generate calls on a
+    cache miss (ISSUE 16): try each holder the router named (skipping
+    this replica itself) until one push lands, returning pages fetched.
+    Any holder failure falls through to the next; exhausting them
+    returns 0 and the caller recomputes — fetch is an optimization,
+    never a correctness dependency."""
+    self_addr = str(self_addr)
+
+    def fetch(prompt, holders) -> int:
+        for h in holders:
+            h = str(h)
+            if h == self_addr:
+                continue
+            try:
+                pages = migrator.fetch(prompt, h, self_addr)
+            except Exception:
+                continue
+            if pages:
+                return pages
+        return 0
+
+    return fetch
 
 
 def rebalance_pusher(timeout_ms: int = 10_000):

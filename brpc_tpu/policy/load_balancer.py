@@ -233,6 +233,41 @@ class ConsistentHashLB(LoadBalancer):
                     return ep
         return ring[i][1]
 
+    def placement(self, request_code, n: int,
+                  exclude: set | None = None) -> list:
+        """The request's N-WAY PLACEMENT (ISSUE 16): up to `n` DISTINCT
+        endpoints walking the ring from the code's position — the
+        owner (what ``select_server`` returns) first, then the ring
+        successors a failover would land on, i.e. exactly where a
+        replica of this prefix is worth keeping warm.  Healthy
+        endpoints are taken first; broken ones fill remaining slots
+        only when the fleet is too degraded to satisfy `n` otherwise
+        (a placement must stay stable across a brief quarantine, not
+        shrink the replica set)."""
+        from brpc_tpu.policy.health_check import is_broken
+        with self._mu:
+            ring = self._ring
+            keys = self._ring_keys
+        if not ring or n <= 0:
+            return []
+        h = self._map_code(request_code)
+        i = bisect.bisect_left(keys, h) % len(ring)
+        out: list = []
+        broken: list = []
+        for step in range(len(ring)):
+            ep = ring[(i + step) % len(ring)][1]
+            if exclude is not None and ep in exclude:
+                continue
+            if ep in out or ep in broken:
+                continue
+            if is_broken(ep):
+                broken.append(ep)
+            else:
+                out.append(ep)
+            if len(out) >= n:
+                return out
+        return (out + broken)[:n]
+
 
 class ConsistentHashMd5LB(ConsistentHashLB):
     name = "c_md5"

@@ -178,3 +178,7 @@ from brpc_tpu.serving.router import (  # noqa: E402,F401
     ClusterRouter, ReplicaHandle, RouterClient, RouterService,
     SessionTable, register_router,
 )
+from brpc_tpu.serving.session_wal import SessionWAL  # noqa: E402,F401
+from brpc_tpu.serving.cluster_control import (  # noqa: E402,F401
+    CLUSTER_SERVICE, ClusterControlService, register_cluster_control,
+)

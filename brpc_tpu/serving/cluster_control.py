@@ -1,0 +1,132 @@
+"""The ``_cluster`` control service — wire-level overload with epoch
+fencing (ISSUE 16).
+
+PR 8's overload gradient was cluster-wide in POLICY but local in
+MECHANISM: levels 2-4 acted through in-process ``ReplicaHandle``
+components, so a remote-only fleet only ever felt level 1 (less
+traffic forwarded).  This service is the wire half, modeled on bRPC's
+multiplexed control traffic (baidu_std rides control and data on one
+connection — PAPER.md L4): each router tick pushes
+
+    SetFloor {epoch, level, router}   (tensorframe-framed)
+
+to every remote replica.  The replica applies the level through the
+SAME policy as the in-process path
+(:func:`~brpc_tpu.serving.ladder.apply_level_to_components`) and the
+reply carries its pressure report back — so one RPC per tick both
+browns the fleet out together AND feeds the router's gradient the
+remote pressures it could not see before.
+
+EPOCH FENCING.  ``epoch`` is the fleet membership epoch, persisted in
+the session WAL and bumped by every adopting router.  The service
+latches the highest epoch it has seen and REFUSES (EREQUEST, "stale
+epoch") any push carrying a lower one: a superseded router that is
+still ticking — the classic split-brain after a router failover —
+cannot drag the fleet's overload posture around.  A dropped push needs
+no special handling: the router re-pushes every tick (chaos scenario
+17 drives both paths via ``cluster.floor_push``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from brpc_tpu import errors
+from brpc_tpu.butil.lockprof import InstrumentedLock
+from brpc_tpu.rpc.service import Service, method
+from brpc_tpu.serving.ladder import apply_level_to_components
+
+CLUSTER_SERVICE = "_cluster"
+
+
+class ClusterControlService(Service):
+    """Replica-side half of the wire-level overload gradient (see
+    module docstring).  Holds the same component references a local
+    :class:`~brpc_tpu.serving.router.ReplicaHandle` would, and applies
+    pushed levels through the shared policy."""
+
+    NAME = CLUSTER_SERVICE
+
+    def __init__(self, *, supervisor=None, batcher=None, engine=None,
+                 store=None, clamp_new_tokens: int = 32,
+                 evict_pages: Optional[int] = None, name: str = ""):
+        from brpc_tpu.serving.router import ReplicaHandle
+        self.name = name
+        self.clamp_new_tokens = int(clamp_new_tokens)
+        self.evict_pages = evict_pages
+        # a loopback handle purely for its pressures() logic
+        self._handle = ReplicaHandle(
+            "0.0.0.0:0", name=name or "local", supervisor=supervisor,
+            batcher=batcher, engine=engine, store=store)
+        self._mu = InstrumentedLock("cluster.control")
+        self.epoch = 0
+        self.level = 0
+        self.router = ""
+        self.applied = 0
+        self.refusals = 0
+        self.last_push_t: Optional[float] = None
+
+    @method(request="tensorframe", response="tensorframe")
+    def SetFloor(self, cntl, req):
+        req = req or {}
+        epoch = int(req.get("epoch", 0))
+        level = int(req.get("level", 0))
+        with self._mu:
+            if epoch < self.epoch:
+                self.refusals += 1
+                cntl.set_failed(
+                    errors.EREQUEST,
+                    f"stale epoch {epoch} < {self.epoch}: floor push "
+                    f"from a superseded router refused")
+                return None
+            self.epoch = epoch
+            self.level = level
+            self.router = str(req.get("router", ""))
+            self.applied += 1
+            self.last_push_t = time.monotonic()
+        h = self._handle
+        apply_level_to_components(
+            level, supervisor=h.supervisor, batcher=h.batcher,
+            engine=h.engine, store=h.store,
+            clamp_new_tokens=self.clamp_new_tokens,
+            evict_pages=self.evict_pages)
+        resp = {"applied": True, "epoch": epoch, "level": level}
+        for k, v in h.pressures().items():
+            resp[k] = float(v)
+        return resp
+
+    @method(request="tensorframe", response="tensorframe")
+    def Report(self, cntl, req):
+        """Pressure report without a level change — for pollers that
+        are not the fleet's router (no epoch check: reading is free)."""
+        resp = {"epoch": self.epoch, "level": self.level}
+        for k, v in self._handle.pressures().items():
+            resp[k] = float(v)
+        return resp
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "epoch": self.epoch,
+                "level": self.level,
+                "router": self.router,
+                "applied": self.applied,
+                "refusals": self.refusals,
+                "push_age_s": (round(time.monotonic() - self.last_push_t,
+                                     3) if self.last_push_t else None),
+            }
+
+
+def register_cluster_control(server, *, supervisor=None, batcher=None,
+                             engine=None, store=None,
+                             clamp_new_tokens: int = 32,
+                             evict_pages: Optional[int] = None,
+                             name: str = "") -> ClusterControlService:
+    """Expose this replica to the wire-level overload gradient (call
+    before ``server.start()``)."""
+    svc = ClusterControlService(
+        supervisor=supervisor, batcher=batcher, engine=engine,
+        store=store, clamp_new_tokens=clamp_new_tokens,
+        evict_pages=evict_pages, name=name)
+    server.add_service(svc)
+    return svc

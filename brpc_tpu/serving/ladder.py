@@ -112,3 +112,41 @@ class OverloadLadder:
             "escalations": list(self.escalations[1:]),
             "de_escalations": self.de_escalations,
         }
+
+
+def apply_level_to_components(level: int, *, supervisor=None,
+                              batcher=None, engine=None, store=None,
+                              clamp_new_tokens: int = 32,
+                              evict_pages=None) -> None:
+    """Drive one replica's components to the cluster gradient `level`
+    — the SHARED half of the router's four-level gradient, extracted
+    (ISSUE 16) so the in-process path (``ClusterRouter._apply_level``
+    over local handles) and the wire path (the ``_cluster`` control
+    service applying a remote router's floor push) are literally the
+    same policy:
+
+      * a SUPERVISOR keeps its own ladder — it is held at a floor one
+        below the cluster level (its 3 local levels sit under the
+        router's shed level) and drives its own components;
+      * otherwise: level >= 2 brownouts the batcher, >= 3 clamps new
+        generations' budgets, >= 4 evicts pages each application.
+    """
+    if supervisor is not None:
+        supervisor.set_level_floor(max(0, int(level) - 1))
+        return
+    if batcher is not None:
+        batcher.brownout = max(batcher.brownout, 1) \
+            if level >= 2 else 0
+    if engine is not None:
+        engine.degraded_clamp = clamp_new_tokens if level >= 3 else None
+    if level >= 4 and store is not None:
+        n = evict_pages
+        if n is None:
+            try:
+                n = store.pagepool.pages_per_block
+            except Exception:
+                n = 4
+        try:
+            store.evict_pages(n)
+        except Exception:
+            pass

@@ -181,9 +181,10 @@ class Session:
 
     __slots__ = ("sid", "prompt", "budget", "emitted", "state",
                  "error_code", "replica", "resumes", "re_decoded_tokens",
-                 "replicated_pages", "shipped_pages", "created_t",
+                 "replicated_pages", "shipped_pages", "replicate",
+                 "created_t",
                  "finished_t", "trace", "mu", "delivery_mu", "_sink",
-                 "_sink_done", "attach_epoch")
+                 "_sink_done", "attach_epoch", "wal", "_sink_from")
 
     def __init__(self, sid: str, prompt: Sequence[int], budget: int):
         self.sid = sid
@@ -197,6 +198,9 @@ class Session:
         self.re_decoded_tokens = 0
         self.replicated_pages = 0        # pushed to the ring buddy
         self.shipped_pages = 0           # full pages already enqueued
+        # per-session replication opt-out: short interactive jobs whose
+        # recompute is cheaper than shipping their pages set this False
+        self.replicate = True
         self.created_t = time.monotonic()
         self.finished_t: Optional[float] = None
         self.trace = rpcz.current_trace_ctx()
@@ -207,6 +211,13 @@ class Session:
         self._sink: Optional[Callable[[int], None]] = None
         self._sink_done: Optional[Callable] = None
         self.attach_epoch = 0
+        # the table's session WAL (ISSUE 16), or None: every append /
+        # terminal is logged write-ahead of client delivery
+        self.wal = None
+        # delivery suppressed up to this cursor: a client that attached
+        # AHEAD of the record (its tokens outran a failed WAL append
+        # before the crash) must not re-receive the re-decoded gap
+        self._sink_from = 0
 
     @property
     def cursor(self) -> int:
@@ -221,7 +232,15 @@ class Session:
             with self.mu:
                 self.emitted.append(int(tok))
                 cur = len(self.emitted)
-                sink = self._sink
+                sink = self._sink if cur > self._sink_from else None
+            if self.wal is not None:
+                # WRITE-AHEAD: the durable record reaches disk before
+                # the client can see the token, so a successor process
+                # replaying the WAL is never behind a presented cursor.
+                # append_tok never raises — a failed append parks on
+                # the WAL's self-healing pending tail and this session
+                # degrades to recompute-on-resume, never a lost token.
+                self.wal.append_tok(self.sid, int(tok), cur)
             if sink is not None:
                 try:
                     sink(int(tok))
@@ -240,17 +259,31 @@ class Session:
         recorded token past it, then subscribe for live tokens.  A
         newer attach wins (the previous client is detached).  Returns
         the number of tokens replayed.  If the session already
-        finished, the terminal is delivered after the replay."""
-        if cursor < 0 or cursor > len(self.emitted):
-            raise errors.RpcError(
-                errors.EREQUEST,
-                f"cursor {cursor} outside the recorded stream "
-                f"({len(self.emitted)} tokens)")
+        finished, the terminal is delivered after the replay.
+
+        A cursor AHEAD of the record is legal while the session can
+        still decode (ISSUE 16): it means the client saw tokens whose
+        WAL append failed before a crash.  The driver re-decodes the
+        gap bit-exact and delivery stays suppressed up to the cursor
+        (``_sink_from``), so the client receives exactly the tokens
+        past its cursor — recompute-on-resume, never a duplicate.  On
+        a TERMINAL session the record can't grow, so a cursor beyond
+        it is a client error as before."""
+        if cursor < 0:
+            raise errors.RpcError(errors.EREQUEST,
+                                  f"negative cursor {cursor}")
         with self.delivery_mu:
             with self.mu:
+                if cursor > len(self.emitted) and \
+                        self.state in ("finished", "failed"):
+                    raise errors.RpcError(
+                        errors.EREQUEST,
+                        f"cursor {cursor} outside the recorded stream "
+                        f"({len(self.emitted)} tokens)")
                 self.attach_epoch += 1
                 self._sink = None        # fence the previous client
                 self._sink_done = None
+                self._sink_from = cursor
                 backlog = self.emitted[cursor:]
                 state, err_code = self.state, self.error_code
             for t in backlog:
@@ -285,6 +318,9 @@ class Session:
                 sink_done = self._sink_done
                 self._sink = None
                 self._sink_done = None
+            if self.wal is not None:
+                # terminal logged ahead of delivery, same discipline
+                self.wal.append_fin(self.sid, self.error_code)
             if sink_done is not None:
                 try:
                     sink_done(err)
@@ -314,21 +350,91 @@ class SessionTable:
     :class:`ClusterRouter` over the SAME table and every in-flight
     session resumes instead of recomputing.  Finished sessions are
     kept (bounded ring) so a late reconnect can still replay its
-    tail."""
+    tail.
 
-    def __init__(self, *, keep_finished: int = 512):
+    With ``wal=`` a path (or a :class:`~brpc_tpu.serving.session_wal.
+    SessionWAL`), every mutation is also logged write-ahead of client
+    delivery, and :meth:`recover` rebuilds the table in a FRESH
+    PROCESS — the durable half of the control plane (ISSUE 16)."""
+
+    def __init__(self, *, keep_finished: int = 512, wal=None):
         self._mu = InstrumentedLock("router.sessions")
         self._sessions: dict[str, Session] = {}
         self._finished: deque = deque(maxlen=max(keep_finished, 1))
         self.keep_finished = int(keep_finished)
         self.opened_total = 0
+        self.replay_stats: Optional[dict] = None
+        if wal is not None and not hasattr(wal, "append_tok"):
+            from brpc_tpu.serving.session_wal import SessionWAL
+            wal = SessionWAL(str(wal))
+        self.wal = wal
+        if wal is not None:
+            wal.snapshot_source = self._wal_snapshot
+
+    @classmethod
+    def recover(cls, path, *, keep_finished: int = 512,
+                **wal_kwargs) -> "SessionTable":
+        """Rebuild a table from a session WAL in a fresh process: every
+        recovered non-terminal session comes back SUSPENDED (its driver
+        died with the old router) at its recorded cursor, terminal ones
+        land in the keep-ring so late reconnects still replay.  The
+        replayed state is immediately compacted (adoption is the
+        natural compaction point) and ``replay_stats`` records what the
+        adoption replayed for the /cluster page."""
+        from brpc_tpu.serving.session_wal import SessionWAL
+        wal = SessionWAL(str(path), **wal_kwargs)
+        t = cls(keep_finished=keep_finished, wal=wal)
+        recovered, wal.recovered = wal.recovered, {}
+        live = finished = 0
+        for sid, rec in recovered.items():
+            s = Session(sid, rec["prompt"], rec["budget"])
+            s.emitted = list(rec["emitted"])
+            s.state = rec["state"]
+            s.error_code = rec["error_code"]
+            if s.state == "running":
+                s.state = "suspended"
+            s.wal = wal
+            t._sessions[sid] = s
+            if s.state in ("finished", "failed"):
+                s.finished_t = time.monotonic()
+                t._finished.append(s)
+                finished += 1
+            else:
+                live += 1
+        t.opened_total = len(recovered)
+        t.replay_stats = dict(wal.replay)
+        t.replay_stats.update({"live": live, "finished": finished})
+        wal.compact()
+        return t
+
+    def _wal_snapshot(self) -> list[dict]:
+        """Compaction source: the full current state of every session
+        still in the table, as the dicts a ``snap`` record holds.
+        Called UNDER the WAL lock (wal._mu -> table._mu -> session.mu
+        is the documented order)."""
+        with self._mu:
+            sessions = list(self._sessions.values())
+        out = []
+        for s in sessions:
+            with s.mu:
+                out.append({"sid": s.sid, "prompt": list(s.prompt),
+                            "budget": s.budget,
+                            "emitted": list(s.emitted),
+                            "state": s.state,
+                            "error_code": s.error_code})
+        return out
 
     def new_session(self, prompt: Sequence[int], budget: int) -> Session:
         sid = uuid.uuid4().hex[:16]
         s = Session(sid, prompt, budget)
+        s.wal = self.wal
         with self._mu:
             self._sessions[sid] = s
             self.opened_total += 1
+        if self.wal is not None:
+            # logged after the insert but before any token can flow
+            # (the driver starts only after open_session returns)
+            self.wal.append_open(sid, s.prompt, s.budget)
         return s
 
     def get(self, sid: str) -> Optional[Session]:
@@ -381,6 +487,12 @@ class SessionTable:
         sessions.sort(key=lambda s: s.created_t)
         return [s.snapshot() for s in sessions[-limit:]]
 
+    def close(self) -> None:
+        """Close the table's WAL (if any).  The table itself needs no
+        teardown — it is plain caller-owned state."""
+        if self.wal is not None:
+            self.wal.close()
+
 
 class _ForwardCollector:
     """Stream handler for ONE forward attempt: tokens go straight into
@@ -431,6 +543,7 @@ class ClusterRouter:
 
     def __init__(self, replicas: Sequence, *,
                  sessions: Optional[SessionTable] = None,
+                 wal=None,
                  limiter=None,
                  max_sessions: int = 256,
                  ladder: Sequence[dict] = DEFAULT_ROUTER_LADDER,
@@ -438,6 +551,7 @@ class ClusterRouter:
                  check_interval_s: float = 0.05,
                  auto_tick: bool = True,
                  replicate_sessions: bool = False,
+                 replication_factor: int = 2,
                  page_tokens: int = 16,
                  chunk_tokens: int = 16,
                  clamp_new_tokens: int = 32,
@@ -446,6 +560,8 @@ class ClusterRouter:
                  failure_window_s: float = 60.0,
                  name: str = "router",
                  timeout_ms: int = 10_000,
+                 control_timeout_ms: int = 2_000,
+                 epoch: Optional[int] = None,
                  progress_timeout_s: float = 30.0):
         from brpc_tpu.policy.load_balancer import PrefixAffinityLB
         from brpc_tpu.rpc.channel import Channel
@@ -453,6 +569,7 @@ class ClusterRouter:
 
         self.name = name
         self.timeout_ms = int(timeout_ms)
+        self.control_timeout_ms = int(control_timeout_ms)
         self.progress_timeout_s = float(progress_timeout_s)
         self.chunk_tokens = int(chunk_tokens)
         self.page_tokens = int(page_tokens)
@@ -462,6 +579,9 @@ class ClusterRouter:
         self.quarantine_after = int(quarantine_after)
         self.failure_window_s = float(failure_window_s)
         self.replicate_sessions = bool(replicate_sessions)
+        # N-way placement (ISSUE 16): total copies of a prefix on the
+        # affinity ring — the owner plus replication_factor-1 buddies
+        self.replication_factor = max(1, int(replication_factor))
         self.check_interval_s = float(check_interval_s)
 
         self.replicas: list[ReplicaHandle] = [
@@ -483,10 +603,24 @@ class ClusterRouter:
             self._ep_by_name[str(h.endpoint)] = h.endpoint
             self._ep_by_name[h.addr] = h.endpoint
 
-        self.sessions = sessions if sessions is not None else SessionTable()
+        if sessions is not None:
+            self.sessions = sessions
+        else:
+            self.sessions = SessionTable(wal=wal)
         # adopting a table from a dead router: its running sessions have
         # no driver anymore — suspend them so attach restarts the drive
         self.sessions.suspend_running()
+
+        # membership epoch (ISSUE 16): every floor push carries it and
+        # replicas fence pushes from superseded routers.  A router over
+        # a WAL bumps the PERSISTED epoch so a successor process always
+        # strictly supersedes the router whose log it adopted.
+        if epoch is not None:
+            self.epoch = int(epoch)
+        elif self.sessions.wal is not None:
+            self.epoch = self.sessions.wal.bump_epoch()
+        else:
+            self.epoch = 1
 
         if limiter is not None:
             from brpc_tpu.policy.concurrency_limiter import create_limiter
@@ -499,6 +633,23 @@ class ClusterRouter:
         self._mu = InstrumentedLock("router.state")
         self._failures: dict = {}        # endpoint -> [monotonic times]
         self._drivers: dict[str, threading.Thread] = {}
+
+        # wire-level overload (ISSUE 16): per-remote-replica floor-push
+        # state (epoch/level acked, push/ack times, last error) and the
+        # freshest pressure report each SetFloor reply carried back
+        self._ctrl_chan_by_ep: dict = {}
+        self._remote_floor: dict = {}    # endpoint -> state dict
+        self.floor_pushes = 0
+        self.floor_push_drops = 0
+        self.floor_push_refused = 0
+
+        # ownership directory (ISSUE 16): prefix fingerprint -> where
+        # its pages actually are (owner + buddies that acked a push) —
+        # forwarded as the prefix_holders hint so a cache-miss replica
+        # can PULL the prefix instead of recomputing
+        from collections import OrderedDict
+        self._placement_dir: "OrderedDict[int, dict]" = OrderedDict()
+        self._placement_cap = 256
 
         safe = re.sub(r"\W", "_", name)
         from brpc_tpu.bvar.variable import exposed_variables
@@ -629,7 +780,11 @@ class ClusterRouter:
         excluded: set = set()
         attempts = 0
         max_attempts = 3 * len(self.replicas) + 3
-        first_attempt = True
+        # a session with recorded tokens at drive entry is a RESUME
+        # (router restart / WAL adoption): its first forward re-sends
+        # prompt+emitted and must account re-decoded tokens like any
+        # mid-drive failover would
+        first_attempt = s.cursor == 0
         try:
             while self._running:
                 with s.mu:
@@ -678,11 +833,18 @@ class ClusterRouter:
                 cntl = Controller(timeout_ms=self.timeout_ms)
                 stream = stream_create(cntl, col)
                 t0 = time.monotonic()
+                fwd = {"prompt": resume_prompt,
+                       "max_new_tokens": remaining}
+                holders = self._holders_for(fp, exclude_addr=str(ep))
+                if holders:
+                    # pull-based prefix fetch (ISSUE 16): tell the
+                    # target where this prefix's pages already are so a
+                    # cache miss warms itself from an owner over the
+                    # migrator instead of re-prefilling
+                    fwd["prefix_holders"] = holders
                 try:
                     resp = chan.call_sync(
-                        "Serving", "Generate",
-                        {"prompt": resume_prompt,
-                         "max_new_tokens": remaining},
+                        "Serving", "Generate", fwd,
                         serializer="json", cntl=cntl)
                 except errors.RpcError as e:
                     # the forward RPC itself failed (replica server
@@ -700,6 +862,10 @@ class ClusterRouter:
                     first_attempt = False
                     continue
                 self.forwards.add(1)
+                buddy_addr = self._by_ep.get(ep)
+                self._note_placement(fp, owner=(
+                    buddy_addr.addr if buddy_addr is not None
+                    else str(ep)))
                 hit = int((resp or {}).get("prefix_hit", 0))
                 with s.mu:
                     s.replica = str(ep)
@@ -815,7 +981,7 @@ class ClusterRouter:
     # ---- buddy replication (resume-over-migration) ----
 
     def _on_session_progress(self, s: Session, cursor: int) -> None:
-        if not self.replicate_sessions:
+        if not self.replicate_sessions or not s.replicate:
             return
         with s.mu:
             full = (len(s.prompt) + cursor) // self.page_tokens
@@ -849,10 +1015,12 @@ class ClusterRouter:
 
     def _ship_one(self, sid: str) -> None:
         """Ask the session's serving replica to push its committed
-        full pages to the ring BUDDY — the replica a failover of this
-        prefix would land on — over the ``_kvmig`` PushTo RPC.  A
-        failing push degrades the future resume to recompute; it never
-        touches the token path."""
+        full pages to its ring BUDDIES — the ``replication_factor - 1``
+        ring successors a failover of this prefix would land on — over
+        the ``_kvmig`` PushTo RPC, and record the resulting N-way
+        placement in the ownership directory.  A failing push degrades
+        the future resume to recompute; it never touches the token
+        path."""
         from brpc_tpu.policy.load_balancer import prefix_fingerprint
         s = self.sessions.get(sid)
         if s is None:
@@ -864,10 +1032,12 @@ class ClusterRouter:
             cur_addr = s.replica
         cur_ep = self._ep_by_name.get(cur_addr)
         fp = prefix_fingerprint(s.prompt, self.chunk_tokens)
-        buddy = self._lb.select_server(
-            exclude={cur_ep} if cur_ep is not None else set(),
-            request_code=fp)
-        if buddy is None or str(buddy) == cur_addr:
+        buddies = self._lb.placement(
+            fp, self.replication_factor,
+            exclude={cur_ep} if cur_ep is not None else None)
+        buddies = [b for b in buddies if str(b) != cur_addr]
+        buddies = buddies[:max(0, self.replication_factor - 1)]
+        if not buddies:
             return
         picked = self._chan_by_ep.get(cur_ep)
         if picked is None:
@@ -875,16 +1045,77 @@ class ClusterRouter:
         full = len(toks) // self.page_tokens * self.page_tokens
         if not full:
             return
-        buddy_h = self._by_ep.get(buddy)
-        dest = buddy_h.addr if buddy_h is not None else str(buddy)
-        out = picked.call_sync(
-            "_kvmig", "PushTo",
-            {"tokens": toks[:full], "dest": dest},
-            serializer="json", response_serializer="json")
-        pages = int((out or {}).get("migrated_pages", 0))
-        if pages:
+        best = 0
+        acked: list[str] = []
+        for buddy in buddies:
+            buddy_h = self._by_ep.get(buddy)
+            dest = buddy_h.addr if buddy_h is not None else str(buddy)
+            try:
+                out = picked.call_sync(
+                    "_kvmig", "PushTo",
+                    {"tokens": toks[:full], "dest": dest},
+                    serializer="json", response_serializer="json")
+            except errors.RpcError:
+                # this buddy degrades to recompute; the others still
+                # get their copy
+                continue
+            pages = int((out or {}).get("migrated_pages", 0))
+            if pages:
+                best = max(best, pages)
+                acked.append(dest)
+        self._note_placement(fp, owner=cur_addr, buddies=acked)
+        if best:
             with s.mu:
-                s.replicated_pages = max(s.replicated_pages, pages)
+                s.replicated_pages = max(s.replicated_pages, best)
+
+    # ---- the ownership directory (N-way placement, ISSUE 16) ----
+
+    def _note_placement(self, fp: int, *, owner: Optional[str] = None,
+                        buddies: Optional[Sequence[str]] = None) -> None:
+        with self._mu:
+            rec = self._placement_dir.get(fp)
+            if rec is None:
+                rec = {"owner": None, "buddies": []}
+                self._placement_dir[fp] = rec
+                while len(self._placement_dir) > self._placement_cap:
+                    self._placement_dir.popitem(last=False)
+            else:
+                self._placement_dir.move_to_end(fp)
+            if owner is not None:
+                rec["owner"] = str(owner)
+            for b in buddies or ():
+                if b not in rec["buddies"]:
+                    rec["buddies"].append(str(b))
+
+    def _holders_for(self, fp: int,
+                     exclude_addr: Optional[str] = None) -> list[str]:
+        """Everywhere this prefix's pages are known to be (owner first,
+        then acked buddies), minus the forward target itself."""
+        with self._mu:
+            rec = self._placement_dir.get(fp)
+            if rec is None:
+                return []
+            out = []
+            if rec["owner"]:
+                out.append(rec["owner"])
+            out.extend(b for b in rec["buddies"] if b not in out)
+        ex = str(exclude_addr) if exclude_addr is not None else None
+        ex_ep = self._ep_by_name.get(ex) if ex is not None else None
+        drop = {ex} if ex else set()
+        if ex_ep is not None:
+            drop.add(str(ex_ep))
+            h = self._by_ep.get(ex_ep)
+            if h is not None:
+                drop.add(h.addr)
+        return [a for a in out if a not in drop]
+
+    def placements(self, limit: int = 32) -> list[dict]:
+        """The N-way buddy placement table for the /cluster page."""
+        with self._mu:
+            items = list(self._placement_dir.items())[-limit:]
+        return [{"fingerprint": f"{fp:016x}", "owner": rec["owner"],
+                 "buddies": list(rec["buddies"])}
+                for fp, rec in items]
 
     # ---- the cluster overload gradient ----
 
@@ -910,6 +1141,13 @@ class ClusterRouter:
         qd = pool = depth = 0.0
         for h in self.replicas:
             p = h.pressures()
+            if not p and self._is_remote(h):
+                # remote replica: read the pressure report its last
+                # SetFloor ack carried back (wire-level overload) —
+                # a remote-only fleet feeds the gradient too
+                st = self._remote_floor.get(h.endpoint)
+                if st is not None:
+                    p = st.get("pressures") or {}
             qd = max(qd, p.get("queue_delay_us", 0.0))
             pool = max(pool, p.get("pool_ratio", 0.0))
             depth = max(depth, p.get("queue_depth", 0.0))
@@ -921,9 +1159,11 @@ class ClusterRouter:
     def _tick(self) -> int:
         lvl = self._ladder.update(self._pressures())
         self._apply_level(lvl)
+        self._push_floor(lvl)
         return lvl
 
     def _apply_level(self, lvl: int) -> None:
+        from brpc_tpu.serving.ladder import apply_level_to_components
         prev = self._applied_level
         if lvl > prev:
             # count each action the FIRST time the ramp reaches it —
@@ -935,28 +1175,101 @@ class ClusterRouter:
                     self.gradient_fired[LEVEL_ACTIONS[step - 1]].add(1)
         self._applied_level = lvl
         for h in self.replicas:
-            if h.supervisor is not None:
-                # replica supervisors keep their own ladders; the
-                # cluster holds them at a floor so both gradients agree
-                h.supervisor.set_level_floor(max(0, lvl - 1))
+            apply_level_to_components(
+                lvl, supervisor=h.supervisor, batcher=h.batcher,
+                engine=h.engine, store=h.store,
+                clamp_new_tokens=self.clamp_new_tokens,
+                evict_pages=self.ladder_evict_pages)
+
+    # ---- wire-level overload (remote floor push, ISSUE 16) ----
+
+    @staticmethod
+    def _is_remote(h: ReplicaHandle) -> bool:
+        return all(x is None for x in
+                   (h.supervisor, h.batcher, h.engine, h.store))
+
+    def _ctrl_channel(self, h: ReplicaHandle):
+        ch = self._ctrl_chan_by_ep.get(h.endpoint)
+        if ch is None:
+            from brpc_tpu.rpc.channel import Channel
+            # a dedicated short-timeout channel: a dead replica must
+            # cost the tick loop control_timeout_ms, not the data
+            # plane's full forward timeout
+            ch = Channel(h.addr, timeout_ms=self.control_timeout_ms)
+            self._ctrl_chan_by_ep[h.endpoint] = ch
+        return ch
+
+    def _push_floor(self, lvl: int) -> None:
+        """Push the cluster gradient level (plus this router's
+        membership epoch) to every REMOTE replica's ``_cluster``
+        control service, and collect its pressure report from the
+        reply.  A dropped push (injected ``cluster.floor_push``, dead
+        replica) is simply re-pushed next tick; a replica that already
+        saw a HIGHER epoch refuses — this router is superseded."""
+        for h in self.replicas:
+            if not self._is_remote(h):
                 continue
-            if h.batcher is not None:
-                h.batcher.brownout = max(h.batcher.brownout, 1) \
-                    if lvl >= 2 else 0
-            if h.engine is not None:
-                h.engine.degraded_clamp = self.clamp_new_tokens \
-                    if lvl >= 3 else None
-            if lvl >= 4 and h.store is not None:
-                n = self.ladder_evict_pages
-                if n is None:
-                    try:
-                        n = h.store.pagepool.pages_per_block
-                    except Exception:
-                        n = 4
-                try:
-                    h.store.evict_pages(n)
-                except Exception:
-                    pass
+            st = self._remote_floor.setdefault(h.endpoint, {
+                "addr": h.addr, "epoch": self.epoch, "level": None,
+                "acked_level": None, "last_push_t": None,
+                "last_ack_t": None, "pressures": {}, "error": None,
+                "unsupported": False, "drops": 0, "refused": 0})
+            if st["unsupported"]:
+                continue
+            if fault.ENABLED and fault.hit(
+                    "cluster.floor_push", replica=h.addr) is not None:
+                # the push is LOST on the wire: no state change at the
+                # replica; the next tick re-pushes
+                st["drops"] += 1
+                self.floor_push_drops += 1
+                continue
+            st["last_push_t"] = time.monotonic()
+            st["level"] = lvl
+            st["epoch"] = self.epoch
+            self.floor_pushes += 1
+            try:
+                resp = self._ctrl_channel(h).call_sync(
+                    "_cluster", "SetFloor",
+                    {"epoch": int(self.epoch), "level": int(lvl),
+                     "router": self.name},
+                    serializer="tensorframe",
+                    response_serializer="tensorframe")
+            except errors.RpcError as e:
+                if e.code == errors.ENOMETHOD:
+                    # replica without the control service: stop asking
+                    st["unsupported"] = True
+                elif "stale epoch" in (e.text or ""):
+                    st["refused"] += 1
+                    self.floor_push_refused += 1
+                st["error"] = e.code
+                continue
+            st["error"] = None
+            st["last_ack_t"] = time.monotonic()
+            st["acked_level"] = int((resp or {}).get("level", lvl))
+            st["pressures"] = {
+                k: float(resp[k]) for k in
+                ("queue_delay_us", "pool_ratio", "queue_depth")
+                if resp and k in resp}
+
+    def remote_floor_table(self) -> list[dict]:
+        """Remote-floor propagation per replica for /cluster: epoch,
+        last push, ack age, acked level."""
+        now = time.monotonic()
+        out = []
+        for ep, st in list(self._remote_floor.items()):
+            out.append({
+                "addr": st["addr"], "epoch": st["epoch"],
+                "pushed_level": st["level"],
+                "acked_level": st["acked_level"],
+                "push_age_s": (round(now - st["last_push_t"], 3)
+                               if st["last_push_t"] else None),
+                "ack_age_s": (round(now - st["last_ack_t"], 3)
+                              if st["last_ack_t"] else None),
+                "drops": st["drops"], "refused": st["refused"],
+                "error": st["error"],
+                "unsupported": st["unsupported"],
+            })
+        return out
 
     @property
     def level(self) -> int:
@@ -1015,10 +1328,13 @@ class ClusterRouter:
         return out
 
     def stats(self) -> dict:
+        wal = self.sessions.wal
         return {
             "name": self.name,
+            "epoch": self.epoch,
             "replicas": self.replica_table(),
             "sessions": self.sessions.counts(),
+            "session_rows": self.sessions.snapshot(limit=20),
             "ladder": self._ladder.stats(),
             "level_actions": list(LEVEL_ACTIONS),
             "gradient_fired": {a: c.get_value()
@@ -1030,6 +1346,14 @@ class ClusterRouter:
             "replayed_tokens": self.replays_total.get_value(),
             "retry_after_s": self.retry_after_s(),
             "replicate_sessions": self.replicate_sessions,
+            "replication_factor": self.replication_factor,
+            "placements": self.placements(),
+            "remote_floor": self.remote_floor_table(),
+            "floor_pushes": self.floor_pushes,
+            "floor_push_drops": self.floor_push_drops,
+            "floor_push_refused": self.floor_push_refused,
+            "wal": wal.stats() if wal is not None else None,
+            "wal_replay": self.sessions.replay_stats,
         }
 
 

@@ -32,9 +32,15 @@ from brpc_tpu.rpc.service import Service, method
 class ServingService(Service):
     NAME = "Serving"
 
-    def __init__(self, batcher=None, engine=None):
+    def __init__(self, batcher=None, engine=None, prefix_fetcher=None):
         self._batcher = batcher
         self._engine = engine
+        # pull-based prefix fetch (ISSUE 16): ``fetch(prompt, holders)
+        # -> pages`` — usually brpc_tpu.migrate.make_prefix_fetcher.
+        # Set after server start too (the fetcher needs its own addr).
+        self.prefix_fetcher = prefix_fetcher
+        self.prefix_fetches = 0
+        self.prefix_fetched_pages = 0
 
     @method(request="json", response="json")
     def Score(self, cntl, req):
@@ -97,6 +103,31 @@ class ServingService(Service):
                 hit = int(store.probe(prompt))
             except Exception:
                 hit = 0
+        # pull-based prefix fetch (ISSUE 16): when the router names
+        # replicas that hold this prefix (prefix_holders) and the local
+        # cache misses the full-page prefix, FETCH it from an owner via
+        # the migrator before submitting — a cold replica warms itself
+        # instead of re-prefilling.  Any fetch failure falls back to
+        # recompute; the generation never depends on it.
+        holders = req.get("prefix_holders") or []
+        if (self.prefix_fetcher is not None and holders
+                and store is not None and len(prompt) > 1):
+            pt = getattr(store, "page_tokens", 16)
+            full = len(prompt) // pt * pt
+            if full and hit < full:
+                try:
+                    fetched = int(self.prefix_fetcher(
+                        [int(t) for t in prompt],
+                        [str(h) for h in holders]))
+                except Exception:
+                    fetched = 0
+                if fetched:
+                    self.prefix_fetches += 1
+                    self.prefix_fetched_pages += fetched
+                    try:
+                        hit = max(hit, int(store.probe(prompt)))
+                    except Exception:
+                        pass
         kw = {}
         if "speculative" in req:
             # per-request opt-out of the engine's draft proposals
@@ -146,12 +177,13 @@ def http_generate_handler(engine):
 
 
 def register_serving(server, batcher=None, engine=None,
+                     prefix_fetcher=None,
                      http_generate_path: Optional[str]
                      = "/serving/generate") -> ServingService:
     """Register the serving surface on a Server: the Serving service
     (Score/Generate) plus the chunked HTTP generate route.  Call before
     ``server.start()``."""
-    svc = ServingService(batcher, engine)
+    svc = ServingService(batcher, engine, prefix_fetcher)
     server.add_service(svc)
     if engine is not None and http_generate_path:
         server.add_http_handler(http_generate_path,

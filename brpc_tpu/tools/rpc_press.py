@@ -523,34 +523,14 @@ def spin_up_cluster(n_replicas: int, *, page_tokens: int = 8,
     Returns ``(replicas, router, rsrv, raddr)`` with ``replicas`` a
     list of ``(store, engine, server, addr)``; tear down with
     :func:`tear_down_cluster`."""
-    import numpy as np
+    from brpc_tpu.serving import (ClusterRouter, ReplicaHandle,
+                                  register_router)
 
-    from brpc_tpu.kvcache import KVCacheStore
-    from brpc_tpu.migrate import register_migration
-    from brpc_tpu.serving import (ClusterRouter, DecodeEngine,
-                                  ReplicaHandle, register_router,
-                                  register_serving)
-
-    def step(tokens, positions, pages=None):
-        if step_delay_s:
-            time.sleep(step_delay_s)
-        return (np.asarray(tokens) * 7 + np.asarray(positions)) % 997
-
-    replicas = []
-    for i in range(n_replicas):
-        store = KVCacheStore(page_tokens=page_tokens,
-                             page_bytes=page_bytes,
-                             max_blocks=max_blocks,
-                             name=f"{name_prefix}_{i}",
-                             commit_live_pages=commit_live_pages)
-        eng = DecodeEngine(step, num_slots=num_slots, store=store,
-                           max_pages_per_slot=max_pages_per_slot,
-                           name=f"{name_prefix}_eng_{i}")
-        srv = brpc.Server(enable_dcn=True)
-        register_serving(srv, engine=eng)
-        register_migration(srv, store)
-        srv.start("127.0.0.1", 0)
-        replicas.append((store, eng, srv, f"127.0.0.1:{srv.port}"))
+    replicas = spin_up_replicas(
+        n_replicas, page_tokens=page_tokens, step_delay_s=step_delay_s,
+        num_slots=num_slots, max_blocks=max_blocks,
+        page_bytes=page_bytes, max_pages_per_slot=max_pages_per_slot,
+        name_prefix=name_prefix, commit_live_pages=commit_live_pages)
     router = ClusterRouter(
         [ReplicaHandle(addr, name=f"{name_prefix}_{i}", engine=eng,
                        store=store, server=srv)
@@ -564,13 +544,73 @@ def spin_up_cluster(n_replicas: int, *, page_tokens: int = 8,
     return replicas, router, rsrv, f"127.0.0.1:{rsrv.port}"
 
 
-def tear_down_cluster(replicas, router, rsrv,
-                      timeout_s: float = 3.0) -> None:
-    """Close everything :func:`spin_up_cluster` built (replicas that
-    were already killed mid-run tear down quietly)."""
-    router.close(timeout_s=timeout_s)
-    rsrv.stop()
-    rsrv.join()
+def spin_up_replicas(n_replicas: int, *, page_tokens: int = 8,
+                     step_delay_s: float = 0.0, num_slots: int = 8,
+                     max_blocks: int = 64, page_bytes: int = 512,
+                     max_pages_per_slot: int = 64,
+                     name_prefix: str = "cluster",
+                     commit_live_pages: bool = False,
+                     prefill_cost_per_token_s: float = 0.0):
+    """The replica half of :func:`spin_up_cluster`: N serving replicas
+    (paged KV store + decode engine) each exposing the Serving,
+    ``_kvmig`` AND ``_cluster`` services — so they work behind an
+    in-process router (ISSUE 8 shape) or a remote-only SUBPROCESS
+    router (ISSUE 16: address-only handles, floor pushes over the
+    wire, prefix pulls between replicas).
+
+    ``prefill_cost_per_token_s`` adds a prefill stage whose cost
+    scales with the (bucket-padded) UNCACHED suffix — the real-model
+    cost shape where a prefix-cache hit buys skipped compute, so
+    benches measuring warmth effects (``bench.py durable``) see them
+    at true proportions instead of one flat-priced vectorized call.
+
+    Returns a list of ``(store, engine, server, addr)``; tear down
+    with :func:`tear_down_replicas`."""
+    import numpy as np
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.migrate import make_prefix_fetcher, register_migration
+    from brpc_tpu.serving import (DecodeEngine, register_cluster_control,
+                                  register_serving)
+
+    def step(tokens, positions, pages=None):
+        if step_delay_s:
+            time.sleep(step_delay_s)
+        return (np.asarray(tokens) * 7 + np.asarray(positions)) % 997
+
+    prefill_fn = None
+    if prefill_cost_per_token_s:
+        def prefill_fn(tokens, prefill_from):
+            time.sleep(prefill_cost_per_token_s * int(np.size(tokens)))
+
+    replicas = []
+    for i in range(n_replicas):
+        store = KVCacheStore(page_tokens=page_tokens,
+                             page_bytes=page_bytes,
+                             max_blocks=max_blocks,
+                             name=f"{name_prefix}_{i}",
+                             commit_live_pages=commit_live_pages)
+        eng = DecodeEngine(step, num_slots=num_slots, store=store,
+                           max_pages_per_slot=max_pages_per_slot,
+                           prefill_fn=prefill_fn,
+                           name=f"{name_prefix}_eng_{i}")
+        srv = brpc.Server(enable_dcn=True)
+        serving_svc = register_serving(srv, engine=eng)
+        mig_svc = register_migration(srv, store)
+        register_cluster_control(srv, engine=eng, store=store,
+                                 name=f"{name_prefix}_{i}")
+        srv.start("127.0.0.1", 0)
+        addr = f"127.0.0.1:{srv.port}"
+        # the fetcher needs the replica's own addr, known only now
+        serving_svc.prefix_fetcher = make_prefix_fetcher(
+            mig_svc.migrator, addr)
+        replicas.append((store, eng, srv, addr))
+    return replicas
+
+
+def tear_down_replicas(replicas) -> None:
+    """Close what :func:`spin_up_replicas` built (replicas already
+    killed mid-run tear down quietly)."""
     for store, eng, srv, _addr in replicas:
         try:
             eng.close(timeout_s=2.0)
@@ -583,6 +623,16 @@ def tear_down_cluster(replicas, router, rsrv,
             pass
         store.clear()
         store.close()
+
+
+def tear_down_cluster(replicas, router, rsrv,
+                      timeout_s: float = 3.0) -> None:
+    """Close everything :func:`spin_up_cluster` built (replicas that
+    were already killed mid-run tear down quietly)."""
+    router.close(timeout_s=timeout_s)
+    rsrv.stop()
+    rsrv.join()
+    tear_down_replicas(replicas)
 
 
 def zipf_key_sampler(vocab: int, s: float, seed: int = 0):
@@ -917,6 +967,192 @@ def run_cluster_press(n_replicas: int, request,
     return summary
 
 
+def run_router_kill_press(n_replicas: int, request,
+                          duration_s: float = 10.0, threads: int = 4,
+                          kill_router_after: float = 3.0,
+                          timeout_ms: int = 20_000,
+                          request_factory=None,
+                          out=sys.stderr) -> dict:
+    """``--cluster N --kill-router-after S`` mode (ISSUE 16): the
+    replicas stay in-process but the ROUTER runs as its own OS process
+    over a session WAL.  S seconds in, the harness SIGKILLs it — no
+    goodbye, no flush beyond the WAL's write-ahead discipline — and
+    spawns a successor over the same WAL file.  Every generation that
+    was mid-flight resumes against the successor from its client-held
+    cursor; the report adds the resume count and resume-latency
+    percentiles (client resume call -> generation complete) next to
+    the usual press numbers."""
+    import os
+    import tempfile
+
+    from brpc_tpu.serving import RouterClient
+    from brpc_tpu.serving.router_proc import spawn_router
+
+    replicas = spin_up_replicas(
+        n_replicas, page_tokens=8, commit_live_pages=True,
+        step_delay_s=0.002, name_prefix="press_kr")
+    addrs = [addr for _, _, _, addr in replicas]
+    wal_dir = tempfile.mkdtemp(prefix="rpc_press_wal_")
+    wal_path = os.path.join(wal_dir, "sessions.wal")
+    proc, raddr = spawn_router(
+        wal_path, addrs, replicate_sessions=True, replication_factor=2,
+        page_tokens=8, max_sessions=max(64, 8 * threads),
+        timeout_ms=timeout_ms)
+
+    rec_ttft = LatencyRecorder("rpc_press_krouter_ttft")
+    rec_resume = LatencyRecorder("rpc_press_krouter_resume")
+    mu = threading.Lock()
+    gens_ok = [0]
+    nerr = [0]
+    nshed = [0]
+    tokens = [0]
+    resumes = [0]
+    stop = threading.Event()
+    router_up = threading.Event()
+    router_up.set()
+    cur_addr = [raddr]
+
+    def worker(k: int):
+        gen_req = request_factory(k) if request_factory is not None \
+            else None
+        while not stop.is_set():
+            router_up.wait(1.0)
+            if stop.is_set():
+                return
+            addr = cur_addr[0]
+            cli = RouterClient(addr, timeout_ms=timeout_ms,
+                               shed_retries=0)
+            req = gen_req() if gen_req is not None else request
+            prompt = req.get("prompt") or [1]
+            n = int(req.get("max_new_tokens", 16))
+            first = [None]
+
+            def emit(tok, first=first):
+                if first[0] is None:
+                    first[0] = time.monotonic()
+
+            t0 = time.monotonic()
+            try:
+                live = cli.start(prompt, n, emit=emit)
+            except brpc.RpcError as e:
+                with mu:
+                    if e.code == brpc.errors.ELIMIT:
+                        nshed[0] += 1
+                    else:
+                        nerr[0] += 1
+                continue
+            except Exception:
+                with mu:
+                    nerr[0] += 1
+                continue
+            done = live.wait(timeout_ms / 1e3)
+            if done and live.error is None:
+                with mu:
+                    gens_ok[0] += 1
+                    tokens[0] += len(live.tokens)
+                if first[0] is not None:
+                    rec_ttft.add(int((first[0] - t0) * 1e6))
+                continue
+            # mid-flight router death (or wedge): resume the SESSION on
+            # whatever router holds the WAL now, from the client-held
+            # cursor — the durable-control-plane acceptance path
+            sid, cursor = live.session_id, live.cursor
+            try:
+                live.drop()
+            except Exception:
+                pass
+            if not sid or stop.is_set():
+                with mu:
+                    nerr[0] += 1
+                continue
+            router_up.wait(timeout_ms / 1e3)
+            r0 = time.monotonic()
+            try:
+                res = RouterClient(cur_addr[0], timeout_ms=timeout_ms,
+                                   shed_retries=0).resume_wait(
+                    sid, cursor, timeout_s=timeout_ms / 1e3)
+            except Exception:
+                with mu:
+                    nerr[0] += 1
+                continue
+            rec_resume.add(int((time.monotonic() - r0) * 1e6))
+            with mu:
+                resumes[0] += 1
+                if res["error"]:
+                    nerr[0] += 1
+                else:
+                    gens_ok[0] += 1
+                    tokens[0] += len(res["tokens"]) + cursor
+
+    ts = [threading.Thread(target=worker, args=(k,), daemon=True)
+          for k in range(threads)]
+    t_start = time.monotonic()
+    [t.start() for t in ts]
+    adoption_ms = None
+    replay = None
+    try:
+        time.sleep(min(kill_router_after, duration_s))
+        print(f"cluster press: SIGKILL router pid={proc.pid}",
+              file=sys.stderr)
+        router_up.clear()
+        k0 = time.monotonic()
+        proc.kill()
+        proc.wait()
+        proc2, raddr2 = spawn_router(
+            wal_path, addrs, replicate_sessions=True,
+            replication_factor=2, page_tokens=8,
+            max_sessions=max(64, 8 * threads), timeout_ms=timeout_ms)
+        adoption_ms = round((time.monotonic() - k0) * 1e3, 1)
+        cur_addr[0] = raddr2
+        proc = proc2
+        router_up.set()
+        time.sleep(max(0.0, duration_s - kill_router_after))
+    finally:
+        stop.set()
+        router_up.set()
+    [t.join(timeout_ms / 1e3 + 2) for t in ts]
+    elapsed = time.monotonic() - t_start
+    try:
+        from brpc_tpu.rpc.channel import Channel
+        replay = Channel(cur_addr[0], timeout_ms=5000).call_sync(
+            "Router", "Stats", {}, serializer="json",
+            response_serializer="json").get("wal_replay")
+    except Exception:
+        replay = None
+    summary = {
+        "replicas": n_replicas,
+        "generations_ok": gens_ok[0],
+        "errors": nerr[0],
+        "client_sheds": nshed[0],
+        "tokens": tokens[0],
+        "generations_per_s": round(gens_ok[0] / elapsed, 1),
+        "tokens_per_s": round(tokens[0] / elapsed, 1),
+        "ttft_avg_us": round(rec_ttft.latency(), 1),
+        "ttft_p50_us": rec_ttft.latency_percentile(0.5),
+        "ttft_p99_us": rec_ttft.latency_percentile(0.99),
+        "router_resumes": resumes[0],
+        "resume_p50_us": rec_resume.latency_percentile(0.5),
+        "resume_p90_us": rec_resume.latency_percentile(0.9),
+        "resume_p99_us": rec_resume.latency_percentile(0.99),
+        "router_adoption_ms": adoption_ms,
+        "wal_replay": replay,
+        "elapsed_s": round(elapsed, 2),
+    }
+    print(json.dumps(summary), file=out)
+    try:
+        proc.kill()
+        proc.wait()
+    except Exception:
+        pass
+    tear_down_replicas(replicas)
+    try:
+        os.unlink(wal_path)
+        os.rmdir(wal_dir)
+    except OSError:
+        pass
+    return summary
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--server", help="host:port (unary/streaming modes)")
@@ -933,6 +1169,14 @@ def main(argv=None):
                     help="with --cluster: kill one replica S seconds "
                          "into the run so session resume runs under "
                          "load")
+    ap.add_argument("--kill-router-after", type=float, default=None,
+                    metavar="S",
+                    help="with --cluster: run the router as its own OS "
+                         "process over a session WAL, SIGKILL it S "
+                         "seconds in, spawn a successor over the same "
+                         "WAL, and resume every mid-flight session "
+                         "(reports resume count + resume-latency "
+                         "percentiles)")
     ap.add_argument("--embedding", type=int, default=0, metavar="N",
                     help="spin up N in-process parameter-server shards "
                          "and press zipf-skewed Lookup/Update key load "
@@ -1016,7 +1260,13 @@ def main(argv=None):
         factory = make_prefix_skew(req, a.shared_prefix_ratio,
                                    prefix_tokens=a.prefix_tokens,
                                    seed=a.prefix_seed)
-    if a.cluster:
+    if a.cluster and a.kill_router_after is not None:
+        run_router_kill_press(a.cluster, req, duration_s=a.duration,
+                              threads=a.threads,
+                              kill_router_after=a.kill_router_after,
+                              timeout_ms=max(a.timeout_ms, 5000),
+                              request_factory=factory, out=sys.stdout)
+    elif a.cluster:
         run_cluster_press(a.cluster, req, duration_s=a.duration,
                           threads=a.threads,
                           timeout_ms=max(a.timeout_ms, 5000),

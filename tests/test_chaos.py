@@ -2171,3 +2171,439 @@ def test_psserve_partition_faults_exactly_once_apply(seed):
             s.stop()
             s.join()
         pc.close()
+
+
+# ---------------------------------------------------------------------------
+# scenario 17 (ISSUE 16): the ROUTER PROCESS dies (SIGKILL, no goodbye)
+# plus a replica kill -> a successor process adopts the session WAL and
+# every session resumes bit-exact, exactly once, over buddy-warm pages;
+# a superseded router's floor pushes are fenced by epoch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_router_process_kill_wal_adoption_exactly_once(seed):
+    """The durable control plane's acceptance drill: N=8 generations
+    stream through a router running as its OWN OS PROCESS over a
+    session WAL; mid-generation the harness SIGKILLs the router AND
+    kills one serving replica.  A successor (fresh process w.r.t. the
+    dead router) adopts the fleet from the WAL.  Invariants:
+
+    * every session resumes from the client-held cursor and the
+      assembled stream is bit-exact vs the uninterrupted oracle —
+      zero duplicate tokens across the adoption seam, zero holes;
+    * resumes ride the N-way buddy pages: ``re_decoded_tokens`` is
+      strictly less than the generation's total on buddy-warm resumes;
+    * the successor's epoch strictly supersedes the dead router's, and
+      a floor push carrying the OLD epoch is refused ('stale epoch');
+    * the killed replica is quarantined by the survivors;
+    * survivor pools and refcounts return to baseline.
+    """
+    import random
+
+    from brpc_tpu.serving import (ClusterRouter, ReplicaHandle,
+                                  RouterClient, SessionTable,
+                                  register_router)
+    from brpc_tpu.serving.router_proc import spawn_router
+    from brpc_tpu.tools.rpc_press import (spin_up_replicas,
+                                          tear_down_replicas)
+
+    PT = 4
+    N = 8
+    budget = 10
+
+    def expected(prompt, n):
+        last, pos, out = prompt[-1], len(prompt), []
+        for _ in range(n):
+            last = (last * 7 + pos) % 997
+            out.append(last)
+            pos += 1
+        return out
+
+    replicas = spin_up_replicas(
+        3, page_tokens=PT, step_delay_s=0.03, num_slots=8,
+        commit_live_pages=True, name_prefix=f"c17_{seed}")
+    addrs = [addr for *_, addr in replicas]
+    import tempfile
+    wal_dir = tempfile.mkdtemp(prefix=f"chaos17_{seed}_")
+    wal_path = os.path.join(wal_dir, "sessions.wal")
+    proc, raddr = spawn_router(
+        wal_path, addrs, replicate_sessions=True,
+        replication_factor=3, page_tokens=PT, check_interval_s=0.02)
+
+    rng = random.Random(seed)
+    successor = rsrv2 = None
+    try:
+        cli = RouterClient(raddr, timeout_ms=20_000)
+        gens = []
+        for k in range(N):
+            base = rng.randrange(100, 800)
+            prompt = [base + k + i for i in range(13)]   # 3 full pages
+            gens.append((prompt, cli.start(prompt, budget)))
+        for prompt, g in gens:
+            assert g.wait_tokens(3, timeout_s=30), \
+                f"seed {seed}: no tokens before the kill"
+        # buddy replication visible through the subprocess router's
+        # Stats RPC before the kill
+        from brpc_tpu.rpc.channel import Channel
+
+        def _warm():
+            st = Channel(raddr, timeout_ms=5000).call_sync(
+                "Router", "Stats", {}, serializer="json",
+                response_serializer="json")
+            return sum(1 for r in st["session_rows"]
+                       if r["replicated_pages"] > 0)
+        assert wait_until(lambda: _warm() >= 1, 15), \
+            f"seed {seed}: no buddy replication before the kill"
+        old_epoch = Channel(raddr, timeout_ms=5000).call_sync(
+            "Router", "Stats", {}, serializer="json",
+            response_serializer="json")["epoch"]
+
+        # -- the crash: router PROCESS and one replica die together --
+        proc.kill()
+        proc.wait()
+        vstore, veng, vsrv, vaddr = replicas[0]
+        vsrv.stop()
+        vsrv.join()
+        veng.close(timeout_s=2.0)
+
+        # client-held cursors (the WAL, by write-ahead, is >= these)
+        held = []
+        for prompt, g in gens:
+            g.drop()
+            held.append((prompt, g.session_id, g.cursor, g.tokens))
+
+        # -- adoption: a successor over the same WAL --
+        table = SessionTable.recover(wal_path)
+        assert table.replay_stats["sessions"] >= N
+        assert table.replay_stats["live"] >= 1
+        successor = ClusterRouter(
+            [ReplicaHandle(a) for a in addrs], sessions=table,
+            replicate_sessions=True, replication_factor=3,
+            page_tokens=PT, quarantine_after=1,
+            name=f"c17_successor{seed}", check_interval_s=0.02)
+        assert successor.epoch > old_epoch
+        rsrv2 = brpc.Server()
+        register_router(rsrv2, successor)
+        rsrv2.start("127.0.0.1", 0)
+        cli2 = RouterClient(f"127.0.0.1:{rsrv2.port}",
+                            timeout_ms=30_000)
+
+        warm_resumes = 0
+        for prompt, sid, cursor, seen in held:
+            out = cli2.resume_wait(sid, cursor, timeout_s=60)
+            assert out["error"] is None, \
+                f"seed {seed}: resume failed E{out['error']}"
+            full = seen[:cursor] + out["tokens"]
+            assert full == expected(prompt, budget), \
+                f"seed {seed}: stream diverged across the adoption seam"
+            assert len(full) == budget    # zero dups, zero holes
+            s = table.get(sid)
+            total = len(prompt) + budget
+            assert s.re_decoded_tokens < total, \
+                f"seed {seed}: resume recomputed everything"
+            if s.re_decoded_tokens < total - len(prompt):
+                warm_resumes += 1
+        assert warm_resumes >= 1, \
+            f"seed {seed}: no buddy-warm resume rode the shipped pages"
+
+        # -- epoch fencing: the dead router's epoch is refused --
+        ctrl = replicas[1][2]._services["_cluster"]
+        assert wait_until(lambda: ctrl.epoch >= successor.epoch, 10), \
+            f"seed {seed}: successor floor push never reached replica"
+        with pytest.raises(brpc.RpcError) as ei:
+            Channel(replicas[1][3], timeout_ms=2000).call_sync(
+                "_cluster", "SetFloor",
+                {"epoch": old_epoch, "level": 4, "router": "zombie"},
+                serializer="tensorframe",
+                response_serializer="tensorframe")
+        assert ei.value.code == errors.EREQUEST
+        assert "stale epoch" in (ei.value.text or "")
+
+        # -- the victim is quarantined by the survivors --
+        from brpc_tpu.policy.health_check import is_broken
+        victim_ep = ReplicaHandle(vaddr).endpoint
+        assert wait_until(lambda: is_broken(victim_ep), 15), \
+            f"seed {seed}: killed replica not quarantined"
+
+        # -- survivor baseline: pools and refcounts drain --
+        for store, _eng, _srv, _addr in replicas[1:]:
+            assert wait_until(
+                lambda s=store: s.stats()["live_seqs"] == 0, 15), \
+                f"seed {seed}: leaked live sequences on a survivor"
+            store.clear()
+            store.pagepool.assert_consistent()
+            assert store.pagepool.blocks_leased() == 0
+    finally:
+        try:
+            proc.kill()
+            proc.wait()
+        except Exception:
+            pass
+        if successor is not None:
+            successor.close(timeout_s=3.0)
+        if rsrv2 is not None:
+            rsrv2.stop()
+            rsrv2.join()
+        tear_down_replicas(replicas)
+        try:
+            os.unlink(wal_path)
+            os.rmdir(wal_dir)
+        except OSError:
+            pass
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_durable_control_plane_fault_sites(seed):
+    """The three ISSUE 16 fault sites, driven end to end:
+
+    * ``router.wal_append`` — appends fail (un-durable tail), the
+      router process 'dies' without healing them, and the successor
+      still serves EXACTLY ONCE: the client's cursor outran the WAL,
+      the gap is re-decoded bit-exact and never re-delivered;
+    * ``cluster.floor_push`` — a dropped push is simply re-pushed next
+      tick: the remote floor converges, drops are counted;
+    * ``migrate.prefix_fetch`` — a failing pull falls back to
+      recompute (generation completes, prefix_hit == 0), pools and
+      refcounts at baseline after; the next fetch (no fault) works.
+    """
+    import random
+    import tempfile
+
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.migrate import make_prefix_fetcher, register_migration
+    from brpc_tpu.serving import (ClusterRouter, DecodeEngine,
+                                  ReplicaHandle, SessionTable,
+                                  register_cluster_control,
+                                  register_serving)
+
+    PT = 4
+    rng = random.Random(seed)
+
+    def step(tokens, positions, pages=None):
+        time.sleep(0.005)
+        return (np.asarray(tokens) * 7 + np.asarray(positions)) % 997
+
+    def expected(prompt, n):
+        last, pos, out = prompt[-1], len(prompt), []
+        for _ in range(n):
+            last = (last * 7 + pos) % 997
+            out.append(last)
+            pos += 1
+        return out
+
+    # ---- (1) WAL append failure -> exactly-once across adoption ----
+    wal_dir = tempfile.mkdtemp(prefix=f"c17b_{seed}_")
+    wal_path = os.path.join(wal_dir, "s.wal")
+    store = KVCacheStore(page_tokens=PT, page_bytes=256, max_blocks=32,
+                         name=f"c17b_{seed}", commit_live_pages=True)
+    eng = DecodeEngine(step, num_slots=4, store=store,
+                       max_pages_per_slot=32,
+                       name=f"c17b_eng_{seed}")
+    srv = brpc.Server(enable_dcn=True)
+    register_serving(srv, engine=eng)
+    register_migration(srv, store)
+    srv.start("127.0.0.1", 0)
+    addr = f"127.0.0.1:{srv.port}"
+
+    table = SessionTable(wal=wal_path)
+    router = ClusterRouter(
+        [ReplicaHandle(addr, name="c17b", engine=eng, store=store,
+                       server=srv)],
+        sessions=table, page_tokens=PT, name=f"c17b_router{seed}",
+        check_interval_s=0.02)
+    successor = None
+    budget = 8
+    base = rng.randrange(100, 800)
+    prompt = [base + i for i in range(9)]
+    try:
+        plan = fault.FaultPlan(seed=seed)
+        # fail every append after the first few: the tail of the
+        # stream is never durable
+        plan.on("router.wal_append", fault.ERROR, times=100, after=4)
+        got = []
+        with fault.injected(plan):
+            s = router.open_session(prompt, budget)
+            router.attach(s.sid, 0, got.append)
+            assert wait_until(lambda: s.state == "finished", 30), \
+                f"seed {seed}: generation never finished under faults"
+        assert got == expected(prompt, budget)
+        assert plan.injected.get("router.wal_append", 0) >= 1
+        wal_stats = table.wal.stats()
+        assert wal_stats["append_failures"] >= 1
+        client_cursor = len(got)
+        sid = s.sid
+        # the router process "dies" with the pending tail UNHEALED
+        router.close(timeout_s=3.0)
+        table.wal._pending.clear()     # simulate: heal never happened
+        table.close()
+
+        table2 = SessionTable.recover(wal_path)
+        r = table2.get(sid)
+        assert r is not None
+        # the WAL is BEHIND the client (its tail appends failed) —
+        # legal, because attach-ahead re-decodes and suppresses
+        assert r.cursor <= client_cursor
+        successor = ClusterRouter(
+            [ReplicaHandle(addr, name="c17b2", engine=eng,
+                           store=store, server=srv)],
+            sessions=table2, page_tokens=PT,
+            name=f"c17b_succ{seed}", check_interval_s=0.02)
+        got2 = []
+        done = threading.Event()
+        successor.attach(sid, client_cursor, got2.append,
+                         lambda err: done.set())
+        assert done.wait(30), f"seed {seed}: resume never finished"
+        # the client saw `got` then `got2`: exactly the oracle, no
+        # token twice even though the gap was re-decoded
+        assert got + got2 == expected(prompt, budget), \
+            f"seed {seed}: duplicate or hole across the WAL gap"
+        successor.close(timeout_s=3.0)
+        successor = None
+        table2.close()
+
+        # ---- (2) dropped floor push -> re-pushed next tick ----
+        ctrl = register_cluster_control  # noqa: F841  (site below)
+        rep_srv = brpc.Server()
+        ctrl_svc = register_cluster_control(rep_srv, engine=eng,
+                                            store=store,
+                                            name=f"c17b_ctrl{seed}")
+        rep_srv.start("127.0.0.1", 0)
+        wire_router = ClusterRouter(
+            [f"127.0.0.1:{rep_srv.port}"], page_tokens=PT,
+            name=f"c17b_wire{seed}", auto_tick=False, epoch=3)
+        plan2 = fault.FaultPlan(seed=seed)
+        plan2.on("cluster.floor_push", fault.ERROR, times=2)
+        with fault.injected(plan2):
+            wire_router._push_floor(2)     # dropped on the wire
+            assert ctrl_svc.level == 0
+            wire_router._push_floor(2)     # dropped again
+            assert ctrl_svc.level == 0
+            wire_router._push_floor(2)     # next tick: lands
+            assert ctrl_svc.level == 2 and ctrl_svc.epoch == 3
+        assert plan2.injected.get("cluster.floor_push", 0) == 2
+        assert wire_router.floor_push_drops == 2
+        rows = wire_router.remote_floor_table()
+        assert rows[0]["drops"] == 2
+        assert rows[0]["acked_level"] == 2
+        wire_router.close(timeout_s=2.0)
+        rep_srv.stop()
+        rep_srv.join()
+
+        # ---- (3) prefix fetch failure -> recompute fallback ----
+        cold_store = KVCacheStore(page_tokens=PT, page_bytes=256,
+                                  max_blocks=32,
+                                  name=f"c17b_cold_{seed}",
+                                  commit_live_pages=True)
+        cold_eng = DecodeEngine(step, num_slots=4, store=cold_store,
+                                max_pages_per_slot=32,
+                                name=f"c17b_cold_eng_{seed}")
+        cold_srv = brpc.Server(enable_dcn=True)
+        cold_svc = register_serving(cold_srv, engine=cold_eng)
+        cold_mig = register_migration(cold_srv, cold_store)
+        cold_srv.start("127.0.0.1", 0)
+        cold_addr = f"127.0.0.1:{cold_srv.port}"
+        cold_svc.prefix_fetcher = make_prefix_fetcher(
+            cold_mig.migrator, cold_addr)
+
+        from brpc_tpu.rpc.channel import Channel
+        from brpc_tpu.rpc.controller import Controller
+        from brpc_tpu.rpc.stream import stream_create
+
+        class _Drain:
+            def __init__(self):
+                self.done = threading.Event()
+
+            def on_received_messages(self, stream, messages):
+                import json as _json
+                for m in messages:
+                    if _json.loads(bytes(m)).get("done") is not None:
+                        self.done.set()
+
+            def on_closed(self, stream):
+                self.done.set()
+
+        warm_prompt = prompt    # replica `store` is warm from part 1
+        plan3 = fault.FaultPlan(seed=seed)
+        plan3.on("migrate.prefix_fetch", fault.ERROR, times=1)
+        with fault.injected(plan3):
+            d = _Drain()
+            cntl = Controller(timeout_ms=15_000)
+            stream_create(cntl, d)
+            resp = Channel(cold_addr, timeout_ms=15_000).call_sync(
+                "Serving", "Generate",
+                {"prompt": warm_prompt, "max_new_tokens": 4,
+                 "prefix_holders": [addr]},
+                serializer="json", cntl=cntl)
+            assert d.done.wait(15), \
+                f"seed {seed}: generation hung on fetch failure"
+        assert plan3.injected.get("migrate.prefix_fetch", 0) == 1
+        # the fetch failed -> recompute fallback: no prefix served
+        assert resp["prefix_hit"] == 0, resp
+        assert cold_svc.prefix_fetches == 0
+        mig_stats = cold_mig.migrator.stats()
+        assert mig_stats["fetch_routes"][addr]["failed"] == 1
+        # no fault: the same pull lands on a FRESH cold replica (the
+        # first one's recompute fallback warmed its own cache, which
+        # is exactly the point of the fallback)
+        cold2_store = KVCacheStore(page_tokens=PT, page_bytes=256,
+                                   max_blocks=32,
+                                   name=f"c17b_cold2_{seed}",
+                                   commit_live_pages=True)
+        cold2_eng = DecodeEngine(step, num_slots=4, store=cold2_store,
+                                 max_pages_per_slot=32,
+                                 name=f"c17b_cold2_eng_{seed}")
+        cold2_srv = brpc.Server(enable_dcn=True)
+        cold2_svc = register_serving(cold2_srv, engine=cold2_eng)
+        cold2_mig = register_migration(cold2_srv, cold2_store)
+        cold2_srv.start("127.0.0.1", 0)
+        cold2_addr = f"127.0.0.1:{cold2_srv.port}"
+        cold2_svc.prefix_fetcher = make_prefix_fetcher(
+            cold2_mig.migrator, cold2_addr)
+        d2 = _Drain()
+        cntl2 = Controller(timeout_ms=15_000)
+        stream_create(cntl2, d2)
+        resp2 = Channel(cold2_addr, timeout_ms=15_000).call_sync(
+            "Serving", "Generate",
+            {"prompt": warm_prompt, "max_new_tokens": 4,
+             "prefix_holders": [addr]},
+            serializer="json", cntl=cntl2)
+        assert d2.done.wait(15)
+        assert resp2["prefix_hit"] >= PT, resp2
+        assert cold2_svc.prefix_fetches == 1
+        assert cold2_svc.prefix_fetched_pages >= 1
+        # baseline on the cold stores after drain
+        for c_store, c_eng, c_srv in (
+                (cold_store, cold_eng, cold_srv),
+                (cold2_store, cold2_eng, cold2_srv)):
+            assert wait_until(
+                lambda s=c_store: s.stats()["live_seqs"] == 0, 10)
+            c_eng.close(timeout_s=2.0)
+            c_srv.stop()
+            c_srv.join()
+            c_store.clear()
+            c_store.pagepool.assert_consistent()
+            assert c_store.pagepool.blocks_leased() == 0
+            c_store.close()
+    finally:
+        if successor is not None:
+            successor.close(timeout_s=2.0)
+        try:
+            router.close(timeout_s=2.0)
+        except Exception:
+            pass
+        try:
+            eng.close(timeout_s=2.0)
+        except Exception:
+            pass
+        try:
+            srv.stop()
+            srv.join()
+        except Exception:
+            pass
+        store.clear()
+        store.close()
+        try:
+            os.unlink(wal_path)
+            os.rmdir(wal_dir)
+        except OSError:
+            pass

@@ -368,10 +368,10 @@ def test_cluster_page_shows_replica_table_and_gradient():
 
     router = ClusterRouter(["127.0.0.1:9", "127.0.0.1:11"],
                            auto_tick=False, name="console_router")
-    s = brpc.Server()
-    s.start("127.0.0.1", 0)
+    srv = brpc.Server()
+    srv.start("127.0.0.1", 0)
     try:
-        status, body = _get(s, "/cluster")
+        status, body = _get(srv, "/cluster")
         assert status == 200
         snap = json.loads(body)
         r = snap["routers"]["console_router"]
@@ -388,8 +388,8 @@ def test_cluster_page_shows_replica_table_and_gradient():
         assert r["level_actions"][0] == "shed_at_router"
         assert "retry_after_s" in r
     finally:
-        s.stop()
-        s.join()
+        srv.stop()
+        srv.join()
         router.close(timeout_s=1.0)
 
 
@@ -452,3 +452,58 @@ def test_psserve_page_shows_shards_batchers_and_hot_keys():
         s.stop()
         s.join()
         cli.close()
+
+
+def test_cluster_page_shows_wal_placement_and_remote_floor(tmp_path):
+    """/cluster renders the ISSUE 16 durable-control-plane state: WAL
+    size/records/compaction + replay stats after an adoption, the
+    N-way buddy placement table, per-remote-replica floor propagation
+    (epoch / pushed level / drops / refusals), and the membership
+    epoch."""
+    from brpc_tpu.serving import ClusterRouter, SessionTable
+
+    wal_path = str(tmp_path / "console.wal")
+    table = SessionTable(wal=wal_path)
+    sess = table.new_session([1, 2, 3], 4)
+    sess.append(7)
+    table.close()
+
+    adopted = SessionTable.recover(wal_path)
+    router = ClusterRouter(["127.0.0.1:9"], sessions=adopted,
+                           auto_tick=False, replication_factor=3,
+                           name="console_wal_router")
+    router._note_placement(0xABC, owner="127.0.0.1:9",
+                           buddies=["127.0.0.1:11"])
+    srv = brpc.Server()
+    srv.start("127.0.0.1", 0)
+    try:
+        status, body = _get(srv, "/cluster")
+        assert status == 200
+        r = json.loads(body)["routers"]["console_wal_router"]
+        # adopting a WAL bumps the persisted membership epoch
+        assert r["epoch"] >= 1
+        assert r["replication_factor"] == 3
+        # WAL state: size, records, compaction row, adoption replay
+        wal = r["wal"]
+        assert wal["path"] == wal_path
+        assert wal["size_bytes"] > 0 and wal["records"] >= 1
+        assert wal["compactions"] >= 1          # adoption compacts
+        assert wal["last_compaction"]["records_after"] >= 1
+        assert r["wal_replay"]["sessions"] == 1
+        assert r["wal_replay"]["live"] == 1
+        # N-way placement table
+        assert r["placements"] == [{
+            "fingerprint": f"{0xABC:016x}", "owner": "127.0.0.1:9",
+            "buddies": ["127.0.0.1:11"]}]
+        # remote-floor propagation: present (empty until a push)
+        assert r["remote_floor"] == []
+        assert r["floor_pushes"] == 0
+        assert r["floor_push_drops"] == 0
+        assert r["floor_push_refused"] == 0
+        # suspended session row survived into the adopted table
+        assert r["sessions"]["suspended"] == 1
+    finally:
+        srv.stop()
+        srv.join()
+        router.close(timeout_s=1.0)
+        adopted.close()

@@ -757,3 +757,283 @@ def test_attach_after_close_raises_elogoff():
     finally:
         router.close(timeout_s=1.0)
         healthy.close()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: N-way placement, wire-level overload, durable sessions
+# ---------------------------------------------------------------------------
+
+
+def test_placement_n_way_distinct_healthy_first():
+    """ConsistentHashLB.placement returns n DISTINCT endpoints, owner
+    first (= select_server's choice), healthy before broken — broken
+    ones fill slots only when the healthy set runs out."""
+    from brpc_tpu.butil.endpoint import str2endpoint
+    from brpc_tpu.policy import health_check as hc
+    from brpc_tpu.policy.load_balancer import ConsistentHashLB, ServerNode
+
+    lb = ConsistentHashLB()
+    eps = [str2endpoint(f"10.0.0.{i}:80") for i in range(1, 6)]
+    for ep in eps:
+        lb.add_server(ServerNode(ep))
+    fp = 0xDEADBEEF
+    place = lb.placement(fp, 3)
+    assert len(place) == 3 and len(set(place)) == 3
+    assert place[0] == lb.select_server(request_code=fp)
+    # break the owner: it drops out of the healthy walk entirely
+    hc.mark_broken(place[0], hold_s=60.0)
+    try:
+        place2 = lb.placement(fp, 3)
+        assert place[0] not in place2
+        assert len(place2) == 3 and len(set(place2)) == 3
+        # ask for more copies than healthy nodes: broken ones fill in
+        for ep in eps[1:]:
+            hc.mark_broken(ep, hold_s=60.0)
+        place3 = lb.placement(fp, 3)
+        assert len(place3) == 3 and len(set(place3)) == 3
+    finally:
+        hc.reset_all()
+
+
+def test_three_way_buddy_ship_and_ownership_directory():
+    """replication_factor=3: a page-crossing generation ships its
+    committed pages to TWO ring buddies, the ownership directory
+    records owner + acked buddies, and every named holder can actually
+    serve the prefix (store.probe > 0)."""
+    reps = [_Replica(f"nway_{i}", delay_s=0.002) for i in range(3)]
+    router = ClusterRouter([r.handle() for r in reps], page_tokens=PT,
+                           replicate_sessions=True,
+                           replication_factor=3, name="nway_router",
+                           check_interval_s=0.02)
+    try:
+        prompt = list(range(70, 83))        # 13 + 6 tokens = 4 pages
+        s = router.open_session(prompt, 6)
+        got = []
+        router.attach(s.sid, 0, got.append)
+        assert wait_until(lambda: s.state == "finished", 20)
+        assert got == _expected(prompt, 6)
+        assert wait_until(lambda: s.replicated_pages > 0, 10), \
+            "no buddy received pages"
+        rows = router.placements()
+        assert rows, "ownership directory is empty"
+        row = rows[-1]
+        assert row["owner"] == s.replica
+        assert len(row["buddies"]) == 2, row
+        # all three holders (owner + both buddies) can serve the
+        # prefix — at least the pages shipped before the final
+        # boundary (the tail page ship can race session finish)
+        by_addr = {r.addr: r for r in reps}
+        toks = prompt + got
+        for holder in [row["owner"]] + row["buddies"]:
+            assert by_addr[holder].store.probe(toks) >= 2 * PT, holder
+        st = router.stats()
+        assert st["replication_factor"] == 3
+        assert st["placements"], "placements missing from stats()"
+    finally:
+        router.close(timeout_s=3.0)
+        for r in reps:
+            r.close()
+
+
+def test_attach_ahead_of_record_suppresses_redelivery():
+    """A cursor AHEAD of the record is legal while the session can
+    still decode (the client outran a failed WAL append): the gap is
+    re-decoded but NOT re-delivered — the client receives exactly the
+    tokens past its cursor.  On a terminal session the same cursor is
+    still a client error."""
+    table = SessionTable()
+    s = table.new_session([1, 2, 3], 10)
+    for t in (7, 8, 9):
+        s.append(t)
+    got = []
+    replayed = s.attach(5, got.append, lambda err: None)
+    assert replayed == 0 and got == []
+    # the driver re-decodes the gap (cursors 4, 5): suppressed
+    s.append(40)
+    s.append(50)
+    assert got == []
+    # past the attach cursor: delivered
+    s.append(60)
+    assert got == [60]
+    s.finish(None)
+    with pytest.raises(errors.RpcError) as ei:
+        s.attach(99, lambda t: None)
+    assert ei.value.code == errors.EREQUEST
+
+
+class _ControlReplica:
+    """A remote-shaped replica: serving + _kvmig + _cluster services,
+    but the router only knows its ADDRESS (no in-process components) —
+    the ISSUE 16 wire-level overload shape."""
+
+    def __init__(self, name, *, delay_s=0.0):
+        from brpc_tpu.serving import register_cluster_control
+        self.name = name
+        self.store = KVCacheStore(page_tokens=PT, page_bytes=256,
+                                  max_blocks=64, name=f"{name}_store",
+                                  commit_live_pages=True)
+        self.engine = DecodeEngine(_step_fn(delay_s), num_slots=4,
+                                   store=self.store,
+                                   max_pages_per_slot=32,
+                                   name=f"{name}_eng")
+        self.server = brpc.Server(enable_dcn=True)
+        register_serving(self.server, engine=self.engine)
+        register_migration(self.server, self.store)
+        self.ctrl = register_cluster_control(
+            self.server, engine=self.engine, store=self.store,
+            name=name)
+        self.server.start("127.0.0.1", 0)
+        self.addr = f"127.0.0.1:{self.server.port}"
+
+    def close(self):
+        try:
+            self.engine.close(timeout_s=2.0)
+        except Exception:
+            pass
+        try:
+            self.server.stop()
+            self.server.join()
+        except Exception:
+            pass
+        self.store.clear()
+        self.store.close()
+
+
+def test_remote_floor_push_applies_level_over_the_wire():
+    """An address-only (remote) replica receives the router's gradient
+    level through the _cluster SetFloor push, applies it via the SAME
+    policy as the in-process path, and its pressure report feeds the
+    router's gradient back."""
+    rep = _ControlReplica("wire_a", delay_s=0.002)
+    router = ClusterRouter([rep.addr], page_tokens=PT,
+                           name="wire_router", auto_tick=False,
+                           epoch=5)
+    try:
+        router._push_floor(3)
+        assert rep.ctrl.level == 3 and rep.ctrl.epoch == 5
+        assert rep.ctrl.applied == 1
+        # level 3 clamps new generations' budgets at the remote engine
+        assert rep.engine.degraded_clamp == router.clamp_new_tokens
+        rows = router.remote_floor_table()
+        assert len(rows) == 1
+        assert rows[0]["acked_level"] == 3
+        assert rows[0]["epoch"] == 5
+        assert rows[0]["ack_age_s"] is not None
+        assert router.floor_pushes == 1
+        # the ack carried the replica's pressures back: the router's
+        # gradient can now SEE the remote replica
+        p = router._pressures()
+        assert p["replica_pool_ratio"] >= 0.0
+        st = router._remote_floor[router.replicas[0].endpoint]
+        assert st["pressures"], "no pressure report rode the ack"
+        # de-escalation propagates too
+        router._push_floor(0)
+        assert rep.ctrl.level == 0
+        assert rep.engine.degraded_clamp is None
+    finally:
+        router.close(timeout_s=2.0)
+        rep.close()
+
+
+def test_epoch_fence_refuses_superseded_router():
+    """Split-brain: the replica latches the HIGHEST epoch it has seen
+    and refuses SetFloor pushes carrying a lower one (EREQUEST, 'stale
+    epoch') — a superseded router cannot drag the fleet's overload
+    posture around."""
+    rep = _ControlReplica("fence_a", delay_s=0.002)
+    new_router = ClusterRouter([rep.addr], page_tokens=PT,
+                               name="fence_new", auto_tick=False,
+                               epoch=7)
+    old_router = ClusterRouter([rep.addr], page_tokens=PT,
+                               name="fence_old", auto_tick=False,
+                               epoch=6)
+    try:
+        new_router._push_floor(2)
+        assert rep.ctrl.epoch == 7 and rep.ctrl.level == 2
+        old_router._push_floor(4)           # superseded: refused
+        assert rep.ctrl.level == 2, "stale push moved the floor"
+        assert rep.ctrl.refusals == 1
+        assert old_router.floor_push_refused == 1
+        rows = old_router.remote_floor_table()
+        assert rows[0]["refused"] == 1
+        # the raw wire error is diagnosable
+        from brpc_tpu.rpc.channel import Channel
+        with pytest.raises(errors.RpcError) as ei:
+            Channel(rep.addr, timeout_ms=2000).call_sync(
+                "_cluster", "SetFloor",
+                {"epoch": 1, "level": 4, "router": "zombie"},
+                serializer="tensorframe",
+                response_serializer="tensorframe")
+        assert ei.value.code == errors.EREQUEST
+        assert "stale epoch" in (ei.value.text or "")
+    finally:
+        new_router.close(timeout_s=2.0)
+        old_router.close(timeout_s=2.0)
+        rep.close()
+
+
+def test_prefix_fetch_pulls_pages_from_named_holder():
+    """Pull-based prefix fetch (ISSUE 16): a Generate carrying
+    prefix_holders on a COLD replica fetches the committed prefix from
+    the named owner via the migrator instead of recomputing — the
+    response reports the fetched pages as prefix_hit."""
+    from brpc_tpu.rpc.channel import Channel
+    warm = _ControlReplica("pf_warm", delay_s=0.002)
+    cold = _ControlReplica("pf_cold", delay_s=0.002)
+    # the serving services need their own addr to skip self-fetches
+    from brpc_tpu.migrate import make_prefix_fetcher
+    for rep in (warm, cold):
+        for svc in rep.server._services.values():
+            if getattr(svc, "NAME", "") == "Serving":
+                svc.prefix_fetcher = make_prefix_fetcher(
+                    rep.server._services["_kvmig"].migrator, rep.addr)
+    try:
+        prompt = list(range(30, 42))        # 12 tokens = 3 full pages
+        # warm the owner the ordinary way
+        ch_w = Channel(warm.addr, timeout_ms=10_000)
+        from brpc_tpu.rpc.controller import Controller
+        from brpc_tpu.rpc.stream import stream_create
+
+        class _Drain:
+            def __init__(self):
+                self.done = threading.Event()
+
+            def on_received_messages(self, stream, messages):
+                import json as _json
+                for m in messages:
+                    if _json.loads(bytes(m)).get("done") is not None:
+                        self.done.set()
+
+            def on_closed(self, stream):
+                self.done.set()
+
+        d = _Drain()
+        cntl = Controller(timeout_ms=10_000)
+        stream_create(cntl, d)
+        ch_w.call_sync("Serving", "Generate",
+                       {"prompt": prompt, "max_new_tokens": 4},
+                       serializer="json", cntl=cntl)
+        assert d.done.wait(10)
+        # the live page commits one page behind the decode head: the
+        # owner durably holds at least the first two prompt pages
+        assert wait_until(lambda: warm.store.probe(prompt) >= 2 * PT, 10)
+        assert cold.store.probe(prompt) == 0
+        # cold replica told where the prefix lives: it PULLS
+        d2 = _Drain()
+        cntl2 = Controller(timeout_ms=10_000)
+        stream_create(cntl2, d2)
+        resp = Channel(cold.addr, timeout_ms=10_000).call_sync(
+            "Serving", "Generate",
+            {"prompt": prompt, "max_new_tokens": 4,
+             "prefix_holders": [warm.addr]},
+            serializer="json", cntl=cntl2)
+        assert d2.done.wait(10)
+        assert resp["prefix_hit"] >= 2 * PT, resp
+        assert cold.store.probe(prompt) >= 2 * PT
+        svc = [s for s in cold.server._services.values()
+               if getattr(s, "NAME", "") == "Serving"][0]
+        assert svc.prefix_fetches == 1
+        assert svc.prefix_fetched_pages >= 2
+    finally:
+        warm.close()
+        cold.close()
