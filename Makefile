@@ -132,6 +132,18 @@ psserve: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_psserve.py -q
 	JAX_PLATFORMS=cpu python bench.py embedding
 
+# Training plane (README "Training plane", ISSUE 17): the
+# trainer-in-the-loop suite — fused co-located optimizer bit-identity
+# vs the dense oracle at partitions 1/2/4 (RPC AND lowered),
+# retried-wave exactly-once, bounded-staleness gating, arbiter shed
+# ordering — then the timed wire-optimizer vs pull-compute-push rung
+# (wire >= baseline beyond spread is the acceptance bar) plus the
+# serving-coexistence tokens/s ratio (3-trial median+spread, feeds
+# the same perf_diff gate `make bench` ends with).
+train: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_train.py -q
+	JAX_PLATFORMS=cpu python bench.py train
+
 # Binary tensor wire (README "Binary tensor wire", ISSUE 13): the
 # frame identity/golden/fuzz suite + PS bit-identity over tensorframe
 # vs JSON vs the dense oracle + the ICI fast path, then the embedding
@@ -301,4 +313,5 @@ stress:
 
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
     cluster durable model speculative trace hotspots microbench perf \
-    bench tsan tsan-core asan stress check ring-stress wedge-hunt
+    bench tsan tsan-core asan stress check ring-stress wedge-hunt \
+    psserve tensorframe train

@@ -70,8 +70,48 @@ migrations_failed = Adder("kvcache_migrations_failed")
 migrate_rollbacks = Adder("kvcache_migrate_rollbacks")
 migrate_zero_copy = Adder("kvcache_migrate_zero_copy")
 migrate_fallback = Adder("kvcache_migrate_fallback")
+migrate_offer_frames = Adder("kvcache_migrate_offer_frames")
 
 _mig_ids = itertools.count(1)
+
+
+def _envelope_frame_fields(header: dict, arrays: list) -> dict:
+    """The Offer envelope as tensorframe fields (ISSUE 17 adopter):
+    the page METADATA that used to bloat the json header — token runs,
+    chunk fingerprints, refcounts — rides as native little-endian
+    tensors, the page payload as one uint8 tensor, and only the small
+    irregular remainder (trace ids, zero-copy ticket/specs) stays as a
+    json bytes field.  :func:`_frame_envelope` reconstructs EXACTLY
+    the ``(header, arrays)`` the legacy json-header envelope decodes
+    to, so both wire formats feed one splice path."""
+    import json as _json
+    hdr = dict(header)
+    fields = {
+        "tokens": np.asarray(hdr.pop("tokens", []), np.int64),
+        # murmur-like 64-bit fingerprints may exceed int64: uint64
+        "fingerprints": np.asarray(hdr.pop("fingerprints", []),
+                                   np.uint64),
+        "refcounts": np.asarray(hdr.pop("refcounts", []), np.int64),
+        "hdr": _json.dumps(hdr).encode(),
+    }
+    if arrays:
+        fields["pages"] = np.ascontiguousarray(arrays[0], np.uint8)
+    return fields
+
+
+def _frame_envelope(req: dict) -> tuple[dict, list]:
+    """Inverse of :func:`_envelope_frame_fields`: back to the legacy
+    decode's ``(header, arrays)`` shape — bit-for-bit the same header
+    values and payload bytes (the regression test pins this)."""
+    import json as _json
+    hdr = _json.loads(bytes(req["hdr"]).decode())
+    hdr["tokens"] = [int(t) for t in np.asarray(req["tokens"])]
+    hdr["fingerprints"] = [int(f) for f in
+                           np.asarray(req["fingerprints"])]
+    hdr["refcounts"] = [int(r) for r in np.asarray(req["refcounts"])]
+    arrays = [np.asarray(req["pages"], np.uint8)] \
+        if "pages" in req else []
+    return hdr, arrays
 
 
 def chunk_fingerprints(tokens: Sequence[int], page_tokens: int) -> list:
@@ -116,6 +156,11 @@ class PageMigrator:
         self._shipped: dict[str, set] = {}
         # per-source pull-fetch matrix (ISSUE 16, /migration page)
         self.fetch_routes: dict[str, dict] = {}
+        # per-destination offer wire format: "frame" (tensorframe
+        # OfferT) until a peer answers ENOMETHOD, then STICKY "legacy"
+        # (json-header envelope) — the PS client's negotiation contract
+        self._wire_mode: dict[str, str] = {}
+        self.n_negotiation_fallbacks = 0
         from brpc_tpu import migrate as _migrate
         _migrate._register_migrator(self)
 
@@ -278,6 +323,7 @@ class PageMigrator:
             header["parent_span_id"] = span.span_id
             header["trace_sampled"] = span.sampled
         ticket = None
+        arrays: list = []
         if topo.get("xfer") and topo.get("nonce") != dcn._PROCESS_NONCE \
                 and dcn.transfer_server() is not None:
             # ZERO-COPY: page bytes stay device-resident, registered
@@ -289,7 +335,6 @@ class PageMigrator:
             header["xfer"] = dcn.transfer_address()
             header["ticket"] = ticket
             header["specs"] = specs
-            body = dcn._pack_envelope(header, [])
             migrate_zero_copy.add(1)
             with self._mu:
                 route["zero_copy"] += 1
@@ -297,18 +342,14 @@ class PageMigrator:
                           f"{have}..{nfull} ({len(send) * pb}B stay "
                           f"on device)")
         else:
-            stacked = np.stack(
-                [self.store.pagepool.read_raw(p) for p in send])
-            body = dcn._pack_envelope(header, [stacked])
+            arrays = [np.stack(
+                [self.store.pagepool.read_raw(p) for p in send])]
             migrate_fallback.add(1)
             span.annotate(f"host-serialized fallback: pages "
                           f"{have}..{nfull} ({len(send) * pb}B on the "
                           f"envelope)")
-        span.request_size = len(body)
         try:
-            raw = ch.channel.call_sync(
-                MIGRATE_SERVICE, "Offer", body,
-                serializer="raw", response_serializer="raw")
+            hdr = self._post_offer(ch, dest, header, arrays, span)
         finally:
             if ticket is not None:
                 # ack-on-pull-completion (ISSUE 7 satellite): a reply
@@ -316,9 +357,7 @@ class PageMigrator:
                 # offer unpins NOW — the TTL sweeper is the backstop
                 # for a peer that died mid-pull, not the release path
                 dcn.release_offer(ticket)
-        hdr, _ = dcn._unpack_envelope(bytes(raw))
         retained = int(hdr.get("imported", 0))
-        span.response_size = len(raw)
         span.annotate(f"destination spliced: {retained}/{len(send)} "
                       f"sent pages newly retained (dst span "
                       f"{hdr.get('dst_span_id', 0)})")
@@ -331,6 +370,45 @@ class PageMigrator:
             route["pages"] += len(send)
             route["bytes"] += len(send) * pb
         return nfull
+
+    def _post_offer(self, ch, dest: str, header: dict, arrays: list,
+                    span) -> dict:
+        """Send one Offer envelope, preferring the tensorframe method
+        (``OfferT``, ISSUE 17 adopter) and downgrading STICKY per
+        destination to the legacy json-header envelope when the peer
+        answers ENOMETHOD — the same per-peer negotiation contract the
+        PS client runs per shard.  Returns the reply header dict."""
+        with self._mu:
+            mode = self._wire_mode.get(dest)
+        if mode != "legacy":
+            fields = _envelope_frame_fields(header, arrays)
+            span.request_size = sum(
+                v.nbytes if isinstance(v, np.ndarray) else len(v)
+                for v in fields.values())
+            try:
+                resp = ch.channel.call_sync(
+                    MIGRATE_SERVICE, "OfferT", fields,
+                    serializer="tensorframe")
+                with self._mu:
+                    self._wire_mode[dest] = "frame"
+                migrate_offer_frames.add(1)
+                return dict(resp or {})
+            except errors.RpcError as e:
+                if e.code != errors.ENOMETHOD:
+                    raise
+                with self._mu:
+                    self._wire_mode[dest] = "legacy"
+                    self.n_negotiation_fallbacks += 1
+                span.annotate(f"peer {dest} lacks OfferT; sticky "
+                              f"json-envelope downgrade")
+        body = dcn._pack_envelope(header, arrays)
+        span.request_size = len(body)
+        raw = ch.channel.call_sync(
+            MIGRATE_SERVICE, "Offer", body,
+            serializer="raw", response_serializer="raw")
+        hdr, _ = dcn._unpack_envelope(bytes(raw))
+        span.response_size = len(raw)
+        return hdr
 
     def fetch(self, tokens: Sequence[int], src: str, dest: str) -> int:
         """PULL-based prefix warm-up (ISSUE 16): ask `src`'s
@@ -379,8 +457,11 @@ class PageMigrator:
         with self._mu:
             routes = {d: dict(r) for d, r in self.routes.items()}
             fetches = {s: dict(r) for s, r in self.fetch_routes.items()}
+            modes = dict(self._wire_mode)
+            fallbacks = self.n_negotiation_fallbacks
         return {"store": self.store.name, "routes": routes,
-                "fetch_routes": fetches}
+                "fetch_routes": fetches, "wire_modes": modes,
+                "negotiation_fallbacks": fallbacks}
 
 
 class MigrateService(Service):
@@ -415,16 +496,39 @@ class MigrateService(Service):
     @method(request="raw", response="raw")
     def Offer(self, cntl, req):
         with stagetag.stage("migrate"):
-            return self._offer(cntl, req)
+            try:
+                hdr, arrays = dcn._unpack_envelope(bytes(req))
+            except Exception as e:
+                cntl.set_failed(errors.EREQUEST,
+                                f"bad migration envelope: {e}")
+                return None
+            resp = self._splice(cntl, hdr, arrays)
+            return None if resp is None \
+                else dcn._pack_envelope(resp, [])
 
-    def _offer(self, cntl, req):
+    @method(request="tensorframe", response="tensorframe")
+    def OfferT(self, cntl, req):
+        """The same Offer on the BINARY tensor wire (ISSUE 17
+        adopter): page metadata and payload arrive as tensorframe
+        fields, decode to exactly the legacy envelope's (header,
+        arrays), and feed the one splice path.  Old sources never call
+        this; new sources downgrade sticky on ENOMETHOD."""
+        with stagetag.stage("migrate"):
+            try:
+                hdr, arrays = _frame_envelope(req or {})
+            except Exception as e:
+                cntl.set_failed(errors.EREQUEST,
+                                f"bad migration envelope: {e}")
+                return None
+            return self._splice(cntl, hdr, arrays)
+
+    def _splice(self, cntl, hdr, arrays):
         if fault.ENABLED and fault.hit(
                 "dcn.migrate_recv", store=self.store.name) is not None:
             cntl.set_failed(errors.EINTERNAL,
                             "injected migration recv loss")
             return None
         try:
-            hdr, arrays = dcn._unpack_envelope(bytes(req))
             toks = [int(t) for t in hdr["tokens"]]
             pt = int(hdr["page_tokens"])
             pb = int(hdr["page_bytes"])
@@ -520,7 +624,7 @@ class MigrateService(Service):
         resp = {"imported": retained, "pages": len(toks) // pt - have,
                 "dst_span_id": span.span_id}
         rpcz.submit(span)
-        return dcn._pack_envelope(resp, [])
+        return resp
 
     @method(request="json", response="json")
     def PushTo(self, cntl, req):

@@ -241,17 +241,25 @@ class PSClient:
     # ---- Update ----
 
     def update(self, keys, grads,
-               update_token: Optional[int] = None) -> dict[int, int]:
+               update_token: Optional[int] = None,
+               optimizer=None) -> dict[int, int]:
         """Sparse scatter-add; returns {partition: acked version}.
         Exactly-once per partition even across retries (update_ids).
+
+        With ``optimizer`` (an :class:`OptimizerSpec` or its wire
+        dict, ISSUE 17) ``grads`` are RAW gradients and each shard
+        runs the FUSED scatter+slot-step program against its
+        co-located momentum/Adam rows — the slots never cross the
+        wire.  The spec rides the JSON wire as an ``"optimizer"``
+        object and the binary wire as flattened ``opt_*`` fields.
 
         If the fan-out fails PARTIALLY (some partitions acked, some
         exhausted their retries), the raised RpcError carries
         ``update_token`` — replay the SAME logical update with
         ``update(keys, grads, update_token=e.update_token)`` and the
         partitions that already applied will dedup instead of double
-        scatter-adding.  A retry WITHOUT the token mints fresh ids and
-        re-applies everywhere."""
+        scatter-adding (or double-stepping momentum).  A retry WITHOUT
+        the token mints fresh ids and re-applies everywhere."""
         keys = np.asarray(keys, np.int64)
         grads = np.asarray(grads, np.float32)
         if keys.ndim != 1:
@@ -264,8 +272,14 @@ class PSClient:
         if grads.shape != (keys.shape[0], self.dim):
             raise ValueError(f"grads shape {grads.shape} != "
                              f"({keys.shape[0]}, {self.dim})")
+        spec = None
+        if optimizer is not None:
+            from brpc_tpu.train.optimizer import OptimizerSpec
+            spec = OptimizerSpec.from_wire(optimizer)
         if self._lowered is not None:
-            ver = self._lowered.update(keys, grads)
+            ver = self._lowered.update(keys, grads, optimizer=spec) \
+                if spec is not None else \
+                self._lowered.update(keys, grads)
             with self._mu:
                 self.n_updates += 1
             CLIENT_UPDATES.add(1)
@@ -278,7 +292,10 @@ class PSClient:
             # idempotent by the token itself (a replayed update_token
             # hits the table's applied set and acks the original —
             # the same discipline the RPC shards run per partition)
-            ver = tbl.update(keys, grads, update_id=token)
+            ver = tbl.update(keys, grads, update_id=token,
+                             optimizer=spec) \
+                if spec is not None else \
+                tbl.update(keys, grads, update_id=token)
             self._note_ici(ver, acked=True)
             with self._mu:
                 self.n_updates += 1
@@ -287,15 +304,22 @@ class PSClient:
         split = self._split(keys)
 
         def make_json(part, pos):
-            return {"keys": keys[pos].tolist(),
-                    "grads": grads[pos].tolist(),
-                    "update_id": self._uid_for(token, part)}
+            req = {"keys": keys[pos].tolist(),
+                   "grads": grads[pos].tolist(),
+                   "update_id": self._uid_for(token, part)}
+            if spec is not None:
+                req["optimizer"] = spec.to_wire()
+            return req
 
         def make_frame(part, pos):
             # tensors ride as raw int64/float32 bytes (fancy-index
-            # slices, one vectorized copy each), never Python lists
-            return {"keys": keys[pos], "grads": grads[pos],
-                    "update_id": self._uid_for(token, part)}
+            # slices, one vectorized copy each), never Python lists;
+            # the optimizer spec flattens to inline scalar fields
+            req = {"keys": keys[pos], "grads": grads[pos],
+                   "update_id": self._uid_for(token, part)}
+            if spec is not None:
+                req.update(spec.to_frame_fields())
+            return req
 
         try:
             resp = self._fan_out(split, "Update", make_json, make_frame)

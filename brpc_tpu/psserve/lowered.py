@@ -86,6 +86,7 @@ class ShardedEmbeddingTable:
         self.version = 0
         self.n_lookups = 0
         self.n_updates = 0
+        self.n_opt_updates = 0
         self.n_dup_updates = 0
         self.name = str(name)
         # the ICI fast path's idempotence (ISSUE 13): the same
@@ -145,6 +146,35 @@ class ShardedEmbeddingTable:
             g = jnp_.where(mask[:, None], grads, 0.0)
             return tbl.at[safe].add(g)
 
+        # the fused co-located optimizer updates (ISSUE 17): the SAME
+        # ownership-mask discipline as _update, with the slot step
+        # from train/optimizer.py running on each chip's block — the
+        # whole train step stays ONE shard_map program and the slot
+        # rows stay sharded exactly like their table rows.  Pad keys
+        # (-1) are owned by nobody: mask-zeroed gradient AND zero
+        # touch count, so padding can't decay row 0's momentum.
+        from brpc_tpu.train.optimizer import adam_step, sgdm_step
+
+        def _local_acc(tbl, keys, grads):
+            lo = jax.lax.axis_index("tp") * rows_per
+            local = keys - lo
+            mask = (local >= 0) & (local < rows_per)
+            safe = jnp_.clip(local, 0, rows_per - 1)
+            g = jnp_.where(mask[:, None], grads, 0.0)
+            g_acc = jnp_.zeros_like(tbl).at[safe].add(g)
+            cnt = jnp_.zeros((tbl.shape[0],), jnp_.float32
+                             ).at[safe].add(mask.astype(jnp_.float32))
+            return g_acc, cnt > 0.0
+
+        def _update_sgdm(tbl, m, keys, grads, lr, mu):
+            g_acc, touched = _local_acc(tbl, keys, grads)
+            return sgdm_step(jnp_, tbl, m, g_acc, touched, lr, mu)
+
+        def _update_adam(tbl, m, v, t, keys, grads, lr, b1, b2, eps):
+            g_acc, touched = _local_acc(tbl, keys, grads)
+            return adam_step(jnp_, tbl, m, v, t, g_acc, touched,
+                             lr, b1, b2, eps)
+
         self._lookup_psum = jax.jit(shard_map(
             _lookup_psum, mesh, in_specs=(P("tp", None), P()),
             out_specs=P()))
@@ -154,6 +184,17 @@ class ShardedEmbeddingTable:
         self._update = jax.jit(shard_map(
             _update, mesh, in_specs=(P("tp", None), P(), P()),
             out_specs=P("tp", None)))
+        self._update_sgdm = jax.jit(shard_map(
+            _update_sgdm, mesh,
+            in_specs=(P("tp", None), P("tp", None), P(), P(), P(), P()),
+            out_specs=(P("tp", None), P("tp", None))))
+        self._update_adam = jax.jit(shard_map(
+            _update_adam, mesh,
+            in_specs=(P("tp", None), P("tp", None), P("tp", None),
+                      P("tp"), P(), P(), P(), P(), P(), P()),
+            out_specs=(P("tp", None), P("tp", None), P("tp", None),
+                       P("tp"))))
+        self._slots: dict = {}
 
     # ---- client surface (PSClient's co-located backend) ----
 
@@ -182,12 +223,32 @@ class ShardedEmbeddingTable:
         LOWERED_LOOKUPS.add(1)
         return np.asarray(out)[:n], ver
 
-    def update(self, keys, grads,
-               update_id: Optional[int] = None) -> int:
+    def _ensure_slots_locked(self, spec) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if "m" not in self._slots:
+            self._slots["m"] = jnp.zeros_like(self._table)
+        if spec.kind == "adam":
+            if "v" not in self._slots:
+                self._slots["v"] = jnp.zeros_like(self._table)
+            if "t" not in self._slots:
+                self._slots["t"] = jax.device_put(
+                    np.zeros((self.vpad,), np.float32),
+                    NamedSharding(self.mesh, P("tp")))
+
+    def update(self, keys, grads, update_id: Optional[int] = None,
+               optimizer=None) -> int:
         """Scatter-add grads into the sharded table; one compiled
         program, table stays sharded.  With ``update_id`` the apply is
         idempotent exactly like the RPC shards: a duplicate id acks
-        the ORIGINAL apply's version and touches nothing."""
+        the ORIGINAL apply's version and touches nothing.
+
+        With ``optimizer`` (an :class:`OptimizerSpec`, ISSUE 17) the
+        grads are RAW gradients and the apply is the fused
+        scatter+slot-step shard_map program under the ownership mask —
+        momentum/Adam slots stay sharded with their rows, and the dup
+        check above covers them: a replayed wave steps nothing."""
         padded, n = self._pad_keys(keys)
         g = np.zeros((padded.shape[0], self.dim), np.float32)
         g[:n] = np.asarray(grads, np.float32)
@@ -195,7 +256,24 @@ class ShardedEmbeddingTable:
             if update_id is not None and update_id in self._applied:
                 self.n_dup_updates += 1
                 return self._applied[update_id]
-            self._table = self._update(self._table, padded, g)
+            if optimizer is None:
+                self._table = self._update(self._table, padded, g)
+            else:
+                self._ensure_slots_locked(optimizer)
+                s = self._slots
+                f32 = np.float32
+                if optimizer.kind == "sgdm":
+                    self._table, s["m"] = self._update_sgdm(
+                        self._table, s["m"], padded, g,
+                        f32(optimizer.lr), f32(optimizer.momentum))
+                else:
+                    self._table, s["m"], s["v"], s["t"] = \
+                        self._update_adam(
+                            self._table, s["m"], s["v"], s["t"],
+                            padded, g, f32(optimizer.lr),
+                            f32(optimizer.beta1), f32(optimizer.beta2),
+                            f32(optimizer.eps))
+                self.n_opt_updates += 1
             self.version += 1
             ver = self.version
             if update_id is not None:
@@ -213,6 +291,12 @@ class ShardedEmbeddingTable:
         with self._mu:
             return np.asarray(self._table)[:self.vocab]
 
+    def snapshot_slots(self) -> dict:
+        """Optimizer slots (vocab rows, pad stripped) as numpy."""
+        with self._mu:
+            return {k: np.asarray(v)[:self.vocab]
+                    for k, v in self._slots.items()}
+
     def stats(self) -> dict:
         with self._mu:
             return {
@@ -224,6 +308,8 @@ class ShardedEmbeddingTable:
                 "version": self.version,
                 "lookups": self.n_lookups,
                 "updates": self.n_updates,
+                "opt_updates": self.n_opt_updates,
+                "opt_slots": sorted(self._slots),
                 "dup_updates": self.n_dup_updates,
                 "applied_ids": len(self._applied),
                 "mesh": dict(self.mesh.shape),

@@ -128,6 +128,44 @@ class PSService(Service):
     def _release_bypass(b) -> None:
         b.release_idle()
 
+    # ---- the fused co-located optimizer apply (ISSUE 17) ----
+    #
+    # An optimizer-carrying Update takes the DIRECT path: the wave is
+    # already trainer-batched (one RPC per partition per step), its
+    # semantics (slot step per touched row) can't coalesce with plain
+    # scatter-adds in a batcher row, and the apply is one fused jitted
+    # program either way.  Same lock, same version counter, same
+    # applied-id dedup set as every other update — a retry on EITHER
+    # wire acks the original apply and steps nothing.
+
+    def _apply_opt(self, cntl, keys, grads, uid, spec):
+        if fault.ENABLED and fault.hit(
+                "psserve.opt_apply", shard=self.shard.shard_index,
+                stage="pre") is not None:
+            # pre-apply: no slot stepped, no row written; a retried
+            # wave applies normally
+            cntl.set_failed(errors.EINTERNAL,
+                            "injected psserve.opt_apply fault "
+                            "(pre-apply)")
+            return None
+        try:
+            ver, dup = self.shard.update_opt(keys, grads, spec,
+                                             update_id=uid)
+        except ValueError as e:
+            cntl.set_failed(errors.EREQUEST, str(e))
+            return None
+        if fault.ENABLED and fault.hit(
+                "psserve.opt_apply", shard=self.shard.shard_index,
+                stage="post") is not None:
+            # post-apply ack drop: momentum DID step; the retried wave
+            # must dedup by update_id or the slot double-steps (chaos
+            # scenario 18 proves it doesn't)
+            cntl.set_failed(errors.EINTERNAL,
+                            "injected psserve.opt_apply fault "
+                            "(post-apply)")
+            return None
+        return {"version": int(ver), "duplicate": bool(dup)}
+
     # ---- Lookup ----
 
     @method(request="json", response="json")
@@ -206,6 +244,14 @@ class PSService(Service):
         if not ok:
             cntl.set_failed(errors.EREQUEST, msg)
             return None
+        spec = None
+        if req.get("optimizer") is not None:
+            from brpc_tpu.train.optimizer import OptimizerSpec
+            try:
+                spec = OptimizerSpec.from_wire(req["optimizer"])
+            except ValueError as e:
+                cntl.set_failed(errors.EREQUEST, str(e))
+                return None
         if fault.ENABLED and fault.hit(
                 "psserve.update", shard=self.shard.shard_index,
                 stage="pre") is not None:
@@ -223,6 +269,8 @@ class PSService(Service):
         except ValueError as e:
             cntl.set_failed(errors.EREQUEST, str(e))
             return None
+        if spec is not None:
+            return self._apply_opt(cntl, keys, g, uid, spec)
 
         def ack(ver: int, dup: bool):
             if fault.ENABLED and fault.hit(
@@ -358,6 +406,15 @@ class PSService(Service):
         if not ok:
             cntl.set_failed(errors.EREQUEST, msg)
             return None
+        # the binary wire's optimizer spec rides as FLATTENED inline
+        # fields (opt_kind + opt_* floats — tensorframe has no nested
+        # dicts); same validation → EREQUEST contract as JSON
+        from brpc_tpu.train.optimizer import OptimizerSpec
+        try:
+            spec = OptimizerSpec.from_frame_fields(req)
+        except ValueError as e:
+            cntl.set_failed(errors.EREQUEST, str(e))
+            return None
         if fault.ENABLED and fault.hit(
                 "psserve.update", shard=self.shard.shard_index,
                 stage="pre") is not None:
@@ -372,6 +429,8 @@ class PSService(Service):
         except ValueError as e:
             cntl.set_failed(errors.EREQUEST, str(e))
             return None
+        if spec is not None:
+            return self._apply_opt(cntl, keys, grads, uid, spec)
 
         def ack(ver: int, dup: bool):
             if fault.ENABLED and fault.hit(

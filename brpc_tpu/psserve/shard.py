@@ -42,6 +42,7 @@ LOOKUP_KEYS = Adder("psserve_lookup_keys")
 UPDATES = Adder("psserve_updates")
 UPDATE_KEYS = Adder("psserve_update_keys")
 DUP_UPDATES = Adder("psserve_dup_updates")
+OPT_UPDATES = Adder("psserve_opt_updates")
 PULLS = Adder("psserve_pulls")
 PUSHES = Adder("psserve_pushes")
 
@@ -135,9 +136,15 @@ class EmbeddingShardServer:
         self.version = 0
         self._applied: OrderedDict[int, int] = OrderedDict()  # uid -> ver
         self._applied_cap = int(applied_cap)
+        # co-located optimizer slots (ISSUE 17): per-row momentum /
+        # Adam m/v/step tables, lazily allocated on the first
+        # optimizer-carrying update, living WITH the rows (same
+        # sharding) so they never cross the wire
+        self._slots: dict = {}
         # per-shard counters (process-wide Adders above aggregate)
         self.n_lookups = 0
         self.n_updates = 0
+        self.n_opt_updates = 0
         self.n_dup_updates = 0
         self.n_pulls = 0
         self.n_pushes = 0
@@ -149,10 +156,17 @@ class EmbeddingShardServer:
         self._scatter = jax.jit(lambda t, k, g: t.at[k].add(g))
         # CPU fast path (ISSUE 13): with no device mesh, a bucketed
         # gather is a plain numpy fancy-index over a zero-copy view of
-        # the (immutable, swap-on-update) jax array — bit-identical to
-        # the jitted gather, without ~200us of dispatch per call.  On a
-        # real mesh the jit path stays (the gather must run where the
-        # rows live).
+        # the jax array — bit-identical to the jitted gather, without
+        # ~200us of dispatch per call.  On a real mesh the jit path
+        # stays (the gather must run where the rows live).
+        #
+        # Lock discipline (ISSUE 17): the fused optimizer apply DONATES
+        # rows and slots, overwriting the old buffers in place, so the
+        # swap-on-update immutability the zero-copy view used to rely
+        # on no longer holds.  Every raw read of ``self._rows`` /
+        # ``self._slots`` must COMPLETE under ``self._mu`` (the gather
+        # result is a fresh array, so nothing aliasing the table
+        # escapes the lock); snapshots hand out copies.
         self._cpu_fast = mesh is None and jax.default_backend() == "cpu"
 
     # ---- ownership helpers ----
@@ -191,15 +205,18 @@ class EmbeddingShardServer:
         local = self._to_local(keys)
         n = local.shape[0]
         b = _bucket_up(max(n, 1), self.key_buckets)
-        if self._cpu_fast:
-            with self._mu:
-                tbl = self._rows
-            rows = np.asarray(tbl)[local]
-        else:
-            padded = np.zeros((b,), np.int64)
-            padded[:n] = local
-            rows = np.asarray(self._gather(self._rows, padded))[:n]
         with self._mu:
+            # the gather must FINISH under the lock: the fused
+            # optimizer apply donates the table buffer and overwrites
+            # it in place (see the lock-discipline note in __init__) —
+            # the fancy-index / forced gather below returns a copy, so
+            # nothing aliasing the table leaves the critical section
+            if self._cpu_fast:
+                rows = np.asarray(self._rows)[local]
+            else:
+                padded = np.zeros((b,), np.int64)
+                padded[:n] = local
+                rows = np.asarray(self._gather(self._rows, padded))[:n]
             ver = self.version
             self.n_lookups += 1
             self._note_hot(local)
@@ -240,6 +257,88 @@ class EmbeddingShardServer:
         pg[:n] = grads          # padded rows add 0 to row 0: a no-op
         self._rows = self._scatter(self._rows, pk, pg)
         self.version += 1
+
+    # ---- the fused co-located optimizer apply (ISSUE 17) ----
+
+    def _ensure_slots_locked(self, spec) -> None:
+        jnp = self._jnp
+        if "m" not in self._slots:
+            # zeros_like preserves the rows' sharding: on a tp mesh
+            # the momentum rows live exactly where their table rows do
+            self._slots["m"] = jnp.zeros_like(self._rows)
+        if spec.kind == "adam":
+            if "v" not in self._slots:
+                self._slots["v"] = jnp.zeros_like(self._rows)
+            if "t" not in self._slots:
+                self._slots["t"] = jnp.zeros((self.n_rows,), jnp.float32)
+
+    def update_opt(self, keys, grads, spec,
+                   update_id: Optional[int] = None) -> tuple[int, bool]:
+        """``update`` with co-located optimizer state: the gradient
+        scatter AND the slot step run as ONE jitted program per key
+        bucket (train/optimizer.py), under the same lock, version
+        counter and applied-id dedup as the plain scatter-add — so a
+        retried wave acks the ORIGINAL version and can never
+        double-step momentum.  The client sends RAW gradients; the
+        slot rows never cross the wire."""
+        from brpc_tpu.train.optimizer import fused_apply
+        local = self._to_local(keys)
+        grads = np.asarray(grads, np.float32)
+        if grads.shape != (local.shape[0], self.dim):
+            raise ValueError(f"grads shape {grads.shape} != "
+                             f"({local.shape[0]}, {self.dim})")
+        fn = fused_apply(spec.kind)
+        n = local.shape[0]
+        b = _bucket_up(max(n, 1), self.key_buckets)
+        pk = np.zeros((b,), np.int64)
+        pg = np.zeros((b, self.dim), np.float32)
+        # padding entries carry valid=0: they add no gradient AND do
+        # not mark row 0 touched (a plain zero-grad pad would still
+        # decay row 0's momentum — the mask is what makes padding a
+        # true no-op under an optimizer)
+        pv = np.zeros((b,), np.float32)
+        pk[:n] = local
+        pg[:n] = grads
+        pv[:n] = 1.0
+        with self._mu:
+            if update_id is not None and update_id in self._applied:
+                self.n_dup_updates += 1
+                DUP_UPDATES.add(1)
+                return self._applied[update_id], True
+            self._ensure_slots_locked(spec)
+            s = self._slots
+            if spec.kind == "sgdm":
+                self._rows, s["m"] = fn(
+                    self._rows, s["m"], pk, pg, pv,
+                    spec.lr, spec.momentum)
+            else:
+                self._rows, s["m"], s["v"], s["t"] = fn(
+                    self._rows, s["m"], s["v"], s["t"], pk, pg, pv,
+                    spec.lr, spec.beta1, spec.beta2, spec.eps)
+            self.version += 1
+            ver = self.version
+            if update_id is not None:
+                self._record_applied_locked(update_id, ver)
+            self.n_updates += 1
+            self.n_opt_updates += 1
+            # no _note_hot here: key heat feeds migration's hot-shard
+            # detection and means READ traffic — lookups track it, the
+            # plain update path doesn't, and a trainer hammering its
+            # own rows every wave must not masquerade as serving heat
+            # (it is also ~1ms of python dict loop per wave)
+        UPDATES.add(1)
+        OPT_UPDATES.add(1)
+        UPDATE_KEYS.add(int(n))
+        return ver, False
+
+    def snapshot_slots(self) -> dict:
+        """Current optimizer slot tables as numpy (tests compare
+        against the dense oracle's slots)."""
+        with self._mu:
+            # np.array (not asarray): the caller keeps the snapshot
+            # past the lock, and the next donated apply overwrites the
+            # buffer a zero-copy view would still be pointing at
+            return {k: np.array(v) for k, v in self._slots.items()}
 
     def _record_applied_locked(self, uid: int, ver: int) -> None:
         self._applied[uid] = ver
@@ -293,12 +392,13 @@ class EmbeddingShardServer:
         # cannot tell live from padding
         k = np.asarray(padded, np.int64)
         with self._mu:
-            rows = self._rows
-        if self._cpu_fast:
-            # numpy fancy-index over the zero-copy CPU view: exact same
-            # rows as the jitted gather, none of the dispatch
-            return np.asarray(rows)[k]
-        return np.asarray(self._gather(rows, k))
+            # complete the gather under the lock — the fused optimizer
+            # apply donates and overwrites the table in place, so the
+            # zero-copy view must not be read outside the critical
+            # section (the fancy-index result is a fresh array)
+            if self._cpu_fast:
+                return np.asarray(self._rows)[k]
+            return np.asarray(self._gather(self._rows, k))
 
     # Update rows pack (update_id, then per key [key, grad...]) into ONE
     # float64 vector: [uid, k0, g0_0..g0_{D-1}, k1, g1_0..].  float64
@@ -451,6 +551,8 @@ class EmbeddingShardServer:
                 "version": self.version,
                 "lookups": self.n_lookups,
                 "updates": self.n_updates,
+                "opt_updates": self.n_opt_updates,
+                "opt_slots": sorted(self._slots),
                 "dup_updates": self.n_dup_updates,
                 "pulls": self.n_pulls,
                 "pushes": self.n_pushes,
@@ -465,4 +567,6 @@ class EmbeddingShardServer:
         """The shard's current rows as numpy (tests compare against the
         dense oracle)."""
         with self._mu:
-            return np.asarray(self._rows)
+            # copy, not view: the donated optimizer apply overwrites
+            # the table buffer in place after the lock is released
+            return np.array(self._rows)

@@ -167,7 +167,7 @@ def generations_snapshot(limit: int = 50) -> dict:
 from brpc_tpu.serving.batcher import DynamicBatcher  # noqa: E402,F401
 from brpc_tpu.serving.engine import DecodeEngine  # noqa: E402,F401
 from brpc_tpu.serving.service import (  # noqa: E402,F401
-    ServingService, http_generate_handler, register_serving,
+    ScoreClient, ServingService, http_generate_handler, register_serving,
 )
 from brpc_tpu.serving.supervisor import EngineSupervisor  # noqa: E402,F401
 from brpc_tpu.serving.ladder import OverloadLadder  # noqa: E402,F401

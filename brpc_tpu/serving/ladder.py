@@ -50,9 +50,17 @@ class OverloadLadder:
     """
 
     def __init__(self, thresholds: Sequence[Mapping[str, float]], *,
-                 hysteresis_ticks: int = 5):
+                 hysteresis_ticks: int = 5,
+                 level_names: Sequence[str] = ()):
         self.thresholds = tuple(dict(t) for t in thresholds)
         self.hysteresis_ticks = int(hysteresis_ticks)
+        # optional display names, one per level (the training-plane
+        # arbiter labels its background-tier rungs so /cluster and the
+        # ordering proofs read "pace_trainer" instead of "level 1")
+        self.level_names = tuple(level_names)
+        if self.level_names and \
+                len(self.level_names) != len(self.thresholds):
+            raise ValueError("level_names must match thresholds")
         self.level = 0
         self.floor = 0
         self._calm_ticks = 0
@@ -62,6 +70,11 @@ class OverloadLadder:
         # proof reads these
         self.escalations = [0] * (len(self.thresholds) + 1)
         self.de_escalations = 0
+        # tick of each level's FIRST fire (None = never): the
+        # cheapest-first ordering proof is first_fired[cheap] <
+        # first_fired[expensive] under a ramp, strict and readable
+        self._tick = 0
+        self.first_fired = [None] * (len(self.thresholds) + 1)
 
     @property
     def num_levels(self) -> int:
@@ -81,10 +94,13 @@ class OverloadLadder:
         """One tick: escalate immediately to the pressed level,
         de-escalate one level per ``hysteresis_ticks`` calm ticks,
         never below ``floor``."""
+        self._tick += 1
         target = max(self.target_level(pressures), self.floor)
         if target > self.level:
             for lvl in range(self.level + 1, target + 1):
                 self.escalations[lvl] += 1
+                if self.first_fired[lvl] is None:
+                    self.first_fired[lvl] = self._tick
             self.level = target
             self._calm_ticks = 0
         elif target < self.level:
@@ -110,6 +126,8 @@ class OverloadLadder:
             "hysteresis_ticks": self.hysteresis_ticks,
             "calm_ticks": self._calm_ticks,
             "escalations": list(self.escalations[1:]),
+            "first_fired": list(self.first_fired[1:]),
+            "level_names": list(self.level_names),
             "de_escalations": self.de_escalations,
         }
 

@@ -56,6 +56,25 @@ class ServingService(Service):
             transform=lambda row: {"y": np.asarray(row).tolist()})
         return None   # deferred: the batch drainer completes the RPC
 
+    @method(request="tensorframe", response="tensorframe")
+    def ScoreT(self, cntl, req):
+        """Score on the BINARY tensor wire (ISSUE 17 adopter): the row
+        payload rides as a float32 tensor field both ways — no float
+        list round-trip.  Old peers never see this; new clients
+        (:class:`ScoreClient`) downgrade sticky on ENOMETHOD."""
+        if self._batcher is None:
+            cntl.set_failed(errors.ENOMETHOD, "no batcher registered")
+            return None
+        x = (req or {}).get("x")
+        if not isinstance(x, np.ndarray) or x.ndim != 1:
+            cntl.set_failed(errors.EREQUEST,
+                            'need rank-1 tensor field "x"')
+            return None
+        self._batcher.submit(
+            cntl, np.asarray(x, dtype=np.float32),
+            transform=lambda row: {"y": np.asarray(row, np.float32)})
+        return None   # deferred: the batch drainer completes the RPC
+
     @method(request="json", response="json")
     def Generate(self, cntl, req):
         if self._engine is None:
@@ -136,6 +155,42 @@ class ServingService(Service):
             kw["speculative"] = bool(req["speculative"])
         rid = self._engine.submit(prompt, max_new, emit, on_done, **kw)
         return {"accepted": True, "req_id": rid, "prefix_hit": hit}
+
+
+class ScoreClient:
+    """Client half of the Score adopter (ISSUE 17): prefers the binary
+    ``ScoreT`` wire and downgrades STICKY to json ``Score`` when the
+    peer answers ENOMETHOD (an old server) — the per-peer negotiation
+    contract the PS client runs per shard.  Both paths return the same
+    float32 rows; the regression test pins them byte-identical."""
+
+    def __init__(self, channel):
+        self._ch = channel
+        self._mode: Optional[str] = None     # None | "frame" | "json"
+        self.n_negotiation_fallbacks = 0
+
+    @property
+    def wire_mode(self) -> Optional[str]:
+        return self._mode
+
+    def score(self, x, **kw) -> np.ndarray:
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        if self._mode != "json":
+            try:
+                resp = self._ch.call_sync(
+                    "Serving", "ScoreT", {"x": x},
+                    serializer="tensorframe", **kw)
+                self._mode = "frame"
+                return np.asarray(resp["y"], np.float32)
+            except errors.RpcError as e:
+                if e.code != errors.ENOMETHOD:
+                    raise
+                self._mode = "json"
+                self.n_negotiation_fallbacks += 1
+        resp = self._ch.call_sync("Serving", "Score",
+                                  {"x": x.tolist()},
+                                  serializer="json", **kw)
+        return np.asarray(resp["y"], np.float32)
 
 
 def http_generate_handler(engine):

@@ -857,6 +857,83 @@ def run_embedding_press(n_shards: int, *, vocab: int = 1024,
         tear_down_psserve(servers, svcs, pc)
 
 
+def run_mixed_press(shapes, *, weights=None, n_shards: int = 2,
+                    vocab: int = 128, dim: int = 16,
+                    gen_tokens: int = 16, train_steps: int = 8,
+                    duration_s: float = 10.0, seed: int = 0,
+                    out=sys.stderr) -> dict:
+    """``--mixed lookup,generate,train`` (ISSUE 17): ONE in-process
+    fleet serving every requested traffic shape SIMULTANEOUSLY — zipf
+    PS lookups, streamed generations, trainer update waves — with the
+    :class:`~brpc_tpu.train.TrafficArbiter` arbitrating across shapes.
+    ``weights`` scales worker counts per shape (matching the shape
+    list's order; default 1 each).  The report prints per-shape qps
+    and latency percentiles plus the arbiter ladder's fire counters —
+    escalations and first-fired ticks per named rung — so the
+    cheapest-first ordering (trainer paced/shed BEFORE any serving
+    rung) is visible from the command line."""
+    from brpc_tpu.train.arbiter import MixedWorkloadHarness
+    shapes = [s.strip() for s in shapes if s.strip()]
+    known = ("lookup", "generate", "train")
+    bad = [s for s in shapes if s not in known]
+    if bad:
+        raise ValueError(f"unknown shapes {bad}; pick from {known}")
+    if not shapes:
+        raise ValueError("--mixed needs at least one shape")
+    w = {s: 1 for s in shapes}
+    for s, n in zip(shapes, weights or []):
+        w[s] = int(n)
+    h = MixedWorkloadHarness(
+        n_shards=n_shards, vocab=vocab, dim=dim,
+        lookup_workers=w.get("lookup", 0),
+        gen_workers=w.get("generate", 0), gen_tokens=gen_tokens,
+        train_workers=w.get("train", 0),
+        train_steps=train_steps if "train" in w else 0,
+        min_duration_s=duration_s, seed=seed, name="mixed_press")
+    try:
+        rep = h.run()
+    finally:
+        h.close()
+
+    def ms(v):
+        return "-" if v is None else f"{v / 1000.0:.2f}ms"
+
+    print(f"--- mixed press: {'+'.join(shapes)} over {n_shards} PS "
+          f"shards, {rep['elapsed_s']:.1f}s ---", file=out)
+    for name in ("lookup", "generate"):
+        st = rep["shapes"][name]
+        if not (st["ok"] or st["err"]):
+            continue
+        extra = ""
+        if name == "generate":
+            extra = (f"  bit_exact={st['bit_exact']}/"
+                     f"{st['ok']}")
+        print(f"{name:>9}: {st['qps']:8.1f} qps  "
+              f"p50 {ms(st['p50_us'])}  p99 {ms(st['p99_us'])}  "
+              f"errors {st['err']}{extra}", file=out)
+    tr = rep["train"]
+    if tr["waves"]:
+        print(f"{'train':>9}: {tr['updates_per_s']:8.1f} waves/s  "
+              f"waves {tr['waves']}  retries {tr['wave_retries']}  "
+              f"paced {tr['paced_waves']}  "
+              f"loss {tr['loss_first']:.4f} -> {tr['loss_final']:.4f}",
+              file=out)
+    lad = rep["arbiter"]["ladder"]
+    print("ladder fire counts (cheapest first):", file=out)
+    for i, name in enumerate(lad["level_names"]):
+        print(f"  L{i + 1} {name:<18} escalations "
+              f"{lad['escalations'][i]:<4} first_fired "
+              f"{lad['first_fired'][i]}", file=out)
+    print(f"arbiter: admitted {rep['arbiter']['admitted_waves']}  "
+          f"paced {rep['arbiter']['paced_waves']}  "
+          f"shed {rep['arbiter']['shed_waves']}", file=out)
+    print(f"invariants: exactly_once={all(rep['exactly_once'])}  "
+          f"stale_reads={rep['stale_reads']}  "
+          f"queues_drained={rep['queues_drained']}  "
+          f"pools_at_baseline={rep['pools_at_baseline']}", file=out)
+    return rep
+
+
 def run_cluster_press(n_replicas: int, request,
                       duration_s: float = 10.0, threads: int = 4,
                       timeout_ms: int = 20_000, request_factory=None,
@@ -1193,6 +1270,19 @@ def main(argv=None):
                     help="with --embedding: embedding table rows")
     ap.add_argument("--dim", type=int, default=32,
                     help="with --embedding: embedding row width")
+    ap.add_argument("--mixed", metavar="SHAPES",
+                    help="comma list from lookup,generate,train: one "
+                         "in-process fleet serving every shape at "
+                         "once, TrafficArbiter arbitrating; reports "
+                         "per-shape qps/p99 + ladder fire counts "
+                         "(ISSUE 17)")
+    ap.add_argument("--mixed-weights", metavar="W",
+                    help="comma worker weights matching --mixed order "
+                         "(default 1 each)")
+    ap.add_argument("--shards", type=int, default=2,
+                    help="--mixed: PS shard count")
+    ap.add_argument("--train-steps", type=int, default=8,
+                    help="--mixed: trainer steps per worker")
     ap.add_argument("--disagg", metavar="PREFILL_ADDR,DECODE_ADDR",
                     help="drive a disaggregated prefill/decode split: "
                          "each call runs DisaggPrefill.Prefill on the "
@@ -1236,6 +1326,14 @@ def main(argv=None):
                          "top-N stage-tagged folded stacks alongside "
                          "the latency report; 0 disables")
     a = ap.parse_args(argv)
+    if a.mixed:
+        weights = [int(x) for x in a.mixed_weights.split(",")] \
+            if a.mixed_weights else None
+        run_mixed_press(a.mixed.split(","), weights=weights,
+                        n_shards=a.shards, vocab=a.vocab, dim=a.dim,
+                        train_steps=a.train_steps,
+                        duration_s=a.duration, out=sys.stdout)
+        return
     if a.embedding:
         run_embedding_press(a.embedding, vocab=a.vocab, dim=a.dim,
                             serializer=a.serializer,

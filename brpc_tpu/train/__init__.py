@@ -1,0 +1,48 @@
+"""brpc_tpu.train — the training plane (ISSUE 17).
+
+The fourth traffic shape: a real data-parallel trainer driving the
+sharded parameter server end to end over the same RPC core that
+serves lookups and generations —
+
+  * ``optimizer.py`` — :class:`OptimizerSpec` + the fused
+    scatter-and-slot-update math.  ``PS.Update`` with an optimizer
+    spec runs the gradient scatter AND the momentum/Adam slot step as
+    ONE jitted program per key-count bucket, with the slot rows living
+    WITH the shard ("RPC Considered Harmful"'s fix done natively:
+    momentum never crosses the wire);
+  * ``trainer.py`` — :class:`DataParallelTrainer`: N worker threads
+    pulling minibatches, Lookup through PSClient (batched, tensorframe
+    wire), local grads, PS.Update waves under bounded-staleness
+    gating, periodic Pull-based eval proving loss decreases THROUGH
+    the service;
+  * ``arbiter.py`` — :class:`TrafficArbiter` (the OverloadLadder's
+    background-tier rungs: pace/shed trainer waves BEFORE serving
+    traffic is touched) + :class:`MixedWorkloadHarness` (one fleet
+    carrying zipf lookups, streamed generations and update waves
+    simultaneously — the paper's north-star mixed-shape claim).
+
+``trainer``/``arbiter`` import lazily (PEP 562) so the wire layers can
+import :class:`OptimizerSpec` without dragging the harness in.
+"""
+from __future__ import annotations
+
+from brpc_tpu.train.optimizer import OptimizerSpec, oracle_apply
+
+__all__ = [
+    "OptimizerSpec", "oracle_apply",
+    "DataParallelTrainer", "TrafficArbiter", "MixedWorkloadHarness",
+]
+
+_LAZY = {
+    "DataParallelTrainer": "brpc_tpu.train.trainer",
+    "TrafficArbiter": "brpc_tpu.train.arbiter",
+    "MixedWorkloadHarness": "brpc_tpu.train.arbiter",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+    return getattr(importlib.import_module(mod), name)

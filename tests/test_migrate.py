@@ -633,3 +633,119 @@ def test_commit_live_pages_exposes_filled_pages_mid_generation():
         assert store.pagepool.blocks_leased() == 0
     finally:
         store.close()
+
+
+# ---------------------------------------------------------------------------
+# the tensorframe Offer envelope (ISSUE 17 adopter)
+# ---------------------------------------------------------------------------
+
+def test_offer_envelope_frame_codec_byte_identical():
+    """Regression pin: the tensorframe Offer fields decode to EXACTLY
+    the (header, arrays) the legacy json-header envelope decodes to —
+    same header values (including a fingerprint above 2^63, which
+    rides uint64), same payload bytes — so both wire formats feed one
+    splice path."""
+    from brpc_tpu.migrate.plane import (_envelope_frame_fields,
+                                        _frame_envelope)
+    from brpc_tpu.rpc import tensorframe as tf
+
+    header = {
+        "tokens": [100, 101, 102, 103, 104, 105, 106, 107],
+        "fingerprints": [12345, 2**63 + 17],     # > int64 max
+        "refcounts": [2, 1],
+        "page_tokens": PT,
+        "page_bytes": PB,
+        "src": "127.0.0.1:5555",
+        "trace_id": 987654321,
+        "span_id": 42,
+    }
+    pages = np.arange(2 * PB, dtype=np.uint8)
+    arrays = [pages]
+
+    legacy_hdr, legacy_arrays = dcn._unpack_envelope(
+        dcn._pack_envelope(header, arrays))
+    # the frame path through the REAL binary wire (encode + decode)
+    fields = _envelope_frame_fields(header, arrays)
+    frame_hdr, frame_arrays = _frame_envelope(
+        tf.decode_frame(tf.encode_frame(fields)))
+
+    assert frame_hdr == legacy_hdr
+    assert len(frame_arrays) == len(legacy_arrays) == 1
+    assert frame_arrays[0].tobytes() == legacy_arrays[0].tobytes()
+    assert frame_hdr["fingerprints"][1] == 2**63 + 17
+
+    # the no-payload (zero-copy) envelope round-trips too
+    zc_hdr = dict(header, ticket=7, specs=[[PB, "uint8"]])
+    lh, la = dcn._unpack_envelope(dcn._pack_envelope(zc_hdr, []))
+    fh, fa = _frame_envelope(
+        tf.decode_frame(tf.encode_frame(
+            _envelope_frame_fields(zc_hdr, []))))
+    assert fh == lh and fa == [] and la == []
+
+
+def test_offer_wire_negotiation_frame_then_sticky_legacy(dest_server):
+    """A new destination serves ``OfferT`` (binary wire, counted); an
+    OLD destination (no OfferT method) answers ENOMETHOD and the
+    migrator downgrades STICKY per destination to the legacy envelope —
+    and the migration itself works identically on both wires (dest
+    admit prefix-hits every page, contents bit-exact)."""
+    from brpc_tpu.migrate.plane import MigrateService
+
+    _, dst, addr = dest_server
+    prompt = list(range(700, 708))              # 2 full pages
+    src = _committed_src("mig_src_neg", prompt)
+
+    class _OldMigrate(MigrateService):
+        OfferT = None       # an old peer: binary method unregistered
+
+    old_dst = _mk_store("mig_dst_old")
+    old_srv = brpc.Server(enable_dcn=True)
+    old_srv.add_service(_OldMigrate(old_dst))
+    old_srv.start("127.0.0.1", 0)
+    old_addr = f"127.0.0.1:{old_srv.port}"
+    try:
+        m = PageMigrator(src, name="neg_migrator")
+        # new peer: the frame wire sticks
+        assert m.migrate(prompt, addr) == 2
+        st = m.stats()
+        assert st["wire_modes"][addr] == "frame"
+        assert st["negotiation_fallbacks"] == 0
+        seq = dst.admit(prompt + [1])
+        assert seq.prefix_hit_tokens == 2 * PT
+        for i in range(2):
+            assert dst.pagepool.read(seq.pages[i]).tolist() == \
+                prompt[i * PT:(i + 1) * PT]
+        dst.retire(seq, cache=False)
+
+        # old peer: ENOMETHOD -> sticky legacy, migration still lands
+        assert m.migrate(prompt, old_addr) == 2
+        st = m.stats()
+        assert st["wire_modes"][old_addr] == "legacy"
+        assert st["negotiation_fallbacks"] == 1
+        seq2 = old_dst.admit(prompt + [2])
+        assert seq2.prefix_hit_tokens == 2 * PT
+        for i in range(2):
+            assert old_dst.pagepool.read(seq2.pages[i]).tolist() == \
+                prompt[i * PT:(i + 1) * PT]
+        old_dst.retire(seq2, cache=False)
+
+        # sticky: a second ship to the old peer never re-probes (the
+        # fallback counter does not move again)
+        src2 = _committed_src("mig_src_neg2",
+                              list(range(720, 728)))
+        try:
+            m2 = PageMigrator(src2, name="neg_migrator2")
+            m2._wire_mode[old_addr] = m._wire_mode[old_addr]
+            assert m2.migrate(list(range(720, 728)), old_addr) == 2
+            assert m2.stats()["negotiation_fallbacks"] == 0
+            assert m2.stats()["wire_modes"][old_addr] == "legacy"
+        finally:
+            src2.clear()
+            src2.close()
+    finally:
+        old_srv.stop()
+        old_srv.join()
+        old_dst.clear()
+        old_dst.close()
+        src.clear()
+        src.close()

@@ -47,7 +47,11 @@ _HIGHER_BETTER_KEYS = {"qps", "gbps", "tokens_per_s", "items_per_s",
                        "tensorframe_lookups_per_s",
                        "json_lookups_per_s",
                        "lowered_lookups_per_s",
-                       "tax_reduction_x"}
+                       "tax_reduction_x",
+                       "wire_updates_per_s",
+                       "pcp_updates_per_s",
+                       "tokens_per_s_alone",
+                       "tokens_per_s_mixed"}
 
 
 def direction(key: str) -> str | None:
