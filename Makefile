@@ -111,6 +111,16 @@ durable: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_session_wal.py -q
 	JAX_PLATFORMS=cpu python bench.py durable
 
+# Multi-model plane (README "Multi-model plane", ISSUE 18): the
+# deployment/catalog/canary suite (named deployments, (model, prefix)
+# routing, model-aware WAL adoption, lifecycle fencing, misroute
+# counters) plus the timed two-model-tax / 95-5-canary-split rung
+# (3-trial median+spread, feeds the same perf_diff gate `make bench`
+# ends with).  CPU jit path.
+multimodel: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_modelplane.py -q
+	JAX_PLATFORMS=cpu python bench.py multimodel
+
 # Real model serving (README "Real model serving", ISSUE 10): the
 # paged-attention equivalence suite (gather + pallas-interpret vs the
 # dense reference at page boundaries / COW forks / evict-readmit), the
@@ -314,4 +324,4 @@ stress:
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
     cluster durable model speculative trace hotspots microbench perf \
     bench tsan tsan-core asan stress check ring-stress wedge-hunt \
-    psserve tensorframe train
+    psserve tensorframe train multimodel

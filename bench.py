@@ -3511,6 +3511,196 @@ def durable_main(argv) -> None:
     print(json.dumps(out))
 
 
+def bench_multimodel(n_replicas: int = 2, trials: int = 3,
+                     duration_s: float = 2.0, threads: int = 3,
+                     step_delay_s: float = 0.01, max_new: int = 16,
+                     canary_sessions: int = 200) -> dict:
+    """Multi-model plane rung (ISSUE 18), two halves:
+
+    A. **Two-model tax** — generations/s through ONE router front door
+       over the same replica fleet, single-deployment vs
+       two-deployment (every request names its model; the only delta
+       is the plane itself: catalog resolution, the (model, prefix)
+       fingerprint fold, per-deployment engine dispatch).  Publishes
+       ``two_model_overhead_pct`` with the ISSUE 18 acceptance claim
+       ``two_model_overhead_within_5pct``.
+
+    B. **Canary split** — one model_id behind two versioned
+       deployments weighted 95/5; clients ask for the bare model_id
+       and the router's smooth-WRR canary splitter picks the version.
+       Publishes the observed v1 share with the acceptance claim
+       ``canary_within_2pts`` (|observed - 95| <= 2 points; smooth WRR
+       is deterministic to ±1 pick, so the band is generous).
+
+    ``wrong_model_routes`` rides along and must be 0 — the plane's
+    invariant, not a performance number.  CPU-valid: numpy step fns."""
+    import threading as _threading
+
+    import brpc_tpu as brpc
+    from brpc_tpu.serving import RouterClient
+    from brpc_tpu.serving.modelplane import WARM
+    from brpc_tpu.tools.rpc_press import (spin_up_multimodel_cluster,
+                                          tear_down_multimodel_cluster)
+
+    PT = 8
+
+    # ---- half A: single-deployment vs two-deployment gens/s ----
+
+    def drive(raddr, duration, models):
+        stop = _threading.Event()
+        mu = _threading.Lock()
+        ok = [0]
+        clients = [RouterClient(raddr, timeout_ms=20_000)
+                   for _ in range(threads)]
+
+        def worker(w):
+            n = 0
+            while not stop.is_set():
+                prompt = [w * 31 + j for j in range(PT)]
+                m = models[(w + n) % len(models)]
+                n += 1
+                try:
+                    res = clients[w % len(clients)].generate(
+                        prompt, max_new, timeout_s=20, model=m)
+                except brpc.RpcError:
+                    continue
+                if res["error"] is None:
+                    with mu:
+                        ok[0] += 1
+
+        ts = [_threading.Thread(target=worker, args=(w,), daemon=True)
+              for w in range(threads)]
+        t0 = time.monotonic()
+        [t.start() for t in ts]
+        time.sleep(duration)
+        stop.set()
+        [t.join(10) for t in ts]
+        return ok[0] / (time.monotonic() - t0)
+
+    def tax_trial(k):
+        qps = {}
+        wrong = 0
+        for mode, models in (("single", ["modela"]),
+                             ("dual", ["modela", "modelb"])):
+            replicas, _mults, router, rsrv, raddr = \
+                spin_up_multimodel_cluster(
+                    n_replicas, models, page_tokens=PT,
+                    step_delay_s=step_delay_s, max_sessions=512,
+                    name_prefix=f"bench_mm_{k}_{mode}")
+            try:
+                drive(raddr, 0.2, models)        # warm both paths
+                qps[mode] = drive(raddr, duration_s, models)
+                wrong += router.stats()["wrong_model_routes"]
+            finally:
+                tear_down_multimodel_cluster(replicas, router, rsrv)
+        return qps["single"], qps["dual"], wrong
+
+    tax_rs = [tax_trial(k) for k in range(trials)]
+    singles = sorted(r[0] for r in tax_rs)
+    duals = sorted(r[1] for r in tax_rs)
+    s_med = singles[len(singles) // 2]
+    d_med = duals[len(duals) // 2]
+    overheads = sorted((s - d) / s * 100.0
+                       for s, d, _w in tax_rs if s > 0)
+    o_med = overheads[len(overheads) // 2] if overheads else None
+    wrong_routes = sum(r[2] for r in tax_rs)
+    # same minimum-spread floor as the cluster/durable rungs:
+    # admission quantization hides ± half a step period per generation
+    floor_frac = 1.0 / (2 * max_new)
+
+    # ---- half B: 95/5 canary split over one model_id ----
+
+    def canary_trial(k):
+        replicas, _mults, router, rsrv, raddr = \
+            spin_up_multimodel_cluster(
+                1, ["orca@v1", "orca@v2"], page_tokens=PT,
+                step_delay_s=0.0, max_sessions=1024,
+                name_prefix=f"bench_can_{k}")
+        try:
+            # the canary weights: v1 holds 95, v2 holds 5
+            replicas[0]["deps"].deploy("orca@v1", weight=95, state=WARM)
+            replicas[0]["deps"].deploy("orca@v2", weight=5, state=WARM)
+            router.catalog.note(replicas[0]["addr"],
+                                replicas[0]["deps"].snapshot())
+            cli = RouterClient(raddr, timeout_ms=20_000)
+            for i in range(canary_sessions):
+                prompt = [900 + 7 * i + j for j in range(PT)]
+                res = cli.generate(prompt, 2, timeout_s=20,
+                                   model="orca")
+                if res["error"] is not None:
+                    raise RuntimeError(
+                        f"bench_multimodel: canary generation failed "
+                        f"E{res['error']}")
+            picks = router.stats()["canary"].get("orca", {})
+            v1 = picks.get("orca@v1", 0)
+            total = sum(picks.values())
+            return 100.0 * v1 / total if total else 0.0
+        finally:
+            tear_down_multimodel_cluster(replicas, router, rsrv)
+
+    shares = sorted(canary_trial(k) for k in range(trials))
+    share_med = shares[len(shares) // 2]
+
+    return {
+        "replicas": n_replicas,
+        "threads": threads,
+        "step_delay_ms": step_delay_s * 1e3,
+        "single_model_gens_per_s": round(s_med, 1),
+        "single_model_gens_per_s_spread": _floor_spread(
+            s_med, singles[0], singles[-1], s_med * floor_frac),
+        "two_model_gens_per_s": round(d_med, 1),
+        "two_model_gens_per_s_spread": _floor_spread(
+            d_med, duals[0], duals[-1], d_med * floor_frac),
+        "two_model_overhead_pct": (round(o_med, 2)
+                                   if o_med is not None else None),
+        "two_model_overhead_pct_spread": (
+            _floor_spread(o_med, overheads[0], overheads[-1],
+                          100.0 * floor_frac)
+            if o_med is not None else None),
+        # the ISSUE 18 acceptance claim: naming models costs <= 5% of
+        # anonymous single-model throughput at the median
+        "two_model_overhead_within_5pct": bool(
+            o_med is not None and o_med <= 5.0),
+        "canary_sessions": canary_sessions,
+        "canary_v1_share_pct": round(share_med, 2),
+        "canary_v1_share_pct_spread": _floor_spread(
+            share_med, shares[0], shares[-1],
+            100.0 / canary_sessions),
+        "canary_within_2pts": bool(abs(share_med - 95.0) <= 2.0),
+        "wrong_model_routes": wrong_routes,
+        "trials": trials,
+        "cpu_valid": True,
+        "note": ("multi-model plane rung (ISSUE 18): half A is "
+                 "generations/s through one router front door, "
+                 "single- vs two-deployment on the same fleet and the "
+                 "same decode-bound operating point (the plane's "
+                 "catalog/fingerprint/dispatch cost is the only "
+                 "delta; <=5% acceptance), half B drives one model_id "
+                 "behind 95/5-weighted versioned deployments and "
+                 "reads the router's smooth-WRR canary scoreboard "
+                 "(±2-point acceptance; the splitter is deterministic "
+                 f"to ±1 pick); {trials} trials, minimum-spread "
+                 f"floors of ±{100.0 / (2 * max_new):.1f}% "
+                 "(admission quantization) / ±1 pick (canary); "
+                 "wrong_model_routes must read 0"),
+    }
+
+
+def multimodel_main(argv) -> None:
+    """`python bench.py multimodel`: run ONLY the multi-model plane
+    rung and print one JSON object on stdout (progress on stderr) —
+    the `make multimodel`-adjacent bench entry and the subprocess the
+    full bench run shells out to."""
+    log("multimodel: two-model tax + canary split rung...")
+    out = bench_multimodel()
+    for k, v in out.items():
+        if isinstance(v, (dict, list)):
+            log(f"  {k}: {json.dumps(v)}")
+        else:
+            log(f"  {k}: {v}")
+    print(json.dumps(out))
+
+
 def migrate_main(argv) -> None:
     """`python bench.py migrate`: run ONLY the migration rung and
     print one JSON object on stdout (progress on stderr) — the
@@ -3672,6 +3862,12 @@ def main():
     except Exception as e:
         details["durable"] = {"error": f"{type(e).__name__}: {e}"}
     log(f"  {details['durable']}")
+    log("bench: multi-model plane (subprocess, forced CPU)...")
+    try:
+        details["multimodel"] = _run_cpu_subcommand("multimodel")
+    except Exception as e:
+        details["multimodel"] = {"error": f"{type(e).__name__}: {e}"}
+    log(f"  {details['multimodel']}")
     log("bench: real-model serving (subprocess, forced CPU)...")
     try:
         details["model"] = _run_cpu_subcommand("model")
@@ -3824,6 +4020,8 @@ if __name__ == "__main__":
         cluster_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "durable":
         durable_main(sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "multimodel":
+        multimodel_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "model":
         model_main(sys.argv[2:])
     elif len(sys.argv) > 1 and sys.argv[1] == "speculative":

@@ -410,7 +410,8 @@ class PageMigrator:
         span.response_size = len(raw)
         return hdr
 
-    def fetch(self, tokens: Sequence[int], src: str, dest: str) -> int:
+    def fetch(self, tokens: Sequence[int], src: str, dest: str,
+              model: Optional[str] = None) -> int:
         """PULL-based prefix warm-up (ISSUE 16): ask `src`'s
         ``_kvmig`` service to push `tokens`' committed prefix to
         `dest` — normally this process's own migration address, so a
@@ -418,7 +419,11 @@ class PageMigrator:
         of recomputing it.  Returns pages landed (0 when the owner
         holds none of the prefix); raises RpcError on a dead or
         refusing owner — the caller's recompute path is the fallback,
-        exactly the ``migrate()`` contract in the other direction."""
+        exactly the ``migrate()`` contract in the other direction.
+        ``model`` tags the request on the multi-model plane (ISSUE 18):
+        a model-tagged ``_kvmig`` owner REFUSES a mismatched fetch, so
+        a stale holder list can never splice one model's pages into
+        another's store."""
         with stagetag.stage("migrate"):
             if fault.ENABLED and fault.hit(
                     "migrate.prefix_fetch", src=src) is not None:
@@ -428,11 +433,13 @@ class PageMigrator:
                     errors.EINTERNAL,
                     f"injected prefix fetch failure from {src}")
             ch = self._channel(str(src))
+            req = {"tokens": [int(t) for t in tokens],
+                   "dest": str(dest)}
+            if model:
+                req["model"] = str(model)
             try:
                 out = ch.channel.call_sync(
-                    MIGRATE_SERVICE, "PushTo",
-                    {"tokens": [int(t) for t in tokens],
-                     "dest": str(dest)},
+                    MIGRATE_SERVICE, "PushTo", req,
                     serializer="json", response_serializer="json")
             except errors.RpcError:
                 with self._mu:
@@ -474,10 +481,18 @@ class MigrateService(Service):
 
     NAME = MIGRATE_SERVICE
 
-    def __init__(self, store, *, migrator: Optional[PageMigrator] = None):
+    def __init__(self, store, *, migrator: Optional[PageMigrator] = None,
+                 model: str = ""):
         self.store = store
         self.migrator = migrator or PageMigrator(
             store, name=f"{store.name}_pusher")
+        # multi-model plane (ISSUE 18): the deployment this store's
+        # pages belong to.  "" (pre-plane) accepts anything; a tagged
+        # service refuses a PushTo carrying a DIFFERENT model — the
+        # same-model fetch constraint that makes cross-model page
+        # splices structurally impossible.
+        self.model = str(model or "")
+        self.n_model_refusals = 0
         self._mu = InstrumentedLock("migrate.service")
         # per-source route matrix (the inbound half of /migration)
         self.inbound: dict[str, dict] = {}
@@ -639,6 +654,15 @@ class MigrateService(Service):
             cntl.set_failed(errors.EREQUEST,
                             'PushTo needs "tokens" and "dest"')
             return None
+        want = str(req.get("model") or "")
+        if want and self.model and want != self.model:
+            with self._mu:
+                self.n_model_refusals += 1
+            cntl.set_failed(
+                errors.EREQUEST,
+                f"model mismatch: this store holds {self.model!r} "
+                f"pages, refusing a {want!r} fetch")
+            return None
         try:
             pages = self.migrator.migrate(tokens, str(dest))
         except errors.RpcError as e:
@@ -649,26 +673,31 @@ class MigrateService(Service):
     def stats(self) -> dict:
         with self._mu:
             inbound = {s: dict(r) for s, r in self.inbound.items()}
-        return {"store": self.store.name, "inbound": inbound}
+        return {"store": self.store.name, "model": self.model,
+                "model_refusals": self.n_model_refusals,
+                "inbound": inbound}
 
 
 def register_migration(server, store,
-                       migrator: Optional[PageMigrator] = None
-                       ) -> MigrateService:
+                       migrator: Optional[PageMigrator] = None,
+                       model: str = "") -> MigrateService:
     """Expose `store` as a migration destination (and PushTo source) on
-    `server`.  Call before ``server.start()``."""
-    svc = MigrateService(store, migrator=migrator)
+    `server`.  Call before ``server.start()``.  ``model`` tags the
+    store's deployment on the multi-model plane (see MigrateService)."""
+    svc = MigrateService(store, migrator=migrator, model=model)
     server.add_service(svc)
     return svc
 
 
-def make_prefix_fetcher(migrator: PageMigrator, self_addr: str):
+def make_prefix_fetcher(migrator: PageMigrator, self_addr: str,
+                        model: Optional[str] = None):
     """Build the ``prefix_fetcher`` hook Serving.Generate calls on a
     cache miss (ISSUE 16): try each holder the router named (skipping
     this replica itself) until one push lands, returning pages fetched.
     Any holder failure falls through to the next; exhausting them
     returns 0 and the caller recomputes — fetch is an optimization,
-    never a correctness dependency."""
+    never a correctness dependency.  ``model`` tags every fetch on the
+    multi-model plane so a mismatched owner refuses it (ISSUE 18)."""
     self_addr = str(self_addr)
 
     def fetch(prompt, holders) -> int:
@@ -677,7 +706,8 @@ def make_prefix_fetcher(migrator: PageMigrator, self_addr: str):
             if h == self_addr:
                 continue
             try:
-                pages = migrator.fetch(prompt, h, self_addr)
+                pages = migrator.fetch(prompt, h, self_addr,
+                                       model=model)
             except Exception:
                 continue
             if pages:

@@ -11,3 +11,6 @@ from brpc_tpu.models.runner import (  # noqa: F401
     as_runner, dense_forward, dense_generate, init_runner_params,
     make_store_for, make_tp_mesh, place_runner_params, run_prefill,
 )
+from brpc_tpu.models.registry import (  # noqa: F401
+    DeploymentRegistry, ModelDeployment, global_registry,
+)

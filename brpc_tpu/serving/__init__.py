@@ -182,3 +182,8 @@ from brpc_tpu.serving.session_wal import SessionWAL  # noqa: E402,F401
 from brpc_tpu.serving.cluster_control import (  # noqa: E402,F401
     CLUSTER_SERVICE, ClusterControlService, register_cluster_control,
 )
+from brpc_tpu.serving.modelplane import (  # noqa: E402,F401
+    DEFAULT_MODEL, CanarySplit, ModelCatalog, ModelMetrics,
+    ReplicaDeployments, cluster_deploy, deployment_key,
+    model_fingerprint, split_deployment_key,
+)

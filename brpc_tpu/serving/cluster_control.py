@@ -1,5 +1,5 @@
 """The ``_cluster`` control service — wire-level overload with epoch
-fencing (ISSUE 16).
+fencing (ISSUE 16) and model-deployment lifecycle (ISSUE 18).
 
 PR 8's overload gradient was cluster-wide in POLICY but local in
 MECHANISM: levels 2-4 acted through in-process ``ReplicaHandle``
@@ -25,16 +25,31 @@ still ticking — the classic split-brain after a router failover —
 cannot drag the fleet's overload posture around.  A dropped push needs
 no special handling: the router re-pushes every tick (chaos scenario
 17 drives both paths via ``cluster.floor_push``).
+
+MODEL PLANE (ISSUE 18).  The same connection carries the deployment
+catalog both ways: every ``SetFloor``/``Report`` reply embeds the
+replica's :class:`~brpc_tpu.serving.modelplane.ReplicaDeployments`
+snapshot as one JSON str field (``deployments``), so the router's
+catalog converges within one tick of any replica-side change, with no
+extra RPC.  Lifecycle mutations arrive as ``Deploy`` / ``Undeploy`` /
+``Drain`` pushes and are fenced by the SAME epoch latch as
+``SetFloor`` — a superseded router can no more reshape the fleet's
+model topology than its overload posture (chaos scenario 19 proves
+both refusals).  Deploy here is CATALOG-level: it marks an
+already-bound deployment's state/weight (warm/draining) or registers a
+catalog-only row; binding an actual engine/store happens at replica
+spin-up where the accelerator lives.
 """
 from __future__ import annotations
 
 import time
 from typing import Optional
 
-from brpc_tpu import errors
+from brpc_tpu import errors, fault
 from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.rpc.service import Service, method
 from brpc_tpu.serving.ladder import apply_level_to_components
+from brpc_tpu.serving.modelplane import (LOADING, WARM, publish_deployments)
 
 CLUSTER_SERVICE = "_cluster"
 
@@ -49,7 +64,8 @@ class ClusterControlService(Service):
 
     def __init__(self, *, supervisor=None, batcher=None, engine=None,
                  store=None, clamp_new_tokens: int = 32,
-                 evict_pages: Optional[int] = None, name: str = ""):
+                 evict_pages: Optional[int] = None, name: str = "",
+                 deployments=None):
         from brpc_tpu.serving.router import ReplicaHandle
         self.name = name
         self.clamp_new_tokens = int(clamp_new_tokens)
@@ -58,28 +74,50 @@ class ClusterControlService(Service):
         self._handle = ReplicaHandle(
             "0.0.0.0:0", name=name or "local", supervisor=supervisor,
             batcher=batcher, engine=engine, store=store)
+        self.deployments = deployments
         self._mu = InstrumentedLock("cluster.control")
         self.epoch = 0
         self.level = 0
         self.router = ""
         self.applied = 0
         self.refusals = 0
+        self.deploy_ops = 0
+        self.deploy_refusals = 0
         self.last_push_t: Optional[float] = None
+
+    def _publish_into(self, resp: dict) -> dict:
+        """Ride the deployment catalog on a control reply (one inline
+        str field — tensorframe caps these at 1MB, plenty for any
+        realistic deployment count)."""
+        if self.deployments is not None:
+            field = publish_deployments(self.deployments)
+            if field is not None:
+                resp["deployments"] = field
+        return resp
+
+    def _fence(self, cntl, req, *, counter: str) -> Optional[int]:
+        """Latch-or-refuse the push's epoch.  Returns the epoch when
+        admitted, None after set_failed (stale)."""
+        epoch = int((req or {}).get("epoch", 0))
+        with self._mu:
+            if epoch < self.epoch:
+                setattr(self, counter, getattr(self, counter) + 1)
+                cntl.set_failed(
+                    errors.EREQUEST,
+                    f"stale epoch {epoch} < {self.epoch}: push from a "
+                    f"superseded router refused")
+                return None
+            self.epoch = epoch
+        return epoch
 
     @method(request="tensorframe", response="tensorframe")
     def SetFloor(self, cntl, req):
         req = req or {}
-        epoch = int(req.get("epoch", 0))
+        epoch = self._fence(cntl, req, counter="refusals")
+        if epoch is None:
+            return None
         level = int(req.get("level", 0))
         with self._mu:
-            if epoch < self.epoch:
-                self.refusals += 1
-                cntl.set_failed(
-                    errors.EREQUEST,
-                    f"stale epoch {epoch} < {self.epoch}: floor push "
-                    f"from a superseded router refused")
-                return None
-            self.epoch = epoch
             self.level = level
             self.router = str(req.get("router", ""))
             self.applied += 1
@@ -93,7 +131,7 @@ class ClusterControlService(Service):
         resp = {"applied": True, "epoch": epoch, "level": level}
         for k, v in h.pressures().items():
             resp[k] = float(v)
-        return resp
+        return self._publish_into(resp)
 
     @method(request="tensorframe", response="tensorframe")
     def Report(self, cntl, req):
@@ -102,31 +140,110 @@ class ClusterControlService(Service):
         resp = {"epoch": self.epoch, "level": self.level}
         for k, v in self._handle.pressures().items():
             resp[k] = float(v)
-        return resp
+        return self._publish_into(resp)
+
+    # -- model lifecycle (ISSUE 18) -------------------------------------
+
+    def _lifecycle(self, cntl, req, op: str):
+        req = req or {}
+        if self.deployments is None:
+            cntl.set_failed(errors.EREQUEST,
+                            "replica has no deployment table")
+            return None
+        model = str(req.get("model") or "")
+        if not model:
+            cntl.set_failed(errors.EREQUEST, 'missing "model"')
+            return None
+        if fault.ENABLED and fault.hit("cluster.deploy", op=op,
+                                       model=model, name=self.name):
+            cntl.set_failed(errors.EINTERNAL,
+                            f"injected deploy fault ({op} {model})")
+            return None
+        epoch = self._fence(cntl, req, counter="deploy_refusals")
+        if epoch is None:
+            return None
+        deps = self.deployments
+        if op == "deploy":
+            state = str(req.get("state") or "") or None
+            weight = int(req.get("weight", 1))
+            row = deps.get(model)
+            if row is not None:
+                # re-deploy of a bound model: refresh weight/state
+                # (canary re-weighting, un-drain) on the live bindings
+                deps.deploy(model, engine=row.get("engine"),
+                            batcher=row.get("batcher"),
+                            store=row.get("store"),
+                            prefix_fetcher=row.get("prefix_fetcher"),
+                            state=state or row.get("state", LOADING),
+                            weight=weight)
+            else:
+                # catalog-only deployment: visible on the plane, no
+                # bindings yet (spin-up binds the engine later)
+                deps.deploy(model, state=state or LOADING, weight=weight)
+            if state == WARM:
+                deps.mark_warm(model)
+        elif op == "drain":
+            if not deps.drain(model):
+                cntl.set_failed(errors.EREQUEST,
+                                f"model {model!r} not deployed here")
+                return None
+        elif op == "undeploy":
+            if not deps.undeploy(model):
+                cntl.set_failed(errors.EREQUEST,
+                                f"model {model!r} not deployed here")
+                return None
+        with self._mu:
+            self.deploy_ops += 1
+        return self._publish_into(
+            {"applied": True, "epoch": epoch, "op": op, "model": model})
+
+    @method(request="tensorframe", response="tensorframe")
+    def Deploy(self, cntl, req):
+        """Register/refresh a deployment on this replica (epoch-fenced;
+        ``state`` may force ``warm``, ``weight`` re-weights a canary)."""
+        return self._lifecycle(cntl, req, "deploy")
+
+    @method(request="tensorframe", response="tensorframe")
+    def Undeploy(self, cntl, req):
+        """Remove a deployment (epoch-fenced).  In-flight sessions on
+        it keep their bindings; new placements stop immediately."""
+        return self._lifecycle(cntl, req, "undeploy")
+
+    @method(request="tensorframe", response="tensorframe")
+    def Drain(self, cntl, req):
+        """Mark a deployment draining (epoch-fenced): finishes
+        in-flight work, leaves the placement ring for new sessions."""
+        return self._lifecycle(cntl, req, "drain")
 
     def stats(self) -> dict:
         with self._mu:
-            return {
+            out = {
                 "epoch": self.epoch,
                 "level": self.level,
                 "router": self.router,
                 "applied": self.applied,
                 "refusals": self.refusals,
+                "deploy_ops": self.deploy_ops,
+                "deploy_refusals": self.deploy_refusals,
                 "push_age_s": (round(time.monotonic() - self.last_push_t,
                                      3) if self.last_push_t else None),
             }
+        if self.deployments is not None:
+            out["deployments"] = self.deployments.snapshot()
+        return out
 
 
 def register_cluster_control(server, *, supervisor=None, batcher=None,
                              engine=None, store=None,
                              clamp_new_tokens: int = 32,
                              evict_pages: Optional[int] = None,
-                             name: str = "") -> ClusterControlService:
+                             name: str = "",
+                             deployments=None) -> ClusterControlService:
     """Expose this replica to the wire-level overload gradient (call
     before ``server.start()``)."""
     svc = ClusterControlService(
         supervisor=supervisor, batcher=batcher, engine=engine,
         store=store, clamp_new_tokens=clamp_new_tokens,
-        evict_pages=evict_pages, name=name)
+        evict_pages=evict_pages, name=name, deployments=deployments)
     server.add_service(svc)
     return svc

@@ -87,6 +87,10 @@ from brpc_tpu import errors, fault, rpcz
 from brpc_tpu.bvar import Adder, PassiveStatus
 from brpc_tpu.rpc.service import Service, method
 from brpc_tpu.serving.ladder import OverloadLadder
+from brpc_tpu.serving.modelplane import (DEFAULT_MODEL, CanarySplit,
+                                         ModelCatalog, ModelMetrics,
+                                         model_fingerprint,
+                                         parse_deployments)
 
 ROUTER_SERVICE = "Router"
 
@@ -129,7 +133,7 @@ class ReplicaHandle:
 
     def __init__(self, addr: str, *, name: Optional[str] = None,
                  supervisor=None, batcher=None, engine=None, store=None,
-                 server=None):
+                 server=None, deployments=None):
         from brpc_tpu.butil.endpoint import str2endpoint
         self.addr = str(addr)
         self.endpoint = str2endpoint(self.addr)
@@ -139,6 +143,10 @@ class ReplicaHandle:
         self.engine = engine
         self.store = store
         self.server = server
+        # the replica's ReplicaDeployments (ISSUE 18), when this
+        # process knows which models it serves — the router folds its
+        # snapshot into the fleet catalog without an RPC
+        self.deployments = deployments
 
     def pressures(self) -> dict:
         """This replica's local pressure triple (empty when remote)."""
@@ -184,12 +192,20 @@ class Session:
                  "replicated_pages", "shipped_pages", "replicate",
                  "created_t",
                  "finished_t", "trace", "mu", "delivery_mu", "_sink",
-                 "_sink_done", "attach_epoch", "wal", "_sink_from")
+                 "_sink_done", "attach_epoch", "wal", "_sink_from",
+                 "model", "t_first_tok", "t_last_tok")
 
-    def __init__(self, sid: str, prompt: Sequence[int], budget: int):
+    def __init__(self, sid: str, prompt: Sequence[int], budget: int,
+                 model: str = DEFAULT_MODEL):
         self.sid = sid
         self.prompt = [int(t) for t in prompt]
         self.budget = int(budget)
+        # the deployment this session is bound to (ISSUE 18): routing,
+        # buddy placement and WAL adoption are all constrained by it
+        self.model = str(model or DEFAULT_MODEL)
+        # serving-latency marks for the per-model TTFT/ITL counters
+        self.t_first_tok: Optional[float] = None
+        self.t_last_tok: Optional[float] = None
         self.emitted: list[int] = []     # the durable cursor record
         self.state = "running"           # running|suspended|finished|failed
         self.error_code = 0
@@ -333,6 +349,7 @@ class Session:
             return {
                 "session_id": self.sid,
                 "state": self.state,
+                "model": self.model,
                 "prompt_len": len(self.prompt),
                 "budget": self.budget,
                 "cursor": len(self.emitted),
@@ -387,7 +404,8 @@ class SessionTable:
         recovered, wal.recovered = wal.recovered, {}
         live = finished = 0
         for sid, rec in recovered.items():
-            s = Session(sid, rec["prompt"], rec["budget"])
+            s = Session(sid, rec["prompt"], rec["budget"],
+                        rec.get("model") or DEFAULT_MODEL)
             s.emitted = list(rec["emitted"])
             s.state = rec["state"]
             s.error_code = rec["error_code"]
@@ -421,12 +439,14 @@ class SessionTable:
                             "budget": s.budget,
                             "emitted": list(s.emitted),
                             "state": s.state,
-                            "error_code": s.error_code})
+                            "error_code": s.error_code,
+                            "model": s.model})
         return out
 
-    def new_session(self, prompt: Sequence[int], budget: int) -> Session:
+    def new_session(self, prompt: Sequence[int], budget: int,
+                    model: str = DEFAULT_MODEL) -> Session:
         sid = uuid.uuid4().hex[:16]
-        s = Session(sid, prompt, budget)
+        s = Session(sid, prompt, budget, model)
         s.wal = self.wal
         with self._mu:
             self._sessions[sid] = s
@@ -434,7 +454,7 @@ class SessionTable:
         if self.wal is not None:
             # logged after the insert but before any token can flow
             # (the driver starts only after open_session returns)
-            self.wal.append_open(sid, s.prompt, s.budget)
+            self.wal.append_open(sid, s.prompt, s.budget, model=s.model)
         return s
 
     def get(self, sid: str) -> Optional[Session]:
@@ -480,6 +500,18 @@ class SessionTable:
             sessions = list(self._sessions.values())
         return sum(1 for s in sessions
                    if s.state in ("running", "suspended"))
+
+    def counts_by_model(self) -> dict:
+        """Per-deployment session-state counts (the /cluster catalog
+        panel's per-model column, ISSUE 18)."""
+        with self._mu:
+            sessions = list(self._sessions.values())
+        out: dict[str, dict] = {}
+        for s in sessions:
+            row = out.setdefault(s.model, {"running": 0, "suspended": 0,
+                                           "finished": 0, "failed": 0})
+            row[s.state] = row.get(s.state, 0) + 1
+        return out
 
     def snapshot(self, limit: int = 50) -> list[dict]:
         with self._mu:
@@ -562,7 +594,8 @@ class ClusterRouter:
                  timeout_ms: int = 10_000,
                  control_timeout_ms: int = 2_000,
                  epoch: Optional[int] = None,
-                 progress_timeout_s: float = 30.0):
+                 progress_timeout_s: float = 30.0,
+                 default_model: str = DEFAULT_MODEL):
         from brpc_tpu.policy.load_balancer import PrefixAffinityLB
         from brpc_tpu.rpc.channel import Channel
         from brpc_tpu.rpc.combo_channels import SelectiveChannel
@@ -583,6 +616,7 @@ class ClusterRouter:
         # affinity ring — the owner plus replication_factor-1 buddies
         self.replication_factor = max(1, int(replication_factor))
         self.check_interval_s = float(check_interval_s)
+        self.default_model = str(default_model or DEFAULT_MODEL)
 
         self.replicas: list[ReplicaHandle] = [
             r if isinstance(r, ReplicaHandle) else ReplicaHandle(r)
@@ -646,10 +680,22 @@ class ClusterRouter:
         # ownership directory (ISSUE 16): prefix fingerprint -> where
         # its pages actually are (owner + buddies that acked a push) —
         # forwarded as the prefix_holders hint so a cache-miss replica
-        # can PULL the prefix instead of recomputing
+        # can PULL the prefix instead of recomputing.  Keys are MODEL
+        # fingerprints (ISSUE 18), so two models sharing a prompt can
+        # never read each other's placement.
         from collections import OrderedDict
         self._placement_dir: "OrderedDict[int, dict]" = OrderedDict()
         self._placement_cap = 256
+
+        # the multi-model plane (ISSUE 18): fleet catalog (who serves
+        # what, in which lifecycle state), the canary version splitter,
+        # and the per-(model,version) serving counters
+        self.catalog = ModelCatalog()
+        self.canary = CanarySplit()
+        self.model_metrics = ModelMetrics()
+        for h in self.replicas:
+            if getattr(h, "deployments", None) is not None:
+                self.catalog.note(h.addr, h.deployments.snapshot())
 
         safe = re.sub(r"\W", "_", name)
         from brpc_tpu.bvar.variable import exposed_variables
@@ -659,6 +705,11 @@ class ClusterRouter:
         self.resumes_total = Adder(f"router_{safe}_resumes")
         self.replays_total = Adder(f"router_{safe}_replayed_tokens")
         self.reconnects = Adder(f"router_{safe}_reconnects")
+        # mis-routes the model constraint caught (a pick landing on a
+        # replica that does not serve the session's model — stale
+        # catalog or injected router.model_route): MUST stay 0 in any
+        # healthy run (rpc_press --models asserts it)
+        self.wrong_model_routes = Adder(f"router_{safe}_wrong_model_routes")
         # per-level gradient action counters — the ordering proof
         self.gradient_fired = {
             a: Adder(f"router_{safe}_{a}") for a in LEVEL_ACTIONS}
@@ -699,8 +750,46 @@ class ClusterRouter:
         return round(max(0.25, self._ladder.hysteresis_ticks *
                          self.check_interval_s), 3)
 
+    def resolve_model(self, model: Optional[str] = None) -> str:
+        """Resolve a request's ``model`` field to one deployment key
+        (ISSUE 18): absent -> the sole deployment (or the default
+        model), a bare ``model_id`` with several versions -> the canary
+        split over the published version weights.  Unknown models raise
+        EREQUEST — the misroute never leaves the front door."""
+        cat = self.catalog
+        if not model:
+            if cat.empty():
+                return self.default_model
+            sole = cat.sole_key()
+            if sole is not None:
+                return sole
+            model = self.default_model
+        model = str(model)
+        if cat.empty():
+            # no catalog published: the pre-plane single-model fleet —
+            # only the default model exists
+            if model == self.default_model:
+                return model
+            raise errors.RpcError(
+                errors.EREQUEST,
+                f"unknown model {model!r}: this router serves only "
+                f"{self.default_model!r}")
+        keys = cat.resolve(model)
+        if not keys:
+            raise errors.RpcError(
+                errors.EREQUEST,
+                f"unknown model {model!r}; deployed: {cat.keys()}")
+        if len(keys) == 1:
+            return keys[0]
+        weights = {k: w for k, w in cat.version_weights(model).items()
+                   if k in keys}
+        if not weights:
+            weights = {k: 1 for k in keys}
+        return self.canary.pick(model, weights)
+
     def open_session(self, prompt: Sequence[int],
-                     max_new_tokens: int) -> Session:
+                     max_new_tokens: int,
+                     model: Optional[str] = None) -> Session:
         """Admit one generation: shed-at-router (ELIMIT with a
         ``retry_after_s`` hint in the error text) before anything
         crosses DCN, else create the durable session and start its
@@ -709,6 +798,7 @@ class ClusterRouter:
                                        name=self.name) is not None:
             raise errors.RpcError(errors.EINTERNAL,
                                   "injected router admit failure")
+        model = self.resolve_model(model)
         live = self.sessions.live_count()
         shed_text = None
         if not self._running:
@@ -724,10 +814,13 @@ class ClusterRouter:
         if shed_text is not None:
             self.shed_total.add(1)
             self.gradient_fired["shed_at_router"].add(1)
+            self.model_metrics.note_shed(model)
             raise errors.RpcError(
                 errors.ELIMIT,
                 f"{shed_text}; retry_after_s={self.retry_after_s()}")
-        s = self.sessions.new_session(prompt, max_new_tokens)
+        s = self.sessions.new_session(prompt, max_new_tokens,
+                                      model=model)
+        self.model_metrics.note_open(model)
         self._start_driver(s)
         return s
 
@@ -772,11 +865,32 @@ class ClusterRouter:
             self._drivers[s.sid] = t
         t.start()
 
+    def _fp_for(self, model: str, prompt: Sequence[int]) -> int:
+        """The session's ring key: the ``(model, prefix)`` fingerprint,
+        with the router's default model mapping to the plain prefix
+        fingerprint (single-model placement identical to pre-plane)."""
+        m = None if model == self.default_model else model
+        return model_fingerprint(m, prompt, self.chunk_tokens)
+
+    def _allowed_eps(self, model: str) -> Optional[set]:
+        """Endpoints serving ``model`` for NEW placements (warm or
+        loading; draining replicas only finish what they hold), or
+        ``None`` when no catalog is published — the unconstrained
+        pre-plane fleet."""
+        cat = self.catalog
+        if cat.empty():
+            return None
+        eps = set()
+        for addr in cat.replicas_for(model, for_new=True):
+            ep = self._ep_by_name.get(addr)
+            if ep is not None:
+                eps.add(ep)
+        return eps
+
     def _drive(self, s: Session) -> None:
-        from brpc_tpu.policy.load_balancer import prefix_fingerprint
         from brpc_tpu.rpc.controller import Controller
         from brpc_tpu.rpc.stream import stream_create
-        fp = prefix_fingerprint(s.prompt, self.chunk_tokens)
+        fp = self._fp_for(s.model, s.prompt)
         excluded: set = set()
         attempts = 0
         max_attempts = 3 * len(self.replicas) + 3
@@ -807,18 +921,59 @@ class ClusterRouter:
                     # must not burn the whole attempt budget before
                     # health marking / breaker recovery can land
                     time.sleep(min(0.25, 0.01 * (attempts - 1)))
-                picked = self._sel.pick(exclude=excluded, request_code=fp)
+                # the model constraint (ISSUE 18): the pick may only
+                # land on a replica the catalog says serves s.model —
+                # re-read each attempt, a deploy/drain can land mid-
+                # session and the failover must honor the new state
+                allowed = self._allowed_eps(s.model)
+                if allowed is not None and not allowed:
+                    self._finish_session(s, errors.RpcError(
+                        errors.ENODATA,
+                        f"no replica serves model {s.model!r}"))
+                    return
+                constraint = (set(self._by_ep) - allowed
+                              if allowed is not None else set())
+                picked = self._sel.pick(exclude=excluded | constraint,
+                                        request_code=fp)
+                if picked is not None and allowed is not None \
+                        and picked[2] not in allowed:
+                    # the ring's last-resort fallback handed back an
+                    # excluded endpoint: treat as unroutable this round
+                    picked = None
                 if picked is None and excluded:
                     # everything healthy was tried this round: start a
                     # fresh round (a probe may have revived someone)
                     excluded = set()
-                    picked = self._sel.pick(exclude=excluded,
+                    picked = self._sel.pick(exclude=constraint,
                                             request_code=fp)
+                    if picked is not None and allowed is not None \
+                            and picked[2] not in allowed:
+                        picked = None
                 if picked is None:
                     self._finish_session(s, errors.RpcError(
-                        errors.ENODATA, "no routable replica"))
+                        errors.ENODATA,
+                        f"no routable replica serves model {s.model!r}"
+                        if allowed is not None
+                        else "no routable replica"))
                     return
                 _i, chan, ep = picked
+                if fault.ENABLED and fault.hit(
+                        "router.model_route", model=s.model,
+                        replica=str(ep)) is not None:
+                    # injected catalog staleness: the pick is treated
+                    # as a mis-route — counted (the invariant press
+                    # asserts on) and re-routed, never forwarded
+                    self.wrong_model_routes.add(1)
+                    excluded.add(ep)
+                    first_attempt = False
+                    continue
+                if allowed is not None and ep not in allowed:
+                    # defense in depth: a stale catalog let a non-
+                    # serving replica through — count and re-route
+                    self.wrong_model_routes.add(1)
+                    excluded.add(ep)
+                    first_attempt = False
+                    continue
                 if not first_attempt:
                     with s.mu:
                         s.resumes += 1
@@ -835,6 +990,11 @@ class ClusterRouter:
                 t0 = time.monotonic()
                 fwd = {"prompt": resume_prompt,
                        "max_new_tokens": remaining}
+                if not self.catalog.empty():
+                    # name the deployment so a multi-model replica
+                    # resolves the right engine (a single-model fleet
+                    # keeps the pre-plane wire shape)
+                    fwd["model"] = s.model
                 holders = self._holders_for(fp, exclude_addr=str(ep))
                 if holders:
                     # pull-based prefix fetch (ISSUE 16): tell the
@@ -981,6 +1141,15 @@ class ClusterRouter:
     # ---- buddy replication (resume-over-migration) ----
 
     def _on_session_progress(self, s: Session, cursor: int) -> None:
+        # per-(model,version) latency counters (ISSUE 18): one writer
+        # per session (the collector thread), so the marks need no lock
+        now = time.monotonic()
+        if s.t_first_tok is None:
+            s.t_first_tok = now
+            self.model_metrics.note_ttft(s.model, now - s.created_t)
+        elif s.t_last_tok is not None:
+            self.model_metrics.note_itl(s.model, now - s.t_last_tok)
+        s.t_last_tok = now
         if not self.replicate_sessions or not s.replicate:
             return
         with s.mu:
@@ -1021,7 +1190,6 @@ class ClusterRouter:
         placement in the ownership directory.  A failing push degrades
         the future resume to recompute; it never touches the token
         path."""
-        from brpc_tpu.policy.load_balancer import prefix_fingerprint
         s = self.sessions.get(sid)
         if s is None:
             return
@@ -1031,10 +1199,18 @@ class ClusterRouter:
             toks = s.prompt + s.emitted
             cur_addr = s.replica
         cur_ep = self._ep_by_name.get(cur_addr)
-        fp = prefix_fingerprint(s.prompt, self.chunk_tokens)
+        fp = self._fp_for(s.model, s.prompt)
+        # buddy placement constrained to SAME-MODEL holders (ISSUE 18):
+        # a failover can only land on a replica serving s.model, so
+        # only those are worth warming
+        ex = {cur_ep} if cur_ep is not None else set()
+        allowed = self._allowed_eps(s.model)
+        if allowed is not None:
+            ex |= set(self._by_ep) - allowed
         buddies = self._lb.placement(
-            fp, self.replication_factor,
-            exclude={cur_ep} if cur_ep is not None else None)
+            fp, self.replication_factor, exclude=ex or None)
+        if allowed is not None:
+            buddies = [b for b in buddies if b in allowed]
         buddies = [b for b in buddies if str(b) != cur_addr]
         buddies = buddies[:max(0, self.replication_factor - 1)]
         if not buddies:
@@ -1047,13 +1223,19 @@ class ClusterRouter:
             return
         best = 0
         acked: list[str] = []
+        push = {"tokens": toks[:full], "dest": None}
+        if not self.catalog.empty():
+            # same-model fetch constraint (ISSUE 18): a model-tagged
+            # _kvmig endpoint refuses pushes for another model, so a
+            # stale placement can never splice B-pages into an A-store
+            push["model"] = s.model
         for buddy in buddies:
             buddy_h = self._by_ep.get(buddy)
             dest = buddy_h.addr if buddy_h is not None else str(buddy)
+            push["dest"] = dest
             try:
                 out = picked.call_sync(
-                    "_kvmig", "PushTo",
-                    {"tokens": toks[:full], "dest": dest},
+                    "_kvmig", "PushTo", dict(push),
                     serializer="json", response_serializer="json")
             except errors.RpcError:
                 # this buddy degrades to recompute; the others still
@@ -1160,6 +1342,11 @@ class ClusterRouter:
         lvl = self._ladder.update(self._pressures())
         self._apply_level(lvl)
         self._push_floor(lvl)
+        # refresh the catalog from in-process replicas (remote ones
+        # publish via their SetFloor ack in _push_floor)
+        for h in self.replicas:
+            if getattr(h, "deployments", None) is not None:
+                self.catalog.note(h.addr, h.deployments.snapshot())
         return lvl
 
     def _apply_level(self, lvl: int) -> None:
@@ -1250,6 +1437,55 @@ class ClusterRouter:
                 k: float(resp[k]) for k in
                 ("queue_delay_us", "pool_ratio", "queue_depth")
                 if resp and k in resp}
+            # the ack doubles as the replica's catalog publication
+            # (ISSUE 18): fold its deployments into the fleet view
+            rows = parse_deployments((resp or {}).get("deployments"))
+            if rows is not None:
+                self.catalog.note(h.addr, rows)
+
+    def deploy_model(self, model: str, *, op: str = "deploy",
+                     addrs: Optional[Sequence[str]] = None,
+                     weight: int = 1,
+                     state: Optional[str] = None) -> dict:
+        """Fleet-wide lifecycle push (ISSUE 18): ``deploy`` /
+        ``undeploy`` / ``drain`` one model on the named replicas (all
+        by default), carrying this router's membership epoch so a
+        superseded router's lifecycle pushes are fenced exactly like
+        its floor pushes.  In-process replicas are driven directly;
+        remote ones over the ``_cluster`` service.  Returns per-replica
+        outcomes (``"ok"`` or the error text) — partial failure is the
+        caller's to retry, the push is idempotent."""
+        from brpc_tpu.serving.modelplane import cluster_deploy
+        targets = []
+        want = set(str(a) for a in addrs) if addrs is not None else None
+        for h in self.replicas:
+            if want is None or h.addr in want \
+                    or str(h.endpoint) in (want or ()):
+                targets.append(h)
+        out = {}
+        for h in targets:
+            deps = getattr(h, "deployments", None)
+            if deps is not None:
+                if op == "deploy":
+                    deps.deploy(model, weight=weight,
+                                state=state or "loading")
+                elif op == "undeploy":
+                    deps.undeploy(model)
+                elif op == "drain":
+                    deps.drain(model)
+                else:
+                    raise ValueError(f"unknown deploy op {op!r}")
+                self.catalog.note(h.addr, deps.snapshot())
+                out[h.addr] = "ok"
+                continue
+            try:
+                cluster_deploy(h.addr, epoch=self.epoch, model=model,
+                               op=op, weight=weight, state=state,
+                               timeout_ms=self.control_timeout_ms)
+                out[h.addr] = "ok"
+            except errors.RpcError as e:
+                out[h.addr] = f"E{e.code}: {e.text}"
+        return out
 
     def remote_floor_table(self) -> list[dict]:
         """Remote-floor propagation per replica for /cluster: epoch,
@@ -1334,6 +1570,7 @@ class ClusterRouter:
             "epoch": self.epoch,
             "replicas": self.replica_table(),
             "sessions": self.sessions.counts(),
+            "sessions_by_model": self.sessions.counts_by_model(),
             "session_rows": self.sessions.snapshot(limit=20),
             "ladder": self._ladder.stats(),
             "level_actions": list(LEVEL_ACTIONS),
@@ -1348,6 +1585,11 @@ class ClusterRouter:
             "replicate_sessions": self.replicate_sessions,
             "replication_factor": self.replication_factor,
             "placements": self.placements(),
+            "default_model": self.default_model,
+            "catalog": self.catalog.snapshot(),
+            "models": self.model_metrics.snapshot(),
+            "canary": self.canary.snapshot(),
+            "wrong_model_routes": self.wrong_model_routes.get_value(),
             "remote_floor": self.remote_floor_table(),
             "floor_pushes": self.floor_pushes,
             "floor_push_drops": self.floor_push_drops,
@@ -1403,11 +1645,12 @@ class RouterService(Service):
         req = req or {}
         prompt = req.get("prompt") or [0]
         max_new = int(req.get("max_new_tokens", 16))
+        model = req.get("model") or None
         try:
-            sess = self._router.open_session(prompt, max_new)
+            sess = self._router.open_session(prompt, max_new, model=model)
         except errors.RpcError as e:
-            cntl.set_failed(e.code, e.text)    # ELIMIT text carries
-            return None                        # retry_after_s=<hint>
+            cntl.set_failed(e.code, e.text)    # ELIMIT retry_after_s=<hint>
+            return None                        # or EREQUEST unknown model
         try:
             sid, _ = self._attach_stream(cntl, sess, 0)
         except errors.RpcError as e:
@@ -1417,7 +1660,8 @@ class RouterService(Service):
             self._router.cancel_session(sess, e)
             cntl.set_failed(e.code, e.text)
             return None
-        return {"accepted": True, "session_id": sid}
+        return {"accepted": True, "session_id": sid,
+                "model": sess.model}
 
     @method(request="json", response="json")
     def Resume(self, cntl, req):
@@ -1587,14 +1831,15 @@ class RouterClient:
 
     def start(self, prompt: Sequence[int], max_new_tokens: int, *,
               emit: Optional[Callable[[int], None]] = None,
-              deadline_s: Optional[float] = None) -> LiveGeneration:
+              deadline_s: Optional[float] = None,
+              model: Optional[str] = None) -> LiveGeneration:
         attempt = 0
         deadline = (time.monotonic() + deadline_s) \
             if deadline_s is not None else None
         while True:
             try:
                 return self._start_once(prompt, max_new_tokens,
-                                        emit=emit)
+                                        emit=emit, model=model)
             except errors.RpcError as e:
                 hint = parse_retry_after_s(e.text) \
                     if e.code == errors.ELIMIT else None
@@ -1614,18 +1859,20 @@ class RouterClient:
                 time.sleep(delay)
 
     def _start_once(self, prompt: Sequence[int], max_new_tokens: int, *,
-                    emit: Optional[Callable[[int], None]] = None
-                    ) -> LiveGeneration:
+                    emit: Optional[Callable[[int], None]] = None,
+                    model: Optional[str] = None) -> LiveGeneration:
         from brpc_tpu.rpc.controller import Controller
         from brpc_tpu.rpc.stream import stream_create
         col = _ClientCollector(emit)
         cntl = Controller(timeout_ms=self.timeout_ms)
         stream = stream_create(cntl, col)
+        req = {"prompt": [int(t) for t in prompt],
+               "max_new_tokens": int(max_new_tokens)}
+        if model:
+            req["model"] = str(model)
         try:
             resp = self._ch.call_sync(
-                "Router", "Generate",
-                {"prompt": [int(t) for t in prompt],
-                 "max_new_tokens": int(max_new_tokens)},
+                "Router", "Generate", req,
                 serializer="json", cntl=cntl)
         except errors.RpcError:
             # shed (ELIMIT) or dead router: the never-bound stream
@@ -1640,10 +1887,11 @@ class RouterClient:
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int, *,
                  emit: Optional[Callable[[int], None]] = None,
-                 timeout_s: float = 30.0) -> dict:
+                 timeout_s: float = 30.0,
+                 model: Optional[str] = None) -> dict:
         deadline = time.monotonic() + timeout_s
         gen = self.start(prompt, max_new_tokens, emit=emit,
-                         deadline_s=timeout_s)
+                         deadline_s=timeout_s, model=model)
         if not gen.wait(max(0.0, deadline - time.monotonic())):
             raise errors.RpcError(errors.ERPCTIMEDOUT,
                                   "router generation never finished")

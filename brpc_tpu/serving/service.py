@@ -32,7 +32,8 @@ from brpc_tpu.rpc.service import Service, method
 class ServingService(Service):
     NAME = "Serving"
 
-    def __init__(self, batcher=None, engine=None, prefix_fetcher=None):
+    def __init__(self, batcher=None, engine=None, prefix_fetcher=None,
+                 deployments=None):
         self._batcher = batcher
         self._engine = engine
         # pull-based prefix fetch (ISSUE 16): ``fetch(prompt, holders)
@@ -41,17 +42,53 @@ class ServingService(Service):
         self.prefix_fetcher = prefix_fetcher
         self.prefix_fetches = 0
         self.prefix_fetched_pages = 0
+        # multi-model plane (ISSUE 18): a ReplicaDeployments table maps
+        # the forwarded "model" field to per-deployment bindings.  None
+        # keeps the legacy single-anonymous-model behavior exactly.
+        self.deployments = deployments
+        self.n_model_misroutes = 0
+
+    def _resolve(self, cntl, req):
+        """``(model_key, bindings)`` for this request.  Without a
+        deployment table the constructor bindings apply (model field
+        ignored — a pre-plane replica).  A forwarded model this replica
+        does not serve fails EINTERNAL — a FAILOVER code, so the
+        router's session driver re-routes instead of killing the
+        session — and bumps ``n_model_misroutes`` (must stay 0 in a
+        healthy fleet: the router constrains picks to the catalog)."""
+        model = (req or {}).get("model") or None
+        if self.deployments is None or len(self.deployments) == 0:
+            return None, {"engine": self._engine,
+                          "batcher": self._batcher,
+                          "prefix_fetcher": self.prefix_fetcher}
+        try:
+            key, row = self.deployments.resolve(model)
+        except KeyError:
+            self.n_model_misroutes += 1
+            cntl.set_failed(
+                errors.EINTERNAL,
+                f"model {model!r} not served by this replica "
+                f"(serves {self.deployments.keys()})")
+            return None, None
+        return key, {"engine": row.get("engine") or self._engine,
+                     "batcher": row.get("batcher") or self._batcher,
+                     "prefix_fetcher": (row.get("prefix_fetcher")
+                                        or self.prefix_fetcher)}
 
     @method(request="json", response="json")
     def Score(self, cntl, req):
-        if self._batcher is None:
+        _, b = self._resolve(cntl, req)
+        if b is None:
+            return None
+        batcher = b["batcher"]
+        if batcher is None:
             cntl.set_failed(errors.ENOMETHOD, "no batcher registered")
             return None
         x = (req or {}).get("x")
         if x is None:
             cntl.set_failed(errors.EREQUEST, 'missing "x"')
             return None
-        self._batcher.submit(
+        batcher.submit(
             cntl, np.asarray(x, dtype=np.float32),
             transform=lambda row: {"y": np.asarray(row).tolist()})
         return None   # deferred: the batch drainer completes the RPC
@@ -62,7 +99,11 @@ class ServingService(Service):
         payload rides as a float32 tensor field both ways — no float
         list round-trip.  Old peers never see this; new clients
         (:class:`ScoreClient`) downgrade sticky on ENOMETHOD."""
-        if self._batcher is None:
+        _, b = self._resolve(cntl, req)
+        if b is None:
+            return None
+        batcher = b["batcher"]
+        if batcher is None:
             cntl.set_failed(errors.ENOMETHOD, "no batcher registered")
             return None
         x = (req or {}).get("x")
@@ -70,14 +111,18 @@ class ServingService(Service):
             cntl.set_failed(errors.EREQUEST,
                             'need rank-1 tensor field "x"')
             return None
-        self._batcher.submit(
+        batcher.submit(
             cntl, np.asarray(x, dtype=np.float32),
             transform=lambda row: {"y": np.asarray(row, np.float32)})
         return None   # deferred: the batch drainer completes the RPC
 
     @method(request="json", response="json")
     def Generate(self, cntl, req):
-        if self._engine is None:
+        model_key, b = self._resolve(cntl, req)
+        if b is None:
+            return None
+        engine = b["engine"]
+        if engine is None:
             cntl.set_failed(errors.ENOMETHOD, "no decode engine registered")
             return None
         req = req or {}
@@ -97,6 +142,11 @@ class ServingService(Service):
                          timeout_s=2.0)
 
         def on_done(err) -> None:
+            if err is None and model_key is not None \
+                    and self.deployments is not None:
+                # warm-up proof: a completed generation flips this
+                # deployment loading -> warm on the published plane
+                self.deployments.note_generation(model_key)
             msg = {"done": True}
             if err is not None:
                 msg["error"] = err.code
@@ -116,7 +166,7 @@ class ServingService(Service):
         # lands on a replica holding the committed prefix reports
         # prefix_hit > 0 and re-prefills only the tail.
         hit = 0
-        store = getattr(self._engine, "store", None)
+        store = getattr(engine, "store", None)
         if store is not None and len(prompt) > 1:
             try:
                 hit = int(store.probe(prompt))
@@ -129,13 +179,14 @@ class ServingService(Service):
         # instead of re-prefilling.  Any fetch failure falls back to
         # recompute; the generation never depends on it.
         holders = req.get("prefix_holders") or []
-        if (self.prefix_fetcher is not None and holders
+        fetcher = b["prefix_fetcher"]
+        if (fetcher is not None and holders
                 and store is not None and len(prompt) > 1):
             pt = getattr(store, "page_tokens", 16)
             full = len(prompt) // pt * pt
             if full and hit < full:
                 try:
-                    fetched = int(self.prefix_fetcher(
+                    fetched = int(fetcher(
                         [int(t) for t in prompt],
                         [str(h) for h in holders]))
                 except Exception:
@@ -153,8 +204,11 @@ class ServingService(Service):
             # (ISSUE 11); only forwarded when the client says so, so
             # engine-shaped submitters without the keyword still work
             kw["speculative"] = bool(req["speculative"])
-        rid = self._engine.submit(prompt, max_new, emit, on_done, **kw)
-        return {"accepted": True, "req_id": rid, "prefix_hit": hit}
+        rid = engine.submit(prompt, max_new, emit, on_done, **kw)
+        resp = {"accepted": True, "req_id": rid, "prefix_hit": hit}
+        if model_key is not None:
+            resp["model"] = model_key
+        return resp
 
 
 class ScoreClient:
@@ -232,13 +286,14 @@ def http_generate_handler(engine):
 
 
 def register_serving(server, batcher=None, engine=None,
-                     prefix_fetcher=None,
+                     prefix_fetcher=None, deployments=None,
                      http_generate_path: Optional[str]
                      = "/serving/generate") -> ServingService:
     """Register the serving surface on a Server: the Serving service
     (Score/Generate) plus the chunked HTTP generate route.  Call before
     ``server.start()``."""
-    svc = ServingService(batcher, engine, prefix_fetcher)
+    svc = ServingService(batcher, engine, prefix_fetcher,
+                         deployments=deployments)
     server.add_service(svc)
     if engine is not None and http_generate_path:
         server.add_http_handler(http_generate_path,

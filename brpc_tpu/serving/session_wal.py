@@ -55,6 +55,7 @@ from typing import Callable, Optional
 from brpc_tpu import fault
 from brpc_tpu.butil.lockprof import InstrumentedLock
 from brpc_tpu.butil.recordio import RecordReader, RecordWriter
+from brpc_tpu.serving.modelplane import DEFAULT_MODEL
 
 # record type tags (recordio meta field)
 REC_OPEN = b"open"
@@ -68,7 +69,7 @@ class SessionWAL:
     """Write-ahead log for one SessionTable (see module docstring).
 
     ``recovered`` holds ``{sid: {"prompt", "budget", "emitted",
-    "state", "error_code"}}`` replayed from the path at open;
+    "state", "error_code", "model"}}`` replayed from the path at open;
     ``SessionTable.recover`` consumes (and clears) it.  All ``append_*``
     methods are non-raising: failures park on the pending tail and are
     counted, because the WAL must never break the token path it
@@ -133,12 +134,16 @@ class SessionWAL:
                                          int(d.get("e", 0)))
                 elif meta == REC_OPEN and d["s"] not in sessions:
                     # never clobbers an existing record: a compaction
-                    # snapshot supersedes any healed-late open record
+                    # snapshot supersedes any healed-late open record.
+                    # "m" is the model column (ISSUE 18); records from
+                    # before the multi-model plane lack it and decode
+                    # as the default model — version-tolerant decode.
                     sessions[d["s"]] = {
                         "prompt": [int(t) for t in d.get("p", [])],
                         "budget": int(d.get("b", 0)),
                         "emitted": [], "state": "running",
-                        "error_code": None}
+                        "error_code": None,
+                        "model": str(d.get("m") or DEFAULT_MODEL)}
                 elif meta == REC_SNAP:
                     sessions[d["s"]] = {
                         "prompt": [int(t) for t in d.get("p", [])],
@@ -146,7 +151,8 @@ class SessionWAL:
                         "emitted": [int(t) for t in d.get("e", [])],
                         "state": str(d.get("st", "running")),
                         "error_code": (None if d.get("ec") is None
-                                       else int(d["ec"]))}
+                                       else int(d["ec"])),
+                        "model": str(d.get("m") or DEFAULT_MODEL)}
                 elif meta == REC_TOK:
                     rec = sessions.get(d["s"])
                     if rec is None:
@@ -215,9 +221,16 @@ class SessionWAL:
                 self._compact_cv.notify()
             return True
 
-    def append_open(self, sid: str, prompt, budget: int) -> bool:
-        return self._append(REC_OPEN, {
-            "s": sid, "p": [int(t) for t in prompt], "b": int(budget)})
+    def append_open(self, sid: str, prompt, budget: int,
+                    model: Optional[str] = None) -> bool:
+        body = {"s": sid, "p": [int(t) for t in prompt],
+                "b": int(budget)}
+        # the model column rides only when it says something: default-
+        # model records stay byte-identical to pre-plane WALs (and old
+        # readers ignore unknown keys anyway)
+        if model and model != DEFAULT_MODEL:
+            body["m"] = str(model)
+        return self._append(REC_OPEN, body)
 
     def append_tok(self, sid: str, tok: int, cursor: int) -> bool:
         return self._append(REC_TOK,
@@ -297,11 +310,14 @@ class SessionWAL:
                         REC_EPOCH)
                 n = 1
                 for r in rows:
+                    row = {"s": r["sid"], "p": r["prompt"],
+                           "b": r["budget"], "e": r["emitted"],
+                           "st": r["state"], "ec": r["error_code"]}
+                    m = r.get("model")
+                    if m and m != DEFAULT_MODEL:
+                        row["m"] = str(m)
                     w.write(json.dumps(
-                        {"s": r["sid"], "p": r["prompt"],
-                         "b": r["budget"], "e": r["emitted"],
-                         "st": r["state"], "ec": r["error_code"]},
-                        separators=(",", ":")).encode(), REC_SNAP)
+                        row, separators=(",", ":")).encode(), REC_SNAP)
                     n += 1
                 w.flush()
                 os.fsync(fp.fileno())

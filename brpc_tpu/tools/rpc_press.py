@@ -635,6 +635,194 @@ def tear_down_cluster(replicas, router, rsrv,
     tear_down_replicas(replicas)
 
 
+# ---------------------------------------------------------------------------
+# multi-model fleets (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+# per-model step-function multipliers: model i's decode rule is
+# (t * PRIME_i + pos) % 997, so every model's token stream is
+# distinguishable from every other's — a generation that bit-matches
+# the WRONG model's oracle is a mis-route, caught client-side
+MODEL_STEP_PRIMES = (7, 11, 13, 17, 19, 23, 29)
+
+
+def model_step_fn(mult: int, step_delay_s: float = 0.0):
+    """The numpy step function for one model deployment (CPU-valid)."""
+    import numpy as np
+
+    def step(tokens, positions, pages=None):
+        if step_delay_s:
+            time.sleep(step_delay_s)
+        return (np.asarray(tokens) * int(mult)
+                + np.asarray(positions)) % 997
+
+    return step
+
+
+def expected_model_tokens(prompt, n: int, mult: int = 7) -> list:
+    """The bit-exact oracle for :func:`model_step_fn`: the n tokens a
+    correct generation of ``prompt`` emits under multiplier ``mult``."""
+    out = []
+    last = int(prompt[-1])
+    pos = len(prompt)
+    for _ in range(int(n)):
+        last = (last * int(mult) + pos) % 997
+        out.append(last)
+        pos += 1
+    return out
+
+
+def spin_up_multimodel_replicas(n_replicas: int, models, *, layout=None,
+                                page_tokens: int = 8,
+                                step_delay_s: float = 0.0,
+                                num_slots: int = 8, max_blocks: int = 64,
+                                page_bytes: int = 512,
+                                max_pages_per_slot: int = 64,
+                                name_prefix: str = "mm",
+                                commit_live_pages: bool = False,
+                                warm: bool = True):
+    """N serving replicas, each carrying one :class:`~brpc_tpu.serving.
+    ReplicaDeployments` table over the given ``models`` (ISSUE 18):
+    per-deployment store + engine (model i's step rule uses
+    ``MODEL_STEP_PRIMES[i]``, so streams are model-attributable), the
+    Serving service resolving the forwarded ``model`` field, the
+    ``_cluster`` service publishing the catalog, and ``_kvmig`` bound
+    to the FIRST deployment's store, model-tagged (a mismatched fetch
+    is refused; other models fall back to recompute — fetch is an
+    optimization, never a correctness dependency).
+
+    ``layout[i]`` restricts replica i to a subset of ``models``
+    (default: every replica serves all of them) — the knob chaos
+    scenario 19 uses to build a fleet where exactly one replica is
+    warm for model B.  ``warm=False`` starts deployments ``loading``
+    (the first completed generation flips them warm).
+
+    Returns ``(replicas, mults)``: ``replicas`` a list of dicts with
+    keys ``deps``/``stores``/``engines``/``server``/``addr``/
+    ``models``, ``mults`` the ``model -> multiplier`` oracle map.
+    Tear down with :func:`tear_down_multimodel_replicas`."""
+    from brpc_tpu.kvcache import KVCacheStore
+    from brpc_tpu.migrate import make_prefix_fetcher, register_migration
+    from brpc_tpu.serving import (DecodeEngine, ReplicaDeployments,
+                                  register_cluster_control,
+                                  register_serving)
+    from brpc_tpu.serving.modelplane import LOADING, WARM
+
+    models = [str(m) for m in models]
+    mults = {m: MODEL_STEP_PRIMES[i % len(MODEL_STEP_PRIMES)]
+             for i, m in enumerate(models)}
+    state0 = WARM if warm else LOADING
+    replicas = []
+    for i in range(n_replicas):
+        served = models if layout is None \
+            else [str(m) for m in layout[i]]
+        deps = ReplicaDeployments(name=f"{name_prefix}_{i}")
+        stores, engines = {}, {}
+        srv = brpc.Server(enable_dcn=True)
+        for m in served:
+            store = KVCacheStore(page_tokens=page_tokens,
+                                 page_bytes=page_bytes,
+                                 max_blocks=max_blocks,
+                                 name=f"{name_prefix}_{i}_{m}",
+                                 commit_live_pages=commit_live_pages)
+            eng = DecodeEngine(model_step_fn(mults[m], step_delay_s),
+                               num_slots=num_slots, store=store,
+                               max_pages_per_slot=max_pages_per_slot,
+                               name=f"{name_prefix}_eng_{i}_{m}")
+            stores[m], engines[m] = store, eng
+            deps.deploy(m, engine=eng, store=store, state=state0)
+        m0 = served[0] if served else None
+        serving_svc = register_serving(
+            srv, engine=engines.get(m0), deployments=deps)
+        mig_svc = register_migration(srv, stores[m0], model=m0) \
+            if m0 else None
+        register_cluster_control(srv, engine=engines.get(m0),
+                                 store=stores.get(m0),
+                                 name=f"{name_prefix}_{i}",
+                                 deployments=deps)
+        srv.start("127.0.0.1", 0)
+        addr = f"127.0.0.1:{srv.port}"
+        if mig_svc is not None:
+            # fetcher ONLY on the _kvmig-bound deployment: a shared
+            # svc-level fetcher would splice other models' fetches
+            # into m0's store
+            deps.deploy(m0, prefix_fetcher=make_prefix_fetcher(
+                mig_svc.migrator, addr, model=m0), state=state0)
+        replicas.append({"deps": deps, "stores": stores,
+                         "engines": engines, "server": srv,
+                         "addr": addr, "models": list(served),
+                         "serving": serving_svc})
+    return replicas, mults
+
+
+def tear_down_multimodel_replicas(replicas) -> None:
+    for r in replicas:
+        for eng in r["engines"].values():
+            try:
+                eng.close(timeout_s=2.0)
+            except Exception:
+                pass
+        try:
+            r["server"].stop()
+            r["server"].join()
+        except Exception:
+            pass
+        for store in r["stores"].values():
+            store.clear()
+            store.close()
+
+
+def spin_up_multimodel_cluster(n_replicas: int, models, *, layout=None,
+                               page_tokens: int = 8,
+                               step_delay_s: float = 0.0,
+                               commit_live_pages: bool = False,
+                               replicate_sessions: bool = False,
+                               max_sessions: int = 256,
+                               timeout_ms: int = 20_000,
+                               name_prefix: str = "mm", warm: bool = True,
+                               wal=None, **replica_kw):
+    """A multi-model fleet behind one :class:`~brpc_tpu.serving.
+    ClusterRouter` front door: :func:`spin_up_multimodel_replicas` plus
+    a router whose handles carry the deployment tables (the catalog
+    seeds instantly; remote publication keeps it fresh).  Returns
+    ``(replicas, mults, router, rsrv, raddr)``; tear down with
+    :func:`tear_down_multimodel_cluster`."""
+    from brpc_tpu.serving import (ClusterRouter, ReplicaHandle,
+                                  register_router)
+
+    replicas, mults = spin_up_multimodel_replicas(
+        n_replicas, models, layout=layout, page_tokens=page_tokens,
+        step_delay_s=step_delay_s, commit_live_pages=commit_live_pages,
+        name_prefix=name_prefix, warm=warm, **replica_kw)
+    handles = []
+    for i, r in enumerate(replicas):
+        m0 = r["models"][0] if r["models"] else None
+        handles.append(ReplicaHandle(
+            r["addr"], name=f"{name_prefix}_{i}",
+            engine=r["engines"].get(m0), store=r["stores"].get(m0),
+            server=r["server"], deployments=r["deps"]))
+    kw = {}
+    if wal is not None:
+        kw["wal"] = wal
+    router = ClusterRouter(
+        handles, page_tokens=page_tokens,
+        replicate_sessions=replicate_sessions,
+        max_sessions=max_sessions, name=f"{name_prefix}_router",
+        timeout_ms=timeout_ms, **kw)
+    rsrv = brpc.Server()
+    register_router(rsrv, router)
+    rsrv.start("127.0.0.1", 0)
+    return replicas, mults, router, rsrv, f"127.0.0.1:{rsrv.port}"
+
+
+def tear_down_multimodel_cluster(replicas, router, rsrv,
+                                 timeout_s: float = 3.0) -> None:
+    router.close(timeout_s=timeout_s)
+    rsrv.stop()
+    rsrv.join()
+    tear_down_multimodel_replicas(replicas)
+
+
 def zipf_key_sampler(vocab: int, s: float, seed: int = 0):
     """Seeded zipf-skewed key sampler: key k's probability is
     proportional to 1/(rank+1)^s under a seeded permutation (so hot
@@ -1044,6 +1232,124 @@ def run_cluster_press(n_replicas: int, request,
     return summary
 
 
+def run_multimodel_press(n_replicas: int, models,
+                         duration_s: float = 10.0, threads: int = 4,
+                         max_new_tokens: int = 12,
+                         timeout_ms: int = 20_000,
+                         out=sys.stderr) -> dict:
+    """``--cluster N --models a,b[,c]`` mode (ISSUE 18): a multi-model
+    fleet behind one router front door, workers alternating models per
+    request.  Every finished stream is checked against ITS model's
+    bit-exact oracle; a stream matching a DIFFERENT model's oracle is
+    a wrong-model route.  The report carries per-model generations/s +
+    TTFT percentiles and the wrong-model-route count — which must be 0
+    (three independent witnesses: client oracles, the router's
+    ``wrong_model_routes`` counter, the replicas' ``n_model_misroutes``
+    counters)."""
+    import random
+
+    from brpc_tpu.serving import RouterClient
+
+    models = [str(m) for m in models]
+    replicas, mults, router, rsrv, raddr = spin_up_multimodel_cluster(
+        n_replicas, models, commit_live_pages=True,
+        replicate_sessions=True, max_sessions=max(64, 8 * threads),
+        name_prefix="press_mm", timeout_ms=timeout_ms)
+
+    mu = threading.Lock()
+    per = {m: {"ok": 0, "err": 0, "sheds": 0, "tokens": 0,
+               "mismatches": 0,
+               "rec": LatencyRecorder(f"rpc_press_mm_ttft_{i}")}
+           for i, m in enumerate(models)}
+    wrong_route = [0]
+    stop = threading.Event()
+
+    def worker(k: int):
+        cli = RouterClient(raddr, timeout_ms=timeout_ms)
+        rng = random.Random(1000 + k)
+        j = 0
+        while not stop.is_set():
+            m = models[(k + j) % len(models)]
+            j += 1
+            st = per[m]
+            prompt = [rng.randrange(1, 97)]
+            first = [None]
+
+            def emit(tok, first=first):
+                if first[0] is None:
+                    first[0] = time.monotonic()
+
+            t0 = time.monotonic()
+            try:
+                res = cli.generate(prompt, max_new_tokens, emit=emit,
+                                   timeout_s=timeout_ms / 1e3, model=m)
+            except brpc.RpcError as e:
+                with mu:
+                    if e.code == brpc.errors.ELIMIT:
+                        st["sheds"] += 1
+                    else:
+                        st["err"] += 1
+                continue
+            except Exception:
+                with mu:
+                    st["err"] += 1
+                continue
+            with mu:
+                if res["error"]:
+                    st["err"] += 1
+                    continue
+                st["ok"] += 1
+                st["tokens"] += len(res["tokens"])
+                exp = expected_model_tokens(prompt, len(res["tokens"]),
+                                            mults[m])
+                if res["tokens"] != exp:
+                    st["mismatches"] += 1
+                    if any(res["tokens"] == expected_model_tokens(
+                            prompt, len(res["tokens"]), mm)
+                           for mo, mm in mults.items() if mo != m):
+                        wrong_route[0] += 1
+            if first[0] is not None:
+                st["rec"].add(int((first[0] - t0) * 1e6))
+
+    ts = [threading.Thread(target=worker, args=(k,), daemon=True)
+          for k in range(threads)]
+    t_start = time.monotonic()
+    [t.start() for t in ts]
+    try:
+        time.sleep(duration_s)
+    finally:
+        stop.set()
+    [t.join(timeout_ms / 1e3 + 2) for t in ts]
+    elapsed = time.monotonic() - t_start
+    rstats = router.stats()
+    misroutes = sum(r["serving"].n_model_misroutes for r in replicas)
+    summary = {
+        "replicas": n_replicas,
+        "models": {},
+        "wrong_model_routes": (wrong_route[0]
+                               + int(rstats["wrong_model_routes"])
+                               + misroutes),
+        "elapsed_s": round(elapsed, 2),
+    }
+    for m in models:
+        st = per[m]
+        rec = st["rec"]
+        summary["models"][m] = {
+            "generations_ok": st["ok"],
+            "errors": st["err"],
+            "client_sheds": st["sheds"],
+            "mismatches": st["mismatches"],
+            "generations_per_s": round(st["ok"] / elapsed, 1),
+            "tokens_per_s": round(st["tokens"] / elapsed, 1),
+            "ttft_p50_us": rec.latency_percentile(0.5),
+            "ttft_p90_us": rec.latency_percentile(0.9),
+            "ttft_p99_us": rec.latency_percentile(0.99),
+        }
+    print(json.dumps(summary), file=out)
+    tear_down_multimodel_cluster(replicas, router, rsrv)
+    return summary
+
+
 def run_router_kill_press(n_replicas: int, request,
                           duration_s: float = 10.0, threads: int = 4,
                           kill_router_after: float = 3.0,
@@ -1241,6 +1547,12 @@ def main(argv=None):
                          "through the front door (generations/s, TTFT "
                          "percentiles, resume count, per-level shed "
                          "counts)")
+    ap.add_argument("--models", metavar="A,B[,C]",
+                    help="with --cluster: serve a comma list of named "
+                         "model deployments on every replica and press "
+                         "them through one router front door; reports "
+                         "per-model generations/s + TTFT percentiles "
+                         "and the wrong-model-route count (must be 0)")
     ap.add_argument("--kill-replica-after", type=float, default=None,
                     metavar="S",
                     help="with --cluster: kill one replica S seconds "
@@ -1358,7 +1670,12 @@ def main(argv=None):
         factory = make_prefix_skew(req, a.shared_prefix_ratio,
                                    prefix_tokens=a.prefix_tokens,
                                    seed=a.prefix_seed)
-    if a.cluster and a.kill_router_after is not None:
+    if a.cluster and a.models:
+        run_multimodel_press(
+            a.cluster, [m for m in a.models.split(",") if m],
+            duration_s=a.duration, threads=a.threads,
+            timeout_ms=max(a.timeout_ms, 5000), out=sys.stdout)
+    elif a.cluster and a.kill_router_after is not None:
         run_router_kill_press(a.cluster, req, duration_s=a.duration,
                               threads=a.threads,
                               kill_router_after=a.kill_router_after,

@@ -25,6 +25,18 @@ show trainer waves absorbing overload while serving traffic still runs
 untouched.  Trainer waves are throughput work — delaying one costs
 nothing a user can see; a browned-out batcher sheds real requests.
 
+CLUSTER FLOOR TIER (ISSUE 18, closing ROADMAP 5c).  PR 16 gave the
+fleet a wire-level overload floor: the router pushes its gradient
+level to every replica's ``_cluster`` service each tick.  The arbiter
+now consumes that floor as an EXTERNAL level source
+(:meth:`add_cluster_floor_source` / :meth:`bind_cluster_service`): any
+router-pushed floor >= 1 raises the arbiter's EFFECTIVE level to
+shed_trainer, holding update waves FLEET-WIDE before any
+serving-touching rung fires anywhere — the cluster's cheapest-first
+extension of the local ordering.  ``n_cluster_held_waves`` counts the
+waves held by the floor alone (local ladder calm), which is the
+cheapest-first proof: trainer paused, zero local brownouts/clamps.
+
 The harness also carries the chaos story (scenario 18): ``kill_shard``
 mid-update-wave + ``restart_shard`` (same shard STATE, fresh server —
 the PartitionChannel's replica rotation heals the fan-out), with the
@@ -85,7 +97,8 @@ class TrafficArbiter:
                  shed_poll_s: float = 0.01,
                  shed_timeout_s: float = 30.0,
                  batchers=(), engines=(), pressure_fn=None,
-                 clamp_new_tokens: int = 32, name: str = "arbiter"):
+                 clamp_new_tokens: int = 32, name: str = "arbiter",
+                 cluster_floor_sources=()):
         self.ladder = OverloadLadder(thresholds,
                                      hysteresis_ticks=hysteresis_ticks,
                                      level_names=ARBITER_LEVEL_NAMES[
@@ -109,6 +122,47 @@ class TrafficArbiter:
         self.n_admitted_waves = 0
         self.n_brownouts = 0
         self.n_clamps = 0
+        # cluster floor tier (ISSUE 18): external level sources — the
+        # router-pushed ``_cluster`` floor this process has latched
+        self._floor_sources = list(cluster_floor_sources)
+        self.n_cluster_held_waves = 0
+
+    # ---- the cluster floor tier (ISSUE 18) ----
+
+    def add_cluster_floor_source(self, fn) -> "TrafficArbiter":
+        """Register a zero-arg callable returning the cluster overload
+        floor this process currently sees (a failing source reads as
+        0 — a dead floor never wedges the trainer)."""
+        self._floor_sources.append(fn)
+        return self
+
+    def bind_cluster_service(self, svc) -> "TrafficArbiter":
+        """Consume a replica-side
+        :class:`~brpc_tpu.serving.cluster_control.ClusterControlService`
+        as a floor source: the router pushes its gradient level there
+        every tick, so the trainer co-located with this replica yields
+        fleet-wide within one tick."""
+        return self.add_cluster_floor_source(lambda: svc.level)
+
+    def cluster_floor(self) -> int:
+        """The highest router-pushed floor across sources."""
+        floor = 0
+        for fn in self._floor_sources:
+            try:
+                floor = max(floor, int(fn() or 0))
+            except Exception:
+                pass
+        return floor
+
+    def effective_level(self) -> int:
+        """The level :meth:`admit_wave` gates on: the local ladder,
+        raised to shed_trainer (2) whenever ANY cluster floor >= 1 — a
+        router already shaping serving traffic means background waves
+        must hold everywhere, the cheapest relief the fleet has."""
+        lvl = self.ladder.level
+        if self._floor_sources and self.cluster_floor() >= 1:
+            lvl = max(lvl, 2)
+        return lvl
 
     # ---- the ladder tick ----
 
@@ -171,28 +225,37 @@ class TrafficArbiter:
 
     def admit_wave(self) -> bool:
         """Called by the trainer before each update wave.  Blocks
-        while the ladder sheds trainer waves (level >= 2), sleeps one
-        pace delay while it paces them (level >= 1); returns True when
-        the wave was delayed at all.  Raises ELIMIT only after
-        ``shed_timeout_s`` of continuous shed — background work waits,
-        it doesn't fail fast."""
+        while the EFFECTIVE level sheds trainer waves (>= 2 — local
+        ladder, or any cluster floor >= 1), sleeps one pace delay
+        while it paces them (>= 1); returns True when the wave was
+        delayed at all.  Raises ELIMIT only after ``shed_timeout_s``
+        of continuous shed — background work waits, it doesn't fail
+        fast."""
         delayed = False
         shed_counted = False
+        cluster_counted = False
         deadline = time.monotonic() + self.shed_timeout_s
-        while self.ladder.level >= 2:
+        while self.effective_level() >= 2:
             if not shed_counted:
                 shed_counted = True
                 with self._mu:
                     self.n_shed_waves += 1
                 SHED_WAVES.add(1)
+            if not cluster_counted and self.ladder.level < 2:
+                # held by the ROUTER'S floor alone — the fleet-wide
+                # cheapest-first proof the tests pin
+                cluster_counted = True
+                with self._mu:
+                    self.n_cluster_held_waves += 1
             delayed = True
             if time.monotonic() > deadline:
                 raise errors.RpcError(
                     errors.ELIMIT,
                     f"trainer waves shed for {self.shed_timeout_s}s "
-                    f"(ladder level {self.ladder.level})")
+                    f"(effective level {self.effective_level()}, "
+                    f"cluster floor {self.cluster_floor()})")
             time.sleep(self.shed_poll_s)
-        if self.ladder.level >= 1:
+        if self.effective_level() >= 1:
             with self._mu:
                 self.n_paced_waves += 1
             PACED_WAVES.add(1)
@@ -213,6 +276,8 @@ class TrafficArbiter:
                 "admitted_waves": self.n_admitted_waves,
                 "brownouts": self.n_brownouts,
                 "clamps": self.n_clamps,
+                "cluster_floor": self.cluster_floor(),
+                "cluster_held_waves": self.n_cluster_held_waves,
             }
 
 

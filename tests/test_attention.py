@@ -25,7 +25,10 @@ def _mesh():
 
 
 def _run_sharded(fn, q, k, v, **kw):
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.4.38 exposes it under experimental only
+        from jax.experimental.shard_map import shard_map
     mesh = _mesh()
     spec = P(None, "sp", None, None)
 
@@ -145,7 +148,10 @@ def test_sequence_parallel_exact_across_mesh_sizes(n, fn):
     """Regression: Ulysses' head reassembly interleaved wrongly for any
     n < heads (invisible at n == heads where h/n == 1) — every op must be
     exact on every mesh size, causal on."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.4.38 exposes it under experimental only
+        from jax.experimental.shard_map import shard_map
     q, k, v = _qkv(seed=10 + n)
     mesh = Mesh(np.array(jax.devices()[:n]), ("sp",))
     spec = P(None, "sp", None, None)

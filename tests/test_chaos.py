@@ -2697,3 +2697,174 @@ def test_mixed_traffic_shard_kill_midwave_exactly_once(seed):
     finally:
         kt.join(5)
         h.close()
+
+
+# ---------------------------------------------------------------------------
+# scenario 19 (ISSUE 18): kill the only WARM replica of model B
+# mid-decode in a two-model fleet -> B sessions fail over onto the
+# LOADING replica serving B (bit-exact, exactly once), model A sessions
+# never notice, stale-epoch deploy/undeploy pushes are refused, and no
+# page ever crosses a model boundary
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_multimodel_warm_replica_kill_same_model_failover(seed):
+    """The multi-model plane's acceptance drill (chaos scenario 19).
+    Fleet of N=4 replicas: two serve only model A (warm), one serves
+    only model B (warm — the victim), one serves only B but LOADING.
+    Mid-decode the victim dies.  Invariants:
+
+    * every B session finishes bit-exact against B's oracle, exactly
+      once — the driver re-routed it to the loading B replica (its
+      pages arrive by buddy ship or recompute fallback; either way the
+      stream may not diverge, duplicate, or hole);
+    * the loading replica flips WARM via the completed generations;
+    * A sessions stream bit-exact with zero errors — a B-side crash
+      is invisible to the other model;
+    * ``Deploy``/``Undeploy`` carrying a superseded epoch are refused
+      ('stale epoch'), and an injected ``cluster.deploy`` wire fault
+      is survivable by retry;
+    * zero cross-model page splices: no A-model store ever holds a B
+      prompt's pages and vice versa; every misroute counter reads 0;
+    * survivor pools/refcounts and the native emit rings return to
+      baseline.
+    """
+    import gc
+
+    from brpc_tpu import native_path
+    from brpc_tpu.serving import RouterClient
+    from brpc_tpu.serving.modelplane import (LOADING, WARM,
+                                             cluster_deploy)
+    from brpc_tpu.tools.rpc_press import (expected_model_tokens,
+                                          spin_up_multimodel_cluster,
+                                          tear_down_multimodel_cluster)
+
+    PT = 4
+    budget = 10
+    MODELS = ["modela", "modelb"]
+    layout = [["modela"], ["modela"], ["modelb"], ["modelb"]]
+    replicas, mults, router, rsrv, raddr = spin_up_multimodel_cluster(
+        4, MODELS, layout=layout, page_tokens=PT, step_delay_s=0.03,
+        commit_live_pages=True, replicate_sessions=True,
+        name_prefix=f"c19_{seed}")
+    try:
+        # replica 3 starts LOADING: it serves B but has not proven
+        # itself — still a legal placement/failover target
+        replicas[3]["deps"].deploy("modelb", state=LOADING)
+        assert wait_until(
+            lambda: any(r["state"] == LOADING
+                        for r in router.catalog.snapshot().get(
+                            replicas[3]["addr"], [])), 10), \
+            f"seed {seed}: catalog never saw the loading state"
+        ring0 = native_path.tokring_live()
+
+        cli = RouterClient(raddr, timeout_ms=30_000)
+        # DISJOINT prompt ranges per model, so a page crossing the
+        # model boundary is detectable by probing the stores
+        a_prompts = [[100 + 20 * k + i for i in range(13)]
+                     for k in range(3)]
+        b_prompts = [[500 + 20 * k + i for i in range(13)]
+                     for k in range(4)]
+        a_gens = [(p, cli.start(p, budget, model="modela"))
+                  for p in a_prompts]
+        b_gens = [(p, cli.start(p, budget, model="modelb"))
+                  for p in b_prompts]
+        for p, g in a_gens + b_gens:
+            assert g.wait_tokens(3, timeout_s=30), \
+                f"seed {seed}: no tokens before the kill"
+
+        # -- the crash: the only WARM replica of model B dies --
+        victim = replicas[2]
+        victim["server"].stop()
+        # Server.join is internally bounded by graceful_quit_timeout_s
+        victim["server"].join()  # brpc-check: allow(wedge-hygiene)
+        victim["engines"]["modelb"].close(timeout_s=2.0)
+
+        # every stream finishes THROUGH the crash: B rides the driver's
+        # same-model failover onto replica 3, A never re-routes
+        for p, g in a_gens:
+            assert g.wait(60), f"seed {seed}: model A stream hung"
+            assert g.error is None, \
+                f"seed {seed}: model A session broke (E{g.error})"
+            assert g.tokens == expected_model_tokens(
+                p, budget, mults["modela"]), \
+                f"seed {seed}: model A stream diverged"
+        for p, g in b_gens:
+            assert g.wait(60), f"seed {seed}: model B stream hung"
+            assert g.error is None, \
+                f"seed {seed}: model B failover failed (E{g.error})"
+            assert g.tokens == expected_model_tokens(
+                p, budget, mults["modelb"]), \
+                f"seed {seed}: model B stream diverged across failover"
+            assert len(g.tokens) == budget    # zero dups, zero holes
+
+        # the loading replica earned its warm state by serving
+        assert replicas[3]["deps"].get("modelb")["state"] == WARM
+
+        # -- lifecycle fencing on the wire (replica 3's _cluster) --
+        r3addr = replicas[3]["addr"]
+        E = router.epoch
+        # a fault outlasting the channel's retry budget (4 attempts:
+        # initial + max_retry=3) surfaces as EINTERNAL to the pusher
+        plan = fault.FaultPlan(seed=seed)
+        plan.on("cluster.deploy", fault.ERROR, times=4)
+        with fault.injected(plan):
+            with pytest.raises(errors.RpcError) as ei0:
+                cluster_deploy(r3addr, epoch=E, model="modelb",
+                               op="deploy", weight=2)
+            assert ei0.value.code == errors.EINTERNAL
+        assert plan.injected.get("cluster.deploy", 0) >= 1
+        # ...but a ONE-SHOT wire fault is absorbed by the channel's
+        # retry: the fault provably fired, yet the push landed — the
+        # deploy path is idempotent so the retry is safe
+        plan2 = fault.FaultPlan(seed=seed)
+        plan2.on("cluster.deploy", fault.ERROR, times=1)
+        with fault.injected(plan2):
+            out = cluster_deploy(r3addr, epoch=E, model="modelb",
+                                 op="deploy", weight=2, state="warm")
+            assert out["applied"] and out["epoch"] == E
+        assert plan2.injected.get("cluster.deploy", 0) == 1
+        assert replicas[3]["deps"].get("modelb")["weight"] == 2
+        for op in ("deploy", "undeploy"):
+            with pytest.raises(errors.RpcError) as ei:
+                cluster_deploy(r3addr, epoch=E - 1, model="modelb",
+                               op=op)
+            assert ei.value.code == errors.EREQUEST
+            assert "stale epoch" in (ei.value.text or "")
+
+        # -- zero cross-model page splices, three witnesses --
+        assert router.stats()["wrong_model_routes"] == 0
+        for r in replicas:
+            assert r["serving"].n_model_misroutes == 0
+            mig = r["server"]._services.get("_kvmig")
+            if mig is not None:
+                assert mig.n_model_refusals == 0
+            if r is victim:
+                continue
+            for m, store in r["stores"].items():
+                foreign = a_prompts if m == "modelb" else b_prompts
+                for p in foreign:
+                    assert store.probe(p) == 0, \
+                        f"seed {seed}: {m} store holds a foreign " \
+                        f"model's prefix"
+
+        # -- survivor baselines: pools, refcounts, native rings --
+        for r in replicas:
+            if r is victim:
+                continue
+            for store in r["stores"].values():
+                assert wait_until(
+                    lambda s=store: s.stats()["live_seqs"] == 0, 15), \
+                    f"seed {seed}: leaked live sequences on a survivor"
+                store.clear()
+                store.pagepool.assert_consistent()
+                assert store.pagepool.blocks_leased() == 0
+    finally:
+        tear_down_multimodel_cluster(replicas, router, rsrv)
+    # after the engines close, every request's native emit ring must be
+    # gone — idle slots may pin their LAST request's ring while the
+    # engine lives, so this check belongs after teardown
+    assert wait_until(
+        lambda: (gc.collect(), native_path.tokring_live())[1]
+        <= ring0, 10), \
+        f"seed {seed}: native emit rings leaked across the failover"

@@ -507,3 +507,59 @@ def test_cluster_page_shows_wal_placement_and_remote_floor(tmp_path):
         srv.join()
         router.close(timeout_s=1.0)
         adopted.close()
+
+
+def test_cluster_page_shows_deployment_catalog_and_canary():
+    """/cluster renders the multi-model plane (ISSUE 18): the fleet
+    deployment catalog (replica -> model@version rows with lifecycle
+    state and weight), the per-(model,version) serving scoreboard with
+    TTFT/ITL percentiles, the canary pick counts, per-model session
+    counts, and the wrong-model-route invariant counter."""
+    from brpc_tpu.serving import (ClusterRouter, ReplicaDeployments,
+                                  ReplicaHandle)
+
+    deps = ReplicaDeployments(name="console_mm_r0")
+    deps.deploy("modela", state="warm")
+    deps.deploy("orca@v1", weight=95, state="warm")
+    deps.deploy("orca@v2", weight=5, state="loading")
+    h = ReplicaHandle("127.0.0.1:9", name="console_mm_r0",
+                      deployments=deps)
+    router = ClusterRouter([h], auto_tick=False,
+                           name="console_mm_router")
+    # a little traffic on the scoreboard + canary so the page has
+    # numbers to show (the serving path drives these in production)
+    router.model_metrics.note_open("orca@v1")
+    router.model_metrics.note_ttft("orca@v1", 0.025)
+    router.model_metrics.note_itl("orca@v1", 0.004)
+    router.model_metrics.note_finish("orca@v1")
+    for _ in range(20):
+        router.resolve_model("orca")
+    srv = brpc.Server()
+    srv.start("127.0.0.1", 0)
+    try:
+        status, body = _get(srv, "/cluster")
+        assert status == 200
+        r = json.loads(body)["routers"]["console_mm_router"]
+        assert r["default_model"] == "default"
+        # the catalog panel: one replica, three deployment rows
+        rows = {row["model"]: row for row in r["catalog"]["127.0.0.1:9"]}
+        assert set(rows) == {"modela", "orca@v1", "orca@v2"}
+        assert rows["orca@v1"]["state"] == "warm"
+        assert rows["orca@v1"]["weight"] == 95
+        assert rows["orca@v2"]["state"] == "loading"
+        assert rows["modela"]["model_id"] == "modela"
+        assert rows["orca@v2"]["version"] == "v2"
+        # the canary scoreboard: 95/5 smooth-WRR over 20 picks = 19/1
+        assert r["canary"]["orca"] == {"orca@v1": 19, "orca@v2": 1}
+        # the per-deployment serving counters with latency percentiles
+        m = r["models"]["orca@v1"]
+        assert m["sessions"] == 1 and m["finished"] == 1
+        assert m["ttft"]["p50_ms"] == pytest.approx(25.0)
+        assert m["itl"]["p99_ms"] == pytest.approx(4.0)
+        # per-model session counts + the mis-route invariant
+        assert r["sessions_by_model"] == {}
+        assert r["wrong_model_routes"] == 0
+    finally:
+        srv.stop()
+        srv.join()
+        router.close(timeout_s=1.0)

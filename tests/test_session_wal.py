@@ -58,7 +58,7 @@ def test_roundtrip_replay(tmp_path):
     w2 = _wal(tmp_path)
     assert w2.recovered["a"] == {
         "prompt": [1, 2, 3], "budget": 8, "emitted": [10, 11, 12],
-        "state": "finished", "error_code": None}
+        "state": "finished", "error_code": None, "model": "default"}
     assert w2.recovered["b"]["state"] == "running"
     assert w2.recovered["b"]["emitted"] == [20]
     assert w2.recovered["c"]["state"] == "failed"
