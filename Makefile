@@ -121,6 +121,14 @@ multimodel: $(LIB) $(PYEXT)
 	JAX_PLATFORMS=cpu python -m pytest tests/test_modelplane.py -q
 	JAX_PLATFORMS=cpu python bench.py multimodel
 
+# Fleet telemetry plane (README "Fleet telemetry", ISSUE 20): the
+# collector/SLO/stitching suite, then the collection-overhead rung —
+# front-door generations/s with the 20 Hz collector+SLO tick off vs
+# on (<=2% acceptance, 3-trial median+spread, perf_diff gated).
+telemetry: $(LIB) $(PYEXT)
+	JAX_PLATFORMS=cpu python -m pytest tests/test_telemetry.py -q
+	JAX_PLATFORMS=cpu python bench.py telemetry
+
 # Real model serving (README "Real model serving", ISSUE 10): the
 # paged-attention equivalence suite (gather + pallas-interpret vs the
 # dense reference at page boundaries / COW forks / evict-readmit), the
@@ -324,4 +332,4 @@ stress:
 .PHONY: all clean test chaos serving kvcache recovery migrate disagg \
     cluster durable model speculative trace hotspots microbench perf \
     bench tsan tsan-core asan stress check ring-stress wedge-hunt \
-    psserve tensorframe train multimodel
+    psserve tensorframe train multimodel telemetry

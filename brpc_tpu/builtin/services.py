@@ -175,9 +175,30 @@ def build_routes(server) -> dict:
             spans = rpcz.recent_spans(2048, int(tid))
             if not spans:
                 spans = rpcz.load_disk_spans(2048, int(tid))
+            # CROSS-PROCESS STITCHING (ISSUE 20): on a router, fan the
+            # query out through the fleet collector — replica and
+            # PS-shard spans of the same trace join the local tree
+            import sys as _sys
+            if "brpc_tpu.serving" in _sys.modules:
+                try:
+                    from brpc_tpu.serving import fleet_trace_spans
+                    seen = {(s.trace_id, s.span_id, s.kind, s.start_us)
+                            for s in spans}
+                    for s in fleet_trace_spans(int(tid)):
+                        key = (s.trace_id, s.span_id, s.kind, s.start_us)
+                        if key not in seen:
+                            seen.add(key)
+                            spans.append(s)
+                except Exception:
+                    pass   # a dead peer must not 500 the local view
             if not spans:
                 return f"no spans collected for trace {tid}\n"
-            return rpcz.format_trace(spans)
+            # span ids are pid-salted (top bits), so distinct processes
+            # in the merged tree are countable without a pid field
+            pids = {s.span_id >> 40 for s in spans}
+            head = (f"(stitched across {len(pids)} processes)\n"
+                    if len(pids) > 1 else "")
+            return head + rpcz.format_trace(spans)
         spans = rpcz.recent_spans(limit)
         lines = []
         for s in reversed(spans):
@@ -289,6 +310,71 @@ def build_routes(server) -> dict:
                 out.append(f"# HELP {name} bvar {what}")
                 out.append(f"# TYPE {name} {kind}")
                 out.append(f"{name} {v}")
+        # fleet families (ISSUE 20): on a router, every collected
+        # series' last sample exports as ONE aggregated family with a
+        # replica label — the cross-process scrape a per-process /vars
+        # cannot answer
+        import sys as _sys
+        if "brpc_tpu.serving" in _sys.modules:
+            try:
+                from brpc_tpu.serving import fleet_snapshot
+                snap = fleet_snapshot(points=1)
+                rows, dead, slos = [], [], []
+                for fs in snap["routers"].values():
+                    for rep, models in (fs.get("series") or {}).items():
+                        for mod, mets in models.items():
+                            for met, vals in mets.items():
+                                if vals:
+                                    rows.append((rep, mod, met,
+                                                 vals[-1]))
+                    for r in (fs.get("collector") or {}).get(
+                            "replicas", []):
+                        dead.append((r.get("addr", ""),
+                                     1 if r.get("tombstoned") else 0))
+                    if fs.get("slo"):
+                        slos.append(fs["slo"])
+                if rows:
+                    out.append("# HELP brpc_fleet_metric last collected "
+                               "fleet series sample")
+                    out.append("# TYPE brpc_fleet_metric gauge")
+                    for rep, mod, met, v in sorted(rows):
+                        out.append(
+                            f'brpc_fleet_metric{{replica="{esc(rep)}",'
+                            f'model="{esc(mod)}",metric="{esc(met)}"}}'
+                            f' {v}')
+                if dead:
+                    out.append("# HELP brpc_fleet_tombstoned replica "
+                               "tombstoned by the fleet collector")
+                    out.append("# TYPE brpc_fleet_tombstoned gauge")
+                    for rep, v in sorted(dead):
+                        out.append(
+                            f'brpc_fleet_tombstoned{{replica='
+                            f'"{esc(rep)}"}} {v}')
+                if slos:
+                    out.append("# HELP brpc_fleet_slo_state SLO "
+                               "engine ramp state (1 = current)")
+                    out.append("# TYPE brpc_fleet_slo_state gauge")
+                    for s in slos:
+                        out.append(
+                            f'brpc_fleet_slo_state{{model='
+                            f'"{esc(s.get("model_id", ""))}",state='
+                            f'"{esc(s.get("state", ""))}"}} 1')
+                    for fam, key, what in (
+                            ("brpc_fleet_slo_floor", "floor",
+                             "advisory overload floor while burning"),
+                            ("brpc_fleet_slo_evaluations",
+                             "evaluations", "burn evaluations run"),
+                            ("brpc_fleet_slo_holds", "holds",
+                             "ramp holds during fleet disruption")):
+                        out.append(f"# HELP {fam} {what}")
+                        out.append(f"# TYPE {fam} gauge")
+                        for s in slos:
+                            out.append(
+                                f'{fam}{{model='
+                                f'"{esc(s.get("model_id", ""))}"}}'
+                                f' {int(s.get(key, 0) or 0)}')
+            except Exception:
+                pass   # fleet families are additive, never 500 a scrape
         return "\n".join(out) + "\n", "text/plain; version=0.0.4"
 
     def services_page(req):
@@ -396,7 +482,165 @@ def build_routes(server) -> dict:
         if not snap["shards"] and not snap["clients"] \
                 and not snap["lowered"]:
             return "no parameter-server components registered\n"
+        # PR 15 syscall attribution alongside the shard tables: a PS
+        # process is the fleet's I/O hot spot, and the same counters
+        # ride every _telemetry Pull (ISSUE 20)
+        from brpc_tpu.butil import flight
+        snap["syscalls"] = flight.syscall_counters()
         return json.dumps(snap, indent=1), "application/json"
+
+    def fleet_page(req):
+        # fleet telemetry console (ISSUE 20): per router the collector's
+        # replica table (pulls / bytes / tombstones), the per-model
+        # scoreboard, sparkline series, canary ramp state and the SLO
+        # engine's burn rates + decision trail.  Lazy import, same
+        # discipline as /serving; ?fmt=json for the raw snapshot,
+        # ?points=N sizes the sparklines.
+        import sys
+        if "brpc_tpu.serving" not in sys.modules:
+            return "no cluster routers registered\n"
+        from brpc_tpu.serving import fleet_snapshot
+        try:
+            points = min(128, max(2, int(req.query.get("points", "32"))))
+        except ValueError:
+            points = 32
+        snap = fleet_snapshot(points)
+        if not snap["routers"]:
+            return "no cluster routers registered\n"
+        if req.query.get("fmt") == "json":
+            return json.dumps(snap, indent=1), "application/json"
+        out = ["<html><body><style>td,th{padding:2px 8px;"
+               "font:12px monospace}table{border-collapse:collapse}"
+               "th{text-align:left;border-bottom:1px solid #999}"
+               "</style>"]
+        for rname, fs in sorted(snap["routers"].items()):
+            col = fs.get("collector") or {}
+            out.append(f"<h2>fleet: {html.escape(rname)}</h2>")
+            out.append(
+                f"<p>pulls={col.get('pulls', 0)} "
+                f"bytes={col.get('pull_bytes', 0)} "
+                f"errors={col.get('pull_errors', 0)} "
+                f"tombstones={col.get('tombstones', 0)} "
+                f"series={col.get('series', 0)} "
+                f"fleet_spans={col.get('fleet_spans', 0)}</p>")
+            rows = col.get("replicas") or []
+            if rows:
+                out.append("<h3>replicas</h3><table><tr>"
+                           "<th>addr</th><th>name</th><th>pid</th>"
+                           "<th>pulls</th><th>errors</th><th>state</th>"
+                           "<th>bytes</th><th>age_s</th>"
+                           "<th>write_syscalls</th></tr>")
+                for r in rows:
+                    state = ("TOMBSTONED" if r.get("tombstoned")
+                             else "no-telemetry" if r.get("unsupported")
+                             else "live")
+                    sc = (r.get("syscalls") or {}).get("write_syscalls",
+                                                       "")
+                    out.append(
+                        f"<tr><td>{html.escape(str(r.get('addr')))}</td>"
+                        f"<td>{html.escape(str(r.get('name') or ''))}</td>"
+                        f"<td>{r.get('pid') or ''}</td>"
+                        f"<td>{r.get('pulls', 0)}</td>"
+                        f"<td>{r.get('errors', 0)}</td>"
+                        f"<td>{state}</td>"
+                        f"<td>{r.get('last_bytes', 0)}</td>"
+                        f"<td>{r.get('pull_age_s') or ''}</td>"
+                        f"<td>{sc}</td></tr>")
+                out.append("</table>")
+            models = fs.get("models") or {}
+            if models:
+                out.append("<h3>models</h3><table><tr><th>key</th>"
+                           "<th>sessions</th><th>sheds</th>"
+                           "<th>finished</th><th>failed</th>"
+                           "<th>ttft_p99_ms</th><th>itl_p99_ms</th>"
+                           "</tr>")
+                for key, row in sorted(models.items()):
+                    out.append(
+                        f"<tr><td>{html.escape(key)}</td>"
+                        f"<td>{row.get('sessions', 0)}</td>"
+                        f"<td>{row.get('sheds', 0)}</td>"
+                        f"<td>{row.get('finished', 0)}</td>"
+                        f"<td>{row.get('failed', 0)}</td>"
+                        f"<td>{(row.get('ttft') or {}).get('p99_ms')}"
+                        f"</td>"
+                        f"<td>{(row.get('itl') or {}).get('p99_ms')}"
+                        f"</td></tr>")
+                out.append("</table>")
+            canary = fs.get("canary") or {}
+            if canary:
+                out.append("<h3>canary picks</h3><table>"
+                           "<tr><th>model</th><th>splits</th></tr>")
+                for m, picks in sorted(canary.items()):
+                    split = " ".join(f"{html.escape(k)}={v}"
+                                     for k, v in sorted(picks.items()))
+                    out.append(f"<tr><td>{html.escape(m)}</td>"
+                               f"<td>{split}</td></tr>")
+                out.append("</table>")
+            slo = fs.get("slo")
+            if slo:
+                cw = slo.get("clean_windows") or {}
+                out.append(
+                    f"<h3>slo: {html.escape(slo.get('model_id', ''))} "
+                    f"— {html.escape(slo.get('state', ''))}</h3>"
+                    f"<p>canary={html.escape(slo.get('canary', ''))} "
+                    f"baseline={html.escape(slo.get('baseline', ''))} "
+                    f"clean_windows={cw.get('streak', 0)}/"
+                    f"{cw.get('required', 0)} "
+                    f"holds={slo.get('holds', 0)} "
+                    f"floor={slo.get('floor', 0)}</p>")
+                last = slo.get("last_eval") or {}
+                for side in ("canary", "baseline"):
+                    ev = last.get(side) or {}
+                    burns = ev.get("burns") or {}
+                    if not burns:
+                        continue
+                    out.append(f"<h4>{side}: "
+                               f"{html.escape(str(ev.get('verdict')))}"
+                               f"</h4><table><tr><th>metric</th>"
+                               f"<th>target</th><th>burn_short</th>"
+                               f"<th>burn_long</th></tr>")
+                    for met, b in sorted(burns.items()):
+                        flag = " &#x1F525;" if b.get("burning") else ""
+                        out.append(
+                            f"<tr><td>{html.escape(met)}{flag}</td>"
+                            f"<td>{b.get('target')}</td>"
+                            f"<td>{b.get('short')}</td>"
+                            f"<td>{b.get('long')}</td></tr>")
+                    out.append("</table>")
+                trail = slo.get("trail") or []
+                if trail:
+                    out.append("<h4>decision trail</h4><table>"
+                               "<tr><th>t</th><th>verdict</th>"
+                               "<th>action</th><th>detail</th></tr>")
+                    for ev in trail[-20:]:
+                        t = time.strftime(
+                            "%H:%M:%S", time.localtime(ev.get("t", 0)))
+                        out.append(
+                            f"<tr><td>{t}</td>"
+                            f"<td>{html.escape(ev.get('verdict', ''))}"
+                            f"</td>"
+                            f"<td>{html.escape(ev.get('action', ''))}"
+                            f"</td>"
+                            f"<td>{html.escape(ev.get('detail', ''))}"
+                            f"</td></tr>")
+                    out.append("</table>")
+            series = fs.get("series") or {}
+            if series:
+                out.append("<h3>series</h3><table><tr><th>replica</th>"
+                           "<th>model</th><th>metric</th><th>last</th>"
+                           "<th>spark</th></tr>")
+                for rep, models_ in sorted(series.items()):
+                    for mod, mets in sorted(models_.items()):
+                        for met, vals in sorted(mets.items()):
+                            out.append(
+                                f"<tr><td>{html.escape(rep)}</td>"
+                                f"<td>{html.escape(mod)}</td>"
+                                f"<td>{html.escape(met)}</td>"
+                                f"<td>{vals[-1] if vals else ''}</td>"
+                                f"<td>{_spark(vals)}</td></tr>")
+                out.append("</table>")
+        out.append("<p>args: ?fmt=json ?points=N</p></body></html>")
+        return "\n".join(out), "text/html"
 
     def migration_page(req):
         # cross-host KV data plane introspection (brpc_tpu/migrate):
@@ -700,6 +944,7 @@ def build_routes(server) -> dict:
         "/kvcache": kvcache_page,
         "/migration": migration_page,
         "/cluster": cluster_page,
+        "/fleet": fleet_page,
         "/psserve": psserve_page,
         "/flightrecorder": flightrecorder_page,
         "/hotspots": hotspots_index,

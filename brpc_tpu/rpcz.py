@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import contextvars
+import os
 import random
 import threading
 import time
@@ -25,10 +26,19 @@ from dataclasses import dataclass, field
 # propagation, bthread/key.cpp:49 + the rpcz parent-span contract.
 _current_span: contextvars.ContextVar = contextvars.ContextVar(
     "rpcz_span", default=None)
-_span_counter = itertools.count(1)
+# pid-salted span ids (ISSUE 20): the fleet telemetry plane merges
+# spans COLLECTED in several processes into one tree, so span ids must
+# not collide across processes the way bare count(1) streams do.  The
+# low 16 pid bits in bits 40..55 keep the id inside the uint64 the
+# wire TLV carries while leaving 2^40 spans per process before overlap.
+_span_counter = itertools.count(((os.getpid() & 0xFFFF) << 40) | 1)
 
 _COLLECT_MAX = 2048
 _collected: deque = deque(maxlen=_COLLECT_MAX)
+# monotone collection cursor (ISSUE 20): every span landing in
+# _collected gets the next seq, so a fleet collector can pull "finished
+# spans since my last pull" incrementally without re-shipping the ring
+_collect_seq = 0
 # NAMED hot lock (ISSUE 6): every submitted span's collector handoff
 # lands here — ledger row "rpcz.collect" on /hotspots/locks
 from brpc_tpu.butil.lockprof import InstrumentedLock  # noqa: E402
@@ -74,6 +84,11 @@ class Span:
     # the SOURCE process's migrate span whose pages this span spliced
     # in — 0 when this span is not a migration destination
     migrated_from: int = 0
+    # collection cursor (ISSUE 20): position in THIS process's
+    # recent-span store, assigned when the span lands there.  Purely
+    # local bookkeeping for incremental _telemetry pulls — never
+    # meaningful across processes and never persisted.
+    seq: int = 0
 
     @property
     def latency_us(self) -> int:
@@ -104,6 +119,7 @@ class _NullSpan:
     sampled = True
     recovered_from = 0
     migrated_from = 0
+    seq = 0
 
     def __setattr__(self, k, v):
         pass
@@ -250,17 +266,7 @@ def _db_append_locked(span: Span) -> None:
         _db_file = open(name, "ab")
         _db_writer = RecordWriter(_db_file)
         _db_bytes = 0
-    rec = json.dumps({
-        "trace_id": span.trace_id, "span_id": span.span_id,
-        "parent_span_id": span.parent_span_id, "service": span.service,
-        "method": span.method, "remote_side": span.remote_side,
-        "start_us": span.start_us, "end_us": span.end_us,
-        "request_size": span.request_size,
-        "response_size": span.response_size,
-        "error_code": span.error_code, "kind": span.kind,
-        "recovered_from": span.recovered_from,
-        "migrated_from": span.migrated_from,
-        "annotations": list(span.annotations)}).encode()
+    rec = json.dumps(span_to_dict(span)).encode()
     _db_writer.write(rec)
     # no per-span flush: a write(2) per span would defeat buffering; the
     # reader flushes the live writer before scanning, and RecordReader
@@ -321,7 +327,10 @@ class _SpanSample:
         self.span = span
 
     def dump_and_destroy(self) -> None:
+        global _collect_seq
         with _collect_lock:
+            _collect_seq += 1
+            self.span.seq = _collect_seq
             _collected.append(self.span)
         with _db_lock:
             if _db_dir is not None:
@@ -381,7 +390,14 @@ def _drain_native_spanq() -> None:
     # token path this queue exists to protect
     kept = spans[:limit.grab_n(len(spans))]
     if kept:
+        global _collect_seq
         with _collect_lock:
+            for span in kept:
+                _collect_seq += 1
+                try:
+                    span.seq = _collect_seq
+                except AttributeError:
+                    pass   # a foreign probe object on the native queue
             _collected.extend(kept)
         with _db_lock:
             if _db_dir is not None:
@@ -489,6 +505,55 @@ def recent_spans(limit: int = 100, trace_id: int | None = None) -> list[Span]:
     if trace_id is not None:
         spans = [s for s in spans if s.trace_id == trace_id]
     return spans[-limit:]
+
+
+def spans_since(cursor: int, limit: int = 256,
+                finished_only: bool = True) -> tuple[list[Span], int]:
+    """Incremental pull for the fleet telemetry plane (ISSUE 20):
+    collected spans with ``seq > cursor`` (oldest first, at most
+    ``limit``) plus the store's current high-water seq.  A caller that
+    re-pulls with the returned cursor sees each span exactly once —
+    until the bounded ring evicts faster than it pulls, in which case
+    the gap is simply skipped (the cursor is monotone, never rewound).
+    ``finished_only`` drops still-open spans (end_us unset) — the
+    telemetry contract ships only finished spans."""
+    flush()
+    with _collect_lock:
+        hi = _collect_seq
+        out = [s for s in _collected if getattr(s, "seq", 0) > cursor]
+    if finished_only:
+        out = [s for s in out if s.end_us]
+    out.sort(key=lambda s: s.seq)
+    return out[:max(0, int(limit))], hi
+
+
+def span_to_dict(span: Span) -> dict:
+    """The wire shape of one span — exactly the SpanDB record (so
+    ``span_from_dict``/``load_disk_spans`` share one decode path)."""
+    return {
+        "trace_id": span.trace_id, "span_id": span.span_id,
+        "parent_span_id": span.parent_span_id, "service": span.service,
+        "method": span.method, "remote_side": span.remote_side,
+        "start_us": span.start_us, "end_us": span.end_us,
+        "request_size": span.request_size,
+        "response_size": span.response_size,
+        "error_code": span.error_code, "kind": span.kind,
+        "recovered_from": span.recovered_from,
+        "migrated_from": span.migrated_from,
+        "annotations": list(span.annotations)}
+
+
+def span_from_dict(rec: dict) -> Span | None:
+    """Inverse of :func:`span_to_dict`; ``None`` on a malformed record
+    (one bad span from a remote process must not kill the merge)."""
+    try:
+        rec = dict(rec)
+        ann = [tuple(a) for a in rec.pop("annotations", ())]
+        rec.pop("sampled", None)
+        rec.pop("seq", None)
+        return Span(annotations=ann, **rec)
+    except (TypeError, ValueError, AttributeError):
+        return None
 
 
 def traceprintf(msg: str) -> None:

@@ -79,6 +79,36 @@ def cluster_snapshot() -> dict:
     }
 
 
+def fleet_snapshot(points: int = 32) -> dict:
+    """Live routers' fleet telemetry (ISSUE 20) — the /fleet console
+    page's data: per router the collector state (pulls, bytes,
+    tombstones), the windowed series rings, per-model scoreboard,
+    canary ramp state and the SLO decision trail."""
+    with _reg_mu:
+        routers = dict(_routers)
+    return {
+        "routers": {name: r.fleet_snapshot(points)
+                    for name, r in sorted(routers.items())},
+    }
+
+
+def fleet_trace_spans(trace_id: int) -> list:
+    """Cross-process spans of one trace, fanned out through every live
+    router (the /rpcz?trace_id= stitching read) — empty when no router
+    is registered or nothing was collected."""
+    with _reg_mu:
+        routers = dict(_routers)
+    merged: dict[tuple, object] = {}
+    for r in routers.values():
+        try:
+            for s in r.trace_fanout(trace_id):
+                merged.setdefault(
+                    (s.trace_id, s.span_id, s.kind, s.start_us), s)
+        except Exception:
+            continue
+    return list(merged.values())
+
+
 def serving_snapshot() -> dict:
     """Live components' stats — the /serving console page's data."""
     with _reg_mu:
@@ -187,3 +217,8 @@ from brpc_tpu.serving.modelplane import (  # noqa: E402,F401
     ReplicaDeployments, cluster_deploy, deployment_key,
     model_fingerprint, split_deployment_key,
 )
+from brpc_tpu.serving.telemetry import (  # noqa: E402,F401
+    TELEMETRY_SERVICE, FleetCollector, TelemetryService,
+    register_telemetry, telemetry_snapshot,
+)
+from brpc_tpu.serving.slo import Objective, SLOEngine  # noqa: E402,F401

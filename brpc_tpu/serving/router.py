@@ -595,7 +595,9 @@ class ClusterRouter:
                  control_timeout_ms: int = 2_000,
                  epoch: Optional[int] = None,
                  progress_timeout_s: float = 30.0,
-                 default_model: str = DEFAULT_MODEL):
+                 default_model: str = DEFAULT_MODEL,
+                 telemetry_collect: bool = True,
+                 telemetry_pull_interval_s: float = 0.25):
         from brpc_tpu.policy.load_balancer import PrefixAffinityLB
         from brpc_tpu.rpc.channel import Channel
         from brpc_tpu.rpc.combo_channels import SelectiveChannel
@@ -696,6 +698,22 @@ class ClusterRouter:
         for h in self.replicas:
             if getattr(h, "deployments", None) is not None:
                 self.catalog.note(h.addr, h.deployments.snapshot())
+
+        # fleet telemetry plane (ISSUE 20): the router-LOCAL half
+        # (scoreboard sampling + SLO evaluation) runs every tick, but
+        # the per-endpoint _telemetry pulls ride their own slower
+        # cadence — a pull ships a full bvar snapshot both sides must
+        # JSON-encode/decode under the GIL, and at the 20 Hz overload
+        # tick that tax alone breaks the <2% overhead gate while SLO
+        # windows are seconds-scale and gain nothing from it
+        from brpc_tpu.serving.telemetry import FleetCollector
+        self.telemetry_collect = bool(telemetry_collect)
+        self.telemetry_pull_interval_s = float(telemetry_pull_interval_s)
+        self._last_pull_t = 0.0
+        self.collector = FleetCollector(
+            name, control_timeout_ms=self.control_timeout_ms)
+        self.slo = None
+        self._floor_sources: list = []
 
         safe = re.sub(r"\W", "_", name)
         from brpc_tpu.bvar.variable import exposed_variables
@@ -1339,6 +1357,16 @@ class ClusterRouter:
         return out
 
     def _tick(self) -> int:
+        # advisory floor sources (ISSUE 20): the ladder's floor is the
+        # max over registered sources — a held MINIMUM level, never an
+        # escalation; the pressure gradient stays in charge above it
+        floor = 0
+        for fn in self._floor_sources:
+            try:
+                floor = max(floor, int(fn()))
+            except Exception:
+                pass
+        self._ladder.floor = min(floor, self._ladder.num_levels)
         lvl = self._ladder.update(self._pressures())
         self._apply_level(lvl)
         self._push_floor(lvl)
@@ -1347,7 +1375,76 @@ class ClusterRouter:
         for h in self.replicas:
             if getattr(h, "deployments", None) is not None:
                 self.catalog.note(h.addr, h.deployments.snapshot())
+        if self.telemetry_collect:
+            self._collect_telemetry()
         return lvl
+
+    def _collect_telemetry(self) -> None:
+        """One fleet-telemetry pass (ISSUE 20): sample the router-local
+        per-(model, version) scoreboard into the fleet series, pull each
+        endpoint's ``_telemetry`` increment over the control channel the
+        SetFloor push already holds open (at most once per
+        ``telemetry_pull_interval_s``), then run the SLO engine over
+        the refreshed series."""
+        from brpc_tpu.policy.health_check import is_broken
+        c = self.collector
+        c.sample_models(self.model_metrics)
+        now = time.monotonic()
+        if now - self._last_pull_t >= self.telemetry_pull_interval_s:
+            self._last_pull_t = now
+            for h in self.replicas:
+                if is_broken(h.endpoint):
+                    # a quarantined replica is TOMBSTONED, never
+                    # pulled: pulling a dead endpoint would stall the
+                    # tick thread for the control timeout every pass,
+                    # and the series must show the gap, not silently
+                    # average over it
+                    c.note_dead(h.addr)
+                    continue
+                c.pull(h.addr, self._ctrl_channel(h))
+        if self.slo is not None:
+            try:
+                self.slo.tick(c, self)
+            except Exception:
+                import logging
+                logging.getLogger(__name__).exception("slo tick failed")
+
+    def add_floor_source(self, fn) -> None:
+        """Register an advisory floor source: a zero-arg callable whose
+        value (clamped to the ladder height) joins the per-tick max
+        holding the ladder's floor."""
+        self._floor_sources.append(fn)
+
+    def attach_slo(self, engine) -> None:
+        """Attach an SLO burn-rate engine (``serving/slo.py``): ticked
+        after every collection pass, its promote/rollback decisions ride
+        this router's epoch-fenced deploy pushes and its ``floor()``
+        becomes an advisory floor source."""
+        self.slo = engine
+        self.add_floor_source(engine.floor)
+
+    def trace_fanout(self, trace_id: int) -> list:
+        """Every collected span of one trace across the FLEET (ISSUE
+        20): local + fleet-store spans plus live ``_telemetry`` Trace
+        queries to each replica and to every peer address the merged
+        client spans name — the hop that reaches a PS shard this router
+        never talks to directly."""
+        return self.collector.fan_out_trace(
+            int(trace_id), addrs=[h.addr for h in self.replicas])
+
+    def fleet_snapshot(self, points: int = 32) -> dict:
+        """The /fleet console page's data for this router: collector
+        state + tombstones, the windowed series rings, the per-model
+        scoreboard, canary ramp state and the SLO decision trail."""
+        return {
+            "collector": self.collector.stats(),
+            "series": self.collector.series_snapshot(points),
+            "models": self.model_metrics.snapshot(),
+            "canary": self.canary.snapshot(),
+            "catalog": self.catalog.snapshot(),
+            "slo": self.slo.snapshot() if self.slo is not None else None,
+            "ladder": self._ladder.stats(),
+        }
 
     def _apply_level(self, lvl: int) -> None:
         from brpc_tpu.serving.ladder import apply_level_to_components
@@ -1538,6 +1635,7 @@ class ClusterRouter:
             v = find_exposed(n)
             if v is not None:
                 v.hide()
+        self.collector.close()
 
     def replica_table(self) -> list[dict]:
         from brpc_tpu.policy.circuit_breaker import global_breaker
@@ -1590,6 +1688,8 @@ class ClusterRouter:
             "models": self.model_metrics.snapshot(),
             "canary": self.canary.snapshot(),
             "wrong_model_routes": self.wrong_model_routes.get_value(),
+            "telemetry": self.collector.stats(),
+            "slo": self.slo.snapshot() if self.slo is not None else None,
             "remote_floor": self.remote_floor_table(),
             "floor_pushes": self.floor_pushes,
             "floor_push_drops": self.floor_push_drops,
@@ -1684,9 +1784,16 @@ class RouterService(Service):
 
 
 def register_router(server, router: ClusterRouter) -> RouterService:
-    """Expose `router` on `server` (call before ``server.start()``)."""
+    """Expose `router` on `server` (call before ``server.start()``).
+    The router process joins the fleet telemetry plane too (ISSUE 20):
+    its ``_telemetry`` service is what lets ANOTHER router (or an
+    operator's one-shot pull) read this one's bvars and spans."""
+    from brpc_tpu.serving.telemetry import (TELEMETRY_SERVICE,
+                                            register_telemetry)
     svc = RouterService(router)
     server.add_service(svc)
+    if TELEMETRY_SERVICE not in server.services:
+        register_telemetry(server, name=router.name)
     return svc
 
 

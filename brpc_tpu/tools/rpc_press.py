@@ -571,7 +571,7 @@ def spin_up_replicas(n_replicas: int, *, page_tokens: int = 8,
     from brpc_tpu.kvcache import KVCacheStore
     from brpc_tpu.migrate import make_prefix_fetcher, register_migration
     from brpc_tpu.serving import (DecodeEngine, register_cluster_control,
-                                  register_serving)
+                                  register_serving, register_telemetry)
 
     def step(tokens, positions, pages=None):
         if step_delay_s:
@@ -599,6 +599,7 @@ def spin_up_replicas(n_replicas: int, *, page_tokens: int = 8,
         mig_svc = register_migration(srv, store)
         register_cluster_control(srv, engine=eng, store=store,
                                  name=f"{name_prefix}_{i}")
+        register_telemetry(srv, name=f"{name_prefix}_{i}")
         srv.start("127.0.0.1", 0)
         addr = f"127.0.0.1:{srv.port}"
         # the fetcher needs the replica's own addr, known only now
@@ -646,13 +647,17 @@ def tear_down_cluster(replicas, router, rsrv,
 MODEL_STEP_PRIMES = (7, 11, 13, 17, 19, 23, 29)
 
 
-def model_step_fn(mult: int, step_delay_s: float = 0.0):
-    """The numpy step function for one model deployment (CPU-valid)."""
+def model_step_fn(mult: int, step_delay_s=0.0):
+    """The numpy step function for one model deployment (CPU-valid).
+    ``step_delay_s`` may be a float or a zero-arg callable evaluated
+    per step — the knob the SLO rollback test turns mid-run to make
+    ONE version's ITL burn while its tokens stay bit-exact."""
     import numpy as np
 
     def step(tokens, positions, pages=None):
-        if step_delay_s:
-            time.sleep(step_delay_s)
+        d = step_delay_s() if callable(step_delay_s) else step_delay_s
+        if d:
+            time.sleep(d)
         return (np.asarray(tokens) * int(mult)
                 + np.asarray(positions)) % 997
 
@@ -674,7 +679,7 @@ def expected_model_tokens(prompt, n: int, mult: int = 7) -> list:
 
 def spin_up_multimodel_replicas(n_replicas: int, models, *, layout=None,
                                 page_tokens: int = 8,
-                                step_delay_s: float = 0.0,
+                                step_delay_s=0.0,
                                 num_slots: int = 8, max_blocks: int = 64,
                                 page_bytes: int = 512,
                                 max_pages_per_slot: int = 64,
@@ -705,7 +710,7 @@ def spin_up_multimodel_replicas(n_replicas: int, models, *, layout=None,
     from brpc_tpu.migrate import make_prefix_fetcher, register_migration
     from brpc_tpu.serving import (DecodeEngine, ReplicaDeployments,
                                   register_cluster_control,
-                                  register_serving)
+                                  register_serving, register_telemetry)
     from brpc_tpu.serving.modelplane import LOADING, WARM
 
     models = [str(m) for m in models]
@@ -725,7 +730,12 @@ def spin_up_multimodel_replicas(n_replicas: int, models, *, layout=None,
                                  max_blocks=max_blocks,
                                  name=f"{name_prefix}_{i}_{m}",
                                  commit_live_pages=commit_live_pages)
-            eng = DecodeEngine(model_step_fn(mults[m], step_delay_s),
+            # step_delay_s: scalar/callable for the whole fleet, or a
+            # dict keyed by deployment key — per-VERSION latency
+            # injection (the SLO rollback test slows only the canary)
+            delay = step_delay_s.get(m, 0.0) \
+                if isinstance(step_delay_s, dict) else step_delay_s
+            eng = DecodeEngine(model_step_fn(mults[m], delay),
                                num_slots=num_slots, store=store,
                                max_pages_per_slot=max_pages_per_slot,
                                name=f"{name_prefix}_eng_{i}_{m}")
@@ -740,6 +750,7 @@ def spin_up_multimodel_replicas(n_replicas: int, models, *, layout=None,
                                  store=stores.get(m0),
                                  name=f"{name_prefix}_{i}",
                                  deployments=deps)
+        register_telemetry(srv, name=f"{name_prefix}_{i}")
         srv.start("127.0.0.1", 0)
         addr = f"127.0.0.1:{srv.port}"
         if mig_svc is not None:
@@ -774,13 +785,13 @@ def tear_down_multimodel_replicas(replicas) -> None:
 
 def spin_up_multimodel_cluster(n_replicas: int, models, *, layout=None,
                                page_tokens: int = 8,
-                               step_delay_s: float = 0.0,
+                               step_delay_s=0.0,
                                commit_live_pages: bool = False,
                                replicate_sessions: bool = False,
                                max_sessions: int = 256,
                                timeout_ms: int = 20_000,
                                name_prefix: str = "mm", warm: bool = True,
-                               wal=None, **replica_kw):
+                               wal=None, router_kw=None, **replica_kw):
     """A multi-model fleet behind one :class:`~brpc_tpu.serving.
     ClusterRouter` front door: :func:`spin_up_multimodel_replicas` plus
     a router whose handles carry the deployment tables (the catalog
@@ -801,7 +812,7 @@ def spin_up_multimodel_cluster(n_replicas: int, models, *, layout=None,
             r["addr"], name=f"{name_prefix}_{i}",
             engine=r["engines"].get(m0), store=r["stores"].get(m0),
             server=r["server"], deployments=r["deps"]))
-    kw = {}
+    kw = dict(router_kw or {})
     if wal is not None:
         kw["wal"] = wal
     router = ClusterRouter(
@@ -849,6 +860,7 @@ def spin_up_psserve(n_shards: int, *, vocab: int = 1024, dim: int = 32,
     over it (shared by --embedding mode and bench.py embedding)."""
     from brpc_tpu.psserve import EmbeddingShardServer, register_psserve
     from brpc_tpu.rpc.combo_channels import PartitionChannel
+    from brpc_tpu.serving.telemetry import register_telemetry
 
     servers, svcs, shards = [], [], []
     pc = PartitionChannel(n_shards)
@@ -859,6 +871,7 @@ def spin_up_psserve(n_shards: int, *, vocab: int = 1024, dim: int = 32,
         s = brpc.Server()
         svcs.append(register_psserve(s, sh, max_delay_us=max_delay_us,
                                      name=f"{name_prefix}_{i}"))
+        register_telemetry(s, name=f"{name_prefix}_ps_{i}")
         s.start("127.0.0.1", 0)
         servers.append(s)
         pc.add_partition(i, brpc.Channel(f"127.0.0.1:{s.port}",
@@ -1126,6 +1139,7 @@ def run_cluster_press(n_replicas: int, request,
                       duration_s: float = 10.0, threads: int = 4,
                       timeout_ms: int = 20_000, request_factory=None,
                       kill_replica_after: float | None = None,
+                      slo: bool = False,
                       out=sys.stderr) -> dict:
     """``--cluster N`` mode: spin up N in-process serving replicas
     behind a :class:`~brpc_tpu.serving.ClusterRouter` and press full
@@ -1142,6 +1156,18 @@ def run_cluster_press(n_replicas: int, request,
         n_replicas, page_tokens=8, commit_live_pages=True,
         replicate_sessions=True, max_sessions=max(64, 8 * threads),
         name_prefix="press_cl", timeout_ms=timeout_ms)
+    if slo:
+        # --slo (ISSUE 20): observe-only burn-rate evaluation riding
+        # the collector ticks — a single-model press has no canary
+        # pair to re-weight, so verdicts report, never act
+        from brpc_tpu.serving import Objective, SLOEngine
+        from brpc_tpu.serving.modelplane import DEFAULT_MODEL
+        router.attach_slo(SLOEngine(
+            DEFAULT_MODEL, DEFAULT_MODEL, DEFAULT_MODEL,
+            [Objective("ttft_p99_ms", 500.0),
+             Objective("itl_p99_ms", 50.0),
+             Objective("error_rate", 0.05)],
+            short_window_s=1.0, long_window_s=3.0, act=False))
 
     rec_ttft = LatencyRecorder("rpc_press_cluster_ttft")
     mu = threading.Lock()
@@ -1227,6 +1253,28 @@ def run_cluster_press(n_replicas: int, request,
         "router_level": rstats["ladder"]["level"],
         "elapsed_s": round(elapsed, 2),
     }
+    tel = rstats.get("telemetry") or {}
+    summary["telemetry"] = {k: tel.get(k, 0) for k in
+                            ("pulls", "pull_bytes", "pull_errors",
+                             "tombstones")}
+    if slo and rstats.get("slo"):
+        s = rstats["slo"]
+        can = (s.get("last_eval") or {}).get("canary") or {}
+        summary["slo"] = {
+            "verdict": can.get("verdict"),
+            "burns": can.get("burns"),
+            "floor": s.get("floor"),
+            "evaluations": s.get("evaluations"),
+        }
+        print("--- slo (observe-only burn rates) ---", file=sys.stderr)
+        print(f"verdict={can.get('verdict')} floor={s.get('floor')} "
+              f"evaluations={s.get('evaluations')}", file=sys.stderr)
+        for met, b in sorted((can.get("burns") or {}).items()):
+            print(f"  {met}: target={b.get('target')} "
+                  f"burn_short={b.get('short')} "
+                  f"burn_long={b.get('long')}"
+                  + (" BURNING" if b.get("burning") else ""),
+                  file=sys.stderr)
     print(json.dumps(summary), file=out)
     tear_down_cluster(replicas, router, rsrv)
     return summary
@@ -1558,6 +1606,10 @@ def main(argv=None):
                     help="with --cluster: kill one replica S seconds "
                          "into the run so session resume runs under "
                          "load")
+    ap.add_argument("--slo", action="store_true",
+                    help="with --cluster: attach an observe-only SLO "
+                         "burn-rate engine to the router and print its "
+                         "verdict/burn summary block (ISSUE 20)")
     ap.add_argument("--kill-router-after", type=float, default=None,
                     metavar="S",
                     help="with --cluster: run the router as its own OS "
@@ -1687,6 +1739,7 @@ def main(argv=None):
                           timeout_ms=max(a.timeout_ms, 5000),
                           request_factory=factory,
                           kill_replica_after=a.kill_replica_after,
+                          slo=a.slo,
                           out=sys.stdout)
     elif a.disagg:
         try:

@@ -366,6 +366,11 @@ class MixedWorkloadHarness:
             s = brpc.Server()
             self.ps_svcs.append(register_psserve(
                 s, sh, name=f"{self.name}_{i}"))
+            # every serving process joins the fleet telemetry plane
+            # (ISSUE 20) — a trainer-harness PS shard is pullable like
+            # any replica
+            from brpc_tpu.serving.telemetry import register_telemetry
+            register_telemetry(s, name=f"{self.name}_ps_{i}")
             s.start("127.0.0.1", 0)
             self.ps_servers.append(s)
             self.pc.add_partition(i, brpc.Channel(
@@ -415,10 +420,12 @@ class MixedWorkloadHarness:
         endpoint and the trainer's update_token replay dedups anything
         the killed server already applied."""
         from brpc_tpu.psserve import register_psserve
+        from brpc_tpu.serving.telemetry import register_telemetry
         brpc = self._brpc
         s = brpc.Server()
         self.ps_svcs.append(register_psserve(
             s, self.shards[i], name=f"{self.name}_r{i}"))
+        register_telemetry(s, name=f"{self.name}_ps_r{i}")
         s.start("127.0.0.1", 0)
         self.ps_servers[i] = s
         self.pc.add_partition(i, brpc.Channel(

@@ -2868,3 +2868,123 @@ def test_multimodel_warm_replica_kill_same_model_failover(seed):
         lambda: (gc.collect(), native_path.tokring_live())[1]
         <= ring0, 10), \
         f"seed {seed}: native emit rings leaked across the failover"
+
+
+# ---------------------------------------------------------------------------
+# chaos scenario 20: replica kill mid-collection — the telemetry plane
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_replica_kill_mid_collection_tombstones_and_slo_holds(seed):
+    """The fleet telemetry plane's chaos drill (ISSUE 20).  A 2-replica
+    canary fleet (m@v1 baseline / m@v2 canary, both warm on both
+    replicas) streams under an attached SLO engine while the router's
+    collector pulls at tick cadence.  Mid-collection one replica is
+    killed.  Invariants:
+
+    * the victim is TOMBSTONED on the collector — its series freeze
+      and drop out of aggregates, never silently averaged in;
+    * the SLO engine HOLDs every canary decision for the disruption
+      window: a clean canary must NOT promote (and chaos-induced burn
+      must not roll back) while the fleet is disrupted — the ramp
+      stays ``ramping`` with ``holds`` ticking;
+    * every in-flight stream finishes bit-exact against the oracle of
+      whichever version the router bound it to, exactly once, through
+      the failover — telemetry is observation, never a correctness
+      dependency;
+    * survivor pools/refcounts and the native emit rings return to
+      baseline.
+    """
+    import gc
+
+    from brpc_tpu import native_path
+    from brpc_tpu.serving import RouterClient
+    from brpc_tpu.serving.slo import (HOLD, RAMPING, Objective,
+                                      SLOEngine)
+    from brpc_tpu.tools.rpc_press import (expected_model_tokens,
+                                          spin_up_multimodel_cluster,
+                                          tear_down_multimodel_cluster)
+
+    PT = 4
+    budget = 10
+    replicas, mults, router, rsrv, raddr = spin_up_multimodel_cluster(
+        2, ["m@v1", "m@v2"], page_tokens=PT, step_delay_s=0.03,
+        commit_live_pages=True, replicate_sessions=True,
+        name_prefix=f"c20_{seed}")
+    try:
+        ring0 = native_path.tokring_live()
+        eng = SLOEngine(
+            "m", "m@v1", "m@v2",
+            # generous targets: the canary is CLEAN — only the HOLD may
+            # stop it; clean_windows is set far past this test's
+            # horizon so the ramp is provably still open at kill time
+            [Objective("ttft_p99_ms", 60_000.0),
+             Objective("itl_p99_ms", 60_000.0)],
+            short_window_s=0.3, long_window_s=0.8, clean_windows=1000)
+        router.attach_slo(eng)
+        # collection is live on BOTH replicas before the kill — the
+        # crash lands mid-collection, not before it
+        assert wait_until(
+            lambda: all(r["pulls"] > 0
+                        for r in router.collector.replica_table()), 10), \
+            f"seed {seed}: collector never pulled both replicas"
+
+        cli = RouterClient(raddr, timeout_ms=30_000)
+        prompts = [[100 + 20 * k + i for i in range(13)]
+                   for k in range(4)]
+        gens = [(p, cli.start(p, budget, model="m")) for p in prompts]
+        for p, g in gens:
+            assert g.wait_tokens(3, timeout_s=30), \
+                f"seed {seed}: no tokens before the kill"
+
+        # -- the crash --
+        victim = replicas[0]
+        victim["server"].stop()
+        victim["server"].join()  # brpc-check: allow(wedge-hygiene)
+        for e in victim["engines"].values():
+            e.close(timeout_s=2.0)
+
+        # the collector tombstones the victim (consecutive pull
+        # failures or the router's quarantine note — either path)
+        assert wait_until(
+            lambda: victim["addr"] in router.collector.tombstoned(),
+            15), f"seed {seed}: victim never tombstoned"
+        # the SLO engine HOLDs the ramp for the disruption
+        assert wait_until(lambda: eng.holds > 0, 10), \
+            f"seed {seed}: SLO never held during the disruption"
+        assert eng.state == RAMPING, \
+            f"seed {seed}: ramp decided during a disruption " \
+            f"({eng.state}): {eng.trail()}"
+
+        # every stream finishes THROUGH the crash, bit-exact against
+        # the version the router bound it to
+        for p, g in gens:
+            assert g.wait(60), f"seed {seed}: stream hung"
+            assert g.error is None, \
+                f"seed {seed}: stream broke (E{g.error})"
+            oracle_v1 = expected_model_tokens(p, budget, mults["m@v1"])
+            oracle_v2 = expected_model_tokens(p, budget, mults["m@v2"])
+            assert g.tokens in (oracle_v1, oracle_v2), \
+                f"seed {seed}: stream matches NEITHER version's oracle"
+            assert len(g.tokens) == budget    # zero dups, zero holes
+        assert router.stats()["wrong_model_routes"] == 0
+
+        # the hold persists while the tombstone is active
+        assert eng.tick(router.collector, router) == HOLD
+        assert eng.state == RAMPING
+
+        # -- survivor baselines --
+        surv = replicas[1]
+        for store in surv["stores"].values():
+            assert wait_until(
+                lambda s=store: s.stats()["live_seqs"] == 0, 15), \
+                f"seed {seed}: leaked live sequences on the survivor"
+            store.clear()
+            store.pagepool.assert_consistent()
+            assert store.pagepool.blocks_leased() == 0
+    finally:
+        tear_down_multimodel_cluster(replicas, router, rsrv)
+    assert wait_until(
+        lambda: (gc.collect(), native_path.tokring_live())[1]
+        <= ring0, 10), \
+        f"seed {seed}: native emit rings leaked across the kill"

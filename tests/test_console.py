@@ -1,6 +1,7 @@
 """Builtin HTTP console tests (analog of brpc_builtin_service_unittest)."""
 import http.client
 import json
+import time
 
 import pytest
 
@@ -272,6 +273,7 @@ def test_every_console_route_answers(server):
         "/version", "/connections", "/sockets", "/bthreads", "/services",
         "/protobufs", "/memory", "/ici", "/serving",
         "/serving/generations", "/kvcache", "/migration", "/cluster",
+        "/fleet", "/fleet?fmt=json", "/fleet?points=8",
         "/psserve",
         "/rpcz",
         "/rpcz?trace_id=1", "/brpc_metrics",
@@ -559,6 +561,74 @@ def test_cluster_page_shows_deployment_catalog_and_canary():
         # per-model session counts + the mis-route invariant
         assert r["sessions_by_model"] == {}
         assert r["wrong_model_routes"] == 0
+    finally:
+        srv.stop()
+        srv.join()
+        router.close(timeout_s=1.0)
+
+
+def test_fleet_page_renders_collector_slo_and_metrics_families():
+    """/fleet renders the fleet telemetry plane (ISSUE 20): the
+    collector's replica table with tombstone state, the per-model
+    scoreboard, the SLO burn tables + decision trail — and the
+    aggregated ``brpc_fleet_*`` families ride /brpc_metrics with a
+    replica label."""
+    from brpc_tpu.serving import ClusterRouter, ReplicaHandle
+    from brpc_tpu.serving.slo import BURNING, Objective, SLOEngine
+
+    h = ReplicaHandle("127.0.0.1:9", name="console_fleet_r0")
+    router = ClusterRouter([h], auto_tick=False,
+                           name="console_fleet_router")
+    eng = SLOEngine("orca", "orca@v1", "orca@v2",
+                    [Objective("itl_p99_ms", 5.0)],
+                    short_window_s=0.1, long_window_s=0.2,
+                    clean_windows=3)
+    router.attach_slo(eng)
+    # a burning canary next to a clean baseline, sampled into the
+    # collector's router-keyed series the way the tick thread does
+    for _ in range(4):
+        router.model_metrics.note_ttft("orca@v1", 0.005)
+        router.model_metrics.note_itl("orca@v1", 0.001)
+        router.model_metrics.note_itl("orca@v2", 0.500)
+        router.collector.sample_models(router.model_metrics)
+        time.sleep(0.03)
+    router.collector.note_dead("127.0.0.1:9")
+    # the disruption HOLD fires first — the trail shows it; the burn
+    # tables still carry the canary's 🔥 rows
+    assert eng.tick(router.collector, router) == "HOLD"
+    srv = brpc.Server()
+    srv.start("127.0.0.1", 0)
+    try:
+        status, body = _get(srv, "/fleet?fmt=json")
+        assert status == 200
+        fs = json.loads(body)["routers"]["console_fleet_router"]
+        reps = {r["addr"]: r for r in fs["collector"]["replicas"]}
+        assert reps["127.0.0.1:9"]["tombstoned"] is True
+        assert fs["slo"]["state"] == "ramping"
+        assert fs["slo"]["holds"] == 1
+        burns = fs["slo"]["last_eval"]["canary"]["burns"]
+        assert burns["itl_p99_ms"]["burning"] is True
+        assert fs["models"]["orca@v2"]["itl"]["p99_ms"] > 100
+
+        status, body = _get(srv, "/fleet")
+        assert status == 200
+        page = body.decode()
+        assert "fleet: console_fleet_router" in page
+        assert "TOMBSTONED" in page
+        assert "orca@v2" in page
+        assert "slo: orca" in page and "ramping" in page
+        assert "decision trail" in page
+        assert "&#x1F525;" in page   # the burning-metric flame
+
+        status, body = _get(srv, "/brpc_metrics")
+        assert status == 200
+        text = body.decode()
+        assert 'brpc_fleet_metric{replica="router",model="orca@v2",' \
+            in text
+        assert 'brpc_fleet_tombstoned{replica="127.0.0.1:9"} 1' in text
+        assert ('brpc_fleet_slo_state{model="orca",state="ramping"} 1'
+                in text)
+        assert 'brpc_fleet_slo_holds{model="orca"} 1' in text
     finally:
         srv.stop()
         srv.join()

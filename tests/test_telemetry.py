@@ -1,0 +1,751 @@
+"""Fleet telemetry plane (ISSUE 20): the ``_telemetry`` service's
+incremental pulls, the router-side FleetCollector (series rings,
+unsupported latch, tombstones), the SLO burn-rate engine's verdicts,
+and the two acceptance E2Es — the canary loop closing over real
+traffic (healthy canary auto-promotes, slow canary auto-rolls-back,
+both bit-exact) and one ``/rpcz?trace_id=`` tree stitched from THREE
+distinct OS processes."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import brpc_tpu as brpc
+from brpc_tpu import errors, rpcz
+from brpc_tpu.serving.slo import (BURNING, HOLD, INSUFFICIENT, OK,
+                                  PROMOTED, RAMPING, ROLLED_BACK,
+                                  Objective, SLOEngine)
+from brpc_tpu.serving.telemetry import (FleetCollector, TelemetryService,
+                                        parse_spans_field,
+                                        register_telemetry,
+                                        telemetry_snapshot)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _hygiene():
+    from brpc_tpu import fault
+    fault.clear()
+    yield
+    rpcz.set_current_span(None)
+    rpcz.set_enabled(False)
+    fault.clear()
+
+
+def _flush_rpcz():
+    from brpc_tpu.bvar.collector import Collector
+    Collector.instance().flush(family="rpcz")
+
+
+# ---------------------------------------------------------------------------
+# the per-process half: telemetry_snapshot + the _telemetry service
+# ---------------------------------------------------------------------------
+
+class TestTelemetryService:
+    def test_snapshot_carries_every_variable_family(self):
+        from brpc_tpu.bvar.recorder import LatencyRecorder
+        from brpc_tpu.bvar.reducer import Adder
+        a = Adder("telem_test_adder")
+        a.add(7)
+        rec = LatencyRecorder("telem_test_rec")
+        rec.add(1000)
+        try:
+            # pattern-filtered: a full-suite run leaves hundreds of
+            # other tests' bvars exposed in-process, and the default
+            # alphabetical max_vars cut would drop ours
+            snap = telemetry_snapshot(pattern="telem_test_*")
+            assert snap["scalars"]["telem_test_adder"] == 7
+            r = snap["recorders"]["telem_test_rec_latency"]
+            assert r["count"] == 1 and r["max_us"] >= 1000
+            # PR 15 syscall attribution rides every snapshot (zeros
+            # when the native core is absent — key always present)
+            assert "write_syscalls" in snap["syscalls"]
+            assert snap["truncated"] is False
+        finally:
+            a.hide()
+            rec.hide()
+
+    def test_snapshot_truncation_is_deterministic(self):
+        snap = telemetry_snapshot(max_vars=1)
+        assert snap["truncated"] is True
+        total = (len(snap["scalars"]) + len(snap["recorders"])
+                 + len(snap["windows"]))
+        assert total == 1
+
+    def test_pull_is_incremental_over_the_span_cursor(self):
+        rpcz.set_enabled(True, 1.0)
+        srv = brpc.Server()
+        svc = register_telemetry(srv, name="unit_replica")
+        srv.start("127.0.0.1", 0)
+        try:
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            # the span seq is process-global, so in a full-suite run
+            # thousands of earlier spans precede ours — prime with a
+            # zero-span pull to learn the CURRENT high-water cursor
+            r0 = ch.call_sync("_telemetry", "Pull",
+                              {"cursor": 0, "max_spans": 0},
+                              serializer="tensorframe",
+                              response_serializer="tensorframe")
+            base = int(r0["cursor"])
+            for i in range(3):
+                s = rpcz.new_span("server", "Unit", f"m{i}")
+                rpcz.submit(s)
+            _flush_rpcz()
+            r1 = ch.call_sync("_telemetry", "Pull", {"cursor": base},
+                              serializer="tensorframe",
+                              response_serializer="tensorframe")
+            assert r1["name"] == "unit_replica"
+            assert r1["pid"] == os.getpid()
+            spans1 = parse_spans_field(r1["spans"])
+            assert len(spans1) >= 3
+            assert {x.method for x in spans1} >= {"m0", "m1", "m2"}
+            # vars payload decodes to the snapshot shape
+            snap = json.loads(r1["vars"])
+            assert "scalars" in snap and "syscalls" in snap
+            # second pull FROM the returned cursor never re-ships an
+            # already-pulled span (the pulls themselves are traced, so
+            # new spans — the first Pull's own ingress — may appear)
+            r2 = ch.call_sync("_telemetry", "Pull",
+                              {"cursor": int(r1["cursor"])},
+                              serializer="tensorframe",
+                              response_serializer="tensorframe")
+            again = {x.span_id for x in parse_spans_field(r2["spans"])}
+            assert not again & {x.span_id for x in spans1}
+            assert int(r2["cursor"]) >= int(r1["cursor"])
+            assert svc.stats()["pulls"] == 3
+        finally:
+            srv.stop()
+            srv.join()
+
+    def test_trace_query_returns_one_trace(self):
+        rpcz.set_enabled(True, 1.0)
+        srv = brpc.Server()
+        register_telemetry(srv)
+        srv.start("127.0.0.1", 0)
+        try:
+            a = rpcz.new_span("server", "T", "a")
+            rpcz.submit(a)
+            b = rpcz.new_span("server", "T", "b")
+            rpcz.submit(b)
+            _flush_rpcz()
+            ch = brpc.Channel(f"127.0.0.1:{srv.port}", timeout_ms=5000)
+            r = ch.call_sync("_telemetry", "Trace",
+                             {"trace_id": a.trace_id},
+                             serializer="tensorframe",
+                             response_serializer="tensorframe")
+            got = parse_spans_field(r["spans"])
+            assert [s.trace_id for s in got] == [a.trace_id] * len(got)
+            assert any(s.method == "a" for s in got)
+            assert not any(s.method == "b" for s in got)
+        finally:
+            srv.stop()
+            srv.join()
+
+
+# ---------------------------------------------------------------------------
+# the router half: FleetCollector
+# ---------------------------------------------------------------------------
+
+class _FakeMetrics:
+    """snapshot()-compatible stand-in for ModelMetrics."""
+
+    def __init__(self):
+        self.rows = {}
+
+    def set(self, model, *, ttft_ms=None, itl_ms=None,
+            finished=0, failed=0):
+        self.rows[model] = {
+            "ttft": {"p99_ms": ttft_ms}, "itl": {"p99_ms": itl_ms},
+            "finished": finished, "failed": failed,
+        }
+
+    def snapshot(self):
+        return dict(self.rows)
+
+
+class TestFleetCollector:
+    def test_pull_merges_vars_and_spans_into_rings(self):
+        rpcz.set_enabled(True, 1.0)
+        from brpc_tpu.bvar.recorder import LatencyRecorder
+        rec = LatencyRecorder("telem_ring_rec")
+        rec.add(500)
+        srv = brpc.Server()
+        register_telemetry(srv, name="ring_replica")
+        srv.start("127.0.0.1", 0)
+        addr = f"127.0.0.1:{srv.port}"
+        # var_filter keeps the pull hermetic against the hundreds of
+        # unrelated bvars a full-suite run leaves exposed in-process
+        c = FleetCollector("unit", var_filter="telem_ring_rec*")
+        try:
+            s = rpcz.new_span("server", "Ring", "m")
+            rpcz.submit(s)
+            _flush_rpcz()
+            ch = brpc.Channel(addr, timeout_ms=5000)
+            assert c.pull(addr, ch) is True
+            st = c.replica_table()[0]
+            assert st["name"] == "ring_replica"
+            assert st["pulls"] == 1 and not st["tombstoned"]
+            # recorder p99/qps became fleet series
+            vals = c.window_values(addr, "",
+                                   "telem_ring_rec_latency.p99_us", 60.0)
+            assert vals and vals[-1] >= 400   # bucketed percentile of one 500us record
+            # the pulled span landed in the fleet span store
+            assert any(x.trace_id == s.trace_id
+                       for x in c.fleet_spans(s.trace_id))
+            assert c.stats()["pulls"] == 1
+            assert c.stats()["pull_bytes"] > 0
+        finally:
+            c.close()
+            rec.hide()
+            srv.stop()
+            srv.join()
+
+    def test_telemetry_less_process_latches_unsupported_not_dead(self):
+        srv = brpc.Server()   # no _telemetry registered
+        srv.start("127.0.0.1", 0)
+        addr = f"127.0.0.1:{srv.port}"
+        c = FleetCollector("unit_unsup")
+        try:
+            ch = brpc.Channel(addr, timeout_ms=5000)
+            assert c.pull(addr, ch) is False
+            st = c.replica_table()[0]
+            assert st["unsupported"] is True
+            assert not st["tombstoned"] and st["errors"] == 0
+            # further pulls are no-ops, never RPCs, never tombstones
+            for _ in range(5):
+                assert c.pull(addr, ch) is False
+            assert c.replica_table()[0]["errors"] == 0
+            assert c.stats()["pull_errors"] == 0
+            assert not c.disruption_within(60.0)
+        finally:
+            c.close()
+            srv.stop()
+            srv.join()
+
+    def test_dead_endpoint_tombstones_then_recovers(self):
+        # a connectable-then-closed port: pulls fail with a transport
+        # error, which DOES count toward the tombstone
+        tmp = brpc.Server()
+        tmp.start("127.0.0.1", 0)
+        addr = f"127.0.0.1:{tmp.port}"
+        tmp.stop()
+        tmp.join()  # brpc-check: allow(wedge-hygiene) — stopped echo-less server, joins instantly
+        c = FleetCollector("unit_tomb")
+        try:
+            ch = brpc.Channel(addr, timeout_ms=300)
+            for _ in range(FleetCollector.TOMBSTONE_AFTER):
+                assert c.pull(addr, ch) is False
+            st = c.replica_table()[0]
+            assert st["tombstoned"] is True
+            assert c.tombstoned() == [addr]
+            assert c.disruption_within(60.0)
+            assert c.stats()["tombstones"] == 1
+            # the replica comes back (same port) with telemetry: one
+            # good pull clears the tombstone and stamps recover_t
+            srv = brpc.Server()
+            register_telemetry(srv, name="back")
+            host, port = addr.split(":")
+            srv.start(host, int(port))
+            try:
+                ch2 = brpc.Channel(addr, timeout_ms=5000)
+                assert c.pull(addr, ch2) is True
+                st = c.replica_table()[0]
+                assert not st["tombstoned"]
+                # the recovery edge still holds the disruption window
+                # open (SLO HOLD covers the healing fleet too) ...
+                assert c.disruption_within(60.0)
+                # ... but an expired window closes it
+                assert not c.disruption_within(
+                    0.5, now=time.monotonic() + 100.0)
+            finally:
+                srv.stop()
+                srv.join()
+        finally:
+            c.close()
+
+    def test_note_dead_tombstones_immediately(self):
+        c = FleetCollector("unit_dead")
+        try:
+            c.note_dead("10.0.0.1:1")
+            assert c.tombstoned() == ["10.0.0.1:1"]
+            assert c.disruption_within(60.0)
+            c.note_dead("10.0.0.1:1")   # idempotent
+            assert c.stats()["tombstones"] == 1
+        finally:
+            c.close()
+
+    def test_values_across_excludes_tombstoned_series(self):
+        c = FleetCollector("unit_excl")
+        try:
+            m = _FakeMetrics()
+            m.set("m", itl_ms=10.0)
+            c.sample_models(m, replica="r1:1")
+            m.set("m", itl_ms=99.0)
+            c.sample_models(m, replica="r2:2")
+            vals = sorted(c.values_across("m", "itl_p99_ms", 60.0))
+            assert vals == [10.0, 99.0]
+            c.note_dead("r2:2")
+            # the dead replica's series FREEZES and drops out of the
+            # aggregate — never silently averaged
+            assert c.values_across("m", "itl_p99_ms", 60.0) == [10.0]
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# SLO engine verdicts (unit: real collector, fake metrics, fake router)
+# ---------------------------------------------------------------------------
+
+class _FakeRouter:
+    def __init__(self):
+        self.pushes = []
+
+    def deploy_model(self, model, *, op="deploy", weight=1, state=None,
+                     addrs=None):
+        self.pushes.append((op, model, weight, state))
+        return {}
+
+
+def _engine(objs=None, **kw):
+    # wide enough that a ~0.05s feed/tick loop always lands >=2
+    # samples inside the SHORT window (the _burn data floor)
+    kw.setdefault("short_window_s", 0.15)
+    kw.setdefault("long_window_s", 0.4)
+    kw.setdefault("clean_windows", 2)
+    return SLOEngine("m", "m@v1", "m@v2",
+                     objs or [Objective("itl_p99_ms", 10.0)], **kw)
+
+
+def _feed(c, m, *, base_itl=5.0, can_itl=5.0, n=3, dt=0.02):
+    """n samples for both deployment keys, spaced dt apart."""
+    for _ in range(n):
+        m.set("m@v1", ttft_ms=5.0, itl_ms=base_itl, finished=1)
+        m.set("m@v2", ttft_ms=5.0, itl_ms=can_itl, finished=1)
+        c.sample_models(m)
+        time.sleep(dt)
+
+
+class TestSLOEngine:
+    def test_insufficient_until_both_windows_have_data(self):
+        c = FleetCollector("slo_ins")
+        try:
+            eng = _engine()
+            assert eng.tick(c, None) == INSUFFICIENT
+            assert eng.state == RAMPING
+        finally:
+            c.close()
+
+    def test_clean_windows_promote_and_push_the_ramp(self):
+        c = FleetCollector("slo_prom")
+        r = _FakeRouter()
+        try:
+            eng = _engine()
+            m = _FakeMetrics()
+            _feed(c, m, n=6)
+            deadline = time.monotonic() + 5.0
+            while eng.state == RAMPING and time.monotonic() < deadline:
+                _feed(c, m, n=1)
+                eng.tick(c, r)
+                time.sleep(0.03)
+            assert eng.state == PROMOTED
+            # winner re-deployed warm, loser drained — 100/0
+            assert ("deploy", "m@v2", 1, "warm") in r.pushes
+            assert ("drain", "m@v1", 1, None) in r.pushes
+            acts = [e.get("action") for e in eng.trail()]
+            assert "promote" in acts and "clean_window" in acts
+            # terminal: further burn cannot un-promote
+            _feed(c, m, can_itl=500.0, n=6)
+            assert eng.tick(c, r) == PROMOTED
+        finally:
+            c.close()
+
+    def test_burning_canary_rolls_back_when_baseline_is_clean(self):
+        c = FleetCollector("slo_rb")
+        r = _FakeRouter()
+        try:
+            eng = _engine()
+            m = _FakeMetrics()
+            _feed(c, m, can_itl=500.0, n=6)
+            v = eng.tick(c, r)
+            assert v == BURNING
+            assert eng.state == ROLLED_BACK
+            assert ("deploy", "m@v1", 1, "warm") in r.pushes
+            assert ("drain", "m@v2", 1, None) in r.pushes
+            # the advisory floor holds shed-at-router while burning
+            assert eng.floor() == 1
+            assert any(e.get("action") == "rollback"
+                       for e in eng.trail())
+        finally:
+            c.close()
+
+    def test_floor_clears_after_terminal_rollback(self):
+        """The drained canary's frozen (cumulative) reservoir must not
+        pin the advisory floor after the decision — post-rollback only
+        the SURVIVING baseline's burn counts."""
+        c = FleetCollector("slo_rbfloor")
+        r = _FakeRouter()
+        try:
+            eng = _engine()
+            m = _FakeMetrics()
+            _feed(c, m, can_itl=500.0, n=6)
+            eng.tick(c, r)
+            assert eng.state == ROLLED_BACK and eng.floor() == 1
+            # next tick: canary still publishes its stale burn, but the
+            # baseline is clean — the floor releases
+            _feed(c, m, can_itl=500.0, n=1)
+            eng.tick(c, r)
+            assert eng.floor() == 0
+        finally:
+            c.close()
+
+    def test_fleet_wide_burn_is_not_the_canarys_fault(self):
+        c = FleetCollector("slo_fleet")
+        r = _FakeRouter()
+        try:
+            eng = _engine(rollback_margin=10.0)
+            m = _FakeMetrics()
+            # both sides burn EQUALLY: fleet-wide pressure, no verdict
+            _feed(c, m, base_itl=500.0, can_itl=500.0, n=6)
+            assert eng.tick(c, r) == BURNING
+            assert eng.state == RAMPING and r.pushes == []
+            assert eng.floor() == 1
+        finally:
+            c.close()
+
+    def test_error_rate_objective_burns_on_failures(self):
+        c = FleetCollector("slo_err")
+        try:
+            eng = _engine([Objective("error_rate", 0.05)])
+            m = _FakeMetrics()
+            fin, fail = 0, 0
+            for _ in range(6):
+                fin, fail = fin + 2, fail + 1   # 33% errors
+                m.set("m@v1", finished=fin, failed=fail)
+                m.set("m@v2", finished=fin, failed=fail)
+                c.sample_models(m)
+                time.sleep(0.02)
+            v = eng.tick(c, _FakeRouter())
+            assert v == BURNING
+        finally:
+            c.close()
+
+    def test_disruption_holds_the_ramp(self):
+        c = FleetCollector("slo_hold")
+        r = _FakeRouter()
+        try:
+            eng = _engine()
+            m = _FakeMetrics()
+            _feed(c, m, n=6)
+            c.note_dead("r9:9")
+            assert eng.tick(c, r) == HOLD
+            assert eng.state == RAMPING and eng.holds == 1
+            assert r.pushes == []
+            assert eng.clean_streak == 0   # the streak froze at zero
+        finally:
+            c.close()
+
+    def test_observe_only_engine_never_acts(self):
+        c = FleetCollector("slo_obs")
+        r = _FakeRouter()
+        try:
+            eng = _engine(act=False)
+            m = _FakeMetrics()
+            _feed(c, m, can_itl=500.0, n=6)
+            assert eng.tick(c, r) == BURNING
+            assert eng.state == RAMPING and r.pushes == []
+            assert eng.floor() == 1   # the advisory floor still works
+            snap = eng.snapshot()
+            assert snap["last_eval"]["canary"]["verdict"] == BURNING
+        finally:
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# E2E: the canary loop closes over real traffic (acceptance)
+# ---------------------------------------------------------------------------
+
+def _expected(prompt, n, mult):
+    from brpc_tpu.tools.rpc_press import expected_model_tokens
+    return expected_model_tokens(prompt, n, mult)
+
+
+def _assert_bit_exact_either(tokens, prompt, n, mults):
+    """During the ramp the router picks EITHER version — the stream
+    must bit-match exactly one version's oracle (anything else is a
+    mis-route or corruption)."""
+    a = _expected(prompt, n, mults["m@v1"])
+    b = _expected(prompt, n, mults["m@v2"])
+    assert tokens in (a, b), (tokens, a, b)
+    return "m@v1" if tokens == a else "m@v2"
+
+
+def _drive_until(cli, router, engine, mults, *, want_state,
+                 timeout_s=30.0):
+    """Stream generations through the front door until the engine
+    reaches ``want_state``; every stream is checked bit-exact."""
+    deadline = time.monotonic() + timeout_s
+    i = 0
+    while engine.state != want_state:
+        assert time.monotonic() < deadline, \
+            f"engine stuck in {engine.state}: {engine.snapshot()}"
+        prompt = [100 + (i % 7) + j for j in range(6)]
+        g = cli.start(prompt, 4, model="m")
+        assert g.wait(30) and g.error is None
+        _assert_bit_exact_either(g.tokens, prompt, 4, mults)
+        i += 1
+    return i
+
+
+class TestCanaryLoopE2E:
+    def test_healthy_canary_auto_promotes_bit_exact(self):
+        from brpc_tpu.serving import RouterClient
+        from brpc_tpu.tools.rpc_press import (spin_up_multimodel_cluster,
+                                              tear_down_multimodel_cluster)
+        replicas, mults, router, rsrv, raddr = spin_up_multimodel_cluster(
+            2, ["m@v1", "m@v2"], page_tokens=4, name_prefix="slo_e2e_p")
+        try:
+            # the PR 18 split: baseline heavy, canary light
+            router.deploy_model("m@v1", op="deploy", weight=3,
+                                state="warm")
+            router.deploy_model("m@v2", op="deploy", weight=1,
+                                state="warm")
+            eng = SLOEngine(
+                "m", "m@v1", "m@v2",
+                # generous latency targets: a healthy canary must read
+                # OK, never BURNING, on a loaded CI box
+                [Objective("ttft_p99_ms", 60_000.0),
+                 Objective("itl_p99_ms", 60_000.0)],
+                short_window_s=0.3, long_window_s=0.8, clean_windows=3)
+            router.attach_slo(eng)
+            cli = RouterClient(raddr, timeout_ms=10_000)
+            _drive_until(cli, router, eng, mults, want_state=PROMOTED)
+            # the ramp pushed 100/0: only the canary takes new traffic
+            weights = router.catalog.version_weights("m")
+            assert list(weights) == ["m@v2"]
+            for _ in range(10):
+                assert router.resolve_model("m") == "m@v2"
+            p = [40, 41, 42, 43, 44, 45]
+            g = cli.start(p, 5, model="m")
+            assert g.wait(30) and g.error is None
+            assert g.tokens == _expected(p, 5, mults["m@v2"])
+            # the decision trail tells the story, and /fleet renders it
+            acts = [e.get("action") for e in eng.trail()]
+            assert "promote" in acts
+            snap = router.fleet_snapshot()
+            assert snap["slo"]["state"] == PROMOTED
+        finally:
+            tear_down_multimodel_cluster(replicas, router, rsrv)
+
+    def test_slow_canary_auto_rolls_back_bit_exact(self):
+        from brpc_tpu.serving import RouterClient
+        from brpc_tpu.tools.rpc_press import (spin_up_multimodel_cluster,
+                                              tear_down_multimodel_cluster)
+        # ONLY the canary's engine is slow — per-version latency
+        # injection; its tokens stay bit-exact (slow, not wrong)
+        replicas, mults, router, rsrv, raddr = spin_up_multimodel_cluster(
+            2, ["m@v1", "m@v2"], page_tokens=4,
+            step_delay_s={"m@v2": 0.05}, name_prefix="slo_e2e_r")
+        try:
+            router.deploy_model("m@v1", op="deploy", weight=1,
+                                state="warm")
+            router.deploy_model("m@v2", op="deploy", weight=1,
+                                state="warm")
+            eng = SLOEngine(
+                "m", "m@v1", "m@v2",
+                # the injected 50ms/step ITL burns a 5ms target ~10x;
+                # the clean baseline stays far under it
+                [Objective("itl_p99_ms", 5.0)],
+                short_window_s=0.3, long_window_s=0.8,
+                clean_windows=1000)   # never promote in this test
+            router.attach_slo(eng)
+            cli = RouterClient(raddr, timeout_ms=20_000)
+            _drive_until(cli, router, eng, mults,
+                         want_state=ROLLED_BACK)
+            # rolled back: baseline-only, and still bit-exact
+            weights = router.catalog.version_weights("m")
+            assert list(weights) == ["m@v1"]
+            for _ in range(10):
+                assert router.resolve_model("m") == "m@v1"
+            p = [70, 71, 72, 73, 74, 75]
+            g = cli.start(p, 5, model="m")
+            assert g.wait(30) and g.error is None
+            assert g.tokens == _expected(p, 5, mults["m@v1"])
+            acts = [e.get("action") for e in eng.trail()]
+            assert "rollback" in acts and "promote" not in acts
+            snap = router.fleet_snapshot()
+            assert snap["slo"]["state"] == ROLLED_BACK
+        finally:
+            tear_down_multimodel_cluster(replicas, router, rsrv)
+
+
+# ---------------------------------------------------------------------------
+# E2E: one /rpcz?trace_id= tree from THREE OS processes (acceptance)
+# ---------------------------------------------------------------------------
+
+_LEAF_SRC = """
+import sys
+import brpc_tpu as brpc
+from brpc_tpu import rpcz
+from brpc_tpu.serving.telemetry import register_telemetry
+
+rpcz.set_enabled(True, 1.0)
+
+
+class Leaf(brpc.Service):
+    @brpc.method(request="json", response="json")
+    def Do(self, cntl, req):
+        return {"leaf": "ok"}
+
+
+srv = brpc.Server()
+srv.add_service(Leaf())
+register_telemetry(srv, name="leaf")
+srv.start("127.0.0.1", 0)
+print(f"PORT {srv.port}", flush=True)
+sys.stdin.read()   # parent closes stdin to stop us
+srv.stop()
+srv.join()
+"""
+
+_HOP_SRC = """
+import sys
+import brpc_tpu as brpc
+from brpc_tpu import rpcz
+from brpc_tpu.serving.telemetry import register_telemetry
+
+LEAF_ADDR = sys.argv[1]
+rpcz.set_enabled(True, 1.0)
+leaf_ch = brpc.Channel(LEAF_ADDR, timeout_ms=5000)
+
+
+class Hop(brpc.Service):
+    @brpc.method(request="json", response="json")
+    def Fwd(self, cntl, req):
+        # client span around the onward call, remote_side naming the
+        # leaf — the address the router's fan-out FOLLOWS to reach a
+        # process it never talks to directly (the PS-shard hop)
+        span = rpcz.child_span("client", "Leaf", "Do")
+        span.remote_side = LEAF_ADDR
+        prev = rpcz.get_current_span()
+        rpcz.set_current_span(span)
+        try:
+            return leaf_ch.call_sync("Leaf", "Do", {},
+                                     serializer="json")
+        finally:
+            rpcz.set_current_span(prev)
+            rpcz.submit(span)
+
+
+srv = brpc.Server()
+srv.add_service(Hop())
+register_telemetry(srv, name="hop")
+srv.start("127.0.0.1", 0)
+print(f"PORT {srv.port}", flush=True)
+sys.stdin.read()
+srv.stop()
+srv.join()
+"""
+
+
+def _spawn_helper(tmp_path, name, src, *args):
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent(src))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, str(path), *args],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, cwd=REPO, text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("PORT "), f"{name} failed to start: {line!r}"
+    return proc, f"127.0.0.1:{line.split()[1]}"
+
+
+def _stop_helper(proc):
+    try:
+        proc.stdin.close()
+        proc.wait(timeout=10)
+    except Exception:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+class TestThreeProcessTraceStitching:
+    def test_rpcz_trace_id_renders_spans_from_three_processes(
+            self, tmp_path):
+        import http.client
+
+        from brpc_tpu.serving import ClusterRouter, ReplicaHandle
+
+        rpcz.set_enabled(True, 1.0)
+        leaf = hop = None
+        router = None
+        console = brpc.Server()
+        console.start("127.0.0.1", 0)
+        try:
+            leaf, leaf_addr = _spawn_helper(tmp_path, "leaf", _LEAF_SRC)
+            hop, hop_addr = _spawn_helper(tmp_path, "hop", _HOP_SRC,
+                                          leaf_addr)
+            # the router knows ONLY the hop replica; the leaf joins the
+            # tree through the hop's client span's remote_side
+            router = ClusterRouter([ReplicaHandle(hop_addr)],
+                                   name="trace3_router",
+                                   auto_tick=False)
+            # THIS process's half of the trace: a root client span
+            # around the call into the hop
+            root = rpcz.new_span("client", "Hop", "Fwd")
+            rpcz.set_current_span(root)
+            try:
+                ch = brpc.Channel(hop_addr, timeout_ms=10_000)
+                r = ch.call_sync("Hop", "Fwd", {}, serializer="json")
+                assert r == {"leaf": "ok"}
+            finally:
+                rpcz.set_current_span(None)
+                rpcz.submit(root)
+            _flush_rpcz()
+            tid = root.trace_id
+
+            def pids_of(spans):
+                # span ids are pid-salted: span_id >> 40 IS the process
+                return {s.span_id >> 40 for s in spans}
+
+            # the helpers' collectors hand spans over asynchronously —
+            # poll the fan-out until all three processes answered
+            spans = []
+            for _ in range(80):
+                spans = router.trace_fanout(tid)
+                if len(pids_of(spans)) >= 3:
+                    break
+                time.sleep(0.05)
+            assert len(pids_of(spans)) >= 3, \
+                f"only {pids_of(spans)} from {len(spans)} spans"
+            kinds = {(s.kind, s.service) for s in spans}
+            assert ("client", "Hop") in kinds     # this process
+            assert ("server", "Hop") in kinds     # hop ingress
+            assert ("client", "Leaf") in kinds    # hop's onward call
+            assert ("server", "Leaf") in kinds    # leaf ingress
+
+            # ONE console query renders the stitched tree
+            c = http.client.HTTPConnection("127.0.0.1", console.port,
+                                           timeout=10)
+            c.request("GET", f"/rpcz?trace_id={tid}")
+            resp = c.getresponse()
+            body = resp.read().decode()
+            c.close()
+            assert resp.status == 200
+            assert "stitched across 3 processes" in body
+            assert "Leaf" in body and "Hop" in body
+        finally:
+            if router is not None:
+                router.close(timeout_s=3.0)
+            console.stop()
+            console.join()  # brpc-check: allow(wedge-hygiene) — stopped console server, joins instantly
+            for p in (hop, leaf):
+                if p is not None:
+                    _stop_helper(p)

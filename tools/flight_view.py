@@ -1,0 +1,200 @@
+"""flight_view — render a wedge-hunt/autopsy dump as a per-thread
+timeline (ISSUE 20 satellite; closes the loop ISSUE 15 opened).
+
+``tools/wedge_hunt.py`` and tests/wedge_guard.py leave wedge evidence
+as flat text artifacts (``build/wedge_hunt/``, ``build/wedge_autopsy/``)
+whose flight-recorder section interleaves every native thread's events
+into one merged tail.  Reading one still means manually correlating
+"what did the epoll thread do while worker_3 stopped" across hundreds
+of lines.  This tool re-renders the dump the way a wedge is actually
+triaged:
+
+  * the LAST-EVENT TABLE first, sorted stalest-last — the wedged
+    thread is the live one whose last event is oldest, so the answer
+    reads off the bottom row;
+  * then the merged tail as a PER-THREAD LANE TIMELINE: one column per
+    native thread (the busiest N get their own lane), timestamps
+    rebased to the tail's start, so vertical whitespace in a lane IS
+    the stall, visually.
+
+Usage:
+    python tools/flight_view.py [DUMP.log ...] [--lanes N] [--limit N]
+    python tools/flight_view.py          # newest artifact under build/
+
+A dump may carry several appended autopsies (wedge_hunt concatenates
+them); the LAST flight-recorder section is rendered — it is the one
+closest to the hang.  Exit 3 when no artifact exists yet.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_THREAD_RE = re.compile(
+    r"tid=(\S+)\s+(\S+)\s+(live|exited)\s+last=(\S+)\s+"
+    r"age_us=(\S+)\s+events=(\d+)\s+dropped=(\d+)")
+_EVENT_RE = re.compile(
+    r"^\s+(\d+)\s+(\S+)\s+(\S+)\s+a=0x([0-9a-fA-F]+)\s+b=(-?\d+)")
+_FLIGHT_HEADER = "flight recorder:"
+_TAIL_HEADER = "--- merged event tail"
+
+
+def newest_artifact() -> str | None:
+    """The most recent wedge artifact under build/wedge_hunt/ (incl.
+    per-run autopsy dirs) and build/wedge_autopsy/."""
+    pats = [os.path.join(REPO, "build", "wedge_hunt", "**", "*.log"),
+            os.path.join(REPO, "build", "wedge_autopsy", "*.log")]
+    paths = [p for pat in pats for p in glob.glob(pat, recursive=True)]
+    if not paths:
+        return None
+    return max(paths, key=os.path.getmtime)
+
+
+def parse_dump(text: str) -> dict | None:
+    """The LAST flight-recorder section of a dump: recorder/syscall
+    header lines, the per-thread table, the merged event tail.  None
+    when the dump carries no flight section (e.g. a witness-only dump
+    from a build without the native core)."""
+    start = text.rfind(_FLIGHT_HEADER)
+    if start < 0:
+        return None
+    section = text[start:]
+    header: list[str] = []
+    threads: list[dict] = []
+    events: list[dict] = []
+    in_tail = False
+    for line in section.splitlines():
+        if line.startswith("==="):
+            break   # the next appended autopsy section
+        m = _THREAD_RE.search(line)
+        if m:
+            tid, name, live, last, age, nev, ndrop = m.groups()
+            try:
+                age_v = float(age)
+            except ValueError:
+                age_v = float("inf")
+            threads.append({"tid": tid, "thread": name,
+                            "live": live == "live", "last": last,
+                            "age_us": age_v, "events": int(nev),
+                            "dropped": int(ndrop)})
+            continue
+        if _TAIL_HEADER in line:
+            in_tail = True
+            continue
+        if in_tail:
+            m = _EVENT_RE.match(line)
+            if m:
+                ts, name, kind, a, b = m.groups()
+                events.append({"ts_us": int(ts), "thread": name,
+                               "kind": kind, "a": int(a, 16),
+                               "b": int(b)})
+            continue
+        if line.strip() and not line.startswith("---"):
+            header.append(line.rstrip())
+    return {"header": header[:4], "threads": threads, "events": events}
+
+
+def render(parsed: dict, *, lanes: int = 6, limit: int = 200) -> str:
+    out: list[str] = list(parsed["header"])
+    out.append("")
+
+    # 1. last-event table, stalest LAST: on a wedge, the bottom live
+    # row names the thread that stopped advancing
+    threads = sorted(parsed["threads"], key=lambda t: t["age_us"])
+    if threads:
+        out.append("--- last event per thread (stalest last; a wedged "
+                   "thread is a LIVE row with an old age) ---")
+        out.append(f"{'thread':<14}{'tid':<10}{'state':<8}"
+                   f"{'last event':<16}{'age_us':>14}{'events':>9}"
+                   f"{'dropped':>9}")
+        for t in threads:
+            age = ("?" if t["age_us"] == float("inf")
+                   else f"{t['age_us']:.0f}")
+            out.append(f"{t['thread']:<14}{t['tid']:<10}"
+                       f"{'live' if t['live'] else 'exited':<8}"
+                       f"{t['last']:<16}{age:>14}{t['events']:>9}"
+                       f"{t['dropped']:>9}")
+        out.append("")
+
+    # 2. per-thread lane timeline over the tail
+    events = parsed["events"][-max(1, limit):]
+    if not events:
+        out.append("(no merged event tail in this dump)")
+        return "\n".join(out) + "\n"
+    by_thread: dict[str, int] = {}
+    for e in events:
+        by_thread[e["thread"]] = by_thread.get(e["thread"], 0) + 1
+    laned = [n for n, _c in sorted(by_thread.items(),
+                                   key=lambda kv: -kv[1])][:max(1, lanes)]
+    lane_of = {n: i for i, n in enumerate(sorted(laned))}
+    width = 24
+    t0 = events[0]["ts_us"]
+    cols = "".join(f"{n[:width - 2]:<{width}}" for n in sorted(laned))
+    out.append(f"--- timeline ({len(events)} events, lanes = "
+               f"{len(laned)} busiest threads"
+               + (f" of {len(by_thread)}" if len(by_thread) > len(laned)
+                  else "") + "; +offset µs from tail start) ---")
+    out.append(f"{'+µs':>12}  {cols}" + ("other" if len(by_thread)
+                                         > len(laned) else ""))
+    for e in events:
+        cell = f"{e['kind']} b={e['b']}"
+        lane = lane_of.get(e["thread"])
+        if lane is None:
+            row = " " * (width * len(laned)) + \
+                f"{e['thread']}:{cell}"
+        else:
+            row = " " * (width * lane) + f"{cell:<{width}}"
+        out.append(f"{e['ts_us'] - t0:>12}  {row.rstrip()}")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render wedge-hunt flight-recorder dumps as a "
+                    "per-thread timeline")
+    ap.add_argument("dumps", nargs="*",
+                    help="artifact files (default: newest under "
+                         "build/wedge_hunt/ and build/wedge_autopsy/)")
+    ap.add_argument("--lanes", type=int, default=6,
+                    help="timeline lanes for the busiest N threads "
+                         "(default 6)")
+    ap.add_argument("--limit", type=int, default=200,
+                    help="tail events rendered (default 200)")
+    a = ap.parse_args(argv)
+    paths = a.dumps
+    if not paths:
+        p = newest_artifact()
+        if p is None:
+            print("flight_view: no wedge artifacts under "
+                  "build/wedge_hunt/ or build/wedge_autopsy/ — run "
+                  "`make wedge-hunt` (or wait for a tier-1 wedge) "
+                  "first", file=sys.stderr)
+            return 3
+        paths = [p]
+    rc = 0
+    for path in paths:
+        try:
+            with open(path, errors="replace") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"flight_view: cannot read {path}: {e}",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        print(f"=== {path} ===")
+        parsed = parse_dump(text)
+        if parsed is None:
+            print("(no flight-recorder section in this dump — "
+                  "witness/stack dump only)")
+            continue
+        sys.stdout.write(render(parsed, lanes=a.lanes, limit=a.limit))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,12 +34,17 @@ _LOWER_BETTER_SUFFIXES = ("_us", "_ms", "_s")
 _LOWER_BETTER_KEYS = {"overhead_pct", "overhead_pct_vs_off",
                       "lat_us", "shed_frac", "err_frac",
                       "router_overhead_pct", "wal_overhead_pct",
+                      "telemetry_overhead_pct",
                       "serving_host_us_per_token"}
 _HIGHER_BETTER_KEYS = {"qps", "gbps", "tokens_per_s", "items_per_s",
                        "hbm_traffic_gbps", "qps_off", "qps_on",
                        "speedup_at_peak", "zero_copy_speedup",
                        "prefill_skip_ratio",
                        "direct_gens_per_s", "router_gens_per_s",
+                       "telemetry_off_gens_per_s",
+                       "telemetry_on_gens_per_s",
+                       "single_model_gens_per_s",
+                       "two_model_gens_per_s",
                        "wal_off_gens_per_s", "wal_on_gens_per_s",
                        "native_speedup",
                        "batched_lookups_per_s",
